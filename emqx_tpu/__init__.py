@@ -1,0 +1,20 @@
+"""emqx_tpu — a TPU-native messaging framework with EMQX's capabilities.
+
+The north-star hot path (reference: emqx_broker:publish ->
+emqx_router:match_routes, apps/emqx/src/emqx_broker.erl:293-298,
+apps/emqx/src/emqx_router.erl:205-212) is re-expressed as a batched,
+vmap'd wildcard-match kernel over a flattened filter table resident in
+TPU HBM (`emqx_tpu.ops.match`), fronted by an incremental router
+(`emqx_tpu.models.router`) and an asyncio MQTT broker
+(`emqx_tpu.broker`).
+
+Layout:
+  ops/       pure + device kernels: topic algebra, dictionary encoding,
+             filter tables, the batched matcher, Pallas variants
+  models/    stateful engines built on ops: router, shared subs, retainer
+  parallel/  device mesh, shardings, multi-chip match (shard_map)
+  broker/    the MQTT runtime: frame codec, channel, session, server
+  utils/     ids, config, misc
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,1 @@
+"""Pure topic algebra and device-side match kernels."""

@@ -1,0 +1,202 @@
+"""MQTT topic algebra — the pure host-side oracle.
+
+Behavioral parity with the reference broker's topic module
+(apps/emqx/src/emqx_topic.erl): words/join parsing, wildcard detection,
+the single-pair matcher `match` (emqx_topic.erl:80-116) that every index
+implementation is property-tested against, filter intersection
+(emqx_topic.erl:125-169), and `$share/Group/Topic` parsing.
+
+Semantics (MQTT 3.1.1 / 5.0):
+  * Topics split on '/'; empty levels are legal distinct words
+    ("a//b" == ["a", "", "b"], "/a" == ["", "a"]).
+  * '+' matches exactly one level (any value, including empty).
+  * '#' matches zero or more trailing levels and must be last
+    ("sport/#" matches "sport").
+  * A topic whose FIRST level starts with '$' is not matched by a filter
+    whose first level is '+' or '#' (emqx_topic.erl:83-101); deeper
+    levels have no '$' special-casing.
+
+Everything here is plain Python over tuples of str — this module is the
+correctness oracle for the TPU kernels in emqx_tpu.ops.match.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional, Sequence, Tuple
+
+Words = Tuple[str, ...]
+
+MAX_TOPIC_LEN = 65535  # wire-format limit (2-byte length prefix)
+
+
+def words(topic: str) -> Words:
+    """Split a topic/filter into its levels. '' -> ('',)."""
+    return tuple(topic.split("/"))
+
+
+def join(ws: Iterable[str]) -> str:
+    return "/".join(ws)
+
+
+def is_wildcard(topic_or_words) -> bool:
+    """True if the filter contains '+' or '#' (emqx_topic.erl:65-77)."""
+    ws = words(topic_or_words) if isinstance(topic_or_words, str) else topic_or_words
+    return any(w in ("+", "#") for w in ws)
+
+
+def validate_name(topic: str) -> None:
+    """Validate a topic NAME (publish target): no wildcards allowed."""
+    _validate_common(topic)
+    if is_wildcard(topic):
+        raise ValueError(f"wildcard not allowed in topic name: {topic!r}")
+
+
+def validate_filter(topic: str) -> None:
+    """Validate a topic FILTER (subscription). '$share/...' filters are
+    validated through share parsing (emqx_topic.erl validate_share)."""
+    _validate_common(topic)
+    if topic.startswith(SHARE_PREFIX + "/"):
+        _, topic = parse_share(topic)
+    ws = words(topic)
+    for i, w in enumerate(ws):
+        if w == "#":
+            if i != len(ws) - 1:
+                raise ValueError(f"'#' must be the last level: {topic!r}")
+        elif "#" in w or "+" in w:
+            if w not in ("+", "#"):
+                raise ValueError(f"wildcard must occupy entire level: {topic!r}")
+
+
+def _validate_common(topic: str) -> None:
+    if topic == "":
+        raise ValueError("empty topic")
+    if len(topic.encode("utf-8")) > MAX_TOPIC_LEN:
+        raise ValueError("topic too long")
+    if "\x00" in topic:
+        raise ValueError("NUL byte in topic")
+
+
+def match(name, flt) -> bool:
+    """Does topic `name` match filter `flt`? (emqx_topic.erl:80-116).
+
+    Accepts str or word-tuples for either side. This is the 30-line
+    reference matcher used as the oracle for every index/kernel.
+    """
+    nw = words(name) if isinstance(name, str) else tuple(name)
+    fw = words(flt) if isinstance(flt, str) else tuple(flt)
+    if nw and nw[0].startswith("$") and fw and fw[0] in ("+", "#"):
+        return False
+    return _match_tokens(nw, fw)
+
+
+def _match_tokens(nw: Words, fw: Words) -> bool:
+    for i, f in enumerate(fw):
+        if f == "#" and i == len(fw) - 1:
+            return True  # matches remainder, including zero levels
+        if i >= len(nw):
+            return False
+        if f != "+" and f != nw[i]:
+            return False
+    return len(nw) == len(fw)
+
+
+def intersection(t1, t2) -> Optional[str]:
+    """Intersection of two topics/filters (emqx_topic.erl:118-169).
+
+    Returns the most general filter matching exactly the topics matched
+    by both inputs, or None if disjoint. Commutative.
+    """
+    w1 = words(t1) if isinstance(t1, str) else tuple(t1)
+    w2 = words(t2) if isinstance(t2, str) else tuple(t2)
+    out = _intersect_words(w1, w2)
+    return None if out is None else join(out)
+
+
+def _intersect_words(w1: Words, w2: Words) -> Optional[Words]:
+    # '$'-root rule: a wildcard root level never covers '$'-topics, so a
+    # literal '$'-root on one side cannot intersect a wildcard root on
+    # the other (mirrors emqx_topic.erl intersect_start/2).
+    if w1 and w1[0].startswith("$") and w2 and w2[0] in ("+", "#"):
+        return None
+    if w2 and w2[0].startswith("$") and w1 and w1[0] in ("+", "#"):
+        return None
+    return _intersect(w1, w2)
+
+
+def _intersect(w1: Words, w2: Words) -> Optional[Words]:
+    # mirrors emqx_topic.erl intersect/2:144-163, iteratively (topics may
+    # have tens of thousands of levels within the 64KiB wire limit)
+    out = []
+    n1, n2 = len(w1), len(w2)
+    i = 0
+    while True:
+        l1, l2 = n1 - i, n2 - i
+        if l2 == 1 and w2[i] == "#":
+            return tuple(out) + w1[i:]
+        if l1 == 1 and w1[i] == "#":
+            return tuple(out) + w2[i:]
+        if l1 == 1 and l2 == 1 and w2[i] == "+":
+            return tuple(out) + (w1[i],)
+        if l1 == 1 and l2 == 1 and w1[i] == "+":
+            return tuple(out) + (w2[i],)
+        if l1 <= 0 or l2 <= 0:
+            return tuple(out) if l1 == 0 and l2 == 0 else None
+        a, b = w1[i], w2[i]
+        a_wild = a in ("+", "#")
+        b_wild = b in ("+", "#")
+        if a_wild and b_wild:
+            out.append(a if a == b else "+")
+        elif a == b:
+            out.append(a)
+        elif a_wild:
+            out.append(b)
+        elif b_wild:
+            out.append(a)
+        else:
+            return None
+        i += 1
+
+
+def is_subset(flt1, flt2) -> bool:
+    """True if every topic matching flt1 also matches flt2
+    (emqx_topic.erl:172-178: intersection(f1, f2) == f1)."""
+    f1 = flt1 if isinstance(flt1, str) else join(flt1)
+    return intersection(f1, flt2) == f1
+
+
+def union(filters: Sequence[str]) -> list:
+    """Smallest covering set: drop filters subsumed by another
+    (emqx_topic.erl:184-192). Not optimal — pairs may still intersect."""
+    out = []
+    rest = list(filters)
+    while rest:
+        head, rest = rest[0], rest[1:]
+        disjoint = [f for f in rest if not is_subset(f, head)]
+        if not any(is_subset(head, f) for f in disjoint):
+            out.append(head)
+        rest = disjoint
+    return out
+
+
+# --- shared subscriptions ($share/Group/Topic) --------------------------
+
+SHARE_PREFIX = "$share"
+
+
+def parse_share(flt: str) -> Tuple[Optional[str], str]:
+    """Split '$share/Group/Real/Topic' -> ('Group', 'Real/Topic');
+    plain filters -> (None, flt). (emqx_topic.erl make_shared_record)."""
+    if flt.startswith(SHARE_PREFIX + "/"):
+        rest = flt[len(SHARE_PREFIX) + 1 :]
+        group, sep, real = rest.partition("/")
+        if not sep or group == "" or real == "":
+            raise ValueError(f"malformed shared subscription: {flt!r}")
+        if "+" in group or "#" in group:
+            raise ValueError(f"wildcard in share group: {flt!r}")
+        return group, real
+    return None, flt
+
+
+def feed_var(var: str, value: str, topic: str) -> str:
+    """Substitute ${var} placeholders per level (emqx_topic.erl feed_var)."""
+    return join(value if w == var else w for w in words(topic))

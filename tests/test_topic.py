@@ -1,0 +1,183 @@
+"""Oracle tests for emqx_tpu.ops.topic.
+
+Cases mirror the reference's emqx_topic_SUITE / inline doc semantics
+(apps/emqx/src/emqx_topic.erl:80-116, 125-169).
+"""
+
+import random
+
+import pytest
+
+from emqx_tpu.ops import topic as T
+
+
+# --- words / join -------------------------------------------------------
+
+def test_words():
+    assert T.words("a/b/c") == ("a", "b", "c")
+    assert T.words("/a") == ("", "a")
+    assert T.words("a//b") == ("a", "", "b")
+    assert T.words("a/b/") == ("a", "b", "")
+    assert T.words("") == ("",)
+    assert T.join(T.words("a//b/")) == "a//b/"
+
+
+def test_wildcard():
+    assert T.is_wildcard("a/+/b")
+    assert T.is_wildcard("#")
+    assert not T.is_wildcard("a/b")
+    assert not T.is_wildcard("a/b+c")  # '+' must occupy whole level
+
+
+# --- match: positives ---------------------------------------------------
+
+MATCHES = [
+    ("a/b/c", "a/b/c"),
+    ("a/b/c", "a/+/c"),
+    ("a/b/c", "+/+/+"),
+    ("a/b/c", "#"),
+    ("a/b/c", "a/#"),
+    ("a/b/c", "a/b/#"),
+    ("a/b/c", "a/b/c/#"),  # '#' matches zero levels ("sport/#" ~ "sport")
+    ("sport", "sport/#"),
+    ("a", "+"),
+    ("/a", "+/a"),
+    ("/a", "/+"),
+    ("a//b", "a/+/b"),
+    ("a//", "a/+/+"),
+    ("$SYS/broker", "$SYS/broker"),
+    ("$SYS/broker", "$SYS/#"),
+    ("$SYS/broker", "$SYS/+"),
+    ("a/$sys/b", "a/+/b"),  # '$' only special at level 0
+    ("a/$sys", "a/#"),
+]
+
+NONMATCHES = [
+    ("a/b/c", "a/b"),
+    ("a/b", "a/b/c"),
+    ("a/b", "a/b/+"),  # '+' matches exactly one level
+    ("a/b/c", "b/+/c"),
+    ("a/b/c", "+"),
+    ("$SYS/broker", "#"),  # '$'-root not matched by root wildcards
+    ("$SYS/broker", "+/broker"),
+    ("$SYS", "+"),
+    ("$SYS", "#"),
+    ("a", "a/+"),
+    ("a", "/a"),
+    ("a/b/c/d", "a/+/c"),
+]
+
+
+@pytest.mark.parametrize("name,flt", MATCHES)
+def test_match_positive(name, flt):
+    assert T.match(name, flt), f"{name!r} should match {flt!r}"
+
+
+@pytest.mark.parametrize("name,flt", NONMATCHES)
+def test_match_negative(name, flt):
+    assert not T.match(name, flt), f"{name!r} should NOT match {flt!r}"
+
+
+# --- validate -----------------------------------------------------------
+
+def test_validate():
+    T.validate_filter("a/+/b/#")
+    T.validate_name("a/b/c")
+    with pytest.raises(ValueError):
+        T.validate_name("a/+/b")
+    with pytest.raises(ValueError):
+        T.validate_filter("a/#/b")
+    with pytest.raises(ValueError):
+        T.validate_filter("a/b+/c")
+    with pytest.raises(ValueError):
+        T.validate_filter("")
+
+
+# --- intersection / subset / union -------------------------------------
+
+def test_intersection():
+    # the doc example: emqx_topic.erl:118-124
+    assert T.intersection("t/global/#", "t/+/1/+") == "t/global/1/+"
+    assert T.intersection("a/b", "a/b") == "a/b"
+    assert T.intersection("a/b", "a/c") is None
+    assert T.intersection("a/+", "+/b") == "a/b"
+    assert T.intersection("#", "a/b/#") == "a/b/#"
+    assert T.intersection("+/+", "a/#") == "a/+"
+    assert T.intersection("$SYS/#", "#") is None  # '$'-root rule
+    assert T.intersection("a/b/c", "#") == "a/b/c"
+
+
+def test_intersection_commutative_random():
+    rng = random.Random(7)
+    vocab = ["a", "b", "c", "+", "#", ""]
+
+    def mk():
+        n = rng.randint(1, 5)
+        ws = [rng.choice(vocab) for _ in range(n)]
+        ws = [w for i, w in enumerate(ws) if w != "#" or i == len(ws) - 1]
+        return "/".join(ws) if ws else "a"
+
+    for _ in range(500):
+        f1, f2 = mk(), mk()
+        assert T.intersection(f1, f2) == T.intersection(f2, f1)
+
+
+def test_intersection_soundness_random():
+    # any topic matching the intersection matches both inputs
+    rng = random.Random(11)
+    vocab = ["a", "b", "c"]
+    for _ in range(300):
+        n = rng.randint(1, 4)
+        f1 = "/".join(rng.choice(vocab + ["+"]) for _ in range(n))
+        f2 = "/".join(rng.choice(vocab + ["+"]) for _ in range(n))
+        inter = T.intersection(f1, f2)
+        topic = "/".join(rng.choice(vocab) for _ in range(n))
+        if inter is not None and T.match(topic, inter):
+            assert T.match(topic, f1) and T.match(topic, f2)
+        if T.match(topic, f1) and T.match(topic, f2):
+            assert inter is not None and T.match(topic, inter)
+
+
+def test_is_subset_union():
+    assert T.is_subset("a/b/c", "a/#")
+    assert T.is_subset("a/+/c", "a/#")
+    assert not T.is_subset("a/#", "a/+/c")
+    assert T.union(["a/b", "a/#", "c"]) == ["a/#", "c"]
+
+
+# --- shared subs --------------------------------------------------------
+
+def test_parse_share():
+    assert T.parse_share("$share/g1/a/b") == ("g1", "a/b")
+    assert T.parse_share("a/b") == (None, "a/b")
+    assert T.parse_share("$shareish/a") == (None, "$shareish/a")
+    with pytest.raises(ValueError):
+        T.parse_share("$share/g1")
+    with pytest.raises(ValueError):
+        T.parse_share("$share/+/t")
+
+
+def test_feed_var():
+    assert T.feed_var("${c}", "cid42", "a/${c}/b") == "a/cid42/b"
+
+
+# --- regressions --------------------------------------------------------
+
+def test_non_terminal_hash_in_word_tuple():
+    # match_tokens(_, ['#']) only fires when '#' is the WHOLE remainder
+    assert not T.match("a", ("#", "x"))
+    assert not T.match(("a", "b"), ("#", "b"))
+
+
+def test_validate_filter_share():
+    T.validate_filter("$share/g1/t/#")
+    with pytest.raises(ValueError):
+        T.validate_filter("$share/+/t")
+    with pytest.raises(ValueError):
+        T.validate_filter("$share/g")
+
+
+def test_deep_topics_no_recursion():
+    deep = "/".join(["a"] * 30000)
+    assert T.intersection(deep, deep) == deep
+    assert T.match(deep, "/".join(["+"] * 30000))
