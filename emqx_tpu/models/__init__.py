@@ -1,0 +1,1 @@
+"""Stateful engines built on ops: router, shared subs, retainer, ..."""

@@ -1,0 +1,248 @@
+"""The route table: Topic/Filter -> destinations, with a TPU-resident
+wildcard matcher kept coherent by batched incremental sync.
+
+Reproduces the reference v2 routing split (apps/emqx/src/emqx_router.erl):
+  * exact-topic routes in a plain host hash table
+    (?ROUTE_TAB ets bag, emqx_router.erl:511-516 first leg) — these
+    never need the device;
+  * wildcard routes in BOTH a host trie (ops/host_index.py — the
+    single-publish cut-through path) and the flattened device table
+    (ops/table.py + ops/match.py — the batched scale path);
+  * a (filter, dest) pair is one logical route; duplicates refcount
+    (bag semantics of mria route tables).
+
+Device coherence mirrors emqx_router_syncer (apps/emqx/src/
+emqx_router_syncer.erl:57 ?MAX_BATCH_SIZE 1000): dirty rows drain in
+fixed-size scatter batches through one pre-compiled donated XLA update,
+so steady-state sync never recompiles; only capacity growth re-uploads.
+
+Destinations are opaque hashables — node ids, session ids, or
+(group, dest) tuples for shared subscriptions (emqx_broker.erl:405-406
+routes to {Group, Node} dests the same way).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Hashable, List, Optional, Sequence, Set, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import match as match_ops
+from ..ops import topic as topic_mod
+from ..ops.host_index import TopicTrie
+from ..ops.table import EncodedFilters, FilterTable, FilterTooDeep
+
+Dest = Hashable
+
+SYNC_BATCH_SIZE = 1024  # rows per scatter step (ref: ?MAX_BATCH_SIZE 1000)
+
+
+@functools.partial(jax.jit, donate_argnums=0)
+def _scatter_rows(
+    dev: EncodedFilters,
+    rows: jnp.ndarray,  # int32 [K]
+    words: jnp.ndarray,  # int32 [K, L]
+    prefix_len: jnp.ndarray,  # int32 [K]
+    has_hash: jnp.ndarray,  # bool [K]
+    root_wild: jnp.ndarray,  # bool [K]
+    active: jnp.ndarray,  # bool [K]
+) -> EncodedFilters:
+    return EncodedFilters(
+        dev.words.at[rows].set(words),
+        dev.prefix_len.at[rows].set(prefix_len),
+        dev.has_hash.at[rows].set(has_hash),
+        dev.root_wild.at[rows].set(root_wild),
+        dev.active.at[rows].set(active),
+    )
+
+
+class DeviceTable:
+    """Device-resident mirror of a FilterTable, synced by batched
+    scatter updates (double-buffer-free: XLA donation updates in place)."""
+
+    def __init__(self, table: FilterTable, device=None) -> None:
+        self.table = table
+        self.device = device
+        self._dev: Optional[EncodedFilters] = None
+        self._synced_capacity = 0
+
+    def _upload_full(self) -> None:
+        snap = self.table.snapshot()
+        arrs = [np.ascontiguousarray(a) for a in snap]
+        if self.device is not None:
+            self._dev = EncodedFilters(
+                *(jax.device_put(a, self.device) for a in arrs)
+            )
+        else:
+            self._dev = EncodedFilters(*(jnp.asarray(a) for a in arrs))
+        self._synced_capacity = self.table.capacity
+
+    def sync(self) -> int:
+        """Bring device state up to date; returns rows written."""
+        t = self.table
+        if self._dev is None or t.grew or t.capacity != self._synced_capacity:
+            n = len(t.dirty)
+            t.drain_dirty()
+            self._upload_full()
+            return n
+        dirty = t.drain_dirty()
+        total = len(dirty)
+        for off in range(0, total, SYNC_BATCH_SIZE):
+            batch = dirty[off : off + SYNC_BATCH_SIZE]
+            k = len(batch)
+            rows = np.empty(SYNC_BATCH_SIZE, np.int32)
+            rows[:k] = batch
+            rows[k:] = batch[-1]  # idempotent padding: rewrite last row
+            self._dev = _scatter_rows(
+                self._dev,
+                jnp.asarray(rows),
+                jnp.asarray(t.words[rows]),
+                jnp.asarray(t.prefix_len[rows]),
+                jnp.asarray(t.has_hash[rows]),
+                jnp.asarray(t.root_wild[rows]),
+                jnp.asarray(t.active[rows]),
+            )
+        return total
+
+    def filters(self) -> EncodedFilters:
+        assert self._dev is not None, "sync() before matching"
+        return self._dev
+
+
+class Router:
+    """Topic/filter -> dests with exact/wildcard split and device
+    offload for batched wildcard matching."""
+
+    def __init__(self, max_levels: int = 16, device=None) -> None:
+        self.max_levels = max_levels
+        # exact topics: host hash (never on device — the v2 split)
+        self._exact: Dict[str, Dict[Dest, int]] = {}
+        # wildcard filters
+        self.table = FilterTable(max_levels=max_levels)
+        self._trie = TopicTrie()  # host cut-through; ids are table rows
+        self._pair_row: Dict[Tuple[str, Dest], int] = {}
+        self._pair_refs: Dict[Tuple[str, Dest], int] = {}
+        self._row_dest: Dict[int, Tuple[str, Dest]] = {}
+        # filters too deep for the flattened table: host-only
+        self._deep: Dict[Tuple[str, Dest], int] = {}
+        self.device_table = DeviceTable(self.table, device=device)
+
+    # --- write path (emqx_router:do_add_route / do_delete_route) -------
+
+    def add_route(self, flt: str, dest: Dest) -> None:
+        if not topic_mod.is_wildcard(flt):
+            dests = self._exact.setdefault(flt, {})
+            dests[dest] = dests.get(dest, 0) + 1
+            return
+        key = (flt, dest)
+        if key in self._pair_refs:
+            self._pair_refs[key] += 1
+            return
+        if key in self._deep:
+            self._deep[key] += 1
+            return
+        try:
+            row = self.table.add(flt)
+        except FilterTooDeep:
+            self._deep[key] = 1
+            return
+        self._pair_row[key] = row
+        self._pair_refs[key] = 1
+        self._row_dest[row] = key
+        self._trie.insert(topic_mod.words(flt), row)
+
+    def delete_route(self, flt: str, dest: Dest) -> None:
+        if not topic_mod.is_wildcard(flt):
+            dests = self._exact.get(flt)
+            if not dests or dest not in dests:
+                return
+            dests[dest] -= 1
+            if dests[dest] == 0:
+                del dests[dest]
+                if not dests:
+                    del self._exact[flt]
+            return
+        key = (flt, dest)
+        if key in self._deep:
+            self._deep[key] -= 1
+            if self._deep[key] == 0:
+                del self._deep[key]
+            return
+        if key not in self._pair_refs:
+            return
+        self._pair_refs[key] -= 1
+        if self._pair_refs[key]:
+            return
+        row = self._pair_row.pop(key)
+        del self._pair_refs[key]
+        del self._row_dest[row]
+        self._trie.remove(topic_mod.words(flt), row)
+        self.table.remove(row)
+
+    def has_route(self, flt: str, dest: Dest) -> bool:
+        if not topic_mod.is_wildcard(flt):
+            return dest in self._exact.get(flt, ())
+        return (flt, dest) in self._pair_refs or (flt, dest) in self._deep
+
+    def topics(self) -> List[str]:
+        """All routed topics/filters (emqx_router:topics/0)."""
+        out = list(self._exact)
+        out.extend({f for (f, _d) in self._pair_refs})
+        out.extend({f for (f, _d) in self._deep})
+        return sorted(set(out))
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "exact_topics": len(self._exact),
+            "wildcard_routes": len(self._pair_refs),
+            "deep_routes": len(self._deep),
+            "table_rows": len(self.table),
+            "table_capacity": self.table.capacity,
+        }
+
+    # --- read path (emqx_router:match_routes) ---------------------------
+
+    def _deep_matches(self, topic_words) -> Set[Dest]:
+        return {
+            d
+            for (f, d) in self._deep
+            if topic_mod.match(topic_words, topic_mod.words(f))
+        }
+
+    def _exact_dests(self, topic: str) -> Set[Dest]:
+        return set(self._exact.get(topic, ()))
+
+    def match_routes(self, topic: str) -> Set[Dest]:
+        """Single-topic host path: exact hash + trie walk. This is the
+        low-latency cut-through used for cold/low-rate topics."""
+        tw = topic_mod.words(topic)
+        dests = self._exact_dests(topic)
+        for row in self._trie.match(tw):
+            dests.add(self._row_dest[row][1])
+        if self._deep:
+            dests |= self._deep_matches(tw)
+        return dests
+
+    def match_batch(self, topics: Sequence[str]) -> List[Set[Dest]]:
+        """Batched device path: ONE XLA dispatch for all wildcard
+        matching, host hash for exact topics. The hot loop of
+        emqx_broker:do_publish expressed over a topic batch."""
+        if not topics:
+            return []
+        self.device_table.sync()
+        enc = match_ops.encode_topics(self.table.vocab, topics, self.max_levels)
+        packed = np.asarray(
+            match_ops.match_packed(self.device_table.filters(), enc)
+        )
+        out: List[Set[Dest]] = []
+        for i, t in enumerate(topics):
+            dests = self._exact_dests(t)
+            for row in match_ops.unpack_indices(packed[i]):
+                dests.add(self._row_dest[int(row)][1])
+            if self._deep:
+                dests |= self._deep_matches(topic_mod.words(t))
+            out.append(dests)
+        return out

@@ -1,0 +1,109 @@
+"""Host-side topic trie index — the low-latency / fallback match path.
+
+The TPU kernel (ops/match.py) is a *batched* matcher: it wins when many
+inbound topics amortize one dispatch. For single cold publishes, for
+filters too deep for the flattened table, and as the default before a
+device is attached, the broker needs a host index. This is the
+recursive-descent trie of the reference's v1 schema
+(apps/emqx/src/emqx_trie.erl:303-352 match_no_compact: try the literal
+branch, the '+' branch, and collect '#' leaves, with the '$'-root
+exclusion of emqx_trie.erl:286-293) — implemented iteratively over
+dict nodes.
+
+Complexity O(2^wildcard-branches) worst case like the reference v1;
+the device kernel is the scale path.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Hashable, List, Sequence, Set, Tuple
+
+
+class _Node:
+    __slots__ = ("children", "ids", "hash_ids")
+
+    def __init__(self) -> None:
+        self.children: Dict[str, _Node] = {}
+        self.ids: Set[Hashable] = set()  # filters ending exactly here
+        self.hash_ids: Set[Hashable] = set()  # filters ending in '#' here
+
+    def empty(self) -> bool:
+        return not (self.children or self.ids or self.hash_ids)
+
+
+class TopicTrie:
+    """Wildcard filter trie: insert/remove (filter words, id), match
+    topic words -> set of ids. No depth limit."""
+
+    def __init__(self) -> None:
+        self._root = _Node()
+        self._count = 0
+
+    def __len__(self) -> int:
+        return self._count
+
+    def insert(self, filter_words: Sequence[str], fid: Hashable) -> None:
+        ws = tuple(filter_words)
+        has_hash = bool(ws) and ws[-1] == "#"
+        prefix = ws[:-1] if has_hash else ws
+        node = self._root
+        for w in prefix:
+            node = node.children.setdefault(w, _Node())
+        bucket = node.hash_ids if has_hash else node.ids
+        if fid in bucket:
+            raise KeyError(f"duplicate id {fid!r} for {'/'.join(ws)}")
+        bucket.add(fid)
+        self._count += 1
+
+    def remove(self, filter_words: Sequence[str], fid: Hashable) -> None:
+        ws = tuple(filter_words)
+        has_hash = bool(ws) and ws[-1] == "#"
+        prefix = ws[:-1] if has_hash else ws
+        path: List[Tuple[_Node, str]] = []
+        node = self._root
+        for w in prefix:
+            child = node.children.get(w)
+            if child is None:
+                raise KeyError("/".join(ws))
+            path.append((node, w))
+            node = child
+        bucket = node.hash_ids if has_hash else node.ids
+        if fid not in bucket:
+            raise KeyError(f"id {fid!r} not under {'/'.join(ws)}")
+        bucket.remove(fid)
+        self._count -= 1
+        # prune now-empty nodes bottom-up
+        for parent, w in reversed(path):
+            if node.empty():
+                del parent.children[w]
+                node = parent
+            else:
+                break
+
+    def match(self, topic_words: Sequence[str]) -> Set[Hashable]:
+        """All filter ids matching the topic (emqx_trie.erl match/1
+        semantics incl. the '$'-root rule)."""
+        tw = tuple(topic_words)
+        n = len(tw)
+        dollar = bool(tw) and tw[0].startswith("$")
+        out: Set[Hashable] = set()
+        # stack of (node, next topic level index)
+        stack: List[Tuple[_Node, int]] = [(self._root, 0)]
+        while stack:
+            node, i = stack.pop()
+            root_restricted = dollar and i == 0
+            # '#' at this node matches the (possibly empty) remainder —
+            # unless it's a root wildcard over a '$' topic
+            if not root_restricted:
+                out |= node.hash_ids
+            if i == n:
+                out |= node.ids
+                continue
+            child = node.children.get(tw[i])
+            if child is not None:
+                stack.append((child, i + 1))
+            if not root_restricted:
+                plus = node.children.get("+")
+                if plus is not None:
+                    stack.append((plus, i + 1))
+        return out

@@ -1,0 +1,160 @@
+"""Flattened wildcard-filter table — the host source of truth for the
+TPU-resident match kernel.
+
+This is the TPU-era replacement for the reference's ordered-set filter
+index (apps/emqx/src/emqx_router.erl:133-162 ?ROUTE_TAB_FILTERS +
+emqx_topic_index keys): instead of `{Words, {ID}}` ets keys walked with
+`ets:next`, every filter becomes one row of fixed-width arrays sized
+for a single batched XLA dispatch:
+
+  words      int32 [C, L]   word ids; PLUS(1) marks '+'; 0-padded
+  prefix_len int32 [C]      levels before '#' (== level count if none)
+  has_hash   bool  [C]      filter ends in '#'
+  root_wild  bool  [C]      first level is '+' or '#' ($-topic rule)
+  active     bool  [C]      live row (False == tombstone)
+
+Rows are identified by index; deletion tombstones the row and recycles
+it for the next add (so device buffers update in place without
+compaction). Capacity is static per power-of-two growth step, which
+keeps XLA shapes stable — a capacity bump is the only recompile event.
+
+Filters deeper than L levels cannot be represented and raise
+FilterTooDeep — the router keeps those on a host-side fallback path
+(mirrors the v2 split where exact topics stay in plain ets,
+emqx_router.erl:511-516).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, NamedTuple, Optional, Set, Tuple
+
+import numpy as np
+
+from . import topic as topic_mod
+from .vocab import OOV, Vocab
+
+DEFAULT_MAX_LEVELS = 16
+MIN_CAPACITY = 1024
+
+
+class FilterTooDeep(ValueError):
+    """Filter has more non-'#' levels than the table's max_levels."""
+
+
+class EncodedFilters(NamedTuple):
+    """The array-of-struct view handed to match kernels (numpy or jax)."""
+
+    words: np.ndarray  # int32 [C, L]
+    prefix_len: np.ndarray  # int32 [C]
+    has_hash: np.ndarray  # bool  [C]
+    root_wild: np.ndarray  # bool  [C]
+    active: np.ndarray  # bool  [C]
+
+
+class FilterTable:
+    """Incrementally-updated flattened filter table (host numpy)."""
+
+    def __init__(
+        self,
+        max_levels: int = DEFAULT_MAX_LEVELS,
+        capacity: int = MIN_CAPACITY,
+        vocab: Optional[Vocab] = None,
+    ) -> None:
+        assert capacity >= 32 and capacity & (capacity - 1) == 0
+        self.max_levels = max_levels
+        self.vocab = vocab if vocab is not None else Vocab()
+        self.capacity = capacity
+        self.words = np.zeros((capacity, max_levels), np.int32)
+        self.prefix_len = np.zeros(capacity, np.int32)
+        self.has_hash = np.zeros(capacity, bool)
+        self.root_wild = np.zeros(capacity, bool)
+        self.active = np.zeros(capacity, bool)
+        self._filters: List[Optional[Tuple[str, ...]]] = [None] * capacity
+        self._free: List[int] = list(range(capacity - 1, -1, -1))
+        self._count = 0
+        # rows touched since the last drain; consumed by the device sync
+        self.dirty: Set[int] = set()
+        self.grew = False  # capacity changed since last drain → full upload
+
+    def __len__(self) -> int:
+        return self._count
+
+    def add(self, flt: str) -> int:
+        """Insert a filter, returning its row id. The same filter string
+        may be inserted under multiple rows (the router dedups per dest,
+        like the bag semantics of ?ROUTE_TAB_FILTERS)."""
+        ws = topic_mod.words(flt)
+        hh = ws[-1] == "#"
+        prefix = ws[:-1] if hh else ws
+        if len(prefix) > self.max_levels:
+            raise FilterTooDeep(flt)
+        if not self._free:
+            self._grow()
+        row = self._free.pop()
+        ids = [self.vocab.intern(w) for w in prefix]
+        self.words[row, : len(ids)] = ids
+        self.words[row, len(ids) :] = OOV
+        self.prefix_len[row] = len(prefix)
+        self.has_hash[row] = hh
+        self.root_wild[row] = (hh and len(prefix) == 0) or (
+            len(prefix) > 0 and prefix[0] == "+"
+        )
+        self.active[row] = True
+        self._filters[row] = ws
+        self._count += 1
+        self.dirty.add(row)
+        return row
+
+    def remove(self, row: int) -> None:
+        ws = self._filters[row]
+        assert ws is not None and self.active[row], f"row {row} not live"
+        hh = ws[-1] == "#"
+        for w in ws[:-1] if hh else ws:
+            self.vocab.release(w)
+        self.active[row] = False
+        self.words[row, :] = OOV
+        self.prefix_len[row] = 0
+        self.has_hash[row] = False
+        self.root_wild[row] = False
+        self._filters[row] = None
+        self._free.append(row)
+        self._count -= 1
+        self.dirty.add(row)
+
+    def filter_words(self, row: int) -> Tuple[str, ...]:
+        ws = self._filters[row]
+        assert ws is not None, f"row {row} not live"
+        return ws
+
+    def rows(self) -> Iterator[int]:
+        """Iterate live row ids."""
+        return (i for i in range(self.capacity) if self.active[i])
+
+    def snapshot(self) -> EncodedFilters:
+        """Zero-copy numpy view of the current table state."""
+        return EncodedFilters(
+            self.words, self.prefix_len, self.has_hash, self.root_wild, self.active
+        )
+
+    def drain_dirty(self) -> np.ndarray:
+        """Return-and-clear the dirty row ids (sorted int32 array)."""
+        rows = np.fromiter(self.dirty, np.int32, len(self.dirty))
+        rows.sort()
+        self.dirty.clear()
+        self.grew = False
+        return rows
+
+    def _grow(self) -> None:
+        old = self.capacity
+        new = old * 2
+        self.words = np.vstack(
+            [self.words, np.zeros((old, self.max_levels), np.int32)]
+        )
+        self.prefix_len = np.concatenate([self.prefix_len, np.zeros(old, np.int32)])
+        self.has_hash = np.concatenate([self.has_hash, np.zeros(old, bool)])
+        self.root_wild = np.concatenate([self.root_wild, np.zeros(old, bool)])
+        self.active = np.concatenate([self.active, np.zeros(old, bool)])
+        self._filters.extend([None] * old)
+        self._free.extend(range(new - 1, old - 1, -1))
+        self.capacity = new
+        self.grew = True

@@ -1,0 +1,75 @@
+"""Dictionary encoding of topic-level words to dense int32 ids.
+
+The reference walks binary topic words directly (ets ordered-set keys,
+apps/emqx/src/emqx_trie_search.erl:115-128). A TPU-resident table needs
+fixed-width integers instead; we intern every word that appears in any
+*filter* into a host-side dictionary. Topic words are encoded by lookup
+only — a word never seen in a filter maps to OOV(0), which by
+construction equals no filter word id, so matching stays *exact* (no
+hash collisions / false positives).
+
+Reserved ids:
+  0  OOV / padding  (matches nothing literal)
+  1  '+'            (single-level wildcard marker inside filter rows)
+Real words intern from 2 upward. Freed ids (refcount 0) are recycled.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+OOV = 0
+PLUS = 1
+FIRST_ID = 2
+
+
+class Vocab:
+    """Refcounted word ↔ id interning table (host side)."""
+
+    def __init__(self) -> None:
+        self._ids: Dict[str, int] = {}
+        self._refs: Dict[int, int] = {}
+        self._words: Dict[int, str] = {}
+        self._free: List[int] = []
+        self._next = FIRST_ID
+
+    def __len__(self) -> int:
+        return len(self._ids)
+
+    def intern(self, word: str) -> int:
+        """Get-or-create an id for a filter word; bumps its refcount."""
+        if word == "+":
+            return PLUS
+        wid = self._ids.get(word)
+        if wid is None:
+            wid = self._free.pop() if self._free else self._next
+            if wid == self._next:
+                self._next += 1
+            self._ids[word] = wid
+            self._words[wid] = word
+            self._refs[wid] = 0
+        self._refs[wid] += 1
+        return wid
+
+    def release(self, word: str) -> None:
+        """Drop one reference; id is recycled at refcount 0."""
+        if word == "+":
+            return
+        wid = self._ids[word]
+        self._refs[wid] -= 1
+        if self._refs[wid] == 0:
+            del self._ids[word]
+            del self._words[wid]
+            del self._refs[wid]
+            self._free.append(wid)
+
+    def lookup(self, word: str) -> int:
+        """Encode a topic word: known filter words get their id, anything
+        else OOV. ('+' in a topic *name* is technically invalid MQTT; it
+        encodes to PLUS which preserves oracle semantics either way.)"""
+        if word == "+":
+            return PLUS
+        return self._ids.get(word, OOV)
+
+    def word(self, wid: int) -> str:
+        return "+" if wid == PLUS else self._words[wid]

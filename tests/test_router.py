@@ -1,0 +1,125 @@
+"""Router tests: write path refcounts, host/device match parity,
+incremental device sync (emqx_router / emqx_router_syncer behaviors)."""
+
+import random
+
+import numpy as np
+
+from emqx_tpu.models.router import Router
+from emqx_tpu.ops import topic as T
+
+
+def oracle_dests(routes, topic):
+    tw = T.words(topic)
+    return {d for (f, d) in routes if T.match(tw, T.words(f))}
+
+
+def test_exact_and_wildcard_split():
+    r = Router(max_levels=6)
+    r.add_route("a/b", "n1")
+    r.add_route("a/+", "n2")
+    r.add_route("a/#", "n3")
+    r.add_route("other", "n4")
+    assert r.match_routes("a/b") == {"n1", "n2", "n3"}
+    assert r.match_routes("a/c") == {"n2", "n3"}
+    assert r.match_routes("a") == {"n3"}
+    assert r.match_routes("other") == {"n4"}
+    assert r.stats()["exact_topics"] == 2
+    assert r.stats()["wildcard_routes"] == 2
+
+
+def test_delete_and_refcount():
+    r = Router()
+    r.add_route("x/#", "n1")
+    r.add_route("x/#", "n1")  # duplicate route (bag semantics)
+    r.delete_route("x/#", "n1")
+    assert r.match_routes("x/y") == {"n1"}  # still one ref
+    r.delete_route("x/#", "n1")
+    assert r.match_routes("x/y") == set()
+    r.delete_route("x/#", "n1")  # no-op on absent route
+    r.add_route("e/t", "n2")
+    r.delete_route("e/t", "n2")
+    assert r.match_routes("e/t") == set()
+
+
+def test_same_filter_multiple_dests():
+    r = Router()
+    r.add_route("s/+", "nodeA")
+    r.add_route("s/+", "nodeB")
+    assert r.match_routes("s/1") == {"nodeA", "nodeB"}
+    r.delete_route("s/+", "nodeA")
+    assert r.match_routes("s/1") == {"nodeB"}
+
+
+def test_batch_matches_host_path():
+    rng = random.Random(5)
+    vocab = ["a", "b", "c", "d", ""]
+    routes = []
+    r = Router(max_levels=6)
+    for i in range(400):
+        n = rng.randint(1, 5)
+        ws = [rng.choice(vocab + ["+"]) for _ in range(n)]
+        if rng.random() < 0.3:
+            ws[-1] = "#"
+        f = "/".join(ws) if any(ws) else "a"
+        dest = f"n{i % 7}"
+        routes.append((f, dest))
+        r.add_route(f, dest)
+    # delete a slice
+    for f, d in routes[100:200]:
+        r.delete_route(f, d)
+    live = routes[:100] + routes[200:]
+    topics = ["/".join(rng.choice(vocab) for _ in range(rng.randint(1, 6))) for _ in range(50)]
+    topics += ["$SYS/x", "$SYS"]
+    batch = r.match_batch(topics)
+    for t, got in zip(topics, batch):
+        assert got == oracle_dests(live, t), t
+        assert r.match_routes(t) == got, t
+
+
+def test_deep_filters_host_fallback():
+    r = Router(max_levels=3)
+    deep = "a/b/c/d/e/+"
+    r.add_route(deep, "n1")
+    r.add_route("a/#", "n2")
+    assert r.stats()["deep_routes"] == 1
+    assert r.match_routes("a/b/c/d/e/f") == {"n1", "n2"}
+    [res] = r.match_batch(["a/b/c/d/e/f"])
+    assert res == {"n1", "n2"}
+    r.delete_route(deep, "n1")
+    assert r.match_routes("a/b/c/d/e/f") == {"n2"}
+
+
+def test_incremental_sync_after_batches():
+    r = Router(max_levels=4)
+    [empty] = r.match_batch(["t/1"])
+    assert empty == set()
+    r.add_route("t/+", "n1")
+    [res] = r.match_batch(["t/1"])  # delta scatter path
+    assert res == {"n1"}
+    r.delete_route("t/+", "n1")
+    r.add_route("t/#", "n2")
+    [res] = r.match_batch(["t/1"])
+    assert res == {"n2"}
+    # growth forces full re-upload
+    for i in range(1500):
+        r.add_route(f"g/{i}/+", "n3")
+    assert r.table.capacity >= 2048
+    [res] = r.match_batch(["g/7/x"])
+    assert res == {"n3"}
+
+
+def test_shared_group_dests():
+    r = Router()
+    r.add_route("q/#", ("g1", "sess1"))
+    r.add_route("q/#", ("g1", "sess2"))
+    r.add_route("q/#", "plain")
+    dests = r.match_routes("q/x")
+    assert dests == {("g1", "sess1"), ("g1", "sess2"), "plain"}
+
+
+def test_topics_listing():
+    r = Router()
+    r.add_route("a/b", "n")
+    r.add_route("a/+", "n")
+    assert r.topics() == ["a/+", "a/b"]
