@@ -1,0 +1,147 @@
+"""North-star benchmark: batched wildcard topic matching on TPU.
+
+Workload ≈ BASELINE.json config #2/#3: a 1M-row wildcard filter table
+(IoT-shaped `tenant/region/dev/+/metric/#` filters, L=8) matched by
+1024-topic batches. Compares the one-dispatch TPU kernel against the
+in-process host trie (the same recursive-descent structure the broker
+uses as its CPU path — itself the analog of the reference's
+emqx_trie/emqx_trie_search match, apps/emqx/src/emqx_trie_search.erl).
+
+Measurement notes (see PERF_NOTES.md): the axon relay memoizes repeated
+identical computations, does not synchronize on block_until_ready, and
+has a ~66ms dispatch RTT floor. So: fresh topic ids per dispatch, K
+batches per dispatch inside lax.scan, one scalar fetch, subtract the
+measured RTT floor.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from emqx_tpu.ops import match as M
+    from emqx_tpu.ops import topic as topic_mod
+    from emqx_tpu.ops.host_index import TopicTrie
+    from emqx_tpu.ops.match import _match_block
+    from emqx_tpu.ops.table import FilterTable
+
+    L = 8
+    N = 1 << 20
+    B = 1024
+    K = 16  # batches per dispatch
+    DISPATCHES = 4
+
+    log(f"devices: {jax.devices()}")
+    t0 = time.time()
+    table = FilterTable(max_levels=L, capacity=N)
+    trie = TopicTrie()
+    for i in range(N):
+        f = f"t{i % 997}/r{i % 13}/d{i}/+/m/#"
+        row = table.add(f)
+        trie.insert(topic_mod.words(f), row)
+    log(f"built 1M-filter table+trie in {time.time() - t0:.1f}s")
+
+    dev = jax.tree.map(jnp.asarray, table.snapshot())
+
+    # topic batches: hit rate ~1 match/topic (realistic sparse fanout)
+    rng = np.random.default_rng(7)
+
+    def fresh_args():
+        dd = rng.integers(0, N, size=(K, B))
+        ids = np.zeros((K, B, L), np.int32)
+        lk = table.vocab.lookup
+        # vectorized-ish encode: levels are t{d%997}/r{d%13}/d{d}/x9/m/temp
+        for k in range(K):
+            for b in range(B):
+                d = dd[k, b]
+                for j, w in enumerate(
+                    (f"t{d % 997}", f"r{d % 13}", f"d{d}", "x9", "m", "temp")
+                ):
+                    ids[k, b, j] = lk(w)
+        lens = np.full((K, B), 6, np.int32)
+        dollar = np.zeros((K, B), bool)
+        return jnp.asarray(ids), jnp.asarray(lens), jnp.asarray(dollar)
+
+    @jax.jit
+    def many(dev, ids, lens, dollar):
+        def one(carry, xs):
+            i, l, d = xs
+            ok = _match_block(i, l, d, *dev)
+            return carry + ok.sum(dtype=jnp.int32), None
+
+        s, _ = jax.lax.scan(one, jnp.int32(0), (ids, lens, dollar))
+        return s
+
+    # RTT floor of a dispatch+fetch round trip
+    @jax.jit
+    def triv(x):
+        return x + 1
+
+    float(triv(jnp.float32(0)))
+    floors = []
+    for r in range(5):
+        t0 = time.time()
+        float(triv(jnp.float32(r + 100)))
+        floors.append(time.time() - t0)
+    floor = float(np.median(floors))
+    log(f"dispatch RTT floor: {floor * 1e3:.1f} ms")
+
+    args = fresh_args()
+    int(many(dev, *args))  # compile
+    times = []
+    total_matches = 0
+    for _ in range(DISPATCHES):
+        args = fresh_args()
+        t0 = time.time()
+        total_matches += int(many(dev, *args))
+        times.append(time.time() - t0)
+    per_batch = (float(np.median(times)) - floor) / K
+    tpu_rate = B / per_batch
+    log(
+        f"TPU: {per_batch * 1e3:.2f} ms/batch-of-{B} "
+        f"({tpu_rate:,.0f} topics/s vs {N} subs; {total_matches} matches)"
+    )
+
+    # host-trie baseline on the same workload
+    hostN = 2000
+    dd = rng.integers(0, N, size=hostN)
+    host_topics = [
+        (f"t{d % 997}", f"r{d % 13}", f"d{d}", "x9", "m", "temp") for d in dd
+    ]
+    t0 = time.time()
+    hits = 0
+    for tw in host_topics:
+        hits += len(trie.match(tw))
+    host_dt = (time.time() - t0) / hostN
+    host_rate = 1.0 / host_dt
+    log(
+        f"host trie: {host_dt * 1e6:.1f} us/topic ({host_rate:,.0f} topics/s; "
+        f"{hits} matches on {hostN})"
+    )
+
+    print(
+        json.dumps(
+            {
+                "metric": "wildcard_topic_matches_per_sec_1M_subs",
+                "value": round(tpu_rate, 1),
+                "unit": "topics/s",
+                "vs_baseline": round(tpu_rate / host_rate, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,2 @@
+"""The MQTT broker runtime: wire codec, channel state machine,
+sessions, pubsub dispatch, asyncio server."""

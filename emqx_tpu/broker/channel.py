@@ -1,0 +1,338 @@
+"""The MQTT protocol state machine, transport-agnostic.
+
+Parity with apps/emqx/src/emqx_channel.erl handle_in/2:361-531:
+CONNECT (auth, session open/resume, will), PUBLISH QoS0/1/2 (QoS2
+parks packet ids in awaiting_rel and publishes on first receipt,
+emqx_channel.erl:705-746), SUBSCRIBE (authz + retained dispatch),
+UNSUBSCRIBE, PING, DISCONNECT (normal discards the will). The server
+feeds packets in; the channel returns packets to write out.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Tuple
+
+from .hooks import Hooks
+from .message import Message
+from .packet import (
+    MQTT_V5,
+    Auth,
+    Connack,
+    Connect,
+    Disconnect,
+    Pingreq,
+    Pingresp,
+    Puback,
+    Publish,
+    RC,
+    Suback,
+    Subscribe,
+    Type,
+    Unsuback,
+    Unsubscribe,
+    Will,
+)
+from .pubsub import Broker
+from .session import Session, SessionConfig
+
+
+class ProtocolError(Exception):
+    def __init__(self, code: int, msg: str = ""):
+        super().__init__(msg or hex(code))
+        self.code = code
+
+
+class Channel:
+    def __init__(self, broker: Broker, peer: str = "?"):
+        self.broker = broker
+        self.peer = peer
+        self.client_id: Optional[str] = None
+        self.proto_ver: int = 4
+        self.session: Optional[Session] = None
+        self.will: Optional[Will] = None
+        self.keepalive: int = 0
+        self.last_rx: float = time.time()
+        self.connected = False
+        self.clean_disconnect = False
+        self.topic_aliases: dict = {}  # v5 inbound alias -> topic
+
+    # --- inbound dispatch -------------------------------------------------
+
+    def handle_packet(self, pkt) -> List[object]:
+        self.last_rx = time.time()
+        if not self.connected:
+            if isinstance(pkt, Connect):
+                return self._handle_connect(pkt)
+            raise ProtocolError(RC.PROTOCOL_ERROR, "packet before CONNECT")
+        if isinstance(pkt, Connect):
+            raise ProtocolError(RC.PROTOCOL_ERROR, "duplicate CONNECT")
+        if isinstance(pkt, Publish):
+            return self._handle_publish(pkt)
+        if isinstance(pkt, Puback):
+            return self._handle_ack(pkt)
+        if isinstance(pkt, Subscribe):
+            return self._handle_subscribe(pkt)
+        if isinstance(pkt, Unsubscribe):
+            return self._handle_unsubscribe(pkt)
+        if isinstance(pkt, Pingreq):
+            return [Pingresp()]
+        if isinstance(pkt, Disconnect):
+            self.clean_disconnect = pkt.code == 0
+            if (
+                self.proto_ver == MQTT_V5
+                and self.session is not None
+                and "session_expiry_interval" in pkt.props
+            ):
+                self.session.cfg.session_expiry_interval = pkt.props[
+                    "session_expiry_interval"
+                ]
+            return []
+        if isinstance(pkt, Auth):
+            raise ProtocolError(RC.BAD_AUTHENTICATION_METHOD, "AUTH unsupported")
+        raise ProtocolError(RC.PROTOCOL_ERROR, f"unexpected {type(pkt).__name__}")
+
+    # --- connect ----------------------------------------------------------
+
+    def _handle_connect(self, pkt: Connect) -> List[object]:
+        self.proto_ver = pkt.proto_ver
+        client_id = pkt.client_id
+        if not client_id:
+            if not pkt.clean_start:
+                return [
+                    Connack(
+                        False,
+                        RC.CLIENT_IDENTIFIER_NOT_VALID
+                        if self.proto_ver == MQTT_V5
+                        else 2,
+                    )
+                ]
+            client_id = f"auto-{id(self):x}-{int(time.time() * 1000) & 0xFFFFFF:x}"
+        ok = self.broker.hooks.run_fold(
+            "client.authenticate",
+            (dict(client_id=client_id, username=pkt.username, password=pkt.password, peer=self.peer),),
+            True,
+        )
+        if ok is not True:
+            code = (
+                ok
+                if isinstance(ok, int)
+                else (RC.NOT_AUTHORIZED if self.proto_ver == MQTT_V5 else 5)
+            )
+            self.broker.metrics.inc("client.auth.failure")
+            return [Connack(False, code)]
+
+        cfg = SessionConfig()
+        if self.proto_ver == MQTT_V5:
+            cfg.session_expiry_interval = pkt.props.get("session_expiry_interval", 0)
+            cfg.receive_maximum = pkt.props.get("receive_maximum", cfg.receive_maximum)
+        else:
+            # v3: clean_start=False means the session persists "forever"
+            cfg.session_expiry_interval = 0 if pkt.clean_start else float("inf")
+        session, present = self.broker.open_session(
+            client_id, pkt.clean_start, cfg
+        )
+        self.session = session
+        self.client_id = client_id
+        self.keepalive = pkt.keepalive
+        self.will = pkt.will
+        self.connected = True
+        self.broker.metrics.inc("client.connected")
+        self.broker.hooks.run("client.connected", client_id, self.proto_ver)
+        out: List[object] = [Connack(present, 0)]
+        if present:
+            out.extend(session.on_reconnect())
+        return out
+
+    # --- publish (inbound) -------------------------------------------------
+
+    def _resolve_alias(self, pkt: Publish) -> str:
+        if self.proto_ver != MQTT_V5:
+            return pkt.topic
+        alias = pkt.props.get("topic_alias")
+        if alias is None:
+            return pkt.topic
+        if pkt.topic:
+            self.topic_aliases[alias] = pkt.topic
+            return pkt.topic
+        topic = self.topic_aliases.get(alias)
+        if topic is None:
+            raise ProtocolError(RC.TOPIC_ALIAS_INVALID, "unknown topic alias")
+        return topic
+
+    def _handle_publish(self, pkt: Publish) -> List[object]:
+        topic = self._resolve_alias(pkt)
+        try:
+            from ..ops.topic import validate_name
+
+            validate_name(topic)
+        except ValueError:
+            raise ProtocolError(RC.TOPIC_NAME_INVALID, topic)
+        allowed = self.broker.hooks.run_fold(
+            "client.authorize",
+            (self.client_id, "publish", topic),
+            True,
+        )
+        if allowed is not True:
+            self.broker.metrics.inc("packets.publish.auth_error")
+            if pkt.qos == 1:
+                return [Puback(Type.PUBACK, pkt.packet_id, RC.NOT_AUTHORIZED)]
+            if pkt.qos == 2:
+                return [Puback(Type.PUBREC, pkt.packet_id, RC.NOT_AUTHORIZED)]
+            return []
+        msg = Message(
+            topic=topic,
+            payload=pkt.payload,
+            qos=pkt.qos,
+            retain=pkt.retain,
+            from_client=self.client_id or "",
+            props={
+                k: v
+                for k, v in pkt.props.items()
+                if k in ("message_expiry_interval", "content_type",
+                         "response_topic", "correlation_data",
+                         "payload_format_indicator", "user_property")
+            },
+        )
+        if pkt.qos == 0:
+            self.broker.publish(msg)
+            return []
+        if pkt.qos == 1:
+            n = self.broker.publish(msg)
+            code = 0 if n else RC.NO_MATCHING_SUBSCRIBERS
+            return [Puback(Type.PUBACK, pkt.packet_id, code if self.proto_ver == MQTT_V5 else 0)]
+        # QoS2: publish on first receipt, park until PUBREL
+        assert self.session is not None
+        try:
+            fresh = self.session.await_rel(pkt.packet_id)
+        except OverflowError:
+            raise ProtocolError(RC.RECEIVE_MAXIMUM_EXCEEDED, "too many inflight QoS2")
+        code = 0
+        if fresh:
+            n = self.broker.publish(msg)
+            if not n and self.proto_ver == MQTT_V5:
+                code = RC.NO_MATCHING_SUBSCRIBERS
+        elif self.proto_ver == MQTT_V5:
+            code = RC.PACKET_IDENTIFIER_IN_USE
+        return [Puback(Type.PUBREC, pkt.packet_id, code)]
+
+    # --- acks (outbound flow control) --------------------------------------
+
+    def _handle_ack(self, pkt: Puback) -> List[object]:
+        assert self.session is not None
+        s = self.session
+        out: List[object] = []
+        if pkt.type == Type.PUBACK:
+            if s.on_puback(pkt.packet_id):
+                self.broker.hooks.run("message.acked", self.client_id, pkt.packet_id)
+            out.extend(s.drain())
+        elif pkt.type == Type.PUBREC:
+            if s.on_pubrec(pkt.packet_id):
+                out.append(Puback(Type.PUBREL, pkt.packet_id))
+            else:
+                out.append(
+                    Puback(
+                        Type.PUBREL,
+                        pkt.packet_id,
+                        RC.PACKET_IDENTIFIER_NOT_FOUND
+                        if self.proto_ver == MQTT_V5
+                        else 0,
+                    )
+                )
+        elif pkt.type == Type.PUBREL:
+            found = s.release_rel(pkt.packet_id)
+            out.append(
+                Puback(
+                    Type.PUBCOMP,
+                    pkt.packet_id,
+                    0
+                    if found or self.proto_ver != MQTT_V5
+                    else RC.PACKET_IDENTIFIER_NOT_FOUND,
+                )
+            )
+        elif pkt.type == Type.PUBCOMP:
+            if s.on_pubcomp(pkt.packet_id):
+                self.broker.hooks.run("message.acked", self.client_id, pkt.packet_id)
+            out.extend(s.drain())
+        return out
+
+    # --- subscribe / unsubscribe -------------------------------------------
+
+    def _handle_subscribe(self, pkt: Subscribe) -> List[object]:
+        assert self.session is not None
+        codes: List[int] = []
+        out: List[object] = []
+        acc = self.broker.hooks.run_fold(
+            "client.subscribe", (self.client_id,), pkt.filters
+        )
+        filters = acc if acc is not None else pkt.filters
+        for flt, opts in filters:
+            allowed = self.broker.hooks.run_fold(
+                "client.authorize", (self.client_id, "subscribe", flt), True
+            )
+            if allowed is not True:
+                codes.append(RC.NOT_AUTHORIZED if self.proto_ver == MQTT_V5 else 0x80)
+                continue
+            try:
+                retained = self.broker.subscribe(self.session, flt, opts)
+            except ValueError:
+                codes.append(
+                    RC.TOPIC_FILTER_INVALID if self.proto_ver == MQTT_V5 else 0x80
+                )
+                continue
+            codes.append(opts.qos)
+            for m in retained:
+                rm = Message(**{**m.__dict__})
+                rm.retain = True
+                ropts = type(opts)(
+                    qos=opts.qos,
+                    no_local=opts.no_local,
+                    retain_as_published=True,  # retained reads keep the flag
+                    retain_handling=opts.retain_handling,
+                )
+                out.extend(self.session.deliver(rm, ropts))
+        return [Suback(pkt.packet_id, codes)] + out
+
+    def _handle_unsubscribe(self, pkt: Unsubscribe) -> List[object]:
+        assert self.session is not None
+        codes = []
+        for flt in pkt.filters:
+            ok = self.broker.unsubscribe(self.session, flt)
+            codes.append(0 if ok else RC.NO_SUBSCRIPTION_EXISTED)
+        self.broker.hooks.run("client.unsubscribe", self.client_id, pkt.filters)
+        return [Unsuback(pkt.packet_id, codes)]
+
+    # --- lifecycle -----------------------------------------------------------
+
+    def keepalive_expired(self, now: Optional[float] = None) -> bool:
+        if not self.keepalive:
+            return False
+        now = now if now is not None else time.time()
+        return now - self.last_rx > self.keepalive * 1.5
+
+    def on_close(self) -> None:
+        """Socket gone: publish the will unless cleanly disconnected,
+        keep or drop the session per expiry (emqx_channel terminate)."""
+        if not self.connected:
+            return
+        self.connected = False
+        self.broker.metrics.inc("client.disconnected")
+        if self.will is not None and not self.clean_disconnect:
+            self.broker.publish(
+                Message(
+                    topic=self.will.topic,
+                    payload=self.will.payload,
+                    qos=self.will.qos,
+                    retain=self.will.retain,
+                    from_client=self.client_id or "",
+                )
+            )
+        self.will = None
+        if self.session is not None:
+            if self.session.cfg.session_expiry_interval > 0:
+                self.session.on_disconnect()
+            else:
+                self.broker.close_session(self.session)
+        self.broker.hooks.run(
+            "client.disconnected", self.client_id, "normal" if self.clean_disconnect else "closed"
+        )

@@ -1,0 +1,94 @@
+"""Hook registry — the in-process extension mechanism.
+
+Parity with the reference's emqx_hooks (apps/emqx/src/emqx_hooks.erl):
+named hookpoints hold priority-ordered callback chains;
+`run` stops on 'stop', `run_fold` threads an accumulator which
+callbacks may replace. Hookpoint names mirror
+apps/emqx/src/emqx_hookpoints.erl:41-69 so reference plugins map 1:1.
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+# Canonical hookpoints (emqx_hookpoints.erl:41-69)
+HOOKPOINTS = [
+    "client.connect",
+    "client.connack",
+    "client.connected",
+    "client.disconnected",
+    "client.authenticate",
+    "client.authorize",
+    "client.check_authz_complete",
+    "client.check_authn_complete",
+    "client.subscribe",
+    "client.unsubscribe",
+    "client.timeout",
+    "client.monitored_process_down",
+    "session.created",
+    "session.subscribed",
+    "session.unsubscribed",
+    "session.resumed",
+    "session.discarded",
+    "session.takenover",
+    "session.terminated",
+    "message.publish",
+    "message.puback",
+    "message.delivered",
+    "message.acked",
+    "message.dropped",
+    "message.transformation_failed",
+    "schema.validation_failed",
+    "delivery.dropped",
+]
+
+STOP = object()  # callback return: halt the chain (emqx_hooks 'stop')
+OK = None  # continue
+
+
+class Hooks:
+    """Priority-ordered callback chains per hookpoint."""
+
+    def __init__(self, strict: bool = True) -> None:
+        self._hooks: Dict[str, List[Tuple[int, int, Callable]]] = {}
+        self._seq = 0
+        self._strict = strict
+
+    def _check(self, name: str) -> None:
+        if self._strict and name not in HOOKPOINTS:
+            raise KeyError(f"unknown hookpoint {name!r}")
+
+    def add(self, name: str, cb: Callable, priority: int = 0) -> None:
+        """Register; higher priority runs first (emqx_hooks.erl:63-70
+        sorts descending, ties keep registration order)."""
+        self._check(name)
+        chain = self._hooks.setdefault(name, [])
+        self._seq += 1
+        # sort key: -priority then insertion order
+        entry = (-priority, self._seq, cb)
+        bisect.insort(chain, entry, key=lambda e: (e[0], e[1]))
+        # bisect.insort with key keeps chain sorted
+
+    def delete(self, name: str, cb: Callable) -> None:
+        chain = self._hooks.get(name, [])
+        self._hooks[name] = [e for e in chain if e[2] is not cb]
+
+    def run(self, name: str, *args: Any) -> bool:
+        """Run the chain; returns False if a callback returned STOP."""
+        for _, _, cb in self._hooks.get(name, ()):
+            if cb(*args) is STOP:
+                return False
+        return True
+
+    def run_fold(self, name: str, args: Tuple, acc: Any) -> Any:
+        """Fold the accumulator through the chain. Callbacks receive
+        (*args, acc) and return None (keep), (STOP, acc'), or acc'."""
+        for _, _, cb in self._hooks.get(name, ()):
+            r = cb(*args, acc)
+            if r is None:
+                continue
+            if isinstance(r, tuple) and len(r) == 2 and r[0] is STOP:
+                return r[1]
+            acc = r
+        return acc

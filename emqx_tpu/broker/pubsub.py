@@ -1,0 +1,215 @@
+"""The broker core: subscribe/publish/dispatch over the Router.
+
+The single-node analog of the reference hot path
+(apps/emqx/src/emqx_broker.erl): subscribe writes routes
+(emqx_broker.erl:159-198), publish runs the 'message.publish' hook
+fold, stores retained, matches routes, dedups destinations, and
+dispatches to sessions (emqx_broker.erl:253-298, 726-760); shared
+groups elect one member (emqx_shared_sub.erl:144-163).
+
+Destinations in the Router are:
+    client_id                 — a direct subscriber session
+    ("$group", group, filter) — a shared-subscription group
+
+Publish offers two paths, exactly the v2 split the survey flags
+(SURVEY.md §7 hard parts):
+  * publish()        — single-message cut-through via the host trie;
+  * publish_batch()  — the TPU path: one device dispatch matches the
+    whole inbound batch (emqx_tpu.models.router.match_batch).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from ..models.retainer import Retainer
+from ..models.router import Router
+from ..models.shared_sub import SharedSubs
+from ..ops import topic as topic_mod
+from .hooks import Hooks
+from .message import Message
+from .metrics import Metrics, Stats
+from .packet import Publish, SubOpts
+from .session import Session
+
+GROUP_DEST = "$group"
+
+
+class Broker:
+    def __init__(
+        self,
+        max_levels: int = 16,
+        shared_strategy: str = "random",
+        hooks: Optional[Hooks] = None,
+    ):
+        self.router = Router(max_levels=max_levels)
+        self.shared = SharedSubs(strategy=shared_strategy)
+        self.retainer = Retainer()
+        self.hooks = hooks or Hooks()
+        self.metrics = Metrics()
+        self.stats = Stats()
+        self.sessions: Dict[str, Session] = {}
+        # (filter, client) subopts — mirror of ?SUBOPTION
+        self.suboptions: Dict[Tuple[str, str], SubOpts] = {}
+
+    # --- session registry (emqx_cm-lite) --------------------------------
+
+    def open_session(
+        self, client_id: str, clean_start: bool, cfg=None
+    ) -> Tuple[Session, bool]:
+        """Returns (session, session_present). Clean start discards
+        (emqx_cm:open_session:285-304)."""
+        old = self.sessions.get(client_id)
+        if clean_start or old is None or old.expired():
+            if old is not None:
+                self.close_session(old, discard=True)
+            s = Session(client_id, cfg)
+            self.sessions[client_id] = s
+            self.stats.set("sessions.count", len(self.sessions))
+            self.hooks.run("session.created", client_id)
+            return s, False
+        old.connected = True
+        self.hooks.run("session.resumed", client_id)
+        return old, True
+
+    def close_session(self, session: Session, discard: bool = False) -> None:
+        """Drop a session and all its routes (emqx_broker:subscriber_down)."""
+        for flt in list(session.subscriptions):
+            self._unsubscribe_route(session.client_id, flt)
+        session.subscriptions.clear()
+        self.sessions.pop(session.client_id, None)
+        self.stats.set("sessions.count", len(self.sessions))
+        self.hooks.run(
+            "session.discarded" if discard else "session.terminated",
+            session.client_id,
+        )
+
+    # --- subscribe path --------------------------------------------------
+
+    def subscribe(
+        self, session: Session, flt: str, opts: SubOpts
+    ) -> List[Message]:
+        """Register a subscription; returns retained messages to
+        deliver (per retain_handling)."""
+        group, real = topic_mod.parse_share(flt)
+        topic_mod.validate_filter(real)
+        existed = flt in session.subscriptions
+        session.subscriptions[flt] = opts
+        self.suboptions[(flt, session.client_id)] = opts
+        if group is not None:
+            if self.shared.subscribe(group, real, session.client_id):
+                self.router.add_route(real, (GROUP_DEST, group, real))
+        elif not existed:
+            self.router.add_route(real, session.client_id)
+        self.stats.set("subscriptions.count", len(self.suboptions))
+        self.hooks.run("session.subscribed", session.client_id, flt, opts)
+        # retained delivery: never for shared subs (MQTT-5 §4.8.2)
+        if group is not None:
+            return []
+        if opts.retain_handling == 2 or (opts.retain_handling == 1 and existed):
+            return []
+        return self.retainer.read(real)
+
+    def unsubscribe(self, session: Session, flt: str) -> bool:
+        if flt not in session.subscriptions:
+            return False
+        del session.subscriptions[flt]
+        self.suboptions.pop((flt, session.client_id), None)
+        self._unsubscribe_route(session.client_id, flt)
+        self.stats.set("subscriptions.count", len(self.suboptions))
+        self.hooks.run("session.unsubscribed", session.client_id, flt)
+        return True
+
+    def _unsubscribe_route(self, client_id: str, flt: str) -> None:
+        group, real = topic_mod.parse_share(flt)
+        if group is not None:
+            if self.shared.unsubscribe(group, real, client_id):
+                self.router.delete_route(real, (GROUP_DEST, group, real))
+        else:
+            self.router.delete_route(real, client_id)
+
+    # --- publish path -----------------------------------------------------
+
+    def publish(self, msg: Message) -> int:
+        """Single-message cut-through (host trie). Returns deliveries."""
+        msg = self._pre_publish(msg)
+        if msg is None:
+            return 0
+        return self._dispatch(msg, self.router.match_routes(msg.topic))
+
+    def publish_batch(self, msgs: Sequence[Message]) -> List[int]:
+        """The TPU hot path: one batched device dispatch for the whole
+        inbound publish batch."""
+        live = [self._pre_publish(m) for m in msgs]
+        topics = [m.topic for m in live if m is not None]
+        dest_sets = iter(self.router.match_batch(topics))
+        return [
+            self._dispatch(m, next(dest_sets)) if m is not None else 0
+            for m in live
+        ]
+
+    def _pre_publish(self, msg: Message) -> Optional[Message]:
+        self.metrics.inc("messages.received")
+        out = self.hooks.run_fold("message.publish", (), msg)
+        if out is None or out.headers.get("allow_publish") is False:
+            self.metrics.inc("messages.dropped")
+            self.hooks.run("message.dropped", msg, "publish_denied")
+            return None
+        if out.retain:
+            self.retainer.retain(out)
+        return out
+
+    def _dispatch(self, msg: Message, dests: Set) -> int:
+        n = 0
+        for dest in dests:
+            if isinstance(dest, tuple) and dest and dest[0] == GROUP_DEST:
+                _tag, group, real = dest
+                member = self.shared.pick(
+                    group, real, msg.topic, from_client=msg.from_client
+                )
+                if member is None:
+                    continue
+                n += self._deliver_to(member, f"$share/{group}/{real}", msg)
+            else:
+                n += self._deliver_to(dest, None, msg)
+        if n == 0:
+            self.metrics.inc("messages.dropped.no_subscribers")
+            self.hooks.run("message.dropped", msg, "no_subscribers")
+        else:
+            self.metrics.inc("messages.delivered", n)
+        return n
+
+    def _deliver_to(
+        self, client_id: str, share_filter: Optional[str], msg: Message
+    ) -> int:
+        session = self.sessions.get(client_id)
+        if session is None:
+            return 0
+        if share_filter is not None:
+            opts = session.subscriptions.get(share_filter)
+        else:
+            opts = self._matching_subopts(session, msg.topic)
+        if opts is None:
+            return 0
+        packets = session.deliver(msg, opts)
+        self.hooks.run("message.delivered", client_id, msg)
+        if packets:
+            sink = getattr(session, "outgoing_sink", None)
+            if sink is not None:
+                sink(packets)
+        return 1
+
+    def _matching_subopts(self, session: Session, topic: str) -> Optional[SubOpts]:
+        """Find the (non-shared) subscription that matched; when several
+        overlap, the highest granted QoS wins (reference delivers once
+        per subscription via per-filter SUBOPTION; we dedup per client
+        like aggre/1 and take max QoS)."""
+        best = None
+        tw = topic_mod.words(topic)
+        for flt, opts in session.subscriptions.items():
+            if flt.startswith("$share/"):
+                continue
+            if topic_mod.match(tw, topic_mod.words(flt)):
+                if best is None or opts.qos > best.qos:
+                    best = opts
+        return best

@@ -1,0 +1,197 @@
+"""Asyncio TCP front end: the esockd/emqx_connection analog.
+
+One Connection task per client socket (the reference runs one Erlang
+process per connection, emqx_connection.erl:315); inbound bytes flow
+through the incremental Parser into the Channel; deliveries from other
+sessions arrive via the session's outgoing sink. An optional publish
+micro-batcher aggregates concurrent publishes into one TPU match
+dispatch (the batching window the survey calls out, SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Dict, List, Optional
+
+from . import frame
+from .channel import Channel, ProtocolError
+from .message import Message
+from .packet import Disconnect, MQTT_V5
+from .pubsub import Broker
+
+log = logging.getLogger("emqx_tpu.server")
+
+
+class PublishBatcher:
+    """Aggregate publishes across connections into one router batch
+    (mirrors emqx_router_syncer's batching, applied to the read path).
+    Flushes when `max_batch` is reached or `max_delay` elapses."""
+
+    def __init__(self, broker: Broker, max_batch: int = 256, max_delay: float = 0.002):
+        self.broker = broker
+        self.max_batch = max_batch
+        self.max_delay = max_delay
+        self._pending: List[Message] = []
+        self._flusher: Optional[asyncio.TimerHandle] = None
+        self._loop = None
+
+    def submit(self, msg: Message) -> None:
+        self._pending.append(msg)
+        if len(self._pending) >= self.max_batch:
+            self.flush()
+        elif self._flusher is None:
+            if self._loop is None:
+                self._loop = asyncio.get_event_loop()
+            self._flusher = self._loop.call_later(self.max_delay, self.flush)
+
+    def flush(self) -> None:
+        if self._flusher is not None:
+            self._flusher.cancel()
+            self._flusher = None
+        if not self._pending:
+            return
+        batch, self._pending = self._pending, []
+        self.broker.publish_batch(batch)
+
+
+class Connection:
+    def __init__(self, server: "Server", reader, writer):
+        self.server = server
+        self.reader = reader
+        self.writer = writer
+        peer = writer.get_extra_info("peername")
+        self.channel = Channel(server.broker, peer=str(peer))
+        self.parser = frame.Parser(max_packet_size=server.max_packet_size)
+
+    def _wire_sink(self) -> None:
+        sess = self.channel.session
+        if sess is not None:
+            sess.outgoing_sink = self._send_packets
+
+    def _send_packets(self, pkts) -> None:
+        try:
+            ver = self.channel.proto_ver
+            data = b"".join(frame.serialize(p, ver) for p in pkts)
+            self.writer.write(data)
+        except Exception:  # connection already gone; session keeps state
+            pass
+
+    async def run(self) -> None:
+        try:
+            while True:
+                timeout = None
+                if self.channel.keepalive:
+                    timeout = self.channel.keepalive * 1.5
+                elif not self.channel.connected:
+                    timeout = self.server.connect_timeout
+                try:
+                    data = await asyncio.wait_for(
+                        self.reader.read(65536), timeout=timeout
+                    )
+                except asyncio.TimeoutError:
+                    break  # keepalive/connect timeout
+                if not data:
+                    break
+                try:
+                    pkts = self.parser.feed(data)
+                except frame.FrameError as e:
+                    if self.channel.proto_ver == MQTT_V5 and self.channel.connected:
+                        self._send_packets([Disconnect(e.code)])
+                    break
+                for pkt in pkts:
+                    try:
+                        out = self.channel.handle_packet(pkt)
+                    except ProtocolError as e:
+                        if self.channel.proto_ver == MQTT_V5:
+                            self._send_packets([Disconnect(e.code)])
+                        raise
+                    if out:
+                        self._send_packets(out)
+                    self._wire_sink()
+                await self.drain()
+        except (ProtocolError, ConnectionError):
+            pass
+        except Exception:
+            log.exception("connection crashed")
+        finally:
+            sess = self.channel.session
+            if sess is not None and getattr(sess, "outgoing_sink", None) is self._send_packets:
+                sess.outgoing_sink = None
+            self.channel.on_close()
+            try:
+                self.writer.close()
+            except Exception:
+                pass
+
+    async def drain(self) -> None:
+        try:
+            await self.writer.drain()
+        except ConnectionError:
+            pass
+
+
+class Server:
+    def __init__(
+        self,
+        broker: Optional[Broker] = None,
+        host: str = "127.0.0.1",
+        port: int = 1883,
+        max_packet_size: int = frame.DEFAULT_MAX_PACKET_SIZE,
+        connect_timeout: float = 10.0,
+    ):
+        self.broker = broker or Broker()
+        self.host = host
+        self.port = port
+        self.max_packet_size = max_packet_size
+        self.connect_timeout = connect_timeout
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._conns: set = set()
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._on_client, self.host, self.port
+        )
+        addr = self._server.sockets[0].getsockname()
+        log.info("listening on %s", addr)
+
+    async def _on_client(self, reader, writer) -> None:
+        conn = Connection(self, reader, writer)
+        self._conns.add(conn)
+        try:
+            await conn.run()
+        finally:
+            self._conns.discard(conn)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            # kick live connections so wait_closed() cannot hang on them
+            for conn in list(self._conns):
+                try:
+                    conn.writer.close()
+                except Exception:
+                    pass
+            await self._server.wait_closed()
+
+    async def serve_forever(self) -> None:
+        await self.start()
+        assert self._server is not None
+        async with self._server:
+            await self._server.serve_forever()
+
+
+def main() -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(description="emqx_tpu MQTT broker")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=1883)
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.DEBUG if args.verbose else logging.INFO)
+    asyncio.run(Server(host=args.host, port=args.port).serve_forever())
+
+
+if __name__ == "__main__":
+    main()

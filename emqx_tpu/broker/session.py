@@ -1,0 +1,222 @@
+"""Session state: subscriptions, message queue, in-flight windows.
+
+The in-memory session of the reference (apps/emqx/src/emqx_session_mem.erl
+mqueue+inflight, emqx_mqueue.erl bounded priority queue, emqx_inflight.erl
+receive-maximum window, and the QoS2 awaiting_rel set of
+emqx_channel.erl:705-746) collapsed into one transport-agnostic object.
+The channel drives it with packets; it emits outgoing packets.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict, deque
+from dataclasses import dataclass, field
+from typing import Deque, Dict, List, Optional, Tuple
+
+from .message import Message
+from .packet import Publish, SubOpts
+
+
+@dataclass
+class SessionConfig:
+    max_mqueue_len: int = 1000
+    receive_maximum: int = 32  # outgoing inflight window
+    max_awaiting_rel: int = 100  # incoming QoS2 window
+    await_rel_timeout: float = 300.0
+    retry_interval: float = 30.0
+    session_expiry_interval: float = 0.0  # 0 = ends with connection
+    upgrade_qos: bool = False
+
+
+@dataclass
+class _InflightEntry:
+    msg: Message
+    phase: str  # 'puback' | 'pubrec' | 'pubcomp'
+    sent_at: float
+    dup: bool = False
+
+
+class Session:
+    """One client's session (mem-session semantics)."""
+
+    def __init__(self, client_id: str, cfg: Optional[SessionConfig] = None):
+        self.client_id = client_id
+        self.cfg = cfg or SessionConfig()
+        self.created_at = time.time()
+        self.subscriptions: Dict[str, SubOpts] = {}  # full filter (incl $share)
+        self.mqueue: Deque[Tuple[Message, SubOpts]] = deque()
+        self.inflight: "OrderedDict[int, _InflightEntry]" = OrderedDict()
+        self.awaiting_rel: Dict[int, float] = {}  # incoming QoS2 pids
+        self._next_pid = 1
+        self.connected = True
+        self.disconnected_at: Optional[float] = None
+        # counters surfaced in stats/info
+        self.dropped = 0
+
+    # --- packet-id allocation ------------------------------------------
+
+    def alloc_packet_id(self) -> int:
+        for _ in range(0xFFFF):
+            pid = self._next_pid
+            self._next_pid = pid % 0xFFFF + 1
+            if pid not in self.inflight:
+                return pid
+        raise RuntimeError("no free packet id")
+
+    # --- outgoing delivery ---------------------------------------------
+
+    def deliver(self, msg: Message, subopts: SubOpts) -> List[Publish]:
+        """Route one matched message into this session; returns the
+        PUBLISH packets to send now (emqx_session:deliver/3)."""
+        qos = min(msg.qos, subopts.qos) if not self.cfg.upgrade_qos else max(
+            msg.qos, subopts.qos
+        )
+        if subopts.no_local and msg.from_client == self.client_id:
+            return []
+        eff = Message(**{**msg.__dict__})
+        eff.qos = qos
+        if not subopts.retain_as_published:
+            eff.retain = False
+        if not self.connected:
+            self._enqueue(eff, subopts)
+            return []
+        if qos == 0:
+            return [self._to_publish(eff, None)]
+        if len(self.inflight) >= self.cfg.receive_maximum:
+            self._enqueue(eff, subopts)
+            return []
+        pid = self.alloc_packet_id()
+        self.inflight[pid] = _InflightEntry(
+            eff, "puback" if qos == 1 else "pubrec", time.time()
+        )
+        return [self._to_publish(eff, pid)]
+
+    def _enqueue(self, msg: Message, subopts: SubOpts) -> None:
+        if len(self.mqueue) >= self.cfg.max_mqueue_len:
+            # emqx_mqueue default: drop the oldest QoS0, else drop new
+            for i, (m, _o) in enumerate(self.mqueue):
+                if m.qos == 0:
+                    del self.mqueue[i]
+                    self.dropped += 1
+                    break
+            else:
+                self.dropped += 1
+                return
+        self.mqueue.append((msg, subopts))
+
+    def _to_publish(self, msg: Message, pid: Optional[int]) -> Publish:
+        props = dict(msg.props)
+        return Publish(
+            topic=msg.topic,
+            payload=msg.payload,
+            qos=msg.qos,
+            retain=msg.retain,
+            packet_id=pid,
+            props=props,
+        )
+
+    def drain(self) -> List[Publish]:
+        """Move queued messages into the inflight window (after acks
+        free slots, or on reconnect)."""
+        out: List[Publish] = []
+        while self.mqueue:
+            msg, subopts = self.mqueue[0]
+            if msg.expired():
+                self.mqueue.popleft()
+                self.dropped += 1
+                continue
+            if msg.qos == 0:
+                self.mqueue.popleft()
+                out.append(self._to_publish(msg, None))
+                continue
+            if len(self.inflight) >= self.cfg.receive_maximum:
+                break
+            self.mqueue.popleft()
+            pid = self.alloc_packet_id()
+            self.inflight[pid] = _InflightEntry(
+                msg, "puback" if msg.qos == 1 else "pubrec", time.time()
+            )
+            out.append(self._to_publish(msg, pid))
+        return out
+
+    # --- outgoing acks --------------------------------------------------
+
+    def on_puback(self, pid: int) -> bool:
+        e = self.inflight.get(pid)
+        if e is None or e.phase != "puback":
+            return False
+        del self.inflight[pid]
+        return True
+
+    def on_pubrec(self, pid: int) -> bool:
+        e = self.inflight.get(pid)
+        if e is None or e.phase != "pubrec":
+            return False
+        e.phase = "pubcomp"
+        e.msg = Message(topic=e.msg.topic)  # payload released (rel marker)
+        return True
+
+    def on_pubcomp(self, pid: int) -> bool:
+        e = self.inflight.get(pid)
+        if e is None or e.phase != "pubcomp":
+            return False
+        del self.inflight[pid]
+        return True
+
+    def retry(self, now: Optional[float] = None) -> List[Publish]:
+        """Re-send unacked QoS1/2 after retry_interval (dup=1)."""
+        now = now if now is not None else time.time()
+        out = []
+        for pid, e in self.inflight.items():
+            if now - e.sent_at >= self.cfg.retry_interval:
+                e.sent_at = now
+                e.dup = True
+                if e.phase in ("puback", "pubrec"):
+                    p = self._to_publish(e.msg, pid)
+                    p.dup = True
+                    out.append(p)
+                # phase 'pubcomp': PUBREL retransmit handled by channel
+        return out
+
+    # --- incoming QoS2 --------------------------------------------------
+
+    def await_rel(self, pid: int) -> bool:
+        """Register an incoming QoS2 publish; False if window full or
+        duplicate (duplicate is not an error: dup redelivery)."""
+        if pid in self.awaiting_rel:
+            return False
+        if len(self.awaiting_rel) >= self.cfg.max_awaiting_rel:
+            raise OverflowError("RECEIVE_MAXIMUM_EXCEEDED")
+        self.awaiting_rel[pid] = time.time()
+        return True
+
+    def release_rel(self, pid: int) -> bool:
+        return self.awaiting_rel.pop(pid, None) is not None
+
+    # --- lifecycle -------------------------------------------------------
+
+    def on_disconnect(self) -> None:
+        self.connected = False
+        self.disconnected_at = time.time()
+
+    def on_reconnect(self) -> List[Publish]:
+        """Resume: re-send inflight (dup) then drain the queue
+        (emqx_session_mem:replay)."""
+        self.connected = True
+        self.disconnected_at = None
+        out = []
+        for pid, e in self.inflight.items():
+            e.sent_at = time.time()
+            if e.phase in ("puback", "pubrec"):
+                p = self._to_publish(e.msg, pid)
+                p.dup = True
+                out.append(p)
+        out.extend(self.drain())
+        return out
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        if self.connected or self.disconnected_at is None:
+            return False
+        now = now if now is not None else time.time()
+        return now - self.disconnected_at >= self.cfg.session_expiry_interval

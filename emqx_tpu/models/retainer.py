@@ -1,0 +1,112 @@
+"""Retained-message store.
+
+Parity with apps/emqx_retainer: store the latest retained message per
+topic (empty payload deletes, MQTT spec), and on subscribe return all
+retained messages matching a new filter. The read pattern is the
+*inverse* of routing (a filter matched against stored topic names), so
+the store keeps its own exact-topic dict plus a trie over stored topic
+names for wildcard-filter reads — mirroring emqx_retainer_index's
+dedicated index tables (emqx_retainer_index.erl:17-50).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from ..broker.message import Message
+from ..ops import topic as topic_mod
+from ..ops.host_index import TopicTrie
+
+
+class Retainer:
+    def __init__(self, max_retained: int = 1_000_000):
+        self.max_retained = max_retained
+        self._store: Dict[str, Message] = {}
+        # trie of stored TOPIC NAMES (no wildcards): match(filter_words)
+        # cannot use TopicTrie.match directly (it matches topic->filters);
+        # instead we walk the trie with the filter. Keep a names trie
+        # keyed by exact words.
+        self._names = TopicTrie()
+
+    def __len__(self) -> int:
+        return len(self._store)
+
+    def retain(self, msg: Message) -> None:
+        """Store/replace/delete (empty payload) the retained message."""
+        if not msg.payload:
+            old = self._store.pop(msg.topic, None)
+            if old is not None:
+                self._names.remove(topic_mod.words(msg.topic), msg.topic)
+            return
+        if msg.topic not in self._store:
+            if len(self._store) >= self.max_retained:
+                return  # full: drop (reference behavior is configurable)
+            self._names.insert(topic_mod.words(msg.topic), msg.topic)
+        self._store[msg.topic] = msg
+
+    def read(self, flt: str, now: Optional[float] = None) -> List[Message]:
+        """All live retained messages matching the filter."""
+        now = now if now is not None else time.time()
+        out = []
+        if not topic_mod.is_wildcard(flt):
+            m = self._store.get(flt)
+            if m is not None and not m.expired(now):
+                out.append(m)
+            return out
+        fw = topic_mod.words(flt)
+        for name in self._match_names(fw):
+            m = self._store.get(name)
+            if m is not None and not m.expired(now):
+                out.append(m)
+        return out
+
+    def _match_names(self, fw) -> List[str]:
+        """Walk the names trie with a wildcard filter (inverse match)."""
+        has_hash = fw[-1] == "#"
+        prefix = fw[:-1] if has_hash else fw
+        results: List[str] = []
+        # stack: (node, filter position)
+        stack = [(self._names._root, 0)]
+        while stack:
+            node, i = stack.pop()
+            if i == len(prefix):
+                if has_hash:
+                    if i == 0:
+                        # bare '#': root wildcards never cover '$'-topics
+                        results.extend(node.ids)
+                        for cw, child in node.children.items():
+                            if not cw.startswith("$"):
+                                self._collect_all(child, results)
+                    else:
+                        self._collect_all(node, results)
+                else:
+                    results.extend(node.ids)
+                continue
+            w = prefix[i]
+            if w == "+":
+                for cw, child in node.children.items():
+                    if i == 0 and cw.startswith("$"):
+                        continue  # '$'-root isolation
+                    stack.append((child, i + 1))
+            else:
+                child = node.children.get(w)
+                if child is not None:
+                    stack.append((child, i + 1))
+        return results
+
+    def _collect_all(self, node, results: List[str]) -> None:
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            results.extend(n.ids)
+            stack.extend(n.children.values())
+
+    def clean(self, now: Optional[float] = None) -> int:
+        """Drop expired retained messages; returns count removed."""
+        now = now if now is not None else time.time()
+        dead = [t for t, m in self._store.items() if m.expired(now)]
+        for t in dead:
+            self._names.remove(topic_mod.words(t), t)
+            del self._store[t]
+        return len(dead)

@@ -43,20 +43,34 @@ SYNC_BATCH_SIZE = 1024  # rows per scatter step (ref: ?MAX_BATCH_SIZE 1000)
 @functools.partial(jax.jit, donate_argnums=0)
 def _scatter_rows(
     dev: EncodedFilters,
-    rows: jnp.ndarray,  # int32 [K]
-    words: jnp.ndarray,  # int32 [K, L]
-    prefix_len: jnp.ndarray,  # int32 [K]
-    has_hash: jnp.ndarray,  # bool [K]
-    root_wild: jnp.ndarray,  # bool [K]
-    active: jnp.ndarray,  # bool [K]
+    rows: jnp.ndarray,  # int32 [n_batches, K]
+    words: jnp.ndarray,  # int32 [n_batches, K, L]
+    prefix_len: jnp.ndarray,  # int32 [n_batches, K]
+    has_hash: jnp.ndarray,  # bool [n_batches, K]
+    root_wild: jnp.ndarray,  # bool [n_batches, K]
+    active: jnp.ndarray,  # bool [n_batches, K]
 ) -> EncodedFilters:
-    return EncodedFilters(
-        dev.words.at[rows].set(words),
-        dev.prefix_len.at[rows].set(prefix_len),
-        dev.has_hash.at[rows].set(has_hash),
-        dev.root_wild.at[rows].set(root_wild),
-        dev.active.at[rows].set(active),
+    """Apply all delta batches in ONE dispatch (scan over the batch
+    axis) — chained dispatches do not pipeline through the device relay
+    (PERF_NOTES.md), so a bulk route sync must not pay RTT per batch."""
+
+    def step(d, xs):
+        r, w, p, h, rw_, a = xs
+        return (
+            EncodedFilters(
+                d.words.at[r].set(w),
+                d.prefix_len.at[r].set(p),
+                d.has_hash.at[r].set(h),
+                d.root_wild.at[r].set(rw_),
+                d.active.at[r].set(a),
+            ),
+            None,
+        )
+
+    out, _ = jax.lax.scan(
+        step, dev, (rows, words, prefix_len, has_hash, root_wild, active)
     )
+    return out
 
 
 class DeviceTable:
@@ -90,21 +104,25 @@ class DeviceTable:
             return n
         dirty = t.drain_dirty()
         total = len(dirty)
-        for off in range(0, total, SYNC_BATCH_SIZE):
-            batch = dirty[off : off + SYNC_BATCH_SIZE]
-            k = len(batch)
-            rows = np.empty(SYNC_BATCH_SIZE, np.int32)
-            rows[:k] = batch
-            rows[k:] = batch[-1]  # idempotent padding: rewrite last row
-            self._dev = _scatter_rows(
-                self._dev,
-                jnp.asarray(rows),
-                jnp.asarray(t.words[rows]),
-                jnp.asarray(t.prefix_len[rows]),
-                jnp.asarray(t.has_hash[rows]),
-                jnp.asarray(t.root_wild[rows]),
-                jnp.asarray(t.active[rows]),
-            )
+        if total == 0:
+            return 0
+        # pad to [n_batches, K]: idempotent padding rewrites the last row;
+        # n_batches rounds up to a power of two so recompiles stay
+        # log-bounded across workload sizes
+        n_batches = max(1, -(-total // SYNC_BATCH_SIZE))
+        n_batches = 1 << (n_batches - 1).bit_length()
+        rows = np.full(n_batches * SYNC_BATCH_SIZE, dirty[-1], np.int32)
+        rows[:total] = dirty
+        shape2 = (n_batches, SYNC_BATCH_SIZE)
+        self._dev = _scatter_rows(
+            self._dev,
+            jnp.asarray(rows.reshape(shape2)),
+            jnp.asarray(t.words[rows].reshape(shape2 + (t.max_levels,))),
+            jnp.asarray(t.prefix_len[rows].reshape(shape2)),
+            jnp.asarray(t.has_hash[rows].reshape(shape2)),
+            jnp.asarray(t.root_wild[rows].reshape(shape2)),
+            jnp.asarray(t.active[rows].reshape(shape2)),
+        )
         return total
 
     def filters(self) -> EncodedFilters:
@@ -126,8 +144,10 @@ class Router:
         self._pair_row: Dict[Tuple[str, Dest], int] = {}
         self._pair_refs: Dict[Tuple[str, Dest], int] = {}
         self._row_dest: Dict[int, Tuple[str, Dest]] = {}
-        # filters too deep for the flattened table: host-only
+        # filters too deep for the flattened table: host-only, in their
+        # own depth-unlimited trie (ids are (filter, dest) pairs)
         self._deep: Dict[Tuple[str, Dest], int] = {}
+        self._deep_trie = TopicTrie()
         self.device_table = DeviceTable(self.table, device=device)
 
     # --- write path (emqx_router:do_add_route / do_delete_route) -------
@@ -148,6 +168,7 @@ class Router:
             row = self.table.add(flt)
         except FilterTooDeep:
             self._deep[key] = 1
+            self._deep_trie.insert(topic_mod.words(flt), key)
             return
         self._pair_row[key] = row
         self._pair_refs[key] = 1
@@ -170,6 +191,7 @@ class Router:
             self._deep[key] -= 1
             if self._deep[key] == 0:
                 del self._deep[key]
+                self._deep_trie.remove(topic_mod.words(flt), key)
             return
         if key not in self._pair_refs:
             return
@@ -206,11 +228,7 @@ class Router:
     # --- read path (emqx_router:match_routes) ---------------------------
 
     def _deep_matches(self, topic_words) -> Set[Dest]:
-        return {
-            d
-            for (f, d) in self._deep
-            if topic_mod.match(topic_words, topic_mod.words(f))
-        }
+        return {d for (_f, d) in self._deep_trie.match(topic_words)}
 
     def _exact_dests(self, topic: str) -> Set[Dest]:
         return set(self._exact.get(topic, ()))
@@ -234,15 +252,25 @@ class Router:
             return []
         self.device_table.sync()
         enc = match_ops.encode_topics(self.table.vocab, topics, self.max_levels)
-        packed = np.asarray(
-            match_ops.match_packed(self.device_table.filters(), enc)
+        filters = self.device_table.filters()
+        out: List[Set[Dest]] = [self._exact_dests(t) for t in topics]
+        # compacted result: transfer ∝ matches; pick the bound from the
+        # batch size and escalate once on overflow before the bitmap
+        # fallback (transfer ∝ table size)
+        max_hits = max(4096, 4 * len(topics))
+        ti, ri, total = (
+            np.asarray(a)
+            for a in match_ops.match_ids(filters, enc, max_hits=max_hits)
         )
-        out: List[Set[Dest]] = []
-        for i, t in enumerate(topics):
-            dests = self._exact_dests(t)
-            for row in match_ops.unpack_indices(packed[i]):
-                dests.add(self._row_dest[int(row)][1])
-            if self._deep:
-                dests |= self._deep_matches(topic_mod.words(t))
-            out.append(dests)
+        if total > max_hits:
+            packed = np.asarray(match_ops.match_packed(filters, enc))
+            for i in range(len(topics)):
+                for row in match_ops.unpack_indices(packed[i]):
+                    out[i].add(self._row_dest[int(row)][1])
+        else:
+            for t_idx, row in zip(ti[:total], ri[:total]):
+                out[t_idx].add(self._row_dest[int(row)][1])
+        if self._deep:
+            for i, t in enumerate(topics):
+                out[i] |= self._deep_matches(topic_mod.words(t))
         return out

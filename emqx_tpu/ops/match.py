@@ -142,6 +142,61 @@ def match_packed(
     return jnp.transpose(ys, (1, 0, 2)).reshape(b, n // 32)
 
 
+@functools.partial(jax.jit, static_argnames=("max_hits", "chunk"))
+def match_ids(
+    filters: EncodedFilters,
+    topics: EncodedTopics,
+    max_hits: int = 4096,
+    chunk: int = 65536,
+):
+    """Device-side compaction: returns (topic_idx int32 [max_hits],
+    row_idx int32 [max_hits], total int32). Each valid slot i holds one
+    matching (topic, filter-row) pair; slots beyond the true hit count
+    are -1. If total > max_hits the result overflowed — the caller must
+    fall back to match_packed. This keeps the device→host transfer
+    proportional to the number of MATCHES, not the table size
+    (PERF_NOTES.md: packed bitmaps are 128MB/batch at 1M rows; matches
+    are a few KB)."""
+    n = filters.words.shape[0]
+    chunk = min(chunk, n)
+    assert n % chunk == 0, (n, chunk)
+    n_chunks = n // chunk
+    b = topics.ids.shape[0]
+
+    def step(carry, xs):
+        t_buf, r_buf, pos = carry
+        words, plen, hh, rw, act, off = xs
+        ok = _match_block(
+            topics.ids, topics.lens, topics.dollar, words, plen, hh, rw, act
+        )  # [B, chunk]
+        cnt = ok.sum(dtype=jnp.int32)
+        idx = jnp.nonzero(ok.reshape(-1), size=max_hits, fill_value=-1)[0]
+        valid = idx >= 0
+        ti = jnp.where(valid, idx // chunk, -1).astype(jnp.int32)
+        ri = jnp.where(valid, idx % chunk + off, -1).astype(jnp.int32)
+        # valid entries are dense at the front; write them at pos+rank
+        dst = jnp.where(valid, pos + jnp.arange(max_hits, dtype=jnp.int32), max_hits)
+        t_buf = t_buf.at[dst].set(ti, mode="drop")
+        r_buf = r_buf.at[dst].set(ri, mode="drop")
+        return (t_buf, r_buf, pos + cnt), None
+
+    xs = (
+        filters.words.reshape(n_chunks, chunk, -1),
+        filters.prefix_len.reshape(n_chunks, chunk),
+        filters.has_hash.reshape(n_chunks, chunk),
+        filters.root_wild.reshape(n_chunks, chunk),
+        filters.active.reshape(n_chunks, chunk),
+        jnp.arange(n_chunks, dtype=jnp.int32) * chunk,
+    )
+    init = (
+        jnp.full(max_hits, -1, jnp.int32),
+        jnp.full(max_hits, -1, jnp.int32),
+        jnp.int32(0),
+    )
+    (t_buf, r_buf, total), _ = jax.lax.scan(step, init, xs)
+    return t_buf, r_buf, total
+
+
 @jax.jit
 def match_counts(filters: EncodedFilters, topics: EncodedTopics) -> jnp.ndarray:
     """int32 [B] — matches per topic (metrics / routing decisions)."""

@@ -1,0 +1,1 @@
+"""Device mesh, shardings, and multi-chip match/update paths."""

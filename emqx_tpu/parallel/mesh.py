@@ -1,0 +1,102 @@
+"""Device mesh + sharding layout for the distributed matcher.
+
+The reference scales by (a) fully replicating route tables per node
+(mria ram_copies, emqx_router.erl:133-162) and (b) sharding fanout
+work across pools (SURVEY.md §2.5). On a TPU pod the idiomatic layout
+is the opposite of replication: *partition* the subscription table
+across chips and let ICI collectives reassemble per-topic results —
+the moral equivalent of context parallelism over the subscription
+axis:
+
+  mesh axes:
+    dp   — topic-batch data parallelism (inbound publish stream split)
+    sub  — subscription-table model parallelism (filter rows split)
+
+  shardings:
+    filter table arrays  [N, ...]  -> P('sub')         (rows split)
+    topic batch arrays   [B, ...]  -> P('dp')          (batch split)
+    match matrix         [B, N]    -> P('dp', 'sub')   (2-D tiles)
+    per-topic counts     [B]       -> P('dp')          (psum over sub)
+
+XLA's SPMD partitioner inserts the all-reduce over 'sub' for count
+reductions; packed bitmaps stay tiled and are fetched shard-wise.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.match import EncodedTopics
+from ..ops.table import EncodedFilters
+
+DP_AXIS = "dp"
+SUB_AXIS = "sub"
+
+
+def make_mesh(
+    n_dp: Optional[int] = None,
+    n_sub: Optional[int] = None,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a (dp, sub) mesh over the given (default: all) devices.
+    With neither count given, prefers sharding the subscription axis
+    (n_dp=1): table HBM capacity is the scaling reason to go
+    multi-chip at all (10M+ filter rows)."""
+    devs = list(devices if devices is not None else jax.devices())
+    n = len(devs)
+    if n_dp is None and n_sub is None:
+        n_dp, n_sub = 1, n
+    elif n_dp is None:
+        assert n % n_sub == 0, (n, n_sub)
+        n_dp = n // n_sub
+    elif n_sub is None:
+        assert n % n_dp == 0, (n, n_dp)
+        n_sub = n // n_dp
+    assert n_dp * n_sub == n, (n_dp, n_sub, n)
+    arr = np.asarray(devs).reshape(n_dp, n_sub)
+    return Mesh(arr, (DP_AXIS, SUB_AXIS))
+
+
+def filter_sharding(mesh: Mesh) -> EncodedFilters:
+    """Shardings for each EncodedFilters leaf (rows over 'sub')."""
+    row = NamedSharding(mesh, P(SUB_AXIS))
+    return EncodedFilters(
+        NamedSharding(mesh, P(SUB_AXIS, None)), row, row, row, row
+    )
+
+
+def topic_sharding(mesh: Mesh) -> EncodedTopics:
+    """Shardings for each EncodedTopics leaf (batch over 'dp')."""
+    row = NamedSharding(mesh, P(DP_AXIS))
+    return EncodedTopics(NamedSharding(mesh, P(DP_AXIS, None)), row, row)
+
+
+def put_filters(filters: EncodedFilters, mesh: Mesh) -> EncodedFilters:
+    """Place a host filter-table snapshot onto the mesh, rows split
+    over 'sub'. Row count must divide the sub axis (power-of-two table
+    capacities do)."""
+    shs = filter_sharding(mesh)
+    return EncodedFilters(
+        *(jax.device_put(a, s) for a, s in zip(filters, shs))
+    )
+
+
+def put_topics(enc: EncodedTopics, mesh: Mesh) -> EncodedTopics:
+    """Place an encoded topic batch onto the mesh, batch over 'dp'.
+    Pads the batch up to a multiple of the dp axis size."""
+    n_dp = mesh.shape[DP_AXIS]
+    b = enc.ids.shape[0]
+    pad = (-b) % n_dp
+    if pad:
+        enc = EncodedTopics(
+            np.pad(enc.ids, ((0, pad), (0, 0))),
+            np.pad(enc.lens, (0, pad)),
+            np.pad(enc.dollar, (0, pad)),
+        )
+    shs = topic_sharding(mesh)
+    return EncodedTopics(*(jax.device_put(a, s) for a, s in zip(enc, shs)))

@@ -1,0 +1,103 @@
+"""Multi-chip match + table update over a (dp, sub) mesh.
+
+Two styles, both idiomatic:
+
+* The *match* path relies on XLA SPMD auto-partitioning: the dense
+  predicate is elementwise over the [B, N] plane, so sharded inputs
+  ([B]→'dp', [N]→'sub') partition it with zero communication; count
+  reductions become one psum over 'sub' that XLA inserts on its own.
+  (This replaces the reference's full-table replication + local match,
+  emqx_router.erl:133-162 — ICI is fast enough to partition instead.)
+
+* The *update* path (route add/delete deltas) uses shard_map because
+  each 'sub' shard must translate global row ids into its local slice:
+  every shard receives the same delta batch (deltas are tiny — ≤1024
+  rows, mirroring emqx_router_syncer batches) and applies the rows it
+  owns with a masked scatter; rows outside the shard drop out. This is
+  the mria-rlog analog: one write stream, applied shard-locally.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.match import EncodedTopics, _match_block, _pack_bits
+from ..ops.table import EncodedFilters
+from .mesh import DP_AXIS, SUB_AXIS, filter_sharding, topic_sharding
+
+
+def make_sharded_kernels(mesh: Mesh):
+    """Compile the mesh-partitioned kernels. Returns
+    (match_counts, match_packed, apply_delta)."""
+
+    f_shard = filter_sharding(mesh)
+    t_shard = topic_sharding(mesh)
+    counts_out = NamedSharding(mesh, P(DP_AXIS))
+    packed_out = NamedSharding(mesh, P(DP_AXIS, SUB_AXIS))
+    repl = NamedSharding(mesh, P())
+
+    @functools.partial(
+        jax.jit,
+        in_shardings=(f_shard, t_shard),
+        out_shardings=counts_out,
+    )
+    def match_counts(filters: EncodedFilters, topics: EncodedTopics):
+        ok = _match_block(topics.ids, topics.lens, topics.dollar, *filters)
+        return ok.sum(axis=1, dtype=jnp.int32)  # XLA: psum over 'sub'
+
+    @functools.partial(
+        jax.jit,
+        in_shardings=(f_shard, t_shard),
+        out_shardings=packed_out,
+    )
+    def match_packed(filters: EncodedFilters, topics: EncodedTopics):
+        ok = _match_block(topics.ids, topics.lens, topics.dollar, *filters)
+        return _pack_bits(ok)
+
+    n_sub = mesh.shape[SUB_AXIS]
+
+    def _apply_delta_local(dev: EncodedFilters, rows, words, plen, hh, rw, act):
+        # dev leaves are the LOCAL shard [N/n_sub, ...]; rows are global.
+        local_n = dev.words.shape[0]
+        offset = jax.lax.axis_index(SUB_AXIS).astype(jnp.int32) * local_n
+        local = rows - offset
+        # rows outside this shard scatter out of range -> dropped
+        oob = (local < 0) | (local >= local_n)
+        local = jnp.where(oob, local_n, local)
+        return EncodedFilters(
+            dev.words.at[local].set(words, mode="drop"),
+            dev.prefix_len.at[local].set(plen, mode="drop"),
+            dev.has_hash.at[local].set(hh, mode="drop"),
+            dev.root_wild.at[local].set(rw, mode="drop"),
+            dev.active.at[local].set(act, mode="drop"),
+        )
+
+    dev_specs = EncodedFilters(
+        P(SUB_AXIS, None), P(SUB_AXIS), P(SUB_AXIS), P(SUB_AXIS), P(SUB_AXIS)
+    )
+    # rows, words, plen, hh, rw, act — all replicated to every shard
+    delta_specs = (P(None), P(None, None), P(None), P(None), P(None), P(None))
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def apply_delta(
+        dev: EncodedFilters,
+        rows: jnp.ndarray,  # int32 [K] global row ids
+        words: jnp.ndarray,  # int32 [K, L]
+        plen: jnp.ndarray,
+        hh: jnp.ndarray,
+        rw: jnp.ndarray,
+        act: jnp.ndarray,
+    ) -> EncodedFilters:
+        return jax.shard_map(
+            _apply_delta_local,
+            mesh=mesh,
+            in_specs=(dev_specs,) + delta_specs,
+            out_specs=dev_specs,
+        )(dev, rows, words, plen, hh, rw, act)
+
+    return match_counts, match_packed, apply_delta

@@ -8,7 +8,9 @@ sharding/collective paths execute for real, without hardware.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force CPU even when the shell exports a TPU platform (axon): tests
+# must be hermetic and able to fake an 8-device mesh
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -18,3 +20,33 @@ if "xla_force_host_platform_device_count" not in flags:
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# jax may already be imported (the axon sitecustomize registers the TPU
+# relay plugin at interpreter start) — override via config as well; this
+# works as long as no backend has been initialized yet.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+# --- minimal async-test support (pytest-asyncio is not in the image) ----
+import asyncio
+import inspect
+
+import pytest
+
+
+@pytest.hookimpl(tryfirst=True)
+def pytest_pyfunc_call(pyfuncitem):
+    func = pyfuncitem.obj
+    if inspect.iscoroutinefunction(func):
+        kwargs = {
+            name: pyfuncitem.funcargs[name]
+            for name in pyfuncitem._fixtureinfo.argnames
+        }
+        asyncio.run(asyncio.wait_for(func(**kwargs), timeout=30))
+        return True
+    return None
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "asyncio: async test (built-in runner)")

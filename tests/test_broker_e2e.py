@@ -1,0 +1,340 @@
+"""End-to-end broker tests over real sockets: an asyncio MQTT client
+(built on our own codec, like the reference tests use the emqtt client)
+drives CONNECT/SUBSCRIBE/PUBLISH/QoS flows against a live Server."""
+
+import asyncio
+
+import pytest
+
+from emqx_tpu.broker import frame as F
+from emqx_tpu.broker.packet import (
+    MQTT_V4,
+    MQTT_V5,
+    Connack,
+    Connect,
+    Disconnect,
+    Pingreq,
+    Pingresp,
+    Puback,
+    Publish,
+    Suback,
+    SubOpts,
+    Subscribe,
+    Type,
+    Unsuback,
+    Unsubscribe,
+    Will,
+)
+from emqx_tpu.broker.pubsub import Broker
+from emqx_tpu.broker.server import Server
+
+
+class MiniClient:
+    """Raw-socket MQTT client for tests."""
+
+    def __init__(self, port, ver=MQTT_V4):
+        self.port = port
+        self.ver = ver
+        self.parser = F.Parser(proto_ver=ver)
+        self.inbox = asyncio.Queue()
+        self._task = None
+
+    async def connect(self, client_id, clean_start=True, keepalive=60, will=None,
+                      props=None):
+        self.reader, self.writer = await asyncio.open_connection("127.0.0.1", self.port)
+        self._task = asyncio.create_task(self._read_loop())
+        await self.send(
+            Connect(
+                proto_ver=self.ver,
+                clean_start=clean_start,
+                keepalive=keepalive,
+                client_id=client_id,
+                will=will,
+                props=props or {},
+            )
+        )
+        ack = await self.expect(Connack)
+        return ack
+
+    async def _read_loop(self):
+        try:
+            while True:
+                data = await self.reader.read(65536)
+                if not data:
+                    break
+                for pkt in self.parser.feed(data):
+                    await self.inbox.put(pkt)
+        except Exception:
+            pass
+
+    async def send(self, pkt):
+        self.writer.write(F.serialize(pkt, self.ver))
+        await self.writer.drain()
+
+    async def expect(self, typ, timeout=2.0):
+        pkt = await asyncio.wait_for(self.inbox.get(), timeout)
+        assert isinstance(pkt, typ), f"expected {typ.__name__}, got {pkt}"
+        return pkt
+
+    async def subscribe(self, *filters, qos=0, pid=1):
+        await self.send(
+            Subscribe(pid, [(f, SubOpts(qos=qos)) for f in filters])
+        )
+        return await self.expect(Suback)
+
+    async def publish(self, topic, payload=b"", qos=0, retain=False, pid=None):
+        await self.send(
+            Publish(topic=topic, payload=payload, qos=qos, retain=retain, packet_id=pid)
+        )
+
+    async def close(self):
+        self.writer.close()
+        if self._task:
+            self._task.cancel()
+
+
+from contextlib import asynccontextmanager
+
+
+@asynccontextmanager
+async def make_server():
+    srv = Server(broker=Broker(), port=0)
+    await srv.start()
+    srv.port = srv._server.sockets[0].getsockname()[1]
+    try:
+        yield srv
+    finally:
+        await srv.stop()
+
+
+
+
+
+async def test_connect_ping_disconnect():
+    async with make_server() as server:
+        c = MiniClient(server.port)
+        ack = await c.connect("c1")
+        assert ack.code == 0 and not ack.session_present
+        await c.send(Pingreq())
+        await c.expect(Pingresp)
+        await c.send(Disconnect())
+        await c.close()
+
+
+async def test_pubsub_qos0():
+    async with make_server() as server:
+        sub = MiniClient(server.port)
+        await sub.connect("sub1")
+        await sub.subscribe("t/+/x", "exact/topic")
+        pub = MiniClient(server.port)
+        await pub.connect("pub1")
+        await pub.publish("t/1/x", b"hello")
+        msg = await sub.expect(Publish)
+        assert msg.topic == "t/1/x" and msg.payload == b"hello" and msg.qos == 0
+        await pub.publish("exact/topic", b"e")
+        msg = await sub.expect(Publish)
+        assert msg.topic == "exact/topic"
+        await pub.publish("t/nomatch", b"z")
+        await pub.publish("t/2/x", b"again")
+        msg = await sub.expect(Publish)
+        assert msg.topic == "t/2/x"  # nomatch skipped
+        for c in (sub, pub):
+            await c.close()
+
+
+async def test_qos1_flow():
+    async with make_server() as server:
+        sub = MiniClient(server.port)
+        await sub.connect("s1")
+        await sub.subscribe("q1/#", qos=1)
+        pub = MiniClient(server.port)
+        await pub.connect("p1")
+        await pub.publish("q1/a", b"m1", qos=1, pid=10)
+        ack = await pub.expect(Puback)
+        assert ack.type == Type.PUBACK and ack.packet_id == 10
+        msg = await sub.expect(Publish)
+        assert msg.qos == 1 and msg.packet_id is not None and msg.payload == b"m1"
+        await sub.send(Puback(Type.PUBACK, msg.packet_id))
+        for c in (sub, pub):
+            await c.close()
+
+
+async def test_qos2_flow():
+    async with make_server() as server:
+        sub = MiniClient(server.port)
+        await sub.connect("s2")
+        await sub.subscribe("q2/t", qos=2)
+        pub = MiniClient(server.port)
+        await pub.connect("p2")
+        await pub.publish("q2/t", b"m2", qos=2, pid=21)
+        rec = await pub.expect(Puback)
+        assert rec.type == Type.PUBREC
+        await pub.send(Puback(Type.PUBREL, 21))
+        comp = await pub.expect(Puback)
+        assert comp.type == Type.PUBCOMP
+        # subscriber side: PUBLISH qos2 -> PUBREC -> PUBREL -> PUBCOMP
+        msg = await sub.expect(Publish)
+        assert msg.qos == 2
+        await sub.send(Puback(Type.PUBREC, msg.packet_id))
+        rel = await sub.expect(Puback)
+        assert rel.type == Type.PUBREL
+        await sub.send(Puback(Type.PUBCOMP, msg.packet_id))
+        # a TRUE duplicate (resent before PUBREL, dup flag) must not
+        # publish twice: send a new QoS2 pid, resend it, then release
+        await pub.send(
+            Publish(topic="q2/t", payload=b"m3", qos=2, packet_id=22)
+        )
+        rec2 = await pub.expect(Puback)
+        assert rec2.type == Type.PUBREC and rec2.packet_id == 22
+        await pub.send(
+            Publish(topic="q2/t", payload=b"m3", qos=2, packet_id=22, dup=True)
+        )
+        rec3 = await pub.expect(Puback)
+        assert rec3.type == Type.PUBREC and rec3.packet_id == 22
+        await pub.send(Puback(Type.PUBREL, 22))
+        comp2 = await pub.expect(Puback)
+        assert comp2.type == Type.PUBCOMP
+        # exactly ONE delivery of m3 despite the duplicate PUBLISH
+        m3 = await sub.expect(Publish)
+        assert m3.payload == b"m3"
+        await sub.send(Puback(Type.PUBREC, m3.packet_id))
+        await sub.expect(Puback)  # PUBREL
+        await sub.send(Puback(Type.PUBCOMP, m3.packet_id))
+        await asyncio.sleep(0.05)
+        assert sub.inbox.empty()
+        for c in (sub, pub):
+            await c.close()
+
+
+async def test_retained():
+    async with make_server() as server:
+        pub = MiniClient(server.port)
+        await pub.connect("rp")
+        await pub.publish("state/dev1", b"on", retain=True)
+        await pub.publish("state/dev2", b"off", retain=True)
+        await asyncio.sleep(0.05)
+        sub = MiniClient(server.port)
+        await sub.connect("rs")
+        await sub.subscribe("state/+")
+        got = {}
+        for _ in range(2):
+            m = await sub.expect(Publish)
+            got[m.topic] = (m.payload, m.retain)
+        assert got == {"state/dev1": (b"on", True), "state/dev2": (b"off", True)}
+        # deleting via empty retained payload
+        await pub.publish("state/dev1", b"", retain=True)
+        await asyncio.sleep(0.05)
+        sub2 = MiniClient(server.port)
+        await sub2.connect("rs2")
+        await sub2.subscribe("state/+")
+        m = await sub2.expect(Publish)
+        assert m.topic == "state/dev2"
+        assert sub2.inbox.empty()
+        for c in (pub, sub, sub2):
+            await c.close()
+
+
+async def test_unsubscribe():
+    async with make_server() as server:
+        c = MiniClient(server.port)
+        await c.connect("u1")
+        await c.subscribe("a/#")
+        await c.send(Unsubscribe(9, ["a/#", "never/was"]))
+        ua = await c.expect(Unsuback)
+        assert ua.packet_id == 9
+        p = MiniClient(server.port)
+        await p.connect("u2")
+        await p.publish("a/x", b"1")
+        await asyncio.sleep(0.05)
+        assert c.inbox.empty()
+        for x in (c, p):
+            await x.close()
+
+
+async def test_will_message():
+    async with make_server() as server:
+        w = MiniClient(server.port)
+        await w.connect("willer", will=Will(topic="wills/w1", payload=b"gone"))
+        sub = MiniClient(server.port)
+        await sub.connect("watcher")
+        await sub.subscribe("wills/#")
+        # abrupt close (no DISCONNECT) -> will published
+        w.writer.close()
+        m = await sub.expect(Publish)
+        assert m.topic == "wills/w1" and m.payload == b"gone"
+        await sub.close()
+
+
+async def test_clean_disconnect_no_will():
+    async with make_server() as server:
+        w = MiniClient(server.port)
+        await w.connect("willer2", will=Will(topic="wills/w2", payload=b"gone"))
+        sub = MiniClient(server.port)
+        await sub.connect("watcher2")
+        await sub.subscribe("wills/#")
+        await w.send(Disconnect())
+        await w.close()
+        await asyncio.sleep(0.1)
+        assert sub.inbox.empty()
+        await sub.close()
+
+
+async def test_session_resume_v5():
+    async with make_server() as server:
+        sub = MiniClient(server.port, ver=MQTT_V5)
+        await sub.connect("persist1", props={"session_expiry_interval": 300})
+        await sub.subscribe("keep/#", qos=1)
+        sub.writer.close()  # drop without DISCONNECT; session persists
+        await asyncio.sleep(0.05)
+        pub = MiniClient(server.port)
+        await pub.connect("pp")
+        await pub.publish("keep/x", b"queued", qos=1, pid=5)
+        await pub.expect(Puback)
+        # reconnect with clean_start=False resumes and replays
+        sub2 = MiniClient(server.port, ver=MQTT_V5)
+        ack = await sub2.connect(
+            "persist1", clean_start=False, props={"session_expiry_interval": 300}
+        )
+        assert ack.session_present
+        m = await sub2.expect(Publish)
+        assert m.topic == "keep/x" and m.payload == b"queued" and m.qos == 1
+        for c in (pub, sub2):
+            await c.close()
+
+
+async def test_shared_subscription():
+    async with make_server() as server:
+        subs = []
+        for i in range(3):
+            c = MiniClient(server.port)
+            await c.connect(f"worker{i}")
+            await c.subscribe("$share/g1/jobs/#")
+            subs.append(c)
+        pub = MiniClient(server.port)
+        await pub.connect("dispatcher")
+        for i in range(30):
+            await pub.publish("jobs/t", b"%d" % i)
+        await asyncio.sleep(0.2)
+        counts = [s.inbox.qsize() for s in subs]
+        assert sum(counts) == 30, counts  # each message to exactly one member
+        for c in subs + [pub]:
+            await c.close()
+
+
+async def test_dollar_topics_isolated():
+    async with make_server() as server:
+        sub = MiniClient(server.port)
+        await sub.connect("d1")
+        await sub.subscribe("#", "$SYS/#")
+        pub = MiniClient(server.port)
+        await pub.connect("d2")
+        await pub.publish("$SYS/fake", b"x")
+        await pub.publish("normal", b"y")
+        m = await sub.expect(Publish)
+        assert m.topic == "$SYS/fake"  # via $SYS/#, not '#'
+        m2 = await sub.expect(Publish)
+        assert m2.topic == "normal"
+        await asyncio.sleep(0.05)
+        assert sub.inbox.empty()  # '$SYS/fake' delivered once, not twice
+        for c in (sub, pub):
+            await c.close()

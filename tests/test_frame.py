@@ -1,0 +1,195 @@
+"""Codec tests: round-trip property tests (prop_emqx_frame analog),
+incremental feeding, malformed-input rejection."""
+
+import random
+
+import pytest
+
+from emqx_tpu.broker import frame as F
+from emqx_tpu.broker.packet import (
+    MQTT_V4,
+    MQTT_V5,
+    Auth,
+    Connack,
+    Connect,
+    Disconnect,
+    Pingreq,
+    Pingresp,
+    Puback,
+    Publish,
+    Suback,
+    SubOpts,
+    Subscribe,
+    Type,
+    Unsuback,
+    Unsubscribe,
+    Will,
+)
+
+
+def roundtrip(pkt, ver):
+    raw = F.serialize(pkt, ver)
+    p = F.Parser(proto_ver=ver)
+    out = p.feed(raw)
+    assert len(out) == 1, out
+    return out[0]
+
+
+@pytest.mark.parametrize("ver", [MQTT_V4, MQTT_V5])
+def test_roundtrip_connect(ver):
+    pkt = Connect(
+        proto_ver=ver,
+        clean_start=True,
+        keepalive=30,
+        client_id="cid-1",
+        username="u",
+        password=b"pw",
+        will=Will(topic="w/t", payload=b"bye", qos=1, retain=True),
+        props={"session_expiry_interval": 300} if ver == MQTT_V5 else {},
+    )
+    out = roundtrip(pkt, ver)
+    assert out == pkt
+
+
+@pytest.mark.parametrize("ver", [MQTT_V4, MQTT_V5])
+def test_roundtrip_publish(ver):
+    pkt = Publish(
+        topic="a/b/c",
+        payload=b"\x00\x01data",
+        qos=1,
+        retain=True,
+        dup=True,
+        packet_id=77,
+        props=(
+            {"message_expiry_interval": 60, "user_property": [("k", "v"), ("k", "v2")]}
+            if ver == MQTT_V5
+            else {}
+        ),
+    )
+    assert roundtrip(pkt, ver) == pkt
+
+
+def test_roundtrip_qos0_no_pid():
+    pkt = Publish(topic="t", payload=b"x", qos=0)
+    assert roundtrip(pkt, MQTT_V4) == pkt
+
+
+@pytest.mark.parametrize("ver", [MQTT_V4, MQTT_V5])
+def test_roundtrip_sub_unsub(ver):
+    s = Subscribe(
+        5,
+        [
+            ("a/+", SubOpts(qos=1)),
+            ("b/#", SubOpts(qos=2, no_local=True, retain_as_published=True, retain_handling=2)),
+        ],
+        props={"subscription_identifier": 9} if ver == MQTT_V5 else {},
+    )
+    out = roundtrip(s, ver)
+    if ver == MQTT_V4:
+        # v3 wire drops v5-only sub opts
+        assert [f for f, _ in out.filters] == ["a/+", "b/#"]
+        assert out.filters[0][1].qos == 1 and out.filters[1][1].qos == 2
+    else:
+        assert out == s
+    u = Unsubscribe(6, ["a/+", "b/#"])
+    assert roundtrip(u, ver).filters == ["a/+", "b/#"]
+
+
+@pytest.mark.parametrize("ver", [MQTT_V4, MQTT_V5])
+def test_roundtrip_acks(ver):
+    for t in (Type.PUBACK, Type.PUBREC, Type.PUBREL, Type.PUBCOMP):
+        pkt = Puback(t, 42, code=0x10 if ver == MQTT_V5 else 0)
+        out = roundtrip(pkt, ver)
+        assert out.type == t and out.packet_id == 42
+        if ver == MQTT_V5:
+            assert out.code == 0x10
+    assert roundtrip(Suback(7, [0, 1, 0x80]), ver).codes == [0, 1, 0x80]
+    ua = roundtrip(Unsuback(8, codes=[0, 0x11] if ver == MQTT_V5 else []), ver)
+    assert ua.packet_id == 8
+
+
+@pytest.mark.parametrize("ver", [MQTT_V4, MQTT_V5])
+def test_roundtrip_misc(ver):
+    assert isinstance(roundtrip(Pingreq(), ver), Pingreq)
+    assert isinstance(roundtrip(Pingresp(), ver), Pingresp)
+    assert isinstance(roundtrip(Connack(True, 0), ver), Connack)
+    d = roundtrip(Disconnect(code=0x8E if ver == MQTT_V5 else 0), ver)
+    assert isinstance(d, Disconnect)
+    if ver == MQTT_V5:
+        assert d.code == 0x8E
+        a = roundtrip(Auth(code=0x18, props={"authentication_method": "m"}), ver)
+        assert a.code == 0x18
+
+
+def test_incremental_feed():
+    pkts = [
+        Publish(topic="t/%d" % i, payload=b"x" * i, qos=0) for i in range(20)
+    ]
+    raw = b"".join(F.serialize(p, MQTT_V4) for p in pkts)
+    rng = random.Random(3)
+    p = F.Parser(proto_ver=MQTT_V4)
+    got = []
+    i = 0
+    while i < len(raw):
+        n = rng.randint(1, 7)
+        got += p.feed(raw[i : i + n])
+        i += n
+    assert got == pkts
+
+
+def test_connect_latches_version():
+    p = F.Parser()
+    c = Connect(proto_ver=MQTT_V5, client_id="c")
+    [out] = p.feed(F.serialize(c, MQTT_V5))
+    assert out.proto_ver == MQTT_V5
+    assert p.proto_ver == MQTT_V5
+    # subsequent v5 publish with props decodes
+    pub = Publish(topic="t", payload=b"", qos=0, props={"topic_alias": 3})
+    [out2] = p.feed(F.serialize(pub, MQTT_V5))
+    assert out2.props["topic_alias"] == 3
+
+
+def test_malformed():
+    p = F.Parser(proto_ver=MQTT_V4)
+    with pytest.raises(F.FrameError):
+        p.feed(bytes([0x00, 0x00]))  # type 0 invalid
+    p = F.Parser(proto_ver=MQTT_V4)
+    with pytest.raises(F.FrameError):
+        # SUBSCRIBE with wrong fixed flags
+        p.feed(bytes([0x80, 0x02, 0x00, 0x01]))
+    p = F.Parser(proto_ver=MQTT_V4, max_packet_size=16)
+    with pytest.raises(F.FrameError):
+        p.feed(F.serialize(Publish(topic="t", payload=b"z" * 64), MQTT_V4))
+    p = F.Parser()
+    with pytest.raises(F.FrameError):
+        bad = F.serialize(Connect(proto_name="MQTT", proto_ver=9), MQTT_V4)
+        p.feed(bad)
+    p = F.Parser(proto_ver=MQTT_V4)
+    with pytest.raises(F.FrameError):
+        p.feed(bytes([0x30, 0x03, 0x00, 0x05, 0x61]))  # topic len 5, 1 byte
+
+
+def test_publish_invalid_qos3():
+    p = F.Parser(proto_ver=MQTT_V4)
+    with pytest.raises(F.FrameError):
+        p.feed(bytes([0x36, 0x05, 0x00, 0x01, 0x61, 0x00, 0x01]))
+
+
+def test_random_roundtrip_fuzz():
+    rng = random.Random(11)
+    for _ in range(200):
+        ver = rng.choice([MQTT_V4, MQTT_V5])
+        topic = "/".join(
+            "".join(rng.choice("abcd") for _ in range(rng.randint(1, 3)))
+            for _ in range(rng.randint(1, 4))
+        )
+        qos = rng.randint(0, 2)
+        pkt = Publish(
+            topic=topic,
+            payload=bytes(rng.randrange(256) for _ in range(rng.randint(0, 40))),
+            qos=qos,
+            packet_id=rng.randint(1, 0xFFFF) if qos else None,
+            retain=rng.random() < 0.5,
+            dup=qos > 0 and rng.random() < 0.5,
+        )
+        assert roundtrip(pkt, ver) == pkt

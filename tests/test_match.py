@@ -133,6 +133,37 @@ def test_growth():
     assert len(table) == 0
 
 
+def test_match_ids_compaction():
+    rng = random.Random(9)
+    table = FilterTable(max_levels=6, capacity=1024)
+    for _ in range(300):
+        table.add(random_filter(rng))
+    topics = [random_topic(rng) for _ in range(40)]
+    enc_t = M.encode_topics(table.vocab, topics, table.max_levels)
+    filters = table.snapshot()
+    expected = M.oracle_match_rows(table, topics)
+    ti, ri, total = (np.asarray(a) for a in M.match_ids(filters, enc_t, max_hits=4096, chunk=256))
+    assert total == sum(len(e) for e in expected)
+    got = [[] for _ in topics]
+    for t_idx, row in zip(ti[:total], ri[:total]):
+        got[t_idx].append(row)
+    for i in range(len(topics)):
+        assert sorted(got[i]) == list(expected[i]), topics[i]
+    # overflow detection: tiny bound
+    _, _, total2 = M.match_ids(filters, enc_t, max_hits=32, chunk=256)
+    if sum(len(e) for e in expected) > 32:
+        assert int(total2) > 32
+
+
+def test_match_ids_overflow_bound():
+    table = FilterTable(max_levels=4, capacity=1024)
+    for _ in range(100):
+        table.add("#")  # every topic matches all 100
+    enc_t = M.encode_topics(table.vocab, ["a"] * 8, table.max_levels)
+    ti, ri, total = M.match_ids(table.snapshot(), enc_t, max_hits=64, chunk=256)
+    assert int(total) == 800 > 64  # overflow signalled, caller falls back
+
+
 def test_packed_equals_dense_large():
     rng = random.Random(1)
     table = FilterTable(max_levels=6, capacity=2048)
