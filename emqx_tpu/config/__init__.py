@@ -1,0 +1,18 @@
+from .hocon import loads as hocon_loads
+from .schema import (
+    Array,
+    Bool,
+    Bytesize,
+    Duration,
+    Enum,
+    Field,
+    Float,
+    Int,
+    Map,
+    SchemaError,
+    String,
+    Struct,
+    Union,
+)
+from .config import Config, ConfigHandler, UpdateError
+from .default_schema import broker_schema

@@ -1,0 +1,364 @@
+"""Root config schema for the broker — emqx_schema.erl analog.
+
+Mirrors the reference's root set (apps/emqx/src/emqx_schema.erl roots()
+:204 and emqx_conf_schema node/cluster roots) at the granularity the
+runtime actually reads; zones overlay the `mqtt` root per
+emqx_zone_schema.
+"""
+
+from __future__ import annotations
+
+from .schema import (
+    Array,
+    Bool,
+    Bytesize,
+    Duration,
+    Enum,
+    Field,
+    Float,
+    Int,
+    Map,
+    String,
+    Struct,
+    Union,
+)
+
+
+def mqtt_struct(sparse: bool = False) -> Struct:
+    """The zone-overridable MQTT behavior root (emqx_schema `mqtt`).
+    `sparse=True` builds the zone-overlay variant: same fields, no
+    default filling (absence = inherit global)."""
+    return Struct(
+        sparse=sparse,
+        fields={
+            "max_packet_size": Field(Bytesize(), default=1 << 20),
+            "max_clientid_len": Field(Int(min=23, max=65535), default=65535),
+            "max_topic_levels": Field(Int(min=1, max=65535), default=128),
+            "max_topic_alias": Field(Int(min=0, max=65535), default=65535),
+            "max_qos_allowed": Field(Int(min=0, max=2), default=2),
+            "retain_available": Field(Bool(), default=True),
+            "wildcard_subscription": Field(Bool(), default=True),
+            "shared_subscription": Field(Bool(), default=True),
+            "exclusive_subscription": Field(Bool(), default=False),
+            "ignore_loop_deliver": Field(Bool(), default=False),
+            "keepalive_multiplier": Field(Float(), default=1.5),
+            "max_inflight": Field(Int(min=1, max=65535), default=32),
+            "max_awaiting_rel": Field(Int(min=0), default=100),
+            "await_rel_timeout": Field(Duration(), default=300_000),
+            "max_mqueue_len": Field(Int(min=0), default=1000),
+            "mqueue_priorities": Field(Map(Int(min=1, max=255)), default=None),
+            "mqueue_default_priority": Field(
+                Enum("highest", "lowest"), default="lowest"
+            ),
+            "mqueue_store_qos0": Field(Bool(), default=True),
+            "upgrade_qos": Field(Bool(), default=False),
+            "session_expiry_interval": Field(Duration(), default=7_200_000),
+            "message_expiry_interval": Field(Duration(), default=float("inf")),
+            "server_keepalive": Field(Int(min=1), default=None),
+            "idle_timeout": Field(Duration(), default=15_000),
+            "retry_interval": Field(Duration(), default=30_000),
+            "use_username_as_clientid": Field(Bool(), default=False),
+            "peer_cert_as_clientid": Field(Bool(), default=False),
+        }
+    )
+
+
+def listener_struct() -> Struct:
+    return Struct(
+        {
+            "enable": Field(Bool(), default=True),
+            "bind": Field(String(), default="0.0.0.0:1883"),
+            "max_connections": Field(
+                Union(Int(min=1), Enum("infinity")), default="infinity"
+            ),
+            "max_conn_rate": Field(Int(min=1), default=None),
+            "mountpoint": Field(String(), default=""),
+            "zone": Field(String(), default="default"),
+            "acceptors": Field(Int(min=1), default=16),
+            "proxy_protocol": Field(Bool(), default=False),
+            "tcp_backlog": Field(Int(min=1), default=1024),
+            "ssl_certfile": Field(String(), default=None),
+            "ssl_keyfile": Field(String(), default=None),
+            "ssl_cacertfile": Field(String(), default=None),
+            "ssl_verify": Field(Enum("verify_none", "verify_peer"), default="verify_none"),
+        }
+    )
+
+
+def limiter_bucket() -> Struct:
+    return Struct(
+        {
+            "rate": Field(Union(Float(), Enum("infinity")), default="infinity"),
+            "burst": Field(Union(Float(), Enum("infinity")), default=0),
+        }
+    )
+
+
+def broker_schema() -> Struct:
+    """Root schema: the full checked document."""
+    return Struct(
+        {
+            "node": Field(
+                Struct(
+                    {
+                        "name": Field(String(), default="emqx@127.0.0.1"),
+                        "cookie": Field(String(), default="emqxsecretcookie"),
+                        "data_dir": Field(String(), default="data"),
+                        "broker_pool_size": Field(Int(min=1), default=16),
+                        "process_limit": Field(Int(min=1), default=2_097_152),
+                        "max_ports": Field(Int(min=1), default=1_048_576),
+                        "role": Field(Enum("core", "replicant"), default="core"),
+                    }
+                )
+            ),
+            "cluster": Field(
+                Struct(
+                    {
+                        "name": Field(String(), default="emqxcl"),
+                        "discovery_strategy": Field(
+                            Enum("manual", "static", "dns"), default="manual"
+                        ),
+                        "static_seeds": Field(Array(String()), default=[]),
+                        "autoheal": Field(Bool(), default=True),
+                        "autoclean": Field(Duration(), default=86_400_000),
+                    }
+                )
+            ),
+            "mqtt": Field(mqtt_struct()),
+            "zones": Field(Map(mqtt_struct(sparse=True)), default={}),
+            "listeners": Field(
+                Struct(
+                    {
+                        "tcp": Field(Map(listener_struct()), default={}),
+                        "ssl": Field(Map(listener_struct()), default={}),
+                        "ws": Field(Map(listener_struct()), default={}),
+                        "wss": Field(Map(listener_struct()), default={}),
+                    }
+                )
+            ),
+            "broker": Field(
+                Struct(
+                    {
+                        "enable_session_registry": Field(Bool(), default=True),
+                        "session_locking_strategy": Field(
+                            Enum("local", "leader", "quorum", "all"), default="quorum"
+                        ),
+                        "shared_subscription_strategy": Field(
+                            Enum(
+                                "random",
+                                "round_robin",
+                                "round_robin_per_group",
+                                "sticky",
+                                "local",
+                                "hash_clientid",
+                                "hash_topic",
+                            ),
+                            default="round_robin",
+                        ),
+                        "shared_dispatch_ack_enabled": Field(Bool(), default=False),
+                        "perf": Field(
+                            Struct(
+                                {
+                                    # routing schema choice (emqx_router v1/v2)
+                                    "routing_schema": Field(
+                                        Enum("v1", "v2"), default="v2"
+                                    ),
+                                    "trie_compaction": Field(Bool(), default=True),
+                                    # TPU offload knobs (ours)
+                                    "tpu_match_enable": Field(Bool(), default=True),
+                                    "tpu_batch_window_ms": Field(Duration(), default=1),
+                                    "tpu_min_batch": Field(Int(min=1), default=64),
+                                }
+                            )
+                        ),
+                        "routing": Field(
+                            Struct(
+                                {
+                                    "batch_sync": Field(
+                                        Struct(
+                                            {
+                                                "enable_on": Field(
+                                                    Enum("none", "core", "replicant", "both"),
+                                                    default="both",
+                                                ),
+                                                "max_batch_size": Field(
+                                                    Int(min=1), default=1000
+                                                ),
+                                            }
+                                        )
+                                    ),
+                                }
+                            )
+                        ),
+                    }
+                )
+            ),
+            "force_shutdown": Field(
+                Struct(
+                    {
+                        "enable": Field(Bool(), default=True),
+                        "max_mailbox_size": Field(Int(min=0), default=1000),
+                        "max_heap_size": Field(Bytesize(), default=32 << 20),
+                    }
+                )
+            ),
+            "force_gc": Field(
+                Struct(
+                    {
+                        "enable": Field(Bool(), default=True),
+                        "count": Field(Int(min=0), default=16000),
+                        "bytes": Field(Bytesize(), default=16 << 20),
+                    }
+                )
+            ),
+            "flapping_detect": Field(
+                Struct(
+                    {
+                        "enable": Field(Bool(), default=False),
+                        "max_count": Field(Int(min=1), default=15),
+                        "window_time": Field(Duration(), default=60_000),
+                        "ban_time": Field(Duration(), default=300_000),
+                    }
+                )
+            ),
+            "limiter": Field(
+                Struct(
+                    {
+                        "max_conn_rate": Field(
+                            Union(Float(), Enum("infinity")), default="infinity"
+                        ),
+                        "messages_rate": Field(
+                            Union(Float(), Enum("infinity")), default="infinity"
+                        ),
+                        "bytes_rate": Field(
+                            Union(Float(), Enum("infinity")), default="infinity"
+                        ),
+                        "client": Field(Map(limiter_bucket()), default={}),
+                    }
+                )
+            ),
+            "authentication": Field(Array(Struct({}, open=True)), default=[]),
+            "authorization": Field(
+                Struct(
+                    {
+                        "no_match": Field(Enum("allow", "deny"), default="allow"),
+                        "deny_action": Field(
+                            Enum("ignore", "disconnect"), default="ignore"
+                        ),
+                        "cache": Field(
+                            Struct(
+                                {
+                                    "enable": Field(Bool(), default=True),
+                                    "max_size": Field(Int(min=1), default=32),
+                                    "ttl": Field(Duration(), default=60_000),
+                                }
+                            )
+                        ),
+                        "sources": Field(Array(Struct({}, open=True)), default=[]),
+                    }
+                )
+            ),
+            "retainer": Field(
+                Struct(
+                    {
+                        "enable": Field(Bool(), default=True),
+                        "msg_expiry_interval": Field(Duration(), default=0),
+                        "max_payload_size": Field(Bytesize(), default=1 << 20),
+                        "max_retained_messages": Field(Int(min=0), default=0),
+                        "delivery_rate": Field(
+                            Union(Float(), Enum("infinity")), default="infinity"
+                        ),
+                    }
+                )
+            ),
+            "delayed": Field(
+                Struct(
+                    {
+                        "enable": Field(Bool(), default=True),
+                        "max_delayed_messages": Field(Int(min=0), default=0),
+                    }
+                )
+            ),
+            "rewrite": Field(Array(Struct({}, open=True)), default=[]),
+            "auto_subscribe": Field(
+                Struct({"topics": Field(Array(Struct({}, open=True)), default=[])})
+            ),
+            "rule_engine": Field(
+                Struct(
+                    {
+                        "ignore_sys_message": Field(Bool(), default=True),
+                        "jq_function_default_timeout": Field(Duration(), default=10_000),
+                        "rules": Field(Map(Struct({}, open=True)), default={}),
+                    }
+                )
+            ),
+            "durable_sessions": Field(
+                Struct(
+                    {
+                        "enable": Field(Bool(), default=False),
+                        "batch_size": Field(Int(min=1), default=100),
+                        "idle_poll_interval": Field(Duration(), default=100),
+                        "heartbeat_interval": Field(Duration(), default=5000),
+                        "session_gc_interval": Field(Duration(), default=600_000),
+                    }
+                )
+            ),
+            "durable_storage": Field(
+                Struct(
+                    {
+                        "messages": Field(
+                            Struct(
+                                {
+                                    "backend": Field(
+                                        Enum("builtin_local", "builtin_raft"),
+                                        default="builtin_local",
+                                    ),
+                                    "n_shards": Field(Int(min=1), default=4),
+                                    "replication_factor": Field(Int(min=1), default=3),
+                                    "data_dir": Field(String(), default=None),
+                                }
+                            )
+                        ),
+                    }
+                )
+            ),
+            "sys_topics": Field(
+                Struct(
+                    {
+                        "sys_msg_interval": Field(Duration(), default=60_000),
+                        "sys_heartbeat_interval": Field(Duration(), default=30_000),
+                    }
+                )
+            ),
+            "log": Field(
+                Struct(
+                    {
+                        "level": Field(
+                            Enum("debug", "info", "notice", "warning", "error"),
+                            default="warning",
+                        ),
+                        "to": Field(Enum("console", "file", "both"), default="console"),
+                        "file": Field(String(), default="log/emqx.log"),
+                    }
+                )
+            ),
+            "prometheus": Field(
+                Struct(
+                    {
+                        "enable": Field(Bool(), default=False),
+                        "port": Field(Int(min=1, max=65535), default=9100),
+                    }
+                )
+            ),
+            "telemetry": Field(Struct({"enable": Field(Bool(), default=False)})),
+            "api": Field(
+                Struct(
+                    {
+                        "enable": Field(Bool(), default=True),
+                        "bind": Field(String(), default="0.0.0.0:18083"),
+                        "api_keys": Field(Array(Struct({}, open=True)), default=[]),
+                    }
+                )
+            ),
+        }
+    )

@@ -1,0 +1,142 @@
+"""Config system tests: HOCON parse, schema check, zones, handlers."""
+
+import pytest
+
+from emqx_tpu.config import (
+    Config,
+    ConfigHandler,
+    SchemaError,
+    UpdateError,
+    broker_schema,
+    hocon_loads,
+)
+from emqx_tpu.config.schema import Bytesize, Duration
+
+
+class TestHocon:
+    def test_basic_object(self):
+        assert hocon_loads("a = 1\nb = true\nc = hello") == {
+            "a": 1,
+            "b": True,
+            "c": "hello",
+        }
+
+    def test_dotted_paths_merge(self):
+        doc = hocon_loads("a.b.c = 1\na.b.d = 2\na { b { e = 3 } }")
+        assert doc == {"a": {"b": {"c": 1, "d": 2, "e": 3}}}
+
+    def test_nested_and_arrays(self):
+        doc = hocon_loads(
+            """
+            listeners.tcp.default {
+              bind = "0.0.0.0:1883"
+              max_connections = 1024000
+            }
+            seeds = ["a@h1", "b@h2"]
+            nums = [1, 2, 3]
+            """
+        )
+        assert doc["listeners"]["tcp"]["default"]["bind"] == "0.0.0.0:1883"
+        assert doc["seeds"] == ["a@h1", "b@h2"]
+        assert doc["nums"] == [1, 2, 3]
+
+    def test_comments_and_unquoted(self):
+        doc = hocon_loads(
+            """
+            # comment
+            interval = 15s   // trailing
+            size = 100MB
+            name = emqx@127.0.0.1
+            """
+        )
+        assert doc == {"interval": "15s", "size": "100MB", "name": "emqx@127.0.0.1"}
+
+    def test_substitution(self):
+        doc = hocon_loads('base = "x"\nref = ${base}\nopt = ${?NOPE_NOT_SET}')
+        assert doc["ref"] == "x"
+        assert "opt" not in doc
+
+    def test_append(self):
+        doc = hocon_loads("xs = [1]\nxs += 2")
+        assert doc["xs"] == [1, 2]
+
+    def test_triple_quoted(self):
+        doc = hocon_loads('sql = """SELECT * FROM "t/#" WHERE x = 1"""')
+        assert doc["sql"] == 'SELECT * FROM "t/#" WHERE x = 1'
+
+
+class TestSchemaTypes:
+    def test_duration(self):
+        d = Duration()
+        assert d.check("p", "15s") == 15_000
+        assert d.check("p", "1h30m") == 5_400_000
+        assert d.check("p", "100ms") == 100
+        assert d.check("p", 42) == 42
+        with pytest.raises(SchemaError):
+            d.check("p", "nope")
+
+    def test_bytesize(self):
+        b = Bytesize()
+        assert b.check("p", "100MB") == 100 << 20
+        assert b.check("p", "512KB") == 512 << 10
+        assert b.check("p", "1gb") == 1 << 30
+        assert b.check("p", 7) == 7
+
+
+class TestConfig:
+    def test_defaults_fill(self):
+        cfg = Config(broker_schema())
+        assert cfg.get("mqtt.max_inflight") == 32
+        assert cfg.get("mqtt.session_expiry_interval") == 7_200_000
+        assert cfg.get("broker.perf.routing_schema") == "v2"
+
+    def test_load_and_zone_overlay(self):
+        cfg = Config.load(
+            broker_schema(),
+            text="""
+            mqtt.max_inflight = 64
+            zones.iot.max_inflight = 8
+            zones.iot.max_mqueue_len = 10
+            """,
+        )
+        assert cfg.get("mqtt.max_inflight") == 64
+        # zone overlay reads relative to the mqtt root
+        assert cfg.get_zone("iot", "max_inflight") == 8
+        assert cfg.get_zone("iot", "max_mqueue_len") == 10
+        # zone without an override falls back to the global mqtt value
+        assert cfg.get_zone("other", "max_inflight") == 64
+        assert cfg.get_zone(None, "max_inflight") == 64
+
+    def test_unknown_field_rejected(self):
+        with pytest.raises(SchemaError):
+            Config.load(broker_schema(), text="mqtt.not_a_field = 1")
+
+    def test_update_with_handler(self):
+        cfg = Config(broker_schema())
+        seen = {}
+
+        def pre(v):
+            if v > 1000:
+                raise ValueError("too big")
+            return v
+
+        def post(old, new):
+            seen["old"], seen["new"] = old, new
+
+        cfg.add_handler("mqtt.max_inflight", ConfigHandler(pre=pre, post=post))
+        cfg.update("mqtt.max_inflight", 100)
+        assert cfg.get("mqtt.max_inflight") == 100
+        assert seen == {"old": 32, "new": 100}
+        with pytest.raises(UpdateError):
+            cfg.update("mqtt.max_inflight", 2000)
+        # schema violation also rejected
+        with pytest.raises(UpdateError):
+            cfg.update("mqtt.max_qos_allowed", 9)
+
+    def test_override_roundtrip(self):
+        cfg = Config(broker_schema())
+        cfg.update("mqtt.max_inflight", 77)
+        dump = cfg.dump_overrides()
+        cfg2 = Config(broker_schema())
+        cfg2.load_overrides(dump)
+        assert cfg2.get("mqtt.max_inflight") == 77
