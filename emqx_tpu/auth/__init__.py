@@ -1,0 +1,23 @@
+from .authn import (
+    GLOBAL_CHAIN,
+    AuthnChains,
+    AuthResult,
+    BuiltinDbProvider,
+    Credentials,
+    FixedUserProvider,
+    JwtProvider,
+    make_jwt,
+)
+from .authz import (
+    ALLOW,
+    DENY,
+    NOMATCH,
+    AclRule,
+    Authz,
+    AuthzCache,
+    BuiltinAclSource,
+    FileAclSource,
+)
+from .banned import Banned, BanEntry
+from .bridge import AuthPipeline
+from .flapping import FlappingDetector

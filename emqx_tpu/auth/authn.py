@@ -1,0 +1,240 @@
+"""Authentication chains — the emqx_auth authn framework analog.
+
+Mirrors emqx_authn_chains (apps/emqx_auth/src/emqx_authn/
+emqx_authn_chains.erl:17-60): named chains (one per listener, plus the
+'mqtt:global' default) hold ordered authenticator instances, each
+backed by a provider. `authenticate` walks the chain: a provider
+returns ok / {error,...} / ignore (try next). The channel invokes this
+via the 'client.authenticate' hook (emqx_channel.erl:2080).
+
+Providers implemented natively:
+  * built_in_db — username/clientid + salted pbkdf2/sha256 password
+    store (emqx_auth_mnesia analog)
+  * jwt          — HMAC-SHA256 JWT verification with claim checks
+    (emqx_auth_jwt analog; hmac from stdlib, no external deps)
+  * fixed_users  — static user map (file-auth analog, for tests/dev)
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+GLOBAL_CHAIN = "mqtt:global"
+
+
+@dataclass
+class Credentials:
+    client_id: str
+    username: Optional[str] = None
+    password: Optional[bytes] = None
+    peerhost: str = ""
+    cert_cn: Optional[str] = None
+
+
+@dataclass
+class AuthResult:
+    ok: bool
+    reason: str = ""
+    superuser: bool = False
+    # attrs the provider attaches (acl claims, expire_at, ...)
+    attrs: Dict[str, Any] = field(default_factory=dict)
+
+
+IGNORE = object()  # provider verdict: not my user — next in chain
+
+
+class Provider:
+    """Authenticator provider behaviour (emqx_authn_provider)."""
+
+    def authenticate(self, creds: Credentials):
+        """Return AuthResult or IGNORE."""
+        raise NotImplementedError
+
+    def destroy(self) -> None:
+        pass
+
+
+class FixedUserProvider(Provider):
+    def __init__(self, users: Dict[str, str], superusers: Tuple[str, ...] = ()):
+        self.users = users
+        self.superusers = set(superusers)
+
+    def authenticate(self, creds: Credentials):
+        if creds.username not in self.users:
+            return IGNORE
+        pw = (creds.password or b"").decode("utf-8", "replace")
+        if self.users[creds.username] == pw:
+            return AuthResult(True, superuser=creds.username in self.superusers)
+        return AuthResult(False, "bad_username_or_password")
+
+
+class BuiltinDbProvider(Provider):
+    """Salted-hash user store (emqx_auth_mnesia analog). Lookup by
+    username or clientid per `user_id_type`."""
+
+    def __init__(self, user_id_type: str = "username", algorithm: str = "pbkdf2"):
+        assert user_id_type in ("username", "clientid")
+        assert algorithm in ("pbkdf2", "sha256")
+        self.user_id_type = user_id_type
+        self.algorithm = algorithm
+        self._users: Dict[str, Tuple[bytes, bytes, bool]] = {}  # id -> (salt, hash, su)
+
+    def _hash(self, password: bytes, salt: bytes) -> bytes:
+        if self.algorithm == "pbkdf2":
+            return hashlib.pbkdf2_hmac("sha256", password, salt, 1000)
+        return hashlib.sha256(salt + password).digest()
+
+    def add_user(self, user_id: str, password: str, superuser: bool = False) -> None:
+        salt = os.urandom(16)
+        self._users[user_id] = (salt, self._hash(password.encode(), salt), superuser)
+
+    def delete_user(self, user_id: str) -> bool:
+        return self._users.pop(user_id, None) is not None
+
+    def list_users(self) -> List[str]:
+        return sorted(self._users)
+
+    def authenticate(self, creds: Credentials):
+        uid = creds.username if self.user_id_type == "username" else creds.client_id
+        rec = self._users.get(uid or "")
+        if rec is None:
+            return IGNORE
+        salt, digest, superuser = rec
+        if hmac.compare_digest(self._hash(creds.password or b"", salt), digest):
+            return AuthResult(True, superuser=superuser)
+        return AuthResult(False, "bad_username_or_password")
+
+
+def _b64url_decode(s: str) -> bytes:
+    return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+
+def _b64url_encode(b: bytes) -> str:
+    return base64.urlsafe_b64encode(b).rstrip(b"=").decode()
+
+
+def make_jwt(claims: Dict[str, Any], secret: bytes, alg: str = "HS256") -> str:
+    """Test/dev helper: mint an HS256 JWT."""
+    header = _b64url_encode(json.dumps({"alg": alg, "typ": "JWT"}).encode())
+    body = _b64url_encode(json.dumps(claims).encode())
+    signing = f"{header}.{body}".encode()
+    sig = _b64url_encode(hmac.new(secret, signing, hashlib.sha256).digest())
+    return f"{header}.{body}.{sig}"
+
+
+class JwtProvider(Provider):
+    """HS256 JWT authn (emqx_auth_jwt analog): password carries the
+    token; claims checked: exp, optional acl (list of {permission,
+    action, topic}), optional verify_claims equality (supports
+    ${clientid}/${username} placeholders)."""
+
+    def __init__(
+        self,
+        secret: bytes,
+        verify_claims: Optional[Dict[str, str]] = None,
+        acl_claim_name: str = "acl",
+    ):
+        self.secret = secret
+        self.verify_claims = verify_claims or {}
+        self.acl_claim_name = acl_claim_name
+
+    def authenticate(self, creds: Credentials):
+        token = (creds.password or b"").decode("utf-8", "replace")
+        if token.count(".") != 2:
+            return IGNORE
+        header_b64, body_b64, sig_b64 = token.split(".")
+        try:
+            header = json.loads(_b64url_decode(header_b64))
+            claims = json.loads(_b64url_decode(body_b64))
+            sig = _b64url_decode(sig_b64)
+        except Exception:
+            return AuthResult(False, "bad_token")
+        if header.get("alg") != "HS256":
+            return AuthResult(False, "unsupported_alg")
+        expect = hmac.new(
+            self.secret, f"{header_b64}.{body_b64}".encode(), hashlib.sha256
+        ).digest()
+        if not hmac.compare_digest(sig, expect):
+            return AuthResult(False, "bad_signature")
+        exp = claims.get("exp")
+        if exp is not None and time.time() > float(exp):
+            return AuthResult(False, "token_expired")
+        for name, want in self.verify_claims.items():
+            want = want.replace("${clientid}", creds.client_id).replace(
+                "${username}", creds.username or ""
+            )
+            if str(claims.get(name)) != want:
+                return AuthResult(False, f"claim_mismatch:{name}")
+        attrs: Dict[str, Any] = {}
+        if self.acl_claim_name in claims:
+            attrs["acl"] = claims[self.acl_claim_name]
+        if exp is not None:
+            attrs["expire_at"] = float(exp)
+        return AuthResult(True, superuser=bool(claims.get("superuser")), attrs=attrs)
+
+
+@dataclass
+class Authenticator:
+    id: str
+    provider: Provider
+    enable: bool = True
+
+
+class AuthnChains:
+    """Named chains of authenticators; empty config = allow all
+    (anonymous), matching the reference default."""
+
+    def __init__(self) -> None:
+        self._chains: Dict[str, List[Authenticator]] = {}
+
+    def create_authenticator(
+        self, chain: str, auth_id: str, provider: Provider, position: Optional[int] = None
+    ) -> None:
+        lst = self._chains.setdefault(chain, [])
+        if any(a.id == auth_id for a in lst):
+            raise ValueError(f"duplicate authenticator {auth_id!r}")
+        a = Authenticator(auth_id, provider)
+        lst.insert(position if position is not None else len(lst), a)
+
+    def delete_authenticator(self, chain: str, auth_id: str) -> None:
+        lst = self._chains.get(chain, [])
+        for a in lst:
+            if a.id == auth_id:
+                a.provider.destroy()
+        self._chains[chain] = [a for a in lst if a.id != auth_id]
+
+    def set_enable(self, chain: str, auth_id: str, enable: bool) -> None:
+        for a in self._chains.get(chain, []):
+            if a.id == auth_id:
+                a.enable = enable
+
+    def list_authenticators(self, chain: str) -> List[str]:
+        return [a.id for a in self._chains.get(chain, [])]
+
+    def authenticate(self, creds: Credentials, listener: Optional[str] = None) -> AuthResult:
+        """Listener chain if it exists, else the global chain
+        (emqx_authn_chains listener→global fallback). Empty/absent
+        chain ⇒ anonymous allow."""
+        chain = None
+        if listener is not None and self._chains.get(listener):
+            chain = self._chains[listener]
+        elif self._chains.get(GLOBAL_CHAIN):
+            chain = self._chains[GLOBAL_CHAIN]
+        if not chain:
+            return AuthResult(True, "anonymous")
+        last = AuthResult(False, "no_authn_provider")
+        for a in chain:
+            if not a.enable:
+                continue
+            r = a.provider.authenticate(creds)
+            if r is IGNORE:
+                continue
+            return r
+        return last

@@ -1,0 +1,195 @@
+"""Authorization — emqx_authz source-chain analog.
+
+Mirrors apps/emqx_auth/src/emqx_authz/emqx_authz.erl:93,148-155: an
+ordered chain of ACL sources evaluated per (client, action, topic);
+each source answers allow / deny / nomatch (try next); the configured
+`no_match` default applies when the chain is exhausted. Per-client
+results go through a small TTL'd LRU cache (emqx_authz_cache analog).
+Client-attached ACLs (the JWT `acl` claim) are checked before the
+chain, like the reference's client-info authz.
+
+Topic placeholders: ${clientid}, ${username} (emqx_authz_rule
+placeholder substitution).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..ops import topic as topic_mod
+
+ALLOW, DENY, NOMATCH = "allow", "deny", "nomatch"
+PUBLISH, SUBSCRIBE = "publish", "subscribe"
+
+
+@dataclass(frozen=True)
+class AclRule:
+    permission: str  # allow | deny
+    action: str      # publish | subscribe | all
+    topic: str       # filter, may contain placeholders; 'eq ' prefix = literal
+    who: Optional[Tuple[str, str]] = None  # ("username"|"clientid"|"ipaddr", value)
+
+
+def _fill(t: str, client_id: str, username: str) -> str:
+    return t.replace("${clientid}", client_id).replace("${username}", username or "")
+
+
+def _rule_topic_match(rule_topic: str, topic: str, client_id: str, username: str) -> bool:
+    rt = _fill(rule_topic, client_id, username)
+    if rt.startswith("eq "):
+        return rt[3:] == topic
+    return topic_mod.match(topic_mod.words(topic), topic_mod.words(rt))
+
+
+def _match_rule(
+    rule: AclRule, client_id: str, username: str, peerhost: str, action: str, topic: str
+) -> bool:
+    if rule.action not in (action, "all"):
+        return False
+    if rule.who is not None:
+        kind, val = rule.who
+        got = {"username": username, "clientid": client_id, "ipaddr": peerhost}.get(kind)
+        if got != val:
+            return False
+    return _rule_topic_match(rule.topic, topic, client_id, username)
+
+
+class Source:
+    """Authz source behaviour: authorize -> allow|deny|nomatch."""
+
+    def authorize(self, client_id, username, peerhost, action, topic) -> str:
+        raise NotImplementedError
+
+
+class BuiltinAclSource(Source):
+    """Rule-table source (emqx_authz_mnesia analog): per-user rules +
+    an `all` bucket."""
+
+    def __init__(self) -> None:
+        self._by_user: Dict[Tuple[str, str], List[AclRule]] = {}
+        self._all: List[AclRule] = []
+
+    def set_rules(self, who: Optional[Tuple[str, str]], rules: Sequence[AclRule]) -> None:
+        if who is None:
+            self._all = list(rules)
+        else:
+            self._by_user[who] = list(rules)
+
+    def authorize(self, client_id, username, peerhost, action, topic) -> str:
+        for key in ((("username", username or "")), (("clientid", client_id))):
+            for rule in self._by_user.get(key, ()):
+                if _match_rule(rule, client_id, username, peerhost, action, topic):
+                    return rule.permission
+        for rule in self._all:
+            if _match_rule(rule, client_id, username, peerhost, action, topic):
+                return rule.permission
+        return NOMATCH
+
+
+class FileAclSource(Source):
+    """Static rule-list source (acl.conf analog)."""
+
+    def __init__(self, rules: Sequence[AclRule]):
+        self.rules = list(rules)
+
+    def authorize(self, client_id, username, peerhost, action, topic) -> str:
+        for rule in self.rules:
+            if _match_rule(rule, client_id, username, peerhost, action, topic):
+                return rule.permission
+        return NOMATCH
+
+
+class AuthzCache:
+    """Per-connection LRU+TTL verdict cache (emqx_authz_cache)."""
+
+    def __init__(self, max_size: int = 32, ttl_ms: int = 60_000):
+        self.max_size = max_size
+        self.ttl_ms = ttl_ms
+        self._cache: "OrderedDict[Tuple[str,str], Tuple[str,float]]" = OrderedDict()
+
+    def get(self, action: str, topic: str) -> Optional[str]:
+        k = (action, topic)
+        hit = self._cache.get(k)
+        if hit is None:
+            return None
+        verdict, at = hit
+        if (time.monotonic() - at) * 1000 > self.ttl_ms:
+            del self._cache[k]
+            return None
+        self._cache.move_to_end(k)
+        return verdict
+
+    def put(self, action: str, topic: str, verdict: str) -> None:
+        self._cache[(action, topic)] = (verdict, time.monotonic())
+        self._cache.move_to_end((action, topic))
+        while len(self._cache) > self.max_size:
+            self._cache.popitem(last=False)
+
+    def drain(self) -> None:
+        self._cache.clear()
+
+
+class Authz:
+    def __init__(self, no_match: str = ALLOW, sources: Optional[List[Source]] = None):
+        assert no_match in (ALLOW, DENY)
+        self.no_match = no_match
+        self.sources = sources or []
+
+    def add_source(self, source: Source, front: bool = False) -> None:
+        if front:
+            self.sources.insert(0, source)
+        else:
+            self.sources.append(source)
+
+    def authorize(
+        self,
+        client_id: str,
+        username: Optional[str],
+        peerhost: str,
+        action: str,
+        topic: str,
+        superuser: bool = False,
+        client_acl: Optional[Sequence[Any]] = None,
+        cache: Optional[AuthzCache] = None,
+    ) -> bool:
+        """Full authorize walk. `client_acl` is the authn-attached rule
+        list (JWT acl claim), checked before sources."""
+        if superuser:
+            return True
+        if cache is not None:
+            v = cache.get(action, topic)
+            if v is not None:
+                return v == ALLOW
+        verdict = self._authorize_nocache(
+            client_id, username or "", peerhost, action, topic, client_acl
+        )
+        if cache is not None:
+            cache.put(action, topic, verdict)
+        return verdict == ALLOW
+
+    def _authorize_nocache(self, client_id, username, peerhost, action, topic, client_acl):
+        if client_acl:
+            for raw in client_acl:
+                rule = self._coerce_rule(raw)
+                if rule and _match_rule(rule, client_id, username, peerhost, action, topic):
+                    return rule.permission
+        for src in self.sources:
+            v = src.authorize(client_id, username, peerhost, action, topic)
+            if v in (ALLOW, DENY):
+                return v
+        return self.no_match
+
+    @staticmethod
+    def _coerce_rule(raw: Any) -> Optional[AclRule]:
+        if isinstance(raw, AclRule):
+            return raw
+        if isinstance(raw, dict):
+            return AclRule(
+                permission=raw.get("permission", "allow"),
+                action=raw.get("action", "all"),
+                topic=raw.get("topic", "#"),
+            )
+        return None

@@ -1,0 +1,103 @@
+"""Hook bridge: installs authn/authz/banned/flapping into a Broker.
+
+The reference's auth apps attach to L1 via hookpoints
+('client.authenticate' from emqx_channel:2080, 'client.authorize' as
+the source chain, flapping on 'client.disconnected') — SURVEY.md §2.6.
+This module is that wiring for our broker: one `AuthPipeline` object
+owns the chains/sources and registers the callbacks.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ..broker.hooks import Hooks, STOP
+from .authn import AuthnChains, AuthResult, Credentials
+from .authz import ALLOW, Authz, AuthzCache
+from .banned import Banned
+from .flapping import FlappingDetector
+
+
+class AuthPipeline:
+    def __init__(
+        self,
+        authn: Optional[AuthnChains] = None,
+        authz: Optional[Authz] = None,
+        banned: Optional[Banned] = None,
+        flapping: Optional[FlappingDetector] = None,
+        cache_cfg: Optional[Dict[str, int]] = None,
+    ):
+        self.authn = authn or AuthnChains()
+        self.authz = authz or Authz()
+        self.banned = banned or Banned()
+        self.flapping = flapping or FlappingDetector(self.banned, enable=False)
+        self._cache_cfg = cache_cfg or {}
+        # client_id -> auth attrs (superuser, acl claim, username, peer)
+        self._clients: Dict[str, Dict[str, Any]] = {}
+        self._caches: Dict[str, AuthzCache] = {}
+
+    # --- hook callbacks -------------------------------------------------
+
+    def _on_authenticate(self, info: Dict[str, Any], acc):
+        client_id = info.get("client_id", "")
+        username = info.get("username")
+        peer = info.get("peer", "")
+        if self.banned.check(client_id, username, peer) is not None:
+            return (STOP, 0x8C)  # banned reason code
+        pw = info.get("password")
+        creds = Credentials(
+            client_id=client_id,
+            username=username,
+            password=pw if isinstance(pw, (bytes, type(None))) else str(pw).encode(),
+            peerhost=peer,
+        )
+        r: AuthResult = self.authn.authenticate(creds, listener=info.get("listener"))
+        if not r.ok:
+            return (STOP, False)
+        self._clients[client_id] = {
+            "username": username,
+            "peer": peer,
+            "superuser": r.superuser,
+            "acl": r.attrs.get("acl"),
+        }
+        self._caches[client_id] = AuthzCache(**self._cache_cfg) if self._cache_cfg else AuthzCache()
+        return True
+
+    def _on_authorize(self, client_id: str, action: str, topic: str, acc):
+        info = self._clients.get(client_id, {})
+        ok = self.authz.authorize(
+            client_id,
+            info.get("username"),
+            info.get("peer", ""),
+            action,
+            topic,
+            superuser=info.get("superuser", False),
+            client_acl=info.get("acl"),
+            cache=self._caches.get(client_id),
+        )
+        return True if ok else (STOP, False)
+
+    def _on_disconnected(self, client_id: str, reason: str):
+        self.flapping.on_disconnect(client_id or "")
+        self._clients.pop(client_id, None)
+        self._caches.pop(client_id, None)
+
+    # --- wiring ---------------------------------------------------------
+
+    def install(self, hooks: Hooks) -> None:
+        hooks.add("client.authenticate", self._on_authenticate, priority=100)
+        hooks.add("client.authorize", self._on_authorize, priority=100)
+        hooks.add("client.disconnected", self._on_disconnected, priority=100)
+
+    def uninstall(self, hooks: Hooks) -> None:
+        hooks.delete("client.authenticate", self._on_authenticate)
+        hooks.delete("client.authorize", self._on_authorize)
+        hooks.delete("client.disconnected", self._on_disconnected)
+
+    def drain_cache(self, client_id: Optional[str] = None) -> None:
+        """Invalidate authz verdict caches (rule changes)."""
+        if client_id is None:
+            for c in self._caches.values():
+                c.drain()
+        elif client_id in self._caches:
+            self._caches[client_id].drain()

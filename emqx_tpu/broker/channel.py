@@ -116,7 +116,7 @@ class Channel:
         if ok is not True:
             code = (
                 ok
-                if isinstance(ok, int)
+                if isinstance(ok, int) and not isinstance(ok, bool)
                 else (RC.NOT_AUTHORIZED if self.proto_ver == MQTT_V5 else 5)
             )
             self.broker.metrics.inc("client.auth.failure")

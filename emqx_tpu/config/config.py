@@ -160,12 +160,18 @@ class Config:
         return new
 
     def remove(self, path: "str | Path") -> None:
+        """Drop a path back to its schema default and clear its
+        override so persistence won't resurrect it."""
         p = _normalize(path)
         with self._lock:
-            parent = _deep_get(self._data, p[:-1], None)
+            candidate = copy.deepcopy(self._data)
+            parent = _deep_get(candidate, p[:-1], None)
             if isinstance(parent, dict):
                 parent.pop(p[-1], None)
-            self._data = self.schema.check("", self._data)
+            self._data = self.schema.check("", candidate)
+            over_parent = _deep_get(self._overrides, p[:-1], None)
+            if isinstance(over_parent, dict):
+                over_parent.pop(p[-1], None)
 
     # --- override persistence (cluster.hocon analog) --------------------
 

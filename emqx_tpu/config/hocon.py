@@ -92,15 +92,17 @@ class _Parser:
                 out.append(c)
                 self.pos += 1
 
-    def _key(self) -> str:
+    def _key(self) -> List[str]:
+        """Returns the key as a path: quoted keys are literal (no dot
+        splitting, per HOCON), unquoted dotted keys are paths."""
         self._skip_ws()
         if self._peek() == '"':
-            return self._quoted()
+            return [self._quoted()]
         m = re.match(r"[A-Za-z0-9_\-\.\$@]+", self.text[self.pos :])
         if not m:
             self._err(f"expected key, got {self._peek()!r}")
         self.pos += m.end()
-        return m.group(0)
+        return m.group(0).split(".")
 
     # --- values ---------------------------------------------------------
 
@@ -149,7 +151,7 @@ class _Parser:
                 val = _Append(self._value())
             else:
                 self._err(f"expected '=', ':' or '{{' after key {key!r}")
-            _merge_path(obj, key.split("."), val)
+            _merge_path(obj, key, val)
 
     def _array(self) -> List[Any]:
         assert self._peek() == "["
@@ -242,16 +244,20 @@ def _merge_path(obj: Dict[str, Any], path: List[str], val: Any) -> None:
         obj[last] = val
 
 
-def _resolve(node: Any, root: Dict[str, Any]) -> Any:
+def _resolve(node: Any, root: Dict[str, Any], stack: Tuple[str, ...] = ()) -> Any:
     if isinstance(node, dict):
         return {
             k: r
             for k, v in node.items()
-            if (r := _resolve(v, root)) is not _MISSING
+            if (r := _resolve(v, root, stack)) is not _MISSING
         }
     if isinstance(node, list):
-        return [r for v in node if (r := _resolve(v, root)) is not _MISSING]
+        return [r for v in node if (r := _resolve(v, root, stack)) is not _MISSING]
     if isinstance(node, _Subst):
+        if node.path in stack:
+            raise HoconError(
+                f"substitution cycle: {' -> '.join(stack + (node.path,))}"
+            )
         cur: Any = root
         for p in node.path.split("."):
             if isinstance(cur, dict) and p in cur:
@@ -260,7 +266,7 @@ def _resolve(node: Any, root: Dict[str, Any]) -> Any:
                 cur = _MISSING
                 break
         if cur is not _MISSING:
-            return _resolve(cur, root)
+            return _resolve(cur, root, stack + (node.path,))
         env = os.environ.get(node.path)
         if env is not None:
             return _coerce(env)

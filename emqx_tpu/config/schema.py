@@ -43,8 +43,6 @@ class Int(Type):
         if isinstance(v, bool) or not isinstance(v, (int, str)):
             raise SchemaError(path, f"expected int, got {v!r}")
         if isinstance(v, str):
-            if v == "infinity":
-                return float("inf")
             try:
                 v = int(v)
             except ValueError:
@@ -64,9 +62,14 @@ class Float(Type):
             if v.endswith("%"):  # percent idiom ("80%")
                 return float(v[:-1]) / 100.0
             try:
-                return float(v)
+                f = float(v)
             except ValueError:
                 raise SchemaError(path, f"expected number, got {v!r}")
+            # "infinity"/"nan" strings are not numbers here — they belong
+            # to Enum branches of unions (e.g. rate = infinity)
+            if f != f or f in (float("inf"), float("-inf")):
+                raise SchemaError(path, f"expected finite number, got {v!r}")
+            return f
         return float(v)
 
 

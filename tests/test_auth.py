@@ -1,0 +1,270 @@
+"""AuthN/AuthZ framework tests: chains, providers, sources, banned,
+flapping, and the end-to-end hook wiring through a Channel."""
+
+import time
+
+import pytest
+
+from emqx_tpu.auth import (
+    GLOBAL_CHAIN,
+    AclRule,
+    AuthPipeline,
+    AuthnChains,
+    Authz,
+    AuthzCache,
+    Banned,
+    BuiltinAclSource,
+    BuiltinDbProvider,
+    Credentials,
+    FileAclSource,
+    FixedUserProvider,
+    FlappingDetector,
+    JwtProvider,
+    make_jwt,
+)
+from emqx_tpu.broker.channel import Channel
+from emqx_tpu.broker.packet import Connack, Connect, Puback, Publish, Suback, Subscribe, SubOpts, Type
+from emqx_tpu.broker.pubsub import Broker
+
+
+class TestAuthnChains:
+    def test_empty_chain_is_anonymous_allow(self):
+        chains = AuthnChains()
+        r = chains.authenticate(Credentials("c1"))
+        assert r.ok and r.reason == "anonymous"
+
+    def test_chain_order_and_ignore(self):
+        chains = AuthnChains()
+        chains.create_authenticator(
+            GLOBAL_CHAIN, "fixed1", FixedUserProvider({"alice": "pw1"})
+        )
+        chains.create_authenticator(
+            GLOBAL_CHAIN, "fixed2", FixedUserProvider({"bob": "pw2"})
+        )
+        # alice handled by first, bob ignored by first and handled by second
+        assert chains.authenticate(Credentials("c", "alice", b"pw1")).ok
+        assert chains.authenticate(Credentials("c", "bob", b"pw2")).ok
+        assert not chains.authenticate(Credentials("c", "alice", b"bad")).ok
+        # unknown user falls off the chain
+        assert not chains.authenticate(Credentials("c", "eve", b"x")).ok
+
+    def test_builtin_db_pbkdf2(self):
+        db = BuiltinDbProvider()
+        db.add_user("u1", "secret", superuser=True)
+        r = db.authenticate(Credentials("c", "u1", b"secret"))
+        assert r.ok and r.superuser
+        assert not db.authenticate(Credentials("c", "u1", b"wrong")).ok
+        assert db.authenticate(Credentials("c", "nobody", b"x")) is not None
+        assert db.delete_user("u1") and not db.delete_user("u1")
+
+    def test_builtin_db_by_clientid(self):
+        db = BuiltinDbProvider(user_id_type="clientid")
+        db.add_user("dev-1", "pw")
+        assert db.authenticate(Credentials("dev-1", None, b"pw")).ok
+
+    def test_listener_chain_overrides_global(self):
+        chains = AuthnChains()
+        chains.create_authenticator(
+            GLOBAL_CHAIN, "g", FixedUserProvider({"alice": "pw"})
+        )
+        chains.create_authenticator(
+            "tcp:internal", "l", FixedUserProvider({"svc": "spw"})
+        )
+        assert chains.authenticate(
+            Credentials("c", "svc", b"spw"), listener="tcp:internal"
+        ).ok
+        # listener chain exists → global not consulted
+        assert not chains.authenticate(
+            Credentials("c", "alice", b"pw"), listener="tcp:internal"
+        ).ok
+
+
+class TestJwt:
+    SECRET = b"test-secret"
+
+    def test_valid_token(self):
+        tok = make_jwt({"sub": "c1", "exp": time.time() + 60}, self.SECRET)
+        p = JwtProvider(self.SECRET)
+        assert p.authenticate(Credentials("c1", "u", tok.encode())).ok
+
+    def test_expired_and_bad_sig(self):
+        p = JwtProvider(self.SECRET)
+        tok = make_jwt({"exp": time.time() - 10}, self.SECRET)
+        assert p.authenticate(Credentials("c", "u", tok.encode())).reason == "token_expired"
+        tok2 = make_jwt({"exp": time.time() + 60}, b"other")
+        assert (
+            p.authenticate(Credentials("c", "u", tok2.encode())).reason
+            == "bad_signature"
+        )
+
+    def test_verify_claims_placeholder(self):
+        p = JwtProvider(self.SECRET, verify_claims={"sub": "${clientid}"})
+        good = make_jwt({"sub": "dev-9"}, self.SECRET)
+        bad = make_jwt({"sub": "dev-8"}, self.SECRET)
+        assert p.authenticate(Credentials("dev-9", None, good.encode())).ok
+        assert not p.authenticate(Credentials("dev-9", None, bad.encode())).ok
+
+    def test_acl_claim_attached(self):
+        acl = [{"permission": "allow", "action": "publish", "topic": "t/1"}]
+        tok = make_jwt({"acl": acl}, self.SECRET)
+        r = JwtProvider(self.SECRET).authenticate(Credentials("c", None, tok.encode()))
+        assert r.attrs["acl"] == acl
+
+    def test_non_jwt_password_ignored(self):
+        from emqx_tpu.auth.authn import IGNORE
+
+        assert JwtProvider(self.SECRET).authenticate(Credentials("c", "u", b"plain")) is IGNORE
+
+
+class TestAuthz:
+    def test_default_no_match(self):
+        assert Authz(no_match="allow").authorize("c", "u", "", "publish", "t")
+        assert not Authz(no_match="deny").authorize("c", "u", "", "publish", "t")
+
+    def test_source_chain_order(self):
+        deny_t = FileAclSource([AclRule("deny", "all", "t/#")])
+        allow_all = FileAclSource([AclRule("allow", "all", "#")])
+        az = Authz(no_match="deny", sources=[deny_t, allow_all])
+        assert not az.authorize("c", "u", "", "publish", "t/1")
+        assert az.authorize("c", "u", "", "publish", "other")
+
+    def test_placeholders_and_eq(self):
+        src = FileAclSource(
+            [
+                AclRule("allow", "publish", "dev/${clientid}/up"),
+                AclRule("allow", "subscribe", "eq q/+/x"),
+            ]
+        )
+        az = Authz(no_match="deny", sources=[src])
+        assert az.authorize("d1", None, "", "publish", "dev/d1/up")
+        assert not az.authorize("d1", None, "", "publish", "dev/d2/up")
+        # 'eq' matches the literal filter only, not the wildcard expansion
+        assert az.authorize("d1", None, "", "subscribe", "q/+/x")
+        assert not az.authorize("d1", None, "", "subscribe", "q/1/x")
+
+    def test_who_filter(self):
+        src = FileAclSource(
+            [AclRule("allow", "all", "#", who=("username", "admin"))]
+        )
+        az = Authz(no_match="deny", sources=[src])
+        assert az.authorize("c", "admin", "", "publish", "t")
+        assert not az.authorize("c", "bob", "", "publish", "t")
+
+    def test_builtin_source_per_user(self):
+        src = BuiltinAclSource()
+        src.set_rules(("username", "u1"), [AclRule("allow", "publish", "a/#")])
+        src.set_rules(None, [AclRule("deny", "all", "#")])
+        az = Authz(no_match="allow", sources=[src])
+        assert az.authorize("c", "u1", "", "publish", "a/b")
+        assert not az.authorize("c", "u2", "", "publish", "a/b")
+
+    def test_superuser_bypasses(self):
+        az = Authz(no_match="deny")
+        assert az.authorize("c", "u", "", "publish", "t", superuser=True)
+
+    def test_client_acl_precedes_sources(self):
+        az = Authz(no_match="deny", sources=[FileAclSource([AclRule("deny", "all", "#")])])
+        acl = [{"permission": "allow", "action": "publish", "topic": "t"}]
+        assert az.authorize("c", "u", "", "publish", "t", client_acl=acl)
+
+    def test_cache(self):
+        calls = []
+
+        class Counting(FileAclSource):
+            def authorize(self, *a):
+                calls.append(a)
+                return super().authorize(*a)
+
+        az = Authz(no_match="deny", sources=[Counting([AclRule("allow", "all", "#")])])
+        cache = AuthzCache(max_size=4, ttl_ms=60_000)
+        for _ in range(5):
+            assert az.authorize("c", "u", "", "publish", "t", cache=cache)
+        assert len(calls) == 1
+
+
+class TestBannedFlapping:
+    def test_ban_expiry(self):
+        b = Banned()
+        b.create("clientid", "c1", duration_s=0.05)
+        assert b.check("c1") is not None
+        time.sleep(0.06)
+        assert b.check("c1") is None
+
+    def test_ban_kinds(self):
+        b = Banned()
+        b.create("username", "mallory")
+        b.create("peerhost", "10.0.0.9")
+        b.create("clientid_re", "bot-*")
+        assert b.check("c", username="mallory") is not None
+        assert b.check("c", peerhost="10.0.0.9") is not None
+        assert b.check("bot-42") is not None
+        assert b.check("dev-1", username="ok", peerhost="10.0.0.1") is None
+        assert b.delete("username", "mallory")
+
+    def test_flapping_bans(self):
+        banned = Banned()
+        f = FlappingDetector(banned, max_count=3, window_time_s=10, ban_time_s=60)
+        for _ in range(3):
+            assert not f.on_disconnect("flappy")
+        assert f.on_disconnect("flappy")
+        assert banned.check("flappy") is not None
+
+
+class TestEndToEnd:
+    def _broker_with_auth(self):
+        broker = Broker()
+        pipe = AuthPipeline()
+        db = BuiltinDbProvider()
+        db.add_user("alice", "pw")
+        pipe.authn.create_authenticator(GLOBAL_CHAIN, "db", db)
+        pipe.authz.no_match = "deny"
+        pipe.authz.add_source(
+            FileAclSource(
+                [
+                    AclRule("allow", "publish", "up/${clientid}"),
+                    AclRule("allow", "subscribe", "down/#"),
+                ]
+            )
+        )
+        pipe.install(broker.hooks)
+        return broker, pipe
+
+    def test_connect_auth(self):
+        broker, _ = self._broker_with_auth()
+        ch = Channel(broker)
+        (ack,) = ch.handle_packet(Connect(client_id="c1", username="alice", password=b"pw"))
+        assert isinstance(ack, Connack) and ack.code == 0
+        ch2 = Channel(broker)
+        (nak,) = ch2.handle_packet(Connect(client_id="c2", username="alice", password=b"no"))
+        assert nak.code != 0
+
+    def test_banned_client_rejected(self):
+        broker, pipe = self._broker_with_auth()
+        pipe.banned.create("clientid", "evil")
+        ch = Channel(broker)
+        (nak,) = ch.handle_packet(
+            Connect(client_id="evil", username="alice", password=b"pw")
+        )
+        assert nak.code != 0
+
+    def test_publish_authz(self):
+        broker, _ = self._broker_with_auth()
+        ch = Channel(broker)
+        ch.handle_packet(Connect(client_id="c1", username="alice", password=b"pw"))
+        # allowed: up/c1; denied: up/c2
+        out = ch.handle_packet(Publish(topic="up/c1", payload=b"x", qos=1, packet_id=1))
+        assert out[0].code == 0 or out[0].code == 0x10  # ok / no subscribers
+        out = ch.handle_packet(Publish(topic="up/c2", payload=b"x", qos=1, packet_id=2))
+        assert out[0].code == 0x87  # NOT_AUTHORIZED
+
+    def test_subscribe_authz(self):
+        broker, _ = self._broker_with_auth()
+        ch = Channel(broker)
+        ch.handle_packet(Connect(client_id="c1", username="alice", password=b"pw"))
+        out = ch.handle_packet(
+            Subscribe(packet_id=1, filters=[("down/1", SubOpts(qos=1)), ("secret", SubOpts(qos=0))])
+        )
+        suback = out[0]
+        assert isinstance(suback, Suback)
+        assert suback.codes[0] == 1  # granted
+        assert suback.codes[1] in (0x80, 0x87)  # denied
