@@ -156,6 +156,8 @@ class JwtProvider(Provider):
             sig = _b64url_decode(sig_b64)
         except Exception:
             return AuthResult(False, "bad_token")
+        if not isinstance(header, dict) or not isinstance(claims, dict):
+            return AuthResult(False, "bad_token")
         if header.get("alg") != "HS256":
             return AuthResult(False, "unsupported_alg")
         expect = hmac.new(
@@ -164,8 +166,13 @@ class JwtProvider(Provider):
         if not hmac.compare_digest(sig, expect):
             return AuthResult(False, "bad_signature")
         exp = claims.get("exp")
-        if exp is not None and time.time() > float(exp):
-            return AuthResult(False, "token_expired")
+        if exp is not None:
+            try:
+                exp = float(exp)
+            except (TypeError, ValueError):
+                return AuthResult(False, "bad_token")
+            if time.time() > exp:
+                return AuthResult(False, "token_expired")
         for name, want in self.verify_claims.items():
             want = want.replace("${clientid}", creds.client_id).replace(
                 "${username}", creds.username or ""

@@ -48,6 +48,7 @@ class Channel:
         self.broker = broker
         self.peer = peer
         self.client_id: Optional[str] = None
+        self.username: Optional[str] = None
         self.proto_ver: int = 4
         self.session: Optional[Session] = None
         self.will: Optional[Will] = None
@@ -119,6 +120,8 @@ class Channel:
                 if isinstance(ok, int) and not isinstance(ok, bool)
                 else (RC.NOT_AUTHORIZED if self.proto_ver == MQTT_V5 else 5)
             )
+            if self.proto_ver != MQTT_V5 and code > 5:
+                code = 5  # v3 CONNACK codes are 0-5; map v5 reasons down
             self.broker.metrics.inc("client.auth.failure")
             return [Connack(False, code)]
 
@@ -134,6 +137,7 @@ class Channel:
         )
         self.session = session
         self.client_id = client_id
+        self.username = pkt.username
         self.keepalive = pkt.keepalive
         self.will = pkt.will
         self.connected = True
@@ -193,6 +197,9 @@ class Channel:
                          "response_topic", "correlation_data",
                          "payload_format_indicator", "user_property")
             },
+            # publisher identity rides broker-internal headers (the
+            # reference's #message.headers), never the wire props
+            headers={"username": self.username or "", "peerhost": self.peer},
         )
         if pkt.qos == 0:
             self.broker.publish(msg)

@@ -1,0 +1,414 @@
+"""Rule engine runtime — emqx_rule_engine / emqx_rule_runtime analog.
+
+Rules are indexed by their FROM topic filters in the SAME matcher
+structure the router uses (the reference shares emqx_topic_index
+between router and ?RULE_TOPIC_INDEX, apps/emqx_rule_engine/src/
+emqx_rule_engine.erl:230-231,537,545 — BASELINE config #5). On
+'message.publish' the engine matches the message topic against the
+rule index (host trie for singles; the engine also exposes
+`match_rules_batch` so the broker's TPU batch path can fold rule
+matching into the same device dispatch), evaluates WHERE, binds the
+SELECT fields, and feeds the result to the rule's actions.
+
+Actions: console (debug log), republish (back into the broker with
+placeholder-templated topic/payload/qos), function (any callable —
+the bridge/action hookup point).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+from ..broker.message import Message
+from ..ops import topic as topic_mod
+from ..ops.host_index import TopicTrie
+from . import events as ev
+from .funcs import FUNCS, _str
+from .sql import Select, SqlError, parse
+
+log = logging.getLogger("emqx_tpu.rules")
+
+_UNDEF = object()
+
+
+# --- expression evaluation ---------------------------------------------
+
+
+def _get_path(env: Dict[str, Any], path: List[str]) -> Any:
+    cur: Any = env
+    for i, seg in enumerate(path):
+        if seg == "*":
+            return cur
+        if isinstance(cur, (bytes, str)) and i >= 1:
+            # payload.* auto-decodes JSON payloads (reference behavior)
+            try:
+                cur = json.loads(cur if isinstance(cur, str) else cur.decode())
+            except Exception:
+                return None
+        if isinstance(cur, dict):
+            cur = cur.get(seg, _UNDEF)
+            if cur is _UNDEF:
+                return None
+        elif isinstance(cur, list):
+            try:
+                cur = cur[int(seg) - 1]
+            except (ValueError, IndexError):
+                return None
+        else:
+            return None
+    if isinstance(cur, bytes):
+        cur = cur.decode("utf-8", "replace")
+    return cur
+
+
+def _like(s: Any, pat: str) -> bool:
+    rx = re.escape(pat).replace("%", ".*").replace("_", ".")
+    return re.fullmatch(rx, _str(s)) is not None
+
+
+def _eq(a: Any, b: Any) -> bool:
+    if isinstance(a, bool) or isinstance(b, bool):
+        return a is b
+    if isinstance(a, (int, float)) and isinstance(b, (int, float)):
+        return a == b
+    if isinstance(a, (int, float)) or isinstance(b, (int, float)):
+        try:
+            return float(a) == float(b)
+        except (TypeError, ValueError):
+            return False
+    return a == b
+
+
+def eval_expr(e: Any, env: Dict[str, Any]) -> Any:
+    op = e[0]
+    if op == "lit":
+        return e[1]
+    if op == "path":
+        return _get_path(env, e[1])
+    if op == "index":
+        obj = eval_expr(e[1], env)
+        idx = eval_expr(e[2], env)
+        if isinstance(obj, dict):
+            return obj.get(_str(idx))
+        if isinstance(obj, list):
+            try:
+                return obj[int(idx) - 1]
+            except (ValueError, IndexError):
+                return None
+        return None
+    if op == "and":
+        return bool(eval_expr(e[1], env)) and bool(eval_expr(e[2], env))
+    if op == "or":
+        return bool(eval_expr(e[1], env)) or bool(eval_expr(e[2], env))
+    if op == "not":
+        return not bool(eval_expr(e[1], env))
+    if op == "neg":
+        return -eval_expr(e[1], env)
+    if op in ("=", "!=", ">", "<", ">=", "<="):
+        a, b = eval_expr(e[1], env), eval_expr(e[2], env)
+        if op == "=":
+            return _eq(a, b)
+        if op == "!=":
+            return not _eq(a, b)
+        try:
+            if op == ">":
+                return a > b
+            if op == "<":
+                return a < b
+            if op == ">=":
+                return a >= b
+            return a <= b
+        except TypeError:
+            return False
+    if op in ("+", "-", "*", "/", "div", "mod"):
+        a, b = eval_expr(e[1], env), eval_expr(e[2], env)
+        if op == "+" and (isinstance(a, str) or isinstance(b, str)):
+            return _str(a) + _str(b)
+        try:
+            if op == "+":
+                return a + b
+            if op == "-":
+                return a - b
+            if op == "*":
+                return a * b
+            if op == "/":
+                return a / b
+            if op == "div":
+                return int(a) // int(b)
+            return int(a) % int(b)
+        except (TypeError, ZeroDivisionError):
+            return None
+    if op == "in":
+        v = eval_expr(e[1], env)
+        return any(_eq(v, eval_expr(x, env)) for x in e[2])
+    if op == "like":
+        return _like(eval_expr(e[1], env), e[2])
+    if op == "isnull":
+        return eval_expr(e[1], env) is None
+    if op == "case":
+        for c, v in e[1]:
+            if bool(eval_expr(c, env)):
+                return eval_expr(v, env)
+        return eval_expr(e[2], env)
+    if op == "call":
+        fn = FUNCS.get(e[1])
+        if fn is None:
+            raise SqlError(f"unknown function {e[1]!r}")
+        return fn(*(eval_expr(a, env) for a in e[2]))
+    raise SqlError(f"bad expr node {op!r}")
+
+
+def select_fields(sel: Select, env: Dict[str, Any]) -> Dict[str, Any]:
+    """Bind the SELECT list; '*' keeps the whole env."""
+    if not sel.fields:
+        return dict(env)
+    out: Dict[str, Any] = {}
+    for expr, alias in sel.fields:
+        if expr == ("path", ["*"]):
+            out.update(env)
+            continue
+        val = eval_expr(expr, env)
+        name = alias or (expr[1][-1] if expr[0] == "path" else "value")
+        out[name] = val
+    return out
+
+
+_PLACEHOLDER = re.compile(r"\$\{([^}]+)\}")
+
+
+def render_template(tpl: str, env: Dict[str, Any]) -> str:
+    """${path.to.field} placeholder substitution (emqx_placeholder)."""
+    return _PLACEHOLDER.sub(
+        lambda m: _str(_get_path(env, m.group(1).split("."))), tpl
+    )
+
+
+# --- rules --------------------------------------------------------------
+
+
+@dataclass
+class RuleMetrics:
+    matched: int = 0
+    passed: int = 0
+    failed: int = 0
+    no_result: int = 0
+    actions_success: int = 0
+    actions_failed: int = 0
+
+
+@dataclass
+class Rule:
+    id: str
+    sql: str
+    select: Select
+    actions: List[Dict[str, Any]] = field(default_factory=list)
+    enable: bool = True
+    description: str = ""
+    metrics: RuleMetrics = field(default_factory=RuleMetrics)
+    created_at: float = field(default_factory=time.time)
+
+
+class RuleEngine:
+    def __init__(self, broker=None, ignore_sys: bool = True):
+        self.broker = broker
+        self.ignore_sys = ignore_sys
+        self.rules: Dict[str, Rule] = {}
+        # FROM-filter index, shared matcher shape with the router
+        # (?RULE_TOPIC_INDEX analog)
+        self._index = TopicTrie()
+        self._event_rules: Dict[str, Set[str]] = {}  # event topic -> rule ids
+        self._installed = False
+
+    # --- CRUD -----------------------------------------------------------
+
+    def create_rule(
+        self,
+        rule_id: str,
+        sql: str,
+        actions: Optional[List[Dict[str, Any]]] = None,
+        enable: bool = True,
+        description: str = "",
+    ) -> Rule:
+        if rule_id in self.rules:
+            raise ValueError(f"rule {rule_id!r} exists")
+        sel = parse(sql)
+        rule = Rule(rule_id, sql, sel, actions or [], enable, description)
+        self.rules[rule_id] = rule
+        for f in sel.froms:
+            if ev.is_event_topic(f):
+                self._event_rules.setdefault(f, set()).add(rule_id)
+            else:
+                self._index.insert(topic_mod.words(f), (rule_id, f))
+        return rule
+
+    def delete_rule(self, rule_id: str) -> bool:
+        rule = self.rules.pop(rule_id, None)
+        if rule is None:
+            return False
+        for f in rule.select.froms:
+            if ev.is_event_topic(f):
+                self._event_rules.get(f, set()).discard(rule_id)
+            else:
+                self._index.remove(topic_mod.words(f), (rule_id, f))
+        return True
+
+    def update_rule(self, rule_id: str, **kw) -> Rule:
+        old = self.rules.get(rule_id)
+        if old is None:
+            raise KeyError(rule_id)
+        sql = kw.get("sql", old.sql)
+        parse(sql)  # validate BEFORE touching the live rule
+        actions = kw.get("actions", old.actions)
+        enable = kw.get("enable", old.enable)
+        desc = kw.get("description", old.description)
+        self.delete_rule(rule_id)
+        return self.create_rule(rule_id, sql, actions, enable, desc)
+
+    # --- matching -------------------------------------------------------
+
+    def match_rules(self, topic: str) -> List[Rule]:
+        ids = self._index.match(topic_mod.words(topic))
+        return [
+            self.rules[rid]
+            for rid, _f in ids
+            if rid in self.rules and self.rules[rid].enable
+        ]
+
+    def match_rules_batch(self, topics: Sequence[str]) -> List[List[Rule]]:
+        """Batch-shaped API so the broker's device dispatch can carry
+        rule matching in the same batch (config #5)."""
+        return [self.match_rules(t) for t in topics]
+
+    # --- evaluation -----------------------------------------------------
+
+    MAX_REPUBLISH_DEPTH = 8
+
+    def on_message_publish(self, msg: Message, acc=None):
+        """'message.publish' hook body (emqx_rule_events.erl:80,118)."""
+        if self.ignore_sys and msg.topic.startswith("$SYS/"):
+            return None
+        depth = int(msg.headers.get("republish_depth", 0))
+        if depth >= self.MAX_REPUBLISH_DEPTH:
+            log.warning("republish loop cut at depth %d on %s", depth, msg.topic)
+            return None
+        env = ev.message_event(msg)
+        env["_republish_depth"] = depth
+        by = msg.headers.get("republish_by")
+        for rule in self.match_rules(msg.topic):
+            if by is not None and rule.id == by:
+                continue  # a rule never re-triggers itself
+            self.apply_rule(rule, env)
+        return None
+
+    def on_event(self, event_topic: str, env: Dict[str, Any]) -> None:
+        for rid in self._event_rules.get(event_topic, ()):
+            rule = self.rules.get(rid)
+            if rule is not None and rule.enable:
+                self.apply_rule(rule, env)
+
+    def apply_rule(self, rule: Rule, env: Dict[str, Any]) -> Optional[List[Dict[str, Any]]]:
+        rule.metrics.matched += 1
+        try:
+            sel = rule.select
+            rows: List[Dict[str, Any]]
+            if sel.foreach is not None:
+                coll = eval_expr(sel.foreach[0], env)
+                if not isinstance(coll, list):
+                    rule.metrics.no_result += 1
+                    return None
+                alias = sel.foreach[1] or "item"
+                rows = []
+                for item in coll:
+                    ienv = {**env, alias: item, "item": item}
+                    if sel.incase is not None and not bool(eval_expr(sel.incase, ienv)):
+                        continue
+                    if sel.where is not None and not bool(eval_expr(sel.where, ienv)):
+                        continue
+                    rows.append(select_fields(sel, ienv))
+                if not rows:
+                    rule.metrics.no_result += 1
+                    return None
+            else:
+                if sel.where is not None and not bool(eval_expr(sel.where, env)):
+                    rule.metrics.no_result += 1
+                    return None
+                rows = [select_fields(sel, env)]
+            rule.metrics.passed += 1
+        except Exception:
+            rule.metrics.failed += 1
+            log.exception("rule %s evaluation failed", rule.id)
+            return None
+        for row in rows:
+            self._run_actions(rule, row, env)
+        return rows
+
+    def _run_actions(self, rule: Rule, row: Dict[str, Any], env: Dict[str, Any]) -> None:
+        for action in rule.actions:
+            try:
+                self._run_action({**action, "_rule_id": rule.id}, row, env)
+                rule.metrics.actions_success += 1
+            except Exception:
+                rule.metrics.actions_failed += 1
+                log.exception("rule %s action %s failed", rule.id, action)
+
+    def _run_action(self, action: Dict[str, Any], row: Dict[str, Any], env: Dict[str, Any]) -> None:
+        kind = action.get("function", action.get("type", "console"))
+        if kind == "console":
+            log.info("[rule console] %s", json.dumps(row, default=_str))
+        elif kind == "republish":
+            args = action.get("args", {})
+            tpl_env = {**env, **row}
+            topic = render_template(args.get("topic", "republish/${topic}"), tpl_env)
+            payload_tpl = args.get("payload", "${payload}")
+            payload = render_template(payload_tpl, tpl_env) if payload_tpl else json.dumps(row, default=_str)
+            qos_raw = args.get("qos", 0)
+            qos = int(render_template(str(qos_raw), tpl_env)) if isinstance(qos_raw, str) else qos_raw
+            if self.broker is None:
+                raise RuntimeError("republish without a broker")
+            out = Message(
+                topic=topic,
+                payload=payload.encode() if isinstance(payload, str) else payload,
+                qos=qos,
+                retain=bool(args.get("retain", False)),
+                from_client=f"rule:{action.get('rule_id', '')}",
+            )
+            # loop guards: a rule never re-triggers itself, and chains
+            # across rules are depth-capped (the reference marks
+            # republished messages and warns on loops)
+            out.headers["republish_by"] = action.get("_rule_id")
+            out.headers["republish_depth"] = int(env.get("_republish_depth", 0)) + 1
+            self.broker.publish(out)
+        elif callable(kind):
+            kind(row, env)
+        else:
+            raise ValueError(f"unknown action {kind!r}")
+
+    # --- wiring + dry run ----------------------------------------------
+
+    def install(self, hooks) -> None:
+        if self._installed:
+            return
+        hooks.add("message.publish", self._hook_cb, priority=50)
+        self._installed = True
+
+    def _hook_cb(self, msg, acc=None):
+        # run_fold('message.publish', (), msg): single arg is the acc
+        m = msg if isinstance(msg, Message) else acc
+        if isinstance(m, Message):
+            self.on_message_publish(m)
+        return None
+
+    def test_sql(self, sql: str, env: Dict[str, Any]) -> Optional[Any]:
+        """Dry-run (emqx_rule_sqltester analog): returns the bound
+        SELECT result or None if WHERE filtered it out."""
+        sel = parse(sql)
+        tmp = Rule("$test", sql, sel)
+        rows = self.apply_rule(tmp, env)
+        if rows is None:
+            return None
+        return rows[0] if sel.foreach is None else rows

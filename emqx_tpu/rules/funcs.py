@@ -1,0 +1,208 @@
+"""Builtin rule functions — emqx_rule_funcs analog.
+
+The reference ships ~200 builtins (apps/emqx_rule_engine/src/
+emqx_rule_funcs.erl); this table covers the families rules actually
+lean on: type conversion, string, arithmetic/rounding, map/array,
+JSON, time, hashing/encoding, topic, conditional.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import math
+import re
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+from ..ops import topic as topic_mod
+
+
+def _num(x: Any) -> float:
+    if isinstance(x, bool):
+        return 1.0 if x else 0.0
+    if isinstance(x, (int, float)):
+        return x
+    return float(x)
+
+
+def _str(x: Any) -> str:
+    if isinstance(x, bytes):
+        return x.decode("utf-8", "replace")
+    if isinstance(x, bool):
+        return "true" if x else "false"
+    if x is None:
+        return ""
+    if isinstance(x, (dict, list)):
+        return json.dumps(x)
+    return str(x)
+
+
+FUNCS: Dict[str, Callable[..., Any]] = {}
+
+
+def func(name: str):
+    def deco(f):
+        FUNCS[name] = f
+        return f
+
+    return deco
+
+
+# --- type conversion / checks ------------------------------------------
+
+FUNCS["str"] = _str
+FUNCS["str_utf8"] = _str
+FUNCS["int"] = lambda x: int(_num(x))
+FUNCS["float"] = _num
+FUNCS["bool"] = lambda x: x in (True, "true", 1)
+FUNCS["num"] = _num
+FUNCS["is_null"] = lambda x: x is None
+FUNCS["is_not_null"] = lambda x: x is not None
+FUNCS["is_str"] = lambda x: isinstance(x, str)
+FUNCS["is_num"] = lambda x: isinstance(x, (int, float)) and not isinstance(x, bool)
+FUNCS["is_int"] = lambda x: isinstance(x, int) and not isinstance(x, bool)
+FUNCS["is_float"] = lambda x: isinstance(x, float)
+FUNCS["is_bool"] = lambda x: isinstance(x, bool)
+FUNCS["is_map"] = lambda x: isinstance(x, dict)
+FUNCS["is_array"] = lambda x: isinstance(x, list)
+
+# --- arithmetic ---------------------------------------------------------
+
+FUNCS["abs"] = lambda x: abs(_num(x))
+FUNCS["ceil"] = lambda x: math.ceil(_num(x))
+FUNCS["floor"] = lambda x: math.floor(_num(x))
+FUNCS["round"] = lambda x: round(_num(x))
+FUNCS["sqrt"] = lambda x: math.sqrt(_num(x))
+FUNCS["exp"] = lambda x: math.exp(_num(x))
+FUNCS["power"] = lambda x, y: _num(x) ** _num(y)
+FUNCS["log"] = lambda x: math.log(_num(x))
+FUNCS["log10"] = lambda x: math.log10(_num(x))
+FUNCS["log2"] = lambda x: math.log2(_num(x))
+FUNCS["mod"] = lambda x, y: int(_num(x)) % int(_num(y))
+FUNCS["range"] = lambda a, b: list(range(int(_num(a)), int(_num(b)) + 1))
+FUNCS["random"] = lambda: __import__("random").random()
+
+# --- strings ------------------------------------------------------------
+
+FUNCS["lower"] = lambda s: _str(s).lower()
+FUNCS["upper"] = lambda s: _str(s).upper()
+FUNCS["trim"] = lambda s: _str(s).strip()
+FUNCS["ltrim"] = lambda s: _str(s).lstrip()
+FUNCS["rtrim"] = lambda s: _str(s).rstrip()
+FUNCS["reverse"] = lambda s: _str(s)[::-1]
+FUNCS["strlen"] = lambda s: len(_str(s))
+FUNCS["substr"] = lambda s, start, *n: (
+    _str(s)[int(start) :] if not n else _str(s)[int(start) : int(start) + int(n[0])]
+)
+FUNCS["split"] = lambda s, sep=" ", *_: [p for p in _str(s).split(_str(sep)) if p != ""]
+FUNCS["concat"] = lambda *xs: "".join(_str(x) for x in xs)
+FUNCS["sprintf"] = lambda fmt, *xs: _str(fmt).replace("~s", "{}").replace("~p", "{!r}").format(*xs)
+FUNCS["pad"] = lambda s, n, *a: _str(s).ljust(int(n))
+FUNCS["replace"] = lambda s, old, new: _str(s).replace(_str(old), _str(new))
+FUNCS["regex_match"] = lambda s, p: re.search(p, _str(s)) is not None
+FUNCS["regex_replace"] = lambda s, p, r: re.sub(p, r, _str(s))
+FUNCS["regex_extract"] = lambda s, p: (
+    (m := re.search(p, _str(s))) and (m.group(1) if m.groups() else m.group(0)) or ""
+)
+FUNCS["ascii"] = lambda s: ord(_str(s)[0])
+FUNCS["find"] = lambda s, sub: (
+    _str(s)[i:] if (i := _str(s).find(_str(sub))) >= 0 else ""
+)
+FUNCS["join_to_string"] = lambda sep, xs: _str(sep).join(_str(x) for x in xs)
+FUNCS["tokens"] = lambda s, sep: [p for p in _str(s).split(_str(sep)) if p]
+
+# --- maps / arrays ------------------------------------------------------
+
+FUNCS["map_get"] = lambda key, m, *d: (m or {}).get(_str(key), d[0] if d else None)
+FUNCS["map_put"] = lambda key, val, m: {**(m or {}), _str(key): val}
+FUNCS["map_keys"] = lambda m: list((m or {}).keys())
+FUNCS["map_values"] = lambda m: list((m or {}).values())
+FUNCS["map_to_entries"] = lambda m: [
+    {"key": k, "value": v} for k, v in (m or {}).items()
+]
+FUNCS["mget"] = FUNCS["map_get"]
+FUNCS["mput"] = FUNCS["map_put"]
+FUNCS["nth"] = lambda n, xs: xs[int(n) - 1] if 0 < int(n) <= len(xs) else None
+FUNCS["length"] = lambda xs: len(xs)
+FUNCS["sublist"] = lambda n, xs: list(xs)[: int(n)]
+FUNCS["first"] = lambda xs: xs[0] if xs else None
+FUNCS["last"] = lambda xs: xs[-1] if xs else None
+FUNCS["contains"] = lambda x, xs: x in xs
+
+
+# --- JSON ---------------------------------------------------------------
+
+
+@func("json_decode")
+def _json_decode(s):
+    if isinstance(s, (dict, list)):
+        return s
+    if isinstance(s, bytes):
+        s = s.decode("utf-8", "replace")
+    return json.loads(s)
+
+
+FUNCS["json_encode"] = lambda x: json.dumps(x, separators=(",", ":"))
+
+# --- time ---------------------------------------------------------------
+
+FUNCS["now_timestamp"] = lambda *unit: (
+    int(time.time() * 1000) if unit and unit[0] == "millisecond" else int(time.time())
+)
+FUNCS["now_rfc3339"] = lambda *unit: time.strftime(
+    "%Y-%m-%dT%H:%M:%S%z", time.localtime()
+)
+FUNCS["unix_ts_to_rfc3339"] = lambda ts, *unit: time.strftime(
+    "%Y-%m-%dT%H:%M:%S%z",
+    time.localtime(ts / 1000 if unit and unit[0] == "millisecond" else ts),
+)
+FUNCS["timezone_to_offset_seconds"] = lambda tz: -time.timezone
+FUNCS["format_date"] = lambda unit, offset, fmt, ts: time.strftime(
+    fmt.replace("%Y", "%Y").replace("%m", "%m"),
+    time.gmtime(ts / 1000 if unit == "millisecond" else ts),
+)
+
+# --- hashing / encoding -------------------------------------------------
+
+FUNCS["md5"] = lambda s: hashlib.md5(_b(s)).hexdigest()
+FUNCS["sha"] = lambda s: hashlib.sha1(_b(s)).hexdigest()
+FUNCS["sha256"] = lambda s: hashlib.sha256(_b(s)).hexdigest()
+FUNCS["base64_encode"] = lambda s: base64.b64encode(_b(s)).decode()
+FUNCS["base64_decode"] = lambda s: base64.b64decode(_str(s)).decode("utf-8", "replace")
+FUNCS["hexstr"] = lambda s: _b(s).hex()
+FUNCS["bitsize"] = lambda s: len(_b(s)) * 8
+FUNCS["bytesize"] = lambda s: len(_b(s))
+FUNCS["byteszie"] = FUNCS["bytesize"]  # reference's typo'd alias
+FUNCS["uuid_v4"] = lambda: str(uuid.uuid4())
+FUNCS["crc32"] = lambda s: __import__("zlib").crc32(_b(s))
+
+
+def _b(x: Any) -> bytes:
+    if isinstance(x, bytes):
+        return x
+    return _str(x).encode()
+
+
+# --- topic helpers ------------------------------------------------------
+
+FUNCS["topic_match"] = lambda t, f: topic_mod.match(
+    topic_mod.words(_str(t)), topic_mod.words(_str(f))
+)
+
+
+@func("nth_topic_level")
+def _nth_level(n, t):
+    ws = topic_mod.words(_str(t))
+    n = int(n)
+    return ws[n - 1] if 0 < n <= len(ws) else None
+
+
+FUNCS["topic_levels"] = lambda t: topic_mod.words(_str(t))
+
+# --- conditional --------------------------------------------------------
+
+FUNCS["coalesce"] = lambda *xs: next((x for x in xs if x is not None), None)
+FUNCS["iif"] = lambda c, a, b: a if c in (True, "true") else b

@@ -1,0 +1,208 @@
+"""Rule engine: SQL parse, expression eval, funcs, topic-indexed
+matching, actions, events, sqltester."""
+
+import json
+
+import pytest
+
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.packet import SubOpts
+from emqx_tpu.broker.pubsub import Broker
+from emqx_tpu.broker.session import Session
+from emqx_tpu.rules import RuleEngine, parse_sql
+from emqx_tpu.rules.engine import eval_expr, render_template, select_fields
+from emqx_tpu.rules.sql import SqlError
+
+
+def env_for(topic="t/1", payload=b"{}", **kw):
+    from emqx_tpu.rules.events import message_event
+
+    return message_event(Message(topic=topic, payload=payload, **kw))
+
+
+class TestSqlParse:
+    def test_select_star(self):
+        s = parse_sql('SELECT * FROM "t/#"')
+        assert s.fields == [] and s.froms == ["t/#"] and s.where is None
+
+    def test_fields_aliases_multi_from(self):
+        s = parse_sql('SELECT payload.x AS x, clientid FROM "a/+", "b/#" WHERE x > 1')
+        assert len(s.fields) == 2 and s.froms == ["a/+", "b/#"]
+        assert s.fields[0][1] == "x"
+
+    def test_bad_sql(self):
+        with pytest.raises(SqlError):
+            parse_sql("SELECT FROM x")
+        with pytest.raises(SqlError):
+            parse_sql('SELECT * FROM "t" WHERE (1 = ')
+
+    def test_foreach(self):
+        s = parse_sql(
+            'FOREACH payload.sensors AS s DO s.name, s.value FROM "t" INCASE s.value > 0'
+        )
+        assert s.foreach is not None and s.foreach[1] == "s"
+        assert s.incase is not None
+
+
+class TestEval:
+    def test_arith_and_compare(self):
+        env = {"a": 7, "b": 2}
+        assert eval_expr(parse_sql('SELECT a + b AS v FROM "t"').fields[0][0], env) == 9
+        assert eval_expr(parse_sql('SELECT a div b AS v FROM "t"').fields[0][0], env) == 3
+        assert eval_expr(parse_sql('SELECT a mod b AS v FROM "t"').fields[0][0], env) == 1
+
+    def test_where_logic(self):
+        sql = 'SELECT * FROM "t" WHERE (qos > 0 AND topic LIKE \'up/%\') OR retain'
+        w = parse_sql(sql).where
+        assert eval_expr(w, {"qos": 1, "topic": "up/1", "retain": False})
+        assert eval_expr(w, {"qos": 0, "topic": "x", "retain": True})
+        assert not eval_expr(w, {"qos": 0, "topic": "up/1", "retain": False})
+
+    def test_payload_json_auto_decode(self):
+        env = env_for(payload=b'{"temp": {"hi": 31.5}, "tags": ["a", "b"]}')
+        sel = parse_sql('SELECT payload.temp.hi AS hi, payload.tags[2] AS t2 FROM "t"')
+        row = select_fields(sel, env)
+        assert row == {"hi": 31.5, "t2": "b"}
+
+    def test_case_when_in(self):
+        sql = (
+            "SELECT CASE WHEN qos = 0 THEN 'zero' WHEN qos IN (1, 2) THEN 'up' "
+            "ELSE 'bad' END AS cls FROM \"t\""
+        )
+        f = parse_sql(sql).fields[0][0]
+        assert eval_expr(f, {"qos": 0}) == "zero"
+        assert eval_expr(f, {"qos": 2}) == "up"
+        assert eval_expr(f, {"qos": 9}) == "bad"
+
+    def test_funcs(self):
+        cases = {
+            "SELECT upper(concat('a', 'b')) AS v FROM \"t\"": "AB",
+            "SELECT nth(2, split('a,b,c', ',')) AS v FROM \"t\"": "b",
+            "SELECT nth_topic_level(2, topic) AS v FROM \"t\"": "1",
+            "SELECT topic_match(topic, 't/+') AS v FROM \"t\"": True,
+            "SELECT coalesce(nope, 'd') AS v FROM \"t\"": "d",
+            "SELECT strlen('hello') AS v FROM \"t\"": 5,
+            "SELECT regex_extract('v=42;', 'v=(\\d+)') AS v FROM \"t\"": "42",
+            "SELECT map_get('k', json_decode('{\"k\": 3}')) AS v FROM \"t\"": 3,
+        }
+        env = env_for()
+        for sql, want in cases.items():
+            row = select_fields(parse_sql(sql), env)
+            assert row["v"] == want, sql
+
+    def test_template(self):
+        env = {"clientid": "c1", "payload": {"x": 5}}
+        assert render_template("d/${clientid}/${payload.x}", env) == "d/c1/5"
+
+
+class TestEngine:
+    def test_topic_indexed_match(self):
+        eng = RuleEngine()
+        eng.create_rule("r1", 'SELECT * FROM "dev/+/up"')
+        eng.create_rule("r2", 'SELECT * FROM "dev/#"')
+        eng.create_rule("r3", 'SELECT * FROM "other"')
+        got = {r.id for r in eng.match_rules("dev/1/up")}
+        assert got == {"r1", "r2"}
+        assert [
+            {r.id for r in rs} for rs in eng.match_rules_batch(["dev/1/up", "other"])
+        ] == [{"r1", "r2"}, {"r3"}]
+        eng.delete_rule("r2")
+        assert {r.id for r in eng.match_rules("dev/1/up")} == {"r1"}
+
+    def test_disabled_rule_skipped(self):
+        eng = RuleEngine()
+        r = eng.create_rule("r1", 'SELECT * FROM "t"', enable=False)
+        assert eng.match_rules("t") == []
+        eng.update_rule("r1", enable=True)
+        assert [x.id for x in eng.match_rules("t")] == ["r1"]
+
+    def test_apply_and_metrics(self):
+        eng = RuleEngine()
+        hits = []
+        r = eng.create_rule(
+            "r1",
+            'SELECT payload.v AS v FROM "t" WHERE payload.v > 10',
+            actions=[{"function": lambda row, env: hits.append(row)}],
+        )
+        eng.on_message_publish(Message(topic="t", payload=b'{"v": 42}'))
+        eng.on_message_publish(Message(topic="t", payload=b'{"v": 1}'))
+        assert hits == [{"v": 42}]
+        assert r.metrics.matched == 2 and r.metrics.passed == 1
+        assert r.metrics.no_result == 1 and r.metrics.actions_success == 1
+
+    def test_foreach_rows(self):
+        eng = RuleEngine()
+        rows = []
+        eng.create_rule(
+            "r1",
+            'FOREACH payload.sensors AS s DO s.name AS name, s.v AS v FROM "t" '
+            "INCASE s.v > 0",
+            actions=[{"function": lambda row, env: rows.append(row)}],
+        )
+        eng.on_message_publish(
+            Message(
+                topic="t",
+                payload=json.dumps(
+                    {"sensors": [{"name": "a", "v": 1}, {"name": "b", "v": -1}, {"name": "c", "v": 2}]}
+                ).encode(),
+            )
+        )
+        assert rows == [{"name": "a", "v": 1}, {"name": "c", "v": 2}]
+
+    def test_republish_through_broker(self):
+        broker = Broker()
+        eng = RuleEngine(broker=broker)
+        eng.install(broker.hooks)
+        eng.create_rule(
+            "fwd",
+            'SELECT * FROM "up/#" WHERE qos = 0',
+            actions=[
+                {
+                    "function": "republish",
+                    "args": {"topic": "fanout/${clientid}", "payload": "${payload}", "qos": 0},
+                }
+            ],
+        )
+        sess, _ = broker.open_session("watcher", True)
+        got = []
+        sess.outgoing_sink = lambda pkts: got.extend(pkts)
+        broker.subscribe(sess, "fanout/#", SubOpts(qos=0))
+        broker.publish(Message(topic="up/1", payload=b"ping", from_client="dev9"))
+        assert len(got) == 1
+        assert got[0].topic == "fanout/dev9" and got[0].payload == b"ping"
+
+    def test_event_rules(self):
+        from emqx_tpu.rules.events import client_event
+
+        eng = RuleEngine()
+        seen = []
+        eng.create_rule(
+            "conn",
+            'SELECT clientid FROM "$events/client_connected"',
+            actions=[{"function": lambda row, env: seen.append(row["clientid"])}],
+        )
+        eng.on_event(
+            "$events/client_connected", client_event("client_connected", "c42")
+        )
+        assert seen == ["c42"]
+
+    def test_sys_topic_ignored(self):
+        eng = RuleEngine(ignore_sys=True)
+        hits = []
+        eng.create_rule(
+            "r",
+            'SELECT * FROM "#"',
+            actions=[{"function": lambda row, env: hits.append(1)}],
+        )
+        eng.on_message_publish(Message(topic="$SYS/brokers", payload=b""))
+        assert hits == []
+
+    def test_sqltester(self):
+        eng = RuleEngine()
+        row = eng.test_sql(
+            'SELECT payload.x + 1 AS y FROM "t"', env_for(payload=b'{"x": 1}')
+        )
+        assert row == {"y": 2}
+        assert (
+            eng.test_sql('SELECT * FROM "t" WHERE false', env_for()) is None
+        )
