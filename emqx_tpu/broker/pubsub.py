@@ -51,6 +51,17 @@ class Broker:
         self.sessions: Dict[str, Session] = {}
         # (filter, client) subopts — mirror of ?SUBOPTION
         self.suboptions: Dict[Tuple[str, str], SubOpts] = {}
+        # durable-session manager (emqx_persistent_session_ds seam);
+        # attach with enable_durable()
+        self.durable = None
+
+    def enable_durable(self, manager) -> None:
+        """Wire a DurableSessionManager: installs the persist gate and
+        routes qualifying sessions through DS (emqx_broker.erl:294,
+        300-311 persist path)."""
+        self.durable = manager
+        manager.broker = self
+        manager.install(self.hooks)
 
     # --- session registry (emqx_cm-lite) --------------------------------
 
@@ -58,7 +69,25 @@ class Broker:
         self, client_id: str, clean_start: bool, cfg=None
     ) -> Tuple[Session, bool]:
         """Returns (session, session_present). Clean start discards
-        (emqx_cm:open_session:285-304)."""
+        (emqx_cm:open_session:285-304). Sessions with a nonzero expiry
+        become durable when a DS manager is attached."""
+        if (
+            self.durable is not None
+            and cfg is not None
+            and cfg.session_expiry_interval > 0
+        ):
+            # an existing LIVE session under this id must be torn down
+            # first or its routes leak and deliveries double up
+            prev = self.sessions.get(client_id)
+            if prev is not None and not self._is_durable(prev):
+                self.close_session(prev, discard=True)
+            session, present = self.durable.open_session(client_id, clean_start, cfg)
+            self.sessions[client_id] = session
+            self.stats.set("sessions.count", len(self.sessions))
+            self.hooks.run(
+                "session.resumed" if present else "session.created", client_id
+            )
+            return session, present
         old = self.sessions.get(client_id)
         if clean_start or old is None or old.expired():
             if old is not None:
@@ -74,6 +103,21 @@ class Broker:
 
     def close_session(self, session: Session, discard: bool = False) -> None:
         """Drop a session and all its routes (emqx_broker:subscriber_down)."""
+        if self.durable is not None and self._is_durable(session):
+            # shared-group routes live in the live router — release them
+            for flt in list(session.subscriptions):
+                if topic_mod.parse_share(flt)[0] is not None:
+                    self._unsubscribe_route(session.client_id, flt)
+                self.suboptions.pop((flt, session.client_id), None)
+            self.durable.discard_session(session.client_id)
+            self.sessions.pop(session.client_id, None)
+            self.stats.set("sessions.count", len(self.sessions))
+            self.stats.set("subscriptions.count", len(self.suboptions))
+            self.hooks.run(
+                "session.discarded" if discard else "session.terminated",
+                session.client_id,
+            )
+            return
         for flt in list(session.subscriptions):
             self._unsubscribe_route(session.client_id, flt)
         session.subscriptions.clear()
@@ -93,6 +137,16 @@ class Broker:
         deliver (per retain_handling)."""
         group, real = topic_mod.parse_share(flt)
         topic_mod.validate_filter(real)
+        # durable sessions route through the ps-router + DS scheduler,
+        # never the live router (emqx_persistent_session_ds model)
+        if self.durable is not None and self._is_durable(session) and group is None:
+            existed = self.durable.subscribe(session, flt, opts)
+            self.suboptions[(flt, session.client_id)] = opts
+            self.stats.set("subscriptions.count", len(self.suboptions))
+            self.hooks.run("session.subscribed", session.client_id, flt, opts)
+            if opts.retain_handling == 2 or (opts.retain_handling == 1 and existed):
+                return []
+            return self.retainer.read(real)
         existed = flt in session.subscriptions
         session.subscriptions[flt] = opts
         self.suboptions[(flt, session.client_id)] = opts
@@ -113,12 +167,27 @@ class Broker:
     def unsubscribe(self, session: Session, flt: str) -> bool:
         if flt not in session.subscriptions:
             return False
+        # shared subs always live in the live router, even for durable
+        # sessions (the durable subscribe branch requires group None)
+        is_shared = topic_mod.parse_share(flt)[0] is not None
+        if self.durable is not None and self._is_durable(session) and not is_shared:
+            self.durable.unsubscribe(session, flt)
+            self.suboptions.pop((flt, session.client_id), None)
+            self.stats.set("subscriptions.count", len(self.suboptions))
+            self.hooks.run("session.unsubscribed", session.client_id, flt)
+            return True
         del session.subscriptions[flt]
         self.suboptions.pop((flt, session.client_id), None)
         self._unsubscribe_route(session.client_id, flt)
         self.stats.set("subscriptions.count", len(self.suboptions))
         self.hooks.run("session.unsubscribed", session.client_id, flt)
         return True
+
+    @staticmethod
+    def _is_durable(session: Session) -> bool:
+        from ..ds.session_ds import DurableSession
+
+        return isinstance(session, DurableSession)
 
     def _unsubscribe_route(self, client_id: str, flt: str) -> None:
         group, real = topic_mod.parse_share(flt)
@@ -173,8 +242,11 @@ class Broker:
             else:
                 n += self._deliver_to(dest, None, msg)
         if n == 0:
-            self.metrics.inc("messages.dropped.no_subscribers")
-            self.hooks.run("message.dropped", msg, "no_subscribers")
+            # a durable-only audience isn't a drop: the persist gate
+            # stored the message and the DS pump will deliver it
+            if self.durable is None or not self.durable.needs_persist(msg.topic):
+                self.metrics.inc("messages.dropped.no_subscribers")
+                self.hooks.run("message.dropped", msg, "no_subscribers")
         else:
             self.metrics.inc("messages.delivered", n)
         return n

@@ -68,6 +68,9 @@ class Connection:
         sess = self.channel.session
         if sess is not None:
             sess.outgoing_sink = self._send_packets
+            # background producers (DS pump) must hop onto this loop
+            # before touching the session or transport
+            sess.event_loop = asyncio.get_running_loop()
 
     def _send_packets(self, pkts) -> None:
         try:

@@ -1,0 +1,10 @@
+from .api import Db, close_db, open_db
+from .kvstore import NativeKv, PyKv, open_kv
+from .lts import LtsTrie, varying_match
+from .storage import (
+    DsIterator,
+    StorageLayer,
+    Stream,
+    deserialize_message,
+    serialize_message,
+)

@@ -1,0 +1,63 @@
+"""Per-shard write batching — emqx_ds_buffer analog.
+
+Accumulates messages per shard and flushes on size or age, from a
+single background thread (the reference runs one buffer process per
+shard; one thread suffices here since flush fans out per shard).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List
+
+
+class DsBuffer:
+    def __init__(
+        self,
+        n_shards: int,
+        flush: Callable[[int, List], None],
+        flush_interval_ms: int = 10,
+        max_items: int = 500,
+    ):
+        self.flush_cb = flush
+        self.flush_interval = flush_interval_ms / 1000.0
+        self.max_items = max_items
+        self._pending: Dict[int, List] = {i: [] for i in range(n_shards)}
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop = False
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def push(self, shard: int, item) -> None:
+        kick = False
+        with self._lock:
+            q = self._pending[shard]
+            q.append(item)
+            if len(q) >= self.max_items:
+                kick = True
+        if kick:
+            self._wake.set()
+
+    def flush_now(self) -> None:
+        with self._lock:
+            batches = {s: q for s, q in self._pending.items() if q}
+            for s in batches:
+                self._pending[s] = []
+        for s, q in batches.items():
+            self.flush_cb(s, q)
+
+    def _run(self) -> None:
+        while not self._stop:
+            self._wake.wait(self.flush_interval)
+            self._wake.clear()
+            if self._stop:
+                break
+            self.flush_now()
+
+    def close(self) -> None:
+        self._stop = True
+        self._wake.set()
+        self._thread.join(timeout=2)
+        self.flush_now()

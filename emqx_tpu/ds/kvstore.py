@@ -1,0 +1,253 @@
+"""Ordered KV store binding — native C++ engine with Python fallback.
+
+The durable-storage layer's bottom tier, standing where the reference
+keeps rocksdb behind a NIF (emqx_ds_storage_layer.erl:140,252,282-294
+→ erlang-rocksdb dep). Primary implementation is native/kvlog.cc
+(WAL + ordered memtable) loaded via ctypes; `PyKv` is the pure-Python
+equivalent (same WAL format) used where the shared lib isn't built.
+
+API (both impls): put/get/delete bytes keys/values, ordered range
+scan(start, end, limit), flush (fsync boundary), compact, close.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import threading
+from typing import Dict, Iterator, List, Optional, Tuple
+
+_LIB_PATHS = [
+    os.path.join(os.path.dirname(__file__), "..", "..", "native", "libemqxkv.so"),
+    os.path.join(os.path.dirname(__file__), "libemqxkv.so"),
+]
+
+_TOMBSTONE = 0xFFFFFFFF
+
+
+def _load_lib() -> Optional[ctypes.CDLL]:
+    for p in _LIB_PATHS:
+        p = os.path.abspath(p)
+        if os.path.exists(p):
+            try:
+                lib = ctypes.CDLL(p)
+            except OSError:
+                continue
+            lib.kv_open.restype = ctypes.c_void_p
+            lib.kv_open.argtypes = [ctypes.c_char_p]
+            lib.kv_put.restype = ctypes.c_int
+            lib.kv_put.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+                ctypes.c_char_p, ctypes.c_uint32,
+            ]
+            lib.kv_delete.restype = ctypes.c_int
+            lib.kv_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32]
+            lib.kv_get.restype = ctypes.c_int64
+            lib.kv_get.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+                ctypes.POINTER(ctypes.c_char_p),
+            ]
+            lib.kv_count.restype = ctypes.c_uint64
+            lib.kv_count.argtypes = [ctypes.c_void_p]
+            lib.kv_scan.restype = ctypes.c_void_p
+            lib.kv_scan.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+                ctypes.c_char_p, ctypes.c_uint32, ctypes.c_uint64,
+            ]
+            lib.kv_iter_next.restype = ctypes.c_int
+            lib.kv_iter_next.argtypes = [
+                ctypes.c_void_p,
+                ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_uint64),
+            ]
+            lib.kv_iter_free.argtypes = [ctypes.c_void_p]
+            lib.kv_flush.restype = ctypes.c_int
+            lib.kv_flush.argtypes = [ctypes.c_void_p]
+            lib.kv_compact.restype = ctypes.c_int
+            lib.kv_compact.argtypes = [ctypes.c_void_p]
+            lib.kv_wal_records.restype = ctypes.c_uint64
+            lib.kv_wal_records.argtypes = [ctypes.c_void_p]
+            lib.kv_close.argtypes = [ctypes.c_void_p]
+            return lib
+    return None
+
+
+_LIB = _load_lib()
+
+
+class KvError(IOError):
+    pass
+
+
+class NativeKv:
+    """ctypes wrapper over native/kvlog.cc."""
+
+    def __init__(self, path: str):
+        if _LIB is None:
+            raise KvError("libemqxkv.so not built (make -C native)")
+        self._h = _LIB.kv_open(path.encode())
+        if not self._h:
+            raise KvError(f"kv_open failed: {path}")
+        self.path = path
+
+    def put(self, key: bytes, val: bytes) -> None:
+        if _LIB.kv_put(self._h, key, len(key), val, len(val)) != 0:
+            raise KvError("kv_put failed")
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        out = ctypes.c_char_p()
+        n = _LIB.kv_get(self._h, key, len(key), ctypes.byref(out))
+        if n < 0:
+            return None
+        return ctypes.string_at(out, n)
+
+    def delete(self, key: bytes) -> None:
+        if _LIB.kv_delete(self._h, key, len(key)) != 0:
+            raise KvError("kv_delete failed")
+
+    def scan(
+        self, start: bytes = b"", end: bytes = b"", limit: int = 0
+    ) -> Iterator[Tuple[bytes, bytes]]:
+        it = _LIB.kv_scan(self._h, start, len(start), end, len(end), limit)
+        try:
+            k = ctypes.c_char_p()
+            kl = ctypes.c_uint64()
+            v = ctypes.c_char_p()
+            vl = ctypes.c_uint64()
+            while (
+                _LIB.kv_iter_next(
+                    it, ctypes.byref(k), ctypes.byref(kl), ctypes.byref(v), ctypes.byref(vl)
+                )
+                == 0
+            ):
+                yield ctypes.string_at(k, kl.value), ctypes.string_at(v, vl.value)
+        finally:
+            _LIB.kv_iter_free(it)
+
+    def count(self) -> int:
+        return _LIB.kv_count(self._h)
+
+    def wal_records(self) -> int:
+        return _LIB.kv_wal_records(self._h)
+
+    def flush(self) -> None:
+        if _LIB.kv_flush(self._h) != 0:
+            raise KvError("kv_flush failed")
+
+    def compact(self) -> None:
+        if _LIB.kv_compact(self._h) != 0:
+            raise KvError("kv_compact failed")
+
+    def close(self) -> None:
+        if self._h:
+            _LIB.kv_close(self._h)
+            self._h = None
+
+
+class PyKv:
+    """Pure-Python engine, same WAL format as kvlog.cc."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._table: Dict[bytes, bytes] = {}
+        self._lock = threading.Lock()
+        self._wal_records = 0
+        self._replay()
+        self._wal = open(path, "ab")
+
+    def _replay(self) -> None:
+        if not os.path.exists(self.path):
+            return
+        good = 0  # offset after the last intact record
+        with open(self.path, "rb") as f:
+            while True:
+                hdr = f.read(8)
+                if len(hdr) < 8:
+                    break
+                klen, vlen = struct.unpack("<II", hdr)
+                key = f.read(klen)
+                if len(key) < klen:
+                    break
+                if vlen == _TOMBSTONE:
+                    self._table.pop(key, None)
+                    self._wal_records += 1
+                    good = f.tell()
+                    continue
+                val = f.read(vlen)
+                if len(val) < vlen:
+                    break
+                self._table[key] = val
+                self._wal_records += 1
+                good = f.tell()
+        # a torn tail (crash mid-append) must be cut, or new appends
+        # land after garbage and corrupt every later replay
+        if good < os.path.getsize(self.path):
+            with open(self.path, "r+b") as f:
+                f.truncate(good)
+
+    def put(self, key: bytes, val: bytes) -> None:
+        with self._lock:
+            self._wal.write(struct.pack("<II", len(key), len(val)) + key + val)
+            self._table[key] = val
+            self._wal_records += 1
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        with self._lock:
+            return self._table.get(key)
+
+    def delete(self, key: bytes) -> None:
+        with self._lock:
+            self._wal.write(struct.pack("<II", len(key), _TOMBSTONE) + key)
+            self._table.pop(key, None)
+            self._wal_records += 1
+
+    def scan(
+        self, start: bytes = b"", end: bytes = b"", limit: int = 0
+    ) -> Iterator[Tuple[bytes, bytes]]:
+        with self._lock:
+            keys = sorted(
+                k for k in self._table if k >= start and (not end or k < end)
+            )
+            if limit:
+                keys = keys[:limit]
+            items = [(k, self._table[k]) for k in keys]
+        yield from items
+
+    def count(self) -> int:
+        return len(self._table)
+
+    def wal_records(self) -> int:
+        return self._wal_records
+
+    def flush(self) -> None:
+        with self._lock:
+            self._wal.flush()
+            os.fsync(self._wal.fileno())
+
+    def compact(self) -> None:
+        with self._lock:
+            tmp = self.path + ".compact"
+            with open(tmp, "wb") as f:
+                for k in sorted(self._table):
+                    v = self._table[k]
+                    f.write(struct.pack("<II", len(k), len(v)) + k + v)
+                f.flush()
+                os.fsync(f.fileno())
+            self._wal.close()
+            os.replace(tmp, self.path)
+            self._wal = open(self.path, "ab")
+            self._wal_records = len(self._table)
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._wal.closed:
+                self._wal.flush()
+                self._wal.close()
+
+
+def open_kv(path: str, prefer_native: bool = True):
+    """Open an ordered KV store at `path`, native engine when built."""
+    if prefer_native and _LIB is not None:
+        return NativeKv(path)
+    return PyKv(path)

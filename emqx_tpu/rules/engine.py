@@ -272,12 +272,18 @@ class RuleEngine:
     # --- matching -------------------------------------------------------
 
     def match_rules(self, topic: str) -> List[Rule]:
-        ids = self._index.match(topic_mod.words(topic))
-        return [
-            self.rules[rid]
-            for rid, _f in ids
-            if rid in self.rules and self.rules[rid].enable
-        ]
+        # a rule with several FROM filters matching the same topic
+        # still fires once (the reference dedups by rule id)
+        seen = set()
+        out = []
+        for rid, _f in self._index.match(topic_mod.words(topic)):
+            if rid in seen or rid not in self.rules:
+                continue
+            seen.add(rid)
+            rule = self.rules[rid]
+            if rule.enable:
+                out.append(rule)
+        return out
 
     def match_rules_batch(self, topics: Sequence[str]) -> List[List[Rule]]:
         """Batch-shaped API so the broker's device dispatch can carry
@@ -375,7 +381,7 @@ class RuleEngine:
                 payload=payload.encode() if isinstance(payload, str) else payload,
                 qos=qos,
                 retain=bool(args.get("retain", False)),
-                from_client=f"rule:{action.get('rule_id', '')}",
+                from_client=f"rule:{action.get('_rule_id', '')}",
             )
             # loop guards: a rule never re-triggers itself, and chains
             # across rules are depth-capped (the reference marks

@@ -1,0 +1,245 @@
+// kvlog — ordered KV store: WAL + memtable, C ABI for ctypes.
+//
+// The native storage engine backing the durable-storage layer, the
+// TPU-era stand-in for the reference's rocksdb NIF
+// (erlang-rocksdb, used by emqx_ds_storage_layer.erl:140,252,282-294).
+// Design: append-only write-ahead log on disk, replayed into an
+// ordered in-memory table (std::map) on open; puts/deletes append a
+// record then apply; `compact` rewrites the log to the live set;
+// range scans walk the ordered map. Durability boundary = kv_flush
+// (fflush+fsync), called by the storage layer at batch boundaries —
+// the same contract the reference gets from rocksdb WAL.
+//
+// Record format, little-endian:
+//   [u32 klen][u32 vlen][key bytes][val bytes]   vlen==0xFFFFFFFF → tombstone
+//
+// C ABI kept minimal and allocation-disciplined: kv_get copies into a
+// store-owned scratch buffer valid until the next call on the same
+// handle from the same thread is fine for our single-Python-thread use.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#ifdef _WIN32
+#define EXPORT extern "C" __declspec(dllexport)
+#else
+#define EXPORT extern "C" __attribute__((visibility("default")))
+#include <unistd.h>
+#endif
+
+namespace {
+
+constexpr uint32_t kTombstone = 0xFFFFFFFFu;
+
+struct Store {
+  std::map<std::string, std::string> table;
+  FILE* wal = nullptr;
+  std::string path;
+  std::mutex mu;
+  std::string scratch;  // get() result buffer
+  uint64_t wal_records = 0;
+};
+
+bool append_record(FILE* f, const char* k, uint32_t klen, const char* v,
+                   uint32_t vlen_field, uint32_t vlen_real) {
+  if (fwrite(&klen, 4, 1, f) != 1) return false;
+  if (fwrite(&vlen_field, 4, 1, f) != 1) return false;
+  if (klen && fwrite(k, 1, klen, f) != klen) return false;
+  if (vlen_real && fwrite(v, 1, vlen_real, f) != vlen_real) return false;
+  return true;
+}
+
+bool replay(Store* s) {
+  FILE* f = fopen(s->path.c_str(), "rb");
+  if (!f) return true;  // fresh store
+  std::vector<char> kbuf, vbuf;
+  long good = 0;  // offset after the last intact record
+  for (;;) {
+    uint32_t klen, vlen;
+    if (fread(&klen, 4, 1, f) != 1) break;  // clean EOF or torn header
+    if (fread(&vlen, 4, 1, f) != 1) break;
+    kbuf.resize(klen);
+    if (klen && fread(kbuf.data(), 1, klen, f) != klen) break;  // torn tail
+    std::string key(kbuf.data(), klen);
+    if (vlen == kTombstone) {
+      s->table.erase(key);
+      s->wal_records++;
+      good = ftell(f);
+      continue;
+    }
+    vbuf.resize(vlen);
+    if (vlen && fread(vbuf.data(), 1, vlen, f) != vlen) break;
+    s->table[std::move(key)] = std::string(vbuf.data(), vlen);
+    s->wal_records++;
+    good = ftell(f);
+  }
+  // cut a torn tail so future appends don't land after garbage
+  fseek(f, 0, SEEK_END);
+  long size = ftell(f);
+  fclose(f);
+  if (good < size) {
+#ifndef _WIN32
+    if (truncate(s->path.c_str(), good) != 0) return false;
+#endif
+  }
+  return true;
+}
+
+}  // namespace
+
+EXPORT void* kv_open(const char* path) {
+  auto* s = new Store();
+  s->path = path;
+  if (!replay(s)) {
+    delete s;
+    return nullptr;
+  }
+  s->wal = fopen(path, "ab");
+  if (!s->wal) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+EXPORT int kv_put(void* h, const char* k, uint32_t klen, const char* v,
+                  uint32_t vlen) {
+  auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  if (!append_record(s->wal, k, klen, v, vlen, vlen)) return -1;
+  s->table[std::string(k, klen)] = std::string(v, vlen);
+  s->wal_records++;
+  return 0;
+}
+
+EXPORT int kv_delete(void* h, const char* k, uint32_t klen) {
+  auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  if (!append_record(s->wal, k, klen, nullptr, kTombstone, 0)) return -1;
+  s->table.erase(std::string(k, klen));
+  s->wal_records++;
+  return 0;
+}
+
+// Returns value length, or -1 if missing. *out points at store-owned
+// memory valid until the next mutating call.
+EXPORT int64_t kv_get(void* h, const char* k, uint32_t klen,
+                      const char** out) {
+  auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  auto it = s->table.find(std::string(k, klen));
+  if (it == s->table.end()) return -1;
+  s->scratch = it->second;
+  *out = s->scratch.data();
+  return static_cast<int64_t>(s->scratch.size());
+}
+
+EXPORT uint64_t kv_count(void* h) {
+  auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  return s->table.size();
+}
+
+// --- range scan ---------------------------------------------------------
+// Iterator over [start, end); end empty = to the end of the keyspace.
+// Snapshot semantics: the iterator copies matching keys at creation
+// (cheap relative to message payloads; isolates scans from writers).
+
+struct Iter {
+  std::vector<std::pair<std::string, std::string>> items;
+  size_t pos = 0;
+};
+
+EXPORT void* kv_scan(void* h, const char* start, uint32_t slen,
+                     const char* end, uint32_t elen, uint64_t limit) {
+  auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  auto* it = new Iter();
+  std::string sk(start, slen), ek(end, elen);
+  auto lo = s->table.lower_bound(sk);
+  auto hi = elen ? s->table.lower_bound(ek) : s->table.end();
+  for (auto p = lo; p != hi; ++p) {
+    if (limit && it->items.size() >= limit) break;
+    it->items.emplace_back(p->first, p->second);
+  }
+  return it;
+}
+
+// Fills key/val pointers; returns 0 on ok, -1 when exhausted. Pointers
+// are owned by the iterator, valid until kv_iter_free.
+EXPORT int kv_iter_next(void* ih, const char** k, uint64_t* klen,
+                        const char** v, uint64_t* vlen) {
+  auto* it = static_cast<Iter*>(ih);
+  if (it->pos >= it->items.size()) return -1;
+  auto& kv = it->items[it->pos++];
+  *k = kv.first.data();
+  *klen = kv.first.size();
+  *v = kv.second.data();
+  *vlen = kv.second.size();
+  return 0;
+}
+
+EXPORT void kv_iter_free(void* ih) { delete static_cast<Iter*>(ih); }
+
+EXPORT int kv_flush(void* h) {
+  auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  if (fflush(s->wal) != 0) return -1;
+#ifndef _WIN32
+  if (fsync(fileno(s->wal)) != 0) return -1;
+#endif
+  return 0;
+}
+
+// Rewrite the WAL to contain only the live table (GC of tombstones and
+// overwrites) — the rocksdb-compaction analog.
+EXPORT int kv_compact(void* h) {
+  auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  std::string tmp = s->path + ".compact";
+  FILE* f = fopen(tmp.c_str(), "wb");
+  if (!f) return -1;
+  for (auto& kv : s->table) {
+    if (!append_record(f, kv.first.data(),
+                       static_cast<uint32_t>(kv.first.size()),
+                       kv.second.data(),
+                       static_cast<uint32_t>(kv.second.size()),
+                       static_cast<uint32_t>(kv.second.size()))) {
+      fclose(f);
+      return -1;
+    }
+  }
+  if (fflush(f) != 0) { fclose(f); return -1; }
+#ifndef _WIN32
+  fsync(fileno(f));
+#endif
+  fclose(f);
+  fclose(s->wal);
+  if (rename(tmp.c_str(), s->path.c_str()) != 0) return -1;
+  s->wal = fopen(s->path.c_str(), "ab");
+  s->wal_records = s->table.size();
+  return s->wal ? 0 : -1;
+}
+
+EXPORT uint64_t kv_wal_records(void* h) {
+  auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  return s->wal_records;
+}
+
+EXPORT void kv_close(void* h) {
+  auto* s = static_cast<Store*>(h);
+  {
+    std::lock_guard<std::mutex> g(s->mu);
+    if (s->wal) {
+      fflush(s->wal);
+      fclose(s->wal);
+    }
+  }
+  delete s;
+}

@@ -1,0 +1,274 @@
+"""Durable storage: KV engines (native + Python), LTS trie, storage
+layer streams/iterators, generations, DS facade."""
+
+import os
+import struct
+
+import pytest
+
+from emqx_tpu.broker.message import Message
+from emqx_tpu.ds import Db, LtsTrie, varying_match
+from emqx_tpu.ds.kvstore import _LIB, NativeKv, PyKv
+from emqx_tpu.ds.storage import deserialize_message, serialize_message
+
+
+def kv_impls():
+    impls = [PyKv]
+    if _LIB is not None:
+        impls.append(NativeKv)
+    return impls
+
+
+@pytest.mark.parametrize("impl", kv_impls())
+class TestKv:
+    def test_put_get_delete(self, impl, tmp_path):
+        kv = impl(str(tmp_path / "t.kv"))
+        kv.put(b"a", b"1")
+        kv.put(b"b", b"2" * 1000)
+        assert kv.get(b"a") == b"1"
+        assert kv.get(b"b") == b"2" * 1000
+        assert kv.get(b"c") is None
+        kv.delete(b"a")
+        assert kv.get(b"a") is None
+        assert kv.count() == 1
+        kv.close()
+
+    def test_replay_after_reopen(self, impl, tmp_path):
+        p = str(tmp_path / "t.kv")
+        kv = impl(p)
+        for i in range(100):
+            kv.put(b"k%03d" % i, b"v%d" % i)
+        kv.delete(b"k050")
+        kv.flush()
+        kv.close()
+        kv2 = impl(p)
+        assert kv2.count() == 99
+        assert kv2.get(b"k007") == b"v7"
+        assert kv2.get(b"k050") is None
+        kv2.close()
+
+    def test_ordered_scan(self, impl, tmp_path):
+        kv = impl(str(tmp_path / "t.kv"))
+        for i in (5, 1, 9, 3, 7):
+            kv.put(struct.pack(">I", i), b"%d" % i)
+        keys = [struct.unpack(">I", k)[0] for k, _ in kv.scan()]
+        assert keys == [1, 3, 5, 7, 9]
+        rng = [
+            struct.unpack(">I", k)[0]
+            for k, _ in kv.scan(struct.pack(">I", 3), struct.pack(">I", 8))
+        ]
+        assert rng == [3, 5, 7]
+        lim = list(kv.scan(limit=2))
+        assert len(lim) == 2
+        kv.close()
+
+    def test_compact_shrinks_wal(self, impl, tmp_path):
+        p = str(tmp_path / "t.kv")
+        kv = impl(p)
+        for i in range(50):
+            kv.put(b"same", b"v%d" % i)
+        assert kv.wal_records() == 50
+        kv.compact()
+        assert kv.wal_records() == 1
+        assert kv.get(b"same") == b"v49"
+        kv.close()
+        kv2 = impl(p)
+        assert kv2.get(b"same") == b"v49"
+        kv2.close()
+
+    def test_torn_tail_tolerated(self, impl, tmp_path):
+        p = str(tmp_path / "t.kv")
+        kv = impl(p)
+        kv.put(b"good", b"1")
+        kv.flush()
+        kv.close()
+        with open(p, "ab") as f:
+            f.write(struct.pack("<II", 100, 100) + b"partial")  # torn record
+        kv2 = impl(p)
+        assert kv2.get(b"good") == b"1"
+        kv2.close()
+
+
+def test_native_lib_is_built():
+    assert _LIB is not None, "native/libemqxkv.so must build (make -C native)"
+
+
+class TestLts:
+    def test_low_cardinality_stays_static(self):
+        t = LtsTrie(threshold=5)
+        k1, v1 = t.topic_key(["cfg", "node", "a"])
+        k2, v2 = t.topic_key(["cfg", "node", "b"])
+        assert k1 != k2 and v1 == [] and v2 == []
+        # same topic → same key
+        assert t.topic_key(["cfg", "node", "a"])[0] == k1
+
+    def test_high_cardinality_learns_plus(self):
+        t = LtsTrie(threshold=3)
+        keys = set()
+        for i in range(10):
+            k, varying = t.topic_key(["dev", f"d{i}", "temp"])
+            keys.add(k)
+            if i >= 3:
+                assert varying == [f"d{i}"]
+        # first 3 got static paths; the rest share one '+' path
+        assert len(keys) == 4
+
+    def test_match_filter_constraints(self):
+        t = LtsTrie(threshold=2)
+        for i in range(6):
+            t.topic_key(["dev", f"d{i}", "temp"])
+        # exact device under the '+' edge → constraint pins varying
+        ms = t.match_filter(["dev", "d5", "temp"])
+        assert any(c == ["d5"] for _k, c in ms)
+        # '+' filter matches static and varying branches unconstrained
+        ms2 = t.match_filter(["dev", "+", "temp"])
+        assert len(ms2) >= 3
+        # '#' collects everything under dev
+        ms3 = t.match_filter(["dev", "#"])
+        assert len(ms3) >= len(ms2)
+
+    def test_dump_load_stable_keys(self):
+        t = LtsTrie(threshold=2)
+        ks = [t.topic_key(["a", f"x{i}", "y"])[0] for i in range(5)]
+        t2 = LtsTrie.load(t.dump())
+        ks2 = [t2.topic_key(["a", f"x{i}", "y"])[0] for i in range(5)]
+        assert ks == ks2
+
+    def test_varying_match(self):
+        assert varying_match(["d1", "t"], ["+", "t"])
+        assert varying_match(["d1"], ["d1"])
+        assert not varying_match(["d2"], ["d1"])
+        assert varying_match(["d1", "extra"], ["d1"])  # '#' tail
+
+
+class TestSerializer:
+    def test_roundtrip(self):
+        m = Message(
+            topic="a/b/c",
+            payload=b"\x00\x01bin",
+            qos=1,
+            retain=True,
+            from_client="c9",
+            props={"content_type": "x"},
+        )
+        m2, varying = deserialize_message(serialize_message(m, ["b"]))
+        assert varying == ["b"]
+        assert (m2.topic, m2.payload, m2.qos, m2.retain, m2.from_client) == (
+            "a/b/c", b"\x00\x01bin", 1, True, "c9",
+        )
+        assert m2.props == {"content_type": "x"}
+        assert m2.id == m.id
+
+
+class TestDb:
+    def _mk(self, tmp_path, **kw):
+        return Db("messages", data_dir=str(tmp_path), n_shards=2, **kw)
+
+    def test_store_and_replay(self, tmp_path):
+        db = self._mk(tmp_path)
+        msgs = [
+            Message(topic=f"dev/d{i}/up", payload=b"%d" % i, from_client=f"c{i % 3}")
+            for i in range(20)
+        ]
+        db.store_batch(msgs)
+        streams = db.get_streams("dev/+/up")
+        assert streams
+        got = []
+        for s in streams:
+            it = db.make_iterator(s, "dev/+/up")
+            while True:
+                it, batch = db.next(it, batch_size=7)
+                if not batch:
+                    break
+                got.extend(batch)
+        assert sorted(m.payload for m in got) == sorted(b"%d" % i for i in range(20))
+        db.close()
+
+    def test_filter_selectivity(self, tmp_path):
+        db = self._mk(tmp_path)
+        db.store_batch(
+            [Message(topic=f"dev/d{i}/up", payload=b"x", from_client="c") for i in range(50)]
+            + [Message(topic="other/t", payload=b"y", from_client="c")]
+        )
+        got = []
+        for s in db.get_streams("dev/d7/up"):
+            it = db.make_iterator(s, "dev/d7/up")
+            it, batch = db.next(it, batch_size=100)
+            got.extend(batch)
+        assert len(got) == 1 and got[0].topic == "dev/d7/up"
+        db.close()
+
+    def test_iterator_resume(self, tmp_path):
+        db = self._mk(tmp_path)
+        db.store_batch(
+            [Message(topic="t/x", payload=b"%d" % i, from_client="c") for i in range(10)]
+        )
+        (s,) = db.get_streams("t/x")
+        it = db.make_iterator(s, "t/x")
+        it, b1 = db.next(it, batch_size=4)
+        it, b2 = db.next(it, batch_size=100)
+        assert len(b1) == 4 and len(b2) == 6
+        # resumed iterator sees nothing new until new data lands
+        it, b3 = db.next(it, batch_size=10)
+        assert b3 == []
+        db.store_batch([Message(topic="t/x", payload=b"new", from_client="c")])
+        it, b4 = db.next(it, batch_size=10)
+        assert [m.payload for m in b4] == [b"new"]
+        db.close()
+
+    def test_durability_across_reopen(self, tmp_path):
+        db = self._mk(tmp_path)
+        db.store_batch(
+            [Message(topic=f"s/{i}/v", payload=b"p%d" % i, from_client="c") for i in range(30)]
+        )
+        db.close()
+        db2 = self._mk(tmp_path)
+        got = []
+        for s in db2.get_streams("s/#"):
+            it = db2.make_iterator(s, "s/#")
+            while True:
+                it, batch = db2.next(it, batch_size=50)
+                if not batch:
+                    break
+                got.extend(batch)
+        assert len(got) == 30
+        db2.close()
+
+    def test_generations(self, tmp_path):
+        db = self._mk(tmp_path)
+        db.store_batch([Message(topic="t/old", payload=b"old", from_client="c")])
+        db.add_generation()
+        db.store_batch([Message(topic="t/new", payload=b"new", from_client="c")])
+        all_msgs = []
+        for s in db.get_streams("t/#"):
+            it = db.make_iterator(s, "t/#")
+            it, batch = db.next(it, batch_size=10)
+            all_msgs.extend(batch)
+        assert {m.payload for m in all_msgs} == {b"old", b"new"}
+        dropped = db.drop_generation(0)
+        assert dropped == 1
+        left = []
+        for s in db.get_streams("t/#"):
+            it = db.make_iterator(s, "t/#")
+            it, batch = db.next(it, batch_size=10)
+            left.extend(batch)
+        assert {m.payload for m in left} == {b"new"}
+        db.close()
+
+    def test_buffered_store_and_poll(self, tmp_path):
+        import threading
+
+        db = self._mk(tmp_path, buffer_flush_ms=5)
+        woke = threading.Event()
+        db.poll(woke.set)
+        for i in range(5):
+            db.store_async(Message(topic="b/t", payload=b"%d" % i, from_client="c"))
+        assert woke.wait(2.0)
+        db.buffer.flush_now()
+        got = []
+        for s in db.get_streams("b/t"):
+            it = db.make_iterator(s, "b/t")
+            it, batch = db.next(it, batch_size=10)
+            got.extend(batch)
+        assert len(got) == 5
+        db.close()

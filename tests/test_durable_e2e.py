@@ -1,0 +1,104 @@
+"""Durable sessions end-to-end: MQTT clients over real sockets with a
+DS-backed broker; messages survive a full broker restart."""
+
+import asyncio
+import contextlib
+
+import pytest
+
+from emqx_tpu.broker.packet import MQTT_V5, Puback, Publish, Type
+from emqx_tpu.broker.pubsub import Broker
+from emqx_tpu.broker.server import Server
+from emqx_tpu.ds import Db
+from emqx_tpu.ds.session_ds import DurableSessionManager
+
+from test_broker_e2e import MiniClient
+
+
+@contextlib.asynccontextmanager
+async def durable_server(tmp_path):
+    db = Db("messages", data_dir=str(tmp_path), n_shards=1, buffer_flush_ms=5)
+    mgr = DurableSessionManager(db, state_dir=str(tmp_path))
+    broker = Broker()
+    broker.enable_durable(mgr)
+    srv = Server(broker=broker, port=0)
+    await srv.start()
+    srv.port = srv._server.sockets[0].getsockname()[1]
+    try:
+        yield srv
+    finally:
+        await srv.stop()
+        mgr.close()
+        db.close()
+
+
+async def test_durable_offline_delivery(tmp_path):
+    async with durable_server(tmp_path) as server:
+        sub = MiniClient(server.port, ver=MQTT_V5)
+        await sub.connect("dur1", props={"session_expiry_interval": 300})
+        await sub.subscribe("iot/#", qos=1)
+        sub.writer.close()  # vanish without DISCONNECT
+        await asyncio.sleep(0.05)
+
+        pub = MiniClient(server.port)
+        await pub.connect("pp")
+        await pub.publish("iot/x", b"while-away", qos=1, pid=3)
+        await pub.expect(Puback)
+        await asyncio.sleep(0.1)  # DS buffer flush
+
+        sub2 = MiniClient(server.port, ver=MQTT_V5)
+        ack = await sub2.connect(
+            "dur1", clean_start=False, props={"session_expiry_interval": 300}
+        )
+        assert ack.session_present
+        m = await sub2.expect(Publish)
+        assert m.topic == "iot/x" and m.payload == b"while-away" and m.qos == 1
+        await sub2.send(Puback(type=Type.PUBACK, packet_id=m.packet_id))
+        for c in (pub, sub2):
+            await c.close()
+
+
+async def test_durable_survives_broker_restart(tmp_path):
+    db = Db("messages", data_dir=str(tmp_path), n_shards=1, buffer_flush_ms=5)
+    mgr = DurableSessionManager(db, state_dir=str(tmp_path))
+    broker = Broker()
+    broker.enable_durable(mgr)
+    srv = Server(broker=broker, port=0)
+    await srv.start()
+    port = srv._server.sockets[0].getsockname()[1]
+
+    sub = MiniClient(port, ver=MQTT_V5)
+    await sub.connect("dur1", props={"session_expiry_interval": 300})
+    await sub.subscribe("keep/#", qos=1)
+    sub.writer.close()
+    await asyncio.sleep(0.05)
+
+    pub = MiniClient(port)
+    await pub.connect("pp")
+    await pub.publish("keep/x", b"precrash", qos=1, pid=9)
+    await pub.expect(Puback)
+    await asyncio.sleep(0.1)
+
+    # hard broker "crash": stop server, drop broker, close manager
+    await srv.stop()
+    mgr.close()
+
+    # new broker process over the same data dir
+    mgr2 = DurableSessionManager(db, state_dir=str(tmp_path))
+    broker2 = Broker()
+    broker2.enable_durable(mgr2)
+    srv2 = Server(broker=broker2, port=0)
+    await srv2.start()
+    port2 = srv2._server.sockets[0].getsockname()[1]
+
+    sub2 = MiniClient(port2, ver=MQTT_V5)
+    ack = await sub2.connect(
+        "dur1", clean_start=False, props={"session_expiry_interval": 300}
+    )
+    assert ack.session_present
+    m = await sub2.expect(Publish)
+    assert m.topic == "keep/x" and m.payload == b"precrash"
+    await sub2.close()
+    await srv2.stop()
+    mgr2.close()
+    db.close()

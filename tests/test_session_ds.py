@@ -1,0 +1,172 @@
+"""Durable sessions: persist gate, stream scheduler, offline replay,
+position commit on ack, restart recovery, GC."""
+
+import time
+
+import pytest
+
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.packet import SubOpts
+from emqx_tpu.broker.pubsub import Broker
+from emqx_tpu.broker.session import SessionConfig
+from emqx_tpu.ds import Db
+from emqx_tpu.ds.session_ds import DurableSessionManager
+
+
+@pytest.fixture
+def mgr(tmp_path):
+    db = Db("messages", data_dir=str(tmp_path), n_shards=2, buffer_flush_ms=5)
+    m = DurableSessionManager(db, state_dir=str(tmp_path))
+    yield m
+    m.close()
+    db.close()
+
+
+def drain_all(mgr, sess):
+    pkts = []
+    for _ in range(20):
+        got = mgr.pump(sess)
+        if not got:
+            break
+        pkts.extend(got)
+    return pkts
+
+
+class TestDurableSession:
+    def test_persist_gate_only_for_routed_topics(self, mgr):
+        s, _ = mgr.open_session("d1", clean_start=True)
+        mgr.subscribe(s, "keep/#", SubOpts(qos=1))
+        assert mgr.needs_persist("keep/x")
+        assert not mgr.needs_persist("other/x")
+
+    def test_offline_store_and_replay(self, mgr):
+        s, _ = mgr.open_session("d1", clean_start=True, cfg=SessionConfig(session_expiry_interval=300))
+        mgr.subscribe(s, "keep/#", SubOpts(qos=1))
+        s.on_disconnect()
+        # messages land in DS while the session is offline
+        mgr.db.store_batch(
+            [Message(topic="keep/a", payload=b"m%d" % i, qos=1, from_client="p") for i in range(5)]
+        )
+        s2, present = mgr.open_session("d1", clean_start=False)
+        assert present and s2 is s
+        pkts = drain_all(mgr, s2)
+        assert [p.payload for p in pkts] == [b"m%d" % i for i in range(5)]
+        assert all(p.qos == 1 for p in pkts)
+
+    def test_subscribe_skips_history(self, mgr):
+        mgr.db.store_batch([Message(topic="h/t", payload=b"old", from_client="p")])
+        s, _ = mgr.open_session("d1", clean_start=True)
+        mgr.subscribe(s, "h/#", SubOpts(qos=0))
+        assert drain_all(mgr, s) == []
+        mgr.db.store_batch([Message(topic="h/t", payload=b"new", from_client="p")])
+        pkts = drain_all(mgr, s)
+        assert [p.payload for p in pkts] == [b"new"]
+
+    def test_position_commits_on_ack(self, mgr):
+        s, _ = mgr.open_session("d1", clean_start=True)
+        mgr.subscribe(s, "q/#", SubOpts(qos=1))
+        mgr.db.store_batch(
+            [Message(topic="q/t", payload=b"a", qos=1, from_client="p")]
+        )
+        (pkt,) = drain_all(mgr, s)
+        # unacked: a fresh pump does NOT re-read past the batch, and the
+        # stream holds until ack
+        assert mgr.pump(s) == []
+        assert s.on_puback(pkt.packet_id)
+        # after ack, position committed; new data flows
+        mgr.db.store_batch(
+            [Message(topic="q/t", payload=b"b", qos=1, from_client="p")]
+        )
+        (pkt2,) = drain_all(mgr, s)
+        assert pkt2.payload == b"b"
+
+    def test_replay_from_uncommitted_position_after_crash(self, tmp_path):
+        db = Db("messages", data_dir=str(tmp_path), n_shards=1)
+        mgr = DurableSessionManager(db, state_dir=str(tmp_path))
+        s, _ = mgr.open_session("d1", clean_start=True, cfg=SessionConfig(session_expiry_interval=300))
+        mgr.subscribe(s, "r/#", SubOpts(qos=1))
+        db.store_batch([Message(topic="r/t", payload=b"x", qos=1, from_client="p")])
+        (pkt,) = drain_all(mgr, s)
+        # crash before ack: manager state reloaded from disk
+        mgr.close()
+        mgr2 = DurableSessionManager(db, state_dir=str(tmp_path))
+        s2, present = mgr2.open_session("d1", clean_start=False)
+        assert present
+        pkts = drain_all(mgr2, s2)
+        # at-least-once: unacked message replays
+        assert [p.payload for p in pkts] == [b"x"]
+        mgr2.close()
+        db.close()
+
+    def test_restart_preserves_subs_and_routes(self, tmp_path):
+        db = Db("messages", data_dir=str(tmp_path), n_shards=1)
+        mgr = DurableSessionManager(db, state_dir=str(tmp_path))
+        s, _ = mgr.open_session("d1", clean_start=True, cfg=SessionConfig(session_expiry_interval=300))
+        mgr.subscribe(s, "keep/#", SubOpts(qos=1))
+        mgr.close()
+        mgr2 = DurableSessionManager(db, state_dir=str(tmp_path))
+        assert mgr2.needs_persist("keep/x")
+        s2, present = mgr2.open_session("d1", clean_start=False)
+        assert present and "keep/#" in s2.subscriptions
+        mgr2.close()
+        db.close()
+
+    def test_broker_gate_end_to_end(self, mgr):
+        broker = Broker()
+        mgr.install(broker.hooks)
+        s, _ = mgr.open_session("dur1", clean_start=True)
+        mgr.subscribe(s, "iot/#", SubOpts(qos=1))
+        s.on_disconnect()
+        broker.publish(Message(topic="iot/dev/1", payload=b"v", qos=1, from_client="pub"))
+        broker.publish(Message(topic="nomatch", payload=b"v", from_client="pub"))
+        mgr.db.buffer.flush_now()
+        s.connected = True
+        pkts = drain_all(mgr, s)
+        assert [p.topic for p in pkts] == ["iot/dev/1"]
+
+    def test_clean_start_discards(self, mgr):
+        s, _ = mgr.open_session("d1", clean_start=True)
+        mgr.subscribe(s, "a/#", SubOpts(qos=0))
+        s2, present = mgr.open_session("d1", clean_start=True)
+        assert not present and not s2.subscriptions
+        assert not mgr.needs_persist("a/x")
+
+    def test_gc_expired(self, mgr):
+        s, _ = mgr.open_session("d1", clean_start=True, cfg=SessionConfig(session_expiry_interval=0.01))
+        mgr.subscribe(s, "g/#", SubOpts(qos=0))
+        s.on_disconnect()
+        time.sleep(0.05)
+        assert mgr.gc() == 1
+        assert "d1" not in mgr.sessions
+        assert not mgr.needs_persist("g/x")
+
+
+class TestReviewRegressions:
+    def test_live_to_durable_takeover_no_leak(self, mgr):
+        from emqx_tpu.broker.pubsub import Broker
+
+        broker = Broker()
+        broker.enable_durable(mgr)
+        live, _ = broker.open_session("c1", True, SessionConfig())
+        broker.subscribe(live, "t/1", SubOpts(qos=0))
+        # reconnect as durable: live routes must be torn down
+        dur, present = broker.open_session(
+            "c1", True, SessionConfig(session_expiry_interval=300)
+        )
+        assert not present
+        assert broker.router.match_routes("t/1") == set()
+
+    def test_shared_sub_on_durable_session_cleans_up(self, mgr):
+        from emqx_tpu.broker.pubsub import Broker
+
+        broker = Broker()
+        broker.enable_durable(mgr)
+        s, _ = broker.open_session("c1", True, SessionConfig(session_expiry_interval=300))
+        broker.subscribe(s, "$share/g/jobs", SubOpts(qos=0))
+        assert broker.router.match_routes("jobs")
+        assert broker.unsubscribe(s, "$share/g/jobs")
+        assert broker.router.match_routes("jobs") == set()
+        # and via close_session
+        broker.subscribe(s, "$share/g/jobs", SubOpts(qos=0))
+        broker.close_session(s, discard=True)
+        assert broker.router.match_routes("jobs") == set()
