@@ -1,0 +1,20 @@
+"""Cluster layer: the distribution planes of the reference
+(SURVEY.md §5 "Distributed communication backend") rebuilt for the
+new runtime:
+
+  * wire       — compact binary term codec (the external-term-format
+                 analog for the data plane);
+  * rpc        — gen_rpc analog: per-key sharded TCP channels,
+                 call/cast/multicall (apps/emqx/src/emqx_rpc.erl:82-98);
+  * bpapi      — versioned backplane protocols with compat negotiation
+                 (apps/emqx/src/bpapi/README.md);
+  * membership — ekka analog: join/leave, heartbeat failure detection,
+                 member_up/member_down events;
+  * node       — ClusterNode/ClusterBroker: replicated route table
+                 (mria analog) where the cluster table is itself a
+                 Router with dest=node — cluster fanout rides the same
+                 batched TPU matcher as local fanout.
+"""
+
+from .node import ClusterBroker, ClusterNode  # noqa: F401
+from .rpc import RpcError, RpcPlane  # noqa: F401

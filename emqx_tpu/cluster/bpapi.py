@@ -1,0 +1,73 @@
+"""BPAPI analog: versioned backplane protocols.
+
+The reference wraps every cross-node call in a `*_proto_vN` module and
+statically checks compatibility between releases
+(apps/emqx/src/bpapi/README.md:1-48, src/proto/*.erl). The analog:
+each protocol registers (name, version, methods); the RPC hello
+exchange carries the supported-version map, and `negotiate` picks the
+highest common version per protocol. Handlers are registered per
+(proto, method); a call names (proto, version, method) and is rejected
+if the version is unsupported — the runtime equivalent of the static
+compat DB.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+
+class BpapiError(Exception):
+    pass
+
+
+class ProtocolRegistry:
+    def __init__(self) -> None:
+        # proto -> sorted list of supported versions
+        self._versions: Dict[str, List[int]] = {}
+        # (proto, version, method) -> handler
+        self._handlers: Dict[Tuple[str, int, str], Callable[..., Any]] = {}
+
+    def declare(self, proto: str, version: int) -> None:
+        vs = self._versions.setdefault(proto, [])
+        if version not in vs:
+            vs.append(version)
+            vs.sort()
+
+    def register(
+        self, proto: str, version: int, method: str, handler: Callable[..., Any]
+    ) -> None:
+        self.declare(proto, version)
+        self._handlers[(proto, version, method)] = handler
+
+    def register_all(
+        self, proto: str, version: int, handlers: Dict[str, Callable[..., Any]]
+    ) -> None:
+        for m, h in handlers.items():
+            self.register(proto, version, m, h)
+
+    def supported(self) -> Dict[str, List[int]]:
+        return {p: list(vs) for p, vs in self._versions.items()}
+
+    def lookup(self, proto: str, version: int, method: str) -> Callable[..., Any]:
+        h = self._handlers.get((proto, version, method))
+        if h is None:
+            # older peer calling v(n-1): fall back to the highest
+            # registered version ≤ requested (handlers are expected to
+            # stay wire-compatible within a proto, like *_proto_vN)
+            for v in sorted(self._versions.get(proto, ()), reverse=True):
+                if v <= version and (proto, v, method) in self._handlers:
+                    return self._handlers[(proto, v, method)]
+            raise BpapiError(f"no handler for {proto} v{version} {method}")
+        return h
+
+
+def negotiate(
+    mine: Dict[str, Iterable[int]], theirs: Dict[str, Iterable[int]]
+) -> Dict[str, int]:
+    """Highest common version per protocol present on both sides."""
+    out: Dict[str, int] = {}
+    for proto, vs in mine.items():
+        common = set(vs) & set(theirs.get(proto, ()))
+        if common:
+            out[proto] = max(common)
+    return out

@@ -1,0 +1,167 @@
+"""Cluster membership: the ekka analog.
+
+Join/leave through a seed node, full-mesh member gossip, periodic
+heartbeats with consecutive-miss failure detection. On a detected
+nodedown every surviving node fires its member_down callbacks locally
+— the same contract as `emqx_router_helper` reacting to
+`ekka:monitor(membership)` and purging the dead node's routes
+(apps/emqx/src/emqx_router_helper.erl:103,147-166).
+
+Protocol (over the RPC plane, proto "membership" v1):
+    join(node_id, host, port)  -> [(node_id, host, port), ...]  (full view)
+    member_up(node_id, host, port)    broadcast on join
+    member_leave(node_id)             broadcast on graceful leave
+    ping() -> "pong"                  heartbeat
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .rpc import PeerDown, RpcPlane
+
+log = logging.getLogger("emqx_tpu.cluster.membership")
+
+Addr = Tuple[str, int]
+
+
+class Membership:
+    def __init__(
+        self,
+        rpc: RpcPlane,
+        heartbeat_interval: float = 1.0,
+        miss_threshold: int = 3,
+    ):
+        self.rpc = rpc
+        self.node_id = rpc.node_id
+        self.heartbeat_interval = heartbeat_interval
+        self.miss_threshold = miss_threshold
+        self.members: Dict[str, Addr] = {}  # peers only (not self)
+        self._misses: Dict[str, int] = {}
+        self.on_member_up: List[Callable[[str, Addr], None]] = []
+        self.on_member_down: List[Callable[[str], None]] = []
+        self._hb_task: Optional[asyncio.Task] = None
+        rpc.registry.register_all(
+            "membership",
+            1,
+            {
+                "join": self._handle_join,
+                "member_up": self._handle_member_up,
+                "member_leave": self._handle_leave,
+                "ping": lambda: "pong",
+            },
+        )
+        # fired with the peer node_id after each successful ping — the
+        # cluster layer piggybacks replica resync on this
+        self.on_ping_ok: List[Callable[[str], None]] = []
+
+    # --- handlers (run on the receiving node) -----------------------------
+
+    def _handle_join(self, node_id: str, host: str, port: int):
+        view = [(self.node_id, *self.rpc.listen_addr)] + [
+            (n, *a) for n, a in self.members.items()
+        ]
+        self._add_member(node_id, (host, port))
+        # tell everyone else about the newcomer
+        asyncio.ensure_future(self._broadcast_up(node_id, (host, port)))
+        return view
+
+    def _handle_member_up(self, node_id: str, host: str, port: int) -> None:
+        if node_id != self.node_id:
+            self._add_member(node_id, (host, port))
+
+    def _handle_leave(self, node_id: str) -> None:
+        self._drop_member(node_id, graceful=True)
+
+    # --- membership state -------------------------------------------------
+
+    def _add_member(self, node_id: str, addr: Addr) -> None:
+        if node_id == self.node_id:
+            return
+        addr = tuple(addr)
+        known = self.members.get(node_id)
+        if known == addr:
+            return
+        # a restarted node re-joins under the same id with a NEW
+        # ephemeral address: update in place and re-fire member_up so
+        # peers stop casting at the dead port
+        self.members[node_id] = addr
+        self._misses[node_id] = 0
+        log.info("%s: member up %s@%s", self.node_id, node_id, addr)
+        for cb in self.on_member_up:
+            cb(node_id, addr)
+
+    def _drop_member(self, node_id: str, graceful: bool) -> None:
+        if self.members.pop(node_id, None) is None:
+            return
+        self._misses.pop(node_id, None)
+        log.info(
+            "%s: member %s %s", self.node_id, "left" if graceful else "DOWN", node_id
+        )
+        for cb in self.on_member_down:
+            cb(node_id)
+
+    # --- lifecycle --------------------------------------------------------
+
+    async def join(self, seed: Addr) -> None:
+        view = await self.rpc.call(
+            seed, "membership", "join", (self.node_id, *self.rpc.listen_addr)
+        )
+        for node_id, host, port in view:
+            if node_id != self.node_id:
+                self._add_member(node_id, (host, port))
+
+    async def _broadcast_up(self, node_id: str, addr: Addr) -> None:
+        for peer, peer_addr in list(self.members.items()):
+            if peer == node_id:
+                continue
+            try:
+                await self.rpc.cast(
+                    peer_addr, "membership", "member_up", (node_id, *addr)
+                )
+            except PeerDown:
+                pass
+
+    async def leave(self) -> None:
+        for _peer, addr in list(self.members.items()):
+            try:
+                await self.rpc.cast(addr, "membership", "member_leave", (self.node_id,))
+            except PeerDown:
+                pass
+
+    def start_heartbeat(self) -> None:
+        if self._hb_task is None:
+            self._hb_task = asyncio.create_task(self._heartbeat_loop())
+
+    def stop_heartbeat(self) -> None:
+        if self._hb_task is not None:
+            self._hb_task.cancel()
+            self._hb_task = None
+
+    async def _ping_one(self, node_id: str, addr: Addr) -> None:
+        try:
+            await self.rpc.call(
+                addr, "membership", "ping", timeout=self.heartbeat_interval
+            )
+            self._misses[node_id] = 0
+            for cb in self.on_ping_ok:
+                cb(node_id)
+        except Exception:
+            self._misses[node_id] = self._misses.get(node_id, 0) + 1
+            if self._misses[node_id] >= self.miss_threshold:
+                self._drop_member(node_id, graceful=False)
+
+    async def _heartbeat_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.heartbeat_interval)
+            # concurrent pings: one black-holed peer must not delay
+            # failure detection for the others
+            await asyncio.gather(
+                *(
+                    self._ping_one(n, a)
+                    for n, a in list(self.members.items())
+                ),
+                return_exceptions=True,
+            )

@@ -1,0 +1,625 @@
+"""ClusterNode / ClusterBroker: the mria-analog replicated routing
+tier plus cross-node message forwarding.
+
+Shape (mirrors the reference, SURVEY.md §3.3/§3.4):
+  * every node holds a FULL replica of the cluster route table —
+    filter -> node dests — exactly the mria ram_copies model
+    (emqx_router.erl:133-162). Here that table is ITSELF a Router, so
+    cluster-level matching for a publish batch rides the same batched
+    TPU kernel as local fanout;
+  * route writes replicate as batched op streams through a syncer
+    (≤1000 ops/flush, emqx_router_syncer.erl:57) over the gen_rpc
+    analog; remote fanout is collapsed to ONE forward per node then
+    re-expanded on the peer (aggre, emqx_broker.erl:408-467);
+  * shared-subscription membership is globally replicated
+    ({group, topic, member} mria bag, emqx_shared_sub.erl:115-123);
+    the PUBLISHING node elects exactly one member cluster-wide and
+    forwards if remote (emqx_shared_sub:dispatch);
+  * a replicated client_id -> node registry (emqx_cm_registry) drives
+    cross-node kick on duplicate connects; session state moves via an
+    async takeover import (the reference does a synchronous 2-phase
+    takeover under a cluster lock, emqx_cm.erl:285-304 — bounded
+    divergence, documented here);
+  * on nodedown, every survivor purges the dead node's routes,
+    shared members, and registry entries (emqx_router_helper.erl:147-166).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..broker.message import Message
+from ..broker.packet import SubOpts
+from ..broker.pubsub import GROUP_DEST, Broker
+from ..models.router import Router
+from ..models.shared_sub import SharedSubs
+from .membership import Addr, Membership
+from .rpc import PeerDown, RpcError, RpcPlane
+
+log = logging.getLogger("emqx_tpu.cluster.node")
+
+SYNC_MAX_BATCH = 1000  # ref: emqx_router_syncer ?MAX_BATCH_SIZE
+SYNC_MAX_DELAY = 0.002
+
+
+def msg_to_wire(msg: Message) -> dict:
+    return {
+        "topic": msg.topic,
+        "payload": msg.payload,
+        "qos": msg.qos,
+        "retain": msg.retain,
+        "from_client": msg.from_client,
+        "id": msg.id,
+        "timestamp": msg.timestamp,
+        "props": dict(msg.props),
+    }
+
+
+def msg_from_wire(d: dict) -> Message:
+    return Message(
+        topic=d["topic"],
+        payload=d["payload"],
+        qos=d["qos"],
+        retain=d["retain"],
+        from_client=d["from_client"],
+        id=d["id"],
+        timestamp=d["timestamp"],
+        props=dict(d.get("props") or {}),
+    )
+
+
+class ClusterBroker(Broker):
+    """A Broker whose publish path adds the cluster legs: remote-node
+    forwarding for direct routes and cluster-wide shared-group
+    election. Falls back to plain Broker behavior until attach()."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.node: Optional["ClusterNode"] = None
+
+    def _dispatch(self, msg: Message, dests: Set) -> int:
+        node = self.node
+        if node is None:
+            return super()._dispatch(msg, dests)
+        # local direct dests only — group election happens cluster-wide
+        direct = {
+            d
+            for d in dests
+            if not (isinstance(d, tuple) and d and d[0] == GROUP_DEST)
+        }
+        n = self._dispatch_direct(msg, direct)
+        n += node.route_remote(msg)
+        if n == 0:
+            if self.durable is None or not self.durable.needs_persist(msg.topic):
+                self.metrics.inc("messages.dropped.no_subscribers")
+                self.hooks.run("message.dropped", msg, "no_subscribers")
+        return n
+
+    def _dispatch_direct(self, msg: Message, dests: Set) -> int:
+        n = 0
+        for dest in dests:
+            n += self._deliver_to(dest, None, msg)
+        if n:
+            self.metrics.inc("messages.delivered", n)
+        return n
+
+    def dispatch_forwarded(self, msg: Message) -> int:
+        """Peer leg of a forward: deliver to LOCAL direct subscribers
+        only — no re-forwarding, no shared election (the publisher
+        already elected; emqx_broker:dispatch :472-480)."""
+        dests = {
+            d
+            for d in self.router.match_routes(msg.topic)
+            if not (isinstance(d, tuple) and d and d[0] == GROUP_DEST)
+        }
+        return self._dispatch_direct(msg, dests)
+
+    def open_session(self, client_id: str, clean_start: bool, cfg=None):
+        if self.node is not None:
+            self.node.on_session_opening(client_id, clean_start)
+        session, present = super().open_session(client_id, clean_start, cfg)
+        if self.node is not None:
+            self.node.announce_session(client_id)
+        return session, present
+
+    def close_session(self, session, discard: bool = False) -> None:
+        cid = session.client_id
+        super().close_session(session, discard=discard)
+        if self.node is not None:
+            self.node.retract_session(cid)
+
+
+class ClusterNode:
+    """One broker node in the cluster: RPC endpoint + membership +
+    replicated route/shared/registry tables wired into a ClusterBroker."""
+
+    def __init__(
+        self,
+        node_id: str,
+        broker: Optional[ClusterBroker] = None,
+        heartbeat_interval: float = 1.0,
+        miss_threshold: int = 3,
+    ):
+        self.node_id = node_id
+        self.broker = broker or ClusterBroker()
+        self.broker.node = self
+        self.rpc = RpcPlane(node_id)
+        self.membership = Membership(
+            self.rpc,
+            heartbeat_interval=heartbeat_interval,
+            miss_threshold=miss_threshold,
+        )
+        # cluster route table: filter -> node ids (FULL replica; a
+        # Router so batched cluster matching uses the TPU kernel)
+        self.cluster_router = Router(max_levels=self.broker.router.max_levels)
+        # global shared membership; members are (node, client) tuples
+        self.cluster_shared = SharedSubs(strategy=self.broker.shared.strategy)
+        # topic index over shared groups: filter -> ("$g", group, filter)
+        # dest per group with ≥1 member anywhere — publish-side election
+        # is a match here, not a scan of all groups
+        self.group_router = Router(max_levels=self.broker.router.max_levels)
+        # the set of (filter, node) pairs currently in cluster_router —
+        # cluster routes are SET-semantic (mria bag of unique pairs),
+        # so replays (op pushed AND in a bootstrap dump) stay idempotent
+        self._cluster_pairs: set = set()
+        # peers whose replica may have missed an op batch (cast failed
+        # while they stayed alive): full-resync on next successful ping
+        self._resync: set = set()
+        # client_id -> node_id (emqx_cm_registry analog)
+        self.registry: Dict[str, str] = {}
+        # local (filter -> distinct local clients) refcount driving
+        # cluster route announcements (first sub on node -> route add)
+        self._local_refs: Dict[str, int] = {}
+        self._op_queue: List[tuple] = []
+        self._flusher: Optional[asyncio.TimerHandle] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._register_protocols()
+        self.broker.router.on_dest_added = self._on_local_dest_added
+        self.broker.router.on_dest_removed = self._on_local_dest_removed
+        self.broker.shared.on_subscribed = (
+            lambda g, f, c: self.on_shared_subscribed(g, f, c)
+        )
+        self.broker.shared.on_unsubscribed = (
+            lambda g, f, c: self.on_shared_unsubscribed(g, f, c)
+        )
+        self.membership.on_member_down.append(self._purge_node)
+        self.membership.on_ping_ok.append(self._maybe_resync)
+        # a broker attached with pre-existing sessions/subscriptions:
+        # seed local refs + cluster tables from its current state (the
+        # callbacks above only see transitions from here on)
+        self._import_existing()
+
+    def _import_existing(self) -> None:
+        for flt, dest in self.broker.router.routes():
+            self._on_local_dest_added(flt, dest)
+        for (group, flt), members in self.broker.shared.items():
+            for client in members:
+                self.on_shared_subscribed(group, flt, client)
+        for client in self.broker.sessions:
+            self.registry[client] = self.node_id
+
+    # --- lifecycle --------------------------------------------------------
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> Addr:
+        self._loop = asyncio.get_running_loop()
+        addr = await self.rpc.start(host, port)
+        self.membership.start_heartbeat()
+        return addr
+
+    async def join(self, seed: Addr) -> None:
+        await self.membership.join(seed)
+        # bootstrap the replicated tables from the seed (mria join copy)
+        dump = await self.rpc.call(seed, "route", "bootstrap")
+        self._apply_ops(dump["ops"])
+        for client, node in dump["sessions"]:
+            self.registry[client] = node
+        # the dump may credit a PREVIOUS incarnation of this node_id
+        # (restart + rejoin before the heartbeat declared us down):
+        # drop everything attributed to us, rebuild from local truth,
+        # and resync every peer the same way
+        self._purge_contrib(self.node_id)
+        self._rebuild_self()
+        await self._resync_all()
+        self.membership.start_heartbeat()
+
+    def _rebuild_self(self) -> None:
+        """Re-derive this node's cluster contributions from its live
+        broker state (the local tables are the source of truth)."""
+        for flt in self._local_refs:
+            self._route_add(flt, self.node_id)
+        for (group, flt), members in self.broker.shared.items():
+            for client in members:
+                self._shared_add(group, flt, self.node_id, client)
+        for client in self.broker.sessions:
+            self.registry[client] = self.node_id
+
+    async def _resync_all(self) -> None:
+        ops = self._full_dump_ops()
+        sessions = [(c, n) for c, n in self.registry.items() if n == self.node_id]
+        for node, addr in list(self.membership.members.items()):
+            try:
+                await self.rpc.call(
+                    addr, "route", "resync", (self.node_id, ops, sessions)
+                )
+            except Exception:
+                self._resync.add(node)
+
+    async def stop(self) -> None:
+        self.membership.stop_heartbeat()
+        await self.membership.leave()
+        await self.rpc.close()
+
+    # --- bpapi protocol registration --------------------------------------
+
+    def _register_protocols(self) -> None:
+        reg = self.rpc.registry
+        reg.register_all(
+            "route",
+            1,
+            {
+                "push": self._handle_push,
+                "bootstrap": self._handle_bootstrap,
+                "resync": self._handle_resync,
+            },
+        )
+        reg.register_all(
+            "broker",
+            1,
+            {
+                "forward": self._handle_forward,
+                "shared_deliver": self._handle_shared_deliver,
+            },
+        )
+        reg.register_all(
+            "cm",
+            1,
+            {
+                "discard": self._handle_discard,
+                "takeover": self._handle_takeover,
+            },
+        )
+
+    # --- route write stream (local transitions -> announced ops) ---------
+
+    def _route_add(self, flt: str, node: str) -> None:
+        """Idempotent cluster route write (set semantics over the
+        refcounting Router)."""
+        if (flt, node) not in self._cluster_pairs:
+            self._cluster_pairs.add((flt, node))
+            self.cluster_router.add_route(flt, node)
+
+    def _route_del(self, flt: str, node: str) -> None:
+        if (flt, node) in self._cluster_pairs:
+            self._cluster_pairs.discard((flt, node))
+            self.cluster_router.delete_route(flt, node)
+
+    def _on_local_dest_added(self, flt: str, dest) -> None:
+        if isinstance(dest, tuple) and dest and dest[0] == GROUP_DEST:
+            return  # group dests announced via shared membership ops
+        n = self._local_refs.get(flt, 0)
+        self._local_refs[flt] = n + 1
+        if n == 0:
+            self._route_add(flt, self.node_id)
+            self._enqueue_op(("add_r", flt, self.node_id))
+
+    def _on_local_dest_removed(self, flt: str, dest) -> None:
+        if isinstance(dest, tuple) and dest and dest[0] == GROUP_DEST:
+            return
+        n = self._local_refs.get(flt, 0) - 1
+        if n <= 0:
+            self._local_refs.pop(flt, None)
+            self._route_del(flt, self.node_id)
+            self._enqueue_op(("del_r", flt, self.node_id))
+        else:
+            self._local_refs[flt] = n
+
+    def _shared_add(self, group: str, flt: str, node: str, client: str) -> None:
+        if self.cluster_shared.subscribe(group, flt, (node, client)):
+            self.group_router.add_route(flt, (GROUP_DEST, group, flt))
+
+    def _shared_del(self, group: str, flt: str, node: str, client: str) -> None:
+        if self.cluster_shared.unsubscribe(group, flt, (node, client)):
+            self.group_router.delete_route(flt, (GROUP_DEST, group, flt))
+
+    def on_shared_subscribed(self, group: str, flt: str, client: str) -> None:
+        self._shared_add(group, flt, self.node_id, client)
+        self._enqueue_op(("add_s", group, flt, self.node_id, client))
+
+    def on_shared_unsubscribed(self, group: str, flt: str, client: str) -> None:
+        self._shared_del(group, flt, self.node_id, client)
+        self._enqueue_op(("del_s", group, flt, self.node_id, client))
+
+    def announce_session(self, client: str) -> None:
+        self.registry[client] = self.node_id
+        self._enqueue_op(("sess_up", client, self.node_id))
+
+    def retract_session(self, client: str) -> None:
+        if self.registry.get(client) == self.node_id:
+            del self.registry[client]
+        self._enqueue_op(("sess_down", client, self.node_id))
+
+    # --- syncer (batched op replication) ----------------------------------
+
+    def _enqueue_op(self, op: tuple) -> None:
+        if not self.membership.members:
+            return
+        self._op_queue.append(op)
+        if len(self._op_queue) >= SYNC_MAX_BATCH:
+            self._flush_ops()
+        elif self._flusher is None and self._loop is not None:
+            self._flusher = self._loop.call_later(SYNC_MAX_DELAY, self._flush_ops)
+
+    def _flush_ops(self) -> None:
+        if self._flusher is not None:
+            self._flusher.cancel()
+            self._flusher = None
+        if not self._op_queue:
+            return
+        ops, self._op_queue = self._op_queue, []
+        asyncio.ensure_future(self._broadcast_ops(ops))
+
+    async def _broadcast_ops(self, ops: List[tuple]) -> None:
+        """Replicate an op batch to every peer. Pushes are ACKED calls
+        (the reference's route writes are mria transactions, not
+        fire-and-forget) — a failed push marks the peer's replica
+        diverged and schedules a full resync for when it answers pings
+        again."""
+
+        async def push_one(node: str, addr: Addr) -> None:
+            try:
+                await self.rpc.call(
+                    addr, "route", "push", (self.node_id, ops), timeout=2.0
+                )
+            except Exception:
+                self._resync.add(node)
+
+        await asyncio.gather(
+            *(push_one(n, a) for n, a in list(self.membership.members.items()))
+        )
+
+    async def flush(self) -> None:
+        """Drain pending announcements now (syncer wait/1 analog)."""
+        if self._op_queue:
+            ops, self._op_queue = self._op_queue, []
+            await self._broadcast_ops(ops)
+
+    def _handle_push(self, origin: str, ops: List[tuple]) -> None:
+        self._apply_ops(ops)
+
+    def _apply_ops(self, ops: Sequence[tuple]) -> None:
+        for op in ops:
+            kind = op[0]
+            if kind == "add_r":
+                self._route_add(op[1], op[2])
+            elif kind == "del_r":
+                self._route_del(op[1], op[2])
+            elif kind == "add_s":
+                _k, group, flt, node, client = op
+                self._shared_add(group, flt, node, client)
+            elif kind == "del_s":
+                _k, group, flt, node, client = op
+                self._shared_del(group, flt, node, client)
+            elif kind == "sess_up":
+                self.registry[op[1]] = op[2]
+            elif kind == "sess_down":
+                if self.registry.get(op[1]) == op[2]:
+                    del self.registry[op[1]]
+
+    def _full_dump_ops(self) -> List[tuple]:
+        """Ops reconstructing THIS node's contributions (join announce,
+        resync payload)."""
+        ops: List[tuple] = [
+            ("add_r", flt, self.node_id) for flt in self._local_refs
+        ]
+        for (group, flt), members in self.cluster_shared.items():
+            for node, client in members:
+                if node == self.node_id:
+                    ops.append(("add_s", group, flt, node, client))
+        return ops
+
+    def _handle_bootstrap(self) -> dict:
+        """Full replica dump for a joining node."""
+        ops: List[tuple] = [
+            ("add_r", flt, node) for (flt, node) in self._cluster_pairs
+        ]
+        for (group, flt), members in self.cluster_shared.items():
+            for node, client in members:
+                ops.append(("add_s", group, flt, node, client))
+        return {
+            "ops": ops,
+            "sessions": [(c, n) for c, n in self.registry.items()],
+        }
+
+    # --- replica resync (anti-entropy after a lost batch) ------------------
+
+    def _maybe_resync(self, node_id: str) -> None:
+        if node_id in self._resync:
+            self._resync.discard(node_id)
+            self._spawn(self._do_resync(node_id))
+
+    async def _do_resync(self, node_id: str) -> None:
+        addr = self.membership.members.get(node_id)
+        if addr is None:
+            return
+        sessions = [(c, n) for c, n in self.registry.items() if n == self.node_id]
+        try:
+            await self.rpc.call(
+                addr, "route", "resync", (self.node_id, self._full_dump_ops(), sessions)
+            )
+        except Exception:
+            self._resync.add(node_id)  # retry on the next good ping
+
+    def _handle_resync(self, origin: str, ops: List[tuple], sessions: list) -> None:
+        """Replace everything `origin` contributed with its fresh dump."""
+        self._purge_contrib(origin)
+        self._apply_ops(ops)
+        for client, node in sessions:
+            self.registry[client] = node
+
+    # --- publish-path cluster legs ---------------------------------------
+
+    def route_remote(self, msg: Message) -> int:
+        """Forward to remote nodes with matching routes (once per node)
+        and elect shared-group members cluster-wide. Returns deliveries
+        initiated (remote forwards count as 1 each, like the reference
+        counting a forward as one delivery leg)."""
+        dests = self.cluster_router.match_routes(msg.topic)
+        remote_nodes = {d for d in dests if isinstance(d, str) and d != self.node_id}
+        n = 0
+        payload = msg_to_wire(msg)
+        for node in remote_nodes:
+            addr = self.membership.members.get(node)
+            if addr is None:
+                continue
+            self._spawn(
+                self.rpc.cast(
+                    addr, "broker", "forward", (payload,), key=msg.topic
+                )
+            )
+            n += 1
+        n += self._dispatch_shared(msg)
+        return n
+
+    def _dispatch_shared(self, msg: Message) -> int:
+        """Cluster-wide shared-group election for every matched group —
+        groups come from the group_router topic index (one match, not a
+        scan over every group in the cluster)."""
+        groups = {
+            (d[1], d[2]) for d in self.group_router.match_routes(msg.topic)
+        }
+        n = 0
+        for group, flt in groups:
+            member = self._pick_shared(group, flt, msg)
+            if member is None:
+                continue
+            node, client = member
+            share_filter = f"$share/{group}/{flt}"
+            if node == self.node_id:
+                n += self.broker._deliver_to(client, share_filter, msg)
+            else:
+                addr = self.membership.members.get(node)
+                if addr is None:
+                    continue
+                self._spawn(
+                    self.rpc.cast(
+                        addr,
+                        "broker",
+                        "shared_deliver",
+                        (client, share_filter, msg_to_wire(msg)),
+                        key=msg.topic,
+                    )
+                )
+                n += 1
+        return n
+
+    def _pick_shared(self, group: str, flt: str, msg: Message):
+        if self.cluster_shared.strategy == "local":
+            local = [
+                m
+                for m in self.cluster_shared.members(group, flt)
+                if m[0] == self.node_id
+            ]
+            if local:
+                return self.cluster_shared.pick_among(
+                    local, group, flt, msg.topic, msg.from_client
+                )
+        return self.cluster_shared.pick(
+            group, flt, msg.topic, from_client=msg.from_client
+        )
+
+    def _spawn(self, coro) -> None:
+        asyncio.ensure_future(coro)
+
+    # --- inbound handlers --------------------------------------------------
+
+    def _handle_forward(self, payload: dict) -> None:
+        self.broker.dispatch_forwarded(msg_from_wire(payload))
+
+    def _handle_shared_deliver(
+        self, client: str, share_filter: str, payload: dict
+    ) -> None:
+        self.broker._deliver_to(client, share_filter, msg_from_wire(payload))
+
+    # --- session registry / takeover --------------------------------------
+
+    def on_session_opening(self, client_id: str, clean_start: bool) -> None:
+        """Duplicate connect: kick the previous owner node. Async kick
+        (vs the reference's synchronous locked takeover) — the old
+        session dies shortly after the new one starts."""
+        owner = self.registry.get(client_id)
+        if owner is None or owner == self.node_id:
+            return
+        addr = self.membership.members.get(owner)
+        if addr is None:
+            return
+        if clean_start:
+            self._spawn(self.rpc.cast(addr, "cm", "discard", (client_id,)))
+        else:
+            self._spawn(self._takeover_import(addr, client_id))
+
+    async def _takeover_import(self, addr: Addr, client_id: str) -> None:
+        try:
+            state = await self.rpc.call(addr, "cm", "takeover", (client_id,))
+        except (PeerDown, RpcError, asyncio.TimeoutError, OSError):
+            return  # old owner unreachable: fresh session, nothing to move
+        if not state:
+            return
+        session = self.broker.sessions.get(client_id)
+        if session is None:
+            return
+        try:
+            for flt, opts in state["subs"]:
+                if flt not in session.subscriptions:
+                    self.broker.subscribe(session, flt, SubOpts(**opts))
+            for payload in state["pending"]:
+                self.broker._deliver_to(client_id, None, msg_from_wire(payload))
+        except Exception:
+            log.exception("takeover import for %s failed", client_id)
+
+    def _handle_discard(self, client_id: str) -> None:
+        session = self.broker.sessions.get(client_id)
+        if session is not None:
+            self.broker.close_session(session, discard=True)
+
+    def _handle_takeover(self, client_id: str):
+        session = self.broker.sessions.get(client_id)
+        if session is None:
+            return None
+        subs = [
+            (
+                flt,
+                {
+                    "qos": o.qos,
+                    "no_local": o.no_local,
+                    "retain_as_published": o.retain_as_published,
+                    "retain_handling": o.retain_handling,
+                },
+            )
+            for flt, o in session.subscriptions.items()
+        ]
+        pending = [msg_to_wire(m) for (m, _o) in getattr(session, "mqueue", ())]
+        self.broker.close_session(session, discard=True)
+        return {"subs": subs, "pending": pending}
+
+    # --- failure handling ---------------------------------------------------
+
+    def _purge_node(self, node_id: str) -> None:
+        """Survivor-side cleanup of a dead node (router_helper analog)."""
+        self._purge_contrib(node_id)
+        self._resync.discard(node_id)
+
+    def _purge_contrib(self, node_id: str) -> None:
+        """Drop every route / shared member / registry entry `node_id`
+        contributed."""
+        for flt, node in list(self._cluster_pairs):
+            if node == node_id:
+                self._route_del(flt, node)
+        for (group, flt), members in self.cluster_shared.items():
+            for m in members:
+                if m[0] == node_id:
+                    self._shared_del(group, flt, m[0], m[1])
+        for client, node in list(self.registry.items()):
+            if node == node_id:
+                del self.registry[client]

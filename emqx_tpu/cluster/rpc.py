@@ -1,0 +1,335 @@
+"""RPC plane: the gen_rpc analog.
+
+Per-peer, per-key sharded TCP channels (the reference shards gen_rpc
+client connections by `{Key, Node}` so one hot stream can't
+head-of-line-block the rest, apps/emqx/src/emqx_rpc.erl:82-98,115-119),
+carrying wire-encoded frames:
+
+    ("hello", node_id, {proto: [versions]})
+    ("call", req_id, proto, version, method, args_tuple)
+    ("cast",          proto, version, method, args_tuple)
+    ("reply", req_id, True,  value)
+    ("reply", req_id, False, error_string)
+
+call() awaits a reply with a timeout; cast() is fire-and-forget
+(rpc.mode async, emqx_broker.erl:448-467). multicall fans a call to
+many peers concurrently and returns per-peer results or exceptions —
+the emqx_rpc:multicall/unwrap_erpc shape.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import struct
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from . import wire
+from .bpapi import ProtocolRegistry, negotiate
+
+log = logging.getLogger("emqx_tpu.cluster.rpc")
+
+_LEN = struct.Struct(">I")
+MAX_FRAME = 64 << 20
+
+
+class RpcError(Exception):
+    pass
+
+
+class PeerDown(RpcError):
+    pass
+
+
+async def _read_frame(reader: asyncio.StreamReader) -> Any:
+    hdr = await reader.readexactly(4)
+    (n,) = _LEN.unpack(hdr)
+    if n > MAX_FRAME:
+        raise RpcError(f"frame too large: {n}")
+    return wire.decode(await reader.readexactly(n))
+
+
+def _write_frame(writer: asyncio.StreamWriter, term: Any) -> None:
+    body = wire.encode(term)
+    writer.write(_LEN.pack(len(body)) + body)
+
+
+class _Channel:
+    """One client connection to a peer (one shard of the per-key pool)."""
+
+    def __init__(self, plane: "RpcPlane", addr: Tuple[str, int]):
+        self.plane = plane
+        self.addr = addr
+        self.writer: Optional[asyncio.StreamWriter] = None
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._req_id = 0
+        self._lock = asyncio.Lock()
+        self._reader_task: Optional[asyncio.Task] = None
+
+    async def _connect(self) -> None:
+        reader, writer = await asyncio.open_connection(*self.addr)
+        try:
+            _write_frame(
+                writer,
+                ("hello", self.plane.node_id, self.plane.registry.supported()),
+            )
+            await writer.drain()
+            ack = await _read_frame(reader)
+            if not (isinstance(ack, tuple) and ack and ack[0] == "hello"):
+                raise RpcError(f"bad hello ack: {ack!r}")
+        except BaseException:
+            # includes cancellation by the connect_timeout wait_for: a
+            # half-done handshake must not leak its socket
+            writer.close()
+            raise
+        _h, peer_node, peer_protos = ack
+        self.plane.note_peer(self.addr, peer_node, peer_protos)
+        self.writer = writer
+        self._reader_task = asyncio.create_task(self._read_loop(reader))
+
+    async def _ensure(self) -> asyncio.StreamWriter:
+        """Returns a connected writer. Connection setup is bounded by
+        connect_timeout — a black-holed peer must not stall callers for
+        the OS TCP timeout."""
+        if self.writer is None or self.writer.is_closing():
+            async with self._lock:
+                if self.writer is None or self.writer.is_closing():
+                    try:
+                        await asyncio.wait_for(
+                            self._connect(), self.plane.connect_timeout
+                        )
+                    except asyncio.TimeoutError:
+                        raise PeerDown(f"connect to {self.addr} timed out") from None
+        # snapshot: the read loop may null self.writer concurrently
+        w = self.writer
+        if w is None:
+            raise PeerDown(f"channel to {self.addr} lost during setup")
+        return w
+
+    async def _read_loop(self, reader: asyncio.StreamReader) -> None:
+        try:
+            while True:
+                frame = await _read_frame(reader)
+                if frame[0] == "reply":
+                    _, req_id, ok, val = frame
+                    fut = self._pending.pop(req_id, None)
+                    if fut is not None and not fut.done():
+                        if ok:
+                            fut.set_result(val)
+                        else:
+                            fut.set_exception(RpcError(str(val)))
+        except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
+            pass
+        finally:
+            self._fail_all(PeerDown(f"channel to {self.addr} closed"))
+
+    def _fail_all(self, exc: Exception) -> None:
+        pending, self._pending = self._pending, {}
+        for fut in pending.values():
+            if not fut.done():
+                fut.set_exception(exc)
+                # the awaiting side may itself have been cancelled —
+                # mark the exception retrieved to keep shutdown quiet
+                fut.exception()
+        if self.writer is not None:
+            self.writer.close()
+            self.writer = None
+
+    async def call(
+        self, proto: str, version: int, method: str, args: tuple, timeout: float
+    ) -> Any:
+        w = await self._ensure()
+        self._req_id += 1
+        req_id = self._req_id
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[req_id] = fut
+        try:
+            _write_frame(w, ("call", req_id, proto, version, method, args))
+            await w.drain()
+            return await asyncio.wait_for(fut, timeout)
+        finally:
+            self._pending.pop(req_id, None)
+
+    async def cast(self, proto: str, version: int, method: str, args: tuple) -> None:
+        w = await self._ensure()
+        _write_frame(w, ("cast", proto, version, method, args))
+        await w.drain()
+
+    def close(self) -> None:
+        if self._reader_task is not None:
+            self._reader_task.cancel()
+        self._fail_all(PeerDown("closed"))
+
+
+class RpcPlane:
+    """One node's RPC endpoint: a listening server plus sharded client
+    channels to every peer it talks to."""
+
+    def __init__(
+        self,
+        node_id: str,
+        registry: Optional[ProtocolRegistry] = None,
+        n_shards: int = 4,
+        call_timeout: float = 5.0,
+        connect_timeout: float = 3.0,
+    ):
+        self.node_id = node_id
+        self.registry = registry or ProtocolRegistry()
+        self.n_shards = n_shards
+        self.call_timeout = call_timeout
+        self.connect_timeout = connect_timeout
+        self.listen_addr: Optional[Tuple[str, int]] = None
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._inbound: set = set()  # live server-side writers
+        # (peer_addr, shard) -> channel
+        self._channels: Dict[Tuple[Tuple[str, int], int], _Channel] = {}
+        # negotiated versions per peer node (from either hello direction)
+        self.peer_versions: Dict[str, Dict[str, int]] = {}
+        self._addr_node: Dict[Tuple[str, int], str] = {}
+
+    def note_peer(self, addr, node_id: str, protos: Dict[str, list]) -> None:
+        self._addr_node[tuple(addr)] = node_id
+        self.peer_versions[node_id] = negotiate(self.registry.supported(), protos)
+
+    def _resolve_version(self, addr, proto: str, version) -> int:
+        """Explicit version pins win; otherwise use the negotiated
+        version for this peer (the bpapi compat rule), defaulting to 1."""
+        if version is not None:
+            return version
+        node = self._addr_node.get(tuple(addr))
+        if node is not None:
+            return self.peer_versions.get(node, {}).get(proto, 1)
+        return 1
+
+    # --- server side ------------------------------------------------------
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> Tuple[str, int]:
+        self._server = await asyncio.start_server(self._serve, host, port)
+        sock = self._server.sockets[0]
+        self.listen_addr = sock.getsockname()[:2]
+        return self.listen_addr
+
+    async def _serve(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        peer_node = None
+        self._inbound.add(writer)
+        try:
+            hello = await _read_frame(reader)
+            if not (isinstance(hello, tuple) and hello[0] == "hello"):
+                return
+            _, peer_node, peer_protos = hello
+            self.peer_versions[peer_node] = negotiate(
+                self.registry.supported(), peer_protos
+            )
+            _write_frame(
+                writer, ("hello", self.node_id, self.registry.supported())
+            )
+            await writer.drain()
+            while True:
+                frame = await _read_frame(reader)
+                kind = frame[0]
+                if kind == "call":
+                    _, req_id, proto, version, method, args = frame
+                    try:
+                        result = self.registry.lookup(proto, version, method)(*args)
+                        if asyncio.iscoroutine(result):
+                            result = await result
+                        _write_frame(writer, ("reply", req_id, True, result))
+                    except Exception as e:  # handler errors go back to caller
+                        _write_frame(writer, ("reply", req_id, False, repr(e)))
+                    await writer.drain()
+                elif kind == "cast":
+                    _, proto, version, method, args = frame
+                    try:
+                        result = self.registry.lookup(proto, version, method)(*args)
+                        if asyncio.iscoroutine(result):
+                            await result
+                    except Exception:
+                        log.exception("cast %s.%s failed", proto, method)
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            self._inbound.discard(writer)
+            writer.close()
+
+    # --- client side ------------------------------------------------------
+
+    def _channel(self, addr: Tuple[str, int], key: Any) -> _Channel:
+        shard = hash(key) % self.n_shards
+        ch = self._channels.get((addr, shard))
+        if ch is None:
+            ch = _Channel(self, addr)
+            self._channels[(addr, shard)] = ch
+        return ch
+
+    async def call(
+        self,
+        addr: Tuple[str, int],
+        proto: str,
+        method: str,
+        args: tuple = (),
+        *,
+        version: Optional[int] = None,
+        key: Any = None,
+        timeout: Optional[float] = None,
+    ) -> Any:
+        ch = self._channel(tuple(addr), key)
+        v = self._resolve_version(addr, proto, version)
+        return await ch.call(proto, v, method, args, timeout or self.call_timeout)
+
+    async def cast(
+        self,
+        addr: Tuple[str, int],
+        proto: str,
+        method: str,
+        args: tuple = (),
+        *,
+        version: Optional[int] = None,
+        key: Any = None,
+    ) -> None:
+        try:
+            v = self._resolve_version(addr, proto, version)
+            await self._channel(tuple(addr), key).cast(proto, v, method, args)
+        except (ConnectionError, OSError) as e:
+            raise PeerDown(f"cast to {addr} failed: {e}") from e
+
+    async def multicall(
+        self,
+        addrs: List[Tuple[str, int]],
+        proto: str,
+        method: str,
+        args: tuple = (),
+        *,
+        version: Optional[int] = None,
+        timeout: Optional[float] = None,
+    ) -> List[Any]:
+        """Concurrent call to many peers; exceptions are returned in
+        place of results (unwrap_erpc shape — callers partition
+        ok/error)."""
+        return await asyncio.gather(
+            *(
+                self.call(a, proto, method, args, version=version, timeout=timeout)
+                for a in addrs
+            ),
+            return_exceptions=True,
+        )
+
+    async def close(self) -> None:
+        for ch in self._channels.values():
+            ch.close()
+        self._channels.clear()
+        if self._server is not None:
+            # stop accepting FIRST; handlers for already-accepted
+            # connections may not have registered their writer yet, so
+            # give the loop a couple of ticks before sweeping
+            self._server.close()
+            for _ in range(3):
+                for w in list(self._inbound):
+                    w.close()
+                await asyncio.sleep(0)
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), 2.0)
+            except asyncio.TimeoutError:
+                log.warning("rpc server close timed out with handlers live")
+            self._server = None

@@ -136,6 +136,12 @@ class Router:
 
     def __init__(self, max_levels: int = 16, device=None) -> None:
         self.max_levels = max_levels
+        # route-transition callbacks: fired when a (filter, dest) pair
+        # first appears / finally disappears — the seam the cluster
+        # layer announces route writes through (the sync_route analog,
+        # emqx_broker.erl:778-795)
+        self.on_dest_added = None
+        self.on_dest_removed = None
         # exact topics: host hash (never on device — the v2 split)
         self._exact: Dict[str, Dict[Dest, int]] = {}
         # wildcard filters
@@ -155,7 +161,10 @@ class Router:
     def add_route(self, flt: str, dest: Dest) -> None:
         if not topic_mod.is_wildcard(flt):
             dests = self._exact.setdefault(flt, {})
+            fresh = dest not in dests
             dests[dest] = dests.get(dest, 0) + 1
+            if fresh and self.on_dest_added is not None:
+                self.on_dest_added(flt, dest)
             return
         key = (flt, dest)
         if key in self._pair_refs:
@@ -169,11 +178,15 @@ class Router:
         except FilterTooDeep:
             self._deep[key] = 1
             self._deep_trie.insert(topic_mod.words(flt), key)
+            if self.on_dest_added is not None:
+                self.on_dest_added(flt, dest)
             return
         self._pair_row[key] = row
         self._pair_refs[key] = 1
         self._row_dest[row] = key
         self._trie.insert(topic_mod.words(flt), row)
+        if self.on_dest_added is not None:
+            self.on_dest_added(flt, dest)
 
     def delete_route(self, flt: str, dest: Dest) -> None:
         if not topic_mod.is_wildcard(flt):
@@ -185,6 +198,8 @@ class Router:
                 del dests[dest]
                 if not dests:
                     del self._exact[flt]
+                if self.on_dest_removed is not None:
+                    self.on_dest_removed(flt, dest)
             return
         key = (flt, dest)
         if key in self._deep:
@@ -192,6 +207,8 @@ class Router:
             if self._deep[key] == 0:
                 del self._deep[key]
                 self._deep_trie.remove(topic_mod.words(flt), key)
+                if self.on_dest_removed is not None:
+                    self.on_dest_removed(flt, dest)
             return
         if key not in self._pair_refs:
             return
@@ -203,6 +220,8 @@ class Router:
         del self._row_dest[row]
         self._trie.remove(topic_mod.words(flt), row)
         self.table.remove(row)
+        if self.on_dest_removed is not None:
+            self.on_dest_removed(flt, dest)
 
     def has_route(self, flt: str, dest: Dest) -> bool:
         if not topic_mod.is_wildcard(flt):
@@ -215,6 +234,25 @@ class Router:
         out.extend({f for (f, _d) in self._pair_refs})
         out.extend({f for (f, _d) in self._deep})
         return sorted(set(out))
+
+    def dests(self, flt: str) -> List[Dest]:
+        """All destinations routed for one topic/filter
+        (emqx_router:lookup_routes/1)."""
+        if not topic_mod.is_wildcard(flt):
+            return list(self._exact.get(flt, ()))
+        return [d for (f, d) in self._pair_refs if f == flt] + [
+            d for (f, d) in self._deep if f == flt
+        ]
+
+    def routes(self) -> List[Tuple[str, Dest]]:
+        """Every (filter, dest) pair — the full-table stream the
+        cluster bootstrap dump walks (emqx_router:stream/1)."""
+        out: List[Tuple[str, Dest]] = []
+        for flt, dests in self._exact.items():
+            out.extend((flt, d) for d in dests)
+        out.extend(self._pair_refs)
+        out.extend(self._deep)
+        return out
 
     def stats(self) -> Dict[str, int]:
         return {

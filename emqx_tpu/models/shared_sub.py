@@ -29,6 +29,10 @@ class SharedSubs:
         assert strategy in STRATEGIES, strategy
         self.strategy = strategy
         self._rng = random.Random(seed)
+        # membership-transition callbacks (group, flt, member) — the
+        # cluster layer replicates the mria shared-sub bag through these
+        self.on_subscribed = None
+        self.on_unsubscribed = None
         # (group, filter) -> ordered member list
         self._members: Dict[Tuple[str, str], List[Hashable]] = {}
         self._rr: Dict[Tuple[str, str], int] = {}  # round-robin cursors
@@ -41,6 +45,8 @@ class SharedSubs:
         mem = self._members.setdefault(key, [])
         if member not in mem:
             mem.append(member)
+            if self.on_subscribed is not None:
+                self.on_subscribed(group, flt, member)
         return len(mem) == 1
 
     def unsubscribe(self, group: str, flt: str, member: Hashable) -> bool:
@@ -51,6 +57,8 @@ class SharedSubs:
             return False
         if member in mem:
             mem.remove(member)
+            if self.on_unsubscribed is not None:
+                self.on_unsubscribed(group, flt, member)
         self._sticky = {
             k: v for k, v in self._sticky.items() if not (k[:2] == key and v == member)
         }
@@ -62,6 +70,18 @@ class SharedSubs:
 
     def members(self, group: str, flt: str) -> List[Hashable]:
         return list(self._members.get((group, flt), ()))
+
+    def items(self) -> List[Tuple[Tuple[str, str], List[Hashable]]]:
+        """All ((group, filter), members) entries."""
+        return [(k, list(v)) for k, v in self._members.items()]
+
+    def pick_among(self, members: List[Hashable], group: str, flt: str,
+                   topic: str, from_client: str = "") -> Optional[Hashable]:
+        """Elect from an explicit candidate list (the cluster layer's
+        local-preference path)."""
+        if not members:
+            return None
+        return self._elect(members, (group, flt), topic, from_client)
 
     def pick(
         self,
@@ -77,6 +97,10 @@ class SharedSubs:
         mem = [m for m in self._members.get(key, ()) if m not in exclude]
         if not mem:
             return None
+        return self._elect(mem, key, topic, from_client)
+
+    def _elect(self, mem, key, topic: str, from_client: str):
+        group, flt = key
         s = self.strategy
         if s in ("random", "local"):
             return self._rng.choice(mem)

@@ -1,0 +1,304 @@
+"""Cluster-layer tests: N broker nodes in one process over localhost
+TCP — the cth_cluster pattern (multi-node as in-proc peers,
+apps/emqx/test/emqx_cth_cluster.erl) applied to the new runtime."""
+
+import asyncio
+
+import pytest
+
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.packet import SubOpts
+from emqx_tpu.cluster import ClusterNode
+from emqx_tpu.cluster import wire
+from emqx_tpu.cluster.bpapi import ProtocolRegistry, negotiate
+
+
+# --- wire codec ----------------------------------------------------------
+
+
+def test_wire_roundtrip():
+    terms = [
+        None,
+        True,
+        False,
+        0,
+        -1,
+        2**62,
+        2**80,  # bigint path
+        -(2**80),
+        3.25,
+        "topic/+/x",
+        "ünïcode",
+        b"\x00\xffpayload",
+        [1, "a", b"b"],
+        ("t", 1, None),
+        {"k": [1, 2], "nested": {"x": (True, b"")}},
+        [],
+        {},
+        (),
+    ]
+    for t in terms:
+        assert wire.decode(wire.encode(t)) == t
+
+
+def test_wire_rejects_unknown():
+    with pytest.raises(wire.WireError):
+        wire.encode(object())
+    with pytest.raises(wire.WireError):
+        wire.decode(b"\x99")
+    with pytest.raises(wire.WireError):
+        wire.decode(wire.encode(1) + b"x")
+
+
+def test_bpapi_negotiate():
+    assert negotiate({"broker": [1, 2]}, {"broker": [1]}) == {"broker": 1}
+    assert negotiate({"broker": [1, 2]}, {"broker": [1, 2, 3]}) == {"broker": 2}
+    assert negotiate({"broker": [1]}, {"cm": [1]}) == {}
+
+
+def test_bpapi_version_fallback():
+    reg = ProtocolRegistry()
+    reg.register("p", 1, "m", lambda: "v1")
+    reg.declare("p", 2)
+    # a v2 call with no v2 handler falls back to v1 (wire-compatible)
+    assert reg.lookup("p", 2, "m")() == "v1"
+    with pytest.raises(Exception):
+        reg.lookup("q", 1, "m")
+
+
+# --- cluster scaffolding -------------------------------------------------
+
+
+async def make_cluster(n, hb=0.05, miss=2):
+    nodes = []
+    addrs = []
+    for i in range(n):
+        node = ClusterNode(f"n{i}", heartbeat_interval=hb, miss_threshold=miss)
+        addrs.append(await node.start())
+        nodes.append(node)
+    for node in nodes[1:]:
+        await node.join(addrs[0])
+    await asyncio.sleep(0.05)
+    return nodes, addrs
+
+
+async def settle(nodes, delay=0.05):
+    for n in nodes:
+        await n.flush()
+    await asyncio.sleep(delay)
+
+
+def attach_client(node, client_id):
+    """Open a session with a capture sink; returns (session, received)."""
+    session, _present = node.broker.open_session(client_id, clean_start=True)
+    received = []
+    session.outgoing_sink = lambda pkts: received.extend(pkts)
+    return session, received
+
+
+async def stop_all(nodes):
+    for n in nodes:
+        await n.stop()
+
+
+# --- replication + forwarding -------------------------------------------
+
+
+async def test_cross_node_pubsub():
+    nodes, _ = await make_cluster(2)
+    a, b = nodes
+    try:
+        sess, inbox = attach_client(b, "sub1")
+        b.broker.subscribe(sess, "room/+/temp", SubOpts(qos=0))
+        await settle(nodes)
+        # route replicated to node a
+        assert "n1" in a.cluster_router.match_routes("room/1/temp")
+        a.broker.publish(Message(topic="room/1/temp", payload=b"21"))
+        await asyncio.sleep(0.05)
+        assert [p.payload for p in inbox] == [b"21"]
+        # no self-forward: publishing on b delivers once
+        inbox.clear()
+        b.broker.publish(Message(topic="room/2/temp", payload=b"22"))
+        await asyncio.sleep(0.05)
+        assert [p.payload for p in inbox] == [b"22"]
+    finally:
+        await stop_all(nodes)
+
+
+async def test_route_delete_propagates():
+    nodes, _ = await make_cluster(2)
+    a, b = nodes
+    try:
+        sess, inbox = attach_client(b, "sub1")
+        b.broker.subscribe(sess, "x/#", SubOpts(qos=0))
+        await settle(nodes)
+        assert "n1" in a.cluster_router.match_routes("x/y")
+        b.broker.unsubscribe(sess, "x/#")
+        await settle(nodes)
+        assert "n1" not in a.cluster_router.match_routes("x/y")
+        a.broker.publish(Message(topic="x/y", payload=b"gone"))
+        await asyncio.sleep(0.05)
+        assert inbox == []
+    finally:
+        await stop_all(nodes)
+
+
+async def test_late_joiner_bootstraps_routes():
+    nodes, addrs = await make_cluster(2)
+    a, b = nodes
+    try:
+        sess, inbox = attach_client(a, "early")
+        a.broker.subscribe(sess, "boot/+", SubOpts(qos=0))
+        await settle(nodes)
+        c = ClusterNode("n2", heartbeat_interval=0.05, miss_threshold=2)
+        await c.start()
+        await c.join(addrs[0])
+        nodes.append(c)
+        await asyncio.sleep(0.05)
+        # bootstrap copied the existing route
+        assert "n0" in c.cluster_router.match_routes("boot/x")
+        c.broker.publish(Message(topic="boot/x", payload=b"hi"))
+        await asyncio.sleep(0.05)
+        assert [p.payload for p in inbox] == [b"hi"]
+    finally:
+        await stop_all(nodes)
+
+
+async def test_fanout_collapses_to_one_forward_per_node():
+    nodes, _ = await make_cluster(2)
+    a, b = nodes
+    try:
+        inboxes = []
+        for i in range(5):
+            sess, inbox = attach_client(b, f"s{i}")
+            b.broker.subscribe(sess, "wide/#", SubOpts(qos=0))
+            inboxes.append(inbox)
+        await settle(nodes)
+        # cluster table holds ONE dest (n1) despite 5 subscribers
+        assert a.cluster_router.match_routes("wide/t") == {"n1"}
+        a.broker.publish(Message(topic="wide/t", payload=b"x"))
+        await asyncio.sleep(0.05)
+        assert all(len(ib) == 1 for ib in inboxes)
+    finally:
+        await stop_all(nodes)
+
+
+async def test_shared_subscription_cluster_wide_single_delivery():
+    nodes, _ = await make_cluster(3)
+    a, b, c = nodes
+    try:
+        boxes = []
+        for node, cid in ((b, "w1"), (c, "w2")):
+            sess, inbox = attach_client(node, cid)
+            node.broker.subscribe(sess, "$share/g/jobs/+", SubOpts(qos=0))
+            boxes.append(inbox)
+        await settle(nodes)
+        # membership replicated everywhere
+        assert len(a.cluster_shared.members("g", "jobs/+")) == 2
+        for i in range(20):
+            a.broker.publish(Message(topic=f"jobs/{i}", payload=b"j"))
+        await asyncio.sleep(0.1)
+        total = sum(len(b_) for b_ in boxes)
+        assert total == 20  # exactly-one election per publish
+    finally:
+        await stop_all(nodes)
+
+
+async def test_duplicate_clientid_kicks_old_node():
+    nodes, _ = await make_cluster(2)
+    a, b = nodes
+    try:
+        sess_a, _ = attach_client(a, "dev1")
+        await settle(nodes)
+        assert b.registry.get("dev1") == "n0"
+        sess_b, _ = attach_client(b, "dev1")
+        await settle(nodes, delay=0.1)
+        assert "dev1" not in a.broker.sessions  # kicked
+        assert "dev1" in b.broker.sessions
+        assert a.registry.get("dev1") == "n1"
+    finally:
+        await stop_all(nodes)
+
+
+async def test_session_takeover_imports_subscriptions():
+    nodes, _ = await make_cluster(2)
+    a, b = nodes
+    try:
+        sess_a, _ = attach_client(a, "roamer")
+        a.broker.subscribe(sess_a, "keep/+", SubOpts(qos=1))
+        await settle(nodes)
+        # non-clean reconnect on the other node
+        sess_b, inbox = a_inbox = b.broker.open_session("roamer", clean_start=False)
+        sess_b = b.broker.sessions["roamer"]
+        received = []
+        sess_b.outgoing_sink = lambda pkts: received.extend(pkts)
+        await settle(nodes, delay=0.1)
+        assert "keep/+" in sess_b.subscriptions
+        assert "roamer" not in a.broker.sessions
+        b.broker.publish(Message(topic="keep/x", payload=b"moved", qos=0))
+        await asyncio.sleep(0.05)
+        assert [p.payload for p in received] == [b"moved"]
+    finally:
+        await stop_all(nodes)
+
+
+async def test_nodedown_purges_routes_and_registry():
+    nodes, _ = await make_cluster(3, hb=0.05, miss=2)
+    a, b, c = nodes
+    try:
+        sess, _ = attach_client(c, "doomed")
+        c.broker.subscribe(sess, "purge/#", SubOpts(qos=0))
+        await settle(nodes)
+        assert "n2" in a.cluster_router.match_routes("purge/x")
+        assert a.registry.get("doomed") == "n2"
+        # hard-kill c: no graceful leave
+        c.membership.stop_heartbeat()
+        await c.rpc.close()
+        await asyncio.sleep(0.5)  # heartbeats miss -> down -> purge
+        assert "n2" not in a.membership.members
+        assert "n2" not in a.cluster_router.match_routes("purge/x")
+        assert "doomed" not in a.registry
+        assert "n2" not in b.cluster_router.match_routes("purge/x")
+    finally:
+        await stop_all([a, b])
+
+
+async def test_resync_after_lost_batch():
+    """A peer that misses an op batch while transiently unreachable is
+    fully resynced on the next successful heartbeat (anti-entropy)."""
+    nodes, _ = await make_cluster(2, hb=0.05, miss=100)  # never declare down
+    a, b = nodes
+    try:
+        addr_b = b.rpc.listen_addr
+        # b becomes unreachable (listener down) but is NOT dead
+        await b.rpc.close()
+        sess, inbox = attach_client(a, "pub-side")
+        a.broker.subscribe(sess, "lost/+", SubOpts(qos=0))
+        await a.flush()
+        await asyncio.sleep(0.1)
+        assert "n1" in a._resync  # batch was lost, divergence recorded
+        assert "n0" not in b.cluster_router.match_routes("lost/x")
+        # b comes back on the same address; heartbeat succeeds -> resync
+        await b.rpc.start(addr_b[0], addr_b[1])
+        await asyncio.sleep(0.3)
+        assert "n1" not in a._resync
+        assert "n0" in b.cluster_router.match_routes("lost/x")
+        b.broker.publish(Message(topic="lost/x", payload=b"found"))
+        await asyncio.sleep(0.05)
+        assert [p.payload for p in inbox] == [b"found"]
+    finally:
+        await stop_all(nodes)
+
+
+async def test_multicall_returns_errors_in_place():
+    nodes, addrs = await make_cluster(2)
+    a, b = nodes
+    try:
+        dead = ("127.0.0.1", 1)  # nothing listens here
+        res = await a.rpc.multicall(
+            [addrs[1], dead], "membership", "ping", timeout=0.5
+        )
+        assert res[0] == "pong"
+        assert isinstance(res[1], Exception)
+    finally:
+        await stop_all(nodes)
