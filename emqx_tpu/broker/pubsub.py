@@ -49,6 +49,8 @@ class Broker:
         self.metrics = Metrics()
         self.stats = Stats()
         self.sessions: Dict[str, Session] = {}
+        # live listeners (Server instances register on start)
+        self.servers: list = []
         # (filter, client) subopts — mirror of ?SUBOPTION
         self.suboptions: Dict[Tuple[str, str], SubOpts] = {}
         # durable-session manager (emqx_persistent_session_ds seam);
@@ -103,6 +105,19 @@ class Broker:
 
     def close_session(self, session: Session, discard: bool = False) -> None:
         """Drop a session and all its routes (emqx_broker:subscriber_down)."""
+        # re-entrancy guard: an admin kick closes the transport, whose
+        # teardown calls back in here — the second call must be a no-op
+        # (no duplicate terminated/discarded hooks)
+        if self.sessions.get(session.client_id) is not session:
+            return
+        # sever the transport (admin kick / takeover); harmless if the
+        # teardown originated from the connection itself
+        closer = getattr(session, "closer", None)
+        if closer is not None:
+            try:
+                closer()
+            except Exception:
+                pass
         if self.durable is not None and self._is_durable(session):
             # shared-group routes live in the live router — release them
             for flt in list(session.subscriptions):

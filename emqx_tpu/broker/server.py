@@ -68,6 +68,8 @@ class Connection:
         sess = self.channel.session
         if sess is not None:
             sess.outgoing_sink = self._send_packets
+            # admin kick severs the socket through this
+            sess.closer = self.writer.close
             # background producers (DS pump) must hop onto this loop
             # before touching the session or transport
             sess.event_loop = asyncio.get_running_loop()
@@ -121,6 +123,7 @@ class Connection:
             sess = self.channel.session
             if sess is not None and getattr(sess, "outgoing_sink", None) is self._send_packets:
                 sess.outgoing_sink = None
+                sess.closer = None
             self.channel.on_close()
             try:
                 self.writer.close()
@@ -150,12 +153,17 @@ class Server:
         self.connect_timeout = connect_timeout
         self._server: Optional[asyncio.AbstractServer] = None
         self._conns: set = set()
+        self.listen_addr = None
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
             self._on_client, self.host, self.port
         )
         addr = self._server.sockets[0].getsockname()
+        self.listen_addr = addr[:2]
+        # live-listener registry: the mgmt listeners view walks this
+        if self not in self.broker.servers:
+            self.broker.servers.append(self)
         log.info("listening on %s", addr)
 
     async def _on_client(self, reader, writer) -> None:
@@ -167,6 +175,8 @@ class Server:
             self._conns.discard(conn)
 
     async def stop(self) -> None:
+        if self in self.broker.servers:
+            self.broker.servers.remove(self)
         if self._server is not None:
             self._server.close()
             # kick live connections so wait_closed() cannot hang on them

@@ -53,6 +53,10 @@ class Session:
         self.disconnected_at: Optional[float] = None
         # counters surfaced in stats/info
         self.dropped = 0
+        # transport seams set by the connection layer: packet sink and
+        # socket closer (used by admin kick / takeover)
+        self.outgoing_sink = None
+        self.closer = None
 
     # --- packet-id allocation ------------------------------------------
 

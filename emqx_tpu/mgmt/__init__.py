@@ -1,0 +1,14 @@
+"""Management surface: REST API + CLI (the emqx_management /
+emqx_dashboard-login / emqx_ctl analogs, SURVEY.md §2.2).
+
+  * http — dependency-free asyncio HTTP/1.1 server with path-param
+           routing (the minirest analog);
+  * api  — the /api/v5 REST handlers over a live broker: clients,
+           subscriptions, topics, publish, metrics/stats, configs,
+           banned, api keys, rules, retainer, nodes;
+  * cli  — the `emqx ctl` command registry/dispatcher.
+"""
+
+from .api import ManagementApi  # noqa: F401
+from .cli import Ctl  # noqa: F401
+from .http import HttpServer, Request, Response  # noqa: F401

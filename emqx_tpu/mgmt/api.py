@@ -1,0 +1,610 @@
+"""/api/v5 REST management API over a live broker — the
+emqx_management analog (apps/emqx_management/src/emqx_mgmt_api_*.erl:
+clients, subscriptions, topics, publish, metrics, stats, nodes,
+configs, banned, api_key; retainer API from
+apps/emqx_retainer/src/emqx_retainer_api.erl; rules API from
+apps/emqx_rule_engine/src/emqx_rule_engine_api*.erl; dashboard login
+from apps/emqx_dashboard).
+
+Auth model: POST /api/v5/login issues a bearer token (dashboard
+users, default admin/public); programmatic access uses API keys via
+HTTP basic auth (emqx_mgmt_auth.erl). /status and /login are the only
+unauthenticated routes.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import secrets
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..broker.message import Message
+from ..broker.packet import SubOpts
+from ..ops import topic as topic_mod
+from . import views
+from .http import HttpServer, Request, Response
+
+TOKEN_TTL = 3600.0
+
+
+def _hash_pw(pw: str, salt: bytes) -> bytes:
+    return hashlib.pbkdf2_hmac("sha256", pw.encode(), salt, 10_000)
+
+
+def _paginate(items: List[Any], query: Dict[str, str]) -> Dict[str, Any]:
+    try:
+        page = max(1, int(query.get("page", "1")))
+        limit = max(1, min(10_000, int(query.get("limit", "100"))))
+    except ValueError:
+        raise ValueError("page/limit must be integers") from None
+    start = (page - 1) * limit
+    return {
+        "data": items[start : start + limit],
+        "meta": {
+            "page": page,
+            "limit": limit,
+            "count": len(items),
+            "hasnext": start + limit < len(items),
+        },
+    }
+
+
+class ApiKeys:
+    """API key store (apps/emqx_management/src/emqx_mgmt_auth.erl)."""
+
+    def __init__(self) -> None:
+        self._keys: Dict[str, Dict[str, Any]] = {}  # api_key -> record
+
+    def create(
+        self,
+        name: str,
+        desc: str = "",
+        enable: bool = True,
+        expired_at: Optional[float] = None,
+    ) -> Dict[str, Any]:
+        if any(r["name"] == name for r in self._keys.values()):
+            raise ValueError(f"api key name exists: {name}")
+        api_key = secrets.token_urlsafe(12)
+        api_secret = secrets.token_urlsafe(24)
+        salt = secrets.token_bytes(16)
+        self._keys[api_key] = {
+            "name": name,
+            "desc": desc,
+            "enable": enable,
+            "expired_at": expired_at,
+            "created_at": time.time(),
+            "salt": salt,
+            "secret_hash": _hash_pw(api_secret, salt),
+        }
+        # the secret is returned exactly once, at creation
+        return {"name": name, "api_key": api_key, "api_secret": api_secret}
+
+    def verify(self, api_key: str, api_secret: str) -> bool:
+        r = self._keys.get(api_key)
+        if r is None or not r["enable"]:
+            return False
+        if r["expired_at"] is not None and time.time() > r["expired_at"]:
+            return False
+        return hmac.compare_digest(r["secret_hash"], _hash_pw(api_secret, r["salt"]))
+
+    def delete(self, name: str) -> bool:
+        for k, r in list(self._keys.items()):
+            if r["name"] == name:
+                del self._keys[k]
+                return True
+        return False
+
+    def list(self) -> List[Dict[str, Any]]:
+        return [
+            {
+                "name": r["name"],
+                "api_key": k,
+                "desc": r["desc"],
+                "enable": r["enable"],
+                "expired_at": r["expired_at"],
+                "created_at": r["created_at"],
+            }
+            for k, r in self._keys.items()
+        ]
+
+
+class ManagementApi:
+    """Binds the REST surface to a broker (and optional subsystems)."""
+
+    def __init__(
+        self,
+        broker,
+        config=None,
+        rules=None,
+        banned=None,
+        node=None,  # ClusterNode, for /nodes and cluster-wide views
+        node_name: str = "emqx@127.0.0.1",
+    ):
+        self.broker = broker
+        self.config = config
+        self.rules = rules
+        self.banned = banned
+        self.node = node
+        self.node_name = node_name
+        self.started_at = time.time()
+        self.http = HttpServer()
+        self.api_keys = ApiKeys()
+        # dashboard users (default admin/public, like the reference)
+        self._users: Dict[str, Tuple[bytes, bytes]] = {}
+        self.add_user("admin", "public")
+        self._tokens: Dict[str, Tuple[str, float]] = {}
+        self.http.before.append(self._auth_mw)
+        self._register_routes()
+
+    # --- auth -------------------------------------------------------------
+
+    def add_user(self, username: str, password: str) -> None:
+        salt = secrets.token_bytes(16)
+        self._users[username] = (salt, _hash_pw(password, salt))
+
+    def _auth_mw(self, req: Request) -> Optional[Response]:
+        if req.path == "/status" or (req.method, req.path) == (
+            "POST",
+            "/api/v5/login",
+        ):
+            return None
+        auth = req.headers.get("authorization", "")
+        if auth.startswith("Bearer "):
+            tok = auth[7:]
+            ent = self._tokens.get(tok)
+            if ent and time.time() < ent[1]:
+                req.principal = ent[0]
+                return None
+        elif auth.startswith("Basic "):
+            try:
+                user, _, pw = (
+                    base64.b64decode(auth[6:]).decode("utf-8").partition(":")
+                )
+            except Exception:
+                return Response.error(401, "BAD_USERNAME_OR_PWD", "bad basic auth")
+            if self.api_keys.verify(user, pw):
+                req.principal = f"api_key:{user}"
+                return None
+        return Response.error(401, "UNAUTHORIZED", "missing or invalid credentials")
+
+    def _login(self, req: Request):
+        body = req.json() or {}
+        user, pw = body.get("username", ""), body.get("password", "")
+        ent = self._users.get(user)
+        if ent is None or not hmac.compare_digest(ent[1], _hash_pw(pw, ent[0])):
+            return Response.error(401, "BAD_USERNAME_OR_PWD", "bad credentials")
+        now = time.time()
+        self._tokens = {t: e for t, e in self._tokens.items() if e[1] > now}
+        tok = secrets.token_urlsafe(32)
+        self._tokens[tok] = (user, now + TOKEN_TTL)
+        return {"token": tok, "version": "5", "license": {"edition": "opensource"}}
+
+    # --- lifecycle --------------------------------------------------------
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0):
+        return await self.http.start(host, port)
+
+    async def stop(self) -> None:
+        await self.http.stop()
+
+    # --- route table ------------------------------------------------------
+
+    def _register_routes(self) -> None:
+        r = self.http.route
+        r("GET", "/status", self._status)
+        r("POST", "/api/v5/login", self._login)
+        r("GET", "/api/v5/nodes", self._nodes)
+        r("GET", "/api/v5/nodes/{node}", self._node_one)
+        r("GET", "/api/v5/metrics", lambda q: self.broker.metrics.all())
+        r("GET", "/api/v5/stats", lambda q: self.broker.stats.all())
+        r("GET", "/api/v5/clients", self._clients)
+        r("GET", "/api/v5/clients/{clientid}", self._client_one)
+        r("DELETE", "/api/v5/clients/{clientid}", self._client_kick)
+        r("GET", "/api/v5/clients/{clientid}/subscriptions", self._client_subs)
+        r("POST", "/api/v5/clients/{clientid}/subscribe", self._client_subscribe)
+        r("POST", "/api/v5/clients/{clientid}/unsubscribe", self._client_unsubscribe)
+        r("GET", "/api/v5/subscriptions", self._subscriptions)
+        r("GET", "/api/v5/topics", self._topics)
+        r("POST", "/api/v5/publish", self._publish)
+        r("POST", "/api/v5/publish/bulk", self._publish_bulk)
+        r("GET", "/api/v5/configs", self._config_all)
+        r("GET", "/api/v5/configs/{path...}", self._config_get)
+        r("PUT", "/api/v5/configs/{path...}", self._config_put)
+        r("GET", "/api/v5/banned", self._banned_list)
+        r("POST", "/api/v5/banned", self._banned_create)
+        r("DELETE", "/api/v5/banned/{as}/{who}", self._banned_delete)
+        r("GET", "/api/v5/api_key", lambda q: self.api_keys.list())
+        r("POST", "/api/v5/api_key", self._api_key_create)
+        r("DELETE", "/api/v5/api_key/{name}", self._api_key_delete)
+        r("GET", "/api/v5/rules", self._rules_list)
+        r("POST", "/api/v5/rules", self._rules_create)
+        r("GET", "/api/v5/rules/{id}", self._rules_one)
+        r("PUT", "/api/v5/rules/{id}", self._rules_update)
+        r("DELETE", "/api/v5/rules/{id}", self._rules_delete)
+        r("POST", "/api/v5/rule_test", self._rule_test)
+        r("GET", "/api/v5/mqtt/retainer/messages", self._retained_list)
+        r("GET", "/api/v5/mqtt/retainer/message/{topic...}", self._retained_one)
+        r("DELETE", "/api/v5/mqtt/retainer/message/{topic...}", self._retained_delete)
+
+    # --- handlers ---------------------------------------------------------
+
+    def _status(self, req: Request) -> Response:
+        return Response.text(
+            f"Node {self.node_name} is started\nemqx is running"
+        )
+
+    def _node_info(self) -> Dict[str, Any]:
+        return {
+            "node": self.node_name,
+            "node_status": "running",
+            "uptime": int((time.time() - self.started_at) * 1000),
+            "version": "0.1.0",
+            "edition": "Opensource",
+            "connections": sum(
+                1 for s in self.broker.sessions.values() if s.connected
+            ),
+            "live_connections": sum(
+                1 for s in self.broker.sessions.values() if s.connected
+            ),
+            "cluster_members": views.cluster_members(self.node, self.node_name),
+        }
+
+    def _nodes(self, req: Request):
+        return [self._node_info()]
+
+    def _node_one(self, req: Request):
+        info = self._node_info()
+        if req.params["node"] not in (self.node_name, "self"):
+            return Response.error(404, "NOT_FOUND", req.params["node"])
+        return info
+
+    def _client_info(self, s) -> Dict[str, Any]:
+        return {
+            "clientid": s.client_id,
+            "connected": s.connected,
+            "created_at": s.created_at,
+            "subscriptions_cnt": len(s.subscriptions),
+            "mqueue_len": len(s.mqueue),
+            "inflight_cnt": len(s.inflight),
+            "mqueue_dropped": s.dropped,
+            "expiry_interval": s.cfg.session_expiry_interval,
+        }
+
+    def _clients(self, req: Request):
+        items = [self._client_info(s) for s in self.broker.sessions.values()]
+        like = req.query.get("like_clientid")
+        if like:
+            items = [c for c in items if like in c["clientid"]]
+        if "conn_state" in req.query:
+            want = req.query["conn_state"] == "connected"
+            items = [c for c in items if c["connected"] == want]
+        return _paginate(items, req.query)
+
+    def _get_session(self, req: Request):
+        return self.broker.sessions.get(req.params["clientid"])
+
+    def _client_one(self, req: Request):
+        s = self._get_session(req)
+        if s is None:
+            return Response.error(404, "CLIENTID_NOT_FOUND", req.params["clientid"])
+        return self._client_info(s)
+
+    def _client_kick(self, req: Request):
+        s = self._get_session(req)
+        if s is None:
+            return Response.error(404, "CLIENTID_NOT_FOUND", req.params["clientid"])
+        self.broker.close_session(s, discard=True)
+        return 204, None
+
+    def _client_subs(self, req: Request):
+        s = self._get_session(req)
+        if s is None:
+            return Response.error(404, "CLIENTID_NOT_FOUND", req.params["clientid"])
+        return [
+            {"topic": flt, "qos": o.qos, "clientid": s.client_id}
+            for flt, o in s.subscriptions.items()
+        ]
+
+    def _client_subscribe(self, req: Request):
+        s = self._get_session(req)
+        if s is None:
+            return Response.error(404, "CLIENTID_NOT_FOUND", req.params["clientid"])
+        body = req.json() or {}
+        try:
+            flt = body["topic"]
+            opts = SubOpts(qos=int(body.get("qos", 0)))
+            retained = self.broker.subscribe(s, flt, opts)
+        except (KeyError, ValueError) as e:
+            return Response.error(400, "BAD_REQUEST", str(e))
+        views.deliver_retained(self.broker, s, retained, opts)
+        return {"clientid": s.client_id, "topic": flt, "qos": opts.qos}
+
+    def _client_unsubscribe(self, req: Request):
+        s = self._get_session(req)
+        if s is None:
+            return Response.error(404, "CLIENTID_NOT_FOUND", req.params["clientid"])
+        body = req.json() or {}
+        try:
+            self.broker.unsubscribe(s, body["topic"])
+        except (KeyError, ValueError) as e:
+            return Response.error(400, "BAD_REQUEST", str(e))
+        return 204, None
+
+    def _subscriptions(self, req: Request):
+        items = [
+            {"clientid": cid, "topic": flt, "qos": opts.qos}
+            for (flt, cid), opts in self.broker.suboptions.items()
+        ]
+        if "clientid" in req.query:
+            items = [x for x in items if x["clientid"] == req.query["clientid"]]
+        if "topic" in req.query:
+            items = [x for x in items if x["topic"] == req.query["topic"]]
+        if "qos" in req.query:
+            try:
+                want_qos = int(req.query["qos"])
+            except ValueError:
+                raise ValueError("qos must be an integer") from None
+            items = [x for x in items if x["qos"] == want_qos]
+        if "match_topic" in req.query:
+            t = topic_mod.words(req.query["match_topic"])
+            items = [
+                x
+                for x in items
+                if topic_mod.match(
+                    t, topic_mod.words(topic_mod.parse_share(x["topic"])[1])
+                )
+            ]
+        return _paginate(items, req.query)
+
+    def _topics(self, req: Request):
+        """Cluster route table view (emqx_mgmt_api_topics)."""
+        routes = [
+            {"topic": flt, "node": node}
+            for (flt, node) in views.routes_view(
+                self.broker, self.node, self.node_name
+            )
+        ]
+        if "topic" in req.query:
+            routes = [x for x in routes if x["topic"] == req.query["topic"]]
+        return _paginate(routes, req.query)
+
+    def _msg_from_body(self, body: Dict[str, Any]) -> Message:
+        payload = body.get("payload", "")
+        if body.get("payload_encoding") == "base64":
+            data = base64.b64decode(payload)
+        else:
+            data = payload.encode("utf-8") if isinstance(payload, str) else payload
+        topic_mod.validate_name(body["topic"])
+        return Message(
+            topic=body["topic"],
+            payload=data,
+            qos=int(body.get("qos", 0)),
+            retain=bool(body.get("retain", False)),
+            props=body.get("properties", {}) or {},
+        )
+
+    def _publish(self, req: Request):
+        try:
+            msg = self._msg_from_body(req.json() or {})
+        except (KeyError, ValueError) as e:
+            return Response.error(400, "BAD_REQUEST", str(e))
+        n = self.broker.publish(msg)
+        return {"id": msg.id, "delivered": n}
+
+    def _publish_bulk(self, req: Request):
+        try:
+            msgs = [self._msg_from_body(b) for b in (req.json() or [])]
+        except (KeyError, ValueError) as e:
+            return Response.error(400, "BAD_REQUEST", str(e))
+        counts = self.broker.publish_batch(msgs)
+        return [
+            {"id": m.id, "delivered": n} for m, n in zip(msgs, counts)
+        ]
+
+    def _config_all(self, req: Request):
+        if self.config is None:
+            return Response.error(404, "NO_CONFIG", "no config attached")
+        return self.config.to_dict()
+
+    def _config_get(self, req: Request):
+        if self.config is None:
+            return Response.error(404, "NO_CONFIG", "no config attached")
+        path = req.params["path"].replace("/", ".")
+        try:
+            return {"value": self.config.get(path)}
+        except KeyError:
+            return Response.error(404, "CONFIG_PATH_NOT_FOUND", path)
+
+    def _config_put(self, req: Request):
+        if self.config is None:
+            return Response.error(404, "NO_CONFIG", "no config attached")
+        path = req.params["path"].replace("/", ".")
+        body = req.json()
+        try:
+            self.config.update(path, body["value"])
+        except KeyError:
+            return Response.error(400, "BAD_REQUEST", "body must be {\"value\": ...}")
+        except Exception as e:
+            return Response.error(400, "UPDATE_FAILED", str(e))
+        return {"value": self.config.get(path)}
+
+    def _banned_list(self, req: Request):
+        if self.banned is None:
+            return _paginate([], req.query)
+        items = [
+            {
+                "as": e.who_type,
+                "who": e.who,
+                "by": e.by,
+                "reason": e.reason,
+                "until": e.until,
+            }
+            for e in self.banned.list()
+        ]
+        return _paginate(items, req.query)
+
+    def _banned_create(self, req: Request):
+        if self.banned is None:
+            return Response.error(404, "NO_BANNED", "banned table not attached")
+        b = req.json() or {}
+        try:
+            until = b.get("until")
+            duration = (
+                None if until is None else max(0.0, float(until) - time.time())
+            )
+            self.banned.create(
+                b["as"],
+                b["who"],
+                by=b.get("by", req.principal or "mgmt_api"),
+                reason=b.get("reason", ""),
+                duration_s=duration,
+            )
+        except KeyError as e:
+            return Response.error(400, "BAD_REQUEST", f"missing field {e}")
+        except ValueError as e:
+            return Response.error(400, "BAD_REQUEST", str(e))
+        return 201, b
+
+    def _banned_delete(self, req: Request):
+        if self.banned is None or not self.banned.delete(
+            req.params["as"], req.params["who"]
+        ):
+            return Response.error(404, "NOT_FOUND", req.params["who"])
+        return 204, None
+
+    def _api_key_create(self, req: Request):
+        b = req.json() or {}
+        try:
+            return 201, self.api_keys.create(
+                b["name"],
+                desc=b.get("desc", ""),
+                enable=b.get("enable", True),
+                expired_at=b.get("expired_at"),
+            )
+        except KeyError:
+            return Response.error(400, "BAD_REQUEST", "missing name")
+        except ValueError as e:
+            return Response.error(400, "NAME_EXISTS", str(e))
+
+    def _api_key_delete(self, req: Request):
+        if not self.api_keys.delete(req.params["name"]):
+            return Response.error(404, "NOT_FOUND", req.params["name"])
+        return 204, None
+
+    # --- rules ------------------------------------------------------------
+
+    def _rule_info(self, rule) -> Dict[str, Any]:
+        return {
+            "id": rule.id,
+            "sql": rule.sql,
+            "enable": rule.enable,
+            "description": rule.description,
+            "actions": rule.actions,
+            "metrics": {
+                "matched": rule.metrics.matched,
+                "passed": rule.metrics.passed,
+                "failed": rule.metrics.failed,
+                "no_result": rule.metrics.no_result,
+                "actions.success": rule.metrics.actions_success,
+                "actions.failed": rule.metrics.actions_failed,
+            },
+        }
+
+    def _rules_list(self, req: Request):
+        if self.rules is None:
+            return _paginate([], req.query)
+        return _paginate(
+            [self._rule_info(r) for r in self.rules.rules.values()], req.query
+        )
+
+    def _rules_create(self, req: Request):
+        if self.rules is None:
+            return Response.error(404, "NO_RULES", "rule engine not attached")
+        b = req.json() or {}
+        try:
+            rule = self.rules.create_rule(
+                sql=b["sql"],
+                actions=b.get("actions", []),
+                rule_id=b.get("id") or f"rule_{uuid.uuid4().hex[:8]}",
+                enable=b.get("enable", True),
+                description=b.get("description", ""),
+            )
+        except KeyError:
+            return Response.error(400, "BAD_REQUEST", "missing sql")
+        except Exception as e:
+            return Response.error(400, "BAD_SQL", str(e))
+        return 201, self._rule_info(rule)
+
+    def _rules_one(self, req: Request):
+        rule = self.rules.rules.get(req.params["id"]) if self.rules else None
+        if rule is None:
+            return Response.error(404, "NOT_FOUND", req.params["id"])
+        return self._rule_info(rule)
+
+    def _rules_update(self, req: Request):
+        if self.rules is None:
+            return Response.error(404, "NO_RULES", "rule engine not attached")
+        b = req.json() or {}
+        try:
+            rule = self.rules.update_rule(req.params["id"], **b)
+        except KeyError:
+            return Response.error(404, "NOT_FOUND", req.params["id"])
+        except Exception as e:
+            return Response.error(400, "BAD_SQL", str(e))
+        return self._rule_info(rule)
+
+    def _rules_delete(self, req: Request):
+        if self.rules is None or not self.rules.delete_rule(req.params["id"]):
+            return Response.error(404, "NOT_FOUND", req.params["id"])
+        return 204, None
+
+    def _rule_test(self, req: Request):
+        """Dry-run a SQL statement against a test context
+        (emqx_rule_sqltester)."""
+        if self.rules is None:
+            return Response.error(404, "NO_RULES", "rule engine not attached")
+        b = req.json() or {}
+        try:
+            out = self.rules.test_sql(b["sql"], b.get("context", {}))
+        except KeyError:
+            return Response.error(400, "BAD_REQUEST", "missing sql")
+        except Exception as e:
+            return Response.error(400, "BAD_SQL", str(e))
+        if out is None:
+            return Response.error(412, "SQL_NOT_MATCH", "no match")
+        return out
+
+    # --- retainer ---------------------------------------------------------
+
+    def _retained_info(self, m: Message) -> Dict[str, Any]:
+        return {
+            "topic": m.topic,
+            "qos": m.qos,
+            "payload": base64.b64encode(m.payload).decode(),
+            "publish_at": m.timestamp,
+            "from_clientid": m.from_client,
+        }
+
+    def _retained_list(self, req: Request):
+        msgs = self.broker.retainer.read("#")
+        return _paginate([self._retained_info(m) for m in msgs], req.query)
+
+    def _retained_one(self, req: Request):
+        msgs = self.broker.retainer.read(req.params["topic"])
+        exact = [m for m in msgs if m.topic == req.params["topic"]]
+        if not exact:
+            return Response.error(404, "NOT_FOUND", req.params["topic"])
+        return self._retained_info(exact[0])
+
+    def _retained_delete(self, req: Request):
+        t = req.params["topic"]
+        if not [m for m in self.broker.retainer.read(t) if m.topic == t]:
+            return Response.error(404, "NOT_FOUND", t)
+        # retained delete = empty-payload retain (MQTT semantics)
+        self.broker.retainer.retain(Message(topic=t, payload=b"", retain=True))
+        return 204, None

@@ -1,0 +1,278 @@
+"""`emqx ctl` analog: a command registry + dispatcher
+(apps/emqx_ctl/src/emqx_ctl.erl registry; command impls from
+apps/emqx_management/src/emqx_mgmt_cli.erl).
+
+Commands take (ctl, args) and return output text. Unknown commands and
+`help` print usage, like `emqx ctl` with no args lists all commands.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..broker.message import Message
+from ..broker.packet import SubOpts
+from . import views
+
+
+class Ctl:
+    def __init__(
+        self,
+        broker,
+        config=None,
+        rules=None,
+        banned=None,
+        node=None,
+        node_name: str = "emqx@127.0.0.1",
+    ):
+        self.broker = broker
+        self.config = config
+        self.rules = rules
+        self.banned = banned
+        self.node = node
+        self.node_name = node_name
+        self.started_at = time.time()
+        self._cmds: Dict[str, Tuple[Callable, str]] = {}
+        self._register_builtin()
+
+    def register(self, name: str, fn: Callable, usage: str) -> None:
+        """Plugin seam: apps register their own ctl commands
+        (emqx_ctl:register_command)."""
+        self._cmds[name] = (fn, usage)
+
+    def unregister(self, name: str) -> None:
+        self._cmds.pop(name, None)
+
+    def run(self, argv: List[str]) -> str:
+        if not argv or argv[0] in ("help", "--help"):
+            lines = ["Usage: ctl <command> [args...]", ""]
+            for name in sorted(self._cmds):
+                lines.append(f"  {self._cmds[name][1]}")
+            return "\n".join(lines)
+        name, *args = argv
+        ent = self._cmds.get(name)
+        if ent is None:
+            return f"unknown command: {name!r} (try 'help')"
+        try:
+            return ent[0](args)
+        except (IndexError, KeyError, ValueError) as e:
+            return f"error: {e}\nusage: {ent[1]}"
+
+    # --- builtin commands -------------------------------------------------
+
+    def _register_builtin(self) -> None:
+        reg = self.register
+        reg("status", self._status, "status                  # broker status")
+        reg("broker", self._broker, "broker                  # broker overview")
+        reg("metrics", self._metrics, "metrics                 # all counters")
+        reg("stats", self._stats, "stats                   # all gauges")
+        reg("cluster", self._cluster, "cluster status          # membership view")
+        reg(
+            "clients",
+            self._clients,
+            "clients list | show <clientid> | kick <clientid>",
+        )
+        reg(
+            "subscriptions",
+            self._subscriptions,
+            "subscriptions list | show <clientid> | add <clientid> <topic> <qos>"
+            " | del <clientid> <topic>",
+        )
+        reg("topics", self._topics, "topics list | show <topic>")
+        reg("publish", self._publish, "publish <topic> <payload> [qos] [retain]")
+        reg(
+            "retainer",
+            self._retainer,
+            "retainer info | topics | clean [topic]",
+        )
+        reg("rules", self._rules, "rules list | show <id> | delete <id>")
+        reg(
+            "banned",
+            self._banned,
+            "banned list | add <as> <who> [seconds] | del <as> <who>",
+        )
+        reg("listeners", self._listeners, "listeners               # active listeners")
+
+    def _status(self, args) -> str:
+        up = int(time.time() - self.started_at)
+        return (
+            f"Node {self.node_name} is started\n"
+            f"emqx 0.1.0 is running, uptime {up}s"
+        )
+
+    def _broker(self, args) -> str:
+        st = self.broker.stats
+        return "\n".join(
+            [
+                f"sysdescr  : emqx-tpu broker",
+                f"node      : {self.node_name}",
+                f"sessions  : {st.val('sessions.count')}",
+                f"subscriptions : {st.val('subscriptions.count')}",
+                f"uptime    : {int(time.time() - self.started_at)}s",
+            ]
+        )
+
+    def _metrics(self, args) -> str:
+        return "\n".join(
+            f"{k:<40} : {v}" for k, v in sorted(self.broker.metrics.all().items())
+        )
+
+    def _stats(self, args) -> str:
+        return "\n".join(
+            f"{k:<40} : {v}" for k, v in sorted(self.broker.stats.all().items())
+        )
+
+    def _cluster(self, args) -> str:
+        members = views.cluster_members(self.node, self.node_name)
+        if self.node is None:
+            return f"running nodes: {members} (standalone)"
+        return f"Cluster status: #{{running_nodes => {members}}}"
+
+    def _clients(self, args) -> str:
+        sub = args[0] if args else "list"
+        if sub == "list":
+            return "\n".join(
+                f"Client(clientid={s.client_id}, connected={s.connected}, "
+                f"subscriptions={len(s.subscriptions)})"
+                for s in self.broker.sessions.values()
+            ) or "(none)"
+        cid = args[1]
+        s = self.broker.sessions.get(cid)
+        if s is None:
+            return f"client {cid!r} not found"
+        if sub == "show":
+            return (
+                f"Client(clientid={s.client_id}, connected={s.connected}, "
+                f"created_at={s.created_at}, subscriptions={len(s.subscriptions)}, "
+                f"mqueue={len(s.mqueue)}, inflight={len(s.inflight)})"
+            )
+        if sub == "kick":
+            self.broker.close_session(s, discard=True)
+            return f"ok, kicked {cid}"
+        raise ValueError(f"bad subcommand {sub!r}")
+
+    def _subscriptions(self, args) -> str:
+        sub = args[0] if args else "list"
+        if sub == "list":
+            return "\n".join(
+                f"{cid} -> {flt} (qos{o.qos})"
+                for (flt, cid), o in self.broker.suboptions.items()
+            ) or "(none)"
+        if sub == "show":
+            cid = args[1]
+            s = self.broker.sessions.get(cid)
+            if s is None:
+                return f"client {cid!r} not found"
+            return "\n".join(
+                f"{flt} (qos{o.qos})" for flt, o in s.subscriptions.items()
+            ) or "(none)"
+        if sub == "add":
+            cid, flt, qos = args[1], args[2], int(args[3])
+            s = self.broker.sessions.get(cid)
+            if s is None:
+                return f"client {cid!r} not found"
+            self.broker.subscribe(s, flt, SubOpts(qos=qos))
+            return "ok"
+        if sub == "del":
+            cid, flt = args[1], args[2]
+            s = self.broker.sessions.get(cid)
+            if s is None:
+                return f"client {cid!r} not found"
+            self.broker.unsubscribe(s, flt)
+            return "ok"
+        raise ValueError(f"bad subcommand {sub!r}")
+
+    def _topics(self, args) -> str:
+        sub = args[0] if args else "list"
+        pairs = views.routes_view(self.broker, self.node, self.node_name)
+        if sub == "list":
+            return "\n".join(f"{t} -> {n}" for t, n in pairs) or "(none)"
+        if sub == "show":
+            t = args[1]
+            hits = [(f, n) for f, n in pairs if f == t]
+            return "\n".join(f"{f} -> {n}" for f, n in hits) or f"{t!r} not routed"
+        raise ValueError(f"bad subcommand {sub!r}")
+
+    def _publish(self, args) -> str:
+        topic, payload = args[0], args[1]
+        qos = int(args[2]) if len(args) > 2 else 0
+        retain = len(args) > 3 and args[3] in ("1", "true", "retain")
+        n = self.broker.publish(
+            Message(topic=topic, payload=payload.encode(), qos=qos, retain=retain)
+        )
+        return f"ok, delivered to {n} subscribers"
+
+    def _retainer(self, args) -> str:
+        sub = args[0] if args else "info"
+        ret = self.broker.retainer
+        if sub == "info":
+            return f"retained messages: {len(ret)}"
+        if sub == "topics":
+            return "\n".join(m.topic for m in ret.read("#")) or "(none)"
+        if sub == "clean":
+            flt = args[1] if len(args) > 1 else "#"
+            msgs = ret.read(flt)
+            for m in msgs:
+                ret.retain(Message(topic=m.topic, payload=b"", retain=True))
+            return f"cleaned {len(msgs)} retained messages"
+        raise ValueError(f"bad subcommand {sub!r}")
+
+    def _rules(self, args) -> str:
+        if self.rules is None:
+            return "rule engine not attached"
+        sub = args[0] if args else "list"
+        if sub == "list":
+            return "\n".join(
+                f"Rule(id={r.id}, enabled={r.enable}, sql={r.sql!r})"
+                for r in self.rules.rules.values()
+            ) or "(none)"
+        rid = args[1]
+        if sub == "show":
+            r = self.rules.rules.get(rid)
+            if r is None:
+                return f"rule {rid!r} not found"
+            return json.dumps(
+                {
+                    "id": r.id,
+                    "sql": r.sql,
+                    "enable": r.enable,
+                    "actions": r.actions,
+                    "matched": r.metrics.matched,
+                },
+                indent=2,
+            )
+        if sub == "delete":
+            return "ok" if self.rules.delete_rule(rid) else f"rule {rid!r} not found"
+        raise ValueError(f"bad subcommand {sub!r}")
+
+    def _banned(self, args) -> str:
+        if self.banned is None:
+            return "banned table not attached"
+        sub = args[0] if args else "list"
+        if sub == "list":
+            return "\n".join(
+                f"banned {e.who_type} {e.who!r} by {e.by} until "
+                f"{'forever' if e.until is None else e.until}"
+                for e in self.banned.list()
+            ) or "(none)"
+        if sub == "add":
+            dur = float(args[3]) if len(args) > 3 else None
+            self.banned.create(args[1], args[2], by="cli", duration_s=dur)
+            return "ok"
+        if sub == "del":
+            ok = self.banned.delete(args[1], args[2])
+            return "ok" if ok else "not found"
+        raise ValueError(f"bad subcommand {sub!r}")
+
+    def _listeners(self, args) -> str:
+        ls = views.listeners_view(self.broker)
+        if not ls:
+            return "(no live listeners)"
+        return "\n".join(
+            f"{l['id']}\n  listen_on : {l['bind']}\n  running   : "
+            f"{str(l['running']).lower()}\n  current_conns : "
+            f"{l['current_connections']}"
+            for l in ls
+        )

@@ -1,0 +1,58 @@
+"""Shared read-model helpers used by both the REST API and the CLI so
+the two surfaces can never diverge (emqx_mgmt.erl plays this role for
+emqx_mgmt_api_* and emqx_mgmt_cli in the reference)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+
+def routes_view(broker, node, node_name: str) -> List[Tuple[str, str]]:
+    """(topic/filter, node) pairs — cluster table when clustered, the
+    local router otherwise."""
+    if node is not None:
+        return sorted(node._cluster_pairs)
+    return [(t, node_name) for t in broker.router.topics()]
+
+
+def cluster_members(node, node_name: str) -> List[str]:
+    if node is not None:
+        return sorted([node.node_id, *node.membership.members])
+    return [node_name]
+
+
+def listeners_view(broker) -> List[Dict[str, Any]]:
+    out = []
+    for srv in getattr(broker, "servers", ()):
+        if srv.listen_addr is not None:
+            out.append(
+                {
+                    "id": "tcp:default",
+                    "type": "tcp",
+                    "bind": f"{srv.listen_addr[0]}:{srv.listen_addr[1]}",
+                    "running": True,
+                    "current_connections": len(srv._conns),
+                }
+            )
+    return out
+
+
+def deliver_retained(broker, session, retained, opts) -> None:
+    """Deliver retained messages for an API-initiated subscription the
+    same way the channel does on SUBSCRIBE (retain flag preserved,
+    subscription qos cap)."""
+    from ..broker.message import Message
+
+    sink = getattr(session, "outgoing_sink", None)
+    for m in retained:
+        rm = Message(**{**m.__dict__})
+        rm.retain = True
+        ropts = type(opts)(
+            qos=opts.qos,
+            no_local=opts.no_local,
+            retain_as_published=True,
+            retain_handling=opts.retain_handling,
+        )
+        pkts = session.deliver(rm, ropts)
+        if pkts and sink is not None:
+            sink(pkts)

@@ -1,0 +1,417 @@
+"""Management REST API + CLI tests, driven over real HTTP sockets
+(the reference tests emqx_mgmt_api_*_SUITE drive minirest the same
+way)."""
+
+import asyncio
+import base64
+import json
+
+import pytest
+
+from emqx_tpu.auth.banned import Banned
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.packet import SubOpts
+from emqx_tpu.broker.pubsub import Broker
+from emqx_tpu.mgmt import Ctl, ManagementApi
+from emqx_tpu.rules.engine import RuleEngine
+
+
+async def http_req(port, method, path, body=None, token=None, basic=None):
+    """Tiny HTTP/1.1 client over asyncio streams."""
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    data = b"" if body is None else json.dumps(body).encode()
+    headers = [
+        f"{method} {path} HTTP/1.1",
+        "host: localhost",
+        f"content-length: {len(data)}",
+        "connection: close",
+    ]
+    if token:
+        headers.append(f"authorization: Bearer {token}")
+    if basic:
+        headers.append(
+            "authorization: Basic "
+            + base64.b64encode(f"{basic[0]}:{basic[1]}".encode()).decode()
+        )
+    writer.write("\r\n".join(headers).encode() + b"\r\n\r\n" + data)
+    await writer.drain()
+    raw = await reader.read(-1)
+    writer.close()
+    head, _, payload = raw.partition(b"\r\n\r\n")
+    status = int(head.split(b" ", 2)[1])
+    obj = json.loads(payload) if payload.strip() else None
+    return status, obj
+
+
+class Api:
+    """Bound helper: carries port + auth."""
+
+    def __init__(self, port, token=None, basic=None):
+        self.port, self.token, self.basic = port, token, basic
+
+    async def __call__(self, method, path, body=None):
+        return await http_req(
+            self.port, method, path, body, token=self.token, basic=self.basic
+        )
+
+
+async def make_api(**kw):
+    broker = Broker()
+    mgmt = ManagementApi(broker, **kw)
+    host, port = await mgmt.start()
+    _, login = await http_req(
+        port, "POST", "/api/v5/login",
+        {"username": "admin", "password": "public"},
+    )
+    return broker, mgmt, Api(port, token=login["token"])
+
+
+def sess(broker, cid, subs=()):
+    s, _ = broker.open_session(cid, clean_start=True)
+    inbox = []
+    s.outgoing_sink = lambda pkts: inbox.extend(pkts)
+    for flt in subs:
+        broker.subscribe(s, flt, SubOpts(qos=0))
+    return s, inbox
+
+
+async def test_status_unauthenticated():
+    broker, mgmt, api = await make_api()
+    try:
+        reader, writer = await asyncio.open_connection("127.0.0.1", api.port)
+        writer.write(b"GET /status HTTP/1.1\r\nhost: x\r\nconnection: close\r\n\r\n")
+        raw = await reader.read(-1)
+        writer.close()
+        assert b"200" in raw.split(b"\r\n")[0]
+        assert b"emqx is running" in raw
+    finally:
+        await mgmt.stop()
+
+
+async def test_auth_required_and_login():
+    broker, mgmt, api = await make_api()
+    try:
+        st, body = await http_req(api.port, "GET", "/api/v5/clients")
+        assert st == 401
+        st, _ = await http_req(
+            api.port, "POST", "/api/v5/login",
+            {"username": "admin", "password": "wrong"},
+        )
+        assert st == 401
+        st, body = await api("GET", "/api/v5/clients")
+        assert st == 200 and body["data"] == []
+    finally:
+        await mgmt.stop()
+
+
+async def test_api_key_basic_auth():
+    broker, mgmt, api = await make_api()
+    try:
+        st, created = await api("POST", "/api/v5/api_key", {"name": "ci"})
+        assert st == 201 and "api_secret" in created
+        key_api = Api(api.port, basic=(created["api_key"], created["api_secret"]))
+        st, _ = await key_api("GET", "/api/v5/metrics")
+        assert st == 200
+        st, _ = await key_api("GET", "/api/v5/api_key")
+        assert st == 200
+        st, _ = await api("DELETE", "/api/v5/api_key/ci")
+        assert st == 204
+        st, _ = await key_api("GET", "/api/v5/metrics")
+        assert st == 401  # revoked
+    finally:
+        await mgmt.stop()
+
+
+async def test_clients_and_subscriptions_views():
+    broker, mgmt, api = await make_api()
+    try:
+        sess(broker, "alpha", subs=["t/1", "t/+"])
+        sess(broker, "beta", subs=["x/#"])
+        st, body = await api("GET", "/api/v5/clients")
+        assert st == 200 and body["meta"]["count"] == 2
+        st, body = await api("GET", "/api/v5/clients?like_clientid=alp")
+        assert [c["clientid"] for c in body["data"]] == ["alpha"]
+        st, one = await api("GET", "/api/v5/clients/alpha")
+        assert one["subscriptions_cnt"] == 2
+        st, subs = await api("GET", "/api/v5/clients/alpha/subscriptions")
+        assert {s["topic"] for s in subs} == {"t/1", "t/+"}
+        st, body = await api("GET", "/api/v5/subscriptions?match_topic=x/y/z")
+        assert [s["topic"] for s in body["data"]] == ["x/#"]
+        st, body = await api("GET", "/api/v5/subscriptions?clientid=alpha")
+        assert body["meta"]["count"] == 2
+        # kick
+        st, _ = await api("DELETE", "/api/v5/clients/beta")
+        assert st == 204
+        assert "beta" not in broker.sessions
+        st, _ = await api("GET", "/api/v5/clients/beta")
+        assert st == 404
+    finally:
+        await mgmt.stop()
+
+
+async def test_subscribe_unsubscribe_via_api():
+    broker, mgmt, api = await make_api()
+    try:
+        s, inbox = sess(broker, "dev1")
+        st, _ = await api(
+            "POST", "/api/v5/clients/dev1/subscribe", {"topic": "cmd/+", "qos": 1}
+        )
+        assert st == 200
+        broker.publish(Message(topic="cmd/go", payload=b"x"))
+        assert len(inbox) == 1
+        st, _ = await api(
+            "POST", "/api/v5/clients/dev1/unsubscribe", {"topic": "cmd/+"}
+        )
+        assert st == 204
+        broker.publish(Message(topic="cmd/go", payload=b"y"))
+        assert len(inbox) == 1
+    finally:
+        await mgmt.stop()
+
+
+async def test_publish_api_and_topics():
+    broker, mgmt, api = await make_api()
+    try:
+        _, inbox = sess(broker, "listener", subs=["news/#"])
+        st, out = await api(
+            "POST", "/api/v5/publish", {"topic": "news/a", "payload": "hello"}
+        )
+        assert st == 200 and out["delivered"] == 1
+        assert inbox[0].payload == b"hello"
+        # base64 payload
+        st, out = await api(
+            "POST",
+            "/api/v5/publish",
+            {
+                "topic": "news/b",
+                "payload": base64.b64encode(b"\x00\x01").decode(),
+                "payload_encoding": "base64",
+            },
+        )
+        assert inbox[1].payload == b"\x00\x01"
+        # bulk
+        st, out = await api(
+            "POST",
+            "/api/v5/publish/bulk",
+            [
+                {"topic": "news/c", "payload": "1"},
+                {"topic": "nobody/listens", "payload": "2"},
+            ],
+        )
+        assert [o["delivered"] for o in out] == [1, 0]
+        # topics view shows the route
+        st, body = await api("GET", "/api/v5/topics")
+        assert {"topic": "news/#", "node": "emqx@127.0.0.1"} in body["data"]
+        # invalid topic rejected
+        st, _ = await api(
+            "POST", "/api/v5/publish", {"topic": "bad/+/wild", "payload": "x"}
+        )
+        assert st == 400
+    finally:
+        await mgmt.stop()
+
+
+async def test_metrics_stats_nodes():
+    broker, mgmt, api = await make_api()
+    try:
+        sess(broker, "c1", subs=["a/b"])
+        broker.publish(Message(topic="a/b", payload=b"m"))
+        st, metrics = await api("GET", "/api/v5/metrics")
+        assert metrics["messages.received"] == 1
+        st, stats = await api("GET", "/api/v5/stats")
+        assert stats["sessions.count"] == 1
+        st, nodes = await api("GET", "/api/v5/nodes")
+        assert nodes[0]["node_status"] == "running"
+        st, one = await api("GET", "/api/v5/nodes/emqx@127.0.0.1")
+        assert one["connections"] == 1
+    finally:
+        await mgmt.stop()
+
+
+async def test_banned_crud():
+    banned = Banned()
+    broker, mgmt, api = await make_api(banned=banned)
+    try:
+        st, _ = await api(
+            "POST", "/api/v5/banned",
+            {"as": "clientid", "who": "evil", "reason": "spam"},
+        )
+        assert st == 201
+        assert banned.check("evil") is not None
+        st, body = await api("GET", "/api/v5/banned")
+        assert body["data"][0]["who"] == "evil"
+        st, _ = await api("DELETE", "/api/v5/banned/clientid/evil")
+        assert st == 204
+        assert banned.check("evil") is None
+        st, _ = await api("DELETE", "/api/v5/banned/clientid/evil")
+        assert st == 404
+    finally:
+        await mgmt.stop()
+
+
+async def test_rules_crud_and_test():
+    broker = Broker()
+    rules = RuleEngine(broker)
+    rules.install(broker.hooks)
+    mgmt = ManagementApi(broker, rules=rules)
+    _, port = await mgmt.start()
+    _, login = await http_req(
+        port, "POST", "/api/v5/login", {"username": "admin", "password": "public"}
+    )
+    api = Api(port, token=login["token"])
+    try:
+        st, rule = await api(
+            "POST",
+            "/api/v5/rules",
+            {
+                "id": "r1",
+                "sql": 'SELECT payload FROM "sensors/+"',
+                "actions": [{"function": "republish", "args": {"topic": "out/t"}}],
+            },
+        )
+        assert st == 201
+        _, inbox = sess(broker, "watcher", subs=["out/t"])
+        broker.publish(Message(topic="sensors/1", payload=b'{"v":1}'))
+        assert len(inbox) == 1
+        st, got = await api("GET", "/api/v5/rules/r1")
+        assert got["metrics"]["matched"] == 1
+        st, body = await api("GET", "/api/v5/rules")
+        assert body["meta"]["count"] == 1
+        st, upd = await api("PUT", "/api/v5/rules/r1", {"enable": False})
+        assert upd["enable"] is False
+        st, _ = await api(
+            "POST",
+            "/api/v5/rule_test",
+            {
+                "sql": 'SELECT payload.x FROM "t"',
+                "context": {"topic": "t", "payload": '{"x": 42}'},
+            },
+        )
+        assert st == 200
+        st, _ = await api("POST", "/api/v5/rules", {"sql": "NOT VALID SQL"})
+        assert st == 400
+        st, _ = await api("DELETE", "/api/v5/rules/r1")
+        assert st == 204
+        st, _ = await api("GET", "/api/v5/rules/r1")
+        assert st == 404
+    finally:
+        await mgmt.stop()
+
+
+async def test_retainer_api():
+    broker, mgmt, api = await make_api()
+    try:
+        broker.publish(
+            Message(topic="cfg/a", payload=b"keep", retain=True, qos=1)
+        )
+        st, body = await api("GET", "/api/v5/mqtt/retainer/messages")
+        assert body["meta"]["count"] == 1
+        st, one = await api("GET", "/api/v5/mqtt/retainer/message/cfg/a")
+        assert base64.b64decode(one["payload"]) == b"keep"
+        st, _ = await api("DELETE", "/api/v5/mqtt/retainer/message/cfg/a")
+        assert st == 204
+        st, _ = await api("GET", "/api/v5/mqtt/retainer/message/cfg/a")
+        assert st == 404
+    finally:
+        await mgmt.stop()
+
+
+async def test_pagination():
+    broker, mgmt, api = await make_api()
+    try:
+        for i in range(25):
+            sess(broker, f"c{i:02}")
+        st, body = await api("GET", "/api/v5/clients?limit=10&page=3")
+        assert body["meta"]["count"] == 25
+        assert len(body["data"]) == 5
+        assert body["meta"]["hasnext"] is False
+        st, body = await api("GET", "/api/v5/clients?limit=10&page=1")
+        assert len(body["data"]) == 10 and body["meta"]["hasnext"] is True
+    finally:
+        await mgmt.stop()
+
+
+async def test_kick_closes_live_tcp_connection():
+    from emqx_tpu.broker.server import Server
+    from test_broker_e2e import MiniClient
+
+    broker = Broker()
+    server = Server(broker, port=0)
+    await server.start()
+    mgmt = ManagementApi(broker)
+    _, port = await mgmt.start()
+    _, login = await http_req(
+        port, "POST", "/api/v5/login", {"username": "admin", "password": "public"}
+    )
+    api = Api(port, token=login["token"])
+    try:
+        c = MiniClient(server.listen_addr[1])
+        await c.connect("victim")
+        st, _ = await api("DELETE", "/api/v5/clients/victim")
+        assert st == 204
+        assert "victim" not in broker.sessions
+        # the socket is really severed: reads hit EOF
+        data = await asyncio.wait_for(c.reader.read(-1), 2.0)
+        assert data == b""
+    finally:
+        await mgmt.stop()
+        await server.stop()
+
+
+async def test_api_subscribe_delivers_retained():
+    broker, mgmt, api = await make_api()
+    try:
+        broker.publish(Message(topic="cfg/x", payload=b"saved", retain=True))
+        s, inbox = sess(broker, "late")
+        st, _ = await api(
+            "POST", "/api/v5/clients/late/subscribe", {"topic": "cfg/#"}
+        )
+        assert st == 200
+        assert [p.payload for p in inbox] == [b"saved"]
+        assert inbox[0].retain is True
+        # malformed bodies are 400s, not 500s
+        st, _ = await api("POST", "/api/v5/clients/late/subscribe", {"qos": 1})
+        assert st == 400
+        st, _ = await api(
+            "POST", "/api/v5/clients/late/subscribe", {"topic": "a/#/b"}
+        )
+        assert st == 400
+        st, body = await api("GET", "/api/v5/clients?page=abc")
+        assert st == 400
+    finally:
+        await mgmt.stop()
+
+
+# --- CLI -----------------------------------------------------------------
+
+
+def test_cli_commands():
+    broker = Broker()
+    rules = RuleEngine(broker)
+    banned = Banned()
+    ctl = Ctl(broker, rules=rules, banned=banned)
+    s, inbox = sess(broker, "dev1")
+    assert "is running" in ctl.run(["status"])
+    assert "unknown command" in ctl.run(["nope"])
+    assert "Usage" in ctl.run([])
+    assert "ok" == ctl.run(["subscriptions", "add", "dev1", "t/+", "1"])
+    assert "delivered to 1" in ctl.run(["publish", "t/x", "hi"])
+    assert inbox[0].payload == b"hi"
+    assert "dev1" in ctl.run(["clients", "list"])
+    assert "t/+" in ctl.run(["subscriptions", "show", "dev1"])
+    assert "t/+" in ctl.run(["topics", "list"])
+    assert "sessions" in ctl.run(["broker"])
+    assert "messages.received" in ctl.run(["metrics"])
+    assert "subscriptions.count" in ctl.run(["stats"])
+    assert "standalone" in ctl.run(["cluster", "status"])
+    ctl.run(["banned", "add", "clientid", "evil"])
+    assert "evil" in ctl.run(["banned", "list"])
+    assert "ok" == ctl.run(["banned", "del", "clientid", "evil"])
+    broker.publish(Message(topic="keep/me", payload=b"x", retain=True))
+    assert "retained messages: 1" in ctl.run(["retainer", "info"])
+    assert "keep/me" in ctl.run(["retainer", "topics"])
+    assert "cleaned 1" in ctl.run(["retainer", "clean"])
+    assert "kicked" in ctl.run(["clients", "kick", "dev1"])
+    # custom command registration (plugin seam)
+    ctl.register("hello", lambda args: f"hi {args[0]}", "hello <name>")
+    assert ctl.run(["hello", "world"]) == "hi world"
