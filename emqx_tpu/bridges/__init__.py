@@ -1,0 +1,22 @@
+"""Data integration: resource lifecycle + buffered delivery +
+connectors + bridges (the emqx_resource / emqx_connector / emqx_bridge
+v2 actions/sources stack, SURVEY.md §2.6).
+
+  * resource   — Connector behaviour, BufferWorker (batching, retry,
+                 inflight, overflow), Resource manager with health
+                 checks and auto-restart;
+  * connectors — MQTT (egress+ingress), HTTP/webhook, console, mock;
+  * bridge     — named bridges: connector + actions (egress, fed by
+                 local topic filters or rule actions) + sources
+                 (ingress publishing into the local broker).
+"""
+
+from .bridge import Bridge, BridgeRegistry  # noqa: F401
+from .resource import (  # noqa: F401
+    BufferWorker,
+    Connector,
+    QueryError,
+    RecoverableError,
+    Resource,
+    ResourceStatus,
+)

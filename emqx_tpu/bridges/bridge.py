@@ -1,0 +1,223 @@
+"""Bridges: named connector + actions (egress) + sources (ingress).
+
+The emqx_bridge v2 model (apps/emqx_bridge/src/emqx_bridge_v2.erl):
+a bridge wraps one Resource; its EGRESS leg forwards locally-published
+messages matching `local_topic` (or rows sent by a rule action)
+through the buffer worker with topic/payload templates; its INGRESS
+leg turns remote messages (delivered by the connector, e.g. an MQTT
+subscription) into local publishes. Bridges register the "bridge"
+rule-action provider so rules can target them by name.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+from typing import Any, Dict, List, Optional
+
+from ..broker.message import Message
+from ..ops import topic as topic_mod
+from ..ops.host_index import TopicTrie
+from ..rules.engine import render_template
+from .resource import Connector, Resource, ResourceStatus
+
+log = logging.getLogger("emqx_tpu.bridges")
+
+
+def _msg_env(msg: Message) -> Dict[str, Any]:
+    try:
+        payload_str = msg.payload.decode("utf-8")
+    except UnicodeDecodeError:
+        payload_str = msg.payload.decode("latin-1")
+    return {
+        "topic": msg.topic,
+        "payload": payload_str,
+        "clientid": msg.from_client,
+        "qos": msg.qos,
+        "retain": msg.retain,
+        "id": msg.id,
+        "timestamp": msg.timestamp,
+    }
+
+
+class Bridge:
+    def __init__(
+        self,
+        name: str,
+        resource: Resource,
+        egress: Optional[Dict[str, Any]] = None,
+        ingress: Optional[Dict[str, Any]] = None,
+    ):
+        self.name = name
+        self.resource = resource
+        # egress: {local_topic, remote_topic, payload, qos, retain}
+        self.egress = egress
+        # ingress: {local_topic, qos, payload} (remote filter lives in
+        # the connector's subscriptions)
+        self.ingress = ingress
+        self.enabled = True
+        self.created_at = time.time()
+
+    @property
+    def status(self) -> ResourceStatus:
+        return self.resource.status
+
+    def render_egress(self, env: Dict[str, Any]) -> Dict[str, Any]:
+        eg = self.egress or {}
+        payload_tpl = eg.get("payload")
+        if payload_tpl:
+            payload = render_template(payload_tpl, env)
+        elif "payload" in env:
+            payload = env["payload"]
+        else:
+            payload = json.dumps(env, default=str)
+        return {
+            "topic": render_template(
+                eg.get("remote_topic", "${topic}"), env
+            ),
+            "payload": payload.encode() if isinstance(payload, str) else payload,
+            "qos": eg.get("qos", 1),
+            "retain": eg.get("retain", False),
+        }
+
+    def info(self) -> Dict[str, Any]:
+        m = self.resource.metrics
+        return {
+            "name": self.name,
+            "enabled": self.enabled,
+            "status": self.status.value,
+            "error": self.resource.error,
+            "egress": self.egress,
+            "ingress": self.ingress,
+            "metrics": {
+                "matched": m.val("matched"),
+                "success": m.val("success"),
+                "failed": m.val("failed"),
+                "retried": m.val("retried"),
+                "dropped": m.val("dropped.queue_full"),
+                "queuing": self.resource.buffer.queuing,
+                "inflight": self.resource.buffer.inflight,
+            },
+        }
+
+
+class BridgeRegistry:
+    def __init__(self, broker, rules=None):
+        self.broker = broker
+        self.rules = rules
+        self.bridges: Dict[str, Bridge] = {}
+        # local_topic filter index -> bridge names (egress matching)
+        self._egress_index = TopicTrie()
+        self._installed = False
+        if rules is not None:
+            rules.action_providers["bridge"] = self._rule_action
+
+    # --- lifecycle ----------------------------------------------------------
+
+    def install(self) -> None:
+        if not self._installed:
+            self.broker.hooks.add(
+                "message.publish", self._on_publish, priority=40
+            )
+            self._installed = True
+
+    async def create(
+        self,
+        name: str,
+        connector: Connector,
+        egress: Optional[Dict[str, Any]] = None,
+        ingress: Optional[Dict[str, Any]] = None,
+        start: bool = True,
+        **resource_opts,
+    ) -> Bridge:
+        if name in self.bridges:
+            raise ValueError(f"bridge {name!r} exists")
+        if ingress is not None and hasattr(connector, "on_ingress"):
+            connector.on_ingress = self._make_ingress_cb(name, ingress)
+        resource = Resource(f"bridge:{name}", connector, **resource_opts)
+        bridge = Bridge(name, resource, egress=egress, ingress=ingress)
+        self.bridges[name] = bridge
+        if egress and egress.get("local_topic"):
+            self._egress_index.insert(
+                topic_mod.words(egress["local_topic"]), name
+            )
+        self.install()
+        if start:
+            await resource.start()
+        return bridge
+
+    async def delete(self, name: str) -> bool:
+        bridge = self.bridges.pop(name, None)
+        if bridge is None:
+            return False
+        if bridge.egress and bridge.egress.get("local_topic"):
+            self._egress_index.remove(
+                topic_mod.words(bridge.egress["local_topic"]), name
+            )
+        await bridge.resource.stop()
+        return True
+
+    async def stop_all(self) -> None:
+        for name in list(self.bridges):
+            await self.delete(name)
+
+    def list(self) -> List[Dict[str, Any]]:
+        return [b.info() for b in self.bridges.values()]
+
+    # --- egress (local publishes -> remote) ---------------------------------
+
+    def _on_publish(self, msg, acc=None):
+        m = msg if isinstance(msg, Message) else acc
+        if not isinstance(m, Message):
+            return None
+        if m.topic.startswith("$"):
+            return None
+        names = self._egress_index.match(topic_mod.words(m.topic))
+        for name in names:
+            bridge = self.bridges.get(name)
+            if bridge is None or not bridge.enabled:
+                continue
+            # loop guard: don't re-forward what this bridge ingested
+            if m.headers.get("bridge_ingress") == name:
+                continue
+            bridge.resource.query_async(bridge.render_egress(_msg_env(m)))
+        return None
+
+    # --- ingress (remote -> local publishes) --------------------------------
+
+    def _make_ingress_cb(self, name: str, ingress: Dict[str, Any]):
+        def cb(pkt) -> None:
+            env = {
+                "topic": pkt.topic,
+                "payload": pkt.payload.decode("utf-8", "replace"),
+                "qos": pkt.qos,
+                "retain": pkt.retain,
+            }
+            local_topic = render_template(
+                ingress.get("local_topic", "${topic}"), env
+            )
+            msg = Message(
+                topic=local_topic,
+                payload=pkt.payload,
+                qos=ingress.get("qos", pkt.qos),
+                retain=bool(ingress.get("retain", False)) and pkt.retain,
+                from_client=f"bridge:{name}",
+            )
+            msg.headers["bridge_ingress"] = name
+            self.broker.publish(msg)
+
+        return cb
+
+    # --- rule action provider ----------------------------------------------
+
+    def _rule_action(
+        self, args: Dict[str, Any], row: Dict[str, Any], env: Dict[str, Any]
+    ) -> None:
+        name = args.get("name") or args.get("bridge")
+        bridge = self.bridges.get(name)
+        if bridge is None:
+            raise ValueError(f"bridge {name!r} not found")
+        tpl_env = {**env, **row}
+        bridge.resource.query_async(bridge.render_egress(tpl_env))

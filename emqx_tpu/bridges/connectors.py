@@ -1,0 +1,220 @@
+"""Concrete connectors.
+
+  * MqttConnector — the MQTT bridge driver (egress publish + ingress
+    subscription), apps/emqx_bridge_mqtt/src/emqx_bridge_mqtt_connector.erl;
+  * HttpConnector — webhook POST driver,
+    apps/emqx_bridge_http/src/emqx_bridge_http_connector.erl;
+  * ConsoleConnector — the rule-engine console action sink;
+  * MockConnector — in-memory driver for tests (records requests,
+    scriptable failures).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Any, Callable, Dict, List, Optional
+
+from ..client import MqttClient, MqttError
+from .resource import Connector, QueryError, RecoverableError, ResourceStatus
+
+
+class MqttConnector(Connector):
+    """Requests are dicts: {"topic", "payload", "qos", "retain"}."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        client_id: str = "bridge",
+        subscriptions: Optional[List[str]] = None,
+        on_ingress: Optional[Callable] = None,
+        qos_in: int = 1,
+        proto_ver: int = 4,
+    ):
+        self.host, self.port = host, port
+        self.client_id = client_id
+        self.subscriptions = subscriptions or []
+        self.on_ingress = on_ingress
+        self.qos_in = qos_in
+        self.proto_ver = proto_ver
+        self.client: Optional[MqttClient] = None
+
+    async def on_start(self) -> None:
+        self.client = MqttClient(
+            self.host,
+            self.port,
+            client_id=self.client_id,
+            proto_ver=self.proto_ver,
+            reconnect=True,
+            reconnect_delay=0.5,
+            on_message=self.on_ingress,
+        )
+        await self.client.connect()
+        if self.subscriptions:
+            await self.client.subscribe(*self.subscriptions, qos=self.qos_in)
+
+    async def on_stop(self) -> None:
+        if self.client is not None:
+            await self.client.disconnect()
+            self.client = None
+
+    async def on_query(self, request: Dict[str, Any]) -> None:
+        if self.client is None or not self.client.connected:
+            raise RecoverableError("mqtt bridge not connected")
+        try:
+            await self.client.publish(
+                request["topic"],
+                request.get("payload", b""),
+                qos=request.get("qos", 0),
+                retain=request.get("retain", False),
+            )
+        except (ConnectionError, OSError, asyncio.TimeoutError, MqttError) as e:
+            # MqttError covers in-flight acks failed by a dropped
+            # connection ("connection lost"/"not connected") — these
+            # must survive into the retry path, not be dropped
+            raise RecoverableError(str(e)) from e
+
+    async def health_check(self) -> ResourceStatus:
+        if self.client is not None and self.client.connected:
+            return ResourceStatus.CONNECTED
+        return ResourceStatus.DISCONNECTED
+
+
+class HttpConnector(Connector):
+    """Webhook driver. Requests: {"path", "method", "body", "headers"}
+    merged over the connector-level defaults."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        path: str = "/",
+        method: str = "POST",
+        headers: Optional[Dict[str, str]] = None,
+        timeout: float = 5.0,
+    ):
+        self.host, self.port = host, port
+        self.path, self.method = path, method
+        self.headers = headers or {"content-type": "application/json"}
+        self.timeout = timeout
+
+    async def on_query(self, request: Dict[str, Any]) -> int:
+        if "body" in request:
+            body = request["body"]
+        elif "payload" in request:
+            # mqtt-shaped request from a bridge egress leg: the webhook
+            # default body is the message as JSON (the reference's
+            # webhook template default)
+            body = {
+                "topic": request.get("topic"),
+                "payload": (
+                    request["payload"].decode("utf-8", "replace")
+                    if isinstance(request["payload"], (bytes, bytearray))
+                    else request["payload"]
+                ),
+                "qos": request.get("qos", 0),
+                "retain": request.get("retain", False),
+            }
+        else:
+            body = b""
+        if isinstance(body, str):
+            body = body.encode()
+        elif not isinstance(body, (bytes, bytearray)):
+            body = json.dumps(body).encode()
+        method = request.get("method", self.method)
+        path = request.get("path", self.path)
+        headers = {**self.headers, **request.get("headers", {})}
+        try:
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(self.host, self.port), self.timeout
+            )
+        except (OSError, asyncio.TimeoutError) as e:
+            raise RecoverableError(f"connect failed: {e}") from e
+        try:
+            head = [f"{method} {path} HTTP/1.1", f"host: {self.host}"]
+            head += [f"{k}: {v}" for k, v in headers.items()]
+            head += [f"content-length: {len(body)}", "connection: close"]
+            writer.write("\r\n".join(head).encode() + b"\r\n\r\n" + body)
+            await writer.drain()
+            raw = await asyncio.wait_for(reader.read(-1), self.timeout)
+            status = int(raw.split(b" ", 2)[1])
+        except (OSError, asyncio.TimeoutError, ConnectionError) as e:
+            raise RecoverableError(f"request failed: {e}") from e
+        except (IndexError, ValueError) as e:
+            raise QueryError(f"bad http response: {e}") from e
+        finally:
+            writer.close()
+        if status >= 500:
+            raise RecoverableError(f"server error {status}")
+        if status >= 400:
+            raise QueryError(f"rejected {status}")
+        return status
+
+    async def health_check(self) -> ResourceStatus:
+        try:
+            _r, w = await asyncio.wait_for(
+                asyncio.open_connection(self.host, self.port), self.timeout
+            )
+            w.close()
+            return ResourceStatus.CONNECTED
+        except (OSError, asyncio.TimeoutError):
+            return ResourceStatus.DISCONNECTED
+
+
+class ConsoleConnector(Connector):
+    """Prints/collects requests (the rule-engine console sink)."""
+
+    def __init__(self, sink: Optional[Callable[[Any], None]] = None):
+        self.sink = sink or (lambda r: print(f"[console] {r}"))
+
+    async def on_query(self, request: Any) -> None:
+        self.sink(request)
+
+
+class MockConnector(Connector):
+    """Test driver: records everything; failures scripted via
+    `fail_next` (int) or `fail_when` predicate; `started`/`healthy`
+    flags model driver state."""
+
+    def __init__(self) -> None:
+        self.requests: List[Any] = []
+        self.batches: List[List[Any]] = []
+        self.fail_next = 0
+        self.fail_recoverable = True
+        self.healthy = True
+        self.started = False
+        self.start_count = 0
+
+    async def on_start(self) -> None:
+        if not self.healthy:
+            raise ConnectionError("mock down")
+        self.started = True
+        self.start_count += 1
+
+    async def on_stop(self) -> None:
+        self.started = False
+
+    def _maybe_fail(self) -> None:
+        if self.fail_next > 0:
+            self.fail_next -= 1
+            if self.fail_recoverable:
+                raise RecoverableError("mock transient")
+            raise QueryError("mock fatal")
+
+    async def on_query(self, request: Any) -> Any:
+        self._maybe_fail()
+        self.requests.append(request)
+        return request
+
+    async def on_batch_query(self, requests: List[Any]) -> None:
+        self._maybe_fail()
+        self.batches.append(list(requests))
+        self.requests.extend(requests)
+
+    async def health_check(self) -> ResourceStatus:
+        return (
+            ResourceStatus.CONNECTED
+            if self.healthy and self.started
+            else ResourceStatus.DISCONNECTED
+        )

@@ -1,0 +1,299 @@
+"""Resource lifecycle + buffered async delivery.
+
+The emqx_resource analog: a Connector implements the driver behaviour
+(emqx_resource.erl callbacks on_start/on_stop/on_query/on_batch_query/
+on_get_status); a Resource owns one started connector, a BufferWorker,
+and a health-check loop that flips status between connected/
+connecting/disconnected and restarts the driver with backoff
+(emqx_resource_manager.erl). The BufferWorker reproduces
+emqx_resource_buffer_worker.erl: bounded queue (overflow drops
+OLDEST, counted), size/time batching, an inflight window, and
+retry-with-backoff on recoverable errors — a retry PAUSES the pump so
+no newer request is dispatched until it resolves (queued order is
+preserved; batches already in the inflight window may still complete
+out of order, the same caveat as the reference's async mode) — and
+drop on unrecoverable ones.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+import logging
+import time
+from collections import deque
+from typing import Any, Callable, Deque, Dict, List, Optional
+
+log = logging.getLogger("emqx_tpu.bridges.resource")
+
+
+class ResourceStatus(str, enum.Enum):
+    CONNECTED = "connected"
+    CONNECTING = "connecting"
+    DISCONNECTED = "disconnected"
+    STOPPED = "stopped"
+
+
+class QueryError(Exception):
+    """Unrecoverable query failure: the request is dropped."""
+
+
+class RecoverableError(QueryError):
+    """Transient failure: the buffer worker blocks and retries
+    (emqx_resource_buffer_worker 'recoverable_error')."""
+
+
+class Connector:
+    """Driver behaviour. Subclasses implement the async callbacks."""
+
+    async def on_start(self) -> None:
+        pass
+
+    async def on_stop(self) -> None:
+        pass
+
+    async def on_query(self, request: Any) -> Any:
+        raise NotImplementedError
+
+    async def on_batch_query(self, requests: List[Any]) -> Any:
+        # default: sequential single queries (drivers override to batch
+        # natively, like the kafka/influx bridges)
+        for r in requests:
+            await self.on_query(r)
+
+    async def health_check(self) -> ResourceStatus:
+        return ResourceStatus.CONNECTED
+
+
+class Metrics:
+    def __init__(self) -> None:
+        self.counters: Dict[str, int] = {}
+
+    def inc(self, name: str, n: int = 1) -> None:
+        self.counters[name] = self.counters.get(name, 0) + n
+
+    def val(self, name: str) -> int:
+        return self.counters.get(name, 0)
+
+
+class BufferWorker:
+    def __init__(
+        self,
+        connector: Connector,
+        max_queue: int = 10_000,
+        batch_size: int = 1,
+        batch_time: float = 0.01,
+        inflight_window: int = 8,
+        max_retries: Optional[int] = None,  # None = retry forever
+        retry_interval: float = 0.2,
+        metrics: Optional[Metrics] = None,
+    ):
+        self.connector = connector
+        self.max_queue = max_queue
+        self.batch_size = batch_size
+        self.batch_time = batch_time
+        self.inflight_window = inflight_window
+        self.max_retries = max_retries
+        self.retry_interval = retry_interval
+        self.metrics = metrics or Metrics()
+        self._queue: Deque[Any] = deque()
+        self._wake = asyncio.Event()
+        self._task: Optional[asyncio.Task] = None
+        self._inflight = asyncio.Semaphore(inflight_window)
+        self._inflight_count = 0
+        self._send_tasks: set = set()
+        # set while a recoverable failure is being retried: the pump
+        # must not dispatch newer work past a blocked batch
+        self._resume = asyncio.Event()
+        self._resume.set()
+        self._idle = asyncio.Event()
+        self._idle.set()
+
+    # --- enqueue (the async cast path) -------------------------------------
+
+    def submit(self, request: Any) -> None:
+        self.metrics.inc("matched")
+        if len(self._queue) >= self.max_queue:
+            self._queue.popleft()  # drop OLDEST (replayq overflow mode)
+            self.metrics.inc("dropped.queue_full")
+        self._queue.append(request)
+        self._idle.clear()
+        self._wake.set()
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.create_task(self._run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        # orphaned retry loops must not outlive the resource
+        for t in list(self._send_tasks):
+            t.cancel()
+        if self._send_tasks:
+            await asyncio.gather(*self._send_tasks, return_exceptions=True)
+        self._send_tasks.clear()
+
+    async def drain(self, timeout: float = 10.0) -> None:
+        """Wait until queue AND inflight are empty (test/shutdown aid)."""
+        await asyncio.wait_for(self._idle.wait(), timeout)
+
+    @property
+    def queuing(self) -> int:
+        return len(self._queue)
+
+    @property
+    def inflight(self) -> int:
+        return self._inflight_count
+
+    # --- pump ---------------------------------------------------------------
+
+    async def _run(self) -> None:
+        while True:
+            if not self._queue:
+                if self._inflight_count == 0:
+                    self._idle.set()
+                self._wake.clear()
+                await self._wake.wait()
+            await self._resume.wait()  # a retrying batch blocks the pump
+            batch = await self._collect_batch()
+            if not batch:
+                continue
+            await self._inflight.acquire()
+            self._inflight_count += 1
+            t = asyncio.ensure_future(self._send(batch))
+            self._send_tasks.add(t)
+            t.add_done_callback(self._send_tasks.discard)
+
+    async def _collect_batch(self) -> List[Any]:
+        if self.batch_size <= 1:
+            return [self._queue.popleft()] if self._queue else []
+        deadline = time.monotonic() + self.batch_time
+        while (
+            len(self._queue) < self.batch_size
+            and time.monotonic() < deadline
+        ):
+            await asyncio.sleep(min(0.001, self.batch_time / 4))
+        batch = []
+        while self._queue and len(batch) < self.batch_size:
+            batch.append(self._queue.popleft())
+        return batch
+
+    async def _send(self, batch: List[Any]) -> None:
+        try:
+            attempt = 0
+            while True:
+                try:
+                    if len(batch) == 1:
+                        await self.connector.on_query(batch[0])
+                    else:
+                        await self.connector.on_batch_query(batch)
+                    self.metrics.inc("success", len(batch))
+                    return
+                except RecoverableError:
+                    attempt += 1
+                    self.metrics.inc("retried")
+                    if (
+                        self.max_retries is not None
+                        and attempt > self.max_retries
+                    ):
+                        self.metrics.inc("failed", len(batch))
+                        return
+                    # bounded backoff; the pump pauses so newer work
+                    # queues up behind this batch instead of passing it
+                    self._resume.clear()
+                    await asyncio.sleep(
+                        min(self.retry_interval * (2 ** min(attempt, 6)), 5.0)
+                    )
+                except Exception:
+                    log.exception("query failed (unrecoverable)")
+                    self.metrics.inc("failed", len(batch))
+                    return
+        finally:
+            self._resume.set()
+            self._inflight_count -= 1
+            self._inflight.release()
+            if self._inflight_count == 0 and not self._queue:
+                self._idle.set()
+
+
+class Resource:
+    """One started connector + buffer + health loop
+    (emqx_resource_manager.erl lifecycle)."""
+
+    def __init__(
+        self,
+        resource_id: str,
+        connector: Connector,
+        health_interval: float = 1.0,
+        **buffer_opts,
+    ):
+        self.id = resource_id
+        self.connector = connector
+        self.status = ResourceStatus.STOPPED
+        self.health_interval = health_interval
+        self.buffer = BufferWorker(connector, **buffer_opts)
+        self.metrics = self.buffer.metrics
+        self._health_task: Optional[asyncio.Task] = None
+        self.error: Optional[str] = None
+
+    async def start(self) -> None:
+        self.status = ResourceStatus.CONNECTING
+        try:
+            await self.connector.on_start()
+            self.status = await self.connector.health_check()
+            self.error = None
+        except Exception as e:
+            self.status = ResourceStatus.DISCONNECTED
+            self.error = repr(e)
+        self.buffer.start()
+        if self._health_task is None:
+            self._health_task = asyncio.create_task(self._health_loop())
+
+    async def stop(self) -> None:
+        if self._health_task is not None:
+            self._health_task.cancel()
+            self._health_task = None
+        await self.buffer.stop()
+        try:
+            await self.connector.on_stop()
+        except Exception:
+            pass
+        self.status = ResourceStatus.STOPPED
+
+    def query_async(self, request: Any) -> None:
+        """Fire-and-forget through the buffer (the bridge data path)."""
+        self.buffer.submit(request)
+
+    async def query_sync(self, request: Any) -> Any:
+        """Bypass the buffer (rule-test / health probes)."""
+        return await self.connector.on_query(request)
+
+    async def _health_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.health_interval)
+            try:
+                status = await self.connector.health_check()
+            except Exception as e:
+                status = ResourceStatus.DISCONNECTED
+                self.error = repr(e)
+            if status == ResourceStatus.DISCONNECTED:
+                # auto-restart the driver (resource_manager reconnect)
+                self.status = ResourceStatus.CONNECTING
+                try:
+                    await self.connector.on_stop()
+                except Exception:
+                    pass
+                try:
+                    await self.connector.on_start()
+                    status = await self.connector.health_check()
+                    self.error = None
+                except Exception as e:
+                    status = ResourceStatus.DISCONNECTED
+                    self.error = repr(e)
+            self.status = status

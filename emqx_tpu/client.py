@@ -1,0 +1,333 @@
+"""Asyncio MQTT client — the emqtt analog (the reference vendors the
+emqtt client for bridges, cluster link, and tests; rebar.config:104).
+
+Built on the broker's own codec (broker/frame.py). Supports MQTT
+3.1.1/5.0, QoS 0/1/2 publish, subscriptions with a message callback or
+inbox queue, keepalive pings, clean/persistent sessions, and
+auto-reconnect with resubscribe (the bridge ingress requirement,
+apps/emqx_bridge_mqtt/src/emqx_bridge_mqtt_ingress.erl).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Awaitable, Callable, Dict, List, Optional, Tuple
+
+from .broker import frame
+from .broker.packet import (
+    MQTT_V4,
+    MQTT_V5,
+    Connack,
+    Connect,
+    Disconnect,
+    Pingreq,
+    Pingresp,
+    Puback,
+    Publish,
+    Suback,
+    Subscribe,
+    SubOpts,
+    Type,
+    Unsuback,
+    Unsubscribe,
+    Will,
+)
+
+log = logging.getLogger("emqx_tpu.client")
+
+
+class MqttError(Exception):
+    pass
+
+
+class MqttClient:
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 1883,
+        client_id: str = "",
+        proto_ver: int = MQTT_V4,
+        clean_start: bool = True,
+        keepalive: int = 60,
+        username: Optional[str] = None,
+        password: Optional[bytes] = None,
+        will: Optional[Will] = None,
+        reconnect: bool = False,
+        reconnect_delay: float = 1.0,
+        on_message: Optional[Callable[[Publish], "None | Awaitable[None]"]] = None,
+        on_connected: Optional[Callable[[], "None | Awaitable[None]"]] = None,
+        on_disconnected: Optional[Callable[[], None]] = None,
+    ):
+        self.host, self.port = host, port
+        self.client_id = client_id
+        self.proto_ver = proto_ver
+        self.clean_start = clean_start
+        self.keepalive = keepalive
+        self.username, self.password = username, password
+        self.will = will
+        self.reconnect = reconnect
+        self.reconnect_delay = reconnect_delay
+        self.on_message = on_message
+        self.on_connected = on_connected
+        self.on_disconnected = on_disconnected
+        self.inbox: "asyncio.Queue[Publish]" = asyncio.Queue()
+        self.connected = False
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._reader_task: Optional[asyncio.Task] = None
+        self._ping_task: Optional[asyncio.Task] = None
+        self._reconnect_task: Optional[asyncio.Task] = None
+        self._pending: Dict[Tuple[str, int], asyncio.Future] = {}
+        self._pid = 0
+        self._subs: Dict[str, SubOpts] = {}  # for resubscribe on reconnect
+        self._closing = False
+        # QoS2 receive state (pids we PUBRECed, awaiting PUBREL)
+        self._rx_qos2: set = set()
+
+    # --- connection lifecycle ---------------------------------------------
+
+    async def connect(self, timeout: float = 10.0) -> Connack:
+        reader, writer = await asyncio.open_connection(self.host, self.port)
+        parser = frame.Parser(proto_ver=self.proto_ver)
+        try:
+            self._writer = writer
+            self._send(
+                Connect(
+                    proto_ver=self.proto_ver,
+                    clean_start=self.clean_start,
+                    keepalive=self.keepalive,
+                    client_id=self.client_id,
+                    username=self.username,
+                    password=self.password,
+                    will=self.will,
+                )
+            )
+            await writer.drain()
+            ack = await asyncio.wait_for(self._read_one(reader, parser), timeout)
+            if not isinstance(ack, Connack):
+                raise MqttError(f"expected CONNACK, got {ack!r}")
+            if ack.code != 0:
+                raise MqttError(f"connection refused: code {ack.code}")
+        except BaseException:
+            # refused/malformed/timed-out handshakes must not leak the
+            # socket (reconnect loops call this every half second)
+            self._writer = None
+            writer.close()
+            raise
+        self.connected = True
+        self._closing = False
+        self._reader_task = asyncio.create_task(self._read_loop(reader, parser))
+        if self.keepalive:
+            self._ping_task = asyncio.create_task(self._ping_loop())
+        try:
+            if self._subs:  # resubscribe on reconnect
+                await self._do_subscribe(dict(self._subs))
+            if self.on_connected is not None:
+                out = self.on_connected()
+                if asyncio.iscoroutine(out):
+                    await out
+        except BaseException:
+            # a failed resubscribe must not leave this half-set-up
+            # connection alive while the reconnect loop opens another
+            self._teardown()
+            raise
+        return ack
+
+    async def disconnect(self) -> None:
+        self._closing = True
+        if self._reconnect_task is not None:
+            self._reconnect_task.cancel()
+            self._reconnect_task = None
+        if self.connected and self._writer is not None:
+            try:
+                self._send(Disconnect())
+                await self._writer.drain()
+            except (ConnectionError, OSError):
+                pass
+        self._teardown()
+
+    def _teardown(self) -> None:
+        self.connected = False
+        self._rx_qos2.clear()
+        for t in (self._reader_task, self._ping_task):
+            if t is not None:
+                t.cancel()
+        self._reader_task = self._ping_task = None
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(MqttError("connection lost"))
+                fut.exception()
+        self._pending.clear()
+
+    def _on_conn_lost(self) -> None:
+        was_connected = self.connected
+        self._teardown()
+        if self.on_disconnected is not None and was_connected:
+            self.on_disconnected()
+        if self.reconnect and not self._closing and self._reconnect_task is None:
+            self._reconnect_task = asyncio.create_task(self._reconnect_loop())
+
+    async def _reconnect_loop(self) -> None:
+        try:
+            while not self._closing:
+                await asyncio.sleep(self.reconnect_delay)
+                try:
+                    await self.connect()
+                    return
+                except (OSError, MqttError, asyncio.TimeoutError):
+                    continue
+        finally:
+            self._reconnect_task = None
+
+    # --- io ----------------------------------------------------------------
+
+    def _send(self, pkt) -> None:
+        if self._writer is None:
+            raise MqttError("not connected")
+        self._writer.write(frame.serialize(pkt, self.proto_ver))
+
+    async def _read_one(self, reader, parser):
+        while True:
+            data = await reader.read(65536)
+            if not data:
+                raise ConnectionError("eof")
+            pkts = parser.feed(data)
+            if pkts:
+                assert len(pkts) == 1
+                return pkts[0]
+
+    async def _read_loop(self, reader, parser) -> None:
+        try:
+            while True:
+                data = await reader.read(65536)
+                if not data:
+                    break
+                for pkt in parser.feed(data):
+                    await self._handle(pkt)
+        except (ConnectionError, asyncio.CancelledError, frame.FrameError):
+            pass
+        finally:
+            self._on_conn_lost()
+
+    async def _ping_loop(self) -> None:
+        while True:
+            await asyncio.sleep(max(1.0, self.keepalive * 0.75))
+            try:
+                self._send(Pingreq())
+                await self._writer.drain()
+            except (MqttError, ConnectionError, OSError, AttributeError):
+                return
+
+    def _resolve(self, kind: str, pid: int, value=None) -> None:
+        fut = self._pending.pop((kind, pid), None)
+        if fut is not None and not fut.done():
+            fut.set_result(value)
+
+    async def _handle(self, pkt) -> None:
+        if isinstance(pkt, Publish):
+            await self._handle_publish(pkt)
+        elif isinstance(pkt, Puback):
+            if pkt.type == Type.PUBACK:
+                self._resolve("puback", pkt.packet_id)
+            elif pkt.type == Type.PUBREC:
+                # QoS2 sender: PUBREC -> PUBREL, wait for PUBCOMP
+                self._send(Puback(Type.PUBREL, pkt.packet_id))
+                await self._writer.drain()
+            elif pkt.type == Type.PUBCOMP:
+                self._resolve("pubcomp", pkt.packet_id)
+            elif pkt.type == Type.PUBREL:
+                # QoS2 receiver: release
+                self._rx_qos2.discard(pkt.packet_id)
+                self._send(Puback(Type.PUBCOMP, pkt.packet_id))
+                await self._writer.drain()
+        elif isinstance(pkt, Suback):
+            self._resolve("suback", pkt.packet_id, pkt.codes)
+        elif isinstance(pkt, Unsuback):
+            self._resolve("unsuback", pkt.packet_id)
+        elif isinstance(pkt, (Pingresp, Disconnect)):
+            pass
+
+    async def _handle_publish(self, pkt: Publish) -> None:
+        if pkt.qos == 1:
+            self._send(Puback(Type.PUBACK, pkt.packet_id))
+            await self._writer.drain()
+        elif pkt.qos == 2:
+            first = pkt.packet_id not in self._rx_qos2
+            self._rx_qos2.add(pkt.packet_id)
+            self._send(Puback(Type.PUBREC, pkt.packet_id))
+            await self._writer.drain()
+            if not first:
+                return  # duplicate delivery of an unreleased pid
+        if self.on_message is not None:
+            out = self.on_message(pkt)
+            if asyncio.iscoroutine(out):
+                await out
+        else:
+            self.inbox.put_nowait(pkt)
+
+    # --- operations ---------------------------------------------------------
+
+    def _next_pid(self) -> int:
+        self._pid = self._pid % 0xFFFF + 1
+        return self._pid
+
+    async def subscribe(
+        self, *filters: str, qos: int = 0, timeout: float = 10.0
+    ) -> List[int]:
+        subs = {f: SubOpts(qos=qos) for f in filters}
+        self._subs.update(subs)
+        return await self._do_subscribe(subs, timeout)
+
+    async def _do_subscribe(self, subs: Dict[str, SubOpts], timeout: float = 10.0):
+        pid = self._next_pid()
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[("suback", pid)] = fut
+        self._send(Subscribe(pid, list(subs.items())))
+        await self._writer.drain()
+        return await asyncio.wait_for(fut, timeout)
+
+    async def unsubscribe(self, *filters: str, timeout: float = 10.0) -> None:
+        for f in filters:
+            self._subs.pop(f, None)
+        pid = self._next_pid()
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[("unsuback", pid)] = fut
+        self._send(Unsubscribe(pid, list(filters)))
+        await self._writer.drain()
+        await asyncio.wait_for(fut, timeout)
+
+    async def publish(
+        self,
+        topic: str,
+        payload: bytes = b"",
+        qos: int = 0,
+        retain: bool = False,
+        props: Optional[dict] = None,
+        timeout: float = 10.0,
+    ) -> None:
+        """Publish; QoS1 awaits PUBACK, QoS2 awaits PUBCOMP."""
+        pid = self._next_pid() if qos else None
+        pkt = Publish(
+            topic=topic,
+            payload=payload,
+            qos=qos,
+            retain=retain,
+            packet_id=pid,
+            props=props or {},
+        )
+        if qos == 0:
+            self._send(pkt)
+            await self._writer.drain()
+            return
+        kind = "puback" if qos == 1 else "pubcomp"
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[(kind, pid)] = fut
+        self._send(pkt)
+        await self._writer.drain()
+        await asyncio.wait_for(fut, timeout)
+
+    async def recv(self, timeout: float = 5.0) -> Publish:
+        return await asyncio.wait_for(self.inbox.get(), timeout)

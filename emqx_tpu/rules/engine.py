@@ -223,6 +223,8 @@ class RuleEngine:
         self._index = TopicTrie()
         self._event_rules: Dict[str, Set[str]] = {}  # event topic -> rule ids
         self._installed = False
+        # named action providers: kind -> fn(args, row, env)
+        self.action_providers: Dict[str, Any] = {}
 
     # --- CRUD -----------------------------------------------------------
 
@@ -391,6 +393,10 @@ class RuleEngine:
             self.broker.publish(out)
         elif callable(kind):
             kind(row, env)
+        elif kind in self.action_providers:
+            # registered providers (bridges register "bridge" here —
+            # the actions-v2 seam of emqx_bridge_v2:send_message)
+            self.action_providers[kind](action.get("args", {}), row, env)
         else:
             raise ValueError(f"unknown action {kind!r}")
 

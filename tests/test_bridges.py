@@ -1,0 +1,399 @@
+"""Data-integration tests: buffer worker semantics, resource health,
+the MQTT client, and end-to-end MQTT/HTTP bridges between two live
+brokers (the reference covers this in emqx_bridge_mqtt_SUITE /
+emqx_resource buffer worker suites)."""
+
+import asyncio
+import json
+
+import pytest
+
+from emqx_tpu.bridges import BridgeRegistry, BufferWorker, Resource, ResourceStatus
+from emqx_tpu.bridges.connectors import (
+    ConsoleConnector,
+    HttpConnector,
+    MockConnector,
+    MqttConnector,
+)
+from emqx_tpu.bridges.resource import QueryError, RecoverableError
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.packet import SubOpts
+from emqx_tpu.broker.pubsub import Broker
+from emqx_tpu.broker.server import Server
+from emqx_tpu.client import MqttClient
+from emqx_tpu.mgmt.http import HttpServer, Response
+from emqx_tpu.rules.engine import RuleEngine
+
+
+async def make_broker_server():
+    broker = Broker()
+    server = Server(broker, port=0)
+    await server.start()
+    return broker, server, server.listen_addr[1]
+
+
+def capture(broker, cid, *filters, qos=0):
+    s, _ = broker.open_session(cid, clean_start=True)
+    box = []
+    s.outgoing_sink = lambda pkts: box.extend(pkts)
+    for f in filters:
+        broker.subscribe(s, f, SubOpts(qos=qos))
+    return box
+
+
+# --- buffer worker -------------------------------------------------------
+
+
+async def test_buffer_batching():
+    mock = MockConnector()
+    w = BufferWorker(mock, batch_size=4, batch_time=0.01)
+    w.start()
+    for i in range(10):
+        w.submit(i)
+    await w.drain()
+    await w.stop()
+    assert mock.requests == list(range(10))
+    assert any(len(b) > 1 for b in mock.batches), mock.batches
+    assert w.metrics.val("success") == 10
+
+
+async def test_buffer_overflow_drops_oldest():
+    mock = MockConnector()
+    w = BufferWorker(mock, max_queue=5)
+    # not started: queue only
+    for i in range(8):
+        w.submit(i)
+    assert w.metrics.val("dropped.queue_full") == 3
+    w.start()
+    await w.drain()
+    await w.stop()
+    assert mock.requests == [3, 4, 5, 6, 7]  # oldest dropped
+
+
+async def test_buffer_retry_recoverable_preserves_order():
+    mock = MockConnector()
+    mock.fail_next = 2
+    w = BufferWorker(mock, retry_interval=0.01)
+    w.start()
+    w.submit("a")
+    w.submit("b")
+    await w.drain()
+    await w.stop()
+    assert mock.requests == ["a", "b"]
+    assert w.metrics.val("retried") == 2
+    assert w.metrics.val("success") == 2
+
+
+async def test_buffer_unrecoverable_drops():
+    mock = MockConnector()
+    mock.fail_next = 1
+    mock.fail_recoverable = False
+    w = BufferWorker(mock)
+    w.start()
+    w.submit("doomed")
+    w.submit("fine")
+    await w.drain()
+    await w.stop()
+    assert mock.requests == ["fine"]
+    assert w.metrics.val("failed") == 1
+    assert w.metrics.val("success") == 1
+
+
+async def test_buffer_max_retries_gives_up():
+    mock = MockConnector()
+    mock.fail_next = 10
+    w = BufferWorker(mock, max_retries=2, retry_interval=0.01)
+    w.start()
+    w.submit("x")
+    await w.drain()
+    await w.stop()
+    assert w.metrics.val("failed") == 1
+    assert mock.requests == []
+
+
+async def test_retry_blocks_pump_so_later_work_cannot_overtake():
+    mock = MockConnector()
+    mock.fail_next = 1  # only the FIRST request fails once
+    w = BufferWorker(mock, retry_interval=0.05)
+    w.start()
+    w.submit("first")
+    await asyncio.sleep(0.02)  # first is now in its backoff sleep
+    w.submit("second")
+    await w.drain()
+    await w.stop()
+    assert mock.requests == ["first", "second"]  # no overtaking
+
+
+async def test_stop_cancels_orphaned_retry_loop():
+    mock = MockConnector()
+    mock.fail_next = 10**9  # retries forever
+    w = BufferWorker(mock, retry_interval=0.01)
+    w.start()
+    w.submit("stuck")
+    await asyncio.sleep(0.05)
+    assert w.inflight == 1
+    await w.stop()
+    assert not w._send_tasks  # no immortal retry task left behind
+
+
+# --- resource manager ----------------------------------------------------
+
+
+async def test_resource_health_and_restart():
+    mock = MockConnector()
+    res = Resource("r1", mock, health_interval=0.05)
+    await res.start()
+    assert res.status == ResourceStatus.CONNECTED
+    # driver dies; health loop notices and tries restarts
+    mock.healthy = False
+    await asyncio.sleep(0.15)
+    assert res.status in (ResourceStatus.DISCONNECTED, ResourceStatus.CONNECTING)
+    # recovers
+    mock.healthy = True
+    await asyncio.sleep(0.2)
+    assert res.status == ResourceStatus.CONNECTED
+    assert mock.start_count >= 2  # restarted at least once
+    await res.stop()
+    assert res.status == ResourceStatus.STOPPED
+
+
+# --- mqtt client ---------------------------------------------------------
+
+
+async def test_mqtt_client_pubsub_qos12():
+    broker, server, port = await make_broker_server()
+    try:
+        sub = MqttClient(port=port, client_id="sub")
+        pub = MqttClient(port=port, client_id="pub")
+        await sub.connect()
+        await pub.connect()
+        codes = await sub.subscribe("t/#", qos=2)
+        assert codes == [2]
+        await pub.publish("t/1", b"one", qos=1)
+        await pub.publish("t/2", b"two", qos=2)
+        m1 = await sub.recv()
+        m2 = await sub.recv()
+        assert {m1.payload, m2.payload} == {b"one", b"two"}
+        await sub.unsubscribe("t/#")
+        await pub.publish("t/3", b"three", qos=1)
+        with pytest.raises(asyncio.TimeoutError):
+            await sub.recv(timeout=0.2)
+        await sub.disconnect()
+        await pub.disconnect()
+    finally:
+        await server.stop()
+
+
+async def test_mqtt_client_reconnect_resubscribes():
+    broker, server, port = await make_broker_server()
+    got = []
+    c = MqttClient(
+        port=port, client_id="resub", reconnect=True, reconnect_delay=0.05,
+        on_message=lambda p: got.append(p),
+    )
+    await c.connect()
+    await c.subscribe("keep/#", qos=1)
+    # bounce the listener (same port, same broker)
+    await server.stop()
+    await asyncio.sleep(0.1)
+    server2 = Server(broker, port=port)
+    await server2.start()
+    try:
+        for _ in range(100):
+            if c.connected:
+                break
+            await asyncio.sleep(0.05)
+        assert c.connected
+        broker.publish(Message(topic="keep/alive", payload=b"back", qos=1))
+        await asyncio.sleep(0.2)
+        assert [p.payload for p in got] == [b"back"]
+        await c.disconnect()
+    finally:
+        await server2.stop()
+
+
+# --- bridges -------------------------------------------------------------
+
+
+async def test_egress_bridge_between_brokers():
+    broker_a, server_a, port_a = await make_broker_server()
+    broker_b, server_b, port_b = await make_broker_server()
+    reg = BridgeRegistry(broker_a)
+    try:
+        remote_box = capture(broker_b, "remote-sub", "from-a/#")
+        await reg.create(
+            "to-b",
+            MqttConnector("127.0.0.1", port_b, client_id="bridge-ab"),
+            egress={
+                "local_topic": "out/#",
+                "remote_topic": "from-a/${topic}",
+                "qos": 1,
+            },
+        )
+        broker_a.publish(Message(topic="out/x", payload=b"hop", qos=1))
+        bridge = reg.bridges["to-b"]
+        await bridge.resource.buffer.drain()
+        await asyncio.sleep(0.1)
+        assert [p.topic for p in remote_box] == ["from-a/out/x"]
+        assert remote_box[0].payload == b"hop"
+        info = bridge.info()
+        assert info["status"] == "connected"
+        assert info["metrics"]["success"] == 1
+    finally:
+        await reg.stop_all()
+        await server_a.stop()
+        await server_b.stop()
+
+
+async def test_ingress_bridge_between_brokers():
+    broker_a, server_a, port_a = await make_broker_server()
+    broker_b, server_b, port_b = await make_broker_server()
+    reg = BridgeRegistry(broker_a)
+    try:
+        local_box = capture(broker_a, "local-sub", "cloud/#")
+        await reg.create(
+            "from-b",
+            MqttConnector(
+                "127.0.0.1",
+                port_b,
+                client_id="bridge-ba",
+                subscriptions=["telemetry/#"],
+            ),
+            ingress={"local_topic": "cloud/${topic}", "qos": 1},
+        )
+        broker_b.publish(Message(topic="telemetry/t1", payload=b"42", qos=1))
+        await asyncio.sleep(0.2)
+        assert [p.topic for p in local_box] == ["cloud/telemetry/t1"]
+    finally:
+        await reg.stop_all()
+        await server_a.stop()
+        await server_b.stop()
+
+
+async def test_bridge_buffers_while_remote_down_then_flushes():
+    broker_a, server_a, port_a = await make_broker_server()
+    broker_b, server_b, port_b = await make_broker_server()
+    reg = BridgeRegistry(broker_a)
+    try:
+        remote_box = capture(broker_b, "r", "mirror/#", qos=1)
+        await reg.create(
+            "buffered",
+            MqttConnector("127.0.0.1", port_b, client_id="bridge-buf"),
+            egress={"local_topic": "m/#", "remote_topic": "mirror/${topic}"},
+            retry_interval=0.02,
+        )
+        # remote goes away
+        await server_b.stop()
+        await asyncio.sleep(0.1)
+        for i in range(5):
+            broker_a.publish(Message(topic=f"m/{i}", payload=str(i).encode()))
+        bridge = reg.bridges["buffered"]
+        assert bridge.resource.metrics.val("success") == 0
+        # remote returns on the same port
+        server_b2 = Server(broker_b, port=port_b)
+        await server_b2.start()
+        await bridge.resource.buffer.drain(timeout=15.0)
+        await asyncio.sleep(0.2)
+        assert sorted(p.payload for p in remote_box) == [
+            b"0", b"1", b"2", b"3", b"4"
+        ]
+        await server_b2.stop()
+    finally:
+        await reg.stop_all()
+        await server_a.stop()
+
+
+async def test_rule_action_targets_bridge():
+    broker_a, server_a, port_a = await make_broker_server()
+    broker_b, server_b, port_b = await make_broker_server()
+    rules = RuleEngine(broker_a)
+    rules.install(broker_a.hooks)
+    reg = BridgeRegistry(broker_a, rules=rules)
+    try:
+        remote_box = capture(broker_b, "r", "alerts/#")
+        await reg.create(
+            "alerter",
+            MqttConnector("127.0.0.1", port_b, client_id="bridge-rule"),
+            egress={"remote_topic": "alerts/${clientid}", "payload": "${temp}"},
+        )
+        rules.create_rule(
+            "hot",
+            'SELECT payload.temp as temp, clientid FROM "sensors/+" '
+            "WHERE payload.temp > 30",
+            actions=[{"function": "bridge", "args": {"name": "alerter"}}],
+        )
+        broker_a.publish(
+            Message(
+                topic="sensors/s1", payload=b'{"temp": 35}', from_client="dev9"
+            )
+        )
+        broker_a.publish(
+            Message(
+                topic="sensors/s1", payload=b'{"temp": 20}', from_client="dev9"
+            )
+        )
+        await reg.bridges["alerter"].resource.buffer.drain()
+        await asyncio.sleep(0.1)
+        assert [(p.topic, p.payload) for p in remote_box] == [
+            ("alerts/dev9", b"35")
+        ]
+    finally:
+        await reg.stop_all()
+        await server_a.stop()
+        await server_b.stop()
+
+
+async def test_http_webhook_bridge():
+    received = []
+    hs = HttpServer()
+    hs.route(
+        "POST", "/hook", lambda req: (received.append(req.json()), {"ok": True})[1]
+    )
+    _, hport = await hs.start()
+    broker, server, port = await make_broker_server()
+    reg = BridgeRegistry(broker)
+    try:
+        await reg.create(
+            "webhook",
+            HttpConnector("127.0.0.1", hport, path="/hook"),
+            egress={"local_topic": "events/#"},
+        )
+        broker.publish(
+            Message(topic="events/login", payload=b'{"user":"bob"}')
+        )
+        await reg.bridges["webhook"].resource.buffer.drain()
+        assert len(received) == 1
+        assert received[0]["topic"] == "events/login"
+        assert json.loads(received[0]["payload"]) == {"user": "bob"}
+        assert reg.bridges["webhook"].resource.metrics.val("success") == 1
+    finally:
+        await reg.stop_all()
+        await server.stop()
+        await hs.stop()
+
+
+async def test_ingress_egress_loop_guard():
+    """A bridge whose ingress local topic matches its own egress filter
+    must not echo messages back to the remote."""
+    broker_a, server_a, port_a = await make_broker_server()
+    broker_b, server_b, port_b = await make_broker_server()
+    reg = BridgeRegistry(broker_a)
+    try:
+        await reg.create(
+            "loopy",
+            MqttConnector(
+                "127.0.0.1", port_b, client_id="bridge-loop",
+                subscriptions=["sync/#"],
+            ),
+            egress={"local_topic": "sync/#", "remote_topic": "${topic}"},
+            ingress={"local_topic": "${topic}"},
+        )
+        broker_b.publish(Message(topic="sync/x", payload=b"remote-origin"))
+        await asyncio.sleep(0.2)
+        bridge = reg.bridges["loopy"]
+        # ingested locally but NOT echoed back out
+        assert bridge.resource.metrics.val("matched") == 0
+    finally:
+        await reg.stop_all()
+        await server_a.stop()
+        await server_b.stop()
