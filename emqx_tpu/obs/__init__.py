@@ -1,0 +1,17 @@
+"""Observability: the reference's orthogonal L9 layer (SURVEY.md §5).
+
+  * sys        — $SYS heartbeat topics (emqx_sys.erl);
+  * alarm      — activate/deactivate alarms with $SYS + hook fan-out
+                 (emqx_alarm.erl);
+  * slow_subs  — top-k delivery-latency tracker (apps/emqx_slow_subs);
+  * trace      — client/topic/ip traces to files with text or json
+                 formatting (apps/emqx/src/emqx_trace);
+  * prometheus — text exposition of metrics/stats
+                 (apps/emqx_prometheus).
+"""
+
+from .alarm import Alarms  # noqa: F401
+from .prometheus import prometheus_text  # noqa: F401
+from .slow_subs import SlowSubs  # noqa: F401
+from .sys import SysHeartbeat  # noqa: F401
+from .trace import TraceManager  # noqa: F401
