@@ -142,7 +142,9 @@ class Channel:
         self.will = pkt.will
         self.connected = True
         self.broker.metrics.inc("client.connected")
-        self.broker.hooks.run("client.connected", client_id, self.proto_ver)
+        self.broker.hooks.run(
+            "client.connected", client_id, self.proto_ver, self.peer
+        )
         out: List[object] = [Connack(present, 0)]
         if present:
             out.extend(session.on_reconnect())

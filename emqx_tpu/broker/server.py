@@ -61,6 +61,9 @@ class Connection:
         self.reader = reader
         self.writer = writer
         peer = writer.get_extra_info("peername")
+        # normalize to "ip:port" (banned/flapping/trace match on the ip)
+        if isinstance(peer, (tuple, list)) and len(peer) >= 2:
+            peer = f"{peer[0]}:{peer[1]}"
         self.channel = Channel(server.broker, peer=str(peer))
         self.parser = frame.Parser(max_packet_size=server.max_packet_size)
 

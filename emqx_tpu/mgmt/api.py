@@ -124,12 +124,14 @@ class ManagementApi:
         banned=None,
         node=None,  # ClusterNode, for /nodes and cluster-wide views
         node_name: str = "emqx@127.0.0.1",
+        obs=None,  # Observability bundle (emqx_tpu.obs.Observability)
     ):
         self.broker = broker
         self.config = config
         self.rules = rules
         self.banned = banned
         self.node = node
+        self.obs = obs
         self.node_name = node_name
         self.started_at = time.time()
         self.http = HttpServer()
@@ -227,6 +229,19 @@ class ManagementApi:
         r("PUT", "/api/v5/rules/{id}", self._rules_update)
         r("DELETE", "/api/v5/rules/{id}", self._rules_delete)
         r("POST", "/api/v5/rule_test", self._rule_test)
+        if self.obs is not None:
+            # obs routes exist only when the layer is wired; otherwise
+            # the dispatcher's plain 404 answers for them
+            r("GET", "/api/v5/prometheus/stats", self._prometheus)
+            r("GET", "/api/v5/alarms", self._alarms_list)
+            r("DELETE", "/api/v5/alarms", self._alarms_clear)
+            r("GET", "/api/v5/slow_subscriptions", self._slow_subs)
+            r("DELETE", "/api/v5/slow_subscriptions", self._slow_subs_clear)
+            r("GET", "/api/v5/trace", self._trace_list)
+            r("POST", "/api/v5/trace", self._trace_create)
+            r("DELETE", "/api/v5/trace/{name}", self._trace_delete)
+            r("PUT", "/api/v5/trace/{name}/stop", self._trace_stop)
+            r("GET", "/api/v5/trace/{name}/log", self._trace_log)
         r("GET", "/api/v5/mqtt/retainer/messages", self._retained_list)
         r("GET", "/api/v5/mqtt/retainer/message/{topic...}", self._retained_one)
         r("DELETE", "/api/v5/mqtt/retainer/message/{topic...}", self._retained_delete)
@@ -580,6 +595,74 @@ class ManagementApi:
         return out
 
     # --- retainer ---------------------------------------------------------
+
+    # --- observability (obs layer: prometheus/alarms/slow_subs/trace) ----
+    # (routes only registered when self.obs is wired)
+
+    def _prometheus(self, req: Request):
+        return Response(
+            status=200,
+            body=self.obs.prometheus_text().encode(),
+            content_type="text/plain; version=0.0.4",
+        )
+
+    def _alarms_list(self, req: Request):
+        which = "all"
+        if req.query.get("activated") == "true":
+            which = "activated"
+        elif req.query.get("activated") == "false":
+            which = "deactivated"
+        return _paginate(self.obs.alarms.get_alarms(which), req.query)
+
+    def _alarms_clear(self, req: Request):
+        self.obs.alarms.delete_all_deactivated()
+        return Response(status=204)
+
+    def _slow_subs(self, req: Request):
+        return _paginate(self.obs.slow_subs.topk(), req.query)
+
+    def _slow_subs_clear(self, req: Request):
+        self.obs.slow_subs.clear()
+        return Response(status=204)
+
+    def _trace_list(self, req: Request):
+        return self.obs.traces.list()
+
+    def _trace_create(self, req: Request):
+        body = req.json() or {}
+        ttype = body.get("type", "")
+        flt = body.get(ttype) or body.get("filter", "")
+        try:
+            self.obs.traces.create(
+                name=body.get("name", ""),
+                type=ttype,
+                filter=flt,
+                formatter=body.get("formatter", "text"),
+                end_at=body.get("end_at"),
+            )
+        except ValueError as e:
+            return Response.error(400, "BAD_REQUEST", str(e))
+        return Response.json({"name": body.get("name", "")}, status=200)
+
+    def _trace_delete(self, req: Request):
+        try:
+            self.obs.traces.delete(req.params["name"])
+        except KeyError:
+            return Response.error(404, "NOT_FOUND", req.params["name"])
+        return Response(status=204)
+
+    def _trace_stop(self, req: Request):
+        try:
+            self.obs.traces.stop_trace(req.params["name"])
+        except KeyError:
+            return Response.error(404, "NOT_FOUND", req.params["name"])
+        return {"name": req.params["name"], "status": "stopped"}
+
+    def _trace_log(self, req: Request):
+        try:
+            return Response.text(self.obs.traces.read_log(req.params["name"]))
+        except KeyError:
+            return Response.error(404, "NOT_FOUND", req.params["name"])
 
     def _retained_info(self, m: Message) -> Dict[str, Any]:
         return {

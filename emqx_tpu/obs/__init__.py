@@ -1,17 +1,55 @@
 """Observability: the reference's orthogonal L9 layer (SURVEY.md §5).
 
   * sys        — $SYS heartbeat topics (emqx_sys.erl);
-  * alarm      — activate/deactivate alarms with $SYS + hook fan-out
-                 (emqx_alarm.erl);
+  * alarm      — activate/deactivate alarms with $SYS + listener
+                 fan-out (emqx_alarm.erl);
   * slow_subs  — top-k delivery-latency tracker (apps/emqx_slow_subs);
   * trace      — client/topic/ip traces to files with text or json
                  formatting (apps/emqx/src/emqx_trace);
   * prometheus — text exposition of metrics/stats
                  (apps/emqx_prometheus).
+
+`Observability` bundles the per-broker pieces and installs the hook
+taps, the emqx_sup-analog wiring.
 """
 
-from .alarm import Alarms  # noqa: F401
+from __future__ import annotations
+
+from .alarm import AlarmError, Alarms  # noqa: F401
 from .prometheus import prometheus_text  # noqa: F401
 from .slow_subs import SlowSubs  # noqa: F401
 from .sys import SysHeartbeat  # noqa: F401
 from .trace import TraceManager  # noqa: F401
+
+
+class Observability:
+    def __init__(
+        self,
+        broker,
+        node_name: str = "emqx@127.0.0.1",
+        trace_dir: str = "/tmp/emqx_tpu_trace",
+        slow_threshold_ms: float = 500.0,
+        slow_top_k: int = 10,
+    ):
+        self.broker = broker
+        self.node_name = node_name
+        self.sys = SysHeartbeat(broker, node_name)
+        self.alarms = Alarms(broker, node_name)
+        self.slow_subs = SlowSubs(
+            threshold_ms=slow_threshold_ms, top_k=slow_top_k
+        )
+        self.traces = TraceManager(trace_dir)
+        self.slow_subs.install(broker.hooks)
+        self.traces.install(broker.hooks)
+
+    def prometheus_text(self) -> str:
+        return prometheus_text(self.broker, self.node_name)
+
+    def start(self, sys_interval: float = 30.0) -> None:
+        self.sys.start(sys_interval)
+
+    def stop(self) -> None:
+        self.sys.stop()
+        self.traces.close()
+        self.traces.uninstall()
+        self.slow_subs.uninstall()

@@ -1,0 +1,46 @@
+"""Prometheus text exposition (apps/emqx_prometheus/src/emqx_prometheus.erl).
+
+Renders the broker's counters and gauges into the Prometheus text
+format the reference serves at /api/v5/prometheus/stats. Counter
+names are mapped `messages.received` → `emqx_messages_received`,
+matching the reference's emqx_* metric families.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+
+def _norm(name: str) -> str:
+    return "emqx_" + name.replace(".", "_").replace("-", "_")
+
+
+def prometheus_text(broker, node_name: str = "emqx@127.0.0.1") -> str:
+    lines: List[str] = []
+    label = f'{{node="{node_name}"}}'
+    seen = set()
+
+    def emit(name: str, kind: str, value) -> None:
+        if name in seen:  # one family per name or the scrape fails
+            return
+        seen.add(name)
+        lines.append(f"# TYPE {name} {kind}")
+        lines.append(f"{name}{label} {value}")
+
+    for name, val in sorted(broker.metrics.all().items()):
+        emit(_norm(name), "counter", val)
+    # broker-level families the reference always exposes (win over the
+    # stats-loop variants below, which only appear once traffic starts)
+    emit("emqx_sessions_count", "gauge", len(broker.sessions))
+    emit("emqx_subscriptions_count", "gauge", len(broker.suboptions))
+    for name, val in sorted(broker.stats.all().items()):
+        if name.endswith(".max"):
+            continue
+        emit(_norm(name), "gauge", val)
+    rstats = broker.router.stats()
+    emit(
+        "emqx_topics_count",
+        "gauge",
+        rstats["exact_topics"] + rstats["wildcard_routes"] + rstats["deep_routes"],
+    )
+    return "\n".join(lines) + "\n"

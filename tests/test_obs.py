@@ -1,0 +1,174 @@
+"""Observability layer tests: $SYS heartbeats, alarms, slow subs,
+trace files, Prometheus exposition — the L9 surface the reference
+covers in emqx_sys/emqx_alarm/emqx_slow_subs/emqx_trace/
+emqx_prometheus SUITEs."""
+
+import time
+
+import pytest
+
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.packet import SubOpts
+from emqx_tpu.broker.pubsub import Broker
+from emqx_tpu.obs import AlarmError, Observability, prometheus_text
+
+
+def sess(broker, cid, subs=()):
+    s, _ = broker.open_session(cid, clean_start=True)
+    inbox = []
+    s.outgoing_sink = lambda pkts: inbox.extend(pkts)
+    for flt in subs:
+        broker.subscribe(s, flt, SubOpts(qos=0))
+    return s, inbox
+
+
+def test_import_obs_package():
+    import emqx_tpu.obs  # the round-1 stub crashed here
+
+    assert hasattr(emqx_tpu.obs, "Observability")
+
+
+def test_sys_heartbeat_topics():
+    broker = Broker()
+    obs = Observability(broker, node_name="n1@host")
+    _, inbox = sess(broker, "watcher", ["$SYS/#"])
+    obs.sys.tick()
+    topics = [p.topic for p in inbox]
+    assert f"$SYS/brokers/n1@host/version" in topics
+    assert f"$SYS/brokers/n1@host/uptime" in topics
+    assert any(t.startswith("$SYS/brokers/n1@host/stats/") for t in topics)
+    # $SYS must NOT leak into root wildcards
+    _, root_inbox = sess(broker, "rooty", ["#"])
+    obs.sys.heartbeat()
+    assert root_inbox == []
+
+
+def test_alarm_lifecycle_and_sys_publish():
+    broker = Broker()
+    obs = Observability(broker, node_name="n1@host")
+    _, inbox = sess(broker, "w", ["$SYS/brokers/n1@host/alarms/#"])
+    obs.alarms.activate("high_mem", {"usage": 0.93}, "memory high")
+    assert obs.alarms.is_active("high_mem")
+    with pytest.raises(AlarmError):
+        obs.alarms.activate("high_mem")
+    obs.alarms.ensure("high_mem")  # idempotent path
+    active = obs.alarms.get_alarms("activated")
+    assert len(active) == 1 and active[0]["details"] == {"usage": 0.93}
+    obs.alarms.deactivate("high_mem")
+    assert not obs.alarms.is_active("high_mem")
+    with pytest.raises(AlarmError):
+        obs.alarms.deactivate("high_mem")
+    hist = obs.alarms.get_alarms("deactivated")
+    assert len(hist) == 1 and "deactivate_at" in hist[0]
+    kinds = [p.topic.rsplit("/", 1)[-1] for p in inbox]
+    assert kinds == ["activate", "deactivate"]
+    obs.alarms.delete_all_deactivated()
+    assert obs.alarms.get_alarms("deactivated") == []
+
+
+def test_alarm_history_bounded():
+    broker = Broker()
+    obs = Observability(broker)
+    obs.alarms.size_limit = 5
+    for i in range(10):
+        obs.alarms.activate(f"a{i}")
+        obs.alarms.deactivate(f"a{i}")
+    assert len(obs.alarms.get_alarms("deactivated")) <= 5
+
+
+def test_slow_subs_topk_via_hook():
+    broker = Broker()
+    obs = Observability(broker, slow_threshold_ms=50.0, slow_top_k=3)
+    _, _ = sess(broker, "c1", ["t/1"])
+    # fresh message -> fast delivery, below threshold
+    broker.publish(Message(topic="t/1", payload=b"x"))
+    assert obs.slow_subs.topk() == []
+    # stale timestamp -> counted as slow
+    broker.publish(Message(topic="t/1", payload=b"x", timestamp=time.time() - 1.0))
+    top = obs.slow_subs.topk()
+    assert len(top) == 1 and top[0]["clientid"] == "c1"
+    assert top[0]["timespan"] >= 50.0
+    # top-k bound
+    obs.slow_subs.clear()
+    for i in range(10):
+        obs.slow_subs.track(f"cl{i}", "t/x", 100.0 + i)
+    top = obs.slow_subs.topk()
+    assert len(top) == 3
+    assert top[0]["timespan"] == 109.0  # largest survive
+
+
+def test_trace_clientid_and_topic(tmp_path):
+    broker = Broker()
+    obs = Observability(broker, trace_dir=str(tmp_path))
+    obs.traces.create("by_client", "clientid", "dev1")
+    obs.traces.create("by_topic", "topic", "t/#", formatter="json")
+    broker.publish(Message(topic="t/a", payload=b"p1", from_client="dev1"))
+    broker.publish(Message(topic="other", payload=b"p2", from_client="dev2"))
+    log1 = obs.traces.read_log("by_client")
+    assert "PUBLISH" in log1 and "t/a" in log1 and "dev2" not in log1
+    log2 = obs.traces.read_log("by_topic")
+    assert '"topic": "t/a"' in log2 and "other" not in log2
+    # stop halts collection
+    obs.traces.stop_trace("by_client")
+    broker.publish(Message(topic="t/b", payload=b"x", from_client="dev1"))
+    assert "t/b" not in obs.traces.read_log("by_client")
+    names = {t["name"]: t["status"] for t in obs.traces.list()}
+    assert names == {"by_client": "stopped", "by_topic": "running"}
+    obs.stop()
+
+
+def test_trace_name_validation_and_missing(tmp_path):
+    broker = Broker()
+    obs = Observability(broker, trace_dir=str(tmp_path))
+    with pytest.raises(ValueError):
+        obs.traces.create("../escape", "clientid", "x")
+    with pytest.raises(ValueError):
+        obs.traces.create("", "clientid", "x")
+    with pytest.raises(KeyError):
+        obs.traces.stop_trace("nope")
+    with pytest.raises(KeyError):
+        obs.traces.delete("nope")
+
+
+def test_trace_ip_address(tmp_path):
+    broker = Broker()
+    obs = Observability(broker, trace_dir=str(tmp_path))
+    obs.traces.create("by_ip", "ip_address", "10.0.0.5")
+    # channel fires (client_id, proto_ver, peer)
+    broker.hooks.run("client.connected", "devA", 5, "10.0.0.5:52001")
+    broker.hooks.run("client.connected", "devB", 5, "10.9.9.9:52002")
+    log = obs.traces.read_log("by_ip")
+    assert "devA" in log and "devB" not in log
+
+
+def test_alarm_history_no_timestamp_collision():
+    broker = Broker()
+    obs = Observability(broker)
+    for i in range(3):
+        obs.alarms.activate(f"x{i}")
+        obs.alarms.deactivate(f"x{i}")  # same-tick deactivations
+    assert len(obs.alarms.get_alarms("deactivated")) == 3
+
+
+def test_prometheus_no_duplicate_families():
+    broker = Broker()
+    _, _ = sess(broker, "c1", ["t/#"])  # populates sessions.count stat
+    text = prometheus_text(broker)
+    names = [
+        line.split("{")[0]
+        for line in text.splitlines()
+        if line and not line.startswith("#")
+    ]
+    assert len(names) == len(set(names))
+
+
+def test_prometheus_exposition():
+    broker = Broker()
+    obs = Observability(broker, node_name="n1@host")
+    _, _ = sess(broker, "c1", ["t/#"])
+    broker.publish(Message(topic="t/1", payload=b"x"))
+    text = prometheus_text(broker, "n1@host")
+    assert '# TYPE emqx_messages_received counter' in text
+    assert 'emqx_messages_received{node="n1@host"} 1' in text
+    assert 'emqx_sessions_count{node="n1@host"} 1' in text
+    assert text.endswith("\n")

@@ -1,0 +1,110 @@
+"""REST surface for the obs layer: /api/v5/prometheus/stats, alarms,
+slow_subscriptions, trace (emqx_prometheus + emqx_mgmt_api_alarms +
+emqx_slow_subs_api + emqx_mgmt_api_trace analogs)."""
+
+import asyncio
+import json
+
+from emqx_tpu.broker.pubsub import Broker
+from emqx_tpu.mgmt import ManagementApi
+from emqx_tpu.obs import Observability
+
+from test_mgmt import Api, http_req
+
+
+async def make_obs_api(tmp_path):
+    broker = Broker()
+    obs = Observability(broker, node_name="n1@host", trace_dir=str(tmp_path))
+    mgmt = ManagementApi(broker, obs=obs, node_name="n1@host")
+    host, port = await mgmt.start()
+    _, login = await http_req(
+        port, "POST", "/api/v5/login",
+        {"username": "admin", "password": "public"},
+    )
+    return broker, obs, mgmt, Api(port, token=login["token"])
+
+
+async def test_prometheus_scrape(tmp_path):
+    broker, obs, mgmt, api = await make_obs_api(tmp_path)
+    try:
+        reader, writer = await asyncio.open_connection("127.0.0.1", api.port)
+        writer.write(
+            (
+                f"GET /api/v5/prometheus/stats HTTP/1.1\r\nhost: x\r\n"
+                f"authorization: Bearer {api.token}\r\nconnection: close\r\n\r\n"
+            ).encode()
+        )
+        raw = await reader.read(-1)
+        writer.close()
+        assert b"200" in raw.split(b"\r\n")[0]
+        assert b"emqx_sessions_count" in raw
+        assert b"text/plain" in raw
+    finally:
+        await mgmt.stop()
+
+
+async def test_alarms_api(tmp_path):
+    broker, obs, mgmt, api = await make_obs_api(tmp_path)
+    try:
+        obs.alarms.activate("cpu_high", {"v": 1}, "cpu high")
+        st, body = await api("GET", "/api/v5/alarms?activated=true")
+        assert st == 200 and body["data"][0]["name"] == "cpu_high"
+        obs.alarms.deactivate("cpu_high")
+        st, body = await api("GET", "/api/v5/alarms?activated=false")
+        assert st == 200 and len(body["data"]) == 1
+        st, _ = await api("DELETE", "/api/v5/alarms")
+        assert st == 204
+        st, body = await api("GET", "/api/v5/alarms?activated=false")
+        assert body["data"] == []
+    finally:
+        await mgmt.stop()
+
+
+async def test_slow_subs_api(tmp_path):
+    broker, obs, mgmt, api = await make_obs_api(tmp_path)
+    try:
+        obs.slow_subs.track("c9", "t/slow", 800.0)
+        st, body = await api("GET", "/api/v5/slow_subscriptions")
+        assert st == 200 and body["data"][0]["clientid"] == "c9"
+        st, _ = await api("DELETE", "/api/v5/slow_subscriptions")
+        assert st == 204
+        st, body = await api("GET", "/api/v5/slow_subscriptions")
+        assert body["data"] == []
+    finally:
+        await mgmt.stop()
+
+
+async def test_trace_api(tmp_path):
+    broker, obs, mgmt, api = await make_obs_api(tmp_path)
+    try:
+        st, _ = await api(
+            "POST", "/api/v5/trace",
+            {"name": "tr1", "type": "clientid", "clientid": "devX"},
+        )
+        assert st == 200
+        st, lst = await api("GET", "/api/v5/trace")
+        assert st == 200 and lst[0]["name"] == "tr1"
+        from emqx_tpu.broker.message import Message
+
+        broker.publish(Message(topic="a/b", payload=b"z", from_client="devX"))
+        st, _ = await api("PUT", "/api/v5/trace/tr1/stop")
+        assert st == 200
+        reader, writer = await asyncio.open_connection("127.0.0.1", api.port)
+        writer.write(
+            (
+                f"GET /api/v5/trace/tr1/log HTTP/1.1\r\nhost: x\r\n"
+                f"authorization: Bearer {api.token}\r\nconnection: close\r\n\r\n"
+            ).encode()
+        )
+        raw = await reader.read(-1)
+        writer.close()
+        assert b"PUBLISH" in raw and b"a/b" in raw
+        st, _ = await api("DELETE", "/api/v5/trace/tr1")
+        assert st == 204
+        # bad type rejected
+        st, _ = await api(
+            "POST", "/api/v5/trace", {"name": "bad", "type": "nope", "filter": "x"}
+        )
+        assert st == 400
+    finally:
+        await mgmt.stop()
