@@ -102,8 +102,11 @@ class BufferWorker:
         self._inflight = asyncio.Semaphore(inflight_window)
         self._inflight_count = 0
         self._send_tasks: set = set()
-        # set while a recoverable failure is being retried: the pump
-        # must not dispatch newer work past a blocked batch
+        # set while NO recoverable failure is being retried: the pump
+        # must not dispatch newer work past a blocked batch. Ownership
+        # is counted — with inflight_window > 1, another batch finishing
+        # must not un-pause while a different batch still backs off.
+        self._retrying = 0
         self._resume = asyncio.Event()
         self._resume.set()
         self._idle = asyncio.Event()
@@ -185,6 +188,7 @@ class BufferWorker:
         return batch
 
     async def _send(self, batch: List[Any]) -> None:
+        pausing = False
         try:
             attempt = 0
             while True:
@@ -206,7 +210,10 @@ class BufferWorker:
                         return
                     # bounded backoff; the pump pauses so newer work
                     # queues up behind this batch instead of passing it
-                    self._resume.clear()
+                    if not pausing:
+                        pausing = True
+                        self._retrying += 1
+                        self._resume.clear()
                     await asyncio.sleep(
                         min(self.retry_interval * (2 ** min(attempt, 6)), 5.0)
                     )
@@ -215,7 +222,10 @@ class BufferWorker:
                     self.metrics.inc("failed", len(batch))
                     return
         finally:
-            self._resume.set()
+            if pausing:
+                self._retrying -= 1
+                if self._retrying == 0:
+                    self._resume.set()
             self._inflight_count -= 1
             self._inflight.release()
             if self._inflight_count == 0 and not self._queue:

@@ -20,6 +20,7 @@ import asyncio
 import logging
 from typing import Callable, Dict, List, Optional, Tuple
 
+from . import rpc as rpc_mod
 from .rpc import PeerDown, RpcPlane
 
 log = logging.getLogger("emqx_tpu.cluster.membership")
@@ -142,8 +143,14 @@ class Membership:
 
     async def _ping_one(self, node_id: str, addr: Addr) -> None:
         try:
+            # CONTROL shard: failure detection must never queue behind
+            # a bulk bootstrap/resync on the default channel
             await self.rpc.call(
-                addr, "membership", "ping", timeout=self.heartbeat_interval
+                addr,
+                "membership",
+                "ping",
+                key=rpc_mod.CONTROL,
+                timeout=self.heartbeat_interval,
             )
             self._misses[node_id] = 0
             for cb in self.on_ping_ok:

@@ -141,11 +141,13 @@ class ClusterNode:
         broker: Optional[ClusterBroker] = None,
         heartbeat_interval: float = 1.0,
         miss_threshold: int = 3,
+        cookie: Optional[str] = None,
     ):
         self.node_id = node_id
         self.broker = broker or ClusterBroker()
         self.broker.node = self
-        self.rpc = RpcPlane(node_id)
+        kw = {} if cookie is None else {"cookie": cookie}
+        self.rpc = RpcPlane(node_id, **kw)
         self.membership = Membership(
             self.rpc,
             heartbeat_interval=heartbeat_interval,
@@ -185,6 +187,7 @@ class ClusterNode:
             lambda g, f, c: self.on_shared_unsubscribed(g, f, c)
         )
         self.membership.on_member_down.append(self._purge_node)
+        self.membership.on_member_up.append(self._on_member_up)
         self.membership.on_ping_ok.append(self._maybe_resync)
         # a broker attached with pre-existing sessions/subscriptions:
         # seed local refs + cluster tables from its current state (the
@@ -243,6 +246,9 @@ class ClusterNode:
                 await self.rpc.call(
                     addr, "route", "resync", (self.node_id, ops, sessions)
                 )
+                # a peer pre-scheduled by member_up is now covered —
+                # don't re-send the identical dump on its next ping
+                self._resync.discard(node)
             except Exception:
                 self._resync.add(node)
 
@@ -433,6 +439,14 @@ class ClusterNode:
         }
 
     # --- replica resync (anti-entropy after a lost batch) ------------------
+
+    def _on_member_up(self, node_id: str, addr) -> None:
+        """A newcomer's bootstrap snapshot was taken by the seed BEFORE
+        this node learned of it — any op batch we broadcast in that
+        window never reached it. Schedule a full resync on its next
+        good ping so the joiner's replica converges (ADVICE r1)."""
+        if node_id != self.node_id:
+            self._resync.add(node_id)
 
     def _maybe_resync(self, node_id: str) -> None:
         if node_id in self._resync:

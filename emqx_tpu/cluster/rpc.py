@@ -20,6 +20,7 @@ the emqx_rpc:multicall/unwrap_erpc shape.
 from __future__ import annotations
 
 import asyncio
+import hashlib
 import logging
 import struct
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -31,6 +32,27 @@ log = logging.getLogger("emqx_tpu.cluster.rpc")
 
 _LEN = struct.Struct(">I")
 MAX_FRAME = 64 << 20
+
+# Shard key for latency-critical control traffic (membership pings):
+# rides its OWN channel so failure detection never queues behind a
+# bulk bootstrap/resync transfer on the shared default shard. A
+# private object so no user-controlled key (e.g. an MQTT topic used
+# as a shard key) can ever route bulk traffic onto the control slot.
+CONTROL = object()
+
+# The reference gates its dist/gen_rpc planes with the Erlang cookie;
+# same default name. Non-loopback binds MUST set a private cookie —
+# anything that reaches the port with the right cookie can inject
+# routes and kick sessions. The cookie itself never crosses the wire:
+# both sides prove possession via HMAC over the peer's nonce
+# (challenge-response, like Erlang distribution's MD5 challenge).
+DEFAULT_COOKIE = "emqxsecretcookie"
+
+
+def _proof(cookie: bytes, nonce: bytes) -> str:
+    import hmac as _hmac
+
+    return _hmac.new(cookie, nonce, hashlib.sha256).hexdigest()
 
 
 class RpcError(Exception):
@@ -69,20 +91,38 @@ class _Channel:
     async def _connect(self) -> None:
         reader, writer = await asyncio.open_connection(*self.addr)
         try:
+            import hmac as _hmac
+            import os as _os
+
+            client_nonce = _os.urandom(16)
             _write_frame(
                 writer,
-                ("hello", self.plane.node_id, self.plane.registry.supported()),
+                (
+                    "hello",
+                    self.plane.node_id,
+                    self.plane.registry.supported(),
+                    client_nonce,
+                ),
             )
             await writer.drain()
             ack = await _read_frame(reader)
             if not (isinstance(ack, tuple) and ack and ack[0] == "hello"):
                 raise RpcError(f"bad hello ack: {ack!r}")
+            if len(ack) < 5 or not _hmac.compare_digest(
+                str(ack[4]), _proof(self.plane.cookie, client_nonce)
+            ):
+                raise RpcError(f"cluster cookie mismatch with {self.addr}")
+            server_nonce = ack[3]
+            _write_frame(
+                writer, ("auth", _proof(self.plane.cookie, server_nonce))
+            )
+            await writer.drain()
         except BaseException:
             # includes cancellation by the connect_timeout wait_for: a
             # half-done handshake must not leak its socket
             writer.close()
             raise
-        _h, peer_node, peer_protos = ack
+        _h, peer_node, peer_protos = ack[:3]
         self.plane.note_peer(self.addr, peer_node, peer_protos)
         self.writer = writer
         self._reader_task = asyncio.create_task(self._read_loop(reader))
@@ -172,8 +212,10 @@ class RpcPlane:
         n_shards: int = 4,
         call_timeout: float = 5.0,
         connect_timeout: float = 3.0,
+        cookie: str = DEFAULT_COOKIE,
     ):
         self.node_id = node_id
+        self.cookie = cookie.encode()
         self.registry = registry or ProtocolRegistry()
         self.n_shards = n_shards
         self.call_timeout = call_timeout
@@ -215,17 +257,44 @@ class RpcPlane:
         peer_node = None
         self._inbound.add(writer)
         try:
+            import hmac as _hmac
+            import os as _os
+
             hello = await _read_frame(reader)
-            if not (isinstance(hello, tuple) and hello[0] == "hello"):
+            if not (
+                isinstance(hello, tuple) and hello[0] == "hello" and len(hello) >= 4
+            ):
                 return
-            _, peer_node, peer_protos = hello
+            _h, peer_node, peer_protos, client_nonce = hello[:4]
+            # prove possession to the client, challenge it back
+            server_nonce = _os.urandom(16)
+            _write_frame(
+                writer,
+                (
+                    "hello",
+                    self.node_id,
+                    self.registry.supported(),
+                    server_nonce,
+                    _proof(self.cookie, client_nonce),
+                ),
+            )
+            await writer.drain()
+            auth = await _read_frame(reader)
+            if not (
+                isinstance(auth, tuple)
+                and len(auth) == 2
+                and auth[0] == "auth"
+                and _hmac.compare_digest(
+                    str(auth[1]), _proof(self.cookie, server_nonce)
+                )
+            ):
+                log.warning("rejecting peer with bad cluster cookie")
+                _write_frame(writer, ("bye", "bad_cookie"))
+                await writer.drain()
+                return
             self.peer_versions[peer_node] = negotiate(
                 self.registry.supported(), peer_protos
             )
-            _write_frame(
-                writer, ("hello", self.node_id, self.registry.supported())
-            )
-            await writer.drain()
             while True:
                 frame = await _read_frame(reader)
                 kind = frame[0]
@@ -256,7 +325,9 @@ class RpcPlane:
     # --- client side ------------------------------------------------------
 
     def _channel(self, addr: Tuple[str, int], key: Any) -> _Channel:
-        shard = hash(key) % self.n_shards
+        # CONTROL gets a reserved slot outside the numeric shards so
+        # pings can never hash-collide with bulk traffic
+        shard: Any = "ctl" if key is CONTROL else hash(key) % self.n_shards
         ch = self._channels.get((addr, shard))
         if ch is None:
             ch = _Channel(self, addr)

@@ -124,6 +124,35 @@ async def test_retry_blocks_pump_so_later_work_cannot_overtake():
     assert mock.requests == ["first", "second"]  # no overtaking
 
 
+async def test_retry_pause_survives_other_inflight_completions():
+    """With inflight_window > 1, a sibling batch finishing must NOT
+    un-pause the pump while another batch is still in retry backoff
+    (ADVICE r1: pause ownership is counted, not a bare event)."""
+
+    class ScriptedConnector(MockConnector):
+        async def on_query(self, request):
+            if request == "blocked" and self.fail_next > 0:
+                self.fail_next -= 1
+                raise RecoverableError("scripted")
+            if request == "slow-sibling":
+                await asyncio.sleep(0.03)
+            self.requests.append(request)
+
+    mock = ScriptedConnector()
+    mock.fail_next = 2
+    w = BufferWorker(mock, inflight_window=4, retry_interval=0.1)
+    w.start()
+    w.submit("slow-sibling")  # dispatched first, completes during backoff
+    w.submit("blocked")       # enters retry backoff (~0.2s+0.4s)
+    await asyncio.sleep(0.02)
+    w.submit("late")          # must NOT overtake the blocked batch
+    await asyncio.sleep(0.1)  # sibling done; pause must still hold
+    assert "late" not in mock.requests
+    await w.drain()
+    await w.stop()
+    assert mock.requests.index("blocked") < mock.requests.index("late")
+
+
 async def test_stop_cancels_orphaned_retry_loop():
     mock = MockConnector()
     mock.fail_next = 10**9  # retries forever

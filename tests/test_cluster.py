@@ -290,6 +290,66 @@ async def test_resync_after_lost_batch():
         await stop_all(nodes)
 
 
+async def test_join_window_ops_resynced_via_member_up():
+    """Ops broadcast by an existing node between the seed's bootstrap
+    snapshot and that node learning of the joiner must reach the joiner
+    via the member_up-scheduled resync (ADVICE r1 join-window gap)."""
+    nodes, addrs = await make_cluster(2, hb=0.05)
+    a, b = nodes
+    try:
+        # member_up scheduling is the mechanism under test: fire it
+        # directly and observe the resync being queued
+        b._on_member_up("ghost", ("127.0.0.1", 1))
+        assert "ghost" in b._resync
+        b._resync.discard("ghost")
+        c = ClusterNode("n2", heartbeat_interval=0.05)
+        await c.start()
+        nodes.append(c)  # cleaned up even if an assert below fails
+        await c.join(addrs[0])
+        # b's contributions converge onto c via the scheduled resync:
+        sess, _ = attach_client(b, "s-on-b")
+        b.broker.subscribe(sess, "joinwin/+", SubOpts(qos=0))
+        await settle([a, b, c], delay=0.3)
+        assert "n1" in c.cluster_router.match_routes("joinwin/x")
+    finally:
+        await stop_all(nodes)
+
+
+async def test_cookie_mismatch_rejected():
+    """A peer with the wrong cluster cookie cannot join or call
+    (the gen_rpc/dist plane is cookie-gated in the reference)."""
+    good = ClusterNode("g1", cookie="secret-a")
+    addr = await good.start()
+    bad = ClusterNode("b1", cookie="secret-b")
+    await bad.start()
+    try:
+        with pytest.raises(Exception):
+            await bad.rpc.call(addr, "membership", "ping", timeout=1.0)
+        # same cookie works
+        good2 = ClusterNode("g2", cookie="secret-a")
+        await good2.start()
+        assert await good2.rpc.call(addr, "membership", "ping", timeout=1.0) == "pong"
+        await good2.stop()
+    finally:
+        await good.stop()
+        await bad.stop()
+
+
+async def test_heartbeat_rides_control_channel():
+    """Pings use the reserved CONTROL shard, not the default bulk
+    shard (ADVICE r1: bulk transfers must not delay failure detection)."""
+    from emqx_tpu.cluster import rpc as rpc_mod
+
+    nodes, addrs = await make_cluster(2, hb=0.05)
+    a, b = nodes
+    try:
+        await asyncio.sleep(0.15)  # let heartbeats run
+        slots = {shard for (_addr, shard) in a.rpc._channels}
+        assert "ctl" in slots
+    finally:
+        await stop_all(nodes)
+
+
 async def test_multicall_returns_errors_in_place():
     nodes, addrs = await make_cluster(2)
     a, b = nodes
