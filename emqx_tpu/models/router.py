@@ -30,14 +30,20 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..ops import hash_index as hash_ops
 from ..ops import match as match_ops
 from ..ops import topic as topic_mod
+from ..ops.hash_index import ClassIndex, ClassMeta, SlotArrays
 from ..ops.host_index import TopicTrie
 from ..ops.table import EncodedFilters, FilterTable, FilterTooDeep
 
 Dest = Hashable
 
 SYNC_BATCH_SIZE = 1024  # rows per scatter step (ref: ?MAX_BATCH_SIZE 1000)
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, n - 1).bit_length()
 
 
 @functools.partial(jax.jit, donate_argnums=0)
@@ -73,26 +79,96 @@ def _scatter_rows(
     return out
 
 
-class DeviceTable:
-    """Device-resident mirror of a FilterTable, synced by batched
-    scatter updates (double-buffer-free: XLA donation updates in place)."""
+@functools.partial(jax.jit, donate_argnums=0)
+def _scatter_slots(
+    slots: SlotArrays,
+    idx: jnp.ndarray,  # int32 [n_batches, K]
+    fp: jnp.ndarray,  # uint32 [n_batches, K]
+    bucket: jnp.ndarray,  # int32 [n_batches, K]
+) -> SlotArrays:
+    """Batched in-place update of the hash-slot arrays (same shape
+    discipline as _scatter_rows: padding rewrites the last slot)."""
 
-    def __init__(self, table: FilterTable, device=None) -> None:
+    def step(s, xs):
+        i, f, b = xs
+        return SlotArrays(s.fp.at[i].set(f), s.bucket.at[i].set(b)), None
+
+    out, _ = jax.lax.scan(step, slots, (idx, fp, bucket))
+    return out
+
+
+class DeviceTable:
+    """Device-resident mirror of a FilterTable (and optionally its
+    pattern-class hash index), synced by batched scatter updates
+    (double-buffer-free: XLA donation updates in place)."""
+
+    def __init__(
+        self,
+        table: FilterTable,
+        device=None,
+        index: Optional[ClassIndex] = None,
+    ) -> None:
         self.table = table
         self.device = device
+        self.index = index
         self._dev: Optional[EncodedFilters] = None
         self._synced_capacity = 0
+        self._dev_meta: Optional[ClassMeta] = None
+        self._dev_slots: Optional[SlotArrays] = None
+        self._dev_residual: Optional[jnp.ndarray] = None
+
+    def _put(self, a: np.ndarray) -> jnp.ndarray:
+        a = np.ascontiguousarray(a)
+        return jax.device_put(a, self.device) if self.device is not None else jnp.asarray(a)
 
     def _upload_full(self) -> None:
         snap = self.table.snapshot()
-        arrs = [np.ascontiguousarray(a) for a in snap]
-        if self.device is not None:
-            self._dev = EncodedFilters(
-                *(jax.device_put(a, self.device) for a in arrs)
-            )
-        else:
-            self._dev = EncodedFilters(*(jnp.asarray(a) for a in arrs))
+        self._dev = EncodedFilters(*(self._put(a) for a in snap))
         self._synced_capacity = self.table.capacity
+
+    def _sync_index(self) -> None:
+        ix = self.index
+        assert ix is not None
+        if ix.meta_dirty or self._dev_meta is None:
+            self._dev_meta = ClassMeta(*(self._put(np.array(a)) for a in ix.meta))
+            ix.meta_dirty = False
+        if ix.rebuilt or self._dev_slots is None:
+            ix.dirty_slots.clear()
+            self._dev_slots = SlotArrays(*(self._put(np.array(a)) for a in ix.slots))
+            ix.rebuilt = False
+        elif ix.dirty_slots:
+            dirty = np.fromiter(ix.dirty_slots, np.int32, len(ix.dirty_slots))
+            dirty.sort()
+            ix.dirty_slots.clear()
+            total = len(dirty)
+            n_batches = _next_pow2(-(-total // SYNC_BATCH_SIZE))
+            idx = np.full(n_batches * SYNC_BATCH_SIZE, dirty[-1], np.int32)
+            idx[:total] = dirty
+            shape2 = (n_batches, SYNC_BATCH_SIZE)
+            self._dev_slots = _scatter_slots(
+                self._dev_slots,
+                jnp.asarray(idx.reshape(shape2)),
+                jnp.asarray(ix.slots.fp[idx].reshape(shape2)),
+                jnp.asarray(ix.slots.bucket[idx].reshape(shape2)),
+            )
+        if ix.residual_dirty or self._dev_residual is None or (
+            self._dev_residual.shape[0] != self.table.capacity
+        ):
+            mask = np.zeros(self.table.capacity, bool)
+            if ix.residual_rows:
+                mask[list(ix.residual_rows)] = True
+            self._dev_residual = self._put(mask)
+            ix.residual_dirty = False
+
+    def hash_state(self) -> Tuple[ClassMeta, SlotArrays]:
+        assert self._dev_meta is not None and self._dev_slots is not None
+        return self._dev_meta, self._dev_slots
+
+    def residual_filters(self) -> EncodedFilters:
+        """EncodedFilters view whose active mask covers only residual
+        (budget-overflow) rows — input to the dense fallback kernel."""
+        assert self._dev is not None and self._dev_residual is not None
+        return self._dev._replace(active=self._dev_residual)
 
     def sync(self) -> int:
         """Bring device state up to date; returns rows written."""
@@ -101,16 +177,19 @@ class DeviceTable:
             n = len(t.dirty)
             t.drain_dirty()
             self._upload_full()
+            if self.index is not None:
+                self._sync_index()
             return n
         dirty = t.drain_dirty()
         total = len(dirty)
         if total == 0:
+            if self.index is not None:
+                self._sync_index()
             return 0
         # pad to [n_batches, K]: idempotent padding rewrites the last row;
         # n_batches rounds up to a power of two so recompiles stay
         # log-bounded across workload sizes
-        n_batches = max(1, -(-total // SYNC_BATCH_SIZE))
-        n_batches = 1 << (n_batches - 1).bit_length()
+        n_batches = _next_pow2(-(-total // SYNC_BATCH_SIZE))
         rows = np.full(n_batches * SYNC_BATCH_SIZE, dirty[-1], np.int32)
         rows[:total] = dirty
         shape2 = (n_batches, SYNC_BATCH_SIZE)
@@ -123,6 +202,8 @@ class DeviceTable:
             jnp.asarray(t.root_wild[rows].reshape(shape2)),
             jnp.asarray(t.active[rows].reshape(shape2)),
         )
+        if self.index is not None:
+            self._sync_index()
         return total
 
     def filters(self) -> EncodedFilters:
@@ -134,7 +215,9 @@ class Router:
     """Topic/filter -> dests with exact/wildcard split and device
     offload for batched wildcard matching."""
 
-    def __init__(self, max_levels: int = 16, device=None) -> None:
+    def __init__(
+        self, max_levels: int = 16, device=None, use_hash_index: bool = True
+    ) -> None:
         self.max_levels = max_levels
         # route-transition callbacks: fired when a (filter, dest) pair
         # first appears / finally disappears — the seam the cluster
@@ -154,7 +237,8 @@ class Router:
         # own depth-unlimited trie (ids are (filter, dest) pairs)
         self._deep: Dict[Tuple[str, Dest], int] = {}
         self._deep_trie = TopicTrie()
-        self.device_table = DeviceTable(self.table, device=device)
+        self.index = ClassIndex(max_levels) if use_hash_index else None
+        self.device_table = DeviceTable(self.table, device=device, index=self.index)
 
     # --- write path (emqx_router:do_add_route / do_delete_route) -------
 
@@ -185,6 +269,8 @@ class Router:
         self._pair_refs[key] = 1
         self._row_dest[row] = key
         self._trie.insert(topic_mod.words(flt), row)
+        if self.index is not None:
+            self.index.add_row(row, self.table)
         if self.on_dest_added is not None:
             self.on_dest_added(flt, dest)
 
@@ -219,6 +305,8 @@ class Router:
         del self._pair_refs[key]
         del self._row_dest[row]
         self._trie.remove(topic_mod.words(flt), row)
+        if self.index is not None:
+            self.index.remove_row(row)
         self.table.remove(row)
         if self.on_dest_removed is not None:
             self.on_dest_removed(flt, dest)
@@ -282,32 +370,67 @@ class Router:
             dests |= self._deep_matches(tw)
         return dests
 
+    @staticmethod
+    def _escalating_pairs(kernel, max_hits: int):
+        """Run a compaction kernel (max_hits -> (a, b, total)), escalating
+        max_hits once to the exact total on overflow (both kernels report
+        the true count, so one retry suffices — no bitmap fallback)."""
+        a, b, total = kernel(max_hits)
+        total = int(total)
+        if total > max_hits:
+            a, b, _ = kernel(_next_pow2(total))
+        return np.asarray(a), np.asarray(b), total
+
     def match_batch(self, topics: Sequence[str]) -> List[Set[Dest]]:
         """Batched device path: ONE XLA dispatch for all wildcard
         matching, host hash for exact topics. The hot loop of
-        emqx_broker:do_publish expressed over a topic batch."""
+        emqx_broker:do_publish expressed over a topic batch.
+
+        With the pattern-class index (default) the wildcard leg is a
+        B×C hash-probe kernel returning (topic, bucket) candidates that
+        the host verifies against the oracle before expanding to dests;
+        rows the index couldn't class (skeleton budget) fall back to
+        the dense kernel over a residual mask. Result transfers stay
+        proportional to the number of matches either way, with one
+        exact-size retry on overflow."""
         if not topics:
             return []
         self.device_table.sync()
         enc = match_ops.encode_topics(self.table.vocab, topics, self.max_levels)
-        filters = self.device_table.filters()
         out: List[Set[Dest]] = [self._exact_dests(t) for t in topics]
-        # compacted result: transfer ∝ matches; pick the bound from the
-        # batch size and escalate once on overflow before the bitmap
-        # fallback (transfer ∝ table size)
-        max_hits = max(4096, 4 * len(topics))
-        ti, ri, total = (
-            np.asarray(a)
-            for a in match_ops.match_ids(filters, enc, max_hits=max_hits)
-        )
-        if total > max_hits:
-            packed = np.asarray(match_ops.match_packed(filters, enc))
-            for i in range(len(topics)):
-                for row in match_ops.unpack_indices(packed[i]):
-                    out[i].add(self._row_dest[int(row)][1])
+        ix = self.index
+        if ix is not None:
+            if len(ix):
+                meta, slots = self.device_table.hash_state()
+                ti, bi, total = self._escalating_pairs(
+                    lambda mh: hash_ops.match_ids_hash(meta, slots, enc, max_hits=mh),
+                    max(1024, _next_pow2(2 * len(topics))),
+                )
+                twords: List = [None] * len(topics)
+                for t_idx, bid in zip(ti[:total], bi[:total]):
+                    t_idx, bid = int(t_idx), int(bid)
+                    if twords[t_idx] is None:
+                        twords[t_idx] = topic_mod.words(topics[t_idx])
+                    fw = ix.bucket_filter(bid)
+                    if topic_mod.match(twords[t_idx], fw):
+                        for row in ix.bucket_rows(bid):
+                            out[t_idx].add(self._row_dest[row][1])
+            if ix.residual_rows:
+                filters = self.device_table.residual_filters()
+                ti, ri, total = self._escalating_pairs(
+                    lambda mh: match_ops.match_ids(filters, enc, max_hits=mh),
+                    max(1024, _next_pow2(2 * len(topics))),
+                )
+                for t_idx, row in zip(ti[:total], ri[:total]):
+                    out[int(t_idx)].add(self._row_dest[int(row)][1])
         else:
+            filters = self.device_table.filters()
+            ti, ri, total = self._escalating_pairs(
+                lambda mh: match_ops.match_ids(filters, enc, max_hits=mh),
+                max(4096, _next_pow2(4 * len(topics))),
+            )
             for t_idx, row in zip(ti[:total], ri[:total]):
-                out[t_idx].add(self._row_dest[int(row)][1])
+                out[int(t_idx)].add(self._row_dest[int(row)][1])
         if self._deep:
             for i, t in enumerate(topics):
                 out[i] |= self._deep_matches(topic_mod.words(t))

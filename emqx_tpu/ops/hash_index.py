@@ -1,0 +1,381 @@
+"""Pattern-class hash index — the ≥10× match kernel.
+
+The dense kernel (ops/match.py) streams every filter row per topic:
+B×N×L compares, compute-bound at ~10ms/batch for N=1M (PERF_NOTES.md).
+This module exploits the structure of real subscription tables: they
+contain FEW distinct wildcard *skeletons* (the positions of '+'/'#'
+and the prefix length — the reference observes the same regularity in
+its learned-topic-structure trie, apps/emqx_durable_storage/src/
+emqx_ds_lts.erl:20-45, and in the retainer's reordered word
+projections, apps/emqx_retainer/src/emqx_retainer_index.erl:17-50).
+
+Grouping filters by skeleton ("class"), all filters of one class agree
+on which level positions are literals. Matching one topic against an
+entire class is then ONE hash probe: project the topic's words at the
+class's literal positions, hash, and look up an open-addressing table.
+Per batch the kernel does B×C hash mixes + B×C×P gathers instead of
+B×N×L compares — for C≈32 classes that is ~1000× less work than the
+dense kernel at N=1M.
+
+Design points:
+
+* ONE global open-addressing table for all classes, keyed by
+  (class id, literal-word projection). Growth is a global rehash —
+  the only recompile event, mirroring FilterTable capacity bumps.
+* A slot holds (fingerprint u32, bucket id i32). A **bucket** is one
+  distinct filter string; all routes for that filter (1 or 100k dests)
+  share the bucket, so wide fanout costs one slot and one device hit.
+* Exactness: equal projections hash equal (no false negatives); hash
+  collisions are possible but the host verifies each candidate
+  (topic, bucket) pair against the pure oracle before expanding it to
+  destinations — the "false-positive verify on host" scheme SURVEY.md
+  §7 prescribes for unbounded vocabularies.
+* Skeleton budget: at most C classes (static shape). Tables with
+  adversarially many skeletons overflow into a *residual* row set that
+  the caller matches with the dense kernel — graceful degradation, not
+  a cliff.
+
+The kernel returns compacted (topic_idx, bucket_id) pairs with an
+exact total, so an undersized result buffer escalates once to
+next_pow2(total) and never falls back to full bitmaps.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, NamedTuple, Optional, Set, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .match import EncodedTopics
+from .table import FilterTable
+from .vocab import PLUS
+
+DEFAULT_CLASS_BUDGET = 256
+MAX_PROBES = 8
+MIN_SLOTS = 1024
+MAX_LOAD_NUM, MAX_LOAD_DEN = 1, 2  # rebuild past 50% fill
+
+M32 = 0xFFFFFFFF
+_H1_SEED, _H1_CLS, _H1_MUL = 0x811C9DC5, 0x9E3779B1, 16777619
+_FP_SEED, _FP_CLS, _FP_XOR, _FP_MUL = 0x2545F491, 0x85EBCA6B, 0xC2B2AE35, 0x27D4EB2F
+
+
+def _hash_host(class_id: int, lit_words: List[Tuple[int, int]], max_levels: int):
+    """Host mirror of the device hash. lit_words = [(position, word_id)]
+    for the literal positions only; all other positions contribute 0.
+    Must stay bit-identical to the mixing loop in match_ids_hash."""
+    xs = [0] * max_levels
+    for pos, wid in lit_words:
+        xs[pos] = (wid + 1) & M32
+    h1 = (_H1_SEED ^ ((class_id * _H1_CLS) & M32)) & M32
+    fp = (_FP_SEED + ((class_id * _FP_CLS) & M32)) & M32
+    for x in xs:
+        h1 = ((h1 ^ x) * _H1_MUL) & M32
+        fp = ((fp ^ ((x * _FP_XOR) & M32)) * _FP_MUL) & M32
+    return h1, fp
+
+
+class ClassMeta(NamedTuple):
+    """Per-class metadata arrays, [C] each (device or host numpy)."""
+
+    plen: np.ndarray  # int32 — levels before '#'
+    has_hash: np.ndarray  # bool — skeleton ends in '#'
+    root_wild: np.ndarray  # bool — first level is '+'/'#' ($-topic rule)
+    plus: np.ndarray  # uint32 — bitmask of '+' positions (< plen)
+    active: np.ndarray  # bool — class id in use
+
+
+class SlotArrays(NamedTuple):
+    """The open-addressing table, [T] each. bucket: -1 empty, -2
+    tombstone, >=0 live bucket id (fingerprint only valid when >=0)."""
+
+    fp: np.ndarray  # uint32
+    bucket: np.ndarray  # int32
+
+
+class _Bucket(NamedTuple):
+    filter_words: Tuple[str, ...]
+    class_id: int
+    h1: int
+    fp: int
+    slot: int
+
+
+class _NeedRebuild(Exception):
+    pass
+
+
+class ClassIndex:
+    """Host source of truth for the pattern-class hash table.
+
+    The owner (Router/DeviceTable) calls add_row/remove_row alongside
+    FilterTable add/remove; this module keeps skeleton classes, filter
+    buckets, and the slot array coherent, tracking dirty slots for
+    incremental device sync."""
+
+    def __init__(
+        self,
+        max_levels: int,
+        class_budget: int = DEFAULT_CLASS_BUDGET,
+        min_slots: int = MIN_SLOTS,
+    ) -> None:
+        assert min_slots >= 32 and min_slots & (min_slots - 1) == 0
+        self.max_levels = max_levels
+        self.class_budget = class_budget
+        self._skel_class: Dict[Tuple[int, bool, int], int] = {}
+        self._class_free: List[int] = list(range(class_budget - 1, -1, -1))
+        self._class_buckets: List[int] = [0] * class_budget
+        self.meta = ClassMeta(
+            np.zeros(class_budget, np.int32),
+            np.zeros(class_budget, bool),
+            np.zeros(class_budget, bool),
+            np.zeros(class_budget, np.uint32),
+            np.zeros(class_budget, bool),
+        )
+        self.n_slots = min_slots
+        self.slots = SlotArrays(
+            np.zeros(min_slots, np.uint32), np.full(min_slots, -1, np.int32)
+        )
+        self._fill = 0  # live + tombstoned slots (probe-chain occupancy)
+        self._live = 0  # live slots only
+        self._buckets: List[Optional[_Bucket]] = []
+        self._bucket_free: List[int] = []
+        self._bucket_of: Dict[Tuple[str, ...], int] = {}
+        self._bucket_rows: List[Set[int]] = []
+        self._row_bucket: Dict[int, int] = {}
+        # rows that could not get a class (skeleton budget exhausted):
+        # matched by the dense kernel over a residual mask instead
+        self.residual_rows: Set[int] = set()
+        self.residual_dirty = False
+        self.dirty_slots: Set[int] = set()
+        self.meta_dirty = True
+        self.rebuilt = True  # device must re-upload slot arrays
+
+    def __len__(self) -> int:
+        return self._live
+
+    # --- write path ----------------------------------------------------
+
+    def add_row(self, row: int, table: FilterTable) -> None:
+        """Index row `row` of `table` (call right after table.add)."""
+        ws = table.filter_words(row)
+        plen = int(table.prefix_len[row])
+        if plen > 32:
+            # the '+'-position bitmask is uint32 and the device kernel
+            # shifts it by the level index — skeletons deeper than 32
+            # levels can't be classed; they degrade to the dense
+            # residual path (same contract as budget overflow)
+            self.residual_rows.add(row)
+            self.residual_dirty = True
+            return
+        has_hash = bool(table.has_hash[row])
+        plus_mask = 0
+        lit_words: List[Tuple[int, int]] = []
+        for i in range(plen):
+            wid = int(table.words[row, i])
+            if wid == PLUS:
+                plus_mask |= 1 << i
+            else:
+                lit_words.append((i, wid))
+        bid = self._bucket_of.get(ws)
+        if bid is not None:
+            self._bucket_rows[bid].add(row)
+            self._row_bucket[row] = bid
+            return
+        cid = self._class_of(plen, has_hash, bool(table.root_wild[row]), plus_mask)
+        if cid is None:
+            self.residual_rows.add(row)
+            self.residual_dirty = True
+            return
+        h1, fp = _hash_host(cid, lit_words, self.max_levels)
+        bid = self._bucket_free.pop() if self._bucket_free else len(self._buckets)
+        if bid == len(self._buckets):
+            self._buckets.append(None)
+            self._bucket_rows.append(set())
+        try:
+            slot = self._place(h1, fp, bid)
+        except _NeedRebuild:
+            self._buckets[bid] = _Bucket(ws, cid, h1, fp, -1)
+            self._finish_bucket(bid, row, ws, cid)
+            self._rebuild(self.n_slots * 2)
+            return
+        self._buckets[bid] = _Bucket(ws, cid, h1, fp, slot)
+        self._finish_bucket(bid, row, ws, cid)
+        if self._fill * MAX_LOAD_DEN > self.n_slots * MAX_LOAD_NUM:
+            self._rebuild(self.n_slots * 2)
+
+    def _finish_bucket(self, bid: int, row: int, ws, cid: int) -> None:
+        self._bucket_rows[bid] = {row}
+        self._bucket_of[ws] = bid
+        self._row_bucket[row] = bid
+        self._class_buckets[cid] += 1
+        self._live += 1
+
+    def remove_row(self, row: int) -> None:
+        """Un-index a row (safe before or after table.remove)."""
+        if row in self.residual_rows:
+            self.residual_rows.discard(row)
+            self.residual_dirty = True
+            return
+        bid = self._row_bucket.pop(row)
+        rows = self._bucket_rows[bid]
+        rows.discard(row)
+        if rows:
+            return
+        b = self._buckets[bid]
+        assert b is not None
+        if b.slot >= 0:
+            self.slots.bucket[b.slot] = -2  # tombstone keeps probe chains
+            self.dirty_slots.add(b.slot)
+            self._live -= 1
+        del self._bucket_of[b.filter_words]
+        self._buckets[bid] = None
+        self._bucket_free.append(bid)
+        self._class_buckets[b.class_id] -= 1
+        if self._class_buckets[b.class_id] == 0:
+            self._retire_class(b.class_id)
+
+    # --- read path (host) ----------------------------------------------
+
+    def bucket_filter(self, bid: int) -> Tuple[str, ...]:
+        b = self._buckets[bid]
+        assert b is not None, f"bucket {bid} not live"
+        return b.filter_words
+
+    def bucket_rows(self, bid: int) -> Set[int]:
+        return self._bucket_rows[bid]
+
+    # --- internals ------------------------------------------------------
+
+    def _class_of(
+        self, plen: int, has_hash: bool, root_wild: bool, plus_mask: int
+    ) -> Optional[int]:
+        skel = (plen, has_hash, plus_mask)
+        cid = self._skel_class.get(skel)
+        if cid is not None:
+            return cid
+        if not self._class_free:
+            return None
+        cid = self._class_free.pop()
+        self._skel_class[skel] = cid
+        self.meta.plen[cid] = plen
+        self.meta.has_hash[cid] = has_hash
+        self.meta.root_wild[cid] = root_wild
+        self.meta.plus[cid] = plus_mask
+        self.meta.active[cid] = True
+        self.meta_dirty = True
+        return cid
+
+    def _retire_class(self, cid: int) -> None:
+        skel = (
+            int(self.meta.plen[cid]),
+            bool(self.meta.has_hash[cid]),
+            int(self.meta.plus[cid]),
+        )
+        del self._skel_class[skel]
+        self.meta.active[cid] = False
+        self.meta_dirty = True
+        self._class_free.append(cid)
+
+    def _place(self, h1: int, fp: int, bid: int) -> int:
+        mask = self.n_slots - 1
+        for p in range(MAX_PROBES):
+            i = (h1 + p) & mask
+            cur = self.slots.bucket[i]
+            if cur < 0:
+                if cur == -1:
+                    self._fill += 1
+                self.slots.fp[i] = fp
+                self.slots.bucket[i] = bid
+                self.dirty_slots.add(i)
+                return i
+        raise _NeedRebuild
+
+    def _rebuild(self, n_slots: int) -> None:
+        """Global rehash into a table of n_slots (doubling until every
+        bucket places within MAX_PROBES)."""
+        while True:
+            slots = SlotArrays(
+                np.zeros(n_slots, np.uint32), np.full(n_slots, -1, np.int32)
+            )
+            mask = n_slots - 1
+            ok = True
+            for bid, b in enumerate(self._buckets):
+                if b is None:
+                    continue
+                for p in range(MAX_PROBES):
+                    i = (b.h1 + p) & mask
+                    if slots.bucket[i] == -1:
+                        slots.fp[i] = b.fp
+                        slots.bucket[i] = bid
+                        self._buckets[bid] = b._replace(slot=i)
+                        break
+                else:
+                    ok = False
+                    break
+            if ok:
+                break
+            n_slots *= 2
+        self.n_slots = n_slots
+        self.slots = slots
+        self._fill = self._live
+        self.dirty_slots.clear()
+        self.rebuilt = True
+
+
+@functools.partial(jax.jit, static_argnames=("max_hits", "n_probes"))
+def match_ids_hash(
+    meta: ClassMeta,
+    slots: SlotArrays,
+    topics: EncodedTopics,
+    max_hits: int = 4096,
+    n_probes: int = MAX_PROBES,
+):
+    """Hash-probe every (topic, class) pair in one dispatch.
+
+    Returns (topic_idx int32 [max_hits], bucket_id int32 [max_hits],
+    total int32). Valid slots are dense at the front; `total` is the
+    EXACT candidate count, so on overflow the caller re-runs once with
+    max_hits = next_pow2(total). Candidates may (rarely) be hash false
+    positives — the caller verifies each pair on the host before
+    expanding buckets to destinations."""
+    b, max_levels = topics.ids.shape
+    c = meta.plen.shape[0]
+    tl = topics.lens[:, None]  # [B,1]
+    pl = meta.plen[None, :]  # [1,C]
+    len_ok = jnp.where(meta.has_hash[None, :], tl >= pl, tl == pl)
+    elig = len_ok & meta.active[None, :] & ~(
+        topics.dollar[:, None] & meta.root_wild[None, :]
+    )  # [B,C]
+    cids = jnp.arange(c, dtype=jnp.uint32)
+    h1 = jnp.broadcast_to(
+        jnp.uint32(_H1_SEED) ^ (cids * jnp.uint32(_H1_CLS)), (b, c)
+    )
+    fp = jnp.broadcast_to(
+        jnp.uint32(_FP_SEED) + (cids * jnp.uint32(_FP_CLS)), (b, c)
+    )
+    for i in range(max_levels):
+        lit = (i < meta.plen) & (((meta.plus >> i) & 1) == 0)  # [C]
+        x = jnp.where(
+            lit[None, :],
+            topics.ids[:, i : i + 1].astype(jnp.uint32) + 1,
+            jnp.uint32(0),
+        )  # [B,C]
+        h1 = (h1 ^ x) * jnp.uint32(_H1_MUL)
+        fp = (fp ^ (x * jnp.uint32(_FP_XOR))) * jnp.uint32(_FP_MUL)
+    mask = jnp.uint32(slots.fp.shape[0] - 1)
+    idx = (
+        (h1[:, :, None] + jnp.arange(n_probes, dtype=jnp.uint32)) & mask
+    ).astype(jnp.int32)  # [B,C,P]
+    g_fp = slots.fp[idx]
+    g_bkt = slots.bucket[idx]
+    hit = elig[:, :, None] & (g_fp == fp[:, :, None]) & (g_bkt >= 0)
+    total = hit.sum(dtype=jnp.int32)
+    flat = jnp.nonzero(hit.reshape(-1), size=max_hits, fill_value=-1)[0]
+    valid = flat >= 0
+    ti = jnp.where(valid, flat // (c * n_probes), -1).astype(jnp.int32)
+    bi = jnp.where(valid, g_bkt.reshape(-1)[flat], -1).astype(jnp.int32)
+    return ti, bi, total

@@ -1,0 +1,255 @@
+"""Pattern-class hash index tests: kernel + host verify vs the oracle.
+
+Same strategy as test_match.py (the reference property-tests every
+index implementation against emqx_topic:match/2); here the object
+under test is the B×C hash-probe kernel plus its host-side bucket
+expansion, exercised both directly and through Router.match_batch.
+"""
+
+import random
+
+import numpy as np
+
+from emqx_tpu.models.router import Router
+from emqx_tpu.ops import hash_index as H
+from emqx_tpu.ops import match as M
+from emqx_tpu.ops import topic as T
+from emqx_tpu.ops.table import FilterTable
+
+from test_match import random_filter, random_topic
+
+
+def oracle_dests(routes, topic):
+    tw = T.words(topic)
+    return {d for (f, d) in routes if T.match(tw, T.words(f))}
+
+
+def build_indexed(filters):
+    table = FilterTable(max_levels=6, capacity=1024)
+    ix = H.ClassIndex(table.max_levels, min_slots=64)
+    rows = []
+    for f in filters:
+        row = table.add(f)
+        ix.add_row(row, table)
+        rows.append(row)
+    return table, ix, rows
+
+
+def hash_match_rows(table, ix, topics, max_hits=4096):
+    """Kernel + host verify + bucket expansion -> per-topic row sets."""
+    enc = M.encode_topics(table.vocab, topics, table.max_levels)
+    meta = H.ClassMeta(*(np.array(a) for a in ix.meta))
+    slots = H.SlotArrays(*(np.array(a) for a in ix.slots))
+    ti, bi, total = H.match_ids_hash(meta, slots, enc, max_hits=max_hits)
+    total = int(total)
+    assert total <= max_hits, "test tables must fit the bound"
+    out = [set() for _ in topics]
+    for t_idx, bid in zip(np.asarray(ti)[:total], np.asarray(bi)[:total]):
+        t_idx, bid = int(t_idx), int(bid)
+        if T.match(T.words(topics[t_idx]), ix.bucket_filter(bid)):
+            out[t_idx] |= ix.bucket_rows(bid)
+    return out
+
+
+def assert_hash_matches_oracle(table, ix, topics):
+    expected = M.oracle_match_rows(table, topics)
+    got = hash_match_rows(table, ix, topics)
+    for i, t in enumerate(topics):
+        exp = set(int(r) for r in expected[i]) - ix.residual_rows
+        assert got[i] == exp, (
+            f"hash mismatch for {t!r}: got "
+            f"{sorted('/'.join(table.filter_words(r)) for r in got[i])} "
+            f"expected {sorted('/'.join(table.filter_words(r)) for r in exp)}"
+        )
+
+
+def test_basic_classes():
+    table, ix, _ = build_indexed(
+        ["a/b/c", "a/+/c", "a/#", "#", "+/b/#", "$SYS/#", "a//b", "+", "x/y"]
+    )
+    assert not ix.residual_rows
+    assert_hash_matches_oracle(
+        table, ix, ["a/b/c", "a/x/c", "a", "x", "$SYS/broker", "a//b", "", "x/y"]
+    )
+
+
+def test_bucket_shares_slot_across_dests():
+    """100k routes on one filter must cost ONE slot (the bucket rule)."""
+    table, ix, rows = build_indexed(["t/+/x"] * 500)
+    assert len(ix) == 1  # one live bucket
+    got = hash_match_rows(table, ix, ["t/9/x"])
+    assert got[0] == set(rows)
+
+
+def test_property_random_tables_with_churn():
+    rng = random.Random(7)
+    for _ in range(8):
+        table = FilterTable(max_levels=6, capacity=1024)
+        ix = H.ClassIndex(table.max_levels, min_slots=32)  # force rebuilds
+        live = []
+        for _ in range(rng.randint(50, 400)):
+            f = random_filter(rng)
+            row = table.add(f)
+            ix.add_row(row, table)
+            live.append(row)
+        for row in rng.sample(live, len(live) // 3):
+            ix.remove_row(row)
+            table.remove(row)
+            live.remove(row)
+        for _ in range(rng.randint(0, 60)):
+            row = table.add(random_filter(rng))
+            ix.add_row(row, table)
+            live.append(row)
+        topics = [random_topic(rng) for _ in range(64)]
+        assert_hash_matches_oracle(table, ix, topics)
+
+
+def test_tombstones_keep_probe_chains():
+    # many filters in one class to build probe clusters, then delete some
+    table = FilterTable(max_levels=4, capacity=1024)
+    ix = H.ClassIndex(table.max_levels, min_slots=32)
+    rows = {}
+    for i in range(200):
+        f = f"lvl/{i}/+"
+        rows[f] = table.add(f)
+        ix.add_row(rows[f], table)
+    for i in range(0, 200, 3):
+        f = f"lvl/{i}/+"
+        ix.remove_row(rows[f])
+        table.remove(rows[f])
+        del rows[f]
+    topics = [f"lvl/{i}/zz" for i in range(0, 200, 7)]
+    assert_hash_matches_oracle(table, ix, topics)
+
+
+def test_class_budget_overflow_residual():
+    table = FilterTable(max_levels=8, capacity=1024)
+    ix = H.ClassIndex(table.max_levels, class_budget=4, min_slots=32)
+    # 4 distinct skeletons fill the budget; later skeletons go residual
+    for f in ["a/b", "a/+", "a/#", "+/b/c"]:
+        ix.add_row(table.add(f), table)
+    assert not ix.residual_rows
+    r5 = table.add("+/+/+/x")  # 5th skeleton
+    ix.add_row(r5, table)
+    assert r5 in ix.residual_rows
+    # same-skeleton filters still get classed
+    r6 = table.add("q/+")
+    ix.add_row(r6, table)
+    assert r6 not in ix.residual_rows
+    # removing residual rows maintains the set
+    ix.remove_row(r5)
+    table.remove(r5)
+    assert not ix.residual_rows
+    # class retirement frees budget for a new skeleton
+    ix.remove_row(r6)  # 'a/+' skeleton still held by row 1
+    table.remove(r6)
+
+
+def test_class_retirement_reuses_budget():
+    table = FilterTable(max_levels=4, capacity=1024)
+    ix = H.ClassIndex(table.max_levels, class_budget=2, min_slots=32)
+    r1 = table.add("a/b")
+    ix.add_row(r1, table)
+    r2 = table.add("c/+")
+    ix.add_row(r2, table)
+    r3 = table.add("x/y/z")  # budget exhausted -> residual
+    ix.add_row(r3, table)
+    assert r3 in ix.residual_rows
+    ix.remove_row(r1)
+    table.remove(r1)  # retires the 'a/b' skeleton class
+    r4 = table.add("q/r/s")  # new skeleton fits the freed class slot
+    ix.add_row(r4, table)
+    assert r4 not in ix.residual_rows
+    assert_hash_matches_oracle(table, ix, ["q/r/s", "c/9", "a/b"])
+
+
+def test_router_hash_path_vs_oracle():
+    rng = random.Random(11)
+    routes = []
+    r = Router(max_levels=6)
+    assert r.index is not None
+    for i in range(500):
+        f = random_filter(rng)
+        d = f"n{rng.randint(0, 5)}"
+        routes.append((f, d))
+        r.add_route(f, d)
+    for _ in range(120):
+        f, d = routes.pop(rng.randrange(len(routes)))
+        r.delete_route(f, d)
+    topics = [random_topic(rng) for _ in range(96)]
+    got = r.match_batch(topics)
+    for i, t in enumerate(topics):
+        assert got[i] == oracle_dests(routes, t), t
+        assert got[i] == r.match_routes(t), t
+
+
+def test_router_residual_and_hash_combined():
+    """Router with a tiny class budget: some filters hash-classed, some
+    residual-dense — match_batch must merge both legs correctly."""
+    r = Router(max_levels=8)
+    assert r.index is not None
+    r.index.class_budget = 2
+    r.index._class_free = [1, 0]
+    routes = []
+    for f, d in [
+        ("a/+", "n1"),
+        ("b/+", "n2"),  # same skeleton as a/+
+        ("a/#", "n3"),
+        ("+/+/c", "n4"),  # 3rd skeleton -> residual
+        ("x/y/z/w", "n5"),  # 4th skeleton -> residual
+        ("exact/topic", "n6"),
+    ]:
+        r.add_route(f, d)
+        routes.append((f, d))
+    assert r.index.residual_rows
+    topics = ["a/1", "b/2", "a", "q/r/c", "x/y/z/w", "exact/topic", "$SYS/x"]
+    got = r.match_batch(topics)
+    for i, t in enumerate(topics):
+        assert got[i] == oracle_dests(routes, t), t
+
+
+def test_router_overflow_escalation():
+    """More matches than the initial max_hits bound: the exact-total
+    retry must return the full result (no silent truncation)."""
+    r = Router(max_levels=4)
+    routes = []
+    for i in range(3000):
+        f = f"f/{i}/#"
+        r.add_route(f, f"n{i}")
+        routes.append((f, f"n{i}"))
+    # every topic f/i/x matches exactly one filter... instead use shared
+    # prefix wildcards so a single topic matches thousands of buckets
+    for i in range(2000):
+        f = f"w/{i}/+"
+        r.add_route(f, f"m{i}")
+        routes.append((f, f"m{i}"))
+    topics = [f"w/{i}/q" for i in range(1500)]  # 1500 matches + exacts
+    got = r.match_batch(topics)
+    for i, t in enumerate(topics):
+        assert got[i] == oracle_dests(routes, t), t
+
+
+def test_hash_host_device_agreement():
+    """The host placement hash and the device probe hash must be
+    bit-identical — a direct check, not just end-to-end."""
+    table, ix, _ = build_indexed(["dev/+/room/#", "dev/a/room/#"])
+    enc = M.encode_topics(table.vocab, ["dev/a/room/1"], table.max_levels)
+    meta = H.ClassMeta(*(np.array(a) for a in ix.meta))
+    slots = H.SlotArrays(*(np.array(a) for a in ix.slots))
+    ti, bi, total = H.match_ids_hash(meta, slots, enc, max_hits=64)
+    # both buckets must be found via their stored (h1, fp)
+    assert int(total) == 2
+
+
+def test_deep_skeleton_goes_residual():
+    """plen > 32 can't be expressed in the uint32 plus-mask — such rows
+    must degrade to the residual (dense) path, not crash or misroute."""
+    r = Router(max_levels=40)
+    deep = "/".join(["a"] * 33) + "/+"
+    r.add_route(deep, "n1")
+    r.add_route("a/+", "n2")
+    assert r.index is not None and len(r.index.residual_rows) == 1
+    t = "/".join(["a"] * 34)
+    got = r.match_batch([t, "a/zz"])
+    assert got[0] == {"n1"}
+    assert got[1] == {"n2"}
