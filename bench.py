@@ -1,90 +1,65 @@
 """North-star benchmark: batched wildcard topic matching on TPU.
 
-Workload ≈ BASELINE.json config #2/#3: a 1M-row wildcard filter table
-(IoT-shaped `tenant/region/dev/+/metric/#` filters, L=8) matched by
-1024-topic batches. Compares the one-dispatch TPU kernel against the
-in-process host trie (the same recursive-descent structure the broker
-uses as its CPU path — itself the analog of the reference's
-emqx_trie/emqx_trie_search match, apps/emqx/src/emqx_trie_search.erl).
+Covers the BASELINE.md config matrix:
+
+  #1  10K exact-match subs, 1K-topic batches (host hash path — the v2
+      exact/wildcard split keeps this off the device entirely).
+  #2  (headline) 1M wildcard subs, 1024-topic batches through the
+      pattern-class hash kernel (ops/hash_index.py).
+  #3  10M mixed +/# subs over a 6-level IoT tree, same kernel.
+  #4  $share groups over the 1M table: match + group-hash member pick.
+  #5  rule-engine FROM filters (10K) through the same matcher.
+
+plus insert RPS (route churn incl. device delta-scatter sync) and
+table RAM (host + device + baseline index).
+
+The CPU baseline is the reference's own v2 match algorithm — the
+ordered-set skip-scan of apps/emqx/src/emqx_trie_search.erl:192-348 —
+reimplemented in C++ over a red-black tree (native/triesearch.cc).
+That is *faster* than the BEAM original it mirrors (no term boxing, no
+ets call overhead), so vs_baseline is conservative: the BEAM broker
+itself would score lower.  (No Erlang toolchain ships in this image,
+so running apps/emqx/src/emqx_broker_bench.erl directly is not
+possible; this is the measured-equivalent VERDICT.md asked for.)
 
 Measurement notes (see PERF_NOTES.md): the axon relay memoizes repeated
 identical computations, does not synchronize on block_until_ready, and
-has a ~66ms dispatch RTT floor. So: fresh topic ids per dispatch, K
-batches per dispatch inside lax.scan, one scalar fetch, subtract the
-measured RTT floor.
+has a ~66-90ms dispatch RTT floor. So: fresh topic values per dispatch,
+K batches per dispatch inside lax.scan, one scalar fetch, subtract the
+measured RTT floor.  An on-device exactness check (kernel candidates
+vs the native oracle on a sampled batch) runs as part of the headline
+config — a TPU-only numeric bug fails the bench, not just a test on a
+CPU mesh.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"};
+writes the full matrix to BENCH_DETAILS.json.
 """
 
 import json
+import os
 import sys
 import time
 
 import numpy as np
+
+# EMQX_BENCH_SCALE=small shrinks every table by 64x for CI smoke runs
+SMALL = os.environ.get("EMQX_BENCH_SCALE") == "small"
+SHRINK = 64 if SMALL else 1
 
 
 def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
-def main():
-    import jax
-    import jax.numpy as jnp
+def pctl(xs, p):
+    return float(np.percentile(np.asarray(xs, float), p))
 
-    from emqx_tpu.ops import match as M
-    from emqx_tpu.ops import topic as topic_mod
-    from emqx_tpu.ops.host_index import TopicTrie
-    from emqx_tpu.ops.match import _match_block
-    from emqx_tpu.ops.table import FilterTable
 
-    L = 8
-    N = 1 << 20
-    B = 1024
-    K = 16  # batches per dispatch
-    DISPATCHES = 4
+# --------------------------------------------------------------------------
+# shared plumbing
 
-    log(f"devices: {jax.devices()}")
-    t0 = time.time()
-    table = FilterTable(max_levels=L, capacity=N)
-    trie = TopicTrie()
-    for i in range(N):
-        f = f"t{i % 997}/r{i % 13}/d{i}/+/m/#"
-        row = table.add(f)
-        trie.insert(topic_mod.words(f), row)
-    log(f"built 1M-filter table+trie in {time.time() - t0:.1f}s")
 
-    dev = jax.tree.map(jnp.asarray, table.snapshot())
-
-    # topic batches: hit rate ~1 match/topic (realistic sparse fanout)
-    rng = np.random.default_rng(7)
-
-    def fresh_args():
-        dd = rng.integers(0, N, size=(K, B))
-        ids = np.zeros((K, B, L), np.int32)
-        lk = table.vocab.lookup
-        # vectorized-ish encode: levels are t{d%997}/r{d%13}/d{d}/x9/m/temp
-        for k in range(K):
-            for b in range(B):
-                d = dd[k, b]
-                for j, w in enumerate(
-                    (f"t{d % 997}", f"r{d % 13}", f"d{d}", "x9", "m", "temp")
-                ):
-                    ids[k, b, j] = lk(w)
-        lens = np.full((K, B), 6, np.int32)
-        dollar = np.zeros((K, B), bool)
-        return jnp.asarray(ids), jnp.asarray(lens), jnp.asarray(dollar)
-
-    @jax.jit
-    def many(dev, ids, lens, dollar):
-        def one(carry, xs):
-            i, l, d = xs
-            ok = _match_block(i, l, d, *dev)
-            return carry + ok.sum(dtype=jnp.int32), None
-
-        s, _ = jax.lax.scan(one, jnp.int32(0), (ids, lens, dollar))
-        return s
-
-    # RTT floor of a dispatch+fetch round trip
+def rtt_floor(jax, jnp):
     @jax.jit
     def triv(x):
         return x + 1
@@ -95,49 +70,631 @@ def main():
         t0 = time.time()
         float(triv(jnp.float32(r + 100)))
         floors.append(time.time() - t0)
-    floor = float(np.median(floors))
-    log(f"dispatch RTT floor: {floor * 1e3:.1f} ms")
+    return float(np.median(floors))
 
-    args = fresh_args()
-    int(many(dev, *args))  # compile
-    times = []
-    total_matches = 0
-    for _ in range(DISPATCHES):
-        args = fresh_args()
+
+def make_scan_bench(jax, jnp, match_ids_hash, max_hits, gen_topics, k):
+    """One dispatch = generate K fresh topic batches ON DEVICE from a
+    seed scalar (uploading per-dispatch topic tensors through the
+    relay costs ~50ms/MB and would swamp the kernel), then lax.scan
+    the match over them.  Returns (total, checksum): the checksum
+    keeps the compaction from being dead-code eliminated, and only two
+    scalars cross the wire."""
+    from emqx_tpu.ops.match import EncodedTopics
+
+    @jax.jit
+    def many(meta, slots, aux, seed):
+        ids, lens, dollar = gen_topics(jax.random.PRNGKey(seed), aux)
+
+        def one(carry, xs):
+            enc = EncodedTopics(xs[0], xs[1], xs[2])
+            ti, bi, total = match_ids_hash(meta, slots, enc, max_hits=max_hits)
+            chk = (ti * jnp.int32(1315423911) + bi).sum(dtype=jnp.int32)
+            return (carry[0] + total, carry[1] + chk), None
+
+        (s, c), _ = jax.lax.scan(
+            one, (jnp.int32(0), jnp.int32(0)), (ids, lens, dollar)
+        )
+        return s, c
+
+    return many
+
+
+def time_dispatches(many, dev_args, floor, k, n_dispatches=6):
+    """Compile, then time n dispatches with fresh seeds.
+    Returns (per_batch_seconds list, total_matches)."""
+    r = many(*dev_args, 999_000)
+    _ = int(r[0])  # compile + settle
+    per_batch, total = [], 0
+    for i in range(n_dispatches):
         t0 = time.time()
-        total_matches += int(many(dev, *args))
-        times.append(time.time() - t0)
-    per_batch = (float(np.median(times)) - floor) / K
-    tpu_rate = B / per_batch
-    log(
-        f"TPU: {per_batch * 1e3:.2f} ms/batch-of-{B} "
-        f"({tpu_rate:,.0f} topics/s vs {N} subs; {total_matches} matches)"
+        s, _c = many(*dev_args, i)
+        total += int(s)
+        per_batch.append((time.time() - t0 - floor) / k)
+    return per_batch, total
+
+
+# --------------------------------------------------------------------------
+# headline: config #2 — 1M wildcard subs
+
+
+def bench_1m(jax, jnp, floor, details):
+    from emqx_tpu.ops import hash_index as H
+    from emqx_tpu.ops import native_baseline as NB
+    from emqx_tpu.ops import topic as topic_mod
+    from emqx_tpu.ops.hash_index import ClassIndex, match_ids_hash
+    from emqx_tpu.ops.match import EncodedTopics
+    from emqx_tpu.ops.table import FilterTable
+
+    L, N, B, K = 8, (1 << 20) // SHRINK, 1024, 16
+    t0 = time.time()
+    table = FilterTable(max_levels=L, capacity=N)
+    index = ClassIndex(L, min_slots=max(1024, (1 << 22) // SHRINK))
+    filters = []
+    for i in range(N):
+        f = f"t{i % 997}/r{i % 13}/d{i}/+/m/#"
+        filters.append(f)
+        index.add_row(table.add(f), table)
+    log(f"#2 built 1M-filter table+class index in {time.time() - t0:.1f}s "
+        f"(classes={int(index.meta.active.sum())}, slots={index.n_slots})")
+
+    meta = H.ClassMeta(*(jnp.asarray(a) for a in index.packed_meta()))
+    slots = H.SlotArrays(*(jnp.asarray(np.array(a)) for a in index.slots))
+
+    rng = np.random.default_rng(7)
+    lk = table.vocab.lookup
+
+    # word-id maps, uploaded ONCE: per-dispatch topics derive on device
+    # from a draw d in [0, N) via these gathers
+    t_map = jnp.asarray(np.array([lk(f"t{j}") for j in range(997)], np.int32))
+    r_map = jnp.asarray(np.array([lk(f"r{j}") for j in range(13)], np.int32))
+    d_map = jnp.asarray(np.array([lk(f"d{j}") for j in range(N)], np.int32))
+    m_id = int(lk("m"))
+
+    def gen_topics(key, aux):
+        tmap, rmap, dmap = aux
+        k1, k2 = jax.random.split(key)
+        d = jax.random.randint(k1, (K, B), 0, N)
+        junk = jax.random.randint(k2, (K, B), 1 << 28, 1 << 29)  # OOV-ish
+        ids = jnp.zeros((K, B, L), jnp.int32)
+        ids = ids.at[..., 0].set(tmap[d % 997])
+        ids = ids.at[..., 1].set(rmap[d % 13])
+        ids = ids.at[..., 2].set(dmap[d])
+        ids = ids.at[..., 3].set(junk)  # the '+' level: arbitrary word
+        ids = ids.at[..., 4].set(m_id)
+        ids = ids.at[..., 5].set(junk ^ 7)  # trailing level under '#'
+        lens = jnp.full((K, B), 6, jnp.int32)
+        dollar = jnp.zeros((K, B), bool)
+        return ids, lens, dollar
+
+    many = make_scan_bench(jax, jnp, match_ids_hash, 4096, gen_topics, K)
+    per_batch, total = time_dispatches(
+        many, (meta, slots, (t_map, r_map, d_map)), floor, K
+    )
+    med = float(np.median(per_batch))
+    rate = B / med
+    log(f"#2 TPU hash kernel: {med * 1e3:.3f} ms/batch-of-{B} "
+        f"({rate:,.0f} topics/s vs {N} subs; {total} matches over "
+        f"{len(per_batch) * K * B} topics)")
+
+    # --- on-device exactness: one real dispatch, verify vs native oracle
+    ds = rng.integers(0, N, size=B)
+    ids = np.zeros((B, L), np.int32)
+    for j, d in enumerate(ds):
+        for i, w in enumerate(
+            (f"t{d % 997}", f"r{d % 13}", f"d{d}", "x9", "m", "temp")
+        ):
+            ids[j, i] = lk(w)
+    enc = EncodedTopics(
+        jnp.asarray(ids),
+        jnp.asarray(np.full(B, 6, np.int32)),
+        jnp.asarray(np.zeros(B, bool)),
+    )
+    ti, bi, tot = match_ids_hash(meta, slots, enc, max_hits=4096)
+    ti, bi, tot = np.asarray(ti), np.asarray(bi), int(tot)
+    got = [set() for _ in range(B)]
+    topics_s = [
+        f"t{d % 997}/r{d % 13}/d{d}/x9/m/temp" for d in ds
+    ]
+    for t_idx, bid in zip(ti[:tot], bi[:tot]):
+        fw = index.bucket_filter(int(bid))
+        if topic_mod.match(topic_mod.words(topics_s[int(t_idx)]), fw):
+            got[int(t_idx)].update(index.bucket_rows(int(bid)))
+    exp_counts = [1] * B  # each topic embeds exactly one d
+    assert [len(g) for g in got] == exp_counts, "on-device exactness FAILED"
+    log(f"#2 on-device exactness vs oracle: ok ({tot} candidates, {B} topics)")
+
+    # --- native baseline (the reference algorithm in C++)
+    ts = NB.NativeTrieSearch()
+    t0 = time.time()
+    ts.add_batch(filters, range(N))
+    log(f"#2 native baseline built in {time.time() - t0:.1f}s")
+    nb_topics = [
+        f"t{d % 997}/r{d % 13}/d{d}/x9/m/temp"
+        for d in rng.integers(0, N, size=4096)
+    ]
+    packed = ts.pack(nb_topics)
+    t0 = time.time()
+    nb_total, _, lats = ts.match_batch(packed, want_latencies=True)
+    nb_dt = time.time() - t0
+    nb_rate = len(nb_topics) / nb_dt
+    log(f"#2 native skip-scan: {nb_dt / len(nb_topics) * 1e6:.2f} us/topic "
+        f"({nb_rate:,.0f} topics/s; {nb_total} matches) "
+        f"p50={pctl(lats, 50) / 1e3:.1f}us p99={pctl(lats, 99) / 1e3:.1f}us")
+
+    details["config2_1M_wildcard"] = {
+        "tpu_topics_per_sec": round(rate, 1),
+        "tpu_ms_per_batch_p50": round(pctl(per_batch, 50) * 1e3, 4),
+        "tpu_ms_per_batch_p99": round(pctl(per_batch, 99) * 1e3, 4),
+        "batch": B,
+        "subs": N,
+        "native_topics_per_sec": round(nb_rate, 1),
+        "native_us_per_topic_p50": round(pctl(lats, 50) / 1e3, 2),
+        "native_us_per_topic_p99": round(pctl(lats, 99) / 1e3, 2),
+        "native_index_ram_mb": round(ts.ram_bytes() / 1e6, 1),
+        "device_ram_mb": round(
+            (slots.fp.nbytes + slots.bucket.nbytes + sum(a.nbytes for a in meta))
+            / 1e6,
+            1,
+        ),
+        "exactness_check": "ok",
+    }
+    ts.close()
+    return rate, nb_rate, table, index, meta, slots, filters
+
+
+# --------------------------------------------------------------------------
+# config #1 — exact-topic path (host hash, no device)
+
+
+def bench_exact(details):
+    from emqx_tpu.models.router import Router
+    from emqx_tpu.ops import native_baseline as NB
+
+    N, B = 10_000, 1024
+    r = Router(max_levels=8)
+    topics = [f"site/{i}/up" for i in range(N)]
+    for i, t in enumerate(topics):
+        r.add_route(t, f"s{i}")
+    rng = np.random.default_rng(3)
+    probe = [topics[i] for i in rng.integers(0, N, size=B)]
+    t0 = time.time()
+    hits = sum(len(r.match_routes(t)) for t in probe)
+    dt = time.time() - t0
+    rate = B / dt
+
+    ts = NB.NativeTrieSearch()
+    ts.add_batch(topics, range(N))
+    packed = ts.pack(probe)
+    t0 = time.time()
+    nb_hits, _, lats = ts.match_batch(packed, want_latencies=True)
+    nb_rate = B / (time.time() - t0)
+    assert hits == nb_hits == B
+    log(f"#1 exact 10K: host hash {rate:,.0f} topics/s, "
+        f"native ordered-set {nb_rate:,.0f} topics/s")
+    details["config1_exact_10K"] = {
+        "host_topics_per_sec": round(rate, 1),
+        "native_topics_per_sec": round(nb_rate, 1),
+        "native_us_per_topic_p99": round(pctl(lats, 99) / 1e3, 2),
+    }
+    ts.close()
+
+
+# --------------------------------------------------------------------------
+# config #3 — 10M mixed filters (vectorized table construction)
+
+
+def bench_10m(jax, jnp, floor, details):
+    from emqx_tpu.ops import hash_index as H
+    from emqx_tpu.ops import native_baseline as NB
+    from emqx_tpu.ops.hash_index import match_ids_hash
+
+    L, B, K = 8, 1024, 16
+    N = 10_000_000 // SHRINK
+    C = 8  # pow2-packed active classes (kernel work scales with C)
+    t0 = time.time()
+    rng = np.random.default_rng(11)
+
+    # Skeletons over a 6-level IoT tree: site/f/line/dev/chan/metric.
+    # '+' at one varying position; half the skeletons end in '#'.
+    skels = [  # (plus_mask, plen, has_hash)
+        (0b001000, 6, False),  # site/f/line/+/chan/metric
+        (0b000100, 6, False),  # site/f/+/dev/chan/metric
+        (0b001000, 6, True),
+        (0b010000, 6, True),
+        (0b000010, 5, True),   # site/+/line/dev/#
+        (0b100000, 6, False),  # site/f/line/dev/chan/+  (plus at tail)
+        (0, 4, True),          # site/f/line/dev/#
+        (0b000100, 6, True),
+    ]
+    skel_of = rng.integers(0, len(skels), size=N)
+
+    # Word ids derive from the row index by a fixed uint32 formula so
+    # host (slots build, baseline strings) and device (topic gen) agree
+    # without shipping an [N, L] tensor through the relay.
+    # level: (base, cardinality); dev level (i=3) is the row id itself.
+    LVL_BASE = np.uint32([10, 1_000, 10_000, 100_000, 20_000_000, 30_000_000])
+    LVL_CARD = np.uint32([100, 100, 1000, 0, 50, 10])
+
+    def lvl_word(rows, i, xp=np):
+        """Word id at level i for filter row(s) `rows` (np or jnp)."""
+        r = rows.astype(xp.uint32)
+        if i == 3:
+            return (LVL_BASE[3] + r).astype(xp.int32)
+        h = (r * xp.uint32(2654435761 + 2 * i + 1)) ^ xp.uint32(
+            0x9E3779B9 * (i + 1) & 0xFFFFFFFF
+        )
+        h = (h >> xp.uint32(7)) % LVL_CARD[i]
+        return (LVL_BASE[i] + h).astype(xp.int32)
+
+    lvl = np.zeros((N, 6), np.int32)
+    rows_all = np.arange(N)
+    with np.errstate(over="ignore"):
+        for i in range(6):
+            lvl[:, i] = lvl_word(rows_all, i)
+
+    meta_np = H.ClassMeta(
+        np.zeros(C, np.int32),
+        np.zeros(C, bool),
+        np.zeros(C, bool),
+        np.zeros(C, np.uint32),
+        np.zeros(C, bool),
+    )
+    for cid, (pm, plen, hh) in enumerate(skels):
+        meta_np.plen[cid] = plen
+        meta_np.has_hash[cid] = hh
+        meta_np.plus[cid] = pm
+        meta_np.active[cid] = True
+
+    # vectorized mirror of hash_index._hash_host
+    cidv = skel_of.astype(np.uint32)
+    plen_v = meta_np.plen[skel_of]
+    plus_v = meta_np.plus[skel_of]
+    with np.errstate(over="ignore"):
+        h1 = np.uint32(H._H1_SEED) ^ (cidv * np.uint32(H._H1_CLS))
+        fp = np.uint32(H._FP_SEED) + (cidv * np.uint32(H._FP_CLS))
+        for i in range(L):
+            if i < 6:
+                lit = (i < plen_v) & (((plus_v >> np.uint32(i)) & 1) == 0)
+                x = np.where(lit, (lvl[:, i] + 1).astype(np.uint32), np.uint32(0))
+            else:
+                x = np.uint32(0)  # beyond the 6-level tree: pad like _hash_host
+            h1 = (h1 ^ x) * np.uint32(H._H1_MUL)
+            fp = (fp ^ (x * np.uint32(H._FP_XOR))) * np.uint32(H._FP_MUL)
+
+    n_slots = max(1024, (1 << 25) // SHRINK)  # 33.5M slots, ~30% load
+    while True:  # grow-and-rehash on probe-chain overflow, like _rebuild
+        slot_fp = np.zeros(n_slots, np.uint32)
+        slot_bkt = np.full(n_slots, -1, np.int32)
+        mask = np.uint32(n_slots - 1)
+        pending = np.arange(N)
+        for p in range(H.MAX_PROBES):
+            if len(pending) == 0:
+                break
+            with np.errstate(over="ignore"):
+                idx = (h1[pending] + np.uint32(p)) & mask
+            empty = slot_bkt[idx] == -1
+            # first claimant per slot wins this round
+            order = np.argsort(idx, kind="stable")
+            sidx = idx[order]
+            first = np.ones(len(sidx), bool)
+            first[1:] = sidx[1:] != sidx[:-1]
+            win = np.zeros(len(pending), bool)
+            win[order] = first
+            win &= empty
+            rows = pending[win]
+            slot_fp[idx[win]] = fp[rows]
+            slot_bkt[idx[win]] = rows
+            pending = pending[~win]
+        if len(pending) == 0:
+            break
+        n_slots *= 2
+        log(f"#3 {len(pending)} rows overflowed 8-probe chains; "
+            f"rehashing into {n_slots} slots")
+    slots_np = H.SlotArrays(slot_fp, slot_bkt)
+    log(f"#3 built 10M-row hash table in {time.time() - t0:.1f}s "
+        f"(slots={n_slots}, load={N / n_slots:.2f})")
+
+    meta = H.ClassMeta(*(jnp.asarray(a) for a in meta_np))
+    slots = H.SlotArrays(*(jnp.asarray(a) for a in slots_np))
+    # small per-row aux (skeleton id per row would be 10MB; instead ship
+    # the per-class plen/plus/has_hash and the row->skeleton array once)
+    skel_dev = jnp.asarray(skel_of.astype(np.int8))
+    plen_c = jnp.asarray(meta_np.plen)
+    plus_c = jnp.asarray(meta_np.plus)
+    hash_c = jnp.asarray(meta_np.has_hash)
+
+    def gen_topics(key, aux):
+        # topics generated FROM rows: each matches exactly its row
+        skel_d, plen_d, plus_d, hash_d = aux
+        k1, k2 = jax.random.split(key)
+        rows = jax.random.randint(k1, (K, B), 0, N)
+        junk = jax.random.randint(k2, (K, B), 40_000_000, 41_000_000)
+        sk = skel_d[rows].astype(jnp.int32)
+        plus_r = plus_d[sk]
+        ids = jnp.zeros((K, B, L), jnp.int32)
+        for i in range(6):
+            w = lvl_word(rows, i, jnp)
+            is_plus = ((plus_r >> jnp.uint32(i)) & 1) == 1
+            ids = ids.at[..., i].set(jnp.where(is_plus, junk + i, w))
+        lens = jnp.where(hash_d[sk], 6, plen_d[sk]).astype(jnp.int32)
+        return ids, lens, jnp.zeros((K, B), bool)
+
+    many = make_scan_bench(jax, jnp, match_ids_hash, 8192, gen_topics, K)
+    per_batch, total = time_dispatches(
+        many,
+        (meta, slots, (skel_dev, plen_c, plus_c, hash_c)),
+        floor,
+        K,
+        n_dispatches=5,
+    )
+    med = float(np.median(per_batch))
+    rate = B / med
+    n_topics = len(per_batch) * K * B
+    log(f"#3 TPU hash kernel @10M: {med * 1e3:.3f} ms/batch "
+        f"({rate:,.0f} topics/s; {total} matches / {n_topics} topics)")
+    # every topic was generated from a row → ≥1 candidate each; hash
+    # false positives could only add. A deficit means wrong matching.
+    assert total >= n_topics, f"10M config lost matches: {total}/{n_topics}"
+
+    # native baseline on the same shape (2M subset — the skip-scan is
+    # O(matches×levels), table size only adds log factors, and 10M C++
+    # string keys would dominate build time, not lookup honesty)
+    NB_N = 2_000_000 // SHRINK
+    ts = NB.NativeTrieSearch()
+    t0 = time.time()
+    CH = 200_000
+    for lo in range(0, NB_N, CH):
+        hi = min(lo + CH, NB_N)
+        fs = []
+        for r in range(lo, hi):
+            pm, plen, hh = skels[skel_of[r]]
+            ws = [str(lvl[r, i]) if not (pm >> i) & 1 else "+" for i in range(plen)]
+            if hh:
+                ws.append("#")
+            fs.append("/".join(ws))
+        ts.add_batch(fs, range(lo, hi))
+    log(f"#3 native baseline (2M rows) built in {time.time() - t0:.1f}s")
+    rows = rng.integers(0, NB_N, size=2048)
+    nb_topics = []
+    for r in rows:
+        pm, plen, hh = skels[skel_of[r]]
+        ws = [
+            str(lvl[r, i]) if not (pm >> i) & 1 else str(40_000_000 + r)
+            for i in range(6 if hh else plen)
+        ]
+        nb_topics.append("/".join(ws))
+    packed = ts.pack(nb_topics)
+    t0 = time.time()
+    nb_total, _, lats = ts.match_batch(packed, want_latencies=True)
+    nb_rate = len(nb_topics) / (time.time() - t0)
+    log(f"#3 native skip-scan: {nb_rate:,.0f} topics/s "
+        f"(p99={pctl(lats, 99) / 1e3:.1f}us; {nb_total} matches)")
+    details["config3_10M_mixed"] = {
+        "tpu_topics_per_sec": round(rate, 1),
+        "tpu_ms_per_batch_p50": round(pctl(per_batch, 50) * 1e3, 4),
+        "tpu_ms_per_batch_p99": round(pctl(per_batch, 99) * 1e3, 4),
+        "subs": N,
+        "native_topics_per_sec": round(nb_rate, 1),
+        "native_subs": NB_N,
+        "native_us_per_topic_p99": round(pctl(lats, 99) / 1e3, 2),
+        "device_ram_mb": round((slot_fp.nbytes + slot_bkt.nbytes) / 1e6, 1),
+    }
+    ts.close()
+
+
+# --------------------------------------------------------------------------
+# config #4 — shared groups over the 1M table
+
+
+def bench_shared(jax, jnp, floor, details, state):
+    from emqx_tpu.ops.hash_index import match_ids_hash
+    from emqx_tpu.ops.match import EncodedTopics
+
+    table, index, meta, slots = state
+    L, B, K, N = 8, 1024, 16, (1 << 20) // SHRINK
+    G = 1024  # shared groups; bucket -> group = bucket % G
+    members = jnp.asarray(
+        np.random.default_rng(5).integers(2, 10, size=G, dtype=np.int32)
+    )
+    lk = table.vocab.lookup
+    t_map = jnp.asarray(np.array([lk(f"t{j}") for j in range(997)], np.int32))
+    r_map = jnp.asarray(np.array([lk(f"r{j}") for j in range(13)], np.int32))
+    d_map = jnp.asarray(np.array([lk(f"d{j}") for j in range(N)], np.int32))
+    m_id = int(lk("m"))
+
+    @jax.jit
+    def many(meta, slots, tmap, rmap, dmap, mem, seed):
+        k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+        d = jax.random.randint(k1, (K, B), 0, N)
+        junk = jax.random.randint(k2, (K, B), 1 << 28, 1 << 29)
+        ids = jnp.zeros((K, B, L), jnp.int32)
+        ids = ids.at[..., 0].set(tmap[d % 997])
+        ids = ids.at[..., 1].set(rmap[d % 13])
+        ids = ids.at[..., 2].set(dmap[d])
+        ids = ids.at[..., 3].set(junk)
+        ids = ids.at[..., 4].set(m_id)
+        ids = ids.at[..., 5].set(junk ^ 7)
+
+        def one(carry, xs):
+            enc = EncodedTopics(
+                xs[0], jnp.full((B,), 6, jnp.int32), jnp.zeros((B,), bool)
+            )
+            ti, bi, total = match_ids_hash(meta, slots, enc, max_hits=4096)
+            # group-hash member pick ON DEVICE (hash_clientid strategy:
+            # the TPU-native fanout design — segment ops, not host loops)
+            grp = jnp.where(bi >= 0, bi % G, 0)
+            pick = (ti * jnp.int32(2654435761 & 0x7FFFFFFF) + grp) % mem[grp]
+            chk = jnp.where(ti >= 0, pick, 0).sum(dtype=jnp.int32)
+            return (carry[0] + total, carry[1] + chk), None
+
+        (s, c), _ = jax.lax.scan(
+            one, (jnp.int32(0), jnp.int32(0)), (ids,)
+        )
+        return s, c
+
+    args = (meta, slots, t_map, r_map, d_map, members)
+    _ = int(many(*args, 999_001)[0])
+    times, total = [], 0
+    for i in range(5):
+        t0 = time.time()
+        s, _c = many(*args, i + 50)
+        total += int(s)
+        times.append((time.time() - t0 - floor) / K)
+    med = float(np.median(times))
+    rate = B / med
+    log(f"#4 shared-group match+device pick: {med * 1e3:.3f} ms/batch "
+        f"({rate:,.0f} topics/s; {total} picks)")
+
+    # end-to-end single-dispatch latency incl. pair transfer to host
+    # (what a cut-through shared-sub delivery would pay)
+    lk2 = table.vocab.lookup
+    rng = np.random.default_rng(13)
+    e2e = []
+    for trial in range(4):
+        ds = rng.integers(0, N, size=B)
+        ids = np.zeros((B, L), np.int32)
+        for j, d in enumerate(ds):
+            for i, w in enumerate(
+                (f"t{d % 997}", f"r{d % 13}", f"d{d}", "x9", "m", "temp")
+            ):
+                ids[j, i] = lk2(w)
+        enc = EncodedTopics(
+            jnp.asarray(ids),
+            jnp.asarray(np.full(B, 6, np.int32)),
+            jnp.asarray(np.zeros(B, bool)),
+        )
+        t0 = time.time()
+        ti, bi, tot = match_ids_hash(meta, slots, enc, max_hits=4096)
+        _ = np.asarray(ti), np.asarray(bi), int(tot)
+        if trial:  # first trial pays compile
+            e2e.append(time.time() - t0 - floor)
+    log(f"#4 end-to-end dispatch+pair-fetch: {np.median(e2e) * 1e3:.1f} ms "
+        f"(relay RTT floor {floor * 1e3:.0f} ms subtracted)")
+    details["config4_shared_groups"] = {
+        "tpu_topics_per_sec": round(rate, 1),
+        "groups": G,
+        "e2e_batch_ms_incl_transfer": round(float(np.median(e2e)) * 1e3, 2),
+        "note": "match kernel + on-device group-hash pick, scan-of-16 "
+        "timing; e2e row adds device->host pair transfer",
+    }
+
+
+# --------------------------------------------------------------------------
+# config #5 — rule-engine FROM filters
+
+
+def bench_rules(jax, jnp, floor, details):
+    from emqx_tpu.ops import hash_index as H
+    from emqx_tpu.ops.hash_index import ClassIndex, match_ids_hash
+    from emqx_tpu.ops.table import FilterTable
+
+    L, B, K, NR = 8, 1024, 16, 10_000
+    table = FilterTable(max_levels=L, capacity=1 << 14)
+    index = ClassIndex(L, min_slots=1 << 16)
+    for i in range(NR):
+        f = f"evt/{i % 100}/dev{i}/+/#"
+        index.add_row(table.add(f), table)
+    meta = H.ClassMeta(*(jnp.asarray(a) for a in index.packed_meta()))
+    slots = H.SlotArrays(*(jnp.asarray(np.array(a)) for a in index.slots))
+    lk = table.vocab.lookup
+    evt_id = int(lk("evt"))
+    n_map = jnp.asarray(np.array([lk(f"{j}") for j in range(100)], np.int32))
+    dev_map = jnp.asarray(
+        np.array([lk(f"dev{j}") for j in range(NR)], np.int32)
     )
 
-    # host-trie baseline on the same workload
-    hostN = 2000
-    dd = rng.integers(0, N, size=hostN)
-    host_topics = [
-        (f"t{d % 997}", f"r{d % 13}", f"d{d}", "x9", "m", "temp") for d in dd
-    ]
-    t0 = time.time()
-    hits = 0
-    for tw in host_topics:
-        hits += len(trie.match(tw))
-    host_dt = (time.time() - t0) / hostN
-    host_rate = 1.0 / host_dt
-    log(
-        f"host trie: {host_dt * 1e6:.1f} us/topic ({host_rate:,.0f} topics/s; "
-        f"{hits} matches on {hostN})"
+    def gen_topics(key, aux):
+        nmap, dmap = aux
+        k1, k2 = jax.random.split(key)
+        d = jax.random.randint(k1, (K, B), 0, NR)
+        junk = jax.random.randint(k2, (K, B), 1 << 28, 1 << 29)
+        ids = jnp.zeros((K, B, L), jnp.int32)
+        ids = ids.at[..., 0].set(evt_id)
+        ids = ids.at[..., 1].set(nmap[d % 100])
+        ids = ids.at[..., 2].set(dmap[d])
+        ids = ids.at[..., 3].set(junk)
+        ids = ids.at[..., 4].set(junk ^ 3)
+        return ids, jnp.full((K, B), 5, jnp.int32), jnp.zeros((K, B), bool)
+
+    many = make_scan_bench(jax, jnp, match_ids_hash, 4096, gen_topics, K)
+    per_batch, total = time_dispatches(
+        many, (meta, slots, (n_map, dev_map)), floor, K, n_dispatches=4
     )
+    med = float(np.median(per_batch))
+    log(f"#5 rule filters (10K): {med * 1e3:.3f} ms/batch "
+        f"({B / med:,.0f} topics/s; {total} rule hits)")
+    details["config5_rule_filters"] = {
+        "tpu_topics_per_sec": round(B / med, 1),
+        "rules": NR,
+    }
+
+
+# --------------------------------------------------------------------------
+# insert RPS — route churn through the full Router incl. device sync
+
+
+def bench_insert(details):
+    from emqx_tpu.models.router import Router
+
+    r = Router(max_levels=8)
+    NI = 50_000 // SHRINK
+    # two identical rounds: round 1 pays the one-time XLA compile of the
+    # delta-scatter kernels; round 2 is the steady-state number
+    for round_ in range(2):
+        t0 = time.time()
+        for i in range(NI):
+            r.add_route(f"ins/{i % 317}/d{i}/+/#", f"node{i % 7}")
+        r.device_table.sync()
+        add_dt = time.time() - t0
+        t0 = time.time()
+        for i in range(NI):
+            r.delete_route(f"ins/{i % 317}/d{i}/+/#", f"node{i % 7}")
+        r.device_table.sync()
+        del_dt = time.time() - t0
+    log(f"insert RPS: {NI / add_dt:,.0f} adds/s, {NI / del_dt:,.0f} deletes/s "
+        f"(incl. class index + device delta-scatter sync)")
+    details["route_churn"] = {
+        "insert_rps": round(NI / add_dt, 1),
+        "delete_rps": round(NI / del_dt, 1),
+        "n": NI,
+    }
+
+
+# --------------------------------------------------------------------------
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    details = {}
+    log(f"devices: {jax.devices()}")
+    floor = rtt_floor(jax, jnp)
+    log(f"dispatch RTT floor: {floor * 1e3:.1f} ms")
+    details["dispatch_rtt_floor_ms"] = round(floor * 1e3, 1)
+
+    rate, nb_rate, table, index, meta, slots, _filters = bench_1m(
+        jax, jnp, floor, details
+    )
+    bench_exact(details)
+    bench_shared(jax, jnp, floor, details, (table, index, meta, slots))
+    bench_rules(jax, jnp, floor, details)
+    bench_insert(details)
+    del table, index, meta, slots
+    bench_10m(jax, jnp, floor, details)
+
+    with open("BENCH_DETAILS.json", "w") as f:
+        json.dump(details, f, indent=1)
+    log(json.dumps(details, indent=1))
 
     print(
         json.dumps(
             {
                 "metric": "wildcard_topic_matches_per_sec_1M_subs",
-                "value": round(tpu_rate, 1),
+                "value": round(rate, 1),
                 "unit": "topics/s",
-                "vs_baseline": round(tpu_rate / host_rate, 2),
+                "vs_baseline": round(rate / nb_rate, 2),
             }
         )
     )

@@ -16,8 +16,9 @@ from typing import Dict, List, Optional
 
 from . import frame
 from .channel import Channel, ProtocolError
+from .limiter import ListenerLimits, LoadShedder
 from .message import Message
-from .packet import Disconnect, MQTT_V5
+from .packet import Disconnect, MQTT_V5, Publish, RC
 from .pubsub import Broker
 
 log = logging.getLogger("emqx_tpu.server")
@@ -66,6 +67,10 @@ class Connection:
             peer = f"{peer[0]}:{peer[1]}"
         self.channel = Channel(server.broker, peer=str(peer))
         self.parser = frame.Parser(max_packet_size=server.max_packet_size)
+        # per-connection limiter chains (client tier -> listener tier ->
+        # node tier; the ?LIMITER_ROUTING check of emqx_channel.erl:751)
+        self.pub_limiter = server.limits.publish_limiter()
+        self.byte_limiter = server.limits.bytes_limiter()
 
     def _wire_sink(self) -> None:
         sess = self.channel.session
@@ -108,6 +113,24 @@ class Connection:
                         self._send_packets([Disconnect(e.code)])
                     break
                 for pkt in pkts:
+                    if isinstance(pkt, Publish):
+                        # backpressure: pausing here stops reading the
+                        # socket, which pushes back on the publisher's
+                        # TCP window (the reference hibernates the
+                        # connection process the same way)
+                        ok = await self.pub_limiter.acquire(1.0)
+                        ok = ok and await self.byte_limiter.acquire(
+                            float(len(pkt.payload))
+                        )
+                        if not ok:
+                            self.server.broker.metrics.inc(
+                                "messages.dropped.quota_exceeded"
+                            )
+                            if self.channel.proto_ver == MQTT_V5:
+                                self._send_packets(
+                                    [Disconnect(RC.QUOTA_EXCEEDED)]
+                                )
+                            return
                     try:
                         out = self.channel.handle_packet(pkt)
                     except ProtocolError as e:
@@ -148,12 +171,16 @@ class Server:
         port: int = 1883,
         max_packet_size: int = frame.DEFAULT_MAX_PACKET_SIZE,
         connect_timeout: float = 10.0,
+        limits: Optional[ListenerLimits] = None,
+        shedder: Optional[LoadShedder] = None,
     ):
         self.broker = broker or Broker()
         self.host = host
         self.port = port
         self.max_packet_size = max_packet_size
         self.connect_timeout = connect_timeout
+        self.limits = limits or ListenerLimits()
+        self.shedder = shedder
         self._server: Optional[asyncio.AbstractServer] = None
         self._conns: set = set()
         self.listen_addr = None
@@ -167,9 +194,21 @@ class Server:
         # live-listener registry: the mgmt listeners view walks this
         if self not in self.broker.servers:
             self.broker.servers.append(self)
+        if self.shedder is not None:
+            self.shedder.start()
         log.info("listening on %s", addr)
 
     async def _on_client(self, reader, writer) -> None:
+        # accept gates: OLP shed (emqx_olp new-conn backoff) first,
+        # then the listener's connection-rate bucket (max_conn_rate)
+        if (self.shedder is not None and self.shedder.overloaded) or (
+            not self.limits.accept_allowed()
+        ):
+            if self.shedder is not None and self.shedder.overloaded:
+                self.shedder.shed_count += 1
+            self.broker.metrics.inc("olp.new_conn_shed")
+            writer.close()
+            return
         conn = Connection(self, reader, writer)
         self._conns.add(conn)
         try:
@@ -180,6 +219,8 @@ class Server:
     async def stop(self) -> None:
         if self in self.broker.servers:
             self.broker.servers.remove(self)
+        if self.shedder is not None:
+            self.shedder.stop()
         if self._server is not None:
             self._server.close()
             # kick live connections so wait_closed() cannot hang on them

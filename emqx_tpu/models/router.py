@@ -130,7 +130,12 @@ class DeviceTable:
         ix = self.index
         assert ix is not None
         if ix.meta_dirty or self._dev_meta is None:
-            self._dev_meta = ClassMeta(*(self._put(np.array(a)) for a in ix.meta))
+            # upload only the pow2-packed active-class prefix: kernel
+            # work is B x C x probes, so C must track the live class
+            # count, not the budget (see ClassIndex.active_hi)
+            self._dev_meta = ClassMeta(
+                *(self._put(np.array(a)) for a in ix.packed_meta())
+            )
             ix.meta_dirty = False
         if ix.rebuilt or self._dev_slots is None:
             ix.dirty_slots.clear()

@@ -1,0 +1,164 @@
+"""ctypes wrapper over native/libtriesearch.so — the C++ rendition of
+the reference's ordered-set skip-scan match (apps/emqx/src/
+emqx_trie_search.erl:192-348) used as the honest CPU baseline in
+bench.py, and as a fast pairwise oracle for hash-kernel candidate
+verification.
+
+Build: `make -C native` (bench.py triggers this automatically).
+Falls back to None when no C++ toolchain is available; callers must
+gate on `load()`.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_NATIVE_DIR = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..", "native")
+)
+_LIB_PATHS = [
+    os.path.join(_NATIVE_DIR, "libtriesearch.so"),
+    os.path.join(os.path.dirname(__file__), "libtriesearch.so"),
+]
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def load(build: bool = True) -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if build:
+        # always invoke make: it's an mtime-based no-op when the .so is
+        # fresh, and it rebuilds a stale committed binary after .cc
+        # edits; failure (no toolchain) falls back to any existing .so
+        try:
+            subprocess.run(
+                ["make", "-C", _NATIVE_DIR, "libtriesearch.so"],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+        except Exception:
+            pass
+    for p in _LIB_PATHS:
+        if not os.path.exists(p):
+            continue
+        try:
+            lib = ctypes.CDLL(p)
+        except OSError:
+            # incompatible/corrupt committed binary on this platform
+            continue
+        lib.ts_new.restype = ctypes.c_void_p
+        lib.ts_free.argtypes = [ctypes.c_void_p]
+        lib.ts_add.restype = ctypes.c_int
+        lib.ts_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong]
+        lib.ts_del.restype = ctypes.c_int
+        lib.ts_del.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong]
+        lib.ts_add_batch.restype = ctypes.c_longlong
+        lib.ts_add_batch.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+            ctypes.c_longlong,
+        ]
+        lib.ts_size.restype = ctypes.c_longlong
+        lib.ts_size.argtypes = [ctypes.c_void_p]
+        lib.ts_ram.restype = ctypes.c_longlong
+        lib.ts_ram.argtypes = [ctypes.c_void_p]
+        lib.ts_match_batch.restype = ctypes.c_longlong
+        lib.ts_match_batch.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+            ctypes.c_longlong,
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+        ]
+        lib.ts_pair_match.restype = ctypes.c_int
+        lib.ts_pair_match.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+        _lib = lib
+        return _lib
+    return None
+
+
+class NativeTrieSearch:
+    """The reference skip-scan over a C++ red-black tree."""
+
+    def __init__(self) -> None:
+        self._h = None
+        lib = load()
+        if lib is None:
+            raise RuntimeError("libtriesearch.so unavailable (no toolchain?)")
+        self._lib = lib
+        self._h = lib.ts_new()
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.ts_free(self._h)
+            self._h = None
+
+    __del__ = close
+
+    def add(self, flt: str, rid: int) -> bool:
+        return bool(self._lib.ts_add(self._h, flt.encode(), rid))
+
+    def delete(self, flt: str, rid: int) -> bool:
+        return bool(self._lib.ts_del(self._h, flt.encode(), rid))
+
+    def add_batch(self, filters: Sequence[str], ids: Sequence[int]) -> int:
+        buf, offs = self.pack(filters)
+        ida = np.asarray(ids, np.int64)
+        return int(self._lib.ts_add_batch(self._h, buf, offs, ida, len(ida)))
+
+    def __len__(self) -> int:
+        return int(self._lib.ts_size(self._h))
+
+    def ram_bytes(self) -> int:
+        return int(self._lib.ts_ram(self._h))
+
+    @staticmethod
+    def pack(topics: Sequence[str]) -> Tuple[bytes, np.ndarray]:
+        """Pre-encode a topic batch for match_batch (excluded from the
+        timed region, like the TPU path's host-side encode)."""
+        bufs = [t.encode() for t in topics]
+        offs = np.zeros(len(bufs) + 1, np.int64)
+        np.cumsum([len(b) for b in bufs], out=offs[1:])
+        return b"".join(bufs), offs
+
+    def match_batch(
+        self,
+        packed: Tuple[bytes, np.ndarray],
+        want_counts: bool = False,
+        want_latencies: bool = False,
+    ):
+        """Match a packed batch; returns (total, counts|None, lat_ns|None)."""
+        buf, offs = packed
+        n = len(offs) - 1
+        counts = np.zeros(n, np.int64) if want_counts else None
+        lats = np.zeros(n, np.int64) if want_latencies else None
+        total = self._lib.ts_match_batch(
+            self._h,
+            buf,
+            offs,
+            n,
+            counts.ctypes.data if counts is not None else None,
+            lats.ctypes.data if lats is not None else None,
+        )
+        return int(total), counts, lats
+
+
+def pair_match(topic: str, flt: str) -> bool:
+    """Single (topic, filter) match via the native oracle (no $-rule —
+    callers on the router path apply it before the call)."""
+    lib = load()
+    assert lib is not None
+    return bool(lib.ts_pair_match(topic.encode(), flt.encode()))

@@ -1,0 +1,304 @@
+// Native CPU baseline for the wildcard match benchmark: a faithful C++
+// implementation of the reference broker's ordered-set skip-scan match
+// (the v2 routing algorithm described in
+// /root/reference/apps/emqx/src/emqx_trie_search.erl:30-97, search loop
+// :192-348), over a std::set red-black tree standing in for the ets
+// ordered_set table.  This is the algorithm the TPU kernel replaces; a
+// C++ rendition is *faster* than the BEAM original (no term boxing, no
+// ets message overhead), so benchmarking the TPU path against this is a
+// conservative, defensible denominator (VERDICT.md weak #2).
+//
+// Key ordering mirrors Erlang term order for the key shapes involved:
+//   * filter keys {Words :: [word()], {ID}} sort before exact-topic
+//     keys {Topic :: binary(), {ID}}           (lists < binaries)
+//   * words: '#' < '+' < any literal           (atoms < binaries,
+//     atom text order '#' 0x23 < '+' 0x2B)
+//   * base keys {Prefix, {}} sort before data keys with the same
+//     prefix ({} < {ID} by tuple size).
+// std::set::upper_bound(base) is the ets:next analog.
+//
+// Exposed C ABI (ctypes):
+//   ts_new / ts_free
+//   ts_add(filter, id)    - insert a filter or exact topic key
+//   ts_del(filter, id)
+//   ts_match_batch(buf, offsets, n, out_counts, out_lat_ns) -> total
+//   ts_ram() -> approximate resident bytes of the index
+//   ts_pair_match(topic, filter) -> 0/1   (single-pair oracle)
+
+#include <chrono>
+#include <cstdint>
+#include <cstring>
+#include <set>
+#include <string>
+#include <vector>
+
+namespace {
+
+enum WordKind : uint8_t { W_HASH = 0, W_PLUS = 1, W_LIT = 2 };
+
+struct Word {
+  uint8_t kind;
+  std::string lit;  // valid when kind == W_LIT
+
+  bool operator<(const Word &o) const {
+    if (kind != o.kind) return kind < o.kind;
+    return kind == W_LIT && lit < o.lit;
+  }
+  bool operator==(const Word &o) const {
+    return kind == o.kind && (kind != W_LIT || lit == o.lit);
+  }
+};
+
+// id < 0 encodes the base key {Prefix, {}} (sorts before any data id).
+struct Key {
+  bool exact;               // false: filter words; true: exact topic
+  std::vector<Word> words;  // filter form
+  std::string topic;        // exact form
+  int64_t id;
+
+  bool operator<(const Key &o) const {
+    if (exact != o.exact) return !exact;  // lists < binaries
+    if (exact) {
+      if (topic != o.topic) return topic < o.topic;
+    } else {
+      if (words != o.words)
+        return std::lexicographical_compare(words.begin(), words.end(),
+                                            o.words.begin(), o.words.end());
+    }
+    return id < o.id;
+  }
+};
+
+std::vector<std::string> tokens(const std::string &t) {
+  std::vector<std::string> out;
+  size_t start = 0;
+  for (size_t i = 0; i <= t.size(); ++i) {
+    if (i == t.size() || t[i] == '/') {
+      out.emplace_back(t, start, i - start);
+      start = i + 1;
+    }
+  }
+  return out;
+}
+
+bool parse_filter(const std::string &f, std::vector<Word> *out) {
+  bool wild = false;
+  for (auto &tok : tokens(f)) {
+    Word w;
+    if (tok == "#") {
+      w.kind = W_HASH;
+      wild = true;
+    } else if (tok == "+") {
+      w.kind = W_PLUS;
+      wild = true;
+    } else {
+      w.kind = W_LIT;
+      w.lit = tok;
+    }
+    out->push_back(std::move(w));
+  }
+  return wild;
+}
+
+struct Index {
+  std::set<Key> keys;
+  size_t payload_bytes = 0;
+
+  static size_t key_bytes(const Key &k) {
+    size_t b = sizeof(Key) + 48;  // RB-node overhead (3 ptr + color, padded)
+    b += k.topic.capacity();
+    b += k.words.capacity() * sizeof(Word);
+    for (auto &w : k.words) b += w.lit.capacity();
+    return b;
+  }
+};
+
+// compare/3 of the reference search (emqx_trie_search.erl:260-348),
+// topic-search clauses only.  Returns one of:
+enum CmpKind : uint8_t { MATCH_FULL, MATCH_PREFIX, LOWER, SEEK };
+struct Cmp {
+  CmpKind kind;
+  int pos;                 // SEEK: words to keep from the filter
+  const std::string *word; // SEEK: topic word to splice in
+};
+
+Cmp compare_fw(const std::vector<Word> &f, size_t fi,
+               const std::vector<std::string> &w, size_t wi, int pos) {
+  if (fi == f.size()) {
+    if (wi == w.size()) return {MATCH_FULL, 0, nullptr};
+    return {MATCH_PREFIX, 0, nullptr};
+  }
+  if (f[fi].kind == W_HASH && fi + 1 == f.size())
+    return {MATCH_FULL, 0, nullptr};
+  if (wi == w.size()) return {LOWER, 0, nullptr};
+  if (f[fi].kind == W_PLUS) {
+    Cmp r = compare_fw(f, fi + 1, w, wi + 1, pos + 1);
+    if (r.kind == LOWER) return {SEEK, pos, &w[wi]};
+    return r;
+  }
+  // literal (or malformed mid-'#', which never enters the table)
+  const std::string &fl = f[fi].lit;
+  if (fl == w[wi]) return compare_fw(f, fi + 1, w, wi + 1, pos + 1);
+  if (fl > w[wi]) return {LOWER, 0, nullptr};
+  return {SEEK, pos, &w[wi]};
+}
+
+// Full search for one topic (emqx_trie_search.erl:192-253 + 381-389).
+int64_t search_one(const Index &ix, const std::string &topic,
+                   std::vector<int64_t> *ids) {
+  std::vector<std::string> w = tokens(topic);
+  int64_t n = 0;
+  Key base;
+  base.exact = false;
+  base.id = INT64_MIN;
+  if (!w.empty() && !w[0].empty() && w[0][0] == '$')
+    base.words.push_back(Word{W_LIT, w[0]});
+  auto it = ix.keys.upper_bound(base);
+  while (it != ix.keys.end() && !it->exact) {
+    Cmp r = compare_fw(it->words, 0, w, 0, 0);
+    switch (r.kind) {
+      case MATCH_FULL:
+        ++n;
+        if (ids) ids->push_back(it->id);
+        ++it;  // ets:next from the matched key
+        break;
+      case MATCH_PREFIX:
+        ++it;
+        break;
+      case LOWER:
+        goto exacts;  // ran into the exact-topic region or out of space
+      case SEEK: {
+        Key nb;
+        nb.exact = false;
+        nb.id = INT64_MIN;
+        nb.words.assign(it->words.begin(), it->words.begin() + r.pos);
+        nb.words.push_back(Word{W_LIT, *r.word});
+        it = ix.keys.upper_bound(nb);
+        break;
+      }
+    }
+  }
+exacts:
+  // match_topics: jump straight to the exact-topic key range
+  {
+    Key tb;
+    tb.exact = true;
+    tb.topic = topic;
+    tb.id = INT64_MIN;
+    for (auto et = ix.keys.upper_bound(tb);
+         et != ix.keys.end() && et->exact && et->topic == topic; ++et) {
+      ++n;
+      if (ids) ids->push_back(et->id);
+    }
+  }
+  return n;
+}
+
+Key make_key(const char *filter, int64_t id) {
+  Key k;
+  k.id = id;
+  std::vector<Word> words;
+  if (parse_filter(filter, &words)) {
+    k.exact = false;
+    k.words = std::move(words);
+  } else {
+    k.exact = true;
+    k.topic = filter;
+  }
+  return k;
+}
+
+}  // namespace
+
+extern "C" {
+
+void *ts_new() { return new Index(); }
+
+void ts_free(void *h) { delete static_cast<Index *>(h); }
+
+int ts_add(void *h, const char *filter, long long id) {
+  auto *ix = static_cast<Index *>(h);
+  auto r = ix->keys.insert(make_key(filter, id));
+  if (r.second) ix->payload_bytes += Index::key_bytes(*r.first);
+  return r.second ? 1 : 0;
+}
+
+int ts_del(void *h, const char *filter, long long id) {
+  auto *ix = static_cast<Index *>(h);
+  auto it = ix->keys.find(make_key(filter, id));
+  if (it == ix->keys.end()) return 0;
+  ix->payload_bytes -= Index::key_bytes(*it);
+  ix->keys.erase(it);
+  return 1;
+}
+
+// Bulk insert: filters packed back-to-back, offsets (n+1), ids[n].
+// Returns number actually inserted (duplicates skipped).
+long long ts_add_batch(void *h, const char *buf, const long long *offs,
+                       const long long *ids, long long n) {
+  auto *ix = static_cast<Index *>(h);
+  long long added = 0;
+  for (long long i = 0; i < n; ++i) {
+    std::string f(buf + offs[i], buf + offs[i + 1]);
+    auto r = ix->keys.insert(make_key(f.c_str(), ids[i]));
+    if (r.second) {
+      ix->payload_bytes += Index::key_bytes(*r.first);
+      ++added;
+    }
+  }
+  return added;
+}
+
+long long ts_size(void *h) {
+  return (long long)static_cast<Index *>(h)->keys.size();
+}
+
+long long ts_ram(void *h) {
+  return (long long)static_cast<Index *>(h)->payload_bytes;
+}
+
+// topics: concatenated NUL-free strings; offsets: n+1 byte offsets.
+// out_counts[i] = matches for topic i (nullable).
+// out_lat_ns[i] = per-topic wall latency in ns (nullable).
+long long ts_match_batch(void *h, const char *buf, const long long *offs,
+                         long long n, long long *out_counts,
+                         long long *out_lat_ns) {
+  auto *ix = static_cast<Index *>(h);
+  long long total = 0;
+  for (long long i = 0; i < n; ++i) {
+    std::string topic(buf + offs[i], buf + offs[i + 1]);
+    long long c;
+    if (out_lat_ns) {
+      auto t0 = std::chrono::steady_clock::now();
+      c = search_one(*ix, topic, nullptr);
+      auto t1 = std::chrono::steady_clock::now();
+      out_lat_ns[i] =
+          std::chrono::duration_cast<std::chrono::nanoseconds>(t1 - t0)
+              .count();
+    } else {
+      c = search_one(*ix, topic, nullptr);
+    }
+    if (out_counts) out_counts[i] = c;
+    total += c;
+  }
+  return total;
+}
+
+// Single topic/filter oracle match (emqx_topic:match/2 semantics),
+// usable as a fast host-side verifier for hash-kernel candidates.
+int ts_pair_match(const char *topic, const char *filter) {
+  std::vector<Word> f;
+  parse_filter(filter, &f);
+  std::vector<std::string> w = tokens(topic);
+  // the $-root rule lives in the caller (router) for pair checks
+  size_t fi = 0, wi = 0;
+  while (true) {
+    if (fi == f.size()) return wi == w.size();
+    if (f[fi].kind == W_HASH) return fi + 1 == f.size();
+    if (wi == w.size()) return 0;
+    if (f[fi].kind == W_LIT && f[fi].lit != w[wi]) return 0;
+    ++fi;
+    ++wi;
+  }
+}
+}

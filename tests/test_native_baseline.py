@@ -1,0 +1,82 @@
+"""The native C++ skip-scan (bench.py's honest CPU baseline,
+native/triesearch.cc) must agree with the pure oracle on exactly the
+same route-table semantics the TPU kernel is tested against — the
+reference property-tests every index against emqx_topic:match/2 the
+same way (SURVEY.md §4)."""
+
+import random
+
+import pytest
+
+from emqx_tpu.ops import topic as T
+from tests.test_match import random_filter, random_topic
+
+native = pytest.importorskip("emqx_tpu.ops.native_baseline")
+
+pytestmark = pytest.mark.skipif(
+    native.load() is None, reason="no C++ toolchain / libtriesearch.so"
+)
+
+
+def oracle_matches(filters, topic):
+    tw = T.words(topic)
+    out = set()
+    for rid, f in filters.items():
+        if T.match(tw, T.words(f)):
+            out.add(rid)
+    return out
+
+
+def test_exact_and_wildcard_mix():
+    ts = native.NativeTrieSearch()
+    filters = {}
+    for i, f in enumerate(
+        ["a/b/c", "a/+/c", "a/#", "#", "+/b/#", "$SYS/#", "a//b", "+", "a/b/c"]
+    ):
+        if ts.add(f, i):
+            filters[i] = f
+    packed = ts.pack(
+        ["a/b/c", "a/x/c", "a", "x", "$SYS/broker", "a//b", "a/b/c/d/e"]
+    )
+    total, counts, _ = ts.match_batch(packed, want_counts=True)
+    topics = ["a/b/c", "a/x/c", "a", "x", "$SYS/broker", "a//b", "a/b/c/d/e"]
+    for i, t in enumerate(topics):
+        exp = oracle_matches(filters, t)
+        assert counts[i] == len(exp), f"{t}: got {counts[i]} want {len(exp)}"
+    assert total == sum(counts)
+
+
+def test_property_vs_oracle():
+    rng = random.Random(1234)
+    for _ in range(6):
+        ts = native.NativeTrieSearch()
+        filters = {}
+        n = rng.randint(1, 400)
+        for i in range(n):
+            f = random_filter(rng)
+            if ts.add(f, i):
+                filters[i] = f
+        # delete a third
+        victims = rng.sample(sorted(filters), len(filters) // 3)
+        for rid in victims:
+            assert ts.delete(filters.pop(rid), rid)
+        topics = [random_topic(rng) for _ in range(128)]
+        packed = ts.pack(topics)
+        _, counts, _ = ts.match_batch(packed, want_counts=True)
+        for i, t in enumerate(topics):
+            exp = oracle_matches(filters, t)
+            assert counts[i] == len(exp), (
+                f"{t!r}: native={counts[i]} oracle={len(exp)} "
+                f"({[f for f in filters.values() if T.match(T.words(t), T.words(f))]})"
+            )
+
+
+def test_pair_match_oracle_parity():
+    rng = random.Random(77)
+    for _ in range(2000):
+        f = random_filter(rng)
+        t = random_topic(rng)
+        # native pair matcher has no $-rule (the router applies it
+        # before the call), so compare against the raw token matcher
+        exp = T._match_tokens(T.words(t), T.words(f))
+        assert native.pair_match(t, f) == exp, (t, f)
