@@ -85,12 +85,15 @@ class Limiter:
 
     def check(self, n: float = 1.0) -> float:
         """0.0 and debit if every tier grants; else the wait in
-        seconds with nothing debited."""
+        seconds with nothing debited.  INF means unsatisfiable: n
+        exceeds some tier's capacity, so no amount of waiting helps."""
         if not self.buckets:
             return 0.0
         now = time.monotonic()
         wait = 0.0
         for b in self.buckets:
+            if n > b.capacity:
+                return INF
             wait = max(wait, b.peek(n, now))
         if wait > 0.0:
             return wait
@@ -100,13 +103,14 @@ class Limiter:
 
     async def acquire(self, n: float = 1.0, max_wait: float = 60.0) -> bool:
         """Await until n tokens are granted (pausing the caller — the
-        socket read loop) or max_wait is exceeded."""
+        socket read loop), or return False immediately for an
+        unsatisfiable request / once max_wait is exceeded."""
         waited = 0.0
         while True:
             w = self.check(n)
             if w == 0.0:
                 return True
-            if waited + w > max_wait:
+            if w == INF or waited + w > max_wait:
                 return False
             await asyncio.sleep(min(w, 1.0))
             waited += min(w, 1.0)
@@ -114,9 +118,10 @@ class Limiter:
 
 class ListenerLimits:
     """Per-listener enforcement state built from the config's limiter
-    section (node tiers are shared across listeners via `node_tier`)."""
-
-    _node_tiers: Dict[int, Dict[str, TokenBucket]] = {}
+    section.  Node-wide tiers are caller-provided shared buckets: the
+    boot layer builds one {"messages_rate": TokenBucket, ...} dict and
+    passes the SAME dict as `node_tier` to every listener's limits so
+    the node quota is consumed jointly."""
 
     def __init__(
         self,
@@ -133,14 +138,18 @@ class ListenerLimits:
         self.node_tier = node_tier or {}
 
     @classmethod
-    def from_config(cls, cfg: dict) -> "ListenerLimits":
-        """cfg = the checked `limiter` section of the broker schema."""
+    def from_config(
+        cls, cfg: dict, node_tier: Optional[Dict[str, TokenBucket]] = None
+    ) -> "ListenerLimits":
+        """cfg = the checked `limiter` section of the broker schema;
+        node_tier = the node-wide shared buckets (one dict per node)."""
         cfg = cfg or {}
         return cls(
             max_conn_rate=cfg.get("max_conn_rate"),
             messages_rate=cfg.get("messages_rate"),
             bytes_rate=cfg.get("bytes_rate"),
             client=cfg.get("client"),
+            node_tier=node_tier,
         )
 
     def accept_allowed(self) -> bool:

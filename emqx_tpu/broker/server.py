@@ -201,12 +201,13 @@ class Server:
     async def _on_client(self, reader, writer) -> None:
         # accept gates: OLP shed (emqx_olp new-conn backoff) first,
         # then the listener's connection-rate bucket (max_conn_rate)
-        if (self.shedder is not None and self.shedder.overloaded) or (
-            not self.limits.accept_allowed()
-        ):
-            if self.shedder is not None and self.shedder.overloaded:
-                self.shedder.shed_count += 1
+        if self.shedder is not None and self.shedder.overloaded:
+            self.shedder.shed_count += 1
             self.broker.metrics.inc("olp.new_conn_shed")
+            writer.close()
+            return
+        if not self.limits.accept_allowed():
+            self.broker.metrics.inc("listener.conn_rate_limited")
             writer.close()
             return
         conn = Connection(self, reader, writer)

@@ -97,7 +97,8 @@ def test_conn_rate_gate(loop_run):
         # CONNECT is even read
         with pytest.raises((ConnectionError, asyncio.TimeoutError)):
             await asyncio.wait_for(c3.connect("c3"), timeout=1.0)
-        assert broker.metrics.val("olp.new_conn_shed") == 1
+        assert broker.metrics.val("listener.conn_rate_limited") == 1
+        assert broker.metrics.val("olp.new_conn_shed") == 0
         await server.stop()
 
     loop_run(main())
