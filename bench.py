@@ -662,6 +662,45 @@ def bench_insert(details):
 
 
 # --------------------------------------------------------------------------
+# wide fanout — 1 topic x 100k subscribers through the full dispatch
+# path (shard plan + per-subscriber serialize sink)
+
+
+def bench_fanout(details):
+    from emqx_tpu.broker import frame
+    from emqx_tpu.broker.message import Message
+    from emqx_tpu.broker.packet import SubOpts
+    from emqx_tpu.broker.pubsub import Broker
+
+    b = Broker()
+    NS = 100_000 // SHRINK
+    nbytes = [0]
+
+    def sink(pkts):
+        for p in pkts:
+            nbytes[0] += len(frame.serialize(p, 4))
+
+    for i in range(NS):
+        s, _ = b.open_session(f"f{i}", True)
+        b.subscribe(s, "fan/wide/#", SubOpts(qos=0))
+        s.outgoing_sink = sink
+    ROUNDS = 5
+    t0 = time.time()
+    total = 0
+    for i in range(ROUNDS):
+        total += b.publish(Message(topic=f"fan/wide/{i}", payload=b"x" * 64))
+    dt = time.time() - t0
+    rate = total / dt
+    log(f"wide fanout: {NS:,} subs x {ROUNDS} msgs -> "
+        f"{rate:,.0f} deliveries/s ({nbytes[0] / dt / 1e6:.0f} MB/s serialized)")
+    details["fanout_100k"] = {
+        "subscribers": NS,
+        "deliveries_per_sec": round(rate, 1),
+        "serialized_mb_per_sec": round(nbytes[0] / dt / 1e6, 1),
+    }
+
+
+# --------------------------------------------------------------------------
 
 
 def main():
@@ -681,6 +720,7 @@ def main():
     bench_shared(jax, jnp, floor, details, (table, index, meta, slots))
     bench_rules(jax, jnp, floor, details)
     bench_insert(details)
+    bench_fanout(details)
     del table, index, meta, slots
     bench_10m(jax, jnp, floor, details)
 

@@ -450,6 +450,23 @@ def _fixed(ptype: int, flags: int, body: bytes) -> bytes:
 
 
 def serialize(pkt: Packet, proto_ver: int = MQTT_V4) -> bytes:
+    # wide-fanout fast path: a packet carrying a `_wire` dict memoizes
+    # its wire form per protocol version, so one shared QoS0 PUBLISH
+    # serializes once and every subscriber's sink writes cached bytes
+    # (the fanout loop of emqx_broker.erl:726-760 pays serialization
+    # per subscriber; we pay it per distinct protocol version)
+    cache = getattr(pkt, "_wire", None)
+    if cache is not None:
+        hit = cache.get(proto_ver)
+        if hit is not None:
+            return hit
+        data = _serialize_uncached(pkt, proto_ver)
+        cache[proto_ver] = data
+        return data
+    return _serialize_uncached(pkt, proto_ver)
+
+
+def _serialize_uncached(pkt: Packet, proto_ver: int = MQTT_V4) -> bytes:
     v5 = proto_ver == MQTT_V5
     if isinstance(pkt, Connect):
         v5c = pkt.proto_ver == MQTT_V5

@@ -20,6 +20,7 @@ Publish offers two paths, exactly the v2 split the survey flags
 
 from __future__ import annotations
 
+import asyncio
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 from ..models.retainer import Retainer
@@ -33,6 +34,14 @@ from .packet import Publish, SubOpts
 from .session import Session
 
 GROUP_DEST = "$group"
+
+# subscribers per dispatch shard (ref: emqx_broker_helper.erl:60 — ≤1024
+# subscribers on one topic dispatch inline, beyond that they shard)
+FANOUT_SHARD = 1024
+
+# route match results flow through dispatch as (filter, dests) pairs;
+# dests is a Dest -> refcount map owned by the Router
+Pairs = Iterable[Tuple[str, Dict]]
 
 
 class Broker:
@@ -219,16 +228,16 @@ class Broker:
         msg = self._pre_publish(msg)
         if msg is None:
             return 0
-        return self._dispatch(msg, self.router.match_routes(msg.topic))
+        return self._dispatch(msg, self.router.match_pairs(msg.topic))
 
     def publish_batch(self, msgs: Sequence[Message]) -> List[int]:
         """The TPU hot path: one batched device dispatch for the whole
         inbound publish batch."""
         live = [self._pre_publish(m) for m in msgs]
         topics = [m.topic for m in live if m is not None]
-        dest_sets = iter(self.router.match_batch(topics))
+        pair_sets = iter(self.router.match_pairs_batch(topics))
         return [
-            self._dispatch(m, next(dest_sets)) if m is not None else 0
+            self._dispatch(m, next(pair_sets)) if m is not None else 0
             for m in live
         ]
 
@@ -243,39 +252,174 @@ class Broker:
             self.retainer.retain(out)
         return out
 
-    def _dispatch(self, msg: Message, dests: Set) -> int:
-        n = 0
-        for dest in dests:
-            if isinstance(dest, tuple) and dest and dest[0] == GROUP_DEST:
-                _tag, group, real = dest
-                member = self.shared.pick(
-                    group, real, msg.topic, from_client=msg.from_client
-                )
-                if member is None:
-                    continue
-                n += self._deliver_to(member, f"$share/{group}/{real}", msg)
-            else:
-                n += self._deliver_to(dest, None, msg)
+    def _dispatch(self, msg: Message, pairs: Pairs) -> int:
+        n = self._dispatch_shared_local(msg, pairs)
+        nd = self._dispatch_direct(msg, pairs)
+        if nd:
+            self.metrics.inc("messages.delivered", nd)
+        self._account_dispatch(msg, n + nd)
+        return n + nd
+
+    def _account_dispatch(self, msg: Message, n: int) -> None:
         if n == 0:
             # a durable-only audience isn't a drop: the persist gate
             # stored the message and the DS pump will deliver it
             if self.durable is None or not self.durable.needs_persist(msg.topic):
                 self.metrics.inc("messages.dropped.no_subscribers")
                 self.hooks.run("message.dropped", msg, "no_subscribers")
-        else:
-            self.metrics.inc("messages.delivered", n)
+
+    def _dispatch_shared_local(self, msg: Message, pairs: Pairs) -> int:
+        n = 0
+        for _flt, dests in pairs:
+            # snapshot: dests is the Router's live refcount dict and the
+            # delivery hooks/sinks below may (un)subscribe mid-iteration
+            for dest in tuple(dests):
+                if isinstance(dest, tuple) and dest and dest[0] == GROUP_DEST:
+                    _tag, group, real = dest
+                    member = self.shared.pick(
+                        group, real, msg.topic, from_client=msg.from_client
+                    )
+                    if member is None:
+                        continue
+                    got = self._deliver_to(member, f"$share/{group}/{real}", msg)
+                    if got:
+                        self.metrics.inc("messages.delivered", got)
+                    n += got
         return n
 
-    def _deliver_to(
-        self, client_id: str, share_filter: Optional[str], msg: Message
+    def _dispatch_direct(self, msg: Message, pairs: Pairs) -> int:
+        """Dedup direct destinations across matched filters (aggre/1,
+        emqx_broker.erl:408-424): one delivery per client, max granted
+        QoS wins. SubOpts come from a direct (filter, client) lookup —
+        the ?SUBOPTION key read of emqx_broker.erl:726-760 — never a
+        scan of the client's subscription list."""
+        best: Dict[str, Tuple[str, SubOpts]] = {}
+        for flt, dests in pairs:
+            for dest in tuple(dests):
+                if isinstance(dest, tuple) and dest and dest[0] == GROUP_DEST:
+                    continue  # shared legs handled by group election
+                opts = self.suboptions.get((flt, dest))
+                if opts is None:
+                    continue
+                cur = best.get(dest)
+                if cur is None or opts.qos > cur[1].qos:
+                    best[dest] = (flt, opts)
+        return self._fanout(msg, list(best.items()))
+
+    def _fanout(
+        self, msg: Message, entries: List[Tuple[str, Tuple[str, SubOpts]]]
     ) -> int:
+        """Wide-fanout sharding (the 1024 rule): shard 0 delivers
+        inline; later shards are scheduled as separate event-loop turns
+        so a 100k-subscriber topic cannot stall the loop for one long
+        dispatch (the reference parallelizes shards across broker-pool
+        workers, emqx_broker.erl:643-672,753-760). Returns deliveries
+        INITIATED — deferred shards count at plan time."""
+        pkt_cache: Dict[bool, Publish] = {}  # retain flag -> shared pkt
+        if len(entries) <= FANOUT_SHARD:
+            return self._deliver_shard(msg, entries, pkt_cache)
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            loop = None
+        n = self._deliver_shard(msg, entries[:FANOUT_SHARD], pkt_cache)
+        for i in range(FANOUT_SHARD, len(entries), FANOUT_SHARD):
+            shard = entries[i : i + FANOUT_SHARD]
+            if loop is None:
+                n += self._deliver_shard(msg, shard, pkt_cache)
+            else:
+                loop.call_soon(self._deliver_shard, msg, shard, pkt_cache)
+                n += len(shard)
+        return n
+
+    def _deliver_shard(
+        self,
+        msg: Message,
+        entries: List[Tuple[str, Tuple[str, SubOpts]]],
+        pkt_cache: Optional[Dict[bool, Publish]] = None,
+    ) -> int:
+        """Deliver one shard. Trivial-QoS0 deliveries (connected mem
+        session, effective QoS 0) share ONE Publish packet per retain
+        flag, carried in pkt_cache ACROSS shards; its wire form is
+        serialized once per protocol version (frame.serialize memoizes
+        on the packet) — the fanout hot loop writes the same bytes to
+        every socket instead of re-serializing per subscriber."""
+        n = 0
+        if pkt_cache is None:
+            pkt_cache = {}
+        for client, (flt, opts) in entries:
+            session = self.sessions.get(client)
+            if session is None:
+                continue
+            if (
+                session.__class__ is Session
+                and session.connected
+                and min(msg.qos, opts.qos) == 0
+                and not session.cfg.upgrade_qos
+            ):
+                n += 1
+                self.hooks.run("message.delivered", client, msg)
+                if opts.no_local and msg.from_client == client:
+                    continue
+                retain = msg.retain if opts.retain_as_published else False
+                shared_pkt = pkt_cache.get(retain)
+                if shared_pkt is None:
+                    shared_pkt = Publish(
+                        topic=msg.topic,
+                        payload=msg.payload,
+                        qos=0,
+                        retain=retain,
+                        packet_id=None,
+                        props=dict(msg.props),
+                    )
+                    shared_pkt._wire = {}  # opt into serialize memoization
+                    pkt_cache[retain] = shared_pkt
+                sink = getattr(session, "outgoing_sink", None)
+                if sink is not None:
+                    sink([shared_pkt])
+                continue
+            packets = session.deliver(msg, opts)
+            self.hooks.run("message.delivered", client, msg)
+            if packets:
+                sink = getattr(session, "outgoing_sink", None)
+                if sink is not None:
+                    sink(packets)
+            n += 1
+        return n
+
+    def deliver_replayed(self, client_id: str, msg: Message) -> int:
+        """Deliver one replayed message to a specific client by
+        re-matching its own subscriptions (takeover import: the message
+        was already matched on the old owner, so this is a per-client
+        re-match, not a route lookup; max granted QoS wins)."""
         session = self.sessions.get(client_id)
         if session is None:
             return 0
-        if share_filter is not None:
-            opts = session.subscriptions.get(share_filter)
-        else:
-            opts = self._matching_subopts(session, msg.topic)
+        best: Optional[SubOpts] = None
+        tw = topic_mod.words(msg.topic)
+        for flt, opts in session.subscriptions.items():
+            group, real = topic_mod.parse_share(flt)
+            if topic_mod.match(tw, topic_mod.words(real)):
+                if best is None or opts.qos > best.qos:
+                    best = opts
+        if best is None:
+            return 0
+        packets = session.deliver(msg, best)
+        self.hooks.run("message.delivered", client_id, msg)
+        if packets:
+            sink = getattr(session, "outgoing_sink", None)
+            if sink is not None:
+                sink(packets)
+        return 1
+
+    def _deliver_to(
+        self, client_id: str, share_filter: str, msg: Message
+    ) -> int:
+        """Shared-group leg: subopts key is the full $share filter."""
+        session = self.sessions.get(client_id)
+        if session is None:
+            return 0
+        opts = session.subscriptions.get(share_filter)
         if opts is None:
             return 0
         packets = session.deliver(msg, opts)
@@ -285,18 +429,3 @@ class Broker:
             if sink is not None:
                 sink(packets)
         return 1
-
-    def _matching_subopts(self, session: Session, topic: str) -> Optional[SubOpts]:
-        """Find the (non-shared) subscription that matched; when several
-        overlap, the highest granted QoS wins (reference delivers once
-        per subscription via per-filter SUBOPTION; we dedup per client
-        like aggre/1 and take max QoS)."""
-        best = None
-        tw = topic_mod.words(topic)
-        for flt, opts in session.subscriptions.items():
-            if flt.startswith("$share/"):
-                continue
-            if topic_mod.match(tw, topic_mod.words(flt)):
-                if best is None or opts.qos > best.qos:
-                    best = opts
-        return best

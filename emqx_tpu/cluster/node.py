@@ -79,42 +79,26 @@ class ClusterBroker(Broker):
         super().__init__(*args, **kwargs)
         self.node: Optional["ClusterNode"] = None
 
-    def _dispatch(self, msg: Message, dests: Set) -> int:
+    def _dispatch(self, msg: Message, pairs) -> int:
         node = self.node
         if node is None:
-            return super()._dispatch(msg, dests)
+            return super()._dispatch(msg, pairs)
         # local direct dests only — group election happens cluster-wide
-        direct = {
-            d
-            for d in dests
-            if not (isinstance(d, tuple) and d and d[0] == GROUP_DEST)
-        }
-        n = self._dispatch_direct(msg, direct)
-        n += node.route_remote(msg)
-        if n == 0:
-            if self.durable is None or not self.durable.needs_persist(msg.topic):
-                self.metrics.inc("messages.dropped.no_subscribers")
-                self.hooks.run("message.dropped", msg, "no_subscribers")
-        return n
-
-    def _dispatch_direct(self, msg: Message, dests: Set) -> int:
-        n = 0
-        for dest in dests:
-            n += self._deliver_to(dest, None, msg)
+        n = self._dispatch_direct(msg, pairs)
         if n:
             self.metrics.inc("messages.delivered", n)
+        n += node.route_remote(msg)
+        self._account_dispatch(msg, n)
         return n
 
     def dispatch_forwarded(self, msg: Message) -> int:
         """Peer leg of a forward: deliver to LOCAL direct subscribers
         only — no re-forwarding, no shared election (the publisher
         already elected; emqx_broker:dispatch :472-480)."""
-        dests = {
-            d
-            for d in self.router.match_routes(msg.topic)
-            if not (isinstance(d, tuple) and d and d[0] == GROUP_DEST)
-        }
-        return self._dispatch_direct(msg, dests)
+        n = self._dispatch_direct(msg, self.router.match_pairs(msg.topic))
+        if n:
+            self.metrics.inc("messages.delivered", n)
+        return n
 
     def open_session(self, client_id: str, clean_start: bool, cfg=None):
         if self.node is not None:
@@ -588,7 +572,7 @@ class ClusterNode:
                 if flt not in session.subscriptions:
                     self.broker.subscribe(session, flt, SubOpts(**opts))
             for payload in state["pending"]:
-                self.broker._deliver_to(client_id, None, msg_from_wire(payload))
+                self.broker.deliver_replayed(client_id, msg_from_wire(payload))
         except Exception:
             log.exception("takeover import for %s failed", client_id)
 

@@ -232,15 +232,19 @@ class Router:
         self.on_dest_removed = None
         # exact topics: host hash (never on device — the v2 split)
         self._exact: Dict[str, Dict[Dest, int]] = {}
-        # wildcard filters
+        # wildcard filters: ONE device row per DISTINCT filter; the
+        # dest fan lives host-side per filter. This is the reference's
+        # route-table/subscriber-table split (emqx_router ?ROUTE_TAB
+        # keyed by topic vs emqx_broker ?SUBSCRIBER ets) — a 100k-wide
+        # fanout is one row in HBM, not 100k copies of the filter.
         self.table = FilterTable(max_levels=max_levels)
         self._trie = TopicTrie()  # host cut-through; ids are table rows
-        self._pair_row: Dict[Tuple[str, Dest], int] = {}
-        self._pair_refs: Dict[Tuple[str, Dest], int] = {}
-        self._row_dest: Dict[int, Tuple[str, Dest]] = {}
+        self._wild: Dict[str, Dict[Dest, int]] = {}
+        self._filter_row: Dict[str, int] = {}
+        self._row_filter: Dict[int, str] = {}
         # filters too deep for the flattened table: host-only, in their
-        # own depth-unlimited trie (ids are (filter, dest) pairs)
-        self._deep: Dict[Tuple[str, Dest], int] = {}
+        # own depth-unlimited trie (ids are filter strings)
+        self._deep: Dict[str, Dict[Dest, int]] = {}
         self._deep_trie = TopicTrie()
         self.index = ClassIndex(max_levels) if use_hash_index else None
         self.device_table = DeviceTable(self.table, device=device, index=self.index)
@@ -255,28 +259,25 @@ class Router:
             if fresh and self.on_dest_added is not None:
                 self.on_dest_added(flt, dest)
             return
-        key = (flt, dest)
-        if key in self._pair_refs:
-            self._pair_refs[key] += 1
-            return
-        if key in self._deep:
-            self._deep[key] += 1
-            return
-        try:
-            row = self.table.add(flt)
-        except FilterTooDeep:
-            self._deep[key] = 1
-            self._deep_trie.insert(topic_mod.words(flt), key)
-            if self.on_dest_added is not None:
-                self.on_dest_added(flt, dest)
-            return
-        self._pair_row[key] = row
-        self._pair_refs[key] = 1
-        self._row_dest[row] = key
-        self._trie.insert(topic_mod.words(flt), row)
-        if self.index is not None:
-            self.index.add_row(row, self.table)
-        if self.on_dest_added is not None:
+        dests = self._wild.get(flt)
+        if dests is None and flt in self._deep:
+            dests = self._deep[flt]
+        if dests is None:
+            try:
+                row = self.table.add(flt)
+            except FilterTooDeep:
+                dests = self._deep.setdefault(flt, {})
+                self._deep_trie.insert(topic_mod.words(flt), flt)
+            else:
+                dests = self._wild.setdefault(flt, {})
+                self._filter_row[flt] = row
+                self._row_filter[row] = flt
+                self._trie.insert(topic_mod.words(flt), row)
+                if self.index is not None:
+                    self.index.add_row(row, self.table)
+        fresh = dest not in dests
+        dests[dest] = dests.get(dest, 0) + 1
+        if fresh and self.on_dest_added is not None:
             self.on_dest_added(flt, dest)
 
     def delete_route(self, flt: str, dest: Dest) -> None:
@@ -292,40 +293,42 @@ class Router:
                 if self.on_dest_removed is not None:
                     self.on_dest_removed(flt, dest)
             return
-        key = (flt, dest)
-        if key in self._deep:
-            self._deep[key] -= 1
-            if self._deep[key] == 0:
-                del self._deep[key]
-                self._deep_trie.remove(topic_mod.words(flt), key)
-                if self.on_dest_removed is not None:
-                    self.on_dest_removed(flt, dest)
+        deep = False
+        dests = self._wild.get(flt)
+        if dests is None:
+            dests = self._deep.get(flt)
+            deep = True
+        if dests is None or dest not in dests:
             return
-        if key not in self._pair_refs:
+        dests[dest] -= 1
+        if dests[dest]:
             return
-        self._pair_refs[key] -= 1
-        if self._pair_refs[key]:
-            return
-        row = self._pair_row.pop(key)
-        del self._pair_refs[key]
-        del self._row_dest[row]
-        self._trie.remove(topic_mod.words(flt), row)
-        if self.index is not None:
-            self.index.remove_row(row)
-        self.table.remove(row)
+        del dests[dest]
+        if not dests:
+            if deep:
+                del self._deep[flt]
+                self._deep_trie.remove(topic_mod.words(flt), flt)
+            else:
+                del self._wild[flt]
+                row = self._filter_row.pop(flt)
+                del self._row_filter[row]
+                self._trie.remove(topic_mod.words(flt), row)
+                if self.index is not None:
+                    self.index.remove_row(row)
+                self.table.remove(row)
         if self.on_dest_removed is not None:
             self.on_dest_removed(flt, dest)
 
     def has_route(self, flt: str, dest: Dest) -> bool:
         if not topic_mod.is_wildcard(flt):
             return dest in self._exact.get(flt, ())
-        return (flt, dest) in self._pair_refs or (flt, dest) in self._deep
+        return dest in self._wild.get(flt, ()) or dest in self._deep.get(flt, ())
 
     def topics(self) -> List[str]:
         """All routed topics/filters (emqx_router:topics/0)."""
         out = list(self._exact)
-        out.extend({f for (f, _d) in self._pair_refs})
-        out.extend({f for (f, _d) in self._deep})
+        out.extend(self._wild)
+        out.extend(self._deep)
         return sorted(set(out))
 
     def dests(self, flt: str) -> List[Dest]:
@@ -333,46 +336,62 @@ class Router:
         (emqx_router:lookup_routes/1)."""
         if not topic_mod.is_wildcard(flt):
             return list(self._exact.get(flt, ()))
-        return [d for (f, d) in self._pair_refs if f == flt] + [
-            d for (f, d) in self._deep if f == flt
-        ]
+        return list(self._wild.get(flt, ())) + list(self._deep.get(flt, ()))
 
     def routes(self) -> List[Tuple[str, Dest]]:
         """Every (filter, dest) pair — the full-table stream the
         cluster bootstrap dump walks (emqx_router:stream/1)."""
         out: List[Tuple[str, Dest]] = []
-        for flt, dests in self._exact.items():
-            out.extend((flt, d) for d in dests)
-        out.extend(self._pair_refs)
-        out.extend(self._deep)
+        for table in (self._exact, self._wild, self._deep):
+            for flt, dests in table.items():
+                out.extend((flt, d) for d in dests)
         return out
 
     def stats(self) -> Dict[str, int]:
         return {
             "exact_topics": len(self._exact),
-            "wildcard_routes": len(self._pair_refs),
-            "deep_routes": len(self._deep),
+            "wildcard_filters": len(self._wild),
+            "wildcard_routes": sum(len(d) for d in self._wild.values()),
+            "deep_routes": sum(len(d) for d in self._deep.values()),
             "table_rows": len(self.table),
             "table_capacity": self.table.capacity,
         }
 
     # --- read path (emqx_router:match_routes) ---------------------------
 
-    def _deep_matches(self, topic_words) -> Set[Dest]:
-        return {d for (_f, d) in self._deep_trie.match(topic_words)}
+    def match_filters(self, topic: str) -> List[str]:
+        """All routed filters matching one topic (exact key included).
+        The primary match result: expansion to destinations is a host
+        dict walk per filter (the ?SUBSCRIBER-table leg of the
+        reference's dispatch, emqx_broker.erl:726-760)."""
+        tw = topic_mod.words(topic)
+        out: List[str] = []
+        if topic in self._exact:
+            out.append(topic)
+        for row in self._trie.match(tw):
+            out.append(self._row_filter[row])
+        if self._deep:
+            out.extend(self._deep_trie.match(tw))
+        return out
 
-    def _exact_dests(self, topic: str) -> Set[Dest]:
-        return set(self._exact.get(topic, ()))
+    def filter_dests(self, flt: str) -> Dict[Dest, int]:
+        """Dest refcount map for a matched filter (read-only view)."""
+        if not topic_mod.is_wildcard(flt):
+            return self._exact.get(flt, {})
+        d = self._wild.get(flt)
+        return d if d is not None else self._deep.get(flt, {})
+
+    def match_pairs(self, topic: str) -> List[Tuple[str, Dict[Dest, int]]]:
+        """(filter, dests) pairs for one topic — dispatch uses the
+        filter for direct subopts lookup instead of re-matching."""
+        return [(f, self.filter_dests(f)) for f in self.match_filters(topic)]
 
     def match_routes(self, topic: str) -> Set[Dest]:
         """Single-topic host path: exact hash + trie walk. This is the
         low-latency cut-through used for cold/low-rate topics."""
-        tw = topic_mod.words(topic)
-        dests = self._exact_dests(topic)
-        for row in self._trie.match(tw):
-            dests.add(self._row_dest[row][1])
-        if self._deep:
-            dests |= self._deep_matches(tw)
+        dests: Set[Dest] = set()
+        for _f, dmap in self.match_pairs(topic):
+            dests.update(dmap)
         return dests
 
     @staticmethod
@@ -386,7 +405,7 @@ class Router:
             a, b, _ = kernel(_next_pow2(total))
         return np.asarray(a), np.asarray(b), total
 
-    def match_batch(self, topics: Sequence[str]) -> List[Set[Dest]]:
+    def match_filters_batch(self, topics: Sequence[str]) -> List[List[str]]:
         """Batched device path: ONE XLA dispatch for all wildcard
         matching, host hash for exact topics. The hot loop of
         emqx_broker:do_publish expressed over a topic batch.
@@ -402,7 +421,9 @@ class Router:
             return []
         self.device_table.sync()
         enc = match_ops.encode_topics(self.table.vocab, topics, self.max_levels)
-        out: List[Set[Dest]] = [self._exact_dests(t) for t in topics]
+        out: List[List[str]] = [
+            [t] if t in self._exact else [] for t in topics
+        ]
         ix = self.index
         if ix is not None:
             if len(ix):
@@ -419,7 +440,7 @@ class Router:
                     fw = ix.bucket_filter(bid)
                     if topic_mod.match(twords[t_idx], fw):
                         for row in ix.bucket_rows(bid):
-                            out[t_idx].add(self._row_dest[row][1])
+                            out[t_idx].append(self._row_filter[row])
             if ix.residual_rows:
                 filters = self.device_table.residual_filters()
                 ti, ri, total = self._escalating_pairs(
@@ -427,7 +448,7 @@ class Router:
                     max(1024, _next_pow2(2 * len(topics))),
                 )
                 for t_idx, row in zip(ti[:total], ri[:total]):
-                    out[int(t_idx)].add(self._row_dest[int(row)][1])
+                    out[int(t_idx)].append(self._row_filter[int(row)])
         else:
             filters = self.device_table.filters()
             ti, ri, total = self._escalating_pairs(
@@ -435,8 +456,25 @@ class Router:
                 max(4096, _next_pow2(4 * len(topics))),
             )
             for t_idx, row in zip(ti[:total], ri[:total]):
-                out[int(t_idx)].add(self._row_dest[int(row)][1])
+                out[int(t_idx)].append(self._row_filter[int(row)])
         if self._deep:
             for i, t in enumerate(topics):
-                out[i] |= self._deep_matches(topic_mod.words(t))
+                out[i].extend(self._deep_trie.match(topic_mod.words(t)))
+        return out
+
+    def match_pairs_batch(
+        self, topics: Sequence[str]
+    ) -> List[List[Tuple[str, Dict[Dest, int]]]]:
+        return [
+            [(f, self.filter_dests(f)) for f in flts]
+            for flts in self.match_filters_batch(topics)
+        ]
+
+    def match_batch(self, topics: Sequence[str]) -> List[Set[Dest]]:
+        out: List[Set[Dest]] = []
+        for flts in self.match_filters_batch(topics):
+            dests: Set[Dest] = set()
+            for f in flts:
+                dests.update(self.filter_dests(f))
+            out.append(dests)
         return out

@@ -1,0 +1,122 @@
+"""Wide-fanout dispatch: the 1024-shard rule, distinct-filter device
+rows, direct (filter, client) subopts lookup, and the serialize-once
+QoS0 fast path.
+
+Reference semantics: subscriber shards of 1024 per topic
+(emqx_broker_helper.erl:60,87-97), per-shard dispatch
+(emqx_broker.erl:643-672,753-760), direct ?SUBOPTION reads on
+delivery (emqx_broker.erl:726-760).
+"""
+
+import asyncio
+
+from emqx_tpu.broker import frame
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.packet import MQTT_V4, Publish, SubOpts
+from emqx_tpu.broker.pubsub import FANOUT_SHARD, Broker
+
+
+def _sub(broker, cid, flt, qos=0):
+    s, _ = broker.open_session(cid, True)
+    broker.subscribe(s, flt, SubOpts(qos=qos))
+    return s
+
+
+def test_one_device_row_per_distinct_filter():
+    b = Broker()
+    for i in range(500):
+        _sub(b, f"c{i}", "sensors/+/temp")
+    st = b.router.stats()
+    assert st["table_rows"] == 1
+    assert st["wildcard_filters"] == 1
+    assert st["wildcard_routes"] == 500
+    n = b.publish(Message(topic="sensors/1/temp", payload=b"x"))
+    assert n == 500
+
+
+def test_wide_fanout_inline_when_no_loop():
+    b = Broker()
+    total = FANOUT_SHARD * 2 + 7
+    got = []
+    for i in range(total):
+        s = _sub(b, f"c{i}", "wide/#")
+        s.outgoing_sink = lambda pkts, i=i: got.append(i)
+    n = b.publish(Message(topic="wide/t", payload=b"x"))
+    assert n == total
+    assert len(got) == total
+
+
+def test_wide_fanout_defers_shards_on_event_loop():
+    async def run():
+        b = Broker()
+        total = FANOUT_SHARD + 10
+        got = []
+        for i in range(total):
+            s = _sub(b, f"c{i}", "wide/#")
+            s.outgoing_sink = lambda pkts, i=i: got.append(i)
+        n = b.publish(Message(topic="wide/t", payload=b"x"))
+        assert n == total
+        # shard 0 inline; the tail shard runs on the next loop turn
+        assert len(got) == FANOUT_SHARD
+        await asyncio.sleep(0)
+        assert len(got) == total
+
+    asyncio.run(run())
+
+
+def test_overlapping_filters_dedup_max_qos():
+    b = Broker()
+    s = _sub(b, "c1", "a/+", qos=0)
+    b.subscribe(s, "a/b", SubOpts(qos=1))
+    out = []
+    s.outgoing_sink = out.extend
+    n = b.publish(Message(topic="a/b", payload=b"x", qos=1))
+    assert n == 1  # aggre dedup: one delivery per client
+    assert len(out) == 1
+    assert out[0].qos == 1  # max granted QoS wins
+
+
+def test_qos0_shared_packet_serializes_once():
+    b = Broker()
+    sinks = []
+    for i in range(50):
+        s = _sub(b, f"c{i}", "t/#")
+        s.outgoing_sink = lambda pkts, acc=sinks: acc.append(pkts[0])
+    b.publish(Message(topic="t/x", payload=b"hello"))
+    assert len(sinks) == 50
+    # one shared packet object with a wire cache
+    assert all(p is sinks[0] for p in sinks)
+    w1 = frame.serialize(sinks[0], MQTT_V4)
+    assert sinks[0]._wire[MQTT_V4] is frame.serialize(sinks[0], MQTT_V4)
+    # cached bytes parse back to the right PUBLISH
+    pkts = frame.Parser().feed(w1)
+    assert isinstance(pkts[0], Publish)
+    assert pkts[0].topic == "t/x" and pkts[0].payload == b"hello"
+
+
+def test_no_local_and_rap_still_honored_on_fast_path():
+    b = Broker()
+    s = _sub(b, "pub", "t/#")
+    s.subscriptions["t/#"] = SubOpts(qos=0, no_local=True)
+    b.suboptions[("t/#", "pub")] = SubOpts(qos=0, no_local=True)
+    out = []
+    s.outgoing_sink = out.extend
+    b.publish(Message(topic="t/x", payload=b"x", from_client="pub"))
+    assert out == []  # no_local suppressed
+    s2 = _sub(b, "other", "t/#")
+    b.suboptions[("t/#", "other")] = SubOpts(qos=0, retain_as_published=True)
+    s2.subscriptions["t/#"] = SubOpts(qos=0, retain_as_published=True)
+    out2 = []
+    s2.outgoing_sink = out2.extend
+    b.publish(Message(topic="t/y", payload=b"x", retain=True))
+    assert out2 and out2[0].retain is True
+
+
+def test_batch_path_matches_pairs():
+    b = Broker()
+    for i in range(20):
+        _sub(b, f"c{i}", f"room/{i}/+")
+    _sub(b, "all", "room/#")
+    msgs = [Message(topic=f"room/{i}/t", payload=b"x") for i in range(20)]
+    counts = b.publish_batch(msgs)
+    assert counts == [2] * 20  # per-room subscriber + the wildcard one
