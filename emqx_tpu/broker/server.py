@@ -20,6 +20,7 @@ from .limiter import ListenerLimits, LoadShedder
 from .message import Message
 from .packet import Disconnect, MQTT_V5, Publish, RC
 from .pubsub import Broker
+from .transport import TcpTransport, WsTransport
 
 log = logging.getLogger("emqx_tpu.server")
 
@@ -57,11 +58,10 @@ class PublishBatcher:
 
 
 class Connection:
-    def __init__(self, server: "Server", reader, writer):
+    def __init__(self, server: "Server", transport):
         self.server = server
-        self.reader = reader
-        self.writer = writer
-        peer = writer.get_extra_info("peername")
+        self.transport = transport
+        peer = transport.peername()
         # normalize to "ip:port" (banned/flapping/trace match on the ip)
         if isinstance(peer, (tuple, list)) and len(peer) >= 2:
             peer = f"{peer[0]}:{peer[1]}"
@@ -77,7 +77,7 @@ class Connection:
         if sess is not None:
             sess.outgoing_sink = self._send_packets
             # admin kick severs the socket through this
-            sess.closer = self.writer.close
+            sess.closer = self.transport.close
             # background producers (DS pump) must hop onto this loop
             # before touching the session or transport
             sess.event_loop = asyncio.get_running_loop()
@@ -86,7 +86,7 @@ class Connection:
         try:
             ver = self.channel.proto_ver
             data = b"".join(frame.serialize(p, ver) for p in pkts)
-            self.writer.write(data)
+            self.transport.write(data)
         except Exception:  # connection already gone; session keeps state
             pass
 
@@ -100,7 +100,7 @@ class Connection:
                     timeout = self.server.connect_timeout
                 try:
                     data = await asyncio.wait_for(
-                        self.reader.read(65536), timeout=timeout
+                        self.transport.read(), timeout=timeout
                     )
                 except asyncio.TimeoutError:
                     break  # keepalive/connect timeout
@@ -151,19 +151,21 @@ class Connection:
                 sess.outgoing_sink = None
                 sess.closer = None
             self.channel.on_close()
-            try:
-                self.writer.close()
-            except Exception:
-                pass
+            self.transport.close()
 
     async def drain(self) -> None:
         try:
-            await self.writer.drain()
+            await self.transport.drain()
         except ConnectionError:
             pass
 
 
 class Server:
+    """One listener. `ssl_context` upgrades it to ssl:// (or wss://
+    when `websocket` is set); the reference's four listener types
+    tcp/ssl/ws/wss (emqx_listeners.erl:444-455,657) map onto these two
+    flags over the same connection runtime."""
+
     def __init__(
         self,
         broker: Optional[Broker] = None,
@@ -173,6 +175,10 @@ class Server:
         connect_timeout: float = 10.0,
         limits: Optional[ListenerLimits] = None,
         shedder: Optional[LoadShedder] = None,
+        ssl_context=None,
+        websocket: bool = False,
+        ws_path: str = "/mqtt",
+        name: Optional[str] = None,
     ):
         self.broker = broker or Broker()
         self.host = host
@@ -181,13 +187,22 @@ class Server:
         self.connect_timeout = connect_timeout
         self.limits = limits or ListenerLimits()
         self.shedder = shedder
+        self.ssl_context = ssl_context
+        self.websocket = websocket
+        self.ws_path = ws_path
+        proto = ("wss" if ssl_context else "ws") if websocket else (
+            "ssl" if ssl_context else "tcp"
+        )
+        self.proto = proto
+        self.name = name or f"{proto}:default"
         self._server: Optional[asyncio.AbstractServer] = None
         self._conns: set = set()
+        self._pending: set = set()  # transports still in ws handshake
         self.listen_addr = None
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
-            self._on_client, self.host, self.port
+            self._on_client, self.host, self.port, ssl=self.ssl_context
         )
         addr = self._server.sockets[0].getsockname()
         self.listen_addr = addr[:2]
@@ -210,7 +225,27 @@ class Server:
             self.broker.metrics.inc("listener.conn_rate_limited")
             writer.close()
             return
-        conn = Connection(self, reader, writer)
+        if self.websocket:
+            # bound + track the handshake: a client that connects and
+            # sends nothing must not hold the fd forever, and stop()
+            # must be able to kick a socket still mid-handshake
+            raw = TcpTransport(reader, writer)
+            self._pending.add(raw)
+            try:
+                t = await asyncio.wait_for(
+                    WsTransport.handshake(reader, writer, path=self.ws_path),
+                    timeout=self.connect_timeout,
+                )
+            except (asyncio.TimeoutError, ConnectionError):
+                t = None
+            finally:
+                self._pending.discard(raw)
+            if t is None:
+                raw.close()
+                return
+        else:
+            t = TcpTransport(reader, writer)
+        conn = Connection(self, t)
         self._conns.add(conn)
         try:
             await conn.run()
@@ -227,9 +262,11 @@ class Server:
             # kick live connections so wait_closed() cannot hang on them
             for conn in list(self._conns):
                 try:
-                    conn.writer.close()
+                    conn.transport.close()
                 except Exception:
                     pass
+            for raw in list(self._pending):
+                raw.close()
             await self._server.wait_closed()
 
     async def serve_forever(self) -> None:

@@ -77,6 +77,8 @@ def listener_struct() -> Struct:
             "acceptors": Field(Int(min=1), default=16),
             "proxy_protocol": Field(Bool(), default=False),
             "tcp_backlog": Field(Int(min=1), default=1024),
+            # ws/wss upgrade path (emqx: listeners.ws.default.websocket.mqtt_path)
+            "path": Field(String(), default="/mqtt"),
             "ssl_certfile": Field(String(), default=None),
             "ssl_keyfile": Field(String(), default=None),
             "ssl_cacertfile": Field(String(), default=None),

@@ -27,8 +27,8 @@ def listeners_view(broker) -> List[Dict[str, Any]]:
         if srv.listen_addr is not None:
             out.append(
                 {
-                    "id": "tcp:default",
-                    "type": "tcp",
+                    "id": srv.name,
+                    "type": srv.proto,
                     "bind": f"{srv.listen_addr[0]}:{srv.listen_addr[1]}",
                     "running": True,
                     "current_connections": len(srv._conns),

@@ -1,0 +1,283 @@
+"""WS / TLS transports + listener lifecycle e2e.
+
+Reference: ws/wss via cowboy (emqx_ws_connection.erl), ssl via esockd
+(emqx_listeners.erl:444), listener start/stop/update (:657).
+"""
+
+import asyncio
+import base64
+import hashlib
+import os
+import ssl
+import subprocess
+
+import pytest
+
+from emqx_tpu.broker import frame
+from emqx_tpu.broker.listeners import Listeners, parse_bind
+from emqx_tpu.broker.packet import (
+    Connack, Connect, Publish, Suback, Subscribe, SubOpts,
+)
+from emqx_tpu.broker.pubsub import Broker
+from emqx_tpu.broker.server import Server
+from emqx_tpu.broker.transport import (
+    OP_BINARY, OP_CLOSE, OP_PING, OP_PONG, ws_accept_key, ws_encode_frame,
+)
+
+
+class WsClient:
+    """Minimal masked ws client for the tests."""
+
+    def __init__(self, reader, writer):
+        self.reader = reader
+        self.writer = writer
+        self.parser = frame.Parser()
+        self.pkts = []
+
+    @classmethod
+    async def connect(cls, host, port, path="/mqtt", subproto="mqtt", sslctx=None):
+        r, w = await asyncio.open_connection(host, port, ssl=sslctx)
+        key = base64.b64encode(os.urandom(16)).decode()
+        proto_hdr = f"Sec-WebSocket-Protocol: {subproto}\r\n" if subproto else ""
+        w.write(
+            (
+                f"GET {path} HTTP/1.1\r\nHost: {host}\r\n"
+                "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+                f"Sec-WebSocket-Key: {key}\r\n"
+                "Sec-WebSocket-Version: 13\r\n" + proto_hdr + "\r\n"
+            ).encode()
+        )
+        resp = await r.readuntil(b"\r\n\r\n")
+        status = resp.split(b"\r\n")[0]
+        if b"101" not in status:
+            raise AssertionError(f"handshake rejected: {status!r}")
+        assert ws_accept_key(key).encode() in resp
+        return cls(r, w)
+
+    def send(self, pkt):
+        data = frame.serialize(pkt)
+        self.writer.write(ws_encode_frame(OP_BINARY, data, mask=os.urandom(4)))
+
+    async def recv(self, want, timeout=5.0):
+        while not any(isinstance(p, want) for p in self.pkts):
+            h = await asyncio.wait_for(self.reader.readexactly(2), timeout)
+            n = h[1] & 0x7F
+            assert not (h[1] & 0x80)  # server frames unmasked
+            if n == 126:
+                import struct
+
+                n = struct.unpack(">H", await self.reader.readexactly(2))[0]
+            payload = await self.reader.readexactly(n) if n else b""
+            op = h[0] & 0x0F
+            if op == OP_BINARY:
+                self.pkts += self.parser.feed(payload)
+            elif op == OP_CLOSE:
+                raise ConnectionError("server closed")
+        out = [p for p in self.pkts if isinstance(p, want)][0]
+        self.pkts = [p for p in self.pkts if p is not out]
+        return out
+
+
+def test_parse_bind():
+    assert parse_bind("1883") == ("0.0.0.0", 1883)
+    assert parse_bind(":8083") == ("0.0.0.0", 8083)
+    assert parse_bind("127.0.0.1:8883") == ("127.0.0.1", 8883)
+    assert parse_bind(9001) == ("0.0.0.0", 9001)
+
+
+def test_ws_mqtt_roundtrip():
+    async def run():
+        srv = Server(Broker(), port=0, websocket=True)
+        await srv.start()
+        host, port = srv.listen_addr
+        c = await WsClient.connect(host, port)
+        c.send(Connect(client_id="wsc", proto_ver=4))
+        await c.recv(Connack)
+        c.send(Subscribe(packet_id=1, filters=[("ws/+", SubOpts(qos=0))]))
+        await c.recv(Suback)
+        # second ws client publishes
+        p = await WsClient.connect(host, port)
+        p.send(Connect(client_id="wsp", proto_ver=4))
+        await p.recv(Connack)
+        p.send(Publish(topic="ws/t", payload=b"over-websocket"))
+        await p.writer.drain()
+        got = await c.recv(Publish)
+        assert got.topic == "ws/t" and got.payload == b"over-websocket"
+        # ping is answered with pong
+        c.writer.write(ws_encode_frame(OP_PING, b"hb", mask=os.urandom(4)))
+        h = await asyncio.wait_for(c.reader.readexactly(2), 5)
+        assert h[0] & 0x0F == OP_PONG
+        await srv.stop()
+
+    asyncio.run(run())
+
+
+def test_ws_rejects_bad_upgrade():
+    async def run():
+        srv = Server(Broker(), port=0, websocket=True)
+        await srv.start()
+        host, port = srv.listen_addr
+        r, w = await asyncio.open_connection(host, port)
+        w.write(b"GET /mqtt HTTP/1.1\r\nHost: x\r\n\r\n")  # no upgrade headers
+        resp = await asyncio.wait_for(r.read(1024), 5)
+        assert b"400" in resp
+        # wrong subprotocol also rejected
+        r2, w2 = await asyncio.open_connection(host, port)
+        key = base64.b64encode(os.urandom(16)).decode()
+        w2.write(
+            (
+                "GET /mqtt HTTP/1.1\r\nHost: x\r\n"
+                "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+                f"Sec-WebSocket-Key: {key}\r\n"
+                "Sec-WebSocket-Protocol: stomp\r\n\r\n"
+            ).encode()
+        )
+        resp2 = await asyncio.wait_for(r2.read(1024), 5)
+        assert b"400" in resp2
+        await srv.stop()
+
+    asyncio.run(run())
+
+
+@pytest.fixture(scope="module")
+def certs(tmp_path_factory):
+    d = tmp_path_factory.mktemp("certs")
+    crt, key = d / "srv.crt", d / "srv.key"
+    subprocess.run(
+        [
+            "openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+            "-keyout", str(key), "-out", str(crt), "-days", "1",
+            "-subj", "/CN=127.0.0.1",
+        ],
+        check=True,
+        capture_output=True,
+    )
+    return str(crt), str(key)
+
+
+def _client_ctx(crt):
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    ctx.check_hostname = False
+    ctx.load_verify_locations(crt)
+    return ctx
+
+
+def test_tls_mqtt_roundtrip(certs):
+    crt, key = certs
+
+    async def run():
+        lis = Listeners(Broker())
+        srv = await lis.start(
+            "ssl", "default", {"bind": "127.0.0.1:0", "certfile": crt, "keyfile": key}
+        )
+        host, port = srv.listen_addr
+        r, w = await asyncio.open_connection(host, port, ssl=_client_ctx(crt))
+        w.write(frame.serialize(Connect(client_id="tlsc", proto_ver=4)))
+        p = frame.Parser()
+        pkts = []
+        while not any(isinstance(x, Connack) for x in pkts):
+            pkts += p.feed(await asyncio.wait_for(r.read(4096), 5))
+        w.write(
+            frame.serialize(Subscribe(packet_id=1, filters=[("t/#", SubOpts())]))
+        )
+        while not any(isinstance(x, Suback) for x in pkts):
+            pkts += p.feed(await asyncio.wait_for(r.read(4096), 5))
+        lis.broker.publish(
+            __import__("emqx_tpu.broker.message", fromlist=["Message"]).Message(
+                topic="t/tls", payload=b"secure"
+            )
+        )
+        while not any(isinstance(x, Publish) for x in pkts):
+            pkts += p.feed(await asyncio.wait_for(r.read(4096), 5))
+        got = [x for x in pkts if isinstance(x, Publish)][0]
+        assert got.payload == b"secure"
+        await lis.stop_all()
+
+    asyncio.run(run())
+
+
+def test_wss_roundtrip(certs):
+    crt, key = certs
+
+    async def run():
+        lis = Listeners(Broker())
+        srv = await lis.start(
+            "wss", "default", {"bind": "127.0.0.1:0", "certfile": crt, "keyfile": key}
+        )
+        host, port = srv.listen_addr
+        c = await WsClient.connect(host, port, sslctx=_client_ctx(crt))
+        c.send(Connect(client_id="wssc", proto_ver=4))
+        await c.recv(Connack)
+        await lis.stop_all()
+
+    asyncio.run(run())
+
+
+def test_update_rolls_back_on_bad_config(certs):
+    crt, key = certs
+
+    async def run():
+        lis = Listeners(Broker())
+        await lis.start(
+            "ssl", "default",
+            {"bind": "127.0.0.1:0", "certfile": crt, "keyfile": key},
+        )
+        old = lis.get("ssl", "default")
+        with pytest.raises(Exception):
+            await lis.update(
+                "ssl", "default",
+                {"bind": "127.0.0.1:0", "certfile": "/nonexistent", "keyfile": key},
+            )
+        # validation failed before the old listener was touched
+        assert lis.get("ssl", "default") is old
+        assert old._server is not None
+        await lis.stop_all()
+
+    asyncio.run(run())
+
+
+def test_stalled_ws_handshake_times_out():
+    async def run():
+        srv = Server(Broker(), port=0, websocket=True, connect_timeout=0.2)
+        await srv.start()
+        host, port = srv.listen_addr
+        r, w = await asyncio.open_connection(host, port)
+        # send nothing: the server must drop us after connect_timeout
+        data = await asyncio.wait_for(r.read(64), 5)
+        assert data == b""  # closed by server, not hanging
+        assert not srv._pending
+        await srv.stop()
+
+    asyncio.run(run())
+
+
+def test_listener_lifecycle(certs):
+    async def run():
+        b = Broker()
+        lis = Listeners(b)
+        await lis.start_all(
+            {
+                "ws": {"default": {"bind": "127.0.0.1:0"}},
+                "tcp": {
+                    "default": {"bind": "127.0.0.1:0"},
+                    "internal": {"bind": "127.0.0.1:0", "enabled": False},
+                },
+            }
+        )
+        ids = {i["id"] for i in lis.info()}
+        assert "tcp:default" in ids
+        assert "tcp:internal" not in ids  # disabled stays down
+        srv = lis.get("tcp", "default")
+        host, port = srv.listen_addr
+        # update restarts on a new ephemeral port
+        srv2 = await lis.update("tcp", "default", {"bind": "127.0.0.1:0"})
+        assert lis.get("tcp", "default") is srv2
+        # old port refuses connections now
+        with pytest.raises(OSError):
+            await asyncio.wait_for(asyncio.open_connection(host, port), 2)
+        assert await lis.stop("tcp", "default")
+        assert not await lis.stop("tcp", "default")  # idempotent
+        await lis.stop_all()
+        assert lis.info() == []
+
+    asyncio.run(run())
