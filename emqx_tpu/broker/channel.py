@@ -33,7 +33,8 @@ from .packet import (
     Unsubscribe,
     Will,
 )
-from .pubsub import Broker
+from .caps import CapError
+from .pubsub import Broker, EXCLUSIVE_PREFIX, ExclusiveTaken
 from .session import Session, SessionConfig
 
 
@@ -44,7 +45,13 @@ class ProtocolError(Exception):
 
 
 class Channel:
-    def __init__(self, broker: Broker, peer: str = "?"):
+    def __init__(
+        self,
+        broker: Broker,
+        peer: str = "?",
+        mountpoint: str = "",
+        max_packet_size: Optional[int] = None,
+    ):
         self.broker = broker
         self.peer = peer
         self.client_id: Optional[str] = None
@@ -57,6 +64,17 @@ class Channel:
         self.connected = False
         self.clean_disconnect = False
         self.topic_aliases: dict = {}  # v5 inbound alias -> topic
+        # per-listener mountpoint template; resolved at CONNECT
+        # (emqx_mountpoint: applied to publish topics, filters, and the
+        # will; stripped from deliveries in the outgoing path)
+        self.mountpoint_tpl = mountpoint
+        self.mountpoint = ""
+        # the listener's inbound parser limit, advertised in CONNACK so
+        # the client is never told a limit the parser will reject
+        self.listener_max_packet = max_packet_size
+        # client's advertised maximum packet size: outgoing PUBLISHes
+        # exceeding it are dropped, not sent (MQTT-5 §3.1.2.11.4)
+        self.client_max_packet: Optional[int] = None
 
     # --- inbound dispatch -------------------------------------------------
 
@@ -125,10 +143,27 @@ class Channel:
             self.broker.metrics.inc("client.auth.failure")
             return [Connack(False, code)]
 
+        if len(client_id) > self.broker.caps.max_clientid_len:
+            return [
+                Connack(
+                    False,
+                    RC.CLIENT_IDENTIFIER_NOT_VALID
+                    if self.proto_ver == MQTT_V5
+                    else 2,
+                )
+            ]
+        self.mountpoint = (
+            self.mountpoint_tpl.replace("${clientid}", client_id).replace(
+                "${username}", pkt.username or ""
+            )
+            if self.mountpoint_tpl
+            else ""
+        )
         cfg = SessionConfig()
         if self.proto_ver == MQTT_V5:
             cfg.session_expiry_interval = pkt.props.get("session_expiry_interval", 0)
             cfg.receive_maximum = pkt.props.get("receive_maximum", cfg.receive_maximum)
+            self.client_max_packet = pkt.props.get("maximum_packet_size")
         else:
             # v3: clean_start=False means the session persists "forever"
             cfg.session_expiry_interval = 0 if pkt.clean_start else float("inf")
@@ -145,7 +180,14 @@ class Channel:
         self.broker.hooks.run(
             "client.connected", client_id, self.proto_ver, self.peer
         )
-        out: List[object] = [Connack(present, 0)]
+        props = (
+            self.broker.caps.connack_props(
+                cfg.max_awaiting_rel, self.listener_max_packet
+            )
+            if self.proto_ver == MQTT_V5
+            else {}
+        )
+        out: List[object] = [Connack(present, 0, props=props)]
         if present:
             out.extend(session.on_reconnect())
         return out
@@ -174,11 +216,20 @@ class Channel:
             validate_name(topic)
         except ValueError:
             raise ProtocolError(RC.TOPIC_NAME_INVALID, topic)
+        try:
+            self.broker.caps.check_pub(pkt.qos, pkt.retain)
+        except CapError as e:
+            raise ProtocolError(e.code, topic)
+        # authorize on the UNMOUNTED topic — ACLs must see the same
+        # namespace on publish and subscribe (mount happens after, like
+        # the reference's packet_to_message)
         allowed = self.broker.hooks.run_fold(
             "client.authorize",
             (self.client_id, "publish", topic),
             True,
         )
+        if self.mountpoint:
+            topic = self.mountpoint + topic
         if allowed is not True:
             self.broker.metrics.inc("packets.publish.auth_error")
             if pkt.qos == 1:
@@ -282,8 +333,23 @@ class Channel:
             if allowed is not True:
                 codes.append(RC.NOT_AUTHORIZED if self.proto_ver == MQTT_V5 else 0x80)
                 continue
+            exclusive = flt.startswith(EXCLUSIVE_PREFIX)
             try:
-                retained = self.broker.subscribe(self.session, flt, opts)
+                self.broker.caps.check_sub(
+                    flt[len(EXCLUSIVE_PREFIX):] if exclusive else flt
+                )
+            except CapError as e:
+                codes.append(e.code if self.proto_ver == MQTT_V5 else 0x80)
+                continue
+            try:
+                retained = self.broker.subscribe(
+                    self.session, self._mount_filter(flt), opts
+                )
+            except ExclusiveTaken:
+                codes.append(
+                    RC.QUOTA_EXCEEDED if self.proto_ver == MQTT_V5 else 0x80
+                )
+                continue
             except ValueError:
                 codes.append(
                     RC.TOPIC_FILTER_INVALID if self.proto_ver == MQTT_V5 else 0x80
@@ -304,12 +370,32 @@ class Channel:
 
     def _handle_unsubscribe(self, pkt: Unsubscribe) -> List[object]:
         assert self.session is not None
+        # fold first (topic-rewrite etc. must transform filters the
+        # same way the subscribe fold did, emqx_channel process_unsubscribe)
+        acc = self.broker.hooks.run_fold(
+            "client.unsubscribe", (self.client_id,), pkt.filters
+        )
+        filters = acc if acc is not None else pkt.filters
         codes = []
-        for flt in pkt.filters:
-            ok = self.broker.unsubscribe(self.session, flt)
+        for flt in filters:
+            ok = self.broker.unsubscribe(self.session, self._mount_filter(flt))
             codes.append(0 if ok else RC.NO_SUBSCRIPTION_EXISTED)
-        self.broker.hooks.run("client.unsubscribe", self.client_id, pkt.filters)
         return [Unsuback(pkt.packet_id, codes)]
+
+    def _mount_filter(self, flt: str) -> str:
+        """Apply the listener mountpoint to a subscription filter,
+        keeping $share/$exclusive prefixes outside the mount
+        (emqx_mountpoint mounts inside the share record)."""
+        if not self.mountpoint:
+            return flt
+        if flt.startswith(EXCLUSIVE_PREFIX):
+            return EXCLUSIVE_PREFIX + self.mountpoint + flt[len(EXCLUSIVE_PREFIX):]
+        from ..ops.topic import parse_share
+
+        group, real = parse_share(flt)
+        if group is not None:
+            return f"$share/{group}/{self.mountpoint}{real}"
+        return self.mountpoint + flt
 
     # --- lifecycle -----------------------------------------------------------
 
@@ -329,7 +415,7 @@ class Channel:
         if self.will is not None and not self.clean_disconnect:
             self.broker.publish(
                 Message(
-                    topic=self.will.topic,
+                    topic=self.mountpoint + self.will.topic,
                     payload=self.will.payload,
                     qos=self.will.qos,
                     retain=self.will.retain,

@@ -86,6 +86,7 @@ class Listeners:
             websocket=ltype in ("ws", "wss"),
             ws_path=conf.get("path", "/mqtt"),
             name=f"{ltype}:{name}",
+            mountpoint=conf.get("mountpoint", ""),
             **(
                 {"max_packet_size": conf["max_packet_size"]}
                 if conf.get("max_packet_size")

@@ -39,6 +39,14 @@ GROUP_DEST = "$group"
 # subscribers on one topic dispatch inline, beyond that they shard)
 FANOUT_SHARD = 1024
 
+# exclusive subscriptions (ref: emqx_topic.erl:396-401 strips the
+# prefix and flags is_exclusive; emqx_exclusive_subscription.erl claims)
+EXCLUSIVE_PREFIX = "$exclusive/"
+
+
+class ExclusiveTaken(Exception):
+    """Another client holds the exclusive claim (-> RC 0x97)."""
+
 # route match results flow through dispatch as (filter, dests) pairs;
 # dests is a Dest -> refcount map owned by the Router
 Pairs = Iterable[Tuple[str, Dict]]
@@ -58,6 +66,13 @@ class Broker:
         self.metrics = Metrics()
         self.stats = Stats()
         self.sessions: Dict[str, Session] = {}
+        # capability limits advertised/enforced (emqx_mqtt_caps)
+        from .caps import MqttCaps
+
+        self.caps = MqttCaps()
+        # exclusive-subscription claims: topic -> owning client
+        # (emqx_exclusive_subscription mria set table)
+        self.exclusive: Dict[str, str] = {}
         # live listeners (Server instances register on start)
         self.servers: list = []
         # (filter, client) subopts — mirror of ?SUBOPTION
@@ -133,6 +148,7 @@ class Broker:
                 if topic_mod.parse_share(flt)[0] is not None:
                     self._unsubscribe_route(session.client_id, flt)
                 self.suboptions.pop((flt, session.client_id), None)
+                self._release_exclusive(session.client_id, flt)
             self.durable.discard_session(session.client_id)
             self.sessions.pop(session.client_id, None)
             self.stats.set("sessions.count", len(self.sessions))
@@ -144,6 +160,7 @@ class Broker:
             return
         for flt in list(session.subscriptions):
             self._unsubscribe_route(session.client_id, flt)
+            self._release_exclusive(session.client_id, flt)
         session.subscriptions.clear()
         self.sessions.pop(session.client_id, None)
         self.stats.set("sessions.count", len(self.sessions))
@@ -158,9 +175,26 @@ class Broker:
         self, session: Session, flt: str, opts: SubOpts
     ) -> List[Message]:
         """Register a subscription; returns retained messages to
-        deliver (per retain_handling)."""
+        deliver (per retain_handling). `$exclusive/T` claims T for this
+        client (raises ExclusiveTaken if another client holds it) and
+        subscribes to the stripped topic, like the reference parse
+        (emqx_topic.erl:396-401)."""
+        exclusive = flt.startswith(EXCLUSIVE_PREFIX)
+        if exclusive:
+            if not self.caps.exclusive_subscription:
+                raise ValueError("exclusive subscriptions disabled")
+            flt = flt[len(EXCLUSIVE_PREFIX):]
+            if not flt:
+                raise ValueError("empty exclusive topic")
         group, real = topic_mod.parse_share(flt)
         topic_mod.validate_filter(real)
+        if exclusive:
+            # claim only AFTER validation — a rejected subscribe must
+            # not leave a claim nothing will ever release
+            owner = self.exclusive.get(flt)
+            if owner is not None and owner != session.client_id:
+                raise ExclusiveTaken(flt)
+            self.exclusive[flt] = session.client_id
         # durable sessions route through the ps-router + DS scheduler,
         # never the live router (emqx_persistent_session_ds model)
         if self.durable is not None and self._is_durable(session) and group is None:
@@ -189,8 +223,11 @@ class Broker:
         return self.retainer.read(real)
 
     def unsubscribe(self, session: Session, flt: str) -> bool:
+        if flt.startswith(EXCLUSIVE_PREFIX):
+            flt = flt[len(EXCLUSIVE_PREFIX):]
         if flt not in session.subscriptions:
             return False
+        self._release_exclusive(session.client_id, flt)
         # shared subs always live in the live router, even for durable
         # sessions (the durable subscribe branch requires group None)
         is_shared = topic_mod.parse_share(flt)[0] is not None
@@ -206,6 +243,10 @@ class Broker:
         self.stats.set("subscriptions.count", len(self.suboptions))
         self.hooks.run("session.unsubscribed", session.client_id, flt)
         return True
+
+    def _release_exclusive(self, client_id: str, flt: str) -> None:
+        if self.exclusive.get(flt) == client_id:
+            del self.exclusive[flt]
 
     @staticmethod
     def _is_durable(session: Session) -> bool:
@@ -245,8 +286,11 @@ class Broker:
         self.metrics.inc("messages.received")
         out = self.hooks.run_fold("message.publish", (), msg)
         if out is None or out.headers.get("allow_publish") is False:
-            self.metrics.inc("messages.dropped")
-            self.hooks.run("message.dropped", msg, "publish_denied")
+            # a hook that intercepted the message (delayed-publish
+            # store) is not a drop — it re-enters publish later
+            if out is None or not out.headers.get("intercepted"):
+                self.metrics.inc("messages.dropped")
+                self.hooks.run("message.dropped", msg, "publish_denied")
             return None
         if out.retain:
             self.retainer.retain(out)
@@ -276,15 +320,29 @@ class Broker:
             for dest in tuple(dests):
                 if isinstance(dest, tuple) and dest and dest[0] == GROUP_DEST:
                     _tag, group, real = dest
-                    member = self.shared.pick(
-                        group, real, msg.topic, from_client=msg.from_client
-                    )
-                    if member is None:
-                        continue
-                    got = self._deliver_to(member, f"$share/{group}/{real}", msg)
-                    if got:
-                        self.metrics.inc("messages.delivered", got)
-                    n += got
+                    # redispatch loop: a stale member (session gone)
+                    # must not eat the message — re-elect excluding it
+                    # (emqx_shared_sub:dispatch/4 retry + redispatch,
+                    # emqx_shared_sub.erl:149-163,217-244)
+                    tried: tuple = ()
+                    while True:
+                        member = self.shared.pick(
+                            group,
+                            real,
+                            msg.topic,
+                            from_client=msg.from_client,
+                            exclude=tried,
+                        )
+                        if member is None:
+                            break
+                        got = self._deliver_to(
+                            member, f"$share/{group}/{real}", msg
+                        )
+                        if got:
+                            self.metrics.inc("messages.delivered", got)
+                            n += got
+                            break
+                        tried = tried + (member,)
         return n
 
     def _dispatch_direct(self, msg: Message, pairs: Pairs) -> int:

@@ -65,7 +65,12 @@ class Connection:
         # normalize to "ip:port" (banned/flapping/trace match on the ip)
         if isinstance(peer, (tuple, list)) and len(peer) >= 2:
             peer = f"{peer[0]}:{peer[1]}"
-        self.channel = Channel(server.broker, peer=str(peer))
+        self.channel = Channel(
+            server.broker,
+            peer=str(peer),
+            mountpoint=server.mountpoint,
+            max_packet_size=server.max_packet_size,
+        )
         self.parser = frame.Parser(max_packet_size=server.max_packet_size)
         # per-connection limiter chains (client tier -> listener tier ->
         # node tier; the ?LIMITER_ROUTING check of emqx_channel.erl:751)
@@ -85,8 +90,47 @@ class Connection:
     def _send_packets(self, pkts) -> None:
         try:
             ver = self.channel.proto_ver
-            data = b"".join(frame.serialize(p, ver) for p in pkts)
-            self.transport.write(data)
+            mp = self.channel.mountpoint
+            if mp:
+                # strip the listener mountpoint from delivered topics —
+                # copies, never mutation: a wide-fanout PUBLISH object
+                # is shared across subscribers (emqx_mountpoint:unmount)
+                pkts = [
+                    Publish(
+                        topic=p.topic[len(mp):],
+                        payload=p.payload,
+                        qos=p.qos,
+                        retain=p.retain,
+                        dup=p.dup,
+                        packet_id=p.packet_id,
+                        props=p.props,
+                    )
+                    if isinstance(p, Publish) and p.topic.startswith(mp)
+                    else p
+                    for p in pkts
+                ]
+            chunks = []
+            limit = self.channel.client_max_packet
+            for p in pkts:
+                wire = frame.serialize(p, ver)
+                # client's maximum_packet_size: drop, don't send
+                # (MQTT-5 §3.1.2.11.4; the reference counts
+                # 'delivery.dropped.too_large')
+                if (
+                    limit is not None
+                    and len(wire) > limit
+                    and isinstance(p, Publish)
+                ):
+                    self.server.broker.metrics.inc("delivery.dropped.too_large")
+                    # release the inflight slot or the window shrinks
+                    # permanently — the client will never ack a packet
+                    # it never received
+                    sess = self.channel.session
+                    if p.packet_id is not None and sess is not None:
+                        sess.inflight.pop(p.packet_id, None)
+                    continue
+                chunks.append(wire)
+            self.transport.write(b"".join(chunks))
         except Exception:  # connection already gone; session keeps state
             pass
 
@@ -179,6 +223,7 @@ class Server:
         websocket: bool = False,
         ws_path: str = "/mqtt",
         name: Optional[str] = None,
+        mountpoint: str = "",
     ):
         self.broker = broker or Broker()
         self.host = host
@@ -195,6 +240,7 @@ class Server:
         )
         self.proto = proto
         self.name = name or f"{proto}:default"
+        self.mountpoint = mountpoint
         self._server: Optional[asyncio.AbstractServer] = None
         self._conns: set = set()
         self._pending: set = set()  # transports still in ws handshake

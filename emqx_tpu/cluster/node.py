@@ -489,16 +489,26 @@ class ClusterNode:
         }
         n = 0
         for group, flt in groups:
-            member = self._pick_shared(group, flt, msg)
-            if member is None:
-                continue
-            node, client = member
             share_filter = f"$share/{group}/{flt}"
-            if node == self.node_id:
-                n += self.broker._deliver_to(client, share_filter, msg)
-            else:
+            # redispatch loop for stale LOCAL members (session gone):
+            # re-elect excluding them; a remote forward counts as
+            # initiated — the peer runs its own local re-election
+            # (emqx_shared_sub:dispatch/4 retry, :149-163)
+            tried: tuple = ()
+            while True:
+                member = self._pick_shared(group, flt, msg, exclude=tried)
+                if member is None:
+                    break
+                node, client = member
+                if node == self.node_id:
+                    if self.broker._deliver_to(client, share_filter, msg):
+                        n += 1
+                        break
+                    tried = tried + (member,)
+                    continue
                 addr = self.membership.members.get(node)
                 if addr is None:
+                    tried = tried + (member,)
                     continue
                 self._spawn(
                     self.rpc.cast(
@@ -510,21 +520,24 @@ class ClusterNode:
                     )
                 )
                 n += 1
+                break
         return n
 
-    def _pick_shared(self, group: str, flt: str, msg: Message):
+    def _pick_shared(
+        self, group: str, flt: str, msg: Message, exclude: tuple = ()
+    ):
         if self.cluster_shared.strategy == "local":
             local = [
                 m
                 for m in self.cluster_shared.members(group, flt)
-                if m[0] == self.node_id
+                if m[0] == self.node_id and m not in exclude
             ]
             if local:
                 return self.cluster_shared.pick_among(
                     local, group, flt, msg.topic, msg.from_client
                 )
         return self.cluster_shared.pick(
-            group, flt, msg.topic, from_client=msg.from_client
+            group, flt, msg.topic, from_client=msg.from_client, exclude=exclude
         )
 
     def _spawn(self, coro) -> None:
@@ -538,7 +551,19 @@ class ClusterNode:
     def _handle_shared_deliver(
         self, client: str, share_filter: str, payload: dict
     ) -> None:
-        self.broker._deliver_to(client, share_filter, msg_from_wire(payload))
+        msg = msg_from_wire(payload)
+        if self.broker._deliver_to(client, share_filter, msg):
+            return
+        # elected member vanished between election and arrival:
+        # redispatch to another LOCAL member of the group rather than
+        # dropping (emqx_shared_sub redispatch, :217-244)
+        group, flt = share_filter[len("$share/"):].split("/", 1)
+        tried = {(self.node_id, client)}
+        for member in self.cluster_shared.members(group, flt):
+            if member in tried or member[0] != self.node_id:
+                continue
+            if self.broker._deliver_to(member[1], share_filter, msg):
+                return
 
     # --- session registry / takeover --------------------------------------
 

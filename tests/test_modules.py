@@ -1,0 +1,313 @@
+"""Feature-module sweep: delayed publish, topic rewrite,
+auto-subscribe, exclusive subscriptions, shared-sub redispatch,
+mountpoint, MQTT caps.
+
+Refs: apps/emqx_modules/src/emqx_delayed.erl, emqx_rewrite.erl,
+apps/emqx_auto_subscribe, emqx_exclusive_subscription.erl,
+emqx_shared_sub.erl:149-163, emqx_mountpoint.erl, emqx_mqtt_caps.erl.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from emqx_tpu.broker.channel import Channel, ProtocolError
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.packet import (
+    MQTT_V5, Connack, Connect, Publish, RC, Suback, Subscribe, SubOpts,
+    Unsubscribe,
+)
+from emqx_tpu.broker.pubsub import Broker, ExclusiveTaken
+from emqx_tpu.modules import AutoSubscribe, DelayedPublish, TopicRewrite
+
+
+def _sub(broker, cid, flt, qos=0):
+    s, _ = broker.open_session(cid, True)
+    broker.subscribe(s, flt, SubOpts(qos=qos))
+    return s
+
+
+# --- delayed publish -----------------------------------------------------
+
+
+def test_delayed_publish_holds_then_fires():
+    b = Broker()
+    d = DelayedPublish(b)
+    d.enable()
+    s = _sub(b, "c1", "room/1")
+    out = []
+    s.outgoing_sink = out.extend
+    n = b.publish(Message(topic="$delayed/5/room/1", payload=b"later"))
+    assert n == 0 and len(d) == 1 and out == []
+    d.tick(now=time.time() + 1)  # not due yet
+    assert out == []
+    d.tick(now=time.time() + 6)
+    assert len(out) == 1 and out[0].topic == "room/1" and out[0].payload == b"later"
+    assert len(d) == 0
+
+
+def test_delayed_publish_timer_on_loop():
+    async def run():
+        b = Broker()
+        d = DelayedPublish(b)
+        d.enable()
+        s = _sub(b, "c1", "t")
+        out = []
+        s.outgoing_sink = out.extend
+        b.publish(Message(topic="$delayed/0/t", payload=b"now"))
+        await asyncio.sleep(0.05)
+        assert len(out) == 1 and out[0].payload == b"now"
+        d.disable()
+
+    asyncio.run(run())
+
+
+def test_delayed_malformed_and_limit():
+    b = Broker()
+    d = DelayedPublish(b, max_delayed_messages=1)
+    d.enable()
+    assert b.publish(Message(topic="$delayed/notanum/t", payload=b"x")) == 0
+    assert d.dropped == 1
+    b.publish(Message(topic="$delayed/60/t", payload=b"1"))
+    b.publish(Message(topic="$delayed/60/t", payload=b"2"))  # over limit
+    assert len(d) == 1 and d.dropped == 2
+
+
+# --- topic rewrite -------------------------------------------------------
+
+
+def test_rewrite_publish_and_subscribe():
+    b = Broker()
+    rw = TopicRewrite(
+        b,
+        [
+            {
+                "action": "all",
+                "source_topic": "x/#",
+                "re": r"^x/y/(.+)$",
+                "dest_topic": "z/y/$1",
+            }
+        ],
+    )
+    rw.enable()
+    s, _ = b.open_session("c1", True)
+    # subscribe-side rewrite goes through the channel hook
+    ch = Channel(b)
+    ch.session = s
+    ch.client_id = "c1"
+    ch.connected = True
+    ch.handle_packet(Subscribe(packet_id=1, filters=[("x/y/1", SubOpts())]))
+    assert "z/y/1" in s.subscriptions  # filter rewritten
+    out = []
+    s.outgoing_sink = out.extend
+    n = b.publish(Message(topic="x/y/1", payload=b"m"))
+    assert n == 1 and out[0].topic == "z/y/1"
+    # non-matching topics untouched
+    assert "a/b" == rw.rewrite("a/b", "publish")
+
+
+def test_rewrite_unsubscribe_symmetric():
+    b = Broker()
+    rw = TopicRewrite(
+        b,
+        [{"action": "all", "source_topic": "x/#", "re": r"^x/(.+)$",
+          "dest_topic": "y/$1"}],
+    )
+    rw.enable()
+    ch = Channel(b)
+    ch.handle_packet(Connect(client_id="c1", proto_ver=4))
+    ch.handle_packet(Subscribe(packet_id=1, filters=[("x/a", SubOpts())]))
+    assert "y/a" in ch.session.subscriptions
+    out = ch.handle_packet(Unsubscribe(packet_id=2, filters=["x/a"]))
+    assert out[0].codes == [0]  # found and removed via the same rewrite
+    assert not ch.session.subscriptions
+
+
+def test_rewrite_preserves_share_prefix():
+    b = Broker()
+    rw = TopicRewrite(
+        b,
+        [{"action": "subscribe", "source_topic": "old/#", "re": "^old/(.+)$",
+          "dest_topic": "new/$1"}],
+    )
+    out = rw._on_subscribe("c", [(f"$share/g/old/a", SubOpts())])
+    assert out == [("$share/g/new/a", SubOpts())]
+
+
+# --- auto-subscribe ------------------------------------------------------
+
+
+def test_auto_subscribe_on_connect():
+    b = Broker()
+    a = AutoSubscribe(
+        b, [{"topic": "clients/${clientid}/inbox", "qos": 1}]
+    )
+    a.enable()
+    ch = Channel(b)
+    ch.handle_packet(Connect(client_id="dev7", proto_ver=4))
+    s = b.sessions["dev7"]
+    assert "clients/dev7/inbox" in s.subscriptions
+    assert s.subscriptions["clients/dev7/inbox"].qos == 1
+    n = b.publish(Message(topic="clients/dev7/inbox", payload=b"hi"))
+    assert n == 1
+
+
+# --- exclusive subscriptions --------------------------------------------
+
+
+def test_exclusive_claim_and_release():
+    b = Broker()
+    b.caps.exclusive_subscription = True
+    s1, _ = b.open_session("c1", True)
+    s2, _ = b.open_session("c2", True)
+    b.subscribe(s1, "$exclusive/jobs/1", SubOpts())
+    assert "jobs/1" in s1.subscriptions  # stripped, like the reference
+    with pytest.raises(ExclusiveTaken):
+        b.subscribe(s2, "$exclusive/jobs/1", SubOpts())
+    # plain subscribe to the same topic is NOT blocked (only $exclusive is)
+    b.subscribe(s2, "jobs/other", SubOpts())
+    # release on unsubscribe, then the other client can claim
+    b.unsubscribe(s1, "$exclusive/jobs/1")
+    b.subscribe(s2, "$exclusive/jobs/1", SubOpts())
+    # release on session close too
+    b.close_session(s2)
+    b.subscribe(s1, "$exclusive/jobs/1", SubOpts())
+
+
+def test_exclusive_disabled_by_default_and_channel_code():
+    b = Broker()
+    ch = Channel(b)
+    ch.handle_packet(Connect(client_id="c1", proto_ver=MQTT_V5))
+    out = ch.handle_packet(
+        Subscribe(packet_id=1, filters=[("$exclusive/t", SubOpts())])
+    )
+    suback = [p for p in out if isinstance(p, Suback)][0]
+    assert suback.codes == [RC.TOPIC_FILTER_INVALID]  # cap disabled
+    b.caps.exclusive_subscription = True
+    ch2 = Channel(b)
+    ch2.handle_packet(Connect(client_id="c2", proto_ver=MQTT_V5))
+    out2 = ch2.handle_packet(
+        Subscribe(packet_id=2, filters=[("$exclusive/t", SubOpts())])
+    )
+    assert [p for p in out2 if isinstance(p, Suback)][0].codes == [0]
+    ch3 = Channel(b)
+    ch3.handle_packet(Connect(client_id="c3", proto_ver=MQTT_V5))
+    out3 = ch3.handle_packet(
+        Subscribe(packet_id=3, filters=[("$exclusive/t", SubOpts())])
+    )
+    assert [p for p in out3 if isinstance(p, Suback)][0].codes == [
+        RC.QUOTA_EXCEEDED
+    ]
+
+
+# --- shared-sub redispatch ----------------------------------------------
+
+
+def test_shared_redispatch_skips_stale_member():
+    b = Broker(shared_strategy="round_robin")
+    s1 = _sub(b, "m1", "$share/g/t")
+    s2 = _sub(b, "m2", "$share/g/t")
+    # m1's session vanishes without unsubscribing (stale membership)
+    del b.sessions["m1"]
+    got = []
+    s2.outgoing_sink = got.extend
+    for _ in range(4):
+        assert b.publish(Message(topic="t", payload=b"x")) == 1
+    assert len(got) == 4  # every message redispatched to the live member
+
+
+# --- mountpoint ----------------------------------------------------------
+
+
+def test_mountpoint_mounts_and_strips():
+    from emqx_tpu.broker import frame as frame_mod
+
+    b = Broker()
+    ch = Channel(b, mountpoint="tenant/${clientid}/")
+    ch.handle_packet(Connect(client_id="u1", proto_ver=4))
+    assert ch.mountpoint == "tenant/u1/"
+    ch.handle_packet(Subscribe(packet_id=1, filters=[("a/#", SubOpts())]))
+    assert "tenant/u1/a/#" in ch.session.subscriptions
+    # a publish from the same tenant listener lands in the namespace
+    out = []
+    ch.session.outgoing_sink = out.extend
+    ch.handle_packet(Publish(topic="a/b", payload=b"x"))
+    assert len(out) == 1 and out[0].topic == "tenant/u1/a/b"
+    # messages outside the namespace don't reach it
+    assert b.publish(Message(topic="a/b", payload=b"x")) == 0
+    # unsubscribe mounts too
+    ch.handle_packet(Unsubscribe(packet_id=2, filters=["a/#"]))
+    assert not ch.session.subscriptions
+
+
+# --- MQTT caps -----------------------------------------------------------
+
+
+def test_connack_advertises_caps():
+    b = Broker()
+    ch = Channel(b)
+    out = ch.handle_packet(Connect(client_id="c", proto_ver=MQTT_V5))
+    ack = [p for p in out if isinstance(p, Connack)][0]
+    assert ack.props["retain_available"] == 1
+    assert ack.props["shared_subscription_available"] == 1
+    assert ack.props["maximum_packet_size"] == b.caps.max_packet_size
+    # Maximum QoS property only legal as 0/1 (MQTT-5 §3.2.2.3.4)
+    assert "maximum_qos" not in ack.props
+    b.caps.max_qos_allowed = 1
+    ch2 = Channel(b)
+    out2 = ch2.handle_packet(Connect(client_id="c2", proto_ver=MQTT_V5))
+    assert [p for p in out2 if isinstance(p, Connack)][0].props["maximum_qos"] == 1
+    # advertised packet size never exceeds the listener's parser limit
+    ch3 = Channel(b, max_packet_size=4096)
+    out3 = ch3.handle_packet(Connect(client_id="c3", proto_ver=MQTT_V5))
+    assert [p for p in out3 if isinstance(p, Connack)][0].props[
+        "maximum_packet_size"
+    ] == 4096
+
+
+def test_exclusive_claim_not_leaked_on_invalid_filter():
+    b = Broker()
+    b.caps.exclusive_subscription = True
+    s, _ = b.open_session("c1", True)
+    with pytest.raises(ValueError):
+        b.subscribe(s, "$exclusive/a/#/b", SubOpts())  # invalid filter
+    assert b.exclusive == {}  # no claim leaked
+    s2, _ = b.open_session("c2", True)
+    b.subscribe(s2, "$exclusive/a/b", SubOpts())  # topic still claimable
+
+
+def test_caps_enforced():
+    b = Broker()
+    b.caps.retain_available = False
+    b.caps.wildcard_subscription = False
+    b.caps.max_qos_allowed = 1
+    ch = Channel(b)
+    ch.handle_packet(Connect(client_id="c", proto_ver=MQTT_V5))
+    with pytest.raises(ProtocolError) as ei:
+        ch.handle_packet(Publish(topic="t", payload=b"x", retain=True))
+    assert ei.value.code == RC.RETAIN_NOT_SUPPORTED
+    with pytest.raises(ProtocolError) as ei2:
+        ch.handle_packet(Publish(topic="t", payload=b"x", qos=2, packet_id=1))
+    assert ei2.value.code == RC.QOS_NOT_SUPPORTED
+    out = ch.handle_packet(
+        Subscribe(packet_id=1, filters=[("a/#", SubOpts())])
+    )
+    assert [p for p in out if isinstance(p, Suback)][0].codes == [
+        RC.WILDCARD_SUBSCRIPTIONS_NOT_SUPPORTED
+    ]
+    b.caps.shared_subscription = False
+    out2 = ch.handle_packet(
+        Subscribe(packet_id=2, filters=[("$share/g/a", SubOpts())])
+    )
+    assert [p for p in out2 if isinstance(p, Suback)][0].codes == [
+        RC.SHARED_SUBSCRIPTIONS_NOT_SUPPORTED
+    ]
+
+
+def test_clientid_too_long_rejected():
+    b = Broker()
+    b.caps.max_clientid_len = 8
+    ch = Channel(b)
+    out = ch.handle_packet(Connect(client_id="way-too-long-id", proto_ver=MQTT_V5))
+    assert out[0].code == RC.CLIENT_IDENTIFIER_NOT_VALID
