@@ -170,6 +170,7 @@ class Channel:
         session, present = self.broker.open_session(
             client_id, pkt.clean_start, cfg
         )
+        session.mountpoint = self.mountpoint  # hooks (auto-subscribe) read it
         self.session = session
         self.client_id = client_id
         self.username = pkt.username

@@ -43,6 +43,11 @@ class Db:
             max_items=buffer_max,
         )
         self._watchers: List[Callable[[], None]] = []
+        # replication seam: when set, write batches route through it
+        # (shard_id, msgs) instead of the local storage — the
+        # replication layer sits exactly here in the reference stack
+        # (emqx_ds_buffer -> emqx_ds_replication_layer -> storage)
+        self.interceptor: Optional[Callable[[int, List[Message]], None]] = None
 
     # --- write path -----------------------------------------------------
 
@@ -52,8 +57,12 @@ class Db:
         for m in msgs:
             by_shard.setdefault(self.storage.shard_of(m), []).append(m)
         for sid, batch in by_shard.items():
-            self.storage.shards[sid].store_batch(batch, sync=sync)
-        self._notify()
+            if self.interceptor is not None:
+                self.interceptor(sid, batch)
+            else:
+                self.storage.shards[sid].store_batch(batch, sync=sync)
+        if self.interceptor is None:
+            self._notify()
 
     def store_async(self, msg: Message) -> None:
         """Buffered store through the per-shard batching buffer
@@ -61,7 +70,16 @@ class Db:
         self.buffer.push(self.storage.shard_of(msg), msg)
 
     def _flush_shard(self, shard_id: int, msgs: List[Message]) -> None:
+        if self.interceptor is not None:
+            self.interceptor(shard_id, msgs)
+            return
         self.storage.shards[shard_id].store_batch(msgs, sync=True)
+        self._notify()
+
+    def apply_local(self, shard_id: int, msgs: Sequence[Message]) -> None:
+        """Replication-layer apply: write straight to local storage,
+        bypassing the interceptor (the replica side of the log)."""
+        self.storage.shards[shard_id].store_batch(list(msgs), sync=True)
         self._notify()
 
     # --- read path ------------------------------------------------------

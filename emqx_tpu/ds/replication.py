@@ -1,0 +1,294 @@
+"""DS replication tier: per-shard ordered-log replication over the
+cluster RPC plane, plus durable-session-state fan-out.
+
+The reference replicates each DS shard with raft
+(apps/emqx_ds_builtin_raft/src/emqx_ds_replication_layer.erl:1-1342:
+leader appends to a ra log, quorum-acked entries apply to rocksdb on
+every replica). This is the raft-LITE analog, documented honestly:
+
+  * every shard has ONE leader, chosen deterministically from the
+    live membership (sorted node ids, round-robin by shard) — no
+    elections, the membership view IS the election;
+  * all writes for a shard route to its leader, which assigns a
+    monotonically increasing log index and broadcasts (idx, batch) to
+    every peer; replicas apply strictly in index order, so every
+    node's storage evolves identically — byte-identical keys, which
+    makes stream positions PORTABLE across nodes (the property that
+    lets a durable session resume elsewhere);
+  * no quorum ack: entries the leader appended but had not yet
+    broadcast when it died are lost (a bounded window the reference's
+    raft closes; accepted here and stated);
+  * gap recovery: a replica detecting idx > last+1 parks the batch
+    and pulls the missing range from the sender's bounded in-memory
+    log (`replay`); a leader change continues from the new leader's
+    last applied index.
+
+Session docs (subs + committed stream positions) fan out on every
+save through the same plane, so the session itself — not just its
+messages — survives node loss (the reference stores session state in
+DS proper; same effect).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import threading
+from collections import deque
+from typing import Deque, Dict, List, Optional, Tuple
+
+from ..broker.message import Message
+from ..cluster.node import ClusterNode, msg_from_wire, msg_to_wire
+
+log = logging.getLogger("emqx_tpu.ds.replication")
+
+LOG_RETENTION = 4096  # (idx, batch) entries kept per shard for replay
+
+
+class ReplicatedDs:
+    def __init__(self, node: ClusterNode, manager) -> None:
+        """node: started ClusterNode; manager: DurableSessionManager."""
+        self.node = node
+        self.manager = manager
+        self.db = manager.db
+        self.node_id = node.node_id
+        self.n_shards = len(self.db.storage.shards)
+        # per-shard replication state; the mutex covers it all — writes
+        # arrive both from the DS buffer flush THREAD (local submits)
+        # and the node loop thread (RPC handlers), and index assignment
+        # must be atomic or two batches share an index and every
+        # replica drops one as a duplicate. RLock: apply_local's notify
+        # chain (pump -> save_session -> _on_sess_save) re-enters on
+        # the same thread while the apply still holds the lock
+        self._mutex = threading.RLock()
+        self._next_idx: Dict[int, int] = {}  # as leader: next index to assign
+        self._applied: Dict[int, int] = {}  # last index applied locally
+        self._log: Dict[int, Deque[Tuple[int, list]]] = {}
+        self._parked: Dict[int, Dict[int, list]] = {}  # out-of-order buffer
+        # session-doc fan-out is DEBOUNCED: ack commits save on every
+        # puback, and a per-message cluster-wide doc broadcast would be
+        # a hot-path amplifier — coalesce to the latest doc per client
+        self._sess_dirty: Dict[str, dict] = {}
+        self._sess_flush_pending = False
+        self.sess_debounce_s = 0.05
+        node.rpc.registry.register_all(
+            "ds",
+            1,
+            {
+                "write": self._handle_write,
+                "apply": self._handle_apply,
+                "replay": self._handle_replay,
+                "sess_put": self._handle_sess_put,
+                "sess_del": self._handle_sess_del,
+            },
+        )
+        self.db.interceptor = self._submit
+        manager.on_save = self._on_sess_save
+        manager.on_discard = self._on_sess_discard
+
+    # --- leadership ------------------------------------------------------
+
+    def leader_of(self, shard: int) -> str:
+        nodes = sorted([self.node_id, *self.node.membership.members])
+        return nodes[shard % len(nodes)]
+
+    def _peers(self):
+        return list(self.node.membership.members.items())
+
+    def _spawn(self, coro) -> None:
+        """Schedule an RPC coroutine on the node's loop — writes arrive
+        from the DS buffer's flush THREAD, so cross-thread handoff must
+        go through call_soon_threadsafe."""
+        loop = getattr(self.node, "_loop", None)
+        if loop is None or loop.is_closed():
+            coro.close()
+            return
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if running is loop:
+            asyncio.ensure_future(coro)
+        else:
+            try:
+                loop.call_soon_threadsafe(asyncio.ensure_future, coro)
+            except RuntimeError:
+                coro.close()
+
+    # --- write path ------------------------------------------------------
+
+    def _submit(self, shard: int, msgs: List[Message]) -> None:
+        """Db interceptor: route a local write to the shard leader."""
+        leader = self.leader_of(shard)
+        if leader == self.node_id:
+            self._leader_append(shard, [msg_to_wire(m) for m in msgs])
+            return
+        addr = self.node.membership.members.get(leader)
+        if addr is None:
+            # leader unknown (partition): apply locally rather than
+            # lose the write; anti-entropy is out of scope here
+            self.db.apply_local(shard, msgs)
+            return
+        self._spawn(
+            self.node.rpc.cast(
+                addr, "ds", "write", ([msg_to_wire(m) for m in msgs],), key=f"ds{shard}"
+            )
+        )
+
+    def _leader_append(self, shard: int, payload: list) -> None:
+        with self._mutex:
+            idx = self._next_idx.get(shard, self._applied.get(shard, 0) + 1)
+            self._next_idx[shard] = idx + 1
+            self._apply_locked(shard, idx, payload)
+        # notify OUTSIDE the mutex: the watcher chain takes the session
+        # manager's lock, which other threads hold while calling back
+        # into _on_sess_save (AB-BA deadlock if notified under _mutex)
+        self.db._notify()
+        for peer, addr in self._peers():
+            self._spawn(
+                self.node.rpc.cast(
+                    addr, "ds", "apply", (shard, idx, payload), key=f"ds{shard}"
+                )
+            )
+
+    def _handle_write(self, payload: list, hops: int = 0) -> None:
+        """A forwarded write; payload items are wire messages. Shard is
+        recomputed here — shard_of is deterministic on from_client.
+        `hops` bounds re-forwarding: with asymmetric membership views
+        two nodes can each think the other leads, so after one re-
+        forward the receiver appends as leader itself (SOME single
+        node must order the batch; a loop orders it nowhere)."""
+        msgs = [msg_from_wire(d) for d in payload]
+        by_shard: Dict[int, list] = {}
+        for m, d in zip(msgs, payload):
+            by_shard.setdefault(self.db.storage.shard_of(m), []).append(d)
+        for shard, batch in by_shard.items():
+            if hops >= 1 or self.leader_of(shard) == self.node_id:
+                self._leader_append(shard, batch)
+            else:
+                addr = self.node.membership.members.get(self.leader_of(shard))
+                if addr is not None:
+                    self._spawn(
+                        self.node.rpc.cast(
+                            addr, "ds", "write", (batch, hops + 1),
+                            key=f"ds{shard}",
+                        )
+                    )
+                else:
+                    self._leader_append(shard, batch)
+
+    # --- replica apply ---------------------------------------------------
+
+    def _apply_locked(self, shard: int, idx: int, payload: list) -> None:
+        """Caller holds self._mutex — storage write + log state ONLY;
+        the watcher notification happens after the lock is released."""
+        self.db.storage.shards[shard].store_batch(
+            [msg_from_wire(d) for d in payload], sync=True
+        )
+        self._applied[shard] = idx
+        self._next_idx[shard] = max(self._next_idx.get(shard, 0), idx + 1)
+        lg = self._log.setdefault(shard, deque(maxlen=LOG_RETENTION))
+        lg.append((idx, payload))
+
+    def _handle_apply(self, shard: int, idx: int, payload: list, _from=None) -> None:
+        pull_from = None
+        applied = False
+        with self._mutex:
+            last = self._applied.get(shard, 0)
+            if idx <= last:
+                return  # duplicate
+            if idx == last + 1:
+                self._apply_locked(shard, idx, payload)
+                applied = True
+                # drain any parked successors
+                parked = self._parked.get(shard)
+                while parked:
+                    nxt = self._applied[shard] + 1
+                    batch = parked.pop(nxt, None)
+                    if batch is None:
+                        break
+                    self._apply_locked(shard, nxt, batch)
+            else:
+                # gap: park and pull the missing range from the leader
+                self._parked.setdefault(shard, {})[idx] = payload
+                pull_from = self.node.membership.members.get(
+                    self.leader_of(shard)
+                )
+        if applied:
+            self.db._notify()
+        if pull_from is not None:
+            self._spawn(self._pull(pull_from, shard, last))
+
+    async def _pull(self, addr, shard: int, after_idx: int) -> None:
+        try:
+            entries = await self.node.rpc.call(
+                addr, "ds", "replay", (shard, after_idx)
+            )
+        except Exception:
+            return
+        for idx, payload in entries:
+            self._handle_apply(shard, idx, payload)
+
+    def _handle_replay(self, shard: int, after_idx: int):
+        with self._mutex:
+            lg = self._log.get(shard)
+            if not lg:
+                return []
+            return [(i, p) for i, p in lg if i > after_idx]
+
+    # --- session-state replication ---------------------------------------
+
+    def _on_sess_save(self, doc: dict) -> None:
+        """Coalesce: ack commits save per PUBACK; broadcast only the
+        LATEST doc per client every sess_debounce_s."""
+        with self._mutex:
+            self._sess_dirty[doc["client_id"]] = doc
+            if self._sess_flush_pending:
+                return
+            self._sess_flush_pending = True
+        loop = getattr(self.node, "_loop", None)
+        if loop is None or loop.is_closed():
+            with self._mutex:
+                self._sess_flush_pending = False
+            return
+        try:
+            loop.call_soon_threadsafe(
+                loop.call_later, self.sess_debounce_s, self._flush_sess
+            )
+        except RuntimeError:
+            with self._mutex:
+                self._sess_flush_pending = False
+
+    def _flush_sess(self) -> None:
+        with self._mutex:
+            docs = list(self._sess_dirty.values())
+            self._sess_dirty.clear()
+            self._sess_flush_pending = False
+        for doc in docs:
+            for _peer, addr in self._peers():
+                self._spawn(
+                    self.node.rpc.cast(
+                        addr, "ds", "sess_put", (doc,), key="ds-sess"
+                    )
+                )
+
+    def _on_sess_discard(self, client_id: str) -> None:
+        for _peer, addr in self._peers():
+            self._spawn(
+                self.node.rpc.cast(
+                    addr, "ds", "sess_del", (client_id,), key="ds-sess"
+                )
+            )
+
+    def _handle_sess_put(self, doc: dict) -> None:
+        self.manager.adopt_doc(doc)
+
+    def _handle_sess_del(self, client_id: str) -> None:
+        self.manager.drop_replica(client_id)
+
+    # --- lifecycle --------------------------------------------------------
+
+    def detach(self) -> None:
+        self.db.interceptor = None
+        self.manager.on_save = None
+        self.manager.on_discard = None

@@ -66,6 +66,7 @@ class DurableSession(Session):
     def __init__(self, client_id: str, cfg: Optional[SessionConfig] = None, manager=None):
         super().__init__(client_id, cfg)
         self.manager = manager
+        self.is_replica = False  # peer-replicated copy; owner decides expiry
         self._streams: Dict[str, _StreamState] = {}
         # pid -> stream id (for position commit on ack)
         self._pid_stream: Dict[int, str] = {}
@@ -128,6 +129,10 @@ class DurableSessionManager:
         # the caller thread; asyncio sessions are pumped ON their loop
         # via call_soon_threadsafe instead (see _on_new_data)
         self._lock = threading.RLock()
+        # replication callbacks (ds/replication.py): session docs fan
+        # out to peers so a durable session can resume on another node
+        self.on_save = None  # fn(doc)
+        self.on_discard = None  # fn(client_id)
         self._load_all()
         self.db.poll(self._on_new_data)
 
@@ -161,6 +166,7 @@ class DurableSessionManager:
                 return s, False
             old.connected = True
             old.disconnected_at = None
+            old.is_replica = False  # failover adoption: we own it now
             return old, True
 
     def discard_session(self, client_id: str) -> None:
@@ -172,6 +178,8 @@ class DurableSessionManager:
                 self._del_route(flt, client_id)
             self.kv.delete(b"sess/" + client_id.encode())
             self.kv.flush()
+        if self.on_discard is not None:
+            self.on_discard(client_id)
 
     def subscribe(
         self, session: DurableSession, flt: str, opts: SubOpts
@@ -311,8 +319,8 @@ class DurableSessionManager:
 
     # --- persistence ----------------------------------------------------
 
-    def save_session(self, s: DurableSession) -> None:
-        doc = {
+    def session_doc(self, s: DurableSession) -> dict:
+        return {
             "client_id": s.client_id,
             "created_at": s.created_at,
             "expiry": s.cfg.session_expiry_interval,
@@ -329,38 +337,85 @@ class DurableSessionManager:
                 for sid, st in s._streams.items()
             },
         }
+
+    def save_session(self, s: DurableSession) -> None:
+        doc = self.session_doc(s)
         self.kv.put(b"sess/" + s.client_id.encode(), json.dumps(doc).encode())
         self.kv.flush()
+        if self.on_save is not None:
+            self.on_save(doc)
+
+    def _session_from_doc(self, doc: dict) -> DurableSession:
+        cfg = SessionConfig(session_expiry_interval=doc["expiry"])
+        s = DurableSession(doc["client_id"], cfg, manager=self)
+        s.connected = False
+        s.disconnected_at = time.time()
+        for f, o in doc["subs"].items():
+            s.subscriptions[f] = SubOpts(qos=o["qos"])
+            try:
+                self.ps_router.insert(topic_mod.words(f), s.client_id)
+            except KeyError:
+                pass
+        for sid, sd in doc.get("streams", {}).items():
+            stream = Stream(
+                shard=sd["shard"],
+                generation=sd["gen"],
+                static_key=sd["static"],
+                constraints=tuple(sd["constraints"]),
+            )
+            s._streams[sid] = _StreamState(
+                stream, sd["filter"], bytes.fromhex(sd["committed"])
+            )
+        return s
+
+    def adopt_doc(self, doc: dict) -> None:
+        """Apply a replicated session doc from a peer (replica upsert).
+        A session CONNECTED here is locally owned — a late/stale
+        broadcast must not clobber it. Replicas are marked so the local
+        GC never expires them (the OWNER decides expiry; a replica's
+        disconnected_at is adoption time, not a real disconnect)."""
+        with self._lock:
+            cur = self.sessions.get(doc["client_id"])
+            if cur is not None and cur.connected:
+                return
+            if cur is not None:
+                for flt in list(cur.subscriptions):
+                    self._del_route(flt, cur.client_id)
+            s = self._session_from_doc(doc)
+            s.is_replica = True
+            self.sessions[s.client_id] = s
+            self.kv.put(
+                b"sess/" + s.client_id.encode(), json.dumps(doc).encode()
+            )
+
+    def drop_replica(self, client_id: str) -> None:
+        """Apply a replicated discard (no re-broadcast). A session
+        CONNECTED here is locally owned — ignore the stale delete."""
+        with self._lock:
+            s = self.sessions.get(client_id)
+            if s is not None and s.connected:
+                return
+            self.sessions.pop(client_id, None)
+            if s is not None:
+                for flt in list(s.subscriptions):
+                    self._del_route(flt, client_id)
+            self.kv.delete(b"sess/" + client_id.encode())
 
     def _load_all(self) -> None:
         for k, v in self.kv.scan(b"sess/", b"sess0"):
             doc = json.loads(v)
-            cfg = SessionConfig(session_expiry_interval=doc["expiry"])
-            s = DurableSession(doc["client_id"], cfg, manager=self)
-            s.connected = False
-            s.disconnected_at = time.time()
-            for f, o in doc["subs"].items():
-                s.subscriptions[f] = SubOpts(qos=o["qos"])
-                try:
-                    self.ps_router.insert(topic_mod.words(f), s.client_id)
-                except KeyError:
-                    pass
-            for sid, sd in doc.get("streams", {}).items():
-                stream = Stream(
-                    shard=sd["shard"],
-                    generation=sd["gen"],
-                    static_key=sd["static"],
-                    constraints=tuple(sd["constraints"]),
-                )
-                s._streams[sid] = _StreamState(
-                    stream, sd["filter"], bytes.fromhex(sd["committed"])
-                )
+            s = self._session_from_doc(doc)
             self.sessions[s.client_id] = s
 
     def gc(self) -> int:
         """Drop expired disconnected sessions (the reference's session
-        GC worker)."""
-        dead = [cid for cid, s in self.sessions.items() if s.expired()]
+        GC worker). Replicas are exempt — only the owning node may
+        expire a session (its discard then replicates as sess_del)."""
+        dead = [
+            cid
+            for cid, s in self.sessions.items()
+            if s.expired() and not getattr(s, "is_replica", False)
+        ]
         for cid in dead:
             self.discard_session(cid)
         return len(dead)

@@ -110,3 +110,79 @@ class Retainer:
             self._names.remove(topic_mod.words(t), t)
             del self._store[t]
         return len(dead)
+
+
+class PersistentRetainer(Retainer):
+    """Retainer backed by the DS KV engine: write-through on
+    store/delete, full reload on open — retained state survives broker
+    restart the way the reference's mnesia disc backend does
+    (apps/emqx_retainer/src/emqx_retainer_mnesia.erl:288-298). Reads
+    stay in-memory (the KV tier is durability, not the read path)."""
+
+    def __init__(
+        self,
+        path: str,
+        max_retained: int = 1_000_000,
+        prefer_native: bool = True,
+    ):
+        super().__init__(max_retained)
+        from ..cluster import wire
+        from ..ds.kvstore import open_kv
+
+        self._wire = wire
+        self._kv = open_kv(path, prefer_native=prefer_native)
+        now = time.time()
+        for key, val in self._kv.scan():
+            try:
+                d = wire.decode(val)
+                msg = Message(
+                    topic=d["topic"],
+                    payload=d["payload"],
+                    qos=d["qos"],
+                    retain=True,
+                    from_client=d.get("from_client", ""),
+                    timestamp=d.get("timestamp", now),
+                    props=dict(d.get("props") or {}),
+                )
+            except Exception:
+                continue  # torn/corrupt record: skip, don't fail boot
+            if msg.expired(now):
+                self._kv.delete(key)
+                continue
+            Retainer.retain(self, msg)
+
+    def retain(self, msg: Message) -> None:
+        had = msg.topic in self._store
+        super().retain(msg)
+        key = msg.topic.encode()
+        if not msg.payload:
+            if had:
+                self._kv.delete(key)
+            return
+        if msg.topic in self._store:  # not rejected by max_retained
+            self._kv.put(
+                key,
+                self._wire.encode(
+                    {
+                        "topic": msg.topic,
+                        "payload": msg.payload,
+                        "qos": msg.qos,
+                        "from_client": msg.from_client,
+                        "timestamp": msg.timestamp,
+                        "props": dict(msg.props),
+                    }
+                ),
+            )
+
+    def clean(self, now: Optional[float] = None) -> int:
+        now = now if now is not None else time.time()
+        dead = [t for t, m in self._store.items() if m.expired(now)]
+        for t in dead:
+            self._kv.delete(t.encode())
+        return super().clean(now)
+
+    def flush(self) -> None:
+        self._kv.flush()
+
+    def close(self) -> None:
+        self._kv.close()

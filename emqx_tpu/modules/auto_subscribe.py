@@ -41,8 +41,12 @@ class AutoSubscribe:
             return None
         username = getattr(session, "username", "") or ""
         host = (peer or "").rsplit(":", 1)[0] if peer else ""
+        # a mounted listener namespaces its clients: forced subs must
+        # land in the SAME namespace or they never match (the channel
+        # records its resolved mountpoint on the session at CONNECT)
+        mountpoint = getattr(session, "mountpoint", "")
         for t in self.topics:
-            flt = (
+            flt = mountpoint + (
                 t["topic"]
                 .replace("${clientid}", client_id)
                 .replace("${username}", username)
@@ -58,8 +62,8 @@ class AutoSubscribe:
             )
             try:
                 retained = self.broker.subscribe(session, flt, opts)
-            except ValueError:
-                continue  # placeholder produced an invalid filter
+            except Exception:
+                continue  # invalid filter / exclusive collision: skip
             for m in retained:
                 pkts = session.deliver(m, opts)
                 if not pkts:

@@ -53,6 +53,8 @@ class DelayedPublish:
         if not self._enabled:
             self.broker.hooks.add("message.publish", self._on_publish, priority=900)
             self._enabled = True
+            if self._heap:
+                self._schedule()  # re-enable must re-arm held messages
 
     def disable(self) -> None:
         if self._enabled:

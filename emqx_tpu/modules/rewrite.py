@@ -22,7 +22,11 @@ class RewriteRule:
         self.action = action
         self.source_words = topic_mod.words(source)
         self.re = re.compile(regex)
-        self.dest = dest
+        # $N backreferences become \g<N> for a SINGLE-pass expand:
+        # sequential str.replace would re-substitute inside earlier
+        # groups' matched text (topic 'x/$2/b' corrupting) and break
+        # on $10+
+        self.dest_tpl = re.sub(r"\$(\d+)", r"\\g<\1>", dest)
 
     def apply(self, topic: str) -> Optional[str]:
         if not topic_mod.match(topic_mod.words(topic), self.source_words):
@@ -30,10 +34,7 @@ class RewriteRule:
         m = self.re.search(topic)
         if m is None:
             return None
-        out = self.dest
-        for i, g in enumerate(m.groups(), start=1):
-            out = out.replace(f"${i}", g or "")
-        return out
+        return m.expand(self.dest_tpl)
 
 
 class TopicRewrite:

@@ -1,0 +1,173 @@
+"""DS replication tier: per-shard ordered log + session-doc fan-out.
+Kill-node test: a durable session resumes on a peer WITH its messages.
+
+Ref: apps/emqx_ds_builtin_raft/src/emqx_ds_replication_layer.erl
+(raft-lite here: deterministic shard leaders, ordered apply, no
+quorum ack — see emqx_tpu/ds/replication.py docstring).
+"""
+
+import asyncio
+
+import pytest
+
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.packet import SubOpts
+from emqx_tpu.broker.session import SessionConfig
+from emqx_tpu.cluster.node import ClusterBroker, ClusterNode
+from emqx_tpu.ds import Db
+from emqx_tpu.ds.replication import ReplicatedDs
+from emqx_tpu.ds.session_ds import DurableSessionManager
+
+
+async def make_node(name, tmp_path, seed=None):
+    db = Db(
+        "messages", data_dir=str(tmp_path / name), n_shards=2, buffer_flush_ms=5
+    )
+    mgr = DurableSessionManager(db, state_dir=str(tmp_path / name))
+    broker = ClusterBroker()
+    broker.enable_durable(mgr)
+    node = ClusterNode(name, broker=broker, heartbeat_interval=0.05,
+                       miss_threshold=2)
+    addr = await node.start()
+    if seed is not None:
+        await node.join(seed)
+    repl = ReplicatedDs(node, mgr)
+    return node, mgr, db, repl, addr
+
+
+async def settle(t=0.15):
+    await asyncio.sleep(t)
+
+
+DUR = SessionConfig(session_expiry_interval=3600)
+
+
+async def test_messages_replicate_to_all_nodes(tmp_path):
+    n1, m1, db1, r1, a1 = await make_node("n1", tmp_path)
+    n2, m2, db2, r2, a2 = await make_node("n2", tmp_path, seed=a1)
+    try:
+        s, _ = n1.broker.open_session("dev1", True, DUR)
+        n1.broker.subscribe(s, "jobs/#", SubOpts(qos=1))
+        await settle()
+        # session doc replicated: n2's persist gate knows the route
+        assert m2.needs_persist("jobs/x")
+        # publish on n2 (remote from the session's home node)
+        n2.broker.publish(Message(topic="jobs/x", payload=b"m1",
+                                  qos=1, from_client="pub"))
+        await settle(0.3)
+        # both DBs hold the message with IDENTICAL keys (ordered log)
+        for db in (db1, db2):
+            streams = db.get_streams("jobs/#")
+            assert streams
+            rows = []
+            for st in streams:
+                shard = db.storage.shards[st.shard]
+                got, _ = shard.scan_stream(st, "jobs/#", b"", 0, 10)
+                rows.extend(got)
+            assert [m.payload for _k, m in rows] == [b"m1"]
+        k1 = [
+            k
+            for st in db1.get_streams("jobs/#")
+            for k, _ in db1.storage.shards[st.shard].scan_stream(
+                st, "jobs/#", b"", 0, 10
+            )[0]
+        ]
+        k2 = [
+            k
+            for st in db2.get_streams("jobs/#")
+            for k, _ in db2.storage.shards[st.shard].scan_stream(
+                st, "jobs/#", b"", 0, 10
+            )[0]
+        ]
+        assert k1 == k2  # byte-identical positions -> portable
+    finally:
+        for n in (n1, n2):
+            await n.stop()
+        for m in (m1, m2):
+            m.close()
+        for db in (db1, db2):
+            db.close()
+
+
+async def test_durable_session_survives_node_death(tmp_path):
+    n1, m1, db1, r1, a1 = await make_node("n1", tmp_path)
+    n2, m2, db2, r2, a2 = await make_node("n2", tmp_path, seed=a1)
+    try:
+        # durable session lives on n1, receives + acks one message
+        s, _ = n1.broker.open_session("dev1", True, DUR)
+        n1.broker.subscribe(s, "jobs/#", SubOpts(qos=1))
+        got = []
+        s.outgoing_sink = got.extend
+        await settle()
+        n1.broker.publish(Message(topic="jobs/1", payload=b"first",
+                                  qos=1, from_client="p"))
+        await settle(0.3)
+        assert [p.payload for p in got] == [b"first"]
+        assert s.on_puback(got[0].packet_id)  # commit the position
+        await settle()
+        # client drops; more traffic arrives while it is offline
+        s.on_disconnect()
+        n2.broker.publish(Message(topic="jobs/2", payload=b"second",
+                                  qos=1, from_client="p"))
+        n2.broker.publish(Message(topic="jobs/3", payload=b"third",
+                                  qos=1, from_client="p"))
+        await settle(0.3)
+        # n1 dies
+        await n1.stop()
+        m1.close()
+        db1.close()
+        await settle(0.3)
+        # client reconnects on n2: session present, pending replayed,
+        # the acked message NOT duplicated
+        s2, present = n2.broker.open_session("dev1", False, DUR)
+        assert present
+        out = []
+        s2.outgoing_sink = out.extend
+        pkts = s2.on_reconnect()
+        payloads = [p.payload for p in pkts]
+        assert payloads == [b"second", b"third"]
+    finally:
+        await n2.stop()
+        m2.close()
+        db2.close()
+
+
+async def test_gap_recovery_via_replay(tmp_path):
+    n1, m1, db1, r1, a1 = await make_node("n1", tmp_path)
+    n2, m2, db2, r2, a2 = await make_node("n2", tmp_path, seed=a1)
+    try:
+        # leader of shard 0 is n1 (sorted order). Simulate a dropped
+        # broadcast by appending directly on n1 with the peer list
+        # emptied, then restoring it — the next apply shows a gap and
+        # n2 pulls the missing range.
+        shard = 0
+        assert r1.leader_of(shard) == "n1"
+        real = n1.membership.members
+        n1.membership.members = {}
+        r1._leader_append(shard, [
+            {"topic": "g/a", "payload": b"lost", "qos": 0, "retain": False,
+             "from_client": "", "id": "x1", "timestamp": 1.0, "props": {}}
+        ])
+        n1.membership.members = real
+        r1._leader_append(shard, [
+            {"topic": "g/b", "payload": b"next", "qos": 0, "retain": False,
+             "from_client": "", "id": "x2", "timestamp": 2.0, "props": {}}
+        ])
+        await settle(0.5)
+        assert r2._applied.get(shard) == 2  # replayed through the gap
+        streams = db2.get_streams("g/#")
+        msgs = [
+            m.payload
+            for st in streams
+            for _k, m in db2.storage.shards[st.shard].scan_stream(
+                st, "g/#", b"", 0, 10
+            )[0]
+        ]
+        assert sorted(msgs) == [b"lost", b"next"]
+    finally:
+        for n in (n1, n2):
+            await n.stop()
+        for m in (m1, m2):
+            m.close()
+        for db in (db1, db2):
+            db.close()

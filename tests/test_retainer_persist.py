@@ -1,0 +1,76 @@
+"""PersistentRetainer: retained state survives restart on the KV tier.
+
+Ref: apps/emqx_retainer/src/emqx_retainer_mnesia.erl:288-298.
+"""
+
+import time
+
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.packet import SubOpts
+from emqx_tpu.broker.pubsub import Broker
+from emqx_tpu.models.retainer import PersistentRetainer
+
+
+def test_retained_survive_restart(tmp_path):
+    path = str(tmp_path / "retained")
+    r = PersistentRetainer(path)
+    r.retain(Message(topic="a/1", payload=b"one", retain=True, qos=1))
+    r.retain(Message(topic="a/2", payload=b"two", retain=True,
+                     props={"content_type": "t"}))
+    r.retain(Message(topic="gone", payload=b"x", retain=True))
+    r.retain(Message(topic="gone", payload=b"", retain=True))  # delete
+    r.flush()
+    r.close()
+
+    r2 = PersistentRetainer(path)
+    assert len(r2) == 2
+    got = {m.topic: m for m in r2.read("a/+")}
+    assert got["a/1"].payload == b"one" and got["a/1"].qos == 1
+    assert got["a/2"].props["content_type"] == "t"
+    assert r2.read("gone") == []
+    r2.close()
+
+
+def test_expired_dropped_on_reload(tmp_path):
+    path = str(tmp_path / "retained")
+    r = PersistentRetainer(path)
+    m = Message(topic="exp/1", payload=b"x", retain=True,
+                props={"message_expiry_interval": 1})
+    m.timestamp = time.time() - 10  # already expired
+    r.retain(m)
+    r.retain(Message(topic="live/1", payload=b"y", retain=True))
+    r.flush()
+    r.close()
+    r2 = PersistentRetainer(path)
+    assert [m.topic for m in r2.read("#")] == ["live/1"]
+    r2.close()
+
+
+def test_clean_removes_from_kv(tmp_path):
+    path = str(tmp_path / "retained")
+    r = PersistentRetainer(path)
+    m = Message(topic="exp/2", payload=b"x", retain=True,
+                props={"message_expiry_interval": 0.01})
+    r.retain(m)
+    assert r.clean(now=time.time() + 1) == 1
+    r.flush()
+    r.close()
+    r2 = PersistentRetainer(path)
+    assert len(r2) == 0
+    r2.close()
+
+
+def test_broker_with_persistent_retainer(tmp_path):
+    path = str(tmp_path / "retained")
+    b = Broker()
+    b.retainer = PersistentRetainer(path)
+    b.publish(Message(topic="cfg/x", payload=b"v1", retain=True))
+    b.retainer.flush()
+    b.retainer.close()
+
+    b2 = Broker()
+    b2.retainer = PersistentRetainer(path)
+    s, _ = b2.open_session("c1", True)
+    retained = b2.subscribe(s, "cfg/#", SubOpts())
+    assert [m.payload for m in retained] == [b"v1"]
+    b2.retainer.close()
