@@ -147,7 +147,11 @@ class ReplicatedDs:
         for peer, addr in self._peers():
             self._spawn(
                 self.node.rpc.cast(
-                    addr, "ds", "apply", (shard, idx, payload), key=f"ds{shard}"
+                    addr,
+                    "ds",
+                    "apply",
+                    (shard, idx, payload, self.node_id),
+                    key=f"ds{shard}",
                 )
             )
 
@@ -209,10 +213,12 @@ class ReplicatedDs:
                         break
                     self._apply_locked(shard, nxt, batch)
             else:
-                # gap: park and pull the missing range from the leader
+                # gap: park and pull the missing range from the SENDER
+                # — it just broadcast idx, so its log has the range; the
+                # computed leader may never have led this shard
                 self._parked.setdefault(shard, {})[idx] = payload
                 pull_from = self.node.membership.members.get(
-                    self.leader_of(shard)
+                    _from if _from is not None else self.leader_of(shard)
                 )
         if applied:
             self.db._notify()

@@ -221,8 +221,18 @@ class Router:
     offload for batched wildcard matching."""
 
     def __init__(
-        self, max_levels: int = 16, device=None, use_hash_index: bool = True
+        self,
+        max_levels: int = 16,
+        device=None,
+        use_hash_index: bool = True,
+        mesh=None,
     ) -> None:
+        """With `mesh` (a jax.sharding.Mesh), the wildcard table lives
+        SUB-SHARDED across the mesh and batched matching runs the
+        shard_map compaction kernel (parallel/sharded_match.py) — the
+        broker's production path on a pod. The pattern-class hash index
+        is a single-device structure, so the mesh path uses the dense
+        partitioned kernel instead (replication-as-partitioning)."""
         self.max_levels = max_levels
         # route-transition callbacks: fired when a (filter, dest) pair
         # first appears / finally disappears — the seam the cluster
@@ -246,8 +256,17 @@ class Router:
         # own depth-unlimited trie (ids are filter strings)
         self._deep: Dict[str, Dict[Dest, int]] = {}
         self._deep_trie = TopicTrie()
-        self.index = ClassIndex(max_levels) if use_hash_index else None
-        self.device_table = DeviceTable(self.table, device=device, index=self.index)
+        self.mesh = mesh
+        if mesh is not None:
+            from ..parallel.sharded_match import ShardedDeviceTable
+
+            self.index = None
+            self.device_table = ShardedDeviceTable(self.table, mesh)
+        else:
+            self.index = ClassIndex(max_levels) if use_hash_index else None
+            self.device_table = DeviceTable(
+                self.table, device=device, index=self.index
+            )
 
     # --- write path (emqx_router:do_add_route / do_delete_route) -------
 
@@ -424,6 +443,16 @@ class Router:
         out: List[List[str]] = [
             [t] if t in self._exact else [] for t in topics
         ]
+        if self.mesh is not None:
+            ti, ri, = self.device_table.match_ids(enc)
+            b = len(topics)
+            for t_idx, row in zip(ti, ri):
+                if t_idx < b:  # drop dp-padding rows
+                    out[int(t_idx)].append(self._row_filter[int(row)])
+            if self._deep:
+                for i, t in enumerate(topics):
+                    out[i].extend(self._deep_trie.match(topic_mod.words(t)))
+            return out
         ix = self.index
         if ix is not None:
             if len(ix):

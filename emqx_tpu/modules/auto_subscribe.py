@@ -60,9 +60,11 @@ class AutoSubscribe:
                 retain_as_published=t.get("retain_as_published", False),
                 retain_handling=t.get("retain_handling", 0),
             )
+            from ..broker.pubsub import ExclusiveTaken
+
             try:
                 retained = self.broker.subscribe(session, flt, opts)
-            except Exception:
+            except (ValueError, ExclusiveTaken):
                 continue  # invalid filter / exclusive collision: skip
             for m in retained:
                 pkts = session.deliver(m, opts)

@@ -25,8 +25,18 @@ class RewriteRule:
         # $N backreferences become \g<N> for a SINGLE-pass expand:
         # sequential str.replace would re-substitute inside earlier
         # groups' matched text (topic 'x/$2/b' corrupting) and break
-        # on $10+
-        self.dest_tpl = re.sub(r"\$(\d+)", r"\\g<\1>", dest)
+        # on $10+. Group references are validated HERE so a bad rule
+        # fails at config time, not on every matching publish.
+        refs = [int(n) for n in re.findall(r"\$(\d+)", dest)]
+        if refs and max(refs) > self.re.groups:
+            raise ValueError(
+                f"dest_topic references group ${max(refs)} but the regex "
+                f"has {self.re.groups} group(s)"
+            )
+        # literal backslashes in dest must not read as expand escapes
+        self.dest_tpl = re.sub(
+            r"\$(\d+)", r"\\g<\1>", dest.replace("\\", "\\\\")
+        )
 
     def apply(self, topic: str) -> Optional[str]:
         if not topic_mod.match(topic_mod.words(topic), self.source_words):

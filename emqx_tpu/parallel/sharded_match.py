@@ -20,7 +20,7 @@ Two styles, both idiomatic:
 from __future__ import annotations
 
 import functools
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -62,32 +62,48 @@ def make_sharded_kernels(mesh: Mesh):
     n_sub = mesh.shape[SUB_AXIS]
 
     def _apply_delta_local(dev: EncodedFilters, rows, words, plen, hh, rw, act):
-        # dev leaves are the LOCAL shard [N/n_sub, ...]; rows are global.
+        # dev leaves are the LOCAL shard [N/n_sub, ...]; rows are
+        # GLOBAL ids with a leading delta-batch axis [n_b, K, ...] —
+        # all batches apply inside ONE dispatch via scan (chained
+        # dispatches do not pipeline through the device relay,
+        # PERF_NOTES.md; same rule as the single-device _scatter_rows).
         local_n = dev.words.shape[0]
         offset = jax.lax.axis_index(SUB_AXIS).astype(jnp.int32) * local_n
-        local = rows - offset
-        # rows outside this shard scatter out of range -> dropped
-        oob = (local < 0) | (local >= local_n)
-        local = jnp.where(oob, local_n, local)
-        return EncodedFilters(
-            dev.words.at[local].set(words, mode="drop"),
-            dev.prefix_len.at[local].set(plen, mode="drop"),
-            dev.has_hash.at[local].set(hh, mode="drop"),
-            dev.root_wild.at[local].set(rw, mode="drop"),
-            dev.active.at[local].set(act, mode="drop"),
-        )
+
+        def step(d, xs):
+            r, w, p, h, rw_, a = xs
+            local = r - offset
+            # rows outside this shard scatter out of range -> dropped
+            oob = (local < 0) | (local >= local_n)
+            local = jnp.where(oob, local_n, local)
+            return (
+                EncodedFilters(
+                    d.words.at[local].set(w, mode="drop"),
+                    d.prefix_len.at[local].set(p, mode="drop"),
+                    d.has_hash.at[local].set(h, mode="drop"),
+                    d.root_wild.at[local].set(rw_, mode="drop"),
+                    d.active.at[local].set(a, mode="drop"),
+                ),
+                None,
+            )
+
+        out, _ = jax.lax.scan(step, dev, (rows, words, plen, hh, rw, act))
+        return out
 
     dev_specs = EncodedFilters(
         P(SUB_AXIS, None), P(SUB_AXIS), P(SUB_AXIS), P(SUB_AXIS), P(SUB_AXIS)
     )
     # rows, words, plen, hh, rw, act — all replicated to every shard
-    delta_specs = (P(None), P(None, None), P(None), P(None), P(None), P(None))
+    delta_specs = (
+        P(None, None), P(None, None, None), P(None, None),
+        P(None, None), P(None, None), P(None, None),
+    )
 
     @functools.partial(jax.jit, donate_argnums=0)
     def apply_delta(
         dev: EncodedFilters,
-        rows: jnp.ndarray,  # int32 [K] global row ids
-        words: jnp.ndarray,  # int32 [K, L]
+        rows: jnp.ndarray,  # int32 [n_b, K] global row ids
+        words: jnp.ndarray,  # int32 [n_b, K, L]
         plen: jnp.ndarray,
         hh: jnp.ndarray,
         rw: jnp.ndarray,
@@ -101,3 +117,138 @@ def make_sharded_kernels(mesh: Mesh):
         )(dev, rows, words, plen, hh, rw, act)
 
     return match_counts, match_packed, apply_delta
+
+
+def make_match_ids_kernel(mesh: Mesh, max_hits_per_block: int):
+    """Sharded compaction kernel: every (dp, sub) block matches its
+    LOCAL [B/dp, N/sub] tile and compacts its hits to fixed-size
+    (topic, row) id buffers with GLOBAL indices (axis_index offsets) —
+    the device→host transfer stays proportional to matches per block,
+    the multi-chip version of ops.match.match_ids. Returns
+    (ti [dp, sub*mh], ri [dp, sub*mh], totals [dp, sub]); slots are -1
+    beyond each block's true count, and a block whose total exceeds
+    max_hits_per_block overflowed (caller escalates)."""
+
+    f_specs = EncodedFilters(
+        P(SUB_AXIS, None), P(SUB_AXIS), P(SUB_AXIS), P(SUB_AXIS), P(SUB_AXIS)
+    )
+    t_specs = EncodedTopics(P(DP_AXIS, None), P(DP_AXIS), P(DP_AXIS))
+    mh = max_hits_per_block
+
+    def _local(ids, lens, dollar, words, plen, hh, rw, act):
+        dp_i = jax.lax.axis_index(DP_AXIS).astype(jnp.int32)
+        sub_i = jax.lax.axis_index(SUB_AXIS).astype(jnp.int32)
+        ok = _match_block(ids, lens, dollar, words, plen, hh, rw, act)
+        b_loc, n_loc = ok.shape
+        cnt = ok.sum(dtype=jnp.int32)
+        idx = jnp.nonzero(ok.reshape(-1), size=mh, fill_value=-1)[0]
+        valid = idx >= 0
+        ti = jnp.where(valid, idx // n_loc + dp_i * b_loc, -1).astype(jnp.int32)
+        ri = jnp.where(valid, idx % n_loc + sub_i * n_loc, -1).astype(jnp.int32)
+        return ti[None, :], ri[None, :], cnt.reshape(1, 1)
+
+    @jax.jit
+    def match_ids(filters: EncodedFilters, topics: EncodedTopics):
+        return jax.shard_map(
+            _local,
+            mesh=mesh,
+            in_specs=(
+                t_specs.ids, t_specs.lens, t_specs.dollar,
+                f_specs.words, f_specs.prefix_len, f_specs.has_hash,
+                f_specs.root_wild, f_specs.active,
+            ),
+            out_specs=(
+                P(DP_AXIS, SUB_AXIS),
+                P(DP_AXIS, SUB_AXIS),
+                P(DP_AXIS, SUB_AXIS),
+            ),
+        )(
+            topics.ids, topics.lens, topics.dollar,
+            filters.words, filters.prefix_len, filters.has_hash,
+            filters.root_wild, filters.active,
+        )
+
+    return match_ids
+
+
+class ShardedDeviceTable:
+    """Mesh-resident mirror of a FilterTable: rows sub-sharded across
+    the mesh, topics dp-sharded, batched delta sync through the
+    shard_map scatter. The multi-device counterpart of
+    models.router.DeviceTable behind the same sync()/match surface —
+    replication-as-partitioning instead of the reference's full
+    per-node table replica (emqx_router.erl:133-162)."""
+
+    DELTA_BATCH = 1024  # rows per apply_delta call (syncer batch size)
+
+    def __init__(self, table, mesh: Mesh, max_hits_per_block: int = 2048):
+        from . import mesh as mesh_mod
+
+        self.table = table
+        self.mesh = mesh
+        self._mesh_mod = mesh_mod
+        self._dev: Optional[EncodedFilters] = None
+        self._synced_capacity = 0
+        _mc, _mp, self._apply_delta = make_sharded_kernels(mesh)
+        self._match_ids_cache: dict = {}
+        self.default_mh = max_hits_per_block
+
+    def _match_kernel(self, mh: int):
+        k = self._match_ids_cache.get(mh)
+        if k is None:
+            k = make_match_ids_kernel(self.mesh, mh)
+            self._match_ids_cache[mh] = k
+        return k
+
+    def sync(self) -> int:
+        t = self.table
+        if self._dev is None or t.grew or t.capacity != self._synced_capacity:
+            n = len(t.dirty)
+            t.drain_dirty()
+            self._dev = self._mesh_mod.put_filters(t.snapshot(), self.mesh)
+            self._synced_capacity = t.capacity
+            return n
+        dirty = t.drain_dirty()  # ndarray: row id 0 alone is falsy —
+        if len(dirty) == 0:      # test LENGTH, never truthiness
+            return 0
+        import numpy as np
+
+        total = len(dirty)
+        arr = np.asarray(dirty, np.int32)
+        # ONE dispatch for the whole churn: pad to [n_b, K] (n_b pow2
+        # so recompiles stay log-bounded) and scan inside the kernel
+        k = self.DELTA_BATCH
+        n_b = 1 << max(0, -(-total // k) - 1).bit_length()  # pow2 ceil-div
+        idx = np.full(n_b * k, arr[-1], np.int32)
+        idx[:total] = arr
+        shape2 = (n_b, k)
+        self._dev = self._apply_delta(
+            self._dev,
+            jnp.asarray(idx.reshape(shape2)),
+            jnp.asarray(t.words[idx].reshape(shape2 + (t.max_levels,))),
+            jnp.asarray(t.prefix_len[idx].reshape(shape2)),
+            jnp.asarray(t.has_hash[idx].reshape(shape2)),
+            jnp.asarray(t.root_wild[idx].reshape(shape2)),
+            jnp.asarray(t.active[idx].reshape(shape2)),
+        )
+        return total
+
+    def match_ids(self, enc: EncodedTopics):
+        """All (topic, row) hit pairs for an encoded topic batch.
+        Returns (ti 1d, ri 1d) host arrays of equal length (valid pairs
+        only), escalating per-block capacity on overflow."""
+        import numpy as np
+
+        assert self._dev is not None, "sync() before matching"
+        t_dev = self._mesh_mod.put_topics(enc, self.mesh)
+        mh = self.default_mh
+        while True:
+            ti, ri, totals = self._match_kernel(mh)(self._dev, t_dev)
+            totals = np.asarray(totals)
+            if int(totals.max(initial=0)) <= mh:
+                break
+            mh = max(mh * 2, 1 << int(totals.max()).bit_length())
+        ti = np.asarray(ti).reshape(-1)
+        ri = np.asarray(ri).reshape(-1)
+        keep = ti >= 0
+        return ti[keep], ri[keep]

@@ -67,12 +67,12 @@ def test_sharded_apply_delta(mesh8):
     idx[len(dirty) :] = dirty[-1]
     f_dev = apply_delta(
         f_dev,
-        jnp.asarray(idx),
-        jnp.asarray(table.words[idx]),
-        jnp.asarray(table.prefix_len[idx]),
-        jnp.asarray(table.has_hash[idx]),
-        jnp.asarray(table.root_wild[idx]),
-        jnp.asarray(table.active[idx]),
+        jnp.asarray(idx.reshape(1, k)),
+        jnp.asarray(table.words[idx].reshape(1, k, -1)),
+        jnp.asarray(table.prefix_len[idx].reshape(1, k)),
+        jnp.asarray(table.has_hash[idx].reshape(1, k)),
+        jnp.asarray(table.root_wild[idx].reshape(1, k)),
+        jnp.asarray(table.active[idx].reshape(1, k)),
     )
 
     topics = ["a/0/x", "b/z", "a/5/x"]
@@ -106,3 +106,65 @@ def test_topic_padding(mesh8):
     counts = np.asarray(match_counts(f_dev, t_dev))
     assert list(counts[:3]) == [2, 2, 2]  # a/i/+ and a/#
     assert counts[3] == 0  # the pad row matches nothing
+
+
+# --- mesh-integrated broker path (VERDICT r1 item 5) --------------------
+
+
+def test_mesh_router_matches_oracle(mesh8):
+    from emqx_tpu.models.router import Router
+
+    r = Router(max_levels=4, mesh=mesh8)
+    for i in range(40):
+        r.add_route(f"a/{i}/+", f"c{i}")
+    r.add_route("a/#", "call")
+    r.add_route("b/exact", "cex")
+    topics = [f"a/{i}/x" for i in range(10)] + ["b/exact", "zzz"]
+    got = r.match_batch(topics)
+    # oracle: the single-topic host path
+    want = [r.match_routes(t) for t in topics]
+    assert got == want
+    # route churn flows through the shard_map delta scatter
+    r.delete_route("a/0/+", "c0")
+    r.add_route("new/+", "cn")
+    got2 = r.match_batch(["a/0/x", "new/y"])
+    assert got2 == [{"call"}, {"cn"}]
+
+
+def test_mesh_router_escalates_on_overflow(mesh8):
+    from emqx_tpu.models.router import Router
+
+    r = Router(max_levels=4, mesh=mesh8)
+    r.device_table.default_mh = 4  # force per-block overflow
+    for i in range(200):
+        r.add_route(f"w/{i}/#", f"c{i}")
+    got = r.match_batch(["w/5/x"])
+    assert got == [{"c5"}]
+    wide = r.match_batch([f"w/{i}/t" for i in range(64)])
+    assert all(g == {f"c{i}"} for i, g in enumerate(wide))
+
+
+def test_mesh_broker_publish_batch(mesh8):
+    """ClusterBroker.publish_batch end-to-end on the mesh router."""
+    from emqx_tpu.broker.message import Message
+    from emqx_tpu.broker.packet import SubOpts
+    from emqx_tpu.cluster.node import ClusterBroker
+    from emqx_tpu.models.router import Router
+
+    b = ClusterBroker()
+    b.router = Router(max_levels=8, mesh=mesh8)
+    outs = {}
+    for i in range(30):
+        s, _ = b.open_session(f"c{i}", True)
+        b.subscribe(s, f"room/{i}/+", SubOpts(qos=0))
+        outs[f"c{i}"] = []
+        s.outgoing_sink = outs[f"c{i}"].extend
+    s_all, _ = b.open_session("watcher", True)
+    b.subscribe(s_all, "room/#", SubOpts(qos=0))
+    outs["watcher"] = []
+    s_all.outgoing_sink = outs["watcher"].extend
+    msgs = [Message(topic=f"room/{i}/t", payload=b"x") for i in range(30)]
+    counts = b.publish_batch(msgs)
+    assert counts == [2] * 30  # per-room subscriber + watcher
+    assert all(len(outs[f"c{i}"]) == 1 for i in range(30))
+    assert len(outs["watcher"]) == 30
