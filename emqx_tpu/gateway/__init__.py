@@ -1,0 +1,93 @@
+"""Gateway framework: foreign-protocol front ends over the broker core.
+
+The reference's gateway app (apps/emqx_gateway) provides a registry of
+protocol implementations, per-gateway instance supervision, and the
+emqx_gateway_impl behaviour (on_gateway_load/update/unload,
+apps/emqx_gateway/src/bhvrs/emqx_gateway_impl.erl:27-48); each protocol
+app ships its own frame codec + channel and maps sessions onto broker
+pubsub. Here a GatewayImpl subclass owns its listener(s) and speaks to
+the shared Broker; the registry loads/unloads named instances with
+per-gateway config (mountpoint, bind, ...).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Type
+
+from .base import GatewayImpl
+
+
+class GatewayRegistry:
+    """Type registry + running-instance manager
+    (emqx_gateway_registry + emqx_gateway_sup analog)."""
+
+    def __init__(self, broker):
+        self.broker = broker
+        self._types: Dict[str, Type[GatewayImpl]] = {}
+        self._running: Dict[str, GatewayImpl] = {}
+        from .stomp import StompGateway
+        from .mqttsn import MqttSnGateway
+
+        self.register_type("stomp", StompGateway)
+        self.register_type("mqttsn", MqttSnGateway)
+
+    def register_type(self, name: str, impl: Type[GatewayImpl]) -> None:
+        self._types[name] = impl
+
+    def types(self):
+        return sorted(self._types)
+
+    async def load(self, name: str, conf: Optional[dict] = None) -> GatewayImpl:
+        if name in self._running:
+            raise ValueError(f"gateway {name} already loaded")
+        impl = self._types.get(name)
+        if impl is None:
+            raise KeyError(f"unknown gateway type {name}")
+        gw = impl(self.broker, conf or {})
+        await gw.on_load()
+        self._running[name] = gw
+        return gw
+
+    async def update(self, name: str, conf: dict) -> GatewayImpl:
+        """Restart with new config; a failed start rolls back to the
+        previous config so a typo doesn't become an outage."""
+        old = self._running.get(name)
+        old_conf = dict(old.conf) if old is not None else None
+        await self.unload(name)
+        try:
+            return await self.load(name, conf)
+        except Exception:
+            if old_conf is not None:
+                try:
+                    await self.load(name, old_conf)
+                except Exception:
+                    pass
+            raise
+
+    async def unload(self, name: str) -> bool:
+        gw = self._running.pop(name, None)
+        if gw is None:
+            return False
+        await gw.on_unload()
+        return True
+
+    def get(self, name: str) -> Optional[GatewayImpl]:
+        return self._running.get(name)
+
+    def status(self) -> list:
+        return [
+            {
+                "name": name,
+                "status": "running",
+                "current_connections": gw.connection_count(),
+                "listeners": gw.listener_info(),
+            }
+            for name, gw in sorted(self._running.items())
+        ]
+
+    async def unload_all(self) -> None:
+        for name in list(self._running):
+            await self.unload(name)
+
+
+__all__ = ["GatewayImpl", "GatewayRegistry"]

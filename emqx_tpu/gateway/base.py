@@ -1,0 +1,83 @@
+"""Gateway behaviour + shared session glue.
+
+GatewayImpl is the emqx_gateway_impl analog (on_gateway_load/unload,
+apps/emqx_gateway/src/bhvrs/emqx_gateway_impl.erl:27-48). The session
+glue opens ordinary broker sessions (the gateway CM of
+emqx_gateway_cm) with the gateway's mountpoint applied, so foreign
+protocols interoperate with MQTT clients through the same pubsub core.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..broker.message import Message
+from ..broker.packet import SubOpts
+from ..broker.session import SessionConfig
+
+
+class GatewayImpl:
+    """One loaded gateway instance. Subclasses implement the protocol
+    listener(s) and frame handling."""
+
+    name = "?"
+
+    def __init__(self, broker, conf: dict):
+        self.broker = broker
+        self.conf = conf
+        self.mountpoint = conf.get("mountpoint", "")
+
+    async def on_load(self) -> None:
+        raise NotImplementedError
+
+    async def on_unload(self) -> None:
+        raise NotImplementedError
+
+    def connection_count(self) -> int:
+        return 0
+
+    def listener_info(self) -> List[dict]:
+        return []
+
+    # --- session glue (emqx_gateway_cm-lite) ----------------------------
+
+    def open_session(self, client_id: str, clean_start: bool = True):
+        cid = f"{self.name}-{client_id}"
+        session, present = self.broker.open_session(
+            cid, clean_start, SessionConfig()
+        )
+        session.mountpoint = self.mountpoint
+        self.broker.hooks.run("client.connected", cid, 0, self.name)
+        return session, present
+
+    def close_session(self, session) -> None:
+        if session is not None:
+            self.broker.hooks.run(
+                "client.disconnected", session.client_id, "closed"
+            )
+            self.broker.close_session(session)
+
+    def publish(self, session, topic: str, payload: bytes, qos: int = 0,
+                retain: bool = False) -> int:
+        return self.broker.publish(
+            Message(
+                topic=self.mountpoint + topic,
+                payload=payload,
+                qos=qos,
+                retain=retain,
+                from_client=session.client_id,
+            )
+        )
+
+    def subscribe(self, session, flt: str, qos: int = 0):
+        return self.broker.subscribe(
+            session, self.mountpoint + flt, SubOpts(qos=qos)
+        )
+
+    def unsubscribe(self, session, flt: str) -> bool:
+        return self.broker.unsubscribe(session, self.mountpoint + flt)
+
+    def unmount(self, topic: str) -> str:
+        if self.mountpoint and topic.startswith(self.mountpoint):
+            return topic[len(self.mountpoint):]
+        return topic

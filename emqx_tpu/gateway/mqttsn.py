@@ -1,0 +1,354 @@
+"""MQTT-SN gateway: the sensor-network binary protocol over UDP.
+
+Parity with apps/emqx_gateway_mqttsn: frame codec
+(emqx_mqttsn_frame.erl — 1-or-3-byte length, msg type, flags with
+topic-id-type 0/1/2) and the topic registry (emqx_mqttsn_registry.erl
+— per-client REGISTER'd ids plus configured predefined ids). Each UDP
+peer address is one session; QoS0/1 map straight onto broker pubsub,
+and deliveries to unregistered topic names REGISTER first, exactly the
+reference's outbound flow.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import struct
+from typing import Dict, List, Optional, Tuple
+
+from .base import GatewayImpl
+
+log = logging.getLogger("emqx_tpu.gateway.mqttsn")
+
+# message types (MQTT-SN 1.2 spec §5.2.2; emqx_mqttsn_frame.erl)
+CONNECT = 0x04
+CONNACK = 0x05
+REGISTER = 0x0A
+REGACK = 0x0B
+PUBLISH = 0x0C
+PUBACK = 0x0D
+SUBSCRIBE = 0x12
+SUBACK = 0x13
+UNSUBSCRIBE = 0x14
+UNSUBACK = 0x15
+PINGREQ = 0x16
+PINGRESP = 0x17
+DISCONNECT = 0x18
+
+RC_ACCEPTED = 0x00
+RC_INVALID_TOPIC_ID = 0x02
+RC_NOT_SUPPORTED = 0x03
+
+# flags
+FLAG_RETAIN = 0x10
+FLAG_CLEAN = 0x04
+TOPIC_NORMAL = 0x00  # registered numeric id
+TOPIC_PREDEF = 0x01
+TOPIC_SHORT = 0x02  # 2-char name carried in the id field
+
+
+def encode(msg_type: int, payload: bytes) -> bytes:
+    n = len(payload) + 2
+    if n < 256:
+        return bytes([n, msg_type]) + payload
+    return b"\x01" + struct.pack(">H", n + 2)[0:2] + bytes([msg_type]) + payload
+
+
+def decode(data: bytes) -> Tuple[int, bytes]:
+    if not data:
+        raise ValueError("empty datagram")
+    if data[0] == 0x01:
+        if len(data) < 4:
+            raise ValueError("short frame")
+        (n,) = struct.unpack(">H", data[1:3])
+        if len(data) < n:
+            raise ValueError("truncated frame")
+        return data[3], data[4:n]
+    n = data[0]
+    if len(data) < n or n < 2:
+        raise ValueError("truncated frame")
+    return data[1], data[2:n]
+
+
+def qos_of(flags: int) -> int:
+    q = (flags >> 5) & 0x3
+    return 0 if q == 3 else q  # qos=-1 (0b11) treated as 0
+
+
+class SnPeer:
+    """One UDP peer: its broker session + topic-id registry."""
+
+    def __init__(self) -> None:
+        self.session = None
+        self.topic_by_id: Dict[int, str] = {}
+        self.id_by_topic: Dict[str, int] = {}
+        self._next_id = 1
+        # outbound-register handshake: msgid -> (topic, payload, flags)
+        self.pending_reg: Dict[int, Tuple[str, bytes, int]] = {}
+        self._next_msgid = 1
+
+    def assign_id(self, topic: str) -> int:
+        tid = self.id_by_topic.get(topic)
+        if tid is None:
+            tid = self._next_id
+            self._next_id += 1
+            self.id_by_topic[topic] = tid
+            self.topic_by_id[tid] = topic
+        return tid
+
+    def next_msgid(self) -> int:
+        m = self._next_msgid
+        self._next_msgid = m % 0xFFFF + 1
+        return m
+
+
+class _SnProtocol(asyncio.DatagramProtocol):
+    def __init__(self, gw: "MqttSnGateway"):
+        self.gw = gw
+
+    def connection_made(self, transport) -> None:
+        self.gw._transport = transport
+
+    def datagram_received(self, data: bytes, addr) -> None:
+        try:
+            self.gw.handle_datagram(data, addr)
+        except ValueError as e:
+            log.debug("bad mqttsn datagram from %s: %s", addr, e)
+        except Exception:
+            log.exception("mqttsn datagram crashed")
+
+
+class MqttSnGateway(GatewayImpl):
+    name = "mqttsn"
+
+    def __init__(self, broker, conf: dict):
+        super().__init__(broker, conf)
+        # predefined topics: {id(int): topic} (emqx_mqttsn_registry)
+        self.predefined: Dict[int, str] = {
+            int(k): v for k, v in (conf.get("predefined") or {}).items()
+        }
+        self._transport = None
+        self.peers: Dict[tuple, SnPeer] = {}
+        self.listen_addr = None
+
+    async def on_load(self) -> None:
+        from ..broker.listeners import parse_bind
+
+        host, port = parse_bind(self.conf.get("bind", "0.0.0.0:1884"))
+        loop = asyncio.get_running_loop()
+        self._transport, _ = await loop.create_datagram_endpoint(
+            lambda: _SnProtocol(self), local_addr=(host, port)
+        )
+        self.listen_addr = self._transport.get_extra_info("sockname")[:2]
+        log.info("mqttsn gateway on %s", self.listen_addr)
+
+    async def on_unload(self) -> None:
+        for addr in list(self.peers):
+            self._drop_peer(addr)
+        if self._transport is not None:
+            self._transport.close()
+            self._transport = None
+
+    def connection_count(self) -> int:
+        return len(self.peers)
+
+    def listener_info(self) -> List[dict]:
+        return (
+            [{"type": "udp", "bind": f"{self.listen_addr[0]}:{self.listen_addr[1]}"}]
+            if self.listen_addr
+            else []
+        )
+
+    # --- datagram handling ----------------------------------------------
+
+    def _send(self, addr, msg_type: int, payload: bytes) -> None:
+        if self._transport is not None:
+            self._transport.sendto(encode(msg_type, payload), addr)
+
+    def _drop_peer(self, addr) -> None:
+        peer = self.peers.pop(addr, None)
+        if peer is not None and peer.session is not None:
+            self.close_session(peer.session)
+
+    def handle_datagram(self, data: bytes, addr) -> None:
+        msg_type, body = decode(data)
+        if msg_type == CONNECT:
+            self._on_connect(body, addr)
+            return
+        peer = self.peers.get(addr)
+        if peer is None or peer.session is None:
+            return  # not connected: ignore (reference drops too)
+        if msg_type == REGISTER:
+            tid_req, msgid = struct.unpack(">HH", body[:4])
+            topic = body[4:].decode("utf-8", "replace")
+            tid = peer.assign_id(topic)
+            self._send(addr, REGACK, struct.pack(">HHB", tid, msgid, RC_ACCEPTED))
+        elif msg_type == REGACK:
+            tid, msgid, rc = struct.unpack(">HHB", body[:5])
+            pend = peer.pending_reg.pop(msgid, None)
+            if pend is not None and rc == RC_ACCEPTED:
+                topic, payload, flags = pend
+                self._publish_out(addr, peer, topic, payload, flags)
+        elif msg_type == PUBLISH:
+            self._on_publish(body, addr, peer)
+        elif msg_type == PUBACK:
+            pass  # qos1 outbound ack (at-most-once mapping per send)
+        elif msg_type == SUBSCRIBE:
+            self._on_subscribe(body, addr, peer)
+        elif msg_type == UNSUBSCRIBE:
+            self._on_unsubscribe(body, addr, peer)
+        elif msg_type == PINGREQ:
+            self._send(addr, PINGRESP, b"")
+        elif msg_type == DISCONNECT:
+            self._send(addr, DISCONNECT, b"")
+            self._drop_peer(addr)
+
+    def _on_connect(self, body: bytes, addr) -> None:
+        if len(body) < 4:
+            raise ValueError("short CONNECT")
+        flags = body[0]
+        client_id = body[4:].decode("utf-8", "replace") or f"sn-{addr[1]}"
+        # the SAME authenticate chain every other front end runs — an
+        # installed auth provider must gate UDP peers too
+        ok = self.broker.hooks.run_fold(
+            "client.authenticate",
+            (dict(client_id=f"{self.name}-{client_id}", username=None,
+                  password=None, peer=f"{addr[0]}:{addr[1]}"),),
+            True,
+        )
+        if ok is not True:
+            self._send(addr, CONNACK, bytes([RC_NOT_SUPPORTED]))
+            return
+        self._drop_peer(addr)  # re-connect replaces the old session
+        peer = SnPeer()
+        session, _ = self.open_session(client_id, bool(flags & FLAG_CLEAN))
+        peer.session = session
+        session.outgoing_sink = lambda pkts, a=addr: self._deliver(a, pkts)
+        self.peers[addr] = peer
+        self._send(addr, CONNACK, bytes([RC_ACCEPTED]))
+
+    def _resolve_topic(self, peer: SnPeer, tid_type: int, tid: int) -> Optional[str]:
+        if tid_type == TOPIC_NORMAL:
+            return peer.topic_by_id.get(tid)
+        if tid_type == TOPIC_PREDEF:
+            return self.predefined.get(tid)
+        if tid_type == TOPIC_SHORT:
+            return struct.pack(">H", tid).decode("utf-8", "replace")
+        return None
+
+    def _on_publish(self, body: bytes, addr, peer: SnPeer) -> None:
+        flags = body[0]
+        tid, msgid = struct.unpack(">HH", body[1:5])
+        payload = body[5:]
+        topic = self._resolve_topic(peer, flags & 0x3, tid)
+        # QoS2 would need the 4-way handshake; clamp to 1 so the client
+        # gets its PUBACK instead of retransmitting forever (docstring:
+        # QoS0/1 mapping)
+        qos = min(qos_of(flags), 1)
+        if topic is None:
+            if qos == 1:
+                self._send(
+                    addr, PUBACK, struct.pack(">HHB", tid, msgid, RC_INVALID_TOPIC_ID)
+                )
+            return
+        self.publish(
+            peer.session, topic, payload, qos=qos, retain=bool(flags & FLAG_RETAIN)
+        )
+        if qos == 1 or qos_of(flags) == 2:
+            self._send(addr, PUBACK, struct.pack(">HHB", tid, msgid, RC_ACCEPTED))
+
+    def _on_subscribe(self, body: bytes, addr, peer: SnPeer) -> None:
+        flags = body[0]
+        (msgid,) = struct.unpack(">H", body[1:3])
+        tid_type = flags & 0x3
+        qos = qos_of(flags)
+        tid = 0
+        if tid_type == TOPIC_NORMAL:  # topic NAME (possibly wildcard)
+            topic = body[3:].decode("utf-8", "replace")
+            if "+" not in topic and "#" not in topic:
+                tid = peer.assign_id(topic)
+        else:
+            (raw,) = struct.unpack(">H", body[3:5])
+            topic = self._resolve_topic(peer, tid_type, raw)
+            tid = raw
+            if topic is None:
+                self._send(
+                    addr, SUBACK,
+                    struct.pack(">BHHB", flags, 0, msgid, RC_INVALID_TOPIC_ID),
+                )
+                return
+        try:
+            retained = self.subscribe(peer.session, topic, qos=qos)
+        except ValueError:
+            self._send(
+                addr, SUBACK,
+                struct.pack(">BHHB", flags, 0, msgid, RC_NOT_SUPPORTED),
+            )
+            return
+        self._send(
+            addr, SUBACK, struct.pack(">BHHB", flags, tid, msgid, RC_ACCEPTED)
+        )
+        for m in retained:
+            self._deliver_one(addr, peer, self.unmount(m.topic), m.payload, 0)
+
+    def _on_unsubscribe(self, body: bytes, addr, peer: SnPeer) -> None:
+        flags = body[0]
+        (msgid,) = struct.unpack(">H", body[1:3])
+        tid_type = flags & 0x3
+        if tid_type == TOPIC_NORMAL:
+            topic = body[3:].decode("utf-8", "replace")
+        else:
+            (raw,) = struct.unpack(">H", body[3:5])
+            topic = self._resolve_topic(peer, tid_type, raw)
+        if topic is not None:
+            self.unsubscribe(peer.session, topic)
+        self._send(addr, UNSUBACK, struct.pack(">H", msgid))
+
+    # --- delivery (broker -> SN PUBLISH) --------------------------------
+
+    def _deliver(self, addr, pkts) -> None:
+        peer = self.peers.get(addr)
+        if peer is None:
+            return
+        for p in pkts:
+            self._deliver_one(
+                addr, peer, self.unmount(p.topic), p.payload, p.qos
+            )
+
+    def _deliver_one(
+        self, addr, peer: SnPeer, topic: str, payload: bytes, qos: int
+    ) -> None:
+        short = topic.encode()
+        if len(topic) == 2 and len(short) == 2:  # non-ASCII 2-char names
+            tid = struct.unpack(">H", short)[0]  # are NOT short topics
+            self._publish_out_raw(addr, peer, TOPIC_SHORT, tid, payload, qos)
+            return
+        tid = peer.id_by_topic.get(topic)
+        if tid is None:
+            # REGISTER-then-PUBLISH (emqx_mqttsn outbound register flow)
+            tid = peer.assign_id(topic)
+            msgid = peer.next_msgid()
+            peer.pending_reg[msgid] = (topic, payload, qos << 5)
+            self._send(
+                addr, REGISTER,
+                struct.pack(">HH", tid, msgid) + topic.encode(),
+            )
+            return
+        self._publish_out_raw(addr, peer, TOPIC_NORMAL, tid, payload, qos)
+
+    def _publish_out(self, addr, peer: SnPeer, topic: str, payload: bytes,
+                     flags: int) -> None:
+        tid = peer.id_by_topic[topic]
+        self._publish_out_raw(
+            addr, peer, TOPIC_NORMAL, tid, payload, (flags >> 5) & 0x3
+        )
+
+    def _publish_out_raw(
+        self, addr, peer: SnPeer, tid_type: int, tid: int, payload: bytes,
+        qos: int,
+    ) -> None:
+        flags = (qos << 5) | tid_type
+        msgid = peer.next_msgid() if qos else 0
+        self._send(
+            addr, PUBLISH, bytes([flags]) + struct.pack(">HH", tid, msgid) + payload
+        )

@@ -1,0 +1,321 @@
+"""STOMP 1.2 gateway: text frames over TCP mapped onto broker pubsub.
+
+Parity with apps/emqx_gateway_stomp: frame codec
+(emqx_stomp_frame.erl — COMMAND / header lines / blank / body / NUL,
+header value escaping, content-length bodies) and channel semantics
+(emqx_stomp_channel.erl — CONNECT/STOMP -> CONNECTED, SEND -> publish,
+SUBSCRIBE id+destination -> MESSAGE frames, RECEIPT on request, ERROR
++ close on protocol violations).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Dict, List, Optional, Tuple
+
+from .base import GatewayImpl
+
+log = logging.getLogger("emqx_tpu.gateway.stomp")
+
+MAX_FRAME = 1 << 20
+
+_ESC = {"\\n": "\n", "\\r": "\r", "\\c": ":", "\\\\": "\\"}
+
+
+def _unescape(s: str) -> str:
+    out = []
+    i = 0
+    while i < len(s):
+        if s[i] == "\\" and i + 1 < len(s):
+            out.append(_ESC.get(s[i : i + 2], s[i : i + 2]))
+            i += 2
+        else:
+            out.append(s[i])
+            i += 1
+    return "".join(out)
+
+
+def _escape(s: str) -> str:
+    return (
+        s.replace("\\", "\\\\").replace("\r", "\\r")
+        .replace("\n", "\\n").replace(":", "\\c")
+    )
+
+
+class StompFrame:
+    def __init__(self, command: str, headers: Optional[Dict[str, str]] = None,
+                 body: bytes = b""):
+        self.command = command
+        self.headers = headers or {}
+        self.body = body
+
+    def encode(self) -> bytes:
+        lines = [self.command]
+        for k, v in self.headers.items():
+            lines.append(f"{_escape(k)}:{_escape(str(v))}")
+        head = ("\n".join(lines) + "\n\n").encode()
+        return head + self.body + b"\x00"
+
+
+class StompParser:
+    """Incremental parser; CONNECT/CONNECTED headers are not unescaped
+    (STOMP 1.2 spec), all other frames are."""
+
+    def __init__(self) -> None:
+        self._buf = bytearray()
+
+    def feed(self, data: bytes) -> List[StompFrame]:
+        self._buf += data
+        if len(self._buf) > MAX_FRAME:
+            raise ValueError("frame too large")
+        out = []
+        while True:
+            f = self._try_one()
+            if f is None:
+                return out
+            out.append(f)
+
+    def _try_one(self) -> Optional[StompFrame]:
+        buf = self._buf
+        # skip heart-beat EOLs between frames
+        i = 0
+        while i < len(buf) and buf[i] in (0x0A, 0x0D):
+            i += 1
+        del buf[:i]
+        if not buf:
+            return None
+        # header block ends at the first blank line — LF or CRLF framed
+        lf = buf.find(b"\n\n")
+        crlf = buf.find(b"\r\n\r\n")
+        if lf < 0 and crlf < 0:
+            return None
+        if crlf >= 0 and (lf < 0 or crlf < lf):
+            head_end, body_start = crlf, crlf + 4
+        else:
+            head_end, body_start = lf, lf + 2
+        head = buf[:head_end].decode("utf-8", "replace").split("\n")
+        command = head[0].rstrip("\r")
+        headers: Dict[str, str] = {}
+        raw = command in ("CONNECT", "CONNECTED")
+        for ln in head[1:]:
+            ln = ln.rstrip("\r")
+            if ":" not in ln:
+                raise ValueError(f"bad header line {ln!r}")
+            k, v = ln.split(":", 1)
+            if not raw:
+                k, v = _unescape(k), _unescape(v)
+            headers.setdefault(k, v)  # first occurrence wins (spec)
+        cl = headers.get("content-length")
+        if cl is not None:
+            n = int(cl)
+            if n < 0 or n > MAX_FRAME:
+                raise ValueError("bad content-length")
+            if len(buf) < body_start + n + 1:
+                return None
+            if buf[body_start + n] != 0:
+                raise ValueError("missing NUL after sized body")
+            body = bytes(buf[body_start : body_start + n])
+            del buf[: body_start + n + 1]
+        else:
+            nul = buf.find(b"\x00", body_start)
+            if nul < 0:
+                return None
+            body = bytes(buf[body_start:nul])
+            del buf[: nul + 1]
+        return StompFrame(command, headers, body)
+
+
+class StompConnection:
+    def __init__(self, gw: "StompGateway", reader, writer):
+        self.gw = gw
+        self.reader = reader
+        self.writer = writer
+        self.parser = StompParser()
+        self.session = None
+        self._subs: Dict[str, str] = {}  # sub id -> destination
+        self._msg_seq = 0
+
+    def send(self, frame: StompFrame) -> None:
+        try:
+            self.writer.write(frame.encode() + b"\n")
+        except Exception:
+            pass
+
+    def _receipt(self, headers: Dict[str, str]) -> None:
+        rid = headers.get("receipt")
+        if rid is not None:
+            self.send(StompFrame("RECEIPT", {"receipt-id": rid}))
+
+    def _error(self, msg: str) -> None:
+        self.send(StompFrame("ERROR", {"message": msg}))
+
+    async def run(self) -> None:
+        try:
+            while True:
+                data = await self.reader.read(65536)
+                if not data:
+                    break
+                for frame in self.parser.feed(data):
+                    if not self._handle(frame):
+                        return
+                await self.writer.drain()
+        except (ValueError, ConnectionError) as e:
+            self._error(str(e))
+        except Exception:
+            log.exception("stomp connection crashed")
+        finally:
+            self.gw.close_session(self.session)
+            self.session = None
+            try:
+                self.writer.close()
+            except Exception:
+                pass
+
+    def _handle(self, f: StompFrame) -> bool:
+        cmd = f.command
+        if self.session is None:
+            if cmd not in ("CONNECT", "STOMP"):
+                self._error("not connected")
+                return False
+            login = f.headers.get("login", "")
+            cid = login or f"anon-{id(self):x}"
+            ok = self.gw.broker.hooks.run_fold(
+                "client.authenticate",
+                (dict(client_id=f"stomp-{cid}", username=login or None,
+                      password=(f.headers.get("passcode") or "").encode(),
+                      peer="stomp"),),
+                True,
+            )
+            if ok is not True:
+                self._error("auth failed")
+                return False
+            self.session, _ = self.gw.open_session(cid)
+            self.session.outgoing_sink = self._deliver
+            self.send(
+                StompFrame(
+                    "CONNECTED",
+                    {"version": "1.2", "server": "emqx-tpu",
+                     "heart-beat": "0,0"},
+                )
+            )
+            return True
+        if cmd == "SEND":
+            dest = f.headers.get("destination")
+            if not dest:
+                self._error("SEND without destination")
+                return False
+            self.gw.publish(self.session, dest, f.body)
+            self._receipt(f.headers)
+            return True
+        if cmd == "SUBSCRIBE":
+            sid = f.headers.get("id")
+            dest = f.headers.get("destination")
+            if not sid or not dest:
+                self._error("SUBSCRIBE needs id and destination")
+                return False
+            # re-SUBSCRIBE with the same id replaces the old
+            # destination — release its route or it leaks
+            old = self._subs.get(sid)
+            if old is not None and old != dest:
+                self.gw.unsubscribe(self.session, old)
+            self._subs[sid] = dest
+            retained = self.gw.subscribe(self.session, dest)
+            self._receipt(f.headers)
+            for m in retained:
+                self._deliver_msg(m.topic, m.payload)
+            return True
+        if cmd == "UNSUBSCRIBE":
+            sid = f.headers.get("id")
+            dest = self._subs.pop(sid or "", None)
+            if dest is not None:
+                self.gw.unsubscribe(self.session, dest)
+            self._receipt(f.headers)
+            return True
+        if cmd in ("ACK", "NACK"):
+            return True  # deliveries are at-most-once (qos0 mapping)
+        if cmd == "DISCONNECT":
+            self._receipt(f.headers)
+            return False
+        self._error(f"unsupported command {cmd}")
+        return False
+
+    # --- delivery (broker -> STOMP MESSAGE) -----------------------------
+
+    def _deliver(self, pkts) -> None:
+        for p in pkts:
+            self._deliver_msg(p.topic, p.payload)
+
+    def _deliver_msg(self, topic: str, payload: bytes) -> None:
+        topic = self.gw.unmount(topic)
+        sub_id = next(
+            (sid for sid, d in self._subs.items()
+             if self._dest_matches(d, topic)),
+            None,
+        )
+        self._msg_seq += 1
+        self.send(
+            StompFrame(
+                "MESSAGE",
+                {
+                    "subscription": sub_id or "0",
+                    "message-id": str(self._msg_seq),
+                    "destination": topic,
+                    "content-length": str(len(payload)),
+                },
+                payload,
+            )
+        )
+
+    @staticmethod
+    def _dest_matches(dest: str, topic: str) -> bool:
+        from ..ops import topic as topic_mod
+
+        return topic_mod.match(topic_mod.words(topic), topic_mod.words(dest))
+
+
+class StompGateway(GatewayImpl):
+    name = "stomp"
+
+    def __init__(self, broker, conf: dict):
+        super().__init__(broker, conf)
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._conns: set = set()
+        self.listen_addr = None
+
+    async def on_load(self) -> None:
+        from ..broker.listeners import parse_bind
+
+        host, port = parse_bind(self.conf.get("bind", "0.0.0.0:61613"))
+        self._server = await asyncio.start_server(self._on_client, host, port)
+        self.listen_addr = self._server.sockets[0].getsockname()[:2]
+        log.info("stomp gateway on %s", self.listen_addr)
+
+    async def on_unload(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            for c in list(self._conns):
+                try:
+                    c.writer.close()
+                except Exception:
+                    pass
+            await self._server.wait_closed()
+            self._server = None
+
+    async def _on_client(self, reader, writer) -> None:
+        conn = StompConnection(self, reader, writer)
+        self._conns.add(conn)
+        try:
+            await conn.run()
+        finally:
+            self._conns.discard(conn)
+
+    def connection_count(self) -> int:
+        return len(self._conns)
+
+    def listener_info(self) -> List[dict]:
+        return (
+            [{"type": "tcp", "bind": f"{self.listen_addr[0]}:{self.listen_addr[1]}"}]
+            if self.listen_addr
+            else []
+        )

@@ -1,0 +1,268 @@
+"""Gateway framework + STOMP + MQTT-SN e2e (real TCP/UDP sockets).
+
+Refs: apps/emqx_gateway/src/bhvrs/emqx_gateway_impl.erl:27-48,
+emqx_stomp_frame.erl / emqx_stomp_channel.erl,
+emqx_mqttsn_frame.erl / emqx_mqttsn_registry.erl.
+"""
+
+import asyncio
+import struct
+
+import pytest
+
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.packet import SubOpts
+from emqx_tpu.broker.pubsub import Broker
+from emqx_tpu.gateway import GatewayRegistry
+from emqx_tpu.gateway import mqttsn as sn
+from emqx_tpu.gateway.stomp import StompFrame, StompParser
+
+
+# --- frame codecs --------------------------------------------------------
+
+
+def test_stomp_frame_roundtrip():
+    p = StompParser()
+    f = StompFrame("SEND", {"destination": "a:b\nc", "receipt": "r1"}, b"hello")
+    got = p.feed(f.encode())
+    assert len(got) == 1
+    g = got[0]
+    assert g.command == "SEND" and g.body == b"hello"
+    assert g.headers["destination"] == "a:b\nc"  # escaping survived
+    # partial feed
+    data = StompFrame("SUBSCRIBE", {"id": "0", "destination": "t"}).encode()
+    assert p.feed(data[:5]) == []
+    assert p.feed(data[5:])[0].command == "SUBSCRIBE"
+
+
+def test_stomp_content_length_body_with_nul():
+    body = b"bin\x00ary"
+    f = StompFrame("SEND", {"destination": "d",
+                            "content-length": str(len(body))}, body)
+    got = StompParser().feed(f.encode())
+    assert got[0].body == body
+
+
+def test_mqttsn_frame_roundtrip():
+    w = sn.encode(sn.PUBLISH, b"\x00" + struct.pack(">HH", 3, 7) + b"pay")
+    t, body = sn.decode(w)
+    assert t == sn.PUBLISH
+    assert body[1:5] == struct.pack(">HH", 3, 7) and body[5:] == b"pay"
+    big = sn.encode(sn.PUBLISH, b"x" * 300)
+    t2, body2 = sn.decode(big)
+    assert t2 == sn.PUBLISH and len(body2) == 300
+
+
+# --- registry lifecycle --------------------------------------------------
+
+
+async def test_registry_load_unload():
+    b = Broker()
+    reg = GatewayRegistry(b)
+    assert set(reg.types()) >= {"stomp", "mqttsn"}
+    gw = await reg.load("stomp", {"bind": "127.0.0.1:0"})
+    assert reg.get("stomp") is gw
+    st = reg.status()
+    assert st[0]["name"] == "stomp" and st[0]["listeners"]
+    with pytest.raises(ValueError):
+        await reg.load("stomp")
+    assert await reg.unload("stomp")
+    assert not await reg.unload("stomp")
+    await reg.unload_all()
+
+
+# --- STOMP e2e -----------------------------------------------------------
+
+
+class StompClient:
+    def __init__(self, r, w):
+        self.r, self.w = r, w
+        self.parser = StompParser()
+        self.frames = []
+
+    @classmethod
+    async def connect(cls, host, port, login=""):
+        r, w = await asyncio.open_connection(host, port)
+        c = cls(r, w)
+        c.send(StompFrame("CONNECT", {"accept-version": "1.2", "login": login}))
+        got = await c.recv("CONNECTED")
+        assert got.headers["version"] == "1.2"
+        return c
+
+    def send(self, f):
+        self.w.write(f.encode())
+
+    async def recv(self, command, timeout=5.0):
+        while not any(f.command == command for f in self.frames):
+            data = await asyncio.wait_for(self.r.read(4096), timeout)
+            if not data:
+                raise ConnectionError("closed")
+            self.frames += self.parser.feed(data)
+        out = [f for f in self.frames if f.command == command][0]
+        self.frames.remove(out)
+        return out
+
+
+async def test_stomp_pubsub_interop():
+    b = Broker()
+    reg = GatewayRegistry(b)
+    gw = await reg.load("stomp", {"bind": "127.0.0.1:0"})
+    host, port = gw.listen_addr
+    c1 = await StompClient.connect(host, port, login="alice")
+    c1.send(StompFrame("SUBSCRIBE", {"id": "7", "destination": "chat/+",
+                                     "receipt": "s1"}))
+    await c1.recv("RECEIPT")
+    # MQTT-side subscriber sees STOMP SENDs
+    outs = []
+    s, _ = b.open_session("mqttc", True)
+    b.subscribe(s, "chat/#", SubOpts())
+    s.outgoing_sink = outs.extend
+    c2 = await StompClient.connect(host, port, login="bob")
+    c2.send(StompFrame("SEND", {"destination": "chat/room1"}, b"hi from stomp"))
+    msg = await c1.recv("MESSAGE")
+    assert msg.headers["destination"] == "chat/room1"
+    assert msg.headers["subscription"] == "7"
+    assert msg.body == b"hi from stomp"
+    assert outs and outs[0].payload == b"hi from stomp"
+    # MQTT publish reaches the STOMP subscriber
+    b.publish(Message(topic="chat/room2", payload=b"from mqtt"))
+    msg2 = await c1.recv("MESSAGE")
+    assert msg2.body == b"from mqtt"
+    # unsubscribe stops delivery
+    c1.send(StompFrame("UNSUBSCRIBE", {"id": "7", "receipt": "u1"}))
+    await c1.recv("RECEIPT")
+    assert b.publish(Message(topic="chat/room1", payload=b"x")) == 1  # only mqttc
+    await reg.unload_all()
+
+
+async def test_stomp_mountpoint_isolation():
+    b = Broker()
+    reg = GatewayRegistry(b)
+    gw = await reg.load("stomp", {"bind": "127.0.0.1:0", "mountpoint": "gw/"})
+    host, port = gw.listen_addr
+    c = await StompClient.connect(host, port)
+    c.send(StompFrame("SUBSCRIBE", {"id": "1", "destination": "t",
+                                    "receipt": "r"}))
+    await c.recv("RECEIPT")
+    assert b.publish(Message(topic="t", payload=b"nope")) == 0  # outside ns
+    b.publish(Message(topic="gw/t", payload=b"yes"))
+    m = await c.recv("MESSAGE")
+    assert m.headers["destination"] == "t" and m.body == b"yes"
+    await reg.unload_all()
+
+
+# --- MQTT-SN e2e ---------------------------------------------------------
+
+
+class SnClient(asyncio.DatagramProtocol):
+    def __init__(self):
+        self.inbox = asyncio.Queue()
+        self.transport = None
+
+    def connection_made(self, transport):
+        self.transport = transport
+
+    def datagram_received(self, data, addr):
+        self.inbox.put_nowait(sn.decode(data))
+
+    def send(self, msg_type, payload):
+        self.transport.sendto(sn.encode(msg_type, payload))
+
+    async def recv(self, want, timeout=5.0):
+        while True:
+            t, body = await asyncio.wait_for(self.inbox.get(), timeout)
+            if t == want:
+                return body
+
+
+async def test_mqttsn_pubsub_interop():
+    b = Broker()
+    reg = GatewayRegistry(b)
+    gw = await reg.load(
+        "mqttsn", {"bind": "127.0.0.1:0", "predefined": {1: "sensors/pre"}}
+    )
+    loop = asyncio.get_running_loop()
+    t1, c1 = await loop.create_datagram_endpoint(
+        SnClient, remote_addr=gw.listen_addr
+    )
+    c1.send(sn.CONNECT, bytes([sn.FLAG_CLEAN, 0x01, 0, 60]) + b"dev1")
+    assert (await c1.recv(sn.CONNACK))[0] == sn.RC_ACCEPTED
+    # subscribe by topic NAME with wildcard
+    c1.send(sn.SUBSCRIBE, bytes([0]) + struct.pack(">H", 1) + b"sensors/+")
+    sub = await c1.recv(sn.SUBACK)
+    assert sub[5] == sn.RC_ACCEPTED
+    # register + publish from a second SN client
+    t2, c2 = await loop.create_datagram_endpoint(
+        SnClient, remote_addr=gw.listen_addr
+    )
+    c2.send(sn.CONNECT, bytes([sn.FLAG_CLEAN, 0x01, 0, 60]) + b"dev2")
+    await c2.recv(sn.CONNACK)
+    c2.send(sn.REGISTER, struct.pack(">HH", 0, 9) + b"sensors/temp")
+    reg_ack = await c2.recv(sn.REGACK)
+    tid = struct.unpack(">H", reg_ack[:2])[0]
+    c2.send(
+        sn.PUBLISH,
+        bytes([sn.TOPIC_NORMAL]) + struct.pack(">HH", tid, 0) + b"21.5",
+    )
+    # dev1 gets REGISTER (unknown topic) then PUBLISH after REGACK
+    reg_body = await c1.recv(sn.REGISTER)
+    rtid, rmsgid = struct.unpack(">HH", reg_body[:4])
+    assert reg_body[4:] == b"sensors/temp"
+    c1.send(sn.REGACK, struct.pack(">HHB", rtid, rmsgid, sn.RC_ACCEPTED))
+    pub = await c1.recv(sn.PUBLISH)
+    assert struct.unpack(">H", pub[1:3])[0] == rtid
+    assert pub[5:] == b"21.5"
+    # MQTT-side interop: mqtt subscriber receives SN publishes
+    outs = []
+    s, _ = b.open_session("mq", True)
+    b.subscribe(s, "sensors/#", SubOpts())
+    s.outgoing_sink = outs.extend
+    c2.send(
+        sn.PUBLISH,
+        bytes([sn.TOPIC_NORMAL]) + struct.pack(">HH", tid, 0) + b"22.0",
+    )
+    await c1.recv(sn.PUBLISH)
+    assert any(p.payload == b"22.0" for p in outs)
+    # predefined topic publish
+    c2.send(
+        sn.PUBLISH,
+        bytes([sn.TOPIC_PREDEF]) + struct.pack(">HH", 1, 0) + b"pre!",
+    )
+    await asyncio.sleep(0.1)
+    assert any(p.payload == b"pre!" and p.topic == "sensors/pre" for p in outs)
+    # ping + disconnect
+    c1.send(sn.PINGREQ, b"")
+    await c1.recv(sn.PINGRESP)
+    c1.send(sn.DISCONNECT, b"")
+    await c1.recv(sn.DISCONNECT)
+    t1.close()
+    t2.close()
+    await reg.unload_all()
+
+
+async def test_mqttsn_qos1_and_invalid_topic():
+    b = Broker()
+    reg = GatewayRegistry(b)
+    gw = await reg.load("mqttsn", {"bind": "127.0.0.1:0"})
+    loop = asyncio.get_running_loop()
+    t1, c1 = await loop.create_datagram_endpoint(
+        SnClient, remote_addr=gw.listen_addr
+    )
+    c1.send(sn.CONNECT, bytes([sn.FLAG_CLEAN, 0x01, 0, 60]) + b"q1dev")
+    await c1.recv(sn.CONNACK)
+    # publish to an unregistered id -> PUBACK rc=invalid-topic-id
+    c1.send(
+        sn.PUBLISH, bytes([0x20]) + struct.pack(">HH", 99, 5) + b"x"
+    )
+    ack = await c1.recv(sn.PUBACK)
+    assert ack[4] == sn.RC_INVALID_TOPIC_ID
+    # register then qos1 publish -> accepted
+    c1.send(sn.REGISTER, struct.pack(">HH", 0, 6) + b"q/t")
+    tid = struct.unpack(">H", (await c1.recv(sn.REGACK))[:2])[0]
+    c1.send(
+        sn.PUBLISH, bytes([0x20]) + struct.pack(">HH", tid, 7) + b"y"
+    )
+    ack2 = await c1.recv(sn.PUBACK)
+    assert ack2[4] == sn.RC_ACCEPTED
+    t1.close()
+    await reg.unload_all()
