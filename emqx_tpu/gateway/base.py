@@ -59,6 +59,18 @@ class GatewayImpl:
 
     def publish(self, session, topic: str, payload: bytes, qos: int = 0,
                 retain: bool = False) -> int:
+        """Raises ValueError on an invalid topic NAME and PermissionError
+        when the authorize chain denies — the same gates the MQTT
+        channel applies (emqx_channel.erl: validate + authz before
+        process_publish); gateways must not be an ACL bypass."""
+        from ..ops.topic import validate_name
+
+        validate_name(topic)
+        allowed = self.broker.hooks.run_fold(
+            "client.authorize", (session.client_id, "publish", topic), True
+        )
+        if allowed is not True:
+            raise PermissionError(topic)
         return self.broker.publish(
             Message(
                 topic=self.mountpoint + topic,
@@ -69,13 +81,33 @@ class GatewayImpl:
             )
         )
 
+    def _mount_filter(self, flt: str) -> str:
+        """Mount INSIDE $share/$exclusive prefixes, like the MQTT
+        channel (channel.py _mount_filter)."""
+        if not self.mountpoint:
+            return flt
+        from ..broker.pubsub import EXCLUSIVE_PREFIX
+        from ..ops.topic import parse_share
+
+        if flt.startswith(EXCLUSIVE_PREFIX):
+            return EXCLUSIVE_PREFIX + self.mountpoint + flt[len(EXCLUSIVE_PREFIX):]
+        group, real = parse_share(flt)
+        if group is not None:
+            return f"$share/{group}/{self.mountpoint}{real}"
+        return self.mountpoint + flt
+
     def subscribe(self, session, flt: str, qos: int = 0):
+        allowed = self.broker.hooks.run_fold(
+            "client.authorize", (session.client_id, "subscribe", flt), True
+        )
+        if allowed is not True:
+            raise PermissionError(flt)
         return self.broker.subscribe(
-            session, self.mountpoint + flt, SubOpts(qos=qos)
+            session, self._mount_filter(flt), SubOpts(qos=qos)
         )
 
     def unsubscribe(self, session, flt: str) -> bool:
-        return self.broker.unsubscribe(session, self.mountpoint + flt)
+        return self.broker.unsubscribe(session, self._mount_filter(flt))
 
     def unmount(self, topic: str) -> str:
         if self.mountpoint and topic.startswith(self.mountpoint):

@@ -82,18 +82,24 @@ class SnPeer:
         self.session = None
         self.topic_by_id: Dict[int, str] = {}
         self.id_by_topic: Dict[str, int] = {}
+        # ids the CLIENT knows about: client-initiated REGISTERs are
+        # confirmed immediately; server-initiated ones only on REGACK —
+        # a PUBLISH with an unconfirmed id would be undeliverable
+        self.confirmed: set = set()
         self._next_id = 1
-        # outbound-register handshake: msgid -> (topic, payload, flags)
-        self.pending_reg: Dict[int, Tuple[str, bytes, int]] = {}
+        # outbound-register handshake: msgid -> (topic, [payloads...])
+        self.pending_reg: Dict[int, Tuple[str, list]] = {}
         self._next_msgid = 1
 
-    def assign_id(self, topic: str) -> int:
+    def assign_id(self, topic: str, confirmed: bool) -> int:
         tid = self.id_by_topic.get(topic)
         if tid is None:
             tid = self._next_id
             self._next_id += 1
             self.id_by_topic[topic] = tid
             self.topic_by_id[tid] = topic
+        if confirmed:
+            self.confirmed.add(tid)
         return tid
 
     def next_msgid(self) -> int:
@@ -179,16 +185,22 @@ class MqttSnGateway(GatewayImpl):
         if peer is None or peer.session is None:
             return  # not connected: ignore (reference drops too)
         if msg_type == REGISTER:
+            if len(body) < 5:
+                raise ValueError("short REGISTER")
             tid_req, msgid = struct.unpack(">HH", body[:4])
             topic = body[4:].decode("utf-8", "replace")
-            tid = peer.assign_id(topic)
+            tid = peer.assign_id(topic, confirmed=True)
             self._send(addr, REGACK, struct.pack(">HHB", tid, msgid, RC_ACCEPTED))
         elif msg_type == REGACK:
+            if len(body) < 5:
+                raise ValueError("short REGACK")
             tid, msgid, rc = struct.unpack(">HHB", body[:5])
             pend = peer.pending_reg.pop(msgid, None)
             if pend is not None and rc == RC_ACCEPTED:
-                topic, payload, flags = pend
-                self._publish_out(addr, peer, topic, payload, flags)
+                topic, payloads = pend
+                peer.confirmed.add(peer.id_by_topic.get(topic, tid))
+                for payload, qos in payloads:
+                    self._publish_out(addr, peer, topic, payload, qos)
         elif msg_type == PUBLISH:
             self._on_publish(body, addr, peer)
         elif msg_type == PUBACK:
@@ -237,6 +249,8 @@ class MqttSnGateway(GatewayImpl):
         return None
 
     def _on_publish(self, body: bytes, addr, peer: SnPeer) -> None:
+        if len(body) < 5:
+            raise ValueError("short PUBLISH")
         flags = body[0]
         tid, msgid = struct.unpack(">HH", body[1:5])
         payload = body[5:]
@@ -251,13 +265,24 @@ class MqttSnGateway(GatewayImpl):
                     addr, PUBACK, struct.pack(">HHB", tid, msgid, RC_INVALID_TOPIC_ID)
                 )
             return
-        self.publish(
-            peer.session, topic, payload, qos=qos, retain=bool(flags & FLAG_RETAIN)
-        )
-        if qos == 1 or qos_of(flags) == 2:
+        try:
+            self.publish(
+                peer.session, topic, payload, qos=qos,
+                retain=bool(flags & FLAG_RETAIN),
+            )
+        except (ValueError, PermissionError):
+            if qos_of(flags) >= 1:
+                self._send(
+                    addr, PUBACK,
+                    struct.pack(">HHB", tid, msgid, RC_NOT_SUPPORTED),
+                )
+            return
+        if qos_of(flags) >= 1:
             self._send(addr, PUBACK, struct.pack(">HHB", tid, msgid, RC_ACCEPTED))
 
     def _on_subscribe(self, body: bytes, addr, peer: SnPeer) -> None:
+        if len(body) < 4:
+            raise ValueError("short SUBSCRIBE")
         flags = body[0]
         (msgid,) = struct.unpack(">H", body[1:3])
         tid_type = flags & 0x3
@@ -266,8 +291,10 @@ class MqttSnGateway(GatewayImpl):
         if tid_type == TOPIC_NORMAL:  # topic NAME (possibly wildcard)
             topic = body[3:].decode("utf-8", "replace")
             if "+" not in topic and "#" not in topic:
-                tid = peer.assign_id(topic)
+                tid = peer.assign_id(topic, confirmed=True)
         else:
+            if len(body) < 5:
+                raise ValueError("short SUBSCRIBE")
             (raw,) = struct.unpack(">H", body[3:5])
             topic = self._resolve_topic(peer, tid_type, raw)
             tid = raw
@@ -279,7 +306,7 @@ class MqttSnGateway(GatewayImpl):
                 return
         try:
             retained = self.subscribe(peer.session, topic, qos=qos)
-        except ValueError:
+        except (ValueError, PermissionError):
             self._send(
                 addr, SUBACK,
                 struct.pack(">BHHB", flags, 0, msgid, RC_NOT_SUPPORTED),
@@ -292,12 +319,16 @@ class MqttSnGateway(GatewayImpl):
             self._deliver_one(addr, peer, self.unmount(m.topic), m.payload, 0)
 
     def _on_unsubscribe(self, body: bytes, addr, peer: SnPeer) -> None:
+        if len(body) < 4:
+            raise ValueError("short UNSUBSCRIBE")
         flags = body[0]
         (msgid,) = struct.unpack(">H", body[1:3])
         tid_type = flags & 0x3
         if tid_type == TOPIC_NORMAL:
             topic = body[3:].decode("utf-8", "replace")
         else:
+            if len(body) < 5:
+                raise ValueError("short UNSUBSCRIBE")
             (raw,) = struct.unpack(">H", body[3:5])
             topic = self._resolve_topic(peer, tid_type, raw)
         if topic is not None:
@@ -324,11 +355,18 @@ class MqttSnGateway(GatewayImpl):
             self._publish_out_raw(addr, peer, TOPIC_SHORT, tid, payload, qos)
             return
         tid = peer.id_by_topic.get(topic)
-        if tid is None:
-            # REGISTER-then-PUBLISH (emqx_mqttsn outbound register flow)
-            tid = peer.assign_id(topic)
+        if tid is None or tid not in peer.confirmed:
+            # REGISTER-then-PUBLISH (emqx_mqttsn outbound register
+            # flow). Messages arriving while the REGISTER is in flight
+            # QUEUE behind it — a TOPIC_NORMAL id the client never
+            # acked would be undeliverable
+            for msgid, (t, payloads) in peer.pending_reg.items():
+                if t == topic:
+                    payloads.append((payload, qos))
+                    return
+            tid = peer.assign_id(topic, confirmed=False)
             msgid = peer.next_msgid()
-            peer.pending_reg[msgid] = (topic, payload, qos << 5)
+            peer.pending_reg[msgid] = (topic, [(payload, qos)])
             self._send(
                 addr, REGISTER,
                 struct.pack(">HH", tid, msgid) + topic.encode(),
@@ -337,11 +375,9 @@ class MqttSnGateway(GatewayImpl):
         self._publish_out_raw(addr, peer, TOPIC_NORMAL, tid, payload, qos)
 
     def _publish_out(self, addr, peer: SnPeer, topic: str, payload: bytes,
-                     flags: int) -> None:
+                     qos: int) -> None:
         tid = peer.id_by_topic[topic]
-        self._publish_out_raw(
-            addr, peer, TOPIC_NORMAL, tid, payload, (flags >> 5) & 0x3
-        )
+        self._publish_out_raw(addr, peer, TOPIC_NORMAL, tid, payload, qos)
 
     def _publish_out_raw(
         self, addr, peer: SnPeer, tid_type: int, tid: int, payload: bytes,

@@ -205,7 +205,14 @@ class StompConnection:
             if not dest:
                 self._error("SEND without destination")
                 return False
-            self.gw.publish(self.session, dest, f.body)
+            try:
+                self.gw.publish(self.session, dest, f.body)
+            except ValueError:
+                self._error(f"invalid destination {dest!r}")
+                return False
+            except PermissionError:
+                self._error(f"SEND to {dest!r} denied")
+                return False
             self._receipt(f.headers)
             return True
         if cmd == "SUBSCRIBE":
@@ -219,8 +226,13 @@ class StompConnection:
             old = self._subs.get(sid)
             if old is not None and old != dest:
                 self.gw.unsubscribe(self.session, old)
+            try:
+                retained = self.gw.subscribe(self.session, dest)
+            except (ValueError, PermissionError) as e:
+                self._subs.pop(sid, None)
+                self._error(f"SUBSCRIBE {dest!r} rejected: {e}")
+                return False
             self._subs[sid] = dest
-            retained = self.gw.subscribe(self.session, dest)
             self._receipt(f.headers)
             for m in retained:
                 self._deliver_msg(m.topic, m.payload)
@@ -248,10 +260,15 @@ class StompConnection:
 
     def _deliver_msg(self, topic: str, payload: bytes) -> None:
         topic = self.gw.unmount(topic)
+        # the broker dedups overlapping subscriptions to one delivery;
+        # tag it with the most specific matching id (exact wins)
+        cands = [
+            sid for sid, d in self._subs.items()
+            if self._dest_matches(d, topic)
+        ]
         sub_id = next(
-            (sid for sid, d in self._subs.items()
-             if self._dest_matches(d, topic)),
-            None,
+            (sid for sid in cands if self._subs[sid] == topic),
+            cands[0] if cands else None,
         )
         self._msg_seq += 1
         self.send(

@@ -1,0 +1,215 @@
+"""Runtime-installable plugins — the emqx_plugins analog.
+
+The reference installs external plugin apps from .tar.gz packages at
+runtime: unpack, validate metadata (release.json), start/stop under
+config control, persist the enabled set + boot order
+(apps/emqx_plugins/src/emqx_plugins.erl). Here a package is a
+directory (or tarball of one) containing:
+
+    plugin.json   {"name", "version", "description", "entry"}
+    <entry>.py    exposing  on_load(broker, conf) -> state
+                            on_unload(state)       (optional)
+
+Plugins get the live Broker and register through the same hookpoints
+in-tree features use — a plugin IS the extension surface, exactly the
+reference's model (the north-star router plugin ships this way,
+SURVEY.md §2.2).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import logging
+import os
+import shutil
+import tarfile
+from typing import Dict, List, Optional
+
+log = logging.getLogger("emqx_tpu.plugins")
+
+STATE_FILE = "plugins_state.json"
+
+
+class PluginError(Exception):
+    pass
+
+
+class _Plugin:
+    def __init__(self, meta: dict, root: str):
+        self.meta = meta
+        self.root = root
+        self.module = None
+        self.state = None
+        self.running = False
+
+    @property
+    def name_vsn(self) -> str:
+        return f"{self.meta['name']}-{self.meta['version']}"
+
+
+def _load_meta(root: str) -> dict:
+    path = os.path.join(root, "plugin.json")
+    if not os.path.isfile(path):
+        raise PluginError("package has no plugin.json")
+    with open(path) as f:
+        meta = json.load(f)
+    for k in ("name", "version", "entry"):
+        if not isinstance(meta.get(k), str) or not meta[k]:
+            raise PluginError(f"plugin.json missing field {k!r}")
+    if "/" in meta["name"] or ".." in meta["entry"] or meta["entry"].startswith("/"):
+        raise PluginError("unsafe plugin metadata")
+    return meta
+
+
+class PluginManager:
+    def __init__(self, broker, install_dir: str = "data/plugins"):
+        self.broker = broker
+        self.dir = install_dir
+        os.makedirs(install_dir, exist_ok=True)
+        self._plugins: Dict[str, _Plugin] = {}
+        self._scan()
+        self._apply_state()
+
+    # --- discovery / persistence ----------------------------------------
+
+    def _scan(self) -> None:
+        for entry in sorted(os.listdir(self.dir)):
+            root = os.path.join(self.dir, entry)
+            if not os.path.isdir(root):
+                continue
+            try:
+                meta = _load_meta(root)
+            except (PluginError, json.JSONDecodeError):
+                continue
+            self._plugins[meta["name"]] = _Plugin(meta, root)
+
+    def _state_path(self) -> str:
+        return os.path.join(self.dir, STATE_FILE)
+
+    def _save_state(self) -> None:
+        state = {
+            name: {"enabled": p.running} for name, p in self._plugins.items()
+        }
+        with open(self._state_path(), "w") as f:
+            json.dump(state, f)
+
+    def _apply_state(self) -> None:
+        """Boot: restart plugins that were enabled last run."""
+        try:
+            with open(self._state_path()) as f:
+                state = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return
+        for name, st in state.items():
+            if st.get("enabled") and name in self._plugins:
+                try:
+                    self.start(name)
+                except Exception:
+                    log.exception("plugin %s failed to restart on boot", name)
+
+    # --- install / uninstall --------------------------------------------
+
+    def install(self, package: str) -> str:
+        """Install from a package directory or .tar.gz; returns the
+        plugin name. Does NOT start it (reference parity)."""
+        if os.path.isdir(package):
+            meta = _load_meta(package)
+            dest = os.path.join(self.dir, f"{meta['name']}-{meta['version']}")
+            if os.path.exists(dest):
+                raise PluginError(f"{meta['name']}-{meta['version']} already installed")
+            shutil.copytree(package, dest)
+        else:
+            with tarfile.open(package) as tar:
+                names = tar.getnames()
+                # path-traversal guard (absolute paths / .. segments)
+                for n in names:
+                    if n.startswith(("/", "..")) or ".." in n.split("/"):
+                        raise PluginError(f"unsafe path in package: {n}")
+                tmp = os.path.join(self.dir, ".unpack")
+                shutil.rmtree(tmp, ignore_errors=True)
+                os.makedirs(tmp)
+                tar.extractall(tmp, filter="data")
+            # the package root is either tmp itself or a single subdir
+            root = tmp
+            entries = os.listdir(tmp)
+            if "plugin.json" not in entries and len(entries) == 1:
+                root = os.path.join(tmp, entries[0])
+            try:
+                meta = _load_meta(root)
+            except PluginError:
+                shutil.rmtree(tmp, ignore_errors=True)
+                raise
+            dest = os.path.join(self.dir, f"{meta['name']}-{meta['version']}")
+            if os.path.exists(dest):
+                shutil.rmtree(tmp, ignore_errors=True)
+                raise PluginError(f"{meta['name']}-{meta['version']} already installed")
+            shutil.move(root, dest)
+            shutil.rmtree(tmp, ignore_errors=True)
+        self._plugins[meta["name"]] = _Plugin(meta, dest)
+        self._save_state()
+        return meta["name"]
+
+    def uninstall(self, name: str) -> bool:
+        p = self._plugins.get(name)
+        if p is None:
+            return False
+        if p.running:
+            self.stop(name)
+        self._plugins.pop(name)
+        shutil.rmtree(p.root, ignore_errors=True)
+        self._save_state()
+        return True
+
+    # --- start / stop ----------------------------------------------------
+
+    def start(self, name: str, conf: Optional[dict] = None) -> None:
+        p = self._plugins.get(name)
+        if p is None:
+            raise PluginError(f"plugin {name} not installed")
+        if p.running:
+            return
+        entry = os.path.join(p.root, p.meta["entry"])
+        spec = importlib.util.spec_from_file_location(
+            f"emqx_tpu_plugin_{name}", entry
+        )
+        if spec is None or spec.loader is None:
+            raise PluginError(f"cannot load entry {p.meta['entry']}")
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        if not hasattr(mod, "on_load"):
+            raise PluginError(f"plugin {name} entry has no on_load")
+        p.state = mod.on_load(self.broker, conf or p.meta.get("config") or {})
+        p.module = mod
+        p.running = True
+        self._save_state()
+        log.info("plugin %s started", p.name_vsn)
+
+    def stop(self, name: str) -> None:
+        p = self._plugins.get(name)
+        if p is None or not p.running:
+            return
+        if p.module is not None and hasattr(p.module, "on_unload"):
+            try:
+                p.module.on_unload(p.state)
+            except Exception:
+                log.exception("plugin %s on_unload failed", name)
+        p.running = False
+        p.module = None
+        p.state = None
+        self._save_state()
+
+    def restart(self, name: str) -> None:
+        self.stop(name)
+        self.start(name)
+
+    def list(self) -> List[dict]:
+        return [
+            {
+                "name": p.meta["name"],
+                "version": p.meta["version"],
+                "description": p.meta.get("description", ""),
+                "status": "running" if p.running else "stopped",
+            }
+            for _n, p in sorted(self._plugins.items())
+        ]

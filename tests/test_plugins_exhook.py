@@ -1,0 +1,218 @@
+"""Plugin install/start/stop lifecycle + out-of-proc hook servers.
+
+Refs: apps/emqx_plugins/src/emqx_plugins.erl,
+apps/emqx_exhook/src/emqx_exhook_handler.erl:24-68,78-118.
+"""
+
+import asyncio
+import json
+import os
+import tarfile
+import threading
+import time
+
+import pytest
+
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.packet import SubOpts
+from emqx_tpu.broker.pubsub import Broker
+from emqx_tpu.exhook import ExHookBridge, ExHookServer
+from emqx_tpu.plugins import PluginError, PluginManager
+
+PLUGIN_CODE = '''
+from emqx_tpu.broker.message import Message
+
+def on_load(broker, conf):
+    tag = conf.get("tag", "tagged")
+
+    def stamp(msg):
+        out = Message(**{**msg.__dict__})
+        out.headers = dict(msg.headers, plugin=tag)
+        return out
+
+    broker.hooks.add("message.publish", stamp, priority=700)
+    return {"broker": broker, "cb": stamp}
+
+def on_unload(state):
+    state["broker"].hooks.delete("message.publish", state["cb"])
+'''
+
+
+def make_package(tmp_path, name="tagger", version="1.0.0", as_tar=False):
+    root = tmp_path / f"{name}_pkg_{'tar' if as_tar else 'dir'}"
+    root.mkdir(exist_ok=True)
+    (root / "plugin.json").write_text(json.dumps({
+        "name": name, "version": version, "entry": "plugin.py",
+        "description": "stamps messages", "config": {"tag": "default-tag"},
+    }))
+    (root / "plugin.py").write_text(PLUGIN_CODE)
+    if not as_tar:
+        return str(root)
+    tar_path = tmp_path / f"{name}.tar.gz"
+    with tarfile.open(tar_path, "w:gz") as tar:
+        tar.add(root, arcname=f"{name}-{version}")
+    return str(tar_path)
+
+
+def test_plugin_lifecycle_dir(tmp_path):
+    b = Broker()
+    mgr = PluginManager(b, install_dir=str(tmp_path / "plugins"))
+    name = mgr.install(make_package(tmp_path))
+    assert name == "tagger"
+    assert mgr.list()[0]["status"] == "stopped"
+    mgr.start(name)
+    assert mgr.list()[0]["status"] == "running"
+    seen = []
+    b.hooks.add("message.publish", lambda m: seen.append(m) and None, priority=1)
+    b.publish(Message(topic="t", payload=b"x"))
+    assert seen and seen[0].headers.get("plugin") == "default-tag"
+    mgr.stop(name)
+    seen.clear()
+    b.publish(Message(topic="t", payload=b"x"))
+    assert seen[0].headers.get("plugin") is None
+    assert mgr.uninstall(name)
+    assert mgr.list() == []
+
+
+def test_plugin_tarball_and_boot_restart(tmp_path):
+    b = Broker()
+    d = str(tmp_path / "plugins")
+    mgr = PluginManager(b, install_dir=d)
+    name = mgr.install(make_package(tmp_path, as_tar=True))
+    mgr.start(name)
+    # a NEW manager over the same dir restarts enabled plugins (boot)
+    b2 = Broker()
+    mgr2 = PluginManager(b2, install_dir=d)
+    assert mgr2.list()[0]["status"] == "running"
+    out = b2.hooks.run_fold("message.publish", (), Message(topic="t"))
+    assert out.headers.get("plugin") == "default-tag"
+    # duplicate install rejected
+    with pytest.raises(PluginError):
+        mgr2.install(make_package(tmp_path, as_tar=False))
+
+
+def test_plugin_tar_traversal_rejected(tmp_path):
+    evil = tmp_path / "evil.tar.gz"
+    with tarfile.open(evil, "w:gz") as tar:
+        p = tmp_path / "x.txt"
+        p.write_text("boom")
+        tar.add(p, arcname="../../escape.txt")
+    mgr = PluginManager(Broker(), install_dir=str(tmp_path / "plugins"))
+    with pytest.raises(PluginError):
+        mgr.install(str(evil))
+
+
+# --- exhook --------------------------------------------------------------
+
+
+class ServerThread:
+    """Run an ExHookServer on its own thread+loop (the out-of-proc
+    server stand-in; a separate thread is the in-test analog of a
+    separate process)."""
+
+    def __init__(self, handlers):
+        self.server = ExHookServer(handlers)
+        self.addr = None
+        self._loop = None
+        ready = threading.Event()
+
+        def run():
+            loop = asyncio.new_event_loop()
+            self._loop = loop
+            asyncio.set_event_loop(loop)
+
+            async def boot():
+                self.addr = await self.server.start()
+                ready.set()
+
+            loop.create_task(boot())
+            loop.run_forever()
+            loop.close()
+
+        self._t = threading.Thread(target=run, daemon=True)
+        self._t.start()
+        assert ready.wait(5)
+
+    def close(self):
+        loop = self._loop
+        if loop is not None:
+
+            def stop():
+                asyncio.ensure_future(self.server.stop())
+                loop.call_later(0.1, loop.stop)
+
+            loop.call_soon_threadsafe(stop)
+        self._t.join(timeout=3)
+
+
+def test_exhook_fold_and_notify():
+    notified = []
+
+    def on_publish(args, acc):
+        msg = acc["__msg__"]
+        if msg["topic"].startswith("blocked/"):
+            msg = dict(msg)
+            # deny: reference on_message_publish sets allow_publish false
+            return ("stop", None)
+        msg = dict(msg, payload=msg["payload"] + b"!")
+        return ("ok", {"__msg__": msg})
+
+    def on_connected(args, acc):
+        notified.append(tuple(args))
+
+    srv = ServerThread({
+        "message.publish": on_publish,
+        "client.connected": on_connected,
+    })
+    b = Broker()
+    bridge = ExHookBridge(b, srv.addr, timeout=5.0)
+    bridge.start()
+    assert set(bridge.hookpoints) == {"message.publish", "client.connected"}
+    try:
+        outs = []
+        s, _ = b.open_session("c1", True)
+        b.subscribe(s, "#", SubOpts())
+        s.outgoing_sink = outs.extend
+        b.publish(Message(topic="t/x", payload=b"hi"))
+        assert outs[-1].payload == b"hi!"  # server-side mutation applied
+        assert b.publish(Message(topic="blocked/t", payload=b"no")) == 0
+        b.hooks.run("client.connected", "c9", 5, "1.2.3.4")
+        deadline = time.time() + 5
+        while not notified and time.time() < deadline:
+            time.sleep(0.01)
+        assert notified and notified[0][0] == "c9"
+        assert bridge.metrics["calls"] >= 2
+    finally:
+        bridge.stop()
+        srv.close()
+    # hooks are removed after stop
+    assert b.publish(Message(topic="blocked/t", payload=b"yes")) == 1
+
+
+def test_exhook_failed_action():
+    srv = ServerThread({"client.authenticate": lambda a, acc: ("ok", True)})
+    b_ignore = Broker()
+    bridge = ExHookBridge(b_ignore, srv.addr, failed_action="ignore", timeout=1.0)
+    bridge.start()
+    srv.close()  # server dies
+    time.sleep(0.1)
+    # ignore: the chain continues with the old acc
+    assert b_ignore.hooks.run_fold("client.authenticate", ({},), True) is True
+    bridge.stop()
+
+    srv2 = ServerThread({"client.authenticate": lambda a, acc: ("ok", True)})
+    b_deny = Broker()
+    bridge2 = ExHookBridge(b_deny, srv2.addr, failed_action="deny", timeout=1.0)
+    bridge2.start()
+    srv2.close()
+    time.sleep(0.1)
+    out = b_deny.hooks.run_fold("client.authenticate", ({},), True)
+    assert out is False  # deny on failure
+    bridge2.stop()
+
+
+def test_exhook_connect_refused():
+    b = Broker()
+    bridge = ExHookBridge(b, ("127.0.0.1", 1), timeout=1.0)
+    with pytest.raises(ConnectionError):
+        bridge.start()
