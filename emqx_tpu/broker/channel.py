@@ -384,19 +384,9 @@ class Channel:
         return [Unsuback(pkt.packet_id, codes)]
 
     def _mount_filter(self, flt: str) -> str:
-        """Apply the listener mountpoint to a subscription filter,
-        keeping $share/$exclusive prefixes outside the mount
-        (emqx_mountpoint mounts inside the share record)."""
-        if not self.mountpoint:
-            return flt
-        if flt.startswith(EXCLUSIVE_PREFIX):
-            return EXCLUSIVE_PREFIX + self.mountpoint + flt[len(EXCLUSIVE_PREFIX):]
-        from ..ops.topic import parse_share
+        from ..ops.topic import mount_filter
 
-        group, real = parse_share(flt)
-        if group is not None:
-            return f"$share/{group}/{self.mountpoint}{real}"
-        return self.mountpoint + flt
+        return mount_filter(self.mountpoint, flt)
 
     # --- lifecycle -----------------------------------------------------------
 

@@ -230,9 +230,14 @@ class ExHookBridge:
         seq = self._seq
         fut = asyncio.get_running_loop().create_future()
         self._pending[seq] = fut
-        _write_frame(self._writer, ("call", hookpoint, args, acc, seq))
-        await self._writer.drain()
-        return await fut
+        try:
+            _write_frame(self._writer, ("call", hookpoint, args, acc, seq))
+            await self._writer.drain()
+            return await fut
+        finally:
+            # caller-side timeout cancels this coroutine; the pending
+            # slot must not leak per timed-out call
+            self._pending.pop(seq, None)
 
     async def _do_cast(self, hookpoint, args):
         _write_frame(self._writer, ("cast", hookpoint, args))
@@ -241,6 +246,15 @@ class ExHookBridge:
     # --- broker-side hook callbacks --------------------------------------
 
     def _install_hooks(self) -> None:
+        from ..broker.hooks import HOOKPOINTS
+
+        unknown = [p for p in self.hookpoints if p not in HOOKPOINTS]
+        if unknown:
+            log.warning(
+                "exhook server %s declared unknown hookpoints %s — skipped",
+                self.addr, unknown,
+            )
+            self.hookpoints = [p for p in self.hookpoints if p in HOOKPOINTS]
         for point in self.hookpoints:
             if point in FOLD_HOOKPOINTS:
                 cb = self._make_fold(point)
@@ -258,6 +272,7 @@ class ExHookBridge:
             loop = self._loop
             if loop is None or loop.is_closed():
                 return self._failed(acc)
+            fut = None
             try:
                 fut = asyncio.run_coroutine_threadsafe(
                     self._do_call(point, self._wireable(args), self._wireable(acc)),
@@ -265,6 +280,8 @@ class ExHookBridge:
                 )
                 verdict, out = fut.result(self.timeout)
             except Exception:
+                if fut is not None:
+                    fut.cancel()  # cancels _do_call -> pending cleanup
                 self.metrics["failures"] += 1
                 return self._failed(acc)
             if verdict == "ok":

@@ -82,19 +82,9 @@ class GatewayImpl:
         )
 
     def _mount_filter(self, flt: str) -> str:
-        """Mount INSIDE $share/$exclusive prefixes, like the MQTT
-        channel (channel.py _mount_filter)."""
-        if not self.mountpoint:
-            return flt
-        from ..broker.pubsub import EXCLUSIVE_PREFIX
-        from ..ops.topic import parse_share
+        from ..ops.topic import mount_filter
 
-        if flt.startswith(EXCLUSIVE_PREFIX):
-            return EXCLUSIVE_PREFIX + self.mountpoint + flt[len(EXCLUSIVE_PREFIX):]
-        group, real = parse_share(flt)
-        if group is not None:
-            return f"$share/{group}/{self.mountpoint}{real}"
-        return self.mountpoint + flt
+        return mount_filter(self.mountpoint, flt)
 
     def subscribe(self, session, flt: str, qos: int = 0):
         allowed = self.broker.hooks.run_fold(

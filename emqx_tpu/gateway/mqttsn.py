@@ -288,10 +288,10 @@ class MqttSnGateway(GatewayImpl):
         tid_type = flags & 0x3
         qos = qos_of(flags)
         tid = 0
+        plain_name = False
         if tid_type == TOPIC_NORMAL:  # topic NAME (possibly wildcard)
             topic = body[3:].decode("utf-8", "replace")
-            if "+" not in topic and "#" not in topic:
-                tid = peer.assign_id(topic, confirmed=True)
+            plain_name = "+" not in topic and "#" not in topic
         else:
             if len(body) < 5:
                 raise ValueError("short SUBSCRIBE")
@@ -312,6 +312,11 @@ class MqttSnGateway(GatewayImpl):
                 struct.pack(">BHHB", flags, 0, msgid, RC_NOT_SUPPORTED),
             )
             return
+        if plain_name:
+            # id confirmed only AFTER the subscribe is granted — a
+            # denied SUBSCRIBE must not record an id the client never
+            # learned (the SUBACK below carries it)
+            tid = peer.assign_id(topic, confirmed=True)
         self._send(
             addr, SUBACK, struct.pack(">BHHB", flags, tid, msgid, RC_ACCEPTED)
         )

@@ -229,6 +229,10 @@ class StompConnection:
             try:
                 retained = self.gw.subscribe(self.session, dest)
             except (ValueError, PermissionError) as e:
+                # a re-subscribe rejection tears the OLD route down too
+                # (old == dest means it was never unsubscribed above)
+                if old is not None:
+                    self.gw.unsubscribe(self.session, old)
                 self._subs.pop(sid, None)
                 self._error(f"SUBSCRIBE {dest!r} rejected: {e}")
                 return False

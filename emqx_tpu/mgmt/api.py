@@ -18,6 +18,7 @@ import base64
 import hashlib
 import hmac
 import json
+import os
 import secrets
 import time
 import uuid
@@ -125,7 +126,10 @@ class ManagementApi:
         node=None,  # ClusterNode, for /nodes and cluster-wide views
         node_name: str = "emqx@127.0.0.1",
         obs=None,  # Observability bundle (emqx_tpu.obs.Observability)
+        backup_dir: str = "data/backup",
     ):
+        from .audit import AuditLog
+
         self.broker = broker
         self.config = config
         self.rules = rules
@@ -133,9 +137,12 @@ class ManagementApi:
         self.node = node
         self.obs = obs
         self.node_name = node_name
+        self.backup_dir = backup_dir
         self.started_at = time.time()
         self.http = HttpServer()
         self.api_keys = ApiKeys()
+        self.audit = AuditLog()
+        self.http.after.append(self._audit_mw)
         # dashboard users (default admin/public, like the reference)
         self._users: Dict[str, Tuple[bytes, bytes]] = {}
         self.add_user("admin", "public")
@@ -242,11 +249,81 @@ class ManagementApi:
             r("DELETE", "/api/v5/trace/{name}", self._trace_delete)
             r("PUT", "/api/v5/trace/{name}/stop", self._trace_stop)
             r("GET", "/api/v5/trace/{name}/log", self._trace_log)
+        r("GET", "/api/v5/audit", self._audit_list)
+        r("POST", "/api/v5/data/export", self._data_export)
+        r("GET", "/api/v5/data/files", self._data_files)
+        r("POST", "/api/v5/data/import", self._data_import)
         r("GET", "/api/v5/mqtt/retainer/messages", self._retained_list)
         r("GET", "/api/v5/mqtt/retainer/message/{topic...}", self._retained_one)
         r("DELETE", "/api/v5/mqtt/retainer/message/{topic...}", self._retained_delete)
 
     # --- handlers ---------------------------------------------------------
+
+    def _audit_mw(self, req: Request, resp) -> None:
+        """Record every mutating API call with its outcome
+        (emqx_audit: intercepted at the REST layer)."""
+        if req.method in ("POST", "PUT", "DELETE") and req.path != "/api/v5/login":
+            self.audit.record(
+                getattr(req, "principal", "?"),
+                "api",
+                f"{req.method} {req.path}",
+                result="ok" if resp.status < 400 else "failed",
+                code=resp.status,
+            )
+
+    def _audit_list(self, req: Request):
+        return _paginate(
+            self.audit.list(
+                actor=req.query.get("actor"),
+                via=req.query.get("via"),
+            ),
+            req.query,
+        )
+
+    def _data_export(self, req: Request):
+        from .backup import export_backup
+
+        path = export_backup(
+            self.backup_dir,
+            broker=self.broker,
+            config=self.config,
+            rules=self.rules,
+            banned=self.banned,
+            api_keys=self.api_keys,
+            node_name=self.node_name,
+        )
+        return {"filename": os.path.basename(path), "path": path}
+
+    def _data_files(self, req: Request):
+        try:
+            files = sorted(
+                f for f in os.listdir(self.backup_dir)
+                if f.startswith("emqx-export-")
+            )
+        except OSError:
+            files = []
+        return {"files": files}
+
+    def _data_import(self, req: Request):
+        from .backup import import_backup
+
+        body = req.json() or {}
+        fname = body.get("filename")
+        if not fname:
+            raise ValueError("filename required")
+        if "/" in fname or fname.startswith("."):
+            raise ValueError("bad filename")
+        path = os.path.join(self.backup_dir, fname)
+        if not os.path.isfile(path):
+            return Response.error(404, "NOT_FOUND", fname)
+        return import_backup(
+            path,
+            broker=self.broker,
+            config=self.config,
+            rules=self.rules,
+            banned=self.banned,
+            api_keys=self.api_keys,
+        )
 
     def _status(self, req: Request) -> Response:
         return Response.text(

@@ -1,0 +1,211 @@
+"""Data backup: export/import of operator state — the
+emqx_mgmt_data_backup analog.
+
+Exports a tar.gz of JSON sections (config overrides, banned table,
+API keys, rules, retained messages) with a manifest; import applies
+sections additively and reports per-section counts + errors, like the
+reference's export/import with a result summary
+(apps/emqx_management/src/emqx_mgmt_data_backup.erl).
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+import json
+import os
+import tarfile
+import time
+from typing import Any, Dict, Optional
+
+FORMAT_VERSION = 1
+
+
+def _add_json(tar: tarfile.TarFile, name: str, obj: Any) -> None:
+    data = json.dumps(obj, indent=1).encode()
+    info = tarfile.TarInfo(name)
+    info.size = len(data)
+    info.mtime = int(time.time())
+    tar.addfile(info, io.BytesIO(data))
+
+
+def export_backup(
+    out_dir: str,
+    broker=None,
+    config=None,
+    rules=None,
+    banned=None,
+    api_keys=None,
+    node_name: str = "emqx@127.0.0.1",
+) -> str:
+    """Write emqx-export-<ts>.tar.gz into out_dir; returns the path."""
+    os.makedirs(out_dir, exist_ok=True)
+    ts = time.strftime("%Y%m%d%H%M%S")
+    path = os.path.join(out_dir, f"emqx-export-{ts}.tar.gz")
+    with tarfile.open(path, "w:gz") as tar:
+        _add_json(
+            tar,
+            "META.json",
+            {"version": FORMAT_VERSION, "node": node_name, "exported_at": time.time()},
+        )
+        if config is not None:
+            _add_json(tar, "config.json", getattr(config, "_overrides", {}))
+        if banned is not None:
+            _add_json(
+                tar,
+                "banned.json",
+                [
+                    {
+                        "as": e.who_type,
+                        "who": e.who,
+                        "by": e.by,
+                        "reason": e.reason,
+                        "until": e.until,
+                    }
+                    for e in banned.list()
+                ],
+            )
+        if api_keys is not None:
+            _add_json(
+                tar,
+                "api_keys.json",
+                [
+                    {
+                        "api_key": k,
+                        "name": v["name"],
+                        "desc": v["desc"],
+                        "enable": v["enable"],
+                        "expired_at": v["expired_at"],
+                        "created_at": v["created_at"],
+                        "salt": base64.b64encode(v["salt"]).decode(),
+                        "secret_hash": base64.b64encode(v["secret_hash"]).decode(),
+                    }
+                    for k, v in api_keys._keys.items()
+                ],
+            )
+        if rules is not None:
+            _add_json(
+                tar,
+                "rules.json",
+                [
+                    {
+                        "id": rule.id,
+                        "sql": rule.sql,
+                        "actions": rule.actions,
+                        "enable": rule.enable,
+                        "description": rule.description,
+                    }
+                    for rule in rules.rules.values()
+                ],
+            )
+        if broker is not None:
+            _add_json(
+                tar,
+                "retained.json",
+                [
+                    {
+                        "topic": m.topic,
+                        "payload": base64.b64encode(m.payload).decode(),
+                        "qos": m.qos,
+                        "props": m.props,
+                    }
+                    for m in broker.retainer.read("#")
+                ],
+            )
+    return path
+
+
+def _read_json(tar: tarfile.TarFile, name: str):
+    try:
+        f = tar.extractfile(name)
+    except KeyError:
+        return None
+    return json.load(f) if f is not None else None
+
+
+def import_backup(
+    path: str,
+    broker=None,
+    config=None,
+    rules=None,
+    banned=None,
+    api_keys=None,
+) -> Dict[str, Any]:
+    """Apply a backup additively; returns {section: imported_count,
+    "errors": [...]}"""
+    report: Dict[str, Any] = {"errors": []}
+    with tarfile.open(path) as tar:
+        meta = _read_json(tar, "META.json")
+        if not meta or meta.get("version") != FORMAT_VERSION:
+            raise ValueError("unsupported backup format")
+        report["meta"] = meta
+        conf = _read_json(tar, "config.json")
+        if conf and config is not None:
+            try:
+                config.load_overrides(json.dumps(conf))
+                report["config"] = len(conf)
+            except Exception as e:  # noqa: BLE001
+                report["errors"].append(f"config: {e}")
+        for entry in _read_json(tar, "banned.json") or ():
+            if banned is None:
+                break
+            try:
+                dur = None
+                if entry.get("until") is not None:
+                    dur = max(0.0, entry["until"] - time.time())
+                banned.create(
+                    entry["as"], entry["who"], by=entry.get("by", "import"),
+                    reason=entry.get("reason", ""), duration_s=dur,
+                )
+                report["banned"] = report.get("banned", 0) + 1
+            except Exception as e:  # noqa: BLE001
+                report["errors"].append(f"banned {entry.get('who')}: {e}")
+        for entry in _read_json(tar, "api_keys.json") or ():
+            if api_keys is None:
+                break
+            try:
+                api_keys._keys[entry["api_key"]] = {
+                    "name": entry["name"],
+                    "desc": entry.get("desc", ""),
+                    "enable": entry.get("enable", True),
+                    "expired_at": entry.get("expired_at"),
+                    "created_at": entry.get("created_at", time.time()),
+                    "salt": base64.b64decode(entry["salt"]),
+                    "secret_hash": base64.b64decode(entry["secret_hash"]),
+                }
+                report["api_keys"] = report.get("api_keys", 0) + 1
+            except Exception as e:  # noqa: BLE001
+                report["errors"].append(f"api_key {entry.get('name')}: {e}")
+        for entry in _read_json(tar, "rules.json") or ():
+            if rules is None:
+                break
+            try:
+                if entry["id"] in rules.rules:
+                    rules.delete_rule(entry["id"])
+                rules.create_rule(
+                    entry["id"], entry["sql"], entry.get("actions") or [],
+                    enable=entry.get("enable", True),
+                    description=entry.get("description", ""),
+                )
+                report["rules"] = report.get("rules", 0) + 1
+            except Exception as e:  # noqa: BLE001
+                report["errors"].append(f"rule {entry.get('id')}: {e}")
+        for entry in _read_json(tar, "retained.json") or ():
+            if broker is None:
+                break
+            try:
+                from ..broker.message import Message
+
+                broker.retainer.retain(
+                    Message(
+                        topic=entry["topic"],
+                        payload=base64.b64decode(entry["payload"]),
+                        qos=entry.get("qos", 0),
+                        retain=True,
+                        props=entry.get("props") or {},
+                    )
+                )
+                report["retained"] = report.get("retained", 0) + 1
+            except Exception as e:  # noqa: BLE001
+                report["errors"].append(f"retained {entry.get('topic')}: {e}")
+    return report

@@ -99,6 +99,8 @@ class HttpServer:
         self.listen_addr: Optional[Tuple[str, int]] = None
         # middleware: (req) -> Optional[Response]; a Response short-circuits
         self.before: List[Callable[[Request], Optional[Response]]] = []
+        # observers: (req, resp) -> None, after every dispatched request
+        self.after: List[Callable[[Request, Response], None]] = []
 
     def route(self, method: str, pattern: str, handler: Callable) -> None:
         self._routes.append(_Route(method.upper(), pattern, handler))
@@ -129,6 +131,11 @@ class HttpServer:
                 if req is None:
                     break
                 resp = await self._handle(req)
+                for obs in self.after:
+                    try:
+                        obs(req, resp)
+                    except Exception:
+                        log.exception("after-middleware failed")
                 data = (
                     f"HTTP/1.1 {resp.status} {_REASONS.get(resp.status, '')}\r\n"
                     f"content-type: {resp.content_type}\r\n"

@@ -200,3 +200,22 @@ def parse_share(flt: str) -> Tuple[Optional[str], str]:
 def feed_var(var: str, value: str, topic: str) -> str:
     """Substitute ${var} placeholders per level (emqx_topic.erl feed_var)."""
     return join(value if w == var else w for w in words(topic))
+
+
+EXCLUSIVE_PREFIX_STR = "$exclusive/"
+
+
+def mount_filter(mountpoint: str, flt: str) -> str:
+    """Apply a listener/gateway mountpoint to a subscription filter,
+    keeping $share/$exclusive prefixes OUTSIDE the mount (the reference
+    mounts inside the share record, emqx_mountpoint.erl). Shared by the
+    MQTT channel and the gateway session glue — one definition, no
+    divergence."""
+    if not mountpoint:
+        return flt
+    if flt.startswith(EXCLUSIVE_PREFIX_STR):
+        return EXCLUSIVE_PREFIX_STR + mountpoint + flt[len(EXCLUSIVE_PREFIX_STR):]
+    group, real = parse_share(flt)
+    if group is not None:
+        return f"{SHARE_PREFIX}/{group}/{mountpoint}{real}"
+    return mountpoint + flt

@@ -115,6 +115,10 @@ class PluginManager:
         plugin name. Does NOT start it (reference parity)."""
         if os.path.isdir(package):
             meta = _load_meta(package)
+            if meta["name"] in self._plugins:
+                raise PluginError(
+                    f"plugin {meta['name']} already installed — uninstall first"
+                )
             dest = os.path.join(self.dir, f"{meta['name']}-{meta['version']}")
             if os.path.exists(dest):
                 raise PluginError(f"{meta['name']}-{meta['version']} already installed")
@@ -141,9 +145,13 @@ class PluginManager:
                 shutil.rmtree(tmp, ignore_errors=True)
                 raise
             dest = os.path.join(self.dir, f"{meta['name']}-{meta['version']}")
-            if os.path.exists(dest):
+            if meta["name"] in self._plugins or os.path.exists(dest):
+                # a different VERSION of a (possibly running) plugin
+                # must not silently orphan the old one's hooks
                 shutil.rmtree(tmp, ignore_errors=True)
-                raise PluginError(f"{meta['name']}-{meta['version']} already installed")
+                raise PluginError(
+                    f"plugin {meta['name']} already installed — uninstall first"
+                )
             shutil.move(root, dest)
             shutil.rmtree(tmp, ignore_errors=True)
         self._plugins[meta["name"]] = _Plugin(meta, dest)

@@ -1,0 +1,234 @@
+"""Schema registry/validation, message transformation, audit log,
+data backup export/import.
+
+Refs: apps/emqx_schema_validation, apps/emqx_message_transformation,
+apps/emqx_schema_registry, apps/emqx_audit,
+apps/emqx_management/src/emqx_mgmt_data_backup.erl.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.packet import SubOpts
+from emqx_tpu.broker.pubsub import Broker
+from emqx_tpu.transform import (
+    MessageTransformation, SchemaError, SchemaRegistry, SchemaValidation,
+)
+
+
+def _sub(b, cid, flt):
+    s, _ = b.open_session(cid, True)
+    b.subscribe(s, flt, SubOpts())
+    out = []
+    s.outgoing_sink = out.extend
+    return out
+
+
+# --- schema registry -----------------------------------------------------
+
+
+def test_registry_json_schema():
+    reg = SchemaRegistry()
+    reg.put("telemetry", {
+        "type": "json_schema",
+        "schema": {
+            "type": "object",
+            "required": ["temp"],
+            "properties": {
+                "temp": {"type": "number", "minimum": -50, "maximum": 150},
+                "unit": {"type": "string", "enum": ["C", "F"]},
+            },
+        },
+    })
+    assert reg.check_payload("telemetry", b'{"temp": 21.5, "unit": "C"}')
+    with pytest.raises(SchemaError):
+        reg.check_payload("telemetry", b'{"unit": "C"}')  # missing temp
+    with pytest.raises(SchemaError):
+        reg.check_payload("telemetry", b'{"temp": 999}')  # over maximum
+    with pytest.raises(SchemaError):
+        reg.check_payload("telemetry", b"not json")
+    with pytest.raises(SchemaError):
+        reg.check_payload("nope", b"{}")
+    assert reg.list() == ["telemetry"]
+    assert reg.delete("telemetry") and not reg.delete("telemetry")
+
+
+# --- validation ----------------------------------------------------------
+
+
+def test_validation_drops_bad_payloads():
+    b = Broker()
+    v = SchemaValidation(b)
+    v.registry.put("m", {
+        "type": "json_schema",
+        "schema": {"type": "object", "required": ["v"]},
+    })
+    v.put({"name": "check-m", "topics": ["data/#"],
+           "checks": [{"type": "schema", "schema": "m"}]})
+    v.enable()
+    failed = []
+    b.hooks.add("schema.validation_failed", lambda m, n: failed.append(n))
+    out = _sub(b, "c1", "data/#")
+    assert b.publish(Message(topic="data/1", payload=b'{"v": 1}')) == 1
+    assert b.publish(Message(topic="data/1", payload=b'{"x": 1}')) == 0  # dropped
+    assert failed == ["check-m"]
+    assert len(out) == 1
+    # non-matching topics bypass validation entirely
+    _sub(b, "c2", "other")
+    assert b.publish(Message(topic="other", payload=b"raw-bytes")) == 1
+    st = v.list()[0]
+    assert st["matched"] == 2 and st["failed"] == 1
+    assert v.delete("check-m") and v.list() == []
+
+
+def test_validation_any_pass_and_predicate():
+    b = Broker()
+    v = SchemaValidation(b)
+    v.put({
+        "name": "either", "topics": ["t"], "strategy": "any_pass",
+        "checks": [
+            {"type": "json_schema", "schema": {"type": "object"}},
+            {"type": "predicate", "fn": lambda m: m.payload == b"magic"},
+        ],
+    })
+    v.enable()
+    _sub(b, "c", "t")
+    assert b.publish(Message(topic="t", payload=b"{}")) == 1
+    assert b.publish(Message(topic="t", payload=b"magic")) == 1
+    assert b.publish(Message(topic="t", payload=b"junk")) == 0
+
+
+# --- transformation ------------------------------------------------------
+
+
+def test_transformation_rewrites_payload_and_topic():
+    b = Broker()
+    t = MessageTransformation(b)
+    t.put({
+        "name": "enrich", "topics": ["in/#"],
+        "operations": [
+            {"key": "payload.device", "value": "${clientid}"},
+            {"key": "payload.orig_topic", "value": "${topic}"},
+            {"key": "topic", "value": "enriched"},
+            {"key": "user_property.source", "value": "gateway"},
+        ],
+    })
+    t.enable()
+    out = _sub(b, "c1", "enriched")
+    n = b.publish(Message(topic="in/x", payload=b'{"temp": 3}',
+                          from_client="dev9"))
+    assert n == 1
+    got = json.loads(out[0].payload)
+    assert got == {"temp": 3, "device": "dev9", "orig_topic": "in/x"}
+    assert out[0].props["user_property"]["source"] == "gateway"
+
+
+def test_transformation_failure_drops():
+    b = Broker()
+    t = MessageTransformation(b)
+    t.put({"name": "j", "topics": ["t"],
+           "operations": [{"key": "payload.x", "value": 1}]})
+    t.enable()
+    failed = []
+    b.hooks.add("message.transformation_failed", lambda m, n: failed.append(n))
+    _sub(b, "c", "t")
+    assert b.publish(Message(topic="t", payload=b"not-json")) == 0
+    assert failed == ["j"]
+    # ignore action passes the original through
+    t.put({"name": "j", "topics": ["t"], "failure_action": "ignore",
+           "operations": [{"key": "payload.x", "value": 1}]})
+    assert b.publish(Message(topic="t", payload=b"not-json")) == 1
+
+
+def test_validation_sees_original_transformation_after():
+    """Order parity: validation (860) runs BEFORE transformation (850)."""
+    b = Broker()
+    v = SchemaValidation(b)
+    v.put({"name": "need-raw", "topics": ["t"],
+           "checks": [{"type": "predicate",
+                       "fn": lambda m: m.payload == b'{"ok":1}'}]})
+    v.enable()
+    t = MessageTransformation(b)
+    t.put({"name": "mut", "topics": ["t"],
+           "operations": [{"key": "payload.added", "value": True}]})
+    t.enable()
+    out = _sub(b, "c", "t")
+    assert b.publish(Message(topic="t", payload=b'{"ok":1}')) == 1
+    assert json.loads(out[0].payload) == {"ok": 1, "added": True}
+
+
+# --- audit + backup over the REST surface --------------------------------
+
+
+async def test_audit_and_backup_roundtrip(tmp_path):
+    from emqx_tpu.auth.banned import Banned
+    from emqx_tpu.mgmt.api import ManagementApi
+    from emqx_tpu.mgmt.backup import export_backup, import_backup
+    from emqx_tpu.rules.engine import RuleEngine
+
+    b = Broker()
+    banned = Banned()
+    rules = RuleEngine(b)
+    rules.create_rule("r1", 'SELECT * FROM "a/#"')
+    banned.create("clientid", "badguy", reason="test")
+    b.publish(Message(topic="keep/me", payload=b"v", retain=True))
+    api = ManagementApi(
+        b, rules=rules, banned=banned, backup_dir=str(tmp_path / "bk")
+    )
+    key = api.api_keys.create("backup-key")
+    path = export_backup(
+        str(tmp_path / "bk"), broker=b, rules=rules, banned=banned,
+        api_keys=api.api_keys,
+    )
+    # fresh broker: import restores everything
+    b2 = Broker()
+    banned2 = Banned()
+    rules2 = RuleEngine(b2)
+    api2 = ManagementApi(b2, rules=rules2, banned=banned2)
+    report = import_backup(
+        path, broker=b2, rules=rules2, banned=banned2, api_keys=api2.api_keys
+    )
+    assert report["errors"] == []
+    assert report["banned"] == 1 and report["rules"] == 1
+    assert report["retained"] == 1 and report["api_keys"] == 1
+    assert banned2.list()[0].who == "badguy"
+    assert "r1" in rules2.rules
+    assert b2.retainer.read("keep/me")[0].payload == b"v"
+    assert api2.api_keys.verify(key["api_key"], key["api_secret"])
+
+    # audit records mutations through the REST surface
+    import urllib.request
+
+    host, port = await api.start()
+    req = urllib.request.Request(
+        f"http://{host}:{port}/api/v5/login", method="POST",
+        data=json.dumps({"username": "admin", "password": "public"}).encode(),
+        headers={"content-type": "application/json"},
+    )
+    loop = asyncio.get_running_loop()
+    tok = json.loads(
+        (await loop.run_in_executor(None, urllib.request.urlopen, req)).read()
+    )["token"]
+
+    async def call(method, path_, body=None):
+        rq = urllib.request.Request(
+            f"http://{host}:{port}{path_}", method=method,
+            data=json.dumps(body).encode() if body is not None else None,
+            headers={"authorization": f"Bearer {tok}",
+                     "content-type": "application/json"},
+        )
+        resp = await loop.run_in_executor(None, urllib.request.urlopen, rq)
+        return json.loads(resp.read() or b"{}")
+
+    out = await call("POST", "/api/v5/data/export")
+    assert out["filename"].startswith("emqx-export-")
+    files = await call("GET", "/api/v5/data/files")
+    assert out["filename"] in files["files"]
+    audit = await call("GET", "/api/v5/audit")
+    ops = [e["operation"] for e in audit["data"]]
+    assert "POST /api/v5/data/export" in ops
+    assert audit["data"][0]["actor"] == "admin"
+    await api.stop()
