@@ -149,6 +149,9 @@ class Broker:
                     self._unsubscribe_route(session.client_id, flt)
                 self.suboptions.pop((flt, session.client_id), None)
                 self._release_exclusive(session.client_id, flt)
+                # observers (cluster link, plugins) must see the
+                # subscription END even when the whole session goes
+                self.hooks.run("session.unsubscribed", session.client_id, flt)
             self.durable.discard_session(session.client_id)
             self.sessions.pop(session.client_id, None)
             self.stats.set("sessions.count", len(self.sessions))
@@ -161,6 +164,7 @@ class Broker:
         for flt in list(session.subscriptions):
             self._unsubscribe_route(session.client_id, flt)
             self._release_exclusive(session.client_id, flt)
+            self.hooks.run("session.unsubscribed", session.client_id, flt)
         session.subscriptions.clear()
         self.sessions.pop(session.client_id, None)
         self.stats.set("sessions.count", len(self.sessions))

@@ -1,0 +1,294 @@
+"""Cluster linking: federation of independent clusters over plain MQTT
+— the DCN tier (apps/emqx_cluster_link analog; SURVEY.md §2.6 calls it
+the pattern for the cross-pod plane).
+
+Shape mirrors the reference exactly:
+
+  * the LOCAL cluster configures a link per remote cluster with the
+    topic filters it wants to receive (emqx_cluster_link.erl);
+  * the link's MQTT client connects to the remote cluster, announces
+    the local cluster's ACTUAL route set (local subscriptions
+    intersecting the link topics) as ops on `$LINK/route/v1/<local>`,
+    kept fresh by subscribe/unsubscribe transitions + a bootstrap
+    marker on (re)connect (emqx_cluster_link_router_syncer.erl /
+    _bootstrap.erl);
+  * the REMOTE side's LinkServer (installed wherever linking is
+    enabled) maintains a per-source-cluster extrouter topic index
+    (emqx_cluster_link_extrouter.erl) and, as the in-tree
+    emqx_external_broker implementation does on the publish path
+    (emqx_cluster_link.erl:41-54), forwards matching local publishes —
+    wrapped — to `$LINK/fwd/<cluster>`, which rides the normal broker
+    delivery to the link client's subscription;
+  * the link client unwraps forwarded messages and dispatches them
+    locally with a loop-guard header so they are never re-forwarded.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Dict, List, Optional
+
+from ..broker.hooks import STOP
+from ..broker.message import Message
+from ..client import MqttClient
+from ..models.router import Router
+from ..ops import topic as topic_mod
+from .node import msg_from_wire, msg_to_wire
+from . import wire
+
+log = logging.getLogger("emqx_tpu.cluster.link")
+
+ROUTE_PREFIX = "$LINK/route/v1/"
+FWD_PREFIX = "$LINK/fwd/"
+
+
+LINK_CLIENT_PREFIX = "$cluster-link-"
+
+
+class LinkServer:
+    """Remote-side half: consumes route announcements, forwards
+    matching publishes to each linked cluster's fwd topic.
+
+    Route ops are only honored from the link client identity
+    `$cluster-link-<cluster>` matching the announced cluster name, and
+    — when `allowed_clusters` is set — from clusters on that list;
+    otherwise any broker client could inject {"op":"add","filter":"#"}
+    and siphon all traffic (deployments should additionally restrict
+    the $cluster-link-* client-id prefix via authn/ACL)."""
+
+    def __init__(self, broker, local_name: str, allowed_clusters=None):
+        self.broker = broker
+        self.local_name = local_name
+        self.allowed_clusters = (
+            None if allowed_clusters is None else set(allowed_clusters)
+        )
+        # filter -> source cluster dests (an extrouter per the lot —
+        # dests are cluster names, so one Router serves every link)
+        self.extrouter = Router(use_hash_index=False)
+        self._enabled = False
+
+    def enable(self) -> None:
+        if self._enabled:
+            return
+        # route-op intercept runs EARLY (before retain/validation see
+        # control traffic); forward runs LATE (after rewrites settle)
+        self.broker.hooks.add("message.publish", self._on_publish, priority=950)
+        self.broker.hooks.add("message.publish", self._forward, priority=10)
+        self._enabled = True
+
+    def disable(self) -> None:
+        if self._enabled:
+            self.broker.hooks.delete("message.publish", self._on_publish)
+            self.broker.hooks.delete("message.publish", self._forward)
+            self._enabled = False
+
+    def routes(self, cluster: Optional[str] = None) -> List[tuple]:
+        return [
+            (f, d) for (f, d) in self.extrouter.routes()
+            if cluster is None or d == cluster
+        ]
+
+    # --- control-plane intercept ----------------------------------------
+
+    def _on_publish(self, msg: Message):
+        if not msg.topic.startswith(ROUTE_PREFIX):
+            return None
+        cluster = msg.topic[len(ROUTE_PREFIX):]
+        authorized = (
+            msg.from_client == f"{LINK_CLIENT_PREFIX}{cluster}"
+            and (self.allowed_clusters is None or cluster in self.allowed_clusters)
+        )
+        if not authorized:
+            log.warning(
+                "rejected link route op for %r from client %r",
+                cluster, msg.from_client,
+            )
+            op = None
+        else:
+            try:
+                op = json.loads(msg.payload)
+            except ValueError:
+                log.warning("bad link route op from %s", cluster)
+                op = None
+        if op is not None:
+            self._apply_op(cluster, op)
+        # control traffic never reaches normal dispatch
+        out = Message(**{**msg.__dict__})
+        out.headers = dict(msg.headers, allow_publish=False, intercepted="link")
+        return (STOP, out)
+
+    def _apply_op(self, cluster: str, op: dict) -> None:
+        kind = op.get("op")
+        if kind == "boot":
+            # fresh announcement epoch: drop everything stale
+            for flt, dest in self.routes(cluster):
+                self.extrouter.delete_route(flt, dest)
+        elif kind == "add":
+            try:
+                topic_mod.validate_filter(op["filter"])
+            except (KeyError, ValueError):
+                return
+            if not self.extrouter.has_route(op["filter"], cluster):
+                self.extrouter.add_route(op["filter"], cluster)
+        elif kind == "del":
+            self.extrouter.delete_route(op.get("filter", ""), cluster)
+
+    # --- data-plane forward ----------------------------------------------
+
+    def _forward(self, msg: Message):
+        if msg.topic.startswith("$LINK/"):
+            return None
+        if msg.headers.get("cluster_link"):
+            return None  # arrived over a link: never re-forward (loop)
+        if msg.headers.get("allow_publish") is False:
+            return None
+        clusters = self.extrouter.match_routes(msg.topic)
+        for cluster in clusters:
+            self.broker.publish(
+                Message(
+                    topic=f"{FWD_PREFIX}{cluster}",
+                    payload=wire.encode(msg_to_wire(msg)),
+                    qos=1,
+                    from_client=f"$link-{self.local_name}",
+                    headers={"cluster_link": self.local_name},
+                )
+            )
+        return None
+
+
+class ClusterLink:
+    """Local-side half: one configured link to one remote cluster."""
+
+    def __init__(
+        self,
+        broker,
+        local_name: str,
+        remote_name: str,
+        server: str,  # "host:port"
+        topics: List[str],
+        username: Optional[str] = None,
+        password: Optional[bytes] = None,
+    ):
+        self.broker = broker
+        self.local_name = local_name
+        self.remote_name = remote_name
+        host, _, port = server.rpartition(":")
+        self.addr = (host or "127.0.0.1", int(port))
+        self.topics = list(topics)
+        for flt in self.topics:
+            topic_mod.validate_filter(flt)
+        # announced filter -> the CLIENTS holding it (sets, not
+        # refcounts: session.subscribed fires on every re-subscribe
+        # but unsubscribed fires once — counting would drift)
+        self._wanted: Dict[str, set] = {}
+        self.client = MqttClient(
+            host=self.addr[0],
+            port=self.addr[1],
+            client_id=f"$cluster-link-{local_name}",
+            username=username,
+            password=password,
+            reconnect=True,
+            reconnect_delay=0.5,
+            on_message=self._on_forwarded,
+            on_connected=self._on_connected,
+        )
+        self._started = False
+
+    # --- lifecycle -------------------------------------------------------
+
+    async def start(self) -> None:
+        self.broker.hooks.add("session.subscribed", self._on_subscribed)
+        self.broker.hooks.add("session.unsubscribed", self._on_unsubscribed)
+        # seed from subscriptions that existed before the link did —
+        # the hooks only see transitions from here on
+        for (flt, client) in list(self.broker.suboptions):
+            if self._covered(flt):
+                _g, real = topic_mod.parse_share(flt)
+                self._wanted.setdefault(real, set()).add(client)
+        self._started = True
+        await self.client.connect()
+
+    async def stop(self) -> None:
+        if self._started:
+            self.broker.hooks.delete("session.subscribed", self._on_subscribed)
+            self.broker.hooks.delete("session.unsubscribed", self._on_unsubscribed)
+            self._started = False
+        await self.client.disconnect()
+
+    def status(self) -> dict:
+        return {
+            "name": self.remote_name,
+            "server": f"{self.addr[0]}:{self.addr[1]}",
+            "status": "connected" if self.client.connected else "connecting",
+            "topics": self.topics,
+            "announced_routes": len(self._wanted),
+        }
+
+    # --- route announcements (local -> remote) ---------------------------
+
+    def _covered(self, flt: str) -> bool:
+        group, real = topic_mod.parse_share(flt)
+        return any(
+            topic_mod.intersection(real, t) is not None for t in self.topics
+        )
+
+    async def _on_connected(self) -> None:
+        await self.client.subscribe(f"{FWD_PREFIX}{self.local_name}", qos=1)
+        # bootstrap: epoch marker, then the full current announcement
+        # set (emqx_cluster_link_bootstrap)
+        await self._announce({"op": "boot"})
+        for flt in list(self._wanted):
+            await self._announce({"op": "add", "filter": flt})
+
+    async def _announce(self, op: dict) -> None:
+        try:
+            await self.client.publish(
+                f"{ROUTE_PREFIX}{self.local_name}",
+                json.dumps(op).encode(),
+                qos=1,
+            )
+        except Exception:
+            pass  # reconnect re-bootstraps the whole set
+
+    def _spawn(self, coro) -> None:
+        try:
+            asyncio.get_running_loop()
+        except RuntimeError:
+            coro.close()
+            return
+        asyncio.ensure_future(coro)
+
+    def _on_subscribed(self, client_id, flt, opts) -> None:
+        if client_id == self.client.client_id or not self._covered(flt):
+            return
+        _g, real = topic_mod.parse_share(flt)
+        holders = self._wanted.setdefault(real, set())
+        fresh = not holders
+        holders.add(client_id)
+        if fresh and self.client.connected:
+            self._spawn(self._announce({"op": "add", "filter": real}))
+
+    def _on_unsubscribed(self, client_id, flt, *extra) -> None:
+        _g, real = topic_mod.parse_share(flt)
+        holders = self._wanted.get(real)
+        if holders is None:
+            return
+        holders.discard(client_id)
+        if not holders:
+            del self._wanted[real]
+            if self.client.connected:
+                self._spawn(self._announce({"op": "del", "filter": real}))
+
+    # --- forwarded message intake (remote -> local) -----------------------
+
+    async def _on_forwarded(self, pkt) -> None:
+        try:
+            msg = msg_from_wire(wire.decode(pkt.payload))
+        except Exception:
+            log.warning("undecodable forwarded message from %s", self.remote_name)
+            return
+        # loop guard: dispatch locally, never re-forward
+        msg.headers = dict(msg.headers or {}, cluster_link=self.remote_name)
+        self.broker.publish(msg)

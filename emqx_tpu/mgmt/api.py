@@ -93,6 +93,37 @@ class ApiKeys:
             return False
         return hmac.compare_digest(r["secret_hash"], _hash_pw(api_secret, r["salt"]))
 
+    def export_entries(self) -> List[Dict[str, Any]]:
+        """Serializable entries (hashed secrets only) for data backup."""
+        return [
+            {
+                "api_key": k,
+                "name": v["name"],
+                "desc": v["desc"],
+                "enable": v["enable"],
+                "expired_at": v["expired_at"],
+                "created_at": v["created_at"],
+                "salt": base64.b64encode(v["salt"]).decode(),
+                "secret_hash": base64.b64encode(v["secret_hash"]).decode(),
+            }
+            for k, v in self._keys.items()
+        ]
+
+    def import_entry(self, entry: Dict[str, Any]) -> None:
+        """Restore one exported entry, preserving the name-uniqueness
+        invariant create() enforces."""
+        if any(r["name"] == entry["name"] for r in self._keys.values()):
+            raise ValueError(f"api key name exists: {entry['name']}")
+        self._keys[entry["api_key"]] = {
+            "name": entry["name"],
+            "desc": entry.get("desc", ""),
+            "enable": entry.get("enable", True),
+            "expired_at": entry.get("expired_at"),
+            "created_at": entry.get("created_at", time.time()),
+            "salt": base64.b64decode(entry["salt"]),
+            "secret_hash": base64.b64decode(entry["secret_hash"]),
+        }
+
     def delete(self, name: str) -> bool:
         for k, r in list(self._keys.items()):
             if r["name"] == name:
@@ -280,10 +311,14 @@ class ManagementApi:
             req.query,
         )
 
-    def _data_export(self, req: Request):
+    async def _data_export(self, req: Request):
+        import asyncio
+
         from .backup import export_backup
 
-        path = export_backup(
+        # tar+gzip of the whole retained set must not stall the loop
+        path = await asyncio.to_thread(
+            export_backup,
             self.backup_dir,
             broker=self.broker,
             config=self.config,
@@ -304,7 +339,9 @@ class ManagementApi:
             files = []
         return {"files": files}
 
-    def _data_import(self, req: Request):
+    async def _data_import(self, req: Request):
+        import asyncio
+
         from .backup import import_backup
 
         body = req.json() or {}
@@ -316,7 +353,8 @@ class ManagementApi:
         path = os.path.join(self.backup_dir, fname)
         if not os.path.isfile(path):
             return Response.error(404, "NOT_FOUND", fname)
-        return import_backup(
+        return await asyncio.to_thread(
+            import_backup,
             path,
             broker=self.broker,
             config=self.config,

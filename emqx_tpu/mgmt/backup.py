@@ -66,23 +66,7 @@ def export_backup(
                 ],
             )
         if api_keys is not None:
-            _add_json(
-                tar,
-                "api_keys.json",
-                [
-                    {
-                        "api_key": k,
-                        "name": v["name"],
-                        "desc": v["desc"],
-                        "enable": v["enable"],
-                        "expired_at": v["expired_at"],
-                        "created_at": v["created_at"],
-                        "salt": base64.b64encode(v["salt"]).decode(),
-                        "secret_hash": base64.b64encode(v["secret_hash"]).decode(),
-                    }
-                    for k, v in api_keys._keys.items()
-                ],
-            )
+            _add_json(tar, "api_keys.json", api_keys.export_entries())
         if rules is not None:
             _add_json(
                 tar,
@@ -164,15 +148,7 @@ def import_backup(
             if api_keys is None:
                 break
             try:
-                api_keys._keys[entry["api_key"]] = {
-                    "name": entry["name"],
-                    "desc": entry.get("desc", ""),
-                    "enable": entry.get("enable", True),
-                    "expired_at": entry.get("expired_at"),
-                    "created_at": entry.get("created_at", time.time()),
-                    "salt": base64.b64decode(entry["salt"]),
-                    "secret_hash": base64.b64decode(entry["secret_hash"]),
-                }
+                api_keys.import_entry(entry)
                 report["api_keys"] = report.get("api_keys", 0) + 1
             except Exception as e:  # noqa: BLE001
                 report["errors"].append(f"api_key {entry.get('name')}: {e}")

@@ -74,10 +74,21 @@ class Transformation:
         self.topics = list(conf["topics"])
         self.payload_decoder = conf.get("payload_decoder", "json")
         self.payload_encoder = conf.get("payload_encoder", self.payload_decoder)
-        assert self.payload_decoder in ("json", "none")
+        if self.payload_decoder not in ("json", "none"):
+            raise ValueError(f"unknown payload_decoder {self.payload_decoder!r}")
+        if self.payload_encoder not in ("json", "none"):
+            raise ValueError(f"unknown payload_encoder {self.payload_encoder!r}")
         self.failure_action = conf.get("failure_action", "drop")
         assert self.failure_action in ("drop", "ignore")
         self.operations = list(conf.get("operations", ()))
+        # payload ops with a non-json pipeline would be silently
+        # discarded at encode time — reject the CONFIG, not the traffic
+        if any(op.get("key", "").startswith("payload") for op in self.operations):
+            if self.payload_decoder != "json" or self.payload_encoder != "json":
+                raise ValueError(
+                    "payload operations require payload_decoder and "
+                    "payload_encoder to be 'json'"
+                )
         self.enabled = conf.get("enabled", True)
         self.matched = 0
         self.failed = 0

@@ -1,0 +1,169 @@
+"""Cluster linking: two independent clusters federated over MQTT.
+
+Refs: apps/emqx_cluster_link/src/emqx_cluster_link.erl (external
+broker provider), emqx_cluster_link_extrouter.erl (route mirror),
+emqx_cluster_link_mqtt.erl (transport).
+"""
+
+import asyncio
+
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.packet import SubOpts
+from emqx_tpu.broker.pubsub import Broker
+from emqx_tpu.broker.server import Server
+from emqx_tpu.cluster.link import ClusterLink, LinkServer
+
+
+async def make_cluster(name):
+    broker = Broker()
+    srv = Server(broker, port=0)
+    await srv.start()
+    link_srv = LinkServer(broker, name)
+    link_srv.enable()
+    return broker, srv, link_srv
+
+
+def _sub(b, cid, flt, qos=0):
+    s, _ = b.open_session(cid, True)
+    b.subscribe(s, flt, SubOpts(qos=qos))
+    out = []
+    s.outgoing_sink = out.extend
+    return out
+
+
+async def settle(t=0.25):
+    await asyncio.sleep(t)
+
+
+async def test_route_mirror_and_forwarding(tmp_path):
+    b_a, srv_a, ls_a = await make_cluster("A")
+    b_b, srv_b, ls_b = await make_cluster("B")
+    # A wants sensor data from B
+    link = ClusterLink(
+        b_a, "A", "B", f"127.0.0.1:{srv_b.listen_addr[1]}", topics=["sensors/#"]
+    )
+    try:
+        # local subscriber exists BEFORE the link connects -> bootstrap
+        out_pre = _sub(b_a, "pre", "sensors/pre")
+        await link.start()
+        await settle()
+        # B's extrouter mirrors A's matching route
+        assert ("sensors/pre", "A") in ls_b.routes()
+        # B-side publish crosses the link into A
+        b_b.publish(Message(topic="sensors/pre", payload=b"hello-from-B", qos=1))
+        await settle()
+        assert [p.payload for p in out_pre] == [b"hello-from-B"]
+        # live subscription transitions announce incrementally
+        out_live = _sub(b_a, "live", "sensors/live/+")
+        await settle()
+        assert ("sensors/live/+", "A") in ls_b.routes()
+        b_b.publish(Message(topic="sensors/live/1", payload=b"x"))
+        await settle()
+        assert [p.payload for p in out_live] == [b"x"]
+        # topics OUTSIDE the link config are never announced
+        _sub(b_a, "other", "alerts/#")
+        await settle()
+        assert ("alerts/#", "A") not in ls_b.routes()
+        # unsubscribe retracts the route
+        sess = b_a.sessions["live"]
+        b_a.unsubscribe(sess, "sensors/live/+")
+        await settle()
+        assert ("sensors/live/+", "A") not in ls_b.routes()
+        assert link.status()["status"] == "connected"
+    finally:
+        await link.stop()
+        await srv_a.stop()
+        await srv_b.stop()
+
+
+async def test_no_forward_loop_bidirectional(tmp_path):
+    """Both clusters link to each other on the same filters: a message
+    must cross exactly once, never ping-pong."""
+    b_a, srv_a, ls_a = await make_cluster("A")
+    b_b, srv_b, ls_b = await make_cluster("B")
+    link_ab = ClusterLink(
+        b_a, "A", "B", f"127.0.0.1:{srv_b.listen_addr[1]}", topics=["t/#"]
+    )
+    link_ba = ClusterLink(
+        b_b, "B", "A", f"127.0.0.1:{srv_a.listen_addr[1]}", topics=["t/#"]
+    )
+    try:
+        out_a = _sub(b_a, "ca", "t/x")
+        out_b = _sub(b_b, "cb", "t/x")
+        await link_ab.start()
+        await link_ba.start()
+        await settle()
+        b_a.publish(Message(topic="t/x", payload=b"once"))
+        await settle(0.4)
+        assert [p.payload for p in out_a] == [b"once"]  # local delivery
+        assert [p.payload for p in out_b] == [b"once"]  # exactly one hop
+    finally:
+        await link_ab.stop()
+        await link_ba.stop()
+        await srv_a.stop()
+        await srv_b.stop()
+
+
+async def test_reconnect_rebootstraps(tmp_path):
+    b_a, srv_a, ls_a = await make_cluster("A")
+    b_b, srv_b, ls_b = await make_cluster("B")
+    port_b = srv_b.listen_addr[1]
+    link = ClusterLink(b_a, "A", "B", f"127.0.0.1:{port_b}", topics=["d/#"])
+    try:
+        out = _sub(b_a, "c1", "d/1")
+        await link.start()
+        await settle()
+        assert ("d/1", "A") in ls_b.routes()
+        # remote listener restarts on the same port: link reconnects
+        # and re-announces from the boot marker
+        await srv_b.stop()
+        srv_b = Server(b_b, port=port_b)
+        await settle(0.3)
+        await srv_b.start()
+        await settle(1.2)
+        assert ("d/1", "A") in ls_b.routes()
+        b_b.publish(Message(topic="d/1", payload=b"after-restart"))
+        await settle()
+        assert [p.payload for p in out] == [b"after-restart"]
+    finally:
+        await link.stop()
+        await srv_a.stop()
+        await srv_b.stop()
+
+
+async def test_route_injection_rejected(tmp_path):
+    """An ordinary client must not be able to inject federation routes
+    (read-ACL bypass) or wipe a legitimate cluster's mirror."""
+    b_a, srv_a, ls_a = await make_cluster("A")
+    b_b, srv_b, ls_b = await make_cluster("B")
+    link = ClusterLink(
+        b_a, "A", "B", f"127.0.0.1:{srv_b.listen_addr[1]}", topics=["t/#"]
+    )
+    try:
+        _sub(b_a, "c1", "t/real")
+        await link.start()
+        await settle()
+        assert ("t/real", "A") in ls_b.routes()
+        # an ordinary B-side client forges route ops
+        import json as _json
+
+        b_b.publish(Message(topic="$LINK/route/v1/evil",
+                            payload=_json.dumps({"op": "add", "filter": "#"}).encode(),
+                            from_client="attacker"))
+        b_b.publish(Message(topic="$LINK/route/v1/A",
+                            payload=_json.dumps({"op": "boot"}).encode(),
+                            from_client="attacker"))
+        await settle()
+        assert ("#", "evil") not in ls_b.routes()  # injection rejected
+        assert ("t/real", "A") in ls_b.routes()  # wipe rejected
+        # allowlist: unknown cluster rejected even with matching id
+        ls_b.allowed_clusters = {"A"}
+        b_b.publish(Message(topic="$LINK/route/v1/X",
+                            payload=_json.dumps({"op": "add", "filter": "#"}).encode(),
+                            from_client="$cluster-link-X"))
+        await settle()
+        assert ("#", "X") not in ls_b.routes()
+    finally:
+        await link.stop()
+        await srv_a.stop()
+        await srv_b.stop()
