@@ -1,0 +1,316 @@
+"""Node boot orchestration — the emqx_machine analog.
+
+The reference boots a sorted application list (gproc, esockd, ...,
+emqx; apps/emqx_machine/src/emqx_machine_boot.erl:34-47), starts
+autocluster, installs signal handlers, and tears everything down
+through a terminator. Here `Node` wires every subsystem from one
+checked config in dependency order:
+
+    config -> broker(+caps/auth/modules/governance/durable) ->
+    observability -> cluster(+DS replication) -> listeners ->
+    gateways -> cluster links -> management API -> plugins
+
+and stops them in reverse. `main()` is the release entry
+(`python -m emqx_tpu.boot -c etc/emqx.conf`), with SIGINT/SIGTERM
+triggering a graceful stop (emqx_machine_terminator analog).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import signal
+from typing import List, Optional
+
+log = logging.getLogger("emqx_tpu.boot")
+
+
+class Node:
+    def __init__(
+        self,
+        config_files: Optional[List[str]] = None,
+        config_text: str = "",
+    ):
+        from .config.config import Config
+        from .config.default_schema import broker_schema
+
+        self.config = Config.load(
+            broker_schema(), files=config_files or (), text=config_text
+        )
+        self.broker = None
+        self.cluster_node = None
+        self.listeners = None
+        self.gateways = None
+        self.mgmt = None
+        self.obs = None
+        self.auth = None
+        self.durable_mgr = None
+        self.durable_db = None
+        self.replicator = None
+        self.plugins = None
+        self.links: list = []
+        self.modules: list = []
+        self._stopping = False
+
+    # --- boot order ------------------------------------------------------
+
+    async def start(self) -> None:
+        cfg = self.config
+        data_dir = cfg.get("node.data_dir")
+        os.makedirs(data_dir, exist_ok=True)
+        node_name = cfg.get("node.name")
+
+        # 1. broker core (+ caps from the mqtt zone config)
+        from .broker.caps import MqttCaps
+        from .cluster.node import ClusterBroker, ClusterNode
+        from .models.retainer import PersistentRetainer
+
+        broker = ClusterBroker(
+            shared_strategy=cfg.get("broker.shared_subscription_strategy"),
+        )
+        broker.caps = MqttCaps(
+            max_packet_size=cfg.get("mqtt.max_packet_size"),
+            max_clientid_len=cfg.get("mqtt.max_clientid_len"),
+            max_topic_levels=cfg.get("mqtt.max_topic_levels"),
+            max_qos_allowed=cfg.get("mqtt.max_qos_allowed"),
+            max_topic_alias=cfg.get("mqtt.max_topic_alias"),
+            retain_available=cfg.get("mqtt.retain_available"),
+            wildcard_subscription=cfg.get("mqtt.wildcard_subscription"),
+            shared_subscription=cfg.get("mqtt.shared_subscription"),
+            exclusive_subscription=cfg.get("mqtt.exclusive_subscription"),
+        )
+        if cfg.get("retainer.enable"):
+            broker.retainer = PersistentRetainer(
+                os.path.join(data_dir, "retained"),
+                max_retained=cfg.get("retainer.max_retained_messages") or 1_000_000,
+            )
+        self.broker = broker
+
+        # 2. auth pipeline
+        from .auth.bridge import AuthPipeline
+
+        self.auth = AuthPipeline()
+        self.auth.install(broker.hooks)
+
+        # 3. feature modules
+        from .modules import AutoSubscribe, DelayedPublish, TopicRewrite
+
+        if cfg.get("delayed.enable"):
+            d = DelayedPublish(
+                broker, max_delayed_messages=cfg.get("delayed.max_delayed_messages")
+            )
+            d.enable()
+            self.modules.append(d)
+        rw_rules = cfg.get("rewrite")
+        if rw_rules:
+            rw = TopicRewrite(broker, rw_rules)
+            rw.enable()
+            self.modules.append(rw)
+        auto_topics = cfg.get("auto_subscribe.topics")
+        if auto_topics:
+            a = AutoSubscribe(broker, auto_topics)
+            a.enable()
+            self.modules.append(a)
+
+        # 4. rule engine
+        from .rules.engine import RuleEngine
+
+        self.rules = RuleEngine(
+            broker, ignore_sys=cfg.get("rule_engine.ignore_sys_message")
+        )
+        for rid, rconf in (cfg.get("rule_engine.rules") or {}).items():
+            self.rules.create_rule(
+                rid,
+                rconf["sql"],
+                rconf.get("actions") or [],
+                enable=rconf.get("enable", True),
+                description=rconf.get("description", ""),
+            )
+
+        # 5. durable sessions (+ storage)
+        if cfg.get("durable_sessions.enable"):
+            from .ds import Db
+            from .ds.session_ds import DurableSessionManager
+
+            ds_dir = cfg.get("durable_storage.messages.data_dir") or os.path.join(
+                data_dir, "ds"
+            )
+            self.durable_db = Db(
+                "messages",
+                data_dir=ds_dir,
+                n_shards=cfg.get("durable_storage.messages.n_shards"),
+            )
+            self.durable_mgr = DurableSessionManager(
+                self.durable_db, state_dir=ds_dir
+            )
+            broker.enable_durable(self.durable_mgr)
+
+        # 6. observability ($SYS, alarms, traces, slow subs, prometheus)
+        from .obs import Observability
+
+        self.obs = Observability(
+            broker,
+            node_name=node_name,
+            trace_dir=os.path.join(data_dir, "trace"),
+        )
+        self.obs.start(cfg.get("sys_topics.sys_heartbeat_interval") / 1000.0)
+
+        # 7. cluster membership + DS replication
+        seeds = cfg.get("cluster.static_seeds")
+        if seeds or cfg.get("cluster.discovery_strategy") == "static":
+            node = ClusterNode(node_name, broker=broker, cookie=cfg.get("node.cookie"))
+            await node.start()
+            self.cluster_node = node
+            for seed in seeds:
+                host, _, port = seed.rpartition(":")
+                try:
+                    await node.join((host, int(port)))
+                    break
+                except Exception:
+                    log.warning("seed %s unreachable", seed)
+            if self.durable_mgr is not None and cfg.get(
+                "durable_storage.messages.backend"
+            ) == "builtin_raft":
+                from .ds.replication import ReplicatedDs
+
+                self.replicator = ReplicatedDs(node, self.durable_mgr)
+
+        # 8. listeners
+        from .broker.listeners import Listeners
+
+        self.listeners = Listeners(broker)
+        lconf = cfg.get("listeners")
+        if not any((lconf or {}).get(t) for t in ("tcp", "ssl", "ws", "wss")):
+            lconf = {"tcp": {"default": {"bind": "0.0.0.0:1883"}}}
+        await self.listeners.start_all(lconf)
+
+        # 9. gateways
+        from .gateway import GatewayRegistry
+
+        self.gateways = GatewayRegistry(broker)
+        for gname, gconf in (cfg.get("gateway") or {}).items():
+            if gconf.get("enable", True):
+                await self.gateways.load(gname, gconf)
+
+        # 10. cluster links
+        if cfg.get("cluster_link.enable"):
+            from .cluster.link import ClusterLink, LinkServer
+
+            cluster_name = cfg.get("cluster.name")
+            server = LinkServer(
+                broker,
+                cluster_name,
+                allowed_clusters=[
+                    l["name"] for l in cfg.get("cluster_link.links")
+                ] or None,
+            )
+            server.enable()
+            self.link_server = server
+            for lk in cfg.get("cluster_link.links"):
+                link = ClusterLink(
+                    broker,
+                    cluster_name,
+                    lk["name"],
+                    lk["server"],
+                    topics=lk.get("topics") or [],
+                    username=lk.get("username"),
+                    password=(lk.get("password") or "").encode() or None,
+                )
+                await link.start()
+                self.links.append(link)
+
+        # 11. management API
+        if cfg.get("api.enable"):
+            from .broker.listeners import parse_bind
+            from .mgmt.api import ManagementApi
+
+            self.mgmt = ManagementApi(
+                broker,
+                config=cfg,
+                rules=self.rules,
+                banned=self.auth.banned,
+                node=self.cluster_node,
+                node_name=node_name,
+                obs=self.obs,
+                backup_dir=os.path.join(data_dir, "backup"),
+            )
+            host, port = parse_bind(cfg.get("api.bind"))
+            await self.mgmt.start(host, port)
+
+        # 12. plugins (restarts previously enabled ones)
+        from .plugins import PluginManager
+
+        self.plugins = PluginManager(
+            broker,
+            install_dir=cfg.get("plugins.install_dir")
+            or os.path.join(data_dir, "plugins"),
+        )
+        log.info("node %s started", node_name)
+
+    async def stop(self) -> None:
+        if self._stopping:
+            return
+        self._stopping = True
+        for name in [p["name"] for p in (self.plugins.list() if self.plugins else [])]:
+            try:
+                self.plugins.stop(name)
+            except Exception:
+                pass
+        if self.mgmt is not None:
+            await self.mgmt.stop()
+        for link in self.links:
+            try:
+                await link.stop()
+            except Exception:
+                pass
+        if self.gateways is not None:
+            await self.gateways.unload_all()
+        if self.listeners is not None:
+            await self.listeners.stop_all()
+        if self.cluster_node is not None:
+            await self.cluster_node.stop()
+        if self.obs is not None:
+            self.obs.stop()
+        if self.durable_mgr is not None:
+            self.durable_mgr.close()
+        if self.durable_db is not None:
+            self.durable_db.close()
+        retainer = getattr(self.broker, "retainer", None)
+        if retainer is not None and hasattr(retainer, "close"):
+            retainer.close()
+        log.info("node stopped")
+
+    async def run_forever(self) -> None:
+        """Start, then park until SIGINT/SIGTERM; graceful stop."""
+        await self.start()
+        stop_ev = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                loop.add_signal_handler(sig, stop_ev.set)
+            except NotImplementedError:
+                pass
+        try:
+            await stop_ev.wait()
+        finally:
+            await self.stop()
+
+
+def main() -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(description="emqx_tpu broker node")
+    ap.add_argument("-c", "--config", action="append", default=[],
+                    help="config file (repeatable; later override earlier)")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args()
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s %(message)s",
+    )
+    asyncio.run(Node(config_files=args.config).run_forever())
+
+
+if __name__ == "__main__":
+    main()

@@ -93,6 +93,20 @@ class LinkServer:
     # --- control-plane intercept ----------------------------------------
 
     def _on_publish(self, msg: Message):
+        if msg.topic.startswith(FWD_PREFIX):
+            # only OUR forward wrapper may publish into fwd topics — an
+            # ordinary client pushing wire blobs there would inject
+            # arbitrary (ACL-bypassing) messages into the peer cluster
+            if msg.from_client != f"$link-{self.local_name}":
+                log.warning(
+                    "rejected fwd-topic publish from client %r", msg.from_client
+                )
+                out = Message(**{**msg.__dict__})
+                out.headers = dict(
+                    msg.headers, allow_publish=False, intercepted="link"
+                )
+                return (STOP, out)
+            return None
         if not msg.topic.startswith(ROUTE_PREFIX):
             return None
         cluster = msg.topic[len(ROUTE_PREFIX):]
@@ -179,10 +193,13 @@ class ClusterLink:
         self.topics = list(topics)
         for flt in self.topics:
             topic_mod.validate_filter(flt)
-        # announced filter -> the CLIENTS holding it (sets, not
-        # refcounts: session.subscribed fires on every re-subscribe
-        # but unsubscribed fires once — counting would drift)
+        # announced real-filter -> set of (client, FULL filter) holders
+        # (sets, not refcounts: session.subscribed fires on every
+        # re-subscribe but unsubscribed fires once; the full filter
+        # keeps '$share/g/t' and plain 't' as distinct holders)
         self._wanted: Dict[str, set] = {}
+        self._tasks: set = set()  # strong refs: bare ensure_future is GC-able
+        self._retry_task = None
         self.client = MqttClient(
             host=self.addr[0],
             port=self.addr[1],
@@ -206,11 +223,35 @@ class ClusterLink:
         for (flt, client) in list(self.broker.suboptions):
             if self._covered(flt):
                 _g, real = topic_mod.parse_share(flt)
-                self._wanted.setdefault(real, set()).add(client)
+                self._wanted.setdefault(real, set()).add((client, flt))
         self._started = True
-        await self.client.connect()
+        try:
+            await self.client.connect()
+        except Exception as e:  # noqa: BLE001
+            # a down federation peer must not fail local boot — keep
+            # retrying in the background (MqttClient's own reconnect
+            # loop only engages after a FIRST successful connect)
+            log.warning(
+                "link %s peer unreachable (%s); retrying in background",
+                self.remote_name, e,
+            )
+            self._retry_task = asyncio.ensure_future(self._retry_connect())
+
+    async def _retry_connect(self) -> None:
+        while self._started and not self.client.connected:
+            await asyncio.sleep(self.client.reconnect_delay)
+            try:
+                await self.client.connect()
+                return
+            except Exception:
+                continue
 
     async def stop(self) -> None:
+        if self._retry_task is not None:
+            self._retry_task.cancel()
+            self._retry_task = None
+        for t in list(self._tasks):
+            t.cancel()
         if self._started:
             self.broker.hooks.delete("session.subscribed", self._on_subscribed)
             self.broker.hooks.delete("session.unsubscribed", self._on_unsubscribed)
@@ -258,7 +299,9 @@ class ClusterLink:
         except RuntimeError:
             coro.close()
             return
-        asyncio.ensure_future(coro)
+        task = asyncio.ensure_future(coro)
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
 
     def _on_subscribed(self, client_id, flt, opts) -> None:
         if client_id == self.client.client_id or not self._covered(flt):
@@ -266,7 +309,7 @@ class ClusterLink:
         _g, real = topic_mod.parse_share(flt)
         holders = self._wanted.setdefault(real, set())
         fresh = not holders
-        holders.add(client_id)
+        holders.add((client_id, flt))
         if fresh and self.client.connected:
             self._spawn(self._announce({"op": "add", "filter": real}))
 
@@ -275,7 +318,7 @@ class ClusterLink:
         holders = self._wanted.get(real)
         if holders is None:
             return
-        holders.discard(client_id)
+        holders.discard((client_id, flt))
         if not holders:
             del self._wanted[real]
             if self.client.connected:
@@ -288,6 +331,14 @@ class ClusterLink:
             msg = msg_from_wire(wire.decode(pkt.payload))
         except Exception:
             log.warning("undecodable forwarded message from %s", self.remote_name)
+            return
+        # never let a forwarded payload smuggle control traffic: a
+        # wire blob claiming a $LINK topic could forge route ops with
+        # an arbitrary from_client
+        if msg.topic.startswith("$LINK/"):
+            log.warning(
+                "dropped forwarded control-topic message from %s", self.remote_name
+            )
             return
         # loop guard: dispatch locally, never re-forward
         msg.headers = dict(msg.headers or {}, cluster_link=self.remote_name)

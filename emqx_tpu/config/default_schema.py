@@ -353,6 +353,20 @@ def broker_schema() -> Struct:
                 )
             ),
             "telemetry": Field(Struct({"enable": Field(Bool(), default=False)})),
+            # gateway.<type> = per-gateway config (emqx_gateway conf root)
+            "gateway": Field(Map(Struct({}, open=True)), default={}),
+            # cluster.links analog, flattened to its own root
+            "cluster_link": Field(
+                Struct(
+                    {
+                        "enable": Field(Bool(), default=False),
+                        "links": Field(Array(Struct({}, open=True)), default=[]),
+                    }
+                )
+            ),
+            "plugins": Field(
+                Struct({"install_dir": Field(String(), default=None)})
+            ),
             "api": Field(
                 Struct(
                     {

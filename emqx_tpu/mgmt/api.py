@@ -111,9 +111,11 @@ class ApiKeys:
 
     def import_entry(self, entry: Dict[str, Any]) -> None:
         """Restore one exported entry, preserving the name-uniqueness
-        invariant create() enforces."""
-        if any(r["name"] == entry["name"] for r in self._keys.values()):
-            raise ValueError(f"api key name exists: {entry['name']}")
+        invariant create() enforces. Re-importing the SAME key record
+        is an idempotent upsert (disaster-recovery replays)."""
+        for k, r in self._keys.items():
+            if r["name"] == entry["name"] and k != entry["api_key"]:
+                raise ValueError(f"api key name exists: {entry['name']}")
         self._keys[entry["api_key"]] = {
             "name": entry["name"],
             "desc": entry.get("desc", ""),
@@ -314,12 +316,10 @@ class ManagementApi:
     async def _data_export(self, req: Request):
         import asyncio
 
-        from .backup import export_backup
+        from .backup import collect_sections, write_backup
 
-        # tar+gzip of the whole retained set must not stall the loop
-        path = await asyncio.to_thread(
-            export_backup,
-            self.backup_dir,
+        # snapshot ON the loop (reads live tables), tar+gzip OFF it
+        sections = collect_sections(
             broker=self.broker,
             config=self.config,
             rules=self.rules,
@@ -327,6 +327,7 @@ class ManagementApi:
             api_keys=self.api_keys,
             node_name=self.node_name,
         )
+        path = await asyncio.to_thread(write_backup, self.backup_dir, sections)
         return {"filename": os.path.basename(path), "path": path}
 
     def _data_files(self, req: Request):
@@ -353,14 +354,18 @@ class ManagementApi:
         path = os.path.join(self.backup_dir, fname)
         if not os.path.isfile(path):
             return Response.error(404, "NOT_FOUND", fname)
-        return await asyncio.to_thread(
-            import_backup,
+        from .backup import read_sections
+
+        # archive IO off-loop; state mutation ON the loop
+        sections = await asyncio.to_thread(read_sections, path)
+        return import_backup(
             path,
             broker=self.broker,
             config=self.config,
             rules=self.rules,
             banned=self.banned,
             api_keys=self.api_keys,
+            sections=sections,
         )
 
     def _status(self, req: Request) -> Response:

@@ -79,7 +79,8 @@ class Transformation:
         if self.payload_encoder not in ("json", "none"):
             raise ValueError(f"unknown payload_encoder {self.payload_encoder!r}")
         self.failure_action = conf.get("failure_action", "drop")
-        assert self.failure_action in ("drop", "ignore")
+        if self.failure_action not in ("drop", "ignore"):
+            raise ValueError(f"unknown failure_action {self.failure_action!r}")
         self.operations = list(conf.get("operations", ()))
         # payload ops with a non-json pipeline would be silently
         # discarded at encode time — reject the CONFIG, not the traffic
