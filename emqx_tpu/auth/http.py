@@ -1,0 +1,156 @@
+"""HTTP authn/authz backends — emqx_auth_http analog.
+
+The reference delegates authentication and per-action authorization to
+an external HTTP service (apps/emqx_auth_http): a request templated
+from the client's credentials; the JSON response decides
+allow/deny/ignore plus is_superuser. Calls are synchronous with a
+bounded timeout — the same blocking window the reference imposes on
+the channel process; size the timeout accordingly.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Dict, Optional
+
+from .authn import IGNORE, AuthResult, Credentials, Provider
+from .authz import Source
+
+log = logging.getLogger("emqx_tpu.auth.http")
+
+
+def _fill(template: str, mapping: Dict[str, str]) -> str:
+    out = template
+    for k, v in mapping.items():
+        out = out.replace("${" + k + "}", v)
+    return out
+
+
+def _fill_url(template: str, mapping: Dict[str, str]) -> str:
+    """URL templating percent-encodes every value — a client id like
+    'c&topic=public/t' must not rewrite the query string."""
+    out = template
+    for k, v in mapping.items():
+        out = out.replace("${" + k + "}", urllib.parse.quote(v, safe=""))
+    return out
+
+
+def _request(
+    url: str,
+    method: str,
+    body: Optional[dict],
+    headers: Dict[str, str],
+    timeout: float,
+) -> Optional[dict]:
+    data = None
+    hdrs = dict(headers)
+    if method == "POST":
+        data = json.dumps(body or {}).encode()
+        hdrs.setdefault("content-type", "application/json")
+    elif body:
+        url = url + ("&" if "?" in url else "?") + urllib.parse.urlencode(body)
+    req = urllib.request.Request(url, data=data, headers=hdrs, method=method)
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        if resp.status == 204:
+            return {}
+        return json.loads(resp.read() or b"{}")
+
+
+class HttpAuthnProvider(Provider):
+    """POST/GET the credentials; response:
+    {"result": "allow"|"deny"|"ignore", "is_superuser": bool}.
+    HTTP errors / timeouts -> IGNORE (fall through the chain), the
+    reference's resilience default."""
+
+    def __init__(
+        self,
+        url: str,
+        method: str = "POST",
+        headers: Optional[Dict[str, str]] = None,
+        timeout: float = 5.0,
+        body: Optional[Dict[str, str]] = None,
+    ):
+        self.url = url
+        self.method = method.upper()
+        self.headers = headers or {}
+        self.timeout = timeout
+        # body template; values support ${clientid}/${username}/
+        # ${password}/${peerhost}
+        self.body_tpl = body or {
+            "clientid": "${clientid}",
+            "username": "${username}",
+            "password": "${password}",
+        }
+
+    def authenticate(self, creds: Credentials):
+        mapping = {
+            "clientid": creds.client_id,
+            "username": creds.username or "",
+            "password": (creds.password or b"").decode("utf-8", "replace"),
+            "peerhost": creds.peerhost or "",
+        }
+        body = {k: _fill(v, mapping) for k, v in self.body_tpl.items()}
+        try:
+            out = _request(
+                _fill_url(self.url, mapping), self.method, body, self.headers,
+                self.timeout,
+            ) or {}
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            log.warning("http authn request failed: %s", e)
+            return IGNORE  # next provider decides
+        result = out.get("result", "ignore")
+        if result == "allow":
+            return AuthResult(
+                True,
+                superuser=bool(out.get("is_superuser", False)),
+                attrs={"acl": out.get("acl")} if out.get("acl") else {},
+            )
+        if result == "deny":
+            return AuthResult(False, "http_deny")
+        return IGNORE
+
+
+class HttpAuthzSource(Source):
+    """Per-(action, topic) authorization check; response
+    {"result": "allow"|"deny"|"ignore"}. Failures -> ignore."""
+
+    def __init__(
+        self,
+        url: str,
+        method: str = "POST",
+        headers: Optional[Dict[str, str]] = None,
+        timeout: float = 5.0,
+    ):
+        self.url = url
+        self.method = method.upper()
+        self.headers = headers or {}
+        self.timeout = timeout
+
+    def authorize(self, client_id, username, peerhost, action, topic) -> str:
+        mapping = {
+            "clientid": client_id,
+            "username": username or "",
+            "peerhost": peerhost or "",
+            "action": action,
+            "topic": topic,
+        }
+        body = {
+            "clientid": client_id,
+            "username": username or "",
+            "action": action,
+            "topic": topic,
+        }
+        try:
+            out = _request(
+                _fill_url(self.url, mapping), self.method, body, self.headers,
+                self.timeout,
+            ) or {}
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            log.warning("http authz request failed: %s", e)
+            return "ignore"
+        r = out.get("result", "ignore")
+        return r if r in ("allow", "deny") else "ignore"

@@ -198,12 +198,14 @@ class Node:
             from .cluster.link import ClusterLink, LinkServer
 
             cluster_name = cfg.get("cluster.name")
+            # an empty links list means deny-all route ops, never
+            # allow-any — pass the (possibly empty) list through
             server = LinkServer(
                 broker,
                 cluster_name,
                 allowed_clusters=[
                     l["name"] for l in cfg.get("cluster_link.links")
-                ] or None,
+                ],
             )
             server.enable()
             self.link_server = server
@@ -254,7 +256,9 @@ class Node:
         self._stopping = True
         for name in [p["name"] for p in (self.plugins.list() if self.plugins else [])]:
             try:
-                self.plugins.stop(name)
+                # shutdown stop must not persist disabled state — the
+                # next boot restarts previously-enabled plugins
+                self.plugins.stop(name, persist=False)
             except Exception:
                 pass
         if self.mgmt is not None:

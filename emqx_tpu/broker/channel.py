@@ -75,6 +75,9 @@ class Channel:
         # client's advertised maximum packet size: outgoing PUBLISHes
         # exceeding it are dropped, not sent (MQTT-5 §3.1.2.11.4)
         self.client_max_packet: Optional[int] = None
+        # (client_id, verdict) pre-computed by the connection layer's
+        # off-loop authenticate run; consumed once by _handle_connect
+        self.preauth = None
 
     # --- inbound dispatch -------------------------------------------------
 
@@ -127,11 +130,17 @@ class Channel:
                     )
                 ]
             client_id = f"auto-{id(self):x}-{int(time.time() * 1000) & 0xFFFFFF:x}"
-        ok = self.broker.hooks.run_fold(
-            "client.authenticate",
-            (dict(client_id=client_id, username=pkt.username, password=pkt.password, peer=self.peer),),
-            True,
-        )
+        if self.preauth is not None and self.preauth[0] == pkt.client_id:
+            # the connection layer ran the authenticate fold OFF-loop
+            # (blocking providers like HTTP must not stall the broker)
+            ok = self.preauth[1]
+            self.preauth = None
+        else:
+            ok = self.broker.hooks.run_fold(
+                "client.authenticate",
+                (dict(client_id=client_id, username=pkt.username, password=pkt.password, peer=self.peer),),
+                True,
+            )
         if ok is not True:
             code = (
                 ok

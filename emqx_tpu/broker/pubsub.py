@@ -248,6 +248,13 @@ class Broker:
         self.hooks.run("session.unsubscribed", session.client_id, flt)
         return True
 
+    def connected_count(self) -> int:
+        """Sessions with a live transport — ONE definition, shared by
+        eviction, rebalance RPC, and telemetry."""
+        return sum(
+            1 for s in self.sessions.values() if getattr(s, "connected", False)
+        )
+
     def _release_exclusive(self, client_id: str, flt: str) -> None:
         if self.exclusive.get(flt) == client_id:
             del self.exclusive[flt]

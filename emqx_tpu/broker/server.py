@@ -157,6 +157,30 @@ class Connection:
                         self._send_packets([Disconnect(e.code)])
                     break
                 for pkt in pkts:
+                    from .packet import Connect
+
+                    if (
+                        isinstance(pkt, Connect)
+                        and not self.channel.connected
+                        and pkt.client_id
+                    ):
+                        # run the authenticate fold OFF-loop: providers
+                        # doing network IO (HTTP authn) block for up to
+                        # their timeout, and that must stall only THIS
+                        # connection — never the whole broker loop
+                        info = dict(
+                            client_id=pkt.client_id,
+                            username=pkt.username,
+                            password=pkt.password,
+                            peer=self.channel.peer,
+                        )
+                        verdict = await asyncio.get_running_loop().run_in_executor(
+                            None,
+                            lambda: self.server.broker.hooks.run_fold(
+                                "client.authenticate", (info,), True
+                            ),
+                        )
+                        self.channel.preauth = (pkt.client_id, verdict)
                     if isinstance(pkt, Publish):
                         # backpressure: pausing here stops reading the
                         # socket, which pushes back on the publisher's
@@ -245,6 +269,8 @@ class Server:
         self._conns: set = set()
         self._pending: set = set()  # transports still in ws handshake
         self.listen_addr = None
+        # set by the eviction agent: shed new connections while draining
+        self.evicting = False
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
@@ -262,6 +288,10 @@ class Server:
     async def _on_client(self, reader, writer) -> None:
         # accept gates: OLP shed (emqx_olp new-conn backoff) first,
         # then the listener's connection-rate bucket (max_conn_rate)
+        if self.evicting:
+            self.broker.metrics.inc("eviction.conn_rejected")
+            writer.close()
+            return
         if self.shedder is not None and self.shedder.overloaded:
             self.shedder.shed_count += 1
             self.broker.metrics.inc("olp.new_conn_shed")

@@ -270,6 +270,18 @@ class ClusterNode:
                 "takeover": self._handle_takeover,
             },
         )
+        reg.register_all(
+            "node",
+            1,
+            {
+                # load view for the rebalance coordinator
+                "info": lambda: {
+                    "node": self.node_id,
+                    "sessions": self.broker.connected_count(),
+                    "subscriptions": len(self.broker.suboptions),
+                },
+            },
+        )
 
     # --- route write stream (local transitions -> announced ops) ---------
 

@@ -1,0 +1,178 @@
+"""Eviction agent + node evacuation/rebalance — the
+emqx_eviction_agent / emqx_node_rebalance analog.
+
+Evacuation drains a node for maintenance: stop accepting new
+connections, then disconnect clients at a bounded rate with a v5
+USE_ANOTHER_SERVER reason (+ server_reference) so they reconnect to a
+peer; durable sessions survive the move through the DS replication
+tier. Rebalance computes the cluster's mean session count over the RPC
+plane and evicts only the local excess
+(apps/emqx_node_rebalance/src/emqx_node_rebalance_evacuation.erl,
+emqx_node_rebalance.erl).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import List, Optional
+
+from ..broker.packet import Disconnect, MQTT_V5, RC
+
+log = logging.getLogger("emqx_tpu.cluster.rebalance")
+
+
+class EvictionAgent:
+    """Per-node: blocks new connections while enabled and disconnects
+    existing clients on demand (emqx_eviction_agent.erl)."""
+
+    def __init__(self, broker):
+        self.broker = broker
+        self.enabled = False
+        self.evicted = 0
+
+    def enable(self) -> None:
+        """New connections are shed at accept while enabled."""
+        self.enabled = True
+        for srv in self.broker.servers:
+            srv.evicting = True
+
+    def disable(self) -> None:
+        self.enabled = False
+        for srv in self.broker.servers:
+            srv.evicting = False
+
+    def connection_count(self) -> int:
+        return self.broker.connected_count()
+
+    def evict_connections(self, n: int, server_reference: str = "") -> int:
+        """Disconnect up to n connected clients: v5 clients get a
+        DISCONNECT USE_ANOTHER_SERVER first; then the transport closes.
+        Sessions (incl. durable) keep their state for the takeover."""
+        done = 0
+        for session in list(self.broker.sessions.values()):
+            if done >= n:
+                break
+            if not getattr(session, "connected", False):
+                continue
+            sink = getattr(session, "outgoing_sink", None)
+            closer = getattr(session, "closer", None)
+            if sink is None and closer is None:
+                continue  # not transport-attached (internal session)
+            if sink is not None:
+                try:
+                    props = (
+                        {"server_reference": server_reference}
+                        if server_reference
+                        else {}
+                    )
+                    sink([Disconnect(RC.USE_ANOTHER_SERVER, props=props)])
+                except Exception:
+                    pass
+            if closer is not None:
+                try:
+                    closer()
+                except Exception:
+                    pass
+            session.connected = False
+            done += 1
+        self.evicted += done
+        return done
+
+
+class NodeEvacuation:
+    """Drain the whole node at conn_evict_rate connections/second."""
+
+    def __init__(
+        self,
+        broker,
+        conn_evict_rate: int = 500,
+        server_reference: str = "",
+    ):
+        self.agent = EvictionAgent(broker)
+        self.rate = max(1, conn_evict_rate)
+        self.server_reference = server_reference
+        self.status = "idle"
+        self._task: Optional[asyncio.Task] = None
+
+    async def start(self) -> None:
+        if self.status == "evacuating":
+            return
+        self.status = "evacuating"
+        self.agent.enable()
+        self._task = asyncio.ensure_future(self._run())
+
+    async def _run(self) -> None:
+        try:
+            while self.agent.connection_count() > 0:
+                self.agent.evict_connections(
+                    self.rate, server_reference=self.server_reference
+                )
+                await asyncio.sleep(1.0)
+            self.status = "drained"
+        except asyncio.CancelledError:
+            pass
+
+    async def stop(self) -> None:
+        """Abort: resume accepting connections."""
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        self.agent.disable()
+        self.status = "idle"
+
+    def stats(self) -> dict:
+        return {
+            "status": self.status,
+            "current_connections": self.agent.connection_count(),
+            "evicted": self.agent.evicted,
+            "rate": self.rate,
+        }
+
+
+class Rebalance:
+    """Move the local node toward the cluster mean session count by
+    evicting only the excess (emqx_node_rebalance.erl: coordinator
+    computes donor/recipient split; here the local node self-assesses
+    against peer counts fetched over the RPC plane)."""
+
+    def __init__(self, node, conn_evict_rate: int = 100, rel_threshold: float = 1.1):
+        self.node = node  # ClusterNode
+        self.agent = EvictionAgent(node.broker)
+        self.rate = max(1, conn_evict_rate)
+        self.rel_threshold = rel_threshold
+
+    async def peer_counts(self) -> List[int]:
+        counts = []
+        for peer, addr in list(self.node.membership.members.items()):
+            try:
+                info = await self.node.rpc.call(addr, "node", "info")
+                counts.append(int(info["sessions"]))
+            except Exception:
+                log.warning("rebalance: peer %s unreachable", peer)
+        return counts
+
+    async def run_once(self) -> dict:
+        """One rebalance pass; returns what happened."""
+        local = self.agent.connection_count()
+        peers = await self.peer_counts()
+        if not peers:
+            return {"evicted": 0, "reason": "no_peers"}
+        avg = (local + sum(peers)) / (1 + len(peers))
+        if local <= avg * self.rel_threshold:
+            return {"evicted": 0, "reason": "balanced", "local": local, "avg": avg}
+        excess = int(local - avg)
+        evicted = 0
+        self.agent.enable()
+        try:
+            while evicted < excess:
+                got = self.agent.evict_connections(
+                    min(self.rate, excess - evicted)
+                )
+                evicted += got
+                if got == 0:
+                    break
+                await asyncio.sleep(1.0 if evicted < excess else 0)
+        finally:
+            self.agent.disable()
+        return {"evicted": evicted, "local": local, "avg": avg}

@@ -74,15 +74,10 @@ def collect_sections(
             for rule in rules.rules.values()
         ]
     if broker is not None:
-        sections["retained.json"] = [
-            {
-                "topic": m.topic,
-                "payload": base64.b64encode(m.payload).decode(),
-                "qos": m.qos,
-                "props": m.props,
-            }
-            for m in broker.retainer.read("#")
-        ]
+        # snapshot MESSAGE REFS only on the loop (payload bytes are
+        # immutable); the per-message base64/JSON shaping happens in
+        # write_backup's thread — a 1M-entry encode must not stall it
+        sections["_retained"] = list(broker.retainer.read("#"))
     return sections
 
 
@@ -90,6 +85,18 @@ def write_backup(out_dir: str, sections: Dict[str, Any]) -> str:
     """Tar+gzip a collected snapshot (thread-safe: touches no live
     state); returns the archive path."""
     os.makedirs(out_dir, exist_ok=True)
+    sections = dict(sections)
+    retained = sections.pop("_retained", None)
+    if retained is not None:
+        sections["retained.json"] = [
+            {
+                "topic": m.topic,
+                "payload": base64.b64encode(m.payload).decode(),
+                "qos": m.qos,
+                "props": m.props,
+            }
+            for m in retained
+        ]
     ts = time.strftime("%Y%m%d%H%M%S")
     path = os.path.join(out_dir, f"emqx-export-{ts}.tar.gz")
     with tarfile.open(path, "w:gz") as tar:

@@ -1,0 +1,106 @@
+"""Opt-in usage telemetry — the emqx_telemetry analog.
+
+Disabled by default (reference parity). When enabled, a periodic task
+assembles an anonymous usage report (version, uptime, feature flags,
+aggregate counters — never topics, payloads, or client identifiers)
+and hands it to a pluggable reporter (HTTP POST by default; tests
+inject a collector)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import platform
+import time
+import urllib.request
+import uuid
+from typing import Callable, Optional
+
+log = logging.getLogger("emqx_tpu.telemetry")
+
+DEFAULT_INTERVAL = 7 * 24 * 3600.0  # weekly, like the reference
+
+
+class Telemetry:
+    def __init__(
+        self,
+        broker,
+        node_name: str = "emqx@127.0.0.1",
+        url: str = "",
+        interval: float = DEFAULT_INTERVAL,
+        reporter: Optional[Callable[[dict], None]] = None,
+    ):
+        self.broker = broker
+        self.node_name = node_name
+        self.url = url
+        self.interval = interval
+        self.reporter = reporter
+        # random per-install id: stable for the process, anonymous
+        self.uuid = uuid.uuid4().hex
+        self.started_at = time.time()
+        self.enabled = False
+        self._task: Optional[asyncio.Task] = None
+        self.last_report: Optional[dict] = None
+
+    def build_report(self) -> dict:
+        m = self.broker.metrics.all()
+        return {
+            "uuid": self.uuid,
+            "node": "anonymized",  # never the real node name
+            "uptime_s": round(time.time() - self.started_at, 1),
+            "os": platform.system().lower(),
+            "python": platform.python_version(),
+            "active_sessions": self.broker.connected_count(),
+            "subscriptions": len(self.broker.suboptions),
+            "messages_received": m.get("messages.received", 0),
+            "messages_delivered": m.get("messages.delivered", 0),
+            "durable_enabled": self.broker.durable is not None,
+            "num_listeners": len(self.broker.servers),
+        }
+
+    def _send(self, report: dict) -> None:
+        self.last_report = report
+        if self.reporter is not None:
+            self.reporter(report)
+            return
+        if not self.url:
+            return
+        try:
+            req = urllib.request.Request(
+                self.url,
+                data=json.dumps(report).encode(),
+                headers={"content-type": "application/json"},
+                method="POST",
+            )
+            urllib.request.urlopen(req, timeout=10.0)
+        except Exception as e:  # noqa: BLE001
+            log.debug("telemetry report failed: %s", e)
+
+    async def _loop(self) -> None:
+        while self.enabled:
+            try:
+                await asyncio.to_thread(self._send, self.build_report())
+            except Exception:
+                log.debug("telemetry tick failed", exc_info=True)
+            await asyncio.sleep(self.interval)
+
+    def start(self) -> None:
+        if self.enabled:
+            return
+        self.enabled = True
+        try:
+            self._task = asyncio.ensure_future(self._loop())
+        except RuntimeError:
+            self.enabled = False  # no loop: explicit report() only
+
+    def stop(self) -> None:
+        self.enabled = False
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    def report_now(self) -> dict:
+        r = self.build_report()
+        self._send(r)
+        return r

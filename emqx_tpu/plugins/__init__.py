@@ -193,7 +193,10 @@ class PluginManager:
         self._save_state()
         log.info("plugin %s started", p.name_vsn)
 
-    def stop(self, name: str) -> None:
+    def stop(self, name: str, persist: bool = True) -> None:
+        """persist=False is the node-shutdown path: the plugin stays
+        ENABLED on disk so the next boot restarts it (an operator
+        `stop` records the disable; a process exit must not)."""
         p = self._plugins.get(name)
         if p is None or not p.running:
             return
@@ -205,7 +208,8 @@ class PluginManager:
         p.running = False
         p.module = None
         p.state = None
-        self._save_state()
+        if persist:
+            self._save_state()
 
     def restart(self, name: str) -> None:
         self.stop(name)
