@@ -49,6 +49,8 @@ class Node:
         self.durable_db = None
         self.replicator = None
         self.plugins = None
+        self.ft = None
+        self.telemetry = None
         self.links: list = []
         self.modules: list = []
         self._stopping = False
@@ -112,6 +114,25 @@ class Node:
             a = AutoSubscribe(broker, auto_topics)
             a.enable()
             self.modules.append(a)
+
+        # 3b. file transfer + telemetry
+        self.ft = None
+        if cfg.get("file_transfer.enable"):
+            from .ft import FileTransfer
+
+            self.ft = FileTransfer(
+                broker,
+                storage_dir=os.path.join(data_dir, "file_transfer"),
+                max_file_size=cfg.get("file_transfer.max_file_size"),
+                segments_ttl=cfg.get("file_transfer.segments_ttl") / 1000.0,
+            )
+            self.ft.enable()
+        self.telemetry = None
+        if cfg.get("telemetry.enable"):
+            from .mgmt.telemetry import Telemetry
+
+            self.telemetry = Telemetry(broker, node_name=node_name)
+            self.telemetry.start()
 
         # 4. rule engine
         from .rules.engine import RuleEngine
@@ -236,6 +257,7 @@ class Node:
                 node_name=node_name,
                 obs=self.obs,
                 backup_dir=os.path.join(data_dir, "backup"),
+                ft=self.ft,
             )
             host, port = parse_bind(cfg.get("api.bind"))
             await self.mgmt.start(host, port)
@@ -274,6 +296,8 @@ class Node:
             await self.listeners.stop_all()
         if self.cluster_node is not None:
             await self.cluster_node.stop()
+        if self.telemetry is not None:
+            self.telemetry.stop()
         if self.obs is not None:
             self.obs.stop()
         if self.durable_mgr is not None:

@@ -159,11 +159,7 @@ class Connection:
                 for pkt in pkts:
                     from .packet import Connect
 
-                    if (
-                        isinstance(pkt, Connect)
-                        and not self.channel.connected
-                        and pkt.client_id
-                    ):
+                    if isinstance(pkt, Connect) and not self.channel.connected:
                         # run the authenticate fold OFF-loop: providers
                         # doing network IO (HTTP authn) block for up to
                         # their timeout, and that must stall only THIS
@@ -269,8 +265,20 @@ class Server:
         self._conns: set = set()
         self._pending: set = set()  # transports still in ws handshake
         self.listen_addr = None
-        # set by the eviction agent: shed new connections while draining
-        self.evicting = False
+        # eviction holds: multiple agents (evacuation + rebalance) may
+        # gate accepts concurrently; last-writer-wins booleans would
+        # let one agent's disable reopen another's drain
+        self._evict_holds = 0
+
+    @property
+    def evicting(self) -> bool:
+        return self._evict_holds > 0
+
+    def evict_hold(self) -> None:
+        self._evict_holds += 1
+
+    def evict_release(self) -> None:
+        self._evict_holds = max(0, self._evict_holds - 1)
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(

@@ -32,15 +32,22 @@ class EvictionAgent:
         self.evicted = 0
 
     def enable(self) -> None:
-        """New connections are shed at accept while enabled."""
+        """New connections are shed at accept while enabled (a HOLD per
+        agent — concurrent agents never reopen each other's gate)."""
+        if self.enabled:
+            return
         self.enabled = True
-        for srv in self.broker.servers:
-            srv.evicting = True
+        self._held = list(self.broker.servers)
+        for srv in self._held:
+            srv.evict_hold()
 
     def disable(self) -> None:
+        if not self.enabled:
+            return
         self.enabled = False
-        for srv in self.broker.servers:
-            srv.evicting = False
+        for srv in getattr(self, "_held", ()):
+            srv.evict_release()
+        self._held = []
 
     def connection_count(self) -> int:
         return self.broker.connected_count()

@@ -353,6 +353,15 @@ def broker_schema() -> Struct:
                 )
             ),
             "telemetry": Field(Struct({"enable": Field(Bool(), default=False)})),
+            "file_transfer": Field(
+                Struct(
+                    {
+                        "enable": Field(Bool(), default=False),
+                        "max_file_size": Field(Bytesize(), default=256 << 20),
+                        "segments_ttl": Field(Duration(), default=300_000),
+                    }
+                )
+            ),
             # gateway.<type> = per-gateway config (emqx_gateway conf root)
             "gateway": Field(Map(Struct({}, open=True)), default={}),
             # cluster.links analog, flattened to its own root

@@ -1,0 +1,264 @@
+"""File transfer over MQTT — the emqx_ft analog.
+
+Protocol (apps/emqx_ft/src/emqx_ft.erl:124-199): clients publish to
+`$file/...` command topics, intercepted before normal dispatch:
+
+    $file/{fileid}/init                      JSON metadata {name, size,
+                                             checksum?, segments_ttl?}
+    $file/{fileid}/{offset}[/{checksum}]     one binary segment
+    $file/{fileid}/fin/{final_size}[/{sha}]  assemble + verify
+
+The transfer identity is (clientid, fileid) so concurrent clients
+never collide. Results are answered on `$file-response/{clientid}`
+(the reference's response topic) as JSON
+{"vsn":"0.2","topic":...,"reason_code":0|rc,"reason_description":...};
+assembled files land in <storage>/exports/{clientid}/{fileid}/{name}.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import shutil
+import time
+from typing import Dict, Optional, Tuple
+
+from .broker.hooks import STOP
+from .broker.message import Message
+
+log = logging.getLogger("emqx_tpu.ft")
+
+PREFIX = "$file/"
+RESPONSE_PREFIX = "$file-response/"
+
+RC_SUCCESS = 0
+RC_UNSPECIFIED = 0x80
+RC_NOT_AUTHORIZED = 0x87
+
+
+class _Transfer:
+    def __init__(self, meta: dict, tmp_dir: str):
+        self.meta = meta
+        self.tmp_dir = tmp_dir
+        self.segments: Dict[int, str] = {}  # offset -> segment path
+        self.started_at = time.time()
+        self.bytes = 0
+
+
+class FileTransfer:
+    def __init__(
+        self,
+        broker,
+        storage_dir: str = "data/file_transfer",
+        max_file_size: int = 256 * 1024 * 1024,
+        segments_ttl: float = 300.0,
+    ):
+        self.broker = broker
+        self.dir = storage_dir
+        self.max_file_size = max_file_size
+        self.segments_ttl = segments_ttl
+        self._transfers: Dict[Tuple[str, str], _Transfer] = {}
+        self._enabled = False
+        os.makedirs(os.path.join(self.dir, "exports"), exist_ok=True)
+        os.makedirs(os.path.join(self.dir, "tmp"), exist_ok=True)
+
+    def enable(self) -> None:
+        if not self._enabled:
+            self.broker.hooks.add("message.publish", self._on_publish, priority=940)
+            self._enabled = True
+
+    def disable(self) -> None:
+        if self._enabled:
+            self.broker.hooks.delete("message.publish", self._on_publish)
+            self._enabled = False
+
+    # --- hook -------------------------------------------------------------
+
+    def _on_publish(self, msg: Message):
+        if not msg.topic.startswith(PREFIX):
+            return None
+        rc, desc = RC_UNSPECIFIED, "malformed file command"
+        try:
+            rc, desc = self._handle(msg)
+        except Exception as e:  # noqa: BLE001
+            log.exception("file transfer command failed")
+            rc, desc = RC_UNSPECIFIED, str(e)
+        if msg.from_client:
+            self.broker.publish(
+                Message(
+                    topic=f"{RESPONSE_PREFIX}{msg.from_client}",
+                    payload=json.dumps(
+                        {
+                            "vsn": "0.2",
+                            "topic": msg.topic,
+                            "reason_code": rc,
+                            "reason_description": desc,
+                        }
+                    ).encode(),
+                    qos=1,
+                )
+            )
+        out = Message(**{**msg.__dict__})
+        out.headers = dict(msg.headers, allow_publish=False, intercepted="ft")
+        return (STOP, out)
+
+    # --- command handling -------------------------------------------------
+
+    def _handle(self, msg: Message) -> Tuple[int, str]:
+        parts = msg.topic[len(PREFIX):].split("/")
+        if len(parts) < 2 or not parts[0]:
+            return RC_UNSPECIFIED, "bad $file topic"
+        fileid = parts[0]
+        if "/" in fileid or ".." in fileid:
+            return RC_NOT_AUTHORIZED, "bad fileid"
+        key = (msg.from_client or "", fileid)
+        cmd = parts[1]
+        if cmd == "init":
+            return self._init(key, msg)
+        if cmd == "fin":
+            if len(parts) < 3:
+                return RC_UNSPECIFIED, "fin needs final_size"
+            checksum = parts[3] if len(parts) > 3 else None
+            return self._fin(key, int(parts[2]), checksum)
+        if cmd == "abort":
+            self._drop(key)
+            return RC_SUCCESS, "aborted"
+        # segment: {offset}[/{checksum}]
+        try:
+            offset = int(cmd)
+        except ValueError:
+            return RC_UNSPECIFIED, f"bad command {cmd!r}"
+        checksum = parts[2] if len(parts) > 2 else None
+        return self._segment(key, offset, msg.payload, checksum)
+
+    def _init(self, key, msg: Message) -> Tuple[int, str]:
+        try:
+            meta = json.loads(msg.payload)
+        except ValueError:
+            return RC_UNSPECIFIED, "init metadata is not JSON"
+        name = os.path.basename(str(meta.get("name") or key[1]))
+        if meta.get("size") and int(meta["size"]) > self.max_file_size:
+            return RC_UNSPECIFIED, "file too large"
+        self._drop(key)  # re-init restarts the transfer
+        tmp = os.path.join(
+            self.dir, "tmp", _safe(key[0]) or "anon", _safe(key[1])
+        )
+        shutil.rmtree(tmp, ignore_errors=True)
+        os.makedirs(tmp, exist_ok=True)
+        meta["name"] = name
+        self._transfers[key] = _Transfer(meta, tmp)
+        return RC_SUCCESS, "ok"
+
+    def _segment(self, key, offset: int, data: bytes, checksum) -> Tuple[int, str]:
+        t = self._transfers.get(key)
+        if t is None:
+            return RC_UNSPECIFIED, "no transfer in progress (init first)"
+        if offset < 0:
+            return RC_UNSPECIFIED, "negative offset"
+        if checksum is not None:
+            if hashlib.sha256(data).hexdigest() != checksum.lower():
+                return RC_UNSPECIFIED, "segment checksum mismatch"
+        if t.bytes + len(data) > self.max_file_size:
+            self._drop(key)
+            return RC_UNSPECIFIED, "file too large"
+        path = os.path.join(t.tmp_dir, f"seg-{offset}")
+        with open(path, "wb") as f:
+            f.write(data)
+        t.segments[offset] = path
+        t.bytes += len(data)
+        return RC_SUCCESS, "ok"
+
+    def _fin(self, key, final_size: int, checksum) -> Tuple[int, str]:
+        t = self._transfers.get(key)
+        if t is None:
+            return RC_UNSPECIFIED, "no transfer in progress"
+        # final_size rides the TOPIC — bound it BEFORE any allocation
+        # (a forged fin/1099511627776 must not allocate a terabyte)
+        if final_size < 0 or final_size > self.max_file_size:
+            return RC_UNSPECIFIED, "final size out of bounds"
+        if final_size > t.bytes:
+            # cheap reject before allocating: overlaps only shrink
+            # coverage, so stored bytes below final_size can't cover it
+            return RC_UNSPECIFIED, "missing segments"
+        # assemble in offset order; segments may overlap (retries) —
+        # later data wins at its offset; coverage is the MERGED
+        # interval span, never summed lengths (overlaps double-count)
+        out = bytearray(final_size)
+        covered = 0
+        reach = 0  # exclusive end of the merged covered prefix
+        for offset in sorted(t.segments):
+            if offset > reach:
+                return RC_UNSPECIFIED, "missing segments"
+            with open(t.segments[offset], "rb") as f:
+                data = f.read()
+            end = offset + len(data)
+            if end > final_size:
+                data = data[: max(0, final_size - offset)]
+                end = final_size
+            out[offset:end] = data
+            reach = max(reach, end)
+        covered = reach
+        if covered < final_size:
+            return RC_UNSPECIFIED, "missing segments"
+        want = checksum or t.meta.get("checksum")
+        if want:
+            got = hashlib.sha256(bytes(out)).hexdigest()
+            if got != str(want).lower():
+                return RC_UNSPECIFIED, f"checksum mismatch (got {got})"
+        export_dir = os.path.join(
+            self.dir, "exports", _safe(key[0]) or "anon", _safe(key[1])
+        )
+        os.makedirs(export_dir, exist_ok=True)
+        dest = os.path.join(export_dir, t.meta["name"])
+        with open(dest, "wb") as f:
+            f.write(bytes(out))
+        with open(dest + ".MANIFEST.json", "w") as f:
+            json.dump(
+                {
+                    "clientid": key[0],
+                    "fileid": key[1],
+                    "name": t.meta["name"],
+                    "size": final_size,
+                    "meta": t.meta,
+                    "finished_at": time.time(),
+                },
+                f,
+            )
+        self._drop(key)
+        return RC_SUCCESS, dest
+
+    def _drop(self, key) -> None:
+        t = self._transfers.pop(key, None)
+        if t is not None:
+            shutil.rmtree(t.tmp_dir, ignore_errors=True)
+
+    def gc(self, now: Optional[float] = None) -> int:
+        """Drop stale unfinished transfers (segments_ttl)."""
+        now = now if now is not None else time.time()
+        stale = [
+            k for k, t in self._transfers.items()
+            if now - t.started_at > self.segments_ttl
+        ]
+        for k in stale:
+            self._drop(k)
+        return len(stale)
+
+    def exports(self) -> list:
+        """Manifest list of completed transfers (REST view)."""
+        out = []
+        base = os.path.join(self.dir, "exports")
+        for root, _dirs, files in os.walk(base):
+            for fn in files:
+                if fn.endswith(".MANIFEST.json"):
+                    try:
+                        with open(os.path.join(root, fn)) as f:
+                            out.append(json.load(f))
+                    except (OSError, ValueError):
+                        continue
+        return sorted(out, key=lambda m: m.get("finished_at", 0))
+
+
+def _safe(s: str) -> str:
+    return "".join(c if c.isalnum() or c in "-_." else "_" for c in s)[:120]

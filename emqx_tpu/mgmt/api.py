@@ -160,6 +160,7 @@ class ManagementApi:
         node_name: str = "emqx@127.0.0.1",
         obs=None,  # Observability bundle (emqx_tpu.obs.Observability)
         backup_dir: str = "data/backup",
+        ft=None,  # FileTransfer (exports listing)
     ):
         from .audit import AuditLog
 
@@ -169,6 +170,8 @@ class ManagementApi:
         self.banned = banned
         self.node = node
         self.obs = obs
+        self.ft = ft
+        self.evacuation = None  # NodeEvacuation, created on demand
         self.node_name = node_name
         self.backup_dir = backup_dir
         self.started_at = time.time()
@@ -176,6 +179,9 @@ class ManagementApi:
         self.api_keys = ApiKeys()
         self.audit = AuditLog()
         self.http.after.append(self._audit_mw)
+        from . import dashboard
+
+        dashboard.install(self)
         # dashboard users (default admin/public, like the reference)
         self._users: Dict[str, Tuple[bytes, bytes]] = {}
         self.add_user("admin", "public")
@@ -190,10 +196,10 @@ class ManagementApi:
         self._users[username] = (salt, _hash_pw(password, salt))
 
     def _auth_mw(self, req: Request) -> Optional[Response]:
-        if req.path == "/status" or (req.method, req.path) == (
-            "POST",
-            "/api/v5/login",
-        ):
+        if req.path in ("/status", "/", "/dashboard") or (
+            req.method,
+            req.path,
+        ) == ("POST", "/api/v5/login"):
             return None
         auth = req.headers.get("authorization", "")
         if auth.startswith("Bearer "):
@@ -283,6 +289,10 @@ class ManagementApi:
             r("PUT", "/api/v5/trace/{name}/stop", self._trace_stop)
             r("GET", "/api/v5/trace/{name}/log", self._trace_log)
         r("GET", "/api/v5/audit", self._audit_list)
+        r("GET", "/api/v5/file_transfer/files", self._ft_files)
+        r("POST", "/api/v5/load_rebalance/evacuation/start", self._evac_start)
+        r("POST", "/api/v5/load_rebalance/evacuation/stop", self._evac_stop)
+        r("GET", "/api/v5/load_rebalance/status", self._evac_status)
         r("POST", "/api/v5/data/export", self._data_export)
         r("GET", "/api/v5/data/files", self._data_files)
         r("POST", "/api/v5/data/import", self._data_import)
@@ -303,6 +313,36 @@ class ManagementApi:
                 result="ok" if resp.status < 400 else "failed",
                 code=resp.status,
             )
+
+    def _ft_files(self, req: Request):
+        if self.ft is None:
+            return _paginate([], req.query)
+        return _paginate(self.ft.exports(), req.query)
+
+    async def _evac_start(self, req: Request):
+        from ..cluster.rebalance import NodeEvacuation
+
+        body = req.json() or {}
+        if self.evacuation is not None and self.evacuation.status == "evacuating":
+            return Response.error(400, "BAD_REQUEST", "evacuation in progress")
+        self.evacuation = NodeEvacuation(
+            self.broker,
+            conn_evict_rate=int(body.get("conn_evict_rate", 500)),
+            server_reference=body.get("server_reference", ""),
+        )
+        await self.evacuation.start()
+        return self.evacuation.stats()
+
+    async def _evac_stop(self, req: Request):
+        if self.evacuation is None:
+            return Response.error(404, "NOT_FOUND", "no evacuation")
+        await self.evacuation.stop()
+        return self.evacuation.stats()
+
+    def _evac_status(self, req: Request):
+        return {
+            "evacuation": self.evacuation.stats() if self.evacuation else None,
+        }
 
     def _audit_list(self, req: Request):
         return _paginate(

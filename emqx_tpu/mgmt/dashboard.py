@@ -1,0 +1,100 @@
+"""Dashboard-lite: a single-page console served by the management API
+(the emqx_dashboard analog, minus the SPA build — one self-contained
+HTML page that logs in against /api/v5/login and polls the JSON API).
+"""
+
+from __future__ import annotations
+
+PAGE = """<!DOCTYPE html>
+<html lang="en">
+<head>
+<meta charset="utf-8">
+<title>emqx-tpu dashboard</title>
+<style>
+  :root { color-scheme: light dark; }
+  body { font-family: ui-sans-serif, system-ui, sans-serif; margin: 2rem;
+         max-width: 72rem; }
+  h1 { font-size: 1.3rem; }
+  .grid { display: grid; grid-template-columns: repeat(auto-fill,
+          minmax(14rem, 1fr)); gap: .8rem; margin: 1rem 0; }
+  .card { border: 1px solid #8884; border-radius: .5rem; padding: .8rem; }
+  .card b { font-size: 1.4rem; display: block; }
+  table { border-collapse: collapse; width: 100%; margin-top: .6rem; }
+  th, td { text-align: left; padding: .3rem .6rem; border-bottom:
+           1px solid #8883; font-size: .9rem; }
+  #login { max-width: 20rem; }
+  input { display: block; margin: .4rem 0; padding: .4rem; width: 100%; }
+  button { padding: .4rem 1rem; }
+  .err { color: #c33; }
+</style>
+</head>
+<body>
+<h1>emqx-tpu &mdash; node console</h1>
+<div id="login">
+  <input id="u" placeholder="username" value="admin">
+  <input id="p" placeholder="password" type="password">
+  <button onclick="login()">Sign in</button>
+  <div id="lerr" class="err"></div>
+</div>
+<div id="main" style="display:none">
+  <div class="grid" id="tiles"></div>
+  <h2 style="font-size:1.05rem">Clients</h2>
+  <table id="clients"><thead><tr><th>client id</th><th>connected</th>
+  <th>subscriptions</th></tr></thead><tbody></tbody></table>
+</div>
+<script>
+let tok = null;
+function esc(v) {  // every interpolated value is attacker-influenced
+  return String(v).replace(/[&<>"']/g, c => ({'&':'&amp;','<':'&lt;',
+    '>':'&gt;','"':'&quot;',"'":'&#39;'}[c]));
+}
+async function login() {
+  const r = await fetch('/api/v5/login', {method: 'POST',
+    headers: {'content-type': 'application/json'},
+    body: JSON.stringify({username: u.value, password: p.value})});
+  if (!r.ok) { lerr.textContent = 'login failed'; return; }
+  tok = (await r.json()).token;
+  document.getElementById('login').style.display = 'none';
+  document.getElementById('main').style.display = '';
+  tick(); setInterval(tick, 5000);
+}
+async function get(path) {
+  const r = await fetch(path, {headers: {authorization: 'Bearer ' + tok}});
+  return r.ok ? r.json() : null;
+}
+function tile(name, value) {
+  return `<div class="card">${esc(name)}<b>${esc(value)}</b></div>`;
+}
+async function tick() {
+  const [stats, metrics, clients] = await Promise.all([
+    get('/api/v5/stats'), get('/api/v5/metrics'),
+    get('/api/v5/clients?limit=50')]);
+  if (!stats) return;
+  tiles.innerHTML =
+    tile('sessions', stats['sessions.count'] ?? 0) +
+    tile('subscriptions', stats['subscriptions.count'] ?? 0) +
+    tile('messages received', metrics['messages.received'] ?? 0) +
+    tile('messages delivered', metrics['messages.delivered'] ?? 0) +
+    tile('dropped', metrics['messages.dropped'] ?? 0) +
+    tile('connected', metrics['client.connected'] ?? 0);
+  const tb = document.querySelector('#clients tbody');
+  tb.innerHTML = (clients.data || []).map(c =>
+    `<tr><td>${esc(c.clientid)}</td><td>${esc(c.connected)}</td>` +
+    `<td>${esc(c.subscriptions_cnt ?? '')}</td></tr>`).join('');
+}
+</script>
+</body>
+</html>
+"""
+
+
+def install(api) -> None:
+    """Mount GET / and /dashboard on a ManagementApi (no auth for the
+    page itself — the page logs in via the API like the reference)."""
+    from .http import Response
+
+    def page(_req):
+        return Response(body=PAGE.encode(), content_type="text/html; charset=utf-8")
+
+    api.http.route("GET", "/", page)
+    api.http.route("GET", "/dashboard", page)

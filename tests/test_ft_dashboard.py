@@ -1,0 +1,157 @@
+"""File transfer over MQTT + dashboard page.
+
+Refs: apps/emqx_ft/src/emqx_ft.erl:124-199, apps/emqx_dashboard.
+"""
+
+import asyncio
+import hashlib
+import json
+import os
+
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.packet import SubOpts
+from emqx_tpu.broker.pubsub import Broker
+from emqx_tpu.ft import FileTransfer
+
+
+def _client(b, cid, sub=None):
+    s, _ = b.open_session(cid, True)
+    out = []
+    s.outgoing_sink = out.extend
+    if sub:
+        b.subscribe(s, sub, SubOpts(qos=1))
+    return s, out
+
+
+def _cmd(b, cid, topic, payload=b""):
+    return b.publish(Message(topic=topic, payload=payload, from_client=cid, qos=1))
+
+
+def _responses(out):
+    return [json.loads(p.payload) for p in out if p.topic.startswith("$file-response/")]
+
+
+def test_ft_full_transfer(tmp_path):
+    b = Broker()
+    ft = FileTransfer(b, storage_dir=str(tmp_path))
+    ft.enable()
+    s, out = _client(b, "dev1", sub="$file-response/dev1")
+    content = os.urandom(70000)
+    sha = hashlib.sha256(content).hexdigest()
+    _cmd(b, "dev1", "$file/f1/init",
+         json.dumps({"name": "firmware.bin", "size": len(content),
+                     "checksum": sha}).encode())
+    # out-of-order segments with a retry overlap
+    _cmd(b, "dev1", "$file/f1/30000", content[30000:])
+    _cmd(b, "dev1", "$file/f1/0", content[:30000])
+    _cmd(b, "dev1", "$file/f1/0", content[:30000])  # duplicate retry
+    _cmd(b, "dev1", f"$file/f1/fin/{len(content)}")
+    rs = _responses(out)
+    assert [r["reason_code"] for r in rs] == [0, 0, 0, 0, 0]
+    dest = rs[-1]["reason_description"]
+    with open(dest, "rb") as f:
+        assert f.read() == content
+    assert ft.exports()[0]["name"] == "firmware.bin"
+    # the $file command itself never reached normal subscribers
+    watcher, wout = _client(b, "w", sub="#")
+    _cmd(b, "dev1", "$file/f2/init", b"{}")
+    assert all(not p.topic.startswith("$file/") for p in wout)
+
+
+def test_ft_checksum_and_missing_segments(tmp_path):
+    b = Broker()
+    ft = FileTransfer(b, storage_dir=str(tmp_path))
+    ft.enable()
+    s, out = _client(b, "d2", sub="$file-response/d2")
+    _cmd(b, "d2", "$file/x/init", json.dumps({"name": "a.txt"}).encode())
+    _cmd(b, "d2", "$file/x/0", b"hello")
+    # fin with wrong size -> missing segments
+    _cmd(b, "d2", "$file/x/fin/10")
+    assert _responses(out)[-1]["reason_code"] != 0
+    # fin with bad checksum
+    _cmd(b, "d2", "$file/x/fin/5/" + "0" * 64)
+    assert _responses(out)[-1]["reason_code"] != 0
+    # correct fin
+    _cmd(b, "d2", "$file/x/fin/5/" + hashlib.sha256(b"hello").hexdigest())
+    assert _responses(out)[-1]["reason_code"] == 0
+    # segment checksum validated per segment
+    _cmd(b, "d2", "$file/y/init", b"{}")
+    _cmd(b, "d2", "$file/y/0/" + "f" * 64, b"data")
+    assert _responses(out)[-1]["reason_code"] != 0
+
+
+def test_ft_gc_and_abort(tmp_path):
+    b = Broker()
+    ft = FileTransfer(b, storage_dir=str(tmp_path), segments_ttl=0.01)
+    ft.enable()
+    _client(b, "d3")
+    _cmd(b, "d3", "$file/z/init", b"{}")
+    _cmd(b, "d3", "$file/z/0", b"x")
+    import time
+
+    assert ft.gc(now=time.time() + 1) == 1
+    _cmd(b, "d3", "$file/q/init", b"{}")
+    _cmd(b, "d3", "$file/q/abort")
+    assert ft._transfers == {}
+
+
+async def test_dashboard_page_served():
+    from emqx_tpu.mgmt.api import ManagementApi
+
+    api = ManagementApi(Broker())
+    host, port = await api.start()
+    import urllib.request
+
+    loop = asyncio.get_running_loop()
+    body = await loop.run_in_executor(
+        None, lambda: urllib.request.urlopen(f"http://{host}:{port}/").read()
+    )
+    assert b"emqx-tpu" in body and b"/api/v5/login" in body
+    body2 = await loop.run_in_executor(
+        None,
+        lambda: urllib.request.urlopen(f"http://{host}:{port}/dashboard").read(),
+    )
+    assert body2 == body
+    await api.stop()
+
+
+async def test_ft_and_evacuation_rest(tmp_path):
+    import urllib.request
+
+    from emqx_tpu.mgmt.api import ManagementApi
+
+    b = Broker()
+    ft = FileTransfer(b, storage_dir=str(tmp_path))
+    ft.enable()
+    _client(b, "d9")
+    _cmd(b, "d9", "$file/r/init", json.dumps({"name": "r.bin"}).encode())
+    _cmd(b, "d9", "$file/r/0", b"abc")
+    _cmd(b, "d9", "$file/r/fin/3")
+    api = ManagementApi(b, ft=ft)
+    host, port = await api.start()
+    loop = asyncio.get_running_loop()
+
+    def call(method, path, body=None, tok=None):
+        req = urllib.request.Request(
+            f"http://{host}:{port}{path}", method=method,
+            data=json.dumps(body).encode() if body is not None else None,
+            headers={"content-type": "application/json",
+                     **({"authorization": f"Bearer {tok}"} if tok else {})})
+        return json.loads(urllib.request.urlopen(req).read() or b"{}")
+
+    tok = (await loop.run_in_executor(None, lambda: call(
+        "POST", "/api/v5/login", {"username": "admin", "password": "public"})))["token"]
+    files = await loop.run_in_executor(
+        None, lambda: call("GET", "/api/v5/file_transfer/files", tok=tok))
+    assert files["data"][0]["name"] == "r.bin"
+    st = await loop.run_in_executor(
+        None, lambda: call("POST", "/api/v5/load_rebalance/evacuation/start",
+                           {"conn_evict_rate": 5}, tok=tok))
+    assert st["status"] == "evacuating"
+    st2 = await loop.run_in_executor(
+        None, lambda: call("GET", "/api/v5/load_rebalance/status", tok=tok))
+    assert st2["evacuation"]["status"] in ("evacuating", "drained")
+    await loop.run_in_executor(
+        None, lambda: call("POST", "/api/v5/load_rebalance/evacuation/stop",
+                           tok=tok))
+    await api.stop()
