@@ -127,6 +127,17 @@ class Node:
                 segments_ttl=cfg.get("file_transfer.segments_ttl") / 1000.0,
             )
             self.ft.enable()
+
+            async def _ft_gc_loop():
+                ttl = max(1.0, cfg.get("file_transfer.segments_ttl") / 1000.0)
+                while True:
+                    await asyncio.sleep(ttl)
+                    try:
+                        self.ft.gc()
+                    except Exception:
+                        log.exception("file-transfer gc failed")
+
+            self._ft_gc_task = asyncio.ensure_future(_ft_gc_loop())
         self.telemetry = None
         if cfg.get("telemetry.enable"):
             from .mgmt.telemetry import Telemetry
@@ -296,6 +307,9 @@ class Node:
             await self.listeners.stop_all()
         if self.cluster_node is not None:
             await self.cluster_node.stop()
+        if getattr(self, "_ft_gc_task", None) is not None:
+            self._ft_gc_task.cancel()
+            self._ft_gc_task = None
         if self.telemetry is not None:
             self.telemetry.stop()
         if self.obs is not None:

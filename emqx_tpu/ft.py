@@ -43,6 +43,7 @@ class _Transfer:
         self.meta = meta
         self.tmp_dir = tmp_dir
         self.segments: Dict[int, str] = {}  # offset -> segment path
+        self.seg_sizes: Dict[int, int] = {}
         self.started_at = time.time()
         self.bytes = 0
 
@@ -160,14 +161,18 @@ class FileTransfer:
         if checksum is not None:
             if hashlib.sha256(data).hexdigest() != checksum.lower():
                 return RC_UNSPECIFIED, "segment checksum mismatch"
-        if t.bytes + len(data) > self.max_file_size:
+        # a retried segment REPLACES its offset: count the delta, not
+        # the gross bytes, or legitimate retries trip the size cap
+        old = t.seg_sizes.get(offset, 0)
+        if t.bytes - old + len(data) > self.max_file_size:
             self._drop(key)
             return RC_UNSPECIFIED, "file too large"
         path = os.path.join(t.tmp_dir, f"seg-{offset}")
         with open(path, "wb") as f:
             f.write(data)
         t.segments[offset] = path
-        t.bytes += len(data)
+        t.seg_sizes[offset] = len(data)
+        t.bytes += len(data) - old
         return RC_SUCCESS, "ok"
 
     def _fin(self, key, final_size: int, checksum) -> Tuple[int, str]:

@@ -323,8 +323,12 @@ class ManagementApi:
         from ..cluster.rebalance import NodeEvacuation
 
         body = req.json() or {}
-        if self.evacuation is not None and self.evacuation.status == "evacuating":
-            return Response.error(400, "BAD_REQUEST", "evacuation in progress")
+        if self.evacuation is not None:
+            if self.evacuation.status == "evacuating":
+                return Response.error(400, "BAD_REQUEST", "evacuation in progress")
+            # a drained evacuation still HOLDS the accept gate — release
+            # through its own agent or the hold leaks forever
+            await self.evacuation.stop()
         self.evacuation = NodeEvacuation(
             self.broker,
             conn_evict_rate=int(body.get("conn_evict_rate", 500)),

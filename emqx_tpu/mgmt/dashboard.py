@@ -69,7 +69,7 @@ async function tick() {
   const [stats, metrics, clients] = await Promise.all([
     get('/api/v5/stats'), get('/api/v5/metrics'),
     get('/api/v5/clients?limit=50')]);
-  if (!stats) return;
+  if (!stats || !metrics || !clients) return;  // partial failure: skip tick
   tiles.innerHTML =
     tile('sessions', stats['sessions.count'] ?? 0) +
     tile('subscriptions', stats['subscriptions.count'] ?? 0) +

@@ -1,0 +1,131 @@
+"""Durable shared-subscription queues over DS.
+
+Ref: apps/emqx_ds_shared_sub (leader/agent durable queues).
+"""
+
+import asyncio
+
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.pubsub import Broker
+from emqx_tpu.ds import Db
+from emqx_tpu.ds.session_ds import DurableSessionManager
+from emqx_tpu.ds.shared_queue import SharedQueues
+
+
+def make(tmp_path, name="q"):
+    db = Db("messages", data_dir=str(tmp_path / name), n_shards=1,
+            buffer_flush_ms=5)
+    mgr = DurableSessionManager(db, state_dir=str(tmp_path / name))
+    broker = Broker()
+    broker.enable_durable(mgr)
+    sq = SharedQueues(mgr, batch_size=4)
+    sq.install(broker.hooks)
+    return broker, mgr, db, sq
+
+
+def _member(broker, cid):
+    s, _ = broker.open_session(cid, True)
+    out = []
+    s.outgoing_sink = out.extend
+    return s, out
+
+
+def _ack_all(broker, s, out, start=0):
+    for p in out[start:]:
+        if p.packet_id is not None:
+            s.on_puback(p.packet_id)
+            broker.hooks.run("message.acked", s.client_id, p.packet_id)
+
+
+def test_queue_balances_and_commits(tmp_path):
+    broker, mgr, db, sq = make(tmp_path)
+    s1, out1 = _member(broker, "m1")
+    s2, out2 = _member(broker, "m2")
+    sq.join("g", "jobs/#", s1)
+    sq.join("g", "jobs/#", s2)
+    # one TOPIC -> one stream, so batch semantics are observable
+    db.store_batch([
+        Message(topic="jobs/task", payload=str(i).encode(), qos=1,
+                from_client="p")
+        for i in range(8)
+    ])
+    q = sq.queues["g/jobs/#"]
+    sq.pump(q)
+    # batch of 4 split between the two members
+    assert len(out1) + len(out2) == 4
+    assert out1 and out2  # both participated
+    n1, n2 = len(out1), len(out2)
+    _ack_all(broker, s1, out1)
+    _ack_all(broker, s2, out2)
+    # ack of the full batch commits + pumps the next one
+    assert len(out1) + len(out2) == 8
+    _ack_all(broker, s1, out1, n1)
+    _ack_all(broker, s2, out2, n2)
+    payloads = sorted(p.payload for p in out1 + out2)
+    assert payloads == sorted(str(i).encode() for i in range(8))
+    assert q.delivered == 8
+
+
+def test_member_down_redispatches(tmp_path):
+    broker, mgr, db, sq = make(tmp_path)
+    s1, out1 = _member(broker, "m1")
+    s2, out2 = _member(broker, "m2")
+    sq.join("g", "w/#", s1)
+    sq.join("g", "w/#", s2)
+    for i in range(4):
+        db.store_batch([Message(topic=f"w/{i}", payload=b"x", qos=1,
+                                from_client="p")])
+    q = sq.queues["g/w/#"]
+    sq.pump(q)
+    assert out1 and out2
+    # m1 dies without acking: its messages go to m2
+    n1 = len(out1)
+    s1.connected = False
+    broker.hooks.run("client.disconnected", "m1", "closed")
+    assert q.redispatched == n1
+    assert len(out2) == 4  # m2 now holds the whole batch
+    _ack_all(broker, s2, out2)
+    st = next(iter(q.streams.values()))
+    assert not st.pending and st.committed  # batch committed
+
+
+def test_queue_survives_restart(tmp_path):
+    broker, mgr, db, sq = make(tmp_path)
+    s1, out1 = _member(broker, "m1")
+    sq.join("g", "r/#", s1)
+    db.store_batch([Message(topic="r/1", payload=b"one", qos=1,
+                            from_client="p")])
+    q = sq.queues["g/r/#"]
+    sq.pump(q)
+    _ack_all(broker, s1, out1)
+    assert len(out1) == 1
+    mgr.close()
+    db.close()
+
+    # new process: queue + committed position reload; only NEW messages
+    broker2, mgr2, db2, sq2 = make(tmp_path)
+    assert "g/r/#" in sq2.queues
+    s2, out2 = _member(broker2, "m9")
+    sq2.join("g", "r/#", s2)
+    db2.store_batch([Message(topic="r/2", payload=b"two", qos=1,
+                             from_client="p")])
+    sq2.pump(sq2.queues["g/r/#"])
+    assert [p.payload for p in out2] == [b"two"]  # r/1 NOT replayed
+
+
+def test_publish_gate_persists_for_queue(tmp_path):
+    """A declared queue makes the broker's persist gate store matching
+    publishes even with no durable session subscribed."""
+    broker, mgr, db, sq = make(tmp_path)
+    s1, out1 = _member(broker, "m1")
+    sq.join("grp", "tele/#", s1)
+    broker.publish(Message(topic="tele/1", payload=b"v", qos=1,
+                           from_client="sensor"))
+    db.buffer.flush_now()
+    import time
+
+    deadline = time.time() + 3
+    while not out1 and time.time() < deadline:
+        sq.pump(sq.queues["grp/tele/#"])
+        time.sleep(0.02)
+    assert [p.payload for p in out1] == [b"v"]
