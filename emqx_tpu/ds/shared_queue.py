@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import json
 import logging
+import threading
 from typing import Dict, List, Optional, Tuple
 
 from ..broker.message import Message
@@ -76,6 +77,9 @@ class SharedQueues:
         self.queues: Dict[str, Queue] = {}
         # (client_id, packet_id) -> (queue id, stream id, msg key)
         self._acks: Dict[Tuple[str, int], Tuple[str, str, bytes]] = {}
+        # serializes pump/ack/redispatch across the DS buffer thread
+        # and the broker thread (same seam session_ds guards)
+        self._lock = threading.RLock()
         self._load_all()
         self.db.poll(self._on_new_data)
         self._installed = False
@@ -106,18 +110,24 @@ class SharedQueues:
         return q
 
     def drop(self, group: str, flt: str) -> bool:
-        q = self.queues.pop(f"{group}/{flt}", None)
-        if q is None:
-            return False
-        try:
-            self.manager.ps_router.remove(
-                topic_mod.words(q.filter), f"$queue/{q.id}"
-            )
-        except KeyError:
-            pass
-        self.manager.kv.delete(b"queue/" + q.id.encode())
-        self.manager.kv.flush()
-        return True
+        with self._lock:
+            q = self.queues.pop(f"{group}/{flt}", None)
+            if q is None:
+                return False
+            # purge in-flight ack entries or they ghost until a member
+            # happens to reuse the same packet id
+            self._acks = {
+                k: v for k, v in self._acks.items() if v[0] != q.id
+            }
+            try:
+                self.manager.ps_router.remove(
+                    topic_mod.words(q.filter), f"$queue/{q.id}"
+                )
+            except KeyError:
+                pass
+            self.manager.kv.delete(b"queue/" + q.id.encode())
+            self.manager.kv.flush()
+            return True
 
     def join(self, group: str, flt: str, session) -> Queue:
         q = self.declare(group, flt)
@@ -127,12 +137,13 @@ class SharedQueues:
         return q
 
     def leave(self, group: str, flt: str, client_id: str) -> None:
-        q = self.queues.get(f"{group}/{flt}")
-        if q is None:
-            return
-        if client_id in q.members:
-            q.members.remove(client_id)
-        self._redispatch_member(q, client_id)
+        with self._lock:
+            q = self.queues.get(f"{group}/{flt}")
+            if q is None:
+                return
+            if client_id in q.members:
+                q.members.remove(client_id)
+            self._redispatch_member(q, client_id)
 
     def list(self) -> List[dict]:
         return [
@@ -156,6 +167,10 @@ class SharedQueues:
 
     def pump(self, q: Queue) -> int:
         """Drain due batches to members; returns deliveries made."""
+        with self._lock:
+            return self._pump_locked(q)
+
+    def _pump_locked(self, q: Queue) -> int:
         self._refresh_streams(q)
         sessions = self.manager.broker.sessions if self.manager.broker else {}
         n = 0
@@ -171,67 +186,80 @@ class SharedQueues:
                 continue
             st.batch = {k: m for k, m in rows}
             st.inflight_pos = last
+            delivered_here = 0
             for key, msg in rows:
-                n += self._deliver_one(q, sid, st, key, msg, sessions)
+                delivered_here += self._deliver_one(q, sid, st, key, msg, sessions)
+            n += delivered_here
             if not st.pending:
-                # nothing landed in flight (all QoS0-deliveries or no
-                # members): only commit if deliveries actually happened
-                if n:
+                # commit only on THIS stream's own full delivery —
+                # another stream's successes must not advance a stream
+                # whose rows went nowhere (at-least-once)
+                if delivered_here == len(rows):
                     st.committed = last
                     st.inflight_pos = None
                     st.batch = {}
                     self._save(q)
                 else:
                     st.inflight_pos = None  # retry later
+                    st.batch = {}
         return n
 
     def _deliver_one(self, q, sid, st, key, msg, sessions) -> int:
-        member = q.next_member(sessions)
-        if member is None:
-            return 0
-        session = sessions[member]
-        before = set(session.inflight)
-        pkts = session.deliver(msg, SubOpts(qos=1))
-        new_pids = set(session.inflight) - before
-        if new_pids:
-            pid = new_pids.pop()
+        # try each live member once: skip full inflight windows — a
+        # QoS1 delivery that PARKS in the volatile mqueue allocates no
+        # packet id, so the queue could never track (or redispatch) it
+        for _ in range(max(1, len(q.members))):
+            member = q.next_member(sessions)
+            if member is None:
+                return 0
+            session = sessions[member]
+            if len(session.inflight) >= session.cfg.receive_maximum:
+                continue
+            pkts = session.deliver(msg, SubOpts(qos=1))
+            pid = pkts[0].packet_id if pkts else None
+            if pid is None:
+                continue  # raced a window fill / disconnect: next member
             st.pending[key] = (member, pid)
             self._acks[(member, pid)] = (q.id, sid, key)
-        sink = getattr(session, "outgoing_sink", None)
-        if pkts and sink is not None:
-            sink(pkts)
-        q.delivered += 1
-        return 1
+            sink = getattr(session, "outgoing_sink", None)
+            if sink is not None:
+                sink(pkts)
+            q.delivered += 1
+            return 1
+        return 0
 
     # --- ack / failure accounting ----------------------------------------
 
     def _on_acked(self, client_id, pid, *extra) -> None:
-        entry = self._acks.pop((client_id, pid), None)
-        if entry is None:
-            return
-        qid, sid, key = entry
-        q = self.queues.get(qid)
-        if q is None:
-            return
-        st = q.streams.get(sid)
-        if st is None:
-            return
-        st.pending.pop(key, None)
-        if not st.pending and st.inflight_pos is not None:
-            st.committed = st.inflight_pos
-            st.inflight_pos = None
-            st.batch = {}
-            self._save(q)
-            self.pump(q)  # next batch immediately
+        with self._lock:
+            entry = self._acks.pop((client_id, pid), None)
+            if entry is None:
+                return
+            qid, sid, key = entry
+            q = self.queues.get(qid)
+            if q is None:
+                return
+            st = q.streams.get(sid)
+            if st is None:
+                return
+            st.pending.pop(key, None)
+            if not st.pending and st.inflight_pos is not None:
+                st.committed = st.inflight_pos
+                st.inflight_pos = None
+                st.batch = {}
+                self._save(q)
+                self._pump_locked(q)  # next batch immediately
 
     def _on_member_down(self, client_id, *extra) -> None:
-        for q in self.queues.values():
-            if client_id in q.members:
-                # keep membership (sessions may reconnect) but free its
-                # unacked work NOW — survivors take it over
-                self._redispatch_member(q, client_id)
+        with self._lock:
+            for q in self.queues.values():
+                if client_id in q.members:
+                    # keep membership (sessions may reconnect) but free
+                    # its unacked work NOW — survivors take it over
+                    self._redispatch_member(q, client_id)
 
     def _redispatch_member(self, q: Queue, client_id: str) -> None:
+        """Caller holds self._lock."""
         sessions = self.manager.broker.sessions if self.manager.broker else {}
         for sid, st in q.streams.items():
             stale = [
