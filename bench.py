@@ -600,7 +600,7 @@ def bench_shared(jax, jnp, floor, details, state):
         if trial:  # first trial pays compile
             e2e.append(max(dt - min(f0, dt), 1e-5))
     log(f"#4 end-to-end dispatch+pair-fetch: {np.median(e2e) * 1e3:.1f} ms "
-        f"(relay RTT floor {floor * 1e3:.0f} ms subtracted)")
+        f"(per-trial bracketed relay-RTT floor subtracted)")
     details["config4_shared_groups"] = {
         "tpu_topics_per_sec": round(rate, 1),
         "groups": G,

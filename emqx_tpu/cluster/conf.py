@@ -1,0 +1,192 @@
+"""Cluster-wide configuration — the emqx_conf / emqx_cluster_rpc analog.
+
+The reference serializes every cluster-wide config mutation through a
+transactional multicall: the MFA is appended to a replicated,
+totally-ordered commit log (mnesia tnx_id), every node applies commits
+in order, and lagging nodes catch up by replaying the history
+(apps/emqx_conf/src/emqx_cluster_rpc.erl:26). Here total order comes
+from a deterministic COORDINATOR (smallest live node id — the same
+membership-is-the-election rule the DS replication tier uses): any
+node's update forwards to the coordinator, which assigns the next
+tnx_id, applies, and broadcasts; followers apply strictly in order,
+parking out-of-order commits and pulling gaps from the coordinator's
+bounded history. A joiner bootstraps the full override set + tnx_id.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from collections import deque
+from typing import Deque, Dict, List, Optional, Tuple
+
+log = logging.getLogger("emqx_tpu.cluster.conf")
+
+HISTORY = 2048  # commits retained for catch-up
+
+
+class ClusterConf:
+    def __init__(self, node, config) -> None:
+        """node: started ClusterNode; config: the local Config."""
+        self.node = node
+        self.config = config
+        self.node_id = node.node_id
+        self.tnx_id = 0  # last applied
+        self._history: Deque[Tuple[int, dict]] = deque(maxlen=HISTORY)
+        self._parked: Dict[int, dict] = {}
+        node.rpc.registry.register_all(
+            "conf",
+            1,
+            {
+                "propose": self._handle_propose,
+                "commit": self._handle_commit,
+                "replay": self._handle_replay,
+                "bootstrap": self._handle_bootstrap,
+            },
+        )
+
+    # --- coordination -----------------------------------------------------
+
+    def coordinator(self) -> str:
+        return min([self.node_id, *self.node.membership.members])
+
+    async def update(self, path: str, value) -> int:
+        """Cluster-wide config update; returns the commit's tnx_id.
+        Raises if the coordinator rejects (schema check fails there —
+        and everywhere, since configs share one schema)."""
+        return await self._propose({"op": "update", "path": path, "value": value})
+
+    async def remove(self, path: str) -> int:
+        return await self._propose({"op": "remove", "path": path})
+
+    async def _propose(self, op: dict) -> int:
+        coord = self.coordinator()
+        if coord == self.node_id:
+            return self._commit_local(op)
+        addr = self.node.membership.members.get(coord)
+        if addr is None:
+            raise ConnectionError(f"coordinator {coord} unreachable")
+        out = await self.node.rpc.call(addr, "conf", "propose", (op,))
+        if isinstance(out, dict) and out.get("error"):
+            raise ValueError(out["error"])
+        return int(out)
+
+    def _handle_propose(self, op: dict):
+        if self.coordinator() != self.node_id:
+            return {"error": f"not coordinator (is {self.coordinator()})"}
+        try:
+            return self._commit_local(op)
+        except Exception as e:  # noqa: BLE001
+            return {"error": str(e)}
+
+    def _commit_local(self, op: dict) -> int:
+        """Coordinator path: validate+apply FIRST (a rejected update
+        must not burn a tnx_id), then broadcast."""
+        self._apply(op)  # raises on schema violation
+        self.tnx_id += 1
+        self._history.append((self.tnx_id, op))
+        for _peer, addr in list(self.node.membership.members.items()):
+            self._spawn(
+                self.node.rpc.cast(
+                    addr, "conf", "commit", (self.tnx_id, op, self.node_id)
+                )
+            )
+        return self.tnx_id
+
+    # --- follower apply ---------------------------------------------------
+
+    def _handle_commit(self, tnx_id: int, op: dict, _from=None) -> None:
+        if tnx_id <= self.tnx_id:
+            return  # duplicate
+        if tnx_id == self.tnx_id + 1:
+            self._apply_follower(tnx_id, op)
+            while self._parked:
+                nxt = self._parked.pop(self.tnx_id + 1, None)
+                if nxt is None:
+                    break
+                self._apply_follower(self.tnx_id + 1, nxt)
+            return
+        self._parked[tnx_id] = op
+        addr = self.node.membership.members.get(
+            _from if _from is not None else self.coordinator()
+        )
+        if addr is not None:
+            self._spawn(self._pull(addr))
+
+    def _apply_follower(self, tnx_id: int, op: dict) -> None:
+        try:
+            self._apply(op)
+        except Exception:
+            # the op passed the shared schema on the coordinator; a
+            # local failure means divergent local state — log loudly
+            # but keep the log position moving (reference behavior:
+            # skipped commits surface in the cluster_rpc status)
+            log.exception("config commit %s failed locally", tnx_id)
+        self.tnx_id = tnx_id
+        self._history.append((tnx_id, op))
+
+    def _apply(self, op: dict) -> None:
+        if op["op"] == "update":
+            self.config.update(op["path"], op["value"])
+        elif op["op"] == "remove":
+            self.config.remove(op["path"])
+        else:
+            raise ValueError(f"unknown config op {op['op']!r}")
+
+    async def _pull(self, addr) -> None:
+        try:
+            entries = await self.node.rpc.call(
+                addr, "conf", "replay", (self.tnx_id,)
+            )
+        except Exception:
+            return
+        for tnx_id, op in entries:
+            self._handle_commit(tnx_id, op)
+
+    def _handle_replay(self, after: int):
+        return [(t, op) for t, op in self._history if t > after]
+
+    # --- join bootstrap ---------------------------------------------------
+
+    async def bootstrap(self) -> None:
+        """Pull the coordinator's full override set (fresh joiner, or
+        a node lagging past the history window)."""
+        coord = self.coordinator()
+        if coord == self.node_id:
+            return
+        addr = self.node.membership.members.get(coord)
+        if addr is None:
+            return
+        dump = await self.node.rpc.call(addr, "conf", "bootstrap")
+        self.config.load_overrides(dump["overrides"])
+        self.tnx_id = int(dump["tnx_id"])
+        self._parked.clear()
+
+    def _handle_bootstrap(self):
+        return {
+            "overrides": self.config.dump_overrides(),
+            "tnx_id": self.tnx_id,
+        }
+
+    def status(self) -> dict:
+        return {
+            "node": self.node_id,
+            "coordinator": self.coordinator(),
+            "tnx_id": self.tnx_id,
+            "parked": len(self._parked),
+        }
+
+    def _spawn(self, coro) -> None:
+        try:
+            asyncio.get_running_loop()
+        except RuntimeError:
+            coro.close()
+            return
+        task = asyncio.ensure_future(coro)
+        # strong ref until done (bare ensure_future is GC-able)
+        _tasks.add(task)
+        task.add_done_callback(_tasks.discard)
+
+
+_tasks: set = set()

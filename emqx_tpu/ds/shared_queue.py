@@ -95,19 +95,20 @@ class SharedQueues:
     def declare(self, group: str, flt: str) -> Queue:
         topic_mod.validate_filter(flt)
         qid = f"{group}/{flt}"
-        q = self.queues.get(qid)
-        if q is None:
-            q = Queue(group, flt)
-            self.queues[qid] = q
-            # route into the persist gate: matching publishes store to DS
-            try:
-                self.manager.ps_router.insert(
-                    topic_mod.words(flt), f"$queue/{qid}"
-                )
-            except KeyError:
-                pass
-            self._save(q)
-        return q
+        with self._lock:
+            q = self.queues.get(qid)
+            if q is None:
+                q = Queue(group, flt)
+                self.queues[qid] = q
+                # route into the persist gate: matching publishes store
+                try:
+                    self.manager.ps_router.insert(
+                        topic_mod.words(flt), f"$queue/{qid}"
+                    )
+                except KeyError:
+                    pass
+                self._save(q)
+            return q
 
     def drop(self, group: str, flt: str) -> bool:
         with self._lock:
@@ -131,9 +132,10 @@ class SharedQueues:
 
     def join(self, group: str, flt: str, session) -> Queue:
         q = self.declare(group, flt)
-        if session.client_id not in q.members:
-            q.members.append(session.client_id)
-        self.pump(q)
+        with self._lock:
+            if session.client_id not in q.members:
+                q.members.append(session.client_id)
+            self._pump_locked(q)
         return q
 
     def leave(self, group: str, flt: str, client_id: str) -> None:
@@ -184,24 +186,32 @@ class SharedQueues:
             )
             if not rows:
                 continue
-            st.batch = {k: m for k, m in rows}
-            st.inflight_pos = last
-            delivered_here = 0
+            # deliver IN ORDER and cut the batch at the first failure:
+            # the commit target becomes the delivered PREFIX, so rows
+            # nobody took stay beyond the position and rescan later —
+            # never committed past (at-least-once)
+            delivered_keys = []
             for key, msg in rows:
-                delivered_here += self._deliver_one(q, sid, st, key, msg, sessions)
-            n += delivered_here
+                if self._deliver_one(q, sid, st, key, msg, sessions) == 0:
+                    break
+                delivered_keys.append(key)
+            n += len(delivered_keys)
+            if not delivered_keys:
+                st.inflight_pos = None
+                st.batch = {}
+                continue  # retry later
+            prefix_end = delivered_keys[-1]
+            st.batch = {
+                k: m for k, m in rows if k <= prefix_end
+            }
+            st.inflight_pos = prefix_end
             if not st.pending:
-                # commit only on THIS stream's own full delivery —
-                # another stream's successes must not advance a stream
-                # whose rows went nowhere (at-least-once)
-                if delivered_here == len(rows):
-                    st.committed = last
-                    st.inflight_pos = None
-                    st.batch = {}
-                    self._save(q)
-                else:
-                    st.inflight_pos = None  # retry later
-                    st.batch = {}
+                # every delivered row was an effective-QoS0 fire:
+                # nothing to ack, the prefix commits now
+                st.committed = prefix_end
+                st.inflight_pos = None
+                st.batch = {}
+                self._save(q)
         return n
 
     def _deliver_one(self, q, sid, st, key, msg, sessions) -> int:
@@ -216,11 +226,14 @@ class SharedQueues:
             if len(session.inflight) >= session.cfg.receive_maximum:
                 continue
             pkts = session.deliver(msg, SubOpts(qos=1))
-            pid = pkts[0].packet_id if pkts else None
-            if pid is None:
-                continue  # raced a window fill / disconnect: next member
-            st.pending[key] = (member, pid)
-            self._acks[(member, pid)] = (q.id, sid, key)
+            if not pkts:
+                continue  # raced a disconnect (parked): next member
+            pid = pkts[0].packet_id
+            if pid is not None:
+                st.pending[key] = (member, pid)
+                self._acks[(member, pid)] = (q.id, sid, key)
+            # pid None = the MESSAGE was QoS0 (eff qos min(0,1)=0):
+            # fire-and-forget, commits with the prefix, no tracking
             sink = getattr(session, "outgoing_sink", None)
             if sink is not None:
                 sink(pkts)

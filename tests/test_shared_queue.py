@@ -129,3 +129,25 @@ def test_publish_gate_persists_for_queue(tmp_path):
         sq.pump(sq.queues["grp/tele/#"])
         time.sleep(0.02)
     assert [p.payload for p in out1] == [b"v"]
+
+
+def test_qos0_messages_fire_and_commit(tmp_path):
+    """QoS0-published messages (eff qos 0: no packet id) must neither
+    wedge the stream nor head-of-line block later QoS1 work."""
+    broker, mgr, db, sq = make(tmp_path)
+    s1, out1 = _member(broker, "m1")
+    sq.join("g", "mix/#", s1)
+    db.store_batch([
+        Message(topic="mix/t", payload=b"q0", qos=0, from_client="p"),
+        Message(topic="mix/t", payload=b"q1", qos=1, from_client="p"),
+    ])
+    q = sq.queues["g/mix/#"]
+    sq.pump(q)
+    assert [p.payload for p in out1] == [b"q0", b"q1"]
+    assert out1[0].packet_id is None and out1[1].packet_id is not None
+    _ack_all(broker, s1, out1)
+    st = next(iter(q.streams.values()))
+    assert not st.pending and st.committed
+    # nothing redelivers on the next pump
+    sq.pump(q)
+    assert len(out1) == 2
