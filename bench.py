@@ -110,6 +110,15 @@ def make_scan_bench(jax, jnp, match_ids_hash, max_hits, gen_topics, k):
     return many
 
 
+EPS = 1e-5  # per-batch clamp (seconds); samples pinned here are floor-saturated
+
+
+def saturated(per_batch) -> bool:
+    """True when the floor subtraction consumed the whole measurement —
+    the resulting 'rate' is the clamp ceiling, not a throughput."""
+    return float(np.median(per_batch)) <= EPS * 1.2
+
+
 def time_dispatches(many, dev_args, floor, k, n_dispatches=6, jj=None):
     """Compile, then time n dispatches with fresh seeds. Each timed
     dispatch is bracketed by its OWN trivial-RTT samples: the relay
@@ -132,7 +141,7 @@ def time_dispatches(many, dev_args, floor, k, n_dispatches=6, jj=None):
         dt = time.time() - t0
         f1 = _floor_once(*jj) if jj else floor
         total += got
-        per_batch.append(max(dt - min(f0, f1, dt), 1e-5 * k) / k)
+        per_batch.append(max(dt - min(f0, f1, dt), EPS * k) / k)
     return per_batch, total
 
 
@@ -173,21 +182,27 @@ def bench_1m(jax, jnp, floor, details):
     d_map = jnp.asarray(np.array([lk(f"d{j}") for j in range(N)], np.int32))
     m_id = int(lk("m"))
 
-    def gen_topics(key, aux):
-        tmap, rmap, dmap = aux
-        k1, k2 = jax.random.split(key)
-        d = jax.random.randint(k1, (K, B), 0, N)
-        junk = jax.random.randint(k2, (K, B), 1 << 28, 1 << 29)  # OOV-ish
-        ids = jnp.zeros((K, B, L), jnp.int32)
-        ids = ids.at[..., 0].set(tmap[d % 997])
-        ids = ids.at[..., 1].set(rmap[d % 13])
-        ids = ids.at[..., 2].set(dmap[d])
-        ids = ids.at[..., 3].set(junk)  # the '+' level: arbitrary word
-        ids = ids.at[..., 4].set(m_id)
-        ids = ids.at[..., 5].set(junk ^ 7)  # trailing level under '#'
-        lens = jnp.full((K, B), 6, jnp.int32)
-        dollar = jnp.zeros((K, B), bool)
-        return ids, lens, dollar
+    def make_gen(k_, b_):
+        # one topic-derivation scheme for every batch geometry (#2, #2b)
+        def gen_topics(key, aux):
+            tmap, rmap, dmap = aux
+            k1, k2 = jax.random.split(key)
+            d = jax.random.randint(k1, (k_, b_), 0, N)
+            junk = jax.random.randint(k2, (k_, b_), 1 << 28, 1 << 29)  # OOV-ish
+            ids = jnp.zeros((k_, b_, L), jnp.int32)
+            ids = ids.at[..., 0].set(tmap[d % 997])
+            ids = ids.at[..., 1].set(rmap[d % 13])
+            ids = ids.at[..., 2].set(dmap[d])
+            ids = ids.at[..., 3].set(junk)  # the '+' level: arbitrary word
+            ids = ids.at[..., 4].set(m_id)
+            ids = ids.at[..., 5].set(junk ^ 7)  # trailing level under '#'
+            lens = jnp.full((k_, b_), 6, jnp.int32)
+            dollar = jnp.zeros((k_, b_), bool)
+            return ids, lens, dollar
+
+        return gen_topics
+
+    gen_topics = make_gen(K, B)
 
     many = make_scan_bench(jax, jnp, match_ids_hash, 4096, gen_topics, K)
     per_batch, total = time_dispatches(
@@ -198,6 +213,26 @@ def bench_1m(jax, jnp, floor, details):
     log(f"#2 TPU hash kernel: {med * 1e3:.3f} ms/batch-of-{B} "
         f"({rate:,.0f} topics/s vs {N} subs; {total} matches over "
         f"{len(per_batch) * K * B} topics)")
+
+    # --- batch scaling: a server under load aggregates bigger batches;
+    # B=8192 amortizes fixed per-dispatch work 8x
+    B2, K2 = 8192, 4
+    many_big = make_scan_bench(
+        jax, jnp, match_ids_hash, 16384, make_gen(K2, B2), K2
+    )
+    pb_big, _tot_big = time_dispatches(
+        many_big, (meta, slots, (t_map, r_map, d_map)), floor, K2,
+        n_dispatches=4, jj=(jax, jnp),
+    )
+    med_big = float(np.median(pb_big))
+    log(f"#2b batch scaling: {med_big * 1e3:.3f} ms/batch-of-{B2} "
+        f"({B2 / med_big:,.0f} topics/s)")
+    details["config2b_big_batch"] = {
+        "batch": B2,
+        "tpu_topics_per_sec": round(B2 / med_big, 1),
+        "tpu_ms_per_batch_p50": round(med_big * 1e3, 4),
+        **({"floor_saturated": True} if saturated(pb_big) else {}),
+    }
 
     # --- on-device exactness: one real dispatch, verify vs native oracle
     ds = rng.integers(0, N, size=B)
@@ -568,7 +603,7 @@ def bench_shared(jax, jnp, floor, details, state):
         dt = time.time() - t0
         f1 = _floor_once(jax, jnp)
         total += got
-        times.append(max(dt - min(f0, f1, dt), 1e-5 * K) / K)
+        times.append(max(dt - min(f0, f1, dt), EPS * K) / K)
     med = float(np.median(times))
     rate = B / med
     log(f"#4 shared-group match+device pick: {med * 1e3:.3f} ms/batch "
@@ -619,7 +654,7 @@ def bench_rules(jax, jnp, floor, details):
     from emqx_tpu.ops.hash_index import ClassIndex, match_ids_hash
     from emqx_tpu.ops.table import FilterTable
 
-    L, B, K, NR = 8, 1024, 16, 10_000
+    L, B, K, NR = 8, 1024, 128, 10_000  # small table: big K so\n    # kernel work dominates the relay floor noise
     table = FilterTable(max_levels=L, capacity=1 << 14)
     index = ClassIndex(L, min_slots=1 << 16)
     for i in range(NR):
@@ -658,6 +693,7 @@ def bench_rules(jax, jnp, floor, details):
     details["config5_rule_filters"] = {
         "tpu_topics_per_sec": round(B / med, 1),
         "rules": NR,
+        **({"floor_saturated": True} if saturated(per_batch) else {}),
     }
 
 

@@ -269,6 +269,8 @@ class Node:
                 obs=self.obs,
                 backup_dir=os.path.join(data_dir, "backup"),
                 ft=self.ft,
+                gateways=self.gateways,
+                listeners=self.listeners,
             )
             host, port = parse_bind(cfg.get("api.bind"))
             await self.mgmt.start(host, port)

@@ -108,9 +108,15 @@ class Listeners:
         srv = self._live.pop((ltype, name), None)
         if srv is None:
             return False
-        self._conf.pop((ltype, name), None)
+        # the CONFIG survives a stop: a later start() without an
+        # explicit config restores the listener as it was, instead of
+        # rebinding on schema defaults
         await srv.stop()
         return True
+
+    def conf_of(self, ltype: str, name: str) -> Optional[Dict]:
+        c = self._conf.get((ltype, name))
+        return dict(c) if c is not None else None
 
     async def update(self, ltype: str, name: str, conf: Dict) -> Server:
         """Restart-on-update (the reference restarts when bind or

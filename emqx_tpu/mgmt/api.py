@@ -161,6 +161,8 @@ class ManagementApi:
         obs=None,  # Observability bundle (emqx_tpu.obs.Observability)
         backup_dir: str = "data/backup",
         ft=None,  # FileTransfer (exports listing)
+        gateways=None,  # GatewayRegistry
+        listeners=None,  # broker.listeners.Listeners manager
     ):
         from .audit import AuditLog
 
@@ -171,6 +173,8 @@ class ManagementApi:
         self.node = node
         self.obs = obs
         self.ft = ft
+        self.gateways = gateways
+        self.listeners = listeners
         self.evacuation = None  # NodeEvacuation, created on demand
         self.node_name = node_name
         self.backup_dir = backup_dir
@@ -290,6 +294,14 @@ class ManagementApi:
             r("GET", "/api/v5/trace/{name}/log", self._trace_log)
         r("GET", "/api/v5/audit", self._audit_list)
         r("GET", "/api/v5/file_transfer/files", self._ft_files)
+        r("GET", "/api/v5/gateways", self._gateways_list)
+        r("GET", "/api/v5/gateways/{name}", self._gateway_one)
+        r("PUT", "/api/v5/gateways/{name}", self._gateway_put)
+        r("DELETE", "/api/v5/gateways/{name}", self._gateway_delete)
+        r("GET", "/api/v5/listeners", self._listeners_list)
+        r("POST", "/api/v5/listeners/{id}/stop", self._listener_stop)
+        r("POST", "/api/v5/listeners/{id}/start", self._listener_start)
+        r("GET", "/api/v5/cluster", self._cluster_view)
         r("POST", "/api/v5/load_rebalance/evacuation/start", self._evac_start)
         r("POST", "/api/v5/load_rebalance/evacuation/stop", self._evac_stop)
         r("GET", "/api/v5/load_rebalance/status", self._evac_status)
@@ -313,6 +325,99 @@ class ManagementApi:
                 result="ok" if resp.status < 400 else "failed",
                 code=resp.status,
             )
+
+    # --- gateways / listeners / cluster -----------------------------------
+
+    def _gateways_list(self, req: Request):
+        if self.gateways is None:
+            return {"gateways": [], "types": []}
+        return {
+            "gateways": self.gateways.status(),
+            "types": self.gateways.types(),
+        }
+
+    def _gateway_one(self, req: Request):
+        if self.gateways is None:
+            return Response.error(404, "NOT_FOUND", "gateways not enabled")
+        gw = self.gateways.get(req.params["name"])
+        if gw is None:
+            return Response.error(404, "NOT_FOUND", req.params["name"])
+        return {
+            "name": req.params["name"],
+            "status": "running",
+            "current_connections": gw.connection_count(),
+            "listeners": gw.listener_info(),
+            "config": gw.conf,
+        }
+
+    async def _gateway_put(self, req: Request):
+        if self.gateways is None:
+            return Response.error(404, "NOT_FOUND", "gateways not enabled")
+        name = req.params["name"]
+        conf = req.json() or {}
+        try:
+            if self.gateways.get(name) is None:
+                gw = await self.gateways.load(name, conf)
+            else:
+                gw = await self.gateways.update(name, conf)
+        except KeyError:
+            return Response.error(400, "BAD_REQUEST", f"unknown gateway type {name!r}")
+        return {"name": name, "listeners": gw.listener_info()}
+
+    async def _gateway_delete(self, req: Request):
+        if self.gateways is None:
+            return Response.error(404, "NOT_FOUND", "gateways not enabled")
+        ok = await self.gateways.unload(req.params["name"])
+        return (204, None) if ok else Response.error(
+            404, "NOT_FOUND", req.params["name"]
+        )
+
+    def _listeners_list(self, req: Request):
+        if self.listeners is not None:
+            return self.listeners.info()
+        return views.listeners_view(self.broker)
+
+    def _split_listener_id(self, req: Request):
+        lid = req.params["id"]
+        if ":" not in lid:
+            raise ValueError("listener id is <type>:<name>")
+        return lid.split(":", 1)
+
+    async def _listener_stop(self, req: Request):
+        if self.listeners is None:
+            return Response.error(404, "NOT_FOUND", "no listener manager")
+        ltype, name = self._split_listener_id(req)
+        ok = await self.listeners.stop(ltype, name)
+        return (204, None) if ok else Response.error(
+            404, "NOT_FOUND", req.params["id"]
+        )
+
+    async def _listener_start(self, req: Request):
+        if self.listeners is None:
+            return Response.error(404, "NOT_FOUND", "no listener manager")
+        ltype, name = self._split_listener_id(req)
+        conf = req.json() or self.listeners.conf_of(ltype, name)
+        if conf is None:
+            return Response.error(
+                404, "NOT_FOUND", f"no stored config for {req.params['id']}"
+            )
+        srv = await self.listeners.start(ltype, name, conf)
+        return {"id": srv.name, "bind": f"{srv.listen_addr[0]}:{srv.listen_addr[1]}"}
+
+    def _cluster_view(self, req: Request):
+        if self.node is None:
+            return {"name": "standalone", "nodes": [self.node_name]}
+        return {
+            "name": getattr(self.node, "cluster_name", "emqxcl"),
+            "self": self.node.node_id,
+            "nodes": sorted(
+                [self.node.node_id, *self.node.membership.members]
+            ),
+            "members": {
+                n: f"{a[0]}:{a[1]}"
+                for n, a in self.node.membership.members.items()
+            },
+        }
 
     def _ft_files(self, req: Request):
         if self.ft is None:

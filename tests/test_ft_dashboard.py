@@ -155,3 +155,63 @@ async def test_ft_and_evacuation_rest(tmp_path):
         None, lambda: call("POST", "/api/v5/load_rebalance/evacuation/stop",
                            tok=tok))
     await api.stop()
+
+
+async def test_gateway_listener_cluster_rest(tmp_path):
+    import urllib.request
+
+    from emqx_tpu.broker.listeners import Listeners
+    from emqx_tpu.gateway import GatewayRegistry
+    from emqx_tpu.mgmt.api import ManagementApi
+
+    b = Broker()
+    lis = Listeners(b)
+    await lis.start("tcp", "default", {"bind": "127.0.0.1:0"})
+    gws = GatewayRegistry(b)
+    api = ManagementApi(b, gateways=gws, listeners=lis)
+    host, port = await api.start()
+    loop = asyncio.get_running_loop()
+
+    def call(method, path, body=None, tok=None):
+        req = urllib.request.Request(
+            f"http://{host}:{port}{path}", method=method,
+            data=json.dumps(body).encode() if body is not None else None,
+            headers={"content-type": "application/json",
+                     **({"authorization": f"Bearer {tok}"} if tok else {})})
+        resp = urllib.request.urlopen(req)
+        raw = resp.read()
+        return json.loads(raw) if raw else {}
+
+    tok = (await loop.run_in_executor(None, lambda: call(
+        "POST", "/api/v5/login",
+        {"username": "admin", "password": "public"})))["token"]
+    # load a stomp gateway over REST
+    out = await loop.run_in_executor(None, lambda: call(
+        "PUT", "/api/v5/gateways/stomp", {"bind": "127.0.0.1:0"}, tok=tok))
+    assert out["name"] == "stomp" and out["listeners"]
+    gws_list = await loop.run_in_executor(None, lambda: call(
+        "GET", "/api/v5/gateways", tok=tok))
+    assert gws_list["gateways"][0]["name"] == "stomp"
+    one = await loop.run_in_executor(None, lambda: call(
+        "GET", "/api/v5/gateways/stomp", tok=tok))
+    assert one["status"] == "running"
+    await loop.run_in_executor(None, lambda: call(
+        "DELETE", "/api/v5/gateways/stomp", tok=tok))
+    assert gws.get("stomp") is None
+    # listeners lifecycle over REST
+    ls = await loop.run_in_executor(None, lambda: call(
+        "GET", "/api/v5/listeners", tok=tok))
+    assert ls[0]["id"] == "tcp:default"
+    await loop.run_in_executor(None, lambda: call(
+        "POST", "/api/v5/listeners/tcp:default/stop", tok=tok))
+    assert lis.get("tcp", "default") is None
+    out2 = await loop.run_in_executor(None, lambda: call(
+        "POST", "/api/v5/listeners/tcp:default/start",
+        {"bind": "127.0.0.1:0"}, tok=tok))
+    assert out2["id"] == "tcp:default"
+    # cluster view (standalone)
+    cv = await loop.run_in_executor(None, lambda: call(
+        "GET", "/api/v5/cluster", tok=tok))
+    assert cv["name"] == "standalone"
+    await api.stop()
+    await lis.stop_all()
