@@ -654,7 +654,8 @@ def bench_rules(jax, jnp, floor, details):
     from emqx_tpu.ops.hash_index import ClassIndex, match_ids_hash
     from emqx_tpu.ops.table import FilterTable
 
-    L, B, K, NR = 8, 1024, 128, 10_000  # small table: big K so\n    # kernel work dominates the relay floor noise
+    # small table: big K so kernel work dominates the relay floor noise
+    L, B, K, NR = 8, 1024, 128, 10_000
     table = FilterTable(max_levels=L, capacity=1 << 14)
     index = ClassIndex(L, min_slots=1 << 16)
     for i in range(NR):

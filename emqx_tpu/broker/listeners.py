@@ -126,11 +126,14 @@ class Listeners:
         config — a rejected change must not turn into an outage."""
         self._build(ltype, name, conf)  # validate (bind parse, certs)
         old_conf = self._conf.get((ltype, name))
+        was_running = (ltype, name) in self._live
         await self.stop(ltype, name)
         try:
             return await self.start(ltype, name, conf)
         except Exception:
-            if old_conf is not None:
+            # roll back only what was RUNNING — a failed update must
+            # never resurrect a deliberately-stopped listener
+            if was_running and old_conf is not None:
                 try:
                     await self.start(ltype, name, old_conf)
                 except Exception:

@@ -25,11 +25,13 @@ class GatewayRegistry:
         self.broker = broker
         self._types: Dict[str, Type[GatewayImpl]] = {}
         self._running: Dict[str, GatewayImpl] = {}
+        from .coap import CoapGateway
         from .stomp import StompGateway
         from .mqttsn import MqttSnGateway
 
         self.register_type("stomp", StompGateway)
         self.register_type("mqttsn", MqttSnGateway)
+        self.register_type("coap", CoapGateway)
 
     def register_type(self, name: str, impl: Type[GatewayImpl]) -> None:
         self._types[name] = impl

@@ -1,0 +1,361 @@
+"""CoAP gateway (RFC 7252 over UDP), pubsub mode — the
+emqx_gateway_coap analog.
+
+URI convention (emqx_coap_channel.erl:685, emqx_coap_pubsub_handler):
+`/ps/{topic...}` with optional `?clientid=...&qos=...` query:
+
+    PUT/POST /ps/a/b  payload     -> MQTT publish a/b
+    GET      /ps/a/b  Observe:0   -> subscribe (notifications arrive as
+                                     NON 2.05 Content with an Observe
+                                     sequence and the register token)
+    GET      /ps/a/b  Observe:1   -> unsubscribe
+    GET      /ps/a/b  (no observe)-> read the retained message
+
+CON requests are ACKed (piggybacked responses); observers keyed by
+(address, token). One CoAP endpoint address = one broker session, so
+observers interoperate with every other protocol through pubsub.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import struct
+from typing import Dict, List, Optional, Tuple
+
+from .base import GatewayImpl
+
+log = logging.getLogger("emqx_tpu.gateway.coap")
+
+# message types
+CON, NON, ACK, RST = 0, 1, 2, 3
+# method / response codes (class << 5 | detail)
+GET, POST, PUT, DELETE = 1, 2, 3, 4
+CREATED = 0x41  # 2.01
+DELETED = 0x42  # 2.02
+CHANGED = 0x44  # 2.04
+CONTENT = 0x45  # 2.05
+BAD_REQUEST = 0x80  # 4.00
+UNAUTHORIZED = 0x81  # 4.01
+NOT_FOUND = 0x84  # 4.04
+
+OPT_OBSERVE = 6
+OPT_URI_PATH = 11
+OPT_CONTENT_FORMAT = 12
+OPT_URI_QUERY = 15
+
+
+class CoapMessage:
+    def __init__(self, mtype=NON, code=0, mid=0, token=b"",
+                 options=None, payload=b""):
+        self.mtype = mtype
+        self.code = code
+        self.mid = mid
+        self.token = token
+        self.options: List[Tuple[int, bytes]] = options or []
+        self.payload = payload
+
+    def opt_all(self, num: int) -> List[bytes]:
+        return [v for n, v in self.options if n == num]
+
+    def opt(self, num: int) -> Optional[bytes]:
+        vals = self.opt_all(num)
+        return vals[0] if vals else None
+
+
+def _ext(v: int) -> Tuple[int, bytes]:
+    if v < 13:
+        return v, b""
+    if v < 269:
+        return 13, bytes([v - 13])
+    return 14, struct.pack(">H", v - 269)
+
+
+def encode(msg: CoapMessage) -> bytes:
+    out = bytearray()
+    out.append((1 << 6) | (msg.mtype << 4) | len(msg.token))
+    out.append(msg.code)
+    out += struct.pack(">H", msg.mid)
+    out += msg.token
+    last = 0
+    for num, val in sorted(msg.options, key=lambda o: o[0]):
+        dnib, dext = _ext(num - last)
+        lnib, lext = _ext(len(val))
+        out.append((dnib << 4) | lnib)
+        out += dext + lext + val
+        last = num
+    if msg.payload:
+        out += b"\xff" + msg.payload
+    return bytes(out)
+
+
+def _read_ext(nib: int, data: bytes, off: int) -> Tuple[int, int]:
+    if nib < 13:
+        return nib, off
+    if nib == 13:
+        return data[off] + 13, off + 1
+    if nib == 14:
+        return struct.unpack_from(">H", data, off)[0] + 269, off + 2
+    raise ValueError("reserved option nibble")
+
+
+def decode(data: bytes) -> CoapMessage:
+    if len(data) < 4:
+        raise ValueError("short coap message")
+    ver = data[0] >> 6
+    if ver != 1:
+        raise ValueError("bad coap version")
+    mtype = (data[0] >> 4) & 0x3
+    tkl = data[0] & 0xF
+    if tkl > 8:
+        raise ValueError("bad token length")
+    code = data[1]
+    (mid,) = struct.unpack_from(">H", data, 2)
+    off = 4
+    token = data[off : off + tkl]
+    if len(token) < tkl:
+        raise ValueError("truncated token")
+    off += tkl
+    options: List[Tuple[int, bytes]] = []
+    num = 0
+    while off < len(data):
+        b = data[off]
+        if b == 0xFF:
+            off += 1
+            break
+        off += 1
+        dnib, lnib = b >> 4, b & 0xF
+        delta, off = _read_ext(dnib, data, off)
+        length, off = _read_ext(lnib, data, off)
+        num += delta
+        if off + length > len(data):
+            raise ValueError("truncated option")
+        options.append((num, data[off : off + length]))
+        off += length
+    return CoapMessage(mtype, code, mid, token, options, data[off:])
+
+
+class _CoapProtocol(asyncio.DatagramProtocol):
+    def __init__(self, gw: "CoapGateway"):
+        self.gw = gw
+
+    def connection_made(self, transport) -> None:
+        self.gw._transport = transport
+
+    def datagram_received(self, data: bytes, addr) -> None:
+        try:
+            self.gw.handle_datagram(data, addr)
+        except ValueError as e:
+            log.debug("bad coap datagram from %s: %s", addr, e)
+        except Exception:
+            log.exception("coap datagram crashed")
+
+
+class _Observer:
+    def __init__(self, token: bytes, topic: str):
+        self.token = token
+        self.topic = topic
+        self.seq = 1
+        self.last_mid = -1  # mid of the last notification (RST cancel)
+
+
+class CoapGateway(GatewayImpl):
+    name = "coap"
+
+    def __init__(self, broker, conf: dict):
+        super().__init__(broker, conf)
+        self._transport = None
+        self.listen_addr = None
+        self._mid = 0
+        # endpoint addr -> session + its observers (token hex -> _Observer)
+        self.peers: Dict[tuple, dict] = {}
+        # unauthenticated UDP sources must not grow sessions unbounded
+        self.max_peers = int(conf.get("max_connections", 10_000))
+
+    async def on_load(self) -> None:
+        from ..broker.listeners import parse_bind
+
+        host, port = parse_bind(self.conf.get("bind", "0.0.0.0:5683"))
+        loop = asyncio.get_running_loop()
+        self._transport, _ = await loop.create_datagram_endpoint(
+            lambda: _CoapProtocol(self), local_addr=(host, port)
+        )
+        self.listen_addr = self._transport.get_extra_info("sockname")[:2]
+        log.info("coap gateway on %s", self.listen_addr)
+
+    async def on_unload(self) -> None:
+        for addr in list(self.peers):
+            self._drop_peer(addr)
+        if self._transport is not None:
+            self._transport.close()
+            self._transport = None
+
+    def connection_count(self) -> int:
+        return len(self.peers)
+
+    def listener_info(self) -> List[dict]:
+        return (
+            [{"type": "udp", "bind": f"{self.listen_addr[0]}:{self.listen_addr[1]}"}]
+            if self.listen_addr
+            else []
+        )
+
+    # --- request handling ------------------------------------------------
+
+    def _send(self, addr, msg: CoapMessage) -> None:
+        if self._transport is not None:
+            self._transport.sendto(encode(msg), addr)
+
+    def _reply(self, addr, req: CoapMessage, code: int,
+               payload: bytes = b"", options=None) -> None:
+        # CON -> piggybacked ACK; NON -> NON response (RFC 7252 §5.2)
+        if req.mtype == CON:
+            mtype, mid = ACK, req.mid
+        else:
+            self._mid = (self._mid + 1) & 0xFFFF
+            mtype, mid = NON, self._mid
+        self._send(addr, CoapMessage(mtype, code, mid, req.token,
+                                     options or [], payload))
+
+    def _peer(self, addr, query: Dict[str, str]) -> dict:
+        p = self.peers.get(addr)
+        if p is None:
+            if len(self.peers) >= self.max_peers:
+                raise BufferError("coap peer limit reached")
+            cid = query.get("clientid") or f"{addr[0]}-{addr[1]}"
+            session, _ = self.open_session(cid)
+            session.outgoing_sink = lambda pkts, a=addr: self._deliver(a, pkts)
+            p = {"session": session, "observers": {}}
+            self.peers[addr] = p
+        return p
+
+    def _drop_peer(self, addr) -> None:
+        p = self.peers.pop(addr, None)
+        if p is not None:
+            self.close_session(p["session"])
+
+    def handle_datagram(self, data: bytes, addr) -> None:
+        msg = decode(data)
+        if msg.mtype in (ACK, RST):
+            if msg.mtype == RST:
+                # RFC 7641 §3.6: the RST is an EMPTY message echoing
+                # the notification's message id — match by mid
+                self._cancel_by_mid(addr, msg.mid)
+            return
+        if not (1 <= msg.code <= 4):
+            return  # only requests
+        path = [v.decode("utf-8", "replace") for v in msg.opt_all(OPT_URI_PATH)]
+        query = dict(
+            q.decode("utf-8", "replace").partition("=")[::2]
+            for q in msg.opt_all(OPT_URI_QUERY)
+        )
+        if not path or path[0] != "ps" or len(path) < 2:
+            self._reply(addr, msg, NOT_FOUND)
+            return
+        topic = "/".join(path[1:])
+        try:
+            if msg.code in (PUT, POST):
+                self._handle_publish(addr, msg, topic, query)
+            elif msg.code == GET:
+                self._handle_get(addr, msg, topic, query)
+            elif msg.code == DELETE:
+                self._drop_peer(addr)
+                self._reply(addr, msg, DELETED)
+        except (ValueError, PermissionError):
+            self._reply(addr, msg, UNAUTHORIZED)
+        except BufferError:
+            self._reply(addr, msg, 0xA3)  # 5.03 Service Unavailable
+
+    def _handle_publish(self, addr, msg, topic, query) -> None:
+        p = self._peer(addr, query)
+        qos = int(query.get("qos", "0") or 0)
+        retain = query.get("retain") in ("true", "1")
+        self.publish(p["session"], topic, msg.payload, qos=min(qos, 1),
+                     retain=retain)
+        self._reply(addr, msg, CHANGED)
+
+    def _handle_get(self, addr, msg, topic, query) -> None:
+        obs = msg.opt(OPT_OBSERVE)
+        if obs is not None and not msg.token:
+            self._reply(addr, msg, BAD_REQUEST, b"observe without token")
+            return
+        # a 0-length option value IS the uint 0 (RFC 7252 §3.2) —
+        # presence must be None-checked, never truthiness-checked
+        obs_val = int.from_bytes(obs, "big") if obs is not None else None
+        if obs_val == 0:  # register (the only GET that makes a peer)
+            p = self._peer(addr, query)
+            self.subscribe(p["session"], topic,
+                           qos=min(int(query.get("qos", "0") or 0), 1))
+            p["observers"][msg.token.hex()] = _Observer(msg.token, topic)
+            self._reply(addr, msg, CONTENT,
+                        options=[(OPT_OBSERVE, b"\x00")])
+            return
+        if obs_val == 1:  # deregister
+            self._cancel_token(addr, msg.token)
+            self._reply(addr, msg, CONTENT)
+            return
+        # plain GET: a retained-message read. Same ACL gate as a
+        # subscribe (a denied client must not read retained state),
+        # and NO peer/session allocation — stateless reads from
+        # spoofed sources must not grow broker sessions
+        client_id = f"{self.name}-" + (
+            query.get("clientid") or f"{addr[0]}-{addr[1]}"
+        )
+        allowed = self.broker.hooks.run_fold(
+            "client.authorize", (client_id, "subscribe", topic), True
+        )
+        if allowed is not True:
+            self._reply(addr, msg, UNAUTHORIZED)
+            return
+        retained = self.broker.retainer.read(self.mountpoint + topic)
+        if retained:
+            self._reply(addr, msg, CONTENT, payload=retained[0].payload)
+        else:
+            self._reply(addr, msg, NOT_FOUND)
+
+    def _cancel_token(self, addr, token: bytes) -> None:
+        p = self.peers.get(addr)
+        if p is None:
+            return
+        o = p["observers"].pop(token.hex(), None)
+        if o is not None and not any(
+            x.topic == o.topic for x in p["observers"].values()
+        ):
+            self.unsubscribe(p["session"], o.topic)
+
+    def _cancel_by_mid(self, addr, mid: int) -> None:
+        p = self.peers.get(addr)
+        if p is None:
+            return
+        for o in list(p["observers"].values()):
+            if o.last_mid == mid:
+                self._cancel_token(addr, o.token)
+                return
+
+    # --- delivery (broker -> observe notification) ------------------------
+
+    def _deliver(self, addr, pkts) -> None:
+        p = self.peers.get(addr)
+        if p is None:
+            return
+        from ..ops import topic as topic_mod
+
+        for pkt in pkts:
+            topic = self.unmount(pkt.topic)
+            tw = topic_mod.words(topic)
+            # EVERY matching observation notifies — registrations are
+            # independent resources (RFC 7641), not dedup candidates
+            for o in list(p["observers"].values()):
+                if topic_mod.match(tw, topic_mod.words(o.topic)):
+                    o.seq = (o.seq + 1) & 0xFFFFFF
+                    self._mid = (self._mid + 1) & 0xFFFF
+                    o.last_mid = self._mid
+                    self._send(
+                        addr,
+                        CoapMessage(
+                            NON, CONTENT, self._mid, o.token,
+                            [(OPT_OBSERVE,
+                              o.seq.to_bytes(3, "big").lstrip(b"\x00") or b"\x01")],
+                            pkt.payload,
+                        ),
+                    )

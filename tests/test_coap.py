@@ -1,0 +1,131 @@
+"""CoAP gateway (pubsub mode) over real UDP sockets.
+
+Ref: apps/emqx_gateway_coap (emqx_coap_channel.erl:685 /ps/ routing,
+emqx_coap_pubsub_handler observe register/deregister).
+"""
+
+import asyncio
+
+import pytest
+
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.packet import SubOpts
+from emqx_tpu.broker.pubsub import Broker
+from emqx_tpu.gateway import GatewayRegistry
+from emqx_tpu.gateway.coap import (
+    ACK, CHANGED, CoapMessage, CON, CONTENT, GET, NON, NOT_FOUND,
+    OPT_OBSERVE, OPT_URI_PATH, OPT_URI_QUERY, PUT, decode, encode,
+)
+
+
+def test_codec_roundtrip():
+    m = CoapMessage(
+        CON, PUT, 0x1234, b"tok1",
+        [(OPT_URI_PATH, b"ps"), (OPT_URI_PATH, b"a"), (OPT_URI_PATH, b"b"),
+         (OPT_URI_QUERY, b"qos=1"), (OPT_OBSERVE, b"\x00")],
+        b"hello",
+    )
+    d = decode(encode(m))
+    assert (d.mtype, d.code, d.mid, d.token, d.payload) == (
+        CON, PUT, 0x1234, b"tok1", b"hello")
+    assert d.opt_all(OPT_URI_PATH) == [b"ps", b"a", b"b"]
+    assert d.opt(OPT_OBSERVE) == b"\x00"
+    # large option delta (observe=6 .. uri_query=15 spans ext encoding)
+    big = CoapMessage(NON, GET, 1, b"", [(300, b"x"), (14, b"y")])
+    d2 = decode(encode(big))
+    assert sorted(d2.options) == [(14, b"y"), (300, b"x")]
+    with pytest.raises(ValueError):
+        decode(b"\x00\x01")  # wrong version/short
+
+
+class CoapClient(asyncio.DatagramProtocol):
+    def __init__(self):
+        self.inbox = asyncio.Queue()
+        self.transport = None
+        self._mid = 0
+
+    def connection_made(self, transport):
+        self.transport = transport
+
+    def datagram_received(self, data, addr):
+        self.inbox.put_nowait(decode(data))
+
+    def request(self, code, path, payload=b"", token=b"", options=None,
+                query=None, mtype=CON):
+        self._mid += 1
+        opts = [(OPT_URI_PATH, seg.encode()) for seg in path.split("/")]
+        for q in query or []:
+            opts.append((OPT_URI_QUERY, q.encode()))
+        opts += options or []
+        self.transport.sendto(encode(CoapMessage(
+            mtype, code, self._mid, token, opts, payload)))
+        return self._mid
+
+    async def recv(self, timeout=5.0):
+        return await asyncio.wait_for(self.inbox.get(), timeout)
+
+
+async def make(broker=None):
+    b = broker or Broker()
+    reg = GatewayRegistry(b)
+    gw = await reg.load("coap", {"bind": "127.0.0.1:0"})
+    loop = asyncio.get_running_loop()
+    t, c = await loop.create_datagram_endpoint(
+        CoapClient, remote_addr=gw.listen_addr)
+    return b, reg, gw, t, c
+
+
+async def test_publish_and_observe():
+    b, reg, gw, t, c = await make()
+    # MQTT-side subscriber sees CoAP publishes
+    outs = []
+    s, _ = b.open_session("mq", True)
+    b.subscribe(s, "sensors/#", SubOpts())
+    s.outgoing_sink = outs.extend
+    mid = c.request(PUT, "ps/sensors/one", b"21.5", query=["clientid=dev1"])
+    resp = await c.recv()
+    assert (resp.mtype, resp.code, resp.mid) == (ACK, CHANGED, mid)
+    assert outs and outs[0].topic == "sensors/one" and outs[0].payload == b"21.5"
+    # observe registration, then an MQTT publish notifies the observer
+    c.request(GET, "ps/alerts/fire", token=b"t1",
+              options=[(OPT_OBSERVE, b"")],  # 0-length int = 0 (register)
+              query=["clientid=dev1"])
+    reg_resp = await c.recv()
+    assert reg_resp.code == CONTENT
+    b.publish(Message(topic="alerts/fire", payload=b"evacuate"))
+    note = await c.recv()
+    assert note.code == CONTENT and note.token == b"t1"
+    assert note.payload == b"evacuate"
+    assert note.opt(OPT_OBSERVE) is not None
+    # deregister stops notifications
+    c.request(GET, "ps/alerts/fire", token=b"t1",
+              options=[(OPT_OBSERVE, b"\x01")], query=["clientid=dev1"])
+    await c.recv()
+    b.publish(Message(topic="alerts/fire", payload=b"again"))
+    await asyncio.sleep(0.1)
+    assert c.inbox.empty()
+    t.close()
+    await reg.unload_all()
+
+
+async def test_plain_get_reads_retained():
+    b, reg, gw, t, c = await make()
+    b.publish(Message(topic="cfg/v", payload=b"1.2.3", retain=True))
+    c.request(GET, "ps/cfg/v")
+    resp = await c.recv()
+    assert resp.code == CONTENT and resp.payload == b"1.2.3"
+    c.request(GET, "ps/cfg/missing")
+    assert (await c.recv()).code == NOT_FOUND
+    t.close()
+    await reg.unload_all()
+
+
+async def test_bad_paths_and_observe_without_token():
+    b, reg, gw, t, c = await make()
+    c.request(GET, "other/x")
+    assert (await c.recv()).code == NOT_FOUND
+    c.request(GET, "ps/t", options=[(OPT_OBSERVE, b"")])  # no token
+    resp = await c.recv()
+    assert resp.code >> 5 == 4  # 4.xx
+    t.close()
+    await reg.unload_all()
