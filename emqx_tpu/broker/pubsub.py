@@ -71,8 +71,11 @@ class Broker:
 
         self.caps = MqttCaps()
         # exclusive-subscription claims: topic -> owning client
-        # (emqx_exclusive_subscription mria set table)
+        # (emqx_exclusive_subscription mria set table); the cluster
+        # layer replicates transitions through these callbacks
         self.exclusive: Dict[str, str] = {}
+        self.on_exclusive_claimed = None  # fn(topic, client)
+        self.on_exclusive_released = None  # fn(topic, client)
         # live listeners (Server instances register on start)
         self.servers: list = []
         # (filter, client) subopts — mirror of ?SUBOPTION
@@ -199,6 +202,11 @@ class Broker:
             if owner is not None and owner != session.client_id:
                 raise ExclusiveTaken(flt)
             self.exclusive[flt] = session.client_id
+            if self.on_exclusive_claimed is not None:
+                # fire on RE-claims too: a client that moved nodes must
+                # transfer claim OWNERSHIP to its new node (dup xadds
+                # are idempotent on the cluster side)
+                self.on_exclusive_claimed(flt, session.client_id)
         # durable sessions route through the ps-router + DS scheduler,
         # never the live router (emqx_persistent_session_ds model)
         if self.durable is not None and self._is_durable(session) and group is None:
@@ -258,6 +266,8 @@ class Broker:
     def _release_exclusive(self, client_id: str, flt: str) -> None:
         if self.exclusive.get(flt) == client_id:
             del self.exclusive[flt]
+            if self.on_exclusive_released is not None:
+                self.on_exclusive_released(flt, client_id)
 
     @staticmethod
     def _is_durable(session: Session) -> bool:

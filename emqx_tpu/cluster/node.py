@@ -170,6 +170,14 @@ class ClusterNode:
         self.broker.shared.on_unsubscribed = (
             lambda g, f, c: self.on_shared_unsubscribed(g, f, c)
         )
+        # exclusive claims replicate like every other table; the claim
+        # check consults the converged local replica (no global lock —
+        # a cross-node race has the same bounded-divergence window the
+        # takeover path documents; the reference closes it with a mria
+        # transaction)
+        self._exclusive_owner: Dict[str, str] = {}  # topic -> node
+        self.broker.on_exclusive_claimed = self._on_exclusive_claimed
+        self.broker.on_exclusive_released = self._on_exclusive_released
         self.membership.on_member_down.append(self._purge_node)
         self.membership.on_member_up.append(self._on_member_up)
         self.membership.on_ping_ok.append(self._maybe_resync)
@@ -333,6 +341,79 @@ class ClusterNode:
         self._shared_del(group, flt, self.node_id, client)
         self._enqueue_op(("del_s", group, flt, self.node_id, client))
 
+    def _on_exclusive_claimed(self, topic: str, client: str) -> None:
+        self._exclusive_owner[topic] = self.node_id
+        self._enqueue_op(("xadd", topic, self.node_id, client))
+
+    def _on_exclusive_released(self, topic: str, client: str) -> None:
+        owner = self._exclusive_owner.get(topic)
+        if owner is not None and owner != self.node_id:
+            # the claim MOVED to another node (client reconnected
+            # there): this node's teardown must not delete the live
+            # claim — undo the local release and stay quiet
+            self.broker.exclusive[topic] = client
+            return
+        self._exclusive_owner.pop(topic, None)
+        self._enqueue_op(("xdel", topic, self.node_id, client))
+
+    def _xadd(self, topic: str, node: str, client: str) -> None:
+        """Deterministic convergence: on conflict the smaller
+        (node, client) pair wins EVERYWHERE; a losing locally-owned
+        claim force-unsubscribes its session, and the winning OWNER
+        re-asserts once so reordered third parties converge too (the
+        reference avoids all this with a mria transaction; this is the
+        documented lock-free analog)."""
+        cur = self.broker.exclusive.get(topic)
+        if cur is None:
+            self.broker.exclusive[topic] = client
+            self._exclusive_owner[topic] = node
+            return
+        cur_node = self._exclusive_owner.get(topic, self.node_id)
+        if cur == client:
+            # same claimant, possibly a NEW owning node (the client
+            # reconnected elsewhere): ownership follows the claimant
+            self._exclusive_owner[topic] = node
+            return
+        if (node, client) < (cur_node, cur):
+            # incoming wins; revoke the local claimant if we own it
+            if cur_node == self.node_id:
+                self._exclusive_owner[topic] = node  # silence release op
+                sess = self.broker.sessions.get(cur)
+                if sess is not None:
+                    try:
+                        self.broker.unsubscribe(sess, topic)
+                    except Exception:
+                        log.exception("exclusive revoke of %r failed", cur)
+            self.broker.exclusive[topic] = client
+            self._exclusive_owner[topic] = node
+            log.warning(
+                "exclusive conflict on %r: %r@%s displaced %r@%s",
+                topic, client, node, cur, cur_node,
+            )
+        elif cur_node == self.node_id:
+            # we own the winning claim: re-assert so the loser's view
+            # (and any reordered third party) converges
+            self._enqueue_op(("xadd", topic, cur_node, cur))
+
+    def _xdel(self, topic: str, node: str, client: str) -> None:
+        # matched by CLAIMANT: the owning node may have changed since
+        # the op was queued (client moved); a stale node id must not
+        # keep a dead claim alive
+        if self.broker.exclusive.get(topic) != client:
+            return
+        if (
+            self._exclusive_owner.get(topic) == self.node_id
+            and node != self.node_id
+        ):
+            # the claimant moved HERE and its previous node's teardown
+            # raced the transfer: our live claim is authoritative —
+            # re-assert so every replica (including the releaser)
+            # converges back instead of deleting a live claim
+            self._enqueue_op(("xadd", topic, self.node_id, client))
+            return
+        del self.broker.exclusive[topic]
+        self._exclusive_owner.pop(topic, None)
+
     def announce_session(self, client: str) -> None:
         self.registry[client] = self.node_id
         self._enqueue_op(("sess_up", client, self.node_id))
@@ -408,6 +489,10 @@ class ClusterNode:
             elif kind == "sess_down":
                 if self.registry.get(op[1]) == op[2]:
                     del self.registry[op[1]]
+            elif kind == "xadd":
+                self._xadd(op[1], op[2], op[3])
+            elif kind == "xdel":
+                self._xdel(op[1], op[2], op[3])
 
     def _full_dump_ops(self) -> List[tuple]:
         """Ops reconstructing THIS node's contributions (join announce,
@@ -419,6 +504,9 @@ class ClusterNode:
             for node, client in members:
                 if node == self.node_id:
                     ops.append(("add_s", group, flt, node, client))
+        for topic, node in self._exclusive_owner.items():
+            if node == self.node_id and topic in self.broker.exclusive:
+                ops.append(("xadd", topic, node, self.broker.exclusive[topic]))
         return ops
 
     def _handle_bootstrap(self) -> dict:
@@ -429,6 +517,9 @@ class ClusterNode:
         for (group, flt), members in self.cluster_shared.items():
             for node, client in members:
                 ops.append(("add_s", group, flt, node, client))
+        for topic, node in self._exclusive_owner.items():
+            if topic in self.broker.exclusive:
+                ops.append(("xadd", topic, node, self.broker.exclusive[topic]))
         return {
             "ops": ops,
             "sessions": [(c, n) for c, n in self.registry.items()],
@@ -658,3 +749,9 @@ class ClusterNode:
         for client, node in list(self.registry.items()):
             if node == node_id:
                 del self.registry[client]
+        for topic, node in list(self._exclusive_owner.items()):
+            if node == node_id and node_id != self.node_id:
+                # self-purge (rejoin) must NOT delete broker-LOCAL
+                # truth — live local claims re-announce via the dump
+                self.broker.exclusive.pop(topic, None)
+                del self._exclusive_owner[topic]

@@ -362,3 +362,97 @@ async def test_multicall_returns_errors_in_place():
         assert isinstance(res[1], Exception)
     finally:
         await stop_all(nodes)
+
+
+async def test_exclusive_claims_replicate():
+    """$exclusive claims are cluster-wide: a second claimant on ANOTHER
+    node is rejected; claims release on unsubscribe and purge on
+    nodedown (emqx_exclusive_subscription mria table analog)."""
+    from emqx_tpu.broker.pubsub import ExclusiveTaken
+
+    a = ClusterNode("n1", heartbeat_interval=0.05, miss_threshold=2)
+    b = ClusterNode("n2", heartbeat_interval=0.05, miss_threshold=2)
+    addr_a = await a.start()
+    await b.start()
+    await b.join(addr_a)
+    try:
+        for n in (a, b):
+            n.broker.caps.exclusive_subscription = True
+        s1, _ = a.broker.open_session("c1", True)
+        a.broker.subscribe(s1, "$exclusive/jobs/1", SubOpts())
+        await asyncio.sleep(0.2)
+        assert b.broker.exclusive.get("jobs/1") == "c1"  # replicated
+        s2, _ = b.broker.open_session("c2", True)
+        with pytest.raises(ExclusiveTaken):
+            b.broker.subscribe(s2, "$exclusive/jobs/1", SubOpts())
+        # release on n1 frees the claim on n2
+        a.broker.unsubscribe(s1, "$exclusive/jobs/1")
+        await asyncio.sleep(0.2)
+        assert "jobs/1" not in b.broker.exclusive
+        b.broker.subscribe(s2, "$exclusive/jobs/1", SubOpts())
+        await asyncio.sleep(0.2)
+        assert a.broker.exclusive.get("jobs/1") == "c2"
+        # nodedown purges the dead node's claims on survivors
+        await b.stop()
+        await asyncio.sleep(0.6)
+        assert "jobs/1" not in a.broker.exclusive
+    finally:
+        await a.stop()
+        await b.stop()
+
+
+async def test_exclusive_claim_follows_client_across_nodes():
+    """A claimant that reconnects on another node keeps its claim; the
+    old node's teardown must not delete it (ownership transfer)."""
+    a = ClusterNode("n1", heartbeat_interval=0.05, miss_threshold=3)
+    b = ClusterNode("n2", heartbeat_interval=0.05, miss_threshold=3)
+    addr_a = await a.start()
+    await b.start()
+    await b.join(addr_a)
+    try:
+        for n in (a, b):
+            n.broker.caps.exclusive_subscription = True
+        s1, _ = a.broker.open_session("dev", True)
+        a.broker.subscribe(s1, "$exclusive/leases/1", SubOpts())
+        await asyncio.sleep(0.2)
+        # client moves to n2 and re-claims there
+        s2, _ = b.broker.open_session("dev", True)
+        b.broker.subscribe(s2, "$exclusive/leases/1", SubOpts())
+        await asyncio.sleep(0.2)
+        assert b._exclusive_owner.get("leases/1") == "n2"
+        # old node's session teardown must not kill the live claim
+        a.broker.close_session(s1)
+        await asyncio.sleep(0.3)
+        assert b.broker.exclusive.get("leases/1") == "dev"
+        assert a.broker.exclusive.get("leases/1") == "dev"
+    finally:
+        await a.stop()
+        await b.stop()
+
+
+async def test_exclusive_concurrent_claims_converge():
+    """Two nodes claim the same topic in the same sync window: the
+    deterministic (node, client) minimum wins on BOTH, and the loser's
+    session is force-unsubscribed."""
+    a = ClusterNode("n1", heartbeat_interval=0.05, miss_threshold=3)
+    b = ClusterNode("n2", heartbeat_interval=0.05, miss_threshold=3)
+    addr_a = await a.start()
+    await b.start()
+    await b.join(addr_a)
+    try:
+        for n in (a, b):
+            n.broker.caps.exclusive_subscription = True
+        sa, _ = a.broker.open_session("alice", True)
+        sb, _ = b.broker.open_session("bob", True)
+        # race: both claim before either replica converges
+        a.broker.subscribe(sa, "$exclusive/race/t", SubOpts())
+        b.broker.subscribe(sb, "$exclusive/race/t", SubOpts())
+        await asyncio.sleep(0.5)
+        # ("n1","alice") < ("n2","bob") -> alice everywhere
+        assert a.broker.exclusive.get("race/t") == "alice"
+        assert b.broker.exclusive.get("race/t") == "alice"
+        assert "race/t" not in sb.subscriptions  # loser revoked
+        assert "race/t" in sa.subscriptions
+    finally:
+        await a.stop()
+        await b.stop()
