@@ -211,7 +211,7 @@ class Node:
         # 8. listeners
         from .broker.listeners import Listeners
 
-        self.listeners = Listeners(broker)
+        self.listeners = Listeners(broker, config=cfg)
         lconf = cfg.get("listeners")
         if not any((lconf or {}).get(t) for t in ("tcp", "ssl", "ws", "wss")):
             lconf = {"tcp": {"default": {"bind": "0.0.0.0:1883"}}}

@@ -51,6 +51,7 @@ class Channel:
         peer: str = "?",
         mountpoint: str = "",
         max_packet_size: Optional[int] = None,
+        mqtt_conf: Optional[dict] = None,
     ):
         self.broker = broker
         self.peer = peer
@@ -72,6 +73,12 @@ class Channel:
         # the listener's inbound parser limit, advertised in CONNACK so
         # the client is never told a limit the parser will reject
         self.listener_max_packet = max_packet_size
+        # the listener zone's checked `mqtt` section: session windows,
+        # mqueue behavior, keepalive policy (emqx zone config)
+        self.mqtt_conf = mqtt_conf or {}
+        self.keepalive_multiplier = float(
+            self.mqtt_conf.get("keepalive_multiplier", 1.5)
+        )
         # client's advertised maximum packet size: outgoing PUBLISHes
         # exceeding it are dropped, not sent (MQTT-5 §3.1.2.11.4)
         self.client_max_packet: Optional[int] = None
@@ -168,14 +175,50 @@ class Channel:
             if self.mountpoint_tpl
             else ""
         )
-        cfg = SessionConfig()
+        mc = self.mqtt_conf
+
+        def _secs(key, default_s):
+            v = mc.get(key)
+            return default_s if v is None else float(v) / 1000.0
+
+        # schema encodes the default priority as "lowest"/"highest"
+        dp = mc.get("mqueue_default_priority", 0)
+        if dp == "lowest":
+            dp = 0
+        elif dp == "highest":
+            dp = 255
+        cfg = SessionConfig(
+            max_mqueue_len=mc.get("max_mqueue_len", 1000),
+            receive_maximum=mc.get("max_inflight", 32),
+            max_awaiting_rel=mc.get("max_awaiting_rel", 100),
+            await_rel_timeout=_secs("await_rel_timeout", 300.0),
+            retry_interval=_secs("retry_interval", 30.0),
+            upgrade_qos=mc.get("upgrade_qos", False),
+            mqueue_priorities={
+                k: int(v) for k, v in (mc.get("mqueue_priorities") or {}).items()
+            },
+            mqueue_default_priority=int(dp),
+            mqueue_store_qos0=mc.get("mqueue_store_qos0", True),
+        )
+        # the zone's session_expiry_interval caps what clients may ask
+        zone_expiry = (
+            _secs("session_expiry_interval", float("inf"))
+            if "session_expiry_interval" in mc
+            else float("inf")
+        )
         if self.proto_ver == MQTT_V5:
-            cfg.session_expiry_interval = pkt.props.get("session_expiry_interval", 0)
-            cfg.receive_maximum = pkt.props.get("receive_maximum", cfg.receive_maximum)
+            asked = pkt.props.get("session_expiry_interval", 0)
+            cfg.session_expiry_interval = min(float(asked), zone_expiry)
+            # the zone inflight cap bounds the client's receive_maximum
+            # ask — a 65535 request must not defeat the operator limit
+            cfg.receive_maximum = min(
+                pkt.props.get("receive_maximum", cfg.receive_maximum),
+                cfg.receive_maximum,
+            )
             self.client_max_packet = pkt.props.get("maximum_packet_size")
         else:
-            # v3: clean_start=False means the session persists "forever"
-            cfg.session_expiry_interval = 0 if pkt.clean_start else float("inf")
+            # v3: clean_start=False persists up to the zone cap
+            cfg.session_expiry_interval = 0 if pkt.clean_start else zone_expiry
         session, present = self.broker.open_session(
             client_id, pkt.clean_start, cfg
         )
@@ -184,6 +227,11 @@ class Channel:
         self.client_id = client_id
         self.username = pkt.username
         self.keepalive = pkt.keepalive
+        # v5 server keepalive OVERRIDES the client's ask (advertised in
+        # CONNACK, emqx zone mqtt.server_keepalive)
+        server_ka = mc.get("server_keepalive")
+        if server_ka is not None and self.proto_ver == MQTT_V5:
+            self.keepalive = int(server_ka)
         self.will = pkt.will
         self.connected = True
         self.broker.metrics.inc("client.connected")
@@ -197,6 +245,8 @@ class Channel:
             if self.proto_ver == MQTT_V5
             else {}
         )
+        if server_ka is not None and self.proto_ver == MQTT_V5:
+            props["server_keep_alive"] = int(server_ka)
         out: List[object] = [Connack(present, 0, props=props)]
         if present:
             out.extend(session.on_reconnect())
@@ -403,7 +453,7 @@ class Channel:
         if not self.keepalive:
             return False
         now = now if now is not None else time.time()
-        return now - self.last_rx > self.keepalive * 1.5
+        return now - self.last_rx > self.keepalive * self.keepalive_multiplier
 
     def on_close(self) -> None:
         """Socket gone: publish the will unless cleanly disconnected,

@@ -59,11 +59,36 @@ def make_ssl_context(conf: Dict) -> ssl_mod.SSLContext:
     return ctx
 
 
+MQTT_ZONE_KEYS = (
+    "max_mqueue_len", "max_inflight", "max_awaiting_rel",
+    "await_rel_timeout", "retry_interval", "upgrade_qos",
+    "mqueue_priorities", "mqueue_default_priority", "mqueue_store_qos0",
+    "server_keepalive", "keepalive_multiplier", "session_expiry_interval",
+)
+
+
+def zone_mqtt_conf(config, zone: str) -> Dict:
+    """Resolve the zone-overlaid `mqtt` section into a flat dict the
+    Channel consumes (emqx_config:get_zone_conf analog)."""
+    if config is None:
+        return {}
+    out = {}
+    for key in MQTT_ZONE_KEYS:
+        try:
+            v = config.get_zone(zone, key, None)
+        except Exception:
+            v = None
+        if v is not None:
+            out[key] = v
+    return out
+
+
 class Listeners:
     """Named-listener registry over a shared Broker."""
 
-    def __init__(self, broker: Broker):
+    def __init__(self, broker: Broker, config=None):
         self.broker = broker
+        self.config = config  # typed Config for zone-aware session conf
         self._live: Dict[Tuple[str, str], Server] = {}
         self._conf: Dict[Tuple[str, str], Dict] = {}
 
@@ -87,6 +112,7 @@ class Listeners:
             ws_path=conf.get("path", "/mqtt"),
             name=f"{ltype}:{name}",
             mountpoint=conf.get("mountpoint", ""),
+            mqtt_conf=zone_mqtt_conf(self.config, conf.get("zone", "default")),
             **(
                 {"max_packet_size": conf["max_packet_size"]}
                 if conf.get("max_packet_size")

@@ -70,6 +70,7 @@ class Connection:
             peer=str(peer),
             mountpoint=server.mountpoint,
             max_packet_size=server.max_packet_size,
+            mqtt_conf=server.mqtt_conf,
         )
         self.parser = frame.Parser(max_packet_size=server.max_packet_size)
         # per-connection limiter chains (client tier -> listener tier ->
@@ -139,7 +140,10 @@ class Connection:
             while True:
                 timeout = None
                 if self.channel.keepalive:
-                    timeout = self.channel.keepalive * 1.5
+                    timeout = (
+                        self.channel.keepalive
+                        * self.channel.keepalive_multiplier
+                    )
                 elif not self.channel.connected:
                     timeout = self.server.connect_timeout
                 try:
@@ -244,6 +248,7 @@ class Server:
         ws_path: str = "/mqtt",
         name: Optional[str] = None,
         mountpoint: str = "",
+        mqtt_conf: Optional[dict] = None,
     ):
         self.broker = broker or Broker()
         self.host = host
@@ -261,6 +266,7 @@ class Server:
         self.proto = proto
         self.name = name or f"{proto}:default"
         self.mountpoint = mountpoint
+        self.mqtt_conf = mqtt_conf or {}
         self._server: Optional[asyncio.AbstractServer] = None
         self._conns: set = set()
         self._pending: set = set()  # transports still in ws handshake

@@ -27,6 +27,12 @@ class SessionConfig:
     retry_interval: float = 30.0
     session_expiry_interval: float = 0.0  # 0 = ends with connection
     upgrade_qos: bool = False
+    # mqueue priorities (emqx_mqueue.erl): exact topic -> 1..255,
+    # higher drains first; store_qos0=False drops queued QoS0 while
+    # the client is disconnected
+    mqueue_priorities: Dict[str, int] = field(default_factory=dict)
+    mqueue_default_priority: int = 0
+    mqueue_store_qos0: bool = True
 
 
 @dataclass
@@ -45,7 +51,8 @@ class Session:
         self.cfg = cfg or SessionConfig()
         self.created_at = time.time()
         self.subscriptions: Dict[str, SubOpts] = {}  # full filter (incl $share)
-        self.mqueue: Deque[Tuple[Message, SubOpts]] = deque()
+        # (priority, msg, subopts); highest priority at the head
+        self.mqueue: Deque[Tuple[int, Message, SubOpts]] = deque()
         self.inflight: "OrderedDict[int, _InflightEntry]" = OrderedDict()
         self.awaiting_rel: Dict[int, float] = {}  # incoming QoS2 pids
         self._next_pid = 1
@@ -96,18 +103,43 @@ class Session:
         )
         return [self._to_publish(eff, pid)]
 
+    def _queue_priority(self, msg: Message) -> int:
+        return self.cfg.mqueue_priorities.get(
+            msg.topic, self.cfg.mqueue_default_priority
+        )
+
     def _enqueue(self, msg: Message, subopts: SubOpts) -> None:
+        if (
+            msg.qos == 0
+            and not self.connected
+            and not self.cfg.mqueue_store_qos0
+        ):
+            # emqx_mqueue store_qos0=false: QoS0 is not worth holding
+            # for an absent client
+            self.dropped += 1
+            return
         if len(self.mqueue) >= self.cfg.max_mqueue_len:
-            # emqx_mqueue default: drop the oldest QoS0, else drop new
-            for i, (m, _o) in enumerate(self.mqueue):
-                if m.qos == 0:
+            # emqx_mqueue: shed a QoS0 from the LOWEST priority class
+            # (the tail of the priority-sorted queue) — never the
+            # high-priority head the feature exists to protect
+            for i in range(len(self.mqueue) - 1, -1, -1):
+                if self.mqueue[i][1].qos == 0:
                     del self.mqueue[i]
                     self.dropped += 1
                     break
             else:
                 self.dropped += 1
                 return
-        self.mqueue.append((msg, subopts))
+        prio = self._queue_priority(msg)
+        if not self.cfg.mqueue_priorities or not self.mqueue:
+            self.mqueue.append((prio, msg, subopts))
+            return
+        # priority queue (emqx_pqueue analog): keep the deque sorted by
+        # non-increasing priority, FIFO within a priority class
+        i = len(self.mqueue)
+        while i > 0 and self.mqueue[i - 1][0] < prio:
+            i -= 1
+        self.mqueue.insert(i, (prio, msg, subopts))
 
     def _to_publish(self, msg: Message, pid: Optional[int]) -> Publish:
         props = dict(msg.props)
@@ -125,7 +157,7 @@ class Session:
         free slots, or on reconnect)."""
         out: List[Publish] = []
         while self.mqueue:
-            msg, subopts = self.mqueue[0]
+            _prio, msg, subopts = self.mqueue[0]
             if msg.expired():
                 self.mqueue.popleft()
                 self.dropped += 1

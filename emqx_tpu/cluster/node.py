@@ -725,7 +725,9 @@ class ClusterNode:
             )
             for flt, o in session.subscriptions.items()
         ]
-        pending = [msg_to_wire(m) for (m, _o) in getattr(session, "mqueue", ())]
+        pending = [
+            msg_to_wire(m) for (_p, m, _o) in getattr(session, "mqueue", ())
+        ]
         self.broker.close_session(session, discard=True)
         return {"subs": subs, "pending": pending}
 

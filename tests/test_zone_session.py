@@ -1,0 +1,141 @@
+"""Zone-config-driven sessions: mqueue priorities/store_qos0, session
+windows from the zone mqtt section, server keepalive override.
+
+Refs: apps/emqx/src/emqx_mqueue.erl (priorities, store_qos0),
+emqx_zone_schema / emqx_config:get_zone_conf, v5 Server Keep Alive.
+"""
+
+import asyncio
+import json
+
+from emqx_tpu.broker.channel import Channel
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.packet import MQTT_V5, Connack, Connect, SubOpts
+from emqx_tpu.broker.pubsub import Broker
+from emqx_tpu.broker.session import Session, SessionConfig
+
+
+def test_mqueue_priorities_drain_order():
+    cfg = SessionConfig(
+        mqueue_priorities={"alerts/fire": 10, "logs/debug": 1},
+        mqueue_default_priority=5,
+    )
+    s = Session("c1", cfg)
+    s.connected = False
+    for topic in ("logs/debug", "normal/x", "alerts/fire", "normal/y",
+                  "alerts/fire"):
+        s.deliver(Message(topic=topic, payload=b"m", qos=1), SubOpts(qos=1))
+    s.connected = True
+    out = s.drain()
+    assert [p.topic for p in out] == [
+        "alerts/fire", "alerts/fire",  # priority 10 first, FIFO within
+        "normal/x", "normal/y",        # default 5
+        "logs/debug",                  # lowest
+    ]
+
+
+def test_mqueue_store_qos0_false_drops_offline_qos0():
+    cfg = SessionConfig(mqueue_store_qos0=False)
+    s = Session("c1", cfg)
+    s.connected = False
+    s.deliver(Message(topic="t", payload=b"q0", qos=0), SubOpts(qos=0))
+    s.deliver(Message(topic="t", payload=b"q1", qos=1), SubOpts(qos=1))
+    assert len(s.mqueue) == 1 and s.dropped == 1
+    s.connected = True
+    assert [p.payload for p in s.drain()] == [b"q1"]
+
+
+def test_channel_session_config_from_zone():
+    b = Broker()
+    ch = Channel(b, mqtt_conf={
+        "max_mqueue_len": 5,
+        "max_inflight": 3,
+        "retry_interval": 7000,  # ms in config
+        "upgrade_qos": True,
+        "mqueue_priorities": {"a/b": 9},
+        "server_keepalive": 25,
+        "keepalive_multiplier": 2.0,
+    })
+    out = ch.handle_packet(Connect(client_id="c", proto_ver=MQTT_V5,
+                                   keepalive=60))
+    cfg = ch.session.cfg
+    assert cfg.max_mqueue_len == 5
+    assert cfg.receive_maximum == 3
+    assert cfg.retry_interval == 7.0
+    assert cfg.upgrade_qos is True
+    assert cfg.mqueue_priorities == {"a/b": 9}
+    # server keepalive overrides the client's 60 and is advertised
+    assert ch.keepalive == 25
+    ack = [p for p in out if isinstance(p, Connack)][0]
+    assert ack.props["server_keep_alive"] == 25
+    assert ch.keepalive_multiplier == 2.0
+    assert not ch.keepalive_expired()  # fresh
+
+
+def test_zone_overlay_resolution(tmp_path):
+    from emqx_tpu.broker.listeners import zone_mqtt_conf
+    from emqx_tpu.config.config import Config
+    from emqx_tpu.config.default_schema import broker_schema
+
+    cfg = Config.load(broker_schema(), text=json.dumps({
+        "mqtt": {"max_inflight": 64},
+        "zones": {"iot": {"max_inflight": 4, "mqueue_store_qos0": False}},
+    }))
+    default = zone_mqtt_conf(cfg, "default")
+    iot = zone_mqtt_conf(cfg, "iot")
+    assert default["max_inflight"] == 64
+    assert iot["max_inflight"] == 4  # zone overlay wins
+    assert iot["mqueue_store_qos0"] is False
+    assert default.get("mqueue_store_qos0", True) is True
+
+
+def test_overflow_sheds_lowest_priority_qos0():
+    cfg = SessionConfig(max_mqueue_len=3,
+                        mqueue_priorities={"alerts/x": 10})
+    s = Session("c1", cfg)
+    s.connected = False
+    s.deliver(Message(topic="alerts/x", payload=b"a1", qos=0), SubOpts())
+    s.deliver(Message(topic="low/1", payload=b"l1", qos=0), SubOpts())
+    s.deliver(Message(topic="low/2", payload=b"l2", qos=0), SubOpts())
+    s.deliver(Message(topic="alerts/x", payload=b"a2", qos=0), SubOpts())
+    # the LOW-priority tail was shed, not the alert at the head
+    topics = [m.topic for _p, m, _o in s.mqueue]
+    assert topics.count("alerts/x") == 2 and len(topics) == 3
+
+
+def test_v5_receive_maximum_capped_by_zone():
+    b = Broker()
+    ch = Channel(b, mqtt_conf={"max_inflight": 4})
+    ch.handle_packet(Connect(client_id="c", proto_ver=MQTT_V5,
+                             props={"receive_maximum": 60000}))
+    assert ch.session.cfg.receive_maximum == 4
+    # a smaller client ask is honored
+    ch2 = Channel(b, mqtt_conf={"max_inflight": 4})
+    ch2.handle_packet(Connect(client_id="c2", proto_ver=MQTT_V5,
+                              props={"receive_maximum": 2}))
+    assert ch2.session.cfg.receive_maximum == 2
+
+
+def test_session_expiry_capped_by_zone():
+    b = Broker()
+    ch = Channel(b, mqtt_conf={"session_expiry_interval": 3_600_000})
+    ch.handle_packet(Connect(client_id="c", proto_ver=MQTT_V5,
+                             props={"session_expiry_interval": 999999}))
+    assert ch.session.cfg.session_expiry_interval == 3600.0
+    # v3 persistent session uses the zone cap, not infinity
+    ch2 = Channel(b, mqtt_conf={"session_expiry_interval": 3_600_000})
+    ch2.handle_packet(Connect(client_id="c3", proto_ver=4, clean_start=False))
+    assert ch2.session.cfg.session_expiry_interval == 3600.0
+
+
+def test_default_priority_enum_strings():
+    b = Broker()
+    ch = Channel(b, mqtt_conf={"mqueue_priorities": {"a": 7},
+                               "mqueue_default_priority": "highest"})
+    ch.handle_packet(Connect(client_id="c", proto_ver=4))
+    assert ch.session.cfg.mqueue_default_priority == 255
+    # queueing with the enum default must not crash the insert
+    ch.session.connected = False
+    ch.session.deliver(Message(topic="zz", payload=b"x", qos=1), SubOpts(qos=1))
+    ch.session.deliver(Message(topic="a", payload=b"y", qos=1), SubOpts(qos=1))
+    assert len(ch.session.mqueue) == 2
