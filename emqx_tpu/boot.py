@@ -254,7 +254,17 @@ class Node:
                 await link.start()
                 self.links.append(link)
 
-        # 11. management API
+        # 11. plugins (restarts previously enabled ones) — before the
+        # API so the REST surface can manage them
+        from .plugins import PluginManager
+
+        self.plugins = PluginManager(
+            broker,
+            install_dir=cfg.get("plugins.install_dir")
+            or os.path.join(data_dir, "plugins"),
+        )
+
+        # 12. management API
         if cfg.get("api.enable"):
             from .broker.listeners import parse_bind
             from .mgmt.api import ManagementApi
@@ -271,17 +281,24 @@ class Node:
                 ft=self.ft,
                 gateways=self.gateways,
                 listeners=self.listeners,
+                plugins=self.plugins,
             )
             host, port = parse_bind(cfg.get("api.bind"))
             await self.mgmt.start(host, port)
 
-        # 12. plugins (restarts previously enabled ones)
-        from .plugins import PluginManager
+        # 13. ctl command surface (emqx ctl analog)
+        from .mgmt.cli import Ctl
 
-        self.plugins = PluginManager(
+        self.ctl = Ctl(
             broker,
-            install_dir=cfg.get("plugins.install_dir")
-            or os.path.join(data_dir, "plugins"),
+            config=cfg,
+            rules=self.rules,
+            banned=self.auth.banned,
+            node=self.cluster_node,
+            node_name=node_name,
+            plugins=self.plugins,
+            gateways=self.gateways,
+            listeners=self.listeners,
         )
         log.info("node %s started", node_name)
 

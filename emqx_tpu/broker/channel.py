@@ -201,14 +201,12 @@ class Channel:
             mqueue_store_qos0=mc.get("mqueue_store_qos0", True),
         )
         # the zone's session_expiry_interval caps what clients may ask
-        zone_expiry = (
-            _secs("session_expiry_interval", float("inf"))
-            if "session_expiry_interval" in mc
-            else float("inf")
-        )
+        zone_expiry = _secs("session_expiry_interval", float("inf"))
+        expiry_adjusted = False
         if self.proto_ver == MQTT_V5:
             asked = pkt.props.get("session_expiry_interval", 0)
             cfg.session_expiry_interval = min(float(asked), zone_expiry)
+            expiry_adjusted = cfg.session_expiry_interval != float(asked)
             # the zone inflight cap bounds the client's receive_maximum
             # ask — a 65535 request must not defeat the operator limit
             cfg.receive_maximum = min(
@@ -247,6 +245,10 @@ class Channel:
         )
         if server_ka is not None and self.proto_ver == MQTT_V5:
             props["server_keep_alive"] = int(server_ka)
+        if expiry_adjusted:
+            # MQTT-5 §3.2.2.3.2: a server using a DIFFERENT expiry than
+            # the client asked must say so in CONNACK
+            props["session_expiry_interval"] = int(cfg.session_expiry_interval)
         out: List[object] = [Connack(present, 0, props=props)]
         if present:
             out.extend(session.on_reconnect())

@@ -118,19 +118,26 @@ class Session:
             # for an absent client
             self.dropped += 1
             return
+        prio = self._queue_priority(msg)
         if len(self.mqueue) >= self.cfg.max_mqueue_len:
-            # emqx_mqueue: shed a QoS0 from the LOWEST priority class
-            # (the tail of the priority-sorted queue) — never the
-            # high-priority head the feature exists to protect
+            # emqx_mqueue overflow, priority-aware: shed from the
+            # LOWEST priority class, never to admit something lower.
+            # 1) prefer a QoS0 victim of <= incoming priority (tail =
+            #    lowest first); 2) else any strictly-lower-priority
+            #    tail entry; 3) else the INCOMING message is the
+            #    lowest-value item — drop it.
+            victim = None
             for i in range(len(self.mqueue) - 1, -1, -1):
-                if self.mqueue[i][1].qos == 0:
-                    del self.mqueue[i]
-                    self.dropped += 1
+                if self.mqueue[i][1].qos == 0 and self.mqueue[i][0] <= prio:
+                    victim = i
                     break
-            else:
+            if victim is None and self.mqueue and self.mqueue[-1][0] < prio:
+                victim = len(self.mqueue) - 1
+            if victim is None:
                 self.dropped += 1
                 return
-        prio = self._queue_priority(msg)
+            del self.mqueue[victim]
+            self.dropped += 1
         if not self.cfg.mqueue_priorities or not self.mqueue:
             self.mqueue.append((prio, msg, subopts))
             return

@@ -163,6 +163,7 @@ class ManagementApi:
         ft=None,  # FileTransfer (exports listing)
         gateways=None,  # GatewayRegistry
         listeners=None,  # broker.listeners.Listeners manager
+        plugins=None,  # PluginManager
     ):
         from .audit import AuditLog
 
@@ -175,6 +176,7 @@ class ManagementApi:
         self.ft = ft
         self.gateways = gateways
         self.listeners = listeners
+        self.plugins = plugins
         self.evacuation = None  # NodeEvacuation, created on demand
         self.node_name = node_name
         self.backup_dir = backup_dir
@@ -302,6 +304,11 @@ class ManagementApi:
         r("POST", "/api/v5/listeners/{id}/stop", self._listener_stop)
         r("POST", "/api/v5/listeners/{id}/start", self._listener_start)
         r("GET", "/api/v5/cluster", self._cluster_view)
+        r("GET", "/api/v5/plugins", self._plugins_list)
+        r("POST", "/api/v5/plugins/install", self._plugin_install)
+        r("PUT", "/api/v5/plugins/{name}/start", self._plugin_start)
+        r("PUT", "/api/v5/plugins/{name}/stop", self._plugin_stop)
+        r("DELETE", "/api/v5/plugins/{name}", self._plugin_delete)
         r("POST", "/api/v5/load_rebalance/evacuation/start", self._evac_start)
         r("POST", "/api/v5/load_rebalance/evacuation/stop", self._evac_stop)
         r("GET", "/api/v5/load_rebalance/status", self._evac_status)
@@ -418,6 +425,51 @@ class ManagementApi:
                 for n, a in self.node.membership.members.items()
             },
         }
+
+    def _plugins_list(self, req: Request):
+        return self.plugins.list() if self.plugins is not None else []
+
+    def _plugin_install(self, req: Request):
+        from ..plugins import PluginError
+
+        if self.plugins is None:
+            return Response.error(404, "NOT_FOUND", "plugins not enabled")
+        pkg = (req.json() or {}).get("package")
+        if not pkg:
+            raise ValueError("package path required")
+        try:
+            name = self.plugins.install(pkg)
+        except PluginError as e:
+            return Response.error(400, "BAD_REQUEST", str(e))
+        return {"name": name}
+
+    def _plugin_start(self, req: Request):
+        from ..plugins import PluginError
+
+        if self.plugins is None:
+            return Response.error(404, "NOT_FOUND", "plugins not enabled")
+        try:
+            self.plugins.start(req.params["name"])
+        except PluginError as e:
+            return Response.error(400, "BAD_REQUEST", str(e))
+        return (204, None)
+
+    def _plugin_stop(self, req: Request):
+        if self.plugins is None:
+            return Response.error(404, "NOT_FOUND", "plugins not enabled")
+        name = req.params["name"]
+        if not any(p["name"] == name for p in self.plugins.list()):
+            return Response.error(404, "NOT_FOUND", name)
+        self.plugins.stop(name)
+        return (204, None)
+
+    def _plugin_delete(self, req: Request):
+        if self.plugins is None:
+            return Response.error(404, "NOT_FOUND", "plugins not enabled")
+        ok = self.plugins.uninstall(req.params["name"])
+        return (204, None) if ok else Response.error(
+            404, "NOT_FOUND", req.params["name"]
+        )
 
     def _ft_files(self, req: Request):
         if self.ft is None:

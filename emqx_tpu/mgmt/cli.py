@@ -26,6 +26,9 @@ class Ctl:
         banned=None,
         node=None,
         node_name: str = "emqx@127.0.0.1",
+        plugins=None,
+        gateways=None,
+        listeners=None,
     ):
         self.broker = broker
         self.config = config
@@ -33,6 +36,9 @@ class Ctl:
         self.banned = banned
         self.node = node
         self.node_name = node_name
+        self.plugins = plugins
+        self.gateways = gateways
+        self.listeners = listeners
         self.started_at = time.time()
         self._cmds: Dict[str, Tuple[Callable, str]] = {}
         self._register_builtin()
@@ -93,6 +99,12 @@ class Ctl:
             self._banned,
             "banned list | add <as> <who> [seconds] | del <as> <who>",
         )
+        reg(
+            "plugins",
+            self._plugins,
+            "plugins list | start <name> | stop <name>",
+        )
+        reg("gateways", self._gateways, "gateways list")
         reg("listeners", self._listeners, "listeners               # active listeners")
 
     def _status(self, args) -> str:
@@ -266,8 +278,44 @@ class Ctl:
             return "ok" if ok else "not found"
         raise ValueError(f"bad subcommand {sub!r}")
 
+    def _plugins(self, args) -> str:
+        if self.plugins is None:
+            return "(plugins not enabled)"
+        sub = args[0] if args else "list"
+        if sub == "list":
+            rows = self.plugins.list()
+            if not rows:
+                return "(no plugins installed)"
+            return "\n".join(
+                f"{p['name']}-{p['version']}  {p['status']}  {p['description']}"
+                for p in rows
+            )
+        if sub == "start":
+            self.plugins.start(args[1])
+            return "ok"
+        if sub == "stop":
+            self.plugins.stop(args[1])
+            return "ok"
+        raise ValueError(f"bad subcommand {sub!r}")
+
+    def _gateways(self, args) -> str:
+        if self.gateways is None:
+            return "(gateways not enabled)"
+        rows = self.gateways.status()
+        if not rows:
+            return "(no gateways running; types: " + ", ".join(
+                self.gateways.types()) + ")"
+        return "\n".join(
+            f"{g['name']}  {g['status']}  conns={g['current_connections']}  "
+            + ", ".join(f"{l['type']}:{l['bind']}" for l in g["listeners"])
+            for g in rows
+        )
+
     def _listeners(self, args) -> str:
-        ls = views.listeners_view(self.broker)
+        if self.listeners is not None:
+            ls = self.listeners.info()
+        else:
+            ls = views.listeners_view(self.broker)
         if not ls:
             return "(no live listeners)"
         return "\n".join(

@@ -124,7 +124,11 @@ class PluginManager:
                 raise PluginError(f"{meta['name']}-{meta['version']} already installed")
             shutil.copytree(package, dest)
         else:
-            with tarfile.open(package) as tar:
+            try:
+                tar_cm = tarfile.open(package)
+            except (OSError, tarfile.TarError) as e:
+                raise PluginError(f"cannot open package {package!r}: {e}") from e
+            with tar_cm as tar:
                 names = tar.getnames()
                 # path-traversal guard (absolute paths / .. segments)
                 for n in names:

@@ -96,3 +96,23 @@ async def test_minimal_boot_defaults(tmp_path):
         assert node.broker.durable is None  # durable off by default
     finally:
         await node.stop()
+
+
+async def test_boot_ctl_commands(tmp_path):
+    node = Node(config_text=json.dumps({
+        "node": {"data_dir": str(tmp_path / "ctl")},
+        "listeners": {"tcp": {"default": {"bind": "127.0.0.1:0"}}},
+        "api": {"enable": False},
+        "gateway": {"stomp": {"bind": "127.0.0.1:0"}},
+    }))
+    await node.start()
+    try:
+        out = node.ctl.run(["gateways", "list"])
+        assert "stomp" in out and "running" in out
+        out2 = node.ctl.run(["listeners"])
+        assert "tcp:default" in out2
+        out3 = node.ctl.run(["plugins", "list"])
+        assert "no plugins installed" in out3
+        assert "status" in node.ctl.run(["help"])
+    finally:
+        await node.stop()

@@ -216,3 +216,53 @@ def test_exhook_connect_refused():
     bridge = ExHookBridge(b, ("127.0.0.1", 1), timeout=1.0)
     with pytest.raises(ConnectionError):
         bridge.start()
+
+
+async def test_plugins_rest_lifecycle(tmp_path):
+    import urllib.request
+
+    from emqx_tpu.mgmt.api import ManagementApi
+
+    b = Broker()
+    mgr = PluginManager(b, install_dir=str(tmp_path / "plugins"))
+    api = ManagementApi(b, plugins=mgr)
+    host, port = await api.start()
+    loop = asyncio.get_running_loop()
+
+    def call(method, path, body=None, tok=None):
+        req = urllib.request.Request(
+            f"http://{host}:{port}{path}", method=method,
+            data=json.dumps(body).encode() if body is not None else None,
+            headers={"content-type": "application/json",
+                     **({"authorization": f"Bearer {tok}"} if tok else {})})
+        resp = urllib.request.urlopen(req)
+        raw = resp.read()
+        return json.loads(raw) if raw else {}
+
+    tok = (await loop.run_in_executor(None, lambda: call(
+        "POST", "/api/v5/login",
+        {"username": "admin", "password": "public"})))["token"]
+    pkg = make_package(tmp_path)
+    out = await loop.run_in_executor(None, lambda: call(
+        "POST", "/api/v5/plugins/install", {"package": pkg}, tok=tok))
+    assert out["name"] == "tagger"
+    await loop.run_in_executor(None, lambda: call(
+        "PUT", "/api/v5/plugins/tagger/start", {}, tok=tok))
+    rows = await loop.run_in_executor(None, lambda: call(
+        "GET", "/api/v5/plugins", tok=tok))
+    assert rows[0]["status"] == "running"
+    await loop.run_in_executor(None, lambda: call(
+        "PUT", "/api/v5/plugins/tagger/stop", {}, tok=tok))
+    await loop.run_in_executor(None, lambda: call(
+        "DELETE", "/api/v5/plugins/tagger", tok=tok))
+    assert mgr.list() == []
+    # bad install -> 400
+    import urllib.error
+
+    try:
+        await loop.run_in_executor(None, lambda: call(
+            "POST", "/api/v5/plugins/install", {"package": "/nope"}, tok=tok))
+        assert False, "expected 400"
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+    await api.stop()

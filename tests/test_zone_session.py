@@ -139,3 +139,38 @@ def test_default_priority_enum_strings():
     ch.session.deliver(Message(topic="zz", payload=b"x", qos=1), SubOpts(qos=1))
     ch.session.deliver(Message(topic="a", payload=b"y", qos=1), SubOpts(qos=1))
     assert len(ch.session.mqueue) == 2
+
+
+def test_overflow_priority_aware():
+    cfg = SessionConfig(max_mqueue_len=3,
+                        mqueue_priorities={"hi": 10, "lo": 1})
+    # full of high-priority QoS0: a LOW-priority arrival drops ITSELF
+    s = Session("c1", cfg)
+    s.connected = False
+    for _ in range(3):
+        s.deliver(Message(topic="hi", payload=b"h", qos=0), SubOpts())
+    s.deliver(Message(topic="lo", payload=b"l", qos=0), SubOpts())
+    assert [m.topic for _p, m, _o in s.mqueue] == ["hi", "hi", "hi"]
+    # full of low-priority QoS1: a HIGH-priority QoS1 evicts the tail
+    s2 = Session("c2", cfg)
+    s2.connected = False
+    for _ in range(3):
+        s2.deliver(Message(topic="lo", payload=b"l", qos=1), SubOpts(qos=1))
+    s2.deliver(Message(topic="hi", payload=b"h", qos=1), SubOpts(qos=1))
+    topics = [m.topic for _p, m, _o in s2.mqueue]
+    assert topics[0] == "hi" and topics.count("lo") == 2
+
+
+def test_v5_capped_expiry_advertised():
+    b = Broker()
+    ch = Channel(b, mqtt_conf={"session_expiry_interval": 3_600_000})
+    out = ch.handle_packet(Connect(client_id="c", proto_ver=MQTT_V5,
+                                   props={"session_expiry_interval": 999999}))
+    ack = [p for p in out if isinstance(p, Connack)][0]
+    assert ack.props["session_expiry_interval"] == 3600
+    # an honored ask is NOT echoed
+    ch2 = Channel(b, mqtt_conf={"session_expiry_interval": 3_600_000})
+    out2 = ch2.handle_packet(Connect(client_id="c2", proto_ver=MQTT_V5,
+                                     props={"session_expiry_interval": 60}))
+    ack2 = [p for p in out2 if isinstance(p, Connack)][0]
+    assert "session_expiry_interval" not in ack2.props
