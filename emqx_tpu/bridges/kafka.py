@@ -30,6 +30,8 @@ from .resource import Connector, QueryError, RecoverableError, ResourceStatus
 log = logging.getLogger("emqx_tpu.bridges.kafka")
 
 API_PRODUCE = 0
+API_FETCH = 1
+API_OFFSETS = 2
 API_METADATA = 3
 
 # error codes (kafka protocol)
@@ -314,3 +316,204 @@ class KafkaProducer(Connector):
                             f"partition {rpid} retriable error {err}"
                         )
                     raise QueryError(f"partition {rpid} error {err}")
+
+
+def _parse_message_set(mset: bytes):
+    """Yield (offset, key, value, attrs) from a v0 message set; a
+    truncated trailing message (normal in Fetch responses) is ignored."""
+    off = 0
+    while off + 12 <= len(mset):
+        (msg_offset, size) = struct.unpack_from(">qi", mset, off)
+        off += 12
+        if off + size > len(mset):
+            break  # partial trailing message
+        body = mset[off : off + size]
+        off += size
+        r = _Reader(body)
+        _crc = r.i32()
+        _magic = r.data[r.off]
+        attrs = r.data[r.off + 1]
+        r.off += 2  # magic + attributes
+        klen = r.i32()
+        key = r.data[r.off : r.off + klen] if klen >= 0 else None
+        r.off += max(klen, 0)
+        vlen = r.i32()
+        value = bytes(r.data[r.off : r.off + vlen]) if vlen >= 0 else b""
+        yield (
+            msg_offset,
+            (bytes(key) if key is not None else None),
+            value,
+            attrs,
+        )
+
+
+class _IngressRecord:
+    """Publish-shaped record handed to the bridge ingress callback."""
+
+    def __init__(self, topic: str, payload: bytes, key, partition: int,
+                 offset: int):
+        self.topic = topic
+        self.payload = payload
+        self.qos = 0
+        self.retain = False
+        self.key = key
+        self.partition = partition
+        self.offset = offset
+
+
+class KafkaConsumer(KafkaProducer):
+    """Kafka SOURCE: long-polls Fetch v0 per partition from the latest
+    (or earliest) offset and feeds records into the bridge ingress
+    (emqx_bridge_kafka consumer without group coordination — one
+    bridge owns all partitions, the reference's single-member default)."""
+
+    def __init__(
+        self,
+        bootstrap: str,
+        topic: str,
+        client_id: str = "emqx-tpu-consumer",
+        timeout: float = 10.0,
+        start_from: str = "latest",  # or "earliest"
+        max_wait_ms: int = 500,
+        max_bytes: int = 1 << 20,
+    ):
+        super().__init__(bootstrap, topic, client_id=client_id, timeout=timeout)
+        assert start_from in ("latest", "earliest")
+        self.start_from = start_from
+        self.max_wait_ms = max_wait_ms
+        self.max_bytes = max_bytes
+        self.on_ingress = None  # set by the bridge registry
+        self.offsets: Dict[int, int] = {}
+        self._poll_task = None
+        self.consumed = 0
+
+    async def _fetch_offset(self, pid: int) -> int:
+        addr = self.partitions[pid]
+        time_v = -1 if self.start_from == "latest" else -2
+        payload = (
+            struct.pack(">i", -1)
+            + struct.pack(">i", 1) + _str(self.topic)
+            + struct.pack(">i", 1)
+            + struct.pack(">iqi", pid, time_v, 1)
+        )
+        async with self._lock:
+            r = await self._call(addr, API_OFFSETS, 0, payload)
+        for _ in range(r.i32()):
+            r.string()
+            for _ in range(r.i32()):
+                rpid = r.i32()
+                err = r.i16()
+                n = r.i32()
+                offs = [r.i64() for _ in range(n)]
+                if rpid == pid and err == ERR_NONE and offs:
+                    return offs[0]
+        raise RecoverableError(f"no offset for partition {pid}")
+
+    async def on_start(self) -> None:
+        await self.refresh_metadata()
+        for pid in list(self.partitions):
+            # a health-loop restart must RESUME, not jump to latest —
+            # records produced during the blip would silently vanish
+            if pid not in self.offsets:
+                self.offsets[pid] = await self._fetch_offset(pid)
+        self._poll_task = asyncio.ensure_future(self._poll_loop())
+
+    async def on_stop(self) -> None:
+        if self._poll_task is not None:
+            self._poll_task.cancel()
+            self._poll_task = None
+        await super().on_stop()
+
+    async def _poll_loop(self) -> None:
+        while True:
+            try:
+                idle = await self._poll_once()
+                if idle:
+                    await asyncio.sleep(self.max_wait_ms / 1000.0)
+            except asyncio.CancelledError:
+                return
+            except Exception as e:  # noqa: BLE001
+                log.warning("kafka consumer poll failed: %s", e)
+                self.partitions = {}
+                await asyncio.sleep(1.0)
+                try:
+                    await self.refresh_metadata()
+                    for pid in list(self.partitions):
+                        if pid not in self.offsets:
+                            self.offsets[pid] = await self._fetch_offset(pid)
+                except Exception:
+                    pass
+
+    async def _ensure_offset(self, pid: int) -> int:
+        # a partition discovered AFTER startup initializes per
+        # start_from — never from 0 (full-history replay)
+        if pid not in self.offsets:
+            self.offsets[pid] = await self._fetch_offset(pid)
+        return self.offsets[pid]
+
+    async def _poll_once(self) -> bool:
+        got_any = False
+        # one Fetch per LEADER, all its partitions batched (Fetch v0
+        # arrays) — serial per-partition long-polls would make idle
+        # latency scale as partitions x max_wait
+        by_addr: Dict[Tuple[str, int], List[int]] = {}
+        for pid, addr in list(self.partitions.items()):
+            by_addr.setdefault(addr, []).append(pid)
+        for addr, pids in by_addr.items():
+            parts = b""
+            for pid in pids:
+                parts += struct.pack(
+                    ">iqi", pid, await self._ensure_offset(pid), self.max_bytes
+                )
+            payload = (
+                struct.pack(">iii", -1, self.max_wait_ms, 1)
+                + struct.pack(">i", 1) + _str(self.topic)
+                + struct.pack(">i", len(pids)) + parts
+            )
+            # under the connector lock: the health loop's metadata call
+            # shares this connection, and interleaved frames desync it
+            try:
+                async with self._lock:
+                    r = await self._call(addr, API_FETCH, 0, payload)
+            except (ConnectionError, OSError, asyncio.TimeoutError,
+                    asyncio.IncompleteReadError) as e:
+                # a half-read frame loses the framing: the connection
+                # is poison — drop it like the producer path does
+                self._drop_conn(addr)
+                raise RecoverableError(f"fetch transport: {e}") from e
+            for _ in range(r.i32()):
+                r.string()
+                for _ in range(r.i32()):
+                    rpid = r.i32()
+                    err = r.i16()
+                    _hw = r.i64()
+                    mlen = r.i32()
+                    mset = r.data[r.off : r.off + mlen]
+                    r.off += mlen
+                    if err == 1:  # OFFSET_OUT_OF_RANGE: position aged
+                        # out of retention — reset per start_from or
+                        # the consumer stalls on the dead offset forever
+                        self.offsets.pop(rpid, None)
+                        await self._ensure_offset(rpid)
+                        continue
+                    if err != ERR_NONE:
+                        if err in RETRIABLE:
+                            raise RecoverableError(f"fetch error {err}")
+                        raise QueryError(f"fetch error {err}")
+                    for offset, key, value, attrs in _parse_message_set(mset):
+                        self.offsets[rpid] = offset + 1
+                        got_any = True
+                        if attrs & 0x7:
+                            # compressed wrapper: decoding gzip/snappy
+                            # nests is out of scope — skipping beats
+                            # publishing a compressed blob as payload
+                            log.warning(
+                                "skipping compressed kafka record "
+                                "(partition %s offset %s)", rpid, offset,
+                            )
+                            continue
+                        self.consumed += 1
+                        if self.on_ingress is not None:
+                            self.on_ingress(_IngressRecord(
+                                self.topic, value, key, rpid, offset))
+        return not got_any

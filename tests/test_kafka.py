@@ -32,6 +32,10 @@ class MiniKafka:
         self._server = None
         self.addr = None
 
+    def log_of(self, pid):
+        # fetchable log: reuse the produced list as the partition log
+        return self.produced[pid]
+
     async def start(self):
         self._server = await asyncio.start_server(self._client, "127.0.0.1", 0)
         self.addr = self._server.sockets[0].getsockname()[:2]
@@ -54,6 +58,10 @@ class MiniKafka:
                     resp = self._metadata(corr)
                 elif api == 0:
                     resp = self._produce(corr, r)
+                elif api == 2:
+                    resp = self._offsets(corr, r)
+                elif api == 1:
+                    resp = self._fetch(corr, r)
                 else:
                     break
                 writer.write(struct.pack(">i", len(resp)) + resp)
@@ -110,6 +118,53 @@ class MiniKafka:
         out = struct.pack(">i", corr)
         out += struct.pack(">i", 1) + _str(tname)
         out += struct.pack(">i", 1) + struct.pack(">ihq", pid, err, 42)
+        return out
+
+
+    def _offsets(self, corr, r):
+        r.i32()  # replica
+        n_topics = r.i32()
+        tname = r.string()
+        n_parts = r.i32()
+        out = struct.pack(">i", corr)
+        out += struct.pack(">i", 1) + _str(tname)
+        out += struct.pack(">i", n_parts)
+        for _ in range(n_parts):
+            pid = r.i32()
+            time_v = r.i64()
+            r.i32()  # max offsets
+            off = 0 if time_v == -2 else len(self.log_of(pid))
+            out += struct.pack(">ih", pid, ERR_NONE)
+            out += struct.pack(">i", 1) + struct.pack(">q", off)
+        return out
+
+    def _fetch(self, corr, r):
+        from emqx_tpu.bridges.kafka import _message_set
+
+        r.i32()  # replica
+        r.i32()  # max wait
+        r.i32()  # min bytes
+        r.i32()  # n topics
+        tname = r.string()
+        n_parts = r.i32()
+        out = struct.pack(">i", corr)
+        out += struct.pack(">i", 1) + _str(tname)
+        body_parts = b""
+        for _ in range(n_parts):
+            pid = r.i32()
+            fetch_offset = r.i64()
+            r.i32()  # max bytes
+            log = self.log_of(pid)
+            msgs = log[fetch_offset:]
+            # v0 message sets carry REAL offsets when served by a broker
+            mset = b""
+            for i, (k, v) in enumerate(msgs):
+                one = _message_set([(k, v)])
+                # patch the -1 placeholder offset with the real one
+                mset += struct.pack(">q", fetch_offset + i) + one[8:]
+            body_parts += struct.pack(">ihq", pid, ERR_NONE, len(log))
+            body_parts += struct.pack(">i", len(mset)) + mset
+        out += struct.pack(">i", n_parts) + body_parts
         return out
 
 
@@ -171,3 +226,61 @@ async def test_through_resource_buffer_retries():
 async def test_unreachable_is_disconnected():
     prod = KafkaProducer("127.0.0.1:1", "events", timeout=0.5)
     assert await prod.health_check() == ResourceStatus.DISCONNECTED
+
+
+async def test_consumer_ingress_flow():
+    from emqx_tpu.bridges.kafka import KafkaConsumer
+
+    mk = MiniKafka(topic="in-events", n_partitions=2)
+    host, port = await mk.start()
+    # pre-existing records are SKIPPED by start_from=latest
+    mk.produced[0].append((None, b"old"))
+    got = []
+    cons = KafkaConsumer(f"{host}:{port}", "in-events", max_wait_ms=50)
+    cons.on_ingress = lambda rec: got.append(rec)
+    await cons.on_start()
+    await asyncio.sleep(0.2)
+    assert got == []  # latest: the old record is not replayed
+    mk.produced[0].append((b"k1", b"fresh-1"))
+    mk.produced[1].append((None, b"fresh-2"))
+    deadline = asyncio.get_running_loop().time() + 5
+    while len(got) < 2:
+        await asyncio.sleep(0.05)
+        assert asyncio.get_running_loop().time() < deadline
+    assert sorted(r.payload for r in got) == [b"fresh-1", b"fresh-2"]
+    assert {r.topic for r in got} == {"in-events"}
+    assert cons.consumed == 2
+    await cons.on_stop()
+    await mk.stop()
+
+
+async def test_consumer_earliest_and_bridge_to_mqtt():
+    """Full source path: kafka records -> bridge ingress -> MQTT subs."""
+    from emqx_tpu.bridges.bridge import BridgeRegistry
+    from emqx_tpu.bridges.kafka import KafkaConsumer
+    from emqx_tpu.broker.message import Message
+    from emqx_tpu.broker.packet import SubOpts
+    from emqx_tpu.broker.pubsub import Broker
+
+    mk = MiniKafka(topic="telemetry", n_partitions=1)
+    host, port = await mk.start()
+    mk.produced[0].append((None, b"r1"))
+    b = Broker()
+    outs = []
+    s, _ = b.open_session("mq", True)
+    b.subscribe(s, "kafka/#", SubOpts())
+    s.outgoing_sink = outs.extend
+    reg = BridgeRegistry(b)
+    await reg.create(
+        "kafka-in",
+        KafkaConsumer(f"{host}:{port}", "telemetry", start_from="earliest",
+                      max_wait_ms=50),
+        ingress={"local_topic": "kafka/${topic}"},
+    )
+    deadline = asyncio.get_running_loop().time() + 5
+    while not outs:
+        await asyncio.sleep(0.05)
+        assert asyncio.get_running_loop().time() < deadline
+    assert outs[0].topic == "kafka/telemetry" and outs[0].payload == b"r1"
+    await reg.stop_all()
+    await mk.stop()
