@@ -427,15 +427,23 @@ class KafkaConsumer(KafkaProducer):
     async def _poll_loop(self) -> None:
         while True:
             try:
-                idle = await self._poll_once()
-                if idle:
-                    await asyncio.sleep(self.max_wait_ms / 1000.0)
+                # no client-side idle sleep: the Fetch itself is a
+                # server-side long poll (max_wait_ms); a second sleep
+                # here would double worst-case delivery latency
+                await self._poll_once()
+                await asyncio.sleep(0)  # yield between cycles
             except asyncio.CancelledError:
                 return
             except Exception as e:  # noqa: BLE001
                 log.warning("kafka consumer poll failed: %s", e)
                 self.partitions = {}
-                await asyncio.sleep(1.0)
+                # permanent errors (deleted topic, authorization) back
+                # off harder than transient ones — retrying them at
+                # 1Hz forever just spams the broker and the log
+                await asyncio.sleep(
+                    5.0 if isinstance(e, QueryError)
+                    and not isinstance(e, RecoverableError) else 1.0
+                )
                 try:
                     await self.refresh_metadata()
                     for pid in list(self.partitions):
@@ -501,7 +509,6 @@ class KafkaConsumer(KafkaProducer):
                             raise RecoverableError(f"fetch error {err}")
                         raise QueryError(f"fetch error {err}")
                     for offset, key, value, attrs in _parse_message_set(mset):
-                        self.offsets[rpid] = offset + 1
                         got_any = True
                         if attrs & 0x7:
                             # compressed wrapper: decoding gzip/snappy
@@ -511,9 +518,15 @@ class KafkaConsumer(KafkaProducer):
                                 "skipping compressed kafka record "
                                 "(partition %s offset %s)", rpid, offset,
                             )
+                            self.offsets[rpid] = offset + 1
                             continue
-                        self.consumed += 1
                         if self.on_ingress is not None:
+                            # deliver BEFORE advancing: a raising hook
+                            # must leave the offset on the failed
+                            # record so recovery redelivers it
+                            # (at-least-once)
                             self.on_ingress(_IngressRecord(
                                 self.topic, value, key, rpid, offset))
+                        self.offsets[rpid] = offset + 1
+                        self.consumed += 1
         return not got_any

@@ -1,0 +1,171 @@
+"""Whole-node integration soak: one booted Node, many subsystems
+exercised together over real sockets — the closest in-suite analog of
+the reference's cross-app common tests.
+
+Flow: config-driven boot (listeners, gateways, durable sessions,
+delayed, rewrite, retainer, REST) → MQTT + STOMP clients interoperate →
+validation gates → retained + delayed delivery → REST observability
+reflects it all → graceful stop releases every port.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from emqx_tpu.boot import Node
+from emqx_tpu.broker import frame
+from emqx_tpu.broker.packet import (
+    MQTT_V5, Connack, Connect, Publish, Suback, Subscribe, SubOpts,
+)
+from emqx_tpu.gateway.stomp import StompFrame, StompParser
+from emqx_tpu.transform import SchemaValidation
+
+
+async def mqtt(port, cid, ver=4, sub=None, expiry=0, clean=True):
+    r, w = await asyncio.open_connection("127.0.0.1", port)
+    props = {"session_expiry_interval": expiry} if ver == MQTT_V5 else {}
+    w.write(frame.serialize(
+        Connect(client_id=cid, proto_ver=ver, props=props,
+                clean_start=clean), ver))
+    p = frame.Parser(proto_ver=ver)
+    pkts = []
+    while not any(isinstance(x, Connack) for x in pkts):
+        pkts += p.feed(await asyncio.wait_for(r.read(4096), 5))
+    if sub:
+        w.write(frame.serialize(
+            Subscribe(packet_id=1, filters=[(sub, SubOpts(qos=1))]), ver))
+        while not any(isinstance(x, Suback) for x in pkts):
+            pkts += p.feed(await asyncio.wait_for(r.read(4096), 5))
+    return r, w, p, pkts
+
+
+async def expect_pub(r, p, pkts, want_payload, timeout=5.0):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while True:
+        for x in pkts:
+            if isinstance(x, Publish) and x.payload == want_payload:
+                return x
+        left = deadline - asyncio.get_running_loop().time()
+        assert left > 0, f"timed out waiting for {want_payload!r}: {pkts}"
+        pkts += p.feed(await asyncio.wait_for(r.read(4096), left))
+
+
+async def test_everything_together(tmp_path):
+    node = Node(config_text=json.dumps({
+        "node": {"name": "soak@127.0.0.1", "data_dir": str(tmp_path / "d")},
+        "listeners": {"tcp": {"default": {"bind": "127.0.0.1:0"}},
+                      "ws": {"default": {"bind": "127.0.0.1:0"}}},
+        "api": {"enable": True, "bind": "127.0.0.1:0"},
+        "gateway": {"stomp": {"bind": "127.0.0.1:0"}},
+        "delayed": {"enable": True},
+        "rewrite": [{"action": "all", "source_topic": "legacy/#",
+                     "re": "^legacy/(.+)$", "dest_topic": "modern/$1"}],
+        "retainer": {"enable": True},
+        "durable_sessions": {"enable": True},
+    }))
+    await node.start()
+    try:
+        port = node.listeners.get("tcp", "default").listen_addr[1]
+        # payload governance added live
+        v = SchemaValidation(node.broker)
+        v.put({"name": "json-only", "topics": ["modern/strict/#"],
+               "checks": [{"type": "json_schema",
+                           "schema": {"type": "object"}}]})
+        v.enable()
+
+        # 1) retained + rewrite: retained publish on legacy lands modern
+        r1, w1, p1, k1 = await mqtt(port, "setup")
+        w1.write(frame.serialize(Publish(
+            topic="legacy/cfg", payload=b"v7", retain=True)))
+        await w1.drain()
+        await asyncio.sleep(0.1)
+        r2, w2, p2, k2 = await mqtt(port, "reader", sub="modern/cfg")
+        got = await expect_pub(r2, p2, k2, b"v7")
+        assert got.retain  # retained delivery on subscribe
+
+        # 2) STOMP interop through the same broker
+        sh, sp = node.gateways.get("stomp").listen_addr
+        sr, sw = await asyncio.open_connection(sh, sp)
+        sparser = StompParser()
+        sw.write(StompFrame("CONNECT", {"accept-version": "1.2"}).encode())
+        sframes = []
+        while not any(f.command == "CONNECTED" for f in sframes):
+            sframes += sparser.feed(await asyncio.wait_for(sr.read(4096), 5))
+        sw.write(StompFrame("SEND", {"destination": "modern/chat"},
+                            b"from-stomp").encode())
+        r3, w3, p3, k3 = await mqtt(port, "chatw", sub="modern/chat")
+        # stomp SEND happened before subscribe; send another after
+        sw.write(StompFrame("SEND", {"destination": "modern/chat"},
+                            b"from-stomp-2").encode())
+        await expect_pub(r3, p3, k3, b"from-stomp-2")
+
+        # 3) validation drops bad payloads on the gated subtree
+        r4, w4, p4, k4 = await mqtt(port, "strictw", sub="modern/strict/+")
+        w1.write(frame.serialize(Publish(topic="legacy/strict/a",
+                                         payload=b"not-json")))
+        w1.write(frame.serialize(Publish(topic="legacy/strict/a",
+                                         payload=b'{"ok": 1}')))
+        await w1.drain()
+        good = await expect_pub(r4, p4, k4, b'{"ok": 1}')
+        assert all(x.payload != b"not-json"
+                   for x in k4 if isinstance(x, Publish))
+
+        # 4) delayed publish
+        w1.write(frame.serialize(Publish(topic="$delayed/1/modern/later",
+                                         payload=b"tick")))
+        await w1.drain()
+        r5, w5, p5, k5 = await mqtt(port, "laterw", sub="modern/later")
+        await expect_pub(r5, p5, k5, b"tick", timeout=5)
+
+        # 5) durable session: disconnect, publish, resume with messages
+        r6, w6, p6, k6 = await mqtt(port, "dur", ver=MQTT_V5,
+                                    sub="modern/dur/#", expiry=600)
+        w6.close()
+        await asyncio.sleep(0.2)
+        w1.write(frame.serialize(Publish(topic="legacy/dur/x",
+                                         payload=b"offline", qos=1,
+                                         packet_id=9)))
+        await w1.drain()
+        await asyncio.sleep(0.4)
+        r7, w7, p7, k7 = await mqtt(port, "dur", ver=MQTT_V5, expiry=600,
+                            clean=False)
+        ack = [x for x in k7 if isinstance(x, Connack)][0]
+        assert ack.session_present
+        await expect_pub(r7, p7, k7, b"offline")
+
+        # 6) REST sees the world
+        import urllib.request
+
+        ah, ap = node.mgmt.http.listen_addr
+        loop = asyncio.get_running_loop()
+
+        def call(path, tok=None):
+            req = urllib.request.Request(
+                f"http://{ah}:{ap}{path}",
+                headers={"authorization": f"Bearer {tok}"} if tok else {})
+            return json.loads(urllib.request.urlopen(req).read())
+
+        def login():
+            req = urllib.request.Request(
+                f"http://{ah}:{ap}/api/v5/login", method="POST",
+                data=json.dumps({"username": "admin",
+                                 "password": "public"}).encode(),
+                headers={"content-type": "application/json"})
+            return json.loads(urllib.request.urlopen(req).read())["token"]
+
+        tok = await loop.run_in_executor(None, login)
+        stats = await loop.run_in_executor(
+            None, lambda: call("/api/v5/stats", tok))
+        assert stats["sessions.count"] >= 4
+        metrics = await loop.run_in_executor(
+            None, lambda: call("/api/v5/metrics", tok))
+        assert metrics["messages.received"] >= 5
+        gws = await loop.run_in_executor(
+            None, lambda: call("/api/v5/gateways", tok))
+        assert gws["gateways"][0]["current_connections"] >= 1
+        retained = await loop.run_in_executor(
+            None, lambda: call("/api/v5/mqtt/retainer/messages", tok))
+        assert any(m["topic"] == "modern/cfg" for m in retained["data"])
+    finally:
+        await node.stop()
