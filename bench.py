@@ -119,6 +119,27 @@ def saturated(per_batch) -> bool:
     return float(np.median(per_batch)) <= EPS * 1.2
 
 
+def measure_scan(jax, jnp, match_ids_hash, max_hits, gen_factory, k, b,
+                 dev_args, floor, n_dispatches=6, escalate=8, label=""):
+    """Measure via make_scan_bench; on floor saturation, escalate to
+    escalate*k batches per dispatch so kernel work dominates relay
+    jitter. Returns (per_batch, total, used_k, was_saturated)."""
+    many = make_scan_bench(jax, jnp, match_ids_hash, max_hits,
+                           gen_factory(k, b), k)
+    per_batch, total = time_dispatches(
+        many, dev_args, floor, k, n_dispatches, jj=(jax, jnp))
+    used_k = k
+    if saturated(per_batch):
+        used_k = k * escalate
+        log(f"{label} floor-saturated at K={k}; re-measuring at K={used_k}")
+        many = make_scan_bench(jax, jnp, match_ids_hash, max_hits,
+                               gen_factory(used_k, b), used_k)
+        per_batch, total = time_dispatches(
+            many, dev_args, floor, used_k,
+            max(3, n_dispatches // 2), jj=(jax, jnp))
+    return per_batch, total, used_k, saturated(per_batch)
+
+
 def time_dispatches(many, dev_args, floor, k, n_dispatches=6, jj=None):
     """Compile, then time n dispatches with fresh seeds. Each timed
     dispatch is bracketed by its OWN trivial-RTT samples: the relay
@@ -204,25 +225,23 @@ def bench_1m(jax, jnp, floor, details):
 
     gen_topics = make_gen(K, B)
 
-    many = make_scan_bench(jax, jnp, match_ids_hash, 4096, gen_topics, K)
-    per_batch, total = time_dispatches(
-        many, (meta, slots, (t_map, r_map, d_map)), floor, K, jj=(jax, jnp)
+    per_batch, total, used_k, sat2 = measure_scan(
+        jax, jnp, match_ids_hash, 4096, make_gen, K, B,
+        (meta, slots, (t_map, r_map, d_map)), floor, label="#2",
     )
     med = float(np.median(per_batch))
     rate = B / med
     log(f"#2 TPU hash kernel: {med * 1e3:.3f} ms/batch-of-{B} "
         f"({rate:,.0f} topics/s vs {N} subs; {total} matches over "
-        f"{len(per_batch) * K * B} topics)")
+        f"{len(per_batch) * used_k * B} topics)")
 
     # --- batch scaling: a server under load aggregates bigger batches;
     # B=8192 amortizes fixed per-dispatch work 8x
     B2, K2 = 8192, 4
-    many_big = make_scan_bench(
-        jax, jnp, match_ids_hash, 16384, make_gen(K2, B2), K2
-    )
-    pb_big, _tot_big = time_dispatches(
-        many_big, (meta, slots, (t_map, r_map, d_map)), floor, K2,
-        n_dispatches=4, jj=(jax, jnp),
+    pb_big, _tot_big, _k2b, sat2b = measure_scan(
+        jax, jnp, match_ids_hash, 16384, make_gen, K2, B2,
+        (meta, slots, (t_map, r_map, d_map)), floor, n_dispatches=4,
+        label="#2b",
     )
     med_big = float(np.median(pb_big))
     log(f"#2b batch scaling: {med_big * 1e3:.3f} ms/batch-of-{B2} "
@@ -231,7 +250,7 @@ def bench_1m(jax, jnp, floor, details):
         "batch": B2,
         "tpu_topics_per_sec": round(B2 / med_big, 1),
         "tpu_ms_per_batch_p50": round(med_big * 1e3, 4),
-        **({"floor_saturated": True} if saturated(pb_big) else {}),
+        **({"floor_saturated": True} if sat2b else {}),
     }
 
     # --- on-device exactness: one real dispatch, verify vs native oracle
@@ -295,6 +314,7 @@ def bench_1m(jax, jnp, floor, details):
             1,
         ),
         "exactness_check": "ok",
+        **({"floor_saturated": True} if sat2 else {}),
     }
     ts.close()
     return rate, nb_rate, table, index, meta, slots, filters
@@ -670,23 +690,26 @@ def bench_rules(jax, jnp, floor, details):
         np.array([lk(f"dev{j}") for j in range(NR)], np.int32)
     )
 
-    def gen_topics(key, aux):
-        nmap, dmap = aux
-        k1, k2 = jax.random.split(key)
-        d = jax.random.randint(k1, (K, B), 0, NR)
-        junk = jax.random.randint(k2, (K, B), 1 << 28, 1 << 29)
-        ids = jnp.zeros((K, B, L), jnp.int32)
-        ids = ids.at[..., 0].set(evt_id)
-        ids = ids.at[..., 1].set(nmap[d % 100])
-        ids = ids.at[..., 2].set(dmap[d])
-        ids = ids.at[..., 3].set(junk)
-        ids = ids.at[..., 4].set(junk ^ 3)
-        return ids, jnp.full((K, B), 5, jnp.int32), jnp.zeros((K, B), bool)
+    def make_gen5(k_, b_):
+        def gen_topics(key, aux):
+            nmap, dmap = aux
+            k1, k2 = jax.random.split(key)
+            d = jax.random.randint(k1, (k_, b_), 0, NR)
+            junk = jax.random.randint(k2, (k_, b_), 1 << 28, 1 << 29)
+            ids = jnp.zeros((k_, b_, L), jnp.int32)
+            ids = ids.at[..., 0].set(evt_id)
+            ids = ids.at[..., 1].set(nmap[d % 100])
+            ids = ids.at[..., 2].set(dmap[d])
+            ids = ids.at[..., 3].set(junk)
+            ids = ids.at[..., 4].set(junk ^ 3)
+            return (ids, jnp.full((k_, b_), 5, jnp.int32),
+                    jnp.zeros((k_, b_), bool))
 
-    many = make_scan_bench(jax, jnp, match_ids_hash, 4096, gen_topics, K)
-    per_batch, total = time_dispatches(
-        many, (meta, slots, (n_map, dev_map)), floor, K, n_dispatches=4,
-        jj=(jax, jnp),
+        return gen_topics
+
+    per_batch, total, _k5, sat5 = measure_scan(
+        jax, jnp, match_ids_hash, 4096, make_gen5, K, B,
+        (meta, slots, (n_map, dev_map)), floor, n_dispatches=4, label="#5",
     )
     med = float(np.median(per_batch))
     log(f"#5 rule filters (10K): {med * 1e3:.3f} ms/batch "
@@ -694,7 +717,7 @@ def bench_rules(jax, jnp, floor, details):
     details["config5_rule_filters"] = {
         "tpu_topics_per_sec": round(B / med, 1),
         "rules": NR,
-        **({"floor_saturated": True} if saturated(per_batch) else {}),
+        **({"floor_saturated": True} if sat5 else {}),
     }
 
 
