@@ -14,6 +14,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import struct
+import time
 from typing import Dict, List, Optional, Tuple
 
 from .base import GatewayImpl
@@ -80,6 +81,11 @@ class SnPeer:
 
     def __init__(self) -> None:
         self.session = None
+        # keepalive: CONNECT duration * 1.5, refreshed by any datagram
+        # (the SN spec's keep-alive; dead UDP peers must not leak
+        # sessions forever)
+        self.last_seen = 0.0
+        self.duration = 0  # 0 = no expiry
         self.topic_by_id: Dict[int, str] = {}
         self.id_by_topic: Dict[str, int] = {}
         # ids the CLIENT knows about: client-initiated REGISTERs are
@@ -146,14 +152,40 @@ class MqttSnGateway(GatewayImpl):
             lambda: _SnProtocol(self), local_addr=(host, port)
         )
         self.listen_addr = self._transport.get_extra_info("sockname")[:2]
+        self._gc_task = asyncio.ensure_future(self._gc_loop())
         log.info("mqttsn gateway on %s", self.listen_addr)
 
     async def on_unload(self) -> None:
+        if getattr(self, "_gc_task", None) is not None:
+            self._gc_task.cancel()
+            self._gc_task = None
         for addr in list(self.peers):
             self._drop_peer(addr)
         if self._transport is not None:
             self._transport.close()
             self._transport = None
+
+    async def _gc_loop(self) -> None:
+        while True:
+            await asyncio.sleep(5.0)
+            try:
+                self.gc_peers()
+            except Exception:
+                log.exception("mqttsn peer gc failed")
+
+    def gc_peers(self, now: Optional[float] = None) -> int:
+        """Drop peers whose keep-alive lapsed (duration x multiplier,
+        sharing the MQTT channel's configurable tolerance)."""
+        now = now if now is not None else time.time()
+        mult = float(self.conf.get("keepalive_multiplier", 1.5))
+        stale = [
+            addr for addr, p in self.peers.items()
+            if p.duration and now - p.last_seen > p.duration * mult
+        ]
+        for addr in stale:
+            log.info("mqttsn peer %s keepalive expired", addr)
+            self._drop_peer(addr)
+        return len(stale)
 
     def connection_count(self) -> int:
         return len(self.peers)
@@ -184,6 +216,7 @@ class MqttSnGateway(GatewayImpl):
         peer = self.peers.get(addr)
         if peer is None or peer.session is None:
             return  # not connected: ignore (reference drops too)
+        peer.last_seen = time.time()  # any traffic refreshes keepalive
         if msg_type == REGISTER:
             if len(body) < 5:
                 raise ValueError("short REGISTER")
@@ -233,6 +266,8 @@ class MqttSnGateway(GatewayImpl):
             return
         self._drop_peer(addr)  # re-connect replaces the old session
         peer = SnPeer()
+        peer.last_seen = time.time()
+        (peer.duration,) = struct.unpack(">H", body[2:4])
         session, _ = self.open_session(client_id, bool(flags & FLAG_CLEAN))
         peer.session = session
         session.outgoing_sink = lambda pkts, a=addr: self._deliver(a, pkts)

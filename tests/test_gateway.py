@@ -266,3 +266,34 @@ async def test_mqttsn_qos1_and_invalid_topic():
     assert ack2[4] == sn.RC_ACCEPTED
     t1.close()
     await reg.unload_all()
+
+
+async def test_mqttsn_keepalive_expiry():
+    """A vanished UDP peer's session is reaped after duration*1.5;
+    live traffic refreshes the deadline."""
+    import time
+
+    b = Broker()
+    reg = GatewayRegistry(b)
+    gw = await reg.load("mqttsn", {"bind": "127.0.0.1:0"})
+    loop = asyncio.get_running_loop()
+    t1, c1 = await loop.create_datagram_endpoint(
+        SnClient, remote_addr=gw.listen_addr)
+    # duration=2s keepalive
+    c1.send(sn.CONNECT, bytes([sn.FLAG_CLEAN, 0x01, 0, 2]) + b"kadev")
+    await c1.recv(sn.CONNACK)
+    assert gw.connection_count() == 1
+    # traffic keeps it alive past the naive deadline
+    peer = next(iter(gw.peers.values()))
+    peer.last_seen = time.time()
+    assert gw.gc_peers(now=time.time() + 1) == 0
+    # backdate, then PING: only the datagram-refresh path can save it
+    peer.last_seen = time.time() - 10
+    c1.send(sn.PINGREQ, b"")
+    await c1.recv(sn.PINGRESP)
+    assert gw.gc_peers(now=time.time()) == 0  # refreshed by ping
+    # silence past duration*1.5 reaps it
+    assert gw.gc_peers(now=time.time() + 10) == 1
+    assert gw.connection_count() == 0
+    t1.close()
+    await reg.unload_all()
