@@ -436,10 +436,10 @@ class Broker:
                 and min(msg.qos, opts.qos) == 0
                 and not session.cfg.upgrade_qos
             ):
-                n += 1
-                self.hooks.run("message.delivered", client, msg)
                 if opts.no_local and msg.from_client == client:
                     continue
+                n += 1
+                self.hooks.run("message.delivered", client, msg)
                 retain = msg.retain if opts.retain_as_published else False
                 shared_pkt = pkt_cache.get(retain)
                 if shared_pkt is None:
@@ -456,6 +456,8 @@ class Broker:
                 sink = getattr(session, "outgoing_sink", None)
                 if sink is not None:
                     sink([shared_pkt])
+                continue
+            if opts.no_local and msg.from_client == client:
                 continue
             packets = session.deliver(msg, opts)
             self.hooks.run("message.delivered", client, msg)

@@ -119,7 +119,11 @@ class ExHookBridge:
     block on the round trip (bounded by `timeout`); when the server is
     unreachable, fold hookpoints follow `failed_action`:
     'ignore' keeps the accumulator, 'deny' stops the chain with a
-    denial (reference request_failed_action)."""
+    denial. Default 'deny', matching the reference
+    (emqx_exhook_schema.erl request_failed_action) — a dead hook
+    server gating client.authenticate must not silently allow all.
+    A dropped connection is retried in the background with capped
+    exponential backoff until stop()."""
 
     def __init__(
         self,
@@ -127,7 +131,7 @@ class ExHookBridge:
         addr,
         name: str = "default",
         timeout: float = 5.0,
-        failed_action: str = "ignore",
+        failed_action: str = "deny",
     ):
         assert failed_action in ("ignore", "deny")
         self.broker = broker
@@ -219,13 +223,60 @@ class ExHookBridge:
                     fut = self._pending.pop(seq, None)
                     if fut is not None and not fut.done():
                         fut.set_result((verdict, acc))
-        except (asyncio.IncompleteReadError, ConnectionError, ValueError):
+        except Exception:
+            # any decode error (incl. WireError) or disconnect ends the
+            # session: fail pending calls NOW (don't leave them to time
+            # out against a dead link), close the transport, reconnect
             for fut in self._pending.values():
                 if not fut.done():
                     fut.set_exception(ConnectionError("exhook server gone"))
             self._pending.clear()
+            writer, self._reader, self._writer = self._writer, None, None
+            if writer is not None:
+                try:
+                    writer.close()
+                except Exception:
+                    pass
+            asyncio.ensure_future(self._reconnect_loop())
+
+    async def _reconnect_loop(self) -> None:
+        """Retry the server with capped exponential backoff; while the
+        connection is down every fold call keeps taking the
+        `failed_action` path, so a revived server restores service
+        without a broker restart."""
+        delay = 0.25
+        while self._loop is not None and not self._loop.is_closed():
+            await asyncio.sleep(delay)
+            writer = None
+            try:
+                reader, writer = await asyncio.open_connection(*self.addr)
+                hello = await _read_frame(reader)
+                if hello[0] != "hello":
+                    raise ConnectionError(f"bad re-handshake: {hello!r}")
+                self._reader, self._writer = reader, writer
+                log.info("exhook %s reconnected to %s", self.name, self.addr)
+                if sorted(hello[1]) != sorted(self.hookpoints):
+                    # server came back declaring a different hook set —
+                    # re-install so new points bridge and dropped ones
+                    # stop intercepting
+                    for point, cb in self._installed:
+                        self.broker.hooks.delete(point, cb)
+                    self._installed.clear()
+                    self.hookpoints = list(hello[1])
+                    self._install_hooks()
+                asyncio.ensure_future(self._recv_loop())
+                return
+            except Exception:
+                if writer is not None:
+                    try:
+                        writer.close()
+                    except Exception:
+                        pass
+                delay = min(delay * 2, 15.0)
 
     async def _do_call(self, hookpoint, args, acc):
+        if self._writer is None:
+            raise ConnectionError("exhook server disconnected")
         self._seq += 1
         seq = self._seq
         fut = asyncio.get_running_loop().create_future()
@@ -240,8 +291,13 @@ class ExHookBridge:
             self._pending.pop(seq, None)
 
     async def _do_cast(self, hookpoint, args):
-        _write_frame(self._writer, ("cast", hookpoint, args))
-        await self._writer.drain()
+        if self._writer is None:
+            return
+        try:
+            _write_frame(self._writer, ("cast", hookpoint, args))
+            await self._writer.drain()
+        except (OSError, ConnectionError):
+            pass
 
     # --- broker-side hook callbacks --------------------------------------
 

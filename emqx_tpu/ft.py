@@ -266,4 +266,10 @@ class FileTransfer:
 
 
 def _safe(s: str) -> str:
-    return "".join(c if c.isalnum() or c in "-_." else "_" for c in s)[:120]
+    out = "".join(c if c.isalnum() or c in "-_." else "_" for c in s)[:120]
+    # A component made entirely of dots ('.', '..') would resolve upward
+    # when joined into tmp/export paths and later rmtree'd — neutralize
+    # it. Empty stays empty so callers' `or "anon"` fallback applies.
+    if out and set(out) <= {"."}:
+        return "_" * len(out)
+    return out

@@ -57,9 +57,20 @@ def _load_meta(root: str) -> dict:
     for k in ("name", "version", "entry"):
         if not isinstance(meta.get(k), str) or not meta[k]:
             raise PluginError(f"plugin.json missing field {k!r}")
-    if "/" in meta["name"] or ".." in meta["entry"] or meta["entry"].startswith("/"):
+    for field in ("name", "version"):
+        v = meta[field]
+        if "/" in v or "\\" in v or ".." in v:
+            raise PluginError("unsafe plugin metadata")
+    if ".." in meta["entry"] or meta["entry"].startswith("/"):
         raise PluginError("unsafe plugin metadata")
     return meta
+
+
+def _check_dest(dest: str, install_dir: str) -> None:
+    real = os.path.realpath(dest)
+    root = os.path.realpath(install_dir)
+    if not (real == root or real.startswith(root + os.sep)):
+        raise PluginError("unsafe plugin metadata")
 
 
 class PluginManager:
@@ -120,6 +131,7 @@ class PluginManager:
                     f"plugin {meta['name']} already installed — uninstall first"
                 )
             dest = os.path.join(self.dir, f"{meta['name']}-{meta['version']}")
+            _check_dest(dest, self.dir)
             if os.path.exists(dest):
                 raise PluginError(f"{meta['name']}-{meta['version']} already installed")
             shutil.copytree(package, dest)
@@ -149,6 +161,7 @@ class PluginManager:
                 shutil.rmtree(tmp, ignore_errors=True)
                 raise
             dest = os.path.join(self.dir, f"{meta['name']}-{meta['version']}")
+            _check_dest(dest, self.dir)
             if meta["name"] in self._plugins or os.path.exists(dest):
                 # a different VERSION of a (possibly running) plugin
                 # must not silently orphan the old one's hooks

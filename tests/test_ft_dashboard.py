@@ -80,6 +80,30 @@ def test_ft_checksum_and_missing_segments(tmp_path):
     assert _responses(out)[-1]["reason_code"] != 0
 
 
+def test_ft_dotdot_client_id_stays_inside_storage(tmp_path):
+    """A client id of '..' must not resolve transfer paths upward —
+    init used to rmtree <storage>/tmp/../<fileid>, i.e. a sibling of
+    the storage dir (ADVICE r2 high)."""
+    b = Broker()
+    ft = FileTransfer(b, storage_dir=str(tmp_path / "ft"))
+    ft.enable()
+    canary = tmp_path / "ft" / "exports"
+    os.makedirs(canary, exist_ok=True)
+    (canary / "keep.txt").write_text("precious")
+    s, out = _client(b, "..", sub="$file-response/..")
+    content = b"payload"
+    _cmd(b, "..", "$file/exports/init",
+         json.dumps({"name": "a.bin", "size": len(content)}).encode())
+    _cmd(b, "..", "$file/exports/0", content)
+    _cmd(b, "..", f"$file/exports/fin/{len(content)}")
+    rs = _responses(out)
+    assert rs and rs[-1]["reason_code"] == 0
+    assert (canary / "keep.txt").read_text() == "precious"
+    dest = rs[-1]["reason_description"]
+    root = os.path.realpath(str(tmp_path / "ft"))
+    assert os.path.realpath(dest).startswith(root + os.sep)
+
+
 def test_ft_gc_and_abort(tmp_path):
     b = Broker()
     ft = FileTransfer(b, storage_dir=str(tmp_path), segments_ttl=0.01)

@@ -91,6 +91,17 @@ def test_plugin_tarball_and_boot_restart(tmp_path):
         mgr2.install(make_package(tmp_path, as_tar=False))
 
 
+def test_plugin_version_traversal_rejected(tmp_path):
+    """plugin.json version like '../../x' must not escape the install
+    dir via the dir-install copytree path (ADVICE r2 medium)."""
+    mgr = PluginManager(Broker(), install_dir=str(tmp_path / "plugins"))
+    for bad in ("../../../x", "a/b", "..", "1.0\\evil"):
+        pkg = make_package(tmp_path, name=f"v{abs(hash(bad))%1000}", version=bad)
+        with pytest.raises(PluginError):
+            mgr.install(pkg)
+    assert os.listdir(tmp_path / "plugins") == []
+
+
 def test_plugin_tar_traversal_rejected(tmp_path):
     evil = tmp_path / "evil.tar.gz"
     with tarfile.open(evil, "w:gz") as tar:
