@@ -82,18 +82,29 @@ def _scatter_rows(
 @functools.partial(jax.jit, donate_argnums=0)
 def _scatter_slots(
     slots: SlotArrays,
-    idx: jnp.ndarray,  # int32 [n_batches, K]
+    idx: jnp.ndarray,  # int32 [n_batches, K] — flat slot indices
     fp: jnp.ndarray,  # uint32 [n_batches, K]
     bucket: jnp.ndarray,  # int32 [n_batches, K]
+    probe: jnp.ndarray,  # uint32 [n_batches, K] — merged probe WORDS
 ) -> SlotArrays:
     """Batched in-place update of the hash-slot arrays (same shape
-    discipline as _scatter_rows: padding rewrites the last slot)."""
+    discipline as _scatter_rows: padding rewrites the last slot).
+    Probe words scatter at idx//W; duplicate indices in one batch all
+    carry the same host-merged word, so last-write-wins is safe."""
+    from ..ops.hash_index import BUCKET_W
 
     def step(s, xs):
-        i, f, b = xs
-        return SlotArrays(s.fp.at[i].set(f), s.bucket.at[i].set(b)), None
+        i, f, b, pw = xs
+        return (
+            SlotArrays(
+                s.fp.at[i].set(f),
+                s.bucket.at[i].set(b),
+                s.probe.at[i // BUCKET_W].set(pw),
+            ),
+            None,
+        )
 
-    out, _ = jax.lax.scan(step, slots, (idx, fp, bucket))
+    out, _ = jax.lax.scan(step, slots, (idx, fp, bucket, probe))
     return out
 
 
@@ -155,6 +166,9 @@ class DeviceTable:
                 jnp.asarray(idx.reshape(shape2)),
                 jnp.asarray(ix.slots.fp[idx].reshape(shape2)),
                 jnp.asarray(ix.slots.bucket[idx].reshape(shape2)),
+                jnp.asarray(
+                    ix.slots.probe[idx // hash_ops.BUCKET_W].reshape(shape2)
+                ),
             )
         if ix.residual_dirty or self._dev_residual is None or (
             self._dev_residual.shape[0] != self.table.capacity
@@ -455,22 +469,43 @@ class Router:
             return out
         ix = self.index
         if ix is not None:
+            host_fallback = False
             if len(ix):
                 meta, slots = self.device_table.hash_state()
-                ti, bi, total = self._escalating_pairs(
-                    lambda mh: hash_ops.match_ids_hash(meta, slots, enc, max_hits=mh),
-                    max(1024, _next_pow2(2 * len(topics))),
+                mh = max(1024, _next_pow2(2 * len(topics)))
+                ti, bi, total, amb = hash_ops.match_ids_hash(
+                    meta, slots, enc, max_hits=mh
                 )
-                twords: List = [None] * len(topics)
-                for t_idx, bid in zip(ti[:total], bi[:total]):
-                    t_idx, bid = int(t_idx), int(bid)
-                    if twords[t_idx] is None:
-                        twords[t_idx] = topic_mod.words(topics[t_idx])
-                    fw = ix.bucket_filter(bid)
-                    if topic_mod.match(twords[t_idx], fw):
-                        for row in ix.bucket_rows(bid):
-                            out[t_idx].append(self._row_filter[row])
-            if ix.residual_rows:
+                total = int(total)
+                if total > mh:
+                    ti, bi, _t, amb = hash_ops.match_ids_hash(
+                        meta, slots, enc, max_hits=_next_pow2(total)
+                    )
+                if int(amb):
+                    # >1 lane of one pair passed the full-fingerprint
+                    # check: distinct filters colliding on all 32 bits
+                    # (~2^-32/pair). The kernel kept one arbitrarily,
+                    # so re-match the batch on the host trie — exact,
+                    # and covers residual rows too.
+                    host_fallback = True
+                else:
+                    ti, bi = np.asarray(ti), np.asarray(bi)
+                    twords: List = [None] * len(topics)
+                    for t_idx, bid in zip(ti[:total], bi[:total]):
+                        t_idx, bid = int(t_idx), int(bid)
+                        if bid < 0:  # phase-2 reject inside the kernel
+                            continue
+                        if twords[t_idx] is None:
+                            twords[t_idx] = topic_mod.words(topics[t_idx])
+                        fw = ix.bucket_filter(bid)
+                        if topic_mod.match(twords[t_idx], fw):
+                            for row in ix.bucket_rows(bid):
+                                out[t_idx].append(self._row_filter[row])
+            if host_fallback:
+                for i, t in enumerate(topics):
+                    for row in self._trie.match(topic_mod.words(t)):
+                        out[i].append(self._row_filter[row])
+            elif ix.residual_rows:
                 filters = self.device_table.residual_filters()
                 ti, ri, total = self._escalating_pairs(
                     lambda mh: match_ops.match_ids(filters, enc, max_hits=mh),

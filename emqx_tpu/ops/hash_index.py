@@ -12,19 +12,43 @@ projections, apps/emqx_retainer/src/emqx_retainer_index.erl:17-50).
 Grouping filters by skeleton ("class"), all filters of one class agree
 on which level positions are literals. Matching one topic against an
 entire class is then ONE hash probe: project the topic's words at the
-class's literal positions, hash, and look up an open-addressing table.
-Per batch the kernel does B×C hash mixes + B×C×P gathers instead of
-B×N×L compares — for C≈32 classes that is ~1000× less work than the
-dense kernel at N=1M.
+class's literal positions, hash, and look up the table. Per batch the
+kernel does B×C hash mixes + B×C×2 bucket gathers instead of B×N×L
+compares — for C≈32 classes that is ~1000× less work than the dense
+kernel at N=1M.
 
-Design points:
+Table layout — bucketized cuckoo, not linear probing:
 
-* ONE global open-addressing table for all classes, keyed by
-  (class id, literal-word projection). Growth is a global rehash —
-  the only recompile event, mirroring FilterTable capacity bumps.
-* A slot holds (fingerprint u32, bucket id i32). A **bucket** is one
-  distinct filter string; all routes for that filter (1 or 100k dests)
-  share the bucket, so wide fanout costs one slot and one device hit.
+* The table is `n_buckets` (pow2) buckets of BUCKET_W=4 slots each,
+  stored flat ([n_buckets*4] fp/bucket arrays). A key hashes to TWO
+  candidate buckets: b1 = h1 & mask and b2 = b1 XOR spread(fp). The
+  XOR derivation is involutive (either bucket recovers the other from
+  the stored fingerprint alone) and spread(fp) is always odd, so
+  b1 ≠ b2. d=2 choices × 4-wide buckets sustain ≥75% load (theory
+  threshold ~0.98) where the round-2 8-probe linear chains collapsed:
+  that table rehashed 10M rows into 268M slots (load 0.04, 2.1GB of
+  HBM); this one holds them in 16.8M slots with a 16.8MB dense-probe
+  footprint.
+* Inserts take any empty lane in b1/b2, else a bounded random-walk
+  eviction (cuckoo kicks) displaces residents to their alternate
+  buckets.
+* A slot holds (fingerprint u32, bucket id i32); each bucket
+  additionally packs its four lanes' probe BYTES (max(fp>>24,1), 0 =
+  empty) into one u32 **probe word**. A **bucket id** names one
+  distinct filter string; all routes for that filter (1 or 100k
+  dests) share it, so wide fanout costs one slot and one device hit.
+* TWO-PHASE probe: the dense phase gathers exactly TWO u32 probe
+  words per (topic, class) — scattered scalar u32 gathers are the one
+  access pattern TPU serves at a flat ~10ns/element regardless of
+  table size (measured; 8-wide u8 row loads degrade 13x once the
+  array leaves VMEM-cacheable size, and jnp.nonzero over the full
+  B×C×2×W lane tensor cost more than the gathers). Lane hits fall out
+  of a zero-byte bit trick on the probe words. The u32 fingerprint +
+  bucket-id arrays are touched ONLY at candidate positions (sparse),
+  so per-batch HBM traffic stays O(B·C·4B + matches), not O(N).
+* Deletion just empties the slot — cuckoo lookups probe a fixed pair
+  of buckets, so there are no probe chains to preserve (no
+  tombstones, unlike the round-2 linear-probe design).
 * Exactness: equal projections hash equal (no false negatives); hash
   collisions are possible but the host verifies each candidate
   (topic, bucket) pair against the pure oracle before expanding it to
@@ -54,13 +78,15 @@ from .table import FilterTable
 from .vocab import PLUS
 
 DEFAULT_CLASS_BUDGET = 256
-MAX_PROBES = 8
+BUCKET_W = 4  # slots per bucket: one u32 probe word per bucket
+MAX_KICKS = 512  # eviction-walk bound before a rebuild
 MIN_SLOTS = 1024
-MAX_LOAD_NUM, MAX_LOAD_DEN = 1, 2  # rebuild past 50% fill
+MAX_LOAD_NUM, MAX_LOAD_DEN = 3, 4  # rebuild past 75% fill
 
 M32 = 0xFFFFFFFF
 _H1_SEED, _H1_CLS, _H1_MUL = 0x811C9DC5, 0x9E3779B1, 16777619
 _FP_SEED, _FP_CLS, _FP_XOR, _FP_MUL = 0x2545F491, 0x85EBCA6B, 0xC2B2AE35, 0x27D4EB2F
+_ALT_MUL = 0x9E3779B9  # odd: (fp|1)*_ALT_MUL is odd, so alt-bucket != bucket
 
 
 def _hash_host(class_id: int, lit_words: List[Tuple[int, int]], max_levels: int):
@@ -78,6 +104,12 @@ def _hash_host(class_id: int, lit_words: List[Tuple[int, int]], max_levels: int)
     return h1, fp
 
 
+def _alt_bucket(b: int, fp: int, mask: int) -> int:
+    """The other candidate bucket. Involutive in b, and never b itself
+    (the spread is odd so at least bit 0 flips)."""
+    return b ^ ((((fp | 1) * _ALT_MUL) & M32) & mask)
+
+
 class ClassMeta(NamedTuple):
     """Per-class metadata arrays, [C] each (device or host numpy)."""
 
@@ -89,11 +121,43 @@ class ClassMeta(NamedTuple):
 
 
 class SlotArrays(NamedTuple):
-    """The open-addressing table, [T] each. bucket: -1 empty, -2
-    tombstone, >=0 live bucket id (fingerprint only valid when >=0)."""
+    """The cuckoo table. fp/bucket are flat [n_buckets*BUCKET_W];
+    bucket: -1 empty, >=0 live bucket id (fingerprint only valid when
+    >=0). probe is [n_buckets]: lane l's byte (bits 8l..8l+7) holds
+    max(fp >> 24, 1) for a live slot, 0 for empty — the phase-1
+    filter never sees a live slot as empty."""
 
-    fp: np.ndarray  # uint32
-    bucket: np.ndarray  # int32
+    fp: np.ndarray  # uint32 [n_buckets*W]
+    bucket: np.ndarray  # int32 [n_buckets*W]
+    probe: np.ndarray  # uint32 [n_buckets]
+
+
+def _fp8_of(fp):
+    """Probe byte of a full fingerprint (host int or numpy array)."""
+    if isinstance(fp, int):
+        return max(fp >> 24, 1)
+    return np.maximum(fp >> 24, 1).astype(np.uint32)
+
+
+def _pack_probe(slots: SlotArrays) -> None:
+    """Recompute the whole probe array from fp/bucket (vectorized)."""
+    lanes = np.where(
+        slots.bucket >= 0, _fp8_of(slots.fp), np.uint32(0)
+    ).reshape(-1, BUCKET_W)
+    w = lanes[:, 0]
+    for l in range(1, BUCKET_W):
+        w = w | (lanes[:, l] << np.uint32(8 * l))
+    slots.probe[:] = w
+
+
+def _refresh_probe(slots: SlotArrays, b: int) -> None:
+    """Recompute one bucket's probe word after slot writes."""
+    base = b * BUCKET_W
+    w = 0
+    for l in range(BUCKET_W):
+        if slots.bucket[base + l] >= 0:
+            w |= _fp8_of(int(slots.fp[base + l])) << (8 * l)
+    slots.probe[b] = w
 
 
 class _Bucket(NamedTuple):
@@ -108,8 +172,145 @@ class _NeedRebuild(Exception):
     pass
 
 
+def build_slots(
+    h1: np.ndarray,
+    fp: np.ndarray,
+    ids: np.ndarray,
+    min_buckets: int = MIN_SLOTS // BUCKET_W,
+    dirty: Optional[Set[int]] = None,
+) -> Tuple[SlotArrays, np.ndarray, int]:
+    """Vectorized bulk cuckoo placement: place every (h1[i], fp[i]) key
+    with payload ids[i], growing until all fit. Returns
+    (slots, pos int64[n] — the flat slot index per key, n_buckets).
+
+    Greedy rounds place each pending key in the less-loaded of its two
+    candidate buckets (ties and overfull lanes resolved by a stable
+    sort-and-rank sweep, all numpy); the handful of stragglers that a
+    greedy pass can't seat at ≤75% load finish through the same
+    eviction walk single inserts use. ~2s for 10M keys vs ~27s for the
+    round-2 per-row rehash cascade. `dirty` (when given) collects every
+    written slot index — the incremental-sync path for in-place loads.
+    """
+    n = len(h1)
+    h1 = np.ascontiguousarray(h1, np.uint32)
+    fp = np.ascontiguousarray(fp, np.uint32)
+    ids = np.ascontiguousarray(ids, np.int32)
+    need = -(-n * MAX_LOAD_DEN // (BUCKET_W * MAX_LOAD_NUM)) if n else 0
+    n_buckets = max(min_buckets, 1)
+    while n_buckets < need:
+        n_buckets *= 2
+    assert n_buckets & (n_buckets - 1) == 0
+    while True:
+        mask = np.uint32(n_buckets - 1)
+        slots = SlotArrays(
+            np.zeros(n_buckets * BUCKET_W, np.uint32),
+            np.full(n_buckets * BUCKET_W, -1, np.int32),
+            np.zeros(n_buckets, np.uint32),
+        )
+        pos = np.full(n, -1, np.int64)
+        occ = np.zeros(n_buckets, np.int32)
+        with np.errstate(over="ignore"):
+            b1 = (h1 & mask).astype(np.int64)
+            b2 = b1 ^ (((fp | np.uint32(1)) * np.uint32(_ALT_MUL)) & mask).astype(
+                np.int64
+            )
+        pending = np.arange(n)
+        for _round in range(24):
+            if not len(pending):
+                break
+            t1, t2 = b1[pending], b2[pending]
+            tgt = np.where(occ[t1] <= occ[t2], t1, t2)
+            order = np.argsort(tgt, kind="stable")
+            st = tgt[order]
+            first = np.ones(len(st), bool)
+            first[1:] = st[1:] != st[:-1]
+            idxs = np.arange(len(st))
+            start = np.maximum.accumulate(np.where(first, idxs, 0))
+            lane = occ[st] + (idxs - start)
+            acc = lane < BUCKET_W
+            rows = pending[order[acc]]
+            sl = st[acc] * BUCKET_W + lane[acc]
+            slots.fp[sl] = fp[rows]
+            slots.bucket[sl] = ids[rows]
+            pos[rows] = sl
+            occ += np.bincount(st[acc], minlength=n_buckets).astype(np.int32)
+            pending = pending[order[~acc]]
+        ok = True
+        for i in pending:  # stragglers: eviction walk (expected ~none)
+            if not _evict_insert(
+                slots, n_buckets, int(b1[i]), int(fp[i]), int(ids[i])
+            ):
+                ok = False
+                break
+        if ok:
+            if len(pending):
+                # eviction kicks relocate earlier keys: recompute every
+                # position from the table (ids are unique)
+                sl = np.flatnonzero(slots.bucket >= 0)
+                bid_at = slots.bucket[sl].astype(np.int64)
+                inv = np.full(int(ids.max()) + 1, -1, np.int64)
+                inv[ids.astype(np.int64)] = np.arange(n)
+                pos[inv[bid_at]] = sl
+            _pack_probe(slots)
+            if dirty is not None and n:
+                dirty.update(int(p) for p in pos)
+            return slots, pos, n_buckets
+        n_buckets *= 2
+
+
+def _evict_insert(
+    slots: SlotArrays,
+    n_buckets: int,
+    b1: int,
+    fp: int,
+    bid: int,
+    dirty: Optional[Set[int]] = None,
+) -> bool:
+    """Insert (fp, bid) starting at bucket b1, kicking residents along
+    their alternate buckets (which may relocate ANY resident,
+    including the new key itself). Returns False when MAX_KICKS walks
+    found no empty lane. Callers recover final positions from `dirty`
+    (incremental: _repatch_slots) or by rescanning the table (bulk
+    build) — the walk does not report where keys landed."""
+    mask = n_buckets - 1
+    b2 = _alt_bucket(b1, fp, mask)
+    for b in (b1, b2):
+        base = b * BUCKET_W
+        for lane in range(BUCKET_W):
+            if slots.bucket[base + lane] < 0:
+                slots.fp[base + lane] = fp
+                slots.bucket[base + lane] = bid
+                if dirty is not None:
+                    dirty.add(base + lane)
+                return True
+    # both full: place in b1 by evicting, then walk the victim chain
+    seed = (b1 * 0x9E3779B1 + fp) & M32
+    cur = b1
+    for _ in range(MAX_KICKS):
+        seed = (seed * 1103515245 + 12345) & M32
+        lane = (seed >> 16) % BUCKET_W
+        s = cur * BUCKET_W + lane
+        vfp, vbid = int(slots.fp[s]), int(slots.bucket[s])
+        slots.fp[s] = fp
+        slots.bucket[s] = bid
+        if dirty is not None:
+            dirty.add(s)
+        # victim becomes the carried key, headed for its alternate
+        fp, bid = vfp, vbid
+        cur = _alt_bucket(cur, fp, mask)
+        base = cur * BUCKET_W
+        for lane in range(BUCKET_W):
+            if slots.bucket[base + lane] < 0:
+                slots.fp[base + lane] = fp
+                slots.bucket[base + lane] = bid
+                if dirty is not None:
+                    dirty.add(base + lane)
+                return True
+    return False
+
+
 class ClassIndex:
-    """Host source of truth for the pattern-class hash table.
+    """Host source of truth for the pattern-class cuckoo table.
 
     The owner (Router/DeviceTable) calls add_row/remove_row alongside
     FilterTable add/remove; this module keeps skeleton classes, filter
@@ -125,6 +326,7 @@ class ClassIndex:
         assert min_slots >= 32 and min_slots & (min_slots - 1) == 0
         self.max_levels = max_levels
         self.class_budget = class_budget
+        self._min_buckets = max(4, min_slots // BUCKET_W)
         self._skel_class: Dict[Tuple[int, bool, int], int] = {}
         self._class_free: List[int] = list(range(class_budget - 1, -1, -1))
         self._class_buckets: List[int] = [0] * class_budget
@@ -135,12 +337,13 @@ class ClassIndex:
             np.zeros(class_budget, np.uint32),
             np.zeros(class_budget, bool),
         )
-        self.n_slots = min_slots
+        self.n_buckets = self._min_buckets
         self.slots = SlotArrays(
-            np.zeros(min_slots, np.uint32), np.full(min_slots, -1, np.int32)
+            np.zeros(self.n_buckets * BUCKET_W, np.uint32),
+            np.full(self.n_buckets * BUCKET_W, -1, np.int32),
+            np.zeros(self.n_buckets, np.uint32),
         )
-        self._fill = 0  # live + tombstoned slots (probe-chain occupancy)
-        self._live = 0  # live slots only
+        self._live = 0  # live slots
         self._buckets: List[Optional[_Bucket]] = []
         self._bucket_free: List[int] = []
         self._bucket_of: Dict[Tuple[str, ...], int] = {}
@@ -154,17 +357,20 @@ class ClassIndex:
         self.meta_dirty = True
         self.rebuilt = True  # device must re-upload slot arrays
 
+    @property
+    def n_slots(self) -> int:
+        return self.n_buckets * BUCKET_W
+
     def __len__(self) -> int:
         return self._live
 
     def active_hi(self) -> int:
         """One past the highest active class id. Class ids allocate
         lowest-first and the device kernel's per-batch work is
-        B x C x probes, so callers upload/match over meta sliced to
-        next_pow2(active_hi) instead of the full budget — on TPU a
-        random-access gather costs ~15ns/element, making the padded
-        C=256 sweep ~30ms/batch while a packed C=8 sweep is ~1ms
-        (measured; the recompile on pow2 growth is rare and cheap)."""
+        B x C x 2 bucket rows, so callers upload/match over meta sliced
+        to next_pow2(active_hi) instead of the full budget — on TPU a
+        padded C=256 sweep costs ~30x a packed C=8 sweep (measured;
+        the recompile on pow2 growth is rare and cheap)."""
         act = np.flatnonzero(self.meta.active)
         return int(act[-1]) + 1 if len(act) else 0
 
@@ -212,17 +418,15 @@ class ClassIndex:
         if bid == len(self._buckets):
             self._buckets.append(None)
             self._bucket_rows.append(set())
-        try:
-            slot = self._place(h1, fp, bid)
-        except _NeedRebuild:
-            self._buckets[bid] = _Bucket(ws, cid, h1, fp, -1)
-            self._finish_bucket(bid, row, ws, cid)
-            self._rebuild(self.n_slots * 2)
-            return
-        self._buckets[bid] = _Bucket(ws, cid, h1, fp, slot)
+        self._buckets[bid] = _Bucket(ws, cid, h1, fp, -1)
         self._finish_bucket(bid, row, ws, cid)
-        if self._fill * MAX_LOAD_DEN > self.n_slots * MAX_LOAD_NUM:
-            self._rebuild(self.n_slots * 2)
+        if self._live * MAX_LOAD_DEN > self.n_slots * MAX_LOAD_NUM:
+            self._rebuild(self.n_buckets * 2)
+            return
+        try:
+            self._place(h1, fp, bid)
+        except _NeedRebuild:
+            self._rebuild(self.n_buckets * 2)
 
     def _finish_bucket(self, bid: int, row: int, ws, cid: int) -> None:
         self._bucket_rows[bid] = {row}
@@ -245,9 +449,14 @@ class ClassIndex:
         b = self._buckets[bid]
         assert b is not None
         if b.slot >= 0:
-            self.slots.bucket[b.slot] = -2  # tombstone keeps probe chains
+            self.slots.bucket[b.slot] = -1  # cuckoo: plain delete
+            # zero the fingerprint too: phase 2 trusts fp matches and
+            # fetches the bucket id only for the winning lane, so a
+            # stale fp in a vacated slot could outrank the true lane
+            self.slots.fp[b.slot] = 0
+            _refresh_probe(self.slots, b.slot // BUCKET_W)
             self.dirty_slots.add(b.slot)
-            self._live -= 1
+        self._live -= 1
         del self._bucket_of[b.filter_words]
         self._buckets[bid] = None
         self._bucket_free.append(bid)
@@ -297,68 +506,86 @@ class ClassIndex:
         self.meta_dirty = True
         self._class_free.append(cid)
 
-    def _place(self, h1: int, fp: int, bid: int) -> int:
-        mask = self.n_slots - 1
-        for p in range(MAX_PROBES):
-            i = (h1 + p) & mask
-            cur = self.slots.bucket[i]
-            if cur < 0:
-                if cur == -1:
-                    self._fill += 1
-                self.slots.fp[i] = fp
-                self.slots.bucket[i] = bid
-                self.dirty_slots.add(i)
-                return i
-        raise _NeedRebuild
+    def _place(self, h1: int, fp: int, bid: int) -> None:
+        """Seat bucket `bid`; eviction kicks may relocate other live
+        buckets (including `bid` itself), so every _Bucket.slot record
+        is re-aligned from the walk's dirty set afterwards."""
+        dirty: Set[int] = set()
+        ok = _evict_insert(
+            self.slots, self.n_buckets, h1 & (self.n_buckets - 1), fp, bid,
+            dirty=dirty,
+        )
+        for b in {s // BUCKET_W for s in dirty}:
+            _refresh_probe(self.slots, b)
+        self.dirty_slots.update(dirty)  # partial kicks still synced
+        self._repatch_slots(dirty)
+        if not ok:
+            raise _NeedRebuild
 
-    def _rebuild(self, n_slots: int) -> None:
-        """Global rehash into a table of n_slots (doubling until every
-        bucket places within MAX_PROBES)."""
-        while True:
-            slots = SlotArrays(
-                np.zeros(n_slots, np.uint32), np.full(n_slots, -1, np.int32)
-            )
-            mask = n_slots - 1
-            ok = True
-            for bid, b in enumerate(self._buckets):
-                if b is None:
-                    continue
-                for p in range(MAX_PROBES):
-                    i = (b.h1 + p) & mask
-                    if slots.bucket[i] == -1:
-                        slots.fp[i] = b.fp
-                        slots.bucket[i] = bid
-                        self._buckets[bid] = b._replace(slot=i)
-                        break
-                else:
-                    ok = False
-                    break
-            if ok:
-                break
-            n_slots *= 2
-        self.n_slots = n_slots
+    def _repatch_slots(self, touched: Set[int]) -> None:
+        """After eviction kicks, realign _Bucket.slot with the array."""
+        for s in touched:
+            cur = int(self.slots.bucket[s])
+            if cur >= 0:
+                b = self._buckets[cur]
+                if b is not None and b.slot != s:
+                    self._buckets[cur] = b._replace(slot=s)
+
+    def _rebuild(self, n_buckets: int) -> None:
+        """Vectorized global re-place into >= n_buckets buckets."""
+        bids = [i for i, b in enumerate(self._buckets) if b is not None]
+        h1s = np.fromiter(
+            (self._buckets[i].h1 for i in bids), np.uint32, len(bids)
+        )
+        fps = np.fromiter(
+            (self._buckets[i].fp for i in bids), np.uint32, len(bids)
+        )
+        ids = np.asarray(bids, np.int32)
+        slots, pos, n_buckets = build_slots(
+            h1s, fps, ids, min_buckets=max(n_buckets, self._min_buckets)
+        )
+        for i, bid in enumerate(bids):
+            self._buckets[bid] = self._buckets[bid]._replace(slot=int(pos[i]))
+        self.n_buckets = n_buckets
         self.slots = slots
-        self._fill = self._live
         self.dirty_slots.clear()
         self.rebuilt = True
 
 
-@functools.partial(jax.jit, static_argnames=("max_hits", "n_probes"))
+@functools.partial(jax.jit, static_argnames=("max_hits",))
 def match_ids_hash(
     meta: ClassMeta,
     slots: SlotArrays,
     topics: EncodedTopics,
     max_hits: int = 4096,
-    n_probes: int = MAX_PROBES,
 ):
-    """Hash-probe every (topic, class) pair in one dispatch.
+    """Probe every (topic, class) pair's TWO cuckoo buckets in one
+    dispatch: [B,C] hash mixes, then 2 row-gathers of contiguous
+    BUCKET_W-wide bucket rows ([B,C,2,W] fp/id compares). Work and
+    memory traffic are independent of table size N — the property the
+    round-2 linear-probe table lost at 10M rows.
+
+    A (topic, class) pair can have AT MOST ONE truly matching filter:
+    the class fixes which positions are literals, so every filter of
+    the class that matches the topic has the same literal projection —
+    i.e. is the same filter string (= one bucket). Phase 2 therefore
+    emits one candidate per flagged pair (the first lane whose full
+    fingerprint matches), and pairs are the output unit — no per-lane
+    compaction pass.
 
     Returns (topic_idx int32 [max_hits], bucket_id int32 [max_hits],
-    total int32). Valid slots are dense at the front; `total` is the
-    EXACT candidate count, so on overflow the caller re-runs once with
-    max_hits = next_pow2(total). Candidates may (rarely) be hash false
-    positives — the caller verifies each pair on the host before
-    expanding buckets to destinations."""
+    total int32, amb int32). `total` is the EXACT flagged-pair count,
+    so on overflow the caller re-runs once with max_hits =
+    next_pow2(total). Within the first `total` entries, pairs whose
+    full-fingerprint check rejected every lane carry -1/-1 — callers
+    skip negatives. Surviving candidates may still (rarely) be full-
+    fingerprint collisions — the caller verifies each pair on the host
+    before expanding buckets to destinations. `amb` counts pairs where
+    MORE THAN ONE lane passed the full-fingerprint check (distinct
+    filters colliding on all 32 bits, ~2^-32 per pair): the kernel
+    keeps only the first such lane, so when amb > 0 the caller must
+    re-match the batch on a host path to preserve exactness (the
+    Router falls back to its trie; no real workload triggers this)."""
     b, max_levels = topics.ids.shape
     c = meta.plen.shape[0]
     tl = topics.lens[:, None]  # [B,1]
@@ -383,16 +610,55 @@ def match_ids_hash(
         )  # [B,C]
         h1 = (h1 ^ x) * jnp.uint32(_H1_MUL)
         fp = (fp ^ (x * jnp.uint32(_FP_XOR))) * jnp.uint32(_FP_MUL)
-    mask = jnp.uint32(slots.fp.shape[0] - 1)
-    idx = (
-        (h1[:, :, None] + jnp.arange(n_probes, dtype=jnp.uint32)) & mask
-    ).astype(jnp.int32)  # [B,C,P]
-    g_fp = slots.fp[idx]
-    g_bkt = slots.bucket[idx]
-    hit = elig[:, :, None] & (g_fp == fp[:, :, None]) & (g_bkt >= 0)
-    total = hit.sum(dtype=jnp.int32)
-    flat = jnp.nonzero(hit.reshape(-1), size=max_hits, fill_value=-1)[0]
-    valid = flat >= 0
-    ti = jnp.where(valid, flat // (c * n_probes), -1).astype(jnp.int32)
-    bi = jnp.where(valid, g_bkt.reshape(-1)[flat], -1).astype(jnp.int32)
-    return ti, bi, total
+    n_buckets = slots.probe.shape[0]
+    mask = jnp.uint32(n_buckets - 1)
+    b1 = h1 & mask
+    b2 = b1 ^ (((fp | jnp.uint32(1)) * jnp.uint32(_ALT_MUL)) & mask)
+    # phase 1: ONE u32 probe-word gather per candidate bucket; a pair
+    # is flagged iff either word has a byte equal to the key's probe
+    # byte — zero-byte detection on w XOR (byte replicated). The trick
+    # can flag a byte adjacent to a true zero byte (borrow chain) — a
+    # phase-1 false positive the phase-2 fingerprint check removes; it
+    # can never MISS a zero byte (no false negatives).
+    p8 = jnp.maximum(fp >> jnp.uint32(24), jnp.uint32(1))
+    rep = p8 * jnp.uint32(0x01010101)
+    w1 = slots.probe[b1.astype(jnp.int32)]  # [B,C]
+    w2 = slots.probe[b2.astype(jnp.int32)]
+
+    def has_byte(w):
+        x = w ^ rep
+        return ((x - jnp.uint32(0x01010101)) & ~x & jnp.uint32(0x80808080)) != 0
+
+    pairhit = elig & (has_byte(w1) | has_byte(w2))  # [B,C]
+    total = pairhit.sum(dtype=jnp.int32)  # exact flagged-pair count
+    pflat = jnp.nonzero(pairhit.reshape(-1), size=max_hits, fill_value=-1)[0]
+    pvalid = pflat >= 0
+    psafe = jnp.maximum(pflat, 0)
+    pb1 = b1.reshape(-1)[psafe]  # [H] on-chip gathers
+    pb2 = b2.reshape(-1)[psafe]
+    pfp = fp.reshape(-1)[psafe]
+    # phase 2: sparse verify — gather BOTH buckets' 2W lanes of full
+    # fingerprint for each flagged pair, pick the lane whose full
+    # fingerprint matches, then fetch the bucket id for ONLY that lane
+    # (empty and deleted slots hold fp=0, so a nonzero fp match
+    # implies a live slot; a true fp of 0 makes every empty lane
+    # "match" and lands in the amb -> host-fallback path)
+    lid = jnp.arange(2 * BUCKET_W, dtype=jnp.uint32)
+    lslot = (
+        jnp.where(lid < BUCKET_W, pb1[:, None], pb2[:, None])
+        * jnp.uint32(BUCKET_W)
+        + (lid & jnp.uint32(BUCKET_W - 1))
+    ).astype(jnp.int32)  # [H, 2W]
+    g_fp = slots.fp[lslot]
+    okl = (g_fp == pfp[:, None]) & pvalid[:, None]
+    nmatch = okl.sum(axis=1, dtype=jnp.int32)  # [H]
+    lane = jnp.argmax(okl, axis=1)
+    found = nmatch > 0
+    win_slot = lslot[jnp.arange(lslot.shape[0]), lane]
+    g_bkt = slots.bucket[win_slot]  # [H] — one sparse gather per pair
+    ok = found & (g_bkt >= 0)
+    topic_of_pair = (pflat // c).astype(jnp.int32)
+    ti = jnp.where(ok, topic_of_pair, -1).astype(jnp.int32)
+    bi = jnp.where(ok, g_bkt, -1).astype(jnp.int32)
+    amb = (nmatch > 1).sum(dtype=jnp.int32)
+    return ti, bi, total, amb

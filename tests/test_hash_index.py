@@ -40,12 +40,15 @@ def hash_match_rows(table, ix, topics, max_hits=4096):
     enc = M.encode_topics(table.vocab, topics, table.max_levels)
     meta = H.ClassMeta(*(np.array(a) for a in ix.meta))
     slots = H.SlotArrays(*(np.array(a) for a in ix.slots))
-    ti, bi, total = H.match_ids_hash(meta, slots, enc, max_hits=max_hits)
+    ti, bi, total, amb = H.match_ids_hash(meta, slots, enc, max_hits=max_hits)
     total = int(total)
+    assert int(amb) == 0, "full-fingerprint collision in a test table"
     assert total <= max_hits, "test tables must fit the bound"
     out = [set() for _ in topics]
     for t_idx, bid in zip(np.asarray(ti)[:total], np.asarray(bi)[:total]):
         t_idx, bid = int(t_idx), int(bid)
+        if bid < 0:  # phase-2 reject inside the kernel
+            continue
         if T.match(T.words(topics[t_idx]), ix.bucket_filter(bid)):
             out[t_idx] |= ix.bucket_rows(bid)
     return out
@@ -236,8 +239,8 @@ def test_hash_host_device_agreement():
     enc = M.encode_topics(table.vocab, ["dev/a/room/1"], table.max_levels)
     meta = H.ClassMeta(*(np.array(a) for a in ix.meta))
     slots = H.SlotArrays(*(np.array(a) for a in ix.slots))
-    ti, bi, total = H.match_ids_hash(meta, slots, enc, max_hits=64)
-    # both buckets must be found via their stored (h1, fp)
+    ti, bi, total, _amb = H.match_ids_hash(meta, slots, enc, max_hits=64)
+    # both pairs must be found via their stored (h1, fp)
     assert int(total) == 2
 
 
