@@ -68,8 +68,29 @@ class Node:
         from .cluster.node import ClusterBroker, ClusterNode
         from .models.retainer import PersistentRetainer
 
+        mesh = None
+        if cfg.get("parallel.enable"):
+            # multi-chip route matching: shard the cuckoo match table
+            # over a (dp, sub) jax mesh (SURVEY.md §7 stage 6). The
+            # same Router code runs on 1 chip when disabled.
+            import jax
+
+            from .parallel.mesh import make_mesh
+
+            n_dp = cfg.get("parallel.dp")
+            n_sub = cfg.get("parallel.sub") or None
+            n_dev = len(jax.devices())
+            if n_dev >= 2 and n_dev % n_dp == 0:
+                mesh = make_mesh(n_dp=n_dp, n_sub=n_sub)
+                log.info("parallel mesh: %s", dict(mesh.shape))
+            else:
+                log.warning(
+                    "parallel.enable set but %d device(s) don't fit "
+                    "dp=%d — running single-device", n_dev, n_dp,
+                )
         broker = ClusterBroker(
             shared_strategy=cfg.get("broker.shared_subscription_strategy"),
+            mesh=mesh,
         )
         broker.caps = MqttCaps(
             max_packet_size=cfg.get("mqtt.max_packet_size"),

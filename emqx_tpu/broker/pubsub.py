@@ -58,8 +58,9 @@ class Broker:
         max_levels: int = 16,
         shared_strategy: str = "random",
         hooks: Optional[Hooks] = None,
+        mesh=None,
     ):
-        self.router = Router(max_levels=max_levels)
+        self.router = Router(max_levels=max_levels, mesh=mesh)
         self.shared = SharedSubs(strategy=shared_strategy)
         self.retainer = Retainer()
         self.hooks = hooks or Hooks()

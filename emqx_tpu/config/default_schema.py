@@ -128,6 +128,18 @@ def broker_schema() -> Struct:
             ),
             "mqtt": Field(mqtt_struct()),
             "zones": Field(Map(mqtt_struct(sparse=True)), default={}),
+            # multi-chip scale-out: shard the route-match table over a
+            # (dp, sub) jax device mesh (SURVEY.md §2.5 / §7 stage 6).
+            # sub=0 means "all devices not used by dp".
+            "parallel": Field(
+                Struct(
+                    {
+                        "enable": Field(Bool(), default=False),
+                        "dp": Field(Int(min=1), default=1),
+                        "sub": Field(Int(min=0), default=0),
+                    }
+                )
+            ),
             "listeners": Field(
                 Struct(
                     {

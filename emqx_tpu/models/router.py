@@ -243,10 +243,12 @@ class Router:
     ) -> None:
         """With `mesh` (a jax.sharding.Mesh), the wildcard table lives
         SUB-SHARDED across the mesh and batched matching runs the
-        shard_map compaction kernel (parallel/sharded_match.py) — the
-        broker's production path on a pod. The pattern-class hash index
-        is a single-device structure, so the mesh path uses the dense
-        partitioned kernel instead (replication-as-partitioning)."""
+        PRODUCTION pattern-class cuckoo kernel with its slot table
+        bucket-partitioned over the 'sub' axis
+        (parallel/sharded_match.py make_sharded_hash_kernel) — the
+        broker's publish path on a pod; the dense partitioned kernel
+        serves only residual (unclassed) rows, exactly as on one
+        chip."""
         self.max_levels = max_levels
         # route-transition callbacks: fired when a (filter, dest) pair
         # first appears / finally disappears — the seam the cluster
@@ -274,8 +276,10 @@ class Router:
         if mesh is not None:
             from ..parallel.sharded_match import ShardedDeviceTable
 
-            self.index = None
-            self.device_table = ShardedDeviceTable(self.table, mesh)
+            self.index = ClassIndex(max_levels) if use_hash_index else None
+            self.device_table = ShardedDeviceTable(
+                self.table, mesh, index=self.index
+            )
         else:
             self.index = ClassIndex(max_levels) if use_hash_index else None
             self.device_table = DeviceTable(
@@ -457,7 +461,9 @@ class Router:
         out: List[List[str]] = [
             [t] if t in self._exact else [] for t in topics
         ]
-        if self.mesh is not None:
+        ix = self.index
+        if self.mesh is not None and ix is None:
+            # dense-only mesh path (use_hash_index=False)
             ti, ri, = self.device_table.match_ids(enc)
             b = len(topics)
             for t_idx, row in zip(ti, ri):
@@ -467,21 +473,26 @@ class Router:
                 for i, t in enumerate(topics):
                     out[i].extend(self._deep_trie.match(topic_mod.words(t)))
             return out
-        ix = self.index
         if ix is not None:
             host_fallback = False
             if len(ix):
-                meta, slots = self.device_table.hash_state()
-                mh = max(1024, _next_pow2(2 * len(topics)))
-                ti, bi, total, amb = hash_ops.match_ids_hash(
-                    meta, slots, enc, max_hits=mh
-                )
-                total = int(total)
-                if total > mh:
-                    ti, bi, _t, amb = hash_ops.match_ids_hash(
-                        meta, slots, enc, max_hits=_next_pow2(total)
+                if self.mesh is not None:
+                    ti, bi, amb = self.device_table.match_hash(enc)
+                else:
+                    meta, slots = self.device_table.hash_state()
+                    mh = max(1024, _next_pow2(2 * len(topics)))
+                    ti, bi, total, amb = hash_ops.match_ids_hash(
+                        meta, slots, enc, max_hits=mh
                     )
-                if int(amb):
+                    total = int(total)
+                    if total > mh:
+                        ti, bi, _t, amb = hash_ops.match_ids_hash(
+                            meta, slots, enc, max_hits=_next_pow2(total)
+                        )
+                    ti = np.asarray(ti)[:total]
+                    bi = np.asarray(bi)[:total]
+                    amb = int(amb)
+                if amb:
                     # >1 lane of one pair passed the full-fingerprint
                     # check: distinct filters colliding on all 32 bits
                     # (~2^-32/pair). The kernel kept one arbitrarily,
@@ -489,11 +500,11 @@ class Router:
                     # and covers residual rows too.
                     host_fallback = True
                 else:
-                    ti, bi = np.asarray(ti), np.asarray(bi)
                     twords: List = [None] * len(topics)
-                    for t_idx, bid in zip(ti[:total], bi[:total]):
+                    for t_idx, bid in zip(ti, bi):
                         t_idx, bid = int(t_idx), int(bid)
-                        if bid < 0:  # phase-2 reject inside the kernel
+                        if bid < 0 or t_idx >= len(topics):
+                            # phase-2 reject / dp-padding topic
                             continue
                         if twords[t_idx] is None:
                             twords[t_idx] = topic_mod.words(topics[t_idx])
@@ -506,13 +517,21 @@ class Router:
                     for row in self._trie.match(topic_mod.words(t)):
                         out[i].append(self._row_filter[row])
             elif ix.residual_rows:
-                filters = self.device_table.residual_filters()
-                ti, ri, total = self._escalating_pairs(
-                    lambda mh: match_ops.match_ids(filters, enc, max_hits=mh),
-                    max(1024, _next_pow2(2 * len(topics))),
-                )
-                for t_idx, row in zip(ti[:total], ri[:total]):
-                    out[int(t_idx)].append(self._row_filter[int(row)])
+                if self.mesh is not None:
+                    ti, ri = self.device_table.match_ids(enc, residual=True)
+                    for t_idx, row in zip(ti, ri):
+                        if t_idx < len(topics):
+                            out[int(t_idx)].append(self._row_filter[int(row)])
+                else:
+                    filters = self.device_table.residual_filters()
+                    ti, ri, total = self._escalating_pairs(
+                        lambda mh: match_ops.match_ids(
+                            filters, enc, max_hits=mh
+                        ),
+                        max(1024, _next_pow2(2 * len(topics))),
+                    )
+                    for t_idx, row in zip(ti[:total], ri[:total]):
+                        out[int(t_idx)].append(self._row_filter[int(row)])
         else:
             filters = self.device_table.filters()
             ti, ri, total = self._escalating_pairs(

@@ -171,27 +171,233 @@ def make_match_ids_kernel(mesh: Mesh, max_hits_per_block: int):
     return match_ids
 
 
+def make_sharded_hash_kernel(mesh: Mesh, max_hits_per_block: int):
+    """The PRODUCTION pattern-class cuckoo kernel, bucket-partitioned
+    over the 'sub' axis (VERDICT r2 #2: the mesh must run the 67x hash
+    path, not the dense demo). Each shard owns a contiguous bucket
+    range of the global table; it probes only the candidate buckets
+    that fall inside its slice, so a pair whose b1/b2 land on
+    different shards is served by both — each emits its own candidate
+    with the GLOBAL bucket id, and the host union (plus its oracle
+    verify) merges them. Meta and the per-(topic,class) hash mixing
+    are replicated (B×C u32 ops — cheap); the O(table) state is what
+    partitions, exactly the HBM-capacity reason to go multi-chip.
+
+    Returns kernel(meta, slots, topics) ->
+    (ti [dp, sub*mh], bi [dp, sub*mh], totals [dp, sub], amb [1,1]):
+    per-block flagged-pair counts for escalation, per-shard ambiguity
+    summed over the mesh (see ops.hash_index.match_ids_hash)."""
+    from ..ops.hash_index import BUCKET_W, _ALT_MUL, _FP_CLS, _FP_MUL
+    from ..ops.hash_index import _FP_SEED, _FP_XOR, _H1_CLS, _H1_MUL, _H1_SEED
+
+    mh = max_hits_per_block
+    meta_specs = (P(None),) * 5
+    slot_specs = (P(SUB_AXIS), P(SUB_AXIS), P(SUB_AXIS))
+    t_specs = (P(DP_AXIS, None), P(DP_AXIS), P(DP_AXIS))
+
+    def _local(plen, has_hash, root_wild, plus, active, sfp, sbkt, probe,
+               ids, lens, dollar):
+        dp_i = jax.lax.axis_index(DP_AXIS).astype(jnp.int32)
+        sub_i = jax.lax.axis_index(SUB_AXIS).astype(jnp.int32)
+        n_sub = jax.lax.axis_size(SUB_AXIS)
+        b_loc, max_levels = ids.shape
+        c = plen.shape[0]
+        nb_loc = probe.shape[0]
+        nb_global = nb_loc * n_sub
+        tl = lens[:, None]
+        pl = plen[None, :]
+        len_ok = jnp.where(has_hash[None, :], tl >= pl, tl == pl)
+        elig = len_ok & active[None, :] & ~(
+            dollar[:, None] & root_wild[None, :]
+        )
+        cids = jnp.arange(c, dtype=jnp.uint32)
+        h1 = jnp.broadcast_to(
+            jnp.uint32(_H1_SEED) ^ (cids * jnp.uint32(_H1_CLS)), (b_loc, c)
+        )
+        fp = jnp.broadcast_to(
+            jnp.uint32(_FP_SEED) + (cids * jnp.uint32(_FP_CLS)), (b_loc, c)
+        )
+        for i in range(max_levels):
+            lit = (i < plen) & (((plus >> i) & 1) == 0)
+            x = jnp.where(
+                lit[None, :],
+                ids[:, i : i + 1].astype(jnp.uint32) + 1,
+                jnp.uint32(0),
+            )
+            h1 = (h1 ^ x) * jnp.uint32(_H1_MUL)
+            fp = (fp ^ (x * jnp.uint32(_FP_XOR))) * jnp.uint32(_FP_MUL)
+        mask = jnp.uint32(nb_global - 1)
+        b1 = h1 & mask
+        b2 = b1 ^ (((fp | jnp.uint32(1)) * jnp.uint32(_ALT_MUL)) & mask)
+        off = (sub_i * nb_loc).astype(jnp.int32)
+        p8 = jnp.maximum(fp >> jnp.uint32(24), jnp.uint32(1))
+        rep = p8 * jnp.uint32(0x01010101)
+
+        def local_hit(b):
+            lb = b.astype(jnp.int32) - off
+            inside = (lb >= 0) & (lb < nb_loc)
+            w = probe[jnp.clip(lb, 0, nb_loc - 1)]
+            x = w ^ rep
+            hz = ((x - jnp.uint32(0x01010101)) & ~x
+                  & jnp.uint32(0x80808080)) != 0
+            return inside & hz, lb
+
+        hit1, l1 = local_hit(b1)
+        hit2, l2 = local_hit(b2)
+        pairhit = elig & (hit1 | hit2)
+        total = pairhit.sum(dtype=jnp.int32)
+        pflat = jnp.nonzero(
+            pairhit.reshape(-1), size=mh, fill_value=-1
+        )[0]
+        pvalid = pflat >= 0
+        psafe = jnp.maximum(pflat, 0)
+        ph1 = hit1.reshape(-1)[psafe]
+        ph2 = hit2.reshape(-1)[psafe]
+        pl1 = l1.reshape(-1)[psafe]
+        pl2 = l2.reshape(-1)[psafe]
+        pfp = fp.reshape(-1)[psafe]
+        lid = jnp.arange(2 * BUCKET_W, dtype=jnp.int32)
+        use1 = lid < BUCKET_W
+        lvalid = jnp.where(use1[None, :], ph1[:, None], ph2[:, None])
+        lslot = (
+            jnp.where(use1[None, :], pl1[:, None], pl2[:, None]) * BUCKET_W
+            + (lid % BUCKET_W)
+        )
+        lslot = jnp.clip(lslot, 0, sfp.shape[0] - 1)
+        g_fp = sfp[lslot]
+        okl = lvalid & (g_fp == pfp[:, None]) & pvalid[:, None]
+        nmatch = okl.sum(axis=1, dtype=jnp.int32)
+        lane = jnp.argmax(okl, axis=1)
+        found = nmatch > 0
+        win = lslot[jnp.arange(lslot.shape[0]), lane]
+        g_bkt = sbkt[win]
+        ok = found & (g_bkt >= 0)
+        ti = jnp.where(
+            ok, psafe // c + dp_i * b_loc, -1
+        ).astype(jnp.int32)
+        bi = jnp.where(ok, g_bkt, -1).astype(jnp.int32)
+        amb = jax.lax.psum(
+            jax.lax.psum((nmatch > 1).sum(dtype=jnp.int32), SUB_AXIS),
+            DP_AXIS,
+        )
+        return (
+            ti[None, :], bi[None, :], total.reshape(1, 1),
+            amb.reshape(1, 1),
+        )
+
+    @jax.jit
+    def kernel(meta, slots, topics):
+        return jax.shard_map(
+            _local,
+            mesh=mesh,
+            in_specs=meta_specs + slot_specs + t_specs,
+            out_specs=(
+                P(DP_AXIS, SUB_AXIS),
+                P(DP_AXIS, SUB_AXIS),
+                P(DP_AXIS, SUB_AXIS),
+                P(None, None),
+            ),
+        )(
+            meta.plen, meta.has_hash, meta.root_wild, meta.plus, meta.active,
+            slots.fp, slots.bucket, slots.probe,
+            topics.ids, topics.lens, topics.dollar,
+        )
+
+    return kernel
+
+
+def make_slot_delta_kernel(mesh: Mesh):
+    """shard_map scatter for incremental cuckoo-slot sync: every shard
+    receives the same (global slot idx, fp, bucket, probe word) delta
+    batches and applies the slots/probe words it owns (mode='drop'
+    discards out-of-slice rows) — one write stream, applied
+    shard-locally, the same mria-rlog shape as the filter-row delta."""
+    from ..ops.hash_index import BUCKET_W
+
+    def _local(sfp, sbkt, probe, idx, fpv, bktv, pwv):
+        n_loc = sfp.shape[0]
+        nb_loc = probe.shape[0]
+        sub_i = jax.lax.axis_index(SUB_AXIS).astype(jnp.int32)
+        s_off = sub_i * n_loc
+        b_off = sub_i * nb_loc
+
+        def step(carry, xs):
+            cfp, cbkt, cpw = carry
+            i, f, b, pw = xs
+            # clamp negatives to one-past-end: jnp negative indices WRAP
+            # (they'd corrupt the tail of lower shards); only >= n is
+            # dropped by mode='drop' (same guard as _apply_delta_local)
+            ls = i - s_off
+            ls = jnp.where((ls < 0) | (ls >= n_loc), n_loc, ls)
+            lb = i // BUCKET_W - b_off
+            lb = jnp.where((lb < 0) | (lb >= nb_loc), nb_loc, lb)
+            return (
+                (
+                    cfp.at[ls].set(f, mode="drop"),
+                    cbkt.at[ls].set(b, mode="drop"),
+                    cpw.at[lb].set(pw, mode="drop"),
+                ),
+                None,
+            )
+
+        (sfp, sbkt, probe), _ = jax.lax.scan(
+            step, (sfp, sbkt, probe), (idx, fpv, bktv, pwv)
+        )
+        return sfp, sbkt, probe
+
+    specs = (P(SUB_AXIS), P(SUB_AXIS), P(SUB_AXIS))
+    dspecs = ((P(None, None),) * 4)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+    def apply(sfp, sbkt, probe, idx, fpv, bktv, pwv):
+        return jax.shard_map(
+            _local,
+            mesh=mesh,
+            in_specs=specs + dspecs,
+            out_specs=specs,
+        )(sfp, sbkt, probe, idx, fpv, bktv, pwv)
+
+    return apply
+
+
 class ShardedDeviceTable:
     """Mesh-resident mirror of a FilterTable: rows sub-sharded across
     the mesh, topics dp-sharded, batched delta sync through the
     shard_map scatter. The multi-device counterpart of
     models.router.DeviceTable behind the same sync()/match surface —
     replication-as-partitioning instead of the reference's full
-    per-node table replica (emqx_router.erl:133-162)."""
+    per-node table replica (emqx_router.erl:133-162). With `index`,
+    the pattern-class cuckoo table is ALSO mesh-resident (buckets
+    sub-sharded) and match_hash runs the production kernel; the dense
+    kernel then serves only residual (unclassed) rows."""
 
     DELTA_BATCH = 1024  # rows per apply_delta call (syncer batch size)
 
-    def __init__(self, table, mesh: Mesh, max_hits_per_block: int = 2048):
+    def __init__(
+        self,
+        table,
+        mesh: Mesh,
+        max_hits_per_block: int = 2048,
+        index=None,
+    ):
         from . import mesh as mesh_mod
 
         self.table = table
         self.mesh = mesh
+        self.index = index
         self._mesh_mod = mesh_mod
         self._dev: Optional[EncodedFilters] = None
         self._synced_capacity = 0
         _mc, _mp, self._apply_delta = make_sharded_kernels(mesh)
         self._match_ids_cache: dict = {}
+        self._hash_cache: dict = {}
         self.default_mh = max_hits_per_block
+        self._dev_meta = None
+        self._dev_slots = None
+        self._dev_residual = None
+        self._apply_slot_delta = (
+            make_slot_delta_kernel(mesh) if index is not None else None
+        )
 
     def _match_kernel(self, mh: int):
         k = self._match_ids_cache.get(mh)
@@ -200,6 +406,72 @@ class ShardedDeviceTable:
             self._match_ids_cache[mh] = k
         return k
 
+    def _hash_kernel(self, mh: int):
+        k = self._hash_cache.get(mh)
+        if k is None:
+            k = make_sharded_hash_kernel(self.mesh, mh)
+            self._hash_cache[mh] = k
+        return k
+
+    def _put_repl(self, a):
+        return jax.device_put(a, NamedSharding(self.mesh, P()))
+
+    def _put_sub(self, a):
+        return jax.device_put(a, NamedSharding(self.mesh, P(SUB_AXIS)))
+
+    def _sync_index(self) -> None:
+        import numpy as np
+
+        from ..ops.hash_index import BUCKET_W, ClassMeta, SlotArrays
+
+        ix = self.index
+        assert ix is not None
+        n_sub = self.mesh.shape[SUB_AXIS]
+        assert ix.n_buckets % n_sub == 0, (ix.n_buckets, n_sub)
+        if ix.meta_dirty or self._dev_meta is None:
+            self._dev_meta = ClassMeta(
+                *(self._put_repl(np.array(a)) for a in ix.packed_meta())
+            )
+            ix.meta_dirty = False
+        if ix.rebuilt or self._dev_slots is None:
+            ix.dirty_slots.clear()
+            self._dev_slots = SlotArrays(
+                self._put_sub(np.array(ix.slots.fp)),
+                self._put_sub(np.array(ix.slots.bucket)),
+                self._put_sub(np.array(ix.slots.probe)),
+            )
+            ix.rebuilt = False
+        elif ix.dirty_slots:
+            dirty = np.fromiter(ix.dirty_slots, np.int32, len(ix.dirty_slots))
+            dirty.sort()
+            ix.dirty_slots.clear()
+            total = len(dirty)
+            k = self.DELTA_BATCH
+            n_b = 1 << max(0, -(-total // k) - 1).bit_length()
+            idx = np.full(n_b * k, dirty[-1], np.int32)
+            idx[:total] = dirty
+            shape2 = (n_b, k)
+            out = self._apply_slot_delta(
+                self._dev_slots.fp,
+                self._dev_slots.bucket,
+                self._dev_slots.probe,
+                jnp.asarray(idx.reshape(shape2)),
+                jnp.asarray(ix.slots.fp[idx].reshape(shape2)),
+                jnp.asarray(ix.slots.bucket[idx].reshape(shape2)),
+                jnp.asarray(
+                    ix.slots.probe[idx // BUCKET_W].reshape(shape2)
+                ),
+            )
+            self._dev_slots = SlotArrays(*out)
+        if ix.residual_dirty or self._dev_residual is None or (
+            self._dev_residual.shape[0] != self.table.capacity
+        ):
+            mask = np.zeros(self.table.capacity, bool)
+            if ix.residual_rows:
+                mask[list(ix.residual_rows)] = True
+            self._dev_residual = self._put_sub(mask)
+            ix.residual_dirty = False
+
     def sync(self) -> int:
         t = self.table
         if self._dev is None or t.grew or t.capacity != self._synced_capacity:
@@ -207,9 +479,13 @@ class ShardedDeviceTable:
             t.drain_dirty()
             self._dev = self._mesh_mod.put_filters(t.snapshot(), self.mesh)
             self._synced_capacity = t.capacity
+            if self.index is not None:
+                self._sync_index()
             return n
         dirty = t.drain_dirty()  # ndarray: row id 0 alone is falsy —
         if len(dirty) == 0:      # test LENGTH, never truthiness
+            if self.index is not None:
+                self._sync_index()
             return 0
         import numpy as np
 
@@ -231,19 +507,27 @@ class ShardedDeviceTable:
             jnp.asarray(t.root_wild[idx].reshape(shape2)),
             jnp.asarray(t.active[idx].reshape(shape2)),
         )
+        if self.index is not None:
+            self._sync_index()
         return total
 
-    def match_ids(self, enc: EncodedTopics):
-        """All (topic, row) hit pairs for an encoded topic batch.
-        Returns (ti 1d, ri 1d) host arrays of equal length (valid pairs
-        only), escalating per-block capacity on overflow."""
+    def match_ids(self, enc: EncodedTopics, residual: bool = False):
+        """All (topic, row) hit pairs for an encoded topic batch via
+        the dense kernel. With residual=True the active mask narrows
+        to the class index's residual rows (the unclassed fallback).
+        Returns (ti 1d, ri 1d) host arrays of equal length (valid
+        pairs only), escalating per-block capacity on overflow."""
         import numpy as np
 
         assert self._dev is not None, "sync() before matching"
+        dev = self._dev
+        if residual:
+            assert self._dev_residual is not None
+            dev = dev._replace(active=self._dev_residual)
         t_dev = self._mesh_mod.put_topics(enc, self.mesh)
         mh = self.default_mh
         while True:
-            ti, ri, totals = self._match_kernel(mh)(self._dev, t_dev)
+            ti, ri, totals = self._match_kernel(mh)(dev, t_dev)
             totals = np.asarray(totals)
             if int(totals.max(initial=0)) <= mh:
                 break
@@ -252,3 +536,28 @@ class ShardedDeviceTable:
         ri = np.asarray(ri).reshape(-1)
         keep = ti >= 0
         return ti[keep], ri[keep]
+
+    def match_hash(self, enc: EncodedTopics):
+        """(topic, bucket) candidates via the mesh-sharded production
+        hash kernel. Returns (ti 1d, bi 1d, amb int): global topic
+        indices (may include dp-padding rows — callers drop
+        t_idx >= batch), global bucket ids, and the mesh-wide
+        ambiguity count (amb > 0 -> caller re-matches on a host path,
+        see ops.hash_index.match_ids_hash)."""
+        import numpy as np
+
+        assert self._dev_slots is not None, "sync() before matching"
+        t_dev = self._mesh_mod.put_topics(enc, self.mesh)
+        mh = self.default_mh
+        while True:
+            ti, bi, totals, amb = self._hash_kernel(mh)(
+                self._dev_meta, self._dev_slots, t_dev
+            )
+            totals = np.asarray(totals)
+            if int(totals.max(initial=0)) <= mh:
+                break
+            mh = max(mh * 2, 1 << int(totals.max()).bit_length())
+        ti = np.asarray(ti).reshape(-1)
+        bi = np.asarray(bi).reshape(-1)
+        keep = ti >= 0
+        return ti[keep], bi[keep], int(np.asarray(amb).reshape(-1)[0])

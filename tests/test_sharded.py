@@ -168,3 +168,72 @@ def test_mesh_broker_publish_batch(mesh8):
     assert counts == [2] * 30  # per-room subscriber + watcher
     assert all(len(outs[f"c{i}"]) == 1 for i in range(30))
     assert len(outs["watcher"]) == 30
+
+
+# --- the PRODUCTION hash kernel on the mesh (VERDICT r2 #2) -----------
+
+
+def oracle_rows(table, rows_of, topics):
+    """Row sets straight from the pure oracle."""
+    import emqx_tpu.ops.topic as T
+
+    out = []
+    for t in topics:
+        tw = T.words(t)
+        out.append(
+            {r for f, r in rows_of.items() if T.match(tw, T.words(f))}
+        )
+    return out
+
+
+def test_mesh_hash_kernel_matches_oracle_with_churn(mesh8):
+    """Router(mesh=...) must run the cuckoo hash kernel (not the dense
+    demo), stay oracle-exact through add/delete churn, and keep the
+    dense kernel only for residual rows."""
+    import random
+
+    from emqx_tpu.models.router import Router
+    from emqx_tpu.ops import topic as T
+
+    rng = random.Random(31)
+    r = Router(max_levels=6, mesh=mesh8)
+    assert r.index is not None, "mesh Router must carry the class index"
+
+    live = {}
+    for i in range(300):
+        f = rng.choice(
+            [f"s/{i}/+", f"s/{i}/#", f"+/x/{i}", f"s/{i}/t/{i % 7}", "#"]
+        )
+        r.add_route(f, f"d{i}")
+        live.setdefault(f, set()).add(f"d{i}")
+
+    topics = [f"s/{rng.randrange(320)}/t/{rng.randrange(9)}" for _ in range(40)]
+    topics += [f"q/x/{rng.randrange(320)}" for _ in range(10)]
+    topics += ["$SYS/broker", "s/5/t"]
+
+    def check():
+        got = r.match_batch(topics)
+        routes = r.routes()
+        for t, g in zip(topics, got):
+            tw = T.words(t)
+            want = {d for (f, d) in routes if T.match(tw, T.words(f))}
+            assert g == want, (t, g, want)
+
+    check()
+
+    # churn: delete a third, add fresh filters, re-check (exercises the
+    # shard_map slot-delta scatter, not just the full upload)
+    victims = rng.sample(sorted(live), len(live) // 3)
+    for f in victims:
+        for d in sorted(live[f]):
+            r.delete_route(f, d)
+        del live[f]
+    for i in range(40):
+        f = f"n/{i}/+"
+        r.add_route(f, f"nd{i}")
+    topics.extend(f"n/{i}/z" for i in range(0, 40, 7))
+    check()
+
+    # the hash index carries the classed rows; residuals only overflow
+    assert len(r.index) > 0
+    assert not r.index.residual_rows
