@@ -331,21 +331,60 @@ def bench_1m(jax, jnp, floor, details):
 # config #1 — exact-topic path (host hash, no device)
 
 
-def bench_exact(details):
+def bench_exact(jax, jnp, floor, details):
     from emqx_tpu.models.router import Router
+    from emqx_tpu.ops import hash_index as H
     from emqx_tpu.ops import native_baseline as NB
+    from emqx_tpu.ops.hash_index import match_ids_hash
 
-    N, B = 10_000, 1024
+    N, B, K = 10_000, 1024, 64
     r = Router(max_levels=8)
     topics = [f"site/{i}/up" for i in range(N)]
     for i, t in enumerate(topics):
         r.add_route(t, f"s{i}")
+
+    # device leg: exact topics ride the hash table as wildcard-free
+    # classes (VERDICT r2 #3), so the batched publish path resolves
+    # them in the SAME kernel dispatch as wildcards — measured here
+    # through the production Router's own index state
+    r.device_table.sync()
+    meta = H.ClassMeta(
+        *(jnp.asarray(np.array(a)) for a in r.index.packed_meta())
+    )
+    slots = H.SlotArrays(*(jnp.asarray(np.array(a)) for a in r.index.slots))
+    lk = r.table.vocab.lookup
+    site_id, up_id = int(lk("site")), int(lk("up"))
+    d_map = jnp.asarray(np.array([lk(str(i)) for i in range(N)], np.int32))
+
+    def make_gen(k_, b_):
+        def gen(key, aux):
+            (dmap,) = aux
+            d = jax.random.randint(key, (k_, b_), 0, N)
+            ids = jnp.zeros((k_, b_, 8), jnp.int32)
+            ids = ids.at[..., 0].set(site_id)
+            ids = ids.at[..., 1].set(dmap[d])
+            ids = ids.at[..., 2].set(up_id)
+            lens = jnp.full((k_, b_), 3, jnp.int32)
+            return ids, lens, jnp.zeros((k_, b_), bool)
+
+        return gen
+
+    per_batch, total, used_k, sat = measure_scan(
+        jax, jnp, match_ids_hash, 2048, make_gen, K, B,
+        (meta, slots, (d_map,)), floor, label="#1",
+    )
+    med = float(np.median(per_batch))
+    dev_rate = B / med
+    n_topics = len(per_batch) * used_k * B
+    assert total >= n_topics, f"exact config lost matches: {total}/{n_topics}"
+
+    # host cut-through leg (single-publish path: dict hit + dest walk)
     rng = np.random.default_rng(3)
     probe = [topics[i] for i in rng.integers(0, N, size=B)]
     t0 = time.time()
     hits = sum(len(r.match_routes(t)) for t in probe)
     dt = time.time() - t0
-    rate = B / dt
+    host_rate = B / dt
 
     ts = NB.NativeTrieSearch()
     ts.add_batch(topics, range(N))
@@ -354,12 +393,17 @@ def bench_exact(details):
     nb_hits, _, lats = ts.match_batch(packed, want_latencies=True)
     nb_rate = B / (time.time() - t0)
     assert hits == nb_hits == B
-    log(f"#1 exact 10K: host hash {rate:,.0f} topics/s, "
+    log(f"#1 exact 10K: device kernel {dev_rate:,.0f} topics/s "
+        f"({med * 1e3:.3f} ms/batch), host hash {host_rate:,.0f} topics/s, "
         f"native ordered-set {nb_rate:,.0f} topics/s")
     details["config1_exact_10K"] = {
-        "host_topics_per_sec": round(rate, 1),
+        "tpu_topics_per_sec": round(dev_rate, 1),
+        "tpu_ms_per_batch_p50": round(med * 1e3, 4),
+        "host_topics_per_sec": round(host_rate, 1),
         "native_topics_per_sec": round(nb_rate, 1),
         "native_us_per_topic_p99": round(pctl(lats, 99) / 1e3, 2),
+        "vs_baseline": round(dev_rate / nb_rate, 2),
+        **({"floor_saturated": True} if sat else {}),
     }
     ts.close()
 
@@ -762,11 +806,18 @@ def bench_fanout(details):
         for p in pkts:
             nbytes[0] += len(frame.serialize(p, 4))
 
+    def sink_bytes(data):
+        # what a mountpoint-free Connection does: write the shared
+        # pre-serialized buffer (server.Connection._send_bytes)
+        nbytes[0] += len(data)
+
     for i in range(NS):
         s, _ = b.open_session(f"f{i}", True)
         b.subscribe(s, "fan/wide/#", SubOpts(qos=0))
         s.outgoing_sink = sink
-    ROUNDS = 5
+        s.outgoing_sink_bytes = sink_bytes
+    ROUNDS = 6
+    b.publish(Message(topic="fan/wide/warm", payload=b"x" * 64))  # plan build
     t0 = time.time()
     total = 0
     for i in range(ROUNDS):
@@ -798,7 +849,7 @@ def main():
     rate, nb_rate, table, index, meta, slots, _filters = bench_1m(
         jax, jnp, floor, details
     )
-    bench_exact(details)
+    bench_exact(jax, jnp, floor, details)
     bench_shared(jax, jnp, floor, details, (table, index, meta, slots))
     bench_rules(jax, jnp, floor, details)
     bench_insert(details)

@@ -74,6 +74,12 @@ class Hooks:
         chain = self._hooks.get(name, [])
         self._hooks[name] = [e for e in chain if e[2] is not cb]
 
+    def has(self, name: str) -> bool:
+        """True when any callback is registered (lets hot loops hoist
+        the per-delivery chain walk; emqx runs chains unconditionally
+        but BEAM call overhead is not Python call overhead)."""
+        return bool(self._hooks.get(name))
+
     def run(self, name: str, *args: Any) -> bool:
         """Run the chain; returns False if a callback returned STOP."""
         for _, _, cb in self._hooks.get(name, ()):

@@ -27,6 +27,7 @@ from ..models.retainer import Retainer
 from ..models.router import Router
 from ..models.shared_sub import SharedSubs
 from ..ops import topic as topic_mod
+from . import frame
 from .hooks import Hooks
 from .message import Message
 from .metrics import Metrics, Stats
@@ -79,6 +80,11 @@ class Broker:
         self.on_exclusive_released = None  # fn(topic, client)
         # live listeners (Server instances register on start)
         self.servers: list = []
+        # fanout plans: matched-filter-set -> prebuilt deduped
+        # delivery lists (the ?SUBSCRIBER-bag precomputation,
+        # emqx_broker.erl:126-140) — invalidated wholesale on any
+        # session/subscription mutation
+        self._fanout_cache: Dict[tuple, tuple] = {}
         # (filter, client) subopts — mirror of ?SUBOPTION
         self.suboptions: Dict[Tuple[str, str], SubOpts] = {}
         # durable-session manager (emqx_persistent_session_ds seam);
@@ -108,6 +114,7 @@ class Broker:
         ):
             # an existing LIVE session under this id must be torn down
             # first or its routes leak and deliveries double up
+            self._fanout_cache.clear()
             prev = self.sessions.get(client_id)
             if prev is not None and not self._is_durable(prev):
                 self.close_session(prev, discard=True)
@@ -118,6 +125,7 @@ class Broker:
                 "session.resumed" if present else "session.created", client_id
             )
             return session, present
+        self._fanout_cache.clear()
         old = self.sessions.get(client_id)
         if clean_start or old is None or old.expired():
             if old is not None:
@@ -138,6 +146,7 @@ class Broker:
         # (no duplicate terminated/discarded hooks)
         if self.sessions.get(session.client_id) is not session:
             return
+        self._fanout_cache.clear()
         # sever the transport (admin kick / takeover); harmless if the
         # teardown originated from the connection itself
         closer = getattr(session, "closer", None)
@@ -213,6 +222,7 @@ class Broker:
         if self.durable is not None and self._is_durable(session) and group is None:
             existed = self.durable.subscribe(session, flt, opts)
             self.suboptions[(flt, session.client_id)] = opts
+            self._fanout_cache.clear()
             self.stats.set("subscriptions.count", len(self.suboptions))
             self.hooks.run("session.subscribed", session.client_id, flt, opts)
             if opts.retain_handling == 2 or (opts.retain_handling == 1 and existed):
@@ -221,6 +231,7 @@ class Broker:
         existed = flt in session.subscriptions
         session.subscriptions[flt] = opts
         self.suboptions[(flt, session.client_id)] = opts
+        self._fanout_cache.clear()
         if group is not None:
             if self.shared.subscribe(group, real, session.client_id):
                 self.router.add_route(real, (GROUP_DEST, group, real))
@@ -240,6 +251,7 @@ class Broker:
             flt = flt[len(EXCLUSIVE_PREFIX):]
         if flt not in session.subscriptions:
             return False
+        self._fanout_cache.clear()
         self._release_exclusive(session.client_id, flt)
         # shared subs always live in the live router, even for durable
         # sessions (the durable subscribe branch requires group None)
@@ -326,6 +338,27 @@ class Broker:
         self._account_dispatch(msg, n + nd)
         return n + nd
 
+    def _shared_group_dests(self, pairs: Pairs):
+        """(group, real) legs in a match result. Cached per filter-set:
+        scanning a 100k-dest fan for the (rare) group tuples on every
+        publish cost more than the whole delivery loop."""
+        key = ("$shared", tuple(flt for flt, _ in pairs))
+        groups = self._fanout_cache.get(key)
+        if groups is None:
+            groups = []
+            for _flt, dests in pairs:
+                for dest in dests:
+                    if (
+                        isinstance(dest, tuple)
+                        and dest
+                        and dest[0] == GROUP_DEST
+                    ):
+                        groups.append((dest[1], dest[2]))
+            if len(self._fanout_cache) >= 4096:
+                self._fanout_cache.clear()
+            self._fanout_cache[key] = groups
+        return groups
+
     def _account_dispatch(self, msg: Message, n: int) -> None:
         if n == 0:
             # a durable-only audience isn't a drop: the persist gate
@@ -335,81 +368,195 @@ class Broker:
                 self.hooks.run("message.dropped", msg, "no_subscribers")
 
     def _dispatch_shared_local(self, msg: Message, pairs: Pairs) -> int:
+        # snapshot via the cached plan: delivery hooks/sinks below may
+        # (un)subscribe mid-iteration, which clears the cache but
+        # leaves this list intact
         n = 0
-        for _flt, dests in pairs:
-            # snapshot: dests is the Router's live refcount dict and the
-            # delivery hooks/sinks below may (un)subscribe mid-iteration
-            for dest in tuple(dests):
-                if isinstance(dest, tuple) and dest and dest[0] == GROUP_DEST:
-                    _tag, group, real = dest
-                    # redispatch loop: a stale member (session gone)
-                    # must not eat the message — re-elect excluding it
-                    # (emqx_shared_sub:dispatch/4 retry + redispatch,
-                    # emqx_shared_sub.erl:149-163,217-244)
-                    tried: tuple = ()
-                    while True:
-                        member = self.shared.pick(
-                            group,
-                            real,
-                            msg.topic,
-                            from_client=msg.from_client,
-                            exclude=tried,
-                        )
-                        if member is None:
-                            break
-                        got = self._deliver_to(
-                            member, f"$share/{group}/{real}", msg
-                        )
-                        if got:
-                            self.metrics.inc("messages.delivered", got)
-                            n += got
-                            break
-                        tried = tried + (member,)
+        for group, real in self._shared_group_dests(pairs):
+            # redispatch loop: a stale member (session gone) must not
+            # eat the message — re-elect excluding it
+            # (emqx_shared_sub:dispatch/4 retry + redispatch,
+            # emqx_shared_sub.erl:149-163,217-244)
+            tried: tuple = ()
+            while True:
+                member = self.shared.pick(
+                    group,
+                    real,
+                    msg.topic,
+                    from_client=msg.from_client,
+                    exclude=tried,
+                )
+                if member is None:
+                    break
+                got = self._deliver_to(member, f"$share/{group}/{real}", msg)
+                if got:
+                    self.metrics.inc("messages.delivered", got)
+                    n += got
+                    break
+                tried = tried + (member,)
         return n
 
     def _dispatch_direct(self, msg: Message, pairs: Pairs) -> int:
         """Dedup direct destinations across matched filters (aggre/1,
         emqx_broker.erl:408-424): one delivery per client, max granted
-        QoS wins. SubOpts come from a direct (filter, client) lookup —
-        the ?SUBOPTION key read of emqx_broker.erl:726-760 — never a
-        scan of the client's subscription list."""
+        QoS wins — then execute a cached fanout PLAN. Identical
+        filter-sets share one plan (keyed by matched filters, not the
+        topic: a wildcard's whole topic space reuses it), rebuilt lazily
+        after any session/subscription mutation — the precomputed
+        ?SUBSCRIBER-bag read of emqx_broker.erl:726-760 rather than a
+        per-publish suboption scan."""
+        key = tuple(flt for flt, _ in pairs)
+        plan = self._fanout_cache.get(key)
+        if plan is None:
+            plan = self._build_fanout_plan(pairs)
+            if len(self._fanout_cache) >= 4096:
+                self._fanout_cache.clear()
+            self._fanout_cache[key] = plan
+        return self._fanout(msg, plan)
+
+    def _build_fanout_plan(self, pairs: Pairs) -> tuple:
+        """(mem_entries, other_entries): mem = live in-memory sessions
+        eligible for the shared-packet QoS0 fast loop; other = durable
+        or exotic sessions that always take session.deliver. Entries
+        carry the session OBJECT — any mutation that could stale it
+        clears the whole cache."""
         best: Dict[str, Tuple[str, SubOpts]] = {}
+        subopts = self.suboptions
         for flt, dests in pairs:
             for dest in tuple(dests):
                 if isinstance(dest, tuple) and dest and dest[0] == GROUP_DEST:
                     continue  # shared legs handled by group election
-                opts = self.suboptions.get((flt, dest))
+                opts = subopts.get((flt, dest))
                 if opts is None:
                     continue
                 cur = best.get(dest)
                 if cur is None or opts.qos > cur[1].qos:
                     best[dest] = (flt, opts)
-        return self._fanout(msg, list(best.items()))
+        mem: list = []
+        other: list = []
+        for client, (flt, opts) in best.items():
+            session = self.sessions.get(client)
+            if session is None:
+                continue
+            if session.__class__ is Session:
+                mem.append((client, session, opts))
+            else:
+                other.append((client, flt, opts))
+        return mem, other
 
-    def _fanout(
-        self, msg: Message, entries: List[Tuple[str, Tuple[str, SubOpts]]]
-    ) -> int:
+    def _fanout(self, msg: Message, plan: tuple) -> int:
         """Wide-fanout sharding (the 1024 rule): shard 0 delivers
         inline; later shards are scheduled as separate event-loop turns
         so a 100k-subscriber topic cannot stall the loop for one long
         dispatch (the reference parallelizes shards across broker-pool
         workers, emqx_broker.erl:643-672,753-760). Returns deliveries
         INITIATED — deferred shards count at plan time."""
-        pkt_cache: Dict[bool, Publish] = {}  # retain flag -> shared pkt
-        if len(entries) <= FANOUT_SHARD:
-            return self._deliver_shard(msg, entries, pkt_cache)
+        mem, other = plan
+        total = len(mem) + len(other)
+        pkt_cache: Dict[bool, tuple] = {}  # retain -> (pkt, (pkt,))
+        if total <= FANOUT_SHARD:
+            return self._deliver_plan(msg, plan, 0, total, pkt_cache)
         try:
             loop = asyncio.get_running_loop()
         except RuntimeError:
             loop = None
-        n = self._deliver_shard(msg, entries[:FANOUT_SHARD], pkt_cache)
-        for i in range(FANOUT_SHARD, len(entries), FANOUT_SHARD):
-            shard = entries[i : i + FANOUT_SHARD]
+        n = self._deliver_plan(msg, plan, 0, FANOUT_SHARD, pkt_cache)
+        for i in range(FANOUT_SHARD, total, FANOUT_SHARD):
+            hi = min(i + FANOUT_SHARD, total)
             if loop is None:
-                n += self._deliver_shard(msg, shard, pkt_cache)
+                n += self._deliver_plan(msg, plan, i, hi, pkt_cache)
             else:
-                loop.call_soon(self._deliver_shard, msg, shard, pkt_cache)
-                n += len(shard)
+                loop.call_soon(
+                    self._deliver_plan, msg, plan, i, hi, pkt_cache
+                )
+                n += hi - i
+        return n
+
+    def _deliver_plan(
+        self,
+        msg: Message,
+        plan: tuple,
+        lo: int,
+        hi: int,
+        pkt_cache: Dict[bool, tuple],
+    ) -> int:
+        """Deliver plan slice [lo, hi). The QoS0 fast loop shares ONE
+        Publish packet (and one singleton tuple) per retain flag across
+        every shard of the fanout; its wire form serializes once per
+        protocol version (frame.serialize memoizes on the packet), so
+        the hot loop is: no_local check, connected check, sink write."""
+        mem, other = plan
+        n = 0
+        run_hook = self.hooks.has("message.delivered")
+        hooks_run = self.hooks.run
+        fr = msg.from_client
+        mq = msg.qos
+        m = len(mem)
+        if lo < m:
+            for client, s, opts in mem[lo:min(hi, m)]:
+                if opts.no_local and fr == client:
+                    continue
+                if (
+                    s.connected
+                    and (mq == 0 or opts.qos == 0)
+                    and not s.cfg.upgrade_qos
+                ):
+                    retain = msg.retain if opts.retain_as_published else False
+                    cached = pkt_cache.get(retain)
+                    if cached is None:
+                        pkt = Publish(
+                            topic=msg.topic,
+                            payload=msg.payload,
+                            qos=0,
+                            retain=retain,
+                            packet_id=None,
+                            props=dict(msg.props),
+                        )
+                        pkt._wire = {}  # opt into serialize memoization
+                        cached = (pkt, (pkt,))
+                        pkt_cache[retain] = cached
+                    if run_hook:
+                        hooks_run("message.delivered", client, msg)
+                    sb = s.outgoing_sink_bytes
+                    if sb is not None:
+                        # bytes fast path: serialize once per (proto
+                        # version, retain) for the WHOLE fanout, write
+                        # the same buffer to every socket
+                        ver = s.sink_proto_ver
+                        data = pkt_cache.get((ver, retain))
+                        if data is None:
+                            data = frame.serialize(cached[0], ver)
+                            pkt_cache[(ver, retain)] = data
+                        sb(data)
+                    else:
+                        sink = s.outgoing_sink
+                        if sink is not None:
+                            sink(cached[1])
+                    n += 1
+                    continue
+                packets = s.deliver(msg, opts)
+                if run_hook:
+                    hooks_run("message.delivered", client, msg)
+                if packets:
+                    sink = s.outgoing_sink
+                    if sink is not None:
+                        sink(packets)
+                n += 1
+        if hi > m:
+            for client, flt, opts in other[max(lo - m, 0):hi - m]:
+                session = self.sessions.get(client)
+                if session is None:
+                    continue
+                if opts.no_local and fr == client:
+                    continue
+                packets = session.deliver(msg, opts)
+                if run_hook:
+                    hooks_run("message.delivered", client, msg)
+                if packets:
+                    sink = getattr(session, "outgoing_sink", None)
+                    if sink is not None:
+                        sink(packets)
+                n += 1
         return n
 
     def _deliver_shard(

@@ -82,11 +82,28 @@ class Connection:
         sess = self.channel.session
         if sess is not None:
             sess.outgoing_sink = self._send_packets
+            if not self.channel.mountpoint:
+                # bytes fast path: valid only when no mountpoint strip
+                # rewrites delivered topics (bytes differ per client)
+                sess.outgoing_sink_bytes = self._send_bytes
+                sess.sink_proto_ver = self.channel.proto_ver
             # admin kick severs the socket through this
             sess.closer = self.transport.close
             # background producers (DS pump) must hop onto this loop
             # before touching the session or transport
             sess.event_loop = asyncio.get_running_loop()
+
+    def _send_bytes(self, data: bytes) -> None:
+        """Fanout fast path: one shared QoS0 PUBLISH, serialized once
+        per (proto version, retain) by the broker, written verbatim."""
+        try:
+            limit = self.channel.client_max_packet
+            if limit is not None and len(data) > limit:
+                self.server.broker.metrics.inc("delivery.dropped.too_large")
+                return
+            self.transport.write(data)
+        except Exception:  # connection already gone
+            pass
 
     def _send_packets(self, pkts) -> None:
         try:
@@ -217,6 +234,7 @@ class Connection:
             sess = self.channel.session
             if sess is not None and getattr(sess, "outgoing_sink", None) is self._send_packets:
                 sess.outgoing_sink = None
+                sess.outgoing_sink_bytes = None
                 sess.closer = None
             self.channel.on_close()
             self.transport.close()

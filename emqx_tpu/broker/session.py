@@ -63,6 +63,11 @@ class Session:
         # transport seams set by the connection layer: packet sink and
         # socket closer (used by admin kick / takeover)
         self.outgoing_sink = None
+        # wide-fanout bytes fast path: a mountpoint-free connection
+        # accepts the shared pre-serialized QoS0 PUBLISH directly
+        # (set together with outgoing_sink by the transport)
+        self.outgoing_sink_bytes = None
+        self.sink_proto_ver = 4
         self.closer = None
 
     # --- packet-id allocation ------------------------------------------
