@@ -1,0 +1,188 @@
+"""Redis-backed authn provider + authz source.
+
+Reference: apps/emqx_auth_redis/src/emqx_authn_redis.erl (HGET/HMGET
+command templated from the client's credentials; fields password_hash/
+salt/is_superuser decide), emqx_authz_redis.erl (HGETALL of an ACL
+hash whose field/value pairs are topic_filter -> action; every Redis
+ACL rule is an ALLOW rule — deny-by-default comes from the chain's
+no-match policy).
+
+The provider runs on the auth hot path, so it uses the small sync
+RESP client (bridges/redis.py) with bounded timeouts — the same
+blocking-window model as auth/http.py; the channel offloads the chain
+to an executor.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import logging
+from typing import Dict, List, Optional
+
+from ..bridges.redis import RedisClient, RedisError
+from ..ops import topic as topic_mod
+from .authn import IGNORE, AuthResult, Credentials, Provider
+from .authz import Source
+
+log = logging.getLogger("emqx_tpu.auth.redis")
+
+
+def _fill(template: str, creds: Credentials) -> str:
+    pw = creds.password
+    return (
+        template.replace("${clientid}", creds.client_id)
+        .replace("${username}", creds.username or "")
+        .replace("${peerhost}", creds.peerhost or "")
+        .replace(
+            "${password}", pw.decode("utf-8", "replace") if pw else ""
+        )
+        .replace("${cert_common_name}", creds.cert_cn or "")
+    )
+
+
+def verify_password(
+    algorithm: str,
+    stored: bytes,
+    password: bytes,
+    salt: bytes = b"",
+    salt_position: str = "prefix",
+    iterations: int = 1000,
+) -> bool:
+    """The emqx_passwd subset the image can do without native bcrypt:
+    plain | sha256 (salt prefix/suffix/disable) | pbkdf2_sha256.
+    Stored hashes are hex (reference convention) or raw."""
+    if algorithm == "plain":
+        digest = password
+    elif algorithm == "sha256":
+        if salt and salt_position == "suffix":
+            digest = hashlib.sha256(password + salt).digest()
+        elif salt and salt_position == "prefix":
+            digest = hashlib.sha256(salt + password).digest()
+        else:
+            digest = hashlib.sha256(password).digest()
+    elif algorithm in ("pbkdf2", "pbkdf2_sha256"):
+        digest = hashlib.pbkdf2_hmac("sha256", password, salt, iterations)
+    else:
+        raise ValueError(f"unsupported algorithm {algorithm!r}")
+    if algorithm != "plain" and len(stored) == 2 * len(digest):
+        try:
+            stored = bytes.fromhex(stored.decode())
+        except ValueError:
+            pass
+    return hmac.compare_digest(digest, stored)
+
+
+class RedisAuthnProvider(Provider):
+    """cmd: e.g. "HMGET mqtt_user:${username} password_hash salt
+    is_superuser" — only GET/HGET/HMGET are accepted, mirroring the
+    reference's command whitelist (emqx_authn_redis.erl)."""
+
+    def __init__(
+        self,
+        cmd: str,
+        client: Optional[RedisClient] = None,
+        algorithm: str = "sha256",
+        salt_position: str = "prefix",
+        iterations: int = 1000,
+        **client_kw,
+    ) -> None:
+        parts = cmd.split()
+        if not parts or parts[0].upper() not in ("GET", "HGET", "HMGET"):
+            raise ValueError(f"unsupported authn redis cmd {cmd!r}")
+        self.op = parts[0].upper()
+        self.key_tpl = parts[1]
+        self.fields = parts[2:]
+        if self.op == "HMGET" and "password_hash" not in self.fields:
+            raise ValueError("HMGET fields must include password_hash")
+        self.algorithm = algorithm
+        self.salt_position = salt_position
+        self.iterations = iterations
+        self.client = client or RedisClient(**client_kw)
+
+    def authenticate(self, creds: Credentials):
+        key = _fill(self.key_tpl, creds)
+        try:
+            if self.op == "GET":
+                r = self.client.command(["GET", key])
+                row: Dict[str, bytes] = (
+                    {} if r is None else {"password_hash": r}
+                )
+            elif self.op == "HGET":
+                r = self.client.command(["HGET", key, self.fields[0]])
+                row = {} if r is None else {self.fields[0]: r}
+            else:
+                r = self.client.command(["HMGET", key] + self.fields)
+                row = {
+                    f: v
+                    for f, v in zip(self.fields, r or [])
+                    if v is not None
+                }
+        except Exception as e:  # server down: not my verdict
+            log.warning("redis authn lookup failed: %s", e)
+            return IGNORE
+        stored = row.get("password_hash")
+        if stored is None:
+            return IGNORE  # unknown user -> next provider in chain
+        ok = verify_password(
+            self.algorithm,
+            stored,
+            creds.password or b"",
+            row.get("salt", b""),
+            self.salt_position,
+            self.iterations,
+        )
+        if not ok:
+            return AuthResult(False, "bad_username_or_password")
+        su = row.get("is_superuser", b"") in (b"1", b"true", b"True")
+        return AuthResult(True, superuser=su)
+
+    def destroy(self) -> None:
+        self.client.close()
+
+
+class RedisAuthzSource(Source):
+    """cmd: e.g. "HGETALL mqtt_acl:${username}". Reply pairs are
+    topic_filter -> action (publish|subscribe|all); matches ALLOW,
+    anything else is nomatch (emqx_authz_redis.erl semantics: Redis
+    rules cannot deny)."""
+
+    def __init__(
+        self,
+        cmd: str = "HGETALL mqtt_acl:${username}",
+        client: Optional[RedisClient] = None,
+        **client_kw,
+    ) -> None:
+        parts = cmd.split()
+        if len(parts) != 2 or parts[0].upper() != "HGETALL":
+            raise ValueError(f"unsupported authz redis cmd {cmd!r}")
+        self.key_tpl = parts[1]
+        self.client = client or RedisClient(**client_kw)
+
+    def authorize(self, client_id, username, peerhost, action, topic) -> str:
+        creds = Credentials(
+            client_id=client_id, username=username, peerhost=peerhost
+        )
+        try:
+            r = self.client.command(["HGETALL", _fill(self.key_tpl, creds)])
+        except Exception as e:
+            log.warning("redis authz lookup failed: %s", e)
+            return "nomatch"
+        if not r:
+            return "nomatch"
+        pairs: List[bytes] = list(r)
+        for i in range(0, len(pairs) - 1, 2):
+            flt = pairs[i].decode("utf-8", "replace")
+            act = pairs[i + 1].decode("utf-8", "replace").lower()
+            if act != "all" and act != action:
+                continue
+            ft = _fill(flt, creds)
+            if ft.startswith("eq "):
+                if ft[3:] == topic:
+                    return "allow"
+            elif topic_mod.match(topic_mod.words(topic), topic_mod.words(ft)):
+                return "allow"
+        return "nomatch"
+
+    def destroy(self) -> None:
+        self.client.close()

@@ -49,6 +49,7 @@ class Node:
         self.durable_db = None
         self.replicator = None
         self.plugins = None
+        self.bridge_registry = None
         self.ft = None
         self.telemetry = None
         self.links: list = []
@@ -172,6 +173,9 @@ class Node:
         self.rules = RuleEngine(
             broker, ignore_sys=cfg.get("rule_engine.ignore_sys_message")
         )
+        from .bridges.bridge import BridgeRegistry
+
+        self.bridge_registry = BridgeRegistry(broker, rules=self.rules)
         for rid, rconf in (cfg.get("rule_engine.rules") or {}).items():
             self.rules.create_rule(
                 rid,
@@ -303,6 +307,7 @@ class Node:
                 gateways=self.gateways,
                 listeners=self.listeners,
                 plugins=self.plugins,
+                bridges=self.bridge_registry,
             )
             host, port = parse_bind(cfg.get("api.bind"))
             await self.mgmt.start(host, port)
@@ -336,6 +341,8 @@ class Node:
                 pass
         if self.mgmt is not None:
             await self.mgmt.stop()
+        if getattr(self, "bridge_registry", None) is not None:
+            await self.bridge_registry.stop_all()
         for link in self.links:
             try:
                 await link.stop()

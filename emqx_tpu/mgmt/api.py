@@ -164,6 +164,7 @@ class ManagementApi:
         gateways=None,  # GatewayRegistry
         listeners=None,  # broker.listeners.Listeners manager
         plugins=None,  # PluginManager
+        bridges=None,  # BridgeRegistry
     ):
         from .audit import AuditLog
 
@@ -177,6 +178,7 @@ class ManagementApi:
         self.gateways = gateways
         self.listeners = listeners
         self.plugins = plugins
+        self.bridges = bridges
         self.evacuation = None  # NodeEvacuation, created on demand
         self.node_name = node_name
         self.backup_dir = backup_dir
@@ -305,6 +307,8 @@ class ManagementApi:
         r("POST", "/api/v5/listeners/{id}/start", self._listener_start)
         r("GET", "/api/v5/cluster", self._cluster_view)
         r("GET", "/api/v5/plugins", self._plugins_list)
+        r("GET", "/api/v5/bridges", self._bridges_list)
+        r("GET", "/api/v5/bridges/{name}", self._bridge_one)
         r("POST", "/api/v5/plugins/install", self._plugin_install)
         r("PUT", "/api/v5/plugins/{name}/start", self._plugin_start)
         r("PUT", "/api/v5/plugins/{name}/stop", self._plugin_stop)
@@ -425,6 +429,19 @@ class ManagementApi:
                 for n, a in self.node.membership.members.items()
             },
         }
+
+    def _bridges_list(self, q):
+        if self.bridges is None:
+            return []
+        return self.bridges.list()
+
+    def _bridge_one(self, q, name):
+        if self.bridges is None:
+            return Response(404, {"code": "NOT_FOUND"})
+        b = self.bridges.bridges.get(name)
+        if b is None:
+            return Response(404, {"code": "NOT_FOUND"})
+        return b.info()
 
     def _plugins_list(self, req: Request):
         return self.plugins.list() if self.plugins is not None else []

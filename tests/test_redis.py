@@ -1,0 +1,346 @@
+"""Redis stack tests: RESP codec, authn/authz against an in-process
+mini RESP server, and a rule-action bridge writing through it — the
+same proven pattern as test_kafka.py's mini broker (VERDICT r2 #4).
+"""
+
+import asyncio
+import hashlib
+import threading
+
+import pytest
+
+from emqx_tpu.auth.authn import IGNORE, Credentials
+from emqx_tpu.auth.redis import RedisAuthnProvider, RedisAuthzSource, verify_password
+from emqx_tpu.bridges.redis import (
+    RedisClient,
+    RedisConnector,
+    RedisError,
+    RespParser,
+    encode_command,
+    encode_reply,
+)
+
+
+class MiniRedis:
+    """In-process RESP2 server over a dict store (enough surface for
+    the authn/authz/bridge paths: AUTH/SELECT/PING/GET/SET/HSET/HGET/
+    HMGET/HGETALL/SADD/SMEMBERS/LPUSH/LRANGE/DEL)."""
+
+    def __init__(self, password=None):
+        self.password = password
+        self.store = {}
+        self.server = None
+        self.port = None
+        self.commands = []  # every command seen, for assertions
+
+    async def start(self):
+        self.server = await asyncio.start_server(self._conn, "127.0.0.1", 0)
+        self.port = self.server.sockets[0].getsockname()[1]
+
+    async def stop(self):
+        self.server.close()
+        await self.server.wait_closed()
+
+    async def _conn(self, reader, writer):
+        parser = RespParser()
+        authed = self.password is None
+        try:
+            while True:
+                data = await reader.read(4096)
+                if not data:
+                    return
+                for cmd in parser.feed(data):
+                    args = [
+                        a.decode() if isinstance(a, bytes) else str(a)
+                        for a in cmd
+                    ]
+                    self.commands.append(args)
+                    op = args[0].upper()
+                    if op == "AUTH":
+                        if args[-1] == self.password:
+                            authed = True
+                            reply = "OK"
+                        else:
+                            reply = RedisError("invalid password")
+                    elif not authed:
+                        reply = RedisError("NOAUTH Authentication required.")
+                    else:
+                        reply = self._exec(op, args[1:])
+                    writer.write(encode_reply(reply))
+                await writer.drain()
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            writer.close()
+
+    def _exec(self, op, a):
+        st = self.store
+        if op in ("PING",):
+            return "PONG"
+        if op == "SELECT":
+            return "OK"
+        if op == "SET":
+            st[a[0]] = a[1].encode()
+            return "OK"
+        if op == "GET":
+            v = st.get(a[0])
+            return v if isinstance(v, (bytes, type(None))) else None
+        if op == "HSET":
+            h = st.setdefault(a[0], {})
+            for i in range(1, len(a) - 1, 2):
+                h[a[i]] = a[i + 1].encode()
+            return (len(a) - 1) // 2
+        if op == "HGET":
+            return st.get(a[0], {}).get(a[1])
+        if op == "HMGET":
+            h = st.get(a[0], {})
+            return [h.get(f) for f in a[1:]]
+        if op == "HGETALL":
+            h = st.get(a[0], {})
+            out = []
+            for k, v in h.items():
+                out.append(k.encode())
+                out.append(v)
+            return out
+        if op == "SADD":
+            st.setdefault(a[0], set()).update(x.encode() for x in a[1:])
+            return len(a) - 1
+        if op == "SMEMBERS":
+            return sorted(st.get(a[0], set()))
+        if op == "LPUSH":
+            lst = st.setdefault(a[0], [])
+            for x in a[1:]:
+                lst.insert(0, x.encode())
+            return len(lst)
+        if op == "LRANGE":
+            lst = st.get(a[0], [])
+            stop = int(a[2])
+            stop = len(lst) if stop == -1 else stop + 1
+            return lst[int(a[1]):stop]
+        if op == "DEL":
+            n = 0
+            for k in a:
+                n += 1 if st.pop(k, None) is not None else 0
+            return n
+        return RedisError(f"unknown command '{op}'")
+
+
+# --- codec ----------------------------------------------------------------
+
+
+def test_resp_codec_roundtrip():
+    p = RespParser()
+    wire = (
+        encode_reply("OK")
+        + encode_reply(5)
+        + encode_reply(b"hello")
+        + encode_reply(None)
+        + encode_reply([b"a", 1, None, [b"nested"]])
+    )
+    # feed byte-by-byte: the parser must be fully incremental
+    out = []
+    for i in range(len(wire)):
+        out.extend(p.feed(wire[i : i + 1]))
+    assert out == ["OK", 5, b"hello", None, [b"a", 1, None, [b"nested"]]]
+    err = RespParser().feed(encode_reply(RedisError("boom")))
+    assert isinstance(err[0], RedisError) and "boom" in str(err[0])
+    assert encode_command(["SET", "k", b"v", 2]) == (
+        b"*4\r\n$3\r\nSET\r\n$1\r\nk\r\n$1\r\nv\r\n$1\r\n2\r\n"
+    )
+
+
+def test_verify_password_shapes():
+    pw, salt = b"secret", b"s1"
+    hex_hash = hashlib.sha256(salt + pw).hexdigest().encode()
+    assert verify_password("sha256", hex_hash, pw, salt, "prefix")
+    assert not verify_password("sha256", hex_hash, b"wrong", salt, "prefix")
+    raw = hashlib.sha256(pw + salt).digest()
+    assert verify_password("sha256", raw, pw, salt, "suffix")
+    assert verify_password("plain", b"secret", pw)
+    pb = hashlib.pbkdf2_hmac("sha256", pw, salt, 1000)
+    assert verify_password("pbkdf2_sha256", pb, pw, salt)
+
+
+# --- helpers --------------------------------------------------------------
+
+
+def run_sync_against_server(fn, password=None, seed=None):
+    """Run the mini server on a private loop thread; call fn(port) in
+    the test thread (the sync RedisClient blocks, as it does on the
+    channel's auth executor)."""
+    result = {}
+    started = threading.Event()
+    stop = threading.Event()
+
+    def thread():
+        async def main():
+            srv = MiniRedis(password=password)
+            await srv.start()
+            if seed:
+                seed(srv)
+            result["srv"] = srv
+            result["port"] = srv.port
+            started.set()
+            while not stop.is_set():
+                await asyncio.sleep(0.01)
+            await srv.stop()
+
+        asyncio.run(main())
+
+    t = threading.Thread(target=thread, daemon=True)
+    t.start()
+    assert started.wait(5)
+    try:
+        fn(result["port"], result["srv"])
+    finally:
+        stop.set()
+        t.join(5)
+
+
+# --- authn e2e ------------------------------------------------------------
+
+
+def test_redis_authn_hmget():
+    salt = b"na"
+    hashed = hashlib.sha256(salt + b"pw1").hexdigest()
+
+    def seed(srv):
+        srv.store["mqtt_user:alice"] = {
+            "password_hash": hashed.encode(),
+            "salt": salt,
+            "is_superuser": b"1",
+        }
+        srv.store["mqtt_user:bob"] = {
+            "password_hash": hashlib.sha256(b"xx" + b"pw2").hexdigest().encode(),
+            "salt": b"xx",
+        }
+
+    def check(port, srv):
+        p = RedisAuthnProvider(
+            "HMGET mqtt_user:${username} password_hash salt is_superuser",
+            algorithm="sha256",
+            salt_position="prefix",
+            host="127.0.0.1",
+            port=port,
+        )
+        r = p.authenticate(Credentials("c1", "alice", b"pw1"))
+        assert r.ok and r.superuser
+        r = p.authenticate(Credentials("c1", "alice", b"wrong"))
+        assert not r.ok and r.reason == "bad_username_or_password"
+        r = p.authenticate(Credentials("c2", "bob", b"pw2"))
+        assert r.ok and not r.superuser
+        # unknown user -> IGNORE so the chain can continue
+        assert p.authenticate(Credentials("c3", "nobody", b"x")) is IGNORE
+        p.destroy()
+
+    run_sync_against_server(check, seed=seed)
+
+
+def test_redis_authn_server_down_is_ignore():
+    p = RedisAuthnProvider(
+        "HMGET mqtt_user:${username} password_hash salt",
+        host="127.0.0.1",
+        port=1,  # nothing listens
+        timeout=0.2,
+    )
+    assert p.authenticate(Credentials("c", "u", b"x")) is IGNORE
+
+
+def test_redis_authn_with_auth_password():
+    def seed(srv):
+        srv.store["mqtt_user:u"] = {"password_hash": b"topsecret"}
+
+    def check(port, srv):
+        p = RedisAuthnProvider(
+            "HMGET mqtt_user:${username} password_hash",
+            algorithm="plain",
+            host="127.0.0.1",
+            port=port,
+            password="redispass",
+        )
+        assert p.authenticate(Credentials("c", "u", b"topsecret")).ok
+        assert ["AUTH", "redispass"] in srv.commands
+        p.destroy()
+
+    run_sync_against_server(check, password="redispass", seed=seed)
+
+
+# --- authz e2e ------------------------------------------------------------
+
+
+def test_redis_authz_rules():
+    def seed(srv):
+        srv.store["mqtt_acl:alice"] = {
+            "sensors/${clientid}/#": b"publish",
+            "cmds/+": b"subscribe",
+            "eq t/+/literal": b"all",
+        }
+
+    def check(port, srv):
+        src = RedisAuthzSource(
+            "HGETALL mqtt_acl:${username}", host="127.0.0.1", port=port
+        )
+        au = lambda a, t: src.authorize("c9", "alice", "10.0.0.1", a, t)
+        assert au("publish", "sensors/c9/temp") == "allow"
+        assert au("publish", "sensors/other/temp") == "nomatch"
+        assert au("subscribe", "cmds/reboot") == "allow"
+        assert au("publish", "cmds/reboot") == "nomatch"  # wrong action
+        # 'eq' rule matches the literal filter, not its expansion
+        assert au("publish", "t/+/literal") == "allow"
+        assert au("publish", "t/x/literal") == "nomatch"
+        assert au("publish", "elsewhere") == "nomatch"
+        src.destroy()
+
+    run_sync_against_server(check, seed=seed)
+
+
+# --- bridge action e2e ----------------------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_redis_rule_action_bridge_and_rest():
+    from emqx_tpu.bridges.bridge import BridgeRegistry
+    from emqx_tpu.bridges.resource import ResourceStatus
+    from emqx_tpu.broker.message import Message
+    from emqx_tpu.broker.pubsub import Broker
+    from emqx_tpu.mgmt.api import ManagementApi
+    from emqx_tpu.rules.engine import RuleEngine
+
+    srv = MiniRedis()
+    await srv.start()
+    broker = Broker()
+    rules = RuleEngine(broker)
+    rules.install(broker.hooks)
+    reg = BridgeRegistry(broker, rules=rules)
+    try:
+        await reg.create(
+            "redis_sink",
+            RedisConnector(
+                "127.0.0.1",
+                srv.port,
+                command_template=["LPUSH", "mqtt:${topic}", "${payload}"],
+            ),
+        )
+        rules.create_rule(
+            "to_redis",
+            'SELECT topic, payload FROM "metrics/#"',
+            actions=[{"function": "bridge", "args": {"name": "redis_sink"}}],
+        )
+        broker.publish(Message(topic="metrics/cpu", payload=b"0.93"))
+        broker.publish(Message(topic="metrics/cpu", payload=b"0.95"))
+        await reg.bridges["redis_sink"].resource.buffer.drain()
+        await asyncio.sleep(0.05)
+        assert srv.store.get("mqtt:metrics/cpu") == [b"0.95", b"0.93"]
+
+        # health flows to the REST surface (resource healthy)
+        st = await reg.bridges["redis_sink"].resource.connector.health_check()
+        assert st == ResourceStatus.CONNECTED
+        api = ManagementApi(broker, bridges=reg)
+        listing = api._bridges_list(None)
+        assert listing and listing[0]["name"] == "redis_sink"
+        assert listing[0]["status"] == "connected"
+        one = api._bridge_one(None, "redis_sink")
+        assert one["metrics"]["success"] >= 2
+    finally:
+        await reg.stop_all()
+        await srv.stop()
