@@ -1,27 +1,42 @@
-"""DS replication tier: per-shard ordered-log replication over the
-cluster RPC plane, plus durable-session-state fan-out.
+"""DS replication tier: per-shard ordered-log replication with
+QUORUM-ACKED commits over the cluster RPC plane, plus durable-session
+state fan-out.
 
 The reference replicates each DS shard with raft
 (apps/emqx_ds_builtin_raft/src/emqx_ds_replication_layer.erl:1-1342:
 leader appends to a ra log, quorum-acked entries apply to rocksdb on
-every replica). This is the raft-LITE analog, documented honestly:
+every replica). This tier keeps the deterministic-leader simplicity
+(no elections: sorted live membership, round-robin by shard — the
+membership view IS the election) but carries raft's durability
+contract:
 
-  * every shard has ONE leader, chosen deterministically from the
-    live membership (sorted node ids, round-robin by shard) — no
-    elections, the membership view IS the election;
-  * all writes for a shard route to its leader, which assigns a
-    monotonically increasing log index and broadcasts (idx, batch) to
-    every peer; replicas apply strictly in index order, so every
-    node's storage evolves identically — byte-identical keys, which
-    makes stream positions PORTABLE across nodes (the property that
-    lets a durable session resume elsewhere);
-  * no quorum ack: entries the leader appended but had not yet
-    broadcast when it died are lost (a bounded window the reference's
-    raft closes; accepted here and stated);
-  * gap recovery: a replica detecting idx > last+1 parks the batch
-    and pulls the missing range from the sender's bounded in-memory
-    log (`replay`); a leader change continues from the new leader's
-    last applied index.
+  * the leader assigns a monotonically increasing index and sends
+    (term, idx, batch) to every peer as an RPC CALL; a batch is
+    COMMITTED — applied to storage, visible to readers, fanned out to
+    session pumps — only after a MAJORITY of the cluster (leader
+    included) accepted it. Replicas hold accepted batches in a
+    pending log and apply them, strictly in index order, when the
+    commit notice (or a later commit index) arrives. Round-2's loss
+    window (leader-appended, unbroadcast entries vanishing with the
+    leader) is gone: an exposed entry exists on a majority, and any
+    surviving majority intersects it.
+  * TERMS: a node bumps its term on every membership change and
+    adopts any higher term it sees. Appends carry the leader's term;
+    a replica that has seen a newer term rejects ('stale') and the
+    old leader steps down, re-routing its batch to the current
+    leader. Split-brain appends for the same index race their acks —
+    a replica accepts exactly one, so only one can reach majority;
+    the loser gets 'conflict' and steps down.
+  * LEADER CATCH-UP: on its first append in a new term, a leader
+    first pulls every live peer's (applied, pending) tail, adopts the
+    longest committed prefix (committed entries live on ≥ a majority
+    of the old view, and any surviving majority contains one holder)
+    and re-commits adopted pending entries under its own term —
+    raft's commit-previous-term rule. Writes arriving mid-sync buffer
+    and drain after.
+  * gap recovery: a replica whose accept cursor trails the incoming
+    index nacks with ('gap', last) and the leader streams it the
+    missing committed + pending range in order.
 
 Session docs (subs + committed stream positions) fan out on every
 save through the same plane, so the session itself — not just its
@@ -35,14 +50,14 @@ import asyncio
 import logging
 import threading
 from collections import deque
-from typing import Deque, Dict, List, Optional, Tuple
+from typing import Deque, Dict, List, Optional, Set, Tuple
 
 from ..broker.message import Message
 from ..cluster.node import ClusterNode, msg_from_wire, msg_to_wire
 
 log = logging.getLogger("emqx_tpu.ds.replication")
 
-LOG_RETENTION = 4096  # (idx, batch) entries kept per shard for replay
+LOG_RETENTION = 4096  # committed (idx, batch) entries kept for replay
 
 
 class ReplicatedDs:
@@ -53,18 +68,26 @@ class ReplicatedDs:
         self.db = manager.db
         self.node_id = node.node_id
         self.n_shards = len(self.db.storage.shards)
-        # per-shard replication state; the mutex covers it all — writes
-        # arrive both from the DS buffer flush THREAD (local submits)
-        # and the node loop thread (RPC handlers), and index assignment
-        # must be atomic or two batches share an index and every
-        # replica drops one as a duplicate. RLock: apply_local's notify
-        # chain (pump -> save_session -> _on_sess_save) re-enters on
-        # the same thread while the apply still holds the lock
+        # the mutex covers all replication state — writes arrive both
+        # from the DS buffer flush THREAD (local submits) and the node
+        # loop thread (RPC handlers). RLock: apply's notify chain
+        # (pump -> save_session -> _on_sess_save) re-enters on the
+        # same thread while the apply still holds the lock.
         self._mutex = threading.RLock()
-        self._next_idx: Dict[int, int] = {}  # as leader: next index to assign
-        self._applied: Dict[int, int] = {}  # last index applied locally
+        self.term = 0
+        self._next_idx: Dict[int, int] = {}  # as leader: next index
+        self._applied: Dict[int, int] = {}  # last COMMITTED idx applied
+        self._accepted: Dict[int, int] = {}  # last contiguously accepted
+        # accepted-but-uncommitted: shard -> idx -> (term, payload)
+        self._pending: Dict[int, Dict[int, Tuple[int, list]]] = {}
+        # as leader: (shard, idx) -> ack state
+        self._unacked: Dict[Tuple[int, int], dict] = {}
+        # committed log for replay/catch-up
         self._log: Dict[int, Deque[Tuple[int, list]]] = {}
-        self._parked: Dict[int, Dict[int, list]] = {}  # out-of-order buffer
+        # leadership sync state: shard -> term we last synced for
+        self._lead_synced: Dict[int, int] = {}
+        self._lead_syncing: Set[int] = set()
+        self._lead_buf: Dict[int, List[list]] = {}
         # session-doc fan-out is DEBOUNCED: ack commits save on every
         # puback, and a per-message cluster-wide doc broadcast would be
         # a hot-path amplifier — coalesce to the latest doc per client
@@ -73,10 +96,12 @@ class ReplicatedDs:
         self.sess_debounce_s = 0.05
         node.rpc.registry.register_all(
             "ds",
-            1,
+            2,
             {
                 "write": self._handle_write,
-                "apply": self._handle_apply,
+                "append": self._handle_append,
+                "commit": self._handle_commit,
+                "tail": self._handle_tail,
                 "replay": self._handle_replay,
                 "sess_put": self._handle_sess_put,
                 "sess_del": self._handle_sess_del,
@@ -85,8 +110,22 @@ class ReplicatedDs:
         self.db.interceptor = self._submit
         manager.on_save = self._on_sess_save
         manager.on_discard = self._on_sess_discard
+        node.membership.on_member_up.append(lambda *_a: self._bump_term())
+        node.membership.on_member_down.append(lambda *_a: self._bump_term())
 
     # --- leadership ------------------------------------------------------
+
+    def _bump_term(self) -> None:
+        with self._mutex:
+            self.term += 1
+            self._lead_synced.clear()
+
+    def _see_term(self, term: int) -> None:
+        """Adopt a higher term seen on the wire (stale-leader fence)."""
+        with self._mutex:
+            if term > self.term:
+                self.term = term
+                self._lead_synced.clear()
 
     def leader_of(self, shard: int) -> str:
         nodes = sorted([self.node_id, *self.node.membership.members])
@@ -94,6 +133,9 @@ class ReplicatedDs:
 
     def _peers(self):
         return list(self.node.membership.members.items())
+
+    def _majority(self) -> int:
+        return (len(self.node.membership.members) + 1) // 2 + 1
 
     def _spawn(self, coro) -> None:
         """Schedule an RPC coroutine on the node's loop — writes arrive
@@ -125,9 +167,10 @@ class ReplicatedDs:
             return
         addr = self.node.membership.members.get(leader)
         if addr is None:
-            # leader unknown (partition): apply locally rather than
-            # lose the write; anti-entropy is out of scope here
-            self.db.apply_local(shard, msgs)
+            # leader unknown (partition): order it ourselves — the
+            # append still needs a majority, so nothing uncommitted
+            # can become visible
+            self._leader_append(shard, [msg_to_wire(m) for m in msgs])
             return
         self._spawn(
             self.node.rpc.cast(
@@ -137,22 +180,118 @@ class ReplicatedDs:
 
     def _leader_append(self, shard: int, payload: list) -> None:
         with self._mutex:
-            idx = self._next_idx.get(shard, self._applied.get(shard, 0) + 1)
-            self._next_idx[shard] = idx + 1
-            self._apply_locked(shard, idx, payload)
-        # notify OUTSIDE the mutex: the watcher chain takes the session
-        # manager's lock, which other threads hold while calling back
-        # into _on_sess_save (AB-BA deadlock if notified under _mutex)
+            term = self.term
+            if self._lead_synced.get(shard) != term:
+                # new leadership: catch up with the cluster's tail
+                # before assigning indexes (raft's you-win-you-sync)
+                self._lead_buf.setdefault(shard, []).append(payload)
+                if shard in self._lead_syncing:
+                    return
+                self._lead_syncing.add(shard)
+                sync_needed = True
+            else:
+                sync_needed = False
+                idx = self._assign_locked(shard, term, payload)
+        if sync_needed:
+            self._spawn(self._sync_leadership(shard, term))
+            return
+        self._replicate(shard, idx, term, payload)
+
+    def _assign_locked(self, shard: int, term: int, payload: list) -> int:
+        idx = self._next_idx.get(shard, self._applied.get(shard, 0) + 1)
+        self._next_idx[shard] = idx + 1
+        self._pending.setdefault(shard, {})[idx] = (term, payload)
+        self._accepted[shard] = max(self._accepted.get(shard, 0), idx)
+        self._unacked[(shard, idx)] = {
+            "term": term, "payload": payload, "acks": set(), "committed": False,
+        }
+        return idx
+
+    def _replicate(self, shard: int, idx: int, term: int, payload: list) -> None:
+        peers = self._peers()
+        if self._majority() <= 1:
+            self._on_ack(shard, idx, None)  # single node: self-quorum
+            return
+        for peer, addr in peers:
+            self._spawn(self._send_append(peer, addr, shard, idx, term, payload))
+
+    async def _send_append(self, peer, addr, shard, idx, term, payload) -> None:
+        try:
+            r = await self.node.rpc.call(
+                addr, "ds", "append",
+                (shard, idx, term, payload, self.node_id), key=f"ds{shard}",
+            )
+        except Exception:
+            return  # peer unreachable: its ack never arrives
+        verdict = r[0] if isinstance(r, (list, tuple)) and r else r
+        if verdict == "ok":
+            self._on_ack(shard, idx, peer)
+        elif verdict == "stale":
+            self._see_term(int(r[1]))
+            self._step_down(shard)
+        elif verdict == "conflict":
+            self._step_down(shard)
+        elif verdict == "gap":
+            await self._catch_peer(addr, shard, int(r[1]))
+
+    def _on_ack(self, shard: int, idx: int, peer) -> None:
+        to_commit: List[Tuple[int, list]] = []
+        with self._mutex:
+            e = self._unacked.get((shard, idx))
+            if e is None:
+                return
+            if peer is not None:
+                e["acks"].add(peer)
+            if not e["committed"] and len(e["acks"]) + 1 >= self._majority():
+                e["committed"] = True
+            # advance the commit frontier over contiguous committed
+            # entries (commits must apply in index order)
+            nxt = self._applied.get(shard, 0) + 1
+            while True:
+                en = self._unacked.get((shard, nxt))
+                if en is None or not en["committed"]:
+                    break
+                self._apply_locked(shard, nxt, en["payload"])
+                del self._unacked[(shard, nxt)]
+                to_commit.append(nxt)
+                nxt += 1
+            upto = self._applied.get(shard, 0)
+        if not to_commit:
+            return
         self.db._notify()
-        for peer, addr in self._peers():
+        for _peer, addr in self._peers():
             self._spawn(
                 self.node.rpc.cast(
-                    addr,
-                    "ds",
-                    "apply",
-                    (shard, idx, payload, self.node_id),
-                    key=f"ds{shard}",
+                    addr, "ds", "commit", (shard, upto), key=f"ds{shard}"
                 )
+            )
+
+    def _step_down(self, shard: int) -> None:
+        """Stale leadership: re-route our uncommitted entries through
+        the (new) leader as fresh writes."""
+        with self._mutex:
+            orphans = [
+                (i, e) for (s, i), e in list(self._unacked.items()) if s == shard
+            ]
+            for i, _e in orphans:
+                del self._unacked[(shard, i)]
+                self._pending.get(shard, {}).pop(i, None)
+            self._accepted[shard] = self._applied.get(shard, 0)
+            self._next_idx.pop(shard, None)
+            self._lead_synced.pop(shard, None)
+        for _i, e in sorted(orphans):
+            if not e["committed"]:
+                self._resubmit(shard, e["payload"])
+
+    def _resubmit(self, shard: int, payload: list) -> None:
+        leader = self.leader_of(shard)
+        if leader == self.node_id:
+            self._leader_append(shard, payload)
+            return
+        addr = self.node.membership.members.get(leader)
+        if addr is not None:
+            self._spawn(
+                self.node.rpc.cast(addr, "ds", "write", (payload,), key=f"ds{shard}")
             )
 
     def _handle_write(self, payload: list, hops: int = 0) -> None:
@@ -160,8 +299,8 @@ class ReplicatedDs:
         recomputed here — shard_of is deterministic on from_client.
         `hops` bounds re-forwarding: with asymmetric membership views
         two nodes can each think the other leads, so after one re-
-        forward the receiver appends as leader itself (SOME single
-        node must order the batch; a loop orders it nowhere)."""
+        forward the receiver appends as leader itself (the quorum ack
+        arbitrates which ordering wins)."""
         msgs = [msg_from_wire(d) for d in payload]
         by_shard: Dict[int, list] = {}
         for m, d in zip(msgs, payload):
@@ -181,7 +320,7 @@ class ReplicatedDs:
                 else:
                     self._leader_append(shard, batch)
 
-    # --- replica apply ---------------------------------------------------
+    # --- replica side ----------------------------------------------------
 
     def _apply_locked(self, shard: int, idx: int, payload: list) -> None:
         """Caller holds self._mutex — storage write + log state ONLY;
@@ -190,50 +329,66 @@ class ReplicatedDs:
             [msg_from_wire(d) for d in payload], sync=True
         )
         self._applied[shard] = idx
+        self._accepted[shard] = max(self._accepted.get(shard, 0), idx)
         self._next_idx[shard] = max(self._next_idx.get(shard, 0), idx + 1)
+        self._pending.get(shard, {}).pop(idx, None)
         lg = self._log.setdefault(shard, deque(maxlen=LOG_RETENTION))
         lg.append((idx, payload))
 
-    def _handle_apply(self, shard: int, idx: int, payload: list, _from=None) -> None:
-        pull_from = None
-        applied = False
+    def _handle_append(self, shard: int, idx: int, term: int, payload: list, _from=None):
         with self._mutex:
-            last = self._applied.get(shard, 0)
-            if idx <= last:
-                return  # duplicate
-            if idx == last + 1:
-                self._apply_locked(shard, idx, payload)
-                applied = True
-                # drain any parked successors
-                parked = self._parked.get(shard)
-                while parked:
-                    nxt = self._applied[shard] + 1
-                    batch = parked.pop(nxt, None)
-                    if batch is None:
-                        break
-                    self._apply_locked(shard, nxt, batch)
-            else:
-                # gap: park and pull the missing range from the SENDER
-                # — it just broadcast idx, so its log has the range; the
-                # computed leader may never have led this shard
-                self._parked.setdefault(shard, {})[idx] = payload
-                pull_from = self.node.membership.members.get(
-                    _from if _from is not None else self.leader_of(shard)
-                )
-        if applied:
-            self.db._notify()
-        if pull_from is not None:
-            self._spawn(self._pull(pull_from, shard, last))
+            if term < self.term:
+                return ("stale", self.term)
+            if term > self.term:
+                self.term = term
+                self._lead_synced.clear()
+            applied = self._applied.get(shard, 0)
+            if idx <= applied:
+                return ("ok",)  # already committed here
+            accepted = self._accepted.get(shard, applied)
+            cur = self._pending.get(shard, {}).get(idx)
+            if cur is not None:
+                if cur[0] == term:
+                    return ("ok",)  # duplicate of the same leadership
+                if cur[0] > term:
+                    return ("stale", self.term)
+                # newer term overwrites an uncommitted older entry
+                self._pending[shard][idx] = (term, payload)
+                return ("ok",)
+            if idx == accepted + 1:
+                self._pending.setdefault(shard, {})[idx] = (term, payload)
+                self._accepted[shard] = idx
+                return ("ok",)
+            if idx <= accepted:
+                # accepted an entry at this index from another leader
+                return ("conflict",)
+            return ("gap", accepted)
 
-    async def _pull(self, addr, shard: int, after_idx: int) -> None:
-        try:
-            entries = await self.node.rpc.call(
-                addr, "ds", "replay", (shard, after_idx)
+    def _handle_commit(self, shard: int, upto: int) -> None:
+        applied_any = False
+        with self._mutex:
+            pend = self._pending.get(shard, {})
+            nxt = self._applied.get(shard, 0) + 1
+            upto = min(upto, self._accepted.get(shard, 0))
+            while nxt <= upto:
+                e = pend.get(nxt)
+                if e is None:
+                    break
+                self._apply_locked(shard, nxt, e[1])
+                applied_any = True
+                nxt += 1
+        if applied_any:
+            self.db._notify()
+
+    def _handle_tail(self, shard: int):
+        """(applied, [(idx, term, payload) pending in order]) — leader
+        catch-up source."""
+        with self._mutex:
+            pend = sorted(self._pending.get(shard, {}).items())
+            return (
+                self._applied.get(shard, 0),
+                [(i, t, p) for i, (t, p) in pend],
             )
-        except Exception:
-            return
-        for idx, payload in entries:
-            self._handle_apply(shard, idx, payload)
 
     def _handle_replay(self, shard: int, after_idx: int):
         with self._mutex:
@@ -241,6 +396,118 @@ class ReplicatedDs:
             if not lg:
                 return []
             return [(i, p) for i, p in lg if i > after_idx]
+
+    async def _catch_peer(self, addr, shard: int, after: int) -> None:
+        """Stream a lagging replica the committed + pending range
+        above `after`, in order, then the commit frontier."""
+        with self._mutex:
+            term = self.term
+            entries = [
+                (i, term, p)
+                for i, p in self._log.get(shard, ())
+                if i > after
+            ]
+            entries += [
+                (i, t, p)
+                for i, (t, p) in sorted(self._pending.get(shard, {}).items())
+                if i > after
+            ]
+            upto = self._applied.get(shard, 0)
+        for i, t, p in entries:
+            try:
+                r = await self.node.rpc.call(
+                    addr, "ds", "append", (shard, i, t, p, self.node_id),
+                    key=f"ds{shard}",
+                )
+            except Exception:
+                return
+            if not (isinstance(r, (list, tuple)) and r and r[0] == "ok"):
+                return
+            self._on_ack(shard, i, None)  # progress the ack sets too
+        try:
+            await self.node.rpc.cast(addr, "ds", "commit", (shard, upto), key=f"ds{shard}")
+        except Exception:
+            pass
+
+    # --- leader catch-up --------------------------------------------------
+
+    async def _sync_leadership(self, shard: int, term: int) -> None:
+        """First append of a new term: adopt the cluster's committed
+        prefix and re-commit stranded pending entries, then drain the
+        buffered writes."""
+        peers = self._peers()
+        tails = []
+        for peer, addr in peers:
+            try:
+                tails.append(
+                    await self.node.rpc.call(addr, "ds", "tail", (shard,))
+                )
+            except Exception:
+                continue
+        # pull committed entries we miss from the most advanced peer
+        best_applied = max([t[0] for t in tails], default=0)
+        with self._mutex:
+            my_applied = self._applied.get(shard, 0)
+        if best_applied > my_applied:
+            for (peer, addr), t in zip(peers, tails):
+                if t[0] != best_applied:
+                    continue
+                try:
+                    entries = await self.node.rpc.call(
+                        addr, "ds", "replay", (shard, my_applied)
+                    )
+                except Exception:
+                    continue
+                applied_any = False
+                with self._mutex:
+                    for i, p in entries:
+                        if i == self._applied.get(shard, 0) + 1:
+                            self._apply_locked(shard, i, p)
+                            applied_any = True
+                if applied_any:
+                    self.db._notify()
+                break
+        # adopt stranded pending entries (commit-previous-term): merge
+        # everyone's pending tail, highest term wins per index
+        merged: Dict[int, Tuple[int, list]] = {}
+        for t in tails:
+            for i, tm, p in t[1]:
+                if i > best_applied and (
+                    i not in merged or tm > merged[i][0]
+                ):
+                    merged[i] = (tm, p)
+        with self._mutex:
+            for i, (tm, p) in sorted(self._pending.get(shard, {}).items()):
+                if i > best_applied and (
+                    i not in merged or tm > merged[i][0]
+                ):
+                    merged[i] = (tm, p)
+            base = self._applied.get(shard, 0)
+            self._pending.setdefault(shard, {}).clear()
+            self._accepted[shard] = base
+            self._next_idx[shard] = base + 1
+            adopt: List[list] = [
+                p for i, (_tm, p) in sorted(merged.items()) if i > base
+            ]
+            bufs = self._lead_buf.pop(shard, [])
+            self._lead_synced[shard] = term
+            self._lead_syncing.discard(shard)
+            if self.term != term:
+                # membership moved again mid-sync; re-route everything
+                stranded = adopt + bufs
+            else:
+                stranded = None
+                work = []
+                for p in adopt + bufs:
+                    work.append(
+                        (self._assign_locked(shard, term, p), p)
+                    )
+        if stranded is not None:
+            for p in stranded:
+                self._resubmit(shard, p)
+            return
+        for idx, p in work:
+            self._replicate(shard, idx, term, p)
 
     # --- session-state replication ---------------------------------------
 

@@ -2,8 +2,8 @@
 Kill-node test: a durable session resumes on a peer WITH its messages.
 
 Ref: apps/emqx_ds_builtin_raft/src/emqx_ds_replication_layer.erl
-(raft-lite here: deterministic shard leaders, ordered apply, no
-quorum ack — see emqx_tpu/ds/replication.py docstring).
+(deterministic shard leaders + QUORUM-ACKED commits with term fencing
+and leader catch-up — see emqx_tpu/ds/replication.py docstring).
 """
 
 import asyncio
@@ -164,6 +164,112 @@ async def test_gap_recovery_via_replay(tmp_path):
             )[0]
         ]
         assert sorted(msgs) == [b"lost", b"next"]
+    finally:
+        for n in (n1, n2):
+            await n.stop()
+        for m in (m1, m2):
+            m.close()
+        for db in (db1, db2):
+            db.close()
+
+
+async def test_kill_leader_zero_committed_loss(tmp_path):
+    """VERDICT r2 #6: a committed (reader-visible) entry must survive
+    the death of the shard leader that ordered it. Three nodes, writes
+    spread over both shards, leader killed mid-stream: everything that
+    was visible on a surviving replica before the kill must still be
+    there after, and writes must keep flowing under the new term."""
+    n1, m1, db1, r1, a1 = await make_node("n1", tmp_path)
+    n2, m2, db2, r2, a2 = await make_node("n2", tmp_path, seed=a1)
+    n3, m3, db3, r3, a3 = await make_node("n3", tmp_path, seed=a1)
+    try:
+        await settle(0.3)
+        # shard leaders split across nodes (sorted round-robin)
+        assert r2.leader_of(0) == "n1" and r2.leader_of(1) == "n2"
+        # a durable subscriber (on n3) opens the persist gate
+        s, _ = n3.broker.open_session("dev1", True, DUR)
+        n3.broker.subscribe(s, "jobs/#", SubOpts(qos=1))
+        await settle(0.3)
+        # writes from varied publishers spread over shards; publish on
+        # n2 so some route to n1 (shard 0's leader)
+        for i in range(12):
+            n2.broker.publish(Message(
+                topic=f"jobs/{i}", payload=f"pre{i}".encode(), qos=1,
+                from_client=f"pub{i}",
+            ))
+        await settle(0.5)
+
+        def visible(db):
+            out = set()
+            for st in db.get_streams("jobs/#"):
+                batch, _ = db.storage.shards[st.shard].scan_stream(
+                    st, "jobs/#", b"", 0, 1000
+                )
+                out.update(m.payload for _k, m in batch)
+            return out
+
+        committed_before = visible(db2)
+        assert len(committed_before) == 12  # all 12 made it through quorum
+        assert visible(db3) == committed_before
+        # leader of shard 0 dies abruptly
+        await n1.stop()
+        db1.close()
+        # survivors detect the death and bump terms
+        await settle(0.8)
+        assert "n1" not in n2.membership.members
+        assert r2.leader_of(0) == "n2" and r3.leader_of(0) == "n2"
+        assert r2.term > 0 and r3.term > 0
+        # zero committed-entry loss on BOTH survivors
+        assert visible(db2) >= committed_before
+        assert visible(db3) >= committed_before
+        # and the shard keeps accepting writes under the new leadership
+        for i in range(6):
+            n3.broker.publish(Message(
+                topic=f"jobs/post{i}", payload=f"post{i}".encode(), qos=1,
+                from_client=f"pub{i}",
+            ))
+        await settle(0.6)
+        after2, after3 = visible(db2), visible(db3)
+        assert {f"post{i}".encode() for i in range(6)} <= after2
+        assert after2 == after3 == committed_before | {
+            f"post{i}".encode() for i in range(6)
+        }
+    finally:
+        for n in (n2, n3):
+            await n.stop()
+        for m in (m1, m2, m3):
+            m.close()
+        for db in (db2, db3):
+            db.close()
+
+
+async def test_stale_leader_fenced_by_term(tmp_path):
+    """An append stamped with an old term is rejected ('stale') and
+    carries the rejector's term back, so the old leader steps down."""
+    n1, m1, db1, r1, a1 = await make_node("n1", tmp_path)
+    n2, m2, db2, r2, a2 = await make_node("n2", tmp_path, seed=a1)
+    try:
+        await settle(0.2)
+        r2._bump_term()
+        r2._bump_term()
+        verdict = r2._handle_append(
+            0, 1, r2.term - 1,
+            [{"topic": "t", "payload": b"x", "qos": 0, "retain": False,
+              "from_client": "", "id": "i1", "timestamp": 1.0, "props": {}}],
+            "n1",
+        )
+        assert verdict[0] == "stale" and verdict[1] == r2.term
+        # an accepted entry is NOT visible until a commit arrives
+        ok = r2._handle_append(
+            0, 1, r2.term,
+            [{"topic": "t/u", "payload": b"unc", "qos": 0, "retain": False,
+              "from_client": "", "id": "i2", "timestamp": 1.0, "props": {}}],
+            "n1",
+        )
+        assert ok == ("ok",)
+        assert r2._applied.get(0, 0) == 0  # pending, invisible
+        r2._handle_commit(0, 1)
+        assert r2._applied.get(0) == 1  # visible only after commit
     finally:
         for n in (n1, n2):
             await n.stop()
