@@ -26,12 +26,14 @@ class GatewayRegistry:
         self._types: Dict[str, Type[GatewayImpl]] = {}
         self._running: Dict[str, GatewayImpl] = {}
         from .coap import CoapGateway
-        from .stomp import StompGateway
+        from .lwm2m import Lwm2mGateway
         from .mqttsn import MqttSnGateway
+        from .stomp import StompGateway
 
         self.register_type("stomp", StompGateway)
         self.register_type("mqttsn", MqttSnGateway)
         self.register_type("coap", CoapGateway)
+        self.register_type("lwm2m", Lwm2mGateway)
 
     def register_type(self, name: str, impl: Type[GatewayImpl]) -> None:
         self._types[name] = impl

@@ -40,9 +40,32 @@ UNAUTHORIZED = 0x81  # 4.01
 NOT_FOUND = 0x84  # 4.04
 
 OPT_OBSERVE = 6
+OPT_LOCATION_PATH = 8
 OPT_URI_PATH = 11
 OPT_CONTENT_FORMAT = 12
 OPT_URI_QUERY = 15
+OPT_BLOCK2 = 23  # RFC 7959: response payload transfer
+OPT_BLOCK1 = 27  # RFC 7959: request payload transfer
+CONTINUE = 0x5F  # 2.31
+REQUEST_ENTITY_INCOMPLETE = 0x88  # 4.08
+REQUEST_ENTITY_TOO_LARGE = 0x8D  # 4.13
+
+BLOCK_SZX = 6  # preferred block size 2^(6+4) = 1024
+MAX_BLOCKWISE_BODY = 1 << 20  # reassembly cap per transfer
+MAX_BLOCK1_TRANSFERS = 256
+
+
+def block_decode(v: bytes) -> Tuple[int, bool, int]:
+    """Block option uint -> (num, more, szx). Zero-length = 0."""
+    u = int.from_bytes(v, "big")
+    return u >> 4, bool(u & 0x8), u & 0x7
+
+
+def block_encode(num: int, more: bool, szx: int) -> bytes:
+    u = (num << 4) | (0x8 if more else 0) | szx
+    if u == 0:
+        return b"\x00"
+    return u.to_bytes((u.bit_length() + 7) // 8, "big")
 
 
 class CoapMessage:
@@ -171,6 +194,8 @@ class CoapGateway(GatewayImpl):
         self.peers: Dict[tuple, dict] = {}
         # unauthenticated UDP sources must not grow sessions unbounded
         self.max_peers = int(conf.get("max_connections", 10_000))
+        # Block1 reassembly buffers: (addr, path) -> bytearray
+        self._block1: Dict[tuple, bytearray] = {}
 
     async def on_load(self) -> None:
         from ..broker.listeners import parse_bind
@@ -214,8 +239,26 @@ class CoapGateway(GatewayImpl):
         else:
             self._mid = (self._mid + 1) & 0xFFFF
             mtype, mid = NON, self._mid
+        options = list(options or [])
+        # Block2 (RFC 7959): slice a large response; handlers are
+        # idempotent reads, so later blocks re-run the handler and we
+        # slice at the client's requested num — no response cache
+        b2 = req.opt(OPT_BLOCK2)
+        szx = BLOCK_SZX
+        num = 0
+        if b2 is not None:
+            num, _m, szx = block_decode(b2)
+            szx = min(szx, BLOCK_SZX)
+        size = 1 << (szx + 4)
+        if len(payload) > size:
+            chunk = payload[num * size : (num + 1) * size]
+            more = (num + 1) * size < len(payload)
+            options.append((OPT_BLOCK2, block_encode(num, more, szx)))
+            payload = chunk
+        elif b2 is not None and num > 0:
+            options.append((OPT_BLOCK2, block_encode(num, False, szx)))
         self._send(addr, CoapMessage(mtype, code, mid, req.token,
-                                     options or [], payload))
+                                     options, payload))
 
     def _peer(self, addr, query: Dict[str, str]) -> dict:
         p = self.peers.get(addr)
@@ -253,6 +296,36 @@ class CoapGateway(GatewayImpl):
             self._reply(addr, msg, NOT_FOUND)
             return
         topic = "/".join(path[1:])
+        # Block1 (RFC 7959): reassemble a multi-block request body
+        # before dispatching it
+        b1 = msg.opt(OPT_BLOCK1)
+        if b1 is not None:
+            num, more, szx = block_decode(b1)
+            size = 1 << (szx + 4)
+            key = (addr, "/".join(path))
+            buf = self._block1.get(key)
+            if num == 0:
+                if buf is None and len(self._block1) >= MAX_BLOCK1_TRANSFERS:
+                    self._reply(addr, msg, 0xA3)  # 5.03
+                    return
+                buf = self._block1[key] = bytearray()
+            elif buf is None or len(buf) != num * size:
+                # missing/mismatched prefix: restart the transfer
+                self._block1.pop(key, None)
+                self._reply(addr, msg, REQUEST_ENTITY_INCOMPLETE,
+                            options=[(OPT_BLOCK1, b1)])
+                return
+            if len(buf) + len(msg.payload) > MAX_BLOCKWISE_BODY:
+                self._block1.pop(key, None)
+                self._reply(addr, msg, REQUEST_ENTITY_TOO_LARGE)
+                return
+            buf += msg.payload
+            if more:
+                self._reply(addr, msg, CONTINUE,
+                            options=[(OPT_BLOCK1, b1)])
+                return
+            msg.payload = bytes(self._block1.pop(key))
+            # final response echoes Block1 (handled below by dispatch)
         try:
             if msg.code in (PUT, POST):
                 self._handle_publish(addr, msg, topic, query)

@@ -1,0 +1,528 @@
+"""LwM2M gateway — the emqx_gateway_lwm2m analog, on the CoAP codec.
+
+Reference: apps/emqx_gateway_lwm2m/src/emqx_lwm2m_channel.erl
+(registration interface), emqx_lwm2m_cmd.erl (MQTT downlink commands
+-> CoAP requests and responses -> MQTT uplink), emqx_lwm2m_tlv.erl
+(OMA-TS-LightweightM2M §6.4.3 TLV codec).
+
+Protocol surface:
+
+  device -> gateway (CoAP over UDP):
+    POST /rd?ep={endpoint}&lt={lifetime}&lwm2m={ver}&b={binding}
+         payload "</1/0>,</3/0>,..."      -> 2.01 + Location /rd/{id}
+    POST /rd/{id}?lt=...                  -> update       -> 2.04
+    DELETE /rd/{id}                       -> deregister   -> 2.02
+    2.05 responses / NON notifications    -> uplink publishes
+
+  MQTT -> device (downlink commands on lwm2m/{ep}/dn/+, JSON):
+    {"reqID": 7, "msgType": "read",    "data": {"path": "/3/0/0"}}
+    {"reqID": 8, "msgType": "write",   "data": {"path": "/3/0/14",
+                                       "type": "Integer", "value": 5}}
+    {"reqID": 9, "msgType": "execute", "data": {"path": "/3/0/4",
+                                       "args": "0"}}
+    {"reqID": 10, "msgType": "observe"/"cancel-observe",
+                                       "data": {"path": "/3/0/1"}}
+    {"reqID": 11, "msgType": "discover", "data": {"path": "/3"}}
+
+  gateway -> MQTT uplinks:
+    lwm2m/{ep}/up/resp    command responses + register/update events
+    lwm2m/{ep}/up/notify  observe notifications
+
+One registered endpoint = one broker session (gateway CM glue), so
+LwM2M devices interoperate with MQTT clients through pubsub. Lifetime
+expiry reaps silent registrations (same GC shape as the MQTT-SN
+keepalive sweeper).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import struct
+import time
+from typing import Dict, List, Optional, Tuple
+
+from .base import GatewayImpl
+from .coap import (
+    ACK, BAD_REQUEST, CHANGED, CON, CREATED, DELETE, DELETED, GET, NON,
+    NOT_FOUND, OPT_CONTENT_FORMAT, OPT_LOCATION_PATH, OPT_OBSERVE,
+    OPT_URI_PATH, OPT_URI_QUERY, POST, PUT, RST, CoapMessage, decode, encode,
+)
+
+log = logging.getLogger("emqx_tpu.gateway.lwm2m")
+
+CF_TLV = 11542  # application/vnd.oma.lwm2m+tlv
+CF_TEXT = 0
+
+# --- TLV codec (OMA-TS-LightweightM2M §6.4.3; emqx_lwm2m_tlv.erl) ---------
+
+T_OBJECT_INSTANCE = 0
+T_RESOURCE_INSTANCE = 1
+T_MULTIPLE_RESOURCE = 2
+T_RESOURCE = 3
+
+
+def tlv_encode(entries: List[dict]) -> bytes:
+    """entries: [{"type": T_*, "id": int, "value": bytes} or
+    {"type": ..., "id": ..., "children": [...]}]."""
+    out = bytearray()
+    for e in entries:
+        if "children" in e:
+            value = tlv_encode(e["children"])
+        else:
+            value = e["value"]
+        t = e["type"] << 6
+        ident = e["id"]
+        if ident > 0xFF:
+            t |= 0x20
+            idb = struct.pack(">H", ident)
+        else:
+            idb = bytes([ident])
+        n = len(value)
+        if n < 8:
+            out.append(t | n)
+            out += idb
+        elif n <= 0xFF:
+            out.append(t | 0x08)
+            out += idb + bytes([n])
+        elif n <= 0xFFFF:
+            out.append(t | 0x10)
+            out += idb + struct.pack(">H", n)
+        else:
+            out.append(t | 0x18)
+            out += idb + n.to_bytes(3, "big")
+        out += value
+    return bytes(out)
+
+
+def tlv_decode(data: bytes) -> List[dict]:
+    out = []
+    off = 0
+    n = len(data)
+    while off < n:
+        t = data[off]
+        off += 1
+        typ = t >> 6
+        if t & 0x20:
+            ident = struct.unpack_from(">H", data, off)[0]
+            off += 2
+        else:
+            ident = data[off]
+            off += 1
+        lt = (t >> 3) & 0x3
+        if lt == 0:
+            length = t & 0x7
+        elif lt == 1:
+            length = data[off]
+            off += 1
+        elif lt == 2:
+            length = struct.unpack_from(">H", data, off)[0]
+            off += 2
+        else:
+            length = int.from_bytes(data[off : off + 3], "big")
+            off += 3
+        value = data[off : off + length]
+        if len(value) < length:
+            raise ValueError("truncated TLV")
+        off += length
+        if typ in (T_OBJECT_INSTANCE, T_MULTIPLE_RESOURCE):
+            out.append({"type": typ, "id": ident,
+                        "children": tlv_decode(value)})
+        else:
+            out.append({"type": typ, "id": ident, "value": bytes(value)})
+    return out
+
+
+def tlv_value_encode(kind: str, value) -> bytes:
+    """MQTT command value -> TLV resource bytes (emqx_lwm2m_cmd value
+    coercion)."""
+    k = (kind or "String").lower()
+    if k in ("integer", "time"):
+        v = int(value)
+        for size in (1, 2, 4, 8):
+            try:
+                return v.to_bytes(size, "big", signed=True)
+            except OverflowError:
+                continue
+        raise ValueError("integer too large")
+    if k == "float":
+        return struct.pack(">d", float(value))
+    if k in ("boolean", "bool"):
+        return b"\x01" if value in (True, 1, "1", "true") else b"\x00"
+    if k == "opaque":
+        return bytes.fromhex(value) if isinstance(value, str) else bytes(value)
+    return str(value).encode()
+
+
+def _tlv_json(entries: List[dict]) -> list:
+    """TLV -> JSON-friendly uplink shape (values as utf-8 when clean,
+    else int for short binary, else hex)."""
+    out = []
+    for e in entries:
+        o: dict = {"type": e["type"], "id": e["id"]}
+        if "children" in e:
+            o["children"] = _tlv_json(e["children"])
+        else:
+            v = e["value"]
+            try:
+                txt = v.decode("utf-8")
+                printable = all(31 < c < 127 for c in v)
+            except UnicodeDecodeError:
+                printable = False
+            if printable:
+                o["value"] = txt
+            elif 0 < len(v) <= 8:
+                o["value"] = int.from_bytes(v, "big", signed=True)
+            else:
+                o["value"] = v.hex()
+        out.append(o)
+    return out
+
+
+class _Registration:
+    def __init__(self, reg_id: str, ep: str, addr, lifetime: int,
+                 binding: str, links: str, session):
+        self.reg_id = reg_id
+        self.ep = ep
+        self.addr = addr
+        self.lifetime = lifetime
+        self.binding = binding
+        self.links = links
+        self.session = session
+        self.last_seen = time.time()
+        # pending downlink commands: token -> (req_id, msg_type, path)
+        self.pending: Dict[bytes, Tuple[object, str, str]] = {}
+        # observe tokens: path -> token
+        self.observes: Dict[str, bytes] = {}
+
+
+class _LwProtocol(asyncio.DatagramProtocol):
+    def __init__(self, gw: "Lwm2mGateway"):
+        self.gw = gw
+
+    def connection_made(self, transport) -> None:
+        self.gw._transport = transport
+
+    def datagram_received(self, data: bytes, addr) -> None:
+        try:
+            self.gw.handle_datagram(data, addr)
+        except ValueError as e:
+            log.debug("bad lwm2m datagram from %s: %s", addr, e)
+        except Exception:
+            log.exception("lwm2m datagram crashed")
+
+
+class Lwm2mGateway(GatewayImpl):
+    name = "lwm2m"
+
+    def __init__(self, broker, conf: dict):
+        super().__init__(broker, conf)
+        self._transport = None
+        self.listen_addr = None
+        self._mid = 0
+        self._next_reg = 0
+        self._next_token = 0
+        self.regs: Dict[str, _Registration] = {}  # reg_id -> reg
+        self.by_ep: Dict[str, str] = {}
+        self.by_addr: Dict[tuple, str] = {}
+        self.max_regs = int(conf.get("max_connections", 10_000))
+        self.lifetime_mult = float(conf.get("lifetime_multiplier", 1.2))
+        self._gc_task = None
+        self.uplink_tpl = conf.get("uplink_topic", "lwm2m/%e/up/%t")
+        self.dnlink_tpl = conf.get("downlink_topic", "lwm2m/%e/dn/+")
+
+    # --- lifecycle -------------------------------------------------------
+
+    async def on_load(self) -> None:
+        from ..broker.listeners import parse_bind
+
+        host, port = parse_bind(self.conf.get("bind", "0.0.0.0:5783"))
+        loop = asyncio.get_running_loop()
+        self._transport, _ = await loop.create_datagram_endpoint(
+            lambda: _LwProtocol(self), local_addr=(host, port)
+        )
+        self.listen_addr = self._transport.get_extra_info("sockname")[:2]
+        self._gc_task = asyncio.ensure_future(self._gc_loop())
+        log.info("lwm2m gateway on %s", self.listen_addr)
+
+    async def on_unload(self) -> None:
+        if self._gc_task is not None:
+            self._gc_task.cancel()
+            self._gc_task = None
+        for reg_id in list(self.regs):
+            self._drop_reg(reg_id)
+        if self._transport is not None:
+            self._transport.close()
+            self._transport = None
+
+    def connection_count(self) -> int:
+        return len(self.regs)
+
+    def listener_info(self) -> List[dict]:
+        return (
+            [{"type": "udp",
+              "bind": f"{self.listen_addr[0]}:{self.listen_addr[1]}"}]
+            if self.listen_addr else []
+        )
+
+    async def _gc_loop(self) -> None:
+        """Reap registrations whose lifetime elapsed without an update
+        (emqx_lwm2m_channel keepalive; same sweeper shape as MQTT-SN)."""
+        while True:
+            await asyncio.sleep(1.0)
+            now = time.time()
+            for reg_id in list(self.regs):
+                r = self.regs.get(reg_id)
+                if r and now - r.last_seen > r.lifetime * self.lifetime_mult:
+                    log.info("lwm2m %s lifetime expired", r.ep)
+                    self._drop_reg(reg_id)
+
+    # --- wire helpers ----------------------------------------------------
+
+    def _send(self, addr, msg: CoapMessage) -> None:
+        if self._transport is not None:
+            self._transport.sendto(encode(msg), addr)
+
+    def _reply(self, addr, req: CoapMessage, code: int, payload: bytes = b"",
+               options=None) -> None:
+        if req.mtype == CON:
+            mtype, mid = ACK, req.mid
+        else:
+            self._mid = (self._mid + 1) & 0xFFFF
+            mtype, mid = NON, self._mid
+        self._send(addr, CoapMessage(mtype, code, mid, req.token,
+                                     options or [], payload))
+
+    def _uplink(self, reg: _Registration, kind: str, body: dict) -> None:
+        topic = self.uplink_tpl.replace("%e", reg.ep).replace("%t", kind)
+        try:
+            self.publish(reg.session, topic,
+                         json.dumps(body).encode(), qos=0)
+        except (ValueError, PermissionError) as e:
+            log.warning("lwm2m uplink denied: %s", e)
+
+    # --- device -> gateway -----------------------------------------------
+
+    def handle_datagram(self, data: bytes, addr) -> None:
+        msg = decode(data)
+        if msg.mtype == RST:
+            return
+        if 1 <= msg.code <= 4:  # request: the registration interface
+            self._handle_request(msg, addr)
+            return
+        if msg.code >= 0x40:  # response from the device
+            self._handle_device_response(msg, addr)
+
+    def _handle_request(self, msg: CoapMessage, addr) -> None:
+        path = [v.decode("utf-8", "replace")
+                for v in msg.opt_all(OPT_URI_PATH)]
+        query = dict(
+            q.decode("utf-8", "replace").partition("=")[::2]
+            for q in msg.opt_all(OPT_URI_QUERY)
+        )
+        if not path or path[0] != "rd":
+            self._reply(addr, msg, NOT_FOUND)
+            return
+        if msg.code == POST and len(path) == 1:
+            self._register(msg, addr, query)
+        elif msg.code == POST and len(path) == 2:
+            self._update(msg, addr, path[1], query)
+        elif msg.code == DELETE and len(path) == 2:
+            reg = self.regs.get(path[1])
+            if reg is None:
+                self._reply(addr, msg, NOT_FOUND)
+                return
+            self._drop_reg(path[1])
+            self._reply(addr, msg, DELETED)
+        else:
+            self._reply(addr, msg, BAD_REQUEST)
+
+    def _register(self, msg: CoapMessage, addr, query: Dict[str, str]) -> None:
+        ep = query.get("ep")
+        if not ep:
+            self._reply(addr, msg, BAD_REQUEST, b"ep required")
+            return
+        if len(self.regs) >= self.max_regs and ep not in self.by_ep:
+            self._reply(addr, msg, 0xA3)  # 5.03
+            return
+        # re-registration replaces the old one (same endpoint name)
+        old = self.by_ep.pop(ep, None)
+        if old is not None:
+            self._drop_reg(old)
+        lifetime = int(query.get("lt", "86400") or 86400)
+        self._next_reg += 1
+        reg_id = f"{self._next_reg:x}"
+        try:
+            session, _ = self.open_session(ep)
+        except Exception:
+            self._reply(addr, msg, 0x81)  # 4.01
+            return
+        reg = _Registration(
+            reg_id, ep, addr, lifetime, query.get("b", "U"),
+            msg.payload.decode("utf-8", "replace"), session,
+        )
+        self.regs[reg_id] = reg
+        self.by_ep[ep] = reg_id
+        self.by_addr[addr] = reg_id
+        session.outgoing_sink = lambda pkts, r=reg_id: self._downlink(r, pkts)
+        try:
+            self.subscribe(session, self.dnlink_tpl.replace("%e", ep), qos=0)
+        except PermissionError:
+            self._drop_reg(reg_id)
+            self._reply(addr, msg, 0x81)
+            return
+        self._reply(
+            addr, msg, CREATED,
+            options=[(OPT_LOCATION_PATH, b"rd"),
+                     (OPT_LOCATION_PATH, reg_id.encode())],
+        )
+        self._uplink(reg, "resp", {
+            "msgType": "register",
+            "data": {"ep": ep, "lt": lifetime, "lwm2m": query.get("lwm2m"),
+                     "b": reg.binding, "alternatePath": "/",
+                     "objectList": reg.links.split(",") if reg.links else []},
+        })
+
+    def _update(self, msg, addr, reg_id: str, query: Dict[str, str]) -> None:
+        reg = self.regs.get(reg_id)
+        if reg is None:
+            self._reply(addr, msg, NOT_FOUND)
+            return
+        reg.last_seen = time.time()
+        reg.addr = addr  # NAT rebinding moves the source address
+        self.by_addr[addr] = reg_id
+        if "lt" in query:
+            reg.lifetime = int(query["lt"])
+        if msg.payload:
+            reg.links = msg.payload.decode("utf-8", "replace")
+        self._reply(addr, msg, CHANGED)
+        self._uplink(reg, "resp", {
+            "msgType": "update",
+            "data": {"ep": reg.ep, "lt": reg.lifetime},
+        })
+
+    def _drop_reg(self, reg_id: str) -> None:
+        reg = self.regs.pop(reg_id, None)
+        if reg is None:
+            return
+        self.by_ep.pop(reg.ep, None)
+        self.by_addr.pop(reg.addr, None)
+        self.close_session(reg.session)
+
+    # --- MQTT downlink -> CoAP request to the device ----------------------
+
+    def _downlink(self, reg_id: str, pkts) -> None:
+        reg = self.regs.get(reg_id)
+        if reg is None:
+            return
+        for pkt in pkts:
+            try:
+                cmd = json.loads(pkt.payload)
+            except Exception:
+                log.warning("lwm2m %s: bad downlink json", reg.ep)
+                continue
+            try:
+                self._send_command(reg, cmd)
+            except (KeyError, ValueError) as e:
+                self._uplink(reg, "resp", {
+                    "reqID": cmd.get("reqID"),
+                    "msgType": cmd.get("msgType"),
+                    "data": {"code": "4.00", "codeMsg": f"bad command: {e}"},
+                })
+
+    def _send_command(self, reg: _Registration, cmd: dict) -> None:
+        msg_type = cmd["msgType"]
+        data = cmd.get("data") or {}
+        path = data["path"]
+        segs = [s for s in path.split("/") if s]
+        self._next_token += 1
+        token = self._next_token.to_bytes(4, "big")
+        self._mid = (self._mid + 1) & 0xFFFF
+        opts: List[Tuple[int, bytes]] = [
+            (OPT_URI_PATH, s.encode()) for s in segs
+        ]
+        payload = b""
+        if msg_type == "read":
+            code = GET
+        elif msg_type == "discover":
+            code = GET
+            opts.append((OPT_CONTENT_FORMAT, b"\x28"))  # link-format 40
+        elif msg_type == "observe":
+            code = GET
+            opts.insert(0, (OPT_OBSERVE, b""))  # 0: register
+            reg.observes[path] = token
+        elif msg_type == "cancel-observe":
+            code = GET
+            opts.insert(0, (OPT_OBSERVE, b"\x01"))
+            reg.observes.pop(path, None)
+        elif msg_type == "write":
+            code = PUT
+            rid = int(segs[-1])
+            payload = tlv_encode([{
+                "type": T_RESOURCE, "id": rid,
+                "value": tlv_value_encode(data.get("type"), data["value"]),
+            }])
+            opts.append((OPT_CONTENT_FORMAT,
+                         struct.pack(">H", CF_TLV)))
+        elif msg_type == "execute":
+            code = POST
+            payload = str(data.get("args", "")).encode()
+        else:
+            raise ValueError(f"unknown msgType {msg_type!r}")
+        reg.pending[token] = (cmd.get("reqID"), msg_type, path)
+        self._send(reg.addr, CoapMessage(CON, code, self._mid, token,
+                                         opts, payload))
+
+    # --- device responses / notifications -> MQTT uplink ------------------
+
+    def _handle_device_response(self, msg: CoapMessage, addr) -> None:
+        reg_id = self.by_addr.get(addr)
+        reg = self.regs.get(reg_id) if reg_id else None
+        if reg is None:
+            return
+        reg.last_seen = time.time()
+        code_str = f"{msg.code >> 5}.{msg.code & 0x1F:02d}"
+        obs = msg.opt(OPT_OBSERVE)
+        content = self._decode_content(msg)
+        pend = reg.pending.pop(msg.token, None)
+        if pend is not None:
+            req_id, msg_type, path = pend
+            body = {
+                "reqID": req_id,
+                "msgType": msg_type,
+                "data": {"code": code_str, "reqPath": path,
+                         "content": content},
+            }
+            # an observe's LATER notifications match via reg.observes
+            # (the token stays registered there, not in pending)
+            self._uplink(reg, "resp", body)
+            return
+        if obs is not None:
+            # notification on a standing observe token
+            for path, tok in reg.observes.items():
+                if tok == msg.token:
+                    self._uplink(reg, "notify", {
+                        "msgType": "notify",
+                        "seqNum": int.from_bytes(obs, "big"),
+                        "data": {"code": code_str, "reqPath": path,
+                                 "content": content},
+                    })
+                    if msg.mtype == CON:  # ack confirmable notifies
+                        self._send(addr, CoapMessage(ACK, 0, msg.mid, b""))
+                    return
+
+    def _decode_content(self, msg: CoapMessage):
+        cf = msg.opt(OPT_CONTENT_FORMAT)
+        cfv = int.from_bytes(cf, "big") if cf else CF_TEXT
+        if not msg.payload:
+            return None
+        if cfv in (CF_TLV, 11543, 110):  # tlv (+legacy ids)
+            try:
+                return _tlv_json(tlv_decode(msg.payload))
+            except ValueError:
+                return msg.payload.hex()
+        try:
+            return msg.payload.decode("utf-8")
+        except UnicodeDecodeError:
+            return msg.payload.hex()

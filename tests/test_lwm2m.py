@@ -1,0 +1,310 @@
+"""LwM2M gateway e2e: a fake device over a real UDP socket registers,
+answers reads/writes/observes in TLV, and interoperates with MQTT
+subscribers through pubsub — plus CoAP blockwise (RFC 7959) transfers.
+
+Ref: apps/emqx_gateway_lwm2m/src/emqx_lwm2m_channel.erl,
+emqx_lwm2m_cmd.erl, emqx_lwm2m_tlv.erl; apps/emqx_gateway_coap
+(blockwise).
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from emqx_tpu.broker.packet import SubOpts
+from emqx_tpu.broker.pubsub import Broker
+from emqx_tpu.gateway import GatewayRegistry
+from emqx_tpu.gateway.coap import (
+    ACK, CHANGED, CON, CONTENT, CONTINUE, CREATED, DELETE, GET, NON, POST,
+    PUT, OPT_BLOCK1, OPT_CONTENT_FORMAT, OPT_LOCATION_PATH, OPT_OBSERVE,
+    OPT_URI_PATH, OPT_URI_QUERY, CoapMessage, block_encode, decode, encode,
+)
+from emqx_tpu.gateway.lwm2m import (
+    CF_TLV, T_OBJECT_INSTANCE, T_RESOURCE, _tlv_json, tlv_decode, tlv_encode,
+    tlv_value_encode,
+)
+
+
+def test_tlv_roundtrip():
+    entries = [
+        {"type": T_OBJECT_INSTANCE, "id": 0, "children": [
+            {"type": T_RESOURCE, "id": 0, "value": b"EMQX-TPU"},
+            {"type": T_RESOURCE, "id": 1, "value": (42).to_bytes(2, "big")},
+            {"type": T_RESOURCE, "id": 300, "value": b"x" * 300},
+        ]},
+        {"type": T_RESOURCE, "id": 9, "value": b"\x05"},
+    ]
+    wire = tlv_encode(entries)
+    back = tlv_decode(wire)
+    assert back[0]["id"] == 0 and len(back[0]["children"]) == 3
+    assert back[0]["children"][0]["value"] == b"EMQX-TPU"
+    assert back[0]["children"][2]["id"] == 300
+    assert back[1]["value"] == b"\x05"
+    j = _tlv_json(back)
+    assert j[0]["children"][0]["value"] == "EMQX-TPU"
+    assert j[1]["value"] == 5
+    assert tlv_value_encode("Integer", 1000) == b"\x03\xe8"
+    assert tlv_value_encode("String", "hi") == b"hi"
+
+
+class FakeDevice:
+    """LwM2M client endpoint: real UDP datagrams, scripted responses."""
+
+    def __init__(self):
+        self.transport = None
+        self.inbox = asyncio.Queue()
+
+    async def start(self):
+        loop = asyncio.get_running_loop()
+        outer = self
+
+        class P(asyncio.DatagramProtocol):
+            def connection_made(self, tr):
+                outer.transport = tr
+
+            def datagram_received(self, data, addr):
+                outer.inbox.put_nowait((decode(data), addr))
+
+        self.transport, _ = await loop.create_datagram_endpoint(
+            P, local_addr=("127.0.0.1", 0)
+        )
+        self.addr = self.transport.get_extra_info("sockname")[:2]
+
+    def send(self, gw_addr, msg):
+        self.transport.sendto(encode(msg), gw_addr)
+
+    async def recv(self, timeout=2.0):
+        return await asyncio.wait_for(self.inbox.get(), timeout)
+
+    def close(self):
+        self.transport.close()
+
+
+def _register_msg(ep, mid=1, lt=120):
+    return CoapMessage(
+        CON, POST, mid, b"rt",
+        [(OPT_URI_PATH, b"rd"), (OPT_URI_QUERY, f"ep={ep}".encode()),
+         (OPT_URI_QUERY, f"lt={lt}".encode()),
+         (OPT_URI_QUERY, b"lwm2m=1.0")],
+        b"</3/0>,</1/0>",
+    )
+
+
+def capture(broker, cid, flt):
+    s, _ = broker.open_session(cid, True)
+    box = []
+    s.outgoing_sink = box.extend
+    broker.subscribe(s, flt, SubOpts(qos=0))
+    return box
+
+
+@pytest.mark.asyncio
+async def test_lwm2m_register_read_write_observe_deregister():
+    broker = Broker()
+    reg = GatewayRegistry(broker)
+    gw = await reg.load("lwm2m", {"bind": "127.0.0.1:0"})
+    dev = FakeDevice()
+    await dev.start()
+    up = capture(broker, "watcher", "lwm2m/dev-1/up/#")
+    try:
+        # --- register ---------------------------------------------------
+        dev.send(gw.listen_addr, _register_msg("dev-1"))
+        ack, _ = await dev.recv()
+        assert ack.mtype == ACK and ack.code == CREATED
+        loc = [v for n, v in ack.options if n == OPT_LOCATION_PATH]
+        assert loc[0] == b"rd"
+        reg_id = loc[1].decode()
+        await asyncio.sleep(0.05)
+        ev = json.loads(up[0].payload)
+        assert ev["msgType"] == "register" and ev["data"]["ep"] == "dev-1"
+        assert "</3/0>" in ev["data"]["objectList"]
+        assert gw.connection_count() == 1
+
+        # --- downlink read -> device GET -> TLV response -> uplink ------
+        broker.publish_str = None
+        from emqx_tpu.broker.message import Message
+
+        broker.publish(Message(
+            topic="lwm2m/dev-1/dn/cmd",
+            payload=json.dumps({
+                "reqID": 7, "msgType": "read", "data": {"path": "/3/0/0"}
+            }).encode(),
+        ))
+        req, gw_addr = await dev.recv()
+        assert req.code == GET
+        path = [v.decode() for n, v in req.options if n == OPT_URI_PATH]
+        assert path == ["3", "0", "0"]
+        dev.send(gw_addr, CoapMessage(
+            ACK, CONTENT, req.mid, req.token,
+            [(OPT_CONTENT_FORMAT, (11542).to_bytes(2, "big"))],
+            tlv_encode([{"type": T_RESOURCE, "id": 0, "value": b"EMQX"}]),
+        ))
+        await asyncio.sleep(0.05)
+        resp = json.loads(up[-1].payload)
+        assert resp["reqID"] == 7 and resp["data"]["code"] == "2.05"
+        assert resp["data"]["content"][0]["value"] == "EMQX"
+
+        # --- downlink write -> device PUT with TLV ----------------------
+        broker.publish(Message(
+            topic="lwm2m/dev-1/dn/cmd",
+            payload=json.dumps({
+                "reqID": 8, "msgType": "write",
+                "data": {"path": "/3/0/14", "type": "Integer", "value": 5},
+            }).encode(),
+        ))
+        wreq, _ = await dev.recv()
+        assert wreq.code == PUT
+        decoded = tlv_decode(wreq.payload)
+        assert decoded[0]["id"] == 14 and decoded[0]["value"] == b"\x05"
+        dev.send(gw_addr, CoapMessage(ACK, CHANGED, wreq.mid, wreq.token))
+        await asyncio.sleep(0.05)
+        assert json.loads(up[-1].payload)["data"]["code"] == "2.04"
+
+        # --- observe + notifications ------------------------------------
+        broker.publish(Message(
+            topic="lwm2m/dev-1/dn/cmd",
+            payload=json.dumps({
+                "reqID": 9, "msgType": "observe", "data": {"path": "/3/0/1"}
+            }).encode(),
+        ))
+        oreq, _ = await dev.recv()
+        assert oreq.opt(OPT_OBSERVE) == b""
+        dev.send(gw_addr, CoapMessage(
+            ACK, CONTENT, oreq.mid, oreq.token,
+            [(OPT_OBSERVE, b"\x01")], b"21",
+        ))
+        await asyncio.sleep(0.05)
+        assert json.loads(up[-1].payload)["reqID"] == 9
+        # device pushes a NON notification later
+        dev.send(gw_addr, CoapMessage(
+            NON, CONTENT, 999, oreq.token, [(OPT_OBSERVE, b"\x02")], b"22",
+        ))
+        await asyncio.sleep(0.05)
+        note = json.loads(up[-1].payload)
+        assert up[-1].topic == "lwm2m/dev-1/up/notify"
+        assert note["msgType"] == "notify" and note["data"]["content"] == "22"
+        assert note["data"]["reqPath"] == "/3/0/1"
+
+        # --- update refreshes the lifetime -------------------------------
+        dev.send(gw.listen_addr, CoapMessage(
+            CON, POST, 77, b"up",
+            [(OPT_URI_PATH, b"rd"), (OPT_URI_PATH, reg_id.encode()),
+             (OPT_URI_QUERY, b"lt=600")],
+        ))
+        uack, _ = await dev.recv()
+        assert uack.code == CHANGED
+        assert gw.regs[reg_id].lifetime == 600
+
+        # --- deregister ---------------------------------------------------
+        dev.send(gw.listen_addr, CoapMessage(
+            CON, DELETE, 78, b"de",
+            [(OPT_URI_PATH, b"rd"), (OPT_URI_PATH, reg_id.encode())],
+        ))
+        dack, _ = await dev.recv()
+        assert dack.code == 0x42  # 2.02 Deleted
+        assert gw.connection_count() == 0
+    finally:
+        dev.close()
+        await reg.unload_all()
+
+
+@pytest.mark.asyncio
+async def test_lwm2m_lifetime_expiry_reaps():
+    broker = Broker()
+    reg = GatewayRegistry(broker)
+    gw = await reg.load("lwm2m", {"bind": "127.0.0.1:0",
+                                  "lifetime_multiplier": 1.0})
+    dev = FakeDevice()
+    await dev.start()
+    try:
+        dev.send(gw.listen_addr, _register_msg("dev-2", lt=1))
+        await dev.recv()
+        assert gw.connection_count() == 1
+        await asyncio.sleep(2.3)  # 1s lifetime + 1s gc cadence
+        assert gw.connection_count() == 0
+    finally:
+        dev.close()
+        await reg.unload_all()
+
+
+@pytest.mark.asyncio
+async def test_coap_blockwise_put_and_get():
+    """RFC 7959: a 2.5-block PUT reassembles into ONE publish; a large
+    retained message reads back through Block2 slices."""
+    broker = Broker()
+    reg = GatewayRegistry(broker)
+    gw = await reg.load("coap", {"bind": "127.0.0.1:0"})
+    dev = FakeDevice()
+    await dev.start()
+    box = capture(broker, "sub1", "big/#")
+    try:
+        body = bytes(range(256)) * 10  # 2560 bytes -> 3 blocks of 1024
+        blocks = [body[i:i + 1024] for i in range(0, len(body), 1024)]
+        for i, chunk in enumerate(blocks):
+            more = i < len(blocks) - 1
+            dev.send(gw.listen_addr, CoapMessage(
+                CON, PUT, 100 + i, b"bw",
+                [(OPT_URI_PATH, b"ps"), (OPT_URI_PATH, b"big"),
+                 (OPT_URI_PATH, b"data"),
+                 (OPT_URI_QUERY, b"clientid=blockdev"),
+                 (OPT_URI_QUERY, b"retain=1"),
+                 (OPT_BLOCK1, block_encode(i, more, 6))],
+                chunk,
+            ))
+            ack, _ = await dev.recv()
+            assert ack.code == (CONTINUE if more else CHANGED), hex(ack.code)
+        await asyncio.sleep(0.05)
+        assert len(box) == 1 and box[0].payload == body  # ONE reassembled msg
+
+        # Block2 read-back of the retained message
+        got = b""
+        num = 0
+        while True:
+            opts = [(OPT_URI_PATH, b"ps"), (OPT_URI_PATH, b"big"),
+                    (OPT_URI_PATH, b"data")]
+            if num:
+                from emqx_tpu.gateway.coap import OPT_BLOCK2
+                opts.append((OPT_BLOCK2, block_encode(num, False, 6)))
+            dev.send(gw.listen_addr,
+                     CoapMessage(CON, GET, 200 + num, b"rd", opts))
+            resp, _ = await dev.recv()
+            assert resp.code == CONTENT
+            got += resp.payload
+            from emqx_tpu.gateway.coap import OPT_BLOCK2, block_decode
+            b2 = resp.opt(OPT_BLOCK2)
+            assert b2 is not None
+            bn, more, _szx = block_decode(b2)
+            assert bn == num
+            if not more:
+                break
+            num += 1
+        assert got == body
+    finally:
+        dev.close()
+        await reg.unload_all()
+
+
+@pytest.mark.asyncio
+async def test_block1_gap_rejected():
+    """A mid-transfer gap gets 4.08 Request Entity Incomplete and the
+    transfer restarts cleanly."""
+    broker = Broker()
+    reg = GatewayRegistry(broker)
+    gw = await reg.load("coap", {"bind": "127.0.0.1:0"})
+    dev = FakeDevice()
+    await dev.start()
+    try:
+        # block 1 without block 0 first
+        dev.send(gw.listen_addr, CoapMessage(
+            CON, PUT, 300, b"gp",
+            [(OPT_URI_PATH, b"ps"), (OPT_URI_PATH, b"g"),
+             (OPT_URI_QUERY, b"clientid=gapdev"),
+             (OPT_BLOCK1, block_encode(1, True, 6))],
+            b"x" * 1024,
+        ))
+        ack, _ = await dev.recv()
+        assert ack.code == 0x88  # 4.08
+    finally:
+        dev.close()
+        await reg.unload_all()
