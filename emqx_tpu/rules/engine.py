@@ -62,7 +62,15 @@ def _get_path(env: Dict[str, Any], path: List[str]) -> Any:
         else:
             return None
     if isinstance(cur, bytes):
-        cur = cur.decode("utf-8", "replace")
+        # strict-else-bytes keeps binary payloads LOSSLESS end to end:
+        # a valid-utf8 payload round-trips through str (decode/encode
+        # are inverse), an invalid one stays bytes for the binary
+        # consumers (schema_decode of avro/protobuf wire payloads) —
+        # 'replace' corrupted them irreversibly
+        try:
+            cur = cur.decode("utf-8")
+        except UnicodeDecodeError:
+            pass
     return cur
 
 

@@ -206,3 +206,32 @@ FUNCS["topic_levels"] = lambda t: topic_mod.words(_str(t))
 
 FUNCS["coalesce"] = lambda *xs: next((x for x in xs if x is not None), None)
 FUNCS["iif"] = lambda c, a, b: a if c in (True, "true") else b
+
+# --- schema registry (emqx_schema_registry_serde rule functions) --------
+
+
+def _schema_registry():
+    from ..transform.registry import default_registry
+
+    return default_registry()
+
+
+@func("schema_decode")
+def _schema_decode(name, payload):
+    data = payload.encode() if isinstance(payload, str) else bytes(payload)
+    return _schema_registry().check_payload(_str(name), data)
+
+
+@func("schema_encode")
+def _schema_encode(name, value):
+    return _schema_registry().encode_payload(_str(name), value)
+
+
+@func("schema_check")
+def _schema_check(name, payload):
+    try:
+        data = payload.encode() if isinstance(payload, str) else bytes(payload)
+        _schema_registry().check_payload(_str(name), data)
+        return True
+    except Exception:
+        return False

@@ -16,7 +16,9 @@ from ..broker.hooks import STOP
 from ..broker.message import Message
 from ..ops import topic as topic_mod
 from ..ops.host_index import TopicTrie
-from .registry import SchemaError, SchemaRegistry, check_json_schema
+from .registry import (
+    SchemaError, SchemaRegistry, check_json_schema, default_registry,
+)
 
 
 class Validation:
@@ -70,7 +72,7 @@ class Validation:
 class SchemaValidation:
     def __init__(self, broker, registry: Optional[SchemaRegistry] = None):
         self.broker = broker
-        self.registry = registry or SchemaRegistry()
+        self.registry = registry or default_registry()
         self._validations: Dict[str, Validation] = {}
         self._order: List[str] = []
         self._index = TopicTrie()

@@ -1,0 +1,199 @@
+"""Avro + protobuf serdes: codec round trips, registration-time
+rejection, and the e2e pipeline VERDICT r2 #9 asks for — an
+avro-encoded payload validated and transformed through rules.
+
+Ref: apps/emqx_schema_registry/src/emqx_schema_registry.erl (serde
+types avro/protobuf), emqx_schema_registry_serde.erl (rule functions
+schema_decode/schema_encode).
+"""
+
+import json
+import struct
+
+import pytest
+
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.packet import SubOpts
+from emqx_tpu.broker.pubsub import Broker
+from emqx_tpu.rules.engine import RuleEngine
+from emqx_tpu.transform.avro import AvroError, AvroSchema
+from emqx_tpu.transform.protobuf import ProtoCodec, ProtoFile, ProtobufError
+from emqx_tpu.transform.registry import (
+    SchemaError, SchemaRegistry, set_default_registry,
+)
+
+SENSOR_AVRO = {
+    "type": "record",
+    "name": "Sensor",
+    "fields": [
+        {"name": "device", "type": "string"},
+        {"name": "temp", "type": "double"},
+        {"name": "seq", "type": "long"},
+        {"name": "ok", "type": "boolean"},
+        {"name": "tags", "type": {"type": "array", "items": "string"}},
+        {"name": "attrs", "type": {"type": "map", "values": "int"}},
+        {"name": "mode", "type": {
+            "type": "enum", "name": "Mode",
+            "symbols": ["OFF", "ECO", "BOOST"],
+        }},
+        {"name": "loc", "type": ["null", {
+            "type": "record", "name": "Loc",
+            "fields": [{"name": "lat", "type": "double"},
+                       {"name": "lon", "type": "double"}],
+        }], "default": None},
+        {"name": "raw", "type": "bytes", "default": b""},
+    ],
+}
+
+
+def test_avro_roundtrip_all_types():
+    sch = AvroSchema(SENSOR_AVRO)
+    val = {
+        "device": "d-1", "temp": -3.25, "seq": 123456789012, "ok": True,
+        "tags": ["a", "b"], "attrs": {"x": 1, "y": -2}, "mode": "ECO",
+        "loc": {"lat": 52.5, "lon": 13.4}, "raw": b"\x00\xff",
+    }
+    wire = sch.encode(val)
+    assert sch.decode(wire) == val
+    # null union branch + defaults
+    val2 = dict(val, loc=None)
+    del val2["raw"]  # default fills it
+    out = sch.decode(sch.encode(val2))
+    assert out["loc"] is None and out["raw"] == b""
+    # zigzag negatives
+    assert sch.decode(sch.encode(dict(val, seq=-1)))["seq"] == -1
+    with pytest.raises(AvroError):
+        sch.encode(dict(val, mode="TURBO"))
+    with pytest.raises(AvroError):
+        sch.decode(wire + b"\x00")  # trailing bytes
+
+
+PROTO_SRC = """
+syntax = "proto3";
+message GpsPoint {
+  double lat = 1;
+  double lon = 2;
+}
+enum Level {
+  INFO = 0;
+  WARN = 1;
+  ALERT = 2;
+}
+message Report {
+  string device = 1;
+  int64 seq = 2;
+  sint32 delta = 3;
+  bool active = 4;
+  repeated int32 samples = 5;
+  GpsPoint gps = 6;
+  Level level = 7;
+  bytes blob = 8;
+  float speed = 9;
+  fixed32 crc = 10;
+}
+"""
+
+
+def test_protobuf_roundtrip():
+    codec = ProtoCodec(ProtoFile(PROTO_SRC), "Report")
+    val = {
+        "device": "r2", "seq": -5, "delta": -7, "active": True,
+        "samples": [1, 2, 300], "gps": {"lat": 1.5, "lon": -2.5},
+        "level": "ALERT", "blob": b"\x01\x02", "speed": 2.5,
+        "crc": 0xDEADBEEF,
+    }
+    wire = codec.encode(val)
+    out = codec.decode(wire)
+    assert out["device"] == "r2" and out["seq"] == -5 and out["delta"] == -7
+    assert out["samples"] == [1, 2, 300]
+    assert out["gps"] == {"lat": 1.5, "lon": -2.5}
+    assert out["level"] == "ALERT" and out["crc"] == 0xDEADBEEF
+    assert abs(out["speed"] - 2.5) < 1e-6
+
+
+def test_protobuf_packed_and_unknown_fields():
+    codec = ProtoCodec(ProtoFile(PROTO_SRC), "Report")
+    # packed repeated int32 (wire type 2 on field 5)
+    packed = b"\x2a\x03\x01\x02\x03"
+    # unknown field 99 (varint tag is multi-byte) must be skipped
+    from emqx_tpu.transform.protobuf import _uvarint
+    unknown = _uvarint((99 << 3) | 0) + b"\x2a"
+    out = codec.decode(packed + unknown)
+    assert out["samples"] == [1, 2, 3]
+
+
+def test_unsupported_proto_rejected_at_parse():
+    with pytest.raises(ProtobufError, match="oneof"):
+        ProtoFile("message M { oneof x { int32 a = 1; } }")
+
+
+def test_registry_serdes_and_rejection():
+    reg = SchemaRegistry()
+    reg.put("sensor", {"type": "avro", "schema": SENSOR_AVRO})
+    reg.put("report", {"type": "protobuf", "source": PROTO_SRC,
+                       "message_type": "Report"})
+    val = {"device": "d", "temp": 1.0, "seq": 1, "ok": True, "tags": [],
+           "attrs": {}, "mode": "OFF", "loc": None, "raw": b""}
+    wire = reg.encode_payload("sensor", val)
+    assert reg.check_payload("sensor", wire) == val
+    pb = reg.encode_payload("report", {"device": "x", "seq": 9})
+    assert reg.check_payload("report", pb)["device"] == "x"
+    with pytest.raises(SchemaError):
+        reg.check_payload("sensor", b"\x01garbage\xff\xff\xff\xff\xff")
+    with pytest.raises(SchemaError, match="protobuf"):
+        reg.put("bad", {"type": "protobuf",
+                        "source": "message M { map<string,int32> m = 1; }",
+                        "message_type": "M"})
+    with pytest.raises(SchemaError, match="avro"):
+        reg.put("bad2", {"type": "avro",
+                         "schema": {"type": "record", "fields": []}})
+
+
+def test_avro_rule_pipeline_e2e():
+    """Avro payload -> validation gate -> rule schema_decode ->
+    transformed republish (the full registry/validation/rules chain)."""
+    from emqx_tpu.transform.validation import SchemaValidation
+
+    reg = SchemaRegistry()
+    set_default_registry(reg)
+    try:
+        reg.put("sensor", {"type": "avro", "schema": SENSOR_AVRO})
+        broker = Broker()
+        vp = SchemaValidation(broker, registry=reg)
+        vp.put({
+            "name": "v1", "topics": ["ingest/#"],
+            "checks": [{"type": "schema", "schema": "sensor"}],
+        })
+        vp.enable()
+        rules = RuleEngine(broker)
+        rules.install(broker.hooks)
+        rules.create_rule(
+            "decode",
+            "SELECT schema_decode('sensor', payload) as s, topic "
+            'FROM "ingest/#"',
+            actions=[{
+                "function": "republish",
+                "args": {"topic": "decoded/${s.device}",
+                         "payload": "${s.temp}"},
+            }],
+        )
+        s, _ = broker.open_session("watcher", True)
+        got = []
+        s.outgoing_sink = got.extend
+        broker.subscribe(s, "decoded/#", SubOpts(qos=0))
+
+        sch = AvroSchema(SENSOR_AVRO)
+        good = sch.encode({
+            "device": "dev7", "temp": 21.5, "seq": 1, "ok": True,
+            "tags": [], "attrs": {}, "mode": "ECO", "loc": None, "raw": b"",
+        })
+        broker.publish(Message(topic="ingest/a", payload=good))
+        # invalid avro payload is dropped by validation, never reaches
+        # the rule
+        broker.publish(Message(topic="ingest/a", payload=b"\xff\xfejunk"))
+        assert [(p.topic, p.payload) for p in got] == [
+            ("decoded/dev7", b"21.5")
+        ]
+        assert vp.list()[0]["failed"] == 1
+    finally:
+        set_default_registry(SchemaRegistry())
