@@ -183,3 +183,57 @@ class Rebalance:
         finally:
             self.agent.disable()
         return {"evicted": evicted, "local": local, "avg": avg}
+
+
+class NodePurge:
+    """Maintenance wipe: discard EVERY session (connected or parked)
+    at purge_rate sessions/second — the emqx_node_rebalance_purge
+    analog (apps/emqx_node_rebalance/src/emqx_node_rebalance_purge.erl).
+    Unlike evacuation, purge destroys session state: durable sessions
+    are discarded, not migrated."""
+
+    def __init__(self, broker, purge_rate: int = 500):
+        self.broker = broker
+        self.rate = max(1, purge_rate)
+        self.status = "idle"
+        self.purged = 0
+        self._task: Optional[asyncio.Task] = None
+
+    async def start(self) -> None:
+        if self.status == "purging":
+            return
+        self.status = "purging"
+        self._task = asyncio.ensure_future(self._run())
+
+    async def _run(self) -> None:
+        try:
+            while True:
+                batch = list(self.broker.sessions.values())[: self.rate]
+                if not batch:
+                    break
+                for s in batch:
+                    try:
+                        self.broker.close_session(s, discard=True)
+                        self.purged += 1
+                    except Exception:
+                        log.exception("purge close_session failed")
+                if not self.broker.sessions:
+                    break  # done: don't sit in 'purging' for a beat
+                await asyncio.sleep(1.0)
+            self.status = "purged"
+        except asyncio.CancelledError:
+            pass
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        self.status = "idle"
+
+    def stats(self) -> dict:
+        return {
+            "status": self.status,
+            "purged": self.purged,
+            "rate": self.rate,
+            "remaining_sessions": len(self.broker.sessions),
+        }

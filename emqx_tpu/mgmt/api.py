@@ -67,9 +67,13 @@ class ApiKeys:
         desc: str = "",
         enable: bool = True,
         expired_at: Optional[float] = None,
+        role: str = "administrator",
     ) -> Dict[str, Any]:
         if any(r["name"] == name for r in self._keys.values()):
             raise ValueError(f"api key name exists: {name}")
+        if role not in ("administrator", "viewer"):
+            # the reference's dashboard RBAC roles (emqx_dashboard_rbac)
+            raise ValueError(f"unknown role {role!r}")
         api_key = secrets.token_urlsafe(12)
         api_secret = secrets.token_urlsafe(24)
         salt = secrets.token_bytes(16)
@@ -79,11 +83,19 @@ class ApiKeys:
             "enable": enable,
             "expired_at": expired_at,
             "created_at": time.time(),
+            "role": role,
             "salt": salt,
             "secret_hash": _hash_pw(api_secret, salt),
         }
         # the secret is returned exactly once, at creation
-        return {"name": name, "api_key": api_key, "api_secret": api_secret}
+        return {
+            "name": name, "api_key": api_key, "api_secret": api_secret,
+            "role": role,
+        }
+
+    def role_of(self, api_key: str) -> str:
+        r = self._keys.get(api_key)
+        return (r or {}).get("role", "administrator")
 
     def verify(self, api_key: str, api_secret: str) -> bool:
         r = self._keys.get(api_key)
@@ -192,6 +204,7 @@ class ManagementApi:
         dashboard.install(self)
         # dashboard users (default admin/public, like the reference)
         self._users: Dict[str, Tuple[bytes, bytes]] = {}
+        self._user_roles: Dict[str, str] = {}
         self.add_user("admin", "public")
         self._tokens: Dict[str, Tuple[str, float]] = {}
         self.http.before.append(self._auth_mw)
@@ -199,9 +212,13 @@ class ManagementApi:
 
     # --- auth -------------------------------------------------------------
 
-    def add_user(self, username: str, password: str) -> None:
+    def add_user(self, username: str, password: str,
+                 role: str = "administrator") -> None:
+        if role not in ("administrator", "viewer"):
+            raise ValueError(f"unknown role {role!r}")
         salt = secrets.token_bytes(16)
         self._users[username] = (salt, _hash_pw(password, salt))
+        self._user_roles[username] = role
 
     def _auth_mw(self, req: Request) -> Optional[Response]:
         if req.path in ("/status", "/", "/dashboard") or (
@@ -215,7 +232,8 @@ class ManagementApi:
             ent = self._tokens.get(tok)
             if ent and time.time() < ent[1]:
                 req.principal = ent[0]
-                return None
+                req.role = self._user_roles.get(ent[0], "administrator")
+                return self._enforce_role(req)
         elif auth.startswith("Basic "):
             try:
                 user, _, pw = (
@@ -225,8 +243,20 @@ class ManagementApi:
                 return Response.error(401, "BAD_USERNAME_OR_PWD", "bad basic auth")
             if self.api_keys.verify(user, pw):
                 req.principal = f"api_key:{user}"
-                return None
+                req.role = self.api_keys.role_of(user)
+                return self._enforce_role(req)
         return Response.error(401, "UNAUTHORIZED", "missing or invalid credentials")
+
+    def _enforce_role(self, req: Request) -> Optional[Response]:
+        """RBAC (emqx_dashboard_rbac): viewers are read-only — every
+        mutating method is denied, not just hidden."""
+        if req.role == "viewer" and req.method != "GET" and req.path not in (
+            "/api/v5/logout",
+        ):
+            return Response.error(
+                403, "NOT_ALLOWED", "viewer role is read-only"
+            )
+        return None
 
     def _login(self, req: Request):
         body = req.json() or {}
@@ -309,6 +339,15 @@ class ManagementApi:
         r("GET", "/api/v5/plugins", self._plugins_list)
         r("GET", "/api/v5/bridges", self._bridges_list)
         r("GET", "/api/v5/bridges/{name}", self._bridge_one)
+        r("GET", "/api/v5/swagger.json", self._swagger)
+        r("GET", "/api/v5/mqtt/topic_metrics", self._topic_metrics_list)
+        r("POST", "/api/v5/mqtt/topic_metrics", self._topic_metrics_add)
+        r(
+            "DELETE", "/api/v5/mqtt/topic_metrics/{topic...}",
+            self._topic_metrics_del,
+        )
+        r("POST", "/api/v5/load_rebalance/purge/start", self._purge_start)
+        r("POST", "/api/v5/load_rebalance/purge/stop", self._purge_stop)
         r("POST", "/api/v5/plugins/install", self._plugin_install)
         r("PUT", "/api/v5/plugins/{name}/start", self._plugin_start)
         r("PUT", "/api/v5/plugins/{name}/stop", self._plugin_stop)
@@ -430,17 +469,115 @@ class ManagementApi:
             },
         }
 
+    def _swagger(self, q):
+        """OpenAPI 3 document generated from the live route table
+        (emqx_dashboard_swagger analog: the spec IS the router, so it
+        cannot drift from the implementation)."""
+        paths: Dict[str, Dict[str, Any]] = {}
+        for rt in self.http._routes:
+            parts = []
+            params = []
+            for seg in rt.pattern.split("/"):
+                if seg.startswith("{") and seg.endswith("}"):
+                    name = seg[1:-1]
+                    if name.endswith("..."):
+                        name = name[:-3]
+                    params.append(name)
+                    parts.append("{" + name + "}")
+                else:
+                    parts.append(seg)
+            path = "/".join(parts)
+            doc = (getattr(rt.handler, "__doc__", None) or "").strip()
+            op = {
+                "summary": doc.split("\n")[0] if doc else rt.pattern,
+                "tags": [path.split("/")[3] if path.count("/") >= 3 else "misc"],
+                "parameters": [
+                    {
+                        "name": p,
+                        "in": "path",
+                        "required": True,
+                        "schema": {"type": "string"},
+                    }
+                    for p in params
+                ],
+                "responses": {"200": {"description": "OK"}},
+                "security": [{"basicAuth": []}, {"bearerAuth": []}],
+            }
+            paths.setdefault(path, {})[rt.method.lower()] = op
+        return {
+            "openapi": "3.0.0",
+            "info": {
+                "title": "EMQX-TPU Management API",
+                "version": "5.0",
+            },
+            "components": {
+                "securitySchemes": {
+                    "basicAuth": {"type": "http", "scheme": "basic"},
+                    "bearerAuth": {"type": "http", "scheme": "bearer"},
+                }
+            },
+            "paths": paths,
+        }
+
+    # --- topic metrics (emqx_topic_metrics) ----------------------------
+
+    def _topic_metrics(self):
+        if getattr(self, "topic_metrics", None) is None:
+            from ..obs.topic_metrics import TopicMetrics
+
+            self.topic_metrics = TopicMetrics(self.broker)
+        return self.topic_metrics
+
+    def _topic_metrics_list(self, q):
+        return self._topic_metrics().list()
+
+    def _topic_metrics_add(self, req: Request):
+        body = req.json() or {}
+        topic = body.get("topic", "")
+        try:
+            self._topic_metrics().register(topic)
+        except (ValueError, OverflowError) as e:
+            return Response.error(400, "BAD_REQUEST", str(e))
+        return self._topic_metrics().metrics(topic)
+
+    def _topic_metrics_del(self, req: Request):
+        if not self._topic_metrics().deregister(req.params["topic"]):
+            return Response.error(404, "NOT_FOUND", "topic not registered")
+        return Response(204)
+
+    # --- rebalance purge (emqx_node_rebalance_purge) --------------------
+
+    async def _purge_start(self, req: Request):
+        from ..cluster.rebalance import NodePurge
+
+        body = req.json() or {}
+        cur = getattr(self, "purge", None)
+        if cur is not None and cur.status == "purging":
+            return Response.error(400, "BAD_REQUEST", "purge in progress")
+        self.purge = NodePurge(
+            self.broker, purge_rate=int(body.get("purge_rate", 500))
+        )
+        await self.purge.start()
+        return self.purge.stats()
+
+    async def _purge_stop(self, req: Request):
+        cur = getattr(self, "purge", None)
+        if cur is None:
+            return Response.error(400, "BAD_REQUEST", "no purge running")
+        await cur.stop()
+        return cur.stats()
+
     def _bridges_list(self, q):
         if self.bridges is None:
             return []
         return self.bridges.list()
 
-    def _bridge_one(self, q, name):
+    def _bridge_one(self, req: Request):
         if self.bridges is None:
-            return Response(404, {"code": "NOT_FOUND"})
-        b = self.bridges.bridges.get(name)
+            return Response.error(404, "NOT_FOUND", "no bridge registry")
+        b = self.bridges.bridges.get(req.params["name"])
         if b is None:
-            return Response(404, {"code": "NOT_FOUND"})
+            return Response.error(404, "NOT_FOUND", "no such bridge")
         return b.info()
 
     def _plugins_list(self, req: Request):
@@ -516,6 +653,10 @@ class ManagementApi:
             return Response.error(404, "NOT_FOUND", "no evacuation")
         await self.evacuation.stop()
         return self.evacuation.stats()
+
+    def _evac_status_with_purge(self):
+        purge = getattr(self, "purge", None)
+        return {"purge": purge.stats()} if purge else {}
 
     def _evac_status(self, req: Request):
         return {

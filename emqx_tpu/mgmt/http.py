@@ -68,6 +68,7 @@ _REASONS = {
 class _Route:
     def __init__(self, method: str, pattern: str, handler: Callable):
         self.method = method
+        self.pattern = pattern
         self.handler = handler
         self.segs = pattern.strip("/").split("/") if pattern.strip("/") else []
 

@@ -339,7 +339,10 @@ async def test_redis_rule_action_bridge_and_rest():
         listing = api._bridges_list(None)
         assert listing and listing[0]["name"] == "redis_sink"
         assert listing[0]["status"] == "connected"
-        one = api._bridge_one(None, "redis_sink")
+        class _Req:
+            params = {"name": "redis_sink"}
+
+        one = api._bridge_one(_Req())
         assert one["metrics"]["success"] >= 2
     finally:
         await reg.stop_all()
