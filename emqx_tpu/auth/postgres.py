@@ -1,0 +1,130 @@
+"""PostgreSQL-backed authn provider + authz source.
+
+Reference: apps/emqx_auth_postgresql/src/emqx_authn_postgresql.erl
+(SELECT returning password_hash/salt/is_superuser for the client) and
+emqx_authz_postgresql.erl (SELECT returning permission/action/topic
+rows evaluated in order). Queries are ${placeholder} templates
+rendered as escaped SQL literals (bridges/postgres.py render_sql) —
+the injection-safe subset of the reference's prepared statements.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from ..bridges.postgres import PgClient, render_sql
+from ..ops import topic as topic_mod
+from .authn import IGNORE, AuthResult, Credentials, Provider
+from .authz import Source
+from .redis import verify_password
+
+log = logging.getLogger("emqx_tpu.auth.postgres")
+
+
+def _cred_params(creds: Credentials) -> dict:
+    return {
+        "clientid": creds.client_id,
+        "username": creds.username or "",
+        "peerhost": creds.peerhost or "",
+        "cert_common_name": creds.cert_cn or "",
+    }
+
+
+class PostgresAuthnProvider(Provider):
+    """query e.g. "SELECT password_hash, salt, is_superuser FROM
+    mqtt_user WHERE username = ${username} LIMIT 1"."""
+
+    def __init__(
+        self,
+        query: str,
+        client: Optional[PgClient] = None,
+        algorithm: str = "sha256",
+        salt_position: str = "prefix",
+        iterations: int = 1000,
+        **client_kw,
+    ) -> None:
+        self.query = query
+        self.algorithm = algorithm
+        self.salt_position = salt_position
+        self.iterations = iterations
+        self.client = client or PgClient(**client_kw)
+
+    def authenticate(self, creds: Credentials):
+        sql = render_sql(self.query, _cred_params(creds))
+        try:
+            cols, rows = self.client.query(sql)
+        except Exception as e:  # backend down: not my verdict
+            log.warning("postgres authn lookup failed: %s", e)
+            return IGNORE
+        if not rows:
+            return IGNORE  # unknown user -> next provider in chain
+        row = dict(zip(cols, rows[0]))
+        stored = row.get("password_hash")
+        if stored is None:
+            return IGNORE
+        ok = verify_password(
+            self.algorithm,
+            stored.encode(),
+            creds.password or b"",
+            (row.get("salt") or "").encode(),
+            self.salt_position,
+            self.iterations,
+        )
+        if not ok:
+            return AuthResult(False, "bad_username_or_password")
+        su = str(row.get("is_superuser", "")).lower() in ("1", "t", "true")
+        return AuthResult(True, superuser=su)
+
+    def destroy(self) -> None:
+        self.client.close()
+
+
+class PostgresAuthzSource(Source):
+    """query returning (permission, action, topic) rows evaluated in
+    order; first topic match wins (emqx_authz_postgresql.erl)."""
+
+    def __init__(
+        self,
+        query: str = (
+            "SELECT permission, action, topic FROM mqtt_acl "
+            "WHERE username = ${username}"
+        ),
+        client: Optional[PgClient] = None,
+        **client_kw,
+    ) -> None:
+        self.query = query
+        self.client = client or PgClient(**client_kw)
+
+    def authorize(self, client_id, username, peerhost, action, topic) -> str:
+        creds = Credentials(
+            client_id=client_id, username=username, peerhost=peerhost
+        )
+        try:
+            cols, rows = self.client.query(
+                render_sql(self.query, _cred_params(creds))
+            )
+        except Exception as e:
+            log.warning("postgres authz lookup failed: %s", e)
+            return "nomatch"
+        for r in rows:
+            row = dict(zip(cols, r))
+            act = (row.get("action") or "").lower()
+            if act != "all" and act != action:
+                continue
+            flt = (row.get("topic") or "").replace(
+                "${clientid}", client_id
+            ).replace("${username}", username or "")
+            if flt.startswith("eq "):
+                matched = flt[3:] == topic
+            else:
+                matched = topic_mod.match(
+                    topic_mod.words(topic), topic_mod.words(flt)
+                )
+            if matched:
+                perm = (row.get("permission") or "").lower()
+                return "allow" if perm == "allow" else "deny"
+        return "nomatch"
+
+    def destroy(self) -> None:
+        self.client.close()

@@ -1,0 +1,303 @@
+"""PostgreSQL wire protocol (v3): codec, sync client, bridge connector.
+
+The reference ships a shared client app (apps/emqx_postgresql, epgsql
+behind ecpool) used by emqx_auth_postgresql and emqx_bridge_pgsql.
+This speaks the frontend/backend protocol directly:
+
+    StartupMessage(196608, user/database) -> 'R' auth request
+    (trust/cleartext/md5 supported) -> 'S'/'K' -> 'Z' ReadyForQuery.
+    Simple query: 'Q' sql -> 'T' RowDescription + 'D' DataRows +
+    'C' CommandComplete -> 'Z'. 'E' ErrorResponse surfaces the
+    severity/code/message fields.
+
+Templating: ${placeholders} substitute as SQL string literals with
+quote doubling (the injection-safe subset of what the reference's
+prepared statements give it); callers never interpolate raw strings.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import logging
+import socket
+import struct
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from .resource import Connector, QueryError, RecoverableError, ResourceStatus
+
+log = logging.getLogger("emqx_tpu.bridges.postgres")
+
+PROTO_V3 = 196608
+
+
+class PgError(QueryError):
+    pass
+
+
+def sql_quote(v: Any) -> str:
+    """Render a template value as a safe SQL literal."""
+    if v is None:
+        return "NULL"
+    if isinstance(v, bool):
+        return "TRUE" if v else "FALSE"
+    if isinstance(v, (int, float)):
+        return str(v)
+    if isinstance(v, (bytes, bytearray)):
+        v = v.decode("utf-8", "replace")  # not the b'..' repr
+    s = str(v).replace("'", "''")
+    if "\x00" in s:
+        raise PgError("NUL byte in SQL parameter")
+    return f"'{s}'"
+
+
+def render_sql(template: str, params: Dict[str, Any]) -> str:
+    out = template
+    for k, v in params.items():
+        out = out.replace("${" + k + "}", sql_quote(v))
+    return out
+
+
+def _startup(user: str, database: str) -> bytes:
+    body = struct.pack(">i", PROTO_V3)
+    body += b"user\x00" + user.encode() + b"\x00"
+    body += b"database\x00" + database.encode() + b"\x00\x00"
+    return struct.pack(">i", len(body) + 4) + body
+
+
+def _msg(tag: bytes, body: bytes = b"") -> bytes:
+    return tag + struct.pack(">i", len(body) + 4) + body
+
+
+def md5_password(user: str, password: str, salt: bytes) -> bytes:
+    inner = hashlib.md5(password.encode() + user.encode()).hexdigest()
+    outer = hashlib.md5(inner.encode() + salt).hexdigest()
+    return b"md5" + outer.encode() + b"\x00"
+
+
+class PgFramer:
+    """Incremental backend-message framer: feed -> [(tag, body)]."""
+
+    def __init__(self) -> None:
+        self._buf = bytearray()
+
+    def feed(self, data: bytes) -> List[Tuple[bytes, bytes]]:
+        self._buf.extend(data)
+        out = []
+        while len(self._buf) >= 5:
+            tag = bytes(self._buf[:1])
+            (n,) = struct.unpack_from(">i", self._buf, 1)
+            if len(self._buf) < 1 + n:
+                break
+            out.append((tag, bytes(self._buf[5 : 1 + n])))
+            del self._buf[: 1 + n]
+        return out
+
+
+def parse_error(body: bytes) -> str:
+    fields = {}
+    off = 0
+    while off < len(body) and body[off] != 0:
+        code = chr(body[off])
+        end = body.index(b"\x00", off + 1)
+        fields[code] = body[off + 1 : end].decode("utf-8", "replace")
+        off = end + 1
+    return f"{fields.get('S', 'ERROR')} {fields.get('C', '')}: {fields.get('M', '')}"
+
+
+def parse_row_description(body: bytes) -> List[str]:
+    (n,) = struct.unpack_from(">h", body, 0)
+    off = 2
+    names = []
+    for _ in range(n):
+        end = body.index(b"\x00", off)
+        names.append(body[off:end].decode())
+        off = end + 1 + 18  # tableoid i32, attnum i16, typoid i32,
+        # typlen i16, typmod i32, format i16
+    return names
+
+
+def parse_data_row(body: bytes) -> List[Optional[bytes]]:
+    (n,) = struct.unpack_from(">h", body, 0)
+    off = 2
+    cols: List[Optional[bytes]] = []
+    for _ in range(n):
+        (ln,) = struct.unpack_from(">i", body, off)
+        off += 4
+        if ln < 0:
+            cols.append(None)
+        else:
+            cols.append(body[off : off + ln])
+            off += ln
+    return cols
+
+
+class PgClient:
+    """Minimal SYNC client (simple query protocol) for the auth hot
+    path — same blocking-window model as the Redis/HTTP backends."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 5432,
+        user: str = "postgres",
+        password: str = "",
+        database: str = "postgres",
+        timeout: float = 5.0,
+    ) -> None:
+        self.host, self.port = host, port
+        self.user, self.password, self.database = user, password, database
+        self.timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._framer = PgFramer()
+        self._lock = threading.Lock()
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+
+    def _read_msgs(self):
+        while True:
+            data = self._sock.recv(65536)
+            if not data:
+                raise ConnectionError("postgres closed connection")
+            msgs = self._framer.feed(data)
+            if msgs:
+                return msgs
+
+    def _connect(self) -> None:
+        s = socket.create_connection((self.host, self.port), self.timeout)
+        s.settimeout(self.timeout)
+        self._framer = PgFramer()
+        self._sock = s
+        s.sendall(_startup(self.user, self.database))
+        pending: List[Tuple[bytes, bytes]] = []
+        while True:
+            pending.extend(self._read_msgs())
+            while pending:
+                tag, body = pending.pop(0)
+                if tag == b"R":
+                    (code,) = struct.unpack_from(">i", body, 0)
+                    if code == 0:
+                        continue
+                    if code == 3:  # cleartext
+                        s.sendall(_msg(b"p", self.password.encode() + b"\x00"))
+                    elif code == 5:  # md5
+                        s.sendall(_msg(b"p", md5_password(
+                            self.user, self.password, body[4:8]
+                        )))
+                    else:
+                        raise PgError(f"unsupported auth method {code}")
+                elif tag == b"E":
+                    raise PgError(parse_error(body))
+                elif tag == b"Z":
+                    return
+                # 'S' params / 'K' key data: ignored
+
+    def query(self, sql: str) -> Tuple[List[str], List[List[Any]]]:
+        """Run one simple query; returns (column names, rows) with
+        text-format values decoded to str (None for NULL)."""
+        with self._lock:
+            try:
+                if self._sock is None:
+                    self._connect()
+                return self._query_locked(sql)
+            except PgError:
+                raise
+            except Exception:
+                self.close()
+                raise
+
+    def _query_locked(self, sql: str):
+        self._sock.sendall(_msg(b"Q", sql.encode() + b"\x00"))
+        cols: List[str] = []
+        rows: List[List[Any]] = []
+        err: Optional[str] = None
+        pending: List[Tuple[bytes, bytes]] = []
+        while True:
+            pending.extend(self._read_msgs())
+            while pending:
+                tag, body = pending.pop(0)
+                if tag == b"T":
+                    cols = parse_row_description(body)
+                elif tag == b"D":
+                    rows.append([
+                        None if c is None else c.decode("utf-8", "replace")
+                        for c in parse_data_row(body)
+                    ])
+                elif tag == b"E":
+                    err = parse_error(body)
+                elif tag == b"Z":
+                    if err is not None:
+                        raise PgError(err)
+                    return cols, rows
+
+    def ping(self) -> bool:
+        try:
+            self.query("SELECT 1")
+            return True
+        except Exception:
+            return False
+
+
+class PostgresConnector(Connector):
+    """Async bridge driver: sql_template rendered per request
+    (emqx_bridge_pgsql sql template, e.g.
+    "INSERT INTO t (topic, payload) VALUES (${topic}, ${payload})")."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 5432,
+        user: str = "postgres",
+        password: str = "",
+        database: str = "postgres",
+        sql_template: Optional[str] = None,
+        timeout: float = 5.0,
+    ) -> None:
+        self._mk = lambda: PgClient(
+            host, port, user=user, password=password, database=database,
+            timeout=timeout,
+        )
+        self.sql_template = sql_template
+        self.client: Optional[PgClient] = None
+
+    async def on_start(self) -> None:
+        self.client = self._mk()
+        ok = await asyncio.get_running_loop().run_in_executor(
+            None, self.client.ping
+        )
+        if not ok:
+            raise RecoverableError("postgres unreachable")
+
+    async def on_stop(self) -> None:
+        if self.client is not None:
+            self.client.close()
+            self.client = None
+
+    async def on_query(self, request: Any) -> Any:
+        if isinstance(request, str):
+            sql = request
+        else:
+            if not self.sql_template:
+                raise QueryError("postgres action has no sql_template")
+            sql = render_sql(self.sql_template, dict(request))
+        loop = asyncio.get_running_loop()
+        try:
+            return await loop.run_in_executor(None, self.client.query, sql)
+        except PgError:
+            raise
+        except Exception as e:
+            raise RecoverableError(str(e)) from e
+
+    async def health_check(self) -> ResourceStatus:
+        if self.client is None:
+            return ResourceStatus.CONNECTING
+        ok = await asyncio.get_running_loop().run_in_executor(
+            None, self.client.ping
+        )
+        return ResourceStatus.CONNECTED if ok else ResourceStatus.CONNECTING

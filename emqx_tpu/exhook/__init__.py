@@ -147,6 +147,10 @@ class ExHookBridge:
         self._seq = 0
         self._pending: Dict[int, asyncio.Future] = {}
         self._installed: List[tuple] = []
+        # the loop the broker (and Hooks registry) lives on — captured
+        # at start() so the reconnect path can marshal hook rebinds
+        # back onto it
+        self._main_loop: Optional[asyncio.AbstractEventLoop] = None
         self.metrics = {"calls": 0, "failures": 0, "casts": 0}
 
     # --- lifecycle -------------------------------------------------------
@@ -179,6 +183,10 @@ class ExHookBridge:
             loop.run_forever()
             loop.close()
 
+        try:
+            self._main_loop = asyncio.get_running_loop()
+        except RuntimeError:
+            self._main_loop = None
         self._thread = threading.Thread(target=run, daemon=True, name=f"exhook-{self.name}")
         self._thread.start()
         if not ready.wait(self.timeout) or err:
@@ -255,15 +263,22 @@ class ExHookBridge:
                     raise ConnectionError(f"bad re-handshake: {hello!r}")
                 self._reader, self._writer = reader, writer
                 log.info("exhook %s reconnected to %s", self.name, self.addr)
-                if sorted(hello[1]) != sorted(self.hookpoints):
+                # compare FILTERED sets: the stored hookpoints were
+                # filtered at install, so a raw-vs-filtered compare
+                # would re-install on every reconnect
+                new_points = self._filter_points(list(hello[1]))
+                if sorted(new_points) != sorted(self.hookpoints):
                     # server came back declaring a different hook set —
-                    # re-install so new points bridge and dropped ones
-                    # stop intercepting
-                    for point, cb in self._installed:
-                        self.broker.hooks.delete(point, cb)
-                    self._installed.clear()
-                    self.hookpoints = list(hello[1])
-                    self._install_hooks()
+                    # diff-apply it on the BROKER's loop, not this
+                    # bridge thread (the registry is not thread-safe
+                    # against running chains)
+                    main = self._main_loop
+                    if main is not None and not main.is_closed():
+                        main.call_soon_threadsafe(
+                            self._rebind_hooks, new_points
+                        )
+                    else:
+                        self._rebind_hooks(new_points)
                 asyncio.ensure_future(self._recv_loop())
                 return
             except Exception:
@@ -301,16 +316,20 @@ class ExHookBridge:
 
     # --- broker-side hook callbacks --------------------------------------
 
-    def _install_hooks(self) -> None:
+    @staticmethod
+    def _filter_points(declared) -> List[str]:
         from ..broker.hooks import HOOKPOINTS
 
-        unknown = [p for p in self.hookpoints if p not in HOOKPOINTS]
+        unknown = [p for p in declared if p not in HOOKPOINTS]
         if unknown:
             log.warning(
-                "exhook server %s declared unknown hookpoints %s — skipped",
-                self.addr, unknown,
+                "exhook server declared unknown hookpoints %s — skipped",
+                unknown,
             )
-            self.hookpoints = [p for p in self.hookpoints if p in HOOKPOINTS]
+        return [p for p in declared if p in HOOKPOINTS]
+
+    def _install_hooks(self) -> None:
+        self.hookpoints = self._filter_points(self.hookpoints)
         for point in self.hookpoints:
             if point in FOLD_HOOKPOINTS:
                 cb = self._make_fold(point)
@@ -320,6 +339,30 @@ class ExHookBridge:
             # features but after rewrite/delayed interceptors
             self.broker.hooks.add(point, cb, priority=500)
             self._installed.append((point, cb))
+
+    def _rebind_hooks(self, new_points: List[str]) -> None:
+        """Diff-apply a changed hook set after a re-handshake: add the
+        new points, remove the dropped ones, NEVER touch the kept ones
+        — so an interceptor (client.authenticate with
+        failed_action=deny) has no uninstalled window. Runs on the
+        broker's thread (marshalled by the caller): the Hooks registry
+        is not thread-safe against running chains."""
+        keep = set(new_points)
+        for point, cb in [e for e in self._installed if e[0] not in keep]:
+            self.broker.hooks.delete(point, cb)
+            self._installed.remove((point, cb))
+        have = {p for p, _ in self._installed}
+        for point in new_points:
+            if point in have:
+                continue
+            cb = (
+                self._make_fold(point)
+                if point in FOLD_HOOKPOINTS
+                else self._make_cast(point)
+            )
+            self.broker.hooks.add(point, cb, priority=500)
+            self._installed.append((point, cb))
+        self.hookpoints = list(new_points)
 
     def _make_fold(self, point: str):
         def cb(*args_and_acc):

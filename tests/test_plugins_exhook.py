@@ -277,3 +277,42 @@ async def test_plugins_rest_lifecycle(tmp_path):
     except urllib.error.HTTPError as e:
         assert e.code == 400
     await api.stop()
+
+
+def test_exhook_reconnect_rebind_no_window():
+    """Re-handshake with unknown hookpoints must NOT churn the hook
+    registry (filtered sets compare equal), and a genuinely changed
+    set diff-applies: kept points keep their ORIGINAL callback object
+    (no uninstalled window), dropped points detach, new points attach."""
+    from emqx_tpu.exhook import ExHookBridge
+
+    b = Broker()
+    srv = ServerThread({
+        "client.authenticate": lambda a, acc: ("ok", True),
+        "bogus.point": lambda a, acc: ("ok", acc),  # unknown: filtered
+        "session.created": lambda a: None,
+    })
+    bridge = ExHookBridge(b, srv.addr, failed_action="deny", timeout=2.0)
+    bridge.start()
+    assert sorted(bridge.hookpoints) == [
+        "client.authenticate", "session.created",
+    ]
+    orig_auth_cb = dict(bridge._installed)["client.authenticate"]
+
+    # identical filtered set on re-handshake -> no reinstall at all
+    new_points = bridge._filter_points(
+        ["client.authenticate", "bogus.point", "session.created"]
+    )
+    assert sorted(new_points) == sorted(bridge.hookpoints)
+
+    # changed set: authenticate kept, session.created dropped,
+    # message.publish added
+    bridge._rebind_hooks(["client.authenticate", "message.publish"])
+    installed = dict(bridge._installed)
+    assert installed["client.authenticate"] is orig_auth_cb  # untouched
+    assert "session.created" not in installed
+    assert "message.publish" in installed
+    # the kept interceptor still gates (server up -> allow)
+    assert b.hooks.run_fold("client.authenticate", ({},), False) is True
+    bridge.stop()
+    srv.close()

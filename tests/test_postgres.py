@@ -1,0 +1,301 @@
+"""Postgres stack tests: wire codec, authn/authz against an
+in-process mini PG server (startup + cleartext/md5 auth + simple
+query), and a rule-action bridge writing through it — the same
+mini-server pattern as Kafka/Redis (VERDICT r2 #4, 'Postgres next').
+"""
+
+import asyncio
+import hashlib
+import struct
+import threading
+
+import pytest
+
+from emqx_tpu.auth.authn import IGNORE, Credentials
+from emqx_tpu.auth.postgres import PostgresAuthnProvider, PostgresAuthzSource
+from emqx_tpu.bridges.postgres import (
+    PgClient,
+    PgError,
+    PgFramer,
+    PostgresConnector,
+    md5_password,
+    render_sql,
+    sql_quote,
+)
+
+
+def _be_msg(tag, body=b""):
+    return tag + struct.pack(">i", len(body) + 4) + body
+
+
+class MiniPg:
+    """Just enough backend: startup, trust/cleartext/md5 auth, simple
+    Query answered from a scripted handler(sql) -> (cols, rows) or a
+    raised Exception -> ErrorResponse."""
+
+    def __init__(self, handler, auth="trust", user="app", password="pw"):
+        self.handler = handler
+        self.auth = auth
+        self.user = user
+        self.password = password
+        self.queries = []
+        self.server = None
+        self.port = None
+
+    async def start(self):
+        self.server = await asyncio.start_server(self._conn, "127.0.0.1", 0)
+        self.port = self.server.sockets[0].getsockname()[1]
+
+    async def stop(self):
+        self.server.close()
+        await self.server.wait_closed()
+
+    async def _conn(self, reader, writer):
+        try:
+            # startup message (untagged)
+            (n,) = struct.unpack(">i", await reader.readexactly(4))
+            body = await reader.readexactly(n - 4)
+            (proto,) = struct.unpack_from(">i", body, 0)
+            assert proto == 196608
+            salt = b"ps1T"
+            if self.auth == "cleartext":
+                writer.write(_be_msg(b"R", struct.pack(">i", 3)))
+                await writer.drain()
+                tag, pw = await self._read_msg(reader)
+                assert tag == b"p"
+                if pw[:-1].decode() != self.password:
+                    writer.write(_be_msg(b"E", b"SFATAL\x00C28P01\x00Mbad password\x00\x00"))
+                    await writer.drain()
+                    return
+            elif self.auth == "md5":
+                writer.write(_be_msg(b"R", struct.pack(">i", 5) + salt))
+                await writer.drain()
+                tag, pw = await self._read_msg(reader)
+                if pw[:-1] != md5_password(self.user, self.password, salt)[:-1]:
+                    writer.write(_be_msg(b"E", b"SFATAL\x00C28P01\x00Mbad md5\x00\x00"))
+                    await writer.drain()
+                    return
+            writer.write(_be_msg(b"R", struct.pack(">i", 0)))
+            writer.write(_be_msg(b"S", b"server_version\x0015.0\x00"))
+            writer.write(_be_msg(b"Z", b"I"))
+            await writer.drain()
+            while True:
+                tag, body = await self._read_msg(reader)
+                if tag != b"Q":
+                    return
+                sql = body[:-1].decode()
+                self.queries.append(sql)
+                try:
+                    cols, rows = self.handler(sql)
+                    out = b""
+                    if cols:
+                        d = struct.pack(">h", len(cols))
+                        for c in cols:
+                            d += c.encode() + b"\x00"
+                            d += struct.pack(">ihihih", 0, 0, 25, -1, -1, 0)
+                        out += _be_msg(b"T", d)
+                        for r in rows:
+                            d = struct.pack(">h", len(r))
+                            for v in r:
+                                if v is None:
+                                    d += struct.pack(">i", -1)
+                                else:
+                                    b = str(v).encode()
+                                    d += struct.pack(">i", len(b)) + b
+                            out += _be_msg(b"D", d)
+                    out += _be_msg(b"C", b"SELECT\x00")
+                except Exception as e:
+                    out = _be_msg(
+                        b"E",
+                        b"SERROR\x00C42601\x00M" + str(e).encode() + b"\x00\x00",
+                    )
+                out += _be_msg(b"Z", b"I")
+                writer.write(out)
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionError, AssertionError):
+            pass
+        finally:
+            writer.close()
+
+    async def _read_msg(self, reader):
+        tag = await reader.readexactly(1)
+        (n,) = struct.unpack(">i", await reader.readexactly(4))
+        return tag, await reader.readexactly(n - 4)
+
+
+def run_sync_against_server(fn, **srv_kw):
+    result = {}
+    started = threading.Event()
+    stop = threading.Event()
+
+    def thread():
+        async def main():
+            srv = MiniPg(**srv_kw)
+            await srv.start()
+            result["srv"] = srv
+            started.set()
+            while not stop.is_set():
+                await asyncio.sleep(0.01)
+            await srv.stop()
+
+        asyncio.run(main())
+
+    t = threading.Thread(target=thread, daemon=True)
+    t.start()
+    assert started.wait(5)
+    try:
+        fn(result["srv"])
+    finally:
+        stop.set()
+        t.join(5)
+
+
+def test_sql_quoting():
+    assert sql_quote("o'brien") == "'o''brien'"
+    assert sql_quote(None) == "NULL"
+    assert sql_quote(5) == "5"
+    assert sql_quote(True) == "TRUE"
+    assert render_sql("SELECT ${u}", {"u": "a'; DROP TABLE x;--"}) == (
+        "SELECT 'a''; DROP TABLE x;--'"
+    )
+    with pytest.raises(PgError):
+        sql_quote("a\x00b")
+
+
+def test_pg_client_query_and_errors():
+    users = {"alice": ("h1", "s1", "t")}
+
+    def handler(sql):
+        if "syntax" in sql:
+            raise ValueError("bad syntax")
+        if sql == "SELECT 1":
+            return ["?column?"], [["1"]]
+        for u, row in users.items():
+            if f"'{u}'" in sql:
+                return ["password_hash", "salt", "is_superuser"], [list(row)]
+        return ["password_hash", "salt", "is_superuser"], []
+
+    def check(srv):
+        c = PgClient("127.0.0.1", srv.port, user="app", database="db")
+        assert c.ping()
+        cols, rows = c.query(
+            "SELECT password_hash, salt, is_superuser FROM u "
+            "WHERE username = 'alice'"
+        )
+        assert cols == ["password_hash", "salt", "is_superuser"]
+        assert rows == [["h1", "s1", "t"]]
+        with pytest.raises(PgError, match="syntax"):
+            c.query("this is syntax garbage")
+        # connection survives an error (ReadyForQuery resynced)
+        assert c.ping()
+        c.close()
+
+    run_sync_against_server(check, handler=handler)
+
+
+def test_pg_md5_auth():
+    def check(srv):
+        good = PgClient("127.0.0.1", srv.port, user="app", password="pw")
+        assert good.ping()
+        good.close()
+        bad = PgClient("127.0.0.1", srv.port, user="app", password="wrong")
+        assert not bad.ping()
+
+    run_sync_against_server(
+        check, handler=lambda sql: (["?column?"], [["1"]]), auth="md5",
+    )
+
+
+def test_postgres_authn_and_authz():
+    salt = "ns"
+    hashed = hashlib.sha256((salt + "pw9").encode()).hexdigest()
+    acl = [
+        ("allow", "publish", "sensors/${clientid}/#"),
+        ("deny", "all", "secret/#"),
+        ("allow", "subscribe", "eq cmds/+"),
+    ]
+
+    def handler(sql):
+        if "mqtt_user" in sql and "'carol'" in sql:
+            return (["password_hash", "salt", "is_superuser"],
+                    [[hashed, salt, "f"]])
+        if "mqtt_user" in sql:
+            return ["password_hash", "salt", "is_superuser"], []
+        if "mqtt_acl" in sql and "'carol'" in sql:
+            return ["permission", "action", "topic"], [list(r) for r in acl]
+        return ["permission", "action", "topic"], []
+
+    def check(srv):
+        p = PostgresAuthnProvider(
+            "SELECT password_hash, salt, is_superuser FROM mqtt_user "
+            "WHERE username = ${username} LIMIT 1",
+            algorithm="sha256", salt_position="prefix",
+            host="127.0.0.1", port=srv.port, user="app", database="db",
+        )
+        r = p.authenticate(Credentials("c7", "carol", b"pw9"))
+        assert r.ok and not r.superuser
+        assert not p.authenticate(Credentials("c7", "carol", b"no")).ok
+        assert p.authenticate(Credentials("cx", "mallory", b"x")) is IGNORE
+        p.destroy()
+
+        z = PostgresAuthzSource(
+            "SELECT permission, action, topic FROM mqtt_acl "
+            "WHERE username = ${username}",
+            host="127.0.0.1", port=srv.port, user="app", database="db",
+        )
+        au = lambda a, t: z.authorize("c7", "carol", "10.1.1.1", a, t)
+        assert au("publish", "sensors/c7/temp") == "allow"
+        assert au("publish", "secret/x") == "deny"  # deny rows DO deny
+        assert au("subscribe", "cmds/+") == "allow"  # eq literal
+        assert au("subscribe", "cmds/go") == "nomatch"
+        z.destroy()
+
+    run_sync_against_server(check, handler=handler)
+
+
+@pytest.mark.asyncio
+async def test_postgres_rule_action_bridge():
+    from emqx_tpu.bridges.bridge import BridgeRegistry
+    from emqx_tpu.broker.message import Message
+    from emqx_tpu.broker.pubsub import Broker
+    from emqx_tpu.rules.engine import RuleEngine
+
+    inserted = []
+
+    def handler(sql):
+        if sql.startswith("INSERT"):
+            inserted.append(sql)
+            return [], []
+        return ["?column?"], [["1"]]
+
+    srv = MiniPg(handler=handler)
+    await srv.start()
+    broker = Broker()
+    rules = RuleEngine(broker)
+    rules.install(broker.hooks)
+    reg = BridgeRegistry(broker, rules=rules)
+    try:
+        await reg.create(
+            "pg_sink",
+            PostgresConnector(
+                "127.0.0.1", srv.port, user="app", database="db",
+                sql_template=(
+                    "INSERT INTO mqtt_msg (topic, payload) "
+                    "VALUES (${topic}, ${payload})"
+                ),
+            ),
+        )
+        rules.create_rule(
+            "to_pg", 'SELECT topic, payload FROM "logs/#"',
+            actions=[{"function": "bridge", "args": {"name": "pg_sink"}}],
+        )
+        broker.publish(Message(topic="logs/a", payload=b"it's fine"))
+        await reg.bridges["pg_sink"].resource.buffer.drain()
+        await asyncio.sleep(0.05)
+        assert inserted == [
+            "INSERT INTO mqtt_msg (topic, payload) "
+            "VALUES ('logs/a', 'it''s fine')"
+        ]
+    finally:
+        await reg.stop_all()
+        await srv.stop()
