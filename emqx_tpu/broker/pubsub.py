@@ -80,6 +80,9 @@ class Broker:
         self.on_exclusive_released = None  # fn(topic, client)
         # live listeners (Server instances register on start)
         self.servers: list = []
+        # external tracing seam (emqx_external_trace provider): None
+        # costs one attribute check per publish
+        self.tracer = None
         # fanout plans: matched-filter-set -> prebuilt deduped
         # delivery lists (the ?SUBSCRIBER-bag precomputation,
         # emqx_broker.erl:126-140) — invalidated wholesale on any
@@ -300,10 +303,46 @@ class Broker:
 
     def publish(self, msg: Message) -> int:
         """Single-message cut-through (host trie). Returns deliveries."""
+        if self.tracer is not None:
+            return self._publish_traced(msg)
         msg = self._pre_publish(msg)
         if msg is None:
             return 0
         return self._dispatch(msg, self.router.match_pairs(msg.topic))
+
+    def _publish_traced(self, msg: Message) -> int:
+        """The external-trace leg (emqx_external_trace.erl:29-123 /
+        emqx_otel_trace spans around route + dispatch); lives off the
+        None-tracer hot path entirely."""
+        from ..obs.otel import trace_id_of
+
+        tr = self.tracer
+        tid = trace_id_of(msg)
+        root = tr.start_span("mqtt.publish", tid, None)
+        root.set("mqtt.topic", msg.topic).set("mqtt.qos", msg.qos)
+        if msg.from_client:
+            root.set("mqtt.clientid", msg.from_client)
+        try:
+            out = self._pre_publish(msg)
+            if out is None:
+                root.set("mqtt.dropped", True)
+                return 0
+            rs = tr.start_span("broker.route", tid, root)
+            pairs = self.router.match_pairs(out.topic)
+            rs.set("broker.matched_filters", len(pairs))
+            tr.finish(rs)
+            ds = tr.start_span("broker.dispatch", tid, root)
+            out.headers["trace_root"] = root  # cluster leg parents here
+            try:
+                n = self._dispatch(out, pairs)
+            finally:
+                out.headers.pop("trace_root", None)
+            ds.set("broker.deliveries", n)
+            tr.finish(ds)
+            root.set("mqtt.deliveries", n)
+            return n
+        finally:
+            tr.finish(root)
 
     def publish_batch(self, msgs: Sequence[Message]) -> List[int]:
         """The TPU hot path: one batched device dispatch for the whole

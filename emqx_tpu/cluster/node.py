@@ -570,10 +570,18 @@ class ClusterNode:
         remote_nodes = {d for d in dests if isinstance(d, str) and d != self.node_id}
         n = 0
         payload = msg_to_wire(msg)
+        tracer = getattr(self.broker, "tracer", None)
+        root = msg.headers.get("trace_root") if tracer is not None else None
         for node in remote_nodes:
             addr = self.membership.members.get(node)
             if addr is None:
                 continue
+            if root is not None:
+                # the external-trace forward leg (emqx_otel_trace wraps
+                # emqx_broker:forward, emqx_broker.erl:429-441)
+                fs = tracer.start_span("broker.forward", root.trace_id, root)
+                fs.set("peer.node", node).set("mqtt.topic", msg.topic)
+                tracer.finish(fs)
             self._spawn(
                 self.rpc.cast(
                     addr, "broker", "forward", (payload,), key=msg.topic

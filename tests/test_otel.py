@@ -1,0 +1,114 @@
+"""External tracing seam + OTLP export: span hierarchy around the
+broker publish path and the OTLP/HTTP JSON wire shape against an
+in-process collector.
+
+Ref: apps/emqx/src/emqx_external_trace.erl:29-123,
+apps/emqx_opentelemetry/src/emqx_otel_trace.erl.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.packet import SubOpts
+from emqx_tpu.broker.pubsub import Broker
+from emqx_tpu.obs.otel import MemoryTracer, OtelTracer
+
+
+def test_publish_span_hierarchy():
+    b = Broker()
+    tr = MemoryTracer()
+    b.tracer = tr
+    s, _ = b.open_session("c1", True)
+    s.outgoing_sink = lambda pkts: None
+    b.subscribe(s, "t/#", SubOpts(qos=0))
+    n = b.publish(Message(topic="t/1", payload=b"x", from_client="pub"))
+    assert n == 1
+    by_name = {sp.name: sp for sp in tr.spans}
+    assert set(by_name) == {"mqtt.publish", "broker.route", "broker.dispatch"}
+    root = by_name["mqtt.publish"]
+    assert root.attrs["mqtt.topic"] == "t/1"
+    assert root.attrs["mqtt.deliveries"] == 1
+    assert root.parent_id == ""
+    for child in ("broker.route", "broker.dispatch"):
+        sp = by_name[child]
+        assert sp.trace_id == root.trace_id
+        assert sp.parent_id == root.span_id
+        assert sp.end_ns >= sp.start_ns
+    assert by_name["broker.route"].attrs["broker.matched_filters"] == 1
+    assert by_name["broker.dispatch"].attrs["broker.deliveries"] == 1
+    # trace ids are stable per message id (cross-node correlation)
+    assert len(root.trace_id) == 32
+
+    # dropped publish: root span carries the drop, no route/dispatch
+    from emqx_tpu.broker.hooks import STOP
+
+    tr.spans.clear()
+    b.hooks.add("message.publish", lambda acc: (STOP, None), priority=900)
+    b.publish(Message(topic="t/2", payload=b"y"))
+    names = [sp.name for sp in tr.spans]
+    assert names == ["mqtt.publish"]
+    assert tr.spans[0].attrs.get("mqtt.dropped") is True
+
+
+def test_tracer_none_path_untouched():
+    b = Broker()
+    s, _ = b.open_session("c1", True)
+    got = []
+    s.outgoing_sink = got.extend
+    b.subscribe(s, "t", SubOpts(qos=0))
+    assert b.publish(Message(topic="t", payload=b"z")) == 1
+    assert len(got) == 1
+
+
+@pytest.mark.asyncio
+async def test_otlp_export_shape():
+    received = []
+
+    async def collector(reader, writer):
+        data = b""
+        while b"\r\n\r\n" not in data:
+            data += await reader.read(4096)
+        head, _, body = data.partition(b"\r\n\r\n")
+        clen = int(
+            [l for l in head.split(b"\r\n") if b"content-length" in l.lower()][0]
+            .split(b":")[1]
+        )
+        while len(body) < clen:
+            body += await reader.read(4096)
+        received.append(json.loads(body))
+        writer.write(b"HTTP/1.1 200 OK\r\ncontent-length: 0\r\n\r\n")
+        await writer.drain()
+        writer.close()
+
+    srv = await asyncio.start_server(collector, "127.0.0.1", 0)
+    port = srv.sockets[0].getsockname()[1]
+    b = Broker()
+    tr = OtelTracer(endpoint=f"http://127.0.0.1:{port}/v1/traces",
+                    service_name="test-broker")
+    b.tracer = tr
+    s, _ = b.open_session("c1", True)
+    s.outgoing_sink = lambda pkts: None
+    b.subscribe(s, "m/+", SubOpts(qos=0))
+    b.publish(Message(topic="m/1", payload=b"p"))
+    await asyncio.get_running_loop().run_in_executor(None, tr.flush)
+    srv.close()
+    await srv.wait_closed()
+
+    assert tr.exported == 3
+    doc = received[0]
+    rs = doc["resourceSpans"][0]
+    svc = rs["resource"]["attributes"][0]
+    assert svc == {"key": "service.name",
+                   "value": {"stringValue": "test-broker"}}
+    spans = rs["scopeSpans"][0]["spans"]
+    names = sorted(sp["name"] for sp in spans)
+    assert names == ["broker.dispatch", "broker.route", "mqtt.publish"]
+    root = [sp for sp in spans if sp["name"] == "mqtt.publish"][0]
+    assert "parentSpanId" not in root
+    kids = [sp for sp in spans if sp["name"] != "mqtt.publish"]
+    assert all(sp["parentSpanId"] == root["spanId"] for sp in kids)
+    assert all(sp["traceId"] == root["traceId"] for sp in kids)
+    assert int(root["endTimeUnixNano"]) >= int(root["startTimeUnixNano"])
