@@ -134,9 +134,28 @@ class WsTransport:
         cls, reader: asyncio.StreamReader, writer: asyncio.StreamWriter,
         path: str = "/mqtt",
     ) -> Optional["WsTransport"]:
-        """HTTP/1.1 upgrade. Returns None (after writing an error
-        response) if the request is not a well-formed ws upgrade for
-        `path`; advertises the `mqtt` subprotocol when offered."""
+        """HTTP/1.1 upgrade for the MQTT listener. Returns None (after
+        writing an error response) if the request is not a well-formed
+        ws upgrade for `path`; advertises the `mqtt` subprotocol when
+        offered."""
+        got = await cls.handshake_ex(
+            reader, writer,
+            path_ok=lambda p: p == path,
+            subprotocols=("mqtt",),
+        )
+        return got[0] if got else None
+
+    @classmethod
+    async def handshake_ex(
+        cls, reader: asyncio.StreamReader, writer: asyncio.StreamWriter,
+        path_ok, subprotocols: tuple = (),
+    ):
+        """Generalized upgrade (gateways ride this with their own path
+        shapes and subprotocols, e.g. OCPP's /ocpp/{clientid} +
+        ocpp1.6). Returns (transport, request_path, chosen_subprotocol)
+        or None. When the client offers subprotocols, one of
+        `subprotocols` must match (RFC 6455 §1.9); offering none is
+        accepted with no subprotocol header."""
         try:
             raw = await reader.readuntil(b"\r\n\r\n")
         except (asyncio.IncompleteReadError, asyncio.LimitOverrunError):
@@ -154,36 +173,38 @@ class WsTransport:
                 k, v = ln.split(":", 1)
                 headers[k.strip().lower()] = v.strip()
         key = headers.get("sec-websocket-key")
+        bare_path = req_path.split("?")[0]
         if (
             method != "GET"
-            or req_path.split("?")[0] != path
+            or not path_ok(bare_path)
             or "websocket" not in headers.get("upgrade", "").lower()
             or key is None
         ):
             writer.write(b"HTTP/1.1 400 Bad Request\r\ncontent-length: 0\r\n\r\n")
             return None
-        proto = ""
+        proto_hdr = ""
+        chosen = None
         offered = [
             p.strip()
             for p in headers.get("sec-websocket-protocol", "").split(",")
             if p.strip()
         ]
         if offered:
-            # the reference requires the mqtt subprotocol on ws listeners
-            if "mqtt" not in offered:
+            chosen = next((p for p in offered if p in subprotocols), None)
+            if chosen is None:
                 writer.write(b"HTTP/1.1 400 Bad Request\r\ncontent-length: 0\r\n\r\n")
                 return None
-            proto = "Sec-WebSocket-Protocol: mqtt\r\n"
+            proto_hdr = f"Sec-WebSocket-Protocol: {chosen}\r\n"
         writer.write(
             (
                 "HTTP/1.1 101 Switching Protocols\r\n"
                 "Upgrade: websocket\r\n"
                 "Connection: Upgrade\r\n"
                 f"Sec-WebSocket-Accept: {ws_accept_key(key)}\r\n"
-                f"{proto}\r\n"
+                f"{proto_hdr}\r\n"
             ).encode()
         )
-        return cls(reader, writer)
+        return cls(reader, writer), req_path, chosen
 
     def peername(self):
         return self.writer.get_extra_info("peername")

@@ -28,6 +28,8 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
+import zlib
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from ..broker.message import Message
@@ -179,6 +181,11 @@ class ClusterNode:
         self.broker.on_exclusive_claimed = self._on_exclusive_claimed
         self.broker.on_exclusive_released = self._on_exclusive_released
         self.membership.on_member_down.append(self._purge_node)
+        # per-clientid cluster locks this node LEADS (emqx_cm_locker /
+        # ekka_locker analog): client_id -> holder node. Purged when
+        # the holder dies so a crashed takeover can't wedge the id.
+        self._cm_locks: Dict[str, str] = {}
+        self.membership.on_member_down.append(self._purge_locks)
         self.membership.on_member_up.append(self._on_member_up)
         self.membership.on_ping_ok.append(self._maybe_resync)
         # a broker attached with pre-existing sessions/subscriptions:
@@ -276,6 +283,8 @@ class ClusterNode:
             {
                 "discard": self._handle_discard,
                 "takeover": self._handle_takeover,
+                "lock": self._handle_lock,
+                "unlock": self._handle_unlock,
             },
         )
         reg.register_all(
@@ -679,19 +688,94 @@ class ClusterNode:
     # --- session registry / takeover --------------------------------------
 
     def on_session_opening(self, client_id: str, clean_start: bool) -> None:
-        """Duplicate connect: kick the previous owner node. Async kick
-        (vs the reference's synchronous locked takeover) — the old
-        session dies shortly after the new one starts."""
+        """Duplicate connect: kick the previous owner node UNDER a
+        per-clientid cluster lock, so two simultaneous reconnects on
+        different nodes serialize instead of interleaving their
+        kick/import legs (the reference's emqx_cm_locker around
+        open_session, emqx_cm.erl:285-304). The kick itself stays
+        async relative to the new connection."""
         owner = self.registry.get(client_id)
         if owner is None or owner == self.node_id:
             return
         addr = self.membership.members.get(owner)
         if addr is None:
             return
-        if clean_start:
-            self._spawn(self.rpc.cast(addr, "cm", "discard", (client_id,)))
-        else:
-            self._spawn(self._takeover_import(addr, client_id))
+        self._spawn(self._locked_kick(addr, client_id, clean_start))
+
+    async def _locked_kick(self, addr: Addr, client_id: str,
+                           clean_start: bool) -> None:
+        async def work():
+            if clean_start:
+                try:
+                    await self.rpc.call(addr, "cm", "discard", (client_id,))
+                except (PeerDown, RpcError, asyncio.TimeoutError, OSError):
+                    pass
+            else:
+                await self._takeover_import(addr, client_id)
+
+        await self.with_client_lock(client_id, work)
+
+    # --- per-clientid cluster lock (emqx_cm_locker analog) ----------------
+
+    def _lock_leader(self, client_id: str) -> str:
+        nodes = sorted([self.node_id, *self.membership.members])
+        return nodes[zlib.crc32(client_id.encode()) % len(nodes)]
+
+    def _handle_lock(self, client_id: str, holder: str) -> bool:
+        cur = self._cm_locks.get(client_id)
+        if cur is None or cur == holder:
+            self._cm_locks[client_id] = holder
+            return True
+        return False
+
+    def _handle_unlock(self, client_id: str, holder: str) -> None:
+        if self._cm_locks.get(client_id) == holder:
+            del self._cm_locks[client_id]
+
+    def _purge_locks(self, node_id: str) -> None:
+        for cid in [c for c, h in self._cm_locks.items() if h == node_id]:
+            del self._cm_locks[cid]
+
+    async def with_client_lock(self, client_id: str, fn,
+                               timeout: float = 2.0) -> None:
+        """Run fn() holding the cluster-wide per-clientid lock. The
+        lock leader is deterministic over the live membership; on
+        timeout (leader unreachable / lock wedged) fn runs anyway —
+        availability over strictness, with the contention window
+        logged instead of silent."""
+        leader = self._lock_leader(client_id)
+        addr = self.membership.members.get(leader)
+        deadline = time.monotonic() + timeout
+        got = False
+        while True:
+            try:
+                if leader == self.node_id:
+                    got = self._handle_lock(client_id, self.node_id)
+                else:
+                    got = bool(await self.rpc.call(
+                        addr, "cm", "lock", (client_id, self.node_id)
+                    ))
+            except (PeerDown, RpcError, asyncio.TimeoutError, OSError):
+                break
+            if got or time.monotonic() >= deadline:
+                break
+            await asyncio.sleep(0.05)
+        if not got:
+            log.warning("client lock for %s not acquired — proceeding",
+                        client_id)
+        try:
+            await fn()
+        finally:
+            if got:
+                try:
+                    if leader == self.node_id:
+                        self._handle_unlock(client_id, self.node_id)
+                    else:
+                        await self.rpc.cast(
+                            addr, "cm", "unlock", (client_id, self.node_id)
+                        )
+                except Exception:
+                    pass
 
     async def _takeover_import(self, addr: Addr, client_id: str) -> None:
         try:

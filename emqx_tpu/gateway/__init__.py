@@ -28,12 +28,14 @@ class GatewayRegistry:
         from .coap import CoapGateway
         from .lwm2m import Lwm2mGateway
         from .mqttsn import MqttSnGateway
+        from .ocpp import OcppGateway
         from .stomp import StompGateway
 
         self.register_type("stomp", StompGateway)
         self.register_type("mqttsn", MqttSnGateway)
         self.register_type("coap", CoapGateway)
         self.register_type("lwm2m", Lwm2mGateway)
+        self.register_type("ocpp", OcppGateway)
 
     def register_type(self, name: str, impl: Type[GatewayImpl]) -> None:
         self._types[name] = impl

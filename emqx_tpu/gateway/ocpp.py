@@ -1,0 +1,230 @@
+"""OCPP gateway — WebSocket + OCPP-J (JSON) charge points on pubsub.
+
+Reference: apps/emqx_gateway_ocpp (emqx_ocpp_connection.erl WS
+endpoint, emqx_ocpp_frame.erl OCPP-J codec, emqx_ocpp_channel.erl
+topic mapping; README.md:29-60 for the up/dn topic scheme).
+
+Charge points connect with `GET /ocpp/{clientid}` (subprotocol
+ocpp1.6 / ocpp2.0 / ocpp2.0.1) and exchange OCPP-J TEXT frames:
+
+    Call        [2, "id", "Action", {payload}]
+    CallResult  [3, "id", {payload}]
+    CallError   [4, "id", "code", "description", {details}]
+
+Mapping (the reference's default topic structure):
+
+    device -> broker   publish  ocpp/{cid}/up/{type}/{action}/{id}
+    broker -> device   subscribe ocpp/{cid}/dn/+/+/+; a message on
+                       ocpp/{cid}/dn/{type}/{action}/{id} becomes the
+                       corresponding OCPP-J frame
+
+where type is request|response|error. CallResults need the Action of
+the call they answer, so the gateway tracks in-flight ids in BOTH
+directions (the reference channel keeps the same pending table)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Dict, List, Optional, Tuple
+
+from ..broker.transport import OP_TEXT, ws_encode_frame
+from .base import GatewayImpl
+
+log = logging.getLogger("emqx_tpu.gateway.ocpp")
+
+SUBPROTOCOLS = ("ocpp1.6", "ocpp2.0", "ocpp2.0.1")
+MSG_CALL, MSG_RESULT, MSG_ERROR = 2, 3, 4
+TYPE_OF = {MSG_CALL: "request", MSG_RESULT: "response", MSG_ERROR: "error"}
+MAX_PENDING = 256
+
+
+class _Peer:
+    def __init__(self, session, transport, proto: str):
+        self.session = session
+        self.transport = transport
+        self.proto = proto
+        # upstream Calls awaiting a dn response: id -> action
+        self.up_pending: Dict[str, str] = {}
+        # downstream Calls awaiting an up response: id -> action
+        self.dn_pending: Dict[str, str] = {}
+
+
+class OcppGateway(GatewayImpl):
+    name = "ocpp"
+
+    def __init__(self, broker, conf: dict):
+        super().__init__(broker, conf)
+        self._server: Optional[asyncio.AbstractServer] = None
+        self.listen_addr = None
+        self.peers: Dict[str, _Peer] = {}  # raw charge-point id -> peer
+        self.max_conns = int(conf.get("max_connections", 10_000))
+
+    async def on_load(self) -> None:
+        from ..broker.listeners import parse_bind
+
+        host, port = parse_bind(self.conf.get("bind", "0.0.0.0:33033"))
+        self._server = await asyncio.start_server(self._conn, host, port)
+        self.listen_addr = self._server.sockets[0].getsockname()[:2]
+        log.info("ocpp gateway on %s", self.listen_addr)
+
+    async def on_unload(self) -> None:
+        for cid in list(self.peers):
+            self._drop(cid)
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    def connection_count(self) -> int:
+        return len(self.peers)
+
+    def listener_info(self) -> List[dict]:
+        return (
+            [{"type": "ws",
+              "bind": f"{self.listen_addr[0]}:{self.listen_addr[1]}"}]
+            if self.listen_addr else []
+        )
+
+    # --- connection lifecycle --------------------------------------------
+
+    async def _conn(self, reader, writer) -> None:
+        from ..broker.transport import WsTransport
+
+        got = await WsTransport.handshake_ex(
+            reader, writer,
+            path_ok=lambda p: p.startswith("/ocpp/") and len(p) > 6,
+            subprotocols=SUBPROTOCOLS,
+        )
+        if got is None:
+            writer.close()
+            return
+        transport, path, proto = got
+        cid = path.split("?")[0].rsplit("/", 1)[-1]
+        if len(self.peers) >= self.max_conns:
+            transport.close()
+            writer.close()
+            return
+        old = self.peers.pop(cid, None)
+        if old is not None:  # reconnect replaces the old socket
+            self.close_session(old.session)
+            old.transport.close()
+        try:
+            session, _ = self.open_session(cid)
+        except Exception:
+            transport.close()
+            writer.close()
+            return
+        peer = _Peer(session, transport, proto or SUBPROTOCOLS[0])
+        self.peers[cid] = peer
+        session.outgoing_sink = lambda pkts, c=cid: self._downlink(c, pkts)
+        try:
+            self.subscribe(session, f"ocpp/{cid}/dn/+/+/+", qos=1)
+        except PermissionError:
+            self._drop(cid)
+            writer.close()
+            return
+        try:
+            while True:
+                data = await transport.read()
+                if not data:
+                    break
+                self._handle_frame(cid, data)
+        finally:
+            if self.peers.get(cid) is peer:
+                self._drop(cid)
+            writer.close()
+
+    def _drop(self, cid: str) -> None:
+        peer = self.peers.pop(cid, None)
+        if peer is not None:
+            self.close_session(peer.session)
+            peer.transport.close()
+
+    # --- device -> broker (upstream) --------------------------------------
+
+    def _handle_frame(self, cid: str, data: bytes) -> None:
+        peer = self.peers.get(cid)
+        if peer is None:
+            return
+        try:
+            frame = json.loads(data)
+            mtype = int(frame[0])
+            uid = str(frame[1])
+        except (ValueError, IndexError, TypeError):
+            log.debug("ocpp %s: bad frame", cid)
+            return
+        if mtype == MSG_CALL:
+            if len(frame) < 4 or not isinstance(frame[2], str):
+                return
+            action, payload = frame[2], frame[3]
+            if len(peer.up_pending) >= MAX_PENDING:
+                peer.up_pending.pop(next(iter(peer.up_pending)))
+            peer.up_pending[uid] = action
+        elif mtype == MSG_RESULT:
+            # the response's Action comes from the dn call it answers
+            action = peer.dn_pending.pop(uid, "")
+            payload = frame[2] if len(frame) > 2 else {}
+        elif mtype == MSG_ERROR:
+            action = peer.dn_pending.pop(uid, "")
+            payload = {
+                "ErrorCode": frame[2] if len(frame) > 2 else "",
+                "ErrorDescription": frame[3] if len(frame) > 3 else "",
+                "ErrorDetails": frame[4] if len(frame) > 4 else {},
+            }
+        else:
+            return
+        topic = f"ocpp/{cid}/up/{TYPE_OF[mtype]}/{action}/{uid}"
+        try:
+            self.publish(
+                peer.session, topic,
+                json.dumps(payload).encode(), qos=1,
+            )
+        except (ValueError, PermissionError) as e:
+            log.warning("ocpp %s upstream denied: %s", cid, e)
+
+    # --- broker -> device (downstream) -------------------------------------
+
+    def _downlink(self, cid: str, pkts) -> None:
+        peer = self.peers.get(cid)
+        if peer is None:
+            return
+        for pkt in pkts:
+            topic = self.unmount(pkt.topic)
+            segs = topic.split("/")
+            # ocpp/{cid}/dn/{type}/{action}/{id}
+            if len(segs) != 6 or segs[2] != "dn":
+                continue
+            _, _, _, mtype, action, uid = segs
+            try:
+                payload = json.loads(pkt.payload) if pkt.payload else {}
+            except ValueError:
+                log.warning("ocpp %s: bad dn json for %s", cid, topic)
+                continue
+            if mtype == "request":
+                if len(peer.dn_pending) >= MAX_PENDING:
+                    peer.dn_pending.pop(next(iter(peer.dn_pending)))
+                peer.dn_pending[uid] = action
+                frame: list = [MSG_CALL, uid, action, payload]
+            elif mtype == "response":
+                peer.up_pending.pop(uid, None)
+                frame = [MSG_RESULT, uid, payload]
+            elif mtype == "error":
+                peer.up_pending.pop(uid, None)
+                frame = [
+                    MSG_ERROR, uid,
+                    payload.get("ErrorCode", "GenericError"),
+                    payload.get("ErrorDescription", ""),
+                    payload.get("ErrorDetails", {}),
+                ]
+            else:
+                continue
+            try:
+                # OCPP-J rides TEXT frames (the MQTT listener uses BINARY)
+                peer.transport.writer.write(
+                    ws_encode_frame(OP_TEXT, json.dumps(frame).encode())
+                )
+            except Exception:
+                self._drop(cid)
+                return

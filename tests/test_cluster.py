@@ -456,3 +456,44 @@ async def test_exclusive_concurrent_claims_converge():
     finally:
         await a.stop()
         await b.stop()
+
+
+async def test_client_lock_serializes_takeovers():
+    """Two nodes contending for the same client id serialize through
+    the per-clientid cluster lock (emqx_cm_locker analog); the lock
+    releases afterwards and dead holders are purged."""
+    import asyncio
+
+    nodes, _addrs = await make_cluster(2)
+    n1, n2 = nodes
+    try:
+        await settle(nodes)
+        assert n1._lock_leader("dev-9") == n2._lock_leader("dev-9")
+        order = []
+
+        async def critical(tag, hold):
+            async def work():
+                order.append(f"{tag}-in")
+                await asyncio.sleep(hold)
+                order.append(f"{tag}-out")
+            return work
+
+        # n1 holds the lock; n2's attempt must wait for release
+        t1 = asyncio.ensure_future(
+            n1.with_client_lock("dev-9", await critical("n1", 0.3))
+        )
+        await asyncio.sleep(0.05)
+        t2 = asyncio.ensure_future(
+            n2.with_client_lock("dev-9", await critical("n2", 0.0))
+        )
+        await asyncio.gather(t1, t2)
+        assert order == ["n1-in", "n1-out", "n2-in", "n2-out"]
+        # lock fully released on the leader
+        leader = n1 if n1._lock_leader("dev-9") == "n1" else n2
+        assert leader._cm_locks == {}
+        # a dead holder's locks purge on member_down
+        leader._cm_locks["ghost"] = "nX"
+        leader._purge_locks("nX")
+        assert "ghost" not in leader._cm_locks
+    finally:
+        await stop_all(nodes)

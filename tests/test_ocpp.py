@@ -1,0 +1,169 @@
+"""OCPP gateway e2e: a fake charge point over a real WebSocket
+(masked client frames, ocpp1.6 subprotocol) exchanging OCPP-J calls
+with MQTT peers through pubsub.
+
+Ref: apps/emqx_gateway_ocpp (emqx_ocpp_frame.erl, README.md:29-60).
+"""
+
+import asyncio
+import json
+import os
+
+import pytest
+
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.packet import SubOpts
+from emqx_tpu.broker.pubsub import Broker
+from emqx_tpu.broker.transport import OP_TEXT, ws_encode_frame, ws_read_frame
+from emqx_tpu.gateway import GatewayRegistry
+
+
+class ChargePoint:
+    """WS client speaking OCPP-J with masked frames."""
+
+    def __init__(self, cid):
+        self.cid = cid
+        self.reader = None
+        self.writer = None
+
+    async def connect(self, addr, subproto="ocpp1.6"):
+        self.reader, self.writer = await asyncio.open_connection(*addr)
+        key = "x3JJHMbDL1EzLkh9GBhXDw=="
+        self.writer.write(
+            (
+                f"GET /ocpp/{self.cid} HTTP/1.1\r\n"
+                f"Host: x\r\nUpgrade: websocket\r\nConnection: Upgrade\r\n"
+                f"Sec-WebSocket-Key: {key}\r\n"
+                f"Sec-WebSocket-Version: 13\r\n"
+                f"Sec-WebSocket-Protocol: {subproto}\r\n\r\n"
+            ).encode()
+        )
+        await self.writer.drain()
+        head = await self.reader.readuntil(b"\r\n\r\n")
+        assert b"101" in head.split(b"\r\n")[0], head
+        return head
+
+    async def send(self, frame):
+        self.writer.write(
+            ws_encode_frame(OP_TEXT, json.dumps(frame).encode(),
+                            mask=os.urandom(4))
+        )
+        await self.writer.drain()
+
+    async def recv(self, timeout=2.0):
+        opcode, fin, payload = await asyncio.wait_for(
+            ws_read_frame(self.reader), timeout
+        )
+        assert opcode == OP_TEXT
+        return json.loads(payload)
+
+    def close(self):
+        self.writer.close()
+
+
+def capture(broker, cid, flt):
+    s, _ = broker.open_session(cid, True)
+    box = []
+    s.outgoing_sink = box.extend
+    broker.subscribe(s, flt, SubOpts(qos=0))
+    return box
+
+
+@pytest.mark.asyncio
+async def test_ocpp_call_flow_both_directions():
+    broker = Broker()
+    reg = GatewayRegistry(broker)
+    gw = await reg.load("ocpp", {"bind": "127.0.0.1:0"})
+    cp = ChargePoint("cp-1")
+    up = capture(broker, "csms", "ocpp/cp-1/up/#")
+    try:
+        head = await cp.connect(gw.listen_addr)
+        assert b"Sec-WebSocket-Protocol: ocpp1.6" in head
+        await asyncio.sleep(0.05)
+        assert gw.connection_count() == 1
+
+        # --- device Call -> upstream request topic ----------------------
+        await cp.send([2, "19223201", "BootNotification",
+                       {"chargePointVendor": "emqx", "chargePointModel": "t"}])
+        await asyncio.sleep(0.05)
+        assert up[-1].topic == "ocpp/cp-1/up/request/BootNotification/19223201"
+        assert json.loads(up[-1].payload)["chargePointVendor"] == "emqx"
+
+        # --- CSMS answers on the dn response topic -> CallResult --------
+        broker.publish(Message(
+            topic="ocpp/cp-1/dn/response/BootNotification/19223201",
+            payload=json.dumps({"status": "Accepted", "interval": 300}).encode(),
+        ))
+        frame = await cp.recv()
+        assert frame == [3, "19223201", {"status": "Accepted", "interval": 300}]
+
+        # --- CSMS-originated Call -> device, device answers -------------
+        broker.publish(Message(
+            topic="ocpp/cp-1/dn/request/RemoteStartTransaction/77",
+            payload=json.dumps({"idTag": "abc"}).encode(),
+        ))
+        frame = await cp.recv()
+        assert frame == [2, "77", "RemoteStartTransaction", {"idTag": "abc"}]
+        await cp.send([3, "77", {"status": "Accepted"}])
+        await asyncio.sleep(0.05)
+        # the response's Action is recovered from the pending dn call
+        assert up[-1].topic == "ocpp/cp-1/up/response/RemoteStartTransaction/77"
+
+        # --- device CallError for a dn call ------------------------------
+        broker.publish(Message(
+            topic="ocpp/cp-1/dn/request/Reset/78",
+            payload=json.dumps({"type": "Hard"}).encode(),
+        ))
+        await cp.recv()
+        await cp.send([4, "78", "NotSupported", "no hard reset", {}])
+        await asyncio.sleep(0.05)
+        assert up[-1].topic == "ocpp/cp-1/up/error/Reset/78"
+        assert json.loads(up[-1].payload)["ErrorCode"] == "NotSupported"
+    finally:
+        cp.close()
+        await reg.unload_all()
+
+
+@pytest.mark.asyncio
+async def test_ocpp_bad_subprotocol_rejected():
+    broker = Broker()
+    reg = GatewayRegistry(broker)
+    gw = await reg.load("ocpp", {"bind": "127.0.0.1:0"})
+    try:
+        r, w = await asyncio.open_connection(*gw.listen_addr)
+        w.write(
+            b"GET /ocpp/cp-2 HTTP/1.1\r\nHost: x\r\nUpgrade: websocket\r\n"
+            b"Connection: Upgrade\r\nSec-WebSocket-Key: aaaabbbbccccdddd\r\n"
+            b"Sec-WebSocket-Protocol: mqtt\r\n\r\n"
+        )
+        await w.drain()
+        head = await r.read(64)
+        assert b"400" in head
+        w.close()
+        assert gw.connection_count() == 0
+    finally:
+        await reg.unload_all()
+
+
+@pytest.mark.asyncio
+async def test_ocpp_reconnect_replaces_and_cleans_up():
+    broker = Broker()
+    reg = GatewayRegistry(broker)
+    gw = await reg.load("ocpp", {"bind": "127.0.0.1:0"})
+    try:
+        cp1 = ChargePoint("cp-3")
+        await cp1.connect(gw.listen_addr)
+        await asyncio.sleep(0.05)
+        cp2 = ChargePoint("cp-3")  # same id reconnects
+        await cp2.connect(gw.listen_addr)
+        await asyncio.sleep(0.1)
+        assert gw.connection_count() == 1
+        # the new socket is live
+        await cp2.send([2, "1", "Heartbeat", {}])
+        await asyncio.sleep(0.05)
+        cp2.close()
+        await asyncio.sleep(0.1)
+        assert gw.connection_count() == 0
+        cp1.close()
+    finally:
+        await reg.unload_all()
