@@ -38,6 +38,7 @@ SUBPROTOCOLS = ("ocpp1.6", "ocpp2.0", "ocpp2.0.1")
 MSG_CALL, MSG_RESULT, MSG_ERROR = 2, 3, 4
 TYPE_OF = {MSG_CALL: "request", MSG_RESULT: "response", MSG_ERROR: "error"}
 MAX_PENDING = 256
+MAX_TX_BUFFER = 1 << 20  # drop a peer whose socket stopped draining
 
 
 class _Peer:
@@ -102,6 +103,13 @@ class OcppGateway(GatewayImpl):
             return
         transport, path, proto = got
         cid = path.split("?")[0].rsplit("/", 1)[-1]
+        # the id is embedded in topic names AND the dn filter: a
+        # wildcard or separator here would subscribe to other charge
+        # points' command streams (cross-device eavesdropping)
+        if not cid or any(c in cid for c in "+#/\x00"):
+            transport.close()
+            writer.close()
+            return
         if len(self.peers) >= self.max_conns:
             transport.close()
             writer.close()
@@ -121,7 +129,7 @@ class OcppGateway(GatewayImpl):
         session.outgoing_sink = lambda pkts, c=cid: self._downlink(c, pkts)
         try:
             self.subscribe(session, f"ocpp/{cid}/dn/+/+/+", qos=1)
-        except PermissionError:
+        except (ValueError, PermissionError):
             self._drop(cid)
             writer.close()
             return
@@ -152,7 +160,8 @@ class OcppGateway(GatewayImpl):
             frame = json.loads(data)
             mtype = int(frame[0])
             uid = str(frame[1])
-        except (ValueError, IndexError, TypeError):
+        except (ValueError, IndexError, TypeError, KeyError):
+            # KeyError: a JSON *object* indexes by key, not position
             log.debug("ocpp %s: bad frame", cid)
             return
         if mtype == MSG_CALL:
@@ -221,10 +230,20 @@ class OcppGateway(GatewayImpl):
             else:
                 continue
             try:
+                w = peer.transport.writer
+                # a charge point that stopped reading must not grow the
+                # transmit buffer without bound (the MQTT WS path gets
+                # this from its drain; a sync sink can only cap + drop)
+                if w.transport.get_write_buffer_size() > MAX_TX_BUFFER:
+                    log.warning("ocpp %s: tx buffer overflow — dropping", cid)
+                    self._drop(cid)
+                    return
                 # OCPP-J rides TEXT frames (the MQTT listener uses BINARY)
-                peer.transport.writer.write(
-                    ws_encode_frame(OP_TEXT, json.dumps(frame).encode())
-                )
+                w.write(ws_encode_frame(OP_TEXT, json.dumps(frame).encode()))
             except Exception:
                 self._drop(cid)
                 return
+            # the dn subscription is QoS 1: ack so the inflight window
+            # (receive_maximum) never wedges command delivery
+            if pkt.packet_id is not None:
+                peer.session.on_puback(pkt.packet_id)

@@ -488,8 +488,11 @@ async def test_client_lock_serializes_takeovers():
         )
         await asyncio.gather(t1, t2)
         assert order == ["n1-in", "n1-out", "n2-in", "n2-out"]
-        # lock fully released on the leader
-        leader = n1 if n1._lock_leader("dev-9") == "n1" else n2
+        await asyncio.sleep(0.1)  # unlock is a cast; let it land
+        # lock fully released on the leader (node ids are n0/n1)
+        lid = n1._lock_leader("dev-9")
+        leader = n1 if n1.node_id == lid else n2
+        assert leader.node_id == lid
         assert leader._cm_locks == {}
         # a dead holder's locks purge on member_down
         leader._cm_locks["ghost"] = "nX"
