@@ -26,6 +26,7 @@ class GatewayRegistry:
         self._types: Dict[str, Type[GatewayImpl]] = {}
         self._running: Dict[str, GatewayImpl] = {}
         from .coap import CoapGateway
+        from .exproto import ExProtoGateway
         from .lwm2m import Lwm2mGateway
         from .mqttsn import MqttSnGateway
         from .ocpp import OcppGateway
@@ -36,6 +37,7 @@ class GatewayRegistry:
         self.register_type("coap", CoapGateway)
         self.register_type("lwm2m", Lwm2mGateway)
         self.register_type("ocpp", OcppGateway)
+        self.register_type("exproto", ExProtoGateway)
 
     def register_type(self, name: str, impl: Type[GatewayImpl]) -> None:
         self._types[name] = impl

@@ -1,0 +1,139 @@
+"""ExProto gateway e2e: a toy line-based protocol whose LOGIC lives in
+an out-of-process handler server, bridged to pubsub.
+
+Ref: apps/emqx_gateway_exproto (ConnectionHandler/ConnectionAdapter
+gRPC pair; here the exhook length-prefixed wire carries the same
+conversation).
+"""
+
+import asyncio
+
+import pytest
+
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.packet import SubOpts
+from emqx_tpu.broker.pubsub import Broker
+from emqx_tpu.exhook import _read_frame, _write_frame
+from emqx_tpu.gateway import GatewayRegistry
+
+
+class LineProtoServer:
+    """Handler server for a toy protocol:
+        CONNECT <id>\\n   -> auth
+        SUB <filter>\\n   -> subscribe qos1
+        PUB <topic> <payload>\\n -> publish
+    deliveries render as 'MSG <topic> <payload>\\n' back to the device."""
+
+    def __init__(self):
+        self.server = None
+        self.addr = None
+        self.events = []
+        self._buf = {}
+
+    async def start(self):
+        self.server = await asyncio.start_server(self._conn, "127.0.0.1", 0)
+        self.addr = self.server.sockets[0].getsockname()[:2]
+
+    async def stop(self):
+        self.server.close()
+        await self.server.wait_closed()
+
+    async def _conn(self, reader, writer):
+        try:
+            while True:
+                frame = await _read_frame(reader)
+                self.events.append(frame[0])
+                op = frame[0]
+                if op == "on_bytes":
+                    conn = frame[1]
+                    buf = self._buf.setdefault(conn, b"") + bytes(frame[2])
+                    while b"\n" in buf:
+                        line, _, buf = buf.partition(b"\n")
+                        for cmd in self._lines(conn, line.decode()):
+                            _write_frame(writer, cmd)
+                    self._buf[conn] = buf
+                    await writer.drain()
+                elif op == "deliver":
+                    conn, topic, payload = frame[1], frame[2], bytes(frame[3])
+                    _write_frame(writer, (
+                        "send", conn,
+                        f"MSG {topic} ".encode() + payload + b"\n",
+                    ))
+                    await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionError, ValueError):
+            pass
+        finally:
+            writer.close()
+
+    def _lines(self, conn, line):
+        parts = line.split(" ", 2)
+        if parts[0] == "CONNECT":
+            yield ("auth", conn, parts[1])
+            yield ("send", conn, b"CONNACK\n")
+        elif parts[0] == "SUB":
+            yield ("subscribe", conn, parts[1], 1)
+        elif parts[0] == "PUB":
+            yield ("publish", conn, parts[1], parts[2].encode(), 0)
+        elif parts[0] == "QUIT":
+            yield ("close", conn)
+
+
+def capture(broker, cid, flt):
+    s, _ = broker.open_session(cid, True)
+    box = []
+    s.outgoing_sink = box.extend
+    broker.subscribe(s, flt, SubOpts(qos=0))
+    return box
+
+
+@pytest.mark.asyncio
+async def test_exproto_custom_protocol_end_to_end():
+    handler = LineProtoServer()
+    await handler.start()
+    broker = Broker()
+    reg = GatewayRegistry(broker)
+    gw = await reg.load("exproto", {
+        "bind": "127.0.0.1:0",
+        "server": f"{handler.addr[0]}:{handler.addr[1]}",
+    })
+    box = capture(broker, "mqtt-peer", "frames/#")
+    try:
+        r, w = await asyncio.open_connection(*gw.listen_addr)
+        w.write(b"CONNECT dev42\n")
+        await w.drain()
+        assert await asyncio.wait_for(r.readline(), 2) == b"CONNACK\n"
+        assert gw.connection_count() == 1
+        # device-originated publish reaches MQTT subscribers
+        w.write(b"PUB frames/a hello-x\n")
+        await w.drain()
+        await asyncio.sleep(0.1)
+        assert [(p.topic, p.payload) for p in box] == [
+            ("frames/a", b"hello-x")
+        ]
+        # MQTT publish reaches the device through the handler encoding
+        w.write(b"SUB cmds/dev42\n")
+        await w.drain()
+        await asyncio.sleep(0.1)
+        broker.publish(Message(topic="cmds/dev42", payload=b"go", qos=1))
+        assert await asyncio.wait_for(r.readline(), 2) == b"MSG cmds/dev42 go\n"
+        # server-commanded close tears the device connection down
+        w.write(b"QUIT now\n")
+        await w.drain()
+        assert await asyncio.wait_for(r.read(16), 2) == b""
+        await asyncio.sleep(0.1)
+        assert gw.connection_count() == 0
+        assert "on_connect" in handler.events and "on_close" in handler.events
+        w.close()
+    finally:
+        await reg.unload_all()
+        await handler.stop()
+
+
+@pytest.mark.asyncio
+async def test_exproto_refuses_without_handler_server():
+    broker = Broker()
+    reg = GatewayRegistry(broker)
+    with pytest.raises(OSError):
+        await reg.load("exproto", {
+            "bind": "127.0.0.1:0", "server": "127.0.0.1:1",
+        })
