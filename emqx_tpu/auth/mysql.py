@@ -1,0 +1,123 @@
+"""MySQL-backed authn provider + authz source.
+
+Reference: apps/emqx_auth_mysql/src/emqx_authn_mysql.erl (SELECT
+returning password_hash/salt/is_superuser) and emqx_authz_mysql.erl
+(SELECT returning permission/action/topic rows evaluated in order) —
+the same provider shape as the Postgres backend, over the MySQL wire
+client (bridges/mysql.py)."""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from ..bridges.mysql import MySqlClient, render_sql
+from ..ops import topic as topic_mod
+from .authn import IGNORE, AuthResult, Credentials, Provider
+from .authz import Source
+from .redis import verify_password
+
+log = logging.getLogger("emqx_tpu.auth.mysql")
+
+
+def _cred_params(creds: Credentials) -> dict:
+    return {
+        "clientid": creds.client_id,
+        "username": creds.username or "",
+        "peerhost": creds.peerhost or "",
+        "cert_common_name": creds.cert_cn or "",
+    }
+
+
+class MySqlAuthnProvider(Provider):
+    def __init__(
+        self,
+        query: str,
+        client: Optional[MySqlClient] = None,
+        algorithm: str = "sha256",
+        salt_position: str = "prefix",
+        iterations: int = 1000,
+        **client_kw,
+    ) -> None:
+        self.query = query
+        self.algorithm = algorithm
+        self.salt_position = salt_position
+        self.iterations = iterations
+        self.client = client or MySqlClient(**client_kw)
+
+    def authenticate(self, creds: Credentials):
+        try:
+            cols, rows = self.client.query(
+                render_sql(self.query, _cred_params(creds))
+            )
+        except Exception as e:
+            log.warning("mysql authn lookup failed: %s", e)
+            return IGNORE
+        if not rows:
+            return IGNORE
+        row = dict(zip(cols, rows[0]))
+        stored = row.get("password_hash")
+        if stored is None:
+            return IGNORE
+        ok = verify_password(
+            self.algorithm,
+            stored.encode(),
+            creds.password or b"",
+            (row.get("salt") or "").encode(),
+            self.salt_position,
+            self.iterations,
+        )
+        if not ok:
+            return AuthResult(False, "bad_username_or_password")
+        su = str(row.get("is_superuser", "")).lower() in ("1", "true")
+        return AuthResult(True, superuser=su)
+
+    def destroy(self) -> None:
+        self.client.close()
+
+
+class MySqlAuthzSource(Source):
+    def __init__(
+        self,
+        query: str = (
+            "SELECT permission, action, topic FROM mqtt_acl "
+            "WHERE username = ${username}"
+        ),
+        client: Optional[MySqlClient] = None,
+        **client_kw,
+    ) -> None:
+        self.query = query
+        self.client = client or MySqlClient(**client_kw)
+
+    def authorize(self, client_id, username, peerhost, action, topic) -> str:
+        creds = Credentials(
+            client_id=client_id, username=username, peerhost=peerhost
+        )
+        try:
+            cols, rows = self.client.query(
+                render_sql(self.query, _cred_params(creds))
+            )
+        except Exception as e:
+            log.warning("mysql authz lookup failed: %s", e)
+            return "nomatch"
+        for r in rows:
+            row = dict(zip(cols, r))
+            act = (row.get("action") or "").lower()
+            if act != "all" and act != action:
+                continue
+            flt = (row.get("topic") or "").replace(
+                "${clientid}", client_id
+            ).replace("${username}", username or "")
+            if flt.startswith("eq "):
+                matched = flt[3:] == topic
+            else:
+                matched = topic_mod.match(
+                    topic_mod.words(topic), topic_mod.words(flt)
+                )
+            if matched:
+                perm = (row.get("permission") or "").lower()
+                return "allow" if perm == "allow" else "deny"
+        return "nomatch"
+
+    def destroy(self) -> None:
+        self.client.close()

@@ -1,0 +1,335 @@
+"""MySQL client protocol: codec, sync client, bridge connector.
+
+The reference ships apps/emqx_mysql (mysql-otp behind ecpool) used by
+emqx_auth_mysql and emqx_bridge_mysql. This speaks the client/server
+protocol directly:
+
+    packets: 3-byte little-endian length + sequence byte;
+    handshake v10 -> HandshakeResponse41 (CLIENT_PROTOCOL_41 |
+    SECURE_CONNECTION | PLUGIN_AUTH [| CONNECT_WITH_DB]) with
+    mysql_native_password scrambles (SHA1(pw) XOR SHA1(nonce +
+    SHA1(SHA1(pw)))); AuthSwitchRequest honored for the same plugin;
+    COM_QUERY text protocol (lenenc column count, column definitions,
+    EOF, lenenc-string rows, EOF/OK; ERR -> MySqlError).
+
+Templating reuses the ${placeholder}-to-escaped-literal scheme of the
+Postgres client (backslash escapes added: MySQL strings are not
+standard-SQL by default)."""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import logging
+import socket
+import struct
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from .resource import Connector, QueryError, RecoverableError, ResourceStatus
+
+log = logging.getLogger("emqx_tpu.bridges.mysql")
+
+CLIENT_PROTOCOL_41 = 0x00000200
+CLIENT_CONNECT_WITH_DB = 0x00000008
+CLIENT_SECURE_CONNECTION = 0x00008000
+CLIENT_PLUGIN_AUTH = 0x00080000
+
+
+class MySqlError(QueryError):
+    pass
+
+
+def sql_quote(v: Any) -> str:
+    if v is None:
+        return "NULL"
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, (int, float)):
+        return str(v)
+    if isinstance(v, (bytes, bytearray)):
+        v = v.decode("utf-8", "replace")
+    s = str(v)
+    if "\x00" in s:
+        raise MySqlError("NUL byte in SQL parameter")
+    s = s.replace("\\", "\\\\").replace("'", "''")
+    return f"'{s}'"
+
+
+def render_sql(template: str, params: Dict[str, Any]) -> str:
+    out = template
+    for k, v in params.items():
+        out = out.replace("${" + k + "}", sql_quote(v))
+    return out
+
+
+def native_password_scramble(password: str, nonce: bytes) -> bytes:
+    """mysql_native_password: SHA1(pw) XOR SHA1(nonce + SHA1(SHA1(pw)))."""
+    if not password:
+        return b""
+    h1 = hashlib.sha1(password.encode()).digest()
+    h2 = hashlib.sha1(h1).digest()
+    h3 = hashlib.sha1(nonce + h2).digest()
+    return bytes(a ^ b for a, b in zip(h1, h3))
+
+
+def lenenc(n: int) -> bytes:
+    if n < 0xFB:
+        return bytes([n])
+    if n < 1 << 16:
+        return b"\xfc" + struct.pack("<H", n)
+    if n < 1 << 24:
+        return b"\xfd" + n.to_bytes(3, "little")
+    return b"\xfe" + struct.pack("<Q", n)
+
+
+def read_lenenc(data: bytes, off: int) -> Tuple[Optional[int], int]:
+    b = data[off]
+    off += 1
+    if b < 0xFB:
+        return b, off
+    if b == 0xFB:
+        return None, off  # NULL
+    if b == 0xFC:
+        return struct.unpack_from("<H", data, off)[0], off + 2
+    if b == 0xFD:
+        return int.from_bytes(data[off : off + 3], "little"), off + 3
+    return struct.unpack_from("<Q", data, off)[0], off + 8
+
+
+class MySqlClient:
+    """Minimal SYNC client for the auth hot path (same blocking-window
+    model as the Redis/Postgres backends)."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 3306,
+        user: str = "root",
+        password: str = "",
+        database: str = "",
+        timeout: float = 5.0,
+    ) -> None:
+        self.host, self.port = host, port
+        self.user, self.password, self.database = user, password, database
+        self.timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._seq = 0
+        self._lock = threading.Lock()
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+
+    # --- packet layer -----------------------------------------------------
+
+    def _recv_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("mysql closed connection")
+            buf += chunk
+        return buf
+
+    def _read_packet(self) -> bytes:
+        head = self._recv_exact(4)
+        n = int.from_bytes(head[:3], "little")
+        self._seq = (head[3] + 1) & 0xFF
+        return self._recv_exact(n)
+
+    def _send_packet(self, payload: bytes) -> None:
+        self._sock.sendall(
+            len(payload).to_bytes(3, "little")
+            + bytes([self._seq])
+            + payload
+        )
+        self._seq = (self._seq + 1) & 0xFF
+
+    @staticmethod
+    def _err(payload: bytes) -> MySqlError:
+        code = struct.unpack_from("<H", payload, 1)[0]
+        msg = payload[3:]
+        if msg[:1] == b"#":
+            msg = msg[6:]  # sql state marker + state
+        return MySqlError(f"mysql error {code}: {msg.decode('utf-8', 'replace')}")
+
+    # --- handshake --------------------------------------------------------
+
+    def _connect(self) -> None:
+        s = socket.create_connection((self.host, self.port), self.timeout)
+        s.settimeout(self.timeout)
+        self._sock = s
+        self._seq = 0
+        greet = self._read_packet()
+        if greet[:1] == b"\xff":
+            raise self._err(greet)
+        if greet[0] != 10:
+            raise MySqlError(f"unsupported protocol version {greet[0]}")
+        off = 1
+        end = greet.index(b"\x00", off)  # server version
+        off = end + 1
+        off += 4  # thread id
+        nonce = greet[off : off + 8]
+        off += 8 + 1  # auth data part 1 + filler
+        off += 2 + 1 + 2 + 2  # caps low, charset, status, caps high
+        alen = greet[off]
+        off += 1 + 10  # auth data len + reserved
+        part2 = max(13, alen - 8)
+        nonce += greet[off : off + part2].rstrip(b"\x00")
+        off += part2
+        plugin = greet[off:].split(b"\x00", 1)[0].decode() if off < len(greet) else ""
+        caps = (
+            CLIENT_PROTOCOL_41
+            | CLIENT_SECURE_CONNECTION
+            | CLIENT_PLUGIN_AUTH
+            | (CLIENT_CONNECT_WITH_DB if self.database else 0)
+        )
+        auth = native_password_scramble(self.password, nonce[:20])
+        resp = (
+            struct.pack("<IIB", caps, 1 << 24, 33)  # caps, max packet, utf8
+            + b"\x00" * 23
+            + self.user.encode() + b"\x00"
+            + bytes([len(auth)]) + auth
+            + (self.database.encode() + b"\x00" if self.database else b"")
+            + b"mysql_native_password\x00"
+        )
+        self._send_packet(resp)
+        ok = self._read_packet()
+        if ok[:1] == b"\xfe":  # AuthSwitchRequest
+            plugin = ok[1:].split(b"\x00", 1)[0].decode()
+            if plugin != "mysql_native_password":
+                raise MySqlError(f"unsupported auth plugin {plugin!r}")
+            new_nonce = ok[1:].split(b"\x00", 1)[1].rstrip(b"\x00")
+            self._send_packet(
+                native_password_scramble(self.password, new_nonce[:20])
+            )
+            ok = self._read_packet()
+        if ok[:1] == b"\xff":
+            raise self._err(ok)
+        if ok[:1] != b"\x00":
+            raise MySqlError("handshake did not complete")
+
+    # --- query ------------------------------------------------------------
+
+    def query(self, sql: str) -> Tuple[List[str], List[List[Any]]]:
+        with self._lock:
+            try:
+                if self._sock is None:
+                    self._connect()
+                return self._query_locked(sql)
+            except MySqlError:
+                raise
+            except Exception:
+                self.close()
+                raise
+
+    def _query_locked(self, sql: str):
+        self._seq = 0
+        self._send_packet(b"\x03" + sql.encode())
+        first = self._read_packet()
+        if first[:1] == b"\xff":
+            raise self._err(first)
+        if first[:1] == b"\x00":
+            return [], []  # OK packet: no result set (INSERT/UPDATE)
+        ncols, _ = read_lenenc(first, 0)
+        cols = []
+        for _ in range(ncols):
+            cdef = self._read_packet()
+            # column definition 41: catalog, schema, table, org_table,
+            # name, org_name (lenenc strings)
+            off = 0
+            vals = []
+            for _f in range(6):
+                ln, off = read_lenenc(cdef, off)
+                vals.append(cdef[off : off + (ln or 0)])
+                off += ln or 0
+            cols.append(vals[4].decode())
+        pkt = self._read_packet()
+        if pkt[:1] == b"\xfe" and len(pkt) < 9:
+            pkt = self._read_packet()  # EOF after column defs
+        rows: List[List[Any]] = []
+        while True:
+            if pkt[:1] == b"\xff":
+                raise self._err(pkt)
+            if pkt[:1] == b"\xfe" and len(pkt) < 9:
+                return cols, rows  # EOF/OK terminator
+            off = 0
+            row: List[Any] = []
+            for _ in range(ncols):
+                ln, off = read_lenenc(pkt, off)
+                if ln is None:
+                    row.append(None)
+                else:
+                    row.append(pkt[off : off + ln].decode("utf-8", "replace"))
+                    off += ln
+            rows.append(row)
+            pkt = self._read_packet()
+
+    def ping(self) -> bool:
+        try:
+            self.query("SELECT 1")
+            return True
+        except Exception:
+            return False
+
+
+class MySqlConnector(Connector):
+    """Async bridge driver with sql_template rendering
+    (emqx_bridge_mysql analog)."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 3306,
+        user: str = "root",
+        password: str = "",
+        database: str = "",
+        sql_template: Optional[str] = None,
+        timeout: float = 5.0,
+    ) -> None:
+        self._mk = lambda: MySqlClient(
+            host, port, user=user, password=password, database=database,
+            timeout=timeout,
+        )
+        self.sql_template = sql_template
+        self.client: Optional[MySqlClient] = None
+
+    async def on_start(self) -> None:
+        self.client = self._mk()
+        ok = await asyncio.get_running_loop().run_in_executor(
+            None, self.client.ping
+        )
+        if not ok:
+            raise RecoverableError("mysql unreachable")
+
+    async def on_stop(self) -> None:
+        if self.client is not None:
+            self.client.close()
+            self.client = None
+
+    async def on_query(self, request: Any) -> Any:
+        if isinstance(request, str):
+            sql = request
+        else:
+            if not self.sql_template:
+                raise QueryError("mysql action has no sql_template")
+            sql = render_sql(self.sql_template, dict(request))
+        loop = asyncio.get_running_loop()
+        try:
+            return await loop.run_in_executor(None, self.client.query, sql)
+        except MySqlError:
+            raise
+        except Exception as e:
+            raise RecoverableError(str(e)) from e
+
+    async def health_check(self) -> ResourceStatus:
+        if self.client is None:
+            return ResourceStatus.CONNECTING
+        ok = await asyncio.get_running_loop().run_in_executor(
+            None, self.client.ping
+        )
+        return ResourceStatus.CONNECTED if ok else ResourceStatus.CONNECTING
