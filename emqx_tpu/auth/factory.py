@@ -1,0 +1,130 @@
+"""Config -> auth provider/source materialization.
+
+The reference builds authenticator chains from the `authentication`
+config array (emqx_authn_chains creates one provider per entry keyed
+by mechanism+backend, apps/emqx_auth/src/emqx_authn/
+emqx_authn_chains.erl:17-60) and the authz source chain from
+`authorization.sources` (emqx_authz.erl:93,148-155). This module is
+that mapping for the backends this tree implements; unknown backends
+raise at BOOT so a typo'd config cannot silently run open."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from .authn import BuiltinDbProvider, FixedUserProvider, JwtProvider, Provider
+from .authz import AclRule, BuiltinAclSource, FileAclSource, Source
+
+
+def _common_pw_kw(conf: Dict[str, Any]) -> Dict[str, Any]:
+    ph = conf.get("password_hash_algorithm") or {}
+    return {
+        "algorithm": ph.get("name", conf.get("algorithm", "sha256")),
+        "salt_position": ph.get(
+            "salt_position", conf.get("salt_position", "prefix")
+        ),
+        "iterations": int(ph.get("iterations", 1000)),
+    }
+
+
+def _net_kw(conf: Dict[str, Any], default_port: int) -> Dict[str, Any]:
+    server = conf.get("server", f"127.0.0.1:{default_port}")
+    host, _, port = str(server).rpartition(":")
+    kw: Dict[str, Any] = {
+        "host": host or "127.0.0.1",
+        "port": int(port or default_port),
+    }
+    if conf.get("password") is not None:
+        kw["password"] = conf["password"]
+    if conf.get("username") is not None:
+        kw["user"] = conf["username"]
+    if conf.get("database") is not None:
+        kw["database"] = conf["database"]
+    return kw
+
+
+def provider_from_conf(conf: Dict[str, Any]) -> Provider:
+    backend = conf.get("backend", conf.get("mechanism", ""))
+    if backend == "built_in_database":
+        return BuiltinDbProvider(
+            user_id_type=conf.get("user_id_type", "username"),
+        )
+    if backend == "fixed":
+        return FixedUserProvider(
+            conf.get("users") or {},
+            tuple(conf.get("superusers") or ()),
+        )
+    if backend == "jwt" or conf.get("mechanism") == "jwt":
+        return JwtProvider(
+            secret=str(conf.get("secret", "")).encode(),
+            acl_claim_name=conf.get("acl_claim_name", "acl"),
+        )
+    if backend == "http":
+        from .http import HttpAuthnProvider
+
+        return HttpAuthnProvider(
+            url=conf["url"],
+            method=conf.get("method", "post"),
+            headers=conf.get("headers") or {},
+            timeout=float(conf.get("request_timeout", 5.0)),
+        )
+    if backend == "redis":
+        from .redis import RedisAuthnProvider
+
+        kw = _net_kw(conf, 6379)
+        kw.pop("user", None)
+        return RedisAuthnProvider(
+            conf.get("cmd", "HMGET mqtt_user:${username} password_hash salt"),
+            **_common_pw_kw(conf), **kw,
+        )
+    if backend == "postgresql":
+        from .postgres import PostgresAuthnProvider
+
+        return PostgresAuthnProvider(
+            conf["query"], **_common_pw_kw(conf), **_net_kw(conf, 5432),
+        )
+    if backend == "mysql":
+        from .mysql import MySqlAuthnProvider
+
+        return MySqlAuthnProvider(
+            conf["query"], **_common_pw_kw(conf), **_net_kw(conf, 3306),
+        )
+    raise ValueError(f"unknown authentication backend {backend!r}")
+
+
+def source_from_conf(conf: Dict[str, Any]) -> Source:
+    stype = conf.get("type", "")
+    if stype == "built_in_database":
+        src = BuiltinAclSource()
+        for r in conf.get("rules") or []:
+            src.set_rules(None, [AclRule(**r)])
+        return src
+    if stype == "file":
+        # emqx_authz_file: acl rules from config (or a parsed file)
+        return FileAclSource([AclRule(**r) for r in conf.get("rules") or []])
+    if stype == "http":
+        from .http import HttpAuthzSource
+
+        return HttpAuthzSource(
+            url=conf["url"],
+            method=conf.get("method", "post"),
+            headers=conf.get("headers") or {},
+            timeout=float(conf.get("request_timeout", 5.0)),
+        )
+    if stype == "redis":
+        from .redis import RedisAuthzSource
+
+        kw = _net_kw(conf, 6379)
+        kw.pop("user", None)
+        return RedisAuthzSource(
+            conf.get("cmd", "HGETALL mqtt_acl:${username}"), **kw
+        )
+    if stype == "postgresql":
+        from .postgres import PostgresAuthzSource
+
+        return PostgresAuthzSource(conf["query"], **_net_kw(conf, 5432))
+    if stype == "mysql":
+        from .mysql import MySqlAuthzSource
+
+        return MySqlAuthzSource(conf["query"], **_net_kw(conf, 3306))
+    raise ValueError(f"unknown authorization source type {stype!r}")

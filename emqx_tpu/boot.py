@@ -111,10 +111,29 @@ class Node:
             )
         self.broker = broker
 
-        # 2. auth pipeline
+        # 2. auth pipeline — chains/sources materialize from config
+        # (emqx_authn_chains + emqx_authz source registration); an
+        # unknown backend fails BOOT rather than running open
         from .auth.bridge import AuthPipeline
+        from .auth.factory import provider_from_conf, source_from_conf
+        from .auth.authn import GLOBAL_CHAIN
 
+        authz_conf = cfg.get("authorization") or {}
         self.auth = AuthPipeline()
+        self.auth.authz.no_match = authz_conf.get("no_match", "allow")
+        for i, aconf in enumerate(cfg.get("authentication") or []):
+            if aconf.get("enable", True) is False:
+                continue
+            provider = provider_from_conf(aconf)
+            self.auth.authn.create_authenticator(
+                GLOBAL_CHAIN,
+                aconf.get("id", f"authn-{i}"),
+                provider,
+            )
+        for sconf in authz_conf.get("sources") or []:
+            if sconf.get("enable", True) is False:
+                continue
+            self.auth.authz.add_source(source_from_conf(sconf))
         self.auth.install(broker.hooks)
 
         # 3. feature modules

@@ -7,6 +7,8 @@ boot), emqx_machine_terminator (graceful stop).
 import asyncio
 import json
 
+import pytest
+
 from emqx_tpu.boot import Node
 from emqx_tpu.broker import frame
 from emqx_tpu.broker.packet import (
@@ -116,3 +118,52 @@ async def test_boot_ctl_commands(tmp_path):
         assert "status" in node.ctl.run(["help"])
     finally:
         await node.stop()
+
+
+async def test_auth_chain_materializes_from_config(tmp_path):
+    """`authentication` entries and `authorization.sources` in config
+    become live providers/sources at boot (the emqx_authn_chains /
+    emqx_authz registration path); unknown backends fail boot."""
+    conf = {
+        "node": {"name": "auth-boot@127.0.0.1",
+                 "data_dir": str(tmp_path / "d")},
+        "listeners": {"tcp": {"default": {"bind": "127.0.0.1:0"}}},
+        "authentication": [
+            {"mechanism": "password_based", "backend": "fixed",
+             "users": {"alice": "pw1"}, "superusers": []},
+        ],
+        "authorization": {
+            "no_match": "deny",
+            "sources": [
+                {"type": "file", "rules": [
+                    {"permission": "allow", "action": "all",
+                     "topic": "ok/#"},
+                ]},
+            ],
+        },
+    }
+    node = Node(config_text=json.dumps(conf))
+    await node.start()
+    try:
+        from emqx_tpu.auth.authn import Credentials
+
+        assert node.auth.authn.authenticate(
+            Credentials("c1", "alice", b"pw1")
+        ).ok
+        assert not node.auth.authn.authenticate(
+            Credentials("c1", "alice", b"wrong")
+        ).ok
+        # authz: allowed topic passes, everything else hits no_match=deny
+        assert node.auth.authz.authorize("c1", "alice", "", "publish", "ok/x")
+        assert not node.auth.authz.authorize(
+            "c1", "alice", "", "publish", "secret/x"
+        )
+    finally:
+        await node.stop()
+
+    bad = dict(conf)
+    bad["authentication"] = [{"backend": "carrier_pigeon"}]
+    node2 = Node(config_text=json.dumps(bad))
+    with pytest.raises(ValueError, match="carrier_pigeon"):
+        await node2.start()
+    await node2.stop()
