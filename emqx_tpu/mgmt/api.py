@@ -273,9 +273,13 @@ class ManagementApi:
     # --- lifecycle --------------------------------------------------------
 
     async def start(self, host: str = "127.0.0.1", port: int = 0):
-        return await self.http.start(host, port)
+        addr = await self.http.start(host, port)
+        self._monitor().start()  # dashboard rate sampling
+        return addr
 
     async def stop(self) -> None:
+        if getattr(self, "monitor", None) is not None:
+            self.monitor.stop()
         await self.http.stop()
 
     # --- route table ------------------------------------------------------
@@ -340,6 +344,8 @@ class ManagementApi:
         r("GET", "/api/v5/bridges", self._bridges_list)
         r("GET", "/api/v5/bridges/{name}", self._bridge_one)
         r("GET", "/api/v5/swagger.json", self._swagger)
+        r("GET", "/api/v5/monitor", self._monitor_window)
+        r("GET", "/api/v5/monitor_current", self._monitor_current)
         r("GET", "/api/v5/mqtt/topic_metrics", self._topic_metrics_list)
         r("POST", "/api/v5/mqtt/topic_metrics", self._topic_metrics_add)
         r(
@@ -520,6 +526,29 @@ class ManagementApi:
         }
 
     # --- topic metrics (emqx_topic_metrics) ----------------------------
+
+    def _monitor(self):
+        if getattr(self, "monitor", None) is None:
+            from ..obs.monitor import Monitor
+
+            self.monitor = Monitor(self.broker)
+        return self.monitor
+
+    def _monitor_window(self, req: Request):
+        """Sampled rate window (emqx_dashboard_monitor)."""
+        latest = None
+        if req is not None and req.query.get("latest"):
+            try:
+                latest = int(req.query["latest"])
+            except ValueError:
+                return Response.error(400, "BAD_REQUEST", "bad latest")
+        m = self._monitor()
+        if not m.samples:
+            m.sample()
+        return m.window(latest)
+
+    def _monitor_current(self, q):
+        return self._monitor().current()
 
     def _topic_metrics(self):
         if getattr(self, "topic_metrics", None) is None:

@@ -173,3 +173,34 @@ async def test_rebalance_purge():
         assert st == 200
     finally:
         await api.stop()
+
+
+@pytest.mark.asyncio
+async def test_dashboard_monitor_sampling():
+    """Rate samples derive from counter deltas; the window is bounded
+    and /monitor_current serves instantaneous gauges
+    (emqx_dashboard_monitor analog)."""
+    broker = Broker()
+    api = ManagementApi(broker)
+    api._monitor().interval = 0.05
+    addr = await api.start("127.0.0.1", 0)
+    try:
+        tok = await login(addr)
+        s, _ = broker.open_session("m1", True)
+        s.outgoing_sink = lambda pkts: None
+        broker.subscribe(s, "mon/#", SubOpts(qos=0))
+        for i in range(20):
+            broker.publish(Message(topic=f"mon/{i}", payload=b"x"))
+        await asyncio.sleep(0.2)
+        st, cur = await http_call(addr, "GET", "/api/v5/monitor_current",
+                                  token=tok)
+        assert st == 200
+        assert cur["received_msg"] >= 20 and cur["subscriptions"] == 1
+        st, win = await http_call(addr, "GET", "/api/v5/monitor?latest=3",
+                                  token=tok)
+        assert st == 200 and 1 <= len(win) <= 3
+        assert all("received_msg_rate" in w and "time_stamp" in w for w in win)
+        # some sample saw the burst as a positive rate
+        assert any(w["received_msg_rate"] > 0 for w in api.monitor.samples)
+    finally:
+        await api.stop()
