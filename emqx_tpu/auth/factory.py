@@ -89,6 +89,17 @@ def provider_from_conf(conf: Dict[str, Any]) -> Provider:
         return MySqlAuthnProvider(
             conf["query"], **_common_pw_kw(conf), **_net_kw(conf, 3306),
         )
+    if backend == "mongodb":
+        from .mongodb import MongoAuthnProvider
+
+        kw = _net_kw(conf, 27017)
+        kw.pop("user", None)
+        kw.pop("password", None)
+        return MongoAuthnProvider(
+            collection=conf.get("collection", "mqtt_user"),
+            flt=conf.get("filter"),
+            **_common_pw_kw(conf), **kw,
+        )
     raise ValueError(f"unknown authentication backend {backend!r}")
 
 
@@ -127,4 +138,14 @@ def source_from_conf(conf: Dict[str, Any]) -> Source:
         from .mysql import MySqlAuthzSource
 
         return MySqlAuthzSource(conf["query"], **_net_kw(conf, 3306))
+    if stype == "mongodb":
+        from .mongodb import MongoAuthzSource
+
+        kw = _net_kw(conf, 27017)
+        kw.pop("user", None)
+        kw.pop("password", None)
+        return MongoAuthzSource(
+            collection=conf.get("collection", "mqtt_acl"),
+            flt=conf.get("filter"), **kw,
+        )
     raise ValueError(f"unknown authorization source type {stype!r}")

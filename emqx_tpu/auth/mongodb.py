@@ -1,0 +1,138 @@
+"""MongoDB-backed authn provider + authz source.
+
+Reference: apps/emqx_auth_mongodb/src/emqx_authn_mongodb.erl (find one
+document by a templated filter; password_hash/salt/is_superuser
+fields) and emqx_authz_mongodb.erl (documents carrying
+permission/action/topics arrays, evaluated in order)."""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Optional
+
+from ..bridges.mongodb import MongoClient
+from ..ops import topic as topic_mod
+from .authn import IGNORE, AuthResult, Credentials, Provider
+from .authz import Source
+from .redis import verify_password
+
+log = logging.getLogger("emqx_tpu.auth.mongodb")
+
+
+def _fill(v: Any, creds: Credentials) -> Any:
+    if isinstance(v, str):
+        return (
+            v.replace("${clientid}", creds.client_id)
+            .replace("${username}", creds.username or "")
+            .replace("${peerhost}", creds.peerhost or "")
+        )
+    if isinstance(v, dict):
+        return {k: _fill(x, creds) for k, x in v.items()}
+    if isinstance(v, list):
+        return [_fill(x, creds) for x in v]
+    return v
+
+
+class MongoAuthnProvider(Provider):
+    def __init__(
+        self,
+        collection: str = "mqtt_user",
+        flt: Optional[Dict[str, Any]] = None,
+        client: Optional[MongoClient] = None,
+        password_hash_field: str = "password_hash",
+        salt_field: str = "salt",
+        is_superuser_field: str = "is_superuser",
+        algorithm: str = "sha256",
+        salt_position: str = "prefix",
+        iterations: int = 1000,
+        **client_kw,
+    ) -> None:
+        self.collection = collection
+        self.filter = flt or {"username": "${username}"}
+        self.fields = (password_hash_field, salt_field, is_superuser_field)
+        self.algorithm = algorithm
+        self.salt_position = salt_position
+        self.iterations = iterations
+        self.client = client or MongoClient(**client_kw)
+
+    def authenticate(self, creds: Credentials):
+        try:
+            docs = self.client.find(
+                self.collection, _fill(self.filter, creds), limit=1
+            )
+        except Exception as e:
+            log.warning("mongodb authn lookup failed: %s", e)
+            return IGNORE
+        if not docs:
+            return IGNORE
+        doc = docs[0]
+        pw_f, salt_f, su_f = self.fields
+        stored = doc.get(pw_f)
+        if stored is None:
+            return IGNORE
+        ok = verify_password(
+            self.algorithm,
+            stored.encode() if isinstance(stored, str) else bytes(stored),
+            creds.password or b"",
+            (doc.get(salt_f) or "").encode()
+            if isinstance(doc.get(salt_f), str)
+            else bytes(doc.get(salt_f) or b""),
+            self.salt_position,
+            self.iterations,
+        )
+        if not ok:
+            return AuthResult(False, "bad_username_or_password")
+        return AuthResult(True, superuser=bool(doc.get(su_f)))
+
+    def destroy(self) -> None:
+        self.client.close()
+
+
+class MongoAuthzSource(Source):
+    """Documents shaped {permission, action, topics: [...]}, evaluated
+    in order; first topic match wins (emqx_authz_mongodb.erl)."""
+
+    def __init__(
+        self,
+        collection: str = "mqtt_acl",
+        flt: Optional[Dict[str, Any]] = None,
+        client: Optional[MongoClient] = None,
+        **client_kw,
+    ) -> None:
+        self.collection = collection
+        self.filter = flt or {"username": "${username}"}
+        self.client = client or MongoClient(**client_kw)
+
+    def authorize(self, client_id, username, peerhost, action, topic) -> str:
+        creds = Credentials(
+            client_id=client_id, username=username, peerhost=peerhost
+        )
+        try:
+            docs = self.client.find(
+                self.collection, _fill(self.filter, creds)
+            )
+        except Exception as e:
+            log.warning("mongodb authz lookup failed: %s", e)
+            return "nomatch"
+        for doc in docs:
+            act = str(doc.get("action", "")).lower()
+            if act != "all" and act != action:
+                continue
+            topics = doc.get("topics") or []
+            if isinstance(topics, str):
+                topics = [topics]
+            for raw in topics:
+                flt = _fill(str(raw), creds)
+                if flt.startswith("eq "):
+                    matched = flt[3:] == topic
+                else:
+                    matched = topic_mod.match(
+                        topic_mod.words(topic), topic_mod.words(flt)
+                    )
+                if matched:
+                    perm = str(doc.get("permission", "")).lower()
+                    return "allow" if perm == "allow" else "deny"
+        return "nomatch"
+
+    def destroy(self) -> None:
+        self.client.close()

@@ -1,0 +1,279 @@
+"""MongoDB wire protocol: BSON codec, OP_MSG client, bridge connector.
+
+The reference ships apps/emqx_mongodb (mongodb-erlang behind ecpool)
+used by emqx_auth_mongodb and emqx_bridge_mongodb. This speaks the
+modern wire directly:
+
+    BSON documents (the subset drivers actually exchange: double,
+    string, embedded doc, array, binary, bool, null, int32, int64,
+    objectid passthrough);
+    OP_MSG (opcode 2013) with a single section-0 body document;
+    commands: hello/ping, find (with filter/limit), insert.
+
+Authentication: SCRAM is deliberately out (no server to test against
+would exercise it honestly); connections are unauthenticated like a
+default mongod — configs carrying username/password are rejected at
+CONFIG time rather than silently ignored."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import socket
+import struct
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from .resource import Connector, QueryError, RecoverableError, ResourceStatus
+
+log = logging.getLogger("emqx_tpu.bridges.mongodb")
+
+OP_MSG = 2013
+
+
+class MongoError(QueryError):
+    pass
+
+
+# --- BSON ------------------------------------------------------------------
+
+
+def bson_encode(doc: Dict[str, Any]) -> bytes:
+    body = b"".join(_bson_elem(k, v) for k, v in doc.items())
+    return struct.pack("<i", len(body) + 5) + body + b"\x00"
+
+
+def _bson_elem(key: str, v: Any) -> bytes:
+    k = key.encode() + b"\x00"
+    if isinstance(v, bool):
+        return b"\x08" + k + (b"\x01" if v else b"\x00")
+    if isinstance(v, float):
+        return b"\x01" + k + struct.pack("<d", v)
+    if isinstance(v, int):
+        if -(1 << 31) <= v < 1 << 31:
+            return b"\x10" + k + struct.pack("<i", v)
+        return b"\x12" + k + struct.pack("<q", v)
+    if isinstance(v, str):
+        b = v.encode()
+        return b"\x02" + k + struct.pack("<i", len(b) + 1) + b + b"\x00"
+    if isinstance(v, (bytes, bytearray)):
+        return b"\x05" + k + struct.pack("<i", len(v)) + b"\x00" + bytes(v)
+    if v is None:
+        return b"\x0a" + k
+    if isinstance(v, dict):
+        return b"\x03" + k + bson_encode(v)
+    if isinstance(v, (list, tuple)):
+        return b"\x04" + k + bson_encode(
+            {str(i): item for i, item in enumerate(v)}
+        )
+    raise MongoError(f"cannot BSON-encode {type(v).__name__}")
+
+
+def bson_decode(data: bytes, off: int = 0) -> Tuple[Dict[str, Any], int]:
+    (n,) = struct.unpack_from("<i", data, off)
+    end = off + n - 1  # excludes trailing NUL
+    off += 4
+    doc: Dict[str, Any] = {}
+    while off < end:
+        t = data[off]
+        off += 1
+        knul = data.index(b"\x00", off)
+        key = data[off:knul].decode()
+        off = knul + 1
+        if t == 0x01:
+            doc[key] = struct.unpack_from("<d", data, off)[0]
+            off += 8
+        elif t == 0x02:
+            (ln,) = struct.unpack_from("<i", data, off)
+            off += 4
+            doc[key] = data[off : off + ln - 1].decode("utf-8", "replace")
+            off += ln
+        elif t in (0x03, 0x04):
+            sub, off = bson_decode(data, off)
+            doc[key] = (
+                [sub[str(i)] for i in range(len(sub))] if t == 0x04 else sub
+            )
+        elif t == 0x05:
+            (ln,) = struct.unpack_from("<i", data, off)
+            off += 5  # length + subtype
+            doc[key] = bytes(data[off : off + ln])
+            off += ln
+        elif t == 0x07:  # objectid: passthrough hex
+            doc[key] = data[off : off + 12].hex()
+            off += 12
+        elif t == 0x08:
+            doc[key] = data[off] != 0
+            off += 1
+        elif t == 0x09:  # UTC datetime (ms)
+            doc[key] = struct.unpack_from("<q", data, off)[0]
+            off += 8
+        elif t == 0x0A:
+            doc[key] = None
+        elif t == 0x10:
+            doc[key] = struct.unpack_from("<i", data, off)[0]
+            off += 4
+        elif t == 0x12:
+            doc[key] = struct.unpack_from("<q", data, off)[0]
+            off += 8
+        else:
+            raise MongoError(f"unsupported BSON type 0x{t:02x}")
+    return doc, end + 1
+
+
+# --- client ---------------------------------------------------------------
+
+
+class MongoClient:
+    """Minimal SYNC client (OP_MSG commands) for the auth hot path."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 27017,
+        database: str = "mqtt",
+        timeout: float = 5.0,
+    ) -> None:
+        self.host, self.port = host, port
+        self.database = database
+        self.timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._req = 0
+        self._lock = threading.Lock()
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+
+    def _recv_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("mongodb closed connection")
+            buf += chunk
+        return buf
+
+    def command(self, doc: Dict[str, Any]) -> Dict[str, Any]:
+        with self._lock:
+            try:
+                if self._sock is None:
+                    self._sock = socket.create_connection(
+                        (self.host, self.port), self.timeout
+                    )
+                    self._sock.settimeout(self.timeout)
+                return self._command_locked(doc)
+            except MongoError:
+                raise
+            except Exception:
+                self.close()
+                raise
+
+    def _command_locked(self, doc: Dict[str, Any]) -> Dict[str, Any]:
+        doc = dict(doc)
+        doc.setdefault("$db", self.database)
+        self._req += 1
+        # flagBits i32 = 0, then one kind-0 section (the body document)
+        payload = struct.pack("<i", 0) + b"\x00" + bson_encode(doc)
+        self._sock.sendall(
+            struct.pack("<iiii", 16 + len(payload), self._req, 0, OP_MSG)
+            + payload
+        )
+        head = self._recv_exact(16)
+        (ln, _rid, _resp_to, opcode) = struct.unpack("<iiii", head)
+        data = self._recv_exact(ln - 16)
+        if opcode != OP_MSG:
+            raise MongoError(f"unexpected opcode {opcode}")
+        # flagBits(4) + kind byte + body document
+        if data[4] != 0:
+            raise MongoError("unsupported OP_MSG section kind")
+        out, _ = bson_decode(data, 5)
+        if out.get("ok") != 1 and out.get("ok") != 1.0:
+            raise MongoError(str(out.get("errmsg", out)))
+        return out
+
+    def find(
+        self,
+        collection: str,
+        flt: Dict[str, Any],
+        limit: int = 0,
+    ) -> List[Dict[str, Any]]:
+        cmd: Dict[str, Any] = {"find": collection, "filter": flt}
+        if limit:
+            cmd["limit"] = limit
+        out = self.command(cmd)
+        return out.get("cursor", {}).get("firstBatch", [])
+
+    def insert(self, collection: str, docs: List[Dict[str, Any]]) -> int:
+        out = self.command({"insert": collection, "documents": docs})
+        return int(out.get("n", 0))
+
+    def ping(self) -> bool:
+        try:
+            return self.command({"ping": 1}).get("ok") in (1, 1.0)
+        except Exception:
+            return False
+
+
+class MongoConnector(Connector):
+    """Async bridge driver: message-env dicts insert into a collection
+    (emqx_bridge_mongodb payload template -> document)."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 27017,
+        database: str = "mqtt",
+        collection: str = "msg",
+        username: Optional[str] = None,
+        password: Optional[str] = None,
+        timeout: float = 5.0,
+    ) -> None:
+        if username or password:
+            raise ValueError(
+                "mongodb auth (SCRAM) is not implemented — connect to an "
+                "unauthenticated endpoint or front it with a proxy"
+            )
+        self._mk = lambda: MongoClient(
+            host, port, database=database, timeout=timeout
+        )
+        self.collection = collection
+        self.client: Optional[MongoClient] = None
+
+    async def on_start(self) -> None:
+        self.client = self._mk()
+        ok = await asyncio.get_running_loop().run_in_executor(
+            None, self.client.ping
+        )
+        if not ok:
+            raise RecoverableError("mongodb unreachable")
+
+    async def on_stop(self) -> None:
+        if self.client is not None:
+            self.client.close()
+            self.client = None
+
+    async def on_query(self, request: Any) -> Any:
+        doc = {
+            k: (v.decode("utf-8", "replace") if isinstance(v, bytes) else v)
+            for k, v in dict(request).items()
+        }
+        loop = asyncio.get_running_loop()
+        try:
+            return await loop.run_in_executor(
+                None, self.client.insert, self.collection, [doc]
+            )
+        except MongoError:
+            raise
+        except Exception as e:
+            raise RecoverableError(str(e)) from e
+
+    async def health_check(self) -> ResourceStatus:
+        if self.client is None:
+            return ResourceStatus.CONNECTING
+        ok = await asyncio.get_running_loop().run_in_executor(
+            None, self.client.ping
+        )
+        return ResourceStatus.CONNECTED if ok else ResourceStatus.CONNECTING

@@ -1,0 +1,189 @@
+"""MongoDB stack tests: BSON round trips, OP_MSG client against a
+mini server, authn/authz e2e — the same pattern as the other
+wire-backend mini servers.
+"""
+
+import asyncio
+import hashlib
+import struct
+import threading
+
+import pytest
+
+from emqx_tpu.auth.authn import IGNORE, Credentials
+from emqx_tpu.auth.mongodb import MongoAuthnProvider, MongoAuthzSource
+from emqx_tpu.bridges.mongodb import (
+    MongoClient,
+    MongoError,
+    bson_decode,
+    bson_encode,
+)
+
+
+def test_bson_roundtrip():
+    doc = {
+        "s": "héllo",
+        "i": 42,
+        "big": 1 << 40,
+        "f": -2.5,
+        "b": True,
+        "n": None,
+        "bin": b"\x00\xff",
+        "sub": {"x": 1, "arr": ["a", 2, {"deep": False}]},
+    }
+    wire = bson_encode(doc)
+    out, used = bson_decode(wire)
+    assert used == len(wire)
+    assert out == doc
+    with pytest.raises(MongoError):
+        bson_encode({"bad": object()})
+
+
+class MiniMongo:
+    """OP_MSG server over dict collections."""
+
+    def __init__(self):
+        self.collections = {}
+        self.server = None
+        self.port = None
+
+    async def start(self):
+        self.server = await asyncio.start_server(self._conn, "127.0.0.1", 0)
+        self.port = self.server.sockets[0].getsockname()[1]
+
+    async def stop(self):
+        self.server.close()
+        await self.server.wait_closed()
+
+    async def _conn(self, reader, writer):
+        try:
+            while True:
+                head = await reader.readexactly(16)
+                (ln, rid, _rt, opcode) = struct.unpack("<iiii", head)
+                data = await reader.readexactly(ln - 16)
+                doc, _ = bson_decode(data, 5)
+                resp = self._exec(doc)
+                payload = struct.pack("<i", 0) + b"\x00" + bson_encode(resp)
+                writer.write(
+                    struct.pack("<iiii", 16 + len(payload), 1, rid, 2013)
+                    + payload
+                )
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            writer.close()
+
+    def _exec(self, doc):
+        if "ping" in doc:
+            return {"ok": 1}
+        if "find" in doc:
+            coll = self.collections.get(doc["find"], [])
+            flt = doc.get("filter") or {}
+            hits = [
+                d for d in coll
+                if all(d.get(k) == v for k, v in flt.items())
+            ]
+            limit = doc.get("limit") or 0
+            if limit:
+                hits = hits[:limit]
+            return {
+                "ok": 1,
+                "cursor": {"id": 0, "firstBatch": hits,
+                           "ns": f"db.{doc['find']}"},
+            }
+        if "insert" in doc:
+            self.collections.setdefault(doc["insert"], []).extend(
+                doc.get("documents") or []
+            )
+            return {"ok": 1, "n": len(doc.get("documents") or [])}
+        return {"ok": 0, "errmsg": f"unknown command {list(doc)[0]}"}
+
+
+def run_sync(fn, seed=None):
+    result = {}
+    started = threading.Event()
+    stop = threading.Event()
+
+    def thread():
+        async def main():
+            srv = MiniMongo()
+            await srv.start()
+            if seed:
+                seed(srv)
+            result["srv"] = srv
+            started.set()
+            while not stop.is_set():
+                await asyncio.sleep(0.01)
+            await srv.stop()
+
+        asyncio.run(main())
+
+    t = threading.Thread(target=thread, daemon=True)
+    t.start()
+    assert started.wait(5)
+    try:
+        fn(result["srv"])
+    finally:
+        stop.set()
+        t.join(5)
+
+
+def test_mongo_client_find_insert_errors():
+    def check(srv):
+        c = MongoClient("127.0.0.1", srv.port, database="db")
+        assert c.ping()
+        assert c.insert("t", [{"a": 1}, {"a": 2, "tag": "x"}]) == 2
+        assert c.find("t", {"a": 2}) == [{"a": 2, "tag": "x"}]
+        assert c.find("t", {"a": 99}) == []
+        with pytest.raises(MongoError, match="unknown command"):
+            c.command({"frobnicate": 1})
+        assert c.ping()  # connection survives a command error
+        c.close()
+
+    run_sync(check)
+
+
+def test_mongo_authn_authz():
+    salt = "mg"
+    hashed = hashlib.sha256((salt + "pw7").encode()).hexdigest()
+
+    def seed(srv):
+        srv.collections["mqtt_user"] = [{
+            "username": "frank", "password_hash": hashed,
+            "salt": salt, "is_superuser": False,
+        }]
+        srv.collections["mqtt_acl"] = [
+            {"username": "frank", "permission": "allow",
+             "action": "publish", "topics": ["f/${clientid}/#", "shared/x"]},
+            {"username": "frank", "permission": "deny",
+             "action": "all", "topics": ["#"]},
+        ]
+
+    def check(srv):
+        p = MongoAuthnProvider(
+            host="127.0.0.1", port=srv.port, database="db",
+            algorithm="sha256", salt_position="prefix",
+        )
+        assert p.authenticate(Credentials("c8", "frank", b"pw7")).ok
+        assert not p.authenticate(Credentials("c8", "frank", b"no")).ok
+        assert p.authenticate(Credentials("cx", "grace", b"x")) is IGNORE
+        p.destroy()
+
+        z = MongoAuthzSource(host="127.0.0.1", port=srv.port, database="db")
+        au = lambda a, t: z.authorize("c8", "frank", "::1", a, t)
+        assert au("publish", "f/c8/data") == "allow"
+        assert au("publish", "shared/x") == "allow"
+        # the catch-all deny document matches everything else
+        assert au("publish", "elsewhere") == "deny"
+        assert au("subscribe", "f/c8/data") == "deny"  # action-scoped allow
+        z.destroy()
+
+    run_sync(check, seed=seed)
+
+
+def test_mongo_connector_rejects_auth_config():
+    from emqx_tpu.bridges.mongodb import MongoConnector
+
+    with pytest.raises(ValueError, match="SCRAM"):
+        MongoConnector(username="u", password="p")
