@@ -89,6 +89,21 @@ def provider_from_conf(conf: Dict[str, Any]) -> Provider:
         return MySqlAuthnProvider(
             conf["query"], **_common_pw_kw(conf), **_net_kw(conf, 3306),
         )
+    if backend == "ldap":
+        from .ldap import LdapAuthnProvider
+
+        kw = _net_kw(conf, 389)
+        kw.pop("database", None)
+        kw["bind_dn"] = conf.get("bind_dn", "")
+        kw["bind_password"] = conf.get("bind_password", "")
+        kw.pop("user", None)
+        kw.pop("password", None)
+        return LdapAuthnProvider(
+            base_dn=conf["base_dn"],
+            filter_attr=conf.get("filter_attr", "uid"),
+            method=conf.get("method", "bind"),
+            **kw,
+        )
     if backend == "mongodb":
         from .mongodb import MongoAuthnProvider
 
@@ -138,6 +153,20 @@ def source_from_conf(conf: Dict[str, Any]) -> Source:
         from .mysql import MySqlAuthzSource
 
         return MySqlAuthzSource(conf["query"], **_net_kw(conf, 3306))
+    if stype == "ldap":
+        from .ldap import LdapAuthzSource
+
+        kw = _net_kw(conf, 389)
+        kw.pop("database", None)
+        kw.pop("user", None)
+        kw.pop("password", None)
+        kw["bind_dn"] = conf.get("bind_dn", "")
+        kw["bind_password"] = conf.get("bind_password", "")
+        return LdapAuthzSource(
+            base_dn=conf["base_dn"],
+            filter_attr=conf.get("filter_attr", "uid"),
+            **kw,
+        )
     if stype == "mongodb":
         from .mongodb import MongoAuthzSource
 

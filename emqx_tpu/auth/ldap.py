@@ -1,0 +1,348 @@
+"""LDAP authn provider + authz-by-attribute, over a BER/LDAPv3 codec.
+
+Reference: apps/emqx_auth_ldap (eldap behind ecpool):
+emqx_authn_ldap.erl supports two methods — `hash` (search the user's
+entry, compare a password attribute) and `bind` (re-bind as the
+user's DN with the presented password); emqx_authz_ldap reads
+publish/subscribe topic attributes from the same entry.
+
+The wire here is LDAPv3 over BER (RFC 4511):
+
+    LDAPMessage ::= SEQUENCE { messageID, protocolOp }
+    BindRequest   [APPLICATION 0]: version, name, simple [0] password
+    BindResponse  [APPLICATION 1]: resultCode, matchedDN, diagnostic
+    SearchRequest [APPLICATION 3]: baseObject, scope, derefAliases,
+        sizeLimit, timeLimit, typesOnly, filter (equalityMatch [3] /
+        and [0]), attributes
+    SearchResultEntry [APPLICATION 4]: objectName, attributes
+    SearchResultDone  [APPLICATION 5]: LDAPResult
+
+Only the subset the auth flows need is implemented; anything else in
+a response is skipped structurally (BER is length-framed)."""
+
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..ops import topic as topic_mod
+from .authn import IGNORE, AuthResult, Credentials, Provider
+from .authz import Source
+from .redis import verify_password
+
+log = logging.getLogger("emqx_tpu.auth.ldap")
+
+
+class LdapError(Exception):
+    pass
+
+
+# --- BER (definite lengths only) -------------------------------------------
+
+
+def ber(tag: int, content: bytes) -> bytes:
+    n = len(content)
+    if n < 0x80:
+        ln = bytes([n])
+    elif n < 0x100:
+        ln = bytes([0x81, n])
+    else:
+        ln = bytes([0x82, n >> 8, n & 0xFF])
+    return bytes([tag]) + ln + content
+
+
+def ber_int(v: int, tag: int = 0x02) -> bytes:
+    out = v.to_bytes(max(1, (v.bit_length() + 8) // 8), "big", signed=True)
+    return ber(tag, out)
+
+
+def ber_str(s, tag: int = 0x04) -> bytes:
+    return ber(tag, s if isinstance(s, bytes) else s.encode())
+
+
+def ber_read(data: bytes, off: int) -> Tuple[int, bytes, int]:
+    """-> (tag, content, next_offset)."""
+    tag = data[off]
+    ln = data[off + 1]
+    off += 2
+    if ln & 0x80:
+        nb = ln & 0x7F
+        ln = int.from_bytes(data[off : off + nb], "big")
+        off += nb
+    return tag, data[off : off + ln], off + ln
+
+
+# --- LDAP client ------------------------------------------------------------
+
+
+class LdapClient:
+    """Minimal SYNC LDAPv3 client: simple bind + equality search."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 389,
+        bind_dn: str = "",
+        bind_password: str = "",
+        timeout: float = 5.0,
+    ) -> None:
+        self.host, self.port = host, port
+        self.bind_dn, self.bind_password = bind_dn, bind_password
+        self.timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._mid = 0
+        self._lock = threading.Lock()
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+
+    def _send(self, op: bytes) -> int:
+        self._mid += 1
+        self._sock.sendall(ber(0x30, ber_int(self._mid) + op))
+        return self._mid
+
+    def _recv_msg(self) -> Tuple[int, int, bytes]:
+        """-> (message_id, op_tag, op_content)."""
+        head = b""
+        while len(head) < 2:
+            chunk = self._sock.recv(2 - len(head))
+            if not chunk:
+                raise ConnectionError("ldap closed connection")
+            head += chunk
+        ln = head[1]
+        extra = b""
+        if ln & 0x80:
+            nb = ln & 0x7F
+            while len(extra) < nb:
+                extra += self._sock.recv(nb - len(extra))
+            total = int.from_bytes(extra, "big")
+        else:
+            total = ln
+        body = b""
+        while len(body) < total:
+            chunk = self._sock.recv(total - len(body))
+            if not chunk:
+                raise ConnectionError("ldap closed connection")
+            body += chunk
+        _tag, mid_content, off = ber_read(body, 0)
+        mid = int.from_bytes(mid_content, "big", signed=True)
+        op_tag = body[off]
+        _t, op_content, _n = ber_read(body, off)
+        return mid, op_tag, op_content
+
+    def _connect_and_bind(self) -> None:
+        self._sock = socket.create_connection(
+            (self.host, self.port), self.timeout
+        )
+        self._sock.settimeout(self.timeout)
+        self._mid = 0
+        code = self.bind(self.bind_dn, self.bind_password)
+        if code != 0:
+            raise LdapError(f"service bind failed: resultCode {code}")
+
+    def bind(self, dn: str, password: str) -> int:
+        """Simple bind; returns the LDAP resultCode (0 = success,
+        49 = invalidCredentials)."""
+        op = ber(
+            0x60,  # [APPLICATION 0] BindRequest
+            ber_int(3) + ber_str(dn) + ber_str(password, 0x80),
+        )
+        self._send(op)
+        _mid, tag, content = self._recv_msg()
+        if tag != 0x61:
+            raise LdapError(f"unexpected response tag 0x{tag:02x}")
+        _t, code, _off = ber_read(content, 0)
+        return int.from_bytes(code, "big", signed=True)
+
+    def search_eq(
+        self, base: str, attr: str, value: str, attrs: List[str]
+    ) -> List[Tuple[str, Dict[str, List[bytes]]]]:
+        """Whole-subtree equality search; returns
+        [(dn, {attr: [values]})]."""
+        flt = ber(0xA3, ber_str(attr) + ber_str(value))  # equalityMatch
+        op = ber(
+            0x63,  # [APPLICATION 3] SearchRequest
+            ber_str(base)
+            + ber(0x0A, b"\x02")  # scope: wholeSubtree
+            + ber(0x0A, b"\x00")  # derefAliases: never
+            + ber_int(0) + ber_int(0)  # size/time limits
+            + ber(0x01, b"\x00")  # typesOnly: false
+            + flt
+            + ber(0x30, b"".join(ber_str(a) for a in attrs)),
+        )
+        self._send(op)
+        out = []
+        while True:
+            _mid, tag, content = self._recv_msg()
+            if tag == 0x65:  # SearchResultDone
+                _t, code, _o = ber_read(content, 0)
+                rc = int.from_bytes(code, "big", signed=True)
+                if rc != 0:
+                    raise LdapError(f"search failed: resultCode {rc}")
+                return out
+            if tag != 0x64:  # not a SearchResultEntry: skip
+                continue
+            _t, dn, off = ber_read(content, 0)
+            _t, attrseq, _o = ber_read(content, off)
+            entry: Dict[str, List[bytes]] = {}
+            p = 0
+            while p < len(attrseq):
+                _t, one, p = ber_read(attrseq, p)
+                _t2, name, q = ber_read(one, 0)
+                _t3, vals, _q2 = ber_read(one, q)
+                vlist = []
+                r = 0
+                while r < len(vals):
+                    _t4, v, r = ber_read(vals, r)
+                    vlist.append(v)
+                entry[name.decode()] = vlist
+            out.append((dn.decode(), entry))
+
+    def with_conn(self, fn):
+        with self._lock:
+            if self._sock is None:
+                self._connect_and_bind()
+            try:
+                return fn()
+            except LdapError:
+                raise
+            except Exception:
+                self.close()
+                raise
+
+
+class LdapAuthnProvider(Provider):
+    """method='hash': search the entry, verify a password attribute;
+    method='bind': re-bind as the found DN with the presented
+    password (emqx_authn_ldap + emqx_authn_ldap_bind)."""
+
+    def __init__(
+        self,
+        base_dn: str,
+        filter_attr: str = "uid",
+        method: str = "bind",
+        password_attr: str = "userPassword",
+        is_superuser_attr: str = "isSuperuser",
+        algorithm: str = "plain",
+        salt_position: str = "prefix",
+        client: Optional[LdapClient] = None,
+        **client_kw,
+    ) -> None:
+        assert method in ("bind", "hash")
+        self.base_dn = base_dn
+        self.filter_attr = filter_attr
+        self.method = method
+        self.password_attr = password_attr
+        self.is_superuser_attr = is_superuser_attr
+        self.algorithm = algorithm
+        self.salt_position = salt_position
+        self.client = client or LdapClient(**client_kw)
+
+    def authenticate(self, creds: Credentials):
+        uid = creds.username or creds.client_id
+
+        def run():
+            return self.client.search_eq(
+                self.base_dn, self.filter_attr, uid,
+                [self.password_attr, self.is_superuser_attr],
+            )
+
+        try:
+            entries = self.client.with_conn(run)
+        except Exception as e:
+            log.warning("ldap authn lookup failed: %s", e)
+            return IGNORE
+        if not entries:
+            return IGNORE
+        dn, attrs = entries[0]
+        su = attrs.get(self.is_superuser_attr, [b""])[0] in (b"1", b"true", b"TRUE")
+        if self.method == "bind":
+            try:
+                code = self.client.with_conn(
+                    lambda: self.client.bind(
+                        dn, (creds.password or b"").decode("utf-8", "replace")
+                    )
+                )
+            except Exception as e:
+                log.warning("ldap bind failed: %s", e)
+                return IGNORE
+            finally:
+                # the connection is now bound as the USER — drop it so
+                # the next lookup rebinds as the service account
+                self.client.close()
+            if code != 0:
+                return AuthResult(False, "bad_username_or_password")
+            return AuthResult(True, superuser=su)
+        stored = attrs.get(self.password_attr, [None])[0]
+        if stored is None:
+            return IGNORE
+        if not verify_password(
+            self.algorithm, stored, creds.password or b"",
+            b"", self.salt_position,
+        ):
+            return AuthResult(False, "bad_username_or_password")
+        return AuthResult(True, superuser=su)
+
+    def destroy(self) -> None:
+        self.client.close()
+
+
+class LdapAuthzSource(Source):
+    """Topic filters from per-entry attributes (emqx_authz_ldap:
+    publish/subscribe/all attributes, allow-only like the reference)."""
+
+    def __init__(
+        self,
+        base_dn: str,
+        filter_attr: str = "uid",
+        publish_attr: str = "mqttPublishTopic",
+        subscribe_attr: str = "mqttSubscriptionTopic",
+        all_attr: str = "mqttPubSubTopic",
+        client: Optional[LdapClient] = None,
+        **client_kw,
+    ) -> None:
+        self.base_dn = base_dn
+        self.filter_attr = filter_attr
+        self.attrs = {
+            "publish": publish_attr,
+            "subscribe": subscribe_attr,
+            "all": all_attr,
+        }
+        self.client = client or LdapClient(**client_kw)
+
+    def authorize(self, client_id, username, peerhost, action, topic) -> str:
+        uid = username or client_id
+
+        def run():
+            return self.client.search_eq(
+                self.base_dn, self.filter_attr, uid,
+                list(self.attrs.values()),
+            )
+
+        try:
+            entries = self.client.with_conn(run)
+        except Exception as e:
+            log.warning("ldap authz lookup failed: %s", e)
+            return "nomatch"
+        if not entries:
+            return "nomatch"
+        _dn, attrs = entries[0]
+        filters = attrs.get(self.attrs[action], []) + attrs.get(
+            self.attrs["all"], []
+        )
+        for raw in filters:
+            flt = raw.decode("utf-8", "replace").replace(
+                "${clientid}", client_id
+            ).replace("${username}", username or "")
+            if topic_mod.match(topic_mod.words(topic), topic_mod.words(flt)):
+                return "allow"
+        return "nomatch"
+
+    def destroy(self) -> None:
+        self.client.close()

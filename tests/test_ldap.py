@@ -1,0 +1,203 @@
+"""LDAP auth tests: BER codec, bind + search against a mini LDAPv3
+server, hash and bind authentication methods, attribute-based authz.
+"""
+
+import asyncio
+import threading
+
+import pytest
+
+from emqx_tpu.auth.authn import IGNORE, Credentials
+from emqx_tpu.auth.ldap import (
+    LdapAuthnProvider,
+    LdapAuthzSource,
+    LdapClient,
+    ber,
+    ber_int,
+    ber_read,
+    ber_str,
+)
+
+
+def test_ber_roundtrip():
+    b = ber(0x30, ber_int(7) + ber_str("hi") + ber_str(b"\x00" * 200))
+    tag, content, off = ber_read(b, 0)
+    assert tag == 0x30 and off == len(b)
+    t1, v1, o = ber_read(content, 0)
+    assert t1 == 0x02 and int.from_bytes(v1, "big") == 7
+    t2, v2, o = ber_read(content, o)
+    assert v2 == b"hi"
+    t3, v3, o = ber_read(content, o)
+    assert len(v3) == 200  # long-form length
+    assert ber_int(-1)[2] == 0xFF  # signed encoding
+
+
+class MiniLdap:
+    """LDAPv3 mini server: simple bind against a password table,
+    subtree equality search over entry dicts."""
+
+    def __init__(self):
+        # dn -> (password, {attr: [bytes]})
+        self.entries = {}
+        self.service = ("cn=svc", "svcpw")
+        self.server = None
+        self.port = None
+
+    async def start(self):
+        self.server = await asyncio.start_server(self._conn, "127.0.0.1", 0)
+        self.port = self.server.sockets[0].getsockname()[1]
+
+    async def stop(self):
+        self.server.close()
+        await self.server.wait_closed()
+
+    async def _read_msg(self, reader):
+        head = await reader.readexactly(2)
+        ln = head[1]
+        if ln & 0x80:
+            nb = ln & 0x7F
+            ln = int.from_bytes(await reader.readexactly(nb), "big")
+        return await reader.readexactly(ln)
+
+    async def _conn(self, reader, writer):
+        bound = None
+        try:
+            while True:
+                body = await self._read_msg(reader)
+                _t, mid_c, off = ber_read(body, 0)
+                mid = int.from_bytes(mid_c, "big")
+                op_tag = body[off]
+                _t2, op, _o = ber_read(body, off)
+                if op_tag == 0x60:  # bind
+                    _tv, _ver, p = ber_read(op, 0)
+                    _td, dn, p = ber_read(op, p)
+                    _tp, pw, p = ber_read(op, p)
+                    dn_s, pw_s = dn.decode(), pw.decode()
+                    ok = (
+                        (dn_s, pw_s) == self.service
+                        or (
+                            dn_s in self.entries
+                            and self.entries[dn_s][0] == pw_s
+                        )
+                    )
+                    bound = dn_s if ok else None
+                    code = 0 if ok else 49
+                    resp = ber(0x61, ber(0x0A, bytes([code]))
+                               + ber_str("") + ber_str(""))
+                    writer.write(ber(0x30, ber_int(mid) + resp))
+                elif op_tag == 0x63:  # search
+                    _tb, base, p = ber_read(op, 0)
+                    for _ in range(4):  # scope, deref, size, time
+                        _tx, _vx, p = ber_read(op, p)
+                    _ty, _types, p = ber_read(op, p)
+                    ftag = op[p]
+                    _tf, flt, p = ber_read(op, p)
+                    assert ftag == 0xA3, hex(ftag)
+                    _ta, attr, q = ber_read(flt, 0)
+                    _tv2, value, q = ber_read(flt, q)
+                    for dn_s, (_pw, attrs) in self.entries.items():
+                        if attrs.get(attr.decode(), [b""])[0] != value:
+                            continue
+                        if not dn_s.endswith(base.decode()):
+                            continue
+                        aseq = b""
+                        for name, vals in attrs.items():
+                            aseq += ber(0x30, ber_str(name) + ber(
+                                0x31, b"".join(ber_str(v) for v in vals)
+                            ))
+                        entry = ber(0x64, ber_str(dn_s) + ber(0x30, aseq))
+                        writer.write(ber(0x30, ber_int(mid) + entry))
+                    done = ber(0x65, ber(0x0A, b"\x00")
+                               + ber_str("") + ber_str(""))
+                    writer.write(ber(0x30, ber_int(mid) + done))
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionError, AssertionError):
+            pass
+        finally:
+            writer.close()
+
+
+def run_sync(fn, seed=None):
+    result = {}
+    started = threading.Event()
+    stop = threading.Event()
+
+    def thread():
+        async def main():
+            srv = MiniLdap()
+            await srv.start()
+            if seed:
+                seed(srv)
+            result["srv"] = srv
+            started.set()
+            while not stop.is_set():
+                await asyncio.sleep(0.01)
+            await srv.stop()
+
+        asyncio.run(main())
+
+    t = threading.Thread(target=thread, daemon=True)
+    t.start()
+    assert started.wait(5)
+    try:
+        fn(result["srv"])
+    finally:
+        stop.set()
+        t.join(5)
+
+
+def _seed(srv):
+    srv.entries["uid=hank,ou=mqtt,dc=x"] = ("hankpw", {
+        "uid": [b"hank"],
+        "userPassword": [b"hankpw"],
+        "isSuperuser": [b"true"],
+        "mqttPublishTopic": [b"h/${clientid}/#"],
+        "mqttSubscriptionTopic": [b"cmds/hank"],
+        "mqttPubSubTopic": [b"both/x"],
+    })
+
+
+def test_ldap_bind_and_hash_authn():
+    def check(srv):
+        common = dict(
+            base_dn="ou=mqtt,dc=x", host="127.0.0.1", port=srv.port,
+            bind_dn="cn=svc", bind_password="svcpw",
+        )
+        for method in ("bind", "hash"):
+            p = LdapAuthnProvider(method=method, algorithm="plain", **common)
+            r = p.authenticate(Credentials("c1", "hank", b"hankpw"))
+            assert r.ok and r.superuser, method
+            assert not p.authenticate(
+                Credentials("c1", "hank", b"wrong")
+            ).ok, method
+            assert p.authenticate(
+                Credentials("c1", "nobody", b"x")
+            ) is IGNORE, method
+            p.destroy()
+        # wrong service credentials: lookups fail soft -> IGNORE
+        p = LdapAuthnProvider(
+            base_dn="ou=mqtt,dc=x", host="127.0.0.1", port=srv.port,
+            bind_dn="cn=svc", bind_password="WRONG",
+        )
+        assert p.authenticate(Credentials("c1", "hank", b"hankpw")) is IGNORE
+        p.destroy()
+
+    run_sync(check, seed=_seed)
+
+
+def test_ldap_authz_attributes():
+    def check(srv):
+        z = LdapAuthzSource(
+            base_dn="ou=mqtt,dc=x", host="127.0.0.1", port=srv.port,
+            bind_dn="cn=svc", bind_password="svcpw",
+        )
+        au = lambda a, t: z.authorize("c9", "hank", "::1", a, t)
+        assert au("publish", "h/c9/temp") == "allow"
+        assert au("publish", "cmds/hank") == "nomatch"  # wrong action
+        assert au("subscribe", "cmds/hank") == "allow"
+        assert au("publish", "both/x") == "allow"  # pubsub attr
+        assert au("subscribe", "both/x") == "allow"
+        assert au("publish", "elsewhere") == "nomatch"
+        z.destroy()
+
+    run_sync(check, seed=_seed)
