@@ -153,10 +153,12 @@ def _pack_probe(slots: SlotArrays) -> None:
 def _refresh_probe(slots: SlotArrays, b: int) -> None:
     """Recompute one bucket's probe word after slot writes."""
     base = b * BUCKET_W
+    bkt = slots.bucket[base : base + BUCKET_W].tolist()
+    fps = slots.fp[base : base + BUCKET_W].tolist()
     w = 0
     for l in range(BUCKET_W):
-        if slots.bucket[base + l] >= 0:
-            w |= _fp8_of(int(slots.fp[base + l])) << (8 * l)
+        if bkt[l] >= 0:
+            w |= max(fps[l] >> 24, 1) << (8 * l)
     slots.probe[b] = w
 
 
@@ -276,8 +278,9 @@ def _evict_insert(
     b2 = _alt_bucket(b1, fp, mask)
     for b in (b1, b2):
         base = b * BUCKET_W
+        lanes = slots.bucket[base : base + BUCKET_W].tolist()
         for lane in range(BUCKET_W):
-            if slots.bucket[base + lane] < 0:
+            if lanes[lane] < 0:
                 slots.fp[base + lane] = fp
                 slots.bucket[base + lane] = bid
                 if dirty is not None:
@@ -397,8 +400,10 @@ class ClassIndex:
         has_hash = bool(table.has_hash[row])
         plus_mask = 0
         lit_words: List[Tuple[int, int]] = []
-        for i in range(plen):
-            wid = int(table.words[row, i])
+        # one bulk conversion instead of plen numpy scalar reads (the
+        # route-churn hot path is pure Python overhead)
+        wids = table.words[row, :plen].tolist()
+        for i, wid in enumerate(wids):
             if wid == PLUS:
                 plus_mask |= 1 << i
             else:
