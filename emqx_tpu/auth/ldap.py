@@ -246,6 +246,11 @@ class LdapAuthnProvider(Provider):
 
     def authenticate(self, creds: Credentials):
         uid = creds.username or creds.client_id
+        if self.method == "bind" and not creds.password:
+            # RFC 4513 §5.1.2: a simple bind with an empty password is
+            # an UNAUTHENTICATED bind, which many servers answer with
+            # success — never an authentication proof
+            return AuthResult(False, "bad_username_or_password")
 
         def run():
             return self.client.search_eq(

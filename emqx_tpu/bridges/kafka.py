@@ -287,7 +287,10 @@ def _parse_record_batches(data: bytes, verify_crc: bool = False):
             break  # partial trailing batch
         magic = data[off + 16]
         if magic < 2:
-            yield from _parse_message_set(data[off:])
+            # legacy message set: normalize to the (offset, key, value)
+            # triple this generator yields
+            for o, k, v, _attrs in _parse_message_set(data[off:]):
+                yield o, k, v
             return
         body = data[off + 12 : off + 12 + blen]
         off += 12 + blen

@@ -87,6 +87,10 @@ class Connection:
                 # rewrites delivered topics (bytes differ per client)
                 sess.outgoing_sink_bytes = self._send_bytes
                 sess.sink_proto_ver = self.channel.proto_ver
+            else:
+                # a takeover from a mountpoint-free listener must not
+                # leave the PREVIOUS connection's bytes sink installed
+                sess.outgoing_sink_bytes = None
             # admin kick severs the socket through this
             sess.closer = self.transport.close
             # background producers (DS pump) must hop onto this loop

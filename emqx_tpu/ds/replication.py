@@ -232,7 +232,7 @@ class ReplicatedDs:
         elif verdict == "conflict":
             self._step_down(shard)
         elif verdict == "gap":
-            await self._catch_peer(addr, shard, int(r[1]))
+            await self._catch_peer(peer, addr, shard, int(r[1]))
 
     def _on_ack(self, shard: int, idx: int, peer) -> None:
         to_commit: List[Tuple[int, list]] = []
@@ -397,9 +397,12 @@ class ReplicatedDs:
                 return []
             return [(i, p) for i, p in lg if i > after_idx]
 
-    async def _catch_peer(self, addr, shard: int, after: int) -> None:
+    async def _catch_peer(self, peer, addr, shard: int, after: int) -> None:
         """Stream a lagging replica the committed + pending range
-        above `after`, in order, then the commit frontier."""
+        above `after`, in order, then the commit frontier. The peer's
+        accepts COUNT toward quorum — on a 2-node cluster the
+        committing majority can hinge entirely on a peer that went
+        through gap recovery."""
         with self._mutex:
             term = self.term
             entries = [
@@ -423,7 +426,7 @@ class ReplicatedDs:
                 return
             if not (isinstance(r, (list, tuple)) and r and r[0] == "ok"):
                 return
-            self._on_ack(shard, i, None)  # progress the ack sets too
+            self._on_ack(shard, i, peer)
         try:
             await self.node.rpc.cast(addr, "ds", "commit", (shard, upto), key=f"ds{shard}")
         except Exception:
@@ -435,21 +438,22 @@ class ReplicatedDs:
         """First append of a new term: adopt the cluster's committed
         prefix and re-commit stranded pending entries, then drain the
         buffered writes."""
-        peers = self._peers()
+        # keep (peer, addr, tail) TOGETHER: failed calls drop out, and
+        # a positional zip against the peer list would pair survivors
+        # with dead peers' addresses
         tails = []
-        for peer, addr in peers:
+        for peer, addr in self._peers():
             try:
-                tails.append(
-                    await self.node.rpc.call(addr, "ds", "tail", (shard,))
-                )
+                t = await self.node.rpc.call(addr, "ds", "tail", (shard,))
             except Exception:
                 continue
+            tails.append((peer, addr, t))
         # pull committed entries we miss from the most advanced peer
-        best_applied = max([t[0] for t in tails], default=0)
+        best_applied = max([t[0] for _p, _a, t in tails], default=0)
         with self._mutex:
             my_applied = self._applied.get(shard, 0)
         if best_applied > my_applied:
-            for (peer, addr), t in zip(peers, tails):
+            for _peer, addr, t in tails:
                 if t[0] != best_applied:
                     continue
                 try:
@@ -470,7 +474,7 @@ class ReplicatedDs:
         # adopt stranded pending entries (commit-previous-term): merge
         # everyone's pending tail, highest term wins per index
         merged: Dict[int, Tuple[int, list]] = {}
-        for t in tails:
+        for _peer, _addr, t in tails:
             for i, tm, p in t[1]:
                 if i > best_applied and (
                     i not in merged or tm > merged[i][0]

@@ -390,6 +390,12 @@ class Router:
             return dest in self._exact.get(flt, ())
         return dest in self._wild.get(flt, ()) or dest in self._deep.get(flt, ())
 
+    def topic_count(self) -> int:
+        """O(1) routed-topic count (the stores are disjoint) — the
+        monitor samples this every interval; materializing the sorted
+        10M-row list there would stall the event loop for seconds."""
+        return len(self._exact) + len(self._wild) + len(self._deep)
+
     def topics(self) -> List[str]:
         """All routed topics/filters (emqx_router:topics/0)."""
         out = list(self._exact)

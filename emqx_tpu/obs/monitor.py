@@ -43,7 +43,7 @@ class Monitor:
             "connections": stats.get("connections.count", 0),
             "sessions": stats.get("sessions.count", 0),
             "subscriptions": stats.get("subscriptions.count", 0),
-            "topics": len(self.broker.router.topics()),
+            "topics": self.broker.router.topic_count(),
             "retained": stats.get("retained.count", 0),
             "received_msg": m.val("messages.received"),
             "sent_msg": m.val("messages.sent"),

@@ -425,3 +425,19 @@ def test_legacy_gzip_wrapper_messages_decode():
     assert [(o, k, v) for o, k, v, _a in out] == [
         (10, b"k1", b"w1"), (11, None, b"w2"),
     ]
+
+
+async def test_v2_consumer_decodes_legacy_message_sets():
+    """A wire_version=2 consumer against a broker still serving magic-0
+    message sets must normalize the legacy 4-tuples into records, not
+    crash unpacking them (round-3 review finding)."""
+    from emqx_tpu.bridges.kafka import KafkaConsumer, _parse_record_batches
+
+    # direct: the generator normalizes arity
+    legacy = b""
+    for i, (k, v) in enumerate([(b"k", b"v1"), (None, b"v2")]):
+        one = _message_set([(k, v)])
+        legacy += struct.pack(">q", i) + one[8:]
+    assert list(_parse_record_batches(legacy)) == [
+        (0, b"k", b"v1"), (1, None, b"v2"),
+    ]

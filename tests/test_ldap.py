@@ -201,3 +201,22 @@ def test_ldap_authz_attributes():
         z.destroy()
 
     run_sync(check, seed=_seed)
+
+
+def test_ldap_empty_password_bind_rejected():
+    """RFC 4513 §5.1.2: an empty password makes a simple bind
+    UNAUTHENTICATED — many servers answer success, so the provider
+    must fail it before ever touching the wire."""
+    def check(srv):
+        p = LdapAuthnProvider(
+            base_dn="ou=mqtt,dc=x", method="bind",
+            host="127.0.0.1", port=srv.port,
+            bind_dn="cn=svc", bind_password="svcpw",
+        )
+        r = p.authenticate(Credentials("c1", "hank", b""))
+        assert not r.ok and r.reason == "bad_username_or_password"
+        r = p.authenticate(Credentials("c1", "hank", None))
+        assert not r.ok
+        p.destroy()
+
+    run_sync(check, seed=_seed)
