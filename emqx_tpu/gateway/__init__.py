@@ -27,6 +27,7 @@ class GatewayRegistry:
         self._running: Dict[str, GatewayImpl] = {}
         from .coap import CoapGateway
         from .exproto import ExProtoGateway
+        from .gbt32960 import Gbt32960Gateway
         from .lwm2m import Lwm2mGateway
         from .mqttsn import MqttSnGateway
         from .ocpp import OcppGateway
@@ -38,6 +39,7 @@ class GatewayRegistry:
         self.register_type("lwm2m", Lwm2mGateway)
         self.register_type("ocpp", OcppGateway)
         self.register_type("exproto", ExProtoGateway)
+        self.register_type("gbt32960", Gbt32960Gateway)
 
     def register_type(self, name: str, impl: Type[GatewayImpl]) -> None:
         self._types[name] = impl

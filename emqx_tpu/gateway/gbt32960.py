@@ -1,0 +1,392 @@
+"""GB/T 32960 gateway — EV telematics (national standard) on pubsub.
+
+Reference: apps/emqx_gateway_gbt32960 (emqx_gbt32960_frame.erl codec,
+emqx_gbt32960_channel.erl topic mapping).
+
+Frame ('##' framed, BCC = XOR over cmd..data):
+
+    0x23 0x23 | cmd(1) | ack(1) | VIN(17 ascii) | encrypt(1) |
+    len(2 BE) | data(len) | bcc(1)
+
+Commands: 0x01 vehicle login, 0x02 realtime report, 0x03 reissue
+report, 0x04 vehicle logout, 0x05/0x06 platform login/logout,
+0x07 heartbeat, 0x08 clock sync. ack 0xFE marks a command (request);
+0x01/0x02/0x03 are response codes.
+
+Topic scheme (the reference's default mountpoint gbt32960/${clientid}/,
+clientid = VIN):
+
+    uplink   gbt32960/{vin}/upstream/{vlogin|info|reinfo|vlogout|
+                                      plogin|plogout|transparent|response}
+    downlink gbt32960/{vin}/dnstream   JSON {"Cmd": int, "Data": hex}
+             -> framed command (ack 0xFE) to the vehicle
+
+Realtime info types parse per the standard's fixed layouts (vehicle,
+drive motors, engine, location, extremes, alarms); unrecognized types
+end structured parsing with a hex passthrough (their lengths are
+type-specific, so skipping blind would misparse the tail)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import struct
+import time
+from typing import Dict, List, Optional, Tuple
+
+from .base import GatewayImpl
+
+log = logging.getLogger("emqx_tpu.gateway.gbt32960")
+
+CMD_VLOGIN, CMD_INFO, CMD_REINFO, CMD_VLOGOUT = 0x01, 0x02, 0x03, 0x04
+CMD_PLOGIN, CMD_PLOGOUT, CMD_HEARTBEAT, CMD_TIME = 0x05, 0x06, 0x07, 0x08
+ACK_SUCCESS, ACK_ERROR, ACK_VIN_REPEAT, ACK_IS_CMD = 0x01, 0x02, 0x03, 0xFE
+
+_SUFFIX = {
+    CMD_VLOGIN: "upstream/vlogin",
+    CMD_INFO: "upstream/info",
+    CMD_REINFO: "upstream/reinfo",
+    CMD_VLOGOUT: "upstream/vlogout",
+    CMD_PLOGIN: "upstream/plogin",
+    CMD_PLOGOUT: "upstream/plogout",
+}
+
+HEADER = 24  # '##' + cmd + ack + vin(17) + encrypt + len(2)
+
+
+class FrameError(ValueError):
+    pass
+
+
+def bcc(data: bytes) -> int:
+    c = 0
+    for b in data:
+        c ^= b
+    return c
+
+
+def serialize_frame(cmd: int, ack: int, vin: str, data: bytes = b"",
+                    encrypt: int = 0x01) -> bytes:
+    vb = vin.encode()
+    if len(vb) != 17:
+        raise FrameError("VIN must be 17 bytes")
+    body = bytes([cmd, ack]) + vb + bytes([encrypt]) + struct.pack(
+        ">H", len(data)
+    ) + data
+    return b"##" + body + bytes([bcc(body)])
+
+
+def parse_frames(buf: bytearray) -> List[dict]:
+    """Consume complete frames from buf; raises FrameError on a bad
+    checksum (the connection should drop — framing is lost)."""
+    out = []
+    while True:
+        start = buf.find(b"##")
+        if start < 0:
+            buf.clear()
+            return out
+        if start:
+            del buf[:start]
+        if len(buf) < HEADER:
+            return out
+        (length,) = struct.unpack_from(">H", buf, 22)
+        total = HEADER + length + 1
+        if len(buf) < total:
+            return out
+        body = bytes(buf[2 : HEADER + length])
+        check = buf[HEADER + length]
+        del buf[:total]
+        if bcc(body) != check:
+            raise FrameError("bad BCC")
+        out.append({
+            "cmd": body[0],
+            "ack": body[1],
+            "vin": body[2:19].decode("ascii", "replace"),
+            "encrypt": body[19],
+            "data": body[22:],
+        })
+
+
+def _time6(data: bytes) -> dict:
+    return {
+        "Year": data[0], "Month": data[1], "Day": data[2],
+        "Hour": data[3], "Minute": data[4], "Second": data[5],
+    }
+
+
+def _gentime() -> bytes:
+    t = time.localtime()
+    return bytes([
+        t.tm_year % 100, t.tm_mon, t.tm_mday,
+        t.tm_hour, t.tm_min, t.tm_sec,
+    ])
+
+
+def parse_info(data: bytes) -> List[dict]:
+    """Realtime report info list (emqx_gbt32960_frame:parse_info)."""
+    out: List[dict] = []
+    off = 0
+    n = len(data)
+    while off < n:
+        t = data[off]
+        off += 1
+        if t == 0x01 and off + 20 <= n:  # vehicle
+            (st, chg, mode, speed, mileage, volt, cur, soc, dc, gear,
+             res, acc, brake) = struct.unpack_from(">BBBHIHHBBBHBB", data, off)
+            off += 20
+            out.append({
+                "Type": "Vehicle", "Status": st, "Charging": chg,
+                "Mode": mode, "Speed": speed, "Mileage": mileage,
+                "Voltage": volt, "Current": cur, "SOC": soc, "DC": dc,
+                "Gear": gear, "Resistance": res,
+                "AcceleratorPedal": acc, "BrakePedal": brake,
+            })
+        elif t == 0x02 and off + 1 <= n:  # drive motors, 12B each
+            num = data[off]
+            off += 1
+            motors = []
+            for _ in range(num):
+                if off + 12 > n:
+                    raise FrameError("truncated drive motor")
+                (no, st, ctrl_t, speed, torque, motor_t, volt, cur) = (
+                    struct.unpack_from(">BBBHHBHH", data, off)
+                )
+                off += 12
+                motors.append({
+                    "No": no, "Status": st, "CtrlTemp": ctrl_t,
+                    "Rotating": speed, "Torque": torque,
+                    "MotorTemp": motor_t, "Voltage": volt, "Current": cur,
+                })
+            out.append({"Type": "DriveMotor", "Number": num,
+                        "Motors": motors})
+        elif t == 0x04 and off + 5 <= n:  # engine
+            st, crank, fuel = struct.unpack_from(">BHH", data, off)
+            off += 5
+            out.append({"Type": "Engine", "Status": st,
+                        "CrankshaftSpeed": crank, "FuelConsumption": fuel})
+        elif t == 0x05 and off + 9 <= n:  # location
+            st, lon, lat = struct.unpack_from(">BII", data, off)
+            off += 9
+            out.append({"Type": "Location", "Status": st,
+                        "Longitude": lon, "Latitude": lat})
+        elif t == 0x06 and off + 14 <= n:  # extremes
+            vals = struct.unpack_from(">BBHBBHBBBBBB", data, off)
+            off += 14
+            keys = (
+                "MaxVoltageBatterySubsysNo", "MaxVoltageBatteryCode",
+                "MaxBatteryVoltage", "MinVoltageBatterySubsysNo",
+                "MinVoltageBatteryCode", "MinBatteryVoltage",
+                "MaxTempSubsysNo", "MaxTempProbeNo", "MaxTemp",
+                "MinTempSubsysNo", "MinTempProbeNo", "MinTemp",
+            )
+            out.append({"Type": "Extreme", **dict(zip(keys, vals))})
+        elif t == 0x07 and off + 5 <= n:  # alarms
+            level = data[off]
+            (flag,) = struct.unpack_from(">I", data, off + 1)
+            off += 5
+            lists = []
+            for _ in range(4):  # battery/motor/engine/other fault lists
+                if off >= n:
+                    raise FrameError("truncated alarm lists")
+                cnt = data[off]
+                off += 1
+                codes = []
+                for _c in range(cnt):
+                    (code,) = struct.unpack_from(">I", data, off)
+                    off += 4
+                    codes.append(code)
+                lists.append(codes)
+            out.append({
+                "Type": "Alarm", "MaxAlarmLevel": level,
+                "GeneralAlarmFlag": flag,
+                "FaultChargeableDeviceNum": len(lists[0]),
+                "FaultChargeableDeviceList": lists[0],
+                "FaultDriveMotorNum": len(lists[1]),
+                "FaultDriveMotorList": lists[1],
+                "FaultEngineNum": len(lists[2]),
+                "FaultEngineList": lists[2],
+                "FaultOthersNum": len(lists[3]),
+                "FaultOthersList": lists[3],
+            })
+        else:
+            # unknown type id: lengths are type-specific, so structured
+            # parsing must stop — passthrough the tail
+            out.append({"Type": "Unknown", "Raw": data[off - 1:].hex()})
+            break
+    return out
+
+
+def parse_data(cmd: int, data: bytes) -> dict:
+    if cmd == CMD_VLOGIN and len(data) >= 30:
+        (seq,) = struct.unpack_from(">H", data, 6)
+        num, length = data[28], data[29]
+        return {
+            "Time": _time6(data), "Seq": seq,
+            "ICCID": data[8:28].decode("ascii", "replace"),
+            "Num": num, "Length": length,
+            "Id": data[30:].decode("ascii", "replace"),
+        }
+    if cmd in (CMD_INFO, CMD_REINFO) and len(data) >= 6:
+        return {"Time": _time6(data), "Infos": parse_info(data[6:])}
+    if cmd == CMD_VLOGOUT and len(data) >= 8:
+        (seq,) = struct.unpack_from(">H", data, 6)
+        return {"Time": _time6(data), "Seq": seq}
+    return {"Raw": data.hex()}
+
+
+class _Vehicle:
+    def __init__(self, vin: str, session, writer):
+        self.vin = vin
+        self.session = session
+        self.writer = writer
+
+
+class Gbt32960Gateway(GatewayImpl):
+    name = "gbt32960"
+
+    def __init__(self, broker, conf: dict):
+        super().__init__(broker, conf)
+        self._server: Optional[asyncio.AbstractServer] = None
+        self.listen_addr = None
+        self.vehicles: Dict[str, _Vehicle] = {}
+        self.max_conns = int(conf.get("max_connections", 10_000))
+
+    async def on_load(self) -> None:
+        from ..broker.listeners import parse_bind
+
+        host, port = parse_bind(self.conf.get("bind", "0.0.0.0:7325"))
+        self._server = await asyncio.start_server(self._conn, host, port)
+        self.listen_addr = self._server.sockets[0].getsockname()[:2]
+        log.info("gbt32960 gateway on %s", self.listen_addr)
+
+    async def on_unload(self) -> None:
+        for vin in list(self.vehicles):
+            self._drop(vin)
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    def connection_count(self) -> int:
+        return len(self.vehicles)
+
+    def listener_info(self) -> List[dict]:
+        return (
+            [{"type": "tcp",
+              "bind": f"{self.listen_addr[0]}:{self.listen_addr[1]}"}]
+            if self.listen_addr else []
+        )
+
+    # --- connection ------------------------------------------------------
+
+    async def _conn(self, reader, writer) -> None:
+        buf = bytearray()
+        veh: Optional[_Vehicle] = None
+        try:
+            while True:
+                data = await reader.read(65536)
+                if not data:
+                    break
+                buf += data
+                for frame in parse_frames(buf):
+                    veh = self._handle_frame(frame, veh, writer)
+        except (FrameError, ConnectionError) as e:
+            log.debug("gbt32960 connection dropped: %s", e)
+        finally:
+            if veh is not None and self.vehicles.get(veh.vin) is veh:
+                self._drop(veh.vin)
+            writer.close()
+
+    def _drop(self, vin: str) -> None:
+        v = self.vehicles.pop(vin, None)
+        if v is not None:
+            self.close_session(v.session)
+            try:
+                v.writer.close()
+            except Exception:
+                pass
+
+    def _handle_frame(self, frame: dict, veh: Optional[_Vehicle],
+                      writer) -> Optional[_Vehicle]:
+        cmd, vin = frame["cmd"], frame["vin"]
+        if veh is None:
+            if cmd != CMD_VLOGIN:
+                return None  # must log in first (reference channel gate)
+            if len(self.vehicles) >= self.max_conns and vin not in self.vehicles:
+                return None
+            old = self.vehicles.pop(vin, None)
+            if old is not None:
+                self.close_session(old.session)
+                try:
+                    old.writer.close()
+                except Exception:
+                    pass
+            try:
+                session, _ = self.open_session(vin)
+            except Exception:
+                return None
+            veh = _Vehicle(vin, session, writer)
+            self.vehicles[vin] = veh
+            session.outgoing_sink = (
+                lambda pkts, v=vin: self._downlink(v, pkts)
+            )
+            try:
+                self.subscribe(session, f"gbt32960/{vin}/dnstream", qos=1)
+            except PermissionError:
+                self._drop(vin)
+                return None
+        data = parse_data(cmd, frame["data"])
+        suffix = (
+            _SUFFIX.get(cmd, "upstream/transparent")
+            if frame["ack"] == ACK_IS_CMD
+            else "upstream/response"
+        )
+        body = {
+            "Cmd": cmd, "Vin": vin, "Encrypt": frame["encrypt"],
+            "Data": data,
+        }
+        try:
+            self.publish(
+                veh.session, f"gbt32960/{vin}/{suffix}",
+                json.dumps(body).encode(), qos=1,
+            )
+        except (ValueError, PermissionError) as e:
+            log.warning("gbt32960 %s upstream denied: %s", vin, e)
+        if frame["ack"] == ACK_IS_CMD and cmd in (
+            CMD_VLOGIN, CMD_INFO, CMD_REINFO, CMD_VLOGOUT, CMD_HEARTBEAT,
+            CMD_PLOGIN, CMD_PLOGOUT,
+        ):
+            # PROTO: ack echoes the frame with code + fresh time
+            writer.write(serialize_frame(
+                cmd, ACK_SUCCESS, vin, _gentime(),
+                encrypt=frame["encrypt"],
+            ))
+        if cmd == CMD_VLOGOUT:
+            self._drop(vin)
+            return None
+        return veh
+
+    # --- downlink ---------------------------------------------------------
+
+    def _downlink(self, vin: str, pkts) -> None:
+        v = self.vehicles.get(vin)
+        if v is None:
+            return
+        for pkt in pkts:
+            try:
+                cmd = json.loads(pkt.payload)
+                frame = serialize_frame(
+                    int(cmd["Cmd"]), int(cmd.get("Ack", ACK_IS_CMD)), vin,
+                    bytes.fromhex(cmd.get("Data", "")),
+                )
+            except (ValueError, KeyError, TypeError) as e:
+                log.warning("gbt32960 %s: bad dnstream payload: %s", vin, e)
+                continue
+            try:
+                v.writer.write(frame)
+            except Exception:
+                self._drop(vin)
+                return
+            if pkt.packet_id is not None:
+                v.session.on_puback(pkt.packet_id)

@@ -1,0 +1,150 @@
+"""GB/T 32960 gateway e2e: a fake EV over a raw socket logs in,
+reports realtime data, receives platform commands, and logs out.
+
+Ref: apps/emqx_gateway_gbt32960 (emqx_gbt32960_frame.erl layouts,
+emqx_gbt32960_channel.erl topic mapping + ACK echo).
+"""
+
+import asyncio
+import json
+import struct
+
+import pytest
+
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.packet import SubOpts
+from emqx_tpu.broker.pubsub import Broker
+from emqx_tpu.gateway import GatewayRegistry
+from emqx_tpu.gateway.gbt32960 import (
+    ACK_IS_CMD,
+    ACK_SUCCESS,
+    CMD_HEARTBEAT,
+    CMD_INFO,
+    CMD_VLOGIN,
+    CMD_VLOGOUT,
+    FrameError,
+    parse_frames,
+    parse_info,
+    serialize_frame,
+)
+
+VIN = "LSVAA1234E1234567"
+
+
+def test_frame_codec_roundtrip_and_bcc():
+    f = serialize_frame(CMD_VLOGIN, ACK_IS_CMD, VIN, b"\x01\x02")
+    buf = bytearray(b"junk" + f + f[:10])  # garbage prefix + partial tail
+    frames = parse_frames(buf)
+    assert len(frames) == 1
+    fr = frames[0]
+    assert fr["cmd"] == CMD_VLOGIN and fr["vin"] == VIN
+    assert fr["data"] == b"\x01\x02"
+    assert len(buf) == 10  # partial frame retained
+    bad = bytearray(f)
+    bad[-1] ^= 0xFF
+    with pytest.raises(FrameError, match="BCC"):
+        parse_frames(bad)
+
+
+def test_parse_info_layouts():
+    vehicle = bytes([0x01]) + struct.pack(
+        ">BBBHIHHBBBHBB", 1, 2, 1, 550, 123456, 3500, 1000, 88, 1, 0xD,
+        1200, 10, 0,
+    )
+    location = bytes([0x05]) + struct.pack(">BII", 0, 116_000_000, 39_000_000)
+    alarm = bytes([0x07, 2]) + struct.pack(">I", 0b101) + bytes(
+        [1]) + struct.pack(">I", 99) + bytes([0, 0, 0])
+    infos = parse_info(vehicle + location + alarm)
+    assert infos[0]["Type"] == "Vehicle" and infos[0]["Speed"] == 550
+    assert infos[0]["SOC"] == 88
+    assert infos[1]["Type"] == "Location"
+    assert infos[1]["Longitude"] == 116_000_000
+    assert infos[2]["Type"] == "Alarm"
+    assert infos[2]["MaxAlarmLevel"] == 2
+    assert infos[2]["FaultChargeableDeviceList"] == [99]
+    # unknown type ends structured parsing with a passthrough
+    weird = parse_info(bytes([0x55, 1, 2, 3]))
+    assert weird[0]["Type"] == "Unknown" and weird[0]["Raw"] == "55010203"
+
+
+def login_data(seq=1):
+    t = bytes([24, 7, 30, 12, 0, 0])
+    return (t + struct.pack(">H", seq) + b"89860000000000000000"
+            + bytes([1, 1]) + b"C1")
+
+
+def capture(broker, cid, flt):
+    s, _ = broker.open_session(cid, True)
+    box = []
+    s.outgoing_sink = box.extend
+    broker.subscribe(s, flt, SubOpts(qos=0))
+    return box
+
+
+@pytest.mark.asyncio
+async def test_gbt32960_end_to_end():
+    broker = Broker()
+    reg = GatewayRegistry(broker)
+    gw = await reg.load("gbt32960", {"bind": "127.0.0.1:0"})
+    up = capture(broker, "tsp", f"gbt32960/{VIN}/upstream/#")
+    try:
+        r, w = await asyncio.open_connection(*gw.listen_addr)
+        # frames before login are ignored (the reference channel gate)
+        w.write(serialize_frame(CMD_HEARTBEAT, ACK_IS_CMD, VIN))
+        # login -> ACK_SUCCESS echo + vlogin uplink
+        w.write(serialize_frame(CMD_VLOGIN, ACK_IS_CMD, VIN, login_data()))
+        await w.drain()
+        buf = bytearray(await r.read(1024))
+        acks = parse_frames(buf)
+        assert acks and acks[0]["cmd"] == CMD_VLOGIN
+        assert acks[0]["ack"] == ACK_SUCCESS
+        await asyncio.sleep(0.05)
+        assert gw.connection_count() == 1
+        ev = json.loads(up[-1].payload)
+        assert up[-1].topic == f"gbt32960/{VIN}/upstream/vlogin"
+        assert ev["Data"]["ICCID"] == "89860000000000000000"
+        assert ev["Data"]["Seq"] == 1
+
+        # realtime report -> parsed infos uplink + ack
+        t6 = bytes([24, 7, 30, 12, 0, 1])
+        vehicle = bytes([0x01]) + struct.pack(
+            ">BBBHIHHBBBHBB", 1, 1, 1, 420, 999, 3400, 900, 77, 1, 0xD,
+            1100, 5, 0,
+        )
+        w.write(serialize_frame(CMD_INFO, ACK_IS_CMD, VIN, t6 + vehicle))
+        await w.drain()
+        await asyncio.sleep(0.05)
+        ev = json.loads(up[-1].payload)
+        assert up[-1].topic == f"gbt32960/{VIN}/upstream/info"
+        assert ev["Data"]["Infos"][0]["SOC"] == 77
+
+        # platform command downstream -> framed to the vehicle
+        broker.publish(Message(
+            topic=f"gbt32960/{VIN}/dnstream",
+            payload=json.dumps({"Cmd": 0x80, "Data": "0102"}).encode(),
+            qos=1,
+        ))
+        buf = bytearray()
+        while True:
+            buf += await asyncio.wait_for(r.read(256), 2)
+            frames = parse_frames(bytearray(buf))
+            got = [f for f in frames if f["cmd"] == 0x80]
+            if got:
+                assert got[0]["ack"] == ACK_IS_CMD
+                assert got[0]["data"] == b"\x01\x02"
+                break
+
+        # logout tears the vehicle down
+        w.write(serialize_frame(
+            CMD_VLOGOUT, ACK_IS_CMD, VIN,
+            bytes([24, 7, 30, 12, 0, 2]) + struct.pack(">H", 1),
+        ))
+        await w.drain()
+        await asyncio.sleep(0.1)
+        assert gw.connection_count() == 0
+        assert any(
+            p.topic == f"gbt32960/{VIN}/upstream/vlogout" for p in up
+        )
+        w.close()
+    finally:
+        await reg.unload_all()
