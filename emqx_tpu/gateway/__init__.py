@@ -28,6 +28,7 @@ class GatewayRegistry:
         from .coap import CoapGateway
         from .exproto import ExProtoGateway
         from .gbt32960 import Gbt32960Gateway
+        from .jt808 import Jt808Gateway
         from .lwm2m import Lwm2mGateway
         from .mqttsn import MqttSnGateway
         from .ocpp import OcppGateway
@@ -40,6 +41,7 @@ class GatewayRegistry:
         self.register_type("ocpp", OcppGateway)
         self.register_type("exproto", ExProtoGateway)
         self.register_type("gbt32960", Gbt32960Gateway)
+        self.register_type("jt808", Jt808Gateway)
 
     def register_type(self, name: str, impl: Type[GatewayImpl]) -> None:
         self._types[name] = impl

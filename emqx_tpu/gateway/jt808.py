@@ -1,0 +1,343 @@
+"""JT/T 808 gateway — vehicle terminals (Chinese national standard).
+
+Reference: apps/emqx_gateway_jt808 (emqx_jt808_frame.erl codec with
+0x7E framing + 0x7D escaping, emqx_jt808_channel.erl register/auth
+flow, default topics {mountpoint}${phone}/up and ${phone}/dn).
+
+Frame (escaped between 0x7E flags; checksum = XOR of header+body):
+
+    0x7E | header | body | check | 0x7E
+    escaping: 0x7E -> 0x7D 0x02, 0x7D -> 0x7D 0x01
+
+Header: msg_id(2) | properties(2: bits0-9 body length, bit13
+fragment) | phone BCD(6 -> 12 digits, the client id) | msg_sn(2)
+[| frag total(2) | frag seq(2)].
+
+Flow (emqx_jt808_channel): terminal REGISTERs (0x0100) -> platform
+register-ack (0x8100) carrying an auth code; terminal AUTHs (0x0102)
+with that code -> session opens, dn topic subscribed. Uplinks publish
+JSON to {phone}/up; JSON on {phone}/dn frames down to the terminal.
+Location reports (0x0200) and deregister get platform general acks
+(0x8001). Fragmented messages (bit 13) are refused — the reference
+reassembles them; here oversized bodies should use the transparent
+path instead of silently mis-parsing."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import secrets
+import struct
+from typing import Dict, List, Optional, Tuple
+
+from .base import GatewayImpl
+
+log = logging.getLogger("emqx_tpu.gateway.jt808")
+
+MC_GENERAL_ACK, MC_HEARTBEAT, MC_DEREGISTER = 0x0001, 0x0002, 0x0003
+MC_REGISTER, MC_AUTH, MC_LOCATION = 0x0100, 0x0102, 0x0200
+MS_GENERAL_ACK, MS_REGISTER_ACK = 0x8001, 0x8100
+
+
+class FrameError(ValueError):
+    pass
+
+
+def _escape(data: bytes) -> bytes:
+    return data.replace(b"\x7d", b"\x7d\x01").replace(b"\x7e", b"\x7d\x02")
+
+
+def _unescape(data: bytes) -> bytes:
+    return data.replace(b"\x7d\x02", b"\x7e").replace(b"\x7d\x01", b"\x7d")
+
+
+def _bcd(phone: str) -> bytes:
+    phone = phone.rjust(12, "0")[-12:]
+    return bytes(
+        (int(phone[i]) << 4) | int(phone[i + 1]) for i in range(0, 12, 2)
+    )
+
+
+def _from_bcd(b: bytes) -> str:
+    return "".join(f"{x >> 4}{x & 0xF}" for x in b)
+
+
+def serialize_frame(msg_id: int, phone: str, msg_sn: int,
+                    body: bytes = b"") -> bytes:
+    head = struct.pack(">HH", msg_id, len(body) & 0x3FF) + _bcd(phone)
+    head += struct.pack(">H", msg_sn)
+    raw = head + body
+    check = 0
+    for x in raw:
+        check ^= x
+    return b"\x7e" + _escape(raw + bytes([check])) + b"\x7e"
+
+
+def parse_frames(buf: bytearray) -> List[dict]:
+    """Consume complete frames; bad checksum raises (framing lost)."""
+    out = []
+    while True:
+        start = buf.find(b"\x7e")
+        if start < 0:
+            buf.clear()
+            return out
+        if start:
+            del buf[:start]
+        end = buf.find(b"\x7e", 1)
+        if end < 0:
+            return out
+        raw = _unescape(bytes(buf[1:end]))
+        del buf[: end + 1]
+        if not raw:
+            continue  # back-to-back flags
+        if len(raw) < 13:
+            raise FrameError("short frame")
+        body_check, check = raw[:-1], raw[-1]
+        c = 0
+        for x in body_check:
+            c ^= x
+        if c != check:
+            raise FrameError("bad checksum")
+        msg_id, props = struct.unpack_from(">HH", body_check, 0)
+        if props & 0x2000:
+            raise FrameError("fragmented messages not supported")
+        phone = _from_bcd(body_check[4:10])
+        (msg_sn,) = struct.unpack_from(">H", body_check, 10)
+        body = body_check[12:]
+        if len(body) != props & 0x3FF:
+            raise FrameError("body length mismatch")
+        out.append({
+            "msg_id": msg_id, "phone": phone, "msg_sn": msg_sn,
+            "body": body,
+        })
+
+
+def parse_body(msg_id: int, body: bytes) -> dict:
+    if msg_id == MC_REGISTER and len(body) >= 37:
+        province, city = struct.unpack_from(">HH", body, 0)
+        return {
+            "province": province,
+            "city": city,
+            "manufacturer": body[4:9].decode("ascii", "replace").strip("\x00"),
+            "model": body[9:29].decode("ascii", "replace").strip("\x00"),
+            "dev_id": body[29:36].decode("ascii", "replace").strip("\x00"),
+            "color": body[36],
+            "license_number": body[37:].decode("utf-8", "replace"),
+        }
+    if msg_id == MC_AUTH:
+        return {"code": body.decode("utf-8", "replace")}
+    if msg_id == MC_LOCATION and len(body) >= 28:
+        alarm, status, lat, lon, alt, speed, direction = struct.unpack_from(
+            ">IIIIHHH", body, 0
+        )
+        return {
+            "alarm": alarm, "status": status,
+            "latitude": lat, "longitude": lon, "altitude": alt,
+            "speed": speed, "direction": direction,
+            "time": _from_bcd(body[22:28]),
+        }
+    if msg_id == MC_GENERAL_ACK and len(body) >= 5:
+        sn, mid = struct.unpack_from(">HH", body, 0)
+        return {"seq": sn, "id": mid, "result": body[4]}
+    return {"raw": body.hex()}
+
+
+class _Terminal:
+    def __init__(self, phone: str, writer):
+        self.phone = phone
+        self.writer = writer
+        self.session = None  # set after AUTH succeeds
+        self.authcode: Optional[str] = None
+        self.sn = 0
+
+    def next_sn(self) -> int:
+        self.sn = (self.sn + 1) & 0xFFFF
+        return self.sn
+
+
+class Jt808Gateway(GatewayImpl):
+    name = "jt808"
+
+    def __init__(self, broker, conf: dict):
+        super().__init__(broker, conf)
+        self._server: Optional[asyncio.AbstractServer] = None
+        self.listen_addr = None
+        self.terminals: Dict[str, _Terminal] = {}
+        self.max_conns = int(conf.get("max_connections", 10_000))
+        # anonymous registration (the reference's default when no
+        # registry/authentication URLs are configured)
+        self.allow_anonymous = bool(conf.get("allow_anonymous", True))
+
+    async def on_load(self) -> None:
+        from ..broker.listeners import parse_bind
+
+        host, port = parse_bind(self.conf.get("bind", "0.0.0.0:6207"))
+        self._server = await asyncio.start_server(self._conn, host, port)
+        self.listen_addr = self._server.sockets[0].getsockname()[:2]
+        log.info("jt808 gateway on %s", self.listen_addr)
+
+    async def on_unload(self) -> None:
+        for phone in list(self.terminals):
+            self._drop(phone)
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    def connection_count(self) -> int:
+        return len(self.terminals)
+
+    def listener_info(self) -> List[dict]:
+        return (
+            [{"type": "tcp",
+              "bind": f"{self.listen_addr[0]}:{self.listen_addr[1]}"}]
+            if self.listen_addr else []
+        )
+
+    # --- connection ------------------------------------------------------
+
+    async def _conn(self, reader, writer) -> None:
+        buf = bytearray()
+        term: Optional[_Terminal] = None
+        try:
+            while True:
+                data = await reader.read(65536)
+                if not data:
+                    break
+                buf += data
+                for frame in parse_frames(buf):
+                    term = self._handle_frame(frame, term, writer)
+        except (FrameError, ConnectionError) as e:
+            log.debug("jt808 connection dropped: %s", e)
+        finally:
+            if term is not None and self.terminals.get(term.phone) is term:
+                self._drop(term.phone)
+            writer.close()
+
+    def _drop(self, phone: str) -> None:
+        t = self.terminals.pop(phone, None)
+        if t is not None:
+            if t.session is not None:
+                self.close_session(t.session)
+            try:
+                t.writer.close()
+            except Exception:
+                pass
+
+    def _send(self, term: _Terminal, msg_id: int, body: bytes) -> None:
+        term.writer.write(
+            serialize_frame(msg_id, term.phone, term.next_sn(), body)
+        )
+
+    def _general_ack(self, term: _Terminal, frame: dict,
+                     result: int = 0) -> None:
+        self._send(
+            term, MS_GENERAL_ACK,
+            struct.pack(">HHB", frame["msg_sn"], frame["msg_id"], result),
+        )
+
+    def _handle_frame(self, frame: dict, term: Optional[_Terminal],
+                      writer) -> Optional[_Terminal]:
+        msg_id, phone = frame["msg_id"], frame["phone"]
+        if term is None:
+            if msg_id != MC_REGISTER:
+                return None  # register first (emqx_jt808_channel gate)
+            if len(self.terminals) >= self.max_conns and (
+                phone not in self.terminals
+            ):
+                return None
+            old = self.terminals.pop(phone, None)
+            if old is not None:
+                if old.session is not None:
+                    self.close_session(old.session)
+                try:
+                    old.writer.close()
+                except Exception:
+                    pass
+            term = _Terminal(phone, writer)
+            self.terminals[phone] = term
+            if not self.allow_anonymous:
+                self._send(
+                    term, MS_REGISTER_ACK,
+                    struct.pack(">HB", frame["msg_sn"], 1),  # rejected
+                )
+                return term
+            term.authcode = secrets.token_hex(8)
+            self._send(
+                term, MS_REGISTER_ACK,
+                struct.pack(">HB", frame["msg_sn"], 0)
+                + term.authcode.encode(),
+            )
+            return term
+        if term.session is None:
+            if msg_id != MC_AUTH:
+                return term  # must authenticate before anything else
+            code = frame["body"].decode("utf-8", "replace")
+            if code != term.authcode:
+                self._general_ack(term, frame, result=1)
+                return term
+            try:
+                session, _ = self.open_session(phone)
+            except Exception:
+                self._general_ack(term, frame, result=1)
+                return term
+            term.session = session
+            session.outgoing_sink = (
+                lambda pkts, p=phone: self._downlink(p, pkts)
+            )
+            try:
+                self.subscribe(session, f"jt808/{phone}/dn", qos=1)
+            except PermissionError:
+                self._drop(phone)
+                return None
+            self._general_ack(term, frame, result=0)
+            self._uplink(term, frame)
+            return term
+        # authenticated traffic
+        self._uplink(term, frame)
+        if msg_id in (MC_LOCATION, MC_DEREGISTER):
+            self._general_ack(term, frame, result=0)
+        if msg_id == MC_DEREGISTER:
+            self._drop(phone)
+            return None
+        return term
+
+    def _uplink(self, term: _Terminal, frame: dict) -> None:
+        if term.session is None:
+            return
+        body = {
+            "header": {
+                "msg_id": frame["msg_id"],
+                "phone": frame["phone"],
+                "msg_sn": frame["msg_sn"],
+            },
+            "body": parse_body(frame["msg_id"], frame["body"]),
+        }
+        try:
+            self.publish(
+                term.session, f"jt808/{term.phone}/up",
+                json.dumps(body).encode(), qos=1,
+            )
+        except (ValueError, PermissionError) as e:
+            log.warning("jt808 %s uplink denied: %s", term.phone, e)
+
+    # --- downlink ---------------------------------------------------------
+
+    def _downlink(self, phone: str, pkts) -> None:
+        term = self.terminals.get(phone)
+        if term is None or term.session is None:
+            return
+        for pkt in pkts:
+            try:
+                cmd = json.loads(pkt.payload)
+                body = bytes.fromhex(cmd.get("body", ""))
+                self._send(term, int(cmd["msg_id"]), body)
+            except (ValueError, KeyError, TypeError) as e:
+                log.warning("jt808 %s: bad dn payload: %s", phone, e)
+                continue
+            except Exception:
+                self._drop(phone)
+                return
+            if pkt.packet_id is not None:
+                term.session.on_puback(pkt.packet_id)

@@ -1,0 +1,140 @@
+"""JT/T 808 gateway e2e: register -> register-ack with auth code ->
+auth -> location uplink + general acks + downlink commands.
+
+Ref: apps/emqx_gateway_jt808 (emqx_jt808_frame.erl escaping/checksum,
+emqx_jt808_channel.erl register/auth flow).
+"""
+
+import asyncio
+import json
+import struct
+
+import pytest
+
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.packet import SubOpts
+from emqx_tpu.broker.pubsub import Broker
+from emqx_tpu.gateway import GatewayRegistry
+from emqx_tpu.gateway.jt808 import (
+    FrameError,
+    MC_AUTH,
+    MC_DEREGISTER,
+    MC_HEARTBEAT,
+    MC_LOCATION,
+    MC_REGISTER,
+    MS_GENERAL_ACK,
+    MS_REGISTER_ACK,
+    parse_frames,
+    serialize_frame,
+)
+
+PHONE = "013812345678"
+
+
+def test_frame_escaping_and_checksum():
+    # body containing both escape bytes round-trips
+    body = b"\x7e\x7d\x01\x02"
+    f = serialize_frame(0x0900, PHONE, 7, body)
+    assert f.count(b"\x7e") == 2  # flags only; payload 0x7e escaped
+    frames = parse_frames(bytearray(b"noise" + f))
+    assert frames[0]["msg_id"] == 0x0900
+    assert frames[0]["phone"] == PHONE
+    assert frames[0]["msg_sn"] == 7
+    assert frames[0]["body"] == body
+    bad = bytearray(f)
+    bad[-3] ^= 0x10  # corrupt inside the frame
+    with pytest.raises(FrameError):
+        parse_frames(bad)
+
+
+def register_body():
+    return (
+        struct.pack(">HH", 11, 2)
+        + b"MANUF" + b"MODEL".ljust(20, b"\x00")
+        + b"DEV0001" + bytes([1]) + "京A12345".encode()
+    )
+
+
+def location_body():
+    return struct.pack(
+        ">IIIIHHH", 0, 0x02, 31_230_000, 121_470_000, 40, 600, 90
+    ) + bytes([0x24, 0x07, 0x30, 0x12, 0x30, 0x00])
+
+
+class Terminal:
+    def __init__(self):
+        self.buf = bytearray()
+
+    async def connect(self, addr):
+        self.r, self.w = await asyncio.open_connection(*addr)
+
+    async def send(self, msg_id, sn, body=b""):
+        self.w.write(serialize_frame(msg_id, PHONE, sn, body))
+        await self.w.drain()
+
+    async def recv(self, timeout=2.0):
+        while True:
+            frames = parse_frames(self.buf)
+            if frames:
+                return frames[0]
+            self.buf += await asyncio.wait_for(self.r.read(4096), timeout)
+
+
+@pytest.mark.asyncio
+async def test_jt808_register_auth_location_flow():
+    broker = Broker()
+    reg = GatewayRegistry(broker)
+    gw = await reg.load("jt808", {"bind": "127.0.0.1:0"})
+    s, _ = broker.open_session("tsp", True)
+    up = []
+    s.outgoing_sink = up.extend
+    broker.subscribe(s, f"jt808/{PHONE}/up", SubOpts(qos=0))
+    t = Terminal()
+    try:
+        await t.connect(gw.listen_addr)
+        # location before register: ignored entirely
+        await t.send(MC_LOCATION, 1, location_body())
+        # register -> ack result 0 + auth code
+        await t.send(MC_REGISTER, 2, register_body())
+        ack = await t.recv()
+        assert ack["msg_id"] == MS_REGISTER_ACK
+        sn, result = struct.unpack_from(">HB", ack["body"], 0)
+        assert (sn, result) == (2, 0)
+        authcode = ack["body"][3:].decode()
+        # wrong auth code -> general ack result 1, session still absent
+        await t.send(MC_AUTH, 3, b"WRONG")
+        nack = await t.recv()
+        assert nack["msg_id"] == MS_GENERAL_ACK and nack["body"][4] == 1
+        assert gw.terminals[PHONE].session is None
+        # correct auth -> general ack 0 + auth uplink
+        await t.send(MC_AUTH, 4, authcode.encode())
+        ok = await t.recv()
+        assert ok["msg_id"] == MS_GENERAL_ACK and ok["body"][4] == 0
+        await asyncio.sleep(0.05)
+        assert json.loads(up[-1].payload)["header"]["msg_id"] == MC_AUTH
+        # location report -> parsed uplink + general ack
+        await t.send(MC_LOCATION, 5, location_body())
+        lack = await t.recv()
+        assert lack["msg_id"] == MS_GENERAL_ACK
+        await asyncio.sleep(0.05)
+        ev = json.loads(up[-1].payload)
+        assert ev["header"]["msg_id"] == MC_LOCATION
+        assert ev["body"]["latitude"] == 31_230_000
+        assert ev["body"]["speed"] == 600
+        assert ev["body"]["time"] == "240730123000"
+        # downlink command frames to the terminal with the dn body
+        broker.publish(Message(
+            topic=f"jt808/{PHONE}/dn",
+            payload=json.dumps({"msg_id": 0x8103, "body": "0102"}).encode(),
+            qos=1,
+        ))
+        dn = await t.recv()
+        assert dn["msg_id"] == 0x8103 and dn["body"] == b"\x01\x02"
+        # deregister -> ack + teardown
+        await t.send(MC_DEREGISTER, 6)
+        await t.recv()
+        await asyncio.sleep(0.1)
+        assert gw.connection_count() == 0
+        t.w.close()
+    finally:
+        await reg.unload_all()
