@@ -56,7 +56,12 @@ HEADER = 24  # '##' + cmd + ack + vin(17) + encrypt + len(2)
 
 
 class FrameError(ValueError):
-    pass
+    """Framing lost. `frames` carries messages parsed from the same
+    buffer BEFORE the bad one, so a caller can still process them."""
+
+    def __init__(self, msg: str, frames=None):
+        super().__init__(msg)
+        self.frames = frames or []
 
 
 def bcc(data: bytes) -> int:
@@ -98,7 +103,7 @@ def parse_frames(buf: bytearray) -> List[dict]:
         check = buf[HEADER + length]
         del buf[:total]
         if bcc(body) != check:
-            raise FrameError("bad BCC")
+            raise FrameError("bad BCC", out)
         out.append({
             "cmd": body[0],
             "ack": body[1],
@@ -289,7 +294,13 @@ class Gbt32960Gateway(GatewayImpl):
                 if not data:
                     break
                 buf += data
-                for frame in parse_frames(buf):
+                try:
+                    frames = parse_frames(buf)
+                except FrameError as e:
+                    for frame in e.frames:
+                        veh = self._handle_frame(frame, veh, writer)
+                    raise
+                for frame in frames:
                     veh = self._handle_frame(frame, veh, writer)
         except (FrameError, ConnectionError) as e:
             log.debug("gbt32960 connection dropped: %s", e)

@@ -41,7 +41,15 @@ MS_GENERAL_ACK, MS_REGISTER_ACK = 0x8001, 0x8100
 
 
 class FrameError(ValueError):
-    pass
+    """Framing lost. `frames` carries messages parsed from the same
+    buffer BEFORE the bad one, so a caller can still process them."""
+
+    def __init__(self, msg: str, frames: Optional[List[dict]] = None):
+        super().__init__(msg)
+        self.frames = frames or []
+
+
+MAX_PARTIAL = 8192  # a legitimate escaped JT808 frame is ~2KB max
 
 
 def _escape(data: bytes) -> bytes:
@@ -60,12 +68,24 @@ def _bcd(phone: str) -> bytes:
 
 
 def _from_bcd(b: bytes) -> str:
-    return "".join(f"{x >> 4}{x & 0xF}" for x in b)
+    digits = []
+    for x in b:
+        hi, lo = x >> 4, x & 0xF
+        if hi > 9 or lo > 9:
+            # non-decimal nibbles would render >12 chars and collide
+            # with other ids after the reply-side truncation
+            raise FrameError("non-BCD phone digit")
+        digits.append(f"{hi}{lo}")
+    return "".join(digits)
 
 
 def serialize_frame(msg_id: int, phone: str, msg_sn: int,
                     body: bytes = b"") -> bytes:
-    head = struct.pack(">HH", msg_id, len(body) & 0x3FF) + _bcd(phone)
+    if len(body) > 0x3FF:
+        # fragmentation is unsupported: emitting a masked length would
+        # corrupt the frame while the broker still acks the delivery
+        raise FrameError(f"body too large ({len(body)} > 1023)")
+    head = struct.pack(">HH", msg_id, len(body)) + _bcd(phone)
     head += struct.pack(">H", msg_sn)
     raw = head + body
     check = 0
@@ -75,8 +95,14 @@ def serialize_frame(msg_id: int, phone: str, msg_sn: int,
 
 
 def parse_frames(buf: bytearray) -> List[dict]:
-    """Consume complete frames; bad checksum raises (framing lost)."""
-    out = []
+    """Consume complete frames; a bad frame raises FrameError with the
+    already-parsed frames attached (callers process them, THEN drop
+    the connection)."""
+    out: List[dict] = []
+
+    def fail(msg: str):
+        raise FrameError(msg, out)
+
     while True:
         start = buf.find(b"\x7e")
         if start < 0:
@@ -86,27 +112,29 @@ def parse_frames(buf: bytearray) -> List[dict]:
             del buf[:start]
         end = buf.find(b"\x7e", 1)
         if end < 0:
+            if len(buf) > MAX_PARTIAL:
+                fail("unterminated frame exceeds size cap")
             return out
         raw = _unescape(bytes(buf[1:end]))
         del buf[: end + 1]
         if not raw:
             continue  # back-to-back flags
         if len(raw) < 13:
-            raise FrameError("short frame")
+            fail("short frame")
         body_check, check = raw[:-1], raw[-1]
         c = 0
         for x in body_check:
             c ^= x
         if c != check:
-            raise FrameError("bad checksum")
+            fail("bad checksum")
         msg_id, props = struct.unpack_from(">HH", body_check, 0)
         if props & 0x2000:
-            raise FrameError("fragmented messages not supported")
+            fail("fragmented messages not supported")
         phone = _from_bcd(body_check[4:10])
         (msg_sn,) = struct.unpack_from(">H", body_check, 10)
         body = body_check[12:]
         if len(body) != props & 0x3FF:
-            raise FrameError("body length mismatch")
+            fail("body length mismatch")
         out.append({
             "msg_id": msg_id, "phone": phone, "msg_sn": msg_sn,
             "body": body,
@@ -206,7 +234,14 @@ class Jt808Gateway(GatewayImpl):
                 if not data:
                     break
                 buf += data
-                for frame in parse_frames(buf):
+                try:
+                    frames = parse_frames(buf)
+                except FrameError as e:
+                    # frames decoded before the bad one still count
+                    for frame in e.frames:
+                        term = self._handle_frame(frame, term, writer)
+                    raise
+                for frame in frames:
                     term = self._handle_frame(frame, term, writer)
         except (FrameError, ConnectionError) as e:
             log.debug("jt808 connection dropped: %s", e)
@@ -246,15 +281,14 @@ class Jt808Gateway(GatewayImpl):
             if len(self.terminals) >= self.max_conns and (
                 phone not in self.terminals
             ):
+                # reject EXPLICITLY — a silent drop leaves the terminal
+                # blind-retrying until its own timeout
+                writer.write(serialize_frame(
+                    MS_REGISTER_ACK, phone, 0,
+                    struct.pack(">HB", frame["msg_sn"], 1),
+                ))
                 return None
-            old = self.terminals.pop(phone, None)
-            if old is not None:
-                if old.session is not None:
-                    self.close_session(old.session)
-                try:
-                    old.writer.close()
-                except Exception:
-                    pass
+            self._drop(phone)  # re-register replaces the old socket
             term = _Terminal(phone, writer)
             self.terminals[phone] = term
             if not self.allow_anonymous:
@@ -269,6 +303,12 @@ class Jt808Gateway(GatewayImpl):
                 struct.pack(">HB", frame["msg_sn"], 0)
                 + term.authcode.encode(),
             )
+            return term
+        if phone != term.phone:
+            # a frame claiming another phone would publish spoofed
+            # header.phone data under this terminal's topics
+            log.warning("jt808 %s: frame with foreign phone %s dropped",
+                        term.phone, phone)
             return term
         if term.session is None:
             if msg_id != MC_AUTH:
@@ -333,7 +373,7 @@ class Jt808Gateway(GatewayImpl):
                 cmd = json.loads(pkt.payload)
                 body = bytes.fromhex(cmd.get("body", ""))
                 self._send(term, int(cmd["msg_id"]), body)
-            except (ValueError, KeyError, TypeError) as e:
+            except (FrameError, ValueError, KeyError, TypeError) as e:
                 log.warning("jt808 %s: bad dn payload: %s", phone, e)
                 continue
             except Exception:

@@ -138,3 +138,58 @@ async def test_jt808_register_auth_location_flow():
         t.w.close()
     finally:
         await reg.unload_all()
+
+
+def test_bad_frame_preserves_earlier_frames():
+    """A good frame followed by a corrupt one in the same read must
+    still surface the good frame (attached to the error)."""
+    good = serialize_frame(MC_HEARTBEAT, PHONE, 9)
+    bad = bytearray(serialize_frame(MC_HEARTBEAT, PHONE, 10))
+    bad[-3] ^= 0x20
+    buf = bytearray(good + bytes(bad))
+    with pytest.raises(FrameError) as ei:
+        parse_frames(buf)
+    assert [f["msg_sn"] for f in ei.value.frames] == [9]
+
+
+def test_oversized_body_rejected():
+    with pytest.raises(FrameError, match="too large"):
+        serialize_frame(0x8300, PHONE, 1, b"x" * 1024)
+
+
+def test_unterminated_buffer_capped():
+    from emqx_tpu.gateway.jt808 import MAX_PARTIAL
+
+    buf = bytearray(b"\x7e" + b"A" * (MAX_PARTIAL + 10))
+    with pytest.raises(FrameError, match="size cap"):
+        parse_frames(buf)
+
+
+@pytest.mark.asyncio
+async def test_foreign_phone_frames_dropped():
+    broker = Broker()
+    reg = GatewayRegistry(broker)
+    gw = await reg.load("jt808", {"bind": "127.0.0.1:0"})
+    s, _ = broker.open_session("tsp", True)
+    up = []
+    s.outgoing_sink = up.extend
+    broker.subscribe(s, "jt808/+/up", SubOpts(qos=0))
+    t = Terminal()
+    try:
+        await t.connect(gw.listen_addr)
+        await t.send(MC_REGISTER, 1, register_body())
+        ack = await t.recv()
+        authcode = ack["body"][3:].decode()
+        await t.send(MC_AUTH, 2, authcode.encode())
+        await t.recv()
+        await asyncio.sleep(0.05)
+        base = len(up)
+        # a frame claiming a DIFFERENT phone on this socket: dropped
+        t.w.write(serialize_frame(MC_LOCATION, "013899999999", 3,
+                                  location_body()))
+        await t.w.drain()
+        await asyncio.sleep(0.1)
+        assert len(up) == base  # nothing published, no spoofed header
+        t.w.close()
+    finally:
+        await reg.unload_all()
