@@ -30,6 +30,9 @@ PAGE = """<!DOCTYPE html>
 </head>
 <body>
 <h1>emqx-tpu &mdash; node console</h1>
+<p><a href="/api/v5/swagger.json">OpenAPI spec</a> &middot;
+   <a href="/api/v5/monitor_current">monitor (current)</a> &middot;
+   <a href="/api/v5/monitor?latest=50">monitor (window)</a></p>
 <div id="login">
   <input id="u" placeholder="username" value="admin">
   <input id="p" placeholder="password" type="password">

@@ -167,3 +167,38 @@ async def test_auth_chain_materializes_from_config(tmp_path):
     with pytest.raises(ValueError, match="carrier_pigeon"):
         await node2.start()
     await node2.stop()
+
+
+async def test_gateways_boot_from_config(tmp_path):
+    """All eight gateway types load from the `gateway` config root
+    (emqx_gateway registry via emqx_machine boot order)."""
+    conf = {
+        "node": {"name": "gw-boot@127.0.0.1",
+                 "data_dir": str(tmp_path / "d")},
+        "listeners": {"tcp": {"default": {"bind": "127.0.0.1:0"}}},
+        "gateway": {
+            "stomp": {"bind": "127.0.0.1:0"},
+            "mqttsn": {"bind": "127.0.0.1:0"},
+            "coap": {"bind": "127.0.0.1:0"},
+            "lwm2m": {"bind": "127.0.0.1:0"},
+            "ocpp": {"bind": "127.0.0.1:0"},
+            "gbt32960": {"bind": "127.0.0.1:0"},
+            "jt808": {"bind": "127.0.0.1:0"},
+            # exproto needs its handler server: covered in test_exproto
+        },
+    }
+    node = Node(config_text=json.dumps(conf))
+    await node.start()
+    try:
+        st = {g["name"]: g for g in node.gateways.status()}
+        assert set(st) == {
+            "stomp", "mqttsn", "coap", "lwm2m", "ocpp", "gbt32960", "jt808",
+        }
+        assert all(g["status"] == "running" for g in st.values())
+        assert all(g["listeners"] for g in st.values())
+        assert sorted(node.gateways.types()) == [
+            "coap", "exproto", "gbt32960", "jt808", "lwm2m", "mqttsn",
+            "ocpp", "stomp",
+        ]
+    finally:
+        await node.stop()
