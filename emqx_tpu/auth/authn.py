@@ -130,20 +130,142 @@ def make_jwt(claims: Dict[str, Any], secret: bytes, alg: str = "HS256") -> str:
 
 
 class JwtProvider(Provider):
-    """HS256 JWT authn (emqx_auth_jwt analog): password carries the
-    token; claims checked: exp, optional acl (list of {permission,
-    action, topic}), optional verify_claims equality (supports
+    """JWT authn (emqx_auth_jwt analog): password carries the token.
+
+    Verification modes, mirroring the reference's three variants:
+      * hmac-based  — `secret` (HS256);
+      * public-key  — `public_key` PEM (RS256 / ES256);
+      * jwks        — `jwks_endpoint` fetched lazily with an in-memory
+        cache and re-fetched once on an unknown kid (key rotation).
+    Claims checked: exp, optional acl (list of {permission, action,
+    topic}), optional verify_claims equality (supports
     ${clientid}/${username} placeholders)."""
 
     def __init__(
         self,
-        secret: bytes,
+        secret: bytes = b"",
         verify_claims: Optional[Dict[str, str]] = None,
         acl_claim_name: str = "acl",
+        public_key: Optional[bytes] = None,
+        jwks_endpoint: Optional[str] = None,
+        jwks_refresh_s: float = 300.0,
     ):
         self.secret = secret
         self.verify_claims = verify_claims or {}
         self.acl_claim_name = acl_claim_name
+        self._pub = None
+        if public_key:
+            from cryptography.hazmat.primitives.serialization import (
+                load_pem_public_key,
+            )
+
+            self._pub = load_pem_public_key(public_key)
+        self.jwks_endpoint = jwks_endpoint
+        self.jwks_refresh_s = jwks_refresh_s
+        self._jwks: Dict[str, Any] = {}
+        self._jwks_at = 0.0
+
+    # --- signature verification ----------------------------------------
+
+    def _load_jwks(self, force: bool = False) -> None:
+        if self.jwks_endpoint is None:
+            return
+        if not force and self._jwks and (
+            time.time() - self._jwks_at < self.jwks_refresh_s
+        ):
+            return
+        import urllib.request
+
+        with urllib.request.urlopen(self.jwks_endpoint, timeout=5.0) as r:
+            doc = json.loads(r.read())
+        keys = {}
+        for jwk in doc.get("keys", []):
+            k = self._jwk_to_key(jwk)
+            if k is not None:
+                keys[jwk.get("kid", "")] = (jwk.get("kty"), k)
+        self._jwks = keys
+        self._jwks_at = time.time()
+
+    @staticmethod
+    def _jwk_to_key(jwk: Dict[str, Any]):
+        try:
+            if jwk.get("kty") == "RSA":
+                from cryptography.hazmat.primitives.asymmetric.rsa import (
+                    RSAPublicNumbers,
+                )
+
+                n = int.from_bytes(_b64url_decode(jwk["n"]), "big")
+                e = int.from_bytes(_b64url_decode(jwk["e"]), "big")
+                return RSAPublicNumbers(e, n).public_key()
+            if jwk.get("kty") == "EC" and jwk.get("crv") == "P-256":
+                from cryptography.hazmat.primitives.asymmetric.ec import (
+                    SECP256R1, EllipticCurvePublicNumbers,
+                )
+
+                x = int.from_bytes(_b64url_decode(jwk["x"]), "big")
+                y = int.from_bytes(_b64url_decode(jwk["y"]), "big")
+                return EllipticCurvePublicNumbers(
+                    x, y, SECP256R1()
+                ).public_key()
+        except Exception:
+            return None
+        return None
+
+    def _verify_sig(self, alg: str, kid: Optional[str], signing: bytes,
+                    sig: bytes) -> bool:
+        if alg == "HS256":
+            if not self.secret:
+                return False
+            return hmac.compare_digest(
+                sig, hmac.new(self.secret, signing, hashlib.sha256).digest()
+            )
+        if alg not in ("RS256", "ES256"):
+            return False
+        key = self._pub
+        if key is None and self.jwks_endpoint is not None:
+            self._load_jwks()
+            ent = self._jwks.get(kid or "")
+            if ent is None:
+                # unknown kid: rotation — one forced refresh
+                self._load_jwks(force=True)
+                ent = self._jwks.get(kid or "")
+            if ent is None and len(self._jwks) == 1:
+                ent = next(iter(self._jwks.values()))
+            if ent is None:
+                return False
+            key = ent[1]
+        if key is None:
+            return False
+        from cryptography.exceptions import InvalidSignature
+        from cryptography.hazmat.primitives.hashes import SHA256
+
+        try:
+            if alg == "RS256":
+                from cryptography.hazmat.primitives.asymmetric.padding import (
+                    PKCS1v15,
+                )
+
+                key.verify(sig, signing, PKCS1v15(), SHA256())
+            else:
+                from cryptography.hazmat.primitives.asymmetric.ec import (
+                    ECDSA,
+                )
+                from cryptography.hazmat.primitives.asymmetric.utils import (
+                    encode_dss_signature,
+                )
+
+                if len(sig) != 64:
+                    return False
+                # JOSE raw r||s -> DER
+                r = int.from_bytes(sig[:32], "big")
+                s_ = int.from_bytes(sig[32:], "big")
+                key.verify(encode_dss_signature(r, s_), signing,
+                           ECDSA(SHA256()))
+            return True
+        except InvalidSignature:
+            return False
+        except Exception:
+            return False
 
     def authenticate(self, creds: Credentials):
         token = (creds.password or b"").decode("utf-8", "replace")
@@ -158,12 +280,12 @@ class JwtProvider(Provider):
             return AuthResult(False, "bad_token")
         if not isinstance(header, dict) or not isinstance(claims, dict):
             return AuthResult(False, "bad_token")
-        if header.get("alg") != "HS256":
+        alg = header.get("alg")
+        if alg not in ("HS256", "RS256", "ES256"):
             return AuthResult(False, "unsupported_alg")
-        expect = hmac.new(
-            self.secret, f"{header_b64}.{body_b64}".encode(), hashlib.sha256
-        ).digest()
-        if not hmac.compare_digest(sig, expect):
+        if not self._verify_sig(
+            alg, header.get("kid"), f"{header_b64}.{body_b64}".encode(), sig
+        ):
             return AuthResult(False, "bad_signature")
         exp = claims.get("exp")
         if exp is not None:

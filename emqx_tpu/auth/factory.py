@@ -55,9 +55,13 @@ def provider_from_conf(conf: Dict[str, Any]) -> Provider:
             tuple(conf.get("superusers") or ()),
         )
     if backend == "jwt" or conf.get("mechanism") == "jwt":
+        pub = conf.get("public_key")
         return JwtProvider(
             secret=str(conf.get("secret", "")).encode(),
             acl_claim_name=conf.get("acl_claim_name", "acl"),
+            verify_claims=conf.get("verify_claims"),
+            public_key=pub.encode() if isinstance(pub, str) else pub,
+            jwks_endpoint=conf.get("endpoint") or conf.get("jwks_endpoint"),
         )
     if backend == "http":
         from .http import HttpAuthnProvider

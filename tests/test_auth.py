@@ -268,3 +268,145 @@ class TestEndToEnd:
         assert isinstance(suback, Suback)
         assert suback.codes[0] == 1  # granted
         assert suback.codes[1] in (0x80, 0x87)  # denied
+
+
+def test_jwt_rs256_and_es256_public_key():
+    """Public-key JWTs (emqx_authn_jwt public-key variant): RS256 and
+    ES256 verify against a configured PEM; wrong keys and tampered
+    tokens fail."""
+    import json as _json
+
+    from cryptography.hazmat.primitives.asymmetric import ec, rsa
+    from cryptography.hazmat.primitives.asymmetric.padding import PKCS1v15
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        decode_dss_signature,
+    )
+    from cryptography.hazmat.primitives.hashes import SHA256
+    from cryptography.hazmat.primitives.serialization import (
+        Encoding, PublicFormat,
+    )
+
+    from emqx_tpu.auth.authn import _b64url_encode
+
+    def mint(alg, sign):
+        header = _b64url_encode(_json.dumps({"alg": alg}).encode())
+        body = _b64url_encode(_json.dumps({"sub": "dev1"}).encode())
+        sig = sign(f"{header}.{body}".encode())
+        return f"{header}.{body}." + _b64url_encode(sig)
+
+    rsa_key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    pem = rsa_key.public_key().public_bytes(
+        Encoding.PEM, PublicFormat.SubjectPublicKeyInfo
+    )
+    p = JwtProvider(public_key=pem)
+    tok = mint("RS256", lambda m: rsa_key.sign(m, PKCS1v15(), SHA256()))
+    assert p.authenticate(Credentials("c1", "u", tok.encode())).ok
+    bad = tok[:-8] + "AAAAAAAA"
+    assert not p.authenticate(Credentials("c1", "u", bad.encode())).ok
+    # HS256 token against a public-key provider: no secret -> reject
+    hs = make_jwt({"sub": "x"}, b"k")
+    assert not p.authenticate(Credentials("c1", "u", hs.encode())).ok
+
+    ec_key = ec.generate_private_key(ec.SECP256R1())
+    ec_pem = ec_key.public_key().public_bytes(
+        Encoding.PEM, PublicFormat.SubjectPublicKeyInfo
+    )
+
+    def ec_sign(m):
+        der = ec_key.sign(m, ec.ECDSA(SHA256()))
+        r, s = decode_dss_signature(der)
+        return r.to_bytes(32, "big") + s.to_bytes(32, "big")  # JOSE raw
+
+    p2 = JwtProvider(public_key=ec_pem)
+    tok2 = mint("ES256", ec_sign)
+    assert p2.authenticate(Credentials("c2", "u", tok2.encode())).ok
+
+
+def test_jwt_jwks_endpoint_with_rotation():
+    """JWKS fetch + kid selection + one forced refresh on unknown kid
+    (key rotation), against an in-process JWKS server."""
+    import asyncio
+    import json as _json
+    import threading
+
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.hazmat.primitives.asymmetric.padding import PKCS1v15
+    from cryptography.hazmat.primitives.hashes import SHA256
+
+    from emqx_tpu.auth.authn import _b64url_encode
+
+    keys = {"k1": rsa.generate_private_key(public_exponent=65537,
+                                           key_size=2048)}
+    state = {"fetches": 0}
+
+    def jwks_doc():
+        out = []
+        for kid, priv in keys.items():
+            nums = priv.public_key().public_numbers()
+            out.append({
+                "kty": "RSA", "kid": kid,
+                "n": _b64url_encode(
+                    nums.n.to_bytes((nums.n.bit_length() + 7) // 8, "big")
+                ),
+                "e": _b64url_encode(
+                    nums.e.to_bytes((nums.e.bit_length() + 7) // 8, "big")
+                ),
+            })
+        return {"keys": out}
+
+    result = {}
+    started = threading.Event()
+    stop = threading.Event()
+
+    def thread():
+        async def handle(reader, writer):
+            await reader.readuntil(b"\r\n\r\n")
+            state["fetches"] += 1
+            body = _json.dumps(jwks_doc()).encode()
+            writer.write(
+                b"HTTP/1.1 200 OK\r\ncontent-type: application/json\r\n"
+                + f"content-length: {len(body)}\r\n\r\n".encode() + body
+            )
+            await writer.drain()
+            writer.close()
+
+        async def main():
+            srv = await asyncio.start_server(handle, "127.0.0.1", 0)
+            result["port"] = srv.sockets[0].getsockname()[1]
+            started.set()
+            while not stop.is_set():
+                await asyncio.sleep(0.01)
+            srv.close()
+
+        asyncio.run(main())
+
+    t = threading.Thread(target=thread, daemon=True)
+    t.start()
+    assert started.wait(5)
+    try:
+        def mint(kid):
+            header = _b64url_encode(
+                _json.dumps({"alg": "RS256", "kid": kid}).encode()
+            )
+            body = _b64url_encode(_json.dumps({"sub": "d"}).encode())
+            sig = keys[kid].sign(
+                f"{header}.{body}".encode(), PKCS1v15(), SHA256()
+            )
+            return f"{header}.{body}." + _b64url_encode(sig)
+
+        p = JwtProvider(
+            jwks_endpoint=f"http://127.0.0.1:{result['port']}/jwks"
+        )
+        assert p.authenticate(Credentials("c", "u", mint("k1").encode())).ok
+        assert state["fetches"] == 1
+        # cached: second auth does not refetch
+        assert p.authenticate(Credentials("c", "u", mint("k1").encode())).ok
+        assert state["fetches"] == 1
+        # rotation: new kid appears -> ONE forced refresh picks it up
+        keys["k2"] = rsa.generate_private_key(public_exponent=65537,
+                                              key_size=2048)
+        assert p.authenticate(Credentials("c", "u", mint("k2").encode())).ok
+        assert state["fetches"] == 2
+    finally:
+        stop.set()
+        t.join(5)
