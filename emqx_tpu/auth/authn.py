@@ -347,6 +347,18 @@ class AuthnChains:
     def list_authenticators(self, chain: str) -> List[str]:
         return [a.id for a in self._chains.get(chain, [])]
 
+    def destroy_all(self) -> None:
+        """Release every provider's resources (backend connections) —
+        the app-stop teardown the reference's authenticator providers
+        get from their supervisor."""
+        for chain in self._chains.values():
+            for a in chain:
+                try:
+                    a.provider.destroy()
+                except Exception:
+                    pass
+        self._chains.clear()
+
     def authenticate(self, creds: Credentials, listener: Optional[str] = None) -> AuthResult:
         """Listener chain if it exists, else the global chain
         (emqx_authn_chains listener→global fallback). Empty/absent

@@ -138,6 +138,16 @@ class Authz:
         self.no_match = no_match
         self.sources = sources or []
 
+    def destroy_all(self) -> None:
+        for src in self.sources:
+            d = getattr(src, "destroy", None)
+            if d is not None:
+                try:
+                    d()
+                except Exception:
+                    pass
+        self.sources.clear()
+
     def add_source(self, source: Source, front: bool = False) -> None:
         if front:
             self.sources.insert(0, source)

@@ -376,6 +376,11 @@ class Node:
         if getattr(self, "_ft_gc_task", None) is not None:
             self._ft_gc_task.cancel()
             self._ft_gc_task = None
+        if self.auth is not None:
+            # backend-connected providers (redis/pg/mysql/...) hold
+            # sockets that must close with the node
+            self.auth.authn.destroy_all()
+            self.auth.authz.destroy_all()
         if self.telemetry is not None:
             self.telemetry.stop()
         if self.obs is not None:

@@ -41,6 +41,15 @@ PAGE = """<!DOCTYPE html>
 </div>
 <div id="main" style="display:none">
   <div class="grid" id="tiles"></div>
+  <h2 style="font-size:1.05rem">Message rates (msg/s, sampled)</h2>
+  <div class="grid">
+    <div class="card">received<svg id="c_recv" viewBox="0 0 240 48"
+      width="100%" height="48" preserveAspectRatio="none"></svg></div>
+    <div class="card">sent<svg id="c_sent" viewBox="0 0 240 48"
+      width="100%" height="48" preserveAspectRatio="none"></svg></div>
+    <div class="card">dropped<svg id="c_drop" viewBox="0 0 240 48"
+      width="100%" height="48" preserveAspectRatio="none"></svg></div>
+  </div>
   <h2 style="font-size:1.05rem">Clients</h2>
   <table id="clients"><thead><tr><th>client id</th><th>connected</th>
   <th>subscriptions</th></tr></thead><tbody></tbody></table>
@@ -68,11 +77,32 @@ async function get(path) {
 function tile(name, value) {
   return `<div class="card">${esc(name)}<b>${esc(value)}</b></div>`;
 }
+function spark(svg, values) {
+  // inline SVG polyline, no deps (emqx_dashboard_monitor chart analog)
+  const w = 240, h = 48, pad = 2;
+  const max = Math.max(1, ...values);
+  const step = values.length > 1 ? (w - 2 * pad) / (values.length - 1) : 0;
+  const pts = values.map((v, i) =>
+    `${(pad + i * step).toFixed(1)},` +
+    `${(h - pad - (v / max) * (h - 2 * pad)).toFixed(1)}`).join(' ');
+  svg.innerHTML = `<polyline fill="none" stroke="currentColor"` +
+    ` stroke-width="1.5" points="${pts}"/>` +
+    `<text x="${w - 4}" y="10" text-anchor="end" font-size="9"` +
+    ` fill="currentColor">${esc(max.toFixed(1))}</text>`;
+}
 async function tick() {
-  const [stats, metrics, clients] = await Promise.all([
+  const [stats, metrics, clients, mon] = await Promise.all([
     get('/api/v5/stats'), get('/api/v5/metrics'),
-    get('/api/v5/clients?limit=50')]);
+    get('/api/v5/clients?limit=50'), get('/api/v5/monitor?latest=48')]);
   if (!stats || !metrics || !clients) return;  // partial failure: skip tick
+  if (mon && mon.length) {
+    spark(document.getElementById('c_recv'),
+          mon.map(s => s.received_msg_rate ?? 0));
+    spark(document.getElementById('c_sent'),
+          mon.map(s => s.sent_msg_rate ?? 0));
+    spark(document.getElementById('c_drop'),
+          mon.map(s => s.dropped_msg_rate ?? 0));
+  }
   tiles.innerHTML =
     tile('sessions', stats['sessions.count'] ?? 0) +
     tile('subscriptions', stats['subscriptions.count'] ?? 0) +

@@ -169,3 +169,126 @@ async def test_everything_together(tmp_path):
         assert any(m["topic"] == "modern/cfg" for m in retained["data"])
     finally:
         await node.stop()
+
+
+async def test_round3_surfaces_together(tmp_path):
+    """Round-3 subsystems in ONE booted node: config-driven Redis
+    authn gating MQTT connects, a JT808 terminal publishing through
+    the gateway to an MQTT subscriber, topic metrics counting it, and
+    the monitor/swagger/RBAC surfaces live."""
+    import hashlib
+    import struct
+
+    from test_redis import MiniRedis
+
+    from emqx_tpu.gateway.jt808 import (
+        MC_AUTH, MC_LOCATION, MC_REGISTER, serialize_frame,
+    )
+    from test_jt808 import Terminal, location_body, register_body, PHONE
+
+    rsrv = MiniRedis()
+    await rsrv.start()
+    salt = "s9"
+    rsrv.store["mqtt_user:good"] = {
+        "password_hash": hashlib.sha256((salt + "pw").encode())
+        .hexdigest().encode(),
+        "salt": salt.encode(),
+    }
+    node = Node(config_text=json.dumps({
+        "node": {"name": "r3@127.0.0.1", "data_dir": str(tmp_path / "d")},
+        "listeners": {"tcp": {"default": {"bind": "127.0.0.1:0"}}},
+        "api": {"enable": True, "bind": "127.0.0.1:0"},
+        "gateway": {"jt808": {"bind": "127.0.0.1:0"}},
+        "authentication": [{
+            "mechanism": "password_based", "backend": "redis",
+            "server": f"127.0.0.1:{rsrv.port}",
+            "cmd": "HMGET mqtt_user:${username} password_hash salt",
+            "password_hash_algorithm": {
+                "name": "sha256", "salt_position": "prefix",
+            },
+        }],
+    }))
+    await node.start()
+    try:
+        port = node.listeners._live[("tcp", "default")].listen_addr[1]
+        # redis-backed authn: wrong password refused at CONNECT
+        r0, w0 = await asyncio.open_connection("127.0.0.1", port)
+        w0.write(frame.serialize(Connect(
+            client_id="bad", username="good", password=b"WRONG")))
+        p0 = frame.Parser()
+        pkts0 = []
+        while not any(isinstance(x, Connack) for x in pkts0):
+            pkts0 += p0.feed(await asyncio.wait_for(r0.read(4096), 5))
+        assert next(x for x in pkts0 if isinstance(x, Connack)).code != 0
+        w0.close()
+        # good credentials connect + subscribe to jt808 uplinks
+        r1, w1 = await asyncio.open_connection("127.0.0.1", port)
+        w1.write(frame.serialize(Connect(
+            client_id="tsp", username="good", password=b"pw")))
+        p1 = frame.Parser()
+        pkts1 = []
+        while not any(isinstance(x, Connack) for x in pkts1):
+            pkts1 += p1.feed(await asyncio.wait_for(r1.read(4096), 5))
+        assert next(x for x in pkts1 if isinstance(x, Connack)).code == 0
+        w1.write(frame.serialize(Subscribe(
+            packet_id=1, filters=[(f"jt808/{PHONE}/up", SubOpts(qos=0))])))
+        while not any(isinstance(x, Suback) for x in pkts1):
+            pkts1 += p1.feed(await asyncio.wait_for(r1.read(4096), 5))
+        # register a topic metric on the uplink topic (REST, admin)
+        api_addr = node.mgmt.http.listen_addr
+        from test_ops_tail import http_call, login
+
+        tok = await login(api_addr)
+        st, _ = await http_call(
+            api_addr, "POST", "/api/v5/mqtt/topic_metrics", token=tok,
+            body={"topic": f"jt808/{PHONE}/up"},
+        )
+        assert st == 200
+        # JT808 terminal registers, auths, reports location
+        gw = node.gateways.get("jt808")
+        t = Terminal()
+        await t.connect(gw.listen_addr)
+        await t.send(MC_REGISTER, 1, register_body())
+        ack = await t.recv()
+        await t.send(MC_AUTH, 2, ack["body"][3:])
+        await t.recv()
+        await t.send(MC_LOCATION, 3, location_body())
+        await t.recv()
+        # the MQTT subscriber sees the location uplink
+        pub = await expect_pub_pred(
+            r1, p1, pkts1, lambda x: b'"latitude"' in x.payload)
+        body = json.loads(pub.payload)
+        assert body["body"]["latitude"] == 31_230_000
+        # topic metrics counted it; monitor + swagger live; viewer RBAC
+        st, lst = await http_call(api_addr, "GET",
+                                  "/api/v5/mqtt/topic_metrics", token=tok)
+        assert lst[0]["metrics"]["messages.in"] >= 1
+        st, cur = await http_call(api_addr, "GET", "/api/v5/monitor_current",
+                                  token=tok)
+        assert st == 200 and cur["received_msg"] >= 1
+        st, doc = await http_call(api_addr, "GET", "/api/v5/swagger.json",
+                                  token=tok)
+        assert "/api/v5/mqtt/topic_metrics" in doc["paths"]
+        viewer = node.mgmt.api_keys.create("soak-ro", role="viewer")
+        st, _ = await http_call(
+            api_addr, "POST", "/api/v5/mqtt/topic_metrics",
+            basic=(viewer["api_key"], viewer["api_secret"]),
+            body={"topic": "x/y"},
+        )
+        assert st == 403
+        t.w.close()
+        w1.close()
+    finally:
+        await node.stop()
+        await rsrv.stop()
+
+
+async def expect_pub_pred(r, p, pkts, pred, timeout=5.0):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while True:
+        for x in pkts:
+            if isinstance(x, Publish) and pred(x):
+                return x
+        left = deadline - asyncio.get_running_loop().time()
+        assert left > 0, f"timed out: {pkts}"
+        pkts += p.feed(await asyncio.wait_for(r.read(4096), left))
