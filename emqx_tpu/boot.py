@@ -257,7 +257,9 @@ class Node:
 
         self.listeners = Listeners(broker, config=cfg)
         lconf = cfg.get("listeners")
-        if not any((lconf or {}).get(t) for t in ("tcp", "ssl", "ws", "wss")):
+        if not any(
+            (lconf or {}).get(t) for t in ("tcp", "ssl", "ws", "wss", "quic")
+        ):
             lconf = {"tcp": {"default": {"bind": "0.0.0.0:1883"}}}
         await self.listeners.start_all(lconf)
 

@@ -28,7 +28,7 @@ from .server import Server
 
 log = logging.getLogger("emqx_tpu.listeners")
 
-LISTENER_TYPES = ("tcp", "ssl", "ws", "wss")
+LISTENER_TYPES = ("tcp", "ssl", "ws", "wss", "quic")
 
 
 def parse_bind(bind) -> Tuple[str, int]:
@@ -83,6 +83,50 @@ def zone_mqtt_conf(config, zone: str) -> Dict:
     return out
 
 
+class _QuicListener:
+    """Start/stop facade pairing the UDP endpoint with its MQTT seat
+    so the registry/REST treat quic listeners like any other."""
+
+    def __init__(self, seat: Server, quic):
+        self.seat = seat
+        self.quic = quic
+        self.name = seat.name
+        self.broker = seat.broker
+
+    @property
+    def listen_addr(self):
+        return self.quic.listen_addr
+
+    @property
+    def _conns(self):
+        return self.seat._conns
+
+    @property
+    def evicting(self):
+        return self.seat.evicting
+
+    def evict_hold(self):
+        self.seat.evict_hold()
+
+    def evict_release(self):
+        self.seat.evict_release()
+
+    async def start(self):
+        await self.quic.start()
+        if self.seat not in self.broker.servers:
+            self.broker.servers.append(self.seat)
+
+    async def stop(self):
+        await self.quic.stop()
+        if self.seat in self.broker.servers:
+            self.broker.servers.remove(self.seat)
+        for conn in list(self.seat._conns):
+            try:
+                conn.transport.close()
+            except Exception:
+                pass
+
+
 class Listeners:
     """Named-listener registry over a shared Broker."""
 
@@ -96,6 +140,24 @@ class Listeners:
         if ltype not in LISTENER_TYPES:
             raise ValueError(f"unknown listener type {ltype!r}")
         host, port = parse_bind(conf.get("bind", 0))
+        if ltype == "quic":
+            # MQTT-over-QUIC (emqx_listeners.erl:193-210): the MQTT
+            # runtime seat is a Server that never opens TCP; the QUIC
+            # endpoint owns the UDP socket and feeds it stream-0
+            # transports
+            from .quic import QuicServer
+
+            seat = Server(
+                self.broker,
+                host=host,
+                port=port,
+                name=f"quic:{name}",
+                mountpoint=conf.get("mountpoint", ""),
+                mqtt_conf=zone_mqtt_conf(
+                    self.config, conf.get("zone", "default")
+                ),
+            )
+            return _QuicListener(seat, QuicServer(seat, host, port))
         limits = ListenerLimits(
             max_conn_rate=conf.get("max_conn_rate"),
             messages_rate=conf.get("messages_rate"),

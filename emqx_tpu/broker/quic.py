@@ -1,0 +1,637 @@
+"""QUIC v1 transport (RFC 9000) carrying MQTT on stream 0.
+
+The reference's MQTT-over-QUIC rides the quicer NIF around MsQuic
+(apps/emqx/src/emqx_quic_connection.erl:1-346, emqx_listeners.erl:
+193-210, single-stream mode: one client-initiated bidirectional
+stream carries the MQTT byte stream). No QUIC library ships in this
+image, so the transport is implemented from the RFCs on the
+`cryptography` primitives: packet protection and the TLS 1.3
+handshake live in quic_crypto.py / quic_tls.py; this module is the
+connection machinery — long/short header packets with coalescing,
+CRYPTO / STREAM / ACK / HANDSHAKE_DONE / CONNECTION_CLOSE frames,
+per-space packet numbers, and ordered stream reassembly.
+
+Scope: the profile our endpoints need. In-order-tolerant reassembly
+(offset-keyed buffers) but NO loss recovery timers — QUIC here runs
+datacenter/loopback links where the kernel does not drop; a lost
+datagram surfaces as an idle-timeout disconnect, the same failure
+mode as a dead TCP peer. Flow-control limits are advertised large
+and not enforced. One bidirectional stream (id 0) is served — exactly
+the reference's single-stream mode."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import struct
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .quic_crypto import (
+    DirectionKeys, dec_varint, enc_varint, encode_pn, initial_keys,
+    protect, unprotect,
+)
+from .quic_tls import TlsClient, TlsServer, TlsError
+
+log = logging.getLogger("emqx_tpu.broker.quic")
+
+VERSION_V1 = 0x00000001
+LEVELS = ("initial", "handshake", "app")
+
+FT_PADDING = 0x00
+FT_PING = 0x01
+FT_ACK = 0x02
+FT_CRYPTO = 0x06
+FT_STREAM_BASE = 0x08  # 0x08..0x0f
+FT_MAX_DATA = 0x10
+FT_CONN_CLOSE = 0x1C
+FT_CONN_CLOSE_APP = 0x1D
+FT_HANDSHAKE_DONE = 0x1E
+
+_LONG_TYPE = {"initial": 0x00, "handshake": 0x02}
+
+
+def encode_transport_params(scid: bytes,
+                            odcid: Optional[bytes] = None) -> bytes:
+    def tp(tid: int, val: bytes) -> bytes:
+        return enc_varint(tid) + enc_varint(len(val)) + val
+
+    out = b""
+    if odcid is not None:
+        out += tp(0x00, odcid)  # original_destination_connection_id
+    out += tp(0x01, enc_varint(30_000))  # max_idle_timeout ms
+    out += tp(0x03, enc_varint(65527))  # max_udp_payload_size
+    out += tp(0x04, enc_varint(1 << 25))  # initial_max_data
+    out += tp(0x05, enc_varint(1 << 24))  # max_stream_data bidi local
+    out += tp(0x06, enc_varint(1 << 24))  # bidi remote
+    out += tp(0x07, enc_varint(1 << 24))  # uni
+    out += tp(0x08, enc_varint(16))  # initial_max_streams_bidi
+    out += tp(0x09, enc_varint(16))  # uni
+    out += tp(0x0F, scid)  # initial_source_connection_id
+    return out
+
+
+class _Space:
+    """One packet-number space (initial / handshake / app)."""
+
+    def __init__(self) -> None:
+        self.rx: Optional[DirectionKeys] = None
+        self.tx: Optional[DirectionKeys] = None
+        self.next_pn = 0
+        self.largest_rx = -1
+        self.received: set = set()
+        self.ack_due = False
+        self.crypto_out = b""
+        self.crypto_sent = 0
+        self.crypto_in: Dict[int, bytes] = {}
+        self.crypto_in_off = 0
+
+
+class QuicConnection:
+    """Role-shared connection core. The owner pumps:
+    datagram_received(data) -> None and flush() -> [datagrams]."""
+
+    def __init__(self, is_server: bool, scid: bytes, dcid: bytes):
+        self.is_server = is_server
+        self.scid = scid  # our CID (peer addresses us with this)
+        self.dcid = dcid  # peer's CID
+        self.spaces = {lvl: _Space() for lvl in LEVELS}
+        self.tls = None  # set by subclass
+        self.stream_rx: Dict[int, bytes] = {}
+        self.stream_rx_off = 0
+        self.stream_out = b""
+        self.stream_sent = 0
+        self.stream_fin_rcvd = False
+        self.on_stream_data: Optional[Callable[[bytes], None]] = None
+        self.on_close: Optional[Callable[[], None]] = None
+        self.handshake_done = False
+        self.closed = False
+        self.close_pending: Optional[Tuple[int, str]] = None
+
+    # --- frame/packet building -----------------------------------------
+
+    def _build_packet(self, level: str, frames: bytes) -> bytes:
+        # header protection samples 16 bytes starting 4 bytes past the
+        # pn offset: with a 2-byte pn the ciphertext (payload + 16-byte
+        # tag) must be >= 18, so tiny frames pad up (RFC 9001 §5.4.2)
+        if len(frames) < 3:
+            frames += b"\x00" * (3 - len(frames))
+        sp = self.spaces[level]
+        pn = sp.next_pn
+        sp.next_pn += 1
+        if level == "app":
+            header = bytes([0x41]) + self.dcid + encode_pn(pn)
+            pn_off = 1 + len(self.dcid)
+        else:
+            flags = 0xC1 | (_LONG_TYPE[level] << 4)
+            header = bytes([flags]) + struct.pack(">I", VERSION_V1)
+            header += bytes([len(self.dcid)]) + self.dcid
+            header += bytes([len(self.scid)]) + self.scid
+            if level == "initial":
+                header += enc_varint(0)  # token length
+            header += enc_varint(len(frames) + 2 + 16)  # pn + payload + tag
+            pn_off = len(header)
+            header += encode_pn(pn)
+        return protect(sp.tx, header, pn, frames, pn_off)
+
+    def _ack_frame(self, sp: _Space) -> bytes:
+        largest = sp.largest_rx
+        first = 0
+        while (largest - first - 1) in sp.received:
+            first += 1
+        return (
+            bytes([FT_ACK]) + enc_varint(largest) + enc_varint(0)
+            + enc_varint(0) + enc_varint(first)
+        )
+
+    def _pending_frames(self, level: str) -> bytes:
+        sp = self.spaces[level]
+        out = b""
+        if sp.ack_due and sp.largest_rx >= 0:
+            out += self._ack_frame(sp)
+            sp.ack_due = False
+        if sp.crypto_sent < len(sp.crypto_out):
+            chunk = sp.crypto_out[sp.crypto_sent:]
+            out += (
+                bytes([FT_CRYPTO]) + enc_varint(sp.crypto_sent)
+                + enc_varint(len(chunk)) + chunk
+            )
+            sp.crypto_sent = len(sp.crypto_out)
+        if level == "app":
+            if self.handshake_done and self.is_server and not getattr(
+                self, "_hs_done_sent", False
+            ):
+                out += bytes([FT_HANDSHAKE_DONE])
+                self._hs_done_sent = True
+            if self.stream_sent < len(self.stream_out):
+                chunk = self.stream_out[self.stream_sent:]
+                out += (
+                    bytes([FT_STREAM_BASE | 0x04 | 0x02])  # off+len bits
+                    + enc_varint(0)  # stream 0
+                    + enc_varint(self.stream_sent)
+                    + enc_varint(len(chunk)) + chunk
+                )
+                self.stream_sent = len(self.stream_out)
+            if self.close_pending is not None:
+                code, reason = self.close_pending
+                r = reason.encode()
+                out += (
+                    bytes([FT_CONN_CLOSE_APP]) + enc_varint(code)
+                    + enc_varint(len(r)) + r
+                )
+                self.close_pending = None
+        return out
+
+    def flush(self) -> List[bytes]:
+        """Datagrams ready to send (levels coalesced)."""
+        dgram = b""
+        for level in LEVELS:
+            sp = self.spaces[level]
+            if sp.tx is None:
+                continue
+            frames = self._pending_frames(level)
+            if not frames:
+                continue
+            if level == "initial" and not self.is_server:
+                # client Initials pad the DATAGRAM to >=1200 (RFC 9000
+                # §14.1); header+tag overhead is ~44B, pad with margin
+                need = 1200 - len(frames) - 28
+                if need > 0:
+                    frames += b"\x00" * need
+            dgram += self._build_packet(level, frames)
+        return [dgram] if dgram else []
+
+    # --- receive --------------------------------------------------------
+
+    def datagram_received(self, data: bytes) -> None:
+        off = 0
+        while off < len(data) and not self.closed:
+            consumed = self._packet_received(data[off:])
+            if consumed <= 0:
+                break
+            off += consumed
+
+    def _packet_received(self, data: bytes) -> int:
+        first = data[0]
+        if first & 0x80:  # long header
+            version = struct.unpack_from(">I", data, 1)[0]
+            if version != VERSION_V1:
+                return -1
+            ptype = (first & 0x30) >> 4
+            off = 5
+            dcid_len = data[off]
+            off += 1 + dcid_len
+            scid_len = data[off]
+            peer_scid = data[off + 1 : off + 1 + scid_len]
+            off += 1 + scid_len
+            if ptype == 0:  # initial
+                tok_len, off = dec_varint(data, off)
+                off += tok_len
+                level = "initial"
+            elif ptype == 2:
+                level = "handshake"
+            else:
+                return -1  # 0-RTT/Retry unsupported
+            length, off = dec_varint(data, off)
+            total = off + length
+            if self.dcid == b"" or level == "initial":
+                self.dcid = peer_scid  # latch the peer's CID
+            sp = self.spaces[level]
+            if sp.rx is None:
+                return total
+            try:
+                pn, payload = unprotect(
+                    sp.rx, data[:total], off, sp.largest_rx
+                )
+            except Exception:
+                return total  # undecryptable: drop silently (RFC 9001)
+            self._accept(level, sp, pn, payload)
+            return total
+        # short header: consumes the remainder of the datagram
+        sp = self.spaces["app"]
+        if sp.rx is None:
+            return -1
+        pn_off = 1 + len(self.scid)
+        try:
+            pn, payload = unprotect(sp.rx, data, pn_off, sp.largest_rx)
+        except Exception:
+            return -1
+        self._accept("app", sp, pn, payload)
+        return len(data)
+
+    def _accept(self, level: str, sp: _Space, pn: int, payload: bytes) -> None:
+        if pn in sp.received:
+            return
+        sp.received.add(pn)
+        sp.largest_rx = max(sp.largest_rx, pn)
+        if self._handle_frames(level, payload):
+            sp.ack_due = True
+
+    # --- frames ---------------------------------------------------------
+
+    def _handle_frames(self, level: str, payload: bytes) -> bool:
+        """Returns True if any frame was ack-eliciting."""
+        off = 0
+        eliciting = False
+        n = len(payload)
+        while off < n:
+            ft = payload[off]
+            off += 1
+            if ft == FT_PADDING:
+                continue
+            if ft == FT_PING:
+                eliciting = True
+                continue
+            if ft == FT_ACK:
+                _largest, off = dec_varint(payload, off)
+                _delay, off = dec_varint(payload, off)
+                rc, off = dec_varint(payload, off)
+                _first, off = dec_varint(payload, off)
+                for _ in range(rc):
+                    _gap, off = dec_varint(payload, off)
+                    _rng, off = dec_varint(payload, off)
+                continue
+            if ft == FT_CRYPTO:
+                coff, off = dec_varint(payload, off)
+                clen, off = dec_varint(payload, off)
+                self._crypto_in(level, coff, payload[off : off + clen])
+                off += clen
+                eliciting = True
+                continue
+            if FT_STREAM_BASE <= ft <= 0x0F:
+                sid, off = dec_varint(payload, off)
+                s_off = 0
+                if ft & 0x04:
+                    s_off, off = dec_varint(payload, off)
+                if ft & 0x02:
+                    slen, off = dec_varint(payload, off)
+                else:
+                    slen = n - off
+                data = payload[off : off + slen]
+                off += slen
+                if sid == 0:
+                    self._stream_in(s_off, data, bool(ft & 0x01))
+                eliciting = True
+                continue
+            if ft in (FT_CONN_CLOSE, FT_CONN_CLOSE_APP):
+                code, off = dec_varint(payload, off)
+                if ft == FT_CONN_CLOSE:
+                    _ft2, off = dec_varint(payload, off)
+                rlen, off = dec_varint(payload, off)
+                off += rlen
+                self._closed_by_peer()
+                continue
+            if ft == FT_HANDSHAKE_DONE:
+                self.handshake_done = True
+                eliciting = True
+                continue
+            if ft in (FT_MAX_DATA, 0x11, 0x12, 0x13):
+                _v, off = dec_varint(payload, off)
+                if ft == 0x11:
+                    _v2, off = dec_varint(payload, off)
+                eliciting = True
+                continue
+            if ft in (0x18,):  # NEW_CONNECTION_ID: skip fields
+                _seq, off = dec_varint(payload, off)
+                _rpt, off = dec_varint(payload, off)
+                cl = payload[off]
+                off += 1 + cl + 16
+                eliciting = True
+                continue
+            log.debug("quic: ignoring unknown frame 0x%02x", ft)
+            return eliciting
+        return eliciting
+
+    def _crypto_in(self, level: str, coff: int, data: bytes) -> None:
+        sp = self.spaces[level]
+        sp.crypto_in[coff] = data
+        out = b""
+        while sp.crypto_in_off in sp.crypto_in:
+            chunk = sp.crypto_in.pop(sp.crypto_in_off)
+            out += chunk
+            sp.crypto_in_off += len(chunk)
+        if out:
+            try:
+                self._tls_input(level, out)
+            except TlsError as e:
+                log.warning("quic tls failure: %s", e)
+                self.close(0x0128, str(e))
+
+    def _stream_in(self, s_off: int, data: bytes, fin: bool) -> None:
+        self.stream_rx[s_off] = data
+        out = b""
+        while self.stream_rx_off in self.stream_rx:
+            chunk = self.stream_rx.pop(self.stream_rx_off)
+            out += chunk
+            self.stream_rx_off += len(chunk)
+        if out and self.on_stream_data is not None:
+            self.on_stream_data(out)
+        if fin:
+            self.stream_fin_rcvd = True
+            self._closed_by_peer()
+
+    def _closed_by_peer(self) -> None:
+        if not self.closed:
+            self.closed = True
+            if self.on_close is not None:
+                self.on_close()
+
+    # --- app API ---------------------------------------------------------
+
+    def send_stream(self, data: bytes) -> None:
+        self.stream_out += data
+
+    def close(self, code: int = 0, reason: str = "") -> None:
+        if not self.closed:
+            self.close_pending = (code, reason)
+
+    def _tls_input(self, level: str, data: bytes) -> None:
+        raise NotImplementedError
+
+
+class ServerConnection(QuicConnection):
+    def __init__(self, odcid: bytes):
+        super().__init__(True, scid=os.urandom(8), dcid=b"")
+        sp = self.spaces["initial"]
+        sp.rx, sp.tx = initial_keys(odcid, is_server=True)
+        self.tls = TlsServer(
+            encode_transport_params(self.scid, odcid=odcid)
+        )
+
+    def _tls_input(self, level: str, data: bytes) -> None:
+        if level == "initial":
+            for lvl, out in self.tls.feed_initial(data):
+                self.spaces[lvl].crypto_out += out
+            if self.tls.server_hs_secret is not None:
+                hs = self.spaces["handshake"]
+                hs.rx = DirectionKeys(self.tls.client_hs_secret)
+                hs.tx = DirectionKeys(self.tls.server_hs_secret)
+                app = self.spaces["app"]
+                app.rx = DirectionKeys(self.tls.client_app_secret)
+                app.tx = DirectionKeys(self.tls.server_app_secret)
+        elif level == "handshake":
+            self.tls.feed_handshake(data)
+            if self.tls.handshake_complete:
+                self.handshake_done = True
+
+
+class ClientConnection(QuicConnection):
+    def __init__(self):
+        odcid = os.urandom(8)
+        super().__init__(False, scid=os.urandom(8), dcid=odcid)
+        sp = self.spaces["initial"]
+        sp.rx, sp.tx = initial_keys(odcid, is_server=False)
+        self.tls = TlsClient(encode_transport_params(self.scid))
+        sp.crypto_out += self.tls.client_hello()
+
+    def _tls_input(self, level: str, data: bytes) -> None:
+        if level == "initial":
+            self.tls.feed_initial(data)
+            if self.tls.client_hs_secret is not None:
+                hs = self.spaces["handshake"]
+                hs.rx = DirectionKeys(self.tls.server_hs_secret)
+                hs.tx = DirectionKeys(self.tls.client_hs_secret)
+        elif level == "handshake":
+            fin = self.tls.feed_handshake(data)
+            if fin is not None:
+                self.spaces["handshake"].crypto_out += fin
+                app = self.spaces["app"]
+                app.rx = DirectionKeys(self.tls.server_app_secret)
+                app.tx = DirectionKeys(self.tls.client_app_secret)
+
+
+# --- UDP endpoints ---------------------------------------------------------
+
+
+def _dgram_dcid(data: bytes) -> Optional[bytes]:
+    """Destination CID of a datagram's first packet (routing key)."""
+    try:
+        if data[0] & 0x80:
+            ln = data[5]
+            return bytes(data[6 : 6 + ln])
+        return bytes(data[1:9])  # our CIDs are always 8 bytes
+    except IndexError:
+        return None
+
+
+class QuicStreamTransport:
+    """Adapts stream 0 of a QUIC connection to the byte-stream
+    transport contract the MQTT Connection runtime uses (read/write/
+    drain/close/peername) — the quicer single-stream mode."""
+
+    quic = True
+
+    def __init__(self, conn: "ServerConnection", endpoint, addr):
+        self.conn = conn
+        self.endpoint = endpoint
+        self.addr = addr
+        self._q: asyncio.Queue = asyncio.Queue()
+        conn.on_stream_data = self._q.put_nowait
+        conn.on_close = lambda: self._q.put_nowait(b"")
+
+    def peername(self):
+        return self.addr
+
+    async def read(self) -> bytes:
+        if self.conn.closed and self._q.empty():
+            return b""
+        return await self._q.get()
+
+    def write(self, data: bytes) -> None:
+        self.conn.send_stream(data)
+        self.endpoint.kick(self.conn)
+
+    async def drain(self) -> None:
+        self.endpoint.kick(self.conn)
+
+    def close(self) -> None:
+        if not self.conn.closed:
+            self.conn.close(0, "server closed")
+            self.endpoint.kick(self.conn)
+            self.conn.closed = True
+        self._q.put_nowait(b"")
+
+
+class _QuicServerProtocol(asyncio.DatagramProtocol):
+    def __init__(self, server: "QuicServer"):
+        self.server = server
+
+    def connection_made(self, transport):
+        self.server._udp = transport
+
+    def datagram_received(self, data, addr):
+        try:
+            self.server._on_datagram(data, addr)
+        except Exception:
+            log.exception("quic datagram crashed")
+
+
+class QuicServer:
+    """MQTT-over-QUIC listener: owns the UDP socket, routes datagrams
+    to connections by CID, and hands handshaken connections to the
+    MQTT Connection runtime of an ordinary `Server` (emqx_listeners
+    quic listener analog)."""
+
+    def __init__(self, mqtt_server, host: str = "0.0.0.0", port: int = 14567):
+        self.mqtt = mqtt_server  # a broker Server (never TCP-started)
+        self.host, self.port = host, port
+        self._udp = None
+        self.listen_addr = None
+        self.conns: Dict[bytes, ServerConnection] = {}
+        self._addr: Dict[bytes, tuple] = {}  # scid -> last peer addr
+        self._started: set = set()
+
+    async def start(self) -> None:
+        loop = asyncio.get_running_loop()
+        await loop.create_datagram_endpoint(
+            lambda: _QuicServerProtocol(self),
+            local_addr=(self.host, self.port),
+        )
+        self.listen_addr = self._udp.get_extra_info("sockname")[:2]
+        log.info("quic listening on %s", self.listen_addr)
+
+    async def stop(self) -> None:
+        for conn in set(self.conns.values()):
+            conn.close(0, "listener stopped")
+            self.kick(conn)
+        if self._udp is not None:
+            self._udp.close()
+            self._udp = None
+
+    def kick(self, conn: "ServerConnection") -> None:
+        addr = self._addr.get(conn.scid)
+        if addr is None or self._udp is None:
+            return
+        for dgram in conn.flush():
+            self._udp.sendto(dgram, addr)
+
+    def _on_datagram(self, data: bytes, addr) -> None:
+        cid = _dgram_dcid(data)
+        if cid is None:
+            return
+        conn = self.conns.get(cid)
+        if conn is None:
+            if not data[0] & 0x80 or len(data) < 1200:
+                return  # only full-size Initials create state
+            conn = ServerConnection(odcid=cid)
+            self.conns[cid] = conn
+            self.conns[conn.scid] = conn
+        self._addr[conn.scid] = addr
+        conn.datagram_received(data)
+        self.kick(conn)
+        if conn.tls.handshake_complete and conn.scid not in self._started:
+            self._started.add(conn.scid)
+            transport = QuicStreamTransport(conn, self, addr)
+            from .server import Connection
+
+            mqtt_conn = Connection(self.mqtt, transport)
+            self.mqtt._conns.add(mqtt_conn)
+
+            async def run():
+                try:
+                    await mqtt_conn.run()
+                finally:
+                    self.mqtt._conns.discard(mqtt_conn)
+                    self.conns.pop(conn.scid, None)
+                    for k in [
+                        k for k, v in self.conns.items() if v is conn
+                    ]:
+                        self.conns.pop(k, None)
+
+            asyncio.ensure_future(run())
+
+
+class QuicClientEndpoint:
+    """Client seam: UDP socket + ClientConnection + handshake pump.
+    recv() yields ordered stream-0 bytes (the MQTT byte stream)."""
+
+    def __init__(self):
+        self.conn = ClientConnection()
+        self._udp = None
+        self.addr = None
+        self._q: asyncio.Queue = asyncio.Queue()
+        self.conn.on_stream_data = self._q.put_nowait
+        self.conn.on_close = lambda: self._q.put_nowait(b"")
+
+    async def connect(self, host: str, port: int, timeout: float = 5.0):
+        loop = asyncio.get_running_loop()
+        outer = self
+
+        class P(asyncio.DatagramProtocol):
+            def connection_made(self, tr):
+                outer._udp = tr
+
+            def datagram_received(self, data, _addr):
+                outer.conn.datagram_received(data)
+                outer._flush()
+
+        await loop.create_datagram_endpoint(P, remote_addr=(host, port))
+        self.addr = (host, port)
+        self._flush()  # ships the Initial (client hello)
+        deadline = loop.time() + timeout
+        while not self.conn.handshake_done:
+            if loop.time() > deadline:
+                raise TimeoutError("quic handshake timed out")
+            await asyncio.sleep(0.005)
+            self._flush()
+        return self
+
+    def _flush(self) -> None:
+        if self._udp is None:
+            return
+        for dgram in self.conn.flush():
+            self._udp.sendto(dgram)
+
+    def send(self, data: bytes) -> None:
+        self.conn.send_stream(data)
+        self._flush()
+
+    async def recv(self, timeout: float = 5.0) -> bytes:
+        return await asyncio.wait_for(self._q.get(), timeout)
+
+    def close(self) -> None:
+        self.conn.close(0, "client done")
+        self._flush()
+        if self._udp is not None:
+            self._udp.close()
+            self._udp = None

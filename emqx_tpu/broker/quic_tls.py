@@ -1,0 +1,402 @@
+"""Minimal TLS 1.3 handshake for QUIC (RFC 8446 + RFC 9001 §4).
+
+Covers exactly the profile our two endpoints negotiate:
+TLS_AES_128_GCM_SHA256, x25519, ecdsa_secp256r1_sha256 self-signed
+server certificates (generated at runtime), ALPN, and the QUIC
+transport_parameters extension (0x39) carried opaquely. Handshake
+messages flow through QUIC CRYPTO frames — this module only builds/
+consumes the TLS byte stream and hands traffic secrets back to the
+connection layer at each level switch.
+
+Client certificates, HelloRetryRequest, PSK/resumption, and any other
+cipher/group are out of scope; an endpoint offering only those gets a
+clean handshake failure."""
+
+from __future__ import annotations
+
+import datetime
+import os
+import struct
+from typing import Dict, List, Optional, Tuple
+
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.hazmat.primitives.asymmetric.x25519 import (
+    X25519PrivateKey, X25519PublicKey,
+)
+from cryptography.hazmat.primitives.hashes import SHA256
+from cryptography.hazmat.primitives.serialization import (
+    Encoding, PublicFormat,
+)
+from cryptography import x509
+from cryptography.x509.oid import NameOID
+
+from .quic_crypto import (
+    KeySchedule, cert_verify_content, finished_verify,
+)
+
+TLS_AES_128_GCM_SHA256 = 0x1301
+GROUP_X25519 = 0x001D
+SIG_ECDSA_P256 = 0x0403
+
+HS_CLIENT_HELLO = 1
+HS_SERVER_HELLO = 2
+HS_ENCRYPTED_EXTENSIONS = 8
+HS_CERTIFICATE = 11
+HS_CERTIFICATE_VERIFY = 15
+HS_FINISHED = 20
+
+EXT_SERVER_NAME = 0
+EXT_SUPPORTED_GROUPS = 10
+EXT_SIG_ALGS = 13
+EXT_ALPN = 16
+EXT_SUPPORTED_VERSIONS = 43
+EXT_KEY_SHARE = 51
+EXT_QUIC_TP = 0x39
+
+TLS13 = 0x0304
+
+
+class TlsError(Exception):
+    pass
+
+
+def _u16(v: int) -> bytes:
+    return struct.pack(">H", v)
+
+
+def _vec(data: bytes, n: int) -> bytes:
+    return len(data).to_bytes(n, "big") + data
+
+
+def _hs_msg(t: int, body: bytes) -> bytes:
+    return bytes([t]) + len(body).to_bytes(3, "big") + body
+
+
+def _exts(pairs: List[Tuple[int, bytes]]) -> bytes:
+    out = b"".join(_u16(t) + _vec(v, 2) for t, v in pairs)
+    return _vec(out, 2)
+
+
+def _parse_exts(data: bytes) -> Dict[int, bytes]:
+    (total,) = struct.unpack_from(">H", data, 0)
+    off = 2
+    end = 2 + total
+    out = {}
+    while off < end:
+        t, ln = struct.unpack_from(">HH", data, off)
+        off += 4
+        out[t] = data[off : off + ln]
+        off += ln
+    return out
+
+
+def make_server_cert():
+    """Runtime self-signed EC P-256 certificate (the test/dev story;
+    production feeds PEMs through the listener config)."""
+    key = ec.generate_private_key(ec.SECP256R1())
+    name = x509.Name(
+        [x509.NameAttribute(NameOID.COMMON_NAME, "emqx-tpu")]
+    )
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name).issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(days=1))
+        .not_valid_after(now + datetime.timedelta(days=365))
+        .sign(key, SHA256())
+    )
+    return key, cert.public_bytes(Encoding.DER)
+
+
+class _MsgBuf:
+    """Reassembles TLS handshake messages from the CRYPTO stream."""
+
+    def __init__(self) -> None:
+        self.buf = b""
+
+    def feed(self, data: bytes) -> List[Tuple[int, bytes, bytes]]:
+        self.buf += data
+        out = []
+        while len(self.buf) >= 4:
+            t = self.buf[0]
+            ln = int.from_bytes(self.buf[1:4], "big")
+            if len(self.buf) < 4 + ln:
+                break
+            raw = self.buf[: 4 + ln]
+            out.append((t, raw[4:], raw))
+            self.buf = self.buf[4 + ln:]
+        return out
+
+
+class TlsServer:
+    """Drives the server handshake. Outputs per call: a list of
+    (level, bytes) to send as CRYPTO data, where level is 'initial' |
+    'handshake'. Secrets surface via the callbacks set by the
+    connection layer."""
+
+    def __init__(self, transport_params: bytes, alpn: str = "mqtt"):
+        self.tp = transport_params
+        self.alpn = alpn
+        self.schedule = KeySchedule()
+        self.transcript = b""
+        self.buf = _MsgBuf()
+        self.priv = X25519PrivateKey.generate()
+        self.cert_key, self.cert_der = make_server_cert()
+        self.client_hs_secret = None
+        self.server_hs_secret = None
+        self.client_app_secret = None
+        self.server_app_secret = None
+        self.peer_transport_params: Optional[bytes] = None
+        self.alpn_selected: Optional[str] = None
+        self.handshake_complete = False
+        self._sent_flight = False
+
+    # --- client hello -> full server flight ---------------------------
+
+    def feed_initial(self, data: bytes) -> List[Tuple[str, bytes]]:
+        out: List[Tuple[str, bytes]] = []
+        for t, body, raw in self.buf.feed(data):
+            if t != HS_CLIENT_HELLO or self._sent_flight:
+                raise TlsError(f"unexpected handshake message {t}")
+            self.transcript += raw
+            out += self._server_flight(body)
+        return out
+
+    def _server_flight(self, ch: bytes) -> List[Tuple[str, bytes]]:
+        off = 2 + 32  # legacy_version + random
+        sid_len = ch[off]
+        session_id = ch[off + 1 : off + 1 + sid_len]
+        off += 1 + sid_len
+        (cs_len,) = struct.unpack_from(">H", ch, off)
+        suites = [
+            struct.unpack_from(">H", ch, off + 2 + i)[0]
+            for i in range(0, cs_len, 2)
+        ]
+        off += 2 + cs_len
+        off += 1 + ch[off]  # compression methods
+        exts = _parse_exts(ch[off:])
+        if TLS_AES_128_GCM_SHA256 not in suites:
+            raise TlsError("no common cipher suite")
+        sv = exts.get(EXT_SUPPORTED_VERSIONS, b"")
+        if TLS13 not in [
+            struct.unpack_from(">H", sv, 1 + i)[0]
+            for i in range(0, sv[0] if sv else 0, 2)
+        ]:
+            raise TlsError("client does not offer TLS 1.3")
+        ks = exts.get(EXT_KEY_SHARE)
+        if ks is None:
+            raise TlsError("no key_share")
+        (ks_total,) = struct.unpack_from(">H", ks, 0)
+        p = 2
+        client_pub = None
+        while p < 2 + ks_total:
+            grp, ln = struct.unpack_from(">HH", ks, p)
+            p += 4
+            if grp == GROUP_X25519:
+                client_pub = ks[p : p + ln]
+            p += ln
+        if client_pub is None:
+            raise TlsError("no x25519 key share")
+        if EXT_QUIC_TP in exts:
+            self.peer_transport_params = exts[EXT_QUIC_TP]
+        alpn_ext = exts.get(EXT_ALPN)
+        if alpn_ext is not None:
+            (al_total,) = struct.unpack_from(">H", alpn_ext, 0)
+            p = 2
+            offered = []
+            while p < 2 + al_total:
+                ln = alpn_ext[p]
+                offered.append(alpn_ext[p + 1 : p + 1 + ln].decode())
+                p += 1 + ln
+            if self.alpn not in offered:
+                raise TlsError(f"no common ALPN in {offered}")
+            self.alpn_selected = self.alpn
+
+        ecdhe = self.priv.exchange(X25519PublicKey.from_public_bytes(client_pub))
+        self.schedule.handshake(ecdhe)
+
+        my_pub = self.priv.public_key().public_bytes(
+            Encoding.Raw, PublicFormat.Raw
+        )
+        sh_body = (
+            _u16(0x0303) + os.urandom(32) + _vec(session_id, 1)
+            + _u16(TLS_AES_128_GCM_SHA256) + b"\x00"
+            + _exts([
+                (EXT_SUPPORTED_VERSIONS, _u16(TLS13)),
+                (EXT_KEY_SHARE, _u16(GROUP_X25519) + _vec(my_pub, 2)),
+            ])
+        )
+        sh = _hs_msg(HS_SERVER_HELLO, sh_body)
+        self.transcript += sh
+        c_hs, s_hs = self.schedule.hs_traffic(self.transcript)
+        self.client_hs_secret, self.server_hs_secret = c_hs, s_hs
+
+        ee_pairs = [(EXT_QUIC_TP, self.tp)]
+        if self.alpn_selected:
+            a = self.alpn_selected.encode()
+            ee_pairs.insert(0, (EXT_ALPN, _vec(_vec(a, 1), 2)))
+        ee = _hs_msg(HS_ENCRYPTED_EXTENSIONS, _exts(ee_pairs))
+        self.transcript += ee
+        cert = _hs_msg(
+            HS_CERTIFICATE,
+            b"\x00" + _vec(_vec(self.cert_der, 3) + _u16(0), 3),
+        )
+        self.transcript += cert
+        sig = self.cert_key.sign(
+            cert_verify_content(self.transcript), ec.ECDSA(SHA256())
+        )
+        cv = _hs_msg(HS_CERTIFICATE_VERIFY, _u16(SIG_ECDSA_P256) + _vec(sig, 2))
+        self.transcript += cv
+        fin = _hs_msg(
+            HS_FINISHED, finished_verify(s_hs, self.transcript)
+        )
+        self.transcript += fin
+        # application secrets derive from the transcript through the
+        # server Finished (RFC 8446 §7.1)
+        self.schedule.derive_master()
+        self.client_app_secret, self.server_app_secret = (
+            self.schedule.app_traffic(self.transcript)
+        )
+        self._sent_flight = True
+        return [("initial", sh), ("handshake", ee + cert + cv + fin)]
+
+    # --- client finished ------------------------------------------------
+
+    def feed_handshake(self, data: bytes) -> None:
+        for t, body, raw in self.buf.feed(data):
+            if t != HS_FINISHED:
+                raise TlsError(f"unexpected handshake message {t}")
+            want = finished_verify(self.client_hs_secret, self.transcript)
+            if body != want:
+                raise TlsError("bad client Finished")
+            self.transcript += raw
+            self.handshake_complete = True
+
+
+class TlsClient:
+    """Client side (the in-repo MQTT-over-QUIC client + tests)."""
+
+    def __init__(self, transport_params: bytes, alpn: str = "mqtt",
+                 server_name: str = "emqx-tpu"):
+        self.tp = transport_params
+        self.alpn = alpn
+        self.server_name = server_name
+        self.schedule = KeySchedule()
+        self.transcript = b""
+        self.buf = _MsgBuf()
+        self.priv = X25519PrivateKey.generate()
+        self.client_hs_secret = None
+        self.server_hs_secret = None
+        self.client_app_secret = None
+        self.server_app_secret = None
+        self.peer_transport_params: Optional[bytes] = None
+        self.handshake_complete = False
+        self._fin_out: Optional[bytes] = None
+
+    def client_hello(self) -> bytes:
+        my_pub = self.priv.public_key().public_bytes(
+            Encoding.Raw, PublicFormat.Raw
+        )
+        sni = _vec(_vec(b"\x00" + _vec(self.server_name.encode(), 2), 2)[2:], 2)
+        a = self.alpn.encode()
+        body = (
+            _u16(0x0303) + os.urandom(32) + _vec(b"", 1)
+            + _vec(_u16(TLS_AES_128_GCM_SHA256), 2) + _vec(b"\x00", 1)
+            + _exts([
+                (EXT_SERVER_NAME, sni),
+                (EXT_SUPPORTED_GROUPS, _vec(_u16(GROUP_X25519), 2)),
+                (EXT_SIG_ALGS, _vec(_u16(SIG_ECDSA_P256), 2)),
+                (EXT_SUPPORTED_VERSIONS, b"\x02" + _u16(TLS13)),
+                (EXT_ALPN, _vec(_vec(a, 1), 2)),
+                (EXT_KEY_SHARE, _vec(_u16(GROUP_X25519) + _vec(my_pub, 2), 2)),
+                (EXT_QUIC_TP, self.tp),
+            ])
+        )
+        ch = _hs_msg(HS_CLIENT_HELLO, body)
+        self.transcript += ch
+        return ch
+
+    def feed_initial(self, data: bytes) -> None:
+        for t, body, raw in self.buf.feed(data):
+            if t != HS_SERVER_HELLO:
+                raise TlsError(f"unexpected message {t} in initial")
+            self._on_server_hello(body, raw)
+
+    def _on_server_hello(self, sh: bytes, raw: bytes) -> None:
+        off = 2 + 32
+        off += 1 + sh[off]  # session id echo
+        (suite,) = struct.unpack_from(">H", sh, off)
+        if suite != TLS_AES_128_GCM_SHA256:
+            raise TlsError("server chose unsupported suite")
+        off += 3  # suite + compression
+        exts = _parse_exts(sh[off:])
+        ks = exts.get(EXT_KEY_SHARE)
+        if ks is None:
+            raise TlsError("server sent no key_share")
+        grp, ln = struct.unpack_from(">HH", ks, 0)
+        if grp != GROUP_X25519:
+            raise TlsError("server chose unsupported group")
+        server_pub = ks[4 : 4 + ln]
+        self.transcript += raw
+        ecdhe = self.priv.exchange(
+            X25519PublicKey.from_public_bytes(server_pub)
+        )
+        self.schedule.handshake(ecdhe)
+        self.client_hs_secret, self.server_hs_secret = (
+            self.schedule.hs_traffic(self.transcript)
+        )
+
+    def feed_handshake(self, data: bytes) -> Optional[bytes]:
+        """Returns the client Finished bytes once the server flight
+        fully verified (send at handshake level), else None."""
+        for t, body, raw in self.buf.feed(data):
+            if t == HS_ENCRYPTED_EXTENSIONS:
+                exts = _parse_exts(body)
+                if EXT_QUIC_TP in exts:
+                    self.peer_transport_params = exts[EXT_QUIC_TP]
+                self.transcript += raw
+            elif t == HS_CERTIFICATE:
+                # self-signed dev certs: presence checked, chain trust
+                # is the deployment's concern (reference: verify none
+                # by default on quic listeners)
+                self.transcript += raw
+                self._cert_raw = raw
+            elif t == HS_CERTIFICATE_VERIFY:
+                (alg,) = struct.unpack_from(">H", body, 0)
+                if alg != SIG_ECDSA_P256:
+                    raise TlsError("unsupported CertificateVerify alg")
+                # signature covers the transcript UP TO Certificate
+                content = cert_verify_content(self.transcript)
+                (slen,) = struct.unpack_from(">H", body, 2)
+                sig = body[4 : 4 + slen]
+                cert_body = self._cert_raw[4:]
+                (clen,) = (int.from_bytes(cert_body[1:4], "big"),)
+                der = cert_body[4 + 3 : 4 + 3 + int.from_bytes(
+                    cert_body[4:7], "big"
+                )]
+                from cryptography.x509 import load_der_x509_certificate
+
+                cert = load_der_x509_certificate(der)
+                cert.public_key().verify(sig, content, ec.ECDSA(SHA256()))
+                self.transcript += raw
+            elif t == HS_FINISHED:
+                want = finished_verify(self.server_hs_secret, self.transcript)
+                if body != want:
+                    raise TlsError("bad server Finished")
+                self.transcript += raw
+                self.schedule.derive_master()
+                self.client_app_secret, self.server_app_secret = (
+                    self.schedule.app_traffic(self.transcript)
+                )
+                fin = _hs_msg(
+                    HS_FINISHED,
+                    finished_verify(self.client_hs_secret, self.transcript),
+                )
+                self.transcript += fin
+                self.handshake_complete = True
+                return fin
+            else:
+                raise TlsError(f"unexpected message {t} in handshake")
+        return None

@@ -147,6 +147,7 @@ def broker_schema() -> Struct:
                         "ssl": Field(Map(listener_struct()), default={}),
                         "ws": Field(Map(listener_struct()), default={}),
                         "wss": Field(Map(listener_struct()), default={}),
+                        "quic": Field(Map(listener_struct()), default={}),
                     }
                 )
             ),

@@ -1,0 +1,210 @@
+"""MQTT-over-QUIC: RFC-vector crypto checks, TLS 1.3 loopback, and a
+full CONNECT/SUBSCRIBE/PUBLISH round trip over real UDP datagrams.
+
+Ref: apps/emqx/src/emqx_quic_connection.erl (quicer single-stream
+mode), emqx_listeners.erl:193-210; wire per RFC 9000/9001/8446.
+"""
+
+import asyncio
+import os
+
+import pytest
+
+from emqx_tpu.broker import frame
+from emqx_tpu.broker.packet import (
+    Connack, Connect, Publish, Suback, Subscribe, SubOpts,
+)
+from emqx_tpu.broker.pubsub import Broker
+from emqx_tpu.broker.quic import (
+    ClientConnection, QuicClientEndpoint, QuicServer, ServerConnection,
+)
+from emqx_tpu.broker.quic_crypto import (
+    encode_pn, enc_varint, initial_keys, protect, unprotect,
+)
+from emqx_tpu.broker.quic_tls import TlsClient, TlsServer
+from emqx_tpu.broker.server import Server
+
+
+def test_initial_secrets_match_rfc9001_vectors():
+    """RFC 9001 Appendix A.1: client initial keys for DCID
+    0x8394c8f03e515708."""
+    rx, _tx = initial_keys(bytes.fromhex("8394c8f03e515708"), is_server=True)
+    assert rx.key.hex() == "1f369613dd76d5467730efcbe3b1a22d"
+    assert rx.iv.hex() == "fa044b2f42a3fd3b46fb255c"
+    assert rx.hp.hex() == "9f50449e04a0e810283a1e9933adedd2"
+
+
+def test_packet_protection_roundtrip_and_tamper():
+    dcid = os.urandom(8)
+    _rx, tx = initial_keys(dcid, is_server=True)
+    hdr = (bytes([0xC1]) + b"\x00\x00\x00\x01" + bytes([8]) + dcid
+           + bytes([0]) + enc_varint(300) + encode_pn(5))
+    pn_off = len(hdr) - 2
+    payload = os.urandom(200)
+    pkt = protect(tx, hdr, 5, payload, pn_off)
+    pn, out = unprotect(tx, pkt, pn_off, 4)
+    assert (pn, out) == (5, payload)
+    bad = bytearray(pkt)
+    bad[-1] ^= 1
+    with pytest.raises(Exception):
+        unprotect(tx, bytes(bad), pn_off, 4)
+
+
+def test_tls13_loopback_and_transport_params():
+    srv = TlsServer(transport_params=b"SP")
+    cli = TlsClient(transport_params=b"CP")
+    flight = srv.feed_initial(cli.client_hello())
+    cli.feed_initial(flight[0][1])
+    fin = cli.feed_handshake(flight[1][1])
+    srv.feed_handshake(fin)
+    assert srv.handshake_complete and cli.handshake_complete
+    assert srv.client_app_secret == cli.client_app_secret
+    assert srv.server_app_secret == cli.server_app_secret
+    assert (srv.peer_transport_params, cli.peer_transport_params) == (
+        b"CP", b"SP",
+    )
+    assert srv.alpn_selected == "mqtt"
+
+
+def test_quic_inmemory_stream_exchange():
+    cli = ClientConnection()
+    srv = ServerConnection(odcid=cli.dcid)
+    got_s, got_c = [], []
+    srv.on_stream_data = got_s.append
+    cli.on_stream_data = got_c.append
+
+    def pump():
+        for _ in range(10):
+            moved = False
+            for d in cli.flush():
+                srv.datagram_received(d)
+                moved = True
+            for d in srv.flush():
+                cli.datagram_received(d)
+                moved = True
+            if not moved:
+                return
+
+    pump()
+    assert cli.handshake_done and srv.tls.handshake_complete
+    cli.send_stream(b"a" * 5000)  # bigger than one MTU-ish chunk
+    pump()
+    assert b"".join(got_s) == b"a" * 5000
+    srv.send_stream(b"pong")
+    pump()
+    assert got_c == [b"pong"]
+
+
+@pytest.mark.asyncio
+async def test_mqtt_over_quic_end_to_end():
+    """CONNECT/SUBSCRIBE over QUIC; a TCP client's publish arrives at
+    the QUIC subscriber through the same broker."""
+    broker = Broker()
+    tcp = Server(broker, host="127.0.0.1", port=0)
+    await tcp.start()
+    mqtt_seat = Server(broker, host="127.0.0.1", port=0, name="quic:default")
+    quic = QuicServer(mqtt_seat, host="127.0.0.1", port=0)
+    await quic.start()
+    try:
+        ep = await QuicClientEndpoint().connect(*quic.listen_addr)
+        parser = frame.Parser(proto_ver=4)
+        pkts = []
+
+        async def read_pkt():
+            while not pkts:
+                pkts.extend(parser.feed(await ep.recv()))
+            return pkts.pop(0)
+
+        ep.send(frame.serialize(Connect(client_id="q1", proto_ver=4)))
+        ack = await read_pkt()
+        assert isinstance(ack, Connack) and ack.code == 0
+        ep.send(frame.serialize(
+            Subscribe(packet_id=1, filters=[("q/+", SubOpts(qos=0))])
+        ))
+        suback = await read_pkt()
+        assert isinstance(suback, Suback)
+        # TCP publisher on the same broker
+        r, w = await asyncio.open_connection("127.0.0.1", tcp.listen_addr[1])
+        w.write(frame.serialize(Connect(client_id="t1", proto_ver=4)))
+        await w.drain()
+        await asyncio.sleep(0.1)
+        w.write(frame.serialize(
+            Publish(topic="q/hello", payload=b"over-quic", qos=0)
+        ))
+        await w.drain()
+        pub = await read_pkt()
+        assert isinstance(pub, Publish)
+        assert (pub.topic, pub.payload) == ("q/hello", b"over-quic")
+        # QUIC-side publish reaches nobody but counts through the
+        # normal broker path (no subscriber on the topic)
+        ep.send(frame.serialize(Publish(topic="t/x", payload=b"up", qos=0)))
+        await asyncio.sleep(0.1)
+        assert broker.metrics.val("messages.received") >= 2
+        assert broker.sessions["q1"].connected
+        ep.close()
+        await asyncio.sleep(0.1)
+        w.close()
+    finally:
+        await quic.stop()
+        await tcp.stop()
+
+
+@pytest.mark.asyncio
+async def test_quic_garbage_and_short_datagrams_ignored():
+    broker = Broker()
+    seat = Server(broker, host="127.0.0.1", port=0, name="quic:g")
+    quic = QuicServer(seat, host="127.0.0.1", port=0)
+    await quic.start()
+    try:
+        loop = asyncio.get_running_loop()
+
+        class P(asyncio.DatagramProtocol):
+            pass
+
+        tr, _ = await loop.create_datagram_endpoint(
+            P, remote_addr=quic.listen_addr
+        )
+        tr.sendto(b"\x00")  # not a QUIC packet
+        tr.sendto(b"\xc0" + os.urandom(40))  # undersized "Initial"
+        tr.sendto(os.urandom(1300))  # garbage at full size
+        await asyncio.sleep(0.2)
+        # no connection state leaked from garbage
+        assert quic.conns == {} or all(
+            not c.tls.handshake_complete for c in quic.conns.values()
+        )
+        tr.close()
+    finally:
+        await quic.stop()
+
+
+@pytest.mark.asyncio
+async def test_quic_listener_from_config(tmp_path):
+    """A `listeners.quic` config root boots an MQTT-over-QUIC
+    listener alongside TCP, visible in the listener registry."""
+    import json
+
+    from emqx_tpu.boot import Node
+
+    node = Node(config_text=json.dumps({
+        "node": {"name": "quic-boot@127.0.0.1",
+                 "data_dir": str(tmp_path / "d")},
+        "listeners": {
+            "tcp": {"default": {"bind": "127.0.0.1:0"}},
+            "quic": {"default": {"bind": "127.0.0.1:0"}},
+        },
+    }))
+    await node.start()
+    try:
+        ql = node.listeners.get("quic", "default")
+        assert ql.listen_addr is not None
+        ep = await QuicClientEndpoint().connect(*ql.listen_addr)
+        parser = frame.Parser(proto_ver=4)
+        pkts = []
+        ep.send(frame.serialize(Connect(client_id="qb", proto_ver=4)))
+        while not pkts:
+            pkts.extend(parser.feed(await ep.recv()))
+        assert isinstance(pkts[0], Connack) and pkts[0].code == 0
+        ep.close()
+        await asyncio.sleep(0.1)
+    finally:
+        await node.stop()
