@@ -144,20 +144,48 @@ class Listeners:
             # MQTT-over-QUIC (emqx_listeners.erl:193-210): the MQTT
             # runtime seat is a Server that never opens TCP; the QUIC
             # endpoint owns the UDP socket and feeds it stream-0
-            # transports
+            # transports. Listener limits gate accepts exactly like
+            # the TCP path; certfile/keyfile feed the TLS 1.3 stack.
             from .quic import QuicServer
 
             seat = Server(
                 self.broker,
                 host=host,
                 port=port,
+                limits=ListenerLimits(
+                    max_conn_rate=conf.get("max_conn_rate"),
+                    messages_rate=conf.get("messages_rate"),
+                    bytes_rate=conf.get("bytes_rate"),
+                ),
                 name=f"quic:{name}",
                 mountpoint=conf.get("mountpoint", ""),
                 mqtt_conf=zone_mqtt_conf(
                     self.config, conf.get("zone", "default")
                 ),
+                **(
+                    {"max_packet_size": conf["max_packet_size"]}
+                    if conf.get("max_packet_size")
+                    else {}
+                ),
             )
-            return _QuicListener(seat, QuicServer(seat, host, port))
+            cert = None
+            if conf.get("certfile") and conf.get("keyfile"):
+                from cryptography.hazmat.primitives.serialization import (
+                    load_pem_private_key,
+                )
+                from cryptography.x509 import load_pem_x509_certificate
+                from cryptography.hazmat.primitives.serialization import (
+                    Encoding,
+                )
+
+                with open(conf["keyfile"], "rb") as f:
+                    key = load_pem_private_key(f.read(), password=None)
+                with open(conf["certfile"], "rb") as f:
+                    der = load_pem_x509_certificate(f.read()).public_bytes(
+                        Encoding.DER
+                    )
+                cert = (key, der)
+            return _QuicListener(seat, QuicServer(seat, host, port, cert=cert))
         limits = ListenerLimits(
             max_conn_rate=conf.get("max_conn_rate"),
             messages_rate=conf.get("messages_rate"),

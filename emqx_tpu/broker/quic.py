@@ -61,10 +61,12 @@ def encode_transport_params(scid: bytes,
         out += tp(0x00, odcid)  # original_destination_connection_id
     out += tp(0x01, enc_varint(30_000))  # max_idle_timeout ms
     out += tp(0x03, enc_varint(65527))  # max_udp_payload_size
-    out += tp(0x04, enc_varint(1 << 25))  # initial_max_data
-    out += tp(0x05, enc_varint(1 << 24))  # max_stream_data bidi local
-    out += tp(0x06, enc_varint(1 << 24))  # bidi remote
-    out += tp(0x07, enc_varint(1 << 24))  # uni
+    # credit is never replenished (no MAX_DATA updates), so advertise
+    # the varint maximum — a conformant peer then never stalls on it
+    out += tp(0x04, enc_varint((1 << 60)))  # initial_max_data
+    out += tp(0x05, enc_varint((1 << 60)))  # max_stream_data bidi local
+    out += tp(0x06, enc_varint((1 << 60)))  # bidi remote
+    out += tp(0x07, enc_varint((1 << 60)))  # uni
     out += tp(0x08, enc_varint(16))  # initial_max_streams_bidi
     out += tp(0x09, enc_varint(16))  # uni
     out += tp(0x0F, scid)  # initial_source_connection_id
@@ -99,8 +101,8 @@ class QuicConnection:
         self.tls = None  # set by subclass
         self.stream_rx: Dict[int, bytes] = {}
         self.stream_rx_off = 0
-        self.stream_out = b""
-        self.stream_sent = 0
+        self.stream_out = b""  # unsent suffix only (trimmed on flush)
+        self.stream_sent = 0  # absolute stream offset already sent
         self.stream_fin_rcvd = False
         self.on_stream_data: Optional[Callable[[bytes], None]] = None
         self.on_close: Optional[Callable[[], None]] = None
@@ -157,29 +159,44 @@ class QuicConnection:
                 + enc_varint(len(chunk)) + chunk
             )
             sp.crypto_sent = len(sp.crypto_out)
+        if self.close_pending is not None and level != "app" and (
+            self.spaces["app"].tx is None
+        ):
+            # a handshake-time failure must still tell the peer (RFC
+            # 9000 §10.2.3): transport-level close at this level
+            code, reason = self.close_pending
+            r = reason.encode()[:64]
+            out += (
+                bytes([FT_CONN_CLOSE]) + enc_varint(code) + enc_varint(0)
+                + enc_varint(len(r)) + r
+            )
+            self.close_pending = None
+            self.closed = True
         if level == "app":
             if self.handshake_done and self.is_server and not getattr(
                 self, "_hs_done_sent", False
             ):
                 out += bytes([FT_HANDSHAKE_DONE])
                 self._hs_done_sent = True
-            if self.stream_sent < len(self.stream_out):
-                chunk = self.stream_out[self.stream_sent:]
+            if self.stream_out:
+                chunk = self.stream_out
                 out += (
                     bytes([FT_STREAM_BASE | 0x04 | 0x02])  # off+len bits
                     + enc_varint(0)  # stream 0
                     + enc_varint(self.stream_sent)
                     + enc_varint(len(chunk)) + chunk
                 )
-                self.stream_sent = len(self.stream_out)
+                self.stream_sent += len(chunk)
+                self.stream_out = b""  # trimmed: no unbounded retention
             if self.close_pending is not None:
                 code, reason = self.close_pending
-                r = reason.encode()
+                r = reason.encode()[:64]
                 out += (
                     bytes([FT_CONN_CLOSE_APP]) + enc_varint(code)
                     + enc_varint(len(r)) + r
                 )
                 self.close_pending = None
+                self.closed = True
         return out
 
     def flush(self) -> List[bytes]:
@@ -264,6 +281,11 @@ class QuicConnection:
             return
         sp.received.add(pn)
         sp.largest_rx = max(sp.largest_rx, pn)
+        if len(sp.received) > 256:
+            # acks only describe the contiguous run below largest_rx;
+            # anything 256 behind can never matter again
+            floor = sp.largest_rx - 256
+            sp.received = {p for p in sp.received if p >= floor}
         if self._handle_frames(level, payload):
             sp.ack_due = True
 
@@ -338,8 +360,13 @@ class QuicConnection:
                 off += 1 + cl + 16
                 eliciting = True
                 continue
-            log.debug("quic: ignoring unknown frame 0x%02x", ft)
-            return eliciting
+            # RFC 9000 §12.4: an unknown frame type is a
+            # FRAME_ENCODING_ERROR — fail LOUDLY; silently skipping
+            # would drop coalesced STREAM/CRYPTO data with no
+            # retransmit to recover it
+            log.warning("quic: unknown frame 0x%02x — closing", ft)
+            self.close(0x07, f"unknown frame 0x{ft:02x}")
+            return True
         return eliciting
 
     def _crypto_in(self, level: str, coff: int, data: bytes) -> None:
@@ -390,12 +417,12 @@ class QuicConnection:
 
 
 class ServerConnection(QuicConnection):
-    def __init__(self, odcid: bytes):
+    def __init__(self, odcid: bytes, cert=None):
         super().__init__(True, scid=os.urandom(8), dcid=b"")
         sp = self.spaces["initial"]
         sp.rx, sp.tx = initial_keys(odcid, is_server=True)
         self.tls = TlsServer(
-            encode_transport_params(self.scid, odcid=odcid)
+            encode_transport_params(self.scid, odcid=odcid), cert=cert
         )
 
     def _tls_input(self, level: str, data: bytes) -> None:
@@ -512,7 +539,13 @@ class QuicServer:
     MQTT Connection runtime of an ordinary `Server` (emqx_listeners
     quic listener analog)."""
 
-    def __init__(self, mqtt_server, host: str = "0.0.0.0", port: int = 14567):
+    HANDSHAKE_TIMEOUT = 10.0  # reap pre-handshake conns (spoofed
+    # Initials are cheap to send; state for them must not be)
+
+    def __init__(self, mqtt_server, host: str = "0.0.0.0", port: int = 14567,
+                 cert=None):
+        import time as _time
+
         self.mqtt = mqtt_server  # a broker Server (never TCP-started)
         self.host, self.port = host, port
         self._udp = None
@@ -520,6 +553,14 @@ class QuicServer:
         self.conns: Dict[bytes, ServerConnection] = {}
         self._addr: Dict[bytes, tuple] = {}  # scid -> last peer addr
         self._started: set = set()
+        self._born: Dict[bytes, float] = {}  # scid -> accept time
+        self._now = _time.monotonic
+        # ONE certificate per listener (configurable PEMs or generated
+        # once) — not per connection
+        from .quic_tls import make_server_cert
+
+        self.cert = cert or make_server_cert()
+        self._gc_task = None
 
     async def start(self) -> None:
         loop = asyncio.get_running_loop()
@@ -528,12 +569,42 @@ class QuicServer:
             local_addr=(self.host, self.port),
         )
         self.listen_addr = self._udp.get_extra_info("sockname")[:2]
+        self._gc_task = asyncio.ensure_future(self._gc_loop())
         log.info("quic listening on %s", self.listen_addr)
+
+    async def _gc_loop(self) -> None:
+        while True:
+            try:
+                await asyncio.sleep(min(2.0, self.HANDSHAKE_TIMEOUT / 2))
+                now = self._now()
+                for scid, born in list(self._born.items()):
+                    conn = self.conns.get(scid)
+                    if conn is None:
+                        self._born.pop(scid, None)
+                        continue
+                    if scid in self._started:
+                        continue
+                    if now - born > self.HANDSHAKE_TIMEOUT:
+                        self._forget(conn)
+            except asyncio.CancelledError:
+                return
+            except Exception:
+                log.exception("quic gc crashed")
+
+    def _forget(self, conn: "ServerConnection") -> None:
+        for k in [k for k, v in self.conns.items() if v is conn]:
+            self.conns.pop(k, None)
+        self._addr.pop(conn.scid, None)
+        self._born.pop(conn.scid, None)
+        self._started.discard(conn.scid)
 
     async def stop(self) -> None:
         for conn in set(self.conns.values()):
             conn.close(0, "listener stopped")
             self.kick(conn)
+        if self._gc_task is not None:
+            self._gc_task.cancel()
+            self._gc_task = None
         if self._udp is not None:
             self._udp.close()
             self._udp = None
@@ -553,9 +624,15 @@ class QuicServer:
         if conn is None:
             if not data[0] & 0x80 or len(data) < 1200:
                 return  # only full-size Initials create state
-            conn = ServerConnection(odcid=cid)
+            # accept gates: eviction + the listener's conn-rate bucket,
+            # exactly like the TCP accept path
+            if self.mqtt.evicting or not self.mqtt.limits.accept_allowed():
+                self.mqtt.broker.metrics.inc("listener.conn_rate_limited")
+                return
+            conn = ServerConnection(odcid=cid, cert=self.cert)
             self.conns[cid] = conn
             self.conns[conn.scid] = conn
+            self._born[conn.scid] = self._now()
         self._addr[conn.scid] = addr
         conn.datagram_received(data)
         self.kick(conn)
@@ -572,11 +649,7 @@ class QuicServer:
                     await mqtt_conn.run()
                 finally:
                     self.mqtt._conns.discard(mqtt_conn)
-                    self.conns.pop(conn.scid, None)
-                    for k in [
-                        k for k, v in self.conns.items() if v is conn
-                    ]:
-                        self.conns.pop(k, None)
+                    self._forget(conn)
 
             asyncio.ensure_future(run())
 

@@ -136,14 +136,17 @@ class TlsServer:
     'handshake'. Secrets surface via the callbacks set by the
     connection layer."""
 
-    def __init__(self, transport_params: bytes, alpn: str = "mqtt"):
+    def __init__(self, transport_params: bytes, alpn: str = "mqtt",
+                 cert: Optional[Tuple[object, bytes]] = None):
         self.tp = transport_params
         self.alpn = alpn
         self.schedule = KeySchedule()
         self.transcript = b""
         self.buf = _MsgBuf()
         self.priv = X25519PrivateKey.generate()
-        self.cert_key, self.cert_der = make_server_cert()
+        # cert = (EC private key, DER): shared per listener — per-
+        # connection keygen+signing would hand attackers free CPU burn
+        self.cert_key, self.cert_der = cert or make_server_cert()
         self.client_hs_secret = None
         self.server_hs_secret = None
         self.client_app_secret = None
