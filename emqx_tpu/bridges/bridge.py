@@ -220,4 +220,11 @@ class BridgeRegistry:
         if bridge is None:
             raise ValueError(f"bridge {name!r} not found")
         tpl_env = {**env, **row}
-        bridge.resource.query_async(bridge.render_egress(tpl_env))
+        if getattr(bridge.resource.connector, "wants_env", False):
+            # template-driven connectors (redis/sql/influx) render
+            # their own command/line from the FULL rule env; the
+            # MQTT-shaped egress narrowing would drop clientid/
+            # timestamp/selected columns
+            bridge.resource.query_async(tpl_env)
+        else:
+            bridge.resource.query_async(bridge.render_egress(tpl_env))

@@ -1,0 +1,209 @@
+"""InfluxDB bridge — line protocol over the v2 HTTP write API.
+
+Reference: apps/emqx_bridge_influxdb (influxdb-client behind
+emqx_resource; the `write_syntax` config is a line-protocol template
+rendered per message). Same shape here:
+
+    write_syntax: "metrics,clientid=${clientid} temp=${payload.temp},\\
+                   ok=${payload.ok} ${timestamp}"
+
+Rendering escapes measurement/tag/field-key characters per the line
+protocol (commas, spaces, equals); field VALUES keep their JSON
+types: numbers bare (i-suffixed when the template says <field>i),
+strings quoted with escapes, booleans true/false. Batches join lines
+with newlines into one POST to /api/v2/write?org=..&bucket=.. with
+Token auth — transport failures surface as recoverable so the buffer
+worker retries in order."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import re
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from .resource import Connector, QueryError, RecoverableError, ResourceStatus
+
+log = logging.getLogger("emqx_tpu.bridges.influxdb")
+
+_PLACEHOLDER = re.compile(r"\$\{([^}]+)\}")
+
+
+def _esc_key(s: str) -> str:
+    return s.replace("\\", "\\\\").replace(",", "\\,").replace(
+        " ", "\\ "
+    ).replace("=", "\\=")
+
+
+def _esc_measurement(s: str) -> str:
+    return s.replace("\\", "\\\\").replace(",", "\\,").replace(" ", "\\ ")
+
+
+def _lookup(env: Dict[str, Any], path: str) -> Any:
+    cur: Any = env
+    for seg in path.split("."):
+        if isinstance(cur, (str, bytes)):
+            try:
+                cur = json.loads(cur)
+            except (ValueError, UnicodeDecodeError):
+                return None
+        if isinstance(cur, dict):
+            cur = cur.get(seg)
+        else:
+            return None
+    return cur
+
+
+def _render_field_value(v: Any, int_hint: bool) -> Optional[str]:
+    if v is None:
+        return None
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, int):
+        return f"{v}i" if int_hint else str(v)
+    if isinstance(v, float):
+        return str(v)
+    if isinstance(v, bytes):
+        v = v.decode("utf-8", "replace")
+    s = str(v).replace("\\", "\\\\").replace('"', '\\"')
+    return f'"{s}"'
+
+
+def render_line(write_syntax: str, env: Dict[str, Any]) -> str:
+    """One line-protocol line from a write_syntax template. A field
+    whose placeholder resolves to None is dropped; a line with no
+    fields left raises (Influx rejects field-less points)."""
+    try:
+        head, fields_part, *ts_part = write_syntax.rsplit(" ", 2) if (
+            write_syntax.count(" ") >= 2
+        ) else [*write_syntax.rsplit(" ", 1), ""]
+        if isinstance(ts_part, list) and ts_part and ts_part[0] == "":
+            ts_part = []
+    except ValueError as e:
+        raise QueryError(f"bad write_syntax: {e}") from e
+
+    def sub_key(m):
+        v = _lookup(env, m.group(1))
+        return _esc_key("" if v is None else str(v))
+
+    head_out = _PLACEHOLDER.sub(sub_key, head)
+    fields_out = []
+    for kv in fields_part.split(","):
+        if "=" not in kv:
+            raise QueryError(f"bad field clause {kv!r}")
+        k, expr = kv.split("=", 1)
+        int_hint = expr.endswith("i") and _PLACEHOLDER.fullmatch(expr[:-1]) is not None
+        if int_hint:
+            expr = expr[:-1]
+        m = _PLACEHOLDER.fullmatch(expr)
+        if m:
+            val = _render_field_value(_lookup(env, m.group(1)), int_hint)
+        else:
+            val = _PLACEHOLDER.sub(sub_key, expr)
+        if val is None:
+            continue
+        fields_out.append(f"{_esc_key(k)}={val}")
+    if not fields_out:
+        raise QueryError("no fields resolved for line")
+    line = f"{head_out} {','.join(fields_out)}"
+    if ts_part:
+        ts = _PLACEHOLDER.sub(
+            lambda m: str(_lookup(env, m.group(1)) or ""), ts_part[0]
+        ).strip()
+        if ts:
+            # ms epoch from the broker -> ns line-protocol default
+            line += f" {int(float(ts) * 1_000_000)}"
+    return line
+
+
+class InfluxConnector(Connector):
+    wants_env = True  # line templates render from the full rule env
+    def __init__(
+        self,
+        url: str = "http://127.0.0.1:8086",
+        org: str = "emqx",
+        bucket: str = "mqtt",
+        token: str = "",
+        write_syntax: str = "",
+        timeout: float = 5.0,
+    ) -> None:
+        if not write_syntax:
+            raise ValueError("influxdb bridge needs write_syntax")
+        # template sanity at CONFIG time: a syntactically bad template
+        # must not fail per-message in production. Unresolved
+        # placeholders against the dummy env are fine (real messages
+        # carry the fields); only STRUCTURAL errors reject.
+        try:
+            render_line(write_syntax, {"timestamp": 0, "payload": "{}"})
+        except QueryError as e:
+            if "no fields resolved" not in str(e):
+                raise
+        self.url = url.rstrip("/")
+        self.org, self.bucket, self.token = org, bucket, token
+        self.write_syntax = write_syntax
+        self.timeout = timeout
+
+    def _post(self, path: str, body: bytes) -> int:
+        req = urllib.request.Request(
+            f"{self.url}{path}", data=body,
+            headers={
+                "authorization": f"Token {self.token}",
+                "content-type": "text/plain; charset=utf-8",
+            },
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            return r.status
+
+    async def _write(self, lines: List[str]) -> None:
+        path = f"/api/v2/write?org={self.org}&bucket={self.bucket}"
+        body = "\n".join(lines).encode()
+        loop = asyncio.get_running_loop()
+        try:
+            status = await loop.run_in_executor(None, self._post, path, body)
+        except urllib.error.HTTPError as e:
+            if e.code in (400, 401, 403, 413):
+                raise QueryError(f"influx rejected write: {e.code}") from e
+            raise RecoverableError(f"influx http {e.code}") from e
+        except Exception as e:
+            raise RecoverableError(str(e)) from e
+        if status >= 300:
+            raise RecoverableError(f"influx status {status}")
+
+    async def on_start(self) -> None:
+        st = await self.health_check()
+        if st != ResourceStatus.CONNECTED:
+            raise RecoverableError("influx unreachable")
+
+    async def on_query(self, request: Any) -> None:
+        await self._write([render_line(self.write_syntax, dict(request))])
+
+    async def on_batch_query(self, requests: List[Any]) -> None:
+        lines = []
+        for req in requests:
+            try:
+                lines.append(render_line(self.write_syntax, dict(req)))
+            except QueryError as e:
+                log.warning("influx line dropped: %s", e)
+        if lines:
+            await self._write(lines)
+
+    async def health_check(self) -> ResourceStatus:
+        loop = asyncio.get_running_loop()
+
+        def ping():
+            req = urllib.request.Request(f"{self.url}/ping")
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                return r.status
+
+        try:
+            st = await loop.run_in_executor(None, ping)
+            return (
+                ResourceStatus.CONNECTED
+                if st < 300
+                else ResourceStatus.CONNECTING
+            )
+        except Exception:
+            return ResourceStatus.CONNECTING

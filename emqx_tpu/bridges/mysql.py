@@ -281,6 +281,8 @@ class MySqlConnector(Connector):
     """Async bridge driver with sql_template rendering
     (emqx_bridge_mysql analog)."""
 
+    wants_env = True  # sql templates render from the full rule env
+
     def __init__(
         self,
         host: str = "127.0.0.1",

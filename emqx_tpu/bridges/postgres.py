@@ -249,6 +249,8 @@ class PostgresConnector(Connector):
     (emqx_bridge_pgsql sql template, e.g.
     "INSERT INTO t (topic, payload) VALUES (${topic}, ${payload})")."""
 
+    wants_env = True  # sql templates render from the full rule env
+
     def __init__(
         self,
         host: str = "127.0.0.1",

@@ -217,6 +217,8 @@ class RedisConnector(Connector):
     `command_template` (reference emqx_bridge_redis command_template,
     apps/emqx_bridge_redis/src/emqx_bridge_redis.erl)."""
 
+    wants_env = True  # command templates render from the full rule env
+
     def __init__(
         self,
         host: str = "127.0.0.1",

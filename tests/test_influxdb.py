@@ -1,0 +1,120 @@
+"""InfluxDB bridge: line-protocol rendering + v2 write API against a
+mini HTTP collector, through the rule engine.
+
+Ref: apps/emqx_bridge_influxdb (write_syntax templates).
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from emqx_tpu.bridges.influxdb import InfluxConnector, render_line
+from emqx_tpu.bridges.resource import QueryError
+
+
+def test_line_rendering_types_and_escapes():
+    env = {
+        "clientid": "dev one",  # space must escape in tags
+        "topic": "t/1",
+        "timestamp": 1722340000.5,
+        "payload": json.dumps({
+            "temp": 21.5, "count": 7, "ok": True, "note": 'say "hi"',
+        }),
+    }
+    line = render_line(
+        "metrics,clientid=${clientid},topic=${topic} "
+        "temp=${payload.temp},count=${payload.count}i,ok=${payload.ok},"
+        "note=${payload.note} ${timestamp}",
+        env,
+    )
+    assert line.startswith(
+        "metrics,clientid=dev\\ one,topic=t/1 "  # tag space escaped
+    )
+    assert "temp=21.5," in line
+    assert "count=7i," in line  # int hint -> i suffix
+    assert "ok=true," in line
+    assert 'note="say \\"hi\\""' in line  # quoted string w/ escapes
+    assert line.endswith(" " + str(int(1722340000.5 * 1_000_000)))
+    # missing field drops; all-missing raises
+    line2 = render_line(
+        "m,t=${clientid} a=${payload.temp},b=${payload.absent}", env
+    )
+    assert line2.endswith(" a=21.5")
+    with pytest.raises(QueryError):
+        render_line("m,t=x a=${payload.absent}", env)
+    # config-time template sanity
+    with pytest.raises(QueryError):
+        InfluxConnector(write_syntax="m,t=x broken_no_equals")
+
+
+@pytest.mark.asyncio
+async def test_influx_rule_to_write_api():
+    received = []
+
+    async def handler(reader, writer):
+        data = b""
+        while b"\r\n\r\n" not in data:
+            data += await reader.read(4096)
+        head, _, body = data.partition(b"\r\n\r\n")
+        req_line = head.split(b"\r\n")[0].decode()
+        clen = 0
+        for ln in head.split(b"\r\n"):
+            if ln.lower().startswith(b"content-length:"):
+                clen = int(ln.split(b":")[1])
+        while len(body) < clen:
+            body += await reader.read(4096)
+        received.append((req_line, dict(
+            (k.decode().lower(), v.decode().strip())
+            for k, _, v in (
+                ln.partition(b":") for ln in head.split(b"\r\n")[1:] if ln
+            )
+        ), body.decode()))
+        writer.write(b"HTTP/1.1 204 No Content\r\ncontent-length: 0\r\n\r\n")
+        await writer.drain()
+        writer.close()
+
+    srv = await asyncio.start_server(handler, "127.0.0.1", 0)
+    port = srv.sockets[0].getsockname()[1]
+
+    from emqx_tpu.bridges.bridge import BridgeRegistry
+    from emqx_tpu.broker.message import Message
+    from emqx_tpu.broker.pubsub import Broker
+    from emqx_tpu.rules.engine import RuleEngine
+
+    broker = Broker()
+    rules = RuleEngine(broker)
+    rules.install(broker.hooks)
+    reg = BridgeRegistry(broker, rules=rules)
+    try:
+        await reg.create(
+            "influx",
+            InfluxConnector(
+                url=f"http://127.0.0.1:{port}", org="o1", bucket="b1",
+                token="secret-token",
+                write_syntax=(
+                    "sensor,clientid=${clientid} temp=${payload.temp} "
+                    "${timestamp}"
+                ),
+            ),
+        )
+        rules.create_rule(
+            "to_influx", 'SELECT * FROM "sensors/#"',
+            actions=[{"function": "bridge", "args": {"name": "influx"}}],
+        )
+        broker.publish(Message(
+            topic="sensors/a", payload=b'{"temp": 19.25}',
+            from_client="d7",
+        ))
+        await reg.bridges["influx"].resource.buffer.drain()
+        await asyncio.sleep(0.05)
+        writes = [r for r in received if "/api/v2/write" in r[0]]
+        assert writes, received
+        req_line, headers, body = writes[0]
+        assert "org=o1" in req_line and "bucket=b1" in req_line
+        assert headers["authorization"] == "Token secret-token"
+        assert body.startswith("sensor,clientid=d7 temp=19.25 ")
+    finally:
+        await reg.stop_all()
+        srv.close()
+        await srv.wait_closed()
