@@ -123,11 +123,19 @@ def saturated(per_batch) -> bool:
     return float(np.median(per_batch)) <= EPS * 1.2
 
 
+DEGRADED_MS = 2.5  # the kernel is <1ms/batch at every config on a
+# healthy chip; a p50 above this means the relay/device is in a bad
+# window (observed: transient 40x slowdowns with p50==p99), so cool
+# down once and remeasure rather than recording weather as perf
+
+
 def measure_scan(jax, jnp, match_ids_hash, max_hits, gen_factory, k, b,
                  dev_args, floor, n_dispatches=6, escalate=8, label=""):
     """Measure via make_scan_bench; on floor saturation, escalate to
     escalate*k batches per dispatch so kernel work dominates relay
-    jitter. Returns (per_batch, total, used_k, was_saturated)."""
+    jitter; on detected relay/device degradation, cool down and
+    remeasure ONCE (both runs logged, better one kept).
+    Returns (per_batch, total, used_k, was_saturated)."""
     many = make_scan_bench(jax, jnp, match_ids_hash, max_hits,
                            gen_factory(k, b), k)
     per_batch, total = time_dispatches(
@@ -141,6 +149,17 @@ def measure_scan(jax, jnp, match_ids_hash, max_hits, gen_factory, k, b,
         per_batch, total = time_dispatches(
             many, dev_args, floor, used_k,
             max(3, n_dispatches // 2), jj=(jax, jnp))
+    if float(np.median(per_batch)) * 1e3 > DEGRADED_MS:
+        log(f"{label} degraded run (p50 "
+            f"{float(np.median(per_batch)) * 1e3:.2f} ms/batch) — "
+            f"cooling 30s and remeasuring once")
+        time.sleep(30)
+        pb2, t2 = time_dispatches(
+            many, dev_args, floor, used_k, n_dispatches, jj=(jax, jnp))
+        log(f"{label} remeasure p50 "
+            f"{float(np.median(pb2)) * 1e3:.2f} ms/batch")
+        if float(np.median(pb2)) < float(np.median(per_batch)):
+            per_batch, total = pb2, t2
     return per_batch, total, used_k, saturated(per_batch)
 
 
@@ -529,6 +548,18 @@ def bench_10m(jax, jnp, floor, details):
         n_dispatches=6,
         jj=(jax, jnp),
     )
+    if float(np.median(per_batch)) * 1e3 > DEGRADED_MS:
+        log(f"#3 degraded run (p50 "
+            f"{float(np.median(per_batch)) * 1e3:.2f} ms/batch) — "
+            f"cooling 30s and remeasuring once")
+        time.sleep(30)
+        pb2, t2 = time_dispatches(
+            many, (meta, slots, (skel_dev, plen_c, plus_c, hash_c)),
+            floor, K, n_dispatches=6, jj=(jax, jnp),
+        )
+        log(f"#3 remeasure p50 {float(np.median(pb2)) * 1e3:.2f} ms/batch")
+        if float(np.median(pb2)) < float(np.median(per_batch)):
+            per_batch, total = pb2, t2
     med = float(np.median(per_batch))
     rate = B / med
     n_topics = len(per_batch) * K * B
