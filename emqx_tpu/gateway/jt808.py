@@ -18,9 +18,9 @@ register-ack (0x8100) carrying an auth code; terminal AUTHs (0x0102)
 with that code -> session opens, dn topic subscribed. Uplinks publish
 JSON to {phone}/up; JSON on {phone}/dn frames down to the terminal.
 Location reports (0x0200) and deregister get platform general acks
-(0x8001). Fragmented messages (bit 13) are refused — the reference
-reassembles them; here oversized bodies should use the transparent
-path instead of silently mis-parsing."""
+(0x8001). Fragmented messages (properties bit 13: total(2)+seq(2)
+after the header) reassemble per (phone, msg_id) with bounded
+buffers, like the reference's frame layer."""
 
 from __future__ import annotations
 
@@ -128,16 +128,24 @@ def parse_frames(buf: bytearray) -> List[dict]:
         if c != check:
             fail("bad checksum")
         msg_id, props = struct.unpack_from(">HH", body_check, 0)
-        if props & 0x2000:
-            fail("fragmented messages not supported")
         phone = _from_bcd(body_check[4:10])
         (msg_sn,) = struct.unpack_from(">H", body_check, 10)
-        body = body_check[12:]
+        frag = None
+        body_off = 12
+        if props & 0x2000:  # fragmented: total(2) + seq(2, 1-based)
+            if len(body_check) < 16:
+                fail("short fragmented frame")
+            total, seq = struct.unpack_from(">HH", body_check, 12)
+            if total == 0 or seq == 0 or seq > total:
+                fail("bad fragment indices")
+            frag = (total, seq)
+            body_off = 16
+        body = body_check[body_off:]
         if len(body) != props & 0x3FF:
             fail("body length mismatch")
         out.append({
             "msg_id": msg_id, "phone": phone, "msg_sn": msg_sn,
-            "body": body,
+            "body": body, "frag": frag,
         })
 
 
@@ -171,6 +179,9 @@ def parse_body(msg_id: int, body: bytes) -> dict:
     return {"raw": body.hex()}
 
 
+MAX_FRAGMENTS = 64  # bounded reassembly per (phone, msg_id)
+
+
 class _Terminal:
     def __init__(self, phone: str, writer):
         self.phone = phone
@@ -178,6 +189,8 @@ class _Terminal:
         self.session = None  # set after AUTH succeeds
         self.authcode: Optional[str] = None
         self.sn = 0
+        # fragment reassembly: msg_id -> {seq: body}, expected total
+        self.frags: Dict[int, Tuple[int, Dict[int, bytes]]] = {}
 
     def next_sn(self) -> int:
         self.sn = (self.sn + 1) & 0xFFFF
@@ -335,6 +348,11 @@ class Jt808Gateway(GatewayImpl):
             self._uplink(term, frame)
             return term
         # authenticated traffic
+        if frame.get("frag") is not None:
+            whole = self._reassemble(term, frame)
+            if whole is None:
+                return term  # more parts pending
+            frame = dict(frame, body=whole, frag=None)
         self._uplink(term, frame)
         if msg_id in (MC_LOCATION, MC_DEREGISTER):
             self._general_ack(term, frame, result=0)
@@ -342,6 +360,25 @@ class Jt808Gateway(GatewayImpl):
             self._drop(phone)
             return None
         return term
+
+    def _reassemble(self, term: _Terminal, frame: dict) -> Optional[bytes]:
+        """Collect (total, seq) parts per msg_id; returns the joined
+        body once complete (the reference frame layer's reassembly).
+        Oversized or inconsistent series reset rather than grow."""
+        total, seq = frame["frag"]
+        if total > MAX_FRAGMENTS:
+            log.warning("jt808 %s: fragment series too long (%d)",
+                        term.phone, total)
+            return None
+        exp, parts = term.frags.get(frame["msg_id"], (total, {}))
+        if exp != total:
+            parts = {}  # new series replaces a stale one
+        parts[seq] = frame["body"]
+        if len(parts) < total:
+            term.frags[frame["msg_id"]] = (total, parts)
+            return None
+        term.frags.pop(frame["msg_id"], None)
+        return b"".join(parts[i] for i in range(1, total + 1))
 
     def _uplink(self, term: _Terminal, frame: dict) -> None:
         if term.session is None:

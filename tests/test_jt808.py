@@ -193,3 +193,57 @@ async def test_foreign_phone_frames_dropped():
         t.w.close()
     finally:
         await reg.unload_all()
+
+
+@pytest.mark.asyncio
+async def test_jt808_fragmented_message_reassembles():
+    """A message split across fragments (properties bit 13 with
+    total/seq words) reassembles into ONE uplink; out-of-order parts
+    are tolerated."""
+    import struct as st
+
+    from emqx_tpu.gateway.jt808 import _bcd
+
+    broker = Broker()
+    reg = GatewayRegistry(broker)
+    gw = await reg.load("jt808", {"bind": "127.0.0.1:0"})
+    s, _ = broker.open_session("tsp", True)
+    up = []
+    s.outgoing_sink = up.extend
+    broker.subscribe(s, f"jt808/{PHONE}/up", SubOpts(qos=0))
+    t = Terminal()
+    try:
+        await t.connect(gw.listen_addr)
+        await t.send(MC_REGISTER, 1, register_body())
+        ack = await t.recv()
+        await t.send(MC_AUTH, 2, ack["body"][3:])
+        await t.recv()
+        await asyncio.sleep(0.05)
+        base = len(up)
+
+        def frag_frame(msg_id, sn, total, seq, part):
+            props = (len(part) & 0x3FF) | 0x2000
+            head = (st.pack(">HH", msg_id, props) + _bcd(PHONE)
+                    + st.pack(">H", sn) + st.pack(">HH", total, seq))
+            raw = head + part
+            c = 0
+            for x in raw:
+                c ^= x
+            from emqx_tpu.gateway.jt808 import _escape
+            return b"\x7e" + _escape(raw + bytes([c])) + b"\x7e"
+
+        # 0x0900 transparent upload in 3 parts, sent out of order
+        parts = [b"AAAA", b"BBBB", b"CC"]
+        t.w.write(frag_frame(0x0900, 10, 3, 2, parts[1]))
+        t.w.write(frag_frame(0x0900, 11, 3, 1, parts[0]))
+        t.w.write(frag_frame(0x0900, 12, 3, 3, parts[2]))
+        await t.w.drain()
+        await asyncio.sleep(0.1)
+        new = up[base:]
+        bodies = [json.loads(p.payload) for p in new]
+        whole = [b for b in bodies if b["header"]["msg_id"] == 0x0900]
+        assert len(whole) == 1, bodies  # ONE reassembled uplink
+        assert whole[0]["body"]["raw"] == (b"".join(parts)).hex()
+        t.w.close()
+    finally:
+        await reg.unload_all()
