@@ -164,6 +164,10 @@ class JwtProvider(Provider):
         self.jwks_refresh_s = jwks_refresh_s
         self._jwks: Dict[str, Any] = {}
         self._jwks_at = 0.0
+        # forced-refresh backoff: a flood of CONNECTs with garbage kids
+        # must not turn into one JWKS fetch per attempt
+        self.jwks_force_min_s = 10.0
+        self._jwks_forced_at = 0.0
 
     # --- signature verification ----------------------------------------
 
@@ -225,11 +229,18 @@ class JwtProvider(Provider):
         if key is None and self.jwks_endpoint is not None:
             self._load_jwks()
             ent = self._jwks.get(kid or "")
-            if ent is None:
-                # unknown kid: rotation — one forced refresh
+            if ent is None and (
+                time.time() - self._jwks_forced_at >= self.jwks_force_min_s
+            ):
+                # unknown kid: rotation — one forced refresh, rate-
+                # limited so garbage kids can't hammer the JWKS server
+                self._jwks_forced_at = time.time()
                 self._load_jwks(force=True)
                 ent = self._jwks.get(kid or "")
-            if ent is None and len(self._jwks) == 1:
+            if ent is None and kid is None and len(self._jwks) == 1:
+                # no kid in the token at all: the single published key
+                # is unambiguous. A kid that MISSES must fail — falling
+                # back would verify against a key the token never named.
                 ent = next(iter(self._jwks.values()))
             if ent is None:
                 return False

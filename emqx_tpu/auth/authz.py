@@ -60,6 +60,11 @@ def _match_rule(
 class Source:
     """Authz source behaviour: authorize -> allow|deny|nomatch."""
 
+    # True on sources that resolve verdicts over the network (redis/
+    # sql/ldap/mongo/http) — the hook bridge advertises the authorize
+    # chain as `slow` so connection loops run it off the event loop
+    blocking = False
+
     def authorize(self, client_id, username, peerhost, action, topic) -> str:
         raise NotImplementedError
 
@@ -147,6 +152,11 @@ class Authz:
                 except Exception:
                     pass
         self.sources.clear()
+
+    @property
+    def maybe_blocking(self) -> bool:
+        """Any source that resolves verdicts over the network?"""
+        return any(getattr(s, "blocking", False) for s in self.sources)
 
     def add_source(self, source: Source, front: bool = False) -> None:
         if front:

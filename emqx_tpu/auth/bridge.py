@@ -86,7 +86,12 @@ class AuthPipeline:
 
     def install(self, hooks: Hooks) -> None:
         hooks.add("client.authenticate", self._on_authenticate, priority=100)
-        hooks.add("client.authorize", self._on_authorize, priority=100)
+        # slow marker is dynamic: the chain only needs the off-loop
+        # path once a network-backed source (redis/sql/ldap/http) is in
+        hooks.add(
+            "client.authorize", self._on_authorize, priority=100,
+            slow=lambda: self.authz.maybe_blocking,
+        )
         hooks.add("client.disconnected", self._on_disconnected, priority=100)
 
     def uninstall(self, hooks: Hooks) -> None:

@@ -118,6 +118,8 @@ class HttpAuthzSource(Source):
     """Per-(action, topic) authorization check; response
     {"result": "allow"|"deny"|"ignore"}. Failures -> ignore."""
 
+    blocking = True
+
     def __init__(
         self,
         url: str,

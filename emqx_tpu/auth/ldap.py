@@ -302,6 +302,8 @@ class LdapAuthzSource(Source):
     """Topic filters from per-entry attributes (emqx_authz_ldap:
     publish/subscribe/all attributes, allow-only like the reference)."""
 
+    blocking = True
+
     def __init__(
         self,
         base_dn: str,

@@ -92,6 +92,8 @@ class MongoAuthzSource(Source):
     """Documents shaped {permission, action, topics: [...]}, evaluated
     in order; first topic match wins (emqx_authz_mongodb.erl)."""
 
+    blocking = True
+
     def __init__(
         self,
         collection: str = "mqtt_acl",

@@ -77,6 +77,7 @@ class MySqlAuthnProvider(Provider):
 
 
 class MySqlAuthzSource(Source):
+    blocking = True
     def __init__(
         self,
         query: str = (

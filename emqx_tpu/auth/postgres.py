@@ -84,6 +84,8 @@ class PostgresAuthzSource(Source):
     """query returning (permission, action, topic) rows evaluated in
     order; first topic match wins (emqx_authz_postgresql.erl)."""
 
+    blocking = True
+
     def __init__(
         self,
         query: str = (

@@ -147,6 +147,8 @@ class RedisAuthzSource(Source):
     anything else is nomatch (emqx_authz_redis.erl semantics: Redis
     rules cannot deny)."""
 
+    blocking = True
+
     def __init__(
         self,
         cmd: str = "HGETALL mqtt_acl:${username}",

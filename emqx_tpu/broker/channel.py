@@ -85,6 +85,10 @@ class Channel:
         # (client_id, verdict) pre-computed by the connection layer's
         # off-loop authenticate run; consumed once by _handle_connect
         self.preauth = None
+        # (action, topic) -> verdict pre-computed off-loop by the
+        # connection layer when a slow (network-backed) authorize chain
+        # is installed; consumed by _handle_publish/_handle_subscribe
+        self.preauthz: dict = {}
 
     # --- inbound dispatch -------------------------------------------------
 
@@ -285,11 +289,13 @@ class Channel:
         # authorize on the UNMOUNTED topic — ACLs must see the same
         # namespace on publish and subscribe (mount happens after, like
         # the reference's packet_to_message)
-        allowed = self.broker.hooks.run_fold(
-            "client.authorize",
-            (self.client_id, "publish", topic),
-            True,
-        )
+        allowed = self.preauthz.get(("publish", topic))
+        if allowed is None:
+            allowed = self.broker.hooks.run_fold(
+                "client.authorize",
+                (self.client_id, "publish", topic),
+                True,
+            )
         if self.mountpoint:
             topic = self.mountpoint + topic
         if allowed is not True:
@@ -389,9 +395,15 @@ class Channel:
         )
         filters = acc if acc is not None else pkt.filters
         for flt, opts in filters:
-            allowed = self.broker.hooks.run_fold(
-                "client.authorize", (self.client_id, "subscribe", flt), True
-            )
+            # get, not pop: one SUBSCRIBE may list the same filter twice
+            # and both occurrences must hit the pre-resolved verdict.
+            # A miss (client.subscribe hook rewrote the filter) falls
+            # back to the inline fold
+            allowed = self.preauthz.get(("subscribe", flt))
+            if allowed is None:
+                allowed = self.broker.hooks.run_fold(
+                    "client.authorize", (self.client_id, "subscribe", flt), True
+                )
             if allowed is not True:
                 codes.append(RC.NOT_AUTHORIZED if self.proto_ver == MQTT_V5 else 0x80)
                 continue

@@ -54,14 +54,21 @@ class Hooks:
         self._hooks: Dict[str, List[Tuple[int, int, Callable]]] = {}
         self._seq = 0
         self._strict = strict
+        # cb -> slow marker (bool, or zero-arg callable evaluated at
+        # query time so a chain can become slow when e.g. a network
+        # authz source is added after registration)
+        self._slow: Dict[str, List[Tuple[Callable, Any]]] = {}
 
     def _check(self, name: str) -> None:
         if self._strict and name not in HOOKPOINTS:
             raise KeyError(f"unknown hookpoint {name!r}")
 
-    def add(self, name: str, cb: Callable, priority: int = 0) -> None:
+    def add(self, name: str, cb: Callable, priority: int = 0, slow: Any = False) -> None:
         """Register; higher priority runs first (emqx_hooks.erl:63-70
-        sorts descending, ties keep registration order)."""
+        sorts descending, ties keep registration order). `slow` marks a
+        callback that may block on I/O (network authz source, out-of-
+        proc exhook) — connection loops consult `has_slow` to decide
+        whether the chain must run off the event loop."""
         self._check(name)
         chain = self._hooks.setdefault(name, [])
         self._seq += 1
@@ -69,16 +76,31 @@ class Hooks:
         entry = (-priority, self._seq, cb)
         bisect.insort(chain, entry, key=lambda e: (e[0], e[1]))
         # bisect.insort with key keeps chain sorted
+        if slow:
+            self._slow.setdefault(name, []).append((cb, slow))
 
     def delete(self, name: str, cb: Callable) -> None:
+        # equality, not identity: `self._method` builds a FRESH bound-
+        # method object on every attribute access, so `is` would never
+        # match the one stored at add() time (== compares __self__ and
+        # __func__; for plain functions it degrades to identity)
         chain = self._hooks.get(name, [])
-        self._hooks[name] = [e for e in chain if e[2] is not cb]
+        self._hooks[name] = [e for e in chain if e[2] != cb]
+        if name in self._slow:
+            self._slow[name] = [e for e in self._slow[name] if e[0] != cb]
 
     def has(self, name: str) -> bool:
         """True when any callback is registered (lets hot loops hoist
         the per-delivery chain walk; emqx runs chains unconditionally
         but BEAM call overhead is not Python call overhead)."""
         return bool(self._hooks.get(name))
+
+    def has_slow(self, name: str) -> bool:
+        """True when any registered callback may block on I/O."""
+        for _cb, marker in self._slow.get(name, ()):
+            if marker is True or (callable(marker) and marker()):
+                return True
+        return False
 
     def run(self, name: str, *args: Any) -> bool:
         """Run the chain; returns False if a callback returned STOP."""

@@ -60,6 +60,26 @@ class TlsError(Exception):
     pass
 
 
+def _normalized(fn):
+    """Attacker-controlled bytes are indexed/unpacked with no bounds
+    checks below; normalize ANY parse failure to TlsError so truncated
+    or malformed handshakes take the documented clean CONNECTION_CLOSE
+    path (quic.py _crypto_in catches TlsError only) instead of
+    escaping as IndexError/struct.error into the catch-all UDP log."""
+
+    def wrap(*args, **kw):
+        try:
+            return fn(*args, **kw)
+        except TlsError:
+            raise
+        except Exception as e:
+            raise TlsError(
+                f"malformed TLS message: {type(e).__name__}: {e}"
+            ) from e
+
+    return wrap
+
+
 def _u16(v: int) -> bytes:
     return struct.pack(">H", v)
 
@@ -158,6 +178,7 @@ class TlsServer:
 
     # --- client hello -> full server flight ---------------------------
 
+    @_normalized
     def feed_initial(self, data: bytes) -> List[Tuple[str, bytes]]:
         out: List[Tuple[str, bytes]] = []
         for t, body, raw in self.buf.feed(data):
@@ -267,6 +288,7 @@ class TlsServer:
 
     # --- client finished ------------------------------------------------
 
+    @_normalized
     def feed_handshake(self, data: bytes) -> None:
         for t, body, raw in self.buf.feed(data):
             if t != HS_FINISHED:
@@ -321,6 +343,7 @@ class TlsClient:
         self.transcript += ch
         return ch
 
+    @_normalized
     def feed_initial(self, data: bytes) -> None:
         for t, body, raw in self.buf.feed(data):
             if t != HS_SERVER_HELLO:
@@ -351,6 +374,7 @@ class TlsClient:
             self.schedule.hs_traffic(self.transcript)
         )
 
+    @_normalized
     def feed_handshake(self, data: bytes) -> Optional[bytes]:
         """Returns the client Finished bytes once the server flight
         fully verified (send at handshake level), else None."""

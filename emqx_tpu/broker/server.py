@@ -18,7 +18,7 @@ from . import frame
 from .channel import Channel, ProtocolError
 from .limiter import ListenerLimits, LoadShedder
 from .message import Message
-from .packet import Disconnect, MQTT_V5, Publish, RC
+from .packet import Disconnect, MQTT_V5, Publish, RC, Subscribe
 from .pubsub import Broker
 from .transport import TcpTransport, WsTransport
 
@@ -220,6 +220,42 @@ class Connection:
                                     [Disconnect(RC.QUOTA_EXCEEDED)]
                                 )
                             return
+                    if self.channel.connected and isinstance(
+                        pkt, (Publish, Subscribe)
+                    ):
+                        # verdicts are scoped to THIS packet: always
+                        # reset so nothing stale survives a has_slow
+                        # flip or an unconsumed rewrite miss
+                        self.channel.preauthz = {}
+                    if self.channel.connected and isinstance(
+                        pkt, (Publish, Subscribe)
+                    ) and self.server.broker.hooks.has_slow("client.authorize"):
+                        # a network-backed authz source (or exhook) is
+                        # installed: pre-resolve the verdicts OFF-loop so
+                        # a backend stall pushes back on this connection
+                        # only, never the broker loop (same pattern as
+                        # the authenticate fold above)
+                        if isinstance(pkt, Publish):
+                            t = pkt.topic or self.channel.topic_aliases.get(
+                                pkt.props.get("topic_alias")
+                            )
+                            pairs = [("publish", t)] if t else []
+                        else:
+                            pairs = [("subscribe", f) for f, _o in pkt.filters]
+                        if pairs:
+                            cid = self.channel.client_id
+                            hooks = self.server.broker.hooks
+                            self.channel.preauthz = (
+                                await asyncio.get_running_loop().run_in_executor(
+                                    None,
+                                    lambda: {
+                                        (a, t): hooks.run_fold(
+                                            "client.authorize", (cid, a, t), True
+                                        )
+                                        for a, t in pairs
+                                    },
+                                )
+                            )
                     try:
                         out = self.channel.handle_packet(pkt)
                     except ProtocolError as e:

@@ -79,7 +79,10 @@ class ReplicatedDs:
         self._applied: Dict[int, int] = {}  # last COMMITTED idx applied
         self._accepted: Dict[int, int] = {}  # last contiguously accepted
         # accepted-but-uncommitted: shard -> idx -> (term, payload)
-        self._pending: Dict[int, Dict[int, Tuple[int, list]]] = {}
+        # shard -> idx -> (term, payload, leader_node_id); the leader id
+        # disambiguates same-term appends from two nodes that both
+        # believe they lead (asymmetric membership views)
+        self._pending: Dict[int, Dict[int, Tuple[int, list, str]]] = {}
         # as leader: (shard, idx) -> ack state
         self._unacked: Dict[Tuple[int, int], dict] = {}
         # committed log for replay/catch-up
@@ -200,7 +203,7 @@ class ReplicatedDs:
     def _assign_locked(self, shard: int, term: int, payload: list) -> int:
         idx = self._next_idx.get(shard, self._applied.get(shard, 0) + 1)
         self._next_idx[shard] = idx + 1
-        self._pending.setdefault(shard, {})[idx] = (term, payload)
+        self._pending.setdefault(shard, {})[idx] = (term, payload, self.node_id)
         self._accepted[shard] = max(self._accepted.get(shard, 0), idx)
         self._unacked[(shard, idx)] = {
             "term": term, "payload": payload, "acks": set(), "committed": False,
@@ -349,14 +352,20 @@ class ReplicatedDs:
             cur = self._pending.get(shard, {}).get(idx)
             if cur is not None:
                 if cur[0] == term:
-                    return ("ok",)  # duplicate of the same leadership
+                    # same term: only a true duplicate (same leader, same
+                    # payload) is "ok" — two nodes holding equal terms can
+                    # both believe they lead, and acking both would let two
+                    # different entries reach majority at the same index
+                    if cur[2] == _from and cur[1] == payload:
+                        return ("ok",)
+                    return ("conflict",)
                 if cur[0] > term:
                     return ("stale", self.term)
                 # newer term overwrites an uncommitted older entry
-                self._pending[shard][idx] = (term, payload)
+                self._pending[shard][idx] = (term, payload, _from)
                 return ("ok",)
             if idx == accepted + 1:
-                self._pending.setdefault(shard, {})[idx] = (term, payload)
+                self._pending.setdefault(shard, {})[idx] = (term, payload, _from)
                 self._accepted[shard] = idx
                 return ("ok",)
             if idx <= accepted:
@@ -387,7 +396,7 @@ class ReplicatedDs:
             pend = sorted(self._pending.get(shard, {}).items())
             return (
                 self._applied.get(shard, 0),
-                [(i, t, p) for i, (t, p) in pend],
+                [(i, t, p) for i, (t, p, _l) in pend],
             )
 
     def _handle_replay(self, shard: int, after_idx: int):
@@ -412,7 +421,7 @@ class ReplicatedDs:
             ]
             entries += [
                 (i, t, p)
-                for i, (t, p) in sorted(self._pending.get(shard, {}).items())
+                for i, (t, p, _l) in sorted(self._pending.get(shard, {}).items())
                 if i > after
             ]
             upto = self._applied.get(shard, 0)
@@ -481,7 +490,7 @@ class ReplicatedDs:
                 ):
                     merged[i] = (tm, p)
         with self._mutex:
-            for i, (tm, p) in sorted(self._pending.get(shard, {}).items()):
+            for i, (tm, p, _l) in sorted(self._pending.get(shard, {}).items()):
                 if i > best_applied and (
                     i not in merged or tm > merged[i][0]
                 ):

@@ -336,8 +336,9 @@ class ExHookBridge:
             else:
                 cb = self._make_cast(point)
             # priority 500: external servers run before most in-proc
-            # features but after rewrite/delayed interceptors
-            self.broker.hooks.add(point, cb, priority=500)
+            # features but after rewrite/delayed interceptors; slow=True
+            # because every call round-trips to the out-of-proc server
+            self.broker.hooks.add(point, cb, priority=500, slow=True)
             self._installed.append((point, cb))
 
     def _rebind_hooks(self, new_points: List[str]) -> None:
@@ -360,7 +361,7 @@ class ExHookBridge:
                 if point in FOLD_HOOKPOINTS
                 else self._make_cast(point)
             )
-            self.broker.hooks.add(point, cb, priority=500)
+            self.broker.hooks.add(point, cb, priority=500, slow=True)
             self._installed.append((point, cb))
         self.hookpoints = list(new_points)
 

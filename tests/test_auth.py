@@ -407,6 +407,25 @@ def test_jwt_jwks_endpoint_with_rotation():
                                               key_size=2048)
         assert p.authenticate(Credentials("c", "u", mint("k2").encode())).ok
         assert state["fetches"] == 2
+        # garbage kid: fails WITHOUT another forced fetch (rate-limited
+        # — a CONNECT flood with bogus kids must not hammer the JWKS
+        # server) and WITHOUT falling back to a key the token never named
+        header = _b64url_encode(
+            _json.dumps({"alg": "RS256", "kid": "bogus"}).encode()
+        )
+        body = _b64url_encode(_json.dumps({"sub": "d"}).encode())
+        sig = keys["k1"].sign(f"{header}.{body}".encode(), PKCS1v15(),
+                              SHA256())
+        bogus = f"{header}.{body}." + _b64url_encode(sig)
+        for _ in range(5):
+            assert not p.authenticate(
+                Credentials("c", "u", bogus.encode())
+            ).ok
+        assert state["fetches"] == 2
+        # once the backoff window passes, one forced refresh is allowed
+        p._jwks_forced_at = 0.0
+        assert not p.authenticate(Credentials("c", "u", bogus.encode())).ok
+        assert state["fetches"] == 3
     finally:
         stop.set()
         t.join(5)

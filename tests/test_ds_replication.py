@@ -277,3 +277,34 @@ async def test_stale_leader_fenced_by_term(tmp_path):
             m.close()
         for db in (db1, db2):
             db.close()
+
+
+async def test_same_term_dual_leader_append_conflicts(tmp_path):
+    """Two nodes holding EQUAL terms can both believe they lead a shard
+    (asymmetric membership views). A replica must accept exactly ONE
+    entry per (term, index) — the second same-term append from a
+    different leader (or with a different payload) gets 'conflict',
+    never 'ok', so divergent entries can't both reach majority."""
+    n1, m1, db1, r1, a1 = await make_node("n1", tmp_path)
+    try:
+        r1.term = 3
+        assert r1._handle_append(0, 1, 3, ["payload-A"], "leaderX") == ("ok",)
+        # duplicate (same leader, same payload): idempotent ok
+        assert r1._handle_append(0, 1, 3, ["payload-A"], "leaderX") == ("ok",)
+        # same term, different leader: conflict
+        assert r1._handle_append(0, 1, 3, ["payload-B"], "leaderY") == (
+            "conflict",
+        )
+        # same term, same leader, different payload: also conflict
+        assert r1._handle_append(0, 1, 3, ["payload-C"], "leaderX") == (
+            "conflict",
+        )
+        # the replica still holds the first entry only
+        assert r1._pending[0][1] == (3, ["payload-A"], "leaderX")
+        # a NEWER term may overwrite the uncommitted entry (raft rule)
+        assert r1._handle_append(0, 1, 4, ["payload-D"], "leaderY") == ("ok",)
+        assert r1._pending[0][1] == (4, ["payload-D"], "leaderY")
+    finally:
+        await n1.stop()
+        m1.close()
+        db1.close()

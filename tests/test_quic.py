@@ -66,6 +66,35 @@ def test_tls13_loopback_and_transport_params():
     assert srv.alpn_selected == "mqtt"
 
 
+def test_malformed_client_hello_raises_tls_error():
+    """Truncated/garbage handshake bytes must surface as TlsError (the
+    one exception quic.py _crypto_in turns into a clean
+    CONNECTION_CLOSE), never IndexError/struct.error stack spam."""
+    import pytest as _pytest
+
+    from emqx_tpu.broker.quic_tls import TlsError
+
+    full = TlsClient(transport_params=b"CP").client_hello()
+
+    def reframe(body: bytes) -> bytes:
+        # complete handshake framing (type=ClientHello, true length)
+        # around a malformed body — incomplete frames just buffer
+        return bytes([1]) + len(body).to_bytes(3, "big") + body
+
+    cases = [
+        # body truncated mid-structure at every interesting boundary
+        reframe(full[4:][:2]),
+        reframe(full[4:][:34]),
+        reframe(full[4:][: len(full) // 2]),
+        # pure garbage body
+        reframe(os.urandom(30)),
+    ]
+    for raw in cases:
+        srv = TlsServer(transport_params=b"SP")
+        with _pytest.raises(TlsError):
+            srv.feed_initial(raw)
+
+
 def test_quic_inmemory_stream_exchange():
     cli = ClientConnection()
     srv = ServerConnection(odcid=cli.dcid)
