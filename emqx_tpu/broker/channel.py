@@ -89,6 +89,10 @@ class Channel:
         # connection layer when a slow (network-backed) authorize chain
         # is installed; consumed by _handle_publish/_handle_subscribe
         self.preauthz: dict = {}
+        # the client.subscribe fold result when the connection layer
+        # already ran the chain off-loop (covers filter rewrites);
+        # consumed once by _handle_subscribe so the chain runs ONCE
+        self.presub_filters = None
 
     # --- inbound dispatch -------------------------------------------------
 
@@ -390,10 +394,14 @@ class Channel:
         assert self.session is not None
         codes: List[int] = []
         out: List[object] = []
-        acc = self.broker.hooks.run_fold(
-            "client.subscribe", (self.client_id,), pkt.filters
-        )
-        filters = acc if acc is not None else pkt.filters
+        if self.presub_filters is not None:
+            filters = self.presub_filters
+            self.presub_filters = None
+        else:
+            acc = self.broker.hooks.run_fold(
+                "client.subscribe", (self.client_id,), pkt.filters
+            )
+            filters = acc if acc is not None else pkt.filters
         for flt, opts in filters:
             # get, not pop: one SUBSCRIBE may list the same filter twice
             # and both occurrences must hit the pre-resolved verdict.

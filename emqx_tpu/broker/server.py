@@ -227,6 +227,7 @@ class Connection:
                         # reset so nothing stale survives a has_slow
                         # flip or an unconsumed rewrite miss
                         self.channel.preauthz = {}
+                        self.channel.presub_filters = None
                     if self.channel.connected and isinstance(
                         pkt, (Publish, Subscribe)
                     ) and self.server.broker.hooks.has_slow("client.authorize"):
@@ -235,26 +236,52 @@ class Connection:
                         # a backend stall pushes back on this connection
                         # only, never the broker loop (same pattern as
                         # the authenticate fold above)
+                        cid = self.channel.client_id
+                        hooks = self.server.broker.hooks
                         if isinstance(pkt, Publish):
                             t = pkt.topic or self.channel.topic_aliases.get(
                                 pkt.props.get("topic_alias")
                             )
-                            pairs = [("publish", t)] if t else []
-                        else:
-                            pairs = [("subscribe", f) for f, _o in pkt.filters]
-                        if pairs:
-                            cid = self.channel.client_id
-                            hooks = self.server.broker.hooks
-                            self.channel.preauthz = (
-                                await asyncio.get_running_loop().run_in_executor(
-                                    None,
-                                    lambda: {
-                                        (a, t): hooks.run_fold(
-                                            "client.authorize", (cid, a, t), True
-                                        )
-                                        for a, t in pairs
-                                    },
+                            if t:
+                                self.channel.preauthz = (
+                                    await asyncio.get_running_loop().run_in_executor(
+                                        None,
+                                        lambda: {
+                                            ("publish", t): hooks.run_fold(
+                                                "client.authorize",
+                                                (cid, "publish", t),
+                                                True,
+                                            )
+                                        },
+                                    )
                                 )
+                        else:
+                            # run the client.subscribe fold HERE (once,
+                            # off-loop) so rewritten filters get their
+                            # verdicts pre-resolved too; the channel
+                            # consumes the folded list instead of re-
+                            # running the chain (presub)
+                            def _presub(pkt=pkt):
+                                acc = hooks.run_fold(
+                                    "client.subscribe", (cid,), pkt.filters
+                                )
+                                filters = (
+                                    acc if acc is not None else pkt.filters
+                                )
+                                verdicts = {
+                                    ("subscribe", f): hooks.run_fold(
+                                        "client.authorize",
+                                        (cid, "subscribe", f),
+                                        True,
+                                    )
+                                    for f, _o in filters
+                                }
+                                return filters, verdicts
+                            (
+                                self.channel.presub_filters,
+                                self.channel.preauthz,
+                            ) = await asyncio.get_running_loop().run_in_executor(
+                                None, _presub
                             )
                     try:
                         out = self.channel.handle_packet(pkt)

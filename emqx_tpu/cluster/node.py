@@ -481,11 +481,31 @@ class ClusterNode:
         self._apply_ops(ops)
 
     def _apply_ops(self, ops: Sequence[tuple]) -> None:
+        """Apply a peer's op stream. Consecutive route-add runs go
+        through Router.add_routes in syncer-sized batches — this is the
+        production storm path (node-join bootstrap dumps, reconnect-
+        wave announcements), the analog of the reference's batched
+        route sync (emqx_router_syncer.erl:57 MAX_BATCH_SIZE)."""
+        pend_adds: List[Tuple[str, str]] = []
+
+        def flush_adds() -> None:
+            if pend_adds:
+                self.cluster_router.add_routes(pend_adds)
+                pend_adds.clear()
+
         for op in ops:
             kind = op[0]
             if kind == "add_r":
-                self._route_add(op[1], op[2])
-            elif kind == "del_r":
+                flt, node = op[1], op[2]
+                if (flt, node) not in self._cluster_pairs:
+                    self._cluster_pairs.add((flt, node))
+                    pend_adds.append((flt, node))
+                    if len(pend_adds) >= 1000:
+                        flush_adds()
+                continue
+            # order matters across kinds: drain the add run first
+            flush_adds()
+            if kind == "del_r":
                 self._route_del(op[1], op[2])
             elif kind == "add_s":
                 _k, group, flt, node, client = op
@@ -502,6 +522,7 @@ class ClusterNode:
                 self._xadd(op[1], op[2], op[3])
             elif kind == "xdel":
                 self._xdel(op[1], op[2], op[3])
+        flush_adds()
 
     def _full_dump_ops(self) -> List[tuple]:
         """Ops reconstructing THIS node's contributions (join announce,

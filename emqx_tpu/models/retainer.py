@@ -16,7 +16,7 @@ from typing import Dict, List, Optional
 
 from ..broker.message import Message
 from ..ops import topic as topic_mod
-from ..ops.host_index import TopicTrie
+from ..ops.host_index import TopicTrie, node_children, node_ids
 
 
 class Retainer:
@@ -74,23 +74,23 @@ class Retainer:
                 if has_hash:
                     if i == 0:
                         # bare '#': root wildcards never cover '$'-topics
-                        results.extend(node.ids)
-                        for cw, child in node.children.items():
+                        results.extend(node_ids(node))
+                        for cw, child in node_children(node):
                             if not cw.startswith("$"):
                                 self._collect_all(child, results)
                     else:
                         self._collect_all(node, results)
                 else:
-                    results.extend(node.ids)
+                    results.extend(node_ids(node))
                 continue
             w = prefix[i]
             if w == "+":
-                for cw, child in node.children.items():
+                for cw, child in node_children(node):
                     if i == 0 and cw.startswith("$"):
                         continue  # '$'-root isolation
                     stack.append((child, i + 1))
             else:
-                child = node.children.get(w)
+                child = node.get(w)
                 if child is not None:
                     stack.append((child, i + 1))
         return results
@@ -99,8 +99,8 @@ class Retainer:
         stack = [node]
         while stack:
             n = stack.pop()
-            results.extend(n.ids)
-            stack.extend(n.children.values())
+            results.extend(node_ids(n))
+            stack.extend(c for _w, c in node_children(n))
 
     def clean(self, now: Optional[float] = None) -> int:
         """Drop expired retained messages; returns count removed."""

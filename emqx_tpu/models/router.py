@@ -268,6 +268,12 @@ class Router:
         # fanout is one row in HBM, not 100k copies of the filter.
         self.table = FilterTable(max_levels=max_levels)
         self._trie = TopicTrie()  # host cut-through; ids are table rows
+        # trie writes from batched route adds are DEFERRED and drained
+        # before the next host-path read (the reference has the same
+        # write-visibility seam: subscribers wait on the router-syncer
+        # flush, emqx_broker.erl:187-193). The device path never reads
+        # the host trie, so storms skip the per-route trie walk.
+        self._trie_pending: List[Tuple[Tuple[str, ...], int]] = []
         self._wild: Dict[str, Dict[Dest, int]] = {}
         self._filter_row: Dict[str, int] = {}
         self._row_filter: Dict[int, str] = {}
@@ -330,13 +336,86 @@ class Router:
                 dests = self._wild.setdefault(flt, {})
                 self._filter_row[flt] = row
                 self._row_filter[row] = flt
-                self._trie.insert(topic_mod.words(flt), row)
+                self._trie_pending.append((self.table.filter_words(row), row))
                 if self.index is not None:
                     self.index.add_row(row, self.table)
         fresh = dest not in dests
         dests[dest] = dests.get(dest, 0) + 1
         if fresh and self.on_dest_added is not None:
             self.on_dest_added(flt, dest)
+
+    def add_routes(self, pairs: Sequence[Tuple[str, Dest]]) -> None:
+        """Batched add_route — the router-syncer write path. The
+        reference flushes route writes in <=1000-op batches through
+        emqx_router:do_batch (emqx_router_syncer.erl:57,
+        emqx_router.erl:255-273); this is that batch entry: dest/dict
+        bookkeeping stays per-pair, but NEW filters go through the
+        vectorized table scatter + class-index bulk placement, which is
+        what subscribe storms (reconnect waves) hit."""
+        new_exact: List[str] = []
+        new_wild: List[str] = []
+        seen_e: Set[str] = set()
+        seen_w: Set[str] = set()
+        wildness: List[bool] = []
+        for flt, _dest in pairs:
+            wild = topic_mod.is_wildcard(flt)
+            wildness.append(wild)
+            if wild:
+                if (
+                    flt not in seen_w
+                    and flt not in self._wild
+                    and flt not in self._deep
+                ):
+                    seen_w.add(flt)
+                    new_wild.append(flt)
+            elif flt not in seen_e and flt not in self._exact:
+                seen_e.add(flt)
+                new_exact.append(flt)
+        idx_rows: List[int] = []
+        if new_exact:
+            rows = self.table.add_bulk(new_exact)
+            for flt, row in zip(new_exact, rows):
+                self._exact[flt] = {}
+                if row < 0:
+                    self._exact_deep.add(flt)
+                else:
+                    self._exact_row[flt] = row
+                    self._row_filter[row] = flt
+                    idx_rows.append(row)
+        if new_wild:
+            rows = self.table.add_bulk(new_wild)
+            for flt, row in zip(new_wild, rows):
+                if row < 0:
+                    self._deep[flt] = {}
+                    self._deep_trie.insert(topic_mod.words(flt), flt)
+                else:
+                    self._wild[flt] = {}
+                    self._filter_row[flt] = row
+                    self._row_filter[row] = flt
+                    self._trie_pending.append(
+                        (self.table.filter_words(row), row)
+                    )
+                    idx_rows.append(row)
+        if idx_rows and self.index is not None:
+            self.index.add_rows(idx_rows, self.table)
+        # dest bookkeeping per pair (duplicates in the batch included)
+        on_added = self.on_dest_added
+        for (flt, dest), wild in zip(pairs, wildness):
+            if not wild:
+                dests = self._exact[flt]
+            else:
+                dests = self._wild.get(flt)
+                if dests is None:
+                    dests = self._deep[flt]
+            fresh = dest not in dests
+            dests[dest] = dests.get(dest, 0) + 1
+            if fresh and on_added is not None:
+                on_added(flt, dest)
+
+    def delete_routes(self, pairs: Sequence[Tuple[str, Dest]]) -> None:
+        """Batched delete_route (the syncer's delete leg)."""
+        for flt, dest in pairs:
+            self.delete_route(flt, dest)
 
     def delete_route(self, flt: str, dest: Dest) -> None:
         if not topic_mod.is_wildcard(flt):
@@ -378,7 +457,7 @@ class Router:
                 del self._wild[flt]
                 row = self._filter_row.pop(flt)
                 del self._row_filter[row]
-                self._trie.remove(topic_mod.words(flt), row)
+                self._host_trie().remove(topic_mod.words(flt), row)
                 if self.index is not None:
                     self.index.remove_row(row)
                 self.table.remove(row)
@@ -431,6 +510,16 @@ class Router:
 
     # --- read path (emqx_router:match_routes) ---------------------------
 
+    def _host_trie(self) -> "TopicTrie":
+        """The host trie with any deferred storm writes drained."""
+        pend = self._trie_pending
+        if pend:
+            ins = self._trie.insert
+            for ws, row in pend:
+                ins(ws, row)
+            pend.clear()
+        return self._trie
+
     def match_filters(self, topic: str) -> List[str]:
         """All routed filters matching one topic (exact key included).
         The primary match result: expansion to destinations is a host
@@ -440,7 +529,7 @@ class Router:
         out: List[str] = []
         if topic in self._exact:
             out.append(topic)
-        for row in self._trie.match(tw):
+        for row in self._host_trie().match(tw):
             out.append(self._row_filter[row])
         if self._deep:
             out.extend(self._deep_trie.match(tw))
@@ -558,7 +647,7 @@ class Router:
                     # dest dict is their host source of truth
                     if t in self._exact_row:
                         out[i].append(t)
-                    for row in self._trie.match(topic_mod.words(t)):
+                    for row in self._host_trie().match(topic_mod.words(t)):
                         out[i].append(self._row_filter[row])
             elif ix.residual_rows:
                 if self.mesh is not None:

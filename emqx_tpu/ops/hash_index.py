@@ -67,7 +67,7 @@ next_pow2(total) and never falls back to full bitmaps.
 from __future__ import annotations
 
 import functools
-from typing import Dict, List, NamedTuple, Optional, Set, Tuple
+from typing import Dict, List, NamedTuple, Optional, Sequence, Set, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -82,6 +82,11 @@ BUCKET_W = 4  # slots per bucket: one u32 probe word per bucket
 MAX_KICKS = 512  # eviction-walk bound before a rebuild
 MIN_SLOTS = 1024
 MAX_LOAD_NUM, MAX_LOAD_DEN = 3, 4  # rebuild past 75% fill
+# the BULK path grows earlier: at 75% fill ~10% of burst keys hit full
+# candidate buckets and pay a ~30us python eviction walk each; at 2/3
+# it's ~3%. Final table sizes are identical (pow2 growth) — only the
+# growth POINT moves, so read-path memory is unchanged.
+BULK_LOAD_NUM, BULK_LOAD_DEN = 2, 3
 
 M32 = 0xFFFFFFFF
 _H1_SEED, _H1_CLS, _H1_MUL = 0x811C9DC5, 0x9E3779B1, 16777619
@@ -108,6 +113,24 @@ def _alt_bucket(b: int, fp: int, mask: int) -> int:
     """The other candidate bucket. Involutive in b, and never b itself
     (the spread is odd so at least bit 0 flips)."""
     return b ^ ((((fp | 1) * _ALT_MUL) & M32) & mask)
+
+
+def _hash_host_batch(
+    cids: np.ndarray, xs: np.ndarray
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Vectorized _hash_host: cids [B], xs uint32 [B, max_levels] with
+    literal positions holding word_id+1 and everything else 0. Must
+    stay bit-identical to the scalar loop (and the device kernel)."""
+    cids = np.ascontiguousarray(cids, np.uint32)
+    xs = np.ascontiguousarray(xs, np.uint32)
+    with np.errstate(over="ignore"):
+        h1 = np.uint32(_H1_SEED) ^ (cids * np.uint32(_H1_CLS))
+        fp = np.uint32(_FP_SEED) + (cids * np.uint32(_FP_CLS))
+        for lvl in range(xs.shape[1]):
+            x = xs[:, lvl]
+            h1 = (h1 ^ x) * np.uint32(_H1_MUL)
+            fp = (fp ^ (x * np.uint32(_FP_XOR))) * np.uint32(_FP_MUL)
+    return h1, fp
 
 
 class ClassMeta(NamedTuple):
@@ -150,6 +173,21 @@ def _pack_probe(slots: SlotArrays) -> None:
     slots.probe[:] = w
 
 
+def _refresh_probe_many(slots: SlotArrays, buckets: np.ndarray) -> None:
+    """Vectorized probe-word recompute for a set of bucket indices."""
+    sub_b = slots.bucket.reshape(-1, BUCKET_W)[buckets]
+    sub_f = slots.fp.reshape(-1, BUCKET_W)[buckets]
+    lanes = np.where(
+        sub_b >= 0,
+        np.maximum(sub_f >> np.uint32(24), np.uint32(1)),
+        np.uint32(0),
+    ).astype(np.uint32)
+    w = lanes[:, 0].copy()
+    for l in range(1, BUCKET_W):
+        w |= lanes[:, l] << np.uint32(8 * l)
+    slots.probe[buckets] = w
+
+
 def _refresh_probe(slots: SlotArrays, b: int) -> None:
     """Recompute one bucket's probe word after slot writes."""
     base = b * BUCKET_W
@@ -162,12 +200,18 @@ def _refresh_probe(slots: SlotArrays, b: int) -> None:
     slots.probe[b] = w
 
 
-class _Bucket(NamedTuple):
-    filter_words: Tuple[str, ...]
-    class_id: int
-    h1: int
-    fp: int
-    slot: int
+class _Bucket:
+    """Mutable: eviction kicks relocate buckets constantly on the
+    churn path, and namedtuple._replace was ~15us per relocation."""
+
+    __slots__ = ("filter_words", "class_id", "h1", "fp", "slot")
+
+    def __init__(self, filter_words, class_id, h1, fp, slot):
+        self.filter_words = filter_words
+        self.class_id = class_id
+        self.h1 = h1
+        self.fp = fp
+        self.slot = slot
 
 
 class _NeedRebuild(Exception):
@@ -440,6 +484,179 @@ class ClassIndex:
         self._class_buckets[cid] += 1
         self._live += 1
 
+    def add_rows(self, rows: Sequence[int], table: FilterTable) -> None:
+        """Batch add_row — same visible state, but the per-row hash and
+        cuckoo placement run vectorized over the burst. This is the
+        write path for router-syncer-style batches (the reference
+        flushes route writes in <=1000-op batches,
+        emqx_router_syncer.erl:57); subscribe storms hit it."""
+        if not rows:
+            return
+        if len(rows) == 1:
+            self.add_row(rows[0], table)
+            return
+        rr = np.asarray(rows, np.int64)
+        plen = table.prefix_len[rr]
+        wids = table.words[rr].astype(np.int64)  # [B, L]
+        lvl = np.arange(wids.shape[1])
+        in_prefix = lvl[None, :] < plen[:, None]
+        isplus = in_prefix & (wids == PLUS)
+        xs = np.where(in_prefix & (wids != PLUS), wids + 1, 0).astype(np.uint32)
+        plus_mask = (
+            isplus.astype(np.uint64) << lvl.astype(np.uint64)[None, :]
+        ).sum(1)
+        plen_l = plen.tolist()
+        hh_l = table.has_hash[rr].tolist()
+        rw_l = table.root_wild[rr].tolist()
+        pm_l = plus_mask.tolist()
+        new_bids: List[int] = []
+        new_idx: List[int] = []
+        new_cids: List[int] = []
+        # hot loop: locals bound once; skeleton-class fast path inlined
+        # (the slow _class_of only runs on a NEW skeleton)
+        filters_l = table._filters
+        bucket_of = self._bucket_of
+        bucket_rows = self._bucket_rows
+        row_bucket = self._row_bucket
+        buckets = self._buckets
+        bucket_free = self._bucket_free
+        skel_class = self._skel_class
+        class_buckets = self._class_buckets
+        live = self._live
+        for i, row in enumerate(rows):
+            if plen_l[i] > 32:
+                self.residual_rows.add(row)
+                self.residual_dirty = True
+                continue
+            ws = filters_l[row]
+            bid = bucket_of.get(ws)
+            if bid is not None:
+                bucket_rows[bid].add(row)
+                row_bucket[row] = bid
+                continue
+            cid = skel_class.get((plen_l[i], hh_l[i], pm_l[i]))
+            if cid is None:
+                cid = self._class_of(plen_l[i], hh_l[i], rw_l[i], pm_l[i])
+                if cid is None:
+                    self.residual_rows.add(row)
+                    self.residual_dirty = True
+                    continue
+            if bucket_free:
+                bid = bucket_free.pop()
+            else:
+                bid = len(buckets)
+                buckets.append(None)
+                bucket_rows.append(None)
+            buckets[bid] = _Bucket(ws, cid, 0, 0, -1)
+            bucket_rows[bid] = {row}
+            bucket_of[ws] = bid
+            row_bucket[row] = bid
+            class_buckets[cid] += 1
+            live += 1
+            new_bids.append(bid)
+            new_idx.append(i)
+            new_cids.append(cid)
+        self._live = live
+        if not new_bids:
+            return
+        h1s, fps = _hash_host_batch(
+            np.asarray(new_cids, np.uint32), xs[new_idx]
+        )
+        h1_l, fp_l = h1s.tolist(), fps.tolist()
+        for j, bid in enumerate(new_bids):
+            b = self._buckets[bid]
+            b.h1, b.fp = h1_l[j], fp_l[j]
+        if self._live * BULK_LOAD_DEN > self.n_slots * BULK_LOAD_NUM:
+            # grow once for the whole burst — the new buckets are
+            # already registered, so the rebuild seats them too
+            need = self.n_buckets * 2
+            while self._live * BULK_LOAD_DEN > need * BUCKET_W * BULK_LOAD_NUM:
+                need *= 2
+            self._rebuild(need)
+            return
+        self._place_bulk(h1s, fps, np.asarray(new_bids, np.int32))
+
+    def _place_bulk(
+        self, h1: np.ndarray, fp: np.ndarray, bids: np.ndarray
+    ) -> None:
+        """Greedy vectorized placement of a key burst into the LIVE
+        table (holes and all): per round, each pending key targets its
+        less-loaded candidate bucket, one key per bucket per round
+        lands in that bucket's first free lane. Stragglers (both
+        buckets full) finish through the single-key eviction walk."""
+        slots, n_buckets = self.slots, self.n_buckets
+        mask = np.uint32(n_buckets - 1)
+        occ = (slots.bucket.reshape(-1, BUCKET_W) >= 0).sum(1).astype(np.int32)
+        with np.errstate(over="ignore"):
+            b1 = (h1 & mask).astype(np.int64)
+            b2 = b1 ^ (
+                ((fp | np.uint32(1)) * np.uint32(_ALT_MUL)) & mask
+            ).astype(np.int64)
+        n = len(h1)
+        pos = np.full(n, -1, np.int64)
+        pending = np.arange(n)
+        stragglers: List[int] = []
+        touched: List[np.ndarray] = []
+        while len(pending):
+            t1, t2 = b1[pending], b2[pending]
+            # keys whose BOTH candidate buckets are full can only land
+            # via eviction kicks — route them to the walk below (occ is
+            # an exact live count, so occ < W guarantees a free lane)
+            both_full = (occ[t1] >= BUCKET_W) & (occ[t2] >= BUCKET_W)
+            if both_full.any():
+                stragglers.extend(pending[both_full].tolist())
+                pending = pending[~both_full]
+                continue
+            tgt = np.where(occ[t1] <= occ[t2], t1, t2)
+            order = np.argsort(tgt, kind="stable")
+            st = tgt[order]
+            first = np.ones(len(st), bool)
+            first[1:] = st[1:] != st[:-1]
+            sel = order[first]  # one key per distinct target bucket
+            tb = tgt[sel]
+            sub = slots.bucket.reshape(-1, BUCKET_W)[tb]
+            lane = np.argmax(sub < 0, 1)
+            rows = pending[sel]
+            sl = tb * BUCKET_W + lane
+            slots.fp[sl] = fp[rows]
+            slots.bucket[sl] = bids[rows]
+            pos[rows] = sl
+            occ[tb] += 1
+            touched.append(sl)
+            keep = np.ones(len(pending), bool)
+            keep[sel] = False
+            pending = pending[keep]
+        pos_l = pos.tolist()
+        bid_l = bids.tolist()
+        for i in range(n):
+            if pos_l[i] >= 0:
+                self._buckets[bid_l[i]].slot = pos_l[i]
+        if touched:
+            allsl = np.concatenate(touched)
+            _refresh_probe_many(slots, np.unique(allsl // BUCKET_W))
+            self.dirty_slots.update(allsl.tolist())
+        if stragglers:
+            # batched eviction walks: share one dirty set, then ONE
+            # probe-refresh + repatch pass (per-key _place paid ~30us
+            # in bookkeeping each; ~10% of keys land here at 75% load)
+            dirty: Set[int] = set()
+            for i in stragglers:
+                if not _evict_insert(
+                    slots, n_buckets, int(b1[i]), int(fp[i]), int(bids[i]),
+                    dirty=dirty,
+                ):
+                    self.dirty_slots.update(dirty)
+                    self._rebuild(self.n_buckets * 2)
+                    return
+            _refresh_probe_many(
+                slots,
+                np.unique(
+                    np.fromiter(dirty, np.int64, len(dirty)) // BUCKET_W
+                ),
+            )
+            self.dirty_slots.update(dirty)
+            self._repatch_slots(dirty)
+
     def remove_row(self, row: int) -> None:
         """Un-index a row (safe before or after table.remove)."""
         if row in self.residual_rows:
@@ -533,8 +750,8 @@ class ClassIndex:
             cur = int(self.slots.bucket[s])
             if cur >= 0:
                 b = self._buckets[cur]
-                if b is not None and b.slot != s:
-                    self._buckets[cur] = b._replace(slot=s)
+                if b is not None:
+                    b.slot = s
 
     def _rebuild(self, n_buckets: int) -> None:
         """Vectorized global re-place into >= n_buckets buckets."""
@@ -549,8 +766,9 @@ class ClassIndex:
         slots, pos, n_buckets = build_slots(
             h1s, fps, ids, min_buckets=max(n_buckets, self._min_buckets)
         )
+        pos_l = pos.tolist()
         for i, bid in enumerate(bids):
-            self._buckets[bid] = self._buckets[bid]._replace(slot=int(pos[i]))
+            self._buckets[bid].slot = pos_l[i]
         self.n_buckets = n_buckets
         self.slots = slots
         self.dirty_slots.clear()

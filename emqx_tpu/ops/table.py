@@ -26,7 +26,7 @@ emqx_router.erl:511-516).
 
 from __future__ import annotations
 
-from typing import Iterator, List, NamedTuple, Optional, Set, Tuple
+from typing import Iterator, List, NamedTuple, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
@@ -104,6 +104,52 @@ class FilterTable:
         self._count += 1
         self.dirty.add(row)
         return row
+
+    def add_bulk(self, filters: Sequence[str]) -> List[int]:
+        """Batch add: one vectorized scatter for the whole burst
+        instead of ~5 numpy scalar writes per row. Returns one row id
+        per filter, -1 where the filter is too deep (the caller's
+        FilterTooDeep degradation, kept in-band so one bad filter
+        doesn't abort the batch)."""
+        L = self.max_levels
+        pad = [OOV] * L
+        rows: List[int] = []
+        padded: List[List[int]] = []
+        plen_b: List[int] = []
+        hh_b: List[bool] = []
+        rw_b: List[bool] = []
+        kept_rows: List[int] = []
+        intern = self.vocab.intern
+        for flt in filters:
+            ws = topic_mod.words(flt)
+            hh = ws[-1] == "#"
+            prefix = ws[:-1] if hh else ws
+            if len(prefix) > L:
+                rows.append(-1)
+                continue
+            while not self._free:
+                self._grow()
+            row = self._free.pop()
+            ids = [intern(w) for w in prefix]
+            padded.append(ids + pad[len(ids):])
+            plen_b.append(len(prefix))
+            hh_b.append(hh)
+            rw_b.append(
+                (hh and not prefix) or (bool(prefix) and prefix[0] == "+")
+            )
+            self._filters[row] = ws
+            rows.append(row)
+            kept_rows.append(row)
+        if kept_rows:
+            rr = np.asarray(kept_rows, np.int64)
+            self.words[rr] = np.asarray(padded, np.int32)
+            self.prefix_len[rr] = plen_b
+            self.has_hash[rr] = hh_b
+            self.root_wild[rr] = rw_b
+            self.active[rr] = True
+            self._count += len(kept_rows)
+            self.dirty.update(kept_rows)
+        return rows
 
     def remove(self, row: int) -> None:
         ws = self._filters[row]

@@ -311,3 +311,66 @@ def test_clientid_too_long_rejected():
     ch = Channel(b)
     out = ch.handle_packet(Connect(client_id="way-too-long-id", proto_ver=MQTT_V5))
     assert out[0].code == RC.CLIENT_IDENTIFIER_NOT_VALID
+
+
+async def test_rewrite_with_slow_authz_preresolved_off_loop():
+    """Rewrite module + network-backed authz: the connection layer runs
+    the client.subscribe fold ONCE off-loop and pre-resolves verdicts
+    for the REWRITTEN filters, so no slow authz call lands on the event
+    loop and the chain doesn't run twice (code-review r4 finding)."""
+    import asyncio
+
+    from emqx_tpu.auth.authz import Source
+    from emqx_tpu.auth.bridge import AuthPipeline
+    from emqx_tpu.broker import frame as F
+    from emqx_tpu.broker.packet import Connack, Connect, Suback
+    from emqx_tpu.broker.server import Server
+
+    calls = []
+
+    class CountingSlowSource(Source):
+        blocking = True  # advertises the off-loop requirement
+
+        def authorize(self, client_id, username, peerhost, action, topic):
+            calls.append((action, topic))
+            return "deny" if topic.startswith("secret") else "allow"
+
+    b = Broker()
+    pipe = AuthPipeline()
+    pipe.authz.add_source(CountingSlowSource())
+    pipe.install(b.hooks)
+    rw = TopicRewrite(
+        b,
+        [{"action": "all", "source_topic": "x/#",
+          "re": r"^x/(.+)$", "dest_topic": "secret/$1"}],
+    )
+    rw.enable()
+    assert b.hooks.has_slow("client.authorize")
+
+    srv = Server(broker=b, port=0)
+    await srv.start()
+    try:
+        r, w = await asyncio.open_connection(*srv.listen_addr)
+        parser = F.Parser(proto_ver=5)
+        w.write(F.serialize(Connect(client_id="c1", proto_ver=5), 5))
+        await w.drain()
+
+        async def read_one(typ):
+            while True:
+                data = await asyncio.wait_for(r.read(4096), 5)
+                assert data
+                for p in parser.feed(data):
+                    assert isinstance(p, typ), p
+                    return p
+
+        await read_one(Connack)
+        w.write(F.serialize(
+            Subscribe(packet_id=1, filters=[("x/a", SubOpts())]), 5))
+        await w.drain()
+        sub = await read_one(Suback)
+        # the REWRITTEN filter (secret/a) was the one authorized -> deny
+        assert sub.codes == [0x87]
+        assert calls == [("subscribe", "secret/a")]  # once, rewritten
+        w.close()
+    finally:
+        await srv.stop()

@@ -123,3 +123,52 @@ def test_topics_listing():
     r.add_route("a/b", "n")
     r.add_route("a/+", "n")
     assert r.topics() == ["a/+", "a/b"]
+
+
+def test_add_routes_batch_equals_single_path():
+    """Router.add_routes (the syncer-batch write path) must leave the
+    router in EXACTLY the state N add_route calls produce: same match
+    results on every leg (exact, indexed wildcard, deep fallback),
+    same dest refcounts, including duplicate filters inside one batch
+    and the deferred host-trie drain."""
+    import random
+
+    from emqx_tpu.models.router import Router
+
+    random.seed(11)
+    single = Router(max_levels=8)
+    batched = Router(max_levels=8)
+    pairs = []
+    for i in range(3000):
+        k = random.random()
+        if k < 0.25:
+            flt = f"exact/{i % 41}/x{i % 211}"
+        elif k < 0.8:
+            flt = f"b/{i % 101}/d{i % 509}/+/#"
+        else:
+            deep = "/".join(str(j) for j in range(11))
+            flt = f"deep/{deep}/{i % 13}/#"
+        pairs.append((flt, f"n{i % 5}"))
+    for f, d in pairs:
+        single.add_route(f, d)
+    for i in range(0, len(pairs), 512):
+        batched.add_routes(pairs[i : i + 512])
+    topics = [
+        "exact/5/x5", "b/3/d3/any/deeper/level", "b/100/d100/e",
+        "deep/0/1/2/3/4/5/6/7/8/9/10/5/tail/x", "none/of/it",
+        "exact/40/x209",
+    ]
+    for t in topics:
+        assert sorted(single.match_filters(t)) == sorted(
+            batched.match_filters(t)
+        ), t
+        assert single.match_routes(t) == batched.match_routes(t), t
+    bm = batched.match_filters_batch(topics)
+    sm = single.match_filters_batch(topics)
+    assert [sorted(x) for x in bm] == [sorted(x) for x in sm]
+    # refcounts survive: deleting every pair empties both routers
+    for f, d in pairs:
+        single.delete_route(f, d)
+        batched.delete_route(f, d)
+    assert batched.topic_count() == single.topic_count() == 0
+    assert len(batched.table) == 0
