@@ -167,6 +167,11 @@ def eval_expr(e: Any, env: Dict[str, Any]) -> Any:
         fn = FUNCS.get(e[1])
         if fn is None:
             raise SqlError(f"unknown function {e[1]!r}")
+        if getattr(fn, "_wants_env", False):
+            # message-context accessors (clientid(), payload(path), …)
+            # read the event env directly, like the reference's
+            # closure-over-message builtins (emqx_rule_funcs.erl:317-396)
+            return fn(env, *(eval_expr(a, env) for a in e[2]))
         return fn(*(eval_expr(a, env) for a in e[2]))
     raise SqlError(f"bad expr node {op!r}")
 
