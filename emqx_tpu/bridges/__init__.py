@@ -9,6 +9,17 @@ v2 actions/sources stack, SURVEY.md §2.6).
   * bridge     — named bridges: connector + actions (egress, fed by
                  local topic filters or rule actions) + sources
                  (ingress publishing into the local broker).
+
+Wire-real backends (each speaks its protocol against an in-process
+mini-server in tests):
+
+  kafka (+confluent), mqtt, http, redis, postgres (+timescale,
+  matrix), mysql, mongodb, influxdb, sqlserver (TDS), cassandra
+  (CQL v4), clickhouse, rabbitmq (AMQP 0-9-1), pulsar (binary proto),
+  gcp_pubsub (REST+JWT), aws: s3 / kinesis / dynamodb (SigV4),
+  elasticsearch, tdengine, iotdb, opentsdb, greptimedb, datalayers,
+  couchbase, snowflake (key-pair JWT), azure blob (SharedKey),
+  rocketmq (remoting), syskeeper (forwarder + proxy halves).
 """
 
 from .bridge import Bridge, BridgeRegistry  # noqa: F401
@@ -20,3 +31,59 @@ from .resource import (  # noqa: F401
     Resource,
     ResourceStatus,
 )
+
+# connector registry: config/REST `type` -> constructor module path.
+# Imports stay lazy (each module pulls its wire deps on first use).
+CONNECTOR_TYPES = {
+    "mqtt": ("emqx_tpu.bridges.connectors", "MqttConnector"),
+    "http": ("emqx_tpu.bridges.connectors", "HttpConnector"),
+    "webhook": ("emqx_tpu.bridges.connectors", "HttpConnector"),
+    "console": ("emqx_tpu.bridges.connectors", "ConsoleConnector"),
+    "kafka_producer": ("emqx_tpu.bridges.kafka", "KafkaProducer"),
+    "kafka_consumer": ("emqx_tpu.bridges.kafka", "KafkaConsumer"),
+    "confluent_producer": ("emqx_tpu.bridges.confluent", "ConfluentProducer"),
+    "redis": ("emqx_tpu.bridges.redis", "RedisConnector"),
+    "pgsql": ("emqx_tpu.bridges.postgres", "PostgresConnector"),
+    "timescale": ("emqx_tpu.bridges.timescale", "TimescaleConnector"),
+    "matrix": ("emqx_tpu.bridges.timescale", "MatrixConnector"),
+    "mysql": ("emqx_tpu.bridges.mysql", "MySqlConnector"),
+    "mongodb": ("emqx_tpu.bridges.mongodb", "MongoConnector"),
+    "influxdb": ("emqx_tpu.bridges.influxdb", "InfluxConnector"),
+    "sqlserver": ("emqx_tpu.bridges.sqlserver", "SqlServerConnector"),
+    "cassandra": ("emqx_tpu.bridges.cassandra", "CassandraConnector"),
+    "clickhouse": ("emqx_tpu.bridges.clickhouse", "ClickHouseConnector"),
+    "rabbitmq": ("emqx_tpu.bridges.rabbitmq", "RabbitMqConnector"),
+    "pulsar_producer": ("emqx_tpu.bridges.pulsar", "PulsarConnector"),
+    "gcp_pubsub": ("emqx_tpu.bridges.gcp_pubsub", "GcpPubSubConnector"),
+    "s3": ("emqx_tpu.bridges.aws", "S3Connector"),
+    "kinesis": ("emqx_tpu.bridges.aws", "KinesisConnector"),
+    "dynamo": ("emqx_tpu.bridges.aws", "DynamoConnector"),
+    "elasticsearch": ("emqx_tpu.bridges.http_family", "ElasticsearchConnector"),
+    "tdengine": ("emqx_tpu.bridges.http_family", "TDengineConnector"),
+    "iotdb": ("emqx_tpu.bridges.http_family", "IotdbConnector"),
+    "opents": ("emqx_tpu.bridges.http_family", "OpenTsdbConnector"),
+    "greptimedb": ("emqx_tpu.bridges.http_family", "GreptimeConnector"),
+    "datalayers": ("emqx_tpu.bridges.http_family", "DatalayersConnector"),
+    "couchbase": ("emqx_tpu.bridges.http_family", "CouchbaseConnector"),
+    "snowflake": ("emqx_tpu.bridges.http_family", "SnowflakeConnector"),
+    "azure_blob_storage": ("emqx_tpu.bridges.http_family", "AzureBlobConnector"),
+    "rocketmq": ("emqx_tpu.bridges.rocketmq", "RocketMqConnector"),
+    "syskeeper_forwarder": ("emqx_tpu.bridges.syskeeper", "SyskeeperConnector"),
+    "syskeeper_proxy": ("emqx_tpu.bridges.syskeeper", "SyskeeperProxyConnector"),
+}
+
+
+def connector_class(type_name: str):
+    """Resolve a config/REST bridge `type` to its connector class."""
+    import importlib
+
+    try:
+        mod_name, cls_name = CONNECTOR_TYPES[type_name]
+    except KeyError:
+        raise ValueError(f"unknown connector type {type_name!r}") from None
+    return getattr(importlib.import_module(mod_name), cls_name)
+
+
+def make_connector(type_name: str, **conf):
+    """Construct a connector from config (`type` + its options)."""
+    return connector_class(type_name)(**conf)

@@ -1,0 +1,283 @@
+"""Cassandra bridge — CQL binary protocol v4.
+
+The reference's emqx_bridge_cassandra drives ecql
+(apps/emqx_bridge_cassandra/src/emqx_bridge_cassandra_connector.erl);
+this client speaks the native protocol (CQL spec v4):
+
+    frame: version(1: 0x04 req / 0x84 resp) flags(1) stream(2 BE)
+    opcode(1) length(4 BE) body
+    STARTUP (0x01, string-map {CQL_VERSION: 3.0.0})
+      -> READY (0x02) | AUTHENTICATE (0x03)
+    AUTH_RESPONSE (0x0F, SASL PLAIN \\0user\\0pass)
+      -> AUTH_SUCCESS (0x10)
+    QUERY (0x07, long-string + consistency u16 + flags u8)
+      -> RESULT (0x08; kind 1 void / 2 rows) | ERROR (0x00)
+
+Rows decode as UTF-8 text (the bridge path is INSERT-shaped).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import socket
+import struct
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from .postgres import render_sql
+from .resource import Connector, QueryError, RecoverableError, ResourceStatus
+
+OP_ERROR = 0x00
+OP_STARTUP = 0x01
+OP_READY = 0x02
+OP_AUTHENTICATE = 0x03
+OP_QUERY = 0x07
+OP_RESULT = 0x08
+OP_AUTH_RESPONSE = 0x0F
+OP_AUTH_SUCCESS = 0x10
+
+CONSISTENCY_ONE = 0x0001
+
+
+class CqlError(QueryError):
+    pass
+
+
+def frame(opcode: int, body: bytes, stream: int = 0) -> bytes:
+    return struct.pack(">BBhBI", 0x04, 0, stream, opcode, len(body)) + body
+
+
+def string_map(m: Dict[str, str]) -> bytes:
+    out = struct.pack(">H", len(m))
+    for k, v in m.items():
+        out += struct.pack(">H", len(k)) + k.encode()
+        out += struct.pack(">H", len(v)) + v.encode()
+    return out
+
+
+def long_string(s: str) -> bytes:
+    b = s.encode()
+    return struct.pack(">I", len(b)) + b
+
+
+class CqlFramer:
+    def __init__(self) -> None:
+        self._buf = bytearray()
+
+    def feed(self, data: bytes) -> List[Tuple[int, int, bytes]]:
+        self._buf.extend(data)
+        out = []
+        while len(self._buf) >= 9:
+            _v, _f, stream, opcode, n = struct.unpack_from(
+                ">BBhBI", self._buf, 0
+            )
+            if len(self._buf) < 9 + n:
+                break
+            out.append((stream, opcode, bytes(self._buf[9 : 9 + n])))
+            del self._buf[: 9 + n]
+        return out
+
+
+def parse_rows(body: bytes) -> Tuple[List[str], List[List[Optional[str]]]]:
+    """RESULT kind=2 Rows: metadata + row content, text decoding."""
+    (flags, col_count) = struct.unpack_from(">II", body, 0)
+    off = 8
+    if flags & 0x0002:  # has_more_pages: paging state
+        (n,) = struct.unpack_from(">i", body, off)
+        off += 4 + max(n, 0)
+    names: List[str] = []
+    global_tables = bool(flags & 0x0001)
+    if global_tables:
+        for _ in range(2):  # keyspace + table
+            (n,) = struct.unpack_from(">H", body, off)
+            off += 2 + n
+    for _ in range(col_count):
+        if not global_tables:
+            for _ in range(2):
+                (n,) = struct.unpack_from(">H", body, off)
+                off += 2 + n
+        (n,) = struct.unpack_from(">H", body, off)
+        names.append(body[off + 2 : off + 2 + n].decode())
+        off += 2 + n
+        (t,) = struct.unpack_from(">H", body, off)
+        off += 2
+        if t == 0x0000:  # custom: classname string
+            (n,) = struct.unpack_from(">H", body, off)
+            off += 2 + n
+    (row_count,) = struct.unpack_from(">I", body, off)
+    off += 4
+    rows: List[List[Optional[str]]] = []
+    for _ in range(row_count):
+        row: List[Optional[str]] = []
+        for _ in range(col_count):
+            (n,) = struct.unpack_from(">i", body, off)
+            off += 4
+            if n < 0:
+                row.append(None)
+            else:
+                row.append(body[off : off + n].decode("utf-8", "replace"))
+                off += n
+        rows.append(row)
+    return names, rows
+
+
+class CassandraClient:
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 9042,
+        user: str = "",
+        password: str = "",
+        keyspace: str = "",
+        timeout: float = 5.0,
+    ) -> None:
+        self.host, self.port = host, port
+        self.user, self.password = user, password
+        self.keyspace = keyspace
+        self.timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._framer = CqlFramer()
+        self._lock = threading.Lock()
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+
+    def _read_frame(self) -> Tuple[int, int, bytes]:
+        while True:
+            data = self._sock.recv(65536)
+            if not data:
+                raise ConnectionError("cassandra closed connection")
+            frames = self._framer.feed(data)
+            if frames:
+                return frames[0]
+
+    @staticmethod
+    def _error(body: bytes) -> str:
+        (code,) = struct.unpack_from(">I", body, 0)
+        (n,) = struct.unpack_from(">H", body, 4)
+        return f"0x{code:04x} {body[6 : 6 + n].decode('utf-8', 'replace')}"
+
+    def _connect(self) -> None:
+        s = socket.create_connection((self.host, self.port), self.timeout)
+        s.settimeout(self.timeout)
+        self._framer = CqlFramer()
+        self._sock = s
+        s.sendall(frame(OP_STARTUP, string_map({"CQL_VERSION": "3.0.0"})))
+        _st, op, body = self._read_frame()
+        if op == OP_AUTHENTICATE:
+            token = b"\x00" + self.user.encode() + b"\x00" + self.password.encode()
+            s.sendall(frame(
+                OP_AUTH_RESPONSE, struct.pack(">I", len(token)) + token
+            ))
+            _st, op, body = self._read_frame()
+            if op != OP_AUTH_SUCCESS:
+                raise CqlError(
+                    f"auth failed: {self._error(body) if op == OP_ERROR else op}"
+                )
+        elif op != OP_READY:
+            raise CqlError(
+                self._error(body) if op == OP_ERROR else f"unexpected op {op}"
+            )
+        if self.keyspace:
+            self._query_locked(f'USE "{self.keyspace}"')
+
+    def query(self, cql: str):
+        with self._lock:
+            try:
+                if self._sock is None:
+                    self._connect()
+                return self._query_locked(cql)
+            except CqlError:
+                raise
+            except Exception:
+                self.close()
+                raise
+
+    def _query_locked(self, cql: str):
+        body = long_string(cql) + struct.pack(">HB", CONSISTENCY_ONE, 0)
+        self._sock.sendall(frame(OP_QUERY, body, stream=1))
+        _st, op, rbody = self._read_frame()
+        if op == OP_ERROR:
+            raise CqlError(self._error(rbody))
+        if op != OP_RESULT:
+            raise CqlError(f"unexpected opcode {op}")
+        (kind,) = struct.unpack_from(">I", rbody, 0)
+        if kind == 0x0001:  # void
+            return [], []
+        if kind == 0x0002:  # rows
+            return parse_rows(rbody[4:])
+        if kind == 0x0003:  # set_keyspace
+            return [], []
+        raise CqlError(f"unsupported result kind {kind}")
+
+    def ping(self) -> bool:
+        try:
+            self.query("SELECT release_version FROM system.local")
+            return True
+        except Exception:
+            return False
+
+
+class CassandraConnector(Connector):
+    """Bridge driver: cql template rendered per request
+    (emqx_bridge_cassandra's cql template)."""
+
+    wants_env = True
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 9042,
+        user: str = "",
+        password: str = "",
+        keyspace: str = "",
+        cql_template: Optional[str] = None,
+        timeout: float = 5.0,
+    ) -> None:
+        self._mk = lambda: CassandraClient(
+            host, port, user=user, password=password, keyspace=keyspace,
+            timeout=timeout,
+        )
+        self.cql_template = cql_template
+        self.client: Optional[CassandraClient] = None
+
+    async def on_start(self) -> None:
+        self.client = self._mk()
+        ok = await asyncio.get_running_loop().run_in_executor(
+            None, self.client.ping
+        )
+        if not ok:
+            raise RecoverableError("cassandra unreachable")
+
+    async def on_stop(self) -> None:
+        if self.client is not None:
+            self.client.close()
+            self.client = None
+
+    async def on_query(self, request: Any) -> Any:
+        if isinstance(request, str):
+            cql = request
+        else:
+            if not self.cql_template:
+                raise QueryError("cassandra action has no cql_template")
+            cql = render_sql(self.cql_template, dict(request))
+        try:
+            return await asyncio.get_running_loop().run_in_executor(
+                None, self.client.query, cql
+            )
+        except CqlError:
+            raise
+        except Exception as e:
+            raise RecoverableError(str(e)) from e
+
+    async def health_check(self) -> ResourceStatus:
+        if self.client is None:
+            return ResourceStatus.CONNECTING
+        ok = await asyncio.get_running_loop().run_in_executor(
+            None, self.client.ping
+        )
+        return ResourceStatus.CONNECTED if ok else ResourceStatus.CONNECTING

@@ -1,0 +1,126 @@
+"""ClickHouse bridge — HTTP interface.
+
+The reference's emqx_bridge_clickhouse drives clickhouse-client over
+the HTTP interface (apps/emqx_bridge_clickhouse/src/
+emqx_bridge_clickhouse_connector.erl): POST the SQL to `/` with
+X-ClickHouse-User/-Key auth headers; 200 = ok, body carries data for
+SELECTs (FORMAT JSONEachRow). Batches join VALUES tuples into one
+INSERT, like the reference's batch_value_separator handling.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Any, Dict, List, Optional
+
+from .postgres import render_sql
+from .resource import Connector, QueryError, RecoverableError, ResourceStatus
+
+
+class ClickHouseConnector(Connector):
+    wants_env = True
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 8123,
+        user: str = "default",
+        password: str = "",
+        database: str = "default",
+        sql_template: Optional[str] = None,
+        batch_value_separator: str = ", ",
+        timeout: float = 5.0,
+    ) -> None:
+        self.host, self.port = host, port
+        self.user, self.password, self.database = user, password, database
+        self.sql_template = sql_template
+        self.sep = batch_value_separator
+        self.timeout = timeout
+
+    async def _post(self, sql: str) -> bytes:
+        body = sql.encode()
+        try:
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(self.host, self.port), self.timeout
+            )
+        except (OSError, asyncio.TimeoutError) as e:
+            raise RecoverableError(f"connect failed: {e}") from e
+        try:
+            head = (
+                f"POST /?database={self.database} HTTP/1.1\r\n"
+                f"host: {self.host}\r\n"
+                f"x-clickhouse-user: {self.user}\r\n"
+                f"x-clickhouse-key: {self.password}\r\n"
+                f"content-length: {len(body)}\r\n"
+                "connection: close\r\n\r\n"
+            )
+            writer.write(head.encode() + body)
+            await writer.drain()
+            raw = await asyncio.wait_for(reader.read(-1), self.timeout)
+        except (OSError, asyncio.TimeoutError, ConnectionError) as e:
+            raise RecoverableError(f"request failed: {e}") from e
+        finally:
+            writer.close()
+        try:
+            status = int(raw.split(b" ", 2)[1])
+            payload = raw.split(b"\r\n\r\n", 1)[1] if b"\r\n\r\n" in raw else b""
+        except (IndexError, ValueError) as e:
+            raise QueryError(f"bad http response: {e}") from e
+        if status >= 500:
+            raise RecoverableError(
+                f"server error {status}: {payload[:200].decode('utf-8', 'replace')}"
+            )
+        if status >= 400:
+            raise QueryError(
+                f"rejected {status}: {payload[:200].decode('utf-8', 'replace')}"
+            )
+        return payload
+
+    def _render(self, request: Any) -> str:
+        if isinstance(request, str):
+            return request
+        if not self.sql_template:
+            raise QueryError("clickhouse action has no sql_template")
+        return render_sql(self.sql_template, dict(request))
+
+    async def on_query(self, request: Any) -> Any:
+        return await self._post(self._render(request))
+
+    async def on_batch_query(self, requests: List[Any]) -> Any:
+        """INSERT batching: shared prefix + joined VALUES tuples (the
+        reference splits the template at 'VALUES')."""
+        sqls = [self._render(r) for r in requests]
+        prefix = None
+        values = []
+        for s in sqls:
+            up = s.upper()
+            i = up.find("VALUES")
+            if i < 0 or (prefix is not None and s[: i + 6] != prefix):
+                # heterogeneous batch: run sequentially
+                for one in sqls:
+                    await self._post(one)
+                return len(sqls)
+            if prefix is None:
+                prefix = s[: i + 6]
+            values.append(s[i + 6 :].strip())
+        await self._post(prefix + " " + self.sep.join(values))
+        return len(sqls)
+
+    async def select_json(self, sql: str) -> List[Dict[str, Any]]:
+        """SELECT helper: FORMAT JSONEachRow parsing."""
+        if "FORMAT" not in sql.upper():
+            sql = sql.rstrip("; ") + " FORMAT JSONEachRow"
+        out = await self._post(sql)
+        return [
+            json.loads(line)
+            for line in out.decode("utf-8", "replace").splitlines()
+            if line.strip()
+        ]
+
+    async def health_check(self) -> ResourceStatus:
+        try:
+            await self._post("SELECT 1")
+            return ResourceStatus.CONNECTED
+        except Exception:
+            return ResourceStatus.DISCONNECTED

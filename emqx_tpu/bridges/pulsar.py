@@ -1,0 +1,307 @@
+"""Apache Pulsar bridge — binary protocol (protobuf-framed).
+
+The reference's emqx_bridge_pulsar drives the pulsar Erlang client
+(apps/emqx_bridge_pulsar/src/emqx_bridge_pulsar.erl); this speaks the
+Pulsar binary protocol (PulsarApi.proto subset, re-declared below and
+encoded with the in-house proto codec):
+
+    simple command frame: totalSize(4 BE) commandSize(4 BE) BaseCommand
+    payload command frame (SEND): ... + magic 0x0e01 + crc32c(4)
+      + metadataSize(4) + MessageMetadata + payload
+    CONNECT -> CONNECTED, PRODUCER -> PRODUCER_SUCCESS,
+    SEND -> SEND_RECEIPT, PING -> PONG.
+
+The checksum is CRC32C (Castagnoli) over metadataSize+metadata+payload,
+matching the Pulsar framing spec; the native crc32c library computes it
+when available, with a table-driven fallback.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..transform.protobuf import ProtoCodec, ProtoFile
+from .resource import Connector, QueryError, RecoverableError, ResourceStatus
+
+PULSAR_PROTO = """
+syntax = "proto2";
+
+enum CommandType {
+    CONNECT = 2;
+    CONNECTED = 3;
+    PRODUCER = 5;
+    SEND = 6;
+    SEND_RECEIPT = 7;
+    SEND_ERROR = 8;
+    PING = 18;
+    PONG = 19;
+    PRODUCER_SUCCESS = 17;
+    CLOSE_PRODUCER = 28;
+    ERROR = 30;
+}
+
+message CommandConnect {
+    required string client_version = 1;
+    optional int32 protocol_version = 4;
+    optional string auth_method_name = 5;
+    optional bytes auth_data = 6;
+}
+
+message CommandConnected {
+    required string server_version = 1;
+    optional int32 protocol_version = 2;
+}
+
+message CommandProducer {
+    required string topic = 1;
+    required uint64 producer_id = 2;
+    required uint64 request_id = 3;
+    optional string producer_name = 4;
+}
+
+message CommandProducerSuccess {
+    required uint64 request_id = 1;
+    required string producer_name = 2;
+}
+
+message CommandSend {
+    required uint64 producer_id = 1;
+    required uint64 sequence_id = 2;
+    optional int32 num_messages = 3;
+}
+
+message MessageIdData {
+    required uint64 ledgerId = 1;
+    required uint64 entryId = 2;
+}
+
+message CommandSendReceipt {
+    required uint64 producer_id = 1;
+    required uint64 sequence_id = 2;
+    optional MessageIdData message_id = 3;
+}
+
+message CommandSendError {
+    required uint64 producer_id = 1;
+    required uint64 sequence_id = 2;
+    required string message = 4;
+}
+
+message CommandError {
+    required uint64 request_id = 1;
+    required string message = 3;
+}
+
+message CommandPing { optional bool dummy = 1; }
+message CommandPong { optional bool dummy = 1; }
+
+message MessageMetadata {
+    required string producer_name = 1;
+    required uint64 sequence_id = 2;
+    required uint64 publish_time = 3;
+    optional string partition_key = 11;
+}
+
+message BaseCommand {
+    required CommandType type = 1;
+    optional CommandConnect connect = 2;
+    optional CommandConnected connected = 3;
+    optional CommandProducer producer = 5;
+    optional CommandSend send = 6;
+    optional CommandSendReceipt send_receipt = 7;
+    optional CommandSendError send_error = 8;
+    optional CommandPing ping = 18;
+    optional CommandPong pong = 19;
+    optional CommandProducerSuccess producer_success = 17;
+    optional CommandError error = 30;
+}
+"""
+
+_PROTO = ProtoFile(PULSAR_PROTO)
+CODEC = ProtoCodec(_PROTO, "BaseCommand")
+META_CODEC = ProtoCodec(_PROTO, "MessageMetadata")
+
+MAGIC = b"\x0e\x01"
+
+
+def crc32c(data: bytes) -> int:
+    from .kafka import _load_crc32c  # native lib w/ python fallback
+
+    return _load_crc32c()(data)
+
+
+class PulsarError(QueryError):
+    pass
+
+
+def simple_frame(cmd: Dict[str, Any]) -> bytes:
+    body = CODEC.encode(cmd)
+    return struct.pack(">II", len(body) + 4, len(body)) + body
+
+
+def payload_frame(cmd: Dict[str, Any], metadata: Dict[str, Any],
+                  payload: bytes) -> bytes:
+    body = CODEC.encode(cmd)
+    meta = META_CODEC.encode(metadata)
+    rest = struct.pack(">I", len(meta)) + meta + payload
+    crc = crc32c(rest)
+    total = 4 + len(body) + 2 + 4 + len(rest)
+    return (
+        struct.pack(">II", total, len(body)) + body
+        + MAGIC + struct.pack(">I", crc) + rest
+    )
+
+
+class PulsarFramer:
+    """Incremental frames: feed -> [(BaseCommand dict, payload|None)]."""
+
+    def __init__(self) -> None:
+        self._buf = bytearray()
+
+    def feed(self, data: bytes) -> List[Tuple[Dict[str, Any], Optional[bytes]]]:
+        self._buf.extend(data)
+        out = []
+        while len(self._buf) >= 4:
+            (total,) = struct.unpack_from(">I", self._buf, 0)
+            if len(self._buf) < 4 + total:
+                break
+            frame = bytes(self._buf[4 : 4 + total])
+            del self._buf[: 4 + total]
+            (csize,) = struct.unpack_from(">I", frame, 0)
+            cmd = CODEC.decode(frame[4 : 4 + csize])
+            rest = frame[4 + csize :]
+            payload = None
+            if rest[:2] == MAGIC:
+                (crc,) = struct.unpack_from(">I", rest, 2)
+                body = rest[6:]
+                if crc32c(body) != crc:
+                    raise PulsarError("payload checksum mismatch")
+                (msize,) = struct.unpack_from(">I", body, 0)
+                payload = body[4 + msize :]
+            out.append((cmd, payload))
+        return out
+
+
+class PulsarConnector(Connector):
+    """Producer on one topic (emqx_bridge_pulsar message template ->
+    payload; strict per-send receipts)."""
+
+    wants_env = True
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 6650,
+        topic: str = "persistent://public/default/mqtt",
+        payload_template: str = "${payload}",
+        partition_key_template: str = "${clientid}",
+        timeout: float = 5.0,
+    ):
+        self.host, self.port = host, port
+        self.topic = topic
+        self.payload_template = payload_template
+        self.pk_template = partition_key_template
+        self.timeout = timeout
+        self._reader = None
+        self._writer = None
+        self._framer = PulsarFramer()
+        self._inbox: List[Tuple[Dict[str, Any], Optional[bytes]]] = []
+        self._seq = 0
+        self.producer_name = ""
+
+    async def _recv(self, want: str) -> Dict[str, Any]:
+        while True:
+            while self._inbox:
+                cmd, _payload = self._inbox.pop(0)
+                t = cmd.get("type")
+                if t == "PING":
+                    self._writer.write(simple_frame(
+                        {"type": "PONG", "pong": {}}
+                    ))
+                    await self._writer.drain()
+                    continue
+                if t in ("ERROR", "SEND_ERROR"):
+                    info = cmd.get("error") or cmd.get("send_error") or {}
+                    raise PulsarError(info.get("message", "pulsar error"))
+                if t != want:
+                    raise PulsarError(f"expected {want}, got {t}")
+                return cmd
+            data = await asyncio.wait_for(
+                self._reader.read(65536), self.timeout
+            )
+            if not data:
+                raise ConnectionError("pulsar closed connection")
+            self._inbox.extend(self._framer.feed(data))
+
+    async def on_start(self) -> None:
+        try:
+            self._reader, self._writer = await asyncio.wait_for(
+                asyncio.open_connection(self.host, self.port), self.timeout
+            )
+            self._framer = PulsarFramer()
+            self._inbox = []
+            self._writer.write(simple_frame({
+                "type": "CONNECT",
+                "connect": {
+                    "client_version": "emqx-tpu-0.4",
+                    "protocol_version": 15,
+                },
+            }))
+            await self._writer.drain()
+            await self._recv("CONNECTED")
+            self._writer.write(simple_frame({
+                "type": "PRODUCER",
+                "producer": {
+                    "topic": self.topic, "producer_id": 1, "request_id": 1,
+                },
+            }))
+            await self._writer.drain()
+            ok = await self._recv("PRODUCER_SUCCESS")
+            self.producer_name = ok["producer_success"]["producer_name"]
+        except (OSError, asyncio.TimeoutError, ConnectionError) as e:
+            raise RecoverableError(f"pulsar connect failed: {e}") from e
+
+    async def on_stop(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+            self._reader = None
+
+    async def on_query(self, request: Any) -> Any:
+        if self._writer is None:
+            raise RecoverableError("pulsar not connected")
+        from ..rules.engine import render_template
+
+        import time as _time
+
+        env = dict(request) if isinstance(request, dict) else {"payload": request}
+        payload = render_template(self.payload_template, env).encode()
+        self._seq += 1
+        seq = self._seq
+        try:
+            self._writer.write(payload_frame(
+                {"type": "SEND",
+                 "send": {"producer_id": 1, "sequence_id": seq,
+                          "num_messages": 1}},
+                {"producer_name": self.producer_name, "sequence_id": seq,
+                 "publish_time": int(_time.time() * 1000),
+                 "partition_key": render_template(self.pk_template, env)},
+                payload,
+            ))
+            await self._writer.drain()
+            receipt = await self._recv("SEND_RECEIPT")
+            got = receipt["send_receipt"]["sequence_id"]
+            if got != seq:
+                raise PulsarError(f"receipt for {got}, wanted {seq}")
+            return receipt["send_receipt"]
+        except (OSError, asyncio.TimeoutError, ConnectionError) as e:
+            raise RecoverableError(str(e)) from e
+
+    async def health_check(self) -> ResourceStatus:
+        return (
+            ResourceStatus.CONNECTED
+            if self._writer is not None
+            else ResourceStatus.DISCONNECTED
+        )

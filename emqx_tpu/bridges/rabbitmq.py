@@ -1,0 +1,267 @@
+"""RabbitMQ bridge — AMQP 0-9-1 wire protocol.
+
+The reference's emqx_bridge_rabbitmq drives the amqp_client library
+(apps/emqx_bridge_rabbitmq/src/emqx_bridge_rabbitmq_connector.erl);
+this client speaks the protocol itself (AMQP 0-9-1 spec):
+
+    "AMQP\\x00\\x00\\x09\\x01" preamble
+    connection.start -> start-ok (PLAIN SASL "\\0user\\0pass")
+    connection.tune -> tune-ok, connection.open(vhost) -> open-ok
+    channel.open -> open-ok, confirm.select -> select-ok
+    basic.publish(exchange, routing_key)
+      + content HEADER frame (class 60, body size, delivery_mode)
+      + content BODY frame(s)
+    <- basic.ack (publisher confirms)
+
+Frames: type(1) channel(2) size(4) payload 0xCE. Method payload:
+class-id(2) method-id(2) args.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+from .resource import Connector, QueryError, RecoverableError, ResourceStatus
+
+FRAME_METHOD = 1
+FRAME_HEADER = 2
+FRAME_BODY = 3
+FRAME_HEARTBEAT = 8
+FRAME_END = 0xCE
+
+
+class AmqpError(QueryError):
+    pass
+
+
+def shortstr(s: str) -> bytes:
+    b = s.encode()
+    return bytes([len(b)]) + b
+
+
+def longstr(b: bytes) -> bytes:
+    return struct.pack(">I", len(b)) + b
+
+
+def frame(ftype: int, channel: int, payload: bytes) -> bytes:
+    return (
+        struct.pack(">BHI", ftype, channel, len(payload))
+        + payload
+        + bytes([FRAME_END])
+    )
+
+
+def method(class_id: int, method_id: int, args: bytes = b"") -> bytes:
+    return struct.pack(">HH", class_id, method_id) + args
+
+
+def parse_table(data: bytes, off: int) -> Tuple[Dict[str, Any], int]:
+    (n,) = struct.unpack_from(">I", data, off)
+    end = off + 4 + n
+    off += 4
+    out: Dict[str, Any] = {}
+    while off < end:
+        klen = data[off]
+        key = data[off + 1 : off + 1 + klen].decode()
+        off += 1 + klen
+        t = data[off : off + 1]
+        off += 1
+        if t == b"S":
+            (ln,) = struct.unpack_from(">I", data, off)
+            out[key] = data[off + 4 : off + 4 + ln].decode("utf-8", "replace")
+            off += 4 + ln
+        elif t == b"t":
+            out[key] = bool(data[off])
+            off += 1
+        elif t == b"I":
+            (out[key],) = struct.unpack_from(">i", data, off)
+            off += 4
+        elif t == b"F":
+            out[key], off = parse_table(data, off)
+        else:
+            raise AmqpError(f"unsupported table field type {t!r}")
+    return out, end
+
+
+def build_table(d: Dict[str, Any]) -> bytes:
+    body = b""
+    for k, v in d.items():
+        body += shortstr(k)
+        if isinstance(v, bool):
+            body += b"t" + bytes([1 if v else 0])
+        elif isinstance(v, int):
+            body += b"I" + struct.pack(">i", v)
+        elif isinstance(v, dict):
+            body += b"F" + build_table(v)
+        else:
+            body += b"S" + longstr(str(v).encode())
+    return struct.pack(">I", len(body)) + body
+
+
+class AmqpFramer:
+    def __init__(self) -> None:
+        self._buf = bytearray()
+
+    def feed(self, data: bytes) -> List[Tuple[int, int, bytes]]:
+        self._buf.extend(data)
+        out = []
+        while len(self._buf) >= 8:
+            ftype, channel, size = struct.unpack_from(">BHI", self._buf, 0)
+            if len(self._buf) < 7 + size + 1:
+                break
+            if self._buf[7 + size] != FRAME_END:
+                raise AmqpError("missing frame-end octet")
+            out.append((ftype, channel, bytes(self._buf[7 : 7 + size])))
+            del self._buf[: 8 + size]
+        return out
+
+
+class RabbitMqConnector(Connector):
+    """Publisher with confirms. Requests are bridge egress dicts
+    ({"topic", "payload"}) or rule env dicts; routing key defaults to
+    the MQTT topic with '/' -> '.' (the reference's topic mapping)."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 5672,
+        user: str = "guest",
+        password: str = "guest",
+        vhost: str = "/",
+        exchange: str = "amq.topic",
+        routing_key_template: Optional[str] = None,
+        delivery_mode: int = 2,
+        timeout: float = 5.0,
+    ):
+        self.host, self.port = host, port
+        self.user, self.password, self.vhost = user, password, vhost
+        self.exchange = exchange
+        self.rk_template = routing_key_template
+        self.delivery_mode = delivery_mode
+        self.timeout = timeout
+        self._reader = None
+        self._writer = None
+        self._framer = AmqpFramer()
+        self._frames: List[Tuple[int, int, bytes]] = []
+        self._seq = 0
+
+    async def _recv_method(self, want: Tuple[int, int]) -> bytes:
+        while True:
+            while self._frames:
+                ftype, _ch, payload = self._frames.pop(0)
+                if ftype == FRAME_HEARTBEAT:
+                    continue
+                if ftype != FRAME_METHOD:
+                    raise AmqpError(f"unexpected frame type {ftype}")
+                cid, mid = struct.unpack_from(">HH", payload, 0)
+                if (cid, mid) == (10, 50) or (cid, mid) == (20, 40):
+                    # connection.close / channel.close
+                    code, = struct.unpack_from(">H", payload, 4)
+                    txt, _ = _read_shortstr(payload, 6)
+                    raise AmqpError(f"closed by broker: {code} {txt}")
+                if (cid, mid) != want:
+                    raise AmqpError(f"expected {want}, got {(cid, mid)}")
+                return payload[4:]
+            data = await asyncio.wait_for(
+                self._reader.read(65536), self.timeout
+            )
+            if not data:
+                raise ConnectionError("rabbitmq closed connection")
+            self._frames.extend(self._framer.feed(data))
+
+    async def on_start(self) -> None:
+        try:
+            self._reader, self._writer = await asyncio.wait_for(
+                asyncio.open_connection(self.host, self.port), self.timeout
+            )
+            self._framer = AmqpFramer()
+            self._frames = []
+            self._seq = 0
+            w = self._writer
+            w.write(b"AMQP\x00\x00\x09\x01")
+            await w.drain()
+            await self._recv_method((10, 10))  # connection.start
+            sasl = b"\x00" + self.user.encode() + b"\x00" + self.password.encode()
+            props = build_table({"product": "emqx-tpu", "version": "0.4"})
+            w.write(frame(FRAME_METHOD, 0, method(
+                10, 11,
+                props + shortstr("PLAIN") + longstr(sasl) + shortstr("en_US"),
+            )))
+            tune = await self._recv_method((10, 30))  # connection.tune
+            channel_max, frame_max, heartbeat = struct.unpack_from(">HIH", tune, 0)
+            self.frame_max = frame_max or 131072
+            w.write(frame(FRAME_METHOD, 0, method(
+                10, 31, struct.pack(">HIH", channel_max, self.frame_max, 0)
+            )))
+            w.write(frame(FRAME_METHOD, 0, method(
+                10, 40, shortstr(self.vhost) + b"\x00\x00"
+            )))
+            await self._recv_method((10, 41))  # connection.open-ok
+            w.write(frame(FRAME_METHOD, 1, method(20, 10, shortstr(""))))
+            await self._recv_method((20, 11))  # channel.open-ok
+            w.write(frame(FRAME_METHOD, 1, method(85, 10, b"\x00")))
+            await self._recv_method((85, 11))  # confirm.select-ok
+            await w.drain()
+        except (OSError, asyncio.TimeoutError, ConnectionError) as e:
+            raise RecoverableError(f"rabbitmq connect failed: {e}") from e
+
+    async def on_stop(self) -> None:
+        if self._writer is not None:
+            try:
+                self._writer.write(frame(FRAME_METHOD, 0, method(
+                    10, 50, struct.pack(">H", 200) + shortstr("bye") + b"\x00\x00\x00\x00"
+                )))
+                await self._writer.drain()
+            except Exception:
+                pass
+            self._writer.close()
+            self._writer = None
+            self._reader = None
+
+    async def on_query(self, request: Any) -> None:
+        if self._writer is None:
+            raise RecoverableError("rabbitmq not connected")
+        req = dict(request) if isinstance(request, dict) else {"payload": request}
+        payload = req.get("payload", b"")
+        if isinstance(payload, str):
+            payload = payload.encode()
+        if self.rk_template:
+            from ..rules.engine import render_template
+
+            rk = render_template(self.rk_template, req)
+        else:
+            rk = str(req.get("topic", "")).replace("/", ".")
+        w = self._writer
+        try:
+            w.write(frame(FRAME_METHOD, 1, method(
+                60, 40, b"\x00\x00" + shortstr(self.exchange) + shortstr(rk) + b"\x00"
+            )))
+            # content header: class 60, weight 0, body size, flags:
+            # delivery-mode only (0x1000)
+            w.write(frame(FRAME_HEADER, 1, struct.pack(
+                ">HHQH", 60, 0, len(payload), 0x1000
+            ) + bytes([self.delivery_mode])))
+            limit = self.frame_max - 8
+            for i in range(0, len(payload), limit):
+                w.write(frame(FRAME_BODY, 1, payload[i : i + limit]))
+            await w.drain()
+            ack = await self._recv_method((60, 80))  # basic.ack
+            (tag,) = struct.unpack_from(">Q", ack, 0)
+            self._seq += 1
+            return tag
+        except (OSError, asyncio.TimeoutError, ConnectionError) as e:
+            raise RecoverableError(str(e)) from e
+
+    async def health_check(self) -> ResourceStatus:
+        return (
+            ResourceStatus.CONNECTED
+            if self._writer is not None
+            else ResourceStatus.DISCONNECTED
+        )
+
+
+def _read_shortstr(data: bytes, off: int) -> Tuple[str, int]:
+    n = data[off]
+    return data[off + 1 : off + 1 + n].decode("utf-8", "replace"), off + 1 + n

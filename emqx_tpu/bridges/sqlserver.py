@@ -1,0 +1,382 @@
+"""Microsoft SQL Server bridge — TDS 7.x wire protocol.
+
+The reference's emqx_bridge_sqlserver drives an ODBC pool
+(apps/emqx_bridge_sqlserver/src/emqx_bridge_sqlserver_connector.erl);
+here the TDS client speaks the protocol itself (MS-TDS spec):
+
+    PRELOGIN (0x12: VERSION + ENCRYPTION=not-supported options)
+    -> server PRELOGIN response
+    LOGIN7 (0x10: fixed header + UCS-2LE hostname/user/password/app/
+    database with the password nibble-swap ^ 0xA5 obfuscation)
+    -> token stream with LOGINACK (0xAD) + DONE (0xFD)
+    SQLBatch (0x01: ALL_HEADERS transaction descriptor + UCS-2LE SQL)
+    -> token stream: COLMETADATA (0x81) / ROW (0xD1) / ERROR (0xAA) /
+    DONE (0xFD, row count)
+
+Templating reuses the postgres renderer (string-literal substitution
+with quote doubling). Rows decode NVARCHAR columns only — the bridge
+path is INSERT-shaped; richer type decoding is out of scope.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import socket
+import struct
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from .postgres import render_sql
+from .resource import Connector, QueryError, RecoverableError, ResourceStatus
+
+PKT_SQLBATCH = 0x01
+PKT_RESPONSE = 0x04
+PKT_LOGIN7 = 0x10
+PKT_PRELOGIN = 0x12
+
+TOK_COLMETADATA = 0x81
+TOK_ERROR = 0xAA
+TOK_INFO = 0xAB
+TOK_LOGINACK = 0xAD
+TOK_ROW = 0xD1
+TOK_DONE = 0xFD
+TOK_ENVCHANGE = 0xE3
+
+
+class TdsError(QueryError):
+    pass
+
+
+def _ucs2(s: str) -> bytes:
+    return s.encode("utf-16-le")
+
+
+def tds_packets(ptype: int, body: bytes, size: int = 4096) -> bytes:
+    """Split a message into TDS packets (EOM status on the last)."""
+    out = []
+    chunks = [body[i : i + size - 8] for i in range(0, len(body), size - 8)] or [b""]
+    for i, chunk in enumerate(chunks):
+        status = 0x01 if i == len(chunks) - 1 else 0x00
+        out.append(
+            struct.pack(">BBHHBB", ptype, status, len(chunk) + 8, 0, 0, 0)
+            + chunk
+        )
+    return b"".join(out)
+
+
+class TdsFramer:
+    """Reassembles TDS packets into complete messages."""
+
+    def __init__(self) -> None:
+        self._buf = bytearray()
+        self._msg = bytearray()
+
+    def feed(self, data: bytes) -> List[Tuple[int, bytes]]:
+        self._buf.extend(data)
+        out = []
+        while len(self._buf) >= 8:
+            ptype, status, length = struct.unpack_from(">BBH", self._buf, 0)
+            if len(self._buf) < length:
+                break
+            self._msg.extend(self._buf[8:length])
+            del self._buf[:length]
+            if status & 0x01:  # EOM
+                out.append((ptype, bytes(self._msg)))
+                self._msg.clear()
+        return out
+
+
+def obfuscate_password(pw: str) -> bytes:
+    """LOGIN7 password encoding: swap nibbles then XOR 0xA5 per byte."""
+    raw = _ucs2(pw)
+    return bytes((((b << 4) | (b >> 4)) & 0xFF) ^ 0xA5 for b in raw)
+
+
+def build_prelogin() -> bytes:
+    # options: VERSION(0) ENCRYPTION(1) + terminator 0xFF
+    opts = [(0, b"\x0c\x00\x0f\xa0\x00\x00"), (1, b"\x02")]  # ENCRYPT_NOT_SUP
+    header_len = 5 * len(opts) + 1
+    head, payload = b"", b""
+    off = header_len
+    for token, data in opts:
+        head += struct.pack(">BHH", token, off, len(data))
+        payload += data
+        off += len(data)
+    return head + b"\xff" + payload
+
+
+def build_login7(
+    user: str, password: str, database: str, host: str = "emqx-tpu",
+    app: str = "emqx_tpu",
+) -> bytes:
+    fields = [  # (text, encoder) in LOGIN7 order
+        _ucs2(host), _ucs2(user), obfuscate_password(password), _ucs2(app),
+        _ucs2(""),  # server name
+        b"",        # unused / extension
+        _ucs2(""),  # clt int name
+        _ucs2(""),  # language
+        _ucs2(database),
+    ]
+    fixed = struct.pack(
+        "<IIIII IBBBB II",
+        0,                     # length patched below
+        0x74000004,            # TDS 7.4
+        4096,                  # packet size
+        7,                     # client prog ver
+        0,                     # client pid
+        0,                     # connection id
+        0xE0, 0x03, 0, 0,      # option flags 1/2, type flags, flags 3
+        0, 0,                  # timezone, lcid
+    )
+    # offsets table: ibHost..ibDatabase as (offset u16, chars u16) LE;
+    # fixed(36) + 9 entries(36) + ClientID(6) + SSPI(4) + AtchDBFile(4)
+    # + ChangePassword(4, TDS 7.2+) + cbSSPILong(4) = 94-byte header
+    table = b""
+    data = b""
+    pos = 94
+    for f in fields:
+        nchars = len(f) // 2
+        table += struct.pack("<HH", pos, nchars)
+        data += f
+        pos += len(f)
+    table += b"\x00\x00\x00\x00\x00\x00"  # client MAC
+    table += struct.pack("<HH", pos, 0)  # ibSSPI
+    table += struct.pack("<HH", pos, 0)  # ibAtchDBFile
+    table += struct.pack("<HH", pos, 0)  # ibChangePassword
+    table += struct.pack("<I", 0)  # cbSSPILong
+    body = fixed + table + data
+    body = struct.pack("<I", len(body)) + body[4:]
+    return body
+
+
+def build_sqlbatch(sql: str) -> bytes:
+    # ALL_HEADERS: total u32 + one transaction-descriptor header
+    hdr = struct.pack("<IIH", 22, 18, 2) + b"\x00" * 8 + struct.pack("<I", 1)
+    return hdr + _ucs2(sql)
+
+
+def _read_b_varchar(body: bytes, off: int) -> Tuple[str, int]:
+    n = body[off]
+    return body[off + 1 : off + 1 + n * 2].decode("utf-16-le"), off + 1 + n * 2
+
+
+def _read_us_varchar(body: bytes, off: int) -> Tuple[str, int]:
+    (n,) = struct.unpack_from("<H", body, off)
+    return body[off + 2 : off + 2 + n * 2].decode("utf-16-le"), off + 2 + n * 2
+
+
+def parse_token_stream(body: bytes):
+    """Yield (token, payload-dict) for the subset the bridge needs.
+    NVARCHAR-only column decoding, by design."""
+    off = 0
+    cols: List[str] = []
+    while off < len(body):
+        tok = body[off]
+        off += 1
+        if tok == TOK_LOGINACK:
+            (n,) = struct.unpack_from("<H", body, off)
+            yield "loginack", {}
+            off += 2 + n
+        elif tok in (TOK_ERROR, TOK_INFO):
+            (n,) = struct.unpack_from("<H", body, off)
+            seg = body[off + 2 : off + 2 + n]
+            number, state, severity = struct.unpack_from("<IBB", seg, 0)
+            msg, _ = _read_us_varchar(seg, 6)
+            if tok == TOK_ERROR:
+                yield "error", {"number": number, "message": msg,
+                                "severity": severity}
+            off += 2 + n
+        elif tok == TOK_ENVCHANGE:
+            (n,) = struct.unpack_from("<H", body, off)
+            off += 2 + n
+        elif tok == TOK_COLMETADATA:
+            (count,) = struct.unpack_from("<H", body, off)
+            off += 2
+            cols = []
+            if count in (0xFFFF,):
+                count = 0
+            for _ in range(count):
+                off += 4 + 2  # usertype u32 + flags u16
+                t = body[off]
+                off += 1
+                if t != 0xE7:  # NVARCHARTYPE only
+                    raise TdsError(f"unsupported column type 0x{t:02x}")
+                off += 2 + 5  # maxlen u16 + collation 5
+                name, off = _read_b_varchar(body, off)
+                cols.append(name)
+            yield "columns", {"names": cols}
+        elif tok == TOK_ROW:
+            row = []
+            for _ in cols:
+                (n,) = struct.unpack_from("<H", body, off)
+                off += 2
+                if n == 0xFFFF:
+                    row.append(None)
+                else:
+                    row.append(body[off : off + n].decode("utf-16-le"))
+                    off += n
+            yield "row", {"values": row}
+        elif tok == TOK_DONE:
+            status, _cur, count = struct.unpack_from("<HHQ", body, off)
+            off += 12
+            yield "done", {"status": status, "rows": count}
+        else:
+            raise TdsError(f"unsupported token 0x{tok:02x}")
+
+
+class SqlServerClient:
+    """Minimal sync TDS client (same blocking-window model as PgClient)."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 1433,
+        user: str = "sa",
+        password: str = "",
+        database: str = "master",
+        timeout: float = 5.0,
+    ) -> None:
+        self.host, self.port = host, port
+        self.user, self.password, self.database = user, password, database
+        self.timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._framer = TdsFramer()
+        self._lock = threading.Lock()
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+
+    def _read_msg(self) -> Tuple[int, bytes]:
+        while True:
+            data = self._sock.recv(65536)
+            if not data:
+                raise ConnectionError("sqlserver closed connection")
+            msgs = self._framer.feed(data)
+            if msgs:
+                return msgs[0]
+
+    def _connect(self) -> None:
+        s = socket.create_connection((self.host, self.port), self.timeout)
+        s.settimeout(self.timeout)
+        self._framer = TdsFramer()
+        self._sock = s
+        s.sendall(tds_packets(PKT_PRELOGIN, build_prelogin()))
+        self._read_msg()  # server prelogin (options ignored; no TLS)
+        s.sendall(tds_packets(
+            PKT_LOGIN7,
+            build_login7(self.user, self.password, self.database),
+        ))
+        _t, body = self._read_msg()
+        ok = False
+        for kind, info in parse_token_stream(body):
+            if kind == "error":
+                raise TdsError(f"login failed: {info['message']}")
+            if kind == "loginack":
+                ok = True
+        if not ok:
+            raise TdsError("no LOGINACK in login response")
+
+    def query(self, sql: str) -> Tuple[List[str], List[List[Any]], int]:
+        """Run one batch; returns (columns, rows, affected_count)."""
+        with self._lock:
+            try:
+                if self._sock is None:
+                    self._connect()
+                return self._query_locked(sql)
+            except TdsError:
+                raise
+            except Exception:
+                self.close()
+                raise
+
+    def _query_locked(self, sql: str):
+        self._sock.sendall(tds_packets(PKT_SQLBATCH, build_sqlbatch(sql)))
+        _t, body = self._read_msg()
+        cols: List[str] = []
+        rows: List[List[Any]] = []
+        count = 0
+        err: Optional[str] = None
+        for kind, info in parse_token_stream(body):
+            if kind == "columns":
+                cols = info["names"]
+            elif kind == "row":
+                rows.append(info["values"])
+            elif kind == "error":
+                err = info["message"]
+            elif kind == "done":
+                count = info["rows"]
+        if err is not None:
+            raise TdsError(err)
+        return cols, rows, count
+
+    def ping(self) -> bool:
+        try:
+            self.query("SELECT 1 AS ping")
+            return True
+        except Exception:
+            return False
+
+
+class SqlServerConnector(Connector):
+    """Bridge driver: sql_template rendered per request, like
+    emqx_bridge_sqlserver's insert template."""
+
+    wants_env = True
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 1433,
+        user: str = "sa",
+        password: str = "",
+        database: str = "master",
+        sql_template: Optional[str] = None,
+        timeout: float = 5.0,
+    ) -> None:
+        self._mk = lambda: SqlServerClient(
+            host, port, user=user, password=password, database=database,
+            timeout=timeout,
+        )
+        self.sql_template = sql_template
+        self.client: Optional[SqlServerClient] = None
+
+    async def on_start(self) -> None:
+        self.client = self._mk()
+        ok = await asyncio.get_running_loop().run_in_executor(
+            None, self.client.ping
+        )
+        if not ok:
+            raise RecoverableError("sqlserver unreachable")
+
+    async def on_stop(self) -> None:
+        if self.client is not None:
+            self.client.close()
+            self.client = None
+
+    async def on_query(self, request: Any) -> Any:
+        if isinstance(request, str):
+            sql = request
+        else:
+            if not self.sql_template:
+                raise QueryError("sqlserver action has no sql_template")
+            sql = render_sql(self.sql_template, dict(request))
+        try:
+            return await asyncio.get_running_loop().run_in_executor(
+                None, self.client.query, sql
+            )
+        except TdsError:
+            raise
+        except Exception as e:
+            raise RecoverableError(str(e)) from e
+
+    async def health_check(self) -> ResourceStatus:
+        if self.client is None:
+            return ResourceStatus.CONNECTING
+        ok = await asyncio.get_running_loop().run_in_executor(
+            None, self.client.ping
+        )
+        return ResourceStatus.CONNECTED if ok else ResourceStatus.CONNECTING

@@ -1,0 +1,260 @@
+"""Syskeeper bridge — EMQX's own cross-network-zone forwarder protocol.
+
+The reference ships both halves (apps/emqx_bridge_syskeeper/src/
+emqx_bridge_syskeeper_frame_v1.erl + _proxy_server.erl): a FORWARDER
+connector that ships messages over a one-way TCP link into a listening
+PROXY in the other security zone, which republishes them locally.
+
+Frame v1 (4-byte length-prefixed on the wire, then):
+    handshake: <<type:4, 0:4, version:8>>
+    forward:   <<type:4, ack:4, varint(len), marshalled messages>>
+    heartbeat: <<type:4, 0:4>>
+where `marshalled` is Erlang external term format (the reference's
+term_to_binary) — encoded here with the in-house ETF codec, so a list
+of message maps round-trips byte-compatibly at the tag level.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from typing import Any, Callable, Dict, List, Optional
+
+from ..rules.funcs import _etf_decode, _etf_encode
+from .resource import Connector, QueryError, RecoverableError, ResourceStatus
+
+TYPE_HANDSHAKE = 1
+TYPE_FORWARD = 2
+TYPE_HEARTBEAT = 3
+VERSION = 1
+
+
+class SyskeeperError(QueryError):
+    pass
+
+
+def varint(n: int) -> bytes:
+    """MQTT-style variable byte integer (the frame module reuses it)."""
+    out = bytearray()
+    while True:
+        b = n % 128
+        n //= 128
+        out.append(b | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+def read_varint(data: bytes, off: int):
+    mult, val = 1, 0
+    while True:
+        b = data[off]
+        off += 1
+        val += (b & 0x7F) * mult
+        if not b & 0x80:
+            return val, off
+        mult *= 128
+
+
+def encode_handshake() -> bytes:
+    return bytes([(TYPE_HANDSHAKE << 4) | 0, VERSION])
+
+
+def encode_forward(messages: List[Dict[str, Any]], ack: bool) -> bytes:
+    data = _etf_encode(messages)
+    return (
+        bytes([(TYPE_FORWARD << 4) | (1 if ack else 0)])
+        + varint(len(data))
+        + data
+    )
+
+
+def encode_heartbeat() -> bytes:
+    return bytes([(TYPE_HEARTBEAT << 4)])
+
+
+def parse_packet(data: bytes) -> Dict[str, Any]:
+    t, flags = data[0] >> 4, data[0] & 0x0F
+    if t == TYPE_HANDSHAKE:
+        return {"type": "handshake", "version": data[1]}
+    if t == TYPE_HEARTBEAT:
+        return {"type": "heartbeat"}
+    if t == TYPE_FORWARD:
+        n, off = read_varint(data, 1)
+        msgs = _etf_decode(data[off : off + n])
+        out = []
+        for m in msgs:
+            out.append({
+                (k.decode() if isinstance(k, bytes) else k): v
+                for k, v in m.items()
+            })
+        return {"type": "forward", "ack": bool(flags), "messages": out}
+    raise SyskeeperError(f"unknown packet type {t}")
+
+
+def _lp(data: bytes) -> bytes:
+    return struct.pack(">I", len(data)) + data
+
+
+class SyskeeperConnector(Connector):
+    """The forwarder leg: handshake once, then length-prefixed forward
+    packets with per-batch acks."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 9092,
+        ack_mode: bool = True,
+        target_topic_template: str = "${topic}",
+        timeout: float = 5.0,
+    ):
+        self.host, self.port = host, port
+        self.ack_mode = ack_mode
+        self.target_topic_template = target_topic_template
+        self.timeout = timeout
+        self._reader = None
+        self._writer = None
+
+    async def on_start(self) -> None:
+        try:
+            self._reader, self._writer = await asyncio.wait_for(
+                asyncio.open_connection(self.host, self.port), self.timeout
+            )
+            self._writer.write(_lp(encode_handshake()))
+            await self._writer.drain()
+            pkt = await self._read_packet()
+            if pkt["type"] != "handshake" or pkt["version"] != VERSION:
+                raise SyskeeperError(f"handshake mismatch: {pkt}")
+        except (OSError, asyncio.TimeoutError, ConnectionError) as e:
+            raise RecoverableError(f"syskeeper connect failed: {e}") from e
+
+    async def _read_packet(self) -> Dict[str, Any]:
+        raw = await asyncio.wait_for(
+            self._reader.readexactly(4), self.timeout
+        )
+        (n,) = struct.unpack(">I", raw)
+        body = await asyncio.wait_for(
+            self._reader.readexactly(n), self.timeout
+        )
+        return parse_packet(body)
+
+    async def on_stop(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+            self._reader = None
+
+    def _shape(self, request: Any) -> Dict[str, Any]:
+        from ..rules.engine import render_template
+
+        env = dict(request) if isinstance(request, dict) else {"payload": request}
+        payload = env.get("payload", b"")
+        if isinstance(payload, str):
+            payload = payload.encode()
+        return {
+            "topic": render_template(self.target_topic_template, env),
+            "payload": payload,
+            "qos": int(env.get("qos") or 0),
+            "retain": bool(env.get("retain", False)),
+        }
+
+    async def on_query(self, request: Any) -> None:
+        await self.on_batch_query([request])
+
+    async def on_batch_query(self, requests: List[Any]) -> None:
+        if self._writer is None:
+            raise RecoverableError("syskeeper not connected")
+        msgs = [self._shape(r) for r in requests]
+        try:
+            self._writer.write(_lp(encode_forward(msgs, self.ack_mode)))
+            await self._writer.drain()
+            if self.ack_mode:
+                pkt = await self._read_packet()
+                if pkt["type"] != "heartbeat":  # ack rides a heartbeat
+                    raise SyskeeperError(f"bad ack packet: {pkt}")
+        except (OSError, asyncio.TimeoutError, ConnectionError) as e:
+            raise RecoverableError(str(e)) from e
+
+    async def health_check(self) -> ResourceStatus:
+        return (
+            ResourceStatus.CONNECTED
+            if self._writer is not None
+            else ResourceStatus.DISCONNECTED
+        )
+
+
+class SyskeeperProxyServer:
+    """The listening half (emqx_bridge_syskeeper_proxy_server):
+    accepts forwarder links, handshakes, republishes each forwarded
+    message through the deliver callback (usually broker.publish)."""
+
+    def __init__(self, deliver: Callable[[Dict[str, Any]], None],
+                 host: str = "127.0.0.1", port: int = 0):
+        self.deliver = deliver
+        self.host, self.port = host, port
+        self.server = None
+        self._writers: List[Any] = []
+
+    async def start(self) -> None:
+        self.server = await asyncio.start_server(
+            self._conn, self.host, self.port
+        )
+        self.port = self.server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self.server is not None:
+            self.server.close()
+            for w in self._writers:
+                w.close()
+            await self.server.wait_closed()
+
+    async def _conn(self, reader, writer) -> None:
+        self._writers.append(writer)
+        try:
+            while True:
+                raw = await reader.readexactly(4)
+                (n,) = struct.unpack(">I", raw)
+                pkt = parse_packet(await reader.readexactly(n))
+                if pkt["type"] == "handshake":
+                    writer.write(_lp(encode_handshake()))
+                elif pkt["type"] == "forward":
+                    for m in pkt["messages"]:
+                        self.deliver(m)
+                    if pkt["ack"]:
+                        writer.write(_lp(encode_heartbeat()))
+                elif pkt["type"] == "heartbeat":
+                    writer.write(_lp(encode_heartbeat()))
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            writer.close()
+
+
+class SyskeeperProxyConnector(Connector):
+    """Connector-shaped wrapper for the proxy half (the reference's
+    `syskeeper_proxy` connector type starts the listening server;
+    queries are meaningless — it is a source, not a sink)."""
+
+    def __init__(self, deliver: Callable[[Dict[str, Any]], None],
+                 host: str = "127.0.0.1", port: int = 9092):
+        self.server = SyskeeperProxyServer(deliver, host, port)
+
+    @property
+    def port(self) -> int:
+        return self.server.port
+
+    async def on_start(self) -> None:
+        await self.server.start()
+
+    async def on_stop(self) -> None:
+        await self.server.stop()
+
+    async def on_query(self, request: Any) -> None:
+        raise QueryError("syskeeper_proxy is ingress-only")
+
+    async def health_check(self) -> ResourceStatus:
+        return (
+            ResourceStatus.CONNECTED
+            if self.server.server is not None
+            else ResourceStatus.DISCONNECTED
+        )

@@ -1,0 +1,23 @@
+"""TimescaleDB + MatrixDB bridges.
+
+Both products speak the PostgreSQL v3 wire protocol verbatim — the
+reference apps are thin schema/branding wrappers over the shared pgsql
+connector (apps/emqx_bridge_timescale/src/emqx_bridge_timescale.erl,
+apps/emqx_bridge_matrix/src/emqx_bridge_matrix.erl both delegate to
+emqx_bridge_pgsql's connector module). The subclasses exist so config
+`type` names, REST listings, and per-backend defaults mirror the
+reference's app split.
+"""
+
+from __future__ import annotations
+
+from .postgres import PostgresConnector
+
+
+class TimescaleConnector(PostgresConnector):
+    """Timescale hypertable sink: identical wire, typically an INSERT
+    into a hypertable with a time column."""
+
+
+class MatrixConnector(PostgresConnector):
+    """MatrixDB (YMatrix) sink: identical wire protocol."""

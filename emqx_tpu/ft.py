@@ -38,6 +38,106 @@ RC_UNSPECIFIED = 0x80
 RC_NOT_AUTHORIZED = 0x87
 
 
+class LocalExporter:
+    """Default export tier: assembled file + manifest under
+    <storage>/exports (emqx_ft_storage_exporter_fs analog)."""
+
+    def __init__(self, base_dir: str):
+        self.base = base_dir
+
+    def export(self, key, name: str, data: bytes, manifest: dict) -> str:
+        export_dir = os.path.join(
+            self.base, _safe(key[0]) or "anon", _safe(key[1])
+        )
+        os.makedirs(export_dir, exist_ok=True)
+        dest = os.path.join(export_dir, name)
+        with open(dest, "wb") as f:
+            f.write(data)
+        with open(dest + ".MANIFEST.json", "w") as f:
+            json.dump(manifest, f)
+        return dest
+
+    def list_manifests(self) -> list:
+        out = []
+        for root, _dirs, files in os.walk(self.base):
+            for fn in files:
+                if fn.endswith(".MANIFEST.json"):
+                    try:
+                        with open(os.path.join(root, fn)) as f:
+                            out.append(json.load(f))
+                    except (OSError, ValueError):
+                        continue
+        return out
+
+
+class PendingExport:
+    """An export still in flight: the $file fin RESPONSE is deferred
+    until `task` resolves, so the client never gets RC_SUCCESS for an
+    object that failed to land (the reference's exporter_s3 completes
+    the export inside fin for the same reason)."""
+
+    def __init__(self, location: str, task):
+        self.location = location
+        self.task = task
+
+
+class S3Exporter:
+    """S3 export tier (emqx_ft_storage_exporter_s3 analog): assembled
+    file + manifest PUT to `{prefix}/{clientid}/{fileid}/{name}` via
+    the SigV4 S3 client. With a live event loop the upload runs as a
+    task and export() returns a PendingExport (FileTransfer defers the
+    client's fin response to its outcome); without one it blocks."""
+
+    def __init__(self, s3_client, prefix: str = "file_transfer"):
+        self.client = s3_client
+        self.prefix = prefix.strip("/")
+        self._tasks: set = set()
+        self.errors: list = []
+
+    def _key(self, key, name: str) -> str:
+        return "/".join(
+            [self.prefix, _safe(key[0]) or "anon", _safe(key[1]), name]
+        )
+
+    def export(self, key, name: str, data: bytes, manifest: dict):
+        import asyncio
+
+        obj_key = self._key(key, name)
+        location = f"s3://{self.client.bucket}/{obj_key}"
+
+        async def upload():
+            try:
+                await self.client.put_object(obj_key, data)
+                await self.client.put_object(
+                    obj_key + ".MANIFEST.json",
+                    json.dumps(manifest).encode(),
+                    content_type="application/json",
+                )
+            except Exception as e:
+                log.warning("s3 export failed for %s: %s", obj_key, e)
+                self.errors.append((obj_key, str(e)))
+                raise
+
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            asyncio.run(upload())
+            return location
+        t = loop.create_task(upload())
+        self._tasks.add(t)
+        t.add_done_callback(self._tasks.discard)
+        return PendingExport(location, t)
+
+    async def drain(self) -> None:
+        import asyncio
+
+        if self._tasks:
+            await asyncio.gather(*list(self._tasks), return_exceptions=True)
+
+    def list_manifests(self) -> list:
+        return []  # listing rides the REST/S3 side, not the local walk
+
+
 class _Transfer:
     def __init__(self, meta: dict, tmp_dir: str):
         self.meta = meta
@@ -55,6 +155,7 @@ class FileTransfer:
         storage_dir: str = "data/file_transfer",
         max_file_size: int = 256 * 1024 * 1024,
         segments_ttl: float = 300.0,
+        exporter=None,
     ):
         self.broker = broker
         self.dir = storage_dir
@@ -64,6 +165,11 @@ class FileTransfer:
         self._enabled = False
         os.makedirs(os.path.join(self.dir, "exports"), exist_ok=True)
         os.makedirs(os.path.join(self.dir, "tmp"), exist_ok=True)
+        # export tier (emqx_ft_storage_exporter behaviour): local fs by
+        # default; S3Exporter ships assembled files off-box
+        self.exporter = exporter or LocalExporter(
+            os.path.join(self.dir, "exports")
+        )
 
     def enable(self) -> None:
         if not self._enabled:
@@ -77,6 +183,24 @@ class FileTransfer:
 
     # --- hook -------------------------------------------------------------
 
+    def _respond(self, msg: Message, rc: int, desc: str) -> None:
+        if not msg.from_client:
+            return
+        self.broker.publish(
+            Message(
+                topic=f"{RESPONSE_PREFIX}{msg.from_client}",
+                payload=json.dumps(
+                    {
+                        "vsn": "0.2",
+                        "topic": msg.topic,
+                        "reason_code": rc,
+                        "reason_description": desc,
+                    }
+                ).encode(),
+                qos=1,
+            )
+        )
+
     def _on_publish(self, msg: Message):
         if not msg.topic.startswith(PREFIX):
             return None
@@ -86,21 +210,23 @@ class FileTransfer:
         except Exception as e:  # noqa: BLE001
             log.exception("file transfer command failed")
             rc, desc = RC_UNSPECIFIED, str(e)
-        if msg.from_client:
-            self.broker.publish(
-                Message(
-                    topic=f"{RESPONSE_PREFIX}{msg.from_client}",
-                    payload=json.dumps(
-                        {
-                            "vsn": "0.2",
-                            "topic": msg.topic,
-                            "reason_code": rc,
-                            "reason_description": desc,
-                        }
-                    ).encode(),
-                    qos=1,
+        if isinstance(desc, PendingExport):
+            # async export (S3): answer the client only when the
+            # upload actually lands — RC_SUCCESS for a dead URI would
+            # silently lose the file
+            pend = desc
+
+            def _done(task):
+                err = task.exception() if not task.cancelled() else "cancelled"
+                self._respond(
+                    msg,
+                    RC_SUCCESS if err is None else RC_UNSPECIFIED,
+                    pend.location if err is None else f"export failed: {err}",
                 )
-            )
+
+            pend.task.add_done_callback(_done)
+        else:
+            self._respond(msg, rc, desc)
         out = Message(**{**msg.__dict__})
         out.headers = dict(msg.headers, allow_publish=False, intercepted="ft")
         return (STOP, out)
@@ -212,25 +338,19 @@ class FileTransfer:
             got = hashlib.sha256(bytes(out)).hexdigest()
             if got != str(want).lower():
                 return RC_UNSPECIFIED, f"checksum mismatch (got {got})"
-        export_dir = os.path.join(
-            self.dir, "exports", _safe(key[0]) or "anon", _safe(key[1])
+        dest = self.exporter.export(
+            key,
+            t.meta["name"],
+            bytes(out),
+            {
+                "clientid": key[0],
+                "fileid": key[1],
+                "name": t.meta["name"],
+                "size": final_size,
+                "meta": t.meta,
+                "finished_at": time.time(),
+            },
         )
-        os.makedirs(export_dir, exist_ok=True)
-        dest = os.path.join(export_dir, t.meta["name"])
-        with open(dest, "wb") as f:
-            f.write(bytes(out))
-        with open(dest + ".MANIFEST.json", "w") as f:
-            json.dump(
-                {
-                    "clientid": key[0],
-                    "fileid": key[1],
-                    "name": t.meta["name"],
-                    "size": final_size,
-                    "meta": t.meta,
-                    "finished_at": time.time(),
-                },
-                f,
-            )
         self._drop(key)
         return RC_SUCCESS, dest
 
@@ -252,16 +372,7 @@ class FileTransfer:
 
     def exports(self) -> list:
         """Manifest list of completed transfers (REST view)."""
-        out = []
-        base = os.path.join(self.dir, "exports")
-        for root, _dirs, files in os.walk(base):
-            for fn in files:
-                if fn.endswith(".MANIFEST.json"):
-                    try:
-                        with open(os.path.join(root, fn)) as f:
-                            out.append(json.load(f))
-                    except (OSError, ValueError):
-                        continue
+        out = self.exporter.list_manifests()
         return sorted(out, key=lambda m: m.get("finished_at", 0))
 
 

@@ -276,10 +276,22 @@ def _subbits(data, *args):
         nbytes = (length + 7) // 8
         return (chunk << (nbytes * 8 - length)).to_bytes(nbytes, "big")
     if endian == "little":
-        nbytes = (length + 7) // 8
-        chunk = int.from_bytes(
-            chunk.to_bytes(nbytes, "big"), "little"
-        )
+        # Erlang bit-syntax little-endian: the FIRST 8 bits of the
+        # stream are the least-significant byte; a trailing partial
+        # byte is most significant (<<16#12, 16#3:4>> :12/little ->
+        # 16#312). Byte-padding then swapping diverges for lengths
+        # that aren't a multiple of 8.
+        nfull, rbits = divmod(length, 8)
+        val = 0
+        stream = chunk
+        if rbits:
+            partial = stream & ((1 << rbits) - 1)
+            stream >>= rbits
+            val = partial << (8 * nfull)
+        for i in range(nfull):
+            byte = (stream >> (8 * (nfull - 1 - i))) & 0xFF
+            val |= byte << (8 * i)
+        chunk = val
     if typ == "float":
         if length == 32:
             return struct.unpack(">f", chunk.to_bytes(4, "big"))[0]
@@ -703,7 +715,11 @@ def _tz_offset(tz):
     if tz in ("Z", "z", "utc", "UTC", ""):
         return 0
     if tz == "local":
-        return -time.timezone + (3600 if time.daylight and time.localtime().tm_isdst else 0)
+        # altzone is the DST-adjusted offset; hardcoding +3600 breaks
+        # half-hour-DST zones (Lord Howe)
+        if time.daylight and time.localtime().tm_isdst:
+            return -time.altzone
+        return -time.timezone
     m = re.fullmatch(r"([+-])(\d{2}):?(\d{2})(?::?(\d{2}))?", tz)
     if not m:
         raise ValueError(f"bad timezone {tz!r}")
@@ -716,20 +732,21 @@ def _tz_offset(tz):
 FUNCS["timezone_to_second"] = _tz_offset
 
 
-def _fmt_epoch(epoch: float, unit_mult: int, offset_s: int, fmt: str) -> str:
+def _fmt_epoch(epoch, unit_mult: int, offset_s: int, fmt: str) -> str:
     """emqx_utils_calendar format tokens: %Y %m %d %H %M %S %N(ns)
-    %3N(ms) %6N(us) %z(+0800) %:z(+08:00)."""
-    secs = epoch / unit_mult
-    frac = secs - math.floor(secs)
-    t = time.gmtime(math.floor(secs) + offset_s)
+    %3N(ms) %6N(us) %z(+0800) %:z(+08:00). Integer arithmetic
+    throughout — nanosecond epochs (~1e18) lose digits past float53."""
+    whole, rem = divmod(int(epoch), unit_mult)
+    frac_ns = rem * (10**9 // unit_mult)
+    t = time.gmtime(whole + offset_s)
     sign = "+" if offset_s >= 0 else "-"
     oh, om = divmod(abs(offset_s) // 60, 60)
     reps = {
         "%Y": f"{t.tm_year:04d}", "%m": f"{t.tm_mon:02d}",
         "%d": f"{t.tm_mday:02d}", "%H": f"{t.tm_hour:02d}",
         "%M": f"{t.tm_min:02d}", "%S": f"{t.tm_sec:02d}",
-        "%6N": f"{int(frac * 1e6):06d}", "%3N": f"{int(frac * 1e3):03d}",
-        "%N": f"{int(frac * 1e9):09d}",
+        "%6N": f"{frac_ns // 1000:06d}", "%3N": f"{frac_ns // 1000000:03d}",
+        "%N": f"{frac_ns:09d}",
         "%:z": f"{sign}{oh:02d}:{om:02d}", "%z": f"{sign}{oh:02d}{om:02d}",
     }
     out = fmt
@@ -743,9 +760,9 @@ def _fmt_epoch(epoch: float, unit_mult: int, offset_s: int, fmt: str) -> str:
 def _format_date(unit, offset, fmt, epoch=None):
     mult = _unit_mult(unit)
     if epoch is None:
-        epoch = time.time() * mult
+        epoch = int(time.time() * mult)
     off = offset if isinstance(offset, int) else _tz_offset(offset)
-    return _fmt_epoch(_num(epoch), mult, off, _str(fmt))
+    return _fmt_epoch(int(_num(epoch)), mult, off, _str(fmt))
 
 
 @func("date_to_unix_ts")
@@ -837,13 +854,12 @@ def _rfc3339_to_unix_ts(s, unit=None):
 @func("unix_ts_to_rfc3339")
 def _unix_ts_to_rfc3339(epoch, unit=None):
     mult = _unit_mult(unit)
-    secs = _num(epoch) / mult
     fmt = {1: "%Y-%m-%dT%H:%M:%S",
            10**3: "%Y-%m-%dT%H:%M:%S.%3N",
            10**6: "%Y-%m-%dT%H:%M:%S.%6N",
            10**9: "%Y-%m-%dT%H:%M:%S.%N"}[mult]
     off = _tz_offset("local")
-    return _fmt_epoch(secs * mult, mult, off, fmt) + _fmt_epoch(
+    return _fmt_epoch(int(_num(epoch)), mult, off, fmt) + _fmt_epoch(
         0, 1, off, "%:z"
     )
 
@@ -1040,10 +1056,10 @@ def _jq(prog, data, _timeout_ms=None):
                     out.append(v)
             return out
         # path expression: .a.b[0].c[] ...
-        if not term.startswith("."):
+        if not re.fullmatch(r"\.(?:[\w]+|\[\d*\])(?:\.?[\w]+|\[\d*\])*|\.", term):
             raise ValueError(f"jq: unsupported program {term!r}")
         out = inputs
-        for step in re.findall(r"\.([\w]+)|\[(\d*)\]", term):
+        for step in re.findall(r"\.?([\w]+)|\[(\d*)\]", term):
             key, idx = step
             nxt = []
             for v in out:

@@ -426,3 +426,18 @@ async def test_ingress_egress_loop_guard():
         await reg.stop_all()
         await server_a.stop()
         await server_b.stop()
+
+
+def test_connector_type_registry_resolves_all():
+    """Every config/REST bridge `type` maps to an importable connector
+    class implementing the Connector behaviour."""
+    from emqx_tpu.bridges import CONNECTOR_TYPES, Connector, connector_class
+
+    assert len(CONNECTOR_TYPES) >= 30
+    for t in CONNECTOR_TYPES:
+        cls = connector_class(t)
+        assert issubclass(cls, Connector), t
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        connector_class("not-a-backend")

@@ -1,0 +1,519 @@
+"""SQL-family bridge wave 2: SQLServer (TDS 7.x), Cassandra (CQL v4),
+ClickHouse (HTTP), Timescale/Matrix (postgres wire) — each against an
+in-process mini-server speaking the real protocol (the house pattern
+of test_postgres/test_kafka)."""
+
+import asyncio
+import struct
+
+import pytest
+
+from emqx_tpu.bridges.cassandra import (
+    CassandraClient,
+    CassandraConnector,
+    CqlError,
+    CqlFramer,
+    frame as cql_frame,
+    OP_AUTH_RESPONSE,
+    OP_AUTH_SUCCESS,
+    OP_AUTHENTICATE,
+    OP_ERROR,
+    OP_QUERY,
+    OP_READY,
+    OP_RESULT,
+    OP_STARTUP,
+)
+from emqx_tpu.bridges.clickhouse import ClickHouseConnector
+from emqx_tpu.bridges.resource import QueryError, Resource
+from emqx_tpu.bridges.sqlserver import (
+    PKT_LOGIN7,
+    PKT_PRELOGIN,
+    PKT_RESPONSE,
+    PKT_SQLBATCH,
+    SqlServerClient,
+    SqlServerConnector,
+    TdsError,
+    TdsFramer,
+    obfuscate_password,
+    tds_packets,
+)
+from emqx_tpu.bridges.timescale import MatrixConnector, TimescaleConnector
+
+
+# --- mini SQL Server ------------------------------------------------------
+
+
+def _tds_token_error(msg: str) -> bytes:
+    m = msg.encode("utf-16-le")
+    seg = struct.pack("<IBB", 105, 1, 16) + struct.pack("<H", len(msg)) + m
+    seg += b"\x00" + struct.pack("<H", 0) + struct.pack("<I", 0)
+    return bytes([0xAA]) + struct.pack("<H", len(seg)) + seg
+
+
+def _tds_token_done(rows: int = 0) -> bytes:
+    return bytes([0xFD]) + struct.pack("<HHQ", 0x10, 0, rows)
+
+
+def _tds_loginack() -> bytes:
+    prog = "mini-tds".encode("utf-16-le")
+    seg = bytes([1]) + b"\x74\x00\x00\x04" + bytes([len(prog) // 2]) + prog
+    seg += b"\x00\x00\x00\x00"
+    return bytes([0xAD]) + struct.pack("<H", len(seg)) + seg
+
+
+def _tds_rows(cols, rows) -> bytes:
+    out = bytes([0x81]) + struct.pack("<H", len(cols))
+    for c in cols:
+        out += struct.pack("<IH", 0, 0) + bytes([0xE7])
+        out += struct.pack("<H", 512) + b"\x00" * 5
+        out += bytes([len(c)]) + c.encode("utf-16-le")
+    for r in rows:
+        out += bytes([0xD1])
+        for v in r:
+            if v is None:
+                out += struct.pack("<H", 0xFFFF)
+            else:
+                b = str(v).encode("utf-16-le")
+                out += struct.pack("<H", len(b)) + b
+    return out
+
+
+class MiniTds:
+    """PRELOGIN echo + LOGIN7 check (user/password/database parsed from
+    the offsets table) + SQLBatch answered by handler(sql)."""
+
+    def __init__(self, handler=None, user="sa", password="pw"):
+        self.handler = handler or (lambda sql: ([], [], 1))
+        self.user, self.password = user, password
+        self.queries = []
+        self.logins = []
+        self.server = None
+        self.port = None
+        self._writers = []
+
+    async def start(self):
+        self.server = await asyncio.start_server(self._conn, "127.0.0.1", 0)
+        self.port = self.server.sockets[0].getsockname()[1]
+
+    async def stop(self):
+        self.server.close()
+        for w in self._writers:
+            w.close()
+        await self.server.wait_closed()
+
+    async def _conn(self, reader, writer):
+        self._writers.append(writer)
+        framer = TdsFramer()
+        try:
+            while True:
+                data = await reader.read(65536)
+                if not data:
+                    return
+                for ptype, body in framer.feed(data):
+                    if ptype == PKT_PRELOGIN:
+                        writer.write(tds_packets(PKT_RESPONSE, body))
+                    elif ptype == PKT_LOGIN7:
+                        # parse user (entry 1) + password (entry 2)
+                        base = 36
+                        entries = [
+                            struct.unpack_from("<HH", body, base + 4 * i)
+                            for i in range(9)
+                        ]
+                        user = body[
+                            entries[1][0] : entries[1][0] + entries[1][1] * 2
+                        ].decode("utf-16-le")
+                        pw_raw = body[
+                            entries[2][0] : entries[2][0] + entries[2][1] * 2
+                        ]
+                        db = body[
+                            entries[8][0] : entries[8][0] + entries[8][1] * 2
+                        ].decode("utf-16-le")
+                        self.logins.append((user, db))
+                        ok = (
+                            user == self.user
+                            and pw_raw == obfuscate_password(self.password)
+                        )
+                        if ok:
+                            writer.write(tds_packets(
+                                PKT_RESPONSE, _tds_loginack() + _tds_token_done()
+                            ))
+                        else:
+                            writer.write(tds_packets(
+                                PKT_RESPONSE,
+                                _tds_token_error("Login failed")
+                                + _tds_token_done(),
+                            ))
+                    elif ptype == PKT_SQLBATCH:
+                        sql = body[22:].decode("utf-16-le")
+                        self.queries.append(sql)
+                        try:
+                            cols, rows, n = self.handler(sql)
+                            out = (
+                                _tds_rows(cols, rows) if cols else b""
+                            ) + _tds_token_done(n)
+                        except Exception as e:
+                            out = _tds_token_error(str(e)) + _tds_token_done()
+                        writer.write(tds_packets(PKT_RESPONSE, out))
+                await writer.drain()
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            writer.close()
+
+
+async def test_sqlserver_login_query_error_and_bridge():
+    hits = {}
+
+    def handler(sql):
+        if "boom" in sql:
+            raise ValueError("Incorrect syntax near boom")
+        if sql.startswith("SELECT"):
+            return ["a", "b"], [["x", None], ["y", "z"]], 2
+        hits["insert"] = sql
+        return [], [], 1
+
+    srv = MiniTds(handler=handler)
+    await srv.start()
+    try:
+        loop = asyncio.get_running_loop()
+
+        def drive():
+            c = SqlServerClient("127.0.0.1", srv.port, user="sa",
+                                password="pw", database="iot")
+            cols, rows, _n = c.query("SELECT a, b FROM t")
+            assert cols == ["a", "b"]
+            assert rows == [["x", None], ["y", "z"]]
+            try:
+                c.query("boom")
+                raise AssertionError("expected TdsError")
+            except TdsError as e:
+                assert "Incorrect syntax" in str(e)
+            # bad credentials
+            c2 = SqlServerClient("127.0.0.1", srv.port, user="sa",
+                                 password="wrong")
+            try:
+                c2.query("SELECT 1")
+                raise AssertionError("expected login failure")
+            except TdsError as e:
+                assert "Login failed" in str(e)
+            c.close()
+            c2.close()
+
+        await loop.run_in_executor(None, drive)
+        assert srv.logins[0] == ("sa", "iot")
+
+        # through the Resource/bridge stack with a template
+        conn = SqlServerConnector(
+            "127.0.0.1", srv.port, user="sa", password="pw",
+            sql_template=(
+                "INSERT INTO msgs (topic, payload) "
+                "VALUES (${topic}, ${payload})"
+            ),
+        )
+        res = Resource("sqlserver-test", conn, health_interval=30)
+        await res.start()
+        await res.query_sync({"topic": "t/1", "payload": "he'llo"})
+        await res.stop()
+        assert hits["insert"] == (
+            "INSERT INTO msgs (topic, payload) VALUES ('t/1', 'he''llo')"
+        )
+    finally:
+        await srv.stop()
+
+
+# --- mini Cassandra -------------------------------------------------------
+
+
+def _cql_resp(opcode: int, body: bytes, stream: int = 0) -> bytes:
+    return struct.pack(">BBhBI", 0x84, 0, stream, opcode, len(body)) + body
+
+
+def _cql_rows(cols, rows) -> bytes:
+    body = struct.pack(">I", 2)  # kind=rows
+    body += struct.pack(">II", 0x0001, len(cols))  # global tables spec
+    for part in ("ks", "tbl"):
+        body += struct.pack(">H", len(part)) + part.encode()
+    for c in cols:
+        body += struct.pack(">H", len(c)) + c.encode()
+        body += struct.pack(">H", 0x000D)  # varchar
+    body += struct.pack(">I", len(rows))
+    for r in rows:
+        for v in r:
+            if v is None:
+                body += struct.pack(">i", -1)
+            else:
+                b = str(v).encode()
+                body += struct.pack(">i", len(b)) + b
+    return body
+
+
+class MiniCql:
+    def __init__(self, handler=None, user=None, password=None):
+        self.handler = handler or (lambda cql: None)
+        self.user, self.password = user, password
+        self.queries = []
+        self.server = None
+        self.port = None
+        self._writers = []
+
+    async def start(self):
+        self.server = await asyncio.start_server(self._conn, "127.0.0.1", 0)
+        self.port = self.server.sockets[0].getsockname()[1]
+
+    async def stop(self):
+        self.server.close()
+        for w in self._writers:
+            w.close()
+        await self.server.wait_closed()
+
+    async def _conn(self, reader, writer):
+        self._writers.append(writer)
+        framer = CqlFramer()
+        authed = self.user is None
+        try:
+            while True:
+                data = await reader.read(65536)
+                if not data:
+                    return
+                for stream, opcode, body in framer.feed(data):
+                    if opcode == OP_STARTUP:
+                        if self.user is None:
+                            writer.write(_cql_resp(OP_READY, b"", stream))
+                        else:
+                            auth = b"org.apache.cassandra.auth.PasswordAuthenticator"
+                            writer.write(_cql_resp(
+                                OP_AUTHENTICATE,
+                                struct.pack(">H", len(auth)) + auth,
+                                stream,
+                            ))
+                    elif opcode == OP_AUTH_RESPONSE:
+                        (n,) = struct.unpack_from(">I", body, 0)
+                        tok = body[4 : 4 + n]
+                        _z, user, pw = tok.split(b"\x00")
+                        if (user.decode(), pw.decode()) == (
+                            self.user, self.password,
+                        ):
+                            authed = True
+                            writer.write(_cql_resp(
+                                OP_AUTH_SUCCESS, struct.pack(">i", -1), stream
+                            ))
+                        else:
+                            msg = b"bad credentials"
+                            writer.write(_cql_resp(
+                                OP_ERROR,
+                                struct.pack(">I", 0x0100)
+                                + struct.pack(">H", len(msg)) + msg,
+                                stream,
+                            ))
+                    elif opcode == OP_QUERY:
+                        (n,) = struct.unpack_from(">I", body, 0)
+                        cql = body[4 : 4 + n].decode()
+                        self.queries.append(cql)
+                        if not authed:
+                            msg = b"not authed"
+                            writer.write(_cql_resp(
+                                OP_ERROR,
+                                struct.pack(">I", 0x0100)
+                                + struct.pack(">H", len(msg)) + msg,
+                                stream,
+                            ))
+                            continue
+                        try:
+                            out = self.handler(cql)
+                        except Exception as e:
+                            msg = str(e).encode()
+                            writer.write(_cql_resp(
+                                OP_ERROR,
+                                struct.pack(">I", 0x2200)
+                                + struct.pack(">H", len(msg)) + msg,
+                                stream,
+                            ))
+                            continue
+                        if out is None:
+                            writer.write(_cql_resp(
+                                OP_RESULT, struct.pack(">I", 1), stream
+                            ))
+                        else:
+                            writer.write(_cql_resp(
+                                OP_RESULT, _cql_rows(*out), stream
+                            ))
+                await writer.drain()
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            writer.close()
+
+
+async def test_cassandra_auth_query_rows_and_bridge():
+    def handler(cql):
+        if "system.local" in cql:
+            return ["release_version"], [["4.0-mini"]]
+        if "bad" in cql:
+            raise ValueError("line 1: syntax error")
+        return None
+
+    srv = MiniCql(handler=handler, user="cassandra", password="cassandra")
+    await srv.start()
+    try:
+        loop = asyncio.get_running_loop()
+
+        def drive():
+            c = CassandraClient(
+                "127.0.0.1", srv.port, user="cassandra",
+                password="cassandra", keyspace="mqtt",
+            )
+            cols, rows = c.query(
+                "SELECT release_version FROM system.local"
+            )
+            assert (cols, rows) == (["release_version"], [["4.0-mini"]])
+            try:
+                c.query("bad cql")
+                raise AssertionError("expected CqlError")
+            except CqlError as e:
+                assert "syntax error" in str(e)
+            c.close()
+            bad = CassandraClient("127.0.0.1", srv.port, user="cassandra",
+                                  password="nope")
+            try:
+                bad.query("SELECT 1")
+                raise AssertionError("expected auth failure")
+            except CqlError:
+                pass
+            bad.close()
+
+        await loop.run_in_executor(None, drive)
+        assert srv.queries[0] == 'USE "mqtt"'
+
+        conn = CassandraConnector(
+            "127.0.0.1", srv.port, user="cassandra", password="cassandra",
+            cql_template=(
+                "INSERT INTO mqtt.msgs (topic, payload) "
+                "VALUES (${topic}, ${payload})"
+            ),
+        )
+        res = Resource("cassandra-test", conn, health_interval=30)
+        await res.start()
+        await res.query_sync({"topic": "t/2", "payload": "v"})
+        await res.stop()
+        assert any("t/2" in q for q in srv.queries)
+    finally:
+        await srv.stop()
+
+
+# --- mini ClickHouse ------------------------------------------------------
+
+
+class MiniClickHouse:
+    def __init__(self, user="default", key=""):
+        self.user, self.key = user, key
+        self.queries = []
+        self.server = None
+        self.port = None
+        self._writers = []
+
+    async def start(self):
+        self.server = await asyncio.start_server(self._conn, "127.0.0.1", 0)
+        self.port = self.server.sockets[0].getsockname()[1]
+
+    async def stop(self):
+        self.server.close()
+        for w in self._writers:
+            w.close()
+        await self.server.wait_closed()
+
+    async def _conn(self, reader, writer):
+        self._writers.append(writer)
+        try:
+            raw = await reader.readuntil(b"\r\n\r\n")
+            headers = {}
+            lines = raw.decode().split("\r\n")
+            for line in lines[1:]:
+                if ":" in line:
+                    k, v = line.split(":", 1)
+                    headers[k.strip().lower()] = v.strip()
+            n = int(headers.get("content-length", 0))
+            body = (await reader.readexactly(n)).decode()
+            self.queries.append(body)
+            if headers.get("x-clickhouse-user") != self.user or headers.get(
+                "x-clickhouse-key"
+            ) != self.key:
+                out, code = b"Code: 516. Authentication failed", 403
+            elif "FORMAT JSONEachRow" in body:
+                out, code = b'{"n": 1}\n{"n": 2}\n', 200
+            elif "syntax-error" in body:
+                out, code = b"Code: 62. Syntax error", 400
+            else:
+                out, code = b"", 200
+            writer.write(
+                f"HTTP/1.1 {code} X\r\ncontent-length: {len(out)}\r\n"
+                "connection: close\r\n\r\n".encode() + out
+            )
+            await writer.drain()
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            writer.close()
+
+
+async def test_clickhouse_insert_select_batch_and_auth():
+    srv = MiniClickHouse(user="default", key="secret")
+    await srv.start()
+    try:
+        conn = ClickHouseConnector(
+            "127.0.0.1", srv.port, user="default", password="secret",
+            sql_template=(
+                "INSERT INTO t (topic, v) VALUES (${topic}, ${payload})"
+            ),
+        )
+        await conn.on_query({"topic": "a", "payload": "1"})
+        assert srv.queries[-1] == "INSERT INTO t (topic, v) VALUES ('a', '1')"
+        # batch: VALUES tuples joined into one INSERT
+        await conn.on_batch_query(
+            [{"topic": "a", "payload": "1"}, {"topic": "b", "payload": "2"}]
+        )
+        assert srv.queries[-1] == (
+            "INSERT INTO t (topic, v) VALUES ('a', '1'), ('b', '2')"
+        )
+        rows = await conn.select_json("SELECT n FROM t")
+        assert rows == [{"n": 1}, {"n": 2}]
+        with pytest.raises(QueryError):
+            await conn.on_query("syntax-error here")
+        bad = ClickHouseConnector("127.0.0.1", srv.port, user="default",
+                                  password="wrong")
+        with pytest.raises(QueryError):
+            await bad.on_query("SELECT 1")
+    finally:
+        await srv.stop()
+
+
+# --- timescale / matrix over the postgres wire ---------------------------
+
+
+async def test_timescale_and_matrix_speak_postgres_wire():
+    from tests.test_postgres import MiniPg
+
+    got = []
+
+    def handler(sql):
+        got.append(sql)
+        return [], []
+
+    srv = MiniPg(handler=handler)
+    await srv.start()
+    try:
+        for cls in (TimescaleConnector, MatrixConnector):
+            conn = cls(
+                "127.0.0.1", srv.port, user="app", database="tsdb",
+                sql_template=(
+                    "INSERT INTO metrics (time, topic, v) "
+                    "VALUES (NOW(), ${topic}, ${payload})"
+                ),
+            )
+            await conn.on_start()
+            await conn.on_query({"topic": "t", "payload": "9"})
+            await conn.on_stop()
+        assert got.count(
+            "INSERT INTO metrics (time, topic, v) VALUES (NOW(), 't', '9')"
+        ) == 2
+    finally:
+        await srv.stop()
