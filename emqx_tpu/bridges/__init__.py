@@ -70,6 +70,7 @@ CONNECTOR_TYPES = {
     "rocketmq": ("emqx_tpu.bridges.rocketmq", "RocketMqConnector"),
     "syskeeper_forwarder": ("emqx_tpu.bridges.syskeeper", "SyskeeperConnector"),
     "syskeeper_proxy": ("emqx_tpu.bridges.syskeeper", "SyskeeperProxyConnector"),
+    "hstreamdb": ("emqx_tpu.bridges.hstreamdb", "HStreamConnector"),
 }
 
 

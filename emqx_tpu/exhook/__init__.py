@@ -132,13 +132,22 @@ class ExHookBridge:
         name: str = "default",
         timeout: float = 5.0,
         failed_action: str = "deny",
+        transport: str = "wire",
     ):
         assert failed_action in ("ignore", "deny")
+        assert transport in ("wire", "grpc")
         self.broker = broker
         self.addr = addr
         self.name = name
         self.timeout = timeout
         self.failed_action = failed_action
+        # "grpc" speaks the reference's actual emqx.exhook.v2
+        # HookProvider service (grpc_transport.py) so ecosystem exhook
+        # servers plug in unchanged; "wire" is the in-house framed
+        # protocol. gRPC channels own their reconnection, so the
+        # custom reconnect loop only runs for "wire".
+        self.transport = transport
+        self._grpc = None
         self.hookpoints: List[str] = []
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
@@ -167,13 +176,19 @@ class ExHookBridge:
 
             async def boot():
                 try:
-                    self._reader, self._writer = await asyncio.open_connection(
-                        *self.addr
-                    )
-                    hello = await _read_frame(self._reader)
-                    assert hello[0] == "hello", hello
-                    self.hookpoints = list(hello[1])
-                    asyncio.ensure_future(self._recv_loop())
+                    if self.transport == "grpc":
+                        from .grpc_transport import GrpcTransport
+
+                        self._grpc = GrpcTransport(self.addr, self.timeout)
+                        self.hookpoints = await self._grpc.connect()
+                    else:
+                        self._reader, self._writer = await asyncio.open_connection(
+                            *self.addr
+                        )
+                        hello = await _read_frame(self._reader)
+                        assert hello[0] == "hello", hello
+                        self.hookpoints = list(hello[1])
+                        asyncio.ensure_future(self._recv_loop())
                 except Exception as e:  # noqa: BLE001
                     err.append(e)
                 finally:
@@ -208,6 +223,21 @@ class ExHookBridge:
                         self._writer.close()
                     except Exception:
                         pass
+                if self._grpc is not None:
+                    grpc_t, self._grpc = self._grpc, None
+
+                    async def close_then_stop():
+                        try:
+                            await grpc_t.close()
+                        except Exception:
+                            pass
+                        for task in asyncio.all_tasks(loop):
+                            if task is not asyncio.current_task():
+                                task.cancel()
+                        loop.stop()
+
+                    loop.create_task(close_then_stop())
+                    return
                 for task in asyncio.all_tasks(loop):
                     task.cancel()
                 loop.stop()
@@ -290,6 +320,8 @@ class ExHookBridge:
                 delay = min(delay * 2, 15.0)
 
     async def _do_call(self, hookpoint, args, acc):
+        if self._grpc is not None:
+            return await self._grpc.call(hookpoint, args, acc)
         if self._writer is None:
             raise ConnectionError("exhook server disconnected")
         self._seq += 1
@@ -306,6 +338,9 @@ class ExHookBridge:
             self._pending.pop(seq, None)
 
     async def _do_cast(self, hookpoint, args):
+        if self._grpc is not None:
+            await self._grpc.cast(hookpoint, args)
+            return
         if self._writer is None:
             return
         try:
@@ -373,9 +408,16 @@ class ExHookBridge:
             if loop is None or loop.is_closed():
                 return self._failed(acc)
             fut = None
+            # grpc transport maps REAL objects into proto messages
+            # itself; only the in-house wire codec needs _wireable
+            wire_mode = self._grpc is None
             try:
                 fut = asyncio.run_coroutine_threadsafe(
-                    self._do_call(point, self._wireable(args), self._wireable(acc)),
+                    self._do_call(
+                        point,
+                        self._wireable(args) if wire_mode else args,
+                        self._wireable(acc) if wire_mode else acc,
+                    ),
                     loop,
                 )
                 verdict, out = fut.result(self.timeout)
@@ -384,6 +426,12 @@ class ExHookBridge:
                     fut.cancel()  # cancels _do_call -> pending cleanup
                 self.metrics["failures"] += 1
                 return self._failed(acc)
+            if not wire_mode:
+                if verdict == "ok":
+                    return out
+                if verdict == "stop":
+                    return (STOP, out)
+                return None
             if verdict == "ok":
                 return self._unwire(point, acc, out)
             if verdict == "stop":
@@ -400,7 +448,12 @@ class ExHookBridge:
                 return None
             try:
                 asyncio.run_coroutine_threadsafe(
-                    self._do_cast(point, self._wireable(list(args))), loop
+                    self._do_cast(
+                        point,
+                        list(args) if self._grpc is not None
+                        else self._wireable(list(args)),
+                    ),
+                    loop,
                 )
             except Exception:
                 pass

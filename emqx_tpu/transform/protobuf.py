@@ -294,3 +294,17 @@ class ProtoCodec:
         if t == "bytes":
             return bytes(raw)
         raise ProtobufError(f"cannot decode {t!r} (wire type {wt})")
+
+
+def make_codec_cache(proto: "ProtoFile"):
+    """Per-proto memoized ProtoCodec lookup: cache = make_codec_cache(p);
+    cache("MsgType") -> codec. Shared by the gRPC-speaking modules."""
+    codecs: Dict[str, ProtoCodec] = {}
+
+    def get(mtype: str) -> ProtoCodec:
+        c = codecs.get(mtype)
+        if c is None:
+            c = codecs[mtype] = ProtoCodec(proto, mtype)
+        return c
+
+    return get

@@ -397,3 +397,91 @@ async def test_confluent_is_kafka_wire():
         assert p.required_acks == -1
     finally:
         await srv.stop()
+
+
+async def test_hstreamdb_grpc_append():
+    """HStreamApi subset over real gRPC: Echo, ListShards, LookupShard
+    redirect honored, Append with BatchHStreamRecords payload."""
+    import grpc
+    import grpc.aio
+
+    from emqx_tpu.bridges.hstreamdb import (
+        METHODS,
+        SERVICE,
+        HStreamConnector,
+        codec,
+    )
+
+    appended = []
+
+    def make_server(port_holder, node_port=None):
+        async def echo(req, ctx):
+            return {"msg": req.get("msg", "")}
+
+        async def list_shards(req, ctx):
+            return {"shards": [
+                {"streamName": req["streamName"], "shardId": 7},
+            ]}
+
+        async def lookup(req, ctx):
+            return {
+                "shardId": req.get("shardId", 0),
+                "serverNode": {
+                    "id": 1, "host": "127.0.0.1",
+                    "port": node_port or port_holder["port"],
+                },
+            }
+
+        async def append(req, ctx):
+            batch = codec("BatchHStreamRecords").decode(
+                req["records"]["payload"]
+            )
+            appended.append((req["streamName"], req.get("shardId"),
+                             batch.get("records", [])))
+            return {
+                "streamName": req["streamName"],
+                "shardId": req.get("shardId", 0),
+                "recordIds": [
+                    {"shardId": req.get("shardId", 0), "batchId": 1,
+                     "batchIndex": i}
+                    for i in range(len(batch.get("records", [])))
+                ],
+            }
+
+        impl = {"Echo": echo, "ListShards": list_shards,
+                "LookupShard": lookup, "Append": append}
+        handlers = {}
+        for m, (req_t, resp_t) in METHODS.items():
+            handlers[m] = grpc.unary_unary_rpc_method_handler(
+                impl[m],
+                request_deserializer=lambda b, _t=req_t: codec(_t).decode(b),
+                response_serializer=lambda d, _t=resp_t: codec(_t).encode(d),
+            )
+        s = grpc.aio.server()
+        s.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(SERVICE, handlers),)
+        )
+        return s
+
+    holder = {}
+    srv = make_server(holder)
+    port = srv.add_insecure_port("127.0.0.1:0")
+    holder["port"] = port
+    await srv.start()
+    try:
+        conn = HStreamConnector("127.0.0.1", port, stream="iot")
+        await conn.on_start()
+        assert conn.shard_id == 7
+        ids = await conn.on_batch_query(
+            [{"clientid": "c1", "payload": "r1"},
+             {"clientid": "c2", "payload": "r2"}]
+        )
+        assert len(ids) == 2 and ids[0]["batchIndex"] == 0
+        stream, shard, records = appended[0]
+        assert (stream, shard) == ("iot", 7)
+        assert [r["payload"] for r in records] == [b"r1", b"r2"]
+        assert records[0]["header"]["key"] == "c1"
+        assert records[0]["header"]["flag"] == "RAW"
+        await conn.on_stop()
+    finally:
+        await srv.stop(0.2)

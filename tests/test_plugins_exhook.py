@@ -316,3 +316,210 @@ def test_exhook_reconnect_rebind_no_window():
     assert b.hooks.run_fold("client.authenticate", ({},), False) is True
     bridge.stop()
     srv.close()
+
+
+# --- gRPC transport (the reference's actual exhook.proto contract) --------
+
+
+class GrpcServerThread:
+    """Run a GrpcHookProvider on its own thread+loop."""
+
+    def __init__(self, handlers):
+        from emqx_tpu.exhook.grpc_transport import GrpcHookProvider
+
+        self.server = GrpcHookProvider(handlers)
+        self.addr = None
+        self._loop = None
+        ready = threading.Event()
+
+        def run():
+            loop = asyncio.new_event_loop()
+            self._loop = loop
+            asyncio.set_event_loop(loop)
+
+            async def boot():
+                self.addr = await self.server.start()
+                ready.set()
+
+            loop.create_task(boot())
+            loop.run_forever()
+            loop.close()
+
+        self._t = threading.Thread(target=run, daemon=True)
+        self._t.start()
+        assert ready.wait(5)
+
+    def close(self):
+        loop = self._loop
+        if loop is not None:
+
+            def stop():
+                asyncio.ensure_future(self.server.stop())
+                loop.call_later(0.3, loop.stop)
+
+            loop.call_soon_threadsafe(stop)
+        self._t.join(timeout=5)
+
+
+def test_exhook_grpc_fold_and_notify():
+    """The fold/notify flow of test_exhook_fold_and_notify, over REAL
+    gRPC frames (grpcio channel against the HookProvider service).
+    Handlers receive real Message objects, not wire dicts."""
+    notified = []
+
+    def on_publish(args, acc):
+        # acc is a real Message here (proto-decoded server-side)
+        if acc.topic.startswith("blocked/"):
+            return ("stop", None)
+        from emqx_tpu.broker.message import Message
+
+        out = Message(
+            topic=acc.topic, payload=acc.payload + b"!", qos=acc.qos,
+            from_client=acc.from_client,
+        )
+        return ("ok", out)
+
+    def on_connected(args, acc):
+        notified.append(tuple(args))
+
+    srv = GrpcServerThread({
+        "message.publish": on_publish,
+        "client.connected": on_connected,
+    })
+    b = Broker()
+    bridge = ExHookBridge(b, srv.addr, timeout=5.0, transport="grpc")
+    bridge.start()
+    assert set(bridge.hookpoints) == {"message.publish", "client.connected"}
+    try:
+        outs = []
+        s, _ = b.open_session("c1", True)
+        b.subscribe(s, "#", SubOpts())
+        s.outgoing_sink = outs.extend
+        b.publish(Message(topic="t/x", payload=b"hi"))
+        assert outs[-1].payload == b"hi!"
+        assert b.publish(Message(topic="blocked/t", payload=b"no")) == 0
+        b.hooks.run("client.connected", "c9", 5, "1.2.3.4")
+        deadline = time.time() + 5
+        while not notified and time.time() < deadline:
+            time.sleep(0.01)
+        assert notified and notified[0][0] == "c9"
+    finally:
+        bridge.stop()
+        srv.close()
+    assert b.publish(Message(topic="blocked/t", payload=b"yes")) == 1
+
+
+def test_exhook_grpc_authenticate_authorize():
+    seen = []
+
+    def on_auth(args, acc):
+        info = args[0]
+        seen.append(("authn", info["client_id"], info["username"]))
+        return ("stop", info["username"] == "alice")
+
+    def on_authz(args, acc):
+        cid, action, topic = args
+        seen.append(("authz", cid, action, topic))
+        return ("stop", not topic.startswith("secret/"))
+
+    srv = GrpcServerThread({
+        "client.authenticate": on_auth,
+        "client.authorize": on_authz,
+    })
+    b = Broker()
+    bridge = ExHookBridge(b, srv.addr, timeout=5.0, transport="grpc")
+    bridge.start()
+    try:
+        ok = b.hooks.run_fold(
+            "client.authenticate",
+            (dict(client_id="c1", username="alice", password=b"pw",
+                  peer="1.1.1.1"),),
+            True,
+        )
+        assert ok is True
+        bad = b.hooks.run_fold(
+            "client.authenticate",
+            (dict(client_id="c2", username="bob", password=b"pw",
+                  peer="1.1.1.1"),),
+            True,
+        )
+        assert bad is False
+        assert b.hooks.run_fold(
+            "client.authorize", ("c1", "publish", "ok/t"), True
+        ) is True
+        assert b.hooks.run_fold(
+            "client.authorize", ("c1", "subscribe", "secret/t"), True
+        ) is False
+        assert ("authn", "c1", "alice") in seen
+        assert ("authz", "c1", "subscribe", "secret/t") in seen
+    finally:
+        bridge.stop()
+        srv.close()
+
+
+def test_exhook_grpc_service_path_is_reference_contract():
+    """A bare grpcio client calling the canonical method path proves
+    the service identity matches the reference's exhook.proto."""
+    import grpc
+
+    from emqx_tpu.exhook.grpc_transport import SERVICE, codec
+
+    srv = GrpcServerThread({"client.connected": lambda a, acc: None})
+    try:
+        with grpc.insecure_channel(f"{srv.addr[0]}:{srv.addr[1]}") as ch:
+            fn = ch.unary_unary(
+                f"/{SERVICE}/OnProviderLoaded",
+                request_serializer=lambda d: codec(
+                    "ProviderLoadedRequest"
+                ).encode(d),
+                response_deserializer=lambda b_: codec(
+                    "LoadedResponse"
+                ).decode(b_),
+            )
+            resp = fn({"broker": {"version": "x"}, "meta": {"node": "n"}})
+            assert [h["name"] for h in resp["hooks"]] == ["client.connected"]
+            assert SERVICE == "emqx.exhook.v2.HookProvider"
+    finally:
+        srv.close()
+
+
+def test_exhook_grpc_subscribe_filters_and_bare_continue():
+    """r4 review regressions: (a) ClientSubscribeRequest carries the
+    actual topic_filters on the cast path; (b) a bare {type: CONTINUE}
+    ValuedResponse (no value) is no-opinion, not a denial."""
+    from emqx_tpu.broker.packet import SubOpts as _SubOpts
+
+    got = []
+
+    def on_subscribe(args, acc):
+        got.append(("sub", args[0], acc))
+
+    from emqx_tpu.exhook import grpc_transport as GT
+
+    # an ecosystem server replying {type: CONTINUE} with NO value means
+    # "no opinion" — it must not overwrite the accumulator with False
+    assert GT.response_to_verdict(
+        "client.authenticate", {"type": "CONTINUE"}, True
+    ) == ("ignore", True)
+    assert GT.response_to_verdict(
+        "client.authenticate", {"type": "STOP_AND_RETURN"}, True
+    ) == ("stop", True)
+
+    srv = GrpcServerThread({"client.subscribe": on_subscribe})
+    b = Broker()
+    bridge = ExHookBridge(b, srv.addr, timeout=5.0, transport="grpc")
+    bridge.start()
+    try:
+        filters = [("a/b", _SubOpts(qos=1)), ("c/#", _SubOpts(qos=0))]
+        b.hooks.run_fold("client.subscribe", ("c1",), filters)
+        deadline = time.time() + 5
+        while not got and time.time() < deadline:
+            time.sleep(0.01)
+        assert got, "subscribe notification never arrived"
+        _k, cid, acc_filters = got[0]
+        assert cid == "c1"
+        assert [f[0] for f in acc_filters] == ["a/b", "c/#"]
+        assert acc_filters[0][1]["qos"] == 1
+    finally:
+        bridge.stop()
+        srv.close()
