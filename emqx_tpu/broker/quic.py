@@ -11,13 +11,19 @@ connection machinery — long/short header packets with coalescing,
 CRYPTO / STREAM / ACK / HANDSHAKE_DONE / CONNECTION_CLOSE frames,
 per-space packet numbers, and ordered stream reassembly.
 
-Scope: the profile our endpoints need. In-order-tolerant reassembly
-(offset-keyed buffers) but NO loss recovery timers — QUIC here runs
-datacenter/loopback links where the kernel does not drop; a lost
-datagram surfaces as an idle-timeout disconnect, the same failure
-mode as a dead TCP peer. Flow-control limits are advertised large
-and not enforced. One bidirectional stream (id 0) is served — exactly
-the reference's single-stream mode."""
+Scope: the profile our endpoints need, now including the RFC 9002
+minimum recovery machinery — per-space sent-packet tracking,
+packet-threshold loss declaration off ACK ranges, PTO timers with
+exponential backoff (server _pto_loop / client endpoint pump), and
+retransmission of lost CRYPTO/STREAM ranges — so a lossy link heals
+instead of idling out. Flow control is real both ways: finite
+windows are advertised and ENFORCED on receive (FLOW_CONTROL_ERROR
+on overrun), replenished with MAX_DATA/MAX_STREAM_DATA as the app
+consumes, and the peer's advertised windows gate our sends. TLS-PSK
+(psk_dhe_ke) authenticates clients against a PskStore when the
+listener carries one. One bidirectional stream (id 0) is served —
+exactly the reference's single-stream mode; congestion control
+beyond PTO pacing is future work."""
 
 from __future__ import annotations
 
@@ -44,6 +50,16 @@ FT_ACK = 0x02
 FT_CRYPTO = 0x06
 FT_STREAM_BASE = 0x08  # 0x08..0x0f
 FT_MAX_DATA = 0x10
+FT_MAX_STREAM_DATA = 0x11
+
+# RFC 9002 minimum-viable recovery knobs
+K_PACKET_THRESHOLD = 3  # reordering threshold (§6.1.1)
+PTO_INITIAL = 0.3  # s; doubles per consecutive timeout (§6.2)
+PTO_MAX = 8.0
+# flow-control windows we ADVERTISE (and therefore enforce on RX);
+# MAX_DATA / MAX_STREAM_DATA replenish as the app consumes (§4)
+FC_CONN_WINDOW = 1 << 20
+FC_STREAM_WINDOW = 1 << 19
 FT_CONN_CLOSE = 0x1C
 FT_CONN_CLOSE_APP = 0x1D
 FT_HANDSHAKE_DONE = 0x1E
@@ -61,16 +77,31 @@ def encode_transport_params(scid: bytes,
         out += tp(0x00, odcid)  # original_destination_connection_id
     out += tp(0x01, enc_varint(30_000))  # max_idle_timeout ms
     out += tp(0x03, enc_varint(65527))  # max_udp_payload_size
-    # credit is never replenished (no MAX_DATA updates), so advertise
-    # the varint maximum — a conformant peer then never stalls on it
-    out += tp(0x04, enc_varint((1 << 60)))  # initial_max_data
-    out += tp(0x05, enc_varint((1 << 60)))  # max_stream_data bidi local
-    out += tp(0x06, enc_varint((1 << 60)))  # bidi remote
-    out += tp(0x07, enc_varint((1 << 60)))  # uni
+    # finite windows, replenished with MAX_DATA / MAX_STREAM_DATA as
+    # the app consumes (RFC 9000 §4) — and ENFORCED on receive
+    out += tp(0x04, enc_varint(FC_CONN_WINDOW))  # initial_max_data
+    out += tp(0x05, enc_varint(FC_STREAM_WINDOW))  # max_stream_data bidi local
+    out += tp(0x06, enc_varint(FC_STREAM_WINDOW))  # bidi remote
+    out += tp(0x07, enc_varint(FC_STREAM_WINDOW))  # uni
     out += tp(0x08, enc_varint(16))  # initial_max_streams_bidi
     out += tp(0x09, enc_varint(16))  # uni
     out += tp(0x0F, scid)  # initial_source_connection_id
     return out
+
+
+class _SentPacket:
+    """Bookkeeping for one ack-eliciting packet in flight."""
+
+    __slots__ = ("time", "crypto", "stream", "hs_done", "ping", "fc")
+
+    def __init__(self, time, crypto=None, stream=None, hs_done=False,
+                 ping=False, fc=False):
+        self.time = time
+        self.crypto = crypto  # (offset, length) into crypto_out
+        self.stream = stream  # (abs offset, length) of stream data
+        self.hs_done = hs_done
+        self.ping = ping
+        self.fc = fc  # carried a MAX_DATA/MAX_STREAM_DATA update
 
 
 class _Space:
@@ -87,6 +118,13 @@ class _Space:
         self.crypto_sent = 0
         self.crypto_in: Dict[int, bytes] = {}
         self.crypto_in_off = 0
+        # --- loss recovery (RFC 9002) ---
+        self.sent: Dict[int, _SentPacket] = {}
+        self.largest_acked = -1
+        self.crypto_rtx: List[Tuple[int, int]] = []  # lost (off, len)
+        self.ping_due = False
+        self.last_eliciting_sent = 0.0
+        self.pto_count = 0
 
 
 class QuicConnection:
@@ -103,12 +141,60 @@ class QuicConnection:
         self.stream_rx_off = 0
         self.stream_out = b""  # unsent suffix only (trimmed on flush)
         self.stream_sent = 0  # absolute stream offset already sent
+        # unacked sent stream chunks (abs_off -> bytes) + declared-lost
+        # chunks awaiting retransmission
+        self._stream_unacked: Dict[int, bytes] = {}
+        self._stream_rtx: List[Tuple[int, bytes]] = []
+        # --- flow control (RFC 9000 §4) ---
+        # peer's allowance for OUR sends (from its transport params /
+        # MAX_DATA / MAX_STREAM_DATA); conservative until params parse
+        self.tx_max_data = 1 << 14
+        self.tx_max_stream = 1 << 14
+        self._peer_params_seen = False
+        # OUR advertised windows (enforced on receive, replenished as
+        # the app consumes)
+        self.rx_max_data = FC_CONN_WINDOW
+        self.rx_max_stream = FC_STREAM_WINDOW
+        self._rx_consumed = 0
+        self._fc_update_due = False
+        self._clock = __import__("time").monotonic
         self.stream_fin_rcvd = False
         self.on_stream_data: Optional[Callable[[bytes], None]] = None
         self.on_close: Optional[Callable[[], None]] = None
         self.handshake_done = False
         self.closed = False
         self.close_pending: Optional[Tuple[int, str]] = None
+
+    def _maybe_parse_peer_params(self) -> None:
+        if self._peer_params_seen or self.tls is None:
+            return
+        raw = getattr(self.tls, "peer_transport_params", None)
+        if not raw:
+            return
+        off = 0
+        params = {}
+        try:
+            while off < len(raw):
+                tid, off = dec_varint(raw, off)
+                ln, off = dec_varint(raw, off)
+                params[tid] = raw[off : off + ln]
+                off += ln
+        except Exception:
+            return
+        def vint(tid, default):
+            v = params.get(tid)
+            if not v:
+                return default
+            try:
+                return dec_varint(v, 0)[0]
+            except Exception:
+                return default
+        self.tx_max_data = vint(0x04, self.tx_max_data)
+        # stream 0 is client-initiated bidi: the sender honors the
+        # receiver's bidi_remote (server side) / bidi_local (client)
+        tid = 0x06 if not self.is_server else 0x05
+        self.tx_max_stream = vint(tid, self.tx_max_stream)
+        self._peer_params_seen = True
 
     # --- frame/packet building -----------------------------------------
 
@@ -134,7 +220,7 @@ class QuicConnection:
             header += enc_varint(len(frames) + 2 + 16)  # pn + payload + tag
             pn_off = len(header)
             header += encode_pn(pn)
-        return protect(sp.tx, header, pn, frames, pn_off)
+        return protect(sp.tx, header, pn, frames, pn_off), pn
 
     def _ack_frame(self, sp: _Space) -> bytes:
         largest = sp.largest_rx
@@ -146,19 +232,45 @@ class QuicConnection:
             + enc_varint(0) + enc_varint(first)
         )
 
-    def _pending_frames(self, level: str) -> bytes:
+    def _pending_frames(self, level: str):
+        """-> (frames bytes, _SentPacket meta | None). Meta is non-None
+        when the packet is ack-eliciting (needs loss tracking)."""
         sp = self.spaces[level]
         out = b""
+        meta = None
+
+        def mark(**kw):
+            nonlocal meta
+            if meta is None:
+                meta = _SentPacket(self._clock())
+            for k, v in kw.items():
+                setattr(meta, k, v)
+
         if sp.ack_due and sp.largest_rx >= 0:
             out += self._ack_frame(sp)
             sp.ack_due = False
-        if sp.crypto_sent < len(sp.crypto_out):
-            chunk = sp.crypto_out[sp.crypto_sent:]
+        if sp.ping_due:
+            out += bytes([FT_PING])
+            sp.ping_due = False
+            mark(ping=True)
+        # retransmit declared-lost CRYPTO ranges first (RFC 9002 §6.3)
+        if sp.crypto_rtx:
+            coff, clen = sp.crypto_rtx.pop(0)
+            chunk = sp.crypto_out[coff : coff + clen]
             out += (
-                bytes([FT_CRYPTO]) + enc_varint(sp.crypto_sent)
+                bytes([FT_CRYPTO]) + enc_varint(coff)
+                + enc_varint(len(chunk)) + chunk
+            )
+            mark(crypto=(coff, clen))
+        elif sp.crypto_sent < len(sp.crypto_out):
+            coff = sp.crypto_sent
+            chunk = sp.crypto_out[coff:]
+            out += (
+                bytes([FT_CRYPTO]) + enc_varint(coff)
                 + enc_varint(len(chunk)) + chunk
             )
             sp.crypto_sent = len(sp.crypto_out)
+            mark(crypto=(coff, len(chunk)))
         if self.close_pending is not None and level != "app" and (
             self.spaces["app"].tx is None
         ):
@@ -178,16 +290,49 @@ class QuicConnection:
             ):
                 out += bytes([FT_HANDSHAKE_DONE])
                 self._hs_done_sent = True
-            if self.stream_out:
-                chunk = self.stream_out
+                mark(hs_done=True)
+            if self._fc_update_due:
+                # replenish the peer's send window as the app consumed
+                self.rx_max_data = self._rx_consumed + FC_CONN_WINDOW
+                self.rx_max_stream = self._rx_consumed + FC_STREAM_WINDOW
+                out += bytes([FT_MAX_DATA]) + enc_varint(self.rx_max_data)
                 out += (
-                    bytes([FT_STREAM_BASE | 0x04 | 0x02])  # off+len bits
-                    + enc_varint(0)  # stream 0
-                    + enc_varint(self.stream_sent)
+                    bytes([FT_MAX_STREAM_DATA]) + enc_varint(0)
+                    + enc_varint(self.rx_max_stream)
+                )
+                self._fc_update_due = False
+                mark(fc=True)
+            self._maybe_parse_peer_params()
+            # retransmit lost stream chunks before new data
+            if self._stream_rtx:
+                s_off, chunk = self._stream_rtx.pop(0)
+                out += (
+                    bytes([FT_STREAM_BASE | 0x04 | 0x02])
+                    + enc_varint(0) + enc_varint(s_off)
                     + enc_varint(len(chunk)) + chunk
                 )
-                self.stream_sent += len(chunk)
-                self.stream_out = b""  # trimmed: no unbounded retention
+                self._stream_unacked[s_off] = chunk
+                mark(stream=(s_off, len(chunk)))
+            elif self.stream_out:
+                # peer flow control: send only within its advertised
+                # connection + stream windows (RFC 9000 §4.1)
+                allowance = max(
+                    0,
+                    min(self.tx_max_data, self.tx_max_stream)
+                    - self.stream_sent,
+                )
+                chunk = self.stream_out[:allowance]
+                if chunk:
+                    out += (
+                        bytes([FT_STREAM_BASE | 0x04 | 0x02])  # off+len
+                        + enc_varint(0)  # stream 0
+                        + enc_varint(self.stream_sent)
+                        + enc_varint(len(chunk)) + chunk
+                    )
+                    self._stream_unacked[self.stream_sent] = chunk
+                    mark(stream=(self.stream_sent, len(chunk)))
+                    self.stream_sent += len(chunk)
+                    self.stream_out = self.stream_out[len(chunk):]
             if self.close_pending is not None:
                 code, reason = self.close_pending
                 r = reason.encode()[:64]
@@ -197,26 +342,35 @@ class QuicConnection:
                 )
                 self.close_pending = None
                 self.closed = True
-        return out
+        return out, meta
 
     def flush(self) -> List[bytes]:
-        """Datagrams ready to send (levels coalesced)."""
-        dgram = b""
-        for level in LEVELS:
-            sp = self.spaces[level]
-            if sp.tx is None:
-                continue
-            frames = self._pending_frames(level)
-            if not frames:
-                continue
-            if level == "initial" and not self.is_server:
-                # client Initials pad the DATAGRAM to >=1200 (RFC 9000
-                # §14.1); header+tag overhead is ~44B, pad with margin
-                need = 1200 - len(frames) - 28
-                if need > 0:
-                    frames += b"\x00" * need
-            dgram += self._build_packet(level, frames)
-        return [dgram] if dgram else []
+        """Datagrams ready to send (levels coalesced). Loops per level
+        until drained (retransmissions emit one range per packet)."""
+        dgrams: List[bytes] = []
+        while True:
+            dgram = b""
+            for level in LEVELS:
+                sp = self.spaces[level]
+                if sp.tx is None:
+                    continue
+                frames, meta = self._pending_frames(level)
+                if not frames:
+                    continue
+                if level == "initial" and not self.is_server:
+                    # client Initials pad the DATAGRAM to >=1200 (RFC
+                    # 9000 §14.1); header+tag overhead ~44B
+                    need = 1200 - len(frames) - 28
+                    if need > 0:
+                        frames += b"\x00" * need
+                pkt, pn = self._build_packet(level, frames)
+                dgram += pkt
+                if meta is not None:
+                    sp.sent[pn] = meta
+                    sp.last_eliciting_sent = meta.time
+            if not dgram:
+                return dgrams
+            dgrams.append(dgram)
 
     # --- receive --------------------------------------------------------
 
@@ -305,13 +459,22 @@ class QuicConnection:
                 eliciting = True
                 continue
             if ft == FT_ACK:
-                _largest, off = dec_varint(payload, off)
+                largest, off = dec_varint(payload, off)
                 _delay, off = dec_varint(payload, off)
                 rc, off = dec_varint(payload, off)
-                _first, off = dec_varint(payload, off)
-                for _ in range(rc):
-                    _gap, off = dec_varint(payload, off)
-                    _rng, off = dec_varint(payload, off)
+                first, off = dec_varint(payload, off)
+                # ranges stay as (lo, hi) BOUNDS — the varints are
+                # peer-controlled up to 2^62; materializing them as a
+                # set would be a one-frame memory-exhaustion DoS
+                ranges = [(largest - first, largest)]
+                lo = largest - first
+                for _ in range(min(rc, 256)):
+                    gap, off = dec_varint(payload, off)
+                    rng, off = dec_varint(payload, off)
+                    hi = lo - gap - 2
+                    ranges.append((hi - rng, hi))
+                    lo = hi - rng
+                self._on_ack(level, ranges)
                 continue
             if ft == FT_CRYPTO:
                 coff, off = dec_varint(payload, off)
@@ -347,10 +510,19 @@ class QuicConnection:
                 self.handshake_done = True
                 eliciting = True
                 continue
-            if ft in (FT_MAX_DATA, 0x11, 0x12, 0x13):
+            if ft == FT_MAX_DATA:
+                v, off = dec_varint(payload, off)
+                self.tx_max_data = max(self.tx_max_data, v)
+                eliciting = True
+                continue
+            if ft == FT_MAX_STREAM_DATA:
+                _sid, off = dec_varint(payload, off)
+                v, off = dec_varint(payload, off)
+                self.tx_max_stream = max(self.tx_max_stream, v)
+                eliciting = True
+                continue
+            if ft in (0x12, 0x13):  # MAX_STREAMS
                 _v, off = dec_varint(payload, off)
-                if ft == 0x11:
-                    _v2, off = dec_varint(payload, off)
                 eliciting = True
                 continue
             if ft in (0x18,):  # NEW_CONNECTION_ID: skip fields
@@ -385,17 +557,114 @@ class QuicConnection:
                 self.close(0x0128, str(e))
 
     def _stream_in(self, s_off: int, data: bytes, fin: bool) -> None:
+        if s_off + len(data) > min(self.rx_max_data, self.rx_max_stream):
+            # the peer overran the window we advertised (RFC 9000
+            # §4.1): FLOW_CONTROL_ERROR, not silent acceptance
+            self.close(0x03, "flow control violated")
+            return
+        if s_off + len(data) <= self.stream_rx_off:
+            return  # spurious retransmission of delivered data
+        if s_off < self.stream_rx_off:
+            # trim the already-delivered prefix so the chunk keys at
+            # the reassembly cursor (a stale key would leak forever)
+            data = data[self.stream_rx_off - s_off:]
+            s_off = self.stream_rx_off
         self.stream_rx[s_off] = data
         out = b""
         while self.stream_rx_off in self.stream_rx:
             chunk = self.stream_rx.pop(self.stream_rx_off)
             out += chunk
             self.stream_rx_off += len(chunk)
-        if out and self.on_stream_data is not None:
-            self.on_stream_data(out)
+        if out:
+            self._rx_consumed += len(out)
+            # replenish once half of EITHER window is consumed — the
+            # (smaller) stream window exhausts first; keying only off
+            # the connection window would deadlock a conformant peer
+            if (
+                self.rx_max_data - self._rx_consumed < FC_CONN_WINDOW // 2
+                or self.rx_max_stream - self._rx_consumed
+                < FC_STREAM_WINDOW // 2
+            ):
+                self._fc_update_due = True
+            if self.on_stream_data is not None:
+                self.on_stream_data(out)
         if fin:
             self.stream_fin_rcvd = True
             self._closed_by_peer()
+
+    def _on_ack(self, level: str, ranges: list) -> None:
+        sp = self.spaces[level]
+        # clamp acknowledgment claims to what we actually sent
+        sent_max = sp.next_pn - 1
+        newly = [
+            pn for pn in sp.sent
+            if any(lo <= pn <= hi for lo, hi in ranges)
+        ]
+        if not newly:
+            return
+        sp.pto_count = 0  # forward progress resets the backoff
+        for pn in newly:
+            meta = sp.sent.pop(pn)
+            if meta.stream is not None:
+                self._stream_unacked.pop(meta.stream[0], None)
+        claimed = max(hi for _lo, hi in ranges)
+        sp.largest_acked = max(sp.largest_acked, min(claimed, sent_max))
+        self._detect_losses(sp)
+
+    def _detect_losses(self, sp: _Space) -> None:
+        """Packet-threshold loss (RFC 9002 §6.1.1): anything
+        K_PACKET_THRESHOLD below the largest acked is lost."""
+        lost = [
+            pn for pn in sp.sent
+            if pn <= sp.largest_acked - K_PACKET_THRESHOLD
+        ]
+        for pn in sorted(lost):
+            self._declare_lost(sp, sp.sent.pop(pn))
+
+    def _declare_lost(self, sp: _Space, meta: "_SentPacket") -> None:
+        if meta.crypto is not None:
+            sp.crypto_rtx.append(meta.crypto)
+        if meta.stream is not None:
+            s_off = meta.stream[0]
+            chunk = self._stream_unacked.pop(s_off, None)
+            if chunk is not None:
+                self._stream_rtx.append((s_off, chunk))
+        if meta.hs_done:
+            self._hs_done_sent = False
+        if meta.fc:
+            # the peer may be BLOCKED on this update; it must resend
+            self._fc_update_due = True
+
+    def next_timeout(self) -> Optional[float]:
+        """Earliest PTO deadline across spaces (absolute monotonic
+        time), None when nothing is in flight."""
+        deadline = None
+        for sp in self.spaces.values():
+            if sp.tx is None or not sp.sent:
+                continue
+            pto = min(PTO_INITIAL * (2 ** sp.pto_count), PTO_MAX)
+            d = sp.last_eliciting_sent + pto
+            deadline = d if deadline is None else min(deadline, d)
+        return deadline
+
+    def on_timeout(self, now: Optional[float] = None) -> bool:
+        """PTO expiry (RFC 9002 §6.2): declare the in-flight packets
+        of overdue spaces lost so their data retransmits, and back off.
+        Returns True when anything became sendable (owner must flush)."""
+        now = self._clock() if now is None else now
+        fired = False
+        for sp in self.spaces.values():
+            if sp.tx is None or not sp.sent or self.closed:
+                continue
+            pto = min(PTO_INITIAL * (2 ** sp.pto_count), PTO_MAX)
+            if now - sp.last_eliciting_sent < pto:
+                continue
+            sp.pto_count += 1
+            for pn in sorted(sp.sent):
+                self._declare_lost(sp, sp.sent.pop(pn))
+            sp.ping_due = True  # elicit an ACK even if nothing rebuilt
+            fired = True
+        return fired
 
     def _closed_by_peer(self) -> None:
         if not self.closed:
@@ -417,12 +686,13 @@ class QuicConnection:
 
 
 class ServerConnection(QuicConnection):
-    def __init__(self, odcid: bytes, cert=None):
+    def __init__(self, odcid: bytes, cert=None, psk_lookup=None):
         super().__init__(True, scid=os.urandom(8), dcid=b"")
         sp = self.spaces["initial"]
         sp.rx, sp.tx = initial_keys(odcid, is_server=True)
         self.tls = TlsServer(
-            encode_transport_params(self.scid, odcid=odcid), cert=cert
+            encode_transport_params(self.scid, odcid=odcid), cert=cert,
+            psk_lookup=psk_lookup,
         )
 
     def _tls_input(self, level: str, data: bytes) -> None:
@@ -443,12 +713,15 @@ class ServerConnection(QuicConnection):
 
 
 class ClientConnection(QuicConnection):
-    def __init__(self):
+    def __init__(self, psk_identity=None, psk=None):
         odcid = os.urandom(8)
         super().__init__(False, scid=os.urandom(8), dcid=odcid)
         sp = self.spaces["initial"]
         sp.rx, sp.tx = initial_keys(odcid, is_server=False)
-        self.tls = TlsClient(encode_transport_params(self.scid))
+        self.tls = TlsClient(
+            encode_transport_params(self.scid),
+            psk_identity=psk_identity, psk=psk,
+        )
         sp.crypto_out += self.tls.client_hello()
 
     def _tls_input(self, level: str, data: bytes) -> None:
@@ -543,7 +816,7 @@ class QuicServer:
     # Initials are cheap to send; state for them must not be)
 
     def __init__(self, mqtt_server, host: str = "0.0.0.0", port: int = 14567,
-                 cert=None):
+                 cert=None, psk_store=None):
         import time as _time
 
         self.mqtt = mqtt_server  # a broker Server (never TCP-started)
@@ -559,8 +832,13 @@ class QuicServer:
         # once) — not per connection
         from .quic_tls import make_server_cert
 
+        # TLS-PSK identity store (emqx_psk analog); enables psk_dhe_ke
+        # on this listener when set
+        self.psk_store = psk_store
+
         self.cert = cert or make_server_cert()
         self._gc_task = None
+        self._pto_task = None
 
     async def start(self) -> None:
         loop = asyncio.get_running_loop()
@@ -570,7 +848,28 @@ class QuicServer:
         )
         self.listen_addr = self._udp.get_extra_info("sockname")[:2]
         self._gc_task = asyncio.ensure_future(self._gc_loop())
+        self._pto_task = asyncio.ensure_future(self._pto_loop())
         log.info("quic listening on %s", self.listen_addr)
+
+    async def _pto_loop(self) -> None:
+        """Recovery pump: fire overdue PTOs and ship retransmissions
+        (RFC 9002 §6.2). 100ms granularity bounds timer error well
+        under one PTO backoff step."""
+        while True:
+            try:
+                await asyncio.sleep(0.1)
+                for scid, conn in list(self.conns.items()):
+                    if conn.closed:
+                        continue
+                    if conn.on_timeout():
+                        addr = self._addr.get(conn.scid)
+                        if addr is not None and self._udp is not None:
+                            for dgram in conn.flush():
+                                self._udp.sendto(dgram, addr)
+            except asyncio.CancelledError:
+                return
+            except Exception:
+                log.exception("quic pto loop crashed")
 
     async def _gc_loop(self) -> None:
         while True:
@@ -605,6 +904,9 @@ class QuicServer:
         if self._gc_task is not None:
             self._gc_task.cancel()
             self._gc_task = None
+        if getattr(self, "_pto_task", None) is not None:
+            self._pto_task.cancel()
+            self._pto_task = None
         if self._udp is not None:
             self._udp.close()
             self._udp = None
@@ -629,7 +931,13 @@ class QuicServer:
             if self.mqtt.evicting or not self.mqtt.limits.accept_allowed():
                 self.mqtt.broker.metrics.inc("listener.conn_rate_limited")
                 return
-            conn = ServerConnection(odcid=cid, cert=self.cert)
+            conn = ServerConnection(
+                odcid=cid, cert=self.cert,
+                psk_lookup=(
+                    self.psk_store.lookup if self.psk_store is not None
+                    else None
+                ),
+            )
             self.conns[cid] = conn
             self.conns[conn.scid] = conn
             self._born[conn.scid] = self._now()
@@ -658,8 +966,8 @@ class QuicClientEndpoint:
     """Client seam: UDP socket + ClientConnection + handshake pump.
     recv() yields ordered stream-0 bytes (the MQTT byte stream)."""
 
-    def __init__(self):
-        self.conn = ClientConnection()
+    def __init__(self, psk_identity=None, psk=None):
+        self.conn = ClientConnection(psk_identity=psk_identity, psk=psk)
         self._udp = None
         self.addr = None
         self._q: asyncio.Queue = asyncio.Queue()
@@ -686,8 +994,23 @@ class QuicClientEndpoint:
             if loop.time() > deadline:
                 raise TimeoutError("quic handshake timed out")
             await asyncio.sleep(0.005)
+            # drive client-side loss recovery during the handshake too:
+            # a dropped Initial/Handshake datagram must retransmit
+            self.conn.on_timeout()
             self._flush()
+        self._pump_task = asyncio.ensure_future(self._pump())
         return self
+
+    async def _pump(self) -> None:
+        """Post-handshake recovery pump (PTO + retransmissions)."""
+        while not self.conn.closed:
+            await asyncio.sleep(0.1)
+            try:
+                if self.conn.on_timeout():
+                    self._flush()
+            except Exception:
+                log.exception("quic client pump crashed")
+                return
 
     def _flush(self) -> None:
         if self._udp is None:
@@ -703,6 +1026,9 @@ class QuicClientEndpoint:
         return await asyncio.wait_for(self._q.get(), timeout)
 
     def close(self) -> None:
+        t = getattr(self, "_pump_task", None)
+        if t is not None:
+            t.cancel()
         self.conn.close(0, "client done")
         self._flush()
         if self._udp is not None:

@@ -114,6 +114,15 @@ class KeySchedule:
         self.hs: Optional[bytes] = None
         self.master: Optional[bytes] = None
 
+    def set_psk(self, psk: bytes) -> None:
+        """Seed the early secret from an external PSK (RFC 8446 §7.1:
+        Early = HKDF-Extract(0, PSK)). Must run before handshake()."""
+        self.early = hkdf_extract(b"\x00" * HASH_LEN, psk)
+
+    def binder_key(self) -> bytes:
+        """The external-PSK binder base key (§4.2.11.2 'ext binder')."""
+        return derive_secret(self.early, "ext binder", b"")
+
     def handshake(self, ecdhe: bytes) -> None:
         derived = derive_secret(self.early, "derived", b"")
         self.hs = hkdf_extract(derived, ecdhe)

@@ -8,9 +8,13 @@ messages flow through QUIC CRYPTO frames — this module only builds/
 consumes the TLS byte stream and hands traffic secrets back to the
 connection layer at each level switch.
 
-Client certificates, HelloRetryRequest, PSK/resumption, and any other
-cipher/group are out of scope; an endpoint offering only those gets a
-clean handshake failure."""
+External PSK (psk_dhe_ke, RFC 8446 §4.2.11) authenticates clients
+against a listener PskStore: binder verification on the truncated
+ClientHello, certificate-free server flight on acceptance, and a
+clean fallback to certificate auth for unknown identities.
+Client certificates, HelloRetryRequest, resumption tickets, and any
+other cipher/group are out of scope; an endpoint offering only those
+gets a clean handshake failure."""
 
 from __future__ import annotations
 
@@ -50,8 +54,11 @@ EXT_SUPPORTED_GROUPS = 10
 EXT_SIG_ALGS = 13
 EXT_ALPN = 16
 EXT_SUPPORTED_VERSIONS = 43
+EXT_PRE_SHARED_KEY = 41
+EXT_PSK_MODES = 45
 EXT_KEY_SHARE = 51
 EXT_QUIC_TP = 0x39
+PSK_DHE_KE = 1
 
 TLS13 = 0x0304
 
@@ -157,9 +164,15 @@ class TlsServer:
     connection layer."""
 
     def __init__(self, transport_params: bytes, alpn: str = "mqtt",
-                 cert: Optional[Tuple[object, bytes]] = None):
+                 cert: Optional[Tuple[object, bytes]] = None,
+                 psk_lookup=None):
         self.tp = transport_params
         self.alpn = alpn
+        # identity -> key resolver (broker/psk.py PskStore.lookup);
+        # psk_dhe_ke only: the ECDHE exchange stays in, PSK replaces
+        # certificate authentication (RFC 8446 §2.2, §4.2.11)
+        self.psk_lookup = psk_lookup
+        self.psk_identity: Optional[bytes] = None
         self.schedule = KeySchedule()
         self.transcript = b""
         self.buf = _MsgBuf()
@@ -184,11 +197,64 @@ class TlsServer:
         for t, body, raw in self.buf.feed(data):
             if t != HS_CLIENT_HELLO or self._sent_flight:
                 raise TlsError(f"unexpected handshake message {t}")
+            psk = self._select_psk(body, raw)
             self.transcript += raw
-            out += self._server_flight(body)
+            out += self._server_flight(body, psk)
         return out
 
-    def _server_flight(self, ch: bytes) -> List[Tuple[str, bytes]]:
+    def _select_psk(self, ch: bytes, raw: bytes):
+        """Parse pre_shared_key (if offered), resolve + verify the
+        binder. Returns the accepted (index, identity) or None (fall
+        back to certificate auth). A WRONG binder is fatal — it proves
+        the client holds a different key for a known identity."""
+        if self.psk_lookup is None:
+            return None
+        off = 2 + 32
+        off += 1 + ch[off]
+        (cs_len,) = struct.unpack_from(">H", ch, off)
+        off += 2 + cs_len
+        off += 1 + ch[off]
+        exts_blob = ch[off:]
+        exts = _parse_exts(exts_blob)
+        psk_ext = exts.get(EXT_PRE_SHARED_KEY)
+        if psk_ext is None:
+            return None
+        modes = exts.get(EXT_PSK_MODES, b"")
+        if PSK_DHE_KE not in modes[1 : 1 + (modes[0] if modes else 0)]:
+            raise TlsError("psk offered without psk_dhe_ke mode")
+        (id_total,) = struct.unpack_from(">H", psk_ext, 0)
+        p = 2
+        identities = []
+        while p < 2 + id_total:
+            (iln,) = struct.unpack_from(">H", psk_ext, p)
+            identities.append(bytes(psk_ext[p + 2 : p + 2 + iln]))
+            p += 2 + iln + 4  # + obfuscated_ticket_age
+        (b_total,) = struct.unpack_from(">H", psk_ext, p)
+        binders = []
+        q = p + 2
+        while q < p + 2 + b_total:
+            bln = psk_ext[q]
+            binders.append(bytes(psk_ext[q + 1 : q + 1 + bln]))
+            q += 1 + bln
+        # binder transcript: the CH (incl. handshake header) truncated
+        # before the binders list (§4.2.11.2); pre_shared_key MUST be
+        # the last extension, so the binders are the message tail
+        trunc = raw[: len(raw) - (2 + b_total)]
+        for i, ident in enumerate(identities):
+            key = self.psk_lookup(ident)
+            if key is None:
+                continue
+            sched = KeySchedule()
+            sched.set_psk(key)
+            want = finished_verify(sched.binder_key(), self.transcript + trunc)
+            if i >= len(binders) or binders[i] != want:
+                raise TlsError("psk binder verification failed")
+            self.schedule = sched
+            self.psk_identity = ident
+            return (i, ident)
+        return None
+
+    def _server_flight(self, ch: bytes, psk=None) -> List[Tuple[str, bytes]]:
         off = 2 + 32  # legacy_version + random
         sid_len = ch[off]
         session_id = ch[off + 1 : off + 1 + sid_len]
@@ -244,13 +310,16 @@ class TlsServer:
         my_pub = self.priv.public_key().public_bytes(
             Encoding.Raw, PublicFormat.Raw
         )
+        sh_exts = [
+            (EXT_SUPPORTED_VERSIONS, _u16(TLS13)),
+            (EXT_KEY_SHARE, _u16(GROUP_X25519) + _vec(my_pub, 2)),
+        ]
+        if psk is not None:
+            sh_exts.append((EXT_PRE_SHARED_KEY, _u16(psk[0])))
         sh_body = (
             _u16(0x0303) + os.urandom(32) + _vec(session_id, 1)
             + _u16(TLS_AES_128_GCM_SHA256) + b"\x00"
-            + _exts([
-                (EXT_SUPPORTED_VERSIONS, _u16(TLS13)),
-                (EXT_KEY_SHARE, _u16(GROUP_X25519) + _vec(my_pub, 2)),
-            ])
+            + _exts(sh_exts)
         )
         sh = _hs_msg(HS_SERVER_HELLO, sh_body)
         self.transcript += sh
@@ -263,16 +332,23 @@ class TlsServer:
             ee_pairs.insert(0, (EXT_ALPN, _vec(_vec(a, 1), 2)))
         ee = _hs_msg(HS_ENCRYPTED_EXTENSIONS, _exts(ee_pairs))
         self.transcript += ee
-        cert = _hs_msg(
-            HS_CERTIFICATE,
-            b"\x00" + _vec(_vec(self.cert_der, 3) + _u16(0), 3),
-        )
-        self.transcript += cert
-        sig = self.cert_key.sign(
-            cert_verify_content(self.transcript), ec.ECDSA(SHA256())
-        )
-        cv = _hs_msg(HS_CERTIFICATE_VERIFY, _u16(SIG_ECDSA_P256) + _vec(sig, 2))
-        self.transcript += cv
+        if psk is None:
+            cert = _hs_msg(
+                HS_CERTIFICATE,
+                b"\x00" + _vec(_vec(self.cert_der, 3) + _u16(0), 3),
+            )
+            self.transcript += cert
+            sig = self.cert_key.sign(
+                cert_verify_content(self.transcript), ec.ECDSA(SHA256())
+            )
+            cv = _hs_msg(
+                HS_CERTIFICATE_VERIFY, _u16(SIG_ECDSA_P256) + _vec(sig, 2)
+            )
+            self.transcript += cv
+            mid = cert + cv
+        else:
+            # PSK authenticates the peer: no Certificate/Verify (§2.2)
+            mid = b""
         fin = _hs_msg(
             HS_FINISHED, finished_verify(s_hs, self.transcript)
         )
@@ -284,7 +360,7 @@ class TlsServer:
             self.schedule.app_traffic(self.transcript)
         )
         self._sent_flight = True
-        return [("initial", sh), ("handshake", ee + cert + cv + fin)]
+        return [("initial", sh), ("handshake", ee + mid + fin)]
 
     # --- client finished ------------------------------------------------
 
@@ -304,11 +380,21 @@ class TlsClient:
     """Client side (the in-repo MQTT-over-QUIC client + tests)."""
 
     def __init__(self, transport_params: bytes, alpn: str = "mqtt",
-                 server_name: str = "emqx-tpu"):
+                 server_name: str = "emqx-tpu",
+                 psk_identity: Optional[bytes] = None,
+                 psk: Optional[bytes] = None):
         self.tp = transport_params
         self.alpn = alpn
         self.server_name = server_name
+        self.psk_identity = (
+            psk_identity.encode() if isinstance(psk_identity, str)
+            else psk_identity
+        )
+        self.psk = psk
+        self._psk_active = False
         self.schedule = KeySchedule()
+        if psk is not None:
+            self.schedule.set_psk(psk)
         self.transcript = b""
         self.buf = _MsgBuf()
         self.priv = X25519PrivateKey.generate()
@@ -326,20 +412,37 @@ class TlsClient:
         )
         sni = _vec(_vec(b"\x00" + _vec(self.server_name.encode(), 2), 2)[2:], 2)
         a = self.alpn.encode()
-        body = (
+        pairs = [
+            (EXT_SERVER_NAME, sni),
+            (EXT_SUPPORTED_GROUPS, _vec(_u16(GROUP_X25519), 2)),
+            (EXT_SIG_ALGS, _vec(_u16(SIG_ECDSA_P256), 2)),
+            (EXT_SUPPORTED_VERSIONS, b"\x02" + _u16(TLS13)),
+            (EXT_ALPN, _vec(_vec(a, 1), 2)),
+            (EXT_KEY_SHARE, _vec(_u16(GROUP_X25519) + _vec(my_pub, 2), 2)),
+            (EXT_QUIC_TP, self.tp),
+        ]
+        prefix = (
             _u16(0x0303) + os.urandom(32) + _vec(b"", 1)
             + _vec(_u16(TLS_AES_128_GCM_SHA256), 2) + _vec(b"\x00", 1)
-            + _exts([
-                (EXT_SERVER_NAME, sni),
-                (EXT_SUPPORTED_GROUPS, _vec(_u16(GROUP_X25519), 2)),
-                (EXT_SIG_ALGS, _vec(_u16(SIG_ECDSA_P256), 2)),
-                (EXT_SUPPORTED_VERSIONS, b"\x02" + _u16(TLS13)),
-                (EXT_ALPN, _vec(_vec(a, 1), 2)),
-                (EXT_KEY_SHARE, _vec(_u16(GROUP_X25519) + _vec(my_pub, 2), 2)),
-                (EXT_QUIC_TP, self.tp),
-            ])
         )
+        if self.psk is None:
+            body = prefix + _exts(pairs)
+            ch = _hs_msg(HS_CLIENT_HELLO, body)
+            self.transcript += ch
+            return ch
+        # PSK offer: psk_key_exchange_modes + pre_shared_key LAST
+        # (RFC 8446 §4.2.11); the binder HMACs the truncated hello
+        # (incl. the 4-byte handshake header) with the ext-binder key
+        pairs.append((EXT_PSK_MODES, bytes([1, PSK_DHE_KE])))
+        identity = _vec(self.psk_identity or b"", 2) + b"\x00" * 4
+        binders = _vec(_vec(b"\x00" * 32, 1), 2)  # placeholder
+        psk_ext = _vec(identity, 2) + binders
+        pairs.append((EXT_PRE_SHARED_KEY, psk_ext))
+        body = prefix + _exts(pairs)
         ch = _hs_msg(HS_CLIENT_HELLO, body)
+        trunc = ch[: len(ch) - 35]  # 2(list len) + 1 + 32 binder bytes
+        binder = finished_verify(self.schedule.binder_key(), trunc)
+        ch = trunc + _vec(_vec(binder, 1), 2)
         self.transcript += ch
         return ch
 
@@ -365,6 +468,14 @@ class TlsClient:
         if grp != GROUP_X25519:
             raise TlsError("server chose unsupported group")
         server_pub = ks[4 : 4 + ln]
+        if EXT_PRE_SHARED_KEY in exts:
+            if self.psk is None:
+                raise TlsError("server selected a psk we never offered")
+            self._psk_active = True
+        elif self.psk is not None:
+            # server declined the offer (unknown identity): fall back
+            # to certificate auth with the zero-PSK early secret
+            self.schedule = KeySchedule()
         self.transcript += raw
         ecdhe = self.priv.exchange(
             X25519PublicKey.from_public_bytes(server_pub)
@@ -385,6 +496,8 @@ class TlsClient:
                     self.peer_transport_params = exts[EXT_QUIC_TP]
                 self.transcript += raw
             elif t == HS_CERTIFICATE:
+                if self._psk_active:
+                    raise TlsError("certificate in a PSK handshake")
                 # self-signed dev certs: presence checked, chain trust
                 # is the deployment's concern (reference: verify none
                 # by default on quic listeners)

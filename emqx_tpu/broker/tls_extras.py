@@ -1,0 +1,248 @@
+"""TLS hardening surfaces: PSK identity store, CRL cache, OCSP cache.
+
+References:
+  * apps/emqx_psk/src/emqx_psk.erl — identity -> shared-secret store,
+    bootstrapped from an init file of "identity:secret" lines and
+    served to listeners' PSK lookups. Here it feeds the QUIC TLS
+    stack's psk_dhe_ke handshake (broker/quic_tls.py; CPython 3.12's
+    ssl module has no PSK callbacks for the TCP listener).
+  * apps/emqx/src/emqx_crl_cache.erl — per-URL CRL fetch + refresh
+    cache; revoked client certs must fail the mTLS handshake. Applied
+    to TCP listeners through ssl.SSLContext VERIFY_CRL_CHECK_LEAF.
+  * apps/emqx/src/emqx_ocsp_cache.erl — OCSP responder fetch + cache
+    of the listener certificate's status (stapling store).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import urllib.request
+from typing import Callable, Dict, List, Optional
+
+log = logging.getLogger("emqx_tpu.tls_extras")
+
+
+class PskStore:
+    """identity -> key table with file bootstrap (emqx_psk.erl
+    init_file: one "identity:secret" per line, '#' comments)."""
+
+    def __init__(self, init_file: Optional[str] = None, enable: bool = True):
+        self.enable = enable
+        self._table: Dict[bytes, bytes] = {}
+        self._lock = threading.Lock()
+        if init_file:
+            self.import_file(init_file)
+
+    @staticmethod
+    def _b(v) -> bytes:
+        return v.encode() if isinstance(v, str) else bytes(v)
+
+    def import_file(self, path: str) -> int:
+        n = 0
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#") or ":" not in line:
+                    continue
+                ident, _, secret = line.partition(":")
+                self.insert(ident, secret)
+                n += 1
+        return n
+
+    def insert(self, identity, key) -> None:
+        with self._lock:
+            self._table[self._b(identity)] = self._b(key)
+
+    def delete(self, identity) -> bool:
+        with self._lock:
+            return self._table.pop(self._b(identity), None) is not None
+
+    def lookup(self, identity) -> Optional[bytes]:
+        if not self.enable:
+            return None
+        with self._lock:
+            return self._table.get(self._b(identity))
+
+    def all(self) -> List[str]:
+        with self._lock:
+            return sorted(i.decode("utf-8", "replace") for i in self._table)
+
+    def __len__(self) -> int:
+        return len(self._table)
+
+
+class CrlCache:
+    """Fetch-and-refresh cache of certificate revocation lists.
+
+    `pem()` returns the concatenated PEM CRLs for loading into an
+    ssl.SSLContext (with VERIFY_CRL_CHECK_LEAF); `revoked_serials()`
+    feeds hand-rolled verifiers. Refresh is lazy: any read past
+    refresh_interval re-fetches (the reference refreshes on a timer,
+    emqx_crl_cache.erl:66 — lazy-on-read gives the same staleness
+    bound without a background thread)."""
+
+    def __init__(self, urls: List[str], refresh_interval: float = 900.0,
+                 http_timeout: float = 10.0,
+                 fetcher: Optional[Callable[[str], bytes]] = None):
+        self.urls = list(urls)
+        self.refresh_interval = refresh_interval
+        self.http_timeout = http_timeout
+        self._fetch = fetcher or self._http_fetch
+        self._crls: Dict[str, object] = {}  # url -> x509.CRL
+        self._fetched_at: Dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    def _http_fetch(self, url: str) -> bytes:
+        with urllib.request.urlopen(url, timeout=self.http_timeout) as r:
+            return r.read()
+
+    def _load(self, der_or_pem: bytes):
+        from cryptography import x509
+
+        if der_or_pem.lstrip().startswith(b"-----BEGIN"):
+            return x509.load_pem_x509_crl(der_or_pem)
+        return x509.load_der_x509_crl(der_or_pem)
+
+    def refresh(self, force: bool = False) -> None:
+        now = time.time()
+        with self._lock:
+            due = [
+                u for u in self.urls
+                if force or now - self._fetched_at.get(u, 0) >= (
+                    self.refresh_interval
+                )
+            ]
+            # claim the fetch windows up front so concurrent readers
+            # don't pile onto the same URLs
+            for u in due:
+                self._fetched_at[u] = now
+        # network I/O OUTSIDE the lock: a slow responder must not
+        # stall every reader (or the event loop) for 10s per URL
+        fetched = {}
+        for url in due:
+            try:
+                fetched[url] = self._load(self._fetch(url))
+            except Exception as e:
+                # keep serving the stale CRL rather than dropping
+                # revocation data (fail-open on fetch is the
+                # reference's evict/keep policy knob)
+                log.warning("CRL fetch failed for %s: %s", url, e)
+        if fetched:
+            with self._lock:
+                self._crls.update(fetched)
+
+    def pem(self) -> bytes:
+        from cryptography.hazmat.primitives.serialization import Encoding
+
+        self.refresh()
+        with self._lock:
+            return b"".join(
+                crl.public_bytes(Encoding.PEM) for crl in self._crls.values()
+            )
+
+    def revoked_serials(self) -> set:
+        self.refresh()
+        out = set()
+        with self._lock:
+            for crl in self._crls.values():
+                for rev in crl:
+                    out.add(rev.serial_number)
+        return out
+
+    def is_revoked(self, cert) -> bool:
+        return cert.serial_number in self.revoked_serials()
+
+    def apply(self, ssl_context) -> None:
+        """Arm an ssl.SSLContext for revocation checking of client
+        certificates (mTLS listeners). CPython's cadata= path accepts
+        only certificates, so the CRL PEM goes through a temp file."""
+        import os
+        import ssl
+        import tempfile
+
+        data = self.pem()
+        if not data:
+            return
+        fd, path = tempfile.mkstemp(suffix=".crl.pem")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(data)
+            ssl_context.load_verify_locations(cafile=path)
+            ssl_context.verify_flags |= ssl.VERIFY_CRL_CHECK_LEAF
+        finally:
+            os.unlink(path)
+
+
+class OcspCache:
+    """OCSP response cache for the listener certificate (stapling
+    store). Builds the OCSPRequest with the cryptography lib, POSTs it
+    to the responder, caches the DER response until its nextUpdate
+    (minus a slack) or max_age."""
+
+    def __init__(self, responder_url: str, cert, issuer,
+                 refresh_interval: float = 3600.0,
+                 http_timeout: float = 10.0,
+                 fetcher: Optional[Callable[[str, bytes], bytes]] = None):
+        self.responder_url = responder_url
+        self.cert, self.issuer = cert, issuer
+        self.refresh_interval = refresh_interval
+        self.http_timeout = http_timeout
+        self._fetch = fetcher or self._http_post
+        self._der: Optional[bytes] = None
+        self._fetched_at = 0.0
+        self._lock = threading.Lock()
+
+    def _http_post(self, url: str, body: bytes) -> bytes:
+        req = urllib.request.Request(
+            url, data=body,
+            headers={"content-type": "application/ocsp-request"},
+        )
+        with urllib.request.urlopen(req, timeout=self.http_timeout) as r:
+            return r.read()
+
+    def build_request(self) -> bytes:
+        from cryptography.hazmat.primitives.hashes import SHA256
+        from cryptography.x509 import ocsp
+
+        b = ocsp.OCSPRequestBuilder().add_certificate(
+            self.cert, self.issuer, SHA256()
+        )
+        from cryptography.hazmat.primitives.serialization import Encoding
+
+        return b.build().public_bytes(Encoding.DER)
+
+    def response_der(self, force: bool = False) -> Optional[bytes]:
+        """The cached DER OCSPResponse (fetches when stale). None when
+        the responder is unreachable and nothing is cached."""
+        with self._lock:
+            fresh = (
+                self._der is not None
+                and time.time() - self._fetched_at < self.refresh_interval
+            )
+            if fresh and not force:
+                return self._der
+            try:
+                der = self._fetch(self.responder_url, self.build_request())
+                # sanity: parses as an OCSP response
+                from cryptography.x509 import ocsp
+
+                ocsp.load_der_ocsp_response(der)
+                self._der = der
+                self._fetched_at = time.time()
+            except Exception as e:
+                log.warning("OCSP fetch failed: %s", e)
+            return self._der
+
+    def status(self):
+        """Decoded certificate status of the cached response."""
+        from cryptography.x509 import ocsp
+
+        der = self.response_der()
+        if der is None:
+            return None
+        resp = ocsp.load_der_ocsp_response(der)
+        if resp.response_status != ocsp.OCSPResponseStatus.SUCCESSFUL:
+            return resp.response_status.name
+        return resp.certificate_status.name

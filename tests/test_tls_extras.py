@@ -1,0 +1,268 @@
+"""TLS hardening surfaces: PSK identity store wired into the QUIC
+listener (full MQTT connect over psk_dhe_ke), CRL cache rejecting a
+revoked client cert in a REAL ssl mTLS handshake, OCSP cache against
+an in-process responder."""
+
+import asyncio
+import datetime
+import ssl
+
+import pytest
+
+from emqx_tpu.broker.tls_extras import CrlCache, OcspCache, PskStore
+
+
+# --- PSK store + QUIC listener -------------------------------------------
+
+
+def test_psk_store_file_and_crud(tmp_path):
+    p = tmp_path / "init.psk"
+    p.write_text(
+        "# comment line\n"
+        "dev-1:secret one\n"
+        "dev-2:0xDEADBEEF\n"
+        "\n"
+        "badline\n"
+    )
+    store = PskStore(init_file=str(p))
+    assert len(store) == 2
+    assert store.lookup("dev-1") == b"secret one"
+    assert store.lookup(b"dev-2") == b"0xDEADBEEF"
+    assert store.lookup("ghost") is None
+    store.insert("dev-3", b"k3")
+    assert store.all() == ["dev-1", "dev-2", "dev-3"]
+    assert store.delete("dev-1") and not store.delete("dev-1")
+    store.enable = False
+    assert store.lookup("dev-2") is None  # disabled store serves nobody
+
+
+async def test_quic_listener_psk_client_accepted_and_rejected():
+    """End to end over a real UDP socket: a PSK client completes the
+    MQTT connect; a wrong-key client is refused at the handshake."""
+    from emqx_tpu.broker import frame
+    from emqx_tpu.broker.packet import Connack, Connect
+    from emqx_tpu.broker.pubsub import Broker
+    from emqx_tpu.broker.quic import QuicClientEndpoint, QuicServer
+    from emqx_tpu.broker.server import Server
+
+    store = PskStore()
+    store.insert("sensor-9", "the shared key")
+    broker = Broker()
+    mqtt_seat = Server(broker, host="127.0.0.1", port=0, name="quic:psk")
+    qs = QuicServer(mqtt_seat, host="127.0.0.1", port=0, psk_store=store)
+    await qs.start()
+    try:
+        ep = await QuicClientEndpoint(
+            psk_identity=b"sensor-9", psk=b"the shared key"
+        ).connect(*qs.listen_addr)
+        assert ep.conn.tls.handshake_complete
+        assert ep.conn.tls._psk_active  # PSK, not cert, authenticated
+        parser = frame.Parser(proto_ver=4)
+        ep.send(frame.serialize(Connect(client_id="psk-dev", proto_ver=4)))
+        pkts = []
+        while not pkts:
+            pkts.extend(parser.feed(await ep.recv()))
+        assert isinstance(pkts[0], Connack) and pkts[0].code == 0
+        ep.close()
+
+        bad = QuicClientEndpoint(psk_identity=b"sensor-9", psk=b"WRONG")
+        with pytest.raises((TimeoutError, ConnectionError)):
+            await bad.connect(*qs.listen_addr, timeout=1.0)
+    finally:
+        await qs.stop()
+
+
+# --- CRL cache ------------------------------------------------------------
+
+
+def _make_ca_and_client():
+    from cryptography import x509
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.hazmat.primitives.hashes import SHA256
+    from cryptography.x509.oid import NameOID
+
+    now = datetime.datetime.now(datetime.timezone.utc)
+
+    def name(cn):
+        return x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, cn)])
+
+    ca_key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    ca = (
+        x509.CertificateBuilder()
+        .subject_name(name("test-ca")).issuer_name(name("test-ca"))
+        .public_key(ca_key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(days=1))
+        .not_valid_after(now + datetime.timedelta(days=365))
+        .add_extension(x509.BasicConstraints(ca=True, path_length=None),
+                       critical=True)
+        .sign(ca_key, SHA256())
+    )
+
+    def issue(cn):
+        key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+        cert = (
+            x509.CertificateBuilder()
+            .subject_name(name(cn)).issuer_name(ca.subject)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(days=1))
+            .not_valid_after(now + datetime.timedelta(days=30))
+            .sign(ca_key, SHA256())
+        )
+        return key, cert
+
+    return ca_key, ca, issue
+
+
+def _crl_for(ca_key, ca, revoked_serials):
+    from cryptography import x509
+    from cryptography.hazmat.primitives.hashes import SHA256
+
+    now = datetime.datetime.now(datetime.timezone.utc)
+    b = (
+        x509.CertificateRevocationListBuilder()
+        .issuer_name(ca.subject)
+        .last_update(now - datetime.timedelta(hours=1))
+        .next_update(now + datetime.timedelta(days=1))
+    )
+    for serial in revoked_serials:
+        b = b.add_revoked_certificate(
+            x509.RevokedCertificateBuilder()
+            .serial_number(serial)
+            .revocation_date(now - datetime.timedelta(minutes=5))
+            .build()
+        )
+    from cryptography.hazmat.primitives.serialization import Encoding
+
+    return b.sign(ca_key, SHA256()).public_bytes(Encoding.DER)
+
+
+async def test_crl_cache_rejects_revoked_client_cert(tmp_path):
+    """mTLS over real sockets: the CRL-armed server context refuses the
+    revoked client certificate and accepts the good one."""
+    from cryptography.hazmat.primitives.serialization import (
+        Encoding, NoEncryption, PrivateFormat,
+    )
+
+    ca_key, ca, issue = _make_ca_and_client()
+    good_key, good_cert = issue("client-good")
+    bad_key, bad_cert = issue("client-revoked")
+    srv_key, srv_cert = issue("server")
+    crl_der = _crl_for(ca_key, ca, [bad_cert.serial_number])
+
+    fetches = []
+
+    def fetcher(url):
+        fetches.append(url)
+        return crl_der
+
+    cache = CrlCache(["http://crl.test/ca.crl"], fetcher=fetcher)
+    assert cache.revoked_serials() == {bad_cert.serial_number}
+    assert cache.is_revoked(bad_cert) and not cache.is_revoked(good_cert)
+    assert len(fetches) == 1  # second read within the interval: cached
+    cache.revoked_serials()
+    assert len(fetches) == 1
+
+    def pem_files(prefix, key, *certs):
+        kp = tmp_path / f"{prefix}.key"
+        cp = tmp_path / f"{prefix}.crt"
+        kp.write_bytes(key.private_bytes(
+            Encoding.PEM, PrivateFormat.PKCS8, NoEncryption()
+        ))
+        cp.write_bytes(b"".join(c.public_bytes(Encoding.PEM) for c in certs))
+        return str(kp), str(cp)
+
+    ca_pem = tmp_path / "ca.crt"
+    ca_pem.write_bytes(ca.public_bytes(Encoding.PEM))
+    skey, scrt = pem_files("srv", srv_key, srv_cert)
+    gkey, gcrt = pem_files("good", good_key, good_cert)
+    bkey, bcrt = pem_files("bad", bad_key, bad_cert)
+
+    sctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    sctx.load_cert_chain(scrt, skey)
+    sctx.load_verify_locations(str(ca_pem))
+    sctx.verify_mode = ssl.CERT_REQUIRED
+    cache.apply(sctx)  # arms VERIFY_CRL_CHECK_LEAF with the fetched CRL
+
+    errors = []
+
+    async def handle(reader, writer):
+        try:
+            writer.write(b"ok")
+            await writer.drain()
+        except Exception as e:
+            errors.append(e)
+        finally:
+            writer.close()
+
+    server = await asyncio.start_server(handle, "127.0.0.1", 0, ssl=sctx)
+    port = server.sockets[0].getsockname()[1]
+
+    async def client(certfile, keyfile):
+        cctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        cctx.load_verify_locations(str(ca_pem))
+        cctx.check_hostname = False
+        cctx.load_cert_chain(certfile, keyfile)
+        r, w = await asyncio.wait_for(
+            asyncio.open_connection("127.0.0.1", port, ssl=cctx), 5
+        )
+        data = await asyncio.wait_for(r.read(2), 5)
+        w.close()
+        return data
+
+    assert await client(gcrt, gkey) == b"ok"
+    # TLS 1.3: the client cert rides the client's second flight, so the
+    # server's revocation rejection lands AFTER the client believes the
+    # handshake finished — asyncio surfaces it as an alert/exception or
+    # an immediate EOF, never as served data
+    try:
+        data = await client(bcrt, bkey)
+        assert data == b"", "revoked client was served data"
+    except (ssl.SSLError, ConnectionError, OSError):
+        pass
+    server.close()
+    await server.wait_closed()
+
+
+# --- OCSP cache -----------------------------------------------------------
+
+
+def test_ocsp_cache_fetch_and_status():
+    from cryptography.hazmat.primitives.hashes import SHA256
+    from cryptography.x509 import ocsp
+
+    ca_key, ca, issue = _make_ca_and_client()
+    _key, cert = issue("listener")
+    now = datetime.datetime.now(datetime.timezone.utc)
+    posts = []
+
+    def responder(url, body):
+        req = ocsp.load_der_ocsp_request(body)
+        posts.append((url, req.serial_number))
+        builder = ocsp.OCSPResponseBuilder().add_response(
+            cert=cert, issuer=ca, algorithm=SHA256(),
+            cert_status=ocsp.OCSPCertStatus.GOOD,
+            this_update=now, next_update=now + datetime.timedelta(hours=4),
+            revocation_time=None, revocation_reason=None,
+        ).responder_id(ocsp.OCSPResponderEncoding.NAME, ca)
+        from cryptography.hazmat.primitives.serialization import Encoding
+
+        return builder.sign(ca_key, SHA256()).public_bytes(Encoding.DER)
+
+    cache = OcspCache(
+        "http://ocsp.test/", cert, ca, fetcher=responder,
+    )
+    der = cache.response_der()
+    assert der is not None
+    assert posts[0][1] == cert.serial_number
+    assert cache.status() == "GOOD"
+    cache.response_der()
+    assert len(posts) == 1  # cached within refresh_interval
+    cache.response_der(force=True)
+    assert len(posts) == 2
+
+    # responder outage: the stale response keeps serving
+    cache._fetch = lambda u, b: (_ for _ in ()).throw(OSError("down"))
+    cache._fetched_at = 0.0
+    assert cache.response_der() == der
