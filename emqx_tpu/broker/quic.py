@@ -60,6 +60,9 @@ PTO_MAX = 8.0
 # MAX_DATA / MAX_STREAM_DATA replenish as the app consumes (§4)
 FC_CONN_WINDOW = 1 << 20
 FC_STREAM_WINDOW = 1 << 19
+# per-packet STREAM chunk bound: a frame larger than one UDP datagram
+# can never be sent (EMSGSIZE) and would retransmit forever
+MAX_STREAM_CHUNK = 1200
 FT_CONN_CLOSE = 0x1C
 FT_CONN_CLOSE_APP = 0x1D
 FT_HANDSHAKE_DONE = 0x1E
@@ -306,6 +309,11 @@ class QuicConnection:
             # retransmit lost stream chunks before new data
             if self._stream_rtx:
                 s_off, chunk = self._stream_rtx.pop(0)
+                if len(chunk) > MAX_STREAM_CHUNK:  # legacy oversize
+                    self._stream_rtx.insert(
+                        0, (s_off + MAX_STREAM_CHUNK, chunk[MAX_STREAM_CHUNK:])
+                    )
+                    chunk = chunk[:MAX_STREAM_CHUNK]
                 out += (
                     bytes([FT_STREAM_BASE | 0x04 | 0x02])
                     + enc_varint(0) + enc_varint(s_off)
@@ -321,7 +329,7 @@ class QuicConnection:
                     min(self.tx_max_data, self.tx_max_stream)
                     - self.stream_sent,
                 )
-                chunk = self.stream_out[:allowance]
+                chunk = self.stream_out[:min(allowance, MAX_STREAM_CHUNK)]
                 if chunk:
                     out += (
                         bytes([FT_STREAM_BASE | 0x04 | 0x02])  # off+len
@@ -468,12 +476,13 @@ class QuicConnection:
                 # set would be a one-frame memory-exhaustion DoS
                 ranges = [(largest - first, largest)]
                 lo = largest - first
-                for _ in range(min(rc, 256)):
+                for i in range(rc):
                     gap, off = dec_varint(payload, off)
                     rng, off = dec_varint(payload, off)
-                    hi = lo - gap - 2
-                    ranges.append((hi - rng, hi))
-                    lo = hi - rng
+                    if i < 256:  # DoS cap on TRACKED ranges; the rest
+                        hi = lo - gap - 2  # still parse (frame sync)
+                        ranges.append((hi - rng, hi))
+                        lo = hi - rng
                 self._on_ack(level, ranges)
                 continue
             if ft == FT_CRYPTO:

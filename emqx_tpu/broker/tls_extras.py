@@ -223,16 +223,21 @@ class OcspCache:
             )
             if fresh and not force:
                 return self._der
-            try:
-                der = self._fetch(self.responder_url, self.build_request())
-                # sanity: parses as an OCSP response
-                from cryptography.x509 import ocsp
+            # claim the window so concurrent readers don't stack
+            # fetches; network I/O happens OUTSIDE the lock
+            self._fetched_at = time.time()
+        try:
+            der = self._fetch(self.responder_url, self.build_request())
+            # sanity: parses as an OCSP response
+            from cryptography.x509 import ocsp
 
-                ocsp.load_der_ocsp_response(der)
+            ocsp.load_der_ocsp_response(der)
+        except Exception as e:
+            log.warning("OCSP fetch failed: %s", e)
+            der = None
+        with self._lock:
+            if der is not None:
                 self._der = der
-                self._fetched_at = time.time()
-            except Exception as e:
-                log.warning("OCSP fetch failed: %s", e)
             return self._der
 
     def status(self):
