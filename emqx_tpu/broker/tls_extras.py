@@ -192,6 +192,7 @@ class OcspCache:
         self._fetch = fetcher or self._http_post
         self._der: Optional[bytes] = None
         self._fetched_at = 0.0
+        self._inflight = False
         self._lock = threading.Lock()
 
     def _http_post(self, url: str, body: bytes) -> bytes:
@@ -223,9 +224,14 @@ class OcspCache:
             )
             if fresh and not force:
                 return self._der
-            # claim the window so concurrent readers don't stack
-            # fetches; network I/O happens OUTSIDE the lock
-            self._fetched_at = time.time()
+            if self._inflight:
+                # one fetcher at a time — cold-start stampedes would
+                # otherwise all POST the responder concurrently
+                return self._der
+            self._inflight = True
+            claimed_at = time.time()
+            # claim the window; network I/O happens OUTSIDE the lock
+            self._fetched_at = claimed_at
         try:
             der = self._fetch(self.responder_url, self.build_request())
             # sanity: parses as an OCSP response
@@ -236,8 +242,15 @@ class OcspCache:
             log.warning("OCSP fetch failed: %s", e)
             der = None
         with self._lock:
+            self._inflight = False
             if der is not None:
                 self._der = der
+            elif self._fetched_at == claimed_at:
+                # FAILED refresh must not hold the claim for a whole
+                # interval: the next reader retries immediately (an
+                # aging response could outlive its nextUpdate and a
+                # revoked cert would keep stapling GOOD)
+                self._fetched_at = 0.0
             return self._der
 
     def status(self):

@@ -265,7 +265,8 @@ class ReplicatedDs:
         for _peer, addr in self._peers():
             self._spawn(
                 self.node.rpc.cast(
-                    addr, "ds", "commit", (shard, upto), key=f"ds{shard}"
+                    addr, "ds", "commit", (shard, upto, self.node_id),
+                    key=f"ds{shard}"
                 )
             )
 
@@ -300,16 +301,19 @@ class ReplicatedDs:
     def _handle_write(self, payload: list, hops: int = 0) -> None:
         """A forwarded write; payload items are wire messages. Shard is
         recomputed here — shard_of is deterministic on from_client.
-        `hops` bounds re-forwarding: with asymmetric membership views
-        two nodes can each think the other leads, so after one re-
-        forward the receiver appends as leader itself (the quorum ack
-        arbitrates which ordering wins)."""
+        `hops` bounds re-forwarding (two hops, then append): appending
+        as leader on the FIRST forward minted a second leader on the
+        same partition side whenever sender and receiver disagreed on
+        the leader — the receiver must first re-forward once to ITS
+        view's leader so each partition side converges on one ordering
+        node (found by the split-brain test); a bounce after two hops
+        still appends so writes can't loop forever."""
         msgs = [msg_from_wire(d) for d in payload]
         by_shard: Dict[int, list] = {}
         for m, d in zip(msgs, payload):
             by_shard.setdefault(self.db.storage.shard_of(m), []).append(d)
         for shard, batch in by_shard.items():
-            if hops >= 1 or self.leader_of(shard) == self.node_id:
+            if hops >= 2 or self.leader_of(shard) == self.node_id:
                 self._leader_append(shard, batch)
             else:
                 addr = self.node.membership.members.get(self.leader_of(shard))
@@ -347,7 +351,14 @@ class ReplicatedDs:
                 self._lead_synced.clear()
             applied = self._applied.get(shard, 0)
             if idx <= applied:
-                return ("ok",)  # already committed here
+                # only a TRUE duplicate of the committed entry may ack:
+                # a blind "ok" here let a leader that re-assigned an
+                # already-committed index count this replica toward
+                # quorum for DIFFERENT content (split-brain test)
+                for i, p in self._log.get(shard, ()):
+                    if i == idx:
+                        return ("ok",) if p == payload else ("conflict",)
+                return ("conflict",)  # evicted from the log: refuse
             accepted = self._accepted.get(shard, applied)
             cur = self._pending.get(shard, {}).get(idx)
             if cur is not None:
@@ -373,7 +384,15 @@ class ReplicatedDs:
                 return ("conflict",)
             return ("gap", accepted)
 
-    def _handle_commit(self, shard: int, upto: int) -> None:
+    def _handle_commit(self, shard: int, upto: int, leader=None) -> None:
+        """Apply pending entries up to `upto` — but ONLY entries
+        appended by the NOTIFYING leader. An index-blind commit let a
+        replica holding a rival same-term leader\'s pending entry
+        apply it on the other\'s notice and diverge (found by the
+        split-brain test). A mismatched pending entry lost its race:
+        drop it and its suffix so the next append surfaces a gap and
+        the true committed range streams over; its own leader got
+        \'conflict\' and resubmits the payload."""
         applied_any = False
         with self._mutex:
             pend = self._pending.get(shard, {})
@@ -383,16 +402,28 @@ class ReplicatedDs:
                 e = pend.get(nxt)
                 if e is None:
                     break
+                if leader is not None and e[2] != leader:
+                    for i in [i for i in pend if i >= nxt]:
+                        del pend[i]
+                    self._accepted[shard] = self._applied.get(shard, 0)
+                    break
                 self._apply_locked(shard, nxt, e[1])
                 applied_any = True
                 nxt += 1
         if applied_any:
             self.db._notify()
 
-    def _handle_tail(self, shard: int):
+    def _handle_tail(self, shard: int, term: int = 0):
         """(applied, [(idx, term, payload) pending in order]) — leader
-        catch-up source."""
+        catch-up source. `term` is the CALLING leader\'s term and
+        FENCES this replica first (raft\'s RequestVote term
+        propagation): after answering a tail at term T, any append
+        with an older term is rejected stale — without this, an
+        old-term leader could still collect our ack in the window
+        between the new leader\'s sync and its first append, and
+        commit a divergent entry (found by the split-brain test)."""
         with self._mutex:
+            self._see_term(term)  # RLock: safe inside the mutex
             pend = sorted(self._pending.get(shard, {}).items())
             return (
                 self._applied.get(shard, 0),
@@ -437,7 +468,10 @@ class ReplicatedDs:
                 return
             self._on_ack(shard, i, peer)
         try:
-            await self.node.rpc.cast(addr, "ds", "commit", (shard, upto), key=f"ds{shard}")
+            await self.node.rpc.cast(
+                addr, "ds", "commit", (shard, upto, self.node_id),
+                key=f"ds{shard}",
+            )
         except Exception:
             pass
 
@@ -453,7 +487,7 @@ class ReplicatedDs:
         tails = []
         for peer, addr in self._peers():
             try:
-                t = await self.node.rpc.call(addr, "ds", "tail", (shard,))
+                t = await self.node.rpc.call(addr, "ds", "tail", (shard, term))
             except Exception:
                 continue
             tails.append((peer, addr, t))

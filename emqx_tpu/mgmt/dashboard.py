@@ -147,10 +147,12 @@ async function get(path) {
   const r = await fetch(path, {headers: {authorization: 'Bearer ' + tok}});
   return r.ok ? r.json() : null;
 }
-async function act(method, path) {
-  await fetch(path, {method,
+async function act(method, path, body) {
+  const r = await fetch(path, {method,
     headers: {authorization: 'Bearer ' + tok,
-              'content-type': 'application/json'}});
+              'content-type': 'application/json'},
+    body: body === undefined ? undefined : JSON.stringify(body)});
+  if (!r.ok) { alert(method + ' ' + path + ' failed: ' + r.status); }
   tick();
 }
 function tile(name, value) {
@@ -266,13 +268,8 @@ document.getElementById('main').addEventListener('click', e => {
     toggleRule(d.rule, d.enable === '1');
   }
 });
-async function toggleRule(id, enable) {
-  await fetch('/api/v5/rules/' + encodeURIComponent(id), {
-    method: 'PUT',
-    headers: {authorization: 'Bearer ' + tok,
-              'content-type': 'application/json'},
-    body: JSON.stringify({enable})});
-  tick();
+function toggleRule(id, enable) {
+  act('PUT', '/api/v5/rules/' + encodeURIComponent(id), {enable});
 }
 </script>
 </body>

@@ -29,6 +29,8 @@ class Request:
     headers: Dict[str, str]
     body: bytes
     params: Dict[str, str] = field(default_factory=dict)
+    # decoded path segments (%2F inside one id stays INSIDE it)
+    path_segments: Optional[list] = None
     # set by auth middleware
     principal: Optional[str] = None
 
@@ -178,16 +180,25 @@ class HttpServer:
         body = await reader.readexactly(n) if n else b""
         parts = urlsplit(target)
         query = dict(parse_qsl(parts.query))
+        # decode PER SEGMENT, after splitting: unquoting the whole
+        # path first turns an encoded '/' inside an id (clientid
+        # "tenant%2Fdev1") into a path separator and the route misses
+        segs = [unquote(s) for s in parts.path.split("/")]
         return Request(
             method=method.upper(),
-            path=unquote(parts.path),
+            path="/".join(segs),
             query=query,
             headers=headers,
             body=body,
+            path_segments=[s for s in segs if s],
         )
 
     async def _handle(self, req: Request) -> Response:
-        path_segs = req.path.strip("/").split("/") if req.path.strip("/") else []
+        path_segs = (
+            req.path_segments
+            if req.path_segments is not None
+            else (req.path.strip("/").split("/") if req.path.strip("/") else [])
+        )
         matched_path = False
         for r in self._routes:
             params = r.match(path_segs)

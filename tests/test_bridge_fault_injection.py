@@ -1,0 +1,174 @@
+"""Network fault injection for bridges — the toxiproxy analog
+(apps/emqx/test/emqx_common_test_helpers.erl:1016-1041 runs bridge
+suites through down/timeout/latency toxics; VERDICT r3 weak #8).
+
+ChaosProxy sits between a connector and its mini-server and injects:
+  * latency  — per-direction delay on forwarded bytes;
+  * reset    — abort the live connection mid-stream (RST-ish close);
+  * down     — refuse new connections.
+
+The buffer-worker retry path must carry the bridge through every one.
+"""
+
+import asyncio
+
+import pytest
+
+from emqx_tpu.bridges.kafka import KafkaProducer
+from emqx_tpu.bridges.postgres import PostgresConnector
+from emqx_tpu.bridges.resource import RecoverableError, Resource, ResourceStatus
+from tests.test_kafka import MiniKafka
+from tests.test_postgres import MiniPg
+
+
+class ChaosProxy:
+    """TCP forwarder with scriptable faults."""
+
+    def __init__(self, upstream_host, upstream_port):
+        self.upstream = (upstream_host, upstream_port)
+        self.latency = 0.0
+        self.down = False
+        self.server = None
+        self.port = None
+        self._conns = []  # live (writer_a, writer_b) pairs
+
+    async def start(self):
+        self.server = await asyncio.start_server(self._conn, "127.0.0.1", 0)
+        self.port = self.server.sockets[0].getsockname()[1]
+
+    async def stop(self):
+        self.server.close()
+        self.reset_all()
+        await self.server.wait_closed()
+
+    def reset_all(self):
+        """Abort every live connection mid-stream."""
+        for wa, wb in self._conns:
+            for w in (wa, wb):
+                try:
+                    w.transport.abort()
+                except Exception:
+                    w.close()
+        self._conns.clear()
+
+    async def _conn(self, reader, writer):
+        if self.down:
+            writer.close()
+            return
+        try:
+            ur, uw = await asyncio.open_connection(*self.upstream)
+        except OSError:
+            writer.close()
+            return
+        self._conns.append((writer, uw))
+
+        async def pump(src, dst):
+            try:
+                while True:
+                    data = await src.read(65536)
+                    if not data:
+                        break
+                    if self.latency:
+                        await asyncio.sleep(self.latency)
+                    dst.write(data)
+                    await dst.drain()
+            except (ConnectionError, asyncio.CancelledError, OSError):
+                pass
+            finally:
+                try:
+                    dst.close()
+                except Exception:
+                    pass
+
+        await asyncio.gather(pump(reader, uw), pump(ur, writer))
+
+
+async def test_kafka_survives_midstream_reset_and_latency():
+    """A mid-stream connection abort between producer and broker lands
+    in the retry path, and the queued message still delivers after the
+    link heals; injected latency only slows things down."""
+    mk = MiniKafka(n_partitions=1)
+    host, port = await mk.start()
+    proxy = ChaosProxy(host, port)
+    await proxy.start()
+    # leader connections must ALSO ride the proxy: metadata advertises
+    # the proxy address, not the real broker
+    mk.advertise = ("127.0.0.1", proxy.port)
+    prod = KafkaProducer(f"127.0.0.1:{proxy.port}", "events", timeout=2.0)
+    res = Resource("kafka-chaos", prod, retry_interval=0.05)
+    await res.start()
+    assert res.status == ResourceStatus.CONNECTED
+    try:
+        # baseline through the proxy
+        await res.query_sync({"key": None, "value": b"calm"})
+        assert mk.produced[0][-1] == (None, b"calm")
+
+        # latency toxic: delivery still completes
+        proxy.latency = 0.15
+        await res.query_sync({"key": None, "value": b"slow"})
+        assert mk.produced[0][-1] == (None, b"slow")
+        proxy.latency = 0.0
+
+        # reset toxic: abort the live connection, then queue a message
+        proxy.reset_all()
+        res.query_async({"key": None, "value": b"after-reset"})
+        deadline = asyncio.get_running_loop().time() + 8
+        while not any(v == b"after-reset" for _k, v in mk.produced[0]):
+            await asyncio.sleep(0.05)
+            assert asyncio.get_running_loop().time() < deadline, (
+                "retry never recovered after mid-stream reset"
+            )
+
+        # down toxic: new connections refused -> recoverable failures
+        # queue; heal -> drain
+        proxy.down = True
+        proxy.reset_all()
+        res.query_async({"key": None, "value": b"while-down"})
+        await asyncio.sleep(0.3)
+        assert not any(v == b"while-down" for _k, v in mk.produced[0])
+        proxy.down = False
+        deadline = asyncio.get_running_loop().time() + 8
+        while not any(v == b"while-down" for _k, v in mk.produced[0]):
+            await asyncio.sleep(0.05)
+            assert asyncio.get_running_loop().time() < deadline, (
+                "retry never recovered after down window"
+            )
+    finally:
+        await res.stop()
+        await proxy.stop()
+        await mk.stop()
+
+
+async def test_postgres_survives_midstream_reset():
+    """The sync PG client path: a reset mid-query surfaces as a
+    RecoverableError (not a hang, not data corruption) and the next
+    query reconnects through the healed link."""
+    got = []
+
+    def handler(sql):
+        got.append(sql)
+        return [], []
+
+    srv = MiniPg(handler=handler)
+    await srv.start()
+    proxy = ChaosProxy("127.0.0.1", srv.port)
+    await proxy.start()
+    conn = PostgresConnector(
+        "127.0.0.1", proxy.port, user="app",
+        sql_template="INSERT INTO t VALUES (${payload})", timeout=2.0,
+    )
+    await conn.on_start()
+    try:
+        await conn.on_query({"payload": "one"})
+        assert got[-1] == "INSERT INTO t VALUES ('one')"
+
+        proxy.reset_all()  # kill the live backend connection
+        with pytest.raises(RecoverableError):
+            await conn.on_query({"payload": "dropped"})
+        # next attempt reconnects and succeeds
+        await conn.on_query({"payload": "recovered"})
+        assert got[-1] == "INSERT INTO t VALUES ('recovered')"
+    finally:
+        await conn.on_stop()
+        await proxy.stop()
+        await srv.stop()

@@ -256,3 +256,44 @@ def test_deep_skeleton_goes_residual():
     got = r.match_batch([t, "a/zz"])
     assert got[0] == {"n1"}
     assert got[1] == {"n2"}
+
+
+def test_amb_collision_falls_back_to_host_exactly():
+    """VERDICT r3 weak #9: the amb>0 escape hatch. Two distinct filters
+    are FORGED into a full 32+32-bit fingerprint collision (the
+    ~2^-32/pair event brute force can't reach) by rewriting one
+    bucket's hashes; the kernel must report amb>0 and the Router must
+    re-match on the host trie, staying oracle-exact."""
+    from emqx_tpu.models.router import Router
+
+    r = Router(max_levels=8)
+    r.add_route("col/+/x", "nodeA")
+    r.add_route("col/+/y", "nodeB")
+    r.add_route("other/t", "nodeC")
+    ix = r.index
+    bidA = ix._row_bucket[r._filter_row["col/+/x"]]
+    bidB = ix._row_bucket[r._filter_row["col/+/y"]]
+    # forge: bucket B collides with A on ALL hash bits, then re-place
+    ix._buckets[bidB].h1 = ix._buckets[bidA].h1
+    ix._buckets[bidB].fp = ix._buckets[bidA].fp
+    ix._rebuild(ix.n_buckets)
+
+    # spy on the host-fallback path
+    calls = {"n": 0}
+    orig = r._host_trie
+
+    def spy():
+        calls["n"] += 1
+        return orig()
+
+    r._host_trie = spy
+
+    topics = ["col/9/x", "col/9/y", "other/t", "col/9/z", "miss/x"]
+    got = [sorted(o) for o in r.match_filters_batch(topics)]
+    assert calls["n"] >= 1, "amb fallback never engaged"
+    assert got == [
+        ["col/+/x"], ["col/+/y"], ["other/t"], [], [],
+    ]
+    # dest resolution stays exact too
+    assert r.match_routes("col/9/x") == {"nodeA"}
+    assert r.match_routes("col/9/y") == {"nodeB"}

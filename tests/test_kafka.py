@@ -33,6 +33,10 @@ class MiniKafka:
         self.serve_gzip = False  # Fetch v4 responses compress with gzip
         self._server = None
         self.addr = None
+        # address ADVERTISED in metadata (defaults to the real one);
+        # fault-injection tests point it at a chaos proxy so the
+        # producer's leader connections also ride the proxy
+        self.advertise = None
 
     def log_of(self, pid):
         # fetchable log: reuse the produced list as the partition log
@@ -76,7 +80,8 @@ class MiniKafka:
     def _metadata(self, corr):
         out = struct.pack(">i", corr)
         out += struct.pack(">i", 1)  # brokers
-        out += struct.pack(">i", 1) + _str(self.addr[0]) + struct.pack(">i", self.addr[1])
+        adv = self.advertise or self.addr
+        out += struct.pack(">i", 1) + _str(adv[0]) + struct.pack(">i", adv[1])
         out += struct.pack(">i", 1)  # topics
         out += struct.pack(">h", ERR_NONE) + _str(self.topic)
         out += struct.pack(">i", self.n_partitions)

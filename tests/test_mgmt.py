@@ -415,3 +415,19 @@ def test_cli_commands():
     # custom command registration (plugin seam)
     ctl.register("hello", lambda args: f"hi {args[0]}", "hello <name>")
     assert ctl.run(["hello", "world"]) == "hi world"
+
+
+async def test_encoded_slash_stays_inside_path_segment():
+    """A clientid containing '/' is addressable as %2F — the server
+    must decode per segment AFTER splitting, or the route misses and
+    the dashboard kick silently 404s (code-review r4)."""
+    broker, mgmt, api = await make_api()
+    try:
+        s, _ = broker.open_session("tenant/dev1", True)
+        status, out = await api("GET", "/api/v5/clients/tenant%2Fdev1")
+        assert status == 200 and out["clientid"] == "tenant/dev1"
+        status, _out = await api("DELETE", "/api/v5/clients/tenant%2Fdev1")
+        assert status in (200, 204)
+        assert "tenant/dev1" not in broker.sessions
+    finally:
+        await mgmt.stop()
