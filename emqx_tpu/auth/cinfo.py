@@ -1,0 +1,186 @@
+"""Client-info authentication + the variform expression evaluator.
+
+The reference's emqx_auth_cinfo (apps/emqx_auth_cinfo/src/
+emqx_authn_cinfo.erl) authenticates on CLIENT METADATA alone: an
+ordered list of checks, each holding `is_match` variform expressions
+rendered against the credential and a result (allow | deny | ignore).
+First matching check wins; no check matching -> ignore (next
+authenticator in the chain).
+
+The expression language (emqx_variform) is function application over
+credential variables with string/number literals — `regex_match(
+clientid, '^dev-')`, `str_eq(username, clientid)` — evaluated here
+against the rule-funcs table (the same builtins the reference's
+variform bif module shares with the rule engine) plus the variform
+comparison bifs.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Dict, List, Optional
+
+from ..rules.funcs import FUNCS, _num, _str
+from .authn import AuthResult, Credentials, IGNORE, Provider
+
+# variform-only bifs (emqx_variform_bif.erl comparison section)
+_VF_FUNCS: Dict[str, Callable[..., Any]] = {
+    "str_eq": lambda a, b: _str(a) == _str(b),
+    "str_neq": lambda a, b: _str(a) != _str(b),
+    "num_eq": lambda a, b: _num(a) == _num(b),
+    "num_neq": lambda a, b: _num(a) != _num(b),
+    "num_gt": lambda a, b: _num(a) > _num(b),
+    "num_gte": lambda a, b: _num(a) >= _num(b),
+    "num_lt": lambda a, b: _num(a) < _num(b),
+    "num_lte": lambda a, b: _num(a) <= _num(b),
+    "is_empty_val": lambda a: a is None or a == "" or a == b"",
+    "not": lambda a: a in (False, "false"),
+}
+
+_TOKEN = re.compile(
+    r"\s*(?:(?P<num>-?\d+(?:\.\d+)?)|(?P<str>'[^']*'|\"[^\"]*\")"
+    r"|(?P<name>[A-Za-z_][\w.]*)|(?P<punct>[(),]))"
+)
+
+
+class VariformError(ValueError):
+    pass
+
+
+def compile_expr(src: str):
+    """Parse one variform expression into an AST:
+    ("call", name, [args]) | ("var", name) | ("lit", value)."""
+    tokens = []
+    pos = 0
+    while pos < len(src):
+        m = _TOKEN.match(src, pos)
+        if m is None:
+            if src[pos:].strip() == "":
+                break
+            raise VariformError(f"bad token at {src[pos:]!r}")
+        pos = m.end()
+        if m.group("num") is not None:
+            v = m.group("num")
+            tokens.append(("lit", float(v) if "." in v else int(v)))
+        elif m.group("str") is not None:
+            tokens.append(("lit", m.group("str")[1:-1]))
+        elif m.group("name") is not None:
+            tokens.append(("name", m.group("name")))
+        else:
+            tokens.append(("punct", m.group("punct")))
+
+    i = 0
+
+    def parse_one():
+        nonlocal i
+        if i >= len(tokens):
+            raise VariformError("unexpected end of expression")
+        kind, val = tokens[i]
+        i += 1
+        if kind == "lit":
+            return ("lit", val)
+        if kind == "punct":
+            raise VariformError(f"unexpected {val!r}")
+        # name: call or variable
+        if i < len(tokens) and tokens[i] == ("punct", "("):
+            i += 1
+            args = []
+
+            def peek():
+                if i >= len(tokens):
+                    raise VariformError("unterminated call")
+                return tokens[i]
+
+            if peek() != ("punct", ")"):
+                while True:
+                    args.append(parse_one())
+                    if peek() == ("punct", ","):
+                        i += 1
+                        continue
+                    break
+            if peek() != ("punct", ")"):
+                raise VariformError("expected ')'")
+            i += 1
+            return ("call", val, args)
+        return ("var", val)
+
+    ast = parse_one()
+    if i != len(tokens):
+        raise VariformError(f"trailing input in {src!r}")
+    return ast
+
+
+def render(ast, env: Dict[str, Any]):
+    kind = ast[0]
+    if kind == "lit":
+        return ast[1]
+    if kind == "var":
+        cur: Any = env
+        for part in ast[1].split("."):
+            if not isinstance(cur, dict):
+                return None
+            cur = cur.get(part)
+        return cur
+    _k, name, args = ast
+    fn = _VF_FUNCS.get(name) or FUNCS.get(name)
+    if fn is None:
+        raise VariformError(f"unknown function {name!r}")
+    return fn(*(render(a, env) for a in args))
+
+
+class CinfoProvider(Provider):
+    """checks = [{"is_match": expr | [exprs], "result":
+    allow|deny|ignore}] — compiled at construction like the
+    reference."""
+
+    def __init__(self, checks: List[Dict[str, Any]]):
+        self.checks = []
+        for c in checks:
+            exprs = c.get("is_match") or []
+            if isinstance(exprs, str):
+                exprs = [exprs]
+            if not exprs:
+                raise VariformError("is_match must be non-empty")
+            result = c.get("result", "ignore")
+            assert result in ("allow", "deny", "ignore"), result
+            self.checks.append(
+                ([compile_expr(e) for e in exprs], result,
+                 c.get("is_superuser", False))
+            )
+
+    @staticmethod
+    def _env(creds: Credentials) -> Dict[str, Any]:
+        pw = creds.password
+        return {
+            "clientid": creds.client_id,
+            "username": creds.username or "",
+            "password": (
+                pw.decode("utf-8", "replace")
+                if isinstance(pw, (bytes, bytearray)) else (pw or "")
+            ),
+            "peerhost": creds.peerhost or "",
+            # aliases the reference adds (cert fields when present)
+            "cert_common_name": getattr(creds, "cert_cn", "") or "",
+        }
+
+    def authenticate(self, creds: Credentials):
+        env = self._env(creds)
+        for exprs, result, superuser in self.checks:
+            matched = True
+            for ast in exprs:
+                try:
+                    v = render(ast, env)
+                except Exception:
+                    matched = False
+                    break
+                if v is not True and v != "true":
+                    matched = False
+                    break
+            if not matched:
+                continue
+            if result == "allow":
+                return AuthResult(ok=True, superuser=superuser)
+            if result == "deny":
+                return AuthResult(ok=False)
+            return IGNORE
+        return IGNORE

@@ -63,6 +63,18 @@ def provider_from_conf(conf: Dict[str, Any]) -> Provider:
             public_key=pub.encode() if isinstance(pub, str) else pub,
             jwks_endpoint=conf.get("endpoint") or conf.get("jwks_endpoint"),
         )
+    if backend == "cinfo" or conf.get("mechanism") == "cinfo":
+        from .cinfo import CinfoProvider
+
+        return CinfoProvider(conf.get("checks") or [])
+    if backend == "gcp_device" or conf.get("mechanism") == "gcp_device":
+        from .gcp_device import GcpDeviceProvider, GcpDeviceRegistry
+
+        registry = conf.get("registry")
+        if registry is None:
+            registry = GcpDeviceRegistry()
+            registry.import_devices(conf.get("devices") or [])
+        return GcpDeviceProvider(registry)
     if backend == "http":
         from .http import HttpAuthnProvider
 

@@ -1,0 +1,171 @@
+"""GCP IoT Core compatible device registry + JWT authentication.
+
+The reference's emqx_gcp_device (apps/emqx_gcp_device/src/
+emqx_gcp_device.erl + emqx_gcp_device_authn.erl) lets devices migrated
+off Google Cloud IoT Core keep their auth model: each device id maps
+to registered public keys (RSA/EC PEM or X.509 certs, with optional
+expiry), the MQTT password is a JWT the device self-signs, and the
+authenticator verifies it against any registered unexpired key.
+Device configs import/export through the management API
+(emqx_gcp_device_api.erl).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import time
+from typing import Any, Dict, List, Optional
+
+from .authn import AuthResult, Credentials, IGNORE, Provider
+
+
+def _b64url_decode(s: str) -> bytes:
+    return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+
+def _load_public_key(key_data: str, key_format: str):
+    from cryptography.hazmat.primitives.serialization import (
+        load_pem_public_key,
+    )
+    from cryptography.x509 import load_pem_x509_certificate
+
+    if key_format in ("RSA_X509_PEM", "ES256_X509_PEM"):
+        return load_pem_x509_certificate(key_data.encode()).public_key()
+    return load_pem_public_key(key_data.encode())
+
+
+class GcpDeviceRegistry:
+    """deviceid -> [{key, key_format, expires_at?}] (the IoT Core
+    credential list shape)."""
+
+    def __init__(self) -> None:
+        self._devices: Dict[str, Dict[str, Any]] = {}
+
+    def put_device(self, deviceid: str, keys: List[Dict[str, Any]],
+                   config: str = "") -> None:
+        loaded = []
+        for k in keys:
+            loaded.append({
+                "key": k["key"],
+                "key_format": k.get("key_format", "RSA_PEM"),
+                "expires_at": k.get("expires_at", 0) or 0,
+                "_pub": _load_public_key(
+                    k["key"], k.get("key_format", "RSA_PEM")
+                ),
+            })
+        self._devices[deviceid] = {
+            "deviceid": deviceid, "keys": loaded, "config": config,
+            "created_at": time.time(),
+        }
+
+    def delete_device(self, deviceid: str) -> bool:
+        return self._devices.pop(deviceid, None) is not None
+
+    def get_device(self, deviceid: str) -> Optional[Dict[str, Any]]:
+        d = self._devices.get(deviceid)
+        if d is None:
+            return None
+        return {
+            "deviceid": d["deviceid"],
+            "keys": [
+                {k2: v for k2, v in k.items() if k2 != "_pub"}
+                for k in d["keys"]
+            ],
+            "config": d["config"],
+        }
+
+    def list_devices(self) -> List[Dict[str, Any]]:
+        return [self.get_device(d) for d in sorted(self._devices)]
+
+    def live_keys(self, deviceid: str, now: Optional[float] = None):
+        d = self._devices.get(deviceid)
+        if d is None:
+            return []
+        now = now if now is not None else time.time()
+        return [
+            k for k in d["keys"]
+            if not k["expires_at"] or k["expires_at"] > now
+        ]
+
+    # --- import/export (emqx_gcp_device_api import format) -------------
+
+    def import_devices(self, docs: List[Dict[str, Any]]) -> int:
+        n = 0
+        for doc in docs:
+            try:
+                self.put_device(
+                    doc["deviceid"], doc.get("keys", []),
+                    doc.get("config", ""),
+                )
+                n += 1
+            except Exception:
+                continue
+        return n
+
+    def export_devices(self) -> List[Dict[str, Any]]:
+        return self.list_devices()
+
+
+class GcpDeviceProvider(Provider):
+    """MQTT password = device-signed JWT (RS256/ES256), verified
+    against the registry's unexpired keys; the exp claim is honored."""
+
+    def __init__(self, registry: GcpDeviceRegistry):
+        self.registry = registry
+
+    def authenticate(self, creds: Credentials):
+        token = (creds.password or b"").decode("utf-8", "replace")
+        if token.count(".") != 2:
+            return IGNORE
+        keys = self.registry.live_keys(creds.client_id)
+        if not keys:
+            return IGNORE  # not a registered device: next provider
+        try:
+            h64, c64, s64 = token.split(".")
+            header = json.loads(_b64url_decode(h64))
+            claims = json.loads(_b64url_decode(c64))
+            sig = _b64url_decode(s64)
+        except Exception:
+            return AuthResult(ok=False, reason="malformed jwt")
+        exp = claims.get("exp")
+        if exp is not None and exp < time.time():
+            return AuthResult(ok=False, reason="jwt expired")
+        alg = header.get("alg")
+        signing = f"{h64}.{c64}".encode()
+        for k in keys:
+            if self._verify(alg, k["_pub"], signing, sig):
+                return AuthResult(ok=True)
+        return AuthResult(ok=False, reason="no registered key matches")
+
+    @staticmethod
+    def _verify(alg, pub, signing: bytes, sig: bytes) -> bool:
+        from cryptography.exceptions import InvalidSignature
+        from cryptography.hazmat.primitives.hashes import SHA256
+
+        try:
+            if alg == "RS256":
+                from cryptography.hazmat.primitives.asymmetric.padding import (
+                    PKCS1v15,
+                )
+
+                pub.verify(sig, signing, PKCS1v15(), SHA256())
+                return True
+            if alg == "ES256":
+                from cryptography.hazmat.primitives.asymmetric.ec import (
+                    ECDSA,
+                )
+                from cryptography.hazmat.primitives.asymmetric.utils import (
+                    encode_dss_signature,
+                )
+
+                if len(sig) != 64:
+                    return False
+                r = int.from_bytes(sig[:32], "big")
+                s = int.from_bytes(sig[32:], "big")
+                pub.verify(encode_dss_signature(r, s), signing,
+                           ECDSA(SHA256()))
+                return True
+        except (InvalidSignature, Exception):
+            return False
+        return False

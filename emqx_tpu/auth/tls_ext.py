@@ -1,0 +1,78 @@
+"""TLS auth extensions — the emqx_auth_ext analog.
+
+The reference app (apps/emqx_auth_ext/src/emqx_auth_ext_tls_lib.erl +
+_tls_const_v1.erl) extends listener TLS with (a) `partial_chain`
+verification — accept a client chain that roots at ANY trusted
+intermediate, not only a full chain to a root CA — and (b) extraction
+of `cn` / `dn` from the peer certificate into the client info so
+authn/authz (cinfo expressions, ACL placeholders) can key on them.
+
+Here: cert-field extraction works on the DER the ssl module exposes
+post-handshake, and partial-chain acceptance is a verifier over the
+presented chain against a trusted-certs set (CPython's ssl module has
+no partial_chain hook, so listeners wanting it verify AFTER an
+optional-mTLS handshake; same trust decision, different seam).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+
+def peer_cert_fields(der: bytes) -> Dict[str, str]:
+    """{cn, dn, serial} from a DER client certificate — the fields the
+    reference splices into ClientInfo (ssl_peer_cert cn/dn)."""
+    from cryptography import x509
+    from cryptography.x509.oid import NameOID
+
+    cert = x509.load_der_x509_certificate(der)
+    cns = cert.subject.get_attributes_for_oid(NameOID.COMMON_NAME)
+    return {
+        "cn": cns[0].value if cns else "",
+        "dn": cert.subject.rfc4514_string(),
+        "serial": format(cert.serial_number, "x"),
+    }
+
+
+class PartialChainVerifier:
+    """Accept a peer chain that links to ANY trusted cert (root or
+    intermediate) — the reference's `partial_chain = true`."""
+
+    def __init__(self, trusted_pems: List[bytes]):
+        from cryptography import x509
+
+        self.trusted = []
+        for pem in trusted_pems:
+            if pem.lstrip().startswith(b"-----BEGIN"):
+                self.trusted.extend(x509.load_pem_x509_certificates(pem))
+            else:
+                self.trusted.append(x509.load_der_x509_certificate(pem))
+
+    def verify(self, chain_ders: List[bytes]) -> Optional[str]:
+        """None when the chain is acceptable, else the failure reason.
+        The leaf is chain_ders[0]; each cert must be signed by the
+        next, and SOME cert in (or signing) the chain must be
+        trusted."""
+        from cryptography import x509
+        from cryptography.exceptions import InvalidSignature
+
+        if not chain_ders:
+            return "empty chain"
+        chain = [x509.load_der_x509_certificate(d) for d in chain_ders]
+
+        def signed_by(child, parent) -> bool:
+            try:
+                child.verify_directly_issued_by(parent)
+                return True
+            except (InvalidSignature, ValueError, TypeError):
+                return False
+
+        for i, cert in enumerate(chain):
+            for t in self.trusted:
+                if signed_by(cert, t):
+                    # anchor found: every link below it must verify
+                    for j in range(i):
+                        if not signed_by(chain[j], chain[j + 1]):
+                            return f"broken link at depth {j}"
+                    return None
+        return "no trusted anchor in chain"

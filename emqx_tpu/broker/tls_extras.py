@@ -224,9 +224,11 @@ class OcspCache:
             )
             if fresh and not force:
                 return self._der
-            if self._inflight:
+            if self._inflight and not force:
                 # one fetcher at a time — cold-start stampedes would
-                # otherwise all POST the responder concurrently
+                # otherwise all POST the responder concurrently.
+                # force=True keeps its always-fetch contract even if
+                # that means a concurrent duplicate
                 return self._der
             self._inflight = True
             claimed_at = time.time()

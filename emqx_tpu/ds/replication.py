@@ -342,9 +342,22 @@ class ReplicatedDs:
         lg = self._log.setdefault(shard, deque(maxlen=LOG_RETENTION))
         lg.append((idx, payload))
 
-    def _handle_append(self, shard: int, idx: int, term: int, payload: list, _from=None):
+    def _advance_accepted(self, shard: int) -> None:
+        """accepted = the end of the CONTIGUOUS pending run above
+        applied. Anything that mutates pending must re-derive it this
+        way — a non-contiguous bump (observed with forced catch-up
+        entries landing above a hole) hides the hole from gap
+        detection and wedges the commit walk forever."""
+        acc = self._applied.get(shard, 0)
+        pend = self._pending.get(shard, {})
+        while acc + 1 in pend:
+            acc += 1
+        self._accepted[shard] = acc
+
+    def _handle_append(self, shard: int, idx: int, term: int, payload: list,
+                       _from=None, forced=False):
         with self._mutex:
-            if term < self.term:
+            if term < self.term and not forced:
                 return ("stale", self.term)
             if term > self.term:
                 self.term = term
@@ -361,6 +374,18 @@ class ReplicatedDs:
                 return ("conflict",)  # evicted from the log: refuse
             accepted = self._accepted.get(shard, applied)
             cur = self._pending.get(shard, {}).get(idx)
+            if forced and idx > applied:
+                # catch-up stream of an entry COMMITTED on the sender:
+                # overwrite any pending rival — committed logs cannot
+                # diverge (maintained by the commit/ack fences), and a
+                # stale sender's committed log is a prefix of ours, so
+                # forcing is at worst a no-op rewrite. accepted moves
+                # only contiguously (holes must stay gap-detectable).
+                self._pending.setdefault(shard, {})[idx] = (
+                    term, payload, _from
+                )
+                self._advance_accepted(shard)
+                return ("ok",)
             if cur is not None:
                 if cur[0] == term:
                     # same term: only a true duplicate (same leader, same
@@ -377,7 +402,7 @@ class ReplicatedDs:
                 return ("ok",)
             if idx == accepted + 1:
                 self._pending.setdefault(shard, {})[idx] = (term, payload, _from)
-                self._accepted[shard] = idx
+                self._advance_accepted(shard)
                 return ("ok",)
             if idx <= accepted:
                 # accepted an entry at this index from another leader
@@ -403,9 +428,19 @@ class ReplicatedDs:
                 if e is None:
                     break
                 if leader is not None and e[2] != leader:
-                    for i in [i for i in pend if i >= nxt]:
+                    # drop the mismatched rival at nxt AND any later
+                    # rival-led entries (they block the leader's gap
+                    # catch-up stream with conflicts), but KEEP the
+                    # notifier's own later appends — those may be
+                    # validly acked parts of committed entries, and
+                    # deleting them would shrink a committed entry's
+                    # replication below quorum
+                    for i in [
+                        i for i in pend
+                        if i >= nxt and pend[i][2] != leader
+                    ]:
                         del pend[i]
-                    self._accepted[shard] = self._applied.get(shard, 0)
+                    self._advance_accepted(shard)
                     break
                 self._apply_locked(shard, nxt, e[1])
                 applied_any = True
@@ -446,20 +481,21 @@ class ReplicatedDs:
         with self._mutex:
             term = self.term
             entries = [
-                (i, term, p)
+                (i, term, p, True)  # committed here: force through rivals
                 for i, p in self._log.get(shard, ())
                 if i > after
             ]
             entries += [
-                (i, t, p)
+                (i, t, p, False)
                 for i, (t, p, _l) in sorted(self._pending.get(shard, {}).items())
                 if i > after
             ]
             upto = self._applied.get(shard, 0)
-        for i, t, p in entries:
+        for i, t, p, forced in entries:
             try:
                 r = await self.node.rpc.call(
-                    addr, "ds", "append", (shard, i, t, p, self.node_id),
+                    addr, "ds", "append",
+                    (shard, i, t, p, self.node_id, forced),
                     key=f"ds{shard}",
                 )
             except Exception:

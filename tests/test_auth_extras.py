@@ -1,0 +1,247 @@
+"""Small auth-parity apps: cinfo (variform checks), GCP IoT Core
+device registry + JWT authn, TLS auth extensions (cert fields +
+partial-chain)."""
+
+import base64
+import datetime
+import json
+import time
+
+import pytest
+
+from emqx_tpu.auth.authn import AuthResult, Credentials, IGNORE
+from emqx_tpu.auth.cinfo import (
+    CinfoProvider,
+    VariformError,
+    compile_expr,
+    render,
+)
+from emqx_tpu.auth.factory import provider_from_conf
+from emqx_tpu.auth.gcp_device import GcpDeviceProvider, GcpDeviceRegistry
+from emqx_tpu.auth.tls_ext import PartialChainVerifier, peer_cert_fields
+
+
+# --- cinfo ----------------------------------------------------------------
+
+
+def test_variform_expressions():
+    env = {"clientid": "dev-42", "username": "alice", "n": {"x": 7}}
+    assert render(compile_expr("clientid"), env) == "dev-42"
+    assert render(compile_expr("regex_match(clientid, '^dev-')"), env)
+    assert render(compile_expr("str_eq(username, 'alice')"), env) is True
+    assert render(compile_expr("num_gt(strlen(clientid), 3)"), env) is True
+    assert render(compile_expr("n.x"), env) == 7
+    assert render(compile_expr("concat(username, '-', clientid)"), env) == (
+        "alice-dev-42"
+    )
+    with pytest.raises(VariformError):
+        compile_expr("no_such_fn(")
+    with pytest.raises(VariformError):
+        render(compile_expr("definitely_not_a_function(clientid)"), env)
+
+
+def test_cinfo_provider_chain_semantics():
+    p = CinfoProvider([
+        {"is_match": "regex_match(clientid, '^banned-')", "result": "deny"},
+        {"is_match": ["str_eq(username, 'root')",
+                      "str_eq(password, 'open sesame')"],
+         "result": "allow", "is_superuser": True},
+        {"is_match": "regex_match(clientid, '^dev-')", "result": "allow"},
+        {"is_match": "str_eq(clientid, 'shadow')", "result": "ignore"},
+    ])
+    assert p.authenticate(Credentials("banned-9", None, None)).ok is False
+    r = p.authenticate(Credentials("any", "root", b"open sesame"))
+    assert r.ok and r.superuser
+    assert p.authenticate(Credentials("dev-1", None, None)).ok
+    assert p.authenticate(Credentials("shadow", None, None)) is IGNORE
+    assert p.authenticate(Credentials("nobody", None, None)) is IGNORE
+    # factory registration
+    fp = provider_from_conf({
+        "mechanism": "cinfo",
+        "checks": [{"is_match": "true", "result": "allow"}],
+    })
+    assert isinstance(fp, CinfoProvider)
+
+
+def test_cinfo_through_authn_chain():
+    from emqx_tpu.auth.authn import GLOBAL_CHAIN, AuthnChains
+
+    chains = AuthnChains()
+    chains.create_authenticator(GLOBAL_CHAIN, "cinfo-1", CinfoProvider([
+        {"is_match": "regex_match(clientid, '^sensor-')",
+         "result": "allow"},
+    ]))
+    assert chains.authenticate(
+        Credentials("sensor-1", None, None)
+    ).ok
+    assert not chains.authenticate(
+        Credentials("laptop-1", None, None)
+    ).ok  # no provider claimed it -> chain default deny
+
+
+# --- GCP device registry --------------------------------------------------
+
+
+def _device_jwt(key, alg="RS256", exp_delta=3600):
+    def b64url(b):
+        return base64.urlsafe_b64encode(b).rstrip(b"=").decode()
+
+    from cryptography.hazmat.primitives.hashes import SHA256
+
+    header = b64url(json.dumps({"alg": alg, "typ": "JWT"}).encode())
+    claims = b64url(json.dumps(
+        {"aud": "proj", "iat": int(time.time()),
+         "exp": int(time.time()) + exp_delta}
+    ).encode())
+    signing = f"{header}.{claims}".encode()
+    if alg == "RS256":
+        from cryptography.hazmat.primitives.asymmetric.padding import (
+            PKCS1v15,
+        )
+
+        sig = key.sign(signing, PKCS1v15(), SHA256())
+    else:
+        from cryptography.hazmat.primitives.asymmetric.ec import ECDSA
+        from cryptography.hazmat.primitives.asymmetric.utils import (
+            decode_dss_signature,
+        )
+
+        der = key.sign(signing, ECDSA(SHA256()))
+        r, s = decode_dss_signature(der)
+        sig = r.to_bytes(32, "big") + s.to_bytes(32, "big")
+    return f"{header}.{claims}.{b64url(sig)}"
+
+
+def test_gcp_device_registry_and_jwt_auth():
+    from cryptography.hazmat.primitives.asymmetric import ec, rsa
+    from cryptography.hazmat.primitives.serialization import (
+        Encoding, PublicFormat,
+    )
+
+    rsa_key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    ec_key = ec.generate_private_key(ec.SECP256R1())
+
+    def pub_pem(k):
+        return k.public_key().public_bytes(
+            Encoding.PEM, PublicFormat.SubjectPublicKeyInfo
+        ).decode()
+
+    reg = GcpDeviceRegistry()
+    reg.put_device("dev-rsa", [
+        {"key": pub_pem(rsa_key), "key_format": "RSA_PEM"},
+    ])
+    reg.put_device("dev-ec", [
+        {"key": pub_pem(ec_key), "key_format": "ES256_PEM"},
+    ])
+    reg.put_device("dev-expired", [
+        {"key": pub_pem(rsa_key), "key_format": "RSA_PEM",
+         "expires_at": time.time() - 10},
+    ])
+    p = GcpDeviceProvider(reg)
+
+    ok = p.authenticate(Credentials(
+        "dev-rsa", None, _device_jwt(rsa_key).encode()
+    ))
+    assert ok.ok
+    ok = p.authenticate(Credentials(
+        "dev-ec", None, _device_jwt(ec_key, alg="ES256").encode()
+    ))
+    assert ok.ok
+    # wrong key -> deny
+    bad = p.authenticate(Credentials(
+        "dev-rsa", None, _device_jwt(
+            rsa.generate_private_key(public_exponent=65537, key_size=2048)
+        ).encode()
+    ))
+    assert bad.ok is False
+    # expired JWT -> deny
+    late = p.authenticate(Credentials(
+        "dev-rsa", None, _device_jwt(rsa_key, exp_delta=-100).encode()
+    ))
+    assert late.ok is False and "expired" in late.reason
+    # all keys expired -> not our device -> next provider
+    assert p.authenticate(Credentials(
+        "dev-expired", None, _device_jwt(rsa_key).encode()
+    )) is IGNORE
+    # unregistered device -> ignore
+    assert p.authenticate(Credentials(
+        "stranger", None, _device_jwt(rsa_key).encode()
+    )) is IGNORE
+
+    # registry CRUD + import/export round trip
+    docs = reg.export_devices()
+    reg2 = GcpDeviceRegistry()
+    assert reg2.import_devices(docs) == 3
+    assert [d["deviceid"] for d in reg2.list_devices()] == [
+        "dev-ec", "dev-expired", "dev-rsa",
+    ]
+    assert reg2.delete_device("dev-ec") and not reg2.delete_device("dev-ec")
+
+
+# --- TLS auth extensions --------------------------------------------------
+
+
+def test_peer_cert_fields_and_partial_chain():
+    from cryptography import x509
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.hazmat.primitives.hashes import SHA256
+    from cryptography.hazmat.primitives.serialization import Encoding
+    from cryptography.x509.oid import NameOID
+
+    now = datetime.datetime.now(datetime.timezone.utc)
+
+    def name(cn, org=None):
+        attrs = [x509.NameAttribute(NameOID.COMMON_NAME, cn)]
+        if org:
+            attrs.append(x509.NameAttribute(NameOID.ORGANIZATION_NAME, org))
+        return x509.Name(attrs)
+
+    def make(subject, issuer_name, issuer_key, key=None, ca=False):
+        key = key or rsa.generate_private_key(
+            public_exponent=65537, key_size=2048
+        )
+        b = (
+            x509.CertificateBuilder()
+            .subject_name(subject).issuer_name(issuer_name)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(days=1))
+            .not_valid_after(now + datetime.timedelta(days=30))
+        )
+        if ca:
+            b = b.add_extension(
+                x509.BasicConstraints(ca=True, path_length=None),
+                critical=True,
+            )
+        return key, b.sign(issuer_key, SHA256())
+
+    root_key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    _rk, root = make(name("root"), name("root"), root_key, key=root_key,
+                     ca=True)
+    inter_key, inter = make(name("intermediate"), name("root"), root_key,
+                            ca=True)
+    leaf_key, leaf = make(name("device-7", "acme"), name("intermediate"),
+                          inter_key)
+
+    fields = peer_cert_fields(leaf.public_bytes(Encoding.DER))
+    assert fields["cn"] == "device-7"
+    assert "CN=device-7" in fields["dn"] and "O=acme" in fields["dn"]
+
+    # partial chain: trusting only the INTERMEDIATE accepts the leaf
+    v = PartialChainVerifier([inter.public_bytes(Encoding.PEM)])
+    assert v.verify([leaf.public_bytes(Encoding.DER)]) is None
+    # full chain to a trusted root also verifies
+    v_root = PartialChainVerifier([root.public_bytes(Encoding.PEM)])
+    assert v_root.verify([
+        leaf.public_bytes(Encoding.DER), inter.public_bytes(Encoding.DER),
+    ]) is None
+    # an unrelated leaf is rejected
+    _ok, other = make(name("intruder"), name("evil-ca"),
+                      rsa.generate_private_key(
+                          public_exponent=65537, key_size=2048
+                      ))
+    assert v.verify([other.public_bytes(Encoding.DER)]) is not None
+    # broken link below the anchor is rejected
+    assert v_root.verify([
+        other.public_bytes(Encoding.DER), inter.public_bytes(Encoding.DER),
+    ]) is not None

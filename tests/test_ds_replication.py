@@ -371,7 +371,7 @@ async def test_split_brain_two_leaders_single_history(tmp_path):
         n3.broker.publish(Message(
             topic="jobs/a", payload=b"post-heal", qos=1, from_client="p3",
         ))
-        for _ in range(20):
+        for _ in range(30):
             await settle(0.3)
             if dict(r1._applied) == dict(r2._applied) == dict(r3._applied):
                 break
