@@ -73,6 +73,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import speedups as _speedups
 from .match import EncodedTopics
 from .table import FilterTable
 from .vocab import PLUS
@@ -198,20 +199,6 @@ def _refresh_probe(slots: SlotArrays, b: int) -> None:
         if bkt[l] >= 0:
             w |= max(fps[l] >> 24, 1) << (8 * l)
     slots.probe[b] = w
-
-
-class _Bucket:
-    """Mutable: eviction kicks relocate buckets constantly on the
-    churn path, and namedtuple._replace was ~15us per relocation."""
-
-    __slots__ = ("filter_words", "class_id", "h1", "fp", "slot")
-
-    def __init__(self, filter_words, class_id, h1, fp, slot):
-        self.filter_words = filter_words
-        self.class_id = class_id
-        self.h1 = h1
-        self.fp = fp
-        self.slot = slot
 
 
 class _NeedRebuild(Exception):
@@ -375,8 +362,11 @@ class ClassIndex:
         self.class_budget = class_budget
         self._min_buckets = max(4, min_slots // BUCKET_W)
         self._skel_class: Dict[Tuple[int, bool, int], int] = {}
+        # packed mirror of _skel_class keyed by plen | hh<<6 | plus<<7
+        # (one int probe per row for the bulk/native write paths)
+        self._skel_packed: Dict[int, int] = {}
         self._class_free: List[int] = list(range(class_budget - 1, -1, -1))
-        self._class_buckets: List[int] = [0] * class_budget
+        self._class_buckets = np.zeros(class_budget, np.int64)
         self.meta = ClassMeta(
             np.zeros(class_budget, np.int32),
             np.zeros(class_budget, bool),
@@ -391,16 +381,33 @@ class ClassIndex:
             np.zeros(self.n_buckets, np.uint32),
         )
         self._live = 0  # live slots
-        self._buckets: List[Optional[_Bucket]] = []
+        # bucket records live in PARALLEL arrays, not python objects:
+        # the churn write path touches every field of every new bucket,
+        # and per-object attribute stores were ~40% of insert time.
+        # _bkt_ws is the only object column (the words tuple the match
+        # path verifies candidates against); _bucket_of keys by the
+        # canonical '/'-joined filter STRING because str hashes are
+        # cached by CPython where tuple hashes re-combine every probe.
+        self._bkt_ws: List[Optional[Tuple[str, ...]]] = []
+        self._bkt_cid = np.zeros(0, np.int32)
+        self._bkt_h1 = np.zeros(0, np.uint32)
+        self._bkt_fp = np.zeros(0, np.uint32)
+        self._bkt_slot = np.zeros(0, np.int64)
         self._bucket_free: List[int] = []
-        self._bucket_of: Dict[Tuple[str, ...], int] = {}
-        self._bucket_rows: List[Set[int]] = []
-        self._row_bucket: Dict[int, int] = {}
+        self._bucket_of: Dict[str, int] = {}
+        # bucket -> member rows: a bare int for the common 1-row
+        # bucket (no set allocation on the churn path), promoted to a
+        # set when a second row shares the filter
+        self._bucket_rows: List[object] = []
+        # row -> bucket id, indexed by table row (-1 = not indexed);
+        # a flat array because rows are dense ints and the native core
+        # writes it raw
+        self._row_bucket = np.full(1024, -1, np.int64)
         # rows that could not get a class (skeleton budget exhausted):
         # matched by the dense kernel over a residual mask instead
         self.residual_rows: Set[int] = set()
         self.residual_dirty = False
-        self.dirty_slots: Set[int] = set()
+        self.dirty_slots: List[int] = []
         self.meta_dirty = True
         self.rebuilt = True  # device must re-upload slot arrays
 
@@ -429,6 +436,52 @@ class ClassIndex:
 
     # --- write path ----------------------------------------------------
 
+    def ensure_row_capacity(self, need: int) -> None:
+        """Guarantee the row->bucket array covers rows < `need`."""
+        cap = len(self._row_bucket)
+        if need <= cap:
+            return
+        while cap < need:
+            cap *= 2
+        self._row_bucket = np.concatenate(
+            [
+                self._row_bucket,
+                np.full(cap - len(self._row_bucket), -1, np.int64),
+            ]
+        )
+
+    def reserve(self, n_new: int, row_capacity: int) -> None:
+        """Pre-grow every structure a burst of up to `n_new` fresh rows
+        could touch, so a native bulk writer can hold raw buffer
+        pointers for the whole batch (no growth mid-call).  Growth
+        points move at most one batch earlier than the incremental
+        path's; final sizes are identical (pow2)."""
+        self.ensure_row_capacity(row_capacity)
+        self._grow_bucket_arrays(len(self._bkt_ws) + n_new)
+        need = self.n_buckets
+        while (
+            (self._live + n_new) * BULK_LOAD_DEN
+            > need * BUCKET_W * BULK_LOAD_NUM
+        ):
+            need *= 2
+        if need != self.n_buckets:
+            self._rebuild(need)
+
+    def _grow_bucket_arrays(self, need: int) -> None:
+        cap = len(self._bkt_cid)
+        if need <= cap:
+            return
+        new = max(64, cap)
+        while new < need:
+            new *= 2
+        pad = new - cap
+        self._bkt_cid = np.concatenate([self._bkt_cid, np.zeros(pad, np.int32)])
+        self._bkt_h1 = np.concatenate([self._bkt_h1, np.zeros(pad, np.uint32)])
+        self._bkt_fp = np.concatenate([self._bkt_fp, np.zeros(pad, np.uint32)])
+        self._bkt_slot = np.concatenate(
+            [self._bkt_slot, np.full(pad, -1, np.int64)]
+        )
+
     def add_row(self, row: int, table: FilterTable) -> None:
         """Index row `row` of `table` (call right after table.add)."""
         ws = table.filter_words(row)
@@ -452,9 +505,15 @@ class ClassIndex:
                 plus_mask |= 1 << i
             else:
                 lit_words.append((i, wid))
-        bid = self._bucket_of.get(ws)
+        self.ensure_row_capacity(row + 1)
+        f = table.filter_str(row)
+        bid = self._bucket_of.get(f)
         if bid is not None:
-            self._bucket_rows[bid].add(row)
+            rs = self._bucket_rows[bid]
+            if isinstance(rs, set):
+                rs.add(row)
+            elif rs != row:
+                self._bucket_rows[bid] = {rs, row}
             self._row_bucket[row] = bid
             return
         cid = self._class_of(plen, has_hash, bool(table.root_wild[row]), plus_mask)
@@ -463,12 +522,23 @@ class ClassIndex:
             self.residual_dirty = True
             return
         h1, fp = _hash_host(cid, lit_words, self.max_levels)
-        bid = self._bucket_free.pop() if self._bucket_free else len(self._buckets)
-        if bid == len(self._buckets):
-            self._buckets.append(None)
-            self._bucket_rows.append(set())
-        self._buckets[bid] = _Bucket(ws, cid, h1, fp, -1)
-        self._finish_bucket(bid, row, ws, cid)
+        if self._bucket_free:
+            bid = self._bucket_free.pop()
+        else:
+            bid = len(self._bkt_ws)
+            self._bkt_ws.append(None)
+            self._bucket_rows.append(None)
+            self._grow_bucket_arrays(bid + 1)
+        self._bkt_ws[bid] = ws
+        self._bkt_cid[bid] = cid
+        self._bkt_h1[bid] = h1
+        self._bkt_fp[bid] = fp
+        self._bkt_slot[bid] = -1
+        self._bucket_rows[bid] = {row}
+        self._bucket_of[f] = bid
+        self._row_bucket[row] = bid
+        self._class_buckets[cid] += 1
+        self._live += 1
         if self._live * MAX_LOAD_DEN > self.n_slots * MAX_LOAD_NUM:
             self._rebuild(self.n_buckets * 2)
             return
@@ -477,26 +547,29 @@ class ClassIndex:
         except _NeedRebuild:
             self._rebuild(self.n_buckets * 2)
 
-    def _finish_bucket(self, bid: int, row: int, ws, cid: int) -> None:
-        self._bucket_rows[bid] = {row}
-        self._bucket_of[ws] = bid
-        self._row_bucket[row] = bid
-        self._class_buckets[cid] += 1
-        self._live += 1
-
-    def add_rows(self, rows: Sequence[int], table: FilterTable) -> None:
-        """Batch add_row — same visible state, but the per-row hash and
-        cuckoo placement run vectorized over the burst. This is the
-        write path for router-syncer-style batches (the reference
-        flushes route writes in <=1000-op batches,
-        emqx_router_syncer.erl:57); subscribe storms hit it."""
+    def add_rows(
+        self,
+        rows: Sequence[int],
+        table: FilterTable,
+        flts: Optional[Sequence[str]] = None,
+    ) -> None:
+        """Batch add_row — same visible state, but everything that can
+        be array work IS array work: skeleton classing runs once per
+        DISTINCT skeleton in the burst (np.unique over packed int64
+        keys), hashes and bucket-record fields write via one fancy
+        index each, and the per-row python loop is down to the dict
+        bookkeeping no array can hold. This is the write path for
+        router-syncer-style batches (the reference flushes route writes
+        in <=1000-op batches, emqx_router_syncer.erl:57); subscribe
+        storms hit it. `flts` (when given) carries the rows' canonical
+        filter strings so the dedup probe skips a '/'-join per row."""
         if not rows:
             return
         if len(rows) == 1:
             self.add_row(rows[0], table)
             return
         rr = np.asarray(rows, np.int64)
-        plen = table.prefix_len[rr]
+        plen = table.prefix_len[rr].astype(np.int64)
         wids = table.words[rr].astype(np.int64)  # [B, L]
         lvl = np.arange(wids.shape[1])
         in_prefix = lvl[None, :] < plen[:, None]
@@ -504,68 +577,101 @@ class ClassIndex:
         xs = np.where(in_prefix & (wids != PLUS), wids + 1, 0).astype(np.uint32)
         plus_mask = (
             isplus.astype(np.uint64) << lvl.astype(np.uint64)[None, :]
-        ).sum(1)
-        plen_l = plen.tolist()
-        hh_l = table.has_hash[rr].tolist()
-        rw_l = table.root_wild[rr].tolist()
-        pm_l = plus_mask.tolist()
-        new_bids: List[int] = []
-        new_idx: List[int] = []
-        new_cids: List[int] = []
-        # hot loop: locals bound once; skeleton-class fast path inlined
-        # (the slow _class_of only runs on a NEW skeleton)
-        filters_l = table._filters
-        bucket_of = self._bucket_of
-        bucket_rows = self._bucket_rows
-        row_bucket = self._row_bucket
-        buckets = self._buckets
-        bucket_free = self._bucket_free
-        skel_class = self._skel_class
-        class_buckets = self._class_buckets
-        live = self._live
-        for i, row in enumerate(rows):
-            if plen_l[i] > 32:
-                self.residual_rows.add(row)
-                self.residual_dirty = True
+        ).sum(1).astype(np.int64)
+        # one packed int64 skeleton key per row: plen (6 bits) |
+        # has_hash (1) | plus_mask (32); -1 marks too-deep rows. Class
+        # resolution then costs one dict probe per DISTINCT skeleton.
+        hh = table.has_hash[rr]
+        skel = plen | (hh.astype(np.int64) << 6) | (plus_mask << 7)
+        skel[plen > 32] = -1
+        uskel, inv = np.unique(skel, return_inverse=True)
+        ucid = np.empty(len(uskel), np.int64)
+        for k, s in enumerate(uskel.tolist()):
+            if s < 0:
+                ucid[k] = -1
                 continue
-            ws = filters_l[row]
-            bid = bucket_of.get(ws)
-            if bid is not None:
-                bucket_rows[bid].add(row)
-                row_bucket[row] = bid
-                continue
-            cid = skel_class.get((plen_l[i], hh_l[i], pm_l[i]))
+            p, h, pm = s & 63, bool((s >> 6) & 1), s >> 7
+            cid = self._skel_class.get((p, h, pm))
             if cid is None:
-                cid = self._class_of(plen_l[i], hh_l[i], rw_l[i], pm_l[i])
-                if cid is None:
-                    self.residual_rows.add(row)
-                    self.residual_dirty = True
+                rw = (h and p == 0) or bool(pm & 1)
+                cid = self._class_of(p, h, rw, pm)
+            ucid[k] = -1 if cid is None else cid
+        cids = ucid[inv]
+        if flts is None:
+            filt_l = table._fstr
+            flt_l = [filt_l[r] for r in rows]
+        else:
+            flt_l = flts if isinstance(flts, list) else list(flts)
+        nb0 = len(self._bkt_ws)
+        rows_l = rows if isinstance(rows, list) else list(rows)
+        self.ensure_row_capacity(max(rows_l) + 1)
+        sp = _speedups.load()
+        if sp is not None:
+            new_idx, new_bids, nb, any_residual = sp.index_dedup(
+                flt_l, cids, rows_l, self._bucket_of, self._bucket_rows,
+                self._row_bucket, self._bucket_free, self.residual_rows,
+                nb0,
+            )
+        else:
+            cid_l = cids.tolist()
+            new_bids = []
+            new_idx = []
+            # hot loop: locals bound once; only dict bookkeeping here
+            bucket_of = self._bucket_of
+            bucket_rows = self._bucket_rows
+            row_bucket = self._row_bucket
+            bucket_free = self._bucket_free
+            residual_add = self.residual_rows.add
+            nb = nb0
+            any_residual = False
+            for i, row in enumerate(rows_l):
+                if cid_l[i] < 0:
+                    residual_add(row)
+                    any_residual = True
                     continue
-            if bucket_free:
-                bid = bucket_free.pop()
-            else:
-                bid = len(buckets)
-                buckets.append(None)
-                bucket_rows.append(None)
-            buckets[bid] = _Bucket(ws, cid, 0, 0, -1)
-            bucket_rows[bid] = {row}
-            bucket_of[ws] = bid
-            row_bucket[row] = bid
-            class_buckets[cid] += 1
-            live += 1
-            new_bids.append(bid)
-            new_idx.append(i)
-            new_cids.append(cid)
-        self._live = live
+                f = flt_l[i]
+                bid = bucket_of.get(f)
+                if bid is not None:
+                    rs = bucket_rows[bid]
+                    if isinstance(rs, set):
+                        rs.add(row)
+                    elif rs != row:
+                        bucket_rows[bid] = {rs, row}
+                    row_bucket[row] = bid
+                    continue
+                if bucket_free:
+                    bid = bucket_free.pop()
+                    bucket_rows[bid] = row
+                else:
+                    bid = nb
+                    nb += 1
+                    bucket_rows.append(row)
+                bucket_of[f] = bid
+                row_bucket[row] = bid
+                new_bids.append(bid)
+                new_idx.append(i)
+        if any_residual:
+            self.residual_dirty = True
         if not new_bids:
             return
-        h1s, fps = _hash_host_batch(
-            np.asarray(new_cids, np.uint32), xs[new_idx]
-        )
-        h1_l, fp_l = h1s.tolist(), fps.tolist()
-        for j, bid in enumerate(new_bids):
-            b = self._buckets[bid]
-            b.h1, b.fp = h1_l[j], fp_l[j]
+        if nb > nb0:
+            self._bkt_ws.extend([None] * (nb - nb0))
+            self._grow_bucket_arrays(nb)
+        bkt_ws = self._bkt_ws
+        for i, bid in zip(new_idx, new_bids):
+            # store the string; bucket_filter materializes the words
+            # tuple lazily on first match-side use
+            bkt_ws[bid] = flt_l[i]
+        sel = np.asarray(new_idx, np.int64)
+        bb = np.asarray(new_bids, np.int64)
+        ncids = cids[sel]
+        h1s, fps = _hash_host_batch(ncids.astype(np.uint32), xs[sel])
+        self._bkt_cid[bb] = ncids
+        self._bkt_h1[bb] = h1s
+        self._bkt_fp[bb] = fps
+        self._bkt_slot[bb] = -1
+        np.add.at(self._class_buckets, ncids, 1)
+        self._live += len(new_bids)
         if self._live * BULK_LOAD_DEN > self.n_slots * BULK_LOAD_NUM:
             # grow once for the whole burst — the new buckets are
             # already registered, so the rebuild seats them too
@@ -574,7 +680,7 @@ class ClassIndex:
                 need *= 2
             self._rebuild(need)
             return
-        self._place_bulk(h1s, fps, np.asarray(new_bids, np.int32))
+        self._place_bulk(h1s, fps, bb.astype(np.int32))
 
     def _place_bulk(
         self, h1: np.ndarray, fp: np.ndarray, bids: np.ndarray
@@ -626,15 +732,12 @@ class ClassIndex:
             keep = np.ones(len(pending), bool)
             keep[sel] = False
             pending = pending[keep]
-        pos_l = pos.tolist()
-        bid_l = bids.tolist()
-        for i in range(n):
-            if pos_l[i] >= 0:
-                self._buckets[bid_l[i]].slot = pos_l[i]
+        seated = pos >= 0
+        self._bkt_slot[bids[seated].astype(np.int64)] = pos[seated]
         if touched:
             allsl = np.concatenate(touched)
             _refresh_probe_many(slots, np.unique(allsl // BUCKET_W))
-            self.dirty_slots.update(allsl.tolist())
+            self.dirty_slots.extend(allsl.tolist())
         if stragglers:
             # batched eviction walks: share one dirty set, then ONE
             # probe-refresh + repatch pass (per-key _place paid ~30us
@@ -645,7 +748,7 @@ class ClassIndex:
                     slots, n_buckets, int(b1[i]), int(fp[i]), int(bids[i]),
                     dirty=dirty,
                 ):
-                    self.dirty_slots.update(dirty)
+                    self.dirty_slots.extend(dirty)
                     self._rebuild(self.n_buckets * 2)
                     return
             _refresh_probe_many(
@@ -654,7 +757,7 @@ class ClassIndex:
                     np.fromiter(dirty, np.int64, len(dirty)) // BUCKET_W
                 ),
             )
-            self.dirty_slots.update(dirty)
+            self.dirty_slots.extend(dirty)
             self._repatch_slots(dirty)
 
     def remove_row(self, row: int) -> None:
@@ -663,38 +766,57 @@ class ClassIndex:
             self.residual_rows.discard(row)
             self.residual_dirty = True
             return
-        bid = self._row_bucket.pop(row)
+        bid = int(self._row_bucket[row])
+        assert bid >= 0, f"row {row} not indexed"
+        self._row_bucket[row] = -1
         rows = self._bucket_rows[bid]
-        rows.discard(row)
-        if rows:
-            return
-        b = self._buckets[bid]
-        assert b is not None
-        if b.slot >= 0:
-            self.slots.bucket[b.slot] = -1  # cuckoo: plain delete
+        if isinstance(rows, set):
+            rows.discard(row)
+            if rows:
+                if len(rows) == 1:  # demote back to the bare-int form
+                    self._bucket_rows[bid] = next(iter(rows))
+                return
+        elif rows != row:
+            return  # stale/foreign row: bucket still owned by another
+        ws = self._bkt_ws[bid]
+        assert ws is not None
+        key = ws if type(ws) is str else "/".join(ws)
+        slot = int(self._bkt_slot[bid])
+        if slot >= 0:
+            self.slots.bucket[slot] = -1  # cuckoo: plain delete
             # zero the fingerprint too: phase 2 trusts fp matches and
             # fetches the bucket id only for the winning lane, so a
             # stale fp in a vacated slot could outrank the true lane
-            self.slots.fp[b.slot] = 0
-            _refresh_probe(self.slots, b.slot // BUCKET_W)
-            self.dirty_slots.add(b.slot)
+            self.slots.fp[slot] = 0
+            _refresh_probe(self.slots, slot // BUCKET_W)
+            self.dirty_slots.append(slot)
         self._live -= 1
-        del self._bucket_of[b.filter_words]
-        self._buckets[bid] = None
+        del self._bucket_of[key]
+        self._bkt_ws[bid] = None
         self._bucket_free.append(bid)
-        self._class_buckets[b.class_id] -= 1
-        if self._class_buckets[b.class_id] == 0:
-            self._retire_class(b.class_id)
+        cid = int(self._bkt_cid[bid])
+        self._class_buckets[cid] -= 1
+        if self._class_buckets[cid] == 0:
+            self._retire_class(cid)
 
     # --- read path (host) ----------------------------------------------
 
     def bucket_filter(self, bid: int) -> Tuple[str, ...]:
-        b = self._buckets[bid]
-        assert b is not None, f"bucket {bid} not live"
-        return b.filter_words
+        ws = self._bkt_ws[bid]
+        assert ws is not None, f"bucket {bid} not live"
+        if type(ws) is not tuple:
+            # native writers store the filter string; materialize the
+            # words tuple on first match-side use (cached thereafter)
+            ws = tuple(ws.split("/"))
+            self._bkt_ws[bid] = ws
+        return ws
 
-    def bucket_rows(self, bid: int) -> Set[int]:
-        return self._bucket_rows[bid]
+    def bucket_rows(self, bid: int):
+        """Member rows of a bucket — an iterable (tuple for the common
+        single-row bucket, set when shared). Use .update()/iteration,
+        not set operators."""
+        rs = self._bucket_rows[bid]
+        return rs if isinstance(rs, set) else (rs,)
 
     # --- internals ------------------------------------------------------
 
@@ -709,6 +831,7 @@ class ClassIndex:
             return None
         cid = self._class_free.pop()
         self._skel_class[skel] = cid
+        self._skel_packed[plen | (int(has_hash) << 6) | (plus_mask << 7)] = cid
         self.meta.plen[cid] = plen
         self.meta.has_hash[cid] = has_hash
         self.meta.root_wild[cid] = root_wild
@@ -724,13 +847,14 @@ class ClassIndex:
             int(self.meta.plus[cid]),
         )
         del self._skel_class[skel]
+        del self._skel_packed[skel[0] | (int(skel[1]) << 6) | (skel[2] << 7)]
         self.meta.active[cid] = False
         self.meta_dirty = True
         self._class_free.append(cid)
 
     def _place(self, h1: int, fp: int, bid: int) -> None:
         """Seat bucket `bid`; eviction kicks may relocate other live
-        buckets (including `bid` itself), so every _Bucket.slot record
+        buckets (including `bid` itself), so every bucket slot record
         is re-aligned from the walk's dirty set afterwards."""
         dirty: Set[int] = set()
         ok = _evict_insert(
@@ -739,36 +863,33 @@ class ClassIndex:
         )
         for b in {s // BUCKET_W for s in dirty}:
             _refresh_probe(self.slots, b)
-        self.dirty_slots.update(dirty)  # partial kicks still synced
+        self.dirty_slots.extend(dirty)  # partial kicks still synced
         self._repatch_slots(dirty)
         if not ok:
             raise _NeedRebuild
 
     def _repatch_slots(self, touched: Set[int]) -> None:
-        """After eviction kicks, realign _Bucket.slot with the array."""
-        for s in touched:
-            cur = int(self.slots.bucket[s])
-            if cur >= 0:
-                b = self._buckets[cur]
-                if b is not None:
-                    b.slot = s
+        """After eviction kicks, realign bucket slot records with the
+        array (vectorized — each live bid occupies exactly one slot)."""
+        if not touched:
+            return
+        ts = np.fromiter(touched, np.int64, len(touched))
+        cur = self.slots.bucket[ts].astype(np.int64)
+        m = cur >= 0
+        self._bkt_slot[cur[m]] = ts[m]
 
     def _rebuild(self, n_buckets: int) -> None:
         """Vectorized global re-place into >= n_buckets buckets."""
-        bids = [i for i, b in enumerate(self._buckets) if b is not None]
-        h1s = np.fromiter(
-            (self._buckets[i].h1 for i in bids), np.uint32, len(bids)
+        bids = np.fromiter(
+            self._bucket_of.values(), np.int64, len(self._bucket_of)
         )
-        fps = np.fromiter(
-            (self._buckets[i].fp for i in bids), np.uint32, len(bids)
-        )
-        ids = np.asarray(bids, np.int32)
         slots, pos, n_buckets = build_slots(
-            h1s, fps, ids, min_buckets=max(n_buckets, self._min_buckets)
+            self._bkt_h1[bids],
+            self._bkt_fp[bids],
+            bids.astype(np.int32),
+            min_buckets=max(n_buckets, self._min_buckets),
         )
-        pos_l = pos.tolist()
-        for i, bid in enumerate(bids):
-            self._buckets[bid].slot = pos_l[i]
+        self._bkt_slot[bids] = pos
         self.n_buckets = n_buckets
         self.slots = slots
         self.dirty_slots.clear()

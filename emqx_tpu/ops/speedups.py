@@ -1,0 +1,65 @@
+"""Loader for the `_emqx_speedups` CPython extension (native/speedups.cc).
+
+The extension implements the route-churn hot loops (filter wildness
+scan, split+intern encoding, class-index dedup bookkeeping) against
+the CPython C API, mutating the SAME dicts/lists/sets the pure-python
+implementations use — so callers can mix freely and fall back when no
+toolchain is present (load() returns None).
+
+Build: `make -C native _emqx_speedups.so` (invoked automatically, an
+mtime no-op when fresh)."""
+
+from __future__ import annotations
+
+import importlib.machinery
+import importlib.util
+import os
+import subprocess
+from typing import Optional
+
+_NATIVE_DIR = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..", "native")
+)
+_SO = os.path.join(_NATIVE_DIR, "_emqx_speedups.so")
+
+_mod = None
+_tried = False
+
+
+def load(build: bool = True):
+    global _mod, _tried
+    if _mod is not None or _tried:
+        return _mod
+    if os.environ.get("EMQX_TPU_NO_SPEEDUPS"):
+        _tried = True
+        return None
+    _tried = True
+    if build:
+        try:
+            subprocess.run(
+                ["make", "-C", _NATIVE_DIR, "_emqx_speedups.so"],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+        except Exception:
+            pass
+    if not os.path.exists(_SO):
+        return None
+    try:
+        loader = importlib.machinery.ExtensionFileLoader("_emqx_speedups", _SO)
+        spec = importlib.util.spec_from_file_location(
+            "_emqx_speedups", _SO, loader=loader
+        )
+        assert spec is not None
+        mod = importlib.util.module_from_spec(spec)
+        loader.exec_module(mod)
+        # committed .so built for a different interpreter ABI would
+        # have failed the import above; a quick self-check guards
+        # against silent miscompiles
+        if mod.wild_flags([("a/+", 0), ("a/b", 0)]) != [True, False]:
+            return None
+        _mod = mod
+    except Exception:
+        _mod = None
+    return _mod

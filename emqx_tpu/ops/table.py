@@ -30,8 +30,9 @@ from typing import Iterator, List, NamedTuple, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
+from . import speedups as _speedups
 from . import topic as topic_mod
-from .vocab import OOV, Vocab
+from .vocab import OOV, PLUS, Vocab
 
 DEFAULT_MAX_LEVELS = 16
 MIN_CAPACITY = 1024
@@ -70,10 +71,16 @@ class FilterTable:
         self.root_wild = np.zeros(capacity, bool)
         self.active = np.zeros(capacity, bool)
         self._filters: List[Optional[Tuple[str, ...]]] = [None] * capacity
+        # canonical filter string per row (== '/'.join(_filters[row])):
+        # the class index keys its dedup map by string, and a stored
+        # reference beats a join per insert on the churn path
+        self._fstr: List[Optional[str]] = [None] * capacity
         self._free: List[int] = list(range(capacity - 1, -1, -1))
         self._count = 0
-        # rows touched since the last drain; consumed by the device sync
-        self.dirty: Set[int] = set()
+        # rows touched since the last drain; consumed by the device
+        # sync.  A LIST (duplicates deduped at drain): appends are the
+        # churn hot path and the native core extends it wholesale
+        self.dirty: List[int] = []
         self.grew = False  # capacity changed since last drain → full upload
 
     def __len__(self) -> int:
@@ -101,16 +108,26 @@ class FilterTable:
         )
         self.active[row] = True
         self._filters[row] = ws
+        self._fstr[row] = flt
         self._count += 1
-        self.dirty.add(row)
+        self.dirty.append(row)
         return row
 
-    def add_bulk(self, filters: Sequence[str]) -> List[int]:
+    def add_bulk(
+        self,
+        filters: Sequence[str],
+        parts: Optional[Sequence[List[str]]] = None,
+    ) -> List[int]:
         """Batch add: one vectorized scatter for the whole burst
-        instead of ~5 numpy scalar writes per row. Returns one row id
-        per filter, -1 where the filter is too deep (the caller's
+        instead of ~5 numpy scalar writes per row, with interning
+        refcounts batched through one Counter.update. Returns one row
+        id per filter, -1 where the filter is too deep (the caller's
         FilterTooDeep degradation, kept in-band so one bad filter
-        doesn't abort the batch)."""
+        doesn't abort the batch). `parts` (when given) carries the
+        filters pre-split so storm callers split each string once."""
+        sp = _speedups.load()
+        if sp is not None:
+            return self._add_bulk_native(sp, filters)
         L = self.max_levels
         pad = [OOV] * L
         rows: List[int] = []
@@ -119,27 +136,43 @@ class FilterTable:
         hh_b: List[bool] = []
         rw_b: List[bool] = []
         kept_rows: List[int] = []
-        intern = self.vocab.intern
-        for flt in filters:
-            ws = topic_mod.words(flt)
+        vocab = self.vocab
+        vocab.ensure_refs(vocab._next + len(filters) * (L + 1))
+        get_id = vocab._ids.get
+        create = vocab._create
+        all_ids: List[int] = []
+        ai_extend = all_ids.extend
+        filters_store = self._filters
+        fstr_store = self._fstr
+        free = self._free
+        for j, flt in enumerate(filters):
+            ws = parts[j] if parts is not None else flt.split("/")
             hh = ws[-1] == "#"
             prefix = ws[:-1] if hh else ws
-            if len(prefix) > L:
+            np_ = len(prefix)
+            if np_ > L:
                 rows.append(-1)
                 continue
-            while not self._free:
+            while not free:
                 self._grow()
-            row = self._free.pop()
-            ids = [intern(w) for w in prefix]
-            padded.append(ids + pad[len(ids):])
-            plen_b.append(len(prefix))
+                free = self._free
+            row = free.pop()
+            # real ids are >=1, so `or` only fires on a miss (None)
+            ids = [
+                get_id(w) or (PLUS if w == "+" else create(w))
+                for w in prefix
+            ]
+            ai_extend(ids)
+            padded.append(ids + pad[np_:])
+            plen_b.append(np_)
             hh_b.append(hh)
-            rw_b.append(
-                (hh and not prefix) or (bool(prefix) and prefix[0] == "+")
-            )
-            self._filters[row] = ws
+            rw_b.append((hh and not prefix) or (np_ > 0 and prefix[0] == "+"))
+            filters_store[row] = tuple(ws)
+            fstr_store[row] = flt
             rows.append(row)
             kept_rows.append(row)
+        if all_ids:
+            vocab.bump_many(all_ids)
         if kept_rows:
             rr = np.asarray(kept_rows, np.int64)
             self.words[rr] = np.asarray(padded, np.int32)
@@ -148,12 +181,57 @@ class FilterTable:
             self.root_wild[rr] = rw_b
             self.active[rr] = True
             self._count += len(kept_rows)
-            self.dirty.update(kept_rows)
+            self.dirty.extend(kept_rows)
+        return rows
+
+    def _add_bulk_native(self, sp, filters: Sequence[str]) -> List[int]:
+        """add_bulk with the split/intern/encode pass in C
+        (native/speedups.cc encode_filters): the C side mutates the
+        vocab's own dicts, so state is identical to the python path."""
+        L = self.max_levels
+        v = self.vocab
+        v.ensure_refs(v._next + len(filters) * (L + 1))
+        # the C side reads and writes v._next itself so a partial batch
+        # can never leave created words ahead of a stale counter
+        ws_l, ids_b, plen_b, hh_b, rw_b = sp.encode_filters(filters, v, L)
+        plen = np.frombuffer(plen_b, np.int32)
+        keep_l = (plen >= 0).tolist()
+        rows: List[int] = []
+        kept_rows: List[int] = []
+        r_append = rows.append
+        k_append = kept_rows.append
+        free = self._free
+        filters_store = self._filters
+        fstr_store = self._fstr
+        for j, flt in enumerate(filters):
+            if not keep_l[j]:
+                r_append(-1)
+                continue
+            while not free:
+                self._grow()
+            row = free.pop()
+            filters_store[row] = ws_l[j]
+            fstr_store[row] = flt
+            r_append(row)
+            k_append(row)
+        if kept_rows:
+            rr = np.asarray(kept_rows, np.int64)
+            sel = np.flatnonzero(plen >= 0)
+            ids = np.frombuffer(ids_b, np.int32).reshape(-1, L)
+            # C memsets padding to 0 == OOV, matching the python path
+            self.words[rr] = ids[sel]
+            self.prefix_len[rr] = plen[sel]
+            self.has_hash[rr] = np.frombuffer(hh_b, np.uint8)[sel].astype(bool)
+            self.root_wild[rr] = np.frombuffer(rw_b, np.uint8)[sel].astype(bool)
+            self.active[rr] = True
+            self._count += len(kept_rows)
+            self.dirty.extend(kept_rows)
         return rows
 
     def remove(self, row: int) -> None:
-        ws = self._filters[row]
-        assert ws is not None and self.active[row], f"row {row} not live"
+        fs = self._fstr[row]
+        assert fs is not None and self.active[row], f"row {row} not live"
+        ws = fs.split("/")
         hh = ws[-1] == "#"
         for w in ws[:-1] if hh else ws:
             self.vocab.release(w)
@@ -163,14 +241,26 @@ class FilterTable:
         self.has_hash[row] = False
         self.root_wild[row] = False
         self._filters[row] = None
+        self._fstr[row] = None
         self._free.append(row)
         self._count -= 1
-        self.dirty.add(row)
+        self.dirty.append(row)
 
     def filter_words(self, row: int) -> Tuple[str, ...]:
         ws = self._filters[row]
-        assert ws is not None, f"row {row} not live"
+        if ws is None:
+            # native bulk writers store only the string; materialize
+            # (and cache) the words tuple on first host-side use
+            fs = self._fstr[row]
+            assert fs is not None, f"row {row} not live"
+            ws = tuple(fs.split("/"))
+            self._filters[row] = ws
         return ws
+
+    def filter_str(self, row: int) -> str:
+        fs = self._fstr[row]
+        assert fs is not None, f"row {row} not live"
+        return fs
 
     def rows(self) -> Iterator[int]:
         """Iterate live row ids."""
@@ -184,8 +274,7 @@ class FilterTable:
 
     def drain_dirty(self) -> np.ndarray:
         """Return-and-clear the dirty row ids (sorted int32 array)."""
-        rows = np.fromiter(self.dirty, np.int32, len(self.dirty))
-        rows.sort()
+        rows = np.unique(np.asarray(self.dirty, np.int32))
         self.dirty.clear()
         self.grew = False
         return rows
@@ -201,6 +290,7 @@ class FilterTable:
         self.root_wild = np.concatenate([self.root_wild, np.zeros(old, bool)])
         self.active = np.concatenate([self.active, np.zeros(old, bool)])
         self._filters.extend([None] * old)
+        self._fstr.extend([None] * old)
         self._free.extend(range(new - 1, old - 1, -1))
         self.capacity = new
         self.grew = True

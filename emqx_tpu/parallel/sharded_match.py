@@ -442,8 +442,7 @@ class ShardedDeviceTable:
             )
             ix.rebuilt = False
         elif ix.dirty_slots:
-            dirty = np.fromiter(ix.dirty_slots, np.int32, len(ix.dirty_slots))
-            dirty.sort()
+            dirty = np.unique(np.asarray(ix.dirty_slots, np.int32))
             ix.dirty_slots.clear()
             total = len(dirty)
             k = self.DELTA_BATCH

@@ -1,0 +1,1181 @@
+// _emqx_speedups — CPython C extension for the route-churn hot loops.
+//
+// The reference broker sustains ~500k route inserts/s on the BEAM
+// (apps/emqx/src/emqx_broker_bench.erl:64-66 InsertRps); matching that
+// through a Python router means the per-route string work (split,
+// vocab intern, wildcard classification) and the per-route dict
+// bookkeeping cannot run as CPython bytecode.  This module implements
+// exactly those loops against the CPython C API, operating on the
+// SAME dict/list/set objects the pure-python fallbacks use — there is
+// no duplicated state, so either implementation can take any batch.
+//
+// Functions:
+//   wild_flags(pairs)        -> list[bool]   (filter wildness per pair)
+//   encode_filters(...)      -> encoded arrays + word tuples (interning)
+//   index_dedup(...)         -> class-index dedup/bucket bookkeeping
+//
+// Build: make -C native _emqx_speedups.so   (see Makefile; loaded via
+// importlib ExtensionFileLoader from emqx_tpu/ops/_speedups.py with a
+// pure-python fallback when no toolchain is present).
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <initializer_list>
+
+namespace {
+
+// ---------------------------------------------------------------------
+// wild_flags(pairs: list[(filter, dest)]) -> list[bool]
+//
+// A filter is wild iff some '/'-delimited word is exactly "+" or "#"
+// (emqx_topic.erl:65-77).  One UTF-8 scan per filter, no split.
+
+static bool word_wild_scan(const char *s, Py_ssize_t n) {
+  Py_ssize_t i = 0;
+  while (i <= n) {
+    // word = s[i..j) up to next '/' or end
+    Py_ssize_t j = i;
+    while (j < n && s[j] != '/') j++;
+    if (j - i == 1 && (s[i] == '+' || s[i] == '#')) return true;
+    if (j >= n) break;
+    i = j + 1;
+    if (i == n) {  // trailing '/': final empty word, not wild
+      break;
+    }
+  }
+  return false;
+}
+
+static PyObject *wild_flags(PyObject *, PyObject *args) {
+  PyObject *pairs;
+  if (!PyArg_ParseTuple(args, "O", &pairs)) return nullptr;
+  PyObject *seq = PySequence_Fast(pairs, "pairs must be a sequence");
+  if (!seq) return nullptr;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  PyObject *out = PyList_New(n);
+  if (!out) {
+    Py_DECREF(seq);
+    return nullptr;
+  }
+  for (Py_ssize_t k = 0; k < n; k++) {
+    PyObject *pair = PySequence_Fast_GET_ITEM(seq, k);
+    PyObject *flt;
+    if (PyTuple_Check(pair) && PyTuple_GET_SIZE(pair) >= 1) {
+      flt = PyTuple_GET_ITEM(pair, 0);
+    } else {
+      flt = PySequence_GetItem(pair, 0);
+      if (!flt) {
+        Py_DECREF(seq);
+        Py_DECREF(out);
+        return nullptr;
+      }
+      Py_DECREF(flt);  // borrowed-enough: pair keeps it alive
+    }
+    Py_ssize_t len;
+    const char *s = PyUnicode_AsUTF8AndSize(flt, &len);
+    if (!s) {
+      Py_DECREF(seq);
+      Py_DECREF(out);
+      return nullptr;
+    }
+    PyObject *b = word_wild_scan(s, len) ? Py_True : Py_False;
+    Py_INCREF(b);
+    PyList_SET_ITEM(out, k, b);
+  }
+  Py_DECREF(seq);
+  return out;
+}
+
+// ---------------------------------------------------------------------
+// encode_filters(filters, vocab, L)
+//   -> (ws_list, ids_bytes, plen_bytes, hh_bytes, rw_bytes)
+//
+// Mirrors FilterTable.add_bulk's string pass + Vocab interning
+// bit-for-bit: trailing '#' strips to has_hash, '+' encodes as PLUS=1
+// without interning, every other word get-or-creates an id in
+// ids_dict/words_dict (recycling from free_list first) and bumps its
+// refcount in refs_dict.  Too-deep rows (prefix > L) emit plen=-1 and
+// touch nothing.  ids_bytes is int32[B,L] row-major (0-padded is NOT
+// done here — caller pads with OOV via numpy where plen>=0).
+
+static const int32_t kPlus = 1;  // vocab.PLUS
+
+struct Buf {
+  Py_buffer b{};
+  bool ok = false;
+  bool get(PyObject *o, int flags = PyBUF_CONTIG) {
+    ok = o && PyObject_GetBuffer(o, &b, flags) == 0;
+    return ok;
+  }
+  ~Buf() {
+    if (ok) PyBuffer_Release(&b);
+  }
+};
+
+struct Ref {
+  PyObject *p = nullptr;
+  ~Ref() { Py_XDECREF(p); }
+};
+
+
+static PyObject *encode_filters(PyObject *, PyObject *args) {
+  PyObject *filters, *vocab;
+  int L;
+  if (!PyArg_ParseTuple(args, "OOi", &filters, &vocab, &L)) return nullptr;
+  // fetch vocab state through the object so next_id can be written
+  // back on EVERY exit — a partial batch must never leave created
+  // words ahead of a stale _next (id aliasing)
+  Ref r_ids, r_words, r_vfree, r_refs;
+  r_ids.p = PyObject_GetAttrString(vocab, "_ids");
+  r_words.p = PyObject_GetAttrString(vocab, "_words");
+  r_vfree.p = PyObject_GetAttrString(vocab, "_free");
+  r_refs.p = PyObject_GetAttrString(vocab, "_refs");
+  if (!r_ids.p || !r_words.p || !r_vfree.p || !r_refs.p) return nullptr;
+  PyObject *ids_dict = r_ids.p, *words_dict = r_words.p,
+           *free_list = r_vfree.p;
+  int64_t next_id;
+  {
+    PyObject *nobj = PyObject_GetAttrString(vocab, "_next");
+    if (!nobj) return nullptr;
+    next_id = PyLong_AsLongLong(nobj);
+    Py_DECREF(nobj);
+  }
+  Py_buffer refs_buf;
+  if (PyObject_GetBuffer(r_refs.p, &refs_buf, PyBUF_CONTIG) < 0)
+    return nullptr;
+  int64_t *refs = (int64_t *)refs_buf.buf;
+  Py_ssize_t refs_cap = refs_buf.len / (Py_ssize_t)sizeof(int64_t);
+  PyObject *seq = PySequence_Fast(filters, "filters must be a sequence");
+  if (!seq) {
+    PyBuffer_Release(&refs_buf);
+    return nullptr;
+  }
+  Py_ssize_t B = PySequence_Fast_GET_SIZE(seq);
+
+  PyObject *ws_list = PyList_New(B);
+  PyObject *ids_b = PyBytes_FromStringAndSize(nullptr, B * (Py_ssize_t)L * 4);
+  PyObject *plen_b = PyBytes_FromStringAndSize(nullptr, B * 4);
+  PyObject *hh_b = PyBytes_FromStringAndSize(nullptr, B);
+  PyObject *rw_b = PyBytes_FromStringAndSize(nullptr, B);
+  if (!ws_list || !ids_b || !plen_b || !hh_b || !rw_b) goto fail;
+  {
+    int32_t *ids_p = (int32_t *)PyBytes_AS_STRING(ids_b);
+    int32_t *plen_p = (int32_t *)PyBytes_AS_STRING(plen_b);
+    uint8_t *hh_p = (uint8_t *)PyBytes_AS_STRING(hh_b);
+    uint8_t *rw_p = (uint8_t *)PyBytes_AS_STRING(rw_b);
+    memset(ids_p, 0, B * (size_t)L * 4);
+    // immortal split separator (created once per process)
+    static PyObject *g_sep = nullptr;
+    if (!g_sep) {
+      g_sep = PyUnicode_InternFromString("/");
+      if (!g_sep) goto fail;
+    }
+
+    for (Py_ssize_t k = 0; k < B; k++) {
+      PyObject *flt = PySequence_Fast_GET_ITEM(seq, k);
+      if (!PyUnicode_Check(flt)) {
+        PyErr_SetString(PyExc_TypeError, "filter must be str");
+        goto fail;
+      }
+      PyObject *ws = PyUnicode_Split(flt, g_sep, -1);
+      if (!ws) goto fail;
+      Py_ssize_t nw = PyList_GET_SIZE(ws);
+      PyObject *last = PyList_GET_ITEM(ws, nw - 1);
+      int hh = (PyUnicode_GetLength(last) == 1 &&
+                PyUnicode_ReadChar(last, 0) == '#');
+      Py_ssize_t plen = hh ? nw - 1 : nw;
+      PyObject *ws_tuple = PyList_AsTuple(ws);
+      Py_DECREF(ws);
+      if (!ws_tuple) goto fail;
+      PyList_SET_ITEM(ws_list, k, ws_tuple);  // steals
+      if (plen > L) {
+        plen_p[k] = -1;
+        hh_p[k] = (uint8_t)hh;
+        rw_p[k] = 0;
+        continue;
+      }
+      int rw = (hh && plen == 0);
+      int32_t *row = ids_p + (size_t)k * L;
+      for (Py_ssize_t i = 0; i < plen; i++) {
+        PyObject *w = PyTuple_GET_ITEM(ws_tuple, i);
+        if (PyUnicode_GetLength(w) == 1 && PyUnicode_ReadChar(w, 0) == '+') {
+          row[i] = kPlus;
+          if (i == 0) rw = 1;
+          continue;
+        }
+        PyObject *wid = PyDict_GetItemWithError(ids_dict, w);  // borrowed
+        int64_t id;
+        if (wid) {
+          id = PyLong_AsLongLong(wid);
+        } else {
+          if (PyErr_Occurred()) goto fail;
+          // new word: recycle from free_list, else next_id++
+          PyObject *idobj;
+          Py_ssize_t nf = PyList_GET_SIZE(free_list);
+          if (nf > 0) {
+            idobj = PyList_GET_ITEM(free_list, nf - 1);
+            Py_INCREF(idobj);
+            if (PyList_SetSlice(free_list, nf - 1, nf, nullptr) < 0) {
+              Py_DECREF(idobj);
+              goto fail;
+            }
+            id = PyLong_AsLongLong(idobj);
+          } else {
+            id = next_id++;
+            idobj = PyLong_FromLongLong(id);
+            if (!idobj) goto fail;
+          }
+          if (PyDict_SetItem(ids_dict, w, idobj) < 0 ||
+              PyDict_SetItem(words_dict, idobj, w) < 0) {
+            Py_DECREF(idobj);
+            goto fail;
+          }
+          Py_DECREF(idobj);
+        }
+        row[i] = (int32_t)id;
+        // refcount bump on the flat id-indexed array (caller pre-grew)
+        if (id < 0 || id >= refs_cap) {
+          PyErr_SetString(PyExc_ValueError, "refs array too small");
+          goto fail;
+        }
+        refs[id]++;
+      }
+      plen_p[k] = (int32_t)plen;
+      hh_p[k] = (uint8_t)hh;
+      rw_p[k] = (uint8_t)rw;
+    }
+  }
+  {
+    PyObject *nv = PyLong_FromLongLong(next_id);
+    if (nv) {
+      PyObject_SetAttrString(vocab, "_next", nv);
+      Py_DECREF(nv);
+    }
+    PyObject *out = Py_BuildValue("(NNNNN)", ws_list, ids_b, plen_b, hh_b,
+                                  rw_b);
+    PyBuffer_Release(&refs_buf);
+    Py_DECREF(seq);
+    return out;
+  }
+fail : {
+  // keep _next consistent even on a partial batch (see fetch comment)
+  PyObject *etype, *eval, *etb;
+  PyErr_Fetch(&etype, &eval, &etb);
+  PyObject *nv = PyLong_FromLongLong(next_id);
+  if (nv) {
+    PyObject_SetAttrString(vocab, "_next", nv);
+    Py_DECREF(nv);
+  }
+  PyErr_Restore(etype, eval, etb);
+}
+  PyBuffer_Release(&refs_buf);
+  Py_DECREF(seq);
+  Py_XDECREF(ws_list);
+  Py_XDECREF(ids_b);
+  Py_XDECREF(plen_b);
+  Py_XDECREF(hh_b);
+  Py_XDECREF(rw_b);
+  return nullptr;
+}
+
+// ---------------------------------------------------------------------
+// index_dedup(flts, cids_buf, rows, bucket_of, bucket_rows, row_bucket,
+//             bucket_free, residual_set, nb0)
+//   -> (new_idx: list[int], new_bids: list[int], nb, any_residual)
+//
+// The per-row dict/set bookkeeping of ClassIndex.add_rows: residual
+// routing for cid<0 rows, dedup against bucket_of (string keys),
+// bucket allocation from the free list (appending None placeholders
+// to bucket_rows for fresh ids — caller extends its parallel arrays
+// from nb0 to nb afterwards).
+
+static PyObject *index_dedup(PyObject *, PyObject *args) {
+  PyObject *flts, *cids_obj, *rows, *bucket_of, *bucket_rows, *row_bucket,
+      *bucket_free, *residual;
+  long nb0_l;
+  if (!PyArg_ParseTuple(args, "OOOO!O!OO!O!l", &flts, &cids_obj, &rows,
+                        &PyDict_Type, &bucket_of, &PyList_Type, &bucket_rows,
+                        &row_bucket, &PyList_Type, &bucket_free,
+                        &PySet_Type, &residual, &nb0_l))
+    return nullptr;
+  Py_buffer cb;
+  if (PyObject_GetBuffer(cids_obj, &cb, PyBUF_CONTIG_RO) < 0) return nullptr;
+  const int64_t *cids = (const int64_t *)cb.buf;
+  Py_buffer rbb;
+  if (PyObject_GetBuffer(row_bucket, &rbb, PyBUF_CONTIG) < 0) {
+    PyBuffer_Release(&cb);
+    return nullptr;
+  }
+  int64_t *rowbkt = (int64_t *)rbb.buf;
+  PyObject *fseq = PySequence_Fast(flts, "flts must be a sequence");
+  PyObject *rseq = PySequence_Fast(rows, "rows must be a sequence");
+  PyObject *new_idx = PyList_New(0);
+  PyObject *new_bids = PyList_New(0);
+  long nb = nb0_l;
+  int any_residual = 0;
+  if (!fseq || !rseq || !new_idx || !new_bids) goto fail;
+  {
+    Py_ssize_t B = PySequence_Fast_GET_SIZE(fseq);
+    if ((Py_ssize_t)(cb.len / (Py_ssize_t)sizeof(int64_t)) < B ||
+        PySequence_Fast_GET_SIZE(rseq) < B) {
+      PyErr_SetString(PyExc_ValueError, "length mismatch");
+      goto fail;
+    }
+    for (Py_ssize_t i = 0; i < B; i++) {
+      PyObject *row = PySequence_Fast_GET_ITEM(rseq, i);  // borrowed int
+      if (cids[i] < 0) {
+        if (PySet_Add(residual, row) < 0) goto fail;
+        any_residual = 1;
+        continue;
+      }
+      PyObject *f = PySequence_Fast_GET_ITEM(fseq, i);
+      PyObject *bid = PyDict_GetItemWithError(bucket_of, f);  // borrowed
+      if (bid) {
+        // duplicate filter: join the existing bucket's row set
+        long b = PyLong_AsLong(bid);
+        PyObject *rs = PyList_GET_ITEM(bucket_rows, b);
+        if (PySet_Check(rs)) {
+          if (PySet_Add(rs, row) < 0) goto fail;
+        } else if (PyObject_RichCompareBool(rs, row, Py_NE) == 1) {
+          PyObject *ns = PySet_New(nullptr);
+          if (!ns || PySet_Add(ns, rs) < 0 || PySet_Add(ns, row) < 0) {
+            Py_XDECREF(ns);
+            goto fail;
+          }
+          PyList_SetItem(bucket_rows, b, ns);
+        }
+        rowbkt[PyLong_AsLong(row)] = b;
+        continue;
+      }
+      if (PyErr_Occurred()) goto fail;
+      long b;
+      PyObject *bobj;
+      Py_ssize_t nf = PyList_GET_SIZE(bucket_free);
+      if (nf > 0) {
+        bobj = PyList_GET_ITEM(bucket_free, nf - 1);
+        Py_INCREF(bobj);
+        if (PyList_SetSlice(bucket_free, nf - 1, nf, nullptr) < 0) {
+          Py_DECREF(bobj);
+          goto fail;
+        }
+        b = PyLong_AsLong(bobj);
+        Py_INCREF(row);
+        PyList_SetItem(bucket_rows, b, row);
+      } else {
+        b = nb++;
+        bobj = PyLong_FromLong(b);
+        if (!bobj || PyList_Append(bucket_rows, row) < 0) {
+          Py_XDECREF(bobj);
+          goto fail;
+        }
+      }
+      if (PyDict_SetItem(bucket_of, f, bobj) < 0) {
+        Py_DECREF(bobj);
+        goto fail;
+      }
+      Py_DECREF(bobj);
+      rowbkt[PyLong_AsLong(row)] = b;
+      PyObject *iobj = PyLong_FromSsize_t(i);
+      if (!iobj || PyList_Append(new_idx, iobj) < 0) {
+        Py_XDECREF(iobj);
+        goto fail;
+      }
+      Py_DECREF(iobj);
+      PyObject *b2 = PyLong_FromLong(b);
+      if (!b2 || PyList_Append(new_bids, b2) < 0) {
+        Py_XDECREF(b2);
+        goto fail;
+      }
+      Py_DECREF(b2);
+    }
+  }
+  PyBuffer_Release(&cb);
+  PyBuffer_Release(&rbb);
+  Py_DECREF(fseq);
+  Py_DECREF(rseq);
+  return Py_BuildValue("(NNlO)", new_idx, new_bids, nb,
+                       any_residual ? Py_True : Py_False);
+fail:
+  PyBuffer_Release(&cb);
+  PyBuffer_Release(&rbb);
+  Py_XDECREF(fseq);
+  Py_XDECREF(rseq);
+  Py_XDECREF(new_idx);
+  Py_XDECREF(new_bids);
+  return nullptr;
+}
+
+// ---------------------------------------------------------------------
+// add_routes_core(router, pairs) -> (fresh | None, need_rebuild)
+//
+// The ENTIRE Router.add_routes batch write path in one C pass over
+// the pairs: wildness scan, dest-dict dedup/registration, vocab
+// intern + filter-table row encode (direct numpy-buffer writes),
+// class-index add incl. the device hash (bit-identical to
+// hash_index._hash_host) and bucketized-cuckoo placement (identical
+// eviction walk to hash_index._evict_insert), and dest refcount bump.
+// Operates on the router's own dicts/lists/sets/arrays — the python
+// implementation remains the fallback and produces identical state.
+//
+// Wrapper contract (Router.add_routes enforces before calling):
+//   * table free-list holds >= len(pairs) rows (no growth mid-call)
+//   * vocab._refs covers next_id + worst-case new words
+//   * index bucket arrays pre-grown by len(pairs); slot table
+//     pre-grown so the batch cannot cross the bulk load factor
+// Returns need_rebuild=True when an eviction walk exhausted MAX_KICKS
+// (the carried key is left unseated; caller must _rebuild, which
+// re-places every bucket from its records).
+
+static const uint32_t kH1Seed = 0x811C9DC5u, kH1Cls = 0x9E3779B1u,
+                      kH1Mul = 16777619u;
+static const uint32_t kFpSeed = 0x2545F491u, kFpCls = 0x85EBCA6Bu,
+                      kFpXor = 0xC2B2AE35u, kFpMul = 0x27D4EB2Fu;
+static const uint32_t kAltMul = 0x9E3779B9u;
+static const int kBucketW = 4, kMaxKicks = 512;
+
+// pop last element of a PyList, returning a NEW reference (or null)
+static PyObject *list_pop_last(PyObject *lst) {
+  Py_ssize_t n = PyList_GET_SIZE(lst);
+  if (n == 0) {
+    PyErr_SetString(PyExc_IndexError, "pop from empty list");
+    return nullptr;
+  }
+  PyObject *it = PyList_GET_ITEM(lst, n - 1);
+  Py_INCREF(it);
+  if (PyList_SetSlice(lst, n - 1, n, nullptr) < 0) {
+    Py_DECREF(it);
+    return nullptr;
+  }
+  return it;
+}
+
+struct CoreState {
+  // router
+  PyObject *exact_t, *wild_t, *deep_t, *exact_row, *filter_row, *row_filter,
+      *exact_deep, *trie_pending_f, *trie_pending_r, *deep_trie, *on_added;
+  // table
+  PyObject *tab, *tab_free, *tab_fstr, *tab_dirty;
+  Buf words, plen, hh, rw, active;
+  long L;
+  long count_delta = 0;
+  Py_ssize_t tab_taken = 0;  // rows consumed off tab_free's tail
+  // vocab
+  PyObject *voc, *voc_ids, *voc_words, *voc_free;
+  Buf refs;
+  int64_t next_id;
+  Py_ssize_t voc_taken = 0;  // ids consumed off voc_free's tail
+  // index (optional)
+  PyObject *ix = nullptr, *skel_packed = nullptr, *bucket_of = nullptr,
+           *bucket_rows = nullptr, *bucket_free = nullptr,
+           *bkt_ws = nullptr, *residual = nullptr, *dirty_slots = nullptr;
+  Buf row_bucket, bkt_cid, bkt_h1, bkt_fp, bkt_slot, class_buckets, s_fp,
+      s_bucket, s_probe;
+  long n_buckets = 0;
+  long live_delta = 0;
+  Py_ssize_t bkt_taken = 0;  // bids consumed off bucket_free's tail
+  bool any_residual = false, need_rebuild = false;
+};
+
+// per-call word-id cache: keys point into the pairs' utf8 buffers
+// (alive for the whole call), so a hit costs one FNV hash + memcmp —
+// no PyUnicode allocation, no dict probe.  Generation counter makes
+// reset O(1) per call.
+struct WordCacheEntry {
+  const char *ptr;
+  int len;
+  uint32_t gen;
+  int64_t id;
+};
+static const int kWCBits = 13, kWCSize = 1 << kWCBits;
+static WordCacheEntry g_wcache[kWCSize];
+static uint32_t g_wgen = 0;
+
+static inline uint32_t fnv1a(const char *s, Py_ssize_t n) {
+  uint32_t h = 0x811C9DC5u;
+  for (Py_ssize_t i = 0; i < n; i++) h = (h ^ (uint8_t)s[i]) * 16777619u;
+  return h;
+}
+
+// place (fp, bid) into the cuckoo table starting from bucket b1.
+// Mirrors hash_index._evict_insert (same LCG walk); maintains probe
+// words, _bkt_slot and dirty_slots inline.  Returns false when the
+// walk exhausts (carried key unseated -> caller sets need_rebuild).
+static bool core_place(CoreState &st, uint32_t h1, uint32_t fp,
+                       int32_t bid) {
+  uint32_t mask = (uint32_t)st.n_buckets - 1;
+  uint32_t *sfp = (uint32_t *)st.s_fp.b.buf;
+  int32_t *sbkt = (int32_t *)st.s_bucket.b.buf;
+  uint32_t *sprobe = (uint32_t *)st.s_probe.b.buf;
+  int64_t *bslot = (int64_t *)st.bkt_slot.b.buf;
+  uint32_t b1 = h1 & mask;
+  uint32_t b2 = b1 ^ (((fp | 1u) * kAltMul) & mask);
+  auto write = [&](long slot, uint32_t f, int32_t id) -> bool {
+    sfp[slot] = f;
+    sbkt[slot] = id;
+    long b = slot / kBucketW, lane = slot % kBucketW;
+    uint32_t byte = f >> 24;
+    if (byte == 0) byte = 1;
+    sprobe[b] = (sprobe[b] & ~(0xFFu << (8 * lane))) | (byte << (8 * lane));
+    bslot[id] = slot;
+    PyObject *s = PyLong_FromLong(slot);
+    if (!s) return false;
+    int rc = PyList_Append(st.dirty_slots, s);
+    Py_DECREF(s);
+    return rc == 0;
+  };
+  for (uint32_t b : {b1, b2}) {
+    long base = (long)b * kBucketW;
+    for (int lane = 0; lane < kBucketW; lane++) {
+      if (sbkt[base + lane] < 0) return write(base + lane, fp, bid);
+    }
+  }
+  // both full: evict along the alternate-bucket walk
+  uint32_t seed = (b1 * 0x9E3779B1u + fp);
+  uint32_t cur = b1;
+  for (int k = 0; k < kMaxKicks; k++) {
+    seed = seed * 1103515245u + 12345u;
+    int lane = (int)((seed >> 16) % kBucketW);
+    long s = (long)cur * kBucketW + lane;
+    uint32_t vfp = sfp[s];
+    int32_t vbid = sbkt[s];
+    if (!write(s, fp, bid)) return false;  // py error -> caller sees
+    fp = vfp;
+    bid = vbid;
+    cur = cur ^ (((fp | 1u) * kAltMul) & mask);
+    long base = (long)cur * kBucketW;
+    for (int l2 = 0; l2 < kBucketW; l2++) {
+      if (sbkt[base + l2] < 0) return write(base + l2, fp, bid);
+    }
+  }
+  bslot[bid] = -1;  // carried key unseated; rebuild re-places all
+  st.need_rebuild = true;
+  return true;  // not a python error
+}
+
+// index one freshly-encoded row.  `rowobj` is the row's PyLong, `r`
+// its value; wrow/plen/hh/rw describe the encoded filter.
+static bool core_index_add(CoreState &st, PyObject *flt, PyObject *rowobj,
+                           long r, const int32_t *wrow, long plen, bool hh,
+                           bool rw) {
+  if (!st.ix) return true;
+  int64_t *rowbkt = (int64_t *)st.row_bucket.b.buf;
+  if (plen > 32) {
+    if (PySet_Add(st.residual, rowobj) < 0) return false;
+    st.any_residual = true;
+    return true;
+  }
+  PyObject *bidobj = PyDict_GetItemWithError(st.bucket_of, flt);
+  if (!bidobj && PyErr_Occurred()) return false;
+  if (bidobj) {  // same filter string indexed under another row
+    long bid = PyLong_AsLong(bidobj);
+    PyObject *rs = PyList_GET_ITEM(st.bucket_rows, bid);
+    if (PySet_Check(rs)) {
+      if (PySet_Add(rs, rowobj) < 0) return false;
+    } else if (PyObject_RichCompareBool(rs, rowobj, Py_NE) == 1) {
+      PyObject *ns = PySet_New(nullptr);
+      if (!ns || PySet_Add(ns, rs) < 0 || PySet_Add(ns, rowobj) < 0) {
+        Py_XDECREF(ns);
+        return false;
+      }
+      PyList_SetItem(st.bucket_rows, bid, ns);  // steals ns, frees rs
+    }
+    rowbkt[r] = bid;
+    return true;
+  }
+  uint64_t pm = 0;
+  for (long i = 0; i < plen; i++) {
+    if (wrow[i] == kPlus) pm |= 1ull << i;
+  }
+  uint64_t skel = (uint64_t)plen | ((uint64_t)hh << 6) | (pm << 7);
+  PyObject *skelobj = PyLong_FromUnsignedLongLong(skel);
+  if (!skelobj) return false;
+  PyObject *cidobj = PyDict_GetItemWithError(st.skel_packed, skelobj);
+  Py_DECREF(skelobj);
+  long cid;
+  if (cidobj) {
+    cid = PyLong_AsLong(cidobj);
+  } else {
+    if (PyErr_Occurred()) return false;
+    // new skeleton: let python allocate the class (meta arrays etc.)
+    PyObject *res = PyObject_CallMethod(
+        st.ix, "_class_of", "lOOK", plen, hh ? Py_True : Py_False,
+        rw ? Py_True : Py_False, (unsigned long long)pm);
+    if (!res) return false;
+    if (res == Py_None) {
+      Py_DECREF(res);
+      if (PySet_Add(st.residual, rowobj) < 0) return false;
+      st.any_residual = true;
+      return true;
+    }
+    cid = PyLong_AsLong(res);
+    Py_DECREF(res);
+  }
+  // device hash — bit-identical to hash_index._hash_host
+  uint32_t h1 = kH1Seed ^ ((uint32_t)cid * kH1Cls);
+  uint32_t fp = kFpSeed + (uint32_t)cid * kFpCls;
+  for (long i = 0; i < st.L; i++) {
+    uint32_t x = 0;
+    if (i < plen && wrow[i] != kPlus) x = (uint32_t)wrow[i] + 1;
+    h1 = (h1 ^ x) * kH1Mul;
+    fp = (fp ^ (x * kFpXor)) * kFpMul;
+  }
+  // allocate a bucket record (bare row — set allocated only on share)
+  long bid;
+  Py_ssize_t nfree = PyList_GET_SIZE(st.bucket_free) - st.bkt_taken;
+  if (nfree > 0) {
+    // consume off the free tail; ONE truncation at write-back
+    PyObject *bobj = PyList_GET_ITEM(st.bucket_free, nfree - 1);
+    st.bkt_taken++;
+    bid = PyLong_AsLong(bobj);
+    Py_INCREF(rowobj);
+    PyList_SetItem(st.bucket_rows, bid, rowobj);
+    Py_INCREF(flt);
+    PyList_SetItem(st.bkt_ws, bid, flt);
+    if (PyDict_SetItem(st.bucket_of, flt, bobj) < 0) return false;
+  } else {
+    bid = PyList_GET_SIZE(st.bkt_ws);
+    if (PyList_Append(st.bkt_ws, flt) < 0 ||
+        PyList_Append(st.bucket_rows, rowobj) < 0)
+      return false;
+    PyObject *bobj = PyLong_FromLong(bid);
+    if (!bobj) return false;
+    if (PyDict_SetItem(st.bucket_of, flt, bobj) < 0) {
+      Py_DECREF(bobj);
+      return false;
+    }
+    Py_DECREF(bobj);
+  }
+  rowbkt[r] = bid;
+  if ((Py_ssize_t)(st.bkt_cid.b.len / 4) <= bid) {
+    PyErr_SetString(PyExc_ValueError, "bucket arrays not pre-grown");
+    return false;
+  }
+  ((int32_t *)st.bkt_cid.b.buf)[bid] = (int32_t)cid;
+  ((uint32_t *)st.bkt_h1.b.buf)[bid] = h1;
+  ((uint32_t *)st.bkt_fp.b.buf)[bid] = fp;
+  ((int64_t *)st.bkt_slot.b.buf)[bid] = -1;
+  ((int64_t *)st.class_buckets.b.buf)[cid] += 1;
+  st.live_delta += 1;
+  return core_place(st, h1, fp, (int32_t)bid);
+}
+
+// word boundaries of one filter (byte offsets into its utf8 form)
+struct WordSpan {
+  int32_t off;
+  int32_t len;
+};
+static const int kMaxWords = 72;  // > L(<=32) + 1; deeper goes DEEP path
+
+// scan a filter's utf8 bytes once: word spans + wildness
+static int scan_words(const char *s, Py_ssize_t n, WordSpan *spans,
+                      bool *wild_out) {
+  int nw = 0;
+  bool wild = false;
+  Py_ssize_t i = 0;
+  for (;;) {
+    Py_ssize_t j = i;
+    while (j < n && s[j] != '/') j++;
+    if (nw < kMaxWords) {
+      spans[nw].off = (int32_t)i;
+      spans[nw].len = (int32_t)(j - i);
+    }
+    nw++;
+    if (j - i == 1 && (s[i] == '+' || s[i] == '#')) wild = true;
+    if (j >= n) break;
+    i = j + 1;
+    if (i > n) break;
+  }
+  *wild_out = wild;
+  return nw;
+}
+
+// encode one fresh filter into a table row.  Returns 1 ok, 0 deep
+// (plen > L; no row consumed), -1 python error.  On ok, *rowobj_out
+// is a BORROWED ref (owned by tab_dirty after append).
+static int core_add_row(CoreState &st, PyObject *flt, const char *s,
+                        const WordSpan *spans, int nw, PyObject **rowobj_out,
+                        long *r_out, const int32_t **wrow_out,
+                        long *plen_out, bool *hh_out, bool *rw_out) {
+  bool hh = spans[nw - 1].len == 1 && s[spans[nw - 1].off] == '#';
+  long plen = hh ? nw - 1 : nw;
+  if (plen > st.L || nw > kMaxWords) return 0;
+  Py_ssize_t nfree = PyList_GET_SIZE(st.tab_free) - st.tab_taken;
+  if (nfree <= 0) {
+    PyErr_SetString(PyExc_ValueError, "table free-list not pre-grown");
+    return -1;
+  }
+  PyObject *rowobj = PyList_GET_ITEM(st.tab_free, nfree - 1);  // borrowed
+  long r = PyLong_AsLong(rowobj);
+  if (r < 0 && PyErr_Occurred()) return -1;
+  st.tab_taken++;
+  int32_t *wrow = (int32_t *)st.words.b.buf + (size_t)r * st.L;
+  int64_t *refs = (int64_t *)st.refs.b.buf;
+  Py_ssize_t refs_cap = st.refs.b.len / 8;
+  bool rw = hh && plen == 0;
+  for (long i = 0; i < st.L; i++) wrow[i] = 0;
+  for (long i = 0; i < plen; i++) {
+    const char *wp = s + spans[i].off;
+    int wl = spans[i].len;
+    if (wl == 1 && wp[0] == '+') {
+      wrow[i] = kPlus;
+      if (i == 0) rw = true;
+      continue;
+    }
+    // per-call word cache: hit avoids the PyUnicode alloc + dict probe
+    uint32_t h = fnv1a(wp, wl);
+    WordCacheEntry *e = &g_wcache[h & (kWCSize - 1)];
+    int64_t id;
+    if (e->gen == g_wgen && e->len == wl && memcmp(e->ptr, wp, wl) == 0) {
+      id = e->id;
+    } else {
+      PyObject *w = PyUnicode_DecodeUTF8(wp, wl, nullptr);
+      if (!w) return -1;
+      PyObject *wid = PyDict_GetItemWithError(st.voc_ids, w);
+      if (wid) {
+        id = PyLong_AsLongLong(wid);
+        Py_DECREF(w);
+      } else {
+        if (PyErr_Occurred()) {
+          Py_DECREF(w);
+          return -1;
+        }
+        PyObject *idobj;
+        Py_ssize_t vfree = PyList_GET_SIZE(st.voc_free) - st.voc_taken;
+        if (vfree > 0) {
+          idobj = PyList_GET_ITEM(st.voc_free, vfree - 1);  // borrowed
+          Py_INCREF(idobj);
+          st.voc_taken++;
+          id = PyLong_AsLongLong(idobj);
+        } else {
+          id = st.next_id++;
+          idobj = PyLong_FromLongLong(id);
+          if (!idobj) {
+            Py_DECREF(w);
+            return -1;
+          }
+        }
+        if (PyDict_SetItem(st.voc_ids, w, idobj) < 0 ||
+            PyDict_SetItem(st.voc_words, idobj, w) < 0) {
+          Py_DECREF(idobj);
+          Py_DECREF(w);
+          return -1;
+        }
+        Py_DECREF(idobj);
+        Py_DECREF(w);
+      }
+      e->ptr = wp;
+      e->len = wl;
+      e->gen = g_wgen;
+      e->id = id;
+    }
+    if (id < 0 || id >= refs_cap) {
+      PyErr_SetString(PyExc_ValueError, "refs array not pre-grown");
+      return -1;
+    }
+    refs[id]++;
+    wrow[i] = (int32_t)id;
+  }
+  ((int32_t *)st.plen.b.buf)[r] = (int32_t)plen;
+  ((uint8_t *)st.hh.b.buf)[r] = hh;
+  ((uint8_t *)st.rw.b.buf)[r] = rw;
+  ((uint8_t *)st.active.b.buf)[r] = 1;
+  // lazy words tuple: store only the string; filter_words() splits on
+  // first host use
+  Py_INCREF(flt);
+  PyList_SetItem(st.tab_fstr, r, flt);
+  if (PyList_Append(st.tab_dirty, rowobj) < 0) return -1;
+  st.count_delta += 1;
+  *rowobj_out = rowobj;  // kept alive by tab_dirty
+  *r_out = r;
+  *wrow_out = wrow;
+  *plen_out = plen;
+  *hh_out = hh;
+  *rw_out = rw;
+  return 1;
+}
+
+static PyObject *add_routes_core(PyObject *, PyObject *args) {
+  PyObject *router, *pairs;
+  if (!PyArg_ParseTuple(args, "OO!", &router, &PyList_Type, &pairs))
+    return nullptr;
+  CoreState st;
+  // --- fetch phase (read-only; any failure leaves no mutation) -------
+  Ref r_exact, r_wild, r_deep, r_xrow, r_frow, r_rfilt, r_xdeep, r_trie,
+      r_trie2, r_dtrie, r_onadd, r_tab, r_tfree, r_tfstr, r_tdirty,
+      r_words, r_plen, r_hh, r_rw, r_active, r_voc, r_vids, r_vwords,
+      r_vfree, r_vrefs, r_ix, r_skel, r_bof, r_rbkt, r_brows, r_bfree,
+      r_bws, r_resid, r_dslots, r_bcid, r_bh1, r_bfp, r_bslot, r_cbkt,
+      r_slots, r_sfp, r_sbkt, r_sprobe;
+#define GETA(ref, obj, name)                              \
+  if (!((ref).p = PyObject_GetAttrString((obj), (name)))) \
+    return nullptr;
+  GETA(r_exact, router, "_exact");
+  GETA(r_wild, router, "_wild");
+  GETA(r_deep, router, "_deep");
+  GETA(r_xrow, router, "_exact_row");
+  GETA(r_frow, router, "_filter_row");
+  GETA(r_rfilt, router, "_row_filter");
+  GETA(r_xdeep, router, "_exact_deep");
+  GETA(r_trie, router, "_trie_pending_f");
+  GETA(r_trie2, router, "_trie_pending_r");
+  GETA(r_dtrie, router, "_deep_trie");
+  GETA(r_onadd, router, "on_dest_added");
+  GETA(r_tab, router, "table");
+  GETA(r_tfree, r_tab.p, "_free");
+  GETA(r_tfstr, r_tab.p, "_fstr");
+  GETA(r_tdirty, r_tab.p, "dirty");
+  GETA(r_words, r_tab.p, "words");
+  GETA(r_plen, r_tab.p, "prefix_len");
+  GETA(r_hh, r_tab.p, "has_hash");
+  GETA(r_rw, r_tab.p, "root_wild");
+  GETA(r_active, r_tab.p, "active");
+  GETA(r_voc, r_tab.p, "vocab");
+  GETA(r_vids, r_voc.p, "_ids");
+  GETA(r_vwords, r_voc.p, "_words");
+  GETA(r_vfree, r_voc.p, "_free");
+  GETA(r_vrefs, r_voc.p, "_refs");
+  {
+    PyObject *lobj = PyObject_GetAttrString(r_tab.p, "max_levels");
+    if (!lobj) return nullptr;
+    st.L = PyLong_AsLong(lobj);
+    Py_DECREF(lobj);
+    PyObject *nobj = PyObject_GetAttrString(r_voc.p, "_next");
+    if (!nobj) return nullptr;
+    st.next_id = PyLong_AsLongLong(nobj);
+    Py_DECREF(nobj);
+  }
+  if (!st.words.get(r_words.p, PyBUF_CONTIG) ||
+      !st.plen.get(r_plen.p, PyBUF_CONTIG) ||
+      !st.hh.get(r_hh.p, PyBUF_CONTIG) || !st.rw.get(r_rw.p, PyBUF_CONTIG) ||
+      !st.active.get(r_active.p, PyBUF_CONTIG) ||
+      !st.refs.get(r_vrefs.p, PyBUF_CONTIG))
+    return nullptr;
+  GETA(r_ix, router, "index");
+  if (r_ix.p != Py_None) {
+    st.ix = r_ix.p;
+    GETA(r_skel, st.ix, "_skel_packed");
+    GETA(r_bof, st.ix, "_bucket_of");
+    GETA(r_rbkt, st.ix, "_row_bucket");
+    GETA(r_brows, st.ix, "_bucket_rows");
+    GETA(r_bfree, st.ix, "_bucket_free");
+    GETA(r_bws, st.ix, "_bkt_ws");
+    GETA(r_resid, st.ix, "residual_rows");
+    GETA(r_dslots, st.ix, "dirty_slots");
+    GETA(r_bcid, st.ix, "_bkt_cid");
+    GETA(r_bh1, st.ix, "_bkt_h1");
+    GETA(r_bfp, st.ix, "_bkt_fp");
+    GETA(r_bslot, st.ix, "_bkt_slot");
+    GETA(r_cbkt, st.ix, "_class_buckets");
+    GETA(r_slots, st.ix, "slots");
+    GETA(r_sfp, r_slots.p, "fp");
+    GETA(r_sbkt, r_slots.p, "bucket");
+    GETA(r_sprobe, r_slots.p, "probe");
+    PyObject *nb = PyObject_GetAttrString(st.ix, "n_buckets");
+    if (!nb) return nullptr;
+    st.n_buckets = PyLong_AsLong(nb);
+    Py_DECREF(nb);
+    if (!st.row_bucket.get(r_rbkt.p, PyBUF_CONTIG) ||
+        !st.bkt_cid.get(r_bcid.p, PyBUF_CONTIG) ||
+        !st.bkt_h1.get(r_bh1.p, PyBUF_CONTIG) ||
+        !st.bkt_fp.get(r_bfp.p, PyBUF_CONTIG) ||
+        !st.bkt_slot.get(r_bslot.p, PyBUF_CONTIG) ||
+        !st.class_buckets.get(r_cbkt.p, PyBUF_CONTIG) ||
+        !st.s_fp.get(r_sfp.p, PyBUF_CONTIG) ||
+        !st.s_bucket.get(r_sbkt.p, PyBUF_CONTIG) ||
+        !st.s_probe.get(r_sprobe.p, PyBUF_CONTIG))
+      return nullptr;
+    st.skel_packed = r_skel.p;
+    st.bucket_of = r_bof.p;
+    st.bucket_rows = r_brows.p;
+    st.bucket_free = r_bfree.p;
+    st.bkt_ws = r_bws.p;
+    st.residual = r_resid.p;
+    st.dirty_slots = r_dslots.p;
+  }
+  st.exact_t = r_exact.p;
+  st.wild_t = r_wild.p;
+  st.deep_t = r_deep.p;
+  st.exact_row = r_xrow.p;
+  st.filter_row = r_frow.p;
+  st.row_filter = r_rfilt.p;
+  st.exact_deep = r_xdeep.p;
+  st.trie_pending_f = r_trie.p;
+  st.trie_pending_r = r_trie2.p;
+  st.deep_trie = r_dtrie.p;
+  st.on_added = r_onadd.p;
+  st.tab = r_tab.p;
+  st.tab_free = r_tfree.p;
+  st.tab_fstr = r_tfstr.p;
+  st.tab_dirty = r_tdirty.p;
+  st.voc = r_voc.p;
+  st.voc_ids = r_vids.p;
+  st.voc_words = r_vwords.p;
+  st.voc_free = r_vfree.p;
+#undef GETA
+
+  bool collect = st.on_added != Py_None;
+  Ref fresh;
+  if (collect) {
+    fresh.p = PyList_New(0);
+    if (!fresh.p) return nullptr;
+  }
+  g_wgen++;  // reset the per-call word cache
+
+  // --- single mutation pass over the pairs ---------------------------
+  Py_ssize_t n = PyList_GET_SIZE(pairs);
+  bool fail = false;
+  PyObject *one = PyLong_FromLong(1);
+  if (!one) return nullptr;
+  for (Py_ssize_t k = 0; k < n && !fail; k++) {
+    PyObject *pair = PyList_GET_ITEM(pairs, k);
+    if (!PyTuple_Check(pair) || PyTuple_GET_SIZE(pair) < 2) {
+      PyErr_SetString(PyExc_TypeError, "pair must be a 2-tuple");
+      fail = true;
+      break;
+    }
+    PyObject *flt = PyTuple_GET_ITEM(pair, 0);
+    PyObject *dest = PyTuple_GET_ITEM(pair, 1);
+    Py_ssize_t slen;
+    const char *s = PyUnicode_AsUTF8AndSize(flt, &slen);
+    if (!s) {
+      fail = true;
+      break;
+    }
+    WordSpan spans[kMaxWords];
+    bool wild;
+    int nw = scan_words(s, slen, spans, &wild);
+    PyObject *dests;
+    if (wild) {
+      dests = PyDict_GetItemWithError(st.wild_t, flt);
+      if (!dests && !PyErr_Occurred())
+        dests = PyDict_GetItemWithError(st.deep_t, flt);
+    } else {
+      dests = PyDict_GetItemWithError(st.exact_t, flt);
+    }
+    if (!dests && PyErr_Occurred()) {
+      fail = true;
+      break;
+    }
+    if (!dests) {
+      // fresh filter: register {dest: 1} directly (fused first bump),
+      // encode a row, index it
+      dests = PyDict_New();
+      if (!dests || PyDict_SetItem(dests, dest, one) < 0 ||
+          PyDict_SetItem(wild ? st.wild_t : st.exact_t, flt, dests) < 0) {
+        Py_XDECREF(dests);
+        fail = true;
+        break;
+      }
+      Py_DECREF(dests);  // owned by the table dict now
+      if (collect && PyList_Append(fresh.p, pair) < 0) {
+        fail = true;
+        break;
+      }
+      PyObject *rowobj;
+      long r, plen;
+      const int32_t *wrow;
+      bool hhf, rwf;
+      int rc = core_add_row(st, flt, s, spans, nw > kMaxWords ? kMaxWords
+                                                              : nw,
+                            &rowobj, &r, &wrow, &plen, &hhf, &rwf);
+      if (rc < 0) {
+        fail = true;
+        break;
+      }
+      if (rc == 0 || nw > kMaxWords) {
+        // too deep for the flattened table
+        if (wild) {
+          PyObject *wst;
+          if (nw > kMaxWords) {
+            // spans truncated: fall back to python split
+            PyObject *meth = PyObject_CallMethod(flt, "split", "s", "/");
+            if (!meth || !PyList_Check(meth)) {
+              Py_XDECREF(meth);
+              fail = true;
+              break;
+            }
+            wst = PyList_AsTuple(meth);
+            Py_DECREF(meth);
+            if (!wst) {
+              fail = true;
+              break;
+            }
+          } else {
+            wst = PyTuple_New(nw);
+            if (!wst) {
+              fail = true;
+              break;
+            }
+            bool tuple_ok = true;
+            for (int i = 0; i < nw; i++) {
+              PyObject *w = PyUnicode_DecodeUTF8(s + spans[i].off,
+                                                 spans[i].len, nullptr);
+              if (!w) {
+                tuple_ok = false;
+                break;
+              }
+              PyTuple_SET_ITEM(wst, i, w);
+            }
+            if (!tuple_ok) {
+              Py_DECREF(wst);
+              fail = true;
+              break;
+            }
+          }
+          // migrate dest dict to the deep store + deep trie
+          Py_INCREF(dests);
+          if (PyDict_DelItem(st.wild_t, flt) < 0 ||
+              PyDict_SetItem(st.deep_t, flt, dests) < 0) {
+            Py_DECREF(dests);
+            Py_DECREF(wst);
+            fail = true;
+            break;
+          }
+          Py_DECREF(dests);
+          PyObject *res =
+              PyObject_CallMethod(st.deep_trie, "insert", "OO", wst, flt);
+          Py_DECREF(wst);
+          if (!res) {
+            fail = true;
+            break;
+          }
+          Py_DECREF(res);
+        } else {
+          if (PySet_Add(st.exact_deep, flt) < 0) {
+            fail = true;
+            break;
+          }
+        }
+      } else {
+        if (PyDict_SetItem(wild ? st.filter_row : st.exact_row, flt,
+                           rowobj) < 0) {
+          fail = true;
+          break;
+        }
+        // row -> filter string (flat list indexed by row)
+        Py_INCREF(flt);
+        if (PyList_SetItem(st.row_filter, r, flt) < 0) {
+          fail = true;
+          break;
+        }
+        if (wild) {
+          // pending trie insert in string form (drained lazily)
+          if (PyList_Append(st.trie_pending_f, flt) < 0 ||
+              PyList_Append(st.trie_pending_r, rowobj) < 0) {
+            fail = true;
+            break;
+          }
+        }
+        if (!core_index_add(st, flt, rowobj, r, wrow, plen, hhf, rwf)) {
+          fail = true;
+          break;
+        }
+      }
+      continue;  // first dest already registered
+    }
+    // dest refcount bump on an existing filter
+    PyObject *cnt = PyDict_GetItemWithError(dests, dest);
+    if (!cnt && PyErr_Occurred()) {
+      fail = true;
+      break;
+    }
+    if (!cnt) {
+      if (PyDict_SetItem(dests, dest, one) < 0) {
+        fail = true;
+        break;
+      }
+      if (collect && PyList_Append(fresh.p, pair) < 0) {
+        fail = true;
+        break;
+      }
+    } else {
+      long c = PyLong_AsLong(cnt);
+      if (c == -1 && PyErr_Occurred()) {
+        fail = true;
+        break;
+      }
+      PyObject *nc = PyLong_FromLong(c + 1);
+      if (!nc || PyDict_SetItem(dests, dest, nc) < 0) {
+        Py_XDECREF(nc);
+        fail = true;
+        break;
+      }
+      Py_DECREF(nc);
+    }
+  }
+  Py_DECREF(one);
+  // --- truncate the consumed free-list tails (once, not per row) -----
+  if (st.tab_taken) {
+    Py_ssize_t nf = PyList_GET_SIZE(st.tab_free);
+    if (PyList_SetSlice(st.tab_free, nf - st.tab_taken, nf, nullptr) < 0)
+      fail = true;
+  }
+  if (st.voc_taken) {
+    Py_ssize_t nf = PyList_GET_SIZE(st.voc_free);
+    if (PyList_SetSlice(st.voc_free, nf - st.voc_taken, nf, nullptr) < 0)
+      fail = true;
+  }
+  if (st.bkt_taken) {
+    Py_ssize_t nf = PyList_GET_SIZE(st.bucket_free);
+    if (PyList_SetSlice(st.bucket_free, nf - st.bkt_taken, nf, nullptr) < 0)
+      fail = true;
+  }
+
+  // --- write back scalar state (even on failure: keep consistent) ----
+  {
+    PyObject *v = PyLong_FromLongLong(st.next_id);
+    if (v) {
+      PyObject_SetAttrString(st.voc, "_next", v);
+      Py_DECREF(v);
+    }
+    PyObject *cobj = PyObject_GetAttrString(st.tab, "_count");
+    if (cobj) {
+      PyObject *nv = PyLong_FromLong(PyLong_AsLong(cobj) + st.count_delta);
+      Py_DECREF(cobj);
+      if (nv) {
+        PyObject_SetAttrString(st.tab, "_count", nv);
+        Py_DECREF(nv);
+      }
+    }
+    if (st.ix) {
+      PyObject *lobj = PyObject_GetAttrString(st.ix, "_live");
+      if (lobj) {
+        PyObject *nv = PyLong_FromLong(PyLong_AsLong(lobj) + st.live_delta);
+        Py_DECREF(lobj);
+        if (nv) {
+          PyObject_SetAttrString(st.ix, "_live", nv);
+          Py_DECREF(nv);
+        }
+      }
+      if (st.any_residual)
+        PyObject_SetAttrString(st.ix, "residual_dirty", Py_True);
+    }
+  }
+  if (fail) return nullptr;
+  return Py_BuildValue("(OO)", collect ? fresh.p : Py_None,
+                       st.need_rebuild ? Py_True : Py_False);
+}
+
+// ---------------------------------------------------------------------
+
+static PyMethodDef Methods[] = {
+    {"wild_flags", wild_flags, METH_VARARGS,
+     "wild_flags(pairs) -> list[bool]"},
+    {"encode_filters", encode_filters, METH_VARARGS,
+     "encode_filters(filters, ids, words, refs, free, next_id, L)"},
+    {"index_dedup", index_dedup, METH_VARARGS,
+     "index_dedup(flts, cids, rows, bucket_of, bucket_rows, row_bucket, "
+     "bucket_free, residual, nb0)"},
+    {"add_routes_core", add_routes_core, METH_VARARGS,
+     "add_routes_core(router, pairs) -> (fresh | None, need_rebuild)"},
+    {nullptr, nullptr, 0, nullptr}};
+
+static struct PyModuleDef Module = {PyModuleDef_HEAD_INIT, "_emqx_speedups",
+                                    "route-churn hot loops", -1, Methods};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__emqx_speedups(void) { return PyModule_Create(&Module); }

@@ -50,7 +50,7 @@ def hash_match_rows(table, ix, topics, max_hits=4096):
         if bid < 0:  # phase-2 reject inside the kernel
             continue
         if T.match(T.words(topics[t_idx]), ix.bucket_filter(bid)):
-            out[t_idx] |= ix.bucket_rows(bid)
+            out[t_idx].update(ix.bucket_rows(bid))
     return out
 
 
@@ -274,8 +274,8 @@ def test_amb_collision_falls_back_to_host_exactly():
     bidA = ix._row_bucket[r._filter_row["col/+/x"]]
     bidB = ix._row_bucket[r._filter_row["col/+/y"]]
     # forge: bucket B collides with A on ALL hash bits, then re-place
-    ix._buckets[bidB].h1 = ix._buckets[bidA].h1
-    ix._buckets[bidB].fp = ix._buckets[bidA].fp
+    ix._bkt_h1[bidB] = ix._bkt_h1[bidA]
+    ix._bkt_fp[bidB] = ix._bkt_fp[bidA]
     ix._rebuild(ix.n_buckets)
 
     # spy on the host-fallback path

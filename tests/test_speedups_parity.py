@@ -1,0 +1,77 @@
+"""Native write-path core vs pure-python router: state equivalence.
+
+The `_emqx_speedups` C extension (native/speedups.cc) implements
+Router.add_routes' entire batch write path against the SAME
+dicts/lists/arrays the python implementation mutates.  These tests
+drive both implementations through an identical churn script — batch
+adds with duplicate filters, exact topics, deep filters, deletes,
+single-row adds, hook callbacks — and require bit-identical visible
+state.  Skipped when no toolchain built the extension (the python
+path is then the only implementation and is covered everywhere else).
+"""
+
+import random
+
+import pytest
+
+from emqx_tpu.ops import speedups
+
+
+def _script(r):
+    random.seed(73)
+    pairs = []
+    for i in range(2500):
+        kind = random.random()
+        if kind < 0.3:
+            f = f"site/{i % 151}/up"
+        elif kind < 0.5:
+            f = f"a/{i % 61}/+/x"
+        elif kind < 0.68:
+            f = f"b/{i % 37}/#"
+        elif kind < 0.73:
+            f = "deep/" + "/".join(str(j) for j in range(12)) + "/#"
+        elif kind < 0.78:
+            f = "+/root"
+        else:
+            f = f"c/{i}/+/#"
+        pairs.append((f, f"n{i % 11}"))
+    random.shuffle(pairs)
+    fired = []
+    r.on_dest_added = lambda f, d: fired.append((f, d))
+    for i in range(0, len(pairs), 400):
+        r.add_routes(pairs[i : i + 400])
+    for f, d in pairs[:800]:
+        r.delete_route(f, d)
+    for i in range(0, 800, 200):
+        r.add_routes(pairs[i : i + 200])
+    for f, d in pairs[1500:1560]:
+        r.add_route(f, (d, "x"))  # single-row path interleaved
+    r.device_table.sync()
+    topics = (
+        [f"site/{k}/up" for k in range(0, 151, 5)]
+        + [f"a/{k}/9/x" for k in range(0, 61, 4)]
+        + [f"b/{k}/z/z" for k in range(0, 37, 3)]
+        + ["deep/" + "/".join(str(j) for j in range(12)) + "/t", "q/root"]
+    )
+    return dict(
+        stats=r.stats(),
+        fired=sorted(map(repr, fired)),
+        batch=[sorted(x) for x in r.match_filters_batch(topics)],
+        single=[sorted(r.match_filters(t)) for t in topics],
+        routes=sorted(map(repr, r.routes())),
+    )
+
+
+def test_native_core_state_equals_python_path(monkeypatch):
+    if speedups.load() is None:
+        pytest.skip("speedups extension not built")
+    from emqx_tpu.models.router import Router
+
+    native_state = _script(Router(max_levels=8))
+    # force the pure-python path without re-importing anything
+    monkeypatch.setattr(speedups, "_mod", None)
+    monkeypatch.setattr(speedups, "_tried", True)
+    py_state = _script(Router(max_levels=8))
+    monkeypatch.undo()
+    for key in native_state:
+        assert native_state[key] == py_state[key], f"divergence in {key}"
