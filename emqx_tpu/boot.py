@@ -64,6 +64,14 @@ class Node:
         os.makedirs(data_dir, exist_ok=True)
         node_name = cfg.get("node.name")
 
+        # 0. native speedups build at BOOT, not at the first subscribe
+        # storm: load() compiles the extension on first call (up to
+        # ~2min on a cold toolchain), which must never land inside the
+        # route-write hot path of a live broker
+        from .ops import speedups as _speedups
+
+        _speedups.load()
+
         # 1. broker core (+ caps from the mqtt zone config)
         from .broker.caps import MqttCaps
         from .cluster.node import ClusterBroker, ClusterNode
@@ -252,10 +260,21 @@ class Node:
 
                 self.replicator = ReplicatedDs(node, self.durable_mgr)
 
-        # 8. listeners
+        # 8. listeners (+ the node-wide TLS-PSK identity store the
+        # QUIC listeners authenticate against — ref: apps/emqx_psk)
         from .broker.listeners import Listeners
 
-        self.listeners = Listeners(broker, config=cfg)
+        psk_conf = cfg.get("psk_authentication") or {}
+        psk_store = None
+        if psk_conf.get("enable"):
+            from .broker.tls_extras import PskStore
+
+            psk_store = PskStore(
+                init_file=psk_conf.get("init_file"),
+                separator=psk_conf.get("separator") or ":",
+            )
+        self.psk_store = psk_store
+        self.listeners = Listeners(broker, config=cfg, psk_store=psk_store)
         lconf = cfg.get("listeners")
         if not any(
             (lconf or {}).get(t) for t in ("tcp", "ssl", "ws", "wss", "quic")

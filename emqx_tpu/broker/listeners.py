@@ -18,6 +18,7 @@ Config shape (config/default_schema.py `listeners` root):
 
 from __future__ import annotations
 
+import asyncio
 import logging
 import ssl as ssl_mod
 from typing import Dict, Optional, Tuple
@@ -56,7 +57,75 @@ def make_ssl_context(conf: Dict) -> ssl_mod.SSLContext:
         ctx.verify_mode = ssl_mod.CERT_REQUIRED
     else:
         ctx.verify_mode = ssl_mod.CERT_NONE
+    # CRL revocation checking, declared purely in listener config
+    # (ref: emqx_crl_cache.erl wired via the listener ssl opts).
+    # FAIL-CLOSED at build time: enabling the check with no URLs or an
+    # unfetchable CRL refuses the listener rather than silently
+    # accepting revoked certificates. A background task (Listeners)
+    # re-fetches and re-arms the live context every refresh interval,
+    # so post-start revocations take effect and the loaded CRL cannot
+    # age past nextUpdate (which would fail every handshake).
+    if conf.get("ssl_crl_check") or conf.get("enable_crl_check"):
+        from .tls_extras import CrlCache
+
+        urls = (
+            conf.get("ssl_crl_cache_urls")
+            or conf.get("crl_cache_urls")
+            or []
+        )
+        if not urls:
+            raise ValueError(
+                "ssl_crl_check enabled but ssl_crl_cache_urls is empty"
+            )
+        cache = CrlCache(
+            urls,
+            refresh_interval=float(
+                conf.get("ssl_crl_refresh_interval", 900) or 900
+            ),
+        )
+        if not cache.pem():
+            raise ValueError(
+                "ssl_crl_check enabled but no CRL could be fetched from "
+                + ", ".join(urls)
+            )
+        cache.apply(ctx)
+        ctx.emqx_crl_cache = cache  # surfaced by the listener manager
     return ctx
+
+
+def make_ocsp_cache(conf: Dict):
+    """Per-listener OCSP responder cache (ref: emqx_ocsp_cache.erl),
+    built from the listener's config. CPython's ssl module has no
+    server-side stapling hook, so on TCP-TLS this cache serves the
+    operator surface (status via the management API); the QUIC TLS
+    stack staples from the same kind of store."""
+    if not conf.get("ssl_ocsp_enable"):
+        return None
+    url = conf.get("ssl_ocsp_responder_url")
+    issuer_file = conf.get("ssl_ocsp_issuer_certfile") or conf.get(
+        "cacertfile"
+    ) or conf.get("ssl_cacertfile")
+    certfile = conf.get("certfile") or conf.get("ssl_certfile")
+    if not (url and issuer_file and certfile):
+        raise ValueError(
+            "ssl_ocsp_enable requires ssl_ocsp_responder_url, a "
+            "certfile and an issuer cert (ssl_ocsp_issuer_certfile "
+            "or cacertfile)"
+        )
+    from cryptography.x509 import load_pem_x509_certificate
+
+    from .tls_extras import OcspCache
+
+    with open(certfile, "rb") as f:
+        cert = load_pem_x509_certificate(f.read())
+    with open(issuer_file, "rb") as f:
+        issuer = load_pem_x509_certificate(f.read())
+    return OcspCache(
+        url, cert, issuer,
+        refresh_interval=float(
+            conf.get("ssl_ocsp_refresh_interval", 3600) or 3600
+        ),
+    )
 
 
 MQTT_ZONE_KEYS = (
@@ -130,11 +199,17 @@ class _QuicListener:
 class Listeners:
     """Named-listener registry over a shared Broker."""
 
-    def __init__(self, broker: Broker, config=None):
+    def __init__(self, broker: Broker, config=None, psk_store=None):
         self.broker = broker
         self.config = config  # typed Config for zone-aware session conf
         self._live: Dict[Tuple[str, str], Server] = {}
         self._conf: Dict[Tuple[str, str], Dict] = {}
+        # node-wide TLS-PSK identity store (ref: apps/emqx_psk) — fed
+        # from config by boot, consumed by QUIC listeners (psk_dhe_ke)
+        self.psk_store = psk_store
+        # per-listener OCSP caches for operator surfacing
+        self.ocsp: Dict[Tuple[str, str], object] = {}
+        self._crl_tasks: Dict[Tuple[str, str], object] = {}
 
     def _build(self, ltype: str, name: str, conf: Dict) -> Server:
         if ltype not in LISTENER_TYPES:
@@ -185,7 +260,13 @@ class Listeners:
                         Encoding.DER
                     )
                 cert = (key, der)
-            return _QuicListener(seat, QuicServer(seat, host, port, cert=cert))
+            return _QuicListener(
+                seat,
+                QuicServer(
+                    seat, host, port, cert=cert,
+                    psk_store=self.psk_store,
+                ),
+            )
         limits = ListenerLimits(
             max_conn_rate=conf.get("max_conn_rate"),
             messages_rate=conf.get("messages_rate"),
@@ -215,13 +296,40 @@ class Listeners:
         if key in self._live:
             raise ValueError(f"listener {ltype}:{name} already running")
         srv = self._build(ltype, name, conf)
+        cache = make_ocsp_cache(conf) if ltype in ("ssl", "wss") else None
         await srv.start()
+        if cache is not None:
+            self.ocsp[key] = cache
         self._live[key] = srv
         self._conf[key] = dict(conf)
+        crl = getattr(getattr(srv, "ssl_context", None), "emqx_crl_cache",
+                      None)
+        if crl is not None:
+            self._crl_tasks[key] = asyncio.get_running_loop().create_task(
+                self._crl_refresh_loop(key, srv.ssl_context, crl)
+            )
         return srv
+
+    async def _crl_refresh_loop(self, key, ctx, cache) -> None:
+        """Periodically re-fetch the listener's CRLs and re-arm the
+        LIVE context (load_verify_locations applies to new handshakes)
+        — the reference's emqx_crl_cache timer refresh."""
+        while True:
+            await asyncio.sleep(max(30.0, cache.refresh_interval))
+            try:
+                await asyncio.get_running_loop().run_in_executor(
+                    None, lambda: cache.refresh(force=True)
+                )
+                cache.apply(ctx)
+            except Exception:
+                log.exception("CRL refresh failed for listener %s", key)
 
     async def stop(self, ltype: str, name: str) -> bool:
         srv = self._live.pop((ltype, name), None)
+        self.ocsp.pop((ltype, name), None)
+        task = self._crl_tasks.pop((ltype, name), None)
+        if task is not None:
+            task.cancel()
         if srv is None:
             return False
         # the CONFIG survives a stop: a later start() without an
@@ -241,6 +349,8 @@ class Listeners:
         listener stops, and a failed start rolls back to the previous
         config — a rejected change must not turn into an outage."""
         self._build(ltype, name, conf)  # validate (bind parse, certs)
+        if ltype in ("ssl", "wss"):
+            make_ocsp_cache(conf)  # validate OCSP opts before the stop
         old_conf = self._conf.get((ltype, name))
         was_running = (ltype, name) in self._live
         await self.stop(ltype, name)

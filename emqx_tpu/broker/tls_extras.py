@@ -26,10 +26,18 @@ log = logging.getLogger("emqx_tpu.tls_extras")
 
 class PskStore:
     """identity -> key table with file bootstrap (emqx_psk.erl
-    init_file: one "identity:secret" per line, '#' comments)."""
+    init_file: one "identity<separator>secret" per line, '#' comments;
+    the separator is configurable like the reference's
+    psk_authentication.chunk separator, default ':')."""
 
-    def __init__(self, init_file: Optional[str] = None, enable: bool = True):
+    def __init__(
+        self,
+        init_file: Optional[str] = None,
+        enable: bool = True,
+        separator: str = ":",
+    ):
         self.enable = enable
+        self.separator = separator or ":"
         self._table: Dict[bytes, bytes] = {}
         self._lock = threading.Lock()
         if init_file:
@@ -40,13 +48,14 @@ class PskStore:
         return v.encode() if isinstance(v, str) else bytes(v)
 
     def import_file(self, path: str) -> int:
+        sep = self.separator
         n = 0
         with open(path, "r", encoding="utf-8") as f:
             for line in f:
                 line = line.strip()
-                if not line or line.startswith("#") or ":" not in line:
+                if not line or line.startswith("#") or sep not in line:
                     continue
-                ident, _, secret = line.partition(":")
+                ident, _, secret = line.partition(sep)
                 self.insert(ident, secret)
                 n += 1
         return n

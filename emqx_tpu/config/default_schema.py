@@ -83,6 +83,20 @@ def listener_struct() -> Struct:
             "ssl_keyfile": Field(String(), default=None),
             "ssl_cacertfile": Field(String(), default=None),
             "ssl_verify": Field(Enum("verify_none", "verify_peer"), default="verify_none"),
+            # CRL revocation checking for mTLS listeners (ref:
+            # apps/emqx/src/emqx_crl_cache.erl wired through the
+            # listener ssl opts' enable_crl_check)
+            "ssl_crl_check": Field(Bool(), default=False),
+            "ssl_crl_cache_urls": Field(Array(String()), default=[]),
+            "ssl_crl_refresh_interval": Field(Duration(), default=900),
+            # OCSP responder cache for the listener certificate (ref:
+            # emqx_ocsp_cache.erl; stapling itself is served on the
+            # QUIC TLS stack — CPython's ssl has no server-side
+            # stapling hook, so TCP-TLS surfaces status via the API)
+            "ssl_ocsp_enable": Field(Bool(), default=False),
+            "ssl_ocsp_responder_url": Field(String(), default=None),
+            "ssl_ocsp_issuer_certfile": Field(String(), default=None),
+            "ssl_ocsp_refresh_interval": Field(Duration(), default=3600),
         }
     )
 
@@ -366,6 +380,18 @@ def broker_schema() -> Struct:
                 )
             ),
             "telemetry": Field(Struct({"enable": Field(Bool(), default=False)})),
+            # TLS-PSK identity store (ref: apps/emqx_psk/src/emqx_psk.erl
+            # psk_authentication root: enable + init_file of
+            # identity:hex-psk lines); consumed by QUIC listeners
+            "psk_authentication": Field(
+                Struct(
+                    {
+                        "enable": Field(Bool(), default=False),
+                        "init_file": Field(String(), default=None),
+                        "separator": Field(String(), default=":"),
+                    }
+                )
+            ),
             "file_transfer": Field(
                 Struct(
                     {

@@ -240,10 +240,10 @@ def make_sharded_hash_kernel(mesh: Mesh, max_hits_per_block: int):
             x = w ^ rep
             hz = ((x - jnp.uint32(0x01010101)) & ~x
                   & jnp.uint32(0x80808080)) != 0
-            return inside & hz, lb
+            return inside & hz, lb, w
 
-        hit1, l1 = local_hit(b1)
-        hit2, l2 = local_hit(b2)
+        hit1, l1, wp1 = local_hit(b1)
+        hit2, l2, wp2 = local_hit(b2)
         pairhit = elig & (hit1 | hit2)
         total = pairhit.sum(dtype=jnp.int32)
         pflat = jnp.nonzero(
@@ -256,20 +256,43 @@ def make_sharded_hash_kernel(mesh: Mesh, max_hits_per_block: int):
         pl1 = l1.reshape(-1)[psafe]
         pl2 = l2.reshape(-1)[psafe]
         pfp = fp.reshape(-1)[psafe]
+        pw1 = wp1.reshape(-1)[psafe]
+        pw2 = wp2.reshape(-1)[psafe]
+        # two-lane sparse verify (mirrors match_ids_hash phase 2): the
+        # probe words pin the candidate lanes exactly; verify the
+        # first two LOCAL byte-matching lanes, route >2 to amb. Lane
+        # validity folds the shard-ownership mask per bucket.
+        pp8 = jnp.maximum(pfp >> jnp.uint32(24), jnp.uint32(1))
         lid = jnp.arange(2 * BUCKET_W, dtype=jnp.int32)
         use1 = lid < BUCKET_W
         lvalid = jnp.where(use1[None, :], ph1[:, None], ph2[:, None])
-        lslot = (
-            jnp.where(use1[None, :], pl1[:, None], pl2[:, None]) * BUCKET_W
-            + (lid % BUCKET_W)
-        )
-        lslot = jnp.clip(lslot, 0, sfp.shape[0] - 1)
-        g_fp = sfp[lslot]
-        okl = lvalid & (g_fp == pfp[:, None]) & pvalid[:, None]
-        nmatch = okl.sum(axis=1, dtype=jnp.int32)
-        lane = jnp.argmax(okl, axis=1)
+        lane_byte = jnp.where(
+            use1[None, :],
+            pw1[:, None] >> (jnp.uint32(8) * (lid[None, :].astype(jnp.uint32) & jnp.uint32(3))),
+            pw2[:, None] >> (jnp.uint32(8) * (lid[None, :].astype(jnp.uint32) & jnp.uint32(3))),
+        ) & jnp.uint32(0xFF)
+        bm = (lane_byte == pp8[:, None]) & lvalid & pvalid[:, None]
+        nbm = bm.sum(axis=1, dtype=jnp.int32)
+        ln1 = jnp.argmax(bm, axis=1)
+        bm2 = bm & (lid[None, :] != ln1[:, None])
+        ln2 = jnp.argmax(bm2, axis=1)
+
+        def lslot_of(ln):
+            s = (
+                jnp.where(ln < BUCKET_W, pl1, pl2) * BUCKET_W
+                + (ln % BUCKET_W)
+            )
+            return jnp.clip(s, 0, sfp.shape[0] - 1)
+
+        s1 = lslot_of(ln1)
+        s2 = lslot_of(ln2)
+        f1 = sfp[s1]
+        f2 = sfp[s2]
+        ok1 = (nbm >= 1) & (f1 == pfp)
+        ok2 = (nbm >= 2) & (f2 == pfp)
+        nmatch = ok1.astype(jnp.int32) + ok2.astype(jnp.int32)
         found = nmatch > 0
-        win = lslot[jnp.arange(lslot.shape[0]), lane]
+        win = jnp.where(ok1, s1, s2)
         g_bkt = sbkt[win]
         ok = found & (g_bkt >= 0)
         ti = jnp.where(
@@ -277,7 +300,10 @@ def make_sharded_hash_kernel(mesh: Mesh, max_hits_per_block: int):
         ).astype(jnp.int32)
         bi = jnp.where(ok, g_bkt, -1).astype(jnp.int32)
         amb = jax.lax.psum(
-            jax.lax.psum((nmatch > 1).sum(dtype=jnp.int32), SUB_AXIS),
+            jax.lax.psum(
+                ((nmatch > 1) | (pvalid & (nbm > 2))).sum(dtype=jnp.int32),
+                SUB_AXIS,
+            ),
             DP_AXIS,
         )
         return (
