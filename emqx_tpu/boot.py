@@ -50,6 +50,7 @@ class Node:
         self.replicator = None
         self.plugins = None
         self.bridge_registry = None
+        self.license = None
         self.ft = None
         self.telemetry = None
         self.links: list = []
@@ -319,6 +320,34 @@ class Node:
                 await link.start()
                 self.links.append(link)
 
+        # 10b. license / connection-quota enforcement (ref:
+        # apps/emqx_license — the connect gate registers at the
+        # 'client.connect' hookpoint, quota visible via /api/v5/license)
+        from .license import LicenseChecker
+
+        lic_conf = cfg.get("license") or {}
+        cluster_node = self.cluster_node
+
+        def _licensed_count() -> int:
+            # the entitlement is CLUSTER-wide (emqx_license_resources
+            # aggregates the count over all nodes): when clustered, the
+            # replicated client registry carries every node's clients;
+            # standalone falls back to the local live-transport count
+            if cluster_node is not None and cluster_node.registry:
+                return len(cluster_node.registry)
+            return broker.connected_count()
+
+        self.license = LicenseChecker(
+            key=lic_conf.get("key") or "default",
+            count_fn=_licensed_count,
+            alarms=getattr(self.obs, "alarms", None),
+            public_key_pem=lic_conf.get("public_key"),
+            low_watermark=lic_conf.get("connection_low_watermark", "75%"),
+            high_watermark=lic_conf.get("connection_high_watermark", "80%"),
+            persist_fn=lambda key: cfg.update("license.key", key),
+        )
+        self.license.attach(broker)
+
         # 11. plugins (restarts previously enabled ones) — before the
         # API so the REST surface can manage them
         from .plugins import PluginManager
@@ -348,6 +377,7 @@ class Node:
                 listeners=self.listeners,
                 plugins=self.plugins,
                 bridges=self.bridge_registry,
+                license=self.license,
             )
             host, port = parse_bind(cfg.get("api.bind"))
             await self.mgmt.start(host, port)
@@ -365,6 +395,7 @@ class Node:
             plugins=self.plugins,
             gateways=self.gateways,
             listeners=self.listeners,
+            license=self.license,
         )
         log.info("node %s started", node_name)
 

@@ -85,6 +85,8 @@ class Channel:
         # (client_id, verdict) pre-computed by the connection layer's
         # off-loop authenticate run; consumed once by _handle_connect
         self.preauth = None
+        # (client_id, verdict) of the pre-run 'client.connect' fold
+        self.preconnect = None
         # (action, topic) -> verdict pre-computed off-loop by the
         # connection layer when a slow (network-backed) authorize chain
         # is installed; consumed by _handle_publish/_handle_subscribe
@@ -145,6 +147,42 @@ class Channel:
                     )
                 ]
             client_id = f"auto-{id(self):x}-{int(time.time() * 1000) & 0xFFFFFF:x}"
+        # 'client.connect' fold runs BEFORE authentication (the
+        # reference's hook posture: license/quota gates and exhook
+        # OnClientConnect see every CONNECT attempt). Acc True admits;
+        # a reason-code accumulator rejects. The TCP server loop
+        # pre-runs this fold (off-loop when a slow hook is registered)
+        # and parks the verdict in `preconnect`; other transports run
+        # it inline here.
+        if self.preconnect is not None and self.preconnect[0] == pkt.client_id:
+            ok = self.preconnect[1]
+            self.preconnect = None
+        elif self.broker.hooks.has("client.connect"):
+            ok = self.broker.hooks.run_fold(
+                "client.connect",
+                (
+                    dict(
+                        client_id=client_id,
+                        username=pkt.username,
+                        proto_ver=self.proto_ver,
+                        keepalive=pkt.keepalive,
+                        clean_start=pkt.clean_start,
+                        peer=self.peer,
+                    ),
+                ),
+                True,
+            )
+        else:
+            ok = True
+        if ok is not True:
+            code = (
+                ok
+                if isinstance(ok, int) and not isinstance(ok, bool)
+                else (RC.UNSPECIFIED_ERROR if self.proto_ver == MQTT_V5 else 3)
+            )
+            if self.proto_ver != MQTT_V5 and code > 5:
+                code = 3  # v3 range: map quota/other to server-unavailable
+            return [Connack(False, code)]
         if self.preauth is not None and self.preauth[0] == pkt.client_id:
             # the connection layer ran the authenticate fold OFF-loop
             # (blocking providers like HTTP must not stall the broker)

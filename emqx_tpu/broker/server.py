@@ -185,23 +185,60 @@ class Connection:
                     from .packet import Connect
 
                     if isinstance(pkt, Connect) and not self.channel.connected:
-                        # run the authenticate fold OFF-loop: providers
-                        # doing network IO (HTTP authn) block for up to
-                        # their timeout, and that must stall only THIS
-                        # connection — never the whole broker loop
-                        info = dict(
+                        hooks = self.server.broker.hooks
+                        # 'client.connect' gate (license quota, exhook
+                        # OnClientConnect) runs FIRST — a shed CONNECT
+                        # must not cost an auth-backend round trip. Run
+                        # it off-loop when a slow (out-of-proc) hook is
+                        # registered, same posture as authenticate.
+                        cinfo = dict(
                             client_id=pkt.client_id,
                             username=pkt.username,
-                            password=pkt.password,
+                            proto_ver=pkt.proto_ver,
+                            keepalive=pkt.keepalive,
+                            clean_start=pkt.clean_start,
                             peer=self.channel.peer,
                         )
-                        verdict = await asyncio.get_running_loop().run_in_executor(
-                            None,
-                            lambda: self.server.broker.hooks.run_fold(
-                                "client.authenticate", (info,), True
-                            ),
-                        )
-                        self.channel.preauth = (pkt.client_id, verdict)
+                        if hooks.has_slow("client.connect"):
+                            cverdict = await (
+                                asyncio.get_running_loop().run_in_executor(
+                                    None,
+                                    lambda: hooks.run_fold(
+                                        "client.connect", (cinfo,), True
+                                    ),
+                                )
+                            )
+                        elif hooks.has("client.connect"):
+                            cverdict = hooks.run_fold(
+                                "client.connect", (cinfo,), True
+                            )
+                        else:
+                            cverdict = True
+                        self.channel.preconnect = (pkt.client_id, cverdict)
+                        if cverdict is not True:
+                            # shed before the auth fold runs at all
+                            self.channel.preauth = (pkt.client_id, True)
+                        else:
+                            # run the authenticate fold OFF-loop:
+                            # providers doing network IO (HTTP authn)
+                            # block for up to their timeout, and that
+                            # must stall only THIS connection — never
+                            # the whole broker loop
+                            info = dict(
+                                client_id=pkt.client_id,
+                                username=pkt.username,
+                                password=pkt.password,
+                                peer=self.channel.peer,
+                            )
+                            verdict = await (
+                                asyncio.get_running_loop().run_in_executor(
+                                    None,
+                                    lambda: hooks.run_fold(
+                                        "client.authenticate", (info,), True
+                                    ),
+                                )
+                            )
+                            self.channel.preauth = (pkt.client_id, verdict)
                     if isinstance(pkt, Publish):
                         # backpressure: pausing here stops reading the
                         # socket, which pushes back on the publisher's

@@ -380,6 +380,22 @@ def broker_schema() -> Struct:
                 )
             ),
             "telemetry": Field(Struct({"enable": Field(Bool(), default=False)})),
+            # License / connection-quota enforcement (ref:
+            # apps/emqx_license/src/emqx_license_schema.erl key_license)
+            "license": Field(
+                Struct(
+                    {
+                        "key": Field(String(), default="default"),
+                        "public_key": Field(String(), default=None),
+                        "connection_low_watermark": Field(
+                            String(), default="75%"
+                        ),
+                        "connection_high_watermark": Field(
+                            String(), default="80%"
+                        ),
+                    }
+                )
+            ),
             # TLS-PSK identity store (ref: apps/emqx_psk/src/emqx_psk.erl
             # psk_authentication root: enable + init_file of
             # identity:hex-psk lines); consumed by QUIC listeners

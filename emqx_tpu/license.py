@@ -1,0 +1,360 @@
+"""License parsing + connection-quota enforcement.
+
+The reference ships this as a whole app (apps/emqx_license/src/
+emqx_license.erl, emqx_license_parser_v20220101.erl,
+emqx_license_checker.erl, emqx_license_resources.erl): a signed
+license key carries a max-connections entitlement; a checker caches
+the effective limits and a 'client.connect' hook rejects CONNECTs
+with RC QUOTA_EXCEEDED once the (cached) connection count passes the
+limit with a 10% grace factor; watermark alarms warn the operator
+before the wall.
+
+Key format (mirrors emqx_license_parser_v20220101.erl:34-60's
+`base64(payload).base64(signature)` shape, re-keyed for this
+framework): payload is newline-joined fields
+
+    FORMAT_VERSION       ("220111")
+    license type         (0 official | 1 trial | 2 community)
+    customer type        (0..11; 10 = community)
+    customer name
+    customer email
+    deployment name
+    start date           (YYYYMMDD)
+    days valid           ("0" = perpetual)
+    max connections
+
+signed with Ed25519. The verification public key defaults to the
+built-in community key and is overridable via `license.public_key`
+(deployments issuing their own entitlements). The special key value
+"default" is the unlimited community license — the OSS build's
+behavior, but through the same enforcement seam so a quota applies
+the moment a real key is configured.
+"""
+
+from __future__ import annotations
+
+import base64
+import binascii
+import datetime as _dt
+import logging
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, Optional
+
+log = logging.getLogger("emqx_tpu.license")
+
+FORMAT_VERSION = "220111"
+TYPE_OFFICIAL, TYPE_TRIAL, TYPE_COMMUNITY = 0, 1, 2
+UNLIMITED = float("inf")
+EXPIRED = "expired"
+
+CHECK_INTERVAL = 5.0  # cached connection-count refresh (checker:13)
+GRACE_FACTOR = 1.1  # emqx_license.erl:176 — reject past max * 1.1
+
+# Built-in community verification key. The matching PRIVATE key is
+# intentionally not distributed; self-issued deployments configure
+# license.public_key with their own.
+COMMUNITY_PUBLIC_KEY_PEM = """-----BEGIN PUBLIC KEY-----
+MCowBQYDK2VwAyEAYROpEmQ1Ys0TJYLfOMfS2PoOjJITK5A9BFkx9OiTSxE=
+-----END PUBLIC KEY-----
+"""
+
+DEFAULT_KEY = "default"
+
+
+class LicenseError(ValueError):
+    pass
+
+
+@dataclass
+class License:
+    license_type: int = TYPE_COMMUNITY
+    customer_type: int = 10
+    customer: str = "community"
+    email: str = ""
+    deployment: str = "default"
+    start_date: str = "20200101"  # YYYYMMDD
+    days: int = 0  # 0 = perpetual
+    max_connections: float = UNLIMITED
+
+    @property
+    def type_name(self) -> str:
+        return {TYPE_OFFICIAL: "official", TYPE_TRIAL: "trial"}.get(
+            self.license_type, "community"
+        )
+
+    def expiry_epoch(self) -> float:
+        if self.days <= 0:
+            return UNLIMITED
+        d = _dt.datetime.strptime(self.start_date, "%Y%m%d").replace(
+            tzinfo=_dt.timezone.utc
+        )
+        return (d + _dt.timedelta(days=self.days)).timestamp()
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        return (now or time.time()) > self.expiry_epoch()
+
+    def summary(self) -> Dict:
+        exp = self.expiry_epoch()
+        return {
+            "customer": self.customer,
+            "customer_type": self.customer_type,
+            "deployment": self.deployment,
+            "email": self.email,
+            "type": self.type_name,
+            "start_at": f"{self.start_date[:4]}-{self.start_date[4:6]}-"
+                        f"{self.start_date[6:]}",
+            "expiry_at": (
+                "never" if exp == UNLIMITED
+                else _dt.datetime.fromtimestamp(
+                    exp, _dt.timezone.utc
+                ).strftime("%Y-%m-%d")
+            ),
+            "expiry": self.expired(),
+            "max_connections": (
+                "unlimited" if self.max_connections == UNLIMITED
+                else int(self.max_connections)
+            ),
+        }
+
+
+def sign_license(lic: License, private_key) -> str:
+    """Issue a key for `lic` (test/ops tooling; Ed25519 private key)."""
+    payload = "\n".join(
+        [
+            FORMAT_VERSION,
+            str(lic.license_type),
+            str(lic.customer_type),
+            lic.customer,
+            lic.email,
+            lic.deployment,
+            lic.start_date,
+            str(lic.days),
+            str(
+                0
+                if lic.max_connections == UNLIMITED
+                else int(lic.max_connections)
+            ),
+        ]
+    ).encode()
+    sig = private_key.sign(payload)
+    return (
+        base64.b64encode(payload).decode()
+        + "."
+        + base64.b64encode(sig).decode()
+    )
+
+
+def parse_license(key: str, public_key_pem: Optional[str] = None) -> License:
+    """Parse + verify a key. "default" yields the community license."""
+    key = (key or DEFAULT_KEY).strip()
+    if key == DEFAULT_KEY:
+        return License()
+    if "." not in key:
+        raise LicenseError("malformed license key (expected payload.sig)")
+    p64, s64 = key.split(".", 1)
+    try:
+        payload = base64.b64decode(p64, validate=True)
+        sig = base64.b64decode(s64, validate=True)
+    except (binascii.Error, ValueError) as e:
+        raise LicenseError(f"malformed license key: {e}") from None
+    from cryptography.hazmat.primitives.serialization import (
+        load_pem_public_key,
+    )
+
+    pub = load_pem_public_key(
+        (public_key_pem or COMMUNITY_PUBLIC_KEY_PEM).encode()
+    )
+    try:
+        pub.verify(sig, payload)
+    except Exception:
+        raise LicenseError("invalid license signature") from None
+    fields = payload.decode("utf-8", "replace").split("\n")
+    if len(fields) != 9:
+        raise LicenseError(f"license payload has {len(fields)} fields, not 9")
+    if fields[0] != FORMAT_VERSION:
+        raise LicenseError(f"unsupported license format {fields[0]!r}")
+    try:
+        maxc = int(fields[8])
+        lic = License(
+            license_type=int(fields[1]),
+            customer_type=int(fields[2]),
+            customer=fields[3],
+            email=fields[4],
+            deployment=fields[5],
+            start_date=fields[6],
+            days=int(fields[7]),
+            max_connections=UNLIMITED if maxc == 0 else float(maxc),
+        )
+        lic.expiry_epoch()  # validates start_date format
+    except (ValueError, TypeError) as e:
+        raise LicenseError(f"bad license field: {e}") from None
+    return lic
+
+
+def _parse_watermark(v, default: float) -> float:
+    if v is None:
+        return default
+    if isinstance(v, str) and v.endswith("%"):
+        return float(v[:-1]) / 100.0
+    return float(v)
+
+
+class LicenseChecker:
+    """Cached-limit connect gate + watermark alarm (emqx_license_checker
+    + emqx_license_resources in one object; no gen_server needed — the
+    broker is single-loop and the count fetch is cached)."""
+
+    ALARM = "license_quota"
+
+    def __init__(
+        self,
+        key: str = DEFAULT_KEY,
+        count_fn: Optional[Callable[[], int]] = None,
+        alarms=None,
+        public_key_pem: Optional[str] = None,
+        low_watermark=0.75,
+        high_watermark=0.80,
+        persist_fn: Optional[Callable[[str], None]] = None,
+    ):
+        self.public_key_pem = public_key_pem
+        self.persist_fn = persist_fn
+        self.license = parse_license(key, public_key_pem)
+        self.key = key or DEFAULT_KEY
+        self.count_fn = count_fn or (lambda: 0)
+        self.alarms = alarms
+        self.low_watermark = _parse_watermark(low_watermark, 0.75)
+        self.high_watermark = _parse_watermark(high_watermark, 0.80)
+        self._cached_count = 0
+        self._counted_at = 0.0
+        self._alarm_active = False
+
+    # --- emqx_license:update_key -------------------------------------
+    def update_key(self, key: str) -> License:
+        lic = parse_license(key, self.public_key_pem)  # throws on bad
+        self.license = lic
+        self.key = key
+        if self.persist_fn is not None:
+            # write through to config (emqx_conf:update override — the
+            # key must survive a restart, emqx_license.erl:60-76)
+            self.persist_fn(key)
+        log.info(
+            "license updated: %s, max_connections=%s",
+            lic.customer, lic.max_connections,
+        )
+        self._watermark_alarm()
+        return lic
+
+    def update_setting(self, setting: Dict) -> None:
+        if "connection_low_watermark" in setting:
+            self.low_watermark = _parse_watermark(
+                setting["connection_low_watermark"], self.low_watermark
+            )
+        if "connection_high_watermark" in setting:
+            self.high_watermark = _parse_watermark(
+                setting["connection_high_watermark"], self.high_watermark
+            )
+
+    # --- emqx_license_checker:limits ----------------------------------
+    def limits(self) -> Dict:
+        if self.license.expired():
+            return {"max_connections": EXPIRED}
+        return {"max_connections": self.license.max_connections}
+
+    def connection_count(self) -> int:
+        now = time.time()
+        if now - self._counted_at >= CHECK_INTERVAL:
+            self._cached_count = int(self.count_fn())
+            self._counted_at = now
+        return self._cached_count
+
+    # --- emqx_license:check (the 'client.connect' hook) ---------------
+    def check_connect(self) -> Optional[str]:
+        """None = admit; else a rejection reason string."""
+        lim = self.limits()["max_connections"]
+        if lim == EXPIRED:
+            log.error("connection rejected: license expired")
+            return "license_expired"
+        if lim == UNLIMITED:
+            return None
+        count = self.connection_count()
+        self._watermark_alarm(count, lim)
+        if count > lim * GRACE_FACTOR:
+            log.error(
+                "connection rejected: license limit reached (%d > %d)",
+                count, int(lim),
+            )
+            return "license_quota"
+        return None
+
+    def _watermark_alarm(self, count=None, lim=None) -> None:
+        if self.alarms is None:
+            return
+        if lim is None:
+            lim = self.limits()["max_connections"]
+        if lim in (EXPIRED, UNLIMITED):
+            # upgrading to unlimited (or expiring) must not strand an
+            # active quota alarm
+            if self._alarm_active:
+                try:
+                    self.alarms.deactivate(self.ALARM)
+                except Exception:
+                    pass
+                self._alarm_active = False
+            return
+        if count is None:
+            count = self.connection_count()
+        frac = count / lim if lim else 1.0
+        if frac >= self.high_watermark and not self._alarm_active:
+            try:
+                self.alarms.activate(
+                    self.ALARM,
+                    details={"count": count, "max": int(lim)},
+                    message=(
+                        f"License: {count} connections >= "
+                        f"{self.high_watermark:.0%} of limit {int(lim)}"
+                    ),
+                )
+                self._alarm_active = True
+            except Exception:
+                pass
+        elif frac < self.low_watermark and self._alarm_active:
+            try:
+                self.alarms.deactivate(self.ALARM)
+            except Exception:
+                pass
+            self._alarm_active = False
+
+    # --- wiring --------------------------------------------------------
+    def attach(self, broker) -> None:
+        """Register the connect gate at the 'client.connect' hookpoint
+        (highest priority — quota rejects before auth providers run,
+        emqx_license_app's hook posture)."""
+
+        def _gate(conninfo, acc):
+            reason = self.check_connect()
+            if reason is None:
+                return None  # continue the fold
+            from .broker.hooks import STOP
+
+            from .broker.packet import RC
+
+            return (STOP, RC.QUOTA_EXCEEDED)
+
+        # priority above exhook's 500: quota sheds before any
+        # out-of-process OnClientConnect round trip runs
+        broker.hooks.add("client.connect", _gate, priority=1000)
+
+    def info(self) -> Dict:
+        lim = self.limits()["max_connections"]
+        return {
+            **self.license.summary(),
+            "connection_low_watermark": f"{self.low_watermark:.0%}",
+            "connection_high_watermark": f"{self.high_watermark:.0%}",
+            "live_connections": self.connection_count(),
+            "effective_max_connections": (
+                "expired" if lim == EXPIRED
+                else "unlimited" if lim == UNLIMITED
+                else int(lim)
+            ),
+        }

@@ -177,6 +177,7 @@ class ManagementApi:
         listeners=None,  # broker.listeners.Listeners manager
         plugins=None,  # PluginManager
         bridges=None,  # BridgeRegistry
+        license=None,  # LicenseChecker
     ):
         from .audit import AuditLog
 
@@ -191,6 +192,7 @@ class ManagementApi:
         self.listeners = listeners
         self.plugins = plugins
         self.bridges = bridges
+        self.license = license
         self.evacuation = None  # NodeEvacuation, created on demand
         self.node_name = node_name
         self.backup_dir = backup_dir
@@ -311,6 +313,11 @@ class ManagementApi:
         r("GET", "/api/v5/api_key", lambda q: self.api_keys.list())
         r("POST", "/api/v5/api_key", self._api_key_create)
         r("DELETE", "/api/v5/api_key/{name}", self._api_key_delete)
+        if self.license is not None:
+            # ref: apps/emqx_license/src/emqx_license_http_api.erl
+            r("GET", "/api/v5/license", lambda q: self.license.info())
+            r("POST", "/api/v5/license", self._license_update)
+            r("PUT", "/api/v5/license/setting", self._license_setting)
         r("GET", "/api/v5/rules", self._rules_list)
         r("POST", "/api/v5/rules", self._rules_create)
         r("GET", "/api/v5/rules/{id}", self._rules_one)
@@ -970,6 +977,31 @@ class ManagementApi:
             for e in self.banned.list()
         ]
         return _paginate(items, req.query)
+
+    def _license_update(self, req: Request):
+        """POST /api/v5/license {key} — install a new license key
+        (emqx_license_http_api:'/license'(post))."""
+        body = req.json() or {}
+        key = body.get("key")
+        if not key:
+            return Response.error(400, "BAD_REQUEST", "missing field 'key'")
+        from ..license import LicenseError
+
+        try:
+            self.license.update_key(key)
+        except LicenseError as e:
+            return Response.error(400, "BAD_REQUEST", str(e))
+        return self.license.info()
+
+    def _license_setting(self, req: Request):
+        """PUT /api/v5/license/setting {connection_low_watermark,
+        connection_high_watermark}."""
+        body = req.json() or {}
+        try:
+            self.license.update_setting(body)
+        except (TypeError, ValueError) as e:
+            return Response.error(400, "BAD_REQUEST", str(e))
+        return self.license.info()
 
     def _banned_create(self, req: Request):
         if self.banned is None:

@@ -29,6 +29,7 @@ class Ctl:
         plugins=None,
         gateways=None,
         listeners=None,
+        license=None,
     ):
         self.broker = broker
         self.config = config
@@ -39,6 +40,7 @@ class Ctl:
         self.plugins = plugins
         self.gateways = gateways
         self.listeners = listeners
+        self.license = license
         self.started_at = time.time()
         self._cmds: Dict[str, Tuple[Callable, str]] = {}
         self._register_builtin()
@@ -106,6 +108,25 @@ class Ctl:
         )
         reg("gateways", self._gateways, "gateways list")
         reg("listeners", self._listeners, "listeners               # active listeners")
+        reg("license", self._license, "license info | update <key>")
+
+    def _license(self, args) -> str:
+        """emqx ctl license (emqx_license_cli.erl)."""
+        if self.license is None:
+            return "license checker not attached"
+        if not args or args[0] == "info":
+            return "\n".join(
+                f"{k:<28}: {v}" for k, v in self.license.info().items()
+            )
+        if args[0] == "update" and len(args) > 1:
+            from ..license import LicenseError
+
+            try:
+                lic = self.license.update_key(args[1])
+            except LicenseError as e:
+                return f"error: {e}"
+            return f"ok: licensed to {lic.customer} ({lic.type_name})"
+        return "usage: license info | update <key>"
 
     def _status(self, args) -> str:
         up = int(time.time() - self.started_at)

@@ -176,9 +176,9 @@ function rows(sel, html) {
 }
 async function tick() {
   if (tab === 'overview') {
-    const [stats, metrics, mon] = await Promise.all([
+    const [stats, metrics, mon, lic] = await Promise.all([
       get('/api/v5/stats'), get('/api/v5/metrics'),
-      get('/api/v5/monitor?latest=48')]);
+      get('/api/v5/monitor?latest=48'), get('/api/v5/license')]);
     if (!stats || !metrics) return;
     if (mon && mon.length) {
       spark(document.getElementById('c_recv'),
@@ -194,7 +194,10 @@ async function tick() {
       tile('topics', stats['topics.count'] ?? 0) +
       tile('messages received', metrics['messages.received'] ?? 0) +
       tile('messages delivered', metrics['messages.delivered'] ?? 0) +
-      tile('dropped', metrics['messages.dropped'] ?? 0);
+      tile('dropped', metrics['messages.dropped'] ?? 0) +
+      (lic ? tile('license (' + esc(lic.type) + ')',
+        esc(lic.live_connections) + ' / ' +
+        esc(lic.effective_max_connections)) : '');
   } else if (tab === 'clients') {
     const clients = await get('/api/v5/clients?limit=200');
     if (!clients) return;

@@ -1,0 +1,211 @@
+"""License parsing + connection-quota enforcement (VERDICT r4 #3).
+
+Ref: apps/emqx_license/src/emqx_license.erl (check/2 rejects with
+RC QUOTA_EXCEEDED past max_connections * 1.1),
+emqx_license_parser_v20220101.erl (signed payload.sig key format),
+emqx_license_checker.erl (cached limits, expiry), and
+emqx_license_http_api.erl (GET/POST /license).
+"""
+
+import asyncio
+import json
+import time
+import pytest
+from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+    Ed25519PrivateKey,
+)
+from cryptography.hazmat.primitives.serialization import (
+    Encoding, PublicFormat,
+)
+
+from emqx_tpu.license import (
+    EXPIRED, License, LicenseChecker, LicenseError, TYPE_OFFICIAL,
+    UNLIMITED, parse_license, sign_license,
+)
+
+
+def _issuer():
+    priv = Ed25519PrivateKey.generate()
+    pub_pem = priv.public_key().public_bytes(
+        Encoding.PEM, PublicFormat.SubjectPublicKeyInfo
+    ).decode()
+    return priv, pub_pem
+
+
+def test_default_key_is_unlimited_community():
+    lic = parse_license("default")
+    assert lic.max_connections == UNLIMITED
+    assert lic.type_name == "community"
+    assert not lic.expired()
+
+
+def test_sign_parse_roundtrip_and_tamper():
+    priv, pub = _issuer()
+    lic = License(
+        license_type=TYPE_OFFICIAL, customer_type=1, customer="acme",
+        email="ops@acme.io", deployment="prod", start_date="20260101",
+        days=365, max_connections=100,
+    )
+    key = sign_license(lic, priv)
+    got = parse_license(key, pub)
+    assert got.customer == "acme" and got.max_connections == 100
+    assert got.type_name == "official"
+    # wrong verification key
+    _, other_pub = _issuer()
+    with pytest.raises(LicenseError):
+        parse_license(key, other_pub)
+    # tampered payload (raise the entitlement) fails the signature
+    import base64
+
+    p64, s64 = key.split(".", 1)
+    fields = base64.b64decode(p64).decode().split("\n")
+    fields[8] = "1000000"
+    forged = base64.b64encode("\n".join(fields).encode()).decode()
+    with pytest.raises(LicenseError):
+        parse_license(forged + "." + s64, pub)
+    with pytest.raises(LicenseError):
+        parse_license("garbage", pub)
+
+
+def test_expiry_and_limits():
+    priv, pub = _issuer()
+    expired = sign_license(
+        License(start_date="20200101", days=30, max_connections=10), priv
+    )
+    chk = LicenseChecker(key=expired, public_key_pem=pub)
+    assert chk.limits()["max_connections"] == EXPIRED
+    assert chk.check_connect() == "license_expired"
+    perpetual = sign_license(
+        License(start_date="20200101", days=0, max_connections=10), priv
+    )
+    chk.update_key(perpetual)
+    assert chk.limits()["max_connections"] == 10
+
+
+def test_quota_gate_grace_and_watermark_alarm():
+    priv, pub = _issuer()
+    key = sign_license(
+        License(start_date="20200101", days=0, max_connections=10), priv
+    )
+    count = {"n": 0}
+
+    class Alarms:
+        def __init__(self):
+            self.active = {}
+
+        def activate(self, name, details=None, message=""):
+            self.active[name] = details
+
+        def deactivate(self, name, details=None, message=""):
+            self.active.pop(name, None)
+
+    alarms = Alarms()
+    chk = LicenseChecker(
+        key=key, count_fn=lambda: count["n"], alarms=alarms,
+        public_key_pem=pub,
+    )
+    assert chk.check_connect() is None
+    # inside grace (10 * 1.1 = 11): admitted, but watermark alarm fires
+    count["n"] = 11
+    chk._counted_at = 0  # expire the count cache
+    assert chk.check_connect() is None
+    assert "license_quota" in alarms.active
+    # past grace: rejected
+    count["n"] = 12
+    chk._counted_at = 0
+    assert chk.check_connect() == "license_quota"
+    # back under the low watermark: alarm clears
+    count["n"] = 2
+    chk._counted_at = 0
+    assert chk.check_connect() is None
+    assert "license_quota" not in alarms.active
+    # upgrading to unlimited while the alarm is active clears it too
+    count["n"] = 9
+    chk._counted_at = 0
+    chk.check_connect()
+    assert "license_quota" in alarms.active
+    chk.update_key("default")
+    assert "license_quota" not in alarms.active
+    assert chk.check_connect() is None
+
+
+def test_update_key_persists_through_config():
+    priv, pub = _issuer()
+    key = sign_license(
+        License(start_date="20200101", days=0, max_connections=7), priv
+    )
+    persisted = {}
+    chk = LicenseChecker(
+        key="default", public_key_pem=pub,
+        persist_fn=lambda k: persisted.update(key=k),
+    )
+    chk.update_key(key)
+    assert persisted["key"] == key  # survives a restart via config
+
+
+async def test_over_quota_connect_rejected_end_to_end(tmp_path):
+    """Over-quota CONNECT gets CONNACK QUOTA_EXCEEDED (v5) through a
+    booted node whose license came purely from config."""
+    from emqx_tpu.boot import Node
+    from emqx_tpu.broker import frame
+    from emqx_tpu.broker.packet import RC, Connack, Connect
+
+    priv, pub = _issuer()
+    key = sign_license(
+        License(start_date="20200101", days=0, max_connections=1), priv
+    )
+    conf = {
+        "node": {"name": "lic@127.0.0.1", "data_dir": str(tmp_path / "d")},
+        "listeners": {"tcp": {"default": {"bind": "127.0.0.1:0"}}},
+        "license": {"key": key, "public_key": pub},
+        "api": {"enable": True, "bind": "127.0.0.1:0"},
+    }
+    node = Node(config_text=json.dumps(conf))
+    await node.start()
+    try:
+        port = node.listeners.get("tcp", "default").listen_addr[1]
+
+        async def connect(cid, ver=5):
+            r, w = await asyncio.open_connection("127.0.0.1", port)
+            w.write(frame.serialize(Connect(client_id=cid, proto_ver=ver)))
+            await w.drain()
+            p = frame.Parser(proto_ver=ver)
+            pkts = []
+            while not any(isinstance(x, Connack) for x in pkts):
+                data = await asyncio.wait_for(r.read(4096), 5)
+                assert data
+                pkts += p.feed(data)
+            return next(x for x in pkts if isinstance(x, Connack)), w
+
+        ack1, w1 = await connect("dev-1")
+        assert ack1.code == 0
+        # grace factor 1.1 on max=1 floors at 1; the checker count
+        # cache refreshes every 5s — force it
+        node.license._counted_at = 0
+        for _ in range(3):  # count>1.1 needs >=2 live at count time
+            ack, w = await connect(f"spill-{_}")
+            node.license._counted_at = 0
+        ack3, _w3 = await connect("dev-over")
+        assert ack3.code == RC.QUOTA_EXCEEDED, hex(ack3.code)
+        # v3 client gets the mapped 0-5 range code
+        ack4, _w4 = await connect("dev-v3", ver=4)
+        assert ack4.code == 3
+
+        # quota visible over /api/v5 (emqx_license_http_api parity)
+        from test_mgmt import http_req
+
+        api_port = node.mgmt.http.listen_addr[1]
+        node.mgmt.add_user("admin", "pw12345")
+        _, login = await http_req(
+            api_port, "POST", "/api/v5/login",
+            {"username": "admin", "password": "pw12345"},
+        )
+        st, info = await http_req(
+            api_port, "GET", "/api/v5/license", token=login["token"]
+        )
+        assert st == 200
+        assert info["max_connections"] == 1
+        assert info["effective_max_connections"] == 1
+        w1.close()
+    finally:
+        await node.stop()
