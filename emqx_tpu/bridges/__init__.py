@@ -71,6 +71,9 @@ CONNECTOR_TYPES = {
     "syskeeper_forwarder": ("emqx_tpu.bridges.syskeeper", "SyskeeperConnector"),
     "syskeeper_proxy": ("emqx_tpu.bridges.syskeeper", "SyskeeperProxyConnector"),
     "hstreamdb": ("emqx_tpu.bridges.hstreamdb", "HStreamConnector"),
+    "oracle": ("emqx_tpu.bridges.oracle", "OracleConnector"),
+    "azure_event_hub": ("emqx_tpu.bridges.azure_event_hub",
+                        "AzureEventHubProducer"),
 }
 
 

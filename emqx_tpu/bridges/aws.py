@@ -136,7 +136,10 @@ class AwsHttp:
         except (OSError, asyncio.TimeoutError) as e:
             raise RecoverableError(f"connect failed: {e}") from e
         try:
-            target = path + (f"?{query}" if query else "")
+            # the wire target must be the SAME uri-encoded form the
+            # canonical request signed — a raw '@'/space in an object
+            # key otherwise yields SignatureDoesNotMatch at the server
+            target = quote(path, safe="/-_.~") + (f"?{query}" if query else "")
             head = [f"{method.upper()} {target} HTTP/1.1"]
             head += [f"{k}: {v}" for k, v in hdrs.items()]
             head += [f"content-length: {len(payload)}", "connection: close"]
@@ -214,8 +217,12 @@ class S3Client(AwsHttp):
 
 
 class S3Connector(Connector):
-    """Bridge driver: one object per message. Key template, e.g.
-    "${topic}/${id}" (emqx_bridge_s3 object_key)."""
+    """Bridge driver. `mode="direct"` (default): one object per
+    message with a ${}-templated key (emqx_bridge_s3 object_key).
+    `mode="aggregated"`: records buffer into time/size-windowed CSV or
+    JSON-lines containers (emqx_connector_aggregator) and each closed
+    container uploads as ONE object keyed by
+    `${action}/${node}/${datetime}_${seq}`-style templates."""
 
     wants_env = True
 
@@ -230,16 +237,48 @@ class S3Connector(Connector):
         key_template: str = "${topic}/${id}",
         content_type: str = "application/octet-stream",
         timeout: float = 5.0,
+        mode: str = "direct",
+        container: str = "csv",
+        time_interval: float = 3600.0,
+        max_records: int = 100_000,
+        action_name: str = "s3",
+        node_name: str = "emqx@127.0.0.1",
     ):
         self.client = S3Client(host, port, bucket, access_key, secret_key,
                                region, timeout)
         self.key_template = key_template
         self.content_type = content_type
+        assert mode in ("direct", "aggregated"), mode
+        self.mode = mode
+        self.aggregator = None
+        if mode == "aggregated":
+            from .aggregator import make_sink_aggregator
+
+            self.aggregator = make_sink_aggregator(
+                self.client.put_object,
+                container=container,
+                time_interval=time_interval,
+                max_records=max_records,
+                action_name=action_name,
+                node_name=node_name,
+                key_template=key_template,
+            )
+
+    async def on_start(self) -> None:
+        if self.aggregator is not None:
+            self.aggregator.start()
+
+    async def on_stop(self) -> None:
+        if self.aggregator is not None:
+            await self.aggregator.stop()
 
     async def on_query(self, request: Any) -> None:
         from ..rules.engine import render_template
 
         env = dict(request)
+        if self.aggregator is not None:
+            await self.aggregator.push(self.aggregator.sanitize(env))
+            return
         key = render_template(self.key_template, env)
         payload = env.get("payload", b"")
         if isinstance(payload, str):

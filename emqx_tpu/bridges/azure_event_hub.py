@@ -1,0 +1,34 @@
+"""Azure Event Hubs bridge — the Event Hubs Kafka-compatible endpoint.
+
+The reference app is a kafka-producer preset (apps/
+emqx_bridge_azure_event_hub/src/emqx_bridge_azure_event_hub.erl:1):
+authentication is pinned to SASL/PLAIN with username
+"$ConnectionString" and the namespace connection string as the
+password, required_acks pinned to all (Event Hubs offers no acks=1
+durability tier), port 9093. The wire protocol is unchanged kafka —
+the producer here rides bridges/kafka.py's record-batch v2 path with
+its SASL/PLAIN bootstrap.
+"""
+
+from __future__ import annotations
+
+from .kafka import KafkaProducer
+
+
+class AzureEventHubProducer(KafkaProducer):
+    """Kafka wire against an Event Hubs namespace."""
+
+    def __init__(
+        self,
+        bootstrap: str,  # "<namespace>.servicebus.windows.net:9093"
+        topic: str,  # the event hub name
+        connection_string: str = "",
+        **kw,
+    ):
+        # Event Hubs accepts ONLY this username; the connection string
+        # ("Endpoint=sb://...;SharedAccessKeyName=..;SharedAccessKey=..")
+        # is the whole secret
+        kw.setdefault("sasl_username", "$ConnectionString")
+        kw.setdefault("sasl_password", connection_string)
+        kw["required_acks"] = -1  # pinned, like the reference preset
+        super().__init__(bootstrap, topic, **kw)

@@ -443,12 +443,37 @@ class AzureBlobConnector(_HttpJsonBase):
 
     def __init__(self, host, port, account: str, account_key_b64: str,
                  container: str, blob_template: str = "${topic}/${id}",
-                 **kw):
+                 mode: str = "direct", agg_container: str = "csv",
+                 time_interval: float = 3600.0, max_records: int = 100_000,
+                 action_name: str = "azure_blob",
+                 node_name: str = "emqx@127.0.0.1", **kw):
         super().__init__(host, port, **kw)
         self.account = account
         self.key = base64.b64decode(account_key_b64)
         self.container = container
         self.blob_template = blob_template
+        assert mode in ("direct", "aggregated"), mode
+        self.mode = mode
+        self.aggregator = None
+        if mode == "aggregated":
+            from .aggregator import make_sink_aggregator
+
+            async def put(key: str, data: bytes, _ctype: str) -> None:
+                await self._put_blob(key, data)
+
+            self.aggregator = make_sink_aggregator(
+                put, container=agg_container, time_interval=time_interval,
+                max_records=max_records, action_name=action_name,
+                node_name=node_name, key_template=blob_template,
+            )
+
+    async def on_start(self) -> None:
+        if self.aggregator is not None:
+            self.aggregator.start()
+
+    async def on_stop(self) -> None:
+        if self.aggregator is not None:
+            await self.aggregator.stop()
 
     def _sign(self, verb: str, path: str, headers: Dict[str, str],
               body: bytes) -> str:
@@ -466,12 +491,7 @@ class AzureBlobConnector(_HttpJsonBase):
         ).decode()
         return f"SharedKey {self.account}:{sig}"
 
-    async def on_query(self, request: Any) -> Any:
-        env = dict(request)
-        blob = _render(self.blob_template, env)
-        payload = env.get("payload", b"")
-        if isinstance(payload, str):
-            payload = payload.encode()
+    async def _put_blob(self, blob: str, payload: bytes) -> str:
         path = f"/{self.container}/{blob}"
         now = datetime.datetime.now(datetime.timezone.utc).strftime(
             "%a, %d %b %Y %H:%M:%S GMT"
@@ -485,3 +505,14 @@ class AzureBlobConnector(_HttpJsonBase):
         headers["authorization"] = self._sign("PUT", path, headers, payload)
         await self._request("PUT", path, payload, headers)
         return blob
+
+    async def on_query(self, request: Any) -> Any:
+        env = dict(request)
+        if self.aggregator is not None:
+            await self.aggregator.push(self.aggregator.sanitize(env))
+            return None
+        blob = _render(self.blob_template, env)
+        payload = env.get("payload", b"")
+        if isinstance(payload, str):
+            payload = payload.encode()
+        return await self._put_blob(blob, payload)

@@ -44,6 +44,8 @@ API_PRODUCE = 0
 API_FETCH = 1
 API_OFFSETS = 2
 API_METADATA = 3
+API_SASL_HANDSHAKE = 17
+API_SASL_AUTHENTICATE = 36
 
 # error codes (kafka protocol)
 ERR_NONE = 0
@@ -335,6 +337,8 @@ class KafkaProducer(Connector):
         required_acks: int = -1,
         wire_version: int = 2,  # 2 = record batches (Produce v3/Fetch v4)
         compression: Optional[str] = None,
+        sasl_username: Optional[str] = None,
+        sasl_password: Optional[str] = None,
     ):
         host, _, port = bootstrap.rpartition(":")
         self.bootstrap = (host or "127.0.0.1", int(port))
@@ -342,6 +346,11 @@ class KafkaProducer(Connector):
         self.client_id = client_id
         self.timeout = timeout
         self.required_acks = required_acks
+        # SASL/PLAIN credentials (SaslHandshake v1 + SaslAuthenticate
+        # v0 per connection before any other API) — the kafka-compat
+        # endpoints (Azure Event Hubs, Confluent Cloud) require it
+        self.sasl_username = sasl_username
+        self.sasl_password = sasl_password
         assert wire_version in (0, 2), wire_version
         self.wire_version = wire_version
         # unsupported codecs rejected HERE, not mid-traffic
@@ -372,8 +381,52 @@ class KafkaProducer(Connector):
         reader, writer = await asyncio.wait_for(
             asyncio.open_connection(*addr), self.timeout
         )
+        if self.sasl_username is not None:
+            try:
+                await self._sasl_plain(reader, writer)
+            except Exception:
+                writer.close()
+                raise
         self._conns[addr] = (reader, writer)
         return reader, writer
+
+    async def _sasl_plain(self, reader, writer) -> None:
+        """SASL/PLAIN on a fresh connection: SaslHandshake (17) v1
+        then SaslAuthenticate (36) v0, before any other API call
+        (KIP-43/KIP-152 sequencing)."""
+
+        async def call(api_key, api_version, payload):
+            self._corr += 1
+            corr = self._corr
+            frame = (
+                struct.pack(">hhi", api_key, api_version, corr)
+                + _str(self.client_id)
+                + payload
+            )
+            writer.write(struct.pack(">i", len(frame)) + frame)
+            await asyncio.wait_for(writer.drain(), self.timeout)
+            (n,) = struct.unpack(">i", await asyncio.wait_for(
+                reader.readexactly(4), self.timeout))
+            body = await asyncio.wait_for(
+                reader.readexactly(n), self.timeout)
+            r = _Reader(body)
+            if r.i32() != corr:
+                raise QueryError("sasl correlation mismatch")
+            return r
+
+        r = await call(API_SASL_HANDSHAKE, 1, _str("PLAIN"))
+        err = r.i16()
+        if err != ERR_NONE:
+            raise QueryError(f"SASL handshake rejected ({err})")
+        token = (
+            b"\x00" + (self.sasl_username or "").encode()
+            + b"\x00" + (self.sasl_password or "").encode()
+        )
+        r = await call(API_SASL_AUTHENTICATE, 0, _bytes(token))
+        err = r.i16()
+        if err != ERR_NONE:
+            msg = r.string() or ""
+            raise QueryError(f"SASL authentication failed ({err}): {msg}")
 
     def _drop_conn(self, addr) -> None:
         c = self._conns.pop(addr, None)
@@ -640,9 +693,13 @@ class KafkaConsumer(KafkaProducer):
         max_wait_ms: int = 500,
         max_bytes: int = 1 << 20,
         wire_version: int = 2,
+        sasl_username: Optional[str] = None,
+        sasl_password: Optional[str] = None,
     ):
         super().__init__(bootstrap, topic, client_id=client_id,
-                         timeout=timeout, wire_version=wire_version)
+                         timeout=timeout, wire_version=wire_version,
+                         sasl_username=sasl_username,
+                         sasl_password=sasl_password)
         assert start_from in ("latest", "earliest")
         self.start_from = start_from
         self.max_wait_ms = max_wait_ms

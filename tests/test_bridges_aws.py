@@ -8,6 +8,7 @@ import base64
 import hashlib
 import hmac
 import json
+from urllib.parse import unquote
 
 import pytest
 
@@ -101,7 +102,11 @@ class MiniAws:
                 self.auth_failures += 1
                 status, out = 403, b"<Error><Code>SignatureDoesNotMatch</Code></Error>"
             else:
-                status, out = self.handler(method, path, query, headers, body)
+                # canonical verification used the wire (encoded) form;
+                # the handler sees the decoded object key, like S3
+                status, out = self.handler(
+                    method, unquote(path), query, headers, body
+                )
             writer.write(
                 f"HTTP/1.1 {status} X\r\ncontent-length: {len(out)}\r\n"
                 "connection: close\r\n\r\n".encode() + out

@@ -1,0 +1,338 @@
+"""Bridge wave 3 — the last reference families (VERDICT r4 #4):
+Oracle (TNS wire vs an in-process mini-server), Azure Event Hub
+(kafka wire + mandatory SASL/PLAIN with the $ConnectionString
+credential), and the connector aggregator feeding the S3 action's
+aggregated-upload mode end to end."""
+
+import asyncio
+import hashlib
+import os
+import struct
+
+import pytest
+
+from emqx_tpu.bridges.aggregator import Aggregator, Container
+from emqx_tpu.bridges.oracle import (
+    FN_AUTH,
+    FN_EXEC,
+    OracleConnector,
+    TNS_ACCEPT,
+    TNS_CONNECT,
+    TNS_DATA,
+    TNS_REFUSE,
+    TnsFramer,
+    password_verifier,
+    tns_packet,
+    _read_lstr,
+    _lstr,
+)
+from emqx_tpu.bridges.resource import QueryError
+
+
+# --- mini Oracle (TNS) ----------------------------------------------------
+
+
+class MiniOracle:
+    """Speaks the bridge's TNS subset: CONNECT/ACCEPT, salted auth
+    challenge, EXEC with ORA- errors for bad SQL."""
+
+    def __init__(self, service="ORCLPDB", user="scott", password="tiger"):
+        self.service = service
+        self.user = user
+        self.password = password
+        self.salt = os.urandom(16)
+        self.sqls = []
+        self.server = None
+        self.port = None
+        self._writers = []
+
+    async def start(self):
+        self.server = await asyncio.start_server(
+            self._handle, "127.0.0.1", 0
+        )
+        self.port = self.server.sockets[0].getsockname()[1]
+
+    async def stop(self):
+        self.server.close()
+        for w in self._writers:
+            w.close()
+        await self.server.wait_closed()
+
+    async def _handle(self, reader, writer):
+        self._writers.append(writer)
+        framer = TnsFramer()
+        authed = False
+        try:
+            while True:
+                data = await reader.read(65536)
+                if not data:
+                    return
+                for ptype, body in framer.feed(data):
+                    if ptype == TNS_CONNECT:
+                        desc = body[34:].decode("utf-8", "replace")
+                        if f"SERVICE_NAME={self.service}" not in desc:
+                            writer.write(tns_packet(
+                                TNS_REFUSE,
+                                b"\x00\x00\x00\x00ORA-12514: unknown service",
+                            ))
+                        else:
+                            writer.write(tns_packet(TNS_ACCEPT, b"\x01\x3a"))
+                    elif ptype == TNS_DATA:
+                        fn = body[2]
+                        if fn == FN_AUTH:
+                            user, off = _read_lstr(body, 3)
+                            if off >= len(body):  # phase 1: salt request
+                                writer.write(tns_packet(
+                                    TNS_DATA,
+                                    b"\x00\x00" + bytes([FN_AUTH])
+                                    + _lstr(self.salt),
+                                ))
+                            else:  # phase 2: verifier
+                                ver, _ = _read_lstr(body, off)
+                                want = password_verifier(
+                                    self.password, self.salt
+                                )
+                                ok = (
+                                    user.decode() == self.user
+                                    and ver == want
+                                )
+                                if ok:
+                                    authed = True
+                                    writer.write(tns_packet(
+                                        TNS_DATA, b"\x00\x00\x76\x00\x00"
+                                    ))
+                                else:
+                                    writer.write(tns_packet(
+                                        TNS_DATA,
+                                        b"\x00\x00\x76\x00\x01"
+                                        + _lstr(b"ORA-01017: invalid "
+                                                b"username/password"),
+                                    ))
+                        elif fn == FN_EXEC:
+                            sql, _ = _read_lstr(body, 7)
+                            text = sql.decode()
+                            if not authed:
+                                resp = (b"\x00\x00\x5e\x00\x01"
+                                        + _lstr(b"ORA-01012: not logged on"))
+                            elif text.upper().startswith(
+                                ("INSERT", "SELECT", "UPDATE", "DELETE")
+                            ):
+                                self.sqls.append(text)
+                                resp = (b"\x00\x00\x5e\x00\x00"
+                                        + struct.pack(">I", 1))
+                            else:
+                                resp = (b"\x00\x00\x5e\x00\x01"
+                                        + _lstr(b"ORA-00900: invalid SQL "
+                                                b"statement"))
+                            writer.write(tns_packet(TNS_DATA, resp))
+                await writer.drain()
+        except (ConnectionError, asyncio.CancelledError):
+            pass
+        finally:
+            writer.close()
+
+
+async def test_oracle_connect_auth_insert():
+    srv = MiniOracle()
+    await srv.start()
+    c = OracleConnector(
+        f"127.0.0.1:{srv.port}", "ORCLPDB", "scott", "tiger",
+        sql="INSERT INTO t_mqtt (topic, msg) VALUES (${topic}, ${payload})",
+    )
+    try:
+        await c.on_start()
+        n = await c.on_query({"topic": "t/1", "payload": "hello"})
+        assert n == 1
+        assert srv.sqls == [
+            "INSERT INTO t_mqtt (topic, msg) VALUES ('t/1', 'hello')"
+        ]
+        # SQL-injection shape stays literal (quote doubling)
+        await c.on_query({"topic": "t/2", "payload": "x'); DROP TABLE--"})
+        assert "''); DROP TABLE--'" in srv.sqls[-1]
+        # server-side ORA error surfaces as QueryError
+        c2 = OracleConnector(
+            f"127.0.0.1:{srv.port}", "ORCLPDB", "scott", "tiger",
+            sql="TRUNCATE nothing",
+        )
+        await c2.on_start()
+        with pytest.raises(QueryError, match="ORA-00900"):
+            await c2.on_query({})
+        await c2.on_stop()
+    finally:
+        await c.on_stop()
+        await srv.stop()
+
+
+async def test_oracle_bad_credentials_and_service():
+    srv = MiniOracle()
+    await srv.start()
+    try:
+        bad = OracleConnector(
+            f"127.0.0.1:{srv.port}", "ORCLPDB", "scott", "WRONG", sql="X"
+        )
+        with pytest.raises(QueryError, match="ORA-01017"):
+            await bad.client.connect()
+        refused = OracleConnector(
+            f"127.0.0.1:{srv.port}", "NOPE", "scott", "tiger", sql="X"
+        )
+        with pytest.raises(QueryError, match="ORA-12514"):
+            await refused.client.connect()
+    finally:
+        await srv.stop()
+
+
+# --- Azure Event Hub (kafka + SASL) ---------------------------------------
+
+
+async def test_azure_event_hub_sasl_produce():
+    from test_kafka import MiniKafka  # the house mini broker
+
+    from emqx_tpu.bridges.azure_event_hub import AzureEventHubProducer
+
+    connstr = (
+        "Endpoint=sb://ns.servicebus.windows.net/;"
+        "SharedAccessKeyName=send;SharedAccessKey=abc123"
+    )
+    srv = MiniKafka(
+        topic="hub1",
+        sasl_plain=("$ConnectionString", connstr),
+    )
+    await srv.start()
+    try:
+        p = AzureEventHubProducer(
+            f"127.0.0.1:{srv.port}", "hub1", connection_string=connstr,
+        )
+        assert p.required_acks == -1  # pinned like the reference preset
+        await p.on_start()
+        await p.on_query({"topic": "t/1", "payload": b"event-1"})
+        await p.on_query({"topic": "t/1", "payload": b"event-2"})
+        assert [v for _k, v in srv.records("hub1")] == [b"event-1", b"event-2"]
+        await p.on_stop()
+
+        # wrong connection string is refused at the SASL step
+        bad = AzureEventHubProducer(
+            f"127.0.0.1:{srv.port}", "hub1", connection_string="WRONG",
+        )
+        with pytest.raises(Exception, match="SASL"):
+            await bad.on_start()
+    finally:
+        await srv.stop()
+
+
+# --- connector aggregator --------------------------------------------------
+
+
+def test_container_csv_column_discovery():
+    c = Container("csv")
+    c.add({"a": 1, "b": "x"})
+    c.add({"b": "y,z", "c": None})
+    out = c.render().decode().splitlines()
+    assert out[0] == "a,b,c"
+    assert out[1] == "1,x,"
+    assert out[2] == ',"y,z",'  # quoting + missing column empty
+
+
+async def test_aggregator_windows_and_seq():
+    shipped = []
+
+    async def deliver(key, data):
+        shipped.append((key, data))
+
+    agg = Aggregator(
+        deliver, action="act", node="n1", container="json_lines",
+        time_interval=3600, max_records=2,
+    )
+    for i in range(5):
+        await agg.push({"i": i})
+    await agg.flush()
+    # 5 records, max 2/file -> 2 full + 1 flush, same window, seq 0..2
+    assert [k.rsplit("_", 1)[1] for k, _ in shipped] == ["0", "1", "2"]
+    assert sum(d.count(b"\n") for _, d in shipped) == 5
+
+
+async def test_aggregator_failed_delivery_retains_records():
+    """A transient delivery failure must neither drop the container
+    nor kill the rotation: records re-attach and the next flush ships
+    them."""
+    calls = {"n": 0}
+    shipped = []
+
+    async def flaky(key, data):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise ConnectionError("s3 down")
+        shipped.append((key, data))
+
+    agg = Aggregator(flaky, container="json_lines", time_interval=3600,
+                     max_records=2)
+    await agg.push({"i": 0})
+    with pytest.raises(ConnectionError):
+        await agg.push({"i": 1})  # size-roll -> delivery fails
+    await agg.flush()  # retries the SAME window
+    assert len(shipped) == 1 and shipped[0][1].count(b"\n") == 2
+    assert shipped[0][0].endswith("_0")
+
+
+async def test_kafka_consumer_sasl_source():
+    from test_kafka import MiniKafka
+
+    from emqx_tpu.bridges.kafka import KafkaConsumer, KafkaProducer
+
+    srv = MiniKafka(topic="hub2", sasl_plain=("user", "pw"))
+    await srv.start()
+    try:
+        p = KafkaProducer(f"127.0.0.1:{srv.port}", "hub2",
+                          sasl_username="user", sasl_password="pw")
+        await p.on_start()
+        await p.on_query({"payload": b"r1"})
+        got = []
+        c = KafkaConsumer(
+            f"127.0.0.1:{srv.port}", "hub2", start_from="earliest",
+            max_wait_ms=50, sasl_username="user", sasl_password="pw",
+        )
+        c.on_ingress = lambda rec: got.append(rec)
+        await c.on_start()
+        for _ in range(100):
+            if got:
+                break
+            await asyncio.sleep(0.02)
+        assert got and got[0].payload == b"r1"
+        await c.on_stop()
+        await p.on_stop()
+    finally:
+        await srv.stop()
+
+
+async def test_s3_aggregated_upload_end_to_end():
+    """The aggregated-upload e2e the VERDICT asked for: records flow
+    through the S3 action in aggregated mode and land as ONE CSV
+    object in the (mini) bucket, SigV4-signed like any other put."""
+    from test_bridges_aws import MiniAws, s3_store_handler
+
+    from emqx_tpu.bridges.aws import S3Connector
+
+    store = {}
+    srv = MiniAws(s3_store_handler(store))
+    await srv.start()
+    try:
+        c = S3Connector(
+            "127.0.0.1", srv.port, "agg-bucket",
+            access_key="AK", secret_key="SK",
+            mode="aggregated", container="csv",
+            time_interval=3600, max_records=100,
+            action_name="s3agg", node_name="n1@host",
+        )
+        await c.on_start()
+        for i in range(3):
+            await c.on_query(
+                {"topic": f"t/{i}", "payload": f"m{i}", "qos": 1}
+            )
+        await c.aggregator.flush()  # close the window (e2e determinism)
+        keys = [k for k in store if "/s3agg/" in k]
+        assert len(keys) == 1 and keys[0].endswith("_0.csv"), store.keys()
+        body = store[keys[0]].decode().splitlines()
+        assert body[0].split(",")[:3] == ["topic", "payload", "qos"]
+        assert len(body) == 4  # header + 3 records
+        await c.on_stop()
+    finally:
+        await srv.stop()

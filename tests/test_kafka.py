@@ -25,9 +25,12 @@ class MiniKafka:
     batches (CRC-32C verified, gzip decoded), serves Fetch v0/v4, and
     can inject one retriable error."""
 
-    def __init__(self, topic="events", n_partitions=2):
+    def __init__(self, topic="events", n_partitions=2, sasl_plain=None):
         self.topic = topic
         self.n_partitions = n_partitions
+        # (username, password) -> SASL/PLAIN REQUIRED before any API
+        # (the Azure Event Hub kafka endpoint posture)
+        self.sasl_plain = sasl_plain
         self.produced = {p: [] for p in range(n_partitions)}
         self.fail_next = 0  # inject NOT_LEADER (6) this many times
         self.serve_gzip = False  # Fetch v4 responses compress with gzip
@@ -47,11 +50,22 @@ class MiniKafka:
         self.addr = self._server.sockets[0].getsockname()[:2]
         return self.addr
 
+    @property
+    def port(self):
+        return self.addr[1]
+
+    def records(self, topic=None):
+        out = []
+        for p in sorted(self.produced):
+            out.extend(self.produced[p])
+        return out
+
     async def stop(self):
         self._server.close()
         await self._server.wait_closed()
 
     async def _client(self, reader, writer):
+        authed = False
         try:
             while True:
                 head = await reader.readexactly(4)
@@ -60,6 +74,36 @@ class MiniKafka:
                 r = _Reader(frame)
                 api, ver, corr = r.i16(), r.i16(), r.i32()
                 r.string()  # client id
+                if api == 17:  # SaslHandshake
+                    mech = r.string()
+                    err = ERR_NONE if mech == "PLAIN" else 33
+                    resp = struct.pack(">ih", corr, err)
+                    resp += struct.pack(">i", 1) + _str("PLAIN")
+                    writer.write(struct.pack(">i", len(resp)) + resp)
+                    await writer.drain()
+                    continue
+                if api == 36:  # SaslAuthenticate
+                    blen = r.i32()
+                    token = r.data[r.off:r.off + blen]
+                    parts = token.split(b"\x00")
+                    ok = (
+                        self.sasl_plain is not None
+                        and len(parts) == 3
+                        and parts[1].decode() == self.sasl_plain[0]
+                        and parts[2].decode() == self.sasl_plain[1]
+                    )
+                    err = ERR_NONE if ok else 58  # SASL_AUTHENTICATION_FAILED
+                    resp = struct.pack(">ih", corr, err)
+                    resp += _str(None if ok else "invalid credentials")
+                    resp += struct.pack(">i", 0)  # auth bytes
+                    writer.write(struct.pack(">i", len(resp)) + resp)
+                    await writer.drain()
+                    if not ok:
+                        break
+                    authed = True
+                    continue
+                if self.sasl_plain is not None and not authed:
+                    break  # unauthenticated API on a SASL-required port
                 if api == 3:
                     resp = self._metadata(corr)
                 elif api == 0:
