@@ -194,6 +194,10 @@ def _bytes(b: Optional[bytes]) -> bytes:
     return struct.pack(">i", len(b)) + b
 
 
+class _CorrMismatch(Exception):
+    pass
+
+
 class _Reader:
     def __init__(self, data: bytes):
         self.data = data
@@ -396,23 +400,12 @@ class KafkaProducer(Connector):
         (KIP-43/KIP-152 sequencing)."""
 
         async def call(api_key, api_version, payload):
-            self._corr += 1
-            corr = self._corr
-            frame = (
-                struct.pack(">hhi", api_key, api_version, corr)
-                + _str(self.client_id)
-                + payload
-            )
-            writer.write(struct.pack(">i", len(frame)) + frame)
-            await asyncio.wait_for(writer.drain(), self.timeout)
-            (n,) = struct.unpack(">i", await asyncio.wait_for(
-                reader.readexactly(4), self.timeout))
-            body = await asyncio.wait_for(
-                reader.readexactly(n), self.timeout)
-            r = _Reader(body)
-            if r.i32() != corr:
-                raise QueryError("sasl correlation mismatch")
-            return r
+            try:
+                return await self._call_on(
+                    reader, writer, api_key, api_version, payload
+                )
+            except _CorrMismatch as e:
+                raise QueryError(str(e)) from None
 
         r = await call(API_SASL_HANDSHAKE, 1, _str("PLAIN"))
         err = r.i16()
@@ -436,10 +429,12 @@ class KafkaProducer(Connector):
             except Exception:
                 pass
 
-    async def _call(
-        self, addr, api_key: int, api_version: int, payload: bytes,
-        expect_response: bool = True,
+    async def _call_on(
+        self, reader, writer, api_key: int, api_version: int,
+        payload: bytes, expect_response: bool = True,
     ) -> Optional[_Reader]:
+        """Framed request/response on an EXPLICIT connection (shared by
+        regular calls and the pre-registration SASL exchange)."""
         self._corr += 1
         corr = self._corr
         head = (
@@ -447,7 +442,6 @@ class KafkaProducer(Connector):
             + _str(self.client_id)
         )
         frame = head + payload
-        reader, writer = await self._conn(addr)
         writer.write(struct.pack(">i", len(frame)) + frame)
         await asyncio.wait_for(writer.drain(), self.timeout)
         if not expect_response:  # acks=0 produce: fire and forget
@@ -458,11 +452,24 @@ class KafkaProducer(Connector):
         r = _Reader(body)
         got_corr = r.i32()
         if got_corr != corr:
+            raise _CorrMismatch(f"correlation mismatch {got_corr} != {corr}")
+        return r
+
+    async def _call(
+        self, addr, api_key: int, api_version: int, payload: bytes,
+        expect_response: bool = True,
+    ) -> Optional[_Reader]:
+        reader, writer = await self._conn(addr)
+        try:
+            return await self._call_on(
+                reader, writer, api_key, api_version, payload,
+                expect_response,
+            )
+        except _CorrMismatch as e:
             # the stream is desynced: keeping it would poison every
             # later call on this connection
             self._drop_conn(addr)
-            raise QueryError(f"correlation mismatch {got_corr} != {corr}")
-        return r
+            raise QueryError(str(e)) from None
 
     # --- metadata -------------------------------------------------------
 

@@ -167,7 +167,7 @@ class OracleClient:
         ))
         await self._w.drain()
         ptype, body = await self._next_packet()
-        if ptype != TNS_DATA or len(body) < 3:
+        if ptype != TNS_DATA or len(body) < 5:
             raise QueryError("bad auth challenge")
         salt, _ = _read_lstr(body, 3)
         self._w.write(tns_packet(
@@ -214,8 +214,12 @@ class OracleClient:
                 raise ConnectionError("bad execute response")
             code, = struct.unpack_from(">H", body, 3)
             if code != 0:
+                if len(body) < 7:
+                    raise QueryError("ORA error with truncated detail")
                 err, _ = _read_lstr(body, 5)
                 raise QueryError(err.decode("utf-8", "replace"))
+            if len(body) < 9:
+                raise ConnectionError("truncated execute response")
             rows, = struct.unpack_from(">I", body, 5)
             return rows
 
@@ -226,6 +230,11 @@ class OracleClient:
             except Exception:
                 pass
             self._r = self._w = None
+        # a reconnect must start from a clean slate: leftover bytes or
+        # queued packets from the dead connection would desync the
+        # new session's framing
+        self._framer = TnsFramer()
+        self._pending = []
 
 
 class OracleConnector(Connector):

@@ -49,6 +49,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import threading
+import time as _time
 from collections import deque
 from typing import Deque, Dict, List, Optional, Set, Tuple
 
@@ -97,6 +98,24 @@ class ReplicatedDs:
         self._sess_dirty: Dict[str, dict] = {}
         self._sess_flush_pending = False
         self.sess_debounce_s = 0.05
+        # QUORUM FLOOR (r5 liveness work): majority is computed over
+        # every node this one has EVER seen in the membership, not the
+        # live view. A minority node whose failure detector purged the
+        # rest of the cluster would otherwise shrink its view to
+        # itself and "commit" alone — divergence the moment the
+        # partition heals. Grow-only is the conservative direction: an
+        # operator-removed node keeps counting toward the denominator
+        # until restart (documented stall, never a split commit).
+        self._known: Set[str] = {self.node_id}
+        self._pulling: Set[int] = set()  # shards with an in-flight pull
+        # leader retransmission (raft AppendEntries retry): unacked
+        # entries re-send to silent peers so a healed partition drains
+        # the stalled writes instead of relying on fresh traffic
+        self.retry_interval_s = 0.5
+        self._retry_task = None
+        self._beat_tick = 0
+        self._beat_last: Dict[int, int] = {}
+        self._spawn_retry()
         node.rpc.registry.register_all(
             "ds",
             2,
@@ -113,7 +132,17 @@ class ReplicatedDs:
         self.db.interceptor = self._submit
         manager.on_save = self._on_sess_save
         manager.on_discard = self._on_sess_discard
-        node.membership.on_member_up.append(lambda *_a: self._bump_term())
+        self._known.update(node.membership.members)
+
+        def _up(nid=None, *_a):
+            # learn the node BEFORE the view can shrink again — the
+            # quorum floor is only a floor if the denominator saw the
+            # node while it was up
+            if nid is not None:
+                self._known.add(nid)
+            self._bump_term()
+
+        node.membership.on_member_up.append(_up)
         node.membership.on_member_down.append(lambda *_a: self._bump_term())
 
     # --- leadership ------------------------------------------------------
@@ -138,7 +167,8 @@ class ReplicatedDs:
         return list(self.node.membership.members.items())
 
     def _majority(self) -> int:
-        return (len(self.node.membership.members) + 1) // 2 + 1
+        self._known.update(self.node.membership.members)
+        return len(self._known) // 2 + 1
 
     def _spawn(self, coro) -> None:
         """Schedule an RPC coroutine on the node's loop — writes arrive
@@ -160,6 +190,91 @@ class ReplicatedDs:
             except RuntimeError:
                 coro.close()
 
+    def _spawn_retry(self) -> None:
+        async def loop():
+            while True:
+                await asyncio.sleep(self.retry_interval_s)
+                try:
+                    self._retry_unacked()
+                except Exception:  # pragma: no cover - defensive
+                    log.exception("ds retry loop")
+
+        loop_obj = getattr(self.node, "_loop", None)
+        if loop_obj is None or loop_obj.is_closed():
+            return
+
+        def _start():
+            self._retry_task = asyncio.ensure_future(loop())
+
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if running is loop_obj:
+            _start()
+        else:
+            try:
+                loop_obj.call_soon_threadsafe(_start)
+            except RuntimeError:
+                pass
+
+    def _retry_unacked(self) -> None:
+        """Re-send unacked appends to peers that have not answered —
+        the liveness half of the commit protocol: without
+        retransmission, entries stranded by a partition stay stranded
+        after it heals until unrelated traffic surfaces a gap."""
+        now = _time.time()
+        with self._mutex:
+            cur_term = self.term
+            work = []
+            stale_shards = set()
+            for (s_, i), e in list(self._unacked.items()):
+                if e["committed"] or now - e.get("ts", 0.0) < self.retry_interval_s:
+                    continue
+                if e["term"] != cur_term:
+                    stale_shards.add(s_)
+                    continue
+                e["ts"] = now
+                work.append((s_, i, e["term"], e["payload"], set(e["acks"])))
+        for s_ in stale_shards:
+            # the term moved under these entries (membership change):
+            # re-route them through the current leader
+            self._step_down(s_)
+        for s_, i, t, p, acks in work:
+            for peer, addr in self._peers():
+                if peer not in acks:
+                    self._spawn(self._send_append(peer, addr, s_, i, t, p))
+        # commit-frontier heartbeat (raft's empty AppendEntries): for
+        # shards this node leads, re-advertise the applied frontier so
+        # a follower that missed an entire committed range (healed
+        # partition, no fresh traffic on the shard) detects the hole
+        # and pulls it — liveness must not depend on new writes.
+        # Suppressed to every 10th tick while the frontier is
+        # unchanged (laggards that missed an advert still hear one
+        # within ~5s; steady state is not S*P casts per tick).
+        self._beat_tick += 1
+        with self._mutex:
+            beats = []
+            for sh, idx in self._applied.items():
+                if idx <= 0 or self.leader_of(sh) != self.node_id:
+                    continue
+                if idx != self._beat_last.get(sh) or self._beat_tick % 10 == 0:
+                    self._beat_last[sh] = idx
+                    beats.append((sh, idx))
+        for sh, idx in beats:
+            for _peer, addr in self._peers():
+                self._spawn(self._cast_quiet(
+                    addr, "commit", (sh, idx, self.node_id), key=f"ds{sh}"
+                ))
+
+    async def _cast_quiet(self, addr, fn, args, key=None) -> None:
+        """Fire-and-forget cast; an unreachable peer is expected during
+        exactly the partitions this machinery exists for."""
+        try:
+            await self.node.rpc.cast(addr, "ds", fn, args, key=key)
+        except Exception:
+            pass
+
     # --- write path ------------------------------------------------------
 
     def _submit(self, shard: int, msgs: List[Message]) -> None:
@@ -176,10 +291,20 @@ class ReplicatedDs:
             self._leader_append(shard, [msg_to_wire(m) for m in msgs])
             return
         self._spawn(
-            self.node.rpc.cast(
-                addr, "ds", "write", ([msg_to_wire(m) for m in msgs],), key=f"ds{shard}"
-            )
+            self._forward_write(addr, shard, [msg_to_wire(m) for m in msgs])
         )
+
+    async def _forward_write(self, addr, shard: int, payload: list) -> None:
+        """Forward to the leader; an unreachable leader falls back to
+        local ordering (the append still needs a quorum, so nothing
+        uncommitted becomes visible — same posture as the unknown-
+        leader branch of _submit)."""
+        try:
+            await self.node.rpc.cast(
+                addr, "ds", "write", (payload,), key=f"ds{shard}"
+            )
+        except Exception:
+            self._leader_append(shard, payload)
 
     def _leader_append(self, shard: int, payload: list) -> None:
         with self._mutex:
@@ -206,7 +331,8 @@ class ReplicatedDs:
         self._pending.setdefault(shard, {})[idx] = (term, payload, self.node_id)
         self._accepted[shard] = max(self._accepted.get(shard, 0), idx)
         self._unacked[(shard, idx)] = {
-            "term": term, "payload": payload, "acks": set(), "committed": False,
+            "term": term, "payload": payload, "acks": set(),
+            "committed": False, "ts": _time.time(),
         }
         return idx
 
@@ -381,6 +507,12 @@ class ReplicatedDs:
                 # stale sender's committed log is a prefix of ours, so
                 # forcing is at worst a no-op rewrite. accepted moves
                 # only contiguously (holes must stay gap-detectable).
+                # EXCEPT a pending entry carrying a strictly NEWER term
+                # (ADVICE r4): a stale catch-up stream must not clobber
+                # the current leader's in-flight entry — conflict sends
+                # the stale sender back through leadership sync.
+                if cur is not None and cur[0] > term:
+                    return ("conflict",)
                 self._pending.setdefault(shard, {})[idx] = (
                     term, payload, _from
                 )
@@ -419,10 +551,23 @@ class ReplicatedDs:
         the true committed range streams over; its own leader got
         \'conflict\' and resubmits the payload."""
         applied_any = False
+        want_pull = None
         with self._mutex:
             pend = self._pending.get(shard, {})
             nxt = self._applied.get(shard, 0) + 1
+            advertised = upto
             upto = min(upto, self._accepted.get(shard, 0))
+            if (
+                advertised > self._accepted.get(shard, 0)
+                and leader is not None
+                and shard not in self._pulling
+            ):
+                # the notifier committed past everything we hold —
+                # pull the missing committed range (follower-side gap
+                # heal; the push side covers appends, this covers
+                # frontier heartbeats)
+                self._pulling.add(shard)
+                want_pull = (leader, self._applied.get(shard, 0))
             while nxt <= upto:
                 e = pend.get(nxt)
                 if e is None:
@@ -447,6 +592,33 @@ class ReplicatedDs:
                 nxt += 1
         if applied_any:
             self.db._notify()
+        if want_pull is not None:
+            self._spawn(self._pull_missing(shard, want_pull[0], want_pull[1]))
+
+    async def _pull_missing(self, shard: int, leader: str, after: int) -> None:
+        """Pull the committed range above `after` from the advertising
+        leader and apply it in order."""
+        try:
+            addr = self.node.membership.members.get(leader)
+            if addr is None:
+                return
+            try:
+                entries = await self.node.rpc.call(
+                    addr, "ds", "replay", (shard, after)
+                )
+            except Exception:
+                return
+            applied_any = False
+            with self._mutex:
+                for i, p in sorted(entries):
+                    if i == self._applied.get(shard, 0) + 1:
+                        self._apply_locked(shard, i, p)
+                        applied_any = True
+                self._advance_accepted(shard)
+            if applied_any:
+                self.db._notify()
+        finally:
+            self._pulling.discard(shard)
 
     def _handle_tail(self, shard: int, term: int = 0):
         """(applied, [(idx, term, payload) pending in order]) — leader
@@ -645,6 +817,13 @@ class ReplicatedDs:
     # --- lifecycle --------------------------------------------------------
 
     def detach(self) -> None:
+        t = self._retry_task
+        if t is not None:
+            self._retry_task = None
+            try:
+                t.cancel()
+            except Exception:
+                pass
         self.db.interceptor = None
         self.manager.on_save = None
         self.manager.on_discard = None
