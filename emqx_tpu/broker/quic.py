@@ -11,19 +11,23 @@ connection machinery — long/short header packets with coalescing,
 CRYPTO / STREAM / ACK / HANDSHAKE_DONE / CONNECTION_CLOSE frames,
 per-space packet numbers, and ordered stream reassembly.
 
-Scope: the profile our endpoints need, now including the RFC 9002
-minimum recovery machinery — per-space sent-packet tracking,
-packet-threshold loss declaration off ACK ranges, PTO timers with
-exponential backoff (server _pto_loop / client endpoint pump), and
-retransmission of lost CRYPTO/STREAM ranges — so a lossy link heals
-instead of idling out. Flow control is real both ways: finite
-windows are advertised and ENFORCED on receive (FLOW_CONTROL_ERROR
-on overrun), replenished with MAX_DATA/MAX_STREAM_DATA as the app
-consumes, and the peer's advertised windows gate our sends. TLS-PSK
-(psk_dhe_ke) authenticates clients against a PskStore when the
-listener carries one. One bidirectional stream (id 0) is served —
-exactly the reference's single-stream mode; congestion control
-beyond PTO pacing is future work."""
+Scope: the profile our endpoints need, including the RFC 9002
+recovery machinery — per-space sent-packet tracking, packet-threshold
+loss declaration off ACK ranges, smoothed-RTT PTO timers that send
+PROBES (not full-flight retransmits) with exponential backoff, and
+retransmission of lost CRYPTO/STREAM ranges — plus NewReno
+congestion control (RFC 9002 §7: slow start / congestion avoidance /
+halving once per recovery period), so a lossy-but-fat link
+retransmits under a cwnd, not at line rate. Flow control is real
+both ways: finite windows are advertised and ENFORCED on receive
+(FLOW_CONTROL_ERROR on overrun), replenished with
+MAX_DATA/MAX_STREAM_DATA per stream as the app consumes, and the
+peer's advertised windows gate our sends. TLS-PSK (psk_dhe_ke)
+authenticates clients against a PskStore when the listener carries
+one. Stream 0 is the MQTT control stream (the reference's
+single-stream mode); additional client-initiated bidirectional
+streams are served as DATA streams with per-stream MQTT parsing and
+same-stream replies (multi-stream mode, emqx_quic_data_stream.erl)."""
 
 from __future__ import annotations
 
@@ -95,16 +99,41 @@ def encode_transport_params(scid: bytes,
 class _SentPacket:
     """Bookkeeping for one ack-eliciting packet in flight."""
 
-    __slots__ = ("time", "crypto", "stream", "hs_done", "ping", "fc")
+    __slots__ = ("time", "crypto", "stream", "hs_done", "ping", "fc",
+                 "size")
 
     def __init__(self, time, crypto=None, stream=None, hs_done=False,
                  ping=False, fc=False):
         self.time = time
         self.crypto = crypto  # (offset, length) into crypto_out
-        self.stream = stream  # (abs offset, length) of stream data
+        self.stream = stream  # (stream id, abs offset, length)
         self.hs_done = hs_done
         self.ping = ping
         self.fc = fc  # carried a MAX_DATA/MAX_STREAM_DATA update
+        self.size = 0  # wire bytes (congestion accounting)
+
+
+class _StreamState:
+    """Per-stream send/receive state (RFC 9000 §2). Stream 0 is the
+    MQTT control stream (the reference's single-stream mode); further
+    client-initiated bidirectional streams (4, 8, ...) are the
+    multi-stream mode's data streams (emqx_quic_data_stream.erl)."""
+
+    __slots__ = ("rx", "rx_off", "out", "sent", "unacked", "rtx",
+                 "fin_rcvd", "tx_max", "rx_max", "consumed", "rx_hwm")
+
+    def __init__(self, tx_max: int, rx_max: int) -> None:
+        self.rx: Dict[int, bytes] = {}
+        self.rx_off = 0
+        self.out = b""  # unsent suffix
+        self.sent = 0  # absolute stream offset already sent
+        self.unacked: Dict[int, bytes] = {}
+        self.rtx: List[Tuple[int, bytes]] = []
+        self.fin_rcvd = False
+        self.tx_max = tx_max  # peer's allowance for OUR sends
+        self.rx_max = rx_max  # our advertised window
+        self.consumed = 0
+        self.rx_hwm = 0  # highest received offset (FC accounting)
 
 
 class _Space:
@@ -140,33 +169,89 @@ class QuicConnection:
         self.dcid = dcid  # peer's CID
         self.spaces = {lvl: _Space() for lvl in LEVELS}
         self.tls = None  # set by subclass
-        self.stream_rx: Dict[int, bytes] = {}
-        self.stream_rx_off = 0
-        self.stream_out = b""  # unsent suffix only (trimmed on flush)
-        self.stream_sent = 0  # absolute stream offset already sent
-        # unacked sent stream chunks (abs_off -> bytes) + declared-lost
-        # chunks awaiting retransmission
-        self._stream_unacked: Dict[int, bytes] = {}
-        self._stream_rtx: List[Tuple[int, bytes]] = []
+        # per-stream state; stream 0 always exists (control stream)
+        self._init_tx_max_stream = 1 << 14
+        self.streams: Dict[int, _StreamState] = {}
+        self._stream(0)
+        # streams whose MAX_STREAM_DATA replenish is due
+        self._fc_stream_due: set = set()
         # --- flow control (RFC 9000 §4) ---
         # peer's allowance for OUR sends (from its transport params /
-        # MAX_DATA / MAX_STREAM_DATA); conservative until params parse
+        # MAX_DATA); conservative until params parse
         self.tx_max_data = 1 << 14
-        self.tx_max_stream = 1 << 14
         self._peer_params_seen = False
-        # OUR advertised windows (enforced on receive, replenished as
-        # the app consumes)
+        # OUR advertised connection window (enforced on receive,
+        # replenished as the app consumes)
         self.rx_max_data = FC_CONN_WINDOW
-        self.rx_max_stream = FC_STREAM_WINDOW
         self._rx_consumed = 0
+        self._rx_hwm_total = 0  # sum of per-stream receive high-water marks
         self._fc_update_due = False
         self._clock = __import__("time").monotonic
-        self.stream_fin_rcvd = False
         self.on_stream_data: Optional[Callable[[bytes], None]] = None
+        # multi-stream seam: inbound bytes for sid != 0 (data streams)
+        self.on_data_stream: Optional[Callable[[int, bytes], None]] = None
         self.on_close: Optional[Callable[[], None]] = None
         self.handshake_done = False
         self.closed = False
         self.close_pending: Optional[Tuple[int, str]] = None
+        # --- congestion control (RFC 9002 §7, NewReno) ---
+        self.max_datagram_size = 1200
+        self.cwnd = 10 * self.max_datagram_size
+        self.ssthresh = float("inf")
+        self.bytes_in_flight = 0
+        self._recovery_start = 0.0  # packets sent before this don't
+        # trigger a NEW congestion event (once per RTT, §7.3.1)
+        # PTO probes may exceed cwnd (§7.5) — but ONLY probes, one
+        # credit per fired PTO; threshold-loss retransmissions wait
+        # for window room like everything else
+        self._probe_credit = 0
+        # total stream bytes sent (connection-level MAX_DATA is a sum
+        # across streams, not per stream)
+        self.tx_sent_total = 0
+        # --- RTT estimate (RFC 9002 §5) ---
+        self.srtt: Optional[float] = None
+        self.rttvar = 0.0
+
+    MAX_STREAMS = 32  # accepted concurrent streams per connection
+    # (DoS bound: each stream can buffer up to FC_STREAM_WINDOW of
+    # reassembly; the reference's quicer listener caps streams too)
+
+    def _stream(self, sid: int) -> _StreamState:
+        st = self.streams.get(sid)
+        if st is None:
+            st = self.streams[sid] = _StreamState(
+                self._init_tx_max_stream, FC_STREAM_WINDOW
+            )
+        return st
+
+    # --- stream-0 back-compat surface (single-stream callers/tests) ---
+    @property
+    def stream_out(self) -> bytes:
+        return self.streams[0].out
+
+    @property
+    def stream_sent(self) -> int:
+        return self.streams[0].sent
+
+    @property
+    def stream_fin_rcvd(self) -> bool:
+        return self.streams[0].fin_rcvd
+
+    @property
+    def rx_max_stream(self) -> int:
+        return self.streams[0].rx_max
+
+    @rx_max_stream.setter
+    def rx_max_stream(self, v: int) -> None:
+        self.streams[0].rx_max = v
+
+    @property
+    def tx_max_stream(self) -> int:
+        return self.streams[0].tx_max
+
+    @tx_max_stream.setter
+    def tx_max_stream(self, v: int) -> None:
+        self.streams[0].tx_max = v
 
     def _maybe_parse_peer_params(self) -> None:
         if self._peer_params_seen or self.tls is None:
@@ -193,10 +278,13 @@ class QuicConnection:
             except Exception:
                 return default
         self.tx_max_data = vint(0x04, self.tx_max_data)
-        # stream 0 is client-initiated bidi: the sender honors the
+        # streams here are client-initiated bidi: the sender honors the
         # receiver's bidi_remote (server side) / bidi_local (client)
         tid = 0x06 if not self.is_server else 0x05
-        self.tx_max_stream = vint(tid, self.tx_max_stream)
+        init_max = vint(tid, self._init_tx_max_stream)
+        self._init_tx_max_stream = init_max
+        for st in self.streams.values():
+            st.tx_max = max(st.tx_max, init_max)
         self._peer_params_seen = True
 
     # --- frame/packet building -----------------------------------------
@@ -294,53 +382,85 @@ class QuicConnection:
                 out += bytes([FT_HANDSHAKE_DONE])
                 self._hs_done_sent = True
                 mark(hs_done=True)
-            if self._fc_update_due:
-                # replenish the peer's send window as the app consumed
+            if self._fc_update_due or self._fc_stream_due:
+                # replenish the peer's send windows as the app consumed
                 self.rx_max_data = self._rx_consumed + FC_CONN_WINDOW
-                self.rx_max_stream = self._rx_consumed + FC_STREAM_WINDOW
                 out += bytes([FT_MAX_DATA]) + enc_varint(self.rx_max_data)
-                out += (
-                    bytes([FT_MAX_STREAM_DATA]) + enc_varint(0)
-                    + enc_varint(self.rx_max_stream)
-                )
-                self._fc_update_due = False
-                mark(fc=True)
-            self._maybe_parse_peer_params()
-            # retransmit lost stream chunks before new data
-            if self._stream_rtx:
-                s_off, chunk = self._stream_rtx.pop(0)
-                if len(chunk) > MAX_STREAM_CHUNK:  # legacy oversize
-                    self._stream_rtx.insert(
-                        0, (s_off + MAX_STREAM_CHUNK, chunk[MAX_STREAM_CHUNK:])
+                fc_sids = sorted(self._fc_stream_due or {0})
+                for sid in fc_sids:
+                    st = self._stream(sid)
+                    st.rx_max = st.consumed + FC_STREAM_WINDOW
+                    out += (
+                        bytes([FT_MAX_STREAM_DATA]) + enc_varint(sid)
+                        + enc_varint(st.rx_max)
                     )
-                    chunk = chunk[:MAX_STREAM_CHUNK]
+                self._fc_update_due = False
+                self._fc_stream_due.clear()
+                # fc records WHICH stream windows rode this packet so
+                # a loss re-advertises exactly those (a lost data-
+                # stream MAX_STREAM_DATA would otherwise deadlock it)
+                mark(fc=tuple(fc_sids))
+            self._maybe_parse_peer_params()
+            # congestion window (RFC 9002 §7): new data AND threshold-
+            # loss retransmissions are gated by cwnd (a halved window
+            # must not re-burst the lost flight at line rate); only
+            # PTO PROBES may exceed it (§7.5), one per fired PTO via
+            # _probe_credit — without that exemption a fully
+            # blackholed window deadlocks recovery.
+            cc_room = self.cwnd - self.bytes_in_flight
+            can_send = cc_room > 0 or self.bytes_in_flight == 0
+            use_probe = False
+            if not can_send and self._probe_credit > 0:
+                can_send = use_probe = True
+            stream_frame = None  # (sid, off, chunk)
+            if can_send:
+                for sid in sorted(self.streams):
+                    st = self.streams[sid]
+                    # retransmit lost chunks before new data
+                    if st.rtx:
+                        s_off, chunk = st.rtx.pop(0)
+                        if len(chunk) > MAX_STREAM_CHUNK:  # legacy oversize
+                            st.rtx.insert(
+                                0,
+                                (
+                                    s_off + MAX_STREAM_CHUNK,
+                                    chunk[MAX_STREAM_CHUNK:],
+                                ),
+                            )
+                            chunk = chunk[:MAX_STREAM_CHUNK]
+                        stream_frame = (sid, s_off, chunk)
+                        st.unacked[s_off] = chunk
+                        break
+                    if st.out:
+                        # peer flow control: the stream window bounds
+                        # this stream's offset, the CONNECTION window
+                        # bounds the SUM across streams (§4.1)
+                        allowance = max(
+                            0,
+                            min(
+                                st.tx_max - st.sent,
+                                self.tx_max_data - self.tx_sent_total,
+                            ),
+                        )
+                        chunk = st.out[:min(allowance, MAX_STREAM_CHUNK)]
+                        if chunk:
+                            stream_frame = (sid, st.sent, chunk)
+                            st.unacked[st.sent] = chunk
+                            st.sent += len(chunk)
+                            self.tx_sent_total += len(chunk)
+                            st.out = st.out[len(chunk):]
+                            break
+            if stream_frame is not None and use_probe:
+                self._probe_credit -= 1
+            if stream_frame is not None:
+                sid, s_off, chunk = stream_frame
                 out += (
-                    bytes([FT_STREAM_BASE | 0x04 | 0x02])
-                    + enc_varint(0) + enc_varint(s_off)
+                    bytes([FT_STREAM_BASE | 0x04 | 0x02])  # off+len
+                    + enc_varint(sid)
+                    + enc_varint(s_off)
                     + enc_varint(len(chunk)) + chunk
                 )
-                self._stream_unacked[s_off] = chunk
-                mark(stream=(s_off, len(chunk)))
-            elif self.stream_out:
-                # peer flow control: send only within its advertised
-                # connection + stream windows (RFC 9000 §4.1)
-                allowance = max(
-                    0,
-                    min(self.tx_max_data, self.tx_max_stream)
-                    - self.stream_sent,
-                )
-                chunk = self.stream_out[:min(allowance, MAX_STREAM_CHUNK)]
-                if chunk:
-                    out += (
-                        bytes([FT_STREAM_BASE | 0x04 | 0x02])  # off+len
-                        + enc_varint(0)  # stream 0
-                        + enc_varint(self.stream_sent)
-                        + enc_varint(len(chunk)) + chunk
-                    )
-                    self._stream_unacked[self.stream_sent] = chunk
-                    mark(stream=(self.stream_sent, len(chunk)))
-                    self.stream_sent += len(chunk)
-                    self.stream_out = self.stream_out[len(chunk):]
+                mark(stream=(sid, s_off, len(chunk)))
             if self.close_pending is not None:
                 code, reason = self.close_pending
                 r = reason.encode()[:64]
@@ -374,6 +494,8 @@ class QuicConnection:
                 pkt, pn = self._build_packet(level, frames)
                 dgram += pkt
                 if meta is not None:
+                    meta.size = len(pkt)
+                    self.bytes_in_flight += meta.size
                     sp.sent[pn] = meta
                     sp.last_eliciting_sent = meta.time
             if not dgram:
@@ -479,10 +601,14 @@ class QuicConnection:
                 for i in range(rc):
                     gap, off = dec_varint(payload, off)
                     rng, off = dec_varint(payload, off)
-                    if i < 256:  # DoS cap on TRACKED ranges; the rest
-                        hi = lo - gap - 2  # still parse (frame sync)
+                    if i < 1024:  # DoS cap on TRACKED ranges; the rest
+                        hi = lo - gap - 2  # still parse (frame sync).
                         ranges.append((hi - rng, hi))
                         lo = hi - rng
+                    # beyond the cap (a pathologically lossy link),
+                    # unmatched acked packets are later threshold-lost
+                    # and retransmit — duplicates the receiver already
+                    # tolerates (ADVICE r4: bandwidth, not corruption)
                 self._on_ack(level, ranges)
                 continue
             if ft == FT_CRYPTO:
@@ -503,8 +629,7 @@ class QuicConnection:
                     slen = n - off
                 data = payload[off : off + slen]
                 off += slen
-                if sid == 0:
-                    self._stream_in(s_off, data, bool(ft & 0x01))
+                self._stream_in(sid, s_off, data, bool(ft & 0x01))
                 eliciting = True
                 continue
             if ft in (FT_CONN_CLOSE, FT_CONN_CLOSE_APP):
@@ -525,9 +650,13 @@ class QuicConnection:
                 eliciting = True
                 continue
             if ft == FT_MAX_STREAM_DATA:
-                _sid, off = dec_varint(payload, off)
+                sid, off = dec_varint(payload, off)
                 v, off = dec_varint(payload, off)
-                self.tx_max_stream = max(self.tx_max_stream, v)
+                # only update KNOWN streams — a flood of window frames
+                # for arbitrary ids must not allocate state
+                st = self.streams.get(sid)
+                if st is not None:
+                    st.tx_max = max(st.tx_max, v)
                 eliciting = True
                 continue
             if ft in (0x12, 0x13):  # MAX_STREAMS
@@ -565,41 +694,67 @@ class QuicConnection:
                 log.warning("quic tls failure: %s", e)
                 self.close(0x0128, str(e))
 
-    def _stream_in(self, s_off: int, data: bytes, fin: bool) -> None:
-        if s_off + len(data) > min(self.rx_max_data, self.rx_max_stream):
-            # the peer overran the window we advertised (RFC 9000
+    def _stream_in(self, sid: int, s_off: int, data: bytes, fin: bool) -> None:
+        if sid % 4 != 0:
+            # only client-initiated bidirectional streams are served
+            # (the reference's quicer listener accepts the same set)
+            self.close(0x05, f"unsupported stream id {sid}")
+            return
+        st = self.streams.get(sid)
+        if st is None:
+            if len(self.streams) >= self.MAX_STREAMS:
+                self.close(0x04, "stream limit exceeded")
+                return
+            st = self._stream(sid)
+        end = s_off + len(data)
+        # FC accounting is OFFSET-based (RFC 9000 §4.1): duplicates /
+        # retransmissions never advance the high-water marks, so a
+        # PTO-probed copy of delivered data cannot trip a violation
+        hwm_delta = max(0, end - st.rx_hwm)
+        if end > st.rx_max or (
+            self._rx_hwm_total + hwm_delta > self.rx_max_data
+        ):
+            # the peer overran a window we advertised (RFC 9000
             # §4.1): FLOW_CONTROL_ERROR, not silent acceptance
             self.close(0x03, "flow control violated")
             return
-        if s_off + len(data) <= self.stream_rx_off:
+        st.rx_hwm = end if end > st.rx_hwm else st.rx_hwm
+        self._rx_hwm_total += hwm_delta
+        if s_off + len(data) <= st.rx_off:
             return  # spurious retransmission of delivered data
-        if s_off < self.stream_rx_off:
+        if s_off < st.rx_off:
             # trim the already-delivered prefix so the chunk keys at
             # the reassembly cursor (a stale key would leak forever)
-            data = data[self.stream_rx_off - s_off:]
-            s_off = self.stream_rx_off
-        self.stream_rx[s_off] = data
+            data = data[st.rx_off - s_off:]
+            s_off = st.rx_off
+        st.rx[s_off] = data
         out = b""
-        while self.stream_rx_off in self.stream_rx:
-            chunk = self.stream_rx.pop(self.stream_rx_off)
+        while st.rx_off in st.rx:
+            chunk = st.rx.pop(st.rx_off)
             out += chunk
-            self.stream_rx_off += len(chunk)
+            st.rx_off += len(chunk)
         if out:
             self._rx_consumed += len(out)
+            st.consumed += len(out)
             # replenish once half of EITHER window is consumed — the
             # (smaller) stream window exhausts first; keying only off
             # the connection window would deadlock a conformant peer
-            if (
-                self.rx_max_data - self._rx_consumed < FC_CONN_WINDOW // 2
-                or self.rx_max_stream - self._rx_consumed
-                < FC_STREAM_WINDOW // 2
-            ):
+            if self.rx_max_data - self._rx_consumed < FC_CONN_WINDOW // 2:
                 self._fc_update_due = True
-            if self.on_stream_data is not None:
-                self.on_stream_data(out)
+            if st.rx_max - st.consumed < FC_STREAM_WINDOW // 2:
+                self._fc_stream_due.add(sid)
+            if sid == 0:
+                if self.on_stream_data is not None:
+                    self.on_stream_data(out)
+            elif self.on_data_stream is not None:
+                self.on_data_stream(sid, out)
         if fin:
-            self.stream_fin_rcvd = True
-            self._closed_by_peer()
+            st.fin_rcvd = True
+            if sid == 0:
+                # the control stream closing ends the connection (the
+                # reference tears the channel down with it); a data
+                # stream's FIN just finishes that stream
+                self._closed_by_peer()
 
     def _on_ack(self, level: str, ranges: list) -> None:
         sp = self.spaces[level]
@@ -612,13 +767,61 @@ class QuicConnection:
         if not newly:
             return
         sp.pto_count = 0  # forward progress resets the backoff
+        now = self._clock()
+        # RTT sample off the largest newly-acked packet (RFC 9002 §5)
+        largest_newly = max(newly)
+        sample = now - sp.sent[largest_newly].time
+        if sample >= 0:
+            if self.srtt is None:
+                self.srtt = sample
+                self.rttvar = sample / 2
+            else:
+                self.rttvar = 0.75 * self.rttvar + 0.25 * abs(
+                    self.srtt - sample
+                )
+                self.srtt = 0.875 * self.srtt + 0.125 * sample
         for pn in newly:
             meta = sp.sent.pop(pn)
+            self.bytes_in_flight = max(0, self.bytes_in_flight - meta.size)
+            self._cc_on_ack(meta)
             if meta.stream is not None:
-                self._stream_unacked.pop(meta.stream[0], None)
+                sid, s_off, _ln = meta.stream
+                st = self.streams.get(sid)
+                if st is not None:
+                    st.unacked.pop(s_off, None)
         claimed = max(hi for _lo, hi in ranges)
         sp.largest_acked = max(sp.largest_acked, min(claimed, sent_max))
         self._detect_losses(sp)
+
+    # --- congestion control (RFC 9002 §7: NewReno) ----------------------
+
+    def _cc_on_ack(self, meta: "_SentPacket") -> None:
+        if meta.size <= 0 or meta.time <= self._recovery_start:
+            return  # acks for pre-recovery packets don't grow cwnd
+        if self.cwnd < self.ssthresh:
+            self.cwnd += meta.size  # slow start (§7.3.1)
+        else:
+            # congestion avoidance: ~one MTU per cwnd of acked bytes
+            self.cwnd += (
+                self.max_datagram_size * meta.size // max(self.cwnd, 1)
+            )
+
+    def _cc_on_loss(self, meta: "_SentPacket") -> None:
+        self.bytes_in_flight = max(0, self.bytes_in_flight - meta.size)
+        if meta.time <= self._recovery_start:
+            return  # one congestion event per recovery period (§7.3.1)
+        self._recovery_start = self._clock()
+        self.ssthresh = max(self.cwnd // 2, 2 * self.max_datagram_size)
+        self.cwnd = self.ssthresh
+
+    def _pto_interval(self, sp: _Space) -> float:
+        """PTO = srtt + 4*rttvar + max_ack_delay, backed off (§6.2.1);
+        the static initial value only seeds the first flight."""
+        if self.srtt is None:
+            base = PTO_INITIAL
+        else:
+            base = self.srtt + max(4 * self.rttvar, 0.001) + 0.025
+        return min(max(base, 0.05) * (2 ** sp.pto_count), PTO_MAX)
 
     def _detect_losses(self, sp: _Space) -> None:
         """Packet-threshold loss (RFC 9002 §6.1.1): anything
@@ -628,21 +831,29 @@ class QuicConnection:
             if pn <= sp.largest_acked - K_PACKET_THRESHOLD
         ]
         for pn in sorted(lost):
-            self._declare_lost(sp, sp.sent.pop(pn))
+            meta = sp.sent.pop(pn)
+            self._cc_on_loss(meta)
+            self._declare_lost(sp, meta)
 
     def _declare_lost(self, sp: _Space, meta: "_SentPacket") -> None:
         if meta.crypto is not None:
             sp.crypto_rtx.append(meta.crypto)
         if meta.stream is not None:
-            s_off = meta.stream[0]
-            chunk = self._stream_unacked.pop(s_off, None)
+            sid, s_off, _ln = meta.stream
+            st = self.streams.get(sid)
+            chunk = st.unacked.pop(s_off, None) if st is not None else None
             if chunk is not None:
-                self._stream_rtx.append((s_off, chunk))
+                st.rtx.append((s_off, chunk))
         if meta.hs_done:
             self._hs_done_sent = False
         if meta.fc:
-            # the peer may be BLOCKED on this update; it must resend
+            # the peer may be BLOCKED on these updates; resend the
+            # SAME stream windows (a lost data-stream MAX_STREAM_DATA
+            # would otherwise deadlock that stream: its local rx_max
+            # already advanced, so the consume trigger can't re-fire)
             self._fc_update_due = True
+            if isinstance(meta.fc, tuple):
+                self._fc_stream_due.update(meta.fc)
 
     def next_timeout(self) -> Optional[float]:
         """Earliest PTO deadline across spaces (absolute monotonic
@@ -651,27 +862,44 @@ class QuicConnection:
         for sp in self.spaces.values():
             if sp.tx is None or not sp.sent:
                 continue
-            pto = min(PTO_INITIAL * (2 ** sp.pto_count), PTO_MAX)
-            d = sp.last_eliciting_sent + pto
+            d = sp.last_eliciting_sent + self._pto_interval(sp)
             deadline = d if deadline is None else min(deadline, d)
         return deadline
 
     def on_timeout(self, now: Optional[float] = None) -> bool:
-        """PTO expiry (RFC 9002 §6.2): declare the in-flight packets
-        of overdue spaces lost so their data retransmits, and back off.
-        Returns True when anything became sendable (owner must flush)."""
+        """PTO expiry (RFC 9002 §6.2.4): send PROBE data — a duplicate
+        of the oldest unacked crypto/stream range — without declaring
+        the whole in-flight set lost (ADVICE r4: on paths with RTT
+        near the timer a merely delayed ACK previously triggered a
+        full spurious retransmit burst). In-flight packets stay
+        tracked; real losses surface via the packet threshold when the
+        probe's ack arrives. Returns True when anything became
+        sendable (owner must flush)."""
         now = self._clock() if now is None else now
         fired = False
         for sp in self.spaces.values():
             if sp.tx is None or not sp.sent or self.closed:
                 continue
-            pto = min(PTO_INITIAL * (2 ** sp.pto_count), PTO_MAX)
-            if now - sp.last_eliciting_sent < pto:
+            if now - sp.last_eliciting_sent < self._pto_interval(sp):
                 continue
             sp.pto_count += 1
-            for pn in sorted(sp.sent):
-                self._declare_lost(sp, sp.sent.pop(pn))
-            sp.ping_due = True  # elicit an ACK even if nothing rebuilt
+            # §7.5: probe packets may exceed the congestion window
+            self._probe_credit = min(self._probe_credit + 1, 2)
+            probed = False
+            oldest = min(sp.sent, key=lambda pn: sp.sent[pn].time)
+            meta = sp.sent[oldest]
+            if meta.crypto is not None and meta.crypto not in sp.crypto_rtx:
+                sp.crypto_rtx.append(meta.crypto)
+                probed = True
+            if meta.stream is not None:
+                sid, s_off, _ln = meta.stream
+                st = self.streams.get(sid)
+                chunk = st.unacked.get(s_off) if st is not None else None
+                if chunk is not None and all(o != s_off for o, _c in st.rtx):
+                    st.rtx.append((s_off, chunk))
+                    probed = True
+            if not probed:
+                sp.ping_due = True  # nothing rebuildable: bare probe
             fired = True
         return fired
 
@@ -683,8 +911,15 @@ class QuicConnection:
 
     # --- app API ---------------------------------------------------------
 
-    def send_stream(self, data: bytes) -> None:
-        self.stream_out += data
+    def send_stream(self, data: bytes, sid: int = 0) -> None:
+        st = self._stream(sid)
+        st.out += data
+
+    def next_client_stream(self) -> int:
+        """Allocate the next client-initiated bidirectional stream id
+        (0, 4, 8, ... — RFC 9000 §2.1). Client side only."""
+        used = [s for s in self.streams if s % 4 == 0]
+        return (max(used) + 4) if used else 0
 
     def close(self, code: int = 0, reason: str = "") -> None:
         if not self.closed:
@@ -766,7 +1001,17 @@ def _dgram_dcid(data: bytes) -> Optional[bytes]:
 class QuicStreamTransport:
     """Adapts stream 0 of a QUIC connection to the byte-stream
     transport contract the MQTT Connection runtime uses (read/write/
-    drain/close/peername) — the quicer single-stream mode."""
+    drain/close/peername) — the quicer single-stream mode.
+
+    MULTI-STREAM mode (emqx_quic_data_stream.erl): further client-
+    initiated bidirectional streams are DATA streams. Each gets its
+    own MQTT parser; its packets feed the SAME channel (so session,
+    auth, aliases and quotas are shared) and the replies they elicit
+    (PUBACK/PUBREC/...) return on the SAME stream, per the reference's
+    per-stream ordering contract. Connection-level packets (CONNECT /
+    DISCONNECT / AUTH) are only legal on the control stream — a data
+    stream carrying one is a protocol error. Broker-initiated
+    deliveries ride the control stream."""
 
     quic = True
 
@@ -775,8 +1020,88 @@ class QuicStreamTransport:
         self.endpoint = endpoint
         self.addr = addr
         self._q: asyncio.Queue = asyncio.Queue()
+        self.mqtt_conn = None  # set by the endpoint after Connection()
+        self._ds_q: Dict[int, asyncio.Queue] = {}
+        self._ds_tasks: Dict[int, object] = {}
         conn.on_stream_data = self._q.put_nowait
-        conn.on_close = lambda: self._q.put_nowait(b"")
+        conn.on_data_stream = self._data_stream_in
+        conn.on_close = self._on_conn_close
+
+    def _on_conn_close(self) -> None:
+        self._q.put_nowait(b"")
+        for t in self._ds_tasks.values():
+            t.cancel()
+        self._ds_tasks.clear()
+
+    def _data_stream_in(self, sid: int, data: bytes) -> None:
+        q = self._ds_q.get(sid)
+        if q is None:
+            q = self._ds_q[sid] = asyncio.Queue()
+            self._ds_tasks[sid] = asyncio.ensure_future(
+                self._ds_run(sid, q)
+            )
+        q.put_nowait(data)
+
+    def _ds_abort(self, reason: str) -> None:
+        self.conn.close(0x0A, reason)
+        self.endpoint.kick(self.conn)
+
+    async def _ds_run(self, sid: int, q: asyncio.Queue) -> None:
+        """One data stream's packet loop — the emqx_quic_data_stream
+        process analog. Mirrors the control-stream run loop's gates:
+        the SAME publish/byte limiters (a client must not evade quotas
+        by spreading publishes over streams), the listener's packet-
+        size cap, and connection-level-packet rejection. Replies
+        return on this stream; keepalive is touched by the channel's
+        own handle_packet."""
+        from . import frame
+        from .packet import Auth, Connect, Disconnect, Publish
+
+        parser = None
+        try:
+            while True:
+                data = await q.get()
+                mc = self.mqtt_conn
+                ch = getattr(mc, "channel", None)
+                if ch is None or not ch.connected:
+                    # data streams are valid only on a CONNECTed
+                    # session (emqx_quic_data_stream waits for the
+                    # control stream's CONNECT)
+                    self._ds_abort("data stream before CONNECT")
+                    return
+                if parser is None:
+                    parser = frame.Parser(
+                        max_packet_size=mc.parser.max_packet_size,
+                        proto_ver=ch.proto_ver,
+                    )
+                out = b""
+                for pkt in parser.feed(data):
+                    if isinstance(pkt, (Connect, Disconnect, Auth)):
+                        self._ds_abort(
+                            "connection-level packet on data stream"
+                        )
+                        return
+                    if isinstance(pkt, Publish):
+                        ok = await mc.pub_limiter.acquire(1.0)
+                        ok = ok and await mc.byte_limiter.acquire(
+                            float(len(pkt.payload))
+                        )
+                        if not ok:
+                            self.endpoint.mqtt.broker.metrics.inc(
+                                "messages.dropped.quota_exceeded"
+                            )
+                            self._ds_abort("publish quota exceeded")
+                            return
+                    for reply in ch.handle_packet(pkt):
+                        out += frame.serialize(reply, ch.proto_ver)
+                if out:
+                    self.conn.send_stream(out, sid=sid)
+                    self.endpoint.kick(self.conn)
+        except asyncio.CancelledError:
+            return
+        except Exception as e:
+            log.warning("quic data stream %d failed: %s", sid, e)
+            self._ds_abort(f"data stream error: {e}")
 
     def peername(self):
         return self.addr
@@ -959,6 +1284,7 @@ class QuicServer:
             from .server import Connection
 
             mqtt_conn = Connection(self.mqtt, transport)
+            transport.mqtt_conn = mqtt_conn  # data-stream channel seam
             self.mqtt._conns.add(mqtt_conn)
 
             async def run():
@@ -980,8 +1306,13 @@ class QuicClientEndpoint:
         self._udp = None
         self.addr = None
         self._q: asyncio.Queue = asyncio.Queue()
+        self._ds_q: Dict[int, asyncio.Queue] = {}  # data-stream inboxes
         self.conn.on_stream_data = self._q.put_nowait
+        self.conn.on_data_stream = self._on_ds
         self.conn.on_close = lambda: self._q.put_nowait(b"")
+
+    def _on_ds(self, sid: int, data: bytes) -> None:
+        self._ds_q.setdefault(sid, asyncio.Queue()).put_nowait(data)
 
     async def connect(self, host: str, port: int, timeout: float = 5.0):
         loop = asyncio.get_running_loop()
@@ -1033,6 +1364,23 @@ class QuicClientEndpoint:
 
     async def recv(self, timeout: float = 5.0) -> bytes:
         return await asyncio.wait_for(self._q.get(), timeout)
+
+    # --- multi-stream mode (data streams) ----------------------------
+    def open_stream(self) -> int:
+        """Open a new client-initiated bidi DATA stream; returns its
+        id (the reference's multi-stream mode publishes on these)."""
+        sid = self.conn.next_client_stream()
+        self.conn._stream(sid)
+        self._ds_q.setdefault(sid, asyncio.Queue())
+        return sid
+
+    def send_on(self, sid: int, data: bytes) -> None:
+        self.conn.send_stream(data, sid=sid)
+        self._flush()
+
+    async def recv_on(self, sid: int, timeout: float = 5.0) -> bytes:
+        q = self._ds_q.setdefault(sid, asyncio.Queue())
+        return await asyncio.wait_for(q.get(), timeout)
 
     def close(self) -> None:
         t = getattr(self, "_pump_task", None)

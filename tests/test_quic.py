@@ -384,9 +384,9 @@ def test_flow_control_enforced():
     # stream offset directly
     srv.rx_max_data = 1000
     srv.rx_max_stream = 1000
-    srv._stream_in(0, b"a" * 500, False)
+    srv._stream_in(0, 0, b"a" * 500, False)
     assert not srv.closed
-    srv._stream_in(500, b"b" * 501, False)  # 1001 > 1000
+    srv._stream_in(0, 500, b"b" * 501, False)  # 1001 > 1000
     assert srv.close_pending is not None or srv.closed
     code = (srv.close_pending or (3, ""))[0]
     assert code == 0x03  # FLOW_CONTROL_ERROR
@@ -401,7 +401,7 @@ def test_flow_control_enforced():
     cli._peer_params_seen = True
     cli.send_stream(b"z" * 250)
     frames, meta = cli._pending_frames("app")
-    assert meta is not None and meta.stream == (0, 100)
+    assert meta is not None and meta.stream == (0, 0, 100)
     assert cli.stream_sent == 100 and len(cli.stream_out) == 150
     # window exhausted: no more stream frames
     frames2, meta2 = cli._pending_frames("app")
@@ -410,5 +410,144 @@ def test_flow_control_enforced():
     cli.tx_max_data = 1000
     cli.tx_max_stream = 1000
     frames3, meta3 = cli._pending_frames("app")
-    assert meta3 is not None and meta3.stream == (100, 150)
+    assert meta3 is not None and meta3.stream == (0, 100, 150)
     assert not cli.stream_out
+
+
+def test_newreno_congestion_control():
+    """RFC 9002 §7: in-memory pair, deterministic loss — cwnd grows in
+    slow start on acks, halves ONCE per recovery period on loss (not
+    per lost packet), and the sender never puts more than cwnd bytes
+    in flight (cwnd-limited, not line-rate, retransmission)."""
+    cli = ClientConnection()
+    srv = ServerConnection(odcid=cli.dcid)
+
+    def pump(drop_c2s=lambda i: False):
+        i = {"n": 0}
+        for _ in range(60):
+            moved = False
+            for d in cli.flush():
+                i["n"] += 1
+                if not drop_c2s(i["n"]):
+                    srv.datagram_received(d)
+                moved = True
+            for d in srv.flush():
+                cli.datagram_received(d)
+                moved = True
+            if not moved:
+                break
+
+    pump()  # handshake
+    assert cli.handshake_done and srv.handshake_done
+    cwnd0 = cli.cwnd
+    assert cli.bytes_in_flight <= cwnd0
+
+    # clean acks grow cwnd (slow start), in-flight drains to ~0
+    cli.send_stream(b"x" * 40_000)
+    for _ in range(40):
+        pump()
+        cli.spaces["app"].ack_due = True  # srv acks promptly via pump
+        srv.spaces["app"].ack_due = True
+    assert cli.cwnd > cwnd0, "slow start never grew cwnd"
+    grown = cli.cwnd
+
+    # cwnd-limited sending: with a huge backlog, bytes_in_flight never
+    # exceeds cwnd at any flush point
+    cli.send_stream(b"y" * 200_000)
+    for _ in range(10):
+        before = cli.cwnd
+        for d in cli.flush():
+            pass  # blackhole: nothing acks
+        assert cli.bytes_in_flight <= max(cli.cwnd, before) + 1500
+    assert cli.streams[0].out, "entire backlog left despite cwnd cap"
+
+    # loss event: a PTO probe's ack surfaces the blackholed packets as
+    # threshold losses — cwnd collapses to ssthresh ONCE (not once per
+    # lost packet), and the floor of 2 datagrams holds
+    lost_before = cli.cwnd
+    assert cli.on_timeout(now=cli._clock() + 100)  # force the probe
+    pump()  # probe delivered, ack returns, threshold losses declared
+    assert cli.cwnd < lost_before, "loss never shrank cwnd"
+    assert cli.cwnd >= 2 * cli.max_datagram_size  # floor holds
+    # ONE halving event: ssthresh sits at ~half the pre-loss window
+    # (post-loss acks may already have grown cwnd past it slightly)
+    assert cli.ssthresh <= lost_before // 2 + cli.max_datagram_size
+    assert cli.cwnd <= lost_before // 2 + 8 * cli.max_datagram_size
+    # the backlog now drains under the REDUCED window as acks flow
+    for _ in range(60):
+        pump()
+        srv.spaces["app"].ack_due = True
+        if not cli.streams[0].out and not cli.streams[0].rtx:
+            break
+    assert srv.streams[0].rx_off >= 200_000, "backlog never drained"
+
+
+async def test_multistream_mqtt_data_streams():
+    """Multi-stream mode (emqx_quic_data_stream.erl): CONNECT on the
+    control stream, PUBLISH on a data stream — the PUBACK returns on
+    the SAME data stream, the delivery rides the control stream, and
+    a second data stream works independently. Connection-level packets
+    on a data stream kill the connection."""
+    broker = Broker()
+    mqtt_seat = Server(broker, host="127.0.0.1", port=0, name="quic:ms")
+    quic = QuicServer(mqtt_seat, host="127.0.0.1", port=0)
+    await quic.start()
+    ep = await QuicClientEndpoint().connect(*quic.listen_addr)
+    try:
+        parser = frame.Parser(proto_ver=4)
+        pkts = []
+
+        async def read_ctrl(timeout=5.0):
+            while not pkts:
+                pkts.extend(parser.feed(await ep.recv(timeout)))
+            return pkts.pop(0)
+
+        ep.send(frame.serialize(Connect(client_id="ms1", proto_ver=4)))
+        ack = await read_ctrl()
+        assert isinstance(ack, Connack) and ack.code == 0
+        ep.send(frame.serialize(
+            Subscribe(packet_id=1, filters=[("ms/#", SubOpts(qos=1))])
+        ))
+        assert isinstance(await read_ctrl(), Suback)
+
+        # data stream 1: qos1 publish -> PUBACK on the SAME stream
+        s1 = ep.open_stream()
+        assert s1 == 4
+        ep.send_on(s1, frame.serialize(
+            Publish(topic="ms/a", payload=b"via-ds", qos=1, packet_id=9)
+        ))
+        p1 = frame.Parser(proto_ver=4)
+        ds_pkts = []
+        while not ds_pkts:
+            ds_pkts.extend(p1.feed(await ep.recv_on(s1)))
+        puback = ds_pkts.pop(0)
+        assert type(puback).__name__ == "Puback" and puback.packet_id == 9
+        # the delivery (we subscribed ms/#) arrives on the CONTROL stream
+        pub = await read_ctrl()
+        assert isinstance(pub, Publish) and pub.payload == b"via-ds"
+
+        # a second, independent data stream
+        s2 = ep.open_stream()
+        assert s2 == 8
+        ep.send_on(s2, frame.serialize(
+            Publish(topic="ms/b", payload=b"ds2", qos=1, packet_id=11)
+        ))
+        p2 = frame.Parser(proto_ver=4)
+        ds2 = []
+        while not ds2:
+            ds2.extend(p2.feed(await ep.recv_on(s2)))
+        assert type(ds2[0]).__name__ == "Puback" and ds2[0].packet_id == 11
+        pub2 = await read_ctrl()
+        assert pub2.payload == b"ds2"
+
+        # CONNECT on a data stream is a protocol violation
+        s3 = ep.open_stream()
+        ep.send_on(s3, frame.serialize(Connect(client_id="evil", proto_ver=4)))
+        for _ in range(50):
+            if ep.conn.closed:
+                break
+            await asyncio.sleep(0.02)
+        assert ep.conn.closed, "connection survived CONNECT on data stream"
+    finally:
+        ep.close()
+        await quic.stop()
