@@ -79,11 +79,17 @@ class BuiltinDbProvider(Provider):
     """Salted-hash user store (emqx_auth_mnesia analog). Lookup by
     username or clientid per `user_id_type`."""
 
-    def __init__(self, user_id_type: str = "username", algorithm: str = "pbkdf2"):
+    def __init__(
+        self,
+        user_id_type: str = "username",
+        algorithm: str = "pbkdf2",
+        bcrypt_log_rounds: int = 10,
+    ):
         assert user_id_type in ("username", "clientid")
-        assert algorithm in ("pbkdf2", "sha256")
+        assert algorithm in ("pbkdf2", "sha256", "bcrypt")
         self.user_id_type = user_id_type
         self.algorithm = algorithm
+        self.bcrypt_log_rounds = bcrypt_log_rounds
         self._users: Dict[str, Tuple[bytes, bytes, bool]] = {}  # id -> (salt, hash, su)
 
     def _hash(self, password: bytes, salt: bytes) -> bytes:
@@ -92,8 +98,34 @@ class BuiltinDbProvider(Provider):
         return hashlib.sha256(salt + password).digest()
 
     def add_user(self, user_id: str, password: str, superuser: bool = False) -> None:
+        if self.algorithm == "bcrypt":
+            from . import bcrypt as _bcrypt
+
+            h = _bcrypt.hashpw(
+                password.encode(), _bcrypt.gensalt(self.bcrypt_log_rounds)
+            )
+            self._users[user_id] = (b"", h, superuser)
+            return
         salt = os.urandom(16)
         self._users[user_id] = (salt, self._hash(password.encode(), salt), superuser)
+
+    def import_user_hash(
+        self, user_id: str, password_hash: str, salt: str = "",
+        superuser: bool = False,
+    ) -> None:
+        """Import a pre-hashed credential row (an EMQX table export:
+        bcrypt rows carry the salt inside the $2b$ string)."""
+        from . import bcrypt as _bcrypt
+
+        ph = password_hash.encode()
+        if _bcrypt.is_bcrypt_hash(ph):
+            self._users[user_id] = (b"", ph, superuser)
+            return
+        self._users[user_id] = (
+            bytes.fromhex(salt) if salt else b"",
+            bytes.fromhex(password_hash),
+            superuser,
+        )
 
     def delete_user(self, user_id: str) -> bool:
         return self._users.pop(user_id, None) is not None
@@ -107,6 +139,12 @@ class BuiltinDbProvider(Provider):
         if rec is None:
             return IGNORE
         salt, digest, superuser = rec
+        from . import bcrypt as _bcrypt
+
+        if _bcrypt.is_bcrypt_hash(digest):
+            if _bcrypt.checkpw(creds.password or b"", digest):
+                return AuthResult(True, superuser=superuser)
+            return AuthResult(False, "bad_username_or_password")
         if hmac.compare_digest(self._hash(creds.password or b"", salt), digest):
             return AuthResult(True, superuser=superuser)
         return AuthResult(False, "bad_username_or_password")

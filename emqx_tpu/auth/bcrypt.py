@@ -1,0 +1,99 @@
+"""bcrypt over native/libbcrypt.so (ctypes).
+
+The reference verifies imported credential tables with the bcrypt NIF
+(rebar.config:113; apps/emqx_auth_mnesia/src/emqx_authn_mnesia.erl
+password_hash algorithms); without it, rows exported from a real EMQX
+cluster cannot authenticate here. The native unit implements the
+algorithm from its definition and is validated against the canonical
+public test vectors (tests/test_bcrypt.py).
+
+Falls back loudly (RuntimeError) when no toolchain built the library:
+silently accepting any password would be worse than failing closed.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hmac
+import os
+import subprocess
+from typing import Optional
+
+_NATIVE_DIR = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..", "native")
+)
+_lib = None
+_tried = False
+
+
+def _load():
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    try:
+        subprocess.run(
+            ["make", "-C", _NATIVE_DIR, "libbcrypt.so"],
+            check=True, capture_output=True, timeout=120,
+        )
+    except Exception:
+        pass
+    path = os.path.join(_NATIVE_DIR, "libbcrypt.so")
+    if not os.path.exists(path):
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+        lib.emqx_bcrypt_hashpass.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+        ]
+        lib.emqx_bcrypt_hashpass.restype = ctypes.c_int
+        lib.emqx_bcrypt_gensalt.argtypes = [
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+        ]
+        lib.emqx_bcrypt_gensalt.restype = ctypes.c_int
+        _lib = lib
+    except OSError:
+        _lib = None
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def gensalt(rounds: int = 10) -> bytes:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native bcrypt unavailable (no toolchain?)")
+    out = ctypes.create_string_buffer(32)
+    if lib.emqx_bcrypt_gensalt(rounds, os.urandom(16), out, 32) != 0:
+        raise ValueError(f"bad bcrypt cost {rounds}")
+    return out.value
+
+
+def hashpw(password: bytes, salt: bytes) -> bytes:
+    """bcrypt(password, salt) -> 60-char \"$2b$..\" hash. `salt` is a
+    gensalt() string or a full prior hash (its salt prefix is used)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native bcrypt unavailable (no toolchain?)")
+    if isinstance(password, str):
+        password = password.encode()
+    if isinstance(salt, str):
+        salt = salt.encode()
+    out = ctypes.create_string_buffer(64)
+    if lib.emqx_bcrypt_hashpass(password, salt, out, 64) != 0:
+        raise ValueError("malformed bcrypt salt/hash string")
+    return out.value
+
+
+def checkpw(password: bytes, hashed: bytes) -> bool:
+    try:
+        return hmac.compare_digest(hashpw(password, hashed), bytes(hashed))
+    except ValueError:
+        return False
+
+
+def is_bcrypt_hash(s) -> bool:
+    b = s.encode() if isinstance(s, str) else bytes(s or b"")
+    return b.startswith((b"$2a$", b"$2b$", b"$2y$")) and len(b) == 60

@@ -176,14 +176,22 @@ def eval_expr(e: Any, env: Dict[str, Any]) -> Any:
     raise SqlError(f"bad expr node {op!r}")
 
 
+def _public_env(env: Dict[str, Any]) -> Dict[str, Any]:
+    # engine-internal keys (_proc_dict, _kv_store, _republish_depth)
+    # must never appear in rows: a bare `SELECT *` republish would
+    # otherwise serialize the whole engine-wide kv store into the
+    # published payload
+    return {k: v for k, v in env.items() if not k.startswith("_")}
+
+
 def select_fields(sel: Select, env: Dict[str, Any]) -> Dict[str, Any]:
-    """Bind the SELECT list; '*' keeps the whole env."""
+    """Bind the SELECT list; '*' keeps the (public) env."""
     if not sel.fields:
-        return dict(env)
+        return _public_env(env)
     out: Dict[str, Any] = {}
     for expr, alias in sel.fields:
         if expr == ("path", ["*"]):
-            out.update(env)
+            out.update(_public_env(env))
             continue
         val = eval_expr(expr, env)
         name = alias or (expr[1][-1] if expr[0] == "path" else "value")
@@ -238,6 +246,9 @@ class RuleEngine:
         self._installed = False
         # named action providers: kind -> fn(args, row, env)
         self.action_providers: Dict[str, Any] = {}
+        # per-rule proc dicts + engine-wide kv store (see apply_rule)
+        self._proc_dicts: Dict[str, Dict[str, Any]] = {}
+        self._kv_store: Dict[str, Any] = {}
 
     # --- CRUD -----------------------------------------------------------
 
@@ -270,6 +281,10 @@ class RuleEngine:
                 self._event_rules.get(f, set()).discard(rule_id)
             else:
                 self._index.remove(topic_mod.words(f), (rule_id, f))
+        # the proc dict dies with the rule (the reference's erlang
+        # proc dict dies with the rule's process); a later rule that
+        # reuses the id must start clean
+        self._proc_dicts.pop(rule_id, None)
         return True
 
     def update_rule(self, rule_id: str, **kw) -> Rule:
@@ -334,6 +349,16 @@ class RuleEngine:
 
     def apply_rule(self, rule: Rule, env: Dict[str, Any]) -> Optional[List[Dict[str, Any]]]:
         rule.metrics.matched += 1
+        # proc_dict is scoped PER RULE (the reference's erlang proc
+        # dict belongs to the evaluating process — rules must not see
+        # each other's values); kv_store is engine-wide like the
+        # reference's node-global ets (ADVICE r4)
+        # a COPY per rule: the caller reuses one env across matching
+        # rules, and injecting per-rule state into the shared dict
+        # would hand every later rule the first rule's proc dict
+        env = dict(env)
+        env["_proc_dict"] = self._proc_dicts.setdefault(rule.id, {})
+        env["_kv_store"] = self._kv_store
         try:
             sel = rule.select
             rows: List[Dict[str, Any]]

@@ -925,33 +925,71 @@ def _contains_topic_match(filters, topic):
 
 
 # --- state: proc dict + kv store ---------------------------------------
+# The reference scopes the proc dict to the evaluating rule's process
+# (emqx_rule_funcs proc_dict over erlang:put/get) — rules must not
+# observe each other's values — while kv_store is node-global ets.
+# Both therefore resolve through the ENV the engine passes (ADVICE
+# r4): apply_rule injects "_proc_dict" (per rule id) and "_kv_store"
+# (per engine). The module-level fallbacks only serve direct FUNCS
+# calls outside an engine (tests/tools).
 
 _PROC_DICT: Dict[str, Any] = {}
 _KV_STORE: Dict[str, Any] = {}
 
-FUNCS["proc_dict_get"] = lambda k: _PROC_DICT.get(_str(k))
-FUNCS["proc_dict_put"] = lambda k, v: _PROC_DICT.__setitem__(_str(k), v)
-FUNCS["proc_dict_del"] = lambda k: _PROC_DICT.pop(_str(k), None) and None
-FUNCS["kv_store_get"] = lambda k, *d: _KV_STORE.get(
-    _str(k), d[0] if d else None
-)
-FUNCS["kv_store_put"] = lambda k, v: _KV_STORE.__setitem__(_str(k), v)
-FUNCS["kv_store_del"] = lambda k: _KV_STORE.pop(_str(k), None) and None
 
-# --- system -------------------------------------------------------------
-
-FUNCS["getenv"] = lambda name: os.environ.get("EMQXVAR_" + _str(name))
-
-# --- message-context accessors (engine passes env via _wants_env) -------
+def _env_state(env, key, fallback):
+    d = env.get(key)
+    return d if d is not None else fallback
 
 
 def env_func(name: str):
+    """Register an env-aware func (the engine prepends the event env;
+    also used by the message-context accessors below)."""
+
     def deco(f):
         f._wants_env = True
         FUNCS[name] = f
         return f
 
     return deco
+
+
+@env_func("proc_dict_get")
+def _proc_dict_get(env, k):
+    return _env_state(env, "_proc_dict", _PROC_DICT).get(_str(k))
+
+
+@env_func("proc_dict_put")
+def _proc_dict_put(env, k, v):
+    _env_state(env, "_proc_dict", _PROC_DICT)[_str(k)] = v
+
+
+@env_func("proc_dict_del")
+def _proc_dict_del(env, k):
+    _env_state(env, "_proc_dict", _PROC_DICT).pop(_str(k), None)
+
+
+@env_func("kv_store_get")
+def _kv_store_get(env, k, *d):
+    return _env_state(env, "_kv_store", _KV_STORE).get(
+        _str(k), d[0] if d else None
+    )
+
+
+@env_func("kv_store_put")
+def _kv_store_put(env, k, v):
+    _env_state(env, "_kv_store", _KV_STORE)[_str(k)] = v
+
+
+@env_func("kv_store_del")
+def _kv_store_del(env, k):
+    _env_state(env, "_kv_store", _KV_STORE).pop(_str(k), None)
+
+# --- system -------------------------------------------------------------
+
+FUNCS["getenv"] = lambda name: os.environ.get("EMQXVAR_" + _str(name))
+
+# --- message-context accessors (engine passes env via _wants_env) -------
 
 
 @env_func("msgid")

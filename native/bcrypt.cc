@@ -1,0 +1,239 @@
+// bcrypt password hashing — implemented from the algorithm definition
+// (Provos & Mazières, "A Future-Adaptable Password Scheme", USENIX
+// 1999): Blowfish with the expensive key schedule (EksBlowfish), salt
+// and password folded into the state over 2^cost rounds, then
+// "OrpheanBeholderScryDoubt" encrypted 64 times. Output format
+// "$2b$<cost>$<22 char salt><31 char hash>" with the bcrypt base64
+// alphabet. The reference broker links the bcrypt NIF
+// (rebar.config:113) so imported credential tables carry these
+// strings; this unit lets them verify natively.
+//
+// Blowfish init tables are GENERATED from pi's hex digits at build
+// time (gen_blowfish_tables.py) — the algorithm's own definition.
+//
+// Exposed C ABI (ctypes):
+//   int emqx_bcrypt_hashpass(const char *pass, const char *salt_str,
+//                            char *out, int outlen);
+//     salt_str: "$2b$NN$<22charsalt>..." (prefix of a full hash ok)
+//     out: NUL-terminated 60-char hash on success; returns 0 ok.
+//   int emqx_bcrypt_gensalt(int cost, const unsigned char rnd[16],
+//                           char *out, int outlen);
+
+#include <cstdint>
+#include <cstring>
+#include <cstdio>
+
+#include "blowfish_tables.h"
+
+namespace {
+
+struct Blf {
+  uint32_t P[18];
+  uint32_t S[4][256];
+};
+
+inline uint32_t f(const Blf &c, uint32_t x) {
+  return ((c.S[0][x >> 24] + c.S[1][(x >> 16) & 0xFF]) ^
+          c.S[2][(x >> 8) & 0xFF]) +
+         c.S[3][x & 0xFF];
+}
+
+void blf_encrypt(const Blf &c, uint32_t &l, uint32_t &r) {
+  uint32_t L = l, R = r;
+  for (int i = 0; i < 16; i += 2) {
+    L ^= c.P[i];
+    R ^= f(c, L);
+    R ^= c.P[i + 1];
+    L ^= f(c, R);
+  }
+  L ^= c.P[16];
+  R ^= c.P[17];
+  l = R;
+  r = L;
+}
+
+uint32_t stream2word(const uint8_t *data, int len, int *j) {
+  uint32_t w = 0;
+  for (int i = 0; i < 4; i++) {
+    w = (w << 8) | data[*j];
+    *j = (*j + 1) % len;
+  }
+  return w;
+}
+
+void expand_state(Blf &c, const uint8_t *data, int datalen,
+                  const uint8_t *key, int keylen) {
+  int j = 0;
+  for (int i = 0; i < 18; i++) c.P[i] ^= stream2word(key, keylen, &j);
+  j = 0;
+  uint32_t l = 0, r = 0;
+  for (int i = 0; i < 18; i += 2) {
+    l ^= stream2word(data, datalen, &j);
+    r ^= stream2word(data, datalen, &j);
+    blf_encrypt(c, l, r);
+    c.P[i] = l;
+    c.P[i + 1] = r;
+  }
+  for (int b = 0; b < 4; b++) {
+    for (int i = 0; i < 256; i += 2) {
+      l ^= stream2word(data, datalen, &j);
+      r ^= stream2word(data, datalen, &j);
+      blf_encrypt(c, l, r);
+      c.S[b][i] = l;
+      c.S[b][i + 1] = r;
+    }
+  }
+}
+
+void expand0_state(Blf &c, const uint8_t *key, int keylen) {
+  int j = 0;
+  for (int i = 0; i < 18; i++) c.P[i] ^= stream2word(key, keylen, &j);
+  uint32_t l = 0, r = 0;
+  for (int i = 0; i < 18; i += 2) {
+    blf_encrypt(c, l, r);
+    c.P[i] = l;
+    c.P[i + 1] = r;
+  }
+  for (int b = 0; b < 4; b++) {
+    for (int i = 0; i < 256; i += 2) {
+      blf_encrypt(c, l, r);
+      c.S[b][i] = l;
+      c.S[b][i + 1] = r;
+    }
+  }
+}
+
+const char B64[] =
+    "./ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789";
+
+int b64_index(char ch) {
+  const char *p = strchr(B64, ch);
+  return p == nullptr ? -1 : (int)(p - B64);
+}
+
+// bcrypt's base64 (no padding chars)
+void b64_encode(const uint8_t *in, int len, char *out) {
+  int o = 0;
+  for (int i = 0; i < len;) {
+    uint32_t c1 = in[i++];
+    out[o++] = B64[c1 >> 2];
+    c1 = (c1 & 0x03) << 4;
+    if (i >= len) {
+      out[o++] = B64[c1];
+      break;
+    }
+    uint32_t c2 = in[i++];
+    c1 |= c2 >> 4;
+    out[o++] = B64[c1];
+    c1 = (c2 & 0x0F) << 2;
+    if (i >= len) {
+      out[o++] = B64[c1];
+      break;
+    }
+    uint32_t c3 = in[i++];
+    c1 |= c3 >> 6;
+    out[o++] = B64[c1];
+    out[o++] = B64[c3 & 0x3F];
+  }
+  out[o] = 0;
+}
+
+int b64_decode(const char *in, int chars, uint8_t *out, int outlen) {
+  int o = 0, bits = 0;
+  uint32_t acc = 0;
+  for (int i = 0; i < chars; i++) {
+    int v = b64_index(in[i]);
+    if (v < 0) return -1;
+    acc = (acc << 6) | (uint32_t)v;
+    bits += 6;
+    if (bits >= 8) {
+      bits -= 8;
+      if (o >= outlen) return -1;
+      out[o++] = (uint8_t)(acc >> bits);
+    }
+  }
+  return o;
+}
+
+}  // namespace
+
+extern "C" {
+
+int emqx_bcrypt_hashpass(const char *pass, const char *salt_str, char *out,
+                         int outlen) {
+  if (outlen < 61 || pass == nullptr || salt_str == nullptr) return -1;
+  // parse "$2a$NN$<22 chars>" / "$2b$NN$..."
+  if (salt_str[0] != '$' || salt_str[1] != '2') return -1;
+  char minor = salt_str[2];
+  if (minor != 'a' && minor != 'b' && minor != 'y') return -1;
+  if (salt_str[3] != '$') return -1;
+  if (salt_str[4] < '0' || salt_str[4] > '3' || salt_str[5] < '0' ||
+      salt_str[5] > '9' || salt_str[6] != '$')
+    return -1;
+  int cost = (salt_str[4] - '0') * 10 + (salt_str[5] - '0');
+  if (cost < 4 || cost > 31) return -1;
+  uint8_t salt[16];
+  if (b64_decode(salt_str + 7, 22, salt, sizeof(salt)) != 16) return -1;
+
+  // key = password + NUL, capped at 72 bytes ('2b' semantics; '2a'
+  // inputs longer than 72 hash identically here, which matches
+  // OpenBSD's modern behavior)
+  size_t plen = strnlen(pass, 72);
+  uint8_t key[73];
+  memcpy(key, pass, plen);
+  key[plen] = 0;
+  int keylen = (int)plen + 1;
+
+  Blf c;
+  memcpy(c.P, BLF_INIT_P, sizeof(c.P));
+  memcpy(c.S, BLF_INIT_S, sizeof(c.S));
+  expand_state(c, salt, 16, key, keylen);
+  uint64_t rounds = 1ull << cost;
+  for (uint64_t i = 0; i < rounds; i++) {
+    expand0_state(c, key, keylen);
+    expand0_state(c, salt, 16);
+  }
+
+  static const char magic[] = "OrpheanBeholderScryDoubt";
+  uint32_t cdata[6];
+  for (int i = 0; i < 6; i++) {
+    cdata[i] = ((uint32_t)(uint8_t)magic[i * 4] << 24) |
+               ((uint32_t)(uint8_t)magic[i * 4 + 1] << 16) |
+               ((uint32_t)(uint8_t)magic[i * 4 + 2] << 8) |
+               (uint32_t)(uint8_t)magic[i * 4 + 3];
+  }
+  for (int k = 0; k < 64; k++) {
+    for (int i = 0; i < 6; i += 2) blf_encrypt(c, cdata[i], cdata[i + 1]);
+  }
+  uint8_t cbytes[24];
+  for (int i = 0; i < 6; i++) {
+    cbytes[i * 4] = (uint8_t)(cdata[i] >> 24);
+    cbytes[i * 4 + 1] = (uint8_t)(cdata[i] >> 16);
+    cbytes[i * 4 + 2] = (uint8_t)(cdata[i] >> 8);
+    cbytes[i * 4 + 3] = (uint8_t)cdata[i];
+  }
+  // header + 22-char salt + 31-char hash (23 of 24 bytes, like the
+  // original implementation drops the last byte)
+  char saltb64[25], hashb64[33];
+  b64_encode(salt, 16, saltb64);
+  saltb64[22] = 0;
+  b64_encode(cbytes, 23, hashb64);
+  snprintf(out, (size_t)outlen, "$2%c$%02d$%s%s", minor, cost, saltb64,
+           hashb64);
+  // wipe key material
+  memset(key, 0, sizeof(key));
+  memset(&c, 0, sizeof(c));
+  return 0;
+}
+
+int emqx_bcrypt_gensalt(int cost, const unsigned char rnd[16], char *out,
+                        int outlen) {
+  if (outlen < 30 || cost < 4 || cost > 31) return -1;
+  char saltb64[25];
+  b64_encode(rnd, 16, saltb64);
+  saltb64[22] = 0;
+  snprintf(out, (size_t)outlen, "$2b$%02d$%s", cost, saltb64);
+  return 0;
+}
+
+}  // extern "C"

@@ -285,15 +285,66 @@ def test_time_funcs_live():
 
 
 def test_state_funcs():
-    F["proc_dict_put"]("k", 7)
-    assert F["proc_dict_get"]("k") == 7
-    F["proc_dict_del"]("k")
-    assert F["proc_dict_get"]("k") is None
-    F["kv_store_put"]("a", [1])
-    assert F["kv_store_get"]("a") == [1]
-    assert F["kv_store_get"]("nope", "dflt") == "dflt"
-    F["kv_store_del"]("a")
-    assert F["kv_store_get"]("a") is None
+    # env-scoped since r5 (ADVICE r4): the engine injects _proc_dict
+    # per rule and _kv_store per engine; direct calls pass an env
+    env: dict = {}
+    F["proc_dict_put"](env, "k", 7)
+    assert F["proc_dict_get"](env, "k") == 7
+    F["proc_dict_del"](env, "k")
+    assert F["proc_dict_get"](env, "k") is None
+    F["kv_store_put"](env, "a", [1])
+    assert F["kv_store_get"](env, "a") == [1]
+    assert F["kv_store_get"](env, "nope", "dflt") == "dflt"
+    F["kv_store_del"](env, "a")
+    assert F["kv_store_get"](env, "a") is None
+
+
+def test_proc_dict_scoped_per_rule_kv_store_shared():
+    """ADVICE r4: two rules in one engine must NOT see each other's
+    proc_dict values, while kv_store is engine-wide — INCLUDING when
+    both fire from the same message (the engine shares one env across
+    matching rules)."""
+    from emqx_tpu.broker.message import Message
+    from emqx_tpu.rules.engine import RuleEngine
+
+    eng = RuleEngine()
+    got = {}
+    eng.action_providers["grab"] = (
+        lambda args, row, env: got.setdefault(args["as"], []).append(row)
+    )
+    eng.create_rule(
+        "rA",
+        'SELECT proc_dict_put(\'x\', payload) AS w, '
+        'kv_store_put(\'shared\', payload) AS k FROM "t/#"',
+        actions=[{"function": "grab", "args": {"as": "A"}}],
+    )
+    eng.create_rule(
+        "rB",
+        'SELECT proc_dict_get(\'x\') AS theirs, '
+        'kv_store_get(\'shared\') AS shared FROM "t/#"',
+        actions=[{"function": "grab", "args": {"as": "B"}}],
+    )
+    eng.on_message_publish(
+        Message(topic="t/a", payload=b"SECRET", qos=0, from_client="p")
+    )
+    # rB fired from the SAME message env but sees only its own dict
+    assert got["B"][0]["theirs"] is None, got
+    assert got["B"][0]["shared"] == "SECRET"  # kv store is engine-wide
+    assert eng._proc_dicts["rA"] == {"x": "SECRET"}
+    assert eng._proc_dicts.get("rB", {}) == {}
+    # SELECT * must not leak engine-internal state into rows
+    eng.create_rule(
+        "rC", 'SELECT * FROM "t/#"',
+        actions=[{"function": "grab", "args": {"as": "C"}}],
+    )
+    eng.on_message_publish(
+        Message(topic="t/b", payload=b"v", qos=0, from_client="p")
+    )
+    leak = [k for k in got["C"][0] if k.startswith("_")]
+    assert not leak, leak
+    # the proc dict dies with the rule
+    eng.delete_rule("rA")
+    assert "rA" not in eng._proc_dicts
 
 
 def test_getenv_prefix(monkeypatch):
