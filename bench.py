@@ -503,6 +503,10 @@ def bench_exact(jax, jnp, floor, details):
         (meta, slots, (d_map,)), floor, n_dispatches=10, label="#1",
     )
     med = pctl(per_batch, 25)  # see the config-2 estimator note
+    # the p25 estimator can sit ON the epsilon clamp even when the
+    # median does not — a clamped value is the measurement FLOOR, not
+    # a throughput; flag it so the recorded rate reads honestly
+    sat = sat or med <= EPS * 1.2
     dev_rate = B / med
     n_topics = len(per_batch) * used_k * B
     assert total >= n_topics, f"exact config lost matches: {total}/{n_topics}"
@@ -533,7 +537,8 @@ def bench_exact(jax, jnp, floor, details):
         f"native ordered-set {nb_rate:,.0f} topics/s")
     details["config1_exact_10K"] = {
         "tpu_topics_per_sec": round(dev_rate, 1),
-        "tpu_ms_per_batch_p50": round(med * 1e3, 4),
+        "tpu_ms_per_batch_p25": round(med * 1e3, 4),
+        "tpu_ms_per_batch_p50": round(pctl(per_batch, 50) * 1e3, 4),
         "host_topics_per_sec": round(host_rate, 1),
         "native_topics_per_sec": round(nb_rate, 1),
         "native_us_per_topic_p99": round(pctl(lats, 99) / 1e3, 2),
