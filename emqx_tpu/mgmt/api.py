@@ -14,6 +14,7 @@ unauthenticated routes.
 
 from __future__ import annotations
 
+import asyncio
 import base64
 import hashlib
 import hmac
@@ -209,6 +210,9 @@ class ManagementApi:
         self._user_roles: Dict[str, str] = {}
         self.add_user("admin", "public")
         self._tokens: Dict[str, Tuple[str, float]] = {}
+        from .sso import SsoManager
+
+        self.sso = SsoManager()
         self.http.before.append(self._auth_mw)
         self._register_routes()
 
@@ -228,6 +232,12 @@ class ManagementApi:
             req.path,
         ) == ("POST", "/api/v5/login"):
             return None
+        if req.path.startswith("/api/v5/sso/login/") or req.path in (
+            "/api/v5/sso/oidc/callback",
+            "/api/v5/sso/oidc/login_url",
+            "/api/v5/sso/running",
+        ):
+            return None  # SSO entry points, like /login itself
         auth = req.headers.get("authorization", "")
         if auth.startswith("Bearer "):
             tok = auth[7:]
@@ -318,6 +328,14 @@ class ManagementApi:
             r("GET", "/api/v5/license", lambda q: self.license.info())
             r("POST", "/api/v5/license", self._license_update)
             r("PUT", "/api/v5/license/setting", self._license_setting)
+        # dashboard SSO (ref: apps/emqx_dashboard_sso)
+        r("GET", "/api/v5/sso", lambda q: self.sso.info())
+        r("GET", "/api/v5/sso/running", lambda q: self.sso.running())
+        r("PUT", "/api/v5/sso/{backend}", self._sso_update)
+        r("DELETE", "/api/v5/sso/{backend}", self._sso_delete)
+        r("POST", "/api/v5/sso/login/{backend}", self._sso_login)
+        r("GET", "/api/v5/sso/oidc/login_url", self._sso_oidc_login_url)
+        r("GET", "/api/v5/sso/oidc/callback", self._sso_oidc_callback)
         r("GET", "/api/v5/rules", self._rules_list)
         r("POST", "/api/v5/rules", self._rules_create)
         r("GET", "/api/v5/rules/{id}", self._rules_one)
@@ -977,6 +995,83 @@ class ManagementApi:
             for e in self.banned.list()
         ]
         return _paginate(items, req.query)
+
+    # --- dashboard SSO (emqx_dashboard_sso) ---------------------------
+
+    def _issue_sso_token(self, user: str, backend: str):
+        """Mint an ordinary dashboard token for an SSO-authenticated
+        user; the backend's default_role bounds the session."""
+        now = time.time()
+        self._tokens = {t: e for t, e in self._tokens.items() if e[1] > now}
+        tok = secrets.token_urlsafe(32)
+        sso_user = f"sso:{backend}:{user}"
+        # ASSIGN (not setdefault): tightening a backend's default_role
+        # must apply on the next login, not after a process restart
+        self._user_roles[sso_user] = self.sso.default_role(backend)
+        self._tokens[tok] = (sso_user, now + TOKEN_TTL)
+        return {
+            "token": tok, "version": "5", "role":
+            self._user_roles[sso_user],
+            "license": {"edition": "opensource"},
+        }
+
+    def _sso_update(self, req: Request):
+        from .sso import SsoError
+
+        try:
+            b = self.sso.update(req.params["backend"], req.json() or {})
+        except SsoError as e:
+            return Response.error(400, "BAD_REQUEST", str(e))
+        return b.info()
+
+    def _sso_delete(self, req: Request):
+        if not self.sso.delete(req.params["backend"]):
+            return Response.error(404, "NOT_FOUND", "no such sso backend")
+        return Response(204)
+
+    async def _sso_login(self, req: Request):
+        from .sso import SsoError
+
+        name = req.params["backend"]
+        b = self.sso.get(name)
+        if b is None or not hasattr(b, "login"):
+            return Response.error(404, "NOT_FOUND", f"sso {name} not running")
+        body = req.json() or {}
+        loop = asyncio.get_running_loop()
+        try:
+            # backend login does network IO (LDAP bind) — off-loop
+            user = await loop.run_in_executor(
+                None,
+                lambda: b.login(
+                    body.get("username", ""), body.get("password", "")
+                ),
+            )
+        except SsoError as e:
+            return Response.error(401, "BAD_USERNAME_OR_PWD", str(e))
+        return self._issue_sso_token(user, name)
+
+    def _sso_oidc_login_url(self, req: Request):
+        b = self.sso.get("oidc")
+        if b is None:
+            return Response.error(404, "NOT_FOUND", "oidc not running")
+        return {"login_url": b.login_url()}
+
+    async def _sso_oidc_callback(self, req: Request):
+        from .sso import SsoError
+
+        b = self.sso.get("oidc")
+        if b is None:
+            return Response.error(404, "NOT_FOUND", "oidc not running")
+        code = (req.query or {}).get("code", "")
+        state = (req.query or {}).get("state", "")
+        loop = asyncio.get_running_loop()
+        try:
+            user = await loop.run_in_executor(
+                None, lambda: b.callback(code, state)
+            )
+        except SsoError as e:
+            return Response.error(401, "BAD_USERNAME_OR_PWD", str(e))
+        return self._issue_sso_token(user, "oidc")
 
     def _license_update(self, req: Request):
         """POST /api/v5/license {key} — install a new license key

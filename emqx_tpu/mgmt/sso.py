@@ -1,0 +1,219 @@
+"""Dashboard single sign-on — emqx_dashboard_sso analog.
+
+The reference ships SSO backends for the dashboard login (apps/
+emqx_dashboard_sso: ldap, oidc, saml). This module carries the two
+protocol-real backends:
+
+  * ldap — the dashboard credentials bind against an LDAP server
+    (reuses auth/ldap.py's LDAPv3/BER client; search-then-bind like
+    emqx_dashboard_sso_ldap).
+  * oidc — authorization-code flow: `login_url` sends the browser to
+    the IdP, the callback exchanges the code at the token endpoint
+    and verifies the id_token (HS256 client-secret or RS256/JWKS via
+    auth.authn.JwtProvider), mapping a claim to the dashboard
+    username (emqx_dashboard_sso_oidc).
+
+SAML stays triaged out (XML-DSig canonicalization stack; recorded in
+PARITY.md).
+
+SSO users receive ordinary dashboard tokens; a backend's
+`default_role` ("viewer" by default) bounds what an SSO-minted
+session may do.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import secrets
+import time
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, Optional
+
+log = logging.getLogger("emqx_tpu.sso")
+
+
+class SsoError(Exception):
+    pass
+
+
+class LdapSso:
+    backend = "ldap"
+
+    def __init__(self, conf: Dict[str, Any]):
+        self.conf = dict(conf)
+        self.enable = bool(conf.get("enable", True))
+
+    def login(self, username: str, password: str) -> str:
+        from ..auth.ldap import LdapClient, LdapError
+
+        if not password or not password.strip():
+            # RFC 4513 §5.1.2: a simple bind with an empty password is
+            # an UNAUTHENTICATED bind — servers return success without
+            # proving anything (same guard as auth/ldap.py's provider)
+            raise SsoError("invalid credentials")
+        c = self.conf
+        from ..broker.listeners import parse_bind
+
+        server = str(c.get("server", "127.0.0.1:389"))
+        if ":" not in server:
+            server += ":389"  # host-only config uses the LDAP default
+        host, port = parse_bind(server)
+        client = LdapClient(
+            host=host or "127.0.0.1", port=port,
+            bind_dn=c.get("bind_dn", ""),
+            bind_password=c.get("bind_password", ""),
+        )
+        try:
+            base = c.get("base_dn", "")
+            attr = c.get("filter_attr", "uid")
+            entries = client.with_conn(
+                lambda: client.search_eq(base, attr, username, [])
+            )
+            if not entries:
+                raise SsoError("user not found")
+            dn = entries[0][0]
+            code = client.with_conn(lambda: client.bind(dn, password))
+            if code != 0:
+                raise SsoError("invalid credentials")
+            return username
+        except LdapError as e:
+            raise SsoError(f"ldap: {e}") from None
+        finally:
+            client.close()
+
+    def info(self) -> Dict[str, Any]:
+        return {"backend": "ldap", "enable": self.enable}
+
+
+class OidcSso:
+    backend = "oidc"
+
+    def __init__(self, conf: Dict[str, Any]):
+        self.conf = dict(conf)
+        self.enable = bool(conf.get("enable", True))
+        self._states: Dict[str, float] = {}  # csrf state -> expiry
+        from ..auth.authn import JwtProvider
+
+        self._jwt = JwtProvider(
+            secret=str(conf.get("client_secret", "")).encode(),
+            jwks_endpoint=conf.get("jwks_endpoint"),
+        )
+
+    def login_url(self) -> str:
+        c = self.conf
+        state = secrets.token_urlsafe(16)
+        now = time.time()
+        # prune IN PLACE: callback() pops states from an executor
+        # thread, and a rebuilt-dict rebind from a stale snapshot
+        # could resurrect a just-consumed CSRF state
+        for s_ in [s_ for s_, e in self._states.items() if e <= now]:
+            self._states.pop(s_, None)
+        self._states[state] = now + 600
+        q = urllib.parse.urlencode({
+            "response_type": "code",
+            "client_id": c.get("client_id", ""),
+            "redirect_uri": c.get("redirect_uri", ""),
+            "scope": c.get("scope", "openid profile"),
+            "state": state,
+        })
+        return f"{c.get('authorization_endpoint', '')}?{q}"
+
+    def callback(self, code: str, state: str) -> str:
+        """Exchange the authorization code; returns the dashboard
+        username from the configured claim. BLOCKING http — callers
+        run it in an executor."""
+        exp = self._states.pop(state, None)  # atomic consume
+        if exp is None or exp < time.time():
+            raise SsoError("bad or expired state")
+        c = self.conf
+        body = urllib.parse.urlencode({
+            "grant_type": "authorization_code",
+            "code": code,
+            "redirect_uri": c.get("redirect_uri", ""),
+            "client_id": c.get("client_id", ""),
+            "client_secret": c.get("client_secret", ""),
+        }).encode()
+        req = urllib.request.Request(
+            c.get("token_endpoint", ""), data=body,
+            headers={"content-type": "application/x-www-form-urlencoded"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=10.0) as r:
+                tok = json.loads(r.read())
+        except Exception as e:
+            raise SsoError(f"token exchange failed: {e}") from None
+        id_token = tok.get("id_token")
+        if not id_token:
+            raise SsoError("no id_token in token response")
+        from ..auth.authn import Credentials
+
+        res = self._jwt.authenticate(Credentials(
+            client_id="sso", username=None,
+            password=id_token.encode(),
+        ))
+        ok = getattr(res, "ok", None)
+        if ok is not True:
+            raise SsoError("id_token verification failed")
+        claims = self._decode_claims(id_token)
+        name = claims.get(self.conf.get("username_claim", "sub"))
+        if not name:
+            raise SsoError("id_token carries no username claim")
+        return str(name)
+
+    @staticmethod
+    def _decode_claims(jwt: str) -> Dict[str, Any]:
+        from ..auth.authn import _b64url_decode
+
+        try:
+            return json.loads(_b64url_decode(jwt.split(".")[1]))
+        except Exception:
+            return {}
+
+    def info(self) -> Dict[str, Any]:
+        return {
+            "backend": "oidc", "enable": self.enable,
+            "authorization_endpoint": self.conf.get(
+                "authorization_endpoint", ""
+            ),
+        }
+
+
+_BACKENDS = {"ldap": LdapSso, "oidc": OidcSso}
+
+
+class SsoManager:
+    """Configured SSO backends + login dispatch (emqx_dashboard_sso's
+    running-backend registry)."""
+
+    def __init__(self) -> None:
+        self.backends: Dict[str, Any] = {}
+
+    def update(self, name: str, conf: Dict[str, Any]):
+        cls = _BACKENDS.get(name)
+        if cls is None:
+            raise SsoError(f"unknown sso backend {name!r} "
+                           f"(supported: {sorted(_BACKENDS)})")
+        b = cls(conf)
+        self.backends[name] = b
+        return b
+
+    def delete(self, name: str) -> bool:
+        return self.backends.pop(name, None) is not None
+
+    def get(self, name: str):
+        b = self.backends.get(name)
+        if b is None or not b.enable:
+            return None
+        return b
+
+    def running(self):
+        return sorted(n for n, b in self.backends.items() if b.enable)
+
+    def info(self):
+        return [b.info() for _n, b in sorted(self.backends.items())]
+
+    def default_role(self, name: str) -> str:
+        b = self.backends.get(name)
+        return (b.conf.get("default_role", "viewer") if b else "viewer")

@@ -327,9 +327,14 @@ def test_proc_dict_scoped_per_rule_kv_store_shared():
     eng.on_message_publish(
         Message(topic="t/a", payload=b"SECRET", qos=0, from_client="p")
     )
+    # rule firing order within one message is unordered — assert on a
+    # SECOND message, by which point rA has certainly run once
+    eng.on_message_publish(
+        Message(topic="t/a", payload=b"SECRET", qos=0, from_client="p")
+    )
     # rB fired from the SAME message env but sees only its own dict
-    assert got["B"][0]["theirs"] is None, got
-    assert got["B"][0]["shared"] == "SECRET"  # kv store is engine-wide
+    assert got["B"][-1]["theirs"] is None, got
+    assert got["B"][-1]["shared"] == "SECRET"  # kv store is engine-wide
     assert eng._proc_dicts["rA"] == {"x": "SECRET"}
     assert eng._proc_dicts.get("rB", {}) == {}
     # SELECT * must not leak engine-internal state into rows

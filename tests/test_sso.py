@@ -1,0 +1,276 @@
+"""Dashboard SSO (emqx_dashboard_sso analog): LDAP search-then-bind
+login and the OIDC authorization-code flow against mini servers, plus
+the RBAC bound on SSO-minted tokens."""
+
+import asyncio
+import json
+import time
+
+import pytest
+
+from emqx_tpu.auth.authn import make_jwt
+from emqx_tpu.broker.pubsub import Broker
+from emqx_tpu.mgmt.api import ManagementApi
+
+from test_ldap import MiniLdap
+from test_mgmt import http_req
+
+
+async def make_api():
+    broker = Broker()
+    api = ManagementApi(broker)
+    port = (await api.start("127.0.0.1", 0))[1]
+    _, login = await http_req(
+        port, "POST", "/api/v5/login",
+        {"username": "admin", "password": "public"},
+    )
+    return api, port, login["token"]
+
+
+async def test_ldap_sso_login_and_viewer_rbac():
+    ldap = MiniLdap()
+    await ldap.start()
+    ldap.entries["uid=jdoe,ou=people,dc=acme"] = (
+        "secret99", {"uid": [b"jdoe"]},
+    )
+    api, port, admin_tok = await make_api()
+    try:
+        st, _ = await http_req(
+            port, "PUT", "/api/v5/sso/ldap",
+            {
+                "enable": True,
+                "server": f"127.0.0.1:{ldap.port}",
+                "bind_dn": "cn=svc", "bind_password": "svcpw",
+                "base_dn": "ou=people,dc=acme", "filter_attr": "uid",
+            },
+            token=admin_tok,
+        )
+        assert st == 200
+        st, body = await http_req(port, "GET", "/api/v5/sso", token=admin_tok)
+        assert st == 200 and body[0]["backend"] == "ldap"
+
+        # good credentials -> dashboard token (no pre-provisioned user)
+        st, body = await http_req(
+            port, "POST", "/api/v5/sso/login/ldap",
+            {"username": "jdoe", "password": "secret99"},
+        )
+        assert st == 200 and body["role"] == "viewer"
+        sso_tok = body["token"]
+        st, _ = await http_req(
+            port, "GET", "/api/v5/stats", token=sso_tok
+        )
+        assert st == 200  # reads allowed
+        st, _ = await http_req(
+            port, "POST", "/api/v5/publish",
+            {"topic": "t", "payload": "x"}, token=sso_tok,
+        )
+        assert st == 403  # viewer role is read-only
+
+        # bad password / unknown user
+        st, _ = await http_req(
+            port, "POST", "/api/v5/sso/login/ldap",
+            {"username": "jdoe", "password": "WRONG"},
+        )
+        assert st == 401
+        st, _ = await http_req(
+            port, "POST", "/api/v5/sso/login/ldap",
+            {"username": "ghost", "password": "x"},
+        )
+        assert st == 401
+    finally:
+        await api.stop()
+        await ldap.stop()
+
+
+class MiniOidcIdp:
+    """Token endpoint: exchanges a known code for an HS256 id_token."""
+
+    def __init__(self, client_id, client_secret):
+        self.client_id = client_id
+        self.client_secret = client_secret
+        self.codes = {}  # code -> username
+        self.server = None
+        self.port = None
+
+    async def start(self):
+        self.server = await asyncio.start_server(self._conn, "127.0.0.1", 0)
+        self.port = self.server.sockets[0].getsockname()[1]
+
+    async def stop(self):
+        self.server.close()
+        await self.server.wait_closed()
+
+    async def _conn(self, reader, writer):
+        try:
+            raw = await reader.readuntil(b"\r\n\r\n")
+            headers = dict(
+                line.split(": ", 1)
+                for line in raw.decode().split("\r\n")[1:] if ": " in line
+            )
+            body = await reader.readexactly(
+                int(headers.get("Content-Length",
+                                headers.get("content-length", 0)))
+            )
+            from urllib.parse import parse_qs
+
+            form = {k: v[0] for k, v in parse_qs(body.decode()).items()}
+            user = self.codes.get(form.get("code"))
+            if (
+                user is None
+                or form.get("client_id") != self.client_id
+                or form.get("client_secret") != self.client_secret
+            ):
+                out = b'{"error": "invalid_grant"}'
+                writer.write(
+                    b"HTTP/1.1 400 Bad\r\ncontent-length: %d\r\n\r\n%s"
+                    % (len(out), out)
+                )
+            else:
+                idt = make_jwt(
+                    {
+                        "sub": user, "name": user.title(),
+                        "aud": self.client_id,
+                        "exp": int(time.time()) + 300,
+                    },
+                    self.client_secret.encode(),
+                )
+                out = json.dumps(
+                    {"access_token": "at", "id_token": idt}
+                ).encode()
+                writer.write(
+                    b"HTTP/1.1 200 OK\r\ncontent-type: application/json\r\n"
+                    b"content-length: %d\r\n\r\n%s" % (len(out), out)
+                )
+            await writer.drain()
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            writer.close()
+
+
+async def test_oidc_sso_code_flow():
+    idp = MiniOidcIdp("dash-client", "s3cret-oidc")
+    await idp.start()
+    idp.codes["code-123"] = "alice"
+    api, port, admin_tok = await make_api()
+    try:
+        st, _ = await http_req(
+            port, "PUT", "/api/v5/sso/oidc",
+            {
+                "enable": True,
+                "client_id": "dash-client",
+                "client_secret": "s3cret-oidc",
+                "authorization_endpoint": "http://idp.test/authorize",
+                "token_endpoint": f"http://127.0.0.1:{idp.port}/token",
+                "redirect_uri": "http://dash.test/callback",
+                "username_claim": "sub",
+                "default_role": "administrator",
+            },
+            token=admin_tok,
+        )
+        assert st == 200
+        st, body = await http_req(
+            port, "GET", "/api/v5/sso/oidc/login_url", token=admin_tok
+        )
+        assert st == 200 and body["login_url"].startswith(
+            "http://idp.test/authorize?"
+        )
+        from urllib.parse import parse_qs, urlparse
+
+        state = parse_qs(urlparse(body["login_url"]).query)["state"][0]
+
+        # IdP redirects back with code+state: the callback exchanges it
+        st, body = await http_req(
+            port, "GET",
+            f"/api/v5/sso/oidc/callback?code=code-123&state={state}",
+        )
+        assert st == 200 and body["role"] == "administrator"
+        st, _ = await http_req(
+            port, "GET", "/api/v5/stats", token=body["token"]
+        )
+        assert st == 200
+
+        # replayed/forged state is refused
+        st, _ = await http_req(
+            port, "GET",
+            f"/api/v5/sso/oidc/callback?code=code-123&state={state}",
+        )
+        assert st == 401
+        st, _ = await http_req(
+            port, "GET",
+            "/api/v5/sso/oidc/callback?code=code-123&state=FORGED",
+        )
+        assert st == 401
+    finally:
+        await api.stop()
+        await idp.stop()
+
+
+async def test_ldap_sso_empty_password_rejected():
+    """RFC 4513 §5.1.2: an empty password is an UNAUTHENTICATED bind —
+    never an authentication proof (review finding)."""
+    ldap = MiniLdap()
+    await ldap.start()
+    ldap.entries["uid=jdoe,ou=people,dc=acme"] = ("pw", {"uid": [b"jdoe"]})
+    api, port, admin_tok = await make_api()
+    try:
+        await http_req(
+            port, "PUT", "/api/v5/sso/ldap",
+            {"enable": True, "server": f"127.0.0.1:{ldap.port}",
+             "bind_dn": "cn=svc", "bind_password": "svcpw",
+             "base_dn": "ou=people,dc=acme"},
+            token=admin_tok,
+        )
+        st, _ = await http_req(
+            port, "POST", "/api/v5/sso/login/ldap",
+            {"username": "jdoe", "password": ""},
+        )
+        assert st == 401
+        st, _ = await http_req(
+            port, "POST", "/api/v5/sso/login/ldap",
+            {"username": "jdoe", "password": "   "},
+        )
+        assert st == 401
+    finally:
+        await api.stop()
+        await ldap.stop()
+
+
+async def test_oidc_login_url_is_unauthenticated_and_role_follows_config():
+    idp = MiniOidcIdp("c1", "s1")
+    await idp.start()
+    idp.codes["k1"] = "bob"
+    idp.codes["k2"] = "bob"
+    api, port, admin_tok = await make_api()
+    try:
+        conf = {
+            "enable": True, "client_id": "c1", "client_secret": "s1",
+            "authorization_endpoint": "http://idp/authorize",
+            "token_endpoint": f"http://127.0.0.1:{idp.port}/t",
+            "redirect_uri": "http://d/cb", "default_role": "administrator",
+        }
+        await http_req(port, "PUT", "/api/v5/sso/oidc", conf,
+                       token=admin_tok)
+        # a fresh browser (NO token) can start the flow
+        st, body = await http_req(port, "GET", "/api/v5/sso/oidc/login_url")
+        assert st == 200
+        from urllib.parse import parse_qs, urlparse
+
+        state = parse_qs(urlparse(body["login_url"]).query)["state"][0]
+        st, body = await http_req(
+            port, "GET", f"/api/v5/sso/oidc/callback?code=k1&state={state}",
+        )
+        assert st == 200 and body["role"] == "administrator"
+        # tightening default_role applies on the NEXT login
+        conf["default_role"] = "viewer"
+        await http_req(port, "PUT", "/api/v5/sso/oidc", conf,
+                       token=admin_tok)
+        st, body = await http_req(port, "GET", "/api/v5/sso/oidc/login_url")
+        state = parse_qs(urlparse(body["login_url"]).query)["state"][0]
+        st, body = await http_req(
+            port, "GET", f"/api/v5/sso/oidc/callback?code=k2&state={state}",
+        )
+        assert st == 200 and body["role"] == "viewer"
+    finally:
+        await api.stop()
+        await idp.stop()
