@@ -311,6 +311,12 @@ class Router:
             rf.extend([None] * (cap - len(rf)))
 
     def add_route(self, flt: str, dest: Dest) -> None:
+        if _speedups.load() is not None:
+            # one-pair batch through the native core: single source of
+            # truth with the storm path, and ~2x the pure-python
+            # per-add cost even with the per-call setup
+            self.add_routes([(flt, dest)])
+            return
         if not topic_mod.is_wildcard(flt):
             fresh_topic = flt not in self._exact
             dests = self._exact.setdefault(flt, {})
