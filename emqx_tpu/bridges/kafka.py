@@ -714,6 +714,7 @@ class KafkaConsumer(KafkaProducer):
         self.on_ingress = None  # set by the bridge registry
         self.offsets: Dict[int, int] = {}
         self._poll_task = None
+        self._stopping = False
         self.consumed = 0
 
     async def _fetch_offset(self, pid: int) -> int:
@@ -745,16 +746,26 @@ class KafkaConsumer(KafkaProducer):
             # records produced during the blip would silently vanish
             if pid not in self.offsets:
                 self.offsets[pid] = await self._fetch_offset(pid)
+        self._stopping = False
         self._poll_task = asyncio.ensure_future(self._poll_loop())
 
     async def on_stop(self) -> None:
-        if self._poll_task is not None:
-            self._poll_task.cancel()
-            self._poll_task = None
+        # cooperative flag FIRST: task.cancel() alone can lose the race
+        # on py<3.12 — wait_for swallows the CancelledError when the
+        # awaited read fails in the same tick the connections close
+        # below, leaving an orphan poll task retrying forever
+        self._stopping = True
+        t, self._poll_task = self._poll_task, None
+        if t is not None:
+            t.cancel()
+            try:
+                await asyncio.wait_for(t, timeout=2.0)
+            except BaseException:  # noqa: BLE001 — timeout/cancel/poll error
+                pass
         await super().on_stop()
 
     async def _poll_loop(self) -> None:
-        while True:
+        while not self._stopping:
             try:
                 # no client-side idle sleep: the Fetch itself is a
                 # server-side long poll (max_wait_ms); a second sleep
@@ -764,6 +775,8 @@ class KafkaConsumer(KafkaProducer):
             except asyncio.CancelledError:
                 return
             except Exception as e:  # noqa: BLE001
+                if self._stopping:
+                    return
                 log.warning("kafka consumer poll failed: %s", e)
                 self.partitions = {}
                 # permanent errors (deleted topic, authorization) back
@@ -773,6 +786,8 @@ class KafkaConsumer(KafkaProducer):
                     5.0 if isinstance(e, QueryError)
                     and not isinstance(e, RecoverableError) else 1.0
                 )
+                if self._stopping:
+                    return
                 try:
                     await self.refresh_metadata()
                     for pid in list(self.partitions):
@@ -796,6 +811,12 @@ class KafkaConsumer(KafkaProducer):
         by_addr: Dict[Tuple[str, int], List[int]] = {}
         for pid, addr in list(self.partitions.items()):
             by_addr.setdefault(addr, []).append(pid)
+        if not by_addr:
+            # partitions get dropped on a failed poll; if the metadata
+            # retry ALSO failed, fetching nothing "succeeds" and the
+            # loop hot-spins on no-op polls — surface it so the retry
+            # backoff applies instead
+            raise RecoverableError("no partitions known")
         v2 = self.wire_version >= 2
         for addr, pids in by_addr.items():
             parts = b""

@@ -355,6 +355,9 @@ class ManagementApi:
             r("DELETE", "/api/v5/trace/{name}", self._trace_delete)
             r("PUT", "/api/v5/trace/{name}/stop", self._trace_stop)
             r("GET", "/api/v5/trace/{name}/log", self._trace_log)
+        # kernel telemetry reads the router's always-on collector, so
+        # it is live even without the obs bundle wired
+        r("GET", "/api/v5/xla/telemetry", self._xla_telemetry)
         r("GET", "/api/v5/audit", self._audit_list)
         r("GET", "/api/v5/file_transfer/files", self._ft_files)
         r("GET", "/api/v5/gateways", self._gateways_list)
@@ -1240,6 +1243,16 @@ class ManagementApi:
             body=self.obs.prometheus_text().encode(),
             content_type="text/plain; version=0.0.4",
         )
+
+    def _xla_telemetry(self, req: Request):
+        """Runtime view of the kernel-telemetry collector: dispatch
+        percentiles per leg, recompile/shape-bucket state, DeviceTable
+        gauges — the same numbers the emqx_xla_* Prometheus families
+        render (obs/kernel_telemetry.py snapshot())."""
+        tel = getattr(self.broker.router, "telemetry", None)
+        if tel is None:
+            return {"enabled": False}
+        return tel.snapshot()
 
     def _alarms_list(self, req: Request):
         which = "all"

@@ -30,6 +30,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs.kernel_telemetry import NULL as _NULL_TEL
+from ..obs.kernel_telemetry import (
+    LEG_DENSE,
+    LEG_ENCODE,
+    LEG_FALLBACK,
+    LEG_HASH,
+    LEG_UNPACK,
+    KernelTelemetry,
+)
 from ..ops import hash_index as hash_ops
 from ..ops import match as match_ops
 from ..ops import speedups as _speedups
@@ -119,10 +128,12 @@ class DeviceTable:
         table: FilterTable,
         device=None,
         index: Optional[ClassIndex] = None,
+        telemetry=None,
     ) -> None:
         self.table = table
         self.device = device
         self.index = index
+        self.telemetry = telemetry if telemetry is not None else _NULL_TEL
         self._dev: Optional[EncodedFilters] = None
         self._synced_capacity = 0
         self._dev_meta: Optional[ClassMeta] = None
@@ -161,6 +172,9 @@ class DeviceTable:
             idx = np.full(n_batches * SYNC_BATCH_SIZE, dirty[-1], np.int32)
             idx[:total] = dirty
             shape2 = (n_batches, SYNC_BATCH_SIZE)
+            self.telemetry.record_shape(
+                "_scatter_slots", (n_batches, len(ix.slots.fp))
+            )
             self._dev_slots = _scatter_slots(
                 self._dev_slots,
                 jnp.asarray(idx.reshape(shape2)),
@@ -191,6 +205,19 @@ class DeviceTable:
 
     def sync(self) -> int:
         """Bring device state up to date; returns rows written."""
+        tel = self.telemetry
+        t0 = tel.clock()
+        pending = len(self.table.dirty)
+        n, full = self._sync_impl()
+        if tel.enabled and (n or full):
+            tel.record_sync(
+                rows=n, seconds=tel.clock() - t0, pending=pending, full=full
+            )
+            tel.observe_device_table(self)
+        return n
+
+    def _sync_impl(self) -> Tuple[int, bool]:
+        """(rows written, was a full re-upload)."""
         t = self.table
         if self._dev is None or t.grew or t.capacity != self._synced_capacity:
             n = len(t.dirty)
@@ -198,13 +225,13 @@ class DeviceTable:
             self._upload_full()
             if self.index is not None:
                 self._sync_index()
-            return n
+            return n, True
         dirty = t.drain_dirty()
         total = len(dirty)
         if total == 0:
             if self.index is not None:
                 self._sync_index()
-            return 0
+            return 0, False
         # pad to [n_batches, K]: idempotent padding rewrites the last row;
         # n_batches rounds up to a power of two so recompiles stay
         # log-bounded across workload sizes
@@ -212,6 +239,9 @@ class DeviceTable:
         rows = np.full(n_batches * SYNC_BATCH_SIZE, dirty[-1], np.int32)
         rows[:total] = dirty
         shape2 = (n_batches, SYNC_BATCH_SIZE)
+        self.telemetry.record_shape(
+            "_scatter_rows", (n_batches, t.capacity, t.max_levels)
+        )
         self._dev = _scatter_rows(
             self._dev,
             jnp.asarray(rows.reshape(shape2)),
@@ -223,7 +253,7 @@ class DeviceTable:
         )
         if self.index is not None:
             self._sync_index()
-        return total
+        return total, False
 
     def filters(self) -> EncodedFilters:
         assert self._dev is not None, "sync() before matching"
@@ -240,6 +270,7 @@ class Router:
         device=None,
         use_hash_index: bool = True,
         mesh=None,
+        telemetry=None,
     ) -> None:
         """With `mesh` (a jax.sharding.Mesh), the wildcard table lives
         SUB-SHARDED across the mesh and batched matching runs the
@@ -288,17 +319,25 @@ class Router:
         self._deep: Dict[str, Dict[Dest, int]] = {}
         self._deep_trie = TopicTrie()
         self.mesh = mesh
+        # kernel telemetry: always-on by default (obs/kernel_telemetry).
+        # Pass NULL (or any NullKernelTelemetry) to run the hot path
+        # with bound no-op hooks instead.
+        self.telemetry = (
+            telemetry if telemetry is not None else KernelTelemetry()
+        )
         if mesh is not None:
             from ..parallel.sharded_match import ShardedDeviceTable
 
             self.index = ClassIndex(max_levels) if use_hash_index else None
             self.device_table = ShardedDeviceTable(
-                self.table, mesh, index=self.index
+                self.table, mesh, index=self.index,
+                telemetry=self.telemetry,
             )
         else:
             self.index = ClassIndex(max_levels) if use_hash_index else None
             self.device_table = DeviceTable(
-                self.table, device=device, index=self.index
+                self.table, device=device, index=self.index,
+                telemetry=self.telemetry,
             )
 
     # --- write path (emqx_router:do_add_route / do_delete_route) -------
@@ -669,15 +708,23 @@ class Router:
             dests.update(dmap)
         return dests
 
-    @staticmethod
-    def _escalating_pairs(kernel, max_hits: int):
+    def _escalating_pairs(self, kernel, max_hits: int, shape_key=None):
         """Run a compaction kernel (max_hits -> (a, b, total)), escalating
         max_hits once to the exact total on overflow (both kernels report
-        the true count, so one retry suffices — no bitmap fallback)."""
+        the true count, so one retry suffices — no bitmap fallback).
+        `shape_key` (kernel-static dims sans max_hits) feeds the
+        recompile tracker: the escalated retry is a NEW shape bucket."""
+        tel = self.telemetry
+        if shape_key is not None:
+            tel.record_shape("match_ids", shape_key + (max_hits,))
         a, b, total = kernel(max_hits)
         total = int(total)
         if total > max_hits:
-            a, b, _ = kernel(_next_pow2(total))
+            tel.count("escalations_total")
+            mh2 = _next_pow2(total)
+            if shape_key is not None:
+                tel.record_shape("match_ids", shape_key + (mh2,))
+            a, b, _ = kernel(mh2)
         return np.asarray(a), np.asarray(b), total
 
     def match_filters_batch(self, topics: Sequence[str]) -> List[List[str]]:
@@ -694,8 +741,18 @@ class Router:
         exact-size retry on overflow."""
         if not topics:
             return []
+        tel = self.telemetry
+        clock = tel.clock
+        tel.count("dispatch_batches_total")
+        root = tel.span("xla.match_batch")
+        if root is not None:
+            root.set("batch", len(topics))
         self.device_table.sync()
+        sp = tel.span("xla.encode", root)
+        t0 = clock()
         enc = match_ops.encode_topics(self.table.vocab, topics, self.max_levels)
+        tel.record_dispatch(LEG_ENCODE, clock() - t0)
+        tel.end_span(sp)
         # exact topics are device rows (wildcard-free classes), so the
         # kernel surfaces them; only too-deep exacts need the host dict
         if self._exact_deep:
@@ -707,7 +764,11 @@ class Router:
         ix = self.index
         if self.mesh is not None and ix is None:
             # dense-only mesh path (use_hash_index=False)
+            sp = tel.span("xla.dispatch", root)
+            t0 = clock()
             ti, ri, = self.device_table.match_ids(enc)
+            tel.record_dispatch(LEG_DENSE, clock() - t0)
+            tel.end_span(sp)
             b = len(topics)
             for t_idx, row in zip(ti, ri):
                 if t_idx < b:  # drop dp-padding rows
@@ -715,34 +776,54 @@ class Router:
             if self._deep:
                 for i, t in enumerate(topics):
                     out[i].extend(self._deep_trie.match(topic_mod.words(t)))
+            tel.end_span(root)
             return out
         if ix is not None:
             host_fallback = False
             if len(ix):
+                sp = tel.span("xla.dispatch", root)
+                t0 = clock()
                 if self.mesh is not None:
                     ti, bi, amb = self.device_table.match_hash(enc)
                 else:
                     meta, slots = self.device_table.hash_state()
                     mh = max(1024, _next_pow2(2 * len(topics)))
+                    tel.record_shape(
+                        "match_ids_hash",
+                        (len(topics), meta.plen.shape[0],
+                         slots.fp.shape[0], mh),
+                    )
                     ti, bi, total, amb = hash_ops.match_ids_hash(
                         meta, slots, enc, max_hits=mh
                     )
                     total = int(total)
                     if total > mh:
+                        tel.count("hash_overflow_retries_total")
+                        mh = _next_pow2(total)
+                        tel.record_shape(
+                            "match_ids_hash",
+                            (len(topics), meta.plen.shape[0],
+                             slots.fp.shape[0], mh),
+                        )
                         ti, bi, _t, amb = hash_ops.match_ids_hash(
-                            meta, slots, enc, max_hits=_next_pow2(total)
+                            meta, slots, enc, max_hits=mh
                         )
                     ti = np.asarray(ti)[:total]
                     bi = np.asarray(bi)[:total]
                     amb = int(amb)
+                tel.record_dispatch(LEG_HASH, clock() - t0)
+                tel.end_span(sp)
                 if amb:
                     # >1 lane of one pair passed the full-fingerprint
                     # check: distinct filters colliding on all 32 bits
                     # (~2^-32/pair). The kernel kept one arbitrarily,
                     # so re-match the batch on the host trie — exact,
                     # and covers residual rows too.
+                    tel.count("ambiguous_batches_total")
                     host_fallback = True
                 else:
+                    sp = tel.span("xla.unpack", root)
+                    t0 = clock()
                     twords: List = [None] * len(topics)
                     for t_idx, bid in zip(ti, bi):
                         t_idx, bid = int(t_idx), int(bid)
@@ -755,7 +836,12 @@ class Router:
                         if topic_mod.match(twords[t_idx], fw):
                             for row in ix.bucket_rows(bid):
                                 out[t_idx].append(self._row_filter[row])
+                    tel.record_dispatch(LEG_UNPACK, clock() - t0)
+                    tel.end_span(sp)
             if host_fallback:
+                tel.count("host_fallback_total")
+                sp = tel.span("xla.host_fallback", root)
+                t0 = clock()
                 for i, t in enumerate(topics):
                     # indexed exact topics are NOT in the trie — the
                     # dest dict is their host source of truth
@@ -763,7 +849,11 @@ class Router:
                         out[i].append(t)
                     for row in self._host_trie().match(topic_mod.words(t)):
                         out[i].append(self._row_filter[row])
+                tel.record_dispatch(LEG_FALLBACK, clock() - t0)
+                tel.end_span(sp)
             elif ix.residual_rows:
+                sp = tel.span("xla.dispatch", root)
+                t0 = clock()
                 if self.mesh is not None:
                     ti, ri = self.device_table.match_ids(enc, residual=True)
                     for t_idx, row in zip(ti, ri):
@@ -776,20 +866,31 @@ class Router:
                             filters, enc, max_hits=mh
                         ),
                         max(1024, _next_pow2(2 * len(topics))),
+                        shape_key=(
+                            len(topics), int(filters.words.shape[0])
+                        ),
                     )
                     for t_idx, row in zip(ti[:total], ri[:total]):
                         out[int(t_idx)].append(self._row_filter[int(row)])
+                tel.record_dispatch(LEG_DENSE, clock() - t0)
+                tel.end_span(sp)
         else:
             filters = self.device_table.filters()
+            sp = tel.span("xla.dispatch", root)
+            t0 = clock()
             ti, ri, total = self._escalating_pairs(
                 lambda mh: match_ops.match_ids(filters, enc, max_hits=mh),
                 max(4096, _next_pow2(4 * len(topics))),
+                shape_key=(len(topics), int(filters.words.shape[0])),
             )
             for t_idx, row in zip(ti[:total], ri[:total]):
                 out[int(t_idx)].append(self._row_filter[int(row)])
+            tel.record_dispatch(LEG_DENSE, clock() - t0)
+            tel.end_span(sp)
         if self._deep:
             for i, t in enumerate(topics):
                 out[i].extend(self._deep_trie.match(topic_mod.words(t)))
+        tel.end_span(root)
         return out
 
     def match_pairs_batch(

@@ -7,7 +7,11 @@
   * trace      — client/topic/ip traces to files with text or json
                  formatting (apps/emqx/src/emqx_trace);
   * prometheus — text exposition of metrics/stats
-                 (apps/emqx_prometheus).
+                 (apps/emqx_prometheus);
+  * kernel_telemetry — device hot-path collector: dispatch-latency
+                 histograms, recompile tracking, DeviceTable gauges,
+                 exported as emqx_xla_* families (no reference analog:
+                 this is the TPU layer the reproduction adds).
 
 `Observability` bundles the per-broker pieces and installs the hook
 taps, the emqx_sup-analog wiring.
@@ -16,6 +20,12 @@ taps, the emqx_sup-analog wiring.
 from __future__ import annotations
 
 from .alarm import AlarmError, Alarms  # noqa: F401
+from .kernel_telemetry import (  # noqa: F401
+    NULL as NULL_TELEMETRY,
+    KernelTelemetry,
+    NullKernelTelemetry,
+    StreamingHistogram,
+)
 from .prometheus import prometheus_text  # noqa: F401
 from .slow_subs import SlowSubs  # noqa: F401
 from .sys import SysHeartbeat  # noqa: F401

@@ -39,7 +39,7 @@ class Monitor:
         """Instantaneous gauges (monitor_current)."""
         stats = self.broker.stats.all()
         m = self.broker.metrics
-        return {
+        out = {
             "connections": stats.get("connections.count", 0),
             "sessions": stats.get("sessions.count", 0),
             "subscriptions": stats.get("subscriptions.count", 0),
@@ -48,7 +48,21 @@ class Monitor:
             "received_msg": m.val("messages.received"),
             "sent_msg": m.val("messages.sent"),
             "dropped_msg": m.val("messages.dropped"),
+            # device hot-path gauges ride the same sampling loop, so
+            # the dashboard time-series carries dispatch p99 and HBM
+            # occupancy alongside connection/message rates
+            "xla_dispatch_p99_ms": 0.0,
+            "xla_hbm_bytes": 0,
+            "xla_recompiles": 0,
         }
+        tel = getattr(self.broker.router, "telemetry", None)
+        if tel is not None and tel.enabled:
+            out["xla_dispatch_p99_ms"] = round(
+                tel.dispatch_percentile(99) * 1e3, 4
+            )
+            out["xla_hbm_bytes"] = int(tel.gauges.get("device_table_bytes", 0))
+            out["xla_recompiles"] = tel.counters.get("recompiles_total", 0)
+        return out
 
     def sample(self) -> Dict:
         """Take one sample; rates are deltas since the previous one."""

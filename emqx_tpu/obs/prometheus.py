@@ -3,7 +3,14 @@
 Renders the broker's counters and gauges into the Prometheus text
 format the reference serves at /api/v5/prometheus/stats. Counter
 names are mapped `messages.received` → `emqx_messages_received`,
-matching the reference's emqx_* metric families.
+matching the reference's emqx_* metric families; stats `.max`
+watermarks map to `emqx_*_max` gauge families.
+
+Kernel-telemetry families (`emqx_xla_*` — dispatch-latency histograms
+with `_bucket`/`_sum`/`_count` + `le` labels, recompile counters,
+DeviceTable gauges; see obs/kernel_telemetry.py) append to the same
+scrape when the broker's Router carries a live collector, so the
+device hot path and the broker surface share one exposition endpoint.
 """
 
 from __future__ import annotations
@@ -34,8 +41,8 @@ def prometheus_text(broker, node_name: str = "emqx@127.0.0.1") -> str:
     emit("emqx_sessions_count", "gauge", len(broker.sessions))
     emit("emqx_subscriptions_count", "gauge", len(broker.suboptions))
     for name, val in sorted(broker.stats.all().items()):
-        if name.endswith(".max"):
-            continue
+        # `.max` watermarks normalize to their own `emqx_*_max` family
+        # (distinct names, so the one-family invariant holds)
         emit(_norm(name), "gauge", val)
     rstats = broker.router.stats()
     emit(
@@ -43,4 +50,9 @@ def prometheus_text(broker, node_name: str = "emqx@127.0.0.1") -> str:
         "gauge",
         rstats["exact_topics"] + rstats["wildcard_routes"] + rstats["deep_routes"],
     )
+    # kernel telemetry: the emqx_xla_* namespace is disjoint from every
+    # broker-derived family, so a plain append preserves uniqueness
+    tel = getattr(broker.router, "telemetry", None)
+    if tel is not None and tel.enabled:
+        lines.extend(tel.prometheus_lines(node_name))
     return "\n".join(lines) + "\n"

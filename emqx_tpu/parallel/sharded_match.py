@@ -405,12 +405,15 @@ class ShardedDeviceTable:
         mesh: Mesh,
         max_hits_per_block: int = 2048,
         index=None,
+        telemetry=None,
     ):
         from . import mesh as mesh_mod
+        from ..obs.kernel_telemetry import NULL as _null_tel
 
         self.table = table
         self.mesh = mesh
         self.index = index
+        self.telemetry = telemetry if telemetry is not None else _null_tel
         self._mesh_mod = mesh_mod
         self._dev: Optional[EncodedFilters] = None
         self._synced_capacity = 0
@@ -498,6 +501,18 @@ class ShardedDeviceTable:
             ix.residual_dirty = False
 
     def sync(self) -> int:
+        tel = self.telemetry
+        t0 = tel.clock()
+        pending = len(self.table.dirty)
+        n, full = self._sync_impl()
+        if tel.enabled and (n or full):
+            tel.record_sync(
+                rows=n, seconds=tel.clock() - t0, pending=pending, full=full
+            )
+            tel.observe_device_table(self)
+        return n
+
+    def _sync_impl(self):
         t = self.table
         if self._dev is None or t.grew or t.capacity != self._synced_capacity:
             n = len(t.dirty)
@@ -506,12 +521,12 @@ class ShardedDeviceTable:
             self._synced_capacity = t.capacity
             if self.index is not None:
                 self._sync_index()
-            return n
+            return n, True
         dirty = t.drain_dirty()  # ndarray: row id 0 alone is falsy —
         if len(dirty) == 0:      # test LENGTH, never truthiness
             if self.index is not None:
                 self._sync_index()
-            return 0
+            return 0, False
         import numpy as np
 
         total = len(dirty)
@@ -523,6 +538,9 @@ class ShardedDeviceTable:
         idx = np.full(n_b * k, arr[-1], np.int32)
         idx[:total] = arr
         shape2 = (n_b, k)
+        self.telemetry.record_shape(
+            "apply_delta", (n_b, t.capacity, t.max_levels)
+        )
         self._dev = self._apply_delta(
             self._dev,
             jnp.asarray(idx.reshape(shape2)),
@@ -534,7 +552,7 @@ class ShardedDeviceTable:
         )
         if self.index is not None:
             self._sync_index()
-        return total
+        return total, False
 
     def match_ids(self, enc: EncodedTopics, residual: bool = False):
         """All (topic, row) hit pairs for an encoded topic batch via

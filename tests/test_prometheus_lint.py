@@ -1,0 +1,125 @@
+"""Prometheus exposition lint: scrape `prometheus_text` output and
+validate the text-format invariants a real Prometheus server enforces —
+TYPE lines, metric/label syntax, one family per name, histogram
+`_bucket`/`_sum`/`_count` structure with cumulative `le` buckets.
+Guards the exporter against the classic silent failure: a scrape that
+looks fine in tests and 400s at ingestion."""
+
+import re
+
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.packet import SubOpts
+from emqx_tpu.broker.pubsub import Broker
+from emqx_tpu.obs import prometheus_text
+
+_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_TYPE_RE = re.compile(rf"^# TYPE ({_NAME}) (counter|gauge|histogram)$")
+_SAMPLE_RE = re.compile(
+    rf"^({_NAME})"
+    rf"(?:\{{({_NAME}=\"[^\"\\]*\"(?:,{_NAME}=\"[^\"\\]*\")*)\}})?"
+    r" (-?[0-9.e+-]+|\+Inf|NaN)$"
+)
+
+
+def _scraped_broker():
+    broker = Broker()
+    s, _ = broker.open_session("c1", clean_start=True)
+    s.outgoing_sink = lambda pkts: None
+    broker.subscribe(s, "t/#", SubOpts(qos=0))
+    broker.publish(Message(topic="t/1", payload=b"x"))
+    # drive the device match path so emqx_xla_* families populate
+    broker.router.add_routes([(f"k{i}/+/v/#", f"d{i}") for i in range(16)])
+    broker.router.match_filters_batch([f"k{i}/a/v/w" for i in range(8)])
+    return broker
+
+
+def _family_of(sample_name: str, histograms) -> str:
+    for suffix in ("_bucket", "_sum", "_count"):
+        if sample_name.endswith(suffix) and sample_name[: -len(suffix)] in histograms:
+            return sample_name[: -len(suffix)]
+    return sample_name
+
+
+def test_exposition_lint():
+    text = prometheus_text(_scraped_broker(), "n1@host")
+    assert text.endswith("\n")
+    types = {}  # family -> kind
+    samples_seen_for = set()
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("#"):
+            m = _TYPE_RE.match(line)
+            assert m, f"malformed comment line: {line!r}"
+            fam = m.group(1)
+            # one TYPE line per family, declared before any sample
+            assert fam not in types, f"duplicate TYPE for {fam}"
+            assert fam not in samples_seen_for, f"TYPE after samples: {fam}"
+            types[fam] = m.group(2)
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"malformed sample line: {line!r}"
+        histograms = {f for f, k in types.items() if k == "histogram"}
+        fam = _family_of(m.group(1), histograms)
+        assert fam in types, f"sample without TYPE: {line!r}"
+        samples_seen_for.add(fam)
+    # every declared family produced at least one sample
+    assert set(types) == samples_seen_for
+
+
+def test_histogram_families_well_formed():
+    text = prometheus_text(_scraped_broker(), "n1@host")
+    fam = "emqx_xla_dispatch_duration_seconds"
+    assert f"# TYPE {fam} histogram" in text
+    legs = {}
+    for line in text.splitlines():
+        if line.startswith(f"{fam}_bucket{{"):
+            labels = line[line.index("{") + 1 : line.index("}")]
+            le = re.search(r'le="([^"]+)"', labels).group(1)
+            leg = re.search(r'leg="([^"]+)"', labels).group(1)
+            legs.setdefault(leg, []).append((le, int(line.rsplit(" ", 1)[1])))
+    assert "hash" in legs and "encode" in legs
+    for leg, buckets in legs.items():
+        les = [le for le, _ in buckets]
+        counts = [c for _, c in buckets]
+        assert les[-1] == "+Inf", f"{leg}: no terminal +Inf bucket"
+        assert counts == sorted(counts), f"{leg}: buckets not cumulative"
+        assert f'{fam}_sum{{node="n1@host",leg="{leg}"}}' in text
+        assert f'{fam}_count{{node="n1@host",leg="{leg}"}}' in text
+        # _count equals the +Inf bucket
+        count_line = next(
+            l for l in text.splitlines()
+            if l.startswith(f'{fam}_count{{node="n1@host",leg="{leg}"}}')
+        )
+        assert int(count_line.rsplit(" ", 1)[1]) == counts[-1]
+
+
+def test_xla_families_present_after_match():
+    text = prometheus_text(_scraped_broker(), "n1@host")
+    assert 'emqx_xla_recompiles_total{node="n1@host"}' in text
+    assert 'emqx_xla_device_table_bytes{node="n1@host"}' in text
+    assert 'emqx_xla_jit_cache_entries{node="n1@host",kernel="match_ids_hash"}' in text
+    # dispatch counts actually populated (non-zero _count for hash leg)
+    m = re.search(
+        r'emqx_xla_dispatch_duration_seconds_count\{node="n1@host",leg="hash"\} (\d+)',
+        text,
+    )
+    assert m and int(m.group(1)) >= 1
+
+
+def test_max_watermark_gauges_emitted():
+    # stats `.max` watermarks were silently dropped before; they now
+    # export as emqx_*_max gauge families
+    text = prometheus_text(_scraped_broker(), "n1@host")
+    assert "# TYPE emqx_sessions_count_max gauge" in text
+    assert 'emqx_sessions_count_max{node="n1@host"}' in text
+
+
+def test_null_telemetry_scrape_stays_clean():
+    from emqx_tpu.obs.kernel_telemetry import NULL
+
+    broker = Broker()
+    broker.router.telemetry = NULL
+    text = prometheus_text(broker, "n1@host")
+    assert "emqx_xla_" not in text
+    assert "# TYPE emqx_topics_count gauge" in text
