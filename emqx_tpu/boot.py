@@ -238,6 +238,8 @@ class Node:
             broker,
             node_name=node_name,
             trace_dir=os.path.join(data_dir, "trace"),
+            flight_dir=os.path.join(data_dir, "flight"),
+            config=cfg,
         )
         self.obs.start(cfg.get("sys_topics.sys_heartbeat_interval") / 1000.0)
 
@@ -396,6 +398,7 @@ class Node:
             gateways=self.gateways,
             listeners=self.listeners,
             license=self.license,
+            obs=self.obs,
         )
         log.info("node %s started", node_name)
 

@@ -24,6 +24,11 @@ import time
 from collections import deque
 from typing import Any, Callable, Deque, Dict, List, Optional
 
+# flight-recorder seam (obs/flight_recorder): retry/fallback/drop
+# transitions land in the black-box ring when a recorder is installed;
+# emit() is one global read + branch otherwise
+from ..obs.flight_recorder import emit as _flight_emit
+
 log = logging.getLogger("emqx_tpu.bridges.resource")
 
 
@@ -119,6 +124,10 @@ class BufferWorker:
         if len(self._queue) >= self.max_queue:
             self._queue.popleft()  # drop OLDEST (replayq overflow mode)
             self.metrics.inc("dropped.queue_full")
+            _flight_emit(
+                "bridge.queue_drop",
+                attrs={"connector": type(self.connector).__name__},
+            )
         self._queue.append(request)
         self._idle.clear()
         self._wake.set()
@@ -202,11 +211,26 @@ class BufferWorker:
                 except RecoverableError:
                     attempt += 1
                     self.metrics.inc("retried")
+                    _flight_emit(
+                        "bridge.retry",
+                        attrs={
+                            "connector": type(self.connector).__name__,
+                            "attempt": attempt,
+                        },
+                    )
                     if (
                         self.max_retries is not None
                         and attempt > self.max_retries
                     ):
                         self.metrics.inc("failed", len(batch))
+                        _flight_emit(
+                            "bridge.failed",
+                            attrs={
+                                "connector": type(self.connector).__name__,
+                                "batch": len(batch),
+                                "reason": "retries_exhausted",
+                            },
+                        )
                         return
                     # bounded backoff; the pump pauses so newer work
                     # queues up behind this batch instead of passing it
@@ -220,6 +244,14 @@ class BufferWorker:
                 except Exception:
                     log.exception("query failed (unrecoverable)")
                     self.metrics.inc("failed", len(batch))
+                    _flight_emit(
+                        "bridge.failed",
+                        attrs={
+                            "connector": type(self.connector).__name__,
+                            "batch": len(batch),
+                            "reason": "unrecoverable",
+                        },
+                    )
                     return
         finally:
             if pausing:
@@ -295,6 +327,10 @@ class Resource:
             if status == ResourceStatus.DISCONNECTED:
                 # auto-restart the driver (resource_manager reconnect)
                 self.status = ResourceStatus.CONNECTING
+                _flight_emit(
+                    "bridge.reconnect",
+                    attrs={"resource": self.id, "error": self.error or ""},
+                )
                 try:
                     await self.connector.on_stop()
                 except Exception:

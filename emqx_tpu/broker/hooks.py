@@ -10,7 +10,8 @@ apps/emqx/src/emqx_hookpoints.erl:41-69 so reference plugins map 1:1.
 from __future__ import annotations
 
 import bisect
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from time import perf_counter
+from typing import Any, Callable, Dict, List, Tuple
 
 # Canonical hookpoints (emqx_hookpoints.erl:41-69)
 HOOKPOINTS = [
@@ -54,6 +55,16 @@ class Hooks:
         self._hooks: Dict[str, List[Tuple[int, int, Callable]]] = {}
         self._seq = 0
         self._strict = strict
+        # observability seam: per-hookpoint observers
+        # fn(hookpoint, seconds, subject) called after a NON-EMPTY
+        # chain run with the chain's wall time and its primary
+        # argument (the flight recorder's hook tap). An empty dict —
+        # the default — costs one truthiness check per run; a
+        # hookpoint without an observer pays one dict probe. Keeping
+        # the registration per-point lets the recorder skip the
+        # per-delivery points (message.delivered/acked/puback) whose
+        # call rate would otherwise dominate the timing cost.
+        self.observers: Dict[str, Callable[[str, float, Any], None]] = {}
         # cb -> slow marker (bool, or zero-arg callable evaluated at
         # query time so a chain can become slow when e.g. a network
         # authz source is added after registration)
@@ -104,6 +115,33 @@ class Hooks:
 
     def run(self, name: str, *args: Any) -> bool:
         """Run the chain; returns False if a callback returned STOP."""
+        chain = self._hooks.get(name)
+        if not chain:
+            return True
+        obs = self.observers.get(name) if self.observers else None
+        if obs is None:
+            for _, _, cb in chain:
+                if cb(*args) is STOP:
+                    return False
+            return True
+        ok = True
+        t0 = perf_counter()
+        try:
+            for _, _, cb in chain:
+                if cb(*args) is STOP:
+                    ok = False
+                    break
+        finally:
+            obs(name, perf_counter() - t0, args[0] if args else None)
+        return ok
+
+    def run_unobserved(self, name: str, *args: Any) -> bool:
+        """run() minus the observer probe, for per-delivery hookpoints
+        (message.delivered and friends — flight_recorder's
+        UNTIMED_HOOKPOINTS): wide-fanout loops call the chain once PER
+        DELIVERY, where even a ~100ns dict probe busts the recorder's
+        <2% enabled-path budget. Semantically identical to run() for
+        any hookpoint that never gets an observer."""
         for _, _, cb in self._hooks.get(name, ()):
             if cb(*args) is STOP:
                 return False
@@ -112,7 +150,24 @@ class Hooks:
     def run_fold(self, name: str, args: Tuple, acc: Any) -> Any:
         """Fold the accumulator through the chain. Callbacks receive
         (*args, acc) and return None (keep), (STOP, acc'), or acc'."""
-        for _, _, cb in self._hooks.get(name, ()):
+        chain = self._hooks.get(name)
+        if not chain:
+            return acc
+        obs = self.observers.get(name) if self.observers else None
+        if obs is None:
+            return self._fold(chain, args, acc)
+        # the fold subject: message.publish passes the message as the
+        # ACCUMULATOR (args empty), so fall back to it for correlation
+        subject = args[0] if args else acc
+        t0 = perf_counter()
+        try:
+            return self._fold(chain, args, acc)
+        finally:
+            obs(name, perf_counter() - t0, subject)
+
+    @staticmethod
+    def _fold(chain, args: Tuple, acc: Any) -> Any:
+        for _, _, cb in chain:
             r = cb(*args, acc)
             if r is None:
                 continue

@@ -527,7 +527,10 @@ class Broker:
         mem, other = plan
         n = 0
         run_hook = self.hooks.has("message.delivered")
-        hooks_run = self.hooks.run
+        # per-delivery hookpoints are untimed by contract (obs/
+        # flight_recorder UNTIMED_HOOKPOINTS): the probe-free runner
+        # keeps the recorder's cost off the per-subscriber loop
+        hooks_run = self.hooks.run_unobserved
         fr = msg.from_client
         mq = msg.qos
         m = len(mem)
@@ -626,7 +629,7 @@ class Broker:
                 if opts.no_local and msg.from_client == client:
                     continue
                 n += 1
-                self.hooks.run("message.delivered", client, msg)
+                self.hooks.run_unobserved("message.delivered", client, msg)
                 retain = msg.retain if opts.retain_as_published else False
                 shared_pkt = pkt_cache.get(retain)
                 if shared_pkt is None:
@@ -647,7 +650,7 @@ class Broker:
             if opts.no_local and msg.from_client == client:
                 continue
             packets = session.deliver(msg, opts)
-            self.hooks.run("message.delivered", client, msg)
+            self.hooks.run_unobserved("message.delivered", client, msg)
             if packets:
                 sink = getattr(session, "outgoing_sink", None)
                 if sink is not None:
@@ -673,7 +676,7 @@ class Broker:
         if best is None:
             return 0
         packets = session.deliver(msg, best)
-        self.hooks.run("message.delivered", client_id, msg)
+        self.hooks.run_unobserved("message.delivered", client_id, msg)
         if packets:
             sink = getattr(session, "outgoing_sink", None)
             if sink is not None:
@@ -691,7 +694,7 @@ class Broker:
         if opts is None:
             return 0
         packets = session.deliver(msg, opts)
-        self.hooks.run("message.delivered", client_id, msg)
+        self.hooks.run_unobserved("message.delivered", client_id, msg)
         if packets:
             sink = getattr(session, "outgoing_sink", None)
             if sink is not None:

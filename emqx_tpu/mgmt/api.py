@@ -355,6 +355,15 @@ class ManagementApi:
             r("DELETE", "/api/v5/trace/{name}", self._trace_delete)
             r("PUT", "/api/v5/trace/{name}/stop", self._trace_stop)
             r("GET", "/api/v5/trace/{name}/log", self._trace_log)
+            # flight recorder (black-box diagnostics): status + ring
+            # tail, manual snapshot trigger, bundle list/download
+            r("GET", "/api/v5/xla/flight", self._flight_status)
+            r("POST", "/api/v5/xla/flight/snapshot", self._flight_snapshot)
+            r("GET", "/api/v5/xla/flight/snapshots", self._flight_snapshots)
+            r(
+                "GET", "/api/v5/xla/flight/snapshots/{name}",
+                self._flight_snapshot_one,
+            )
         # kernel telemetry reads the router's always-on collector, so
         # it is live even without the obs bundle wired
         r("GET", "/api/v5/xla/telemetry", self._xla_telemetry)
@@ -560,6 +569,10 @@ class ManagementApi:
             from ..obs.monitor import Monitor
 
             self.monitor = Monitor(self.broker)
+            # flight snapshot bundles carry the monitor series tail
+            fl = getattr(self.obs, "flight", None)
+            if fl is not None and fl.monitor is None:
+                fl.monitor = self.monitor
         return self.monitor
 
     def _monitor_window(self, req: Request):
@@ -580,9 +593,14 @@ class ManagementApi:
 
     def _topic_metrics(self):
         if getattr(self, "topic_metrics", None) is None:
-            from ..obs.topic_metrics import TopicMetrics
+            # share the obs bundle's registry when wired, so the REST
+            # surface and the Prometheus scrape serve one instance
+            tm = getattr(self.obs, "topic_metrics", None)
+            if tm is None:
+                from ..obs.topic_metrics import TopicMetrics
 
-            self.topic_metrics = TopicMetrics(self.broker)
+                tm = TopicMetrics(self.broker)
+            self.topic_metrics = tm
         return self.topic_metrics
 
     def _topic_metrics_list(self, q):
@@ -1243,6 +1261,51 @@ class ManagementApi:
             body=self.obs.prometheus_text().encode(),
             content_type="text/plain; version=0.0.4",
         )
+
+    def _flight(self):
+        return getattr(self.obs, "flight", None)
+
+    def _flight_status(self, req: Request):
+        """Flight-recorder status + recent ring events (black-box
+        tail; ?limit= bounds the event count, default 100)."""
+        fl = self._flight()
+        if fl is None:
+            return {"enabled": False}
+        try:
+            limit = max(0, int(req.query.get("limit", "100")))
+        except ValueError:
+            return Response.error(400, "BAD_REQUEST", "bad limit")
+        out = fl.status()
+        out["events"] = fl.recorder.recent(limit)
+        return out
+
+    def _flight_snapshot(self, req: Request):
+        """Manual snapshot trigger: freeze the ring and persist a
+        bundle now (no cooldown — the operator asked)."""
+        fl = self._flight()
+        if fl is None:
+            return Response.error(404, "NOT_FOUND", "flight recorder not enabled")
+        body = req.json() or {}
+        path = fl.snapshot(
+            reason=str(body.get("reason", "manual")),
+            details={"requested_by": getattr(req, "principal", "?")},
+        )
+        return 201, {"path": path, "name": os.path.basename(path)}
+
+    def _flight_snapshots(self, req: Request):
+        fl = self._flight()
+        if fl is None:
+            return Response.error(404, "NOT_FOUND", "flight recorder not enabled")
+        return _paginate(fl.store.list(), req.query)
+
+    def _flight_snapshot_one(self, req: Request):
+        fl = self._flight()
+        if fl is None:
+            return Response.error(404, "NOT_FOUND", "flight recorder not enabled")
+        try:
+            return fl.store.read(req.params["name"])
+        except KeyError:
+            return Response.error(404, "NOT_FOUND", req.params["name"])
 
     def _xla_telemetry(self, req: Request):
         """Runtime view of the kernel-telemetry collector: dispatch

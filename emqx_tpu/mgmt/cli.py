@@ -30,6 +30,7 @@ class Ctl:
         gateways=None,
         listeners=None,
         license=None,
+        obs=None,
     ):
         self.broker = broker
         self.config = config
@@ -41,6 +42,7 @@ class Ctl:
         self.gateways = gateways
         self.listeners = listeners
         self.license = license
+        self.obs = obs
         self.started_at = time.time()
         self._cmds: Dict[str, Tuple[Callable, str]] = {}
         self._register_builtin()
@@ -109,6 +111,47 @@ class Ctl:
         reg("gateways", self._gateways, "gateways list")
         reg("listeners", self._listeners, "listeners               # active listeners")
         reg("license", self._license, "license info | update <key>")
+        reg(
+            "flight",
+            self._flight,
+            "flight status | events [n] | snapshot [reason] | snapshots",
+        )
+
+    def _flight(self, args) -> str:
+        """emqx ctl flight — black-box recorder status, ring tail,
+        manual snapshots, bundle listing (obs/flight_recorder)."""
+        fl = getattr(self.obs, "flight", None) if self.obs else None
+        if fl is None:
+            return "flight recorder not enabled"
+        sub = args[0] if args else "status"
+        if sub == "status":
+            st = fl.status()
+            return "\n".join(
+                f"{k:<22}: {v}"
+                for k, v in st.items()
+                if k not in ("rules", "events")
+            )
+        if sub == "events":
+            n = int(args[1]) if len(args) > 1 else 20
+            out = []
+            for e in fl.recorder.recent(n):
+                kv = ""
+                if e["attrs"]:
+                    kv = " " + " ".join(
+                        f"{k}={v}" for k, v in e["attrs"].items()
+                    )
+                tid = f" trace={e['trace_id']}" if e["trace_id"] else ""
+                out.append(f"{e['ts_ns']} [{e['kind']}]{tid}{kv}")
+            return "\n".join(out) or "(no events)"
+        if sub == "snapshot":
+            reason = args[1] if len(args) > 1 else "manual"
+            return f"ok: {fl.snapshot(reason=reason)}"
+        if sub == "snapshots":
+            rows = fl.store.list()
+            return "\n".join(
+                f"{r['name']}  {r['size']}B" for r in rows
+            ) or "(no snapshots)"
+        raise ValueError(f"bad subcommand {sub!r}")
 
     def _license(self, args) -> str:
         """emqx ctl license (emqx_license_cli.erl)."""

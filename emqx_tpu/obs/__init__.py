@@ -8,10 +8,18 @@
                  formatting (apps/emqx/src/emqx_trace);
   * prometheus — text exposition of metrics/stats
                  (apps/emqx_prometheus);
+  * topic_metrics — per-topic message counters
+                 (apps/emqx_modules/emqx_topic_metrics), registered
+                 here so the REST surface and the Prometheus scrape
+                 share one instance;
   * kernel_telemetry — device hot-path collector: dispatch-latency
                  histograms, recompile tracking, DeviceTable gauges,
                  exported as emqx_xla_* families (no reference analog:
-                 this is the TPU layer the reproduction adds).
+                 this is the TPU layer the reproduction adds);
+  * flight_recorder — anomaly-triggered black-box: always-on event
+                 ring over broker hooks + device legs + bridges +
+                 alarms, trigger rules, rotated snapshot bundles
+                 (the sys_mon/trace-download diagnostics analog).
 
 `Observability` bundles the per-broker pieces and installs the hook
 taps, the emqx_sup-analog wiring.
@@ -19,7 +27,16 @@ taps, the emqx_sup-analog wiring.
 
 from __future__ import annotations
 
+from typing import Optional
+
 from .alarm import AlarmError, Alarms  # noqa: F401
+from .flight_recorder import (  # noqa: F401
+    FlightControl,
+    FlightRecorder,
+    SnapshotStore,
+    TriggerRule,
+    default_rules,
+)
 from .kernel_telemetry import (  # noqa: F401
     NULL as NULL_TELEMETRY,
     KernelTelemetry,
@@ -29,6 +46,7 @@ from .kernel_telemetry import (  # noqa: F401
 from .prometheus import prometheus_text  # noqa: F401
 from .slow_subs import SlowSubs  # noqa: F401
 from .sys import SysHeartbeat  # noqa: F401
+from .topic_metrics import TopicMetrics  # noqa: F401
 from .trace import TraceManager  # noqa: F401
 
 
@@ -40,6 +58,9 @@ class Observability:
         trace_dir: str = "/tmp/emqx_tpu_trace",
         slow_threshold_ms: float = 500.0,
         slow_top_k: int = 10,
+        flight: bool = True,
+        flight_dir: Optional[str] = None,
+        config=None,
     ):
         self.broker = broker
         self.node_name = node_name
@@ -49,17 +70,33 @@ class Observability:
             threshold_ms=slow_threshold_ms, top_k=slow_top_k
         )
         self.traces = TraceManager(trace_dir)
+        # one TopicMetrics shared by REST + scrape (hooks install on
+        # first register, so an unused registry costs nothing)
+        self.topic_metrics = TopicMetrics(broker)
         self.slow_subs.install(broker.hooks)
         self.traces.install(broker.hooks)
+        self.flight: Optional[FlightControl] = None
+        if flight:
+            self.flight = FlightControl(
+                snapshot_dir=flight_dir or "/tmp/emqx_tpu_flight",
+                broker=broker,
+                slow_subs=self.slow_subs,
+                alarms=self.alarms,
+                config=config,
+                node_name=node_name,
+            )
+            self.flight.install()
 
     def prometheus_text(self) -> str:
-        return prometheus_text(self.broker, self.node_name)
+        return prometheus_text(self.broker, self.node_name, obs=self)
 
     def start(self, sys_interval: float = 30.0) -> None:
         self.sys.start(sys_interval)
 
     def stop(self) -> None:
         self.sys.stop()
+        if self.flight is not None:
+            self.flight.uninstall()
         self.traces.close()
         self.traces.uninstall()
         self.slow_subs.uninstall()

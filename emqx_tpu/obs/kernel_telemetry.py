@@ -160,6 +160,13 @@ class KernelTelemetry:
         # (None costs one attribute read per batch, same contract as
         # broker.tracer)
         self.tracer = tracer
+        # flight-recorder seam (obs/flight_recorder.FlightRecorder):
+        # when attached, every dispatch-leg sample also lands in the
+        # ring as an `xla.<leg>` event — the same stage names as the
+        # histograms/spans — so the black box can answer "what were
+        # the device legs doing right before the breach". None costs
+        # one attribute read per record.
+        self.flight = None
         self.retrace_warn_after = retrace_warn_after
         self.hist: Dict[str, StreamingHistogram] = {}
         self.counters: Dict[str, int] = {}
@@ -177,6 +184,9 @@ class KernelTelemetry:
 
     def record_dispatch(self, leg: str, seconds: float) -> None:
         self.histogram(leg).observe(seconds)
+        fr = self.flight
+        if fr is not None:
+            fr.record("xla." + leg, "", {"s": seconds})
 
     def record_samples(
         self, leg: str, values: Sequence[float]
@@ -228,6 +238,12 @@ class KernelTelemetry:
             return False
         seen.add(key)
         self.count("recompiles_total")
+        fr = self.flight
+        if fr is not None:
+            fr.record(
+                "xla.recompile", "",
+                {"kernel": kernel, "shape": str(key), "buckets": len(seen)},
+            )
         if len(seen) == self.retrace_warn_after:
             self.count("retrace_warnings_total")
             log.warning(
@@ -363,6 +379,7 @@ class NullKernelTelemetry:
 
     enabled = False
     tracer = None
+    flight = None
 
     @staticmethod
     def clock() -> float:

@@ -69,9 +69,15 @@ class Tracer:
 def trace_id_of(msg) -> str:
     """Message id -> 16-byte hex trace id (stable across nodes)."""
     h = getattr(msg, "id", "") or secrets.token_hex(8)
+    return trace_id_of_str(str(h))
+
+
+def trace_id_of_str(h: str) -> str:
+    """Raw message id -> trace id (the flight recorder stores ids on
+    its hot path and derives trace ids only at read/export time)."""
     import hashlib
 
-    return hashlib.md5(str(h).encode()).hexdigest()
+    return hashlib.md5(h.encode()).hexdigest()
 
 
 class OtelTracer(Tracer):
@@ -111,19 +117,32 @@ class OtelTracer(Tracer):
             self._task = None
 
     async def _flush_loop(self) -> None:
+        # the buffer DETACHES on the event loop (where finish() runs),
+        # so the executor only ever serializes a batch no writer holds;
+        # swapping inside the executor raced finish() appends against
+        # json serialization of the same list
         while True:
             try:
                 await asyncio.sleep(self.flush_interval)
+                batch = self._swap()
                 await asyncio.get_running_loop().run_in_executor(
-                    None, self.flush
+                    None, self._export, batch
                 )
             except asyncio.CancelledError:
                 return
             except Exception as e:  # noqa: BLE001
                 log.warning("otel export failed: %s", e)
 
+    def _swap(self) -> List[Span]:
+        batch = self._buf
+        self._buf = []
+        return batch
+
     def flush(self) -> int:
-        batch, self._buf = self._buf, []
+        """Synchronous swap+export (tests, shutdown drain)."""
+        return self._export(self._swap())
+
+    def _export(self, batch: List[Span]) -> int:
         if not batch:
             return 0
         body = json.dumps(self._otlp(batch)).encode()
@@ -131,8 +150,16 @@ class OtelTracer(Tracer):
             self.endpoint, data=body,
             headers={"content-type": "application/json"},
         )
-        with urllib.request.urlopen(req, timeout=self.timeout):
-            pass
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout):
+                pass
+        except Exception:
+            # a failed export IS a drop: the batch is already detached
+            # and will not be retried — count it so backpressure is
+            # visible on the scrape (emqx_otel_spans_dropped), then
+            # re-raise for the caller's logging
+            self.dropped += len(batch)
+            raise
         self.exported += len(batch)
         return len(batch)
 

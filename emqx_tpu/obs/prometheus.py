@@ -11,6 +11,18 @@ with `_bucket`/`_sum`/`_count` + `le` labels, recompile counters,
 DeviceTable gauges; see obs/kernel_telemetry.py) append to the same
 scrape when the broker's Router carries a live collector, so the
 device hot path and the broker surface share one exposition endpoint.
+
+When the Observability bundle is passed, the scrape also carries:
+
+  * `emqx_slow_subs_*` — tracked slow-subscription count + worst
+    delivery timespan (apps/emqx_slow_subs, previously API-only);
+  * `emqx_topic_messages_*` — per-registered-topic counters with a
+    `topic` label (emqx_topic_metrics, previously API-only);
+  * `emqx_otel_spans_exported`/`emqx_otel_spans_dropped` — exporter
+    throughput/backpressure when an OtelTracer is the broker tracer;
+  * `emqx_flight_*` + `emqx_hook_duration_seconds` — flight-recorder
+    ring/trigger counters and per-hookpoint latency histograms
+    (obs/flight_recorder.py).
 """
 
 from __future__ import annotations
@@ -22,7 +34,14 @@ def _norm(name: str) -> str:
     return "emqx_" + name.replace(".", "_").replace("-", "_")
 
 
-def prometheus_text(broker, node_name: str = "emqx@127.0.0.1") -> str:
+def _lab(value: str) -> str:
+    """Escape a label value per the exposition format."""
+    return (
+        value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+def prometheus_text(broker, node_name: str = "emqx@127.0.0.1", obs=None) -> str:
     lines: List[str] = []
     label = f'{{node="{node_name}"}}'
     seen = set()
@@ -55,4 +74,43 @@ def prometheus_text(broker, node_name: str = "emqx@127.0.0.1") -> str:
     tel = getattr(broker.router, "telemetry", None)
     if tel is not None and tel.enabled:
         lines.extend(tel.prometheus_lines(node_name))
+    # otel exporter throughput/backpressure (previously only process-
+    # internal attributes: a collector outage dropped spans invisibly)
+    tracer = getattr(broker, "tracer", None)
+    if tracer is not None and hasattr(tracer, "exported"):
+        emit("emqx_otel_spans_exported", "counter", tracer.exported)
+        emit("emqx_otel_spans_dropped", "counter", tracer.dropped)
+    if obs is not None:
+        _emit_obs(lines, obs, node_name)
     return "\n".join(lines) + "\n"
+
+
+def _emit_obs(lines: List[str], obs, node_name: str) -> None:
+    node = f'node="{node_name}"'
+    slow = getattr(obs, "slow_subs", None)
+    if slow is not None:
+        top = slow.topk()
+        lines.append("# TYPE emqx_slow_subs_tracked gauge")
+        lines.append(f"emqx_slow_subs_tracked{{{node}}} {len(top)}")
+        lines.append("# TYPE emqx_slow_subs_max_timespan_ms gauge")
+        worst = top[0]["timespan"] if top else 0.0
+        lines.append(
+            f"emqx_slow_subs_max_timespan_ms{{{node}}} {round(worst, 3)}"
+        )
+    tm = getattr(obs, "topic_metrics", None)
+    if tm is not None:
+        rows = tm.list()
+        if rows:
+            # one family per counter, one labeled sample per topic
+            counters = sorted(rows[0]["metrics"])
+            for counter in counters:
+                fam = "emqx_topic_" + counter.replace(".", "_") + "_total"
+                lines.append(f"# TYPE {fam} counter")
+                for row in rows:
+                    lines.append(
+                        f'{fam}{{{node},topic="{_lab(row["topic"])}"}} '
+                        f"{row['metrics'][counter]}"
+                    )
+    flight = getattr(obs, "flight", None)
+    if flight is not None:
+        lines.extend(flight.prometheus_lines(node_name))

@@ -48,10 +48,18 @@ class Trace:
 
 
 class TraceManager:
+    # expired traces are reaped at most this often from the event path
+    SWEEP_INTERVAL = 5.0
+
     def __init__(self, trace_dir: str = "/tmp/emqx_tpu_trace"):
         self.trace_dir = trace_dir
         self._traces: Dict[str, Trace] = {}
         self._files: Dict[str, object] = {}
+        # only RUNNING traces are consulted per event: stopped/expired
+        # records stay in _traces for list()/read_log but must not be
+        # filtered against on every publish
+        self._running: Dict[str, Trace] = {}
+        self._next_sweep = 0.0
 
     # --- lifecycle ------------------------------------------------------
 
@@ -100,6 +108,7 @@ class TraceManager:
             end_at=end_at, path=path,
         )
         self._traces[name] = t
+        self._running[name] = t
         self._files[name] = open(path, "a", encoding="utf-8")
         return t
 
@@ -107,6 +116,7 @@ class TraceManager:
         if name not in self._traces:
             raise KeyError(name)
         self._traces.pop(name)
+        self._running.pop(name, None)
         f = self._files.pop(name, None)
         if f is not None:
             f.close()
@@ -115,6 +125,10 @@ class TraceManager:
         if name not in self._traces:
             raise KeyError(name)
         self._traces[name].enabled = False
+        self._running.pop(name, None)
+        f = self._files.pop(name, None)
+        if f is not None:
+            f.close()
 
     def list(self) -> List[Dict]:
         self._reap_expired()
@@ -131,14 +145,29 @@ class TraceManager:
         ]
 
     def _reap_expired(self) -> None:
-        """Transition past-end_at traces to stopped and release their
-        file handles (the reference stops traces at end_at)."""
+        """Transition past-end_at traces to stopped, release their file
+        handles, and drop them from the per-event filter set (the
+        reference stops traces at end_at). Without this an expired
+        trace kept its file open and kept being matched against on
+        every publish until someone happened to call list()."""
         for t in self._traces.values():
             if t.enabled and t.expired():
                 t.enabled = False
+                self._running.pop(t.name, None)
                 f = self._files.pop(t.name, None)
                 if f is not None:
                     f.close()
+
+    def sweep(self, now: Optional[float] = None) -> None:
+        """Rate-limited expiry sweep, driven from the event path so
+        expiry needs no timer task; cost between sweeps is one float
+        compare per emitted event."""
+        if now is None:
+            now = time.time()
+        if now < self._next_sweep:
+            return
+        self._next_sweep = now + self.SWEEP_INTERVAL
+        self._reap_expired()
 
     def read_log(self, name: str) -> str:
         t = self._traces.get(name)
@@ -157,7 +186,10 @@ class TraceManager:
     # --- event taps -----------------------------------------------------
 
     def _emit(self, clientid: str, topic: Optional[str], ip: str, event: str, detail: Dict) -> None:
-        for t in self._traces.values():
+        if not self._running:
+            return
+        self.sweep()
+        for t in list(self._running.values()):
             if not t.matches(clientid, topic, ip):
                 continue
             f = self._files.get(t.name)

@@ -1,0 +1,375 @@
+"""Flight recorder (obs/flight_recorder): ring semantics, trigger
+rules with cooldown + snapshot rotation, hook-duration timing through
+the broker, bridge-pump taps, the REST/ctl surfaces, and the one-
+publish correlation chain (otel span == ring event == hook sample
+trace id) — ISSUE 2 acceptance coverage."""
+
+import asyncio
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from emqx_tpu.bridges.resource import BufferWorker, Connector, RecoverableError
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.packet import SubOpts
+from emqx_tpu.broker.pubsub import Broker
+from emqx_tpu.obs import Observability
+from emqx_tpu.obs.flight_recorder import (
+    UNTIMED_HOOKPOINTS,
+    FlightRecorder,
+    emit,
+)
+
+
+def make(tmp_path, **kw):
+    b = Broker()
+    obs = Observability(
+        b,
+        node_name="n1@host",
+        trace_dir=str(tmp_path / "trace"),
+        flight_dir=str(tmp_path / "flight"),
+        **kw,
+    )
+    return b, obs
+
+
+# --- ring -----------------------------------------------------------------
+
+
+def test_ring_wraps_and_keeps_order():
+    r = FlightRecorder(capacity=4)
+    for i in range(6):
+        r.record("k", "", {"i": i})
+    ev = r.recent()
+    assert [e["attrs"]["i"] for e in ev] == [2, 3, 4, 5]
+    assert r.events_total == 6
+    # limit returns the NEWEST tail
+    assert [e["attrs"]["i"] for e in r.recent(2)] == [4, 5]
+
+
+def test_ring_freeze_drops_are_counted():
+    r = FlightRecorder(capacity=4)
+    r.record("a")
+    r.freeze()
+    r.record("b")
+    r.unfreeze()
+    r.record("c")
+    assert [e["kind"] for e in r.recent()] == ["a", "c"]
+    assert r.dropped_while_frozen == 1
+
+
+# --- triggers + bundles (the acceptance scenario) -------------------------
+
+
+def test_p99_breach_persists_full_bundle(tmp_path):
+    b, obs = make(tmp_path)
+    fl = obs.flight
+    try:
+        # real device state so the collector dump is non-trivial
+        b.router.add_routes([(f"t{i}/+/x/#", f"d{i}") for i in range(8)])
+        b.router.match_filters_batch(["t0/a/x/y"])
+        # synthetic breach: hash-leg samples far above the 5ms default
+        tel = b.router.telemetry
+        for _ in range(10):
+            tel.record_dispatch("hash", 0.020)
+        paths = fl.evaluate()
+        assert len(paths) == 1 and "dispatch_p99" in paths[0]
+        with open(paths[0]) as f:
+            bundle = json.load(f)
+        assert bundle["reason"] == "dispatch_p99"
+        assert bundle["details"]["p99_ms"] >= 20.0
+        # ring events made it into the bundle, device legs included
+        kinds = {e["kind"] for e in bundle["events"]}
+        assert "xla.hash" in kinds
+        # kernel-telemetry dump rides along...
+        assert bundle["kernel_telemetry"]["dispatch"]["hash"]["count"] >= 10
+        # ...and the config/topology fingerprint
+        fp = bundle["fingerprint"]
+        assert fp["node"] == "n1@host"
+        assert fp["router"]["table_rows"] == 8
+        assert fl.triggers_total["dispatch_p99"] == 1
+    finally:
+        obs.stop()
+
+
+def test_trigger_cooldown_stops_snapshot_spam(tmp_path):
+    b, obs = make(tmp_path)
+    fl = obs.flight
+    try:
+        tel = b.router.telemetry
+        for _ in range(10):
+            tel.record_dispatch("hash", 0.050)
+        assert fl.evaluate()  # fires
+        for _ in range(10):
+            tel.record_dispatch("hash", 0.050)
+        assert fl.evaluate() == []  # still breaching, but cooling down
+        assert fl.triggers_total["dispatch_p99"] == 1
+        assert fl.snapshots_total == 1
+    finally:
+        obs.stop()
+
+
+def test_snapshot_dir_rotation_bounded_under_storm(tmp_path):
+    b, obs = make(tmp_path)
+    fl = obs.flight
+    fl.store.max_snapshots = 3
+    try:
+        for i in range(12):
+            fl.snapshot(reason=f"storm{i}")
+        files = [
+            f for f in os.listdir(fl.store.directory)
+            if f.startswith("flight-")
+        ]
+        assert len(files) == 3
+        # the survivors are the NEWEST three
+        names = sorted(files)
+        assert all(
+            json.load(open(os.path.join(fl.store.directory, n)))["reason"]
+            in ("storm9", "storm10", "storm11")
+            for n in names
+        )
+    finally:
+        obs.stop()
+
+
+def test_recompile_storm_rule_sees_delta(tmp_path):
+    b, obs = make(tmp_path)
+    fl = obs.flight
+    try:
+        tel = b.router.telemetry
+        fl.evaluate()  # seed the delta base
+        for i in range(10):
+            tel.record_shape("k", (i,))
+        paths = fl.evaluate()
+        assert any("recompile_storm" in p for p in paths)
+    finally:
+        obs.stop()
+
+
+def test_alarm_activation_triggers_immediately(tmp_path):
+    b, obs = make(tmp_path)
+    try:
+        obs.alarms.activate("hbm_high", {"bytes": 1}, "HBM high")
+        assert obs.flight.triggers_total.get("alarm") == 1
+        ev = obs.flight.recorder.recent()
+        assert any(e["kind"] == "alarm.activate" for e in ev)
+        rows = obs.flight.store.list()
+        assert any("alarm" in r["name"] for r in rows)
+        with open(
+            os.path.join(obs.flight.store.directory, rows[0]["name"])
+        ) as f:
+            bundle = json.load(f)
+        assert bundle["alarms"][0]["name"] == "hbm_high"
+    finally:
+        obs.stop()
+
+
+# --- bridge taps ----------------------------------------------------------
+
+
+class _Flaky(Connector):
+    def __init__(self, fail_times):
+        self.fail_times = fail_times
+        self.calls = 0
+
+    async def on_query(self, request):
+        self.calls += 1
+        if self.calls <= self.fail_times:
+            raise RecoverableError("transient")
+
+
+async def test_bridge_retries_land_in_ring_and_burst_rule_fires(tmp_path):
+    b, obs = make(tmp_path)
+    fl = obs.flight
+    try:
+        w = BufferWorker(_Flaky(3), retry_interval=0.001)
+        w.start()
+        w.submit("x")
+        await w.drain(timeout=5)
+        await w.stop()
+        kinds = [e["kind"] for e in fl.recorder.recent()]
+        assert kinds.count("bridge.retry") == 3
+        ev = [e for e in fl.recorder.recent() if e["kind"] == "bridge.retry"]
+        assert ev[0]["attrs"]["connector"] == "_Flaky"
+        # pile up a fallback burst through the module seam -> rule fires
+        for _ in range(10):
+            emit("bridge.retry", attrs={"connector": "T"})
+        paths = fl.evaluate()
+        assert any("bridge_fallback_burst" in p for p in paths)
+    finally:
+        obs.stop()
+        # the seam is cleared with the bundle: emits become no-ops
+        before = fl.recorder.events_total
+        emit("bridge.retry")
+        assert fl.recorder.events_total == before
+
+
+# --- hook timing + correlation chain --------------------------------------
+
+
+def test_hook_durations_timed_and_delivery_points_excluded(tmp_path):
+    b, obs = make(tmp_path)
+    try:
+        s, _ = b.open_session("c1", True)
+        s.outgoing_sink = lambda pkts: None
+        b.subscribe(s, "t/#", SubOpts(qos=0))
+        b.publish(Message(topic="t/1", payload=b"x"))
+        fl = obs.flight
+        assert fl.hook_hist["message.publish"].total == 1
+        assert fl.hook_hist["session.subscribed"].total == 1
+        # per-delivery hookpoints are untimed by design (<2% budget)
+        assert UNTIMED_HOOKPOINTS & set(fl.hook_hist) == set()
+        text = obs.prometheus_text()
+        assert (
+            'emqx_hook_duration_seconds_count{node="n1@host",'
+            'hook="message.publish"} 1'
+        ) in text
+        assert 'emqx_flight_events_total{node="n1@host"}' in text
+    finally:
+        obs.stop()
+
+
+def test_one_publish_correlates_span_ring_event_and_hook_sample(tmp_path):
+    from emqx_tpu.obs.otel import MemoryTracer, trace_id_of
+
+    b, obs = make(tmp_path)
+    try:
+        tr = MemoryTracer()
+        b.tracer = tr
+        s, _ = b.open_session("c1", True)
+        s.outgoing_sink = lambda pkts: None
+        b.subscribe(s, "t/#", SubOpts(qos=0))
+        msg = Message(topic="t/1", payload=b"x", from_client="pub")
+        assert b.publish(msg) == 1
+        tid = trace_id_of(msg)
+        # otel: the span tree carries the message's trace id
+        root = next(sp for sp in tr.spans if sp.name == "mqtt.publish")
+        assert root.trace_id == tid
+        # flight ring: the message.publish hook event shares it
+        hook_ev = [
+            e
+            for e in obs.flight.recorder.recent()
+            if e["kind"] == "hook" and e["attrs"]["hook"] == "message.publish"
+        ]
+        assert hook_ev and hook_ev[-1]["trace_id"] == tid
+        # hook-duration histogram saw the same run
+        assert obs.flight.hook_hist["message.publish"].total >= 1
+    finally:
+        obs.stop()
+
+
+def test_uninstall_restores_untimed_hooks(tmp_path):
+    b, obs = make(tmp_path)
+    assert b.hooks.observers  # installed
+    obs.stop()
+    assert not b.hooks.observers
+    tel = b.router.telemetry
+    assert tel.flight is None
+
+
+# --- overhead guard -------------------------------------------------------
+
+
+def test_flight_enabled_publish_overhead_bounded(tmp_path):
+    # the <2% budget is asserted properly in bench_flight_overhead;
+    # here just guard against gross regressions (enabled path within
+    # 1.5x of disabled on a fanout-dominated publish)
+    def build(flight, tag):
+        b = Broker()
+        obs = Observability(
+            b,
+            trace_dir=str(tmp_path / f"t{tag}"),
+            flight_dir=str(tmp_path / f"f{tag}"),
+            flight=flight,
+        )
+        for i in range(128):
+            s, _ = b.open_session(f"c{tag}{i}", True)
+            s.outgoing_sink = lambda pkts: None
+            b.subscribe(s, "ov/#", SubOpts(qos=0))
+        return b, obs
+
+    b_on, obs_on = build(True, "on")
+    b_off, obs_off = build(False, "off")
+    for b in (b_on, b_off):
+        b.publish(Message(topic="ov/warm", payload=b"x"))
+
+    def med(b):
+        ts = []
+        for i in range(15):
+            t0 = time.perf_counter()
+            for j in range(16):
+                b.publish(Message(topic=f"ov/{i}/{j}", payload=b"x"))
+            ts.append(time.perf_counter() - t0)
+        return float(np.median(ts))
+
+    try:
+        assert med(b_on) < 1.5 * med(b_off)
+    finally:
+        obs_on.stop()
+        obs_off.stop()
+
+
+# --- REST + ctl surfaces --------------------------------------------------
+
+
+async def test_flight_rest_api(tmp_path):
+    from emqx_tpu.mgmt import ManagementApi
+
+    from test_mgmt import Api, http_req
+
+    b, obs = make(tmp_path)
+    mgmt = ManagementApi(b, obs=obs, node_name="n1@host")
+    _, port = await mgmt.start()
+    _, login = await http_req(
+        port, "POST", "/api/v5/login",
+        {"username": "admin", "password": "public"},
+    )
+    api = Api(port, token=login["token"])
+    try:
+        st, body = await api("GET", "/api/v5/xla/flight")
+        assert st == 200 and body["enabled"] is True
+        assert body["capacity"] == obs.flight.recorder.capacity
+        st, body = await api(
+            "POST", "/api/v5/xla/flight/snapshot", {"reason": "ops"}
+        )
+        assert st == 201
+        name = body["name"]
+        st, lst = await api("GET", "/api/v5/xla/flight/snapshots")
+        assert st == 200 and any(r["name"] == name for r in lst["data"])
+        st, bundle = await api(
+            "GET", f"/api/v5/xla/flight/snapshots/{name}"
+        )
+        assert st == 200 and bundle["reason"] == "ops"
+        # the snapshot POST itself is audited + visible in status
+        st, body = await api("GET", "/api/v5/xla/flight?limit=5")
+        assert st == 200 and body["snapshots_total"] == 1
+        st, _ = await api(
+            "GET", "/api/v5/xla/flight/snapshots/../../etc/passwd"
+        )
+        assert st == 404
+    finally:
+        await mgmt.stop()
+        obs.stop()
+
+
+def test_ctl_flight_command(tmp_path):
+    from emqx_tpu.mgmt.cli import Ctl
+
+    b, obs = make(tmp_path)
+    try:
+        ctl = Ctl(b, obs=obs)
+        out = ctl.run(["flight", "status"])
+        assert "enabled" in out and "snapshot_dir" in out
+        out = ctl.run(["flight", "snapshot", "ops"])
+        assert "ok: " in out and "flight-" in out
+        out = ctl.run(["flight", "snapshots"])
+        assert "flight-" in out
+        out = ctl.run(["flight", "events", "5"])
+        assert "flight.snapshot" in out
+        # no obs wired -> graceful message
+        assert Ctl(b).run(["flight"]) == "flight recorder not enabled"
+    finally:
+        obs.stop()

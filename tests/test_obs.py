@@ -117,6 +117,34 @@ def test_trace_clientid_and_topic(tmp_path):
     obs.stop()
 
 
+def test_trace_expiry_sweep_closes_files_and_stops_filtering(tmp_path):
+    # regression: an expired trace used to keep its file handle open
+    # and keep being matched against on every event until list() was
+    # called; the event-path sweep now reaps it
+    broker = Broker()
+    obs = Observability(broker, trace_dir=str(tmp_path))
+    tm = obs.traces
+    tm.create("tr1", "clientid", "devX", end_at=time.time() + 0.05)
+    broker.publish(Message(topic="a/b", payload=b"x", from_client="devX"))
+    assert "tr1" in tm._files and "tr1" in tm._running
+    time.sleep(0.06)
+    tm._next_sweep = 0.0  # bypass the rate limiter, not the expiry
+    broker.publish(Message(topic="a/b", payload=b"y", from_client="devX"))
+    # handle closed, no longer consulted per event
+    assert "tr1" not in tm._files
+    assert "tr1" not in tm._running
+    assert {t["name"]: t["status"] for t in tm.list()} == {"tr1": "stopped"}
+    # the post-expiry event was not written
+    log = tm.read_log("tr1")
+    assert log.count("PUBLISH") == 1
+    # stop_trace also releases the handle immediately
+    tm.create("tr2", "clientid", "devY")
+    assert "tr2" in tm._files
+    tm.stop_trace("tr2")
+    assert "tr2" not in tm._files and "tr2" not in tm._running
+    obs.stop()
+
+
 def test_trace_name_validation_and_missing(tmp_path):
     broker = Broker()
     obs = Observability(broker, trace_dir=str(tmp_path))

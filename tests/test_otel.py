@@ -63,6 +63,48 @@ def test_tracer_none_path_untouched():
     assert len(got) == 1
 
 
+def test_flush_detaches_buffer_before_export(monkeypatch):
+    # the flush loop swaps the buffer ON the event loop and exports the
+    # detached batch off it; a span finished mid-export must land in
+    # the fresh buffer, never in the batch being serialized
+    tr = OtelTracer()
+    exported = {}
+
+    def fake_export(batch):
+        exported["batch"] = list(batch)
+        tr.finish(Span("late", "00" * 16))  # concurrent finish
+        return len(batch)
+
+    from emqx_tpu.obs.otel import Span
+
+    for i in range(3):
+        tr.finish(Span(f"s{i}", "11" * 16))
+    monkeypatch.setattr(tr, "_export", fake_export)
+    assert tr.flush() == 3
+    assert [s.name for s in exported["batch"]] == ["s0", "s1", "s2"]
+    assert [s.name for s in tr._buf] == ["late"]
+
+
+def test_export_failure_counts_dropped_and_scrapes():
+    from emqx_tpu.obs.prometheus import prometheus_text
+
+    b = Broker()
+    # nothing listens here: the export must fail, and the detached
+    # batch counts as dropped (visible on the scrape, not just lost)
+    tr = OtelTracer(endpoint="http://127.0.0.1:1/v1/traces", timeout=0.2)
+    b.tracer = tr
+    s, _ = b.open_session("c1", True)
+    s.outgoing_sink = lambda pkts: None
+    b.subscribe(s, "t/#", SubOpts(qos=0))
+    b.publish(Message(topic="t/1", payload=b"x"))
+    with pytest.raises(Exception):
+        tr.flush()
+    assert tr.dropped == 3 and tr.exported == 0
+    text = prometheus_text(b, "n1@host")
+    assert 'emqx_otel_spans_dropped{node="n1@host"} 3' in text
+    assert 'emqx_otel_spans_exported{node="n1@host"} 0' in text
+
+
 @pytest.mark.asyncio
 async def test_otlp_export_shape():
     received = []

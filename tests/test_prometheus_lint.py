@@ -40,8 +40,7 @@ def _family_of(sample_name: str, histograms) -> str:
     return sample_name
 
 
-def test_exposition_lint():
-    text = prometheus_text(_scraped_broker(), "n1@host")
+def _lint(text):
     assert text.endswith("\n")
     types = {}  # family -> kind
     samples_seen_for = set()
@@ -65,6 +64,11 @@ def test_exposition_lint():
         samples_seen_for.add(fam)
     # every declared family produced at least one sample
     assert set(types) == samples_seen_for
+    return types
+
+
+def test_exposition_lint():
+    _lint(prometheus_text(_scraped_broker(), "n1@host"))
 
 
 def test_histogram_families_well_formed():
@@ -113,6 +117,65 @@ def test_max_watermark_gauges_emitted():
     text = prometheus_text(_scraped_broker(), "n1@host")
     assert "# TYPE emqx_sessions_count_max gauge" in text
     assert 'emqx_sessions_count_max{node="n1@host"}' in text
+
+
+def test_obs_families_lint(tmp_path):
+    # the ISSUE-2 families — hook durations, flight counters, otel
+    # exporter counters, slow-subs gauges, per-topic counters — must
+    # pass the same exposition lint and all land on ONE scrape
+    from emqx_tpu.obs import Observability
+    from emqx_tpu.obs.otel import OtelTracer
+
+    broker = Broker()
+    obs = Observability(
+        broker,
+        node_name="n1@host",
+        trace_dir=str(tmp_path / "t"),
+        flight_dir=str(tmp_path / "f"),
+    )
+    try:
+        broker.tracer = OtelTracer()
+        s, _ = broker.open_session("c1", clean_start=True)
+        s.outgoing_sink = lambda pkts: None
+        broker.subscribe(s, "t/#", SubOpts(qos=0))
+        obs.topic_metrics.register("t/1")
+        broker.publish(Message(topic="t/1", payload=b"x"))
+        obs.slow_subs.track("c9", "t/slow", 900.0)
+        broker.router.add_routes([(f"k{i}/+/v/#", f"d{i}") for i in range(16)])
+        broker.router.match_filters_batch([f"k{i}/a/v/w" for i in range(8)])
+        obs.flight.snapshot("lint")
+        text = obs.prometheus_text()
+        types = _lint(text)
+        for fam, kind in (
+            ("emqx_hook_duration_seconds", "histogram"),
+            ("emqx_flight_events_total", "counter"),
+            ("emqx_flight_snapshots_total", "counter"),
+            ("emqx_flight_frozen", "gauge"),
+            ("emqx_otel_spans_exported", "counter"),
+            ("emqx_otel_spans_dropped", "counter"),
+            ("emqx_slow_subs_tracked", "gauge"),
+            ("emqx_slow_subs_max_timespan_ms", "gauge"),
+            ("emqx_topic_messages_in_total", "counter"),
+            ("emqx_topic_messages_out_total", "counter"),
+        ):
+            assert types.get(fam) == kind, f"{fam}: {types.get(fam)}"
+        # labeled samples carry the right values
+        assert 'emqx_topic_messages_in_total{node="n1@host",topic="t/1"} 1' in text
+        assert 'emqx_slow_subs_tracked{node="n1@host"} 1' in text
+        assert 'emqx_flight_snapshots_total{node="n1@host"} 1' in text
+        # hook histogram is cumulative with a terminal +Inf (same
+        # structural contract as the xla dispatch family)
+        hook_counts = [
+            int(l.rsplit(" ", 1)[1])
+            for l in text.splitlines()
+            if l.startswith(
+                'emqx_hook_duration_seconds_bucket{node="n1@host",'
+                'hook="message.publish"'
+            )
+        ]
+        assert hook_counts and hook_counts == sorted(hook_counts)
+    finally:
+        obs.stop()
 
 
 def test_null_telemetry_scrape_stays_clean():
