@@ -39,6 +39,7 @@ import json
 import os
 import sys
 import time
+from contextlib import contextmanager
 
 import numpy as np
 
@@ -59,6 +60,24 @@ def log(msg):
 
 def pctl(xs, p):
     return float(np.percentile(np.asarray(xs, float), p))
+
+
+@contextmanager
+def gc_off():
+    """GC-off timed-window hygiene (PERF_NOTES round 5): a gen-2 pass
+    over a ~500k-object broker graph landing inside one timed window
+    cost a measured 2x swing, so every timed region collects first and
+    keeps the collector off until it closes. Shared by the insert,
+    pipeline, and cache-hot-path legs so the hygiene cannot drift
+    between them."""
+    import gc
+
+    gc.collect()
+    gc.disable()
+    try:
+        yield
+    finally:
+        gc.enable()
 
 
 # --------------------------------------------------------------------------
@@ -983,8 +1002,6 @@ def bench_insert(details):
     baseline is the same one-by-one insert the reference's
     emqx_broker_bench.erl:64-66 times, against the C++ skip-scan index
     (per-row ts_add; the comparison the VERDICT asked for)."""
-    import gc
-
     from emqx_tpu.models.router import Router
     from emqx_tpu.ops import native_baseline as nb
 
@@ -992,17 +1009,11 @@ def bench_insert(details):
     NI = 50_000 // SHRINK
     CH = 1000  # the reference syncer's max batch
     pairs = [(f"ins/{i % 317}/d{i}/+/#", f"node{i % 7}") for i in range(NI)]
-    # standard micro-bench hygiene, applied identically to the python
-    # and native legs: a gen-2 GC pass over the router's ~500k-object
-    # graph lands inside the timed window on ~1 of 3 runs (measured:
-    # a 2x insert_rps swing), so collect first and keep the collector
-    # off for the timed region
-    gc.collect()
-    gc.disable()
-    try:
+    # the shared gc_off hygiene applies identically to the python and
+    # native legs (the gen-2 pass that motivated it lands inside the
+    # timed window on ~1 of 3 runs otherwise)
+    with gc_off():
         _bench_insert_timed(details, r, pairs, NI, CH, nb)
-    finally:
-        gc.enable()
 
 
 def _bench_insert_timed(details, r, pairs, NI, CH, nb):
@@ -1256,6 +1267,183 @@ def bench_fanout(details):
 
 
 # --------------------------------------------------------------------------
+# pipelined dispatch engine — e2e publish throughput (incl. transfer)
+# vs the synchronous single-dispatch path, plus the match-cache hot
+# path vs the kernel path
+
+
+def bench_pipeline(details):
+    """End-to-end publish throughput on the SAME broker/link, three
+    legs:
+
+      * sync      — one device dispatch per publish (encode → kernel →
+                    device-to-host pairs → fanout, serialized): the
+                    pre-engine hot path.
+      * pipelined — concurrent publishers through the micro-batching
+                    DispatchEngine (no match cache, so the win is pure
+                    coalescing + pipelining).
+      * cache     — the generation-stamped hot-topic path vs the same
+                    batch through the kernel.
+
+    Rates use the p25 bracketed estimator over per-round timings
+    (PERF_NOTES r5: link noise is additive on a deterministic
+    pipeline), timed windows run under the shared gc_off hygiene, and
+    the engine's results are asserted bit-identical to the synchronous
+    path (counts + oracle rows) before any number is recorded."""
+    import asyncio
+
+    from emqx_tpu.broker.message import Message
+    from emqx_tpu.broker.packet import SubOpts
+    from emqx_tpu.broker.pubsub import Broker
+    from emqx_tpu.ops.match import oracle_match_rows
+
+    NSUB = max(64, 512 // SHRINK)
+    B = 256  # messages per round
+    ROUNDS = 8
+
+    def build():
+        b = Broker(max_levels=8)
+        for i in range(NSUB):
+            s, _ = b.open_session(f"pl{i}", True)
+            s.outgoing_sink = lambda pkts: None
+            b.subscribe(s, f"pl/{i}/+/#", SubOpts(qos=0))
+        return b
+
+    b = build()
+
+    # --- exactness: pipelined results == synchronous results ------------
+    topics = [f"pl/{j % NSUB}/ex/m{j}" for j in range(B)]
+    sync_counts = b.publish_batch(
+        [Message(topic=t, payload=b"x") for t in topics]
+    )
+
+    async def _exactness():
+        eng = b.enable_dispatch_engine(
+            queue_depth=64, deadline_ms=0.5, match_cache_size=0
+        )
+        counts = await asyncio.gather(
+            *[eng.publish(Message(topic=t, payload=b"x")) for t in topics]
+        )
+        await eng.stop()
+        return counts
+
+    pipe_counts = asyncio.run(_exactness())
+    assert pipe_counts == sync_counts, "pipelined exactness FAILED"
+    log(f"pipeline exactness vs sync path: ok ({sum(sync_counts)} deliveries)")
+
+    # --- sync single-dispatch leg ----------------------------------------
+    def sync_round(r_):
+        msgs = [
+            Message(topic=f"pl/{j % NSUB}/s{r_}/m{j}", payload=b"x")
+            for j in range(B)
+        ]
+        t0 = time.time()
+        for m in msgs:
+            b.publish_batch([m])  # one kernel dispatch per publish
+        return (time.time() - t0) / B
+
+    sync_round(-1)  # warm: compile the batch=1 shape
+    with gc_off():
+        sync_per_topic = [sync_round(r_) for r_ in range(ROUNDS)]
+    sync_rate = 1.0 / pctl(sync_per_topic, 25)
+
+    # --- pipelined engine leg (cache off: coalescing alone) --------------
+    async def pipe_run():
+        eng = b.enable_dispatch_engine(
+            queue_depth=64, deadline_ms=0.5, match_cache_size=0
+        )
+
+        async def one_round(r_):
+            msgs = [
+                Message(topic=f"pl/{j % NSUB}/p{r_}/m{j}", payload=b"x")
+                for j in range(B)
+            ]
+            t0 = time.time()
+            await asyncio.gather(*[eng.publish(m) for m in msgs])
+            return (time.time() - t0) / B
+
+        await one_round(-1)  # warm: compile the coalesced batch shapes
+        with gc_off():
+            per_topic = [await one_round(r_) for r_ in range(ROUNDS)]
+        coalesce = (
+            eng.publishes_total / eng.batches_total
+            if eng.batches_total else 0.0
+        )
+        await eng.stop()
+        return per_topic, coalesce
+
+    pipe_per_topic, coalesce = asyncio.run(pipe_run())
+    pipe_rate = 1.0 / pctl(pipe_per_topic, 25)
+    speedup = pipe_rate / sync_rate
+    log(f"pipeline e2e: sync {sync_rate:,.0f} topics/s vs pipelined "
+        f"{pipe_rate:,.0f} topics/s @p25 -> {speedup:.1f}x "
+        f"(coalesce factor {coalesce:.1f})")
+
+    # --- cache hot path vs kernel path -----------------------------------
+    r = b.router
+    cache = r.enable_match_cache(8192)
+    hot = [f"pl/{j % NSUB}/hot/t{j % 32}" for j in range(B)]
+    r.match_filters_batch(hot)  # kernel fill + cache populate
+    # oracle exactness on the cached path, then again after churn so
+    # the bench itself proves generation invalidation, not just tests
+    oracle = oracle_match_rows(r.table, hot)
+    fr_map = {f: i for i, f in enumerate(r._filter_row) if f is not None}
+    for flts, orc in zip(r.match_filters_batch(hot), oracle):
+        assert sorted(fr_map[f] for f in flts) == sorted(orc.tolist()), (
+            "cached-path oracle exactness FAILED"
+        )
+    b.subscribe(b.sessions["pl0"], "pl/churn/+/#", SubOpts(qos=0))
+    oracle2 = oracle_match_rows(r.table, hot)
+    for flts, orc in zip(r.match_filters_batch(hot), oracle2):
+        assert sorted(fr_map[f] for f in flts) == sorted(orc.tolist()), (
+            "post-churn cached-path oracle exactness FAILED"
+        )
+    log("cache-path oracle exactness (pre/post churn): ok")
+
+    b_nc = build()  # identical table, no cache: the kernel comparand
+    b_nc.router.match_filters_batch(hot)  # compile warm
+    with gc_off():
+        kern = []
+        for r_ in range(ROUNDS):
+            fresh = [f"pl/{j % NSUB}/k{r_}/t{j % 32}" for j in range(B)]
+            t0 = time.time()
+            b_nc.router.match_filters_batch(fresh)
+            kern.append((time.time() - t0) / B)
+        r.match_filters_batch(hot)  # ensure the hot set is resident
+        hit = []
+        for _ in range(ROUNDS):
+            t0 = time.time()
+            r.match_filters_batch(hot)
+            hit.append((time.time() - t0) / B)
+    kern_rate = 1.0 / pctl(kern, 25)
+    hit_rate = 1.0 / pctl(hit, 25)
+    cache_speedup = hit_rate / kern_rate
+    log(f"match cache: kernel {kern_rate:,.0f} topics/s vs cached "
+        f"{hit_rate:,.0f} topics/s @p25 -> {cache_speedup:.1f}x "
+        f"(hit ratio {cache.hit_ratio():.3f})")
+
+    details["pipeline_e2e"] = {
+        "sync_topics_per_sec": round(sync_rate, 1),
+        "pipelined_topics_per_sec": round(pipe_rate, 1),
+        "speedup": round(speedup, 2),
+        "coalesce_factor": round(coalesce, 2),
+        "queue_depth": 64,
+        "deadline_ms": 0.5,
+        "subs": NSUB,
+        "rate_estimator": "p25 of bracketed per-round timings (additive noise)",
+        "exactness_check": "ok",
+    }
+    details["match_cache_hot_path"] = {
+        "kernel_topics_per_sec": round(kern_rate, 1),
+        "cached_topics_per_sec": round(hit_rate, 1),
+        "speedup": round(cache_speedup, 2),
+        "cache_entries": len(cache),
+        "cache_hit_ratio": round(cache.hit_ratio(), 6),
+        "oracle_exactness": "ok (pre/post churn)",
+    }
+
+
+# --------------------------------------------------------------------------
 
 
 def main():
@@ -1310,6 +1498,8 @@ def main():
     stage_done("flight_overhead")
     bench_fanout(details)
     stage_done("fanout")
+    bench_pipeline(details)
+    stage_done("pipeline")
     del table, index, meta, slots
     bench_10m(jax, jnp, floor, details)
     stage_done("config3_10M")

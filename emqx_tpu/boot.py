@@ -118,6 +118,17 @@ class Node:
                 os.path.join(data_dir, "retained"),
                 max_retained=cfg.get("retainer.max_retained_messages") or 1_000_000,
             )
+        # publish hot path: the generation-stamped fanout-plan cap and
+        # the pipelined micro-batching dispatch engine + match cache
+        # (broker/dispatch_engine.py), gated on the TPU offload knob
+        broker._fanout_cap = cfg.get("broker.perf.tpu_fanout_cache_size")
+        if cfg.get("broker.perf.tpu_match_enable"):
+            broker.enable_dispatch_engine(
+                queue_depth=cfg.get("broker.perf.tpu_dispatch_queue_depth"),
+                deadline_ms=cfg.get("broker.perf.tpu_dispatch_deadline_ms"),
+                pipeline_depth=cfg.get("broker.perf.tpu_pipeline_depth"),
+                match_cache_size=cfg.get("broker.perf.tpu_match_cache_size"),
+            )
         self.broker = broker
 
         # 2. auth pipeline — chains/sources materialize from config

@@ -1,0 +1,224 @@
+"""Pipelined async dispatch engine for the publish hot path.
+
+BENCH_r05 put the chip-resident match kernel at ~0.09-0.39 ms/batch
+while end-to-end publish sat at 250 ms p50: the synchronous
+encode → dispatch → device-to-host walk pays the full link round trip
+per publish, so the kernel win evaporates before it reaches a socket.
+This module is the host-side dispatch discipline that closes that gap,
+the emqx_broker pool-worker batching analog re-shaped for an
+accelerator link:
+
+  * **Micro-batching queue** — concurrent publishes coalesce into one
+    kernel dispatch. The batch closes adaptively: flush when
+    `queue_depth` topics are waiting OR when the oldest enqueued
+    publish has waited `deadline_ms` (sub-millisecond by default),
+    whichever comes first — bounded added latency, unbounded
+    coalescing win under load.
+
+  * **Pipelining** — a flush only LAUNCHES the batch
+    (Router.match_filters_begin: cache probe, encode, host-to-device
+    transfer, kernel dispatch); the device-to-host fetch + fanout
+    (match_filters_finish) happens on a later event-loop turn, or when
+    the in-flight window exceeds `pipeline_depth`. JAX dispatch is
+    asynchronous and the device tables update in place through donated
+    buffers, so while batch N executes on the device the host encodes
+    and uploads batch N+1 and drains the result pairs of batch N-1 —
+    the classic double-buffer, for both DeviceTable and
+    ShardedDeviceTable (both sit behind the same begin/finish seam).
+
+  * **Generation-stamped match cache** — in front of the queue,
+    Router's GenMatchCache (ops/match.py) resolves hot topics with one
+    dict probe and no kernel at all; route mutations bump the router
+    generation and stale entries lazily rebuild, so churn never does
+    an O(n) clear.
+
+Exactness contract: every result is produced by the same
+begin/finish code path the synchronous `Broker.publish_batch` →
+`Router.match_filters_batch` composes, and delivery runs through the
+same `Broker._pre_publish`/`Broker._dispatch` — pipelined + cached
+results are bit-identical to the synchronous path (oracle-checked in
+tests/test_dispatch_engine.py and bench.py's pipeline exactness
+stage).
+
+Telemetry (obs/kernel_telemetry, scraped as `emqx_xla_*`): queue-wait
+histogram family `pipeline_queue_wait_seconds`, gauges
+`pipeline_depth` / `pipeline_coalesce`, and the cache's
+hits/misses/evictions counters recorded by the Router.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from collections import deque
+from typing import Deque, List, Optional, Tuple
+
+from .message import Message
+
+
+class DispatchEngine:
+    """One engine per Broker. All entry points must run on the
+    broker's event loop; the engine holds no locks — ordering comes
+    from the loop plus the FIFO in-flight window (begin/finish pairs
+    complete strictly in begin order, the Router contract)."""
+
+    def __init__(
+        self,
+        broker,
+        queue_depth: int = 64,
+        deadline_ms: float = 0.5,
+        pipeline_depth: int = 2,
+        match_cache_size: int = 8192,
+    ) -> None:
+        self.broker = broker
+        self.router = broker.router
+        if match_cache_size:
+            self.router.enable_match_cache(match_cache_size)
+        self.telemetry = self.router.telemetry
+        self.queue_depth = max(1, queue_depth)
+        self.deadline_s = max(0.0, deadline_ms) / 1e3
+        self.pipeline_depth = max(1, pipeline_depth)
+        self._queue: List[tuple] = []  # (msg, future, enqueue clock)
+        # dispatched-but-unfetched batches: (pending match, entries)
+        self._inflight: Deque[tuple] = deque()
+        self._timer = None
+        self._drain_scheduled = False
+        self.batches_total = 0
+        self.publishes_total = 0
+        self.closed = False
+
+    # --- async publish surface -------------------------------------------
+
+    async def publish(self, msg: Message) -> int:
+        """Enqueue one publish and await its delivery count. The
+        pipelined analog of Broker.publish — identical hooks, identical
+        match results, identical dispatch."""
+        return await self.submit(msg)
+
+    def submit(self, msg: Message) -> "asyncio.Future":
+        """Enqueue without awaiting; returns the delivery-count future.
+        Flushes immediately at queue_depth, else arms the sub-ms
+        deadline timer for the batch the first enqueue opened."""
+        assert not self.closed, "dispatch engine stopped"
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        self._queue.append((msg, fut, self.telemetry.clock()))
+        if len(self._queue) >= self.queue_depth:
+            self._flush()
+        elif self._timer is None:
+            self._timer = loop.call_later(self.deadline_s, self._on_deadline)
+        return fut
+
+    def _on_deadline(self) -> None:
+        self._timer = None
+        if self._queue:
+            self._flush()
+
+    # --- batch close + pipeline ------------------------------------------
+
+    def _flush(self) -> None:
+        """Close the current batch: run the publish hooks, LAUNCH the
+        match kernels (no device->host fetch), and push the pending
+        batch onto the in-flight window. Collection happens on a later
+        loop turn (_drain) or immediately for whatever exceeds the
+        pipeline depth."""
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+        batch, self._queue = self._queue, []
+        tel = self.telemetry
+        broker = self.broker
+        now = tel.clock()
+        entries = []
+        topics = []
+        for msg, fut, t_in in batch:
+            tel.observe_family("pipeline_queue_wait_seconds", now - t_in)
+            live = broker._pre_publish(msg)
+            entries.append((live, fut))
+            if live is not None:
+                topics.append(live.topic)
+        self.batches_total += 1
+        self.publishes_total += len(batch)
+        pending = self.router.match_filters_begin(topics)
+        self._inflight.append((pending, entries))
+        tel.set_gauge("pipeline_depth", len(self._inflight))
+        tel.set_gauge("pipeline_coalesce", len(batch))
+        while len(self._inflight) > self.pipeline_depth:
+            self._collect_one()
+        if self._inflight and not self._drain_scheduled:
+            self._drain_scheduled = True
+            asyncio.get_running_loop().call_soon(self._drain)
+
+    def _drain(self) -> None:
+        self._drain_scheduled = False
+        while self._inflight:
+            self._collect_one()
+        self.telemetry.set_gauge("pipeline_depth", 0)
+
+    def _collect_one(self) -> None:
+        """Fetch + deliver the OLDEST in-flight batch (begin order)."""
+        pending, entries = self._inflight.popleft()
+        broker = self.broker
+        router = self.router
+        try:
+            filter_lists = router.match_filters_finish(pending)
+        except Exception as e:  # a failed batch fails its publishers,
+            for _live, fut in entries:  # never wedges the pipeline
+                if not fut.done():
+                    fut.set_exception(e)
+            return
+        fd = router.filter_dests
+        it = iter(filter_lists)
+        for live, fut in entries:
+            if live is None:
+                n = 0  # hook-denied / intercepted: same 0 as publish()
+            else:
+                try:
+                    n = broker._dispatch(
+                        live, [(f, fd(f)) for f in next(it)]
+                    )
+                except Exception as e:
+                    if not fut.done():
+                        fut.set_exception(e)
+                    continue
+            if not fut.done():
+                fut.set_result(n)
+
+    # --- lifecycle --------------------------------------------------------
+
+    async def drain(self) -> None:
+        """Flush the open batch and collect everything in flight."""
+        if self._queue:
+            self._flush()
+        while self._inflight:
+            self._collect_one()
+        await asyncio.sleep(0)  # let resolved futures' awaiters run
+
+    async def stop(self) -> None:
+        await self.drain()
+        self.closed = True
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+
+    def status(self) -> dict:
+        cache = self.router.match_cache
+        return {
+            "queue_depth": self.queue_depth,
+            "deadline_ms": self.deadline_s * 1e3,
+            "pipeline_depth": self.pipeline_depth,
+            "queued": len(self._queue),
+            "inflight": len(self._inflight),
+            "batches_total": self.batches_total,
+            "publishes_total": self.publishes_total,
+            "coalesce_factor": round(
+                self.publishes_total / self.batches_total, 3
+            ) if self.batches_total else 0.0,
+            "match_cache": None if cache is None else {
+                "capacity": cache.capacity,
+                "entries": len(cache),
+                "hits": cache.hits,
+                "misses": cache.misses,
+                "evictions": cache.evictions,
+                "hit_ratio": round(cache.hit_ratio(), 6),
+            },
+        }

@@ -60,6 +60,7 @@ class Broker:
         shared_strategy: str = "random",
         hooks: Optional[Hooks] = None,
         mesh=None,
+        fanout_cache_size: int = 4096,
     ):
         self.router = Router(max_levels=max_levels, mesh=mesh)
         self.shared = SharedSubs(strategy=shared_strategy)
@@ -83,16 +84,33 @@ class Broker:
         # external tracing seam (emqx_external_trace provider): None
         # costs one attribute check per publish
         self.tracer = None
-        # fanout plans: matched-filter-set -> prebuilt deduped
-        # delivery lists (the ?SUBSCRIBER-bag precomputation,
-        # emqx_broker.erl:126-140) — invalidated wholesale on any
-        # session/subscription mutation
+        # fanout plans: matched-filter-set -> (generation, prebuilt
+        # deduped delivery lists) — the ?SUBSCRIBER-bag precomputation,
+        # emqx_broker.erl:126-140. Any session/subscription mutation
+        # bumps _fanout_gen; stamped entries are lazily discarded on
+        # mismatch, so churn never pays an O(n) wholesale clear (the
+        # old clear() thrashed all 4096 plans on every (un)subscribe)
         self._fanout_cache: Dict[tuple, tuple] = {}
+        self._fanout_gen = 0
+        self._fanout_cap = fanout_cache_size
         # (filter, client) subopts — mirror of ?SUBOPTION
         self.suboptions: Dict[Tuple[str, str], SubOpts] = {}
         # durable-session manager (emqx_persistent_session_ds seam);
         # attach with enable_durable()
         self.durable = None
+        # pipelined micro-batching dispatcher; attach with
+        # enable_dispatch_engine() (broker/dispatch_engine.py)
+        self.engine = None
+
+    def enable_dispatch_engine(self, **kw):
+        """Attach a DispatchEngine (pipelined async publish path):
+        concurrent publishes coalesce into one kernel dispatch behind
+        the generation-stamped match cache. Idempotent per broker —
+        repeat calls replace the knobs by building a fresh engine."""
+        from .dispatch_engine import DispatchEngine
+
+        self.engine = DispatchEngine(self, **kw)
+        return self.engine
 
     def enable_durable(self, manager) -> None:
         """Wire a DurableSessionManager: installs the persist gate and
@@ -117,7 +135,7 @@ class Broker:
         ):
             # an existing LIVE session under this id must be torn down
             # first or its routes leak and deliveries double up
-            self._fanout_cache.clear()
+            self._fanout_gen += 1
             prev = self.sessions.get(client_id)
             if prev is not None and not self._is_durable(prev):
                 self.close_session(prev, discard=True)
@@ -128,7 +146,7 @@ class Broker:
                 "session.resumed" if present else "session.created", client_id
             )
             return session, present
-        self._fanout_cache.clear()
+        self._fanout_gen += 1
         old = self.sessions.get(client_id)
         if clean_start or old is None or old.expired():
             if old is not None:
@@ -149,7 +167,7 @@ class Broker:
         # (no duplicate terminated/discarded hooks)
         if self.sessions.get(session.client_id) is not session:
             return
-        self._fanout_cache.clear()
+        self._fanout_gen += 1
         # sever the transport (admin kick / takeover); harmless if the
         # teardown originated from the connection itself
         closer = getattr(session, "closer", None)
@@ -225,7 +243,7 @@ class Broker:
         if self.durable is not None and self._is_durable(session) and group is None:
             existed = self.durable.subscribe(session, flt, opts)
             self.suboptions[(flt, session.client_id)] = opts
-            self._fanout_cache.clear()
+            self._fanout_gen += 1
             self.stats.set("subscriptions.count", len(self.suboptions))
             self.hooks.run("session.subscribed", session.client_id, flt, opts)
             if opts.retain_handling == 2 or (opts.retain_handling == 1 and existed):
@@ -234,7 +252,7 @@ class Broker:
         existed = flt in session.subscriptions
         session.subscriptions[flt] = opts
         self.suboptions[(flt, session.client_id)] = opts
-        self._fanout_cache.clear()
+        self._fanout_gen += 1
         if group is not None:
             if self.shared.subscribe(group, real, session.client_id):
                 self.router.add_route(real, (GROUP_DEST, group, real))
@@ -254,7 +272,7 @@ class Broker:
             flt = flt[len(EXCLUSIVE_PREFIX):]
         if flt not in session.subscriptions:
             return False
-        self._fanout_cache.clear()
+        self._fanout_gen += 1
         self._release_exclusive(session.client_id, flt)
         # shared subs always live in the live router, even for durable
         # sessions (the durable subscribe branch requires group None)
@@ -382,21 +400,30 @@ class Broker:
         scanning a 100k-dest fan for the (rare) group tuples on every
         publish cost more than the whole delivery loop."""
         key = ("$shared", tuple(flt for flt, _ in pairs))
-        groups = self._fanout_cache.get(key)
-        if groups is None:
-            groups = []
-            for _flt, dests in pairs:
-                for dest in dests:
-                    if (
-                        isinstance(dest, tuple)
-                        and dest
-                        and dest[0] == GROUP_DEST
-                    ):
-                        groups.append((dest[1], dest[2]))
-            if len(self._fanout_cache) >= 4096:
-                self._fanout_cache.clear()
-            self._fanout_cache[key] = groups
+        gen = self._fanout_gen
+        entry = self._fanout_cache.get(key)
+        if entry is not None and entry[0] == gen:
+            return entry[1]
+        groups = []
+        for _flt, dests in pairs:
+            for dest in dests:
+                if (
+                    isinstance(dest, tuple)
+                    and dest
+                    and dest[0] == GROUP_DEST
+                ):
+                    groups.append((dest[1], dest[2]))
+        self._fanout_cache_put(key, entry, gen, groups)
         return groups
+
+    def _fanout_cache_put(self, key, entry, gen, value) -> None:
+        """Insert a generation-stamped plan. A stale entry overwrites
+        in place; at capacity ONE oldest-inserted entry evicts (O(1)
+        FIFO) — never a wholesale clear."""
+        cache = self._fanout_cache
+        if entry is None and len(cache) >= self._fanout_cap:
+            del cache[next(iter(cache))]
+        cache[key] = (gen, value)
 
     def _account_dispatch(self, msg: Message, n: int) -> None:
         if n == 0:
@@ -408,8 +435,8 @@ class Broker:
 
     def _dispatch_shared_local(self, msg: Message, pairs: Pairs) -> int:
         # snapshot via the cached plan: delivery hooks/sinks below may
-        # (un)subscribe mid-iteration, which clears the cache but
-        # leaves this list intact
+        # (un)subscribe mid-iteration, which bumps the plan generation
+        # but leaves this list intact
         n = 0
         for group, real in self._shared_group_dests(pairs):
             # redispatch loop: a stale member (session gone) must not
@@ -440,17 +467,19 @@ class Broker:
         emqx_broker.erl:408-424): one delivery per client, max granted
         QoS wins — then execute a cached fanout PLAN. Identical
         filter-sets share one plan (keyed by matched filters, not the
-        topic: a wildcard's whole topic space reuses it), rebuilt lazily
-        after any session/subscription mutation — the precomputed
+        topic: a wildcard's whole topic space reuses it), stamped with
+        the fanout generation and rebuilt lazily on mismatch after any
+        session/subscription mutation — the precomputed
         ?SUBSCRIBER-bag read of emqx_broker.erl:726-760 rather than a
         per-publish suboption scan."""
         key = tuple(flt for flt, _ in pairs)
-        plan = self._fanout_cache.get(key)
-        if plan is None:
+        gen = self._fanout_gen
+        entry = self._fanout_cache.get(key)
+        if entry is not None and entry[0] == gen:
+            plan = entry[1]
+        else:
             plan = self._build_fanout_plan(pairs)
-            if len(self._fanout_cache) >= 4096:
-                self._fanout_cache.clear()
-            self._fanout_cache[key] = plan
+            self._fanout_cache_put(key, entry, gen, plan)
         return self._fanout(msg, plan)
 
     def _build_fanout_plan(self, pairs: Pairs) -> tuple:
@@ -458,7 +487,7 @@ class Broker:
         eligible for the shared-packet QoS0 fast loop; other = durable
         or exotic sessions that always take session.deliver. Entries
         carry the session OBJECT — any mutation that could stale it
-        clears the whole cache."""
+        bumps the fanout generation, orphaning every older stamp."""
         best: Dict[str, Tuple[str, SubOpts]] = {}
         subopts = self.suboptions
         for flt, dests in pairs:

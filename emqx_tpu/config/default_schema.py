@@ -197,6 +197,32 @@ def broker_schema() -> Struct:
                                     "tpu_match_enable": Field(Bool(), default=True),
                                     "tpu_batch_window_ms": Field(Duration(), default=1),
                                     "tpu_min_batch": Field(Int(min=1), default=64),
+                                    # pipelined dispatch engine
+                                    # (broker/dispatch_engine.py): the
+                                    # micro-batch closes at queue_depth
+                                    # topics or the sub-ms deadline,
+                                    # whichever first; pipeline_depth
+                                    # bounds dispatched-but-unfetched
+                                    # batches (double-buffer = 2)
+                                    "tpu_dispatch_queue_depth": Field(
+                                        Int(min=1), default=64
+                                    ),
+                                    "tpu_dispatch_deadline_ms": Field(
+                                        Float(), default=0.5
+                                    ),
+                                    "tpu_pipeline_depth": Field(
+                                        Int(min=1), default=2
+                                    ),
+                                    # generation-stamped caches: 0
+                                    # disables the topic->pairs match
+                                    # cache; the fanout-plan cache cap
+                                    # replaces the old hardwired 4096
+                                    "tpu_match_cache_size": Field(
+                                        Int(min=0), default=8192
+                                    ),
+                                    "tpu_fanout_cache_size": Field(
+                                        Int(min=1), default=4096
+                                    ),
                                 }
                             )
                         ),

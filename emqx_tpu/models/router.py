@@ -260,6 +260,41 @@ class DeviceTable:
         return self._dev
 
 
+class _PendingMatch:
+    """An in-flight batched match: kernels LAUNCHED, results not yet
+    fetched. Produced by Router.match_filters_begin, consumed exactly
+    once (in begin order) by Router.match_filters_finish. Holding one
+    of these while encoding/dispatching the next batch is what lets
+    host work overlap device execution — JAX dispatch is asynchronous,
+    so the arrays stored here are promises, not data."""
+
+    __slots__ = (
+        "topics",       # the sub-batch actually sent to the kernels
+        "enc",          # EncodedTopics of `topics` (escalation retries)
+        "out",          # per-sub-topic result lists (exact-deep prefilled)
+        "root",         # telemetry root span (or None)
+        "mode",         # cached | hash | mesh_hash | mesh_dense | dense
+        "gen",          # router generation captured before the kernels
+        "full_out",     # full-batch skeleton when the match cache fronted it
+        "sub_idx",      # index of each sub-topic within the original batch
+        "hash_dev",     # (ti, bi, total, amb) device arrays (1-dev hash)
+        "hash_mh",      # max_hits the hash kernel launched with
+        "hash_shape",   # shape key sans max_hits (escalated re-dispatch)
+        "hash_elapsed",  # host seconds spent launching the hash leg
+        "mesh_pending",  # ShardedDeviceTable begin handle
+        "residual_pending",  # launched residual-dense leg (1-dev or mesh)
+        "dense_dev",    # (ti, ri, total) device arrays (no-index dense)
+        "dense_mh",
+        "dense_shape",
+        "dense_elapsed",
+        "dense_filters",  # EncodedFilters view (escalation re-dispatch)
+    )
+
+    def __init__(self) -> None:
+        for s in self.__slots__:
+            setattr(self, s, None)
+
+
 class Router:
     """Topic/filter -> dests with exact/wildcard split and device
     offload for batched wildcard matching."""
@@ -318,6 +353,15 @@ class Router:
         # own depth-unlimited trie (ids are filter strings)
         self._deep: Dict[str, Dict[Dest, int]] = {}
         self._deep_trie = TopicTrie()
+        # route-set generation: FilterTable.generation covers every
+        # table-resident mutation; this aux counter covers the host-only
+        # stores (deep filters, too-deep exact topics) the table can't
+        # see. match caches stamp entries with generation and lazily
+        # discard on mismatch — no O(n) clears on the mutation path.
+        self._aux_gen = 0
+        # generation-stamped topic -> filters cache fronting the device
+        # path (enable_match_cache); None keeps the kernel path bare
+        self.match_cache: Optional[match_ops.GenMatchCache] = None
         self.mesh = mesh
         # kernel telemetry: always-on by default (obs/kernel_telemetry).
         # Pass NULL (or any NullKernelTelemetry) to run the hot path
@@ -339,6 +383,23 @@ class Router:
                 self.table, device=device, index=self.index,
                 telemetry=self.telemetry,
             )
+
+    @property
+    def generation(self) -> int:
+        """Monotonic route-set generation: bumps on every mutation that
+        can change which filters match a topic. The validity stamp for
+        GenMatchCache entries and the broker's fanout-plan cache."""
+        return self.table.generation + self._aux_gen
+
+    def enable_match_cache(
+        self, capacity: int = 8192
+    ) -> match_ops.GenMatchCache:
+        """Attach (or resize) the generation-stamped topic->filters
+        cache in front of the batched match path. Idempotent for a
+        matching capacity; hot topics then skip the kernel entirely."""
+        if self.match_cache is None or self.match_cache.capacity != capacity:
+            self.match_cache = match_ops.GenMatchCache(capacity)
+        return self.match_cache
 
     # --- write path (emqx_router:do_add_route / do_delete_route) -------
 
@@ -373,6 +434,7 @@ class Router:
                     row = self.table.add(flt)
                 except FilterTooDeep:
                     self._exact_deep.add(flt)
+                    self._aux_gen += 1
                 else:
                     self._exact_row[flt] = row
                     self._ensure_row_filter()
@@ -391,6 +453,7 @@ class Router:
             except FilterTooDeep:
                 dests = self._deep.setdefault(flt, {})
                 self._deep_trie.insert(topic_mod.words(flt), flt)
+                self._aux_gen += 1
             else:
                 dests = self._wild.setdefault(flt, {})
                 self._filter_row[flt] = row
@@ -438,9 +501,17 @@ class Router:
                 ix = self.index
                 if ix is not None:
                     ix.reserve(B, t.capacity)
+                # the C core appends dirty rows / deep entries without
+                # bumping generations — detect growth and stamp here
+                d0 = len(t.dirty)
+                deep0 = len(self._deep) + len(self._exact_deep)
                 fresh, need_rebuild = sp.add_routes_core(
                     self, pairs if isinstance(pairs, list) else list(pairs)
                 )
+                if len(t.dirty) != d0:
+                    t.generation += 1
+                if len(self._deep) + len(self._exact_deep) != deep0:
+                    self._aux_gen += 1
                 if need_rebuild:
                     ix._rebuild(ix.n_buckets * 2)
                 if fresh:
@@ -477,6 +548,7 @@ class Router:
             for flt, row in zip(new_exact, rows):
                 if row < 0:
                     self._exact_deep.add(flt)
+                    self._aux_gen += 1
                 else:
                     exact_row[flt] = row
                     row_filter[row] = flt
@@ -497,6 +569,7 @@ class Router:
                     # just-registered dest dict to the deep-trie store
                     deep_t[flt] = wild_t.pop(flt)
                     self._deep_trie.insert(topic_mod.words(flt), flt)
+                    self._aux_gen += 1
                 else:
                     filter_row[flt] = row
                     row_filter[row] = flt
@@ -546,6 +619,7 @@ class Router:
                         self.table.remove(row)
                     else:
                         self._exact_deep.discard(flt)
+                        self._aux_gen += 1
                 if self.on_dest_removed is not None:
                     self.on_dest_removed(flt, dest)
             return
@@ -564,6 +638,7 @@ class Router:
             if deep:
                 del self._deep[flt]
                 self._deep_trie.remove(topic_mod.words(flt), flt)
+                self._aux_gen += 1
             else:
                 del self._wild[flt]
                 row = self._filter_row.pop(flt)
@@ -708,110 +783,184 @@ class Router:
             dests.update(dmap)
         return dests
 
-    def _escalating_pairs(self, kernel, max_hits: int, shape_key=None):
-        """Run a compaction kernel (max_hits -> (a, b, total)), escalating
-        max_hits once to the exact total on overflow (both kernels report
-        the true count, so one retry suffices — no bitmap fallback).
-        `shape_key` (kernel-static dims sans max_hits) feeds the
-        recompile tracker: the escalated retry is a NEW shape bucket."""
-        tel = self.telemetry
-        if shape_key is not None:
-            tel.record_shape("match_ids", shape_key + (max_hits,))
-        a, b, total = kernel(max_hits)
-        total = int(total)
-        if total > max_hits:
-            tel.count("escalations_total")
-            mh2 = _next_pow2(total)
-            if shape_key is not None:
-                tel.record_shape("match_ids", shape_key + (mh2,))
-            a, b, _ = kernel(mh2)
-        return np.asarray(a), np.asarray(b), total
-
-    def match_filters_batch(self, topics: Sequence[str]) -> List[List[str]]:
-        """Batched device path: ONE XLA dispatch for all wildcard
-        matching, host hash for exact topics. The hot loop of
-        emqx_broker:do_publish expressed over a topic batch.
-
-        With the pattern-class index (default) the wildcard leg is a
-        B×C hash-probe kernel returning (topic, bucket) candidates that
-        the host verifies against the oracle before expanding to dests;
-        rows the index couldn't class (skeleton budget) fall back to
-        the dense kernel over a residual mask. Result transfers stay
-        proportional to the number of matches either way, with one
-        exact-size retry on overflow."""
-        if not topics:
-            return []
+    def match_filters_begin(self, topics: Sequence[str]) -> _PendingMatch:
+        """Phase 1 of the pipelined batched match: probe the
+        generation-stamped match cache, sync the device table, encode
+        the uncached remainder, and LAUNCH the match kernels without
+        forcing any device->host transfer. JAX dispatch is async, so
+        after begin() returns the device executes this batch while the
+        host encodes the next one and fetches the previous one — the
+        double-buffering seam broker/dispatch_engine pipelines through.
+        Every begin() must be finished exactly once, in begin order, by
+        match_filters_finish; match_filters_batch composes the two for
+        the synchronous path, so results are bit-identical either way."""
         tel = self.telemetry
         clock = tel.clock
+        p = _PendingMatch()
+        p.gen = self.generation
+        cache = self.match_cache
+        if cache is not None and topics:
+            full: List[Optional[List[str]]] = []
+            sub_idx: List[int] = []
+            for i, t in enumerate(topics):
+                f = cache.get(t, p.gen)
+                if f is None:
+                    sub_idx.append(i)
+                    full.append(None)
+                else:
+                    # a fresh list per hit: callers may extend/consume
+                    full.append(list(f))
+            if tel.enabled:
+                nh = len(topics) - len(sub_idx)
+                if nh:
+                    tel.count("match_cache_hits", nh)
+                if sub_idx:
+                    tel.count("match_cache_misses", len(sub_idx))
+                tel.set_gauge(
+                    "match_cache_hit_ratio", round(cache.hit_ratio(), 6)
+                )
+                tel.set_gauge("match_cache_entries", len(cache))
+            p.full_out = full
+            p.sub_idx = sub_idx
+            sub = [topics[i] for i in sub_idx]
+        else:
+            sub = list(topics)
+        p.topics = sub
+        if not sub:
+            p.mode = "cached"
+            return p
         tel.count("dispatch_batches_total")
         root = tel.span("xla.match_batch")
         if root is not None:
-            root.set("batch", len(topics))
+            root.set("batch", len(sub))
+        p.root = root
         self.device_table.sync()
         sp = tel.span("xla.encode", root)
         t0 = clock()
-        enc = match_ops.encode_topics(self.table.vocab, topics, self.max_levels)
+        p.enc = enc = match_ops.encode_topics(
+            self.table.vocab, sub, self.max_levels
+        )
         tel.record_dispatch(LEG_ENCODE, clock() - t0)
         tel.end_span(sp)
         # exact topics are device rows (wildcard-free classes), so the
         # kernel surfaces them; only too-deep exacts need the host dict
         if self._exact_deep:
-            out: List[List[str]] = [
-                [t] if t in self._exact_deep else [] for t in topics
-            ]
+            p.out = [[t] if t in self._exact_deep else [] for t in sub]
         else:
-            out = [[] for _ in topics]
+            p.out = [[] for _ in sub]
         ix = self.index
         if self.mesh is not None and ix is None:
             # dense-only mesh path (use_hash_index=False)
+            p.mode = "mesh_dense"
+            p.mesh_pending = self.device_table.match_ids_begin(enc)
+            return p
+        if ix is not None:
+            p.mode = "hash"
+            if len(ix):
+                t0 = clock()
+                if self.mesh is not None:
+                    p.mode = "mesh_hash"
+                    p.mesh_pending = self.device_table.match_hash_begin(enc)
+                else:
+                    meta, slots = self.device_table.hash_state()
+                    mh = max(1024, _next_pow2(2 * len(sub)))
+                    shape = (
+                        len(sub), meta.plen.shape[0], slots.fp.shape[0],
+                    )
+                    tel.record_shape("match_ids_hash", shape + (mh,))
+                    p.hash_dev = hash_ops.match_ids_hash(
+                        meta, slots, enc, max_hits=mh
+                    )
+                    p.hash_mh = mh
+                    p.hash_shape = shape
+                p.hash_elapsed = clock() - t0
+            if ix.residual_rows:
+                # launch the residual-dense leg NOW so it overlaps the
+                # hash fetch; the (~never) amb host-fallback in finish
+                # simply discards it
+                t0 = clock()
+                if self.mesh is not None:
+                    p.residual_pending = (
+                        "mesh",
+                        self.device_table.match_ids_begin(enc, residual=True),
+                        clock() - t0,
+                    )
+                else:
+                    filters = self.device_table.residual_filters()
+                    mh = max(1024, _next_pow2(2 * len(sub)))
+                    shape = (len(sub), int(filters.words.shape[0]))
+                    tel.record_shape("match_ids", shape + (mh,))
+                    dev = match_ops.match_ids(filters, enc, max_hits=mh)
+                    p.residual_pending = (
+                        "single", dev, mh, shape, filters, clock() - t0,
+                    )
+            return p
+        p.mode = "dense"
+        filters = self.device_table.filters()
+        mh = max(4096, _next_pow2(4 * len(sub)))
+        shape = (len(sub), int(filters.words.shape[0]))
+        tel.record_shape("match_ids", shape + (mh,))
+        t0 = clock()
+        p.dense_dev = match_ops.match_ids(filters, enc, max_hits=mh)
+        p.dense_mh = mh
+        p.dense_shape = shape
+        p.dense_filters = filters
+        p.dense_elapsed = clock() - t0
+        return p
+
+    def match_filters_finish(self, p: _PendingMatch) -> List[List[str]]:
+        """Phase 2 of the pipelined batched match: force the
+        device->host transfers for a begun batch, escalate on
+        compaction overflow, run the host verify/unpack stages, fold in
+        deep-trie matches, populate the match cache, and return
+        per-topic filter lists — bit-identical to the synchronous
+        single-phase result."""
+        tel = self.telemetry
+        clock = tel.clock
+        out = p.out
+        topics = p.topics
+        if p.mode == "mesh_dense":
+            root = p.root
             sp = tel.span("xla.dispatch", root)
             t0 = clock()
-            ti, ri, = self.device_table.match_ids(enc)
+            ti, ri = self.device_table.match_ids_finish(p.mesh_pending)
             tel.record_dispatch(LEG_DENSE, clock() - t0)
             tel.end_span(sp)
             b = len(topics)
             for t_idx, row in zip(ti, ri):
                 if t_idx < b:  # drop dp-padding rows
                     out[int(t_idx)].append(self._row_filter[int(row)])
-            if self._deep:
-                for i, t in enumerate(topics):
-                    out[i].extend(self._deep_trie.match(topic_mod.words(t)))
-            tel.end_span(root)
-            return out
-        if ix is not None:
+        elif p.mode in ("hash", "mesh_hash"):
+            root = p.root
+            ix = self.index
             host_fallback = False
-            if len(ix):
+            if p.hash_dev is not None or p.mesh_pending is not None:
                 sp = tel.span("xla.dispatch", root)
                 t0 = clock()
-                if self.mesh is not None:
-                    ti, bi, amb = self.device_table.match_hash(enc)
+                if p.mode == "mesh_hash":
+                    ti, bi, amb = self.device_table.match_hash_finish(
+                        p.mesh_pending
+                    )
                 else:
-                    meta, slots = self.device_table.hash_state()
-                    mh = max(1024, _next_pow2(2 * len(topics)))
-                    tel.record_shape(
-                        "match_ids_hash",
-                        (len(topics), meta.plen.shape[0],
-                         slots.fp.shape[0], mh),
-                    )
-                    ti, bi, total, amb = hash_ops.match_ids_hash(
-                        meta, slots, enc, max_hits=mh
-                    )
+                    ti, bi, total, amb = p.hash_dev
                     total = int(total)
+                    mh = p.hash_mh
                     if total > mh:
                         tel.count("hash_overflow_retries_total")
                         mh = _next_pow2(total)
                         tel.record_shape(
-                            "match_ids_hash",
-                            (len(topics), meta.plen.shape[0],
-                             slots.fp.shape[0], mh),
+                            "match_ids_hash", p.hash_shape + (mh,)
                         )
+                        meta, slots = self.device_table.hash_state()
                         ti, bi, _t, amb = hash_ops.match_ids_hash(
-                            meta, slots, enc, max_hits=mh
+                            meta, slots, p.enc, max_hits=mh
                         )
                     ti = np.asarray(ti)[:total]
                     bi = np.asarray(bi)[:total]
                     amb = int(amb)
-                tel.record_dispatch(LEG_HASH, clock() - t0)
+                tel.record_dispatch(
+                    LEG_HASH, p.hash_elapsed + clock() - t0
+                )
                 tel.end_span(sp)
                 if amb:
                     # >1 lane of one pair passed the full-fingerprint
@@ -851,47 +1000,96 @@ class Router:
                         out[i].append(self._row_filter[row])
                 tel.record_dispatch(LEG_FALLBACK, clock() - t0)
                 tel.end_span(sp)
-            elif ix.residual_rows:
+            elif p.residual_pending is not None:
                 sp = tel.span("xla.dispatch", root)
                 t0 = clock()
-                if self.mesh is not None:
-                    ti, ri = self.device_table.match_ids(enc, residual=True)
+                if p.residual_pending[0] == "mesh":
+                    _tag, handle, elapsed = p.residual_pending
+                    ti, ri = self.device_table.match_ids_finish(handle)
                     for t_idx, row in zip(ti, ri):
                         if t_idx < len(topics):
-                            out[int(t_idx)].append(self._row_filter[int(row)])
+                            out[int(t_idx)].append(
+                                self._row_filter[int(row)]
+                            )
                 else:
-                    filters = self.device_table.residual_filters()
-                    ti, ri, total = self._escalating_pairs(
-                        lambda mh: match_ops.match_ids(
-                            filters, enc, max_hits=mh
-                        ),
-                        max(1024, _next_pow2(2 * len(topics))),
-                        shape_key=(
-                            len(topics), int(filters.words.shape[0])
-                        ),
+                    _tag, dev, mh, shape, filters, elapsed = (
+                        p.residual_pending
                     )
+                    ti, ri, total = dev
+                    total = int(total)
+                    if total > mh:
+                        tel.count("escalations_total")
+                        mh2 = _next_pow2(total)
+                        tel.record_shape("match_ids", shape + (mh2,))
+                        ti, ri, _t = match_ops.match_ids(
+                            filters, p.enc, max_hits=mh2
+                        )
+                    ti = np.asarray(ti)
+                    ri = np.asarray(ri)
                     for t_idx, row in zip(ti[:total], ri[:total]):
                         out[int(t_idx)].append(self._row_filter[int(row)])
-                tel.record_dispatch(LEG_DENSE, clock() - t0)
+                tel.record_dispatch(LEG_DENSE, elapsed + clock() - t0)
                 tel.end_span(sp)
-        else:
-            filters = self.device_table.filters()
+        elif p.mode == "dense":
+            root = p.root
             sp = tel.span("xla.dispatch", root)
             t0 = clock()
-            ti, ri, total = self._escalating_pairs(
-                lambda mh: match_ops.match_ids(filters, enc, max_hits=mh),
-                max(4096, _next_pow2(4 * len(topics))),
-                shape_key=(len(topics), int(filters.words.shape[0])),
-            )
+            ti, ri, total = p.dense_dev
+            total = int(total)
+            if total > p.dense_mh:
+                tel.count("escalations_total")
+                mh2 = _next_pow2(total)
+                tel.record_shape("match_ids", p.dense_shape + (mh2,))
+                ti, ri, _t = match_ops.match_ids(
+                    p.dense_filters, p.enc, max_hits=mh2
+                )
+            ti = np.asarray(ti)
+            ri = np.asarray(ri)
             for t_idx, row in zip(ti[:total], ri[:total]):
                 out[int(t_idx)].append(self._row_filter[int(row)])
-            tel.record_dispatch(LEG_DENSE, clock() - t0)
+            tel.record_dispatch(LEG_DENSE, p.dense_elapsed + clock() - t0)
             tel.end_span(sp)
-        if self._deep:
-            for i, t in enumerate(topics):
-                out[i].extend(self._deep_trie.match(topic_mod.words(t)))
-        tel.end_span(root)
-        return out
+        if p.mode != "cached":
+            if self._deep:
+                for i, t in enumerate(topics):
+                    out[i].extend(self._deep_trie.match(topic_mod.words(t)))
+            tel.end_span(p.root)
+        if p.full_out is None:
+            return out if out is not None else []
+        # merge the kernel results into the cached prefix and stamp the
+        # cache with the generation captured at begin: a mutation that
+        # landed mid-flight leaves these entries stale-on-arrival, so
+        # the next lookup recomputes — exactness over hit ratio
+        full = p.full_out
+        cache = self.match_cache
+        if out:
+            ev0 = cache.evictions
+            for j, i in enumerate(p.sub_idx):
+                flts = out[j]
+                full[i] = flts
+                cache.put(topics[j], p.gen, tuple(flts))
+            ev = cache.evictions - ev0
+            if ev and tel.enabled:
+                tel.count("match_cache_evictions", ev)
+        return full
+
+    def match_filters_batch(self, topics: Sequence[str]) -> List[List[str]]:
+        """Batched device path: ONE XLA dispatch for all wildcard
+        matching, host hash for exact topics. The hot loop of
+        emqx_broker:do_publish expressed over a topic batch.
+
+        With the pattern-class index (default) the wildcard leg is a
+        B×C hash-probe kernel returning (topic, bucket) candidates that
+        the host verifies against the oracle before expanding to dests;
+        rows the index couldn't class (skeleton budget) fall back to
+        the dense kernel over a residual mask. Result transfers stay
+        proportional to the number of matches either way, with one
+        exact-size retry on overflow. Composed from the begin/finish
+        pipeline phases, so the synchronous and pipelined paths are one
+        code path (and bit-identical by construction)."""
+        if not topics:
+            return []
+        return self.match_filters_finish(self.match_filters_begin(topics))
 
     def match_pairs_batch(
         self, topics: Sequence[str]

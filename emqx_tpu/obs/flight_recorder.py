@@ -179,6 +179,9 @@ def default_rules(
     burst_window_s: float = 60.0,
     slow_subs_n: int = 1,
     cooldown: float = 30.0,
+    cache_collapse_ratio: float = 0.5,
+    cache_min_lookups: int = 64,
+    cache_cooldown: float = 60.0,
 ) -> List[TriggerRule]:
     """The stock rule set; every threshold is a constructor knob so
     config/tests can tighten or disable individual rules."""
@@ -232,6 +235,36 @@ def default_rules(
             return {"bridge_events": n, "window_s": burst_window_s}
         return None
 
+    # match-cache hit-ratio collapse is delta-based like the recompile
+    # rule: compare hit/miss counters against the previous poll so the
+    # rule sees the ratio of THIS window — a route-churn storm that
+    # suddenly orphans the hot set fires it even when the lifetime
+    # ratio still looks healthy
+    cache_state = {"hits": None, "misses": None}
+
+    def cache_hit_collapse(ctl: "FlightControl") -> Optional[Dict]:
+        tel = ctl.telemetry
+        if tel is None:
+            return None
+        hits = tel.counters.get("match_cache_hits", 0)
+        misses = tel.counters.get("match_cache_misses", 0)
+        ph, pm = cache_state["hits"], cache_state["misses"]
+        cache_state["hits"], cache_state["misses"] = hits, misses
+        if ph is None:
+            return None
+        dh, dm = hits - ph, misses - pm
+        n = dh + dm
+        if n < cache_min_lookups:
+            return None
+        ratio = dh / n
+        if ratio < cache_collapse_ratio:
+            return {
+                "hit_ratio": round(ratio, 4),
+                "lookups": n,
+                "threshold": cache_collapse_ratio,
+            }
+        return None
+
     def slow_subs_breach(ctl: "FlightControl") -> Optional[Dict]:
         ss = ctl.slow_subs
         if ss is None:
@@ -246,6 +279,10 @@ def default_rules(
         TriggerRule("recompile_storm", recompile_storm, cooldown),
         TriggerRule("cuckoo_load", cuckoo_load, cooldown),
         TriggerRule("bridge_fallback_burst", bridge_burst, cooldown),
+        # own (longer) cooldown: a churn storm keeps the ratio low for
+        # its whole duration — one bundle per window is the record,
+        # more is noise
+        TriggerRule("cache_hit_collapse", cache_hit_collapse, cache_cooldown),
         TriggerRule("slow_subs_breach", slow_subs_breach, cooldown),
         # event-driven (fired by the Alarms listener, never polled);
         # registered so its cooldown is declared alongside the rest

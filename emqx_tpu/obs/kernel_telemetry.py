@@ -169,6 +169,12 @@ class KernelTelemetry:
         self.flight = None
         self.retrace_warn_after = retrace_warn_after
         self.hist: Dict[str, StreamingHistogram] = {}
+        # standalone histogram FAMILIES (one exposition family each,
+        # `emqx_xla_<name>`), as opposed to `hist` whose legs are label
+        # values of the shared dispatch-duration family. The dispatch
+        # engine's queue-wait series lives here: it measures host-side
+        # batching discipline, not a device dispatch leg.
+        self.family_hist: Dict[str, StreamingHistogram] = {}
         self.counters: Dict[str, int] = {}
         self.gauges: Dict[str, float] = {}
         self._shape_keys: Dict[str, Set[tuple]] = {}
@@ -200,6 +206,14 @@ class KernelTelemetry:
             batch.observe(float(v))
         self.histogram(leg).merge(batch)
         return batch
+
+    def observe_family(self, name: str, seconds: float) -> None:
+        """Record one sample into the standalone histogram family
+        `emqx_xla_<name>` (created on first observe)."""
+        h = self.family_hist.get(name)
+        if h is None:
+            h = self.family_hist[name] = StreamingHistogram()
+        h.observe(seconds)
 
     def dispatch_percentile(
         self,
@@ -325,6 +339,10 @@ class KernelTelemetry:
             "dispatch": {
                 leg: h.snapshot() for leg, h in sorted(self.hist.items())
             },
+            "families": {
+                name: h.snapshot()
+                for name, h in sorted(self.family_hist.items())
+            },
             "recompiles": {
                 "total": self.counters.get("recompiles_total", 0),
                 "shape_buckets": dict(sorted(self.shape_buckets().items())),
@@ -353,6 +371,19 @@ class KernelTelemetry:
                 lines.append(f'{fam}_bucket{{{lab},le="+Inf"}} {h.total}')
                 lines.append(f"{fam}_sum{{{lab}}} {h.sum:.9f}")
                 lines.append(f"{fam}_count{{{lab}}} {h.total}")
+        for name in sorted(self.family_hist):
+            h = self.family_hist[name]
+            fam = f"emqx_xla_{name}"
+            lines.append(f"# TYPE {fam} histogram")
+            cum = 0
+            for le, c in zip(h.bounds, h.counts):
+                cum += c
+                lines.append(
+                    f'{fam}_bucket{{{node},le="{_fmt_le(le)}"}} {cum}'
+                )
+            lines.append(f'{fam}_bucket{{{node},le="+Inf"}} {h.total}')
+            lines.append(f"{fam}_sum{{{node}}} {h.sum:.9f}")
+            lines.append(f"{fam}_count{{{node}}} {h.total}")
         for name in sorted(self.counters):
             fam = f"emqx_xla_{name}"
             lines.append(f"# TYPE {fam} counter")
@@ -396,6 +427,9 @@ class NullKernelTelemetry:
         for v in values:
             batch.observe(float(v))
         return batch
+
+    def observe_family(self, name, seconds) -> None:
+        pass
 
     def dispatch_percentile(self, p, legs=()) -> float:
         return 0.0

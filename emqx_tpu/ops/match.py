@@ -218,6 +218,62 @@ def unpack_all(packed: np.ndarray) -> List[np.ndarray]:
     return [unpack_indices(packed[i]) for i in range(packed.shape[0])]
 
 
+class GenMatchCache:
+    """Generation-stamped topic -> matched-filters cache.
+
+    The front line of the publish hot path: hot topics resolve to
+    their full match result (a tuple of filter strings) with one dict
+    probe and skip the kernel entirely. Every route mutation bumps the
+    owning Router's generation; entries carry the generation they were
+    computed at and are lazily discarded on mismatch — churn costs one
+    stale probe per re-touched topic, never an O(n) wholesale clear
+    (the EMQX route-cache invalidation model, without the flush).
+
+    Eviction at capacity is O(1) FIFO (oldest-inserted key): stale
+    entries age out through it, and hot topics re-enter immediately on
+    their next publish, so the steady-state contents track the live
+    hot set.
+    """
+
+    __slots__ = ("capacity", "data", "hits", "misses", "evictions")
+
+    def __init__(self, capacity: int = 8192):
+        assert capacity > 0
+        self.capacity = capacity
+        self.data: dict = {}  # topic -> (generation, filters tuple)
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        return len(self.data)
+
+    def get(self, topic: str, generation: int):
+        """Filters tuple on a current-generation hit, else None."""
+        e = self.data.get(topic)
+        if e is not None:
+            if e[0] == generation:
+                self.hits += 1
+                return e[1]
+            # lazy discard: the slot frees now, the entry re-fills from
+            # the kernel result at this topic's next publish
+            del self.data[topic]
+        self.misses += 1
+        return None
+
+    def put(self, topic: str, generation: int, filters) -> None:
+        data = self.data
+        if topic not in data and len(data) >= self.capacity:
+            # FIFO evict exactly one entry — bounded, O(1), no clear
+            del data[next(iter(data))]
+            self.evictions += 1
+        data[topic] = (generation, filters)
+
+    def hit_ratio(self) -> float:
+        n = self.hits + self.misses
+        return self.hits / n if n else 0.0
+
+
 def oracle_match_rows(
     table, topics: Sequence[str]
 ) -> List[np.ndarray]:

@@ -82,6 +82,13 @@ class FilterTable:
         # churn hot path and the native core extends it wholesale
         self.dirty: List[int] = []
         self.grew = False  # capacity changed since last drain → full upload
+        # table generation: bumped on every mutation that changes the
+        # FILTER SET (the same events that dirty rows). Match caches
+        # stamp entries with the generation they were computed at and
+        # lazily discard on mismatch — route churn never triggers an
+        # O(n) wholesale clear. Survives drain_dirty: validity is a
+        # host-truth question, not a device-sync one.
+        self.generation = 0
 
     def __len__(self) -> int:
         return self._count
@@ -111,6 +118,7 @@ class FilterTable:
         self._fstr[row] = flt
         self._count += 1
         self.dirty.append(row)
+        self.generation += 1
         return row
 
     def add_bulk(
@@ -182,6 +190,7 @@ class FilterTable:
             self.active[rr] = True
             self._count += len(kept_rows)
             self.dirty.extend(kept_rows)
+            self.generation += 1
         return rows
 
     def _add_bulk_native(self, sp, filters: Sequence[str]) -> List[int]:
@@ -226,6 +235,7 @@ class FilterTable:
             self.active[rr] = True
             self._count += len(kept_rows)
             self.dirty.extend(kept_rows)
+            self.generation += 1
         return rows
 
     def remove(self, row: int) -> None:
@@ -245,6 +255,7 @@ class FilterTable:
         self._free.append(row)
         self._count -= 1
         self.dirty.append(row)
+        self.generation += 1
 
     def filter_words(self, row: int) -> Tuple[str, ...]:
         ws = self._filters[row]

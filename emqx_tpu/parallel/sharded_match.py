@@ -26,6 +26,11 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+try:  # jax >= 0.5 exports it at top level; 0.4.x under experimental
+    _shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover - version-dependent import
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 from ..ops.match import EncodedTopics, _match_block, _pack_bits
 from ..ops.table import EncodedFilters
 from .mesh import DP_AXIS, SUB_AXIS, filter_sharding, topic_sharding
@@ -109,7 +114,7 @@ def make_sharded_kernels(mesh: Mesh):
         rw: jnp.ndarray,
         act: jnp.ndarray,
     ) -> EncodedFilters:
-        return jax.shard_map(
+        return _shard_map(
             _apply_delta_local,
             mesh=mesh,
             in_specs=(dev_specs,) + delta_specs,
@@ -149,7 +154,7 @@ def make_match_ids_kernel(mesh: Mesh, max_hits_per_block: int):
 
     @jax.jit
     def match_ids(filters: EncodedFilters, topics: EncodedTopics):
-        return jax.shard_map(
+        return _shard_map(
             _local,
             mesh=mesh,
             in_specs=(
@@ -194,12 +199,12 @@ def make_sharded_hash_kernel(mesh: Mesh, max_hits_per_block: int):
     meta_specs = (P(None),) * 5
     slot_specs = (P(SUB_AXIS), P(SUB_AXIS), P(SUB_AXIS))
     t_specs = (P(DP_AXIS, None), P(DP_AXIS), P(DP_AXIS))
+    n_sub = mesh.shape[SUB_AXIS]  # static (jax.lax.axis_size is >=0.5)
 
     def _local(plen, has_hash, root_wild, plus, active, sfp, sbkt, probe,
                ids, lens, dollar):
         dp_i = jax.lax.axis_index(DP_AXIS).astype(jnp.int32)
         sub_i = jax.lax.axis_index(SUB_AXIS).astype(jnp.int32)
-        n_sub = jax.lax.axis_size(SUB_AXIS)
         b_loc, max_levels = ids.shape
         c = plen.shape[0]
         nb_loc = probe.shape[0]
@@ -313,7 +318,7 @@ def make_sharded_hash_kernel(mesh: Mesh, max_hits_per_block: int):
 
     @jax.jit
     def kernel(meta, slots, topics):
-        return jax.shard_map(
+        return _shard_map(
             _local,
             mesh=mesh,
             in_specs=meta_specs + slot_specs + t_specs,
@@ -376,7 +381,7 @@ def make_slot_delta_kernel(mesh: Mesh):
 
     @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
     def apply(sfp, sbkt, probe, idx, fpv, bktv, pwv):
-        return jax.shard_map(
+        return _shard_map(
             _local,
             mesh=mesh,
             in_specs=specs + dspecs,
@@ -554,14 +559,11 @@ class ShardedDeviceTable:
             self._sync_index()
         return total, False
 
-    def match_ids(self, enc: EncodedTopics, residual: bool = False):
-        """All (topic, row) hit pairs for an encoded topic batch via
-        the dense kernel. With residual=True the active mask narrows
-        to the class index's residual rows (the unclassed fallback).
-        Returns (ti 1d, ri 1d) host arrays of equal length (valid
-        pairs only), escalating per-block capacity on overflow."""
-        import numpy as np
-
+    def match_ids_begin(self, enc: EncodedTopics, residual: bool = False):
+        """Launch the sharded dense compaction kernel WITHOUT forcing
+        any device->host transfer: the pipelined publish path overlaps
+        this batch's mesh execution with the next batch's host-side
+        encode. Returns an opaque handle for match_ids_finish."""
         assert self._dev is not None, "sync() before matching"
         dev = self._dev
         if residual:
@@ -569,16 +571,64 @@ class ShardedDeviceTable:
             dev = dev._replace(active=self._dev_residual)
         t_dev = self._mesh_mod.put_topics(enc, self.mesh)
         mh = self.default_mh
-        while True:
+        return (dev, t_dev, mh, self._match_kernel(mh)(dev, t_dev))
+
+    def match_ids_finish(self, pending):
+        """Force the transfers for a begun dense match, escalating
+        per-block capacity on overflow. Returns (ti 1d, ri 1d) host
+        arrays of equal length (valid pairs only)."""
+        import numpy as np
+
+        dev, t_dev, mh, (ti, ri, totals) = pending
+        totals = np.asarray(totals)
+        while int(totals.max(initial=0)) > mh:
+            mh = max(mh * 2, 1 << int(totals.max()).bit_length())
             ti, ri, totals = self._match_kernel(mh)(dev, t_dev)
             totals = np.asarray(totals)
-            if int(totals.max(initial=0)) <= mh:
-                break
-            mh = max(mh * 2, 1 << int(totals.max()).bit_length())
         ti = np.asarray(ti).reshape(-1)
         ri = np.asarray(ri).reshape(-1)
         keep = ti >= 0
         return ti[keep], ri[keep]
+
+    def match_ids(self, enc: EncodedTopics, residual: bool = False):
+        """All (topic, row) hit pairs for an encoded topic batch via
+        the dense kernel. With residual=True the active mask narrows
+        to the class index's residual rows (the unclassed fallback).
+        Returns (ti 1d, ri 1d) host arrays of equal length (valid
+        pairs only), escalating per-block capacity on overflow.
+        Composed from the begin/finish pipeline halves."""
+        return self.match_ids_finish(self.match_ids_begin(enc, residual))
+
+    def match_hash_begin(self, enc: EncodedTopics):
+        """Launch the mesh-sharded production hash kernel without a
+        host fetch (the pipelined counterpart of match_hash). Returns
+        an opaque handle for match_hash_finish."""
+        assert self._dev_slots is not None, "sync() before matching"
+        t_dev = self._mesh_mod.put_topics(enc, self.mesh)
+        mh = self.default_mh
+        return (
+            t_dev, mh,
+            self._hash_kernel(mh)(self._dev_meta, self._dev_slots, t_dev),
+        )
+
+    def match_hash_finish(self, pending):
+        """Force the transfers for a begun hash match, escalating
+        per-block capacity on overflow. Same result contract as
+        match_hash."""
+        import numpy as np
+
+        t_dev, mh, (ti, bi, totals, amb) = pending
+        totals = np.asarray(totals)
+        while int(totals.max(initial=0)) > mh:
+            mh = max(mh * 2, 1 << int(totals.max()).bit_length())
+            ti, bi, totals, amb = self._hash_kernel(mh)(
+                self._dev_meta, self._dev_slots, t_dev
+            )
+            totals = np.asarray(totals)
+        ti = np.asarray(ti).reshape(-1)
+        bi = np.asarray(bi).reshape(-1)
+        keep = ti >= 0
+        return ti[keep], bi[keep], int(np.asarray(amb).reshape(-1)[0])
 
     def match_hash(self, enc: EncodedTopics):
         """(topic, bucket) candidates via the mesh-sharded production
@@ -587,20 +637,4 @@ class ShardedDeviceTable:
         t_idx >= batch), global bucket ids, and the mesh-wide
         ambiguity count (amb > 0 -> caller re-matches on a host path,
         see ops.hash_index.match_ids_hash)."""
-        import numpy as np
-
-        assert self._dev_slots is not None, "sync() before matching"
-        t_dev = self._mesh_mod.put_topics(enc, self.mesh)
-        mh = self.default_mh
-        while True:
-            ti, bi, totals, amb = self._hash_kernel(mh)(
-                self._dev_meta, self._dev_slots, t_dev
-            )
-            totals = np.asarray(totals)
-            if int(totals.max(initial=0)) <= mh:
-                break
-            mh = max(mh * 2, 1 << int(totals.max()).bit_length())
-        ti = np.asarray(ti).reshape(-1)
-        bi = np.asarray(bi).reshape(-1)
-        keep = ti >= 0
-        return ti[keep], bi[keep], int(np.asarray(amb).reshape(-1)[0])
+        return self.match_hash_finish(self.match_hash_begin(enc))

@@ -1,0 +1,282 @@
+"""Pipelined dispatch engine + generation-stamped match caches
+(ISSUE 3): micro-batch coalescing with deadline close, begin/finish
+pipeline equivalence to the synchronous path, cache invalidation under
+interleaved subscribe/unsubscribe/publish churn oracle-checked on both
+the single-device and sharded tables, and the fanout-plan cache's
+no-wholesale-clear generation scheme."""
+
+import asyncio
+
+import numpy as np
+
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.packet import SubOpts
+from emqx_tpu.broker.pubsub import Broker
+from emqx_tpu.models.router import Router
+from emqx_tpu.ops.match import GenMatchCache, oracle_match_rows
+from emqx_tpu.parallel import mesh as mesh_mod
+
+
+def _rows(r, flts_lists):
+    inv = {f: i for i, f in enumerate(r._row_filter) if f is not None}
+    return [sorted(inv[f] for f in flts) for flts in flts_lists]
+
+
+def _oracle(r, topics):
+    return [sorted(x.tolist()) for x in oracle_match_rows(r.table, topics)]
+
+
+# --- GenMatchCache unit semantics -----------------------------------------
+
+
+def test_gen_cache_hit_miss_and_lazy_discard():
+    c = GenMatchCache(capacity=4)
+    c.put("a/b", 1, ("f1",))
+    assert c.get("a/b", 1) == ("f1",)
+    assert c.hits == 1
+    # generation mismatch: lazy discard, counted as a miss
+    assert c.get("a/b", 2) is None
+    assert c.misses == 1 and len(c) == 0
+    assert c.get("nope", 2) is None
+    assert c.misses == 2
+
+
+def test_gen_cache_eviction_is_bounded_o1_not_a_clear():
+    c = GenMatchCache(capacity=4)
+    for i in range(4):
+        c.put(f"t{i}", 1, (f"f{i}",))
+    c.put("t4", 1, ("f4",))
+    # exactly ONE entry evicted (FIFO oldest), the rest survive
+    assert len(c) == 4 and c.evictions == 1
+    assert c.get("t0", 1) is None  # the evicted one
+    assert c.get("t3", 1) == ("f3",)
+    # overwriting a stale entry at capacity evicts nothing
+    c.put("t3", 2, ("f3b",))
+    assert c.evictions == 1 and c.get("t3", 2) == ("f3b",)
+
+
+def test_router_generation_tracks_filter_set_not_dest_fans():
+    r = Router(max_levels=8)
+    g0 = r.generation
+    r.add_route("a/+/c", "d1")  # new filter -> bump
+    g1 = r.generation
+    assert g1 > g0
+    r.add_route("a/+/c", "d2")  # extra dest on a live filter -> no bump
+    assert r.generation == g1
+    r.delete_route("a/+/c", "d2")  # refcount drop, filter stays -> no bump
+    assert r.generation == g1
+    r.delete_route("a/+/c", "d1")  # filter disappears -> bump
+    assert r.generation > g1
+    # host-only deep filters bump through the aux counter
+    g2 = r.generation
+    deep = "/".join(["x"] * 20) + "/#"
+    r.add_route(deep, "d3")
+    assert r.generation > g2
+
+
+# --- cache invalidation under interleaved churn (the satellite) -----------
+
+
+def _churn_check(r, topics, steps=6):
+    """Interleave route-mutation batches with (repeated) batched
+    matches; every step must equal oracle_match_rows — the second
+    match per step runs against a warm cache."""
+    cache = r.match_cache
+    for step in range(steps):
+        if step % 2 == 0:
+            r.add_routes(
+                [(f"t{i}/a/+/y", f"e{step}-{i}") for i in range(0, 16, 3)]
+            )
+        else:
+            for i in range(0, 16, 3):
+                r.delete_route(f"t{i}/a/+/y", f"e{step - 1}-{i}")
+        orc = _oracle(r, topics)
+        assert _rows(r, r.match_filters_batch(topics)) == orc, f"step {step}"
+        # warm pass: hits must produce the identical result
+        assert _rows(r, r.match_filters_batch(topics)) == orc, f"step {step}w"
+    assert cache.hits > 0 and cache.misses > 0
+
+
+def test_match_cache_exact_under_churn_single_device():
+    r = Router(max_levels=8)
+    r.enable_match_cache(256)
+    r.add_routes([(f"t{i}/+/x/#", f"d{i}") for i in range(16)])
+    topics = [f"t{i}/a/x/y" for i in range(16)]
+    _churn_check(r, topics)
+    tel = r.telemetry
+    assert tel.counters["match_cache_hits"] == r.match_cache.hits
+    assert tel.counters["match_cache_misses"] == r.match_cache.misses
+
+
+def test_match_cache_exact_under_churn_sharded():
+    r = Router(max_levels=4, mesh=mesh_mod.make_mesh(n_dp=2, n_sub=4))
+    r.enable_match_cache(256)
+    r.add_routes([(f"t{i}/+/x/#", f"d{i}") for i in range(16)])
+    topics = [f"t{i}/a/x/y" for i in range(16)]
+    _churn_check(r, topics)
+
+
+def test_match_cache_eviction_pressure_stays_exact():
+    # capacity far below the topic set: every batch evicts, results
+    # must stay oracle-exact and the cache bounded
+    r = Router(max_levels=8)
+    r.enable_match_cache(8)
+    r.add_routes([(f"t{i}/+/x/#", f"d{i}") for i in range(16)])
+    topics = [f"t{i}/a/x/y" for i in range(16)]
+    for _ in range(3):
+        assert _rows(r, r.match_filters_batch(topics)) == _oracle(r, topics)
+    assert len(r.match_cache) <= 8
+    assert r.match_cache.evictions > 0
+    assert r.telemetry.counters["match_cache_evictions"] == (
+        r.match_cache.evictions
+    )
+
+
+# --- begin/finish pipeline == synchronous batch ---------------------------
+
+
+def test_begin_finish_overlapped_equals_sync_batch():
+    r = Router(max_levels=8)
+    r.add_routes([(f"t{i}/+/x/#", f"d{i}") for i in range(12)])
+    r.add_routes([(f"ex/{i}/up", f"e{i}") for i in range(4)])
+    batch_a = [f"t{i}/a/x/y" for i in range(8)] + ["ex/1/up"]
+    batch_b = [f"t{i}/b/x/z" for i in range(4, 12)] + ["ex/3/up"]
+    want_a = r.match_filters_batch(batch_a)
+    want_b = r.match_filters_batch(batch_b)
+    # two batches in flight at once, finished in begin order
+    pa = r.match_filters_begin(batch_a)
+    pb = r.match_filters_begin(batch_b)
+    assert r.match_filters_finish(pa) == want_a
+    assert r.match_filters_finish(pb) == want_b
+
+
+# --- the engine -----------------------------------------------------------
+
+
+def _fanned_broker(n=24, filt="room/{i}/+"):
+    b = Broker()
+    for i in range(n):
+        s, _ = b.open_session(f"c{i}", True)
+        s.outgoing_sink = lambda pkts: None
+        b.subscribe(s, filt.format(i=i % 8), SubOpts(qos=0))
+    return b
+
+
+async def test_engine_coalesces_concurrent_publishes():
+    b = _fanned_broker()
+    eng = b.enable_dispatch_engine(queue_depth=16, deadline_ms=5.0)
+    msgs = [Message(topic=f"room/{i % 8}/t", payload=b"x") for i in range(32)]
+    counts = await asyncio.gather(*[eng.publish(m) for m in msgs])
+    sync = [b.publish(Message(topic=m.topic, payload=b"y")) for m in msgs]
+    assert counts == sync
+    # 32 concurrent publishes coalesced into far fewer dispatches
+    assert eng.batches_total <= 4
+    assert eng.publishes_total == 32
+    tel = b.router.telemetry
+    assert tel.family_hist["pipeline_queue_wait_seconds"].total == 32
+    assert "pipeline_depth" in tel.gauges
+    await eng.stop()
+
+
+async def test_engine_deadline_closes_short_batches():
+    b = _fanned_broker()
+    eng = b.enable_dispatch_engine(queue_depth=1024, deadline_ms=1.0)
+    # far below queue_depth: only the deadline can close this batch
+    fut = eng.submit(Message(topic="room/1/t", payload=b"x"))
+    n = await asyncio.wait_for(fut, timeout=5)
+    assert n == 3  # room/1/+ holds sessions 1, 9, 17 of the 24-sub fan
+    assert eng.batches_total == 1
+    await eng.stop()
+
+
+async def test_engine_exact_under_interleaved_broker_churn():
+    """Interleaved subscribe/unsubscribe/publish through the engine:
+    delivery counts must equal the synchronous path after every
+    mutation batch (cache + fanout-plan invalidation end to end)."""
+    b = _fanned_broker()
+    eng = b.enable_dispatch_engine(queue_depth=8, deadline_ms=0.5)
+    extra = []
+    for step in range(5):
+        if step % 2 == 0:
+            s, _ = b.open_session(f"x{step}", True)
+            s.outgoing_sink = lambda pkts: None
+            b.subscribe(s, "room/#", SubOpts(qos=0))
+            extra.append(s)
+        elif extra:
+            b.unsubscribe(extra.pop(0), "room/#")
+        msgs = [
+            Message(topic=f"room/{i % 8}/s{step}", payload=b"x")
+            for i in range(16)
+        ]
+        counts = await asyncio.gather(*[eng.publish(m) for m in msgs])
+        sync = [b.publish(Message(topic=m.topic, payload=b"y")) for m in msgs]
+        assert counts == sync, f"step {step}"
+    await eng.stop()
+
+
+async def test_engine_hook_denied_publish_counts_zero():
+    b = _fanned_broker()
+
+    def deny(msg):
+        if msg.topic.endswith("denied"):
+            msg.headers["allow_publish"] = False
+        return msg
+
+    b.hooks.add("message.publish", deny)
+    eng = b.enable_dispatch_engine(queue_depth=4, deadline_ms=0.5)
+    ok, no = await asyncio.gather(
+        eng.publish(Message(topic="room/1/t", payload=b"x")),
+        eng.publish(Message(topic="room/1/denied", payload=b"x")),
+    )
+    assert ok >= 1 and no == 0
+    await eng.stop()
+
+
+async def test_engine_hot_topics_skip_the_kernel():
+    b = _fanned_broker()
+    eng = b.enable_dispatch_engine(queue_depth=8, deadline_ms=0.5)
+    tel = b.router.telemetry
+    msgs = [Message(topic=f"room/{i % 8}/hot", payload=b"x") for i in range(8)]
+    await asyncio.gather(*[eng.publish(m) for m in msgs])
+    kernel_batches = tel.counters["dispatch_batches_total"]
+    # the whole hot set is now cached: a second wave dispatches NOTHING
+    await asyncio.gather(
+        *[eng.publish(Message(topic=m.topic, payload=b"y")) for m in msgs]
+    )
+    assert tel.counters["dispatch_batches_total"] == kernel_batches
+    assert b.router.match_cache.hits >= 8
+    await eng.stop()
+
+
+# --- fanout-plan generation cache -----------------------------------------
+
+
+def test_fanout_cache_mutation_keeps_entries_no_clear():
+    b = _fanned_broker()
+    for i in range(4):
+        b.publish(Message(topic=f"room/{i}/t", payload=b"x"))
+    plans = len(b._fanout_cache)
+    assert plans >= 4
+    gen = b._fanout_gen
+    s, _ = b.open_session("late", True)
+    s.outgoing_sink = lambda pkts: None
+    b.subscribe(s, "room/#", SubOpts(qos=0))
+    # the mutation bumped the generation but did NOT clear the cache
+    assert b._fanout_gen > gen
+    assert len(b._fanout_cache) == plans
+    # stale plan rebuilds lazily and the new subscriber is seen
+    n = b.publish(Message(topic="room/0/t", payload=b"x"))
+    assert n == sum(
+        1 for (f, _c) in b.suboptions if f in ("room/0/+", "room/#")
+    )
+
+
+def test_fanout_cache_capacity_evicts_one_not_all():
+    b = _fanned_broker()
+    b._fanout_cap = 4
+    for i in range(8):
+        b.publish(Message(topic=f"room/{i % 8}/u{i}", payload=b"x"))
+    assert len(b._fanout_cache) <= 4
+    # the cache still serves: a repeated topic re-enters and hits
+    b.publish(Message(topic="room/7/u7", payload=b"x"))
+    assert len(b._fanout_cache) <= 4
