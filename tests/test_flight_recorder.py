@@ -149,6 +149,52 @@ def test_recompile_storm_rule_sees_delta(tmp_path):
         obs.stop()
 
 
+def test_cache_hit_collapse_rule_fires_on_sudden_drop(tmp_path):
+    b, obs = make(tmp_path)
+    fl = obs.flight
+    try:
+        tel = b.router.telemetry
+        # healthy traffic seeds the delta base — no trigger
+        tel.count("match_cache_hits", 200)
+        tel.count("match_cache_misses", 10)
+        assert fl.evaluate() == []
+        # steady healthy window: still no trigger
+        tel.count("match_cache_hits", 200)
+        tel.count("match_cache_misses", 10)
+        assert fl.evaluate() == []
+        # churn storm: this WINDOW is miss-dominated even though the
+        # lifetime ratio still looks fine — the delta rule fires
+        tel.count("match_cache_hits", 10)
+        tel.count("match_cache_misses", 190)
+        paths = fl.evaluate()
+        assert len(paths) == 1 and "cache_hit_collapse" in paths[0]
+        with open(paths[0]) as f:
+            bundle = json.load(f)
+        assert bundle["details"]["hit_ratio"] < 0.5
+        assert bundle["details"]["lookups"] == 200
+        # its own cooldown: a sustained collapse yields one bundle
+        tel.count("match_cache_misses", 500)
+        assert fl.evaluate() == []
+        assert fl.triggers_total["cache_hit_collapse"] == 1
+    finally:
+        obs.stop()
+
+
+def test_cache_rule_ignores_small_windows(tmp_path):
+    b, obs = make(tmp_path)
+    fl = obs.flight
+    try:
+        tel = b.router.telemetry
+        fl.evaluate()  # seed
+        # below the min-lookup floor: a handful of cold misses at boot
+        # must not page anyone
+        tel.count("match_cache_misses", 8)
+        assert fl.evaluate() == []
+        assert "cache_hit_collapse" not in fl.triggers_total
+    finally:
+        obs.stop()
+
+
 def test_alarm_activation_triggers_immediately(tmp_path):
     b, obs = make(tmp_path)
     try:

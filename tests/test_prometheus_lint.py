@@ -5,6 +5,7 @@ TYPE lines, metric/label syntax, one family per name, histogram
 Guards the exporter against the classic silent failure: a scrape that
 looks fine in tests and 400s at ingestion."""
 
+import asyncio
 import re
 
 from emqx_tpu.broker.message import Message
@@ -176,6 +177,54 @@ def test_obs_families_lint(tmp_path):
         assert hook_counts and hook_counts == sorted(hook_counts)
     finally:
         obs.stop()
+
+
+async def test_pipeline_and_cache_families_lint():
+    # ISSUE-3 families: the generation-stamped match-cache counters and
+    # the dispatch-engine pipeline gauges/histogram must pass the same
+    # exposition lint on the same scrape
+    from emqx_tpu.broker.dispatch_engine import DispatchEngine
+
+    broker = Broker()
+    s, _ = broker.open_session("c1", clean_start=True)
+    s.outgoing_sink = lambda pkts: None
+    broker.subscribe(s, "k0/#", SubOpts(qos=0))
+    broker.router.add_routes([(f"k{i}/+/v/#", f"d{i}") for i in range(16)])
+    # tiny cache so the evictions counter populates too
+    eng = DispatchEngine(
+        broker, queue_depth=8, deadline_ms=0.5, match_cache_size=4
+    )
+    topics = [f"k{i}/a/v/w" for i in range(8)]
+    for _ in range(2):  # second wave produces hits
+        await asyncio.gather(
+            *[eng.publish(Message(topic=t, payload=b"x")) for t in topics]
+        )
+    await eng.stop()
+    text = prometheus_text(broker, "n1@host")
+    types = _lint(text)
+    for fam, kind in (
+        ("emqx_xla_match_cache_hits", "counter"),
+        ("emqx_xla_match_cache_misses", "counter"),
+        ("emqx_xla_match_cache_evictions", "counter"),
+        ("emqx_xla_pipeline_depth", "gauge"),
+        ("emqx_xla_pipeline_coalesce", "gauge"),
+        ("emqx_xla_match_cache_hit_ratio", "gauge"),
+        ("emqx_xla_pipeline_queue_wait_seconds", "histogram"),
+    ):
+        assert types.get(fam) == kind, f"{fam}: {types.get(fam)}"
+    # the queue-wait histogram is structurally sound: cumulative with a
+    # terminal +Inf whose count equals _count
+    buckets = [
+        int(l.rsplit(" ", 1)[1])
+        for l in text.splitlines()
+        if l.startswith('emqx_xla_pipeline_queue_wait_seconds_bucket{')
+    ]
+    assert buckets and buckets == sorted(buckets)
+    count_line = next(
+        l for l in text.splitlines()
+        if l.startswith('emqx_xla_pipeline_queue_wait_seconds_count')
+    )
+    assert int(count_line.rsplit(" ", 1)[1]) == buckets[-1] == 16
 
 
 def test_null_telemetry_scrape_stays_clean():
