@@ -1265,6 +1265,108 @@ def bench_fanout(details):
         "serialized_mb_per_sec": round(nbytes[0] / dt / 1e6, 1),
     }
 
+    # --- device-resolved plan resolution vs the Python walk --------------
+    # The ISSUE-4 acceptance stage: 1k/10k/100k-subscriber fans in the
+    # dedup-stressing shape (every subscriber on one wildcard filter,
+    # half ALSO on an overlapping one — the aggre/1 case), timed under
+    # the shared gc_off hygiene, with device plans asserted
+    # bit-identical to the host oracle BEFORE and AFTER churn, and
+    # deliveries/s recorded sync (host walk) vs device-resolved.
+    def build_fan_broker(ns):
+        fb = Broker()
+        fb._fanout_min_fan = 0
+        for i in range(ns):
+            s, _ = fb.open_session(f"pf{i}", True)
+            s.outgoing_sink = lambda pkts: None
+            fb.subscribe(s, "pfan/+/x", SubOpts(qos=i % 3))
+            if i % 2 == 0:
+                fb.subscribe(s, "pfan/#", SubOpts(qos=2))
+        return fb
+
+    ROUNDS_R = 5
+    stages = {}
+    for ns in (1_000 // SHRINK or 64, 10_000 // SHRINK, 100_000 // SHRINK):
+        fb = build_fan_broker(ns)
+        r = fb.router
+        pairs = r.match_pairs("pfan/1/x")
+        key = tuple(f for f, _ in pairs)
+
+        def device_plan():
+            return r.resolve_fanout_finish(
+                r.resolve_fanout_begin(key, min_fan=0)
+            )
+
+        # exactness pre-churn
+        assert device_plan() == fb._build_fanout_plan(pairs), (
+            f"fanout exactness FAILED pre-churn @ {ns}"
+        )
+        # churn: late joiners + leavers on BOTH filters, then re-assert
+        for j in range(8):
+            s, _ = fb.open_session(f"late{j}", True)
+            s.outgoing_sink = lambda pkts: None
+            fb.subscribe(s, "pfan/#", SubOpts(qos=j % 3))
+        for j in range(0, 8, 2):
+            fb.unsubscribe(fb.sessions[f"pf{j}"], "pfan/+/x")
+        pairs = r.match_pairs("pfan/1/x")
+        assert device_plan() == fb._build_fanout_plan(pairs), (
+            f"fanout exactness FAILED post-churn @ {ns}"
+        )
+        device_plan()  # warm the post-churn shape
+        with gc_off():
+            host_t = []
+            for _ in range(ROUNDS_R):
+                t0 = time.time()
+                fb._build_fanout_plan(pairs)
+                host_t.append(time.time() - t0)
+            dev_t = []
+            for _ in range(ROUNDS_R):
+                t0 = time.time()
+                device_plan()
+                dev_t.append(time.time() - t0)
+        host_rate = 1.0 / pctl(host_t, 25)
+        dev_rate = 1.0 / pctl(dev_t, 25)
+        plan_speedup = dev_rate / host_rate
+        # deliveries/s with the plan invalidated before every publish,
+        # so each publish pays a full resolve: sync walk vs device
+        fan_msg = Message(topic="pfan/1/x", payload=b"x" * 64)
+
+        def deliv_rate(device):
+            fb._fanout_device = device
+            fb.publish(fan_msg)  # warm
+            with gc_off():
+                t0 = time.time()
+                n = 0
+                for _ in range(ROUNDS_R):
+                    fb._mark_fanout("pfan/+/x")  # stale the plan
+                    n += fb.publish(fan_msg)
+            return n / (time.time() - t0)
+
+        sync_dps = deliv_rate(False)
+        dev_dps = deliv_rate(True)
+        fb._fanout_device = True
+        log(f"fanout plans @{ns:,} subs: host {host_rate:,.1f}/s vs "
+            f"device {dev_rate:,.1f}/s -> {plan_speedup:.1f}x | "
+            f"deliveries sync {sync_dps:,.0f}/s vs device-resolved "
+            f"{dev_dps:,.0f}/s")
+        stages[f"fan_{ns}"] = {
+            "subscribers": ns,
+            "gathered_fan": int(r.dest_store.fan_of(
+                [r._fanout_row(f) for f in key]
+            )),
+            "host_plans_per_sec": round(host_rate, 1),
+            "device_plans_per_sec": round(dev_rate, 1),
+            "plan_speedup": round(plan_speedup, 2),
+            "sync_deliveries_per_sec": round(sync_dps, 1),
+            "device_deliveries_per_sec": round(dev_dps, 1),
+            "exactness": "ok (pre/post churn)",
+        }
+        if ns >= 100_000:
+            assert plan_speedup >= 3.0, (
+                f"device plan resolution {plan_speedup:.2f}x < 3x @ {ns}"
+            )
+            stages[f"fan_{ns}"]["acceptance_3x"] = "ok"
+    details["fanout_device_resolve"] = stages
+
 
 # --------------------------------------------------------------------------
 # pipelined dispatch engine — e2e publish throughput (incl. transfer)

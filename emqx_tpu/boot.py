@@ -122,6 +122,8 @@ class Node:
         # the pipelined micro-batching dispatch engine + match cache
         # (broker/dispatch_engine.py), gated on the TPU offload knob
         broker._fanout_cap = cfg.get("broker.perf.tpu_fanout_cache_size")
+        broker._fanout_device = cfg.get("broker.perf.tpu_fanout_enable")
+        broker._fanout_min_fan = cfg.get("broker.perf.tpu_fanout_min_fan")
         if cfg.get("broker.perf.tpu_match_enable"):
             broker.enable_dispatch_engine(
                 queue_depth=cfg.get("broker.perf.tpu_dispatch_queue_depth"),

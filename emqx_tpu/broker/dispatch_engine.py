@@ -32,6 +32,15 @@ accelerator link:
     generation and stale entries lazily rebuild, so churn never does
     an O(n) clear.
 
+  * **Fanout-resolve overlap** — topics the match cache answers at
+    begin time have known filter sets before the kernel fetch: their
+    stale/missing fanout plans launch `Router.resolve_fanout_begin`
+    (the device dedup/max-QoS kernel, ops/fanout.py) in the same
+    flush, so the deduped plan materializes on device while the match
+    hash fetch for the uncached remainder is still in flight; plans
+    install stamped with the begin-time clock (stale-on-arrival if a
+    mutation landed mid-flight).
+
 Exactness contract: every result is produced by the same
 begin/finish code path the synchronous `Broker.publish_batch` →
 `Router.match_filters_batch` composes, and delivery runs through the
@@ -139,7 +148,33 @@ class DispatchEngine:
         self.batches_total += 1
         self.publishes_total += len(batch)
         pending = self.router.match_filters_begin(topics)
-        self._inflight.append((pending, entries))
+        # device-resolved fanout overlap: topics the match cache
+        # answered at begin time have known filter sets NOW — launch
+        # their plan resolves immediately so the deduped plan
+        # materializes on device while the match hash fetch for the
+        # uncached remainder is still in flight
+        fanout_pending = None
+        if broker._fanout_device and pending.full_out is not None:
+            seen = set()
+            for flts in pending.full_out:
+                if flts is None:
+                    continue
+                fkey = tuple(flts)
+                if fkey in seen:
+                    continue
+                seen.add(fkey)
+                if broker._plan_fresh(fkey):
+                    continue
+                h = self.router.resolve_fanout_begin(
+                    fkey, min_fan=broker._fanout_min_fan
+                )
+                if h is not None:
+                    if fanout_pending is None:
+                        fanout_pending = []
+                    fanout_pending.append(
+                        (fkey, broker._fanout_clock, h)
+                    )
+        self._inflight.append((pending, entries, fanout_pending))
         tel.set_gauge("pipeline_depth", len(self._inflight))
         tel.set_gauge("pipeline_coalesce", len(batch))
         while len(self._inflight) > self.pipeline_depth:
@@ -156,7 +191,7 @@ class DispatchEngine:
 
     def _collect_one(self) -> None:
         """Fetch + deliver the OLDEST in-flight batch (begin order)."""
-        pending, entries = self._inflight.popleft()
+        pending, entries, fanout_pending = self._inflight.popleft()
         broker = self.broker
         router = self.router
         try:
@@ -166,6 +201,17 @@ class DispatchEngine:
                 if not fut.done():
                     fut.set_exception(e)
             return
+        if fanout_pending is not None:
+            # install the overlapped plans before delivering: stamped
+            # with the clock captured at begin, so a mutation that
+            # landed mid-flight leaves them stale-on-arrival and the
+            # dispatch below rebuilds — exactness over hit ratio
+            for fkey, clock, h in fanout_pending:
+                try:
+                    plan = router.resolve_fanout_finish(h)
+                except Exception:
+                    continue  # the dispatch path rebuilds host-side
+                broker._store_plan(fkey, clock, plan)
         fd = router.filter_dests
         it = iter(filter_lists)
         for live, fut in entries:

@@ -84,15 +84,29 @@ class Broker:
         # external tracing seam (emqx_external_trace provider): None
         # costs one attribute check per publish
         self.tracer = None
-        # fanout plans: matched-filter-set -> (generation, prebuilt
+        # fanout plans: matched-filter-set -> (build clock, prebuilt
         # deduped delivery lists) — the ?SUBSCRIBER-bag precomputation,
-        # emqx_broker.erl:126-140. Any session/subscription mutation
-        # bumps _fanout_gen; stamped entries are lazily discarded on
-        # mismatch, so churn never pays an O(n) wholesale clear (the
-        # old clear() thrashed all 4096 plans on every (un)subscribe)
+        # emqx_broker.erl:126-140. Invalidation is PER FILTER: every
+        # session/subscription mutation stamps the touched filter with
+        # the next clock tick, and a plan is stale only when one of ITS
+        # matched filters carries a newer stamp — a subscribe on filter
+        # A leaves every disjoint filter B's plan intact (the old
+        # single global generation orphaned all 4096 plans broker-wide
+        # on any mutation; under connect churn that meant continuous
+        # 100k-entry rebuilds). Stamps persist for filters that leave —
+        # deleting one would resurrect older plans referencing it.
         self._fanout_cache: Dict[tuple, tuple] = {}
-        self._fanout_gen = 0
+        self._fanout_clock = 0
+        self._filter_stamp: Dict[str, int] = {}
         self._fanout_cap = fanout_cache_size
+        # device-resolved fanout (ops/fanout.py): plan misses above
+        # _fanout_min_fan dedup on device via the CSR dest store; below
+        # it (or for host-resident filters) the Python walk is cheaper.
+        # Boot wires broker.perf.tpu_fanout_{enable,min_fan} here.
+        self._fanout_device = True
+        self._fanout_min_fan = 1024
+        self.router.dest_store.mem_class = Session
+        self.router.fanout_opts_lookup = self._fanout_opts_lookup
         # (filter, client) subopts — mirror of ?SUBOPTION
         self.suboptions: Dict[Tuple[str, str], SubOpts] = {}
         # durable-session manager (emqx_persistent_session_ds seam);
@@ -134,25 +148,27 @@ class Broker:
             and cfg.session_expiry_interval > 0
         ):
             # an existing LIVE session under this id must be torn down
-            # first or its routes leak and deliveries double up
-            self._fanout_gen += 1
+            # first or its routes leak and deliveries double up (the
+            # close touches every filter the old session held, staling
+            # exactly the plans that embedded it)
             prev = self.sessions.get(client_id)
             if prev is not None and not self._is_durable(prev):
                 self.close_session(prev, discard=True)
             session, present = self.durable.open_session(client_id, clean_start, cfg)
             self.sessions[client_id] = session
+            self.router.dest_store.note_session(client_id, session)
             self.stats.set("sessions.count", len(self.sessions))
             self.hooks.run(
                 "session.resumed" if present else "session.created", client_id
             )
             return session, present
-        self._fanout_gen += 1
         old = self.sessions.get(client_id)
         if clean_start or old is None or old.expired():
             if old is not None:
                 self.close_session(old, discard=True)
             s = Session(client_id, cfg)
             self.sessions[client_id] = s
+            self.router.dest_store.note_session(client_id, s)
             self.stats.set("sessions.count", len(self.sessions))
             self.hooks.run("session.created", client_id)
             return s, False
@@ -167,7 +183,10 @@ class Broker:
         # (no duplicate terminated/discarded hooks)
         if self.sessions.get(session.client_id) is not session:
             return
-        self._fanout_gen += 1
+        # stale every plan that embeds this session: stamp each filter
+        # it subscribed (per-filter, so unrelated plans survive)
+        for flt in session.subscriptions:
+            self._mark_fanout(topic_mod.parse_share(flt)[1])
         # sever the transport (admin kick / takeover); harmless if the
         # teardown originated from the connection itself
         closer = getattr(session, "closer", None)
@@ -188,6 +207,7 @@ class Broker:
                 self.hooks.run("session.unsubscribed", session.client_id, flt)
             self.durable.discard_session(session.client_id)
             self.sessions.pop(session.client_id, None)
+            self.router.dest_store.note_session(session.client_id, None)
             self.stats.set("sessions.count", len(self.sessions))
             self.stats.set("subscriptions.count", len(self.suboptions))
             self.hooks.run(
@@ -201,6 +221,7 @@ class Broker:
             self.hooks.run("session.unsubscribed", session.client_id, flt)
         session.subscriptions.clear()
         self.sessions.pop(session.client_id, None)
+        self.router.dest_store.note_session(session.client_id, None)
         self.stats.set("sessions.count", len(self.sessions))
         self.hooks.run(
             "session.discarded" if discard else "session.terminated",
@@ -243,7 +264,7 @@ class Broker:
         if self.durable is not None and self._is_durable(session) and group is None:
             existed = self.durable.subscribe(session, flt, opts)
             self.suboptions[(flt, session.client_id)] = opts
-            self._fanout_gen += 1
+            self._mark_fanout(real)
             self.stats.set("subscriptions.count", len(self.suboptions))
             self.hooks.run("session.subscribed", session.client_id, flt, opts)
             if opts.retain_handling == 2 or (opts.retain_handling == 1 and existed):
@@ -252,12 +273,16 @@ class Broker:
         existed = flt in session.subscriptions
         session.subscriptions[flt] = opts
         self.suboptions[(flt, session.client_id)] = opts
-        self._fanout_gen += 1
+        self._mark_fanout(real)
         if group is not None:
             if self.shared.subscribe(group, real, session.client_id):
                 self.router.add_route(real, (GROUP_DEST, group, real))
-        elif not existed:
-            self.router.add_route(real, session.client_id)
+        else:
+            if not existed:
+                self.router.add_route(real, session.client_id)
+            # stamp the CSR edge with the live suboption (covers
+            # resubscribe-with-new-QoS, which has no route transition)
+            self.router.fanout_note_opts(real, session.client_id, opts, session)
         self.stats.set("subscriptions.count", len(self.suboptions))
         self.hooks.run("session.subscribed", session.client_id, flt, opts)
         # retained delivery: never for shared subs (MQTT-5 §4.8.2)
@@ -272,11 +297,12 @@ class Broker:
             flt = flt[len(EXCLUSIVE_PREFIX):]
         if flt not in session.subscriptions:
             return False
-        self._fanout_gen += 1
+        group, real = topic_mod.parse_share(flt)
+        self._mark_fanout(real)
         self._release_exclusive(session.client_id, flt)
         # shared subs always live in the live router, even for durable
         # sessions (the durable subscribe branch requires group None)
-        is_shared = topic_mod.parse_share(flt)[0] is not None
+        is_shared = group is not None
         if self.durable is not None and self._is_durable(session) and not is_shared:
             self.durable.unsubscribe(session, flt)
             self.suboptions.pop((flt, session.client_id), None)
@@ -388,22 +414,64 @@ class Broker:
         return out
 
     def _dispatch(self, msg: Message, pairs: Pairs) -> int:
-        n = self._dispatch_shared_local(msg, pairs)
-        nd = self._dispatch_direct(msg, pairs)
+        # the matched-filter key is the cache identity for BOTH plan
+        # families (shared legs + direct plan); build it once per
+        # dispatch instead of once per consumer
+        pairs = pairs if isinstance(pairs, list) else list(pairs)
+        key = tuple(flt for flt, _ in pairs)
+        n = self._dispatch_shared_local(msg, pairs, key)
+        nd = self._dispatch_direct(msg, pairs, key)
         if nd:
             self.metrics.inc("messages.delivered", nd)
         self._account_dispatch(msg, n + nd)
         return n + nd
 
-    def _shared_group_dests(self, pairs: Pairs):
+    # --- fanout-plan cache (per-filter stamp invalidation) ---------------
+
+    @property
+    def _fanout_gen(self) -> int:
+        """The monotonic mutation clock (kept under the historical name
+        for introspection: it still bumps on every plan-relevant
+        mutation, but plans no longer stale on it globally)."""
+        return self._fanout_clock
+
+    def _mark_fanout(self, real: str) -> None:
+        """Stamp one (share-stripped) filter with the next clock tick:
+        every cached plan whose matched set contains it is now stale;
+        every other plan stays live."""
+        self._fanout_clock += 1
+        self._filter_stamp[real] = self._fanout_clock
+
+    def _plan_entry_fresh(self, entry: tuple, filters) -> bool:
+        """A plan built at entry's clock is stale only if one of ITS
+        matched filters mutated since — len(filters) dict probes, not a
+        global compare, so disjoint-filter churn never orphans it."""
+        clock = entry[0]
+        stamp = self._filter_stamp
+        for f in filters:
+            s = stamp.get(f)
+            if s is not None and s > clock:
+                return False
+        return True
+
+    def _plan_fresh(self, key: tuple) -> bool:
+        """True when a current plan is cached for this filter set (the
+        dispatch engine's probe before launching a device resolve)."""
+        entry = self._fanout_cache.get(key)
+        return entry is not None and self._plan_entry_fresh(entry, key)
+
+    def _store_plan(self, key: tuple, clock: int, plan) -> None:
+        self._fanout_cache_put(key, self._fanout_cache.get(key), clock, plan)
+
+    def _shared_group_dests(self, pairs: Pairs, key: tuple):
         """(group, real) legs in a match result. Cached per filter-set:
         scanning a 100k-dest fan for the (rare) group tuples on every
         publish cost more than the whole delivery loop."""
-        key = ("$shared", tuple(flt for flt, _ in pairs))
-        gen = self._fanout_gen
-        entry = self._fanout_cache.get(key)
-        if entry is not None and entry[0] == gen:
+        skey = ("$shared", key)
+        entry = self._fanout_cache.get(skey)
+        if entry is not None and self._plan_entry_fresh(entry, key):
             return entry[1]
+        clock = self._fanout_clock
         groups = []
         for _flt, dests in pairs:
             for dest in dests:
@@ -413,17 +481,17 @@ class Broker:
                     and dest[0] == GROUP_DEST
                 ):
                     groups.append((dest[1], dest[2]))
-        self._fanout_cache_put(key, entry, gen, groups)
+        self._fanout_cache_put(skey, entry, clock, groups)
         return groups
 
-    def _fanout_cache_put(self, key, entry, gen, value) -> None:
-        """Insert a generation-stamped plan. A stale entry overwrites
-        in place; at capacity ONE oldest-inserted entry evicts (O(1)
+    def _fanout_cache_put(self, key, entry, clock, value) -> None:
+        """Insert a clock-stamped plan. A stale entry overwrites in
+        place; at capacity ONE oldest-inserted entry evicts (O(1)
         FIFO) — never a wholesale clear."""
         cache = self._fanout_cache
         if entry is None and len(cache) >= self._fanout_cap:
             del cache[next(iter(cache))]
-        cache[key] = (gen, value)
+        cache[key] = (clock, value)
 
     def _account_dispatch(self, msg: Message, n: int) -> None:
         if n == 0:
@@ -433,12 +501,14 @@ class Broker:
                 self.metrics.inc("messages.dropped.no_subscribers")
                 self.hooks.run("message.dropped", msg, "no_subscribers")
 
-    def _dispatch_shared_local(self, msg: Message, pairs: Pairs) -> int:
+    def _dispatch_shared_local(
+        self, msg: Message, pairs: Pairs, key: tuple
+    ) -> int:
         # snapshot via the cached plan: delivery hooks/sinks below may
-        # (un)subscribe mid-iteration, which bumps the plan generation
+        # (un)subscribe mid-iteration, which stamps the plan's filters
         # but leaves this list intact
         n = 0
-        for group, real in self._shared_group_dests(pairs):
+        for group, real in self._shared_group_dests(pairs, key):
             # redispatch loop: a stale member (session gone) must not
             # eat the message — re-elect excluding it
             # (emqx_shared_sub:dispatch/4 retry + redispatch,
@@ -462,25 +532,55 @@ class Broker:
                 tried = tried + (member,)
         return n
 
-    def _dispatch_direct(self, msg: Message, pairs: Pairs) -> int:
+    def _dispatch_direct(
+        self, msg: Message, pairs: Pairs, key: tuple
+    ) -> int:
         """Dedup direct destinations across matched filters (aggre/1,
         emqx_broker.erl:408-424): one delivery per client, max granted
         QoS wins — then execute a cached fanout PLAN. Identical
         filter-sets share one plan (keyed by matched filters, not the
         topic: a wildcard's whole topic space reuses it), stamped with
-        the fanout generation and rebuilt lazily on mismatch after any
-        session/subscription mutation — the precomputed
-        ?SUBSCRIBER-bag read of emqx_broker.erl:726-760 rather than a
-        per-publish suboption scan."""
-        key = tuple(flt for flt, _ in pairs)
-        gen = self._fanout_gen
+        the build clock and rebuilt lazily when one of ITS filters
+        mutates — the precomputed ?SUBSCRIBER-bag read of
+        emqx_broker.erl:726-760 rather than a per-publish suboption
+        scan. Rebuilds above `_fanout_min_fan` run the device
+        dedup/max-QoS kernel (ops/fanout.py); host-resident filter sets
+        and small fans take the Python walk."""
+        tel = self.router.telemetry
         entry = self._fanout_cache.get(key)
-        if entry is not None and entry[0] == gen:
-            plan = entry[1]
-        else:
-            plan = self._build_fanout_plan(pairs)
-            self._fanout_cache_put(key, entry, gen, plan)
+        if entry is not None and self._plan_entry_fresh(entry, key):
+            if tel.enabled:
+                tel.count("fanout_plan_hits")
+            return self._fanout(msg, entry[1])
+        if tel.enabled:
+            tel.count("fanout_plan_stale" if entry is not None
+                      else "fanout_plan_misses")
+        clock = self._fanout_clock
+        plan = self._resolve_plan(key, pairs)
+        self._fanout_cache_put(key, entry, clock, plan)
         return self._fanout(msg, plan)
+
+    def _fanout_opts_lookup(self, flt: str, dest):
+        """The CSR store's live-suboption seam (lazy segment rebuild):
+        same reads as the oracle — suboptions for the word, sessions
+        for the registry note."""
+        opts = self.suboptions.get((flt, dest))
+        if opts is None:
+            return None
+        return opts, self.sessions.get(dest)
+
+    def _resolve_plan(self, key: tuple, pairs: Pairs) -> tuple:
+        """Build the (mem, other) plan for a matched filter set —
+        device kernel when eligible, else the host oracle walk. The
+        two are bit-identical by contract (churn-oracle-tested)."""
+        if self._fanout_device:
+            router = self.router
+            handle = router.resolve_fanout_begin(
+                key, min_fan=self._fanout_min_fan
+            )
+            if handle is not None:
+                return router.resolve_fanout_finish(handle)
+        return self._build_fanout_plan(pairs)
 
     def _build_fanout_plan(self, pairs: Pairs) -> tuple:
         """(mem_entries, other_entries): mem = live in-memory sessions

@@ -86,7 +86,10 @@ class ClusterBroker(Broker):
         if node is None:
             return super()._dispatch(msg, pairs)
         # local direct dests only — group election happens cluster-wide
-        n = self._dispatch_direct(msg, pairs)
+        pairs = pairs if isinstance(pairs, list) else list(pairs)
+        n = self._dispatch_direct(
+            msg, pairs, tuple(flt for flt, _ in pairs)
+        )
         if n:
             self.metrics.inc("messages.delivered", n)
         n += node.route_remote(msg)
@@ -97,7 +100,10 @@ class ClusterBroker(Broker):
         """Peer leg of a forward: deliver to LOCAL direct subscribers
         only — no re-forwarding, no shared election (the publisher
         already elected; emqx_broker:dispatch :472-480)."""
-        n = self._dispatch_direct(msg, self.router.match_pairs(msg.topic))
+        pairs = self.router.match_pairs(msg.topic)
+        n = self._dispatch_direct(
+            msg, pairs, tuple(flt for flt, _ in pairs)
+        )
         if n:
             self.metrics.inc("messages.delivered", n)
         return n

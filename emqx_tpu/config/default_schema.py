@@ -223,6 +223,18 @@ def broker_schema() -> Struct:
                                     "tpu_fanout_cache_size": Field(
                                         Int(min=1), default=4096
                                     ),
+                                    # device-resolved fanout
+                                    # (ops/fanout.py): plan-cache
+                                    # misses dedup on the TPU when the
+                                    # gathered fan reaches min_fan;
+                                    # below it the host walk is cheaper
+                                    # than a kernel dispatch
+                                    "tpu_fanout_enable": Field(
+                                        Bool(), default=True
+                                    ),
+                                    "tpu_fanout_min_fan": Field(
+                                        Int(min=0), default=1024
+                                    ),
                                 }
                             )
                         ),

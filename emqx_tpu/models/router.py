@@ -39,17 +39,31 @@ from ..obs.kernel_telemetry import (
     LEG_UNPACK,
     KernelTelemetry,
 )
+from ..ops import fanout as fanout_ops
 from ..ops import hash_index as hash_ops
 from ..ops import match as match_ops
 from ..ops import speedups as _speedups
 from ..ops import topic as topic_mod
 from ..ops.hash_index import ClassIndex, ClassMeta, SlotArrays
 from ..ops.host_index import TopicTrie
-from ..ops.table import EncodedFilters, FilterTable, FilterTooDeep
+from ..ops.table import (
+    EncodedFilters,
+    FilterTable,
+    FilterTooDeep,
+    pad_pow2_batches,
+)
 
 Dest = Hashable
 
 SYNC_BATCH_SIZE = 1024  # rows per scatter step (ref: ?MAX_BATCH_SIZE 1000)
+
+
+def _fanout_collect_marker(flt, dest) -> None:
+    """Placeholder on_dest_added planted around add_routes_core when no
+    external callback is set: the C core collects the first-appear pair
+    list only when the attribute is non-None, and the dest store feeds
+    from exactly that list. Never actually invoked (the python side
+    does all callback dispatch)."""
 
 
 def _next_pow2(n: int) -> int:
@@ -139,6 +153,15 @@ class DeviceTable:
         self._dev_meta: Optional[ClassMeta] = None
         self._dev_slots: Optional[SlotArrays] = None
         self._dev_residual: Optional[jnp.ndarray] = None
+        self.fanout: Optional[fanout_ops.FanoutDeviceState] = None
+
+    def attach_fanout(self, store: fanout_ops.DestStore) -> None:
+        """Mirror a CSR destination store on this device — the
+        resolve-side counterpart of the filter mirror, same sync
+        discipline (ops/fanout.FanoutDeviceState)."""
+        self.fanout = fanout_ops.FanoutDeviceState(
+            store, device=self.device, telemetry=self.telemetry
+        )
 
     def _put(self, a: np.ndarray) -> jnp.ndarray:
         a = np.ascontiguousarray(a)
@@ -167,22 +190,16 @@ class DeviceTable:
         elif ix.dirty_slots:
             dirty = np.unique(np.asarray(ix.dirty_slots, np.int32))
             ix.dirty_slots.clear()
-            total = len(dirty)
-            n_batches = _next_pow2(-(-total // SYNC_BATCH_SIZE))
-            idx = np.full(n_batches * SYNC_BATCH_SIZE, dirty[-1], np.int32)
-            idx[:total] = dirty
-            shape2 = (n_batches, SYNC_BATCH_SIZE)
+            idx = pad_pow2_batches(dirty, SYNC_BATCH_SIZE)
             self.telemetry.record_shape(
-                "_scatter_slots", (n_batches, len(ix.slots.fp))
+                "_scatter_slots", (idx.shape[0], len(ix.slots.fp))
             )
             self._dev_slots = _scatter_slots(
                 self._dev_slots,
-                jnp.asarray(idx.reshape(shape2)),
-                jnp.asarray(ix.slots.fp[idx].reshape(shape2)),
-                jnp.asarray(ix.slots.bucket[idx].reshape(shape2)),
-                jnp.asarray(
-                    ix.slots.probe[idx // hash_ops.BUCKET_W].reshape(shape2)
-                ),
+                jnp.asarray(idx),
+                jnp.asarray(ix.slots.fp[idx]),
+                jnp.asarray(ix.slots.bucket[idx]),
+                jnp.asarray(ix.slots.probe[idx // hash_ops.BUCKET_W]),
             )
         if ix.residual_dirty or self._dev_residual is None or (
             self._dev_residual.shape[0] != self.table.capacity
@@ -232,24 +249,21 @@ class DeviceTable:
             if self.index is not None:
                 self._sync_index()
             return 0, False
-        # pad to [n_batches, K]: idempotent padding rewrites the last row;
-        # n_batches rounds up to a power of two so recompiles stay
-        # log-bounded across workload sizes
-        n_batches = _next_pow2(-(-total // SYNC_BATCH_SIZE))
-        rows = np.full(n_batches * SYNC_BATCH_SIZE, dirty[-1], np.int32)
-        rows[:total] = dirty
-        shape2 = (n_batches, SYNC_BATCH_SIZE)
+        # pad to [n_batches, K] via the shared sync shape discipline
+        # (ops.table.pad_pow2_batches: idempotent padding, pow2 batch
+        # count so recompiles stay log-bounded)
+        rows = pad_pow2_batches(dirty, SYNC_BATCH_SIZE)
         self.telemetry.record_shape(
-            "_scatter_rows", (n_batches, t.capacity, t.max_levels)
+            "_scatter_rows", (rows.shape[0], t.capacity, t.max_levels)
         )
         self._dev = _scatter_rows(
             self._dev,
-            jnp.asarray(rows.reshape(shape2)),
-            jnp.asarray(t.words[rows].reshape(shape2 + (t.max_levels,))),
-            jnp.asarray(t.prefix_len[rows].reshape(shape2)),
-            jnp.asarray(t.has_hash[rows].reshape(shape2)),
-            jnp.asarray(t.root_wild[rows].reshape(shape2)),
-            jnp.asarray(t.active[rows].reshape(shape2)),
+            jnp.asarray(rows),
+            jnp.asarray(t.words[rows]),
+            jnp.asarray(t.prefix_len[rows]),
+            jnp.asarray(t.has_hash[rows]),
+            jnp.asarray(t.root_wild[rows]),
+            jnp.asarray(t.active[rows]),
         )
         if self.index is not None:
             self._sync_index()
@@ -383,6 +397,23 @@ class Router:
                 self.table, device=device, index=self.index,
                 telemetry=self.telemetry,
             )
+        # CSR destination store — the resolve half of the publish path
+        # (ops/fanout.py): one segment of (client, packed subopts)
+        # edges per table-resident filter row, fed by the same route
+        # transitions that maintain the dest dicts so segment order ==
+        # dict insertion order (the oracle's iteration order). Filters
+        # without a row (deep-trie / too-deep exacts) stay host-only and
+        # resolve_fanout_begin refuses them — identical escalation
+        # shape to the match path.
+        self.dest_store = fanout_ops.DestStore(
+            row_capacity=self.table.capacity
+        )
+        self.device_table.attach_fanout(self.dest_store)
+        # live-suboption seam for lazy segment rebuilds: the Broker
+        # installs `(flt, dest) -> (SubOpts, session) | None`; None
+        # (standalone routers) stores every client edge as SKIP, which
+        # matches the oracle (no suboption -> not in the plan)
+        self.fanout_opts_lookup = None
 
     @property
     def generation(self) -> int:
@@ -400,6 +431,119 @@ class Router:
         if self.match_cache is None or self.match_cache.capacity != capacity:
             self.match_cache = match_ops.GenMatchCache(capacity)
         return self.match_cache
+
+    # --- CSR dest-store feed (the device ?SUBSCRIBER mirror) ------------
+
+    def _fanout_row(self, flt: str) -> Optional[int]:
+        row = self._filter_row.get(flt)
+        if row is None:
+            row = self._exact_row.get(flt)
+        return row
+
+    def _fanout_added(self, flt: str, dest: Dest) -> None:
+        """First-appear route transition -> CSR edge append, in dest
+        dict order. Tuple dests (shared groups, cluster composites) are
+        stored client-less with the shared bit; str dests start SKIP
+        until the broker's fanout_note_opts upgrade arrives."""
+        row = self._fanout_row(flt)
+        if row is None:
+            return  # deep/host-resident filter: resolve falls back
+        ds = self.dest_store
+        ds.ensure_rows(self.table.capacity)
+        if isinstance(dest, str):
+            ds.add(row, dest, fanout_ops.SKIP_BIT, flt)
+        else:
+            ds.add(row, dest, fanout_ops.SHARED_BIT, flt)
+
+    def _fanout_add_batch(self, pairs_iter) -> None:
+        """Storm-path feed: first-appear pairs only MARK their rows
+        pending (~0.3us/route — the full eager segment bookkeeping cost
+        a measured 2.4x insert-RPS regression on the native add_routes
+        path). _fanout_flush rebuilds a pending row from its dest dict
+        the first time a resolve needs it."""
+        fr = self._filter_row
+        xr = self._exact_row
+        pending_add = self.dest_store.pending_rows.add
+        for flt, dest in pairs_iter:
+            row = fr.get(flt)
+            if row is None:
+                row = xr.get(flt)
+                if row is None:
+                    continue  # deep/host-resident: host fallback covers
+            pending_add(row)
+
+    def _fanout_flush(self, rows) -> None:
+        """Rebuild any pending segments among `rows` from their dest
+        dicts (dict order == oracle order) through the broker's live
+        suboption seam — the lazy half of the storm feed."""
+        ds = self.dest_store
+        pending = ds.pending_rows
+        if not pending:
+            return
+        lookup = self.fanout_opts_lookup
+        rf = self._row_filter
+        for row in rows:
+            if row in pending:
+                flt = rf[row]
+                ds.set_row(row, flt, self.filter_dests(flt), lookup)
+                pending.discard(row)
+
+    def _fanout_removed(self, flt: str, dest: Dest) -> None:
+        row = self._fanout_row(flt)
+        if row is not None:
+            self.dest_store.remove(row, dest)
+
+    def fanout_note_opts(self, flt: str, client: str, opts, session) -> None:
+        """Complete a subscribe on the CSR store: stamp the edge with
+        its live suboption word/object and track the session object for
+        the vectorized plan build. No-op for host-resident filters and
+        for routes the broker never subscribed (node dests)."""
+        row = self._fanout_row(flt)
+        if row is not None:
+            self.dest_store.set_opts(row, client, opts, session)
+
+    # --- device-resolved fanout (the aggre/1 kernel) --------------------
+
+    def resolve_fanout_begin(self, filters: Sequence[str], min_fan: int = 0):
+        """Launch the dedup/max-QoS plan kernel for one matched filter
+        set (in pairs order), or None when the set must resolve
+        host-side: a host-resident filter in the set, a fan below
+        `min_fan` (host walk is cheaper), an empty fan, or a fan beyond
+        the kernel's packing cap — the same escalate-to-host shape as
+        the match path's deep-trie leg."""
+        if not filters:
+            return None
+        rows = []
+        fr = self._filter_row
+        xr = self._exact_row
+        for f in filters:
+            row = fr.get(f)
+            if row is None:
+                row = xr.get(f)
+                if row is None:
+                    if self.telemetry.enabled:
+                        self.telemetry.count("fanout_host_fallback_total")
+                    return None
+            rows.append(row)
+        self._fanout_flush(rows)
+        fan = self.dest_store.fan_of(rows)
+        if fan < max(min_fan, 1) or fan > fanout_ops.MAX_FAN:
+            return None
+        return self.device_table.fanout.resolve_begin(rows, fan)
+
+    def resolve_fanout_finish(self, handle):
+        """Finish a begun resolve: fetch the winner edges, record the
+        dedup ratio, and materialize the oracle-ordered (mem, other)
+        plan — bit-identical to Broker._build_fanout_plan over the same
+        host state."""
+        win, fan = self.device_table.fanout.resolve_finish(handle)
+        tel = self.telemetry
+        if tel.enabled:
+            tel.count("fanout_device_plans_total")
+            tel.set_gauge(
+                "fanout_dedup_ratio", round(fan / max(1, len(win)), 6)
+            )
+        return self.dest_store.build_plan(win)
 
     # --- write path (emqx_router:do_add_route / do_delete_route) -------
 
@@ -441,8 +585,10 @@ class Router:
                     self._row_filter[row] = flt
                     if self.index is not None:
                         self.index.add_row(row, self.table)
-            if fresh and self.on_dest_added is not None:
-                self.on_dest_added(flt, dest)
+            if fresh:
+                self._fanout_added(flt, dest)
+                if self.on_dest_added is not None:
+                    self.on_dest_added(flt, dest)
             return
         dests = self._wild.get(flt)
         if dests is None and flt in self._deep:
@@ -465,8 +611,10 @@ class Router:
                     self.index.add_row(row, self.table)
         fresh = dest not in dests
         dests[dest] = dests.get(dest, 0) + 1
-        if fresh and self.on_dest_added is not None:
-            self.on_dest_added(flt, dest)
+        if fresh:
+            self._fanout_added(flt, dest)
+            if self.on_dest_added is not None:
+                self.on_dest_added(flt, dest)
 
     def add_routes(self, pairs: Sequence[Tuple[str, Dest]]) -> None:
         """Batched add_route — the router-syncer write path. The
@@ -505,9 +653,18 @@ class Router:
                 # bumping generations — detect growth and stamp here
                 d0 = len(t.dirty)
                 deep0 = len(self._deep) + len(self._exact_deep)
-                fresh, need_rebuild = sp.add_routes_core(
-                    self, pairs if isinstance(pairs, list) else list(pairs)
-                )
+                # the C core only collects first-appear pairs when a
+                # callback is visible; the dest store needs every one,
+                # so plant a marker for the duration of the call
+                on_added = self.on_dest_added
+                if on_added is None:
+                    self.on_dest_added = _fanout_collect_marker
+                try:
+                    fresh, need_rebuild = sp.add_routes_core(
+                        self, pairs if isinstance(pairs, list) else list(pairs)
+                    )
+                finally:
+                    self.on_dest_added = on_added
                 if len(t.dirty) != d0:
                     t.generation += 1
                 if len(self._deep) + len(self._exact_deep) != deep0:
@@ -515,9 +672,10 @@ class Router:
                 if need_rebuild:
                     ix._rebuild(ix.n_buckets * 2)
                 if fresh:
-                    on_added = self.on_dest_added
-                    for flt, dest in fresh:
-                        on_added(flt, dest)
+                    self._fanout_add_batch(fresh)
+                    if on_added is not None:
+                        for flt, dest in fresh:
+                            on_added(flt, dest)
                 return
         # pure-python path (no toolchain, or table needs growth):
         # scan — split each filter ONCE (the parts ride into add_bulk),
@@ -581,6 +739,8 @@ class Router:
             self.index.add_rows(idx_rows, self.table, idx_flts)
         # dest bookkeeping per pair (duplicates in the batch included)
         on_added = self.on_dest_added
+        fresh_pairs: List[Tuple[str, Dest]] = []
+        fp_append = fresh_pairs.append
         for (flt, dest), wild in zip(pairs, wildness):
             if not wild:
                 dests = exact_t[flt]
@@ -591,10 +751,13 @@ class Router:
             v = dests.get(dest)
             if v is None:
                 dests[dest] = 1
+                fp_append((flt, dest))
                 if on_added is not None:
                     on_added(flt, dest)
             else:
                 dests[dest] = v + 1
+        if fresh_pairs:
+            self._fanout_add_batch(fresh_pairs)
 
     def delete_routes(self, pairs: Sequence[Tuple[str, Dest]]) -> None:
         """Batched delete_route (the syncer's delete leg)."""
@@ -609,10 +772,12 @@ class Router:
             dests[dest] -= 1
             if dests[dest] == 0:
                 del dests[dest]
+                self._fanout_removed(flt, dest)
                 if not dests:
                     del self._exact[flt]
                     row = self._exact_row.pop(flt, None)
                     if row is not None:
+                        self.dest_store.free_row(row)
                         self._row_filter[row] = None
                         if self.index is not None:
                             self.index.remove_row(row)
@@ -634,6 +799,7 @@ class Router:
         if dests[dest]:
             return
         del dests[dest]
+        self._fanout_removed(flt, dest)
         if not dests:
             if deep:
                 del self._deep[flt]
@@ -642,6 +808,7 @@ class Router:
             else:
                 del self._wild[flt]
                 row = self._filter_row.pop(flt)
+                self.dest_store.free_row(row)
                 self._row_filter[row] = None
                 self._host_trie().remove(topic_mod.words(flt), row)
                 if self.index is not None:

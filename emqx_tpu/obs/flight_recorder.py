@@ -182,6 +182,8 @@ def default_rules(
     cache_collapse_ratio: float = 0.5,
     cache_min_lookups: int = 64,
     cache_cooldown: float = 60.0,
+    fanout_rebuild_rate: int = 64,
+    fanout_cooldown: float = 60.0,
 ) -> List[TriggerRule]:
     """The stock rule set; every threshold is a constructor knob so
     config/tests can tighten or disable individual rules."""
@@ -265,6 +267,29 @@ def default_rules(
             }
         return None
 
+    # fanout-plan rebuild storm: delta-based like the cache-collapse
+    # rule — a churn wave that keeps re-staling plans (misses + stale
+    # discards) fires on the rebuild RATE of this poll window, not the
+    # lifetime sum; per-filter stamps should make this rare, so a
+    # breach usually means something is thrashing one hot filter set
+    fanout_state = {"last": None}
+
+    def fanout_plan_storm(ctl: "FlightControl") -> Optional[Dict]:
+        tel = ctl.telemetry
+        if tel is None:
+            return None
+        cur = tel.counters.get("fanout_plan_misses", 0) + tel.counters.get(
+            "fanout_plan_stale", 0
+        )
+        last, fanout_state["last"] = fanout_state["last"], cur
+        if last is not None and cur - last >= fanout_rebuild_rate:
+            return {
+                "plan_rebuilds": cur - last,
+                "threshold": fanout_rebuild_rate,
+                "total": cur,
+            }
+        return None
+
     def slow_subs_breach(ctl: "FlightControl") -> Optional[Dict]:
         ss = ctl.slow_subs
         if ss is None:
@@ -283,6 +308,9 @@ def default_rules(
         # its whole duration — one bundle per window is the record,
         # more is noise
         TriggerRule("cache_hit_collapse", cache_hit_collapse, cache_cooldown),
+        # own cooldown for the same reason as cache_hit_collapse: one
+        # bundle per rebuild storm is the record, more is noise
+        TriggerRule("fanout_plan_storm", fanout_plan_storm, fanout_cooldown),
         TriggerRule("slow_subs_breach", slow_subs_breach, cooldown),
         # event-driven (fired by the Alarms listener, never polled);
         # registered so its cooldown is declared alongside the rest

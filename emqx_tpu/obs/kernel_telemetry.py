@@ -78,6 +78,14 @@ LEG_ENCODE = "encode"  # host: topic dictionary-encode
 LEG_UNPACK = "unpack"  # host: candidate verify + dest expansion
 LEG_SYNC = "sync"  # DeviceTable delta scatter / full upload
 
+# The device-resolved fanout leg (ops/fanout.py) reports through the
+# same surfaces rather than a dedicated series here: resolve latency as
+# the standalone family `emqx_xla_fanout_resolve_seconds`
+# (observe_family), plan-cache traffic as the
+# `fanout_plan_{hits,misses,stale}` / `fanout_device_plans_total` /
+# `fanout_host_fallback_total` counters, and the last resolve's
+# fan-to-plan compression as the `fanout_dedup_ratio` gauge.
+
 
 class StreamingHistogram:
     """Fixed-bucket streaming latency histogram (seconds).

@@ -38,6 +38,26 @@ DEFAULT_MAX_LEVELS = 16
 MIN_CAPACITY = 1024
 
 
+def next_pow2(n: int) -> int:
+    """Smallest power of two >= n (1 for n <= 1)."""
+    return 1 << max(0, n - 1).bit_length()
+
+
+def pad_pow2_batches(dirty: np.ndarray, k: int) -> np.ndarray:
+    """Shape a drained dirty-index array for the batched scatter sync:
+    [n_batches, k] int32 with idempotent padding (the last real index
+    repeats, so padding rewrites one row it already wrote) and
+    n_batches rounded up to a power of two, keeping recompiles
+    log-bounded across workload sizes. The one shape discipline every
+    device mirror (filter rows, cuckoo slots, fanout segments/edges)
+    shares."""
+    total = len(dirty)
+    n_batches = next_pow2(-(-total // k))
+    idx = np.full(n_batches * k, dirty[-1], np.int32)
+    idx[:total] = dirty
+    return idx.reshape(n_batches, k)
+
+
 class FilterTooDeep(ValueError):
     """Filter has more non-'#' levels than the table's max_levels."""
 

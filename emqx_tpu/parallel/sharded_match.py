@@ -432,6 +432,18 @@ class ShardedDeviceTable:
         self._apply_slot_delta = (
             make_slot_delta_kernel(mesh) if index is not None else None
         )
+        self.fanout = None
+
+    def attach_fanout(self, store) -> None:
+        """Mirror a CSR destination store on the mesh (replicated: the
+        fan tables are small next to the sub-sharded filter state, and
+        every shard needs every segment) — the same resolve begin/
+        finish surface as the single-device DeviceTable."""
+        from ..ops.fanout import FanoutDeviceState
+
+        self.fanout = FanoutDeviceState(
+            store, mesh=self.mesh, telemetry=self.telemetry
+        )
 
     def _match_kernel(self, mh: int):
         k = self._match_ids_cache.get(mh)

@@ -180,6 +180,33 @@ def test_cache_hit_collapse_rule_fires_on_sudden_drop(tmp_path):
         obs.stop()
 
 
+def test_fanout_plan_storm_rule_fires_on_rebuild_rate(tmp_path):
+    b, obs = make(tmp_path)
+    fl = obs.flight
+    try:
+        tel = b.router.telemetry
+        fl.evaluate()  # seed the delta base
+        # healthy window: plans mostly hit, a few rebuilds — no trigger
+        tel.count("fanout_plan_hits", 500)
+        tel.count("fanout_plan_misses", 5)
+        assert fl.evaluate() == []
+        # churn storm: this window rebuilds plans continuously (stale
+        # discards count too — a hot filter set being re-stamped)
+        tel.count("fanout_plan_stale", 40)
+        tel.count("fanout_plan_misses", 40)
+        paths = fl.evaluate()
+        assert len(paths) == 1 and "fanout_plan_storm" in paths[0]
+        with open(paths[0]) as f:
+            bundle = json.load(f)
+        assert bundle["details"]["plan_rebuilds"] == 80
+        # its own cooldown: the sustained storm yields ONE bundle
+        tel.count("fanout_plan_stale", 200)
+        assert fl.evaluate() == []
+        assert fl.triggers_total["fanout_plan_storm"] == 1
+    finally:
+        obs.stop()
+
+
 def test_cache_rule_ignores_small_windows(tmp_path):
     b, obs = make(tmp_path)
     fl = obs.flight

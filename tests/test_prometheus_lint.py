@@ -227,6 +227,55 @@ async def test_pipeline_and_cache_families_lint():
     assert int(count_line.rsplit(" ", 1)[1]) == buckets[-1] == 16
 
 
+def test_fanout_families_lint():
+    # ISSUE-4 families: the device-resolved fanout counters, dedup
+    # gauge, and resolve-latency histogram must ride the same scrape,
+    # driven through a REAL device resolve (not hand-poked counters)
+    broker = Broker()
+    broker._fanout_min_fan = 0
+    for i in range(12):
+        s, _ = broker.open_session(f"f{i}", clean_start=True)
+        s.outgoing_sink = lambda pkts: None
+        broker.subscribe(s, "fo/+/v", SubOpts(qos=i % 3))
+        if i < 6:
+            broker.subscribe(s, "fo/#", SubOpts(qos=2))
+    broker.publish(Message(topic="fo/1/v", payload=b"x"))  # miss -> device
+    broker.publish(Message(topic="fo/1/v", payload=b"x"))  # hit
+    s, _ = broker.open_session("late", clean_start=True)
+    s.outgoing_sink = lambda pkts: None
+    broker.subscribe(s, "fo/#", SubOpts(qos=0))
+    broker.publish(Message(topic="fo/1/v", payload=b"x"))  # stale -> device
+    text = prometheus_text(broker, "n1@host")
+    types = _lint(text)
+    for fam, kind in (
+        ("emqx_xla_fanout_plan_hits", "counter"),
+        ("emqx_xla_fanout_plan_misses", "counter"),
+        ("emqx_xla_fanout_plan_stale", "counter"),
+        ("emqx_xla_fanout_device_plans_total", "counter"),
+        ("emqx_xla_fanout_dedup_ratio", "gauge"),
+        ("emqx_xla_fanout_resolve_seconds", "histogram"),
+    ):
+        assert types.get(fam) == kind, f"{fam}: {types.get(fam)}"
+    # the resolve histogram observed one sample per device plan
+    count_line = next(
+        l for l in text.splitlines()
+        if l.startswith("emqx_xla_fanout_resolve_seconds_count")
+    )
+    plans_line = next(
+        l for l in text.splitlines()
+        if l.startswith("emqx_xla_fanout_device_plans_total")
+    )
+    assert int(count_line.rsplit(" ", 1)[1]) == int(
+        plans_line.rsplit(" ", 1)[1]
+    ) >= 2
+    # dedup ratio reflects the overlapping-filter fan (> 1 client/plan)
+    ratio_line = next(
+        l for l in text.splitlines()
+        if l.startswith("emqx_xla_fanout_dedup_ratio")
+    )
+    assert float(ratio_line.rsplit(" ", 1)[1]) > 1.0
+
+
 def test_null_telemetry_scrape_stays_clean():
     from emqx_tpu.obs.kernel_telemetry import NULL
 
