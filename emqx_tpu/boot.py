@@ -255,6 +255,15 @@ class Node:
             config=cfg,
         )
         self.obs.start(cfg.get("sys_topics.sys_heartbeat_interval") / 1000.0)
+        if self.obs.sentinel is not None:
+            st = self.obs.sentinel
+            log.info(
+                "publish sentinel attached: audit 1/%s%s, slo publish "
+                "p99 %sms",
+                st.sample_n or "off",
+                " +quarantine" if st.quarantine_enabled else "",
+                st.slo_publish_ms,
+            )
 
         # 7. cluster membership + DS replication
         seeds = cfg.get("cluster.static_seeds")

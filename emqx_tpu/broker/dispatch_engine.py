@@ -110,7 +110,12 @@ class DispatchEngine:
         assert not self.closed, "dispatch engine stopped"
         loop = asyncio.get_running_loop()
         fut = loop.create_future()
-        self._queue.append((msg, fut, self.telemetry.clock()))
+        # publish sentinel (obs/sentinel.py): a 1/sample_n publish gets
+        # a stage span + a deferred shadow-oracle audit; every other
+        # publish pays one attribute read + one counter increment
+        st = self.broker.sentinel
+        span = st.maybe_span(msg) if st is not None else None
+        self._queue.append((msg, fut, self.telemetry.clock(), span))
         if len(self._queue) >= self.queue_depth:
             self._flush()
         elif self._timer is None:
@@ -136,18 +141,24 @@ class DispatchEngine:
         batch, self._queue = self._queue, []
         tel = self.telemetry
         broker = self.broker
+        st = broker.sentinel
         now = tel.clock()
         entries = []
         topics = []
-        for msg, fut, t_in in batch:
+        bspan = None
+        for msg, fut, t_in, span in batch:
             tel.observe_family("pipeline_queue_wait_seconds", now - t_in)
+            if span is not None:
+                span.add("queue", now - t_in)
+                if bspan is None and st is not None:
+                    bspan = st.batch_span()
             live = broker._pre_publish(msg)
-            entries.append((live, fut))
+            entries.append((live, fut, span))
             if live is not None:
                 topics.append(live.topic)
         self.batches_total += 1
         self.publishes_total += len(batch)
-        pending = self.router.match_filters_begin(topics)
+        pending = self.router.match_filters_begin(topics, span=bspan)
         # device-resolved fanout overlap: topics the match cache
         # answered at begin time have known filter sets NOW — launch
         # their plan resolves immediately so the deduped plan
@@ -174,7 +185,7 @@ class DispatchEngine:
                     fanout_pending.append(
                         (fkey, broker._fanout_clock, h)
                     )
-        self._inflight.append((pending, entries, fanout_pending))
+        self._inflight.append((pending, entries, fanout_pending, bspan))
         tel.set_gauge("pipeline_depth", len(self._inflight))
         tel.set_gauge("pipeline_coalesce", len(batch))
         while len(self._inflight) > self.pipeline_depth:
@@ -191,13 +202,15 @@ class DispatchEngine:
 
     def _collect_one(self) -> None:
         """Fetch + deliver the OLDEST in-flight batch (begin order)."""
-        pending, entries, fanout_pending = self._inflight.popleft()
+        pending, entries, fanout_pending, bspan = self._inflight.popleft()
         broker = self.broker
         router = self.router
+        st = broker.sentinel
+        tclock = self.telemetry.clock
         try:
             filter_lists = router.match_filters_finish(pending)
         except Exception as e:  # a failed batch fails its publishers,
-            for _live, fut in entries:  # never wedges the pipeline
+            for _live, fut, _span in entries:  # never wedges the pipeline
                 if not fut.done():
                     fut.set_exception(e)
             return
@@ -206,26 +219,43 @@ class DispatchEngine:
             # with the clock captured at begin, so a mutation that
             # landed mid-flight leaves them stale-on-arrival and the
             # dispatch below rebuilds — exactness over hit ratio
+            t_res = tclock() if bspan is not None else 0.0
             for fkey, clock, h in fanout_pending:
                 try:
                     plan = router.resolve_fanout_finish(h)
                 except Exception:
                     continue  # the dispatch path rebuilds host-side
                 broker._store_plan(fkey, clock, plan)
+            if bspan is not None:
+                bspan.add("resolve", tclock() - t_res)
         fd = router.filter_dests
         it = iter(filter_lists)
-        for live, fut in entries:
+        for live, fut, span in entries:
             if live is None:
                 n = 0  # hook-denied / intercepted: same 0 as publish()
             else:
+                flts = next(it)
+                pairs = [(f, fd(f)) for f in flts]
+                t_del = tclock() if span is not None else 0.0
                 try:
-                    n = broker._dispatch(
-                        live, [(f, fd(f)) for f in next(it)]
-                    )
+                    n = broker._dispatch(live, pairs)
                 except Exception as e:
                     if not fut.done():
                         fut.set_exception(e)
                     continue
+                if span is not None and st is not None:
+                    span.add("deliver", tclock() - t_del)
+                    if bspan is not None:
+                        span.merge(bspan)
+                    st.finish_span(span)
+                    # shadow-oracle audit of exactly what was served:
+                    # the matched filter set + the (filter, dests)
+                    # pairs, stamped with the begin generation so churn
+                    # mid-flight skips rather than false-positives
+                    st.capture_audit(
+                        live.topic, tuple(flts), pairs, pending.gen,
+                        span.trace_id,
+                    )
             if not fut.done():
                 fut.set_result(n)
 

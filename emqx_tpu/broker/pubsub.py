@@ -115,6 +115,10 @@ class Broker:
         # pipelined micro-batching dispatcher; attach with
         # enable_dispatch_engine() (broker/dispatch_engine.py)
         self.engine = None
+        # publish sentinel (obs/sentinel.py): shadow-oracle audit +
+        # per-stage latency attribution + SLO burn alarms. None is the
+        # probe-free default — the engine pays one attribute read
+        self.sentinel = None
 
     def enable_dispatch_engine(self, **kw):
         """Attach a DispatchEngine (pipelined async publish path):
@@ -349,10 +353,30 @@ class Broker:
         """Single-message cut-through (host trie). Returns deliveries."""
         if self.tracer is not None:
             return self._publish_traced(msg)
+        # publish sentinel seam: the sync path matches host-side, but
+        # the fanout PLAN it executes may be device-resolved — sampled
+        # publishes audit that plan (and feed deliver-stage/SLO
+        # attribution). Unsampled cost: one attribute read; one
+        # counter tick when a sentinel is attached.
+        st = self.sentinel
+        span = st.maybe_span(msg) if st is not None else None
         msg = self._pre_publish(msg)
         if msg is None:
             return 0
-        return self._dispatch(msg, self.router.match_pairs(msg.topic))
+        if span is None:
+            return self._dispatch(msg, self.router.match_pairs(msg.topic))
+        clock = self.router.telemetry.clock
+        gen = self.router.generation
+        pairs = self.router.match_pairs(msg.topic)
+        t0 = clock()
+        n = self._dispatch(msg, pairs)
+        span.add("deliver", clock() - t0)
+        st.finish_span(span)
+        st.capture_audit(
+            msg.topic, tuple(f for f, _ in pairs), pairs, gen,
+            span.trace_id,
+        )
+        return n
 
     def _publish_traced(self, msg: Message) -> int:
         """The external-trace leg (emqx_external_trace.erl:29-123 /

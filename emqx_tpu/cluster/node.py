@@ -305,6 +305,62 @@ class ClusterNode:
                 },
             },
         )
+        reg.register_all(
+            "sentinel",
+            1,
+            {
+                # per-node audit/SLO verdicts for the cluster rollup
+                # (obs/sentinel.py): one node's /api/v5/xla/sentinel
+                # can report cluster-wide state
+                "status": self._handle_sentinel_status,
+            },
+        )
+
+    # --- sentinel rollup (cluster-wide audit/SLO view) --------------------
+
+    def _handle_sentinel_status(self) -> dict:
+        st = getattr(self.broker, "sentinel", None)
+        if st is None:
+            return {"enabled": False}
+        return st.summary()
+
+    async def sentinel_rollup(self) -> dict:
+        """Fan the sentinel summary call across the membership and
+        aggregate: total audits/divergences, worst publish p99, and
+        whether ANY node is burning an SLO — the one-stop view an
+        operator polls to answer 'is the cluster's served path clean'."""
+        nodes = {self.node_id: self._handle_sentinel_status()}
+        members = list(self.membership.members.items())
+        if members:
+            results = await self.rpc.multicall(
+                [addr for _n, addr in members], "sentinel", "status"
+            )
+            for (node, _addr), res in zip(members, results):
+                nodes[node] = (
+                    {"error": str(res)} if isinstance(res, Exception) else res
+                )
+        agg = {
+            "nodes": len(nodes),
+            "unreachable": sum(1 for v in nodes.values() if "error" in v),
+            "audit_total": 0,
+            "audit_divergence": 0,
+            "quarantined_filters": 0,
+            "worst_publish_p99_ms": 0.0,
+            "slo_breached": [],
+        }
+        for node, v in nodes.items():
+            if "error" in v or not v.get("enabled"):
+                continue
+            agg["audit_total"] += v.get("audit_total", 0)
+            agg["audit_divergence"] += v.get("audit_divergence", 0)
+            agg["quarantined_filters"] += v.get("quarantined_filters", 0)
+            agg["worst_publish_p99_ms"] = max(
+                agg["worst_publish_p99_ms"], v.get("publish_p99_ms", 0.0)
+            )
+            for name, s in (v.get("slo") or {}).items():
+                if s.get("breached"):
+                    agg["slo_breached"].append(f"{node}:{name}")
+        return {"cluster": agg, "per_node": nodes}
 
     # --- route write stream (local transitions -> announced ops) ---------
 

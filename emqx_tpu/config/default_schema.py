@@ -235,6 +235,42 @@ def broker_schema() -> Struct:
                                     "tpu_fanout_min_fan": Field(
                                         Int(min=0), default=1024
                                     ),
+                                    # publish sentinel (obs/sentinel):
+                                    # 1/sample_n served publishes get a
+                                    # stage span + a deferred
+                                    # shadow-oracle audit (0 disables
+                                    # sampling); quarantine moves
+                                    # diverging filters to the
+                                    # host-walk fallback until the next
+                                    # clean table sync
+                                    "tpu_audit_sample_n": Field(
+                                        Int(min=0), default=1024
+                                    ),
+                                    "tpu_audit_quarantine": Field(
+                                        Bool(), default=True
+                                    ),
+                                    # SLO objectives: publish-latency
+                                    # threshold + success targets, with
+                                    # fast/slow burn-rate windows (the
+                                    # multiwindow alerting shape)
+                                    "tpu_slo_publish_p99_ms": Field(
+                                        Float(), default=50.0
+                                    ),
+                                    "tpu_slo_publish_target": Field(
+                                        Float(), default=0.999
+                                    ),
+                                    "tpu_slo_audit_target": Field(
+                                        Float(), default=0.999
+                                    ),
+                                    "tpu_slo_fast_window_s": Field(
+                                        Float(), default=300.0
+                                    ),
+                                    "tpu_slo_slow_window_s": Field(
+                                        Float(), default=3600.0
+                                    ),
+                                    "tpu_slo_burn_threshold": Field(
+                                        Float(), default=10.0
+                                    ),
                                 }
                             )
                         ),

@@ -367,6 +367,9 @@ class ManagementApi:
         # kernel telemetry reads the router's always-on collector, so
         # it is live even without the obs bundle wired
         r("GET", "/api/v5/xla/telemetry", self._xla_telemetry)
+        # publish sentinel: audit verdicts, stage attribution, SLO burn
+        # state; ?cluster=true rolls the whole membership up over RPC
+        r("GET", "/api/v5/xla/sentinel", self._xla_sentinel)
         r("GET", "/api/v5/audit", self._audit_list)
         r("GET", "/api/v5/file_transfer/files", self._ft_files)
         r("GET", "/api/v5/gateways", self._gateways_list)
@@ -1315,7 +1318,25 @@ class ManagementApi:
         tel = getattr(self.broker.router, "telemetry", None)
         if tel is None:
             return {"enabled": False}
-        return tel.snapshot()
+        out = tel.snapshot()
+        st = getattr(self.broker, "sentinel", None)
+        if st is not None:
+            # per-stage publish attribution + exemplar topic/trace ids
+            # for the sampled publishes (obs/sentinel.py)
+            out["publish_stages"] = st.stage_snapshot()
+        return out
+
+    def _xla_sentinel(self, req: Request):
+        """GET /api/v5/xla/sentinel — the publish-path watchdog state:
+        shadow-audit counters + recent divergences, quarantine set,
+        stage histograms, SLO burn rates. `?cluster=true` aggregates
+        every member over the sentinel RPC protocol."""
+        st = getattr(self.broker, "sentinel", None)
+        if req.query.get("cluster") == "true" and self.node is not None:
+            return self.node.sentinel_rollup()  # coroutine: awaited
+        if st is None:
+            return {"enabled": False}
+        return st.status()
 
     def _alarms_list(self, req: Request):
         which = "all"

@@ -116,6 +116,61 @@ class Ctl:
             self._flight,
             "flight status | events [n] | snapshot [reason] | snapshots",
         )
+        reg(
+            "sentinel",
+            self._sentinel,
+            "sentinel status | audit | slo | stages | exemplars",
+        )
+
+    def _sentinel(self, args) -> str:
+        """emqx ctl sentinel — publish-path watchdog: shadow-audit
+        verdicts, quarantine state, stage p99s, SLO burn rates
+        (obs/sentinel.py)."""
+        st = getattr(self.broker, "sentinel", None)
+        if st is None:
+            return "publish sentinel not attached"
+        sub = args[0] if args else "status"
+        full = st.status()
+        if sub == "status":
+            lines = [
+                f"{'sampling':<22}: 1/{st.sample_n}" if st.sample_n
+                else f"{'sampling':<22}: off",
+                f"{'quarantine':<22}: "
+                f"{'on' if full['quarantine_enabled'] else 'off'}"
+                f" ({len(full['quarantined_filters'])} filters held)",
+            ]
+            a = full["audit"]
+            lines.append(
+                f"{'audit':<22}: {a['total']} checked, {a['clean']} clean, "
+                f"{a['divergence']} diverged, {a['skipped_stale']} skipped"
+            )
+            for name, s in full["slo"].items():
+                if not isinstance(s, dict):
+                    continue
+                lines.append(
+                    f"{'slo ' + name:<22}: fast {s['fast_burn']}x / "
+                    f"slow {s['slow_burn']}x"
+                    f"{' BREACHED' if s['breached'] else ''}"
+                )
+            return "\n".join(lines)
+        if sub == "audit":
+            return json.dumps(full["audit"], indent=1, default=str)
+        if sub == "slo":
+            return json.dumps(full["slo"], indent=1, default=str)
+        if sub == "stages":
+            stages = full["stages"]["stages"]
+            out = [f"sampled publishes: {full['stages']['sampled_publishes']}"]
+            for stage, snap in stages.items():
+                out.append(
+                    f"{stage:<10}: p50 {snap['p50_ms']}ms  "
+                    f"p99 {snap['p99_ms']}ms  (n={snap['count']})"
+                )
+            return "\n".join(out)
+        if sub == "exemplars":
+            return json.dumps(
+                full["stages"]["exemplars"], indent=1, default=str
+            )
+        raise ValueError(f"bad subcommand {sub!r}")
 
     def _flight(self, args) -> str:
         """emqx ctl flight — black-box recorder status, ring tail,

@@ -291,6 +291,8 @@ class _PendingMatch:
         "gen",          # router generation captured before the kernels
         "full_out",     # full-batch skeleton when the match cache fronted it
         "sub_idx",      # index of each sub-topic within the original batch
+        "span",         # sentinel StageSpan (or None): per-stage publish
+                        # latency attribution for sampled batches
         "hash_dev",     # (ti, bi, total, amb) device arrays (1-dev hash)
         "hash_mh",      # max_hits the hash kernel launched with
         "hash_shape",   # shape key sans max_hits (escalated re-dispatch)
@@ -414,6 +416,13 @@ class Router:
         # (standalone routers) stores every client edge as SKIP, which
         # matches the oracle (no suboption -> not in the plan)
         self.fanout_opts_lookup = None
+        # shadow-audit quarantine (obs/sentinel.py): filters whose
+        # device rows diverged from the host oracle. While quarantined
+        # a filter is answered by the host walk (overlay in
+        # match_filters_finish, refusal in resolve_fanout_begin); its
+        # row is re-marked dirty so the next table sync rewrites device
+        # state from host truth, which auto-unquarantines (counted).
+        self._quarantined: Dict[str, Optional[int]] = {}
 
     @property
     def generation(self) -> int:
@@ -431,6 +440,100 @@ class Router:
         if self.match_cache is None or self.match_cache.capacity != capacity:
             self.match_cache = match_ops.GenMatchCache(capacity)
         return self.match_cache
+
+    # --- shadow-audit quarantine (obs/sentinel.py) ----------------------
+
+    def quarantine_filters(self, filters: Sequence[str]) -> int:
+        """Move `filters` to the host-walk fallback: the batched match
+        path overlays their answers from the host state and the fanout
+        kernel refuses their rows, until the next table sync rewrites
+        the rows from host truth. Returns newly quarantined count."""
+        tel = self.telemetry
+        added = 0
+        for f in filters:
+            if f in self._quarantined:
+                continue
+            row = self._fanout_row(f)
+            self._quarantined[f] = row
+            if row is not None:
+                # force a device rewrite of this row at the next sync —
+                # content is unchanged host-side, so no generation bump
+                # from the table itself
+                self.table.dirty.append(row)
+                # dest segment rebuilds from the dest dict at the next
+                # resolve (post-unquarantine), through the live
+                # suboption seam — same lazy path as the storm feed
+                self.dest_store.pending_rows.add(row)
+            added += 1
+        if added:
+            # cached match results were populated from the now-suspect
+            # device output: stale them all via the aux generation
+            self._aux_gen += 1
+            # the divergence localizes to filters, not to WHICH device
+            # array decayed — re-upload the whole hash-index device
+            # state (meta + slots + residual mask) at the next sync,
+            # not just the row scatter, so a corrupt slot table heals
+            # too. Full index upload is the route-churn rebuild path,
+            # so the cost is bounded and already shape-stable.
+            ix = self.index
+            if ix is not None:
+                ix.meta_dirty = True
+                ix.rebuilt = True
+                ix.residual_dirty = True
+            if tel.enabled:
+                tel.count("audit_quarantine_total", added)
+                tel.set_gauge(
+                    "audit_quarantined_filters", len(self._quarantined)
+                )
+        return added
+
+    def quarantined_filters(self) -> List[str]:
+        return sorted(self._quarantined)
+
+    def _quarantine_overlay(
+        self, topics: Sequence[str], out: List[List[str]]
+    ) -> None:
+        """Rewrite kernel answers for quarantined filters from host
+        truth: a filter the device wrongly dropped is re-added, one it
+        wrongly surfaced is removed. Runs only while the quarantine set
+        is non-empty — the steady-state cost is one falsy test in
+        match_filters_finish. Covers batches LAUNCHED against the
+        corrupt table that finish after the audit quarantined it (the
+        pipeline's in-flight window)."""
+        q = []
+        for f in self._quarantined:
+            routed = (
+                f in self._wild or f in self._deep or f in self._exact
+            )
+            q.append((f, topic_mod.words(f), routed))
+        served = 0
+        for i, t in enumerate(topics):
+            tw = topic_mod.words(t)
+            lst = out[i]
+            for f, fw, routed in q:
+                hit = routed and topic_mod.match(tw, fw)
+                if hit and f not in lst:
+                    lst.append(f)
+                elif not hit and f in lst:
+                    lst.remove(f)
+            served += 1
+        tel = self.telemetry
+        if tel.enabled and served:
+            tel.count("audit_quarantine_overlay_total", served)
+
+    def _maybe_unquarantine(self) -> None:
+        """Called after a device sync: once the dirtied rows drained,
+        the device rows were rewritten from host truth — the clean
+        table sync that ends the quarantine."""
+        if self.table.dirty:
+            return  # quarantined rows not yet synced (mid-storm)
+        n = len(self._quarantined)
+        self._quarantined.clear()
+        self._aux_gen += 1
+        tel = self.telemetry
+        if tel.enabled:
+            tel.count("audit_unquarantine_total", n)
+            tel.set_gauge("audit_quarantined_filters", 0)
 
     # --- CSR dest-store feed (the device ?SUBSCRIBER mirror) ------------
 
@@ -513,6 +616,17 @@ class Router:
         the match path's deep-trie leg."""
         if not filters:
             return None
+        if self._quarantined:
+            # a quarantined filter's dest segment is suspect: the whole
+            # set resolves host-side until the clean sync clears it
+            for f in filters:
+                if f in self._quarantined:
+                    if self.telemetry.enabled:
+                        self.telemetry.count("fanout_host_fallback_total")
+                        self.telemetry.count(
+                            "audit_quarantine_resolve_refusals_total"
+                        )
+                    return None
         rows = []
         fr = self._filter_row
         xr = self._exact_row
@@ -950,7 +1064,9 @@ class Router:
             dests.update(dmap)
         return dests
 
-    def match_filters_begin(self, topics: Sequence[str]) -> _PendingMatch:
+    def match_filters_begin(
+        self, topics: Sequence[str], span=None
+    ) -> _PendingMatch:
         """Phase 1 of the pipelined batched match: probe the
         generation-stamped match cache, sync the device table, encode
         the uncached remainder, and LAUNCH the match kernels without
@@ -960,10 +1076,16 @@ class Router:
         double-buffering seam broker/dispatch_engine pipelines through.
         Every begin() must be finished exactly once, in begin order, by
         match_filters_finish; match_filters_batch composes the two for
-        the synchronous path, so results are bit-identical either way."""
+        the synchronous path, so results are bit-identical either way.
+
+        `span` is the sentinel's per-batch StageSpan (obs/sentinel.py):
+        when a sampled publish rides this batch, begin/finish attribute
+        their encode/kernel/fetch time into it; None (every unsampled
+        batch) costs a handful of is-None tests."""
         tel = self.telemetry
         clock = tel.clock
         p = _PendingMatch()
+        p.span = span
         p.gen = self.generation
         cache = self.match_cache
         if cache is not None and topics:
@@ -1002,12 +1124,17 @@ class Router:
             root.set("batch", len(sub))
         p.root = root
         self.device_table.sync()
+        if self._quarantined:
+            self._maybe_unquarantine()
         sp = tel.span("xla.encode", root)
         t0 = clock()
         p.enc = enc = match_ops.encode_topics(
             self.table.vocab, sub, self.max_levels
         )
-        tel.record_dispatch(LEG_ENCODE, clock() - t0)
+        enc_dt = clock() - t0
+        tel.record_dispatch(LEG_ENCODE, enc_dt)
+        if span is not None:
+            span.add("encode", enc_dt)
         tel.end_span(sp)
         # exact topics are device rows (wildcard-free classes), so the
         # kernel surfaces them; only too-deep exacts need the host dict
@@ -1019,7 +1146,10 @@ class Router:
         if self.mesh is not None and ix is None:
             # dense-only mesh path (use_hash_index=False)
             p.mode = "mesh_dense"
+            t0 = clock()
             p.mesh_pending = self.device_table.match_ids_begin(enc)
+            if span is not None:
+                span.add("kernel", clock() - t0)
             return p
         if ix is not None:
             p.mode = "hash"
@@ -1061,6 +1191,8 @@ class Router:
                     p.residual_pending = (
                         "single", dev, mh, shape, filters, clock() - t0,
                     )
+            if span is not None and p.hash_elapsed is not None:
+                span.add("kernel", p.hash_elapsed)
             return p
         p.mode = "dense"
         filters = self.device_table.filters()
@@ -1073,6 +1205,8 @@ class Router:
         p.dense_shape = shape
         p.dense_filters = filters
         p.dense_elapsed = clock() - t0
+        if span is not None:
+            span.add("kernel", p.dense_elapsed)
         return p
 
     def match_filters_finish(self, p: _PendingMatch) -> List[List[str]]:
@@ -1086,6 +1220,8 @@ class Router:
         clock = tel.clock
         out = p.out
         topics = p.topics
+        span = p.span
+        t_fetch = clock() if span is not None else 0.0
         if p.mode == "mesh_dense":
             root = p.root
             sp = tel.span("xla.dispatch", root)
@@ -1220,7 +1356,13 @@ class Router:
             if self._deep:
                 for i, t in enumerate(topics):
                     out[i].extend(self._deep_trie.match(topic_mod.words(t)))
+            if self._quarantined and out:
+                self._quarantine_overlay(topics, out)
             tel.end_span(p.root)
+        if span is not None:
+            # fetch = everything finish forces: device->host transfer,
+            # overflow escalation, verify/unpack, deep-trie fold
+            span.add("fetch", clock() - t_fetch)
         if p.full_out is None:
             return out if out is not None else []
         # merge the kernel results into the cached prefix and stamp the

@@ -19,7 +19,11 @@
   * flight_recorder — anomaly-triggered black-box: always-on event
                  ring over broker hooks + device legs + bridges +
                  alarms, trigger rules, rotated snapshot bundles
-                 (the sys_mon/trace-download diagnostics analog).
+                 (the sys_mon/trace-download diagnostics analog);
+  * sentinel   — publish-path watchdog: shadow-oracle audit of served
+                 device results, per-stage latency attribution, SLO
+                 burn-rate alarms (obs/sentinel.py — the served-path
+                 correctness leg the bench/test oracles can't cover).
 
 `Observability` bundles the per-broker pieces and installs the hook
 taps, the emqx_sup-analog wiring.
@@ -44,6 +48,7 @@ from .kernel_telemetry import (  # noqa: F401
     StreamingHistogram,
 )
 from .prometheus import prometheus_text  # noqa: F401
+from .sentinel import PublishSentinel, SloObjective, StageSpan  # noqa: F401
 from .slow_subs import SlowSubs  # noqa: F401
 from .sys import SysHeartbeat  # noqa: F401
 from .topic_metrics import TopicMetrics  # noqa: F401
@@ -60,6 +65,7 @@ class Observability:
         slow_top_k: int = 10,
         flight: bool = True,
         flight_dir: Optional[str] = None,
+        sentinel: bool = True,
         config=None,
     ):
         self.broker = broker
@@ -86,6 +92,42 @@ class Observability:
                 node_name=node_name,
             )
             self.flight.install()
+        # publish sentinel: attached alongside the kernel-telemetry
+        # collector so every booted node audits its own served path.
+        # Knobs ride broker.perf.* when a config is wired; the
+        # constructor defaults serve the bare test/bench brokers.
+        self.sentinel: Optional[PublishSentinel] = None
+        if sentinel:
+            self.sentinel = PublishSentinel(
+                broker,
+                sample_n=_cfg(
+                    config, "broker.perf.tpu_audit_sample_n", 1024
+                ),
+                quarantine=_cfg(
+                    config, "broker.perf.tpu_audit_quarantine", True
+                ),
+                alarms=self.alarms,
+                flight=self.flight,
+                slo_publish_ms=_cfg(
+                    config, "broker.perf.tpu_slo_publish_p99_ms", 50.0
+                ),
+                slo_publish_target=_cfg(
+                    config, "broker.perf.tpu_slo_publish_target", 0.999
+                ),
+                slo_audit_target=_cfg(
+                    config, "broker.perf.tpu_slo_audit_target", 0.999
+                ),
+                slo_fast_window_s=_cfg(
+                    config, "broker.perf.tpu_slo_fast_window_s", 300.0
+                ),
+                slo_slow_window_s=_cfg(
+                    config, "broker.perf.tpu_slo_slow_window_s", 3600.0
+                ),
+                slo_burn_threshold=_cfg(
+                    config, "broker.perf.tpu_slo_burn_threshold", 10.0
+                ),
+            )
+            broker.sentinel = self.sentinel
 
     def prometheus_text(self) -> str:
         return prometheus_text(self.broker, self.node_name, obs=self)
@@ -95,8 +137,22 @@ class Observability:
 
     def stop(self) -> None:
         self.sys.stop()
+        if self.sentinel is not None and self.broker.sentinel is self.sentinel:
+            self.broker.sentinel = None
         if self.flight is not None:
             self.flight.uninstall()
         self.traces.close()
         self.traces.uninstall()
         self.slow_subs.uninstall()
+
+
+def _cfg(config, key: str, default):
+    """Config read tolerant of absent config objects (bench/tests
+    construct Observability without one)."""
+    if config is None:
+        return default
+    try:
+        v = config.get(key)
+    except Exception:
+        return default
+    return default if v is None else v

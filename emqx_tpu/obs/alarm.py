@@ -58,9 +58,18 @@ class Alarms:
         self._notify("activate", rec)
 
     def ensure(self, name: str, details=None, message: str = "") -> None:
-        """activate if not already active (safe_activate)."""
-        if name not in self._active:
+        """activate if not already active (safe_activate). An already-
+        active alarm refreshes its details/message in place — no
+        re-notify, no $SYS re-publish — so long-burning alarms (SLO
+        burn rates, audit divergence) read current, not stale, state."""
+        rec = self._active.get(name)
+        if rec is None:
             self.activate(name, details, message)
+            return
+        if details:
+            rec["details"] = details
+        if message:
+            rec["message"] = message
 
     def deactivate(self, name: str, details=None, message: str = "") -> None:
         rec = self._active.pop(name, None)

@@ -51,7 +51,7 @@ import os
 import time
 from typing import Any, Callable, Dict, List, Optional
 
-from .kernel_telemetry import StreamingHistogram
+from .kernel_telemetry import StreamingHistogram, render_histogram_lines
 
 log = logging.getLogger("emqx_tpu.obs.flight_recorder")
 
@@ -315,6 +315,11 @@ def default_rules(
         # event-driven (fired by the Alarms listener, never polled);
         # registered so its cooldown is declared alongside the rest
         TriggerRule("alarm", lambda ctl: None, cooldown),
+        # event-driven: the publish sentinel's shadow-oracle audit
+        # fires this the moment a served result diverges from the host
+        # oracle (obs/sentinel.py) — the one anomaly where the ring's
+        # pre-breach events ARE the forensic record of the bad serve
+        TriggerRule("audit_divergence", lambda ctl: None, cooldown),
     ]
 
 
@@ -691,17 +696,10 @@ class FlightControl:
             fam = "emqx_hook_duration_seconds"
             lines.append(f"# TYPE {fam} histogram")
             for hook in sorted(self.hook_hist):
-                h = self.hook_hist[hook]
-                lab = f'{node},hook="{hook}"'
-                cum = 0
-                for le, c in zip(h.bounds, h.counts):
-                    cum += c
-                    lines.append(
-                        f'{fam}_bucket{{{lab},le="{format(le, "g")}"}} {cum}'
-                    )
-                lines.append(f'{fam}_bucket{{{lab},le="+Inf"}} {h.total}')
-                lines.append(f"{fam}_sum{{{lab}}} {h.sum:.9f}")
-                lines.append(f"{fam}_count{{{lab}}} {h.total}")
+                render_histogram_lines(
+                    lines, fam, f'{node},hook="{hook}"',
+                    self.hook_hist[hook], emit_type=False,
+                )
         return lines
 
 

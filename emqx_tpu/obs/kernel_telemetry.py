@@ -154,6 +154,30 @@ def _fmt_le(v: float) -> str:
     return format(v, "g")
 
 
+def render_histogram_lines(
+    lines: List[str],
+    fam: str,
+    label_str: str,
+    h: StreamingHistogram,
+    emit_type: bool = True,
+) -> None:
+    """Append one labeled histogram series in Prometheus text
+    exposition (cumulative `le` buckets, terminal +Inf, `_sum`/`_count`).
+    Shared by every histogram exporter in obs/ — kernel telemetry,
+    flight-recorder hook durations, sentinel publish stages — so the
+    structural invariants the exposition lint enforces live in one
+    place. `emit_type=False` for the 2nd..nth series of one family."""
+    if emit_type:
+        lines.append(f"# TYPE {fam} histogram")
+    cum = 0
+    for le, c in zip(h.bounds, h.counts):
+        cum += c
+        lines.append(f'{fam}_bucket{{{label_str},le="{_fmt_le(le)}"}} {cum}')
+    lines.append(f'{fam}_bucket{{{label_str},le="+Inf"}} {h.total}')
+    lines.append(f"{fam}_sum{{{label_str}}} {h.sum:.9f}")
+    lines.append(f"{fam}_count{{{label_str}}} {h.total}")
+
+
 class KernelTelemetry:
     """The live collector. One instance per Router (always-on by
     default); every method is cheap host work — dict probes, a bisect,
@@ -368,30 +392,14 @@ class KernelTelemetry:
             fam = "emqx_xla_dispatch_duration_seconds"
             lines.append(f"# TYPE {fam} histogram")
             for leg in sorted(self.hist):
-                h = self.hist[leg]
-                lab = f'{node},leg="{leg}"'
-                cum = 0
-                for le, c in zip(h.bounds, h.counts):
-                    cum += c
-                    lines.append(
-                        f'{fam}_bucket{{{lab},le="{_fmt_le(le)}"}} {cum}'
-                    )
-                lines.append(f'{fam}_bucket{{{lab},le="+Inf"}} {h.total}')
-                lines.append(f"{fam}_sum{{{lab}}} {h.sum:.9f}")
-                lines.append(f"{fam}_count{{{lab}}} {h.total}")
-        for name in sorted(self.family_hist):
-            h = self.family_hist[name]
-            fam = f"emqx_xla_{name}"
-            lines.append(f"# TYPE {fam} histogram")
-            cum = 0
-            for le, c in zip(h.bounds, h.counts):
-                cum += c
-                lines.append(
-                    f'{fam}_bucket{{{node},le="{_fmt_le(le)}"}} {cum}'
+                render_histogram_lines(
+                    lines, fam, f'{node},leg="{leg}"', self.hist[leg],
+                    emit_type=False,
                 )
-            lines.append(f'{fam}_bucket{{{node},le="+Inf"}} {h.total}')
-            lines.append(f"{fam}_sum{{{node}}} {h.sum:.9f}")
-            lines.append(f"{fam}_count{{{node}}} {h.total}")
+        for name in sorted(self.family_hist):
+            render_histogram_lines(
+                lines, f"emqx_xla_{name}", node, self.family_hist[name]
+            )
         for name in sorted(self.counters):
             fam = f"emqx_xla_{name}"
             lines.append(f"# TYPE {fam} counter")

@@ -62,6 +62,12 @@ class Monitor:
             )
             out["xla_hbm_bytes"] = int(tel.gauges.get("device_table_bytes", 0))
             out["xla_recompiles"] = tel.counters.get("recompiles_total", 0)
+        # sentinel series: per-stage publish p99s, audit divergences,
+        # SLO burn rates — the dashboard view of the served-path
+        # watchdog (obs/sentinel.py)
+        st = getattr(self.broker, "sentinel", None)
+        if st is not None:
+            out.update(st.monitor_sample())
         return out
 
     def sample(self) -> Dict:

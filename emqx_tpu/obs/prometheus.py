@@ -74,6 +74,11 @@ def prometheus_text(broker, node_name: str = "emqx@127.0.0.1", obs=None) -> str:
     tel = getattr(broker.router, "telemetry", None)
     if tel is not None and tel.enabled:
         lines.extend(tel.prometheus_lines(node_name))
+    # publish sentinel: stage-attribution histograms + SLO burn gauges
+    # (audit counters already rode the collector's emqx_xla_* render)
+    sentinel = getattr(broker, "sentinel", None)
+    if sentinel is not None:
+        lines.extend(sentinel.prometheus_lines(node_name))
     # otel exporter throughput/backpressure (previously only process-
     # internal attributes: a collector outage dropped spans invisibly)
     tracer = getattr(broker, "tracer", None)

@@ -276,6 +276,71 @@ def test_fanout_families_lint():
     assert float(ratio_line.rsplit(" ", 1)[1]) > 1.0
 
 
+async def test_sentinel_families_lint():
+    # ISSUE-5 families: the publish sentinel's stage-attribution
+    # histogram, audit counters, and SLO burn gauges must pass the same
+    # exposition lint, driven through a REAL pipelined run including a
+    # detected divergence (not hand-poked counters)
+    from emqx_tpu.obs.sentinel import PublishSentinel
+
+    broker = Broker()
+    broker._fanout_min_fan = 0
+    broker.sentinel = PublishSentinel(broker, sample_n=1)
+    eng = broker.enable_dispatch_engine(queue_depth=4, deadline_ms=0.2)
+    for i in range(6):
+        s, _ = broker.open_session(f"c{i}", clean_start=True)
+        s.outgoing_sink = lambda pkts: None
+        broker.subscribe(s, "sn/+/v", SubOpts(qos=0))
+    topics = [f"sn/{i}/v" for i in range(4)]
+    await asyncio.gather(
+        *[eng.publish(Message(topic=t, payload=b"x")) for t in topics]
+    )
+    await asyncio.sleep(0)
+    broker.sentinel.run_audits()
+    # inject a fanout divergence so the audit_divergence/quarantine
+    # counters populate on the scrape
+    key = ("sn/+/v",)
+    clock, (mem, other) = broker._fanout_cache[key]
+    broker._fanout_cache[key] = (clock, (mem[:-1], other))
+    await eng.publish(Message(topic="sn/0/v", payload=b"x"))
+    await asyncio.sleep(0)
+    broker.sentinel.run_audits()
+    await eng.stop()
+    text = prometheus_text(broker, "n1@host")
+    types = _lint(text)
+    for fam, kind in (
+        ("emqx_xla_publish_stage_seconds", "histogram"),
+        ("emqx_xla_slo_burn_rate", "gauge"),
+        ("emqx_xla_slo_breached", "gauge"),
+        ("emqx_xla_audit_total", "counter"),
+        ("emqx_xla_audit_clean_total", "counter"),
+        ("emqx_xla_audit_divergence_total", "counter"),
+        ("emqx_xla_audit_quarantine_total", "counter"),
+        ("emqx_xla_audit_quarantined_filters", "gauge"),
+    ):
+        assert types.get(fam) == kind, f"{fam}: {types.get(fam)}"
+    # the stage family is cumulative per stage label with terminal +Inf
+    fam = "emqx_xla_publish_stage_seconds"
+    stages = {}
+    for line in text.splitlines():
+        if line.startswith(f"{fam}_bucket{{"):
+            labels = line[line.index("{") + 1 : line.index("}")]
+            stage = re.search(r'stage="([^"]+)"', labels).group(1)
+            stages.setdefault(stage, []).append(
+                int(line.rsplit(" ", 1)[1])
+            )
+    for need in ("queue", "encode", "kernel", "fetch", "deliver"):
+        assert need in stages, need
+        assert stages[need] == sorted(stages[need])
+    # both objectives render both burn windows
+    for obj in ("publish_latency", "audit_clean"):
+        for window in ("fast", "slow"):
+            assert (
+                f'emqx_xla_slo_burn_rate{{node="n1@host",objective="{obj}",'
+                f'window="{window}"}}'
+            ) in text
+
+
 def test_null_telemetry_scrape_stays_clean():
     from emqx_tpu.obs.kernel_telemetry import NULL
 
