@@ -124,6 +124,9 @@ class Node:
         broker._fanout_cap = cfg.get("broker.perf.tpu_fanout_cache_size")
         broker._fanout_device = cfg.get("broker.perf.tpu_fanout_enable")
         broker._fanout_min_fan = cfg.get("broker.perf.tpu_fanout_min_fan")
+        broker.router._churn_reserve = cfg.get(
+            "broker.perf.tpu_churn_reserve"
+        )
         if cfg.get("broker.perf.tpu_match_enable"):
             broker.enable_dispatch_engine(
                 queue_depth=cfg.get("broker.perf.tpu_dispatch_queue_depth"),

@@ -219,10 +219,26 @@ class Broker:
                 session.client_id,
             )
             return
+        # batch the direct-route deletes through ONE native
+        # del_routes_core pass (Router.delete_routes) — session close
+        # IS the route-churn steady state at millions of users
+        # (disconnect storms, expiry sweeps, rebalance purges); shared
+        # legs keep the per-filter group election
+        cid = session.client_id
+        pend_dels: List[Tuple[str, str]] = []
         for flt in list(session.subscriptions):
-            self._unsubscribe_route(session.client_id, flt)
-            self._release_exclusive(session.client_id, flt)
-            self.hooks.run("session.unsubscribed", session.client_id, flt)
+            group, real = topic_mod.parse_share(flt)
+            if group is not None:
+                if self.shared.unsubscribe(group, real, cid):
+                    self.router.delete_route(
+                        real, (GROUP_DEST, group, real)
+                    )
+            else:
+                pend_dels.append((real, cid))
+            self._release_exclusive(cid, flt)
+            self.hooks.run("session.unsubscribed", cid, flt)
+        if pend_dels:
+            self.router.delete_routes(pend_dels)
         session.subscriptions.clear()
         self.sessions.pop(session.client_id, None)
         self.router.dest_store.note_session(session.client_id, None)

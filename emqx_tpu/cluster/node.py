@@ -543,21 +543,31 @@ class ClusterNode:
         self._apply_ops(ops)
 
     def _apply_ops(self, ops: Sequence[tuple]) -> None:
-        """Apply a peer's op stream. Consecutive route-add runs go
-        through Router.add_routes in syncer-sized batches — this is the
-        production storm path (node-join bootstrap dumps, reconnect-
-        wave announcements), the analog of the reference's batched
-        route sync (emqx_router_syncer.erl:57 MAX_BATCH_SIZE)."""
+        """Apply a peer's op stream. Consecutive route-add AND
+        route-delete runs go through Router.add_routes/delete_routes
+        in syncer-sized batches — this is the production storm path
+        (node-join bootstrap dumps, reconnect-wave announcements,
+        mass-unsubscribe replays), the analog of the reference's
+        batched route sync (emqx_router_syncer.erl:57
+        MAX_BATCH_SIZE)."""
         pend_adds: List[Tuple[str, str]] = []
+        pend_dels: List[Tuple[str, str]] = []
 
         def flush_adds() -> None:
             if pend_adds:
                 self.cluster_router.add_routes(pend_adds)
                 pend_adds.clear()
 
+        def flush_dels() -> None:
+            if pend_dels:
+                self.cluster_router.delete_routes(pend_dels)
+                pend_dels.clear()
+
         for op in ops:
             kind = op[0]
             if kind == "add_r":
+                # order matters across kinds: drain the delete run
+                flush_dels()
                 flt, node = op[1], op[2]
                 if (flt, node) not in self._cluster_pairs:
                     self._cluster_pairs.add((flt, node))
@@ -565,11 +575,17 @@ class ClusterNode:
                     if len(pend_adds) >= 1000:
                         flush_adds()
                 continue
-            # order matters across kinds: drain the add run first
             flush_adds()
             if kind == "del_r":
-                self._route_del(op[1], op[2])
-            elif kind == "add_s":
+                flt, node = op[1], op[2]
+                if (flt, node) in self._cluster_pairs:
+                    self._cluster_pairs.discard((flt, node))
+                    pend_dels.append((flt, node))
+                    if len(pend_dels) >= 1000:
+                        flush_dels()
+                continue
+            flush_dels()
+            if kind == "add_s":
                 _k, group, flt, node, client = op
                 self._shared_add(group, flt, node, client)
             elif kind == "del_s":
@@ -585,6 +601,7 @@ class ClusterNode:
             elif kind == "xdel":
                 self._xdel(op[1], op[2], op[3])
         flush_adds()
+        flush_dels()
 
     def _full_dump_ops(self) -> List[tuple]:
         """Ops reconstructing THIS node's contributions (join announce,
@@ -915,10 +932,18 @@ class ClusterNode:
 
     def _purge_contrib(self, node_id: str) -> None:
         """Drop every route / shared member / registry entry `node_id`
-        contributed."""
-        for flt, node in list(self._cluster_pairs):
-            if node == node_id:
-                self._route_del(flt, node)
+        contributed. The route sweep is ONE batched native delete
+        (Router.delete_routes -> del_routes_core) — a nodedown purge
+        at 1M routes must not walk a python loop per route
+        (emqx_router_helper cleanup analog)."""
+        dead = [
+            (flt, node)
+            for flt, node in self._cluster_pairs
+            if node == node_id
+        ]
+        if dead:
+            self._cluster_pairs.difference_update(dead)
+            self.cluster_router.delete_routes(dead)
         for (group, flt), members in self.cluster_shared.items():
             for m in members:
                 if m[0] == node_id:

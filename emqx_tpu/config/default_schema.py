@@ -235,6 +235,16 @@ def broker_schema() -> Struct:
                                     "tpu_fanout_min_fan": Field(
                                         Int(min=0), default=1024
                                     ),
+                                    # native churn core (native/
+                                    # speedups.cc): rows the single-add
+                                    # reserve pre-pass grows for at
+                                    # once — bigger = rarer reserve
+                                    # stalls on subscribe storms,
+                                    # smaller = tighter memory on tiny
+                                    # brokers
+                                    "tpu_churn_reserve": Field(
+                                        Int(min=1), default=512
+                                    ),
                                     # publish sentinel (obs/sentinel):
                                     # 1/sample_n served publishes get a
                                     # stage span + a deferred

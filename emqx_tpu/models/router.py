@@ -58,14 +58,6 @@ Dest = Hashable
 SYNC_BATCH_SIZE = 1024  # rows per scatter step (ref: ?MAX_BATCH_SIZE 1000)
 
 
-def _fanout_collect_marker(flt, dest) -> None:
-    """Placeholder on_dest_added planted around add_routes_core when no
-    external callback is set: the C core collects the first-appear pair
-    list only when the attribute is non-None, and the dest store feeds
-    from exactly that list. Never actually invoked (the python side
-    does all callback dispatch)."""
-
-
 def _next_pow2(n: int) -> int:
     return 1 << max(0, n - 1).bit_length()
 
@@ -359,6 +351,12 @@ class Router:
         # appends beat a tuple allocation per route on the storm path
         self._trie_pending_f: List[object] = []
         self._trie_pending_r: List[int] = []
+        # True when the pending op list was DROPPED (write-only storms
+        # outgrew it — see _trie_gc): the next host read rebuilds the
+        # trie from live state instead of replaying. The counter
+        # amortizes the single-row delete path's backlog check.
+        self._trie_stale = False
+        self._trie_gc_tick = 0
         self._wild: Dict[str, Dict[Dest, int]] = {}
         self._filter_row: Dict[str, int] = {}
         # row -> filter string, indexed by table row (None = free); a
@@ -423,6 +421,23 @@ class Router:
         # row is re-marked dirty so the next table sync rewrites device
         # state from host truth, which auto-unquarantines (counted).
         self._quarantined: Dict[str, Optional[int]] = {}
+        # native churn core state (native/speedups.cc): the handle
+        # caches the C side's entire attribute/buffer fetch so a
+        # ONE-pair add/delete rides the same core as a 1000-row storm
+        # with ~zero per-call setup. headroom counts how many fresh
+        # rows the last _reserve_native pre-grew for; reserve (and the
+        # post-rebuild path) recreate the handle because growth
+        # REPLACES the numpy arrays the handle's buffers pin.
+        # _churn_reserve is the pre-grow chunk for single-row adds
+        # (broker.perf.tpu_churn_reserve).
+        self._churn_reserve = 512
+        self._native_headroom = 0
+        self._churn_handle = None
+        # bound C entry points (None without the toolchain): one attr
+        # read on the single-pair hot paths instead of a module lookup
+        sp = _speedups.load()
+        self._add_core = sp.add_route_core if sp is not None else None
+        self._del_core = sp.del_route_core if sp is not None else None
 
     @property
     def generation(self) -> int:
@@ -668,13 +683,68 @@ class Router:
         if len(rf) < cap:
             rf.extend([None] * (cap - len(rf)))
 
+    def _reserve_native(self, n: int) -> None:
+        """Pre-grow every structure up to `n` fresh rows could touch —
+        table free rows, vocab refcount array, row->filter list, class
+        index — so the C core can hold raw buffers for the whole call
+        (no growth mid-call), then rebuild the churn handle over the
+        (possibly replaced) arrays. Growth points move at most one
+        reserve chunk earlier than the python path's; final sizes are
+        identical (pow2)."""
+        t = self.table
+        while len(t._free) < n:
+            t._grow()
+        v = t.vocab
+        v.ensure_refs(v._next + n * (t.max_levels + 1))
+        self._ensure_row_filter()
+        if self.index is not None:
+            self.index.reserve(n, t.capacity)
+        self._native_headroom = n
+        self._churn_handle = _speedups.load().make_churn_handle(self)
+        self._trie_gc()  # amortized backlog bound for single-row adds
+
+    def _handle(self):
+        """The churn-core capsule; built on demand (deletes need no
+        reserve — they only append to the free lists)."""
+        h = self._churn_handle
+        if h is None:
+            h = self._churn_handle = _speedups.load().make_churn_handle(
+                self
+            )
+        return h
+
+    def _drop_native_state(self) -> None:
+        """Python-fallback mutations bypass the headroom accounting and
+        may replace arrays the handle pins — drop both."""
+        self._native_headroom = 0
+        self._churn_handle = None
+
     def add_route(self, flt: str, dest: Dest) -> None:
-        if _speedups.load() is not None:
-            # one-pair batch through the native core: single source of
-            # truth with the storm path, and ~2x the pure-python
-            # per-add cost even with the per-call setup
-            self.add_routes([(flt, dest)])
+        core = self._add_core
+        if core is not None:
+            # allocation-free single-pair C entry (the broker's
+            # per-subscribe hot path), with ZERO per-call setup: the
+            # reserve pre-pass runs once per _churn_reserve adds and
+            # the churn handle carries the C side's whole
+            # attribute/buffer fetch between calls; the generation
+            # bump and the dest-store pending mark happen IN the core.
+            # Flags: 1 fresh, 2 need_rebuild, 8 deep changed.
+            if self._native_headroom < 1:
+                self._reserve_native(self._churn_reserve)
+            self._native_headroom -= 1
+            flags = core(self._churn_handle, flt, dest)
+            if flags:
+                if flags & 8:
+                    self._aux_gen += 1
+                if flags & 2:
+                    self.index._rebuild(self.index.n_buckets * 2)
+                    self._churn_handle = _speedups.load().make_churn_handle(
+                        self
+                    )
+                if flags & 1 and self.on_dest_added is not None:
+                    self.on_dest_added(flt, dest)
             return
+        self._drop_native_state()
         if not topic_mod.is_wildcard(flt):
             fresh_topic = flt not in self._exact
             dests = self._exact.setdefault(flt, {})
@@ -751,47 +821,35 @@ class Router:
         nwp_append = new_wild_parts.append
         sp = _speedups.load()
         if sp is not None:
-            # native one-pass path: pre-grow everything a batch could
-            # need (no growth mid-call — the C core holds raw buffer
-            # pointers), then hand the whole batch to add_routes_core
+            # native one-pass path: reserve headroom for the batch (a
+            # no-op when a prior reserve already covers it — the C core
+            # holds raw buffer pointers, so nothing may grow mid-call),
+            # then hand the whole batch to add_routes_core
             B = len(pairs)
-            t = self.table
-            if len(t._free) >= B:  # else python path grows precisely
-                v = t.vocab
-                v.ensure_refs(v._next + B * (t.max_levels + 1))
-                self._ensure_row_filter()
-                ix = self.index
-                if ix is not None:
-                    ix.reserve(B, t.capacity)
-                # the C core appends dirty rows / deep entries without
-                # bumping generations — detect growth and stamp here
-                d0 = len(t.dirty)
-                deep0 = len(self._deep) + len(self._exact_deep)
-                # the C core only collects first-appear pairs when a
-                # callback is visible; the dest store needs every one,
-                # so plant a marker for the duration of the call
+            if self._native_headroom < B:
+                self._reserve_native(max(B, self._churn_reserve))
+            self._native_headroom -= B
+            # generation bumps and dest-store pending marks happen in
+            # the core; the aux generation (host-only deep stores)
+            # stays a len-delta here
+            deep0 = len(self._deep) + len(self._exact_deep)
+            fresh, need_rebuild = sp.add_routes_core(
+                self._churn_handle,
+                pairs if isinstance(pairs, list) else list(pairs),
+            )
+            if len(self._deep) + len(self._exact_deep) != deep0:
+                self._aux_gen += 1
+            if need_rebuild:
+                self.index._rebuild(self.index.n_buckets * 2)
+                self._churn_handle = sp.make_churn_handle(self)
+            if fresh:
                 on_added = self.on_dest_added
-                if on_added is None:
-                    self.on_dest_added = _fanout_collect_marker
-                try:
-                    fresh, need_rebuild = sp.add_routes_core(
-                        self, pairs if isinstance(pairs, list) else list(pairs)
-                    )
-                finally:
-                    self.on_dest_added = on_added
-                if len(t.dirty) != d0:
-                    t.generation += 1
-                if len(self._deep) + len(self._exact_deep) != deep0:
-                    self._aux_gen += 1
-                if need_rebuild:
-                    ix._rebuild(ix.n_buckets * 2)
-                if fresh:
-                    self._fanout_add_batch(fresh)
-                    if on_added is not None:
-                        for flt, dest in fresh:
-                            on_added(flt, dest)
-                return
-        # pure-python path (no toolchain, or table needs growth):
+                if on_added is not None:
+                    for flt, dest in fresh:
+                        on_added(flt, dest)
+            return
+        self._drop_native_state()
+        # pure-python path (no toolchain):
         # scan — split each filter ONCE (the parts ride into add_bulk),
         # classify wildness by C-level list-contains, and register the
         # fresh dest dict immediately so in-batch duplicates dedup on
@@ -874,11 +932,97 @@ class Router:
             self._fanout_add_batch(fresh_pairs)
 
     def delete_routes(self, pairs: Sequence[Tuple[str, Dest]]) -> None:
-        """Batched delete_route (the syncer's delete leg)."""
-        for flt, dest in pairs:
-            self.delete_route(flt, dest)
+        """Batched delete_route (the syncer's delete leg). With the
+        native core this is ONE C pass over the pairs (the
+        do_delete_route mirror of add_routes_core): dest refcounts,
+        index un-indexing, table tombstones, and deferred host-trie
+        removals all land in C; the wrapper batch-feeds the dest store
+        (pending marks for surviving filters, one vectorized free for
+        vanished rows) and fires on_dest_removed per vanished pair —
+        the write path unsubscribe storms, session-expiry sweeps, and
+        nodedown purges execute."""
+        sp = _speedups.load()
+        if sp is None:
+            self._drop_native_state()
+            for flt, dest in pairs:
+                self._delete_route_py(flt, dest)
+            return
+        # generation bumps and surviving-filter pending marks happen
+        # in the core (the lazy storm feed); dead rows free in one
+        # vectorized pass here
+        deep0 = len(self._deep) + len(self._exact_deep)
+        vanished, removed_rows = sp.del_routes_core(
+            self._handle(),
+            pairs if isinstance(pairs, list) else list(pairs),
+        )
+        if len(self._deep) + len(self._exact_deep) != deep0:
+            self._aux_gen += 1
+        if vanished:
+            if removed_rows:
+                self.dest_store.free_rows(removed_rows)
+                self._trie_gc()
+            on_removed = self.on_dest_removed
+            if on_removed is not None:
+                for flt, dest in vanished:
+                    on_removed(flt, dest)
+
+    def _trie_gc(self) -> None:
+        """Bound the deferred host-trie op list: a write-only workload
+        (pure storms, purge cycles with no host-path reads in between)
+        never drains it, so when the replay backlog outweighs the live
+        filter set, DROP it and mark the trie stale — the next host
+        read rebuilds from live state (_host_trie), which subsumes
+        every dropped op by construction. The mutation-path cost is an
+        O(1) length check (plus the occasional list clear); nothing is
+        ever replayed twice and no storm leg pays a rebuild."""
+        pf = self._trie_pending_f
+        if self._trie_stale:
+            if pf:
+                # still stale (no read since): keep memory flat
+                pf.clear()
+                self._trie_pending_r.clear()
+            return
+        if len(pf) > 4 * len(self._filter_row) + 1024:
+            self._trie_stale = True
+            pf.clear()
+            self._trie_pending_r.clear()
 
     def delete_route(self, flt: str, dest: Dest) -> None:
+        core = self._del_core
+        if core is not None:
+            # allocation-free single-pair delete (unsubscribe hot
+            # path; the churn handle makes per-call setup ~zero and
+            # deletes need no reserve pre-pass; the generation bump
+            # and surviving-filter pending mark happen IN the core).
+            # Packed flags: 1 vanished, 2 row freed (id in bits 8+),
+            # 8 deep changed.
+            h = self._churn_handle
+            if h is None:
+                h = self._churn_handle = _speedups.load().make_churn_handle(
+                    self
+                )
+            flags = core(h, flt, dest)
+            if flags:
+                if flags & 8:
+                    self._aux_gen += 1
+                if flags & 1:
+                    if flags & 2:
+                        self.dest_store.free_row(flags >> 8)
+                        tick = self._trie_gc_tick + 1
+                        if tick >= 1024:
+                            self._trie_gc_tick = 0
+                            self._trie_gc()
+                        else:
+                            self._trie_gc_tick = tick
+                    if self.on_dest_removed is not None:
+                        self.on_dest_removed(flt, dest)
+            return
+        self._drop_native_state()
+        self._delete_route_py(flt, dest)
+
+    def _delete_route_py(self, flt: str, dest: Dest) -> None:
+        """Pure-python delete leg (the fallback and the oracle the C
+        core is parity-tested against)."""
         if not topic_mod.is_wildcard(flt):
             dests = self._exact.get(flt)
             if not dests or dest not in dests:
@@ -981,12 +1125,36 @@ class Router:
         """The host trie with any deferred storm writes drained.
         Pending entries carry words tuples (single-add path) or raw
         filter strings (native bulk path — split here, off the storm
-        hot loop)."""
+        hot loop). The native DELETE leg defers its trie removals into
+        the same ordered list with the row encoded as -(row+1), so
+        interleaved add/delete storms replay in arrival order — the
+        router-syncer write-visibility seam (a host read observes every
+        mutation that preceded it, exactly once)."""
+        if self._trie_stale:
+            # the op backlog was dropped mid-storm (_trie_gc): rebuild
+            # from live state, which reflects every mutation up to NOW
+            # — any ops still pending are subsumed, so they drop too
+            t = TopicTrie()
+            ins = t.insert
+            words = self.table.filter_words
+            for _flt, row in self._filter_row.items():
+                ins(words(row), row)
+            self._trie = t
+            self._trie_pending_f.clear()
+            self._trie_pending_r.clear()
+            self._trie_stale = False
+            return t
         pf = self._trie_pending_f
         if pf:
-            ins = self._trie.insert
+            trie = self._trie
+            ins = trie.insert
+            rem = trie.remove
             for ws, row in zip(pf, self._trie_pending_r):
-                ins(tuple(ws.split("/")) if type(ws) is str else ws, row)
+                w = tuple(ws.split("/")) if type(ws) is str else ws
+                if row >= 0:
+                    ins(w, row)
+                else:
+                    rem(w, -row - 1)
             pf.clear()
             self._trie_pending_r.clear()
         return self._trie

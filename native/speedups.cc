@@ -409,25 +409,34 @@ fail:
 }
 
 // ---------------------------------------------------------------------
-// add_routes_core(router, pairs) -> (fresh | None, need_rebuild)
+// The route-churn core: one C pass over a (filter, dest) pair batch
+// against the router's own dicts/lists/sets/arrays, in BOTH
+// directions:
 //
-// The ENTIRE Router.add_routes batch write path in one C pass over
-// the pairs: wildness scan, dest-dict dedup/registration, vocab
-// intern + filter-table row encode (direct numpy-buffer writes),
-// class-index add incl. the device hash (bit-identical to
-// hash_index._hash_host) and bucketized-cuckoo placement (identical
-// eviction walk to hash_index._evict_insert), and dest refcount bump.
-// Operates on the router's own dicts/lists/sets/arrays — the python
-// implementation remains the fallback and produces identical state.
+//   make_churn_handle(router)              -> capsule
+//   add_routes_core(handle|router, pairs)  -> (fresh, need_rebuild)
+//   del_routes_core(handle|router, pairs)  -> (vanished, removed_rows)
 //
-// Wrapper contract (Router.add_routes enforces before calling):
+// A ChurnHandle caches the entire attribute fetch — every
+// dict/list/set object (strong refs; those containers are mutated in
+// place and never rebound) plus raw buffer views of every numpy
+// array — so the per-call setup of a ONE-pair batch is ~zero and the
+// single-row add/delete paths ride the same core as 1000-row storms.
+// The buffers pin the CURRENT arrays: the Router drops the handle
+// whenever an array can be REPLACED (the _reserve_native growth
+// pre-pass, an index rebuild, any python-fallback mutation) — writing
+// through a stale handle would mutate orphaned arrays.
+//
+// Wrapper contract (Router enforces before an ADD call):
 //   * table free-list holds >= len(pairs) rows (no growth mid-call)
 //   * vocab._refs covers next_id + worst-case new words
 //   * index bucket arrays pre-grown by len(pairs); slot table
 //     pre-grown so the batch cannot cross the bulk load factor
-// Returns need_rebuild=True when an eviction walk exhausted MAX_KICKS
-// (the carried key is left unseated; caller must _rebuild, which
-// re-places every bucket from its records).
+// Deletes need no pre-pass: they only append to the free lists.
+// add returns need_rebuild=True when an eviction walk exhausted
+// MAX_KICKS (the carried key is left unseated; the caller must
+// _rebuild, which re-places every bucket from its records, then
+// recreate the handle).
 
 static const uint32_t kH1Seed = 0x811C9DC5u, kH1Cls = 0x9E3779B1u,
                       kH1Mul = 16777619u;
@@ -436,62 +445,323 @@ static const uint32_t kFpSeed = 0x2545F491u, kFpCls = 0x85EBCA6Bu,
 static const uint32_t kAltMul = 0x9E3779B9u;
 static const int kBucketW = 4, kMaxKicks = 512;
 
-// pop last element of a PyList, returning a NEW reference (or null)
-static PyObject *list_pop_last(PyObject *lst) {
-  Py_ssize_t n = PyList_GET_SIZE(lst);
-  if (n == 0) {
-    PyErr_SetString(PyExc_IndexError, "pop from empty list");
-    return nullptr;
-  }
-  PyObject *it = PyList_GET_ITEM(lst, n - 1);
-  Py_INCREF(it);
-  if (PyList_SetSlice(lst, n - 1, n, nullptr) < 0) {
-    Py_DECREF(it);
-    return nullptr;
-  }
-  return it;
+static const char *kHandleName = "emqx_tpu.churn_handle";
+static uint64_t g_cache_serial = 0;  // word-cache epoch allocator
+
+static PyObject *sep_str() {  // immortal '/' (lazy, once per process)
+  static PyObject *g = nullptr;
+  if (!g) g = PyUnicode_InternFromString("/");
+  return g;
 }
 
-struct CoreState {
-  // router
-  PyObject *exact_t, *wild_t, *deep_t, *exact_row, *filter_row, *row_filter,
-      *exact_deep, *trie_pending_f, *trie_pending_r, *deep_trie, *on_added;
+struct ChurnHandle {
+  // router stores (strong refs)
+  PyObject *exact_t = nullptr, *wild_t = nullptr, *deep_t = nullptr,
+           *exact_row = nullptr, *filter_row = nullptr,
+           *row_filter = nullptr, *exact_deep = nullptr,
+           *trie_pending_f = nullptr, *trie_pending_r = nullptr,
+           *deep_trie = nullptr;
   // table
-  PyObject *tab, *tab_free, *tab_fstr, *tab_dirty;
+  PyObject *tab = nullptr, *tab_free = nullptr, *tab_fstr = nullptr,
+           *tab_filters = nullptr, *tab_dirty = nullptr;
   Buf words, plen, hh, rw, active;
-  long L;
-  long count_delta = 0;
-  Py_ssize_t tab_taken = 0;  // rows consumed off tab_free's tail
+  long L = 0;
   // vocab
-  PyObject *voc, *voc_ids, *voc_words, *voc_free;
+  PyObject *voc = nullptr, *voc_ids = nullptr, *voc_words = nullptr,
+           *voc_free = nullptr;
   Buf refs;
-  int64_t next_id;
-  Py_ssize_t voc_taken = 0;  // ids consumed off voc_free's tail
-  // index (optional)
+  // index (optional; null when router.index is None)
   PyObject *ix = nullptr, *skel_packed = nullptr, *bucket_of = nullptr,
            *bucket_rows = nullptr, *bucket_free = nullptr,
            *bkt_ws = nullptr, *residual = nullptr, *dirty_slots = nullptr;
   Buf row_bucket, bkt_cid, bkt_h1, bkt_fp, bkt_slot, class_buckets, s_fp,
       s_bucket, s_probe;
   long n_buckets = 0;
-  long live_delta = 0;
+
+  // dest-store feed (router.dest_store.pending_rows): fresh pairs'
+  // rows are marked pending a segment rebuild directly from the core
+  // (the lazy storm feed — Router._fanout_flush rebuilds at resolve)
+  PyObject *pending_rows = nullptr;
+  // cached scalars (read once at build, written back only when they
+  // change — the handle contract guarantees no other writer while the
+  // handle is live, so the cache IS the truth between calls)
+  int64_t next_id = 0;      // vocab._next
+  int64_t next_written = 0; // last value written back
+  long count_cache = 0;     // table._count
+  long gen_cache = 0;       // table.generation
+  long live_cache = 0;      // ix._live
+  uint64_t cache_serial = 0;  // word-cache epoch (bumped on release)
+  uint64_t last_skel = 0;   // single-entry skeleton -> class cache
+  long last_cid = -1;
+  bool skel_valid = false;
+
+  // per-call state (reset at the top of each core call; calls hold
+  // the GIL and never reenter)
+  long count_delta = 0, live_delta = 0;
+  Py_ssize_t tab_taken = 0;  // rows consumed off tab_free's tail
+  Py_ssize_t voc_taken = 0;  // ids consumed off voc_free's tail
   Py_ssize_t bkt_taken = 0;  // bids consumed off bucket_free's tail
   bool any_residual = false, need_rebuild = false;
+  bool dirty_grew = false;    // appended to table.dirty this call
+  bool deep_changed = false;  // deep/exact-deep stores changed
+
+  void reset_call() {
+    count_delta = live_delta = 0;
+    tab_taken = voc_taken = bkt_taken = 0;
+    any_residual = need_rebuild = false;
+    dirty_grew = deep_changed = false;
+  }
+
+  ~ChurnHandle() {
+    for (PyObject *o :
+         {exact_t, wild_t, deep_t, exact_row, filter_row, row_filter,
+          exact_deep, trie_pending_f, trie_pending_r, deep_trie, tab,
+          tab_free, tab_fstr, tab_filters, tab_dirty, voc, voc_ids,
+          voc_words, voc_free, pending_rows, ix, skel_packed, bucket_of,
+          bucket_rows, bucket_free, bkt_ws, residual, dirty_slots})
+      Py_XDECREF(o);
+  }
 };
 
-// per-call word-id cache: keys point into the pairs' utf8 buffers
-// (alive for the whole call), so a hit costs one FNV hash + memcmp —
-// no PyUnicode allocation, no dict probe.  Generation counter makes
-// reset O(1) per call.
+// acquire a contiguous buffer view of `o.name` (the buffer itself
+// keeps the array alive; no separate object ref needed)
+static bool get_buf_attr(PyObject *o, const char *name, Buf &b) {
+  PyObject *a = PyObject_GetAttrString(o, name);
+  if (!a) return false;
+  bool ok = b.get(a, PyBUF_CONTIG);
+  Py_DECREF(a);
+  return ok;
+}
+
+static ChurnHandle *handle_build(PyObject *router) {
+  ChurnHandle *h = new ChurnHandle();
+#define GETH(field, obj, name)                                 \
+  if (!((h->field) = PyObject_GetAttrString((obj), (name)))) { \
+    delete h;                                                  \
+    return nullptr;                                            \
+  }
+  GETH(exact_t, router, "_exact");
+  GETH(wild_t, router, "_wild");
+  GETH(deep_t, router, "_deep");
+  GETH(exact_row, router, "_exact_row");
+  GETH(filter_row, router, "_filter_row");
+  GETH(row_filter, router, "_row_filter");
+  GETH(exact_deep, router, "_exact_deep");
+  GETH(trie_pending_f, router, "_trie_pending_f");
+  GETH(trie_pending_r, router, "_trie_pending_r");
+  GETH(deep_trie, router, "_deep_trie");
+  GETH(tab, router, "table");
+  GETH(tab_free, h->tab, "_free");
+  GETH(tab_fstr, h->tab, "_fstr");
+  GETH(tab_filters, h->tab, "_filters");
+  GETH(tab_dirty, h->tab, "dirty");
+  GETH(voc, h->tab, "vocab");
+  GETH(voc_ids, h->voc, "_ids");
+  GETH(voc_words, h->voc, "_words");
+  GETH(voc_free, h->voc, "_free");
+  {
+    PyObject *lobj = PyObject_GetAttrString(h->tab, "max_levels");
+    if (!lobj) {
+      delete h;
+      return nullptr;
+    }
+    h->L = PyLong_AsLong(lobj);
+    Py_DECREF(lobj);
+  }
+  if (!get_buf_attr(h->tab, "words", h->words) ||
+      !get_buf_attr(h->tab, "prefix_len", h->plen) ||
+      !get_buf_attr(h->tab, "has_hash", h->hh) ||
+      !get_buf_attr(h->tab, "root_wild", h->rw) ||
+      !get_buf_attr(h->tab, "active", h->active) ||
+      !get_buf_attr(h->voc, "_refs", h->refs)) {
+    delete h;
+    return nullptr;
+  }
+  {
+    PyObject *nobj = PyObject_GetAttrString(h->voc, "_next");
+    if (!nobj) {
+      delete h;
+      return nullptr;
+    }
+    h->next_id = h->next_written = PyLong_AsLongLong(nobj);
+    Py_DECREF(nobj);
+    PyObject *cobj = PyObject_GetAttrString(h->tab, "_count");
+    if (!cobj) {
+      delete h;
+      return nullptr;
+    }
+    h->count_cache = PyLong_AsLong(cobj);
+    Py_DECREF(cobj);
+    PyObject *gobj = PyObject_GetAttrString(h->tab, "generation");
+    if (!gobj) {
+      delete h;
+      return nullptr;
+    }
+    h->gen_cache = PyLong_AsLong(gobj);
+    Py_DECREF(gobj);
+    PyObject *ds = PyObject_GetAttrString(router, "dest_store");
+    if (!ds) {
+      delete h;
+      return nullptr;
+    }
+    h->pending_rows = PyObject_GetAttrString(ds, "pending_rows");
+    Py_DECREF(ds);
+    if (!h->pending_rows) {
+      delete h;
+      return nullptr;
+    }
+  }
+  h->cache_serial = ++g_cache_serial;
+  PyObject *ixo = PyObject_GetAttrString(router, "index");
+  if (!ixo) {
+    delete h;
+    return nullptr;
+  }
+  if (ixo == Py_None) {
+    Py_DECREF(ixo);
+    return h;
+  }
+  h->ix = ixo;  // steals the new ref
+  GETH(skel_packed, h->ix, "_skel_packed");
+  GETH(bucket_of, h->ix, "_bucket_of");
+  GETH(bucket_rows, h->ix, "_bucket_rows");
+  GETH(bucket_free, h->ix, "_bucket_free");
+  GETH(bkt_ws, h->ix, "_bkt_ws");
+  GETH(residual, h->ix, "residual_rows");
+  GETH(dirty_slots, h->ix, "dirty_slots");
+#undef GETH
+  {
+    PyObject *nb = PyObject_GetAttrString(h->ix, "n_buckets");
+    if (!nb) {
+      delete h;
+      return nullptr;
+    }
+    h->n_buckets = PyLong_AsLong(nb);
+    Py_DECREF(nb);
+  }
+  PyObject *slots = PyObject_GetAttrString(h->ix, "slots");
+  if (!slots) {
+    delete h;
+    return nullptr;
+  }
+  bool ok = get_buf_attr(h->ix, "_row_bucket", h->row_bucket) &&
+            get_buf_attr(h->ix, "_bkt_cid", h->bkt_cid) &&
+            get_buf_attr(h->ix, "_bkt_h1", h->bkt_h1) &&
+            get_buf_attr(h->ix, "_bkt_fp", h->bkt_fp) &&
+            get_buf_attr(h->ix, "_bkt_slot", h->bkt_slot) &&
+            get_buf_attr(h->ix, "_class_buckets", h->class_buckets) &&
+            get_buf_attr(slots, "fp", h->s_fp) &&
+            get_buf_attr(slots, "bucket", h->s_bucket) &&
+            get_buf_attr(slots, "probe", h->s_probe);
+  Py_DECREF(slots);
+  if (!ok) {
+    delete h;
+    return nullptr;
+  }
+  PyObject *lobj = PyObject_GetAttrString(h->ix, "_live");
+  if (!lobj) {
+    delete h;
+    return nullptr;
+  }
+  h->live_cache = PyLong_AsLong(lobj);
+  Py_DECREF(lobj);
+  return h;
+}
+
+static void handle_capsule_free(PyObject *cap) {
+  auto *h = (ChurnHandle *)PyCapsule_GetPointer(cap, kHandleName);
+  delete h;
+}
+
+static PyObject *make_churn_handle(PyObject *, PyObject *args) {
+  PyObject *router;
+  if (!PyArg_ParseTuple(args, "O", &router)) return nullptr;
+  ChurnHandle *h = handle_build(router);
+  if (!h) return nullptr;
+  PyObject *cap = PyCapsule_New(h, kHandleName, handle_capsule_free);
+  if (!cap) {
+    delete h;
+    return nullptr;
+  }
+  return cap;
+}
+
+// a core entry's first arg is either a churn-handle capsule (fast) or
+// the router itself (transient fetch — built and torn down in-call)
+static ChurnHandle *resolve_handle(PyObject *arg, bool *transient) {
+  if (PyCapsule_CheckExact(arg)) {
+    *transient = false;
+    return (ChurnHandle *)PyCapsule_GetPointer(arg, kHandleName);
+  }
+  *transient = true;
+  return handle_build(arg);
+}
+
+// write scalar state back even on failure, keeping counters coherent
+// with whatever prefix of the batch landed (exception-safe). The
+// cached values ARE the truth while the handle is live, so unchanged
+// scalars cost nothing.
+static void write_back_scalars(ChurnHandle &st) {
+  bool had_err = PyErr_Occurred() != nullptr;
+  PyObject *et = nullptr, *ev = nullptr, *tb = nullptr;
+  if (had_err) PyErr_Fetch(&et, &ev, &tb);
+  if (st.next_id != st.next_written) {
+    PyObject *v = PyLong_FromLongLong(st.next_id);
+    if (v) {
+      if (PyObject_SetAttrString(st.voc, "_next", v) == 0)
+        st.next_written = st.next_id;
+      Py_DECREF(v);
+    }
+  }
+  if (st.count_delta) {
+    st.count_cache += st.count_delta;
+    PyObject *nv = PyLong_FromLong(st.count_cache);
+    if (nv) {
+      PyObject_SetAttrString(st.tab, "_count", nv);
+      Py_DECREF(nv);
+    }
+  }
+  if (st.dirty_grew) {
+    // same bump discipline as the python paths: one generation tick
+    // per call that changed the filter set (match caches only need
+    // CHANGE, not a count)
+    st.gen_cache += 1;
+    PyObject *nv = PyLong_FromLong(st.gen_cache);
+    if (nv) {
+      PyObject_SetAttrString(st.tab, "generation", nv);
+      Py_DECREF(nv);
+    }
+  }
+  if (st.ix) {
+    if (st.live_delta) {
+      st.live_cache += st.live_delta;
+      PyObject *nv = PyLong_FromLong(st.live_cache);
+      if (nv) {
+        PyObject_SetAttrString(st.ix, "_live", nv);
+        Py_DECREF(nv);
+      }
+    }
+    if (st.any_residual)
+      PyObject_SetAttrString(st.ix, "residual_dirty", Py_True);
+  }
+  if (had_err) PyErr_Restore(et, ev, tb);
+}
+
+// word-id cache: entries OWN their key bytes and are tagged with the
+// handle's cache serial, so hits persist ACROSS calls (the single-row
+// add path gets the same hot-word locality as a storm batch) while
+// staying correct for multiple routers (distinct serials) and word-id
+// recycling (the delete core bumps the serial whenever it releases an
+// id, which O(1)-invalidates every entry).  A hit costs one FNV hash
+// + memcmp — no PyUnicode allocation, no dict probe.
 struct WordCacheEntry {
-  const char *ptr;
-  int len;
-  uint32_t gen;
+  uint64_t serial;  // owning handle's word-cache epoch (0 = empty)
+  int32_t len;
   int64_t id;
+  char buf[44];
 };
 static const int kWCBits = 13, kWCSize = 1 << kWCBits;
 static WordCacheEntry g_wcache[kWCSize];
-static uint32_t g_wgen = 0;
 
 static inline uint32_t fnv1a(const char *s, Py_ssize_t n) {
   uint32_t h = 0x811C9DC5u;
@@ -503,7 +773,7 @@ static inline uint32_t fnv1a(const char *s, Py_ssize_t n) {
 // Mirrors hash_index._evict_insert (same LCG walk); maintains probe
 // words, _bkt_slot and dirty_slots inline.  Returns false when the
 // walk exhausts (carried key unseated -> caller sets need_rebuild).
-static bool core_place(CoreState &st, uint32_t h1, uint32_t fp,
+static bool core_place(ChurnHandle &st, uint32_t h1, uint32_t fp,
                        int32_t bid) {
   uint32_t mask = (uint32_t)st.n_buckets - 1;
   uint32_t *sfp = (uint32_t *)st.s_fp.b.buf;
@@ -557,7 +827,7 @@ static bool core_place(CoreState &st, uint32_t h1, uint32_t fp,
 
 // index one freshly-encoded row.  `rowobj` is the row's PyLong, `r`
 // its value; wrow/plen/hh/rw describe the encoded filter.
-static bool core_index_add(CoreState &st, PyObject *flt, PyObject *rowobj,
+static bool core_index_add(ChurnHandle &st, PyObject *flt, PyObject *rowobj,
                            long r, const int32_t *wrow, long plen, bool hh,
                            bool rw) {
   if (!st.ix) return true;
@@ -590,28 +860,38 @@ static bool core_index_add(CoreState &st, PyObject *flt, PyObject *rowobj,
     if (wrow[i] == kPlus) pm |= 1ull << i;
   }
   uint64_t skel = (uint64_t)plen | ((uint64_t)hh << 6) | (pm << 7);
-  PyObject *skelobj = PyLong_FromUnsignedLongLong(skel);
-  if (!skelobj) return false;
-  PyObject *cidobj = PyDict_GetItemWithError(st.skel_packed, skelobj);
-  Py_DECREF(skelobj);
   long cid;
-  if (cidobj) {
-    cid = PyLong_AsLong(cidobj);
+  if (st.skel_valid && st.last_skel == skel) {
+    // single-entry skeleton cache: real tables have FEW skeletons, so
+    // storms and single-row adds alike hit this (invalidated on class
+    // retirement)
+    cid = st.last_cid;
   } else {
-    if (PyErr_Occurred()) return false;
-    // new skeleton: let python allocate the class (meta arrays etc.)
-    PyObject *res = PyObject_CallMethod(
-        st.ix, "_class_of", "lOOK", plen, hh ? Py_True : Py_False,
-        rw ? Py_True : Py_False, (unsigned long long)pm);
-    if (!res) return false;
-    if (res == Py_None) {
+    PyObject *skelobj = PyLong_FromUnsignedLongLong(skel);
+    if (!skelobj) return false;
+    PyObject *cidobj = PyDict_GetItemWithError(st.skel_packed, skelobj);
+    Py_DECREF(skelobj);
+    if (cidobj) {
+      cid = PyLong_AsLong(cidobj);
+    } else {
+      if (PyErr_Occurred()) return false;
+      // new skeleton: let python allocate the class (meta arrays etc.)
+      PyObject *res = PyObject_CallMethod(
+          st.ix, "_class_of", "lOOK", plen, hh ? Py_True : Py_False,
+          rw ? Py_True : Py_False, (unsigned long long)pm);
+      if (!res) return false;
+      if (res == Py_None) {
+        Py_DECREF(res);
+        if (PySet_Add(st.residual, rowobj) < 0) return false;
+        st.any_residual = true;
+        return true;
+      }
+      cid = PyLong_AsLong(res);
       Py_DECREF(res);
-      if (PySet_Add(st.residual, rowobj) < 0) return false;
-      st.any_residual = true;
-      return true;
     }
-    cid = PyLong_AsLong(res);
-    Py_DECREF(res);
+    st.last_skel = skel;
+    st.last_cid = cid;
+    st.skel_valid = true;
   }
   // device hash — bit-identical to hash_index._hash_host
   uint32_t h1 = kH1Seed ^ ((uint32_t)cid * kH1Cls);
@@ -695,7 +975,7 @@ static int scan_words(const char *s, Py_ssize_t n, WordSpan *spans,
 // encode one fresh filter into a table row.  Returns 1 ok, 0 deep
 // (plen > L; no row consumed), -1 python error.  On ok, *rowobj_out
 // is a BORROWED ref (owned by tab_dirty after append).
-static int core_add_row(CoreState &st, PyObject *flt, const char *s,
+static int core_add_row(ChurnHandle &st, PyObject *flt, const char *s,
                         const WordSpan *spans, int nw, PyObject **rowobj_out,
                         long *r_out, const int32_t **wrow_out,
                         long *plen_out, bool *hh_out, bool *rw_out) {
@@ -724,11 +1004,12 @@ static int core_add_row(CoreState &st, PyObject *flt, const char *s,
       if (i == 0) rw = true;
       continue;
     }
-    // per-call word cache: hit avoids the PyUnicode alloc + dict probe
+    // word cache: hit avoids the PyUnicode alloc + dict probe
     uint32_t h = fnv1a(wp, wl);
     WordCacheEntry *e = &g_wcache[h & (kWCSize - 1)];
     int64_t id;
-    if (e->gen == g_wgen && e->len == wl && memcmp(e->ptr, wp, wl) == 0) {
+    if (e->serial == st.cache_serial && e->len == wl &&
+        memcmp(e->buf, wp, wl) == 0) {
       id = e->id;
     } else {
       PyObject *w = PyUnicode_DecodeUTF8(wp, wl, nullptr);
@@ -766,10 +1047,12 @@ static int core_add_row(CoreState &st, PyObject *flt, const char *s,
         Py_DECREF(idobj);
         Py_DECREF(w);
       }
-      e->ptr = wp;
-      e->len = wl;
-      e->gen = g_wgen;
-      e->id = id;
+      if (wl <= (int)sizeof(e->buf)) {
+        memcpy(e->buf, wp, wl);
+        e->len = wl;
+        e->serial = st.cache_serial;
+        e->id = id;
+      }
     }
     if (id < 0 || id >= refs_cap) {
       PyErr_SetString(PyExc_ValueError, "refs array not pre-grown");
@@ -788,6 +1071,7 @@ static int core_add_row(CoreState &st, PyObject *flt, const char *s,
   PyList_SetItem(st.tab_fstr, r, flt);
   if (PyList_Append(st.tab_dirty, rowobj) < 0) return -1;
   st.count_delta += 1;
+  st.dirty_grew = true;
   *rowobj_out = rowobj;  // kept alive by tab_dirty
   *r_out = r;
   *wrow_out = wrow;
@@ -797,138 +1081,197 @@ static int core_add_row(CoreState &st, PyObject *flt, const char *s,
   return 1;
 }
 
-static PyObject *add_routes_core(PyObject *, PyObject *args) {
-  PyObject *router, *pairs;
-  if (!PyArg_ParseTuple(args, "OO!", &router, &PyList_Type, &pairs))
-    return nullptr;
-  CoreState st;
-  // --- fetch phase (read-only; any failure leaves no mutation) -------
-  Ref r_exact, r_wild, r_deep, r_xrow, r_frow, r_rfilt, r_xdeep, r_trie,
-      r_trie2, r_dtrie, r_onadd, r_tab, r_tfree, r_tfstr, r_tdirty,
-      r_words, r_plen, r_hh, r_rw, r_active, r_voc, r_vids, r_vwords,
-      r_vfree, r_vrefs, r_ix, r_skel, r_bof, r_rbkt, r_brows, r_bfree,
-      r_bws, r_resid, r_dslots, r_bcid, r_bh1, r_bfp, r_bslot, r_cbkt,
-      r_slots, r_sfp, r_sbkt, r_sprobe;
-#define GETA(ref, obj, name)                              \
-  if (!((ref).p = PyObject_GetAttrString((obj), (name)))) \
-    return nullptr;
-  GETA(r_exact, router, "_exact");
-  GETA(r_wild, router, "_wild");
-  GETA(r_deep, router, "_deep");
-  GETA(r_xrow, router, "_exact_row");
-  GETA(r_frow, router, "_filter_row");
-  GETA(r_rfilt, router, "_row_filter");
-  GETA(r_xdeep, router, "_exact_deep");
-  GETA(r_trie, router, "_trie_pending_f");
-  GETA(r_trie2, router, "_trie_pending_r");
-  GETA(r_dtrie, router, "_deep_trie");
-  GETA(r_onadd, router, "on_dest_added");
-  GETA(r_tab, router, "table");
-  GETA(r_tfree, r_tab.p, "_free");
-  GETA(r_tfstr, r_tab.p, "_fstr");
-  GETA(r_tdirty, r_tab.p, "dirty");
-  GETA(r_words, r_tab.p, "words");
-  GETA(r_plen, r_tab.p, "prefix_len");
-  GETA(r_hh, r_tab.p, "has_hash");
-  GETA(r_rw, r_tab.p, "root_wild");
-  GETA(r_active, r_tab.p, "active");
-  GETA(r_voc, r_tab.p, "vocab");
-  GETA(r_vids, r_voc.p, "_ids");
-  GETA(r_vwords, r_voc.p, "_words");
-  GETA(r_vfree, r_voc.p, "_free");
-  GETA(r_vrefs, r_voc.p, "_refs");
-  {
-    PyObject *lobj = PyObject_GetAttrString(r_tab.p, "max_levels");
-    if (!lobj) return nullptr;
-    st.L = PyLong_AsLong(lobj);
-    Py_DECREF(lobj);
-    PyObject *nobj = PyObject_GetAttrString(r_voc.p, "_next");
-    if (!nobj) return nullptr;
-    st.next_id = PyLong_AsLongLong(nobj);
-    Py_DECREF(nobj);
+// RAII owner for a transiently-built handle (capsule handles persist)
+struct HandleScope {
+  ChurnHandle *h = nullptr;
+  bool transient = false;
+  ~HandleScope() {
+    if (transient) delete h;
   }
-  if (!st.words.get(r_words.p, PyBUF_CONTIG) ||
-      !st.plen.get(r_plen.p, PyBUF_CONTIG) ||
-      !st.hh.get(r_hh.p, PyBUF_CONTIG) || !st.rw.get(r_rw.p, PyBUF_CONTIG) ||
-      !st.active.get(r_active.p, PyBUF_CONTIG) ||
-      !st.refs.get(r_vrefs.p, PyBUF_CONTIG))
-    return nullptr;
-  GETA(r_ix, router, "index");
-  if (r_ix.p != Py_None) {
-    st.ix = r_ix.p;
-    GETA(r_skel, st.ix, "_skel_packed");
-    GETA(r_bof, st.ix, "_bucket_of");
-    GETA(r_rbkt, st.ix, "_row_bucket");
-    GETA(r_brows, st.ix, "_bucket_rows");
-    GETA(r_bfree, st.ix, "_bucket_free");
-    GETA(r_bws, st.ix, "_bkt_ws");
-    GETA(r_resid, st.ix, "residual_rows");
-    GETA(r_dslots, st.ix, "dirty_slots");
-    GETA(r_bcid, st.ix, "_bkt_cid");
-    GETA(r_bh1, st.ix, "_bkt_h1");
-    GETA(r_bfp, st.ix, "_bkt_fp");
-    GETA(r_bslot, st.ix, "_bkt_slot");
-    GETA(r_cbkt, st.ix, "_class_buckets");
-    GETA(r_slots, st.ix, "slots");
-    GETA(r_sfp, r_slots.p, "fp");
-    GETA(r_sbkt, r_slots.p, "bucket");
-    GETA(r_sprobe, r_slots.p, "probe");
-    PyObject *nb = PyObject_GetAttrString(st.ix, "n_buckets");
-    if (!nb) return nullptr;
-    st.n_buckets = PyLong_AsLong(nb);
-    Py_DECREF(nb);
-    if (!st.row_bucket.get(r_rbkt.p, PyBUF_CONTIG) ||
-        !st.bkt_cid.get(r_bcid.p, PyBUF_CONTIG) ||
-        !st.bkt_h1.get(r_bh1.p, PyBUF_CONTIG) ||
-        !st.bkt_fp.get(r_bfp.p, PyBUF_CONTIG) ||
-        !st.bkt_slot.get(r_bslot.p, PyBUF_CONTIG) ||
-        !st.class_buckets.get(r_cbkt.p, PyBUF_CONTIG) ||
-        !st.s_fp.get(r_sfp.p, PyBUF_CONTIG) ||
-        !st.s_bucket.get(r_sbkt.p, PyBUF_CONTIG) ||
-        !st.s_probe.get(r_sprobe.p, PyBUF_CONTIG))
-      return nullptr;
-    st.skel_packed = r_skel.p;
-    st.bucket_of = r_bof.p;
-    st.bucket_rows = r_brows.p;
-    st.bucket_free = r_bfree.p;
-    st.bkt_ws = r_bws.p;
-    st.residual = r_resid.p;
-    st.dirty_slots = r_dslots.p;
-  }
-  st.exact_t = r_exact.p;
-  st.wild_t = r_wild.p;
-  st.deep_t = r_deep.p;
-  st.exact_row = r_xrow.p;
-  st.filter_row = r_frow.p;
-  st.row_filter = r_rfilt.p;
-  st.exact_deep = r_xdeep.p;
-  st.trie_pending_f = r_trie.p;
-  st.trie_pending_r = r_trie2.p;
-  st.deep_trie = r_dtrie.p;
-  st.on_added = r_onadd.p;
-  st.tab = r_tab.p;
-  st.tab_free = r_tfree.p;
-  st.tab_fstr = r_tfstr.p;
-  st.tab_dirty = r_tdirty.p;
-  st.voc = r_voc.p;
-  st.voc_ids = r_vids.p;
-  st.voc_words = r_vwords.p;
-  st.voc_free = r_vfree.p;
-#undef GETA
+};
 
-  bool collect = st.on_added != Py_None;
-  Ref fresh;
-  if (collect) {
-    fresh.p = PyList_New(0);
-    if (!fresh.p) return nullptr;
+static PyObject *g_one() {  // cached small int 1
+  static PyObject *o = nullptr;
+  if (!o) o = PyLong_FromLong(1);
+  return o;
+}
+
+// one (flt, dest) pair through the add leg. `pair`/`fresh_list` (when
+// non-null) collect the first-appear transition for the bulk API;
+// *fresh_out reports it either way. A fresh pair whose filter has a
+// table row is marked pending in the dest store's lazy storm feed
+// right here (Router._fanout_flush rebuilds the segment at the next
+// resolve). Returns 0 ok, -1 python error.
+static int add_one_pair(ChurnHandle &st, PyObject *pair, PyObject *flt,
+                        PyObject *dest, PyObject *fresh_list,
+                        bool *fresh_out) {
+  *fresh_out = false;
+  PyObject *one = g_one();
+  if (!one) return -1;
+  Py_ssize_t slen;
+  const char *s = PyUnicode_AsUTF8AndSize(flt, &slen);
+  if (!s) return -1;
+  WordSpan spans[kMaxWords];
+  bool wild;
+  int nw = scan_words(s, slen, spans, &wild);
+  PyObject *dests;
+  if (wild) {
+    dests = PyDict_GetItemWithError(st.wild_t, flt);
+    if (!dests && !PyErr_Occurred() && PyDict_GET_SIZE(st.deep_t))
+      dests = PyDict_GetItemWithError(st.deep_t, flt);
+  } else {
+    dests = PyDict_GetItemWithError(st.exact_t, flt);
   }
-  g_wgen++;  // reset the per-call word cache
+  if (!dests && PyErr_Occurred()) return -1;
+  if (!dests) {
+    // fresh filter: register {dest: 1} directly (fused first bump),
+    // encode a row, index it
+    dests = PyDict_New();
+    if (!dests || PyDict_SetItem(dests, dest, one) < 0 ||
+        PyDict_SetItem(wild ? st.wild_t : st.exact_t, flt, dests) < 0) {
+      Py_XDECREF(dests);
+      return -1;
+    }
+    Py_DECREF(dests);  // owned by the table dict now
+    *fresh_out = true;
+    if (fresh_list && PyList_Append(fresh_list, pair) < 0) return -1;
+    PyObject *rowobj;
+    long r, plen;
+    const int32_t *wrow;
+    bool hhf, rwf;
+    int rc = core_add_row(st, flt, s, spans,
+                          nw > kMaxWords ? kMaxWords : nw, &rowobj, &r,
+                          &wrow, &plen, &hhf, &rwf);
+    if (rc < 0) return -1;
+    if (rc == 0 || nw > kMaxWords) {
+      // too deep for the flattened table
+      st.deep_changed = true;
+      if (wild) {
+        PyObject *wst;
+        if (nw > kMaxWords) {
+          // spans truncated: fall back to python split
+          PyObject *meth = PyObject_CallMethod(flt, "split", "s", "/");
+          if (!meth || !PyList_Check(meth)) {
+            Py_XDECREF(meth);
+            return -1;
+          }
+          wst = PyList_AsTuple(meth);
+          Py_DECREF(meth);
+          if (!wst) return -1;
+        } else {
+          wst = PyTuple_New(nw);
+          if (!wst) return -1;
+          for (int i = 0; i < nw; i++) {
+            PyObject *w = PyUnicode_DecodeUTF8(s + spans[i].off,
+                                               spans[i].len, nullptr);
+            if (!w) {
+              Py_DECREF(wst);
+              return -1;
+            }
+            PyTuple_SET_ITEM(wst, i, w);
+          }
+        }
+        // migrate dest dict to the deep store + deep trie
+        Py_INCREF(dests);
+        if (PyDict_DelItem(st.wild_t, flt) < 0 ||
+            PyDict_SetItem(st.deep_t, flt, dests) < 0) {
+          Py_DECREF(dests);
+          Py_DECREF(wst);
+          return -1;
+        }
+        Py_DECREF(dests);
+        PyObject *res =
+            PyObject_CallMethod(st.deep_trie, "insert", "OO", wst, flt);
+        Py_DECREF(wst);
+        if (!res) return -1;
+        Py_DECREF(res);
+      } else {
+        if (PySet_Add(st.exact_deep, flt) < 0) return -1;
+      }
+    } else {
+      if (PyDict_SetItem(wild ? st.filter_row : st.exact_row, flt,
+                         rowobj) < 0)
+        return -1;
+      // row -> filter string (flat list indexed by row)
+      Py_INCREF(flt);
+      if (PyList_SetItem(st.row_filter, r, flt) < 0) return -1;
+      if (wild) {
+        // pending trie insert in string form (drained lazily)
+        if (PyList_Append(st.trie_pending_f, flt) < 0 ||
+            PyList_Append(st.trie_pending_r, rowobj) < 0)
+          return -1;
+      }
+      if (!core_index_add(st, flt, rowobj, r, wrow, plen, hhf, rwf))
+        return -1;
+      if (PySet_Add(st.pending_rows, rowobj) < 0) return -1;
+    }
+    return 0;  // first dest already registered
+  }
+  // dest refcount bump on an existing filter
+  PyObject *cnt = PyDict_GetItemWithError(dests, dest);
+  if (!cnt && PyErr_Occurred()) return -1;
+  if (!cnt) {
+    if (PyDict_SetItem(dests, dest, one) < 0) return -1;
+    *fresh_out = true;
+    if (fresh_list && PyList_Append(fresh_list, pair) < 0) return -1;
+    // existing filter, new dest: mark its row pending a segment
+    // rebuild (host-resident filters have no row — fallback covers)
+    PyObject *rowobj = PyDict_GetItemWithError(
+        wild ? st.filter_row : st.exact_row, flt);
+    if (!rowobj && PyErr_Occurred()) return -1;
+    if (rowobj && PySet_Add(st.pending_rows, rowobj) < 0) return -1;
+  } else {
+    long c = PyLong_AsLong(cnt);
+    if (c == -1 && PyErr_Occurred()) return -1;
+    PyObject *nc = PyLong_FromLong(c + 1);
+    if (!nc || PyDict_SetItem(dests, dest, nc) < 0) {
+      Py_XDECREF(nc);
+      return -1;
+    }
+    Py_DECREF(nc);
+  }
+  return 0;
+}
+
+// truncate the consumed free-list tails (once per call, not per row)
+static bool truncate_taken(ChurnHandle &st) {
+  bool ok = true;
+  if (st.tab_taken) {
+    Py_ssize_t nf = PyList_GET_SIZE(st.tab_free);
+    if (PyList_SetSlice(st.tab_free, nf - st.tab_taken, nf, nullptr) < 0)
+      ok = false;
+  }
+  if (st.voc_taken) {
+    Py_ssize_t nf = PyList_GET_SIZE(st.voc_free);
+    if (PyList_SetSlice(st.voc_free, nf - st.voc_taken, nf, nullptr) < 0)
+      ok = false;
+  }
+  if (st.bkt_taken) {
+    Py_ssize_t nf = PyList_GET_SIZE(st.bucket_free);
+    if (PyList_SetSlice(st.bucket_free, nf - st.bkt_taken, nf, nullptr) < 0)
+      ok = false;
+  }
+  return ok;
+}
+
+static PyObject *add_routes_core(PyObject *, PyObject *args) {
+  PyObject *hobj, *pairs;
+  if (!PyArg_ParseTuple(args, "OO!", &hobj, &PyList_Type, &pairs))
+    return nullptr;
+  HandleScope hs;
+  hs.h = resolve_handle(hobj, &hs.transient);
+  if (!hs.h) return nullptr;
+  ChurnHandle &st = *hs.h;
+  st.reset_call();
+  // the first-appear pair list is ALWAYS collected: the dest store's
+  // storm feed reads it, so there is no uncollected fast path
+  Ref fresh;
+  fresh.p = PyList_New(0);
+  if (!fresh.p) return nullptr;
 
   // --- single mutation pass over the pairs ---------------------------
   Py_ssize_t n = PyList_GET_SIZE(pairs);
   bool fail = false;
-  PyObject *one = PyLong_FromLong(1);
-  if (!one) return nullptr;
   for (Py_ssize_t k = 0; k < n && !fail; k++) {
     PyObject *pair = PyList_GET_ITEM(pairs, k);
     if (!PyTuple_Check(pair) || PyTuple_GET_SIZE(pair) < 2) {
@@ -936,227 +1279,441 @@ static PyObject *add_routes_core(PyObject *, PyObject *args) {
       fail = true;
       break;
     }
-    PyObject *flt = PyTuple_GET_ITEM(pair, 0);
-    PyObject *dest = PyTuple_GET_ITEM(pair, 1);
-    Py_ssize_t slen;
-    const char *s = PyUnicode_AsUTF8AndSize(flt, &slen);
-    if (!s) {
-      fail = true;
-      break;
-    }
-    WordSpan spans[kMaxWords];
-    bool wild;
-    int nw = scan_words(s, slen, spans, &wild);
-    PyObject *dests;
-    if (wild) {
-      dests = PyDict_GetItemWithError(st.wild_t, flt);
-      if (!dests && !PyErr_Occurred())
-        dests = PyDict_GetItemWithError(st.deep_t, flt);
-    } else {
-      dests = PyDict_GetItemWithError(st.exact_t, flt);
-    }
-    if (!dests && PyErr_Occurred()) {
-      fail = true;
-      break;
-    }
-    if (!dests) {
-      // fresh filter: register {dest: 1} directly (fused first bump),
-      // encode a row, index it
-      dests = PyDict_New();
-      if (!dests || PyDict_SetItem(dests, dest, one) < 0 ||
-          PyDict_SetItem(wild ? st.wild_t : st.exact_t, flt, dests) < 0) {
-        Py_XDECREF(dests);
-        fail = true;
-        break;
-      }
-      Py_DECREF(dests);  // owned by the table dict now
-      if (collect && PyList_Append(fresh.p, pair) < 0) {
-        fail = true;
-        break;
-      }
-      PyObject *rowobj;
-      long r, plen;
-      const int32_t *wrow;
-      bool hhf, rwf;
-      int rc = core_add_row(st, flt, s, spans, nw > kMaxWords ? kMaxWords
-                                                              : nw,
-                            &rowobj, &r, &wrow, &plen, &hhf, &rwf);
-      if (rc < 0) {
-        fail = true;
-        break;
-      }
-      if (rc == 0 || nw > kMaxWords) {
-        // too deep for the flattened table
-        if (wild) {
-          PyObject *wst;
-          if (nw > kMaxWords) {
-            // spans truncated: fall back to python split
-            PyObject *meth = PyObject_CallMethod(flt, "split", "s", "/");
-            if (!meth || !PyList_Check(meth)) {
-              Py_XDECREF(meth);
-              fail = true;
-              break;
-            }
-            wst = PyList_AsTuple(meth);
-            Py_DECREF(meth);
-            if (!wst) {
-              fail = true;
-              break;
-            }
-          } else {
-            wst = PyTuple_New(nw);
-            if (!wst) {
-              fail = true;
-              break;
-            }
-            bool tuple_ok = true;
-            for (int i = 0; i < nw; i++) {
-              PyObject *w = PyUnicode_DecodeUTF8(s + spans[i].off,
-                                                 spans[i].len, nullptr);
-              if (!w) {
-                tuple_ok = false;
-                break;
-              }
-              PyTuple_SET_ITEM(wst, i, w);
-            }
-            if (!tuple_ok) {
-              Py_DECREF(wst);
-              fail = true;
-              break;
-            }
-          }
-          // migrate dest dict to the deep store + deep trie
-          Py_INCREF(dests);
-          if (PyDict_DelItem(st.wild_t, flt) < 0 ||
-              PyDict_SetItem(st.deep_t, flt, dests) < 0) {
-            Py_DECREF(dests);
-            Py_DECREF(wst);
-            fail = true;
-            break;
-          }
-          Py_DECREF(dests);
-          PyObject *res =
-              PyObject_CallMethod(st.deep_trie, "insert", "OO", wst, flt);
-          Py_DECREF(wst);
-          if (!res) {
-            fail = true;
-            break;
-          }
-          Py_DECREF(res);
-        } else {
-          if (PySet_Add(st.exact_deep, flt) < 0) {
-            fail = true;
-            break;
-          }
-        }
-      } else {
-        if (PyDict_SetItem(wild ? st.filter_row : st.exact_row, flt,
-                           rowobj) < 0) {
-          fail = true;
-          break;
-        }
-        // row -> filter string (flat list indexed by row)
-        Py_INCREF(flt);
-        if (PyList_SetItem(st.row_filter, r, flt) < 0) {
-          fail = true;
-          break;
-        }
-        if (wild) {
-          // pending trie insert in string form (drained lazily)
-          if (PyList_Append(st.trie_pending_f, flt) < 0 ||
-              PyList_Append(st.trie_pending_r, rowobj) < 0) {
-            fail = true;
-            break;
-          }
-        }
-        if (!core_index_add(st, flt, rowobj, r, wrow, plen, hhf, rwf)) {
-          fail = true;
-          break;
-        }
-      }
-      continue;  // first dest already registered
-    }
-    // dest refcount bump on an existing filter
-    PyObject *cnt = PyDict_GetItemWithError(dests, dest);
-    if (!cnt && PyErr_Occurred()) {
-      fail = true;
-      break;
-    }
-    if (!cnt) {
-      if (PyDict_SetItem(dests, dest, one) < 0) {
-        fail = true;
-        break;
-      }
-      if (collect && PyList_Append(fresh.p, pair) < 0) {
-        fail = true;
-        break;
-      }
-    } else {
-      long c = PyLong_AsLong(cnt);
-      if (c == -1 && PyErr_Occurred()) {
-        fail = true;
-        break;
-      }
-      PyObject *nc = PyLong_FromLong(c + 1);
-      if (!nc || PyDict_SetItem(dests, dest, nc) < 0) {
-        Py_XDECREF(nc);
-        fail = true;
-        break;
-      }
-      Py_DECREF(nc);
-    }
-  }
-  Py_DECREF(one);
-  // --- truncate the consumed free-list tails (once, not per row) -----
-  if (st.tab_taken) {
-    Py_ssize_t nf = PyList_GET_SIZE(st.tab_free);
-    if (PyList_SetSlice(st.tab_free, nf - st.tab_taken, nf, nullptr) < 0)
+    bool fresh_flag;
+    if (add_one_pair(st, pair, PyTuple_GET_ITEM(pair, 0),
+                     PyTuple_GET_ITEM(pair, 1), fresh.p,
+                     &fresh_flag) < 0)
       fail = true;
   }
-  if (st.voc_taken) {
-    Py_ssize_t nf = PyList_GET_SIZE(st.voc_free);
-    if (PyList_SetSlice(st.voc_free, nf - st.voc_taken, nf, nullptr) < 0)
-      fail = true;
-  }
-  if (st.bkt_taken) {
-    Py_ssize_t nf = PyList_GET_SIZE(st.bucket_free);
-    if (PyList_SetSlice(st.bucket_free, nf - st.bkt_taken, nf, nullptr) < 0)
-      fail = true;
-  }
-
+  if (!truncate_taken(st)) fail = true;
   // --- write back scalar state (even on failure: keep consistent) ----
-  {
-    PyObject *v = PyLong_FromLongLong(st.next_id);
-    if (v) {
-      PyObject_SetAttrString(st.voc, "_next", v);
-      Py_DECREF(v);
-    }
-    PyObject *cobj = PyObject_GetAttrString(st.tab, "_count");
-    if (cobj) {
-      PyObject *nv = PyLong_FromLong(PyLong_AsLong(cobj) + st.count_delta);
-      Py_DECREF(cobj);
-      if (nv) {
-        PyObject_SetAttrString(st.tab, "_count", nv);
-        Py_DECREF(nv);
-      }
-    }
-    if (st.ix) {
-      PyObject *lobj = PyObject_GetAttrString(st.ix, "_live");
-      if (lobj) {
-        PyObject *nv = PyLong_FromLong(PyLong_AsLong(lobj) + st.live_delta);
-        Py_DECREF(lobj);
-        if (nv) {
-          PyObject_SetAttrString(st.ix, "_live", nv);
-          Py_DECREF(nv);
-        }
-      }
-      if (st.any_residual)
-        PyObject_SetAttrString(st.ix, "residual_dirty", Py_True);
+  write_back_scalars(st);
+  if (fail) return nullptr;
+  return Py_BuildValue("(OO)", fresh.p,
+                       st.need_rebuild ? Py_True : Py_False);
+}
+
+// add_route_core(handle, flt, dest) -> flags int — the
+// allocation-free single-pair entry (the broker's per-subscribe hot
+// path, METH_FASTCALL: no arg tuple, no pair tuple, no batch list, no
+// result tuple; generation bump and dest-store pending mark happen
+// in-core). Flag bits:
+//   1 fresh pair (first appearance — fire on_dest_added)
+//   2 need_rebuild (caller must ix._rebuild + recreate the handle)
+//   8 deep stores changed (caller bumps Router._aux_gen)
+static PyObject *add_route_core(PyObject *, PyObject *const *args,
+                                Py_ssize_t nargs) {
+  if (nargs != 3) {
+    PyErr_SetString(PyExc_TypeError,
+                    "add_route_core(handle, flt, dest)");
+    return nullptr;
+  }
+  HandleScope hs;
+  hs.h = resolve_handle(args[0], &hs.transient);
+  if (!hs.h) return nullptr;
+  ChurnHandle &st = *hs.h;
+  st.reset_call();
+  bool fresh = false;
+  bool fail =
+      add_one_pair(st, nullptr, args[1], args[2], nullptr, &fresh) < 0;
+  if (!truncate_taken(st)) fail = true;
+  write_back_scalars(st);
+  if (fail) return nullptr;
+  return PyLong_FromLong((fresh ? 1 : 0) | (st.need_rebuild ? 2 : 0) |
+                         (st.deep_changed ? 8 : 0));
+}
+
+// ---------------------------------------------------------------------
+// del_routes_core(handle|router, pairs) -> (vanished, removed_rows)
+//
+// The batched delete leg — Router.delete_routes' entire write path in
+// one C pass, bit-identical in visible state to the python
+// delete_route loop: dest refcount decrement, last-ref dest removal,
+// and on a filter's last dest the full teardown — class-index
+// un-index (cuckoo slot vacate + probe-word refresh, bucket
+// retire/demote, class retirement via ix._retire_class), filter-table
+// tombstone (vocab release by word id, free-list recycle, dirty
+// append), and a DEFERRED host-trie removal (appended to the same
+// ordered pending list the adds use, row encoded as -(row+1);
+// _host_trie drains inserts and removals in arrival order, the mria
+// route-delete visibility seam).  Returns:
+//   vanished     — the (flt, dest) pairs whose LAST reference dropped
+//                  (the wrapper feeds the dest store + fires
+//                  on_dest_removed from this list)
+//   removed_rows — table rows freed because their filter lost its
+//                  last dest (the wrapper batch-frees their CSR
+//                  segments via DestStore.free_rows)
+
+// recompute one bucket's packed probe word from its four lanes
+// (mirror of hash_index._refresh_probe)
+static void refresh_probe_c(ChurnHandle &st, long b) {
+  uint32_t *sfp = (uint32_t *)st.s_fp.b.buf;
+  int32_t *sbkt = (int32_t *)st.s_bucket.b.buf;
+  uint32_t *sprobe = (uint32_t *)st.s_probe.b.buf;
+  long base = b * kBucketW;
+  uint32_t w = 0;
+  for (int l = 0; l < kBucketW; l++) {
+    if (sbkt[base + l] >= 0) {
+      uint32_t byte = sfp[base + l] >> 24;
+      if (byte == 0) byte = 1;
+      w |= byte << (8 * l);
     }
   }
+  sprobe[b] = w;
+}
+
+// un-index one row (mirror of ClassIndex.remove_row). Returns false
+// on python error.
+static bool core_index_remove(ChurnHandle &st, PyObject *rowobj, long r) {
+  if (!st.ix) return true;
+  int disc = PySet_Discard(st.residual, rowobj);
+  if (disc < 0) return false;
+  if (disc == 1) {
+    st.any_residual = true;  // residual mask must re-upload
+    return true;
+  }
+  int64_t *rowbkt = (int64_t *)st.row_bucket.b.buf;
+  long bid = (long)rowbkt[r];
+  if (bid < 0) {
+    PyErr_Format(PyExc_AssertionError, "row %ld not indexed", r);
+    return false;
+  }
+  rowbkt[r] = -1;
+  PyObject *rs = PyList_GET_ITEM(st.bucket_rows, bid);  // borrowed
+  if (PySet_Check(rs)) {
+    if (PySet_Discard(rs, rowobj) < 0) return false;
+    Py_ssize_t nleft = PySet_GET_SIZE(rs);
+    if (nleft == 1) {
+      // demote back to the bare-int form (python parity)
+      PyObject *it = PyObject_GetIter(rs);
+      if (!it) return false;
+      PyObject *sole = PyIter_Next(it);
+      Py_DECREF(it);
+      if (!sole) {
+        if (!PyErr_Occurred())
+          PyErr_SetString(PyExc_RuntimeError, "empty bucket set");
+        return false;
+      }
+      PyList_SetItem(st.bucket_rows, bid, sole);  // steals sole
+      return true;
+    }
+    if (nleft > 0) return true;  // bucket still shared
+  } else {
+    int ne = PyObject_RichCompareBool(rs, rowobj, Py_NE);
+    if (ne < 0) return false;
+    if (ne == 1) return true;  // stale/foreign row: bucket not ours
+  }
+  // bucket dies: vacate the cuckoo slot, retire the record
+  PyObject *ws = PyList_GET_ITEM(st.bkt_ws, bid);  // borrowed
+  PyObject *key;
+  bool key_owned = false;
+  if (PyUnicode_Check(ws)) {
+    key = ws;
+  } else {
+    PyObject *sep = sep_str();
+    if (!sep) return false;
+    key = PyUnicode_Join(sep, ws);
+    if (!key) return false;
+    key_owned = true;
+  }
+  int64_t *bslot = (int64_t *)st.bkt_slot.b.buf;
+  long slot = (long)bslot[bid];
+  if (slot >= 0) {
+    ((int32_t *)st.s_bucket.b.buf)[slot] = -1;  // cuckoo: plain delete
+    // zero the fingerprint too: phase 2 trusts fp matches (see
+    // hash_index.remove_row)
+    ((uint32_t *)st.s_fp.b.buf)[slot] = 0;
+    refresh_probe_c(st, slot / kBucketW);
+    PyObject *s = PyLong_FromLong(slot);
+    if (!s) {
+      if (key_owned) Py_DECREF(key);
+      return false;
+    }
+    int rc = PyList_Append(st.dirty_slots, s);
+    Py_DECREF(s);
+    if (rc < 0) {
+      if (key_owned) Py_DECREF(key);
+      return false;
+    }
+  }
+  st.live_delta -= 1;
+  int rc = PyDict_DelItem(st.bucket_of, key);
+  if (key_owned) Py_DECREF(key);
+  if (rc < 0) return false;
+  Py_INCREF(Py_None);
+  PyList_SetItem(st.bkt_ws, bid, Py_None);
+  PyObject *bobj = PyLong_FromLong(bid);
+  if (!bobj) return false;
+  rc = PyList_Append(st.bucket_free, bobj);
+  Py_DECREF(bobj);
+  if (rc < 0) return false;
+  int32_t cid = ((int32_t *)st.bkt_cid.b.buf)[bid];
+  int64_t *cb = (int64_t *)st.class_buckets.b.buf;
+  cb[cid] -= 1;
+  if (cb[cid] == 0) {
+    // rare: last bucket of a skeleton — python owns class retirement
+    PyObject *res =
+        PyObject_CallMethod(st.ix, "_retire_class", "l", (long)cid);
+    if (!res) return false;
+    Py_DECREF(res);
+    st.skel_valid = false;  // the cached skeleton may be this class
+  }
+  return true;
+}
+
+// tombstone one table row (mirror of FilterTable.remove), releasing
+// vocab refs by word id instead of re-splitting the filter string.
+static bool core_table_remove(ChurnHandle &st, PyObject *rowobj, long r) {
+  int32_t *wrow = (int32_t *)st.words.b.buf + (size_t)r * st.L;
+  long plen = ((int32_t *)st.plen.b.buf)[r];
+  int64_t *refs = (int64_t *)st.refs.b.buf;
+  for (long i = 0; i < plen; i++) {
+    int32_t id = wrow[i];
+    if (id == kPlus) continue;
+    refs[id] -= 1;
+    if (refs[id] == 0) {
+      // word's last reference: recycle its id (vocab.release); a
+      // recycled id may be re-assigned to a DIFFERENT word, so the
+      // word cache must forget everything it knew
+      st.cache_serial = ++g_cache_serial;
+      PyObject *idobj = PyLong_FromLong(id);
+      if (!idobj) return false;
+      PyObject *w = PyDict_GetItemWithError(st.voc_words, idobj);
+      if (!w) {
+        Py_DECREF(idobj);
+        if (!PyErr_Occurred())
+          PyErr_Format(PyExc_KeyError, "vocab id %d", (int)id);
+        return false;
+      }
+      Py_INCREF(w);
+      int rc = PyDict_DelItem(st.voc_ids, w);
+      Py_DECREF(w);
+      if (rc < 0 || PyDict_DelItem(st.voc_words, idobj) < 0) {
+        Py_DECREF(idobj);
+        return false;
+      }
+      rc = PyList_Append(st.voc_free, idobj);
+      Py_DECREF(idobj);
+      if (rc < 0) return false;
+    }
+  }
+  for (long i = 0; i < st.L; i++) wrow[i] = 0;  // OOV
+  ((int32_t *)st.plen.b.buf)[r] = 0;
+  ((uint8_t *)st.hh.b.buf)[r] = 0;
+  ((uint8_t *)st.rw.b.buf)[r] = 0;
+  ((uint8_t *)st.active.b.buf)[r] = 0;
+  Py_INCREF(Py_None);
+  PyList_SetItem(st.tab_filters, r, Py_None);
+  Py_INCREF(Py_None);
+  PyList_SetItem(st.tab_fstr, r, Py_None);
+  if (PyList_Append(st.tab_free, rowobj) < 0 ||
+      PyList_Append(st.tab_dirty, rowobj) < 0)
+    return false;
+  st.count_delta -= 1;
+  st.dirty_grew = true;
+  return true;
+}
+
+// full teardown of a table-resident filter's row: row->filter clear,
+// class-index un-index, table tombstone, removed-rows collect
+// (`removed_rows` may be null — the single-pair entry reports the row
+// through its packed return instead). `rowobj` stays owned by caller.
+static bool core_remove_row_full(ChurnHandle &st, PyObject *rowobj,
+                                 PyObject *removed_rows) {
+  long r = PyLong_AsLong(rowobj);
+  if (r < 0 && PyErr_Occurred()) return false;
+  Py_INCREF(Py_None);
+  if (PyList_SetItem(st.row_filter, r, Py_None) < 0) return false;
+  if (!core_index_remove(st, rowobj, r)) return false;
+  if (!core_table_remove(st, rowobj, r)) return false;
+  if (removed_rows) return PyList_Append(removed_rows, rowobj) == 0;
+  return true;
+}
+
+// one (flt, dest) pair through the delete leg. Bulk callers pass the
+// collector lists; the single-pair entry passes nulls and reads the
+// out params. Returns 0 ok, -1 python error.
+static int del_one_pair(ChurnHandle &st, PyObject *pair, PyObject *flt,
+                        PyObject *dest, PyObject *vanished_list,
+                        PyObject *removed_list, bool *vanished_out,
+                        long *freed_row_out) {
+  *vanished_out = false;
+  *freed_row_out = -1;
+  Py_ssize_t slen;
+  const char *s = PyUnicode_AsUTF8AndSize(flt, &slen);
+  if (!s) return -1;
+  bool wild = word_wild_scan(s, slen);
+  bool deep = false;
+  PyObject *dests;
+  if (wild) {
+    dests = PyDict_GetItemWithError(st.wild_t, flt);
+    if (!dests && !PyErr_Occurred() && PyDict_GET_SIZE(st.deep_t)) {
+      dests = PyDict_GetItemWithError(st.deep_t, flt);
+      deep = true;
+    }
+  } else {
+    dests = PyDict_GetItemWithError(st.exact_t, flt);
+  }
+  if (!dests) return PyErr_Occurred() ? -1 : 0;  // unknown: no-op
+  PyObject *cnt = PyDict_GetItemWithError(dests, dest);
+  if (!cnt) return PyErr_Occurred() ? -1 : 0;  // not routed: no-op
+  long c = PyLong_AsLong(cnt);
+  if (c == -1 && PyErr_Occurred()) return -1;
+  if (c > 1) {  // refcounted duplicate: decrement only
+    PyObject *nc = PyLong_FromLong(c - 1);
+    if (!nc || PyDict_SetItem(dests, dest, nc) < 0) {
+      Py_XDECREF(nc);
+      return -1;
+    }
+    Py_DECREF(nc);
+    return 0;
+  }
+  // last reference: the (flt, dest) pair vanishes
+  if (PyDict_DelItem(dests, dest) < 0) return -1;
+  *vanished_out = true;
+  if (vanished_list && PyList_Append(vanished_list, pair) < 0) return -1;
+  if (PyDict_GET_SIZE(dests) != 0) {
+    // other dests remain: mark the surviving filter's row pending a
+    // segment rebuild (the lazy storm feed's delete half; deep
+    // filters have no row — the host fallback covers them)
+    if (!deep) {
+      PyObject *rowobj = PyDict_GetItemWithError(
+          wild ? st.filter_row : st.exact_row, flt);
+      if (!rowobj && PyErr_Occurred()) return -1;
+      if (rowobj && PySet_Add(st.pending_rows, rowobj) < 0) return -1;
+    }
+    return 0;
+  }
+  // the filter's LAST dest vanished: remove the filter itself
+  if (!wild) {
+    if (PyDict_DelItem(st.exact_t, flt) < 0) return -1;
+    PyObject *rowobj = PyDict_GetItemWithError(st.exact_row, flt);
+    if (!rowobj && PyErr_Occurred()) return -1;
+    if (rowobj) {
+      Py_INCREF(rowobj);
+      if (PyDict_DelItem(st.exact_row, flt) < 0 ||
+          !core_remove_row_full(st, rowobj, removed_list)) {
+        Py_DECREF(rowobj);
+        return -1;
+      }
+      *freed_row_out = PyLong_AsLong(rowobj);
+      Py_DECREF(rowobj);
+    } else {
+      // too-deep exact topic: host-only store (aux-gen via wrapper)
+      int disc = PySet_Discard(st.exact_deep, flt);
+      if (disc < 0) return -1;
+      if (disc) st.deep_changed = true;
+    }
+    return 0;
+  }
+  if (deep) {
+    if (PyDict_DelItem(st.deep_t, flt) < 0) return -1;
+    st.deep_changed = true;
+    // rare path: python split + deep-trie removal
+    PyObject *lst = PyObject_CallMethod(flt, "split", "s", "/");
+    if (!lst) return -1;
+    PyObject *wst = PyList_AsTuple(lst);
+    Py_DECREF(lst);
+    if (!wst) return -1;
+    PyObject *res =
+        PyObject_CallMethod(st.deep_trie, "remove", "OO", wst, flt);
+    Py_DECREF(wst);
+    if (!res) return -1;
+    Py_DECREF(res);
+    return 0;
+  }
+  if (PyDict_DelItem(st.wild_t, flt) < 0) return -1;
+  PyObject *rowobj = PyDict_GetItemWithError(st.filter_row, flt);
+  if (!rowobj) {
+    if (!PyErr_Occurred())
+      PyErr_Format(PyExc_KeyError, "filter row missing");
+    return -1;
+  }
+  Py_INCREF(rowobj);
+  if (PyDict_DelItem(st.filter_row, flt) < 0 ||
+      !core_remove_row_full(st, rowobj, removed_list)) {
+    Py_DECREF(rowobj);
+    return -1;
+  }
+  long r = PyLong_AsLong(rowobj);
+  Py_DECREF(rowobj);
+  *freed_row_out = r;
+  // deferred host-trie removal: same ordered pending list as the
+  // adds, row encoded -(row+1); _host_trie drains in arrival order
+  PyObject *neg = PyLong_FromLong(-r - 1);
+  if (!neg) return -1;
+  if (PyList_Append(st.trie_pending_f, flt) < 0 ||
+      PyList_Append(st.trie_pending_r, neg) < 0) {
+    Py_DECREF(neg);
+    return -1;
+  }
+  Py_DECREF(neg);
+  return 0;
+}
+
+static PyObject *del_routes_core(PyObject *, PyObject *args) {
+  PyObject *hobj, *pairs;
+  if (!PyArg_ParseTuple(args, "OO!", &hobj, &PyList_Type, &pairs))
+    return nullptr;
+  HandleScope hs;
+  hs.h = resolve_handle(hobj, &hs.transient);
+  if (!hs.h) return nullptr;
+  ChurnHandle &st = *hs.h;
+  st.reset_call();
+  Ref vanished, removed_rows;
+  vanished.p = PyList_New(0);
+  removed_rows.p = PyList_New(0);
+  if (!vanished.p || !removed_rows.p) return nullptr;
+
+  Py_ssize_t n = PyList_GET_SIZE(pairs);
+  bool fail = false;
+  for (Py_ssize_t k = 0; k < n && !fail; k++) {
+    PyObject *pair = PyList_GET_ITEM(pairs, k);
+    if (!PyTuple_Check(pair) || PyTuple_GET_SIZE(pair) < 2) {
+      PyErr_SetString(PyExc_TypeError, "pair must be a 2-tuple");
+      fail = true;
+      break;
+    }
+    bool van;
+    long freed;
+    if (del_one_pair(st, pair, PyTuple_GET_ITEM(pair, 0),
+                     PyTuple_GET_ITEM(pair, 1), vanished.p,
+                     removed_rows.p, &van, &freed) < 0)
+      fail = true;
+  }
+  write_back_scalars(st);
   if (fail) return nullptr;
-  return Py_BuildValue("(OO)", collect ? fresh.p : Py_None,
-                       st.need_rebuild ? Py_True : Py_False);
+  return Py_BuildValue("(OO)", vanished.p, removed_rows.p);
+}
+
+// del_route_core(handle, flt, dest) -> packed int — the
+// allocation-free single-pair delete (unsubscribe hot path,
+// METH_FASTCALL). Low bits mirror add_route_core where they apply,
+// high bits carry the freed row:
+//   1 pair vanished   2 row freed (id in bits 8+)
+//   4 dirty grew      8 deep stores changed
+static PyObject *del_route_core(PyObject *, PyObject *const *args,
+                                Py_ssize_t nargs) {
+  if (nargs != 3) {
+    PyErr_SetString(PyExc_TypeError,
+                    "del_route_core(handle, flt, dest)");
+    return nullptr;
+  }
+  HandleScope hs;
+  hs.h = resolve_handle(args[0], &hs.transient);
+  if (!hs.h) return nullptr;
+  ChurnHandle &st = *hs.h;
+  st.reset_call();
+  bool van;
+  long freed;
+  bool fail = del_one_pair(st, nullptr, args[1], args[2], nullptr,
+                           nullptr, &van, &freed) < 0;
+  write_back_scalars(st);
+  if (fail) return nullptr;
+  long flags = (van ? 1 : 0) | (freed >= 0 ? 2 : 0) |
+               (st.dirty_grew ? 4 : 0) | (st.deep_changed ? 8 : 0);
+  if (freed >= 0) flags |= freed << 8;
+  return PyLong_FromLong(flags);
 }
 
 // ---------------------------------------------------------------------
@@ -1169,8 +1726,23 @@ static PyMethodDef Methods[] = {
     {"index_dedup", index_dedup, METH_VARARGS,
      "index_dedup(flts, cids, rows, bucket_of, bucket_rows, row_bucket, "
      "bucket_free, residual, nb0)"},
+    {"make_churn_handle", make_churn_handle, METH_VARARGS,
+     "make_churn_handle(router) -> capsule (cached write-path state)"},
     {"add_routes_core", add_routes_core, METH_VARARGS,
-     "add_routes_core(router, pairs) -> (fresh | None, need_rebuild)"},
+     "add_routes_core(handle_or_router, pairs) -> (fresh, need_rebuild)"},
+    {"add_route_core", (PyCFunction)(void (*)(void))add_route_core,
+     METH_FASTCALL,
+     "add_route_core(handle_or_router, flt, dest) -> packed int "
+     "(1 fresh | 2 need_rebuild | 4 dirty_grew | 8 deep_changed | "
+     "(row+1) << 8)"},
+    {"del_routes_core", del_routes_core, METH_VARARGS,
+     "del_routes_core(handle_or_router, pairs) -> "
+     "(vanished, removed_rows)"},
+    {"del_route_core", (PyCFunction)(void (*)(void))del_route_core,
+     METH_FASTCALL,
+     "del_route_core(handle_or_router, flt, dest) -> packed int "
+     "(1 vanished | 2 row_freed | 4 dirty_grew | 8 deep_changed | "
+     "row << 8)"},
     {nullptr, nullptr, 0, nullptr}};
 
 static struct PyModuleDef Module = {PyModuleDef_HEAD_INIT, "_emqx_speedups",

@@ -1,0 +1,334 @@
+"""Native route-churn engine (ISSUE 6): the C delete/purge legs and
+the zero-setup single-row add must leave EVERY surface bit-identical
+to the host oracle after EVERY mutation — device match results, fanout
+plans, and quarantine overlays, on single-device AND sharded tables —
+and the real storm consumers (session close, nodedown purge) must
+actually execute the batched native leg, with the sentinel audit
+staying clean across the churn."""
+
+import asyncio
+import random
+
+import pytest
+
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.packet import SubOpts
+from emqx_tpu.broker.pubsub import Broker
+from emqx_tpu.models.router import Router
+from emqx_tpu.ops import speedups
+from emqx_tpu.ops import topic as topic_mod
+from emqx_tpu.parallel import mesh as mesh_mod
+
+native = pytest.mark.skipif(
+    speedups.load() is None, reason="speedups extension not built"
+)
+
+TOPICS = (
+    [f"site/{k}/up" for k in range(0, 40, 3)]
+    + [f"a/{k}/9/x" for k in range(0, 30, 2)]
+    + [f"b/{k}/z/z" for k in range(0, 20, 2)]
+    + ["deep/" + "/".join(str(j) for j in range(12)) + "/t", "q/root"]
+)
+
+
+def _pairs(n, seed=11):
+    random.seed(seed)
+    out = []
+    for i in range(n):
+        kind = random.random()
+        if kind < 0.3:
+            f = f"site/{i % 40}/up"
+        elif kind < 0.55:
+            f = f"a/{i % 30}/+/x"
+        elif kind < 0.72:
+            f = f"b/{i % 20}/#"
+        elif kind < 0.76:
+            f = "deep/" + "/".join(str(j) for j in range(12)) + "/#"
+        elif kind < 0.8:
+            f = "+/root"
+        else:
+            f = f"c/{i}/+/#"
+        out.append((f, f"n{i % 7}"))
+    random.shuffle(out)
+    return out
+
+
+def _oracle(r, topic):
+    """Independent host oracle: walk EVERY routed filter through
+    topic_mod.match — no trie, no table, no device state shared with
+    the path under test."""
+    tw = topic_mod.words(topic)
+    return sorted(
+        flt
+        for flt in {f for f, _ in r.routes()}
+        if topic_mod.match(tw, topic_mod.words(flt))
+    )
+
+
+def _assert_device_equals_oracle(r, label):
+    got = r.match_filters_batch(TOPICS)
+    for t, flts in zip(TOPICS, got):
+        assert sorted(flts) == _oracle(r, t), f"{label}: {t}"
+
+
+def _churn_script(r):
+    """Interleaved native adds/deletes/purges with a device-match
+    verification after EVERY mutation wave."""
+    pairs = _pairs(900)
+    r.add_routes(pairs[:400])
+    _assert_device_equals_oracle(r, "bulk add")
+    r.delete_routes(pairs[:150])
+    _assert_device_equals_oracle(r, "bulk delete")
+    for f, d in pairs[400:450]:
+        r.add_route(f, d)
+    _assert_device_equals_oracle(r, "single adds")
+    for f, d in pairs[400:430]:
+        r.delete_route(f, d)
+    _assert_device_equals_oracle(r, "single deletes")
+    # duplicate refcounts: add twice, delete once -> still routed
+    r.add_routes(pairs[500:560])
+    r.add_routes(pairs[500:560])
+    r.delete_routes(pairs[500:560])
+    _assert_device_equals_oracle(r, "refcounted deletes")
+    # purge-storm: one batched call removing a whole contribution
+    r.delete_routes(pairs)
+    r.delete_routes(pairs)  # second sweep: all no-ops
+    _assert_device_equals_oracle(r, "purge storm")
+    assert r.stats()["table_rows"] == 0
+    assert len(r._wild) == 0 and len(r._exact) == 0 and len(r._deep) == 0
+    # the table must be fully reusable after the purge
+    r.add_routes(pairs[:200])
+    _assert_device_equals_oracle(r, "post-purge refill")
+
+
+@native
+def test_churn_oracle_single_device():
+    _churn_script(Router(max_levels=8))
+
+
+@native
+def test_churn_oracle_sharded():
+    _churn_script(
+        Router(max_levels=8, mesh=mesh_mod.make_mesh(n_dp=2, n_sub=4))
+    )
+
+
+@native
+def test_churn_oracle_dense_no_index():
+    _churn_script(Router(max_levels=8, use_hash_index=False))
+
+
+@native
+def test_quarantine_overlay_survives_native_churn():
+    r = Router(max_levels=8)
+    pairs = _pairs(400, seed=5)
+    r.add_routes(pairs)
+    r.match_filters_batch(TOPICS)  # device state live
+    r.quarantine_filters(["a/2/+/x", "site/3/up"])
+    # quarantined filters answer from the host walk while churn keeps
+    # mutating through the native legs
+    r.delete_routes(pairs[:100])
+    _assert_device_equals_oracle(r, "quarantined + deletes")
+    for f, d in pairs[100:140]:
+        r.delete_route(f, d)
+    _assert_device_equals_oracle(r, "quarantined + single deletes")
+    # clean sync (device table rewritten from host truth) ends it
+    r.device_table.sync()
+    r.match_filters_batch(TOPICS)
+    assert not r._quarantined
+    _assert_device_equals_oracle(r, "post-unquarantine")
+
+
+# --- fanout plans under churn ----------------------------------------------
+
+
+def _sub(b, cid, flt, qos=0):
+    s = b.sessions.get(cid)
+    if s is None:
+        s, _ = b.open_session(cid, True)
+        s.outgoing_sink = lambda pkts: None
+    b.subscribe(s, flt, SubOpts(qos=qos))
+    return s
+
+
+def _assert_plan_identical(b, topic):
+    pairs = b.router.match_pairs(topic)
+    key = tuple(f for f, _ in pairs)
+    h = b.router.resolve_fanout_begin(key, min_fan=0)
+    assert h is not None, f"device path refused {key}"
+    dev = b.router.resolve_fanout_finish(h)
+    assert dev == b._build_fanout_plan(pairs), topic
+
+
+@native
+def test_fanout_plans_equal_oracle_under_native_delete_churn():
+    b = Broker(max_levels=8)
+    b._fanout_min_fan = 0
+    for i in range(32):
+        _sub(b, f"c{i}", "room/+/t", qos=i % 3)
+    for i in range(16):
+        _sub(b, f"c{i}", "room/#", qos=(i + 1) % 3)
+    _assert_plan_identical(b, "room/7/t")
+    # unsubscribe storm: session closes ride the batched delete leg
+    for i in range(0, 16, 2):
+        b.close_session(b.sessions[f"c{i}"])
+    _assert_plan_identical(b, "room/7/t")
+    for i in range(1, 16, 4):
+        b.unsubscribe(b.sessions[f"c{i}"], "room/#")
+    _assert_plan_identical(b, "room/7/t")
+    # everyone leaves, then a refill — plans must rebuild from scratch
+    for i in range(32):
+        s = b.sessions.get(f"c{i}")
+        if s is not None:
+            b.close_session(s)
+    for i in range(8):
+        _sub(b, f"z{i}", "room/+/t", qos=2)
+    _assert_plan_identical(b, "room/9/t")
+
+
+# --- storm consumers take the native batched leg ---------------------------
+
+
+@native
+def test_close_session_batches_route_deletes(monkeypatch):
+    b = Broker(max_levels=8)
+    s = _sub(b, "bulk", "r0/+/x")
+    for i in range(1, 40):
+        b.subscribe(s, f"r{i}/+/x", SubOpts(qos=0))
+    calls = []
+    orig = Router.delete_routes
+
+    def spy(self, pairs):
+        pairs = list(pairs)
+        calls.append(len(pairs))
+        return orig(self, pairs)
+
+    monkeypatch.setattr(Router, "delete_routes", spy)
+    b.close_session(s)
+    assert calls == [40], calls  # ONE batched call, not 40 singles
+    assert b.router.stats()["table_rows"] == 0
+
+
+@native
+def test_nodedown_purge_takes_native_batched_leg(monkeypatch):
+    from emqx_tpu.cluster.node import ClusterNode
+
+    node = ClusterNode("n1", heartbeat_interval=9.0)
+    # a peer's contribution arrives as an op stream (the bootstrap/
+    # push path — itself batched through add_routes)
+    ops = [("add_r", f"peer/{i}/+/t", "n2") for i in range(300)]
+    ops += [("add_r", f"peer/{i}/+/t", "n3") for i in range(50)]
+    node._apply_ops(ops)
+    assert node.cluster_router.stats()["wildcard_routes"] == 350
+    calls = []
+    orig = Router.delete_routes
+
+    def spy(self, pairs):
+        pairs = list(pairs)
+        calls.append(len(pairs))
+        return orig(self, pairs)
+
+    monkeypatch.setattr(Router, "delete_routes", spy)
+    node._purge_node("n2")
+    # ONE batched native sweep covering n2's whole contribution
+    assert calls == [300], calls
+    assert node.cluster_router.stats()["wildcard_routes"] == 50
+    assert all(n != "n2" for _f, n in node._cluster_pairs)
+    # n3's routes still match
+    assert node.cluster_router.match_filters("peer/7/q/t") == [
+        "peer/7/+/t"
+    ]
+    # del_r op runs batch through delete_routes too
+    calls.clear()
+    node._apply_ops([("del_r", f"peer/{i}/+/t", "n3") for i in range(50)])
+    assert calls == [50], calls
+    assert node.cluster_router.stats()["table_rows"] == 0
+
+
+@native
+def test_sentinel_audit_clean_across_churn_storms(tmp_path):
+    """The full detect surface stays quiet while the native legs churn
+    under served publishes: sampled audits must count zero
+    divergences."""
+    from emqx_tpu.obs import Observability
+
+    async def drive():
+        b = Broker(max_levels=8)
+        b._fanout_min_fan = 0
+        obs = Observability(
+            b, flight=False, trace_dir=str(tmp_path / "t")
+        )
+        try:
+            b.sentinel.sample_n = 1  # audit every served publish
+            eng = b.enable_dispatch_engine(queue_depth=8, deadline_ms=0.2)
+            for wave in range(3):
+                for i in range(24):
+                    _sub(b, f"w{wave}c{i}", f"st/{i % 6}/+", qos=i % 3)
+                await asyncio.gather(
+                    *[
+                        eng.publish(
+                            Message(topic=f"st/{i}/v", payload=b"x")
+                        )
+                        for i in range(6)
+                    ]
+                )
+                await asyncio.sleep(0)
+                b.sentinel.run_audits()
+                # storm out: batched session closes (native delete leg)
+                for i in range(0, 24, 2):
+                    b.close_session(b.sessions[f"w{wave}c{i}"])
+                await asyncio.gather(
+                    *[
+                        eng.publish(
+                            Message(topic=f"st/{i}/v", payload=b"x")
+                        )
+                        for i in range(6)
+                    ]
+                )
+                await asyncio.sleep(0)
+                b.sentinel.run_audits()
+            await eng.stop()
+            audit = b.sentinel.status()["audit"]
+            assert audit["divergence"] == 0, audit
+            assert audit["clean"] > 0, audit
+        finally:
+            obs.stop()
+
+    asyncio.run(drive())
+
+
+# --- python fallback parity for the new delete legs ------------------------
+
+
+@native
+def test_native_delete_state_equals_python_path(monkeypatch):
+    """delete_routes through del_routes_core leaves the same visible
+    state as the pure-python per-pair loop (the add-side twin lives in
+    test_speedups_parity)."""
+
+    def script(r):
+        pairs = _pairs(600, seed=23)
+        r.add_routes(pairs)
+        fired = []
+        r.on_dest_removed = lambda f, d: fired.append((f, d))
+        r.delete_routes(pairs[:200])
+        for f, d in pairs[200:260]:
+            r.delete_route(f, d)
+        r.delete_routes(pairs)  # purge (mostly no-ops + remainder)
+        r.add_routes(pairs[:100])  # recycle freed rows/words/buckets
+        r.device_table.sync()
+        return dict(
+            stats=r.stats(),
+            fired=sorted(map(repr, fired)),
+            routes=sorted(map(repr, r.routes())),
+            batch=[sorted(x) for x in r.match_filters_batch(TOPICS)],
+            single=[sorted(r.match_filters(t)) for t in TOPICS],
+        )
+
+    native_state = script(Router(max_levels=8))
+    monkeypatch.setattr(speedups, "_mod", None)
+    monkeypatch.setattr(speedups, "_tried", True)
+    py_state = script(Router(max_levels=8))
+    monkeypatch.undo()
+    for key in native_state:
+        assert native_state[key] == py_state[key], f"divergence in {key}"

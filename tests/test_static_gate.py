@@ -1,5 +1,5 @@
 """Static-check gate over the whole package — the round-5 judge's
-named CI gap. Three legs, all fast enough for tier-1:
+named CI gap. Four legs, all fast enough for tier-1:
 
   1. every module under emqx_tpu/ byte-compiles (an import typo in a
      rarely-exercised gateway must fail CI, not the first boot);
@@ -10,7 +10,12 @@ named CI gap. Three legs, all fast enough for tier-1:
      package obeys Prometheus naming, and every family declared with a
      `# TYPE` literal actually renders on a real driven scrape that
      passes the exposition lint — a family that can't be driven is a
-     family nobody will ever see on a dashboard.
+     family nobody will ever see on a dashboard;
+  4. native ABI: the symbols exported by native/speedups.cc and their
+     argument arities (parsed from the method table +
+     PyArg_ParseTuple / METH_FASTCALL nargs checks) must match every
+     Python call site — a drifted signature fails tier-1 here instead
+     of segfaulting the bench.
 """
 
 import ast
@@ -22,6 +27,8 @@ import re
 import emqx_tpu
 
 PKG = pathlib.Path(emqx_tpu.__file__).parent
+REPO = PKG.parent
+SPEEDUPS_CC = REPO / "native" / "speedups.cc"
 
 # full family-name literals appearing in "# TYPE <name>" lines whose
 # render needs a backend the gate can't drive hermetically (none today
@@ -152,6 +159,90 @@ def _driven_scrape():
             obs.stop()
 
     return asyncio.run(drive())
+
+
+def _native_abi():
+    """Exported name -> python-visible arity, parsed from the C
+    source: the PyMethodDef table names the entry point, then either
+    its PyArg_ParseTuple format (format units before '|', 'O!'
+    consuming one python arg) or its METH_FASTCALL `nargs != N`
+    guard gives the arity."""
+    src = SPEEDUPS_CC.read_text()
+    methods = re.findall(
+        r'\{"(\w+)",\s*(?:\(PyCFunction\)\(void \(\*\)\(void\)\))?'
+        r"(\w+),\s*(METH_\w+)",
+        src,
+    )
+    assert methods, "no PyMethodDef entries parsed from speedups.cc"
+
+    def fmt_arity(fmt: str) -> int:
+        fmt = fmt.split("|")[0]  # required args only
+        n = i = 0
+        while i < len(fmt):
+            c = fmt[i]
+            if c in "Oislkdfb" or c in "KL":
+                n += 1
+                if i + 1 < len(fmt) and fmt[i + 1] in "!&#":
+                    i += 1
+            i += 1
+        return n
+
+    abi = {}
+    for pyname, cfunc, flavor in methods:
+        # the function body: from its definition to the next
+        # file-level definition
+        m = re.search(
+            r"static PyObject \*" + cfunc + r"\s*\(.*?\n(.*?)\nstatic ",
+            src,
+            re.DOTALL,
+        )
+        body = m.group(1) if m else ""
+        if flavor == "METH_FASTCALL":
+            g = re.search(r"nargs\s*!=\s*(\d+)", body)
+            assert g, f"{cfunc}: METH_FASTCALL without an nargs guard"
+            abi[pyname] = int(g.group(1))
+        else:
+            g = re.search(r'PyArg_ParseTuple\(args,\s*"([^"]+)"', body)
+            assert g, f"{cfunc}: no PyArg_ParseTuple found"
+            abi[pyname] = fmt_arity(g.group(1))
+    return abi
+
+
+def test_native_abi_matches_python_call_sites():
+    abi = _native_abi()
+    # the ABI the rest of the PR depends on must actually be exported
+    for required in (
+        "add_routes_core",
+        "del_routes_core",
+        "add_route_core",
+        "del_route_core",
+        "make_churn_handle",
+        "encode_filters",
+    ):
+        assert required in abi, f"{required} not exported"
+    sources = list(_sources()) + [
+        REPO / "bench.py",
+        *sorted((REPO / "tests").glob("test_*.py")),
+    ]
+    bad = []
+    for path in sources:
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in abi
+            ):
+                continue
+            if any(isinstance(a, ast.Starred) for a in node.args):
+                continue  # splat: arity not statically known
+            got = len(node.args) + len(node.keywords)
+            if got != abi[node.func.attr]:
+                bad.append(
+                    f"{path}:{node.lineno}: {node.func.attr} called "
+                    f"with {got} args, C expects {abi[node.func.attr]}"
+                )
+    assert not bad, "native ABI drift:\n" + "\n".join(bad)
 
 
 def test_every_declared_family_renders_and_lints():
