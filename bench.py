@@ -2013,12 +2013,73 @@ def bench_pipeline(details):
 # --------------------------------------------------------------------------
 
 
+def bench_soak(details, out_path="SOAK_r07.json"):
+    """Million-session soak + chaos scenario stage (ISSUE 7): builds
+    the two-node chaos engine, sustains the Zipf storm through the
+    real pipelined broker, runs the fault catalog (row corruption,
+    disconnect/takeover waves, partition+nodedown purge, evacuation,
+    node purge, whole-table decay) while the sentinel/SLO/flight stack
+    judges the response, asserts every contract, and commits the soak
+    row. EMQX_BENCH_SCALE=small shrinks the fleet for CI smoke."""
+    import asyncio
+
+    from emqx_tpu.chaos.engine import run_soak
+
+    sessions = 1_000_000 // SHRINK
+    victim = 20_000 // SHRINK
+    row = asyncio.run(
+        run_soak(
+            sessions=sessions,
+            victim_sessions=victim,
+            sample_n=64 if not SMALL else 8,
+            baseline_s=20.0 if not SMALL else 2.0,
+            report_path=out_path,
+            progress=log,
+            strict=True,
+        )
+    )
+    details["soak"] = row
+    log(
+        f"soak: {row['sessions']} sessions, "
+        f"{row['storm']['sustained_pub_per_sec']} pub/s sustained, "
+        f"p99 {row['publish_p99_ms_incl_chaos']}ms incl chaos, "
+        f"faults {row['divergences_detected']}/"
+        f"{row['divergences_injected']}, "
+        f"silent {row['silent_divergences']}"
+    )
+    return row
+
+
 def main():
     import jax
     import jax.numpy as jnp
 
     details = {}
     log(f"devices: {jax.devices()}")
+
+    # --soak: the chaos stage is its own run (minutes of wall clock,
+    # a million live sessions) — it executes alone and commits
+    # SOAK_r07.json rather than riding the perf matrix
+    if "--soak" in sys.argv:
+        row = bench_soak(details)
+        print(
+            json.dumps(
+                {
+                    "metric": "soak_sessions_audit_clean",
+                    "value": row["sessions"],
+                    "unit": "sessions",
+                    "sustained_pub_per_sec": row["storm"][
+                        "sustained_pub_per_sec"
+                    ],
+                    "p99_ms_incl_chaos": row["publish_p99_ms_incl_chaos"],
+                    "divergences_detected": row["divergences_detected"],
+                    "divergences_injected": row["divergences_injected"],
+                    "silent_divergences": row["silent_divergences"],
+                    "contracts_ok": row["contracts_ok"],
+                }
+            )
+        )
+        return
 
     # --flight: attach a FlightControl to the run-wide collector and
     # capture one snapshot bundle per bench stage, so a perf regression

@@ -49,6 +49,7 @@ class Node:
         self.durable_db = None
         self.replicator = None
         self.plugins = None
+        self.chaos = None
         self.bridge_registry = None
         self.license = None
         self.ft = None
@@ -287,6 +288,31 @@ class Node:
                 from .ds.replication import ReplicatedDs
 
                 self.replicator = ReplicatedDs(node, self.durable_mgr)
+
+        # 7b. chaos scenario engine (emqx_tpu/chaos) — ARMED, not run:
+        # the engine binds to this node's broker/cluster/sentinel so an
+        # operator can drive soak scenarios against the live node; the
+        # full million-session soak runs standalone (python -m
+        # emqx_tpu.chaos) or as the bench --soak stage
+        self.chaos = None
+        if cfg.get("chaos.enable"):
+            from .chaos.engine import ChaosEngine
+
+            self.chaos = ChaosEngine(
+                broker,
+                self.obs,
+                node=self.cluster_node,
+                sessions=cfg.get("chaos.sessions"),
+                groups=cfg.get("chaos.groups"),
+                zipf_s=cfg.get("chaos.zipf_s"),
+                storm_chunk=cfg.get("chaos.storm_chunk"),
+                sample_n=cfg.get("chaos.audit_sample_n"),
+            )
+            log.info(
+                "chaos engine armed: %s sessions, 1/%s audit sampling",
+                cfg.get("chaos.sessions"),
+                cfg.get("chaos.audit_sample_n"),
+            )
 
         # 8. listeners (+ the node-wide TLS-PSK identity store the
         # QUIC listeners authenticate against — ref: apps/emqx_psk)

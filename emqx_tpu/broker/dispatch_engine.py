@@ -64,6 +64,35 @@ from typing import Deque, List, Optional, Tuple
 from .message import Message
 
 
+class _AggregateCount:
+    """Future-compatible shim folding N per-publish delivery counts
+    into ONE awaitable — the storm surface (submit_many) enqueues a
+    whole chunk against a single future instead of paying a Future
+    allocation + callback wake per publish. Only the three methods
+    _flush/_collect_one actually touch are implemented."""
+
+    __slots__ = ("_fut", "_left", "_total")
+
+    def __init__(self, fut: "asyncio.Future", n: int) -> None:
+        self._fut = fut
+        self._left = n
+        self._total = 0
+
+    def done(self) -> bool:
+        return self._fut.done()
+
+    def set_result(self, n: int) -> None:
+        self._total += n
+        self._left -= 1
+        if self._left <= 0 and not self._fut.done():
+            self._fut.set_result(self._total)
+
+    def set_exception(self, exc: BaseException) -> None:
+        self._left -= 1
+        if not self._fut.done():
+            self._fut.set_exception(exc)
+
+
 class DispatchEngine:
     """One engine per Broker. All entry points must run on the
     broker's event loop; the engine holds no locks — ordering comes
@@ -119,6 +148,33 @@ class DispatchEngine:
         if len(self._queue) >= self.queue_depth:
             self._flush()
         elif self._timer is None:
+            self._timer = loop.call_later(self.deadline_s, self._on_deadline)
+        return fut
+
+    def submit_many(self, msgs) -> "asyncio.Future":
+        """Storm surface: enqueue a chunk of publishes as one unit and
+        return ONE future resolving to the summed delivery count. Same
+        hooks, same match path, same sentinel sampling per message as
+        submit() — only the per-publish Future ceremony is amortized,
+        which is what lets a million-session soak generator saturate
+        the pipeline from a single driver task."""
+        assert not self.closed, "dispatch engine stopped"
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        if not msgs:
+            fut.set_result(0)
+            return fut
+        agg = _AggregateCount(fut, len(msgs))
+        st = self.broker.sentinel
+        clock = self.telemetry.clock
+        for msg in msgs:
+            span = st.maybe_span(msg) if st is not None else None
+            # _flush REPLACES self._queue with a fresh list — re-read
+            # it each append rather than holding a stale binding
+            self._queue.append((msg, agg, clock(), span))
+            if len(self._queue) >= self.queue_depth:
+                self._flush()
+        if self._queue and self._timer is None:
             self._timer = loop.call_later(self.deadline_s, self._on_deadline)
         return fut
 

@@ -1,0 +1,48 @@
+"""Chaos scenario engine — million-session soak judged by the sentinel.
+
+Everything PRs 1-6 built *detects* production failure: the sentinel's
+shadow-oracle audit, SLO burn-rate alarms, the flight recorder, the
+quarantine/clean-sync recovery loop. Nothing *generated* production
+failure conditions at the scale the ROADMAP targets — so until now the
+detect→quarantine→recover chain had only ever fired against unit-test
+miniatures. This package is the proof layer: it sustains 1M+
+lightweight sessions through the real broker (real Session objects,
+real Router routes, the real pipelined dispatch engine) and drives the
+production failure catalog against them —
+
+  * connect/subscribe/publish storms with Zipf topic skew,
+  * mass-disconnect + session-takeover waves,
+  * node purge / evacuation through cluster/rebalance.py,
+  * cluster partition through the RPC plane's black-hole seam,
+  * injected device-table row corruption (Router.chaos_corrupt_rows)
+
+— while the sentinel, SLO tracker, and flight recorder judge the
+outcome. Every scenario declares an expected response contract and the
+engine asserts it: SLOs hold *or* burn-rate alarms fire; corruption is
+detected within one audit window, quarantine engages and auto-clears
+on the next clean sync; flight bundles capture the anomaly;
+`emqx_xla_audit_divergence_total` accounts for every injected fault;
+the final state is audit-clean with zero *silent* divergence.
+
+This is the analog of the reference's cross-app takeover / rebalance /
+purge suites (SURVEY L1/L2): storm generators asserting the broker's
+*response*, not just its steady state.
+
+Entry points: `bench.py --soak` (the committed SOAK row) and
+`python -m emqx_tpu.chaos` (standalone driver).
+"""
+
+from .engine import (  # noqa: F401
+    ChaosEngine,
+    ContractViolation,
+    SessionFleet,
+    ZipfTopics,
+    run_soak,
+)
+from .scenarios import (  # noqa: F401
+    CATALOG,
+    Check,
+    Scenario,
+    ScenarioResult,
+    scenario_catalog,
+)

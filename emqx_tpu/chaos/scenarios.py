@@ -1,0 +1,749 @@
+"""The chaos scenario catalog, each with its expected-response
+contract — the analog of the reference's cross-app suites (SURVEY
+L1/L2): `emqx_cm` / channel-takeover tests, `emqx_node_rebalance`
+evacuation/purge SUITEs, `emqx_router_helper` nodedown purge, and the
+route-consistency checks. A scenario does three things: inject the
+fault, drive the system while the fault is live, and assert the
+broker's *response* — detection, alarming, quarantine, recovery — not
+merely that it survived.
+
+Contract vocabulary (every scenario emits `Check` rows):
+  * detection:  the sentinel confirms the fault within one audit
+    window (a bounded number of sampled publishes);
+  * paging:     the matching alarm fired during the scenario window —
+    SLOs hold OR burn-rate alarms fire, never breached-and-silent;
+  * forensics:  a flight bundle captured the anomaly;
+  * recovery:   quarantine engaged AND auto-cleared on the next clean
+    sync; cluster state reconverged after heal;
+  * accounting: `emqx_xla_audit_divergence_total` moved for every
+    injected fault — nothing detected-but-uncounted.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+log = logging.getLogger("emqx_tpu.chaos.scenarios")
+
+DIVERGENCE_ALARM = "xla_audit_divergence"
+
+
+@dataclass
+class Check:
+    name: str
+    ok: bool
+    detail: str = ""
+
+
+@dataclass
+class ScenarioResult:
+    name: str
+    checks: List[Check] = field(default_factory=list)
+    detect_ms: Optional[float] = None
+    recovery_ms: Optional[float] = None
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def ok(self) -> bool:
+        return all(c.ok for c in self.checks)
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "ok": self.ok,
+            "checks": [
+                {"name": c.name, "ok": c.ok, "detail": c.detail}
+                for c in self.checks
+            ],
+            "detect_ms": self.detect_ms,
+            "recovery_ms": self.recovery_ms,
+            **self.extra,
+        }
+
+
+class Scenario:
+    """Base: a named fault + its contract. `run` receives the engine
+    and returns a ScenarioResult whose checks the engine asserts."""
+
+    name = "scenario"
+    reference = ""  # the reference suite this mirrors (PARITY.md)
+    needs_cluster = False
+
+    async def run(self, eng) -> ScenarioResult:  # pragma: no cover
+        raise NotImplementedError
+
+
+def _slo_check(eng, t0_wall: float) -> Check:
+    """SLOs hold OR burn alarms fire: an objective that burned through
+    the window without paging is the one forbidden state."""
+    silent = []
+    for name, obj in eng.sentinel.slo.items():
+        s = obj.evaluate()
+        alarm = f"xla_slo_{name}_burn"
+        if s["breached"] and not (
+            eng.alarms.is_active(alarm)
+            or alarm in eng.alarms.fired_since(t0_wall)
+        ):
+            silent.append(name)
+    return Check(
+        "slo_holds_or_alarms",
+        not silent,
+        "breached-and-silent: " + ",".join(silent) if silent else "clean",
+    )
+
+
+def _fires(eng, rule: str) -> int:
+    """How many times a flight trigger rule has FIRED (bundle written).
+    The rotation-immune count — `store.list()` drops old bundles at
+    max_snapshots, which would make a presence check racy."""
+    fl = eng.flight
+    if fl is None:
+        return 0
+    return fl.triggers_total.get(rule, 0)
+
+
+class StormBaseline(Scenario):
+    """No fault at all: a pure storm window. The contract is the
+    boring one production lives on — deliveries flow, zero divergence,
+    SLOs clean or paged."""
+
+    name = "storm_baseline"
+    reference = "emqx_broker_SUITE publish storms"
+
+    def __init__(self, seconds: float = 5.0):
+        self.seconds = seconds
+
+    async def run(self, eng) -> ScenarioResult:
+        res = ScenarioResult(self.name)
+        t0w = time.time()
+        d0, p0 = eng.delivered, eng.published
+        det0 = len(eng.detections)
+        await asyncio.sleep(self.seconds)
+        res.checks.append(
+            Check(
+                "deliveries_flow",
+                eng.delivered > d0 and eng.published > p0,
+                f"+{eng.published - p0} pub / +{eng.delivered - d0} dlv",
+            )
+        )
+        res.checks.append(
+            Check(
+                "no_divergence",
+                len(eng.detections) == det0,
+                f"{len(eng.detections) - det0} unexpected",
+            )
+        )
+        res.checks.append(_slo_check(eng, t0w))
+        res.extra["window_s"] = self.seconds
+        return res
+
+
+class _CorruptionBase(Scenario):
+    """Shared inject→detect→quarantine→auto-clear→verify walk; the
+    subclasses differ only in WHAT they corrupt."""
+
+    def _corrupt(self, eng, flt: str) -> int:  # pragma: no cover
+        raise NotImplementedError
+
+    async def _one_fault(self, eng, flt: str, res: ScenarioResult) -> None:
+        c0 = eng.counters()
+        det0 = len(eng.detections)
+        # warm: the row must be device-resident and serving clean
+        fan0 = await eng.burst([eng.fresh_topic(flt)])
+        corrupted = self._corrupt(eng, flt)
+        eng.record_fault(self.name, {"filter": flt, "slots": corrupted})
+        res.checks.append(
+            Check("injectable", corrupted >= 1, f"{flt}: {corrupted} slots")
+        )
+        if corrupted < 1:
+            return
+        t_inj = time.monotonic()
+        t0w = time.time()
+        detected = False
+        rounds = 0
+        for rounds in range(1, eng.detect_rounds + 1):
+            await eng.burst(
+                [eng.fresh_topic(flt) for _ in range(eng.detect_burst)]
+            )
+            if len(eng.detections) > det0:
+                detected = True
+                break
+        window = rounds * eng.detect_burst
+        res.checks.append(
+            Check(
+                "detected_within_window",
+                detected,
+                f"{window} publishes ({rounds} rounds, "
+                f"sample 1/{eng.sentinel.sample_n})",
+            )
+        )
+        if detected:
+            eng.faults_detected += 1
+            res.detect_ms = round(
+                (eng.detections[-1][0] - t_inj) * 1e3, 2
+            )
+        # recovery: quarantine engaged, then auto-cleared by the next
+        # clean table sync (driving fresh matches forces the sync)
+        c1 = eng.counters()
+        res.checks.append(
+            Check(
+                "quarantine_engaged",
+                c1.get("audit_quarantine_total", 0)
+                > c0.get("audit_quarantine_total", 0),
+                f"quarantined={eng.router.quarantined_filters()}",
+            )
+        )
+        rec = await eng.drive_until(
+            lambda: not eng.router.quarantined_filters()
+            and eng.counters().get("audit_unquarantine_total", 0)
+            > c0.get("audit_unquarantine_total", 0),
+            flt=flt,
+            timeout=eng.settle_timeout,
+        )
+        res.checks.append(
+            Check(
+                "quarantine_auto_cleared",
+                rec is not None,
+                f"{round(rec * 1e3, 1)}ms" if rec is not None else "timeout",
+            )
+        )
+        if rec is not None:
+            res.recovery_ms = round((time.monotonic() - t_inj) * 1e3, 2)
+        # post-recovery: the healed device serves the full fan again
+        post = await eng.burst([eng.fresh_topic(flt) for _ in range(4)])
+        res.checks.append(
+            Check(
+                "post_recovery_serving",
+                post == 4 * eng.chaos_fan and fan0 == eng.chaos_fan,
+                f"fan {post}/4 bursts (want {4 * eng.chaos_fan})",
+            )
+        )
+        c2 = eng.counters()
+        res.checks.append(
+            Check(
+                "divergence_accounted",
+                c2.get("audit_divergence_total", 0)
+                > c0.get("audit_divergence_total", 0),
+                f"+{c2.get('audit_divergence_total', 0) - c0.get('audit_divergence_total', 0)}",
+            )
+        )
+        res.checks.append(
+            Check(
+                "alarm_raised",
+                DIVERGENCE_ALARM in eng.alarms.fired_since(t0w)
+                or eng.alarms.is_active(DIVERGENCE_ALARM),
+                DIVERGENCE_ALARM,
+            )
+        )
+
+
+class RowCorruption(_CorruptionBase):
+    """Scoped device-row decay: one filter's cuckoo slot emptied on
+    device while every other row keeps serving — detection must come
+    from the sampled shadow audit, not from gross failure."""
+
+    name = "row_corruption"
+    reference = (
+        "route-consistency checks (emqx_router_SUITE) against "
+        "single-row device memory decay"
+    )
+
+    def __init__(self, faults: int = 2):
+        self.faults = faults
+
+    async def run(self, eng) -> ScenarioResult:
+        res = ScenarioResult(self.name)
+        fires0 = _fires(eng, "audit_divergence")
+        eng.reset_flight_cooldown("audit_divergence")
+        for i in range(self.faults):
+            flt = eng.chaos_filters[i % len(eng.chaos_filters)]
+            await self._one_fault(eng, flt, res)
+        # scenario-level: ≥1 bundle froze for this window (the rule's
+        # cooldown intentionally coalesces faults inside one window)
+        res.checks.append(
+            Check(
+                "flight_bundle_captured",
+                _fires(eng, "audit_divergence") > fires0,
+                "audit_divergence trigger fired",
+            )
+        )
+        res.extra["faults"] = self.faults
+        return res
+
+    def _corrupt(self, eng, flt: str) -> int:
+        return eng.router.chaos_corrupt_rows([flt])
+
+
+class SlotDecay(_CorruptionBase):
+    """Whole-table decay: every device cuckoo slot empties at once (the
+    gross-failure mode). The first detected divergence quarantines and
+    flags a FULL index re-upload, so ONE quarantine cycle must heal
+    the entire table."""
+
+    name = "slot_decay"
+    reference = "whole-table memory decay vs emqx route rebuild"
+
+    async def run(self, eng) -> ScenarioResult:
+        res = ScenarioResult(self.name)
+        fires0 = _fires(eng, "audit_divergence")
+        eng.reset_flight_cooldown("audit_divergence")
+        await self._one_fault(eng, eng.chaos_filters[0], res)
+        # the whole table healed, not just the audited filter: every
+        # chaos filter must serve its full fan again
+        post = await eng.burst(
+            [eng.fresh_topic(f) for f in eng.chaos_filters]
+        )
+        res.checks.append(
+            Check(
+                "whole_table_healed",
+                post == len(eng.chaos_filters) * eng.chaos_fan,
+                f"{post} deliveries from {len(eng.chaos_filters)} filters",
+            )
+        )
+        res.checks.append(
+            Check(
+                "flight_bundle_captured",
+                _fires(eng, "audit_divergence") > fires0,
+                "audit_divergence trigger fired",
+            )
+        )
+        return res
+
+    def _corrupt(self, eng, flt: str) -> int:
+        return eng.router.chaos_corrupt_slots()
+
+
+class DisconnectTakeover(Scenario):
+    """Mass-disconnect + same-node session takeover: a wave of the
+    fleet drops (eviction agent), the storm keeps running, the wave
+    reconnects with clean_start=False and must resume its sessions —
+    routes intact, no divergence, deliveries restored."""
+
+    name = "disconnect_takeover"
+    reference = (
+        "emqx_cm takeover + emqx_eviction_agent_SUITE "
+        "(connection eviction, session preservation)"
+    )
+
+    def __init__(self, wave: Optional[int] = None):
+        self.wave = wave
+
+    async def run(self, eng) -> ScenarioResult:
+        from ..cluster.rebalance import EvictionAgent
+
+        res = ScenarioResult(self.name)
+        t0w = time.time()
+        det0 = len(eng.detections)
+        fleet = eng.fleet
+        wave = self.wave or max(100, fleet.n // 20)
+        wave = min(wave, len(fleet.clients))
+        pre_connected = eng.broker.connected_count()
+        agent = EvictionAgent(eng.broker)
+        t_wave = time.monotonic()
+        evicted = agent.evict_connections(wave)
+        res.checks.append(
+            Check("wave_evicted", evicted == wave, f"{evicted}/{wave}")
+        )
+        # the fleet builds first, so eviction order == fleet order
+        wave_cids = [
+            cid
+            for cid in fleet.clients[: wave * 2]
+            if not eng.broker.sessions[cid].connected
+        ]
+        res.checks.append(
+            Check(
+                "wave_identified",
+                len(wave_cids) == evicted,
+                f"{len(wave_cids)} disconnected",
+            )
+        )
+        d0 = eng.delivered
+        await asyncio.sleep(0.2)  # storm runs against the degraded fleet
+        # takeover: reconnect with clean_start=False -> session resumed
+        resumed = 0
+        b = eng.broker
+        for i, cid in enumerate(wave_cids):
+            s, present = b.open_session(
+                cid, clean_start=False, cfg=fleet.cfg
+            )
+            s.outgoing_sink = fleet.sink
+            resumed += bool(present)
+            if (i + 1) % 2048 == 0:
+                await asyncio.sleep(0)
+        res.recovery_ms = round((time.monotonic() - t_wave) * 1e3, 2)
+        res.checks.append(
+            Check(
+                "sessions_resumed",
+                resumed == len(wave_cids),
+                f"{resumed}/{len(wave_cids)} session_present",
+            )
+        )
+        subs_ok = all(
+            len(b.sessions[cid].subscriptions) == 1
+            for cid in wave_cids[:32]
+        )
+        res.checks.append(
+            Check("subscriptions_survived", subs_ok, "sampled 32")
+        )
+        res.checks.append(
+            Check(
+                "connected_restored",
+                eng.broker.connected_count() == pre_connected,
+                f"{eng.broker.connected_count()}/{pre_connected}",
+            )
+        )
+        res.checks.append(
+            Check(
+                "no_divergence",
+                len(eng.detections) == det0,
+                f"{len(eng.detections) - det0} unexpected",
+            )
+        )
+        res.checks.append(
+            Check(
+                "deliveries_flow", eng.delivered > d0,
+                f"+{eng.delivered - d0}",
+            )
+        )
+        res.checks.append(_slo_check(eng, t0w))
+        res.extra["wave"] = wave
+        return res
+
+
+class PartitionNodedown(Scenario):
+    """Cluster partition through the RPC black-hole seam: the victim
+    vanishes without an RST. Contract: control-plane calls stay
+    BOUNDED (timeout + counted retries, no hang), the membership
+    declares the peer down within its miss budget, the survivor purges
+    the dead node's contribution in one batched sweep, and heal+rejoin
+    reconverges both replicas — forwards flowing again."""
+
+    name = "partition_nodedown"
+    reference = (
+        "emqx_router_helper nodedown purge + ekka membership "
+        "partition handling"
+    )
+    needs_cluster = True
+
+    async def run(self, eng) -> ScenarioResult:
+        res = ScenarioResult(self.name)
+        main, victim = eng.node, eng.victim
+        ma, va = main.rpc.listen_addr, victim.rpc.listen_addr
+        # the reconvergence target is the victim's LOCAL truth (its
+        # announced route contribution) — the survivor-side pair count
+        # can already be racing a heartbeat miss under load
+        vpairs = len(victim._local_refs)
+        res.extra["victim_routes_before"] = vpairs
+        c0 = eng.counters()
+        eng.record_fault(
+            "partition", {"victim": victim.node_id, "routes": vpairs}
+        )
+        # a wire fault is not an audit divergence; the injection counts
+        # as detected when the MEMBERSHIP layer declares the nodedown
+        main.rpc.partition(va)
+        victim.rpc.partition(ma)
+        t_inj = time.monotonic()
+        # rollup first, while the victim is still a member: it must
+        # report the peer unreachable, not hang on it (if a heartbeat
+        # already dropped the peer, that IS the detection — accept it)
+        t_roll = time.monotonic()
+        roll = await main.sentinel_rollup()
+        roll_s = time.monotonic() - t_roll
+        res.checks.append(
+            Check(
+                "rollup_bounded",
+                (
+                    roll["cluster"]["unreachable"] >= 1
+                    or victim.node_id not in main.membership.members
+                )
+                and roll_s < 15.0,
+                f"{roll_s * 1e3:.0f}ms, "
+                f"unreachable={roll['cluster']['unreachable']}",
+            )
+        )
+        # bounded control-plane RPC: the retried call must fail within
+        # its budget, never hang on the black hole. The wall-clock
+        # bound is generous — under storm load the event loop itself
+        # stalls for whole batches — but it is a BOUND, which is the
+        # contract (the pre-PR behavior was an open-ended hang).
+        t_call = time.monotonic()
+        raised = False
+        try:
+            await main.call_retry(
+                va, "node", "info", timeout=0.3, retries=1
+            )
+        except (Exception,):
+            raised = True
+        elapsed = time.monotonic() - t_call
+        res.checks.append(
+            Check(
+                "rpc_bounded",
+                raised and elapsed < 10.0,
+                f"failed in {elapsed * 1e3:.0f}ms (bound 10s)",
+            )
+        )
+        c1 = eng.counters()
+        res.checks.append(
+            Check(
+                "rpc_retry_counted",
+                c1.get("rpc_retry_total", 0) > c0.get("rpc_retry_total", 0)
+                and c1.get("rpc_unreachable_total", 0)
+                > c0.get("rpc_unreachable_total", 0),
+                f"retries +{c1.get('rpc_retry_total', 0) - c0.get('rpc_retry_total', 0)}",
+            )
+        )
+        # failure detection within the miss budget (each heartbeat
+        # cycle = interval + ping timeout while black-holed)
+        ms = main.membership
+        budget = (
+            (ms.heartbeat_interval + ms.ping_timeout)
+            * (ms.miss_threshold + 2)
+            + 3.0
+        )
+        down = await eng.wait_for(
+            lambda: victim.node_id not in ms.members,
+            timeout=budget,
+        )
+        res.checks.append(
+            Check(
+                "nodedown_detected",
+                down is not None,
+                f"{down:.2f}s (budget {budget:.1f}s)"
+                if down is not None
+                else f"not within {budget:.1f}s",
+            )
+        )
+        if down is not None:
+            eng.faults_detected += 1
+            res.detect_ms = round(
+                (time.monotonic() - t_inj) * 1e3, 2
+            )
+        # survivor purge: the dead node's contribution swept (batched)
+        purged = await eng.wait_for(
+            lambda: not any(
+                n == victim.node_id for _f, n in main._cluster_pairs
+            ),
+            timeout=5.0,
+        )
+        res.checks.append(
+            Check(
+                "survivor_purged_routes",
+                purged is not None,
+                f"{vpairs} routes swept",
+            )
+        )
+        # heal + rejoin + reconverge
+        main.rpc.heal()
+        victim.rpc.heal()
+        t_heal = time.monotonic()
+        await eng.wait_for(
+            lambda: main.node_id not in victim.membership.members,
+            timeout=budget,
+        )  # let the victim finish declaring US down before rejoining
+        await victim.join(ma)
+        reconv = await eng.wait_for(
+            lambda: sum(
+                1 for _f, n in main._cluster_pairs if n == victim.node_id
+            )
+            >= vpairs,
+            timeout=eng.settle_timeout + 30.0,
+        )
+        res.checks.append(
+            Check(
+                "rejoin_reconverged",
+                reconv is not None,
+                f"{vpairs} routes restored in "
+                f"{(time.monotonic() - t_heal):.1f}s"
+                if reconv is not None
+                else "routes did not reconverge",
+            )
+        )
+        res.recovery_ms = round((time.monotonic() - t_inj) * 1e3, 2)
+        # the forward leg flows again
+        if eng.victim_fleet is not None and reconv is not None:
+            v0 = victim.broker.metrics.val("messages.delivered")
+            await eng.burst(
+                [eng.victim_fleet.topic_of(0, "postheal")]
+            )
+            flowed = await eng.wait_for(
+                lambda: victim.broker.metrics.val("messages.delivered")
+                > v0,
+                timeout=3.0,
+            )
+            res.checks.append(
+                Check(
+                    "forward_leg_restored",
+                    flowed is not None,
+                    "cross-node delivery after heal",
+                )
+            )
+        return res
+
+
+class NodeEvacuation(Scenario):
+    """Evacuation drain + cross-node takeover: the victim stops taking
+    connections and sheds the fleet at a bounded rate (v5
+    USE_ANOTHER_SERVER); a sample of the shed clients reconnects on the
+    survivor, which imports their sessions over the takeover RPC."""
+
+    name = "node_evacuation"
+    reference = "emqx_node_rebalance_evacuation_SUITE"
+    needs_cluster = True
+
+    def __init__(self, takeover_sample: int = 200):
+        self.takeover_sample = takeover_sample
+
+    async def run(self, eng) -> ScenarioResult:
+        from ..cluster.rebalance import NodeEvacuation as Evac
+
+        res = ScenarioResult(self.name)
+        victim = eng.victim
+        vfleet = eng.victim_fleet
+        n0 = victim.broker.connected_count()
+        ev = Evac(
+            victim.broker,
+            conn_evict_rate=max(2000, n0),
+            server_reference="chaos-main",
+        )
+        t0 = time.monotonic()
+        await ev.start()
+        drained = await eng.wait_for(
+            lambda: ev.status == "drained", timeout=15.0
+        )
+        res.checks.append(
+            Check(
+                "evacuation_drained",
+                drained is not None
+                and victim.broker.connected_count() == 0,
+                f"{n0} connections in {(time.monotonic() - t0):.1f}s",
+            )
+        )
+        await ev.stop()
+        # takeover: shed clients land on the survivor and import state
+        sample = vfleet.clients[: min(self.takeover_sample, len(vfleet.clients))]
+        b = eng.broker
+        for cid in sample:
+            s, _present = b.open_session(
+                cid, clean_start=False, cfg=vfleet.cfg
+            )
+            s.outgoing_sink = vfleet.sink
+        imported = await eng.wait_for(
+            lambda: all(
+                cid in b.sessions and b.sessions[cid].subscriptions
+                for cid in sample
+            ),
+            timeout=eng.settle_timeout,
+        )
+        res.checks.append(
+            Check(
+                "takeover_imported",
+                imported is not None,
+                f"{len(sample)} sessions moved with subscriptions",
+            )
+        )
+        await eng.settle()
+        gone = await eng.wait_for(
+            lambda: all(
+                cid not in victim.broker.sessions for cid in sample
+            ),
+            timeout=eng.settle_timeout,
+        )
+        res.checks.append(
+            Check(
+                "old_owner_released",
+                gone is not None,
+                "victim discarded moved sessions",
+            )
+        )
+        owned = sum(
+            1
+            for cid in sample
+            if eng.node.registry.get(cid) == eng.node.node_id
+        )
+        res.checks.append(
+            Check(
+                "registry_moved",
+                owned == len(sample),
+                f"{owned}/{len(sample)} owned by survivor",
+            )
+        )
+        res.recovery_ms = round((time.monotonic() - t0) * 1e3, 2)
+        res.extra["evacuated"] = n0
+        return res
+
+
+class NodePurge(Scenario):
+    """Maintenance purge of the victim: every session discarded at a
+    bounded rate; the survivor's replicated tables must retract the
+    victim's contribution as the purge announces the deletes."""
+
+    name = "node_purge"
+    reference = "emqx_node_rebalance_purge_SUITE"
+    needs_cluster = True
+
+    async def run(self, eng) -> ScenarioResult:
+        from ..cluster.rebalance import NodePurge as Purge
+
+        res = ScenarioResult(self.name)
+        victim = eng.victim
+        n0 = len(victim.broker.sessions)
+        purge = Purge(victim.broker, purge_rate=5000)
+        t0 = time.monotonic()
+        await purge.start()
+        done = await eng.wait_for(
+            lambda: purge.status == "purged", timeout=30.0
+        )
+        res.checks.append(
+            Check(
+                "purge_completed",
+                done is not None and not victim.broker.sessions,
+                f"{purge.purged} sessions in {(time.monotonic() - t0):.1f}s",
+            )
+        )
+        await eng.settle()
+        retracted = await eng.wait_for(
+            lambda: not any(
+                n == victim.node_id for _f, n in eng.node._cluster_pairs
+            ),
+            timeout=eng.settle_timeout,
+        )
+        res.checks.append(
+            Check(
+                "survivor_retracted_routes",
+                retracted is not None,
+                "victim contribution gone from survivor replica",
+            )
+        )
+        res.recovery_ms = round((time.monotonic() - t0) * 1e3, 2)
+        res.extra["purged"] = purge.purged
+        res.extra["sessions_before"] = n0
+        return res
+
+
+def scenario_catalog(cluster: bool = True) -> List[Scenario]:
+    """The ordered soak catalog. Destructive cluster scenarios run
+    LAST (evacuation/purge consume the victim fleet); corruption runs
+    early while the fleet is pristine so fan expectations are exact."""
+    cat: List[Scenario] = [
+        StormBaseline(),
+        RowCorruption(faults=2),
+        DisconnectTakeover(),
+    ]
+    if cluster:
+        cat += [PartitionNodedown(), NodeEvacuation(), NodePurge()]
+    cat.append(SlotDecay())
+    return cat
+
+
+CATALOG = [
+    StormBaseline.name,
+    RowCorruption.name,
+    DisconnectTakeover.name,
+    PartitionNodedown.name,
+    NodeEvacuation.name,
+    NodePurge.name,
+    SlotDecay.name,
+]

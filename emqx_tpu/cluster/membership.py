@@ -34,11 +34,22 @@ class Membership:
         rpc: RpcPlane,
         heartbeat_interval: float = 1.0,
         miss_threshold: int = 3,
+        ping_timeout: Optional[float] = None,
     ):
         self.rpc = rpc
         self.node_id = rpc.node_id
         self.heartbeat_interval = heartbeat_interval
         self.miss_threshold = miss_threshold
+        # a ping timeout EQUAL to the interval counts one stalled event
+        # loop turn as a full miss — under load (storm windows, bulk
+        # purges) that manufactures spurious nodedowns, found by the
+        # chaos soak. Default: twice the interval; a genuinely dead TCP
+        # peer still fails fast via connection refusal.
+        self.ping_timeout = (
+            ping_timeout
+            if ping_timeout is not None
+            else heartbeat_interval * 2
+        )
         self.members: Dict[str, Addr] = {}  # peers only (not self)
         self._misses: Dict[str, int] = {}
         self.on_member_up: List[Callable[[str, Addr], None]] = []
@@ -150,7 +161,7 @@ class Membership:
                 "membership",
                 "ping",
                 key=rpc_mod.CONTROL,
-                timeout=self.heartbeat_interval,
+                timeout=self.ping_timeout,
             )
             self._misses[node_id] = 0
             for cb in self.on_ping_ok:

@@ -45,6 +45,12 @@ log = logging.getLogger("emqx_tpu.cluster.node")
 SYNC_MAX_BATCH = 1000  # ref: emqx_router_syncer ?MAX_BATCH_SIZE
 SYNC_MAX_DELAY = 0.002
 
+# ops/sessions per bootstrap/resync page. A million-route table dumped
+# in ONE frame is ~80MB — over the RPC MAX_FRAME cap — and its encode
+# stalls the seed's event loop for seconds; paging bounds both. Found
+# by the chaos soak's partition-heal rejoin at 1M routes.
+DUMP_PAGE = 200_000
+
 
 def msg_to_wire(msg: Message) -> dict:
     return {
@@ -134,6 +140,7 @@ class ClusterNode:
         heartbeat_interval: float = 1.0,
         miss_threshold: int = 3,
         cookie: Optional[str] = None,
+        ping_timeout: Optional[float] = None,
     ):
         self.node_id = node_id
         self.broker = broker or ClusterBroker()
@@ -144,6 +151,7 @@ class ClusterNode:
             self.rpc,
             heartbeat_interval=heartbeat_interval,
             miss_threshold=miss_threshold,
+            ping_timeout=ping_timeout,
         )
         # cluster route table: filter -> node ids (FULL replica; a
         # Router so batched cluster matching uses the TPU kernel)
@@ -187,6 +195,22 @@ class ClusterNode:
         self.broker.on_exclusive_claimed = self._on_exclusive_claimed
         self.broker.on_exclusive_released = self._on_exclusive_released
         self.membership.on_member_down.append(self._purge_node)
+        # bounded-RPC discipline (chaos-partition hardening): every
+        # control-plane call this node originates carries an explicit
+        # timeout and a bounded-backoff retry instead of hanging on a
+        # dead peer for the transport default. Counted on the scrape
+        # (emqx_xla_rpc_retry_total / emqx_xla_rpc_unreachable_total).
+        self.rpc_timeout = 2.0
+        self.rpc_retries = 2
+        self.rpc_backoff = 0.05
+        # in-flight paged bootstrap snapshots: token -> (ops, sessions)
+        self._boot_token = 0
+        self._boot_dumps: Dict[int, tuple] = {}
+        # supervised background tasks: strong refs (bare ensure_future
+        # is GC-able) + a done-callback that surfaces exceptions — a
+        # chaos-injected fault in a forwarded cast must be counted,
+        # never silently swallowed by a dropped task object
+        self._tasks: set = set()
         # per-clientid cluster locks this node LEADS (emqx_cm_locker /
         # ekka_locker analog): client_id -> holder node. Purged when
         # the holder dies so a crashed takeover can't wedge the id.
@@ -218,11 +242,23 @@ class ClusterNode:
 
     async def join(self, seed: Addr) -> None:
         await self.membership.join(seed)
-        # bootstrap the replicated tables from the seed (mria join copy)
-        dump = await self.rpc.call(seed, "route", "bootstrap")
-        self._apply_ops(dump["ops"])
-        for client, node in dump["sessions"]:
-            self.registry[client] = node
+        # bootstrap the replicated tables from the seed (mria join
+        # copy), PAGED: million-route tables must neither exceed the
+        # RPC frame cap nor stall the seed's loop in one encode. Each
+        # page is a bounded explicit-timeout call — a seed that dies
+        # mid-join fails the join, not the boot.
+        token, cursor = None, 0
+        while True:
+            page = await self.call_retry(
+                seed, "route", "bootstrap", (token, cursor),
+                timeout=30.0, retries=1,
+            )
+            self._apply_ops(page["ops"])
+            for client, node in page["sessions"]:
+                self.registry[client] = node
+            token, cursor = page["token"], page["next"]
+            if page["done"]:
+                break
         # the dump may credit a PREVIOUS incarnation of this node_id
         # (restart + rejoin before the heartbeat declared us down):
         # drop everything attributed to us, rebuild from local truth,
@@ -244,18 +280,38 @@ class ClusterNode:
             self.registry[client] = self.node_id
 
     async def _resync_all(self) -> None:
-        ops = self._full_dump_ops()
-        sessions = [(c, n) for c, n in self.registry.items() if n == self.node_id]
         for node, addr in list(self.membership.members.items()):
             try:
-                await self.rpc.call(
-                    addr, "route", "resync", (self.node_id, ops, sessions)
-                )
+                await self._send_resync(addr)
                 # a peer pre-scheduled by member_up is now covered —
                 # don't re-send the identical dump on its next ping
                 self._resync.discard(node)
             except Exception:
                 self._resync.add(node)
+
+    async def _send_resync(self, addr: Addr) -> None:
+        """Push this node's full contribution to one peer, PAGED (same
+        frame-cap/loop-stall bound as the join bootstrap). The first
+        page carries first=True so the receiver purges our previous
+        contribution exactly once; later pages append."""
+        ops = self._full_dump_ops()
+        sessions = [
+            (c, n) for c, n in self.registry.items() if n == self.node_id
+        ]
+        total = max(len(ops), len(sessions), 1)
+        first = True
+        for i in range(0, total, DUMP_PAGE):
+            await self.call_retry(
+                addr, "route", "resync",
+                (
+                    self.node_id,
+                    ops[i:i + DUMP_PAGE],
+                    sessions[i:i + DUMP_PAGE],
+                    first,
+                ),
+                timeout=10.0,
+            )
+            first = False
 
     async def stop(self) -> None:
         self.membership.stop_heartbeat()
@@ -332,8 +388,15 @@ class ClusterNode:
         nodes = {self.node_id: self._handle_sentinel_status()}
         members = list(self.membership.members.items())
         if members:
-            results = await self.rpc.multicall(
-                [addr for _n, addr in members], "sentinel", "status"
+            # bounded fan-out: each peer gets the explicit-timeout +
+            # backoff-retry leg, so one partitioned node delays the
+            # rollup by at most its retry budget, never an open hang
+            results = await asyncio.gather(
+                *(
+                    self.call_retry(addr, "sentinel", "status")
+                    for _n, addr in members
+                ),
+                return_exceptions=True,
             )
             for (node, _addr), res in zip(members, results):
                 nodes[node] = (
@@ -618,20 +681,45 @@ class ClusterNode:
                 ops.append(("xadd", topic, node, self.broker.exclusive[topic]))
         return ops
 
-    def _handle_bootstrap(self) -> dict:
-        """Full replica dump for a joining node."""
-        ops: List[tuple] = [
-            ("add_r", flt, node) for (flt, node) in self._cluster_pairs
-        ]
-        for (group, flt), members in self.cluster_shared.items():
-            for node, client in members:
-                ops.append(("add_s", group, flt, node, client))
-        for topic, node in self._exclusive_owner.items():
-            if topic in self.broker.exclusive:
-                ops.append(("xadd", topic, node, self.broker.exclusive[topic]))
+    def _handle_bootstrap(self, token=None, cursor: int = 0) -> dict:
+        """Full replica dump for a joining node, PAGED: the first call
+        (token None) snapshots the replica under a token; subsequent
+        calls stream DUMP_PAGE-sized slices of that consistent
+        snapshot (ops replicated while the joiner pages arrive through
+        the normal push stream — set semantics keep replays
+        idempotent). The snapshot is dropped with the final page; a
+        joiner that dies mid-page leaks at most one snapshot, replaced
+        on the next join."""
+        if token is None:
+            ops: List[tuple] = [
+                ("add_r", flt, node) for (flt, node) in self._cluster_pairs
+            ]
+            for (group, flt), members in self.cluster_shared.items():
+                for node, client in members:
+                    ops.append(("add_s", group, flt, node, client))
+            for topic, node in self._exclusive_owner.items():
+                if topic in self.broker.exclusive:
+                    ops.append(
+                        ("xadd", topic, node, self.broker.exclusive[topic])
+                    )
+            sessions = [(c, n) for c, n in self.registry.items()]
+            self._boot_token += 1
+            token = self._boot_token
+            self._boot_dumps[token] = (ops, sessions)
+        dump = self._boot_dumps.get(token)
+        if dump is None:
+            raise RpcError(f"unknown bootstrap token {token!r}")
+        ops, sessions = dump
+        end = cursor + DUMP_PAGE
+        done = end >= len(ops) and end >= len(sessions)
+        if done:
+            self._boot_dumps.pop(token, None)
         return {
-            "ops": ops,
-            "sessions": [(c, n) for c, n in self.registry.items()],
+            "token": token,
+            "next": end,
+            "done": done,
+            "ops": ops[cursor:end],
+            "sessions": sessions[cursor:end],
         }
 
     # --- replica resync (anti-entropy after a lost batch) ------------------
@@ -653,17 +741,22 @@ class ClusterNode:
         addr = self.membership.members.get(node_id)
         if addr is None:
             return
-        sessions = [(c, n) for c, n in self.registry.items() if n == self.node_id]
         try:
-            await self.rpc.call(
-                addr, "route", "resync", (self.node_id, self._full_dump_ops(), sessions)
-            )
+            await self._send_resync(addr)
         except Exception:
             self._resync.add(node_id)  # retry on the next good ping
 
-    def _handle_resync(self, origin: str, ops: List[tuple], sessions: list) -> None:
-        """Replace everything `origin` contributed with its fresh dump."""
-        self._purge_contrib(origin)
+    def _handle_resync(
+        self,
+        origin: str,
+        ops: List[tuple],
+        sessions: list,
+        first: bool = True,
+    ) -> None:
+        """Replace everything `origin` contributed with its fresh dump.
+        Paged senders purge on the FIRST page only, then append."""
+        if first:
+            self._purge_contrib(origin)
         self._apply_ops(ops)
         for client, node in sessions:
             self.registry[client] = node
@@ -760,8 +853,72 @@ class ClusterNode:
             group, flt, msg.topic, from_client=msg.from_client, exclude=exclude
         )
 
-    def _spawn(self, coro) -> None:
-        asyncio.ensure_future(coro)
+    async def call_retry(
+        self,
+        addr: Addr,
+        proto: str,
+        method: str,
+        args: tuple = (),
+        *,
+        key=None,
+        timeout: Optional[float] = None,
+        retries: Optional[int] = None,
+    ):
+        """Bounded control-plane RPC: explicit timeout + exponential
+        backoff, so a black-holed peer (injected partition, dead link)
+        costs at most (retries+1)*timeout + backoff instead of an
+        open-ended hang. Transport failures (PeerDown / timeout / OS)
+        retry; a REMOTE handler error (plain RpcError) propagates
+        immediately — retrying an application failure can't fix it.
+        Retries and final give-ups land on the scrape via the router's
+        kernel-telemetry counters."""
+        t = self.rpc_timeout if timeout is None else timeout
+        r = self.rpc_retries if retries is None else retries
+        tel = self.broker.router.telemetry
+        delay = self.rpc_backoff
+        attempt = 0
+        while True:
+            try:
+                return await self.rpc.call(
+                    addr, proto, method, args, key=key, timeout=t
+                )
+            except (PeerDown, asyncio.TimeoutError, OSError):
+                if attempt >= r:
+                    if tel.enabled:
+                        tel.count("rpc_unreachable_total")
+                    raise
+                attempt += 1
+                if tel.enabled:
+                    tel.count("rpc_retry_total")
+                await asyncio.sleep(delay)
+                delay *= 2
+
+    def _spawn(self, coro) -> "asyncio.Task":
+        """Supervised fire-and-forget: the task handle is retained (a
+        bare ensure_future is GC-able mid-flight) and its outcome is
+        inspected — expected peer failures are counted, anything else
+        is logged. Chaos-injected exceptions in forwarded casts must
+        never vanish into a dropped task object."""
+        task = asyncio.ensure_future(coro)
+        self._tasks.add(task)
+        task.add_done_callback(self._task_done)
+        return task
+
+    def _task_done(self, task: "asyncio.Task") -> None:
+        self._tasks.discard(task)
+        if task.cancelled():
+            return
+        exc = task.exception()
+        if exc is None:
+            return
+        if isinstance(exc, (PeerDown, RpcError, asyncio.TimeoutError, OSError)):
+            # peers do die; that's the partition steady state — count,
+            # don't spam the log per dropped cast
+            tel = self.broker.router.telemetry
+            if tel.enabled:
+                tel.count("rpc_task_peer_failures_total")
+            return
+        log.error("cluster background task failed", exc_info=exc)
 
     # --- inbound handlers --------------------------------------------------
 
@@ -807,7 +964,7 @@ class ClusterNode:
         async def work():
             if clean_start:
                 try:
-                    await self.rpc.call(addr, "cm", "discard", (client_id,))
+                    await self.call_retry(addr, "cm", "discard", (client_id,))
                 except (PeerDown, RpcError, asyncio.TimeoutError, OSError):
                     pass
             else:
@@ -852,8 +1009,12 @@ class ClusterNode:
                 if leader == self.node_id:
                     got = self._handle_lock(client_id, self.node_id)
                 else:
+                    # the lock attempt is bounded by ITS deadline, not
+                    # the transport default — a partitioned leader must
+                    # not stretch the documented 2s contention window
                     got = bool(await self.rpc.call(
-                        addr, "cm", "lock", (client_id, self.node_id)
+                        addr, "cm", "lock", (client_id, self.node_id),
+                        timeout=max(0.1, deadline - time.monotonic()),
                     ))
             except (PeerDown, RpcError, asyncio.TimeoutError, OSError):
                 break
@@ -879,7 +1040,14 @@ class ClusterNode:
 
     async def _takeover_import(self, addr: Addr, client_id: str) -> None:
         try:
-            state = await self.rpc.call(addr, "cm", "takeover", (client_id,))
+            # takeover is NOT idempotent: the old owner discards the
+            # session as it replies, so a timeout after the discard
+            # loses the state. Generous explicit budget, no mid-flight
+            # retry (a retry would find the session already gone).
+            state = await self.call_retry(
+                addr, "cm", "takeover", (client_id,),
+                timeout=10.0, retries=0,
+            )
         except (PeerDown, RpcError, asyncio.TimeoutError, OSError):
             return  # old owner unreachable: fresh session, nothing to move
         if not state:

@@ -225,9 +225,37 @@ class RpcPlane:
         self._inbound: set = set()  # live server-side writers
         # (peer_addr, shard) -> channel
         self._channels: Dict[Tuple[Tuple[str, int], int], _Channel] = {}
+        # chaos partition seam (emqx_tpu/chaos): peer addresses listed
+        # here are black-holed — calls HANG until their timeout and
+        # casts drop silently, the way a real partition behaves (no
+        # RST, no fast failure). This is what the bounded-timeout +
+        # retry discipline in ClusterNode is tested against.
+        self._partitioned: set = set()
         # negotiated versions per peer node (from either hello direction)
         self.peer_versions: Dict[str, Dict[str, int]] = {}
         self._addr_node: Dict[Tuple[str, int], str] = {}
+
+    # --- chaos partition seam --------------------------------------------
+
+    def partition(self, addr: Tuple[str, int]) -> None:
+        """Black-hole traffic toward `addr` (outbound leg). Symmetric
+        partitions call this on both planes."""
+        self._partitioned.add(tuple(addr))
+
+    def heal(self, addr: Optional[Tuple[str, int]] = None) -> None:
+        if addr is None:
+            self._partitioned.clear()
+        else:
+            self._partitioned.discard(tuple(addr))
+
+    def is_partitioned(self, addr: Tuple[str, int]) -> bool:
+        return tuple(addr) in self._partitioned
+
+    async def _black_hole(self, timeout: float) -> None:
+        """A partitioned peer never answers: burn the caller's timeout
+        budget, then raise the same TimeoutError a dead link would."""
+        await asyncio.sleep(timeout)
+        raise asyncio.TimeoutError("rpc black-holed (injected partition)")
 
     def note_peer(self, addr, node_id: str, protos: Dict[str, list]) -> None:
         self._addr_node[tuple(addr)] = node_id
@@ -345,6 +373,8 @@ class RpcPlane:
         key: Any = None,
         timeout: Optional[float] = None,
     ) -> Any:
+        if self._partitioned and tuple(addr) in self._partitioned:
+            await self._black_hole(timeout or self.call_timeout)
         ch = self._channel(tuple(addr), key)
         v = self._resolve_version(addr, proto, version)
         return await ch.call(proto, v, method, args, timeout or self.call_timeout)
@@ -359,6 +389,8 @@ class RpcPlane:
         version: Optional[int] = None,
         key: Any = None,
     ) -> None:
+        if self._partitioned and tuple(addr) in self._partitioned:
+            return  # black hole: a partitioned cast vanishes silently
         try:
             v = self._resolve_version(addr, proto, version)
             await self._channel(tuple(addr), key).cast(proto, v, method, args)

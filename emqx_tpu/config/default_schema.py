@@ -524,5 +524,26 @@ def broker_schema() -> Struct:
                     }
                 )
             ),
+            # chaos scenario engine (emqx_tpu/chaos): million-session
+            # soak + fault catalog judged by the sentinel. `enable`
+            # only ARMS the engine on a booted node (the soak itself
+            # runs via `python -m emqx_tpu.chaos` / `bench.py --soak`)
+            "chaos": Field(
+                Struct(
+                    {
+                        "enable": Field(Bool(), default=False),
+                        "sessions": Field(Int(min=1), default=1_000_000),
+                        "victim_sessions": Field(Int(min=0), default=20_000),
+                        "groups": Field(Int(min=1), default=None),
+                        "zipf_s": Field(Float(), default=1.2),
+                        "storm_chunk": Field(Int(min=1), default=256),
+                        "audit_sample_n": Field(Int(min=1), default=64),
+                        "baseline_seconds": Field(Float(), default=20.0),
+                        "report_path": Field(
+                            String(), default="SOAK.json"
+                        ),
+                    }
+                )
+            ),
         }
     )

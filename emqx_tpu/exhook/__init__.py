@@ -195,7 +195,9 @@ class ExHookBridge:
                 finally:
                     ready.set()
 
-            loop.create_task(boot())
+            # retained handle: a GC'd boot task would silently drop
+            # its connection error instead of failing the handshake
+            self._boot_task = loop.create_task(boot())
             loop.run_forever()
             loop.close()
 
@@ -237,7 +239,9 @@ class ExHookBridge:
                                 task.cancel()
                         loop.stop()
 
-                    loop.create_task(close_then_stop())
+                    self._shutdown_task = loop.create_task(
+                        close_then_stop()
+                    )
                     return
                 for task in asyncio.all_tasks(loop):
                     task.cancel()

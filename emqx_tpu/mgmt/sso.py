@@ -7,11 +7,13 @@ protocol-real backends:
   * ldap — the dashboard credentials bind against an LDAP server
     (reuses auth/ldap.py's LDAPv3/BER client; search-then-bind like
     emqx_dashboard_sso_ldap).
-  * oidc — authorization-code flow: `login_url` sends the browser to
-    the IdP, the callback exchanges the code at the token endpoint
-    and verifies the id_token (HS256 client-secret or RS256/JWKS via
-    auth.authn.JwtProvider), mapping a claim to the dashboard
-    username (emqx_dashboard_sso_oidc).
+  * oidc — authorization-code flow with PKCE (S256) and full claim
+    verification: `login_url` sends the browser to the IdP (carrying
+    state, nonce, and the code challenge), the callback exchanges the
+    code (+ code_verifier) at the token endpoint, verifies the
+    id_token signature (HS256 client-secret or RS256/JWKS via
+    auth.authn.JwtProvider) AND its iss/aud/nonce claims, mapping a
+    claim to the dashboard username (emqx_dashboard_sso_oidc).
 
 SAML stays triaged out (XML-DSig canonicalization stack; recorded in
 PARITY.md).
@@ -23,6 +25,8 @@ session may do.
 
 from __future__ import annotations
 
+import base64
+import hashlib
 import json
 import logging
 import secrets
@@ -88,12 +92,20 @@ class LdapSso:
 
 
 class OidcSso:
+    """OIDC authorization-code flow with the full claim hardening:
+    `iss`/`aud`/`nonce` are verified against what THIS flow requested
+    (previously any token the IdP had ever signed — for any client,
+    any flow — logged in), and the code exchange carries a PKCE S256
+    code_verifier (RFC 7636) so an intercepted authorization code is
+    useless without the per-flow secret."""
+
     backend = "oidc"
 
     def __init__(self, conf: Dict[str, Any]):
         self.conf = dict(conf)
         self.enable = bool(conf.get("enable", True))
-        self._states: Dict[str, float] = {}  # csrf state -> expiry
+        # csrf state -> (expiry, expected nonce, pkce code_verifier)
+        self._states: Dict[str, tuple] = {}
         from ..auth.authn import JwtProvider
 
         self._jwt = JwtProvider(
@@ -104,19 +116,33 @@ class OidcSso:
     def login_url(self) -> str:
         c = self.conf
         state = secrets.token_urlsafe(16)
+        nonce = secrets.token_urlsafe(16)
+        # RFC 7636 §4.1: 43..128 unreserved chars; token_urlsafe(32)
+        # gives 43. S256 is the only challenge method offered.
+        verifier = secrets.token_urlsafe(32)
+        challenge = (
+            base64.urlsafe_b64encode(
+                hashlib.sha256(verifier.encode("ascii")).digest()
+            )
+            .rstrip(b"=")
+            .decode("ascii")
+        )
         now = time.time()
         # prune IN PLACE: callback() pops states from an executor
         # thread, and a rebuilt-dict rebind from a stale snapshot
         # could resurrect a just-consumed CSRF state
-        for s_ in [s_ for s_, e in self._states.items() if e <= now]:
+        for s_ in [s_ for s_, rec in self._states.items() if rec[0] <= now]:
             self._states.pop(s_, None)
-        self._states[state] = now + 600
+        self._states[state] = (now + 600, nonce, verifier)
         q = urllib.parse.urlencode({
             "response_type": "code",
             "client_id": c.get("client_id", ""),
             "redirect_uri": c.get("redirect_uri", ""),
             "scope": c.get("scope", "openid profile"),
             "state": state,
+            "nonce": nonce,
+            "code_challenge": challenge,
+            "code_challenge_method": "S256",
         })
         return f"{c.get('authorization_endpoint', '')}?{q}"
 
@@ -124,9 +150,10 @@ class OidcSso:
         """Exchange the authorization code; returns the dashboard
         username from the configured claim. BLOCKING http — callers
         run it in an executor."""
-        exp = self._states.pop(state, None)  # atomic consume
-        if exp is None or exp < time.time():
+        rec = self._states.pop(state, None)  # atomic consume
+        if rec is None or rec[0] < time.time():
             raise SsoError("bad or expired state")
+        _exp, nonce, verifier = rec
         c = self.conf
         body = urllib.parse.urlencode({
             "grant_type": "authorization_code",
@@ -134,6 +161,7 @@ class OidcSso:
             "redirect_uri": c.get("redirect_uri", ""),
             "client_id": c.get("client_id", ""),
             "client_secret": c.get("client_secret", ""),
+            "code_verifier": verifier,
         }).encode()
         req = urllib.request.Request(
             c.get("token_endpoint", ""), data=body,
@@ -157,10 +185,28 @@ class OidcSso:
         if ok is not True:
             raise SsoError("id_token verification failed")
         claims = self._decode_claims(id_token)
+        self._verify_id_claims(claims, nonce)
         name = claims.get(self.conf.get("username_claim", "sub"))
         if not name:
             raise SsoError("id_token carries no username claim")
         return str(name)
+
+    def _verify_id_claims(self, claims: Dict[str, Any], nonce: str) -> None:
+        """OIDC Core §3.1.3.7 checks the signature alone can't make:
+        the token must be for US (`aud` = client_id), from the
+        configured issuer, and minted for THIS flow (`nonce` echoes the
+        value this login_url generated — a replayed or cross-flow
+        token fails here even with a valid signature)."""
+        c = self.conf
+        issuer = c.get("issuer")
+        if issuer and claims.get("iss") != issuer:
+            raise SsoError("id_token issuer mismatch")
+        cid = c.get("client_id", "")
+        aud = claims.get("aud")
+        if not (aud == cid or (isinstance(aud, list) and cid in aud)):
+            raise SsoError("id_token audience mismatch")
+        if claims.get("nonce") != nonce:
+            raise SsoError("id_token nonce mismatch")
 
     @staticmethod
     def _decode_claims(jwt: str) -> Dict[str, Any]:

@@ -550,6 +550,62 @@ class Router:
             tel.count("audit_unquarantine_total", n)
             tel.set_gauge("audit_quarantined_filters", 0)
 
+    # --- chaos corruption seam (emqx_tpu/chaos) --------------------------
+
+    def chaos_corrupt_rows(self, filters: Sequence[str]) -> int:
+        """Fault injection: empty the DEVICE copy of the given filters'
+        cuckoo slots while host truth stays pristine — the device-row
+        corruption leg of the chaos scenario engine. The hash kernel
+        stops surfacing exactly these filters, so a served publish on a
+        matching topic diverges from the host oracle and the sentinel's
+        detect→quarantine→clean-sync chain must engage. Scoped: every
+        other filter keeps serving correctly. Returns slots corrupted
+        (0 when a filter is host-resident/unclassed or the device state
+        isn't built yet — callers warm the table first). The quarantine
+        recovery sync re-uploads index state, which heals this."""
+        ix = self.index
+        dt = self.device_table
+        sl = getattr(dt, "_dev_slots", None)
+        if ix is None or sl is None:
+            return 0
+        slots = []
+        for f in filters:
+            row = self._fanout_row(f)
+            if row is None or row >= len(ix._row_bucket):
+                continue
+            b = int(ix._row_bucket[row])
+            if b < 0:
+                continue  # residual/unclassed: dense leg, not slotted
+            slots.append(int(ix._bkt_slot[b]))
+        if not slots:
+            return 0
+        bucket = np.asarray(sl.bucket).copy()
+        bucket[slots] = -1
+        dt._dev_slots = SlotArrays(
+            sl.fp, jax.device_put(bucket, sl.bucket.sharding), sl.probe
+        )
+        if self.telemetry.enabled:
+            self.telemetry.count("chaos_corrupt_slots_total", len(slots))
+        return len(slots)
+
+    def chaos_corrupt_slots(self) -> int:
+        """Fault injection: full device slot-table decay — every bucket
+        id becomes -1, so the hash kernel stops surfacing every classed
+        filter (the whole-table memory-decay failure mode the sentinel
+        suite injects by hand). Returns slots decayed."""
+        dt = self.device_table
+        sl = getattr(dt, "_dev_slots", None)
+        if sl is None:
+            return 0
+        arr = np.asarray(sl.bucket)
+        bad = np.full(arr.shape, -1, arr.dtype)
+        dt._dev_slots = SlotArrays(
+            sl.fp, jax.device_put(bad, sl.bucket.sharding), sl.probe
+        )
+        if self.telemetry.enabled:
+            self.telemetry.count("chaos_corrupt_slots_total", arr.size)
+        return int(arr.size)
+
     # --- CSR dest-store feed (the device ?SUBSCRIBER mirror) ------------
 
     def _fanout_row(self, flt: str) -> Optional[int]:

@@ -105,6 +105,20 @@ class Alarms:
     def is_active(self, name: str) -> bool:
         return name in self._active
 
+    def fired_since(self, ts: float) -> List[str]:
+        """Names of alarms whose activation landed at/after `ts`,
+        whether still active or already cleared — the chaos scenario
+        contract's "did the system page during this window" view."""
+        names = {
+            r["name"]
+            for r in self._active.values()
+            if r["activate_at"] >= ts
+        }
+        names.update(
+            r["name"] for r in self._history if r["activate_at"] >= ts
+        )
+        return sorted(names)
+
     # --- internals ------------------------------------------------------
 
     def _gc(self) -> None:

@@ -320,6 +320,12 @@ def default_rules(
         # oracle (obs/sentinel.py) — the one anomaly where the ring's
         # pre-breach events ARE the forensic record of the bad serve
         TriggerRule("audit_divergence", lambda ctl: None, cooldown),
+        # event-driven: the chaos scenario engine (emqx_tpu/chaos)
+        # stamps every injected fault with a bundle, so the forensic
+        # record of a chaos window carries the injection alongside the
+        # detections it provoked — inject and detect correlate by ring
+        # order, not by guesswork
+        TriggerRule("chaos_fault", lambda ctl: None, cooldown),
     ]
 
 

@@ -250,6 +250,10 @@ class PublishSentinel:
         self._clean_streak = 0
         self.spans_total = 0
         self.divergences: Deque[Dict[str, Any]] = deque(maxlen=16)
+        # divergence listeners (chaos engine / tests): called with the
+        # divergence summary the moment an audit confirms one — lets a
+        # harness timestamp detection latency without polling counters
+        self.on_divergence: List[Any] = []
 
     # --- sampling (the only per-publish cost) ----------------------------
 
@@ -412,6 +416,11 @@ class PublishSentinel:
             **detail,
         }
         self.divergences.append(summary)
+        for cb in self.on_divergence:
+            try:
+                cb(summary)
+            except Exception:
+                log.exception("divergence listener failed")
         log.error(
             "shadow-oracle divergence (%s) on topic %r: device served a "
             "result the host oracle rejects — %s", kind, rec.topic, detail,

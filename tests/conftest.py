@@ -50,3 +50,6 @@ def pytest_pyfunc_call(pyfuncitem):
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "asyncio: async test (built-in runner)")
+    config.addinivalue_line(
+        "markers", "slow: long soak variants excluded from tier-1"
+    )

@@ -1,0 +1,387 @@
+"""Chaos scenario engine (emqx_tpu/chaos): the ISSUE-7 acceptance
+chain — inject→detect→alarm→quarantine→auto-clear→audit-clean walked
+END TO END UNDER SUSTAINED PUBLISH LOAD (the sentinel suite's
+idle-broker injections never had a storm running while the fault was
+live), on both single-device and sharded tables; plus the injector
+seams (row corruption, RPC black-hole partition, paged bootstrap,
+bounded retry) and the soak-row plumbing. Long soak variants ride the
+`slow` marker so tier-1 stays fast."""
+
+import asyncio
+import json
+
+import jax
+import pytest
+
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.packet import SubOpts
+from emqx_tpu.chaos import ChaosEngine, SessionFleet, ZipfTopics, run_soak
+from emqx_tpu.chaos.scenarios import (
+    DisconnectTakeover,
+    NodeEvacuation,
+    NodePurge,
+    PartitionNodedown,
+    RowCorruption,
+    SlotDecay,
+    StormBaseline,
+)
+
+
+def small_engine_kw():
+    return dict(
+        groups=50,
+        sample_n=1,          # every served publish audited
+        storm_chunk=48,
+        detect_rounds=6,
+        detect_burst=16,
+        chaos_filters=2,
+        chaos_fan=4,
+        settle_timeout=8.0,
+    )
+
+
+async def _chain_under_load(tmp_path, mesh=None):
+    """The acceptance walk: a live storm runs the whole time; the
+    fault is injected mid-storm; every contract check (detection
+    within one window, alarm, quarantine, auto-clear, flight bundle,
+    accounting) must hold; the end state is audit-clean."""
+    eng = await ChaosEngine.standalone(
+        sessions=250, data_dir=str(tmp_path), mesh=mesh, **small_engine_kw()
+    )
+    try:
+        await eng.setup()
+        eng.storm_start()
+        res = await RowCorruption(faults=1).run(eng)
+        assert res.ok, json.dumps(res.as_dict(), indent=1)
+        assert res.detect_ms is not None and res.recovery_ms is not None
+        assert eng.faults_detected == eng.faults_injected == 1
+        # the storm really was live across the fault window
+        assert eng.published > 0 and eng.delivered > 0
+        await eng.storm_stop()
+        # end state: clean streak clears the alarm, full-truth sweep
+        # finds zero silent divergence
+        await eng.drain_clean_streak()
+        assert not eng.alarms.is_active("xla_audit_divergence")
+        sweep = await eng.audit_sweep()
+        assert sweep["silent_divergences"] == 0
+        assert eng.router.quarantined_filters() == []
+    finally:
+        await eng.close()
+
+
+async def test_chain_under_load_single_device(tmp_path):
+    await _chain_under_load(tmp_path)
+
+
+async def test_chain_under_load_sharded(tmp_path):
+    from emqx_tpu.parallel import mesh as mesh_mod
+
+    assert len(jax.devices()) == 8, "conftest must fake 8 CPU devices"
+    await _chain_under_load(
+        tmp_path, mesh=mesh_mod.make_mesh(n_dp=2, n_sub=4)
+    )
+
+
+async def test_slot_decay_whole_table_heals(tmp_path):
+    # gross failure: every device slot decays; ONE quarantine cycle
+    # must heal the entire table, with the storm running throughout
+    eng = await ChaosEngine.standalone(
+        sessions=200, data_dir=str(tmp_path), **small_engine_kw()
+    )
+    try:
+        await eng.setup()
+        eng.storm_start()
+        res = await SlotDecay().run(eng)
+        assert res.ok, json.dumps(res.as_dict(), indent=1)
+        await eng.storm_stop()
+        sweep = await eng.audit_sweep()
+        assert sweep["silent_divergences"] == 0
+    finally:
+        await eng.close()
+
+
+async def test_disconnect_takeover_wave(tmp_path):
+    eng = await ChaosEngine.standalone(
+        sessions=300, data_dir=str(tmp_path), **small_engine_kw()
+    )
+    try:
+        await eng.setup()
+        eng.storm_start()
+        res = await DisconnectTakeover(wave=60).run(eng)
+        assert res.ok, json.dumps(res.as_dict(), indent=1)
+        await eng.storm_stop()
+    finally:
+        await eng.close()
+
+
+async def _cluster_engine(tmp_path, **kw):
+    # heartbeat sizing matters even at test scale: a ping timeout that
+    # a storm-stalled loop turn can exceed flaps the membership, and a
+    # post-rejoin flap purges the routes the scenario just restored
+    return await ChaosEngine.cluster(
+        sessions=200,
+        victim_sessions=80,
+        heartbeat_interval=0.25,
+        ping_timeout=1.0,
+        data_dir=str(tmp_path),
+        **{**small_engine_kw(), **kw},
+    )
+
+
+async def test_partition_nodedown_cluster(tmp_path):
+    eng = await _cluster_engine(tmp_path)
+    # tighten the control-plane budgets so the black-hole walk fits a
+    # test window (the defaults are production-scaled). Takeover keeps
+    # its own explicit budget, so this only shortens the bounded-call
+    # and rollup legs.
+    eng.node.rpc_timeout = 0.3
+    eng.node.rpc_retries = 1
+    eng.victim.rpc_timeout = 0.3
+    eng.victim.rpc_retries = 1
+    try:
+        await eng.setup()
+        eng.storm_start()
+        res = await PartitionNodedown().run(eng)
+        assert res.ok, json.dumps(res.as_dict(), indent=1)
+        await eng.storm_stop()
+    finally:
+        await eng.close()
+
+
+async def test_evacuation_then_purge_cluster(tmp_path):
+    eng = await _cluster_engine(tmp_path)
+    try:
+        await eng.setup()
+        eng.storm_start()
+        res = await NodeEvacuation(takeover_sample=20).run(eng)
+        assert res.ok, json.dumps(res.as_dict(), indent=1)
+        res2 = await NodePurge().run(eng)
+        assert res2.ok, json.dumps(res2.as_dict(), indent=1)
+        await eng.storm_stop()
+    finally:
+        await eng.close()
+
+
+# --- injector seams -------------------------------------------------------
+
+
+async def test_corruption_seam_is_scoped(tmp_path):
+    from emqx_tpu.broker.pubsub import Broker
+
+    b = Broker()
+    for i, flt in enumerate(["a/+/x", "b/+/x", "c/+/x"]):
+        s, _ = b.open_session(f"c{i}", clean_start=True)
+        s.outgoing_sink = lambda pkts: None
+        b.subscribe(s, flt, SubOpts(qos=0))
+    r = b.router
+    warm = r.match_filters_batch(["a/1/x", "b/1/x", "c/1/x"])
+    assert warm == [["a/+/x"], ["b/+/x"], ["c/+/x"]]
+    assert r.chaos_corrupt_rows(["b/+/x"]) == 1
+    out = r.match_filters_batch(["a/2/x", "b/2/x", "c/2/x"])
+    # ONLY the corrupted row dropped; neighbors keep serving
+    assert out == [["a/+/x"], [], ["c/+/x"]]
+    # the quarantine recovery path heals it (dirty row + index upload)
+    r.quarantine_filters(["b/+/x"])
+    healed = r.match_filters_batch(["a/3/x", "b/3/x", "c/3/x"])
+    assert healed == [["a/+/x"], ["b/+/x"], ["c/+/x"]]
+    assert r.quarantined_filters() == []
+    # unknown / host-resident filters refuse injection rather than lie
+    assert r.chaos_corrupt_rows(["nope/+/x"]) == 0
+
+
+async def test_rpc_partition_seam(tmp_path):
+    from emqx_tpu.cluster.node import ClusterNode
+
+    a, b = ClusterNode("pa"), ClusterNode("pb")
+    try:
+        aa = await a.start()
+        ba = await b.start()
+        await b.join(aa)
+        # healthy: call works
+        info = await a.rpc.call(ba, "node", "info")
+        assert info["node"] == "pb"
+        a.rpc.partition(ba)
+        # call: hangs exactly its timeout, then TimeoutError
+        t0 = asyncio.get_running_loop().time()
+        with pytest.raises(asyncio.TimeoutError):
+            await a.rpc.call(ba, "node", "info", timeout=0.1)
+        assert asyncio.get_running_loop().time() - t0 < 1.0
+        # cast: silently dropped, no exception
+        await a.rpc.cast(ba, "broker", "forward", ({"topic": "t",
+            "payload": b"", "qos": 0, "retain": False, "from_client": "",
+            "id": "m", "timestamp": 0.0, "props": {}},))
+        a.rpc.heal(ba)
+        info = await a.rpc.call(ba, "node", "info")
+        assert info["node"] == "pb"
+    finally:
+        await b.stop()
+        await a.stop()
+
+
+async def test_call_retry_bounded_and_counted(tmp_path):
+    from emqx_tpu.cluster.node import ClusterNode
+
+    a, b = ClusterNode("ra"), ClusterNode("rb")
+    try:
+        aa = await a.start()
+        ba = await b.start()
+        await b.join(aa)
+        a.rpc.partition(ba)
+        tel = a.broker.router.telemetry
+        t0 = asyncio.get_running_loop().time()
+        with pytest.raises((asyncio.TimeoutError, Exception)):
+            await a.call_retry(ba, "node", "info", timeout=0.1, retries=2)
+        elapsed = asyncio.get_running_loop().time() - t0
+        assert elapsed < 2.0  # 3 x 0.1s + backoff, not an open hang
+        assert tel.counters.get("rpc_retry_total", 0) == 2
+        assert tel.counters.get("rpc_unreachable_total", 0) == 1
+        # a remote HANDLER error is not retried (application failure)
+        a.rpc.heal(ba)
+        before = tel.counters.get("rpc_retry_total", 0)
+        with pytest.raises(Exception):
+            await a.call_retry(ba, "node", "nope", timeout=0.5)
+        assert tel.counters.get("rpc_retry_total", 0) == before
+    finally:
+        await b.stop()
+        await a.stop()
+
+
+async def test_paged_bootstrap_and_resync(tmp_path, monkeypatch):
+    """A joiner pulls the replica in DUMP_PAGE-sized pages (a 1M-route
+    dump in one frame breaks MAX_FRAME — found by the soak's
+    partition-heal rejoin)."""
+    from emqx_tpu.cluster import node as node_mod
+    from emqx_tpu.cluster.node import ClusterNode
+
+    monkeypatch.setattr(node_mod, "DUMP_PAGE", 64)
+    a, b = ClusterNode("ba"), ClusterNode("bb")
+    try:
+        for i in range(300):
+            s, _ = a.broker.open_session(f"c{i}", clean_start=True)
+            s.outgoing_sink = lambda pkts: None
+            a.broker.subscribe(s, f"p/{i}/+", SubOpts(qos=0))
+        aa = await a.start()
+        await b.start()
+        await b.join(aa)  # 300 routes + 300 sessions => several pages
+        assert len(b._cluster_pairs) == 300
+        assert sum(1 for c, n in b.registry.items() if n == "ba") == 300
+        # no snapshot leaked on the seed
+        assert not a._boot_dumps
+    finally:
+        await b.stop()
+        await a.stop()
+
+
+async def test_submit_many_aggregates_counts(tmp_path):
+    from emqx_tpu.broker.pubsub import Broker
+
+    b = Broker()
+    eng = b.enable_dispatch_engine(queue_depth=8, deadline_ms=0.2)
+    for i in range(5):
+        s, _ = b.open_session(f"c{i}", clean_start=True)
+        s.outgoing_sink = lambda pkts: None
+        b.subscribe(s, "m/+", SubOpts(qos=0))
+    msgs = [Message(topic=f"m/{i}", payload=b"x") for i in range(20)]
+    total = await eng.submit_many(msgs)
+    assert total == 20 * 5
+    # bit-identical to the per-publish surface
+    singles = await asyncio.gather(
+        *[eng.publish(Message(topic=f"m/{i}", payload=b"x"))
+          for i in range(20)]
+    )
+    assert sum(singles) == total
+    await eng.stop()
+
+
+def test_zipf_topics_skew_and_shape():
+    from emqx_tpu.broker.pubsub import Broker
+
+    fleet = SessionFleet(Broker(), "z", sessions=100, groups=20)
+    z = ZipfTopics(fleet, s=1.3, seed=3)
+    draws = z.draw(4000)
+    assert len(draws) == 4000
+    assert all(t.startswith("z/") and t.count("/") == 2 for t in draws)
+    counts = {}
+    for t in draws:
+        g = t.split("/")[1]
+        counts[g] = counts.get(g, 0) + 1
+    ranked = sorted(counts.values(), reverse=True)
+    # Zipf head: the hottest group dominates the median group
+    assert ranked[0] > 4 * ranked[len(ranked) // 2]
+
+
+async def test_soak_row_shape_and_report(tmp_path):
+    out = tmp_path / "SOAK_test.json"
+    row = await run_soak(
+        sessions=150,
+        victim_sessions=0,
+        groups=30,
+        sample_n=2,
+        baseline_s=0.3,
+        scenarios=["storm_baseline", "row_corruption"],
+        report_path=str(out),
+        data_dir=str(tmp_path),
+        strict=True,
+        storm_chunk=32,
+        detect_rounds=6,
+        detect_burst=16,
+        chaos_filters=2,
+        chaos_fan=4,
+        settle_timeout=8.0,
+    )
+    assert row["contracts_ok"] and not row["violations"]
+    assert row["sessions"] >= 150
+    assert row["divergences_detected"] == row["divergences_injected"] >= 1
+    assert row["silent_divergences"] == 0
+    assert row["storm"]["sustained_pub_per_sec"] > 0
+    assert row["publish_p99_ms_incl_chaos"] > 0
+    assert "row_corruption" in row["scenarios"]
+    assert json.loads(out.read_text())["contracts_ok"]
+
+
+# --- long soak variants (tier-1 skips these via `-m 'not slow'`) ----------
+
+
+@pytest.mark.slow
+def test_cluster_soak_full_catalog(tmp_path):
+    # sync def on purpose: the conftest async runner caps coroutine
+    # tests at 30s, a real soak needs its own budget
+    row = asyncio.run(
+        run_soak(
+            sessions=20_000,
+            victim_sessions=2_000,
+            sample_n=16,
+            baseline_s=5.0,
+            report_path=str(tmp_path / "SOAK_slow.json"),
+            data_dir=str(tmp_path),
+            strict=True,
+        )
+    )
+    assert row["contracts_ok"]
+    assert row["divergences_detected"] == row["divergences_injected"]
+    assert row["silent_divergences"] == 0
+
+
+@pytest.mark.slow
+def test_sharded_soak(tmp_path):
+    from emqx_tpu.parallel import mesh as mesh_mod
+
+    async def go():
+        eng = await ChaosEngine.standalone(
+            sessions=5_000,
+            data_dir=str(tmp_path),
+            mesh=mesh_mod.make_mesh(n_dp=2, n_sub=4),
+            sample_n=8,
+        )
+        try:
+            await eng.setup()
+            return await eng.run(
+                [StormBaseline(2.0), RowCorruption(2), SlotDecay()],
+                baseline_s=2.0,
+            )
+        finally:
+            await eng.close()
+
+    row = asyncio.run(go())
+    assert row["contracts_ok"]
+    assert row["silent_divergences"] == 0

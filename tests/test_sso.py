@@ -82,13 +82,31 @@ async def test_ldap_sso_login_and_viewer_rbac():
         await ldap.stop()
 
 
-class MiniOidcIdp:
-    """Token endpoint: exchanges a known code for an HS256 id_token."""
+ISSUER = "https://idp.test"
 
-    def __init__(self, client_id, client_secret):
+
+class MiniOidcIdp:
+    """Token endpoint: exchanges a known code for an HS256 id_token.
+
+    Mirrors a hardened IdP: requires a PKCE code_verifier on the
+    exchange and embeds iss/aud/nonce into the id_token. The nonce
+    normally arrives via the authorization request; the mini IdP never
+    sees that leg, so tests parse it from login_url and register it
+    per code (`idp.nonces[code] = nonce`). The `*_override` knobs mint
+    deliberately-wrong claims for the negative cases."""
+
+    def __init__(self, client_id, client_secret, issuer=ISSUER,
+                 require_pkce=True):
         self.client_id = client_id
         self.client_secret = client_secret
+        self.issuer = issuer
+        self.require_pkce = require_pkce
         self.codes = {}  # code -> username
+        self.nonces = {}  # code -> nonce to embed
+        self.iss_override = None
+        self.aud_override = None
+        self.nonce_override = None
+        self.last_form = None  # the most recent exchange request
         self.server = None
         self.port = None
 
@@ -114,11 +132,13 @@ class MiniOidcIdp:
             from urllib.parse import parse_qs
 
             form = {k: v[0] for k, v in parse_qs(body.decode()).items()}
+            self.last_form = form
             user = self.codes.get(form.get("code"))
             if (
                 user is None
                 or form.get("client_id") != self.client_id
                 or form.get("client_secret") != self.client_secret
+                or (self.require_pkce and not form.get("code_verifier"))
             ):
                 out = b'{"error": "invalid_grant"}'
                 writer.write(
@@ -126,14 +146,18 @@ class MiniOidcIdp:
                     % (len(out), out)
                 )
             else:
-                idt = make_jwt(
-                    {
-                        "sub": user, "name": user.title(),
-                        "aud": self.client_id,
-                        "exp": int(time.time()) + 300,
-                    },
-                    self.client_secret.encode(),
+                claims = {
+                    "sub": user, "name": user.title(),
+                    "iss": self.iss_override or self.issuer,
+                    "aud": self.aud_override or self.client_id,
+                    "exp": int(time.time()) + 300,
+                }
+                nonce = self.nonce_override or self.nonces.get(
+                    form.get("code")
                 )
+                if nonce:
+                    claims["nonce"] = nonce
+                idt = make_jwt(claims, self.client_secret.encode())
                 out = json.dumps(
                     {"access_token": "at", "id_token": idt}
                 ).encode()
@@ -148,6 +172,20 @@ class MiniOidcIdp:
             writer.close()
 
 
+async def _oidc_login_start(port, idp, code, token=None):
+    """GET login_url, register the flow's nonce with the mini IdP for
+    `code`, and return (state, query-dict)."""
+    from urllib.parse import parse_qs, urlparse
+
+    st, body = await http_req(
+        port, "GET", "/api/v5/sso/oidc/login_url", token=token
+    )
+    assert st == 200
+    qs = parse_qs(urlparse(body["login_url"]).query)
+    idp.nonces[code] = qs["nonce"][0]
+    return qs["state"][0], qs
+
+
 async def test_oidc_sso_code_flow():
     idp = MiniOidcIdp("dash-client", "s3cret-oidc")
     await idp.start()
@@ -160,6 +198,7 @@ async def test_oidc_sso_code_flow():
                 "enable": True,
                 "client_id": "dash-client",
                 "client_secret": "s3cret-oidc",
+                "issuer": ISSUER,
                 "authorization_endpoint": "http://idp.test/authorize",
                 "token_endpoint": f"http://127.0.0.1:{idp.port}/token",
                 "redirect_uri": "http://dash.test/callback",
@@ -177,7 +216,13 @@ async def test_oidc_sso_code_flow():
         )
         from urllib.parse import parse_qs, urlparse
 
-        state = parse_qs(urlparse(body["login_url"]).query)["state"][0]
+        qs = parse_qs(urlparse(body["login_url"]).query)
+        state = qs["state"][0]
+        # the hardened flow carries nonce + PKCE S256 challenge
+        assert qs["nonce"][0]
+        assert qs["code_challenge_method"] == ["S256"]
+        assert len(qs["code_challenge"][0]) == 43
+        idp.nonces["code-123"] = qs["nonce"][0]
 
         # IdP redirects back with code+state: the callback exchanges it
         st, body = await http_req(
@@ -189,6 +234,18 @@ async def test_oidc_sso_code_flow():
             port, "GET", "/api/v5/stats", token=body["token"]
         )
         assert st == 200
+        # the exchange carried the verifier whose S256 hash is exactly
+        # the challenge login_url advertised
+        import base64
+        import hashlib
+
+        sent = idp.last_form["code_verifier"]
+        assert (
+            base64.urlsafe_b64encode(
+                hashlib.sha256(sent.encode()).digest()
+            ).rstrip(b"=").decode()
+            == qs["code_challenge"][0]
+        )
 
         # replayed/forged state is refused
         st, _ = await http_req(
@@ -199,6 +256,70 @@ async def test_oidc_sso_code_flow():
         st, _ = await http_req(
             port, "GET",
             "/api/v5/sso/oidc/callback?code=code-123&state=FORGED",
+        )
+        assert st == 401
+    finally:
+        await api.stop()
+        await idp.stop()
+
+
+async def test_oidc_claim_hardening_negative_cases():
+    """iss/aud/nonce verification: a signature-valid token minted for
+    another client, another issuer, another flow, or no flow at all
+    must NOT log in (pre-hardening, any same-IdP token did)."""
+    idp = MiniOidcIdp("c1", "s1")
+    await idp.start()
+    api, port, admin_tok = await make_api()
+    try:
+        await http_req(
+            port, "PUT", "/api/v5/sso/oidc",
+            {
+                "enable": True, "client_id": "c1", "client_secret": "s1",
+                "issuer": ISSUER,
+                "authorization_endpoint": "http://idp/authorize",
+                "token_endpoint": f"http://127.0.0.1:{idp.port}/t",
+                "redirect_uri": "http://d/cb",
+            },
+            token=admin_tok,
+        )
+
+        async def attempt(code):
+            state, _qs = await _oidc_login_start(port, idp, code)
+            st, body = await http_req(
+                port, "GET",
+                f"/api/v5/sso/oidc/callback?code={code}&state={state}",
+            )
+            return st
+
+        # control: the honest flow works
+        idp.codes["ok"] = "bob"
+        assert await attempt("ok") == 200
+
+        # aud: token minted for a DIFFERENT client at the same IdP
+        idp.codes["aud"] = "bob"
+        idp.aud_override = "other-dashboard"
+        assert await attempt("aud") == 401
+        idp.aud_override = None
+
+        # iss: same-shaped token from the wrong issuer
+        idp.codes["iss"] = "bob"
+        idp.iss_override = "https://evil.example"
+        assert await attempt("iss") == 401
+        idp.iss_override = None
+
+        # nonce: token from ANOTHER flow (replay/injection)
+        idp.codes["non"] = "bob"
+        idp.nonce_override = "someone-elses-flow"
+        assert await attempt("non") == 401
+        idp.nonce_override = None
+
+        # nonce entirely absent from the token
+        idp.codes["nil"] = "bob"
+        state, _qs = await _oidc_login_start(port, idp, "nil")
+        del idp.nonces["nil"]
+        st, _ = await http_req(
+            port, "GET",
+            f"/api/v5/sso/oidc/callback?code=nil&state={state}",
         )
         assert st == 401
     finally:
@@ -245,6 +366,7 @@ async def test_oidc_login_url_is_unauthenticated_and_role_follows_config():
     try:
         conf = {
             "enable": True, "client_id": "c1", "client_secret": "s1",
+            "issuer": ISSUER,
             "authorization_endpoint": "http://idp/authorize",
             "token_endpoint": f"http://127.0.0.1:{idp.port}/t",
             "redirect_uri": "http://d/cb", "default_role": "administrator",
@@ -252,11 +374,7 @@ async def test_oidc_login_url_is_unauthenticated_and_role_follows_config():
         await http_req(port, "PUT", "/api/v5/sso/oidc", conf,
                        token=admin_tok)
         # a fresh browser (NO token) can start the flow
-        st, body = await http_req(port, "GET", "/api/v5/sso/oidc/login_url")
-        assert st == 200
-        from urllib.parse import parse_qs, urlparse
-
-        state = parse_qs(urlparse(body["login_url"]).query)["state"][0]
+        state, _qs = await _oidc_login_start(port, idp, "k1")
         st, body = await http_req(
             port, "GET", f"/api/v5/sso/oidc/callback?code=k1&state={state}",
         )
@@ -265,8 +383,7 @@ async def test_oidc_login_url_is_unauthenticated_and_role_follows_config():
         conf["default_role"] = "viewer"
         await http_req(port, "PUT", "/api/v5/sso/oidc", conf,
                        token=admin_tok)
-        st, body = await http_req(port, "GET", "/api/v5/sso/oidc/login_url")
-        state = parse_qs(urlparse(body["login_url"]).query)["state"][0]
+        state, _qs = await _oidc_login_start(port, idp, "k2")
         st, body = await http_req(
             port, "GET", f"/api/v5/sso/oidc/callback?code=k2&state={state}",
         )

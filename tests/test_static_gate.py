@@ -96,6 +96,40 @@ def _family_literals():
     return type_decl, tokens
 
 
+def test_create_task_sites_retain_handles():
+    """Every `asyncio.create_task(...)` (and `loop.create_task`) call
+    site in the package must RETAIN the task handle — assignment,
+    container insertion, await, return — or route through a supervised
+    helper. A bare expression-statement spawn is the fire-and-forget
+    shape twice over: the asyncio docs allow the event loop to GC a
+    task nobody references mid-flight, and an exception inside it
+    (exactly what the chaos engine injects) is silently swallowed
+    until interpreter shutdown. Supervised helpers (ClusterNode._spawn
+    and friends) assign + done-callback internally, so they pass this
+    rule by construction."""
+    bad = []
+    for path in _sources():
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Expr):
+                continue
+            call = node.value
+            if not isinstance(call, ast.Call):
+                continue
+            fn = call.func
+            name = (
+                fn.attr
+                if isinstance(fn, ast.Attribute)
+                else fn.id if isinstance(fn, ast.Name) else None
+            )
+            if name == "create_task":
+                bad.append(f"{path}:{node.lineno}")
+    assert not bad, (
+        "fire-and-forget create_task (handle dropped — retain it or "
+        "use a supervised spawn helper):\n" + "\n".join(bad)
+    )
+
+
 def test_metric_name_literals_obey_prometheus_naming():
     _decl, tokens = _family_literals()
     bad = sorted(
