@@ -2013,14 +2013,143 @@ def bench_pipeline(details):
 # --------------------------------------------------------------------------
 
 
-def bench_soak(details, out_path="SOAK_r07.json"):
-    """Million-session soak + chaos scenario stage (ISSUE 7): builds
+def bench_degraded(details):
+    """Device failure domain (ISSUE 8): what does the broker serve
+    when the accelerator is GONE, and how fast does it get there and
+    back? Three numbers the capacity plan needs:
+
+      * device vs host-fallback (breaker-open) publish throughput on
+        the same broker — the degraded-capacity ratio;
+      * breaker trip latency: sticky device loss -> all traffic
+        host-side (the failure budget actually spent);
+      * recovery latency: link heals -> canary probe -> full state
+        resync -> oracle-verified close.
+
+    The degraded rate is EXPECTED to sit well below the device rate —
+    that is the point of the number (bench_compare treats it as its
+    own metric family, so it can never trip the regression banner
+    against a device-path headline)."""
+    import asyncio
+
+    from emqx_tpu.broker.message import Message
+    from emqx_tpu.broker.packet import SubOpts
+    from emqx_tpu.broker.pubsub import Broker
+    from emqx_tpu.chaos.faults import DeviceFaultInjector
+
+    NSUB = max(64, 512 // SHRINK)
+    B = 256
+    ROUNDS = 6
+
+    b = Broker(max_levels=8)
+    for i in range(NSUB):
+        s, _ = b.open_session(f"dg{i}", True)
+        s.outgoing_sink = lambda pkts: None
+        b.subscribe(s, f"dg/{i}/+/#", SubOpts(qos=0))
+    inj = DeviceFaultInjector().install(b.router)
+    tel = b.router.telemetry
+
+    async def run():
+        eng = b.enable_dispatch_engine(
+            queue_depth=64, deadline_ms=0.5, match_cache_size=0,
+            breaker_threshold=3, probe_backoff_ms=5.0,
+            probe_backoff_max_ms=50.0,
+        )
+        errors = 0
+
+        async def timed_rounds(tag):
+            per = []
+            for r_ in range(ROUNDS):
+                msgs = [
+                    Message(topic=f"dg/{j % NSUB}/{tag}{r_}/m{j}",
+                            payload=b"x")
+                    for j in range(B)
+                ]
+                t0 = time.time()
+                await eng.submit_many(msgs)
+                per.append((time.time() - t0) / B)
+            return per
+
+        # warm + device leg
+        await timed_rounds("w")
+        with gc_off():
+            dev = await timed_rounds("d")
+
+        # sticky loss: measure submit->trip wall clock, then the
+        # degraded (host-fallback) leg while the breaker is open
+        inj.fail_sticky()
+        t_inj = time.time()
+        for k in range(64):
+            try:
+                await eng.submit_many(
+                    [Message(topic=f"dg/{j % NSUB}/t{k}", payload=b"x")
+                     for j in range(8)]
+                )
+            except Exception:
+                errors += 1
+            if eng.breaker_state == "open":
+                break
+        trip_ms = (time.time() - t_inj) * 1e3
+        assert eng.breaker_state == "open", "breaker failed to trip"
+        with gc_off():
+            deg = await timed_rounds("h")
+        assert eng.breaker_state == "open", "breaker closed mid-degraded-leg"
+
+        # heal -> probe -> verified close
+        inj.heal()
+        t_heal = time.time()
+        while eng.breaker_state != "closed":
+            await asyncio.sleep(0.005)
+            if time.time() - t_heal > 30.0:
+                raise AssertionError("breaker never recovered")
+        recover_ms = (time.time() - t_heal) * 1e3
+        post = await timed_rounds("p")
+        await eng.stop()
+        return dev, deg, post, trip_ms, recover_ms, errors
+
+    dev, deg, post, trip_ms, recover_ms, errors = asyncio.run(run())
+    dev_rate = 1.0 / pctl(dev, 25)
+    deg_rate = 1.0 / pctl(deg, 25)
+    post_rate = 1.0 / pctl(post, 25)
+    counters = tel.counters
+    assert errors == 0, f"{errors} publisher-visible errors during outage"
+    log(
+        f"degraded capacity: device {dev_rate:,.0f} topics/s vs "
+        f"host-fallback {deg_rate:,.0f} topics/s "
+        f"({deg_rate / dev_rate:.2f}x); trip {trip_ms:.1f}ms, "
+        f"recover {recover_ms:.1f}ms (post-recovery "
+        f"{post_rate:,.0f} topics/s)"
+    )
+    details["device_failure_domain"] = {
+        "device_topics_per_sec": round(dev_rate, 1),
+        "degraded_topics_per_sec": round(deg_rate, 1),
+        "degraded_capacity_ratio": round(deg_rate / dev_rate, 4),
+        "post_recovery_topics_per_sec": round(post_rate, 1),
+        "breaker_trip_ms": round(trip_ms, 2),
+        "breaker_recover_ms": round(recover_ms, 2),
+        "publisher_errors": errors,
+        "trips": counters.get("breaker_trips_total", 0),
+        "recoveries": counters.get("breaker_recoveries_total", 0),
+        "degraded_batches": counters.get(
+            "breaker_degraded_batches_total", 0
+        ),
+        "expected_degraded": (
+            "degraded_topics_per_sec is host-walk capacity BY DESIGN — "
+            "compare within this stage, never against device headlines"
+        ),
+        "subs": NSUB,
+        "rate_estimator": "p25 of per-round timings",
+    }
+
+
+def bench_soak(details, out_path="SOAK_r08.json"):
+    """Million-session soak + chaos scenario stage (ISSUE 7+8): builds
     the two-node chaos engine, sustains the Zipf storm through the
     real pipelined broker, runs the fault catalog (row corruption,
-    disconnect/takeover waves, partition+nodedown purge, evacuation,
-    node purge, whole-table decay) while the sentinel/SLO/flight stack
-    judges the response, asserts every contract, and commits the soak
-    row. EMQX_BENCH_SCALE=small shrinks the fleet for CI smoke."""
+    device loss/flap through the breaker, disconnect/takeover waves,
+    partition+nodedown purge, evacuation, node purge, whole-table
+    decay) while the sentinel/SLO/flight stack judges the response,
+    asserts every contract, and commits the soak row.
+    EMQX_BENCH_SCALE=small shrinks the fleet for CI smoke."""
     import asyncio
 
     from emqx_tpu.chaos.engine import run_soak
@@ -2132,6 +2261,8 @@ def main():
     stage_done("fanout")
     bench_pipeline(details)
     stage_done("pipeline")
+    bench_degraded(details)
+    stage_done("degraded")
     del table, index, meta, slots
     bench_10m(jax, jnp, floor, details)
     stage_done("config3_10M")

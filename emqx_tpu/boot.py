@@ -134,6 +134,28 @@ class Node:
                 deadline_ms=cfg.get("broker.perf.tpu_dispatch_deadline_ms"),
                 pipeline_depth=cfg.get("broker.perf.tpu_pipeline_depth"),
                 match_cache_size=cfg.get("broker.perf.tpu_match_cache_size"),
+                # device failure domain: breaker + admission control
+                breaker_enable=cfg.get("broker.perf.tpu_breaker_enable"),
+                breaker_threshold=cfg.get(
+                    "broker.perf.tpu_breaker_threshold"
+                ),
+                breaker_deadline_ms=cfg.get(
+                    "broker.perf.tpu_breaker_deadline_ms"
+                ),
+                probe_backoff_ms=cfg.get(
+                    "broker.perf.tpu_breaker_probe_backoff_ms"
+                ),
+                probe_backoff_max_ms=cfg.get(
+                    "broker.perf.tpu_breaker_probe_backoff_max_ms"
+                ),
+                queue_max_depth=cfg.get("broker.perf.tpu_queue_max_depth"),
+                queue_policy=cfg.get("broker.perf.tpu_queue_policy"),
+                queue_deadline_ms=cfg.get(
+                    "broker.perf.tpu_queue_deadline_ms"
+                ),
+                queue_low_watermark=cfg.get(
+                    "broker.perf.tpu_queue_low_watermark"
+                ),
             )
         self.broker = broker
 

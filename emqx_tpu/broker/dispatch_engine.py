@@ -49,19 +49,81 @@ results are bit-identical to the synchronous path (oracle-checked in
 tests/test_dispatch_engine.py and bench.py's pipeline exactness
 stage).
 
+**Device failure domain** (the emqx_olp / emqx_limiter analog for the
+accelerator link — see PARITY.md):
+
+  * **Failover** — a device batch that fails (XlaRuntimeError-class,
+    injected or real) or blows the per-batch `breaker_deadline_ms` is
+    transparently re-served through the host match walk
+    (`Router.match_filters_host` — bit-identical by the oracle
+    contract), so publishers never see a transient device fault.
+
+  * **Circuit breaker** — `breaker_threshold` CONSECUTIVE device
+    failures trip the breaker: `Router.suspend_device()` routes ALL
+    match + fanout traffic host-side (degraded-but-correct), the
+    `xla_device_breaker` alarm raises, and the flight recorder
+    freezes a `device_breaker_trip` bundle.
+
+  * **Recovery** — a background canary probe with bounded exponential
+    backoff re-dispatches a sentinel batch through the real kernels;
+    on success it re-uploads FULL device state (the quarantine
+    clean-sync machinery: `Router.device_resync`) and verifies a
+    second canary against the host oracle before closing the breaker
+    and clearing the alarm — the recovered device re-earns trust
+    under the sentinel's shadow audit, never by assumption.
+
+  * **Admission control** — the dispatch queue is bounded
+    (`queue_max_depth` outstanding publishes). Overload either SHEDS
+    (fail fast with `QueueOverloadError`, counted, `xla_queue_overload`
+    alarm at the high watermark, cleared at the low watermark) or
+    BLOCKS (publishers park on a waiter list drained as capacity
+    frees) per `queue_policy`; blocked publishers carry a
+    `queue_deadline_ms` so a wedged device can never hang them
+    indefinitely. The emqx_olp load-shed / emqx_limiter token-bucket
+    analog for the device link.
+
 Telemetry (obs/kernel_telemetry, scraped as `emqx_xla_*`): queue-wait
 histogram family `pipeline_queue_wait_seconds`, gauges
-`pipeline_depth` / `pipeline_coalesce`, and the cache's
-hits/misses/evictions counters recorded by the Router.
+`pipeline_depth` / `pipeline_coalesce`, the cache's
+hits/misses/evictions counters recorded by the Router, plus the
+failure-domain families `emqx_xla_breaker_*` / `emqx_xla_queue_*`
+(state, trips, recoveries, fallbacks, probes, sheds, blocks,
+deadline expiries — all transitions counted).
 """
 
 from __future__ import annotations
 
 import asyncio
+import contextlib
+import logging
 from collections import deque
 from typing import Deque, List, Optional, Tuple
 
 from .message import Message
+
+log = logging.getLogger("emqx_tpu.broker.dispatch_engine")
+
+ALARM_BREAKER = "xla_device_breaker"
+ALARM_OVERLOAD = "xla_queue_overload"
+
+# breaker_state gauge encoding
+_STATE_GAUGE = {"closed": 0, "open": 1, "half_open": 2}
+
+
+class EngineStopped(RuntimeError):
+    """The dispatch engine stopped; queued publishers fail
+    deterministically instead of hanging."""
+
+
+class QueueOverloadError(RuntimeError):
+    """Admission control shed this publish (queue at high watermark
+    under the `shed` policy) — fail fast, counted, alarmed."""
+
+
+class QueueDeadlineExceeded(RuntimeError):
+    """A blocked publish waited past `queue_deadline_ms` for queue
+    capacity — the engine fails it rather than hanging the publisher
+    on a wedged device."""
 
 
 class _AggregateCount:
@@ -106,6 +168,17 @@ class DispatchEngine:
         deadline_ms: float = 0.5,
         pipeline_depth: int = 2,
         match_cache_size: int = 8192,
+        breaker_enable: bool = True,
+        breaker_threshold: int = 4,
+        breaker_deadline_ms: float = 250.0,
+        probe_backoff_ms: float = 100.0,
+        probe_backoff_max_ms: float = 5000.0,
+        queue_max_depth: int = 8192,
+        queue_policy: str = "shed",
+        queue_deadline_ms: float = 1000.0,
+        queue_low_watermark: int = 0,
+        alarms=None,
+        flight=None,
     ) -> None:
         self.broker = broker
         self.router = broker.router
@@ -115,14 +188,72 @@ class DispatchEngine:
         self.queue_depth = max(1, queue_depth)
         self.deadline_s = max(0.0, deadline_ms) / 1e3
         self.pipeline_depth = max(1, pipeline_depth)
-        self._queue: List[tuple] = []  # (msg, future, enqueue clock)
+        # --- device failure domain (breaker) knobs
+        self.breaker_enabled = bool(breaker_enable)
+        self.breaker_threshold = max(1, breaker_threshold)
+        self.breaker_deadline_s = max(0.0, breaker_deadline_ms) / 1e3
+        self.probe_backoff_s = max(0.001, probe_backoff_ms) / 1e3
+        self.probe_backoff_max_s = max(
+            self.probe_backoff_s, probe_backoff_max_ms / 1e3
+        )
+        # --- admission control knobs
+        self.queue_max_depth = max(1, queue_max_depth)
+        assert queue_policy in ("shed", "block"), queue_policy
+        self.queue_policy = queue_policy
+        self.queue_deadline_s = max(0.001, queue_deadline_ms) / 1e3
+        self.queue_low_watermark = (
+            queue_low_watermark
+            if queue_low_watermark
+            else max(1, self.queue_max_depth // 2)
+        )
+        # alarms/flight: explicit wiring wins; otherwise resolved
+        # lazily through the attached sentinel (boot order attaches
+        # the engine first and the obs bundle later — or vice versa in
+        # tests — so neither order may lose the surfaces)
+        self.alarms = alarms
+        self.flight = flight
+        self._queue: List[tuple] = []  # (msg, future, enqueue clock, span)
         # dispatched-but-unfetched batches: (pending match, entries)
         self._inflight: Deque[tuple] = deque()
+        self._inflight_pubs = 0  # publishes inside _inflight entries
+        self._waiters: Deque[tuple] = deque()  # block-policy parked items
         self._timer = None
+        self._waiter_timer = None
         self._drain_scheduled = False
+        self._pumping = False
+        self._overloaded = False
         self.batches_total = 0
         self.publishes_total = 0
         self.closed = False
+        # --- breaker state machine: closed -> open -> half_open -> closed
+        self.breaker_state = "closed"
+        self._consecutive_failures = 0
+        self._probe_task: Optional[asyncio.Task] = None
+        self.last_device_error: Optional[str] = None
+        # canary topics: the most recent distinct batch heads, so the
+        # recovery probe dispatches realistic traffic, not synthetics
+        self._recent_topics: Deque[str] = deque(maxlen=8)
+        tel = self.telemetry
+        if tel.enabled:
+            tel.set_gauge("breaker_state", 0)
+            tel.set_gauge("breaker_consecutive_failures", 0)
+            tel.set_gauge("queue_depth", 0)
+            tel.set_gauge("queue_waiters", 0)
+            tel.set_gauge("queue_overloaded", 0)
+
+    # --- obs wiring -------------------------------------------------------
+
+    def _get_alarms(self):
+        if self.alarms is not None:
+            return self.alarms
+        st = self.broker.sentinel
+        return st.alarms if st is not None else None
+
+    def _get_flight(self):
+        if self.flight is not None:
+            return self.flight
+        st = self.broker.sentinel
+        return st.flight if st is not None else None
 
     # --- async publish surface -------------------------------------------
 
@@ -132,11 +263,15 @@ class DispatchEngine:
         match results, identical dispatch."""
         return await self.submit(msg)
 
+    def _check_open(self) -> None:
+        if self.closed:
+            raise EngineStopped("dispatch engine stopped")
+
     def submit(self, msg: Message) -> "asyncio.Future":
         """Enqueue without awaiting; returns the delivery-count future.
         Flushes immediately at queue_depth, else arms the sub-ms
         deadline timer for the batch the first enqueue opened."""
-        assert not self.closed, "dispatch engine stopped"
+        self._check_open()
         loop = asyncio.get_running_loop()
         fut = loop.create_future()
         # publish sentinel (obs/sentinel.py): a 1/sample_n publish gets
@@ -144,11 +279,13 @@ class DispatchEngine:
         # publish pays one attribute read + one counter increment
         st = self.broker.sentinel
         span = st.maybe_span(msg) if st is not None else None
-        self._queue.append((msg, fut, self.telemetry.clock(), span))
-        if len(self._queue) >= self.queue_depth:
-            self._flush()
-        elif self._timer is None:
-            self._timer = loop.call_later(self.deadline_s, self._on_deadline)
+        if self._admit((msg, fut, self.telemetry.clock(), span), loop):
+            if len(self._queue) >= self.queue_depth:
+                self._flush()
+            elif self._timer is None:
+                self._timer = loop.call_later(
+                    self.deadline_s, self._on_deadline
+                )
         return fut
 
     def submit_many(self, msgs) -> "asyncio.Future":
@@ -157,8 +294,9 @@ class DispatchEngine:
         hooks, same match path, same sentinel sampling per message as
         submit() — only the per-publish Future ceremony is amortized,
         which is what lets a million-session soak generator saturate
-        the pipeline from a single driver task."""
-        assert not self.closed, "dispatch engine stopped"
+        the pipeline from a single driver task. Admission control
+        applies per message: a shed message fails the aggregate."""
+        self._check_open()
         loop = asyncio.get_running_loop()
         fut = loop.create_future()
         if not msgs:
@@ -171,12 +309,153 @@ class DispatchEngine:
             span = st.maybe_span(msg) if st is not None else None
             # _flush REPLACES self._queue with a fresh list — re-read
             # it each append rather than holding a stale binding
-            self._queue.append((msg, agg, clock(), span))
-            if len(self._queue) >= self.queue_depth:
-                self._flush()
+            if self._admit((msg, agg, clock(), span), loop):
+                if len(self._queue) >= self.queue_depth:
+                    self._flush()
         if self._queue and self._timer is None:
             self._timer = loop.call_later(self.deadline_s, self._on_deadline)
         return fut
+
+    # --- admission control (the emqx_olp analog) --------------------------
+
+    def outstanding(self) -> int:
+        """Publishes the engine currently owns: batched + in flight.
+        Blocked waiters are excluded — they ARE the backpressure."""
+        return len(self._queue) + self._inflight_pubs
+
+    def _admit(self, item: tuple, loop) -> bool:
+        """True when the item entered the batch queue; False when it
+        was shed (future failed) or parked on the waiter list."""
+        tel = self.telemetry
+        if self.outstanding() < self.queue_max_depth:
+            self._queue.append(item)
+            return True
+        self._overload(tel)
+        if self.queue_policy == "block":
+            tel.count("queue_blocked_total")
+            self._waiters.append(item)
+            tel.set_gauge("queue_waiters", len(self._waiters))
+            if self._waiter_timer is None:
+                self._waiter_timer = loop.call_later(
+                    self.queue_deadline_s / 2, self._expire_waiters
+                )
+            return False
+        tel.count("queue_shed_total")
+        _msg, fut, _t, _span = item
+        if not fut.done():
+            fut.set_exception(
+                QueueOverloadError(
+                    f"dispatch queue overloaded "
+                    f"({self.outstanding()}/{self.queue_max_depth} "
+                    f"outstanding, policy=shed)"
+                )
+            )
+        return False
+
+    def _overload(self, tel) -> None:
+        if self._overloaded:
+            return
+        self._overloaded = True
+        tel.set_gauge("queue_overloaded", 1)
+        alarms = self._get_alarms()
+        if alarms is not None:
+            try:
+                alarms.ensure(
+                    ALARM_OVERLOAD,
+                    details={
+                        "outstanding": self.outstanding(),
+                        "max_depth": self.queue_max_depth,
+                        "policy": self.queue_policy,
+                    },
+                    message=(
+                        f"dispatch queue overloaded "
+                        f"({self.queue_policy} policy engaged)"
+                    ),
+                )
+            except Exception:
+                tel.count("queue_alarm_failures_total")
+                log.exception("overload alarm failed")
+
+    def _maybe_clear_overload(self) -> None:
+        if not self._overloaded:
+            return
+        if self.outstanding() > self.queue_low_watermark or self._waiters:
+            return
+        self._overloaded = False
+        tel = self.telemetry
+        tel.set_gauge("queue_overloaded", 0)
+        alarms = self._get_alarms()
+        if alarms is not None:
+            alarms.ensure_deactivated(ALARM_OVERLOAD)
+
+    def _pump_waiters(self) -> None:
+        """Admit parked publishers as capacity frees (block policy).
+        Re-entrancy guarded: pumping flushes, flushes collect, and a
+        collect completion calls back in here."""
+        if self._pumping or not self._waiters:
+            return
+        self._pumping = True
+        tel = self.telemetry
+        now = tel.clock()
+        try:
+            while self._waiters and (
+                self.outstanding() < self.queue_max_depth
+            ):
+                item = self._waiters.popleft()
+                _msg, fut, t_in, _span = item
+                if fut.done():
+                    continue
+                if now - t_in > self.queue_deadline_s:
+                    tel.count("queue_deadline_expired_total")
+                    fut.set_exception(
+                        QueueDeadlineExceeded(
+                            f"waited {now - t_in:.3f}s for queue capacity "
+                            f"(deadline {self.queue_deadline_s:.3f}s)"
+                        )
+                    )
+                    continue
+                self._queue.append(item)
+                if len(self._queue) >= self.queue_depth:
+                    self._flush()
+        finally:
+            self._pumping = False
+            tel.set_gauge("queue_waiters", len(self._waiters))
+        self._maybe_clear_overload()
+
+    def _expire_waiters(self) -> None:
+        """Waiter-deadline sweep: a blocked publisher past its queue
+        deadline fails deterministically — a wedged device can slow
+        the broker, never hang its publishers."""
+        self._waiter_timer = None
+        tel = self.telemetry
+        now = tel.clock()
+        keep: Deque[tuple] = deque()
+        expired = 0
+        while self._waiters:
+            item = self._waiters.popleft()
+            _msg, fut, t_in, _span = item
+            if fut.done():
+                continue
+            if now - t_in > self.queue_deadline_s:
+                expired += 1
+                fut.set_exception(
+                    QueueDeadlineExceeded(
+                        f"waited {now - t_in:.3f}s for queue capacity "
+                        f"(deadline {self.queue_deadline_s:.3f}s)"
+                    )
+                )
+            else:
+                keep.append(item)
+        self._waiters = keep
+        if expired:
+            tel.count("queue_deadline_expired_total", expired)
+        tel.set_gauge("queue_waiters", len(self._waiters))
+        if self._waiters and not self.closed:
+            self._waiter_timer = asyncio.get_running_loop().call_later(
+                self.queue_deadline_s / 2, self._expire_waiters
+            )
+        else:
+            self._maybe_clear_overload()
 
     def _on_deadline(self) -> None:
         self._timer = None
@@ -190,13 +469,15 @@ class DispatchEngine:
         match kernels (no device->host fetch), and push the pending
         batch onto the in-flight window. Collection happens on a later
         loop turn (_drain) or immediately for whatever exceeds the
-        pipeline depth."""
+        pipeline depth. A device fault at launch fails over to a
+        host-mode batch — publishers never see it."""
         if self._timer is not None:
             self._timer.cancel()
             self._timer = None
         batch, self._queue = self._queue, []
         tel = self.telemetry
         broker = self.broker
+        router = self.router
         st = broker.sentinel
         now = tel.clock()
         entries = []
@@ -214,14 +495,28 @@ class DispatchEngine:
                 topics.append(live.topic)
         self.batches_total += 1
         self.publishes_total += len(batch)
-        pending = self.router.match_filters_begin(topics, span=bspan)
+        if topics:
+            self._recent_topics.append(topics[0])
+        try:
+            pending = router.match_filters_begin(topics, span=bspan)
+        except Exception as e:
+            # launch-side device fault (encode/sync/kernel dispatch):
+            # re-begin in host mode — the cache probe re-runs (cheap,
+            # correct) and finish serves from host truth
+            tel.count("breaker_begin_failures_total")
+            self._device_failure(e)
+            pending = self._host_begin(topics, bspan)
         # device-resolved fanout overlap: topics the match cache
         # answered at begin time have known filter sets NOW — launch
         # their plan resolves immediately so the deduped plan
         # materializes on device while the match hash fetch for the
         # uncached remainder is still in flight
         fanout_pending = None
-        if broker._fanout_device and pending.full_out is not None:
+        if (
+            broker._fanout_device
+            and pending.full_out is not None
+            and not router.device_suspended
+        ):
             seen = set()
             for flts in pending.full_out:
                 if flts is None:
@@ -232,9 +527,16 @@ class DispatchEngine:
                 seen.add(fkey)
                 if broker._plan_fresh(fkey):
                     continue
-                h = self.router.resolve_fanout_begin(
-                    fkey, min_fan=broker._fanout_min_fan
-                )
+                try:
+                    h = router.resolve_fanout_begin(
+                        fkey, min_fan=broker._fanout_min_fan
+                    )
+                except Exception as e:
+                    # fanout launch fault: the dispatch path rebuilds
+                    # plans host-side — skip the overlap, note the link
+                    tel.count("fanout_host_fallback_total")
+                    self._device_failure(e)
+                    break
                 if h is not None:
                     if fanout_pending is None:
                         fanout_pending = []
@@ -242,13 +544,26 @@ class DispatchEngine:
                         (fkey, broker._fanout_clock, h)
                     )
         self._inflight.append((pending, entries, fanout_pending, bspan))
+        self._inflight_pubs += len(entries)
         tel.set_gauge("pipeline_depth", len(self._inflight))
         tel.set_gauge("pipeline_coalesce", len(batch))
+        tel.set_gauge("queue_depth", self.outstanding())
         while len(self._inflight) > self.pipeline_depth:
             self._collect_one()
         if self._inflight and not self._drain_scheduled:
             self._drain_scheduled = True
             asyncio.get_running_loop().call_soon(self._drain)
+
+    def _host_begin(self, topics, bspan):
+        """Begin a batch with the device forced out of the loop (the
+        failover path when match_filters_begin itself raised)."""
+        router = self.router
+        prev = router.device_suspended
+        router.device_suspended = True
+        try:
+            return router.match_filters_begin(topics, span=bspan)
+        finally:
+            router.device_suspended = prev
 
     def _drain(self) -> None:
         self._drain_scheduled = False
@@ -257,19 +572,50 @@ class DispatchEngine:
         self.telemetry.set_gauge("pipeline_depth", 0)
 
     def _collect_one(self) -> None:
-        """Fetch + deliver the OLDEST in-flight batch (begin order)."""
+        """Fetch + deliver the OLDEST in-flight batch (begin order).
+        A device fault here re-serves the whole batch through the host
+        walk; a slow-but-successful device batch past the breaker
+        deadline counts toward the breaker without being re-served
+        (its results are already correct)."""
         pending, entries, fanout_pending, bspan = self._inflight.popleft()
         broker = self.broker
         router = self.router
         st = broker.sentinel
-        tclock = self.telemetry.clock
+        tel = self.telemetry
+        tclock = tel.clock
+        device_batch = pending.mode not in ("cached", "host")
+        t0 = tclock()
         try:
             filter_lists = router.match_filters_finish(pending)
-        except Exception as e:  # a failed batch fails its publishers,
-            for _live, fut, _span in entries:  # never wedges the pipeline
-                if not fut.done():
-                    fut.set_exception(e)
-            return
+        except Exception as e:
+            # transient device fault: re-serve the WHOLE batch from
+            # host truth — bit-identical by the oracle contract, so
+            # publishers never see it; the failure still counts toward
+            # the breaker
+            tel.count("breaker_fallback_total", len(entries))
+            self._device_failure(e)
+            fanout_pending = None  # overlapped resolves died with it
+            try:
+                filter_lists = router.match_filters_host(pending)
+            except Exception as e2:  # host truth failed: nothing left
+                tel.count("publish_failures_total", len(entries))
+                for _live, fut, _span in entries:
+                    if not fut.done():
+                        fut.set_exception(e2)
+                self._batch_done(len(entries))
+                return
+        else:
+            if device_batch and self.breaker_enabled:
+                if (
+                    self.breaker_deadline_s
+                    and tclock() - t0 > self.breaker_deadline_s
+                ):
+                    # slow is a fault even when it is not wrong: the
+                    # results serve, the breaker still hears about it
+                    tel.count("breaker_deadline_exceeded_total")
+                    self._device_failure(None)
+                else:
+                    self._device_success()
         if fanout_pending is not None:
             # install the overlapped plans before delivering: stamped
             # with the clock captured at begin, so a mutation that
@@ -279,8 +625,12 @@ class DispatchEngine:
             for fkey, clock, h in fanout_pending:
                 try:
                     plan = router.resolve_fanout_finish(h)
-                except Exception:
-                    continue  # the dispatch path rebuilds host-side
+                except Exception as e:
+                    # the dispatch path rebuilds host-side; counted so
+                    # a dying link can't fail resolves silently
+                    tel.count("fanout_host_fallback_total")
+                    self._device_failure(e)
+                    continue
                 broker._store_plan(fkey, clock, plan)
             if bspan is not None:
                 bspan.add("resolve", tclock() - t_res)
@@ -296,6 +646,10 @@ class DispatchEngine:
                 try:
                     n = broker._dispatch(live, pairs)
                 except Exception as e:
+                    # a delivery-side failure is the publisher's to
+                    # see (host bug, not a device fault) — counted,
+                    # then propagated
+                    tel.count("publish_failures_total")
                     if not fut.done():
                         fut.set_exception(e)
                     continue
@@ -314,26 +668,231 @@ class DispatchEngine:
                     )
             if not fut.done():
                 fut.set_result(n)
+        self._batch_done(len(entries))
+
+    def _batch_done(self, n_pubs: int) -> None:
+        self._inflight_pubs -= n_pubs
+        if self._waiters:
+            self._pump_waiters()
+        else:
+            self._maybe_clear_overload()
+
+    # --- circuit breaker (trip -> degrade -> probe -> resync -> close) ----
+
+    def note_device_failure(self, exc: Optional[BaseException]) -> None:
+        """Seam for device faults observed OUTSIDE the engine's own
+        batches (the broker's synchronous match/fanout legs): they
+        count toward the same breaker."""
+        self._device_failure(exc)
+
+    def note_device_success(self) -> None:
+        """Sync-path counterpart: a successful device leg resets the
+        consecutive-failure count, so sparse transient faults spread
+        over hours can never accumulate into a spurious trip."""
+        self._device_success()
+
+    def _device_failure(self, exc: Optional[BaseException]) -> None:
+        tel = self.telemetry
+        tel.count("breaker_device_failures_total")
+        if exc is not None:
+            self.last_device_error = repr(exc)
+        if not self.breaker_enabled:
+            return
+        self._consecutive_failures += 1
+        tel.set_gauge(
+            "breaker_consecutive_failures", self._consecutive_failures
+        )
+        if (
+            self.breaker_state == "closed"
+            and self._consecutive_failures >= self.breaker_threshold
+        ):
+            self._trip_breaker(exc)
+
+    def _device_success(self) -> None:
+        if self._consecutive_failures:
+            self._consecutive_failures = 0
+            self.telemetry.set_gauge("breaker_consecutive_failures", 0)
+
+    def _set_state(self, state: str) -> None:
+        self.breaker_state = state
+        self.telemetry.set_gauge("breaker_state", _STATE_GAUGE[state])
+
+    def _trip_breaker(self, exc: Optional[BaseException]) -> None:
+        """closed -> open: all traffic host-side (degraded-but-
+        correct), alarm raised, flight bundle frozen, probe armed."""
+        tel = self.telemetry
+        self._set_state("open")
+        self.router.suspend_device()
+        tel.count("breaker_trips_total")
+        details = {
+            "consecutive_failures": self._consecutive_failures,
+            "threshold": self.breaker_threshold,
+            "last_error": self.last_device_error,
+        }
+        log.error(
+            "device breaker TRIPPED after %d consecutive failures "
+            "(last: %s) — all publish traffic degraded to the host "
+            "walk; canary probe armed",
+            self._consecutive_failures, self.last_device_error,
+        )
+        alarms = self._get_alarms()
+        if alarms is not None:
+            try:
+                alarms.ensure(
+                    ALARM_BREAKER,
+                    details=details,
+                    message="XLA device breaker open: publish path "
+                            "degraded to host walk",
+                )
+            except Exception:
+                tel.count("breaker_alarm_failures_total")
+                log.exception("breaker alarm failed")
+        fl = self._get_flight()
+        if fl is not None:
+            fl.recorder.record("breaker.trip", "", details)
+            fl.maybe_trigger("device_breaker_trip", details)
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            # no loop (offline/bench sync path): recovery happens on
+            # the next probe_once() a caller drives explicitly
+            return
+        t = loop.create_task(self._probe_loop())
+        self._probe_task = t
+        t.add_done_callback(self._probe_done)
+
+    def _probe_done(self, task: "asyncio.Task") -> None:
+        if self._probe_task is task:
+            self._probe_task = None
+        if not task.cancelled() and task.exception() is not None:
+            self.telemetry.count("breaker_probe_crashes_total")
+            log.error(
+                "breaker probe loop died", exc_info=task.exception()
+            )
+
+    async def _probe_loop(self) -> None:
+        """Bounded-exponential-backoff canary: re-dispatch a sentinel
+        batch through the real kernels; on success, full clean resync
+        then a VERIFIED canary before closing."""
+        backoff = self.probe_backoff_s
+        while not self.closed and self.breaker_state == "open":
+            await asyncio.sleep(backoff)
+            backoff = min(backoff * 2.0, self.probe_backoff_max_s)
+            if self.closed or self.breaker_state != "open":
+                return
+            if self.probe_once():
+                return
+
+    def probe_once(self) -> bool:
+        """One canary attempt (also the offline/bench entry): link
+        canary -> full state resync -> oracle-verified canary ->
+        close. Returns True when the breaker closed."""
+        tel = self.telemetry
+        router = self.router
+        tel.count("breaker_probe_total")
+        self._set_state("half_open")
+        topics = list(self._recent_topics) or ["$breaker/canary"]
+        try:
+            # step 1: does the link dispatch at all? (stale state OK)
+            router.canary_match(topics)
+            # step 2: the outage dropped the delta stream — re-upload
+            # FULL device state from host truth (quarantine clean-sync
+            # machinery), then verify the device answers the oracle
+            router.device_resync()
+            served = router.canary_match(topics)
+            oracle = [sorted(router.match_filters(t)) for t in topics]
+            if [sorted(x) for x in served] != oracle:
+                raise RuntimeError(
+                    "post-resync canary diverged from host oracle"
+                )
+        except Exception as e:
+            tel.count("breaker_probe_failures_total")
+            self.last_device_error = repr(e)
+            self._set_state("open")
+            return False
+        self._close_breaker(topics)
+        return True
+
+    def _close_breaker(self, canary_topics) -> None:
+        tel = self.telemetry
+        self._consecutive_failures = 0
+        tel.set_gauge("breaker_consecutive_failures", 0)
+        self._set_state("closed")
+        self.router.resume_device()
+        tel.count("breaker_recoveries_total")
+        log.warning(
+            "device breaker CLOSED: full state re-uploaded, canary "
+            "verified against host oracle on %d topics",
+            len(canary_topics),
+        )
+        alarms = self._get_alarms()
+        if alarms is not None:
+            alarms.ensure_deactivated(ALARM_BREAKER)
+        fl = self._get_flight()
+        if fl is not None:
+            fl.recorder.record(
+                "breaker.close", "", {"canary_topics": len(canary_topics)}
+            )
 
     # --- lifecycle --------------------------------------------------------
 
     async def drain(self) -> None:
-        """Flush the open batch and collect everything in flight."""
-        if self._queue:
-            self._flush()
-        while self._inflight:
-            self._collect_one()
+        """Flush the open batch, admit + serve every blocked waiter,
+        and collect everything in flight."""
+        while self._queue or self._inflight or self._waiters:
+            if self._waiters:
+                self._pump_waiters()
+            if self._queue:
+                self._flush()
+            while self._inflight:
+                self._collect_one()
+            if not (self._queue or self._waiters):
+                break
         await asyncio.sleep(0)  # let resolved futures' awaiters run
 
-    async def stop(self) -> None:
-        await self.drain()
+    async def stop(self, drain: bool = True) -> None:
+        """Stop the engine. drain=True (default) completes everything
+        first; drain=False is the abort path: in-flight batches still
+        complete (their kernels already launched), but queued and
+        blocked publishers fail deterministically with EngineStopped —
+        never a silent hang."""
+        if self.closed:
+            return
+        if drain:
+            await self.drain()
         self.closed = True
         if self._timer is not None:
             self._timer.cancel()
             self._timer = None
+        if self._waiter_timer is not None:
+            self._waiter_timer.cancel()
+            self._waiter_timer = None
+        while self._inflight:
+            self._collect_one()
+        aborted = 0
+        err = EngineStopped("dispatch engine stopped")
+        for _msg, fut, _t, _span in self._queue:
+            if not fut.done():
+                fut.set_exception(err)
+                aborted += 1
+        self._queue = []
+        while self._waiters:
+            _msg, fut, _t, _span = self._waiters.popleft()
+            if not fut.done():
+                fut.set_exception(err)
+                aborted += 1
+        if aborted:
+            self.telemetry.count("queue_aborted_total", aborted)
+        if self._probe_task is not None:
+            self._probe_task.cancel()
+            with contextlib.suppress(Exception, asyncio.CancelledError):
+                await self._probe_task
+            self._probe_task = None
+        await asyncio.sleep(0)
 
     def status(self) -> dict:
         cache = self.router.match_cache
+        counters = getattr(self.telemetry, "counters", {})
         return {
             "queue_depth": self.queue_depth,
             "deadline_ms": self.deadline_s * 1e3,
@@ -345,6 +904,40 @@ class DispatchEngine:
             "coalesce_factor": round(
                 self.publishes_total / self.batches_total, 3
             ) if self.batches_total else 0.0,
+            "breaker": {
+                "enabled": self.breaker_enabled,
+                "state": self.breaker_state,
+                "threshold": self.breaker_threshold,
+                "consecutive_failures": self._consecutive_failures,
+                "deadline_ms": self.breaker_deadline_s * 1e3,
+                "trips": counters.get("breaker_trips_total", 0),
+                "recoveries": counters.get("breaker_recoveries_total", 0),
+                "fallback_publishes": counters.get(
+                    "breaker_fallback_total", 0
+                ),
+                "degraded_batches": counters.get(
+                    "breaker_degraded_batches_total", 0
+                ),
+                "probes": counters.get("breaker_probe_total", 0),
+                "probe_failures": counters.get(
+                    "breaker_probe_failures_total", 0
+                ),
+                "last_device_error": self.last_device_error,
+            },
+            "admission": {
+                "max_depth": self.queue_max_depth,
+                "low_watermark": self.queue_low_watermark,
+                "policy": self.queue_policy,
+                "queue_deadline_ms": self.queue_deadline_s * 1e3,
+                "outstanding": self.outstanding(),
+                "waiters": len(self._waiters),
+                "overloaded": self._overloaded,
+                "shed": counters.get("queue_shed_total", 0),
+                "blocked": counters.get("queue_blocked_total", 0),
+                "deadline_expired": counters.get(
+                    "queue_deadline_expired_total", 0
+                ),
+            },
             "match_cache": None if cache is None else {
                 "capacity": cache.capacity,
                 "entries": len(cache),

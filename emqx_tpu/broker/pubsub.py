@@ -430,10 +430,31 @@ class Broker:
 
     def publish_batch(self, msgs: Sequence[Message]) -> List[int]:
         """The TPU hot path: one batched device dispatch for the whole
-        inbound publish batch."""
+        inbound publish batch. A device fault mid-batch fails over to
+        the host walk (oracle-identical) instead of failing every
+        coalesced publisher — the same failure-domain contract as the
+        pipelined engine, for the synchronous surface (server
+        PublishBatcher, cluster forward legs, bench)."""
         live = [self._pre_publish(m) for m in msgs]
         topics = [m.topic for m in live if m is not None]
-        pair_sets = iter(self.router.match_pairs_batch(topics))
+        router = self.router
+        try:
+            filter_lists = router.match_filters_batch(topics)
+            eng = self.engine
+            if eng is not None:
+                eng.note_device_success()
+        except Exception as e:
+            tel = router.telemetry
+            if tel.enabled:
+                tel.count("breaker_fallback_total", len(topics))
+            eng = self.engine
+            if eng is not None:
+                eng.note_device_failure(e)
+            filter_lists = [router.match_filters(t) for t in topics]
+        fd = router.filter_dests
+        pair_sets = iter(
+            [(f, fd(f)) for f in flts] for flts in filter_lists
+        )
         return [
             self._dispatch(m, next(pair_sets)) if m is not None else 0
             for m in live
@@ -615,11 +636,27 @@ class Broker:
         two are bit-identical by contract (churn-oracle-tested)."""
         if self._fanout_device:
             router = self.router
-            handle = router.resolve_fanout_begin(
-                key, min_fan=self._fanout_min_fan
-            )
-            if handle is not None:
-                return router.resolve_fanout_finish(handle)
+            try:
+                handle = router.resolve_fanout_begin(
+                    key, min_fan=self._fanout_min_fan
+                )
+                if handle is not None:
+                    plan = router.resolve_fanout_finish(handle)
+                    eng = self.engine
+                    if eng is not None:
+                        eng.note_device_success()
+                    return plan
+            except Exception as e:
+                # device fault on the synchronous resolve leg: the
+                # host walk below is the oracle the kernel is
+                # bit-identical to — serve it, count it, and let the
+                # engine's breaker hear about the link
+                tel = router.telemetry
+                if tel.enabled:
+                    tel.count("fanout_host_fallback_total")
+                eng = self.engine
+                if eng is not None:
+                    eng.note_device_failure(e)
         return self._build_fanout_plan(pairs)
 
     def _build_fanout_plan(self, pairs: Pairs) -> tuple:

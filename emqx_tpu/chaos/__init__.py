@@ -14,7 +14,11 @@ production failure catalog against them —
   * mass-disconnect + session-takeover waves,
   * node purge / evacuation through cluster/rebalance.py,
   * cluster partition through the RPC plane's black-hole seam,
-  * injected device-table row corruption (Router.chaos_corrupt_rows)
+  * injected device-table row corruption (Router.chaos_corrupt_rows),
+  * device-link faults at the XLA boundary (chaos/faults.py): transient
+    kernel failures, sticky device loss, and stalled transfers — the
+    conditions the dispatch engine's circuit breaker + host failover
+    (device_loss / device_flap scenarios) must absorb invisibly
 
 — while the sentinel, SLO tracker, and flight recorder judge the
 outcome. Every scenario declares an expected response contract and the
@@ -38,6 +42,13 @@ from .engine import (  # noqa: F401
     SessionFleet,
     ZipfTopics,
     run_soak,
+)
+from .faults import (  # noqa: F401
+    DeviceDeadlineExceeded,
+    DeviceFaultInjector,
+    DeviceLinkError,
+    DeviceLostError,
+    TransientDeviceError,
 )
 from .scenarios import (  # noqa: F401
     CATALOG,

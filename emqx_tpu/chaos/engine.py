@@ -191,6 +191,8 @@ class ChaosEngine:
         detect_rounds: int = 12,
         detect_burst: int = 256,
         settle_timeout: float = 10.0,
+        breaker_threshold: int = 3,
+        probe_backoff_ms: float = 50.0,
         progress: Optional[Callable[[str], None]] = None,
     ) -> None:
         self.broker = broker
@@ -209,6 +211,10 @@ class ChaosEngine:
         self.detect_rounds = detect_rounds
         self.detect_burst = detect_burst
         self.settle_timeout = settle_timeout
+        self.breaker_threshold = breaker_threshold
+        self.probe_backoff_ms = probe_backoff_ms
+        # device-link fault seam (chaos/faults.py), installed at setup
+        self.injector = None
         self.progress = progress or (lambda msg: log.info("%s", msg))
 
         self.fleet = SessionFleet(broker, "s", sessions, groups=groups)
@@ -267,9 +273,23 @@ class ChaosEngine:
     # --- setup ------------------------------------------------------------
 
     async def setup(self) -> None:
+        from .faults import DeviceFaultInjector
+
         t0 = time.monotonic()
         if self.broker.engine is None:
             self.broker.enable_dispatch_engine()
+        # breaker tuned to soak cadence: trip within a couple of storm
+        # chunks, probe fast enough that recovery fits a scenario
+        # window (production defaults are seconds-scale)
+        de = self.broker.engine
+        de.breaker_threshold = self.breaker_threshold
+        de.probe_backoff_s = self.probe_backoff_ms / 1e3
+        de.probe_backoff_max_s = max(
+            de.probe_backoff_s * 8, de.probe_backoff_s
+        )
+        # the XLA-boundary fault seam the device scenarios drive;
+        # healthy cost is one falsy test per device leg
+        self.injector = DeviceFaultInjector().install(self.router)
         st = self.sentinel
         st.sample_n = self.sample_n
         st.on_divergence.append(
@@ -658,6 +678,32 @@ class ChaosEngine:
                 "retries": counters.get("rpc_retry_total", 0),
                 "unreachable": counters.get("rpc_unreachable_total", 0),
             },
+            # device failure domain: the breaker's whole trip →
+            # degrade → probe → resync → close ledger, plus admission
+            "breaker": {
+                "state_at_end": self.broker.engine.breaker_state,
+                "trips": counters.get("breaker_trips_total", 0),
+                "recoveries": counters.get("breaker_recoveries_total", 0),
+                "device_failures": counters.get(
+                    "breaker_device_failures_total", 0
+                ),
+                "fallback_publishes": counters.get(
+                    "breaker_fallback_total", 0
+                ),
+                "degraded_batches": counters.get(
+                    "breaker_degraded_batches_total", 0
+                ),
+                "probes": counters.get("breaker_probe_total", 0),
+                "probe_failures": counters.get(
+                    "breaker_probe_failures_total", 0
+                ),
+                "device_resyncs": counters.get("device_resyncs_total", 0),
+                "queue_shed": counters.get("queue_shed_total", 0),
+                "queue_blocked": counters.get("queue_blocked_total", 0),
+                "queue_deadline_expired": counters.get(
+                    "queue_deadline_expired_total", 0
+                ),
+            },
             "slo": {
                 name: obj.evaluate() for name, obj in st.slo.items()
             },
@@ -802,7 +848,7 @@ async def run_soak(
     sample_n: int = 64,
     baseline_s: float = 20.0,
     scenarios: Optional[Sequence[str]] = None,
-    report_path: Optional[str] = "SOAK_r07.json",
+    report_path: Optional[str] = "SOAK_r08.json",
     data_dir: Optional[str] = None,
     progress: Optional[Callable[[str], None]] = None,
     strict: bool = True,

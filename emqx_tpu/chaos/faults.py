@@ -1,0 +1,197 @@
+"""Device fault seam — the injectable error/latency layer at the XLA
+boundary.
+
+Everything the broker asks of the accelerator funnels through five
+legs: `Router.match_filters_begin` (encode + kernel launch),
+`match_filters_finish` (device->host fetch), `resolve_fanout_begin` /
+`resolve_fanout_finish` (the dedup/max-QoS plan kernel), and the
+device-table `sync` (delta scatter / full upload, on `DeviceTable` and
+`ShardedDeviceTable` alike). Each leg carries a `fault_injector`
+None-seam (one attribute read when absent — the broker.tracer
+discipline), and this module is the thing that plugs into it: a
+controllable fault source that can
+
+  * raise a bounded burst of **transient** `XlaRuntimeError`-class
+    failures (the flaky-link / preempted-kernel mode the dispatch
+    engine's failover must absorb invisibly);
+  * declare **sticky device loss** — every device leg fails until
+    `heal()` — the mode that must trip the engine's circuit breaker
+    into host-degraded service;
+  * **stall** a bounded number of transfers for a fixed wall-clock
+    delay WITHOUT failing them (the slow-HBM / congested-link mode):
+    results stay correct, but the batch blows the engine's per-batch
+    deadline, which counts toward the breaker exactly like a failure —
+    slow is a fault even when it is not wrong.
+
+The real production fault this seam stands in for surfaces as
+`jaxlib.xla_extension.XlaRuntimeError`; the injected classes derive
+from `DeviceLinkError` so handlers written against the seam catch both
+shapes through one `except Exception` (counted — the static gate's
+dispatch-path lint enforces that no device-leg handler swallows
+silently)."""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Sequence
+
+# the legs check() is called with — one name per XLA-boundary seam
+LEGS = (
+    "match_begin",
+    "match_finish",
+    "fanout_begin",
+    "fanout_finish",
+    "sync",
+)
+
+
+class DeviceLinkError(RuntimeError):
+    """Base of the injected XlaRuntimeError-class failures."""
+
+
+class TransientDeviceError(DeviceLinkError):
+    """A one-off device fault: retry/fallback should absorb it."""
+
+
+class DeviceLostError(DeviceLinkError):
+    """Sticky device loss: every device leg fails until heal()."""
+
+
+class DeviceDeadlineExceeded(DeviceLinkError):
+    """A transfer abandoned past its deadline (wedged link)."""
+
+
+class DeviceFaultInjector:
+    """One injector per Router; installed on the router AND its device
+    table so route-churn syncs outside the publish path are injectable
+    too. `check(leg)` is the hot-path entry: when healthy it is one
+    falsy test, so leaving the injector installed for a whole soak
+    costs nothing measurable."""
+
+    def __init__(self) -> None:
+        self._sticky = False
+        self._transient_left = 0
+        self._stall_left = 0
+        self._stall_s = 0.0
+        self._stall_fail = False
+        self._legs: Optional[Sequence[str]] = None
+        self.checks_total = 0
+        self.faults_raised = 0
+        self.stalls_injected = 0
+        self.telemetry = None
+        self._router = None
+
+    # --- wiring -----------------------------------------------------------
+
+    def install(self, router) -> "DeviceFaultInjector":
+        """Attach to every seam of one Router (idempotent)."""
+        router.fault_injector = self
+        router.device_table.fault_injector = self
+        self.telemetry = router.telemetry
+        self._router = router
+        return self
+
+    def uninstall(self) -> None:
+        r = self._router
+        if r is not None:
+            if r.fault_injector is self:
+                r.fault_injector = None
+            if r.device_table.fault_injector is self:
+                r.device_table.fault_injector = None
+        self._router = None
+
+    # --- fault programming ------------------------------------------------
+
+    def fail_transient(
+        self, n: int = 1, legs: Optional[Sequence[str]] = None
+    ) -> None:
+        """The next `n` device-leg checks (optionally scoped to `legs`)
+        raise TransientDeviceError, then the link is healthy again."""
+        self._transient_left = int(n)
+        self._legs = tuple(legs) if legs else None
+
+    def fail_sticky(self, legs: Optional[Sequence[str]] = None) -> None:
+        """Device loss: every check fails until heal()."""
+        self._sticky = True
+        self._legs = tuple(legs) if legs else None
+
+    def stall(
+        self,
+        seconds: float,
+        n: int = 1,
+        fail: bool = False,
+        legs: Optional[Sequence[str]] = None,
+    ) -> None:
+        """Stall the next `n` checks for `seconds` of wall clock. With
+        `fail=False` (default) the leg then SUCCEEDS — the
+        slow-but-correct mode that must blow the engine's per-batch
+        deadline; `fail=True` additionally abandons the transfer
+        (DeviceDeadlineExceeded), the wedged-link mode."""
+        self._stall_left = int(n)
+        self._stall_s = float(seconds)
+        self._stall_fail = bool(fail)
+        self._legs = tuple(legs) if legs else None
+
+    def heal(self) -> None:
+        """Clear every programmed fault: the link is healthy."""
+        self._sticky = False
+        self._transient_left = 0
+        self._stall_left = 0
+        self._stall_s = 0.0
+        self._stall_fail = False
+        self._legs = None
+
+    @property
+    def healthy(self) -> bool:
+        return not (
+            self._sticky or self._transient_left > 0 or self._stall_left > 0
+        )
+
+    # --- the seam entry ---------------------------------------------------
+
+    def check(self, leg: str) -> None:
+        """Called by every XLA-boundary leg. Healthy: one falsy test.
+        Faulty: count, then stall and/or raise per the programmed
+        mode."""
+        if self.healthy:
+            return
+        if self._legs is not None and leg not in self._legs:
+            return
+        self.checks_total += 1
+        tel = self.telemetry
+        if self._stall_left > 0:
+            self._stall_left -= 1
+            self.stalls_injected += 1
+            if tel is not None and tel.enabled:
+                tel.count("chaos_device_stalls_total")
+            time.sleep(self._stall_s)
+            if not self._stall_fail:
+                return
+            self.faults_raised += 1
+            if tel is not None and tel.enabled:
+                tel.count("chaos_device_faults_total")
+            raise DeviceDeadlineExceeded(
+                f"injected transfer stall abandoned at {leg} "
+                f"({self._stall_s * 1e3:.0f}ms)"
+            )
+        self.faults_raised += 1
+        if tel is not None and tel.enabled:
+            tel.count("chaos_device_faults_total")
+        if self._sticky:
+            raise DeviceLostError(f"injected device loss at {leg}")
+        self._transient_left -= 1
+        raise TransientDeviceError(
+            f"injected transient XLA fault at {leg}"
+        )
+
+    def status(self) -> dict:
+        return {
+            "healthy": self.healthy,
+            "sticky": self._sticky,
+            "transient_left": self._transient_left,
+            "stall_left": self._stall_left,
+            "legs": list(self._legs) if self._legs else None,
+            "checks_total": self.checks_total,
+            "faults_raised": self.faults_raised,
+            "stalls_injected": self.stalls_injected,
+        }

@@ -316,6 +316,272 @@ class SlotDecay(_CorruptionBase):
         return eng.router.chaos_corrupt_slots()
 
 
+class DeviceLoss(Scenario):
+    """Device-link failure walked end to end under the live storm:
+    (1) a transient fault burst is absorbed INVISIBLY by the host
+    failover (zero publisher errors, fallback counted, breaker stays
+    closed); (2) sticky device loss trips the breaker within its
+    failure budget — host-degraded service stays correct and
+    audit-clean, the `xla_device_breaker` alarm pages, a
+    `device_breaker_trip` flight bundle freezes; (3) healing the link
+    lets the canary probe resync full device state and close the
+    breaker, verified divergence-free by a full-truth sweep."""
+
+    name = "device_loss"
+    reference = (
+        "emqx_olp load-control backoff (SURVEY.md:96) applied to the "
+        "device link; breaker trip/recover around XLA faults"
+    )
+
+    async def run(self, eng) -> ScenarioResult:
+        res = ScenarioResult(self.name)
+        de = eng.broker.engine
+        inj = eng.injector
+        c = eng.counters
+        t0w = time.time()
+        err0 = eng.storm_errors
+        c0 = c()
+        det0 = len(eng.detections)
+        fires0 = _fires(eng, "device_breaker_trip")
+        eng.reset_flight_cooldown("device_breaker_trip")
+        # --- phase 1: transient blip — failover absorbs, breaker holds
+        inj.fail_transient(2)
+        await eng.burst(
+            [eng.fresh_topic(eng.chaos_filters[0]) for _ in range(8)]
+        )
+        while not inj.healthy:  # storm may not have hit the seam yet
+            await eng.burst([eng.fresh_topic(eng.chaos_filters[0])])
+        c1 = c()
+        res.checks.append(
+            Check(
+                "transient_absorbed",
+                de.breaker_state == "closed"
+                and c1.get("breaker_device_failures_total", 0)
+                > c0.get("breaker_device_failures_total", 0)
+                and eng.storm_errors == err0,
+                f"+{c1.get('breaker_device_failures_total', 0) - c0.get('breaker_device_failures_total', 0)}"
+                " faults, 0 publisher errors, breaker closed",
+            )
+        )
+        # --- phase 2: sticky loss — trip within the failure budget
+        inj.fail_sticky()
+        eng.record_fault(self.name, {"mode": "sticky"})
+        t_inj = time.monotonic()
+        # failure budget: threshold batches + slack for in-flight ones
+        budget = de.breaker_threshold + 4
+        tripped = None
+        for _ in range(budget):
+            await eng.burst([eng.fresh_topic(eng.chaos_filters[0])])
+            if de.breaker_state == "open":
+                tripped = time.monotonic() - t_inj
+                break
+        res.checks.append(
+            Check(
+                "breaker_tripped_within_budget",
+                tripped is not None,
+                f"{tripped * 1e3:.0f}ms, budget {budget} batches"
+                if tripped is not None
+                else f"not within {budget} batches",
+            )
+        )
+        if tripped is not None:
+            eng.faults_detected += 1
+            res.detect_ms = round(tripped * 1e3, 2)
+        # --- degraded-but-correct: full fan from the host walk, zero
+        # publisher-visible errors, zero audit divergence
+        fan = await eng.burst(
+            [eng.fresh_topic(eng.chaos_filters[0]) for _ in range(4)]
+        )
+        res.checks.append(
+            Check(
+                "degraded_serving_correct",
+                fan == 4 * eng.chaos_fan,
+                f"fan {fan}/{4 * eng.chaos_fan} host-side",
+            )
+        )
+        res.checks.append(
+            Check(
+                "alarm_raised",
+                eng.alarms.is_active("xla_device_breaker")
+                or "xla_device_breaker" in eng.alarms.fired_since(t0w),
+                "xla_device_breaker",
+            )
+        )
+        res.checks.append(
+            Check(
+                "flight_bundle_captured",
+                _fires(eng, "device_breaker_trip") > fires0,
+                "device_breaker_trip trigger fired",
+            )
+        )
+        res.checks.append(
+            Check(
+                "no_divergence_while_degraded",
+                len(eng.detections) == det0,
+                f"{len(eng.detections) - det0} unexpected",
+            )
+        )
+        # --- phase 3: heal -> probe -> resync -> close
+        inj.heal()
+        rec = await eng.wait_for(
+            lambda: de.breaker_state == "closed",
+            timeout=eng.settle_timeout + de.probe_backoff_max_s * 4,
+        )
+        res.checks.append(
+            Check(
+                "breaker_recovered",
+                rec is not None,
+                f"{rec * 1e3:.0f}ms after heal" if rec is not None
+                else "probe never closed the breaker",
+            )
+        )
+        if rec is not None:
+            res.recovery_ms = round((time.monotonic() - t_inj) * 1e3, 2)
+        c2 = c()
+        res.checks.append(
+            Check(
+                "recovery_resynced_device",
+                c2.get("device_resyncs_total", 0)
+                > c0.get("device_resyncs_total", 0)
+                and c2.get("breaker_recoveries_total", 0)
+                > c0.get("breaker_recoveries_total", 0),
+                f"resyncs +{c2.get('device_resyncs_total', 0) - c0.get('device_resyncs_total', 0)}",
+            )
+        )
+        res.checks.append(
+            Check(
+                "alarm_cleared",
+                not eng.alarms.is_active("xla_device_breaker"),
+                "xla_device_breaker deactivated",
+            )
+        )
+        # post-close: device-served again, full fan, zero divergence
+        # (the sentinel's shadow audit samples these bursts; the sweep
+        # compares EVERY answer to the oracle)
+        post = await eng.burst(
+            [eng.fresh_topic(f) for f in eng.chaos_filters]
+        )
+        res.checks.append(
+            Check(
+                "post_recovery_full_fan",
+                post == len(eng.chaos_filters) * eng.chaos_fan,
+                f"{post} deliveries device-side",
+            )
+        )
+        sweep = await eng.audit_sweep(per_groups=128)
+        res.checks.append(
+            Check(
+                "divergence_free_after_close",
+                sweep["silent_divergences"] == 0,
+                f"{sweep['topics_swept']} topics swept",
+            )
+        )
+        res.checks.append(
+            Check(
+                "zero_publisher_errors",
+                eng.storm_errors == err0,
+                f"{eng.storm_errors - err0} storm chunks failed",
+            )
+        )
+        res.checks.append(_slo_check(eng, t0w))
+        res.extra["trip_after_failures"] = de.breaker_threshold
+        return res
+
+
+class DeviceFlap(Scenario):
+    """Repeated loss/heal cycles (a flapping accelerator link): each
+    cycle must trip and fully recover — no wedged half-open state, no
+    publisher-visible errors, no leftover alarm — and the breaker's
+    counters must account for every cycle."""
+
+    name = "device_flap"
+    reference = (
+        "emqx_limiter token-bucket refill (SURVEY.md:376) analog: "
+        "repeated overload/recover cycles must stay bounded"
+    )
+
+    def __init__(self, cycles: int = 3):
+        self.cycles = cycles
+
+    async def run(self, eng) -> ScenarioResult:
+        res = ScenarioResult(self.name)
+        de = eng.broker.engine
+        inj = eng.injector
+        t0w = time.time()
+        err0 = eng.storm_errors
+        c0 = eng.counters()
+        recovered = 0
+        t_first = None
+        for cycle in range(self.cycles):
+            inj.fail_sticky()
+            eng.record_fault(self.name, {"cycle": cycle})
+            if t_first is None:
+                t_first = time.monotonic()
+            tripped = None
+            for _ in range(de.breaker_threshold + 4):
+                await eng.burst([eng.fresh_topic(eng.chaos_filters[0])])
+                if de.breaker_state == "open":
+                    tripped = True
+                    break
+            if tripped:
+                eng.faults_detected += 1
+            inj.heal()
+            rec = await eng.wait_for(
+                lambda: de.breaker_state == "closed",
+                timeout=eng.settle_timeout + de.probe_backoff_max_s * 4,
+            )
+            if tripped and rec is not None:
+                recovered += 1
+        res.checks.append(
+            Check(
+                "every_cycle_recovered",
+                recovered == self.cycles,
+                f"{recovered}/{self.cycles} trip+recover cycles",
+            )
+        )
+        c1 = eng.counters()
+        res.checks.append(
+            Check(
+                "flaps_accounted",
+                c1.get("breaker_trips_total", 0)
+                - c0.get("breaker_trips_total", 0) == self.cycles
+                and c1.get("breaker_recoveries_total", 0)
+                - c0.get("breaker_recoveries_total", 0) == self.cycles,
+                f"trips +{c1.get('breaker_trips_total', 0) - c0.get('breaker_trips_total', 0)}, "
+                f"recoveries +{c1.get('breaker_recoveries_total', 0) - c0.get('breaker_recoveries_total', 0)}",
+            )
+        )
+        if t_first is not None:
+            res.detect_ms = round((time.monotonic() - t_first) * 1e3, 2)
+            res.recovery_ms = res.detect_ms
+        res.checks.append(
+            Check(
+                "breaker_closed_at_end",
+                de.breaker_state == "closed"
+                and not eng.alarms.is_active("xla_device_breaker"),
+                f"state={de.breaker_state}",
+            )
+        )
+        res.checks.append(
+            Check(
+                "zero_publisher_errors",
+                eng.storm_errors == err0,
+                f"{eng.storm_errors - err0} storm chunks failed",
+            )
+        )
+        sweep = await eng.audit_sweep(per_groups=64)
+        res.checks.append(
+            Check(
+                "audit_clean_after_flaps",
+                sweep["silent_divergences"] == 0,
+                f"{sweep['topics_swept']} topics swept",
+            )
+        )
+        res.checks.append(_slo_check(eng, t0w))
+        res.extra["cycles"] = self.cycles
+        return res
+
+
 class DisconnectTakeover(Scenario):
     """Mass-disconnect + same-node session takeover: a wave of the
     fleet drops (eviction agent), the storm keeps running, the wave
@@ -730,6 +996,8 @@ def scenario_catalog(cluster: bool = True) -> List[Scenario]:
     cat: List[Scenario] = [
         StormBaseline(),
         RowCorruption(faults=2),
+        DeviceLoss(),
+        DeviceFlap(),
         DisconnectTakeover(),
     ]
     if cluster:
@@ -741,6 +1009,8 @@ def scenario_catalog(cluster: bool = True) -> List[Scenario]:
 CATALOG = [
     StormBaseline.name,
     RowCorruption.name,
+    DeviceLoss.name,
+    DeviceFlap.name,
     DisconnectTakeover.name,
     PartitionNodedown.name,
     NodeEvacuation.name,

@@ -245,6 +245,52 @@ def broker_schema() -> Struct:
                                     "tpu_churn_reserve": Field(
                                         Int(min=1), default=512
                                     ),
+                                    # device failure domain (broker/
+                                    # dispatch_engine.py): N consecutive
+                                    # device failures (or batches past
+                                    # the per-batch deadline) trip the
+                                    # breaker into host-degraded
+                                    # service; a bounded-exponential-
+                                    # backoff canary probe resyncs and
+                                    # verifies device state before
+                                    # closing it
+                                    "tpu_breaker_enable": Field(
+                                        Bool(), default=True
+                                    ),
+                                    "tpu_breaker_threshold": Field(
+                                        Int(min=1), default=4
+                                    ),
+                                    "tpu_breaker_deadline_ms": Field(
+                                        Float(), default=250.0
+                                    ),
+                                    "tpu_breaker_probe_backoff_ms": Field(
+                                        Float(), default=100.0
+                                    ),
+                                    "tpu_breaker_probe_backoff_max_ms": Field(
+                                        Float(), default=5000.0
+                                    ),
+                                    # admission control (the emqx_olp /
+                                    # emqx_limiter analog for the device
+                                    # link): bounded dispatch queue with
+                                    # shed (fail fast, counted) or block
+                                    # (await capacity) overload policy,
+                                    # and a per-publish queue deadline
+                                    # so a wedged device can never hang
+                                    # publishers. low watermark 0 =
+                                    # auto (max_depth / 2)
+                                    "tpu_queue_max_depth": Field(
+                                        Int(min=1), default=8192
+                                    ),
+                                    "tpu_queue_policy": Field(
+                                        Enum("shed", "block"),
+                                        default="shed",
+                                    ),
+                                    "tpu_queue_deadline_ms": Field(
+                                        Float(), default=1000.0
+                                    ),
+                                    "tpu_queue_low_watermark": Field(
+                                        Int(min=0), default=0
+                                    ),
                                     # publish sentinel (obs/sentinel):
                                     # 1/sample_n served publishes get a
                                     # stage span + a deferred

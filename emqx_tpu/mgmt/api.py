@@ -1324,6 +1324,16 @@ class ManagementApi:
             # per-stage publish attribution + exemplar topic/trace ids
             # for the sampled publishes (obs/sentinel.py)
             out["publish_stages"] = st.stage_snapshot()
+        eng = getattr(self.broker, "engine", None)
+        if eng is not None:
+            # device failure domain: breaker state machine + admission
+            # control, straight off the engine (dispatch_engine.status)
+            es = eng.status()
+            out["dispatch_engine"] = {
+                "breaker": es["breaker"],
+                "admission": es["admission"],
+                "coalesce_factor": es["coalesce_factor"],
+            }
         return out
 
     def _xla_sentinel(self, req: Request):

@@ -146,6 +146,9 @@ class DeviceTable:
         self._dev_slots: Optional[SlotArrays] = None
         self._dev_residual: Optional[jnp.ndarray] = None
         self.fanout: Optional[fanout_ops.FanoutDeviceState] = None
+        # chaos fault seam (emqx_tpu/chaos/faults.py): one attribute
+        # read per sync when absent
+        self.fault_injector = None
 
     def attach_fanout(self, store: fanout_ops.DestStore) -> None:
         """Mirror a CSR destination store on this device — the
@@ -214,6 +217,9 @@ class DeviceTable:
 
     def sync(self) -> int:
         """Bring device state up to date; returns rows written."""
+        fi = self.fault_injector
+        if fi is not None:
+            fi.check("sync")
         tel = self.telemetry
         t0 = tel.clock()
         pending = len(self.table.dirty)
@@ -414,6 +420,14 @@ class Router:
         # (standalone routers) stores every client edge as SKIP, which
         # matches the oracle (no suboption -> not in the plan)
         self.fanout_opts_lookup = None
+        # device failure domain (broker/dispatch_engine.py breaker +
+        # emqx_tpu/chaos/faults.py): `fault_injector` is the chaos seam
+        # at the XLA boundary (None costs one attribute read per leg);
+        # `device_suspended` routes every batched match and fanout
+        # resolve through the host walk — degraded-but-correct service
+        # while the circuit breaker is open.
+        self.fault_injector = None
+        self.device_suspended = False
         # shadow-audit quarantine (obs/sentinel.py): filters whose
         # device rows diverged from the host oracle. While quarantined
         # a filter is answered by the host walk (overlay in
@@ -549,6 +563,96 @@ class Router:
         if tel.enabled:
             tel.count("audit_unquarantine_total", n)
             tel.set_gauge("audit_quarantined_filters", 0)
+
+    # --- device failure domain (dispatch-engine circuit breaker) --------
+
+    def suspend_device(self) -> bool:
+        """Open-breaker mode: every batched match and fanout resolve
+        answers from host truth until resume_device(). Returns True on
+        the closed->open transition. The sync delta stream stops; the
+        dirty backlog is dropped once it outgrows the table (see the
+        host leg of match_filters_begin) because recovery re-uploads
+        full state anyway."""
+        if self.device_suspended:
+            return False
+        self.device_suspended = True
+        tel = self.telemetry
+        if tel.enabled:
+            tel.count("device_suspends_total")
+            tel.set_gauge("device_suspended", 1)
+        return True
+
+    def resume_device(self) -> None:
+        """Close-breaker mode: device serving resumes. Callers run
+        device_resync() + a verified canary FIRST — resuming against
+        stale device state would serve the corruption the suspension
+        existed to avoid."""
+        if not self.device_suspended:
+            return
+        self.device_suspended = False
+        tel = self.telemetry
+        if tel.enabled:
+            tel.count("device_resumes_total")
+            tel.set_gauge("device_suspended", 0)
+
+    def device_resync(self) -> None:
+        """Force the next sync to re-upload FULL device state from host
+        truth: table snapshot, index meta/slots/residual, and the
+        fanout CSR mirror — the quarantine clean-sync machinery reused
+        by breaker recovery, where an outage dropped the delta stream
+        and no scatter replay can be trusted."""
+        dt = self.device_table
+        dt._dev = None  # _sync_impl's full-upload branch (both tables)
+        ix = self.index
+        if ix is not None:
+            ix.meta_dirty = True
+            ix.rebuilt = True
+            ix.residual_dirty = True
+        fan = getattr(dt, "fanout", None)
+        if fan is not None:
+            fan._seg_off = None  # FanoutDeviceState full-upload branch
+        # cached match entries may have been populated host-side during
+        # the outage; stale them so the recovered device re-earns trust
+        # under the sentinel's audit rather than hiding behind hits
+        self._aux_gen += 1
+        if self.telemetry.enabled:
+            self.telemetry.count("device_resyncs_total")
+
+    def canary_match(self, topics: Sequence[str]) -> List[List[str]]:
+        """Device-path probe for the breaker's recovery loop: run the
+        batched kernels for `topics` IGNORING suspension and the match
+        cache (the probe must exercise the link and the kernels, not a
+        dict). Raises on any device fault; returns per-topic filter
+        lists for the caller to compare against match_filters."""
+        prev = self.device_suspended
+        cache = self.match_cache
+        self.device_suspended = False
+        self.match_cache = None
+        try:
+            return self.match_filters_finish(
+                self.match_filters_begin(topics)
+            )
+        finally:
+            self.device_suspended = prev
+            self.match_cache = cache
+
+    def match_filters_host(self, p: "_PendingMatch") -> List[List[str]]:
+        """Host re-serve of a begun batch whose device leg failed:
+        answer every sub-topic from host truth (the oracle the device
+        path is bit-identical to by contract) and merge into the cached
+        prefix — correct regardless of what the kernels did, so the
+        dispatch engine's failover hands publishers exactly what a
+        healthy device would have."""
+        out = [self.match_filters(t) for t in p.topics]
+        tel = self.telemetry
+        if tel.enabled and p.topics:
+            tel.count("host_fallback_total")
+        if p.full_out is None:
+            return out
+        full = p.full_out
+        for j, i in enumerate(p.sub_idx):
+            full[i] = out[j]
+        return full
 
     # --- chaos corruption seam (emqx_tpu/chaos) --------------------------
 
@@ -687,6 +791,12 @@ class Router:
         the match path's deep-trie leg."""
         if not filters:
             return None
+        if self.device_suspended:
+            # breaker open: every plan resolves host-side until the
+            # recovery canary verifies the re-uploaded device state
+            if self.telemetry.enabled:
+                self.telemetry.count("fanout_host_fallback_total")
+            return None
         if self._quarantined:
             # a quarantined filter's dest segment is suspect: the whole
             # set resolves host-side until the clean sync clears it
@@ -714,6 +824,9 @@ class Router:
         fan = self.dest_store.fan_of(rows)
         if fan < max(min_fan, 1) or fan > fanout_ops.MAX_FAN:
             return None
+        fi = self.fault_injector
+        if fi is not None:
+            fi.check("fanout_begin")
         return self.device_table.fanout.resolve_begin(rows, fan)
 
     def resolve_fanout_finish(self, handle):
@@ -721,6 +834,9 @@ class Router:
         dedup ratio, and materialize the oracle-ordered (mem, other)
         plan — bit-identical to Broker._build_fanout_plan over the same
         host state."""
+        fi = self.fault_injector
+        if fi is not None:
+            fi.check("fanout_finish")
         win, fan = self.device_table.fanout.resolve_finish(handle)
         tel = self.telemetry
         if tel.enabled:
@@ -1342,6 +1458,23 @@ class Router:
         if not sub:
             p.mode = "cached"
             return p
+        if self.device_suspended:
+            # breaker open: the whole uncached remainder serves from
+            # host truth at finish — no encode, no sync, no kernels.
+            # The dirty backlog is dropped once it outgrows the table:
+            # recovery re-uploads full state, which subsumes it, and a
+            # churn storm during a long outage must not grow it
+            # unboundedly.
+            p.mode = "host"
+            t = self.table
+            if len(t.dirty) > t.capacity:
+                t.drain_dirty()
+            if tel.enabled:
+                tel.count("breaker_degraded_batches_total")
+            return p
+        fi = self.fault_injector
+        if fi is not None:
+            fi.check("match_begin")
         tel.count("dispatch_batches_total")
         root = tel.span("xla.match_batch")
         if root is not None:
@@ -1446,6 +1579,17 @@ class Router:
         topics = p.topics
         span = p.span
         t_fetch = clock() if span is not None else 0.0
+        if p.mode == "host":
+            # breaker-open batch: serve every sub-topic from host truth
+            # (exact + trie + deep in one walk) — degraded capacity,
+            # identical answers
+            t0 = clock()
+            out = p.out = [self.match_filters(t) for t in topics]
+            tel.record_dispatch(LEG_FALLBACK, clock() - t0)
+        elif p.mode != "cached":
+            fi = self.fault_injector
+            if fi is not None:
+                fi.check("match_finish")
         if p.mode == "mesh_dense":
             root = p.root
             sp = tel.span("xla.dispatch", root)
@@ -1576,7 +1720,9 @@ class Router:
                 out[int(t_idx)].append(self._row_filter[int(row)])
             tel.record_dispatch(LEG_DENSE, p.dense_elapsed + clock() - t0)
             tel.end_span(sp)
-        if p.mode != "cached":
+        if p.mode not in ("cached", "host"):
+            # (host mode already folded deep matches via match_filters
+            # and needs no quarantine overlay: it IS host truth)
             if self._deep:
                 for i, t in enumerate(topics):
                     out[i].extend(self._deep_trie.match(topic_mod.words(t)))

@@ -184,6 +184,7 @@ def default_rules(
     cache_cooldown: float = 60.0,
     fanout_rebuild_rate: int = 64,
     fanout_cooldown: float = 60.0,
+    breaker_cooldown: float = 60.0,
 ) -> List[TriggerRule]:
     """The stock rule set; every threshold is a constructor knob so
     config/tests can tighten or disable individual rules."""
@@ -320,6 +321,15 @@ def default_rules(
         # oracle (obs/sentinel.py) — the one anomaly where the ring's
         # pre-breach events ARE the forensic record of the bad serve
         TriggerRule("audit_divergence", lambda ctl: None, cooldown),
+        # event-driven: the dispatch engine fires this the moment its
+        # device circuit breaker trips (broker/dispatch_engine.py) —
+        # the ring then holds the exact device-leg samples and failed
+        # batches that consumed the failure budget. Own (longer)
+        # cooldown: an outage is one incident, a flapping device must
+        # not snapshot-spam its way through the store rotation.
+        TriggerRule(
+            "device_breaker_trip", lambda ctl: None, breaker_cooldown
+        ),
         # event-driven: the chaos scenario engine (emqx_tpu/chaos)
         # stamps every injected fault with a bundle, so the forensic
         # record of a chaos window carries the injection alongside the

@@ -433,6 +433,9 @@ class ShardedDeviceTable:
             make_slot_delta_kernel(mesh) if index is not None else None
         )
         self.fanout = None
+        # chaos fault seam (emqx_tpu/chaos/faults.py) — same contract
+        # as the single-device DeviceTable: one attribute read per sync
+        self.fault_injector = None
 
     def attach_fanout(self, store) -> None:
         """Mirror a CSR destination store on the mesh (replicated: the
@@ -518,6 +521,9 @@ class ShardedDeviceTable:
             ix.residual_dirty = False
 
     def sync(self) -> int:
+        fi = self.fault_injector
+        if fi is not None:
+            fi.check("sync")
         tel = self.telemetry
         t0 = tel.clock()
         pending = len(self.table.dirty)

@@ -349,3 +349,90 @@ def test_null_telemetry_scrape_stays_clean():
     text = prometheus_text(broker, "n1@host")
     assert "emqx_xla_" not in text
     assert "# TYPE emqx_topics_count gauge" in text
+
+
+async def test_breaker_and_queue_families_lint(tmp_path):
+    # ISSUE-8 families: every emqx_xla_breaker_* / emqx_xla_queue_*
+    # family the device failure domain exports must render on a real
+    # driven scrape — trip, degrade, probe failure, recovery, shed,
+    # block, deadline expiry, slow-batch deadline — and pass the lint
+    import time as _time
+
+    from emqx_tpu.broker.dispatch_engine import QueueOverloadError
+    from emqx_tpu.chaos.faults import DeviceFaultInjector
+    from emqx_tpu.obs.alarm import Alarms
+
+    broker = Broker()
+    for i in range(4):
+        s, _ = broker.open_session(f"c{i}", clean_start=True)
+        s.outgoing_sink = lambda pkts: None
+        broker.subscribe(s, f"q/{i}/+", SubOpts(qos=0))
+    eng = broker.enable_dispatch_engine(
+        queue_depth=4, deadline_ms=0.5, breaker_threshold=2,
+        breaker_deadline_ms=1.0, probe_backoff_ms=5.0,
+        probe_backoff_max_ms=20.0, queue_max_depth=64,
+    )
+    eng.alarms = Alarms(broker)
+    inj = DeviceFaultInjector().install(broker.router)
+    tel = broker.router.telemetry
+
+    # slow batch -> deadline counter; sticky -> trip; heal -> recovery
+    inj.stall(0.005, n=1, legs=("match_finish",))
+    await eng.publish(Message(topic="q/0/slow", payload=b"x"))
+    inj.fail_sticky()
+    for w in range(4):
+        await eng.publish(Message(topic=f"q/1/t{w}", payload=b"x"))
+        if eng.breaker_state == "open":
+            break
+    assert eng.breaker_state == "open"
+    inj.heal()
+    t0 = _time.monotonic()
+    while eng.breaker_state != "closed" and _time.monotonic() - t0 < 10:
+        await asyncio.sleep(0.01)
+    assert eng.breaker_state == "closed"
+    # shed + block + deadline expiry
+    eng.queue_max_depth = 1
+    futs = [
+        eng.submit(Message(topic=f"q/2/s{i}", payload=b"x"))
+        for i in range(3)
+    ]
+    res = await asyncio.gather(*futs, return_exceptions=True)
+    assert any(isinstance(r, QueueOverloadError) for r in res)
+    eng.queue_policy = "block"
+    eng.queue_deadline_s = 0.02
+    futs = [
+        eng.submit(Message(topic=f"q/2/b{i}", payload=b"x"))
+        for i in range(3)
+    ]
+    await asyncio.sleep(0.1)
+    await eng.drain()
+    await asyncio.gather(*futs, return_exceptions=True)
+    eng.queue_max_depth = 64
+    await eng.stop()
+
+    text = prometheus_text(broker, "n1@host")
+    types = _lint(text)
+    for fam, kind in (
+        ("emqx_xla_breaker_state", "gauge"),
+        ("emqx_xla_breaker_consecutive_failures", "gauge"),
+        ("emqx_xla_breaker_trips_total", "counter"),
+        ("emqx_xla_breaker_recoveries_total", "counter"),
+        ("emqx_xla_breaker_device_failures_total", "counter"),
+        ("emqx_xla_breaker_degraded_batches_total", "counter"),
+        ("emqx_xla_breaker_deadline_exceeded_total", "counter"),
+        ("emqx_xla_breaker_probe_total", "counter"),
+        ("emqx_xla_queue_shed_total", "counter"),
+        ("emqx_xla_queue_blocked_total", "counter"),
+        ("emqx_xla_queue_deadline_expired_total", "counter"),
+        ("emqx_xla_queue_depth", "gauge"),
+        ("emqx_xla_queue_waiters", "gauge"),
+        ("emqx_xla_queue_overloaded", "gauge"),
+        ("emqx_xla_device_suspends_total", "counter"),
+        ("emqx_xla_device_resumes_total", "counter"),
+        ("emqx_xla_device_resyncs_total", "counter"),
+        ("emqx_xla_chaos_device_faults_total", "counter"),
+        ("emqx_xla_chaos_device_stalls_total", "counter"),
+    ):
+        assert types.get(fam) == kind, f"{fam}: {types.get(fam)}"
+    assert tel.counters["breaker_trips_total"] == 1
+    assert tel.counters["breaker_recoveries_total"] == 1

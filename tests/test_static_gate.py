@@ -1,5 +1,5 @@
 """Static-check gate over the whole package — the round-5 judge's
-named CI gap. Four legs, all fast enough for tier-1:
+named CI gap. Six legs, all fast enough for tier-1:
 
   1. every module under emqx_tpu/ byte-compiles (an import typo in a
      rarely-exercised gateway must fail CI, not the first boot);
@@ -15,20 +15,51 @@ named CI gap. Four legs, all fast enough for tier-1:
      argument arities (parsed from the method table +
      PyArg_ParseTuple / METH_FASTCALL nargs checks) must match every
      Python call site — a drifted signature fails tier-1 here instead
-     of segfaulting the bench.
+     of segfaulting the bench;
+  5. dispatch-path `except Exception` handlers must COUNT or RE-RAISE
+     (ISSUE 8): the device failure domain turns every device fault
+     into a handled fallback, which is exactly one silent `pass` away
+     from becoming an unobservable outage — a handler on the publish
+     hot path that neither counts a telemetry metric, sets the
+     publisher's exception, nor re-raises fails this gate;
+  6. ruff + mypy (the ROADMAP-named satellite), gated on the tools
+     being installed — the image this repo targets does not ship
+     them, so the legs skip rather than fake a pass; when present,
+     ruff runs the pyflakes-critical selection and mypy checks the
+     typed failure-domain modules.
 """
 
 import ast
 import asyncio
+import importlib.util
 import pathlib
 import py_compile
 import re
+import subprocess
+import sys
+
+import pytest
 
 import emqx_tpu
 
 PKG = pathlib.Path(emqx_tpu.__file__).parent
 REPO = PKG.parent
 SPEEDUPS_CC = REPO / "native" / "speedups.cc"
+
+# the publish dispatch path: a device fault handled here MUST leave a
+# trace (telemetry count / publisher-visible exception / re-raise)
+DISPATCH_PATH = (
+    "broker/dispatch_engine.py",
+    "models/router.py",
+    "ops/fanout.py",
+    "ops/match.py",
+    "ops/hash_index.py",
+    "parallel/sharded_match.py",
+)
+
+# handler calls that count as surfacing the failure: telemetry counts,
+# metrics increments, or handing the exception to the publisher
+_SURFACING_CALLS = {"count", "inc", "set_exception"}
 
 # full family-name literals appearing in "# TYPE <name>" lines whose
 # render needs a backend the gate can't drive hermetically (none today
@@ -128,6 +159,95 @@ def test_create_task_sites_retain_handles():
         "fire-and-forget create_task (handle dropped — retain it or "
         "use a supervised spawn helper):\n" + "\n".join(bad)
     )
+
+
+def _handler_surfaces(handler: ast.ExceptHandler) -> bool:
+    """True when the handler body re-raises or makes a surfacing call
+    (tel.count / metrics.inc / fut.set_exception) somewhere inside."""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _SURFACING_CALLS
+        ):
+            return True
+    return False
+
+
+def _catches_broad_exception(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    names = []
+    if isinstance(t, ast.Name):
+        names = [t.id]
+    elif isinstance(t, ast.Tuple):
+        names = [e.id for e in t.elts if isinstance(e, ast.Name)]
+    return "Exception" in names or "BaseException" in names
+
+
+def test_dispatch_path_except_exception_counts_or_reraises():
+    bad = []
+    for rel in DISPATCH_PATH:
+        path = PKG / rel
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _catches_broad_exception(node):
+                continue
+            if not _handler_surfaces(node):
+                bad.append(f"{path}:{node.lineno}")
+    assert not bad, (
+        "dispatch-path `except Exception` swallows silently (must "
+        "count a telemetry metric, set the publisher's exception, or "
+        "re-raise):\n" + "\n".join(bad)
+    )
+
+
+def _has_tool(mod: str) -> bool:
+    return importlib.util.find_spec(mod) is not None
+
+
+@pytest.mark.skipif(
+    not _has_tool("ruff"), reason="ruff not installed in this image"
+)
+def test_ruff_critical_selection():
+    """Pyflakes-critical ruff rules over the package + tests + bench:
+    syntax errors (E9), invalid comparisons/prints (F63/F7), and
+    undefined names (F82) are bugs, not style."""
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "ruff", "check",
+            "--select", "E9,F63,F7,F82",
+            str(PKG), str(REPO / "tests"), str(REPO / "bench.py"),
+        ],
+        capture_output=True,
+        text=True,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+@pytest.mark.skipif(
+    not _has_tool("mypy"), reason="mypy not installed in this image"
+)
+def test_mypy_failure_domain_modules():
+    """Type-check the failure-domain modules (the newest, most typed
+    surface) — scoped so the gate stays green-by-construction on the
+    legacy loosely-typed modules while still catching signature drift
+    where exceptions and fallbacks interlock."""
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "mypy",
+            "--ignore-missing-imports", "--follow-imports=silent",
+            "--no-error-summary",
+            str(PKG / "chaos" / "faults.py"),
+            str(PKG / "obs" / "alarm.py"),
+        ],
+        capture_output=True,
+        text=True,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
 
 
 def test_metric_name_literals_obey_prometheus_naming():
