@@ -156,7 +156,19 @@ class Node:
                 queue_low_watermark=cfg.get(
                     "broker.perf.tpu_queue_low_watermark"
                 ),
+                # transfer-pipelined dispatch: chunk sizing + AOT
+                # shape warmup + GC discipline (ISSUE 9)
+                transfer_chunk_kb=cfg.get(
+                    "broker.perf.tpu_transfer_chunk_kb"
+                ),
+                aot_warm=cfg.get("broker.perf.tpu_aot_warm"),
+                gc_guard=cfg.get("broker.perf.tpu_gc_guard"),
             )
+            # serve-readiness pass: probe/size the transfer chunk,
+            # pre-trace every kernel shape bucket, freeze steady
+            # state out of the collector — after this, a retrace
+            # counts as recompiles_at_serve_total
+            broker.engine.warmup()
         self.broker = broker
 
         # 2. auth pipeline — chains/sources materialize from config

@@ -95,6 +95,7 @@ from __future__ import annotations
 
 import asyncio
 import contextlib
+import gc
 import logging
 from collections import deque
 from typing import Deque, List, Optional, Tuple
@@ -177,6 +178,9 @@ class DispatchEngine:
         queue_policy: str = "shed",
         queue_deadline_ms: float = 1000.0,
         queue_low_watermark: int = 0,
+        transfer_chunk_kb: float = 0.0,
+        aot_warm: bool = True,
+        gc_guard: bool = True,
         alarms=None,
         flight=None,
     ) -> None:
@@ -206,6 +210,17 @@ class DispatchEngine:
             if queue_low_watermark
             else max(1, self.queue_max_depth // 2)
         )
+        # --- transfer pipeline knobs (ops/transfer.py)
+        # chunk_kb: bound on a ring slot's compacted-result buffer;
+        # 0 = auto-size from the link probe at warmup (BDP). aot_warm:
+        # pre-trace every kernel shape bucket at warmup so production
+        # dispatches never pay an XLA retrace. gc_guard: keep
+        # collector pauses out of launch/collect critical sections
+        # (gc.freeze of steady state at warmup + per-flush pause).
+        self.transfer_chunk_kb = float(transfer_chunk_kb)
+        self.aot_warm = bool(aot_warm)
+        self.gc_guard = bool(gc_guard)
+        self.warmed = False
         # alarms/flight: explicit wiring wins; otherwise resolved
         # lazily through the attached sentinel (boot order attaches
         # the engine first and the obs bundle later — or vice versa in
@@ -254,6 +269,78 @@ class DispatchEngine:
             return self.flight
         st = self.broker.sentinel
         return st.flight if st is not None else None
+
+    # --- warmup: chunk sizing + AOT shape pre-trace + GC discipline ------
+
+    def warmup(self) -> dict:
+        """One-time serve-readiness pass (boot calls it after attach;
+        bench calls it before timed windows; idempotent):
+
+          1. size the transfer chunk — `transfer_chunk_kb` as given, or
+             auto from a link probe (RTT floor x fetch bandwidth, the
+             BDP) — and push it into the device table;
+          2. AOT-warm every kernel shape bucket the engine can dispatch
+             (pow2 batch ladder up to queue_depth through the REAL
+             begin/finish halves), then flip the telemetry to serving:
+             any later retrace counts as `recompiles_at_serve_total`;
+          3. freeze the now-steady object graph out of the cyclic
+             collector (gc.freeze) so gen-2 passes never scan the
+             table/session bulk from inside a timed launch — paired
+             with the per-flush collector pause in _flush/_collect_one.
+
+        Returns a summary dict (also merged into status())."""
+        router = self.router
+        tel = self.telemetry
+        info: dict = {}
+        chunk_kb = self.transfer_chunk_kb
+        if not chunk_kb:
+            from ..ops import transfer as transfer_ops
+
+            try:
+                rtt_s, bw = transfer_ops.probe_link()
+                chunk_kb = transfer_ops.auto_chunk_kb(rtt_s, bw)
+                info["link_rtt_ms"] = round(rtt_s * 1e3, 3)
+                info["link_mb_per_s"] = round(bw / 1e6, 1)
+            except Exception as e:
+                # a dead link at boot is the breaker's business, not
+                # warmup's — leave the chunk unbounded, note it
+                tel.count("warmup_probe_failures_total")
+                log.warning("link probe failed during warmup: %r", e)
+                chunk_kb = 0
+        if chunk_kb:
+            router.set_transfer_chunk(chunk_kb)
+        self.transfer_chunk_kb = chunk_kb
+        info["transfer_chunk_kb"] = chunk_kb
+        if self.aot_warm:
+            try:
+                info["aot_shapes"] = router.warmup_shapes(self.queue_depth)
+            except Exception as e:
+                # a device that cannot even warm up is the breaker's
+                # business — boot comes up degraded, never dead
+                tel.count("warmup_failures_total")
+                log.warning("AOT warmup failed: %r", e)
+                self._device_failure(e)
+        tel.mark_serving()
+        if self.gc_guard and not self.warmed:
+            gc.collect()
+            gc.freeze()
+        self.warmed = True
+        return info
+
+    def _gc_pause(self) -> bool:
+        """Suspend the cyclic collector for a launch/collect critical
+        section; returns whether it was running (restore token)."""
+        if not self.gc_guard:
+            return False
+        was = gc.isenabled()
+        if was:
+            gc.disable()
+        return was
+
+    @staticmethod
+    def _gc_resume(was: bool) -> None:
+        if was:
+            gc.enable()
 
     # --- async publish surface -------------------------------------------
 
@@ -475,81 +562,89 @@ class DispatchEngine:
             self._timer.cancel()
             self._timer = None
         batch, self._queue = self._queue, []
-        tel = self.telemetry
-        broker = self.broker
-        router = self.router
-        st = broker.sentinel
-        now = tel.clock()
-        entries = []
-        topics = []
-        bspan = None
-        for msg, fut, t_in, span in batch:
-            tel.observe_family("pipeline_queue_wait_seconds", now - t_in)
-            if span is not None:
-                span.add("queue", now - t_in)
-                if bspan is None and st is not None:
-                    bspan = st.batch_span()
-            live = broker._pre_publish(msg)
-            entries.append((live, fut, span))
-            if live is not None:
-                topics.append(live.topic)
-        self.batches_total += 1
-        self.publishes_total += len(batch)
-        if topics:
-            self._recent_topics.append(topics[0])
+        # collector pauses must not land inside the launch window (the
+        # gen-2-pass-in-a-timed-batch outlier PERF_NOTES r5/r6 chased);
+        # the pause spans launch + any forced over-depth collects and
+        # restores on exit, so collection happens BETWEEN batches
+        gc_tok = self._gc_pause()
         try:
-            pending = router.match_filters_begin(topics, span=bspan)
-        except Exception as e:
-            # launch-side device fault (encode/sync/kernel dispatch):
-            # re-begin in host mode — the cache probe re-runs (cheap,
-            # correct) and finish serves from host truth
-            tel.count("breaker_begin_failures_total")
-            self._device_failure(e)
-            pending = self._host_begin(topics, bspan)
-        # device-resolved fanout overlap: topics the match cache
-        # answered at begin time have known filter sets NOW — launch
-        # their plan resolves immediately so the deduped plan
-        # materializes on device while the match hash fetch for the
-        # uncached remainder is still in flight
-        fanout_pending = None
-        if (
-            broker._fanout_device
-            and pending.full_out is not None
-            and not router.device_suspended
-        ):
-            seen = set()
-            for flts in pending.full_out:
-                if flts is None:
-                    continue
-                fkey = tuple(flts)
-                if fkey in seen:
-                    continue
-                seen.add(fkey)
-                if broker._plan_fresh(fkey):
-                    continue
-                try:
-                    h = router.resolve_fanout_begin(
-                        fkey, min_fan=broker._fanout_min_fan
-                    )
-                except Exception as e:
-                    # fanout launch fault: the dispatch path rebuilds
-                    # plans host-side — skip the overlap, note the link
-                    tel.count("fanout_host_fallback_total")
-                    self._device_failure(e)
-                    break
-                if h is not None:
-                    if fanout_pending is None:
-                        fanout_pending = []
-                    fanout_pending.append(
-                        (fkey, broker._fanout_clock, h)
-                    )
-        self._inflight.append((pending, entries, fanout_pending, bspan))
-        self._inflight_pubs += len(entries)
-        tel.set_gauge("pipeline_depth", len(self._inflight))
-        tel.set_gauge("pipeline_coalesce", len(batch))
-        tel.set_gauge("queue_depth", self.outstanding())
-        while len(self._inflight) > self.pipeline_depth:
-            self._collect_one()
+            tel = self.telemetry
+            broker = self.broker
+            router = self.router
+            st = broker.sentinel
+            now = tel.clock()
+            entries = []
+            topics = []
+            bspan = None
+            for msg, fut, t_in, span in batch:
+                tel.observe_family("pipeline_queue_wait_seconds", now - t_in)
+                if span is not None:
+                    span.add("queue", now - t_in)
+                    if bspan is None and st is not None:
+                        bspan = st.batch_span()
+                live = broker._pre_publish(msg)
+                entries.append((live, fut, span))
+                if live is not None:
+                    topics.append(live.topic)
+            self.batches_total += 1
+            self.publishes_total += len(batch)
+            if topics:
+                self._recent_topics.append(topics[0])
+            try:
+                pending = router.match_filters_begin(topics, span=bspan)
+            except Exception as e:
+                # launch-side device fault (encode/sync/kernel dispatch):
+                # re-begin in host mode — the cache probe re-runs (cheap,
+                # correct) and finish serves from host truth
+                tel.count("breaker_begin_failures_total")
+                self._device_failure(e)
+                pending = self._host_begin(topics, bspan)
+            # device-resolved fanout overlap: topics the match cache
+            # answered at begin time have known filter sets NOW — launch
+            # their plan resolves immediately so the deduped plan
+            # materializes on device while the match hash fetch for the
+            # uncached remainder is still in flight
+            fanout_pending = None
+            if (
+                broker._fanout_device
+                and pending.full_out is not None
+                and not router.device_suspended
+            ):
+                seen = set()
+                for flts in pending.full_out:
+                    if flts is None:
+                        continue
+                    fkey = tuple(flts)
+                    if fkey in seen:
+                        continue
+                    seen.add(fkey)
+                    if broker._plan_fresh(fkey):
+                        continue
+                    try:
+                        h = router.resolve_fanout_begin(
+                            fkey, min_fan=broker._fanout_min_fan
+                        )
+                    except Exception as e:
+                        # fanout launch fault: the dispatch path rebuilds
+                        # plans host-side — skip the overlap, note the link
+                        tel.count("fanout_host_fallback_total")
+                        self._device_failure(e)
+                        break
+                    if h is not None:
+                        if fanout_pending is None:
+                            fanout_pending = []
+                        fanout_pending.append(
+                            (fkey, broker._fanout_clock, h)
+                        )
+            self._inflight.append((pending, entries, fanout_pending, bspan))
+            self._inflight_pubs += len(entries)
+            tel.set_gauge("pipeline_depth", len(self._inflight))
+            tel.set_gauge("pipeline_coalesce", len(batch))
+            tel.set_gauge("queue_depth", self.outstanding())
+            while len(self._inflight) > self.pipeline_depth:
+                self._collect_one()
+        finally:
+            self._gc_resume(gc_tok)
         if self._inflight and not self._drain_scheduled:
             self._drain_scheduled = True
             asyncio.get_running_loop().call_soon(self._drain)
@@ -565,10 +660,45 @@ class DispatchEngine:
         finally:
             router.device_suspended = prev
 
+    # seconds between readiness re-probes while the ring head's
+    # transfer is still in flight (the loop is yielded, not blocked)
+    _RING_POLL_S = 0.0002
+
+    def _head_ready(self) -> bool:
+        """True when collecting the ring head will not block: the
+        match legs' AND any overlapped fanout resolves' transfer
+        tickets have all landed host-side."""
+        pending, _entries, fanout_pending, _bspan = self._inflight[0]
+        if not self.router.match_finish_ready(pending):
+            return False
+        if fanout_pending is not None:
+            for _fkey, _clock, h in fanout_pending:
+                if not h[0].ready():
+                    return False
+        return True
+
     def _drain(self) -> None:
+        """Collect ring slots in COMPLETION order without ever
+        blocking the event loop on a transfer still in flight:
+        delivery order stays strictly begin order (the Router's
+        finish contract — bit-exactness depends on it), but a head
+        whose transfer has not landed yields the loop and re-probes,
+        so the host keeps encoding/launching instead of stalling in
+        np.asarray. Over-depth slots still force-collect (the ring is
+        the backpressure bound)."""
         self._drain_scheduled = False
         while self._inflight:
-            self._collect_one()
+            if (
+                len(self._inflight) > self.pipeline_depth
+                or self._head_ready()
+            ):
+                self._collect_one()
+                continue
+            self._drain_scheduled = True
+            asyncio.get_running_loop().call_later(
+                self._RING_POLL_S, self._drain
+            )
+            return
         self.telemetry.set_gauge("pipeline_depth", 0)
 
     def _collect_one(self) -> None:
@@ -584,91 +714,95 @@ class DispatchEngine:
         tel = self.telemetry
         tclock = tel.clock
         device_batch = pending.mode not in ("cached", "host")
-        t0 = tclock()
+        gc_tok = self._gc_pause()
         try:
-            filter_lists = router.match_filters_finish(pending)
-        except Exception as e:
-            # transient device fault: re-serve the WHOLE batch from
-            # host truth — bit-identical by the oracle contract, so
-            # publishers never see it; the failure still counts toward
-            # the breaker
-            tel.count("breaker_fallback_total", len(entries))
-            self._device_failure(e)
-            fanout_pending = None  # overlapped resolves died with it
+            t0 = tclock()
             try:
-                filter_lists = router.match_filters_host(pending)
-            except Exception as e2:  # host truth failed: nothing left
-                tel.count("publish_failures_total", len(entries))
-                for _live, fut, _span in entries:
-                    if not fut.done():
-                        fut.set_exception(e2)
-                self._batch_done(len(entries))
-                return
-        else:
-            if device_batch and self.breaker_enabled:
-                if (
-                    self.breaker_deadline_s
-                    and tclock() - t0 > self.breaker_deadline_s
-                ):
-                    # slow is a fault even when it is not wrong: the
-                    # results serve, the breaker still hears about it
-                    tel.count("breaker_deadline_exceeded_total")
-                    self._device_failure(None)
-                else:
-                    self._device_success()
-        if fanout_pending is not None:
-            # install the overlapped plans before delivering: stamped
-            # with the clock captured at begin, so a mutation that
-            # landed mid-flight leaves them stale-on-arrival and the
-            # dispatch below rebuilds — exactness over hit ratio
-            t_res = tclock() if bspan is not None else 0.0
-            for fkey, clock, h in fanout_pending:
+                filter_lists = router.match_filters_finish(pending)
+            except Exception as e:
+                # transient device fault: re-serve the WHOLE batch from
+                # host truth — bit-identical by the oracle contract, so
+                # publishers never see it; the failure still counts toward
+                # the breaker
+                tel.count("breaker_fallback_total", len(entries))
+                self._device_failure(e)
+                fanout_pending = None  # overlapped resolves died with it
                 try:
-                    plan = router.resolve_fanout_finish(h)
-                except Exception as e:
-                    # the dispatch path rebuilds host-side; counted so
-                    # a dying link can't fail resolves silently
-                    tel.count("fanout_host_fallback_total")
-                    self._device_failure(e)
-                    continue
-                broker._store_plan(fkey, clock, plan)
-            if bspan is not None:
-                bspan.add("resolve", tclock() - t_res)
-        fd = router.filter_dests
-        it = iter(filter_lists)
-        for live, fut, span in entries:
-            if live is None:
-                n = 0  # hook-denied / intercepted: same 0 as publish()
+                    filter_lists = router.match_filters_host(pending)
+                except Exception as e2:  # host truth failed: nothing left
+                    tel.count("publish_failures_total", len(entries))
+                    for _live, fut, _span in entries:
+                        if not fut.done():
+                            fut.set_exception(e2)
+                    self._batch_done(len(entries))
+                    return
             else:
-                flts = next(it)
-                pairs = [(f, fd(f)) for f in flts]
-                t_del = tclock() if span is not None else 0.0
-                try:
-                    n = broker._dispatch(live, pairs)
-                except Exception as e:
-                    # a delivery-side failure is the publisher's to
-                    # see (host bug, not a device fault) — counted,
-                    # then propagated
-                    tel.count("publish_failures_total")
-                    if not fut.done():
-                        fut.set_exception(e)
-                    continue
-                if span is not None and st is not None:
-                    span.add("deliver", tclock() - t_del)
-                    if bspan is not None:
-                        span.merge(bspan)
-                    st.finish_span(span)
-                    # shadow-oracle audit of exactly what was served:
-                    # the matched filter set + the (filter, dests)
-                    # pairs, stamped with the begin generation so churn
-                    # mid-flight skips rather than false-positives
-                    st.capture_audit(
-                        live.topic, tuple(flts), pairs, pending.gen,
-                        span.trace_id,
-                    )
-            if not fut.done():
-                fut.set_result(n)
-        self._batch_done(len(entries))
+                if device_batch and self.breaker_enabled:
+                    if (
+                        self.breaker_deadline_s
+                        and tclock() - t0 > self.breaker_deadline_s
+                    ):
+                        # slow is a fault even when it is not wrong: the
+                        # results serve, the breaker still hears about it
+                        tel.count("breaker_deadline_exceeded_total")
+                        self._device_failure(None)
+                    else:
+                        self._device_success()
+            if fanout_pending is not None:
+                # install the overlapped plans before delivering: stamped
+                # with the clock captured at begin, so a mutation that
+                # landed mid-flight leaves them stale-on-arrival and the
+                # dispatch below rebuilds — exactness over hit ratio
+                t_res = tclock() if bspan is not None else 0.0
+                for fkey, clock, h in fanout_pending:
+                    try:
+                        plan = router.resolve_fanout_finish(h)
+                    except Exception as e:
+                        # the dispatch path rebuilds host-side; counted so
+                        # a dying link can't fail resolves silently
+                        tel.count("fanout_host_fallback_total")
+                        self._device_failure(e)
+                        continue
+                    broker._store_plan(fkey, clock, plan)
+                if bspan is not None:
+                    bspan.add("resolve", tclock() - t_res)
+            fd = router.filter_dests
+            it = iter(filter_lists)
+            for live, fut, span in entries:
+                if live is None:
+                    n = 0  # hook-denied / intercepted: same 0 as publish()
+                else:
+                    flts = next(it)
+                    pairs = [(f, fd(f)) for f in flts]
+                    t_del = tclock() if span is not None else 0.0
+                    try:
+                        n = broker._dispatch(live, pairs)
+                    except Exception as e:
+                        # a delivery-side failure is the publisher's to
+                        # see (host bug, not a device fault) — counted,
+                        # then propagated
+                        tel.count("publish_failures_total")
+                        if not fut.done():
+                            fut.set_exception(e)
+                        continue
+                    if span is not None and st is not None:
+                        span.add("deliver", tclock() - t_del)
+                        if bspan is not None:
+                            span.merge(bspan)
+                        st.finish_span(span)
+                        # shadow-oracle audit of exactly what was served:
+                        # the matched filter set + the (filter, dests)
+                        # pairs, stamped with the begin generation so churn
+                        # mid-flight skips rather than false-positives
+                        st.capture_audit(
+                            live.topic, tuple(flts), pairs, pending.gen,
+                            span.trace_id,
+                        )
+                if not fut.done():
+                    fut.set_result(n)
+            self._batch_done(len(entries))
+        finally:
+            self._gc_resume(gc_tok)
 
     def _batch_done(self, n_pubs: int) -> None:
         self._inflight_pubs -= n_pubs
@@ -888,6 +1022,10 @@ class DispatchEngine:
             with contextlib.suppress(Exception, asyncio.CancelledError):
                 await self._probe_task
             self._probe_task = None
+        if self.gc_guard and self.warmed:
+            # hand the frozen steady state back to the collector —
+            # a stopped engine's broker graph must stay reclaimable
+            gc.unfreeze()
         await asyncio.sleep(0)
 
     def status(self) -> dict:

@@ -522,7 +522,10 @@ class Broker:
         return entry is not None and self._plan_entry_fresh(entry, key)
 
     def _store_plan(self, key: tuple, clock: int, plan) -> None:
-        self._fanout_cache_put(key, self._fanout_cache.get(key), clock, plan)
+        self._fanout_cache_put(
+            key, self._fanout_cache.get(key), clock, plan,
+            self._split_plan(plan),
+        )
 
     def _shared_group_dests(self, pairs: Pairs, key: tuple):
         """(group, real) legs in a match result. Cached per filter-set:
@@ -545,14 +548,16 @@ class Broker:
         self._fanout_cache_put(skey, entry, clock, groups)
         return groups
 
-    def _fanout_cache_put(self, key, entry, clock, value) -> None:
+    def _fanout_cache_put(self, key, entry, clock, value, fast=None) -> None:
         """Insert a clock-stamped plan. A stale entry overwrites in
         place; at capacity ONE oldest-inserted entry evicts (O(1)
-        FIFO) — never a wholesale clear."""
+        FIFO) — never a wholesale clear. Direct-plan entries carry
+        their derived broadcast split as a third element; shared-leg
+        entries stay (clock, value)."""
         cache = self._fanout_cache
         if entry is None and len(cache) >= self._fanout_cap:
             del cache[next(iter(cache))]
-        cache[key] = (clock, value)
+        cache[key] = (clock, value) if fast is None else (clock, value, fast)
 
     def _account_dispatch(self, msg: Message, n: int) -> None:
         if n == 0:
@@ -606,20 +611,61 @@ class Broker:
         emqx_broker.erl:726-760 rather than a per-publish suboption
         scan. Rebuilds above `_fanout_min_fan` run the device
         dedup/max-QoS kernel (ops/fanout.py); host-resident filter sets
-        and small fans take the Python walk."""
+        and small fans take the Python walk. Direct-plan cache entries
+        carry a derived BROADCAST SPLIT (see _split_plan) built once
+        per plan so the per-subscriber hot loop skips every
+        per-delivery option test the plan already answers."""
         tel = self.router.telemetry
         entry = self._fanout_cache.get(key)
         if entry is not None and self._plan_entry_fresh(entry, key):
             if tel.enabled:
                 tel.count("fanout_plan_hits")
-            return self._fanout(msg, entry[1])
+            try:
+                fast = entry[2]
+            except IndexError:
+                # legacy 2-tuple entry (chaos/sentinel tests overwrite
+                # plans in place to inject divergence): derive the
+                # split from the plan actually installed — the served
+                # deliveries must follow the corrupted plan for the
+                # audit to judge it
+                fast = self._split_plan(entry[1])
+            return self._fanout(msg, fast)
         if tel.enabled:
             tel.count("fanout_plan_stale" if entry is not None
                       else "fanout_plan_misses")
         clock = self._fanout_clock
         plan = self._resolve_plan(key, pairs)
-        self._fanout_cache_put(key, entry, clock, plan)
-        return self._fanout(msg, plan)
+        fast = self._split_plan(plan)
+        self._fanout_cache_put(key, entry, clock, plan, fast)
+        return self._fanout(msg, fast)
+
+    @staticmethod
+    def _split_plan(plan: tuple) -> tuple:
+        """(bcast, rest, other): partition a plan's mem entries ONCE at
+        build time into the trivially-broadcastable set — QoS 0 grant,
+        no no_local, no retain-as-published, no QoS upgrade: their
+        delivery is connected-check + shared-buffer write regardless of
+        the message — and the rest, which keep the full per-delivery
+        option walk. Everything that can invalidate the split
+        (subscription/session mutations) already stamps the plan's
+        filters, so the split lives exactly as long as its plan. The
+        plan itself stays the oracle (mem, other) shape — audits and
+        device/host equality checks never see the split."""
+        mem, other = plan
+        bcast = []
+        rest = []
+        for e in mem:
+            opts = e[2]
+            if (
+                opts.qos == 0
+                and not opts.no_local
+                and not opts.retain_as_published
+                and not e[1].cfg.upgrade_qos
+            ):
+                bcast.append(e)
+            else:
+                rest.append(e)
+        return bcast, rest, other
 
     def _fanout_opts_lookup(self, flt: str, dest):
         """The CSR store's live-suboption seam (lazy segment rebuild):
@@ -689,48 +735,66 @@ class Broker:
                 other.append((client, flt, opts))
         return mem, other
 
-    def _fanout(self, msg: Message, plan: tuple) -> int:
-        """Wide-fanout sharding (the 1024 rule): shard 0 delivers
-        inline; later shards are scheduled as separate event-loop turns
-        so a 100k-subscriber topic cannot stall the loop for one long
+    def _fanout(self, msg: Message, fast: tuple) -> int:
+        """Wide-fanout sharding (the 1024 rule) over a split plan
+        (_split_plan's (bcast, rest, other)): shard 0 delivers inline;
+        later shards are scheduled as separate event-loop turns so a
+        100k-subscriber topic cannot stall the loop for one long
         dispatch (the reference parallelizes shards across broker-pool
         workers, emqx_broker.erl:643-672,753-760). Returns deliveries
         INITIATED — deferred shards count at plan time."""
-        mem, other = plan
-        total = len(mem) + len(other)
+        bcast, rest, other = fast
+        total = len(bcast) + len(rest) + len(other)
         pkt_cache: Dict[bool, tuple] = {}  # retain -> (pkt, (pkt,))
         if total <= FANOUT_SHARD:
-            return self._deliver_plan(msg, plan, 0, total, pkt_cache)
+            return self._deliver_plan(msg, fast, 0, total, pkt_cache)
         try:
             loop = asyncio.get_running_loop()
         except RuntimeError:
             loop = None
-        n = self._deliver_plan(msg, plan, 0, FANOUT_SHARD, pkt_cache)
+        n = self._deliver_plan(msg, fast, 0, FANOUT_SHARD, pkt_cache)
         for i in range(FANOUT_SHARD, total, FANOUT_SHARD):
             hi = min(i + FANOUT_SHARD, total)
             if loop is None:
-                n += self._deliver_plan(msg, plan, i, hi, pkt_cache)
+                n += self._deliver_plan(msg, fast, i, hi, pkt_cache)
             else:
                 loop.call_soon(
-                    self._deliver_plan, msg, plan, i, hi, pkt_cache
+                    self._deliver_plan, msg, fast, i, hi, pkt_cache
                 )
                 n += hi - i
         return n
 
+    def _shared_pkt(self, msg: Message, retain: bool, pkt_cache) -> tuple:
+        pkt = Publish(
+            topic=msg.topic,
+            payload=msg.payload,
+            qos=0,
+            retain=retain,
+            packet_id=None,
+            props=dict(msg.props),
+        )
+        pkt._wire = {}  # opt into serialize memoization
+        cached = (pkt, (pkt,))
+        pkt_cache[retain] = cached
+        return cached
+
     def _deliver_plan(
         self,
         msg: Message,
-        plan: tuple,
+        fast: tuple,
         lo: int,
         hi: int,
         pkt_cache: Dict[bool, tuple],
     ) -> int:
-        """Deliver plan slice [lo, hi). The QoS0 fast loop shares ONE
-        Publish packet (and one singleton tuple) per retain flag across
-        every shard of the fanout; its wire form serializes once per
-        protocol version (frame.serialize memoizes on the packet), so
-        the hot loop is: no_local check, connected check, sink write."""
-        mem, other = plan
+        """Deliver split-plan slice [lo, hi). The broadcast leg is THE
+        delivery hot loop at scale (fanout_100k: every delivery is a
+        plain QoS0 subscriber) so it carries nothing per-subscriber
+        but: connected check, sink read, shared-buffer write — the
+        option tests (no_local/QoS/upgrade/retain-as-published) were
+        answered once at plan-split time, and the wire bytes serialize
+        once per protocol version for the WHOLE fanout
+        (frame.serialize memoizes on the shared packet)."""
+        bcast, rest, other = fast
         n = 0
         run_hook = self.hooks.has("message.delivered")
         # per-delivery hookpoints are untimed by contract (obs/
@@ -739,9 +803,56 @@ class Broker:
         hooks_run = self.hooks.run_unobserved
         fr = msg.from_client
         mq = msg.qos
-        m = len(mem)
-        if lo < m:
-            for client, s, opts in mem[lo:min(hi, m)]:
+        nb = len(bcast)
+        if lo < nb:
+            cached = pkt_cache.get(False)
+            if cached is None:
+                cached = self._shared_pkt(msg, False, pkt_cache)
+            pkt_tuple = cached[1]
+            cache_get = pkt_cache.get
+            last_ver = None
+            data = None
+            for client, s, opts in bcast[lo:min(hi, nb)]:
+                if s.connected:
+                    sb = s.outgoing_sink_bytes
+                    if sb is not None:
+                        # bytes fast path: one buffer per proto
+                        # version, written to every socket; version
+                        # runs are contiguous in practice so the
+                        # common case is two attribute reads + a call
+                        ver = s.sink_proto_ver
+                        if ver is not last_ver:
+                            data = cache_get((ver, False))
+                            if data is None:
+                                data = frame.serialize(cached[0], ver)
+                                pkt_cache[(ver, False)] = data
+                            last_ver = ver
+                        if run_hook:
+                            hooks_run("message.delivered", client, msg)
+                        sb(data)
+                        n += 1
+                        continue
+                    if run_hook:
+                        hooks_run("message.delivered", client, msg)
+                    sink = s.outgoing_sink
+                    if sink is not None:
+                        sink(pkt_tuple)
+                    n += 1
+                    continue
+                # disconnected broadcast subscriber: the session's own
+                # deliver decides (offline queue / expiry), same as the
+                # generic leg
+                packets = s.deliver(msg, opts)
+                if run_hook:
+                    hooks_run("message.delivered", client, msg)
+                if packets:
+                    sink = s.outgoing_sink
+                    if sink is not None:
+                        sink(packets)
+                n += 1
+        m = nb + len(rest)
+        if hi > nb and lo < m:
+            for client, s, opts in rest[max(lo - nb, 0):min(hi, m) - nb]:
                 if opts.no_local and fr == client:
                     continue
                 if (
@@ -752,24 +863,11 @@ class Broker:
                     retain = msg.retain if opts.retain_as_published else False
                     cached = pkt_cache.get(retain)
                     if cached is None:
-                        pkt = Publish(
-                            topic=msg.topic,
-                            payload=msg.payload,
-                            qos=0,
-                            retain=retain,
-                            packet_id=None,
-                            props=dict(msg.props),
-                        )
-                        pkt._wire = {}  # opt into serialize memoization
-                        cached = (pkt, (pkt,))
-                        pkt_cache[retain] = cached
+                        cached = self._shared_pkt(msg, retain, pkt_cache)
                     if run_hook:
                         hooks_run("message.delivered", client, msg)
                     sb = s.outgoing_sink_bytes
                     if sb is not None:
-                        # bytes fast path: serialize once per (proto
-                        # version, retain) for the WHOLE fanout, write
-                        # the same buffer to every socket
                         ver = s.sink_proto_ver
                         data = pkt_cache.get((ver, retain))
                         if data is None:

@@ -213,6 +213,27 @@ def broker_schema() -> Struct:
                                     "tpu_pipeline_depth": Field(
                                         Int(min=1), default=2
                                     ),
+                                    # transfer-pipelined dispatch
+                                    # (ops/transfer.py): chunk bound on
+                                    # a ring slot's device->host result
+                                    # buffer, KB — 0 auto-sizes from
+                                    # the link probe at engine warmup
+                                    # (RTT x bandwidth, the BDP);
+                                    # aot_warm pre-traces every kernel
+                                    # shape bucket at warmup so no
+                                    # production dispatch pays an XLA
+                                    # retrace; gc_guard keeps cyclic-
+                                    # collector pauses out of the
+                                    # launch/collect critical sections
+                                    "tpu_transfer_chunk_kb": Field(
+                                        Int(min=0), default=0
+                                    ),
+                                    "tpu_aot_warm": Field(
+                                        Bool(), default=True
+                                    ),
+                                    "tpu_gc_guard": Field(
+                                        Bool(), default=True
+                                    ),
                                     # generation-stamped caches: 0
                                     # disables the topic->pairs match
                                     # cache; the fanout-plan cache cap

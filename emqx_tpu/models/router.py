@@ -44,6 +44,7 @@ from ..ops import hash_index as hash_ops
 from ..ops import match as match_ops
 from ..ops import speedups as _speedups
 from ..ops import topic as topic_mod
+from ..ops import transfer as transfer_ops
 from ..ops.hash_index import ClassIndex, ClassMeta, SlotArrays
 from ..ops.host_index import TopicTrie
 from ..ops.table import (
@@ -149,6 +150,11 @@ class DeviceTable:
         # chaos fault seam (emqx_tpu/chaos/faults.py): one attribute
         # read per sync when absent
         self.fault_injector = None
+        # transfer chunk cap (ops/transfer.chunk_hits): bounds the
+        # compacted-pair result buffers to what the link streams in
+        # one RTT; None = unbounded (the exact-size escalation retry
+        # keeps correctness either way)
+        self.transfer_chunk_hits: Optional[int] = None
 
     def attach_fanout(self, store: fanout_ops.DestStore) -> None:
         """Mirror a CSR destination store on this device — the
@@ -271,6 +277,90 @@ class DeviceTable:
         assert self._dev is not None, "sync() before matching"
         return self._dev
 
+    # --- unified batched-match surface -------------------------------
+    # The SAME begin/finish contract ShardedDeviceTable exposes, so the
+    # Router pipelines one code path over both table kinds instead of
+    # maintaining parallel single-device/mesh implementations (the
+    # SNIPPETS one-mesh-context shape). Every begin LAUNCHES its
+    # kernel and immediately starts the device->host copy of the
+    # compacted result buffers (ops/transfer.FetchTicket), so batch
+    # N's transfer rides under batch N+1's encode+launch; the finish
+    # half pays only the residual wait. Handles carry the ticket as
+    # their LAST element (the engine's readiness probe relies on it).
+
+    def _cap_hits(self, mh: int) -> int:
+        cap = self.transfer_chunk_hits
+        if cap is not None and mh > cap >= 1024:
+            # floor-pow2 of the chunk budget: shapes stay log-bounded
+            mh = 1 << (cap.bit_length() - 1)
+        return mh
+
+    def match_hash_begin(self, enc: match_ops.EncodedTopics):
+        """Launch the pattern-class hash kernel + begin the result
+        transfer; no host fetch is forced. Returns an opaque handle
+        for match_hash_finish (ticket last)."""
+        meta, slots = self.hash_state()
+        b = int(enc.ids.shape[0])
+        mh = self._cap_hits(max(1024, _next_pow2(2 * b)))
+        shape = (b, int(meta.plen.shape[0]), int(slots.fp.shape[0]))
+        self.telemetry.record_shape("match_ids_hash", shape + (mh,))
+        dev = hash_ops.match_ids_hash(meta, slots, enc, max_hits=mh)
+        return (enc, mh, shape, transfer_ops.start_fetch(dev, self.telemetry))
+
+    def match_hash_finish(self, pending):
+        """Force a begun hash match, escalating once on compaction
+        overflow. Returns (ti, bi, amb): candidate arrays sliced to
+        the true hit count — entries with bi < 0 (phase-2 rejects) or
+        ti beyond the live batch (pow2 padding) are the caller's to
+        skip, same contract as the sharded finish."""
+        enc, mh, shape, ticket = pending
+        ti, bi, total, amb = ticket.wait()
+        total = int(total)
+        if total > mh:
+            tel = self.telemetry
+            tel.count("hash_overflow_retries_total")
+            mh = _next_pow2(total)
+            tel.record_shape("match_ids_hash", shape + (mh,))
+            meta, slots = self.hash_state()
+            ti, bi, _t, amb = transfer_ops.start_fetch(
+                hash_ops.match_ids_hash(meta, slots, enc, max_hits=mh),
+                self.telemetry,
+            ).wait()
+        return np.asarray(ti)[:total], np.asarray(bi)[:total], int(amb)
+
+    def match_ids_begin(self, enc: match_ops.EncodedTopics, residual: bool = False):
+        """Launch the dense compaction kernel (full table, or the
+        residual unclassed rows) + begin the result transfer. Same
+        handle contract as match_hash_begin."""
+        filters = self.residual_filters() if residual else self.filters()
+        b = int(enc.ids.shape[0])
+        if residual:
+            mh = self._cap_hits(max(1024, _next_pow2(2 * b)))
+        else:
+            mh = self._cap_hits(max(4096, _next_pow2(4 * b)))
+        shape = (b, int(filters.words.shape[0]))
+        self.telemetry.record_shape("match_ids", shape + (mh,))
+        dev = match_ops.match_ids(filters, enc, max_hits=mh)
+        return (enc, filters, mh, shape, transfer_ops.start_fetch(dev, self.telemetry))
+
+    def match_ids_finish(self, pending):
+        """Force a begun dense match, escalating once on overflow.
+        Returns (ti, ri) valid-pair arrays — ti may include pow2
+        batch-padding topic indices the caller drops."""
+        enc, filters, mh, shape, ticket = pending
+        ti, ri, total = ticket.wait()
+        total = int(total)
+        if total > mh:
+            tel = self.telemetry
+            tel.count("escalations_total")
+            mh = _next_pow2(total)
+            tel.record_shape("match_ids", shape + (mh,))
+            ti, ri, _t = transfer_ops.start_fetch(
+                match_ops.match_ids(filters, enc, max_hits=mh),
+                self.telemetry,
+            ).wait()
+        return np.asarray(ti)[:total], np.asarray(ri)[:total]
+
 
 class _PendingMatch:
     """An in-flight batched match: kernels LAUNCHED, results not yet
@@ -282,26 +372,24 @@ class _PendingMatch:
 
     __slots__ = (
         "topics",       # the sub-batch actually sent to the kernels
-        "enc",          # EncodedTopics of `topics` (escalation retries)
+        "enc",          # EncodedTopics of `topics` (pow2-padded)
         "out",          # per-sub-topic result lists (exact-deep prefilled)
         "root",         # telemetry root span (or None)
-        "mode",         # cached | hash | mesh_hash | mesh_dense | dense
+        "mode",         # cached | host | hash | dense
         "gen",          # router generation captured before the kernels
         "full_out",     # full-batch skeleton when the match cache fronted it
         "sub_idx",      # index of each sub-topic within the original batch
         "span",         # sentinel StageSpan (or None): per-stage publish
                         # latency attribution for sampled batches
-        "hash_dev",     # (ti, bi, total, amb) device arrays (1-dev hash)
-        "hash_mh",      # max_hits the hash kernel launched with
-        "hash_shape",   # shape key sans max_hits (escalated re-dispatch)
-        "hash_elapsed",  # host seconds spent launching the hash leg
-        "mesh_pending",  # ShardedDeviceTable begin handle
-        "residual_pending",  # launched residual-dense leg (1-dev or mesh)
-        "dense_dev",    # (ti, ri, total) device arrays (no-index dense)
-        "dense_mh",
-        "dense_shape",
+        # begin handles from the unified device-table surface (single
+        # device and mesh alike); each carries its FetchTicket as the
+        # last element, so readiness is a handle[-1].ready() probe
+        "hash_pending",      # match_hash_begin handle
+        "hash_elapsed",      # host seconds spent launching the hash leg
+        "residual_pending",  # match_ids_begin(residual=True) handle
+        "residual_elapsed",
+        "dense_pending",     # match_ids_begin handle (no-index path)
         "dense_elapsed",
-        "dense_filters",  # EncodedFilters view (escalation re-dispatch)
     )
 
     def __init__(self) -> None:
@@ -1485,8 +1573,15 @@ class Router:
             self._maybe_unquarantine()
         sp = tel.span("xla.encode", root)
         t0 = clock()
+        # the batch axis pads to the next pow2 with inert topics (zero
+        # levels, $-rooted: match NOTHING by the length + $-root rules)
+        # so the jit shape space stays log-bounded — arbitrary coalesce
+        # sizes were a fresh XLA trace per size, the 400ms-class p99
+        # outlier the AOT warmup + this padding eliminate together.
+        # finish drops ti >= len(sub), the same guard as dp padding.
         p.enc = enc = match_ops.encode_topics(
-            self.table.vocab, sub, self.max_levels
+            self.table.vocab, sub, self.max_levels,
+            pad_to=_next_pow2(len(sub)),
         )
         enc_dt = clock() - t0
         tel.record_dispatch(LEG_ENCODE, enc_dt)
@@ -1499,68 +1594,31 @@ class Router:
             p.out = [[t] if t in self._exact_deep else [] for t in sub]
         else:
             p.out = [[] for _ in sub]
+        # ONE launch path for both table kinds: DeviceTable and
+        # ShardedDeviceTable expose the same match_{hash,ids}_begin/
+        # finish halves (each begin also starts its result transfer)
         ix = self.index
-        if self.mesh is not None and ix is None:
-            # dense-only mesh path (use_hash_index=False)
-            p.mode = "mesh_dense"
-            t0 = clock()
-            p.mesh_pending = self.device_table.match_ids_begin(enc)
-            if span is not None:
-                span.add("kernel", clock() - t0)
-            return p
         if ix is not None:
             p.mode = "hash"
             if len(ix):
                 t0 = clock()
-                if self.mesh is not None:
-                    p.mode = "mesh_hash"
-                    p.mesh_pending = self.device_table.match_hash_begin(enc)
-                else:
-                    meta, slots = self.device_table.hash_state()
-                    mh = max(1024, _next_pow2(2 * len(sub)))
-                    shape = (
-                        len(sub), meta.plen.shape[0], slots.fp.shape[0],
-                    )
-                    tel.record_shape("match_ids_hash", shape + (mh,))
-                    p.hash_dev = hash_ops.match_ids_hash(
-                        meta, slots, enc, max_hits=mh
-                    )
-                    p.hash_mh = mh
-                    p.hash_shape = shape
+                p.hash_pending = self.device_table.match_hash_begin(enc)
                 p.hash_elapsed = clock() - t0
             if ix.residual_rows:
                 # launch the residual-dense leg NOW so it overlaps the
                 # hash fetch; the (~never) amb host-fallback in finish
                 # simply discards it
                 t0 = clock()
-                if self.mesh is not None:
-                    p.residual_pending = (
-                        "mesh",
-                        self.device_table.match_ids_begin(enc, residual=True),
-                        clock() - t0,
-                    )
-                else:
-                    filters = self.device_table.residual_filters()
-                    mh = max(1024, _next_pow2(2 * len(sub)))
-                    shape = (len(sub), int(filters.words.shape[0]))
-                    tel.record_shape("match_ids", shape + (mh,))
-                    dev = match_ops.match_ids(filters, enc, max_hits=mh)
-                    p.residual_pending = (
-                        "single", dev, mh, shape, filters, clock() - t0,
-                    )
+                p.residual_pending = self.device_table.match_ids_begin(
+                    enc, residual=True
+                )
+                p.residual_elapsed = clock() - t0
             if span is not None and p.hash_elapsed is not None:
                 span.add("kernel", p.hash_elapsed)
             return p
         p.mode = "dense"
-        filters = self.device_table.filters()
-        mh = max(4096, _next_pow2(4 * len(sub)))
-        shape = (len(sub), int(filters.words.shape[0]))
-        tel.record_shape("match_ids", shape + (mh,))
         t0 = clock()
-        p.dense_dev = match_ops.match_ids(filters, enc, max_hits=mh)
-        p.dense_mh = mh
-        p.dense_shape = shape
-        p.dense_filters = filters
+        p.dense_pending = self.device_table.match_ids_begin(enc)
         p.dense_elapsed = clock() - t0
         if span is not None:
             span.add("kernel", p.dense_elapsed)
@@ -1590,45 +1648,16 @@ class Router:
             fi = self.fault_injector
             if fi is not None:
                 fi.check("match_finish")
-        if p.mode == "mesh_dense":
-            root = p.root
-            sp = tel.span("xla.dispatch", root)
-            t0 = clock()
-            ti, ri = self.device_table.match_ids_finish(p.mesh_pending)
-            tel.record_dispatch(LEG_DENSE, clock() - t0)
-            tel.end_span(sp)
-            b = len(topics)
-            for t_idx, row in zip(ti, ri):
-                if t_idx < b:  # drop dp-padding rows
-                    out[int(t_idx)].append(self._row_filter[int(row)])
-        elif p.mode in ("hash", "mesh_hash"):
+        if p.mode == "hash":
             root = p.root
             ix = self.index
             host_fallback = False
-            if p.hash_dev is not None or p.mesh_pending is not None:
+            if p.hash_pending is not None:
                 sp = tel.span("xla.dispatch", root)
                 t0 = clock()
-                if p.mode == "mesh_hash":
-                    ti, bi, amb = self.device_table.match_hash_finish(
-                        p.mesh_pending
-                    )
-                else:
-                    ti, bi, total, amb = p.hash_dev
-                    total = int(total)
-                    mh = p.hash_mh
-                    if total > mh:
-                        tel.count("hash_overflow_retries_total")
-                        mh = _next_pow2(total)
-                        tel.record_shape(
-                            "match_ids_hash", p.hash_shape + (mh,)
-                        )
-                        meta, slots = self.device_table.hash_state()
-                        ti, bi, _t, amb = hash_ops.match_ids_hash(
-                            meta, slots, p.enc, max_hits=mh
-                        )
-                    ti = np.asarray(ti)[:total]
-                    bi = np.asarray(bi)[:total]
-                    amb = int(amb)
+                ti, bi, amb = self.device_table.match_hash_finish(
+                    p.hash_pending
+                )
                 tel.record_dispatch(
                     LEG_HASH, p.hash_elapsed + clock() - t0
                 )
@@ -1674,50 +1703,26 @@ class Router:
             elif p.residual_pending is not None:
                 sp = tel.span("xla.dispatch", root)
                 t0 = clock()
-                if p.residual_pending[0] == "mesh":
-                    _tag, handle, elapsed = p.residual_pending
-                    ti, ri = self.device_table.match_ids_finish(handle)
-                    for t_idx, row in zip(ti, ri):
-                        if t_idx < len(topics):
-                            out[int(t_idx)].append(
-                                self._row_filter[int(row)]
-                            )
-                else:
-                    _tag, dev, mh, shape, filters, elapsed = (
-                        p.residual_pending
-                    )
-                    ti, ri, total = dev
-                    total = int(total)
-                    if total > mh:
-                        tel.count("escalations_total")
-                        mh2 = _next_pow2(total)
-                        tel.record_shape("match_ids", shape + (mh2,))
-                        ti, ri, _t = match_ops.match_ids(
-                            filters, p.enc, max_hits=mh2
-                        )
-                    ti = np.asarray(ti)
-                    ri = np.asarray(ri)
-                    for t_idx, row in zip(ti[:total], ri[:total]):
+                ti, ri = self.device_table.match_ids_finish(
+                    p.residual_pending
+                )
+                b = len(topics)
+                for t_idx, row in zip(ti, ri):
+                    if t_idx < b:  # drop pow2/dp padding rows
                         out[int(t_idx)].append(self._row_filter[int(row)])
-                tel.record_dispatch(LEG_DENSE, elapsed + clock() - t0)
+                tel.record_dispatch(
+                    LEG_DENSE, p.residual_elapsed + clock() - t0
+                )
                 tel.end_span(sp)
         elif p.mode == "dense":
             root = p.root
             sp = tel.span("xla.dispatch", root)
             t0 = clock()
-            ti, ri, total = p.dense_dev
-            total = int(total)
-            if total > p.dense_mh:
-                tel.count("escalations_total")
-                mh2 = _next_pow2(total)
-                tel.record_shape("match_ids", p.dense_shape + (mh2,))
-                ti, ri, _t = match_ops.match_ids(
-                    p.dense_filters, p.enc, max_hits=mh2
-                )
-            ti = np.asarray(ti)
-            ri = np.asarray(ri)
-            for t_idx, row in zip(ti[:total], ri[:total]):
-                out[int(t_idx)].append(self._row_filter[int(row)])
+            ti, ri = self.device_table.match_ids_finish(p.dense_pending)
+            b = len(topics)
+            for t_idx, row in zip(ti, ri):
+                if t_idx < b:  # drop pow2/dp padding rows
+                    out[int(t_idx)].append(self._row_filter[int(row)])
             tel.record_dispatch(LEG_DENSE, p.dense_elapsed + clock() - t0)
             tel.end_span(sp)
         if p.mode not in ("cached", "host"):
@@ -1730,9 +1735,18 @@ class Router:
                 self._quarantine_overlay(topics, out)
             tel.end_span(p.root)
         if span is not None:
-            # fetch = everything finish forces: device->host transfer,
-            # overflow escalation, verify/unpack, deep-trie fold
-            span.add("fetch", clock() - t_fetch)
+            # transfer = residual device->host wait the tickets
+            # actually blocked for (zero when the eager copies landed
+            # under the next batch's launch); fetch = everything else
+            # finish forces: overflow escalation, verify/unpack,
+            # deep-trie fold
+            waited = 0.0
+            for h in (p.hash_pending, p.residual_pending, p.dense_pending):
+                if h is not None:
+                    waited += h[-1].waited
+            if waited:
+                span.add("transfer", waited)
+            span.add("fetch", clock() - t_fetch - waited)
         if p.full_out is None:
             return out if out is not None else []
         # merge the kernel results into the cached prefix and stamp the
@@ -1751,6 +1765,61 @@ class Router:
             if ev and tel.enabled:
                 tel.count("match_cache_evictions", ev)
         return full
+
+    def match_finish_ready(self, p: "_PendingMatch") -> bool:
+        """True when finishing `p` will not block on a device->host
+        transfer: every begun leg's FetchTicket has landed host-side.
+        The dispatch engine's ring uses this to collect slots in
+        completion order without stalling the event loop; cached and
+        host-mode batches are always ready."""
+        for h in (p.hash_pending, p.residual_pending, p.dense_pending):
+            if h is not None and not h[-1].ready():
+                return False
+        return True
+
+    def set_transfer_chunk(self, chunk_kb: float) -> None:
+        """Bound per-dispatch compacted-result buffers to a transfer
+        chunk (KB) sized to the link (ops/transfer.chunk_hits); 0
+        lifts the bound. Applies to both table kinds."""
+        self.device_table.transfer_chunk_hits = transfer_ops.chunk_hits(
+            chunk_kb
+        )
+
+    def warmup_shapes(self, max_batch: int = 64) -> int:
+        """AOT-warm every kernel shape bucket a production dispatch
+        can hit: run the REAL begin/finish halves over all-padding
+        batches (zero live topics — inert by the length + $-root
+        rules) for each pow2 batch size up to `max_batch`. Combined
+        with the pow2 batch padding in match_filters_begin this makes
+        the serve-time shape space exactly the warmed set, so no
+        production publish ever pays an XLA retrace (the 400ms-class
+        launch outliers in PERF_NOTES r6's decomposition). Returns
+        shape buckets warmed; counted as `aot_warmups_total`."""
+        if self.device_suspended:
+            return 0
+        dt = self.device_table
+        dt.sync()
+        warmed = 0
+        b = 1
+        cap = _next_pow2(max(1, max_batch))
+        ix = self.index
+        while b <= cap:
+            enc = match_ops.encode_topics(
+                self.table.vocab, (), self.max_levels, pad_to=b
+            )
+            if ix is not None:
+                if len(ix):
+                    dt.match_hash_finish(dt.match_hash_begin(enc))
+                if ix.residual_rows:
+                    dt.match_ids_finish(dt.match_ids_begin(enc, residual=True))
+            else:
+                dt.match_ids_finish(dt.match_ids_begin(enc))
+            warmed += 1
+            b *= 2
+        tel = self.telemetry
+        if tel.enabled and warmed:
+            tel.count("aot_warmups_total", warmed)
+        return warmed
 
     def match_filters_batch(self, topics: Sequence[str]) -> List[List[str]]:
         """Batched device path: ONE XLA dispatch for all wildcard

@@ -211,6 +211,13 @@ class KernelTelemetry:
         self.gauges: Dict[str, float] = {}
         self._shape_keys: Dict[str, Set[tuple]] = {}
         self._trace_seq = 0
+        # serve-time retrace accounting: False during AOT warmup (the
+        # engine pre-traces every shape bucket at attach), True once
+        # mark_serving() flips it — a fresh shape key after that is a
+        # compile stall a production publisher PAID for, the exact
+        # outlier class the e2e p99 gate bans (counted as
+        # `recompiles_at_serve_total`, gated at 0 over the bench run)
+        self.serving = False
 
     # --- dispatch histograms ---------------------------------------------
 
@@ -269,6 +276,11 @@ class KernelTelemetry:
     def set_gauge(self, name: str, value: float) -> None:
         self.gauges[name] = value
 
+    def add_gauge(self, name: str, delta: float) -> None:
+        """Relative gauge move (e.g. transfer_inflight up at launch,
+        down at collect) — one dict probe + add, hot-path safe."""
+        self.gauges[name] = self.gauges.get(name, 0) + delta
+
     # --- recompile / shape-bucket tracking --------------------------------
 
     def record_shape(self, kernel: str, key: tuple) -> bool:
@@ -284,6 +296,8 @@ class KernelTelemetry:
             return False
         seen.add(key)
         self.count("recompiles_total")
+        if self.serving:
+            self.count("recompiles_at_serve_total")
         fr = self.flight
         if fr is not None:
             fr.record(
@@ -301,6 +315,14 @@ class KernelTelemetry:
 
     def shape_buckets(self) -> Dict[str, int]:
         return {k: len(v) for k, v in self._shape_keys.items()}
+
+    def mark_serving(self) -> None:
+        """Close the AOT-warmup window: every shape bucket traced from
+        here on is a serve-time compile stall. The counter is seeded
+        at 0 so the family renders on the scrape (and the bench gate
+        can assert it) even over a perfectly clean run."""
+        self.serving = True
+        self.counters.setdefault("recompiles_at_serve_total", 0)
 
     # --- device-table state ----------------------------------------------
 

@@ -60,8 +60,14 @@ from .kernel_telemetry import StreamingHistogram, render_histogram_lines
 log = logging.getLogger("emqx_tpu.obs.sentinel")
 
 # pipeline stages in pipeline order — the label values of
-# emqx_xla_publish_stage_seconds
-STAGES = ("queue", "encode", "kernel", "fetch", "resolve", "deliver")
+# emqx_xla_publish_stage_seconds. `transfer` is the residual
+# device->host wait the finish half actually blocked for (the eager
+# copy_to_host_async overlap makes it ~zero on a healthy ring);
+# `fetch` is the rest of what finish forces (escalation, verify/
+# unpack, deep-trie fold).
+STAGES = (
+    "queue", "encode", "kernel", "transfer", "fetch", "resolve", "deliver"
+)
 
 ALARM_DIVERGENCE = "xla_audit_divergence"
 

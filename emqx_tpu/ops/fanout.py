@@ -764,15 +764,21 @@ class FanoutDeviceState:
             n_clients=nc,
             max_fan=max_fan,
         )
-        return (dev, fan, tel.clock() - t0)
+        # begin the device->host copy of the winner edges NOW — the
+        # plan transfer rides under whatever the pipeline launches
+        # next (the match hash fetch, the next batch's encode), the
+        # same ticket discipline as the match begin halves
+        from . import transfer as transfer_ops
+
+        return (transfer_ops.start_fetch(dev, tel), fan, tel.clock() - t0)
 
     def resolve_finish(self, handle) -> Tuple[np.ndarray, int]:
         """Force the transfer for a begun resolve. Returns (winner edge
         ids in plan order, gathered fan)."""
-        (out, _n, total), fan, elapsed = handle
+        ticket, fan, elapsed = handle
         tel = self.telemetry
         t0 = tel.clock()
-        o = np.asarray(out)
-        win = o[o >= 0]
+        out, _n, total = ticket.wait()
+        win = out[out >= 0]
         tel.observe_family("fanout_resolve_seconds", elapsed + tel.clock() - t0)
         return win, int(total)

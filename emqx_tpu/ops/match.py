@@ -52,16 +52,29 @@ class EncodedTopics(NamedTuple):
 
 
 def encode_topics(
-    vocab: Vocab, topics: Sequence[str], max_levels: int
+    vocab: Vocab,
+    topics: Sequence[str],
+    max_levels: int,
+    pad_to: int = 0,
 ) -> EncodedTopics:
     """Encode topic names for the kernel. Topics deeper than max_levels
     are still matched correctly against any representable filter: only
     the first `plen <= max_levels` levels are ever compared, and the
-    true length is kept for the exact/'#' length checks."""
-    b = len(topics)
+    true length is kept for the exact/'#' length checks.
+
+    `pad_to` (when > len(topics)) grows the batch axis with INERT
+    rows — zero levels, $-rooted — that match no representable filter
+    (a 0-level topic only satisfies the length rule against a bare
+    '#', which the $-root rule then rejects). Kernel shapes stay
+    pow2-bounded instead of retracing per coalesce size; callers drop
+    result rows with topic index >= len(topics), the same guard as
+    mesh dp padding."""
+    b = max(len(topics), pad_to)
     ids = np.zeros((b, max_levels), np.int32)
     lens = np.zeros(b, np.int32)
     dollar = np.zeros(b, bool)
+    if pad_to > len(topics):
+        dollar[len(topics):] = True
     lk = vocab.lookup
     for i, t in enumerate(topics):
         ws = t.split("/")

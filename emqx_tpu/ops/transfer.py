@@ -1,0 +1,167 @@
+"""Device→host transfer discipline — the one implementation of the
+pipeline's fetch leg.
+
+PERF_NOTES r6's stage decomposition localized the 18x e2e-over-link
+multiplier ENTIRELY in the launch-side stage (kernel p99 412ms against
+a p50 of 0.02ms) while the fetch leg sat flat at ~0.2ms: the transfer
+itself was never the problem, but it was *serialized* — every
+`match_filters_finish` forced its device→host copy synchronously with
+`np.asarray`, so batch N's transfer could not ride under batch N+1's
+encode+launch. This module makes the transfer a first-class pipeline
+stage, shared by every finish half in the tree (single-device hash /
+dense legs, the sharded mesh legs, and the fanout resolve):
+
+  * `FetchTicket` — issued at LAUNCH time (`begin` halves): calls
+    `copy_to_host_async()` on each result buffer the moment the kernel
+    is enqueued, so the device→host DMA is already in flight while the
+    host runs the next batch's encode. `wait()` (the `finish` halves)
+    then pays only the *residual* transfer time, and `ready()` lets
+    the dispatch engine collect ring slots without ever blocking the
+    event loop on a transfer that has not landed.
+
+  * link probe + chunk auto-sizing — `probe_link()` measures the
+    dispatch RTT floor and the device→host fetch bandwidth with the
+    same trivial-kernel discipline bench.py uses; `auto_chunk_kb()`
+    turns them into a bandwidth-delay-product transfer chunk
+    (`broker.perf.tpu_transfer_chunk_kb`, 0 = auto), which bounds the
+    per-dispatch compacted-pair buffer (`chunk_hits`) so one fetch is
+    never sized past what the link can stream in one RTT — oversize
+    results escalate through the existing exact-size retry, so the
+    bound costs a (counted) re-dispatch, never correctness.
+
+Telemetry (always-on through the router's collector):
+`emqx_xla_transfer_seconds` (histogram family: wait time actually
+paid at finish), `emqx_xla_transfer_bytes` (counter: bytes moved
+device→host), `emqx_xla_transfer_inflight` (gauge: tickets issued but
+not yet collected).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..obs.kernel_telemetry import NULL as _NULL_TEL
+
+# chunk clamp (KB): the auto-sizer never goes below one sync batch of
+# compacted pairs nor above what a single ring slot should pin in
+# host memory
+MIN_CHUNK_KB = 64
+MAX_CHUNK_KB = 4096
+
+# bytes per compacted hit: two int32 result lanes (topic idx, row/bkt)
+_BYTES_PER_HIT = 8
+
+
+class FetchTicket:
+    """One begun device→host fetch: the async copies are issued at
+    construction (launch time), `wait()` forces + returns the host
+    arrays exactly once. Arrays without `copy_to_host_async` (numpy
+    passthroughs on the host fallback paths) degrade to a plain
+    `np.asarray` at wait — same contract, zero overlap."""
+
+    __slots__ = ("arrays", "nbytes", "telemetry", "waited", "_out")
+
+    def __init__(self, arrays: Sequence, telemetry=None) -> None:
+        tel = telemetry if telemetry is not None else _NULL_TEL
+        self.arrays = tuple(arrays)
+        self.telemetry = tel
+        # residual wall seconds the wait() actually blocked — the
+        # sentinel's `transfer` stage attribution reads it post-finish
+        self.waited = 0.0
+        self._out: Optional[Tuple[np.ndarray, ...]] = None
+        nb = 0
+        for a in self.arrays:
+            start = getattr(a, "copy_to_host_async", None)
+            if start is not None:
+                start()
+            nb += int(getattr(a, "nbytes", 0) or 0)
+        self.nbytes = nb
+        if tel.enabled:
+            tel.count("transfer_bytes", nb)
+            tel.add_gauge("transfer_inflight", 1)
+
+    def ready(self) -> bool:
+        """True when every buffer has landed host-side (wait() will
+        not block). Arrays without is_ready() report ready — they are
+        host values already."""
+        if self._out is not None:
+            return True
+        for a in self.arrays:
+            is_ready = getattr(a, "is_ready", None)
+            if is_ready is not None and not is_ready():
+                return False
+        return True
+
+    def wait(self) -> Tuple[np.ndarray, ...]:
+        """Force the transfer (idempotent). The observed duration is
+        the RESIDUAL wait — with healthy overlap it approaches zero;
+        a fat sample here means the ring is under-depth or the chunk
+        outsizes the link."""
+        out = self._out
+        if out is not None:
+            return out
+        tel = self.telemetry
+        t0 = tel.clock()
+        out = self._out = tuple(np.asarray(a) for a in self.arrays)
+        self.waited = tel.clock() - t0
+        if tel.enabled:
+            tel.observe_family("transfer_seconds", self.waited)
+            tel.add_gauge("transfer_inflight", -1)
+        return out
+
+
+def start_fetch(arrays: Sequence, telemetry=None) -> FetchTicket:
+    """Begin-half entry: enqueue the device→host copies for a just-
+    launched kernel's result buffers and hand back the ticket the
+    finish half waits on."""
+    return FetchTicket(arrays, telemetry)
+
+
+def probe_link(device=None, probes: int = 3) -> Tuple[float, float]:
+    """(rtt_floor_s, fetch_bytes_per_s), measured right now with the
+    bench's trivial-dispatch discipline: the RTT floor is the median
+    of `probes` add-one round trips; bandwidth is a 1MB device buffer
+    fetched to host. Both drift over a run — callers sample at attach
+    time for sizing, never for scoring."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def triv(x):
+        return x + 1
+
+    float(triv(jnp.float32(0)))  # compile outside the probe
+    rtts = []
+    for i in range(max(1, probes)):
+        t0 = time.perf_counter()
+        float(triv(jnp.float32(i + 0.5)))
+        rtts.append(time.perf_counter() - t0)
+    rtt = float(np.median(rtts))
+    buf = jnp.zeros(1 << 18, jnp.int32)  # 1MB
+    if device is not None:
+        buf = jax.device_put(np.zeros(1 << 18, np.int32), device)
+    buf.block_until_ready()
+    t0 = time.perf_counter()
+    np.asarray(buf + 1)
+    dt = max(time.perf_counter() - t0, 1e-9)
+    return rtt, float(buf.nbytes) / dt
+
+
+def auto_chunk_kb(rtt_s: float, bytes_per_s: float) -> int:
+    """Bandwidth-delay product, clamped: the largest transfer that
+    still fits inside one link RTT, so a ring slot's fetch completes
+    under the NEXT slot's launch instead of stacking behind it."""
+    bdp = rtt_s * bytes_per_s
+    return int(min(MAX_CHUNK_KB, max(MIN_CHUNK_KB, bdp / 1024.0)))
+
+
+def chunk_hits(chunk_kb: float) -> Optional[int]:
+    """Translate a chunk budget into a max_hits cap for the compacted
+    (topic, row) result buffers (two int32 lanes per hit). None / 0
+    means uncapped."""
+    if not chunk_kb:
+        return None
+    return max(1024, int(chunk_kb * 1024) // _BYTES_PER_HIT)

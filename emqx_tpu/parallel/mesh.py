@@ -93,10 +93,12 @@ def put_topics(enc: EncodedTopics, mesh: Mesh) -> EncodedTopics:
     b = enc.ids.shape[0]
     pad = (-b) % n_dp
     if pad:
+        # dollar=True pad rows are inert (match nothing): they must
+        # not burn per-block hit slots against '#'-class filters
         enc = EncodedTopics(
             np.pad(enc.ids, ((0, pad), (0, 0))),
             np.pad(enc.lens, (0, pad)),
-            np.pad(enc.dollar, (0, pad)),
+            np.pad(enc.dollar, (0, pad), constant_values=True),
         )
     shs = topic_sharding(mesh)
     return EncodedTopics(*(jax.device_put(a, s) for a, s in zip(enc, shs)))

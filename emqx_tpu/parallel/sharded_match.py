@@ -436,6 +436,9 @@ class ShardedDeviceTable:
         # chaos fault seam (emqx_tpu/chaos/faults.py) — same contract
         # as the single-device DeviceTable: one attribute read per sync
         self.fault_injector = None
+        # transfer chunk cap (ops/transfer.chunk_hits) — same contract
+        # as DeviceTable.transfer_chunk_hits
+        self.transfer_chunk_hits = None
 
     def attach_fanout(self, store) -> None:
         """Mirror a CSR destination store on the mesh (replicated: the
@@ -577,19 +580,43 @@ class ShardedDeviceTable:
             self._sync_index()
         return total, False
 
+    def _block_mh(self) -> int:
+        """Per-block hit capacity, bounded by the transfer chunk when
+        one is set (ops/transfer.chunk_hits semantics — oversize
+        results escalate through the exact-size retry, so the bound
+        costs a counted re-dispatch, never correctness)."""
+        mh = self.default_mh
+        cap = self.transfer_chunk_hits
+        if cap is not None and mh > cap >= 1024:
+            mh = 1 << (cap.bit_length() - 1)
+        return mh
+
     def match_ids_begin(self, enc: EncodedTopics, residual: bool = False):
         """Launch the sharded dense compaction kernel WITHOUT forcing
-        any device->host transfer: the pipelined publish path overlaps
-        this batch's mesh execution with the next batch's host-side
-        encode. Returns an opaque handle for match_ids_finish."""
+        any device->host transfer AND begin the result copy
+        (ops/transfer.FetchTicket, handle's last element — the same
+        begin contract as the single-device DeviceTable): the
+        pipelined publish path overlaps this batch's mesh execution +
+        device->host transfer with the next batch's host-side encode.
+        Returns an opaque handle for match_ids_finish."""
         assert self._dev is not None, "sync() before matching"
         dev = self._dev
         if residual:
             assert self._dev_residual is not None
             dev = dev._replace(active=self._dev_residual)
         t_dev = self._mesh_mod.put_topics(enc, self.mesh)
-        mh = self.default_mh
-        return (dev, t_dev, mh, self._match_kernel(mh)(dev, t_dev))
+        mh = self._block_mh()
+        self.telemetry.record_shape(
+            "mesh_match_ids", (int(t_dev.ids.shape[0]), mh)
+        )
+        from ..ops import transfer as transfer_ops
+
+        return (
+            dev, t_dev, mh,
+            transfer_ops.start_fetch(
+                self._match_kernel(mh)(dev, t_dev), self.telemetry
+            ),
+        )
 
     def match_ids_finish(self, pending):
         """Force the transfers for a begun dense match, escalating
@@ -597,9 +624,11 @@ class ShardedDeviceTable:
         arrays of equal length (valid pairs only)."""
         import numpy as np
 
-        dev, t_dev, mh, (ti, ri, totals) = pending
+        dev, t_dev, mh, ticket = pending
+        ti, ri, totals = ticket.wait()
         totals = np.asarray(totals)
         while int(totals.max(initial=0)) > mh:
+            self.telemetry.count("escalations_total")
             mh = max(mh * 2, 1 << int(totals.max()).bit_length())
             ti, ri, totals = self._match_kernel(mh)(dev, t_dev)
             totals = np.asarray(totals)
@@ -619,14 +648,23 @@ class ShardedDeviceTable:
 
     def match_hash_begin(self, enc: EncodedTopics):
         """Launch the mesh-sharded production hash kernel without a
-        host fetch (the pipelined counterpart of match_hash). Returns
-        an opaque handle for match_hash_finish."""
+        host fetch AND begin the result transfer (ticket last, same
+        contract as DeviceTable.match_hash_begin). Returns an opaque
+        handle for match_hash_finish."""
         assert self._dev_slots is not None, "sync() before matching"
         t_dev = self._mesh_mod.put_topics(enc, self.mesh)
-        mh = self.default_mh
+        mh = self._block_mh()
+        self.telemetry.record_shape(
+            "mesh_match_ids_hash", (int(t_dev.ids.shape[0]), mh)
+        )
+        from ..ops import transfer as transfer_ops
+
         return (
             t_dev, mh,
-            self._hash_kernel(mh)(self._dev_meta, self._dev_slots, t_dev),
+            transfer_ops.start_fetch(
+                self._hash_kernel(mh)(self._dev_meta, self._dev_slots, t_dev),
+                self.telemetry,
+            ),
         )
 
     def match_hash_finish(self, pending):
@@ -635,9 +673,11 @@ class ShardedDeviceTable:
         match_hash."""
         import numpy as np
 
-        t_dev, mh, (ti, bi, totals, amb) = pending
+        t_dev, mh, ticket = pending
+        ti, bi, totals, amb = ticket.wait()
         totals = np.asarray(totals)
         while int(totals.max(initial=0)) > mh:
+            self.telemetry.count("hash_overflow_retries_total")
             mh = max(mh * 2, 1 << int(totals.max()).bit_length())
             ti, bi, totals, amb = self._hash_kernel(mh)(
                 self._dev_meta, self._dev_slots, t_dev

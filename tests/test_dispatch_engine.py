@@ -280,3 +280,210 @@ def test_fanout_cache_capacity_evicts_one_not_all():
     # the cache still serves: a repeated topic re-enters and hits
     b.publish(Message(topic="room/7/u7", payload=b"x"))
     assert len(b._fanout_cache) <= 4
+
+
+# --- ISSUE 9: transfer-pipelined depth-D ring -----------------------------
+
+
+def test_fetch_ticket_overlap_ready_and_wait():
+    from emqx_tpu.obs.kernel_telemetry import KernelTelemetry
+    from emqx_tpu.ops import transfer as transfer_ops
+
+    class FakeBuf:
+        """Device-array stand-in with a controllable landing flag."""
+
+        def __init__(self, value):
+            self._v = np.asarray(value)
+            self.nbytes = self._v.nbytes
+            self.ready_flag = False
+            self.async_started = 0
+
+        def copy_to_host_async(self):
+            self.async_started += 1
+
+        def is_ready(self):
+            return self.ready_flag
+
+        def __array__(self, dtype=None):
+            return self._v if dtype is None else self._v.astype(dtype)
+
+    tel = KernelTelemetry()
+    a, b = FakeBuf([1, 2, 3]), FakeBuf([4])
+    t = transfer_ops.start_fetch((a, b), tel)
+    # the async copies started AT LAUNCH, not at wait
+    assert a.async_started == 1 and b.async_started == 1
+    assert not t.ready()  # neither buffer landed
+    a.ready_flag = True
+    assert not t.ready()  # one still in flight
+    b.ready_flag = True
+    assert t.ready()
+    out = t.wait()
+    assert [x.tolist() for x in out] == [[1, 2, 3], [4]]
+    assert t.wait() is out  # idempotent
+    assert tel.counters["transfer_bytes"] == a.nbytes + b.nbytes
+    assert tel.gauges["transfer_inflight"] == 0  # up at launch, down at wait
+    assert tel.family_hist["transfer_seconds"].total == 1
+    # plain numpy arrays (host fallbacks) are always ready
+    t2 = transfer_ops.start_fetch((np.arange(3),), tel)
+    assert t2.ready() and t2.wait()[0].tolist() == [0, 1, 2]
+
+
+def test_transfer_chunk_caps_hits_and_escalation_stays_exact():
+    from emqx_tpu.ops import transfer as transfer_ops
+
+    assert transfer_ops.chunk_hits(0) is None
+    assert transfer_ops.chunk_hits(64) == 64 * 1024 // 8
+    r = Router(max_levels=8)
+    r.add_routes([(f"t{i}/+/x/#", f"d{i}") for i in range(64)])
+    want = r.match_filters_batch([f"t{i}/a/x/y" for i in range(64)])
+    # a tiny chunk forces mh down to the 1024 floor; results identical
+    r.set_transfer_chunk(8)
+    assert r.device_table.transfer_chunk_hits == 1024
+    assert r.match_filters_batch([f"t{i}/a/x/y" for i in range(64)]) == want
+    r.set_transfer_chunk(0)
+    assert r.device_table.transfer_chunk_hits is None
+
+
+def test_aot_warmup_no_serve_time_recompiles():
+    r = Router(max_levels=8)
+    r.add_routes([(f"t{i}/+/x/#", f"d{i}") for i in range(16)])
+    tel = r.telemetry
+    warmed = r.warmup_shapes(64)
+    assert warmed >= 7  # pow2 ladder 1..64
+    assert tel.counters["aot_warmups_total"] == warmed
+    tel.mark_serving()
+    # every production batch size pads to a warmed pow2 bucket: no
+    # serve-time retrace for ANY batch size up to the warmed cap
+    for n in (1, 3, 7, 16, 33, 64):
+        r.match_filters_batch([f"t{i % 16}/a/x/n{n}" for i in range(n)])
+    assert tel.counters.get("recompiles_at_serve_total", 0) == 0
+
+
+def test_engine_warmup_sizes_chunk_and_freezes_steady_state():
+    b = _fanned_broker()
+    eng = b.enable_dispatch_engine(queue_depth=16, deadline_ms=0.5)
+    info = eng.warmup()
+    assert eng.warmed
+    assert info["transfer_chunk_kb"] >= 0
+    tel = b.router.telemetry
+    assert tel.serving
+    if info["transfer_chunk_kb"]:
+        assert b.router.device_table.transfer_chunk_hits is not None
+    # explicit chunk wins over the probe
+    eng2 = b.enable_dispatch_engine(
+        queue_depth=16, deadline_ms=0.5, transfer_chunk_kb=64
+    )
+    info2 = eng2.warmup()
+    assert info2["transfer_chunk_kb"] == 64
+    assert b.router.device_table.transfer_chunk_hits == 64 * 1024 // 8
+    import gc as _gc
+
+    _gc.unfreeze()  # test hygiene: hand frozen state back
+
+
+async def test_ring_defers_unready_head_and_keeps_begin_order():
+    """Out-of-order transfer arrivals: the drain must NOT block the
+    loop on an unready head, and must still deliver results in begin
+    order once the head lands (the sync-recomposition bit-exactness
+    contract rides on finish-in-begin-order)."""
+    b = _fanned_broker()
+    eng = b.enable_dispatch_engine(
+        queue_depth=8, deadline_ms=0.2, pipeline_depth=4,
+        match_cache_size=0,
+    )
+    r = b.router
+    real_ready = r.match_finish_ready
+    holds = {"left": 3, "deferred": 0}
+
+    def gated(p):
+        # pretend the head's transfer hasn't landed for the first few
+        # drain probes — a later batch "arriving first"
+        if holds["left"] > 0:
+            holds["left"] -= 1
+            holds["deferred"] += 1
+            return False
+        return real_ready(p)
+
+    r.match_finish_ready = gated
+    done_order = []
+    futs = []
+    for w in range(3):  # three waves -> three begun batches
+        for i in range(8):
+            fut = eng.submit(
+                Message(topic=f"room/{i % 8}/w{w}", payload=b"x")
+            )
+            fut.add_done_callback(
+                lambda f, k=(w, len(futs)): done_order.append(k[0])
+            )
+            futs.append(fut)
+        await asyncio.sleep(0.002)
+    counts = await asyncio.gather(*futs)
+    assert holds["deferred"] >= 1  # the defer path actually engaged
+    # completions grouped strictly by begin (wave) order
+    assert done_order == sorted(done_order)
+    sync = [
+        b.publish(Message(topic=f"room/{i % 8}/w{w}", payload=b"y"))
+        for w in range(3)
+        for i in range(8)
+    ]
+    assert counts == sync
+    await eng.stop()
+
+
+async def _ring_churn_breaker_exactness(b):
+    """Depth-4 ring under interleaved route churn with transient
+    faults and a full breaker trip mid-window: every wave's delivery
+    counts must equal the synchronous path (which serves host-side
+    truth) — bit-exactness survives failover, degradation, and
+    recovery."""
+    from emqx_tpu.chaos.faults import DeviceFaultInjector
+
+    eng = b.enable_dispatch_engine(
+        queue_depth=8, deadline_ms=0.3, pipeline_depth=4,
+        breaker_threshold=2, match_cache_size=64,
+    )
+    inj = DeviceFaultInjector().install(b.router)
+    extra = []
+    for step in range(6):
+        # route churn between (and during) in-flight windows
+        if step % 2 == 0:
+            s, _ = b.open_session(f"x{step}", True)
+            s.outgoing_sink = lambda pkts: None
+            b.subscribe(s, "room/#", SubOpts(qos=0))
+            extra.append(s)
+        elif extra:
+            b.unsubscribe(extra.pop(0), "room/#")
+        if step == 2:
+            # transient burst: absorbed by host failover, invisible
+            inj.fail_transient(1, legs=("match_finish",))
+        elif step == 3:
+            # sticky loss: trips the breaker mid-window -> host mode
+            inj.fail_sticky()
+        elif step == 4:
+            inj.heal()
+            assert eng.probe_once()  # verified canary closes it
+        msgs = [
+            Message(topic=f"room/{i % 8}/s{step}", payload=b"x")
+            for i in range(16)
+        ]
+        counts = await asyncio.gather(*[eng.publish(m) for m in msgs])
+        sync = [
+            b.publish(Message(topic=m.topic, payload=b"y")) for m in msgs
+        ]
+        assert counts == sync, f"step {step}"
+    assert eng.breaker_state == "closed"
+    inj.uninstall()
+    await eng.stop()
+
+
+async def test_depth_ring_exact_under_churn_and_breaker_single_device():
+    await _ring_churn_breaker_exactness(_fanned_broker())
+
+
+async def test_depth_ring_exact_under_churn_and_breaker_sharded():
+    b = Broker(max_levels=4, mesh=mesh_mod.make_mesh(n_dp=2, n_sub=4))
+    for i in range(24):
+        s, _ = b.open_session(f"c{i}", True)
+        s.outgoing_sink = lambda pkts: None
+        b.subscribe(s, f"room/{i % 8}/+", SubOpts(qos=0))
+    await _ring_churn_breaker_exactness(b)

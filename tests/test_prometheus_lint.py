@@ -300,7 +300,8 @@ async def test_sentinel_families_lint():
     # inject a fanout divergence so the audit_divergence/quarantine
     # counters populate on the scrape
     key = ("sn/+/v",)
-    clock, (mem, other) = broker._fanout_cache[key]
+    entry = broker._fanout_cache[key]
+    clock, (mem, other) = entry[0], entry[1]
     broker._fanout_cache[key] = (clock, (mem[:-1], other))
     await eng.publish(Message(topic="sn/0/v", payload=b"x"))
     await asyncio.sleep(0)
@@ -436,3 +437,48 @@ async def test_breaker_and_queue_families_lint(tmp_path):
         assert types.get(fam) == kind, f"{fam}: {types.get(fam)}"
     assert tel.counters["breaker_trips_total"] == 1
     assert tel.counters["breaker_recoveries_total"] == 1
+
+
+async def test_transfer_and_warmup_families_lint():
+    """ISSUE-9 families: the transfer-pipeline telemetry
+    (emqx_xla_transfer_{seconds,bytes,inflight}) and the AOT-warmup /
+    serve-time-recompile counters must ride the broker scrape, driven
+    through a REAL warmed engine serving real publishes — never
+    hand-set gauges."""
+    from emqx_tpu.broker.dispatch_engine import DispatchEngine
+
+    broker = Broker()
+    s, _ = broker.open_session("c1", clean_start=True)
+    s.outgoing_sink = lambda pkts: None
+    broker.subscribe(s, "k0/#", SubOpts(qos=0))
+    broker.router.add_routes(
+        [(f"k{i}/+/v/#", f"d{i}") for i in range(16)]
+    )
+    eng = DispatchEngine(
+        broker, queue_depth=8, deadline_ms=0.5, match_cache_size=0,
+        transfer_chunk_kb=64, gc_guard=False,
+    )
+    info = eng.warmup()
+    assert info["transfer_chunk_kb"] == 64
+    topics = [f"k{i}/a/v/w" for i in range(8)]
+    await asyncio.gather(
+        *[eng.publish(Message(topic=t, payload=b"x")) for t in topics]
+    )
+    await eng.stop()
+    tel = broker.router.telemetry
+    # warmed shapes cover every pow2 bucket up to queue_depth: the
+    # serve wave above must not have retraced
+    assert tel.counters["aot_warmups_total"] >= 1
+    assert tel.counters.get("recompiles_at_serve_total", 0) == 0
+    assert tel.counters["transfer_bytes"] > 0
+    assert tel.gauges["transfer_inflight"] == 0  # all tickets collected
+    text = prometheus_text(broker, "n1@host")
+    types = _lint(text)
+    for fam, kind in (
+        ("emqx_xla_transfer_seconds", "histogram"),
+        ("emqx_xla_transfer_bytes", "counter"),
+        ("emqx_xla_transfer_inflight", "gauge"),
+        ("emqx_xla_aot_warmups_total", "counter"),
+        ("emqx_xla_recompiles_at_serve_total", "counter"),
+    ):
+        assert types.get(fam) == kind, f"{fam}: {types.get(fam)}"

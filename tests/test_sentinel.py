@@ -140,7 +140,8 @@ async def test_fanout_plan_divergence_detected(tmp_path):
         subscribe_fan(b, n=8)
         await _drive(b, eng, ["a/1/c"])
         key = ("a/+/c",)
-        clock, plan = b._fanout_cache[key]
+        entry = b._fanout_cache[key]
+        clock, plan = entry[0], entry[1]
         mem, other = plan
         assert len(mem) == 8
         b._fanout_cache[key] = (clock, (mem[:-1], other))  # drop a client
@@ -375,7 +376,8 @@ def test_sync_publish_path_is_sampled_too(tmp_path):
         assert "deliver" in obs.sentinel.stage_hist
         # corrupt the CACHED plan the sync path will execute
         key = ("a/+/c",)
-        clock, (mem, other) = b._fanout_cache[key]
+        entry = b._fanout_cache[key]
+        clock, (mem, other) = entry[0], entry[1]
         b._fanout_cache[key] = (clock, (mem[:-1], other))
         assert b.publish(Message(topic="a/1/c", payload=b"x")) == 5
         b.sentinel.run_audits()

@@ -302,7 +302,8 @@ def _driven_scrape():
             # drive a real divergence so the audit/quarantine families
             # and the flight trigger counter render
             key = ("g/+/v",)
-            clock, (mem, other) = broker._fanout_cache[key]
+            entry = broker._fanout_cache[key]
+            clock, (mem, other) = entry[0], entry[1]
             broker._fanout_cache[key] = (clock, (mem[:-1], other))
             await eng.publish(Message(topic="g/1/v", payload=b"x"))
             await asyncio.sleep(0)
@@ -412,4 +413,114 @@ def test_every_declared_family_renders_and_lints():
     assert not missing, (
         "families declared in source but never rendered on a driven "
         f"scrape (dead or undriveable exposition code): {missing}"
+    )
+
+
+# --- leg 7 (ISSUE 9): no blocking host fetches outside finish sites -------
+
+# The transfer pipeline's whole win is that begin halves LAUNCH and
+# finish halves WAIT — one synchronous fetch smuggled into a launch
+# path silently re-serializes every ring slot behind it (the exact bug
+# class PERF_NOTES r6's 412ms launch-stage p99 decomposed to). These
+# are the dispatch-path modules and, per module, the ONLY functions
+# allowed to force a device->host transfer (np.asarray /
+# jax.device_get / .block_until_ready). Adding a fetch site means
+# adding it HERE, in review, with a reason.
+FETCH_SITE_ALLOWLIST = {
+    "broker/dispatch_engine.py": set(),
+    "models/router.py": {
+        # finish halves + full-upload sync + chaos corruption seams
+        "match_hash_finish", "match_ids_finish", "_sync_index",
+        "chaos_corrupt_rows", "chaos_corrupt_slots",
+    },
+    "ops/match.py": set(),
+    "ops/fanout.py": {
+        # host-numpy CSR bookkeeping (no device values flow here) +
+        # the device mirror's sync scatter feed
+        "set_row", "free_rows", "fan_of", "sync",
+    },
+    "ops/hash_index.py": {"add_rows"},
+    "ops/table.py": {"add_bulk", "_add_bulk_native", "drain_dirty"},
+    "ops/transfer.py": {
+        # THE designated fetch site: every finish half funnels its
+        # wait through FetchTicket.wait; the link probe blocks by
+        # design (attach-time sizing, never the serve path)
+        "wait", "probe_link",
+    },
+    "parallel/sharded_match.py": {
+        "match_hash_finish", "match_ids_finish", "_sync_index",
+        "_sync_impl",
+    },
+}
+
+# begin halves + the engine's flush must not force ANY host value:
+# int()/float() on a device scalar blocks exactly like np.asarray.
+# int()/float() over static shape metadata (`.shape[...]`) is host
+# work and stays legal.
+_BEGIN_RE = re.compile(r"(_begin$|^_flush$)")
+
+
+def _fetch_kind(call: ast.Call):
+    f = call.func
+    if not isinstance(f, ast.Attribute):
+        return None
+    if f.attr == "asarray" and isinstance(f.value, ast.Name) \
+            and f.value.id == "np":
+        return "np.asarray"
+    if f.attr == "device_get":
+        return "jax.device_get"
+    if f.attr == "block_until_ready":
+        return ".block_until_ready()"
+    return None
+
+
+def _contains_shape_attr(node: ast.AST) -> bool:
+    return any(
+        isinstance(n, ast.Attribute) and n.attr in ("shape", "ndim")
+        for n in ast.walk(node)
+    )
+
+
+def test_no_blocking_host_fetch_outside_finish_sites():
+    offenders = []
+    for rel, allowed in FETCH_SITE_ALLOWLIST.items():
+        path = PKG / rel
+        tree = ast.parse(path.read_text())
+        stack = []
+
+        def visit(node):
+            is_fn = isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            )
+            if is_fn:
+                stack.append(node.name)
+            if isinstance(node, ast.Call):
+                fn = stack[-1] if stack else "<module>"
+                kind = _fetch_kind(node)
+                if kind and fn not in allowed:
+                    offenders.append(f"{rel}:{node.lineno} {kind} in "
+                                     f"{fn}()")
+                in_begin = any(_BEGIN_RE.search(s) for s in stack)
+                if (
+                    in_begin
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id in ("int", "float")
+                    and node.args
+                    and not _contains_shape_attr(node.args[0])
+                ):
+                    offenders.append(
+                        f"{rel}:{node.lineno} {node.func.id}() on a "
+                        f"possible device value inside launch half "
+                        f"{fn}()"
+                    )
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+            if is_fn:
+                stack.pop()
+
+        visit(tree)
+    assert not offenders, (
+        "blocking host fetch outside designated finish/fetch sites "
+        "(re-serializes the transfer pipeline):\n  "
+        + "\n  ".join(offenders)
     )
