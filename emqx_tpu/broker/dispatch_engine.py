@@ -98,7 +98,7 @@ import contextlib
 import gc
 import logging
 from collections import deque
-from typing import Deque, List, Optional, Tuple
+from typing import Deque, Dict, List, Optional, Set, Tuple
 
 from .message import Message
 
@@ -244,6 +244,13 @@ class DispatchEngine:
         self.breaker_state = "closed"
         self._consecutive_failures = 0
         self._probe_task: Optional[asyncio.Task] = None
+        # --- shard breaker (ShardedDeviceTable chip loss): failures
+        # whose exception carries a `shard` attribute are accounted
+        # here PER SHARD and never feed _consecutive_failures — one
+        # sick chip must not forfeit the whole mesh
+        self._shard_failures: Dict[int, int] = {}
+        self._shard_open: Set[int] = set()
+        self._shard_probe_tasks: Dict[int, asyncio.Task] = {}
         self.last_device_error: Optional[str] = None
         # canary topics: the most recent distinct batch heads, so the
         # recovery probe dispatches realistic traffic, not synthetics
@@ -832,6 +839,15 @@ class DispatchEngine:
             self.last_device_error = repr(exc)
         if not self.breaker_enabled:
             return
+        shard = getattr(exc, "shard", None)
+        if shard is not None:
+            # chip-granular fault: per-shard ledger, whole-device
+            # breaker untouched (the other shards are fine)
+            n = self._shard_failures.get(shard, 0) + 1
+            self._shard_failures[shard] = n
+            if shard not in self._shard_open and n >= self.breaker_threshold:
+                self._trip_shard(int(shard), exc)
+            return
         self._consecutive_failures += 1
         tel.set_gauge(
             "breaker_consecutive_failures", self._consecutive_failures
@@ -846,6 +862,13 @@ class DispatchEngine:
         if self._consecutive_failures:
             self._consecutive_failures = 0
             self.telemetry.set_gauge("breaker_consecutive_failures", 0)
+        # a clean mesh-wide dispatch clears the ledgers of shards that
+        # have NOT tripped (sparse transients can't accumulate); open
+        # shards stay open — their probe loop owns recovery
+        if self._shard_failures:
+            for s in list(self._shard_failures):
+                if s not in self._shard_open:
+                    del self._shard_failures[s]
 
     def _set_state(self, state: str) -> None:
         self.breaker_state = state
@@ -968,6 +991,150 @@ class DispatchEngine:
                 "breaker.close", "", {"canary_topics": len(canary_topics)}
             )
 
+    # --- shard breaker (chip-granular failure domain) ---------------------
+
+    @property
+    def open_shards(self) -> Set[int]:
+        return set(self._shard_open)
+
+    def _trip_shard(self, shard: int, exc: Optional[BaseException]) -> None:
+        """One chip crossed the threshold: suspend ONLY its slice
+        (host overlay), then evacuate its row/bucket range onto the
+        survivor mesh so service returns to full device speed at N-1,
+        and arm a per-shard recovery probe. The whole-device breaker
+        stays closed — the other chips never stop serving."""
+        tel = self.telemetry
+        self._shard_open.add(shard)
+        tel.count("breaker_shard_trips_total")
+        tel.set_gauge("breaker_open_shards", len(self._shard_open))
+        self.router.suspend_shard(shard)
+        details = {
+            "shard": shard,
+            "failures": self._shard_failures.get(shard, 0),
+            "threshold": self.breaker_threshold,
+            "last_error": self.last_device_error,
+        }
+        log.error(
+            "shard breaker TRIPPED for shard %d (last: %s) — slice "
+            "host-overlaid, evacuating onto survivor mesh",
+            shard, self.last_device_error,
+        )
+        alarms = self._get_alarms()
+        if alarms is not None:
+            try:
+                alarms.ensure(
+                    ALARM_BREAKER,
+                    details=details,
+                    message=f"XLA shard breaker open: shard {shard} "
+                            "slice degraded, evacuating",
+                )
+            except Exception:
+                tel.count("breaker_alarm_failures_total")
+                log.exception("shard breaker alarm failed")
+        fl = self._get_flight()
+        if fl is not None:
+            fl.recorder.record("breaker.shard_trip", "", details)
+            fl.maybe_trigger("device_breaker_trip", details)
+        try:
+            # live evacuation: re-shard over survivors + full re-upload
+            # from host truth; on failure the host overlay stays as the
+            # degraded-but-correct fallback until the probe heals it
+            if self.router.evacuate_shard(shard):
+                tel.count("breaker_shard_evacuations_total")
+                # recompile the survivor-mesh kernel shapes off the
+                # deadline-gated serving path
+                self.router.warmup_shapes(max_batch=64)
+        except Exception:
+            tel.count("breaker_shard_evacuation_failures_total")
+            log.exception(
+                "shard %d evacuation failed; slice stays host-overlaid",
+                shard,
+            )
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return  # offline path: caller drives probe_shard_once()
+        t = loop.create_task(self._shard_probe_loop(shard))
+        self._shard_probe_tasks[shard] = t
+        t.add_done_callback(
+            lambda task, s=shard: self._shard_probe_done(s, task)
+        )
+
+    def _shard_probe_done(self, shard: int, task: "asyncio.Task") -> None:
+        if self._shard_probe_tasks.get(shard) is task:
+            del self._shard_probe_tasks[shard]
+        if not task.cancelled() and task.exception() is not None:
+            self.telemetry.count("breaker_probe_crashes_total")
+            log.error(
+                "shard %d probe loop died", shard,
+                exc_info=task.exception(),
+            )
+
+    async def _shard_probe_loop(self, shard: int) -> None:
+        backoff = self.probe_backoff_s
+        while not self.closed and shard in self._shard_open:
+            await asyncio.sleep(backoff)
+            backoff = min(backoff * 2.0, self.probe_backoff_max_s)
+            if self.closed or shard not in self._shard_open:
+                return
+            if self.probe_shard_once(shard):
+                return
+
+    def probe_shard_once(self, shard: int) -> bool:
+        """One recovery attempt for an evacuated chip: direct link
+        probe -> rebalance back to the full mesh (full state re-upload)
+        -> oracle-verified canary -> close. On canary divergence the
+        chip is re-evacuated — it re-earns trust, never gets it."""
+        tel = self.telemetry
+        router = self.router
+        tel.count("breaker_probe_total")
+        topics = list(self._recent_topics) or ["$breaker/canary"]
+        try:
+            # step 1: is the chip's link back? (raises while sticky)
+            router.probe_shard(shard)
+            # step 2: rebalance back to N and verify against the oracle
+            router.rebalance_shard(shard)
+            served = router.canary_match(topics)
+            oracle = [sorted(router.match_filters(t)) for t in topics]
+            if [sorted(x) for x in served] != oracle:
+                raise RuntimeError(
+                    f"post-rebalance canary diverged on shard {shard}"
+                )
+        except Exception as e:
+            tel.count("breaker_probe_failures_total")
+            self.last_device_error = repr(e)
+            dt = router.device_table
+            if shard not in getattr(dt, "lost_shards", set()):
+                # rebalance half-landed or canary diverged: evacuate
+                # again so serving stays on the verified survivor mesh
+                with contextlib.suppress(Exception):
+                    router.evacuate_shard(shard)
+            return False
+        self._close_shard(shard, topics)
+        return True
+
+    def _close_shard(self, shard: int, canary_topics) -> None:
+        tel = self.telemetry
+        self._shard_open.discard(shard)
+        self._shard_failures.pop(shard, None)
+        tel.set_gauge("breaker_open_shards", len(self._shard_open))
+        tel.count("breaker_shard_recoveries_total")
+        log.warning(
+            "shard breaker CLOSED for shard %d: rebalanced back to "
+            "full mesh, canary verified on %d topics",
+            shard, len(canary_topics),
+        )
+        if not self._shard_open and self.breaker_state == "closed":
+            alarms = self._get_alarms()
+            if alarms is not None:
+                alarms.ensure_deactivated(ALARM_BREAKER)
+        fl = self._get_flight()
+        if fl is not None:
+            fl.recorder.record(
+                "breaker.shard_close", "",
+                {"shard": shard, "canary_topics": len(canary_topics)},
+            )
+
     # --- lifecycle --------------------------------------------------------
 
     async def drain(self) -> None:
@@ -1022,6 +1189,11 @@ class DispatchEngine:
             with contextlib.suppress(Exception, asyncio.CancelledError):
                 await self._probe_task
             self._probe_task = None
+        for t in list(self._shard_probe_tasks.values()):
+            t.cancel()
+            with contextlib.suppress(Exception, asyncio.CancelledError):
+                await t
+        self._shard_probe_tasks.clear()
         if self.gc_guard and self.warmed:
             # hand the frozen steady state back to the collector —
             # a stopped engine's broker graph must stay reclaimable
@@ -1061,6 +1233,23 @@ class DispatchEngine:
                     "breaker_probe_failures_total", 0
                 ),
                 "last_device_error": self.last_device_error,
+            },
+            "shard_breaker": {
+                "open_shards": sorted(self._shard_open),
+                "failures": dict(sorted(self._shard_failures.items())),
+                "lost_shards": sorted(
+                    getattr(self.router.device_table, "lost_shards", ())
+                ),
+                "shard_gen": getattr(
+                    self.router.device_table, "shard_gen", 0
+                ),
+                "trips": counters.get("breaker_shard_trips_total", 0),
+                "evacuations": counters.get(
+                    "breaker_shard_evacuations_total", 0
+                ),
+                "recoveries": counters.get(
+                    "breaker_shard_recoveries_total", 0
+                ),
             },
             "admission": {
                 "max_depth": self.queue_max_depth,

@@ -18,7 +18,11 @@ production failure catalog against them —
   * device-link faults at the XLA boundary (chaos/faults.py): transient
     kernel failures, sticky device loss, and stalled transfers — the
     conditions the dispatch engine's circuit breaker + host failover
-    (device_loss / device_flap scenarios) must absorb invisibly
+    (device_loss / device_flap scenarios) must absorb invisibly,
+  * shard-scoped chip faults on the multi-chip mesh (chip_loss /
+    chip_flap / reshard_churn): one sub-axis column dies, the shard
+    breaker evacuates its slice onto the survivor mesh (N-1 device
+    service), and recovery rebalances back to the full mesh
 
 — while the sentinel, SLO tracker, and flight recorder judge the
 outcome. Every scenario declares an expected response contract and the
@@ -53,6 +57,9 @@ from .faults import (  # noqa: F401
 from .scenarios import (  # noqa: F401
     CATALOG,
     Check,
+    ChipFlap,
+    ChipLoss,
+    ReshardChurn,
     Scenario,
     ScenarioResult,
     scenario_catalog,

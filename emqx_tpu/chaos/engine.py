@@ -436,6 +436,28 @@ class ChaosEngine:
         self.delivered += n
         return n
 
+    async def route_churn(self, n: int = 64) -> int:
+        """Live route churn: `n` add legs (fresh temp-session
+        subscriptions on never-seen filters) followed by `n` delete
+        legs (their unsubscribes), with a device sync + served burst in
+        between — the subscribe/unsubscribe traffic a degraded mesh
+        must keep absorbing. Returns routes churned."""
+        b = self.broker
+        self._chaos_seq += 1
+        seq = self._chaos_seq
+        s, _ = b.open_session(f"churn{seq}", True)
+        s.outgoing_sink = _noop_sink
+        flts = [f"churn/{seq}/{i}/+" for i in range(n)]
+        for flt in flts:
+            b.subscribe(s, flt, SubOpts(qos=0))
+        self.router.device_table.sync()
+        await self.burst([flts[0][:-1] + "x", flts[-1][:-1] + "x"])
+        for flt in flts:
+            b.unsubscribe(s, flt)
+        b.close_session(s, discard=True)
+        self.router.device_table.sync()
+        return 2 * n  # add legs + delete legs
+
     def reset_flight_cooldown(self, rule: str) -> None:
         """Clear one trigger rule's cooldown latch. Scenario contracts
         demand a bundle PER scenario; the production cooldown would
@@ -578,6 +600,10 @@ class ChaosEngine:
             for sc in cat:
                 if sc.needs_cluster and self.victim is None:
                     continue
+                if sc.needs_mesh and getattr(
+                    self.router.device_table, "mesh", None
+                ) is None:
+                    continue
                 self.progress(f"scenario: {sc.name}")
                 res = await sc.run(self)
                 results.append(res)
@@ -698,6 +724,17 @@ class ChaosEngine:
                     "breaker_probe_failures_total", 0
                 ),
                 "device_resyncs": counters.get("device_resyncs_total", 0),
+                # shard failure domain (chip-granular breaker)
+                "shard_trips": counters.get(
+                    "breaker_shard_trips_total", 0
+                ),
+                "shard_evacuations": counters.get(
+                    "breaker_shard_evacuations_total", 0
+                ),
+                "shard_recoveries": counters.get(
+                    "breaker_shard_recoveries_total", 0
+                ),
+                "shard_overlays": counters.get("shard_overlay_total", 0),
                 "queue_shed": counters.get("queue_shed_total", 0),
                 "queue_blocked": counters.get("queue_blocked_total", 0),
                 "queue_deadline_expired": counters.get(

@@ -516,6 +516,12 @@ class Router:
         # while the circuit breaker is open.
         self.fault_injector = None
         self.device_suspended = False
+        # shard failure domain (ShardedDeviceTable only): sub-axis
+        # columns whose bucket slice is answered by the host overlay in
+        # match_filters_finish while their chip is sick — the OTHER
+        # shards keep serving on device (contrast device_suspended,
+        # which forfeits the whole mesh)
+        self._suspended_shards: Set[int] = set()
         # shadow-audit quarantine (obs/sentinel.py): filters whose
         # device rows diverged from the host oracle. While quarantined
         # a filter is answered by the host walk (overlay in
@@ -741,6 +747,135 @@ class Router:
         for j, i in enumerate(p.sub_idx):
             full[i] = out[j]
         return full
+
+    # --- shard failure domain (ShardedDeviceTable chip loss) -------------
+
+    def suspend_shard(self, shard: int) -> bool:
+        """Open the breaker for ONE sub-axis column: topics keep going
+        through the device kernels, but answers owned by the sick
+        shard's row/bucket slice are corrected from host truth by the
+        overlay in match_filters_finish — the same discipline as the
+        quarantine overlay, scoped by ownership instead of by filter.
+        Falls back to whole-device suspension when the table has no
+        mesh. Returns True on the closed->open transition."""
+        dt = self.device_table
+        if getattr(dt, "mesh", None) is None:
+            return self.suspend_device()
+        if shard in self._suspended_shards:
+            return False
+        self._suspended_shards.add(shard)
+        # match-cache entries may hold the sick shard's answers
+        self._aux_gen += 1
+        tel = self.telemetry
+        if tel.enabled:
+            tel.count("shard_suspends_total")
+            tel.set_gauge("shards_suspended", len(self._suspended_shards))
+        return True
+
+    def resume_shard(self, shard: int) -> None:
+        if shard not in self._suspended_shards:
+            return
+        self._suspended_shards.discard(shard)
+        self._aux_gen += 1
+        tel = self.telemetry
+        if tel.enabled:
+            tel.count("shard_resumes_total")
+            tel.set_gauge("shards_suspended", len(self._suspended_shards))
+
+    def _shard_owners(self, flt: str) -> Set[int]:
+        """The sub-axis columns whose device state can answer (or
+        wrongly drop) `flt` under the CURRENT mesh: the shard holding
+        its table row (dense/residual leg) plus — for classed filters —
+        the shard holding its bucket's cuckoo slot (the hash kernel
+        probes by slot position, which cuckoo may have placed under
+        either hash position)."""
+        dt = self.device_table
+        owners: Set[int] = set()
+        row = self._fanout_row(flt)
+        if row is None:
+            return owners  # deep/host-resident: device never answers it
+        owners.add(dt.shard_of_row(row))
+        ix = self.index
+        if ix is not None and row < len(ix._row_bucket):
+            bid = int(ix._row_bucket[row])
+            if bid >= 0:
+                slot = int(ix._bkt_slot[bid])
+                if slot >= 0:
+                    owners.add(dt.shard_of_slot(slot))
+        return owners
+
+    def _shard_overlay(
+        self, topics: Sequence[str], out: List[List[str]]
+    ) -> None:
+        """Rewrite kernel answers owned by suspended shards from host
+        truth: drop every surfaced filter a sick shard served, then
+        re-add from the host walk exactly the matches a sick shard
+        owns. O(answer + host-match) per topic — no enumeration of the
+        suspect slice, which can be a million rows."""
+        sus = self._suspended_shards
+        owners = self._shard_owners
+        served = 0
+        for i, t in enumerate(topics):
+            lst = out[i]
+            keep = [f for f in lst if not (owners(f) & sus)]
+            truth = [
+                f for f in self.match_filters(t) if owners(f) & sus
+            ]
+            if truth or len(keep) != len(lst):
+                out[i] = keep + truth
+            served += 1
+        tel = self.telemetry
+        if tel.enabled and served:
+            tel.count("shard_overlay_total", served)
+
+    def probe_shard(self, shard: int) -> None:
+        """Direct link probe of one (possibly evacuated) chip for the
+        shard breaker's recovery loop: raises while the chip's fault is
+        still programmed. The injector's shard_probe leg deliberately
+        ignores lost_shards — probing the evacuated chip is the point."""
+        fi = self.fault_injector
+        if fi is not None:
+            # literal = chaos.faults.SHARD_PROBE_LEG (importing chaos
+            # here would cycle through broker -> models)
+            fi.check("shard_probe", shard=shard)
+
+    def evacuate_shard(self, shard: int) -> bool:
+        """Live evacuation: remap the lost shard's row/bucket slices
+        onto the surviving chips (new shard-map generation), re-upload
+        from host truth through the full-resync machinery, and lift the
+        host overlay — N-1 chips serving the whole table on device.
+        The EMQX analog is node evacuation (emqx_eviction_agent): move
+        live routing state off the failing member, keep serving."""
+        dt = self.device_table
+        if getattr(dt, "mesh", None) is None:
+            return False  # single-device table: nothing to re-shard
+        if not dt.evacuate_shard(shard):
+            return False
+        self._aux_gen += 1
+        tel = self.telemetry
+        if tel.enabled:
+            tel.count("shard_evacuations_total")
+            tel.set_gauge("shards_lost", len(dt.lost_shards))
+        dt.sync()  # full re-upload onto the survivor mesh
+        self.resume_shard(shard)
+        return True
+
+    def rebalance_shard(self, shard: int) -> bool:
+        """Rebalance-back: re-admit a recovered chip (restore the full
+        mesh layout) and re-upload from host truth. Callers verify the
+        chip first (probe + canary) — the emqx_node_rebalance analog."""
+        dt = self.device_table
+        if getattr(dt, "mesh", None) is None:
+            return False
+        if not dt.restore_shard(shard):
+            return False
+        self._aux_gen += 1
+        tel = self.telemetry
+        if tel.enabled:
+            tel.count("shard_rebalances_total")
+            tel.set_gauge("shards_lost", len(dt.lost_shards))
+        dt.sync()
+        return True
 
     # --- chaos corruption seam (emqx_tpu/chaos) --------------------------
 
@@ -1733,6 +1868,8 @@ class Router:
                     out[i].extend(self._deep_trie.match(topic_mod.words(t)))
             if self._quarantined and out:
                 self._quarantine_overlay(topics, out)
+            if self._suspended_shards and out:
+                self._shard_overlay(topics, out)
             tel.end_span(p.root)
         if span is not None:
             # transfer = residual device->host wait the tickets

@@ -208,6 +208,14 @@ class KernelTelemetry:
         # batching discipline, not a device dispatch leg.
         self.family_hist: Dict[str, StreamingHistogram] = {}
         self.counters: Dict[str, int] = {}
+        # labeled counter families: name -> {((k, v), ...) -> count}.
+        # Disjoint from `counters` by construction (callers pick one
+        # surface per name) so the one-family-per-name exposition
+        # invariant holds; rendered like the jit_cache_entries gauge —
+        # one TYPE line, one sample per label set.
+        self.labeled_counters: Dict[
+            str, Dict[Tuple[Tuple[str, str], ...], int]
+        ] = {}
         self.gauges: Dict[str, float] = {}
         self._shape_keys: Dict[str, Set[tuple]] = {}
         self._trace_seq = 0
@@ -272,6 +280,19 @@ class KernelTelemetry:
 
     def count(self, name: str, n: int = 1) -> None:
         self.counters[name] = self.counters.get(name, 0) + n
+
+    def count_labeled(
+        self, name: str, labels: Dict[str, str], n: int = 1
+    ) -> None:
+        """Increment one series of the labeled counter family
+        `emqx_xla_<name>` (e.g. fault_injected_total{leg,shard}). Two
+        dict probes + a tuple build — hot-path safe for the chaos-only
+        call sites that use it."""
+        fam = self.labeled_counters.get(name)
+        if fam is None:
+            fam = self.labeled_counters[name] = {}
+        key = tuple(sorted(labels.items()))
+        fam[key] = fam.get(key, 0) + n
 
     def set_gauge(self, name: str, value: float) -> None:
         self.gauges[name] = value
@@ -389,6 +410,13 @@ class KernelTelemetry:
         return {
             "enabled": True,
             "counters": dict(sorted(self.counters.items())),
+            "labeled_counters": {
+                name: {
+                    ",".join(f"{k}={v}" for k, v in key): n
+                    for key, n in sorted(series.items())
+                }
+                for name, series in sorted(self.labeled_counters.items())
+            },
             "gauges": dict(sorted(self.gauges.items())),
             "dispatch": {
                 leg: h.snapshot() for leg, h in sorted(self.hist.items())
@@ -426,6 +454,13 @@ class KernelTelemetry:
             fam = f"emqx_xla_{name}"
             lines.append(f"# TYPE {fam} counter")
             lines.append(f"{fam}{{{node}}} {self.counters[name]}")
+        for name in sorted(self.labeled_counters):
+            fam = f"emqx_xla_{name}"
+            lines.append(f"# TYPE {fam} counter")
+            series = self.labeled_counters[name]
+            for key in sorted(series):
+                lbl = ",".join(f'{k}="{v}"' for k, v in key)
+                lines.append(f"{fam}{{{node},{lbl}}} {series[key]}")
         for name in sorted(self.gauges):
             fam = f"emqx_xla_{name}"
             lines.append(f"# TYPE {fam} gauge")
@@ -473,6 +508,9 @@ class NullKernelTelemetry:
         return 0.0
 
     def count(self, name, n=1) -> None:
+        pass
+
+    def count_labeled(self, name, labels, n=1) -> None:
         pass
 
     def set_gauge(self, name, value) -> None:

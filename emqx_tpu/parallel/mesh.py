@@ -76,10 +76,34 @@ def topic_sharding(mesh: Mesh) -> EncodedTopics:
     return EncodedTopics(NamedSharding(mesh, P(DP_AXIS, None)), row, row)
 
 
+def shard_rows(n: int, mesh: Mesh) -> int:
+    """Rows per 'sub' shard for an n-row table: ceil(n / n_sub). When
+    n_sub divides n this is the exact slice; otherwise the trailing
+    `shard_rows*n_sub - n` positions are inert padding (active=False),
+    which is what lets an N-1 survivor mesh keep serving a pow2
+    capacity after a chip is evacuated. Because the pad sits at the
+    END of the flat array, padded-global position == logical row id
+    for every real row, so axis_index offset arithmetic in the
+    shard_map kernels is unchanged."""
+    n_sub = mesh.shape[SUB_AXIS]
+    return -(-n // n_sub)
+
+
 def put_filters(filters: EncodedFilters, mesh: Mesh) -> EncodedFilters:
     """Place a host filter-table snapshot onto the mesh, rows split
-    over 'sub'. Row count must divide the sub axis (power-of-two table
-    capacities do)."""
+    over 'sub'. Row counts that don't divide the sub axis (an N-1
+    survivor mesh serving a pow2 capacity) get trailing inert pad rows
+    (zeros, active=False — they can never match)."""
+    n = filters.words.shape[0]
+    pad = shard_rows(n, mesh) * mesh.shape[SUB_AXIS] - n
+    if pad:
+        filters = EncodedFilters(
+            np.pad(filters.words, ((0, pad), (0, 0))),
+            np.pad(filters.prefix_len, (0, pad)),
+            np.pad(filters.has_hash, (0, pad)),
+            np.pad(filters.root_wild, (0, pad)),
+            np.pad(filters.active, (0, pad)),
+        )
     shs = filter_sharding(mesh)
     return EncodedFilters(
         *(jax.device_put(a, s) for a, s in zip(filters, shs))

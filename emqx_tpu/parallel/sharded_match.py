@@ -176,7 +176,9 @@ def make_match_ids_kernel(mesh: Mesh, max_hits_per_block: int):
     return match_ids
 
 
-def make_sharded_hash_kernel(mesh: Mesh, max_hits_per_block: int):
+def make_sharded_hash_kernel(
+    mesh: Mesh, max_hits_per_block: int, n_buckets: Optional[int] = None
+):
     """The PRODUCTION pattern-class cuckoo kernel, bucket-partitioned
     over the 'sub' axis (VERDICT r2 #2: the mesh must run the 67x hash
     path, not the dense demo). Each shard owns a contiguous bucket
@@ -191,7 +193,15 @@ def make_sharded_hash_kernel(mesh: Mesh, max_hits_per_block: int):
     Returns kernel(meta, slots, topics) ->
     (ti [dp, sub*mh], bi [dp, sub*mh], totals [dp, sub], amb [1,1]):
     per-block flagged-pair counts for escalation, per-shard ambiguity
-    summed over the mesh (see ops.hash_index.match_ids_hash)."""
+    summed over the mesh (see ops.hash_index.match_ids_hash).
+
+    `n_buckets` is the LOGICAL global bucket count (pow2 — the host
+    index's n_buckets). It must be passed whenever the per-shard slice
+    carries trailing pad buckets (an N-1 survivor mesh, where n_sub no
+    longer divides the pow2 count): the hash mask is `n_buckets - 1`,
+    NOT `nb_loc * n_sub - 1`, and pad buckets are simply never probed
+    because every b1/b2 lands below n_buckets. None keeps the
+    divisible-layout default (nb_loc * n_sub)."""
     from ..ops.hash_index import BUCKET_W, _ALT_MUL, _FP_CLS, _FP_MUL
     from ..ops.hash_index import _FP_SEED, _FP_XOR, _H1_CLS, _H1_MUL, _H1_SEED
 
@@ -208,7 +218,7 @@ def make_sharded_hash_kernel(mesh: Mesh, max_hits_per_block: int):
         b_loc, max_levels = ids.shape
         c = plen.shape[0]
         nb_loc = probe.shape[0]
-        nb_global = nb_loc * n_sub
+        nb_global = n_buckets if n_buckets is not None else nb_loc * n_sub
         tl = lens[:, None]
         pl = plen[None, :]
         len_ok = jnp.where(has_hash[None, :], tl >= pl, tl == pl)
@@ -420,6 +430,13 @@ class ShardedDeviceTable:
         self.index = index
         self.telemetry = telemetry if telemetry is not None else _null_tel
         self._mesh_mod = mesh_mod
+        # shard failure domain: `_mesh0` is the full N-chip layout;
+        # `lost_shards` holds ORIGINAL sub-axis columns evacuated off
+        # the mesh (chip loss); `shard_gen` bumps on every re-shard so
+        # in-flight handles/caches can detect a layout change
+        self._mesh0 = mesh
+        self.lost_shards: set = set()
+        self.shard_gen = 0
         self._dev: Optional[EncodedFilters] = None
         self._synced_capacity = 0
         _mc, _mp, self._apply_delta = make_sharded_kernels(mesh)
@@ -451,6 +468,96 @@ class ShardedDeviceTable:
             store, mesh=self.mesh, telemetry=self.telemetry
         )
 
+    # --- shard failure domain (chip loss / evacuation / rebalance) --------
+
+    @property
+    def n_shards(self) -> int:
+        return self.mesh.shape[SUB_AXIS]
+
+    def shard_of_row(self, row: int) -> int:
+        """The sub-axis column serving a table row under the CURRENT
+        mesh (trailing-pad slices: ceil(capacity / n_sub) rows each)."""
+        return row // self._mesh_mod.shard_rows(self.table.capacity, self.mesh)
+
+    def shard_of_slot(self, slot: int) -> int:
+        """The sub-axis column serving a cuckoo slot position under the
+        current mesh (slot slices stay bucket-aligned)."""
+        from ..ops.hash_index import BUCKET_W
+
+        n_sub = self.mesh.shape[SUB_AXIS]
+        nb = self.index.n_buckets
+        nb_loc = -(-nb // n_sub)
+        return slot // (nb_loc * BUCKET_W)
+
+    def _survivor_mesh(self) -> Mesh:
+        import numpy as np
+
+        arr = np.asarray(self._mesh0.devices)  # [n_dp, n_sub0]
+        keep = [
+            i for i in range(arr.shape[1]) if i not in self.lost_shards
+        ]
+        return self._mesh_mod.make_mesh(
+            n_dp=arr.shape[0],
+            n_sub=len(keep),
+            devices=arr[:, keep].reshape(-1).tolist(),
+        )
+
+    def evacuate_shard(self, shard: int) -> bool:
+        """Drop one ORIGINAL sub-axis column from the mesh and re-shard
+        the table over the survivors (N-1 serving). The caller owns the
+        follow-up `sync()` that re-uploads every slice from host truth
+        through the normal full-resync machinery. Returns True when the
+        mesh changed. Adding to `lost_shards` FIRST matters: the fault
+        injector consults it, so the evacuation resync already runs
+        without touching the lost chip while its fault is still live."""
+        n_sub0 = self._mesh0.shape[SUB_AXIS]
+        if shard < 0 or shard >= n_sub0 or shard in self.lost_shards:
+            return False
+        if len(self.lost_shards) + 1 >= n_sub0:
+            raise RuntimeError(
+                f"cannot evacuate shard {shard}: no survivor would remain"
+            )
+        self.lost_shards.add(shard)
+        self._rebuild_mesh(self._survivor_mesh())
+        return True
+
+    def restore_shard(self, shard: int) -> bool:
+        """Rebalance a recovered chip back in: restore the full layout
+        (or the wider survivor layout while other chips are still
+        lost). Caller owns the follow-up full `sync()`."""
+        if shard not in self.lost_shards:
+            return False
+        self.lost_shards.discard(shard)
+        self._rebuild_mesh(
+            self._mesh0 if not self.lost_shards else self._survivor_mesh()
+        )
+        return True
+
+    def _rebuild_mesh(self, mesh: Mesh) -> None:
+        """Swap the serving mesh: recompile the shard_map kernels for
+        the new layout, drop every device-resident array so the next
+        sync() is a full re-upload from host truth, and re-mirror the
+        fanout store."""
+        self.mesh = mesh
+        _mc, _mp, self._apply_delta = make_sharded_kernels(mesh)
+        self._match_ids_cache.clear()
+        self._hash_cache.clear()
+        self._apply_slot_delta = (
+            make_slot_delta_kernel(mesh) if self.index is not None else None
+        )
+        self._dev = None
+        self._dev_meta = None
+        self._dev_slots = None
+        self._dev_residual = None
+        self._synced_capacity = 0
+        if self.fanout is not None:
+            self.attach_fanout(self.fanout.store)
+        self.shard_gen += 1
+        tel = self.telemetry
+        if tel.enabled:
+            tel.set_gauge("mesh_shards", self.mesh.shape[SUB_AXIS])
+            tel.set_gauge("shards_lost", len(self.lost_shards))
+
     def _match_kernel(self, mh: int):
         k = self._match_ids_cache.get(mh)
         if k is None:
@@ -459,16 +566,29 @@ class ShardedDeviceTable:
         return k
 
     def _hash_kernel(self, mh: int):
-        k = self._hash_cache.get(mh)
+        # keyed on (mh, logical bucket count): capacity growth changes
+        # the hash mask, and on an N-1 mesh the mask can no longer be
+        # derived from the padded per-shard slice width
+        nb = self.index.n_buckets
+        k = self._hash_cache.get((mh, nb))
         if k is None:
-            k = make_sharded_hash_kernel(self.mesh, mh)
-            self._hash_cache[mh] = k
+            k = make_sharded_hash_kernel(self.mesh, mh, n_buckets=nb)
+            self._hash_cache[(mh, nb)] = k
         return k
 
     def _put_repl(self, a):
         return jax.device_put(a, NamedSharding(self.mesh, P()))
 
-    def _put_sub(self, a):
+    def _put_sub(self, a, pad_value=0):
+        """Sub-shard a host array, ceil-padding the leading axis to a
+        multiple of n_sub with `pad_value` (trailing pad — logical ids
+        keep their positions; see mesh.shard_rows)."""
+        import numpy as np
+
+        pad = (-a.shape[0]) % self.mesh.shape[SUB_AXIS]
+        if pad:
+            width = ((0, pad),) + ((0, 0),) * (a.ndim - 1)
+            a = np.pad(a, width, constant_values=pad_value)
         return jax.device_put(a, NamedSharding(self.mesh, P(SUB_AXIS)))
 
     def _sync_index(self) -> None:
@@ -479,7 +599,12 @@ class ShardedDeviceTable:
         ix = self.index
         assert ix is not None
         n_sub = self.mesh.shape[SUB_AXIS]
-        assert ix.n_buckets % n_sub == 0, (ix.n_buckets, n_sub)
+        # buckets per shard is ceil(n_buckets / n_sub): when n_sub does
+        # not divide the pow2 count (an N-1 survivor mesh) the trailing
+        # pad buckets are inert — fp=0 can never byte-match (p8 >= 1),
+        # bucket=-1 is rejected by the kernel's g_bkt >= 0 check, and
+        # the logical hash mask (n_buckets - 1) never probes them.
+        nb_pad = (-ix.n_buckets) % n_sub
         if ix.meta_dirty or self._dev_meta is None:
             self._dev_meta = ClassMeta(
                 *(self._put_repl(np.array(a)) for a in ix.packed_meta())
@@ -487,9 +612,18 @@ class ShardedDeviceTable:
             ix.meta_dirty = False
         if ix.rebuilt or self._dev_slots is None:
             ix.dirty_slots.clear()
+            fp = np.array(ix.slots.fp)
+            bkt = np.array(ix.slots.bucket)
+            if nb_pad:
+                # slot pad must stay bucket-aligned (per-shard slots ==
+                # buckets-per-shard * BUCKET_W), which _put_sub's plain
+                # ceil-pad would not produce
+                sp = nb_pad * BUCKET_W
+                fp = np.pad(fp, (0, sp))
+                bkt = np.pad(bkt, (0, sp), constant_values=-1)
             self._dev_slots = SlotArrays(
-                self._put_sub(np.array(ix.slots.fp)),
-                self._put_sub(np.array(ix.slots.bucket)),
+                self._put_sub(fp),
+                self._put_sub(bkt),
                 self._put_sub(np.array(ix.slots.probe)),
             )
             ix.rebuilt = False
@@ -514,8 +648,11 @@ class ShardedDeviceTable:
                 ),
             )
             self._dev_slots = SlotArrays(*out)
+        cap_padded = (
+            self.table.capacity + (-self.table.capacity) % n_sub
+        )
         if ix.residual_dirty or self._dev_residual is None or (
-            self._dev_residual.shape[0] != self.table.capacity
+            self._dev_residual.shape[0] != cap_padded
         ):
             mask = np.zeros(self.table.capacity, bool)
             if ix.residual_rows:

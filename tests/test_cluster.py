@@ -275,14 +275,26 @@ async def test_resync_after_lost_batch():
         sess, inbox = attach_client(a, "pub-side")
         a.broker.subscribe(sess, "lost/+", SubOpts(qos=0))
         await a.flush()
-        await asyncio.sleep(0.1)
-        assert "n1" in a._resync  # batch was lost, divergence recorded
+        # poll, not a fixed sleep: the flush's failed send and the
+        # divergence record race the heartbeat cadence
+        deadline = asyncio.get_running_loop().time() + 3.0
+        while "n1" not in a._resync:
+            assert asyncio.get_running_loop().time() < deadline, (
+                "lost batch never recorded divergence"
+            )
+            await asyncio.sleep(0.02)
         assert "n0" not in b.cluster_router.match_routes("lost/x")
         # b comes back on the same address; heartbeat succeeds -> resync
         await b.rpc.start(addr_b[0], addr_b[1])
-        await asyncio.sleep(0.3)
-        assert "n1" not in a._resync
-        assert "n0" in b.cluster_router.match_routes("lost/x")
+        deadline = asyncio.get_running_loop().time() + 3.0
+        while (
+            "n1" in a._resync
+            or "n0" not in b.cluster_router.match_routes("lost/x")
+        ):
+            assert asyncio.get_running_loop().time() < deadline, (
+                "anti-entropy resync never converged after heal"
+            )
+            await asyncio.sleep(0.02)
         b.broker.publish(Message(topic="lost/x", payload=b"found"))
         await asyncio.sleep(0.05)
         assert [p.payload for p in inbox] == [b"found"]

@@ -284,11 +284,154 @@ async def test_sync_publish_path_degrades_with_breaker(tmp_path):
     await eng.stop()
 
 
-# --- admission control ----------------------------------------------------
+# --- shard breaker: chip loss -> evacuate -> N-1 -> rebalance-back --------
 
 
-async def test_shed_policy_bounds_queue_and_alarms(tmp_path):
-    b = _broker(n=5)
+async def test_shard_trip_evacuates_and_recovers(tmp_path):
+    """Sticky loss scoped to ONE shard of a (1,4) mesh: the shard
+    breaker trips (whole breaker stays closed, table never suspended),
+    the slice evacuates onto the 3 surviving chips which keep serving
+    bit-identically on device, and healing rebalances back to the full
+    mesh with a verified canary."""
+    import jax
+
+    mesh = mesh_mod.make_mesh(n_dp=1, n_sub=4, devices=jax.devices()[:4])
+    b = _broker(mesh=mesh)
+    eng, inj, alarms, fl = _rig(b, tmp_path)
+    tel = b.router.telemetry
+    dt = b.router.device_table
+    topics = [f"room/{i % 4}/s{i}" for i in range(8)]
+    sync = _sync_counts(b, topics)
+
+    victim = 2
+    inj.fail_sticky(shards=[victim])
+    for wave in range(eng.breaker_threshold + 4):
+        counts = await _gather_counts(
+            eng, [f"{t}w{wave}" for t in topics]
+        )
+        assert all(c == 3 for c in counts), f"wave {wave}: {counts}"
+        if victim in eng.open_shards:
+            break
+    assert victim in eng.open_shards, "shard breaker did not trip"
+    # chip-granular: the WHOLE breaker never moved
+    assert eng.breaker_state == "closed"
+    assert not b.router.device_suspended
+    assert tel.counters.get("breaker_trips_total", 0) == 0
+    # evacuated: survivor mesh serves the whole table
+    assert dt.lost_shards == {victim} and dt.n_shards == 3
+    assert tel.counters["breaker_shard_trips_total"] == 1
+    assert tel.counters["breaker_shard_evacuations_total"] == 1
+    assert alarms.is_active("xla_device_breaker")
+    assert fl.triggers_total.get("device_breaker_trip", 0) == 1
+
+    # N-1 device service: batches still dispatch, answers == oracle
+    batches0 = tel.counters.get("dispatch_batches_total", 0)
+    counts = await _gather_counts(eng, topics)
+    assert counts == sync
+    assert tel.counters.get("dispatch_batches_total", 0) > batches0
+    b.sentinel.run_audits()
+    assert tel.counters.get("audit_divergence_total", 0) == 0
+
+    # probes FAIL while the chip is sticky-lost
+    deadline = time.monotonic() + 2.0
+    while (
+        tel.counters.get("breaker_probe_failures_total", 0) < 1
+        and time.monotonic() < deadline
+    ):
+        await asyncio.sleep(0.01)
+    assert tel.counters.get("breaker_probe_failures_total", 0) >= 1
+    assert victim in eng.open_shards
+
+    # heal -> probe -> rebalance back to N -> verified close
+    inj.heal()
+    deadline = time.monotonic() + 10.0
+    while eng.open_shards:
+        assert time.monotonic() < deadline, "shard never recovered"
+        await asyncio.sleep(0.01)
+    assert dt.lost_shards == set() and dt.n_shards == 4
+    assert tel.counters["breaker_shard_recoveries_total"] == 1
+    assert not alarms.is_active("xla_device_breaker")
+    counts = await _gather_counts(eng, topics)
+    assert counts == sync
+    b.sentinel.run_audits()
+    assert tel.counters.get("audit_divergence_total", 0) == 0
+    st = eng.status()["shard_breaker"]
+    assert st["open_shards"] == [] and st["lost_shards"] == []
+    assert st["trips"] == 1 and st["recoveries"] == 1
+    await eng.stop()
+
+
+def test_injector_shard_scoping_and_seeding():
+    """Shard-targeted programming + deterministic seeding: faults fire
+    only while a target shard is still in the mesh, errors carry the
+    shard attribution, the probe leg ignores lost_shards, and two
+    injectors with the same seed replay identical schedules."""
+    import jax
+
+    from emqx_tpu.chaos.faults import SHARD_PROBE_LEG
+    from emqx_tpu.models.router import Router
+
+    mesh = mesh_mod.make_mesh(n_dp=1, n_sub=4, devices=jax.devices()[:4])
+    r = Router(mesh=mesh)
+    r.add_route("room/1/+", "c1")
+    r.device_table.sync()
+    inj = DeviceFaultInjector(seed=7).install(r)
+    inj.fail_sticky(shards=[2])
+    with pytest.raises(DeviceLostError) as ei:
+        inj.check("match_begin")
+    assert ei.value.shard == 2
+    # a shard-scoped probe of a NON-target chip passes
+    inj.check(SHARD_PROBE_LEG, shard=1)
+    with pytest.raises(DeviceLostError):
+        inj.check(SHARD_PROBE_LEG, shard=2)
+    # evacuating the target makes mesh-wide legs dormant (the chip is
+    # out of the mesh) while the direct probe keeps failing
+    assert r.device_table.evacuate_shard(2)
+    inj.check("match_begin")
+    inj.check("sync")
+    with pytest.raises(DeviceLostError):
+        inj.check(SHARD_PROBE_LEG, shard=2)
+    r.device_table.restore_shard(2)
+    with pytest.raises(DeviceLostError):
+        inj.check("match_finish")
+    inj.heal()
+    # per-(leg,shard) ledger fed the labeled scrape family
+    assert inj.injected.get(("match_begin", "2"), 0) >= 1
+    st = inj.status()
+    assert st["seed"] == 7 and st["injected"]
+    # seeded schedules replay bit-identically
+    a, bni = DeviceFaultInjector(seed=3), DeviceFaultInjector(seed=3)
+    a.fail_random(0.5)
+    bni.fail_random(0.5)
+    seq_a, seq_b = [], []
+    for _ in range(64):
+        try:
+            a.check("match_begin")
+            seq_a.append(0)
+        except TransientDeviceError:
+            seq_a.append(1)
+        try:
+            bni.check("match_begin")
+            seq_b.append(0)
+        except TransientDeviceError:
+            seq_b.append(1)
+    assert seq_a == seq_b and sum(seq_a) > 0
+    assert a.pick_shard(8) == bni.pick_shard(8)
+    inj.uninstall()
+
+
+# --- admission control (single-device AND sharded brokers) ----------------
+
+
+def _mesh_or_none(kind):
+    if kind == "single":
+        return None
+    return mesh_mod.make_mesh(n_dp=2, n_sub=4)
+
+
+@pytest.mark.parametrize("kind", ["single", "sharded"])
+async def test_shed_policy_bounds_queue_and_alarms(tmp_path, kind):
+    b = _broker(n=5, mesh=_mesh_or_none(kind))
     eng, _inj, alarms, _fl = _rig(
         b, tmp_path, sentinel=False, queue_depth=64, deadline_ms=50.0,
         queue_max_depth=4, queue_policy="shed",
@@ -312,8 +455,9 @@ async def test_shed_policy_bounds_queue_and_alarms(tmp_path):
     await eng.stop()
 
 
-async def test_block_policy_bounded_and_complete(tmp_path):
-    b = _broker(n=5)
+@pytest.mark.parametrize("kind", ["single", "sharded"])
+async def test_block_policy_bounded_and_complete(tmp_path, kind):
+    b = _broker(n=5, mesh=_mesh_or_none(kind))
     eng, _inj, _alarms, _fl = _rig(
         b, tmp_path, sentinel=False, queue_depth=2, deadline_ms=0.2,
         queue_max_depth=4, queue_policy="block", queue_deadline_ms=5000,
@@ -335,8 +479,9 @@ async def test_block_policy_bounded_and_complete(tmp_path):
     await eng.stop()
 
 
-async def test_block_policy_deadline_fails_waiters(tmp_path):
-    b = _broker(n=5)
+@pytest.mark.parametrize("kind", ["single", "sharded"])
+async def test_block_policy_deadline_fails_waiters(tmp_path, kind):
+    b = _broker(n=5, mesh=_mesh_or_none(kind))
     eng, _inj, _alarms, _fl = _rig(
         b, tmp_path, sentinel=False, queue_depth=1024,
         deadline_ms=60_000.0, queue_max_depth=1, queue_policy="block",
@@ -508,3 +653,58 @@ async def test_device_scenarios_under_storm_sharded(tmp_path):
     await _device_scenarios_under_storm(
         tmp_path, mesh=mesh_mod.make_mesh(n_dp=2, n_sub=4)
     )
+
+
+async def _shard_scenario_under_storm(tmp_path, sc):
+    """One chip-granular scenario against a live storm on an 8-way
+    (1,8) mesh: single-shard loss evacuates without suspending the
+    table, flapping chips recover every cycle, and planned reshard
+    cycles stay divergence-free."""
+    from emqx_tpu.chaos import ChaosEngine
+
+    eng = await ChaosEngine.standalone(
+        sessions=200,
+        data_dir=str(tmp_path),
+        mesh=mesh_mod.make_mesh(n_dp=1, n_sub=8),
+        groups=40,
+        sample_n=1,
+        storm_chunk=32,
+        detect_rounds=6,
+        detect_burst=16,
+        chaos_filters=2,
+        chaos_fan=4,
+        settle_timeout=8.0,
+    )
+    try:
+        await eng.setup()
+        eng.storm_start()
+        res = await sc.run(eng)
+        assert res.ok, (sc.name, [
+            (c.name, c.detail) for c in res.checks if not c.ok
+        ])
+        await eng.storm_stop()
+        assert eng.storm_errors == 0
+        sweep = await eng.audit_sweep()
+        assert sweep["silent_divergences"] == 0
+    finally:
+        await eng.close()
+
+
+async def test_chip_loss_under_storm(tmp_path):
+    from emqx_tpu.chaos.scenarios import ChipLoss
+
+    await _shard_scenario_under_storm(tmp_path, ChipLoss())
+
+
+async def test_chip_flap_under_storm(tmp_path):
+    from emqx_tpu.chaos.scenarios import ChipFlap
+
+    # one full lose->recover cycle keeps this inside the tier-1 async
+    # budget; multi-cycle flapping runs in the slow soak catalog
+    await _shard_scenario_under_storm(tmp_path, ChipFlap(cycles=1))
+
+
+async def test_reshard_churn_under_storm(tmp_path):
+    from emqx_tpu.chaos.scenarios import ReshardChurn
+
+    await _shard_scenario_under_storm(tmp_path, ReshardChurn())

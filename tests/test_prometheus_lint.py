@@ -482,3 +482,82 @@ async def test_transfer_and_warmup_families_lint():
         ("emqx_xla_recompiles_at_serve_total", "counter"),
     ):
         assert types.get(fam) == kind, f"{fam}: {types.get(fam)}"
+
+
+def test_shard_fault_and_failover_families_lint():
+    """ISSUE-11 families: the shard-scoped injector's LABELED counter
+    (emqx_xla_fault_injected_total{leg,shard}) and the shard
+    failure-domain counters/gauges must render on a real driven scrape
+    — injected shard faults, a suspend/overlay/resume cycle, and a
+    live evacuate/rebalance on an N-1 mesh — and pass the same lint."""
+    import jax
+
+    from emqx_tpu.chaos.faults import DeviceFaultInjector, DeviceLinkError
+    from emqx_tpu.parallel import mesh as mesh_mod
+
+    mesh = mesh_mod.make_mesh(n_dp=1, n_sub=4, devices=jax.devices()[:4])
+    broker = Broker(mesh=mesh)
+    for i in range(4):
+        s, _ = broker.open_session(f"c{i}", clean_start=True)
+        s.outgoing_sink = lambda pkts: None
+        broker.subscribe(s, f"q/{i}/+", SubOpts(qos=0))
+    r = broker.router
+    topics = [f"q/{i}/v" for i in range(4)]
+    r.match_filters_batch(topics)  # warm device path
+
+    # shard-targeted faults feed the labeled ledger deterministically
+    inj = DeviceFaultInjector(seed=11).install(r)
+    inj.fail_transient(2, legs=("match_begin",), shards=[1])
+    for _ in range(2):
+        try:
+            inj.check("match_begin")
+        except DeviceLinkError:
+            pass
+    inj.fail_sticky(shards=[2])
+    try:
+        inj.check("sync")
+    except DeviceLinkError:
+        pass
+    inj.heal()
+
+    # suspend one shard (host overlay serves its slice), then run a
+    # real evacuate -> N-1 device serve -> rebalance-back cycle
+    assert r.suspend_shard(0)
+    r.match_filters_batch(topics)
+    r.resume_shard(0)
+    assert r.evacuate_shard(1)
+    r.match_filters_batch(topics)
+    assert r.rebalance_shard(1)
+
+    text = prometheus_text(broker, "n1@host")
+    types = _lint(text)
+    for fam, kind in (
+        ("emqx_xla_fault_injected_total", "counter"),
+        ("emqx_xla_chaos_device_faults_total", "counter"),
+        ("emqx_xla_shard_suspends_total", "counter"),
+        ("emqx_xla_shard_resumes_total", "counter"),
+        ("emqx_xla_shard_overlay_total", "counter"),
+        ("emqx_xla_shard_evacuations_total", "counter"),
+        ("emqx_xla_shard_rebalances_total", "counter"),
+        ("emqx_xla_shards_suspended", "gauge"),
+        ("emqx_xla_shards_lost", "gauge"),
+        ("emqx_xla_mesh_shards", "gauge"),
+    ):
+        assert types.get(fam) == kind, f"{fam}: {types.get(fam)}"
+    # the labeled samples carry per-(leg,shard) attribution
+    assert re.search(
+        r'emqx_xla_fault_injected_total\{node="n1@host",'
+        r'leg="match_begin",shard="1"\} 2(\.0)?$',
+        text,
+        re.M,
+    ), text
+    assert re.search(
+        r'emqx_xla_fault_injected_total\{node="n1@host",'
+        r'leg="sync",shard="2"\} 1(\.0)?$',
+        text,
+        re.M,
+    )
+    # full mesh restored by the end of the drive
+    m = re.search(r'emqx_xla_mesh_shards\{node="n1@host"\} (\d+)', text)
+    assert m and int(m.group(1)) == 4
+    assert re.search(r'emqx_xla_shards_lost\{node="n1@host"\} 0', text)

@@ -282,3 +282,139 @@ def test_sharded_100k_routes_churn_growth_oracle():
         r.add_routes(more[i : i + 1000])
     check(topics + [f"g2/5/z{5 + 313 * k}/a/b" for k in range(8)])
     assert len(r.index) > 100_000
+
+
+# --- shard failure domain: padded N-1 meshes + live evacuation ---------
+
+
+def _oracle_check(r, topics, tag):
+    got = r.match_filters_finish(r.match_filters_begin(topics))
+    for t, g in zip(topics, got):
+        want = sorted(r.match_filters(t))
+        assert sorted(g) == want, (tag, t, sorted(g), want)
+
+
+def _churn_pairs(n=300):
+    pairs = [(f"a/{i}/+", f"s{i}") for i in range(n)]
+    pairs += [("b/#", "sb"), ("exact/topic/x", "sx"), ("c/+/d", "scd")]
+    return pairs
+
+
+_CHURN_TOPICS = [f"a/{i}/z" for i in range(0, 300, 7)] + [
+    "b/q/w", "exact/topic/x", "c/9/d", "no/match/here",
+]
+
+
+def test_non_divisible_mesh_serves_pow2_capacity():
+    """shard_rows ceil-pads: a 3-way sub split must serve a pow2
+    table (512 rows / 1024 buckets do NOT divide by 3) with trailing
+    inert pad rows/slots — the layout every N-1 survivor mesh runs."""
+    from emqx_tpu.models.router import Router
+
+    mesh = mesh_mod.make_mesh(n_dp=1, n_sub=3, devices=jax.devices()[:3])
+    assert mesh_mod.shard_rows(512, mesh) == 171  # ceil, not floor
+    r = Router(mesh=mesh)
+    r.add_routes(_churn_pairs())
+    r.device_table.sync()
+    _oracle_check(r, _CHURN_TOPICS, "mesh(1,3)")
+    # churn on the padded layout: deltas target logical ids
+    r.delete_routes([(f"a/{i}/+", f"s{i}") for i in range(7)])
+    r.add_routes([(f"p/{i}/+", f"p{i}") for i in range(23)])
+    r.device_table.sync()
+    _oracle_check(
+        r, _CHURN_TOPICS + [f"p/{i}/q" for i in range(23)],
+        "mesh(1,3) churn",
+    )
+
+
+def test_evacuate_restore_oracle_exact(mesh8):
+    """Live evacuation on the (2,4) mesh: losing sub column 1 drops a
+    whole device COLUMN (2 chips), the survivor mesh serves the full
+    table bit-identically, churn lands while degraded, and restore
+    rebuilds the original layout."""
+    from emqx_tpu.models.router import Router
+
+    r = Router(mesh=mesh8)
+    r.add_routes(_churn_pairs())
+    r.device_table.sync()
+    dt = r.device_table
+    _oracle_check(r, _CHURN_TOPICS, "pre")
+    assert dt.n_shards == 4 and dt.shard_gen == 0
+
+    assert r.evacuate_shard(1)
+    assert dt.lost_shards == {1}
+    assert dt.n_shards == 3 and dt.shard_gen == 1
+    _oracle_check(r, _CHURN_TOPICS, "N-1")
+    # churn while degraded: adds + deletes flow through the survivor
+    # mesh's delta scatter
+    r.add_routes([(f"deg/{i}", f"d{i}") for i in range(40)])
+    r.delete_routes([(f"a/{i}/+", f"s{i}") for i in range(5)])
+    dt.sync()
+    _oracle_check(
+        r, [f"deg/{i}" for i in range(40)] + _CHURN_TOPICS, "N-1 churn"
+    )
+
+    assert r.rebalance_shard(1)
+    assert not dt.lost_shards and dt.n_shards == 4
+    assert dt.shard_gen == 2
+    _oracle_check(r, _CHURN_TOPICS, "restored")
+    # idempotence + validation edges
+    assert not r.rebalance_shard(1)  # not lost
+    assert not r.evacuate_shard(99)  # out of range
+
+
+def test_evacuate_last_survivor_refused(mesh8):
+    from emqx_tpu.models.router import Router
+
+    r = Router(mesh=mesh8)
+    r.add_routes(_churn_pairs(20))
+    r.device_table.sync()
+    for s in range(3):
+        assert r.evacuate_shard(s)
+    with pytest.raises(RuntimeError, match="no survivor"):
+        r.device_table.evacuate_shard(3)
+    _oracle_check(r, _CHURN_TOPICS[:10], "single survivor")
+    for s in range(3):
+        assert r.rebalance_shard(s)
+    assert r.device_table.n_shards == 4
+    _oracle_check(r, _CHURN_TOPICS[:10], "restored from 1")
+
+
+def test_suspend_shard_overlay_serves_host_truth(mesh8):
+    """A suspended shard's slice is corrected from host truth by the
+    finish overlay while the other shards' answers pass through — and
+    the whole table is never host-degraded."""
+    from emqx_tpu.models.router import Router
+
+    r = Router(mesh=mesh8)
+    r.add_routes(_churn_pairs())
+    r.device_table.sync()
+    tel = r.telemetry
+    assert r.suspend_shard(2)
+    assert not r.suspend_shard(2)  # idempotent
+    assert not r.device_suspended
+    _oracle_check(r, _CHURN_TOPICS, "overlay")
+    assert tel.counters.get("shard_overlay_total", 0) > 0
+    r.resume_shard(2)
+    assert not r._suspended_shards
+    _oracle_check(r, _CHURN_TOPICS, "resumed")
+
+
+def test_shard_ownership_maps_cover_row_and_slot(mesh8):
+    from emqx_tpu.models.router import Router
+
+    r = Router(mesh=mesh8)
+    r.add_routes(_churn_pairs())
+    r.device_table.sync()
+    dt = r.device_table
+    n_sub = 4
+    for f in ("a/7/+", "b/#", "exact/topic/x"):
+        owners = r._shard_owners(f)
+        assert owners, f
+        assert all(0 <= s < n_sub for s in owners), (f, owners)
+    # a host-resident (never-added) filter has no device owner
+    assert r._shard_owners("not/a/route") == set()
+    # every row maps into range under the padded layout
+    cap = r.table.capacity
+    assert dt.shard_of_row(0) == 0
+    assert dt.shard_of_row(cap - 1) == n_sub - 1
