@@ -281,6 +281,27 @@ class Node:
                 self.durable_db, state_dir=ds_dir
             )
             broker.enable_durable(self.durable_mgr)
+            # boot-side crash recovery: the Db open above already
+            # replayed every shard WAL (CRC-verified, torn tails cut)
+            # and the manager resumed durable sessions at their
+            # committed positions. Compact any bloated WAL now so the
+            # NEXT restart's replay stays bounded, then surface what
+            # recovery found.
+            compacted = self.durable_db.maybe_compact()
+            self.ds_recovery = {
+                "db": self.durable_db.recovery_report(),
+                "sessions": self.durable_mgr.recovery_report(),
+                "compacted_shards": compacted,
+            }
+            rep = self.ds_recovery["db"]
+            log.info(
+                "durable tier recovered: %d shard(s) in %.1fms, "
+                "%d session(s) resumed%s",
+                len(rep["shards"]),
+                rep["open_ms"],
+                self.ds_recovery["sessions"]["sessions"],
+                f", compacted {compacted}" if compacted else "",
+            )
 
         # 6. observability ($SYS, alarms, traces, slow subs, prometheus)
         from .obs import Observability
@@ -303,6 +324,26 @@ class Node:
                 st.slo_publish_ms,
             )
 
+        # 6b. durable-tier failure domain: a shard fail-stop (failed
+        # fsync / ENOSPC / EIO) raises the ds_shard_failed alarm and
+        # snapshots a flight bundle; recovery clears the alarm
+        if self.durable_db is not None:
+            obs = self.obs
+
+            def _on_shard_failed(shard_id: int, exc: BaseException) -> None:
+                obs.alarms.ensure(
+                    f"ds_shard_failed_{shard_id}",
+                    details={"shard": shard_id, "error": str(exc)},
+                    message=f"durable shard {shard_id} fail-stopped: {exc}",
+                )
+                if obs.flight is not None:
+                    obs.flight.maybe_trigger(
+                        "ds_shard_failed",
+                        {"shard": shard_id, "error": str(exc)},
+                    )
+
+            self.durable_db.storage.on_shard_failed = _on_shard_failed
+
         # 7. cluster membership + DS replication
         seeds = cfg.get("cluster.static_seeds")
         if seeds or cfg.get("cluster.discovery_strategy") == "static":
@@ -322,6 +363,16 @@ class Node:
                 from .ds.replication import ReplicatedDs
 
                 self.replicator = ReplicatedDs(node, self.durable_mgr)
+                # reboot catch-up: entries the cluster committed while
+                # this node was down exist only on the peers — pull
+                # them before serving (best-effort: no peers yet on a
+                # cold cluster boot is fine, adverts gap-heal later)
+                caught = await self.replicator.catch_up()
+                if caught:
+                    log.info(
+                        "DS replication caught up %d entr%s from peers",
+                        caught, "y" if caught == 1 else "ies",
+                    )
 
         # 7b. chaos scenario engine (emqx_tpu/chaos) — ARMED, not run:
         # the engine binds to this node's broker/cluster/sentinel so an

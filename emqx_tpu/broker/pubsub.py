@@ -150,6 +150,7 @@ class Broker:
             self.durable is not None
             and cfg is not None
             and cfg.session_expiry_interval > 0
+            and cfg.durable is not False
         ):
             # an existing LIVE session under this id must be torn down
             # first or its routes leak and deliveries double up (the

@@ -27,6 +27,11 @@ class SessionConfig:
     retry_interval: float = 30.0
     session_expiry_interval: float = 0.0  # 0 = ends with connection
     upgrade_qos: bool = False
+    # durable-session routing override (the per-zone
+    # `durable_sessions.enable` analog): None = auto (nonzero expiry
+    # becomes durable when a DS manager is attached), False = stay a
+    # live in-memory session regardless of expiry
+    durable: Optional[bool] = None
     # mqueue priorities (emqx_mqueue.erl): exact topic -> 1..255,
     # higher drains first; store_qos0=False drops queued QoS0 while
     # the client is disconnected

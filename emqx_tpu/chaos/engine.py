@@ -25,6 +25,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import os
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
@@ -70,6 +71,10 @@ class SessionFleet:
             session_expiry_interval=session_expiry_s,
             max_mqueue_len=16,
             mqueue_store_qos0=False,
+            # the storm fleet stays in the live router even when the
+            # durable tier is attached: a million DS sessions is a
+            # different experiment than a million live ones
+            durable=False,
         )
         self.sink = _noop_sink
         self.clients: List[str] = []
@@ -193,6 +198,8 @@ class ChaosEngine:
         settle_timeout: float = 10.0,
         breaker_threshold: int = 3,
         probe_backoff_ms: float = 50.0,
+        durable_sessions: int = 8,
+        data_dir: Optional[str] = None,
         progress: Optional[Callable[[str], None]] = None,
     ) -> None:
         self.broker = broker
@@ -215,6 +222,20 @@ class ChaosEngine:
         self.probe_backoff_ms = probe_backoff_ms
         # device-link fault seam (chaos/faults.py), installed at setup
         self.injector = None
+        # durable tier (emqx_tpu/ds): a small QoS1 fleet persisted
+        # through the WAL-backed store, plus the disk fault seam the
+        # crash-consistency scenarios drive. Opened at setup when a
+        # data_dir exists; survives ds_kill()/ds_reboot() cycles.
+        self.durable_sessions = durable_sessions
+        self.data_dir = data_dir
+        self.durable_db = None
+        self.durable_mgr = None
+        self.disk_injector = None
+        self.ds_recovery: Dict[str, Any] = {}
+        self.ds_shard_failures: List[tuple] = []  # (ts, shard, errname)
+        self.dur_published = 0
+        self.dur_delivered = 0
+        self._dur_reboots = 0
         self.progress = progress or (lambda msg: log.info("%s", msg))
 
         self.fleet = SessionFleet(broker, "s", sessions, groups=groups)
@@ -273,7 +294,7 @@ class ChaosEngine:
     # --- setup ------------------------------------------------------------
 
     async def setup(self) -> None:
-        from .faults import DeviceFaultInjector
+        from .faults import DeviceFaultInjector, DiskFaultInjector
 
         t0 = time.monotonic()
         if self.broker.engine is None:
@@ -290,6 +311,11 @@ class ChaosEngine:
         # the XLA-boundary fault seam the device scenarios drive;
         # healthy cost is one falsy test per device leg
         self.injector = DeviceFaultInjector().install(self.router)
+        # the disk-IO fault seam (ds/diskio.py) the durable-tier
+        # scenarios drive; healthy cost is one falsy module read per op
+        self.disk_injector = DiskFaultInjector(seed=self.seed).install()
+        if self.data_dir is not None and self.durable_sessions > 0:
+            self._open_durable(first=True)
         st = self.sentinel
         st.sample_n = self.sample_n
         st.on_divergence.append(
@@ -571,6 +597,163 @@ class ChaosEngine:
             if not self.alarms.is_active("xla_audit_divergence"):
                 break
 
+    # --- durable tier -----------------------------------------------------
+
+    def _open_durable(self, first: bool) -> None:
+        """Open (or re-open after ds_kill) the durable tier from
+        `data_dir`: the WAL-backed message DB, the durable session
+        manager with its persist gate, the fail-stop wiring, and the
+        QoS1 mini-fleet on `dur/<k>/+`. On reboot (`first=False`) this
+        IS the boot-side recovery path: shard WALs replay CRC-verified,
+        sessions resume at their committed positions (at-least-once),
+        and the ps-routes rebuild from their subscriptions."""
+        from ..ds.api import Db
+        from ..ds.session_ds import DurableSessionManager
+
+        ds_dir = os.path.join(self.data_dir, "ds")
+        t0 = time.monotonic()
+        self.durable_db = Db(
+            "chaos-messages", data_dir=ds_dir, n_shards=2,
+            buffer_flush_ms=5,
+        )
+        self.durable_db.storage.on_shard_failed = self._on_shard_failed
+        self.durable_mgr = DurableSessionManager(
+            self.durable_db, state_dir=ds_dir
+        )
+        self.broker.enable_durable(self.durable_mgr)
+        # recovery wall-time is bounded by replay cost: compact any
+        # shard whose WAL bloated past the ratio while we were down
+        compacted = self.durable_db.maybe_compact()
+        cfg = SessionConfig(
+            session_expiry_interval=3600.0, max_mqueue_len=512
+        )
+        for k in range(self.durable_sessions):
+            s, _present = self.broker.open_session(
+                f"dur-{k}", clean_start=first, cfg=cfg
+            )
+            self.broker.subscribe(s, f"dur/{k}/+", SubOpts(qos=1))
+        self.ds_recovery = {
+            "open_ms": round((time.monotonic() - t0) * 1e3, 2),
+            "db": self.durable_db.recovery_report(),
+            "sessions": self.durable_mgr.recovery_report(),
+            "compacted_shards": compacted,
+            "reboots": self._dur_reboots,
+        }
+
+    def _on_shard_failed(self, shard_id: int, exc: BaseException) -> None:
+        """Fail-stop fan-out (called OUTSIDE the shard lock, possibly
+        from the buffer flush thread): page + freeze forensics."""
+        self.ds_shard_failures.append(
+            (time.monotonic(), shard_id, type(exc).__name__)
+        )
+        self.alarms.ensure(
+            f"ds_shard_failed_{shard_id}",
+            details={"shard": shard_id, "error": str(exc)},
+            message=f"durable shard {shard_id} fail-stopped: {exc}",
+        )
+        fl = self.flight
+        if fl is not None:
+            fl.maybe_trigger(
+                "ds_shard_failed",
+                {"shard": shard_id, "error": str(exc)},
+            )
+
+    async def durable_publish(self, n: int = 8) -> List[bytes]:
+        """Publish `n` QoS1 messages into the durable tier through the
+        broker publish path (the persist gate stores them), then flush
+        the DS buffer so the batch reaches the WAL fsynced — i.e.
+        acked-durable. Returns the unique payloads (the loss-accounting
+        ledger). The flush raises ShardFailedError when the target
+        shard fail-stops under an injected disk fault."""
+        payloads: List[bytes] = []
+        groups = max(1, self.durable_sessions)
+        base = self.dur_published
+        for i in range(n):
+            self._chaos_seq += 1
+            p = f"dur{self._chaos_seq}".encode()
+            self.broker.publish(
+                Message(
+                    topic=f"dur/{(base + i) % groups}/m{self._chaos_seq}",
+                    payload=p,
+                    qos=1,
+                )
+            )
+            payloads.append(p)
+        self.dur_published += n
+        self.durable_db.buffer.flush_now()
+        await asyncio.sleep(0)
+        return payloads
+
+    async def durable_drain(self, rounds: int = 64) -> List[bytes]:
+        """Pump every durable session and puback everything delivered,
+        committing stream positions (the consumed ledger). Returns the
+        delivered payloads."""
+        got: List[bytes] = []
+        mgr = self.durable_mgr
+        for _ in range(rounds):
+            new = 0
+            for s in list(mgr.sessions.values()):
+                if not s.client_id.startswith("dur-"):
+                    continue
+                s.connected = True
+                for pkt in mgr.pump(s):
+                    got.append(bytes(pkt.payload))
+                    if pkt.packet_id:
+                        s.on_puback(pkt.packet_id)
+                    new += 1
+            if new == 0:
+                break
+            await asyncio.sleep(0)
+        self.dur_delivered += len(got)
+        return got
+
+    async def ds_recover(self) -> List[int]:
+        """Probe-verified recovery of every fail-stopped shard: reopen
+        + replay + write/fsync/read-back probe; a shard's alarm clears
+        only when its probe passes."""
+        ok: List[int] = []
+        for sid in list(self.durable_db.failed_shards()):
+            if self.durable_db.recover_shard(sid):
+                ok.append(sid)
+                self.alarms.ensure_deactivated(f"ds_shard_failed_{sid}")
+        return ok
+
+    def ds_kill(self) -> None:
+        """Simulated SIGKILL of the durable tier: unflushed buffer
+        dropped (it was never acked durable), no fsync boundary on the
+        WALs, persist gate detached, session objects lost with the
+        process. The data dir survives for ds_reboot()."""
+        mgr, db = self.durable_mgr, self.durable_db
+        if mgr is None:
+            return
+        self.broker.hooks.delete("message.publish", mgr._persist_gate)
+        self.broker.durable = None
+        mgr.kill()
+        db.kill()
+        for cid in [
+            c for c in self.broker.sessions if c.startswith("dur-")
+        ]:
+            self.broker.sessions.pop(cid, None)
+            self.broker.router.dest_store.note_session(cid, None)
+        self.durable_mgr = None
+        self.durable_db = None
+
+    async def ds_reboot(self) -> float:
+        """Boot-side crash recovery from the surviving data dir: WAL
+        replay (CRC-verified, torn tail truncated), durable sessions
+        resumed at committed positions, ps-routes rebuilt. Returns
+        recovery wall-time ms."""
+        from ..ds.metrics import DS_METRICS
+
+        t0 = time.monotonic()
+        self._dur_reboots += 1
+        self._open_durable(first=False)
+        ms = (time.monotonic() - t0) * 1e3
+        self.ds_recovery["recovery_ms"] = round(ms, 2)
+        DS_METRICS.gauge("recovery_last_ms", ms)
+        await asyncio.sleep(0)
+        return ms
+
     # --- the soak ---------------------------------------------------------
 
     async def run(
@@ -603,6 +786,10 @@ class ChaosEngine:
                 if sc.needs_mesh and getattr(
                     self.router.device_table, "mesh", None
                 ) is None:
+                    continue
+                if getattr(sc, "needs_durable", False) and (
+                    self.durable_db is None
+                ):
                     continue
                 self.progress(f"scenario: {sc.name}")
                 res = await sc.run(self)
@@ -777,6 +964,36 @@ class ChaosEngine:
                 "victim_sessions_at_end": len(self.victim.broker.sessions),
                 "cluster_routes_main": len(self.node._cluster_pairs),
             }
+        if self.durable_db is not None:
+            from ..ds.metrics import DS_METRICS
+
+            dsnap = DS_METRICS.snapshot()
+            row["ds"] = {
+                # crash-consistency ledger: the kill→reboot→recover
+                # walk plus the process-global WAL/shard counters
+                "recovery": self.ds_recovery,
+                "reboots": self._dur_reboots,
+                "durable_published": self.dur_published,
+                "durable_delivered": self.dur_delivered,
+                "shard_failures": len(self.ds_shard_failures),
+                "failed_at_end": self.durable_db.failed_shards(),
+                "wal_replayed_records": dsnap.get(
+                    "wal_replayed_records_total", 0
+                ),
+                "wal_torn_records": dsnap.get("wal_torn_records_total", 0),
+                "wal_crc_failures": dsnap.get("wal_crc_failures_total", 0),
+                "wal_upgraded_files": dsnap.get(
+                    "wal_upgraded_files_total", 0
+                ),
+                "shard_fail_stops": dsnap.get("shard_failures_total", 0),
+                "shard_recoveries": dsnap.get("shard_recoveries_total", 0),
+                "recovery_last_ms": dsnap.get("recovery_last_ms", 0.0),
+                "disk_faults_injected": (
+                    dict(sorted(self.disk_injector.injected.items()))
+                    if self.disk_injector is not None
+                    else {}
+                ),
+            }
         return row
 
     # --- builders / teardown ----------------------------------------------
@@ -803,7 +1020,7 @@ class ChaosEngine:
             trace_dir=f"{base}/trace",
             flight_dir=f"{base}/flight",
         )
-        return cls(broker, obs, sessions=sessions, **kw)
+        return cls(broker, obs, sessions=sessions, data_dir=base, **kw)
 
     @classmethod
     async def cluster(
@@ -857,6 +1074,7 @@ class ChaosEngine:
             victim_obs=vobs,
             sessions=sessions,
             victim_sessions=victim_sessions,
+            data_dir=base,
             **kw,
         )
 
@@ -865,6 +1083,21 @@ class ChaosEngine:
         eng = self.broker.engine
         if eng is not None and not eng.closed:
             await eng.stop()
+        if self.disk_injector is not None:
+            self.disk_injector.heal()
+            self.disk_injector.uninstall()
+        if self.durable_mgr is not None:
+            try:
+                self.durable_mgr.close()
+            except Exception:
+                log.exception("durable manager close failed")
+            self.durable_mgr = None
+        if self.durable_db is not None:
+            try:
+                self.durable_db.close()
+            except Exception:
+                log.exception("durable db close failed")
+            self.durable_db = None
         for node in (self.victim, self.node):
             if node is not None:
                 try:
@@ -885,7 +1118,7 @@ async def run_soak(
     sample_n: int = 64,
     baseline_s: float = 20.0,
     scenarios: Optional[Sequence[str]] = None,
-    report_path: Optional[str] = "SOAK_r08.json",
+    report_path: Optional[str] = "SOAK_r12.json",
     data_dir: Optional[str] = None,
     progress: Optional[Callable[[str], None]] = None,
     strict: bool = True,

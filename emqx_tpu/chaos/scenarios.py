@@ -72,6 +72,7 @@ class Scenario:
     reference = ""  # the reference suite this mirrors (PARITY.md)
     needs_cluster = False
     needs_mesh = False  # requires a ShardedDeviceTable (multi-chip)
+    needs_durable = False  # requires the WAL-backed durable tier
 
     async def run(self, eng) -> ScenarioResult:  # pragma: no cover
         raise NotImplementedError
@@ -1384,6 +1385,460 @@ class NodePurge(Scenario):
         return res
 
 
+class TornWal(Scenario):
+    """Power cut mid-append: the WAL's last record is half-written
+    (torn). Reboot recovery must truncate at the last CRC-verified
+    record — counting the torn frame — serve every previously
+    acked-durable message, and never surface the half record as
+    data."""
+
+    name = "torn_wal"
+    reference = (
+        "RocksDB WAL kPointInTimeRecovery truncation; ra log CRC "
+        "checked replay"
+    )
+    needs_durable = True
+
+    async def run(self, eng) -> ScenarioResult:
+        import os
+
+        from ..ds.metrics import DS_METRICS
+        from .faults import DiskFaultInjector
+
+        res = ScenarioResult(self.name)
+        t0w = time.time()
+        err0 = eng.storm_errors
+        # acked-durable baseline: in the WAL, fsynced, unconsumed
+        pre = await eng.durable_publish(10)
+        snap0 = DS_METRICS.snapshot()
+        n_shards = eng.durable_db.storage.n_shards
+        eng.record_fault(self.name, {"torn_bytes": 7, "shards": n_shards})
+        t_inj = time.monotonic()
+        # the process dies mid-append: kill, then plant the torn tail
+        # (7 bytes of a 12-byte record header) on every shard WAL —
+        # the on-disk state replay must truncate, engine-independent
+        eng.ds_kill()
+        for i in range(n_shards):
+            DiskFaultInjector.tear_tail(
+                os.path.join(
+                    eng.data_dir, "ds", "chaos-messages", f"shard_{i}.kv"
+                )
+            )
+        ms = await eng.ds_reboot()
+        res.detect_ms = round((time.monotonic() - t_inj) * 1e3, 2)
+        res.recovery_ms = round(ms, 2)
+        snap1 = DS_METRICS.snapshot()
+        torn = int(
+            snap1["wal_torn_records_total"] - snap0["wal_torn_records_total"]
+        )
+        res.checks.append(
+            Check(
+                "torn_tail_detected",
+                torn >= n_shards,
+                f"+{torn} torn records counted at replay "
+                f"(one per shard WAL)",
+            )
+        )
+        res.checks.append(
+            Check(
+                "crc_clean",
+                snap1["wal_crc_failures_total"]
+                == snap0["wal_crc_failures_total"],
+                "a torn tail is torn, not a checksum failure",
+            )
+        )
+        res.checks.append(
+            Check(
+                "no_shard_failed",
+                not eng.durable_db.failed_shards(),
+                "replay recovered without fail-stop",
+            )
+        )
+        after = await eng.durable_drain()
+        lost = [p for p in pre if p not in after]
+        res.checks.append(
+            Check(
+                "zero_acked_loss",
+                not lost,
+                f"{len(lost)}/{len(pre)} acked-durable messages lost",
+            )
+        )
+        post = await eng.durable_publish(4)
+        served = await eng.durable_drain()
+        res.checks.append(
+            Check(
+                "post_recovery_serving",
+                set(post) <= set(served),
+                f"{len(served)} delivered after reboot",
+            )
+        )
+        res.checks.append(
+            Check(
+                "zero_publisher_errors",
+                eng.storm_errors == err0,
+                f"{eng.storm_errors - err0} storm chunks failed",
+            )
+        )
+        res.checks.append(_slo_check(eng, t0w))
+        res.extra["acked_before_crash"] = len(pre)
+        return res
+
+
+class DiskFull(Scenario):
+    """The disk fills (sticky ENOSPC on WAL appends) under the live
+    storm: the touched shard must FAIL-STOP — alarm paged, flight
+    bundle frozen, writes refused — while reads keep serving the
+    committed data. Healing the disk, probe-verified recovery reopens
+    the shard and writes flow again."""
+
+    name = "disk_full"
+    reference = (
+        "RocksDB ENOSPC fail-stop (no silent retry); emqx alarm "
+        "`disk_full` discipline"
+    )
+    needs_durable = True
+
+    async def run(self, eng) -> ScenarioResult:
+        from ..ds.metrics import DS_METRICS
+
+        res = ScenarioResult(self.name)
+        t0w = time.time()
+        err0 = eng.storm_errors
+        dinj = eng.disk_injector
+        fires0 = _fires(eng, "ds_shard_failed")
+        eng.reset_flight_cooldown("ds_shard_failed")
+        pre = await eng.durable_publish(8)  # acked before the disk fills
+        r0 = DS_METRICS.snapshot()["shard_recoveries_total"]
+        dinj.fail_sticky(
+            "enospc", legs=("append",), paths=("chaos-messages",)
+        )
+        eng.record_fault(self.name, {"kind": "enospc"})
+        t_inj = time.monotonic()
+        blocked = 0
+        for _ in range(8):
+            try:
+                await eng.durable_publish(4)
+            except OSError:
+                blocked += 1
+            if eng.durable_db.failed_shards():
+                break
+        failed = list(eng.durable_db.failed_shards())
+        res.checks.append(
+            Check(
+                "fail_stop_engaged",
+                bool(failed) and blocked >= 1,
+                f"shards {failed} read-only, {blocked} flushes refused",
+            )
+        )
+        if failed:
+            eng.faults_detected += 1
+            res.detect_ms = round((time.monotonic() - t_inj) * 1e3, 2)
+        alarm = f"ds_shard_failed_{failed[0]}" if failed else ""
+        res.checks.append(
+            Check(
+                "alarm_raised",
+                bool(failed)
+                and (
+                    eng.alarms.is_active(alarm)
+                    or alarm in eng.alarms.fired_since(t0w)
+                ),
+                alarm,
+            )
+        )
+        res.checks.append(
+            Check(
+                "flight_bundle_captured",
+                _fires(eng, "ds_shard_failed") > fires0,
+                "ds_shard_failed trigger fired",
+            )
+        )
+        # read-only degraded service: committed data still pumps
+        served = await eng.durable_drain()
+        res.checks.append(
+            Check(
+                "reads_serve_while_failed",
+                set(pre) <= set(served),
+                f"{len(served)} committed messages delivered read-only",
+            )
+        )
+        # heal -> probe-verified recovery -> alarm clears
+        dinj.heal()
+        recovered = await eng.ds_recover()
+        res.checks.append(
+            Check(
+                "probe_verified_recovery",
+                sorted(recovered) == sorted(failed)
+                and not eng.durable_db.failed_shards(),
+                f"recovered {recovered}",
+            )
+        )
+        if recovered:
+            res.recovery_ms = round((time.monotonic() - t_inj) * 1e3, 2)
+        res.checks.append(
+            Check(
+                "alarm_cleared",
+                not any(
+                    eng.alarms.is_active(f"ds_shard_failed_{s}")
+                    for s in range(eng.durable_db.storage.n_shards)
+                ),
+                "all ds_shard_failed alarms deactivated",
+            )
+        )
+        r1 = DS_METRICS.snapshot()["shard_recoveries_total"]
+        res.checks.append(
+            Check(
+                "recovery_accounted",
+                r1 - r0 >= len(recovered) and len(recovered) >= 1,
+                f"shard_recoveries_total +{int(r1 - r0)}",
+            )
+        )
+        post = await eng.durable_publish(6)
+        served = await eng.durable_drain()
+        res.checks.append(
+            Check(
+                "post_recovery_serving",
+                set(post) <= set(served),
+                f"{len(served)} delivered after recovery",
+            )
+        )
+        res.checks.append(
+            Check(
+                "zero_publisher_errors",
+                eng.storm_errors == err0,
+                f"{eng.storm_errors - err0} storm chunks failed",
+            )
+        )
+        res.checks.append(_slo_check(eng, t0w))
+        return res
+
+
+class FsyncFail(Scenario):
+    """ONE transient fsync failure: the fsyncgate loss mode. The
+    kernel may already have dropped the dirty pages, so the shard must
+    fail-stop on the FIRST failed fsync and refuse writes even though
+    the disk is healthy again one op later — never retry-and-continue.
+    Recovery is only via the probe-verified reopen+replay path."""
+
+    name = "fsync_fail"
+    reference = (
+        "fsyncgate (PostgreSQL 2018): a failed fsync cannot be "
+        "retried; reopen-and-replay is the only safe continuation"
+    )
+    needs_durable = True
+
+    async def run(self, eng) -> ScenarioResult:
+        res = ScenarioResult(self.name)
+        t0w = time.time()
+        err0 = eng.storm_errors
+        dinj = eng.disk_injector
+        fires0 = _fires(eng, "ds_shard_failed")
+        eng.reset_flight_cooldown("ds_shard_failed")
+        pre = await eng.durable_publish(8)
+        # exactly ONE fsync fails; the disk is healthy afterwards
+        dinj.fail_transient(
+            1, kind="fsync", legs=("fsync",), paths=("chaos-messages",)
+        )
+        eng.record_fault(self.name, {"kind": "fsync", "transient": 1})
+        t_inj = time.monotonic()
+        raised = False
+        try:
+            await eng.durable_publish(4)
+        except OSError:
+            raised = True
+        failed = list(eng.durable_db.failed_shards())
+        res.checks.append(
+            Check(
+                "fail_stop_on_first_fsync_failure",
+                raised and bool(failed),
+                f"shards {failed} fail-stopped on one transient fsync",
+            )
+        )
+        if failed:
+            eng.faults_detected += 1
+            res.detect_ms = round((time.monotonic() - t_inj) * 1e3, 2)
+        # the forbidden continuation: disk is healthy NOW, but the
+        # shard must still refuse writes until probe-verified recovery
+        blocked = False
+        try:
+            await eng.durable_publish(2)
+        except OSError:
+            blocked = True
+        res.checks.append(
+            Check(
+                "no_retry_and_continue",
+                blocked and dinj.healthy,
+                "writes refused on healthy disk until recover()",
+            )
+        )
+        alarm = f"ds_shard_failed_{failed[0]}" if failed else ""
+        res.checks.append(
+            Check(
+                "alarm_raised",
+                bool(failed)
+                and (
+                    eng.alarms.is_active(alarm)
+                    or alarm in eng.alarms.fired_since(t0w)
+                ),
+                alarm,
+            )
+        )
+        res.checks.append(
+            Check(
+                "flight_bundle_captured",
+                _fires(eng, "ds_shard_failed") > fires0,
+                "ds_shard_failed trigger fired",
+            )
+        )
+        recovered = await eng.ds_recover()
+        res.checks.append(
+            Check(
+                "probe_verified_recovery",
+                sorted(recovered) == sorted(failed)
+                and not eng.durable_db.failed_shards(),
+                f"recovered {recovered} via reopen+replay+probe",
+            )
+        )
+        if recovered:
+            res.recovery_ms = round((time.monotonic() - t_inj) * 1e3, 2)
+        after = await eng.durable_drain()
+        lost = [p for p in pre if p not in after]
+        res.checks.append(
+            Check(
+                "zero_acked_loss",
+                not lost,
+                f"{len(lost)}/{len(pre)} acked-durable messages lost",
+            )
+        )
+        post = await eng.durable_publish(4)
+        served = await eng.durable_drain()
+        res.checks.append(
+            Check(
+                "post_recovery_serving",
+                set(post) <= set(served),
+                f"{len(served)} delivered after recovery",
+            )
+        )
+        res.checks.append(
+            Check(
+                "zero_publisher_errors",
+                eng.storm_errors == err0,
+                f"{eng.storm_errors - err0} storm chunks failed",
+            )
+        )
+        res.checks.append(_slo_check(eng, t0w))
+        return res
+
+
+class BrokerRestart(Scenario):
+    """SIGKILL → reboot → recover of the durable tier under the live
+    storm. Contract: acked-durable-but-unconsumed messages all survive
+    (zero acked loss), already-consumed messages are NOT redelivered
+    (sessions resume at committed positions), the session fleet and
+    its ps-routes rebuild, and recovery wall-time stays bounded."""
+
+    name = "broker_restart"
+    reference = (
+        "emqx_durable_storage restart recovery: ra log replay / "
+        "RocksDB WAL recovery into emqx_persistent_session_ds resume"
+    )
+    needs_durable = True
+
+    async def run(self, eng) -> ScenarioResult:
+        res = ScenarioResult(self.name)
+        t0w = time.time()
+        err0 = eng.storm_errors
+        # batch A: acked-durable, delivered AND pubacked — the
+        # committed-position ledger the reboot must respect
+        batch_a = await eng.durable_publish(10)
+        consumed = await eng.durable_drain()
+        res.checks.append(
+            Check(
+                "pre_crash_delivery",
+                set(batch_a) <= set(consumed),
+                f"{len(consumed)} delivered+acked before the crash",
+            )
+        )
+        # batch B: acked-durable (WAL-fsynced) but never consumed —
+        # exactly the set a crash must not lose
+        batch_b = await eng.durable_publish(10)
+        eng.record_fault(self.name, {"acked_unconsumed": len(batch_b)})
+        t_inj = time.monotonic()
+        eng.ds_kill()
+        ms = await eng.ds_reboot()
+        res.detect_ms = round((time.monotonic() - t_inj) * 1e3, 2)
+        res.recovery_ms = round(ms, 2)
+        rec = eng.ds_recovery
+        res.checks.append(
+            Check(
+                "recovery_bounded",
+                ms < 30_000,
+                f"reboot replay+resume in {ms:.0f}ms",
+            )
+        )
+        shards = rec["db"]["shards"]
+        res.checks.append(
+            Check(
+                "wal_replayed_clean",
+                sum(s["replayed_records"] for s in shards) > 0
+                and not any(s["failed"] for s in shards),
+                f"{sum(s['replayed_records'] for s in shards)} records "
+                f"replayed across {len(shards)} shards",
+            )
+        )
+        res.checks.append(
+            Check(
+                "sessions_resumed",
+                rec["sessions"]["sessions"] >= eng.durable_sessions
+                and rec["sessions"]["ps_routes"] >= eng.durable_sessions,
+                f"{rec['sessions']['sessions']} sessions, "
+                f"{rec['sessions']['ps_routes']} ps-routes rebuilt",
+            )
+        )
+        after = await eng.durable_drain()
+        lost = [p for p in batch_b if p not in after]
+        res.checks.append(
+            Check(
+                "zero_acked_loss",
+                not lost,
+                f"{len(lost)}/{len(batch_b)} acked-durable messages lost",
+            )
+        )
+        redelivered = [p for p in batch_a if p in after]
+        res.checks.append(
+            Check(
+                "resumed_at_committed_positions",
+                not redelivered,
+                f"{len(redelivered)} consumed messages redelivered",
+            )
+        )
+        batch_c = await eng.durable_publish(6)
+        served = await eng.durable_drain()
+        res.checks.append(
+            Check(
+                "post_recovery_serving",
+                set(batch_c) <= set(served),
+                f"{len(served)} delivered after reboot",
+            )
+        )
+        res.checks.append(
+            Check(
+                "no_failed_shards",
+                not eng.durable_db.failed_shards(),
+                "all shards writable after reboot",
+            )
+        )
+        res.checks.append(
+            Check(
+                "zero_publisher_errors",
+                eng.storm_errors == err0,
+                f"{eng.storm_errors - err0} storm chunks failed",
+            )
+        )
+        res.checks.append(_slo_check(eng, t0w))
+        res.extra["acked_unconsumed"] = len(batch_b)
+        return res
+
+
 def scenario_catalog(cluster: bool = True) -> List[Scenario]:
     """The ordered soak catalog. Destructive cluster scenarios run
     LAST (evacuation/purge consume the victim fleet); corruption runs
@@ -1396,6 +1851,10 @@ def scenario_catalog(cluster: bool = True) -> List[Scenario]:
         ChipLoss(),
         ChipFlap(),
         ReshardChurn(),
+        TornWal(),
+        DiskFull(),
+        FsyncFail(),
+        BrokerRestart(),
         DisconnectTakeover(),
     ]
     if cluster:
@@ -1412,6 +1871,10 @@ CATALOG = [
     ChipLoss.name,
     ChipFlap.name,
     ReshardChurn.name,
+    TornWal.name,
+    DiskFull.name,
+    FsyncFail.name,
+    BrokerRestart.name,
     DisconnectTakeover.name,
     PartitionNodedown.name,
     NodeEvacuation.name,

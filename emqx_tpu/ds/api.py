@@ -134,9 +134,49 @@ class Db:
     def generations(self) -> List[int]:
         return list(self.storage.shards[0].generations)
 
+    # --- crash consistency ----------------------------------------------
+
+    def failed_shards(self) -> List[int]:
+        return self.storage.failed_shards()
+
+    def recover_shard(self, shard_id: int) -> bool:
+        return self.storage.recover_shard(shard_id)
+
+    def maybe_compact(
+        self, ratio: float = 4.0, min_records: int = 1024
+    ) -> List[int]:
+        """WAL-bloat compaction sweep — the knob that bounds replay
+        (and therefore restart-recovery) wall-time."""
+        return self.storage.maybe_compact(ratio, min_records)
+
+    def recovery_report(self) -> Dict[str, object]:
+        """What the WAL replay found at open (plus current health) —
+        surfaced by boot.py after a restart and asserted by the
+        broker_restart scenario."""
+        shards = []
+        for s in self.storage.shards:
+            shards.append(
+                {
+                    "shard": s.shard_id,
+                    "replayed_records": int(s.kv.wal_records()),
+                    "live_keys": int(s.kv.count()),
+                    "torn_records": int(s.kv.torn_records),
+                    "crc_failures": int(s.kv.crc_failures),
+                    "failed": s.failed,
+                }
+            )
+        return {"open_ms": round(self.storage.open_ms, 3), "shards": shards}
+
     def close(self) -> None:
         self.buffer.close()
         self.storage.close()
+
+    def kill(self) -> None:
+        """Simulated SIGKILL teardown: drop in-memory state (pending
+        buffer items included), keep the data dir, skip every graceful
+        close — the state a real crash leaves behind."""
+        self.buffer.kill()
+        self.storage.kill()
 
 
 _DBS: Dict[str, Db] = {}
@@ -158,3 +198,12 @@ def close_db(name: str) -> None:
         db = _DBS.pop(name, None)
     if db is not None:
         db.close()
+
+
+def kill_db(name: str) -> None:
+    """Simulated-SIGKILL variant of close_db: the DB leaves the
+    registry with no fsync boundary and no buffer flush."""
+    with _LOCK:
+        db = _DBS.pop(name, None)
+    if db is not None:
+        db.kill()

@@ -7,9 +7,12 @@ shard; one thread suffices here since flush fans out per shard).
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
-from typing import Callable, Dict, List
+from typing import Callable, Dict, List, Optional
+
+log = logging.getLogger("emqx_tpu.ds.buffer")
 
 
 class DsBuffer:
@@ -45,8 +48,18 @@ class DsBuffer:
             batches = {s: q for s, q in self._pending.items() if q}
             for s in batches:
                 self._pending[s] = []
+        # one shard's fail-stop must not starve the healthy shards'
+        # flushes; the first error still surfaces to a direct caller
+        # (the storage layer already fail-stopped the shard itself)
+        first: Optional[BaseException] = None
         for s, q in batches.items():
-            self.flush_cb(s, q)
+            try:
+                self.flush_cb(s, q)
+            except Exception as exc:
+                if first is None:
+                    first = exc
+        if first is not None:
+            raise first
 
     def _run(self) -> None:
         while not self._stop:
@@ -54,10 +67,26 @@ class DsBuffer:
             self._wake.clear()
             if self._stop:
                 break
-            self.flush_now()
+            try:
+                self.flush_now()
+            except Exception:
+                # the background thread must survive a fail-stopped
+                # shard (its writes are refused until recover())
+                log.exception("background flush failed")
 
     def close(self) -> None:
         self._stop = True
         self._wake.set()
         self._thread.join(timeout=2)
         self.flush_now()
+
+    def kill(self) -> None:
+        """Simulated SIGKILL: stop the flush thread and DROP pending
+        items — unflushed buffer contents were never acknowledged as
+        durable, so a crash is allowed to lose exactly these."""
+        self._stop = True
+        self._wake.set()
+        self._thread.join(timeout=2)
+        with self._lock:
+            for s in self._pending:
+                self._pending[s] = []

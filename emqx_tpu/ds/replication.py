@@ -620,6 +620,39 @@ class ReplicatedDs:
         finally:
             self._pulling.discard(shard)
 
+    async def catch_up(self) -> int:
+        """Boot-side peer catch-up: after a kill→reboot, the local
+        applied frontier is whatever the WAL replay recovered — entries
+        the cluster committed while this node was down exist only on
+        the peers. Pull every shard's committed range above our
+        frontier (from the most advanced peer) and apply it in order
+        before serving. Returns the number of entries applied."""
+        applied_total = 0
+        for shard in range(self.n_shards):
+            with self._mutex:
+                after = self._applied.get(shard, 0)
+            best: List[Tuple[int, list]] = []
+            for _peer, addr in self._peers():
+                try:
+                    entries = await self.node.rpc.call(
+                        addr, "ds", "replay", (shard, after)
+                    )
+                except Exception:
+                    continue
+                if entries and len(entries) > len(best):
+                    best = sorted(entries)
+            applied_any = False
+            with self._mutex:
+                for i, p in best:
+                    if i == self._applied.get(shard, 0) + 1:
+                        self._apply_locked(shard, i, p)
+                        applied_any = True
+                        applied_total += 1
+                self._advance_accepted(shard)
+            if applied_any:
+                self.db._notify()
+        return applied_total
+
     def _handle_tail(self, shard: int, term: int = 0):
         """(applied, [(idx, term, payload) pending in order]) — leader
         catch-up source. `term` is the CALLING leader\'s term and

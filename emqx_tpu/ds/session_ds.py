@@ -420,6 +420,27 @@ class DurableSessionManager:
             self.discard_session(cid)
         return len(dead)
 
+    def recovery_report(self) -> dict:
+        """What boot-side recovery rebuilt: sessions resumed from the
+        state KV (at their committed positions — at-least-once) and
+        the ps-routes re-inserted from their subscriptions."""
+        with self._lock:
+            return {
+                "sessions": len(self.sessions),
+                "ps_routes": sum(
+                    len(s.subscriptions) for s in self.sessions.values()
+                ),
+                "streams": sum(
+                    len(s._streams) for s in self.sessions.values()
+                ),
+            }
+
     def close(self) -> None:
         self.db.unpoll(self._on_new_data)
         self.kv.close()
+
+    def kill(self) -> None:
+        """Simulated SIGKILL: drop the sessions KV with no fsync
+        boundary — positions not yet committed replay after reboot."""
+        self.db.unpoll(self._on_new_data)
+        self.kv.kill()

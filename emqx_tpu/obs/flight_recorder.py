@@ -336,6 +336,12 @@ def default_rules(
         # detections it provoked — inject and detect correlate by ring
         # order, not by guesswork
         TriggerRule("chaos_fault", lambda ctl: None, cooldown),
+        # event-driven: a durable-tier shard FAIL-STOPPED (failed
+        # fsync / ENOSPC / EIO — ds/storage.py) — the bundle pins the
+        # traffic the broker was serving when the disk went bad, which
+        # is exactly what the post-incident "what did we lose?" audit
+        # replays against the WAL
+        TriggerRule("ds_shard_failed", lambda ctl: None, cooldown),
     ]
 
 

@@ -87,6 +87,12 @@ def prometheus_text(broker, node_name: str = "emqx@127.0.0.1", obs=None) -> str:
         emit("emqx_otel_spans_dropped", "counter", tracer.dropped)
     if obs is not None:
         _emit_obs(lines, obs, node_name)
+    # durable-tier crash-consistency ledger (emqx_ds_* namespace —
+    # process-global: WAL replay runs at open(), often before any
+    # broker or obs object exists, so it renders on EVERY scrape)
+    from ..ds.metrics import DS_METRICS
+
+    lines.extend(DS_METRICS.prometheus_lines(node_name))
     return "\n".join(lines) + "\n"
 
 

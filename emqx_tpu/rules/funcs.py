@@ -473,9 +473,15 @@ FUNCS["sqlserver_bin2hexstr"] = lambda b: "0x" + _b(b).hex().upper()
 
 # --- compression --------------------------------------------------------
 
-FUNCS["gzip"] = lambda s: zlib.compress(_b(s), wbits=31)
+def _zcompress(data, wbits):
+    # zlib.compress() grew its wbits kwarg in 3.11; compressobj works on 3.10
+    co = zlib.compressobj(wbits=wbits)
+    return co.compress(data) + co.flush()
+
+
+FUNCS["gzip"] = lambda s: _zcompress(_b(s), wbits=31)
 FUNCS["gunzip"] = lambda s: zlib.decompress(_b(s), wbits=31)
-FUNCS["zip"] = lambda s: zlib.compress(_b(s), wbits=-15)  # raw deflate
+FUNCS["zip"] = lambda s: _zcompress(_b(s), wbits=-15)  # raw deflate
 FUNCS["unzip"] = lambda s: zlib.decompress(_b(s), wbits=-15)
 FUNCS["zip_compress"] = lambda s: zlib.compress(_b(s))  # zlib-wrapped
 FUNCS["zip_uncompress"] = lambda s: zlib.decompress(_b(s))

@@ -1,0 +1,357 @@
+"""Crash-consistency matrix for the durable tier.
+
+Walks the on-disk failure space the WAL v2 format and the shard
+fail-stop discipline exist for: torn tails, bit flips, bounded header
+validation, every compaction crash point, errno faults (ENOSPC / EIO /
+failed fsync) through the `ds/diskio` seam, and the kill→reboot→recover
+walk at the Db layer — on BOTH engines wherever the fault can reach
+them (the native engine's raw writes can only be torn on a closed
+file; the live torn-write seam is Python-engine-only by construction).
+"""
+
+import os
+import struct
+import zlib
+
+import pytest
+
+from emqx_tpu.broker.message import Message
+from emqx_tpu.chaos.faults import CRASH_POINTS, DiskFaultInjector
+from emqx_tpu.ds.api import Db
+from emqx_tpu.ds.diskio import (
+    DiskFullError,
+    FsyncFailedError,
+    SimulatedCrash,
+)
+from emqx_tpu.ds.kvstore import _LIB, WAL_MAGIC, NativeKv, PyKv
+from emqx_tpu.ds.metrics import DS_METRICS
+from emqx_tpu.ds.storage import ShardFailedError
+
+
+def kv_impls():
+    impls = [PyKv]
+    if _LIB is not None:
+        impls.append(NativeKv)
+    return impls
+
+
+@pytest.fixture
+def inj():
+    i = DiskFaultInjector(seed=7).install()
+    yield i
+    i.heal()
+    i.uninstall()
+
+
+def _record(key: bytes, val: bytes, vlen=None) -> bytes:
+    """A well-formed v2 record, for crafting corrupt neighbors."""
+    if vlen is None:
+        vlen = len(val)
+    crc = zlib.crc32(struct.pack("<II", len(key), vlen) + key + val)
+    return struct.pack("<III", crc, len(key), vlen) + key + val
+
+
+# --- WAL v2 format ---------------------------------------------------------
+
+
+@pytest.mark.skipif(_LIB is None, reason="native engine not built")
+def test_wal_byte_parity_across_engines(tmp_path):
+    """Both engines must write the SAME bytes for the same op sequence
+    — the on-disk format is the contract, not an implementation."""
+    ops = [
+        ("put", b"a", b"1"),
+        ("put", b"b", b"x" * 300),
+        ("del", b"a", None),
+        ("put", b"empty", b""),
+    ]
+    blobs = {}
+    for impl in (PyKv, NativeKv):
+        p = str(tmp_path / f"{impl.__name__}.kv")
+        kv = impl(p)
+        for op, k, v in ops:
+            kv.put(k, v) if op == "put" else kv.delete(k)
+        kv.flush()
+        kv.close()
+        with open(p, "rb") as f:
+            blobs[impl.__name__] = f.read()
+    assert blobs["PyKv"] == blobs["NativeKv"]
+
+
+@pytest.mark.parametrize("impl", kv_impls())
+def test_wal_v2_framing(impl, tmp_path):
+    """Magic header, CRC-first record layout, 0xFFFFFFFF tombstones —
+    parsed by hand so the test pins the format, not the reader."""
+    p = str(tmp_path / "t.kv")
+    kv = impl(p)
+    kv.put(b"k1", b"v1")
+    kv.delete(b"k1")
+    kv.flush()
+    kv.close()
+    with open(p, "rb") as f:
+        blob = f.read()
+    assert blob.startswith(WAL_MAGIC)
+    off = len(WAL_MAGIC)
+    assert blob[off:] == _record(b"k1", b"v1") + _record(
+        b"k1", b"", vlen=0xFFFFFFFF
+    )
+
+
+@pytest.mark.parametrize("impl", kv_impls())
+def test_v1_file_upgrades_on_open(impl, tmp_path):
+    """A pre-v2 (headerless, length-framed) file must open, replay,
+    and be rewritten as v2 so future replays are CRC-verified."""
+    p = str(tmp_path / "t.kv")
+    with open(p, "wb") as f:
+        f.write(struct.pack("<II", 1, 2) + b"a" + b"v1")
+        f.write(struct.pack("<II", 1, 0xFFFFFFFF) + b"z")
+    up0 = DS_METRICS.snapshot()["wal_upgraded_files_total"]
+    kv = impl(p)
+    assert kv.get(b"a") == b"v1" and kv.get(b"z") is None
+    kv.close()
+    assert DS_METRICS.snapshot()["wal_upgraded_files_total"] == up0 + 1
+    with open(p, "rb") as f:
+        assert f.read(len(WAL_MAGIC)) == WAL_MAGIC
+    kv2 = impl(p)  # and the upgraded file replays v2-clean
+    assert kv2.get(b"a") == b"v1"
+    assert kv2.torn_records == 0 and kv2.crc_failures == 0
+    kv2.close()
+
+
+# --- media damage: torn tails, bit flips, garbage headers ------------------
+
+
+@pytest.mark.parametrize("impl", kv_impls())
+@pytest.mark.parametrize("tail", [b"\x7f", b"\x7f" * 7, b"\x7f" * 13])
+def test_torn_tail_truncated_and_counted(impl, tail, tmp_path):
+    """A crash mid-append leaves a partial record; replay must count
+    it, truncate it, and serve everything before it."""
+    p = str(tmp_path / "t.kv")
+    kv = impl(p)
+    for i in range(10):
+        kv.put(b"k%d" % i, b"v%d" % i)
+    kv.flush()
+    kv.close()
+    good_size = os.path.getsize(p)
+    DiskFaultInjector.tear_tail(p, garbage=tail)
+    kv2 = impl(p)
+    assert kv2.torn_records >= 1
+    assert kv2.crc_failures == 0  # torn is torn, not a checksum failure
+    assert all(kv2.get(b"k%d" % i) == b"v%d" % i for i in range(10))
+    kv2.close()
+    # the poisoned tail is gone from disk, not just skipped in memory
+    assert os.path.getsize(p) == good_size
+
+
+@pytest.mark.parametrize("impl", kv_impls())
+def test_bit_flip_detected_by_crc(impl, tmp_path):
+    """Silent media corruption inside a record: the CRC must refuse to
+    deserialize it, and nothing AFTER it either — once one checksum
+    fails the frame boundary itself is untrusted."""
+    p = str(tmp_path / "t.kv")
+    kv = impl(p)
+    kv.put(b"aaaa", b"A" * 64)
+    kv.put(b"bbbb", b"B" * 64)
+    kv.put(b"cccc", b"C" * 64)
+    kv.flush()
+    kv.close()
+    # flip a payload byte of the SECOND record
+    off = len(WAL_MAGIC) + len(_record(b"aaaa", b"A" * 64)) + 12 + 4 + 10
+    DiskFaultInjector.corrupt_at(p, off)
+    kv2 = impl(p)
+    assert kv2.crc_failures >= 1
+    assert kv2.get(b"aaaa") == b"A" * 64
+    assert kv2.get(b"bbbb") is None  # never served unverified
+    assert kv2.get(b"cccc") is None  # nothing past the bad frame
+    kv2.close()
+
+
+@pytest.mark.parametrize("impl", kv_impls())
+def test_garbage_length_header_bounded(impl, tmp_path):
+    """A corrupted length field claiming multi-GB payloads must be
+    rejected by bounded validation (lengths vs. remaining file size),
+    not by attempting the allocation."""
+    p = str(tmp_path / "t.kv")
+    kv = impl(p)
+    kv.put(b"good", b"1")
+    kv.flush()
+    kv.close()
+    with open(p, "ab") as f:
+        f.write(struct.pack("<III", 0xDEAD, 0x7FFFFFFF, 0x7FFFFFFF))
+        f.write(b"tiny")
+    kv2 = impl(p)
+    assert kv2.get(b"good") == b"1"
+    assert kv2.torn_records >= 1
+    kv2.close()
+
+
+# --- compaction crash points (Python engine choreography) ------------------
+
+
+@pytest.mark.parametrize("point", CRASH_POINTS)
+def test_compaction_crash_point_recovers(point, tmp_path, inj):
+    """Die at each step of the compaction swap; the reboot-open must
+    recover a consistent store from whichever on-disk state the crash
+    left (old WAL + tmp, or the renamed file pre-dir-fsync)."""
+    p = str(tmp_path / "t.kv")
+    kv = PyKv(p)
+    for i in range(40):
+        kv.put(b"hot", b"v%d" % i)  # 40 WAL records, one live key
+        kv.put(b"k%d" % (i % 4), b"x%d" % i)
+    kv.flush()
+    inj.crash_at(point, paths=("t.kv",))
+    with pytest.raises(SimulatedCrash):
+        kv.compact()
+    inj.heal()
+    # reboot: abandon the dead object, open fresh from the data dir
+    kv2 = PyKv(p)
+    assert kv2.get(b"hot") == b"v39"
+    assert all(kv2.get(b"k%d" % j) is not None for j in range(4))
+    assert kv2.crc_failures == 0
+    assert not os.path.exists(p + ".compact")  # stray tmp swept
+    kv2.compact()  # and compaction completes cleanly post-recovery
+    assert kv2.wal_records() == kv2.count()
+    kv2.close()
+
+
+def test_seam_torn_write_mid_put(tmp_path, inj):
+    """The live torn-write seam: an append lands a prefix, the process
+    'dies' (SimulatedCrash — NOT an OSError, no handler may observe
+    it), and the reboot-open truncates the partial record."""
+    p = str(tmp_path / "t.kv")
+    kv = PyKv(p)
+    kv.put(b"committed", b"yes")
+    kv.flush()
+    inj.torn_write(5, paths=("t.kv",))
+    with pytest.raises(SimulatedCrash):
+        kv.put(b"torn", b"never-acked")
+    kv.kill()  # crash teardown: no fsync boundary
+    kv2 = PyKv(p)
+    assert kv2.torn_records == 1
+    assert kv2.get(b"committed") == b"yes"
+    assert kv2.get(b"torn") is None
+    kv2.close()
+
+
+# --- errno faults through the seam: the shard fail-stop discipline ---------
+
+
+def _mk_db(tmp_path, **kw):
+    kw.setdefault("n_shards", 1)
+    kw.setdefault("buffer_flush_ms", 1000)
+    return Db("messages", data_dir=str(tmp_path), **kw)
+
+
+def _drain(db, filt="t/#"):
+    got = []
+    for s in db.get_streams(filt):
+        it = db.make_iterator(s, filt)
+        while True:
+            it, batch = db.next(it, batch_size=100)
+            if not batch:
+                break
+            got.extend(batch)
+    return got
+
+
+def test_enospc_fail_stops_shard_reads_still_serve(tmp_path, inj):
+    db = _mk_db(tmp_path)
+    db.store_batch(
+        [Message(topic="t/a", payload=b"%d" % i, from_client="c")
+         for i in range(5)]
+    )
+    fails0 = DS_METRICS.snapshot()["shard_failures_total"]
+    inj.fail_sticky("enospc", legs=("append",), paths=("messages",))
+    with pytest.raises(ShardFailedError) as ei:
+        db.store_batch([Message(topic="t/a", payload=b"x", from_client="c")])
+    assert isinstance(ei.value.__cause__, DiskFullError) or "ENOSPC" in str(
+        ei.value
+    )
+    assert db.failed_shards() == [0]
+    assert DS_METRICS.snapshot()["shard_failures_total"] == fails0 + 1
+    # fail-stop refuses WRITES; committed data keeps serving
+    assert len(_drain(db)) == 5
+    with pytest.raises(ShardFailedError):
+        db.store_batch([Message(topic="t/a", payload=b"y", from_client="c")])
+    inj.heal()
+    assert db.recover_shard(0)
+    assert db.failed_shards() == []
+    db.store_batch([Message(topic="t/a", payload=b"z", from_client="c")])
+    assert len(_drain(db)) == 6
+    db.close()
+
+
+def test_one_failed_fsync_fail_stops_no_retry(tmp_path, inj):
+    """fsyncgate: ONE transient fsync failure must fail-stop the shard
+    — after a failed fsync the kernel may have dropped the dirty
+    pages, so retry-and-continue silently loses acked data. Writes
+    stay refused even though the disk is healthy again."""
+    db = _mk_db(tmp_path)
+    inj.fail_transient(1, kind="fsync", legs=("fsync",), paths=("messages",))
+    with pytest.raises(ShardFailedError) as ei:
+        db.store_batch([Message(topic="t/a", payload=b"x", from_client="c")])
+    assert isinstance(
+        ei.value.__cause__, FsyncFailedError
+    ) or "fsync" in str(ei.value)
+    assert inj.healthy  # the transient burned itself out...
+    with pytest.raises(ShardFailedError):  # ...but the shard stays down
+        db.store_batch([Message(topic="t/a", payload=b"y", from_client="c")])
+    assert db.recover_shard(0)  # recovery = reopen + replay + probe
+    db.store_batch([Message(topic="t/a", payload=b"z", from_client="c")])
+    assert b"z" in [m.payload for m in _drain(db)]
+    db.close()
+
+
+def test_shard_failure_callback_fires(tmp_path, inj):
+    seen = []
+    db = _mk_db(tmp_path)
+    db.storage.on_shard_failed = lambda sid, exc: seen.append((sid, exc))
+    inj.fail_sticky("eio", legs=("append",), paths=("messages",))
+    with pytest.raises(ShardFailedError):
+        db.store_batch([Message(topic="t/a", payload=b"x", from_client="c")])
+    assert len(seen) == 1 and seen[0][0] == 0
+    inj.heal()
+    db.close()
+
+
+# --- kill → reboot → recover at the Db layer -------------------------------
+
+
+def test_kill_reboot_recovers_committed_batches(tmp_path):
+    db = _mk_db(tmp_path, n_shards=2)
+    msgs = [
+        Message(topic=f"t/{i}", payload=b"p%d" % i, from_client="c")
+        for i in range(30)
+    ]
+    db.store_batch(msgs, sync=True)
+    db.kill()  # SIGKILL teardown: no close-time fsync boundary
+    db2 = _mk_db(tmp_path, n_shards=2)
+    rep = db2.recovery_report()
+    assert sum(s["replayed_records"] for s in rep["shards"]) >= 30
+    assert not db2.failed_shards()
+    assert sorted(m.payload for m in _drain(db2)) == sorted(
+        m.payload for m in msgs
+    )
+    db2.close()
+
+
+def test_reboot_with_torn_shard_wals(tmp_path):
+    """The scenario-engine mechanism in miniature: kill, tear every
+    shard WAL's tail, reboot — replay truncates each and serves all
+    committed data."""
+    db = _mk_db(tmp_path, n_shards=2)
+    msgs = [
+        Message(topic=f"t/{i}", payload=b"p%d" % i, from_client="c")
+        for i in range(20)
+    ]
+    db.store_batch(msgs, sync=True)
+    db.kill()
+    torn0 = DS_METRICS.snapshot()["wal_torn_records_total"]
+    for i in range(2):
+        DiskFaultInjector.tear_tail(
+            str(tmp_path / "messages" / f"shard_{i}.kv")
+        )
+    db2 = _mk_db(tmp_path, n_shards=2)
+    assert DS_METRICS.snapshot()["wal_torn_records_total"] >= torn0 + 2
+    assert not db2.failed_shards()
+    assert len(_drain(db2)) == 20
+    db2.close()

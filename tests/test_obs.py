@@ -182,12 +182,17 @@ def test_prometheus_no_duplicate_families():
     broker = Broker()
     _, _ = sess(broker, "c1", ["t/#"])  # populates sessions.count stat
     text = prometheus_text(broker)
-    names = [
-        line.split("{")[0]
+    # uniqueness is per-series (name + labels): labelled families like
+    # emqx_ds_fault_injected_total{leg=...} emit one sample per label set
+    series = [
+        line.rsplit(" ", 1)[0]
         for line in text.splitlines()
         if line and not line.startswith("#")
     ]
-    assert len(names) == len(set(names))
+    assert len(series) == len(set(series))
+    type_lines = [l for l in text.splitlines() if l.startswith("# TYPE ")]
+    fams = [l.split()[2] for l in type_lines]
+    assert len(fams) == len(set(fams))
 
 
 def test_prometheus_exposition():

@@ -561,3 +561,77 @@ def test_shard_fault_and_failover_families_lint():
     m = re.search(r'emqx_xla_mesh_shards\{node="n1@host"\} (\d+)', text)
     assert m and int(m.group(1)) == 4
     assert re.search(r'emqx_xla_shards_lost\{node="n1@host"\} 0', text)
+
+
+def test_ds_crash_consistency_families_lint(tmp_path):
+    """ISSUE-12 families: the durable tier's `emqx_ds_*` ledger must
+    render on a scrape driven through a REAL fault walk — an injected
+    ENOSPC that fail-stops a shard, a torn-tail reopen, and a
+    probe-verified recovery — and pass the same exposition lint."""
+    import pytest
+
+    from emqx_tpu.broker.message import Message as Msg
+    from emqx_tpu.chaos.faults import DiskFaultInjector
+    from emqx_tpu.ds.api import Db
+    from emqx_tpu.ds.storage import ShardFailedError
+
+    inj = DiskFaultInjector(seed=3).install()
+    try:
+        db = Db("messages", data_dir=str(tmp_path), n_shards=1,
+                buffer_flush_ms=1000)
+        db.store_batch(
+            [Msg(topic="t/a", payload=b"%d" % i, from_client="c")
+             for i in range(5)]
+        )
+        inj.fail_sticky("enospc", legs=("append",), paths=("messages",))
+        with pytest.raises(ShardFailedError):
+            db.store_batch([Msg(topic="t/a", payload=b"x", from_client="c")])
+        inj.heal()
+        # scrape WHILE failed: the read-only gauge is up
+        text = prometheus_text(_scraped_broker(), "n1@host")
+        assert re.search(
+            r'emqx_ds_shard_read_only\{node="n1@host"\} 1(\.0)?$', text, re.M
+        )
+        # torn tail + recovery drive the replay counters
+        db.kill()
+        DiskFaultInjector.tear_tail(str(tmp_path / "messages" / "shard_0.kv"))
+        db = Db("messages", data_dir=str(tmp_path), n_shards=1,
+                buffer_flush_ms=1000)
+        assert not db.failed_shards()
+        db.close()
+    finally:
+        inj.heal()
+        inj.uninstall()
+
+    text = prometheus_text(_scraped_broker(), "n1@host")
+    types = _lint(text)
+    for fam, kind in (
+        ("emqx_ds_wal_torn_records_total", "counter"),
+        ("emqx_ds_wal_crc_failures_total", "counter"),
+        ("emqx_ds_wal_replayed_records_total", "counter"),
+        ("emqx_ds_wal_upgraded_files_total", "counter"),
+        ("emqx_ds_shard_failures_total", "counter"),
+        ("emqx_ds_shard_recoveries_total", "counter"),
+        ("emqx_ds_shard_read_only", "gauge"),
+        ("emqx_ds_recovery_last_ms", "gauge"),
+        ("emqx_ds_fault_injected_total", "counter"),
+    ):
+        assert types.get(fam) == kind, f"{fam}: {types.get(fam)}"
+    # the fault ledger carries per-leg attribution (the sticky ENOSPC
+    # fired on the append leg), and the counters saw the walk
+    assert re.search(
+        r'emqx_ds_fault_injected_total\{node="n1@host",leg="append"\} \d+',
+        text,
+    )
+    m = re.search(
+        r'emqx_ds_wal_torn_records_total\{node="n1@host"\} (\d+)', text
+    )
+    assert m and int(m.group(1)) >= 1
+    m = re.search(
+        r'emqx_ds_shard_failures_total\{node="n1@host"\} (\d+)', text
+    )
+    assert m and int(m.group(1)) >= 1
+    # the shard came back: nothing read-only on the final scrape
+    assert re.search(
+        r'emqx_ds_shard_read_only\{node="n1@host"\} 0(\.0)?$', text, re.M
+    )

@@ -309,6 +309,29 @@ def _driven_scrape():
             await asyncio.sleep(0)
             obs.sentinel.run_audits()
             await eng.stop()
+            # durable-tier drive: a real WAL write, a SIGKILL teardown,
+            # a torn tail planted on the dead file, and the reboot
+            # replay — so the emqx_ds_* counters move on this scrape
+            # instead of rendering only their zero defaults
+            import os
+
+            from emqx_tpu.chaos.faults import DiskFaultInjector
+            from emqx_tpu.ds.api import Db
+
+            ds_dir = tempfile.mkdtemp(prefix="gate_ds_")
+            db = Db("gate-msgs", data_dir=ds_dir, n_shards=1,
+                    buffer_flush_ms=1000)
+            db.store_batch(
+                [Message(topic="g/ds/v", payload=b"x", from_client="c")]
+            )
+            db.kill()
+            DiskFaultInjector.tear_tail(
+                os.path.join(ds_dir, "gate-msgs", "shard_0.kv")
+            )
+            db = Db("gate-msgs", data_dir=ds_dir, n_shards=1,
+                    buffer_flush_ms=1000)
+            assert not db.failed_shards()
+            db.close()
             return obs.prometheus_text()
         finally:
             obs.stop()
@@ -554,4 +577,51 @@ def test_scenario_catalog_covered_by_tests():
     assert not missing, (
         "chaos scenarios with no test reference (add a test that "
         "runs or names them): " + ", ".join(missing)
+    )
+
+
+# --- leg 9 (ISSUE 12): the durable tier's disk-IO funnel -------------------
+
+# Every byte the DS layer puts on (or pulls off) disk must route
+# through `ds/diskio.py` — that module IS the chaos seam, so a bare
+# `open` / `os.fsync` / `os.replace` call site anywhere else under
+# `emqx_tpu/ds/` would be invisible to the DiskFaultInjector: its
+# appends can't be torn, its fsyncs can't fail, and the crash matrix
+# silently stops covering it. New disk I/O goes through the seam, or
+# gets an explicit reviewed exemption HERE.
+_DS_SEAM_OS_BANNED = {
+    "fsync", "replace", "rename", "remove", "unlink", "truncate",
+}
+DS_SEAM_EXEMPT_FILES = {"diskio.py"}  # the seam itself
+
+
+def test_ds_disk_io_funnels_through_seam():
+    offenders = []
+    for path in sorted((PKG / "ds").glob("*.py")):
+        if path.name in DS_SEAM_EXEMPT_FILES:
+            continue
+        rel = f"ds/{path.name}"
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Name) and f.id == "open":
+                offenders.append(
+                    f"{rel}:{node.lineno} bare open() — use "
+                    f"diskio.file_open"
+                )
+            if (
+                isinstance(f, ast.Attribute)
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "os"
+                and f.attr in _DS_SEAM_OS_BANNED
+            ):
+                offenders.append(
+                    f"{rel}:{node.lineno} os.{f.attr}() — use the "
+                    f"diskio seam entry"
+                )
+    assert not offenders, (
+        "disk I/O under emqx_tpu/ds/ bypassing the diskio seam "
+        "(invisible to fault injection):\n  " + "\n  ".join(offenders)
     )
