@@ -2354,7 +2354,7 @@ def bench_degraded(details):
     }
 
 
-def bench_soak(details, out_path="SOAK_r12.json"):
+def bench_soak(details, out_path="SOAK_r13.json"):
     """Million-session soak + chaos scenario stage (ISSUE 7+8): builds
     the two-node chaos engine, sustains the Zipf storm through the
     real pipelined broker, runs the fault catalog (row corruption,

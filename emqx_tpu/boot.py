@@ -347,7 +347,16 @@ class Node:
         # 7. cluster membership + DS replication
         seeds = cfg.get("cluster.static_seeds")
         if seeds or cfg.get("cluster.discovery_strategy") == "static":
-            node = ClusterNode(node_name, broker=broker, cookie=cfg.get("node.cookie"))
+            node = ClusterNode(
+                node_name,
+                broker=broker,
+                cookie=cfg.get("node.cookie"),
+                autoheal=cfg.get("cluster.autoheal"),
+                partition_policy=cfg.get("cluster.partition_policy"),
+            )
+            node.attach_obs(
+                alarms=self.obs.alarms, flight=self.obs.flight
+            )
             await node.start()
             self.cluster_node = node
             for seed in seeds:

@@ -1160,6 +1160,7 @@ class QuicServer:
         self.conns: Dict[bytes, ServerConnection] = {}
         self._addr: Dict[bytes, tuple] = {}  # scid -> last peer addr
         self._started: set = set()
+        self._conn_tasks: set = set()  # retained connection-run handles
         self._born: Dict[bytes, float] = {}  # scid -> accept time
         self._now = _time.monotonic
         # ONE certificate per listener (configurable PEMs or generated
@@ -1294,7 +1295,9 @@ class QuicServer:
                     self.mqtt._conns.discard(mqtt_conn)
                     self._forget(conn)
 
-            asyncio.ensure_future(run())
+            task = asyncio.ensure_future(run())
+            self._conn_tasks.add(task)
+            task.add_done_callback(self._conn_tasks.discard)
 
 
 class QuicClientEndpoint:

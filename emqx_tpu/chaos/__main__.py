@@ -1,6 +1,6 @@
 """Standalone chaos soak driver.
 
-    python -m emqx_tpu.chaos --sessions 1000000 --out SOAK_r12.json
+    python -m emqx_tpu.chaos --sessions 1000000 --out SOAK_r13.json
 
 Builds a two-node in-process cluster (set --victim-sessions 0 for a
 single broker), sustains the Zipf publish storm, runs the scenario
@@ -36,7 +36,7 @@ def main(argv=None) -> int:
                     help="clean storm seconds before the first fault")
     ap.add_argument("--scenario", action="append", choices=CATALOG,
                     help="run only these scenarios (repeatable)")
-    ap.add_argument("--out", default="SOAK_r12.json")
+    ap.add_argument("--out", default="SOAK_r13.json")
     ap.add_argument("--data-dir", default=None)
     ap.add_argument("--lenient", action="store_true",
                     help="report contract violations without failing")

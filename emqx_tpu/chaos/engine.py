@@ -801,6 +801,24 @@ class ChaosEngine:
         # full-truth sweep
         await self.drain_clean_streak()
         sweep = await self.audit_sweep()
+        if self.node is not None and self.victim is not None:
+            # the storm is quiet but the LAST ping round's repair may
+            # still be paging routes across; the ledger must snapshot
+            # the converged state, not a resync in flight. Budget
+            # mirrors the replica_drift repair bound: ping rounds +
+            # settle + a full-contribution paged resync.
+            ms = self.node.membership
+            await self.wait_for(
+                lambda: not self.node._resync
+                and not self.victim._resync
+                and self.node.replica_digests()
+                == self.victim.replica_digests(),
+                timeout=(
+                    (ms.heartbeat_interval + ms.ping_timeout) * 6
+                    + self.settle_timeout
+                    + max(30.0, len(self.node._cluster_pairs) / 5_000.0)
+                ),
+            )
         row = self.soak_row(results, sweep, time.monotonic() - t_run0)
         bad = [
             f"{res.name}: {chk.name} ({chk.detail})"
@@ -958,11 +976,56 @@ class ChaosEngine:
             },
         }
         if self.node is not None:
+            from ..cluster.metrics import CLUSTER_METRICS
+
+            csnap = CLUSTER_METRICS.snapshot()
+
+            def _node_summary(node) -> Dict[str, Any]:
+                st = node.cluster_status()
+                return {
+                    "minority": st["minority"],
+                    "needs_rejoin": st["needs_rejoin"],
+                    "partition_trips": st["partition_trips"],
+                    "partition_heals": st["partition_heals"],
+                    "rejoins_completed": st["autoheal"][
+                        "rejoins_completed"
+                    ],
+                    "antientropy": st["antientropy"],
+                    "registry_conflicts": st["registry_conflicts"],
+                    "digests": st["digests"],
+                }
+
             row["cluster"] = {
                 "nodes": 2,
                 "heartbeat_interval": self.node.membership.heartbeat_interval,
                 "victim_sessions_at_end": len(self.victim.broker.sessions),
                 "cluster_routes_main": len(self.node._cluster_pairs),
+                # the acceptance ledger: both nodes' route-table digests
+                # must be byte-equal after the catalog's partitions heal
+                "digests_equal_at_end": (
+                    self.node.replica_digests()
+                    == self.victim.replica_digests()
+                ),
+                "partitions": csnap.get("partition_total", 0),
+                "heals": csnap.get("heal_total", 0),
+                "autoheal_rejoins": csnap.get("autoheal_rejoin_total", 0),
+                "antientropy_checks": csnap.get(
+                    "antientropy_checks_total", 0
+                ),
+                "antientropy_divergences": csnap.get(
+                    "antientropy_divergence_total", 0
+                ),
+                "antientropy_repairs": csnap.get(
+                    "antientropy_repairs_total", 0
+                ),
+                "registry_conflicts": csnap.get(
+                    "registry_conflicts_total", 0
+                ),
+                "asymmetry_detected": csnap.get("asymmetry_total", 0),
+                "per_node": {
+                    self.node.node_id: _node_summary(self.node),
+                    self.victim.node_id: _node_summary(self.victim),
+                },
             }
         if self.durable_db is not None:
             from ..ds.metrics import DS_METRICS
@@ -1063,6 +1126,8 @@ class ChaosEngine:
             heartbeat_interval=heartbeat_interval,
             ping_timeout=ping_timeout,
         )
+        main.attach_obs(alarms=obs.alarms, flight=obs.flight)
+        victim.attach_obs(alarms=vobs.alarms, flight=vobs.flight)
         addr = await main.start()
         await victim.start()
         await victim.join(addr)
@@ -1118,7 +1183,7 @@ async def run_soak(
     sample_n: int = 64,
     baseline_s: float = 20.0,
     scenarios: Optional[Sequence[str]] = None,
-    report_path: Optional[str] = "SOAK_r12.json",
+    report_path: Optional[str] = "SOAK_r13.json",
     data_dir: Optional[str] = None,
     progress: Optional[Callable[[str], None]] = None,
     strict: bool = True,

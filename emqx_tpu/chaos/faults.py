@@ -349,6 +349,53 @@ class DeviceFaultInjector:
         }
 
 
+# --- the cluster replica seam ---------------------------------------------
+
+
+class ReplicaDriftInjector:
+    """Cluster-replica drift seam: re-registers one ClusterNode's
+    "route"/"push" v1 handler with a wrapper that silently DROPS the
+    next `n` op batches while still acknowledging them. The origin's
+    push call succeeds, so it never schedules the peer into `_resync`
+    — the replica drifts with no nodedown, no failed RPC, no signal at
+    all. This is the exact fault class route anti-entropy exists for:
+    only the digest exchange on the ping path can see it."""
+
+    def __init__(self, node: Any) -> None:
+        self.node = node
+        self._orig = node.rpc.registry.lookup("route", 1, "push")
+        self._drop_left = 0
+        self.dropped_batches = 0
+        self.dropped_ops = 0
+        self.installed = True
+        node.rpc.registry.register("route", 1, "push", self._wrapped)
+
+    def drop_next(self, n: int = 1) -> None:
+        """Silently drop the next `n` inbound op batches."""
+        self._drop_left = int(n)
+
+    def _wrapped(self, origin: str, ops: Any) -> None:
+        if self._drop_left > 0:
+            self._drop_left -= 1
+            self.dropped_batches += 1
+            self.dropped_ops += len(ops)
+            return None  # ACKed but never applied: silent drift
+        return self._orig(origin, ops)
+
+    def uninstall(self) -> None:
+        if self.installed:
+            self.node.rpc.registry.register("route", 1, "push", self._orig)
+            self.installed = False
+
+    def status(self) -> dict:
+        return {
+            "installed": self.installed,
+            "drop_left": self._drop_left,
+            "dropped_batches": self.dropped_batches,
+            "dropped_ops": self.dropped_ops,
+        }
+
+
 # --- the disk seam --------------------------------------------------------
 
 # the legs DiskFaultInjector.check() is called with — one name per
@@ -628,6 +675,7 @@ __all__ = [
     "DeviceLostError",
     "DeviceDeadlineExceeded",
     "DeviceFaultInjector",
+    "ReplicaDriftInjector",
     "DiskFaultInjector",
     "DiskFaultError",
     "DiskFullError",

@@ -1,0 +1,150 @@
+"""Autoheal coordinator: the ekka_autoheal analog.
+
+Implements the `cluster.autoheal` knob. When a partition heals (a down
+peer answers a probe again), a deterministic coordinator — the lowest
+node id among the reunited view's HEALTHY nodes (those not themselves
+flagged needs_rejoin; ekka elects its autoheal leader from the
+majority the same way) — directs each minority node through rejoin:
+paged re-bootstrap off the coordinator via the existing DUMP_PAGE
+machinery, contribution re-derivation from live local state, full
+device resync, and registry conflict resolution (ClusterNode.rejoin).
+
+The signal plane is the membership ping exchange: every structured
+ping carries the sender's `minority`/`needs_rejoin` flags both ways,
+so the coordinator learns who needs healing even across an ASYMMETRIC
+partition where it never declared the minority node down (and so never
+fires on_heal for it). Directives are idempotent — rejoin is guarded
+by needs_rejoin and a lock on the target — so duplicate directives
+from flag-update races are harmless; a lost directive is retried after
+REDIRECT_AFTER seconds while the flag persists.
+
+Protocol (proto "heal" v1):
+    rejoin(host, port) -> bool   directive: re-bootstrap via (host, port).
+                                 Spawned, not awaited — the handler must
+                                 not block the RPC serve loop for the
+                                 duration of a paged bootstrap.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict
+
+log = logging.getLogger("emqx_tpu.cluster.heal")
+
+# re-direct a still-flagged peer after this long (a lost/failed
+# directive must not wedge the minority forever)
+REDIRECT_AFTER = 10.0
+
+
+class Autoheal:
+    def __init__(self, node, enabled: bool = True):
+        self.node = node
+        self.enabled = enabled
+        # peer -> monotonic ts of the last directive we sent it
+        self._directed: Dict[str, float] = {}
+        self.rejoins_directed = 0
+        node.rpc.registry.register_all(
+            "heal", 1, {"rejoin": self._handle_rejoin}
+        )
+        node.membership.on_heal.append(self._on_heal)
+        node.membership.on_peer_flags.append(self._on_peer_flags)
+        node.membership.on_member_down.append(
+            lambda peer: self._directed.pop(peer, None)
+        )
+
+    def coordinator(self) -> str:
+        """Lowest node id among the nodes NOT needing rejoin — the
+        healthy (majority-side) half elects from itself, so a healed
+        minority node that happens to hold the lowest id overall does
+        not end up directing its own repair."""
+        ms = self.node.membership
+        healthy = [
+            n
+            for n in ms.members
+            if not (ms.peer_flags.get(n) or {}).get("needs_rejoin")
+        ]
+        if not ms.needs_rejoin:
+            healthy.append(ms.node_id)
+        return min(healthy) if healthy else ms.node_id
+
+    # --- directive target side --------------------------------------------
+
+    def _handle_rejoin(self, host: str, port: int) -> bool:
+        if not self.enabled:
+            return False
+        # spawned: a paged re-bootstrap must not block the serve loop
+        self.node._spawn(self.node.rejoin((host, port)))
+        return True
+
+    # --- coordinator side --------------------------------------------------
+
+    def _on_heal(self, peer: str) -> None:
+        ms = self.node.membership
+        if not self.enabled:
+            return
+        if ms.needs_rejoin:
+            # WE are the healed minority. Normally the majority-side
+            # coordinator directs us via its own heal detection or our
+            # piggybacked flag; but if we hold the lowest id of the
+            # whole reunited view, nobody outranks us — self-direct
+            # through the healed peer.
+            if min([ms.node_id, *ms.members]) == ms.node_id:
+                addr = ms.members.get(peer)
+                if addr is not None:
+                    log.info(
+                        "%s: coordinator-in-minority — self-rejoin via %s",
+                        ms.node_id, peer,
+                    )
+                    self.node._spawn(self.node.rejoin(addr))
+            return
+        self._consider(peer)
+
+    def _on_peer_flags(self, peer: str, flags: dict) -> None:
+        if not flags.get("needs_rejoin"):
+            self._directed.pop(peer, None)
+            return
+        self._consider(peer)
+
+    def _consider(self, peer: str) -> None:
+        """Direct `peer` through rejoin iff autoheal is on, we are the
+        coordinator, and the peer's latest flags say it needs one."""
+        ms = self.node.membership
+        if not self.enabled or ms.needs_rejoin:
+            return
+        if self.coordinator() != ms.node_id:
+            return
+        if not (ms.peer_flags.get(peer) or {}).get("needs_rejoin"):
+            return
+        addr = ms.members.get(peer)
+        if addr is None:
+            return  # not reunited with us yet; its heal will re-raise
+        last = self._directed.get(peer)
+        if last is not None and time.monotonic() - last < REDIRECT_AFTER:
+            return  # directive in flight
+        self._directed[peer] = time.monotonic()
+        self.node._spawn(self._direct(peer, addr))
+
+    async def _direct(self, peer: str, addr) -> None:
+        node = self.node
+        log.info(
+            "%s: autoheal coordinator directing %s to rejoin via us",
+            node.node_id, peer,
+        )
+        try:
+            accepted = await node.call_retry(
+                addr, "heal", "rejoin", tuple(node.rpc.listen_addr),
+                timeout=5.0,
+            )
+        except Exception:
+            self._directed.pop(peer, None)  # retry on a later flag round
+            return
+        if accepted:
+            self.rejoins_directed += 1
+        else:
+            # peer runs with autoheal disabled: respect it, stop nagging
+            log.warning(
+                "%s: %s refused rejoin directive (autoheal off there)",
+                node.node_id, peer,
+            )

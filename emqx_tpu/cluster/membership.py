@@ -1,31 +1,73 @@
 """Cluster membership: the ekka analog.
 
 Join/leave through a seed node, full-mesh member gossip, periodic
-heartbeats with consecutive-miss failure detection. On a detected
-nodedown every surviving node fires its member_down callbacks locally
-— the same contract as `emqx_router_helper` reacting to
-`ekka:monitor(membership)` and purging the dead node's routes
+heartbeats with a three-state failure detector (alive → suspect →
+down). On a detected nodedown every surviving node fires its
+member_down callbacks locally — the same contract as
+`emqx_router_helper` reacting to `ekka:monitor(membership)` and
+purging the dead node's routes
 (apps/emqx/src/emqx_router_helper.erl:103,147-166).
+
+Partition arbitration (ekka network-partition handling analog): each
+node remembers its *last stable view* — the full member set as of the
+last moment every peer was alive. A node that can reach only a
+minority of that view (strict majority wins; an exact tie goes to the
+half holding the lowest node id, the same deterministic tie-break
+ekka's autoheal coordinator election uses) declares itself in
+*minority* state and fires on_partition — the cluster layer maps that
+onto the configured `cluster.partition_policy`. Down peers keep being
+probed every heartbeat round; a successful probe is *heal detection*:
+with autoheal on the peer is re-admitted (member_up re-fires, resync
+rides the existing on_member_up path) and on_heal fires so the
+autoheal coordinator can direct minority nodes through rejoin; with
+autoheal off the peer is only recorded in `heal_available` — the
+minority stays partitioned, alarmed, and degraded-correct.
+
+Pings carry piggybacked state both ways (new in proto v1, backward
+compatible: a bare `ping()` still answers "pong"):
+
+  ping(from_node, digests, flags) ->
+      {node, caller_state, digests, minority, needs_rejoin}
+
+  * `digests` — the caller's per-origin replica digests (route ops +
+    shared-sub membership + registry pages); on_peer_digests fires on
+    BOTH sides of every successful ping, so route anti-entropy gets
+    symmetric coverage without a separate RPC.
+  * `caller_state` — the receiver's detector state for the caller. A
+    caller whose ping succeeds while the receiver holds it suspect or
+    down has found an *asymmetric* partition (A→B fine, B→A black-
+    holed) — counted, and surfaced long before the symmetric detector
+    would fire.
+  * `flags` / `minority`+`needs_rejoin` — partition posture, read by
+    the autoheal coordinator to decide who rejoins whom.
 
 Protocol (over the RPC plane, proto "membership" v1):
     join(node_id, host, port)  -> [(node_id, host, port), ...]  (full view)
     member_up(node_id, host, port)    broadcast on join
     member_leave(node_id)             broadcast on graceful leave
-    ping() -> "pong"                  heartbeat
+    ping(...) -> "pong" | dict        heartbeat (see above)
 """
 
 from __future__ import annotations
 
 import asyncio
 import logging
-from typing import Callable, Dict, List, Optional, Tuple
+import random
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 from . import rpc as rpc_mod
+from .metrics import CLUSTER_METRICS, STATE_ALIVE, STATE_DOWN, STATE_SUSPECT
 from .rpc import PeerDown, RpcPlane
 
 log = logging.getLogger("emqx_tpu.cluster.membership")
 
 Addr = Tuple[str, int]
+
+_STATE_GAUGE = {
+    "alive": STATE_ALIVE,
+    "suspect": STATE_SUSPECT,
+    "down": STATE_DOWN,
+}
 
 
 class Membership:
@@ -35,6 +77,7 @@ class Membership:
         heartbeat_interval: float = 1.0,
         miss_threshold: int = 3,
         ping_timeout: Optional[float] = None,
+        autoheal: bool = True,
     ):
         self.rpc = rpc
         self.node_id = rpc.node_id
@@ -50,11 +93,52 @@ class Membership:
             if ping_timeout is not None
             else heartbeat_interval * 2
         )
+        self.autoheal = autoheal
         self.members: Dict[str, Addr] = {}  # peers only (not self)
         self._misses: Dict[str, int] = {}
+        # detector state per peer: alive | suspect | down
+        self.member_state: Dict[str, str] = {}
+        # down-but-remembered peers, probed every round for heal
+        self._down: Dict[str, Addr] = {}
+        # the member set (incl. self) as of the last all-alive moment —
+        # the denominator of the majority rule
+        self._stable_view: Set[str] = {self.node_id}
+        self.minority = False
+        # sticky: set on minority entry, cleared only by a COMPLETED
+        # rejoin (ClusterNode.rejoin → clear_needs_rejoin) — a heal
+        # alone reconnects the mesh but does not repair the replica
+        self.needs_rejoin = False
+        # heal evidence withheld while autoheal is off: the peer ids
+        # whose probes succeed but who stay un-readmitted
+        self.heal_available: Set[str] = set()
+        # peers that report holding US suspect/down while our pings to
+        # them succeed — the asymmetric-partition evidence set
+        self.asym_peers: Set[str] = set()
+        # latest partition posture piggybacked by each peer
+        self.peer_flags: Dict[str, Dict[str, Any]] = {}
+        self.partition_trips = 0
+        self.partition_heals = 0
+        # set by the cluster layer: () -> {origin: digest}
+        self.digest_provider: Optional[Callable[[], Dict[str, int]]] = None
         self.on_member_up: List[Callable[[str, Addr], None]] = []
         self.on_member_down: List[Callable[[str], None]] = []
+        # fired with the peer node_id after each successful ping — the
+        # cluster layer piggybacks replica resync on this
+        self.on_ping_ok: List[Callable[[str], None]] = []
+        # fired with (peer, digests) on both sides of a structured ping
+        self.on_peer_digests: List[
+            Callable[[str, Dict[str, int]], None]
+        ] = []
+        # fired with (peer, flags) whenever a peer's posture arrives
+        self.on_peer_flags: List[
+            Callable[[str, Dict[str, Any]], None]
+        ] = []
+        # fired with the peer node_id on heal detection (autoheal on)
+        self.on_heal: List[Callable[[str], None]] = []
+        # fired with True on minority entry, False on exit
+        self.on_partition: List[Callable[[bool], None]] = []
         self._hb_task: Optional[asyncio.Task] = None
+        self._tasks: Set[asyncio.Task] = set()
         rpc.registry.register_all(
             "membership",
             1,
@@ -62,12 +146,29 @@ class Membership:
                 "join": self._handle_join,
                 "member_up": self._handle_member_up,
                 "member_leave": self._handle_leave,
-                "ping": lambda: "pong",
+                "ping": self._handle_ping,
             },
         )
-        # fired with the peer node_id after each successful ping — the
-        # cluster layer piggybacks replica resync on this
-        self.on_ping_ok: List[Callable[[str], None]] = []
+
+    # --- supervised fire-and-forget ---------------------------------------
+
+    def _spawn(self, coro) -> asyncio.Task:
+        """Retained-handle spawn: membership broadcasts/probes must not
+        be GC-able mid-flight nor swallow exceptions (the bug class the
+        static gate bans)."""
+        task = asyncio.get_running_loop().create_task(coro)
+        self._tasks.add(task)
+        task.add_done_callback(self._task_done)
+        return task
+
+    def _task_done(self, task: asyncio.Task) -> None:
+        self._tasks.discard(task)
+        if not task.cancelled() and task.exception() is not None:
+            log.error(
+                "%s: membership task failed",
+                self.node_id,
+                exc_info=task.exception(),
+            )
 
     # --- handlers (run on the receiving node) -----------------------------
 
@@ -76,8 +177,9 @@ class Membership:
             (n, *a) for n, a in self.members.items()
         ]
         self._add_member(node_id, (host, port))
-        # tell everyone else about the newcomer
-        asyncio.ensure_future(self._broadcast_up(node_id, (host, port)))
+        # tell everyone else about the newcomer (supervised: a dropped
+        # broadcast here is exactly how a view silently forks)
+        self._spawn(self._broadcast_up(node_id, (host, port)))
         return view
 
     def _handle_member_up(self, node_id: str, host: str, port: int) -> None:
@@ -87,33 +189,185 @@ class Membership:
     def _handle_leave(self, node_id: str) -> None:
         self._drop_member(node_id, graceful=True)
 
+    def _handle_ping(
+        self,
+        from_node: Optional[str] = None,
+        digests: Optional[Dict[str, int]] = None,
+        flags: Optional[Dict[str, Any]] = None,
+    ):
+        if from_node is None:
+            # legacy/bare probe (pre-piggyback callers, cookie checks)
+            return "pong"
+        caller_state = self.member_state.get(from_node, "unknown")
+        self.peer_flags[from_node] = dict(flags or {})
+        for cb in self.on_peer_flags:
+            cb(from_node, self.peer_flags[from_node])
+        if digests is not None:
+            # an EMPTY digest dict still flows: "I hold nothing of your
+            # contribution" is exactly the drift the exchange must see
+            for cb in self.on_peer_digests:
+                cb(from_node, digests)
+        if from_node in self._down:
+            # a peer we hold down reached US: one-way connectivity is
+            # back — probe outbound now instead of waiting a round
+            addr = self._down[from_node]
+            self._spawn(self._ping_one(from_node, addr))
+        return {
+            "node": self.node_id,
+            "caller_state": caller_state,
+            "digests": self._my_digests(),
+            "minority": self.minority,
+            "needs_rejoin": self.needs_rejoin,
+        }
+
+    # --- piggyback payloads -----------------------------------------------
+
+    def _my_digests(self) -> Dict[str, int]:
+        if self.digest_provider is None:
+            return {}
+        try:
+            return self.digest_provider()
+        except Exception:
+            log.exception("%s: digest provider failed", self.node_id)
+            return {}
+
+    def _my_flags(self) -> Dict[str, Any]:
+        return {
+            "minority": self.minority,
+            "needs_rejoin": self.needs_rejoin,
+        }
+
     # --- membership state -------------------------------------------------
+
+    def _set_state(self, node_id: str, state: str) -> None:
+        if self.member_state.get(node_id) == state:
+            return
+        self.member_state[node_id] = state
+        CLUSTER_METRICS.set_member_state(node_id, _STATE_GAUGE[state])
 
     def _add_member(self, node_id: str, addr: Addr) -> None:
         if node_id == self.node_id:
             return
         addr = tuple(addr)
+        was_down = self._down.pop(node_id, None) is not None
+        self.heal_available.discard(node_id)
         known = self.members.get(node_id)
-        if known == addr:
+        if known == addr and not was_down:
             return
         # a restarted node re-joins under the same id with a NEW
         # ephemeral address: update in place and re-fire member_up so
         # peers stop casting at the dead port
         self.members[node_id] = addr
         self._misses[node_id] = 0
+        self._set_state(node_id, "alive")
         log.info("%s: member up %s@%s", self.node_id, node_id, addr)
+        # partition re-evaluation BEFORE the callbacks: a minority exit
+        # must be visible to the resync/purge logic member_up triggers
+        self._maybe_mark_stable()
+        self._eval_partition()
         for cb in self.on_member_up:
             cb(node_id, addr)
 
     def _drop_member(self, node_id: str, graceful: bool) -> None:
-        if self.members.pop(node_id, None) is None:
+        if not graceful:
+            self._drop_members([node_id])
+            return
+        addr = self.members.pop(node_id, None)
+        if addr is None:
             return
         self._misses.pop(node_id, None)
-        log.info(
-            "%s: member %s %s", self.node_id, "left" if graceful else "DOWN", node_id
-        )
+        # an intentional shrink: forget entirely and shrink the
+        # stable view so the survivors don't read it as a split
+        self.member_state.pop(node_id, None)
+        self.peer_flags.pop(node_id, None)
+        CLUSTER_METRICS.drop_member(node_id)
+        self._stable_view.discard(node_id)
+        log.info("%s: member left %s", self.node_id, node_id)
+        self._eval_partition()
         for cb in self.on_member_down:
             cb(node_id)
+
+    def _drop_members(self, node_ids: Sequence[str]) -> None:
+        """Declare EVERY threshold-crossing peer of a round down before
+        the partition arbitration and the down callbacks run. A node
+        losing its whole majority at once must arbitrate against the
+        full loss — dropping one peer at a time would purge the first
+        peer's routes (still majority) and freeze only the rest."""
+        dropped = []
+        for node_id in node_ids:
+            addr = self.members.pop(node_id, None)
+            if addr is None:
+                continue
+            self._misses.pop(node_id, None)
+            # remember the addr: down peers are probed for heal
+            self._down[node_id] = addr
+            self._set_state(node_id, "down")
+            CLUSTER_METRICS.count("nodedown_total")
+            log.info("%s: member DOWN %s", self.node_id, node_id)
+            dropped.append(node_id)
+        if not dropped:
+            return
+        # partition evaluation BEFORE the down callbacks: a node that
+        # just lost its majority must freeze (not purge) the departed
+        # majority's routes — the callbacks check minority state
+        self._eval_partition()
+        for node_id in dropped:
+            for cb in self.on_member_down:
+                cb(node_id)
+
+    # --- partition arbitration --------------------------------------------
+
+    def _maybe_mark_stable(self) -> None:
+        """Refresh the stable view when every known peer is alive —
+        the denominator the majority rule divides against."""
+        if self._down:
+            return
+        if any(s != "alive" for s in self.member_state.values()):
+            return
+        self._stable_view = {self.node_id} | set(self.members)
+
+    def _eval_partition(self) -> None:
+        view = set(self._stable_view)
+        view.add(self.node_id)
+        # alive+suspect peers count as reachable; down peers do not.
+        # Peers outside the stable view (mid-join newcomers) don't vote.
+        reachable = {self.node_id} | (set(self.members) & view)
+        lost = 2 * len(reachable) < len(view) or (
+            2 * len(reachable) == len(view)
+            and min(view) not in reachable
+        )
+        if lost and not self.minority:
+            self.minority = True
+            self.needs_rejoin = True
+            self.partition_trips += 1
+            CLUSTER_METRICS.count("partition_total")
+            CLUSTER_METRICS.set_minority(self.node_id, True)
+            log.warning(
+                "%s: MINORITY — reachable %s of stable view %s",
+                self.node_id,
+                sorted(reachable),
+                sorted(view),
+            )
+            for cb in self.on_partition:
+                cb(True)
+        elif not lost and self.minority:
+            self.minority = False
+            self.partition_heals += 1
+            CLUSTER_METRICS.set_minority(self.node_id, False)
+            log.info(
+                "%s: minority healed — reachable %s of %s",
+                self.node_id,
+                sorted(reachable),
+                sorted(view),
+            )
+            for cb in self.on_partition:
+                cb(False)
+
+    def clear_needs_rejoin(self) -> None:
+        """Called by the cluster layer once a rejoin COMPLETED (paged
+        re-bootstrap + rebuild + resync) — not on mere reconnection."""
+        self.needs_rejoin = False
+        self.heal_available.clear()
 
     # --- lifecycle --------------------------------------------------------
 
@@ -151,35 +405,114 @@ class Membership:
         if self._hb_task is not None:
             self._hb_task.cancel()
             self._hb_task = None
+        for task in list(self._tasks):
+            task.cancel()
 
     async def _ping_one(self, node_id: str, addr: Addr) -> None:
         try:
             # CONTROL shard: failure detection must never queue behind
             # a bulk bootstrap/resync on the default channel
-            await self.rpc.call(
+            reply = await self.rpc.call(
                 addr,
                 "membership",
                 "ping",
+                (self.node_id, self._my_digests(), self._my_flags()),
                 key=rpc_mod.CONTROL,
                 timeout=self.ping_timeout,
             )
-            self._misses[node_id] = 0
-            for cb in self.on_ping_ok:
-                cb(node_id)
         except Exception:
-            self._misses[node_id] = self._misses.get(node_id, 0) + 1
-            if self._misses[node_id] >= self.miss_threshold:
-                self._drop_member(node_id, graceful=False)
+            if node_id in self._down:
+                return  # still down; keep probing next round
+            misses = self._misses.get(node_id, 0) + 1
+            self._misses[node_id] = misses
+            if misses == 1:
+                self._set_state(node_id, "suspect")
+                CLUSTER_METRICS.count("suspect_total")
+                log.info("%s: member SUSPECT %s", self.node_id, node_id)
+            if misses >= self.miss_threshold:
+                # crossed the threshold: returned to the round loop so
+                # every crossing of this round is declared as ONE batch
+                return node_id
+            return None
+        if node_id in self._down:
+            self._heal_detected(node_id)
+            if node_id not in self.members:
+                return  # autoheal off: recorded, not readmitted
+        if node_id not in self.members:
+            return  # gracefully left while the ping was in flight
+        self._misses[node_id] = 0
+        if self.member_state.get(node_id) != "alive":
+            self._set_state(node_id, "alive")
+            self._maybe_mark_stable()
+            self._eval_partition()
+        self._digest_reply(node_id, reply)
+        for cb in self.on_ping_ok:
+            cb(node_id)
+
+    def _digest_reply(self, node_id: str, reply) -> None:
+        if not isinstance(reply, dict):
+            return  # legacy "pong"
+        caller_state = reply.get("caller_state")
+        if caller_state in ("suspect", "down"):
+            # our ping landed, yet the peer can't reach us: asymmetric
+            # partition, visible rounds before the symmetric detector
+            if node_id not in self.asym_peers:
+                self.asym_peers.add(node_id)
+                CLUSTER_METRICS.count("asymmetry_total")
+                log.warning(
+                    "%s: ASYMMETRIC partition vs %s (peer holds us %s)",
+                    self.node_id,
+                    node_id,
+                    caller_state,
+                )
+        else:
+            self.asym_peers.discard(node_id)
+        self.peer_flags[node_id] = {
+            "minority": reply.get("minority", False),
+            "needs_rejoin": reply.get("needs_rejoin", False),
+        }
+        for cb in self.on_peer_flags:
+            cb(node_id, self.peer_flags[node_id])
+        digests = reply.get("digests")
+        if digests is not None:
+            for cb in self.on_peer_digests:
+                cb(node_id, digests)
+
+    def _heal_detected(self, node_id: str) -> None:
+        addr = self._down.get(node_id)
+        if addr is None:
+            return
+        if not self.autoheal:
+            if node_id not in self.heal_available:
+                self.heal_available.add(node_id)
+                log.warning(
+                    "%s: heal AVAILABLE from %s but cluster.autoheal is "
+                    "off — staying partitioned",
+                    self.node_id,
+                    node_id,
+                )
+            return
+        log.info("%s: heal detected from %s", self.node_id, node_id)
+        CLUSTER_METRICS.count("heal_total")
+        self._add_member(node_id, addr)  # re-fires member_up → resync
+        for cb in self.on_heal:
+            cb(node_id)
 
     async def _heartbeat_loop(self) -> None:
         while True:
-            await asyncio.sleep(self.heartbeat_interval)
+            # ±15% jitter: multi-node clusters must not synchronize
+            # their ping bursts onto the CONTROL shard
+            await asyncio.sleep(
+                self.heartbeat_interval * random.uniform(0.85, 1.15)
+            )
             # concurrent pings: one black-holed peer must not delay
-            # failure detection for the others
-            await asyncio.gather(
-                *(
-                    self._ping_one(n, a)
-                    for n, a in list(self.members.items())
-                ),
+            # failure detection for the others. Down peers are probed
+            # too — that probe IS heal detection.
+            targets = list(self.members.items()) + list(self._down.items())
+            results = await asyncio.gather(
+                *(self._ping_one(n, a) for n, a in targets),
                 return_exceptions=True,
             )
+            crossed = [r for r in results if isinstance(r, str)]
+            if crossed:
+                self._drop_members(crossed)

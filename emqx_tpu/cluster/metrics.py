@@ -1,0 +1,125 @@
+"""Cluster-plane metric surface: the `emqx_cluster_*` Prometheus families.
+
+The replication plane (membership failure detector, partition
+arbitration, autoheal, route anti-entropy) gets its own namespace for
+the same reason the durable tier does (ds/metrics.py): partition and
+heal events happen on membership timers that outlive any single broker
+or scrape object, and the chaos harness runs several in-process nodes
+whose transitions must aggregate into ONE process ledger the lint leg
+can assert deltas against. Counters are process-global and monotonic;
+tests assert deltas, never absolutes.
+
+Every family renders on every scrape with a zero default: the static
+gate's driven-scrape leg requires each declared family to emit at
+least one sample, and an absent-until-first-partition family would
+read as "no exposition code" instead of "no partitions yet".
+
+Rendered families (all counters unless noted):
+
+  # TYPE emqx_cluster_suspect_total counter
+  # TYPE emqx_cluster_nodedown_total counter
+  # TYPE emqx_cluster_partition_total counter
+  # TYPE emqx_cluster_heal_total counter
+  # TYPE emqx_cluster_autoheal_rejoin_total counter
+  # TYPE emqx_cluster_asymmetry_total counter
+  # TYPE emqx_cluster_antientropy_checks_total counter
+  # TYPE emqx_cluster_antientropy_divergence_total counter
+  # TYPE emqx_cluster_antientropy_repairs_total counter
+  # TYPE emqx_cluster_registry_conflicts_total counter
+  # TYPE emqx_cluster_member_state gauge      (labeled {peer}; 2=alive
+                                               1=suspect 0=down)
+  # TYPE emqx_cluster_minority gauge          (labeled {node_id})
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List
+
+_COUNTER_FAMILIES = (
+    "suspect_total",
+    "nodedown_total",
+    "partition_total",
+    "heal_total",
+    "autoheal_rejoin_total",
+    "asymmetry_total",
+    "antientropy_checks_total",
+    "antientropy_divergence_total",
+    "antientropy_repairs_total",
+    "registry_conflicts_total",
+)
+
+# member_state gauge values
+STATE_ALIVE = 2
+STATE_SUSPECT = 1
+STATE_DOWN = 0
+
+
+class ClusterMetrics:
+    """Process-global cluster-plane ledger (see module docstring)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.counters: Dict[str, int] = {n: 0 for n in _COUNTER_FAMILIES}
+        # member_state{peer} — latest detector state per observed peer
+        self.member_state: Dict[str, int] = {}
+        # minority{node_id} — 1 while that node is in declared minority
+        self.minority: Dict[str, int] = {}
+
+    def count(self, name: str, n: int = 1) -> None:
+        if n:
+            with self._lock:
+                self.counters[name] = self.counters.get(name, 0) + int(n)
+        return None
+
+    def set_member_state(self, peer: str, state: int) -> None:
+        with self._lock:
+            self.member_state[peer] = int(state)
+
+    def drop_member(self, peer: str) -> None:
+        """Graceful leave: the peer is gone, not down — drop its sample."""
+        with self._lock:
+            self.member_state.pop(peer, None)
+
+    def set_minority(self, node_id: str, flag: bool) -> None:
+        with self._lock:
+            self.minority[node_id] = 1 if flag else 0
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self.counters)
+
+    def prometheus_lines(self, node_name: str = "emqx@127.0.0.1") -> List[str]:
+        node = f'node="{node_name}"'
+        with self._lock:
+            counters = dict(self.counters)
+            member_state = dict(self.member_state)
+            minority = dict(self.minority)
+        lines: List[str] = []
+        for name in _COUNTER_FAMILIES:
+            fam = f"emqx_cluster_{name}"
+            lines.append(f"# TYPE {fam} counter")
+            lines.append(f"{fam}{{{node}}} {counters.get(name, 0)}")
+        fam = "emqx_cluster_member_state"
+        lines.append(f"# TYPE {fam} gauge")
+        if member_state:
+            for peer in sorted(member_state):
+                lines.append(
+                    f'{fam}{{{node},peer="{peer}"}} {member_state[peer]}'
+                )
+        else:
+            # zero default keeps the family sampled pre-first-peer
+            lines.append(f'{fam}{{{node},peer="none"}} 0')
+        fam = "emqx_cluster_minority"
+        lines.append(f"# TYPE {fam} gauge")
+        if minority:
+            for nid in sorted(minority):
+                lines.append(
+                    f'{fam}{{{node},node_id="{nid}"}} {minority[nid]}'
+                )
+        else:
+            lines.append(f'{fam}{{{node},node_id="none"}} 0')
+        return lines
+
+
+CLUSTER_METRICS = ClusterMetrics()

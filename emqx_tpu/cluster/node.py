@@ -27,17 +27,20 @@ Shape (mirrors the reference, SURVEY.md §3.3/§3.4):
 from __future__ import annotations
 
 import asyncio
+import hashlib
 import logging
 import time
 import zlib
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from ..broker.message import Message
-from ..broker.packet import SubOpts
+from ..broker.packet import Disconnect, RC, SubOpts
 from ..broker.pubsub import GROUP_DEST, Broker
 from ..models.router import Router
 from ..models.shared_sub import SharedSubs
+from .heal import Autoheal
 from .membership import Addr, Membership
+from .metrics import CLUSTER_METRICS
 from .rpc import PeerDown, RpcError, RpcPlane
 
 log = logging.getLogger("emqx_tpu.cluster.node")
@@ -141,6 +144,8 @@ class ClusterNode:
         miss_threshold: int = 3,
         cookie: Optional[str] = None,
         ping_timeout: Optional[float] = None,
+        autoheal: bool = True,
+        partition_policy: str = "degrade",
     ):
         self.node_id = node_id
         self.broker = broker or ClusterBroker()
@@ -152,7 +157,38 @@ class ClusterNode:
             heartbeat_interval=heartbeat_interval,
             miss_threshold=miss_threshold,
             ping_timeout=ping_timeout,
+            autoheal=autoheal,
         )
+        if partition_policy not in ("degrade", "isolate"):
+            raise ValueError(
+                f"bad cluster.partition_policy {partition_policy!r}"
+            )
+        # minority posture (cluster.partition_policy): "degrade" keeps
+        # serving local sessions with the route replica frozen;
+        # "isolate" additionally refuses remote publishes/route writes
+        self.partition_policy = partition_policy
+        self.minority = False
+        # observability seams (attach_obs): alarm + flight-bundle on
+        # partition entry, alarm on repeated anti-entropy divergence
+        self.alarms = None
+        self.flight = None
+        # order-independent per-origin replica digest: XOR of entry
+        # hashes over routes + shared membership + registry — the mria
+        # shard-replay / route-consistency analog. Exchanged on every
+        # ping; own-contribution mismatch == counted divergence.
+        self._contrib_digest: Dict[str, int] = {}
+        self._ae_mismatch: Dict[str, int] = {}  # consecutive per peer
+        self._ae_divergence: Dict[str, int] = {}  # tally per peer
+        self._ae_pending: Set[str] = set()  # repairs in flight
+        self._ae_checks = 0
+        self._ae_divergences = 0
+        self._ae_repairs = 0
+        self.registry_conflicts = 0
+        self.rejoins = 0
+        # serializes join/rejoin: a manual join and a concurrent
+        # coordinator-directed rejoin must not interleave their paged
+        # bootstraps
+        self._rejoin_lock = asyncio.Lock()
         # cluster route table: filter -> node ids (FULL replica; a
         # Router so batched cluster matching uses the TPU kernel)
         self.cluster_router = Router(max_levels=self.broker.router.max_levels)
@@ -218,6 +254,14 @@ class ClusterNode:
         self.membership.on_member_down.append(self._purge_locks)
         self.membership.on_member_up.append(self._on_member_up)
         self.membership.on_ping_ok.append(self._maybe_resync)
+        # route anti-entropy + partition posture ride the ping exchange
+        self.membership.digest_provider = self.replica_digests
+        self.membership.on_peer_digests.append(self._on_peer_digests)
+        self.membership.on_partition.append(self._on_partition)
+        # autoheal coordinator (ekka_autoheal analog) — registered even
+        # when disabled so a mixed cluster's coordinator can still
+        # reach this node's rejoin handler
+        self.heal = Autoheal(self, enabled=autoheal)
         # a broker attached with pre-existing sessions/subscriptions:
         # seed local refs + cluster tables from its current state (the
         # callbacks above only see transitions from here on)
@@ -230,7 +274,7 @@ class ClusterNode:
             for client in members:
                 self.on_shared_subscribed(group, flt, client)
         for client in self.broker.sessions:
-            self.registry[client] = self.node_id
+            self._reg_set(client, self.node_id)
 
     # --- lifecycle --------------------------------------------------------
 
@@ -241,6 +285,10 @@ class ClusterNode:
         return addr
 
     async def join(self, seed: Addr) -> None:
+        async with self._rejoin_lock:
+            await self._join_inner(seed)
+
+    async def _join_inner(self, seed: Addr) -> None:
         await self.membership.join(seed)
         # bootstrap the replicated tables from the seed (mria join
         # copy), PAGED: million-route tables must neither exceed the
@@ -255,7 +303,7 @@ class ClusterNode:
             )
             self._apply_ops(page["ops"])
             for client, node in page["sessions"]:
-                self.registry[client] = node
+                self._reg_apply_conflict(client, node)
             token, cursor = page["token"], page["next"]
             if page["done"]:
                 break
@@ -268,6 +316,37 @@ class ClusterNode:
         await self._resync_all()
         self.membership.start_heartbeat()
 
+    async def rejoin(self, seed: Addr) -> None:
+        """Minority-side heal path, directed by the autoheal
+        coordinator (or run manually): drop every REMOTE origin's
+        replica contribution — the majority may have deleted entries
+        while we were split, and set-semantic re-application would let
+        stale rows survive — then re-bootstrap through the paged join,
+        re-derive our own contribution from live local state, and force
+        a full device re-upload through the existing quarantine/resync
+        path. Completion (not mere reconnection) clears needs_rejoin."""
+        async with self._rejoin_lock:
+            if not self.membership.needs_rejoin:
+                return  # already rejoined (duplicate directive)
+            log.warning("%s: REJOIN via %s", self.node_id, seed)
+            for origin in self._known_origins():
+                if origin != self.node_id:
+                    self._purge_contrib(origin)
+            await self._join_inner(seed)
+            self.broker.router.device_resync()
+            self.membership.clear_needs_rejoin()
+            self.rejoins += 1
+            CLUSTER_METRICS.count("autoheal_rejoin_total")
+            log.info("%s: rejoin complete", self.node_id)
+
+    def _known_origins(self) -> Set[str]:
+        origins = {node for _flt, node in self._cluster_pairs}
+        origins.update(self.registry.values())
+        origins.update(self._contrib_digest)
+        for (_g, _f), members in self.cluster_shared.items():
+            origins.update(m[0] for m in members)
+        return origins
+
     def _rebuild_self(self) -> None:
         """Re-derive this node's cluster contributions from its live
         broker state (the local tables are the source of truth)."""
@@ -277,7 +356,7 @@ class ClusterNode:
             for client in members:
                 self._shared_add(group, flt, self.node_id, client)
         for client in self.broker.sessions:
-            self.registry[client] = self.node_id
+            self._reg_set(client, self.node_id)
 
     async def _resync_all(self) -> None:
         for node, addr in list(self.membership.members.items()):
@@ -425,6 +504,35 @@ class ClusterNode:
                     agg["slo_breached"].append(f"{node}:{name}")
         return {"cluster": agg, "per_node": nodes}
 
+    # --- replica digests (route anti-entropy) -----------------------------
+
+    @staticmethod
+    def _entry_hash(entry: tuple) -> int:
+        return int.from_bytes(
+            hashlib.blake2b(repr(entry).encode(), digest_size=8).digest(),
+            "big",
+        )
+
+    def _dig(self, origin: str, entry: tuple) -> None:
+        """XOR-toggle `entry` in `origin`'s contribution digest. Every
+        caller sits INSIDE a mutation guard — the toggle fires iff the
+        replica actually inserted/removed the entry, so the digest is a
+        pure function of replica content, order-independent, and equal
+        across converged nodes. Entries: ("r", flt) routes,
+        ("s", group, flt, client) shared members, ("c", client)
+        registry rows. Exclusive claims are excluded (their conflict
+        machinery re-announces; they are not page-resynced)."""
+        d = self._contrib_digest.get(origin, 0) ^ self._entry_hash(entry)
+        if d:
+            self._contrib_digest[origin] = d
+        else:
+            self._contrib_digest.pop(origin, None)
+
+    def replica_digests(self) -> Dict[str, int]:
+        """Per-origin digest map, piggybacked on pings and compared by
+        every peer against its own-contribution digest."""
+        return dict(self._contrib_digest)
+
     # --- route write stream (local transitions -> announced ops) ---------
 
     def _route_add(self, flt: str, node: str) -> None:
@@ -433,11 +541,13 @@ class ClusterNode:
         if (flt, node) not in self._cluster_pairs:
             self._cluster_pairs.add((flt, node))
             self.cluster_router.add_route(flt, node)
+            self._dig(node, ("r", flt))
 
     def _route_del(self, flt: str, node: str) -> None:
         if (flt, node) in self._cluster_pairs:
             self._cluster_pairs.discard((flt, node))
             self.cluster_router.delete_route(flt, node)
+            self._dig(node, ("r", flt))
 
     def _on_local_dest_added(self, flt: str, dest) -> None:
         if isinstance(dest, tuple) and dest and dest[0] == GROUP_DEST:
@@ -460,10 +570,19 @@ class ClusterNode:
             self._local_refs[flt] = n
 
     def _shared_add(self, group: str, flt: str, node: str, client: str) -> None:
+        # membership pre-check: subscribe() reports "first member of
+        # group", not "newly added" — the digest must toggle only on an
+        # actual insert
+        if (node, client) in self.cluster_shared.members(group, flt):
+            return
+        self._dig(node, ("s", group, flt, client))
         if self.cluster_shared.subscribe(group, flt, (node, client)):
             self.group_router.add_route(flt, (GROUP_DEST, group, flt))
 
     def _shared_del(self, group: str, flt: str, node: str, client: str) -> None:
+        if (node, client) not in self.cluster_shared.members(group, flt):
+            return
+        self._dig(node, ("s", group, flt, client))
         if self.cluster_shared.unsubscribe(group, flt, (node, client)):
             self.group_router.delete_route(flt, (GROUP_DEST, group, flt))
 
@@ -549,17 +668,91 @@ class ClusterNode:
         self._exclusive_owner.pop(topic, None)
 
     def announce_session(self, client: str) -> None:
-        self.registry[client] = self.node_id
+        self._reg_set(client, self.node_id)
         self._enqueue_op(("sess_up", client, self.node_id))
 
     def retract_session(self, client: str) -> None:
         if self.registry.get(client) == self.node_id:
-            del self.registry[client]
+            self._reg_del(client)
         self._enqueue_op(("sess_down", client, self.node_id))
+
+    # --- registry funnel (emqx_cm_registry writes + digest upkeep) --------
+
+    def _reg_set(self, client: str, node: str) -> None:
+        cur = self.registry.get(client)
+        if cur == node:
+            return
+        if cur is not None:
+            self._dig(cur, ("c", client))
+        self._dig(node, ("c", client))
+        self.registry[client] = node
+
+    def _reg_del(self, client: str) -> None:
+        cur = self.registry.pop(client, None)
+        if cur is not None:
+            self._dig(cur, ("c", client))
+
+    def _reg_apply_conflict(self, client: str, node: str) -> None:
+        """Apply a bootstrap/resync registry row, resolving split-brain
+        conflicts: the same client_id live on BOTH halves resolves to a
+        deterministic winner (lowest node id — symmetric, so both sides
+        agree without coordination) and the loser's session gets the
+        takeover kick, riding the rebalance eviction surface."""
+        if (
+            node != self.node_id
+            and self.registry.get(client) == self.node_id
+            and client in self.broker.sessions
+        ):
+            self.registry_conflicts += 1
+            CLUSTER_METRICS.count("registry_conflicts_total")
+            winner = min(node, self.node_id)
+            log.warning(
+                "%s: registry conflict on %r (also on %s) — winner %s",
+                self.node_id, client, node, winner,
+            )
+            if winner == self.node_id:
+                # keep ours; the peer resolves symmetrically from our
+                # resync page and kicks its copy
+                return
+            self._kick_conflict_loser(client, node)
+        self._reg_set(client, node)
+
+    def _kick_conflict_loser(self, client: str, winner: str) -> None:
+        """Disconnect our (losing) copy of a doubly-registered client:
+        v5 DISCONNECT USE_ANOTHER_SERVER pointing at the winner, then
+        discard — the same wire contract the EvictionAgent uses
+        (cluster/rebalance.py)."""
+        session = self.broker.sessions.get(client)
+        if session is None:
+            return
+        sink = getattr(session, "outgoing_sink", None)
+        if sink is not None:
+            try:
+                sink([
+                    Disconnect(
+                        RC.USE_ANOTHER_SERVER,
+                        props={"server_reference": winner},
+                    )
+                ])
+            except Exception:
+                pass
+        closer = getattr(session, "closer", None)
+        if closer is not None:
+            try:
+                closer()
+            except Exception:
+                pass
+        session.connected = False
+        self.broker.close_session(session, discard=True)
 
     # --- syncer (batched op replication) ----------------------------------
 
     def _enqueue_op(self, op: tuple) -> None:
+        if self.minority and self.partition_policy == "isolate":
+            # isolate: a minority node must not replicate writes it
+            # cannot arbitrate — rejoin re-derives its contribution
+            # from live local state instead
+            return
         if not self.membership.members:
             return
         self._op_queue.append(op)
@@ -575,7 +768,7 @@ class ClusterNode:
         if not self._op_queue:
             return
         ops, self._op_queue = self._op_queue, []
-        asyncio.ensure_future(self._broadcast_ops(ops))
+        self._spawn(self._broadcast_ops(ops))
 
     async def _broadcast_ops(self, ops: List[tuple]) -> None:
         """Replicate an op batch to every peer. Pushes are ACKED calls
@@ -634,6 +827,7 @@ class ClusterNode:
                 flt, node = op[1], op[2]
                 if (flt, node) not in self._cluster_pairs:
                     self._cluster_pairs.add((flt, node))
+                    self._dig(node, ("r", flt))
                     pend_adds.append((flt, node))
                     if len(pend_adds) >= 1000:
                         flush_adds()
@@ -643,6 +837,7 @@ class ClusterNode:
                 flt, node = op[1], op[2]
                 if (flt, node) in self._cluster_pairs:
                     self._cluster_pairs.discard((flt, node))
+                    self._dig(node, ("r", flt))
                     pend_dels.append((flt, node))
                     if len(pend_dels) >= 1000:
                         flush_dels()
@@ -655,10 +850,10 @@ class ClusterNode:
                 _k, group, flt, node, client = op
                 self._shared_del(group, flt, node, client)
             elif kind == "sess_up":
-                self.registry[op[1]] = op[2]
+                self._reg_set(op[1], op[2])
             elif kind == "sess_down":
                 if self.registry.get(op[1]) == op[2]:
-                    del self.registry[op[1]]
+                    self._reg_del(op[1])
             elif kind == "xadd":
                 self._xadd(op[1], op[2], op[3])
             elif kind == "xdel":
@@ -759,7 +954,144 @@ class ClusterNode:
             self._purge_contrib(origin)
         self._apply_ops(ops)
         for client, node in sessions:
-            self.registry[client] = node
+            self._reg_apply_conflict(client, node)
+
+    # --- digest anti-entropy (mria shard-replay analog) --------------------
+
+    def _on_peer_digests(self, peer: str, theirs: Dict[str, int]) -> None:
+        """Compare a peer's piggybacked digests against OUR OWN
+        contribution (each node repairs what it authored — both sides
+        of a drifted pair see the divergence through their own lens, so
+        coverage is symmetric without a pull RPC). Two CONSECUTIVE
+        mismatched rounds count a divergence (one round can be an
+        in-flight op batch) and trigger a targeted paged resync; the
+        repair is counted when the resync lands."""
+        self._ae_checks += 1
+        CLUSTER_METRICS.count("antientropy_checks_total")
+        mine = self._contrib_digest.get(self.node_id, 0)
+        if theirs.get(self.node_id, 0) == mine:
+            self._ae_mismatch[peer] = 0
+            if self._ae_divergence.pop(peer, None) is not None:
+                if not self._ae_divergence and self.alarms is not None:
+                    self.alarms.ensure_deactivated(
+                        "cluster_antientropy_divergence"
+                    )
+            return
+        miss = self._ae_mismatch.get(peer, 0) + 1
+        self._ae_mismatch[peer] = miss
+        if miss < 2 or peer in self._ae_pending:
+            return
+        self._ae_mismatch[peer] = 0
+        self._ae_divergences += 1
+        CLUSTER_METRICS.count("antientropy_divergence_total")
+        tally = self._ae_divergence.get(peer, 0) + 1
+        self._ae_divergence[peer] = tally
+        log.warning(
+            "%s: replica DIVERGENCE at %s (our contribution; tally %d) "
+            "— repairing",
+            self.node_id, peer, tally,
+        )
+        if tally >= 3 and self.alarms is not None:
+            # repeated divergence at the same peer: repairs land but
+            # the replica keeps drifting — page the operator
+            self.alarms.ensure(
+                "cluster_antientropy_divergence",
+                details={"peer": peer, "tally": tally},
+                message=f"replica at {peer} diverged {tally}x",
+            )
+        self._ae_pending.add(peer)
+        self._spawn(self._repair_peer(peer))
+
+    async def _repair_peer(self, peer: str) -> None:
+        addr = self.membership.members.get(peer)
+        if addr is None:
+            self._ae_pending.discard(peer)
+            return
+        try:
+            await self._send_resync(addr)
+        except Exception:
+            # peer went unreachable mid-repair: fall back to the
+            # ping-gated resync path and retry the repair from there
+            self._ae_pending.discard(peer)
+            self._resync.add(peer)
+            return
+        self._ae_pending.discard(peer)
+        self._ae_repairs += 1
+        CLUSTER_METRICS.count("antientropy_repairs_total")
+
+    # --- partition posture (cluster.partition_policy) ----------------------
+
+    def attach_obs(self, alarms=None, flight=None) -> None:
+        """Wire the observability seams: `cluster_partition` alarm +
+        flight bundle on minority entry, divergence alarm for
+        anti-entropy (boot.py / chaos engine call this)."""
+        self.alarms = alarms
+        self.flight = flight
+
+    def _on_partition(self, entered: bool) -> None:
+        self.minority = entered
+        ms = self.membership
+        if entered:
+            details = {
+                "node": self.node_id,
+                "policy": self.partition_policy,
+                "stable_view": sorted(ms._stable_view),
+                "reachable": sorted({self.node_id, *ms.members}),
+            }
+            if self.alarms is not None:
+                self.alarms.ensure(
+                    "cluster_partition",
+                    details=details,
+                    message=(
+                        f"{self.node_id} lost majority — "
+                        f"{self.partition_policy} mode"
+                    ),
+                )
+            if self.flight is not None:
+                self.flight.maybe_trigger("cluster_partition", details)
+        else:
+            if self.alarms is not None:
+                self.alarms.ensure_deactivated("cluster_partition")
+
+    def cluster_status(self) -> dict:
+        """Partition/autoheal/anti-entropy posture for the telemetry
+        API and `ctl cluster` (same shape discipline as the sentinel
+        and breaker status blocks)."""
+        ms = self.membership
+        return {
+            "node": self.node_id,
+            "members": {
+                n: {"addr": list(a), "state": ms.member_state.get(n, "alive")}
+                for n, a in ms.members.items()
+            },
+            "down": sorted(ms._down),
+            "stable_view": sorted(ms._stable_view),
+            "minority": ms.minority,
+            "partition_policy": self.partition_policy,
+            "partition_trips": ms.partition_trips,
+            "partition_heals": ms.partition_heals,
+            "needs_rejoin": ms.needs_rejoin,
+            "heal_available": sorted(ms.heal_available),
+            "asymmetric_peers": sorted(ms.asym_peers),
+            "autoheal": {
+                "enabled": self.heal.enabled,
+                "coordinator": self.heal.coordinator(),
+                "rejoins_directed": self.heal.rejoins_directed,
+                "rejoins_completed": self.rejoins,
+            },
+            "antientropy": {
+                "checks": self._ae_checks,
+                "divergences": self._ae_divergences,
+                "repairs": self._ae_repairs,
+                "pending": sorted(self._ae_pending),
+            },
+            "registry_conflicts": self.registry_conflicts,
+            "digests": {
+                o: format(d, "016x")
+                for o, d in sorted(self._contrib_digest.items())
+            },
+            "resync_pending": sorted(self._resync),
+        }
 
     # --- publish-path cluster legs ---------------------------------------
 
@@ -768,6 +1100,11 @@ class ClusterNode:
         and elect shared-group members cluster-wide. Returns deliveries
         initiated (remote forwards count as 1 each, like the reference
         counting a forward as one delivery leg)."""
+        if self.minority and self.partition_policy == "isolate":
+            # isolate: remote destinations are refused outright while
+            # in declared minority (local sessions keep being served
+            # by the direct-dispatch leg)
+            return 0
         dests = self.cluster_router.match_routes(msg.topic)
         remote_nodes = {d for d in dests if isinstance(d, str) and d != self.node_id}
         n = 0
@@ -1094,7 +1431,19 @@ class ClusterNode:
     # --- failure handling ---------------------------------------------------
 
     def _purge_node(self, node_id: str) -> None:
-        """Survivor-side cleanup of a dead node (router_helper analog)."""
+        """Survivor-side cleanup of a dead node (router_helper analog).
+        A MINORITY node freezes instead of purging: it cannot tell a
+        dead peer from its own isolation, and the majority's routes
+        must survive locally until rejoin re-bootstraps the replica
+        (both partition policies; `degrade` keeps serving local matches
+        against the frozen table)."""
+        if self.minority:
+            log.warning(
+                "%s: minority — route purge of %s FROZEN pending rejoin",
+                self.node_id, node_id,
+            )
+            self._resync.discard(node_id)
+            return
         self._purge_contrib(node_id)
         self._resync.discard(node_id)
 
@@ -1118,10 +1467,14 @@ class ClusterNode:
                     self._shared_del(group, flt, m[0], m[1])
         for client, node in list(self.registry.items()):
             if node == node_id:
-                del self.registry[client]
+                self._reg_del(client)
         for topic, node in list(self._exclusive_owner.items()):
             if node == node_id and node_id != self.node_id:
                 # self-purge (rejoin) must NOT delete broker-LOCAL
                 # truth — live local claims re-announce via the dump
                 self.broker.exclusive.pop(topic, None)
                 del self._exclusive_owner[topic]
+        # a purge is ground truth — NOTHING remains from this origin —
+        # so the digest hard-resets rather than trusting the toggles to
+        # cancel (they wouldn't, if this purge is repairing drift)
+        self._contrib_digest.pop(node_id, None)

@@ -231,22 +231,49 @@ class RpcPlane:
         # RST, no fast failure). This is what the bounded-timeout +
         # retry discipline in ClusterNode is tested against.
         self._partitioned: set = set()
+        # inbound leg of the same seam: node_ids whose frames this
+        # server silently drops after reading them — the caller's call
+        # burns its full timeout, exactly a one-way blackhole (the
+        # asymmetric-partition case the three-state detector alone
+        # cannot see)
+        self._partitioned_in: set = set()
         # negotiated versions per peer node (from either hello direction)
         self.peer_versions: Dict[str, Dict[str, int]] = {}
         self._addr_node: Dict[Tuple[str, int], str] = {}
 
     # --- chaos partition seam --------------------------------------------
 
-    def partition(self, addr: Tuple[str, int]) -> None:
-        """Black-hole traffic toward `addr` (outbound leg). Symmetric
-        partitions call this on both planes."""
-        self._partitioned.add(tuple(addr))
+    def partition(
+        self, addr: Tuple[str, int], direction: str = "out"
+    ) -> None:
+        """Black-hole traffic with `addr`. `direction` picks the legs:
+        "out" (default) black-holes our calls/casts TOWARD addr;
+        "in" drops frames the server reads FROM that peer (resolved to
+        its node id via the hello map); "both" does both. Symmetric
+        partitions call this on both planes; asymmetric ones inject a
+        single "in" (or "out") leg on one plane only."""
+        if direction not in ("out", "in", "both"):
+            raise ValueError(f"bad partition direction {direction!r}")
+        if direction in ("out", "both"):
+            self._partitioned.add(tuple(addr))
+        if direction in ("in", "both"):
+            node = self._addr_node.get(tuple(addr))
+            if node is None:
+                raise ValueError(
+                    f"cannot inbound-partition unknown peer {addr!r} "
+                    "(no hello seen yet)"
+                )
+            self._partitioned_in.add(node)
 
     def heal(self, addr: Optional[Tuple[str, int]] = None) -> None:
         if addr is None:
             self._partitioned.clear()
+            self._partitioned_in.clear()
         else:
             self._partitioned.discard(tuple(addr))
+            node = self._addr_node.get(tuple(addr))
+            if node is not None:
+                self._partitioned_in.discard(node)
 
     def is_partitioned(self, addr: Tuple[str, int]) -> bool:
         return tuple(addr) in self._partitioned
@@ -325,6 +352,11 @@ class RpcPlane:
             )
             while True:
                 frame = await _read_frame(reader)
+                if peer_node in self._partitioned_in:
+                    # injected one-way blackhole: the frame is read off
+                    # the wire but never served — a call's reply simply
+                    # never comes, so the caller burns its timeout
+                    continue
                 kind = frame[0]
                 if kind == "call":
                     _, req_id, proto, version, method, args = frame

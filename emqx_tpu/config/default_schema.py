@@ -136,6 +136,13 @@ def broker_schema() -> Struct:
                         ),
                         "static_seeds": Field(Array(String()), default=[]),
                         "autoheal": Field(Bool(), default=True),
+                        # minority posture during a partition: "degrade"
+                        # serves local sessions with routes frozen;
+                        # "isolate" additionally refuses remote
+                        # publishes/route writes until rejoin
+                        "partition_policy": Field(
+                            Enum("degrade", "isolate"), default="degrade"
+                        ),
                         "autoclean": Field(Duration(), default=86_400_000),
                     }
                 )

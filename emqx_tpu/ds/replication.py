@@ -113,6 +113,7 @@ class ReplicatedDs:
         # the stalled writes instead of relying on fresh traffic
         self.retry_interval_s = 0.5
         self._retry_task = None
+        self._tasks: Set[asyncio.Task] = set()
         self._beat_tick = 0
         self._beat_last: Dict[int, int] = {}
         self._spawn_retry()
@@ -173,7 +174,10 @@ class ReplicatedDs:
     def _spawn(self, coro) -> None:
         """Schedule an RPC coroutine on the node's loop — writes arrive
         from the DS buffer's flush THREAD, so cross-thread handoff must
-        go through call_soon_threadsafe."""
+        go through call_soon_threadsafe. Handles are retained in
+        `_tasks` until completion so the loop can never GC an in-flight
+        replication write, and failures are logged instead of vanishing
+        at interpreter shutdown."""
         loop = getattr(self.node, "_loop", None)
         if loop is None or loop.is_closed():
             coro.close()
@@ -183,12 +187,25 @@ class ReplicatedDs:
         except RuntimeError:
             running = None
         if running is loop:
-            asyncio.ensure_future(coro)
+            self._spawn_on_loop(coro)
         else:
             try:
-                loop.call_soon_threadsafe(asyncio.ensure_future, coro)
+                loop.call_soon_threadsafe(self._spawn_on_loop, coro)
             except RuntimeError:
                 coro.close()
+
+    def _spawn_on_loop(self, coro) -> None:
+        task = asyncio.ensure_future(coro)
+        self._tasks.add(task)
+        task.add_done_callback(self._task_done)
+
+    def _task_done(self, task) -> None:
+        self._tasks.discard(task)
+        if not task.cancelled() and task.exception() is not None:
+            log.error(
+                "ds replication task failed",
+                exc_info=task.exception(),
+            )
 
     def _spawn_retry(self) -> None:
         async def loop():

@@ -162,6 +162,14 @@ class ExHookBridge:
         # back onto it
         self._main_loop: Optional[asyncio.AbstractEventLoop] = None
         self.metrics = {"calls": 0, "failures": 0, "casts": 0}
+        self._bg_tasks: set = set()  # retained recv/reconnect handles
+
+    def _bg(self, coro) -> None:
+        """Spawn a bridge-loop background task with the handle retained
+        (an unreferenced recv loop is eligible for GC mid-flight)."""
+        task = asyncio.ensure_future(coro)
+        self._bg_tasks.add(task)
+        task.add_done_callback(self._bg_tasks.discard)
 
     # --- lifecycle -------------------------------------------------------
 
@@ -189,7 +197,7 @@ class ExHookBridge:
                         hello = await _read_frame(self._reader)
                         assert hello[0] == "hello", hello
                         self.hookpoints = list(hello[1])
-                        asyncio.ensure_future(self._recv_loop())
+                        self._bg(self._recv_loop())
                 except Exception as e:  # noqa: BLE001
                     err.append(e)
                 finally:
@@ -280,7 +288,7 @@ class ExHookBridge:
                     writer.close()
                 except Exception:
                     pass
-            asyncio.ensure_future(self._reconnect_loop())
+            self._bg(self._reconnect_loop())
 
     async def _reconnect_loop(self) -> None:
         """Retry the server with capped exponential backoff; while the
@@ -314,7 +322,7 @@ class ExHookBridge:
                         )
                     else:
                         self._rebind_hooks(new_points)
-                asyncio.ensure_future(self._recv_loop())
+                self._bg(self._recv_loop())
                 return
             except Exception:
                 if writer is not None:

@@ -1334,6 +1334,10 @@ class ManagementApi:
                 "admission": es["admission"],
                 "coalesce_factor": es["coalesce_factor"],
             }
+        if self.node is not None:
+            # split-brain failure domain: membership states, partition
+            # arbitration, autoheal + route anti-entropy ledgers
+            out["cluster"] = self.node.cluster_status()
         return out
 
     def _xla_sentinel(self, req: Request):

@@ -256,10 +256,70 @@ class Ctl:
         )
 
     def _cluster(self, args) -> str:
+        """emqx ctl cluster — membership view plus the split-brain
+        failure domain: per-peer failure-detector states, partition
+        arbitration, autoheal progress, route anti-entropy ledger
+        (cluster/membership.py + cluster/node.py cluster_status)."""
         members = views.cluster_members(self.node, self.node_name)
         if self.node is None:
             return f"running nodes: {members} (standalone)"
-        return f"Cluster status: #{{running_nodes => {members}}}"
+        sub = args[0] if args else "status"
+        st = self.node.cluster_status()
+        if sub == "status":
+            peers = ", ".join(
+                f"{p}={m['state']}" for p, m in sorted(st["members"].items())
+            ) or "(none)"
+            ah = st["autoheal"]
+            ae = st["antientropy"]
+            lines = [
+                f"Cluster status: #{{running_nodes => {members}}}",
+                f"{'members':<22}: {peers}",
+                f"{'down':<22}: "
+                + (", ".join(sorted(st["down"])) or "(none)"),
+                f"{'partition':<22}: "
+                + (
+                    f"MINORITY ({st['partition_policy']})"
+                    if st["minority"]
+                    else "majority"
+                )
+                + f", trips {st['partition_trips']} / "
+                f"heals {st['partition_heals']}",
+                f"{'needs_rejoin':<22}: {st['needs_rejoin']}"
+                + (" (heal available, autoheal off)"
+                   if st["heal_available"] else ""),
+                f"{'autoheal':<22}: "
+                f"{'on' if ah['enabled'] else 'off'}, "
+                f"coordinator {ah['coordinator']}, "
+                f"directed {ah['rejoins_directed']}, "
+                f"completed {ah['rejoins_completed']}",
+                f"{'anti-entropy':<22}: {ae['checks']} checks, "
+                f"{ae['divergences']} diverged, {ae['repairs']} repaired"
+                + (
+                    f", pending {ae['pending']}" if ae["pending"] else ""
+                ),
+                f"{'registry conflicts':<22}: {st['registry_conflicts']}",
+            ]
+            if st["asymmetric_peers"]:
+                lines.append(
+                    f"{'asymmetric peers':<22}: "
+                    + ", ".join(sorted(st["asymmetric_peers"]))
+                )
+            return "\n".join(lines)
+        if sub == "digests":
+            out = [f"{'origin':<22}: digest"]
+            for origin, dig in sorted(st["digests"].items()):
+                out.append(f"{origin:<22}: {dig}")
+            return "\n".join(out)
+        if sub == "heal":
+            ms = self.node.membership
+            if not ms.needs_rejoin:
+                return "nothing to heal: not flagged for rejoin"
+            seed = next(iter(ms.members.values()), None)
+            if seed is None:
+                return "no reachable peer to rejoin through"
+            self.node._spawn(self.node.rejoin(seed))
+            return f"ok: rejoin started via {seed}"
+        raise ValueError(f"bad subcommand {sub!r}")
 
     def _clients(self, args) -> str:
         sub = args[0] if args else "list"

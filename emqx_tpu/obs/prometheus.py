@@ -93,6 +93,12 @@ def prometheus_text(broker, node_name: str = "emqx@127.0.0.1", obs=None) -> str:
     from ..ds.metrics import DS_METRICS
 
     lines.extend(DS_METRICS.prometheus_lines(node_name))
+    # cluster-plane failure-domain ledger (emqx_cluster_* namespace —
+    # process-global for the same reason: partition/heal transitions
+    # ride membership timers that outlive any one broker object)
+    from ..cluster.metrics import CLUSTER_METRICS
+
+    lines.extend(CLUSTER_METRICS.prometheus_lines(node_name))
     return "\n".join(lines) + "\n"
 
 

@@ -17,12 +17,16 @@ from emqx_tpu.broker.message import Message
 from emqx_tpu.broker.packet import SubOpts
 from emqx_tpu.chaos import ChaosEngine, SessionFleet, ZipfTopics, run_soak
 from emqx_tpu.chaos.scenarios import (
+    AsymmetricPartition,
     DisconnectTakeover,
+    HealStorm,
     NodeEvacuation,
     NodePurge,
     PartitionNodedown,
+    ReplicaDrift,
     RowCorruption,
     SlotDecay,
+    SplitBrain,
     StormBaseline,
 )
 
@@ -189,6 +193,43 @@ async def test_partition_nodedown_cluster(tmp_path):
         eng.storm_start()
         res = await PartitionNodedown().run(eng)
         assert res.ok, json.dumps(res.as_dict(), indent=1)
+        await eng.storm_stop()
+    finally:
+        await eng.close()
+
+
+async def test_split_brain_autoheal_cluster(tmp_path):
+    """SplitBrain under storm: symmetric split, conflicting writes on
+    both halves, minority declared + alarmed, autoheal-directed rejoin,
+    registry conflict resolved to ONE live session, digests byte-equal
+    on every node afterwards."""
+    eng = await _cluster_engine(tmp_path)
+    try:
+        await eng.setup()
+        eng.storm_start()
+        res = await SplitBrain().run(eng)
+        assert res.ok, json.dumps(res.as_dict(), indent=1)
+        assert res.extra["silent_divergences"] == 0
+        await eng.storm_stop()
+    finally:
+        await eng.close()
+
+
+async def test_drift_asymmetry_heal_storm_cluster(tmp_path):
+    """ReplicaDrift, AsymmetricPartition and HealStorm chained on one
+    cluster engine: the silent drop is repaired without nodedown, the
+    one-way blackhole is detected from the healthy side, and flapping
+    partitions heal as many times as they trip."""
+    eng = await _cluster_engine(tmp_path)
+    try:
+        await eng.setup()
+        eng.storm_start()
+        res = await ReplicaDrift().run(eng)
+        assert res.ok, json.dumps(res.as_dict(), indent=1)
+        res2 = await AsymmetricPartition().run(eng)
+        assert res2.ok, json.dumps(res2.as_dict(), indent=1)
+        res3 = await HealStorm(flaps=2).run(eng)
+        assert res3.ok, json.dumps(res3.as_dict(), indent=1)
         await eng.storm_stop()
     finally:
         await eng.close()

@@ -1,0 +1,637 @@
+"""Split-brain failure domain tests: three-state failure detection,
+minority arbitration under both partition policies, autoheal-directed
+rejoin (and the autoheal-off contract: wedged-but-correct), asymmetric
+partition detection, digest anti-entropy repair of silently dropped op
+batches, registry conflict resolution, and the paged bootstrap/resync
+edge cases (token expiry mid-bootstrap, empty-contribution resync,
+same-id rejoin from a new ephemeral address mid-storm)."""
+
+import asyncio
+
+import pytest
+
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.packet import SubOpts
+from emqx_tpu.cluster import ClusterNode
+from emqx_tpu.cluster.metrics import CLUSTER_METRICS
+from emqx_tpu.obs.alarm import Alarms
+
+
+# --- scaffolding ---------------------------------------------------------
+
+
+async def make_nodes(
+    n, hb=0.05, miss=2, autoheal=True, policy="degrade"
+):
+    nodes, addrs = [], []
+    for i in range(n):
+        node = ClusterNode(
+            f"n{i}",
+            heartbeat_interval=hb,
+            miss_threshold=miss,
+            autoheal=autoheal,
+            partition_policy=policy,
+        )
+        addrs.append(await node.start())
+        nodes.append(node)
+    for node in nodes[1:]:
+        await node.join(addrs[0])
+    await asyncio.sleep(0.05)
+    return nodes, addrs
+
+
+async def wait_until(pred, timeout=10.0, msg="condition"):
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    while not pred():
+        assert loop.time() < deadline, f"timeout waiting for {msg}"
+        await asyncio.sleep(0.02)
+
+
+def isolate(victim, others):
+    """Symmetric black-hole between `victim` and every other node."""
+    va = victim.rpc.listen_addr
+    for o in others:
+        victim.rpc.partition(o.rpc.listen_addr)
+        o.rpc.partition(va)
+
+
+def heal_wire(nodes):
+    for n in nodes:
+        n.rpc.heal()
+
+
+def attach_client(node, client_id):
+    session, _present = node.broker.open_session(client_id, clean_start=True)
+    received = []
+    session.outgoing_sink = lambda pkts: received.extend(pkts)
+    return session, received
+
+
+async def stop_all(nodes):
+    for n in nodes:
+        await n.stop()
+
+
+def digests_equal(nodes):
+    first = nodes[0].replica_digests()
+    return all(n.replica_digests() == first for n in nodes[1:])
+
+
+# --- three-state failure detector ---------------------------------------
+
+
+async def test_three_state_suspect_then_down():
+    """alive -> suspect (one miss) -> down (miss_threshold), with the
+    suspect counter moving and the state flipping back to alive when
+    the peer answers again."""
+    c0 = CLUSTER_METRICS.snapshot()
+    nodes, _ = await make_nodes(2, hb=0.1, miss=4)
+    a, b = nodes
+    try:
+        assert a.membership.member_state.get("n1") == "alive"
+        isolate(b, [a])
+        await wait_until(
+            lambda: a.membership.member_state.get("n1") == "suspect",
+            msg="suspect state",
+        )
+        assert "n1" in a.membership.members  # suspect is still a member
+        await wait_until(
+            lambda: a.membership.member_state.get("n1") == "down",
+            msg="down state",
+        )
+        assert "n1" not in a.membership.members
+        c1 = CLUSTER_METRICS.snapshot()
+        assert c1["suspect_total"] > c0.get("suspect_total", 0)
+        assert c1["nodedown_total"] > c0.get("nodedown_total", 0)
+        # heal: the probe path readmits and the state returns to alive
+        heal_wire(nodes)
+        await wait_until(
+            lambda: a.membership.member_state.get("n1") == "alive"
+            and "n1" in a.membership.members,
+            msg="re-admission after heal",
+        )
+    finally:
+        await stop_all(nodes)
+
+
+# --- minority arbitration + partition policies --------------------------
+
+
+async def test_minority_degrade_freezes_purges_and_autoheals():
+    """The isolated node of a 3-node mesh declares itself minority:
+    routes FROZEN (it must not purge the majority it merely lost sight
+    of), partition alarm raised; the majority purges it. On heal the
+    autoheal coordinator directs the rejoin and the alarm clears."""
+    nodes, _ = await make_nodes(3)
+    a, b, c = nodes
+    c.attach_obs(alarms=Alarms(c.broker, "n2"))
+    try:
+        sa, _ = attach_client(a, "maj-sub")
+        a.broker.subscribe(sa, "maj/+", SubOpts(qos=0))
+        sc, _ = attach_client(c, "min-sub")
+        c.broker.subscribe(sc, "min/+", SubOpts(qos=0))
+        await wait_until(
+            lambda: "n2" in a.cluster_router.match_routes("min/x")
+            and "n0" in c.cluster_router.match_routes("maj/x"),
+            msg="route replication",
+        )
+        isolate(c, [a, b])
+        await wait_until(
+            lambda: c.membership.minority, msg="minority declaration"
+        )
+        assert c.membership.needs_rejoin
+        assert c.alarms.is_active("cluster_partition")
+        assert not a.membership.minority and not b.membership.minority
+        # majority purges the lost node's contribution...
+        await wait_until(
+            lambda: "n2" not in a.cluster_router.match_routes("min/x"),
+            msg="majority purge",
+        )
+        # ...but the minority keeps the majority's routes FROZEN, even
+        # after its failure detector declared them down
+        await wait_until(
+            lambda: "n0" not in c.membership.members,
+            msg="minority-side nodedown",
+        )
+        assert "n0" in c.cluster_router.match_routes("maj/x")
+        heal_wire(nodes)
+        await wait_until(
+            lambda: not c.membership.needs_rejoin
+            and "n2" in a.membership.members
+            and "n0" in c.membership.members,
+            msg="autoheal convergence",
+        )
+        assert not c.membership.minority
+        assert not c.alarms.is_active("cluster_partition")
+        await wait_until(
+            lambda: "n2" in a.cluster_router.match_routes("min/x")
+            and "n0" in c.cluster_router.match_routes("maj/x")
+            and digests_equal(nodes),
+            msg="post-heal digest equality",
+        )
+    finally:
+        await stop_all(nodes)
+
+
+async def test_minority_isolate_refuses_remote():
+    """partition_policy=isolate: a declared-minority node refuses the
+    remote legs outright — route_remote returns 0 and op broadcast is
+    suppressed — while LOCAL sessions keep being served. The writes
+    made while isolated are re-derived from local truth on rejoin."""
+    nodes, _ = await make_nodes(3, policy="isolate")
+    a, b, c = nodes
+    try:
+        sa, _ = attach_client(a, "remote-sub")
+        a.broker.subscribe(sa, "far/+", SubOpts(qos=0))
+        await wait_until(
+            lambda: "n0" in c.cluster_router.match_routes("far/x"),
+            msg="route replication",
+        )
+        isolate(c, [a, b])
+        await wait_until(
+            lambda: c.membership.minority, msg="minority declaration"
+        )
+        # remote publish leg refused (would otherwise hang on the
+        # black-holed forward)
+        assert c.route_remote(Message(topic="far/x", payload=b"no")) == 0
+        # local sessions still served (isolate != dead)
+        sl, inbox = attach_client(c, "local-sub")
+        c.broker.subscribe(sl, "here/+", SubOpts(qos=0))
+        c.broker.publish(Message(topic="here/1", payload=b"local"))
+        await asyncio.sleep(0.05)
+        assert [p.payload for p in inbox] == [b"local"]
+        heal_wire(nodes)
+        await wait_until(
+            lambda: not c.membership.needs_rejoin
+            and "n2" in a.membership.members,
+            msg="autoheal convergence",
+        )
+        # the isolated-era subscription was re-derived on rejoin
+        await wait_until(
+            lambda: "n2" in a.cluster_router.match_routes("here/1")
+            and digests_equal(nodes),
+            msg="isolated write re-derived",
+        )
+    finally:
+        await stop_all(nodes)
+
+
+async def test_autoheal_off_no_automatic_rejoin():
+    """cluster.autoheal=off: the minority stays partitioned after the
+    wire heals — alarmed, degraded-correct, heal flagged as available —
+    and ONLY a manual rejoin reconverges it."""
+    nodes, addrs = await make_nodes(2, autoheal=False)
+    a, b = nodes
+    b.attach_obs(alarms=Alarms(b.broker, "n1"))
+    try:
+        sa, _ = attach_client(a, "stay")
+        a.broker.subscribe(sa, "keep/+", SubOpts(qos=0))
+        await wait_until(
+            lambda: "n0" in b.cluster_router.match_routes("keep/x"),
+            msg="route replication",
+        )
+        isolate(b, [a])
+        # 2-node tie-break: n0 holds the lowest id, so n1 is minority
+        await wait_until(
+            lambda: b.membership.minority, msg="minority declaration"
+        )
+        heal_wire(nodes)
+        # probes succeed again, but with autoheal off NOTHING rejoins
+        await asyncio.sleep(0.6)
+        assert b.membership.minority
+        assert b.membership.needs_rejoin
+        assert "n0" not in b.membership.members
+        assert b.membership.heal_available  # operator signal
+        assert b.alarms.is_active("cluster_partition")
+        # degraded-correct: the frozen majority route is still intact
+        assert "n0" in b.cluster_router.match_routes("keep/x")
+        # manual heal (the `ctl cluster heal` path)
+        await b.rejoin(addrs[0])
+        assert not b.membership.needs_rejoin
+        assert not b.membership.minority
+        assert not b.alarms.is_active("cluster_partition")
+        await wait_until(
+            lambda: "n1" in a.membership.members and digests_equal(nodes),
+            msg="manual rejoin convergence",
+        )
+    finally:
+        await stop_all(nodes)
+
+
+async def test_heal_storm_trips_match_heals():
+    """Flapping partition/heal cycles: every trip is matched by a heal
+    and nothing wedges."""
+    nodes, _ = await make_nodes(2)
+    a, b = nodes
+    try:
+        trips0 = b.membership.partition_trips
+        heals0 = b.membership.partition_heals
+        for _ in range(3):
+            isolate(b, [a])
+            await wait_until(
+                lambda: b.membership.minority, msg="flap trip"
+            )
+            heal_wire(nodes)
+            await wait_until(
+                lambda: not b.membership.needs_rejoin
+                and not b.membership.minority
+                and "n1" in a.membership.members
+                and "n0" in b.membership.members,
+                msg="flap heal",
+            )
+        trips = b.membership.partition_trips - trips0
+        heals = b.membership.partition_heals - heals0
+        assert trips == heals >= 3
+        await wait_until(
+            lambda: digests_equal(nodes), msg="post-storm digests"
+        )
+    finally:
+        await stop_all(nodes)
+
+
+# --- asymmetric partitions ----------------------------------------------
+
+
+async def test_asymmetric_partition_detected_and_healed():
+    """One-way blackhole: a drops every frame b sends it while a's own
+    calls to b still flow. b declares a down; a — which never lost
+    contact — sees b's stale view in the ping replies and counts the
+    asymmetry; after heal the coordinator directs b's rejoin."""
+    c0 = CLUSTER_METRICS.snapshot()
+    nodes, _ = await make_nodes(2)
+    a, b = nodes
+    try:
+        # inbound drops resolve the victim via its hello; wait for the
+        # first ping exchange to register it
+        await wait_until(
+            lambda: tuple(b.rpc.listen_addr) in a.rpc._addr_node,
+            msg="hello seen",
+        )
+        a.rpc.partition(b.rpc.listen_addr, direction="in")
+        await wait_until(
+            lambda: "n0" not in b.membership.members
+            and b.membership.minority,
+            msg="victim-side nodedown",
+        )
+        # the healthy side still holds the victim as a member...
+        assert "n1" in a.membership.members
+        # ...and detects the asymmetry from the piggybacked view
+        await wait_until(
+            lambda: "n1" in a.membership.asym_peers,
+            msg="asymmetry detection",
+        )
+        c1 = CLUSTER_METRICS.snapshot()
+        assert c1["asymmetry_total"] > c0.get("asymmetry_total", 0)
+        a.rpc.heal()
+        # the first directive may have raced the still-blocked inbound
+        # leg; the coordinator re-directs after its retry window
+        await wait_until(
+            lambda: not b.membership.needs_rejoin
+            and "n0" in b.membership.members,
+            timeout=30.0,
+            msg="directed rejoin over the working direction",
+        )
+        assert not b.membership.minority
+        await wait_until(
+            lambda: digests_equal(nodes), msg="post-heal digests"
+        )
+    finally:
+        await stop_all(nodes)
+
+
+async def test_partition_direction_validation():
+    """direction='in' needs a resolved peer (a hello must have been
+    seen); bad directions are rejected."""
+    a = ClusterNode("solo", heartbeat_interval=0.05)
+    await a.start()
+    try:
+        with pytest.raises(ValueError):
+            a.rpc.partition(("127.0.0.1", 1), direction="sideways")
+        with pytest.raises(ValueError):
+            # no hello ever seen from this address
+            a.rpc.partition(("127.0.0.1", 1), direction="in")
+    finally:
+        await a.stop()
+
+
+# --- digest anti-entropy -------------------------------------------------
+
+
+async def test_antientropy_repairs_silently_dropped_batch():
+    """An op batch ACKed but never applied (the genuinely silent fault)
+    is caught by the digest exchange within bounded ping rounds and
+    repaired by a targeted resync — with zero nodedown."""
+    from emqx_tpu.chaos.faults import ReplicaDriftInjector
+
+    c0 = CLUSTER_METRICS.snapshot()
+    nodes, _ = await make_nodes(2)
+    a, b = nodes
+    try:
+        # let the join-time member_up resync drain first — it flows
+        # through the resync leg, not the wrapped push, and would
+        # otherwise repair the drift without anti-entropy noticing
+        await wait_until(
+            lambda: not a._resync and not b._resync,
+            msg="join-time resync drained",
+        )
+        inj = ReplicaDriftInjector(b)
+        inj.drop_next(1)
+        s, _ = attach_client(a, "drift-writer")
+        a.broker.subscribe(s, "drift/+", SubOpts(qos=0))
+        await wait_until(
+            lambda: inj.dropped_batches >= 1, msg="drop injection"
+        )
+        inj.uninstall()
+        assert inj.dropped_ops >= 1
+        # detection + repair ride the ping path, no manual nudge
+        await wait_until(
+            lambda: "n0" in b.cluster_router.match_routes("drift/x")
+            and digests_equal(nodes),
+            msg="anti-entropy repair",
+        )
+        c1 = CLUSTER_METRICS.snapshot()
+        assert (
+            c1["antientropy_checks_total"]
+            > c0.get("antientropy_checks_total", 0)
+        )
+        assert (
+            c1["antientropy_divergence_total"]
+            > c0.get("antientropy_divergence_total", 0)
+        )
+        assert (
+            c1["antientropy_repairs_total"]
+            > c0.get("antientropy_repairs_total", 0)
+        )
+        # the incident never escalated
+        assert c1["nodedown_total"] == c0.get("nodedown_total", 0)
+        assert "n1" in a.membership.members
+        assert "n0" in b.membership.members
+    finally:
+        await stop_all(nodes)
+
+
+# --- registry conflict resolution ----------------------------------------
+
+
+async def test_registry_conflict_deterministic_winner_kicks_loser():
+    """The same client id connects on both halves of a split. On heal
+    the lowest node id wins on BOTH nodes; the loser's session is
+    kicked with a v5 USE_ANOTHER_SERVER takeover naming the winner."""
+    c0 = CLUSTER_METRICS.snapshot()
+    nodes, _ = await make_nodes(2)
+    a, b = nodes
+    try:
+        isolate(b, [a])
+        await wait_until(
+            lambda: b.membership.minority
+            and "n1" not in a.membership.members,
+            msg="split",
+        )
+        _sa, _rx_a = attach_client(a, "dup")
+        _sb, rx_b = attach_client(b, "dup")
+        heal_wire(nodes)
+        await wait_until(
+            lambda: not b.membership.needs_rejoin
+            and "n1" in a.membership.members,
+            msg="autoheal convergence",
+        )
+        await wait_until(
+            lambda: "dup" not in b.broker.sessions
+            and a.registry.get("dup") == "n0"
+            and b.registry.get("dup") == "n0",
+            msg="conflict resolution",
+        )
+        # exactly one live session, on the deterministic winner
+        assert "dup" in a.broker.sessions
+        assert a.broker.sessions["dup"].connected
+        # the loser was told where to go (server_reference = winner)
+        kicked = [
+            p
+            for p in rx_b
+            if getattr(p, "props", None)
+            and p.props.get("server_reference") == "n0"
+        ]
+        assert kicked, f"no takeover disconnect in {rx_b!r}"
+        c1 = CLUSTER_METRICS.snapshot()
+        assert (
+            c1["registry_conflicts_total"]
+            > c0.get("registry_conflicts_total", 0)
+        )
+        await wait_until(
+            lambda: digests_equal(nodes), msg="post-conflict digests"
+        )
+    finally:
+        await stop_all(nodes)
+
+
+# --- paged bootstrap / resync edge cases ---------------------------------
+
+
+async def test_bootstrap_token_expiry_mid_bootstrap(monkeypatch):
+    """A joiner whose snapshot token vanished mid-page (seed restart,
+    snapshot reclaim) gets a crisp RpcError on the next page call — and
+    a fresh token=None restart streams the full dump."""
+    from emqx_tpu.cluster import node as node_mod
+
+    monkeypatch.setattr(node_mod, "DUMP_PAGE", 2)
+    nodes, addrs = await make_nodes(2)
+    a, b = nodes
+    try:
+        s, _ = attach_client(a, "pager")
+        for i in range(6):
+            a.broker.subscribe(s, f"page/{i}/+", SubOpts(qos=0))
+        await asyncio.sleep(0.1)
+        page = await b.rpc.call(
+            addrs[0], "route", "bootstrap", (None, 0), timeout=5.0
+        )
+        assert not page["done"] and len(page["ops"]) == 2
+        # the seed's snapshot is reclaimed mid-bootstrap
+        a._boot_dumps.clear()
+        with pytest.raises(Exception, match="bootstrap token"):
+            await b.rpc.call(
+                addrs[0],
+                "route",
+                "bootstrap",
+                (page["token"], page["next"]),
+                timeout=5.0,
+            )
+        # a clean restart pages the whole dump
+        token, cursor, ops = None, 0, []
+        while True:
+            page = await b.rpc.call(
+                addrs[0], "route", "bootstrap", (token, cursor),
+                timeout=5.0,
+            )
+            ops.extend(page["ops"])
+            token, cursor = page["token"], page["next"]
+            if page["done"]:
+                break
+        got = {op[1] for op in ops if op[0] == "add_r"}
+        assert {f"page/{i}/+" for i in range(6)} <= got
+    finally:
+        await stop_all(nodes)
+
+
+async def test_empty_contribution_resync_purges_stale_rows():
+    """A resync from a node whose contribution is EMPTY still sends its
+    one first=True page — the receiver purges the origin's stale rows
+    and hard-resets its digest, instead of skipping the purge because
+    there was nothing to page."""
+    nodes, _ = await make_nodes(2)
+    a, b = nodes
+    try:
+        # plant a stale row attributed to n0 on b (a missed delete)
+        b._apply_ops([("add_r", "stale/+", "n0")])
+        assert "n0" in b.cluster_router.match_routes("stale/x")
+        assert b.replica_digests().get("n0", 0) != 0
+        await a._send_resync(b.rpc.listen_addr)
+        assert "n0" not in b.cluster_router.match_routes("stale/x")
+        # digest hard-reset: b's copy of n0's contribution is zero again
+        assert b.replica_digests().get("n0", 0) == 0
+        assert digests_equal(nodes)
+    finally:
+        await stop_all(nodes)
+
+
+async def test_same_id_rejoin_new_address_mid_storm():
+    """A node that dies and comes back under the SAME node id on a NEW
+    ephemeral address, mid-publish-storm: the membership re-points the
+    address, the dead incarnation's contribution is replaced by the new
+    (empty) one via the rejoin resync, and the replicas converge."""
+    nodes, addrs = await make_nodes(3, hb=0.05, miss=2)
+    a, b, c = nodes
+    try:
+        sc, _ = attach_client(c, "old-inc")
+        c.broker.subscribe(sc, "roam/+", SubOpts(qos=0))
+        await wait_until(
+            lambda: "n2" in a.cluster_router.match_routes("roam/x"),
+            msg="route replication",
+        )
+        old_addr = tuple(c.rpc.listen_addr)
+        storm_on = True
+
+        async def storm():
+            i = 0
+            while storm_on:
+                a.broker.publish(
+                    Message(topic=f"roam/{i % 7}", payload=b"s")
+                )
+                i += 1
+                await asyncio.sleep(0.005)
+
+        storm_task = asyncio.ensure_future(storm())
+        try:
+            # hard-kill c: no graceful leave, socket gone
+            c.membership.stop_heartbeat()
+            await c.rpc.close()
+            # same id, NEW ephemeral port, rejoining while the storm
+            # publishes into its (stale) routes
+            c2 = ClusterNode("n2", heartbeat_interval=0.05, miss_threshold=2)
+            new_addr = await c2.start()
+            nodes.append(c2)
+            await c2.join(addrs[0])
+            assert tuple(new_addr) != old_addr
+            await wait_until(
+                lambda: tuple(a.membership.members.get("n2", ()))
+                == tuple(new_addr),
+                msg="address re-point",
+            )
+            # old incarnation's contribution replaced by the new truth
+            # (c2 has no sessions, so the roam route must disappear)
+            await wait_until(
+                lambda: "n2" not in a.cluster_router.match_routes("roam/x")
+                and "old-inc" not in a.registry,
+                msg="stale incarnation purged",
+            )
+            # the reborn node serves: a fresh subscription forwards
+            s2, inbox = attach_client(c2, "new-inc")
+            c2.broker.subscribe(s2, "fresh/+", SubOpts(qos=0))
+            await wait_until(
+                lambda: "n2" in a.cluster_router.match_routes("fresh/x"),
+                msg="new route replication",
+            )
+            a.broker.publish(Message(topic="fresh/1", payload=b"hi"))
+            await wait_until(
+                lambda: [p.payload for p in inbox] == [b"hi"],
+                msg="forward to reborn node",
+            )
+            await wait_until(
+                lambda: digests_equal([a, b, c2]),
+                msg="post-rejoin digests",
+            )
+        finally:
+            storm_on = False
+            await storm_task
+    finally:
+        await stop_all([n for n in nodes if n is not c])
+
+
+# --- config / surfaces ---------------------------------------------------
+
+
+async def test_cluster_status_surfaces():
+    nodes, _ = await make_nodes(2)
+    a, b = nodes
+    try:
+        st = a.cluster_status()
+        assert st["node"] == "n0"
+        assert "n1" in st["members"]
+        assert st["members"]["n1"]["state"] == "alive"
+        assert st["minority"] is False
+        assert st["partition_policy"] == "degrade"
+        assert st["autoheal"]["enabled"] is True
+        assert st["autoheal"]["coordinator"] == "n0"
+        assert set(st["antientropy"]) == {
+            "checks", "divergences", "repairs", "pending",
+        }
+        assert all(
+            len(d) == 16 for d in st["digests"].values()
+        )  # 016x rendering
+    finally:
+        await stop_all(nodes)
+
+
+def test_partition_policy_validated():
+    with pytest.raises(ValueError):
+        ClusterNode("bad", partition_policy="explode")

@@ -635,3 +635,138 @@ def test_ds_crash_consistency_families_lint(tmp_path):
     assert re.search(
         r'emqx_ds_shard_read_only\{node="n1@host"\} 0(\.0)?$', text, re.M
     )
+
+
+async def test_cluster_selfheal_families_lint():
+    """ISSUE-13 families: every emqx_cluster_* family the split-brain
+    failure domain exports must render on a real driven scrape — a
+    3-node walk through silent replica drift (anti-entropy repair), a
+    one-way blackhole (asymmetry), and a full partition with a
+    conflicting registry claim healed by autoheal — and pass the lint.
+    Never hand-set counters."""
+    from emqx_tpu.chaos.faults import ReplicaDriftInjector
+    from emqx_tpu.cluster import ClusterNode
+    from emqx_tpu.cluster.metrics import CLUSTER_METRICS
+
+    async def wait_until(pred, timeout=30.0, msg="condition"):
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout
+        while not pred():
+            assert loop.time() < deadline, f"timeout waiting for {msg}"
+            await asyncio.sleep(0.02)
+
+    def sess(node, cid):
+        s, _ = node.broker.open_session(cid, clean_start=True)
+        s.outgoing_sink = lambda pkts: None
+        return s
+
+    c0 = CLUSTER_METRICS.snapshot()
+    nodes, addrs = [], []
+    for i in range(3):
+        n = ClusterNode(
+            f"n{i}", heartbeat_interval=0.05, miss_threshold=2
+        )
+        addrs.append(await n.start())
+        nodes.append(n)
+    a, b, c = nodes
+    for n in (b, c):
+        await n.join(addrs[0])
+    try:
+        # leg 1 — silent drift: b ACKs but drops one op batch; the
+        # digest exchange repairs it (antientropy_* counters). Let the
+        # join-time member_up resync drain first — it bypasses the
+        # wrapped push and would repair the drift honestly
+        await wait_until(
+            lambda: not a._resync and not b._resync and not c._resync,
+            msg="join-time resync drained",
+        )
+        inj = ReplicaDriftInjector(b)
+        inj.drop_next(1)
+        a.broker.subscribe(
+            sess(a, "lint-w"), "lint/drift/+", SubOpts(qos=0)
+        )
+        await wait_until(
+            lambda: inj.dropped_batches >= 1, msg="drop injection"
+        )
+        inj.uninstall()
+        await wait_until(
+            lambda: "n0" in b.cluster_router.match_routes("lint/drift/x"),
+            msg="anti-entropy repair",
+        )
+        # leg 2 — one-way blackhole: a drops frames from c; c declares
+        # a down, a counts the asymmetry (asymmetry/suspect/nodedown)
+        await wait_until(
+            lambda: tuple(c.rpc.listen_addr) in a.rpc._addr_node,
+            msg="hello seen",
+        )
+        a.rpc.partition(c.rpc.listen_addr, direction="in")
+        await wait_until(
+            lambda: "n2" in a.membership.asym_peers
+            and "n0" not in c.membership.members,
+            msg="asymmetry detection",
+        )
+        a.rpc.heal()
+        await wait_until(
+            lambda: "n0" in c.membership.members,
+            msg="one-way heal",
+        )
+        # leg 3 — full split with a conflicting claim: c goes minority
+        # (partition/minority), the duplicate registry claim resolves
+        # on heal (heal/autoheal_rejoin/registry_conflicts)
+        sess(a, "lint-dup")
+        for o in (a, b):
+            c.rpc.partition(o.rpc.listen_addr)
+            o.rpc.partition(c.rpc.listen_addr)
+        await wait_until(
+            lambda: c.membership.minority, msg="minority declaration"
+        )
+        sess(c, "lint-dup")
+        for n in nodes:
+            n.rpc.heal()
+        await wait_until(
+            lambda: not c.membership.needs_rejoin
+            and "n2" in a.membership.members
+            and c.registry.get("lint-dup") == "n0",
+            msg="autoheal + conflict resolution",
+        )
+    finally:
+        for n in nodes:
+            await n.stop()
+
+    c1 = CLUSTER_METRICS.snapshot()
+    for ctr in (
+        "suspect_total",
+        "nodedown_total",
+        "partition_total",
+        "heal_total",
+        "autoheal_rejoin_total",
+        "asymmetry_total",
+        "antientropy_checks_total",
+        "antientropy_divergence_total",
+        "antientropy_repairs_total",
+        "registry_conflicts_total",
+    ):
+        assert c1[ctr] > c0.get(ctr, 0), f"{ctr} did not move"
+
+    text = prometheus_text(Broker(), "n1@host")
+    types = _lint(text)
+    for fam, kind in (
+        ("emqx_cluster_suspect_total", "counter"),
+        ("emqx_cluster_nodedown_total", "counter"),
+        ("emqx_cluster_partition_total", "counter"),
+        ("emqx_cluster_heal_total", "counter"),
+        ("emqx_cluster_autoheal_rejoin_total", "counter"),
+        ("emqx_cluster_asymmetry_total", "counter"),
+        ("emqx_cluster_antientropy_checks_total", "counter"),
+        ("emqx_cluster_antientropy_divergence_total", "counter"),
+        ("emqx_cluster_antientropy_repairs_total", "counter"),
+        ("emqx_cluster_registry_conflicts_total", "counter"),
+        ("emqx_cluster_member_state", "gauge"),
+        ("emqx_cluster_minority", "gauge"),
+    ):
+        assert types.get(fam) == kind, f"{fam}: {types.get(fam)}"
+    # per-peer detector gauge carries the peer label
+    assert re.search(
+        r'emqx_cluster_member_state\{node="n1@host",peer="n\d+"\} \d',
+        text,
+    )

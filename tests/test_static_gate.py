@@ -128,16 +128,19 @@ def _family_literals():
 
 
 def test_create_task_sites_retain_handles():
-    """Every `asyncio.create_task(...)` (and `loop.create_task`) call
-    site in the package must RETAIN the task handle — assignment,
-    container insertion, await, return — or route through a supervised
-    helper. A bare expression-statement spawn is the fire-and-forget
-    shape twice over: the asyncio docs allow the event loop to GC a
-    task nobody references mid-flight, and an exception inside it
-    (exactly what the chaos engine injects) is silently swallowed
-    until interpreter shutdown. Supervised helpers (ClusterNode._spawn
-    and friends) assign + done-callback internally, so they pass this
-    rule by construction."""
+    """Every `asyncio.create_task(...)` / `loop.create_task` /
+    `asyncio.ensure_future(...)` call site in the package must RETAIN
+    the task handle — assignment, container insertion, await, return —
+    or route through a supervised helper. A bare expression-statement
+    spawn is the fire-and-forget shape twice over: the asyncio docs
+    allow the event loop to GC a task nobody references mid-flight,
+    and an exception inside it (exactly what the chaos engine injects)
+    is silently swallowed until interpreter shutdown. `ensure_future`
+    is the same trap under an older name — the membership layer's
+    nodeup broadcast dropped its handle exactly this way before it was
+    moved onto the supervised `_spawn`. Supervised helpers
+    (ClusterNode._spawn and friends) assign + done-callback
+    internally, so they pass this rule by construction."""
     bad = []
     for path in _sources():
         tree = ast.parse(path.read_text(), filename=str(path))
@@ -153,11 +156,11 @@ def test_create_task_sites_retain_handles():
                 if isinstance(fn, ast.Attribute)
                 else fn.id if isinstance(fn, ast.Name) else None
             )
-            if name == "create_task":
+            if name in ("create_task", "ensure_future"):
                 bad.append(f"{path}:{node.lineno}")
     assert not bad, (
-        "fire-and-forget create_task (handle dropped — retain it or "
-        "use a supervised spawn helper):\n" + "\n".join(bad)
+        "fire-and-forget create_task/ensure_future (handle dropped — "
+        "retain it or use a supervised spawn helper):\n" + "\n".join(bad)
     )
 
 
