@@ -1431,6 +1431,291 @@ def _bench_insert_timed(details, r, pairs, NI, CH, nb):
 
 
 # --------------------------------------------------------------------------
+# r14: the three new device/native workloads — retained match (device
+# cuckoo probe vs host trie walk), batched WHERE (columnar mask vs
+# per-row eval_expr), and the JSON codec seam (native vs stdlib)
+
+
+def bench_retained(details):
+    """1M stored retained names: the SUBSCRIBE-side wildcard match
+    through the device probe halves vs the host trie walk, same
+    filters, bit-exactness asserted on the way. The A/B isolates the
+    MATCH (name lists), then reports the end-to-end read (store
+    expansion rides both legs identically)."""
+    import random as _random
+
+    from emqx_tpu.broker.message import Message
+    from emqx_tpu.models.retainer import Retainer
+    from emqx_tpu.ops import topic as topic_mod
+
+    rng = _random.Random(14)
+    N = 1_000_000 // SHRINK
+    GROUP = 100  # names per '+'-fan group: the walk visits ~GROUP nodes
+    n_groups = max(N // GROUP, 1)
+    ret = Retainer(max_retained=N + 10)
+    t0 = time.time()
+    for i in range(N):
+        ret.retain(
+            Message(
+                topic=f"dev/{i % n_groups}/{i // n_groups}/state",
+                payload=b"v",
+            )
+        )
+    build_s = time.time() - t0
+    t0 = time.time()
+    idx = ret.enable_device(telemetry=TEL)
+    attach_s = time.time() - t0
+
+    B = 512 if not SMALL else 64
+
+    def wave():
+        return [
+            f"dev/{rng.randrange(n_groups)}/+/state" for _ in range(B)
+        ]
+
+    # class build + AOT ladder happen on the first read (control
+    # plane); serving starts after
+    idx.read_finish(idx.read_begin(wave()))
+    TEL.mark_serving()
+
+    dev_t, host_t, e2e_t = [], [], []
+    for r in range(6):
+        filters = wave()
+        t0 = time.time()
+        names_dev = idx.read_finish(idx.read_begin(filters))
+        dev_t.append((time.time() - t0) / B)
+        t0 = time.time()
+        names_host = [
+            ret._match_names(topic_mod.words(f)) for f in filters
+        ]
+        host_t.append((time.time() - t0) / B)
+        t0 = time.time()
+        ret.retained_read_finish(ret.retained_read_begin(filters))
+        e2e_t.append((time.time() - t0) / B)
+        if r == 0:
+            for nd, nh in zip(names_dev, names_host):
+                assert nd is not None, "device leg escalated in the A/B"
+                assert sorted(nd) == sorted(nh)
+    dev_rate = 1.0 / pctl(dev_t, 50)
+    host_rate = 1.0 / pctl(host_t, 50)
+    e2e_rate = 1.0 / pctl(e2e_t, 50)
+    speedup = dev_rate / host_rate
+    retraced = TEL.counters.get("recompiles_at_serve_total", 0)
+    assert retraced == 0, f"retained leg retraced at serve: {retraced}"
+    log(
+        f"retained ({N:,} names): device {dev_rate:,.0f} filters/s vs "
+        f"host walk {host_rate:,.0f} filters/s ({speedup:.2f}x); "
+        f"end-to-end read {e2e_rate:,.0f} filters/s; "
+        f"store build {build_s:.1f}s, device attach {attach_s:.1f}s"
+    )
+    if not SMALL:
+        assert speedup >= 3.0, (
+            f"retained device leg {speedup:.2f}x < 3x host trie gate"
+        )
+    details["retained_1M"] = {
+        "stored_names": N,
+        "filters_per_wave": B,
+        "device_matches_per_sec": round(dev_rate, 1),
+        "host_matches_per_sec": round(host_rate, 1),
+        "device_vs_host_speedup": round(speedup, 2),
+        "read_e2e_per_sec": round(e2e_rate, 1),
+        "device_attach_s": round(attach_s, 2),
+        "recompiles_at_serve": retraced,
+        "device_reads": TEL.counters.get("retained_device_reads_total", 0),
+        "host_fallbacks": TEL.counters.get(
+            "retained_host_fallback_total", 0
+        ),
+    }
+
+
+def bench_rules_where(details):
+    """10k rules in the engine, a hot subset sharing one FROM: the
+    same coalesced publish batch through the batched-WHERE window vs
+    the per-row eval_expr path, metrics asserted identical."""
+    import random as _random
+
+    from emqx_tpu import jsonc
+    from emqx_tpu.broker.message import Message
+    from emqx_tpu.rules import RuleEngine
+
+    NR = 10_000 // SHRINK
+    HOT = 32 if not SMALL else 8
+    B = 4096 if not SMALL else 256
+    rng = _random.Random(5)
+
+    def build(batched):
+        eng = RuleEngine()
+        eng.batch_where_enabled = batched
+        hits = [0]
+
+        def bump(row, env):
+            hits[0] += 1
+
+        for i in range(NR - HOT):
+            eng.create_rule(
+                f"cold{i}",
+                f'SELECT qos FROM "cold/{i}/#" WHERE payload.x > {i % 50}',
+            )
+        for i in range(HOT):
+            eng.create_rule(
+                f"hot{i}",
+                f'SELECT qos FROM "hot/#" WHERE payload.x > {i * 3} '
+                f"AND payload.s = 'a{i % 4}'",
+                actions=[{"function": bump}],
+            )
+        return eng, hits
+
+    msgs = [
+        Message(
+            topic="hot/t",
+            payload=jsonc.dumps(
+                {"x": rng.randrange(100), "s": f"a{rng.randrange(4)}"}
+            ).encode(),
+        )
+        for _ in range(B)
+    ]
+
+    def drive(eng):
+        t0 = time.time()
+        if eng.batch_where_enabled:
+            with eng.batch_window():
+                for m in msgs:
+                    eng.on_message_publish(m)
+        else:
+            for m in msgs:
+                eng.on_message_publish(m)
+        return time.time() - t0
+
+    rows = B * HOT  # every hot message meets every hot rule's WHERE
+    eval_t, batch_t = [], []
+    e_eval, h_eval = build(False)
+    e_batch, h_batch = build(True)
+    drive(e_batch)  # warm: compile + cache the predicates
+    h_batch[0] = 0
+    for r in range(3):
+        for rule in e_eval.rules.values():
+            rule.metrics = type(rule.metrics)()
+        for rule in e_batch.rules.values():
+            rule.metrics = type(rule.metrics)()
+        h_eval[0] = h_batch[0] = 0
+        eval_t.append(drive(e_eval))
+        batch_t.append(drive(e_batch))
+        assert h_eval[0] == h_batch[0] > 0
+        assert {
+            rid: vars(ru.metrics) for rid, ru in e_eval.rules.items()
+        } == {rid: vars(ru.metrics) for rid, ru in e_batch.rules.items()}
+    assert e_batch.where_stats["uncompiled_rows"] == 0
+    assert e_batch.where_stats["fallback_rows"] == 0
+    eval_rate = rows / pctl(eval_t, 50)
+    batch_rate = rows / pctl(batch_t, 50)
+    speedup = batch_rate / eval_rate
+    log(
+        f"rules WHERE ({NR:,} rules, {HOT} hot x {B} msgs): batched "
+        f"{batch_rate:,.0f} rule-rows/s vs eval_expr "
+        f"{eval_rate:,.0f} rule-rows/s ({speedup:.2f}x)"
+    )
+    if not SMALL:
+        assert speedup > 1.0, f"batched WHERE slower than eval_expr ({speedup:.2f}x)"
+    details["rules_where"] = {
+        "rules": NR,
+        "hot_rules": HOT,
+        "batch_msgs": B,
+        "batch_rows_per_sec": round(batch_rate, 1),
+        "eval_rows_per_sec": round(eval_rate, 1),
+        "where_speedup": round(speedup, 2),
+        "uncompiled_rows": e_batch.where_stats["uncompiled_rows"],
+        "fallback_rows": e_batch.where_stats["fallback_rows"],
+    }
+
+
+def bench_json(details):
+    """The codec seam on the bench payload mix: native vs stdlib,
+    loads and dumps, ≥3x gate when the native codec is live."""
+    import json as stdlib_json
+
+    from emqx_tpu import jsonc
+
+    docs = [
+        # the telemetry/alarm/batch/config mix the bridges carry;
+        # sensor readings are rounded at the source (2 decimals), the
+        # shape jiffy's own bench corpus models
+        {"deviceId": "d-000123", "ts": 1722860000123, "temp": 23.75,
+         "hum": 41.2, "ok": True, "tags": ["a", "b", "c"],
+         "geo": {"lat": 52.0116, "lon": 4.3571}},
+        {"event": "alarm", "level": 3, "msg": "over-temperature é漢",
+         "ack": False, "src": None},
+        [{"v": round(i / 7, 2), "i": i, "k": f"s{i}"} for i in range(40)],
+        {"cfg": {"a": {"deep": [1, 2, 3, {"b": "x" * 120}]},
+                 "keys": {f"k{i}": i for i in range(30)}}},
+    ]
+    wires = [stdlib_json.dumps(d, separators=(",", ":")) for d in docs]
+    N = 4000 // (8 if SMALL else 1)
+    if not jsonc.native_enabled():
+        details["json_codec"] = {"status": "native codec unavailable"}
+        log("json codec: native unavailable, stage skipped")
+        return
+
+    def timed(fn, args):
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.time()
+            for _i in range(N):
+                for x in args:
+                    fn(x)
+            best = min(best, time.time() - t0)
+        return (N * len(args)) / best
+
+    native_loads = timed(jsonc.loads, wires)
+    stdlib_loads = timed(stdlib_json.loads, wires)
+    native_dumps = timed(
+        lambda d: jsonc.dumps(d, separators=(",", ":")), docs
+    )
+    stdlib_dumps = timed(
+        lambda d: stdlib_json.dumps(d, separators=(",", ":")), docs
+    )
+    # the payload-path operation: every bridged message is decoded
+    # once and re-encoded once, so the primary gate is the round trip
+    pairs = list(zip(wires, docs))
+
+    def rt_native(pair):
+        jsonc.loads(pair[0])
+        jsonc.dumps(pair[1], separators=(",", ":"))
+
+    def rt_stdlib(pair):
+        stdlib_json.loads(pair[0])
+        stdlib_json.dumps(pair[1], separators=(",", ":"))
+
+    native_rt = timed(rt_native, pairs)
+    stdlib_rt = timed(rt_stdlib, pairs)
+    dec = native_loads / stdlib_loads
+    enc = native_dumps / stdlib_dumps
+    rt = native_rt / stdlib_rt
+    log(
+        f"json codec: decode {native_loads:,.0f}/s vs stdlib "
+        f"{stdlib_loads:,.0f}/s ({dec:.2f}x); encode "
+        f"{native_dumps:,.0f}/s vs {stdlib_dumps:,.0f}/s ({enc:.2f}x); "
+        f"round-trip {rt:.2f}x"
+    )
+    if not SMALL:
+        # decode alone compresses toward ~2.5-3x on object-heavy docs:
+        # both codecs pay the same CPython dict-construction cost per
+        # row; PERF_NOTES r14 carries the decomposition
+        assert rt >= 3.0, f"json round-trip {rt:.2f}x < 3x gate"
+        assert enc >= 3.0, f"json encode {enc:.2f}x < 3x gate"
+        assert dec >= 2.0, f"json decode {dec:.2f}x < 2x floor"
+    details["json_codec"] = {
+        "payload_mix_docs": len(docs),
+        "native_decode_per_sec": round(native_loads, 1),
+        "stdlib_decode_per_sec": round(stdlib_loads, 1),
+        "decode_speedup": round(dec, 2),
+        "native_encode_per_sec": round(native_dumps, 1),
+        "stdlib_encode_per_sec": round(stdlib_dumps, 1),
+        "encode_speedup": round(enc, 2),
+        "roundtrip_speedup": round(rt, 2),
+    }
+
+
+# --------------------------------------------------------------------------
 # kernel-telemetry overhead — instrumented hot path vs null collector
 
 
@@ -1733,6 +2018,10 @@ def bench_provenance(details, jax):
                 "tpu_fanout_min_fan",
                 "tpu_audit_sample_n",
                 "tpu_audit_quarantine",
+                "tpu_retained_enable",
+                "tpu_retained_shards",
+                "tpu_rule_where_enable",
+                "json_native",
             )
         }
     except Exception as e:
@@ -1748,6 +2037,16 @@ def bench_provenance(details, jax):
             ).hexdigest()
     except OSError:
         prov["native_baseline_sha256"] = None
+    # same identity discipline for the JSON codec source (r14): a
+    # changed speedup with the same hash is environmental
+    json_cc = os.path.join(os.path.dirname(__file__), "native", "json.cc")
+    try:
+        with open(json_cc, "rb") as f:
+            prov["native_json_sha256"] = hashlib.sha256(
+                f.read()
+            ).hexdigest()
+    except OSError:
+        prov["native_json_sha256"] = None
     details["provenance"] = prov
 
 
@@ -2402,6 +2701,42 @@ def main():
     # --soak: the chaos stage is its own run (minutes of wall clock,
     # a million live sessions) — it executes alone and commits
     # SOAK_r07.json rather than riding the perf matrix
+    # --r14: the three new-workload stages alone (retained match,
+    # batched WHERE, JSON codec) — commits BENCH_r14.json without
+    # re-running the full matrix
+    if "--r14" in sys.argv:
+        bench_provenance(details, jax)
+        bench_retained(details)
+        bench_rules_where(details)
+        bench_json(details)
+        details["kernel_telemetry_counters"] = dict(TEL.counters)
+        with open("BENCH_r14.json", "w") as f:
+            json.dump(details, f, indent=1)
+        print(
+            json.dumps(
+                {
+                    "metric": "retained_device_vs_host_speedup",
+                    "value": details["retained_1M"][
+                        "device_vs_host_speedup"
+                    ],
+                    "unit": "x",
+                    "where_speedup": details["rules_where"][
+                        "where_speedup"
+                    ],
+                    "json_decode_speedup": details["json_codec"].get(
+                        "decode_speedup"
+                    ),
+                    "json_encode_speedup": details["json_codec"].get(
+                        "encode_speedup"
+                    ),
+                    "json_roundtrip_speedup": details["json_codec"].get(
+                        "roundtrip_speedup"
+                    ),
+                }
+            )
+        )
+        return
+
     if "--soak" in sys.argv:
         row = bench_soak(details)
         print(
@@ -2462,6 +2797,12 @@ def main():
     stage_done("config4_shared")
     bench_rules(jax, jnp, floor, details)
     stage_done("config5_rules")
+    bench_retained(details)
+    stage_done("retained_1M")
+    bench_rules_where(details)
+    stage_done("rules_where")
+    bench_json(details)
+    stage_done("json_codec")
     bench_insert(details)
     stage_done("route_churn")
     bench_telemetry_overhead(details)

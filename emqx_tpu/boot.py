@@ -169,6 +169,22 @@ class Node:
             # state out of the collector — after this, a retrace
             # counts as recompiles_at_serve_total
             broker.engine.warmup()
+        # retained-match device leg: back the retainer with the cuckoo
+        # index (the SUBSCRIBE-side inverse of routing); the host trie
+        # walk stays the oracle and escalation path
+        if getattr(broker, "retainer", None) is not None and cfg.get(
+            "broker.perf.tpu_retained_enable"
+        ):
+            broker.retainer.enable_device(
+                telemetry=getattr(broker.router, "telemetry", None),
+                n_shards=cfg.get("broker.perf.tpu_retained_shards") or 1,
+            )
+        # JSON codec seam: flip the process-global native gate so every
+        # rules/bridge/REST decode rides native/json.cc (stdlib replay
+        # on any parity-risk kwargs or codec error)
+        from .jsonc import set_native_enabled
+
+        set_native_enabled(bool(cfg.get("broker.perf.json_native")))
         self.broker = broker
 
         # 2. auth pipeline — chains/sources materialize from config
@@ -252,6 +268,16 @@ class Node:
         self.rules = RuleEngine(
             broker, ignore_sys=cfg.get("rule_engine.ignore_sys_message")
         )
+        # batched WHERE leg: compile the vectorizable predicate subset
+        # to columnar mask evaluation over coalesced publish batches
+        # (non-compilable predicates fall back to eval_expr per row)
+        self.rules.batch_where_enabled = bool(
+            cfg.get("broker.perf.tpu_rule_where_enable")
+        )
+        # hook the engine into 'message.publish' (also publishes the
+        # rule_batcher handle the coalesced publish paths probe) —
+        # without this a booted node's rules never see a publish
+        self.rules.install(broker.hooks)
         from .bridges.bridge import BridgeRegistry
 
         self.bridge_registry = BridgeRegistry(broker, rules=self.rules)

@@ -23,7 +23,7 @@ from __future__ import annotations
 import asyncio
 import csv
 import io
-import json
+from .. import jsonc as json  # codec seam: native with stdlib fallback
 import time
 from typing import Any, Awaitable, Callable, Dict, List, Optional
 

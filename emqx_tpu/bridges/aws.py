@@ -24,7 +24,7 @@ import base64
 import datetime
 import hashlib
 import hmac
-import json
+from .. import jsonc as json  # codec seam: native with stdlib fallback
 from typing import Any, Dict, List, Optional, Tuple
 from urllib.parse import quote
 

@@ -12,7 +12,7 @@ rule-action provider so rules can target them by name.
 from __future__ import annotations
 
 import asyncio
-import json
+from .. import jsonc as json  # codec seam: native with stdlib fallback
 import logging
 import time
 from typing import Any, Dict, List, Optional

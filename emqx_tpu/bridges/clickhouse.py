@@ -11,7 +11,7 @@ INSERT, like the reference's batch_value_separator handling.
 from __future__ import annotations
 
 import asyncio
-import json
+from .. import jsonc as json  # codec seam: native with stdlib fallback
 from typing import Any, Dict, List, Optional
 
 from .postgres import render_sql

@@ -12,7 +12,7 @@
 from __future__ import annotations
 
 import asyncio
-import json
+from .. import jsonc as json  # codec seam: native with stdlib fallback
 from typing import Any, Callable, Dict, List, Optional
 
 from ..client import MqttClient, MqttError

@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import asyncio
 import base64
-import json
+from .. import jsonc as json  # codec seam: native with stdlib fallback
 import time
 from typing import Any, Dict, List, Optional
 

@@ -18,7 +18,7 @@ worker retries in order."""
 from __future__ import annotations
 
 import asyncio
-import json
+from .. import jsonc as json  # codec seam: native with stdlib fallback
 import logging
 import re
 import urllib.error

@@ -14,7 +14,7 @@ this speaks the remoting wire format:
 from __future__ import annotations
 
 import asyncio
-import json
+from .. import jsonc as json  # codec seam: native with stdlib fallback
 import struct
 from typing import Any, Dict, List, Optional, Tuple
 

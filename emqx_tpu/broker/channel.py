@@ -440,6 +440,7 @@ class Channel:
                 "client.subscribe", (self.client_id,), pkt.filters
             )
             filters = acc if acc is not None else pkt.filters
+        reader = self._begin_retained_batch(filters)
         for flt, opts in filters:
             # get, not pop: one SUBSCRIBE may list the same filter twice
             # and both occurrences must hit the pre-resolved verdict.
@@ -463,7 +464,8 @@ class Channel:
                 continue
             try:
                 retained = self.broker.subscribe(
-                    self.session, self._mount_filter(flt), opts
+                    self.session, self._mount_filter(flt), opts,
+                    retained_reader=reader,
                 )
             except ExclusiveTaken:
                 codes.append(
@@ -487,6 +489,52 @@ class Channel:
                 )
                 out.extend(self.session.deliver(rm, ropts))
         return [Suback(pkt.packet_id, codes)] + out
+
+    def _begin_retained_batch(self, filters):
+        """Launch ONE batched retained lookup for the whole SUBSCRIBE
+        packet (broker.retained_read_begin) before the subscribe loop
+        runs authz/route work — the device probe and its D2H copy ride
+        under that host work. Returns a reader(real) -> messages for
+        Broker.subscribe, or None when the device leg is off or a
+        single-filter packet makes batching pointless. Over-fetch
+        (e.g. a filter later rejected by caps) is harmless: retained
+        reads are side-effect-free."""
+        retainer = self.broker.retainer
+        if not getattr(retainer, "device_enabled", False) or len(filters) < 2:
+            return None
+        from ..ops.topic import parse_share
+
+        reals = []
+        for flt, opts in filters:
+            if opts.retain_handling == 2:
+                continue
+            f = flt[len(EXCLUSIVE_PREFIX):] if flt.startswith(
+                EXCLUSIVE_PREFIX
+            ) else flt
+            try:
+                group, real = parse_share(self._mount_filter(f))
+            except Exception:
+                continue
+            if group is None:  # no retained delivery for shared subs
+                reals.append(real)
+        if not reals:
+            return None
+        begun = retainer.retained_read_begin(reals)
+        cache: dict = {}
+
+        def reader(real):
+            if not cache:
+                for r, msgs in zip(
+                    reals, retainer.retained_read_finish(begun)
+                ):
+                    cache.setdefault(r, msgs)
+                cache.setdefault("", [])  # finished marker
+            hit = cache.get(real)
+            # a hook-rewritten or duplicate filter outside the batch
+            # takes the single-read path
+            return hit if hit is not None else retainer.read(real)
+
+        return reader
 
     def _handle_unsubscribe(self, pkt: Unsubscribe) -> List[object]:
         assert self.session is not None

@@ -98,6 +98,7 @@ import contextlib
 import gc
 import logging
 from collections import deque
+from contextlib import nullcontext
 from typing import Deque, Dict, List, Optional, Set, Tuple
 
 from .message import Message
@@ -583,16 +584,29 @@ class DispatchEngine:
             entries = []
             topics = []
             bspan = None
-            for msg, fut, t_in, span in batch:
-                tel.observe_family("pipeline_queue_wait_seconds", now - t_in)
-                if span is not None:
-                    span.add("queue", now - t_in)
-                    if bspan is None and st is not None:
-                        bspan = st.batch_span()
-                live = broker._pre_publish(msg)
-                entries.append((live, fut, span))
-                if live is not None:
-                    topics.append(live.topic)
+            # batched-WHERE window: rule predicates hit inside the
+            # publish-hook fold defer into one columnar drain when the
+            # window closes — the whole coalesced batch shares one
+            # column extraction per referenced path
+            rb = getattr(broker, "rule_batcher", None)
+            win = (
+                rb.batch_window()
+                if rb is not None and rb.batch_where_enabled
+                else nullcontext()
+            )
+            with win:
+                for msg, fut, t_in, span in batch:
+                    tel.observe_family(
+                        "pipeline_queue_wait_seconds", now - t_in
+                    )
+                    if span is not None:
+                        span.add("queue", now - t_in)
+                        if bspan is None and st is not None:
+                            bspan = st.batch_span()
+                    live = broker._pre_publish(msg)
+                    entries.append((live, fut, span))
+                    if live is not None:
+                        topics.append(live.topic)
             self.batches_total += 1
             self.publishes_total += len(batch)
             if topics:

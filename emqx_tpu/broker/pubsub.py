@@ -252,13 +252,20 @@ class Broker:
     # --- subscribe path --------------------------------------------------
 
     def subscribe(
-        self, session: Session, flt: str, opts: SubOpts
+        self,
+        session: Session,
+        flt: str,
+        opts: SubOpts,
+        retained_reader=None,
     ) -> List[Message]:
         """Register a subscription; returns retained messages to
         deliver (per retain_handling). `$exclusive/T` claims T for this
         client (raises ExclusiveTaken if another client holds it) and
         subscribes to the stripped topic, like the reference parse
-        (emqx_topic.erl:396-401)."""
+        (emqx_topic.erl:396-401). `retained_reader` (real -> messages)
+        lets the channel serve a whole SUBSCRIBE packet's retained
+        lookups from ONE batched device dispatch (retained_read_begin
+        launched before the subscribe loop)."""
         exclusive = flt.startswith(EXCLUSIVE_PREFIX)
         if exclusive:
             if not self.caps.exclusive_subscription:
@@ -290,7 +297,7 @@ class Broker:
             self.hooks.run("session.subscribed", session.client_id, flt, opts)
             if opts.retain_handling == 2 or (opts.retain_handling == 1 and existed):
                 return []
-            return self.retainer.read(real)
+            return self._read_retained(real, retained_reader)
         existed = flt in session.subscriptions
         session.subscriptions[flt] = opts
         self.suboptions[(flt, session.client_id)] = opts
@@ -311,7 +318,19 @@ class Broker:
             return []
         if opts.retain_handling == 2 or (opts.retain_handling == 1 and existed):
             return []
-        return self.retainer.read(real)
+        return self._read_retained(real, retained_reader)
+
+    def _read_retained(self, real: str, reader=None) -> List[Message]:
+        """Retained lookup for one just-registered filter: the
+        channel's batched reader when a SUBSCRIBE-packet window is
+        open, else the device halves at B=1, else the host trie."""
+        if reader is not None:
+            return reader(real)
+        retainer = self.retainer
+        if retainer.device_enabled:
+            begun = retainer.retained_read_begin([real])
+            return retainer.retained_read_finish(begun)[0]
+        return retainer.read(real)
 
     def unsubscribe(self, session: Session, flt: str) -> bool:
         if flt.startswith(EXCLUSIVE_PREFIX):
@@ -436,7 +455,14 @@ class Broker:
         coalesced publisher — the same failure-domain contract as the
         pipelined engine, for the synchronous surface (server
         PublishBatcher, cluster forward legs, bench)."""
-        live = [self._pre_publish(m) for m in msgs]
+        rb = getattr(self, "rule_batcher", None)
+        if rb is not None and rb.batch_where_enabled:
+            # batched-WHERE window: rule predicates hit in the publish
+            # hooks defer into one columnar drain at window close
+            with rb.batch_window():
+                live = [self._pre_publish(m) for m in msgs]
+        else:
+            live = [self._pre_publish(m) for m in msgs]
         topics = [m.topic for m in live if m is not None]
         router = self.router
         try:

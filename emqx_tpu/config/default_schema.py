@@ -204,6 +204,25 @@ def broker_schema() -> Struct:
                                     "tpu_match_enable": Field(Bool(), default=True),
                                     "tpu_batch_window_ms": Field(Duration(), default=1),
                                     "tpu_min_batch": Field(Int(min=1), default=64),
+                                    # new device workloads (r14):
+                                    # retained-match cuckoo probe over
+                                    # stored topic names (the inverse
+                                    # of routing), batched rule WHERE
+                                    # mask evaluation over coalesced
+                                    # publish batches, and the native
+                                    # JSON codec behind the jsonc seam
+                                    "tpu_retained_enable": Field(
+                                        Bool(), default=False
+                                    ),
+                                    "tpu_retained_shards": Field(
+                                        Int(min=1), default=1
+                                    ),
+                                    "tpu_rule_where_enable": Field(
+                                        Bool(), default=False
+                                    ),
+                                    "json_native": Field(
+                                        Bool(), default=True
+                                    ),
                                     # pipelined dispatch engine
                                     # (broker/dispatch_engine.py): the
                                     # micro-batch closes at queue_depth

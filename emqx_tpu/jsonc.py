@@ -1,0 +1,202 @@
+"""JSON codec seam: jiffy-class native codec with stdlib fallback.
+
+The reference broker routes every payload encode/decode through jiffy
+(a C NIF); stdlib `json` was the one remaining pure-Python stage on
+the rules/bridge/REST payload path.  This seam is the single import
+point for that path: `native/json.cc` (`_emqx_json.so`) handles the
+supported surface — stdlib-default semantics (ensure_ascii, NaN/
+Infinity literals, str-keyed objects) plus the compact
+`separators=(",", ":")` and `default=` kwargs — and anything outside
+it falls back to stdlib, counted, never silently wrong:
+
+  * unsupported kwargs (sort_keys/indent/cls/...) → stdlib;
+  * native raising TypeError/ValueError (non-str dict keys, circular
+    refs, parse rejects) → retried on stdlib so callers see stdlib's
+    exact exception types (json.JSONDecodeError, circular-reference
+    ValueError) and stdlib's coercions (int dict keys);
+  * no toolchain / `EMQX_TPU_NO_JSONC` → stdlib for the process.
+
+The codec's ledger is process-global like the durable tier's
+(ds/metrics.py): bridges and REST handlers decode before any broker
+exists, so the `emqx_json_*` families render on EVERY scrape with
+zero defaults.  Static gate: tests/test_static_gate.py pins the
+native ABI and AST-bans raw json.loads/dumps on the seam-covered
+paths; tests/test_jsonc.py holds the parity corpus.
+"""
+
+from __future__ import annotations
+
+import importlib.machinery
+import importlib.util
+import json as _stdlib_json
+import os
+import subprocess
+from typing import Any, List, Optional
+
+_NATIVE_DIR = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "native")
+)
+_SO = os.path.join(_NATIVE_DIR, "_emqx_json.so")
+
+_mod = None
+_tried = False
+
+# the compact-separator form used by the wire/bridge call sites; any
+# other separators value is outside the native surface
+_COMPACT_SEPARATORS = (",", ":")
+_NATIVE_DUMPS_KWARGS = frozenset(("separators", "default"))
+
+
+class JsonMetrics:
+    """Process-global codec ledger (`emqx_json_*` families).
+
+    Plain unlocked ints: increments happen on the per-message hot path
+    and stay atomic enough under the GIL; tests assert deltas."""
+
+    def __init__(self) -> None:
+        self.native_loads = 0
+        self.native_dumps = 0
+        self.fallback_loads = 0
+        self.fallback_dumps = 0
+
+    def snapshot(self) -> dict:
+        return {
+            "native_loads": self.native_loads,
+            "native_dumps": self.native_dumps,
+            "fallback_loads": self.fallback_loads,
+            "fallback_dumps": self.fallback_dumps,
+            "native_enabled": 1 if (_mod is not None and _enabled) else 0,
+        }
+
+    def prometheus_lines(self, node_name: str = "emqx@127.0.0.1") -> List[str]:
+        node = f'node="{node_name}"'
+        enabled = 1 if (_mod is not None and _enabled) else 0
+        lines = [
+            "# TYPE emqx_json_native_enabled gauge",
+            f"emqx_json_native_enabled{{{node}}} {enabled}",
+            "# TYPE emqx_json_native_loads_total counter",
+            f"emqx_json_native_loads_total{{{node}}} {self.native_loads}",
+            "# TYPE emqx_json_native_dumps_total counter",
+            f"emqx_json_native_dumps_total{{{node}}} {self.native_dumps}",
+            "# TYPE emqx_json_fallback_loads_total counter",
+            f"emqx_json_fallback_loads_total{{{node}}} {self.fallback_loads}",
+            "# TYPE emqx_json_fallback_dumps_total counter",
+            f"emqx_json_fallback_dumps_total{{{node}}} {self.fallback_dumps}",
+        ]
+        return lines
+
+
+JSON_METRICS = JsonMetrics()
+
+_enabled = True
+
+
+def set_native_enabled(flag: bool) -> None:
+    """Config seam for the `broker.perf.json_native` knob."""
+    global _enabled
+    _enabled = bool(flag)
+
+
+def native_enabled() -> bool:
+    return _enabled and load() is not None
+
+
+def load(build: bool = True):
+    global _mod, _tried
+    if _mod is not None or _tried:
+        return _mod
+    if os.environ.get("EMQX_TPU_NO_JSONC"):
+        _tried = True
+        return None
+    _tried = True
+    if build:
+        try:
+            subprocess.run(
+                ["make", "-C", _NATIVE_DIR, "_emqx_json.so"],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+        except Exception:
+            pass
+    if not os.path.exists(_SO):
+        return None
+    try:
+        loader = importlib.machinery.ExtensionFileLoader("_emqx_json", _SO)
+        spec = importlib.util.spec_from_file_location(
+            "_emqx_json", _SO, loader=loader
+        )
+        assert spec is not None
+        mod = importlib.util.module_from_spec(spec)
+        loader.exec_module(mod)
+        # a committed .so for a foreign ABI fails the import; this
+        # guards against a silently-miscompiled codec by demanding
+        # byte parity with stdlib on a doc covering every token kind
+        probe = {
+            "k": [1, -2.5, 1e16, "é\t\"x\"", None, True, False],
+            "n": {"deep": [[]], "big": 10**40},
+        }
+        if mod.dumps(probe, 0, None) != _stdlib_json.dumps(probe):
+            return None
+        if mod.loads(mod.dumps(probe, 1, None)) != probe:
+            return None
+        _mod = mod
+    except Exception:
+        _mod = None
+    return _mod
+
+
+def loads(s: Any) -> Any:
+    """Drop-in for json.loads on the payload path (str/bytes input)."""
+    mod = _mod if _tried else load()
+    m = JSON_METRICS
+    if mod is not None and _enabled:
+        try:
+            out = mod.loads(s)
+        except (ValueError, TypeError):
+            # native is (deliberately) at least as strict as stdlib;
+            # re-run on stdlib so callers get json.JSONDecodeError with
+            # stdlib's message/position — or a success if stdlib is
+            # laxer on this input
+            m.fallback_loads += 1
+            return _stdlib_json.loads(s)
+        m.native_loads += 1
+        return out
+    m.fallback_loads += 1
+    return _stdlib_json.loads(s)
+
+
+def dumps(obj: Any, **kwargs: Any) -> str:
+    """Drop-in for json.dumps; native handles the stdlib-default and
+    compact-separator surfaces, everything else falls back."""
+    mod = _mod if _tried else load()
+    m = JSON_METRICS
+    if mod is not None and _enabled:
+        if not kwargs:  # the hot wire/console call shape
+            try:
+                out = mod.dumps(obj, 0, None)
+            except (TypeError, ValueError):
+                pass
+            else:
+                m.native_dumps += 1
+                return out
+        elif not (kwargs.keys() - _NATIVE_DUMPS_KWARGS):
+            seps = kwargs.get("separators")
+            if seps is None or tuple(seps) == _COMPACT_SEPARATORS:
+                try:
+                    out = mod.dumps(
+                        obj,
+                        1 if seps is not None else 0,
+                        kwargs.get("default"),
+                    )
+                except (TypeError, ValueError):
+                    # non-str dict keys (stdlib coerces), circular
+                    # refs (stdlib raises its own ValueError),
+                    # default() failures — replay on stdlib for
+                    # exact semantics
+                    pass
+                else:
+                    m.native_dumps += 1
+                    return out
+    m.fallback_dumps += 1
+    return _stdlib_json.dumps(obj, **kwargs)

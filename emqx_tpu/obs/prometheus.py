@@ -99,6 +99,16 @@ def prometheus_text(broker, node_name: str = "emqx@127.0.0.1", obs=None) -> str:
     from ..cluster.metrics import CLUSTER_METRICS
 
     lines.extend(CLUSTER_METRICS.prometheus_lines(node_name))
+    # JSON codec seam ledger (emqx_json_* namespace — process-global:
+    # bridges/REST decode payloads before any broker object exists)
+    from ..jsonc import JSON_METRICS
+
+    lines.extend(JSON_METRICS.prometheus_lines(node_name))
+    # retainer surface (emqx_retainer_* namespace — the max_retained
+    # drop and expiry sweep were previously invisible)
+    retainer = getattr(broker, "retainer", None)
+    if retainer is not None and hasattr(retainer, "prometheus_lines"):
+        lines.extend(retainer.prometheus_lines(node_name))
     return "\n".join(lines) + "\n"
 
 

@@ -17,14 +17,15 @@ the bridge/action hookup point).
 
 from __future__ import annotations
 
-import json
 import logging
 import re
 import time
+from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 from ..broker.message import Message
+from ..jsonc import dumps as _json_dumps, loads as _json_loads
 from ..ops import topic as topic_mod
 from ..ops.host_index import TopicTrie
 from . import events as ev
@@ -47,7 +48,7 @@ def _get_path(env: Dict[str, Any], path: List[str]) -> Any:
         if isinstance(cur, (bytes, str)) and i >= 1:
             # payload.* auto-decodes JSON payloads (reference behavior)
             try:
-                cur = json.loads(cur if isinstance(cur, str) else cur.decode())
+                cur = _json_loads(cur if isinstance(cur, str) else cur.decode())
             except Exception:
                 return None
         if isinstance(cur, dict):
@@ -249,6 +250,22 @@ class RuleEngine:
         # per-rule proc dicts + engine-wide kv store (see apply_rule)
         self._proc_dicts: Dict[str, Dict[str, Any]] = {}
         self._kv_store: Dict[str, Any] = {}
+        # batched WHERE leg (rules/batch_where.py): inside an open
+        # batch_window(), vectorizable WHERE predicates defer into one
+        # columnar mask evaluation at window close; everything else
+        # (foreach, uncompilable predicates, fallback rows) re-runs
+        # through eval_expr — the oracle — counted, never silently
+        # wrong
+        self.batch_where_enabled = False
+        self.telemetry = None  # KernelTelemetry handle (emqx_xla_rule_*)
+        self._win_envs: Optional[List[Dict[str, Any]]] = None
+        self._win_groups: Optional[Dict[str, Tuple[Rule, List[int]]]] = None
+        self.where_stats = {
+            "windows": 0,
+            "batch_rows": 0,
+            "fallback_rows": 0,
+            "uncompiled_rows": 0,
+        }
 
     # --- CRUD -----------------------------------------------------------
 
@@ -335,6 +352,24 @@ class RuleEngine:
         env = ev.message_event(msg)
         env["_republish_depth"] = depth
         by = msg.headers.get("republish_by")
+        if self._win_envs is not None:
+            # open batch window: defer WHERE-bearing single-row rules
+            # into the columnar drain; foreach and WHERE-less rules
+            # apply immediately (nothing to vectorize)
+            ei = None
+            for rule in self.match_rules(msg.topic):
+                if by is not None and rule.id == by:
+                    continue
+                sel = rule.select
+                if sel.foreach is not None or sel.where is None:
+                    self.apply_rule(rule, env)
+                    continue
+                rule.metrics.matched += 1
+                if ei is None:
+                    ei = len(self._win_envs)
+                    self._win_envs.append(env)
+                self._win_groups.setdefault(rule.id, (rule, []))[1].append(ei)
+            return None
         for rule in self.match_rules(msg.topic):
             if by is not None and rule.id == by:
                 continue  # a rule never re-triggers itself
@@ -347,8 +382,7 @@ class RuleEngine:
             if rule is not None and rule.enable:
                 self.apply_rule(rule, env)
 
-    def apply_rule(self, rule: Rule, env: Dict[str, Any]) -> Optional[List[Dict[str, Any]]]:
-        rule.metrics.matched += 1
+    def _bind_env(self, rule: Rule, env: Dict[str, Any]) -> Dict[str, Any]:
         # proc_dict is scoped PER RULE (the reference's erlang proc
         # dict belongs to the evaluating process — rules must not see
         # each other's values); kv_store is engine-wide like the
@@ -359,6 +393,11 @@ class RuleEngine:
         env = dict(env)
         env["_proc_dict"] = self._proc_dicts.setdefault(rule.id, {})
         env["_kv_store"] = self._kv_store
+        return env
+
+    def apply_rule(self, rule: Rule, env: Dict[str, Any]) -> Optional[List[Dict[str, Any]]]:
+        rule.metrics.matched += 1
+        env = self._bind_env(rule, env)
         try:
             sel = rule.select
             rows: List[Dict[str, Any]]
@@ -393,6 +432,114 @@ class RuleEngine:
             self._run_actions(rule, row, env)
         return rows
 
+    def _finish_rule(self, rule: Rule, env: Dict[str, Any]) -> Optional[List[Dict[str, Any]]]:
+        """Post-WHERE half of apply_rule for a single-row rule whose
+        predicate already passed; env must be _bind_env-bound."""
+        try:
+            rows = [select_fields(rule.select, env)]
+            rule.metrics.passed += 1
+        except Exception:
+            rule.metrics.failed += 1
+            log.exception("rule %s evaluation failed", rule.id)
+            return None
+        for row in rows:
+            self._run_actions(rule, row, env)
+        return rows
+
+    def _apply_where_row(self, rule: Rule, env: Dict[str, Any]) -> None:
+        """Per-row escalation for a batch-windowed rule: evaluate the
+        WHERE through eval_expr (the oracle) and finish. `matched` was
+        counted at enqueue time."""
+        env = self._bind_env(rule, env)
+        try:
+            if not bool(eval_expr(rule.select.where, env)):
+                rule.metrics.no_result += 1
+                return
+        except Exception:
+            rule.metrics.failed += 1
+            log.exception("rule %s evaluation failed", rule.id)
+            return
+        self._finish_rule(rule, env)
+
+    # --- batched WHERE window (rules/batch_where.py) --------------------
+
+    @contextmanager
+    def batch_window(self):
+        """Defer WHERE evaluation for every 'message.publish' rule hit
+        inside the window into one columnar mask drain at close. The
+        broker's coalesced publish paths (publish_batch, the dispatch
+        engine's _flush) open this around their _pre_publish fold.
+        Nested windows are no-ops (the outermost drains)."""
+        if not self.batch_where_enabled or self._win_envs is not None:
+            yield
+            return
+        self._win_envs = []
+        self._win_groups = {}
+        try:
+            yield
+        finally:
+            self._drain_window()
+
+    def _drain_window(self) -> None:
+        envs, self._win_envs = self._win_envs, None
+        groups, self._win_groups = self._win_groups, None
+        if not groups:
+            return
+        import numpy as np
+
+        from .batch_where import ColumnBatch, compile_where
+
+        tel = self.telemetry
+        if tel is None and self.broker is not None:
+            tel = getattr(self.broker.router, "telemetry", None)
+        if tel is not None and not getattr(tel, "enabled", False):
+            tel = None
+        t0 = time.perf_counter()
+        batch = ColumnBatch(envs)
+        st = self.where_stats
+        st["windows"] += 1
+        n_vec = n_fb = n_unc = 0
+        for rule, idxs in groups.values():
+            comp = getattr(rule, "_where_compiled", _UNDEF)
+            if comp is _UNDEF:
+                comp = compile_where(rule.select.where)
+                rule._where_compiled = comp
+            if comp is None:
+                n_unc += len(idxs)
+                for i in idxs:
+                    self._apply_where_row(rule, envs[i])
+                continue
+            ix = np.asarray(idxs, dtype=np.int64)
+            try:
+                mask, fb = comp.eval(batch, ix)
+            except Exception:
+                log.exception(
+                    "rule %s batched WHERE failed; per-row fallback", rule.id
+                )
+                n_fb += len(idxs)
+                for i in idxs:
+                    self._apply_where_row(rule, envs[i])
+                continue
+            n_vec += len(idxs)
+            for j, i in enumerate(idxs):
+                if fb[j]:
+                    n_fb += 1
+                    self._apply_where_row(rule, envs[i])
+                elif mask[j]:
+                    self._finish_rule(rule, self._bind_env(rule, envs[i]))
+                else:
+                    rule.metrics.no_result += 1
+        st["batch_rows"] += n_vec
+        st["fallback_rows"] += n_fb
+        st["uncompiled_rows"] += n_unc
+        if tel is not None:
+            tel.count("rule_where_batch_rows_total", n_vec)
+            tel.count("rule_where_fallback_rows_total", n_fb)
+            tel.count("rule_where_uncompiled_rows_total", n_unc)
+            tel.observe_family(
+                "rule_where_batch_seconds", time.perf_counter() - t0
+            )
+
     def _run_actions(self, rule: Rule, row: Dict[str, Any], env: Dict[str, Any]) -> None:
         for action in rule.actions:
             try:
@@ -405,13 +552,13 @@ class RuleEngine:
     def _run_action(self, action: Dict[str, Any], row: Dict[str, Any], env: Dict[str, Any]) -> None:
         kind = action.get("function", action.get("type", "console"))
         if kind == "console":
-            log.info("[rule console] %s", json.dumps(row, default=_str))
+            log.info("[rule console] %s", _json_dumps(row, default=_str))
         elif kind == "republish":
             args = action.get("args", {})
             tpl_env = {**env, **row}
             topic = render_template(args.get("topic", "republish/${topic}"), tpl_env)
             payload_tpl = args.get("payload", "${payload}")
-            payload = render_template(payload_tpl, tpl_env) if payload_tpl else json.dumps(row, default=_str)
+            payload = render_template(payload_tpl, tpl_env) if payload_tpl else _json_dumps(row, default=_str)
             qos_raw = args.get("qos", 0)
             qos = int(render_template(str(qos_raw), tpl_env)) if isinstance(qos_raw, str) else qos_raw
             if self.broker is None:
@@ -444,6 +591,10 @@ class RuleEngine:
         if self._installed:
             return
         hooks.add("message.publish", self._hook_cb, priority=50)
+        if self.broker is not None:
+            # coalesced publish paths probe this handle to open the
+            # batched-WHERE window around their _pre_publish fold
+            self.broker.rule_batcher = self
         self._installed = True
 
     def _hook_cb(self, msg, acc=None):

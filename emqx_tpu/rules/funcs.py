@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import base64
 import hashlib
-import json
+from .. import jsonc as json  # codec seam: native with stdlib fallback
 import math
 import os
 import re

@@ -1,0 +1,340 @@
+"""Batched WHERE leg (rules/batch_where.py): the columnar mask must
+agree with `eval_expr` — the oracle — bit-for-bit on every row it does
+NOT flag for fallback, and the window drain in the engine must produce
+byte-identical outputs and metrics to the sync path. The corpus leans
+on the nasty equality edges: bool identity (true != 1), num<->str
+coercion ('5' = 5), unparseable strings, None = None, big ints past
+2^53, containers, and mixed-type ordered compares."""
+
+import random
+
+import numpy as np
+import pytest
+
+from emqx_tpu.broker.message import Message
+from emqx_tpu.rules import RuleEngine, parse_sql
+from emqx_tpu.rules.batch_where import ColumnBatch, compile_where
+from emqx_tpu.rules.engine import eval_expr
+from emqx_tpu.rules.events import message_event
+from emqx_tpu import jsonc
+
+
+def _where(pred: str):
+    return parse_sql(f'SELECT * FROM "t/#" WHERE {pred}').where
+
+
+COMPILABLE = [
+    "payload.x > 3",
+    "payload.x >= payload.y",
+    "payload.x = payload.y",
+    "payload.x != payload.y",
+    "payload.x < 2.5 OR payload.y <= 0",
+    "payload.s = 'alpha'",
+    "payload.s != 'alpha' AND payload.s < 'm'",
+    "payload.x = '5'",  # num<->str coercion lane
+    "payload.s > 1",  # str-vs-num ordered: eval_expr -> False
+    "payload.flag",  # bare truthiness
+    "NOT payload.flag",
+    "payload.flag = true",  # bool identity: True != 1
+    "payload.x IN (1, 2, 3, 'alpha')",
+    "payload.gone IS NULL",
+    "payload.x IS NOT NULL",
+    "qos > 0 AND topic = 't/a'",
+    "payload.x = 1 AND (payload.s = 'alpha' OR NOT payload.flag)",
+]
+
+UNCOMPILABLE = [
+    "lower(payload.s) = 'alpha'",  # function call
+    "payload.x + 1 > 3",  # arithmetic
+    "payload.s LIKE 'al%'",  # LIKE
+    "case when payload.x > 1 then true else false end",  # CASE
+]
+
+_VALUES = [
+    0,
+    1,
+    -1,
+    5,
+    2.5,
+    -0.0,
+    float("nan"),
+    2**53 + 1,  # past the float-exact window -> OTHER lane
+    10**40,
+    True,
+    False,
+    None,
+    "alpha",
+    "beta",
+    "5",
+    "2.5",
+    "not-a-number",
+    "",
+    [1, 2],
+    {"k": 1},
+]
+
+
+def _rand_env(rng):
+    payload = {}
+    for key in ("x", "y", "s", "flag"):
+        if rng.random() < 0.85:  # sometimes missing entirely
+            payload[key] = rng.choice(_VALUES)
+    env = message_event(
+        Message(
+            topic=rng.choice(["t/a", "t/b"]),
+            payload=jsonc.dumps(payload, default=str).encode(),
+            qos=rng.choice([0, 1, 2]),
+        )
+    )
+    return env
+
+
+def _oracle(where, env):
+    try:
+        return bool(eval_expr(where, env))
+    except Exception:
+        return False  # eval errors filter the row (engine counts failed)
+
+
+class TestCompiledMaskExactness:
+    def test_corpus_matches_oracle_on_non_fallback_rows(self):
+        rng = random.Random(1405)
+        envs = [_rand_env(rng) for _ in range(400)]
+        batch = ColumnBatch(envs)
+        ix = np.arange(len(envs), dtype=np.int64)
+        total_vec = 0
+        for pred in COMPILABLE:
+            where = _where(pred)
+            comp = compile_where(where)
+            assert comp is not None, f"should compile: {pred}"
+            mask, fb = comp.eval(batch, ix)
+            for i, env in enumerate(envs):
+                if fb[i]:
+                    continue
+                assert bool(mask[i]) == _oracle(where, env), (
+                    f"{pred!r} row {i}: payload="
+                    f"{env.get('payload')!r} mask={bool(mask[i])}"
+                )
+            total_vec += int((~fb).sum())
+        # the leg must actually vectorize the bulk of the corpus, not
+        # quietly shunt everything to the oracle
+        assert total_vec > len(envs) * len(COMPILABLE) * 0.6
+
+    def test_uncompilable_forms_return_none(self):
+        for pred in UNCOMPILABLE:
+            assert compile_where(_where(pred)) is None, pred
+
+    def test_index_paths_compile_and_match_oracle(self):
+        # bracket steps walk _get_path exactly like dotted steps, so
+        # they stay inside the compilable subset
+        envs = [
+            message_event(
+                Message(topic="t/a", payload=jsonc.dumps(p).encode())
+            )
+            for p in ({"arr": [9, 1]}, {"arr": [9, 2]}, {"arr": []}, {})
+        ]
+        where = _where("payload.arr[2] = 1")  # SQL indexes are 1-based
+        comp = compile_where(where)
+        assert comp is not None
+        batch = ColumnBatch(envs)
+        mask, fb = comp.eval(batch, np.arange(4, dtype=np.int64))
+        for i, env in enumerate(envs):
+            if not fb[i]:
+                assert bool(mask[i]) == _oracle(where, env)
+        assert bool(mask[0]) and not bool(mask[1])
+
+    def test_isnull_never_falls_back(self):
+        # OTHER-lane values (containers, big ints) are real non-None
+        # values: IS NULL answers exactly without per-row escalation
+        envs = [
+            message_event(
+                Message(topic="t/a", payload=jsonc.dumps(p).encode())
+            )
+            for p in ({"x": [1, 2]}, {"x": 10**40}, {"x": 1}, {})
+        ]
+        batch = ColumnBatch(envs)
+        comp = compile_where(_where("payload.x IS NULL"))
+        mask, fb = comp.eval(batch, np.arange(4, dtype=np.int64))
+        assert not fb.any()
+        assert mask.tolist() == [False, False, False, True]
+
+    def test_truthiness_of_containers_falls_back(self):
+        envs = [
+            message_event(
+                Message(topic="t/a", payload=jsonc.dumps(p).encode())
+            )
+            for p in ({"flag": [1]}, {"flag": True})
+        ]
+        batch = ColumnBatch(envs)
+        mask, fb = compile_where(_where("payload.flag")).eval(
+            batch, np.arange(2, dtype=np.int64)
+        )
+        assert bool(fb[0]) and not bool(fb[1])
+        assert bool(mask[1])
+
+
+def _mk_engine(batched: bool):
+    eng = RuleEngine()
+    eng.batch_where_enabled = batched
+    return eng
+
+
+def _drive(eng, msgs, rows_sink):
+    def capture_for(rid):
+        sink = rows_sink.setdefault(rid, [])
+        return lambda row, env: sink.append(row)
+
+    eng.create_rule(
+        "r_vec",
+        'SELECT payload.x AS x FROM "t/#" WHERE payload.x > 2',
+        actions=[{"function": capture_for("r_vec")}],
+    )
+    eng.create_rule(
+        "r_unc",
+        "SELECT clientid FROM \"t/#\" WHERE lower(topic) LIKE 't/%'",
+        actions=[{"function": capture_for("r_unc")}],
+    )
+    eng.create_rule(
+        "r_nowhere",
+        'SELECT qos FROM "t/#"',
+        actions=[{"function": capture_for("r_nowhere")}],
+    )
+    if eng.batch_where_enabled:
+        with eng.batch_window():
+            for m in msgs:
+                eng.on_message_publish(m)
+    else:
+        for m in msgs:
+            eng.on_message_publish(m)
+    return {rid: vars(r.metrics).copy() for rid, r in eng.rules.items()}
+
+
+class TestEngineWindow:
+    def test_window_output_and_metrics_match_sync_path(self):
+        rng = random.Random(7)
+        msgs = [
+            Message(
+                topic=f"t/{i % 3}",
+                payload=jsonc.dumps({"x": rng.choice([0, 1, 3, 9, "4", None])}).encode(),
+                qos=i % 3,
+            )
+            for i in range(40)
+        ]
+        rows_sync, rows_batch = {}, {}
+        m_sync = _drive(_mk_engine(False), msgs, rows_sync)
+        m_batch = _drive(_mk_engine(True), msgs, rows_batch)
+        assert m_sync == m_batch
+        # cross-rule interleaving differs (vectorized rules drain at
+        # window close), but per-rule content AND order must not
+        assert rows_sync == rows_batch
+
+    def test_where_stats_and_compiled_cache(self):
+        eng = _mk_engine(True)
+        _drive(eng, [Message(topic="t/a", payload=b'{"x": 5}')] * 8, {})
+        st = eng.where_stats
+        assert st["windows"] == 1
+        assert st["batch_rows"] == 8  # r_vec rode the columnar mask
+        assert st["uncompiled_rows"] == 8  # r_unc fell to eval_expr
+        assert st["fallback_rows"] == 0
+        assert eng.rules["r_vec"]._where_compiled is not None
+        assert eng.rules["r_unc"]._where_compiled is None
+
+    def test_nested_windows_drain_once_at_outermost(self):
+        eng = _mk_engine(True)
+        rows = []
+
+        def capture(row, env):
+            rows.append(row)
+
+        eng.create_rule(
+            "r",
+            'SELECT qos FROM "t/#" WHERE qos >= 0',
+            actions=[{"function": capture}],
+        )
+        with eng.batch_window():
+            with eng.batch_window():
+                eng.on_message_publish(Message(topic="t/a", payload=b"{}"))
+            assert rows == []  # inner exit must not drain
+        assert len(rows) == 1
+
+    def test_republish_self_skip_survives_the_window(self):
+        from emqx_tpu.broker.pubsub import Broker
+
+        broker = Broker()
+        eng = RuleEngine(broker)
+        eng.batch_where_enabled = True
+        eng.install(broker.hooks)
+        eng.create_rule(
+            "loopy",
+            'SELECT * FROM "t/#" WHERE qos >= 0',
+            actions=[{"function": "republish", "args": {"topic": "t/loop"}}],
+        )
+        with eng.batch_window():
+            eng.on_message_publish(Message(topic="t/in", payload=b"{}"))
+        # the republish re-enters on_message_publish (window closed by
+        # then); the self-skip keeps it from exploding
+        assert eng.rules["loopy"].metrics.matched <= 2
+
+
+class TestBrokerIntegration:
+    def test_publish_batch_opens_the_window(self):
+        from emqx_tpu.broker.packet import SubOpts
+        from emqx_tpu.broker.pubsub import Broker
+
+        broker = Broker()
+        eng = RuleEngine(broker)
+        eng.batch_where_enabled = True
+        eng.install(broker.hooks)
+        assert broker.rule_batcher is eng
+        got = []
+        eng.create_rule(
+            "rb",
+            'SELECT payload.x AS x FROM "b/#" WHERE payload.x >= 2',
+            actions=[{"function": lambda row, env: got.append(row["x"])}],
+        )
+        s, _ = broker.open_session("c1", clean_start=True)
+        s.outgoing_sink = lambda pkts: None
+        broker.subscribe(s, "b/#", SubOpts(qos=0))
+        msgs = [
+            Message(topic="b/t", payload=jsonc.dumps({"x": i}).encode())
+            for i in range(6)
+        ]
+        broker.publish_batch(msgs)
+        assert sorted(got) == [2, 3, 4, 5]
+        assert eng.where_stats["windows"] == 1
+        assert eng.where_stats["batch_rows"] == 6
+
+    async def test_dispatch_engine_flush_opens_the_window(self):
+        import asyncio
+
+        from emqx_tpu.broker.packet import SubOpts
+        from emqx_tpu.broker.pubsub import Broker
+
+        broker = Broker()
+        eng = RuleEngine(broker)
+        eng.batch_where_enabled = True
+        eng.install(broker.hooks)
+        got = []
+        eng.create_rule(
+            "rd",
+            'SELECT payload.x AS x FROM "d/#" WHERE payload.x > 0',
+            actions=[{"function": lambda row, env: got.append(row["x"])}],
+        )
+        s, _ = broker.open_session("c1", clean_start=True)
+        s.outgoing_sink = lambda pkts: None
+        broker.subscribe(s, "d/#", SubOpts(qos=0))
+        de = broker.enable_dispatch_engine(queue_depth=8, deadline_ms=0.5)
+        await asyncio.gather(
+            *[
+                de.publish(
+                    Message(
+                        topic=f"d/{i}", payload=jsonc.dumps({"x": i}).encode()
+                    )
+                )
+                for i in range(6)
+            ]
+        )
+        await de.stop()
+        assert sorted(got) == [1, 2, 3, 4, 5]
+        assert eng.where_stats["windows"] >= 1
+        assert eng.where_stats["batch_rows"] == 6

@@ -1,0 +1,189 @@
+"""JSON codec seam (emqx_tpu/jsonc.py + native/json.cc): byte parity
+with stdlib on the supported surface, stdlib's exact exception types
+on errors, counted fallback for everything else, and the knob/env
+gates. Every test here passes with OR without the native .so — the
+seam's whole contract is that callers can't tell the difference."""
+
+import json as stdlib_json
+import math
+
+import pytest
+
+from emqx_tpu import jsonc
+
+PARITY_DOCS = [
+    None,
+    True,
+    False,
+    0,
+    -1,
+    2**63 - 1,
+    -(2**63),
+    10**40,  # bigint: int path in both codecs
+    1.5,
+    -0.0,
+    1e-3,
+    1e16,
+    math.inf,
+    -math.inf,
+    "plain",
+    "",
+    "é漢\t\"quoted\"\\",
+    "\x00\x1f",
+    "😀",  # paired via surrogatepass round-trip semantics
+    [],
+    {},
+    [1, [2, [3, [4]]]],
+    {"a": 1, "b": [True, None, "x"], "c": {"d": {"e": []}}},
+    {"dup-ish": 1, "dup_ish": 2},
+    {"": "empty-key"},
+    list(range(50)),
+    {"unicode-ké": "välue"},
+]
+
+
+@pytest.mark.parametrize("doc", PARITY_DOCS, ids=repr)
+def test_dumps_byte_parity_with_stdlib(doc):
+    assert jsonc.dumps(doc) == stdlib_json.dumps(doc)
+    assert jsonc.dumps(doc, separators=(",", ":")) == stdlib_json.dumps(
+        doc, separators=(",", ":")
+    )
+
+
+@pytest.mark.parametrize("doc", PARITY_DOCS, ids=repr)
+def test_loads_round_trip(doc):
+    s = stdlib_json.dumps(doc)
+    assert jsonc.loads(s) == stdlib_json.loads(s)
+
+
+def test_nan_parity():
+    # stdlib emits the non-standard NaN literal; the seam must match
+    assert jsonc.dumps(float("nan")) == "NaN"
+    got = jsonc.loads("[NaN, Infinity, -Infinity]")
+    assert math.isnan(got[0]) and got[1] == math.inf and got[2] == -math.inf
+
+
+def test_loads_accepts_bytes():
+    assert jsonc.loads(b'{"k": [1, 2]}') == {"k": [1, 2]}
+
+
+def test_float_repr_parity():
+    # shortest-repr floats are where a naive %g codec diverges
+    for v in (0.1, 1 / 3, 6.62607015e-34, 1234567.891011, 2.0):
+        assert jsonc.dumps(v) == stdlib_json.dumps(v)
+        assert jsonc.loads(jsonc.dumps(v)) == v
+
+
+def test_decode_error_is_stdlib_type():
+    for bad in ('{"a": }', "[1,", "", "nul", '"\\u12"', "{1: 2}"):
+        with pytest.raises(stdlib_json.JSONDecodeError):
+            jsonc.loads(bad)
+
+
+def test_circular_reference_raises_valueerror():
+    a = []
+    a.append(a)
+    with pytest.raises(ValueError):
+        jsonc.dumps(a)
+
+
+def test_unserializable_raises_typeerror():
+    with pytest.raises(TypeError):
+        jsonc.dumps({"k": object()})
+
+
+def test_nonstr_keys_coerce_like_stdlib():
+    doc = {1: "a", 2.5: "b", True: "c", None: "d"}
+    assert jsonc.dumps(doc) == stdlib_json.dumps(doc)
+
+
+def test_default_kwarg_supported():
+    class Odd:
+        pass
+
+    assert jsonc.dumps({"o": Odd()}, default=lambda o: "ODD") == (
+        stdlib_json.dumps({"o": Odd()}, default=lambda o: "ODD")
+    )
+
+
+def test_unsupported_kwargs_fall_back_counted():
+    m = jsonc.JSON_METRICS
+    before = m.fallback_dumps
+    out = jsonc.dumps({"b": 1, "a": 2}, sort_keys=True)
+    assert out == '{"a": 2, "b": 1}'
+    assert m.fallback_dumps == before + 1
+    before = m.fallback_dumps
+    assert jsonc.dumps([1], indent=2) == stdlib_json.dumps([1], indent=2)
+    assert m.fallback_dumps == before + 1
+
+
+def test_noncompact_separators_fall_back():
+    before = jsonc.JSON_METRICS.fallback_dumps
+    assert jsonc.dumps([1, 2], separators=("; ", " = ")) == (
+        stdlib_json.dumps([1, 2], separators=("; ", " = "))
+    )
+    assert jsonc.JSON_METRICS.fallback_dumps == before + 1
+
+
+def test_native_enabled_knob_gates_the_codec():
+    m = jsonc.JSON_METRICS
+    try:
+        jsonc.set_native_enabled(False)
+        b_nat, b_fb = m.native_loads, m.fallback_loads
+        jsonc.loads("[1]")
+        assert m.native_loads == b_nat and m.fallback_loads == b_fb + 1
+        assert m.snapshot()["native_enabled"] == 0
+    finally:
+        jsonc.set_native_enabled(True)
+    if jsonc.native_enabled():
+        b_nat = m.native_loads
+        jsonc.loads("[1]")
+        assert m.native_loads == b_nat + 1
+
+
+def test_native_counters_move_when_native_serves():
+    if not jsonc.native_enabled():
+        pytest.skip("native codec unavailable in this environment")
+    m = jsonc.JSON_METRICS
+    b = m.native_dumps
+    jsonc.dumps({"k": [1, "x", None]})
+    assert m.native_dumps == b + 1
+
+
+def test_env_gate_disables_load(monkeypatch):
+    import importlib
+
+    monkeypatch.setenv("EMQX_TPU_NO_JSONC", "1")
+    monkeypatch.setattr(jsonc, "_mod", None)
+    monkeypatch.setattr(jsonc, "_tried", False)
+    assert jsonc.load() is None
+    # stdlib still serves
+    assert jsonc.loads("[1]") == [1]
+
+
+def test_metrics_prometheus_lines_shape():
+    lines = jsonc.JSON_METRICS.prometheus_lines("n1@host")
+    text = "\n".join(lines)
+    for fam, kind in (
+        ("emqx_json_native_enabled", "gauge"),
+        ("emqx_json_native_loads_total", "counter"),
+        ("emqx_json_native_dumps_total", "counter"),
+        ("emqx_json_fallback_loads_total", "counter"),
+        ("emqx_json_fallback_dumps_total", "counter"),
+    ):
+        assert f"# TYPE {fam} {kind}" in text
+        assert f'{fam}{{node="n1@host"}}' in text
+
+
+def test_wire_corpus_round_trips_through_the_seam():
+    # the payload mix the bridges/rules path actually carries
+    corpus = [
+        {"deviceId": "d-000123", "ts": 1722860000123, "temp": 23.75,
+         "ok": True, "tags": ["a", "b"], "geo": {"lat": 52.1, "lon": 4.9}},
+        {"event": "alarm", "level": 3, "msg": "температура"},
+        [{"v": i / 7} for i in range(20)],
+    ]
+    for doc in corpus:
+        compact = jsonc.dumps(doc, separators=(",", ":"))
+        assert compact == stdlib_json.dumps(doc, separators=(",", ":"))
+        assert jsonc.loads(compact) == doc

@@ -770,3 +770,88 @@ async def test_cluster_selfheal_families_lint():
         r'emqx_cluster_member_state\{node="n1@host",peer="n\d+"\} \d',
         text,
     )
+
+
+def test_retained_rule_where_and_json_families_lint():
+    """ISSUE-14 families: the retained-match device leg
+    (emqx_xla_retained_* + emqx_retainer_*), the batched-WHERE leg
+    (emqx_xla_rule_where_*), and the JSON codec seam (emqx_json_*)
+    must all render on ONE scrape driven through real work — a device
+    retained read with a host escalation, a windowed publish_batch
+    with vectorized/fallback/uncompiled rows, and codec traffic — and
+    pass the same exposition lint."""
+    from emqx_tpu import jsonc
+    from emqx_tpu.rules import RuleEngine
+
+    broker = Broker()
+    tel = broker.router.telemetry
+
+    # --- retained leg: device read + deep-filter host escalation +
+    # an expiry purge (read-repair) so every counter moves
+    ret = broker.retainer
+    ret.enable_device(telemetry=tel)
+    for n in ("rm/a", "rm/b", "rm/c/d"):
+        broker.publish(Message(topic=n, payload=b"v", retain=True))
+    broker.publish(
+        Message(
+            topic="rm/ttl", payload=b"v", retain=True, timestamp=100.0,
+            props={"message_expiry_interval": 1},
+        )
+    )
+    deep = "/".join("w" for _ in range(20))  # past max_levels: host plan
+    out = ret.retained_read_finish(
+        ret.retained_read_begin(["rm/+", deep + "/#"], now=200.0)
+    )
+    assert sorted(m.topic for m in out[0]) == ["rm/a", "rm/b"]
+    assert ret.expired_total == 1
+    assert tel.counters.get("retained_device_reads_total", 0) >= 1
+    assert tel.counters.get("retained_host_fallback_total", 0) >= 1
+
+    # --- batched WHERE leg: one window with vectorized rows, an
+    # OTHER-lane fallback row, and an uncompilable rule
+    eng = RuleEngine(broker)
+    eng.batch_where_enabled = True
+    eng.install(broker.hooks)
+    eng.create_rule("lv", 'SELECT qos FROM "rw/#" WHERE payload.flag')
+    eng.create_rule(
+        "lu", "SELECT qos FROM \"rw/#\" WHERE lower(topic) = 'rw/0'"
+    )
+    broker.publish_batch(
+        [
+            Message(topic="rw/0", payload=b'{"flag": true}'),
+            Message(topic="rw/1", payload=b'{"flag": [1]}'),  # fallback
+        ]
+    )
+    assert tel.counters.get("rule_where_batch_rows_total", 0) >= 2
+    assert tel.counters.get("rule_where_fallback_rows_total", 0) >= 1
+    assert tel.counters.get("rule_where_uncompiled_rows_total", 0) >= 2
+
+    # --- codec leg: the publishes above already rode the seam
+    # (payload.* decode); make one explicit call each way too
+    jsonc.loads(jsonc.dumps({"k": 1}))
+
+    text = prometheus_text(broker, "n1@host")
+    types = _lint(text)
+    for fam, kind in (
+        ("emqx_retainer_entries", "gauge"),
+        ("emqx_retainer_expired_total", "counter"),
+        ("emqx_retainer_dropped_full_total", "counter"),
+        ("emqx_xla_retained_device_reads_total", "counter"),
+        ("emqx_xla_retained_host_fallback_total", "counter"),
+        ("emqx_xla_retained_probe_seconds", "histogram"),
+        ("emqx_xla_rule_where_batch_rows_total", "counter"),
+        ("emqx_xla_rule_where_fallback_rows_total", "counter"),
+        ("emqx_xla_rule_where_uncompiled_rows_total", "counter"),
+        ("emqx_xla_rule_where_batch_seconds", "histogram"),
+        ("emqx_json_native_enabled", "gauge"),
+        ("emqx_json_native_loads_total", "counter"),
+        ("emqx_json_native_dumps_total", "counter"),
+        ("emqx_json_fallback_loads_total", "counter"),
+        ("emqx_json_fallback_dumps_total", "counter"),
+    ):
+        assert types.get(fam) == kind, f"{fam}: {types.get(fam)}"
+    # the retained store gauge carries the live entry count
+    m = re.search(r'emqx_retainer_entries\{node="n1@host"\} (\d+)', text)
+    assert m and int(m.group(1)) == len(ret)
+    # no serve-time retraces anywhere in the drive
+    assert tel.counters.get("recompiles_at_serve_total", 0) == 0

@@ -1,0 +1,246 @@
+"""Retained-match device leg (ops/retained.py + models/retainer.py):
+the cuckoo probe must stay bit-identical to the host trie walk — the
+oracle — across churn waves, on single and sharded tables, including
+every escalation path (ambiguity, deep names, staleness, OOV), and
+builds must never retrace at serve time (recompiles_at_serve_total
+stays 0 through read storms)."""
+
+import random
+
+import pytest
+
+from emqx_tpu.broker.message import Message
+from emqx_tpu.models.retainer import Retainer
+from emqx_tpu.obs.kernel_telemetry import KernelTelemetry
+from emqx_tpu.ops.retained import RetainedIndex, ShardedRetainedIndex
+
+FILTERS = [
+    "#",
+    "+",
+    "+/#",
+    "a/#",
+    "a/+",
+    "a/+/c",
+    "a/b/c",
+    "a/b/#",
+    "+/b/+",
+    "$sys/#",
+    "$sys/+",
+    "zz/none/#",
+    "+/+/+/+",
+]
+
+_WORDS = ["a", "b", "c", "d", "$sys", "x", "yy", ""]
+
+
+def _rand_names(rng, n):
+    out = set()
+    while len(out) < n:
+        depth = rng.randint(1, 4)
+        out.add("/".join(rng.choice(_WORDS) for _ in range(depth)))
+    return sorted(out)
+
+
+def _oracle(ret: Retainer, flt: str):
+    from emqx_tpu.ops import topic as topic_mod
+
+    return sorted(ret._match_names(topic_mod.words(flt)))
+
+
+def _device(ret: Retainer, idx, flt: str):
+    """One-filter device read; None means host escalation."""
+    res = idx.read_finish(idx.read_begin([flt]))[0]
+    return None if res is None else sorted(res)
+
+
+@pytest.mark.parametrize("n_shards", [1, 3])
+def test_churn_oracle(n_shards):
+    rng = random.Random(140 + n_shards)
+    ret = Retainer()
+    tel = KernelTelemetry()
+    idx = ret.enable_device(telemetry=tel, n_shards=n_shards)
+    live = []
+    for wave in range(6):
+        # add a wave...
+        for name in _rand_names(rng, 40):
+            if name not in live:
+                live.append(name)
+            ret.retain(Message(topic=name, payload=b"v"))
+        # ...remove a slice (empty payload deletes, MQTT spec)
+        rng.shuffle(live)
+        for name in live[: len(live) // 3]:
+            ret.retain(Message(topic=name, payload=b""))
+        del live[: len(live) // 3]
+        # after EVERY wave: device == host oracle on every filter
+        for flt in FILTERS:
+            want = _oracle(ret, flt)
+            got = _device(ret, idx, flt)
+            if got is None:
+                continue  # escalated: the host walk serves it
+            assert got == want, (wave, flt)
+    # the leg actually served from the device, it didn't escalate
+    # everything to the host walk
+    assert tel.counters.get("retained_device_reads_total", 0) > 0
+
+
+def test_read_storm_never_retraces_at_serve():
+    rng = random.Random(9)
+    ret = Retainer()
+    tel = KernelTelemetry()
+    idx = ret.enable_device(telemetry=tel)
+    for name in _rand_names(rng, 200):
+        ret.retain(Message(topic=name, payload=b"v"))
+    # warm every class the storm will use, then flip to serving
+    idx.read_finish(idx.read_begin(FILTERS))
+    tel.mark_serving()
+    for _ in range(4):
+        storm = [rng.choice(FILTERS) for _ in range(700)]  # > MAX_BATCH
+        idx.read_finish(idx.read_begin(storm))
+    assert tel.counters.get("recompiles_at_serve_total", 0) == 0
+
+
+def test_stale_ticket_escalates_to_host():
+    ret = Retainer()
+    idx = ret.enable_device()
+    ret.retain(Message(topic="a/b", payload=b"v"))
+    idx.read_finish(idx.read_begin(["a/#"]))  # create the class
+    t = idx.read_begin(["a/#"])
+    ret.retain(Message(topic="a/c", payload=b"v"))  # mutate under it
+    assert idx.read_finish(t) == [None]
+    # a fresh ticket sees the new name
+    assert sorted(idx.read_finish(idx.read_begin(["a/#"]))[0]) == [
+        "a/b",
+        "a/c",
+    ]
+
+
+def test_deep_names_force_host_plans():
+    ret = Retainer()
+    idx = ret.enable_device(max_levels=4)
+    deep = "/".join("w" for _ in range(6))
+    ret.retain(Message(topic=deep, payload=b"v"))
+    ret.retain(Message(topic="a/b", payload=b"v"))
+    # any read while an uncovered name exists escalates (the table
+    # cannot prove the deep name absent from a '#' answer)
+    assert idx.read_finish(idx.read_begin(["a/#", "#"])) == [None, None]
+    # host walk still exact
+    msgs = ret.read("#")
+    assert sorted(m.topic for m in msgs) == sorted([deep, "a/b"])
+    # deleting the deep name restores device service
+    ret.retain(Message(topic=deep, payload=b""))
+    assert idx.read_finish(idx.read_begin(["a/#"]))[0] == ["a/b"]
+
+
+def test_oov_literal_is_provably_empty():
+    ret = Retainer()
+    tel = KernelTelemetry()
+    idx = ret.enable_device(telemetry=tel)
+    ret.retain(Message(topic="a/b", payload=b"v"))
+    idx.read_finish(idx.read_begin(["a/+"]))  # class exists
+    # 'nope' is in no stored name: the vocab miss answers [] with no
+    # kernel launch and no host walk
+    assert idx.read_finish(idx.read_begin(["nope/+"])) == [[]]
+
+
+def test_retainer_read_halves_end_to_end():
+    ret = Retainer()
+    ret.retain(Message(topic="a/b", payload=b"1"))
+    ret.retain(Message(topic="a/c", payload=b"2"))
+    ret.retain(Message(topic="x", payload=b"3"))
+    ret.enable_device()
+    # mixed wave: exact (dict hit), wildcard (device), OOV wildcard
+    begun = ret.retained_read_begin(["a/b", "a/+", "q/#"])
+    out = ret.retained_read_finish(begun)
+    assert [m.payload for m in out[0]] == [b"1"]
+    assert sorted(m.payload for m in out[1]) == [b"1", b"2"]
+    assert out[2] == []
+
+
+def test_retained_read_without_device_degrades_to_host():
+    ret = Retainer()  # no enable_device()
+    ret.retain(Message(topic="a/b", payload=b"1"))
+    out = ret.retained_read_finish(ret.retained_read_begin(["a/+", "a/b"]))
+    assert [m.topic for m in out[0]] == ["a/b"]
+    assert [m.topic for m in out[1]] == ["a/b"]
+
+
+class TestExpiryHygiene:
+    def _msg(self, topic, ts, ttl):
+        return Message(
+            topic=topic,
+            payload=b"v",
+            timestamp=ts,
+            props={"message_expiry_interval": ttl},
+        )
+
+    def test_purge_on_read_updates_every_structure(self):
+        ret = Retainer()
+        idx = ret.enable_device()
+        ret.retain(self._msg("a/b", ts=100.0, ttl=10))
+        ret.retain(Message(topic="a/c", payload=b"v"))
+        out = ret.retained_read_finish(
+            ret.retained_read_begin(["a/+"], now=200.0)
+        )
+        assert [m.topic for m in out[0]] == ["a/c"]
+        assert ret.expired_total == 1
+        assert len(ret) == 1 and len(idx) == 1  # device row purged too
+        assert _oracle(ret, "a/#") == ["a/c"]
+
+    def test_bounded_sweep_accrues_full_coverage(self):
+        ret = Retainer()
+        for i in range(10):
+            ret.retain(self._msg(f"s/{i}", ts=100.0, ttl=10))
+        ret.retain(Message(topic="s/live", payload=b"v"))
+        purged = 0
+        ticks = 0
+        while purged < 10 and ticks < 20:
+            purged += ret.sweep(now=200.0, budget=3)  # O(budget) per tick
+            ticks += 1
+        assert purged == 10 and ret.expired_total == 10
+        assert len(ret) == 1 and ticks > 1
+
+    def test_full_store_drop_is_counted_not_silent(self):
+        ret = Retainer(max_retained=2)
+        ret.retain(Message(topic="a", payload=b"v"))
+        ret.retain(Message(topic="b", payload=b"v"))
+        ret.retain(Message(topic="c", payload=b"v"))  # dropped
+        ret.retain(Message(topic="a", payload=b"v2"))  # replace: not a drop
+        assert ret.dropped_full_total == 1
+        assert ret._store["a"].payload == b"v2"
+
+    def test_scrape_families_render(self):
+        ret = Retainer(max_retained=1)
+        ret.retain(self._msg("a", ts=100.0, ttl=1))
+        ret.retain(Message(topic="b", payload=b"v"))
+        ret.read("a", now=200.0)
+        lines = ret.prometheus_lines("n1@host")
+        text = "\n".join(lines)
+        assert 'emqx_retainer_entries{node="n1@host"} 0' in text
+        assert 'emqx_retainer_expired_total{node="n1@host"} 1' in text
+        assert 'emqx_retainer_dropped_full_total{node="n1@host"} 1' in text
+
+
+def test_ambiguity_escalates_never_answers_wrong(monkeypatch):
+    """Force the amb flag on and prove the leg escalates instead of
+    trusting the probe."""
+    import numpy as np
+
+    ret = Retainer()
+    idx = ret.enable_device()
+    for n in ("a/b", "a/c"):
+        ret.retain(Message(topic=n, payload=b"v"))
+    idx.read_finish(idx.read_begin(["a/+"]))
+
+    import emqx_tpu.ops.retained as mod
+
+    real = mod._probe_kernel
+
+    def amb_kernel(*a):
+        bid, amb = real(*a)
+        return bid, amb | True
+
+    monkeypatch.setattr(mod, "_probe_kernel", amb_kernel)
+    assert idx.read_finish(idx.read_begin(["a/+"])) == [None]
+    # the Retainer-level read still answers exactly via the host walk
+    out = ret.retained_read_finish(ret.retained_read_begin(["a/+"]))
+    assert sorted(m.topic for m in out[0]) == ["a/b", "a/c"]

@@ -45,6 +45,7 @@ import emqx_tpu
 PKG = pathlib.Path(emqx_tpu.__file__).parent
 REPO = PKG.parent
 SPEEDUPS_CC = REPO / "native" / "speedups.cc"
+JSON_CC = REPO / "native" / "json.cc"
 
 # the publish dispatch path: a device fault handled here MUST leave a
 # trace (telemetry count / publisher-visible exception / re-raise)
@@ -426,6 +427,90 @@ def test_native_abi_matches_python_call_sites():
     assert not bad, "native ABI drift:\n" + "\n".join(bad)
 
 
+def _json_native_abi():
+    """loads/dumps arity parsed from native/json.cc: METH_O is arity 1
+    by definition; METH_VARARGS arity comes from the PyArg_ParseTuple
+    format (required units before '|')."""
+    src = JSON_CC.read_text()
+    methods = re.findall(
+        r'\{"(\w+)",\s*(?:\(PyCFunction\))?(\w+),\s*(METH_\w+)', src
+    )
+    assert methods, "no PyMethodDef entries parsed from json.cc"
+    abi = {}
+    for pyname, cfunc, flavor in methods:
+        if flavor == "METH_O":
+            abi[pyname] = 1
+            continue
+        m = re.search(
+            r"static PyObject \*" + cfunc + r"\s*\(.*?\n(.*?)\nstatic ",
+            src,
+            re.DOTALL,
+        )
+        body = m.group(1) if m else src
+        g = re.search(r'PyArg_ParseTuple\(args,\s*"([^"]+)"', body)
+        assert g, f"{cfunc}: no PyArg_ParseTuple found"
+        abi[pyname] = sum(1 for c in g.group(1).split("|")[0] if c in "Oisd")
+    return abi
+
+
+def test_json_native_abi_matches_seam_call_sites():
+    """The jsonc seam is the ONLY caller of the raw `_emqx_json`
+    module; its `mod.loads`/`mod.dumps` call arities must match the C
+    method table (loads is METH_O, dumps takes (obj, compact,
+    default)) — drift fails tier-1 here instead of raising at the
+    first payload decode."""
+    abi = _json_native_abi()
+    assert abi.get("loads") == 1, "json.cc loads must be METH_O arity 1"
+    assert abi.get("dumps") == 3, "json.cc dumps must take (obj, compact, default)"
+    tree = ast.parse((PKG / "jsonc.py").read_text())
+    bad = []
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == "mod"
+            and node.func.attr in abi
+        ):
+            got = len(node.args) + len(node.keywords)
+            if got != abi[node.func.attr]:
+                bad.append(
+                    f"jsonc.py:{node.lineno}: mod.{node.func.attr} called "
+                    f"with {got} args, C expects {abi[node.func.attr]}"
+                )
+    assert not bad, "json codec ABI drift:\n" + "\n".join(bad)
+
+
+# the payload paths whose every encode/decode must ride the jsonc seam
+# (native codec with a counted stdlib fallback); the seam itself holds
+# the only stdlib import, under an underscore alias
+JSON_SEAM_DIRS = ("rules", "bridges")
+
+
+def test_rules_bridges_json_rides_the_seam():
+    """No stdlib `import json` (nor `from json import ...`) under
+    rules/ or bridges/: a raw call site there would dodge the native
+    codec AND its fallback ledger, so the emqx_json_* scrape would
+    undercount exactly the hot path it exists to watch."""
+    bad = []
+    for d in JSON_SEAM_DIRS:
+        for path in sorted((PKG / d).rglob("*.py")):
+            rel = path.relative_to(PKG)
+            tree = ast.parse(path.read_text(), filename=str(path))
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Import):
+                    for a in node.names:
+                        if a.name == "json":
+                            bad.append(f"{rel}:{node.lineno} import json")
+                elif isinstance(node, ast.ImportFrom) and node.module == "json":
+                    bad.append(f"{rel}:{node.lineno} from json import ...")
+    assert not bad, (
+        "stdlib json bypassing the jsonc seam under rules/ or "
+        "bridges/ (use `from .. import jsonc as json`):\n  "
+        + "\n  ".join(bad)
+    )
+
+
 def test_every_declared_family_renders_and_lints():
     from test_prometheus_lint import _lint
 
@@ -466,6 +551,11 @@ FETCH_SITE_ALLOWLIST = {
         "set_row", "free_rows", "fan_of", "sync",
     },
     "ops/hash_index.py": {"add_rows"},
+    "ops/retained.py": {
+        # warmup ladder blocks by design (attach-window, never serve);
+        # read_finish funnels its wait through FetchTicket.wait
+        "_warmup",
+    },
     "ops/table.py": {"add_bulk", "_add_bulk_native", "drain_dirty"},
     "ops/transfer.py": {
         # THE designated fetch site: every finish half funnels its
