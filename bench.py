@@ -2075,7 +2075,8 @@ def _headline_metrics(details, prefix=""):
     return out
 
 
-def bench_compare(details, prev_path="BENCH_DETAILS.json", threshold=0.10):
+def bench_compare(details, prev_path="BENCH_DETAILS.json", threshold=0.10,
+                  min_compared=0):
     """Diff this run's headline metrics against the previous round's
     BENCH_DETAILS.json (still on disk at this point — the current run
     writes it only after this stage). Any >threshold unexplained drop
@@ -2085,7 +2086,13 @@ def bench_compare(details, prev_path="BENCH_DETAILS.json", threshold=0.10):
     committed BENCH_EXPECTED.json ({"metric.path": "reason", ...}) —
     the file form puts the explanation in the repo next to the
     artifact it excuses. EMQX_BENCH_STRICT=1 additionally fails the
-    process."""
+    process.
+
+    `min_compared` guards against a VACUOUS pass: MULTICHIP_r11
+    reported status ok with compared: 0 because the previous round's
+    blob carried none of this round's metric keys — an 8x regression
+    would have sailed through. When fewer than `min_compared` metrics
+    intersect, status is VACUOUS (with its own banner), never ok."""
     result = {"prev": prev_path, "threshold_pct": threshold * 100}
     try:
         with open(prev_path) as f:
@@ -2151,15 +2158,30 @@ def bench_compare(details, prev_path="BENCH_DETAILS.json", threshold=0.10):
             explained.append(rec)
         else:
             regressions.append(rec)
+    compared = len(set(cur_m) & set(prev_m))
+    if regressions:
+        status = "REGRESSION"
+    elif compared < min_compared:
+        status = "VACUOUS"
+    else:
+        status = "ok"
     result.update(
         {
-            "compared": len(set(cur_m) & set(prev_m)),
+            "compared": compared,
             "regressions": regressions,
             "explained": explained,
-            "status": "REGRESSION" if regressions else "ok",
+            "status": status,
         }
     )
     details["bench_compare"] = result
+    if status == "VACUOUS":
+        log("=" * 72)
+        log(
+            "BENCH COMPARE: VACUOUS — only %d of the required %d metrics "
+            "overlap with %s; nothing was actually gated"
+            % (compared, min_compared, prev_path)
+        )
+        log("=" * 72)
     if regressions:
         log("=" * 72)
         log("BENCH COMPARE: UNEXPLAINED >%d%% REGRESSION vs previous round"

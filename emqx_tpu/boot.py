@@ -102,6 +102,10 @@ class Node:
         broker = ClusterBroker(
             shared_strategy=cfg.get("broker.shared_subscription_strategy"),
             mesh=mesh,
+            mesh_min_rows_per_shard=(
+                cfg.get("broker.perf.tpu_mesh_min_rows_per_shard")
+                if mesh is not None else 0
+            ),
         )
         broker.caps = MqttCaps(
             max_packet_size=cfg.get("mqtt.max_packet_size"),

@@ -328,6 +328,14 @@ class DispatchEngine:
                 tel.count("warmup_failures_total")
                 log.warning("AOT warmup failed: %r", e)
                 self._device_failure(e)
+        dt = router.device_table
+        if getattr(dt, "mesh", None) is not None:
+            # mesh serve state at readiness: shard count and whether
+            # the admission knob degraded to single-device (small
+            # table at warmup — the mesh kernels are then warmed on
+            # the upgrade resync, not here)
+            info["mesh_shards"] = dt.n_shards
+            info["mesh_degraded"] = bool(dt.degraded)
         tel.mark_serving()
         if self.gc_guard and not self.warmed:
             gc.collect()

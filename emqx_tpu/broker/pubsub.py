@@ -61,8 +61,15 @@ class Broker:
         hooks: Optional[Hooks] = None,
         mesh=None,
         fanout_cache_size: int = 4096,
+        mesh_min_rows_per_shard: int = 0,
     ):
-        self.router = Router(max_levels=max_levels, mesh=mesh)
+        # mesh_min_rows_per_shard: admission floor for sharded serving
+        # (broker.perf.tpu_mesh_min_rows_per_shard) — below it the mesh
+        # degrades to its first device; see ShardedDeviceTable
+        self.router = Router(
+            max_levels=max_levels, mesh=mesh,
+            mesh_min_rows_per_shard=mesh_min_rows_per_shard,
+        )
         self.shared = SharedSubs(strategy=shared_strategy)
         self.retainer = Retainer()
         self.hooks = hooks or Hooks()

@@ -257,6 +257,17 @@ def broker_schema() -> Struct:
                                     "tpu_aot_warm": Field(
                                         Bool(), default=True
                                     ),
+                                    # mesh admission floor: with
+                                    # parallel.enable, tables holding
+                                    # fewer rows per shard than this
+                                    # serve on the mesh's first device
+                                    # instead of paying N-chip launch+
+                                    # combine overhead (the EMQX core/
+                                    # replicant split, device-style);
+                                    # 0 always shards
+                                    "tpu_mesh_min_rows_per_shard": Field(
+                                        Int(min=0), default=65536
+                                    ),
                                     "tpu_gc_guard": Field(
                                         Bool(), default=True
                                     ),

@@ -408,6 +408,7 @@ class Router:
         use_hash_index: bool = True,
         mesh=None,
         telemetry=None,
+        mesh_min_rows_per_shard: int = 0,
     ) -> None:
         """With `mesh` (a jax.sharding.Mesh), the wildcard table lives
         SUB-SHARDED across the mesh and batched matching runs the
@@ -416,7 +417,10 @@ class Router:
         (parallel/sharded_match.py make_sharded_hash_kernel) — the
         broker's publish path on a pod; the dense partitioned kernel
         serves only residual (unclassed) rows, exactly as on one
-        chip."""
+        chip. `mesh_min_rows_per_shard` > 0 enables the admission
+        knob: while the table holds fewer rows per shard than this,
+        serving degrades to the mesh's first device (small tables
+        never amortize mesh launch+combine overhead)."""
         self.max_levels = max_levels
         # route-transition callbacks: fired when a (filter, dest) pair
         # first appears / finally disappears — the seam the cluster
@@ -485,6 +489,7 @@ class Router:
                 self.table, mesh, index=self.index,
                 telemetry=self.telemetry,
             )
+            self.device_table.min_rows_per_shard = mesh_min_rows_per_shard
         else:
             self.index = ClassIndex(max_levels) if use_hash_index else None
             self.device_table = DeviceTable(
@@ -1940,6 +1945,7 @@ class Router:
         b = 1
         cap = _next_pow2(max(1, max_batch))
         ix = self.index
+        mesh_warm = getattr(dt, "warmup_escalated", None)
         while b <= cap:
             enc = match_ops.encode_topics(
                 self.table.vocab, (), self.max_levels, pad_to=b
@@ -1951,8 +1957,19 @@ class Router:
                     dt.match_ids_finish(dt.match_ids_begin(enc, residual=True))
             else:
                 dt.match_ids_finish(dt.match_ids_begin(enc))
+            if mesh_warm is not None:
+                # mesh tables also pre-build the first escalation step
+                # (2x capacity) per batch shape: a serve-time overflow
+                # then re-dispatches warm instead of compiling cold
+                warmed += mesh_warm(enc)
             warmed += 1
             b *= 2
+        delta_warm = getattr(dt, "warmup_deltas", None)
+        if delta_warm is not None:
+            # pre-trace the mesh churn-sync scatters (row / slot /
+            # fused) so the first serve-time subscribe wave doesn't
+            # pay a compile either
+            warmed += delta_warm()
         tel = self.telemetry
         if tel.enabled and warmed:
             tel.count("aot_warmups_total", warmed)

@@ -85,6 +85,15 @@ LEG_SYNC = "sync"  # DeviceTable delta scatter / full upload
 # `fanout_plan_{hits,misses,stale}` / `fanout_device_plans_total` /
 # `fanout_host_fallback_total` counters, and the last resolve's
 # fan-to-plan compression as the `fanout_dedup_ratio` gauge.
+#
+# The mesh serve path (parallel/sharded_match.py) likewise: residual
+# wait + host filter of the device-side cross-shard reduction as
+# `emqx_xla_mesh_combine_seconds` (observe_family), the last fused
+# churn dispatch's row+slot batch as the `mesh_sync_batch_rows` gauge,
+# admission-knob flips to single-device serving as the
+# `mesh_degraded_single_device_total` counter (+ a 0/1 gauge), and
+# per-shard host->device upload skew as the labeled counter family
+# `mesh_shard_transfer_rows_total{shard=...}`.
 
 
 class StreamingHistogram:

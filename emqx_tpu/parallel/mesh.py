@@ -62,6 +62,16 @@ def make_mesh(
     return Mesh(arr, (DP_AXIS, SUB_AXIS))
 
 
+def primary_device(mesh: Mesh):
+    """The mesh's first device — where small tables serve when the
+    tpu_mesh_min_rows_per_shard admission knob degrades sharded
+    serving to a single chip (the mesh overhead exceeds the kernel
+    work it would spread; see ShardedDeviceTable.min_rows_per_shard).
+    The EMQX analog is the core/replicant role split: not every node
+    holds (or should hold) a table shard."""
+    return np.asarray(mesh.devices).reshape(-1)[0]
+
+
 def filter_sharding(mesh: Mesh) -> EncodedFilters:
     """Shardings for each EncodedFilters leaf (rows over 'sub')."""
     row = NamedSharding(mesh, P(SUB_AXIS))

@@ -31,6 +31,24 @@ try:  # jax >= 0.5 exports it at top level; 0.4.x under experimental
 except AttributeError:  # pragma: no cover - version-dependent import
     from jax.experimental.shard_map import shard_map as _shard_map
 
+
+def _shard_map_unchecked(f, *, mesh, in_specs, out_specs):
+    """shard_map with the replication check disabled: the combine
+    kernels' all_gather -> nonzero recompaction IS replicated over
+    'sub' (every member computes from the identical gathered vector),
+    but the static rep-inference can't see through the fixed-size
+    nonzero. The kwarg spelling differs across jax versions."""
+    try:
+        return _shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=False,
+        )
+    except TypeError:  # pragma: no cover - jax >= 0.7 spelling
+        return _shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+
 from ..ops.match import EncodedTopics, _match_block, _pack_bits
 from ..ops.table import EncodedFilters
 from .mesh import DP_AXIS, SUB_AXIS, filter_sharding, topic_sharding
@@ -124,15 +142,38 @@ def make_sharded_kernels(mesh: Mesh):
     return match_counts, match_packed, apply_delta
 
 
+def _combine_pairs(a, b, valid_key, mh):
+    """Device-side cross-shard reduction: gather every shard's
+    compacted [mh] buffers over 'sub' (tiled — one [n_sub*mh] vector,
+    replicated across the axis by the collective) and recompact the
+    valid entries into ONE [mh] result. This is the combine that used
+    to run on host: the finish leg now fetches N-independent bytes and
+    merges nothing. Safe under the same escalation contract — if the
+    psum'd total fits mh then every per-shard count fit mh too, so the
+    per-shard compaction upstream dropped nothing."""
+    a_all = jax.lax.all_gather(a, SUB_AXIS, tiled=True)
+    b_all = jax.lax.all_gather(b, SUB_AXIS, tiled=True)
+    pos = jnp.nonzero(valid_key(a_all), size=mh, fill_value=-1)[0]
+    pv = pos >= 0
+    ps = jnp.maximum(pos, 0)
+    ca = jnp.where(pv, a_all[ps], -1).astype(jnp.int32)
+    cb = jnp.where(pv, b_all[ps], -1).astype(jnp.int32)
+    return ca, cb
+
+
 def make_match_ids_kernel(mesh: Mesh, max_hits_per_block: int):
-    """Sharded compaction kernel: every (dp, sub) block matches its
-    LOCAL [B/dp, N/sub] tile and compacts its hits to fixed-size
-    (topic, row) id buffers with GLOBAL indices (axis_index offsets) —
-    the device→host transfer stays proportional to matches per block,
-    the multi-chip version of ops.match.match_ids. Returns
-    (ti [dp, sub*mh], ri [dp, sub*mh], totals [dp, sub]); slots are -1
-    beyond each block's true count, and a block whose total exceeds
-    max_hits_per_block overflowed (caller escalates)."""
+    """Sharded compaction kernel with DEVICE-SIDE combine: every
+    (dp, sub) block matches its LOCAL [B/dp, N/sub] tile, compacts its
+    hits to fixed-size (topic, row) id buffers with GLOBAL indices
+    (axis_index offsets), then the shards reduce over 'sub' on-device
+    (all_gather + recompaction, totals via psum) so ONE dispatch
+    returns ONE combined buffer whose transfer size is independent of
+    the shard count — the multi-chip version of ops.match.match_ids
+    without the per-shard host merge that inverted the scaling curve
+    (PERF_NOTES.md r15). Returns (ti [dp, mh], ri [dp, mh],
+    totals [dp, 1]); slots are -1 beyond each dp block's true count,
+    and a block whose total exceeds max_hits_per_block overflowed
+    (caller escalates)."""
 
     f_specs = EncodedFilters(
         P(SUB_AXIS, None), P(SUB_AXIS), P(SUB_AXIS), P(SUB_AXIS), P(SUB_AXIS)
@@ -150,11 +191,13 @@ def make_match_ids_kernel(mesh: Mesh, max_hits_per_block: int):
         valid = idx >= 0
         ti = jnp.where(valid, idx // n_loc + dp_i * b_loc, -1).astype(jnp.int32)
         ri = jnp.where(valid, idx % n_loc + sub_i * n_loc, -1).astype(jnp.int32)
-        return ti[None, :], ri[None, :], cnt.reshape(1, 1)
+        cti, cri = _combine_pairs(ti, ri, lambda t: t >= 0, mh)
+        total = jax.lax.psum(cnt, SUB_AXIS)
+        return cti[None, :], cri[None, :], total.reshape(1, 1)
 
     @jax.jit
     def match_ids(filters: EncodedFilters, topics: EncodedTopics):
-        return _shard_map(
+        return _shard_map_unchecked(
             _local,
             mesh=mesh,
             in_specs=(
@@ -163,9 +206,9 @@ def make_match_ids_kernel(mesh: Mesh, max_hits_per_block: int):
                 f_specs.root_wild, f_specs.active,
             ),
             out_specs=(
-                P(DP_AXIS, SUB_AXIS),
-                P(DP_AXIS, SUB_AXIS),
-                P(DP_AXIS, SUB_AXIS),
+                P(DP_AXIS, None),
+                P(DP_AXIS, None),
+                P(DP_AXIS, None),
             ),
         )(
             topics.ids, topics.lens, topics.dollar,
@@ -191,9 +234,12 @@ def make_sharded_hash_kernel(
     partitions, exactly the HBM-capacity reason to go multi-chip.
 
     Returns kernel(meta, slots, topics) ->
-    (ti [dp, sub*mh], bi [dp, sub*mh], totals [dp, sub], amb [1,1]):
-    per-block flagged-pair counts for escalation, per-shard ambiguity
-    summed over the mesh (see ops.hash_index.match_ids_hash).
+    (ti [dp, mh], bi [dp, mh], totals [dp, 1], amb [1,1]): the
+    candidates are combined ON-DEVICE over 'sub' (all_gather +
+    recompaction, same reduction as make_match_ids_kernel) so the
+    fetch is one shard-count-independent buffer; totals are the
+    psum'd flagged-pair counts for escalation, amb the mesh-wide
+    ambiguity (see ops.hash_index.match_ids_hash).
 
     `n_buckets` is the LOGICAL global bucket count (pow2 — the host
     index's n_buckets). It must be passed whenever the per-shard slice
@@ -321,21 +367,26 @@ def make_sharded_hash_kernel(
             ),
             DP_AXIS,
         )
+        # device-side combine over 'sub': valid candidates <= flagged
+        # pairs, so the psum'd flagged total remains a sound overflow
+        # trigger for the combined buffer
+        cti, cbi = _combine_pairs(ti, bi, lambda t: t >= 0, mh)
+        total = jax.lax.psum(total, SUB_AXIS)
         return (
-            ti[None, :], bi[None, :], total.reshape(1, 1),
+            cti[None, :], cbi[None, :], total.reshape(1, 1),
             amb.reshape(1, 1),
         )
 
     @jax.jit
     def kernel(meta, slots, topics):
-        return _shard_map(
+        return _shard_map_unchecked(
             _local,
             mesh=mesh,
             in_specs=meta_specs + slot_specs + t_specs,
             out_specs=(
-                P(DP_AXIS, SUB_AXIS),
-                P(DP_AXIS, SUB_AXIS),
-                P(DP_AXIS, SUB_AXIS),
+                P(DP_AXIS, None),
+                P(DP_AXIS, None),
+                P(DP_AXIS, None),
                 P(None, None),
             ),
         )(
@@ -401,6 +452,93 @@ def make_slot_delta_kernel(mesh: Mesh):
     return apply
 
 
+def make_mesh_sync_kernel(mesh: Mesh):
+    """FUSED churn sync: apply a filter-row delta batch AND a
+    cuckoo-slot delta batch in ONE shard_map dispatch with every
+    device buffer donated. The steady-state churn loop used to pay two
+    launches per sync (row scatter, then slot scatter) — chained
+    dispatches do not pipeline through the device relay
+    (PERF_NOTES.md), so at mesh scale the second launch was pure
+    serial overhead. Delta streams are replicated (tiny — syncer
+    batches); each shard applies the rows/slots it owns via the same
+    masked mode='drop' scatters as the split kernels."""
+    from ..ops.hash_index import BUCKET_W
+
+    def _local(dev, sfp, sbkt, probe,
+               rows, words, plen, hh, rw, act,
+               sidx, sfpv, sbktv, spwv):
+        local_n = dev.words.shape[0]
+        n_loc = sfp.shape[0]
+        nb_loc = probe.shape[0]
+        sub_i = jax.lax.axis_index(SUB_AXIS).astype(jnp.int32)
+        r_off = sub_i * local_n
+        s_off = sub_i * n_loc
+        b_off = sub_i * nb_loc
+
+        def rstep(d, xs):
+            r, w, p, h, rw_, a = xs
+            local = r - r_off
+            oob = (local < 0) | (local >= local_n)
+            local = jnp.where(oob, local_n, local)
+            return (
+                EncodedFilters(
+                    d.words.at[local].set(w, mode="drop"),
+                    d.prefix_len.at[local].set(p, mode="drop"),
+                    d.has_hash.at[local].set(h, mode="drop"),
+                    d.root_wild.at[local].set(rw_, mode="drop"),
+                    d.active.at[local].set(a, mode="drop"),
+                ),
+                None,
+            )
+
+        dev, _ = jax.lax.scan(rstep, dev, (rows, words, plen, hh, rw, act))
+
+        def sstep(carry, xs):
+            cfp, cbkt, cpw = carry
+            i, f, b, pw = xs
+            ls = i - s_off
+            ls = jnp.where((ls < 0) | (ls >= n_loc), n_loc, ls)
+            lb = i // BUCKET_W - b_off
+            lb = jnp.where((lb < 0) | (lb >= nb_loc), nb_loc, lb)
+            return (
+                (
+                    cfp.at[ls].set(f, mode="drop"),
+                    cbkt.at[ls].set(b, mode="drop"),
+                    cpw.at[lb].set(pw, mode="drop"),
+                ),
+                None,
+            )
+
+        (sfp, sbkt, probe), _ = jax.lax.scan(
+            sstep, (sfp, sbkt, probe), (sidx, sfpv, sbktv, spwv)
+        )
+        return dev, sfp, sbkt, probe
+
+    dev_specs = EncodedFilters(
+        P(SUB_AXIS, None), P(SUB_AXIS), P(SUB_AXIS), P(SUB_AXIS), P(SUB_AXIS)
+    )
+    slot_specs = (P(SUB_AXIS), P(SUB_AXIS), P(SUB_AXIS))
+    row_dspecs = (
+        P(None, None), P(None, None, None), P(None, None),
+        P(None, None), P(None, None), P(None, None),
+    )
+    slot_dspecs = (P(None, None),) * 4
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+    def apply(dev, sfp, sbkt, probe,
+              rows, words, plen, hh, rw, act,
+              sidx, sfpv, sbktv, spwv):
+        return _shard_map(
+            _local,
+            mesh=mesh,
+            in_specs=(dev_specs,) + slot_specs + row_dspecs + slot_dspecs,
+            out_specs=(dev_specs,) + slot_specs,
+        )(dev, sfp, sbkt, probe, rows, words, plen, hh, rw, act,
+          sidx, sfpv, sbktv, spwv)
+
+    return apply
+
+
 class ShardedDeviceTable:
     """Mesh-resident mirror of a FilterTable: rows sub-sharded across
     the mesh, topics dp-sharded, batched delta sync through the
@@ -443,12 +581,30 @@ class ShardedDeviceTable:
         self._match_ids_cache: dict = {}
         self._hash_cache: dict = {}
         self.default_mh = max_hits_per_block
+        # sticky escalation floor: the combined result buffer budgets
+        # the SUM of per-shard hits, so once a batch overflows, every
+        # later batch of the same workload would too — re-dispatching
+        # each time is exactly the N-x overhead this path removes. The
+        # floor persists for the life of the layout.
+        self._mh_floor = 0
         self._dev_meta = None
         self._dev_slots = None
         self._dev_residual = None
         self._apply_slot_delta = (
             make_slot_delta_kernel(mesh) if index is not None else None
         )
+        self._mesh_sync = (
+            make_mesh_sync_kernel(mesh) if index is not None else None
+        )
+        # degrade-to-single-device admission (tpu_mesh_min_rows_per_shard
+        # knob): below this many table rows per shard the mesh
+        # launch+combine overhead exceeds the kernel work it spreads,
+        # so serving falls back to a plain DeviceTable on the mesh's
+        # first chip. 0 (the direct-construction default) never
+        # degrades.
+        self.min_rows_per_shard = 0
+        self.degraded = False
+        self._single = None
         self.fanout = None
         # chaos fault seam (emqx_tpu/chaos/faults.py) — same contract
         # as the single-device DeviceTable: one attribute read per sync
@@ -545,6 +701,9 @@ class ShardedDeviceTable:
         self._apply_slot_delta = (
             make_slot_delta_kernel(mesh) if self.index is not None else None
         )
+        self._mesh_sync = (
+            make_mesh_sync_kernel(mesh) if self.index is not None else None
+        )
         self._dev = None
         self._dev_meta = None
         self._dev_slots = None
@@ -557,6 +716,45 @@ class ShardedDeviceTable:
         if tel.enabled:
             tel.set_gauge("mesh_shards", self.mesh.shape[SUB_AXIS])
             tel.set_gauge("shards_lost", len(self.lost_shards))
+
+    # --- degrade-to-single-device admission (small tables) ----------------
+
+    def _decide_mode(self) -> None:
+        """Flip between mesh serving and the single-device fallback
+        when the per-shard row count crosses `min_rows_per_shard`.
+        Capacity is grow-only, so a workload flips at most once each
+        way; each flip forces a full re-upload on the new path (the
+        other path's device state is dropped, not kept coherent)."""
+        thr = self.min_rows_per_shard
+        want = bool(thr) and (
+            self.table.capacity // max(1, self.n_shards) < thr
+        )
+        if want == self.degraded:
+            return
+        tel = self.telemetry
+        if want:
+            from ..models.router import DeviceTable
+
+            single = DeviceTable(
+                self.table,
+                device=self._mesh_mod.primary_device(self.mesh),
+                index=self.index,
+                telemetry=self.telemetry,
+            )
+            single.transfer_chunk_hits = self.transfer_chunk_hits
+            self._single = single
+            if tel.enabled:
+                tel.count("mesh_degraded_single_device_total")
+        else:
+            self._single = None
+            self._dev = None
+            self._dev_meta = None
+            self._dev_slots = None
+            self._dev_residual = None
+            self._synced_capacity = 0
+        self.degraded = want
+        if tel.enabled:
+            tel.set_gauge("mesh_degraded_single_device", int(want))
 
     def _match_kernel(self, mh: int):
         k = self._match_ids_cache.get(mh)
@@ -589,7 +787,27 @@ class ShardedDeviceTable:
         if pad:
             width = ((0, pad),) + ((0, 0),) * (a.ndim - 1)
             a = np.pad(a, width, constant_values=pad_value)
+        self._count_shard_rows(
+            np.full(self.mesh.shape[SUB_AXIS],
+                    a.shape[0] // self.mesh.shape[SUB_AXIS], np.int64)
+        )
         return jax.device_put(a, NamedSharding(self.mesh, P(SUB_AXIS)))
+
+    def _count_shard_rows(self, per_shard) -> None:
+        """Per-shard host->device transfer accounting
+        (emqx_xla_mesh_shard_transfer_rows_total{shard=...}): the
+        combined fetch is shard-count-independent, so the upload side
+        is where per-shard skew shows up."""
+        tel = self.telemetry
+        if not tel.enabled:
+            return
+        for s, n in enumerate(per_shard):
+            if n:
+                tel.count_labeled(
+                    "mesh_shard_transfer_rows_total",
+                    {"shard": str(s)},
+                    int(n),
+                )
 
     def _sync_index(self) -> None:
         import numpy as np
@@ -636,6 +854,9 @@ class ShardedDeviceTable:
             idx = np.full(n_b * k, dirty[-1], np.int32)
             idx[:total] = dirty
             shape2 = (n_b, k)
+            self.telemetry.record_shape(
+                "mesh_slot_delta", (n_b, len(ix.slots.fp))
+            )
             out = self._apply_slot_delta(
                 self._dev_slots.fp,
                 self._dev_slots.bucket,
@@ -664,6 +885,13 @@ class ShardedDeviceTable:
         fi = self.fault_injector
         if fi is not None:
             fi.check("sync")
+        self._decide_mode()
+        if self.degraded:
+            # single-device fallback owns its own sync telemetry; the
+            # fault check stays at this wrapper (the injector reasons
+            # about mesh shards, not the fallback device)
+            self._single.transfer_chunk_hits = self.transfer_chunk_hits
+            return self._single.sync()
         tel = self.telemetry
         t0 = tel.clock()
         pending = len(self.table.dirty)
@@ -701,9 +929,68 @@ class ShardedDeviceTable:
         idx = np.full(n_b * k, arr[-1], np.int32)
         idx[:total] = arr
         shape2 = (n_b, k)
-        self.telemetry.record_shape(
+        tel = self.telemetry
+        if tel.enabled:
+            n_sub = self.mesh.shape[SUB_AXIS]
+            rs = self._mesh_mod.shard_rows(t.capacity, self.mesh)
+            self._count_shard_rows(
+                np.bincount(
+                    np.clip(arr // rs, 0, n_sub - 1), minlength=n_sub
+                )
+            )
+        ix = self.index
+        if (
+            ix is not None
+            and ix.dirty_slots
+            and not ix.rebuilt
+            and self._dev_slots is not None
+            and self._mesh_sync is not None
+        ):
+            # steady-state churn touches rows AND cuckoo slots: apply
+            # both delta streams in ONE fused dispatch (the split
+            # kernels pay two serial launches per sync)
+            from ..ops.hash_index import BUCKET_W, SlotArrays
+
+            sdirty = np.unique(np.asarray(ix.dirty_slots, np.int32))
+            ix.dirty_slots.clear()
+            s_total = len(sdirty)
+            s_nb = 1 << max(0, -(-s_total // k) - 1).bit_length()
+            sidx = np.full(s_nb * k, sdirty[-1], np.int32)
+            sidx[:s_total] = sdirty
+            s_shape2 = (s_nb, k)
+            tel.record_shape(
+                "mesh_sync",
+                (n_b, s_nb, t.capacity, t.max_levels, len(ix.slots.fp)),
+            )
+            if tel.enabled:
+                tel.set_gauge("mesh_sync_batch_rows", total + s_total)
+            out = self._mesh_sync(
+                self._dev,
+                self._dev_slots.fp,
+                self._dev_slots.bucket,
+                self._dev_slots.probe,
+                jnp.asarray(idx.reshape(shape2)),
+                jnp.asarray(t.words[idx].reshape(shape2 + (t.max_levels,))),
+                jnp.asarray(t.prefix_len[idx].reshape(shape2)),
+                jnp.asarray(t.has_hash[idx].reshape(shape2)),
+                jnp.asarray(t.root_wild[idx].reshape(shape2)),
+                jnp.asarray(t.active[idx].reshape(shape2)),
+                jnp.asarray(sidx.reshape(s_shape2)),
+                jnp.asarray(ix.slots.fp[sidx].reshape(s_shape2)),
+                jnp.asarray(ix.slots.bucket[sidx].reshape(s_shape2)),
+                jnp.asarray(
+                    ix.slots.probe[sidx // BUCKET_W].reshape(s_shape2)
+                ),
+            )
+            self._dev = out[0]
+            self._dev_slots = SlotArrays(*out[1:])
+            self._sync_index()  # meta/residual legs only — slots done
+            return total, False
+        tel.record_shape(
             "apply_delta", (n_b, t.capacity, t.max_levels)
         )
+        if tel.enabled:
+            tel.set_gauge("mesh_sync_batch_rows", total)
         self._dev = self._apply_delta(
             self._dev,
             jnp.asarray(idx.reshape(shape2)),
@@ -721,12 +1008,16 @@ class ShardedDeviceTable:
         """Per-block hit capacity, bounded by the transfer chunk when
         one is set (ops/transfer.chunk_hits semantics — oversize
         results escalate through the exact-size retry, so the bound
-        costs a counted re-dispatch, never correctness)."""
+        costs a counted re-dispatch, never correctness), then raised
+        to the sticky escalation floor: the combined buffer budgets
+        the dp-block TOTAL across shards, so a workload that
+        overflowed once would overflow every batch — the floor trades
+        one-time extra transfer width for never re-dispatching."""
         mh = self.default_mh
         cap = self.transfer_chunk_hits
         if cap is not None and mh > cap >= 1024:
             mh = 1 << (cap.bit_length() - 1)
-        return mh
+        return max(mh, self._mh_floor)
 
     def match_ids_begin(self, enc: EncodedTopics, residual: bool = False):
         """Launch the sharded dense compaction kernel WITHOUT forcing
@@ -736,6 +1027,8 @@ class ShardedDeviceTable:
         pipelined publish path overlaps this batch's mesh execution +
         device->host transfer with the next batch's host-side encode.
         Returns an opaque handle for match_ids_finish."""
+        if self.degraded:
+            return ("1dev",) + self._single.match_ids_begin(enc, residual)
         assert self._dev is not None, "sync() before matching"
         dev = self._dev
         if residual:
@@ -757,21 +1050,32 @@ class ShardedDeviceTable:
 
     def match_ids_finish(self, pending):
         """Force the transfers for a begun dense match, escalating
-        per-block capacity on overflow. Returns (ti 1d, ri 1d) host
-        arrays of equal length (valid pairs only)."""
+        per-block capacity on overflow (sticky: the new capacity
+        becomes the floor for later begins). Returns (ti 1d, ri 1d)
+        host arrays of equal length (valid pairs only)."""
         import numpy as np
 
+        if pending[0] == "1dev":
+            return self._single.match_ids_finish(pending[1:])
         dev, t_dev, mh, ticket = pending
+        tel = self.telemetry
+        t0 = tel.clock()
         ti, ri, totals = ticket.wait()
         totals = np.asarray(totals)
         while int(totals.max(initial=0)) > mh:
-            self.telemetry.count("escalations_total")
+            tel.count("escalations_total")
             mh = max(mh * 2, 1 << int(totals.max()).bit_length())
+            tel.record_shape(
+                "mesh_match_ids", (int(t_dev.ids.shape[0]), mh)
+            )
+            self._mh_floor = max(self._mh_floor, mh)
             ti, ri, totals = self._match_kernel(mh)(dev, t_dev)
             totals = np.asarray(totals)
         ti = np.asarray(ti).reshape(-1)
         ri = np.asarray(ri).reshape(-1)
         keep = ti >= 0
+        if tel.enabled:
+            tel.observe_family("mesh_combine_seconds", tel.clock() - t0)
         return ti[keep], ri[keep]
 
     def match_ids(self, enc: EncodedTopics, residual: bool = False):
@@ -788,6 +1092,8 @@ class ShardedDeviceTable:
         host fetch AND begin the result transfer (ticket last, same
         contract as DeviceTable.match_hash_begin). Returns an opaque
         handle for match_hash_finish."""
+        if self.degraded:
+            return ("1dev",) + self._single.match_hash_begin(enc)
         assert self._dev_slots is not None, "sync() before matching"
         t_dev = self._mesh_mod.put_topics(enc, self.mesh)
         mh = self._block_mh()
@@ -806,16 +1112,24 @@ class ShardedDeviceTable:
 
     def match_hash_finish(self, pending):
         """Force the transfers for a begun hash match, escalating
-        per-block capacity on overflow. Same result contract as
-        match_hash."""
+        per-block capacity on overflow (sticky floor, same policy as
+        match_ids_finish). Same result contract as match_hash."""
         import numpy as np
 
+        if pending[0] == "1dev":
+            return self._single.match_hash_finish(pending[1:])
         t_dev, mh, ticket = pending
+        tel = self.telemetry
+        t0 = tel.clock()
         ti, bi, totals, amb = ticket.wait()
         totals = np.asarray(totals)
         while int(totals.max(initial=0)) > mh:
-            self.telemetry.count("hash_overflow_retries_total")
+            tel.count("hash_overflow_retries_total")
             mh = max(mh * 2, 1 << int(totals.max()).bit_length())
+            tel.record_shape(
+                "mesh_match_ids_hash", (int(t_dev.ids.shape[0]), mh)
+            )
+            self._mh_floor = max(self._mh_floor, mh)
             ti, bi, totals, amb = self._hash_kernel(mh)(
                 self._dev_meta, self._dev_slots, t_dev
             )
@@ -823,6 +1137,8 @@ class ShardedDeviceTable:
         ti = np.asarray(ti).reshape(-1)
         bi = np.asarray(bi).reshape(-1)
         keep = ti >= 0
+        if tel.enabled:
+            tel.observe_family("mesh_combine_seconds", tel.clock() - t0)
         return ti[keep], bi[keep], int(np.asarray(amb).reshape(-1)[0])
 
     def match_hash(self, enc: EncodedTopics):
@@ -833,3 +1149,92 @@ class ShardedDeviceTable:
         ambiguity count (amb > 0 -> caller re-matches on a host path,
         see ops.hash_index.match_ids_hash)."""
         return self.match_hash_finish(self.match_hash_begin(enc))
+
+    # --- mesh AOT warmup (recompiles_at_serve_total == 0 discipline) ------
+
+    def warmup_deltas(self) -> int:
+        """Pre-trace the churn sync kernels (row delta, slot delta,
+        fused row+slot) at their small pow2 batch shapes so the first
+        serve-time churn wave hits a warm compile cache — the mesh
+        counterpart of Router.warmup_shapes' match-kernel ladder.
+        Re-applies row/slot 0's CURRENT host truth, so every warm
+        dispatch is semantically a no-op. Requires a completed full
+        sync(); returns the number of kernels warmed."""
+        if self.degraded or self._dev is None:
+            return 0
+        import numpy as np
+
+        t = self.table
+        k = self.DELTA_BATCH
+        tel = self.telemetry
+        warmed = 0
+        for n_b in (1, 2):
+            shape2 = (n_b, k)
+            idx = np.zeros(n_b * k, np.int32)
+            row_args = (
+                jnp.asarray(idx.reshape(shape2)),
+                jnp.asarray(t.words[idx].reshape(shape2 + (t.max_levels,))),
+                jnp.asarray(t.prefix_len[idx].reshape(shape2)),
+                jnp.asarray(t.has_hash[idx].reshape(shape2)),
+                jnp.asarray(t.root_wild[idx].reshape(shape2)),
+                jnp.asarray(t.active[idx].reshape(shape2)),
+            )
+            tel.record_shape("apply_delta", (n_b, t.capacity, t.max_levels))
+            self._dev = self._apply_delta(self._dev, *row_args)
+            warmed += 1
+            ix = self.index
+            if ix is None or self._dev_slots is None:
+                continue
+            from ..ops.hash_index import BUCKET_W, SlotArrays
+
+            slot_args = (
+                jnp.asarray(idx.reshape(shape2)),
+                jnp.asarray(ix.slots.fp[idx].reshape(shape2)),
+                jnp.asarray(ix.slots.bucket[idx].reshape(shape2)),
+                jnp.asarray(ix.slots.probe[idx // BUCKET_W].reshape(shape2)),
+            )
+            tel.record_shape("mesh_slot_delta", (n_b, len(ix.slots.fp)))
+            out = self._apply_slot_delta(
+                self._dev_slots.fp, self._dev_slots.bucket,
+                self._dev_slots.probe, *slot_args,
+            )
+            self._dev_slots = SlotArrays(*out)
+            warmed += 1
+            if self._mesh_sync is None:
+                continue
+            tel.record_shape(
+                "mesh_sync",
+                (n_b, n_b, t.capacity, t.max_levels, len(ix.slots.fp)),
+            )
+            out = self._mesh_sync(
+                self._dev,
+                self._dev_slots.fp, self._dev_slots.bucket,
+                self._dev_slots.probe, *row_args, *slot_args,
+            )
+            self._dev = out[0]
+            self._dev_slots = SlotArrays(*out[1:])
+            warmed += 1
+        return warmed
+
+    def warmup_escalated(self, enc: EncodedTopics) -> int:
+        """Pre-build the first escalation step (2x the current block
+        capacity) for both match kernels at this batch shape: a
+        serve-time overflow then re-dispatches against a warm cache
+        and the shape key is already recorded, keeping
+        recompiles_at_serve_total at 0. Dispatch-only — results are
+        dropped unfetched (compilation happens at call time; no
+        blocking fetch on this path)."""
+        if self.degraded or self._dev is None:
+            return 0
+        t_dev = self._mesh_mod.put_topics(enc, self.mesh)
+        b = int(t_dev.ids.shape[0])
+        mh2 = self._block_mh() * 2
+        warmed = 0
+        self.telemetry.record_shape("mesh_match_ids", (b, mh2))
+        self._match_kernel(mh2)(self._dev, t_dev)
+        warmed += 1
+        if self._dev_slots is not None:
+            self.telemetry.record_shape("mesh_match_ids_hash", (b, mh2))
+            self._hash_kernel(mh2)(self._dev_meta, self._dev_slots, t_dev)
+            warmed += 1
+        return warmed

@@ -855,3 +855,67 @@ def test_retained_rule_where_and_json_families_lint():
     assert m and int(m.group(1)) == len(ret)
     # no serve-time retraces anywhere in the drive
     assert tel.counters.get("recompiles_at_serve_total", 0) == 0
+
+
+def test_mesh_scaling_families_lint():
+    """ISSUE-15 families: the device-side combine histogram, the fused
+    one-dispatch sync gauge, the small-table degrade counter, and the
+    per-shard transfer ledger must render on a real driven scrape — a
+    full sharded upload, churn riding the fused row+slot scatter, and a
+    degrade/upgrade flip on the admission knob — and pass the lint."""
+    import jax
+
+    from emqx_tpu.parallel import mesh as mesh_mod
+
+    mesh = mesh_mod.make_mesh(n_dp=1, n_sub=4, devices=jax.devices()[:4])
+    broker = Broker(mesh=mesh)
+    for i in range(32):
+        s, _ = broker.open_session(f"c{i}", clean_start=True)
+        s.outgoing_sink = lambda pkts: None
+        broker.subscribe(s, f"m/{i}/+/v/#", SubOpts(qos=0))
+    r = broker.router
+    tel = r.telemetry
+    topics = [f"m/{i}/a/v/w" for i in range(8)]
+    # full upload: every shard receives its row slice (labeled ledger),
+    # and the device-side combine times the cross-shard reduction
+    r.match_filters_batch(topics)
+
+    # native delete + re-add dirties rows AND hash slots without a
+    # rebuild, so the next sync rides the fused one-dispatch scatter
+    r.delete_route("m/3/+/v/#", "c3")
+    r.add_route("m/3/+/v/#", "c3")
+    r.match_filters_batch(topics)
+    assert tel.gauges.get("mesh_sync_batch_rows", 0) > 0
+
+    # admission-knob flip: degrade to single-device, serve, upgrade back
+    dt = r.device_table
+    dt.min_rows_per_shard = 1 << 30
+    r.match_filters_batch(topics)
+    assert dt.degraded
+    dt.min_rows_per_shard = 0
+    r.match_filters_batch(topics)
+    assert not dt.degraded
+
+    text = prometheus_text(broker, "n1@host")
+    types = _lint(text)
+    for fam, kind in (
+        ("emqx_xla_mesh_combine_seconds", "histogram"),
+        ("emqx_xla_mesh_sync_batch_rows", "gauge"),
+        ("emqx_xla_mesh_degraded_single_device_total", "counter"),
+        ("emqx_xla_mesh_degraded_single_device", "gauge"),
+        ("emqx_xla_mesh_shard_transfer_rows_total", "counter"),
+    ):
+        assert types.get(fam) == kind, f"{fam}: {types.get(fam)}"
+    # the transfer ledger carries per-shard attribution for every shard
+    for shard in range(4):
+        assert re.search(
+            r'emqx_xla_mesh_shard_transfer_rows_total\{node="n1@host",'
+            rf'shard="{shard}"\}} [1-9]',
+            text,
+            re.M,
+        ), f"shard {shard} missing from transfer ledger"
+    # exactly one degrade flip, and the mesh is back to full service
+    assert tel.counters["mesh_degraded_single_device_total"] == 1
+    assert re.search(
+        r'emqx_xla_mesh_degraded_single_device\{node="n1@host"\} 0', text
+    )

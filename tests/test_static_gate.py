@@ -570,6 +570,11 @@ FETCH_SITE_ALLOWLIST = {
         # for survivor-column selection) — no device value ever flows
         "_survivor_mesh",
     },
+    "parallel/mesh.py": {
+        # np.asarray over Device OBJECTS (layout metadata, not device
+        # values): mesh construction + the degrade-target picker
+        "make_mesh", "primary_device",
+    },
 }
 
 # begin halves + the engine's flush must not force ANY host value:
@@ -642,6 +647,40 @@ def test_no_blocking_host_fetch_outside_finish_sites():
         "blocking host fetch outside designated finish/fetch sites "
         "(re-serializes the transfer pipeline):\n  "
         + "\n  ".join(offenders)
+    )
+
+
+def test_begin_halves_start_their_transfer():
+    """Leg 7b (ISSUE 15): every match-kernel begin half — single-device
+    AND mesh — must START its device->host result copy
+    (ops/transfer.start_fetch) in the same function that launches the
+    kernel. A begin that launches without starting the fetch makes the
+    finish half pay the full transfer serially, re-inverting the
+    pipeline; the mesh path sat outside this discipline until r15,
+    which is how its host-side combine survived unnoticed."""
+    offenders = []
+    for rel in ("models/router.py", "parallel/sharded_match.py"):
+        tree = ast.parse((PKG / rel).read_text())
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.FunctionDef):
+                continue
+            # kernel-level begins only: match_filters_begin composes
+            # these and delegates the fetch start to them
+            if not re.fullmatch(r"match_(ids|hash)_begin", node.name):
+                continue
+            calls = set()
+            for n in ast.walk(node):
+                if isinstance(n, ast.Call):
+                    f = n.func
+                    calls.add(
+                        f.attr if isinstance(f, ast.Attribute)
+                        else getattr(f, "id", "")
+                    )
+            if "start_fetch" not in calls:
+                offenders.append(f"{rel}:{node.lineno} {node.name}()")
+    assert not offenders, (
+        "begin halves that never start their result transfer "
+        "(finish pays the copy serially):\n  " + "\n  ".join(offenders)
     )
 
 
