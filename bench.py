@@ -1978,6 +1978,133 @@ def bench_sentinel_overhead(details):
     }
 
 
+def bench_profiler_overhead(details):
+    """The SAME pipelined publish stream with the 100Hz sampling
+    profiler running vs stopped. The profiler installs no hooks — its
+    whole serve-path cost is the sampler thread waking every 10ms to
+    call sys._current_frames() (a GIL pause proportional to live
+    threads) — so the paired-toggle measures exactly the contention
+    the continuous profiler adds to a loaded event loop. Same
+    order-alternating paired-chunk discipline as
+    bench_sentinel_overhead; the <=2% budget is asserted in-bench
+    (ISSUE 17: the microscope must never become the load)."""
+    import asyncio
+    import threading
+
+    from emqx_tpu.broker.message import Message
+    from emqx_tpu.broker.packet import SubOpts
+    from emqx_tpu.broker.pubsub import Broker
+    from emqx_tpu.obs.profiler import SamplingProfiler
+
+    # windows must STRADDLE sampler wakes: at 100Hz the sampler fires
+    # every 10ms, so each timed side runs REPS back-to-back chunks
+    # (~50ms of pipelined publishing ≈ 5 wakes) — a chunk-sized window
+    # would land between wakes and measure an idle thread
+    NS, PAIRS, CHUNK, REPS, HZ = 256, 40, 8, 100, 100.0
+
+    b = Broker()
+    b._fanout_min_fan = 0
+    b.sentinel = None  # isolate the sampler: no span probes in either arm
+    for i in range(NS):
+        s, _ = b.open_session(f"po{i}", True)
+        s.outgoing_sink = lambda pkts: None
+        b.subscribe(s, "ov/prof/#", SubOpts(qos=0))
+
+    # constructed on the main thread == the thread asyncio.run() will
+    # drive the loop on, so the default target watches the loop
+    prof = SamplingProfiler(hz=HZ, target_thread_id=threading.get_ident())
+    ts_on, ts_off = [], []
+
+    async def run():
+        eng = b.enable_dispatch_engine(queue_depth=CHUNK, deadline_ms=0.2)
+
+        async def chunk():
+            await asyncio.gather(
+                *[
+                    eng.publish(
+                        Message(topic=f"ov/prof/{j}", payload=b"x" * 64)
+                    )
+                    for j in range(CHUNK)
+                ]
+            )
+
+        async def window():
+            t0 = time.time()
+            for _ in range(REPS):
+                await chunk()
+            return time.time() - t0
+
+        await window()  # compile + warm caches
+        with gc_off():
+            for i in range(PAIRS):
+                order = (
+                    ((True, ts_on), (False, ts_off))
+                    if i % 2 == 0
+                    else ((False, ts_off), (True, ts_on))
+                )
+                for on, sink in order:
+                    # toggled OUTSIDE the timed window: spawn/join cost
+                    # is a start/stop event, not serve-path overhead
+                    if on:
+                        prof.start()
+                    else:
+                        prof.stop()
+                    sink.append(await window())
+        prof.stop()
+        await eng.stop()
+
+    asyncio.run(run())
+    on = float(np.median(ts_on))
+    off = float(np.median(ts_off))
+    # same position-bias cancellation as bench_sentinel_overhead: the
+    # order alternates every pair, so trimmed-mean the even/odd delta
+    # halves separately and average — the first-chunk-of-pair term
+    # enters with opposite sign and cancels
+    deltas = np.asarray(ts_on) - np.asarray(ts_off)
+
+    def _trimmed(xs):
+        xs = np.sort(xs)
+        k = len(xs) // 5
+        return float(np.mean(xs[k: len(xs) - k]))
+
+    pct = (
+        (_trimmed(deltas[0::2]) + _trimmed(deltas[1::2])) / 2.0 / off * 100
+        if off
+        else 0.0
+    )
+    st = prof.status()
+    per_pub = CHUNK * REPS
+    log(
+        f"profiler overhead: running {on / per_pub * 1e6:.1f} us/publish "
+        f"vs stopped {off / per_pub * 1e6:.1f} us/publish -> {pct:+.2f}% "
+        f"at {HZ:.0f}Hz (samples {st['samples_total']}, cpu "
+        f"{st['cpu_samples_total']}, unique stacks {st['unique_stacks']})"
+    )
+    details["profiler_overhead"] = {
+        "running_us_per_publish": round(on / per_pub * 1e6, 2),
+        "stopped_us_per_publish": round(off / per_pub * 1e6, 2),
+        "fanout": NS,
+        "hz": HZ,
+        "samples_total": st["samples_total"],
+        "cpu_samples_total": st["cpu_samples_total"],
+        "unique_stacks": st["unique_stacks"],
+        "overhead_pct": round(pct, 2),
+        "budget_pct": 2.0,
+        "within_budget": bool(pct < 2.0),
+    }
+    # a zero-sample run would make the pct a vacuous pass (the thread
+    # existed but never fired) — the same trap bench_compare guards
+    # with min_compared
+    assert st["samples_total"] > 0, (
+        "profiler captured zero samples during the on-windows — "
+        "the overhead measurement is vacuous"
+    )
+    assert pct < 2.0, (
+        f"sampling profiler overhead {pct:+.2f}% blew the 2% budget — "
+        f"the microscope became the load"
+    )
+
+
 # --------------------------------------------------------------------------
 # provenance + round-over-round compare (the round-5 judge's "fanout
 # regressed 29% without a note / native baseline halved" close-out)
@@ -2713,6 +2840,168 @@ def bench_soak(details, out_path="SOAK_r13.json"):
     return row
 
 
+def bench_profile(details, out_path="PROFILE_r17.json"):
+    """Delivery-path microscope artifact stage (ISSUE 17): drive the
+    million-session Zipf storm through the standalone chaos engine
+    with DENSE span sampling (1/8 instead of the production 1/1024)
+    and the 100Hz sampling profiler armed, then commit PROFILE_r17:
+    the queue-stage p99 attributed to the six named sub-stages (whose
+    sums must land within 10% of the queue+deliver wall), the top-10
+    stacks per sub-stage, ring occupancy + loop lag over the storm,
+    the paired-toggle profiler overhead figure, and the two zeros the
+    round is gated on — recompiles_at_serve_total and silent
+    divergences on the accompanying audit sweep.
+    EMQX_BENCH_SCALE=small shrinks the fleet and window for CI."""
+    import asyncio
+
+    from emqx_tpu.chaos.engine import ChaosEngine
+    from emqx_tpu.obs.sentinel import DECOMP_TOLERANCE, DELIVERY_STAGES
+
+    sessions = 1_000_000 // SHRINK
+    storm_s = 20.0 if not SMALL else 2.0
+
+    async def run():
+        eng = await ChaosEngine.standalone(
+            sessions=sessions,
+            sample_n=8,
+            progress=log,
+        )
+        try:
+            await eng.setup()
+            prof = eng.obs.profiler
+            ll = eng.obs.loop_lag
+            ll.start()
+            prof.arm_for(storm_s * 4 + 60.0)
+            t0 = time.monotonic()
+            eng.storm_start()
+            await asyncio.sleep(storm_s)
+            await eng.storm_stop()
+            elapsed = time.monotonic() - t0
+            prof.stop()
+            ll.stop()
+            # the accompanying audit leg: every sampled span already
+            # carried a deferred shadow-oracle audit; sweep the
+            # remainder so "0 silent divergences" covers the storm
+            audit = await eng.audit_sweep()
+            st = eng.sentinel
+            snap = st.stage_snapshot()
+            snap.pop("exemplars", None)
+            return {
+                "n_sessions": len(eng.broker.sessions),
+                "published": eng.published,
+                "chunk_p50_ms": round(
+                    eng.chunk_hist.percentile(50) * 1e3, 2
+                ),
+                "chunk_p99_ms": round(
+                    eng.chunk_hist.percentile(99) * 1e3, 2
+                ),
+                "sample_n": st.sample_n,
+                "audit": audit,
+                "snap": snap,
+                "ring": eng.broker.engine.ring_status(),
+                "counters": dict(eng.counters()),
+                "elapsed": elapsed,
+                "pstat": prof.status(),
+                "top_stacks": prof.snapshot(top_n=10)["top_stacks"],
+                "loop_lag": ll.status(),
+            }
+        finally:
+            await eng.close()
+
+    data = asyncio.run(run())
+    audit, snap, ring = data["audit"], data["snap"], data["ring"]
+    counters, elapsed, pstat = (
+        data["counters"], data["elapsed"], data["pstat"],
+    )
+
+    # -- decomposition contract: sub-stage sums vs queue+deliver wall --
+    stages = snap["stages"]
+    delivery = snap["delivery"]
+    wall = (
+        stages.get("queue", {}).get("sum_seconds", 0.0)
+        + stages.get("deliver", {}).get("sum_seconds", 0.0)
+    )
+    sub_sum = sum(h["sum_seconds"] for h in delivery.values())
+    ratio = sub_sum / wall if wall else 0.0
+    decomp = dict(snap["decomposition"])
+    decomp.update(
+        {
+            "wall_seconds": round(wall, 6),
+            "sub_sum_seconds": round(sub_sum, 6),
+            "sum_to_wall_ratio": round(ratio, 4),
+        }
+    )
+    assert len(delivery) >= 6 and set(delivery) == set(DELIVERY_STAGES), (
+        f"expected all {len(DELIVERY_STAGES)} named sub-stages in the "
+        f"profile, got {sorted(delivery)}"
+    )
+    assert abs(sub_sum - wall) <= DECOMP_TOLERANCE * wall, (
+        f"sub-stage sums ({sub_sum:.4f}s) land {abs(ratio - 1) * 100:.1f}% "
+        f"off the queue+deliver wall ({wall:.4f}s) — decomposition broke"
+    )
+
+    assert pstat["samples_total"] > 0, "profiler captured zero samples"
+    recompiles = counters.get("recompiles_at_serve_total", 0)
+    assert recompiles == 0, (
+        f"{recompiles} serve-path recompiles during the profile storm"
+    )
+    assert audit["silent_divergences"] == 0, (
+        f"audit sweep found {audit['silent_divergences']} SILENT "
+        f"divergences: {audit.get('diverging_topics')}"
+    )
+    overhead = details.get("profiler_overhead") or {}
+    if overhead:
+        assert overhead["within_budget"], (
+            f"profiler overhead {overhead['overhead_pct']}% over budget"
+        )
+
+    row = {
+        "sessions": data["n_sessions"],
+        "storm_seconds": round(elapsed, 2),
+        "published": data["published"],
+        "sustained_pub_per_sec": round(data["published"] / elapsed, 1),
+        "publish_chunk_p50_ms": data["chunk_p50_ms"],
+        "publish_chunk_p99_ms": data["chunk_p99_ms"],
+        "sample_n": data["sample_n"],
+        "sampled_publishes": snap["sampled_publishes"],
+        "stages": stages,
+        "delivery_stages": delivery,
+        "fan": snap["fan"],
+        "decomposition": decomp,
+        "profiler": pstat,
+        "top_stacks": data["top_stacks"],
+        "profiler_overhead": overhead,
+        "ring": ring,
+        "loop_lag": data["loop_lag"],
+        "audit": audit,
+        "recompiles_at_serve_total": recompiles,
+        "contracts_ok": True,
+    }
+
+    details["profile"] = {
+        k: row[k]
+        for k in (
+            "sessions",
+            "sustained_pub_per_sec",
+            "sampled_publishes",
+            "decomposition",
+            "recompiles_at_serve_total",
+        )
+    }
+    with open(out_path, "w") as f:
+        json.dump(row, f, indent=1)
+    log(
+        f"profile: {row['sessions']} sessions, "
+        f"{row['sustained_pub_per_sec']} pub/s, "
+        f"{len(delivery)} sub-stages sum/wall {ratio:.3f}, "
+        f"profiler {pstat['samples_total']} samples "
+        f"({pstat['unique_stacks']} stacks), "
+        f"ring occupancy {ring.get('occupancy_ratio')}, "
+        f"silent {audit['silent_divergences']} -> {out_path}"
+    )
+    return row
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -2754,6 +3043,37 @@ def main():
                     "json_roundtrip_speedup": details["json_codec"].get(
                         "roundtrip_speedup"
                     ),
+                }
+            )
+        )
+        return
+
+    # --profile: the delivery-path microscope artifact is its own run
+    # (million-session storm + dense sampling + the armed profiler) —
+    # it executes alone and commits PROFILE_r17.json. The overhead
+    # stage runs first so the artifact embeds its own budget proof.
+    if "--profile" in sys.argv:
+        bench_provenance(details, jax)
+        bench_profiler_overhead(details)
+        row = bench_profile(details)
+        print(
+            json.dumps(
+                {
+                    "metric": "delivery_substage_sum_to_wall_ratio",
+                    "value": row["decomposition"]["sum_to_wall_ratio"],
+                    "unit": "ratio",
+                    "substages": len(row["delivery_stages"]),
+                    "sustained_pub_per_sec": row["sustained_pub_per_sec"],
+                    "profiler_samples": row["profiler"]["samples_total"],
+                    "profiler_overhead_pct": details[
+                        "profiler_overhead"
+                    ]["overhead_pct"],
+                    "recompiles_at_serve_total": row[
+                        "recompiles_at_serve_total"
+                    ],
+                    "silent_divergences": row["audit"][
+                        "silent_divergences"
+                    ],
                 }
             )
         )
@@ -2833,6 +3153,8 @@ def main():
     stage_done("flight_overhead")
     bench_sentinel_overhead(details)
     stage_done("sentinel_overhead")
+    bench_profiler_overhead(details)
+    stage_done("profiler_overhead")
     bench_fanout(details)
     stage_done("fanout")
     bench_pipeline(details)

@@ -389,6 +389,21 @@ class Channel:
     # --- acks (outbound flow control) --------------------------------------
 
     def _handle_ack(self, pkt: Puback) -> List[object]:
+        # sampled ack-sweep attribution (obs/sentinel): 1/sample_n ack
+        # packets wall-time the inflight bookkeeping + drain below into
+        # the `ack_sweep` delivery sub-stage — QoS1/2 ack traffic shows
+        # up in the decomposition instead of hiding in socket reads
+        st = getattr(self.broker, "sentinel", None)
+        clock = st.maybe_ack_clock() if st is not None else None
+        if clock is None:
+            return self._handle_ack_inner(pkt)
+        t0 = clock()
+        try:
+            return self._handle_ack_inner(pkt)
+        finally:
+            st.observe_delivery("ack_sweep", clock() - t0)
+
+    def _handle_ack_inner(self, pkt: Puback) -> List[object]:
         assert self.session is not None
         s = self.session
         out: List[object] = []

@@ -101,6 +101,7 @@ from collections import deque
 from contextlib import nullcontext
 from typing import Deque, Dict, List, Optional, Set, Tuple
 
+from ..obs.profiler import STAGE_MARK
 from .message import Message
 
 log = logging.getLogger("emqx_tpu.broker.dispatch_engine")
@@ -256,6 +257,16 @@ class DispatchEngine:
         # canary topics: the most recent distinct batch heads, so the
         # recovery probe dispatches realistic traffic, not synthetics
         self._recent_topics: Deque[str] = deque(maxlen=8)
+        # --- device-occupancy timeline (ISSUE 17): launch->land spans
+        # per ring slot, busy-time integral over empty->nonempty
+        # transitions of _inflight, and the idle gaps between lands —
+        # "the device is idle 97% of the time" as a committed number
+        self._ring_track_since: Optional[float] = None
+        self._ring_busy_since: Optional[float] = None
+        self._ring_last_land: Optional[float] = None
+        self._ring_busy_accum = 0.0
+        self._ring_slots_total = 0
+        self._ring_timeline: Deque[Dict] = deque(maxlen=64)
         tel = self.telemetry
         if tel.enabled:
             tel.set_gauge("breaker_state", 0)
@@ -602,19 +613,30 @@ class DispatchEngine:
                 if rb is not None and rb.batch_where_enabled
                 else nullcontext()
             )
+            STAGE_MARK.stage = "coalesce"
             with win:
                 for msg, fut, t_in, span in batch:
                     tel.observe_family(
                         "pipeline_queue_wait_seconds", now - t_in
                     )
-                    if span is not None:
-                        span.add("queue", now - t_in)
-                        if bspan is None and st is not None:
-                            bspan = st.batch_span()
+                    if span is not None and bspan is None and st is not None:
+                        bspan = st.batch_span()
                     live = broker._pre_publish(msg)
+                    if span is not None:
+                        # queue sub-decomposition: submit_wait is
+                        # submit()->flush fire; coalesce is this
+                        # publish's wait inside the flush fold (its own
+                        # hook walk included). submit_wait + coalesce
+                        # == queue exactly, by construction — the
+                        # sum-to-wall contract starts here.
+                        t_end = tel.clock()
+                        span.add("queue", t_end - t_in)
+                        span.add_sub("submit_wait", now - t_in)
+                        span.add_sub("coalesce", t_end - now)
                     entries.append((live, fut, span))
                     if live is not None:
                         topics.append(live.topic)
+            STAGE_MARK.stage = ""
             self.batches_total += 1
             self.publishes_total += len(batch)
             if topics:
@@ -665,7 +687,20 @@ class DispatchEngine:
                         fanout_pending.append(
                             (fkey, broker._fanout_clock, h)
                         )
-            self._inflight.append((pending, entries, fanout_pending, bspan))
+            t_launch = tel.clock()
+            if self._ring_track_since is None:
+                self._ring_track_since = t_launch
+            if self._ring_busy_since is None:
+                # empty->nonempty transition: the gap since the last
+                # land is device idle time — the timeline's blank space
+                self._ring_busy_since = t_launch
+                if self._ring_last_land is not None:
+                    tel.observe_family(
+                        "ring_gap_seconds", t_launch - self._ring_last_land
+                    )
+            self._inflight.append(
+                (pending, entries, fanout_pending, bspan, t_launch)
+            )
             self._inflight_pubs += len(entries)
             tel.set_gauge("pipeline_depth", len(self._inflight))
             tel.set_gauge("pipeline_coalesce", len(batch))
@@ -697,7 +732,7 @@ class DispatchEngine:
         """True when collecting the ring head will not block: the
         match legs' AND any overlapped fanout resolves' transfer
         tickets have all landed host-side."""
-        pending, _entries, fanout_pending, _bspan = self._inflight[0]
+        pending, _entries, fanout_pending, _bspan, _t = self._inflight[0]
         if not self.router.match_finish_ready(pending):
             return False
         if fanout_pending is not None:
@@ -736,7 +771,9 @@ class DispatchEngine:
         walk; a slow-but-successful device batch past the breaker
         deadline counts toward the breaker without being re-served
         (its results are already correct)."""
-        pending, entries, fanout_pending, bspan = self._inflight.popleft()
+        pending, entries, fanout_pending, bspan, t_launch = (
+            self._inflight.popleft()
+        )
         broker = self.broker
         router = self.router
         st = broker.sentinel
@@ -763,6 +800,7 @@ class DispatchEngine:
                     for _live, fut, _span in entries:
                         if not fut.done():
                             fut.set_exception(e2)
+                    self._ring_land(tclock(), t_launch, "failed", len(entries))
                     self._batch_done(len(entries))
                     return
             else:
@@ -782,6 +820,7 @@ class DispatchEngine:
                 # with the clock captured at begin, so a mutation that
                 # landed mid-flight leaves them stale-on-arrival and the
                 # dispatch below rebuilds — exactness over hit ratio
+                STAGE_MARK.stage = "plan_resolve"
                 t_res = tclock() if bspan is not None else 0.0
                 for fkey, clock, h in fanout_pending:
                     try:
@@ -795,6 +834,8 @@ class DispatchEngine:
                     broker._store_plan(fkey, clock, plan)
                 if bspan is not None:
                     bspan.add("resolve", tclock() - t_res)
+                STAGE_MARK.stage = ""
+            self._ring_land(tclock(), t_launch, pending.mode, len(entries))
             fd = router.filter_dests
             it = iter(filter_lists)
             for live, fut, span in entries:
@@ -805,7 +846,7 @@ class DispatchEngine:
                     pairs = [(f, fd(f)) for f in flts]
                     t_del = tclock() if span is not None else 0.0
                     try:
-                        n = broker._dispatch(live, pairs)
+                        n = broker._dispatch(live, pairs, span=span)
                     except Exception as e:
                         # a delivery-side failure is the publisher's to
                         # see (host bug, not a device fault) — counted,
@@ -839,6 +880,54 @@ class DispatchEngine:
             self._pump_waiters()
         else:
             self._maybe_clear_overload()
+
+    # --- device-occupancy timeline ---------------------------------------
+
+    def _ring_land(
+        self, t_land: float, t_launch: float, mode: str, n_pubs: int
+    ) -> None:
+        """One ring slot landed: record its launch->land span, stamp
+        the timeline, and close the busy segment when the ring just
+        went empty (the occupancy integral only advances on
+        transitions — zero cost while the ring stays busy)."""
+        tel = self.telemetry
+        self._ring_slots_total += 1
+        self._ring_last_land = t_land
+        tel.observe_family("ring_slot_span_seconds", t_land - t_launch)
+        self._ring_timeline.append(
+            {
+                "launch": round(t_launch, 6),
+                "land": round(t_land, 6),
+                "span_ms": round((t_land - t_launch) * 1e3, 4),
+                "mode": mode,
+                "publishes": n_pubs,
+            }
+        )
+        if not self._inflight and self._ring_busy_since is not None:
+            self._ring_busy_accum += t_land - self._ring_busy_since
+            self._ring_busy_since = None
+            tel.set_gauge("ring_occupancy_ratio", self._ring_occupancy())
+
+    def _ring_occupancy(self) -> float:
+        """Busy-time fraction since tracking began: the committed
+        answer to 'how idle is the device, really'."""
+        since = self._ring_track_since
+        if since is None:
+            return 0.0
+        now = self.telemetry.clock()
+        busy = self._ring_busy_accum
+        if self._ring_busy_since is not None:
+            busy += now - self._ring_busy_since
+        elapsed = now - since
+        return min(1.0, busy / elapsed) if elapsed > 0 else 0.0
+
+    def ring_status(self) -> Dict:
+        return {
+            "slots_total": self._ring_slots_total,
+            "occupancy_ratio": round(self._ring_occupancy(), 6),
+            "busy_seconds": round(self._ring_busy_accum, 6),
+            "timeline": list(self._ring_timeline),
+        }
 
     # --- circuit breaker (trip -> degrade -> probe -> resync -> close) ----
 
@@ -1295,4 +1384,5 @@ class DispatchEngine:
                 "evictions": cache.evictions,
                 "hit_ratio": round(cache.hit_ratio(), 6),
             },
+            "ring": self.ring_status(),
         }

@@ -26,6 +26,7 @@ from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 from ..models.retainer import Retainer
 from ..models.router import Router
 from ..models.shared_sub import SharedSubs
+from ..obs.profiler import STAGE_MARK
 from ..ops import topic as topic_mod
 from . import frame
 from .hooks import Hooks
@@ -412,7 +413,7 @@ class Broker:
         gen = self.router.generation
         pairs = self.router.match_pairs(msg.topic)
         t0 = clock()
-        n = self._dispatch(msg, pairs)
+        n = self._dispatch(msg, pairs, span=span)
         span.add("deliver", clock() - t0)
         st.finish_span(span)
         st.capture_audit(
@@ -508,14 +509,24 @@ class Broker:
             self.retainer.retain(out)
         return out
 
-    def _dispatch(self, msg: Message, pairs: Pairs) -> int:
+    def _dispatch(self, msg: Message, pairs: Pairs, span=None) -> int:
         # the matched-filter key is the cache identity for BOTH plan
         # families (shared legs + direct plan); build it once per
-        # dispatch instead of once per consumer
+        # dispatch instead of once per consumer. A sampled publish
+        # carries its StageSpan through here so the delivery walk
+        # decomposes into DELIVERY_STAGES sub-stages; the span=None
+        # path is byte-for-byte the old hot path.
         pairs = pairs if isinstance(pairs, list) else list(pairs)
         key = tuple(flt for flt, _ in pairs)
-        n = self._dispatch_shared_local(msg, pairs, key)
-        nd = self._dispatch_direct(msg, pairs, key)
+        if span is None:
+            n = self._dispatch_shared_local(msg, pairs, key)
+        else:
+            clock = self.router.telemetry.clock
+            t0 = clock()
+            n = self._dispatch_shared_local(msg, pairs, key)
+            # shared-group election rides the generic fan walk bucket
+            span.add_sub("dispatch_loop", clock() - t0)
+        nd = self._dispatch_direct(msg, pairs, key, span)
         if nd:
             self.metrics.inc("messages.delivered", nd)
         self._account_dispatch(msg, n + nd)
@@ -633,7 +644,7 @@ class Broker:
         return n
 
     def _dispatch_direct(
-        self, msg: Message, pairs: Pairs, key: tuple
+        self, msg: Message, pairs: Pairs, key: tuple, span=None
     ) -> int:
         """Dedup direct destinations across matched filters (aggre/1,
         emqx_broker.erl:408-424): one delivery per client, max granted
@@ -650,6 +661,7 @@ class Broker:
         per plan so the per-subscriber hot loop skips every
         per-delivery option test the plan already answers."""
         tel = self.router.telemetry
+        t0 = tel.clock() if span is not None else 0.0
         entry = self._fanout_cache.get(key)
         if entry is not None and self._plan_entry_fresh(entry, key):
             if tel.enabled:
@@ -663,7 +675,9 @@ class Broker:
                 # deliveries must follow the corrupted plan for the
                 # audit to judge it
                 fast = self._split_plan(entry[1])
-            return self._fanout(msg, fast)
+            if span is not None:
+                span.add_sub("plan_resolve", tel.clock() - t0)
+            return self._fanout(msg, fast, span)
         if tel.enabled:
             tel.count("fanout_plan_stale" if entry is not None
                       else "fanout_plan_misses")
@@ -671,7 +685,9 @@ class Broker:
         plan = self._resolve_plan(key, pairs)
         fast = self._split_plan(plan)
         self._fanout_cache_put(key, entry, clock, plan, fast)
-        return self._fanout(msg, fast)
+        if span is not None:
+            span.add_sub("plan_resolve", tel.clock() - t0)
+        return self._fanout(msg, fast, span)
 
     @staticmethod
     def _split_plan(plan: tuple) -> tuple:
@@ -769,24 +785,41 @@ class Broker:
                 other.append((client, flt, opts))
         return mem, other
 
-    def _fanout(self, msg: Message, fast: tuple) -> int:
+    def _fanout(self, msg: Message, fast: tuple, span=None) -> int:
         """Wide-fanout sharding (the 1024 rule) over a split plan
         (_split_plan's (bcast, rest, other)): shard 0 delivers inline;
         later shards are scheduled as separate event-loop turns so a
         100k-subscriber topic cannot stall the loop for one long
         dispatch (the reference parallelizes shards across broker-pool
         workers, emqx_broker.erl:643-672,753-760). Returns deliveries
-        INITIATED — deferred shards count at plan time."""
+        INITIATED — deferred shards count at plan time.
+
+        A sampled publish (span) takes the TIMED inline shard
+        (_deliver_plan_timed — delivery-identical, sub-stage
+        accounting added) and stamps its fan size; deferred shards
+        always run the plain loop (they execute outside the span's
+        deliver wall, so timing them would break sum-to-wall)."""
         bcast, rest, other = fast
         total = len(bcast) + len(rest) + len(other)
+        if span is not None:
+            span.fan += total
         pkt_cache: Dict[bool, tuple] = {}  # retain -> (pkt, (pkt,))
         if total <= FANOUT_SHARD:
+            if span is not None:
+                return self._deliver_plan_timed(
+                    msg, fast, 0, total, pkt_cache, span
+                )
             return self._deliver_plan(msg, fast, 0, total, pkt_cache)
         try:
             loop = asyncio.get_running_loop()
         except RuntimeError:
             loop = None
-        n = self._deliver_plan(msg, fast, 0, FANOUT_SHARD, pkt_cache)
+        if span is not None:
+            n = self._deliver_plan_timed(
+                msg, fast, 0, FANOUT_SHARD, pkt_cache, span
+            )
+        else:
+            n = self._deliver_plan(msg, fast, 0, FANOUT_SHARD, pkt_cache)
         for i in range(FANOUT_SHARD, total, FANOUT_SHARD):
             hi = min(i + FANOUT_SHARD, total)
             if loop is None:
@@ -830,6 +863,12 @@ class Broker:
         (frame.serialize memoizes on the shared packet)."""
         bcast, rest, other = fast
         n = 0
+        # profiler stage marks (obs/profiler.STAGE_MARK): one store per
+        # LEG, read by the sampling thread to bucket stacks. The bcast
+        # leg is serialize+socket-write by construction, so it samples
+        # as session_write; the mixed legs sample as dispatch_loop.
+        mark = STAGE_MARK
+        mark.stage = "dispatch_loop"
         run_hook = self.hooks.has("message.delivered")
         # per-delivery hookpoints are untimed by contract (obs/
         # flight_recorder UNTIMED_HOOKPOINTS): the probe-free runner
@@ -839,6 +878,7 @@ class Broker:
         mq = msg.qos
         nb = len(bcast)
         if lo < nb:
+            mark.stage = "session_write"
             cached = pkt_cache.get(False)
             if cached is None:
                 cached = self._shared_pkt(msg, False, pkt_cache)
@@ -884,6 +924,7 @@ class Broker:
                     if sink is not None:
                         sink(packets)
                 n += 1
+            mark.stage = "dispatch_loop"
         m = nb + len(rest)
         if hi > nb and lo < m:
             for client, s, opts in rest[max(lo - nb, 0):min(hi, m) - nb]:
@@ -937,6 +978,155 @@ class Broker:
                     if sink is not None:
                         sink(packets)
                 n += 1
+        mark.stage = ""
+        return n
+
+    def _deliver_plan_timed(
+        self,
+        msg: Message,
+        fast: tuple,
+        lo: int,
+        hi: int,
+        pkt_cache: Dict[bool, tuple],
+        span,
+    ) -> int:
+        """_deliver_plan with sub-stage accounting, run ONLY for the
+        inline shard of a sampled publish (1/sample_n) — the unsampled
+        hot loop above stays untouched. Delivery semantics are
+        mirror-identical by contract (tests/test_delivery_stages.py
+        drives both against the same plan and asserts identical sink
+        output); the additions are clock pairs around the write calls
+        (session_write: serialize + sink/socket writes) and the
+        session.deliver calls (ack_sweep: QoS1/2 inflight
+        bookkeeping), with dispatch_loop taking the residual of the
+        measured leg wall — so the three sub-stages sum to this
+        shard's wall exactly."""
+        clock = self.router.telemetry.clock
+        t_leg = clock()
+        sw = 0.0  # session_write accumulator
+        ack = 0.0  # ack_sweep accumulator
+        bcast, rest, other = fast
+        n = 0
+        run_hook = self.hooks.has("message.delivered")
+        hooks_run = self.hooks.run_unobserved
+        fr = msg.from_client
+        mq = msg.qos
+        nb = len(bcast)
+        if lo < nb:
+            cached = pkt_cache.get(False)
+            if cached is None:
+                cached = self._shared_pkt(msg, False, pkt_cache)
+            pkt_tuple = cached[1]
+            cache_get = pkt_cache.get
+            last_ver = None
+            data = None
+            for client, s, opts in bcast[lo:min(hi, nb)]:
+                if s.connected:
+                    sb = s.outgoing_sink_bytes
+                    if sb is not None:
+                        if run_hook:
+                            hooks_run("message.delivered", client, msg)
+                        t0 = clock()
+                        ver = s.sink_proto_ver
+                        if ver is not last_ver:
+                            data = cache_get((ver, False))
+                            if data is None:
+                                data = frame.serialize(cached[0], ver)
+                                pkt_cache[(ver, False)] = data
+                            last_ver = ver
+                        sb(data)
+                        sw += clock() - t0
+                        n += 1
+                        continue
+                    if run_hook:
+                        hooks_run("message.delivered", client, msg)
+                    sink = s.outgoing_sink
+                    if sink is not None:
+                        t0 = clock()
+                        sink(pkt_tuple)
+                        sw += clock() - t0
+                    n += 1
+                    continue
+                t0 = clock()
+                packets = s.deliver(msg, opts)
+                ack += clock() - t0
+                if run_hook:
+                    hooks_run("message.delivered", client, msg)
+                if packets:
+                    sink = s.outgoing_sink
+                    if sink is not None:
+                        t0 = clock()
+                        sink(packets)
+                        sw += clock() - t0
+                n += 1
+        m = nb + len(rest)
+        if hi > nb and lo < m:
+            for client, s, opts in rest[max(lo - nb, 0):min(hi, m) - nb]:
+                if opts.no_local and fr == client:
+                    continue
+                if (
+                    s.connected
+                    and (mq == 0 or opts.qos == 0)
+                    and not s.cfg.upgrade_qos
+                ):
+                    retain = msg.retain if opts.retain_as_published else False
+                    cached = pkt_cache.get(retain)
+                    if cached is None:
+                        cached = self._shared_pkt(msg, retain, pkt_cache)
+                    if run_hook:
+                        hooks_run("message.delivered", client, msg)
+                    t0 = clock()
+                    sb = s.outgoing_sink_bytes
+                    if sb is not None:
+                        ver = s.sink_proto_ver
+                        data = pkt_cache.get((ver, retain))
+                        if data is None:
+                            data = frame.serialize(cached[0], ver)
+                            pkt_cache[(ver, retain)] = data
+                        sb(data)
+                    else:
+                        sink = s.outgoing_sink
+                        if sink is not None:
+                            sink(cached[1])
+                    sw += clock() - t0
+                    n += 1
+                    continue
+                t0 = clock()
+                packets = s.deliver(msg, opts)
+                ack += clock() - t0
+                if run_hook:
+                    hooks_run("message.delivered", client, msg)
+                if packets:
+                    sink = s.outgoing_sink
+                    if sink is not None:
+                        t0 = clock()
+                        sink(packets)
+                        sw += clock() - t0
+                n += 1
+        if hi > m:
+            for client, flt, opts in other[max(lo - m, 0):hi - m]:
+                session = self.sessions.get(client)
+                if session is None:
+                    continue
+                if opts.no_local and fr == client:
+                    continue
+                t0 = clock()
+                packets = session.deliver(msg, opts)
+                ack += clock() - t0
+                if run_hook:
+                    hooks_run("message.delivered", client, msg)
+                if packets:
+                    sink = getattr(session, "outgoing_sink", None)
+                    if sink is not None:
+                        t0 = clock()
+                        sink(packets)
+                        sw += clock() - t0
+                n += 1
+        span.add_sub("session_write", sw)
+        span.add_sub("ack_sweep", ack)
+        span.add_sub(
+            "dispatch_loop", max(0.0, clock() - t_leg - sw - ack)
+        )
         return n
 
     def _deliver_shard(

@@ -14,6 +14,7 @@ from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Deque, Dict, List, Optional, Tuple
 
+from ..obs.profiler import STAGE_MARK
 from .message import Message
 from .packet import Publish, SubOpts
 
@@ -172,6 +173,10 @@ class Session:
     def drain(self) -> List[Publish]:
         """Move queued messages into the inflight window (after acks
         free slots, or on reconnect)."""
+        # ack_sweep stage mark: the sampler buckets stacks caught in
+        # this window-advance walk under the ack sweep sub-stage (the
+        # wall time is measured by the channel's sampled ack clock)
+        STAGE_MARK.stage = "ack_sweep"
         out: List[Publish] = []
         while self.mqueue:
             _prio, msg, subopts = self.mqueue[0]
@@ -191,6 +196,7 @@ class Session:
                 msg, "puback" if msg.qos == 1 else "pubrec", time.time()
             )
             out.append(self._to_publish(msg, pid))
+        STAGE_MARK.stage = ""
         return out
 
     # --- outgoing acks --------------------------------------------------
@@ -219,6 +225,7 @@ class Session:
 
     def retry(self, now: Optional[float] = None) -> List[Publish]:
         """Re-send unacked QoS1/2 after retry_interval (dup=1)."""
+        STAGE_MARK.stage = "ack_sweep"
         now = now if now is not None else time.time()
         out = []
         for pid, e in self.inflight.items():
@@ -230,6 +237,7 @@ class Session:
                     p.dup = True
                     out.append(p)
                 # phase 'pubcomp': PUBREL retransmit handled by channel
+        STAGE_MARK.stage = ""
         return out
 
     # --- incoming QoS2 --------------------------------------------------

@@ -53,7 +53,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import random
-from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 from . import rpc as rpc_mod
 from .metrics import CLUSTER_METRICS, STATE_ALIVE, STATE_DOWN, STATE_SUSPECT

@@ -69,7 +69,7 @@ def msg_to_wire(msg: Message) -> dict:
 
 
 def msg_from_wire(d: dict) -> Message:
-    return Message(
+    msg = Message(
         topic=d["topic"],
         payload=d["payload"],
         qos=d["qos"],
@@ -79,6 +79,14 @@ def msg_from_wire(d: dict) -> Message:
         timestamp=d["timestamp"],
         props=dict(d.get("props") or {}),
     )
+    # cross-node sentinel trace (Dapper propagation over the broker
+    # RPC plane): a forward whose ORIGIN publish was sampled carries
+    # the origin span's trace id, so the receiving node's delivery
+    # sub-stage samples join the same end-to-end trace
+    trace = d.get("sentinel_trace")
+    if trace:
+        msg.headers["sentinel_trace"] = trace
+    return msg
 
 
 class ClusterBroker(Broker):
@@ -90,29 +98,40 @@ class ClusterBroker(Broker):
         super().__init__(*args, **kwargs)
         self.node: Optional["ClusterNode"] = None
 
-    def _dispatch(self, msg: Message, pairs) -> int:
+    def _dispatch(self, msg: Message, pairs, span=None) -> int:
         node = self.node
         if node is None:
-            return super()._dispatch(msg, pairs)
+            return super()._dispatch(msg, pairs, span=span)
         # local direct dests only — group election happens cluster-wide
         pairs = pairs if isinstance(pairs, list) else list(pairs)
         n = self._dispatch_direct(
-            msg, pairs, tuple(flt for flt, _ in pairs)
+            msg, pairs, tuple(flt for flt, _ in pairs), span
         )
         if n:
             self.metrics.inc("messages.delivered", n)
-        n += node.route_remote(msg)
+        n += node.route_remote(msg, span=span)
         self._account_dispatch(msg, n)
         return n
 
     def dispatch_forwarded(self, msg: Message) -> int:
         """Peer leg of a forward: deliver to LOCAL direct subscribers
         only — no re-forwarding, no shared election (the publisher
-        already elected; emqx_broker:dispatch :472-480)."""
+        already elected; emqx_broker:dispatch :472-480). A forward
+        carrying the origin node's sentinel trace id gets a FORCED
+        remote-side span, so its local delivery decomposes into
+        sub-stage samples stamped with the originating trace."""
+        st = self.sentinel
+        span = st.forwarded_span(msg) if st is not None else None
         pairs = self.router.match_pairs(msg.topic)
-        n = self._dispatch_direct(
-            msg, pairs, tuple(flt for flt, _ in pairs)
-        )
+        key = tuple(flt for flt, _ in pairs)
+        if span is None:
+            n = self._dispatch_direct(msg, pairs, key)
+        else:
+            clock = self.router.telemetry.clock
+            t0 = clock()
+            n = self._dispatch_direct(msg, pairs, key, span)
+            span.add("deliver", clock() - t0)
+            st.finish_span(span)
         if n:
             self.metrics.inc("messages.delivered", n)
         return n
@@ -1095,7 +1114,7 @@ class ClusterNode:
 
     # --- publish-path cluster legs ---------------------------------------
 
-    def route_remote(self, msg: Message) -> int:
+    def route_remote(self, msg: Message, span=None) -> int:
         """Forward to remote nodes with matching routes (once per node)
         and elect shared-group members cluster-wide. Returns deliveries
         initiated (remote forwards count as 1 each, like the reference
@@ -1109,6 +1128,11 @@ class ClusterNode:
         remote_nodes = {d for d in dests if isinstance(d, str) and d != self.node_id}
         n = 0
         payload = msg_to_wire(msg)
+        if span is not None and span.trace_id:
+            # sentinel trace propagation: the sampled origin span's id
+            # rides the forward leg so the peer's forced span (see
+            # ClusterBroker.dispatch_forwarded) joins this trace
+            payload["sentinel_trace"] = span.trace_id
         tracer = getattr(self.broker, "tracer", None)
         root = msg.headers.get("trace_root") if tracer is not None else None
         for node in remote_nodes:

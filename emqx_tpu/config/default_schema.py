@@ -385,6 +385,25 @@ def broker_schema() -> Struct:
                                     "tpu_slo_burn_threshold": Field(
                                         Float(), default=10.0
                                     ),
+                                    # delivery-path microscope
+                                    # (obs/profiler): continuous
+                                    # sampling profiler (off by
+                                    # default — flight bundles
+                                    # auto-arm it), queue-stage
+                                    # sub-decomposition, and the
+                                    # event-loop lag ticker
+                                    "tpu_profiler_enable": Field(
+                                        Bool(), default=False
+                                    ),
+                                    "tpu_profiler_hz": Field(
+                                        Float(), default=100.0
+                                    ),
+                                    "tpu_delivery_stages": Field(
+                                        Bool(), default=True
+                                    ),
+                                    "tpu_loop_lag_interval_ms": Field(
+                                        Float(), default=100.0
+                                    ),
                                 }
                             )
                         ),

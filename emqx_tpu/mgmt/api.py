@@ -364,6 +364,9 @@ class ManagementApi:
                 "GET", "/api/v5/xla/flight/snapshots/{name}",
                 self._flight_snapshot_one,
             )
+            # delivery-path microscope: sampling-profiler status, top
+            # stacks per sub-stage, collapsed flamegraph text
+            r("GET", "/api/v5/xla/profile", self._xla_profile)
         # kernel telemetry reads the router's always-on collector, so
         # it is live even without the obs bundle wired
         r("GET", "/api/v5/xla/telemetry", self._xla_telemetry)
@@ -1333,11 +1336,52 @@ class ManagementApi:
                 "breaker": es["breaker"],
                 "admission": es["admission"],
                 "coalesce_factor": es["coalesce_factor"],
+                # device-occupancy timeline: per-slot launch->land
+                # spans, gaps, and the ring busy-ratio (ISSUE 17)
+                "ring": es.get("ring"),
             }
+        ll = getattr(self.obs, "loop_lag", None)
+        if ll is not None:
+            # co-tenant scheduling delay, measured on its own ticker so
+            # the delivery sub-stages never absorb it
+            out["loop_lag"] = ll.status()
         if self.node is not None:
             # split-brain failure domain: membership states, partition
             # arbitration, autoheal + route anti-entropy ledgers
             out["cluster"] = self.node.cluster_status()
+        return out
+
+    def _xla_profile(self, req: Request):
+        """GET /api/v5/xla/profile — the delivery-path microscope
+        (obs/profiler.py): sampler status + top stacks per delivery
+        sub-stage. `?format=collapsed` returns flamegraph.pl
+        collapsed-stack text (scope with `&stage=<sub-stage>`,
+        `&which=cpu` for on-CPU samples); `?arm=<seconds>` arms the
+        sampler for a bounded window before answering; `?top=N` sizes
+        the per-stage stack lists."""
+        prof = getattr(self.obs, "profiler", None)
+        if prof is None:
+            return Response.error(404, "NOT_FOUND", "profiler not wired")
+        arm = req.query.get("arm")
+        if arm is not None:
+            try:
+                prof.arm_for(float(arm))
+            except ValueError:
+                return Response.error(400, "BAD_REQUEST", f"bad arm: {arm}")
+        which = req.query.get("which", "wall")
+        stage = req.query.get("stage") or None
+        if req.query.get("format") == "collapsed":
+            return Response.text(
+                prof.collapsed(stage=stage, which=which) + "\n"
+            )
+        try:
+            top_n = int(req.query.get("top", "10"))
+        except ValueError:
+            return Response.error(400, "BAD_REQUEST", "bad top")
+        out = prof.snapshot(top_n=top_n)
+        ll = getattr(self.obs, "loop_lag", None)
+        if ll is not None:
+            out["loop_lag"] = ll.status()
         return out
 
     def _xla_sentinel(self, req: Request):

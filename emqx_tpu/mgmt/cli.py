@@ -121,6 +121,76 @@ class Ctl:
             self._sentinel,
             "sentinel status | audit | slo | stages | exemplars",
         )
+        reg(
+            "profile",
+            self._profile,
+            "profile status | start | stop | arm [s] | stacks [stage] "
+            "| collapsed [stage] | lag",
+        )
+
+    def _profile(self, args) -> str:
+        """emqx ctl profile — the delivery-path microscope
+        (obs/profiler.py): continuous sampling profiler control, top
+        stacks per delivery sub-stage, collapsed flamegraph text, and
+        the event-loop lag ticker."""
+        prof = getattr(self.obs, "profiler", None) if self.obs else None
+        if prof is None:
+            return "profiler not wired"
+        sub = args[0] if args else "status"
+        if sub == "status":
+            st = prof.status()
+            lines = [
+                f"{'running':<22}: {st['running']} ({st['hz']:g} Hz)",
+                f"{'samples':<22}: {st['samples_total']} wall / "
+                f"{st['cpu_samples_total']} cpu",
+                f"{'unique stacks':<22}: {st['unique_stacks']} "
+                f"(overflow {st['overflow_total']})",
+                f"{'arms':<22}: {st['arms_total']}",
+            ]
+            for stage, n in st["stage_samples"].items():
+                lines.append(f"{'  stage ' + stage:<22}: {n}")
+            return "\n".join(lines)
+        if sub == "start":
+            return "started" if prof.start() else "already running"
+        if sub == "stop":
+            prof.stop()
+            return "stopped"
+        if sub == "arm":
+            seconds = float(args[1]) if len(args) > 1 else 10.0
+            prof.arm_for(seconds)
+            return f"armed for {seconds:g}s"
+        if sub == "stacks":
+            stage = args[1] if len(args) > 1 else None
+            rows = prof.top_stacks(stage=stage, n=10)
+            if not rows:
+                return "(no samples)"
+            out = []
+            for r in rows:
+                out.append(
+                    f"[{r['stage'] or 'other'}] wall={r['wall_samples']} "
+                    f"cpu={r['cpu_samples']}"
+                )
+                out.append("    " + " <- ".join(reversed(r["stack"])))
+            return "\n".join(out)
+        if sub == "collapsed":
+            stage = args[1] if len(args) > 1 else None
+            return prof.collapsed(stage=stage) or "(no samples)"
+        if sub == "lag":
+            ll = getattr(self.obs, "loop_lag", None)
+            if ll is None:
+                return "loop-lag monitor not wired"
+            st = ll.status()
+            lag = st["lag"]
+            return "\n".join(
+                [
+                    f"{'running':<22}: {st['running']} "
+                    f"(interval {st['interval_s']:g}s)",
+                    f"{'ticks':<22}: {st['ticks_total']}",
+                    f"{'lag p50/p99 ms':<22}: "
+                    f"{lag.get('p50_ms', 0)} / {lag.get('p99_ms', 0)}",
+                ]
+            )
+        raise ValueError(f"bad subcommand {sub!r}")
 
     def _sentinel(self, args) -> str:
         """emqx ctl sentinel — publish-path watchdog: shadow-audit

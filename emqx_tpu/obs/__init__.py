@@ -52,6 +52,12 @@ from .sentinel import PublishSentinel, SloObjective, StageSpan  # noqa: F401
 from .slow_subs import SlowSubs  # noqa: F401
 from .sys import SysHeartbeat  # noqa: F401
 from .topic_metrics import TopicMetrics  # noqa: F401
+from .profiler import (  # noqa: F401
+    DELIVERY_STAGES,
+    STAGE_MARK,
+    LoopLagMonitor,
+    SamplingProfiler,
+)
 from .trace import TraceManager  # noqa: F401
 
 
@@ -128,15 +134,47 @@ class Observability:
                 ),
             )
             broker.sentinel = self.sentinel
+        # delivery-path microscope (obs/profiler.py): the sampling
+        # profiler is constructed whenever delivery-stage attribution
+        # is on, but only RUNS continuously when tpu_profiler_enable
+        # is set — otherwise it stays parked until a flight bundle
+        # auto-arms it or the API/ctl starts it on demand
+        self.profiler = SamplingProfiler(
+            hz=_cfg(config, "broker.perf.tpu_profiler_hz", 100.0)
+        )
+        self.profiler_enabled = bool(
+            _cfg(config, "broker.perf.tpu_profiler_enable", False)
+        )
+        self.loop_lag = LoopLagMonitor(
+            interval_s=_cfg(
+                config, "broker.perf.tpu_loop_lag_interval_ms", 100.0
+            ) / 1e3
+        )
+        if self.flight is not None:
+            self.flight.profiler = self.profiler
+        if not _cfg(config, "broker.perf.tpu_delivery_stages", True):
+            # delivery sub-stage attribution off: spans stop carrying
+            # subs by zeroing the sentinel histograms' feed at the
+            # engine seam (the spans themselves stay — publish-stage
+            # attribution is a separate, older contract)
+            if self.sentinel is not None:
+                self.sentinel.delivery_stages_enabled = False
 
     def prometheus_text(self) -> str:
         return prometheus_text(self.broker, self.node_name, obs=self)
 
     def start(self, sys_interval: float = 30.0) -> None:
         self.sys.start(sys_interval)
+        if self.profiler_enabled:
+            self.profiler.start()
+        # needs a running loop; boot calls start() from async context.
+        # Synchronous callers (bench setup) just skip the ticker.
+        self.loop_lag.start()
 
     def stop(self) -> None:
         self.sys.stop()
+        self.loop_lag.stop()
+        self.profiler.stop()
         if self.sentinel is not None and self.broker.sentinel is self.sentinel:
             self.broker.sentinel = None
         if self.flight is not None:

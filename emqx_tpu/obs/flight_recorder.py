@@ -440,6 +440,12 @@ class FlightControl:
         self.eval_interval = eval_interval
         self.rules = default_rules() if rules is None else rules
         self.hook_hist: Dict[str, StreamingHistogram] = {}
+        # optional sampling profiler (obs/profiler.py): a snapshot
+        # auto-arms it for profile_arm_s so every anomaly bundle ships
+        # with the stacks that caused it, and the bundle attaches the
+        # profiler's stage-bucketed top stacks
+        self.profiler = None
+        self.profile_arm_s = 10.0
         self.snapshots_total = 0
         self.triggers_total: Dict[str, int] = {}
         self._last_fired: Dict[str, float] = {}
@@ -653,6 +659,11 @@ class FlightControl:
                 if self.alarms is not None
                 else []
             ),
+            "profile": (
+                self.profiler.snapshot()
+                if self.profiler is not None
+                else None
+            ),
         }
 
     def snapshot(
@@ -661,6 +672,15 @@ class FlightControl:
         """Freeze, bundle, persist, thaw. The freeze keeps concurrent
         writers (hook taps on other coroutines, bridge pumps) from
         rotating the pre-anomaly events out from under the dump."""
+        if self.profiler is not None:
+            # arm the sampler for the post-anomaly window: this bundle
+            # carries whatever stacks were already aggregated; the NEXT
+            # bundle (or GET /api/v5/xla/profile) sees the anomaly's
+            # aftermath sampled at full rate
+            try:
+                self.profiler.arm_for(self.profile_arm_s)
+            except Exception:
+                log.exception("profiler auto-arm failed")
         self.recorder.freeze()
         try:
             path = self.store.persist(reason, self.bundle(reason, details))

@@ -141,3 +141,12 @@ def _emit_obs(lines: List[str], obs, node_name: str) -> None:
     flight = getattr(obs, "flight", None)
     if flight is not None:
         lines.extend(flight.prometheus_lines(node_name))
+    # delivery-path microscope: sampling-profiler counters/gauges and
+    # the event-loop lag histogram (obs/profiler.py) ride the bundle's
+    # scrape — both are per-Observability objects, not process-global
+    profiler = getattr(obs, "profiler", None)
+    if profiler is not None:
+        lines.extend(profiler.prometheus_lines(node_name))
+    loop_lag = getattr(obs, "loop_lag", None)
+    if loop_lag is not None:
+        lines.extend(loop_lag.prometheus_lines(node_name))

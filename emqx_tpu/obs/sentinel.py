@@ -56,6 +56,7 @@ from collections import deque
 from typing import Any, Deque, Dict, List, Optional, Tuple
 
 from .kernel_telemetry import StreamingHistogram, render_histogram_lines
+from .profiler import DELIVERY_STAGES
 
 log = logging.getLogger("emqx_tpu.obs.sentinel")
 
@@ -68,6 +69,17 @@ log = logging.getLogger("emqx_tpu.obs.sentinel")
 STAGES = (
     "queue", "encode", "kernel", "transfer", "fetch", "resolve", "deliver"
 )
+
+# fan-size histogram bounds: powers of two up to 1M subscribers — the
+# kernel-telemetry seconds ladder tops out at ~8.9 so counts need
+# their own scale
+FAN_BOUNDS = tuple(2.0 ** i for i in range(21))
+
+# the decomposition contract: per sampled span, sum(sub-stages) must
+# land within this fraction of the measured queue+deliver wall, or the
+# span counts as out-of-band (the self-check that keeps the
+# sub-decomposition from silently lying)
+DECOMP_TOLERANCE = 0.10
 
 ALARM_DIVERGENCE = "xla_audit_divergence"
 
@@ -89,22 +101,38 @@ class StageSpan:
     collect time — standard exemplar semantics: the sampled publish
     carries its batch's device legs."""
 
-    __slots__ = ("topic", "trace_id", "stages")
+    __slots__ = ("topic", "trace_id", "stages", "subs", "fan")
 
     def __init__(self, topic: str = "", trace_id: str = ""):
         self.topic = topic
         self.trace_id = trace_id
         self.stages: Dict[str, float] = {}
+        # delivery sub-stages (DELIVERY_STAGES) — the decomposition of
+        # the queue+deliver wall, kept separate so span.total() stays
+        # the wall total and never double-counts
+        self.subs: Dict[str, float] = {}
+        # per-publish fanout plan size, stamped by Broker._fanout
+        self.fan = 0
 
     def add(self, stage: str, seconds: float) -> None:
         self.stages[stage] = self.stages.get(stage, 0.0) + seconds
 
+    def add_sub(self, stage: str, seconds: float) -> None:
+        self.subs[stage] = self.subs.get(stage, 0.0) + seconds
+
     def merge(self, other: "StageSpan") -> None:
         for k, v in other.stages.items():
             self.add(k, v)
+        for k, v in other.subs.items():
+            self.add_sub(k, v)
+        if other.fan:
+            self.fan += other.fan
 
     def total(self) -> float:
         return sum(self.stages.values())
+
+    def sub_total(self) -> float:
+        return sum(self.subs.values())
 
 
 class SloObjective:
@@ -232,6 +260,18 @@ class PublishSentinel:
         self.slo_publish_ms = slo_publish_ms
         self.stage_hist: Dict[str, StreamingHistogram] = {}
         self.total_hist = StreamingHistogram()
+        # delivery sub-stage decomposition (ISSUE 17): the queue+deliver
+        # wall split into DELIVERY_STAGES, plus the fan-size histogram
+        # and the sum-to-wall self-check counters
+        self.delivery_hist: Dict[str, StreamingHistogram] = {}
+        self.fan_hist = StreamingHistogram(bounds=FAN_BOUNDS)
+        # broker.perf.tpu_delivery_stages gate: False parks the
+        # sub-stage histograms (spans still carry publish stages)
+        self.delivery_stages_enabled = True
+        self.decomp_in_band = 0
+        self.decomp_out_of_band = 0
+        self.decomp_last_ratio = 0.0
+        self.forwarded_spans_total = 0
         self.exemplars: Deque[Dict[str, Any]] = deque(maxlen=max_exemplars)
         self.slo = {
             "publish_latency": SloObjective(
@@ -250,6 +290,7 @@ class PublishSentinel:
             ),
         }
         self._tick = 0
+        self._ack_tick = 0
         self._slo_tick = 0
         self._pending: Deque[_AuditRecord] = deque(maxlen=max_pending_audits)
         self._drain_scheduled = False
@@ -282,6 +323,36 @@ class PublishSentinel:
         resolve), merged into each sampled publish's span at collect."""
         return StageSpan()
 
+    def maybe_ack_clock(self):
+        """1/sample_n ack sweeps get wall-timed into the `ack_sweep`
+        delivery histogram (channel._handle_ack wraps its body with
+        the returned clock) — same probe-free discipline as
+        maybe_span: one increment + one modulo per ack packet."""
+        n = self.sample_n
+        if n == 0:
+            return None
+        self._ack_tick += 1
+        if self._ack_tick % n:
+            return None
+        return self.telemetry.clock
+
+    def forwarded_span(self, msg) -> Optional[StageSpan]:
+        """Remote-side span for a cluster-forwarded publish. The
+        origin node stamps its sampled span's trace id into the wire
+        payload (`sentinel_trace`); here the receiving node forces a
+        span carrying that SAME id, so remote-side delivery sub-stage
+        samples join the originating trace — the Dapper propagation
+        shape over the broker RPC plane. Forwards without the header
+        (origin didn't sample them) stay probe-free."""
+        if self.sample_n == 0:
+            return None
+        trace = msg.headers.get("sentinel_trace") if msg.headers else None
+        if not trace:
+            return None
+        self.spans_total += 1
+        self.forwarded_spans_total += 1
+        return StageSpan(msg.topic, str(trace))
+
     # --- stage attribution -----------------------------------------------
 
     def finish_span(self, span: StageSpan) -> None:
@@ -290,6 +361,25 @@ class PublishSentinel:
             if h is None:
                 h = self.stage_hist[stage] = StreamingHistogram()
             h.observe(s)
+        if self.delivery_stages_enabled:
+            for stage, s in span.subs.items():
+                self.observe_delivery(stage, s)
+            if span.fan:
+                self.fan_hist.observe(float(span.fan))
+        # decomposition self-check: the sub-stages must sum to within
+        # DECOMP_TOLERANCE of the queue+deliver wall they decompose —
+        # a drifting ratio means a sub-stage lost its recording site
+        if span.subs:
+            wall = span.stages.get("queue", 0.0) + span.stages.get(
+                "deliver", 0.0
+            )
+            sub_total = span.sub_total()
+            if wall > 1e-9:
+                self.decomp_last_ratio = sub_total / wall
+                if abs(sub_total - wall) <= DECOMP_TOLERANCE * wall:
+                    self.decomp_in_band += 1
+                else:
+                    self.decomp_out_of_band += 1
         total = span.total()
         self.total_hist.observe(total)
         self.exemplars.append(
@@ -300,6 +390,10 @@ class PublishSentinel:
                 "stages_ms": {
                     k: round(v * 1e3, 4) for k, v in span.stages.items()
                 },
+                "subs_ms": {
+                    k: round(v * 1e3, 4) for k, v in span.subs.items()
+                },
+                "fan": span.fan,
             }
         )
         slo = self.slo["publish_latency"]
@@ -309,6 +403,16 @@ class PublishSentinel:
         self._slo_tick += 1
         if self._slo_tick % SLO_EVAL_EVERY == 0 or not slo.events[-1][1]:
             self._slo_alarm("publish_latency", slo.evaluate())
+
+    def observe_delivery(self, stage: str, seconds: float) -> None:
+        """Direct sub-stage observation — spans fold through here, and
+        ack/retry sweeps that run outside any publish span (the QoS1/2
+        timer path) record their `ack_sweep` time here so ack traffic
+        stays visible in the decomposition."""
+        h = self.delivery_hist.get(stage)
+        if h is None:
+            h = self.delivery_hist[stage] = StreamingHistogram()
+        h.observe(seconds)
 
     # --- shadow-oracle audit ---------------------------------------------
 
@@ -486,7 +590,30 @@ class PublishSentinel:
                 for s in STAGES
                 if s in self.stage_hist
             },
+            "delivery": {
+                s: self.delivery_hist[s].snapshot()
+                for s in DELIVERY_STAGES
+                if s in self.delivery_hist
+            },
+            "fan": self.fan_hist.snapshot(),
+            "decomposition": self.decomposition_snapshot(),
+            "forwarded_spans": self.forwarded_spans_total,
             "exemplars": list(self.exemplars),
+        }
+
+    def decomposition_snapshot(self) -> Dict[str, Any]:
+        """The sum-to-wall self-check state: how many sampled spans
+        decomposed within DECOMP_TOLERANCE of their queue+deliver
+        wall, and the latest sub-sum/wall ratio."""
+        checked = self.decomp_in_band + self.decomp_out_of_band
+        return {
+            "tolerance": DECOMP_TOLERANCE,
+            "in_band": self.decomp_in_band,
+            "out_of_band": self.decomp_out_of_band,
+            "in_band_ratio": (
+                round(self.decomp_in_band / checked, 4) if checked else None
+            ),
+            "last_ratio": round(self.decomp_last_ratio, 4),
         }
 
     def status(self) -> Dict[str, Any]:
@@ -556,6 +683,10 @@ class PublishSentinel:
                 s: round(h.percentile(99) * 1e3, 4)
                 for s, h in sorted(self.stage_hist.items())
             },
+            "xla_delivery_stage_p99_ms": {
+                s: round(h.percentile(99) * 1e3, 4)
+                for s, h in sorted(self.delivery_hist.items())
+            },
             "xla_audit_divergence": counters.get(
                 "audit_divergence_total", 0
             ),
@@ -578,6 +709,39 @@ class PublishSentinel:
                     lines, fam, f'{node},stage="{stage}"',
                     self.stage_hist[stage], emit_type=False,
                 )
+        if self.delivery_hist:
+            fam = "emqx_xla_delivery_stage_seconds"
+            lines.append(f"# TYPE {fam} histogram")
+            for stage in sorted(self.delivery_hist):
+                render_histogram_lines(
+                    lines, fam, f'{node},stage="{stage}"',
+                    self.delivery_hist[stage], emit_type=False,
+                )
+            render_histogram_lines(
+                lines, "emqx_xla_delivery_fan", node, self.fan_hist
+            )
+            decomp = self.decomposition_snapshot()
+            lines.append(
+                "# TYPE emqx_xla_delivery_decomp_in_band_total counter"
+            )
+            lines.append(
+                f"emqx_xla_delivery_decomp_in_band_total{{{node}}} "
+                f"{decomp['in_band']}"
+            )
+            lines.append(
+                "# TYPE emqx_xla_delivery_decomp_out_of_band_total counter"
+            )
+            lines.append(
+                f"emqx_xla_delivery_decomp_out_of_band_total{{{node}}} "
+                f"{decomp['out_of_band']}"
+            )
+            lines.append(
+                "# TYPE emqx_xla_delivery_decomp_last_ratio gauge"
+            )
+            lines.append(
+                f"emqx_xla_delivery_decomp_last_ratio{{{node}}} "
+                f"{decomp['last_ratio']}"
+            )
         evals = {name: obj.evaluate() for name, obj in self.slo.items()}
         lines.append("# TYPE emqx_xla_slo_burn_rate gauge")
         for name, s in sorted(evals.items()):

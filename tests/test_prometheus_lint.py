@@ -919,3 +919,112 @@ def test_mesh_scaling_families_lint():
     assert re.search(
         r'emqx_xla_mesh_degraded_single_device\{node="n1@host"\} 0', text
     )
+
+
+async def test_delivery_stage_ring_and_profiler_families_lint(tmp_path):
+    """ISSUE-17 families: the queue-stage sub-decomposition
+    (emqx_xla_delivery_*), the device-occupancy timeline
+    (emqx_xla_ring_*), the sampling profiler counters/gauges
+    (emqx_xla_profiler_*), and the event-loop lag histogram
+    (emqx_xla_loop_lag_seconds) must all render on ONE scrape driven
+    through a REAL dense-sampled engine run — mixed QoS so every one
+    of the six sub-stages records, two publish waves separated by an
+    idle window so the ring-gap histogram moves — and pass the same
+    exposition lint. Never hand-poked counters."""
+    from emqx_tpu.obs import Observability
+    from emqx_tpu.obs.profiler import DELIVERY_STAGES
+
+    broker = Broker()
+    broker._fanout_min_fan = 0
+    obs = Observability(
+        broker,
+        node_name="n1@host",
+        trace_dir=str(tmp_path / "t"),
+        flight_dir=str(tmp_path / "f"),
+    )
+    try:
+        obs.sentinel.sample_n = 1  # every publish carries a span
+        assert obs.loop_lag.start()  # async context: ticker runs
+        obs.profiler.arm_for(10.0)
+        eng = broker.enable_dispatch_engine(queue_depth=4, deadline_ms=0.2)
+        for i in range(8):
+            s, _ = broker.open_session(f"c{i}", clean_start=True)
+            s.outgoing_sink = lambda pkts: None
+            # half QoS0 (session_write fast path), half QoS1
+            # (ack_sweep inflight bookkeeping)
+            broker.subscribe(s, "dl/+/v", SubOpts(qos=0 if i < 4 else 1))
+        topics = [f"dl/{i}/v" for i in range(6)]
+        await asyncio.gather(
+            *[eng.publish(Message(topic=t, payload=b"x")) for t in topics]
+        )
+        await asyncio.sleep(0.15)  # ring idles: next launch records a gap
+        await asyncio.gather(
+            *[eng.publish(Message(topic=t, payload=b"y")) for t in topics]
+        )
+        await eng.stop()
+        obs.profiler.stop()
+        st = broker.sentinel
+        # all six sub-stages recorded on the live path
+        assert sorted(st.delivery_hist) == sorted(DELIVERY_STAGES)
+        # the decomposition self-check held for (nearly) every span
+        snap = st.decomposition_snapshot()
+        assert snap["in_band"] >= 8
+        assert snap["in_band_ratio"] >= 0.75
+        # the ring saw multiple slots and the idle window
+        ring = eng.ring_status()
+        assert ring["slots_total"] >= 2
+        assert 0.0 < ring["occupancy_ratio"] <= 1.0
+
+        text = obs.prometheus_text()
+        types = _lint(text)
+        for fam, kind in (
+            ("emqx_xla_delivery_stage_seconds", "histogram"),
+            ("emqx_xla_delivery_fan", "histogram"),
+            ("emqx_xla_delivery_decomp_in_band_total", "counter"),
+            ("emqx_xla_delivery_decomp_out_of_band_total", "counter"),
+            ("emqx_xla_delivery_decomp_last_ratio", "gauge"),
+            ("emqx_xla_ring_slot_span_seconds", "histogram"),
+            ("emqx_xla_ring_gap_seconds", "histogram"),
+            ("emqx_xla_ring_occupancy_ratio", "gauge"),
+            ("emqx_xla_loop_lag_seconds", "histogram"),
+            ("emqx_xla_profiler_samples_total", "counter"),
+            ("emqx_xla_profiler_cpu_samples_total", "counter"),
+            ("emqx_xla_profiler_overflow_total", "counter"),
+            ("emqx_xla_profiler_running", "gauge"),
+            ("emqx_xla_profiler_unique_stacks", "gauge"),
+        ):
+            assert types.get(fam) == kind, f"{fam}: {types.get(fam)}"
+        # the stage family is cumulative per stage label, every label
+        # is a declared sub-stage, and every declared sub-stage renders
+        fam = "emqx_xla_delivery_stage_seconds"
+        stages = {}
+        for line in text.splitlines():
+            if line.startswith(f"{fam}_bucket{{"):
+                labels = line[line.index("{") + 1 : line.index("}")]
+                stage = re.search(r'stage="([^"]+)"', labels).group(1)
+                stages.setdefault(stage, []).append(
+                    int(line.rsplit(" ", 1)[1])
+                )
+        assert sorted(stages) == sorted(DELIVERY_STAGES)
+        for stage, counts in stages.items():
+            assert counts == sorted(counts), f"{stage}: not cumulative"
+            assert counts[-1] >= 1, f"{stage}: never observed"
+        # the fan histogram counted every sampled publish's fan size
+        m = re.search(
+            r'emqx_xla_delivery_fan_count\{node="n1@host"\} (\d+)', text
+        )
+        assert m and int(m.group(1)) == 12
+        # the gap histogram caught the idle window between the waves
+        m = re.search(
+            r'emqx_xla_ring_gap_seconds_count\{node="n1@host"\} (\d+)',
+            text,
+        )
+        assert m and int(m.group(1)) >= 1
+        # the profiler took samples while armed over the drive
+        m = re.search(
+            r'emqx_xla_profiler_samples_total\{node="n1@host"\} (\d+)',
+            text,
+        )
+        assert m and int(m.group(1)) >= 1
+    finally:
+        obs.stop()

@@ -1,0 +1,166 @@
+#!/usr/bin/env python3
+"""In-repo fallback for the pyflakes-critical ruff selection
+(E9,F63,F7,F82) — the PR 8 static-gate satellite that kept skipping
+because the target image ships neither ruff nor mypy and the repo
+cannot pip-install at test time.
+
+This is NOT a ruff replacement: it implements exactly the four rule
+classes the gate names, each conservatively enough that a finding is a
+bug, never a style opinion:
+
+  * E9   — the file does not parse (`ast.parse` raises);
+  * F632 — `is` / `is not` comparison against a str/bytes/num literal
+           (identity on interned values: works by accident, breaks on
+           a different interpreter);
+  * F631 — `assert (cond, "msg")` — an assertion on a non-empty tuple
+           literal is always true, so the check silently never runs;
+  * F821 — a Name loaded in a module where that name is never BOUND
+           anywhere (no import, assignment, def/class, argument,
+           comprehension/with/except/for target, or global decl).
+           Whole-module flat binding scan: scoping subtleties can only
+           produce false NEGATIVES, so every hit is a real typo.
+           Modules with a wildcard import are skipped for this rule.
+
+Run it as a script (`python tools/static_check.py [paths...]`, exits
+non-zero on findings) or import `check_paths` from the static gate,
+which uses it whenever `ruff` is absent.
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+import pathlib
+import sys
+from typing import Iterable, List
+
+# names the interpreter binds implicitly at module/class scope
+_IMPLICIT = {
+    "__file__", "__name__", "__doc__", "__package__", "__spec__",
+    "__loader__", "__builtins__", "__debug__", "__path__", "__dict__",
+    "__module__", "__qualname__", "__class__", "__annotations__",
+}
+
+_LITERAL_CONST = (str, bytes, int, float, complex)
+
+# PEP 695 type-parameter nodes exist from 3.12 only
+_TYPE_PARAM_NODES = tuple(
+    getattr(ast, n)
+    for n in ("TypeVar", "ParamSpec", "TypeVarTuple")
+    if hasattr(ast, n)
+)
+
+
+def _bound_names(tree: ast.AST) -> set:
+    """Every name the module binds ANYWHERE, scope-flattened."""
+    bound = set(_IMPLICIT) | set(dir(builtins))
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name) and isinstance(
+            node.ctx, (ast.Store, ast.Del)
+        ):
+            bound.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            bound.add(node.name)
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for a in node.names:
+                bound.add((a.asname or a.name).split(".")[0])
+        elif isinstance(node, (ast.Global, ast.Nonlocal)):
+            bound.update(node.names)
+        elif isinstance(node, ast.arg):
+            bound.add(node.arg)
+        elif isinstance(node, ast.ExceptHandler) and node.name:
+            bound.add(node.name)
+        elif isinstance(node, ast.MatchAs) and node.name:
+            bound.add(node.name)
+        elif isinstance(node, ast.MatchStar) and node.name:
+            bound.add(node.name)
+        elif isinstance(node, ast.MatchMapping) and node.rest:
+            bound.add(node.rest)
+        elif isinstance(node, _TYPE_PARAM_NODES):
+            bound.add(node.name)
+    return bound
+
+
+def _has_wildcard_import(tree: ast.AST) -> bool:
+    return any(
+        isinstance(n, ast.ImportFrom)
+        and any(a.name == "*" for a in n.names)
+        for n in ast.walk(tree)
+    )
+
+
+def check_file(path: pathlib.Path) -> List[str]:
+    findings: List[str] = []
+    text = path.read_text()
+    try:
+        tree = ast.parse(text, filename=str(path))
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno}: E9 syntax error: {e.msg}"]
+    for node in ast.walk(tree):
+        # F632: identity comparison against a literal
+        if isinstance(node, ast.Compare):
+            ops_operands = zip(
+                node.ops, [node.left] + list(node.comparators),
+                node.comparators,
+            )
+            for op, lhs, rhs in ops_operands:
+                if not isinstance(op, (ast.Is, ast.IsNot)):
+                    continue
+                for side in (lhs, rhs):
+                    if isinstance(side, ast.Constant) and isinstance(
+                        side.value, _LITERAL_CONST
+                    ) and not isinstance(side.value, bool):
+                        findings.append(
+                            f"{path}:{node.lineno}: F632 `is` "
+                            f"comparison with a literal (use ==)"
+                        )
+                        break
+        # F631: assertion on a non-empty tuple is always true
+        if isinstance(node, ast.Assert) and isinstance(
+            node.test, ast.Tuple
+        ) and node.test.elts:
+            findings.append(
+                f"{path}:{node.lineno}: F631 assert on a tuple "
+                f"literal is always true"
+            )
+    # F821: names loaded but never bound anywhere in the module
+    if not _has_wildcard_import(tree):
+        bound = _bound_names(tree)
+        seen = set()  # one report per (name) per file keeps noise down
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Name)
+                and isinstance(node.ctx, ast.Load)
+                and node.id not in bound
+                and node.id not in seen
+            ):
+                seen.add(node.id)
+                findings.append(
+                    f"{path}:{node.lineno}: F821 undefined name "
+                    f"`{node.id}`"
+                )
+    return findings
+
+
+def check_paths(paths: Iterable[pathlib.Path]) -> List[str]:
+    findings: List[str] = []
+    for p in paths:
+        p = pathlib.Path(p)
+        if p.is_dir():
+            findings.extend(check_paths(sorted(p.rglob("*.py"))))
+        elif p.suffix == ".py":
+            findings.extend(check_file(p))
+    return findings
+
+
+def main(argv: List[str]) -> int:
+    targets = argv or ["emqx_tpu", "tests", "bench.py", "tools"]
+    findings = check_paths(pathlib.Path(t) for t in targets)
+    for f in findings:
+        print(f)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
