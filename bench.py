@@ -1716,6 +1716,190 @@ def bench_json(details):
 
 
 # --------------------------------------------------------------------------
+# the delivery engine: native ledger, native frame codec, window batch
+
+
+def bench_delivery(details):
+    """PR 19's three delivery legs, each against its Python twin:
+
+      * the delivery ledger (reserve/ack window cycle + the priority
+        mqueue overflow decision) — native/speedups.cc vs
+        PyDeliveryLedger, ≥3x gate;
+      * the MQTT frame codec (property-free PUBLISH encode + stream
+        decode) — native/frame.cc vs broker/frame.py, ≥3x gate;
+      * window dispatch — `publish_batch` through `dispatch_window`
+        vs the same messages as sequential `publish` calls on a twin
+        fan; reported as a ratio (the plan cache already amortizes
+        the per-publish probe, so this measures the grouped-write +
+        shared-plan savings, not a 10x)."""
+    from emqx_tpu import framec
+    from emqx_tpu.broker import frame as pyframe
+    from emqx_tpu.broker.delivery import (
+        PHASE_PUBACK,
+        NativeDeliveryLedger,
+        PyDeliveryLedger,
+        _load as load_delivery,
+    )
+    from emqx_tpu.broker.message import Message
+    from emqx_tpu.broker.packet import MQTT_V4, Publish, SubOpts
+    from emqx_tpu.broker.pubsub import Broker
+
+    row = {}
+
+    def timed(fn, n, reps=3):
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.time()
+            fn()
+            best = min(best, time.time() - t0)
+        return n / best
+
+    # --- ledger: the QoS1 serve cycle + the overflow decision ---------
+    mod = load_delivery()
+    if mod is None:
+        row["ledger"] = {"status": "native delivery legs unavailable"}
+        log("delivery ledger: native unavailable, leg skipped")
+    else:
+        N = 200_000 // (8 if SMALL else 1)
+
+        def cycle(led):
+            slot = led.open()
+            def run():
+                for _ in range(N):
+                    pid = led.reserve(slot, 1, 2.0, 32)
+                    led.ack(slot, pid, PHASE_PUBACK)
+                    led.enqueue(slot, 1, 1, 8, 1)
+                    led.popleft(slot)
+            rate = timed(run, N * 4)
+            led.close(slot)
+            return rate
+
+        with gc_off():
+            nat_rate = cycle(NativeDeliveryLedger(mod))
+            py_rate = cycle(PyDeliveryLedger())
+        ledger_x = nat_rate / py_rate
+        log(
+            f"delivery ledger: native {nat_rate:,.0f} ops/s vs twin "
+            f"{py_rate:,.0f} ops/s ({ledger_x:.2f}x)"
+        )
+        if not SMALL:
+            assert ledger_x >= 3.0, f"ledger {ledger_x:.2f}x < 3x gate"
+        row["ledger"] = {
+            "native_ops_per_sec": round(nat_rate, 1),
+            "python_ops_per_sec": round(py_rate, 1),
+            "ledger_speedup": round(ledger_x, 2),
+            "op_mix": "reserve+ack+enqueue+popleft",
+        }
+
+    # --- frame codec: encode + chunked stream decode ------------------
+    if framec.load() is None:
+        row["frame"] = {"status": "native frame codec unavailable"}
+        log("frame codec: native unavailable, leg skipped")
+    else:
+        pkts = [
+            Publish(topic=f"bench/{i}/t", payload=b"x" * (20 + i % 180),
+                    qos=i % 2, packet_id=(i % 0xFFFF) + 1 if i % 2 else None)
+            for i in range(64)
+        ]
+        N = 3000 // (8 if SMALL else 1)
+
+        def enc_loop(enc):
+            def run():
+                for _ in range(N):
+                    for p in pkts:
+                        enc(p, MQTT_V4)
+            return timed(run, N * len(pkts))
+
+        wire = b"".join(
+            pyframe._serialize_uncached(p, MQTT_V4) for p in pkts
+        )
+
+        def dec_loop(parser_cls):
+            def run():
+                for _ in range(N):
+                    parser_cls(proto_ver=MQTT_V4).feed(wire)
+            return timed(run, N * len(pkts))
+
+        with gc_off():
+            nat_enc = enc_loop(framec._encode_uncached)
+            py_enc = enc_loop(pyframe._serialize_uncached)
+            nat_dec = dec_loop(framec.Parser)
+            py_dec = dec_loop(pyframe.Parser)
+        enc_x, dec_x = nat_enc / py_enc, nat_dec / py_dec
+        log(
+            f"frame codec: encode {nat_enc:,.0f}/s vs {py_enc:,.0f}/s "
+            f"({enc_x:.2f}x); decode {nat_dec:,.0f}/s vs "
+            f"{py_dec:,.0f}/s ({dec_x:.2f}x)"
+        )
+        if not SMALL:
+            # decode compresses toward ~3x: both parsers pay the same
+            # CPython Packet construction per frame (the bench_json
+            # decode leg has the same shape) — floor it at 2.5x
+            assert enc_x >= 3.0, f"frame encode {enc_x:.2f}x < 3x gate"
+            assert dec_x >= 2.5, f"frame decode {dec_x:.2f}x < 2.5x floor"
+        row["frame"] = {
+            "native_encode_per_sec": round(nat_enc, 1),
+            "python_encode_per_sec": round(py_enc, 1),
+            "frame_encode_speedup": round(enc_x, 2),
+            "native_decode_per_sec": round(nat_dec, 1),
+            "python_decode_per_sec": round(py_dec, 1),
+            "frame_decode_speedup": round(dec_x, 2),
+        }
+
+    # --- window dispatch: publish_batch vs sequential publish ---------
+    NSUB = max(32, 256 // SHRINK)
+    NTOPIC = 8
+    B = 512 // (8 if SMALL else 1)
+
+    def fanned():
+        b = Broker(max_levels=8)
+        for i in range(NSUB):
+            s, _ = b.open_session(f"bd{i}", True)
+            s.outgoing_sink = lambda pkts: None
+            b.subscribe(s, f"bd/{i % NTOPIC}/+", SubOpts(qos=0))
+        return b
+
+    bseq, bwin = fanned(), fanned()
+    msgs = [
+        Message(topic=f"bd/{j % NTOPIC}/m", payload=b"x") for j in range(B)
+    ]
+    # warm both plan caches before timing
+    bseq.publish(Message(topic="bd/0/m", payload=b"w"))
+    bwin.publish_batch(msgs[:NTOPIC])
+
+    def seq_run():
+        for m in msgs:
+            bseq.publish(m)
+
+    def win_run():
+        bwin.publish_batch(msgs)
+
+    with gc_off():
+        seq_rate = timed(seq_run, B, reps=5)
+        win_rate = timed(win_run, B, reps=5)
+    batch_x = win_rate / seq_rate
+    log(
+        f"window dispatch: batched {win_rate:,.0f} pub/s vs sequential "
+        f"{seq_rate:,.0f} pub/s ({batch_x:.2f}x) at fan "
+        f"{NSUB // NTOPIC}"
+    )
+    if not SMALL:
+        assert batch_x >= 0.9, (
+            f"window dispatch {batch_x:.2f}x — batching must never "
+            f"cost ≥10% against the sequential path"
+        )
+    row["window_dispatch"] = {
+        "batched_pub_per_sec": round(win_rate, 1),
+        "sequential_pub_per_sec": round(seq_rate, 1),
+        "batch_dispatch_speedup": round(batch_x, 2),
+        "subs": NSUB,
+        "distinct_topics": NTOPIC,
+        "batch": B,
+    }
+    details["delivery_engine"] = row
+
+
+# --------------------------------------------------------------------------
 # kernel-telemetry overhead — instrumented hot path vs null collector
 
 
@@ -2802,7 +2986,7 @@ def bench_degraded(details):
     }
 
 
-def bench_soak(details, out_path="SOAK_r13.json"):
+def bench_soak(details, out_path="SOAK_r19.json"):
     """Million-session soak + chaos scenario stage (ISSUE 7+8): builds
     the two-node chaos engine, sustains the Zipf storm through the
     real pipelined broker, runs the fault catalog (row corruption,
@@ -2840,7 +3024,7 @@ def bench_soak(details, out_path="SOAK_r13.json"):
     return row
 
 
-def bench_profile(details, out_path="PROFILE_r17.json"):
+def bench_profile(details, out_path="PROFILE_r19.json"):
     """Delivery-path microscope artifact stage (ISSUE 17): drive the
     million-session Zipf storm through the standalone chaos engine
     with DENSE span sampling (1/8 instead of the production 1/1024)
@@ -3015,6 +3199,36 @@ def main():
     # --r14: the three new-workload stages alone (retained match,
     # batched WHERE, JSON codec) — commits BENCH_r14.json without
     # re-running the full matrix
+    # --r19: the delivery-engine stage alone (native ledger, native
+    # frame codec, window dispatch) — commits BENCH_r19.json without
+    # re-running the full matrix
+    if "--r19" in sys.argv:
+        bench_provenance(details, jax)
+        bench_delivery(details)
+        details["kernel_telemetry_counters"] = dict(TEL.counters)
+        with open("BENCH_r19.json", "w") as f:
+            json.dump(details, f, indent=1)
+        row = details["delivery_engine"]
+        print(
+            json.dumps(
+                {
+                    "metric": "delivery_ledger_speedup",
+                    "value": row["ledger"].get("ledger_speedup"),
+                    "unit": "x",
+                    "frame_encode_speedup": row["frame"].get(
+                        "frame_encode_speedup"
+                    ),
+                    "frame_decode_speedup": row["frame"].get(
+                        "frame_decode_speedup"
+                    ),
+                    "batch_dispatch_speedup": row["window_dispatch"][
+                        "batch_dispatch_speedup"
+                    ],
+                }
+            )
+        )
+        return
+
     if "--r14" in sys.argv:
         bench_provenance(details, jax)
         bench_retained(details)
@@ -3145,6 +3359,8 @@ def main():
     stage_done("rules_where")
     bench_json(details)
     stage_done("json_codec")
+    bench_delivery(details)
+    stage_done("delivery_engine")
     bench_insert(details)
     stage_done("route_churn")
     bench_telemetry_overhead(details)

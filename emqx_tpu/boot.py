@@ -189,6 +189,17 @@ class Node:
         from .jsonc import set_native_enabled
 
         set_native_enabled(bool(cfg.get("broker.perf.json_native")))
+        # wire-frame codec seam: same shape for the framec gate — every
+        # transport serialize/parse rides native/frame.cc with the
+        # Python codec replay on anything outside the native surface
+        from .framec import set_native_enabled as set_frame_native
+
+        set_frame_native(bool(cfg.get("broker.perf.frame_native")))
+        # native delivery ledger: per-session inflight/packet-id/
+        # overflow bookkeeping in the speedups.cc delivery_* legs
+        from .broker.delivery import set_native_enabled as set_delivery_native
+
+        set_delivery_native(bool(cfg.get("broker.perf.tpu_delivery_native")))
         self.broker = broker
 
         # 2. auth pipeline — chains/sources materialize from config

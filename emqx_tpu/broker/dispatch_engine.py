@@ -157,6 +157,15 @@ class _AggregateCount:
         if not self._fut.done():
             self._fut.set_exception(exc)
 
+    def add_many(self, total: int, k: int) -> None:
+        """Fold k publishes' combined count in ONE call — the window
+        dispatch completes a whole submit_many chunk per collect
+        instead of ticking set_result per publish."""
+        self._total += total
+        self._left -= k
+        if self._left <= 0 and not self._fut.done():
+            self._fut.set_result(self._total)
+
 
 class DispatchEngine:
     """One engine per Broker. All entry points must run on the
@@ -641,6 +650,9 @@ class DispatchEngine:
             self.publishes_total += len(batch)
             if topics:
                 self._recent_topics.append(topics[0])
+            # match_launch mark: topic encode + kernel dispatch — the
+            # submit-path cost the profiler used to file under `other`
+            STAGE_MARK.stage = "match_launch"
             try:
                 pending = router.match_filters_begin(topics, span=bspan)
             except Exception as e:
@@ -656,6 +668,7 @@ class DispatchEngine:
             # materializes on device while the match hash fetch for the
             # uncached remainder is still in flight
             fanout_pending = None
+            STAGE_MARK.stage = "plan_resolve"
             if (
                 broker._fanout_device
                 and pending.full_out is not None
@@ -687,6 +700,7 @@ class DispatchEngine:
                         fanout_pending.append(
                             (fkey, broker._fanout_clock, h)
                         )
+            STAGE_MARK.stage = ""
             t_launch = tel.clock()
             if self._ring_track_since is None:
                 self._ring_track_since = t_launch
@@ -782,6 +796,10 @@ class DispatchEngine:
         device_batch = pending.mode not in ("cached", "host")
         gc_tok = self._gc_pause()
         try:
+            # match_fetch mark: device->host transfer + unpack of the
+            # match result — the drain-path cost the profiler used to
+            # file under `other`
+            STAGE_MARK.stage = "match_fetch"
             t0 = tclock()
             try:
                 filter_lists = router.match_filters_finish(pending)
@@ -796,6 +814,7 @@ class DispatchEngine:
                 try:
                     filter_lists = router.match_filters_host(pending)
                 except Exception as e2:  # host truth failed: nothing left
+                    STAGE_MARK.stage = ""
                     tel.count("publish_failures_total", len(entries))
                     for _live, fut, _span in entries:
                         if not fut.done():
@@ -815,6 +834,7 @@ class DispatchEngine:
                         self._device_failure(None)
                     else:
                         self._device_success()
+            STAGE_MARK.stage = ""
             if fanout_pending is not None:
                 # install the overlapped plans before delivering: stamped
                 # with the clock captured at begin, so a mutation that
@@ -836,40 +856,68 @@ class DispatchEngine:
                     bspan.add("resolve", tclock() - t_res)
                 STAGE_MARK.stage = ""
             self._ring_land(tclock(), t_launch, pending.mode, len(entries))
-            fd = router.filter_dests
-            it = iter(filter_lists)
-            for live, fut, span in entries:
-                if live is None:
-                    n = 0  # hook-denied / intercepted: same 0 as publish()
+            # the vectorized delivery half: ONE window dispatch for the
+            # whole collected batch (plan resolution per unique filter
+            # set, session-grouped writes) instead of a per-publish
+            # _dispatch loop — see Broker.dispatch_window
+            results, meta = broker.dispatch_window(
+                [e[0] for e in entries],
+                filter_lists,
+                spans=[e[2] for e in entries],
+                capture_errors=True,
+            )
+            # aggregate completion: consecutive publishes sharing a
+            # submit_many aggregate fold into one add_many instead of a
+            # per-publish set_result tick
+            pend_fut = None
+            pend_total = 0
+            pend_k = 0
+
+            def _flush_agg() -> None:
+                nonlocal pend_fut, pend_total, pend_k
+                if pend_fut is None:
+                    return
+                if type(pend_fut) is _AggregateCount:
+                    pend_fut.add_many(pend_total, pend_k)
+                elif not pend_fut.done():
+                    pend_fut.set_result(pend_total)
+                pend_fut = None
+                pend_total = 0
+                pend_k = 0
+
+            for idx, (live, fut, span) in enumerate(entries):
+                n = results[idx]
+                if isinstance(n, BaseException):
+                    # a delivery-side failure is the publisher's to
+                    # see (host bug, not a device fault) — counted,
+                    # then propagated
+                    _flush_agg()
+                    tel.count("publish_failures_total")
+                    if not fut.done():
+                        fut.set_exception(n)
+                    continue
+                if live is not None and span is not None and st is not None:
+                    if bspan is not None:
+                        span.merge(bspan)
+                    st.finish_span(span)
+                    # shadow-oracle audit of exactly what was served:
+                    # the matched filter set + the (filter, dests)
+                    # pairs, stamped with the begin generation so churn
+                    # mid-flight skips rather than false-positives
+                    key, pairs = meta[idx]
+                    st.capture_audit(
+                        live.topic, key, pairs, pending.gen,
+                        span.trace_id,
+                    )
+                if fut is pend_fut:
+                    pend_total += n
+                    pend_k += 1
                 else:
-                    flts = next(it)
-                    pairs = [(f, fd(f)) for f in flts]
-                    t_del = tclock() if span is not None else 0.0
-                    try:
-                        n = broker._dispatch(live, pairs, span=span)
-                    except Exception as e:
-                        # a delivery-side failure is the publisher's to
-                        # see (host bug, not a device fault) — counted,
-                        # then propagated
-                        tel.count("publish_failures_total")
-                        if not fut.done():
-                            fut.set_exception(e)
-                        continue
-                    if span is not None and st is not None:
-                        span.add("deliver", tclock() - t_del)
-                        if bspan is not None:
-                            span.merge(bspan)
-                        st.finish_span(span)
-                        # shadow-oracle audit of exactly what was served:
-                        # the matched filter set + the (filter, dests)
-                        # pairs, stamped with the begin generation so churn
-                        # mid-flight skips rather than false-positives
-                        st.capture_audit(
-                            live.topic, tuple(flts), pairs, pending.gen,
-                            span.trace_id,
-                        )
-                if not fut.done():
-                    fut.set_result(n)
+                    _flush_agg()
+                    pend_fut = fut
+                    pend_total = n
+                    pend_k = 1
+            _flush_agg()
             self._batch_done(len(entries))
         finally:
             self._gc_resume(gc_tok)

@@ -28,7 +28,7 @@ from ..models.router import Router
 from ..models.shared_sub import SharedSubs
 from ..obs.profiler import STAGE_MARK
 from ..ops import topic as topic_mod
-from . import frame
+from .. import framec
 from .hooks import Hooks
 from .message import Message
 from .metrics import Metrics, Stats
@@ -486,14 +486,158 @@ class Broker:
             if eng is not None:
                 eng.note_device_failure(e)
             filter_lists = [router.match_filters(t) for t in topics]
-        fd = router.filter_dests
-        pair_sets = iter(
-            [(f, fd(f)) for f in flts] for flts in filter_lists
-        )
-        return [
-            self._dispatch(m, next(pair_sets)) if m is not None else 0
-            for m in live
+        results, _meta = self.dispatch_window(live, filter_lists)
+        return results
+
+    def dispatch_window(
+        self,
+        lives: Sequence[Optional[Message]],
+        filter_lists,
+        spans: Optional[Sequence] = None,
+        capture_errors: bool = False,
+    ):
+        """Batch-at-a-time dispatch of one coalesced window — the
+        delivery half of the vectorized publish path (the engine's ring
+        collect and publish_batch both land here):
+
+          * ONE matched-filter resolution and ONE fanout-plan probe per
+            unique filter set in the window, not per publish;
+          * publishes sharing a plan deliver through the grouped window
+            walk (_deliver_plan_window): shared-buffer writes grouped
+            per SESSION across the window's messages, and each
+            session's QoS bookkeeping batched into one ledger call
+            (Session.deliver_many);
+          * sampled publishes (spans[i] not None) take the per-publish
+            timed walk at their window position, so the stage
+            decomposition contract survives batching; per-topic
+            delivery order is preserved either way.
+
+        `filter_lists` carries one matched-filter list per non-None
+        live, in order (the match_filters_finish shape).  Returns
+        (results, meta): results[i] is lives[i]'s delivery count (0
+        where the hooks dropped it) or, when capture_errors, the
+        exception that publish's future should fail with; meta[i] is
+        (key, pairs) for the audit, shared across publishes that
+        matched the same filter set."""
+        fd = self.router.filter_dests
+        results: List = [0] * len(lives)
+        meta: List = [None] * len(lives)
+        groups: Dict[tuple, List[int]] = {}
+        pairs_by_key: Dict[tuple, list] = {}
+        it = iter(filter_lists)
+        for i, live in enumerate(lives):
+            if live is None:
+                continue
+            flts = next(it)
+            key = tuple(flts)
+            g = groups.get(key)
+            if g is None:
+                pairs_by_key[key] = [(f, fd(f)) for f in key]
+                groups[key] = g = []
+            g.append(i)
+            meta[i] = (key, pairs_by_key[key])
+        clock = self.router.telemetry.clock
+        for key, idxs in groups.items():
+            pairs = pairs_by_key[key]
+            # contiguous span-free publishes batch; a sampled publish
+            # breaks the run so per-topic order survives
+            runs: List[tuple] = []
+            for i in idxs:
+                if spans is not None and spans[i] is not None:
+                    runs.append(("one", i))
+                elif runs and runs[-1][0] == "batch":
+                    runs[-1][1].append(i)
+                else:
+                    runs.append(("batch", [i]))
+            for kind, val in runs:
+                if kind == "one":
+                    i = val
+                    span = spans[i]
+                    t0 = clock()
+                    try:
+                        n = self._dispatch(lives[i], pairs, span=span)
+                    except Exception as e:
+                        if not capture_errors:
+                            raise
+                        results[i] = e
+                        continue
+                    span.add("deliver", clock() - t0)
+                    results[i] = n
+                elif len(val) == 1:
+                    i = val[0]
+                    try:
+                        results[i] = self._dispatch(lives[i], pairs)
+                    except Exception as e:
+                        if not capture_errors:
+                            raise
+                        results[i] = e
+                else:
+                    try:
+                        self._dispatch_window_group(
+                            [lives[i] for i in val], val, pairs, key,
+                            results,
+                        )
+                    except Exception as e:
+                        if not capture_errors:
+                            raise
+                        for i in val:
+                            results[i] = e
+        return results, meta
+
+    def _dispatch_window_group(
+        self,
+        msgs: List[Message],
+        idxs: List[int],
+        pairs: Pairs,
+        key: tuple,
+        results: List,
+    ) -> None:
+        """Deliver a run of window publishes that share one matched
+        filter set: shared-group election stays per message (each
+        message elects its own member), the fanout plan resolves ONCE,
+        and the direct fan walks the window grouped by session."""
+        tel = self.router.telemetry
+        shared_counts = [
+            self._window_shared_leg(m, pairs, key) for m in msgs
         ]
+        entry = self._fanout_cache.get(key)
+        if entry is not None and self._plan_entry_fresh(entry, key):
+            if tel.enabled:
+                tel.count("fanout_plan_hits", len(msgs))
+            try:
+                fast = entry[2]
+            except IndexError:
+                fast = self._split_plan(entry[1])
+        else:
+            # the first publish pays the miss; the rest of the window
+            # would have hit — keep the counters per-publish-equivalent
+            if tel.enabled:
+                tel.count(
+                    "fanout_plan_stale" if entry is not None
+                    else "fanout_plan_misses"
+                )
+                if len(msgs) > 1:
+                    tel.count("fanout_plan_hits", len(msgs) - 1)
+            clock = self._fanout_clock
+            plan = self._resolve_plan(key, pairs)
+            fast = self._split_plan(plan)
+            self._fanout_cache_put(key, entry, clock, plan, fast)
+        counts = [0] * len(msgs)
+        self._fanout_window(msgs, fast, counts)
+        nd_total = 0
+        for j, i in enumerate(idxs):
+            nd = counts[j]
+            nd_total += nd
+            self._account_dispatch(msgs[j], shared_counts[j] + nd)
+            results[i] = shared_counts[j] + nd
+        if nd_total:
+            self.metrics.inc("messages.delivered", nd_total)
+
+    def _window_shared_leg(self, msg: Message, pairs: Pairs, key: tuple) -> int:
+        """The per-message leg a window group cannot batch: shared-group
+        election here; ClusterBroker overrides this with its remote
+        route (election is per message in both worlds)."""
+        return self._dispatch_shared_local(msg, pairs, key)
 
     def _pre_publish(self, msg: Message) -> Optional[Message]:
         self.metrics.inc("messages.received")
@@ -831,6 +975,178 @@ class Broker:
                 n += hi - i
         return n
 
+    def _fanout_window(
+        self, msgs: List[Message], fast: tuple, counts: List[int]
+    ) -> None:
+        """_fanout's window twin: shard the SESSION axis — each shard
+        delivers the whole window's messages to a slice of the fan, so
+        shard size shrinks with window width to keep per-turn delivery
+        work bounded by the same ~FANOUT_SHARD write budget. counts[j]
+        accumulates msgs[j]'s deliveries; deferred shards credit at
+        plan time, exactly like _fanout's `hi - i`."""
+        bcast, rest, other = fast
+        total = len(bcast) + len(rest) + len(other)
+        W = len(msgs)
+        wctx: dict = {}
+        per_shard = max(1, FANOUT_SHARD // W)
+        if total <= per_shard:
+            self._deliver_plan_window(msgs, fast, 0, total, wctx, counts)
+            return
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            loop = None
+        self._deliver_plan_window(msgs, fast, 0, per_shard, wctx, counts)
+        for i in range(per_shard, total, per_shard):
+            hi = min(i + per_shard, total)
+            if loop is None:
+                self._deliver_plan_window(msgs, fast, i, hi, wctx, counts)
+            else:
+                loop.call_soon(
+                    self._deliver_plan_window, msgs, fast, i, hi, wctx
+                )
+                step = hi - i
+                for j in range(W):
+                    counts[j] += step
+
+    def _deliver_plan_window(
+        self,
+        msgs: List[Message],
+        fast: tuple,
+        lo: int,
+        hi: int,
+        wctx: dict,
+        counts: Optional[List[int]] = None,
+    ) -> None:
+        """_deliver_plan's window twin: deliver a WINDOW of messages to
+        split-plan slice [lo, hi), grouped by session instead of by
+        message. The broadcast leg serializes the whole window into ONE
+        joined buffer per protocol version and lands it with ONE socket
+        write per subscriber; sessions that need real QoS bookkeeping
+        take ONE Session.deliver_many (one batched ledger reserve) for
+        the window instead of W deliver calls. Per-session packet order
+        is submission order — the same per-topic ordering contract as W
+        sequential _deliver_plan walks. counts is None on deferred
+        shards (already credited at plan time)."""
+        bcast, rest, other = fast
+        mark = STAGE_MARK
+        mark.stage = "dispatch_loop"
+        run_hook = self.hooks.has("message.delivered")
+        hooks_run = self.hooks.run_unobserved
+        W = len(msgs)
+        nb = len(bcast)
+        if lo < nb:
+            mark.stage = "session_write"
+            pkts0 = wctx.get("pkts0")
+            if pkts0 is None:
+                pkts0 = []
+                for m in msgs:
+                    p = Publish(
+                        topic=m.topic,
+                        payload=m.payload,
+                        qos=0,
+                        retain=False,
+                        packet_id=None,
+                        props=dict(m.props),
+                    )
+                    p._wire = {}  # opt into serialize memoization
+                    pkts0.append(p)
+                wctx["pkts0"] = pkts0
+                wctx["ptuple0"] = tuple(pkts0)
+            ptuple0 = wctx["ptuple0"]
+            wget = wctx.get
+            last_ver = None
+            data = None
+            hit = 0
+            for client, s, opts in bcast[lo:min(hi, nb)]:
+                if s.connected:
+                    sb = s.outgoing_sink_bytes
+                    if sb is not None:
+                        ver = s.sink_proto_ver
+                        if ver is not last_ver:
+                            data = wget(("b0", ver))
+                            if data is None:
+                                data = b"".join(
+                                    framec.serialize(p, ver) for p in pkts0
+                                )
+                                wctx[("b0", ver)] = data
+                            last_ver = ver
+                        if run_hook:
+                            for m in msgs:
+                                hooks_run("message.delivered", client, m)
+                        sb(data)
+                        hit += 1
+                        continue
+                    if run_hook:
+                        for m in msgs:
+                            hooks_run("message.delivered", client, m)
+                    sink = s.outgoing_sink
+                    if sink is not None:
+                        sink(ptuple0)
+                    hit += 1
+                    continue
+                # disconnected broadcast subscriber: one batched
+                # offline-queue decision for the whole window
+                packets = s.deliver_many([(m, opts) for m in msgs])
+                if run_hook:
+                    for m in msgs:
+                        hooks_run("message.delivered", client, m)
+                if packets:
+                    sink = s.outgoing_sink
+                    if sink is not None:
+                        sink(packets)
+                hit += 1
+            if counts is not None and hit:
+                for j in range(W):
+                    counts[j] += hit
+            mark.stage = "dispatch_loop"
+        m_end = nb + len(rest)
+        if hi > nb and lo < m_end:
+            for client, s, opts in rest[max(lo - nb, 0):min(hi, m_end) - nb]:
+                nl = opts.no_local
+                items = []
+                idx_js = []
+                for j, m in enumerate(msgs):
+                    if nl and m.from_client == client:
+                        continue
+                    items.append((m, opts))
+                    idx_js.append(j)
+                if not items:
+                    continue
+                packets = s.deliver_many(items)
+                if run_hook:
+                    for m, _o in items:
+                        hooks_run("message.delivered", client, m)
+                if packets:
+                    sink = s.outgoing_sink
+                    if sink is not None:
+                        sink(packets)
+                if counts is not None:
+                    for j in idx_js:
+                        counts[j] += 1
+        if hi > m_end:
+            sessions_get = self.sessions.get
+            for client, _flt, opts in other[max(lo - m_end, 0):hi - m_end]:
+                session = sessions_get(client)
+                if session is None:
+                    continue
+                nl = opts.no_local
+                for j, m in enumerate(msgs):
+                    if nl and m.from_client == client:
+                        continue
+                    # durable/exotic sessions keep the per-message
+                    # deliver: subclasses override it (persist gates)
+                    packets = session.deliver(m, opts)
+                    if run_hook:
+                        hooks_run("message.delivered", client, m)
+                    if packets:
+                        sink = getattr(session, "outgoing_sink", None)
+                        if sink is not None:
+                            sink(packets)
+                    if counts is not None:
+                        counts[j] += 1
+        mark.stage = ""
+
     def _shared_pkt(self, msg: Message, retain: bool, pkt_cache) -> tuple:
         pkt = Publish(
             topic=msg.topic,
@@ -898,7 +1214,7 @@ class Broker:
                         if ver is not last_ver:
                             data = cache_get((ver, False))
                             if data is None:
-                                data = frame.serialize(cached[0], ver)
+                                data = framec.serialize(cached[0], ver)
                                 pkt_cache[(ver, False)] = data
                             last_ver = ver
                         if run_hook:
@@ -946,7 +1262,7 @@ class Broker:
                         ver = s.sink_proto_ver
                         data = pkt_cache.get((ver, retain))
                         if data is None:
-                            data = frame.serialize(cached[0], ver)
+                            data = framec.serialize(cached[0], ver)
                             pkt_cache[(ver, retain)] = data
                         sb(data)
                     else:
@@ -1031,7 +1347,7 @@ class Broker:
                         if ver is not last_ver:
                             data = cache_get((ver, False))
                             if data is None:
-                                data = frame.serialize(cached[0], ver)
+                                data = framec.serialize(cached[0], ver)
                                 pkt_cache[(ver, False)] = data
                             last_ver = ver
                         sb(data)
@@ -1081,7 +1397,7 @@ class Broker:
                         ver = s.sink_proto_ver
                         data = pkt_cache.get((ver, retain))
                         if data is None:
-                            data = frame.serialize(cached[0], ver)
+                            data = framec.serialize(cached[0], ver)
                             pkt_cache[(ver, retain)] = data
                         sb(data)
                     else:

@@ -14,6 +14,7 @@ import asyncio
 import logging
 from typing import Dict, List, Optional
 
+from .. import framec
 from . import frame
 from .channel import Channel, ProtocolError
 from .limiter import ListenerLimits, LoadShedder
@@ -72,7 +73,7 @@ class Connection:
             max_packet_size=server.max_packet_size,
             mqtt_conf=server.mqtt_conf,
         )
-        self.parser = frame.Parser(max_packet_size=server.max_packet_size)
+        self.parser = framec.Parser(max_packet_size=server.max_packet_size)
         # per-connection limiter chains (client tier -> listener tier ->
         # node tier; the ?LIMITER_ROUTING check of emqx_channel.erl:751)
         self.pub_limiter = server.limits.publish_limiter()
@@ -134,7 +135,7 @@ class Connection:
             chunks = []
             limit = self.channel.client_max_packet
             for p in pkts:
-                wire = frame.serialize(p, ver)
+                wire = framec.serialize(p, ver)
                 # client's maximum_packet_size: drop, don't send
                 # (MQTT-5 §3.1.2.11.4; the reference counts
                 # 'delivery.dropped.too_large')
@@ -149,7 +150,7 @@ class Connection:
                     # it never received
                     sess = self.channel.session
                     if p.packet_id is not None and sess is not None:
-                        sess.inflight.pop(p.packet_id, None)
+                        sess.forget_inflight(p.packet_id)
                     continue
                 chunks.append(wire)
             self.transport.write(b"".join(chunks))

@@ -5,16 +5,27 @@ mqueue+inflight, emqx_mqueue.erl bounded priority queue, emqx_inflight.erl
 receive-maximum window, and the QoS2 awaiting_rel set of
 emqx_channel.erl:705-746) collapsed into one transport-agnostic object.
 The channel drives it with packets; it emits outgoing packets.
+
+The NUMERIC side of that state — packet-id allocation, window
+occupancy, ack phases, retry stamps, and the priority-aware mqueue
+overflow decision — lives in the process-global delivery ledger
+(broker/delivery.py: native `delivery_*` legs of speedups.cc, or the
+bit-exact Python twin).  This object keeps owning the messages:
+`inflight` stays the pid → entry mapping and `mqueue` the real deque;
+entry phase/dup/sent_at fields are observability mirrors of the
+ledger's authoritative copies.
 """
 
 from __future__ import annotations
 
 import time
+import weakref
 from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Deque, Dict, List, Optional, Tuple
 
 from ..obs.profiler import STAGE_MARK
+from . import delivery as _delivery
 from .message import Message
 from .packet import Publish, SubOpts
 
@@ -61,7 +72,6 @@ class Session:
         self.mqueue: Deque[Tuple[int, Message, SubOpts]] = deque()
         self.inflight: "OrderedDict[int, _InflightEntry]" = OrderedDict()
         self.awaiting_rel: Dict[int, float] = {}  # incoming QoS2 pids
-        self._next_pid = 1
         self.connected = True
         self.disconnected_at: Optional[float] = None
         # counters surfaced in stats/info
@@ -75,16 +85,14 @@ class Session:
         self.outgoing_sink_bytes = None
         self.sink_proto_ver = 4
         self.closer = None
-
-    # --- packet-id allocation ------------------------------------------
-
-    def alloc_packet_id(self) -> int:
-        for _ in range(0xFFFF):
-            pid = self._next_pid
-            self._next_pid = pid % 0xFFFF + 1
-            if pid not in self.inflight:
-                return pid
-        raise RuntimeError("no free packet id")
+        # delivery ledger binding: all pid/window/phase/queue-overflow
+        # arithmetic runs in the shared ledger slot; the finalizer
+        # returns the slot when the broker drops this session
+        self._ledger = _delivery.make_ledger()
+        self._dslot = self._ledger.open()
+        self._dslot_finalizer = weakref.finalize(
+            self, self._ledger.close, self._dslot
+        )
 
     # --- outgoing delivery ---------------------------------------------
 
@@ -105,14 +113,71 @@ class Session:
             return []
         if qos == 0:
             return [self._to_publish(eff, None)]
-        if len(self.inflight) >= self.cfg.receive_maximum:
+        now = time.time()
+        pid = self._ledger.reserve(
+            self._dslot, qos, now, self.cfg.receive_maximum
+        )
+        if pid == 0:  # window full
             self._enqueue(eff, subopts)
             return []
-        pid = self.alloc_packet_id()
         self.inflight[pid] = _InflightEntry(
-            eff, "puback" if qos == 1 else "pubrec", time.time()
+            eff, "puback" if qos == 1 else "pubrec", now
         )
         return [self._to_publish(eff, pid)]
+
+    def deliver_many(self, items: List[Tuple[Message, SubOpts]]) -> List[Publish]:
+        """Window-batched deliver: semantically a `deliver()` per item
+        in order — same option walk, same packets, same queue behavior
+        (oracle-checked in tests/test_delivery_engine.py) — but every
+        inflight reservation for the window rides ONE batched ledger
+        call (`delivery_reserve_many`) instead of a per-message leg.
+        The broker's window dispatch calls this once per (session,
+        dispatch window)."""
+        if len(items) == 1:
+            return self.deliver(items[0][0], items[0][1])
+        out: List[Optional[Publish]] = []
+        resv: List[Tuple[int, Message, SubOpts]] = []  # (out idx, eff, opts)
+        upgrade = self.cfg.upgrade_qos
+        for msg, subopts in items:
+            qos = (
+                max(msg.qos, subopts.qos)
+                if upgrade
+                else min(msg.qos, subopts.qos)
+            )
+            if subopts.no_local and msg.from_client == self.client_id:
+                continue
+            eff = Message(**{**msg.__dict__})
+            eff.qos = qos
+            if not subopts.retain_as_published:
+                eff.retain = False
+            if not self.connected:
+                # connected is constant across the window, so enqueue
+                # order stays item order (nothing reserves below)
+                self._enqueue(eff, subopts)
+                continue
+            if qos == 0:
+                out.append(self._to_publish(eff, None))
+                continue
+            out.append(None)  # placeholder keeps packet order exact
+            resv.append((len(out) - 1, eff, subopts))
+        if resv:
+            now = time.time()
+            slot = self._dslot
+            pids = self._ledger.reserve_many(
+                [slot] * len(resv),
+                [e.qos for _i, e, _o in resv],
+                now,
+                [self.cfg.receive_maximum] * len(resv),
+            )
+            for (pos, eff, subopts), pid in zip(resv, pids):
+                if pid == 0:  # window full at this item's turn
+                    self._enqueue(eff, subopts)
+                    continue
+                self.inflight[pid] = _InflightEntry(
+                    eff, "puback" if eff.qos == 1 else "pubrec", now
+                )
+                out[pos] = self._to_publish(eff, pid)
+        return [p for p in out if p is not None]
 
     def _queue_priority(self, msg: Message) -> int:
         return self.cfg.mqueue_priorities.get(
@@ -130,34 +195,32 @@ class Session:
             self.dropped += 1
             return
         prio = self._queue_priority(msg)
-        if len(self.mqueue) >= self.cfg.max_mqueue_len:
-            # emqx_mqueue overflow, priority-aware: shed from the
-            # LOWEST priority class, never to admit something lower.
-            # 1) prefer a QoS0 victim of <= incoming priority (tail =
-            #    lowest first); 2) else any strictly-lower-priority
-            #    tail entry; 3) else the INCOMING message is the
-            #    lowest-value item — drop it.
-            victim = None
-            for i in range(len(self.mqueue) - 1, -1, -1):
-                if self.mqueue[i][1].qos == 0 and self.mqueue[i][0] <= prio:
-                    victim = i
-                    break
-            if victim is None and self.mqueue and self.mqueue[-1][0] < prio:
-                victim = len(self.mqueue) - 1
-            if victim is None:
-                self.dropped += 1
-                return
-            del self.mqueue[victim]
+        # emqx_mqueue admission, priority-aware: the ledger's shadow
+        # queue decides — shed from the LOWEST priority class, never
+        # to admit something lower (QoS0 victims first, then a
+        # strictly-lower-priority tail entry, else drop the incoming) —
+        # and hands back where the real deque mutates
+        packed = self._ledger.enqueue(
+            self._dslot,
+            prio,
+            msg.qos,
+            self.cfg.max_mqueue_len,
+            1 if self.cfg.mqueue_priorities else 0,
+        )
+        action = packed & 0x3
+        if action == 0:
             self.dropped += 1
-        if not self.cfg.mqueue_priorities or not self.mqueue:
-            self.mqueue.append((prio, msg, subopts))
             return
-        # priority queue (emqx_pqueue analog): keep the deque sorted by
-        # non-increasing priority, FIFO within a priority class
-        i = len(self.mqueue)
-        while i > 0 and self.mqueue[i - 1][0] < prio:
-            i -= 1
-        self.mqueue.insert(i, (prio, msg, subopts))
+        if action == 2:
+            del self.mqueue[packed >> 32]
+            self.dropped += 1
+        idx = (packed >> 2) & 0x3FFFFFFF
+        if idx == len(self.mqueue):
+            self.mqueue.append((prio, msg, subopts))
+        else:
+            # priority queue (emqx_pqueue analog): non-increasing
+            # priority order, FIFO within a priority class
+            self.mqueue.insert(idx, (prio, msg, subopts))
 
     def _to_publish(self, msg: Message, pid: Optional[int]) -> Publish:
         props = dict(msg.props)
@@ -178,22 +241,27 @@ class Session:
         # wall time is measured by the channel's sampled ack clock)
         STAGE_MARK.stage = "ack_sweep"
         out: List[Publish] = []
+        led, slot = self._ledger, self._dslot
         while self.mqueue:
             _prio, msg, subopts = self.mqueue[0]
             if msg.expired():
                 self.mqueue.popleft()
+                led.popleft(slot)
                 self.dropped += 1
                 continue
             if msg.qos == 0:
                 self.mqueue.popleft()
+                led.popleft(slot)
                 out.append(self._to_publish(msg, None))
                 continue
-            if len(self.inflight) >= self.cfg.receive_maximum:
+            now = time.time()
+            pid = led.reserve(slot, msg.qos, now, self.cfg.receive_maximum)
+            if pid == 0:  # window full
                 break
             self.mqueue.popleft()
-            pid = self.alloc_packet_id()
+            led.popleft(slot)
             self.inflight[pid] = _InflightEntry(
-                msg, "puback" if msg.qos == 1 else "pubrec", time.time()
+                msg, "puback" if msg.qos == 1 else "pubrec", now
             )
             out.append(self._to_publish(msg, pid))
         STAGE_MARK.stage = ""
@@ -202,41 +270,51 @@ class Session:
     # --- outgoing acks --------------------------------------------------
 
     def on_puback(self, pid: int) -> bool:
-        e = self.inflight.get(pid)
-        if e is None or e.phase != "puback":
+        if not self._ledger.ack(self._dslot, pid, _delivery.PHASE_PUBACK):
             return False
-        del self.inflight[pid]
+        self.inflight.pop(pid, None)
         return True
 
     def on_pubrec(self, pid: int) -> bool:
-        e = self.inflight.get(pid)
-        if e is None or e.phase != "pubrec":
+        if not self._ledger.ack(self._dslot, pid, _delivery.PHASE_PUBREC):
             return False
-        e.phase = "pubcomp"
-        e.msg = Message(topic=e.msg.topic)  # payload released (rel marker)
+        e = self.inflight.get(pid)
+        if e is not None:
+            e.phase = "pubcomp"
+            e.msg = Message(topic=e.msg.topic)  # payload released (rel marker)
         return True
 
     def on_pubcomp(self, pid: int) -> bool:
-        e = self.inflight.get(pid)
-        if e is None or e.phase != "pubcomp":
+        if not self._ledger.ack(self._dslot, pid, _delivery.PHASE_PUBCOMP):
             return False
-        del self.inflight[pid]
+        self.inflight.pop(pid, None)
         return True
+
+    def forget_inflight(self, pid: int) -> bool:
+        """Release an inflight slot unconditionally — the transport's
+        drop-too-large path: the client never received the packet, so
+        no ack will ever free the window entry."""
+        self._ledger.forget(self._dslot, pid)
+        return self.inflight.pop(pid, None) is not None
 
     def retry(self, now: Optional[float] = None) -> List[Publish]:
         """Re-send unacked QoS1/2 after retry_interval (dup=1)."""
         STAGE_MARK.stage = "ack_sweep"
         now = now if now is not None else time.time()
         out = []
-        for pid, e in self.inflight.items():
-            if now - e.sent_at >= self.cfg.retry_interval:
-                e.sent_at = now
-                e.dup = True
-                if e.phase in ("puback", "pubrec"):
-                    p = self._to_publish(e.msg, pid)
-                    p.dup = True
-                    out.append(p)
-                # phase 'pubcomp': PUBREL retransmit handled by channel
+        for pid, phase in self._ledger.retry_due(
+            self._dslot, now, self.cfg.retry_interval
+        ):
+            e = self.inflight.get(pid)
+            if e is None:
+                continue
+            e.sent_at = now
+            e.dup = True
+            if phase != _delivery.PHASE_PUBCOMP:
+                p = self._to_publish(e.msg, pid)
+                p.dup = True
+                out.append(p)
+            # phase 'pubcomp': PUBREL retransmit handled by channel
         STAGE_MARK.stage = ""
         return out
 
@@ -267,9 +345,13 @@ class Session:
         self.connected = True
         self.disconnected_at = None
         out = []
-        for pid, e in self.inflight.items():
-            e.sent_at = time.time()
-            if e.phase in ("puback", "pubrec"):
+        now = time.time()
+        for pid, phase in self._ledger.touch_all(self._dslot, now):
+            e = self.inflight.get(pid)
+            if e is None:
+                continue
+            e.sent_at = now
+            if phase != _delivery.PHASE_PUBCOMP:
                 p = self._to_publish(e.msg, pid)
                 p.dup = True
                 out.append(p)

@@ -113,6 +113,15 @@ class ClusterBroker(Broker):
         self._account_dispatch(msg, n)
         return n
 
+    def _window_shared_leg(self, msg: Message, pairs, key) -> int:
+        """Window-group twin of the _dispatch override: the per-message
+        cluster legs (remote-node forwarding + cluster-wide shared
+        election) stay per message; the local direct fan batches."""
+        node = self.node
+        if node is None:
+            return super()._window_shared_leg(msg, pairs, key)
+        return node.route_remote(msg)
+
     def dispatch_forwarded(self, msg: Message) -> int:
         """Peer leg of a forward: deliver to LOCAL direct subscribers
         only — no re-forwarding, no shared election (the publisher

@@ -223,6 +223,22 @@ def broker_schema() -> Struct:
                                     "json_native": Field(
                                         Bool(), default=True
                                     ),
+                                    # native wire-frame codec behind
+                                    # the framec seam (r19): PUBLISH/
+                                    # ack/SUBACK encode+decode in C,
+                                    # Python codec for everything else
+                                    "frame_native": Field(
+                                        Bool(), default=True
+                                    ),
+                                    # native delivery ledger (r19):
+                                    # per-session inflight-window,
+                                    # packet-id and queue-overflow
+                                    # bookkeeping in native/speedups.cc
+                                    # delivery_* legs (Python twin when
+                                    # off or unavailable)
+                                    "tpu_delivery_native": Field(
+                                        Bool(), default=True
+                                    ),
                                     # pipelined dispatch engine
                                     # (broker/dispatch_engine.py): the
                                     # micro-batch closes at queue_depth
@@ -362,6 +378,14 @@ def broker_schema() -> Struct:
                                     ),
                                     "tpu_audit_quarantine": Field(
                                         Bool(), default=True
+                                    ),
+                                    # sentinel warmup exclusion: the
+                                    # first N sampled spans (XLA
+                                    # compile warmup) are exemplar'd
+                                    # but kept out of the serve-stage
+                                    # histograms and SLO (0 disables)
+                                    "tpu_warmup_sample_skip": Field(
+                                        Int(min=0), default=2
                                     ),
                                     # SLO objectives: publish-latency
                                     # threshold + success targets, with

@@ -132,6 +132,9 @@ class Observability:
                 slo_burn_threshold=_cfg(
                     config, "broker.perf.tpu_slo_burn_threshold", 10.0
                 ),
+                warmup_spans=_cfg(
+                    config, "broker.perf.tpu_warmup_sample_skip", 2
+                ),
             )
             broker.sentinel = self.sentinel
         # delivery-path microscope (obs/profiler.py): the sampling

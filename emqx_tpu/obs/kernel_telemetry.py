@@ -105,6 +105,8 @@ class StreamingHistogram:
 
     __slots__ = ("bounds", "counts", "total", "sum")
 
+    unit = "seconds"
+
     def __init__(self, bounds: Sequence[float] = BOUNDS):
         self.bounds = tuple(bounds)
         self.counts = [0] * (len(self.bounds) + 1)  # [+Inf] overflow last
@@ -159,6 +161,29 @@ class StreamingHistogram:
         }
 
 
+class CountHistogram(StreamingHistogram):
+    """Unitless twin for SIZE distributions (fanout width, batch
+    occupancy): same streaming ladder machinery, but the snapshot
+    reports raw quantiles — `p50`, not `p50_ms` — so a subscriber
+    count can never render as six seconds of latency (the r17
+    `emqx_xla_delivery_fan` abuse, ISSUE 19 satellite), and the
+    exposition `_sum` drops the nanosecond padding."""
+
+    __slots__ = ()
+
+    unit = "count"
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "count": self.total,
+            "sum": round(self.sum, 3),
+            "p50": round(self.percentile(50), 3),
+            "p99": round(self.percentile(99), 3),
+            "p999": round(self.percentile(99.9), 3),
+            "clamp_saturated": self.clamp_saturated(),
+        }
+
+
 def _fmt_le(v: float) -> str:
     return format(v, "g")
 
@@ -183,7 +208,12 @@ def render_histogram_lines(
         cum += c
         lines.append(f'{fam}_bucket{{{label_str},le="{_fmt_le(le)}"}} {cum}')
     lines.append(f'{fam}_bucket{{{label_str},le="+Inf"}} {h.total}')
-    lines.append(f"{fam}_sum{{{label_str}}} {h.sum:.9f}")
+    # seconds histograms keep nanosecond precision; unitless (count)
+    # histograms render their sum as a plain number
+    if h.unit == "seconds":
+        lines.append(f"{fam}_sum{{{label_str}}} {h.sum:.9f}")
+    else:
+        lines.append(f"{fam}_sum{{{label_str}}} {_fmt_le(h.sum)}")
     lines.append(f"{fam}_count{{{label_str}}} {h.total}")
 
 

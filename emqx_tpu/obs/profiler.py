@@ -303,11 +303,20 @@ class SamplingProfiler:
                 for st, bucket in sorted(self.stacks.items())
             }
             n_stacks = sum(len(b) for b in self.stacks.values())
+        other = per_stage.get("other", 0)
+        total = self.samples_total
         return {
             "running": self.running,
             "hz": self.hz,
             "samples_total": self.samples_total,
             "cpu_samples_total": self.cpu_samples_total,
+            # fraction of samples landing in a NAMED stage bucket: the
+            # attribution contract (ISSUE 19 satellite) — `other` held
+            # 1898/1910 of r17's samples before the storm-gen/launch/
+            # fetch marks
+            "attributed_ratio": (
+                round((total - other) / total, 4) if total else 0.0
+            ),
             "unique_stacks": n_stacks,
             "overflow_total": self.overflow_total,
             "missed_thread_total": self.missed_thread_total,

@@ -104,6 +104,17 @@ def prometheus_text(broker, node_name: str = "emqx@127.0.0.1", obs=None) -> str:
     from ..jsonc import JSON_METRICS
 
     lines.extend(JSON_METRICS.prometheus_lines(node_name))
+    # wire-frame codec seam ledger (emqx_frame_* namespace — process-
+    # global like jsonc's: the counted fallback IS the parity story,
+    # so it must render even before a broker object exists)
+    from ..framec import FRAME_METRICS
+
+    lines.extend(FRAME_METRICS.prometheus_lines(node_name))
+    # native delivery-ledger seam (emqx_delivery_* namespace): the
+    # native/twin split and per-op fallbacks on every scrape
+    from ..broker.delivery import DELIVERY_METRICS
+
+    lines.extend(DELIVERY_METRICS.prometheus_lines(node_name))
     # retainer surface (emqx_retainer_* namespace — the max_retained
     # drop and expiry sweep were previously invisible)
     retainer = getattr(broker, "retainer", None)

@@ -55,7 +55,11 @@ import time
 from collections import deque
 from typing import Any, Deque, Dict, List, Optional, Tuple
 
-from .kernel_telemetry import StreamingHistogram, render_histogram_lines
+from .kernel_telemetry import (
+    CountHistogram,
+    StreamingHistogram,
+    render_histogram_lines,
+)
 from .profiler import DELIVERY_STAGES
 
 log = logging.getLogger("emqx_tpu.obs.sentinel")
@@ -249,6 +253,7 @@ class PublishSentinel:
         slo_burn_threshold: float = 10.0,
         max_pending_audits: int = 64,
         max_exemplars: int = 32,
+        warmup_spans: int = 0,
     ):
         self.broker = broker
         self.router = broker.router
@@ -264,7 +269,9 @@ class PublishSentinel:
         # wall split into DELIVERY_STAGES, plus the fan-size histogram
         # and the sum-to-wall self-check counters
         self.delivery_hist: Dict[str, StreamingHistogram] = {}
-        self.fan_hist = StreamingHistogram(bounds=FAN_BOUNDS)
+        # fan width is a COUNT: the unitless histogram keeps it from
+        # ever rendering as milliseconds (p50_ms 6000.0 for fan 6, r17)
+        self.fan_hist = CountHistogram(bounds=FAN_BOUNDS)
         # broker.perf.tpu_delivery_stages gate: False parks the
         # sub-stage histograms (spans still carry publish stages)
         self.delivery_stages_enabled = True
@@ -289,6 +296,14 @@ class PublishSentinel:
                 burn_threshold=slo_burn_threshold,
             ),
         }
+        # warmup exclusion (ISSUE 19 satellite): the first sampled
+        # spans ride XLA compile/cache-donation warmup — r17's 723ms
+        # kernel p999 was one jit compile, not a serve-path stall.
+        # The first `warmup_spans` finished spans are counted and
+        # exemplar'd but kept OUT of the serve-stage histograms/SLO.
+        # 0 (the bare-broker default) disables the exclusion.
+        self.warmup_left = max(0, int(warmup_spans))
+        self.warmup_skipped = 0
         self._tick = 0
         self._ack_tick = 0
         self._slo_tick = 0
@@ -356,6 +371,29 @@ class PublishSentinel:
     # --- stage attribution -----------------------------------------------
 
     def finish_span(self, span: StageSpan) -> None:
+        if self.warmup_left > 0:
+            # compile-warmup span: visible as an exemplar (honestly
+            # flagged), excluded from the serve-stage stats
+            self.warmup_left -= 1
+            self.warmup_skipped += 1
+            total = span.total()
+            self.exemplars.append(
+                {
+                    "topic": span.topic,
+                    "trace_id": span.trace_id,
+                    "total_ms": round(total * 1e3, 4),
+                    "stages_ms": {
+                        k: round(v * 1e3, 4)
+                        for k, v in span.stages.items()
+                    },
+                    "subs_ms": {
+                        k: round(v * 1e3, 4) for k, v in span.subs.items()
+                    },
+                    "fan": span.fan,
+                    "warmup": True,
+                }
+            )
+            return
         for stage, s in span.stages.items():
             h = self.stage_hist.get(stage)
             if h is None:
@@ -584,6 +622,7 @@ class PublishSentinel:
         return {
             "sampled_publishes": self.spans_total,
             "sample_n": self.sample_n,
+            "warmup_skipped": self.warmup_skipped,
             "total": self.total_hist.snapshot(),
             "stages": {
                 s: self.stage_hist[s].snapshot()

@@ -24,6 +24,7 @@
 #include <cstdint>
 #include <cstring>
 #include <initializer_list>
+#include <vector>
 
 namespace {
 
@@ -1717,6 +1718,461 @@ static PyObject *del_route_core(PyObject *, PyObject *const *args,
 }
 
 // ---------------------------------------------------------------------
+// delivery ledger (delivery_*) — the per-session QoS bookkeeping of
+// broker/session.py as slot arrays behind one capsule handle (the
+// churn-engine discipline): inflight window entries (packet id, phase,
+// dup, sent_at) in insertion order, packet-id allocation with the
+// exact wraparound walk of Session.alloc_packet_id, and the
+// priority-aware mqueue overflow decision over a (prio, qos) shadow of
+// the Python deque.  Messages stay on the Python side (Session.inflight
+// maps pid -> message); this engine owns only the numeric state, and
+// broker/delivery.py holds the bit-exact Python twin the parity tests
+// fuzz against.  Config scalars (receive_maximum, max_mqueue_len,
+// priority flag) ride each call so the Python SessionConfig stays the
+// single source of truth.
+
+// phase codes: 0 awaiting PUBACK, 1 awaiting PUBREC, 2 awaiting PUBCOMP
+struct DEnt {
+  int32_t pid;
+  int8_t phase;
+  int8_t dup;
+  double sent_at;
+};
+
+struct DSlot {
+  bool used = false;
+  int32_t next_pid = 1;
+  std::vector<DEnt> infl;       // insertion order (OrderedDict analog)
+  std::vector<uint16_t> q;      // prio << 2 | qos, from qhead
+  size_t qhead = 0;
+};
+
+struct DeliveryLedger {
+  std::vector<DSlot> slots;
+  std::vector<int32_t> freelist;
+};
+
+static const char *kDeliveryName = "emqx_tpu.delivery_ledger";
+
+static void delivery_capsule_free(PyObject *cap) {
+  delete (DeliveryLedger *)PyCapsule_GetPointer(cap, kDeliveryName);
+}
+
+static PyObject *delivery_make_handle(PyObject *, PyObject *) {
+  auto *l = new DeliveryLedger();
+  PyObject *cap = PyCapsule_New(l, kDeliveryName, delivery_capsule_free);
+  if (!cap) {
+    delete l;
+    return nullptr;
+  }
+  return cap;
+}
+
+static DeliveryLedger *dledger(PyObject *cap) {
+  return (DeliveryLedger *)PyCapsule_GetPointer(cap, kDeliveryName);
+}
+
+static DSlot *dslot(PyObject *cap, long slot) {
+  DeliveryLedger *l = dledger(cap);
+  if (!l) return nullptr;
+  if (slot < 0 || (size_t)slot >= l->slots.size() ||
+      !l->slots[slot].used) {
+    PyErr_SetString(PyExc_ValueError, "bad delivery slot");
+    return nullptr;
+  }
+  return &l->slots[slot];
+}
+
+static PyObject *delivery_open(PyObject *, PyObject *args) {
+  PyObject *cap;
+  if (!PyArg_ParseTuple(args, "O", &cap)) return nullptr;
+  DeliveryLedger *l = dledger(cap);
+  if (!l) return nullptr;
+  int32_t slot;
+  if (!l->freelist.empty()) {
+    slot = l->freelist.back();
+    l->freelist.pop_back();
+  } else {
+    slot = (int32_t)l->slots.size();
+    l->slots.emplace_back();
+  }
+  DSlot &s = l->slots[slot];
+  s.used = true;
+  s.next_pid = 1;
+  s.infl.clear();
+  s.q.clear();
+  s.qhead = 0;
+  return PyLong_FromLong(slot);
+}
+
+static PyObject *delivery_close(PyObject *, PyObject *args) {
+  PyObject *cap;
+  long slot;
+  if (!PyArg_ParseTuple(args, "Ol", &cap, &slot)) return nullptr;
+  DeliveryLedger *l = dledger(cap);
+  if (!l) return nullptr;
+  if (slot >= 0 && (size_t)slot < l->slots.size() && l->slots[slot].used) {
+    DSlot &s = l->slots[slot];
+    s.used = false;
+    s.infl.clear();
+    s.infl.shrink_to_fit();
+    s.q.clear();
+    s.q.shrink_to_fit();
+    s.qhead = 0;
+    l->freelist.push_back((int32_t)slot);
+  }
+  Py_RETURN_NONE;
+}
+
+// the exact wraparound walk of Session.alloc_packet_id: advance
+// next_pid per CANDIDATE (occupied or not); -1 when all 65535 taken
+static int32_t d_alloc_pid(DSlot &s) {
+  for (int i = 0; i < 0xFFFF; i++) {
+    int32_t pid = s.next_pid;
+    s.next_pid = pid % 0xFFFF + 1;
+    bool taken = false;
+    for (const DEnt &e : s.infl)
+      if (e.pid == pid) {
+        taken = true;
+        break;
+      }
+    if (!taken) return pid;
+  }
+  return -1;
+}
+
+static long d_reserve_one(DSlot &s, long qos, double now, long recv_max) {
+  if ((long)s.infl.size() >= recv_max) return 0;
+  int32_t pid = d_alloc_pid(s);
+  if (pid < 0) return -1;
+  s.infl.push_back(DEnt{pid, (int8_t)(qos == 1 ? 0 : 1), 0, now});
+  return pid;
+}
+
+// delivery_reserve(handle, slot, qos, now, recv_max) -> pid | 0 (window
+// full); raises RuntimeError when every packet id is inflight
+static PyObject *delivery_reserve(PyObject *, PyObject *const *args,
+                                  Py_ssize_t nargs) {
+  if (nargs != 5) {
+    PyErr_SetString(PyExc_TypeError,
+                    "delivery_reserve(handle, slot, qos, now, recv_max)");
+    return nullptr;
+  }
+  long slot = PyLong_AsLong(args[1]);
+  if (slot == -1 && PyErr_Occurred()) return nullptr;
+  DSlot *s = dslot(args[0], slot);
+  if (!s) return nullptr;
+  long qos = PyLong_AsLong(args[2]);
+  double now = PyFloat_AsDouble(args[3]);
+  long recv_max = PyLong_AsLong(args[4]);
+  if (PyErr_Occurred()) return nullptr;
+  long pid = d_reserve_one(*s, qos, now, recv_max);
+  if (pid < 0) {
+    PyErr_SetString(PyExc_RuntimeError, "no free packet id");
+    return nullptr;
+  }
+  return PyLong_FromLong(pid);
+}
+
+// delivery_reserve_many(handle, slots, qoses, now, recv_maxes) -> list
+// of pids (0 = that session's window is full) — the one-call-per-
+// dispatch-window leg the batched QoS fanout rides
+static PyObject *delivery_reserve_many(PyObject *, PyObject *args) {
+  PyObject *cap, *slots_o, *qoses_o, *rmax_o;
+  double now;
+  if (!PyArg_ParseTuple(args, "OOOdO", &cap, &slots_o, &qoses_o, &now,
+                        &rmax_o))
+    return nullptr;
+  DeliveryLedger *l = dledger(cap);
+  if (!l) return nullptr;
+  PyObject *slots = PySequence_Fast(slots_o, "slots must be a sequence");
+  if (!slots) return nullptr;
+  PyObject *qoses = PySequence_Fast(qoses_o, "qoses must be a sequence");
+  if (!qoses) {
+    Py_DECREF(slots);
+    return nullptr;
+  }
+  PyObject *rmaxes = PySequence_Fast(rmax_o, "recv_maxes must be a sequence");
+  if (!rmaxes) {
+    Py_DECREF(slots);
+    Py_DECREF(qoses);
+    return nullptr;
+  }
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(slots);
+  PyObject *out = PyList_New(n);
+  if (!out) goto fail;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    long slot = PyLong_AsLong(PySequence_Fast_GET_ITEM(slots, i));
+    long qos = PyLong_AsLong(PySequence_Fast_GET_ITEM(qoses, i));
+    long rmax = PyLong_AsLong(PySequence_Fast_GET_ITEM(rmaxes, i));
+    if (PyErr_Occurred()) goto fail;
+    if (slot < 0 || (size_t)slot >= l->slots.size() ||
+        !l->slots[slot].used) {
+      PyErr_SetString(PyExc_ValueError, "bad delivery slot");
+      goto fail;
+    }
+    long pid = d_reserve_one(l->slots[slot], qos, now, rmax);
+    if (pid < 0) {
+      PyErr_SetString(PyExc_RuntimeError, "no free packet id");
+      goto fail;
+    }
+    PyObject *v = PyLong_FromLong(pid);
+    if (!v) goto fail;
+    PyList_SET_ITEM(out, i, v);
+  }
+  Py_DECREF(slots);
+  Py_DECREF(qoses);
+  Py_DECREF(rmaxes);
+  return out;
+fail:
+  Py_DECREF(slots);
+  Py_DECREF(qoses);
+  Py_DECREF(rmaxes);
+  Py_XDECREF(out);
+  return nullptr;
+}
+
+// delivery_ack(handle, slot, pid, kind) -> 1 | 0; kind 0 PUBACK
+// (phase 0, delete), 1 PUBREC (phase 1 -> 2), 2 PUBCOMP (phase 2,
+// delete).  Order-preserving erase keeps retry iteration identical to
+// the OrderedDict walk.
+static PyObject *delivery_ack(PyObject *, PyObject *const *args,
+                              Py_ssize_t nargs) {
+  if (nargs != 4) {
+    PyErr_SetString(PyExc_TypeError,
+                    "delivery_ack(handle, slot, pid, kind)");
+    return nullptr;
+  }
+  long slot = PyLong_AsLong(args[1]);
+  if (slot == -1 && PyErr_Occurred()) return nullptr;
+  DSlot *s = dslot(args[0], slot);
+  if (!s) return nullptr;
+  long pid = PyLong_AsLong(args[2]);
+  long kind = PyLong_AsLong(args[3]);
+  if (PyErr_Occurred()) return nullptr;
+  for (size_t i = 0; i < s->infl.size(); i++) {
+    if (s->infl[i].pid != pid) continue;
+    if (s->infl[i].phase != (int8_t)kind) return PyLong_FromLong(0);
+    if (kind == 1) {
+      s->infl[i].phase = 2;
+    } else {
+      s->infl.erase(s->infl.begin() + i);
+    }
+    return PyLong_FromLong(1);
+  }
+  return PyLong_FromLong(0);
+}
+
+// delivery_forget(handle, slot, pid) -> 1 | 0: unconditional removal
+// (the transport's drop-too-large path pops the window entry whatever
+// its phase)
+static PyObject *delivery_forget(PyObject *, PyObject *const *args,
+                                 Py_ssize_t nargs) {
+  if (nargs != 3) {
+    PyErr_SetString(PyExc_TypeError,
+                    "delivery_forget(handle, slot, pid)");
+    return nullptr;
+  }
+  long slot = PyLong_AsLong(args[1]);
+  if (slot == -1 && PyErr_Occurred()) return nullptr;
+  DSlot *s = dslot(args[0], slot);
+  if (!s) return nullptr;
+  long pid = PyLong_AsLong(args[2]);
+  if (PyErr_Occurred()) return nullptr;
+  for (size_t i = 0; i < s->infl.size(); i++) {
+    if (s->infl[i].pid == pid) {
+      s->infl.erase(s->infl.begin() + i);
+      return PyLong_FromLong(1);
+    }
+  }
+  return PyLong_FromLong(0);
+}
+
+// delivery_retry_due(handle, slot, now, interval) -> [(pid, phase)]:
+// entries past the retry interval, stamped sent_at=now / dup=1 in
+// insertion order (Session.retry)
+static PyObject *delivery_retry_due(PyObject *, PyObject *args) {
+  PyObject *cap;
+  long slot;
+  double now, interval;
+  if (!PyArg_ParseTuple(args, "Oldd", &cap, &slot, &now, &interval))
+    return nullptr;
+  DSlot *s = dslot(cap, slot);
+  if (!s) return nullptr;
+  PyObject *out = PyList_New(0);
+  if (!out) return nullptr;
+  for (DEnt &e : s->infl) {
+    if (now - e.sent_at < interval) continue;
+    e.sent_at = now;
+    e.dup = 1;
+    PyObject *t = Py_BuildValue("(ii)", (int)e.pid, (int)e.phase);
+    if (!t || PyList_Append(out, t) < 0) {
+      Py_XDECREF(t);
+      Py_DECREF(out);
+      return nullptr;
+    }
+    Py_DECREF(t);
+  }
+  return out;
+}
+
+// delivery_touch_all(handle, slot, now) -> [(pid, phase)]: reconnect
+// replay — every entry restamped sent_at=now (dup stays as-is, the
+// replay packets carry dup themselves), insertion order
+static PyObject *delivery_touch_all(PyObject *, PyObject *args) {
+  PyObject *cap;
+  long slot;
+  double now;
+  if (!PyArg_ParseTuple(args, "Old", &cap, &slot, &now)) return nullptr;
+  DSlot *s = dslot(cap, slot);
+  if (!s) return nullptr;
+  PyObject *out = PyList_New(s->infl.size());
+  if (!out) return nullptr;
+  for (size_t i = 0; i < s->infl.size(); i++) {
+    DEnt &e = s->infl[i];
+    e.sent_at = now;
+    PyObject *t = Py_BuildValue("(ii)", (int)e.pid, (int)e.phase);
+    if (!t) {
+      Py_DECREF(out);
+      return nullptr;
+    }
+    PyList_SET_ITEM(out, i, t);
+  }
+  return out;
+}
+
+// delivery_enqueue(handle, slot, prio, qos, max_len, has_prios) ->
+// packed decision over the (prio, qos) shadow queue, mirroring
+// Session._enqueue's overflow + priority-insert walk exactly:
+//   bits 0..1  action: 0 drop incoming, 1 admit, 2 admit after
+//              evicting the victim
+//   bits 2..31 insert index (post-eviction queue coordinates)
+//   bits 32+   victim index (action 2, pre-eviction coordinates)
+static PyObject *delivery_enqueue(PyObject *, PyObject *const *args,
+                                  Py_ssize_t nargs) {
+  if (nargs != 6) {
+    PyErr_SetString(
+        PyExc_TypeError,
+        "delivery_enqueue(handle, slot, prio, qos, max_len, has_prios)");
+    return nullptr;
+  }
+  long slot = PyLong_AsLong(args[1]);
+  if (slot == -1 && PyErr_Occurred()) return nullptr;
+  DSlot *s = dslot(args[0], slot);
+  if (!s) return nullptr;
+  long prio = PyLong_AsLong(args[2]);
+  long qos = PyLong_AsLong(args[3]);
+  long max_len = PyLong_AsLong(args[4]);
+  long has_prios = PyLong_AsLong(args[5]);
+  if (PyErr_Occurred()) return nullptr;
+  uint16_t *q = s->q.data() + s->qhead;
+  long n = (long)(s->q.size() - s->qhead);
+  long action = 1, victim = -1;
+  if (n >= max_len) {
+    // 1) a QoS0 victim of <= incoming priority, scanned from the
+    // tail; 2) else a strictly-lower-priority tail entry; 3) else
+    // the incoming message is the lowest-value item — drop it
+    for (long i = n - 1; i >= 0; i--) {
+      if ((q[i] & 0x3) == 0 && (long)(q[i] >> 2) <= prio) {
+        victim = i;
+        break;
+      }
+    }
+    if (victim < 0 && n > 0 && (long)(q[n - 1] >> 2) < prio)
+      victim = n - 1;
+    if (victim < 0) return PyLong_FromLongLong(0);
+    s->q.erase(s->q.begin() + s->qhead + victim);
+    q = s->q.data() + s->qhead;
+    n -= 1;
+    action = 2;
+  }
+  long idx = n;
+  if (has_prios && n > 0) {
+    while (idx > 0 && (long)(q[idx - 1] >> 2) < prio) idx--;
+  }
+  s->q.insert(s->q.begin() + s->qhead + idx,
+              (uint16_t)(((prio & 0x3FFF) << 2) | (qos & 0x3)));
+  long long packed = action | ((long long)idx << 2);
+  if (action == 2) packed |= ((long long)victim << 32);
+  return PyLong_FromLongLong(packed);
+}
+
+// delivery_popleft(handle, slot) -> 1 | 0: the shadow of every
+// mqueue.popleft() (drain / expiry pops)
+static PyObject *delivery_popleft(PyObject *, PyObject *const *args,
+                                  Py_ssize_t nargs) {
+  if (nargs != 2) {
+    PyErr_SetString(PyExc_TypeError, "delivery_popleft(handle, slot)");
+    return nullptr;
+  }
+  long slot = PyLong_AsLong(args[1]);
+  if (slot == -1 && PyErr_Occurred()) return nullptr;
+  DSlot *s = dslot(args[0], slot);
+  if (!s) return nullptr;
+  if (s->qhead >= s->q.size()) return PyLong_FromLong(0);
+  s->qhead += 1;
+  if (s->qhead > 1024 && s->qhead * 2 > s->q.size()) {
+    s->q.erase(s->q.begin(), s->q.begin() + s->qhead);
+    s->qhead = 0;
+  }
+  return PyLong_FromLong(1);
+}
+
+// delivery_window_len(handle, slot) -> live inflight-window size
+static PyObject *delivery_window_len(PyObject *, PyObject *const *args,
+                                     Py_ssize_t nargs) {
+  if (nargs != 2) {
+    PyErr_SetString(PyExc_TypeError, "delivery_window_len(handle, slot)");
+    return nullptr;
+  }
+  long slot = PyLong_AsLong(args[1]);
+  if (slot == -1 && PyErr_Occurred()) return nullptr;
+  DSlot *s = dslot(args[0], slot);
+  if (!s) return nullptr;
+  return PyLong_FromLong((long)s->infl.size());
+}
+
+// delivery_dump(handle, slot) -> (next_pid, [(pid, phase, dup,
+// sent_at)], [(prio, qos)]) — the full observable state the parity
+// fuzzer diffs against the Python twin
+static PyObject *delivery_dump(PyObject *, PyObject *args) {
+  PyObject *cap;
+  long slot;
+  if (!PyArg_ParseTuple(args, "Ol", &cap, &slot)) return nullptr;
+  DSlot *s = dslot(cap, slot);
+  if (!s) return nullptr;
+  PyObject *infl = PyList_New(s->infl.size());
+  if (!infl) return nullptr;
+  for (size_t i = 0; i < s->infl.size(); i++) {
+    const DEnt &e = s->infl[i];
+    PyObject *t = Py_BuildValue("(iiid)", (int)e.pid, (int)e.phase,
+                                (int)e.dup, e.sent_at);
+    if (!t) {
+      Py_DECREF(infl);
+      return nullptr;
+    }
+    PyList_SET_ITEM(infl, i, t);
+  }
+  Py_ssize_t qn = (Py_ssize_t)(s->q.size() - s->qhead);
+  PyObject *qd = PyList_New(qn);
+  if (!qd) {
+    Py_DECREF(infl);
+    return nullptr;
+  }
+  for (Py_ssize_t i = 0; i < qn; i++) {
+    uint16_t v = s->q[s->qhead + i];
+    PyObject *t = Py_BuildValue("(ii)", (int)(v >> 2), (int)(v & 0x3));
+    if (!t) {
+      Py_DECREF(infl);
+      Py_DECREF(qd);
+      return nullptr;
+    }
+    PyList_SET_ITEM(qd, i, t);
+  }
+  return Py_BuildValue("(iNN)", (int)s->next_pid, infl, qd);
+}
+
+// ---------------------------------------------------------------------
 
 static PyMethodDef Methods[] = {
     {"wild_flags", wild_flags, METH_VARARGS,
@@ -1743,6 +2199,39 @@ static PyMethodDef Methods[] = {
      "del_route_core(handle_or_router, flt, dest) -> packed int "
      "(1 vanished | 2 row_freed | 4 dirty_grew | 8 deep_changed | "
      "row << 8)"},
+    {"delivery_make_handle", delivery_make_handle, METH_NOARGS,
+     "delivery_make_handle() -> capsule (per-process delivery ledger)"},
+    {"delivery_open", delivery_open, METH_VARARGS,
+     "delivery_open(handle) -> slot"},
+    {"delivery_close", delivery_close, METH_VARARGS,
+     "delivery_close(handle, slot)"},
+    {"delivery_reserve", (PyCFunction)(void (*)(void))delivery_reserve,
+     METH_FASTCALL,
+     "delivery_reserve(handle, slot, qos, now, recv_max) -> pid | 0"},
+    {"delivery_reserve_many", delivery_reserve_many, METH_VARARGS,
+     "delivery_reserve_many(handle, slots, qoses, now, recv_maxes) -> "
+     "list[pid | 0]"},
+    {"delivery_ack", (PyCFunction)(void (*)(void))delivery_ack,
+     METH_FASTCALL,
+     "delivery_ack(handle, slot, pid, kind) -> 1 | 0"},
+    {"delivery_forget", (PyCFunction)(void (*)(void))delivery_forget,
+     METH_FASTCALL, "delivery_forget(handle, slot, pid) -> 1 | 0"},
+    {"delivery_retry_due", delivery_retry_due, METH_VARARGS,
+     "delivery_retry_due(handle, slot, now, interval) -> "
+     "[(pid, phase)]"},
+    {"delivery_touch_all", delivery_touch_all, METH_VARARGS,
+     "delivery_touch_all(handle, slot, now) -> [(pid, phase)]"},
+    {"delivery_enqueue", (PyCFunction)(void (*)(void))delivery_enqueue,
+     METH_FASTCALL,
+     "delivery_enqueue(handle, slot, prio, qos, max_len, has_prios) -> "
+     "packed int (action | idx << 2 | victim << 32)"},
+    {"delivery_popleft", (PyCFunction)(void (*)(void))delivery_popleft,
+     METH_FASTCALL, "delivery_popleft(handle, slot) -> 1 | 0"},
+    {"delivery_window_len",
+     (PyCFunction)(void (*)(void))delivery_window_len, METH_FASTCALL,
+     "delivery_window_len(handle, slot) -> int"},
+    {"delivery_dump", delivery_dump, METH_VARARGS,
+     "delivery_dump(handle, slot) -> (next_pid, infl, queue)"},
     {nullptr, nullptr, 0, nullptr}};
 
 static struct PyModuleDef Module = {PyModuleDef_HEAD_INIT, "_emqx_speedups",

@@ -1009,11 +1009,28 @@ async def test_delivery_stage_ring_and_profiler_families_lint(tmp_path):
         for stage, counts in stages.items():
             assert counts == sorted(counts), f"{stage}: not cumulative"
             assert counts[-1] >= 1, f"{stage}: never observed"
-        # the fan histogram counted every sampled publish's fan size
+        # the fan histogram counted every sampled publish's fan size —
+        # minus the first two spans the warmup exclusion kept out of
+        # the serve stats (broker.perf.tpu_warmup_sample_skip)
+        assert st.warmup_skipped == 2
         m = re.search(
             r'emqx_xla_delivery_fan_count\{node="n1@host"\} (\d+)', text
         )
-        assert m and int(m.group(1)) == 12
+        assert m and int(m.group(1)) == 10
+        # fan is a COUNT, not a latency (ISSUE 19 satellite): the
+        # snapshot must be unitless (no *_ms keys) and the exposition
+        # _sum must render as a plain number, not nanosecond-padded
+        # seconds
+        fan_snap = st.fan_hist.snapshot()
+        assert not any(k.endswith("_ms") for k in fan_snap), fan_snap
+        assert {"p50", "p99", "p999"} <= set(fan_snap)
+        m = re.search(
+            r'emqx_xla_delivery_fan_sum\{node="n1@host"\} (\S+)', text
+        )
+        assert m and not re.match(r"^\d+\.\d{9}$", m.group(1)), (
+            "fan _sum rendered with seconds-style nanosecond padding: "
+            f"{m.group(1) if m else None}"
+        )
         # the gap histogram caught the idle window between the waves
         m = re.search(
             r'emqx_xla_ring_gap_seconds_count\{node="n1@host"\} (\d+)',

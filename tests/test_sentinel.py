@@ -33,6 +33,7 @@ def make(tmp_path, mesh=None, **obs_kw):
         **obs_kw,
     )
     obs.sentinel.sample_n = 1  # every served publish audited
+    obs.sentinel.warmup_left = 0  # attribution asserted from span one
     b._fanout_min_fan = 0
     return b, obs
 

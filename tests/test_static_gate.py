@@ -430,7 +430,9 @@ def _native_abi():
             re.DOTALL,
         )
         body = m.group(1) if m else ""
-        if flavor == "METH_FASTCALL":
+        if flavor == "METH_NOARGS":
+            abi[pyname] = 0
+        elif flavor == "METH_FASTCALL":
             g = re.search(r"nargs\s*!=\s*(\d+)", body)
             assert g, f"{cfunc}: METH_FASTCALL without an nargs guard"
             abi[pyname] = int(g.group(1))
@@ -852,3 +854,44 @@ def test_ds_disk_io_funnels_through_seam():
         "disk I/O under emqx_tpu/ds/ bypassing the diskio seam "
         "(invisible to fault injection):\n  " + "\n  ".join(offenders)
     )
+
+
+# --- window dispatch stays batched (PR 19) ----------------------------
+#
+# `DispatchEngine._collect_one` is the device->session seam every
+# engine-path publish funnels through.  PR 19 replaced its per-publish
+# `broker._dispatch` loop with ONE `dispatch_window` call (one plan
+# resolution per distinct filter set, grouped session writes,
+# aggregate-count folding).  A regression back to per-publish dispatch
+# would be delivery-identical — the identity tests can't catch it —
+# while silently re-paying the per-publish plan probe at every scale
+# bench.  Gate it structurally.
+
+
+def test_collect_one_dispatches_through_the_window():
+    src = (PKG / "broker" / "dispatch_engine.py").read_text()
+    tree = ast.parse(src, filename="dispatch_engine.py")
+    fn = None
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and node.name == "_collect_one"
+        ):
+            fn = node
+            break
+    assert fn is not None, "_collect_one vanished from dispatch_engine"
+    called = {
+        n.func.attr
+        for n in ast.walk(fn)
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+    }
+    assert "dispatch_window" in called, (
+        "_collect_one must hand the coalesced window to "
+        "Broker.dispatch_window"
+    )
+    for banned in ("_dispatch", "publish", "_dispatch_window_group"):
+        assert banned not in called, (
+            f"_collect_one calls {banned}(): the engine path must not "
+            f"unbatch into per-publish dispatch (or bypass "
+            f"dispatch_window's run ordering)"
+        )
