@@ -2290,6 +2290,298 @@ def bench_profiler_overhead(details):
 
 
 # --------------------------------------------------------------------------
+# mesh microscope (ISSUE 20): paired-toggle overhead proof + the
+# committed 1->8 per-stage scaling decomposition
+
+
+def bench_mesh_scope_overhead(details):
+    """The SAME sharded match stream with the mesh microscope attached
+    vs detached (the production tpu_mesh_scope_enable toggle). The
+    scope's serve cost is a handful of perf_counter laps per dispatch
+    plus one combine-only probe dispatch every sample_n-th batch, so
+    the windows run sample_n dispatches each — every on-window pays
+    exactly one amortized probe, the honest per-dispatch shape. Same
+    order-alternating window discipline as bench_profiler_overhead but
+    gated on min-of-windows per arm (box jitter is additive and an
+    order of magnitude louder than the overhead being measured); the
+    <=2% budget is asserted in-bench."""
+    import jax
+
+    from emqx_tpu.models.router import Router
+    from emqx_tpu.obs.mesh_scope import MeshScope
+    from emqx_tpu.parallel import mesh as mesh_mod
+
+    # B=256 is the serving-representative shape: at tiny batches the
+    # fixed-cost probe dispatch (~3.5 ms on forced-host CPU) is the
+    # same order as the dispatch wall itself and the ratio measures
+    # the box, not the microscope
+    N_ROUTES, B, SAMPLE_N, PAIRS = 4096, 256, 64, 8
+    devs = jax.devices()
+    n_sub = min(4, len(devs))
+    r = Router(
+        max_levels=8,
+        mesh=mesh_mod.make_mesh(n_dp=1, n_sub=n_sub, devices=devs[:n_sub]),
+    )
+    r.add_routes([(f"k{i}/+/v/#", f"d{i % 7}") for i in range(N_ROUTES)])
+    dt = r.device_table
+    sc = MeshScope(telemetry=r.telemetry, sample_n=SAMPLE_N)
+    dt.scope = sc  # attached for warmup so the probe shapes pre-warm
+    r.warmup_shapes(max_batch=B)
+    r.telemetry.mark_serving()
+
+    rep_seq = iter(range(1, 1_000_000))
+
+    def window():
+        # fresh topics per dispatch: the router's result cache (and the
+        # relay's memoization) must never serve a timed batch
+        rep = next(rep_seq)
+        t0 = time.perf_counter()
+        for d in range(SAMPLE_N):
+            r.match_filters_batch(
+                [
+                    f"k{(t * 7919 + rep * 131 + d) % N_ROUTES}/a/v/w"
+                    for t in range(B)
+                ]
+            )
+        return time.perf_counter() - t0
+
+    window()  # warm the serve path itself
+    ts_on, ts_off = [], []
+    with gc_off():
+        for i in range(PAIRS):
+            order = (
+                ((sc, ts_on), (None, ts_off))
+                if i % 2 == 0
+                else ((None, ts_off), (sc, ts_on))
+            )
+            for scope, sink in order:
+                dt.scope = scope
+                sink.append(window())
+    dt.scope = sc
+    # min-of-windows (the timeit discipline): contention on a shared
+    # box only ever ADDS time, so each arm's minimum converges on its
+    # true cost while medians/means keep the noise — window-to-window
+    # jitter here is ±5%, which would swamp a sub-1% true overhead
+    # against the 2% gate. The alternating on/off order still defeats
+    # slow drift: both arms sample the same epochs.
+    on = float(np.min(ts_on))
+    off = float(np.min(ts_off))
+    pct = (on - off) / off * 100 if off else 0.0
+    per_dispatch = SAMPLE_N
+    log(
+        f"mesh scope overhead: attached {on / per_dispatch * 1e3:.2f} "
+        f"ms/dispatch vs detached {off / per_dispatch * 1e3:.2f} "
+        f"ms/dispatch -> {pct:+.2f}% at sample_n={SAMPLE_N} "
+        f"(probe splits {sc.splits_sampled}, dispatches {sc.dispatches})"
+    )
+    details["mesh_scope_overhead"] = {
+        "attached_ms_per_dispatch": round(on / per_dispatch * 1e3, 3),
+        "detached_ms_per_dispatch": round(off / per_dispatch * 1e3, 3),
+        "sample_n": SAMPLE_N,
+        "dispatches_sampled": sc.dispatches,
+        "probe_splits_sampled": sc.splits_sampled,
+        "overhead_pct": round(pct, 2),
+        "budget_pct": 2.0,
+        "within_budget": bool(pct < 2.0),
+        "recompiles_at_serve_total": int(
+            r.telemetry.counters.get("recompiles_at_serve_total", 0)
+        ),
+    }
+    # a zero-sample run would make the pct a vacuous pass: the scope
+    # existed but never exercised its probe path
+    assert sc.splits_sampled > 0, (
+        "mesh scope sampled zero combine probes during the on-windows — "
+        "the overhead measurement is vacuous"
+    )
+    assert pct < 2.0, (
+        f"mesh scope overhead {pct:+.2f}% blew the 2% budget — "
+        f"the microscope became the load"
+    )
+    assert details["mesh_scope_overhead"]["recompiles_at_serve_total"] == 0
+
+
+def bench_mesh_profile(details):
+    """The committed 1->8 scaling decomposition (ISSUE 20): the SAME
+    1M-route workload as the MULTICHIP scaling curve
+    (__graft_entry__.dryrun_multichip), re-measured per mesh width with
+    the microscope attached, so the r15 inference — chips_8 at 1.23x
+    chips_1 blamed on N serialized launches + the O(N) flat gather —
+    becomes measured per-stage rows. Asserted in-bench: stage seconds
+    cover >=0.9 of the dispatch wall at every width, and zero
+    serve-time retraces. Writes MESH_PROFILE_r20.json and diffs the
+    per-stage rows against the previous mesh-profile round."""
+    import glob
+
+    import jax
+
+    from emqx_tpu.models.router import Router
+    from emqx_tpu.obs.mesh_scope import MESH_STAGES, MeshScope
+    from emqx_tpu.parallel import mesh as mesh_mod
+
+    N_ROUTES = max(4_096, 1_000_000 // SHRINK)
+    B_TOPICS = 1024
+    REPS, SAMPLE_N = 12, 4
+    devs = jax.devices()
+
+    pairs = []
+    for i in range(N_ROUTES - 64):
+        g = i % 4
+        if i % 10 == 0:
+            pairs.append((f"site/{g}/dev{i}/state", f"n{i % 5}"))
+        else:
+            pairs.append((f"site/{g}/dev{i}/+/m/#", f"n{i % 5}"))
+    for j in range(64):  # wide mid-level filters: real fanout shape
+        pairs.append((f"site/{j % 4}/+/agg{j}/m/#", f"agg{j}"))
+
+    rep_seq = iter(range(1, 1_000_000))
+
+    def mk_topics(rep):
+        out = []
+        for t in range(B_TOPICS):
+            i = (t * 7919 + rep * 131) % (N_ROUTES - 64)
+            if i % 10 == 0:
+                out.append(f"site/{i % 4}/dev{i}/state")
+            else:
+                j = (t % 16) * 4 + (i % 4)
+                out.append(f"site/{i % 4}/dev{i}/agg{j}/m/r{rep}")
+        return out
+
+    profile = {
+        "routes": N_ROUTES,
+        "topic_batch": B_TOPICS,
+        "reps": REPS,
+        "sample_n": SAMPLE_N,
+        "widths": {},
+    }
+    stage_gate = {}
+    for k in (1, 2, 4, 8):
+        if k > len(devs):
+            continue
+        log(f"mesh profile: chips_{k} — building {N_ROUTES} routes")
+        r = Router(
+            max_levels=8,
+            mesh=mesh_mod.make_mesh(n_dp=1, n_sub=k, devices=devs[:k]),
+        )
+        for lo in range(0, len(pairs), 1000):
+            r.add_routes(pairs[lo: lo + 1000])
+        sc = MeshScope(telemetry=r.telemetry, sample_n=SAMPLE_N)
+        r.device_table.scope = sc
+        # warm the full pow2 ladder INCLUDING the combine probe shapes
+        # (warmup_escalated's tail), then close the warmup window
+        r.warmup_shapes(max_batch=B_TOPICS)
+        r.telemetry.mark_serving()
+        t0 = time.perf_counter()
+        for _ in range(REPS):
+            r.match_filters_batch(mk_topics(next(rep_seq)))
+        wall_s = time.perf_counter() - t0
+        st = sc.status()
+        nk = str(k)
+        ratio = st["stage_wall_ratio"].get(nk, 0.0)
+        # the in-bench decomposition gate: the six stages must explain
+        # >=0.9 of the recorded dispatch wall at this width
+        assert ratio >= 0.9, (
+            f"chips_{k}: stage sum covers only {ratio:.3f} of the "
+            f"dispatch wall (need >=0.9) — the decomposition is lying"
+        )
+        rec = int(r.telemetry.counters.get("recompiles_at_serve_total", 0))
+        assert rec == 0, f"chips_{k}: {rec} serve-time retraces"
+        stages = st["stages"][nk]
+        profile["widths"][f"chips_{k}"] = {
+            "match_topics_per_sec": round(REPS * B_TOPICS / wall_s, 1),
+            "dispatch_wall_p50_ms": st["wall"][nk]["p50_ms"],
+            "dispatch_wall_p99_ms": st["wall"][nk]["p99_ms"],
+            "stage_wall_ratio": ratio,
+            "stages": stages,
+            # the r15 blame, measured directly: the host-side span of
+            # the N-serialized per-shard program launches
+            "serialized_launch_p50_ms": stages["program_launch"]["p50_ms"],
+            "combine_frac": st["collective"]["combine_frac"].get(nk),
+            "collective_gather_bytes_per_dispatch": st["collective"][
+                "gather_bytes_last"
+            ],
+            "combine_occupancy_p50": st["collective"]["occupancy"]
+            .get(nk, {})
+            .get("p50"),
+            "decomp_in_band_ratio": st["decomp"]["in_band_ratio"],
+            "splits_sampled": st["splits_sampled"],
+            "split_skipped": st["split_skipped"],
+            "recompiles_at_serve_total": rec,
+        }
+        # regression-gate rows: inverse stage p50 as *_per_sec so a
+        # stage getting slower next round is a flagged drop in
+        # bench_compare's suffix scan
+        for stg, snap in stages.items():
+            p50_s = snap["p50_ms"] / 1e3
+            if p50_s > 0:
+                stage_gate[f"chips_{k}_{stg}_per_sec"] = round(
+                    1.0 / p50_s, 3
+                )
+        log(
+            f"mesh profile: chips_{k} "
+            f"{profile['widths'][f'chips_{k}']['match_topics_per_sec']:.0f} "
+            f"topics/s, stage/wall {ratio:.3f}, "
+            f"launch p50 {stages['program_launch']['p50_ms']:.3f} ms, "
+            f"combine p50 {stages['combine_collective']['p50_ms']:.3f} ms"
+        )
+        del r, sc
+    profile["stage_gate"] = stage_gate
+
+    # per-leg ranking: WHY the widest mesh holds only ~1.2x the single
+    # chip — the per-stage p50 deltas, widest vs chips_1, ranked by how
+    # much wall each leg added (the ISSUE-20 measured excuse)
+    widths = profile["widths"]
+    if "chips_1" in widths and len(widths) > 1:
+        widest = max(int(w.split("_")[1]) for w in widths)
+        s1 = widths["chips_1"]["stages"]
+        sw = widths[f"chips_{widest}"]["stages"]
+        ranked = []
+        for stg in MESH_STAGES:
+            a = s1.get(stg, {}).get("p50_ms", 0.0)
+            b = sw.get(stg, {}).get("p50_ms", 0.0)
+            ranked.append(
+                {
+                    "stage": stg,
+                    "chips_1_p50_ms": a,
+                    f"chips_{widest}_p50_ms": b,
+                    "added_ms": round(b - a, 6),
+                }
+            )
+        ranked.sort(key=lambda d: -d["added_ms"])
+        profile["scaling_blame"] = {
+            "widest": widest,
+            "throughput_ratio_vs_chips_1": round(
+                widths[f"chips_{widest}"]["match_topics_per_sec"]
+                / widths["chips_1"]["match_topics_per_sec"],
+                4,
+            ),
+            "ranked_stage_deltas": ranked,
+        }
+    details["mesh_profile"] = profile
+
+    report = os.environ.get(
+        "EMQX_MESH_PROFILE_REPORT", "MESH_PROFILE_r20.json"
+    )
+    prevs = [
+        p
+        for p in sorted(glob.glob("MESH_PROFILE_r*.json"))
+        if os.path.abspath(p) != os.path.abspath(report)
+    ]
+    if prevs:
+        bench_compare(details, prev_path=prevs[-1], min_compared=1)
+    else:
+        details["bench_compare"] = {
+            "prev": None,
+            "status": "skipped",
+            "reason": "no previous mesh-profile round",
+        }
+        log("bench_compare: skipped (no previous mesh-profile round)")
+    with open(report, "w") as f:
+        json.dump(details, f, indent=1, default=str)
+    log(f"mesh profile report: {report}")
+    return profile
+
+
+# --------------------------------------------------------------------------
 # provenance + round-over-round compare (the round-5 judge's "fanout
 # regressed 29% without a note / native baseline halved" close-out)
 
@@ -3187,10 +3479,60 @@ def bench_profile(details, out_path="PROFILE_r19.json"):
 
 
 def main():
+    # --mesh-profile needs the 8-device virtual CPU mesh forced BEFORE
+    # any jax backend initializes (same dance as dryrun_multichip: the
+    # axon sitecustomize pins the single-chip TPU relay otherwise)
+    if "--mesh-profile" in sys.argv:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+
     import jax
     import jax.numpy as jnp
 
     details = {}
+
+    # --mesh-profile: the mesh-microscope artifact is its own run (four
+    # 1M-route mesh builds, per-stage decomposition at every width) —
+    # it executes alone and commits MESH_PROFILE_r20.json. The overhead
+    # stage runs first so the artifact embeds its own budget proof.
+    if "--mesh-profile" in sys.argv:
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+        log(f"devices: {jax.devices()}")
+        bench_provenance(details, jax)
+        bench_mesh_scope_overhead(details)
+        row = bench_mesh_profile(details)
+        blame = row.get("scaling_blame", {})
+        ranked = blame.get("ranked_stage_deltas", [])
+        print(
+            json.dumps(
+                {
+                    "metric": "mesh_stage_sum_to_wall_ratio_min",
+                    "value": min(
+                        w["stage_wall_ratio"] for w in row["widths"].values()
+                    ),
+                    "unit": "ratio",
+                    "widths": len(row["widths"]),
+                    "scope_overhead_pct": details["mesh_scope_overhead"][
+                        "overhead_pct"
+                    ],
+                    "widest_vs_chips_1": blame.get(
+                        "throughput_ratio_vs_chips_1"
+                    ),
+                    "top_blame_stage": (
+                        ranked[0]["stage"] if ranked else None
+                    ),
+                    "recompiles_at_serve_total": 0,
+                }
+            )
+        )
+        return
+
     log(f"devices: {jax.devices()}")
 
     # --soak: the chaos stage is its own run (minutes of wall clock,

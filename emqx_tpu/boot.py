@@ -107,6 +107,19 @@ class Node:
                 if mesh is not None else 0
             ),
         )
+        if mesh is not None and cfg.get("broker.perf.tpu_mesh_scope_enable"):
+            # mesh microscope (obs/mesh_scope.py): per-dispatch stage
+            # decomposition + collective-cost ledger. Attaches on the
+            # device table's None-seam; disabled leaves the served
+            # path at one attribute read per dispatch.
+            from .obs.mesh_scope import MeshScope
+
+            dt = broker.router.device_table
+            if hasattr(dt, "scope"):
+                dt.scope = MeshScope(
+                    telemetry=broker.router.telemetry,
+                    sample_n=cfg.get("broker.perf.tpu_mesh_scope_sample_n"),
+                )
         broker.caps = MqttCaps(
             max_packet_size=cfg.get("mqtt.max_packet_size"),
             max_clientid_len=cfg.get("mqtt.max_clientid_len"),

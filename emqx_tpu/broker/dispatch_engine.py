@@ -970,12 +970,20 @@ class DispatchEngine:
         return min(1.0, busy / elapsed) if elapsed > 0 else 0.0
 
     def ring_status(self) -> Dict:
-        return {
+        out = {
             "slots_total": self._ring_slots_total,
             "occupancy_ratio": round(self._ring_occupancy(), 6),
             "busy_seconds": round(self._ring_busy_accum, 6),
             "timeline": list(self._ring_timeline),
         }
+        # mesh microscope: per-chip generalization of the ring ledger
+        # (launch→land spans per serving chip + the stage decomposition)
+        scope = getattr(
+            getattr(self.broker.router, "device_table", None), "scope", None
+        )
+        if scope is not None:
+            out["mesh_scope"] = scope.status()
+        return out
 
     # --- circuit breaker (trip -> degrade -> probe -> resync -> close) ----
 

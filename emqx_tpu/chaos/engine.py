@@ -509,6 +509,17 @@ class ChaosEngine:
             fl.recorder.record("chaos.inject", "", {"kind": kind, **detail})
             fl.maybe_trigger("chaos_fault", {"kind": kind, **detail})
 
+    def scaled_timeout(self, base: float) -> float:
+        """Box-scaled deadline: `base` tuned-wall seconds stretched by
+        the measured box-throughput ratio (chaos/boxcal.py). The
+        SOAK_r19 `takeover_imported` fix: a wall-clock-fixed 10s settle
+        window red-flags a 1-core box that finishes the same work in
+        11.4s — the budget must scale with the box, the way the
+        replica_drift repair budget already scales with pair count."""
+        from .boxcal import scaled
+
+        return scaled(base)
+
     async def wait_for(
         self,
         pred: Callable[[], bool],

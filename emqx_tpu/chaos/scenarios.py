@@ -1534,7 +1534,10 @@ class AsymmetricPartition(Scenario):
         # main drops inbound frames FROM the victim; main->victim flows
         main.rpc.partition(va, direction="in")
         t_inj = time.monotonic()
-        budget = (
+        # box-scaled (boxcal.py): the detect/heal polling around the
+        # heartbeat rounds is interpreter-bound, and this scenario
+        # straddles its budget on 1-core boxes
+        budget = eng.scaled_timeout(
             (vms.heartbeat_interval + vms.ping_timeout)
             * (vms.miss_threshold + 2)
             + 3.0
@@ -1591,7 +1594,7 @@ class AsymmetricPartition(Scenario):
         res.recovery_ms = round((time.monotonic() - t_inj) * 1e3, 2)
         dig = await eng.wait_for(
             lambda: main.replica_digests() == victim.replica_digests(),
-            timeout=30.0,
+            timeout=eng.scaled_timeout(30.0),
         )
         res.checks.append(
             Check(
@@ -1686,7 +1689,12 @@ class ReplicaDrift(Scenario):
         )
         # detection within a bounded number of ping rounds (the digest
         # exchange rides every ping; 2 consecutive mismatches count)
-        budget = (ms.heartbeat_interval + ms.ping_timeout) * 6 + 5.0
+        # ping rounds are wall-time, but the polling/settle work around
+        # them is interpreter-bound — box-scale the whole budget so a
+        # 1-core box doesn't straddle it (boxcal.py discipline)
+        budget = eng.scaled_timeout(
+            (ms.heartbeat_interval + ms.ping_timeout) * 6 + 5.0
+        )
         detected = await eng.wait_for(
             lambda: CLUSTER_METRICS.snapshot().get(
                 "antientropy_divergence_total", 0
@@ -1706,8 +1714,10 @@ class ReplicaDrift(Scenario):
         # repair is a full-contribution paged resync: the time bound
         # scales with the table being replayed (1M routes under storm
         # is minutes of transfer, not ping rounds)
-        repair_budget = budget + eng.settle_timeout + max(
-            30.0, len(main._cluster_pairs) / 5_000.0
+        repair_budget = budget + eng.scaled_timeout(
+            eng.settle_timeout + max(
+                30.0, len(main._cluster_pairs) / 5_000.0
+            )
         )
         repaired = await eng.wait_for(
             lambda: main.replica_digests() == victim.replica_digests()
@@ -1927,12 +1937,15 @@ class NodeEvacuation(Scenario):
                 cid, clean_start=False, cfg=vfleet.cfg
             )
             s.outgoing_sink = vfleet.sink
+        # box-scaled settle budget (SOAK_r19 takeover_imported red
+        # check): the fixed 10s window is tuned wall time — a slow box
+        # finishing the identical import in 11.4s is not a failure
         imported = await eng.wait_for(
             lambda: all(
                 cid in b.sessions and b.sessions[cid].subscriptions
                 for cid in sample
             ),
-            timeout=eng.settle_timeout,
+            timeout=eng.scaled_timeout(eng.settle_timeout),
         )
         res.checks.append(
             Check(
@@ -1946,7 +1959,7 @@ class NodeEvacuation(Scenario):
             lambda: all(
                 cid not in victim.broker.sessions for cid in sample
             ),
-            timeout=eng.settle_timeout,
+            timeout=eng.scaled_timeout(eng.settle_timeout),
         )
         res.checks.append(
             Check(
@@ -2005,7 +2018,7 @@ class NodePurge(Scenario):
             lambda: not any(
                 n == victim.node_id for _f, n in eng.node._cluster_pairs
             ),
-            timeout=eng.settle_timeout,
+            timeout=eng.scaled_timeout(eng.settle_timeout),
         )
         res.checks.append(
             Check(

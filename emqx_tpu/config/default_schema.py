@@ -428,6 +428,17 @@ def broker_schema() -> Struct:
                                     "tpu_loop_lag_interval_ms": Field(
                                         Float(), default=100.0
                                     ),
+                                    # mesh microscope (obs/mesh_scope):
+                                    # per-dispatch stage decomposition
+                                    # + collective-cost ledger; the
+                                    # sample knob paces the combine-
+                                    # probe re-measure (1/N dispatches)
+                                    "tpu_mesh_scope_enable": Field(
+                                        Bool(), default=True
+                                    ),
+                                    "tpu_mesh_scope_sample_n": Field(
+                                        Int(min=1), default=64
+                                    ),
                                 }
                             )
                         ),

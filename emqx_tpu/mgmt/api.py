@@ -1345,6 +1345,13 @@ class ManagementApi:
             # co-tenant scheduling delay, measured on its own ticker so
             # the delivery sub-stages never absorb it
             out["loop_lag"] = ll.status()
+        scope = getattr(
+            getattr(self.broker.router, "device_table", None), "scope", None
+        )
+        if scope is not None:
+            # mesh microscope: per-dispatch stage decomposition +
+            # collective-cost ledger (obs/mesh_scope.py)
+            out["mesh_scope"] = scope.status()
         if self.node is not None:
             # split-brain failure domain: membership states, partition
             # arbitration, autoheal + route anti-entropy ledgers

@@ -127,6 +127,63 @@ class Ctl:
             "profile status | start | stop | arm [s] | stacks [stage] "
             "| collapsed [stage] | lag",
         )
+        reg(
+            "mesh",
+            self._mesh,
+            "mesh scope              # per-dispatch mesh decomposition",
+        )
+
+    def _mesh(self, args) -> str:
+        """emqx ctl mesh — the mesh microscope (obs/mesh_scope.py):
+        per-dispatch stage decomposition, collective-cost ledger,
+        per-chip occupancy."""
+        scope = getattr(
+            getattr(self.broker.router, "device_table", None), "scope", None
+        )
+        if scope is None:
+            return "mesh scope not attached (tpu_mesh_scope_enable)"
+        sub = args[0] if args else "scope"
+        if sub != "scope":
+            raise ValueError(f"bad subcommand {sub!r}")
+        st = scope.status()
+        d = st["decomp"]
+        lines = [
+            f"{'dispatches':<22}: {st['dispatches']} "
+            f"(1/{st['sample_n']} sampled, {st['splits_sampled']} splits, "
+            f"{st['split_skipped']} skipped)",
+            f"{'decomp in-band':<22}: {d['in_band']}/"
+            f"{d['in_band'] + d['out_of_band']} "
+            f"(tol {d['tolerance']:g}, last ratio {d['last_ratio']})",
+        ]
+        for nchips, stages in st["stages"].items():
+            wall = st["wall"][nchips]
+            lines.append(
+                f"nchips={nchips}  wall p50/p99 ms: "
+                f"{wall['p50_ms']} / {wall['p99_ms']}  "
+                f"(stage/wall {st['stage_wall_ratio'][nchips]})"
+            )
+            for stage, h in stages.items():
+                lines.append(
+                    f"  {stage:<20}: p50 {h['p50_ms']}ms  "
+                    f"p99 {h['p99_ms']}ms  n={h['count']}"
+                )
+        c = st["collective"]
+        lines.append(
+            f"{'gather bytes':<22}: {c['gather_bytes_total']} total "
+            f"({c['gather_bytes_last']} last)"
+        )
+        lines.append(
+            f"{'occupancy last':<22}: {c['occupancy_last']}"
+        )
+        if st["shard_skew"] is not None:
+            sk = st["shard_skew"]
+            lines.append(
+                f"{'shard skew hits':<22}: min {sk['min']} / "
+                f"med {sk['median']} / max {sk['max']}"
+            )
+        for chip, ratio in st["chips"].items():
+            lines.append(f"{'  chip ' + chip:<22}: busy {ratio}")
+        return "\n".join(lines)
 
     def _profile(self, args) -> str:
         """emqx ctl profile — the delivery-path microscope

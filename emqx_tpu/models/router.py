@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..obs.kernel_telemetry import NULL as _NULL_TEL
+from ..obs.profiler import STAGE_MARK
 from ..obs.kernel_telemetry import (
     LEG_DENSE,
     LEG_ENCODE,
@@ -305,6 +306,7 @@ class DeviceTable:
         shape = (b, int(meta.plen.shape[0]), int(slots.fp.shape[0]))
         self.telemetry.record_shape("match_ids_hash", shape + (mh,))
         dev = hash_ops.match_ids_hash(meta, slots, enc, max_hits=mh)
+        STAGE_MARK.stage = "ticket_start"
         return (enc, mh, shape, transfer_ops.start_fetch(dev, self.telemetry))
 
     def match_hash_finish(self, pending):
@@ -341,6 +343,7 @@ class DeviceTable:
         shape = (b, int(filters.words.shape[0]))
         self.telemetry.record_shape("match_ids", shape + (mh,))
         dev = match_ops.match_ids(filters, enc, max_hits=mh)
+        STAGE_MARK.stage = "ticket_start"
         return (enc, filters, mh, shape, transfer_ops.start_fetch(dev, self.telemetry))
 
     def match_ids_finish(self, pending):
@@ -1711,6 +1714,15 @@ class Router:
         self.device_table.sync()
         if self._quarantined:
             self._maybe_unquarantine()
+        # match_launch sub-marks (ISSUE 20 satellite): the engine's
+        # outer "match_launch" stamp was one opaque 835/2203-sample
+        # bucket — the sampler now sees encode vs launch vs
+        # ticket_start, with the outer stamp left as the residual
+        # (sync, cache bookkeeping). Saved/restored so non-engine
+        # callers keep whatever stage was live.
+        mark = STAGE_MARK
+        prev_stage = mark.stage
+        mark.stage = "encode"
         sp = tel.span("xla.encode", root)
         t0 = clock()
         # the batch axis pads to the next pow2 with inert topics (zero
@@ -1737,6 +1749,7 @@ class Router:
         # ONE launch path for both table kinds: DeviceTable and
         # ShardedDeviceTable expose the same match_{hash,ids}_begin/
         # finish halves (each begin also starts its result transfer)
+        mark.stage = "launch"
         ix = self.index
         if ix is not None:
             p.mode = "hash"
@@ -1753,6 +1766,7 @@ class Router:
                     enc, residual=True
                 )
                 p.residual_elapsed = clock() - t0
+            mark.stage = prev_stage
             if span is not None and p.hash_elapsed is not None:
                 span.add("kernel", p.hash_elapsed)
             return p
@@ -1760,6 +1774,7 @@ class Router:
         t0 = clock()
         p.dense_pending = self.device_table.match_ids_begin(enc)
         p.dense_elapsed = clock() - t0
+        mark.stage = prev_stage
         if span is not None:
             span.add("kernel", p.dense_elapsed)
         return p

@@ -79,6 +79,14 @@ def prometheus_text(broker, node_name: str = "emqx@127.0.0.1", obs=None) -> str:
     sentinel = getattr(broker, "sentinel", None)
     if sentinel is not None:
         lines.extend(sentinel.prometheus_lines(node_name))
+    # mesh microscope: per-dispatch stage decomposition + collective
+    # ledger (emqx_xla_mesh_* scope families; labeled histograms render
+    # in the scope, like the sentinel's stage exposition)
+    scope = getattr(
+        getattr(broker.router, "device_table", None), "scope", None
+    )
+    if scope is not None:
+        lines.extend(scope.prometheus_lines(node_name))
     # otel exporter throughput/backpressure (previously only process-
     # internal attributes: a collector outage dropped spans invisibly)
     tracer = getattr(broker, "tracer", None)

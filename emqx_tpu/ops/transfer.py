@@ -62,7 +62,10 @@ class FetchTicket:
     passthroughs on the host fallback paths) degrade to a plain
     `np.asarray` at wait — same contract, zero overlap."""
 
-    __slots__ = ("arrays", "nbytes", "telemetry", "waited", "_out")
+    __slots__ = (
+        "arrays", "nbytes", "telemetry", "waited", "_out",
+        "land_clock", "landed_at",
+    )
 
     def __init__(self, arrays: Sequence, telemetry=None) -> None:
         tel = telemetry if telemetry is not None else _NULL_TEL
@@ -71,6 +74,13 @@ class FetchTicket:
         # residual wall seconds the wait() actually blocked — the
         # sentinel's `transfer` stage attribution reads it post-finish
         self.waited = 0.0
+        # land hook (mesh microscope): when a clock is installed by the
+        # scope at launch, the first ready()==True observation (or the
+        # forced wait) stamps the land time — launch/land clock pairs
+        # are what decompose the device span without extra dispatches.
+        # None-seam: one attribute test on the served path when off.
+        self.land_clock = None
+        self.landed_at: Optional[float] = None
         self._out: Optional[Tuple[np.ndarray, ...]] = None
         nb = 0
         for a in self.arrays:
@@ -93,6 +103,8 @@ class FetchTicket:
             is_ready = getattr(a, "is_ready", None)
             if is_ready is not None and not is_ready():
                 return False
+        if self.land_clock is not None and self.landed_at is None:
+            self.landed_at = self.land_clock()
         return True
 
     def wait(self) -> Tuple[np.ndarray, ...]:
@@ -107,6 +119,11 @@ class FetchTicket:
         t0 = tel.clock()
         out = self._out = tuple(np.asarray(a) for a in self.arrays)
         self.waited = tel.clock() - t0
+        if self.land_clock is not None and self.landed_at is None:
+            # never observed ready pre-wait: the buffers landed at some
+            # point inside the forced wait — stamp its end (the waited
+            # residual itself is attributed to d2h_transfer, not here)
+            self.landed_at = self.land_clock()
         if tel.enabled:
             tel.observe_family("transfer_seconds", self.waited)
             tel.add_gauge("transfer_inflight", -1)

@@ -120,9 +120,11 @@ def put_filters(filters: EncodedFilters, mesh: Mesh) -> EncodedFilters:
     )
 
 
-def put_topics(enc: EncodedTopics, mesh: Mesh) -> EncodedTopics:
-    """Place an encoded topic batch onto the mesh, batch over 'dp'.
-    Pads the batch up to a multiple of the dp axis size."""
+def pad_topics(enc: EncodedTopics, mesh: Mesh) -> EncodedTopics:
+    """Host half of `put_topics`: pad the batch up to a multiple of the
+    dp axis size. Split out so the mesh microscope can lap the host pad
+    (`host_encode`) separately from the H2D placement (`h2d_stage`);
+    idempotent — a pre-padded batch passes through unchanged."""
     n_dp = mesh.shape[DP_AXIS]
     b = enc.ids.shape[0]
     pad = (-b) % n_dp
@@ -134,5 +136,12 @@ def put_topics(enc: EncodedTopics, mesh: Mesh) -> EncodedTopics:
             np.pad(enc.lens, (0, pad)),
             np.pad(enc.dollar, (0, pad), constant_values=True),
         )
+    return enc
+
+
+def put_topics(enc: EncodedTopics, mesh: Mesh) -> EncodedTopics:
+    """Place an encoded topic batch onto the mesh, batch over 'dp'.
+    Pads the batch up to a multiple of the dp axis size."""
+    enc = pad_topics(enc, mesh)
     shs = topic_sharding(mesh)
     return EncodedTopics(*(jax.device_put(a, s) for a, s in zip(enc, shs)))

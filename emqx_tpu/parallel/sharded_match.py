@@ -49,6 +49,7 @@ def _shard_map_unchecked(f, *, mesh, in_specs, out_specs):
             check_vma=False,
         )
 
+from ..obs.profiler import STAGE_MARK
 from ..ops.match import EncodedTopics, _match_block, _pack_bits
 from ..ops.table import EncodedFilters
 from .mesh import DP_AXIS, SUB_AXIS, filter_sharding, topic_sharding
@@ -159,6 +160,43 @@ def _combine_pairs(a, b, valid_key, mh):
     ca = jnp.where(pv, a_all[ps], -1).astype(jnp.int32)
     cb = jnp.where(pv, b_all[ps], -1).astype(jnp.int32)
     return ca, cb
+
+
+def make_combine_probe_kernel(mesh: Mesh, mh: int):
+    """Combine-only probe for the mesh microscope (obs/mesh_scope.py):
+    EXACTLY the cross-shard reduction of the match kernels
+    (`_combine_pairs` over 'sub' plus the psum'd total) on synthetic
+    per-shard buffers built on-device, so its device span isolates the
+    `combine_collective` leg of a real dispatch without duplicating
+    either match kernel — the reduction cost depends only on (n_sub,
+    mh), which this probe shares with both the dense and hash paths.
+    The salted scalar input keeps the gathered buffers from being
+    constant-folded and defeats the relay's identical-computation
+    memoization — every probe pays the real collective."""
+
+    def _local(salt):
+        sub_i = jax.lax.axis_index(SUB_AXIS).astype(jnp.int32)
+        iot = jnp.arange(mh, dtype=jnp.int32)
+        # one salted valid entry per shard — occupancy does not change
+        # the gather cost (the buffers are flat [n_sub*mh] either way)
+        a = jnp.where(iot == 0, salt + sub_i + 1, -1)
+        b = jnp.where(iot == 0, salt * 2 + 1, -1)
+        ca, cb = _combine_pairs(a, b, lambda t: t >= 0, mh)
+        total = jax.lax.psum((a >= 0).sum(dtype=jnp.int32), SUB_AXIS)
+        return ca[None, :], cb[None, :], total.reshape(1, 1)
+
+    @jax.jit
+    def probe(salt):
+        return _shard_map_unchecked(
+            _local,
+            mesh=mesh,
+            in_specs=(P(),),
+            out_specs=(
+                P(DP_AXIS, None), P(DP_AXIS, None), P(DP_AXIS, None),
+            ),
+        )(salt)
+
+    return probe
 
 
 def make_match_ids_kernel(mesh: Mesh, max_hits_per_block: int):
@@ -612,6 +650,11 @@ class ShardedDeviceTable:
         # transfer chunk cap (ops/transfer.chunk_hits) — same contract
         # as DeviceTable.transfer_chunk_hits
         self.transfer_chunk_hits = None
+        # mesh microscope seam (obs/mesh_scope.MeshScope): None keeps
+        # the served path at one attribute read per dispatch — the
+        # tpu_mesh_scope_enable=false contract
+        self.scope = None
+        self._probe_cache: dict = {}
 
     def attach_fanout(self, store) -> None:
         """Mirror a CSR destination store on the mesh (replicated: the
@@ -698,6 +741,7 @@ class ShardedDeviceTable:
         _mc, _mp, self._apply_delta = make_sharded_kernels(mesh)
         self._match_ids_cache.clear()
         self._hash_cache.clear()
+        self._probe_cache.clear()
         self._apply_slot_delta = (
             make_slot_delta_kernel(mesh) if self.index is not None else None
         )
@@ -772,6 +816,19 @@ class ShardedDeviceTable:
         if k is None:
             k = make_sharded_hash_kernel(self.mesh, mh, n_buckets=nb)
             self._hash_cache[(mh, nb)] = k
+        return k
+
+    def _nchips(self) -> int:
+        return int(self.mesh.devices.size)
+
+    def _combine_probe(self, mh: int):
+        """Cached combine-only probe kernel for the CURRENT layout
+        (mesh microscope sampled splits; see
+        make_combine_probe_kernel). Cleared on every re-shard."""
+        k = self._probe_cache.get(mh)
+        if k is None:
+            k = make_combine_probe_kernel(self.mesh, mh)
+            self._probe_cache[mh] = k
         return k
 
     def _put_repl(self, a):
@@ -905,11 +962,19 @@ class ShardedDeviceTable:
 
     def _sync_impl(self):
         t = self.table
+        sc = self.scope
         if self._dev is None or t.grew or t.capacity != self._synced_capacity:
+            rec = sc.begin("sync", self._nchips()) if sc is not None else None
             n = len(t.dirty)
             t.drain_dirty()
-            self._dev = self._mesh_mod.put_filters(t.snapshot(), self.mesh)
+            snap = t.snapshot()
+            if rec is not None:
+                sc.lap(rec, "host_encode")
+            self._dev = self._mesh_mod.put_filters(snap, self.mesh)
             self._synced_capacity = t.capacity
+            if rec is not None:
+                sc.lap(rec, "h2d_stage")
+                sc.finish_sync(rec)
             if self.index is not None:
                 self._sync_index()
             return n, True
@@ -920,6 +985,7 @@ class ShardedDeviceTable:
             return 0, False
         import numpy as np
 
+        rec = sc.begin("sync", self._nchips()) if sc is not None else None
         total = len(dirty)
         arr = np.asarray(dirty, np.int32)
         # ONE dispatch for the whole churn: pad to [n_b, K] (n_b pow2
@@ -964,11 +1030,12 @@ class ShardedDeviceTable:
             )
             if tel.enabled:
                 tel.set_gauge("mesh_sync_batch_rows", total + s_total)
-            out = self._mesh_sync(
-                self._dev,
-                self._dev_slots.fp,
-                self._dev_slots.bucket,
-                self._dev_slots.probe,
+            if rec is not None:
+                sc.lap(rec, "host_encode")
+            # staged args hoisted so the microscope can lap the host
+            # gather + device placement (h2d_stage) apart from the
+            # fused kernel dispatch (program_launch)
+            staged = (
                 jnp.asarray(idx.reshape(shape2)),
                 jnp.asarray(t.words[idx].reshape(shape2 + (t.max_levels,))),
                 jnp.asarray(t.prefix_len[idx].reshape(shape2)),
@@ -982,6 +1049,18 @@ class ShardedDeviceTable:
                     ix.slots.probe[sidx // BUCKET_W].reshape(s_shape2)
                 ),
             )
+            if rec is not None:
+                sc.lap(rec, "h2d_stage")
+            out = self._mesh_sync(
+                self._dev,
+                self._dev_slots.fp,
+                self._dev_slots.bucket,
+                self._dev_slots.probe,
+                *staged,
+            )
+            if rec is not None:
+                sc.lap(rec, "program_launch")
+                sc.finish_sync(rec)
             self._dev = out[0]
             self._dev_slots = SlotArrays(*out[1:])
             self._sync_index()  # meta/residual legs only — slots done
@@ -991,8 +1070,9 @@ class ShardedDeviceTable:
         )
         if tel.enabled:
             tel.set_gauge("mesh_sync_batch_rows", total)
-        self._dev = self._apply_delta(
-            self._dev,
+        if rec is not None:
+            sc.lap(rec, "host_encode")
+        staged = (
             jnp.asarray(idx.reshape(shape2)),
             jnp.asarray(t.words[idx].reshape(shape2 + (t.max_levels,))),
             jnp.asarray(t.prefix_len[idx].reshape(shape2)),
@@ -1000,6 +1080,12 @@ class ShardedDeviceTable:
             jnp.asarray(t.root_wild[idx].reshape(shape2)),
             jnp.asarray(t.active[idx].reshape(shape2)),
         )
+        if rec is not None:
+            sc.lap(rec, "h2d_stage")
+        self._dev = self._apply_delta(self._dev, *staged)
+        if rec is not None:
+            sc.lap(rec, "program_launch")
+            sc.finish_sync(rec)
         if self.index is not None:
             self._sync_index()
         return total, False
@@ -1034,19 +1120,29 @@ class ShardedDeviceTable:
         if residual:
             assert self._dev_residual is not None
             dev = dev._replace(active=self._dev_residual)
+        sc = self.scope
+        rec = None
+        if sc is not None:
+            rec = sc.begin("ids", self._nchips())
+            enc = self._mesh_mod.pad_topics(enc, self.mesh)
+            sc.lap(rec, "host_encode")
         t_dev = self._mesh_mod.put_topics(enc, self.mesh)
+        if rec is not None:
+            sc.lap(rec, "h2d_stage")
         mh = self._block_mh()
         self.telemetry.record_shape(
             "mesh_match_ids", (int(t_dev.ids.shape[0]), mh)
         )
         from ..ops import transfer as transfer_ops
 
-        return (
-            dev, t_dev, mh,
-            transfer_ops.start_fetch(
-                self._match_kernel(mh)(dev, t_dev), self.telemetry
-            ),
-        )
+        out = self._match_kernel(mh)(dev, t_dev)
+        if rec is not None:
+            sc.lap(rec, "program_launch")
+        STAGE_MARK.stage = "ticket_start"
+        ticket = transfer_ops.start_fetch(out, self.telemetry)
+        if rec is not None:
+            sc.attach(rec, ticket)
+        return (dev, t_dev, mh, rec, ticket)
 
     def match_ids_finish(self, pending):
         """Force the transfers for a begun dense match, escalating
@@ -1057,11 +1153,12 @@ class ShardedDeviceTable:
 
         if pending[0] == "1dev":
             return self._single.match_ids_finish(pending[1:])
-        dev, t_dev, mh, ticket = pending
+        dev, t_dev, mh, rec, ticket = pending
         tel = self.telemetry
         t0 = tel.clock()
         ti, ri, totals = ticket.wait()
         totals = np.asarray(totals)
+        mh0 = mh
         while int(totals.max(initial=0)) > mh:
             tel.count("escalations_total")
             mh = max(mh * 2, 1 << int(totals.max()).bit_length())
@@ -1076,6 +1173,21 @@ class ShardedDeviceTable:
         keep = ti >= 0
         if tel.enabled:
             tel.observe_family("mesh_combine_seconds", tel.clock() - t0)
+        sc = self.scope
+        if sc is not None and rec is not None and mh == mh0:
+            # escalated dispatches re-ran synchronously — their clock
+            # pairs no longer describe one dispatch, so they are
+            # dropped (the escalation is already counted above)
+            shards = None
+            if rec.sampled:
+                rs = self._mesh_mod.shard_rows(
+                    self.table.capacity, self.mesh
+                )
+                shards = ri[keep] // rs
+            sc.finish(
+                rec, self, ticket, mh,
+                hits=int(keep.sum()), shard_ids=shards,
+            )
         return ti[keep], ri[keep]
 
     def match_ids(self, enc: EncodedTopics, residual: bool = False):
@@ -1095,20 +1207,29 @@ class ShardedDeviceTable:
         if self.degraded:
             return ("1dev",) + self._single.match_hash_begin(enc)
         assert self._dev_slots is not None, "sync() before matching"
+        sc = self.scope
+        rec = None
+        if sc is not None:
+            rec = sc.begin("hash", self._nchips())
+            enc = self._mesh_mod.pad_topics(enc, self.mesh)
+            sc.lap(rec, "host_encode")
         t_dev = self._mesh_mod.put_topics(enc, self.mesh)
+        if rec is not None:
+            sc.lap(rec, "h2d_stage")
         mh = self._block_mh()
         self.telemetry.record_shape(
             "mesh_match_ids_hash", (int(t_dev.ids.shape[0]), mh)
         )
         from ..ops import transfer as transfer_ops
 
-        return (
-            t_dev, mh,
-            transfer_ops.start_fetch(
-                self._hash_kernel(mh)(self._dev_meta, self._dev_slots, t_dev),
-                self.telemetry,
-            ),
-        )
+        out = self._hash_kernel(mh)(self._dev_meta, self._dev_slots, t_dev)
+        if rec is not None:
+            sc.lap(rec, "program_launch")
+        STAGE_MARK.stage = "ticket_start"
+        ticket = transfer_ops.start_fetch(out, self.telemetry)
+        if rec is not None:
+            sc.attach(rec, ticket)
+        return (t_dev, mh, rec, ticket)
 
     def match_hash_finish(self, pending):
         """Force the transfers for a begun hash match, escalating
@@ -1118,11 +1239,12 @@ class ShardedDeviceTable:
 
         if pending[0] == "1dev":
             return self._single.match_hash_finish(pending[1:])
-        t_dev, mh, ticket = pending
+        t_dev, mh, rec, ticket = pending
         tel = self.telemetry
         t0 = tel.clock()
         ti, bi, totals, amb = ticket.wait()
         totals = np.asarray(totals)
+        mh0 = mh
         while int(totals.max(initial=0)) > mh:
             tel.count("hash_overflow_retries_total")
             mh = max(mh * 2, 1 << int(totals.max()).bit_length())
@@ -1139,6 +1261,17 @@ class ShardedDeviceTable:
         keep = ti >= 0
         if tel.enabled:
             tel.observe_family("mesh_combine_seconds", tel.clock() - t0)
+        sc = self.scope
+        if sc is not None and rec is not None and mh == mh0:
+            shards = None
+            if rec.sampled:
+                n_sub = self.mesh.shape[SUB_AXIS]
+                nb_loc = -(-self.index.n_buckets // n_sub)
+                shards = bi[keep] // nb_loc
+            sc.finish(
+                rec, self, ticket, mh,
+                hits=int(keep.sum()), shard_ids=shards,
+            )
         return ti[keep], bi[keep], int(np.asarray(amb).reshape(-1)[0])
 
     def match_hash(self, enc: EncodedTopics):
@@ -1237,4 +1370,11 @@ class ShardedDeviceTable:
             self.telemetry.record_shape("mesh_match_ids_hash", (b, mh2))
             self._hash_kernel(mh2)(self._dev_meta, self._dev_slots, t_dev)
             warmed += 1
+        sc = self.scope
+        if sc is not None:
+            # pre-warm the microscope's combine-only probe at the
+            # current block capacity and its first escalation so
+            # serve-time sampled splits never compile
+            warmed += sc.warm_probe(self, self._block_mh())
+            warmed += sc.warm_probe(self, mh2)
         return warmed

@@ -35,6 +35,16 @@ import inspect
 import pytest
 
 
+# per-test wall: 30s of tuned budget, stretched by the measured box
+# throughput (emqx_tpu/chaos/boxcal.py — dependency-free, safe at
+# collection time) so 1-core boxes don't flake the chaos/replication
+# tests that legitimately fill the window; capped at 120s so a hang is
+# still a hang
+from emqx_tpu.chaos.boxcal import scaled as _box_scaled
+
+TEST_WALL_S = min(120.0, _box_scaled(30.0))
+
+
 @pytest.hookimpl(tryfirst=True)
 def pytest_pyfunc_call(pyfuncitem):
     func = pyfuncitem.obj
@@ -43,7 +53,7 @@ def pytest_pyfunc_call(pyfuncitem):
             name: pyfuncitem.funcargs[name]
             for name in pyfuncitem._fixtureinfo.argnames
         }
-        asyncio.run(asyncio.wait_for(func(**kwargs), timeout=30))
+        asyncio.run(asyncio.wait_for(func(**kwargs), timeout=TEST_WALL_S))
         return True
     return None
 

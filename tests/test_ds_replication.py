@@ -39,6 +39,24 @@ async def settle(t=0.15):
     await asyncio.sleep(t)
 
 
+async def settle_until(pred, budget=5.0, poll=0.05):
+    """Poll-with-deadline, box-scaled (emqx_tpu/chaos/boxcal.py): waits
+    only as long as the condition needs on THIS box instead of a tuned
+    wall sleep — the fixed-sleep ladders straddled the per-test wall on
+    1-core boxes. Returns True when `pred` held within the budget."""
+    from emqx_tpu.chaos.boxcal import scaled as box_scaled
+
+    import time as _time
+
+    deadline = _time.monotonic() + box_scaled(budget)
+    while True:
+        if pred():
+            return True
+        if _time.monotonic() >= deadline:
+            return False
+        await asyncio.sleep(poll)
+
+
 DUR = SessionConfig(session_expiry_interval=3600)
 
 
@@ -480,12 +498,36 @@ async def test_split_brain_two_leaders_single_history(tmp_path):
     n2, m2, db2, r2, a2 = await make_node("n2", tmp_path, seed=a1)
     n3, m3, db3, r3, a3 = await make_node("n3", tmp_path, seed=a1)
     try:
-        await settle(0.3)
+        # poll-with-deadline instead of tuned sleeps: this test straddled
+        # the per-test wall on 1-core boxes (each fixed sleep was sized
+        # for a fast box); polling converges as fast as the box allows
+        # and the budget stretches via boxcal on slow ones
+        # membership AND bpapi hello must both have converged: before
+        # the hello exchange _resolve_version defaults to v1, while the
+        # ds handlers register at v2 — an RPC in that window dies with
+        # "no handler for ds v1" (the race the old 0.3s sleep papered over)
+        def joined():
+            nodes = {"n1": n1, "n2": n2, "n3": n3}
+            for name, node in nodes.items():
+                for peer in nodes:
+                    if peer == name:
+                        continue
+                    if peer not in node.membership.members:
+                        return False
+                    if "ds" not in node.rpc.peer_versions.get(peer, {}):
+                        return False
+            return True
+
+        assert await settle_until(joined, budget=5.0), (
+            "cluster membership/bpapi negotiation did not converge"
+        )
         # durable route known cluster-wide (the persist gate)
         s, _ = n3.broker.open_session("dev", True, DUR)
         n3.broker.subscribe(s, "jobs/#", SubOpts(qos=1))
-        await settle(0.3)
-        assert m1.needs_persist("jobs/x") and m2.needs_persist("jobs/x")
+        assert await settle_until(
+            lambda: m1.needs_persist("jobs/x") and m2.needs_persist("jobs/x"),
+            budget=5.0,
+        ), "durable route did not propagate to n1/n2"
 
         # --- partition the VIEW: n2 declares n1 dead and holds it
         n2.membership.members.pop("n1", None)
@@ -499,13 +541,14 @@ async def test_split_brain_two_leaders_single_history(tmp_path):
             orig_add(nid, addr)
 
         n2.membership._add_member = stubborn_add
-        await settle(0.1)
         # two leaders for some shard now exist: n1's view elects n1,
         # n2's smaller view elects differently for at least one shard
-        views_differ = any(
-            r1.leader_of(sh) != r2.leader_of(sh) for sh in range(2)
-        )
-        assert views_differ, "partition did not produce leader divergence"
+        assert await settle_until(
+            lambda: any(
+                r1.leader_of(sh) != r2.leader_of(sh) for sh in range(2)
+            ),
+            budget=5.0,
+        ), "partition did not produce leader divergence"
 
         # write through BOTH sides of the brain
         for i in range(6):
@@ -518,21 +561,36 @@ async def test_split_brain_two_leaders_single_history(tmp_path):
                 from_client="p2",
             ))
             await settle(0.05)
-        await settle(0.5)
+        # both brains must have committed locally before healing, or the
+        # convergence check below races the in-flight appends
+        assert await settle_until(
+            lambda: sum(len(lg) for lg in r1._log.values()) > 0
+            and sum(len(lg) for lg in r2._log.values()) > 0,
+            budget=5.0,
+        ), "split-brain writes did not commit on both sides"
 
-        # --- heal: n2 re-learns n1
+        # --- heal: n2 re-learns n1 (heartbeats + piggybacked resync)
         n2.membership._add_member = orig_add
         n2.membership._add_member("n1", a1)
-        await settle(1.2)  # heartbeats + piggybacked resync
+        assert await settle_until(
+            lambda: "n1" in n2.membership.members, budget=10.0
+        ), "n2 did not re-learn n1 after heal"
         # post-heal writes drive the lagging replicas' gap recovery
         # (raft heals trailing followers on the next append); poll for
         # frontier convergence
         n3.broker.publish(Message(
             topic="jobs/a", payload=b"post-heal", qos=1, from_client="p3",
         ))
-        for _ in range(30):
+        import time as _time
+
+        from emqx_tpu.chaos.boxcal import scaled as _scaled
+
+        deadline = _time.monotonic() + _scaled(12.0)
+        while True:
             await settle(0.3)
             if dict(r1._applied) == dict(r2._applied) == dict(r3._applied):
+                break
+            if _time.monotonic() >= deadline:
                 break
             n3.broker.publish(Message(
                 topic="jobs/a", payload=b"nudge", qos=1, from_client="p3",

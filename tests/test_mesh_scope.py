@@ -1,0 +1,196 @@
+"""ISSUE-20 mesh microscope: per-dispatch decomposition of every mesh
+match/sync dispatch into six sub-stages, self-checked against the
+dispatch wall, plus the collective-cost ledger and the per-chip busy
+timeline. Everything here drives REAL dispatches on a forced-host
+multi-device mesh — never hand-poked histograms.
+
+Kernel economics: each Broker(mesh=...) build compiles a fresh set of
+shard_map kernels (~20s on CPU), so the width-4 tests share ONE broker
+and attach a fresh MeshScope per test; only the destructive evacuation
+test and the 1/8-wide decomposition legs pay for their own mesh."""
+
+import jax
+import pytest
+
+from emqx_tpu.broker.packet import SubOpts
+from emqx_tpu.broker.pubsub import Broker
+from emqx_tpu.obs.mesh_scope import DECOMP_TOLERANCE, MESH_STAGES, MeshScope
+from emqx_tpu.parallel import mesh as mesh_mod
+
+
+def _scoped_broker(n_sub, sample_n=1, routes=32):
+    mesh = mesh_mod.make_mesh(
+        n_dp=1, n_sub=n_sub, devices=jax.devices()[:n_sub]
+    )
+    broker = Broker(mesh=mesh)
+    r = broker.router
+    sc = MeshScope(telemetry=r.telemetry, sample_n=sample_n)
+    r.device_table.scope = sc
+    for i in range(routes):
+        s, _ = broker.open_session(f"c{i}", clean_start=True)
+        s.outgoing_sink = lambda pkts: None
+        broker.subscribe(s, f"m/{i}/+/v/#", SubOpts(qos=0))
+    # warmup_shapes reaches warmup_escalated, which pre-warms the
+    # combine-only probe at the serving (shard_gen, mh) shapes
+    r.warmup_shapes(max_batch=16)
+    r.telemetry.mark_serving()
+    return broker, r, sc
+
+
+_SHARED = {}
+
+
+def _shared4():
+    """The shared 4-wide broker, re-armed with a FRESH MeshScope so
+    every test starts from zeroed ledgers (probe re-warmed through the
+    already-compiled kernel cache — no serve-time retrace)."""
+    if "b" not in _SHARED:
+        _SHARED["b"] = _scoped_broker(4)
+    broker, r, _ = _SHARED["b"]
+    dt = r.device_table
+    sc = MeshScope(telemetry=r.telemetry, sample_n=1)
+    dt.scope = sc
+    sc.warm_probe(dt, dt._block_mh())
+    return broker, r, sc
+
+
+@pytest.mark.parametrize("n_sub", [1, 4, 8])
+def test_decomposition_sums_to_wall(n_sub):
+    """Every ticketed dispatch decomposes into the six stages and the
+    stage sum lands within DECOMP_TOLERANCE of the dispatch wall — on
+    1-, 4- and 8-device meshes (the committed-profile widths)."""
+    if n_sub == 4:
+        broker, r, sc = _shared4()
+    else:
+        broker, r, sc = _scoped_broker(n_sub)
+    topics = [f"m/{i}/a/v/w" for i in range(8)]
+    for _ in range(6):
+        r.match_filters_batch(topics)
+    st = sc.status()
+    assert st["dispatches"] > 0
+    checked = st["decomp"]["in_band"] + st["decomp"]["out_of_band"]
+    assert checked >= 6
+    assert st["decomp"]["in_band_ratio"] >= 0.9, st["decomp"]
+    assert (
+        1 - DECOMP_TOLERANCE
+        <= st["decomp"]["last_ratio"]
+        <= 1 + DECOMP_TOLERANCE
+    )
+    # all six stages recorded for the serving width
+    stages = st["stages"][str(n_sub)]
+    for stage in MESH_STAGES:
+        assert stage in stages, (n_sub, stage, sorted(stages))
+        assert stages[stage]["count"] > 0
+    # the bench gate: recorded stage seconds cover >= 0.9 of the wall
+    assert st["stage_wall_ratio"][str(n_sub)] >= 0.9, st["stage_wall_ratio"]
+    # sampling the probe never retraced at serve time
+    assert sc.splits_sampled > 0
+    assert r.telemetry.counters.get("recompiles_at_serve_total", 0) == 0
+
+
+def test_toggle_off_zero_hooks():
+    """With no scope attached (tpu_mesh_scope_enable=false boots this
+    way) the served path takes zero clocks: begin halves return a None
+    record and the FetchTicket keeps its land hook unset."""
+    from emqx_tpu.ops import match as match_ops
+
+    broker, r, _ = _shared4()
+    dt = r.device_table
+    dt.scope = None  # the disabled contract: attribute stays None
+    r.match_filters_batch([f"m/{i}/a/v/w" for i in range(8)])  # sync
+    enc = match_ops.encode_topics(
+        r.table.vocab, [f"m/{i}/a/v/w" for i in range(8)], r.max_levels
+    )
+    # the production (hash) begin half, at the warmed batch shape
+    pending = dt.match_hash_begin(enc)
+    *_, rec, ticket = pending
+    assert rec is None
+    assert ticket.land_clock is None
+    dt.match_hash_finish(pending)
+    assert ticket.landed_at is None  # hook never armed, nothing stamped
+    assert r.telemetry.counters.get("recompiles_at_serve_total", 0) == 0
+
+
+def test_collective_ledger_bytes_and_occupancy():
+    """Gathered-buffer bytes follow the O(N) flat-gather formula
+    dp * n_sub * mh * 2 lanes * 4 B exactly, and occupancy is
+    hits / (dp * mh)."""
+    broker, r, sc = _shared4()
+    r.match_filters_batch([f"m/{i}/a/v/w" for i in range(8)])
+    dt = r.device_table
+    mh = dt._block_mh()
+    per_dispatch = 1 * 4 * mh * 2 * 4
+    assert sc.gather_bytes_total > 0
+    assert sc.gather_bytes_total % per_dispatch == 0
+    assert sc.gather_bytes_last == per_dispatch
+    assert 0.0 < sc.occupancy_last <= 1.0
+    st = sc.status()
+    assert st["collective"]["gather_bytes_total"] == sc.gather_bytes_total
+    occ = st["collective"]["occupancy"]["4"]
+    assert occ["count"] > 0
+    # sampled skew: min <= median <= max
+    skew = st["shard_skew"]
+    assert skew is not None
+    assert skew["min"] <= skew["median"] <= skew["max"]
+
+
+def test_probe_skip_counter_on_unwarmed_shape():
+    """A sampled dispatch whose (shard_gen, mh) probe was never warmed
+    skips the combine split, counts it honestly, and does NOT retrace
+    at serve time."""
+    broker, r, sc = _shared4()
+    sc._probe_warm.clear()
+    r.match_filters_batch([f"m/{i}/a/v/w" for i in range(8)])
+    assert sc.split_skipped > 0
+    assert r.telemetry.counters.get("recompiles_at_serve_total", 0) == 0
+    # warming restores sampling without a serve-time retrace
+    dt = r.device_table
+    assert sc.warm_probe(dt, dt._block_mh()) == 1
+    sampled0 = sc.splits_sampled
+    r.match_filters_batch([f"m/{i}/a/v/w" for i in range(8)])
+    assert sc.splits_sampled > sampled0
+    assert r.telemetry.counters.get("recompiles_at_serve_total", 0) == 0
+
+
+def test_sync_dispatches_lap_host_stages():
+    """Sync dispatches decompose into host_encode/h2d_stage (+launch on
+    the delta paths) but never enter the ticketed self-check — their
+    donated outputs stay on device."""
+    broker, r, sc = _shared4()
+    # native delete + re-add dirties rows and slots: the next match's
+    # sync rides the fused delta dispatch through the scope
+    r.delete_route("m/3/+/v/#", "c3")
+    r.add_route("m/3/+/v/#", "c3")
+    r.match_filters_batch([f"m/{i}/a/v/w" for i in range(8)])
+    st = sc.status()
+    stages = st["stages"]["4"]
+    assert stages["host_encode"]["count"] > 0
+    assert stages["h2d_stage"]["count"] > 0
+    # ticketed checks advanced for the match dispatches
+    assert sc.decomp_in_band + sc.decomp_out_of_band > 0
+
+
+def test_per_chip_timeline_bounds_and_evacuation():
+    """Per-chip busy ratios stay in [0, 1]; after evacuate_shard the
+    lost chip stops accruing busy time while survivors keep serving.
+    Destructive (re-shards the mesh), so it owns its broker."""
+    broker, r, sc = _scoped_broker(4, routes=16)
+    topics = [f"m/{i}/a/v/w" for i in range(8)]
+    for _ in range(4):
+        r.match_filters_batch(topics)
+    ratios = sc.chip_ratios()
+    assert len(ratios) == 4
+    for cid, ratio in ratios.items():
+        assert 0.0 <= ratio <= 1.0, (cid, ratio)
+    dt = r.device_table
+    lost_chip = int(dt.mesh.devices.reshape(-1)[1].id)
+    assert r.evacuate_shard(1)
+    # survivors' probe shapes changed with the re-shard: re-warm before
+    # driving so sampled splits stay hot (serve discipline)
+    r.warmup_shapes(max_batch=16)
+    frozen = sc.chips[lost_chip][0]
+    for _ in range(4):
+        r.match_filters_batch(topics)
+    assert sc.chips[lost_chip][0] == frozen, "evacuated chip still accruing"
+    survivors = [c for c in sc.chips if c != lost_chip]
+    assert any(sc.chips[c][0] > 0 for c in survivors)

@@ -624,6 +624,51 @@ def test_delivery_stages_have_recording_sites_and_lint_coverage():
     )
 
 
+def test_mesh_stages_have_recording_sites_and_lint_coverage():
+    """No orphan MESH sub-stages (ISSUE 20): every stage name in
+    obs/mesh_scope.MESH_STAGES must (a) have a live recording site
+    outside the declaring module — a begin-half `lap(rec, "<stage>")`
+    clock fold in the sharded dispatch path, or a finish-half
+    `_observe_stage(rec, "<stage>", ...)` split in the scope itself
+    (the device-span stages can only be recorded there: the launch/land
+    clock pair and the combine probe are scope machinery) — and (b)
+    appear in the prometheus lint suite, which asserts every stage
+    label on a real 4-device emqx_xla_mesh_stage_seconds scrape."""
+    from emqx_tpu.obs.mesh_scope import MESH_STAGES
+
+    corpus = {}
+    for path in _sources():
+        corpus[path] = path.read_text()
+    lint_src = (REPO / "tests" / "test_prometheus_lint.py").read_text()
+    assert "emqx_xla_mesh_stage_seconds" in lint_src, (
+        "the mesh-stage family lost its lint-leg coverage"
+    )
+    orphans = []
+    unchecked = []
+    for stage in MESH_STAGES:
+        recorded = any(
+            f'lap(rec, "{stage}"' in text
+            or (
+                path.name == "mesh_scope.py"
+                and f'_observe_stage(rec, "{stage}"' in text
+            )
+            for path, text in corpus.items()
+        )
+        # the generic finish-half fold (`for stage, s in rec.laps`)
+        # doesn't count: it only re-emits what a lap already recorded
+        if not recorded:
+            orphans.append(stage)
+        if f'"{stage}"' not in lint_src and "MESH_STAGES" not in lint_src:
+            unchecked.append(stage)
+    assert not orphans, (
+        "mesh sub-stages declared but never recorded on the sharded "
+        f"dispatch path: {orphans}"
+    )
+    assert not unchecked, (
+        f"mesh sub-stages with no lint-leg coverage: {unchecked}"
+    )
+
+
 # --- leg 7 (ISSUE 9): no blocking host fetches outside finish sites -------
 
 # The transfer pipeline's whole win is that begin halves LAUNCH and
